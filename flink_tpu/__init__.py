@@ -1,0 +1,34 @@
+"""flink_tpu — a TPU-native stream-processing framework.
+
+A ground-up re-design of Apache Flink's semantic contracts (dataflow graph,
+keyed partitioning by key-group, event-time watermarks + timers, trigger-based
+windows, aligned-barrier exactly-once snapshots, pluggable state backend &
+shuffle) executed as vectorized micro-batches on a TPU device mesh:
+
+- records are columnar batches (``flink_tpu.core.records.RecordBatch``)
+- per-key windowed state is a TPU-resident key->slot table
+  (``flink_tpu.state.slot_table.SlotTable``)
+- ``AggregateFunction.add`` over a batch is one jitted segment-reduce
+  (``flink_tpu.ops.segment_ops``)
+- ``keyBy`` shards the key-group axis over a ``jax.sharding.Mesh``
+  (``flink_tpu.parallel``)
+- window fires are masked segment-extracts triggered by watermark advance
+- snapshots are async device_get of the slot arrays + host hash maps
+
+Reference semantics: Apache Flink 2.x (see SURVEY.md). This is not a port;
+the architecture is JAX/XLA-first.
+"""
+
+from flink_tpu.version import __version__
+
+from flink_tpu.core.config import ConfigOption, Configuration
+from flink_tpu.core.records import RecordBatch
+from flink_tpu.datastream.environment import StreamExecutionEnvironment
+
+__all__ = [
+    "__version__",
+    "ConfigOption",
+    "Configuration",
+    "RecordBatch",
+    "StreamExecutionEnvironment",
+]

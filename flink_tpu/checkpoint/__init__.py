@@ -1,0 +1,3 @@
+from flink_tpu.checkpoint.storage import CheckpointStorage, CheckpointMetadata
+
+__all__ = ["CheckpointStorage", "CheckpointMetadata"]

@@ -1,0 +1,3 @@
+from flink_tpu.cluster.local_executor import LocalExecutor
+
+__all__ = ["LocalExecutor"]

@@ -1,0 +1,183 @@
+"""Local single-process executor — the MiniCluster analog.
+
+reference: runtime/minicluster/MiniCluster.java runs the whole control plane
+in one JVM for tests; the per-task engine is the mailbox loop
+(streaming/runtime/tasks/StreamTask.java:916 + MailboxProcessor.java:214).
+
+Re-design: one Python thread owns the whole dataflow (single-owner discipline
+— the mailbox model without the mailbox). Sources are polled round-robin into
+micro-batches; each batch is pushed depth-first through the operator DAG;
+watermarks are merged per multi-input operator via WatermarkValve. Operator
+"chaining" is implicit (direct method calls); the heavy per-batch math inside
+WindowAggOperator is the jitted device code. Checkpoint barriers are batch
+boundaries: the executor simply snapshots all operators between pushes
+(alignment is structural — SURVEY.md §7 step 6).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from flink_tpu.core.config import (
+    BatchOptions,
+    CheckpointOptions,
+    Configuration,
+    CoreOptions,
+    StateOptions,
+)
+from flink_tpu.core.records import RecordBatch
+from flink_tpu.graph.transformations import StreamGraph, Transformation
+from flink_tpu.runtime.elements import MAX_WATERMARK, Watermark
+from flink_tpu.runtime.operators import Operator, OperatorContext
+from flink_tpu.runtime.watermarks import WatermarkValve
+
+
+class _Node:
+    __slots__ = ("transformation", "operator", "valve", "children",
+                 "child_input_idx", "records_in", "records_out")
+
+    def __init__(self, transformation: Transformation,
+                 operator: Optional[Operator]):
+        self.transformation = transformation
+        self.operator = operator
+        self.valve = WatermarkValve(max(len(transformation.inputs), 1))
+        self.children: List[_Node] = []
+        self.child_input_idx: List[int] = []
+        self.records_in = 0
+        self.records_out = 0
+
+
+class LocalExecutor:
+    def __init__(self, config: Optional[Configuration] = None):
+        self.config = config or Configuration()
+
+    def run(self, graph: StreamGraph, job_name: str = "job",
+            checkpoint_hook=None):
+        from flink_tpu.datastream.environment import JobExecutionResult
+
+        batch_size = self.config.get(BatchOptions.BATCH_SIZE)
+        max_parallelism = self.config.get(CoreOptions.MAX_PARALLELISM)
+        ckpt_interval = self.config.get(CheckpointOptions.INTERVAL_MS)
+
+        # build nodes
+        nodes: Dict[int, _Node] = {}
+        ctx = OperatorContext(operator_index=0, parallelism=1,
+                              max_parallelism=max_parallelism)
+        for t in graph.nodes:
+            op = t.operator_factory() if t.operator_factory else None
+            node = _Node(t, op)
+            if op is not None:
+                op.open(ctx)
+            nodes[t.uid] = node
+        for t in graph.nodes:
+            n = nodes[t.uid]
+            for child_t in graph.children(t):
+                n.children.append(nodes[child_t.uid])
+                n.child_input_idx.append(
+                    graph.input_index(t, child_t))
+
+        sources = [(t, nodes[t.uid]) for t in graph.sources]
+        generators = {}
+        for t, _ in sources:
+            t.source.open(0, 1)
+            generators[t.uid] = t.watermark_strategy.create()
+
+        t0 = time.perf_counter()
+        total_records = 0
+        last_ckpt = time.time() * 1000
+        checkpoint_count = 0
+
+        active = {t.uid for t, _ in sources}
+        while active:
+            progressed = False
+            for t, node in sources:
+                if t.uid not in active:
+                    continue
+                batch = t.source.poll_batch(batch_size)
+                if batch is None:
+                    active.discard(t.uid)
+                    self._emit_watermark(node, MAX_WATERMARK)
+                    t.source.close()
+                    continue
+                if len(batch) == 0:
+                    continue
+                progressed = True
+                batch = t.watermark_strategy.assign_timestamps(batch)
+                total_records += len(batch)
+                self._emit_batch(node, batch)
+                wm = generators[t.uid].on_batch(batch)
+                if wm is not None:
+                    self._emit_watermark(node, wm)
+            if ckpt_interval and checkpoint_hook is not None:
+                now = time.time() * 1000
+                if now - last_ckpt >= ckpt_interval:
+                    checkpoint_count += 1
+                    checkpoint_hook(self.snapshot_all(nodes), checkpoint_count)
+                    last_ckpt = now
+            if not progressed and active:
+                time.sleep(0.001)
+
+        # drain/close in topological order
+        for t in graph.nodes:
+            node = nodes[t.uid]
+            if node.operator is not None:
+                for out in node.operator.close():
+                    self._forward(node, out)
+
+        elapsed = time.perf_counter() - t0
+        metrics = {
+            "records_emitted_by_sources": total_records,
+            "runtime_s": elapsed,
+            "records_per_s": total_records / elapsed if elapsed > 0 else 0.0,
+            "checkpoints": checkpoint_count,
+            "per_operator": {
+                f"{n.transformation.name}#{uid}": {
+                    "records_in": n.records_in, "records_out": n.records_out}
+                for uid, n in nodes.items()
+            },
+        }
+        return JobExecutionResult(job_name, metrics)
+
+    # ------------------------------------------------------------- plumbing
+
+    def _emit_batch(self, node: _Node, batch: RecordBatch) -> None:
+        for child, idx in zip(node.children, node.child_input_idx):
+            self._process(child, batch, idx)
+
+    def _emit_watermark(self, node: _Node, wm: int) -> None:
+        for child, idx in zip(node.children, node.child_input_idx):
+            self._process_watermark(child, wm, idx)
+
+    def _process(self, node: _Node, batch: RecordBatch, input_idx: int) -> None:
+        node.records_in += len(batch)
+        outs = node.operator.process_batch(batch, input_idx)
+        for out in outs:
+            self._forward(node, out)
+
+    def _process_watermark(self, node: _Node, wm: int, input_idx: int) -> None:
+        advanced = node.valve.advance(input_idx, wm)
+        if advanced is None:
+            return
+        outs = node.operator.process_watermark(advanced)
+        for out in outs:
+            self._forward(node, out)
+        self._emit_watermark(node, advanced)
+
+    def _forward(self, node: _Node, batch: RecordBatch) -> None:
+        node.records_out += len(batch)
+        self._emit_batch(node, batch)
+
+    # ----------------------------------------------------------- checkpoint
+
+    @staticmethod
+    def snapshot_all(nodes: Dict[int, _Node]) -> Dict[str, Any]:
+        snap: Dict[str, Any] = {}
+        for uid, node in nodes.items():
+            if node.operator is None:
+                state = {"source": node.transformation.source.snapshot_position()}
+            else:
+                state = node.operator.snapshot_state()
+            if state:
+                snap[str(uid)] = state
+        return snap

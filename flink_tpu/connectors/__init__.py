@@ -1,0 +1,17 @@
+from flink_tpu.connectors.sources import (
+    Source,
+    CollectionSource,
+    DataGenSource,
+    SocketSource,
+)
+from flink_tpu.connectors.sinks import Sink, CollectSink, PrintSink
+
+__all__ = [
+    "Source",
+    "CollectionSource",
+    "DataGenSource",
+    "SocketSource",
+    "Sink",
+    "CollectSink",
+    "PrintSink",
+]

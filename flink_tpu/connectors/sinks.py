@@ -1,0 +1,48 @@
+"""Sinks (reference: Sink V2, flink-core/.../api/connector/sink2/)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from flink_tpu.core.records import RecordBatch
+
+
+class Sink:
+    def open(self, subtask_index: int = 0) -> None:
+        pass
+
+    def write(self, batch: RecordBatch) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class CollectSink(Sink):
+    """Collects all batches in memory (tests / execute_and_collect)."""
+
+    def __init__(self):
+        self.batches: List[RecordBatch] = []
+
+    def write(self, batch):
+        self.batches.append(batch)
+
+    def result(self) -> RecordBatch:
+        return RecordBatch.concat(self.batches)
+
+    def rows(self):
+        return self.result().to_rows()
+
+
+class PrintSink(Sink):
+    def __init__(self, label: str = "", max_rows_per_batch: Optional[int] = 20):
+        self.label = label
+        self.max_rows = max_rows_per_batch
+
+    def write(self, batch):
+        rows = batch.to_rows()
+        shown = rows if self.max_rows is None else rows[: self.max_rows]
+        for r in shown:
+            print(f"{self.label}> {r}")
+        if self.max_rows is not None and len(rows) > self.max_rows:
+            print(f"{self.label}> ... {len(rows) - self.max_rows} more")

@@ -1,0 +1,4 @@
+from flink_tpu.core.config import ConfigOption, Configuration
+from flink_tpu.core.records import RecordBatch, Schema, Field
+
+__all__ = ["ConfigOption", "Configuration", "RecordBatch", "Schema", "Field"]

@@ -1,0 +1,5 @@
+from flink_tpu.datastream.environment import StreamExecutionEnvironment
+from flink_tpu.datastream.stream import DataStream, KeyedStream, WindowedStream
+
+__all__ = ["StreamExecutionEnvironment", "DataStream", "KeyedStream",
+           "WindowedStream"]

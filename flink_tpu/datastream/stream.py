@@ -1,0 +1,182 @@
+"""The DataStream fluent API.
+
+reference: streaming/api/datastream/DataStream.java, KeyedStream.java,
+WindowedStream.java (e.g. WindowedStream.aggregate at
+streaming/api/datastream/WindowedStream.java:310). The fluent surface is kept;
+the semantics of each method build ``Transformation`` nodes that the executor
+turns into batched operators.
+
+User functions are vectorized (RecordBatch -> RecordBatch / mask); see
+flink_tpu.runtime.operators.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from flink_tpu.core.records import RecordBatch
+from flink_tpu.graph.transformations import Transformation
+from flink_tpu.runtime.operators import (
+    FilterOperator,
+    FlatMapOperator,
+    KeyByOperator,
+    MapOperator,
+    SinkOperator,
+    UnionOperator,
+    WindowAggOperator,
+)
+from flink_tpu.windowing.aggregates import (
+    AggregateFunction,
+    AvgAggregate,
+    CountAggregate,
+    MaxAggregate,
+    MinAggregate,
+    MultiAggregate,
+    SumAggregate,
+)
+from flink_tpu.windowing.assigners import WindowAssigner
+
+if TYPE_CHECKING:
+    from flink_tpu.connectors.sinks import Sink
+    from flink_tpu.datastream.environment import StreamExecutionEnvironment
+
+
+class DataStream:
+    def __init__(self, env: "StreamExecutionEnvironment",
+                 transformation: Transformation):
+        self.env = env
+        self.transformation = transformation
+
+    # ------------------------------------------------------------ stateless
+
+    def map(self, fn: Callable[[RecordBatch], RecordBatch],
+            name: str = "map") -> "DataStream":
+        t = Transformation(name=name, kind="one_input",
+                           operator_factory=lambda: MapOperator(fn),
+                           inputs=[self.transformation])
+        return DataStream(self.env, t)
+
+    def filter(self, predicate: Callable[[RecordBatch], np.ndarray],
+               name: str = "filter") -> "DataStream":
+        t = Transformation(name=name, kind="one_input",
+                           operator_factory=lambda: FilterOperator(predicate),
+                           inputs=[self.transformation])
+        return DataStream(self.env, t)
+
+    def flat_map(self, fn: Callable[[RecordBatch], List[RecordBatch]],
+                 name: str = "flat_map") -> "DataStream":
+        t = Transformation(name=name, kind="one_input",
+                           operator_factory=lambda: FlatMapOperator(fn),
+                           inputs=[self.transformation])
+        return DataStream(self.env, t)
+
+    def union(self, *others: "DataStream") -> "DataStream":
+        t = Transformation(
+            name="union", kind="union",
+            operator_factory=UnionOperator,
+            inputs=[self.transformation] + [o.transformation for o in others])
+        return DataStream(self.env, t)
+
+    # --------------------------------------------------------------- keying
+
+    def key_by(self, key_field: str) -> "KeyedStream":
+        t = Transformation(
+            name=f"key_by({key_field})", kind="one_input",
+            operator_factory=lambda: KeyByOperator(key_field),
+            inputs=[self.transformation], keyed=True, key_field=key_field)
+        return KeyedStream(self.env, t, key_field)
+
+    # ---------------------------------------------------------------- sinks
+
+    def sink_to(self, sink: "Sink", name: str = "sink") -> "DataStreamSink":
+        sink.open()
+        t = Transformation(name=name, kind="sink",
+                           operator_factory=lambda: SinkOperator(sink.write),
+                           inputs=[self.transformation])
+        self.env._sinks.append(t)
+        return DataStreamSink(self.env, t, sink)
+
+    def print(self, label: str = "") -> "DataStreamSink":
+        from flink_tpu.connectors.sinks import PrintSink
+
+        return self.sink_to(PrintSink(label), name="print")
+
+    def execute_and_collect(self) -> RecordBatch:
+        """Convenience: attach a collect sink, run, return the result batch."""
+        from flink_tpu.connectors.sinks import CollectSink
+
+        sink = CollectSink()
+        self.sink_to(sink, name="collect")
+        self.env.execute()
+        return sink.result()
+
+
+class DataStreamSink:
+    def __init__(self, env, transformation, sink):
+        self.env = env
+        self.transformation = transformation
+        self.sink = sink
+
+
+class KeyedStream(DataStream):
+    def __init__(self, env, transformation, key_field: str):
+        super().__init__(env, transformation)
+        self.key_field = key_field
+
+    def window(self, assigner: WindowAssigner) -> "WindowedStream":
+        return WindowedStream(self, assigner)
+
+    # keyed running aggregates without windows (KeyedStream.sum/reduce in the
+    # reference) can be expressed as a GlobalWindow; deferred to the table
+    # runtime's GroupAggOperator equivalent.
+
+
+class WindowedStream:
+    """reference: streaming/api/datastream/WindowedStream.java."""
+
+    def __init__(self, keyed: KeyedStream, assigner: WindowAssigner):
+        self.keyed = keyed
+        self.assigner = assigner
+        self._allowed_lateness = 0
+
+    def allowed_lateness(self, ms: int) -> "WindowedStream":
+        self._allowed_lateness = ms
+        return self
+
+    def aggregate(self, agg: AggregateFunction,
+                  name: Optional[str] = None) -> DataStream:
+        env = self.keyed.env
+        capacity = env.state_slot_capacity
+        key_field = self.keyed.key_field
+        assigner = self.assigner
+        lateness = self._allowed_lateness
+        t = Transformation(
+            name=name or f"window_agg({type(agg).__name__})",
+            kind="one_input",
+            operator_factory=lambda: WindowAggOperator(
+                assigner, agg, key_field, capacity=capacity,
+                allowed_lateness=lateness),
+            inputs=[self.keyed.transformation],
+            keyed=True, key_field=key_field)
+        return DataStream(env, t)
+
+    # SQL-ish shorthands
+    def sum(self, field: str) -> DataStream:
+        return self.aggregate(SumAggregate(field))
+
+    def count(self) -> DataStream:
+        return self.aggregate(CountAggregate())
+
+    def max(self, field: str) -> DataStream:
+        return self.aggregate(MaxAggregate(field))
+
+    def min(self, field: str) -> DataStream:
+        return self.aggregate(MinAggregate(field))
+
+    def avg(self, field: str) -> DataStream:
+        return self.aggregate(AvgAggregate(field))
+
+    def aggregate_all(self, aggs: Sequence[AggregateFunction]) -> DataStream:
+        return self.aggregate(MultiAggregate(aggs))

@@ -1,0 +1,3 @@
+from flink_tpu.graph.transformations import Transformation, StreamGraph
+
+__all__ = ["Transformation", "StreamGraph"]

@@ -1,0 +1,8 @@
+from flink_tpu.ops.segment_ops import (
+    SCATTER_METHOD,
+    MERGE_FN,
+    pad_bucket_size,
+    pad_i32,
+)
+
+__all__ = ["SCATTER_METHOD", "MERGE_FN", "pad_bucket_size", "pad_i32"]

@@ -1,0 +1,84 @@
+"""Batched segment/scatter primitives for keyed state.
+
+This is the TPU replacement for the reference's per-record state mutation hot
+path (reference: flink-runtime/.../state/heap/HeapAggregatingState.java:94,101
+``add -> stateTable.transform`` — one virtual call + hash probe per record).
+Here an entire micro-batch of ``AggregateFunction.add`` calls collapses into
+one XLA scatter onto a device-resident slot array:
+
+    acc = acc.at[slot_ids].add(values)     # one fused kernel, N records
+
+Conventions:
+- Slot 0 is the *identity slot*: never allocated, always holds the identity
+  element. Padded lanes point at slot 0 with identity values so fixed bucket
+  shapes never change results.
+- Batches are padded to power-of-two buckets (``pad_bucket_size``) so XLA
+  compiles a small bounded set of program shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+# scatter reduce -> jnp .at[] method name
+SCATTER_METHOD: Dict[str, str] = {
+    "sum": "add",
+    "max": "max",
+    "min": "min",
+}
+
+# merge across the slice axis when combining per-slice partial aggregates
+# (the slice-sharing trick; reference:
+# flink-table-runtime/.../window/tvf/slicing/SliceAssigners.java)
+MERGE_FN: Dict[str, Callable] = {
+    "sum": jnp.sum,
+    "max": jnp.max,
+    "min": jnp.min,
+}
+
+_MIN_BUCKET = 256
+
+
+def pad_bucket_size(n: int, minimum: int = _MIN_BUCKET) -> int:
+    """Next power-of-two >= n (>= minimum). Bounds the set of XLA shapes."""
+    if n <= minimum:
+        return minimum
+    return 1 << (int(n - 1).bit_length())
+
+
+def pad_i32(a: np.ndarray, size: int, fill: int = 0) -> np.ndarray:
+    """Pad an int index array up to ``size`` with ``fill`` (slot 0 default)."""
+    a = np.asarray(a, dtype=np.int32)
+    if len(a) == size:
+        return a
+    out = np.full(size, fill, dtype=np.int32)
+    out[: len(a)] = a
+    return out
+
+
+def pad_values(a: np.ndarray, size: int, fill) -> np.ndarray:
+    a = np.asarray(a)
+    if len(a) == size:
+        return a
+    out = np.full((size,) + a.shape[1:], fill, dtype=a.dtype)
+    out[: len(a)] = a
+    return out
+
+
+def identity_for(reduce: str, dtype) -> float:
+    """Identity element of a scatter reduce for ``dtype``."""
+    dtype = np.dtype(dtype)
+    if reduce == "sum":
+        return dtype.type(0)
+    if reduce == "max":
+        if np.issubdtype(dtype, np.floating):
+            return dtype.type(-np.inf)
+        return np.iinfo(dtype).min
+    if reduce == "min":
+        if np.issubdtype(dtype, np.floating):
+            return dtype.type(np.inf)
+        return np.iinfo(dtype).max
+    raise ValueError(f"unknown reduce {reduce!r}")

@@ -1,0 +1,3 @@
+from flink_tpu.parallel.mesh import make_mesh, KEY_AXIS
+
+__all__ = ["make_mesh", "KEY_AXIS"]

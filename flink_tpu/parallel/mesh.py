@@ -1,0 +1,37 @@
+"""Device mesh construction for keyed-state sharding.
+
+The key-group axis (SURVEY.md §2.9 "keyed parallelism") is THE parallel axis
+of a streaming dataflow: state, timers and window merges are all partitioned
+by key group (reference: KeyGroupRangeAssignment.java). On TPU this axis maps
+onto a 1-D ``jax.sharding.Mesh``; cross-shard exchange ("the shuffle",
+reference: flink-runtime/.../io/network/) becomes host-side bucketing into a
+[shards, ...] leading axis + ``shard_map`` collectives over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+KEY_AXIS = "keygroups"
+
+
+def make_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
+    """A 1-D mesh over the key-group axis."""
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None:
+            devices = devices[:num_devices]
+    return Mesh(np.array(devices), (KEY_AXIS,))
+
+
+def shard_leading(mesh: Mesh) -> NamedSharding:
+    """Sharding that splits the leading axis across the key-group axis."""
+    return NamedSharding(mesh, P(KEY_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

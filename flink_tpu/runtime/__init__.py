@@ -1,0 +1,15 @@
+from flink_tpu.runtime.elements import Watermark, CheckpointBarrier, MAX_WATERMARK
+from flink_tpu.runtime.watermarks import (
+    WatermarkStrategy,
+    BoundedOutOfOrdernessWatermarks,
+    WatermarkValve,
+)
+
+__all__ = [
+    "Watermark",
+    "CheckpointBarrier",
+    "MAX_WATERMARK",
+    "WatermarkStrategy",
+    "BoundedOutOfOrdernessWatermarks",
+    "WatermarkValve",
+]

@@ -1,0 +1,17 @@
+from flink_tpu.state.keygroups import (
+    KeyGroupRange,
+    assign_key_groups,
+    compute_key_group_range,
+    key_group_to_operator_index,
+    hash_keys_to_i64,
+)
+from flink_tpu.state.slot_table import SlotTable
+
+__all__ = [
+    "KeyGroupRange",
+    "assign_key_groups",
+    "compute_key_group_range",
+    "key_group_to_operator_index",
+    "hash_keys_to_i64",
+    "SlotTable",
+]

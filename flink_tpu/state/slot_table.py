@@ -1,0 +1,249 @@
+"""Device-resident key->slot state table.
+
+This replaces the reference's per-key state backends (Heap hash table:
+flink-runtime/.../state/heap/CopyOnWriteStateTable.java; RocksDB column
+families keyed by keyGroup+key+namespace:
+flink-state-backends/flink-statebackend-rocksdb/.../RocksDBKeyedStateBackend.java)
+with a split design natural to XLA's static-shape world:
+
+- **Host**: a hash index ``(key_id, namespace) -> slot`` plus per-slot
+  metadata (key id, namespace, key group) in NumPy arrays, a free list, and a
+  namespace -> slots registry for O(fired) window expiry.
+- **Device**: the accumulator leaves — flat ``[capacity]`` jnp arrays updated
+  by donated scatter kernels (see ``flink_tpu.windowing.aggregates``).
+
+Slot 0 is reserved as the identity slot (padding target). Capacity grows by
+doubling (a bounded number of XLA recompiles). The namespace doubles as the
+window/slice id, mirroring the reference's namespace-per-window keyed state
+(reference: streaming/runtime/operators/windowing/WindowOperator.java:382
+``windowState.setCurrentNamespace(window)``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.state.keygroups import assign_key_groups
+from flink_tpu.windowing.aggregates import AggregateFunction
+from flink_tpu.ops.segment_ops import pad_bucket_size, pad_i32
+
+
+def unique_pairs(
+    key_ids: np.ndarray, namespaces: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized grouping of (key, namespace) pairs.
+
+    Returns (unique_keys, unique_namespaces, inverse) where
+    ``inverse[i]`` is the unique-pair index of record ``i``.
+    """
+    n = len(key_ids)
+    if n == 0:
+        e = np.empty(0, dtype=np.int64)
+        return e, e.copy(), np.empty(0, dtype=np.int64)
+    order = np.lexsort((key_ids, namespaces))
+    ks, ns = key_ids[order], namespaces[order]
+    new_group = np.empty(n, dtype=bool)
+    new_group[0] = True
+    new_group[1:] = (ks[1:] != ks[:-1]) | (ns[1:] != ns[:-1])
+    group_of_sorted = np.cumsum(new_group) - 1
+    inverse = np.empty(n, dtype=np.int64)
+    inverse[order] = group_of_sorted
+    first_pos = order[new_group]
+    return key_ids[first_pos], namespaces[first_pos], inverse
+
+
+class SlotTable:
+    """Keyed windowed state for one operator (one aggregate function)."""
+
+    def __init__(
+        self,
+        agg: AggregateFunction,
+        capacity: int = 1 << 16,
+        max_parallelism: int = 128,
+        device=None,
+    ) -> None:
+        self.agg = agg
+        self.capacity = max(int(capacity), 1024)
+        self.max_parallelism = max_parallelism
+        self.device = device
+        # device accumulators (leaf arrays, slot 0 = identity)
+        self.accs: Tuple[jnp.ndarray, ...] = agg.init_accumulators(self.capacity)
+        # host index + metadata
+        self._index: Dict[Tuple[int, int], int] = {}
+        self._slot_key = np.zeros(self.capacity, dtype=np.int64)
+        self._slot_ns = np.zeros(self.capacity, dtype=np.int64)
+        self._slot_used = np.zeros(self.capacity, dtype=bool)
+        # free list: slots [1, capacity) (0 reserved)
+        self._free: List[int] = list(range(self.capacity - 1, 0, -1))
+        # namespace -> list of np arrays of slots (for O(fired) expiry)
+        self._ns_slots: Dict[int, List[np.ndarray]] = {}
+
+    # ------------------------------------------------------------------ info
+
+    @property
+    def num_used(self) -> int:
+        return int(self._slot_used.sum())
+
+    @property
+    def namespaces(self) -> List[int]:
+        return list(self._ns_slots.keys())
+
+    # ------------------------------------------------------------- main path
+
+    def lookup_or_insert(
+        self, key_ids: np.ndarray, namespaces: np.ndarray
+    ) -> np.ndarray:
+        """Vectorized (key, ns) -> slot mapping; allocates missing slots.
+
+        The per-unique-pair Python dict probe is the only scalar loop on the
+        hot path (bounded by distinct keys per batch, not records).
+        """
+        uk, un, inverse = unique_pairs(
+            np.asarray(key_ids, dtype=np.int64),
+            np.asarray(namespaces, dtype=np.int64),
+        )
+        m = len(uk)
+        uslots = np.empty(m, dtype=np.int32)
+        index = self._index
+        new_by_ns: Dict[int, List[int]] = {}
+        for j in range(m):
+            pair = (int(uk[j]), int(un[j]))
+            slot = index.get(pair)
+            if slot is None:
+                slot = self._allocate()
+                index[pair] = slot
+                self._slot_key[slot] = pair[0]
+                self._slot_ns[slot] = pair[1]
+                self._slot_used[slot] = True
+                new_by_ns.setdefault(pair[1], []).append(slot)
+            uslots[j] = slot
+        for ns, slots in new_by_ns.items():
+            self._ns_slots.setdefault(ns, []).append(
+                np.asarray(slots, dtype=np.int32))
+        return uslots[inverse]
+
+    def _allocate(self) -> int:
+        if not self._free:
+            self._grow()
+        return self._free.pop()
+
+    def _grow(self) -> None:
+        old = self.capacity
+        new_capacity = old * 2
+        self.accs = tuple(
+            jnp.concatenate(
+                [a, jnp.full((old,), leaf.identity, dtype=leaf.dtype)]
+            )
+            for a, leaf in zip(self.accs, self.agg.leaves)
+        )
+        self._slot_key = np.concatenate(
+            [self._slot_key, np.zeros(old, dtype=np.int64)])
+        self._slot_ns = np.concatenate(
+            [self._slot_ns, np.zeros(old, dtype=np.int64)])
+        self._slot_used = np.concatenate(
+            [self._slot_used, np.zeros(old, dtype=bool)])
+        self._free.extend(range(new_capacity - 1, old - 1, -1))
+        self.capacity = new_capacity
+
+    def scatter(self, slots: np.ndarray, values: Tuple[np.ndarray, ...]) -> None:
+        """Accumulate a batch: one donated XLA scatter per leaf."""
+        n = len(slots)
+        if n == 0:
+            return
+        size = pad_bucket_size(n)
+        padded_slots = pad_i32(slots, size, fill=0)
+        padded_vals = self.agg.pad_input_values(values, size)
+        self.accs = self.agg._scatter_jit(self.accs, padded_slots, padded_vals)
+
+    # ------------------------------------------------------------- fire path
+
+    def slots_for_namespace(self, ns: int) -> np.ndarray:
+        chunks = self._ns_slots.get(ns)
+        if not chunks:
+            return np.empty(0, dtype=np.int32)
+        if len(chunks) > 1:
+            merged = np.concatenate(chunks)
+            self._ns_slots[ns] = [merged]
+            return merged
+        return chunks[0]
+
+    def keys_of_slots(self, slots: np.ndarray) -> np.ndarray:
+        return self._slot_key[slots]
+
+    def fire(self, slot_matrix: np.ndarray) -> Dict[str, np.ndarray]:
+        """Merge+finish a [num_windows, k] matrix of slice slots.
+
+        Missing slices point at slot 0 (identity). Returns host result
+        columns.
+        """
+        w, k = slot_matrix.shape
+        if w == 0:
+            return {name: np.empty(0) for name in self.agg.output_names}
+        wp = pad_bucket_size(w, minimum=64)
+        padded = np.zeros((wp, k), dtype=np.int32)
+        padded[:w] = slot_matrix
+        out = self.agg._fire_jit(self.accs, jnp.asarray(padded))
+        return {name: np.asarray(col)[:w] for name, col in out.items()}
+
+    def free_namespaces(self, namespaces: List[int]) -> None:
+        """Release all slots of the given namespaces (windows fully fired)."""
+        freed: List[np.ndarray] = []
+        for ns in namespaces:
+            chunks = self._ns_slots.pop(ns, None)
+            if chunks:
+                freed.extend(chunks)
+        if not freed:
+            return
+        slots = np.concatenate(freed)
+        index = self._index
+        sk = self._slot_key
+        sn = self._slot_ns
+        for s in slots.tolist():
+            index.pop((int(sk[s]), int(sn[s])), None)
+        self._slot_used[slots] = False
+        self._free.extend(slots.tolist())
+        size = pad_bucket_size(len(slots))
+        self.accs = self.agg._reset_jit(self.accs, pad_i32(slots, size, fill=0))
+
+    # ---------------------------------------------------------- snapshot/restore
+
+    def snapshot(self) -> Dict[str, np.ndarray]:
+        """Materialize state as host arrays, filtered to used slots.
+
+        The snapshot is *logical* (key, ns, key_group, leaf values) — slot
+        numbers are not part of the format, so restore can re-shard by key
+        group (the reference's rescale-by-key-group-range contract,
+        reference: KeyGroupRangeAssignment.java + state/restore pipeline).
+        """
+        used = np.nonzero(self._slot_used)[0]
+        accs_host = [np.asarray(a) for a in self.accs]
+        key_ids = self._slot_key[used]
+        return {
+            "key_id": key_ids,
+            "namespace": self._slot_ns[used],
+            "key_group": assign_key_groups(key_ids, self.max_parallelism),
+            **{
+                f"leaf_{i}": accs_host[i][used]
+                for i in range(len(self.accs))
+            },
+        }
+
+    def restore(self, snap: Dict[str, np.ndarray],
+                key_group_filter=None) -> None:
+        """Load a logical snapshot, optionally keeping only owned key groups."""
+        key_ids = np.asarray(snap["key_id"], dtype=np.int64)
+        namespaces = np.asarray(snap["namespace"], dtype=np.int64)
+        groups = np.asarray(snap["key_group"], dtype=np.int32)
+        leaves = [np.asarray(snap[f"leaf_{i}"]) for i in range(len(self.agg.leaves))]
+        if key_group_filter is not None:
+            mask = np.array([g in key_group_filter for g in groups], dtype=bool)
+            key_ids, namespaces = key_ids[mask], namespaces[mask]
+            leaves = [l[mask] for l in leaves]
+        slots = self.lookup_or_insert(key_ids, namespaces)
+        accs_host = [np.array(a) for a in self.accs]  # writable copies
+        for acc, vals in zip(accs_host, leaves):
+            acc[slots] = vals
+        self.accs = tuple(jnp.asarray(a) for a in accs_host)

@@ -1,0 +1,31 @@
+from flink_tpu.windowing.aggregates import (
+    AccLeaf,
+    AggregateFunction,
+    SumAggregate,
+    CountAggregate,
+    MaxAggregate,
+    MinAggregate,
+    AvgAggregate,
+    MultiAggregate,
+)
+from flink_tpu.windowing.assigners import (
+    TumblingEventTimeWindows,
+    SlidingEventTimeWindows,
+    CumulativeEventTimeWindows,
+    EventTimeSessionWindows,
+)
+
+__all__ = [
+    "AccLeaf",
+    "AggregateFunction",
+    "SumAggregate",
+    "CountAggregate",
+    "MaxAggregate",
+    "MinAggregate",
+    "AvgAggregate",
+    "MultiAggregate",
+    "TumblingEventTimeWindows",
+    "SlidingEventTimeWindows",
+    "CumulativeEventTimeWindows",
+    "EventTimeSessionWindows",
+]

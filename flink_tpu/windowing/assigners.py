@@ -1,0 +1,146 @@
+"""Window assigners, re-designed around *slices*.
+
+The reference assigns each record to every window it belongs to and keeps
+per-(key, window) state (reference:
+streaming/runtime/operators/windowing/WindowOperator.java:293 processElement —
+a record in a HOP(1h, 5m) window writes 12 state entries). The table runtime's
+slicing optimization instead assigns each record to exactly ONE slice and
+merges slices at fire time (reference:
+flink-table-runtime/.../window/tvf/slicing/SliceAssigners.java:243
+HoppingSliceAssigner.assignSliceEnd; WindowAggOperator.java:216).
+
+Here slicing is the *only* mode for aligned windows — it is strictly better on
+TPU because a slice assignment is one vectorized arithmetic op over the
+timestamp column, and the fire-time merge is a gather + axis-reduce on device.
+
+All times are int64 milliseconds. A slice/window is identified by its END
+timestamp (exclusive end; a window [s, e) fires when watermark >= e - 1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowAssigner:
+    """Base: maps timestamps -> slice ends, and window ends -> slice ranges."""
+
+    size: int            # full window span (ms)
+    slide: int           # distance between consecutive window ends (ms)
+    slice_width: int     # width of one slice (ms)
+    offset: int = 0
+
+    @property
+    def slices_per_window(self) -> int:
+        return self.size // self.slice_width
+
+    @property
+    def is_merging(self) -> bool:
+        return False
+
+    def assign_slice_ends(self, timestamps: np.ndarray) -> np.ndarray:
+        """Each record -> exclusive end of its slice. Vectorized."""
+        ts = np.asarray(timestamps, dtype=np.int64)
+        w = self.slice_width
+        start = ts - np.remainder(ts - self.offset, w)
+        return start + w
+
+    def window_ends_for_slice(self, slice_end: int) -> List[int]:
+        """All window ends this slice contributes to (ascending)."""
+        first = _align_up(slice_end, self.slide, self.offset)
+        last = slice_end + self.size - self.slice_width
+        return list(range(first, last + 1, self.slide))
+
+    def slice_ends_for_window(self, window_end: int) -> List[int]:
+        """The slices making up window (window_end - size, window_end]."""
+        first = window_end - self.size + self.slice_width
+        return list(range(first, window_end + 1, self.slice_width))
+
+    def last_window_end_for_slice(self, slice_end: int) -> int:
+        """After this window fires, the slice can be freed."""
+        return self.window_ends_for_slice(slice_end)[-1]
+
+    def window_start(self, window_end: int) -> int:
+        return window_end - self.size
+
+
+def _align_up(t: int, step: int, offset: int = 0) -> int:
+    """Smallest multiple of ``step`` (+offset) that is >= t."""
+    r = (t - offset) % step
+    return t if r == 0 else t + (step - r)
+
+
+class TumblingEventTimeWindows(WindowAssigner):
+    """reference: streaming/api/windowing/assigners/TumblingEventTimeWindows.java
+    — one slice per window, fire = emit slice."""
+
+    def __init__(self, size_ms: int, offset_ms: int = 0):
+        super().__init__(size=size_ms, slide=size_ms, slice_width=size_ms,
+                         offset=offset_ms)
+
+    @staticmethod
+    def of(size_ms: int, offset_ms: int = 0) -> "TumblingEventTimeWindows":
+        return TumblingEventTimeWindows(size_ms, offset_ms)
+
+
+class SlidingEventTimeWindows(WindowAssigner):
+    """reference: streaming/api/windowing/assigners/SlidingEventTimeWindows.java,
+    executed with the HOP slice-sharing strategy
+    (reference: SliceAssigners.java HoppingSliceAssigner)."""
+
+    def __init__(self, size_ms: int, slide_ms: int, offset_ms: int = 0):
+        width = math.gcd(size_ms, slide_ms)
+        super().__init__(size=size_ms, slide=slide_ms, slice_width=width,
+                         offset=offset_ms)
+
+    @staticmethod
+    def of(size_ms: int, slide_ms: int, offset_ms: int = 0) -> "SlidingEventTimeWindows":
+        return SlidingEventTimeWindows(size_ms, slide_ms, offset_ms)
+
+
+class CumulativeEventTimeWindows(WindowAssigner):
+    """CUMULATE TVF (reference: SliceAssigners.java CumulativeSliceAssigner):
+    windows [s, s+step), [s, s+2*step) ... [s, s+max_size)."""
+
+    def __init__(self, max_size_ms: int, step_ms: int, offset_ms: int = 0):
+        super().__init__(size=max_size_ms, slide=step_ms, slice_width=step_ms,
+                         offset=offset_ms)
+
+    def window_ends_for_slice(self, slice_end: int) -> List[int]:
+        # slice contributes to window ends slice_end, +step ... up to the end
+        # of its cumulate span.
+        span_start = slice_end - ((slice_end - self.offset - self.slice_width)
+                                  % self.size)
+        span_end = span_start + self.size - self.slice_width
+        return list(range(slice_end, span_end + 1, self.slide))
+
+    def slice_ends_for_window(self, window_end: int) -> List[int]:
+        span_start_end = window_end - ((window_end - self.offset - self.slice_width)
+                                       % self.size)
+        return list(range(span_start_end, window_end + 1, self.slice_width))
+
+    def window_start(self, window_end: int) -> int:
+        return window_end - ((window_end - self.offset - self.slice_width)
+                             % self.size) - self.slice_width
+
+
+@dataclasses.dataclass(frozen=True)
+class EventTimeSessionWindows:
+    """Session windows with a gap; merging happens on host metadata with
+    device accumulators (reference: WindowOperator.java MergingWindowSet /
+    streaming/api/windowing/assigners/EventTimeSessionWindows.java)."""
+
+    gap: int
+
+    @staticmethod
+    def with_gap(gap_ms: int) -> "EventTimeSessionWindows":
+        return EventTimeSessionWindows(gap=gap_ms)
+
+    @property
+    def is_merging(self) -> bool:
+        return True

@@ -1,0 +1,42 @@
+"""Test configuration.
+
+Forces JAX onto the CPU backend with 8 virtual devices so that mesh/sharding
+tests (the multi-chip path) run in CI without TPU hardware, mirroring how the
+reference tests "multi-node" behavior in one JVM via its MiniCluster
+(reference: flink-runtime/src/main/java/org/apache/flink/runtime/minicluster/MiniCluster.java).
+
+Must run before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+# Hard-override: the ambient environment may point JAX at TPU hardware
+# (e.g. JAX_PLATFORMS=axon, plus a sitecustomize hook that calls
+# jax.config.update("jax_platforms", "axon,cpu") at interpreter start —
+# which overrides the env var). Tests always run on the virtual CPU mesh,
+# so both the env var AND the config entry must be forced back to cpu
+# before any backend is initialized.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep compilation fast and deterministic in CI.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def eight_device_mesh():
+    import jax
+    from flink_tpu.parallel.mesh import make_mesh
+
+    n = len(jax.devices())
+    assert n >= 8, f"expected >=8 virtual devices, got {n}"
+    return make_mesh(8)

@@ -1,0 +1,68 @@
+import numpy as np
+import pytest
+
+from flink_tpu.core.config import (
+    BatchOptions,
+    ConfigOption,
+    Configuration,
+    CoreOptions,
+)
+from flink_tpu.core.records import RecordBatch, TIMESTAMP_FIELD
+
+
+class TestConfiguration:
+    def test_defaults_and_set(self):
+        c = Configuration()
+        assert c.get(CoreOptions.DEFAULT_PARALLELISM) == 1
+        c.set(CoreOptions.DEFAULT_PARALLELISM, 8)
+        assert c.get(CoreOptions.DEFAULT_PARALLELISM) == 8
+
+    def test_type_coercion(self):
+        c = Configuration({"parallelism.default": "4"})
+        assert c.get(CoreOptions.DEFAULT_PARALLELISM) == 4
+        b = ConfigOption("b", default=False, type=bool)
+        assert Configuration({"b": "true"}).get(b) is True
+        assert Configuration({"b": "off"}).get(b) is False
+
+    def test_fallback_keys(self):
+        opt = ConfigOption("new.key", default=7, type=int,
+                           fallback_keys=("old.key",))
+        assert Configuration({"old.key": 3}).get(opt) == 3
+        assert Configuration({"new.key": 5, "old.key": 3}).get(opt) == 5
+
+    def test_layering(self):
+        cluster = Configuration({"a": 1, "b": 2})
+        job = Configuration({"b": 3})
+        merged = job.with_fallback(cluster)
+        assert merged.get_raw("a") == 1
+        assert merged.get_raw("b") == 3
+        assert merged.to_dict() == {"a": 1, "b": 3}
+
+
+class TestRecordBatch:
+    def test_roundtrip(self):
+        b = RecordBatch.from_pydict(
+            {"k": [1, 2, 3], "v": [1.0, 2.0, 3.0]}, timestamps=[10, 20, 30])
+        assert len(b) == 3
+        assert b.has_timestamps
+        np.testing.assert_array_equal(b.timestamps, [10, 20, 30])
+        rows = b.to_rows()
+        assert rows[1]["v"] == 2.0
+
+    def test_filter_take_concat(self):
+        b = RecordBatch.from_pydict({"v": np.arange(10)})
+        f = b.filter(b["v"] % 2 == 0)
+        assert f["v"].tolist() == [0, 2, 4, 6, 8]
+        t = b.take(np.array([3, 1]))
+        assert t["v"].tolist() == [3, 1]
+        c = RecordBatch.concat([f, t])
+        assert c["v"].tolist() == [0, 2, 4, 6, 8, 3, 1]
+
+    def test_length_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            RecordBatch({"a": np.arange(3), "b": np.arange(4)})
+
+    def test_empty(self):
+        e = RecordBatch({})
+        assert len(e) == 0
+        assert RecordBatch.concat([]).num_records == 0

@@ -1,0 +1,127 @@
+import numpy as np
+
+from flink_tpu.state.slot_table import SlotTable, unique_pairs
+from flink_tpu.windowing.aggregates import (
+    AvgAggregate,
+    CountAggregate,
+    MaxAggregate,
+    MultiAggregate,
+    SumAggregate,
+)
+from flink_tpu.core.records import RecordBatch
+
+
+def make_batch(keys, values, ts=None):
+    cols = {"v": np.asarray(values, dtype=np.float32)}
+    b = RecordBatch.from_pydict(cols, timestamps=ts)
+    return b
+
+
+def test_unique_pairs():
+    k = np.array([1, 2, 1, 1, 2], dtype=np.int64)
+    n = np.array([10, 10, 10, 20, 10], dtype=np.int64)
+    uk, un, inv = unique_pairs(k, n)
+    assert len(uk) == 3
+    pairs = set(zip(uk.tolist(), un.tolist()))
+    assert pairs == {(1, 10), (2, 10), (1, 20)}
+    # inverse maps each record to its pair
+    for i in range(5):
+        assert (uk[inv[i]], un[inv[i]]) == (k[i], n[i])
+
+
+def test_scatter_and_fire_sum():
+    agg = SumAggregate("v")
+    t = SlotTable(agg, capacity=1024)
+    keys = np.array([7, 8, 7, 9], dtype=np.int64)
+    ns = np.array([100, 100, 100, 100], dtype=np.int64)
+    slots = t.lookup_or_insert(keys, ns)
+    assert slots[0] == slots[2]
+    assert slots.min() >= 1  # slot 0 reserved
+    t.scatter(slots, agg.map_input(make_batch(keys, [1, 2, 3, 4])))
+    s = t.slots_for_namespace(100)
+    res = t.fire(s[:, None])
+    by_key = dict(zip(t.keys_of_slots(s).tolist(), res["sum_v"].tolist()))
+    assert by_key == {7: 4.0, 8: 2.0, 9: 4.0}
+
+
+def test_free_namespaces_resets_and_reuses():
+    agg = SumAggregate("v")
+    t = SlotTable(agg, capacity=1024)
+    keys = np.array([1, 2], dtype=np.int64)
+    ns = np.array([5, 5], dtype=np.int64)
+    slots = t.lookup_or_insert(keys, ns)
+    t.scatter(slots, (np.array([10.0, 20.0], dtype=np.float32),))
+    t.free_namespaces([5])
+    assert t.num_used == 0
+    # reused slots must start from identity
+    slots2 = t.lookup_or_insert(keys, ns)
+    t.scatter(slots2, (np.array([1.0, 1.0], dtype=np.float32),))
+    res = t.fire(t.slots_for_namespace(5)[:, None])
+    assert sorted(res["sum_v"].tolist()) == [1.0, 1.0]
+
+
+def test_growth():
+    agg = CountAggregate()
+    t = SlotTable(agg, capacity=1024)
+    keys = np.arange(5000, dtype=np.int64)
+    ns = np.zeros(5000, dtype=np.int64)
+    slots = t.lookup_or_insert(keys, ns)
+    assert t.capacity >= 5000
+    assert len(np.unique(slots)) == 5000
+    t.scatter(slots, agg.map_input(RecordBatch.from_pydict({"x": np.zeros(5000)})))
+    res = t.fire(t.slots_for_namespace(0)[:, None])
+    assert res["count"].sum() == 5000
+
+
+def test_multi_aggregate():
+    agg = MultiAggregate([SumAggregate("v"), MaxAggregate("v"), AvgAggregate("v"),
+                          CountAggregate()])
+    t = SlotTable(agg, capacity=1024)
+    keys = np.array([1, 1, 2], dtype=np.int64)
+    ns = np.array([0, 0, 0], dtype=np.int64)
+    slots = t.lookup_or_insert(keys, ns)
+    b = make_batch(keys, [3.0, 5.0, 7.0])
+    t.scatter(slots, agg.map_input(b))
+    s = t.slots_for_namespace(0)
+    res = t.fire(s[:, None])
+    by_key = {k: i for i, k in enumerate(t.keys_of_slots(s).tolist())}
+    assert res["sum_v"][by_key[1]] == 8.0
+    assert res["max_v"][by_key[1]] == 5.0
+    assert res["avg_v"][by_key[1]] == 4.0
+    assert res["count"][by_key[2]] == 1
+
+
+def test_snapshot_restore_roundtrip():
+    agg = SumAggregate("v")
+    t = SlotTable(agg, capacity=1024)
+    keys = np.array([1, 2, 3], dtype=np.int64)
+    ns = np.array([100, 100, 200], dtype=np.int64)
+    slots = t.lookup_or_insert(keys, ns)
+    t.scatter(slots, (np.array([1.0, 2.0, 3.0], dtype=np.float32),))
+    snap = t.snapshot()
+
+    t2 = SlotTable(agg, capacity=1024)
+    t2.restore(snap)
+    s = t2.slots_for_namespace(100)
+    res = t2.fire(s[:, None])
+    by_key = dict(zip(t2.keys_of_slots(s).tolist(), res["sum_v"].tolist()))
+    assert by_key == {1: 1.0, 2: 2.0}
+
+
+def test_snapshot_restore_key_group_filter():
+    from flink_tpu.state.keygroups import assign_key_groups
+
+    agg = SumAggregate("v")
+    t = SlotTable(agg, capacity=1024, max_parallelism=16)
+    keys = np.arange(100, dtype=np.int64)
+    ns = np.zeros(100, dtype=np.int64)
+    slots = t.lookup_or_insert(keys, ns)
+    t.scatter(slots, (np.ones(100, dtype=np.float32),))
+    snap = t.snapshot()
+
+    owned = set(range(0, 8))
+    t2 = SlotTable(agg, capacity=1024, max_parallelism=16)
+    t2.restore(snap, key_group_filter=owned)
+    groups = assign_key_groups(keys, 16)
+    expected = int((np.isin(groups, list(owned))).sum())
+    assert t2.num_used == expected

@@ -1,0 +1,214 @@
+"""Windower semantics vs a pure-Python oracle.
+
+Mirrors the role of the reference's WindowOperatorTest
+(flink-streaming-java/src/test/.../windowing/WindowOperatorTest.java): drive
+the operator with records + watermarks, assert fired window contents.
+"""
+
+import collections
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.records import KEY_ID_FIELD, RecordBatch
+from flink_tpu.windowing.aggregates import CountAggregate, SumAggregate
+from flink_tpu.windowing.assigners import (
+    CumulativeEventTimeWindows,
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+)
+from flink_tpu.windowing.windower import (
+    WINDOW_END_FIELD,
+    WINDOW_START_FIELD,
+    SliceSharedWindower,
+)
+
+
+def keyed_batch(keys, values, ts):
+    return RecordBatch.from_pydict(
+        {KEY_ID_FIELD: np.asarray(keys, dtype=np.int64),
+         "v": np.asarray(values, dtype=np.float32)},
+        timestamps=ts)
+
+
+def oracle_windows(assigner, events, watermark):
+    """events: list of (key, value, ts). Returns {(key, wstart, wend): sum}
+    for every window with end-1 <= watermark containing data."""
+    out = collections.defaultdict(float)
+    for key, value, ts in events:
+        se = int(assigner.assign_slice_ends(np.array([ts]))[0])
+        for wend in assigner.window_ends_for_slice(se):
+            if wend - 1 <= watermark:
+                out[(key, assigner.window_start(wend), wend)] += value
+    return dict(out)
+
+
+def fired_to_dict(batches, field="sum_v"):
+    out = {}
+    for b in batches:
+        for row in b.to_rows():
+            out[(row[KEY_ID_FIELD], row[WINDOW_START_FIELD],
+                 row[WINDOW_END_FIELD])] = row[field]
+    return out
+
+
+class TestTumbling:
+    def test_basic_fire(self):
+        assigner = TumblingEventTimeWindows.of(1000)
+        w = SliceSharedWindower(assigner, SumAggregate("v"), capacity=1024)
+        w.process_batch(keyed_batch([1, 1, 2], [1, 2, 5], [100, 900, 500]))
+        assert w.on_watermark(500) == []  # window [0,1000) not complete
+        fired = w.on_watermark(999)
+        got = fired_to_dict(fired)
+        assert got == {(1, 0, 1000): 3.0, (2, 0, 1000): 5.0}
+        # firing again emits nothing
+        assert w.on_watermark(1500) == []
+
+    def test_multiple_windows_in_order(self):
+        assigner = TumblingEventTimeWindows.of(100)
+        w = SliceSharedWindower(assigner, SumAggregate("v"), capacity=1024)
+        w.process_batch(keyed_batch([1, 1, 1], [1, 2, 4], [50, 150, 250]))
+        fired = w.on_watermark(300)
+        got = fired_to_dict(fired)
+        assert got == {(1, 0, 100): 1.0, (1, 100, 200): 2.0, (1, 200, 300): 4.0}
+        ends = [b[WINDOW_END_FIELD][0] for b in fired]
+        assert ends == sorted(ends)
+
+    def test_late_records_dropped(self):
+        assigner = TumblingEventTimeWindows.of(100)
+        w = SliceSharedWindower(assigner, SumAggregate("v"), capacity=1024)
+        w.process_batch(keyed_batch([1], [1], [50]))
+        w.on_watermark(99)
+        w.process_batch(keyed_batch([1], [100], [10]))  # late for [0,100)
+        assert w.late_records_dropped == 1
+        assert w.on_watermark(199) == []
+
+    def test_state_freed_after_fire(self):
+        assigner = TumblingEventTimeWindows.of(100)
+        w = SliceSharedWindower(assigner, SumAggregate("v"), capacity=1024)
+        w.process_batch(keyed_batch([1, 2, 3], [1, 1, 1], [10, 20, 30]))
+        assert w.table.num_used == 3
+        w.on_watermark(99)
+        assert w.table.num_used == 0
+
+
+class TestSliding:
+    def test_hop_slice_sharing(self):
+        # size 300, slide 100 -> 3 slices per window
+        assigner = SlidingEventTimeWindows.of(300, 100)
+        assert assigner.slices_per_window == 3
+        w = SliceSharedWindower(assigner, SumAggregate("v"), capacity=1024)
+        events = [(1, 1.0, 50), (1, 2.0, 150), (1, 4.0, 250), (2, 10.0, 150)]
+        for k, v, t in events:
+            w.process_batch(keyed_batch([k], [v], [t]))
+        wm = 599
+        fired = fired_to_dict(w.on_watermark(wm))
+        assert fired == oracle_windows(assigner, events, wm)
+
+    def test_hop_against_oracle_random(self):
+        rng = np.random.default_rng(42)
+        assigner = SlidingEventTimeWindows.of(500, 100)
+        w = SliceSharedWindower(assigner, SumAggregate("v"), capacity=4096)
+        events = []
+        wm = -1
+        all_fired = {}
+        for step in range(10):
+            n = 200
+            keys = rng.integers(0, 20, n)
+            vals = rng.random(n).astype(np.float32)
+            # monotonically advancing time region per step
+            ts = rng.integers(step * 300, step * 300 + 600, n)
+            for k, v, t in zip(keys.tolist(), vals.tolist(), ts.tolist()):
+                events.append((k, v, t))
+            w.process_batch(keyed_batch(keys, vals, ts))
+            wm = step * 300
+            all_fired.update(fired_to_dict(w.on_watermark(wm)))
+        all_fired.update(fired_to_dict(w.on_watermark(10**9)))
+        # oracle ignores lateness; replicate drop-late semantics by replaying
+        oracle = {}
+        w2_max_fired = -1
+        max_fired = -1
+        fired_so_far = set()
+        # simpler: compare only windows fired after final flush vs oracle with
+        # late-drop simulation
+        oracle = oracle_with_lateness(assigner, events_by_step(events, 10), wm_schedule(10))
+        assert set(all_fired) == set(oracle)
+        for kk in oracle:
+            assert all_fired[kk] == pytest.approx(oracle[kk], rel=1e-5)
+
+
+def events_by_step(events, steps):
+    # events were appended in step order, 200 per step
+    return [events[i * 200:(i + 1) * 200] for i in range(steps)]
+
+
+def wm_schedule(steps):
+    return [s * 300 for s in range(steps)] + [10**9]
+
+
+def oracle_with_lateness(assigner, step_events, watermarks):
+    """Replay with drop-late semantics: record dropped iff its slice's last
+    window end <= max fired end at arrival time."""
+    contrib = collections.defaultdict(float)
+    fired = {}
+    max_fired = -(1 << 62)
+    pending = set()
+
+    def fire_up_to(wm):
+        nonlocal max_fired
+        for wend in sorted(pending):
+            if wend - 1 <= wm:
+                pending.discard(wend)
+                rows = {}
+                for (key, we), v in contrib.items():
+                    if we == wend:
+                        rows[key] = rows.get(key, 0.0) + v
+                for key, v in rows.items():
+                    fired[(key, assigner.window_start(wend), wend)] = v
+                max_fired = max(max_fired, wend)
+
+    wm_i = 0
+    for step, events in enumerate(step_events):
+        for key, value, ts in events:
+            se = int(assigner.assign_slice_ends(np.array([ts]))[0])
+            ends = assigner.window_ends_for_slice(se)
+            if ends[-1] <= max_fired:
+                continue  # late
+            for wend in ends:
+                if wend > max_fired:
+                    contrib[(key, wend)] += value
+                    pending.add(wend)
+        fire_up_to(watermarks[step])
+    fire_up_to(watermarks[-1])
+    return fired
+
+
+class TestCumulate:
+    def test_cumulate(self):
+        assigner = CumulativeEventTimeWindows(max_size_ms=300, step_ms=100)
+        w = SliceSharedWindower(assigner, CountAggregate(), capacity=1024)
+        w.process_batch(keyed_batch([1, 1, 1], [1, 1, 1], [50, 150, 250]))
+        fired = fired_to_dict(w.on_watermark(299), field="count")
+        # windows (0,100]:1, (0,200]:2, (0,300]:3
+        assert fired == {(1, 0, 100): 1, (1, 0, 200): 2, (1, 0, 300): 3}
+
+
+class TestSnapshotRestore:
+    def test_windower_snapshot_restore(self):
+        assigner = SlidingEventTimeWindows.of(300, 100)
+        events1 = [(1, 1.0, 50), (2, 2.0, 150)]
+        events2 = [(1, 4.0, 250)]
+
+        w = SliceSharedWindower(assigner, SumAggregate("v"), capacity=1024)
+        for k, v, t in events1:
+            w.process_batch(keyed_batch([k], [v], [t]))
+        snap = w.snapshot()
+
+        w2 = SliceSharedWindower(assigner, SumAggregate("v"), capacity=1024)
+        w2.restore(snap)
+        for k, v, t in events2:
+            w2.process_batch(keyed_batch([k], [v], [t]))
+        fired = fired_to_dict(w2.on_watermark(10**9))
+
+        oracle = oracle_windows(assigner, events1 + events2, 10**9)
+        assert fired == pytest.approx(oracle)
