@@ -126,6 +126,11 @@ class LocalExecutor:
                     self._forward(node, out)
 
         elapsed = time.perf_counter() - t0
+        fire_latencies: List[float] = []
+        for node in nodes.values():
+            lat = getattr(node.operator, "fire_latencies_ms", None)
+            if lat:
+                fire_latencies.extend(lat)  # deque -> list copy
         metrics = {
             "records_emitted_by_sources": total_records,
             "runtime_s": elapsed,
@@ -137,6 +142,15 @@ class LocalExecutor:
                 for uid, n in nodes.items()
             },
         }
+        if fire_latencies:
+            fire_latencies.sort()
+            metrics["window_fire_latency_ms"] = {
+                "p50": fire_latencies[len(fire_latencies) // 2],
+                "p99": fire_latencies[min(len(fire_latencies) - 1,
+                                          int(len(fire_latencies) * 0.99))],
+                "max": fire_latencies[-1],
+                "count": len(fire_latencies),
+            }
         return JobExecutionResult(job_name, metrics)
 
     # ------------------------------------------------------------- plumbing

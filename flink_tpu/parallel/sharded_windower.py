@@ -1,0 +1,352 @@
+"""Mesh-sharded windowed keyed aggregation.
+
+The multi-device form of ``flink_tpu.windowing.windower.SliceSharedWindower``:
+state lives in ``[num_shards, capacity]`` device arrays with the leading axis
+sharded over the key-group mesh axis; every step (scatter / fire / reset) is
+ONE jitted ``shard_map`` program over the whole mesh. Records are routed to
+their owning shard by the reference's key-group formula
+(reference: KeyGroupRangeAssignment.java:124-127 via
+flink_tpu.state.keygroups) — the same contract that makes checkpoints
+re-shardable.
+
+Scaling contract (SURVEY.md §2.9): shard count == mesh size == the
+"parallelism" of the keyed operator; max_parallelism == number of key groups.
+Cross-shard communication: none during scatter (records are bucketed to their
+owner on the host, the device_put with a sharded layout IS the shuffle);
+window fire is shard-local because every key's slices live on one shard
+(keyed state locality, same as the reference). The collectives
+(all_to_all/psum in flink_tpu.parallel.shuffle) appear when chaining keyed
+stages or doing global two-phase aggregation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
+from flink_tpu.ops.segment_ops import SCATTER_METHOD, MERGE_FN, pad_bucket_size
+from flink_tpu.parallel.mesh import KEY_AXIS
+from flink_tpu.parallel.shuffle import bucket_by_shard, shard_records
+from flink_tpu.state.keygroups import assign_key_groups
+from flink_tpu.state.slot_table import HostSlotIndex
+from flink_tpu.windowing.aggregates import AggregateFunction
+from flink_tpu.windowing.assigners import WindowAssigner
+from flink_tpu.windowing.bookkeeping import SliceBookkeeper
+from flink_tpu.windowing.windower import WINDOW_END_FIELD, WINDOW_START_FIELD
+
+
+# Compiled step programs cached by (mesh devices, aggregate layout) so
+# repeated engines (warmup + measured runs, restarted jobs) share executables.
+_STEP_CACHE: Dict[tuple, tuple] = {}
+
+
+class MeshWindowEngine:
+    """Windowed keyed aggregation sharded over a 1-D device mesh."""
+
+    def __init__(
+        self,
+        assigner: WindowAssigner,
+        agg: AggregateFunction,
+        mesh: Mesh,
+        capacity_per_shard: int = 1 << 16,
+        max_parallelism: int = 128,
+        allowed_lateness: int = 0,
+    ) -> None:
+        self.assigner = assigner
+        self.agg = agg
+        self.mesh = mesh
+        self.P = int(mesh.devices.size)
+        self.capacity = max(int(capacity_per_shard), 1024)
+        self.max_parallelism = max_parallelism
+        self.allowed_lateness = allowed_lateness
+        if max_parallelism < self.P:
+            raise ValueError(
+                f"max_parallelism {max_parallelism} < mesh size {self.P}")
+
+        self.indexes = [
+            HostSlotIndex(
+                self.capacity, growable=False,
+                full_hint="raise MeshWindowEngine capacity_per_shard (hot-key "
+                          "skew can concentrate keys on one shard)")
+            for _ in range(self.P)
+        ]
+        self._sharding = NamedSharding(mesh, P(KEY_AXIS))
+        self._replicated = NamedSharding(mesh, P())
+        self.accs: Tuple[jnp.ndarray, ...] = tuple(
+            jax.device_put(
+                jnp.full((self.P, self.capacity), leaf.identity,
+                         dtype=leaf.dtype),
+                self._sharding)
+            for leaf in agg.leaves
+        )
+        self._build_steps()
+        # window lifecycle metadata is global: watermarks and window ends are
+        # aligned across shards
+        self.book = SliceBookkeeper(assigner, allowed_lateness)
+
+    @property
+    def late_records_dropped(self) -> int:
+        return self.book.late_records_dropped
+
+    # -------------------------------------------------------- jitted programs
+
+    def _build_steps(self) -> None:
+        cache_key = (tuple(d.id for d in self.mesh.devices.flat),
+                     self.agg.cache_key())
+        cached = _STEP_CACHE.get(cache_key)
+        if cached is not None:
+            self._scatter_step, self._fire_step, self._reset_step = cached
+            return
+        mesh = self.mesh
+        methods = tuple(SCATTER_METHOD[l.reduce] for l in self.agg.leaves)
+        merges = tuple(MERGE_FN[l.reduce] for l in self.agg.leaves)
+        idents = tuple(l.identity for l in self.agg.leaves)
+        finish = self.agg.finish
+        n_leaves = len(self.agg.leaves)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def scatter_step(accs, slots, values):
+            # accs: ([P, cap], ...) sharded; slots: [P, B]; values: ([P, B], ...)
+            def local(*args):
+                accs_l = args[:n_leaves]          # each [1, cap]
+                slots_l = args[n_leaves]          # [1, B]
+                vals_l = args[n_leaves + 1:]      # each [1, B]
+                # .at[...].op() returns the full [1, cap] block
+                return tuple(
+                    getattr(a.at[0, slots_l[0]], m)(v[0])
+                    for a, m, v in zip(accs_l, methods, vals_l)
+                )
+
+            return jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(P(KEY_AXIS),) * (2 * n_leaves + 1),
+                out_specs=(P(KEY_AXIS),) * n_leaves,
+            )(*accs, slots, *values)
+
+        @jax.jit
+        def fire_step(accs, slot_matrix):
+            # slot_matrix: [P, W, k] sharded -> result cols each [P, W]
+            def local(*args):
+                accs_l = args[:n_leaves]          # [1, cap]
+                sm = args[n_leaves][0]            # [W, k]
+                merged = tuple(
+                    m(a[0][sm], axis=1) for a, m in zip(accs_l, merges))
+                out = finish(merged)              # dict name -> [W]
+                return tuple(out[name][None]
+                             for name in sorted(out.keys()))
+
+            names = sorted(self.agg.output_names)
+            outs = jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(P(KEY_AXIS),) * (n_leaves + 1),
+                out_specs=(P(KEY_AXIS),) * len(names),
+            )(*accs, slot_matrix)
+            return dict(zip(names, outs))
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def reset_step(accs, slots):
+            def local(*args):
+                accs_l = args[:n_leaves]
+                slots_l = args[n_leaves]
+                return tuple(
+                    a.at[0, slots_l[0]].set(i)
+                    for a, i in zip(accs_l, idents)
+                )
+
+            return jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(P(KEY_AXIS),) * (n_leaves + 1),
+                out_specs=(P(KEY_AXIS),) * n_leaves,
+            )(*accs, slots)
+
+        self._scatter_step = scatter_step
+        self._fire_step = fire_step
+        self._reset_step = reset_step
+        _STEP_CACHE[cache_key] = (scatter_step, fire_step, reset_step)
+
+    def _put_sharded(self, host_block: np.ndarray) -> jnp.ndarray:
+        return jax.device_put(host_block, self._sharding)
+
+    # ---------------------------------------------------------------- ingest
+
+    def process_batch(self, batch: RecordBatch) -> None:
+        n = len(batch)
+        if n == 0:
+            return
+        key_ids = batch.key_ids
+        slice_ends = self.assigner.assign_slice_ends(batch.timestamps)
+        live = self.book.live_mask(slice_ends)
+        if live is not None:
+            key_ids, slice_ends = key_ids[live], slice_ends[live]
+            batch = batch.filter(live)
+            if len(batch) == 0:
+                return
+        self.book.register_slices(slice_ends)
+
+        # route to owning shard, bucket into [P, B] blocks
+        shards = shard_records(key_ids, self.P, self.max_parallelism)
+        values = self.agg.map_input(batch)
+        counts, blocked, order = bucket_by_shard(
+            shards, self.P,
+            columns=[key_ids, slice_ends,
+                     *[np.asarray(v, dtype=l.dtype)
+                       for v, l in zip(values, self.agg.leaves)]],
+            fills=[0, 0, *[l.identity for l in self.agg.leaves]],
+        )
+        key_block, ns_block = blocked[0], blocked[1]
+        value_blocks = blocked[2:]
+
+        # per-shard slot assignment (host)
+        B = key_block.shape[1]
+        slot_block = np.zeros((self.P, B), dtype=np.int32)
+        for p in range(self.P):
+            c = int(counts[p])
+            if c:
+                slot_block[p, :c] = self.indexes[p].lookup_or_insert(
+                    key_block[p, :c], ns_block[p, :c])
+
+        self.accs = self._scatter_step(
+            self.accs,
+            self._put_sharded(slot_block),
+            tuple(self._put_sharded(v) for v in value_blocks),
+        )
+
+    # ------------------------------------------------------------------ fire
+
+    def on_watermark(self, watermark: int) -> List[RecordBatch]:
+        out: List[RecordBatch] = []
+        while True:
+            w_end = self.book.next_window(watermark)
+            if w_end is None:
+                break
+            batch = self._fire_window(w_end)
+            if batch is not None and len(batch) > 0:
+                out.append(batch)
+            freed = self.book.mark_fired(w_end)
+            if freed:
+                self._free_slices(freed)
+        return out
+
+    def _fire_window(self, window_end: int) -> Optional[RecordBatch]:
+        slice_ends = self.assigner.slice_ends_for_window(window_end)
+        k = len(slice_ends)
+        per_shard_mats: List[np.ndarray] = []
+        per_shard_keys: List[np.ndarray] = []
+        w_max = 0
+        for p in range(self.P):
+            idx = self.indexes[p]
+            chunks = [(i, idx.slots_for_namespace(se))
+                      for i, se in enumerate(slice_ends)]
+            chunks = [(i, s) for i, s in chunks if len(s) > 0]
+            if not chunks:
+                per_shard_mats.append(np.zeros((0, k), dtype=np.int32))
+                per_shard_keys.append(np.empty(0, dtype=np.int64))
+                continue
+            all_slots = np.concatenate([s for _, s in chunks])
+            all_sidx = np.concatenate(
+                [np.full(len(s), i, dtype=np.int32) for i, s in chunks])
+            all_keys = idx.slot_key[all_slots]
+            keys, inv = np.unique(all_keys, return_inverse=True)
+            mat = np.zeros((len(keys), k), dtype=np.int32)
+            mat[inv, all_sidx] = all_slots
+            per_shard_mats.append(mat)
+            per_shard_keys.append(keys)
+            w_max = max(w_max, len(keys))
+        if w_max == 0:
+            return None
+        W = pad_bucket_size(w_max, minimum=64)
+        sm = np.zeros((self.P, W, k), dtype=np.int32)
+        for p, mat in enumerate(per_shard_mats):
+            sm[p, : len(mat)] = mat
+        results = {name: np.asarray(arr)
+                   for name, arr in self._fire_step(
+                       self.accs, self._put_sharded(sm)).items()}
+        # assemble host batch
+        key_cols: List[np.ndarray] = []
+        res_cols: Dict[str, List[np.ndarray]] = {n: [] for n in results}
+        for p in range(self.P):
+            m = len(per_shard_keys[p])
+            if m == 0:
+                continue
+            key_cols.append(per_shard_keys[p])
+            for name, arr in results.items():
+                res_cols[name].append(arr[p][:m])
+        keys = np.concatenate(key_cols)
+        m = len(keys)
+        cols = {
+            KEY_ID_FIELD: keys,
+            WINDOW_START_FIELD: np.full(
+                m, self.assigner.window_start(window_end), dtype=np.int64),
+            WINDOW_END_FIELD: np.full(m, window_end, dtype=np.int64),
+            TIMESTAMP_FIELD: np.full(m, window_end - 1, dtype=np.int64),
+        }
+        for name, chunks in res_cols.items():
+            cols[name] = np.concatenate(chunks)
+        return RecordBatch(cols)
+
+    def _free_slices(self, ends: List[int]) -> None:
+        f_max = 0
+        freed: List[Optional[np.ndarray]] = []
+        for p in range(self.P):
+            slots = self.indexes[p].free_namespaces(ends)
+            freed.append(slots)
+            if slots is not None:
+                f_max = max(f_max, len(slots))
+        if f_max == 0:
+            return
+        F = pad_bucket_size(f_max)
+        block = np.zeros((self.P, F), dtype=np.int32)
+        for p, slots in enumerate(freed):
+            if slots is not None:
+                block[p, : len(slots)] = slots
+        self.accs = self._reset_step(self.accs, self._put_sharded(block))
+
+    # -------------------------------------------------------------- snapshot
+
+    def snapshot(self) -> Dict[str, object]:
+        accs_host = [np.asarray(a) for a in self.accs]
+        parts = []
+        for p in range(self.P):
+            idx = self.indexes[p]
+            used = idx.used_slots()
+            key_ids = idx.slot_key[used]
+            parts.append({
+                "key_id": key_ids,
+                "namespace": idx.slot_ns[used],
+                "key_group": assign_key_groups(key_ids, self.max_parallelism),
+                **{f"leaf_{i}": accs_host[i][p][used]
+                   for i in range(len(self.accs))},
+            })
+        merged = {
+            k: np.concatenate([pt[k] for pt in parts]) for k in parts[0]
+        } if parts else {}
+        return {"table": merged, **self.book.snapshot()}
+
+    def restore(self, snap: Dict[str, object]) -> None:
+        """Restore, re-sharding by key group (works across mesh sizes)."""
+        table = snap["table"]
+        key_ids = np.asarray(table["key_id"], dtype=np.int64)
+        namespaces = np.asarray(table["namespace"], dtype=np.int64)
+        leaves = [np.asarray(table[f"leaf_{i}"])
+                  for i in range(len(self.agg.leaves))]
+        if len(key_ids):
+            shards = shard_records(key_ids, self.P, self.max_parallelism)
+            accs_host = [np.array(a) for a in self.accs]
+            for p in range(self.P):
+                mask = shards == p
+                if not mask.any():
+                    continue
+                slots = self.indexes[p].lookup_or_insert(
+                    key_ids[mask], namespaces[mask])
+                for acc, vals in zip(accs_host, leaves):
+                    acc[p][slots] = vals[mask]
+            self.accs = tuple(
+                jax.device_put(jnp.asarray(a), self._sharding)
+                for a in accs_host)
+        self.book.restore(snap)

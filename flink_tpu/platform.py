@@ -1,0 +1,23 @@
+"""JAX platform selection helper.
+
+Some environments install a sitecustomize hook that force-registers an
+accelerator backend and sets ``jax_platforms`` via ``jax.config`` at
+interpreter start — which silently overrides the ``JAX_PLATFORMS`` env var.
+``sync_platform()`` re-asserts the env var (when set) so drivers, benchmarks
+and tests get the backend they asked for.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def sync_platform() -> None:
+    import jax
+
+    p = os.environ.get("JAX_PLATFORMS")
+    if p:
+        try:
+            jax.config.update("jax_platforms", p)
+        except Exception:
+            pass

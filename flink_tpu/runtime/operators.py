@@ -138,6 +138,13 @@ class WindowAggOperator(Operator):
         self.windower: Optional[SliceSharedWindower] = None
         self._key_values: Dict[int, Any] = {}  # key_id -> original key value
         self._keys_hashed = False
+        #: wall-clock ms from watermark advance to fired results on host
+        #: (the p99 window-fire latency metric; reference measures this at
+        #: WindowOperator.emitWindowContents). Bounded reservoir — a
+        #: long-running job must not leak host memory.
+        from collections import deque
+
+        self.fire_latencies_ms = deque(maxlen=8192)
 
     def open(self, ctx):
         self.windower = SliceSharedWindower(
@@ -161,7 +168,12 @@ class WindowAggOperator(Operator):
         return []
 
     def process_watermark(self, watermark, input_index=0):
+        import time as _time
+
+        t0 = _time.perf_counter()
         fired = self.windower.on_watermark(watermark)
+        if fired:
+            self.fire_latencies_ms.append((_time.perf_counter() - t0) * 1e3)
         return [self._reattach_keys(b) for b in fired]
 
     def _reattach_keys(self, batch: RecordBatch) -> RecordBatch:

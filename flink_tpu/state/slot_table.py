@@ -6,11 +6,15 @@ families keyed by keyGroup+key+namespace:
 flink-state-backends/flink-statebackend-rocksdb/.../RocksDBKeyedStateBackend.java)
 with a split design natural to XLA's static-shape world:
 
-- **Host**: a hash index ``(key_id, namespace) -> slot`` plus per-slot
-  metadata (key id, namespace, key group) in NumPy arrays, a free list, and a
-  namespace -> slots registry for O(fired) window expiry.
-- **Device**: the accumulator leaves — flat ``[capacity]`` jnp arrays updated
-  by donated scatter kernels (see ``flink_tpu.windowing.aggregates``).
+- **Host** (``HostSlotIndex``): a hash index ``(key_id, namespace) -> slot``
+  plus per-slot metadata (key id, namespace) in NumPy arrays, a free list,
+  and a namespace -> slots registry for O(fired) window expiry.
+- **Device** (``SlotTable``): the accumulator leaves — flat ``[capacity]``
+  jnp arrays updated by donated scatter kernels (see
+  ``flink_tpu.windowing.aggregates``). The mesh-sharded variant
+  (``flink_tpu.parallel.sharded_windower``) keeps one HostSlotIndex per
+  shard and a single ``[num_shards, capacity]`` device array sharded over
+  the key-group mesh axis.
 
 Slot 0 is reserved as the identity slot (padding target). Capacity grows by
 doubling (a bounded number of XLA recompiles). The namespace doubles as the
@@ -21,7 +25,7 @@ window/slice id, mirroring the reference's namespace-per-window keyed state
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -55,47 +59,38 @@ def unique_pairs(
     return key_ids[first_pos], namespaces[first_pos], inverse
 
 
-class SlotTable:
-    """Keyed windowed state for one operator (one aggregate function)."""
+class HostSlotIndex:
+    """Host half of the state table: (key, ns) -> slot mapping + metadata.
 
-    def __init__(
-        self,
-        agg: AggregateFunction,
-        capacity: int = 1 << 16,
-        max_parallelism: int = 128,
-        device=None,
-    ) -> None:
-        self.agg = agg
+    Capacity growth is signalled via ``on_grow(old, new)`` so the owner can
+    resize device arrays in lockstep.
+    """
+
+    def __init__(self, capacity: int,
+                 on_grow: Optional[Callable[[int, int], None]] = None,
+                 growable: bool = True,
+                 full_hint: str = "raise state.slot-table.capacity") -> None:
         self.capacity = max(int(capacity), 1024)
-        self.max_parallelism = max_parallelism
-        self.device = device
-        # device accumulators (leaf arrays, slot 0 = identity)
-        self.accs: Tuple[jnp.ndarray, ...] = agg.init_accumulators(self.capacity)
-        # host index + metadata
+        self.on_grow = on_grow
+        self.growable = growable
+        self.full_hint = full_hint
         self._index: Dict[Tuple[int, int], int] = {}
-        self._slot_key = np.zeros(self.capacity, dtype=np.int64)
-        self._slot_ns = np.zeros(self.capacity, dtype=np.int64)
-        self._slot_used = np.zeros(self.capacity, dtype=bool)
-        # free list: slots [1, capacity) (0 reserved)
+        self.slot_key = np.zeros(self.capacity, dtype=np.int64)
+        self.slot_ns = np.zeros(self.capacity, dtype=np.int64)
+        self.slot_used = np.zeros(self.capacity, dtype=bool)
         self._free: List[int] = list(range(self.capacity - 1, 0, -1))
-        # namespace -> list of np arrays of slots (for O(fired) expiry)
         self._ns_slots: Dict[int, List[np.ndarray]] = {}
-
-    # ------------------------------------------------------------------ info
 
     @property
     def num_used(self) -> int:
-        return int(self._slot_used.sum())
+        return int(self.slot_used.sum())
 
     @property
     def namespaces(self) -> List[int]:
         return list(self._ns_slots.keys())
 
-    # ------------------------------------------------------------- main path
-
-    def lookup_or_insert(
-        self, key_ids: np.ndarray, namespaces: np.ndarray
-    ) -> np.ndarray:
+    def lookup_or_insert(self, key_ids: np.ndarray,
+                         namespaces: np.ndarray) -> np.ndarray:
         """Vectorized (key, ns) -> slot mapping; allocates missing slots.
 
         The per-unique-pair Python dict probe is the only scalar loop on the
@@ -115,9 +110,9 @@ class SlotTable:
             if slot is None:
                 slot = self._allocate()
                 index[pair] = slot
-                self._slot_key[slot] = pair[0]
-                self._slot_ns[slot] = pair[1]
-                self._slot_used[slot] = True
+                self.slot_key[slot] = pair[0]
+                self.slot_ns[slot] = pair[1]
+                self.slot_used[slot] = True
                 new_by_ns.setdefault(pair[1], []).append(slot)
             uslots[j] = slot
         for ns, slots in new_by_ns.items():
@@ -131,22 +126,98 @@ class SlotTable:
         return self._free.pop()
 
     def _grow(self) -> None:
+        if not self.growable:
+            raise RuntimeError(
+                f"slot table full (capacity={self.capacity}) and not "
+                f"growable; {self.full_hint}")
         old = self.capacity
         new_capacity = old * 2
-        self.accs = tuple(
-            jnp.concatenate(
-                [a, jnp.full((old,), leaf.identity, dtype=leaf.dtype)]
-            )
-            for a, leaf in zip(self.accs, self.agg.leaves)
-        )
-        self._slot_key = np.concatenate(
-            [self._slot_key, np.zeros(old, dtype=np.int64)])
-        self._slot_ns = np.concatenate(
-            [self._slot_ns, np.zeros(old, dtype=np.int64)])
-        self._slot_used = np.concatenate(
-            [self._slot_used, np.zeros(old, dtype=bool)])
+        self.slot_key = np.concatenate(
+            [self.slot_key, np.zeros(old, dtype=np.int64)])
+        self.slot_ns = np.concatenate(
+            [self.slot_ns, np.zeros(old, dtype=np.int64)])
+        self.slot_used = np.concatenate(
+            [self.slot_used, np.zeros(old, dtype=bool)])
         self._free.extend(range(new_capacity - 1, old - 1, -1))
         self.capacity = new_capacity
+        if self.on_grow is not None:
+            self.on_grow(old, new_capacity)
+
+    def slots_for_namespace(self, ns: int) -> np.ndarray:
+        chunks = self._ns_slots.get(ns)
+        if not chunks:
+            return np.empty(0, dtype=np.int32)
+        if len(chunks) > 1:
+            merged = np.concatenate(chunks)
+            self._ns_slots[ns] = [merged]
+            return merged
+        return chunks[0]
+
+    def free_namespaces(self, namespaces: List[int]) -> Optional[np.ndarray]:
+        """Release all slots of the given namespaces. Returns freed slots."""
+        freed: List[np.ndarray] = []
+        for ns in namespaces:
+            chunks = self._ns_slots.pop(ns, None)
+            if chunks:
+                freed.extend(chunks)
+        if not freed:
+            return None
+        slots = np.concatenate(freed)
+        index = self._index
+        sk, sn = self.slot_key, self.slot_ns
+        for s in slots.tolist():
+            index.pop((int(sk[s]), int(sn[s])), None)
+        self.slot_used[slots] = False
+        self._free.extend(slots.tolist())
+        return slots
+
+    def used_slots(self) -> np.ndarray:
+        return np.nonzero(self.slot_used)[0]
+
+
+class SlotTable:
+    """Single-device keyed windowed state (host index + device accumulators)."""
+
+    def __init__(
+        self,
+        agg: AggregateFunction,
+        capacity: int = 1 << 16,
+        max_parallelism: int = 128,
+        device=None,
+    ) -> None:
+        self.agg = agg
+        self.max_parallelism = max_parallelism
+        self.device = device
+        self.index = HostSlotIndex(capacity, on_grow=self._grow_device)
+        self.accs: Tuple[jnp.ndarray, ...] = agg.init_accumulators(
+            self.index.capacity)
+
+    # ------------------------------------------------------------------ info
+
+    @property
+    def capacity(self) -> int:
+        return self.index.capacity
+
+    @property
+    def num_used(self) -> int:
+        return self.index.num_used
+
+    @property
+    def namespaces(self) -> List[int]:
+        return self.index.namespaces
+
+    # ------------------------------------------------------------- main path
+
+    def lookup_or_insert(self, key_ids: np.ndarray,
+                         namespaces: np.ndarray) -> np.ndarray:
+        return self.index.lookup_or_insert(key_ids, namespaces)
+
+    def _grow_device(self, old: int, new: int) -> None:
+        self.accs = tuple(
+            jnp.concatenate(
+                [a, jnp.full((new - old,), leaf.identity, dtype=leaf.dtype)])
+            for a, leaf in zip(self.accs, self.agg.leaves)
+        )
 
     def scatter(self, slots: np.ndarray, values: Tuple[np.ndarray, ...]) -> None:
         """Accumulate a batch: one donated XLA scatter per leaf."""
@@ -161,17 +232,10 @@ class SlotTable:
     # ------------------------------------------------------------- fire path
 
     def slots_for_namespace(self, ns: int) -> np.ndarray:
-        chunks = self._ns_slots.get(ns)
-        if not chunks:
-            return np.empty(0, dtype=np.int32)
-        if len(chunks) > 1:
-            merged = np.concatenate(chunks)
-            self._ns_slots[ns] = [merged]
-            return merged
-        return chunks[0]
+        return self.index.slots_for_namespace(ns)
 
     def keys_of_slots(self, slots: np.ndarray) -> np.ndarray:
-        return self._slot_key[slots]
+        return self.index.slot_key[slots]
 
     def fire(self, slot_matrix: np.ndarray) -> Dict[str, np.ndarray]:
         """Merge+finish a [num_windows, k] matrix of slice slots.
@@ -190,21 +254,9 @@ class SlotTable:
 
     def free_namespaces(self, namespaces: List[int]) -> None:
         """Release all slots of the given namespaces (windows fully fired)."""
-        freed: List[np.ndarray] = []
-        for ns in namespaces:
-            chunks = self._ns_slots.pop(ns, None)
-            if chunks:
-                freed.extend(chunks)
-        if not freed:
+        slots = self.index.free_namespaces(namespaces)
+        if slots is None:
             return
-        slots = np.concatenate(freed)
-        index = self._index
-        sk = self._slot_key
-        sn = self._slot_ns
-        for s in slots.tolist():
-            index.pop((int(sk[s]), int(sn[s])), None)
-        self._slot_used[slots] = False
-        self._free.extend(slots.tolist())
         size = pad_bucket_size(len(slots))
         self.accs = self.agg._reset_jit(self.accs, pad_i32(slots, size, fill=0))
 
@@ -218,12 +270,12 @@ class SlotTable:
         group (the reference's rescale-by-key-group-range contract,
         reference: KeyGroupRangeAssignment.java + state/restore pipeline).
         """
-        used = np.nonzero(self._slot_used)[0]
+        used = self.index.used_slots()
         accs_host = [np.asarray(a) for a in self.accs]
-        key_ids = self._slot_key[used]
+        key_ids = self.index.slot_key[used]
         return {
             "key_id": key_ids,
-            "namespace": self._slot_ns[used],
+            "namespace": self.index.slot_ns[used],
             "key_group": assign_key_groups(key_ids, self.max_parallelism),
             **{
                 f"leaf_{i}": accs_host[i][used]

@@ -51,6 +51,12 @@ class AccLeaf:
         return identity_for(self.reduce, self.dtype)
 
 
+# Compiled steps are cached at module level keyed by aggregate *layout*, not
+# instance, so two pipelines with the same aggregate shape (e.g. a warmup run
+# and a measured run, or repeated jobs) share XLA executables.
+_JIT_CACHE: Dict[tuple, object] = {}
+
+
 class AggregateFunction:
     """Base class. Subclasses define ``leaves``, ``map_input`` and ``finish``."""
 
@@ -58,6 +64,12 @@ class AggregateFunction:
     leaves: Tuple[AccLeaf, ...] = ()
     #: names of the emitted result columns
     output_names: Tuple[str, ...] = ("result",)
+
+    def cache_key(self) -> tuple:
+        """Identity of the compiled programs this aggregate needs. Two
+        aggregates with equal keys can share jitted executables."""
+        return (type(self).__module__, type(self).__qualname__,
+                self.leaves, self.output_names)
 
     # -- host side ----------------------------------------------------------
 
@@ -81,9 +93,10 @@ class AggregateFunction:
 
     @property
     def _scatter_jit(self):
-        fn = getattr(self, "__scatter_jit", None)
+        methods = tuple(SCATTER_METHOD[l.reduce] for l in self.leaves)
+        key = ("scatter", methods, tuple(l.dtype.str for l in self.leaves))
+        fn = _JIT_CACHE.get(key)
         if fn is None:
-            methods = tuple(SCATTER_METHOD[l.reduce] for l in self.leaves)
 
             @partial(jax.jit, donate_argnums=(0,))
             def scatter(accs, slots, values):
@@ -92,33 +105,34 @@ class AggregateFunction:
                     for a, m, v in zip(accs, methods, values)
                 )
 
-            object.__setattr__(self, "__scatter_jit", scatter)
-            fn = scatter
+            _JIT_CACHE[key] = fn = scatter
         return fn
 
     @property
     def _fire_jit(self):
         """(accs, slot_matrix [w, k]) -> result columns [w] + merged leaves."""
-        fn = getattr(self, "__fire_jit", None)
+        key = ("fire", self.cache_key())
+        fn = _JIT_CACHE.get(key)
         if fn is None:
             merges = tuple(MERGE_FN[l.reduce] for l in self.leaves)
+            finish = self.finish
 
             @jax.jit
             def fire(accs, slot_matrix):
                 merged = tuple(
                     m(a[slot_matrix], axis=1) for a, m in zip(accs, merges)
                 )
-                return self.finish(merged)
+                return finish(merged)
 
-            object.__setattr__(self, "__fire_jit", fire)
-            fn = fire
+            _JIT_CACHE[key] = fn = fire
         return fn
 
     @property
     def _reset_jit(self):
-        fn = getattr(self, "__reset_jit", None)
+        idents = tuple(l.identity for l in self.leaves)
+        key = ("reset", idents, tuple(l.dtype.str for l in self.leaves))
+        fn = _JIT_CACHE.get(key)
         if fn is None:
-            idents = tuple(l.identity for l in self.leaves)
 
             @partial(jax.jit, donate_argnums=(0,))
             def reset(accs, slots):
@@ -126,8 +140,7 @@ class AggregateFunction:
                     a.at[slots].set(i) for a, i in zip(accs, idents)
                 )
 
-            object.__setattr__(self, "__reset_jit", reset)
-            fn = reset
+            _JIT_CACHE[key] = fn = reset
         return fn
 
     # -- convenience --------------------------------------------------------
@@ -233,6 +246,9 @@ class MultiAggregate(AggregateFunction):
             outs.extend(a.output_names)
         self.leaves = tuple(leaves)
         self.output_names = tuple(outs)
+
+    def cache_key(self):
+        return ("multi", tuple(a.cache_key() for a in self.aggs))
 
     def map_input(self, batch):
         vals: List[np.ndarray] = []

@@ -1,0 +1,105 @@
+"""Host-side window lifecycle bookkeeping, shared by the single-device and
+mesh-sharded window engines.
+
+Owns the pieces of WindowOperator semantics that are pure host metadata
+(reference: streaming/runtime/operators/windowing/WindowOperator.java —
+isWindowLate handling at processElement:293, timer-driven firing at
+onEventTime:450, state cleanup at clearAllState): the pending-window heap,
+slice -> last-window registry, late-record dropping, and the
+fire/release ordering on watermark advance. The engines own only the state
+arrays and the device math.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from flink_tpu.windowing.assigners import WindowAssigner
+
+_NEG_INF = -(1 << 62)
+
+
+class SliceBookkeeper:
+    def __init__(self, assigner: WindowAssigner, allowed_lateness: int = 0):
+        self.assigner = assigner
+        self.allowed_lateness = allowed_lateness
+        self._pending: List[int] = []
+        self._pending_set: Set[int] = set()
+        self._slice_last_window: Dict[int, int] = {}
+        self._free_after: Dict[int, List[int]] = {}
+        self.max_fired_end: int = _NEG_INF
+        self.late_records_dropped = 0
+
+    # ---------------------------------------------------------------- arrivals
+
+    def live_mask(self, slice_ends: np.ndarray) -> Optional[np.ndarray]:
+        """Late-record filter: a record is late iff every window of its slice
+        already fired (allowing ``allowed_lateness``). Returns a boolean mask
+        if any record must be dropped, else None."""
+        if self.max_fired_end <= _NEG_INF // 2:
+            return None
+        horizon = self.max_fired_end - self.allowed_lateness
+        last_ends = slice_ends + self.assigner.size - self.assigner.slice_width
+        live = last_ends > horizon
+        dropped = len(live) - int(live.sum())
+        if dropped == 0:
+            return None
+        self.late_records_dropped += dropped
+        return live
+
+    def register_slices(self, slice_ends: np.ndarray) -> None:
+        """Track new slices and schedule their windows."""
+        for se in np.unique(slice_ends).tolist():
+            if se not in self._slice_last_window:
+                ends = self.assigner.window_ends_for_slice(se)
+                last = ends[-1]
+                self._slice_last_window[se] = last
+                self._free_after.setdefault(last, []).append(se)
+                for w in ends:
+                    if w > self.max_fired_end and w not in self._pending_set:
+                        self._pending_set.add(w)
+                        heapq.heappush(self._pending, w)
+
+    # -------------------------------------------------------------------- fire
+
+    def next_window(self, watermark: int) -> Optional[int]:
+        """Pop the next window due at ``watermark`` (end-1 <= watermark)."""
+        if self._pending and self._pending[0] - 1 <= watermark:
+            w_end = heapq.heappop(self._pending)
+            self._pending_set.discard(w_end)
+            return w_end
+        return None
+
+    def mark_fired(self, window_end: int) -> List[int]:
+        """Record the fire; returns slice ends that can now be freed."""
+        self.max_fired_end = max(self.max_fired_end, window_end)
+        ends = self._free_after.pop(window_end, None)
+        if not ends:
+            return []
+        for se in ends:
+            self._slice_last_window.pop(se, None)
+        return ends
+
+    # ---------------------------------------------------------------- snapshot
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "pending": sorted(self._pending),
+            "slice_last_window": dict(self._slice_last_window),
+            "max_fired_end": self.max_fired_end,
+            "late_records_dropped": self.late_records_dropped,
+        }
+
+    def restore(self, snap: Dict[str, object]) -> None:
+        self._pending = list(snap["pending"])
+        heapq.heapify(self._pending)
+        self._pending_set = set(self._pending)
+        self._slice_last_window = dict(snap["slice_last_window"])
+        self._free_after = {}
+        for se, last in self._slice_last_window.items():
+            self._free_after.setdefault(last, []).append(se)
+        self.max_fired_end = snap["max_fired_end"]
+        self.late_records_dropped = snap.get("late_records_dropped", 0)

@@ -14,15 +14,15 @@ flink-table-runtime/.../window/tvf/common/WindowAggOperator.java:216,232):
   (the reference frees per-window state in clearAllState; here a slice is
   freed after its last participating window fires).
 
-Timers for aligned windows are implicit (window ends are known at slice
-creation), replacing the reference's per-(key, window) timer registrations
-(reference: InternalTimerServiceImpl.java:314 advanceWatermark).
+Window lifecycle metadata lives in ``SliceBookkeeper`` (shared with the
+mesh-sharded engine). Timers for aligned windows are implicit — window ends
+are known at slice creation, replacing the reference's per-(key, window)
+timer registrations (reference: InternalTimerServiceImpl.java:314).
 """
 
 from __future__ import annotations
 
-import heapq
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -30,6 +30,7 @@ from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
 from flink_tpu.state.slot_table import SlotTable
 from flink_tpu.windowing.aggregates import AggregateFunction
 from flink_tpu.windowing.assigners import WindowAssigner
+from flink_tpu.windowing.bookkeeping import SliceBookkeeper
 
 WINDOW_START_FIELD = "window_start"
 WINDOW_END_FIELD = "window_end"
@@ -50,16 +51,11 @@ class SliceSharedWindower:
         self.agg = agg
         self.table = SlotTable(agg, capacity=capacity,
                                max_parallelism=max_parallelism)
-        self.allowed_lateness = allowed_lateness
-        # pending window ends (min-heap + dedup set)
-        self._pending: List[int] = []
-        self._pending_set: Set[int] = set()
-        # slice end -> last window end (freed after that window fires)
-        self._slice_last_window: Dict[int, int] = {}
-        # window end -> slice ends to free after firing it
-        self._free_after: Dict[int, List[int]] = {}
-        self._max_fired_end: int = -(1 << 62)
-        self.late_records_dropped = 0
+        self.book = SliceBookkeeper(assigner, allowed_lateness)
+
+    @property
+    def late_records_dropped(self) -> int:
+        return self.book.late_records_dropped
 
     # --------------------------------------------------------------- ingest
 
@@ -67,55 +63,32 @@ class SliceSharedWindower:
         n = len(batch)
         if n == 0:
             return
-        ts = batch.timestamps
-        key_ids = batch.key_ids
-        slice_ends = self.assigner.assign_slice_ends(ts)
-
-        # Late-record handling: a record is late iff every window of its slice
-        # already fired (reference: WindowOperator.java:293 isWindowLate /
-        # sideOutput path; default lateness 0).
-        horizon = self._max_fired_end - self.allowed_lateness
-        if self._max_fired_end > -(1 << 61):
-            last_ends = slice_ends + self.assigner.size - self.assigner.slice_width
-            live = last_ends > horizon
-            dropped = n - int(live.sum())
-            if dropped:
-                self.late_records_dropped += dropped
-                key_ids = key_ids[live]
-                slice_ends = slice_ends[live]
-                batch = batch.filter(live)
-                if len(batch) == 0:
-                    return
-
-        # register new slices' windows
-        for se in np.unique(slice_ends).tolist():
-            if se not in self._slice_last_window:
-                ends = self.assigner.window_ends_for_slice(se)
-                last = ends[-1]
-                self._slice_last_window[se] = last
-                self._free_after.setdefault(last, []).append(se)
-                for w in ends:
-                    if w > self._max_fired_end and w not in self._pending_set:
-                        self._pending_set.add(w)
-                        heapq.heappush(self._pending, w)
-
-        slots = self.table.lookup_or_insert(key_ids, slice_ends)
-        values = self.agg.map_input(batch)
-        self.table.scatter(slots, values)
+        slice_ends = self.assigner.assign_slice_ends(batch.timestamps)
+        live = self.book.live_mask(slice_ends)
+        if live is not None:
+            slice_ends = slice_ends[live]
+            batch = batch.filter(live)
+            if len(batch) == 0:
+                return
+        self.book.register_slices(slice_ends)
+        slots = self.table.lookup_or_insert(batch.key_ids, slice_ends)
+        self.table.scatter(slots, self.agg.map_input(batch))
 
     # ----------------------------------------------------------------- fire
 
     def on_watermark(self, watermark: int) -> List[RecordBatch]:
         """Fire all windows with end - 1 <= watermark. Returns result batches."""
         out: List[RecordBatch] = []
-        while self._pending and self._pending[0] - 1 <= watermark:
-            w_end = heapq.heappop(self._pending)
-            self._pending_set.discard(w_end)
+        while True:
+            w_end = self.book.next_window(watermark)
+            if w_end is None:
+                break
             batch = self._fire_window(w_end)
             if batch is not None and len(batch) > 0:
                 out.append(batch)
-            self._max_fired_end = max(self._max_fired_end, w_end)
-            self._release_after(w_end)
+            freed = self.book.mark_fired(w_end)
+            if freed:
+                self.table.free_namespaces(freed)
         return out
 
     def _fire_window(self, window_end: int) -> Optional[RecordBatch]:
@@ -150,31 +123,14 @@ class SliceSharedWindower:
         cols.update(results)
         return RecordBatch(cols)
 
-    def _release_after(self, window_end: int) -> None:
-        ends = self._free_after.pop(window_end, None)
-        if not ends:
-            return
-        for se in ends:
-            self._slice_last_window.pop(se, None)
-        self.table.free_namespaces(ends)
-
     # ------------------------------------------------------------- snapshot
 
     def snapshot(self) -> Dict[str, object]:
         return {
             "table": self.table.snapshot(),
-            "pending": sorted(self._pending),
-            "slice_last_window": dict(self._slice_last_window),
-            "max_fired_end": self._max_fired_end,
+            **self.book.snapshot(),
         }
 
     def restore(self, snap: Dict[str, object], key_group_filter=None) -> None:
         self.table.restore(snap["table"], key_group_filter=key_group_filter)
-        self._pending = list(snap["pending"])
-        heapq.heapify(self._pending)
-        self._pending_set = set(self._pending)
-        self._slice_last_window = dict(snap["slice_last_window"])
-        self._free_after = {}
-        for se, last in self._slice_last_window.items():
-            self._free_after.setdefault(last, []).append(se)
-        self._max_fired_end = snap["max_fired_end"]
+        self.book.restore(snap)

@@ -1,0 +1,193 @@
+"""Mesh-sharded execution tests on the 8-virtual-device CPU mesh — the
+MiniCluster analog for multi-chip behavior (SURVEY.md §4 tier 3)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.records import KEY_ID_FIELD, RecordBatch
+from flink_tpu.parallel.mesh import make_mesh
+from flink_tpu.parallel.shuffle import (
+    bucket_by_shard,
+    make_all_to_all_repartition,
+    make_global_combine,
+    shard_records,
+)
+from flink_tpu.parallel.sharded_windower import MeshWindowEngine
+from flink_tpu.windowing.aggregates import (
+    AvgAggregate,
+    CountAggregate,
+    MultiAggregate,
+    SumAggregate,
+)
+from flink_tpu.windowing.assigners import (
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+)
+from flink_tpu.windowing.windower import SliceSharedWindower
+
+
+def keyed_batch(keys, values, ts):
+    return RecordBatch.from_pydict(
+        {KEY_ID_FIELD: np.asarray(keys, dtype=np.int64),
+         "v": np.asarray(values, dtype=np.float32)},
+        timestamps=ts)
+
+
+def fired_to_dict(batches, fields):
+    out = {}
+    for b in batches:
+        for row in b.to_rows():
+            out[(row[KEY_ID_FIELD], row["window_start"], row["window_end"])] = \
+                tuple(row[f] for f in fields)
+    return out
+
+
+class TestShuffle:
+    def test_shard_records_matches_keygroup_formula(self):
+        keys = np.arange(1000, dtype=np.int64)
+        shards = shard_records(keys, 8, 128)
+        assert shards.min() >= 0 and shards.max() < 8
+        counts = np.bincount(shards, minlength=8)
+        assert counts.min() > 0  # all shards get work
+
+    def test_bucket_by_shard_roundtrip(self):
+        rng = np.random.default_rng(0)
+        shards = rng.integers(0, 4, 100)
+        vals = rng.random(100).astype(np.float32)
+        counts, (block,), order = bucket_by_shard(
+            shards, 4, columns=[vals], fills=[0.0], min_bucket=16)
+        assert counts.sum() == 100
+        for p in range(4):
+            got = np.sort(block[p, :counts[p]])
+            want = np.sort(vals[shards == p])
+            np.testing.assert_allclose(got, want)
+
+    def test_all_to_all_repartition(self, eight_device_mesh):
+        import jax.numpy as jnp
+
+        mesh = eight_device_mesh
+        Pn = 8
+        x = np.arange(Pn * Pn * 4, dtype=np.float32).reshape(Pn, Pn, 4)
+        repart = make_all_to_all_repartition(mesh)
+        y = np.asarray(repart(jnp.asarray(x)))
+        # block [src, dst] moves to [dst, src]
+        np.testing.assert_allclose(y, x.transpose(1, 0, 2))
+
+    def test_global_combine_psum(self, eight_device_mesh):
+        import jax.numpy as jnp
+
+        combine = make_global_combine(eight_device_mesh, "sum")
+        partials = np.ones((8, 5), dtype=np.float32) * np.arange(
+            8, dtype=np.float32)[:, None]
+        out = np.asarray(combine(jnp.asarray(partials)))
+        np.testing.assert_allclose(out, np.full(5, 28.0))
+
+    def test_global_combine_max(self, eight_device_mesh):
+        import jax.numpy as jnp
+
+        combine = make_global_combine(eight_device_mesh, "max")
+        partials = np.arange(8, dtype=np.float32)[:, None] * np.ones(
+            (8, 3), dtype=np.float32)
+        out = np.asarray(combine(jnp.asarray(partials)))
+        np.testing.assert_allclose(out, np.full(3, 7.0))
+
+
+class TestMeshWindowEngine:
+    def _run_both(self, assigner, agg_factory, events, wm_steps, mesh):
+        """Run single-device and mesh engines on the same stream; compare."""
+        single = SliceSharedWindower(assigner, agg_factory(), capacity=1 << 14)
+        sharded = MeshWindowEngine(assigner, agg_factory(), mesh,
+                                   capacity_per_shard=1 << 12)
+        fired_s, fired_m = [], []
+        i = 0
+        for keys, vals, ts, wm in wm_steps:
+            b = keyed_batch(keys, vals, ts)
+            single.process_batch(b)
+            sharded.process_batch(b)
+            fired_s.extend(single.on_watermark(wm))
+            fired_m.extend(sharded.on_watermark(wm))
+        return fired_s, fired_m
+
+    def test_matches_single_device(self, eight_device_mesh):
+        rng = np.random.default_rng(3)
+        assigner = SlidingEventTimeWindows.of(400, 200)
+        steps = []
+        for s in range(6):
+            n = 500
+            keys = rng.integers(0, 100, n).astype(np.int64)
+            vals = rng.random(n).astype(np.float32)
+            ts = rng.integers(s * 300, s * 300 + 500, n).astype(np.int64)
+            steps.append((keys, vals, ts, s * 300))
+        steps.append((np.array([0], dtype=np.int64),
+                      np.array([0.0], dtype=np.float32),
+                      np.array([steps[-1][3] + 1000], dtype=np.int64), 10**9))
+        fired_s, fired_m = self._run_both(
+            assigner, lambda: SumAggregate("v"), None, steps,
+            eight_device_mesh)
+        ds = fired_to_dict(fired_s, ["sum_v"])
+        dm = fired_to_dict(fired_m, ["sum_v"])
+        assert set(ds) == set(dm)
+        for k in ds:
+            assert ds[k][0] == pytest.approx(dm[k][0], rel=1e-4)
+
+    def test_multi_agg_on_mesh(self, eight_device_mesh):
+        assigner = TumblingEventTimeWindows.of(100)
+        eng = MeshWindowEngine(
+            assigner,
+            MultiAggregate([CountAggregate(), AvgAggregate("v")]),
+            eight_device_mesh, capacity_per_shard=1 << 12)
+        keys = np.arange(64, dtype=np.int64)
+        eng.process_batch(keyed_batch(
+            np.repeat(keys, 2), np.tile([1.0, 3.0], 64),
+            np.full(128, 50, dtype=np.int64)))
+        fired = eng.on_watermark(99)
+        d = fired_to_dict(fired, ["count", "avg_v"])
+        assert len(d) == 64
+        for k, (cnt, avg) in d.items():
+            assert cnt == 2
+            assert avg == pytest.approx(2.0)
+
+    def test_snapshot_restore_rescale(self, eight_device_mesh):
+        """State written on an 8-shard mesh restores onto a 4-shard mesh —
+        the key-group rescale contract."""
+        import jax
+
+        assigner = TumblingEventTimeWindows.of(1000)
+        eng8 = MeshWindowEngine(assigner, SumAggregate("v"),
+                                eight_device_mesh, capacity_per_shard=1 << 12)
+        rng = np.random.default_rng(5)
+        keys = rng.integers(0, 500, 2000).astype(np.int64)
+        vals = rng.random(2000).astype(np.float32)
+        ts = np.full(2000, 100, dtype=np.int64)
+        eng8.process_batch(keyed_batch(keys, vals, ts))
+        snap = eng8.snapshot()
+
+        mesh4 = make_mesh(4)
+        eng4 = MeshWindowEngine(assigner, SumAggregate("v"), mesh4,
+                                capacity_per_shard=1 << 12)
+        eng4.restore(snap)
+        fired = eng4.on_watermark(999)
+        got = fired_to_dict(fired, ["sum_v"])
+
+        oracle = {}
+        for k, v in zip(keys.tolist(), vals.tolist()):
+            kk = (k, 0, 1000)
+            oracle[kk] = oracle.get(kk, 0.0) + v
+        assert set(got) == set(oracle)
+        for k in oracle:
+            assert got[k][0] == pytest.approx(oracle[k], rel=1e-4)
+
+    def test_state_locality_no_cross_shard_keys(self, eight_device_mesh):
+        """Each key's state must live on exactly one shard."""
+        eng = MeshWindowEngine(TumblingEventTimeWindows.of(100),
+                               CountAggregate(), eight_device_mesh,
+                               capacity_per_shard=1 << 12)
+        keys = np.arange(200, dtype=np.int64)
+        eng.process_batch(keyed_batch(
+            keys, np.ones(200, dtype=np.float32),
+            np.full(200, 10, dtype=np.int64)))
+        seen = {}
+        for p, idx in enumerate(eng.indexes):
+            for k in idx.slot_key[idx.used_slots()].tolist():
+                assert k not in seen, f"key {k} on shards {seen[k]} and {p}"
+                seen[k] = p
