@@ -152,12 +152,21 @@ class WindowedStream:
         key_field = self.keyed.key_field
         assigner = self.assigner
         lateness = self._allowed_lateness
+        if getattr(assigner, "is_merging", False):
+            from flink_tpu.runtime.operators import SessionWindowAggOperator
+
+            gap = assigner.gap
+            factory = lambda: SessionWindowAggOperator(  # noqa: E731
+                gap, agg, key_field, capacity=capacity,
+                allowed_lateness=lateness)
+        else:
+            factory = lambda: WindowAggOperator(  # noqa: E731
+                assigner, agg, key_field, capacity=capacity,
+                allowed_lateness=lateness)
         t = Transformation(
             name=name or f"window_agg({type(agg).__name__})",
             kind="one_input",
-            operator_factory=lambda: WindowAggOperator(
-                assigner, agg, key_field, capacity=capacity,
-                allowed_lateness=lateness),
+            operator_factory=factory,
             inputs=[self.keyed.transformation],
             keyed=True, key_field=key_field)
         return DataStream(env, t)

@@ -1,0 +1,85 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Build happens on demand with g++ (no pip deps): the shared object is cached
+under ``native/build/``. Set ``FLINK_TPU_NO_NATIVE=1`` to force the pure
+Python fallbacks (used in tests to cover both paths).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "slotmap.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_SO_PATH = os.path.join(_BUILD_DIR, "_slotmap.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compile() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           _SRC, "-o", _SO_PATH]
+    try:
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        return r.returncode == 0 and os.path.exists(_SO_PATH)
+    except Exception:
+        return False
+
+
+def load_slotmap() -> Optional[ctypes.CDLL]:
+    """The slotmap library, or None if unavailable/disabled."""
+    global _lib, _tried
+    if os.environ.get("FLINK_TPU_NO_NATIVE") == "1":
+        return None
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO_PATH) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_SO_PATH)):
+            if not _compile():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        c = ctypes
+        i64, i32, u8, vp = (c.c_int64, c.c_int32, c.c_uint8, c.c_void_p)
+        P = c.POINTER
+        lib.sm_create.restype = vp
+        lib.sm_create.argtypes = [i64, i64]
+        lib.sm_destroy.argtypes = [vp]
+        lib.sm_capacity.restype = i64
+        lib.sm_capacity.argtypes = [vp]
+        lib.sm_used.restype = i64
+        lib.sm_used.argtypes = [vp]
+        lib.sm_slot_keys.restype = P(i64)
+        lib.sm_slot_keys.argtypes = [vp]
+        lib.sm_slot_namespaces.restype = P(i64)
+        lib.sm_slot_namespaces.argtypes = [vp]
+        lib.sm_slot_used.restype = P(u8)
+        lib.sm_slot_used.argtypes = [vp]
+        lib.sm_lookup_or_insert.restype = i32
+        lib.sm_lookup_or_insert.argtypes = [vp, i64, P(i64), P(i64), P(i32),
+                                            P(u8)]
+        lib.sm_erase.restype = i64
+        lib.sm_erase.argtypes = [vp, i64, P(i64), P(i64), P(i32)]
+        lib.sm_erase_namespace.restype = i64
+        lib.sm_erase_namespace.argtypes = [vp, i64, P(i32)]
+        _lib = lib
+        return _lib
+
+
+def slotmap_available() -> bool:
+    return load_slotmap() is not None

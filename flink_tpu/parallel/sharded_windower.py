@@ -69,8 +69,10 @@ class MeshWindowEngine:
             raise ValueError(
                 f"max_parallelism {max_parallelism} < mesh size {self.P}")
 
+        from flink_tpu.state.slot_table import make_slot_index
+
         self.indexes = [
-            HostSlotIndex(
+            make_slot_index(
                 self.capacity, growable=False,
                 full_hint="raise MeshWindowEngine capacity_per_shard (hot-key "
                           "skew can concentrate keys on one shard)")
@@ -129,6 +131,11 @@ class MeshWindowEngine:
                 out_specs=(P(KEY_AXIS),) * n_leaves,
             )(*accs, slots, *values)
 
+        # hoisted so the jitted closures capture only plain values, never
+        # `self` (the step cache outlives engines; a self-capture would pin
+        # the first engine's device arrays in memory for the process)
+        names = sorted(self.agg.output_names)
+
         @jax.jit
         def fire_step(accs, slot_matrix):
             # slot_matrix: [P, W, k] sharded -> result cols each [P, W]
@@ -138,10 +145,8 @@ class MeshWindowEngine:
                 merged = tuple(
                     m(a[0][sm], axis=1) for a, m in zip(accs_l, merges))
                 out = finish(merged)              # dict name -> [W]
-                return tuple(out[name][None]
-                             for name in sorted(out.keys()))
+                return tuple(out[name][None] for name in names)
 
-            names = sorted(self.agg.output_names)
             outs = jax.shard_map(
                 local, mesh=mesh,
                 in_specs=(P(KEY_AXIS),) * (n_leaves + 1),
@@ -228,9 +233,10 @@ class MeshWindowEngine:
             batch = self._fire_window(w_end)
             if batch is not None and len(batch) > 0:
                 out.append(batch)
-            freed = self.book.mark_fired(w_end)
-            if freed:
-                self._free_slices(freed)
+            self.book.mark_fired(w_end)
+        expired = self.book.expired_slices(watermark)
+        if expired:
+            self._free_slices(expired)
         return out
 
     def _fire_window(self, window_end: int) -> Optional[RecordBatch]:

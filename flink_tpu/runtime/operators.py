@@ -201,6 +201,29 @@ class WindowAggOperator(Operator):
         self._keys_hashed = state["keys_hashed"]
 
 
+class SessionWindowAggOperator(WindowAggOperator):
+    """Merging session windows (reference: WindowOperator + MergingWindowSet;
+    see flink_tpu.windowing.sessions for the host/device split). Shares the
+    key-reattachment / latency / snapshot plumbing with WindowAggOperator;
+    only the windower implementation differs."""
+
+    name = "session_window_agg"
+
+    def __init__(self, gap: int, agg: AggregateFunction, key_field: str,
+                 capacity: int = 1 << 16, allowed_lateness: int = 0):
+        super().__init__(assigner=None, agg=agg, key_field=key_field,
+                         capacity=capacity, allowed_lateness=allowed_lateness)
+        self.gap = gap
+
+    def open(self, ctx):
+        from flink_tpu.windowing.sessions import SessionWindower
+
+        self.windower = SessionWindower(
+            self.gap, self.agg, capacity=self.capacity,
+            max_parallelism=ctx.max_parallelism,
+            allowed_lateness=self.allowed_lateness)
+
+
 class UnionOperator(Operator):
     """Pass-through merge of multiple inputs; watermark = min over inputs
     (valve handled by the task wiring)."""

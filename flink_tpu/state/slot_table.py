@@ -59,7 +59,43 @@ def unique_pairs(
     return key_ids[first_pos], namespaces[first_pos], inverse
 
 
-class HostSlotIndex:
+class _NamespaceRegistry:
+    """Shared namespace -> slots registry (O(namespaces), pure Python).
+
+    Mixed into both slot-index implementations so slice expiry and the
+    chunk-merge bookkeeping exist exactly once.
+    """
+
+    def _init_registry(self) -> None:
+        self._ns_slots: Dict[int, List[np.ndarray]] = {}
+
+    @property
+    def namespaces(self) -> List[int]:
+        return list(self._ns_slots.keys())
+
+    def slots_for_namespace(self, ns: int) -> np.ndarray:
+        chunks = self._ns_slots.get(ns)
+        if not chunks:
+            return np.empty(0, dtype=np.int32)
+        if len(chunks) > 1:
+            merged = np.concatenate(chunks)
+            self._ns_slots[ns] = [merged]
+            return merged
+        return chunks[0]
+
+    def _registry_drain(self, namespaces: List[int]) -> Optional[np.ndarray]:
+        """Remove and return all slots registered under ``namespaces``."""
+        freed: List[np.ndarray] = []
+        for ns in namespaces:
+            chunks = self._ns_slots.pop(ns, None)
+            if chunks:
+                freed.extend(chunks)
+        if not freed:
+            return None
+        return np.concatenate(freed)
+
+
+class HostSlotIndex(_NamespaceRegistry):
     """Host half of the state table: (key, ns) -> slot mapping + metadata.
 
     Capacity growth is signalled via ``on_grow(old, new)`` so the owner can
@@ -79,15 +115,11 @@ class HostSlotIndex:
         self.slot_ns = np.zeros(self.capacity, dtype=np.int64)
         self.slot_used = np.zeros(self.capacity, dtype=bool)
         self._free: List[int] = list(range(self.capacity - 1, 0, -1))
-        self._ns_slots: Dict[int, List[np.ndarray]] = {}
+        self._init_registry()
 
     @property
     def num_used(self) -> int:
         return int(self.slot_used.sum())
-
-    @property
-    def namespaces(self) -> List[int]:
-        return list(self._ns_slots.keys())
 
     def lookup_or_insert(self, key_ids: np.ndarray,
                          namespaces: np.ndarray) -> np.ndarray:
@@ -143,26 +175,11 @@ class HostSlotIndex:
         if self.on_grow is not None:
             self.on_grow(old, new_capacity)
 
-    def slots_for_namespace(self, ns: int) -> np.ndarray:
-        chunks = self._ns_slots.get(ns)
-        if not chunks:
-            return np.empty(0, dtype=np.int32)
-        if len(chunks) > 1:
-            merged = np.concatenate(chunks)
-            self._ns_slots[ns] = [merged]
-            return merged
-        return chunks[0]
-
     def free_namespaces(self, namespaces: List[int]) -> Optional[np.ndarray]:
         """Release all slots of the given namespaces. Returns freed slots."""
-        freed: List[np.ndarray] = []
-        for ns in namespaces:
-            chunks = self._ns_slots.pop(ns, None)
-            if chunks:
-                freed.extend(chunks)
-        if not freed:
+        slots = self._registry_drain(namespaces)
+        if slots is None:
             return None
-        slots = np.concatenate(freed)
         index = self._index
         sk, sn = self.slot_key, self.slot_ns
         for s in slots.tolist():
@@ -173,6 +190,127 @@ class HostSlotIndex:
 
     def used_slots(self) -> np.ndarray:
         return np.nonzero(self.slot_used)[0]
+
+
+class NativeSlotIndex(_NamespaceRegistry):
+    """C++-backed drop-in for HostSlotIndex (see native/slotmap.cpp).
+
+    The batch probe loop runs in native code; slot metadata lives in
+    C++-owned arrays exposed to NumPy zero-copy. The namespace -> slots
+    registry stays in Python (it is O(namespaces), not O(records)).
+    """
+
+    def __init__(self, capacity: int,
+                 on_grow: Optional[Callable[[int, int], None]] = None,
+                 growable: bool = True,
+                 full_hint: str = "raise state.slot-table.capacity") -> None:
+        from flink_tpu.native import load_slotmap
+
+        self._lib = load_slotmap()
+        assert self._lib is not None
+        self.capacity = max(int(capacity), 1024)
+        self.on_grow = on_grow
+        self.growable = growable
+        self.full_hint = full_hint
+        max_cap = (1 << 28) if growable else self.capacity
+        self._h = self._lib.sm_create(self.capacity, max_cap)
+        self._wrap_views()
+        self._init_registry()
+
+    def _wrap_views(self) -> None:
+        import ctypes
+
+        cap = int(self._lib.sm_capacity(self._h))
+        self.capacity = cap
+        self.slot_key = np.ctypeslib.as_array(
+            self._lib.sm_slot_keys(self._h), shape=(cap,))
+        self.slot_ns = np.ctypeslib.as_array(
+            self._lib.sm_slot_namespaces(self._h), shape=(cap,))
+        self.slot_used = np.ctypeslib.as_array(
+            self._lib.sm_slot_used(self._h), shape=(cap,)).view(bool)
+
+    def __del__(self):  # pragma: no cover - finalizer
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.sm_destroy(h)
+            self._h = None
+
+    @property
+    def num_used(self) -> int:
+        return int(self._lib.sm_used(self._h))
+
+    def lookup_or_insert(self, key_ids: np.ndarray,
+                         namespaces: np.ndarray) -> np.ndarray:
+        import ctypes
+
+        keys = np.ascontiguousarray(key_ids, dtype=np.int64)
+        nss = np.ascontiguousarray(namespaces, dtype=np.int64)
+        n = len(keys)
+        out = np.empty(n, dtype=np.int32)
+        is_new = np.empty(n, dtype=np.uint8)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        old_cap = self.capacity
+        rc = self._lib.sm_lookup_or_insert(
+            self._h, n,
+            keys.ctypes.data_as(i64p), nss.ctypes.data_as(i64p),
+            out.ctypes.data_as(i32p), is_new.ctypes.data_as(u8p))
+        if rc < 0:
+            raise RuntimeError(
+                f"slot table full (capacity={self.capacity}) and not "
+                f"growable; {self.full_hint}")
+        if rc > 0:
+            self._wrap_views()
+            if self.on_grow is not None:
+                self.on_grow(old_cap, self.capacity)
+        new_mask = is_new.view(bool)
+        if new_mask.any():
+            new_slots = out[new_mask]
+            new_ns = nss[new_mask]
+            # group new slots by namespace: sort + split (O(n log n), not a
+            # per-namespace mask scan)
+            order = np.argsort(new_ns, kind="stable")
+            sorted_ns = new_ns[order]
+            sorted_slots = new_slots[order]
+            boundaries = np.nonzero(np.diff(sorted_ns))[0] + 1
+            chunks = np.split(sorted_slots, boundaries)
+            firsts = np.concatenate(([0], boundaries))
+            reg = self._ns_slots
+            for ns, chunk in zip(sorted_ns[firsts].tolist(), chunks):
+                reg.setdefault(ns, []).append(chunk)
+        return out
+
+    def free_namespaces(self, namespaces: List[int]) -> Optional[np.ndarray]:
+        import ctypes
+
+        drained = self._registry_drain(namespaces)
+        if drained is None:
+            return None
+        slots = np.ascontiguousarray(drained, dtype=np.int32)
+        keys = np.ascontiguousarray(self.slot_key[slots])
+        nss = np.ascontiguousarray(self.slot_ns[slots])
+        out = np.empty(len(slots), dtype=np.int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        n = self._lib.sm_erase(
+            self._h, len(slots),
+            keys.ctypes.data_as(i64p), nss.ctypes.data_as(i64p),
+            out.ctypes.data_as(i32p))
+        return out[:n]
+
+    def used_slots(self) -> np.ndarray:
+        return np.nonzero(self.slot_used)[0]
+
+
+def make_slot_index(capacity: int, on_grow=None, growable: bool = True,
+                    full_hint: str = "raise state.slot-table.capacity"):
+    """Native index when the C++ library is available, else pure Python."""
+    from flink_tpu.native import slotmap_available
+
+    cls = NativeSlotIndex if slotmap_available() else HostSlotIndex
+    return cls(capacity, on_grow=on_grow, growable=growable,
+               full_hint=full_hint)
 
 
 class SlotTable:
@@ -188,7 +326,7 @@ class SlotTable:
         self.agg = agg
         self.max_parallelism = max_parallelism
         self.device = device
-        self.index = HostSlotIndex(capacity, on_grow=self._grow_device)
+        self.index = make_slot_index(capacity, on_grow=self._grow_device)
         self.accs: Tuple[jnp.ndarray, ...] = agg.init_accumulators(
             self.index.capacity)
 

@@ -67,9 +67,19 @@ class AggregateFunction:
 
     def cache_key(self) -> tuple:
         """Identity of the compiled programs this aggregate needs. Two
-        aggregates with equal keys can share jitted executables."""
+        aggregates with equal keys can share jitted executables.
+
+        Includes every hashable-primitive instance attribute so that
+        parameterized subclasses (e.g. a scale factor used inside
+        ``finish``) do not alias each other's compiled kernels. Subclasses
+        whose ``finish`` depends on non-primitive state must override this.
+        """
+        params = tuple(
+            (k, v) for k, v in sorted(vars(self).items())
+            if isinstance(v, (str, int, float, bool, bytes, tuple))
+        )
         return (type(self).__module__, type(self).__qualname__,
-                self.leaves, self.output_names)
+                self.leaves, self.output_names, params)
 
     # -- host side ----------------------------------------------------------
 

@@ -62,8 +62,16 @@ class WindowAssigner:
         return list(range(first, window_end + 1, self.slice_width))
 
     def last_window_end_for_slice(self, slice_end: int) -> int:
-        """After this window fires, the slice can be freed."""
+        """After this window fires (plus lateness), the slice can be freed."""
         return self.window_ends_for_slice(slice_end)[-1]
+
+    def last_window_ends(self, slice_ends: np.ndarray) -> np.ndarray:
+        """Vectorized last participating window end per slice (used by the
+        late-record filter; must agree exactly with
+        ``window_ends_for_slice(se)[-1]``)."""
+        se = np.asarray(slice_ends, dtype=np.int64)
+        w = se + self.size - self.slice_width
+        return w - np.remainder(w - self.offset, self.slide)
 
     def window_start(self, window_end: int) -> int:
         return window_end - self.size
@@ -127,6 +135,12 @@ class CumulativeEventTimeWindows(WindowAssigner):
     def window_start(self, window_end: int) -> int:
         return window_end - ((window_end - self.offset - self.slice_width)
                              % self.size) - self.slice_width
+
+    def last_window_ends(self, slice_ends: np.ndarray) -> np.ndarray:
+        se = np.asarray(slice_ends, dtype=np.int64)
+        span_start = se - np.remainder(
+            se - self.offset - self.slice_width, self.size)
+        return span_start + self.size - self.slice_width
 
 
 @dataclasses.dataclass(frozen=True)

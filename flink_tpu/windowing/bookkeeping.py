@@ -3,11 +3,18 @@ mesh-sharded window engines.
 
 Owns the pieces of WindowOperator semantics that are pure host metadata
 (reference: streaming/runtime/operators/windowing/WindowOperator.java —
-isWindowLate handling at processElement:293, timer-driven firing at
-onEventTime:450, state cleanup at clearAllState): the pending-window heap,
-slice -> last-window registry, late-record dropping, and the
+isWindowLate at processElement:293, timer-driven firing at onEventTime:450,
+allowed-lateness retention + cleanup timers at clearAllState): the
+pending-window heap, the slice cleanup heap, late-record dropping, and the
 fire/release ordering on watermark advance. The engines own only the state
 arrays and the device math.
+
+Allowed-lateness semantics (mirrors the reference): a window first fires when
+the watermark passes its end; its slices are *retained* for ``lateness`` more
+event-time ms. A late record landing in a retained slice re-schedules the
+already-fired windows it contributes to, producing updated ("late firing")
+results — note the vectorized engine re-emits the whole window's keys, not
+just the late key. Records whose slices are past retention are dropped.
 """
 
 from __future__ import annotations
@@ -28,22 +35,24 @@ class SliceBookkeeper:
         self.allowed_lateness = allowed_lateness
         self._pending: List[int] = []
         self._pending_set: Set[int] = set()
+        # slice end -> last participating window end (live slices)
         self._slice_last_window: Dict[int, int] = {}
-        self._free_after: Dict[int, List[int]] = {}
+        # (cleanup_time, slice_end): slice freed when watermark >= cleanup_time
+        self._cleanup: List[tuple] = []
+        self.watermark: int = _NEG_INF
         self.max_fired_end: int = _NEG_INF
         self.late_records_dropped = 0
 
     # ---------------------------------------------------------------- arrivals
 
     def live_mask(self, slice_ends: np.ndarray) -> Optional[np.ndarray]:
-        """Late-record filter: a record is late iff every window of its slice
-        already fired (allowing ``allowed_lateness``). Returns a boolean mask
-        if any record must be dropped, else None."""
-        if self.max_fired_end <= _NEG_INF // 2:
+        """Late-record filter: a record is dropped iff its slice is past
+        retention (last window end - 1 + lateness <= current watermark).
+        Returns a boolean mask if any record must be dropped, else None."""
+        if self.watermark <= _NEG_INF // 2:
             return None
-        horizon = self.max_fired_end - self.allowed_lateness
-        last_ends = slice_ends + self.assigner.size - self.assigner.slice_width
-        live = last_ends > horizon
+        last_ends = self.assigner.last_window_ends(slice_ends)
+        live = last_ends - 1 + self.allowed_lateness > self.watermark
         dropped = len(live) - int(live.sum())
         if dropped == 0:
             return None
@@ -51,37 +60,56 @@ class SliceBookkeeper:
         return live
 
     def register_slices(self, slice_ends: np.ndarray) -> None:
-        """Track new slices and schedule their windows."""
+        """Track new slices and (re-)schedule their windows.
+
+        A window is scheduled iff it can still produce output:
+        w - 1 + lateness > watermark. For an already-fired window inside the
+        lateness allowance this is a late re-firing."""
+        lateness = self.allowed_lateness
         for se in np.unique(slice_ends).tolist():
+            ends = None
             if se not in self._slice_last_window:
                 ends = self.assigner.window_ends_for_slice(se)
                 last = ends[-1]
                 self._slice_last_window[se] = last
-                self._free_after.setdefault(last, []).append(se)
-                for w in ends:
-                    if w > self.max_fired_end and w not in self._pending_set:
-                        self._pending_set.add(w)
-                        heapq.heappush(self._pending, w)
+                heapq.heappush(self._cleanup, (last - 1 + lateness, se))
+            elif lateness > 0:
+                # existing slice: a late record may need to re-fire windows
+                # that already fired
+                ends = self.assigner.window_ends_for_slice(se)
+            if ends is None:
+                continue
+            for w in ends:
+                if (w - 1 + lateness > self.watermark
+                        and w not in self._pending_set):
+                    self._pending_set.add(w)
+                    heapq.heappush(self._pending, w)
 
     # -------------------------------------------------------------------- fire
 
     def next_window(self, watermark: int) -> Optional[int]:
         """Pop the next window due at ``watermark`` (end-1 <= watermark)."""
+        self.watermark = max(self.watermark, watermark)
         if self._pending and self._pending[0] - 1 <= watermark:
             w_end = heapq.heappop(self._pending)
             self._pending_set.discard(w_end)
             return w_end
         return None
 
-    def mark_fired(self, window_end: int) -> List[int]:
-        """Record the fire; returns slice ends that can now be freed."""
+    def mark_fired(self, window_end: int) -> None:
         self.max_fired_end = max(self.max_fired_end, window_end)
-        ends = self._free_after.pop(window_end, None)
-        if not ends:
-            return []
-        for se in ends:
-            self._slice_last_window.pop(se, None)
-        return ends
+
+    def expired_slices(self, watermark: int) -> List[int]:
+        """Slices past retention at ``watermark`` — free their state.
+        Call after the fire loop of the same watermark."""
+        self.watermark = max(self.watermark, watermark)
+        out: List[int] = []
+        while self._cleanup and self._cleanup[0][0] <= watermark:
+            _, se = heapq.heappop(self._cleanup)
+            if se in self._slice_last_window:
+                del self._slice_last_window[se]
+                out.append(se)
+        return out
 
     # ---------------------------------------------------------------- snapshot
 
@@ -89,6 +117,7 @@ class SliceBookkeeper:
         return {
             "pending": sorted(self._pending),
             "slice_last_window": dict(self._slice_last_window),
+            "watermark": self.watermark,
             "max_fired_end": self.max_fired_end,
             "late_records_dropped": self.late_records_dropped,
         }
@@ -98,8 +127,12 @@ class SliceBookkeeper:
         heapq.heapify(self._pending)
         self._pending_set = set(self._pending)
         self._slice_last_window = dict(snap["slice_last_window"])
-        self._free_after = {}
-        for se, last in self._slice_last_window.items():
-            self._free_after.setdefault(last, []).append(se)
+        self._cleanup = [
+            (last - 1 + self.allowed_lateness, se)
+            for se, last in self._slice_last_window.items()
+        ]
+        heapq.heapify(self._cleanup)
+        self.watermark = snap.get("watermark", snap.get("max_fired_end",
+                                                        _NEG_INF))
         self.max_fired_end = snap["max_fired_end"]
         self.late_records_dropped = snap.get("late_records_dropped", 0)

@@ -1,0 +1,300 @@
+"""Session windows: merging windows with device accumulators.
+
+reference semantics: EventTimeSessionWindows + MergingWindowSet
+(streaming/runtime/operators/windowing/WindowOperator.java:159-162 splits
+merge *metadata* from merged *state*; MergingWindowSet tracks interval merges,
+windowMergingState merges namespaces). The TPU re-design keeps exactly that
+split:
+
+- **Host**: per-key sorted interval lists ``key -> [(start, end, sid)]``
+  (tiny per key), a lazy fire heap, and a session-id allocator.
+- **Device**: one accumulator slot per live session. Batch-local
+  sessionization is vectorized (lexsort + gap scan); record values scatter
+  straight into their final session slot; merging two sessions is a batched
+  ``acc.at[dst].op(acc[src])`` scatter (duplicate dst allowed — scatter
+  reduces), then the absorbed slots reset to identity.
+
+A session [start, end) fires when watermark >= end - 1 where
+end = last_event_ts + gap. Extensions/merges invalidate heap entries lazily
+(entries carry their sid+end; stale ones are skipped on pop).
+"""
+
+from __future__ import annotations
+
+import heapq
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
+from flink_tpu.ops.segment_ops import SCATTER_METHOD, pad_bucket_size, pad_i32
+from flink_tpu.state.slot_table import SlotTable
+from flink_tpu.windowing.aggregates import AggregateFunction, _JIT_CACHE
+from flink_tpu.windowing.windower import WINDOW_END_FIELD, WINDOW_START_FIELD
+
+_NEG_INF = -(1 << 62)
+
+
+def _merge_jit(agg: AggregateFunction):
+    """acc[dst] op= acc[src] for arrays of (dst, src), then reset src slots."""
+    methods = tuple(SCATTER_METHOD[l.reduce] for l in agg.leaves)
+    idents = tuple(l.identity for l in agg.leaves)
+    key = ("session-merge", methods, idents,
+           tuple(l.dtype.str for l in agg.leaves))
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def merge(accs, dst, src):
+            out = []
+            for a, m, i in zip(accs, methods, idents):
+                moved = a[src]
+                a = getattr(a.at[dst], m)(moved)
+                # src != dst for real pairs; padded lanes have src == dst == 0
+                a = a.at[src].set(i)
+                out.append(a)
+            return tuple(out)
+
+        _JIT_CACHE[key] = fn = merge
+    return fn
+
+
+class SessionWindower:
+    """Keyed session windows over one shard (single device)."""
+
+    def __init__(
+        self,
+        gap: int,
+        agg: AggregateFunction,
+        capacity: int = 1 << 16,
+        max_parallelism: int = 128,
+        allowed_lateness: int = 0,
+    ) -> None:
+        self.gap = int(gap)
+        self.agg = agg
+        # Late records within the allowance start a NEW session (emitted as an
+        # additional partial result) since fired sessions are freed eagerly;
+        # records beyond the allowance are dropped.
+        self.allowed_lateness = int(allowed_lateness)
+        self.table = SlotTable(agg, capacity=capacity,
+                               max_parallelism=max_parallelism)
+        # key -> list of (start, end, sid), sorted by start; usually length 1
+        self.sessions: Dict[int, List[Tuple[int, int, int]]] = {}
+        self._next_sid = 1
+        self._fire_heap: List[Tuple[int, int, int]] = []  # (end, key, sid)
+        self.max_fired_watermark = _NEG_INF
+        self.late_records_dropped = 0
+        # pending accumulator merges (dst, src) + absorbed session ids whose
+        # host slots must stay allocated until the merge kernel has run
+        self._merge_dst: List[int] = []
+        self._merge_src: List[int] = []
+        self._merge_dst_set: set = set()
+        self._merge_src_set: set = set()
+        self._absorbed_sids: List[int] = []
+
+    # ---------------------------------------------------------------- ingest
+
+    def process_batch(self, batch: RecordBatch) -> None:
+        n = len(batch)
+        if n == 0:
+            return
+        ts = np.asarray(batch.timestamps, dtype=np.int64)
+        keys = np.asarray(batch.key_ids, dtype=np.int64)
+
+        # drop records whose session would already have ended (beyond the
+        # lateness allowance)
+        if self.max_fired_watermark > _NEG_INF // 2:
+            live = (ts + self.gap - 1 + self.allowed_lateness
+                    > self.max_fired_watermark)
+            dropped = n - int(live.sum())
+            if dropped:
+                self.late_records_dropped += dropped
+                ts, keys = ts[live], keys[live]
+                batch = batch.filter(live)
+                n = len(batch)
+                if n == 0:
+                    return
+
+        # vectorized batch-local sessionization: sort by (key, ts); a new
+        # local session starts at a key change or a gap exceedance
+        order = np.lexsort((ts, keys))
+        ks, tss = keys[order], ts[order]
+        new_sess = np.empty(n, dtype=bool)
+        new_sess[0] = True
+        new_sess[1:] = (ks[1:] != ks[:-1]) | (tss[1:] - tss[:-1] > self.gap)
+        sess_of_sorted = np.cumsum(new_sess) - 1
+        starts_pos = np.nonzero(new_sess)[0]
+        m = len(starts_pos)
+        ends_pos = np.empty(m, dtype=np.int64)
+        ends_pos[:-1] = starts_pos[1:] - 1
+        ends_pos[-1] = n - 1
+        sess_key = ks[starts_pos]
+        sess_min = tss[starts_pos]
+        sess_max = tss[ends_pos]
+
+        # merge each batch-local session into the persistent interval set
+        # (pure metadata — slot lookups are batched below)
+        sess_sid = np.empty(m, dtype=np.int64)
+        for j in range(m):
+            sess_sid[j] = self._merge_session(
+                int(sess_key[j]), int(sess_min[j]),
+                int(sess_max[j]) + self.gap)
+
+        # ONE vectorized lookup for all session slots, then scatter records
+        slot_of_sess = self.table.lookup_or_insert(sess_key, sess_sid)
+        rec_slots = np.empty(n, dtype=np.int32)
+        rec_slots[order] = slot_of_sess[sess_of_sorted]
+        self.table.scatter(rec_slots, self.agg.map_input(batch))
+        self._flush_merges()
+
+    def _add_merge(self, key: int, dst_sid: int, src_sid: int) -> None:
+        """Queue an accumulator merge by session id. A chain (src was an
+        earlier dst, or dst was an earlier src) would make the single
+        gather/scatter kernel read stale values, so flush the pending batch
+        first."""
+        if (src_sid in self._merge_dst_set or src_sid in self._merge_src_set
+                or dst_sid in self._merge_src_set):
+            self._flush_merges()
+        self._merge_dst.append((key, dst_sid))
+        self._merge_src.append((key, src_sid))
+        self._merge_dst_set.add(dst_sid)
+        self._merge_src_set.add(src_sid)
+
+    def _flush_merges(self) -> None:
+        if not self._merge_dst:
+            return
+        dk = np.asarray([p[0] for p in self._merge_dst], dtype=np.int64)
+        ds = np.asarray([p[1] for p in self._merge_dst], dtype=np.int64)
+        sk = np.asarray([p[0] for p in self._merge_src], dtype=np.int64)
+        ss = np.asarray([p[1] for p in self._merge_src], dtype=np.int64)
+        dst_slots = self.table.lookup_or_insert(dk, ds)
+        src_slots = self.table.lookup_or_insert(sk, ss)
+        size = pad_bucket_size(len(dst_slots))
+        self.table.accs = _merge_jit(self.agg)(
+            self.table.accs,
+            pad_i32(dst_slots, size, fill=0),
+            pad_i32(src_slots, size, fill=0))
+        # absorbed host slots are only reusable once their values have moved
+        if self._absorbed_sids:
+            self.table.index.free_namespaces(self._absorbed_sids)
+            self._absorbed_sids = []
+        self._merge_dst, self._merge_src = [], []
+        self._merge_dst_set, self._merge_src_set = set(), set()
+
+    def _merge_session(self, key: int, start: int, end: int) -> int:
+        """Merge [start, end) into key's intervals; returns the session id.
+
+        Mirrors MergingWindowSet.addWindow: overlapping intervals collapse
+        into one; absorbed sessions queue an accumulator merge (dst, src).
+        Pure host metadata — device slot lookups are batched by the caller.
+        """
+        intervals = self.sessions.get(key)
+        if intervals is None:
+            sid = self._alloc_sid()
+            self.sessions[key] = [(start, end, sid)]
+            heapq.heappush(self._fire_heap, (end, key, sid))
+            return sid
+
+        overlapping = [iv for iv in intervals
+                       if iv[0] <= end and start <= iv[1]]
+        if not overlapping:
+            sid = self._alloc_sid()
+            intervals.append((start, end, sid))
+            intervals.sort()
+            heapq.heappush(self._fire_heap, (end, key, sid))
+            return sid
+
+        # absorb into the first overlapping interval's session
+        keep = overlapping[0]
+        new_start = min(start, keep[0])
+        new_end = max(end, keep[1])
+        for iv in overlapping[1:]:
+            new_start = min(new_start, iv[0])
+            new_end = max(new_end, iv[1])
+            self._add_merge(key, keep[2], iv[2])
+            self._absorbed_sids.append(iv[2])
+        remaining = [iv for iv in intervals if iv not in overlapping]
+        merged = (new_start, new_end, keep[2])
+        remaining.append(merged)
+        remaining.sort()
+        self.sessions[key] = remaining
+        if new_end != keep[1]:
+            heapq.heappush(self._fire_heap, (new_end, key, keep[2]))
+        return keep[2]
+
+    def _alloc_sid(self) -> int:
+        sid = self._next_sid
+        self._next_sid += 1
+        return sid
+
+    # ------------------------------------------------------------------ fire
+
+    def on_watermark(self, watermark: int) -> List[RecordBatch]:
+        fired_keys: List[int] = []
+        fired_starts: List[int] = []
+        fired_ends: List[int] = []
+        fired_sids: List[int] = []
+        while self._fire_heap and self._fire_heap[0][0] - 1 <= watermark:
+            end, key, sid = heapq.heappop(self._fire_heap)
+            intervals = self.sessions.get(key)
+            if not intervals:
+                continue
+            cur = next((iv for iv in intervals if iv[2] == sid), None)
+            if cur is None or cur[1] != end:
+                continue  # stale entry (merged or extended)
+            fired_keys.append(key)
+            fired_starts.append(cur[0])
+            fired_ends.append(end)
+            fired_sids.append(sid)
+            intervals.remove(cur)
+            if not intervals:
+                del self.sessions[key]
+        self.max_fired_watermark = max(self.max_fired_watermark, watermark)
+        if not fired_keys:
+            return []
+        fired_slots = self.table.lookup_or_insert(
+            np.asarray(fired_keys, dtype=np.int64),
+            np.asarray(fired_sids, dtype=np.int64))
+        matrix = np.asarray(fired_slots, dtype=np.int32)[:, None]
+        results = self.table.fire(matrix)
+        self.table.free_namespaces(fired_sids)
+        m = len(fired_keys)
+        cols = {
+            KEY_ID_FIELD: np.asarray(fired_keys, dtype=np.int64),
+            WINDOW_START_FIELD: np.asarray(fired_starts, dtype=np.int64),
+            WINDOW_END_FIELD: np.asarray(fired_ends, dtype=np.int64),
+            TIMESTAMP_FIELD: np.asarray(fired_ends, dtype=np.int64) - 1,
+        }
+        cols.update(results)
+        return [RecordBatch(cols)]
+
+    # -------------------------------------------------------------- snapshot
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "table": self.table.snapshot(),
+            "sessions": {k: list(v) for k, v in self.sessions.items()},
+            "next_sid": self._next_sid,
+            "max_fired_watermark": self.max_fired_watermark,
+        }
+
+    def restore(self, snap: Dict[str, object], key_group_filter=None) -> None:
+        self.table.restore(snap["table"], key_group_filter=key_group_filter)
+        self.sessions = {}
+        self._fire_heap = []
+        for k, ivs in snap["sessions"].items():
+            kept = [tuple(iv) for iv in ivs]
+            if key_group_filter is not None:
+                from flink_tpu.state.keygroups import assign_key_groups
+
+                g = int(assign_key_groups(np.array([k]),
+                                          self.table.max_parallelism)[0])
+                if g not in key_group_filter:
+                    continue
+            self.sessions[int(k)] = kept
+            for start, end, sid in kept:
+                heapq.heappush(self._fire_heap, (end, int(k), sid))
+        self._next_sid = snap["next_sid"]
+        self.max_fired_watermark = snap["max_fired_watermark"]

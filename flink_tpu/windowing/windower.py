@@ -86,9 +86,10 @@ class SliceSharedWindower:
             batch = self._fire_window(w_end)
             if batch is not None and len(batch) > 0:
                 out.append(batch)
-            freed = self.book.mark_fired(w_end)
-            if freed:
-                self.table.free_namespaces(freed)
+            self.book.mark_fired(w_end)
+        expired = self.book.expired_slices(watermark)
+        if expired:
+            self.table.free_namespaces(expired)
         return out
 
     def _fire_window(self, window_end: int) -> Optional[RecordBatch]:

@@ -1,0 +1,232 @@
+// Batch (key, namespace) -> slot hash index for the TPU slot-table state
+// backend. This is the native half of the keyed-state hot path: the role the
+// reference delegates to RocksDB/ForSt via JNI (batch point lookups backing
+// StateExecutor.executeBatchRequests) is played here by an open-addressing
+// table that maps 128-bit (key_id, namespace) pairs to dense device slot ids
+// in one C call per micro-batch. No LSM is needed — persistence comes from
+// logical snapshots of the slot arrays (see flink_tpu/state/slot_table.py).
+//
+// Design: linear-probing buckets sized 2x slot capacity (load <= 0.5),
+// slot-id free list, slot 0 reserved as the identity slot, growth by
+// doubling with full rebuild (bounded amortized cost, mirrors the device
+// array growth in Python).
+//
+// Exposed as a plain C ABI for ctypes; all batch arguments are raw pointers
+// into NumPy buffers.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+struct SlotMap {
+  int64_t capacity;      // slot array capacity (includes reserved slot 0)
+  int64_t max_capacity;  // growth bound
+  int64_t used;          // live entries
+  int64_t bucket_count;  // power of two, >= 2*capacity
+  int32_t* buckets;      // slot id, -1 empty (deletion is backward-shift,
+                         // so no tombstones ever exist)
+  int64_t* slot_key;     // [capacity]
+  int64_t* slot_ns;      // [capacity]
+  uint8_t* slot_used;    // [capacity]
+  int32_t* free_stack;   // [capacity]
+  int64_t free_top;      // stack size
+};
+
+inline uint64_t mix_hash(uint64_t k, uint64_t n) {
+  uint64_t x = k ^ (n * 0x9E3779B97F4A7C15ull);
+  x ^= x >> 30;
+  x *= 0xBF58476D1CE4E5B9ull;
+  x ^= x >> 27;
+  x *= 0x94D049BB133111EBull;
+  x ^= x >> 31;
+  return x;
+}
+
+void build_buckets(SlotMap* m) {
+  int64_t want = m->capacity * 2;
+  int64_t bc = 64;
+  while (bc < want) bc <<= 1;
+  m->bucket_count = bc;
+  free(m->buckets);
+  m->buckets = (int32_t*)malloc(sizeof(int32_t) * bc);
+  for (int64_t i = 0; i < bc; i++) m->buckets[i] = -1;
+  uint64_t mask = (uint64_t)bc - 1;
+  for (int64_t s = 1; s < m->capacity; s++) {
+    if (!m->slot_used[s]) continue;
+    uint64_t h = mix_hash((uint64_t)m->slot_key[s], (uint64_t)m->slot_ns[s]);
+    uint64_t i = h & mask;
+    while (m->buckets[i] >= 0) i = (i + 1) & mask;
+    m->buckets[i] = (int32_t)s;
+  }
+}
+
+// returns 0 on success, -1 if at max capacity
+int grow(SlotMap* m) {
+  if (m->capacity >= m->max_capacity) return -1;
+  int64_t old_cap = m->capacity;
+  int64_t new_cap = old_cap * 2;
+  if (new_cap > m->max_capacity) new_cap = m->max_capacity;
+  m->slot_key = (int64_t*)realloc(m->slot_key, sizeof(int64_t) * new_cap);
+  m->slot_ns = (int64_t*)realloc(m->slot_ns, sizeof(int64_t) * new_cap);
+  m->slot_used = (uint8_t*)realloc(m->slot_used, sizeof(uint8_t) * new_cap);
+  m->free_stack = (int32_t*)realloc(m->free_stack, sizeof(int32_t) * new_cap);
+  memset(m->slot_used + old_cap, 0, (size_t)(new_cap - old_cap));
+  for (int64_t s = new_cap - 1; s >= old_cap; s--)
+    m->free_stack[m->free_top++] = (int32_t)s;
+  m->capacity = new_cap;
+  build_buckets(m);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* sm_create(int64_t initial_capacity, int64_t max_capacity) {
+  if (initial_capacity < 1024) initial_capacity = 1024;
+  if (max_capacity < initial_capacity) max_capacity = initial_capacity;
+  SlotMap* m = (SlotMap*)calloc(1, sizeof(SlotMap));
+  m->capacity = initial_capacity;
+  m->max_capacity = max_capacity;
+  m->slot_key = (int64_t*)calloc(initial_capacity, sizeof(int64_t));
+  m->slot_ns = (int64_t*)calloc(initial_capacity, sizeof(int64_t));
+  m->slot_used = (uint8_t*)calloc(initial_capacity, 1);
+  m->free_stack = (int32_t*)malloc(sizeof(int32_t) * initial_capacity);
+  m->free_top = 0;
+  for (int64_t s = initial_capacity - 1; s >= 1; s--)
+    m->free_stack[m->free_top++] = (int32_t)s;
+  m->buckets = nullptr;
+  build_buckets(m);
+  return m;
+}
+
+void sm_destroy(void* h) {
+  SlotMap* m = (SlotMap*)h;
+  free(m->buckets);
+  free(m->slot_key);
+  free(m->slot_ns);
+  free(m->slot_used);
+  free(m->free_stack);
+  free(m);
+}
+
+int64_t sm_capacity(void* h) { return ((SlotMap*)h)->capacity; }
+int64_t sm_used(void* h) { return ((SlotMap*)h)->used; }
+const int64_t* sm_slot_keys(void* h) { return ((SlotMap*)h)->slot_key; }
+const int64_t* sm_slot_namespaces(void* h) { return ((SlotMap*)h)->slot_ns; }
+const uint8_t* sm_slot_used(void* h) { return ((SlotMap*)h)->slot_used; }
+
+// Batch lookup-or-insert. Duplicates within the batch are fine (first
+// occurrence inserts, later ones find). out_is_new[i]=1 iff record i
+// performed the insert. Returns:
+//   >=0 : number of grows that occurred (caller must re-wrap slot arrays)
+//   -1  : table full at max_capacity
+int32_t sm_lookup_or_insert(void* h, int64_t n, const int64_t* keys,
+                            const int64_t* nss, int32_t* out_slots,
+                            uint8_t* out_is_new) {
+  SlotMap* m = (SlotMap*)h;
+  int32_t grows = 0;
+  for (int64_t r = 0; r < n; r++) {
+    int64_t k = keys[r], ns = nss[r];
+    uint64_t mask = (uint64_t)m->bucket_count - 1;
+    uint64_t i = mix_hash((uint64_t)k, (uint64_t)ns) & mask;
+    for (;;) {
+      int32_t b = m->buckets[i];
+      if (b == -1) {
+        // miss -> insert
+        if (m->free_top == 0) {
+          if (grow(m) != 0) return -1;
+          grows++;
+          // re-probe against rebuilt buckets
+          mask = (uint64_t)m->bucket_count - 1;
+          i = mix_hash((uint64_t)k, (uint64_t)ns) & mask;
+          continue;
+        }
+        int32_t slot = m->free_stack[--m->free_top];
+        m->buckets[i] = slot;
+        m->slot_key[slot] = k;
+        m->slot_ns[slot] = ns;
+        m->slot_used[slot] = 1;
+        m->used++;
+        out_slots[r] = slot;
+        if (out_is_new) out_is_new[r] = 1;
+        break;
+      } else if (m->slot_key[b] == k && m->slot_ns[b] == ns) {
+        out_slots[r] = b;
+        if (out_is_new) out_is_new[r] = 0;
+        break;
+      }
+      i = (i + 1) & mask;
+    }
+  }
+  return grows;
+}
+
+// Erase pairs; writes freed slot ids to out_slots (only for pairs that were
+// present). Returns the number actually erased. Deletion is backward-shift
+// (Knuth 6.4 algorithm R): no tombstones, so probe chains stay short under
+// the insert/erase churn of session windows and slice expiry.
+int64_t sm_erase(void* h, int64_t n, const int64_t* keys, const int64_t* nss,
+                 int32_t* out_slots) {
+  SlotMap* m = (SlotMap*)h;
+  int64_t erased = 0;
+  uint64_t mask = (uint64_t)m->bucket_count - 1;
+  for (int64_t r = 0; r < n; r++) {
+    int64_t k = keys[r], ns = nss[r];
+    uint64_t i = mix_hash((uint64_t)k, (uint64_t)ns) & mask;
+    for (;;) {
+      int32_t b = m->buckets[i];
+      if (b == -1) break;  // not present
+      if (m->slot_key[b] == k && m->slot_ns[b] == ns) {
+        m->slot_used[b] = 0;
+        m->free_stack[m->free_top++] = b;
+        m->used--;
+        out_slots[erased++] = b;
+        // backward-shift: compact the probe chain following i
+        uint64_t hole = i;
+        uint64_t j = (i + 1) & mask;
+        while (m->buckets[j] != -1) {
+          int32_t c = m->buckets[j];
+          uint64_t home =
+              mix_hash((uint64_t)m->slot_key[c], (uint64_t)m->slot_ns[c]) &
+              mask;
+          // move c into the hole if its home position does not lie
+          // (cyclically) strictly after the hole
+          uint64_t dist_home = (j - home) & mask;
+          uint64_t dist_hole = (j - hole) & mask;
+          if (dist_home >= dist_hole) {
+            m->buckets[hole] = c;
+            hole = j;
+          }
+          j = (j + 1) & mask;
+        }
+        m->buckets[hole] = -1;
+        break;
+      }
+      i = (i + 1) & mask;
+    }
+  }
+  return erased;
+}
+
+// Erase every live entry whose namespace equals ns; returns count, slots in
+// out_slots (caller sizes it at capacity). Used by slice expiry when the
+// namespace registry marks a whole slice dead.
+int64_t sm_erase_namespace(void* h, int64_t ns, int32_t* out_slots) {
+  SlotMap* m = (SlotMap*)h;
+  int64_t erased = 0;
+  for (int64_t s = 1; s < m->capacity; s++) {
+    if (m->slot_used[s] && m->slot_ns[s] == ns) {
+      m->slot_used[s] = 0;
+      m->free_stack[m->free_top++] = (int32_t)s;
+      m->used--;
+      out_slots[erased++] = (int32_t)s;
+    }
+  }
+  if (erased) build_buckets(m);
+  return erased;
+}
+
+}  // extern "C"

@@ -1,0 +1,96 @@
+"""Native C++ slot map vs pure-Python index parity + direct behavior."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.native import slotmap_available
+from flink_tpu.state.slot_table import HostSlotIndex, NativeSlotIndex
+
+needs_native = pytest.mark.skipif(
+    not slotmap_available(), reason="native slotmap not built")
+
+
+@needs_native
+class TestNativeSlotIndex:
+    def test_basic_insert_lookup(self):
+        idx = NativeSlotIndex(1024)
+        keys = np.array([5, 6, 5, 7], dtype=np.int64)
+        ns = np.array([1, 1, 1, 2], dtype=np.int64)
+        slots = idx.lookup_or_insert(keys, ns)
+        assert slots[0] == slots[2]
+        assert len({slots[0], slots[1], slots[3]}) == 3
+        assert slots.min() >= 1
+        assert idx.num_used == 3
+        # idempotent lookup
+        again = idx.lookup_or_insert(keys, ns)
+        np.testing.assert_array_equal(slots, again)
+
+    def test_metadata_views(self):
+        idx = NativeSlotIndex(1024)
+        slots = idx.lookup_or_insert(np.array([42], dtype=np.int64),
+                                     np.array([7], dtype=np.int64))
+        s = int(slots[0])
+        assert idx.slot_key[s] == 42
+        assert idx.slot_ns[s] == 7
+        assert bool(idx.slot_used[s])
+
+    def test_growth_rewraps_and_notifies(self):
+        grows = []
+        idx = NativeSlotIndex(1024, on_grow=lambda o, n: grows.append((o, n)))
+        n = 5000
+        idx.lookup_or_insert(np.arange(n, dtype=np.int64),
+                             np.zeros(n, dtype=np.int64))
+        assert idx.capacity >= n
+        assert grows and grows[-1][1] == idx.capacity
+        assert idx.num_used == n
+
+    def test_not_growable_raises(self):
+        idx = NativeSlotIndex(1024, growable=False, full_hint="HINT")
+        with pytest.raises(RuntimeError, match="HINT"):
+            idx.lookup_or_insert(np.arange(2000, dtype=np.int64),
+                                 np.zeros(2000, dtype=np.int64))
+
+    def test_free_namespaces_and_reuse(self):
+        idx = NativeSlotIndex(1024)
+        keys = np.arange(100, dtype=np.int64)
+        ns = np.full(100, 9, dtype=np.int64)
+        slots = idx.lookup_or_insert(keys, ns)
+        freed = idx.free_namespaces([9])
+        assert sorted(freed.tolist()) == sorted(slots.tolist())
+        assert idx.num_used == 0
+        # reinsert reuses freed slots
+        slots2 = idx.lookup_or_insert(keys, ns)
+        assert idx.num_used == 100
+        assert set(slots2.tolist()) <= set(range(1, 1024))
+
+    def test_parity_with_python_index(self):
+        rng = np.random.default_rng(0)
+        nat = NativeSlotIndex(1 << 12)
+        py = HostSlotIndex(1 << 12)
+        for step in range(10):
+            n = 2000
+            keys = rng.integers(0, 500, n).astype(np.int64)
+            ns = rng.integers(0, 8, n).astype(np.int64)
+            s_n = nat.lookup_or_insert(keys, ns)
+            s_p = py.lookup_or_insert(keys, ns)
+            # slot numbers may differ; the *mapping* must agree
+            assert nat.num_used == py.num_used
+            np.testing.assert_array_equal(nat.slot_key[s_n], keys)
+            np.testing.assert_array_equal(nat.slot_ns[s_n], ns)
+            np.testing.assert_array_equal(py.slot_key[s_p], keys)
+            if step % 3 == 2:
+                dead = int(rng.integers(0, 8))
+                f_n = nat.free_namespaces([dead])
+                f_p = py.free_namespaces([dead])
+                assert (f_n is None) == (f_p is None)
+                if f_n is not None:
+                    assert len(f_n) == len(f_p)
+                assert nat.num_used == py.num_used
+
+    def test_duplicate_heavy_batch(self):
+        idx = NativeSlotIndex(1024)
+        keys = np.zeros(10000, dtype=np.int64)
+        ns = np.zeros(10000, dtype=np.int64)
+        slots = idx.lookup_or_insert(keys, ns)
+        assert len(np.unique(slots)) == 1
+        assert idx.num_used == 1

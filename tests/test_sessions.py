@@ -1,0 +1,171 @@
+"""Session-window semantics vs a brute-force oracle (the reference tests
+sessions in WindowOperatorTest with MergingWindowSet; same role here)."""
+
+import collections
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.records import KEY_ID_FIELD, RecordBatch
+from flink_tpu.windowing.aggregates import CountAggregate, SumAggregate
+from flink_tpu.windowing.sessions import SessionWindower
+
+
+def keyed_batch(keys, values, ts):
+    return RecordBatch.from_pydict(
+        {KEY_ID_FIELD: np.asarray(keys, dtype=np.int64),
+         "v": np.asarray(values, dtype=np.float32)},
+        timestamps=ts)
+
+
+def oracle_sessions(events, gap):
+    """events: (key, value, ts) -> {(key, start, end): sum} after full flush."""
+    by_key = collections.defaultdict(list)
+    for k, v, t in events:
+        by_key[k].append((t, v))
+    out = {}
+    for k, evs in by_key.items():
+        evs.sort()
+        cur = []
+        for t, v in evs:
+            if cur and t - cur[-1][0] > gap:
+                out[(k, cur[0][0], cur[-1][0] + gap)] = sum(x[1] for x in cur)
+                cur = []
+            cur.append((t, v))
+        if cur:
+            out[(k, cur[0][0], cur[-1][0] + gap)] = sum(x[1] for x in cur)
+    return out
+
+
+def fired_to_dict(batches, field="sum_v"):
+    out = {}
+    for b in batches:
+        for r in b.to_rows():
+            out[(r[KEY_ID_FIELD], r["window_start"], r["window_end"])] = r[field]
+    return out
+
+
+class TestSessionBasics:
+    def test_single_session(self):
+        w = SessionWindower(gap=100, agg=SumAggregate("v"), capacity=1024)
+        w.process_batch(keyed_batch([1, 1, 1], [1, 2, 3], [0, 50, 120]))
+        assert w.on_watermark(218) == []  # session end 220, not yet
+        fired = fired_to_dict(w.on_watermark(219))
+        assert fired == {(1, 0, 220): 6.0}
+
+    def test_gap_splits_sessions(self):
+        w = SessionWindower(gap=10, agg=SumAggregate("v"), capacity=1024)
+        w.process_batch(keyed_batch([1, 1], [1, 2], [0, 100]))
+        fired = fired_to_dict(w.on_watermark(10**6))
+        assert fired == {(1, 0, 10): 1.0, (1, 100, 110): 2.0}
+
+    def test_cross_batch_merge(self):
+        w = SessionWindower(gap=50, agg=SumAggregate("v"), capacity=1024)
+        w.process_batch(keyed_batch([1], [1.0], [0]))
+        w.process_batch(keyed_batch([1], [2.0], [40]))   # extends session
+        w.process_batch(keyed_batch([1], [4.0], [200]))  # new session
+        fired = fired_to_dict(w.on_watermark(10**6))
+        assert fired == {(1, 0, 90): 3.0, (1, 200, 250): 4.0}
+
+    def test_bridge_merges_two_sessions(self):
+        """A late-ish record bridging two existing sessions merges them —
+        the MergingWindowSet case."""
+        w = SessionWindower(gap=20, agg=SumAggregate("v"), capacity=1024)
+        w.process_batch(keyed_batch([1], [1.0], [0]))
+        w.process_batch(keyed_batch([1], [2.0], [100]))
+        # sessions: [0,20), [100,120); bridge at 15..95 chain
+        w.process_batch(keyed_batch([1, 1, 1, 1, 1],
+                                    [0.5, 0.5, 0.5, 0.5, 0.5],
+                                    [20, 40, 60, 80, 95]))
+        fired = fired_to_dict(w.on_watermark(10**6))
+        assert fired == {(1, 0, 120): pytest.approx(5.5)}
+
+    def test_fire_frees_state(self):
+        w = SessionWindower(gap=10, agg=CountAggregate(), capacity=1024)
+        w.process_batch(keyed_batch([1, 2, 3], [1, 1, 1], [0, 0, 0]))
+        assert w.table.num_used == 3
+        w.on_watermark(10**6)
+        assert w.table.num_used == 0
+        assert not w.sessions
+
+    def test_late_record_dropped(self):
+        w = SessionWindower(gap=10, agg=CountAggregate(), capacity=1024)
+        w.process_batch(keyed_batch([1], [1], [100]))
+        w.on_watermark(200)
+        w.process_batch(keyed_batch([1], [1], [50]))  # 50+10-1 < 200
+        assert w.late_records_dropped == 1
+
+
+class TestSessionOracle:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_against_oracle(self, seed):
+        rng = np.random.default_rng(seed)
+        gap = 30
+        w = SessionWindower(gap=gap, agg=SumAggregate("v"), capacity=1 << 14)
+        events = []
+        for step in range(8):
+            n = 300
+            keys = rng.integers(0, 25, n).astype(np.int64)
+            vals = rng.random(n).astype(np.float32)
+            ts = rng.integers(step * 200, step * 200 + 400, n).astype(np.int64)
+            for e in zip(keys.tolist(), vals.tolist(), ts.tolist()):
+                events.append(e)
+            w.process_batch(keyed_batch(keys, vals, ts))
+            # watermark stays behind max ts so nothing is dropped as late
+        fired = fired_to_dict(w.on_watermark(10**9))
+        oracle = oracle_sessions(events, gap)
+        assert set(fired) == set(oracle)
+        for k in oracle:
+            assert fired[k] == pytest.approx(oracle[k], rel=1e-4), k
+
+    def test_high_cardinality(self):
+        rng = np.random.default_rng(9)
+        w = SessionWindower(gap=1000, agg=CountAggregate(), capacity=1 << 15)
+        n = 20000
+        keys = rng.integers(0, 10000, n).astype(np.int64)
+        ts = rng.integers(0, 5000, n).astype(np.int64)
+        w.process_batch(keyed_batch(keys, np.ones(n, np.float32), ts))
+        fired = w.on_watermark(10**9)
+        total = sum(int(b["count"].sum()) for b in fired)
+        assert total == n
+
+
+class TestSessionSnapshot:
+    def test_snapshot_restore(self):
+        gap = 50
+        w = SessionWindower(gap=gap, agg=SumAggregate("v"), capacity=1024)
+        w.process_batch(keyed_batch([1, 2], [1.0, 2.0], [0, 10]))
+        snap = w.snapshot()
+        w2 = SessionWindower(gap=gap, agg=SumAggregate("v"), capacity=1024)
+        w2.restore(snap)
+        w2.process_batch(keyed_batch([1], [3.0], [40]))  # extends key 1
+        fired = fired_to_dict(w2.on_watermark(10**9))
+        assert fired == {(1, 0, 90): 4.0, (2, 10, 60): 2.0}
+
+
+class TestSessionAPI:
+    def test_datastream_session_windows(self):
+        from flink_tpu import StreamExecutionEnvironment
+        from flink_tpu.windowing.assigners import EventTimeSessionWindows
+
+        env = StreamExecutionEnvironment()
+        rows = [
+            {"key": "a", "v": 1.0, "t": 0},
+            {"key": "a", "v": 2.0, "t": 900},
+            {"key": "b", "v": 5.0, "t": 100},
+            {"key": "a", "v": 4.0, "t": 5000},
+        ]
+        result = (
+            env.from_collection(rows, timestamp_field="t")
+            .key_by("key")
+            .window(EventTimeSessionWindows.with_gap(1000))
+            .sum("v")
+            .execute_and_collect()
+        )
+        got = {(r["key"], r["window_start"], r["window_end"]): r["sum_v"]
+               for r in result.to_rows()}
+        assert got == {
+            ("a", 0, 1900): 3.0,
+            ("b", 100, 1100): 5.0,
+            ("a", 5000, 6000): 4.0,
+        }
