@@ -1,22 +1,23 @@
-"""Headline benchmark: Nexmark Q5-style hot-items over a sliding window.
+"""Headline benchmark: Nexmark Q5 — hot items over a sliding window.
 
-keyBy(auction) -> HOP(10 s size, 2 s slide) -> COUNT, skewed keys — the
-BASELINE.json row-2 config. Runs the full framework path (DataStream API ->
-local executor -> slot-table scatter kernels on the active JAX backend).
+keyBy(auction) -> HOP(10 s size, 2 s slide) -> COUNT -> per-window arg-max,
+on the synthetic Nexmark bid stream (flink_tpu/benchmarks/nexmark.py). Runs
+the full framework path: DataStream API -> local executor -> native slot-map
+index -> jitted scatter/gather kernels on the active JAX backend.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Diagnostics (fire latency percentiles, result counts) go to stderr.
 
 Baseline note (see BASELINE.md): the reference (Apache Flink, JVM) cannot be
-built or executed in this zero-egress container, and the reference repo
-publishes no absolute numbers. vs_baseline is therefore computed against the
-documented proxy of 500_000 events/s/chip for Flink's RocksDB-backed windowed
-aggregation (a generous per-machine figure relative to typical published
-Nexmark q5 RocksDB results); the ≥10x target of BASELINE.json means
-vs_baseline >= 10.
+built or executed in this zero-egress container and publishes no absolute
+numbers in-repo. vs_baseline is computed against the documented proxy of
+500_000 events/s/chip for Flink's RocksDB-backed windowed aggregation; the
+>=10x target of BASELINE.json corresponds to vs_baseline >= 10.
 """
 
 import json
 import os
+import sys
 import time
 
 from flink_tpu.platform import sync_platform as _sync_platform
@@ -24,38 +25,27 @@ from flink_tpu.platform import sync_platform as _sync_platform
 PROXY_BASELINE_EVENTS_PER_S = 500_000.0
 
 
-def run(total_records: int = 8_000_000, num_keys: int = 100_000,
+def run(total_records: int, num_auctions: int = 100_000,
         batch_size: int = 1 << 17) -> dict:
     from flink_tpu import Configuration, StreamExecutionEnvironment
+    from flink_tpu.benchmarks.nexmark import BidSource, build_q5
     from flink_tpu.connectors.sinks import CollectSink
-    from flink_tpu.connectors.sources import DataGenSource
-    from flink_tpu.runtime.watermarks import WatermarkStrategy
-    from flink_tpu.windowing.assigners import SlidingEventTimeWindows
 
     env = StreamExecutionEnvironment(Configuration({
         "execution.micro-batch.size": batch_size,
         "state.slot-table.capacity": 1 << 20,
     }))
     sink = CollectSink()
-    # 200k events per second of event time -> each 2 s slide covers ~400k
-    # events and a 10 s window ~2M, sized against the 1<<20 slot capacity
-    src = DataGenSource(total_records=total_records, num_keys=num_keys,
-                        events_per_second_of_eventtime=200_000, skew=0.2)
-    stream = (
-        env.from_source(src, WatermarkStrategy.for_bounded_out_of_orderness(0))
-        .key_by("key")
-        .window(SlidingEventTimeWindows.of(10_000, 2_000))
-        .count()
-    )
-    stream.sink_to(sink)
-    # grab the operator to read fire latencies
+    # 200k events/s of event time -> a 2 s slide covers ~400k events, a 10 s
+    # window ~2M, sized against the 1<<20 slot capacity
+    src = BidSource(total_records=total_records, num_auctions=num_auctions,
+                    events_per_second_of_eventtime=200_000)
+    build_q5(env, src, size_ms=10_000, slide_ms=2_000).sink_to(sink)
     t0 = time.perf_counter()
     result = env.execute("nexmark-q5-hot-items")
     elapsed = time.perf_counter() - t0
-
-    events_per_s = total_records / elapsed
     return {
-        "events_per_s": events_per_s,
+        "events_per_s": total_records / elapsed,
         "elapsed_s": elapsed,
         "results": len(sink.result()),
         "fire_latency_ms": result.metrics.get("window_fire_latency_ms"),
@@ -68,9 +58,10 @@ def main():
 
     warnings.filterwarnings("ignore")
     total = int(os.environ.get("BENCH_RECORDS", 8_000_000))
-    # warmup (compile cache)
-    run(total_records=1 << 18, num_keys=10_000)
+    run(total_records=1 << 18, num_auctions=10_000)  # warmup/compile
     stats = run(total_records=total)
+    print(f"# q5: {stats['results']} winner rows, "
+          f"fire_latency={stats['fire_latency_ms']}", file=sys.stderr)
     value = stats["events_per_s"]
     print(json.dumps({
         "metric": "nexmark_q5_hop_hot_items_events_per_sec_per_chip",

@@ -1,0 +1,3 @@
+from flink_tpu.benchmarks.nexmark import BidSource, build_q5, build_q7
+
+__all__ = ["BidSource", "build_q5", "build_q7"]

@@ -1,0 +1,161 @@
+"""Nexmark queries (the reference's SQL smoke suite and the industry-standard
+streaming benchmark; reference:
+flink-table/flink-sql-client/src/test/resources/nexmark.sql).
+
+Implemented on the DataStream API with vectorized operators:
+
+- Q5 (hot items): which auctions received the most bids in the last sliding
+  window? HOP count per auction + per-window arg-max. A fired batch contains
+  one whole window, so the arg-max is a single vectorized pass over it.
+- Q7 (highest bid): the bid(s) with the highest price per tumbling window —
+  global windowed MAX joined back against the bids of the same window
+  (two-stage: const-key MAX, then a price=max window join).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from flink_tpu.connectors.sources import Source
+from flink_tpu.core.records import RecordBatch
+from flink_tpu.runtime.watermarks import WatermarkStrategy
+from flink_tpu.windowing.aggregates import CountAggregate, MaxAggregate
+from flink_tpu.windowing.assigners import (
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+)
+from flink_tpu.windowing.windower import WINDOW_END_FIELD
+
+
+class BidSource(Source):
+    """Synthetic Nexmark bid stream: (auction, bidder, price, ts).
+
+    Deterministic and seedable; auction popularity follows a zipf-ish skew
+    like the Nexmark generator's hot-auction bias.
+    """
+
+    def __init__(self, total_records: int, num_auctions: int = 10_000,
+                 num_bidders: int = 50_000,
+                 events_per_second_of_eventtime: int = 100_000,
+                 hot_ratio: float = 0.5, seed: int = 42):
+        self.total = int(total_records)
+        self.num_auctions = num_auctions
+        self.num_bidders = num_bidders
+        self.rate = events_per_second_of_eventtime
+        self.hot_ratio = hot_ratio
+        self.seed = seed
+        self._emitted = 0
+        self._rng = np.random.default_rng(seed)
+
+    def open(self, subtask_index=0, parallelism=1):
+        self._rng = np.random.default_rng(self.seed + subtask_index)
+
+    def poll_batch(self, max_records):
+        if self._emitted >= self.total:
+            return None
+        n = min(max_records, self.total - self._emitted)
+        rng = self._rng
+        hot = rng.random(n) < self.hot_ratio
+        auctions = np.where(
+            hot,
+            rng.integers(0, max(self.num_auctions // 100, 1), n),
+            rng.integers(0, self.num_auctions, n)).astype(np.int64)
+        bidders = rng.integers(0, self.num_bidders, n, dtype=np.int64)
+        prices = (rng.pareto(3.0, n) * 100 + 1).astype(np.float32)
+        idx = np.arange(self._emitted, self._emitted + n, dtype=np.int64)
+        ts = (idx * 1000) // max(self.rate, 1)
+        self._emitted += n
+        return RecordBatch.from_pydict(
+            {"auction": auctions, "bidder": bidders, "price": prices},
+            timestamps=ts)
+
+    def snapshot_position(self):
+        return {"emitted": self._emitted, "rng": self._rng.bit_generator.state}
+
+    def restore_position(self, pos):
+        self._emitted = pos["emitted"]
+        self._rng.bit_generator.state = pos["rng"]
+
+
+def _window_argmax(field: str):
+    """Fired window batches hold one whole window — per-window arg-max is a
+    vectorized scan of the batch."""
+
+    def fn(batch: RecordBatch):
+        counts = batch[field]
+        best = counts.max()
+        return batch.filter(counts == best)
+
+    return fn
+
+
+def build_q5(env, source: BidSource, size_ms: int = 10_000,
+             slide_ms: int = 2_000):
+    """Q5 hot items -> stream of (auction, count, window) winners."""
+    return (
+        env.from_source(source,
+                        WatermarkStrategy.for_bounded_out_of_orderness(0))
+        .key_by("auction")
+        .window(SlidingEventTimeWindows.of(size_ms, slide_ms))
+        .count()
+        .map(_window_argmax("count"), name="hot_items_argmax")
+    )
+
+
+def build_q7(env, source: BidSource, size_ms: int = 10_000):
+    """Q7 highest bid -> the bid rows achieving the per-window max price."""
+    bids = env.from_source(
+        source, WatermarkStrategy.for_bounded_out_of_orderness(0))
+    bids = bids.map(lambda b: b.with_column(
+        "g", np.zeros(len(b), dtype=np.int64)), name="const_key")
+    maxes = (
+        bids.key_by("g")
+        .window(TumblingEventTimeWindows.of(size_ms))
+        .max("price")
+        .map(lambda b: b.drop("g"), name="drop_g")
+    )
+    joined = (
+        bids.join(maxes).where("price").equal_to("max_price")
+        .window(TumblingEventTimeWindows.of(size_ms))
+        .apply(name="q7_join")
+    )
+    return joined
+
+
+# ---------------------------------------------------------------------------
+# Oracles (pure Python/NumPy, used by tests)
+# ---------------------------------------------------------------------------
+
+
+def oracle_q5(bids, size_ms, slide_ms):
+    """bids: list of (auction, ts). Returns {window_end: (max_count, set of
+    auctions with that count)} for complete windows."""
+    import collections
+
+    counts = collections.defaultdict(lambda: collections.defaultdict(int))
+    for auction, ts in bids:
+        first = ts - (ts % slide_ms) + slide_ms
+        for w in range(first, ts + size_ms + 1, slide_ms):
+            if w - size_ms <= ts < w:
+                counts[w][auction] += 1
+    out = {}
+    for w, per_auction in counts.items():
+        best = max(per_auction.values())
+        out[w] = (best, {a for a, c in per_auction.items() if c == best})
+    return out
+
+
+def oracle_q7(bids, size_ms):
+    """bids: list of (auction, bidder, price, ts). Returns
+    {window_end: (max_price, [(auction, bidder)])}"""
+    import collections
+
+    per_w = collections.defaultdict(list)
+    for auction, bidder, price, ts in bids:
+        w = ts - (ts % size_ms) + size_ms
+        per_w[w].append((auction, bidder, price))
+    out = {}
+    for w, rows in per_w.items():
+        mx = max(r[2] for r in rows)
+        out[w] = (mx, [(a, b) for a, b, p in rows if p == mx])
+    return out

@@ -175,5 +175,12 @@ class CheckpointOptions:
     INTERVAL_MS = ConfigOption(
         "execution.checkpointing.interval-ms", default=0, type=int,
         description="Checkpoint interval; 0 disables periodic checkpoints.")
+    EVERY_N_BATCHES = ConfigOption(
+        "execution.checkpointing.every-n-source-batches", default=0, type=int,
+        description="Deterministic trigger: checkpoint every N source "
+        "batches (tests/benchmarks; 0 = use the time interval).")
+    RETAINED = ConfigOption(
+        "execution.checkpointing.retained", default=3, type=int,
+        description="How many completed checkpoints to keep.")
     MODE = ConfigOption(
         "execution.checkpointing.mode", default="exactly-once", type=str)

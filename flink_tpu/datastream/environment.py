@@ -102,12 +102,17 @@ class StreamExecutionEnvironment:
             raise RuntimeError("no sinks defined — nothing to execute")
         return StreamGraph(self._sinks)
 
-    def execute(self, job_name: str = "job") -> "JobExecutionResult":
+    def execute(self, job_name: str = "job",
+                restore_from: Optional[str] = None) -> "JobExecutionResult":
+        """Run the pipeline. ``restore_from`` points at a checkpoint root
+        directory; the latest completed checkpoint there is restored before
+        processing starts (reference: savepoint/restore CLI flow)."""
         from flink_tpu.cluster.local_executor import LocalExecutor
 
         graph = self.get_stream_graph()
         executor = LocalExecutor(self.config)
-        result = executor.run(graph, job_name=job_name)
+        result = executor.run(graph, job_name=job_name,
+                              restore_from=restore_from)
         self._sinks = []
         return result
 

@@ -79,6 +79,14 @@ class DataStream:
             inputs=[self.transformation] + [o.transformation for o in others])
         return DataStream(self.env, t)
 
+    # ---------------------------------------------------------------- joins
+
+    def join(self, other: "DataStream") -> "JoinedStreams":
+        """Window equi-join builder (reference:
+        streaming/api/datastream/JoinedStreams.java):
+        ``a.join(b).where(f).equal_to(g).window(assigner).apply()``."""
+        return JoinedStreams(self, other)
+
     # --------------------------------------------------------------- keying
 
     def key_by(self, key_field: str) -> "KeyedStream":
@@ -120,6 +128,49 @@ class DataStreamSink:
         self.sink = sink
 
 
+class JoinedStreams:
+    def __init__(self, left: DataStream, right: DataStream):
+        self.left = left
+        self.right = right
+        self.left_key: Optional[str] = None
+        self.right_key: Optional[str] = None
+
+    def where(self, left_key: str) -> "JoinedStreams":
+        self.left_key = left_key
+        return self
+
+    def equal_to(self, right_key: str) -> "JoinedStreams":
+        self.right_key = right_key
+        return self
+
+    def window(self, assigner: WindowAssigner) -> "WindowedJoin":
+        assert self.left_key is not None and self.right_key is not None, \
+            "call .where(left_key).equal_to(right_key) before .window()"
+        return WindowedJoin(self, assigner)
+
+
+class WindowedJoin:
+    def __init__(self, joined: JoinedStreams, assigner: WindowAssigner):
+        self.joined = joined
+        self.assigner = assigner
+
+    def apply(self, suffixes=("_l", "_r"), name: str = "window_join"
+              ) -> DataStream:
+        from flink_tpu.runtime.join_operators import WindowJoinOperator
+
+        j = self.joined
+        left_keyed = j.left.key_by(j.left_key).transformation
+        right_keyed = j.right.key_by(j.right_key).transformation
+        assigner = self.assigner
+        key_fields = (j.left_key, j.right_key)
+        t = Transformation(
+            name=name, kind="two_input",
+            operator_factory=lambda: WindowJoinOperator(
+                assigner, suffixes, key_fields=key_fields),
+            inputs=[left_keyed, right_keyed], keyed=True)
+        return DataStream(j.left.env, t)
+
+
 class KeyedStream(DataStream):
     def __init__(self, env, transformation, key_field: str):
         super().__init__(env, transformation)
@@ -127,6 +178,28 @@ class KeyedStream(DataStream):
 
     def window(self, assigner: WindowAssigner) -> "WindowedStream":
         return WindowedStream(self, assigner)
+
+    def interval_join(self, other: "KeyedStream") -> "IntervalJoinBuilder":
+        """reference: KeyedStream.intervalJoin / IntervalJoinOperator."""
+        return IntervalJoinBuilder(self, other)
+
+
+class IntervalJoinBuilder:
+    def __init__(self, left: "KeyedStream", right: "KeyedStream"):
+        self.left = left
+        self.right = right
+
+    def between(self, lower_ms: int, upper_ms: int,
+                suffixes=("_l", "_r")) -> DataStream:
+        from flink_tpu.runtime.join_operators import IntervalJoinOperator
+
+        t = Transformation(
+            name="interval_join", kind="two_input",
+            operator_factory=lambda: IntervalJoinOperator(
+                lower_ms, upper_ms, suffixes),
+            inputs=[self.left.transformation, self.right.transformation],
+            keyed=True)
+        return DataStream(self.left.env, t)
 
     # keyed running aggregates without windows (KeyedStream.sum/reduce in the
     # reference) can be expressed as a GlobalWindow; deferred to the table

@@ -68,6 +68,16 @@ class StreamGraph:
     def sources(self) -> List[Transformation]:
         return [t for t in self.nodes if t.kind == "source"]
 
+    def stable_id(self, t: Transformation) -> str:
+        """Process-independent operator identity for checkpoints: topological
+        position + sanitized name (the reference uses explicit operator uids /
+        generated uid hashes for the same purpose). Used as a filename
+        component, so path-hostile characters are replaced."""
+        import re
+
+        safe = re.sub(r"[^A-Za-z0-9_.()-]", "_", t.name)
+        return f"{self.nodes.index(t)}:{safe}"
+
     def children(self, t: Transformation) -> List[Transformation]:
         return self.downstream.get(t.uid, [])
 

@@ -75,8 +75,6 @@ def load_slotmap() -> Optional[ctypes.CDLL]:
                                             P(u8)]
         lib.sm_erase.restype = i64
         lib.sm_erase.argtypes = [vp, i64, P(i64), P(i64), P(i32)]
-        lib.sm_erase_namespace.restype = i64
-        lib.sm_erase_namespace.argtypes = [vp, i64, P(i32)]
         _lib = lib
         return _lib
 

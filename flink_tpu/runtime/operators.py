@@ -197,8 +197,9 @@ class WindowAggOperator(Operator):
 
     def restore_state(self, state):
         self.windower.restore(state["windower"])
-        self._key_values = dict(state["key_values"])
-        self._keys_hashed = state["keys_hashed"]
+        # empty sub-dicts are pruned by the checkpoint codec
+        self._key_values = dict(state.get("key_values", {}))
+        self._keys_hashed = state.get("keys_hashed", False)
 
 
 class SessionWindowAggOperator(WindowAggOperator):

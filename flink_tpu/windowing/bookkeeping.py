@@ -123,10 +123,11 @@ class SliceBookkeeper:
         }
 
     def restore(self, snap: Dict[str, object]) -> None:
-        self._pending = list(snap["pending"])
+        # empty sub-structures may be pruned by the checkpoint codec
+        self._pending = list(snap.get("pending", []))
         heapq.heapify(self._pending)
         self._pending_set = set(self._pending)
-        self._slice_last_window = dict(snap["slice_last_window"])
+        self._slice_last_window = dict(snap.get("slice_last_window", {}))
         self._cleanup = [
             (last - 1 + self.allowed_lateness, se)
             for se, last in self._slice_last_window.items()
@@ -134,5 +135,5 @@ class SliceBookkeeper:
         heapq.heapify(self._cleanup)
         self.watermark = snap.get("watermark", snap.get("max_fired_end",
                                                         _NEG_INF))
-        self.max_fired_end = snap["max_fired_end"]
+        self.max_fired_end = snap.get("max_fired_end", _NEG_INF)
         self.late_records_dropped = snap.get("late_records_dropped", 0)

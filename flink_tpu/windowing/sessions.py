@@ -103,19 +103,11 @@ class SessionWindower:
         ts = np.asarray(batch.timestamps, dtype=np.int64)
         keys = np.asarray(batch.key_ids, dtype=np.int64)
 
-        # drop records whose session would already have ended (beyond the
-        # lateness allowance)
-        if self.max_fired_watermark > _NEG_INF // 2:
-            live = (ts + self.gap - 1 + self.allowed_lateness
-                    > self.max_fired_watermark)
-            dropped = n - int(live.sum())
-            if dropped:
-                self.late_records_dropped += dropped
-                ts, keys = ts[live], keys[live]
-                batch = batch.filter(live)
-                n = len(batch)
-                if n == 0:
-                    return
+        # NOTE: lateness is decided per *merged session*, not per record —
+        # an out-of-order record that merges into a live session is never
+        # late (reference: WindowOperator merges first, then isWindowLate).
+        # _merge_session returns sid -1 for sessions that are stale on
+        # arrival; their records route to the identity slot 0.
 
         # vectorized batch-local sessionization: sort by (key, ts); a new
         # local session starts at a key change or a gap exceedance
@@ -142,8 +134,17 @@ class SessionWindower:
                 int(sess_key[j]), int(sess_min[j]),
                 int(sess_max[j]) + self.gap)
 
+        live_sess = sess_sid >= 0
+        if not live_sess.all():
+            # stale-on-arrival sessions: route their records to slot 0
+            sess_counts = np.diff(np.append(starts_pos, n))
+            self.late_records_dropped += int(
+                sess_counts[~live_sess].sum())
         # ONE vectorized lookup for all session slots, then scatter records
-        slot_of_sess = self.table.lookup_or_insert(sess_key, sess_sid)
+        slot_of_sess = np.zeros(m, dtype=np.int32)
+        if live_sess.any():
+            slot_of_sess[live_sess] = self.table.lookup_or_insert(
+                sess_key[live_sess], sess_sid[live_sess])
         rec_slots = np.empty(n, dtype=np.int32)
         rec_slots[order] = slot_of_sess[sess_of_sorted]
         self.table.scatter(rec_slots, self.agg.map_input(batch))
@@ -184,7 +185,9 @@ class SessionWindower:
         self._merge_dst_set, self._merge_src_set = set(), set()
 
     def _merge_session(self, key: int, start: int, end: int) -> int:
-        """Merge [start, end) into key's intervals; returns the session id.
+        """Merge [start, end) into key's intervals; returns the session id,
+        or -1 if the session is stale on arrival (no live session to merge
+        into and its own end is already past the lateness allowance).
 
         Mirrors MergingWindowSet.addWindow: overlapping intervals collapse
         into one; absorbed sessions queue an accumulator merge (dst, src).
@@ -192,6 +195,8 @@ class SessionWindower:
         """
         intervals = self.sessions.get(key)
         if intervals is None:
+            if self._stale(end):
+                return -1
             sid = self._alloc_sid()
             self.sessions[key] = [(start, end, sid)]
             heapq.heappush(self._fire_heap, (end, key, sid))
@@ -200,6 +205,8 @@ class SessionWindower:
         overlapping = [iv for iv in intervals
                        if iv[0] <= end and start <= iv[1]]
         if not overlapping:
+            if self._stale(end):
+                return -1
             sid = self._alloc_sid()
             intervals.append((start, end, sid))
             intervals.sort()
@@ -223,6 +230,12 @@ class SessionWindower:
         if new_end != keep[1]:
             heapq.heappush(self._fire_heap, (new_end, key, keep[2]))
         return keep[2]
+
+    def _stale(self, end: int) -> bool:
+        """A (merged) session ending at ``end`` is stale iff the watermark
+        has already passed end - 1 + lateness."""
+        return (self.max_fired_watermark > _NEG_INF // 2
+                and end - 1 + self.allowed_lateness <= self.max_fired_watermark)
 
     def _alloc_sid(self) -> int:
         sid = self._next_sid
@@ -281,10 +294,11 @@ class SessionWindower:
         }
 
     def restore(self, snap: Dict[str, object], key_group_filter=None) -> None:
-        self.table.restore(snap["table"], key_group_filter=key_group_filter)
+        if "table" in snap:
+            self.table.restore(snap["table"], key_group_filter=key_group_filter)
         self.sessions = {}
         self._fire_heap = []
-        for k, ivs in snap["sessions"].items():
+        for k, ivs in snap.get("sessions", {}).items():
             kept = [tuple(iv) for iv in ivs]
             if key_group_filter is not None:
                 from flink_tpu.state.keygroups import assign_key_groups
@@ -296,5 +310,5 @@ class SessionWindower:
             self.sessions[int(k)] = kept
             for start, end, sid in kept:
                 heapq.heappush(self._fire_heap, (end, int(k), sid))
-        self._next_sid = snap["next_sid"]
-        self.max_fired_watermark = snap["max_fired_watermark"]
+        self._next_sid = snap.get("next_sid", 1)
+        self.max_fired_watermark = snap.get("max_fired_watermark", _NEG_INF)

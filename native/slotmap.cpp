@@ -211,22 +211,4 @@ int64_t sm_erase(void* h, int64_t n, const int64_t* keys, const int64_t* nss,
   return erased;
 }
 
-// Erase every live entry whose namespace equals ns; returns count, slots in
-// out_slots (caller sizes it at capacity). Used by slice expiry when the
-// namespace registry marks a whole slice dead.
-int64_t sm_erase_namespace(void* h, int64_t ns, int32_t* out_slots) {
-  SlotMap* m = (SlotMap*)h;
-  int64_t erased = 0;
-  for (int64_t s = 1; s < m->capacity; s++) {
-    if (m->slot_used[s] && m->slot_ns[s] == ns) {
-      m->slot_used[s] = 0;
-      m->free_stack[m->free_top++] = (int32_t)s;
-      m->used--;
-      out_slots[erased++] = (int32_t)s;
-    }
-  }
-  if (erased) build_buckets(m);
-  return erased;
-}
-
 }  // extern "C"

@@ -1,0 +1,136 @@
+"""Checkpoint / restore end-to-end: crash mid-job, restore from the latest
+checkpoint, verify exactly-once *state* semantics (window results identical
+to an uninterrupted run). Mirrors the reference's recovery ITCases
+(flink-tests/.../checkpointing/, recovery/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from flink_tpu import Configuration, StreamExecutionEnvironment
+from flink_tpu.checkpoint.storage import CheckpointStorage
+from flink_tpu.connectors.sinks import CollectSink
+from flink_tpu.connectors.sources import DataGenSource
+from flink_tpu.runtime.watermarks import WatermarkStrategy
+from flink_tpu.windowing.assigners import (
+    EventTimeSessionWindows,
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+)
+
+
+class FailingMap:
+    """Raises after letting ``fail_after`` records through (fault injection,
+    like throwing UDFs in the reference's recovery tests)."""
+
+    def __init__(self, fail_after):
+        self.seen = 0
+        self.fail_after = fail_after
+        self.armed = True
+
+    def __call__(self, batch):
+        self.seen += len(batch)
+        if self.armed and self.seen > self.fail_after:
+            raise RuntimeError("injected failure")
+        return batch
+
+
+def build_pipeline(env, sink, assigner, total=50_000, fail_after=None):
+    src = DataGenSource(total_records=total, num_keys=500,
+                        events_per_second_of_eventtime=10_000, seed=11)
+    ds = env.from_source(
+        src, WatermarkStrategy.for_bounded_out_of_orderness(0))
+    if fail_after is not None:
+        ds = ds.map(FailingMap(fail_after), name="failmap")
+    (ds.key_by("key")
+       .window(assigner)
+       .sum("value")
+       .sink_to(sink))
+
+
+def collect_results(sink):
+    out = {}
+    for r in sink.result().to_rows():
+        # last write wins: re-fired windows overwrite (exactly-once state)
+        out[(r["key"], r["window_start"], r["window_end"])] = round(
+            r["sum_value"], 3)
+    return out
+
+
+@pytest.mark.parametrize("assigner_factory", [
+    lambda: TumblingEventTimeWindows.of(1000),
+    lambda: SlidingEventTimeWindows.of(2000, 1000),
+    lambda: EventTimeSessionWindows.with_gap(40),
+])
+def test_crash_restore_matches_clean_run(tmp_path, assigner_factory):
+    ckpt = str(tmp_path / "ckpts")
+
+    # clean reference run
+    env = StreamExecutionEnvironment(Configuration({
+        "execution.micro-batch.size": 1000}))
+    clean_sink = CollectSink()
+    build_pipeline(env, clean_sink, assigner_factory())
+    env.execute("clean")
+    expected = collect_results(clean_sink)
+    assert expected
+
+    # run with checkpoints + injected failure
+    conf = Configuration({
+        "execution.micro-batch.size": 1000,
+        "state.checkpoints.dir": ckpt,
+        "execution.checkpointing.every-n-source-batches": 5,
+    })
+    env2 = StreamExecutionEnvironment(conf)
+    sink2 = CollectSink()
+    build_pipeline(env2, sink2, assigner_factory(), fail_after=30_000)
+    with pytest.raises(RuntimeError, match="injected failure"):
+        env2.execute("crashing")
+    store = CheckpointStorage(ckpt)
+    assert store.latest_checkpoint_id() is not None
+
+    # restore and finish (same graph shape, fresh operators, no fault)
+    env3 = StreamExecutionEnvironment(conf)
+    sink3 = CollectSink()
+    build_pipeline(env3, sink3, assigner_factory(), fail_after=None)
+    # graph shape must match: add the map back without the fault
+    env3._sinks = []
+    sink3 = CollectSink()
+    src = DataGenSource(total_records=50_000, num_keys=500,
+                        events_per_second_of_eventtime=10_000, seed=11)
+    ds = env3.from_source(
+        src, WatermarkStrategy.for_bounded_out_of_orderness(0))
+    ds = ds.map(lambda b: b, name="failmap")  # same stable id, benign
+    (ds.key_by("key").window(assigner_factory()).sum("value").sink_to(sink3))
+    env3.execute("restored", restore_from=ckpt)
+
+    # windows fired before the checkpoint are not re-emitted after restore;
+    # merge the two sinks (crashing run emitted the early windows)
+    got = collect_results(sink2)
+    got.update(collect_results(sink3))
+    assert got == expected
+
+
+def test_restore_missing_checkpoint_raises(tmp_path):
+    env = StreamExecutionEnvironment(Configuration({
+        "execution.micro-batch.size": 100}))
+    sink = CollectSink()
+    build_pipeline(env, sink, TumblingEventTimeWindows.of(1000), total=100)
+    with pytest.raises(RuntimeError, match="no checkpoint"):
+        env.execute(restore_from=str(tmp_path / "nothing"))
+
+
+def test_checkpoint_retention(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    conf = Configuration({
+        "execution.micro-batch.size": 200,
+        "state.checkpoints.dir": ckpt,
+        "execution.checkpointing.every-n-source-batches": 2,
+        "execution.checkpointing.retained": 2,
+    })
+    env = StreamExecutionEnvironment(conf)
+    sink = CollectSink()
+    build_pipeline(env, sink, TumblingEventTimeWindows.of(1000), total=10_000)
+    env.execute()
+    names = sorted(os.listdir(ckpt))
+    assert len([n for n in names if n.startswith("chk-")]) <= 2

@@ -1,0 +1,106 @@
+"""Window join + interval join semantics vs pandas-free oracles."""
+
+import numpy as np
+import pytest
+
+from flink_tpu import StreamExecutionEnvironment, Configuration
+from flink_tpu.runtime.join_operators import equi_join_indices
+from flink_tpu.runtime.watermarks import WatermarkStrategy
+from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+
+def test_equi_join_indices():
+    L = np.array([1, 2, 3, 2], dtype=np.int64)
+    R = np.array([2, 2, 4, 1], dtype=np.int64)
+    li, ri = equi_join_indices(L, R)
+    pairs = sorted(zip(L[li].tolist(), li.tolist(), ri.tolist()))
+    # key 1: L[0] x R[3]; key 2: L[1],L[3] x R[0],R[1] -> 1 + 4 = 5 pairs
+    assert len(li) == 5
+    for l, r in zip(li, ri):
+        assert L[l] == R[r]
+
+
+def test_equi_join_empty():
+    e = np.empty(0, dtype=np.int64)
+    li, ri = equi_join_indices(e, np.array([1], dtype=np.int64))
+    assert len(li) == 0
+
+
+class TestWindowJoin:
+    def test_basic_window_join(self):
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 2}))
+        orders = [
+            {"user": 1, "amount": 10.0, "t": 100},
+            {"user": 2, "amount": 20.0, "t": 200},
+            {"user": 1, "amount": 30.0, "t": 1100},
+        ]
+        clicks = [
+            {"user": 1, "page": 7, "t": 150},
+            {"user": 1, "page": 8, "t": 250},
+            {"user": 3, "page": 9, "t": 300},
+        ]
+        a = env.from_collection(orders, timestamp_field="t")
+        b = env.from_collection(clicks, timestamp_field="t")
+        result = (
+            a.join(b).where("user").equal_to("user")
+            .window(TumblingEventTimeWindows.of(1000))
+            .apply()
+            .execute_and_collect()
+        )
+        rows = result.to_rows()
+        # window [0,1000): order(u1,10) x clicks(u1@150, u1@250) = 2 pairs
+        # window [1000,2000): order(u1,30) has no clicks -> nothing
+        assert len(rows) == 2
+        for r in rows:
+            assert r["user"] == 1
+            assert r["amount"] == 10.0
+            assert r["page"] in (7, 8)
+            assert r["window_start"] == 0
+
+    def test_join_no_overlap_keys(self):
+        env = StreamExecutionEnvironment()
+        a = env.from_collection([{"k": 1, "t": 0}], timestamp_field="t")
+        b = env.from_collection([{"k": 2, "t": 0}], timestamp_field="t")
+        result = (a.join(b).where("k").equal_to("k")
+                  .window(TumblingEventTimeWindows.of(100))
+                  .apply().execute_and_collect())
+        assert len(result) == 0
+
+
+class TestIntervalJoin:
+    def test_interval_join_bounds(self):
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 1}))
+        lefts = [{"k": 1, "lv": i, "t": i * 100} for i in range(4)]
+        rights = [{"k": 1, "rv": i, "t": i * 100 + 50} for i in range(4)]
+        a = env.from_collection(lefts, timestamp_field="t").key_by("k")
+        b = env.from_collection(rights, timestamp_field="t").key_by("k")
+        result = a.interval_join(b).between(0, 100).execute_and_collect()
+        got = sorted((r["lv"], r["rv"]) for r in result.to_rows())
+        # left at t=i*100 matches right r at t=r*100+50 iff
+        # 0 <= (r*100+50) - i*100 <= 100  =>  r == i  (only +50 offset fits)
+        assert got == [(0, 0), (1, 1), (2, 2), (3, 3)]
+
+    def test_interval_join_asymmetric(self):
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 10}))
+        lefts = [{"k": 5, "lv": 0, "t": 1000}]
+        rights = [{"k": 5, "rv": i, "t": t}
+                  for i, t in enumerate([400, 800, 1200, 1700])]
+        a = env.from_collection(lefts, timestamp_field="t").key_by("k")
+        b = env.from_collection(rights, timestamp_field="t").key_by("k")
+        # right in [t-500, t+500] -> ts 800 and 1200 (endpoints: 500..1500)
+        result = a.interval_join(b).between(-500, 500).execute_and_collect()
+        got = sorted(r["rv"] for r in result.to_rows())
+        assert got == [1, 2]
+
+    def test_no_duplicate_pairs(self):
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 3}))
+        lefts = [{"k": 1, "lv": i, "t": 100} for i in range(3)]
+        rights = [{"k": 1, "rv": i, "t": 100} for i in range(3)]
+        a = env.from_collection(lefts, timestamp_field="t").key_by("k")
+        b = env.from_collection(rights, timestamp_field="t").key_by("k")
+        result = a.interval_join(b).between(-10, 10).execute_and_collect()
+        assert len(result) == 9  # 3x3 exactly once each

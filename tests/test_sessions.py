@@ -169,3 +169,44 @@ class TestSessionAPI:
             ("b", 100, 1100): 5.0,
             ("a", 5000, 6000): 4.0,
         }
+
+
+class TestOutOfOrderMerge:
+    def test_out_of_order_record_merges_into_live_session(self):
+        """Regression (review find): a record older than the watermark that
+        merges into a LIVE session must be accepted, not dropped."""
+        w = SessionWindower(gap=50, agg=SumAggregate("v"), capacity=1024)
+        w.process_batch(keyed_batch([1], [1.0], [100]))  # session [100,150)
+        assert w.on_watermark(120) == []                  # still open
+        w.process_batch(keyed_batch([1], [2.0], [60]))    # merges -> [60,150)
+        assert w.late_records_dropped == 0
+        fired = fired_to_dict(w.on_watermark(10**6))
+        assert fired == {(1, 60, 150): 3.0}
+
+    def test_stale_new_session_still_dropped(self):
+        w = SessionWindower(gap=50, agg=SumAggregate("v"), capacity=1024)
+        w.process_batch(keyed_batch([1], [1.0], [1000]))
+        w.on_watermark(2000)  # fires [1000,1050)
+        w.process_batch(keyed_batch([1], [2.0], [100]))  # stale, no live sess
+        assert w.late_records_dropped == 1
+        assert fired_to_dict(w.on_watermark(10**6)) == {}
+
+
+class TestEmptyStateCheckpoint:
+    def test_restore_after_quiescent_checkpoint(self):
+        """Regression (review find): snapshot taken when all windows fired
+        and state is empty must restore cleanly (codec prunes empty dicts)."""
+        import pickle
+
+        w = SessionWindower(gap=10, agg=SumAggregate("v"), capacity=1024)
+        w.process_batch(keyed_batch([1], [1.0], [0]))
+        w.on_watermark(10**6)
+        snap = w.snapshot()
+        # simulate the checkpoint codec's empty-dict pruning
+        pruned = {k: v for k, v in snap.items()
+                  if not (isinstance(v, dict) and not v)}
+        w2 = SessionWindower(gap=10, agg=SumAggregate("v"), capacity=1024)
+        w2.restore(pruned)
+        w2.process_batch(keyed_batch([2], [2.0], [10**6 + 100]))
+        fired = fired_to_dict(w2.on_watermark(10**9))
+        assert list(fired.values()) == [2.0]
