@@ -19,12 +19,10 @@ import numpy as np
 from flink_tpu.connectors.sources import Source
 from flink_tpu.core.records import RecordBatch
 from flink_tpu.runtime.watermarks import WatermarkStrategy
-from flink_tpu.windowing.aggregates import CountAggregate, MaxAggregate
 from flink_tpu.windowing.assigners import (
     SlidingEventTimeWindows,
     TumblingEventTimeWindows,
 )
-from flink_tpu.windowing.windower import WINDOW_END_FIELD
 
 
 class BidSource(Source):

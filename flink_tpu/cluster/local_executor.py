@@ -236,12 +236,23 @@ class LocalExecutor:
     @staticmethod
     def _restore_all(graph: StreamGraph, nodes: Dict[int, _Node],
                      states: Dict[str, Any]) -> None:
+        consumed = set()
         for uid, node in nodes.items():
             t = node.transformation
-            state = states.get(graph.stable_id(t))
+            sid = graph.stable_id(t)
+            state = states.get(sid)
             if state is None:
                 continue
+            consumed.add(sid)
             if node.operator is None:
                 t.source.restore_position(state["source"])
             else:
                 node.operator.restore_state(state)
+        leftover = set(states) - consumed
+        if leftover:
+            # the reference fails on non-restored state by default
+            # (allowNonRestoredState opt-in); silently dropping state here
+            # would silently undercount aggregates after a graph edit
+            raise RuntimeError(
+                "checkpoint contains state for operators not present in the "
+                f"graph (graph changed since snapshot?): {sorted(leftover)}")

@@ -144,8 +144,9 @@ class JoinedStreams:
         return self
 
     def window(self, assigner: WindowAssigner) -> "WindowedJoin":
-        assert self.left_key is not None and self.right_key is not None, \
-            "call .where(left_key).equal_to(right_key) before .window()"
+        if self.left_key is None or self.right_key is None:
+            raise ValueError(
+                "call .where(left_key).equal_to(right_key) before .window()")
         return WindowedJoin(self, assigner)
 
 

@@ -1,0 +1,114 @@
+"""Unwindowed GROUP BY — running keyed aggregation with upsert emission.
+
+reference: flink-table-runtime/.../aggregate/GroupAggFunction.java:85
+(processElement reads accState.value(), folds one record, writes back, and
+emits the updated row downstream) and its MiniBatch variant
+(MiniBatchGroupAggFunction.java:163 finishBundle).
+
+Re-design: the per-key accumulators live in the device SlotTable under a
+single namespace (namespace 0 — there is no window dimension); a micro-batch
+folds in with ONE scatter kernel per accumulator leaf, then the current value
+of every key *touched by the batch* is read back and emitted as an upsert
+(latest-value-wins, matching the reference's retract+insert pair collapsed
+into one changelog-upsert row — the reference emits UPDATE_BEFORE/UPDATE_AFTER;
+downstream consumers here key on the group columns).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
+from flink_tpu.runtime.operators import Operator
+from flink_tpu.state.slot_table import SlotTable
+from flink_tpu.windowing.aggregates import AggregateFunction
+
+_GLOBAL_NS = 0
+
+
+class GroupAggOperator(Operator):
+    name = "group_agg"
+
+    def __init__(self, agg: AggregateFunction, key_field: str,
+                 capacity: int = 1 << 16,
+                 emit_on_watermark_only: bool = False):
+        self.agg = agg
+        self.key_field = key_field
+        self.capacity = capacity
+        #: True = suppress per-batch upserts, emit one final table per
+        #: watermark advance (MiniBatch-style deduped emission)
+        self.emit_on_watermark_only = emit_on_watermark_only
+        self.table: Optional[SlotTable] = None
+        self._key_values: Dict[int, Any] = {}
+        self._keys_hashed = False
+        self._dirty: set = set()
+        self._max_ts = 0
+
+    def open(self, ctx):
+        self.table = SlotTable(self.agg, capacity=self.capacity,
+                               max_parallelism=ctx.max_parallelism)
+
+    def process_batch(self, batch: RecordBatch, input_index: int = 0
+                      ) -> List[RecordBatch]:
+        if len(batch) == 0:
+            return []
+        if batch.has_timestamps:
+            self._max_ts = max(self._max_ts, int(batch.timestamps.max()))
+        if self.key_field in batch.columns:
+            keys = batch[self.key_field]
+            if keys.dtype.kind not in "iu":
+                self._keys_hashed = True
+                kid = batch.key_ids
+                uniq, first = np.unique(kid, return_index=True)
+                for i, j in zip(uniq.tolist(), first.tolist()):
+                    self._key_values.setdefault(i, keys[j])
+        namespaces = np.full(len(batch), _GLOBAL_NS, dtype=np.int64)
+        slots = self.table.lookup_or_insert(batch.key_ids, namespaces)
+        self.table.scatter(slots, self.agg.map_input(batch))
+        if self.emit_on_watermark_only:
+            self._dirty.update(np.unique(slots).tolist())
+            return []
+        out = self._emit_slots(np.unique(slots))
+        return [out] if out is not None else []
+
+    def process_watermark(self, watermark, input_index=0):
+        if not self.emit_on_watermark_only or not self._dirty:
+            return []
+        slots = np.fromiter(self._dirty, dtype=np.int64)
+        self._dirty.clear()
+        out = self._emit_slots(slots)
+        return [out] if out is not None else []
+
+    def _emit_slots(self, slots: np.ndarray) -> Optional[RecordBatch]:
+        if len(slots) == 0:
+            return None
+        results = self.table.fire(slots[:, None].astype(np.int32))
+        kid = self.table.keys_of_slots(slots)
+        if self._keys_hashed:
+            kv = np.array([self._key_values.get(int(i)) for i in kid],
+                          dtype=object)
+        else:
+            kv = kid
+        cols = {
+            KEY_ID_FIELD: kid,
+            self.key_field: kv,
+            TIMESTAMP_FIELD: np.full(len(slots), self._max_ts, dtype=np.int64),
+        }
+        cols.update(results)
+        return RecordBatch(cols)
+
+    def snapshot_state(self):
+        return {
+            "table": self.table.snapshot(),
+            "key_values": dict(self._key_values),
+            "keys_hashed": self._keys_hashed,
+            "max_ts": self._max_ts,
+        }
+
+    def restore_state(self, state):
+        self.table.restore(state["table"])
+        self._key_values = dict(state.get("key_values", {}))
+        self._keys_hashed = state.get("keys_hashed", False)
+        self._max_ts = state.get("max_ts", 0)

@@ -211,6 +211,11 @@ class IntervalJoinOperator(Operator):
         lt = left.timestamps[l_idx]
         rt = right.timestamps[r_idx]
         ok = (rt >= lt + self.lower) & (rt <= lt + self.upper)
+        # each side's raw timestamp column must not survive into the merged
+        # schema (it would come out as suffixed __ts___l/__ts___r junk); the
+        # result's timestamp is computed below from lt/rt
+        left = left.drop(TIMESTAMP_FIELD)
+        right = right.drop(TIMESTAMP_FIELD)
         # (duplicate avoidance is structural: a pair is emitted by whichever
         # side arrives second — the new batch is joined only against the
         # other side's buffer, never its own)
@@ -218,8 +223,7 @@ class IntervalJoinOperator(Operator):
         if len(l_idx) == 0:
             return None
         cols = _merge_columns(left, right, l_idx, r_idx, self.suffixes)
-        cols[TIMESTAMP_FIELD] = np.maximum(left.timestamps[l_idx],
-                                           right.timestamps[r_idx])
+        cols[TIMESTAMP_FIELD] = np.maximum(lt[ok], rt[ok])
         return RecordBatch(cols)
 
     def process_watermark(self, watermark, input_index=0):

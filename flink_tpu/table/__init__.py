@@ -1,0 +1,18 @@
+"""Table/SQL API — minimal SQL layer over the DataStream operators.
+
+reference: flink-table/* (TableEnvironmentImpl.executeSql at
+flink-table/flink-table-api-java/.../internal/TableEnvironmentImpl.java:936;
+planner translate at flink-table-planner/.../delegation/PlannerBase.scala:175).
+
+Re-design: no Calcite, no Janino codegen — the SQL text is parsed by a small
+recursive-descent parser, planned directly onto the vectorized DataStream
+operators, and "codegen" is JAX tracing of the resulting batched kernels
+(SURVEY.md §7.8). Scalar expressions evaluate as vectorized NumPy on host
+columns; aggregations run on the device slot table.
+"""
+
+from flink_tpu.table.environment import (  # noqa: F401
+    StreamTableEnvironment,
+    Table,
+    TableResult,
+)

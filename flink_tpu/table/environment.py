@@ -1,0 +1,178 @@
+"""StreamTableEnvironment — SQL entry point + result materialization.
+
+reference: flink-table/flink-table-api-java/.../internal/TableEnvironmentImpl.java
+(:936 executeSql), StreamTableEnvironmentImpl (fromDataStream/toDataStream).
+Catalog here is a flat in-memory name -> Table map (the reference's
+GenericInMemoryCatalog equivalent).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
+from flink_tpu.datastream.environment import StreamExecutionEnvironment
+from flink_tpu.datastream.stream import DataStream
+from flink_tpu.table import sql_parser
+from flink_tpu.table.planner import PlannedTable, PlanError, Planner
+
+_INTERNAL_COLS = (TIMESTAMP_FIELD, KEY_ID_FIELD)
+
+
+class Table:
+    """A (possibly unbounded) relational view over a DataStream."""
+
+    def __init__(self, t_env: "StreamTableEnvironment", stream: DataStream,
+                 columns: Sequence[str], time_field: Optional[str] = None,
+                 upsert_keys: Optional[List[str]] = None,
+                 sort_spec=None, limit: Optional[int] = None):
+        self.t_env = t_env
+        self.stream = stream
+        self.columns = list(columns)
+        self.time_field = time_field
+        self.upsert_keys = upsert_keys
+        self.sort_spec = sort_spec
+        self.limit = limit
+
+    @staticmethod
+    def _from_planned(t_env: "StreamTableEnvironment",
+                      planned: PlannedTable) -> "Table":
+        return Table(t_env, planned.stream, planned.columns,
+                     planned.time_field, planned.upsert_keys,
+                     planned.sort_spec, planned.limit)
+
+    def execute(self) -> "TableResult":
+        return TableResult(self)
+
+    def to_data_stream(self) -> DataStream:
+        return self.stream
+
+
+class TableResult:
+    """Bounded materialization of a Table (collect-style; the reference's
+    TableResult.collect)."""
+
+    def __init__(self, table: Table):
+        self.table = table
+        self._batch: Optional[RecordBatch] = None
+
+    def to_batch(self) -> RecordBatch:
+        if self._batch is None:
+            batch = self.table.stream.execute_and_collect()
+            self._batch = self._materialize(batch)
+        return self._batch
+
+    def collect(self) -> List[dict]:
+        batch = self.to_batch()
+        rows = batch.to_rows()
+        for r in rows:
+            for c in _INTERNAL_COLS:
+                r.pop(c, None)
+        return rows
+
+    def _materialize(self, batch: RecordBatch) -> RecordBatch:
+        t = self.table
+        if len(batch) and t.upsert_keys is not None:
+            # changelog upsert stream: last value per key wins. An empty
+            # key list is a global aggregate — one constant key.
+            if not t.upsert_keys:
+                batch = batch.slice(len(batch) - 1, len(batch))
+            else:
+                keys = list(zip(*[batch[k].tolist()
+                                  for k in t.upsert_keys])) \
+                    if len(t.upsert_keys) > 1 \
+                    else batch[t.upsert_keys[0]].tolist()
+                last: Dict[object, int] = {}
+                for i, k in enumerate(keys):
+                    last[k] = i
+                idx = np.asarray(sorted(last.values()), dtype=np.int64)
+                batch = batch.take(idx)
+        if len(batch) and t.sort_spec is not None:
+            sort_cols = []
+            for expr, desc in reversed(t.sort_spec):
+                v = np.asarray(expr.eval(batch))
+                if v.dtype == object:
+                    v = np.array([str(x) for x in v])
+                sort_cols.append(-v if desc and v.dtype.kind in "iuf" else v)
+            if sort_cols:
+                batch = batch.take(np.lexsort(sort_cols))
+        if t.limit is not None:
+            batch = batch.slice(0, t.limit)
+        return batch
+
+
+class StreamTableEnvironment:
+    def __init__(self, env: Optional[StreamExecutionEnvironment] = None):
+        self.env = env or StreamExecutionEnvironment.get_execution_environment()
+        self._catalog: Dict[str, Table] = {}
+
+    @staticmethod
+    def create(env: Optional[StreamExecutionEnvironment] = None
+               ) -> "StreamTableEnvironment":
+        return StreamTableEnvironment(env)
+
+    # ------------------------------------------------------------- catalog
+
+    def lookup(self, name: str) -> Table:
+        if name not in self._catalog:
+            raise PlanError(f"table or view {name!r} is not registered "
+                            f"(known: {sorted(self._catalog)})")
+        return self._catalog[name]
+
+    def create_temporary_view(self, name: str, source,
+                              columns: Optional[Sequence[str]] = None,
+                              time_field: Optional[str] = None) -> None:
+        """Register a DataStream or Table under a name for SQL queries.
+
+        For a DataStream, ``columns`` lists the visible column names (the
+        reference derives them from TypeInformation; batches here are typed
+        only at runtime).
+        """
+        if isinstance(source, Table):
+            self._catalog[name] = source
+            return
+        if columns is None:
+            raise PlanError(
+                "registering a DataStream as a view requires `columns`")
+        self._catalog[name] = Table(self, source, columns, time_field)
+
+    def from_data_stream(self, stream: DataStream,
+                         columns: Sequence[str],
+                         time_field: Optional[str] = None) -> Table:
+        return Table(self, stream, columns, time_field)
+
+    def from_collection(self, rows, timestamp_field=None,
+                        columns: Optional[Sequence[str]] = None) -> Table:
+        rows = list(rows)
+        ds = self.env.from_collection(rows, timestamp_field=timestamp_field)
+        cols = list(columns) if columns is not None else \
+            [c for c in rows[0].keys()]
+        return Table(self, ds, cols, timestamp_field)
+
+    # ----------------------------------------------------------------- SQL
+
+    def sql_query(self, sql: str) -> Table:
+        stmt = sql_parser.parse(sql)
+        if not isinstance(stmt, sql_parser.SelectStmt):
+            raise PlanError("sql_query expects a SELECT statement")
+        planned = Planner(self).plan_select(stmt)
+        return Table._from_planned(self, planned)
+
+    def execute_sql(self, sql: str) -> Optional[TableResult]:
+        """Execute a statement. SELECT returns a TableResult; CREATE VIEW
+        registers and returns None (reference: TableEnvironmentImpl.java:936)."""
+        stmt = sql_parser.parse(sql)
+        if isinstance(stmt, sql_parser.CreateView):
+            planned = Planner(self).plan_select(stmt.query)
+            self._catalog[stmt.name] = Table._from_planned(self, planned)
+            return None
+        if isinstance(stmt, sql_parser.InsertInto):
+            target = self.lookup(stmt.table)
+            raise PlanError(
+                "INSERT INTO requires a registered sink table; register a "
+                "sink with create_temporary_view and use "
+                "Table.to_data_stream().sink_to(...) instead")
+        planned = Planner(self).plan_select(stmt)
+        return TableResult(Table._from_planned(self, planned))

@@ -1,0 +1,575 @@
+"""A small recursive-descent SQL parser.
+
+The reference parses SQL with Apache Calcite (flink-table/flink-sql-parser)
+into a full relational algebra. This framework needs the *streaming SQL
+subset* that the reference's headline workloads (Nexmark Q5/Q7, GROUP BY HOP)
+exercise: SELECT/WHERE/GROUP BY with window TVFs (TUMBLE/HOP/CUMULATE/SESSION,
+reference: flink-table-runtime/.../window/tvf/slicing/SliceAssigners.java),
+joins with time bounds, Top-N via ROW_NUMBER() OVER, views and INSERT INTO.
+
+Grammar is hand-rolled: tokens -> AST dataclasses in this file +
+expression nodes from flink_tpu.table.expressions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Optional, Tuple, Union
+
+from flink_tpu.table.expressions import (
+    AGG_NAMES,
+    AggCall,
+    Between,
+    BinaryOp,
+    Case,
+    Cast,
+    Column,
+    Expr,
+    InList,
+    Literal,
+    OverCall,
+    ScalarFunc,
+    SelectItem,
+    Star,
+    UnaryOp,
+)
+
+# ---------------------------------------------------------------------------
+# Statement AST
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class WindowTVF:
+    kind: str                 # TUMBLE | HOP | CUMULATE | SESSION
+    table: "TableRef"
+    time_col: str
+    size_ms: int              # TUMBLE size / HOP size / CUMULATE max / SESSION gap
+    slide_ms: Optional[int] = None   # HOP slide / CUMULATE step
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass
+class NamedTable:
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass
+class SubQuery:
+    query: "SelectStmt"
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass
+class Join:
+    left: "TableRef"
+    right: "TableRef"
+    kind: str            # INNER | LEFT
+    condition: Expr
+
+
+TableRef = Union[NamedTable, SubQuery, WindowTVF, Join]
+
+
+@dataclasses.dataclass
+class OrderItem:
+    expr: Expr
+    descending: bool = False
+
+
+@dataclasses.dataclass
+class SelectStmt:
+    items: List[SelectItem]
+    table: TableRef
+    where: Optional[Expr] = None
+    group_by: List[Expr] = dataclasses.field(default_factory=list)
+    having: Optional[Expr] = None
+    order_by: List[OrderItem] = dataclasses.field(default_factory=list)
+    limit: Optional[int] = None
+    distinct: bool = False
+
+
+@dataclasses.dataclass
+class CreateView:
+    name: str
+    query: SelectStmt
+
+
+@dataclasses.dataclass
+class InsertInto:
+    table: str
+    query: SelectStmt
+
+
+Statement = Union[SelectStmt, CreateView, InsertInto]
+
+# ---------------------------------------------------------------------------
+# Lexer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+|--[^\n]*)
+  | (?P<num>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
+  | (?P<str>'(?:[^']|'')*')
+  | (?P<ident>[A-Za-z_][A-Za-z_0-9]*|`[^`]+`)
+  | (?P<op><>|!=|<=|>=|\|\||[-+*/%(),.<>=])
+    """,
+    re.VERBOSE,
+)
+
+_INTERVAL_MS = {
+    "MILLISECOND": 1, "MILLISECONDS": 1,
+    "SECOND": 1000, "SECONDS": 1000,
+    "MINUTE": 60_000, "MINUTES": 60_000,
+    "HOUR": 3_600_000, "HOURS": 3_600_000,
+    "DAY": 86_400_000, "DAYS": 86_400_000,
+}
+
+
+@dataclasses.dataclass
+class Token:
+    kind: str   # num | str | ident | op | end
+    value: str
+
+    @property
+    def upper(self) -> str:
+        return self.value.upper()
+
+
+def tokenize(sql: str) -> List[Token]:
+    tokens: List[Token] = []
+    pos = 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if m is None:
+            raise SqlParseError(f"unexpected character {sql[pos]!r} at {pos}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        value = m.group()
+        if kind == "ident" and value.startswith("`"):
+            value = value[1:-1]
+        tokens.append(Token(kind, value))
+    tokens.append(Token("end", ""))
+    return tokens
+
+
+class SqlParseError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Parser
+# ---------------------------------------------------------------------------
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers ------------------------------------------------------
+
+    def peek(self, offset: int = 0) -> Token:
+        return self.tokens[min(self.i + offset, len(self.tokens) - 1)]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == "ident" and t.upper in kws
+
+    def accept_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.i += 1
+            return True
+        return False
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.accept_kw(kw):
+            raise SqlParseError(f"expected {kw}, got {self.peek().value!r}")
+
+    def accept_op(self, op: str) -> bool:
+        t = self.peek()
+        if t.kind == "op" and t.value == op:
+            self.i += 1
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            raise SqlParseError(f"expected {op!r}, got {self.peek().value!r}")
+
+    # -- statements ---------------------------------------------------------
+
+    def parse_statement(self) -> Statement:
+        if self.at_kw("CREATE"):
+            stmt = self._create_view()
+        elif self.at_kw("INSERT"):
+            stmt = self._insert_into()
+        else:
+            stmt = self.parse_select()
+        self.accept_op(";")
+        if self.peek().kind != "end":
+            raise SqlParseError(f"trailing input at {self.peek().value!r}")
+        return stmt
+
+    def _create_view(self) -> CreateView:
+        self.expect_kw("CREATE")
+        self.accept_kw("TEMPORARY")
+        self.expect_kw("VIEW")
+        name = self.next().value
+        self.expect_kw("AS")
+        return CreateView(name, self.parse_select())
+
+    def _insert_into(self) -> InsertInto:
+        self.expect_kw("INSERT")
+        self.expect_kw("INTO")
+        name = self.next().value
+        return InsertInto(name, self.parse_select())
+
+    # -- SELECT -------------------------------------------------------------
+
+    def parse_select(self) -> SelectStmt:
+        self.expect_kw("SELECT")
+        distinct = self.accept_kw("DISTINCT")
+        items = [self._select_item()]
+        while self.accept_op(","):
+            items.append(self._select_item())
+        self.expect_kw("FROM")
+        table = self._table_ref()
+        where = self.parse_expr() if self.accept_kw("WHERE") else None
+        group_by: List[Expr] = []
+        if self.accept_kw("GROUP"):
+            self.expect_kw("BY")
+            group_by.append(self.parse_expr())
+            while self.accept_op(","):
+                group_by.append(self.parse_expr())
+        having = self.parse_expr() if self.accept_kw("HAVING") else None
+        order_by: List[OrderItem] = []
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            order_by.append(self._order_item())
+            while self.accept_op(","):
+                order_by.append(self._order_item())
+        limit = None
+        if self.accept_kw("LIMIT"):
+            limit = int(self.next().value)
+        return SelectStmt(items, table, where, group_by, having, order_by,
+                          limit, distinct)
+
+    def _order_item(self) -> OrderItem:
+        e = self.parse_expr()
+        desc = False
+        if self.accept_kw("DESC"):
+            desc = True
+        else:
+            self.accept_kw("ASC")
+        return OrderItem(e, desc)
+
+    def _select_item(self) -> SelectItem:
+        if self.accept_op("*"):
+            return SelectItem(Star())
+        e = self.parse_expr()
+        alias = None
+        if self.accept_kw("AS"):
+            alias = self.next().value
+        elif (self.peek().kind == "ident"
+              and self.peek().upper not in _CLAUSE_KWS):
+            alias = self.next().value
+        return SelectItem(e, alias)
+
+    # -- FROM / joins -------------------------------------------------------
+
+    def _table_ref(self) -> TableRef:
+        left = self._table_primary()
+        while True:
+            kind = None
+            if self.accept_kw("JOIN"):
+                kind = "INNER"
+            elif self.at_kw("INNER") and self.peek(1).upper == "JOIN":
+                self.i += 2
+                kind = "INNER"
+            elif self.at_kw("LEFT"):
+                self.i += 1
+                self.accept_kw("OUTER")
+                self.expect_kw("JOIN")
+                kind = "LEFT"
+            else:
+                return left
+            right = self._table_primary()
+            self.expect_kw("ON")
+            cond = self.parse_expr()
+            left = Join(left, right, kind, cond)
+
+    def _table_primary(self) -> TableRef:
+        if self.at_kw("TABLE") and self.peek(1).value == "(":
+            return self._window_tvf()
+        if self.accept_op("("):
+            q = self.parse_select()
+            self.expect_op(")")
+            alias = self._opt_alias()
+            return SubQuery(q, alias)
+        name = self.next().value
+        return NamedTable(name, self._opt_alias())
+
+    def _opt_alias(self) -> Optional[str]:
+        if self.accept_kw("AS"):
+            return self.next().value
+        if (self.peek().kind == "ident"
+                and self.peek().upper not in _CLAUSE_KWS):
+            return self.next().value
+        return None
+
+    def _window_tvf(self) -> WindowTVF:
+        self.expect_kw("TABLE")
+        self.expect_op("(")
+        kind = self.next().upper
+        if kind not in ("TUMBLE", "HOP", "CUMULATE", "SESSION"):
+            raise SqlParseError(f"unknown window TVF {kind}")
+        self.expect_op("(")
+        # positional `TABLE t` or named `DATA => TABLE t`
+        if self.accept_kw("DATA"):
+            self.expect_op("=")
+            self.expect_op(">")
+        self.expect_kw("TABLE")
+        inner = NamedTable(self.next().value)
+        self.expect_op(",")
+        self.expect_kw("DESCRIPTOR")
+        self.expect_op("(")
+        time_col = self.next().value
+        self.expect_op(")")
+        self.expect_op(",")
+        first = self._interval_ms()
+        second = None
+        if self.accept_op(","):
+            second = self._interval_ms()
+        self.expect_op(")")
+        self.expect_op(")")
+        alias = self._opt_alias()
+        # argument order per the reference's TVF definitions:
+        # HOP(data, desc, slide, size); CUMULATE(data, desc, step, max)
+        if kind in ("HOP", "CUMULATE"):
+            if second is None:
+                raise SqlParseError(f"{kind} needs two intervals")
+            slide, size = first, second
+            return WindowTVF(kind, inner, time_col, size, slide, alias)
+        return WindowTVF(kind, inner, time_col, first, None, alias)
+
+    def _interval_ms(self) -> int:
+        self.expect_kw("INTERVAL")
+        tok = self.next()
+        if tok.kind != "str":
+            raise SqlParseError("INTERVAL value must be a quoted string")
+        amount = float(tok.value[1:-1])
+        unit = self.next().upper
+        if unit not in _INTERVAL_MS:
+            raise SqlParseError(f"unknown interval unit {unit}")
+        return int(amount * _INTERVAL_MS[unit])
+
+    # -- expressions (precedence climbing) ----------------------------------
+
+    def parse_expr(self) -> Expr:
+        return self._or_expr()
+
+    def _or_expr(self) -> Expr:
+        e = self._and_expr()
+        while self.accept_kw("OR"):
+            e = BinaryOp("OR", e, self._and_expr())
+        return e
+
+    def _and_expr(self) -> Expr:
+        e = self._not_expr()
+        while self.accept_kw("AND"):
+            e = BinaryOp("AND", e, self._not_expr())
+        return e
+
+    def _not_expr(self) -> Expr:
+        if self.accept_kw("NOT"):
+            return UnaryOp("NOT", self._not_expr())
+        return self._comparison()
+
+    def _comparison(self) -> Expr:
+        e = self._additive()
+        if self.accept_kw("BETWEEN"):
+            low = self._additive()
+            self.expect_kw("AND")
+            return Between(e, low, self._additive())
+        if self.accept_kw("IN") or (self.at_kw("NOT")
+                                    and self.peek(1).upper == "IN"):
+            negated = False
+            if self.at_kw("IN"):
+                self.i += 1
+            else:
+                self.i += 2
+                negated = True
+            self.expect_op("(")
+            opts = [self._literal_value()]
+            while self.accept_op(","):
+                opts.append(self._literal_value())
+            self.expect_op(")")
+            return InList(e, tuple(opts), negated)
+        t = self.peek()
+        if t.kind == "op" and t.value in ("=", "<>", "!=", "<", "<=", ">", ">="):
+            self.next()
+            return BinaryOp(t.value, e, self._additive())
+        return e
+
+    def _literal_value(self):
+        t = self.next()
+        if t.kind == "num":
+            return float(t.value) if "." in t.value else int(t.value)
+        if t.kind == "str":
+            return t.value[1:-1].replace("''", "'")
+        raise SqlParseError(f"expected literal, got {t.value!r}")
+
+    def _additive(self) -> Expr:
+        e = self._multiplicative()
+        while True:
+            if self.accept_op("+"):
+                e = BinaryOp("+", e, self._multiplicative())
+            elif self.accept_op("-"):
+                e = BinaryOp("-", e, self._multiplicative())
+            else:
+                return e
+
+    def _multiplicative(self) -> Expr:
+        e = self._unary()
+        while True:
+            if self.accept_op("*"):
+                e = BinaryOp("*", e, self._unary())
+            elif self.accept_op("/"):
+                e = BinaryOp("/", e, self._unary())
+            elif self.accept_op("%"):
+                e = BinaryOp("%", e, self._unary())
+            else:
+                return e
+
+    def _unary(self) -> Expr:
+        if self.accept_op("-"):
+            return UnaryOp("-", self._unary())
+        return self._primary()
+
+    def _primary(self) -> Expr:
+        t = self.peek()
+        if t.kind == "num":
+            self.next()
+            v = float(t.value) if ("." in t.value or "e" in t.value.lower()) \
+                else int(t.value)
+            return Literal(v)
+        if t.kind == "str":
+            self.next()
+            return Literal(t.value[1:-1].replace("''", "'"))
+        if t.kind == "op" and t.value == "(":
+            self.next()
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if self.at_kw("INTERVAL"):
+            return Literal(self._interval_ms())
+        if self.at_kw("CASE"):
+            return self._case_expr()
+        if self.at_kw("CAST"):
+            self.next()
+            self.expect_op("(")
+            inner = self.parse_expr()
+            self.expect_kw("AS")
+            type_name = self.next().upper
+            # swallow precision like VARCHAR(255)
+            if self.accept_op("("):
+                while not self.accept_op(")"):
+                    self.next()
+            self.expect_op(")")
+            return Cast(inner, type_name)
+        if self.at_kw("TRUE"):
+            self.next()
+            return Literal(True)
+        if self.at_kw("FALSE"):
+            self.next()
+            return Literal(False)
+        if t.kind == "ident":
+            return self._identifier_or_call()
+        raise SqlParseError(f"unexpected token {t.value!r}")
+
+    def _case_expr(self) -> Case:
+        self.expect_kw("CASE")
+        whens = []
+        while self.accept_kw("WHEN"):
+            c = self.parse_expr()
+            self.expect_kw("THEN")
+            whens.append((c, self.parse_expr()))
+        default = self.parse_expr() if self.accept_kw("ELSE") else None
+        self.expect_kw("END")
+        return Case(tuple(whens), default)
+
+    def _identifier_or_call(self) -> Expr:
+        name = self.next().value
+        upper = name.upper()
+        if self.peek().kind == "op" and self.peek().value == "(":
+            self.next()  # (
+            if upper in AGG_NAMES:
+                distinct = self.accept_kw("DISTINCT")
+                if self.accept_op("*"):
+                    self.expect_op(")")
+                    return AggCall(upper, None, distinct)
+                arg = self.parse_expr()
+                self.expect_op(")")
+                return AggCall(upper, arg, distinct)
+            if upper in ("ROW_NUMBER", "RANK"):
+                self.expect_op(")")
+                return self._over_clause(upper)
+            args = []
+            if not self.accept_op(")"):
+                args.append(self.parse_expr())
+                while self.accept_op(","):
+                    args.append(self.parse_expr())
+                self.expect_op(")")
+            return ScalarFunc(upper, tuple(args))
+        if self.accept_op("."):
+            col = self.next().value
+            return Column(col, table=name)
+        return Column(name)
+
+    def _over_clause(self, func: str) -> OverCall:
+        self.expect_kw("OVER")
+        self.expect_op("(")
+        partition: List[Expr] = []
+        order: List[Tuple[Expr, bool]] = []
+        if self.accept_kw("PARTITION"):
+            self.expect_kw("BY")
+            partition.append(self.parse_expr())
+            while self.accept_op(","):
+                partition.append(self.parse_expr())
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            while True:
+                e = self.parse_expr()
+                desc = False
+                if self.accept_kw("DESC"):
+                    desc = True
+                else:
+                    self.accept_kw("ASC")
+                order.append((e, desc))
+                if not self.accept_op(","):
+                    break
+        self.expect_op(")")
+        return OverCall(func, tuple(partition), tuple(order))
+
+
+_CLAUSE_KWS = {
+    "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "JOIN", "INNER",
+    "LEFT", "RIGHT", "FULL", "OUTER", "ON", "AS", "AND", "OR", "NOT",
+    "UNION", "SELECT", "BY", "ASC", "DESC", "BETWEEN", "IN", "CASE", "WHEN",
+    "THEN", "ELSE", "END", "TABLE", "INTERVAL", "HAVING", "CROSS",
+}
+
+
+def parse(sql: str) -> Statement:
+    return Parser(sql).parse_statement()
