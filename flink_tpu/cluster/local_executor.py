@@ -48,12 +48,16 @@ class _Node:
         self.records_out = 0
 
 
+class JobCancelledError(RuntimeError):
+    """Raised inside the task loop when the job is cancelled externally."""
+
+
 class LocalExecutor:
     def __init__(self, config: Optional[Configuration] = None):
         self.config = config or Configuration()
 
     def run(self, graph: StreamGraph, job_name: str = "job",
-            restore_from: Optional[str] = None):
+            restore_from: Optional[str] = None, cancel_event=None):
         """Execute the graph to completion.
 
         Checkpointing: between two source polls the whole dataflow is
@@ -76,6 +80,15 @@ class LocalExecutor:
 
             storage = CheckpointStorage(ckpt_dir)
 
+        # metrics + traces (reference: MetricRegistryImpl + Span reporting;
+        # standard task I/O metric names follow the reference's
+        # numRecordsIn/Out, currentInputWatermark conventions)
+        from flink_tpu.metrics import MetricRegistry, TraceCollector
+
+        registry = MetricRegistry()
+        traces = TraceCollector()
+        job_group = registry.root_group("job", job_name)
+
         # build nodes
         nodes: Dict[int, _Node] = {}
         ctx = OperatorContext(operator_index=0, parallelism=1,
@@ -86,6 +99,20 @@ class LocalExecutor:
             if op is not None:
                 op.open(ctx)
             nodes[t.uid] = node
+            g = job_group.add_group(f"{t.name}#{t.uid}")
+            g.gauge("numRecordsIn", lambda n=node: n.records_in)
+            g.gauge("numRecordsOut", lambda n=node: n.records_out)
+            g.gauge("currentInputWatermark",
+                    lambda n=node: n.valve.combined)
+            if op is not None and hasattr(op, "fire_latencies_ms"):
+                from flink_tpu.metrics.core import quantile_sorted
+
+                g.gauge("windowFireLatencyP99Ms",
+                        lambda o=op: quantile_sorted(
+                            sorted(o.fire_latencies_ms), 0.99))
+            if op is not None and hasattr(op, "late_records_dropped"):
+                g.gauge("numLateRecordsDropped",
+                        lambda o=op: o.late_records_dropped)
         for t in graph.nodes:
             n = nodes[t.uid]
             for child_t in graph.children(t):
@@ -117,48 +144,72 @@ class LocalExecutor:
         batches_since_ckpt = 0
 
         active = {t.uid for t, _ in sources}
-        while active:
-            progressed = False
-            for t, node in sources:
-                if t.uid not in active:
-                    continue
-                batch = t.source.poll_batch(batch_size)
-                if batch is None:
-                    active.discard(t.uid)
-                    self._emit_watermark(node, MAX_WATERMARK)
-                    t.source.close()
-                    continue
-                if len(batch) == 0:
-                    continue
-                progressed = True
-                batches_since_ckpt += 1
-                batch = t.watermark_strategy.assign_timestamps(batch)
-                total_records += len(batch)
-                self._emit_batch(node, batch)
-                wm = generators[t.uid].on_batch(batch)
-                if wm is not None:
-                    self._emit_watermark(node, wm)
-            if storage is not None:
-                due = (ckpt_every_n and batches_since_ckpt >= ckpt_every_n) or (
-                    not ckpt_every_n and ckpt_interval
-                    and time.time() * 1000 - last_ckpt >= ckpt_interval)
-                if due:
-                    checkpoint_count += 1
-                    storage.write_checkpoint(
-                        checkpoint_count, job_name,
-                        self.snapshot_all(graph, nodes))
-                    storage.retain(self.config.get(CheckpointOptions.RETAINED))
-                    last_ckpt = time.time() * 1000
-                    batches_since_ckpt = 0
-            if not progressed and active:
-                time.sleep(0.001)
+        try:
+            while active:
+                if cancel_event is not None and cancel_event.is_set():
+                    raise JobCancelledError(job_name)
+                progressed = False
+                for t, node in sources:
+                    if t.uid not in active:
+                        continue
+                    batch = t.source.poll_batch(batch_size)
+                    if batch is None:
+                        active.discard(t.uid)
+                        self._emit_watermark(node, MAX_WATERMARK)
+                        t.source.close()
+                        continue
+                    if len(batch) == 0:
+                        continue
+                    progressed = True
+                    batches_since_ckpt += 1
+                    batch = t.watermark_strategy.assign_timestamps(batch)
+                    total_records += len(batch)
+                    self._emit_batch(node, batch)
+                    wm = generators[t.uid].on_batch(batch)
+                    if wm is not None:
+                        self._emit_watermark(node, wm)
+                if storage is not None:
+                    due = (ckpt_every_n
+                           and batches_since_ckpt >= ckpt_every_n) or (
+                        not ckpt_every_n and ckpt_interval
+                        and time.time() * 1000 - last_ckpt >= ckpt_interval)
+                    if due:
+                        checkpoint_count += 1
+                        with traces.span(
+                                "checkpoint",
+                                f"checkpoint-{checkpoint_count}") as sp:
+                            snap = self.snapshot_all(graph, nodes)
+                            storage.write_checkpoint(
+                                checkpoint_count, job_name, snap)
+                            sp.set_attribute("checkpointId", checkpoint_count)
+                        storage.retain(
+                            self.config.get(CheckpointOptions.RETAINED))
+                        last_ckpt = time.time() * 1000
+                        batches_since_ckpt = 0
+                if not progressed and active:
+                    time.sleep(0.001)
 
-        # drain/close in topological order
-        for t in graph.nodes:
-            node = nodes[t.uid]
-            if node.operator is not None:
-                for out in node.operator.close():
-                    self._forward(node, out)
+            # drain/close in topological order
+            for t in graph.nodes:
+                node = nodes[t.uid]
+                if node.operator is not None:
+                    for out in node.operator.close():
+                        self._forward(node, out)
+        except BaseException:
+            # failure/cancel path: release resources without emitting
+            # (reference: Task.doRun finally -> cancel + releaseResources)
+            for t, _ in sources:
+                try:
+                    t.source.close()
+                except Exception:
+                    pass
+            for node in nodes.values():
+                if node.operator is not None:
+                    try:
+                        node.operator.dispose()
+                    except Exception:
+                        pass
+            raise
 
         elapsed = time.perf_counter() - t0
         fire_latencies: List[float] = []
@@ -178,15 +229,19 @@ class LocalExecutor:
             },
         }
         if fire_latencies:
+            from flink_tpu.metrics.core import quantile_sorted
+
             fire_latencies.sort()
             metrics["window_fire_latency_ms"] = {
-                "p50": fire_latencies[len(fire_latencies) // 2],
-                "p99": fire_latencies[min(len(fire_latencies) - 1,
-                                          int(len(fire_latencies) * 0.99))],
+                "p50": quantile_sorted(fire_latencies, 0.5),
+                "p99": quantile_sorted(fire_latencies, 0.99),
                 "max": fire_latencies[-1],
                 "count": len(fire_latencies),
             }
-        return JobExecutionResult(job_name, metrics)
+        result = JobExecutionResult(job_name, metrics)
+        result.registry = registry
+        result.traces = traces
+        return result
 
     # ------------------------------------------------------------- plumbing
 

@@ -1,0 +1,122 @@
+"""REST status endpoint for the MiniCluster.
+
+reference: flink-runtime/.../rest (41k LoC of handlers) + the Angular web
+dashboard. Scope here: the JSON monitoring surface the reference's dashboard
+reads — cluster overview, job list, per-job status/metrics — served from a
+background http.server thread.
+
+GET /overview              cluster totals
+GET /jobs                  job summaries
+GET /jobs/<id>             one job's status
+GET /jobs/<id>/metrics     metric registry snapshot of the running attempt
+GET /taskexecutors         live executors + slots
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class RestServer:
+    def __init__(self, cluster, port: int = 0):
+        self.cluster = cluster
+        rest = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                try:
+                    payload = rest._route(self.path)
+                except KeyError:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                except Exception as e:  # noqa: BLE001
+                    body = json.dumps({"error": repr(e)}).encode()
+                    self.send_response(500)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                body = json.dumps(payload, default=str).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="rest-server", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- routing
+
+    def _route(self, path: str):
+        parts = [p for p in path.split("?")[0].split("/") if p]
+        if parts == ["overview"] or not parts:
+            return self._overview()
+        if parts == ["jobs"]:
+            return {"jobs": self.cluster.dispatcher.list_jobs()}
+        if parts == ["taskexecutors"]:
+            return self._executors()
+        if len(parts) >= 2 and parts[0] == "jobs":
+            job_id = parts[1]
+            if len(parts) == 2:
+                st = self.cluster.dispatcher.job_status(job_id)
+                if st["status"] == "UNKNOWN":
+                    raise KeyError(job_id)
+                return dict(st, job_id=job_id)
+            if parts[2] == "metrics":
+                return self._job_metrics(job_id)
+        raise KeyError(path)
+
+    def _overview(self):
+        jobs = self.cluster.dispatcher.list_jobs()
+        by_status: dict = {}
+        for j in jobs:
+            by_status[j["status"]] = by_status.get(j["status"], 0) + 1
+        return {
+            "taskexecutors": len(self.cluster.executors),
+            "slots_total": sum(te.num_slots for te in self.cluster.executors),
+            "jobs": by_status,
+            "flink_tpu_version": _version(),
+        }
+
+    def _executors(self):
+        return {"executors": [te.heartbeat()
+                              for te in self.cluster.executors]}
+
+    def _job_metrics(self, job_id: str):
+        master = self.cluster.dispatcher.master(job_id)
+        if master is None:
+            raise KeyError(job_id)
+        result = master.result
+        if result is not None:
+            snap = getattr(result, "metric_snapshot", None)
+            if snap is None and getattr(result, "registry", None):
+                snap = result.registry.snapshot()
+            return {"job_id": job_id, "metrics": snap or {},
+                    "spans": getattr(result, "spans", [])}
+        return {"job_id": job_id, "metrics": {},
+                "note": "job still running or no result yet"}
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _version() -> str:
+    try:
+        from flink_tpu.version import __version__
+
+        return __version__
+    except Exception:  # pragma: no cover
+        return "unknown"

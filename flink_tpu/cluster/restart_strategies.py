@@ -1,0 +1,114 @@
+"""Restart backoff strategies.
+
+reference: flink-runtime/.../executiongraph/failover/
+FixedDelayRestartBackoffTimeStrategy.java,
+ExponentialDelayRestartBackoffTimeStrategy.java,
+FailureRateRestartBackoffTimeStrategy.java.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+
+class RestartStrategy:
+    def can_restart(self) -> bool:
+        raise NotImplementedError
+
+    def notify_failure(self) -> None:
+        pass
+
+    def backoff_ms(self) -> int:
+        raise NotImplementedError
+
+
+class NoRestartStrategy(RestartStrategy):
+    def can_restart(self) -> bool:
+        return False
+
+    def backoff_ms(self) -> int:
+        return 0
+
+
+class FixedDelayRestartStrategy(RestartStrategy):
+    def __init__(self, max_attempts: int = 3, delay_ms: int = 1000):
+        self.max_attempts = max_attempts
+        self.delay_ms = delay_ms
+        self.attempts = 0
+
+    def notify_failure(self) -> None:
+        self.attempts += 1
+
+    def can_restart(self) -> bool:
+        return self.attempts < self.max_attempts
+
+    def backoff_ms(self) -> int:
+        return self.delay_ms
+
+
+class ExponentialDelayRestartStrategy(RestartStrategy):
+    def __init__(self, initial_ms: int = 100, max_ms: int = 60_000,
+                 multiplier: float = 2.0, max_attempts: int = 10):
+        self.initial_ms = initial_ms
+        self.max_ms = max_ms
+        self.multiplier = multiplier
+        self.max_attempts = max_attempts
+        self.attempts = 0
+        self._current = initial_ms
+
+    def notify_failure(self) -> None:
+        if self.attempts > 0:
+            self._current = min(self.max_ms,
+                                int(self._current * self.multiplier))
+        self.attempts += 1
+
+    def can_restart(self) -> bool:
+        return self.attempts < self.max_attempts
+
+    def backoff_ms(self) -> int:
+        return self._current
+
+
+class FailureRateRestartStrategy(RestartStrategy):
+    """Allow at most ``max_failures`` within ``interval_ms``."""
+
+    def __init__(self, max_failures: int = 3, interval_ms: int = 60_000,
+                 delay_ms: int = 1000):
+        self.max_failures = max_failures
+        self.interval_ms = interval_ms
+        self.delay_ms = delay_ms
+        self._failures: List[float] = []
+
+    def notify_failure(self) -> None:
+        now = time.monotonic() * 1000
+        self._failures.append(now)
+        cutoff = now - self.interval_ms
+        self._failures = [t for t in self._failures if t >= cutoff]
+
+    def can_restart(self) -> bool:
+        return len(self._failures) < self.max_failures
+
+    def backoff_ms(self) -> int:
+        return self.delay_ms
+
+
+def restart_strategy_from_config(config) -> RestartStrategy:
+    from flink_tpu.core.config import RestartOptions
+
+    kind = config.get(RestartOptions.STRATEGY)
+    if kind == "none":
+        return NoRestartStrategy()
+    if kind == "fixed-delay":
+        return FixedDelayRestartStrategy(
+            config.get(RestartOptions.MAX_ATTEMPTS),
+            config.get(RestartOptions.DELAY_MS))
+    if kind == "exponential-delay":
+        return ExponentialDelayRestartStrategy(
+            initial_ms=config.get(RestartOptions.DELAY_MS),
+            max_attempts=config.get(RestartOptions.MAX_ATTEMPTS))
+    if kind == "failure-rate":
+        return FailureRateRestartStrategy(
+            max_failures=config.get(RestartOptions.MAX_ATTEMPTS),
+            delay_ms=config.get(RestartOptions.DELAY_MS))
+    raise ValueError(f"unknown restart strategy {kind!r}")

@@ -1,0 +1,229 @@
+"""Location-transparent RPC backbone over gRPC.
+
+reference: flink-rpc — RpcEndpoint/RpcGateway/RpcService
+(flink-rpc-core/.../rpc/RpcEndpoint.java) implemented over Pekko actors with
+JDK dynamic proxies (flink-rpc-akka/.../pekko/PekkoInvocationHandler.java,
+PekkoRpcActor.java). Key semantics kept:
+
+- every endpoint runs its handlers on ONE main thread (the reference's
+  main-thread executor; MainThreadValidatorUtil assertions)
+- gateways are dynamic proxies: attribute access returns a callable that
+  marshals (endpoint, method, args) over the wire and blocks on the reply
+- fencing tokens guard against split-brain leaders
+
+Re-design: transport is gRPC's generic (un-protoc'ed) byte method with
+cloudpickle payloads — one wire method, dynamic dispatch server-side, which
+is exactly the shape of the reference's RockRpcInvocation messages.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from concurrent import futures
+from typing import Any, Dict, Optional
+
+import cloudpickle
+import grpc
+
+_METHOD = "/flink_tpu.Rpc/Invoke"
+
+
+class RpcException(RuntimeError):
+    pass
+
+
+class FencingTokenException(RpcException):
+    pass
+
+
+class RpcEndpoint:
+    """Base class: subclass and define public methods; they become remotely
+    callable. All calls execute serialized on this endpoint's main thread."""
+
+    def __init__(self, endpoint_id: str):
+        self.endpoint_id = endpoint_id
+        self._mailbox: "queue.Queue" = queue.Queue()
+        self._running = False
+        self._main_thread: Optional[threading.Thread] = None
+        self.fencing_token: Optional[int] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def on_start(self) -> None:
+        pass
+
+    def on_stop(self) -> None:
+        pass
+
+    def start(self) -> None:
+        self._running = True
+        self._main_thread = threading.Thread(
+            target=self._main_loop, name=f"rpc-main-{self.endpoint_id}",
+            daemon=True)
+        self._main_thread.start()
+        self.run_in_main_thread(self.on_start).result()
+
+    def stop(self) -> None:
+        if not self._running:
+            return
+        self.run_in_main_thread(self.on_stop).result()
+        self._running = False
+        self._mailbox.put(None)  # wake the loop
+        self._main_thread.join(timeout=5)
+
+    # -- main-thread executor ----------------------------------------------
+
+    def _main_loop(self) -> None:
+        while True:
+            item = self._mailbox.get()
+            if item is None:
+                if not self._running:
+                    return
+                continue
+            fn, args, kwargs, fut = item
+            try:
+                fut.set_result(fn(*args, **kwargs))
+            except BaseException as e:  # noqa: BLE001 - marshalled to caller
+                fut.set_exception(e)
+
+    def run_in_main_thread(self, fn, *args, **kwargs) -> "futures.Future":
+        fut: "futures.Future" = futures.Future()
+        self._mailbox.put((fn, args, kwargs, fut))
+        return fut
+
+    def validate_main_thread(self) -> None:
+        """reference: MainThreadValidatorUtil.isRunningInExpectedThread."""
+        assert threading.current_thread() is self._main_thread, \
+            "must run on the endpoint main thread"
+
+    # -- dispatch (called by RpcService) ------------------------------------
+
+    def _invoke(self, method: str, args, kwargs,
+                fencing_token: Optional[int]) -> Any:
+        if self.fencing_token is not None and \
+                fencing_token != self.fencing_token:
+            raise FencingTokenException(
+                f"{self.endpoint_id}: fencing token mismatch "
+                f"(got {fencing_token}, expected {self.fencing_token})")
+        fn = getattr(self, method, None)
+        if fn is None or method.startswith("_"):
+            raise RpcException(
+                f"no such rpc method {method!r} on {self.endpoint_id}")
+        return self.run_in_main_thread(fn, *args, **kwargs).result()
+
+
+class _GatewayProxy:
+    """Dynamic proxy — the reference's PekkoInvocationHandler."""
+
+    def __init__(self, invoke, endpoint_id: str,
+                 fencing_token: Optional[int] = None):
+        object.__setattr__(self, "_invoke_fn", invoke)
+        object.__setattr__(self, "_endpoint_id", endpoint_id)
+        object.__setattr__(self, "_fencing_token", fencing_token)
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+
+        def call(*args, **kwargs):
+            return self._invoke_fn(self._endpoint_id, method, args, kwargs,
+                                   self._fencing_token)
+
+        return call
+
+    def with_fencing_token(self, token: int) -> "_GatewayProxy":
+        return _GatewayProxy(self._invoke_fn, self._endpoint_id, token)
+
+
+class RpcService:
+    """Hosts endpoints on a gRPC server; connects gateways to remote ones."""
+
+    def __init__(self, bind_address: str = "127.0.0.1", port: int = 0):
+        self._endpoints: Dict[str, RpcEndpoint] = {}
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=16),
+            options=[("grpc.max_receive_message_length", 512 * 1024 * 1024),
+                     ("grpc.max_send_message_length", 512 * 1024 * 1024)])
+        handler = grpc.method_handlers_generic_handler(
+            "flink_tpu.Rpc",
+            {"Invoke": grpc.unary_unary_rpc_method_handler(
+                self._serve,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b)})
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"{bind_address}:{port}")
+        self._server.start()
+        self.address = f"{bind_address}:{self.port}"
+        self._channels: Dict[str, grpc.Channel] = {}
+        self._lock = threading.Lock()
+
+    # -- server side --------------------------------------------------------
+
+    def register(self, endpoint: RpcEndpoint) -> None:
+        self._endpoints[endpoint.endpoint_id] = endpoint
+        endpoint.start()
+
+    def unregister(self, endpoint_id: str) -> None:
+        ep = self._endpoints.pop(endpoint_id, None)
+        if ep is not None:
+            ep.stop()
+
+    def _serve(self, request: bytes, context) -> bytes:
+        try:
+            endpoint_id, method, args, kwargs, token = \
+                cloudpickle.loads(request)
+            ep = self._endpoints.get(endpoint_id)
+            if ep is None:
+                raise RpcException(f"unknown endpoint {endpoint_id!r}")
+            result = ep._invoke(method, args, kwargs, token)
+            return cloudpickle.dumps(("ok", result))
+        except BaseException as e:  # noqa: BLE001 - marshalled to caller
+            return cloudpickle.dumps(
+                ("err", e, traceback.format_exc()))
+
+    # -- client side --------------------------------------------------------
+
+    def _channel(self, address: str) -> grpc.Channel:
+        with self._lock:
+            ch = self._channels.get(address)
+            if ch is None:
+                ch = grpc.insecure_channel(
+                    address,
+                    options=[("grpc.max_receive_message_length",
+                              512 * 1024 * 1024),
+                             ("grpc.max_send_message_length",
+                              512 * 1024 * 1024)])
+                self._channels[address] = ch
+            return ch
+
+    def connect(self, address: str, endpoint_id: str,
+                fencing_token: Optional[int] = None) -> _GatewayProxy:
+        channel = self._channel(address)
+        stub = channel.unary_unary(
+            _METHOD,
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+
+        def invoke(eid, method, args, kwargs, token):
+            payload = cloudpickle.dumps((eid, method, args, kwargs, token))
+            reply = cloudpickle.loads(stub(payload, timeout=120))
+            if reply[0] == "ok":
+                return reply[1]
+            _, exc, tb = reply
+            raise exc
+
+        return _GatewayProxy(invoke, endpoint_id, fencing_token)
+
+    def self_gateway(self, endpoint_id: str,
+                     fencing_token: Optional[int] = None) -> _GatewayProxy:
+        return self.connect(self.address, endpoint_id, fencing_token)
+
+    def stop(self) -> None:
+        for ep in list(self._endpoints.values()):
+            ep.stop()
+        self._endpoints.clear()
+        for ch in self._channels.values():
+            ch.close()
+        self._server.stop(grace=1)

@@ -46,3 +46,56 @@ class PrintSink(Sink):
             print(f"{self.label}> {r}")
         if self.max_rows is not None and len(rows) > self.max_rows:
             print(f"{self.label}> ... {len(rows) - self.max_rows} more")
+
+
+class JsonLinesFileSink(Sink):
+    """Append rows as JSON lines to a file.
+
+    reference: filesystem connector / FileSink (flink-connectors). Append
+    mode survives job restarts — downstream consumers dedupe on key columns
+    for effectively-once results (the reference's at-least-once file sink
+    without the two-phase-commit part; see checkpoint docs for the exactly-
+    once variant design).
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh = None
+
+    def open(self, subtask_index: int = 0) -> None:
+        import os
+
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    def write(self, batch: RecordBatch) -> None:
+        import json
+
+        if self._fh is None:  # deserialized on a worker without open()
+            self.open()
+        for row in batch.to_rows():
+            self._fh.write(json.dumps(row, default=str) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __getstate__(self):
+        # the sink travels to workers via cloudpickle; the handle does not
+        return {"path": self.path}
+
+    def __setstate__(self, state):
+        self.path = state["path"]
+        self._fh = None
+
+    @staticmethod
+    def read_rows(path: str):
+        import json
+        import os
+
+        if not os.path.exists(path):
+            return []
+        with open(path, encoding="utf-8") as fh:
+            return [json.loads(line) for line in fh if line.strip()]

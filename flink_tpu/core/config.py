@@ -184,3 +184,29 @@ class CheckpointOptions:
         description="How many completed checkpoints to keep.")
     MODE = ConfigOption(
         "execution.checkpointing.mode", default="exactly-once", type=str)
+
+
+class RestartOptions:
+    """reference: RestartStrategyOptions (restart-strategy.* keys)."""
+
+    STRATEGY = ConfigOption(
+        "restart-strategy.type", default="fixed-delay", type=str,
+        description="none | fixed-delay | exponential-delay | failure-rate.")
+    MAX_ATTEMPTS = ConfigOption(
+        "restart-strategy.max-attempts", default=3, type=int)
+    DELAY_MS = ConfigOption(
+        "restart-strategy.delay-ms", default=100, type=int)
+
+
+class ClusterOptions:
+    NUM_TASK_EXECUTORS = ConfigOption(
+        "cluster.task-executors", default=1, type=int)
+    SLOTS_PER_EXECUTOR = ConfigOption(
+        "taskmanager.numberOfTaskSlots", default=1, type=int)
+    HEARTBEAT_INTERVAL_MS = ConfigOption(
+        "heartbeat.interval-ms", default=500, type=int)
+    HEARTBEAT_TIMEOUT_MS = ConfigOption(
+        "heartbeat.timeout-ms", default=5000, type=int)
+    REST_PORT = ConfigOption(
+        "rest.port", default=0, type=int,
+        description="REST status endpoint port; 0 = ephemeral, -1 = off.")

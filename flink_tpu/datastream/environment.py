@@ -121,6 +121,10 @@ class JobExecutionResult:
     def __init__(self, job_name: str, metrics: dict):
         self.job_name = job_name
         self.metrics = metrics
+        #: MetricRegistry with the job's operator-scoped metrics
+        self.registry = None
+        #: TraceCollector with checkpoint/recovery spans
+        self.traces = None
 
     def __repr__(self):  # pragma: no cover - cosmetic
         return f"JobExecutionResult({self.job_name}, {self.metrics})"

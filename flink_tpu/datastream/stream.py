@@ -99,9 +99,8 @@ class DataStream:
     # ---------------------------------------------------------------- sinks
 
     def sink_to(self, sink: "Sink", name: str = "sink") -> "DataStreamSink":
-        sink.open()
         t = Transformation(name=name, kind="sink",
-                           operator_factory=lambda: SinkOperator(sink.write),
+                           operator_factory=lambda: SinkOperator(sink),
                            inputs=[self.transformation])
         self.env._sinks.append(t)
         return DataStreamSink(self.env, t, sink)

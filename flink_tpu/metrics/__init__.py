@@ -1,0 +1,13 @@
+from flink_tpu.metrics.core import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Meter,
+    MetricGroup,
+    MetricRegistry,
+)
+from flink_tpu.metrics.reporters import (  # noqa: F401
+    LoggingReporter,
+    PrometheusReporter,
+)
+from flink_tpu.metrics.traces import Span, SpanBuilder, TraceCollector  # noqa: F401

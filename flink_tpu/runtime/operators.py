@@ -47,6 +47,10 @@ class Operator:
     def close(self) -> List[RecordBatch]:
         return []
 
+    def dispose(self) -> None:
+        """Release resources without emitting (failure/cancel path; the
+        reference's StreamOperator.close vs dispose split)."""
+
     # checkpointing
     def snapshot_state(self) -> Optional[Dict[str, Any]]:
         return None
@@ -236,11 +240,24 @@ class UnionOperator(Operator):
 
 
 class SinkOperator(Operator):
+    """Owns the sink lifecycle: open on task start, close on drain
+    (reference: Sink V2 writer lifecycle)."""
+
     name = "sink"
 
-    def __init__(self, sink_fn: Callable[[RecordBatch], None]):
-        self.sink_fn = sink_fn
+    def __init__(self, sink):
+        self.sink = sink
+
+    def open(self, ctx):
+        self.sink.open(ctx.operator_index)
 
     def process_batch(self, batch, input_index=0):
-        self.sink_fn(batch)
+        self.sink.write(batch)
         return []
+
+    def close(self):
+        self.sink.close()
+        return []
+
+    def dispose(self):
+        self.sink.close()

@@ -1,0 +1,273 @@
+"""Control-plane tests: RPC backbone, MiniCluster lifecycle, failover, REST.
+
+reference test model: MiniCluster-based ITCases + recovery tests
+(flink-tests/.../recovery/, SURVEY.md §4 tier 3) — fault injection by
+throwing in UDFs and killing TaskExecutors.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flink_tpu.cluster.minicluster import (
+    FAILED,
+    FINISHED,
+    MiniCluster,
+)
+from flink_tpu.cluster.restart_strategies import (
+    ExponentialDelayRestartStrategy,
+    FailureRateRestartStrategy,
+    FixedDelayRestartStrategy,
+)
+from flink_tpu.cluster.rpc import RpcEndpoint, RpcException, RpcService
+from flink_tpu.connectors.sinks import CollectSink
+from flink_tpu.core.config import Configuration
+from flink_tpu.datastream.environment import StreamExecutionEnvironment
+from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+
+# ------------------------------------------------------------------ RPC
+
+
+class EchoEndpoint(RpcEndpoint):
+    def __init__(self):
+        super().__init__("echo")
+        self.calls = 0
+
+    def echo(self, x):
+        self.validate_main_thread()
+        self.calls += 1
+        return x
+
+    def boom(self):
+        raise ValueError("intentional")
+
+
+class TestRpc:
+    def test_roundtrip_and_main_thread(self):
+        svc = RpcService()
+        try:
+            svc.register(EchoEndpoint())
+            gw = svc.self_gateway("echo")
+            assert gw.echo({"a": [1, 2, 3]}) == {"a": [1, 2, 3]}
+        finally:
+            svc.stop()
+
+    def test_exception_marshalling(self):
+        svc = RpcService()
+        try:
+            svc.register(EchoEndpoint())
+            gw = svc.self_gateway("echo")
+            with pytest.raises(ValueError, match="intentional"):
+                gw.boom()
+            with pytest.raises(RpcException):
+                gw.no_such_method()
+        finally:
+            svc.stop()
+
+    def test_fencing_token(self):
+        svc = RpcService()
+        try:
+            ep = EchoEndpoint()
+            ep.fencing_token = 42
+            svc.register(ep)
+            good = svc.self_gateway("echo", fencing_token=42)
+            assert good.echo(1) == 1
+            bad = svc.self_gateway("echo", fencing_token=7)
+            with pytest.raises(Exception, match="fencing"):
+                bad.echo(1)
+        finally:
+            svc.stop()
+
+
+# ------------------------------------------------------- restart strategies
+
+
+class TestRestartStrategies:
+    def test_fixed_delay(self):
+        s = FixedDelayRestartStrategy(max_attempts=2, delay_ms=5)
+        assert s.can_restart()
+        s.notify_failure()
+        assert s.can_restart()
+        s.notify_failure()
+        assert not s.can_restart()
+
+    def test_exponential(self):
+        s = ExponentialDelayRestartStrategy(initial_ms=10, max_attempts=5)
+        s.notify_failure()
+        b1 = s.backoff_ms()
+        s.notify_failure()
+        assert s.backoff_ms() > b1
+
+    def test_failure_rate(self):
+        s = FailureRateRestartStrategy(max_failures=2, interval_ms=60_000)
+        s.notify_failure()
+        assert s.can_restart()
+        s.notify_failure()
+        assert not s.can_restart()
+
+
+# ------------------------------------------------------------ MiniCluster
+
+
+def _pipeline(env, sink, fail_at=None):
+    rows = [{"k": i % 5, "v": 1, "ts": i * 10} for i in range(5000)]
+    ds = env.from_collection(rows, timestamp_field="ts")
+    if fail_at is not None:
+        state = {"seen": 0}
+
+        def poison(batch):
+            state["seen"] += len(batch)
+            if state["seen"] > fail_at:
+                raise RuntimeError("injected fault")
+            return batch
+
+        ds = ds.map(poison, name="failmap")
+    else:
+        ds = ds.map(lambda b: b, name="failmap")
+    ds.key_by("k").window(TumblingEventTimeWindows.of(1000)) \
+        .sum("v").sink_to(sink)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(Configuration({
+        "cluster.task-executors": 2,
+        "heartbeat.interval-ms": 100,
+    }))
+    yield c
+    c.shutdown()
+
+
+class TestMiniCluster:
+    def test_submit_and_finish(self, cluster):
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 512}))
+        sink = CollectSink()
+        _pipeline(env, sink)
+        client = cluster.submit(env, "happy-job")
+        st = client.wait(timeout=60)
+        assert st["status"] == FINISHED
+        result = client.result()
+        assert result.metrics["records_emitted_by_sources"] == 5000
+        assert result.metric_snapshot  # wire-safe registry snapshot
+
+    def test_udf_failure_exhausts_restarts(self, cluster):
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 512,
+            "restart-strategy.max-attempts": 2,
+            "restart-strategy.delay-ms": 10,
+        }))
+        sink = CollectSink()
+        _pipeline(env, sink, fail_at=100)
+        client = cluster.submit(env, "doomed-job")
+        st = client.wait(timeout=60)
+        assert st["status"] == FAILED
+        assert st["attempt"] == 1  # original + 1 restart = 2 attempts
+        assert "injected fault" in st["error"]
+
+    def test_failover_restores_from_checkpoint(self, cluster, tmp_path):
+        """Fault once, restart, recover from checkpoint, finish with
+        exactly-once totals (reference: recovery ITCases)."""
+        ckpt = str(tmp_path / "ckpt")
+        rows_total = 5000
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 256,
+            "state.checkpoints.dir": ckpt,
+            "execution.checkpointing.every-n-source-batches": 2,
+            "restart-strategy.max-attempts": 3,
+            "restart-strategy.delay-ms": 10,
+        }))
+        # output must go through the filesystem: the graph (and any sink in
+        # it) is serialized to the worker, so a local CollectSink object
+        # would never see data
+        from flink_tpu.connectors.sinks import JsonLinesFileSink
+
+        out_path = str(tmp_path / "out.jsonl")
+        sink = JsonLinesFileSink(out_path)
+        # graph closures are re-deserialized per deployment attempt, so the
+        # crash-once flag must live outside the process image (a file), like
+        # the reference's e2e fault-injection scripts
+        flag = str(tmp_path / "crashed.flag")
+
+        rows = [{"k": i % 5, "v": 1, "ts": i * 10}
+                for i in range(rows_total)]
+        ds = env.from_collection(rows, timestamp_field="ts")
+
+        def poison_once(batch, flag=flag):
+            import os
+
+            if not os.path.exists(flag) and int(batch.timestamps.max()) > 15_000:
+                with open(flag, "w") as f:
+                    f.write("x")
+                raise RuntimeError("crash once")
+            return batch
+
+        ds.map(poison_once, name="failmap") \
+            .key_by("k").window(TumblingEventTimeWindows.of(1000)) \
+            .sum("v").sink_to(sink)
+        client = cluster.submit(env, "phoenix-job")
+        st = client.wait(timeout=120)
+        assert st["status"] == FINISHED
+        assert st["attempt"] >= 1
+        rows_out = JsonLinesFileSink.read_rows(out_path)
+        assert rows_out
+        # exactly-once state: summed counts across windows equal the row
+        # total (restored from checkpoint, no double counting); the
+        # at-least-once file sink may hold the pre-crash attempt's
+        # emissions -> dedupe per (key, window), last wins
+        seen = {}
+        for r in rows_out:
+            seen[(r["k"], r["window_start"])] = r["sum_v"]
+        assert sum(seen.values()) == rows_total
+
+    def test_kill_task_executor_fails_over(self, cluster):
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 64,
+            "restart-strategy.max-attempts": 3,
+            "restart-strategy.delay-ms": 10,
+            "heartbeat.timeout-ms": 1000,
+        }))
+        sink = CollectSink()
+        rows = [{"k": i % 5, "v": 1, "ts": i * 10} for i in range(200_000)]
+        env.from_collection(rows, timestamp_field="ts") \
+            .key_by("k").window(TumblingEventTimeWindows.of(1000)) \
+            .sum("v").sink_to(sink)
+        client = cluster.submit(env, "survivor-job")
+        # wait until attempt 0 is actually running on some executor
+        deadline = time.time() + 30
+        victim = None
+        exec_id = f"{client.job_id}-0"
+        while time.time() < deadline and victim is None:
+            for te in cluster.executors:
+                if te.task_status(exec_id)["status"] == "RUNNING":
+                    victim = te.endpoint_id
+                    break
+            time.sleep(0.02)
+        if victim is not None:
+            cluster.kill_task_executor(victim)
+        st = client.wait(timeout=120)
+        assert st["status"] == FINISHED
+
+    def test_rest_endpoints(self, cluster):
+        port = cluster.rest_port
+        assert port
+
+        def get(path):
+            return json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5).read())
+
+        overview = get("/overview")
+        assert overview["taskexecutors"] >= 1
+        jobs = get("/jobs")["jobs"]
+        assert jobs, "previous tests should have left jobs"
+        jid = jobs[0]["job_id"]
+        detail = get(f"/jobs/{jid}")
+        assert detail["status"]
+        metrics = get(f"/jobs/{jid}/metrics")
+        assert "metrics" in metrics
+        execs = get("/taskexecutors")["executors"]
+        assert execs

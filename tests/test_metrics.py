@@ -1,0 +1,126 @@
+"""Metrics, reporters and trace spans.
+
+reference test model: flink-metrics-core + runtime metric group tests
+(SURVEY.md §4 tier 1 unit tests).
+"""
+
+import urllib.request
+
+from flink_tpu.metrics import (
+    Counter,
+    Histogram,
+    Meter,
+    MetricRegistry,
+    PrometheusReporter,
+    TraceCollector,
+)
+
+
+class TestMetricTypes:
+    def test_counter(self):
+        c = Counter()
+        c.inc()
+        c.inc(5)
+        c.dec()
+        assert c.count == 5
+
+    def test_histogram_quantiles(self):
+        h = Histogram()
+        for v in range(100):
+            h.update(float(v))
+        snap = h.snapshot()
+        assert snap["count"] == 100
+        assert snap["min"] == 0.0 and snap["max"] == 99.0
+        assert 45 <= snap["p50"] <= 55
+        assert snap["p99"] >= 95
+
+    def test_meter_rate(self):
+        m = Meter()
+        for _ in range(10):
+            m.mark(100)
+        assert m.count == 1000
+
+    def test_groups_and_registry(self):
+        reg = MetricRegistry()
+        job = reg.root_group("job", "test")
+        op = job.add_group("window_agg#3")
+        c = op.counter("numRecordsIn")
+        c.inc(42)
+        op.gauge("currentWatermark", lambda: 123)
+        snap = reg.snapshot()
+        assert snap["job.test.window_agg#3.numRecordsIn"] == 42
+        assert snap["job.test.window_agg#3.currentWatermark"] == 123
+
+    def test_unregister_prefix(self):
+        reg = MetricRegistry()
+        reg.root_group("job", "a").counter("x").inc()
+        reg.root_group("job", "b").counter("y").inc()
+        reg.unregister_scope_prefix(("job", "a"))
+        snap = reg.snapshot()
+        assert "job.a.x" not in snap and "job.b.y" in snap
+
+
+class TestPrometheusReporter:
+    def test_render_text_format(self):
+        reg = MetricRegistry()
+        g = reg.root_group("job", "nexmark", "q5")
+        g.counter("numRecordsIn").inc(7)
+        h = g.histogram("fireLatency")
+        h.update(1.0)
+        h.update(2.0)
+        rep = PrometheusReporter()
+        rep.open(reg)
+        text = rep.render()
+        assert "# TYPE" in text
+        assert "numRecordsIn" in text and " 7" in text
+        assert 'quantile="0.99"' in text
+
+    def test_http_endpoint(self):
+        reg = MetricRegistry()
+        reg.root_group("job", "x").counter("served").inc(3)
+        rep = PrometheusReporter(port=0)
+        rep.open(reg)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{rep.port}/metrics", timeout=5
+            ).read().decode()
+            assert "served" in body
+        finally:
+            rep.close()
+
+
+class TestTraces:
+    def test_span_collection(self):
+        tc = TraceCollector()
+        with tc.span("checkpoint", "checkpoint-1") as sp:
+            sp.set_attribute("checkpointId", 1)
+        spans = tc.spans("checkpoint")
+        assert len(spans) == 1
+        assert spans[0].attributes["checkpointId"] == 1
+        assert spans[0].duration_ms >= 0
+
+
+class TestJobMetricsWiring:
+    def test_job_exposes_registry_and_spans(self, tmp_path):
+        from flink_tpu.core.config import Configuration
+        from flink_tpu.connectors.sinks import CollectSink
+        from flink_tpu.datastream.environment import StreamExecutionEnvironment
+        from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+        conf = Configuration({
+            "state.checkpoints.dir": str(tmp_path / "ckpt"),
+            "execution.checkpointing.every-n-source-batches": 1,
+        })
+        env = StreamExecutionEnvironment(conf)
+        sink = CollectSink()
+        rows = [{"k": i % 3, "v": 1, "ts": i * 100} for i in range(100)]
+        env.from_collection(rows, timestamp_field="ts") \
+            .key_by("k").window(TumblingEventTimeWindows.of(1000)) \
+            .sum("v").sink_to(sink)
+        result = env.execute("metrics-job")
+        snap = result.registry.snapshot()
+        in_keys = [k for k in snap if k.endswith("numRecordsIn")]
+        assert in_keys and any(snap[k] > 0 for k in in_keys)
+        wm_keys = [k for k in snap if k.endswith("currentInputWatermark")]
+        assert wm_keys
+        assert result.traces.spans("checkpoint")
