@@ -24,11 +24,35 @@ from flink_tpu.version import __version__
 from flink_tpu.core.config import ConfigOption, Configuration
 from flink_tpu.core.records import RecordBatch
 from flink_tpu.datastream.environment import StreamExecutionEnvironment
+from flink_tpu.datastream.stream import AsyncDataStream
+from flink_tpu.runtime.process import (
+    BroadcastProcessFunction,
+    CoProcessFunction,
+    KeyedProcessFunction,
+    OutputTag,
+    ProcessFunction,
+)
+from flink_tpu.state.keyed_state import (
+    ListStateDescriptor,
+    MapStateDescriptor,
+    ReducingStateDescriptor,
+    ValueStateDescriptor,
+)
 
 __all__ = [
     "__version__",
+    "AsyncDataStream",
+    "BroadcastProcessFunction",
     "ConfigOption",
     "Configuration",
+    "CoProcessFunction",
+    "KeyedProcessFunction",
+    "ListStateDescriptor",
+    "MapStateDescriptor",
+    "OutputTag",
+    "ProcessFunction",
     "RecordBatch",
+    "ReducingStateDescriptor",
     "StreamExecutionEnvironment",
+    "ValueStateDescriptor",
 ]

@@ -30,6 +30,7 @@ from flink_tpu.core.records import RecordBatch
 from flink_tpu.graph.transformations import StreamGraph, Transformation
 from flink_tpu.runtime.elements import MAX_WATERMARK, Watermark
 from flink_tpu.runtime.operators import Operator, OperatorContext
+from flink_tpu.runtime.process import TaggedBatch
 from flink_tpu.runtime.watermarks import WatermarkValve
 
 
@@ -245,9 +246,15 @@ class LocalExecutor:
 
     # ------------------------------------------------------------- plumbing
 
-    def _emit_batch(self, node: _Node, batch: RecordBatch) -> None:
+    def _emit_batch(self, node: _Node, batch) -> None:
+        """Route an output to children. Side outputs (TaggedBatch) go only to
+        matching side-output edges; main outputs skip side-output edges
+        (reference: OutputTag routing in OperatorChain)."""
+        tag = batch.tag.name if isinstance(batch, TaggedBatch) else None
+        payload = batch.batch if tag is not None else batch
         for child, idx in zip(node.children, node.child_input_idx):
-            self._process(child, batch, idx)
+            if child.transformation.side_tag == tag:
+                self._process(child, payload, idx)
 
     def _emit_watermark(self, node: _Node, wm: int) -> None:
         for child, idx in zip(node.children, node.child_input_idx):
@@ -268,8 +275,9 @@ class LocalExecutor:
             self._forward(node, out)
         self._emit_watermark(node, advanced)
 
-    def _forward(self, node: _Node, batch: RecordBatch) -> None:
-        node.records_out += len(batch)
+    def _forward(self, node: _Node, batch) -> None:
+        n = len(batch.batch) if isinstance(batch, TaggedBatch) else len(batch)
+        node.records_out += n
         self._emit_batch(node, batch)
 
     # ----------------------------------------------------------- checkpoint

@@ -446,7 +446,6 @@ class MiniCluster:
             from flink_tpu.cluster.rest import RestServer
 
             self._rest = RestServer(self, port=rest_port)
-        self._lock = threading.Lock()
 
     # -- membership ---------------------------------------------------------
 
@@ -463,11 +462,14 @@ class MiniCluster:
     def kill_task_executor(self, executor_id: str) -> None:
         """Fault injection: make an executor vanish (tests; the reference
         kills TaskManagers in its recovery ITCases — SURVEY.md §4)."""
-        for te in self.executors:
+        for te in list(self.executors):
             if te.endpoint_id == executor_id:
                 for rec in te._tasks.values():
                     rec["cancel"].set()
                 self.service.unregister(executor_id)
+                # drop from membership so REST /taskexecutors and /overview
+                # stop reporting the dead executor's slots as capacity
+                self.executors.remove(te)
         self._heartbeats.pop(executor_id, None)
         self.rm_gateway().mark_dead(executor_id)
 

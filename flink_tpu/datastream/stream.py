@@ -79,6 +79,42 @@ class DataStream:
             inputs=[self.transformation] + [o.transformation for o in others])
         return DataStream(self.env, t)
 
+    # ----------------------------------------------------- process functions
+
+    def process(self, fn, name: str = "process") -> "DataStream":
+        """Low-level processing with timers and side outputs
+        (reference: DataStream.process ->
+        streaming/api/operators/ProcessOperator.java)."""
+        from flink_tpu.runtime.process import ProcessOperator
+
+        t = Transformation(name=name, kind="one_input",
+                           operator_factory=lambda: ProcessOperator(fn),
+                           inputs=[self.transformation])
+        return DataStream(self.env, t)
+
+    def get_side_output(self, tag) -> "DataStream":
+        """reference: SingleOutputStreamOperator.getSideOutput(OutputTag)."""
+        from flink_tpu.runtime.process import OutputTag, SideOutputSelectOperator
+
+        if isinstance(tag, str):
+            tag = OutputTag(tag)
+        t = Transformation(
+            name=f"side_output({tag.name})", kind="one_input",
+            operator_factory=lambda: SideOutputSelectOperator(tag),
+            inputs=[self.transformation], side_tag=tag.name)
+        return DataStream(self.env, t)
+
+    def connect(self, other: "DataStream") -> "ConnectedStreams":
+        """reference: DataStream.connect -> ConnectedStreams (co-process) or
+        BroadcastConnectedStream when ``other`` is ``.broadcast()``."""
+        if isinstance(other, BroadcastStream):
+            return BroadcastConnectedStream(self, other)
+        return ConnectedStreams(self, other)
+
+    def broadcast(self) -> "BroadcastStream":
+        """reference: DataStream.broadcast(MapStateDescriptor...)."""
+        return BroadcastStream(self)
+
     # ---------------------------------------------------------------- joins
 
     def join(self, other: "DataStream") -> "JoinedStreams":
@@ -171,6 +207,103 @@ class WindowedJoin:
         return DataStream(j.left.env, t)
 
 
+class ConnectedStreams:
+    """reference: streaming/api/datastream/ConnectedStreams.java."""
+
+    def __init__(self, first: DataStream, second: DataStream):
+        self.first = first
+        self.second = second
+        self._keyed = False
+
+    def key_by(self, first_key: str, second_key: str) -> "ConnectedStreams":
+        c = ConnectedStreams(self.first.key_by(first_key),
+                             self.second.key_by(second_key))
+        c._keyed = True
+        return c
+
+    def process(self, fn, name: str = "co_process") -> DataStream:
+        from flink_tpu.runtime.process import CoProcessOperator
+
+        keyed = self._keyed
+        t = Transformation(
+            name=name, kind="two_input",
+            operator_factory=lambda: CoProcessOperator(fn, keyed=keyed),
+            inputs=[self.first.transformation, self.second.transformation],
+            keyed=keyed)
+        return DataStream(self.first.env, t)
+
+    def map(self, fn1, fn2, name: str = "co_map") -> DataStream:
+        """CoMap: fn1 on the first input's batches, fn2 on the second's."""
+        from flink_tpu.runtime.process import CoProcessFunction
+
+        class _CoMap(CoProcessFunction):
+            def process_batch1(self, batch, ctx):
+                ctx.collect(fn1(batch))
+
+            def process_batch2(self, batch, ctx):
+                ctx.collect(fn2(batch))
+
+        return self.process(_CoMap(), name=name)
+
+
+class BroadcastStream:
+    """Marker wrapper produced by DataStream.broadcast()."""
+
+    def __init__(self, stream: DataStream):
+        self.stream = stream
+
+
+class BroadcastConnectedStream:
+    """reference: streaming/api/datastream/BroadcastConnectedStream.java."""
+
+    def __init__(self, data: DataStream, broadcast: BroadcastStream):
+        self.data = data
+        self.broadcast = broadcast
+
+    def process(self, fn, name: str = "broadcast_process") -> DataStream:
+        from flink_tpu.runtime.process import BroadcastProcessOperator
+
+        keyed = isinstance(self.data, KeyedStream)
+        bt = Transformation(
+            name="broadcast", kind="one_input",
+            operator_factory=lambda: UnionOperator(),
+            inputs=[self.broadcast.stream.transformation], broadcast=True)
+        t = Transformation(
+            name=name, kind="two_input",
+            operator_factory=lambda: BroadcastProcessOperator(fn, keyed=keyed),
+            inputs=[self.data.transformation, bt], keyed=keyed)
+        return DataStream(self.data.env, t)
+
+
+class AsyncDataStream:
+    """reference: streaming/api/datastream/AsyncDataStream.java."""
+
+    @staticmethod
+    def _wait(stream: DataStream, fn, ordered: bool, timeout_ms, capacity,
+              name: str) -> DataStream:
+        from flink_tpu.runtime.async_operator import AsyncWaitOperator
+
+        t = Transformation(
+            name=name, kind="one_input",
+            operator_factory=lambda: AsyncWaitOperator(
+                fn, ordered=ordered, capacity=capacity,
+                timeout_ms=timeout_ms),
+            inputs=[stream.transformation])
+        return DataStream(stream.env, t)
+
+    @staticmethod
+    def ordered_wait(stream: DataStream, fn, timeout_ms: int = None,
+                     capacity: int = 8) -> DataStream:
+        return AsyncDataStream._wait(stream, fn, True, timeout_ms, capacity,
+                                     "async_wait_ordered")
+
+    @staticmethod
+    def unordered_wait(stream: DataStream, fn, timeout_ms: int = None,
+                       capacity: int = 8) -> DataStream:
+        return AsyncDataStream._wait(stream, fn, False, timeout_ms, capacity,
+                                     "async_wait_unordered")
+
+
 class KeyedStream(DataStream):
     def __init__(self, env, transformation, key_field: str):
         super().__init__(env, transformation)
@@ -182,6 +315,47 @@ class KeyedStream(DataStream):
     def interval_join(self, other: "KeyedStream") -> "IntervalJoinBuilder":
         """reference: KeyedStream.intervalJoin / IntervalJoinOperator."""
         return IntervalJoinBuilder(self, other)
+
+    def process(self, fn, name: str = "keyed_process") -> DataStream:
+        """reference: KeyedStream.process ->
+        streaming/api/operators/KeyedProcessOperator.java (state + timers)."""
+        from flink_tpu.runtime.process import ProcessOperator
+
+        capacity = self.env.state_slot_capacity
+        t = Transformation(
+            name=name, kind="one_input",
+            operator_factory=lambda: ProcessOperator(
+                fn, keyed=True, state_capacity=capacity),
+            inputs=[self.transformation], keyed=True,
+            key_field=self.key_field)
+        return DataStream(self.env, t)
+
+    # -- running (unwindowed) keyed aggregates -------------------------------
+    # reference: KeyedStream.sum/min/max/reduce — continuous per-key
+    # aggregation with upsert emission, executed by the same slot-table
+    # GroupAggOperator the SQL layer uses.
+
+    def reduce(self, agg: AggregateFunction, name: str = None) -> DataStream:
+        from flink_tpu.runtime.group_agg import GroupAggOperator
+
+        capacity = self.env.state_slot_capacity
+        key_field = self.key_field
+        t = Transformation(
+            name=name or f"keyed_reduce({type(agg).__name__})",
+            kind="one_input",
+            operator_factory=lambda: GroupAggOperator(
+                agg, key_field, capacity=capacity),
+            inputs=[self.transformation], keyed=True, key_field=key_field)
+        return DataStream(self.env, t)
+
+    def sum(self, field: str) -> DataStream:
+        return self.reduce(SumAggregate(field))
+
+    def max(self, field: str) -> DataStream:
+        return self.reduce(MaxAggregate(field))
+
+    def min(self, field: str) -> DataStream:
+        return self.reduce(MinAggregate(field))
 
 
 class IntervalJoinBuilder:

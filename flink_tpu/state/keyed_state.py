@@ -1,0 +1,256 @@
+"""User-facing keyed state primitives for process functions.
+
+reference: flink-runtime/.../runtime/state/KeyedStateBackend.java
+(getPartitionedState), heap/HeapValueState.java, HeapListState.java,
+HeapMapState.java, HeapReducingState.java; descriptors in
+flink-core/.../api/common/state/StateDescriptor.java.
+
+Batched re-design: where the reference exposes per-key scalar handles bound
+to a "current key" (``setCurrentKey`` before every access —
+AbstractKeyedStateBackend.java), these states expose **vectorized** handles:
+every read/write takes an ``int64`` array of key ids and operates on the
+whole batch at once. Fixed-dtype values (Value/Reducing) live in dense NumPy
+arrays indexed by slot (one ``HostSlotIndex`` shared per operator — the same
+host half used by the device SlotTable); variable-size values (List/Map)
+live in host dicts, which never reach the device.
+
+All states snapshot/restore for checkpointing and are partitioned by key
+group for rescale (key id -> key group is recomputed from the key id, so a
+restore with a different parallelism reassigns transparently).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from flink_tpu.state.slot_table import make_slot_index
+
+_NS = 0  # process-function state has no window namespace
+
+
+@dataclasses.dataclass(frozen=True)
+class ValueStateDescriptor:
+    name: str
+    dtype: Any = np.float64
+    default: Any = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ReducingStateDescriptor:
+    """``reduce`` must be a binary NumPy ufunc-like (np.add, np.maximum, ...)
+    so batch folds stay vectorized (``ufunc.at`` scatter)."""
+
+    name: str
+    reduce: Any = None
+    dtype: Any = np.float64
+    default: Any = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ListStateDescriptor:
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class MapStateDescriptor:
+    name: str
+
+
+class ValueState:
+    """Dense vectorized value-per-key state."""
+
+    def __init__(self, store: "KeyedStateStore", desc: ValueStateDescriptor):
+        self._store = store
+        self.desc = desc
+        self._values = np.full(store.capacity, desc.default,
+                               dtype=np.dtype(desc.dtype))
+
+    def _on_grow(self, old: int, new: int) -> None:
+        grown = np.full(new, self.desc.default, dtype=self._values.dtype)
+        grown[:old] = self._values
+        self._values = grown
+
+    def get(self, key_ids: np.ndarray) -> np.ndarray:
+        return self._values[self._store.slots(key_ids)]
+
+    def put(self, key_ids: np.ndarray, values) -> None:
+        self._values[self._store.slots(key_ids)] = values
+
+    def clear(self, key_ids: np.ndarray) -> None:
+        self._values[self._store.slots(key_ids)] = self.desc.default
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"values": self._values.copy()}
+
+    def restore(self, snap: Dict[str, Any], slot_remap=None) -> None:
+        vals = snap["values"]
+        if slot_remap is not None:
+            self._values[slot_remap[1]] = vals[slot_remap[0]]
+        else:
+            self._values[: len(vals)] = vals
+
+
+class ReducingState(ValueState):
+    def __init__(self, store, desc: ReducingStateDescriptor):
+        super().__init__(store, ValueStateDescriptor(
+            desc.name, desc.dtype, desc.default))
+        self.reduce = desc.reduce if desc.reduce is not None else np.add
+
+    def add(self, key_ids: np.ndarray, values) -> None:
+        """Fold a batch in with one scatter (``ufunc.at`` handles duplicate
+        keys within the batch in order)."""
+        slots = self._store.slots(key_ids)
+        self.reduce.at(self._values, slots, values)
+
+
+class ListState:
+    """Append-log per key; host-resident (variable size never hits HBM)."""
+
+    def __init__(self, store: "KeyedStateStore", desc: ListStateDescriptor):
+        self.desc = desc
+        self._lists: Dict[int, list] = {}
+
+    def add(self, key_ids: np.ndarray, values) -> None:
+        lists = self._lists
+        vals = np.asarray(values)
+        for k, v in zip(np.asarray(key_ids).tolist(), vals.tolist()):
+            lists.setdefault(k, []).append(v)
+
+    def get(self, key_id: int) -> list:
+        return self._lists.get(int(key_id), [])
+
+    def clear(self, key_ids) -> None:
+        for k in np.atleast_1d(np.asarray(key_ids)).tolist():
+            self._lists.pop(int(k), None)
+
+    def keys(self) -> List[int]:
+        return list(self._lists)
+
+    def snapshot(self):
+        return {"lists": {k: list(v) for k, v in self._lists.items()}}
+
+    def restore(self, snap, slot_remap=None):
+        self._lists = {int(k): list(v) for k, v in snap["lists"].items()}
+
+
+class MapState:
+    """Per-key hash map; host-resident."""
+
+    def __init__(self, store: "KeyedStateStore", desc: MapStateDescriptor):
+        self.desc = desc
+        self._maps: Dict[int, dict] = {}
+
+    def put(self, key_id: int, map_key, value) -> None:
+        self._maps.setdefault(int(key_id), {})[map_key] = value
+
+    def get(self, key_id: int, map_key, default=None):
+        return self._maps.get(int(key_id), {}).get(map_key, default)
+
+    def contains(self, key_id: int, map_key) -> bool:
+        return map_key in self._maps.get(int(key_id), {})
+
+    def remove(self, key_id: int, map_key) -> None:
+        self._maps.get(int(key_id), {}).pop(map_key, None)
+
+    def entries(self, key_id: int) -> dict:
+        return self._maps.get(int(key_id), {})
+
+    def clear(self, key_ids) -> None:
+        for k in np.atleast_1d(np.asarray(key_ids)).tolist():
+            self._maps.pop(int(k), None)
+
+    def snapshot(self):
+        return {"maps": {k: dict(v) for k, v in self._maps.items()}}
+
+    def restore(self, snap, slot_remap=None):
+        self._maps = {int(k): dict(v) for k, v in snap["maps"].items()}
+
+
+_STATE_TYPES = {
+    ValueStateDescriptor: ValueState,
+    ReducingStateDescriptor: ReducingState,
+    ListStateDescriptor: ListState,
+    MapStateDescriptor: MapState,
+}
+
+
+class KeyedStateStore:
+    """All keyed states of one operator, sharing one key -> slot index.
+
+    reference: AbstractKeyedStateBackend.java keeps a map of registered
+    states per name; state is addressed (key, namespace, name).
+    """
+
+    def __init__(self, capacity: int = 1 << 12):
+        self._states: Dict[str, Any] = {}
+        self._index = make_slot_index(capacity, on_grow=self._on_grow)
+        self.capacity = self._index.capacity
+        # states are registered lazily (first ctx.state(desc) call), which
+        # can happen after restore — park unclaimed snapshots until then
+        self._pending: Dict[str, Any] = {}
+        self._pending_remap = None
+
+    def _on_grow(self, old: int, new: int) -> None:
+        self.capacity = new
+        for st in self._states.values():
+            if isinstance(st, ValueState):
+                st._on_grow(old, new)
+
+    def slots(self, key_ids: np.ndarray) -> np.ndarray:
+        kid = np.asarray(key_ids, dtype=np.int64)
+        return self._index.lookup_or_insert(
+            kid, np.full(len(kid), _NS, dtype=np.int64))
+
+    def get_state(self, desc):
+        st = self._states.get(desc.name)
+        if st is None:
+            st = _STATE_TYPES[type(desc)](self, desc)
+            self._states[desc.name] = st
+            if desc.name in self._pending:
+                st.restore(self._pending.pop(desc.name),
+                           slot_remap=self._pending_remap)
+        return st
+
+    def known_key_ids(self) -> np.ndarray:
+        """All key ids with a slot (dense states) — for full-table scans."""
+        used = self._index.used_slots()
+        return self._index.slot_key[used]
+
+    def snapshot(self) -> Dict[str, Any]:
+        used = self._index.used_slots()
+        states = {n: s.snapshot() for n, s in self._states.items()}
+        # restored states never re-accessed since restore are still parked in
+        # _pending — carry them forward so a restore -> checkpoint -> restore
+        # cycle keeps them. Dense ("values") snapshots are indexed by the OLD
+        # slot layout; re-home them onto the current layout first.
+        for n, s in self._pending.items():
+            if "values" in s and self._pending_remap is not None:
+                old_slots, new_slots = self._pending_remap
+                vals = np.asarray(s["values"])
+                rehomed = np.zeros(self.capacity, dtype=vals.dtype)
+                rehomed[new_slots] = vals[old_slots]
+                s = {"values": rehomed}
+            states.setdefault(n, s)
+        return {
+            "keys": self._index.slot_key[used].copy(),
+            "slots": used.copy(),
+            "states": states,
+        }
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        keys = np.asarray(snap["keys"], dtype=np.int64)
+        old_slots = np.asarray(snap["slots"])
+        # re-insert keys (fresh slot assignment — rescale-safe), then remap
+        # dense state rows old slot -> new slot
+        new_slots = self.slots(keys)
+        remap = (old_slots, new_slots)
+        self._pending_remap = remap
+        for name, s in snap["states"].items():
+            st = self._states.get(name)
+            if st is not None:
+                st.restore(s, slot_remap=remap)
+            else:
+                self._pending[name] = s
