@@ -99,3 +99,54 @@ class JsonLinesFileSink(Sink):
             return []
         with open(path, encoding="utf-8") as fh:
             return [json.loads(line) for line in fh if line.strip()]
+
+
+class BinaryFileSink(Sink):
+    """Length-prefixed binary batches in the framework's columnar wire
+    format (core/serializers.py RowBatchSerializer) — the compact,
+    schema-carrying counterpart of JsonLinesFileSink. The serializer
+    snapshot is embedded in every file header, so a reader can restore the
+    exact row type (and resolve compatibility) without out-of-band schema.
+    """
+
+    MAGIC = b"FTFS"
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh = None
+        self._ser = None
+
+    def open(self, subtask_index: int = 0) -> None:
+        import os
+
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._fh = open(self.path, "wb")
+
+    def write(self, batch: RecordBatch) -> None:
+        import json
+        import struct
+
+        if self._fh is None:
+            self.open()
+        if self._ser is None:
+            from flink_tpu.core.types import RowTypeInfo
+
+            self._ser = RowTypeInfo.from_batch(batch).create_serializer()
+            header = json.dumps(self._ser.snapshot().to_json()).encode()
+            self._fh.write(self.MAGIC + struct.pack("<I", len(header))
+                           + header)
+        payload = self._ser.serialize(batch)
+        self._fh.write(struct.pack("<Q", len(payload)) + payload)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __getstate__(self):
+        return {"path": self.path}
+
+    def __setstate__(self, state):
+        self.path = state["path"]
+        self._fh = None
+        self._ser = None

@@ -169,3 +169,75 @@ class SocketSource(Source):
     def close(self):
         if self._sock is not None:
             self._sock.close()
+
+
+class BinaryFileSource(Source):
+    """Reads files written by BinaryFileSink. The embedded serializer
+    snapshot restores the writer's exact row type; if a ``row_type`` is
+    given, compatibility is resolved first and batches are migrated when
+    the schema evolved (reference: serializer snapshot compatibility on
+    state restore — flink-core/.../typeutils/TypeSerializerSnapshot.java).
+    """
+
+    def __init__(self, path: str, row_type=None):
+        self.path = path
+        self.row_type = row_type
+        self._fh = None
+        self._ser = None
+        self._snap = None
+        self._migrating = False
+        self._pos = 0
+
+    def open(self, subtask_index: int = 0, parallelism: int = 1) -> None:
+        import json
+        import struct
+
+        from flink_tpu.core.serializers import (
+            Compatibility,
+            SerializerSnapshot,
+        )
+
+        self._fh = open(self.path, "rb")
+        magic = self._fh.read(4)
+        if magic != b"FTFS":
+            raise ValueError(f"{self.path}: not a binary batch file")
+        (hlen,) = struct.unpack("<I", self._fh.read(4))
+        self._snap = SerializerSnapshot.from_json(
+            json.loads(self._fh.read(hlen).decode()))
+        if self.row_type is not None:
+            new_ser = self.row_type.create_serializer()
+            compat = self._snap.resolve_compatibility(new_ser)
+            if compat is Compatibility.INCOMPATIBLE:
+                raise ValueError(
+                    f"{self.path}: written schema is incompatible with the "
+                    f"requested row type")
+            self._ser = new_ser
+            self._migrating = compat is Compatibility.COMPATIBLE_AFTER_MIGRATION
+        else:
+            self._ser = self._snap.restore_serializer()
+        if self._pos:
+            self._fh.seek(self._pos)
+
+    def poll_batch(self, max_records):
+        import struct
+
+        head = self._fh.read(8)
+        if len(head) < 8:
+            return None
+        (plen,) = struct.unpack("<Q", head)
+        payload = self._fh.read(plen)
+        self._pos = self._fh.tell()
+        if self._migrating:
+            return self._ser.migrate(payload, self._snap)
+        return self._ser.deserialize(payload)
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def snapshot_position(self):
+        return {"pos": self._pos}
+
+    def restore_position(self, pos):
+        self._pos = pos["pos"]
