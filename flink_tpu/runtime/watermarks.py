@@ -83,17 +83,32 @@ class WatermarkStrategy:
 class WatermarkValve:
     """Min-merge of per-input watermarks (reference: StatusWatermarkValve.java).
 
-    Emits the combined watermark only when it advances.
+    Emits the combined watermark only when it advances. Idle channels
+    (reference: WatermarkStatus.IDLE — an idle source must not hold back
+    the combined watermark) are excluded from the min until they produce a
+    watermark again.
     """
 
     def __init__(self, num_inputs: int):
         self._wms = [MIN_WATERMARK] * max(num_inputs, 1)
+        self._idle = [False] * max(num_inputs, 1)
         self._combined = MIN_WATERMARK
 
     def advance(self, input_index: int, value: int) -> Optional[int]:
+        self._idle[input_index] = False  # a watermark reactivates the channel
         if value > self._wms[input_index]:
             self._wms[input_index] = value
-        combined = min(self._wms)
+        return self._recompute()
+
+    def mark_idle(self, input_index: int) -> Optional[int]:
+        self._idle[input_index] = True
+        return self._recompute()
+
+    def _recompute(self) -> Optional[int]:
+        active = [w for w, idle in zip(self._wms, self._idle) if not idle]
+        if not active:
+            return None  # all idle: hold the last combined value
+        combined = min(active)
         if combined > self._combined:
             self._combined = combined
             return combined
