@@ -1,0 +1,12 @@
+"""CEP — complex event processing (pattern matching on keyed streams).
+
+reference: flink-libraries/flink-cep (NFA-based pattern matching on keyed
+state + timers; see SURVEY.md §2.2).
+"""
+
+from flink_tpu.cep.nfa import KeyNFA, Match
+from flink_tpu.cep.operator import CEP, CepOperator, PatternStream
+from flink_tpu.cep.pattern import AfterMatchSkipStrategy, Pattern
+
+__all__ = ["AfterMatchSkipStrategy", "CEP", "CepOperator", "KeyNFA",
+           "Match", "Pattern", "PatternStream"]

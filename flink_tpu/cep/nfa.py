@@ -1,0 +1,220 @@
+"""NFA runtime for CEP pattern matching.
+
+reference: flink-cep/.../nfa/NFA.java (946 LoC), ComputationState.java,
+SharedBuffer. The reference threads one event at a time through versioned
+computation states (TAKE / IGNORE / PROCEED transitions) over a shared
+event buffer.
+
+Re-design: conditions were already evaluated batch-wide (a bool matrix
+[events x stages]); the NFA advance loop per key reads only those booleans
+and event timestamps. Partial matches keep indices into a per-key event
+log (the SharedBuffer analog — events stored once, matches reference them).
+
+Semantics kept from the reference:
+- between-stage contiguity: ``next`` (strict — a miss kills the waiting
+  partial) vs ``followedBy`` (relaxed — misses are ignored);
+- loop-internal contiguity of ``times``/``oneOrMore`` is relaxed unless
+  ``consecutive()`` (reference: Quantifier.ConsecutiveStrategy);
+- every event may begin a new match (start state always active), including
+  at stages reachable through an all-optional prefix;
+- a match completes as soon as the remaining suffix is all-optional;
+- ``within`` prunes partials whose span exceeds the window;
+- after-match skip: NO_SKIP emits every combination, SKIP_PAST_LAST_EVENT
+  discards partials and events inside the matched span.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from flink_tpu.cep.pattern import (
+    AfterMatchSkipStrategy,
+    Contiguity,
+    Pattern,
+)
+
+_VIRTUAL = -(1 << 62)  # start_ts marker for the always-active start state
+
+
+@dataclasses.dataclass
+class _Partial:
+    """One computation state (reference: ComputationState.java)."""
+
+    stage: int  # index into pattern.stages
+    count: int  # takes in the current stage
+    events: Tuple[Tuple[int, int], ...]  # (stage_idx, event_log_idx)
+    start_ts: int
+
+    def key(self):
+        return (self.stage, self.count, self.events)
+
+
+@dataclasses.dataclass
+class Match:
+    start_ts: int
+    end_ts: int
+    # stage name -> list of event-log indices
+    events_by_stage: Dict[str, List[int]] = dataclasses.field(
+        default_factory=dict)
+
+
+class KeyNFA:
+    """Per-key NFA instance: event log + live partial matches."""
+
+    def __init__(self, pattern: Pattern):
+        self.pattern = pattern
+        # the SharedBuffer analog: events stored once, referenced by index.
+        # Indices are absolute; the log is compacted by rebasing on _log_base
+        # (prune()) so long-running keys don't grow without bound.
+        self.event_log: List[dict] = []
+        self._log_base = 0
+        self.partials: List[_Partial] = []
+        # suffix_optional[j] == True iff all stages AFTER j are optional
+        n = len(pattern.stages)
+        self._suffix_optional = [True] * n
+        for j in range(n - 2, -1, -1):
+            self._suffix_optional[j] = (
+                self._suffix_optional[j + 1]
+                and pattern.stages[j + 1].min_times == 0)
+
+    def _start_stages(self) -> List[int]:
+        """Stage indices a fresh match may begin at (0 plus the stages behind
+        an all-optional prefix)."""
+        out = [0]
+        for j, st in enumerate(self.pattern.stages[:-1]):
+            if st.min_times == 0:
+                out.append(j + 1)
+            else:
+                break
+        return out
+
+    # -- advance -------------------------------------------------------------
+
+    def advance(self, event: dict, ts: int,
+                stage_hits: List[bool]) -> List[Match]:
+        """Feed one event (with precomputed per-stage condition booleans);
+        returns completed matches."""
+        stages = self.pattern.stages
+        within = self.pattern.within_ms
+        skip_past = (self.pattern.skip
+                     is AfterMatchSkipStrategy.SKIP_PAST_LAST_EVENT)
+
+        log_idx = self._log_base + len(self.event_log)
+        self.event_log.append(event)
+        matches: List[Match] = []
+        new_partials: List[_Partial] = []
+        seen = set()
+
+        def emit(start_ts: int, taken) -> None:
+            by_stage: Dict[str, List[int]] = {}
+            for si, ei in taken:
+                by_stage.setdefault(stages[si].name, []).append(ei)
+            matches.append(Match(start_ts, ts, by_stage))
+
+        def add(p: _Partial) -> None:
+            k = p.key()
+            if k not in seen:
+                seen.add(k)
+                new_partials.append(p)
+
+        candidates = list(self.partials) + [
+            _Partial(j, 0, (), _VIRTUAL) for j in self._start_stages()]
+
+        matched_now = False
+        for p in candidates:
+            virtual = p.start_ts == _VIRTUAL
+            if (not virtual and within is not None
+                    and ts - p.start_ts > within):
+                continue  # timed out (reference: pruning on within)
+            st = stages[p.stage]
+            hit = bool(stage_hits[p.stage])
+            can_take = hit and (st.max_times is None or p.count < st.max_times)
+            if can_take:
+                start_ts = ts if virtual else p.start_ts
+                taken = p.events + ((p.stage, log_idx),)
+                count = p.count + 1
+                if count >= st.min_times and self._suffix_optional[p.stage]:
+                    emit(start_ts, taken)
+                    matched_now = True
+                    if skip_past:
+                        break
+                if st.max_times is None or count < st.max_times:
+                    add(_Partial(p.stage, count, taken, start_ts))
+                if count >= st.min_times:
+                    # PROCEED: wait in the next stage, chaining past any
+                    # optional stages (each may be skipped entirely)
+                    j = p.stage + 1
+                    while j < len(stages):
+                        add(_Partial(j, 0, taken, start_ts))
+                        if stages[j].min_times == 0:
+                            j += 1
+                        else:
+                            break
+                if st.combinations and not virtual and p.count > 0:
+                    add(p)  # allowCombinations: also skip the matching event
+            elif virtual:
+                continue  # a start that doesn't start is nothing
+            elif not hit:
+                if p.count == 0 and st.contiguity is Contiguity.STRICT \
+                        and p.stage > 0:
+                    continue  # 'next' stage missed its immediate event
+                if p.count > 0 and st.consecutive_internal:
+                    continue  # consecutive() loop broken
+                add(p)  # IGNORE: keep waiting (relaxed)
+            else:
+                # hit but the loop is saturated (count == max_times): this
+                # partial only survives via the proceed branch spawned at
+                # its last take
+                continue
+
+        if matched_now and skip_past:
+            # discard every other partial match (the reference's
+            # skipPastLastEvent prunes computation states, NOT future
+            # events — the next event starts fresh); the break above also
+            # kept this event out of any new partial
+            self.partials = []
+            return matches
+        self.partials = new_partials
+        return matches
+
+    def event(self, abs_idx: int) -> dict:
+        return self.event_log[abs_idx - self._log_base]
+
+    def prune(self, watermark: int) -> None:
+        """Drop timed-out partials and compact the event log below the
+        lowest index any live partial still references (the reference
+        SharedBuffer's ref-counting, done as a rebase)."""
+        within = self.pattern.within_ms
+        if within is not None:
+            self.partials = [p for p in self.partials
+                             if watermark - p.start_ts <= within]
+        next_idx = self._log_base + len(self.event_log)
+        if not self.partials:
+            min_ref = next_idx
+        else:
+            min_ref = min(ei for p in self.partials for _, ei in p.events)
+        if min_ref > self._log_base:
+            del self.event_log[: min_ref - self._log_base]
+            self._log_base = min_ref
+
+    @property
+    def empty(self) -> bool:
+        return not self.partials and not self.event_log
+
+    # -- checkpoint ----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "event_log": list(self.event_log),
+            "log_base": self._log_base,
+            "partials": [dataclasses.asdict(p) for p in self.partials],
+        }
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        self.event_log = list(snap["event_log"])
+        self._log_base = snap.get("log_base", 0)
+        self.partials = [
+            _Partial(d["stage"], d["count"],
+                     tuple(tuple(e) for e in d["events"]), d["start_ts"])
+            for d in snap["partials"]]

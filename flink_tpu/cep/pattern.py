@@ -1,0 +1,150 @@
+"""CEP Pattern API.
+
+reference: flink-libraries/flink-cep/.../pattern/Pattern.java (begin/next/
+followedBy/where/times/oneOrMore/optional/within) and
+AfterMatchSkipStrategy.java.
+
+Re-design: conditions are *vectorized* — a condition is a function
+``batch -> bool mask`` evaluated once per micro-batch for all events (the
+expensive part), so the per-event NFA loop only reads precomputed booleans.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from flink_tpu.core.records import RecordBatch
+
+
+class Contiguity(enum.Enum):
+    STRICT = "next"  # reference: Pattern.next
+    RELAXED = "followed_by"  # reference: Pattern.followedBy
+
+
+class AfterMatchSkipStrategy(enum.Enum):
+    """reference: cep/nfa/aftermatch/AfterMatchSkipStrategy.java."""
+
+    NO_SKIP = "no_skip"
+    SKIP_PAST_LAST_EVENT = "skip_past_last_event"
+
+
+@dataclasses.dataclass
+class Stage:
+    name: str
+    condition: Optional[Callable[[RecordBatch], np.ndarray]] = None
+    contiguity: Contiguity = Contiguity.STRICT
+    min_times: int = 1
+    max_times: Optional[int] = 1  # None = unbounded (oneOrMore)
+    # loop-internal contiguity of times()/one_or_more(); the reference
+    # defaults to relaxed, .consecutive() opts into strict
+    consecutive_internal: bool = False
+    # allowCombinations(): a matching event may ALSO be skipped inside the
+    # loop, yielding non-adjacent combinations (reference: followedByAny
+    # internal strategy)
+    combinations: bool = False
+
+    def evaluate(self, batch: RecordBatch) -> np.ndarray:
+        if self.condition is None:
+            return np.ones(len(batch), dtype=bool)
+        return np.asarray(self.condition(batch), dtype=bool)
+
+
+class Pattern:
+    """Fluent pattern builder.
+
+    Example (reference docs' canonical fraud pattern)::
+
+        Pattern.begin("small").where(lambda b: b["amount"] < 1.0) \\
+               .next("big").where(lambda b: b["amount"] > 500.0) \\
+               .within(60_000)
+    """
+
+    def __init__(self, stages: List[Stage], within_ms: Optional[int] = None,
+                 skip: AfterMatchSkipStrategy = AfterMatchSkipStrategy.NO_SKIP):
+        self.stages = stages
+        self.within_ms = within_ms
+        self.skip = skip
+
+    # -- construction --------------------------------------------------------
+
+    @staticmethod
+    def begin(name: str,
+              skip: AfterMatchSkipStrategy = AfterMatchSkipStrategy.NO_SKIP
+              ) -> "Pattern":
+        return Pattern([Stage(name)], skip=skip)
+
+    def next(self, name: str) -> "Pattern":
+        self.stages.append(Stage(name, contiguity=Contiguity.STRICT))
+        return self
+
+    def followed_by(self, name: str) -> "Pattern":
+        self.stages.append(Stage(name, contiguity=Contiguity.RELAXED))
+        return self
+
+    # -- stage modifiers (apply to the LAST stage) ---------------------------
+
+    def where(self, condition: Callable[[RecordBatch], np.ndarray]
+              ) -> "Pattern":
+        st = self.stages[-1]
+        if st.condition is None:
+            st.condition = condition
+        else:  # multiple where() = AND (reference: RichAndCondition)
+            prev = st.condition
+            st.condition = lambda b: (np.asarray(prev(b), dtype=bool)
+                                      & np.asarray(condition(b), dtype=bool))
+        return self
+
+    def or_where(self, condition) -> "Pattern":
+        st = self.stages[-1]
+        prev = st.condition or (lambda b: np.zeros(len(b), dtype=bool))
+        st.condition = lambda b: (np.asarray(prev(b), dtype=bool)
+                                  | np.asarray(condition(b), dtype=bool))
+        return self
+
+    def times(self, n: int, max_n: Optional[int] = None) -> "Pattern":
+        st = self.stages[-1]
+        st.min_times = n
+        st.max_times = n if max_n is None else max_n
+        return self
+
+    def one_or_more(self) -> "Pattern":
+        st = self.stages[-1]
+        st.min_times, st.max_times = 1, None
+        return self
+
+    def allow_combinations(self) -> "Pattern":
+        """reference: Pattern.allowCombinations()."""
+        self.stages[-1].combinations = True
+        return self
+
+    def consecutive(self) -> "Pattern":
+        """reference: Pattern.consecutive() — strict contiguity inside a
+        times()/oneOrMore() loop."""
+        self.stages[-1].consecutive_internal = True
+        return self
+
+    def optional(self) -> "Pattern":
+        self.stages[-1].min_times = 0
+        return self
+
+    def within(self, ms: int) -> "Pattern":
+        self.within_ms = ms
+        return self
+
+    def with_skip_strategy(self, skip: AfterMatchSkipStrategy) -> "Pattern":
+        self.skip = skip
+        return self
+
+    # -- validation ----------------------------------------------------------
+
+    def validate(self) -> "Pattern":
+        names = [s.name for s in self.stages]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate stage names: {names}")
+        if all(s.min_times == 0 for s in self.stages):
+            raise ValueError("pattern cannot be entirely optional")
+        return self

@@ -1,0 +1,259 @@
+"""CEP: pattern API, NFA semantics, keyed end-to-end matching.
+
+Semantics mirrored from the reference's NFAITCase / CEPITCase
+(flink-cep/src/test): strict vs relaxed contiguity, quantifiers, within,
+after-match skip, out-of-order input via watermark buffering.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu import Configuration, RecordBatch, StreamExecutionEnvironment
+from flink_tpu.cep import CEP, AfterMatchSkipStrategy, KeyNFA, Pattern
+from flink_tpu.runtime.watermarks import WatermarkStrategy
+
+
+def _advance_all(pattern, events):
+    """events: list of (ts, row). Returns list of matches as
+    {stage: [values]} dicts using row['v'] as identity."""
+    nfa = KeyNFA(pattern)
+    out = []
+    for ts, row in events:
+        hits = [bool(st.evaluate(RecordBatch.from_pydict(
+            {k: [v] for k, v in row.items()}))[0])
+            for st in pattern.stages]
+        for m in nfa.advance(row, ts, hits):
+            out.append({name: [nfa.event_log[i]["v"] for i in idxs]
+                        for name, idxs in m.events_by_stage.items()})
+    return out
+
+
+def _ev(*vs):
+    return [(i * 10, {"v": v}) for i, v in enumerate(vs)]
+
+
+def is_a(b):
+    return np.char.startswith(np.asarray(b["v"], dtype=str), "a")
+
+
+def is_b(b):
+    return np.char.startswith(np.asarray(b["v"], dtype=str), "b")
+
+
+def is_c(b):
+    return np.char.startswith(np.asarray(b["v"], dtype=str), "c")
+
+
+def test_strict_next_kills_on_gap():
+    p = Pattern.begin("A").where(is_a).next("B").where(is_b)
+    assert _advance_all(p, _ev("a1", "b1")) == [{"A": ["a1"], "B": ["b1"]}]
+    # a gap between a and b breaks strict contiguity
+    assert _advance_all(p, _ev("a1", "c1", "b1")) == []
+
+
+def test_relaxed_followed_by_skips_gaps():
+    p = Pattern.begin("A").where(is_a).followed_by("B").where(is_b)
+    assert _advance_all(p, _ev("a1", "c1", "b1")) == [
+        {"A": ["a1"], "B": ["b1"]}]
+
+
+def test_one_or_more_emits_all_combinations():
+    p = Pattern.begin("A").where(is_a).one_or_more().followed_by("B").where(is_b)
+    got = _advance_all(p, _ev("a1", "a2", "b1"))
+    as_sets = sorted(tuple(m["A"]) for m in got)
+    assert as_sets == [("a1",), ("a1", "a2"), ("a2",)]
+
+
+def test_times_exact():
+    p = Pattern.begin("A").where(is_a).times(2).followed_by("B").where(is_b)
+    got = _advance_all(p, _ev("a1", "a2", "a3", "b1"))
+    as_sets = sorted(tuple(m["A"]) for m in got)
+    # default relaxed contiguity consumes matching events: adjacent pairs
+    # only ({a1,a3} needs allow_combinations — reference default semantics)
+    assert as_sets == [("a1", "a2"), ("a2", "a3")]
+
+
+def test_times_allow_combinations():
+    p = (Pattern.begin("A").where(is_a).times(2).allow_combinations()
+         .followed_by("B").where(is_b))
+    got = _advance_all(p, _ev("a1", "a2", "a3", "b1"))
+    as_sets = sorted(tuple(m["A"]) for m in got)
+    assert as_sets == [("a1", "a2"), ("a1", "a3"), ("a2", "a3")]
+
+
+def test_times_consecutive():
+    p = (Pattern.begin("A").where(is_a).times(2).consecutive()
+         .followed_by("B").where(is_b))
+    got = _advance_all(p, _ev("a1", "c1", "a2", "a3", "b1"))
+    as_sets = sorted(tuple(m["A"]) for m in got)
+    assert as_sets == [("a2", "a3")]
+
+
+def test_optional_middle_stage():
+    p = (Pattern.begin("A").where(is_a)
+         .next("B").where(is_b).optional()
+         .next("C").where(is_c))
+    got = _advance_all(p, _ev("a1", "b1", "c1"))
+    assert {"A": ["a1"], "B": ["b1"], "C": ["c1"]} in got
+    got2 = _advance_all(p, _ev("a1", "c1"))
+    assert got2 == [{"A": ["a1"], "C": ["c1"]}]
+
+
+def test_optional_first_stage_allows_late_start():
+    p = (Pattern.begin("A").where(is_a).optional()
+         .next("B").where(is_b))
+    got = _advance_all(p, _ev("b1"))
+    assert got == [{"B": ["b1"]}]
+
+
+def test_optional_last_stage_completes_early():
+    p = Pattern.begin("A").where(is_a).followed_by("B").where(is_b).optional()
+    got = _advance_all(p, _ev("a1", "b1"))
+    assert {"A": ["a1"]} in got and {"A": ["a1"], "B": ["b1"]} in got
+
+
+def test_within_prunes_old_partials():
+    p = (Pattern.begin("A").where(is_a).followed_by("B").where(is_b)
+         .within(15))
+    # a at ts 0, b at ts 20 -> span 20 > 15: no match
+    assert _advance_all(p, _ev("a1", "c1", "b1")) == []
+    # tighter spacing matches
+    events = [(0, {"v": "a1"}), (10, {"v": "b1"})]
+    assert _advance_all(p, events) == [{"A": ["a1"], "B": ["b1"]}]
+
+
+def test_skip_past_last_event():
+    p = (Pattern.begin("A").where(is_a).followed_by("B").where(is_b)
+         .with_skip_strategy(AfterMatchSkipStrategy.SKIP_PAST_LAST_EVENT))
+    got = _advance_all(p, _ev("a1", "a2", "b1", "b2"))
+    # NO_SKIP would give a1b1, a2b1, a1b2, a2b2; skip-past keeps only the
+    # first completed match and then restarts after it
+    assert sorted(tuple(m["A"]) + tuple(m["B"]) for m in got) == [
+        ("a1", "b1")]
+
+
+def test_single_stage_loop():
+    p = Pattern.begin("A").where(is_a).times(2)
+    got = _advance_all(p, _ev("a1", "a2", "a3"))
+    as_sets = sorted(tuple(m["A"]) for m in got)
+    assert as_sets == [("a1", "a2"), ("a2", "a3")]
+
+
+# ---------------------------------------------------------------- end-to-end
+
+
+def test_cep_end_to_end_keyed_fraud_pattern():
+    # canonical fraud detection: small charge followed by a big charge
+    # within 60s, per card
+    rows = []
+    for i, (card, amount, ts) in enumerate([
+            (1, 0.5, 0), (1, 900.0, 10_000),       # match for card 1
+            (2, 0.4, 5_000), (2, 3.0, 12_000),     # no match (no big)
+            (2, 0.6, 20_000), (2, 700.0, 90_000),  # too far apart -> no match
+            (3, 0.9, 30_000), (3, 600.0, 80_000),  # match for card 3
+    ]):
+        rows.append({"card": card, "amount": amount, "ts": ts})
+
+    p = (Pattern.begin("small").where(lambda b: b["amount"] < 1.0)
+         .followed_by("big").where(lambda b: b["amount"] > 500.0)
+         .within(60_000))
+
+    env = StreamExecutionEnvironment(
+        Configuration({"execution.micro-batch.size": 3}))
+    s = env.from_collection(rows, timestamp_field="ts",
+                            watermark_strategy=WatermarkStrategy
+                            .for_bounded_out_of_orderness(0))
+    out = (CEP.pattern(s.key_by("card"), p)
+           .select(lambda key, m, ev: {
+               "card": key,
+               "small": ev["small"][0]["amount"],
+               "big": ev["big"][0]["amount"]})
+           .execute_and_collect())
+    got = sorted(zip(out["card"].tolist(), out["big"].tolist()))
+    assert got == [(1, 900.0), (3, 600.0)]
+
+
+def test_cep_out_of_order_events_sorted_by_watermark():
+    # b arrives before a in processing order but has later event time
+    rows = [
+        {"k": 1, "v": "b1", "ts": 2000},
+        {"k": 1, "v": "a1", "ts": 1000},
+    ]
+    p = Pattern.begin("A").where(is_a).next("B").where(is_b)
+    env = StreamExecutionEnvironment(
+        Configuration({"execution.micro-batch.size": 10}))
+    s = env.from_collection(
+        rows, timestamp_field="ts",
+        watermark_strategy=WatermarkStrategy.for_bounded_out_of_orderness(
+            5000))
+    out = CEP.pattern(s.key_by("k"), p).select().execute_and_collect()
+    assert len(out) == 1
+    assert out["start_ts"].tolist() == [1000]
+    assert out["end_ts"].tolist() == [2000]
+
+
+def test_cep_operator_snapshot_restore():
+    from flink_tpu.cep.operator import CepOperator
+    from flink_tpu.runtime.operators import OperatorContext
+
+    p = Pattern.begin("A").where(is_a).followed_by("B").where(is_b)
+    op = CepOperator(p, "k")
+    op.open(OperatorContext())
+    b = RecordBatch.from_pydict(
+        {"k": np.array([1, 1]), "v": np.array(["a1", "c1"], dtype=object),
+         "__key_id__": np.array([1, 1], dtype=np.int64)},
+        timestamps=np.array([0, 10], dtype=np.int64))
+    op.process_batch(b)
+    op.process_watermark(10)  # a1 absorbed into a partial
+    snap = op.snapshot_state()
+
+    op2 = CepOperator(p, "k")
+    op2.open(OperatorContext())
+    op2.restore_state(snap)
+    b2 = RecordBatch.from_pydict(
+        {"k": np.array([1]), "v": np.array(["b1"], dtype=object),
+         "__key_id__": np.array([1], dtype=np.int64)},
+        timestamps=np.array([20], dtype=np.int64))
+    op2.process_batch(b2)
+    outs = op2.process_watermark(30)
+    assert len(outs) == 1 and outs[0]["A_count"].tolist() == [1]
+
+
+def test_skip_past_last_event_processes_same_ts_followups():
+    # a2 shares b1's timestamp; skip-past must NOT swallow it (the reference
+    # discards partial matches, not future events)
+    p = (Pattern.begin("A").where(is_a).followed_by("B").where(is_b)
+         .with_skip_strategy(AfterMatchSkipStrategy.SKIP_PAST_LAST_EVENT))
+    events = [(0, {"v": "a1"}), (10, {"v": "b1"}),
+              (10, {"v": "a2"}), (20, {"v": "b2"})]
+    got = _advance_all(p, events)
+    assert sorted(tuple(m["A"]) + tuple(m["B"]) for m in got) == [
+        ("a1", "b1"), ("a2", "b2")]
+
+
+def test_event_log_compaction_bounds_memory():
+    p = Pattern.begin("A").where(is_a).followed_by("B").where(is_b).within(1000)
+    nfa = KeyNFA(p)
+    for i in range(500):
+        ts = i * 100
+        nfa.advance({"v": f"a{i}"}, ts, [True, False])
+        nfa.prune(ts)
+    # within=1000 keeps ~11 live partials; the log must stay proportional
+    assert len(nfa.partials) <= 12
+    assert len(nfa.event_log) <= 12
+
+
+def test_heterogeneous_optional_match_rows_share_schema():
+    p = (Pattern.begin("A").where(is_a).optional().next("B").where(is_b))
+    env = StreamExecutionEnvironment(
+        Configuration({"execution.micro-batch.size": 10}))
+    s = env.from_collection(
+        [{"k": 1, "v": "a1", "ts": 0}, {"k": 1, "v": "b1", "ts": 5},
+         {"k": 1, "v": "b2", "ts": 15}],
+        timestamp_field="ts",
+        watermark_strategy=WatermarkStrategy.for_bounded_out_of_orderness(0))
+    out = CEP.pattern(s.key_by("k"), p).select().execute_and_collect()
+    # matches: [a1 b1], [b1], [b2] — all rows carry A_count and B_count
+    assert "A_count" in out.columns and "B_count" in out.columns
+    assert sorted(zip(out["A_count"].tolist(), out["B_count"].tolist())) == [
+        (0, 1), (0, 1), (1, 1)]
