@@ -103,6 +103,12 @@ class CepOperator(Operator):
                 del self._nfas[k]
         for k in [k for k, v in self._pending.items() if not v]:
             del self._pending[k]
+        # a key's id->value mapping is only needed while it has live NFA
+        # state or buffered events; dropping it with them keeps state (and
+        # checkpoints) bounded for high-cardinality keys
+        for k in [k for k in self._key_values
+                  if k not in self._nfas and k not in self._pending]:
+            del self._key_values[k]
         if not out_rows:
             return []
         out = RecordBatch.from_rows(out_rows).with_timestamps(out_ts)

@@ -77,67 +77,72 @@ class Pattern:
               ) -> "Pattern":
         return Pattern([Stage(name)], skip=skip)
 
+    # Builder methods are persistent: each returns a NEW Pattern (stages are
+    # never mutated in place), so a shared prefix can safely branch into
+    # several derived patterns — the same linked-object semantics as the
+    # reference's Pattern.next/followedBy returning fresh Pattern nodes.
+
+    def _append(self, stage: Stage) -> "Pattern":
+        return Pattern(self.stages + [stage], self.within_ms, self.skip)
+
+    def _amend_last(self, **changes) -> "Pattern":
+        stages = self.stages[:-1] + [
+            dataclasses.replace(self.stages[-1], **changes)]
+        return Pattern(stages, self.within_ms, self.skip)
+
     def next(self, name: str) -> "Pattern":
-        self.stages.append(Stage(name, contiguity=Contiguity.STRICT))
-        return self
+        return self._append(Stage(name, contiguity=Contiguity.STRICT))
 
     def followed_by(self, name: str) -> "Pattern":
-        self.stages.append(Stage(name, contiguity=Contiguity.RELAXED))
-        return self
+        return self._append(Stage(name, contiguity=Contiguity.RELAXED))
 
     # -- stage modifiers (apply to the LAST stage) ---------------------------
 
     def where(self, condition: Callable[[RecordBatch], np.ndarray]
               ) -> "Pattern":
-        st = self.stages[-1]
-        if st.condition is None:
-            st.condition = condition
+        prev = self.stages[-1].condition
+        if prev is None:
+            combined = condition
         else:  # multiple where() = AND (reference: RichAndCondition)
-            prev = st.condition
-            st.condition = lambda b: (np.asarray(prev(b), dtype=bool)
-                                      & np.asarray(condition(b), dtype=bool))
-        return self
+            def combined(b, prev=prev, cond=condition):
+                return (np.asarray(prev(b), dtype=bool)
+                        & np.asarray(cond(b), dtype=bool))
+        return self._amend_last(condition=combined)
 
     def or_where(self, condition) -> "Pattern":
-        st = self.stages[-1]
-        prev = st.condition or (lambda b: np.zeros(len(b), dtype=bool))
-        st.condition = lambda b: (np.asarray(prev(b), dtype=bool)
-                                  | np.asarray(condition(b), dtype=bool))
-        return self
+        prev = (self.stages[-1].condition
+                or (lambda b: np.zeros(len(b), dtype=bool)))
+
+        def combined(b, prev=prev, cond=condition):
+            return (np.asarray(prev(b), dtype=bool)
+                    | np.asarray(cond(b), dtype=bool))
+
+        return self._amend_last(condition=combined)
 
     def times(self, n: int, max_n: Optional[int] = None) -> "Pattern":
-        st = self.stages[-1]
-        st.min_times = n
-        st.max_times = n if max_n is None else max_n
-        return self
+        return self._amend_last(min_times=n,
+                                max_times=n if max_n is None else max_n)
 
     def one_or_more(self) -> "Pattern":
-        st = self.stages[-1]
-        st.min_times, st.max_times = 1, None
-        return self
+        return self._amend_last(min_times=1, max_times=None)
 
     def allow_combinations(self) -> "Pattern":
         """reference: Pattern.allowCombinations()."""
-        self.stages[-1].combinations = True
-        return self
+        return self._amend_last(combinations=True)
 
     def consecutive(self) -> "Pattern":
         """reference: Pattern.consecutive() — strict contiguity inside a
         times()/oneOrMore() loop."""
-        self.stages[-1].consecutive_internal = True
-        return self
+        return self._amend_last(consecutive_internal=True)
 
     def optional(self) -> "Pattern":
-        self.stages[-1].min_times = 0
-        return self
+        return self._amend_last(min_times=0)
 
     def within(self, ms: int) -> "Pattern":
-        self.within_ms = ms
-        return self
+        return Pattern(self.stages, ms, self.skip)
 
     def with_skip_strategy(self, skip: AfterMatchSkipStrategy) -> "Pattern":
-        self.skip = skip
-        return self
+        return Pattern(self.stages, self.within_ms, skip)
 
     # -- validation ----------------------------------------------------------
 

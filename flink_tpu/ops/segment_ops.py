@@ -49,6 +49,20 @@ def pad_bucket_size(n: int, minimum: int = _MIN_BUCKET) -> int:
     return 1 << (int(n - 1).bit_length())
 
 
+def sticky_bucket(n: int, cached: int, minimum: int = _MIN_BUCKET) -> int:
+    """Bucket size reusing a previously-compiled bucket when reasonable.
+
+    Reuses ``cached`` when it covers ``n`` and wastes at most 4x padding —
+    avoiding the recompile ladder as batch sizes ramp up — but falls back to
+    the exact bucket when a past spike would otherwise inflate every later
+    call's padding permanently.
+    """
+    need = pad_bucket_size(n, minimum)
+    if need <= cached <= 4 * need:
+        return cached
+    return need
+
+
 def pad_i32(a: np.ndarray, size: int, fill: int = 0) -> np.ndarray:
     """Pad an int index array up to ``size`` with ``fill`` (slot 0 default)."""
     a = np.asarray(a, dtype=np.int32)

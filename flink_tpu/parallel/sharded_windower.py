@@ -30,7 +30,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
-from flink_tpu.ops.segment_ops import SCATTER_METHOD, MERGE_FN, pad_bucket_size
+from flink_tpu.ops.segment_ops import (
+    SCATTER_METHOD,
+    MERGE_FN,
+    pad_bucket_size,
+    sticky_bucket,
+)
 from flink_tpu.parallel.mesh import KEY_AXIS
 from flink_tpu.parallel.shuffle import bucket_by_shard, shard_records
 from flink_tpu.state.keygroups import assign_key_groups
@@ -106,28 +111,39 @@ class MeshWindowEngine:
             self._scatter_step, self._fire_step, self._reset_step = cached
             return
         mesh = self.mesh
+        leaves = self.agg.leaves
         methods = tuple(SCATTER_METHOD[l.reduce] for l in self.agg.leaves)
         merges = tuple(MERGE_FN[l.reduce] for l in self.agg.leaves)
         idents = tuple(l.identity for l in self.agg.leaves)
         finish = self.agg.finish
         n_leaves = len(self.agg.leaves)
+        n_inputs = len(self.agg.input_leaves)
 
         @partial(jax.jit, donate_argnums=(0,))
         def scatter_step(accs, slots, values):
-            # accs: ([P, cap], ...) sharded; slots: [P, B]; values: ([P, B], ...)
+            # accs: ([P, cap], ...) sharded; slots: [P, B]; values: one
+            # [P, B] block per *input* leaf (const leaves broadcast on device)
             def local(*args):
                 accs_l = args[:n_leaves]          # each [1, cap]
                 slots_l = args[n_leaves]          # [1, B]
-                vals_l = args[n_leaves + 1:]      # each [1, B]
+                vals_l = iter(args[n_leaves + 1:])  # each [1, B]
                 # .at[...].op() returns the full [1, cap] block
-                return tuple(
-                    getattr(a.at[0, slots_l[0]], m)(v[0])
-                    for a, m, v in zip(accs_l, methods, vals_l)
-                )
+                out = []
+                for a, m, l in zip(accs_l, methods, leaves):
+                    if l.const is not None:
+                        # padded lanes target identity slot 0 — keep it pure
+                        v = jnp.where(
+                            slots_l[0] == 0,
+                            jnp.asarray(l.identity, dtype=l.dtype),
+                            jnp.asarray(l.const, dtype=l.dtype))
+                    else:
+                        v = next(vals_l)[0]
+                    out.append(getattr(a.at[0, slots_l[0]], m)(v))
+                return tuple(out)
 
             return jax.shard_map(
                 local, mesh=mesh,
-                in_specs=(P(KEY_AXIS),) * (2 * n_leaves + 1),
+                in_specs=(P(KEY_AXIS),) * (n_leaves + 1 + n_inputs),
                 out_specs=(P(KEY_AXIS),) * n_leaves,
             )(*accs, slots, *values)
 
@@ -197,12 +213,13 @@ class MeshWindowEngine:
         # route to owning shard, bucket into [P, B] blocks
         shards = shard_records(key_ids, self.P, self.max_parallelism)
         values = self.agg.map_input(batch)
+        in_leaves = self.agg.input_leaves
         counts, blocked, order = bucket_by_shard(
             shards, self.P,
             columns=[key_ids, slice_ends,
                      *[np.asarray(v, dtype=l.dtype)
-                       for v, l in zip(values, self.agg.leaves)]],
-            fills=[0, 0, *[l.identity for l in self.agg.leaves]],
+                       for v, l in zip(values, in_leaves)]],
+            fills=[0, 0, *[l.identity for l in in_leaves]],
         )
         key_block, ns_block = blocked[0], blocked[1]
         value_blocks = blocked[2:]
@@ -266,7 +283,8 @@ class MeshWindowEngine:
             w_max = max(w_max, len(keys))
         if w_max == 0:
             return None
-        W = pad_bucket_size(w_max, minimum=64)
+        W = sticky_bucket(w_max, getattr(self, "_fire_bucket", 0), minimum=64)
+        self._fire_bucket = W
         sm = np.zeros((self.P, W, k), dtype=np.int32)
         for p, mat in enumerate(per_shard_mats):
             sm[p, : len(mat)] = mat
@@ -306,7 +324,8 @@ class MeshWindowEngine:
                 f_max = max(f_max, len(slots))
         if f_max == 0:
             return
-        F = pad_bucket_size(f_max)
+        F = sticky_bucket(f_max, getattr(self, "_reset_bucket", 0))
+        self._reset_bucket = F
         block = np.zeros((self.P, F), dtype=np.int32)
         for p, slots in enumerate(freed):
             if slots is not None:

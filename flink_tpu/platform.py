@@ -1,15 +1,44 @@
-"""JAX platform selection helper.
+"""JAX platform selection + compilation-cache setup.
 
 Some environments install a sitecustomize hook that force-registers an
 accelerator backend and sets ``jax_platforms`` via ``jax.config`` at
 interpreter start — which silently overrides the ``JAX_PLATFORMS`` env var.
 ``sync_platform()`` re-asserts the env var (when set) so drivers, benchmarks
 and tests get the backend they asked for.
+
+It also enables JAX's persistent compilation cache (XLA compiles dominate
+cold-start cost on remote/tunneled TPU backends — several seconds per
+program shape). The cache directory defaults to ``.jax_cache`` next to this
+package; override with ``FLINK_TPU_COMPILE_CACHE=<dir>`` or disable with
+``FLINK_TPU_COMPILE_CACHE=off``.
 """
 
 from __future__ import annotations
 
 import os
+
+_cache_enabled = False
+
+
+def enable_compilation_cache() -> None:
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    setting = os.environ.get("FLINK_TPU_COMPILE_CACHE", "")
+    if setting.lower() in ("off", "0", "false", "none"):
+        return
+    cache_dir = setting or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache")
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        _cache_enabled = True
+    except Exception:
+        pass
 
 
 def sync_platform() -> None:
@@ -21,3 +50,4 @@ def sync_platform() -> None:
             jax.config.update("jax_platforms", p)
         except Exception:
             pass
+    enable_compilation_cache()

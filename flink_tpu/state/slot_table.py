@@ -32,7 +32,7 @@ import numpy as np
 
 from flink_tpu.state.keygroups import assign_key_groups
 from flink_tpu.windowing.aggregates import AggregateFunction
-from flink_tpu.ops.segment_ops import pad_bucket_size, pad_i32
+from flink_tpu.ops.segment_ops import pad_bucket_size, pad_i32, sticky_bucket
 
 
 def unique_pairs(
@@ -329,6 +329,13 @@ class SlotTable:
         self.index = make_slot_index(capacity, on_grow=self._grow_device)
         self.accs: Tuple[jnp.ndarray, ...] = agg.init_accumulators(
             self.index.capacity)
+        # buckets are sticky: once a program of bucket B compiled, nearby
+        # smaller batches reuse it instead of compiling a smaller program
+        # (XLA compiles dominate cold cost; padded lanes hit identity slot 0;
+        # sticky_bucket caps the padding waste at 4x)
+        self._fire_bucket = 0
+        self._scatter_bucket = 0
+        self._reset_bucket = 0
 
     # ------------------------------------------------------------------ info
 
@@ -362,7 +369,8 @@ class SlotTable:
         n = len(slots)
         if n == 0:
             return
-        size = pad_bucket_size(n)
+        size = sticky_bucket(n, self._scatter_bucket)
+        self._scatter_bucket = size
         padded_slots = pad_i32(slots, size, fill=0)
         padded_vals = self.agg.pad_input_values(values, size)
         self.accs = self.agg._scatter_jit(self.accs, padded_slots, padded_vals)
@@ -384,7 +392,8 @@ class SlotTable:
         w, k = slot_matrix.shape
         if w == 0:
             return {name: np.empty(0) for name in self.agg.output_names}
-        wp = pad_bucket_size(w, minimum=64)
+        wp = sticky_bucket(w, self._fire_bucket, minimum=64)
+        self._fire_bucket = wp
         padded = np.zeros((wp, k), dtype=np.int32)
         padded[:w] = slot_matrix
         out = self.agg._fire_jit(self.accs, jnp.asarray(padded))
@@ -395,7 +404,8 @@ class SlotTable:
         slots = self.index.free_namespaces(namespaces)
         if slots is None:
             return
-        size = pad_bucket_size(len(slots))
+        size = sticky_bucket(len(slots), self._reset_bucket)
+        self._reset_bucket = size
         self.accs = self.agg._reset_jit(self.accs, pad_i32(slots, size, fill=0))
 
     # ---------------------------------------------------------- snapshot/restore

@@ -35,11 +35,19 @@ from flink_tpu.ops.segment_ops import (
 
 @dataclasses.dataclass(frozen=True)
 class AccLeaf:
-    """One flat component of an accumulator pytree."""
+    """One flat component of an accumulator pytree.
+
+    ``const`` marks a leaf whose per-record input value is a compile-time
+    constant (e.g. the ``1`` of COUNT): no host value array is built or
+    transferred for it — the scatter kernel broadcasts the constant on
+    device. Padded lanes target the reserved identity slot 0, so the
+    constant contribution of padding never reaches a live accumulator.
+    """
 
     name: str
     dtype: np.dtype
     reduce: str  # 'sum' | 'max' | 'min'
+    const: object = None
 
     def __post_init__(self):
         object.__setattr__(self, "dtype", np.dtype(self.dtype))
@@ -102,18 +110,38 @@ class AggregateFunction:
         )
 
     @property
+    def input_leaves(self) -> Tuple[AccLeaf, ...]:
+        """Leaves that take a per-record host value array (``const is None``)."""
+        return tuple(l for l in self.leaves if l.const is None)
+
+    @property
     def _scatter_jit(self):
         methods = tuple(SCATTER_METHOD[l.reduce] for l in self.leaves)
-        key = ("scatter", methods, tuple(l.dtype.str for l in self.leaves))
+        consts = tuple(
+            None if l.const is None else (l.const, l.dtype.str)
+            for l in self.leaves)
+        key = ("scatter", methods, consts,
+               tuple(l.dtype.str for l in self.leaves))
         fn = _JIT_CACHE.get(key)
         if fn is None:
+            leaves = self.leaves
 
             @partial(jax.jit, donate_argnums=(0,))
             def scatter(accs, slots, values):
-                return tuple(
-                    getattr(a.at[slots], m)(v)
-                    for a, m, v in zip(accs, methods, values)
-                )
+                vit = iter(values)
+                out = []
+                for a, m, l in zip(accs, methods, leaves):
+                    if l.const is not None:
+                        # padded lanes target the reserved slot 0, which
+                        # must stay identity (fires read it for missing
+                        # slices) — mask the const there
+                        v = jnp.where(slots == 0,
+                                      jnp.asarray(l.identity, dtype=l.dtype),
+                                      jnp.asarray(l.const, dtype=l.dtype))
+                    else:
+                        v = next(vit)
+                    out.append(getattr(a.at[slots], m)(v))
+                return tuple(out)
 
             _JIT_CACHE[key] = fn = scatter
         return fn
@@ -158,9 +186,11 @@ class AggregateFunction:
     def pad_input_values(
         self, values: Sequence[np.ndarray], size: int
     ) -> Tuple[np.ndarray, ...]:
+        """Pad the value arrays of the non-const leaves (``map_input`` returns
+        one array per *input* leaf; const leaves are broadcast on device)."""
         return tuple(
             pad_values(np.asarray(v, dtype=l.dtype), size, l.identity)
-            for v, l in zip(values, self.leaves)
+            for v, l in zip(values, self.input_leaves)
         )
 
 
@@ -184,11 +214,11 @@ class SumAggregate(AggregateFunction):
 
 class CountAggregate(AggregateFunction):
     def __init__(self, output: str = "count"):
-        self.leaves = (AccLeaf("count", np.int32, "sum"),)
+        self.leaves = (AccLeaf("count", np.int32, "sum", const=1),)
         self.output_names = (output,)
 
     def map_input(self, batch):
-        return (np.ones(len(batch), dtype=np.int32),)
+        return ()
 
     def finish(self, merged):
         return {self.output_names[0]: merged[0]}
@@ -225,13 +255,12 @@ class AvgAggregate(AggregateFunction):
         self.field = field
         self.leaves = (
             AccLeaf("sum", np.float32, "sum"),
-            AccLeaf("count", np.float32, "sum"),
+            AccLeaf("count", np.float32, "sum", const=1.0),
         )
         self.output_names = (output or f"avg_{field}",)
 
     def map_input(self, batch):
-        v = batch[self.field]
-        return (v, np.ones(len(batch), dtype=np.float32))
+        return (batch[self.field],)
 
     def finish(self, merged):
         s, c = merged
@@ -250,7 +279,8 @@ class MultiAggregate(AggregateFunction):
         for i, a in enumerate(self.aggs):
             start = len(leaves)
             leaves.extend(
-                AccLeaf(f"a{i}_{l.name}", l.dtype, l.reduce) for l in a.leaves
+                AccLeaf(f"a{i}_{l.name}", l.dtype, l.reduce, l.const)
+                for l in a.leaves
             )
             self._spans.append((start, len(leaves)))
             outs.extend(a.output_names)

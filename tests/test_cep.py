@@ -257,3 +257,20 @@ def test_heterogeneous_optional_match_rows_share_schema():
     assert "A_count" in out.columns and "B_count" in out.columns
     assert sorted(zip(out["A_count"].tolist(), out["B_count"].tolist())) == [
         (0, 1), (0, 1), (1, 1)]
+
+
+def test_pattern_builder_is_persistent():
+    """A shared prefix must branch into independent patterns (reference:
+    Pattern.next returns a new linked Pattern, never mutates the receiver)."""
+    base = Pattern.begin("a").where(lambda b: b["x"] > 0)
+    p1 = base.next("b")
+    p2 = base.followed_by("c").within(500)
+    assert [s.name for s in base.stages] == ["a"]
+    assert [s.name for s in p1.stages] == ["a", "b"]
+    assert [s.name for s in p2.stages] == ["a", "c"]
+    assert base.within_ms is None and p1.within_ms is None
+    assert p2.within_ms == 500
+    # stage modifiers don't leak across branches either
+    p3 = p1.times(3)
+    assert p1.stages[-1].min_times == 1
+    assert p3.stages[-1].min_times == 3

@@ -125,3 +125,54 @@ def test_snapshot_restore_key_group_filter():
     groups = assign_key_groups(keys, 16)
     expected = int((np.isin(groups, list(owned))).sum())
     assert t2.num_used == expected
+
+
+def test_const_leaf_keeps_slot0_identity():
+    """COUNT's const-1 input must not pollute the reserved identity slot 0:
+    padded scatter lanes target slot 0, and fire matrices read slot 0 for
+    missing slices — it must stay at the identity element."""
+    import jax.numpy as jnp
+
+    agg = MultiAggregate([CountAggregate(), SumAggregate("v")])
+    t = SlotTable(agg, capacity=1024)
+    keys = np.array([7, 8, 7], dtype=np.int64)
+    ns = np.array([100, 100, 100], dtype=np.int64)
+    slots = t.lookup_or_insert(keys, ns)
+    # scatter pads to a 256 bucket -> 253 padded lanes target slot 0
+    t.scatter(slots, agg.map_input(make_batch(keys, [1.0, 2.0, 3.0])))
+    assert int(np.asarray(t.accs[0])[0]) == 0  # count leaf identity
+    assert float(np.asarray(t.accs[1])[0]) == 0.0
+    # fire with a missing-slice column (slot 0) must not inflate counts
+    s = t.slots_for_namespace(100)
+    matrix = np.zeros((len(s), 2), dtype=np.int32)
+    matrix[:, 0] = s
+    res = t.fire(matrix)
+    by_key = dict(zip(t.keys_of_slots(s).tolist(), res["count"].tolist()))
+    assert by_key == {7: 2, 8: 1}
+
+
+def test_avg_aggregate_const_count():
+    agg = AvgAggregate("v")
+    t = SlotTable(agg, capacity=1024)
+    keys = np.array([1, 1, 2], dtype=np.int64)
+    ns = np.array([5, 5, 5], dtype=np.int64)
+    slots = t.lookup_or_insert(keys, ns)
+    t.scatter(slots, agg.map_input(make_batch(keys, [2.0, 4.0, 10.0])))
+    s = t.slots_for_namespace(5)
+    res = t.fire(s[:, None])
+    by_key = dict(zip(t.keys_of_slots(s).tolist(), res["avg_v"].tolist()))
+    assert by_key == {1: 3.0, 2: 10.0}
+
+
+def test_monotonic_fire_bucket_reuses_shape():
+    agg = SumAggregate("v")
+    t = SlotTable(agg, capacity=4096)
+    keys = np.arange(1, 201, dtype=np.int64)
+    ns = np.full(200, 1, dtype=np.int64)
+    slots = t.lookup_or_insert(keys, ns)
+    t.scatter(slots, (np.ones(200, dtype=np.float32),))
+    t.fire(slots[:, None])            # bucket -> 256
+    assert t._fire_bucket == 256
+    small = t.fire(slots[:3][:, None])  # smaller fire reuses the 256 bucket
+    assert t._fire_bucket == 256
+    assert len(small["sum_v"]) == 3
