@@ -35,6 +35,134 @@ class CheckpointMetadata:
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
+# --------------------------------------------------------------------------
+# Directory-level snapshot IO (shared by periodic checkpoints, savepoints and
+# the state processor API). A snapshot directory is self-contained:
+# manifest.json + one .npz / .meta.pkl pair per stateful operator.
+# --------------------------------------------------------------------------
+
+
+def _split_state(state: Dict[str, Any]):
+    """Separate flat numpy arrays (npz-able) from pickled host metadata."""
+    arrays: Dict[str, np.ndarray] = {}
+
+    def walk(prefix: str, obj: Any):
+        if isinstance(obj, np.ndarray) and obj.dtype != object:
+            arrays[prefix] = obj
+        elif isinstance(obj, dict) and all(isinstance(k, str) for k in obj):
+            sub_meta = {}
+            for k, v in obj.items():
+                r = walk(f"{prefix}.{k}" if prefix else k, v)
+                if r is not None:
+                    sub_meta[k] = r
+            if sub_meta:
+                return sub_meta
+            return None
+        else:
+            return obj
+        return None
+
+    m = walk("", state)
+    meta = m if isinstance(m, dict) else {}
+    return arrays, {"meta": meta}
+
+
+def _set_path(d: Dict[str, Any], dotted: str, value: Any) -> None:
+    parts = dotted.split(".")
+    cur = d
+    for p in parts[:-1]:
+        cur = cur.setdefault(p, {})
+    cur[parts[-1]] = value
+
+
+def _merge(dst: Dict[str, Any], src: Dict[str, Any]) -> None:
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _merge(dst[k], v)
+        else:
+            dst[k] = v
+
+
+def write_snapshot_dir(final_dir: str, checkpoint_id: int, job_name: str,
+                       operator_states: Dict[str, Dict[str, Any]],
+                       extra: Optional[Dict[str, Any]] = None) -> str:
+    """Write a self-contained snapshot directory (tmp + atomic rename).
+
+    An existing target is replaced only if it is itself a snapshot directory
+    (manifest.json present) or empty — never an arbitrary user directory.
+    """
+    if os.path.exists(final_dir) and os.listdir(final_dir) and \
+            not os.path.exists(os.path.join(final_dir, "manifest.json")):
+        raise FileExistsError(
+            f"refusing to replace non-snapshot directory {final_dir!r}")
+    parent = os.path.dirname(os.path.abspath(final_dir)) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp_dir = tempfile.mkdtemp(
+        prefix=f".snap-{checkpoint_id}-", dir=parent)
+    try:
+        uids = []
+        for uid, state in operator_states.items():
+            uids.append(uid)
+            arrays, meta = _split_state(state)
+            if arrays:
+                np.savez(os.path.join(tmp_dir, f"op-{uid}.npz"), **arrays)
+            with open(os.path.join(tmp_dir, f"op-{uid}.meta.pkl"), "wb") as f:
+                pickle.dump(meta, f)
+        manifest = CheckpointMetadata(
+            checkpoint_id=checkpoint_id,
+            timestamp_ms=int(time.time() * 1000),
+            job_name=job_name,
+            operator_states=uids,
+            extra=extra or {})
+        with open(os.path.join(tmp_dir, "manifest.json"), "w") as f:
+            json.dump(dataclasses.asdict(manifest), f)
+        if os.path.exists(final_dir):
+            shutil.rmtree(final_dir)
+        os.rename(tmp_dir, final_dir)
+        return final_dir
+    except BaseException:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        raise
+
+
+def read_manifest(snapshot_dir: str) -> Dict[str, Any]:
+    with open(os.path.join(snapshot_dir, "manifest.json")) as f:
+        return json.load(f)
+
+
+def read_snapshot_dir(snapshot_dir: str) -> Dict[str, Dict[str, Any]]:
+    """Read a snapshot directory back into operator-uid -> state dicts."""
+    manifest = read_manifest(snapshot_dir)
+    out: Dict[str, Dict[str, Any]] = {}
+    for uid in manifest["operator_states"]:
+        state: Dict[str, Any] = {}
+        npz_path = os.path.join(snapshot_dir, f"op-{uid}.npz")
+        if os.path.exists(npz_path):
+            with np.load(npz_path, allow_pickle=False) as z:
+                for k in z.files:
+                    _set_path(state, k, z[k])
+        with open(os.path.join(snapshot_dir, f"op-{uid}.meta.pkl"), "rb") as f:
+            meta = pickle.load(f)["meta"]
+        _merge(state, meta)
+        out[uid] = state
+    return out
+
+
+def resolve_snapshot_dir(path: str) -> str:
+    """Accept either a self-contained snapshot dir (savepoint / single
+    checkpoint) or a checkpoint root holding chk-N children (newest wins)."""
+    if os.path.exists(os.path.join(path, "manifest.json")):
+        return path
+    ids = [int(n[4:]) for n in os.listdir(path)
+           if n.startswith("chk-") and n[4:].isdigit()] if os.path.isdir(
+               path) else []
+    if ids:
+        return os.path.join(path, f"chk-{max(ids)}")
+    raise RuntimeError(
+        f"no checkpoint or savepoint found at {path!r} (expected "
+        "manifest.json or chk-N subdirectories)")
+
+
 class CheckpointStorage:
     """Directory-per-checkpoint layout:
 
@@ -56,79 +184,13 @@ class CheckpointStorage:
     def write_checkpoint(self, checkpoint_id: int, job_name: str,
                          operator_states: Dict[str, Dict[str, Any]],
                          extra: Optional[Dict[str, Any]] = None) -> str:
-        final_dir = self._dir(checkpoint_id)
-        tmp_dir = tempfile.mkdtemp(prefix=f".chk-{checkpoint_id}-", dir=self.root)
-        try:
-            uids = []
-            for uid, state in operator_states.items():
-                uids.append(uid)
-                arrays, meta = self._split_state(state)
-                if arrays:
-                    np.savez(os.path.join(tmp_dir, f"op-{uid}.npz"), **arrays)
-                with open(os.path.join(tmp_dir, f"op-{uid}.meta.pkl"), "wb") as f:
-                    pickle.dump(meta, f)
-            manifest = CheckpointMetadata(
-                checkpoint_id=checkpoint_id,
-                timestamp_ms=int(time.time() * 1000),
-                job_name=job_name,
-                operator_states=uids,
-                extra=extra or {})
-            with open(os.path.join(tmp_dir, "manifest.json"), "w") as f:
-                json.dump(dataclasses.asdict(manifest), f)
-            if os.path.exists(final_dir):
-                shutil.rmtree(final_dir)
-            os.rename(tmp_dir, final_dir)
-            return final_dir
-        except BaseException:
-            shutil.rmtree(tmp_dir, ignore_errors=True)
-            raise
-
-    @staticmethod
-    def _split_state(state: Dict[str, Any]):
-        """Separate flat numpy arrays (npz-able) from pickled host metadata."""
-        arrays: Dict[str, np.ndarray] = {}
-        meta: Dict[str, Any] = {}
-
-        def walk(prefix: str, obj: Any):
-            if isinstance(obj, np.ndarray) and obj.dtype != object:
-                arrays[prefix] = obj
-            elif isinstance(obj, dict) and all(isinstance(k, str) for k in obj):
-                sub_meta = {}
-                for k, v in obj.items():
-                    r = walk(f"{prefix}.{k}" if prefix else k, v)
-                    if r is not None:
-                        sub_meta[k] = r
-                if sub_meta:
-                    return sub_meta
-                return None
-            else:
-                return obj
-            return None
-
-        m = walk("", state)
-        if isinstance(m, dict):
-            meta = m
-        return arrays, {"meta": meta}
+        return write_snapshot_dir(self._dir(checkpoint_id), checkpoint_id,
+                                  job_name, operator_states, extra)
 
     # ------------------------------------------------------------------- read
 
     def read_checkpoint(self, checkpoint_id: int) -> Dict[str, Dict[str, Any]]:
-        d = self._dir(checkpoint_id)
-        with open(os.path.join(d, "manifest.json")) as f:
-            manifest = json.load(f)
-        out: Dict[str, Dict[str, Any]] = {}
-        for uid in manifest["operator_states"]:
-            state: Dict[str, Any] = {}
-            npz_path = os.path.join(d, f"op-{uid}.npz")
-            if os.path.exists(npz_path):
-                with np.load(npz_path, allow_pickle=False) as z:
-                    for k in z.files:
-                        self._set_path(state, k, z[k])
-            with open(os.path.join(d, f"op-{uid}.meta.pkl"), "rb") as f:
-                meta = pickle.load(f)["meta"]
-            self._merge(state, meta)
-            out[uid] = state
-        return out
+        return read_snapshot_dir(self._dir(checkpoint_id))
 
     def latest_checkpoint_id(self) -> Optional[int]:
         ids = []
@@ -155,18 +217,3 @@ class CheckpointStorage:
     def _dir(self, checkpoint_id: int) -> str:
         return os.path.join(self.root, f"chk-{checkpoint_id}")
 
-    @staticmethod
-    def _set_path(d: Dict[str, Any], dotted: str, value: Any) -> None:
-        parts = dotted.split(".")
-        cur = d
-        for p in parts[:-1]:
-            cur = cur.setdefault(p, {})
-        cur[parts[-1]] = value
-
-    @staticmethod
-    def _merge(dst: Dict[str, Any], src: Dict[str, Any]) -> None:
-        for k, v in src.items():
-            if isinstance(v, dict) and isinstance(dst.get(k), dict):
-                CheckpointStorage._merge(dst[k], v)
-            else:
-                dst[k] = v

@@ -53,12 +53,45 @@ class JobCancelledError(RuntimeError):
     """Raised inside the task loop when the job is cancelled externally."""
 
 
+class SavepointRequest:
+    """A user-triggered savepoint (optionally stop-with-savepoint).
+
+    reference: CheckpointCoordinator.triggerSavepoint + the
+    stop-with-savepoint flow (runtime/scheduler/stopwithsavepoint/*).
+    Served by the task loop at a batch boundary — the structurally aligned
+    barrier point of the micro-batch engine.
+    """
+
+    def __init__(self, path: str, stop: bool = False, drain: bool = False):
+        import threading
+
+        self.path = path
+        self.stop = stop
+        self.drain = drain
+        self.result_path: Optional[str] = None
+        self.error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+    def finish(self, path: Optional[str], error=None) -> None:
+        self.result_path = path
+        self.error = error
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> str:
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"savepoint {self.path!r} not completed")
+        if self.error is not None:
+            raise self.error
+        return self.result_path
+
+
 class LocalExecutor:
     def __init__(self, config: Optional[Configuration] = None):
         self.config = config or Configuration()
 
     def run(self, graph: StreamGraph, job_name: str = "job",
-            restore_from: Optional[str] = None, cancel_event=None):
+            restore_from: Optional[str] = None, cancel_event=None,
+            restore_mode="no-claim", control_queue=None):
         """Execute the graph to completion.
 
         Checkpointing: between two source polls the whole dataflow is
@@ -128,16 +161,26 @@ class LocalExecutor:
             generators[t.uid] = t.watermark_strategy.create()
 
         checkpoint_count = 0
+        claimed = None
         if restore_from is not None:
-            from flink_tpu.checkpoint.storage import CheckpointStorage
+            from flink_tpu.checkpoint.savepoint import prepare_restore
+            from flink_tpu.checkpoint.storage import (
+                read_manifest,
+                read_snapshot_dir,
+            )
 
-            rstore = CheckpointStorage(restore_from)
-            latest = rstore.latest_checkpoint_id()
-            if latest is None:
-                raise RuntimeError(f"no checkpoint found in {restore_from}")
-            states = rstore.read_checkpoint(latest)
+            snap_dir, claimed = prepare_restore(
+                restore_from, restore_mode, own_checkpoint_root=ckpt_dir)
+            states = read_snapshot_dir(snap_dir)
             self._restore_all(graph, nodes, states)
-            checkpoint_count = latest
+            checkpoint_count = int(read_manifest(snap_dir)["checkpoint_id"])
+            if storage is not None:
+                # the checkpoint root may hold higher-numbered checkpoints
+                # from an abandoned timeline (restore from an older
+                # savepoint): keep ids monotonic so new checkpoints
+                # supersede the stale ones instead of being retain()-ed away
+                checkpoint_count = max(
+                    checkpoint_count, storage.latest_checkpoint_id() or 0)
 
         t0 = time.perf_counter()
         total_records = 0
@@ -180,22 +223,50 @@ class LocalExecutor:
                                 "checkpoint",
                                 f"checkpoint-{checkpoint_count}") as sp:
                             snap = self.snapshot_all(graph, nodes)
-                            storage.write_checkpoint(
+                            new_dir = storage.write_checkpoint(
                                 checkpoint_count, job_name, snap)
                             sp.set_attribute("checkpointId", checkpoint_count)
+                        if claimed is not None:
+                            claimed.on_checkpoint_complete(new_dir)
                         storage.retain(
                             self.config.get(CheckpointOptions.RETAINED))
                         last_ckpt = time.time() * 1000
                         batches_since_ckpt = 0
+                if control_queue is not None:
+                    stopped = self._serve_control(
+                        control_queue, graph, nodes, sources, active,
+                        job_name, checkpoint_count, traces)
+                    if stopped is not None:
+                        suppress_final_drain = not stopped.drain
+                        savepoint_path = stopped.result_path
+                        break
                 if not progressed and active:
                     time.sleep(0.001)
+            else:
+                suppress_final_drain = False
+                savepoint_path = None
 
-            # drain/close in topological order
-            for t in graph.nodes:
-                node = nodes[t.uid]
-                if node.operator is not None:
-                    for out in node.operator.close():
-                        self._forward(node, out)
+            # drain/close in topological order (skipped for
+            # stop-with-savepoint without --drain: state was saved, in-flight
+            # windows intentionally not fired — they resume from the
+            # savepoint)
+            if not suppress_final_drain:
+                for t in graph.nodes:
+                    node = nodes[t.uid]
+                    if node.operator is not None:
+                        for out in node.operator.close():
+                            self._forward(node, out)
+            else:
+                # no-drain stop still releases resources and flushes sinks —
+                # dispose() never emits (reference: Task releaseResources)
+                for node in nodes.values():
+                    if node.operator is not None:
+                        try:
+                            node.operator.dispose()
+                        except Exception:
+                            pass
+            self._fail_pending_controls(
+                control_queue, f"job {job_name!r} already terminated")
         except BaseException:
             # failure/cancel path: release resources without emitting
             # (reference: Task.doRun finally -> cancel + releaseResources)
@@ -210,6 +281,8 @@ class LocalExecutor:
                         node.operator.dispose()
                     except Exception:
                         pass
+            self._fail_pending_controls(
+                control_queue, f"job {job_name!r} terminated abnormally")
             raise
 
         elapsed = time.perf_counter() - t0
@@ -223,6 +296,7 @@ class LocalExecutor:
             "runtime_s": elapsed,
             "records_per_s": total_records / elapsed if elapsed > 0 else 0.0,
             "checkpoints": checkpoint_count,
+            **({"savepoint": savepoint_path} if savepoint_path else {}),
             "per_operator": {
                 f"{n.transformation.name}#{uid}": {
                     "records_in": n.records_in, "records_out": n.records_out}
@@ -243,6 +317,68 @@ class LocalExecutor:
         result.registry = registry
         result.traces = traces
         return result
+
+    # -------------------------------------------------------------- control
+
+    def _serve_control(self, control_queue, graph, nodes, sources, active,
+                       job_name: str, checkpoint_id: int, traces):
+        """Serve pending SavepointRequests at a batch boundary. Returns the
+        request if it asked the job to stop, else None."""
+        import queue as _queue
+
+        from flink_tpu.checkpoint.savepoint import write_savepoint
+
+        from flink_tpu.checkpoint.savepoint import check_savepoint_target
+
+        while True:
+            try:
+                req = control_queue.get_nowait()
+            except _queue.Empty:
+                return None
+            try:
+                # fail fast on a bad target BEFORE any irreversible action
+                # (closing sources / draining): a savepoint that cannot be
+                # written must leave the job running (reference semantics)
+                check_savepoint_target(req.path)
+                if req.stop and req.drain:
+                    # --drain: flush every window/timer downstream before
+                    # the snapshot so results are final (reference:
+                    # stop-with-savepoint advanceToEndOfEventTime)
+                    for t, node in sources:
+                        if t.uid in active:
+                            self._emit_watermark(node, MAX_WATERMARK)
+                            t.source.close()
+                    active.clear()
+                with traces.span("savepoint", req.path):
+                    snap = self.snapshot_all(graph, nodes)
+                    path = write_savepoint(req.path, job_name, snap,
+                                           checkpoint_id=checkpoint_id)
+                if req.stop and not req.drain:
+                    for t, node in sources:
+                        if t.uid in active:
+                            t.source.close()
+                    active.clear()
+                req.finish(path)
+            except BaseException as e:  # noqa: BLE001 - reported to caller
+                req.finish(None, e)
+                continue
+            if req.stop:
+                return req
+
+    @staticmethod
+    def _fail_pending_controls(control_queue, reason: str) -> None:
+        """Complete any still-queued control requests so clients don't block
+        on a job that already terminated."""
+        if control_queue is None:
+            return
+        import queue as _queue
+
+        while True:
+            try:
+                req = control_queue.get_nowait()
+            except _queue.Empty:
+                return
+            req.finish(None, RuntimeError(reason))
 
     # ------------------------------------------------------------- plumbing
 

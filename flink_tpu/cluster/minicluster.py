@@ -67,9 +67,12 @@ class TaskExecutorEndpoint(RpcEndpoint):
 
     def submit_task(self, execution_id: str, graph, config_dict: dict,
                     job_name: str, restore_from: Optional[str]) -> str:
+        import queue
+
         cancel = threading.Event()
+        control: "queue.Queue" = queue.Queue()
         record = {"status": RUNNING, "cancel": cancel, "result": None,
-                  "error": None, "alive": True}
+                  "error": None, "alive": True, "control": control}
         self._tasks[execution_id] = record
         self._prune_finished()
 
@@ -78,7 +81,8 @@ class TaskExecutorEndpoint(RpcEndpoint):
                 executor = LocalExecutor(Configuration(config_dict))
                 result = executor.run(graph, job_name=job_name,
                                       restore_from=restore_from,
-                                      cancel_event=cancel)
+                                      cancel_event=cancel,
+                                      control_queue=control)
                 # store only the slim wire view: the live result's registry
                 # gauges close over the whole operator DAG (device buffers,
                 # native slot maps) and must not outlive the attempt
@@ -89,6 +93,17 @@ class TaskExecutorEndpoint(RpcEndpoint):
             except BaseException as e:  # noqa: BLE001 - reported to master
                 record["error"] = e
                 record["status"] = FAILED
+            finally:
+                # a savepoint request racing with termination must not hang
+                # its client: fail anything still queued or newly enqueued
+                # between the executor's own drain and the status flip
+                while True:
+                    try:
+                        req = control.get_nowait()
+                    except queue.Empty:
+                        break
+                    req.finish(None, RuntimeError(
+                        f"task {execution_id} already terminated"))
 
         t = threading.Thread(target=run, name=f"task-{execution_id}",
                              daemon=True)
@@ -107,6 +122,36 @@ class TaskExecutorEndpoint(RpcEndpoint):
         rec = self._tasks.get(execution_id)
         if rec is not None:
             rec["cancel"].set()
+
+    def trigger_savepoint(self, execution_id: str, path: str,
+                          stop: bool = False, drain: bool = False) -> str:
+        """Enqueue a savepoint (optionally stop-with-savepoint) for the
+        task's next batch boundary; returns a request id to poll with
+        ``savepoint_status`` (reference: TaskExecutor triggerCheckpoint RPC
+        is async too — the ack arrives later). Non-blocking so the endpoint
+        main thread stays responsive to heartbeats."""
+        import uuid as _uuid
+
+        from flink_tpu.cluster.local_executor import SavepointRequest
+
+        rec = self._tasks.get(execution_id)
+        if rec is None or rec["status"] != RUNNING:
+            raise RuntimeError(
+                f"no running task {execution_id!r} to savepoint")
+        req = SavepointRequest(path, stop=stop, drain=drain)
+        request_id = _uuid.uuid4().hex[:12]
+        rec.setdefault("savepoints", {})[request_id] = req
+        rec["control"].put(req)
+        return request_id
+
+    def savepoint_status(self, execution_id: str, request_id: str) -> dict:
+        rec = self._tasks.get(execution_id)
+        req = (rec or {}).get("savepoints", {}).get(request_id)
+        if req is None:
+            raise RuntimeError(f"unknown savepoint request {request_id!r}")
+        if not req._done.is_set():
+            return {"done": False}
+        return {"done": True, "path": req.result_path, "error": req.error}
 
     def task_status(self, execution_id: str) -> dict:
         rec = self._tasks.get(execution_id)
@@ -231,6 +276,8 @@ class JobMasterThread:
         self._thread = threading.Thread(
             target=self._run, name=f"jobmaster-{job_id}", daemon=True)
         self._current_executor: Optional[str] = None
+        self._current_address: Optional[str] = None
+        self._current_execution_id: Optional[str] = None
         self._thread.start()
 
     # -- supervision loop ---------------------------------------------------
@@ -258,7 +305,9 @@ class JobMasterThread:
                 self.error = RuntimeError("no slots available")
                 return
             self._current_executor = slot["executor_id"]
+            self._current_address = slot["address"]
             execution_id = f"{self.job_id}-{self.attempt}"
+            self._current_execution_id = execution_id
             try:
                 te = self.cluster.service.connect(slot["address"],
                                                   slot["executor_id"])
@@ -349,6 +398,23 @@ class JobMasterThread:
     def cancel(self) -> None:
         self._cancel_requested.set()
 
+    def trigger_savepoint(self, path: str, stop: bool = False,
+                          drain: bool = False) -> dict:
+        """Start a savepoint of the running attempt; returns polling
+        coordinates (reference: JobMaster triggerSavepoint returns a
+        CompletableFuture — here the client polls savepoint_status)."""
+        if self.status != RUNNING or self._current_executor is None:
+            raise RuntimeError(
+                f"job {self.job_id} is {self.status}, cannot savepoint")
+        te = self.cluster.service.connect(self._current_address,
+                                          self._current_executor)
+        request_id = te.trigger_savepoint(
+            self._current_execution_id, path, stop, drain)
+        return {"executor_id": self._current_executor,
+                "address": self._current_address,
+                "execution_id": self._current_execution_id,
+                "request_id": request_id}
+
     def wait(self, timeout: Optional[float] = None) -> str:
         self._done.wait(timeout)
         return self.status
@@ -389,6 +455,13 @@ class DispatcherEndpoint(RpcEndpoint):
         if m is not None:
             m.cancel()
 
+    def trigger_savepoint(self, job_id: str, path: str, stop: bool = False,
+                          drain: bool = False) -> dict:
+        m = self._masters.get(job_id)
+        if m is None:
+            raise RuntimeError(f"unknown job {job_id}")
+        return m.trigger_savepoint(path, stop=stop, drain=drain)
+
     # local-only helpers (not serializable across processes)
     def master(self, job_id: str) -> Optional[JobMasterThread]:
         return self._masters.get(job_id)
@@ -406,6 +479,36 @@ class JobClient:
 
     def cancel(self) -> None:
         self.cluster.dispatcher.cancel_job(self.job_id)
+
+    def trigger_savepoint(self, path: str, timeout_s: float = 60.0) -> str:
+        """reference: JobClient.triggerSavepoint."""
+        return self._savepoint(path, stop=False, drain=False,
+                               timeout_s=timeout_s)
+
+    def stop_with_savepoint(self, path: str, drain: bool = False,
+                            timeout_s: float = 60.0) -> str:
+        """reference: JobClient.stopWithSavepoint (--drain flushes all
+        windows/timers before the snapshot)."""
+        return self._savepoint(path, stop=True, drain=drain,
+                               timeout_s=timeout_s)
+
+    def _savepoint(self, path: str, stop: bool, drain: bool,
+                   timeout_s: float) -> str:
+        coords = self.cluster.dispatcher_gateway().trigger_savepoint(
+            self.job_id, path, stop=stop, drain=drain)
+        te = self.cluster.service.connect(coords["address"],
+                                          coords["executor_id"])
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            st = te.savepoint_status(coords["execution_id"],
+                                     coords["request_id"])
+            if st["done"]:
+                if st["error"] is not None:
+                    raise st["error"]
+                return st["path"]
+            time.sleep(0.02)
+        raise TimeoutError(f"savepoint {path!r} did not complete in "
+                           f"{timeout_s}s")
 
     def wait(self, timeout: Optional[float] = None) -> dict:
         master = self.cluster.dispatcher.master(self.job_id)

@@ -103,16 +103,21 @@ class StreamExecutionEnvironment:
         return StreamGraph(self._sinks)
 
     def execute(self, job_name: str = "job",
-                restore_from: Optional[str] = None) -> "JobExecutionResult":
+                restore_from: Optional[str] = None,
+                restore_mode: str = "no-claim") -> "JobExecutionResult":
         """Run the pipeline. ``restore_from`` points at a checkpoint root
-        directory; the latest completed checkpoint there is restored before
-        processing starts (reference: savepoint/restore CLI flow)."""
+        directory (latest completed checkpoint wins) or directly at a
+        savepoint / single checkpoint directory. ``restore_mode`` is
+        "no-claim" (default: the artifact stays user-owned and untouched) or
+        "claim" (the job owns it and deletes it once subsumed) —
+        reference: savepoint/restore CLI flow + claim modes."""
         from flink_tpu.cluster.local_executor import LocalExecutor
 
         graph = self.get_stream_graph()
         executor = LocalExecutor(self.config)
         result = executor.run(graph, job_name=job_name,
-                              restore_from=restore_from)
+                              restore_from=restore_from,
+                              restore_mode=restore_mode)
         self._sinks = []
         return result
 
