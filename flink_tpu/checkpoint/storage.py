@@ -148,6 +148,120 @@ def read_snapshot_dir(snapshot_dir: str) -> Dict[str, Dict[str, Any]]:
     return out
 
 
+# --------------------------------------------------------------------------
+# Incremental checkpoints: a delta snapshot stores only rows dirtied since
+# its base checkpoint plus freed-namespace tombstones (reference:
+# RocksIncrementalSnapshotStrategy uploads only new SST files; the chain is
+# re-materialized at restore). A checkpoint's manifest extra carries
+# {"incremental": true, "base": <id>}.
+# --------------------------------------------------------------------------
+
+
+def is_delta_table(d: Any) -> bool:
+    return isinstance(d, dict) and bool(np.asarray(d.get("__delta__", False)))
+
+
+def _pack_rows(key_ids, namespaces) -> np.ndarray:
+    out = np.empty(len(key_ids), dtype=[("k", "<i8"), ("n", "<i8")])
+    out["k"] = np.asarray(key_ids, dtype=np.int64)
+    out["n"] = np.asarray(namespaces, dtype=np.int64)
+    return out
+
+
+def apply_table_delta(base: Optional[Dict[str, Any]],
+                      delta: Dict[str, Any]) -> Dict[str, Any]:
+    """Materialize base rows + delta upserts - tombstoned namespaces."""
+    cols = [k for k in delta if k not in ("__delta__", "freed_namespaces")]
+    delta_rows = {c: np.asarray(delta[c]) for c in cols}
+    if base is None or len(np.asarray(base.get("key_id", ()))) == 0:
+        return delta_rows
+    freed = np.asarray(delta.get("freed_namespaces", ()), dtype=np.int64)
+    keep = np.ones(len(base["key_id"]), dtype=bool)
+    if len(freed):
+        keep &= ~np.isin(np.asarray(base["namespace"], dtype=np.int64),
+                         freed)
+    if len(delta_rows["key_id"]):
+        keep &= ~np.isin(
+            _pack_rows(base["key_id"], base["namespace"]),
+            _pack_rows(delta_rows["key_id"], delta_rows["namespace"]))
+    return {
+        c: np.concatenate([np.asarray(base[c])[keep], delta_rows[c]])
+        for c in cols
+    }
+
+
+def merge_incremental_state(base: Dict[str, Any],
+                            delta: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge one operator's delta state onto its base state: delta tables
+    apply row-wise, other dict values recurse, leaves replace; base keys
+    absent from the delta are kept."""
+    out = dict(base)
+    for k, v in delta.items():
+        if is_delta_table(v):
+            prior = base.get(k) if isinstance(base.get(k), dict) else None
+            out[k] = apply_table_delta(prior, v)
+        elif isinstance(v, dict) and isinstance(base.get(k), dict):
+            out[k] = merge_incremental_state(base[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def read_checkpoint_chain(snapshot_dir: str) -> Dict[str, Dict[str, Any]]:
+    """Read a checkpoint, materializing its incremental chain if any.
+
+    Delta checkpoints reference their base by id; bases live as sibling
+    chk-<id> directories.
+    """
+    manifest = read_manifest(snapshot_dir)
+    states = read_snapshot_dir(snapshot_dir)
+    extra = manifest.get("extra", {})
+    if not extra.get("incremental"):
+        # a full-manifest checkpoint should not carry delta tables; if one
+        # does (writer bug / tampering), materializing it as-if-complete
+        # would silently drop state — fail loudly instead
+        def assert_no_delta(state, path):
+            for k, v in state.items():
+                if is_delta_table(v):
+                    raise RuntimeError(
+                        f"full checkpoint {snapshot_dir!r} contains a "
+                        f"delta-marked table at {path + (k,)!r}")
+                if isinstance(v, dict):
+                    assert_no_delta(v, path + (k,))
+
+        for uid, st in states.items():
+            assert_no_delta(st, (uid,))
+        return states
+    base_dir = os.path.join(os.path.dirname(os.path.abspath(snapshot_dir)),
+                            f"chk-{extra['base']}")
+    if not os.path.isdir(base_dir):
+        raise RuntimeError(
+            f"incremental checkpoint {snapshot_dir!r} references missing "
+            f"base chk-{extra['base']} — was it deleted outside retain()?")
+    base_states = read_checkpoint_chain(base_dir)
+    out: Dict[str, Dict[str, Any]] = dict(base_states)
+    for uid, st in states.items():
+        out[uid] = merge_incremental_state(base_states.get(uid, {}), st)
+    return out
+
+
+def checkpoint_chain_ids(root: str, checkpoint_id: int) -> List[int]:
+    """All checkpoint ids the given checkpoint transitively depends on
+    (including itself)."""
+    ids = [checkpoint_id]
+    cur = checkpoint_id
+    while True:
+        d = os.path.join(root, f"chk-{cur}")
+        if not os.path.isdir(d):
+            break
+        extra = read_manifest(d).get("extra", {})
+        if not extra.get("incremental"):
+            break
+        cur = int(extra["base"])
+        ids.append(cur)
+    return ids
+
+
 def resolve_snapshot_dir(path: str) -> str:
     """Accept either a self-contained snapshot dir (savepoint / single
     checkpoint) or a checkpoint root holding chk-N children (newest wins)."""
@@ -203,14 +317,21 @@ class CheckpointStorage:
         return max(ids) if ids else None
 
     def retain(self, keep: int) -> None:
-        """Drop all but the newest ``keep`` checkpoints."""
+        """Drop all but the newest ``keep`` checkpoints — never a checkpoint
+        that a retained incremental checkpoint still references as (part of)
+        its base chain (reference: shared-state registry refcounting in
+        SharedStateRegistry)."""
         if keep <= 0:
             return
         all_ids = sorted(
             int(n[4:]) for n in os.listdir(self.root)
             if n.startswith("chk-") and n[4:].isdigit())
+        needed = set()
+        for i in all_ids[-keep:]:
+            needed.update(checkpoint_chain_ids(self.root, i))
         for i in all_ids[:-keep]:
-            shutil.rmtree(self._dir(i), ignore_errors=True)
+            if i not in needed:
+                shutil.rmtree(self._dir(i), ignore_errors=True)
 
     # ---------------------------------------------------------------- helpers
 

@@ -16,6 +16,7 @@ boundaries: the executor simply snapshots all operators between pushes
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -165,15 +166,19 @@ class LocalExecutor:
         if restore_from is not None:
             from flink_tpu.checkpoint.savepoint import prepare_restore
             from flink_tpu.checkpoint.storage import (
+                read_checkpoint_chain,
                 read_manifest,
-                read_snapshot_dir,
             )
 
             snap_dir, claimed = prepare_restore(
                 restore_from, restore_mode, own_checkpoint_root=ckpt_dir)
-            states = read_snapshot_dir(snap_dir)
+            states = read_checkpoint_chain(snap_dir)
             self._restore_all(graph, nodes, states)
             checkpoint_count = int(read_manifest(snap_dir)["checkpoint_id"])
+            restored_id = checkpoint_count
+            restored_in_root = bool(ckpt_dir) and (
+                os.path.dirname(os.path.abspath(snap_dir))
+                == os.path.abspath(ckpt_dir))
             if storage is not None:
                 # the checkpoint root may hold higher-numbered checkpoints
                 # from an abandoned timeline (restore from an older
@@ -186,6 +191,16 @@ class LocalExecutor:
         total_records = 0
         last_ckpt = time.time() * 1000
         batches_since_ckpt = 0
+        incremental = self.config.get(CheckpointOptions.INCREMENTAL)
+        full_every = max(self.config.get(CheckpointOptions.FULL_EVERY), 1)
+        # deltas may build on a restored checkpoint only when it lives in
+        # the job's own checkpoint root (its chain stays intact under
+        # retain()); savepoints / foreign artifacts are not valid bases
+        last_written_id = None
+        since_full = 0
+        if restore_from is not None and storage is not None and \
+                restored_in_root:
+            last_written_id = restored_id
 
         active = {t.uid for t, _ in sources}
         try:
@@ -219,13 +234,24 @@ class LocalExecutor:
                         and time.time() * 1000 - last_ckpt >= ckpt_interval)
                     if due:
                         checkpoint_count += 1
+                        use_delta = (incremental and last_written_id
+                                     is not None
+                                     and since_full < full_every)
                         with traces.span(
                                 "checkpoint",
                                 f"checkpoint-{checkpoint_count}") as sp:
-                            snap = self.snapshot_all(graph, nodes)
+                            snap = self.snapshot_all(graph, nodes,
+                                                     delta=use_delta)
+                            extra = ({"incremental": True,
+                                      "base": last_written_id}
+                                     if use_delta else None)
                             new_dir = storage.write_checkpoint(
-                                checkpoint_count, job_name, snap)
+                                checkpoint_count, job_name, snap,
+                                extra=extra)
                             sp.set_attribute("checkpointId", checkpoint_count)
+                            sp.set_attribute("incremental", use_delta)
+                        last_written_id = checkpoint_count
+                        since_full = since_full + 1 if use_delta else 1
                         if claimed is not None:
                             claimed.on_checkpoint_complete(new_dir)
                         storage.retain(
@@ -350,7 +376,7 @@ class LocalExecutor:
                             t.source.close()
                     active.clear()
                 with traces.span("savepoint", req.path):
-                    snap = self.snapshot_all(graph, nodes)
+                    snap = self.snapshot_all(graph, nodes, savepoint=True)
                     path = write_savepoint(req.path, job_name, snap,
                                            checkpoint_id=checkpoint_id)
                 if req.stop and not req.drain:
@@ -419,15 +445,23 @@ class LocalExecutor:
     # ----------------------------------------------------------- checkpoint
 
     @staticmethod
-    def snapshot_all(graph: StreamGraph, nodes: Dict[int, _Node]
-                     ) -> Dict[str, Any]:
+    def snapshot_all(graph: StreamGraph, nodes: Dict[int, _Node],
+                     delta: bool = False,
+                     savepoint: bool = False) -> Dict[str, Any]:
         snap: Dict[str, Any] = {}
         for uid, node in nodes.items():
             t = node.transformation
-            if node.operator is None:
+            op = node.operator
+            if op is None:
                 state = {"source": t.source.snapshot_position()}
+            elif delta and hasattr(op, "snapshot_state_delta"):
+                state = op.snapshot_state_delta()
+            elif savepoint and hasattr(op, "snapshot_state_savepoint"):
+                # full, but preserving incremental dirty tracking — a
+                # savepoint must not shrink the next delta checkpoint
+                state = op.snapshot_state_savepoint()
             else:
-                state = node.operator.snapshot_state()
+                state = op.snapshot_state()
             if state:
                 snap[graph.stable_id(t)] = state
         return snap

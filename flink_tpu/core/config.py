@@ -182,6 +182,15 @@ class CheckpointOptions:
     RETAINED = ConfigOption(
         "execution.checkpointing.retained", default=3, type=int,
         description="How many completed checkpoints to keep.")
+    INCREMENTAL = ConfigOption(
+        "execution.checkpointing.incremental", default=False, type=bool,
+        description="Write delta checkpoints (dirty rows + tombstones) "
+        "between periodic full snapshots.")
+    FULL_EVERY = ConfigOption(
+        "execution.checkpointing.incremental.full-every", default=10,
+        type=int,
+        description="Consolidate: every Nth checkpoint is a full snapshot, "
+        "bounding restore-chain length.")
     MODE = ConfigOption(
         "execution.checkpointing.mode", default="exactly-once", type=str)
 

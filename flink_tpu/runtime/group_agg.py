@@ -107,6 +107,25 @@ class GroupAggOperator(Operator):
             "max_ts": self._max_ts,
         }
 
+    def snapshot_state_delta(self):
+        """Incremental: dirty rows + tombstones only (see
+        SlotTable.snapshot_delta)."""
+        return {
+            "table": self.table.snapshot_delta(),
+            "key_values": dict(self._key_values),
+            "keys_hashed": self._keys_hashed,
+            "max_ts": self._max_ts,
+        }
+
+    def snapshot_state_savepoint(self):
+        """Full state without resetting the incremental base."""
+        return {
+            "table": self.table.snapshot(reset_dirty=False),
+            "key_values": dict(self._key_values),
+            "keys_hashed": self._keys_hashed,
+            "max_ts": self._max_ts,
+        }
+
     def restore_state(self, state):
         self.table.restore(state["table"])
         self._key_values = dict(state.get("key_values", {}))

@@ -199,6 +199,27 @@ class WindowAggOperator(Operator):
             "keys_hashed": self._keys_hashed,
         }
 
+    def snapshot_state_delta(self):
+        """Incremental variant: the keyed table ships only dirty rows +
+        tombstones; host metadata (bookkeeping, key values) is small and
+        written full (reference: incremental checkpoints still write fresh
+        metadata, only SSTs are shared)."""
+        return {
+            "windower": self.windower.snapshot(mode="delta"),
+            "key_values": dict(self._key_values),
+            "keys_hashed": self._keys_hashed,
+        }
+
+    def snapshot_state_savepoint(self):
+        """Savepoint variant: full state, but keeps incremental dirty
+        tracking intact — a savepoint is a side artifact and must not
+        change what the next delta checkpoint contains."""
+        return {
+            "windower": self.windower.snapshot(mode="savepoint"),
+            "key_values": dict(self._key_values),
+            "keys_hashed": self._keys_hashed,
+        }
+
     def restore_state(self, state):
         self.windower.restore(state["windower"])
         # empty sub-dicts are pruned by the checkpoint codec

@@ -336,6 +336,13 @@ class SlotTable:
         self._fire_bucket = 0
         self._scatter_bucket = 0
         self._reset_bucket = 0
+        # incremental-snapshot bookkeeping (reference: the dirty-tracking
+        # role of RocksDB's memtable/SST-diff in
+        # RocksIncrementalSnapshotStrategy — here a host bitmap of slots
+        # touched since the last snapshot + the namespaces freed since)
+        self._dirty = np.zeros(self.index.capacity, dtype=bool)
+        self._freed_ns: List[int] = []
+        self._gather_bucket = 0
 
     # ------------------------------------------------------------------ info
 
@@ -363,12 +370,15 @@ class SlotTable:
                 [a, jnp.full((new - old,), leaf.identity, dtype=leaf.dtype)])
             for a, leaf in zip(self.accs, self.agg.leaves)
         )
+        self._dirty = np.concatenate(
+            [self._dirty, np.zeros(new - old, dtype=bool)])
 
     def scatter(self, slots: np.ndarray, values: Tuple[np.ndarray, ...]) -> None:
         """Accumulate a batch: one donated XLA scatter per leaf."""
         n = len(slots)
         if n == 0:
             return
+        self._dirty[slots] = True
         size = sticky_bucket(n, self._scatter_bucket)
         self._scatter_bucket = size
         padded_slots = pad_i32(slots, size, fill=0)
@@ -399,28 +409,51 @@ class SlotTable:
         out = self.agg._fire_jit(self.accs, jnp.asarray(padded))
         return {name: np.asarray(col)[:w] for name, col in out.items()}
 
+    def mark_dirty(self, slots: np.ndarray) -> None:
+        """For external kernels that mutate ``accs`` directly (e.g. session
+        merges): keep incremental snapshots correct."""
+        self._dirty[slots] = True
+
+    def free_index_only(self, namespaces: List[int]) -> Optional[np.ndarray]:
+        """Release the host index entries of namespaces whose device values
+        were already neutralized by a caller-owned kernel (session merges).
+        Still records tombstones for incremental snapshots."""
+        slots = self.index.free_namespaces(namespaces)
+        self._freed_ns.extend(int(n) for n in namespaces)
+        if slots is not None:
+            self._dirty[slots] = False
+        return slots
+
     def free_namespaces(self, namespaces: List[int]) -> None:
         """Release all slots of the given namespaces (windows fully fired)."""
         slots = self.index.free_namespaces(namespaces)
+        self._freed_ns.extend(int(n) for n in namespaces)
         if slots is None:
             return
+        self._dirty[slots] = False
         size = sticky_bucket(len(slots), self._reset_bucket)
         self._reset_bucket = size
         self.accs = self.agg._reset_jit(self.accs, pad_i32(slots, size, fill=0))
 
     # ---------------------------------------------------------- snapshot/restore
 
-    def snapshot(self) -> Dict[str, np.ndarray]:
+    def snapshot(self, reset_dirty: bool = True) -> Dict[str, np.ndarray]:
         """Materialize state as host arrays, filtered to used slots.
 
         The snapshot is *logical* (key, ns, key_group, leaf values) — slot
         numbers are not part of the format, so restore can re-shard by key
         group (the reference's rescale-by-key-group-range contract,
         reference: KeyGroupRangeAssignment.java + state/restore pipeline).
+        With ``reset_dirty`` (the default) the snapshot establishes a new
+        incremental base; savepoints pass False so a mid-run savepoint does
+        not silently shrink the next delta checkpoint's contents.
         """
         used = self.index.used_slots()
         accs_host = [np.asarray(a) for a in self.accs]
         key_ids = self.index.slot_key[used]
+        if reset_dirty:
+            self._dirty[:] = False
+            self._freed_ns.clear()
         return {
             "key_id": key_ids,
             "namespace": self.index.slot_ns[used],
@@ -430,6 +463,37 @@ class SlotTable:
                 for i in range(len(self.accs))
             },
         }
+
+    def snapshot_delta(self) -> Dict[str, np.ndarray]:
+        """Incremental snapshot: only rows dirtied since the last snapshot
+        plus the namespaces freed since (tombstones). Restore applies deltas
+        on top of the last full snapshot
+        (reference: RocksIncrementalSnapshotStrategy — upload only new SSTs;
+        here: transfer only dirty slots off the device)."""
+        dirty_used = np.nonzero(self._dirty & self.index.slot_used)[0] \
+            .astype(np.int32)
+        freed = np.asarray(sorted(set(self._freed_ns)), dtype=np.int64)
+        n = len(dirty_used)
+        if n:
+            size = sticky_bucket(n, self._gather_bucket)
+            self._gather_bucket = size
+            gathered = self.agg._gather_jit(
+                self.accs, jnp.asarray(pad_i32(dirty_used, size, fill=0)))
+            leaves = [np.asarray(g)[:n] for g in gathered]
+        else:
+            leaves = [np.empty(0, dtype=l.dtype) for l in self.agg.leaves]
+        key_ids = self.index.slot_key[dirty_used]
+        out = {
+            "__delta__": np.asarray(True),
+            "key_id": key_ids,
+            "namespace": self.index.slot_ns[dirty_used],
+            "key_group": assign_key_groups(key_ids, self.max_parallelism),
+            "freed_namespaces": freed,
+            **{f"leaf_{i}": leaves[i] for i in range(len(leaves))},
+        }
+        self._dirty[:] = False
+        self._freed_ns.clear()
+        return out
 
     def restore(self, snap: Dict[str, np.ndarray],
                 key_group_filter=None) -> None:
@@ -447,3 +511,6 @@ class SlotTable:
         for acc, vals in zip(accs_host, leaves):
             acc[slots] = vals
         self.accs = tuple(jnp.asarray(a) for a in accs_host)
+        # restored state IS the new incremental base
+        self._dirty[:] = False
+        self._freed_ns.clear()

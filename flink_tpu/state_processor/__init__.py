@@ -18,8 +18,8 @@ import numpy as np
 
 from flink_tpu.checkpoint.savepoint import write_savepoint
 from flink_tpu.checkpoint.storage import (
+    read_checkpoint_chain,
     read_manifest,
-    read_snapshot_dir,
     resolve_snapshot_dir,
 )
 from flink_tpu.core.records import RecordBatch
@@ -63,7 +63,7 @@ class SavepointReader:
         """``path`` may be a savepoint dir, a single checkpoint dir, or a
         checkpoint root (newest chk-N wins)."""
         d = resolve_snapshot_dir(path)
-        return SavepointReader(d, read_manifest(d), read_snapshot_dir(d))
+        return SavepointReader(d, read_manifest(d), read_checkpoint_chain(d))
 
     # -- inspection ----------------------------------------------------------
 

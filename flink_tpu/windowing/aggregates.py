@@ -166,6 +166,22 @@ class AggregateFunction:
         return fn
 
     @property
+    def _gather_jit(self):
+        """(accs, slots) -> per-leaf gathered values — the incremental-
+        snapshot read path: only dirty slots leave the device instead of
+        the whole [capacity] arrays (HBM->host bandwidth is the cost)."""
+        key = ("gather", tuple(l.dtype.str for l in self.leaves))
+        fn = _JIT_CACHE.get(key)
+        if fn is None:
+
+            @jax.jit
+            def gather(accs, slots):
+                return tuple(a[slots] for a in accs)
+
+            _JIT_CACHE[key] = fn = gather
+        return fn
+
+    @property
     def _reset_jit(self):
         idents = tuple(l.identity for l in self.leaves)
         key = ("reset", idents, tuple(l.dtype.str for l in self.leaves))

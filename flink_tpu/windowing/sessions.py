@@ -173,13 +173,16 @@ class SessionWindower:
         dst_slots = self.table.lookup_or_insert(dk, ds)
         src_slots = self.table.lookup_or_insert(sk, ss)
         size = pad_bucket_size(len(dst_slots))
+        self.table.mark_dirty(dst_slots)
+        self.table.mark_dirty(src_slots)
         self.table.accs = _merge_jit(self.agg)(
             self.table.accs,
             pad_i32(dst_slots, size, fill=0),
             pad_i32(src_slots, size, fill=0))
         # absorbed host slots are only reusable once their values have moved
+        # (free_index_only: the merge kernel already reset the device slots)
         if self._absorbed_sids:
-            self.table.index.free_namespaces(self._absorbed_sids)
+            self.table.free_index_only(self._absorbed_sids)
             self._absorbed_sids = []
         self._merge_dst, self._merge_src = [], []
         self._merge_dst_set, self._merge_src_set = set(), set()
@@ -285,9 +288,14 @@ class SessionWindower:
 
     # -------------------------------------------------------------- snapshot
 
-    def snapshot(self) -> Dict[str, object]:
+    def snapshot(self, mode: str = "full") -> Dict[str, object]:
+        self._flush_merges()  # pending accumulator moves must be material
+        if mode == "delta":
+            table = self.table.snapshot_delta()
+        else:
+            table = self.table.snapshot(reset_dirty=(mode != "savepoint"))
         return {
-            "table": self.table.snapshot(),
+            "table": table,
             "sessions": {k: list(v) for k, v in self.sessions.items()},
             "next_sid": self._next_sid,
             "max_fired_watermark": self.max_fired_watermark,

@@ -126,9 +126,16 @@ class SliceSharedWindower:
 
     # ------------------------------------------------------------- snapshot
 
-    def snapshot(self) -> Dict[str, object]:
+    def snapshot(self, mode: str = "full") -> Dict[str, object]:
+        """mode: "full" (new incremental base), "delta" (dirty rows only),
+        "savepoint" (full, but preserves dirty tracking — a side artifact
+        must not change what the next delta checkpoint contains)."""
+        if mode == "delta":
+            table = self.table.snapshot_delta()
+        else:
+            table = self.table.snapshot(reset_dirty=(mode != "savepoint"))
         return {
-            "table": self.table.snapshot(),
+            "table": table,
             **self.book.snapshot(),
         }
 

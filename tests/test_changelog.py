@@ -1,0 +1,189 @@
+"""Changelog (DSTL) backend: write-ahead state log, instant checkpoints,
+materialization + truncation, replay on restore.
+
+reference model: flink-dstl FsStateChangelogWriter tests + changelog
+backend ITCases.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from flink_tpu.checkpoint.changelog import (
+    ChangelogKeyedBackend,
+    ChangelogWriter,
+    read_entries,
+)
+from flink_tpu.windowing.aggregates import SumAggregate
+
+
+def scatter(backend, keys, ns, vals):
+    backend.scatter(np.asarray(keys, dtype=np.int64),
+                    np.asarray(ns, dtype=np.int64),
+                    (np.asarray(vals, dtype=np.float32),))
+
+
+def sums(backend, ns):
+    s = backend.table.slots_for_namespace(ns)
+    res = backend.table.fire(s[:, None])
+    return dict(zip(backend.table.keys_of_slots(s).tolist(),
+                    res["sum_v"].tolist()))
+
+
+class TestWriter:
+    def test_append_flush_read_roundtrip(self, tmp_path):
+        p = str(tmp_path / "log.bin")
+        w = ChangelogWriter(p)
+        w.append("op", "scatter", {"x": np.arange(3)})
+        w.append("op", "free", {"namespaces": [1, 2]})
+        w.flush()
+        entries = list(read_entries(p))
+        assert [e[0] for e in entries] == [0, 1]
+        assert entries[1][2] == "free"
+        # sequence numbers continue across reopen
+        w.close()
+        w2 = ChangelogWriter(p)
+        assert w2.append("op", "free", {"namespaces": []}) == 2
+        w2.close()
+
+    def test_torn_final_frame_is_ignored(self, tmp_path):
+        p = str(tmp_path / "log.bin")
+        w = ChangelogWriter(p)
+        w.append("op", "scatter", {"x": np.arange(3)})
+        w.flush()
+        w.close()
+        with open(p, "ab") as f:  # simulate crash mid-append
+            f.write(b"FTCL\x99\x00\x00\x00\x00\x00\x00\x00partial")
+        assert len(list(read_entries(p))) == 1
+
+    def test_truncate_drops_materialized_prefix(self, tmp_path):
+        p = str(tmp_path / "log.bin")
+        w = ChangelogWriter(p)
+        for i in range(5):
+            w.append("op", "free", {"namespaces": [i]})
+        w.truncate(3)
+        assert [e[0] for e in read_entries(p)] == [3, 4]
+        assert w.append("op", "free", {"namespaces": []}) == 5
+        w.close()
+
+
+class TestChangelogBackend:
+    def test_checkpoint_is_offset_only_and_restores_exactly(self, tmp_path):
+        b = ChangelogKeyedBackend(SumAggregate("v"), str(tmp_path / "cl"))
+        scatter(b, [1, 2, 1], [10, 10, 10], [1.0, 2.0, 3.0])
+        ck = b.checkpoint()  # instant: just an offset
+        scatter(b, [1], [10], [100.0])  # AFTER the checkpoint cut
+        b.close()
+
+        b2 = ChangelogKeyedBackend(SumAggregate("v"), str(tmp_path / "cl"))
+        b2.restore(ck)
+        assert sums(b2, 10) == {1: 4.0, 2: 2.0}  # post-cut write excluded
+        b2.close()
+
+    def test_materialize_and_subsumption_bound_replay(self, tmp_path):
+        b = ChangelogKeyedBackend(SumAggregate("v"), str(tmp_path / "cl"))
+        scatter(b, [1, 2], [10, 10], [1.0, 2.0])
+        mat_ck = b.materialize()
+        # materialize alone discards nothing (older checkpoints stay
+        # restorable); truncation follows checkpoint subsumption
+        log = os.path.join(str(tmp_path / "cl"), "changelog.bin")
+        assert len(list(read_entries(log))) == 1
+        b.truncate_subsumed(mat_ck["changelog_seq"])
+        assert list(read_entries(log)) == []  # now truncated
+        scatter(b, [2, 3], [10, 10], [5.0, 7.0])
+        b.free_namespaces([99])  # no-op free is still logged + replayable
+        ck = b.checkpoint()
+        b.close()
+
+        b2 = ChangelogKeyedBackend(SumAggregate("v"), str(tmp_path / "cl"))
+        b2.restore(ck)
+        assert sums(b2, 10) == {1: 1.0, 2: 7.0, 3: 7.0}
+        b2.close()
+
+    def test_checkpoint_survives_later_materialization(self, tmp_path):
+        """A checkpoint taken BEFORE a materialization must stay restorable
+        until explicitly subsumed (the bug class: materialize deleting the
+        replay prefix under a retained checkpoint)."""
+        b = ChangelogKeyedBackend(SumAggregate("v"), str(tmp_path / "cl"))
+        scatter(b, [1], [10], [1.0])
+        early_ck = b.checkpoint()
+        scatter(b, [1], [10], [10.0])
+        b.materialize()  # later materialization
+        b.close()
+        b2 = ChangelogKeyedBackend(SumAggregate("v"), str(tmp_path / "cl"))
+        b2.restore(early_ck)
+        assert sums(b2, 10) == {1: 1.0}
+        b2.close()
+
+    def test_truncated_checkpoint_fails_loudly(self, tmp_path):
+        b = ChangelogKeyedBackend(SumAggregate("v"), str(tmp_path / "cl"))
+        scatter(b, [1], [10], [1.0])
+        early_ck = b.checkpoint()
+        scatter(b, [1], [10], [10.0])
+        mat = b.materialize()
+        b.truncate_subsumed(mat["changelog_seq"])  # early_ck now subsumed
+        b.close()
+        b2 = ChangelogKeyedBackend(SumAggregate("v"), str(tmp_path / "cl"))
+        with pytest.raises(RuntimeError, match="not\\s+restorable"):
+            b2.restore(early_ck)
+        b2.close()
+
+    def test_recovery_after_torn_tail_preserves_new_appends(self, tmp_path):
+        """Crash mid-append, reopen, append more: the post-crash entries
+        must be durable (the torn tail is trimmed on reopen)."""
+        p = str(tmp_path / "cl" / "changelog.bin")
+        b = ChangelogKeyedBackend(SumAggregate("v"), str(tmp_path / "cl"))
+        scatter(b, [1], [10], [1.0])
+        b.writer.flush()
+        b.close()
+        with open(p, "ab") as f:
+            f.write(b"FTCL" + b"\xff" * 12)  # torn frame
+        b2 = ChangelogKeyedBackend(SumAggregate("v"), str(tmp_path / "cl"))
+        # replay existing log into the fresh table first
+        b2.restore({"changelog_seq": b2.writer.next_sequence,
+                    "materialized_seq": 0})
+        scatter(b2, [2], [10], [2.0])
+        ck = b2.checkpoint()
+        b2.close()
+        b3 = ChangelogKeyedBackend(SumAggregate("v"), str(tmp_path / "cl"))
+        b3.restore(ck)
+        assert sums(b3, 10) == {1: 1.0, 2: 2.0}
+        b3.close()
+
+    def test_free_is_replayed(self, tmp_path):
+        b = ChangelogKeyedBackend(SumAggregate("v"), str(tmp_path / "cl"))
+        scatter(b, [1, 2], [10, 10], [1.0, 2.0])
+        scatter(b, [1, 2], [20, 20], [3.0, 4.0])
+        b.free_namespaces([10])
+        ck = b.checkpoint()
+        b.close()
+        b2 = ChangelogKeyedBackend(SumAggregate("v"), str(tmp_path / "cl"))
+        b2.restore(ck)
+        assert sums(b2, 10) == {}
+        assert sums(b2, 20) == {1: 3.0, 2: 4.0}
+        b2.close()
+
+    def test_restore_equals_direct_state_randomized(self, tmp_path):
+        rng = np.random.default_rng(11)
+        b = ChangelogKeyedBackend(SumAggregate("v"), str(tmp_path / "cl"))
+        for step in range(10):
+            keys = rng.integers(0, 40, 100)
+            ns = rng.integers(1, 4, 100) * 10
+            vals = rng.random(100)
+            scatter(b, keys, ns, vals)
+            if step == 4:
+                b.materialize()
+            if step == 7:
+                b.free_namespaces([10])
+        expected = {ns: sums(b, ns) for ns in (10, 20, 30)}
+        ck = b.checkpoint()
+        b.close()
+        b2 = ChangelogKeyedBackend(SumAggregate("v"), str(tmp_path / "cl"))
+        b2.restore(ck)
+        for ns in (10, 20, 30):
+            got = sums(b2, ns)
+            assert got.keys() == expected[ns].keys()
+            for k in got:
+                assert abs(got[k] - expected[ns][k]) < 1e-3
+        b2.close()
