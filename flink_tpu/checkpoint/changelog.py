@@ -253,15 +253,15 @@ class ChangelogKeyedBackend:
                 f"checkpoint at changelog_seq={target_seq} is not "
                 "restorable: no materialization at or below it and the log "
                 "does not start at 0 (truncated past the checkpoint?)")
-        if entries and mat_seq < target_seq:
+        if mat_seq < target_seq:
             have = {s for s, _, _, _ in entries}
             missing = [s for s in range(mat_seq, target_seq)
                        if s not in have]
             if missing:
                 raise RuntimeError(
                     f"checkpoint at changelog_seq={target_seq} is not "
-                    f"restorable: log entries {missing[:5]}... were "
-                    "truncated past the checkpoint")
+                    f"restorable: log entries {missing[:5]}... are gone "
+                    "(truncated or lost past the checkpoint)")
         for seq, uid, kind, payload in entries:
             if seq < mat_seq or seq >= target_seq or uid != self.op_uid:
                 continue

@@ -1,0 +1,196 @@
+"""Command-line frontend.
+
+reference: flink-clients CliFrontend (bin/flink run / list / info / cancel /
+savepoint / stop) — the operational surface an operator scripts against.
+Re-design: `run` executes a Python pipeline script with -D dynamic
+properties and restore flags injected through the environment (the
+reference injects dynamic properties into the client Configuration the
+same way); cluster actions talk to the MiniCluster REST API.
+
+    flink-tpu run pipeline.py -D execution.micro-batch.size=65536
+    flink-tpu run pipeline.py --restore /ckpts/job --restore-mode claim
+    flink-tpu list            --rest 127.0.0.1:8081
+    flink-tpu info   <job-id> --rest ...
+    flink-tpu cancel <job-id> --rest ...
+    flink-tpu savepoint <job-id> /path [--stop] [--drain] --rest ...
+    flink-tpu query  <job-id> <operator> <key> [--namespace N] --rest ...
+    flink-tpu inspect /path/to/snapshot
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.parse
+import urllib.request
+
+#: env vars `run` uses to hand flags to StreamExecutionEnvironment
+DYNAMIC_PROPS_ENV = "FLINK_TPU_DYNAMIC_PROPS"
+RESTORE_FROM_ENV = "FLINK_TPU_RESTORE_FROM"
+RESTORE_MODE_ENV = "FLINK_TPU_RESTORE_MODE"
+
+
+def _http(url: str, body: dict = None):
+    if body is None:
+        req = urllib.request.Request(url)
+    else:
+        req = urllib.request.Request(
+            url, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read() or b"{}")
+
+
+def _base(args) -> str:
+    rest = args.rest
+    if "://" not in rest:
+        rest = "http://" + rest
+    return rest.rstrip("/")
+
+
+def cmd_run(args) -> int:
+    props = {}
+    for d in args.define or []:
+        if "=" not in d:
+            print(f"-D expects key=value, got {d!r}", file=sys.stderr)
+            return 2
+        k, v = d.split("=", 1)
+        props[k] = v
+    overrides = {}
+    if props:
+        overrides[DYNAMIC_PROPS_ENV] = json.dumps(props)
+    if args.restore:
+        overrides[RESTORE_FROM_ENV] = args.restore
+        overrides[RESTORE_MODE_ENV] = args.restore_mode
+    import runpy
+
+    prior = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    argv_prior = sys.argv
+    sys.argv = [args.script] + (args.script_args or [])
+    try:
+        runpy.run_path(args.script, run_name="__main__")
+    finally:
+        sys.argv = argv_prior
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return 0
+
+
+def cmd_list(args) -> int:
+    jobs = _http(f"{_base(args)}/jobs")["jobs"]
+    for j in jobs:
+        print(f"{j['job_id']}  {j['status']:<10}  attempt={j.get('attempt')}"
+              f"  {j.get('name', '')}")
+    if not jobs:
+        print("(no jobs)")
+    return 0
+
+
+def cmd_info(args) -> int:
+    print(json.dumps(_http(f"{_base(args)}/jobs/{args.job_id}"), indent=2))
+    return 0
+
+
+def cmd_cancel(args) -> int:
+    out = _http(f"{_base(args)}/jobs/{args.job_id}/cancel", body={})
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_savepoint(args) -> int:
+    out = _http(f"{_base(args)}/jobs/{args.job_id}/savepoints",
+                body={"target": args.target, "stop": args.stop,
+                      "drain": args.drain})
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_query(args) -> int:
+    q = {"key": args.key}
+    if args.namespace is not None:
+        q["namespace"] = str(args.namespace)
+    op = urllib.parse.quote(args.operator, safe="")
+    url = (f"{_base(args)}/jobs/{args.job_id}/state/{op}"
+           f"?{urllib.parse.urlencode(q)}")
+    print(json.dumps(_http(url), indent=2))
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    from flink_tpu.state_processor import SavepointReader
+
+    reader = SavepointReader.load(args.path)
+    print(f"snapshot: {reader.path}")
+    print(f"job: {reader.job_name}  checkpoint_id: {reader.checkpoint_id}")
+    for uid in reader.operators():
+        state = reader.read_state(uid)
+        if "source" in state:
+            print(f"  {uid}: source position {state['source']}")
+        elif reader.has_keyed_state(uid):
+            batch = reader.read_keyed_state(uid)
+            print(f"  {uid}: keyed state, {len(batch)} rows, "
+                  f"columns {sorted(batch.columns)}")
+        else:
+            print(f"  {uid}: host state, keys {sorted(state)}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="flink-tpu",
+                                description=__doc__.split("\n")[0])
+    sub = p.add_subparsers(dest="command", required=True)
+
+    pr = sub.add_parser("run", help="run a pipeline script")
+    pr.add_argument("script")
+    pr.add_argument("script_args", nargs="*")
+    pr.add_argument("-D", dest="define", action="append", metavar="K=V",
+                    help="dynamic config property (repeatable)")
+    pr.add_argument("--restore", help="checkpoint root / savepoint to "
+                    "restore from")
+    pr.add_argument("--restore-mode", default="no-claim",
+                    choices=["no-claim", "claim"])
+    pr.set_defaults(fn=cmd_run)
+
+    for name, fn in (("list", cmd_list),):
+        ps = sub.add_parser(name, help="list cluster jobs")
+        ps.add_argument("--rest", default="127.0.0.1:8081")
+        ps.set_defaults(fn=fn)
+
+    for name, fn in (("info", cmd_info), ("cancel", cmd_cancel)):
+        ps = sub.add_parser(name, help=f"{name} a job")
+        ps.add_argument("job_id")
+        ps.add_argument("--rest", default="127.0.0.1:8081")
+        ps.set_defaults(fn=fn)
+
+    ps = sub.add_parser("savepoint", help="trigger (or stop with) savepoint")
+    ps.add_argument("job_id")
+    ps.add_argument("target")
+    ps.add_argument("--stop", action="store_true")
+    ps.add_argument("--drain", action="store_true")
+    ps.add_argument("--rest", default="127.0.0.1:8081")
+    ps.set_defaults(fn=cmd_savepoint)
+
+    ps = sub.add_parser("query", help="queryable-state lookup")
+    ps.add_argument("job_id")
+    ps.add_argument("operator")
+    ps.add_argument("key")
+    ps.add_argument("--namespace", type=int)
+    ps.add_argument("--rest", default="127.0.0.1:8081")
+    ps.set_defaults(fn=cmd_query)
+
+    ps = sub.add_parser("inspect", help="inspect a checkpoint/savepoint")
+    ps.add_argument("path")
+    ps.set_defaults(fn=cmd_inspect)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,7 +54,49 @@ class JobCancelledError(RuntimeError):
     """Raised inside the task loop when the job is cancelled externally."""
 
 
-class SavepointRequest:
+class _ControlRequest:
+    """Completion plumbing shared by all task-loop control requests: the
+    loop completes them via ``finish(result, error)``, the client blocks in
+    ``wait`` — one contract, relied on by _fail_pending_controls."""
+
+    timeout_message = "control request not served"
+
+    def __init__(self):
+        import threading
+
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+    def finish(self, result, error=None) -> None:
+        self.result = result
+        self.error = error
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(self.timeout_message)
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class StateQueryRequest(_ControlRequest):
+    """Queryable-state point lookup served at a batch boundary — the
+    single-owner loop means reads never race task-thread mutations
+    (reference: flink-queryable-state KvStateServer, but without the
+    concurrent-read hazards of its direct backend access)."""
+
+    timeout_message = "state query not served"
+
+    def __init__(self, operator_name: str, key, namespace=None):
+        super().__init__()
+        self.operator_name = operator_name
+        self.key = key
+        self.namespace = namespace
+
+
+class SavepointRequest(_ControlRequest):
     """A user-triggered savepoint (optionally stop-with-savepoint).
 
     reference: CheckpointCoordinator.triggerSavepoint + the
@@ -64,26 +106,15 @@ class SavepointRequest:
     """
 
     def __init__(self, path: str, stop: bool = False, drain: bool = False):
-        import threading
-
+        super().__init__()
         self.path = path
         self.stop = stop
         self.drain = drain
-        self.result_path: Optional[str] = None
-        self.error: Optional[BaseException] = None
-        self._done = threading.Event()
+        self.timeout_message = f"savepoint {path!r} not completed"
 
-    def finish(self, path: Optional[str], error=None) -> None:
-        self.result_path = path
-        self.error = error
-        self._done.set()
-
-    def wait(self, timeout: Optional[float] = None) -> str:
-        if not self._done.wait(timeout):
-            raise TimeoutError(f"savepoint {self.path!r} not completed")
-        if self.error is not None:
-            raise self.error
-        return self.result_path
+    @property
+    def result_path(self) -> Optional[str]:
+        return self.result
 
 
 class LocalExecutor:
@@ -176,9 +207,13 @@ class LocalExecutor:
             self._restore_all(graph, nodes, states)
             checkpoint_count = int(read_manifest(snap_dir)["checkpoint_id"])
             restored_id = checkpoint_count
+            # a valid delta base is the job's OWN chk-<id> directory — a
+            # savepoint that merely lives inside the root is NOT one (its
+            # id would alias an unrelated sibling checkpoint)
             restored_in_root = bool(ckpt_dir) and (
                 os.path.dirname(os.path.abspath(snap_dir))
-                == os.path.abspath(ckpt_dir))
+                == os.path.abspath(ckpt_dir)) and (
+                os.path.basename(snap_dir) == f"chk-{restored_id}")
             if storage is not None:
                 # the checkpoint root may hold higher-numbered checkpoints
                 # from an abandoned timeline (restore from an older
@@ -361,6 +396,12 @@ class LocalExecutor:
                 req = control_queue.get_nowait()
             except _queue.Empty:
                 return None
+            if isinstance(req, StateQueryRequest):
+                try:
+                    req.finish(self._serve_query(graph, nodes, req))
+                except BaseException as e:  # noqa: BLE001
+                    req.finish(None, e)
+                continue
             try:
                 # fail fast on a bad target BEFORE any irreversible action
                 # (closing sources / draining): a savepoint that cannot be
@@ -390,6 +431,21 @@ class LocalExecutor:
                 continue
             if req.stop:
                 return req
+
+    @staticmethod
+    def _serve_query(graph, nodes, req: "StateQueryRequest"):
+        for uid, node in nodes.items():
+            t = node.transformation
+            if req.operator_name in (t.name, graph.stable_id(t)):
+                op = node.operator
+                if op is None or not hasattr(op, "query_state"):
+                    raise RuntimeError(
+                        f"operator {req.operator_name!r} has no queryable "
+                        "state")
+                return op.query_state(req.key, req.namespace)
+        raise KeyError(f"no operator named {req.operator_name!r}; "
+                       f"available: "
+                       f"{sorted(n.transformation.name for n in nodes.values())}")
 
     @staticmethod
     def _fail_pending_controls(control_queue, reason: str) -> None:

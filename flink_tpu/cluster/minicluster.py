@@ -144,6 +144,21 @@ class TaskExecutorEndpoint(RpcEndpoint):
         rec["control"].put(req)
         return request_id
 
+    def query_state(self, execution_id: str, operator_name: str, key,
+                    namespace=None, timeout_s: float = 10.0):
+        """Queryable-state lookup against a running task (reference:
+        KvStateServer). Short blocking wait: queries are served at the very
+        next batch boundary."""
+        from flink_tpu.cluster.local_executor import StateQueryRequest
+
+        rec = self._tasks.get(execution_id)
+        if rec is None or rec["status"] != RUNNING:
+            raise RuntimeError(
+                f"no running task {execution_id!r} to query")
+        req = StateQueryRequest(operator_name, key, namespace)
+        rec["control"].put(req)
+        return req.wait(timeout_s)
+
     def savepoint_status(self, execution_id: str, request_id: str) -> dict:
         rec = self._tasks.get(execution_id)
         req = (rec or {}).get("savepoints", {}).get(request_id)
@@ -415,6 +430,15 @@ class JobMasterThread:
                 "execution_id": self._current_execution_id,
                 "request_id": request_id}
 
+    def query_state(self, operator_name: str, key, namespace=None):
+        if self.status != RUNNING or self._current_executor is None:
+            raise RuntimeError(
+                f"job {self.job_id} is {self.status}, cannot query state")
+        te = self.cluster.service.connect(self._current_address,
+                                          self._current_executor)
+        return te.query_state(self._current_execution_id, operator_name,
+                              key, namespace)
+
     def wait(self, timeout: Optional[float] = None) -> str:
         self._done.wait(timeout)
         return self.status
@@ -461,6 +485,13 @@ class DispatcherEndpoint(RpcEndpoint):
         if m is None:
             raise RuntimeError(f"unknown job {job_id}")
         return m.trigger_savepoint(path, stop=stop, drain=drain)
+
+    def query_state(self, job_id: str, operator_name: str, key,
+                    namespace=None):
+        m = self._masters.get(job_id)
+        if m is None:
+            raise RuntimeError(f"unknown job {job_id}")
+        return m.query_state(operator_name, key, namespace)
 
     # local-only helpers (not serializable across processes)
     def master(self, job_id: str) -> Optional[JobMasterThread]:

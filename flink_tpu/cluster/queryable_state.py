@@ -1,0 +1,33 @@
+"""Queryable state client: external point-lookup of live keyed state.
+
+reference: flink-queryable-state (QueryableStateClient in
+flink-queryable-state-client-java querying the TM-side KvStateServer over
+Netty). Re-design: lookups route through the existing gRPC control plane to
+the owning task, and are served ON the task loop at a batch boundary — so
+they read a consistent cut without the reference's concurrent-access
+caveats, at the cost of up to one micro-batch of latency.
+
+Usage::
+
+    client = QueryableStateClient(cluster)
+    result = client.get_state(job_id, "window_agg(SumAggregate)", key=7)
+    # -> {namespace -> {output column -> value}}
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class QueryableStateClient:
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def get_state(self, job_id: str, operator_name: str, key,
+                  namespace: Optional[int] = None
+                  ) -> Dict[int, Dict[str, Any]]:
+        """Finished result columns for ``key`` in the named stateful
+        operator; one entry per live namespace (window), or just the one
+        requested."""
+        return self.cluster.dispatcher_gateway().query_state(
+            job_id, operator_name, key, namespace)

@@ -5,11 +5,14 @@ dashboard. Scope here: the JSON monitoring surface the reference's dashboard
 reads — cluster overview, job list, per-job status/metrics — served from a
 background http.server thread.
 
-GET /overview              cluster totals
-GET /jobs                  job summaries
-GET /jobs/<id>             one job's status
-GET /jobs/<id>/metrics     metric registry snapshot of the running attempt
-GET /taskexecutors         live executors + slots
+GET  /overview               cluster totals
+GET  /jobs                   job summaries
+GET  /jobs/<id>              one job's status
+GET  /jobs/<id>/metrics      metric registry snapshot of the running attempt
+GET  /jobs/<id>/state/<op>   queryable-state lookup (?key=K[&namespace=N])
+GET  /taskexecutors          live executors + slots
+POST /jobs/<id>/cancel       cancel the job
+POST /jobs/<id>/savepoints   {"target": path, "stop": bool, "drain": bool}
 """
 
 from __future__ import annotations
@@ -48,6 +51,31 @@ class RestServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def do_POST(self):  # noqa: N802
+                try:
+                    length = int(self.headers.get("Content-Length", 0) or 0)
+                    body = json.loads(self.rfile.read(length) or b"{}") \
+                        if length else {}
+                    payload = rest._route_post(self.path, body)
+                except KeyError:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                except Exception as e:  # noqa: BLE001
+                    out = json.dumps({"error": repr(e)}).encode()
+                    self.send_response(500)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(out)))
+                    self.end_headers()
+                    self.wfile.write(out)
+                    return
+                out = json.dumps(payload, default=str).encode()
+                self.send_response(202)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
             def log_message(self, *a):
                 pass
 
@@ -76,6 +104,53 @@ class RestServer:
                 return dict(st, job_id=job_id)
             if parts[2] == "metrics":
                 return self._job_metrics(job_id)
+            if parts[2] == "state" and len(parts) >= 4:
+                return self._query_state(job_id, parts[3], path)
+        raise KeyError(path)
+
+    def _query_state(self, job_id: str, operator_name: str, raw_path: str):
+        """GET /jobs/<id>/state/<operator>?key=K[&namespace=N] — queryable
+        state over REST (reference: queryable-state client, here on the
+        monitoring port)."""
+        from urllib.parse import parse_qs, unquote, urlsplit
+
+        q = parse_qs(urlsplit(raw_path).query)
+        if "key" not in q:
+            raise KeyError("missing ?key=")
+        key: object = q["key"][0]
+        try:
+            key = int(key)  # numeric keys queried as numbers
+        except ValueError:
+            pass
+        ns = int(q["namespace"][0]) if "namespace" in q else None
+        result = self.cluster.dispatcher.query_state(
+            job_id, unquote(operator_name), key, ns)
+        return {"job_id": job_id, "operator": operator_name,
+                "key": key, "state": result}
+
+    def _route_post(self, path: str, body: dict):
+        parts = [p for p in path.split("?")[0].split("/") if p]
+        if len(parts) == 3 and parts[0] == "jobs" and parts[2] == "cancel":
+            job_id = parts[1]
+            if self.cluster.dispatcher.job_status(job_id)["status"] == \
+                    "UNKNOWN":
+                raise KeyError(job_id)
+            self.cluster.dispatcher.cancel_job(job_id)
+            return {"job_id": job_id, "status": "cancelling"}
+        if len(parts) == 3 and parts[0] == "jobs" and \
+                parts[2] == "savepoints":
+            from flink_tpu.cluster.minicluster import JobClient
+
+            target = body.get("target")
+            if not target:
+                raise ValueError("body must carry 'target'")
+            client = JobClient(self.cluster, parts[1])
+            if body.get("stop"):
+                p = client.stop_with_savepoint(
+                    target, drain=bool(body.get("drain")))
+            else:
+                p = client.trigger_savepoint(target)
+            return {"job_id": parts[1], "savepoint": p}
         raise KeyError(path)
 
     def _overview(self):

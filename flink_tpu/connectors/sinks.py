@@ -18,6 +18,13 @@ class Sink:
         pass
 
 
+class DiscardingSink(Sink):
+    """Swallows output (reference: DiscardingSink test utility)."""
+
+    def write(self, batch: RecordBatch) -> None:
+        pass
+
+
 class CollectSink(Sink):
     """Collects all batches in memory (tests / execute_and_collect)."""
 
@@ -63,10 +70,10 @@ class JsonLinesFileSink(Sink):
         self._fh = None
 
     def open(self, subtask_index: int = 0) -> None:
-        import os
+        from flink_tpu.core.fs import get_filesystem
 
-        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        self._fh = open(self.path, "a", encoding="utf-8")
+        fs, local = get_filesystem(self.path)
+        self._fh = fs.open(local, "ab")
 
     def write(self, batch: RecordBatch) -> None:
         import json
@@ -74,7 +81,7 @@ class JsonLinesFileSink(Sink):
         if self._fh is None:  # deserialized on a worker without open()
             self.open()
         for row in batch.to_rows():
-            self._fh.write(json.dumps(row, default=str) + "\n")
+            self._fh.write((json.dumps(row, default=str) + "\n").encode())
         self._fh.flush()
 
     def close(self) -> None:
@@ -92,13 +99,17 @@ class JsonLinesFileSink(Sink):
 
     @staticmethod
     def read_rows(path: str):
+        import io
         import json
-        import os
 
-        if not os.path.exists(path):
+        from flink_tpu.core.fs import get_filesystem
+
+        fs, local = get_filesystem(path)
+        if not fs.exists(local):
             return []
-        with open(path, encoding="utf-8") as fh:
-            return [json.loads(line) for line in fh if line.strip()]
+        with fs.open(local, "rb") as fh:
+            text = io.TextIOWrapper(fh, encoding="utf-8")
+            return [json.loads(line) for line in text if line.strip()]
 
 
 class BinaryFileSink(Sink):
@@ -117,10 +128,10 @@ class BinaryFileSink(Sink):
         self._ser = None
 
     def open(self, subtask_index: int = 0) -> None:
-        import os
+        from flink_tpu.core.fs import get_filesystem
 
-        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        self._fh = open(self.path, "wb")
+        fs, local = get_filesystem(self.path)
+        self._fh = fs.open(local, "wb")
 
     def write(self, batch: RecordBatch) -> None:
         import json

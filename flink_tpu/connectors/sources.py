@@ -197,7 +197,10 @@ class BinaryFileSource(Source):
             SerializerSnapshot,
         )
 
-        self._fh = open(self.path, "rb")
+        from flink_tpu.core.fs import get_filesystem
+
+        fs, local = get_filesystem(self.path)
+        self._fh = fs.open(local, "rb")
         magic = self._fh.read(4)
         if magic != b"FTFS":
             raise ValueError(f"{self.path}: not a binary batch file")

@@ -29,6 +29,23 @@ class StreamExecutionEnvironment:
         self.config = config or Configuration()
         self._sinks: List[Transformation] = []
 
+    def _effective_config(self) -> Configuration:
+        """CLI `-D` dynamic properties override programmatic config —
+        applied at execute() time so they win over any mutator the script
+        called after constructing the environment (reference: CliFrontend
+        dynamic properties > user Configuration)."""
+        import json
+        import os
+
+        raw = os.environ.get("FLINK_TPU_DYNAMIC_PROPS")
+        if not raw:
+            return self.config
+        try:
+            props = json.loads(raw)
+        except ValueError:
+            return self.config
+        return Configuration(props).with_fallback(self.config)
+
     @staticmethod
     def get_execution_environment(
         config: Optional[Configuration] = None,
@@ -39,7 +56,7 @@ class StreamExecutionEnvironment:
 
     @property
     def parallelism(self) -> int:
-        return self.config.get(CoreOptions.DEFAULT_PARALLELISM)
+        return self._effective_config().get(CoreOptions.DEFAULT_PARALLELISM)
 
     def set_parallelism(self, p: int) -> "StreamExecutionEnvironment":
         self.config.set(CoreOptions.DEFAULT_PARALLELISM, p)
@@ -51,7 +68,7 @@ class StreamExecutionEnvironment:
 
     @property
     def batch_size(self) -> int:
-        return self.config.get(BatchOptions.BATCH_SIZE)
+        return self._effective_config().get(BatchOptions.BATCH_SIZE)
 
     @property
     def state_slot_capacity(self) -> int:
@@ -111,10 +128,16 @@ class StreamExecutionEnvironment:
         "no-claim" (default: the artifact stays user-owned and untouched) or
         "claim" (the job owns it and deletes it once subsumed) —
         reference: savepoint/restore CLI flow + claim modes."""
+        import os
+
         from flink_tpu.cluster.local_executor import LocalExecutor
 
+        if restore_from is None:  # CLI `run --restore` injects via env
+            restore_from = os.environ.get("FLINK_TPU_RESTORE_FROM") or None
+            restore_mode = os.environ.get("FLINK_TPU_RESTORE_MODE",
+                                          restore_mode)
         graph = self.get_stream_graph()
-        executor = LocalExecutor(self.config)
+        executor = LocalExecutor(self._effective_config())
         result = executor.run(graph, job_name=job_name,
                               restore_from=restore_from,
                               restore_mode=restore_mode)

@@ -75,6 +75,8 @@ def load_slotmap() -> Optional[ctypes.CDLL]:
                                             P(u8)]
         lib.sm_erase.restype = i64
         lib.sm_erase.argtypes = [vp, i64, P(i64), P(i64), P(i32)]
+        lib.sm_lookup.restype = None
+        lib.sm_lookup.argtypes = [vp, i64, P(i64), P(i64), P(i32)]
         _lib = lib
         return _lib
 

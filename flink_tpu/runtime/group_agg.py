@@ -126,6 +126,13 @@ class GroupAggOperator(Operator):
             "max_ts": self._max_ts,
         }
 
+    def query_state(self, key_value, namespace=None):
+        """Queryable-state point lookup (see WindowAggOperator)."""
+        from flink_tpu.state.keygroups import hash_keys_to_i64
+
+        key_id = int(hash_keys_to_i64(np.asarray([key_value]))[0])
+        return self.table.query(key_id, namespace)
+
     def restore_state(self, state):
         self.table.restore(state["table"])
         self._key_values = dict(state.get("key_values", {}))

@@ -220,6 +220,16 @@ class WindowAggOperator(Operator):
             "keys_hashed": self._keys_hashed,
         }
 
+    def query_state(self, key_value, namespace=None):
+        """Queryable-state point lookup: {namespace -> result columns} for
+        one key (reference: queryable state KvState lookup). Served on the
+        task loop at a batch boundary, so reads are race-free
+        (single-owner discipline, like the reference's mailbox)."""
+        from flink_tpu.state.keygroups import hash_keys_to_i64
+
+        key_id = int(hash_keys_to_i64(np.asarray([key_value]))[0])
+        return self.windower.table.query(key_id, namespace)
+
     def restore_state(self, state):
         self.windower.restore(state["windower"])
         # empty sub-dicts are pruned by the checkpoint codec

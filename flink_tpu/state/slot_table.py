@@ -152,6 +152,18 @@ class HostSlotIndex(_NamespaceRegistry):
                 np.asarray(slots, dtype=np.int32))
         return uslots[inverse]
 
+    def lookup(self, key_ids: np.ndarray,
+               namespaces: np.ndarray) -> np.ndarray:
+        """Read-only probe: slot per pair, -1 where absent (the queryable-
+        state point-lookup path — never allocates)."""
+        keys = np.asarray(key_ids, dtype=np.int64)
+        nss = np.asarray(namespaces, dtype=np.int64)
+        out = np.empty(len(keys), dtype=np.int32)
+        index = self._index
+        for j in range(len(keys)):
+            out[j] = index.get((int(keys[j]), int(nss[j])), -1)
+        return out
+
     def _allocate(self) -> int:
         if not self._free:
             self._grow()
@@ -279,6 +291,22 @@ class NativeSlotIndex(_NamespaceRegistry):
             reg = self._ns_slots
             for ns, chunk in zip(sorted_ns[firsts].tolist(), chunks):
                 reg.setdefault(ns, []).append(chunk)
+        return out
+
+    def lookup(self, key_ids: np.ndarray,
+               namespaces: np.ndarray) -> np.ndarray:
+        """Read-only probe via the native table: -1 where absent."""
+        import ctypes
+
+        keys = np.ascontiguousarray(key_ids, dtype=np.int64)
+        nss = np.ascontiguousarray(namespaces, dtype=np.int64)
+        out = np.empty(len(keys), dtype=np.int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        self._lib.sm_lookup(self._h, len(keys),
+                            keys.ctypes.data_as(i64p),
+                            nss.ctypes.data_as(i64p),
+                            out.ctypes.data_as(i32p))
         return out
 
     def free_namespaces(self, namespaces: List[int]) -> Optional[np.ndarray]:
@@ -434,6 +462,31 @@ class SlotTable:
         size = sticky_bucket(len(slots), self._reset_bucket)
         self._reset_bucket = size
         self.accs = self.agg._reset_jit(self.accs, pad_i32(slots, size, fill=0))
+
+    # ------------------------------------------------------------ point query
+
+    def query(self, key_id: int, namespace: Optional[int] = None
+              ) -> Dict[int, Dict[str, float]]:
+        """Point lookup for queryable state: finished result columns for the
+        key, per namespace (reference: flink-queryable-state KvState lookup
+        against the live backend). Read-only."""
+        nss = ([int(namespace)] if namespace is not None
+               else [int(n) for n in self.index.namespaces])
+        if not nss:
+            return {}
+        keys = np.full(len(nss), int(key_id), dtype=np.int64)
+        slots = self.index.lookup(keys, np.asarray(nss, dtype=np.int64))
+        hit = slots >= 0
+        if not hit.any():
+            return {}
+        matrix = slots[hit][:, None].astype(np.int32)
+        results = self.fire(matrix)
+        out: Dict[int, Dict[str, float]] = {}
+        hit_nss = [n for n, h in zip(nss, hit) if h]
+        for i, ns in enumerate(hit_nss):
+            out[ns] = {name: col[i].item()
+                       for name, col in results.items()}
+        return out
 
     # ---------------------------------------------------------- snapshot/restore
 

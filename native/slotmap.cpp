@@ -164,6 +164,30 @@ int32_t sm_lookup_or_insert(void* h, int64_t n, const int64_t* keys,
   return grows;
 }
 
+// Read-only batch probe: out_slots[i] = slot id, or -1 if the pair is not
+// present. Never inserts — this is the queryable-state point-lookup path
+// (the role of the reference's QueryableStateClient -> KvStateServer
+// lookups against the live backend).
+void sm_lookup(void* h, int64_t n, const int64_t* keys, const int64_t* nss,
+               int32_t* out_slots) {
+  SlotMap* m = (SlotMap*)h;
+  uint64_t mask = (uint64_t)m->bucket_count - 1;
+  for (int64_t r = 0; r < n; r++) {
+    int64_t k = keys[r], ns = nss[r];
+    uint64_t i = mix_hash((uint64_t)k, (uint64_t)ns) & mask;
+    out_slots[r] = -1;
+    for (;;) {
+      int32_t b = m->buckets[i];
+      if (b == -1) break;
+      if (m->slot_key[b] == k && m->slot_ns[b] == ns) {
+        out_slots[r] = b;
+        break;
+      }
+      i = (i + 1) & mask;
+    }
+  }
+}
+
 // Erase pairs; writes freed slot ids to out_slots (only for pairs that were
 // present). Returns the number actually erased. Deletion is backward-shift
 // (Knuth 6.4 algorithm R): no tombstones, so probe chains stay short under

@@ -178,3 +178,37 @@ class TestIncrementalE2E:
                      if not read_manifest(os.path.join(root, d))
                      ["extra"].get("incremental")]
         assert full_dirs, "the full base must have survived retention"
+
+
+def test_savepoint_inside_root_is_not_a_delta_base(tmp_path):
+    """Restoring from a savepoint that happens to live inside the
+    checkpoint root must NOT seed the delta chain: its manifest id would
+    alias an unrelated sibling chk-<id>. The first post-restore checkpoint
+    must be full."""
+    from flink_tpu.state_processor import SavepointWriter
+
+    root = str(tmp_path / "ck")
+    run_windowed(tmp_path, "ck", 10_240,
+                 {"execution.checkpointing.incremental": True,
+                  "execution.checkpointing.incremental.full-every": 4})
+    # savepoint written INSIDE the root, pinned at an id that collides
+    # with a live sibling checkpoint
+    sp = os.path.join(root, "sp-in-root")
+    w = SavepointWriter.from_existing(root)
+    w.checkpoint_id = max(int(d[4:]) for d in os.listdir(root)
+                          if d.startswith("chk-")) - 1
+    w.write(sp)
+    before = {d for d in os.listdir(root) if d.startswith("chk-")}
+    run_windowed(tmp_path, "ck", 20_480,
+                 {"execution.checkpointing.incremental": True,
+                  "execution.checkpointing.incremental.full-every": 4},
+                 restore=sp)
+    new_ids = sorted(int(d[4:]) for d in os.listdir(root)
+                     if d.startswith("chk-") and d not in before)
+    assert new_ids, "resumed run wrote checkpoints"
+    first = read_manifest(os.path.join(root, f"chk-{new_ids[0]}"))
+    assert not first["extra"].get("incremental"), \
+        "first post-savepoint-restore checkpoint must be full"
+    # and the whole new chain still materializes
+    assert read_checkpoint_chain(
+        os.path.join(root, f"chk-{new_ids[-1]}"))

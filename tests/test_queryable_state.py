@@ -1,0 +1,143 @@
+"""Queryable state: live point-lookups against a running job.
+
+reference model: flink-queryable-state ITCases (QueryableStateITCase).
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flink_tpu.cluster.minicluster import FINISHED, MiniCluster
+from flink_tpu.cluster.queryable_state import QueryableStateClient
+from flink_tpu.connectors.sinks import DiscardingSink
+from flink_tpu.connectors.sources import DataGenSource
+from flink_tpu.core.config import Configuration
+from flink_tpu.datastream.environment import StreamExecutionEnvironment
+from flink_tpu.runtime.watermarks import WatermarkStrategy
+from flink_tpu.state.slot_table import SlotTable
+from flink_tpu.windowing.aggregates import CountAggregate, SumAggregate
+from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+
+class SlowDataGen(DataGenSource):
+    def poll_batch(self, max_records):
+        b = super().poll_batch(max_records)
+        if b is not None:
+            time.sleep(0.01)
+        return b
+
+
+class TestSlotTableQuery:
+    def test_point_query_readonly(self):
+        agg = SumAggregate("v")
+        t = SlotTable(agg, capacity=1024)
+        keys = np.array([7, 8, 7], dtype=np.int64)
+        ns = np.array([100, 100, 200], dtype=np.int64)
+        slots = t.lookup_or_insert(keys, ns)
+        t.scatter(slots, (np.array([1.0, 2.0, 4.0], dtype=np.float32),))
+        used_before = t.num_used
+        assert t.query(7) == {100: {"sum_v": 1.0}, 200: {"sum_v": 4.0}}
+        assert t.query(8, namespace=100) == {100: {"sum_v": 2.0}}
+        assert t.query(8, namespace=999) == {}
+        assert t.query(12345) == {}  # miss never allocates
+        assert t.num_used == used_before
+
+    def test_lookup_probe_both_backends(self, monkeypatch):
+        import flink_tpu.native as native_mod
+
+        for force_py in (False, True):
+            if force_py:
+                monkeypatch.setenv("FLINK_TPU_NO_NATIVE", "1")
+            t = SlotTable(SumAggregate("v"), capacity=1024)
+            s = t.lookup_or_insert(np.array([5], dtype=np.int64),
+                                   np.array([1], dtype=np.int64))
+            probe = t.index.lookup(np.array([5, 6], dtype=np.int64),
+                                   np.array([1, 1], dtype=np.int64))
+            assert probe[0] == s[0] and probe[1] == -1
+            monkeypatch.delenv("FLINK_TPU_NO_NATIVE", raising=False)
+
+
+class TestQueryableStateE2E:
+    def test_query_running_job_and_rest(self):
+        cluster = MiniCluster(Configuration({"rest.port": 0}))
+        try:
+            env = StreamExecutionEnvironment(Configuration(
+                {"execution.micro-batch.size": 256}))
+            (env.add_source(
+                SlowDataGen(total_records=60_000, num_keys=8,
+                            events_per_second_of_eventtime=5_000),
+                WatermarkStrategy.for_bounded_out_of_orderness(0))
+                .key_by("key")
+                .window(TumblingEventTimeWindows.of(100_000))
+                .count()
+                .sink_to(DiscardingSink()))
+            client = cluster.submit(env, "qs-job")
+            qs = QueryableStateClient(cluster)
+            deadline = time.monotonic() + 15
+            state = {}
+            while time.monotonic() < deadline:
+                try:
+                    state = qs.get_state(client.job_id,
+                                         "window_agg(CountAggregate)", 3)
+                    if state:
+                        break
+                except RuntimeError:
+                    pass
+                time.sleep(0.05)
+            assert state, "no state observed while job ran"
+            (ns, cols), = state.items()
+            assert cols["count"] > 0
+            first = cols["count"]
+
+            # the count grows as the stream continues
+            grew = False
+            for _ in range(100):
+                time.sleep(0.05)
+                try:
+                    s2 = qs.get_state(client.job_id,
+                                      "window_agg(CountAggregate)", 3)
+                except RuntimeError:
+                    break
+                if s2 and s2[ns]["count"] > first:
+                    grew = True
+                    break
+            assert grew, "count did not grow between queries"
+
+            # same lookup over REST
+            url = (f"http://127.0.0.1:{cluster.rest_port}/jobs/"
+                   f"{client.job_id}/state/window_agg(CountAggregate)?key=3")
+            body = json.loads(urllib.request.urlopen(url).read())
+            assert body["state"] and "count" in next(
+                iter(body["state"].values()))
+            client.cancel()
+        finally:
+            cluster.shutdown()
+
+    def test_query_unknown_operator_fails(self):
+        cluster = MiniCluster(Configuration({"rest.port": -1}))
+        try:
+            env = StreamExecutionEnvironment(Configuration(
+                {"execution.micro-batch.size": 256}))
+            (env.add_source(
+                SlowDataGen(total_records=40_000, num_keys=4,
+                            events_per_second_of_eventtime=5_000),
+                WatermarkStrategy.for_bounded_out_of_orderness(0))
+                .key_by("key")
+                .window(TumblingEventTimeWindows.of(100_000))
+                .count().sink_to(DiscardingSink()))
+            client = cluster.submit(env, "qs-unknown")
+            qs = QueryableStateClient(cluster)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                try:
+                    with pytest.raises(KeyError):
+                        qs.get_state(client.job_id, "nope", 3)
+                    break
+                except RuntimeError:
+                    time.sleep(0.05)
+            client.cancel()
+        finally:
+            cluster.shutdown()
