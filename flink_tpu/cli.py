@@ -112,7 +112,7 @@ def cmd_savepoint(args) -> int:
 
 
 def cmd_query(args) -> int:
-    q = {"key": args.key}
+    q = {"key": args.key, "key-type": args.key_type}
     if args.namespace is not None:
         q["namespace"] = str(args.namespace)
     op = urllib.parse.quote(args.operator, safe="")
@@ -180,6 +180,10 @@ def main(argv=None) -> int:
     ps.add_argument("job_id")
     ps.add_argument("operator")
     ps.add_argument("key")
+    ps.add_argument("--key-type", default="auto",
+                    choices=["auto", "int", "float", "string"],
+                    help="force the key's type (string keys that look "
+                    "numeric need 'string')")
     ps.add_argument("--namespace", type=int)
     ps.add_argument("--rest", default="127.0.0.1:8081")
     ps.set_defaults(fn=cmd_query)

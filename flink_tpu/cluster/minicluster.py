@@ -34,17 +34,22 @@ from flink_tpu.core.config import (
     CheckpointOptions,
     ClusterOptions,
     Configuration,
+    SchedulerOptions,
     StateOptions,
 )
 
-# job lifecycle (reference: org.apache.flink.api.common.JobStatus)
+# job lifecycle (reference: org.apache.flink.api.common.JobStatus; the
+# WAITING_FOR_RESOURCES state comes from the adaptive scheduler's state
+# machine, reference: scheduler/adaptive/WaitingForResources.java)
 CREATED = "CREATED"
+WAITING_FOR_RESOURCES = "WAITING_FOR_RESOURCES"
 RUNNING = "RUNNING"
 RESTARTING = "RESTARTING"
 FINISHED = "FINISHED"
 FAILED = "FAILED"
 CANCELED = "CANCELED"
 TERMINAL = (FINISHED, FAILED, CANCELED)
+_RESCALED = "RESCALED"  # internal attempt outcome, not a job status
 
 
 class TaskExecutorEndpoint(RpcEndpoint):
@@ -286,7 +291,16 @@ class JobMasterThread:
         self.result = None
         self.restart_strategy: RestartStrategy = \
             restart_strategy_from_config(config)
+        self.adaptive = config.get(SchedulerOptions.MODE) == "adaptive"
+        #: adaptive-scheduler state machine transcript
+        #: (reference: AdaptiveScheduler's State objects)
+        self.state_history: List[tuple] = [(CREATED, time.time())]
+        self._rescale_requested = threading.Event()
         self._cancel_requested = threading.Event()
+        # suspension (cluster shutdown / leadership loss) terminates the
+        # attempt but is NOT globally terminal: the job stays in the HA
+        # store for the next leader (reference: JobStatus.SUSPENDED)
+        self._suspended = threading.Event()
         self._done = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name=f"jobmaster-{job_id}", daemon=True)
@@ -308,16 +322,57 @@ class JobMasterThread:
         finally:
             if self.status not in TERMINAL:
                 self.status = FAILED
+            # globally-terminal jobs leave the HA job graph store; a
+            # suspended job (cluster shutdown) stays for the next leader
+            # (reference: Dispatcher#jobReachedTerminalState vs SUSPENDED)
+            store = getattr(self.cluster, "job_graph_store", None)
+            if store is not None and not self._suspended.is_set():
+                try:
+                    store.remove(self.job_id)
+                except Exception:
+                    pass
             self._done.set()
+
+    def _set_status(self, status: str) -> None:
+        self.status = status
+        self.state_history.append((status, time.time()))
+
+    def _acquire_slot(self, rm):
+        """Default mode: fail fast without a slot. Adaptive: enter
+        WaitingForResources and poll until a slot appears or the wait
+        timeout expires (reference: WaitingForResources state)."""
+        slot = rm.request_slot()
+        if slot is not None or not self.adaptive:
+            return slot
+        self._set_status(WAITING_FOR_RESOURCES)
+        deadline = time.monotonic() + self.config.get(
+            SchedulerOptions.RESOURCE_WAIT_TIMEOUT_MS) / 1000.0
+        while time.monotonic() < deadline:
+            if self._cancel_requested.is_set():
+                return None
+            slot = rm.request_slot()
+            if slot is not None:
+                # settle: let the resource picture stabilize briefly
+                time.sleep(self.config.get(
+                    SchedulerOptions.RESOURCE_STABILIZATION_MS) / 1000.0)
+                return slot
+            time.sleep(0.02)
+        return None
 
     def _supervise(self) -> None:
         rm = self.cluster.rm_gateway()
         ckpt_dir = self.config.get(StateOptions.CHECKPOINT_DIR)
         while True:
-            slot = rm.request_slot()
+            slot = self._acquire_slot(rm)
             if slot is None:
-                self.status = FAILED
-                self.error = RuntimeError("no slots available")
+                if self._cancel_requested.is_set():
+                    self._set_status(CANCELED)
+                    return
+                self._set_status(FAILED)
+                self.error = RuntimeError(
+                    "no slots available" + (
+                        " within the resource wait timeout"
+                        if self.adaptive else ""))
                 return
             self._current_executor = slot["executor_id"]
             self._current_address = slot["address"]
@@ -327,7 +382,7 @@ class JobMasterThread:
                 te = self.cluster.service.connect(slot["address"],
                                                   slot["executor_id"])
                 restore = self._latest_restore_path(ckpt_dir)
-                self.status = RUNNING
+                self._set_status(RUNNING)
                 te.submit_task(execution_id, self.graph,
                                self.config.to_dict(), self.job_name, restore)
                 outcome = self._watch(te, execution_id)
@@ -343,33 +398,55 @@ class JobMasterThread:
                 except Exception:
                     pass
             if outcome == FINISHED:
-                self.status = FINISHED
+                self._set_status(FINISHED)
                 return
             if outcome == CANCELED:
-                self.status = CANCELED
+                self._set_status(CANCELED)
                 return
+            if outcome == _RESCALED:
+                if self._cancel_requested.is_set():
+                    self._set_status(CANCELED)
+                    return
+                # reactive rescale (adaptive scheduler): redeploy from the
+                # latest checkpoint on the changed resource set WITHOUT
+                # consuming restart budget — a rescale is not a failure
+                # (reference: AdaptiveScheduler Executing -> Restarting on
+                # resource change)
+                self._rescale_requested.clear()
+                self.attempt += 1
+                self._set_status(RESTARTING)
+                continue
             # failure path
             self.restart_strategy.notify_failure()
             if self._cancel_requested.is_set():
-                self.status = CANCELED
+                self._set_status(CANCELED)
                 return
             if not self.restart_strategy.can_restart():
-                self.status = FAILED
+                self._set_status(FAILED)
                 return
             self.attempt += 1
-            self.status = RESTARTING
+            self._set_status(RESTARTING)
             time.sleep(self.restart_strategy.backoff_ms() / 1000.0)
 
     def _watch(self, te, execution_id: str) -> str:
         """Poll task status + executor liveness until a terminal outcome."""
         timeout_s = self.config.get(
             ClusterOptions.HEARTBEAT_TIMEOUT_MS) / 1000.0
+        rescaling = False
         while True:
             if self._cancel_requested.is_set():
                 try:
                     te.cancel_task(execution_id)
                 except Exception:
                     return CANCELED
+            elif self._rescale_requested.is_set() and not rescaling:
+                # adaptive reactive rescale: stop this attempt cleanly; the
+                # supervision loop redeploys on the new resource picture
+                rescaling = True
+                try:
+                    te.cancel_task(execution_id)
+                except Exception:
+                    return _RESCALED
             try:
                 st = te.task_status(execution_id)
             except Exception as e:  # executor gone: treat as task failure
@@ -380,6 +457,11 @@ class JobMasterThread:
                         self._current_executor)
                 return FAILED
             if st["status"] in TERMINAL:
+                if rescaling and st["status"] == CANCELED and \
+                        not self._cancel_requested.is_set():
+                    # user cancellation racing the rescale wins: never
+                    # resurrect a cancelled job
+                    return _RESCALED
                 self.error = st["error"]
                 return st["status"]
             hb = self.cluster.last_heartbeat(self._current_executor)
@@ -408,9 +490,29 @@ class JobMasterThread:
             pass
         return None
 
+    def on_new_resources(self) -> None:
+        """Reactive-mode hook: the resource picture changed (reference:
+        AdaptiveScheduler#onNewResourcesAvailable). A rescale redeploy is
+        only safe when the job can resume from a checkpoint — without
+        checkpointing it would replay from record 0 and double-emit (the
+        reference's reactive mode likewise requires checkpointing)."""
+        if not (self.adaptive and self.status == RUNNING):
+            return
+        has_ckpt = bool(self.config.get(StateOptions.CHECKPOINT_DIR)) and (
+            self.config.get(CheckpointOptions.INTERVAL_MS)
+            or self.config.get(CheckpointOptions.EVERY_N_BATCHES))
+        if has_ckpt:
+            self._rescale_requested.set()
+
     # -- client surface -----------------------------------------------------
 
     def cancel(self) -> None:
+        self._cancel_requested.set()
+
+    def suspend(self) -> None:
+        """Terminate the attempt WITHOUT removing the job from the HA
+        store (cluster shutdown / leadership loss)."""
+        self._suspended.set()
         self._cancel_requested.set()
 
     def trigger_savepoint(self, path: str, stop: bool = False,
@@ -455,12 +557,37 @@ class DispatcherEndpoint(RpcEndpoint):
         self.cluster = cluster
         self._masters: Dict[str, JobMasterThread] = {}
 
-    def submit_job(self, graph, config_dict: dict, job_name: str) -> str:
-        job_id = uuid.uuid4().hex[:16]
+    def submit_job(self, graph, config_dict: dict, job_name: str,
+                   job_id: Optional[str] = None) -> str:
+        job_id = job_id or uuid.uuid4().hex[:16]
+        store = getattr(self.cluster, "job_graph_store", None)
+        if store is not None:
+            # persist BEFORE starting: a dispatcher that dies right after
+            # accepting the submission must still recover the job
+            store.put(job_id, job_name, graph, config_dict)
         master = JobMasterThread(self.cluster, job_id, job_name, graph,
                                  Configuration(config_dict))
         self._masters[job_id] = master
         return job_id
+
+    def recover_jobs(self) -> List[str]:
+        """Resubmit every unfinished job from the HA job graph store
+        (reference: Dispatcher HA recovery via JobGraphStore on leadership
+        grant)."""
+        store = getattr(self.cluster, "job_graph_store", None)
+        if store is None:
+            return []
+        recovered = []
+        for job_id in store.job_ids():
+            if job_id in self._masters:
+                continue
+            rec = store.get(job_id)
+            master = JobMasterThread(self.cluster, job_id, rec["job_name"],
+                                     rec["graph"],
+                                     Configuration(rec["config"]))
+            self._masters[job_id] = master
+            recovered.append(job_id)
+        return recovered
 
     def job_status(self, job_id: str) -> dict:
         m = self._masters.get(job_id)
@@ -468,7 +595,9 @@ class DispatcherEndpoint(RpcEndpoint):
             return {"status": "UNKNOWN"}
         return {"status": m.status, "attempt": m.attempt,
                 "error": repr(m.error) if m.error else None,
-                "name": m.job_name}
+                "name": m.job_name,
+                "state_history": [
+                    {"state": s, "ts": ts} for s, ts in m.state_history]}
 
     def list_jobs(self) -> List[dict]:
         return [dict(self.job_status(jid), job_id=jid)
@@ -557,10 +686,22 @@ class MiniCluster:
     between the roles, background heartbeat pump."""
 
     def __init__(self, config: Optional[Configuration] = None):
+        from flink_tpu.core.config import HighAvailabilityOptions
+
         self.config = config or Configuration()
         self.service = RpcService()
         self.rm = ResourceManagerEndpoint()
         self.service.register(self.rm)
+        # HA services (reference: HighAvailabilityServices wiring)
+        self.job_graph_store = None
+        self.blob_store = None
+        ha_mode = self.config.get(HighAvailabilityOptions.MODE)
+        ha_dir = self.config.get(HighAvailabilityOptions.STORAGE_DIR)
+        if ha_mode == "filesystem" and ha_dir:
+            from flink_tpu.cluster.ha import BlobStore, JobGraphStore
+
+            self.job_graph_store = JobGraphStore(ha_dir)
+            self.blob_store = BlobStore(ha_dir)
         self.dispatcher = DispatcherEndpoint(self)
         self.service.register(self.dispatcher)
         self.executors: List[TaskExecutorEndpoint] = []
@@ -570,6 +711,36 @@ class MiniCluster:
         slots = self.config.get(ClusterOptions.SLOTS_PER_EXECUTOR)
         for i in range(n):
             self.add_task_executor(slots)
+        # HA recovery happens only on winning dispatcher leadership — a
+        # standby sharing the storageDir must NOT also run the jobs
+        # (reference: DispatcherLeaderProcess recovers on leadership grant)
+        self._leader_election = None
+        if self.job_graph_store is not None:
+            from flink_tpu.cluster.ha import (
+                FileLeaderElectionDriver,
+                LeaderContender,
+                LeaderElectionService,
+            )
+            from flink_tpu.core.config import HighAvailabilityOptions
+
+            cluster = self
+
+            class _DispatcherContender(LeaderContender):
+                def grant_leadership(self, fencing_token):
+                    cluster.dispatcher.recover_jobs()
+
+                def revoke_leadership(self):
+                    pass  # running jobs keep running; new recovery stops
+
+            lease_s = self.config.get(
+                HighAvailabilityOptions.LEASE_TIMEOUT_MS) / 1000.0
+            self._leader_election = LeaderElectionService(
+                FileLeaderElectionDriver(
+                    self.config.get(HighAvailabilityOptions.STORAGE_DIR),
+                    "dispatcher", lease_timeout_s=lease_s),
+                _DispatcherContender(), poll_interval_s=min(lease_s / 4,
+                                                            0.25))
+            self._leader_election.start()
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, name="heartbeat-manager",
             daemon=True)
@@ -591,6 +762,9 @@ class MiniCluster:
             te.endpoint_id, self.service.address, num_slots)
         self.executors.append(te)
         self._heartbeats[te.endpoint_id] = time.monotonic()
+        # adaptive-scheduler jobs react to the changed resource picture
+        for master in list(self.dispatcher._masters.values()):
+            master.on_new_resources()
         return te
 
     def kill_task_executor(self, executor_id: str) -> None:
@@ -650,9 +824,14 @@ class MiniCluster:
         return self._rest.port if self._rest else None
 
     def shutdown(self) -> None:
+        if self._leader_election is not None:
+            self._leader_election.stop()  # graceful release -> standby wins
         self._hb_stop.set()
-        for jid in list(self.dispatcher._masters):
-            self.dispatcher.cancel_job(jid)
+        for jid, master in list(self.dispatcher._masters.items()):
+            if self.job_graph_store is not None:
+                master.suspend()  # job survives in the HA store
+            else:
+                master.cancel()
         if self._rest is not None:
             self._rest.close()
         self.service.stop()

@@ -118,10 +118,20 @@ class RestServer:
         if "key" not in q:
             raise KeyError("missing ?key=")
         key: object = q["key"][0]
-        try:
-            key = int(key)  # numeric keys queried as numbers
-        except ValueError:
-            pass
+        # key-type=string|int|float forces the key's Python type; the
+        # default 'auto' tries int (string keys that LOOK numeric need the
+        # explicit override — int 3 and "3" hash differently, like the
+        # reference's typed key serializers)
+        key_type = q.get("key-type", ["auto"])[0]
+        if key_type == "int":
+            key = int(key)
+        elif key_type == "float":
+            key = float(key)
+        elif key_type == "auto":
+            try:
+                key = int(key)
+            except ValueError:
+                pass
         ns = int(q["namespace"][0]) if "namespace" in q else None
         result = self.cluster.dispatcher.query_state(
             job_id, unquote(operator_name), key, ns)

@@ -219,3 +219,40 @@ class ClusterOptions:
     REST_PORT = ConfigOption(
         "rest.port", default=0, type=int,
         description="REST status endpoint port; 0 = ephemeral, -1 = off.")
+
+
+class SchedulerOptions:
+    """reference: JobManagerOptions.SCHEDULER + adaptive scheduler knobs."""
+
+    MODE = ConfigOption(
+        "jobmanager.scheduler", default="default", type=str,
+        description="'default' (fail fast when no resources) or 'adaptive' "
+        "(wait for resources, rescale reactively on resource change — "
+        "reference: scheduler/adaptive/AdaptiveScheduler.java).")
+    RESOURCE_WAIT_TIMEOUT_MS = ConfigOption(
+        "jobmanager.adaptive-scheduler.resource-wait-timeout-ms",
+        default=30_000, type=int,
+        description="How long WaitingForResources waits for a slot before "
+        "the job fails.")
+    RESOURCE_STABILIZATION_MS = ConfigOption(
+        "jobmanager.adaptive-scheduler.resource-stabilization-timeout-ms",
+        default=100, type=int,
+        description="Settle time after a resource change before (re)acting "
+        "on it.")
+
+
+class HighAvailabilityOptions:
+    """reference: HighAvailabilityOptions (high-availability.* keys)."""
+
+    MODE = ConfigOption(
+        "high-availability.type", default="none", type=str,
+        description="'none' or 'filesystem' (file-lock leader election + "
+        "persisted job graph store; the role ZooKeeper/K8s drivers play in "
+        "the reference).")
+    STORAGE_DIR = ConfigOption(
+        "high-availability.storageDir", default=None, type=str,
+        description="Directory for leader locks, job graph store and blobs.")
+    LEASE_TIMEOUT_MS = ConfigOption(
+        "high-availability.lease-timeout-ms", default=3000, type=int,
+        description="Leader lease considered stale after this long without "
+        "renewal.")

@@ -221,14 +221,22 @@ class WindowAggOperator(Operator):
         }
 
     def query_state(self, key_value, namespace=None):
-        """Queryable-state point lookup: {namespace -> result columns} for
-        one key (reference: queryable state KvState lookup). Served on the
-        task loop at a batch boundary, so reads are race-free
-        (single-owner discipline, like the reference's mailbox)."""
+        """Queryable-state point lookup: {window_end -> result columns} for
+        one key — window values are composed from per-slice partial
+        accumulators, so sliding/cumulative windows return true window
+        results, not slice fragments (reference: queryable state KvState
+        lookup). Served on the task loop at a batch boundary, so reads are
+        race-free (single-owner discipline, like the reference's mailbox).
+        ``namespace`` restricts to one window end."""
         from flink_tpu.state.keygroups import hash_keys_to_i64
 
         key_id = int(hash_keys_to_i64(np.asarray([key_value]))[0])
-        return self.windower.table.query(key_id, namespace)
+        out = self.windower.table.query_windows(key_id,
+                                                self.windower.assigner)
+        if namespace is not None:
+            return ({int(namespace): out[int(namespace)]}
+                    if int(namespace) in out else {})
+        return out
 
     def restore_state(self, state):
         self.windower.restore(state["windower"])
@@ -258,6 +266,25 @@ class SessionWindowAggOperator(WindowAggOperator):
             self.gap, self.agg, capacity=self.capacity,
             max_parallelism=ctx.max_parallelism,
             allowed_lateness=self.allowed_lateness)
+
+    def query_state(self, key_value, namespace=None):
+        """Session variant: the key's live sessions are host metadata
+        ({key -> [(start, end, sid)]}); each session's accumulator lives
+        under its session id. Returns {session_end -> result columns}."""
+        from flink_tpu.state.keygroups import hash_keys_to_i64
+
+        key_id = int(hash_keys_to_i64(np.asarray([key_value]))[0])
+        w = self.windower
+        w._flush_merges()
+        out = {}
+        for start, end, sid in w.sessions.get(key_id, []):
+            per_sid = w.table.query(key_id, namespace=sid)
+            if sid in per_sid:
+                out[int(end)] = per_sid[sid]
+        if namespace is not None:
+            return ({int(namespace): out[int(namespace)]}
+                    if int(namespace) in out else {})
+        return out
 
 
 class UnionOperator(Operator):

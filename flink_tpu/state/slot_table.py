@@ -432,7 +432,12 @@ class SlotTable:
             return {name: np.empty(0) for name in self.agg.output_names}
         wp = sticky_bucket(w, self._fire_bucket, minimum=64)
         self._fire_bucket = wp
-        padded = np.zeros((wp, k), dtype=np.int32)
+        return self._fire_padded(slot_matrix, wp)
+
+    def _fire_padded(self, slot_matrix: np.ndarray,
+                     bucket: int) -> Dict[str, np.ndarray]:
+        w, k = slot_matrix.shape
+        padded = np.zeros((bucket, k), dtype=np.int32)
         padded[:w] = slot_matrix
         out = self.agg._fire_jit(self.accs, jnp.asarray(padded))
         return {name: np.asarray(col)[:w] for name, col in out.items()}
@@ -469,7 +474,8 @@ class SlotTable:
               ) -> Dict[int, Dict[str, float]]:
         """Point lookup for queryable state: finished result columns for the
         key, per namespace (reference: flink-queryable-state KvState lookup
-        against the live backend). Read-only."""
+        against the live backend). Read-only — including the sticky fire
+        bucket, which belongs to the hot window-fire path."""
         nss = ([int(namespace)] if namespace is not None
                else [int(n) for n in self.index.namespaces])
         if not nss:
@@ -480,13 +486,45 @@ class SlotTable:
         if not hit.any():
             return {}
         matrix = slots[hit][:, None].astype(np.int32)
-        results = self.fire(matrix)
+        results = self._fire_padded(matrix,
+                                    pad_bucket_size(len(matrix), minimum=64))
         out: Dict[int, Dict[str, float]] = {}
         hit_nss = [n for n, h in zip(nss, hit) if h]
         for i, ns in enumerate(hit_nss):
             out[ns] = {name: col[i].item()
                        for name, col in results.items()}
         return out
+
+    def query_windows(self, key_id: int, assigner
+                      ) -> Dict[int, Dict[str, float]]:
+        """Point lookup composing WINDOW results from per-slice partial
+        accumulators (slice sharing: a sliding window's value = merge of k
+        slices — reference: SliceAssigners slice/window mapping). Returns
+        {window_end -> finished result columns} for the key. Read-only."""
+        live_ns = np.asarray([int(n) for n in self.index.namespaces],
+                             dtype=np.int64)
+        if len(live_ns) == 0:
+            return {}
+        keys = np.full(len(live_ns), int(key_id), dtype=np.int64)
+        slots = self.index.lookup(keys, live_ns)
+        hit = slots >= 0
+        if not hit.any():
+            return {}
+        slice_slot = {int(n): int(s)
+                      for n, s, h in zip(live_ns, slots, hit) if h}
+        windows = sorted({
+            int(w)
+            for se in slice_slot
+            for w in assigner.window_ends_for_slice(se)})
+        k = max(len(assigner.slice_ends_for_window(w)) for w in windows)
+        matrix = np.zeros((len(windows), k), dtype=np.int32)
+        for i, w in enumerate(windows):
+            for j, se in enumerate(assigner.slice_ends_for_window(w)):
+                matrix[i, j] = slice_slot.get(int(se), 0)
+        results = self._fire_padded(
+            matrix, pad_bucket_size(len(matrix), minimum=64))
+        return {w: {name: col[i].item() for name, col in results.items()}
+                for i, w in enumerate(windows)}
 
     # ---------------------------------------------------------- snapshot/restore
 

@@ -141,3 +141,30 @@ class TestQueryableStateE2E:
             client.cancel()
         finally:
             cluster.shutdown()
+
+
+class TestSlidingWindowQuery:
+    def test_query_composes_window_values_from_slices(self):
+        """Sliding windows: a query must return true WINDOW results
+        (merged across slices), not per-slice fragments."""
+        from flink_tpu.state.slot_table import SlotTable
+        from flink_tpu.windowing.assigners import SlidingEventTimeWindows
+
+        assigner = SlidingEventTimeWindows.of(1000, 250)  # k = 4 slices
+        agg = CountAggregate()
+        t = SlotTable(agg, capacity=1024)
+        # key 5 gets 3 records in slice (0,250], 2 in (250,500]
+        keys = np.array([5] * 5, dtype=np.int64)
+        ts = np.array([10, 20, 30, 260, 270], dtype=np.int64)
+        ns = assigner.assign_slice_ends(ts)
+        slots = t.lookup_or_insert(keys, ns)
+        t.scatter(slots, agg.map_input(
+            type("B", (), {"__len__": lambda s: 5})()))
+        res = t.query_windows(5, assigner)
+        # window ending 500 covers both slices -> 5; window ending 250
+        # covers only the first slice -> 3
+        assert res[500]["count"] == 5
+        assert res[250]["count"] == 3
+        # per-slice namespaces are NOT window results
+        assert set(res) == {250, 500, 750, 1000, 1250}
+        assert res[1250]["count"] == 2  # only the second slice reaches it
