@@ -289,6 +289,14 @@ class LocalExecutor:
                         since_full = since_full + 1 if use_delta else 1
                         if claimed is not None:
                             claimed.on_checkpoint_complete(new_dir)
+                        # checkpoint durable -> two-phase sinks publish
+                        # (reference: notifyCheckpointComplete -> commit)
+                        for node in nodes.values():
+                            op = node.operator
+                            if op is not None and hasattr(
+                                    op, "notify_checkpoint_complete"):
+                                op.notify_checkpoint_complete(
+                                    checkpoint_count)
                         storage.retain(
                             self.config.get(CheckpointOptions.RETAINED))
                         last_ckpt = time.time() * 1000

@@ -135,8 +135,17 @@ class DataStream:
     # ---------------------------------------------------------------- sinks
 
     def sink_to(self, sink: "Sink", name: str = "sink") -> "DataStreamSink":
+        from flink_tpu.connectors.two_phase import (
+            TwoPhaseCommitSink,
+            TwoPhaseSinkOperator,
+        )
+
+        if isinstance(sink, TwoPhaseCommitSink):
+            factory = lambda: TwoPhaseSinkOperator(sink)  # noqa: E731
+        else:
+            factory = lambda: SinkOperator(sink)  # noqa: E731
         t = Transformation(name=name, kind="sink",
-                           operator_factory=lambda: SinkOperator(sink),
+                           operator_factory=factory,
                            inputs=[self.transformation])
         self.env._sinks.append(t)
         return DataStreamSink(self.env, t, sink)
