@@ -8,6 +8,13 @@ index -> jitted scatter/gather kernels on the active JAX backend.
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Diagnostics (fire latency percentiles, result counts) go to stderr.
 
+Robustness: the TPU backend in this environment is tunneled and flaky —
+init can hang or fail outright. The backend is therefore probed in a
+SUBPROCESS with a hard timeout and retried with backoff; if it never comes
+up, the benchmark falls back to CPU and still emits the JSON line (with an
+"error" field naming the degradation) and exits 0. A missing perf number
+is worse than a degraded one.
+
 Baseline note (see BASELINE.md): the reference (Apache Flink, JVM) cannot be
 built or executed in this zero-egress container and publishes no absolute
 numbers in-repo. vs_baseline is computed against the documented proxy of
@@ -17,12 +24,57 @@ numbers in-repo. vs_baseline is computed against the documented proxy of
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-from flink_tpu.platform import sync_platform as _sync_platform
-
 PROXY_BASELINE_EVENTS_PER_S = 500_000.0
+
+_PROBE_SCRIPT = r"""
+import os, sys
+from flink_tpu.platform import sync_platform
+sync_platform()
+import jax
+devs = jax.devices()
+import jax.numpy as jnp
+x = jnp.ones((256, 256), dtype=jnp.bfloat16)
+(x @ x).block_until_ready()
+print(devs[0].platform)
+"""
+
+
+def probe_backend(timeouts=(90, 150, 240)) -> tuple:
+    """Probe the default (TPU) backend in a subprocess with a hard timeout.
+
+    Returns (ok, platform_or_error). A hanging or crashing init cannot take
+    the benchmark process down with it.
+    """
+    if os.environ.get("BENCH_PROBE_TIMEOUTS"):
+        timeouts = tuple(
+            int(t) for t in
+            os.environ["BENCH_PROBE_TIMEOUTS"].split(","))
+    last_err = "no attempts made"
+    for i, timeout_s in enumerate(timeouts):
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_SCRIPT],
+                capture_output=True, text=True, timeout=timeout_s,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            if proc.returncode == 0:
+                platform = proc.stdout.strip().splitlines()[-1]
+                print(f"# backend probe ok ({platform}) in "
+                      f"{time.time() - t0:.1f}s", file=sys.stderr)
+                return True, platform
+            last_err = (proc.stderr or proc.stdout).strip().splitlines()
+            last_err = last_err[-1] if last_err else f"rc={proc.returncode}"
+        except subprocess.TimeoutExpired:
+            last_err = f"backend init hang (> {timeout_s}s)"
+        print(f"# backend probe attempt {i + 1} failed: {last_err}",
+              file=sys.stderr)
+        if i + 1 < len(timeouts):
+            time.sleep(5 * (i + 1))  # backoff before retry
+    return False, str(last_err)
 
 
 def run(total_records: int, num_auctions: int = 100_000,
@@ -52,23 +104,57 @@ def run(total_records: int, num_auctions: int = 100_000,
     }
 
 
-def main():
-    _sync_platform()
-    import warnings
-
-    warnings.filterwarnings("ignore")
-    total = int(os.environ.get("BENCH_RECORDS", 8_000_000))
-    run(total_records=1 << 18, num_auctions=10_000)  # warmup/compile
-    stats = run(total_records=total)
-    print(f"# q5: {stats['results']} winner rows, "
-          f"fire_latency={stats['fire_latency_ms']}", file=sys.stderr)
-    value = stats["events_per_s"]
-    print(json.dumps({
+def emit(value: float, error: str = None) -> None:
+    line = {
         "metric": "nexmark_q5_hop_hot_items_events_per_sec_per_chip",
         "value": round(value, 1),
         "unit": "events/s",
         "vs_baseline": round(value / PROXY_BASELINE_EVENTS_PER_S, 3),
-    }))
+    }
+    if error:
+        line["error"] = error
+    print(json.dumps(line))
+    sys.stdout.flush()
+
+
+def main():
+    import warnings
+
+    warnings.filterwarnings("ignore")
+    error = None
+    if os.environ.get("BENCH_SKIP_PROBE") != "1":
+        ok, info = probe_backend()
+        if not ok:
+            error = f"tpu backend unavailable ({info}); measured on cpu"
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        elif info not in ("tpu", "axon"):
+            # probe "succeeded" but JAX silently fell back to another
+            # platform — mark the degradation rather than publishing a
+            # non-TPU number as a TPU one
+            error = f"measured on {info} (no tpu device came up)"
+    from flink_tpu.platform import sync_platform
+
+    sync_platform()
+
+    total = int(os.environ.get("BENCH_RECORDS", 8_000_000))
+    try:
+        run(total_records=1 << 18, num_auctions=10_000)  # warmup/compile
+        stats = run(total_records=total)
+    except Exception as e:  # degraded: still emit the JSON line
+        print(f"# benchmark run failed: {e!r}", file=sys.stderr)
+        try:
+            stats = run(total_records=1 << 19)  # smaller degraded run
+            error = ((error + "; " if error else "")
+                     + f"full run failed ({type(e).__name__}), "
+                       "value from reduced run")
+        except Exception as e2:
+            print(f"# degraded run also failed: {e2!r}", file=sys.stderr)
+            emit(0.0, (error + "; " if error else "")
+                 + f"benchmark failed: {e2!r}")
+            return
+    print(f"# q5: {stats['results']} winner rows, "
+          f"fire_latency={stats['fire_latency_ms']}", file=sys.stderr)
+    emit(stats["events_per_s"], error)
 
 
 if __name__ == "__main__":

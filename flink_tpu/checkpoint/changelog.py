@@ -205,7 +205,20 @@ class ChangelogKeyedBackend:
         snap = self.table.snapshot()
         seq = self.writer.next_sequence
         path = os.path.join(self.log_dir, f"materialized-{seq}.npz")
-        np.savez(path, **snap)
+        # atomic-rename discipline (as write_snapshot_dir / truncate): a
+        # crash mid-write must not leave a torn file restore() would pick
+        # as its replay base
+        # tmp name must end in .npz (np.savez appends it otherwise) and
+        # must NOT match the "materialized-" scan prefix restore() uses
+        for name in os.listdir(self.log_dir):
+            if name.startswith(".tmp-materialized-"):  # torn earlier write
+                try:
+                    os.remove(os.path.join(self.log_dir, name))
+                except OSError:
+                    pass
+        tmp = os.path.join(self.log_dir, f".tmp-materialized-{seq}.npz")
+        np.savez(tmp, **snap)
+        os.replace(tmp, path)
         self._materialized_seq = seq
         return {"changelog_seq": seq, "materialized_seq": seq}
 

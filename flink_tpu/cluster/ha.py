@@ -93,14 +93,20 @@ class FileLeaderElectionDriver:
         return False
 
     def renew(self) -> bool:
-        """Touch the lease; False if leadership was lost."""
+        """Touch the lease; False if leadership was lost.
+
+        The touch races a stale-lease ``os.replace`` steal (try_acquire):
+        between our read and the utime a stealer may have replaced the
+        file, so verify ownership AFTER touching — a renewing loser must
+        observe the loss rather than both sides believing they lead."""
         path = self._lock_path
         try:
             with open(path) as f:
                 if json.load(f).get("owner") != self.owner_id:
                     return False
             os.utime(path, None)
-            return True
+            with open(path) as f:
+                return json.load(f).get("owner") == self.owner_id
         except (OSError, ValueError):
             return False
 
@@ -214,8 +220,9 @@ class JobGraphStore:
 class BlobStore:
     """Content-addressed artifact store with a local cache
     (reference: runtime/blob/BlobServer + PermanentBlobCache). Keys are
-    sha256 of the content, so distribution is idempotent and cache hits
-    never revalidate."""
+    sha256 of the content, so distribution is idempotent; every read —
+    cache hit or store fetch — is verified against the key, and a
+    corrupted cache entry falls back to a store re-fetch."""
 
     def __init__(self, storage_dir: str,
                  cache_dir: Optional[str] = None):
@@ -240,7 +247,15 @@ class BlobStore:
             cached = os.path.join(self.cache_dir, key)
             if os.path.exists(cached):
                 with open(cached, "rb") as f:
-                    return f.read()
+                    data = f.read()
+                # the content-addressed contract holds for cache hits too:
+                # a corrupted cache entry falls through to a store re-fetch
+                if hashlib.sha256(data).hexdigest() == key:
+                    return data
+                try:
+                    os.remove(cached)
+                except OSError:
+                    pass
         with open(os.path.join(self.dir, key), "rb") as f:
             data = f.read()
         if hashlib.sha256(data).hexdigest() != key:

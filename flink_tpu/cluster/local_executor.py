@@ -157,12 +157,19 @@ class LocalExecutor:
 
         # build nodes
         nodes: Dict[int, _Node] = {}
-        ctx = OperatorContext(operator_index=0, parallelism=1,
-                              max_parallelism=max_parallelism)
+        default_par = self.config.get(CoreOptions.DEFAULT_PARALLELISM)
         for t in graph.nodes:
             op = t.operator_factory() if t.operator_factory else None
             node = _Node(t, op)
             if op is not None:
+                # explicit set_parallelism wins; otherwise keyed operators
+                # pick up parallelism.default (the mesh size of the
+                # key-group axis — reference: env default parallelism
+                # applied at StreamGraph generation)
+                par = t.parallelism if t.parallelism else (
+                    default_par if t.keyed else 1)
+                ctx = OperatorContext(operator_index=0, parallelism=par,
+                                      max_parallelism=max_parallelism)
                 op.open(ctx)
             nodes[t.uid] = node
             g = job_group.add_group(f"{t.name}#{t.uid}")

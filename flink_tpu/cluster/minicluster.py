@@ -570,17 +570,37 @@ class DispatcherEndpoint(RpcEndpoint):
         self._masters[job_id] = master
         return job_id
 
-    def recover_jobs(self) -> List[str]:
+    def recover_jobs(self, leader_check=None) -> List[str]:
         """Resubmit every unfinished job from the HA job graph store
         (reference: Dispatcher HA recovery via JobGraphStore on leadership
-        grant)."""
+        grant). ``leader_check`` is re-consulted before each resubmission —
+        recovery may run concurrently with a leadership loss."""
         store = getattr(self.cluster, "job_graph_store", None)
         if store is None:
             return []
         recovered = []
         for job_id in store.job_ids():
-            if job_id in self._masters:
-                continue
+            if leader_check is not None and not leader_check():
+                return recovered  # leadership lost mid-recovery: stop
+            existing = self._masters.get(job_id)
+            if existing is not None:
+                if existing._suspended.is_set():
+                    # a master this dispatcher suspended on leadership loss
+                    # is resumed when leadership returns (transient renew
+                    # blip) — once its thread has wound down
+                    if not existing._done.wait(timeout=10):
+                        continue  # still winding down; next grant retries
+                elif existing.status in TERMINAL:
+                    # a terminal (FINISHED/FAILED/CANCELED) job still in
+                    # the store means its remove() silently failed — retry
+                    # the removal, NEVER re-run it (duplicate sink output)
+                    try:
+                        store.remove(job_id)
+                    except Exception:
+                        pass
+                    continue
+                else:
+                    continue  # live master: must not double-start
             rec = store.get(job_id)
             master = JobMasterThread(self.cluster, job_id, rec["job_name"],
                                      rec["graph"],
@@ -727,10 +747,31 @@ class MiniCluster:
 
             class _DispatcherContender(LeaderContender):
                 def grant_leadership(self, fencing_token):
-                    cluster.dispatcher.recover_jobs()
+                    # recovery can block on winding-down masters, so it runs
+                    # OFF the election thread (which must keep renewing the
+                    # lease) and re-checks leadership before each resubmit
+                    election = cluster._leader_election
+
+                    def _recover():
+                        cluster.dispatcher.recover_jobs(
+                            leader_check=lambda: election is None
+                            or election.is_leader)
+
+                    threading.Thread(target=_recover,
+                                     name="dispatcher-recovery",
+                                     daemon=True).start()
 
                 def revoke_leadership(self):
-                    pass  # running jobs keep running; new recovery stops
+                    # split-brain guard: the new leader's recover_jobs()
+                    # will resubmit these jobs from the JobGraphStore, so
+                    # this dispatcher must stop running them (suspend keeps
+                    # them in the HA store for the new leader)
+                    for master in list(
+                            cluster.dispatcher._masters.values()):
+                        try:
+                            master.suspend()
+                        except Exception:
+                            pass
 
             lease_s = self.config.get(
                 HighAvailabilityOptions.LEASE_TIMEOUT_MS) / 1000.0

@@ -54,6 +54,12 @@ class TwoPhaseCommitSink:
         """Discard transaction leftovers not reachable from ``exclude``
         (restore-time cleanup of post-checkpoint writes)."""
 
+    def abort_current(self) -> None:
+        """Abandon the CURRENT (uncommitted) transaction without publishing
+        it. Called on failure-path dispose (reference:
+        TwoPhaseCommitSinkFunction.close aborts the current transaction);
+        the leftovers are cleaned by ``abort_uncommitted`` on restore."""
+
     def close(self) -> None:
         pass
 
@@ -115,6 +121,17 @@ class ExactlyOnceFileSink(TwoPhaseCommitSink):
                     f"committable lost: neither {pending} nor {final} "
                     "exists")
             # else: already committed (idempotent re-commit after failover)
+
+    def abort_current(self) -> None:
+        # close the handle but do NOT seal or publish: the .inprogress file
+        # stays on disk for restore-time abort_uncommitted cleanup
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+            self._current = None
 
     def abort_uncommitted(self, exclude: List[Any]) -> None:
         keep = {os.path.basename(c["pending"]) for c in exclude}
@@ -184,8 +201,12 @@ class TwoPhaseSinkOperator(Operator):
         return []
 
     def dispose(self) -> None:
+        # failure path: NEVER commit here — windows fired after the last
+        # checkpoint must not be published, or restore re-commits them and
+        # produces duplicates. Abort the open transaction; restore's
+        # abort_uncommitted cleans the leftovers.
         try:
-            self.sink.close()
+            self.sink.abort_current()
         except Exception:
             pass
 

@@ -88,6 +88,13 @@ class _MemFile(io.BytesIO):
         self._path = path
         self._writable = writable
 
+    def flush(self) -> None:
+        # mirror local-FS visibility: a write-then-flush is observable by
+        # readers even if close() is never reached
+        super().flush()
+        if self._writable:
+            self._fs._store[self._path] = self.getvalue()
+
     def close(self) -> None:
         if self._writable:
             self._fs._store[self._path] = self.getvalue()

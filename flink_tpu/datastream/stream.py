@@ -49,6 +49,17 @@ class DataStream:
         self.env = env
         self.transformation = transformation
 
+    def set_parallelism(self, parallelism: int) -> "DataStream":
+        """Parallelism of this operator (reference:
+        SingleOutputStreamOperator.setParallelism). For keyed window
+        operators, parallelism N > 1 executes on an N-device mesh with
+        state sharded over the key-group axis (MeshWindowEngine); the
+        config default is ``parallelism.default``."""
+        if parallelism < 1:
+            raise ValueError(f"parallelism must be >= 1, got {parallelism}")
+        self.transformation.parallelism = parallelism
+        return self
+
     # ------------------------------------------------------------ stateless
 
     def map(self, fn: Callable[[RecordBatch], RecordBatch],

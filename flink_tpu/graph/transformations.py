@@ -24,7 +24,10 @@ class Transformation:
     kind: str  # 'source' | 'one_input' | 'union' | 'sink'
     operator_factory: Optional[Callable[[], Any]] = None
     inputs: List["Transformation"] = dataclasses.field(default_factory=list)
-    parallelism: int = 1
+    #: None = unset -> the executor applies `parallelism.default` to keyed
+    #: operators (reference: Transformation.parallelism=-1 sentinel +
+    #: env default)
+    parallelism: Optional[int] = None
     # source-specific
     source: Any = None
     watermark_strategy: Any = None

@@ -96,6 +96,12 @@ class MeshWindowEngine:
         # window lifecycle metadata is global: watermarks and window ends are
         # aligned across shards
         self.book = SliceBookkeeper(assigner, allowed_lateness)
+        # incremental-snapshot bookkeeping, the mesh form of
+        # SlotTable._dirty: a [P, capacity] host bitmap of slots touched
+        # since the last snapshot + namespaces freed since (tombstones)
+        self._dirty = np.zeros((self.P, self.capacity), dtype=bool)
+        self._freed_ns: List[int] = []
+        self._gather_bucket = 0
 
     @property
     def late_records_dropped(self) -> int:
@@ -108,7 +114,8 @@ class MeshWindowEngine:
                      self.agg.cache_key())
         cached = _STEP_CACHE.get(cache_key)
         if cached is not None:
-            self._scatter_step, self._fire_step, self._reset_step = cached
+            (self._scatter_step, self._fire_step, self._reset_step,
+             self._gather_step) = cached
             return
         mesh = self.mesh
         leaves = self.agg.leaves
@@ -186,10 +193,27 @@ class MeshWindowEngine:
                 out_specs=(P(KEY_AXIS),) * n_leaves,
             )(*accs, slots)
 
+        @jax.jit
+        def gather_step(accs, slots):
+            # slots: [P, G] sharded -> per-leaf [P, G] raw accumulator
+            # values (delta-snapshot / point-query readback)
+            def local(*args):
+                accs_l = args[:n_leaves]
+                slots_l = args[n_leaves]
+                return tuple(a[0][slots_l[0]][None] for a in accs_l)
+
+            return jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(P(KEY_AXIS),) * (n_leaves + 1),
+                out_specs=(P(KEY_AXIS),) * n_leaves,
+            )(*accs, slots)
+
         self._scatter_step = scatter_step
         self._fire_step = fire_step
         self._reset_step = reset_step
-        _STEP_CACHE[cache_key] = (scatter_step, fire_step, reset_step)
+        self._gather_step = gather_step
+        _STEP_CACHE[cache_key] = (scatter_step, fire_step, reset_step,
+                                  gather_step)
 
     def _put_sharded(self, host_block: np.ndarray) -> jnp.ndarray:
         return jax.device_put(host_block, self._sharding)
@@ -232,6 +256,7 @@ class MeshWindowEngine:
             if c:
                 slot_block[p, :c] = self.indexes[p].lookup_or_insert(
                     key_block[p, :c], ns_block[p, :c])
+                self._dirty[p, slot_block[p, :c]] = True
 
         self.accs = self._scatter_step(
             self.accs,
@@ -317,10 +342,12 @@ class MeshWindowEngine:
     def _free_slices(self, ends: List[int]) -> None:
         f_max = 0
         freed: List[Optional[np.ndarray]] = []
+        self._freed_ns.extend(int(e) for e in ends)
         for p in range(self.P):
             slots = self.indexes[p].free_namespaces(ends)
             freed.append(slots)
             if slots is not None:
+                self._dirty[p, slots] = False
                 f_max = max(f_max, len(slots))
         if f_max == 0:
             return
@@ -332,9 +359,58 @@ class MeshWindowEngine:
                 block[p, : len(slots)] = slots
         self.accs = self._reset_step(self.accs, self._put_sharded(block))
 
+    # ---------------------------------------------------------- point query
+
+    def query_windows(self, key_id: int) -> Dict[int, Dict[str, float]]:
+        """Queryable-state point lookup, mesh form: route the key to its
+        owning shard (the same key-group formula the data path uses), probe
+        that shard's host index, gather its slice accumulators off the
+        device, and compose window results on host (slice sharing, as
+        SlotTable.query_windows). Read-only."""
+        shard = int(shard_records(
+            np.asarray([key_id], dtype=np.int64), self.P,
+            self.max_parallelism)[0])
+        idx = self.indexes[shard]
+        live_ns = np.asarray([int(n) for n in idx.namespaces],
+                             dtype=np.int64)
+        if len(live_ns) == 0:
+            return {}
+        keys = np.full(len(live_ns), int(key_id), dtype=np.int64)
+        slots = idx.lookup(keys, live_ns)
+        hit = slots >= 0
+        if not hit.any():
+            return {}
+        slice_slot = {int(n): int(s)
+                      for n, s, h in zip(live_ns, slots, hit) if h}
+        assigner = self.assigner
+        windows = sorted({
+            int(w)
+            for se in slice_slot
+            for w in assigner.window_ends_for_slice(se)})
+        k = max(len(assigner.slice_ends_for_window(w)) for w in windows)
+        # pad W to a bucket (slot 0 = reserved identity) — exact shapes
+        # would recompile fire_step per distinct live-window count
+        W = pad_bucket_size(len(windows), minimum=64)
+        sm = np.zeros((self.P, W, k), dtype=np.int32)
+        for i, w in enumerate(windows):
+            for j, se in enumerate(assigner.slice_ends_for_window(w)):
+                sm[shard, i, j] = slice_slot.get(int(se), 0)
+        results = self._fire_step(self.accs, self._put_sharded(sm))
+        return {w: {name: np.asarray(col)[shard][i].item()
+                    for name, col in results.items()}
+                for i, w in enumerate(windows)}
+
     # -------------------------------------------------------------- snapshot
 
-    def snapshot(self) -> Dict[str, object]:
+    def snapshot(self, mode: str = "full") -> Dict[str, object]:
+        """Logical snapshot merged over shards, re-shardable by key group.
+
+        mode: "full" (new incremental base), "delta" (dirty rows +
+        tombstones only), "savepoint" (full, preserving dirty tracking) —
+        the same contract as SliceSharedWindower.snapshot, so mesh and
+        single-device checkpoints are mutually restorable."""
+        if mode == "delta":
+            return {"table": self._snapshot_delta(), **self.book.snapshot()}
         accs_host = [np.asarray(a) for a in self.accs]
         parts = []
         for p in range(self.P):
@@ -351,7 +427,67 @@ class MeshWindowEngine:
         merged = {
             k: np.concatenate([pt[k] for pt in parts]) for k in parts[0]
         } if parts else {}
+        if mode != "savepoint":
+            self._dirty[:] = False
+            self._freed_ns.clear()
         return {"table": merged, **self.book.snapshot()}
+
+    def _snapshot_delta(self) -> Dict[str, np.ndarray]:
+        """Dirty rows gathered off the device in ONE sharded program +
+        freed-namespace tombstones (same format as SlotTable.snapshot_delta)."""
+        per_shard = []
+        g_max = 0
+        for p in range(self.P):
+            used = self.indexes[p].slot_used[:self.capacity]
+            dirty = np.nonzero(self._dirty[p] & used)[0].astype(np.int32)
+            per_shard.append(dirty)
+            g_max = max(g_max, len(dirty))
+        freed = np.asarray(sorted(set(self._freed_ns)), dtype=np.int64)
+        if g_max == 0:
+            empty = {f"leaf_{i}": np.empty(0, dtype=l.dtype)
+                     for i, l in enumerate(self.agg.leaves)}
+            out = {
+                "__delta__": np.asarray(True),
+                "key_id": np.empty(0, dtype=np.int64),
+                "namespace": np.empty(0, dtype=np.int64),
+                "key_group": np.empty(0, dtype=np.int32),
+                "freed_namespaces": freed,
+                **empty,
+            }
+        else:
+            G = sticky_bucket(g_max, self._gather_bucket)
+            self._gather_bucket = G
+            block = np.zeros((self.P, G), dtype=np.int32)
+            for p, dirty in enumerate(per_shard):
+                block[p, :len(dirty)] = dirty
+            gathered = self._gather_step(self.accs,
+                                         self._put_sharded(block))
+            leaves_host = [np.asarray(g) for g in gathered]
+            key_cols, ns_cols = [], []
+            leaf_cols = [[] for _ in leaves_host]
+            for p, dirty in enumerate(per_shard):
+                m = len(dirty)
+                if m == 0:
+                    continue
+                idx = self.indexes[p]
+                key_cols.append(idx.slot_key[dirty])
+                ns_cols.append(idx.slot_ns[dirty])
+                for i, lh in enumerate(leaves_host):
+                    leaf_cols[i].append(lh[p][:m])
+            key_ids = np.concatenate(key_cols)
+            out = {
+                "__delta__": np.asarray(True),
+                "key_id": key_ids,
+                "namespace": np.concatenate(ns_cols),
+                "key_group": assign_key_groups(key_ids,
+                                               self.max_parallelism),
+                "freed_namespaces": freed,
+                **{f"leaf_{i}": np.concatenate(cols)
+                   for i, cols in enumerate(leaf_cols)},
+            }
+        self._dirty[:] = False
+        self._freed_ns.clear()
+        return out
 
     def restore(self, snap: Dict[str, object]) -> None:
         """Restore, re-sharding by key group (works across mesh sizes)."""
@@ -374,4 +510,7 @@ class MeshWindowEngine:
             self.accs = tuple(
                 jax.device_put(jnp.asarray(a), self._sharding)
                 for a in accs_host)
+        # restored state IS the new incremental base
+        self._dirty[:] = False
+        self._freed_ns.clear()
         self.book.restore(snap)

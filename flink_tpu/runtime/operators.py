@@ -151,10 +151,34 @@ class WindowAggOperator(Operator):
         self.fire_latencies_ms = deque(maxlen=8192)
 
     def open(self, ctx):
-        self.windower = SliceSharedWindower(
-            self.assigner, self.agg, capacity=self.capacity,
-            max_parallelism=ctx.max_parallelism,
-            allowed_lateness=self.allowed_lateness)
+        import jax
+
+        # reactive clamp: never build a mesh larger than the devices that
+        # exist (reference: AdaptiveScheduler scales the plan to available
+        # resources rather than failing the job)
+        effective = min(ctx.parallelism, len(jax.devices()))
+        if effective > 1:
+            # parallelism > 1 selects the mesh-sharded engine: state lives
+            # in [P, capacity] device arrays sharded over the key-group
+            # mesh axis, records are routed by the reference's key-group
+            # formula (reference: Execution.java:572 deploy() expands a
+            # vertex into parallel subtasks; KeyGroupStreamPartitioner.java:55
+            # routes by key group — here the "subtasks" are mesh shards of
+            # one jitted program)
+            from flink_tpu.parallel.mesh import make_mesh
+            from flink_tpu.parallel.sharded_windower import MeshWindowEngine
+
+            mesh = getattr(ctx, "mesh", None) or make_mesh(effective)
+            self.windower = MeshWindowEngine(
+                self.assigner, self.agg, mesh,
+                capacity_per_shard=self.capacity,
+                max_parallelism=ctx.max_parallelism,
+                allowed_lateness=self.allowed_lateness)
+        else:
+            self.windower = SliceSharedWindower(
+                self.assigner, self.agg, capacity=self.capacity,
+                max_parallelism=ctx.max_parallelism,
+                allowed_lateness=self.allowed_lateness)
 
     def process_batch(self, batch, input_index=0):
         if self.key_field in batch.columns:
@@ -231,8 +255,7 @@ class WindowAggOperator(Operator):
         from flink_tpu.state.keygroups import hash_keys_to_i64
 
         key_id = int(hash_keys_to_i64(np.asarray([key_value]))[0])
-        out = self.windower.table.query_windows(key_id,
-                                                self.windower.assigner)
+        out = self.windower.query_windows(key_id)
         if namespace is not None:
             return ({int(namespace): out[int(namespace)]}
                     if int(namespace) in out else {})

@@ -124,6 +124,13 @@ class SliceSharedWindower:
         cols.update(results)
         return RecordBatch(cols)
 
+    # ---------------------------------------------------------- point query
+
+    def query_windows(self, key_id: int) -> Dict[int, Dict[str, float]]:
+        """Queryable-state point lookup: {window_end -> result columns} —
+        same contract as MeshWindowEngine.query_windows."""
+        return self.table.query_windows(key_id, self.assigner)
+
     # ------------------------------------------------------------- snapshot
 
     def snapshot(self, mode: str = "full") -> Dict[str, object]:

@@ -52,6 +52,22 @@ class TestFileSystem:
         fs.delete("a", recursive=True)
         assert not fs.exists("a/b/renamed.bin")
 
+    def test_memory_fs_flush_makes_writes_visible(self):
+        """flush() must publish to the store (local-FS visibility
+        semantics): write-then-flush patterns (JsonLinesFileSink) may never
+        reach close()."""
+        fs = InMemoryFileSystem()
+        w = fs.open("a/log.jsonl", "wb")
+        w.write(b"row1\n")
+        w.flush()
+        with fs.open("a/log.jsonl", "rb") as r:
+            assert r.read() == b"row1\n"  # visible without close
+        w.write(b"row2\n")
+        w.flush()
+        with fs.open("a/log.jsonl", "rb") as r:
+            assert r.read() == b"row1\nrow2\n"
+        w.close()
+
     def test_sink_writes_through_mem_scheme(self):
         sink = JsonLinesFileSink("mem://out/rows.jsonl")
         sink.open()

@@ -279,6 +279,93 @@ class TestJobGraphStoreAndBlobs:
         with pytest.raises(IOError, match="verification"):
             BlobStore(str(tmp_path / "ha2")).get(k2)
 
+    def test_blob_store_corrupted_cache_entry_is_repaired(self, tmp_path):
+        """A corrupted LOCAL cache entry must not be served: the
+        content-addressed contract holds on the cache-hit path too, falling
+        back to a store re-fetch and re-caching the good bytes."""
+        cache = str(tmp_path / "cache")
+        store = BlobStore(str(tmp_path / "ha"), cache_dir=cache)
+        key = store.put(b"artifact-bytes")
+        assert store.get(key) == b"artifact-bytes"  # now cached
+        with open(os.path.join(cache, key), "wb") as f:
+            f.write(b"bit-rot")
+        assert store.get(key) == b"artifact-bytes"  # repaired from store
+        with open(os.path.join(cache, key), "rb") as f:
+            assert f.read() == b"artifact-bytes"  # cache re-populated
+
+    def test_lease_renew_detects_concurrent_steal(self, tmp_path,
+                                                  monkeypatch):
+        """renew() races a stale-lease os.replace steal: if the steal lands
+        between renew's read and its utime, the loser must observe the loss
+        (post-touch ownership verification) — otherwise both dispatchers
+        believe they hold the lease (split brain)."""
+        import json as _json
+
+        d = str(tmp_path / "ha")
+        os.makedirs(d)
+        a = FileLeaderElectionDriver(d, "dispatcher", lease_timeout_s=60)
+        b = FileLeaderElectionDriver(d, "dispatcher", lease_timeout_s=60)
+        assert a.try_acquire()
+        real_utime = os.utime
+
+        def steal_then_utime(path, *args, **kwargs):
+            # interleave: b's steal lands exactly between a's read and touch
+            tmp = path + ".steal"
+            with open(tmp, "w") as f:
+                f.write(_json.dumps({"owner": b.owner_id,
+                                     "ts": time.time()}))
+            os.replace(tmp, path)
+            return real_utime(path, *args, **kwargs)
+
+        monkeypatch.setattr(os, "utime", steal_then_utime)
+        assert a.renew() is False  # a must see it lost the lease
+        monkeypatch.setattr(os, "utime", real_utime)
+        assert b.renew() is True
+
+    def test_revoked_leader_suspends_running_jobs(self, tmp_path):
+        """Split-brain guard: when a dispatcher loses its lease, it must
+        suspend its running jobs — the new leader resubmits them from the
+        JobGraphStore, and two clusters must not run the same job against
+        the same checkpoint dir/sinks."""
+        import json as _json
+
+        ha = str(tmp_path / "ha")
+        cluster = MiniCluster(Configuration({
+            "rest.port": -1,
+            "high-availability.type": "filesystem",
+            "high-availability.storageDir": ha,
+            "high-availability.lease-timeout-ms": 400,
+        }))
+        try:
+            env = StreamExecutionEnvironment(Configuration(
+                {"execution.micro-batch.size": 64}))
+            build(env, str(tmp_path / "o.jsonl"), total=2_000_000,
+                  source_cls=SlowDataGen)
+            client = cluster.submit(env, "long-job")
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if client.status()["status"] == "RUNNING":
+                    break
+                time.sleep(0.02)
+            assert client.status()["status"] == "RUNNING"
+            # steal the lease out from under the running dispatcher
+            lock = os.path.join(ha, "dispatcher.lock")
+            with open(lock + ".steal", "w") as f:
+                f.write(_json.dumps({"owner": "other-cluster",
+                                     "ts": time.time()}))
+            os.replace(lock + ".steal", lock)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if client.status()["status"] in ("SUSPENDED", "CANCELED"):
+                    break
+                time.sleep(0.05)
+            assert client.status()["status"] in ("SUSPENDED", "CANCELED")
+            # the job stays in the HA store for the new leader
+            store = JobGraphStore(ha)
+            assert "long-job" in [store.get(j)["job_name"]
+                                  for j in store.job_ids()]
+        finally:
+            cluster.shutdown()
 
     def test_standby_cluster_does_not_run_jobs(self, tmp_path):
         """Two clusters over one HA storageDir: only the leader recovers

@@ -68,6 +68,31 @@ class TestProtocol:
         assert sorted(r["v"] for r in rows) == [1, 2]  # 3,4 discarded
         assert not [n for n in os.listdir(d) if n.endswith(".inprogress")]
 
+    def test_dispose_aborts_instead_of_committing(self, tmp_path):
+        """Failure-path dispose must NOT publish the uncommitted
+        transaction (reference: TwoPhaseCommitSinkFunction.close aborts);
+        publishing there would double-commit after restore."""
+        d = str(tmp_path / "out")
+        sink = ExactlyOnceFileSink(d)
+        op = TwoPhaseSinkOperator(sink)
+        op.open(type("C", (), {"operator_index": 0})())
+        op.process_batch(batch([1, 2]))
+        state = op.snapshot_state()
+        op.notify_checkpoint_complete(1)
+        op.process_batch(batch([3, 4]))  # post-checkpoint, uncommitted
+        op.dispose()  # crash path
+        rows = ExactlyOnceFileSink.read_committed_rows(d)
+        assert sorted(r["v"] for r in rows) == [1, 2]  # 3,4 NOT published
+        # the leftovers stay .inprogress for restore-time cleanup
+        assert [n for n in os.listdir(d) if n.endswith(".inprogress")]
+        sink2 = ExactlyOnceFileSink(d)
+        op2 = TwoPhaseSinkOperator(sink2)
+        op2.open(type("C", (), {"operator_index": 0})())
+        op2.restore_state(state)
+        assert not [n for n in os.listdir(d) if n.endswith(".inprogress")]
+        rows = ExactlyOnceFileSink.read_committed_rows(d)
+        assert sorted(r["v"] for r in rows) == [1, 2]
+
     def test_savepoint_then_checkpoint_commits_all_sealed(self, tmp_path):
         """A savepoint seals a transaction without a commit following; the
         next checkpoint-complete must still publish it."""
