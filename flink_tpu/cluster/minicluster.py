@@ -556,6 +556,7 @@ class DispatcherEndpoint(RpcEndpoint):
         super().__init__("dispatcher")
         self.cluster = cluster
         self._masters: Dict[str, JobMasterThread] = {}
+        self._recovery_lock = threading.Lock()
 
     def submit_job(self, graph, config_dict: dict, job_name: str,
                    job_id: Optional[str] = None) -> str:
@@ -578,6 +579,13 @@ class DispatcherEndpoint(RpcEndpoint):
         store = getattr(self.cluster, "job_graph_store", None)
         if store is None:
             return []
+        # leadership can flap: two grants -> two recovery threads; the lock
+        # serializes them so the check-then-insert on _masters cannot race
+        # and double-start a job
+        with self._recovery_lock:
+            return self._recover_jobs_locked(store, leader_check)
+
+    def _recover_jobs_locked(self, store, leader_check) -> List[str]:
         recovered = []
         for job_id in store.job_ids():
             if leader_check is not None and not leader_check():

@@ -169,6 +169,20 @@ class StateOptions:
     CHECKPOINT_DIR = ConfigOption(
         "state.checkpoints.dir", default=None, type=str,
         description="Directory for checkpoint snapshots.")
+    MAX_DEVICE_SLOTS = ConfigOption(
+        "state.slot-table.max-device-slots", default=0, type=int,
+        description="Device-resident slot budget per keyed state (HBM "
+        "bound). 0 = unbounded (grow by doubling). When the budget is "
+        "reached, cold namespaces spill to host memory and reload "
+        "transparently on access (the RocksDB/ForSt beyond-memory role).")
+    SPILL_DIR = ConfigOption(
+        "state.spill.dir", default=None, type=str,
+        description="Filesystem tier for spilled state (any core.fs "
+        "scheme). None = spill stays in host memory.")
+    SPILL_HOST_MAX_BYTES = ConfigOption(
+        "state.spill.host-max-bytes", default=0, type=int,
+        description="Host-memory budget for spilled namespaces before they "
+        "overflow to state.spill.dir. 0 = unbounded host tier.")
 
 
 class CheckpointOptions:

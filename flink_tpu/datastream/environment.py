@@ -74,6 +74,17 @@ class StreamExecutionEnvironment:
     def state_slot_capacity(self) -> int:
         return self.config.get(StateOptions.SLOT_CAPACITY)
 
+    @property
+    def state_spill_options(self) -> dict:
+        """Beyond-HBM spill knobs handed to keyed-state operators."""
+        return {
+            "max_device_slots": self.config.get(
+                StateOptions.MAX_DEVICE_SLOTS),
+            "spill_dir": self.config.get(StateOptions.SPILL_DIR),
+            "spill_host_max_bytes": self.config.get(
+                StateOptions.SPILL_HOST_MAX_BYTES),
+        }
+
     def enable_checkpointing(self, interval_ms: int) -> "StreamExecutionEnvironment":
         self.config.set(CheckpointOptions.INTERVAL_MS, interval_ms)
         return self

@@ -423,13 +423,15 @@ class WindowedStream:
             from flink_tpu.runtime.operators import SessionWindowAggOperator
 
             gap = assigner.gap
+            spill = env.state_spill_options
             factory = lambda: SessionWindowAggOperator(  # noqa: E731
                 gap, agg, key_field, capacity=capacity,
-                allowed_lateness=lateness)
+                allowed_lateness=lateness, spill=spill)
         else:
+            spill = env.state_spill_options
             factory = lambda: WindowAggOperator(  # noqa: E731
                 assigner, agg, key_field, capacity=capacity,
-                allowed_lateness=lateness)
+                allowed_lateness=lateness, spill=spill)
         t = Transformation(
             name=name or f"window_agg({type(agg).__name__})",
             kind="one_input",

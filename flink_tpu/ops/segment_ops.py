@@ -39,6 +39,14 @@ MERGE_FN: Dict[str, Callable] = {
     "min": jnp.min,
 }
 
+# host-side elementwise combine of two partial-aggregate arrays (the spill
+# tier merges spilled slice values into device-fired results on host)
+HOST_COMBINE: Dict[str, Callable] = {
+    "sum": np.add,
+    "max": np.maximum,
+    "min": np.minimum,
+}
+
 _MIN_BUCKET = 256
 
 

@@ -133,12 +133,13 @@ class WindowAggOperator(Operator):
 
     def __init__(self, assigner: WindowAssigner, agg: AggregateFunction,
                  key_field: str, capacity: int = 1 << 16,
-                 allowed_lateness: int = 0):
+                 allowed_lateness: int = 0, spill: dict = None):
         self.assigner = assigner
         self.agg = agg
         self.key_field = key_field
         self.capacity = capacity
         self.allowed_lateness = allowed_lateness
+        self.spill = spill
         self.windower: Optional[SliceSharedWindower] = None
         self._key_values: Dict[int, Any] = {}  # key_id -> original key value
         self._keys_hashed = False
@@ -168,6 +169,13 @@ class WindowAggOperator(Operator):
             from flink_tpu.parallel.mesh import make_mesh
             from flink_tpu.parallel.sharded_windower import MeshWindowEngine
 
+            if self.spill and self.spill.get("max_device_slots"):
+                import warnings
+
+                warnings.warn(
+                    "state.slot-table.max-device-slots is not yet honored "
+                    "by the mesh-parallel window engine — state stays "
+                    "device-resident at parallelism > 1", stacklevel=2)
             mesh = getattr(ctx, "mesh", None) or make_mesh(effective)
             self.windower = MeshWindowEngine(
                 self.assigner, self.agg, mesh,
@@ -178,7 +186,8 @@ class WindowAggOperator(Operator):
             self.windower = SliceSharedWindower(
                 self.assigner, self.agg, capacity=self.capacity,
                 max_parallelism=ctx.max_parallelism,
-                allowed_lateness=self.allowed_lateness)
+                allowed_lateness=self.allowed_lateness,
+                spill=self.spill)
 
     def process_batch(self, batch, input_index=0):
         if self.key_field in batch.columns:
@@ -277,9 +286,11 @@ class SessionWindowAggOperator(WindowAggOperator):
     name = "session_window_agg"
 
     def __init__(self, gap: int, agg: AggregateFunction, key_field: str,
-                 capacity: int = 1 << 16, allowed_lateness: int = 0):
+                 capacity: int = 1 << 16, allowed_lateness: int = 0,
+                 spill: dict = None):
         super().__init__(assigner=None, agg=agg, key_field=key_field,
-                         capacity=capacity, allowed_lateness=allowed_lateness)
+                         capacity=capacity, allowed_lateness=allowed_lateness,
+                         spill=spill)
         self.gap = gap
 
     def open(self, ctx):
@@ -288,7 +299,8 @@ class SessionWindowAggOperator(WindowAggOperator):
         self.windower = SessionWindower(
             self.gap, self.agg, capacity=self.capacity,
             max_parallelism=ctx.max_parallelism,
-            allowed_lateness=self.allowed_lateness)
+            allowed_lateness=self.allowed_lateness,
+            spill=self.spill)
 
     def query_state(self, key_value, namespace=None):
         """Session variant: the key's live sessions are host metadata

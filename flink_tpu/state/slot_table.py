@@ -59,6 +59,10 @@ def unique_pairs(
     return key_ids[first_pos], namespaces[first_pos], inverse
 
 
+class SlotTableFullError(RuntimeError):
+    """Device slot budget exhausted — the owner may evict and retry."""
+
+
 class _NamespaceRegistry:
     """Shared namespace -> slots registry (O(namespaces), pure Python).
 
@@ -105,11 +109,13 @@ class HostSlotIndex(_NamespaceRegistry):
     def __init__(self, capacity: int,
                  on_grow: Optional[Callable[[int, int], None]] = None,
                  growable: bool = True,
-                 full_hint: str = "raise state.slot-table.capacity") -> None:
+                 full_hint: str = "raise state.slot-table.capacity",
+                 max_capacity: int = 0) -> None:
         self.capacity = max(int(capacity), 1024)
         self.on_grow = on_grow
         self.growable = growable
         self.full_hint = full_hint
+        self.max_capacity = int(max_capacity or 0)
         self._index: Dict[Tuple[int, int], int] = {}
         self.slot_key = np.zeros(self.capacity, dtype=np.int64)
         self.slot_ns = np.zeros(self.capacity, dtype=np.int64)
@@ -170,8 +176,9 @@ class HostSlotIndex(_NamespaceRegistry):
         return self._free.pop()
 
     def _grow(self) -> None:
-        if not self.growable:
-            raise RuntimeError(
+        if not self.growable or (
+                self.max_capacity and self.capacity * 2 > self.max_capacity):
+            raise SlotTableFullError(
                 f"slot table full (capacity={self.capacity}) and not "
                 f"growable; {self.full_hint}")
         old = self.capacity
@@ -203,6 +210,14 @@ class HostSlotIndex(_NamespaceRegistry):
     def used_slots(self) -> np.ndarray:
         return np.nonzero(self.slot_used)[0]
 
+    def free_headroom(self) -> int:
+        """Slots still allocatable (incl. future growth). Slot 0 reserved."""
+        if self.growable:
+            limit = self.max_capacity if self.max_capacity else (1 << 60)
+        else:
+            limit = self.capacity
+        return limit - 1 - self.num_used
+
 
 class NativeSlotIndex(_NamespaceRegistry):
     """C++-backed drop-in for HostSlotIndex (see native/slotmap.cpp).
@@ -215,7 +230,8 @@ class NativeSlotIndex(_NamespaceRegistry):
     def __init__(self, capacity: int,
                  on_grow: Optional[Callable[[int, int], None]] = None,
                  growable: bool = True,
-                 full_hint: str = "raise state.slot-table.capacity") -> None:
+                 full_hint: str = "raise state.slot-table.capacity",
+                 max_capacity: int = 0) -> None:
         from flink_tpu.native import load_slotmap
 
         self._lib = load_slotmap()
@@ -224,7 +240,9 @@ class NativeSlotIndex(_NamespaceRegistry):
         self.on_grow = on_grow
         self.growable = growable
         self.full_hint = full_hint
-        max_cap = (1 << 28) if growable else self.capacity
+        self.max_capacity = int(max_capacity or 0)
+        max_cap = (self.max_capacity or (1 << 28)) if growable \
+            else self.capacity
         self._h = self._lib.sm_create(self.capacity, max_cap)
         self._wrap_views()
         self._init_registry()
@@ -269,7 +287,7 @@ class NativeSlotIndex(_NamespaceRegistry):
             keys.ctypes.data_as(i64p), nss.ctypes.data_as(i64p),
             out.ctypes.data_as(i32p), is_new.ctypes.data_as(u8p))
         if rc < 0:
-            raise RuntimeError(
+            raise SlotTableFullError(
                 f"slot table full (capacity={self.capacity}) and not "
                 f"growable; {self.full_hint}")
         if rc > 0:
@@ -330,19 +348,150 @@ class NativeSlotIndex(_NamespaceRegistry):
     def used_slots(self) -> np.ndarray:
         return np.nonzero(self.slot_used)[0]
 
+    def free_headroom(self) -> int:
+        """Slots still allocatable (incl. future growth). Slot 0 reserved."""
+        if self.growable:
+            limit = self.max_capacity if self.max_capacity else (1 << 28)
+        else:
+            limit = self.capacity
+        return limit - 1 - self.num_used
+
 
 def make_slot_index(capacity: int, on_grow=None, growable: bool = True,
-                    full_hint: str = "raise state.slot-table.capacity"):
+                    full_hint: str = "raise state.slot-table.capacity",
+                    max_capacity: int = 0):
     """Native index when the C++ library is available, else pure Python."""
     from flink_tpu.native import slotmap_available
 
     cls = NativeSlotIndex if slotmap_available() else HostSlotIndex
     return cls(capacity, on_grow=on_grow, growable=growable,
-               full_hint=full_hint)
+               full_hint=full_hint, max_capacity=max_capacity)
+
+
+class SpillTier:
+    """Beyond-HBM state: whole namespaces evicted from the device table.
+
+    Two levels — host memory, then a filesystem directory (any ``core.fs``
+    scheme) once the host budget is exceeded. This is the role RocksDB /
+    ForSt play for the reference (state far larger than memory,
+    reference: RocksDBKeyedStateBackend.java;
+    ForStStateExecutor.java:149 batch contract); the unit of movement is a
+    namespace (window slice / session id), not a key, so reloads are one
+    batched put kernel.
+    """
+
+    def __init__(self, spill_dir: Optional[str] = None,
+                 host_max_bytes: int = 0):
+        self.spill_dir = spill_dir
+        self.host_max_bytes = host_max_bytes
+        self._host: Dict[int, Dict[str, np.ndarray]] = {}
+        self._host_bytes = 0
+        self._fs: Dict[int, str] = {}  # ns -> file path
+        self._dirty: set = set()  # namespaces changed since last snapshot
+        self._seq = 0
+
+    def __len__(self) -> int:
+        return len(self._host) + len(self._fs)
+
+    def __contains__(self, ns: int) -> bool:
+        return ns in self._host or ns in self._fs
+
+    @property
+    def namespaces(self) -> List[int]:
+        return list(self._host) + list(self._fs)
+
+    @staticmethod
+    def _entry_bytes(entry: Dict[str, np.ndarray]) -> int:
+        return sum(a.nbytes for a in entry.values())
+
+    def put(self, ns: int, entry: Dict[str, np.ndarray],
+            dirty: bool) -> None:
+        assert ns not in self, f"namespace {ns} spilled twice"
+        self._host[ns] = entry
+        self._host_bytes += self._entry_bytes(entry)
+        if dirty:
+            self._dirty.add(ns)
+        self._maybe_overflow_to_fs()
+
+    def _maybe_overflow_to_fs(self) -> None:
+        if not self.spill_dir or self.host_max_bytes <= 0:
+            return
+        from flink_tpu.core.fs import get_filesystem
+
+        fs, local = get_filesystem(self.spill_dir)
+        fs.mkdirs(local)
+        while self._host_bytes > self.host_max_bytes and self._host:
+            ns, entry = next(iter(self._host.items()))
+            import io as _io
+
+            buf = _io.BytesIO()
+            np.savez(buf, **entry)
+            self._seq += 1
+            path = f"{local.rstrip('/')}/ns-{ns}-{self._seq}.npz"
+            with fs.open(path, "wb") as f:
+                f.write(buf.getvalue())
+            self._fs[ns] = f"{self._scheme_prefix()}{path}"
+            self._host_bytes -= self._entry_bytes(entry)
+            del self._host[ns]
+
+    def _scheme_prefix(self) -> str:
+        if self.spill_dir and "://" in self.spill_dir:
+            return self.spill_dir.split("://", 1)[0] + "://"
+        return ""
+
+    def pop(self, ns: int) -> Optional[Dict[str, np.ndarray]]:
+        """Remove and return a spilled namespace (reload or free)."""
+        entry = self._host.pop(ns, None)
+        if entry is not None:
+            self._host_bytes -= self._entry_bytes(entry)
+        elif ns in self._fs:
+            from flink_tpu.core.fs import get_filesystem
+
+            path = self._fs.pop(ns)
+            fs, local = get_filesystem(path)
+            with fs.open(local, "rb") as f:
+                loaded = np.load(f)
+                entry = {k: loaded[k] for k in loaded.files}
+            fs.delete(local)
+        was_dirty = ns in self._dirty
+        self._dirty.discard(ns)
+        if entry is not None:
+            entry["__was_dirty__"] = np.asarray(was_dirty)
+        return entry
+
+    def peek(self, ns: int) -> Optional[Dict[str, np.ndarray]]:
+        """Read a spilled namespace without removing it (snapshots)."""
+        entry = self._host.get(ns)
+        if entry is not None:
+            return entry
+        if ns in self._fs:
+            from flink_tpu.core.fs import get_filesystem
+
+            fs, local = get_filesystem(self._fs[ns])
+            with fs.open(local, "rb") as f:
+                loaded = np.load(f)
+                return {k: loaded[k] for k in loaded.files}
+        return None
+
+    def drop(self, ns: int) -> None:
+        """Discard a spilled namespace (window fully fired elsewhere)."""
+        self.pop(ns)
+
+    def dirty_namespaces(self) -> List[int]:
+        return list(self._dirty)
+
+    def clear_dirty(self) -> None:
+        self._dirty.clear()
 
 
 class SlotTable:
-    """Single-device keyed windowed state (host index + device accumulators)."""
+    """Single-device keyed windowed state (host index + device accumulators).
+
+    With ``max_device_slots`` set, the device table is an HBM-bounded cache
+    over a host/filesystem ``SpillTier``: when full, the least-recently-
+    touched namespaces are evicted wholesale (one gather + one reset
+    kernel) and reload transparently on the next access (one put kernel).
+    """
 
     def __init__(
         self,
@@ -350,11 +499,26 @@ class SlotTable:
         capacity: int = 1 << 16,
         max_parallelism: int = 128,
         device=None,
+        max_device_slots: int = 0,
+        spill_dir: Optional[str] = None,
+        spill_host_max_bytes: int = 0,
     ) -> None:
         self.agg = agg
         self.max_parallelism = max_parallelism
         self.device = device
-        self.index = make_slot_index(capacity, on_grow=self._grow_device)
+        self.max_device_slots = int(max_device_slots or 0)
+        if self.max_device_slots:
+            capacity = min(capacity, self.max_device_slots)
+        self.spill = SpillTier(spill_dir, spill_host_max_bytes)
+        self._ns_touch: Dict[int, int] = {}
+        self._touch_clock = 0
+        self.index = make_slot_index(
+            capacity, on_grow=self._grow_device,
+            max_capacity=self.max_device_slots,
+            full_hint=("state spills to host beyond "
+                       "state.slot-table.max-device-slots"
+                       if self.max_device_slots
+                       else "raise state.slot-table.capacity"))
         self.accs: Tuple[jnp.ndarray, ...] = agg.init_accumulators(
             self.index.capacity)
         # buckets are sticky: once a program of bucket B compiled, nearby
@@ -384,13 +548,189 @@ class SlotTable:
 
     @property
     def namespaces(self) -> List[int]:
-        return self.index.namespaces
+        """All live namespaces — device-resident AND spilled."""
+        return self.index.namespaces + self.spill.namespaces
 
     # ------------------------------------------------------------- main path
 
     def lookup_or_insert(self, key_ids: np.ndarray,
                          namespaces: np.ndarray) -> np.ndarray:
+        if self.max_device_slots:
+            touched = np.unique(np.asarray(namespaces, dtype=np.int64))
+            self.ensure_resident(touched.tolist())
+            self._touch(touched.tolist())
+            # headroom pre-check: lookup_or_insert allocates incrementally,
+            # so running out MID-batch would leave the index and the
+            # namespace registry inconsistent — make room up front for
+            # exactly the pairs that are genuinely new (a read-only probe)
+            uk, un, _ = unique_pairs(
+                np.asarray(key_ids, dtype=np.int64),
+                np.asarray(namespaces, dtype=np.int64))
+            # under ample headroom (the steady-state common case) skip the
+            # exact probe — len(uk) over-counts but cheaply proves safety
+            if self.index.free_headroom() < len(uk):
+                needed = int((self.index.lookup(uk, un) < 0).sum())
+                if needed:
+                    self._make_headroom(needed,
+                                        protect=set(touched.tolist()))
         return self.index.lookup_or_insert(key_ids, namespaces)
+
+    def _make_headroom(self, needed: int, protect: set) -> None:
+        while self.index.free_headroom() < needed:
+            self._evict_cold(protect=protect)
+
+    def upsert(self, key_ids: np.ndarray, namespaces: np.ndarray,
+               values: Tuple[np.ndarray, ...]) -> None:
+        """Spill-safe accumulate: when one batch's working set exceeds the
+        device budget, it is processed in namespace groups so only one
+        group must be resident at a time (a single namespace whose key set
+        alone exceeds the budget is the irreducible limit of
+        namespace-granular spill and fails loudly)."""
+        namespaces = np.asarray(namespaces, dtype=np.int64)
+        if self.max_device_slots:
+            # slots are consumed per unique (key, ns) PAIR, not per record
+            # — chunk only when the pair working set exceeds the budget
+            _, pair_ns, _ = unique_pairs(
+                np.asarray(key_ids, dtype=np.int64), namespaces)
+            uniq_ns, counts = np.unique(pair_ns, return_counts=True)
+            budget = max(self.max_device_slots // 2, 1024)
+            if len(uniq_ns) > 1 and int(counts.sum()) > budget:
+                groups: List[List[int]] = []
+                cur: List[int] = []
+                cur_n = 0
+                for ns, c in zip(uniq_ns.tolist(), counts.tolist()):
+                    if cur and cur_n + c > budget:
+                        groups.append(cur)
+                        cur, cur_n = [], 0
+                    cur.append(ns)
+                    cur_n += c
+                groups.append(cur)
+                for g in groups:
+                    mask = np.isin(namespaces, g)
+                    slots = self.lookup_or_insert(key_ids[mask],
+                                                  namespaces[mask])
+                    self.scatter(slots, tuple(np.asarray(v)[mask]
+                                              for v in values))
+                return
+        slots = self.lookup_or_insert(key_ids, namespaces)
+        self.scatter(slots, values)
+
+    # ------------------------------------------------------------ spill tier
+
+    def _touch(self, namespaces: List[int]) -> None:
+        self._touch_clock += 1
+        clock = self._touch_clock
+        for ns in namespaces:
+            self._ns_touch[int(ns)] = clock
+
+    def ensure_resident(self, namespaces: List[int]) -> None:
+        """Reload any spilled namespaces among ``namespaces`` back onto the
+        device — ALL reloads batch into one insert + one put kernel (a
+        session workload reloads thousands of one-row namespaces at once).
+        Transparent to callers: after this, the index serves them like any
+        resident namespace."""
+        if not self.max_device_slots or len(self.spill) == 0:
+            return
+        todo = [int(ns) for ns in namespaces if int(ns) in self.spill]
+        if not todo:
+            return
+        protect = set(int(n) for n in namespaces)
+        key_chunks: List[np.ndarray] = []
+        ns_chunks: List[np.ndarray] = []
+        dirty_chunks: List[np.ndarray] = []
+        leaf_chunks: List[List[np.ndarray]] = [[] for _ in self.agg.leaves]
+        for ns in todo:
+            entry = self.spill.pop(ns)
+            m = len(entry["key_id"])
+            if m == 0:
+                continue
+            key_chunks.append(np.asarray(entry["key_id"], dtype=np.int64))
+            ns_chunks.append(np.full(m, ns, dtype=np.int64))
+            dirty_chunks.append(np.full(
+                m, bool(entry.get("__was_dirty__", False)), dtype=bool))
+            for i, l in enumerate(self.agg.leaves):
+                leaf_chunks[i].append(
+                    np.asarray(entry[f"leaf_{i}"], dtype=l.dtype))
+        if not key_chunks:
+            return
+        key_ids = np.concatenate(key_chunks)
+        nss = np.concatenate(ns_chunks)
+        was_dirty = np.concatenate(dirty_chunks)
+        n = len(key_ids)
+        self._make_headroom(n, protect=protect)
+        slots = self.index.lookup_or_insert(key_ids, nss)
+        size = sticky_bucket(n, self._scatter_bucket)
+        self._scatter_bucket = size
+        padded_slots = pad_i32(slots, size, fill=0)
+        vals = tuple(
+            np.concatenate([
+                np.concatenate(leaf_chunks[i]),
+                np.full(size - n, l.identity, dtype=l.dtype)])
+            for i, l in enumerate(self.agg.leaves))
+        self.accs = self.agg._put_jit(
+            self.accs, jnp.asarray(padded_slots),
+            tuple(jnp.asarray(v) for v in vals))
+        # reloaded rows keep their dirtiness: rows dirty at spill time have
+        # not been in any snapshot since
+        self._dirty[slots] = was_dirty
+        self._touch(todo)
+
+    def _evict_cold(self, protect: set) -> None:
+        """Evict the least-recently-touched namespaces to the spill tier
+        until a workable fraction of the device table is free — ONE gather
+        + ONE reset kernel for the whole eviction batch, however many
+        namespaces it spans."""
+        target_free = max(self.index.capacity // 8, 1024)
+        candidates = sorted(
+            (ns for ns in self.index.namespaces if int(ns) not in protect),
+            key=lambda ns: self._ns_touch.get(int(ns), 0))
+        if not candidates:
+            raise SlotTableFullError(
+                "device slot budget exhausted and every namespace in the "
+                "current batch is protected — raise "
+                "state.slot-table.max-device-slots or reduce batch size")
+        chosen: List[Tuple[int, np.ndarray]] = []
+        freed = 0
+        for ns in candidates:
+            if freed >= target_free:
+                break
+            slots = self.index.slots_for_namespace(int(ns))
+            chosen.append((int(ns), slots))
+            freed += len(slots)
+        empty = [ns for ns, s in chosen if len(s) == 0]
+        if empty:
+            self.index.free_namespaces(empty)
+        chosen = [(ns, s) for ns, s in chosen if len(s) > 0]
+        if not chosen:
+            return
+        all_slots = np.concatenate([s for _, s in chosen])
+        n = len(all_slots)
+        size = sticky_bucket(n, self._gather_bucket)
+        self._gather_bucket = size
+        gathered = self.agg._gather_jit(
+            self.accs, jnp.asarray(pad_i32(all_slots, size, fill=0)))
+        leaves_host = [np.asarray(g)[:n] for g in gathered]
+        off = 0
+        for ns, slots in chosen:
+            m = len(slots)
+            entry = {
+                "key_id": np.asarray(self.index.slot_key[slots]),
+                **{f"leaf_{i}": leaves_host[i][off:off + m]
+                   for i in range(len(leaves_host))},
+            }
+            self.spill.put(ns, entry,
+                           dirty=bool(self._dirty[slots].any()))
+            off += m
+            self._ns_touch.pop(ns, None)
+        # release the device slots: index entries go, values reset to
+        # identity. NOT a logical free — no tombstone (rows live on in the
+        # spill tier and reappear in snapshots from there).
+        self.index.free_namespaces([ns for ns, _ in chosen])
+        self._dirty[all_slots] = False
+        rsize = sticky_bucket(n, self._reset_bucket)
+        self._reset_bucket = rsize
+        self.accs = self.agg._reset_jit(
+            self.accs, pad_i32(all_slots, rsize, fill=0))
 
     def _grow_device(self, old: int, new: int) -> None:
         self.accs = tuple(
@@ -442,6 +782,65 @@ class SlotTable:
         out = self.agg._fire_jit(self.accs, jnp.asarray(padded))
         return {name: np.asarray(col)[:w] for name, col in out.items()}
 
+    def fire_hybrid(self, slice_ends: List[int]
+                    ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """Window fire tolerating spilled slices: device-resident slices
+        merge on device (one kernel), spilled slices merge on host, finish
+        runs on host over the union. Returns (keys, result columns).
+
+        This keeps the device budget independent of the window's slice
+        count — a sliding window whose full slice set exceeds
+        max-device-slots still fires correctly (reference: RocksDB windows
+        never needed to fit in memory either)."""
+        from flink_tpu.ops.segment_ops import HOST_COMBINE
+
+        resident = [se for se in slice_ends if int(se) not in self.spill]
+        spilled = [se for se in slice_ends if int(se) in self.spill]
+        key_chunks: List[np.ndarray] = []
+        leaf_chunks: List[List[np.ndarray]] = [[] for _ in self.agg.leaves]
+        # device part
+        per_slice = [(i, self.index.slots_for_namespace(se))
+                     for i, se in enumerate(resident)]
+        per_slice = [(i, s) for i, s in per_slice if len(s) > 0]
+        if per_slice:
+            all_slots = np.concatenate([s for _, s in per_slice])
+            all_sidx = np.concatenate(
+                [np.full(len(s), i, dtype=np.int32) for i, s in per_slice])
+            keys, inv = np.unique(self.index.slot_key[all_slots],
+                                  return_inverse=True)
+            matrix = np.zeros((len(keys), len(resident)), dtype=np.int32)
+            matrix[inv, all_sidx] = all_slots
+            wp = sticky_bucket(len(keys), self._fire_bucket, minimum=64)
+            self._fire_bucket = wp
+            padded = np.zeros((wp, len(resident)), dtype=np.int32)
+            padded[:len(keys)] = matrix
+            merged = self.agg._merge_jit(self.accs, jnp.asarray(padded))
+            key_chunks.append(keys)
+            for i, m in enumerate(merged):
+                leaf_chunks[i].append(np.asarray(m)[:len(keys)])
+        # host part (spilled slices)
+        for se in spilled:
+            entry = self.spill.peek(int(se))
+            if entry is None or len(entry["key_id"]) == 0:
+                continue
+            key_chunks.append(np.asarray(entry["key_id"], dtype=np.int64))
+            for i, l in enumerate(self.agg.leaves):
+                leaf_chunks[i].append(
+                    np.asarray(entry[f"leaf_{i}"], dtype=l.dtype))
+        if not key_chunks:
+            return np.empty(0, dtype=np.int64), {}
+        all_keys = np.concatenate(key_chunks)
+        uniq, inv = np.unique(all_keys, return_inverse=True)
+        out_leaves = []
+        for i, l in enumerate(self.agg.leaves):
+            acc = np.full(len(uniq), l.identity, dtype=l.dtype)
+            HOST_COMBINE[l.reduce].at(acc, inv,
+                                      np.concatenate(leaf_chunks[i]))
+            out_leaves.append(acc)
+        finished = self.agg.finish(tuple(out_leaves))
+        return uniq, {name: np.asarray(col)
+                      for name, col in finished.items()}
+
     def mark_dirty(self, slots: np.ndarray) -> None:
         """For external kernels that mutate ``accs`` directly (e.g. session
         merges): keep incremental snapshots correct."""
@@ -461,6 +860,12 @@ class SlotTable:
         """Release all slots of the given namespaces (windows fully fired)."""
         slots = self.index.free_namespaces(namespaces)
         self._freed_ns.extend(int(n) for n in namespaces)
+        if len(self.spill):
+            for ns in namespaces:
+                if int(ns) in self.spill:
+                    self.spill.drop(int(ns))
+        for ns in namespaces:
+            self._ns_touch.pop(int(ns), None)
         if slots is None:
             return
         self._dirty[slots] = False
@@ -477,22 +882,52 @@ class SlotTable:
         against the live backend). Read-only — including the sticky fire
         bucket, which belongs to the hot window-fire path."""
         nss = ([int(namespace)] if namespace is not None
-               else [int(n) for n in self.index.namespaces])
+               else [int(n) for n in self.namespaces])
         if not nss:
             return {}
-        keys = np.full(len(nss), int(key_id), dtype=np.int64)
-        slots = self.index.lookup(keys, np.asarray(nss, dtype=np.int64))
-        hit = slots >= 0
-        if not hit.any():
-            return {}
-        matrix = slots[hit][:, None].astype(np.int32)
-        results = self._fire_padded(matrix,
-                                    pad_bucket_size(len(matrix), minimum=64))
+        vals = self._key_values_per_namespace(int(key_id), nss)
         out: Dict[int, Dict[str, float]] = {}
-        hit_nss = [n for n, h in zip(nss, hit) if h]
-        for i, ns in enumerate(hit_nss):
-            out[ns] = {name: col[i].item()
-                       for name, col in results.items()}
+        for ns, leaves in vals.items():
+            finished = self.agg.finish(leaves)
+            out[ns] = {name: np.asarray(col).item()
+                       for name, col in finished.items()}
+        return out
+
+    def _key_values_per_namespace(
+            self, key_id: int, nss: List[int]
+    ) -> Dict[int, Tuple[np.ndarray, ...]]:
+        """One key's raw accumulator leaves per namespace — device-resident
+        namespaces read via one gather kernel, spilled ones from their host
+        entries (no residency change: queries must not thrash the cache)."""
+        resident = [ns for ns in nss if int(ns) not in self.spill]
+        spilled = [ns for ns in nss if int(ns) in self.spill]
+        out: Dict[int, Tuple[np.ndarray, ...]] = {}
+        if resident:
+            keys = np.full(len(resident), key_id, dtype=np.int64)
+            slots = self.index.lookup(
+                keys, np.asarray(resident, dtype=np.int64))
+            hit = slots >= 0
+            if hit.any():
+                hs = slots[hit].astype(np.int32)
+                size = pad_bucket_size(len(hs), minimum=64)
+                gathered = self.agg._gather_jit(
+                    self.accs, jnp.asarray(pad_i32(hs, size, fill=0)))
+                leaves = [np.asarray(g)[:len(hs)] for g in gathered]
+                for j, ns in enumerate(n for n, h in zip(resident, hit)
+                                       if h):
+                    out[int(ns)] = tuple(l[j:j + 1] for l in leaves)
+        for ns in spilled:
+            entry = self.spill.peek(int(ns))
+            if entry is None:
+                continue
+            pos = np.nonzero(np.asarray(entry["key_id"],
+                                        dtype=np.int64) == key_id)[0]
+            if len(pos) == 0:
+                continue
+            j = int(pos[0])
+            out[int(ns)] = tuple(
+                np.asarray(entry[f"leaf_{i}"], dtype=l.dtype)[j:j + 1]
+                for i, l in enumerate(self.agg.leaves))
         return out
 
     def query_windows(self, key_id: int, assigner
@@ -501,30 +936,32 @@ class SlotTable:
         accumulators (slice sharing: a sliding window's value = merge of k
         slices — reference: SliceAssigners slice/window mapping). Returns
         {window_end -> finished result columns} for the key. Read-only."""
-        live_ns = np.asarray([int(n) for n in self.index.namespaces],
-                             dtype=np.int64)
-        if len(live_ns) == 0:
+        from flink_tpu.ops.segment_ops import HOST_COMBINE
+
+        live_ns = [int(n) for n in self.namespaces]
+        if not live_ns:
             return {}
-        keys = np.full(len(live_ns), int(key_id), dtype=np.int64)
-        slots = self.index.lookup(keys, live_ns)
-        hit = slots >= 0
-        if not hit.any():
+        slice_vals = self._key_values_per_namespace(int(key_id), live_ns)
+        if not slice_vals:
             return {}
-        slice_slot = {int(n): int(s)
-                      for n, s, h in zip(live_ns, slots, hit) if h}
         windows = sorted({
             int(w)
-            for se in slice_slot
+            for se in slice_vals
             for w in assigner.window_ends_for_slice(se)})
-        k = max(len(assigner.slice_ends_for_window(w)) for w in windows)
-        matrix = np.zeros((len(windows), k), dtype=np.int32)
-        for i, w in enumerate(windows):
-            for j, se in enumerate(assigner.slice_ends_for_window(w)):
-                matrix[i, j] = slice_slot.get(int(se), 0)
-        results = self._fire_padded(
-            matrix, pad_bucket_size(len(matrix), minimum=64))
-        return {w: {name: col[i].item() for name, col in results.items()}
-                for i, w in enumerate(windows)}
+        out: Dict[int, Dict[str, float]] = {}
+        for w in windows:
+            leaves = [np.full(1, l.identity, dtype=l.dtype)
+                      for l in self.agg.leaves]
+            for se in assigner.slice_ends_for_window(w):
+                sv = slice_vals.get(int(se))
+                if sv is None:
+                    continue
+                leaves = [HOST_COMBINE[l.reduce](acc, v) for acc, v, l in
+                          zip(leaves, sv, self.agg.leaves)]
+            finished = self.agg.finish(tuple(leaves))
+            out[w] = {name: np.asarray(col).item()
+                      for name, col in finished.items()}
+        return out
 
     # ---------------------------------------------------------- snapshot/restore
 
@@ -542,18 +979,40 @@ class SlotTable:
         used = self.index.used_slots()
         accs_host = [np.asarray(a) for a in self.accs]
         key_ids = self.index.slot_key[used]
-        if reset_dirty:
-            self._dirty[:] = False
-            self._freed_ns.clear()
-        return {
+        out = {
             "key_id": key_ids,
             "namespace": self.index.slot_ns[used],
-            "key_group": assign_key_groups(key_ids, self.max_parallelism),
             **{
                 f"leaf_{i}": accs_host[i][used]
                 for i in range(len(self.accs))
             },
         }
+        # spilled namespaces are part of the logical state (chunks
+        # collected first, ONE concatenate — thousands of one-row session
+        # namespaces would otherwise make this O(N^2))
+        key_chunks = [out["key_id"]]
+        ns_chunks = [out["namespace"]]
+        leaf_chunks = [[out[f"leaf_{i}"]] for i in range(len(self.accs))]
+        for ns in self.spill.namespaces:
+            entry = self.spill.peek(int(ns))
+            m = len(entry["key_id"])
+            key_chunks.append(np.asarray(entry["key_id"], dtype=np.int64))
+            ns_chunks.append(np.full(m, int(ns), dtype=np.int64))
+            for i in range(len(self.accs)):
+                leaf_chunks[i].append(
+                    np.asarray(entry[f"leaf_{i}"],
+                               dtype=self.agg.leaves[i].dtype))
+        out["key_id"] = np.concatenate(key_chunks)
+        out["namespace"] = np.concatenate(ns_chunks)
+        for i in range(len(self.accs)):
+            out[f"leaf_{i}"] = np.concatenate(leaf_chunks[i])
+        out["key_group"] = assign_key_groups(out["key_id"],
+                                             self.max_parallelism)
+        if reset_dirty:
+            self._dirty[:] = False
+            self._freed_ns.clear()
+            self.spill.clear_dirty()
+        return out
 
     def snapshot_delta(self) -> Dict[str, np.ndarray]:
         """Incremental snapshot: only rows dirtied since the last snapshot
@@ -574,16 +1033,33 @@ class SlotTable:
         else:
             leaves = [np.empty(0, dtype=l.dtype) for l in self.agg.leaves]
         key_ids = self.index.slot_key[dirty_used]
+        namespaces = self.index.slot_ns[dirty_used]
+        # spilled-but-dirty namespaces were changed since the last snapshot
+        # and must travel in this delta too
+        for ns in self.spill.dirty_namespaces():
+            entry = self.spill.peek(int(ns))
+            if entry is None:
+                continue
+            m = len(entry["key_id"])
+            key_ids = np.concatenate([key_ids, entry["key_id"]])
+            namespaces = np.concatenate(
+                [namespaces, np.full(m, int(ns), dtype=np.int64)])
+            leaves = [np.concatenate([
+                leaves[i],
+                np.asarray(entry[f"leaf_{i}"],
+                           dtype=self.agg.leaves[i].dtype)])
+                for i in range(len(leaves))]
         out = {
             "__delta__": np.asarray(True),
             "key_id": key_ids,
-            "namespace": self.index.slot_ns[dirty_used],
+            "namespace": namespaces,
             "key_group": assign_key_groups(key_ids, self.max_parallelism),
             "freed_namespaces": freed,
             **{f"leaf_{i}": leaves[i] for i in range(len(leaves))},
         }
         self._dirty[:] = False
         self._freed_ns.clear()
+        self.spill.clear_dirty()
         return out
 
     def restore(self, snap: Dict[str, np.ndarray],
@@ -597,11 +1073,34 @@ class SlotTable:
             mask = np.array([g in key_group_filter for g in groups], dtype=bool)
             key_ids, namespaces = key_ids[mask], namespaces[mask]
             leaves = [l[mask] for l in leaves]
-        slots = self.lookup_or_insert(key_ids, namespaces)
-        accs_host = [np.array(a) for a in self.accs]  # writable copies
-        for acc, vals in zip(accs_host, leaves):
-            acc[slots] = vals
-        self.accs = tuple(jnp.asarray(a) for a in accs_host)
+        if self.max_device_slots and len(key_ids):
+            # spill-enabled restore: rows land in the spill tier grouped by
+            # namespace and reload lazily on first access — a snapshot far
+            # larger than HBM restores with bounded device memory
+            order = np.argsort(namespaces, kind="stable")
+            s_ns = namespaces[order]
+            s_keys = key_ids[order]
+            s_leaves = [l[order] for l in leaves]
+            bounds = np.nonzero(np.diff(s_ns))[0] + 1
+            starts = np.concatenate(([0], bounds))
+            ends = np.concatenate((bounds, [len(s_ns)]))
+            for a, b in zip(starts.tolist(), ends.tolist()):
+                ns = int(s_ns[a])
+                entry = {"key_id": s_keys[a:b],
+                         **{f"leaf_{i}": s_leaves[i][a:b]
+                            for i in range(len(s_leaves))}}
+                if ns in self.spill:
+                    self.spill.drop(ns)
+                self.spill.put(ns, entry, dirty=False)
+                # the namespace registry must know spilled namespaces'
+                # windows; registry entries are created on reload
+        elif len(key_ids):
+            slots = self.lookup_or_insert(key_ids, namespaces)
+            accs_host = [np.array(a) for a in self.accs]  # writable copies
+            for acc, vals in zip(accs_host, leaves):
+                acc[slots] = vals
+            self.accs = tuple(jnp.asarray(a) for a in accs_host)
         # restored state IS the new incremental base
         self._dirty[:] = False
         self._freed_ns.clear()
+        self.spill.clear_dirty()

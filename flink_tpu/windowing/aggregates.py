@@ -182,6 +182,49 @@ class AggregateFunction:
         return fn
 
     @property
+    def _merge_jit(self):
+        """(accs, slot_matrix [w, k]) -> merged leaves [w] WITHOUT finish —
+        the hybrid-fire read path: device-resident slices merge on device,
+        spilled slices merge on host, finish runs on host over the union."""
+        key = ("merge", tuple(MERGE_FN[l.reduce].__name__
+                              for l in self.leaves),
+               tuple(l.dtype.str for l in self.leaves))
+        fn = _JIT_CACHE.get(key)
+        if fn is None:
+            merges = tuple(MERGE_FN[l.reduce] for l in self.leaves)
+
+            @jax.jit
+            def merge(accs, slot_matrix):
+                return tuple(
+                    m(a[slot_matrix], axis=1) for a, m in zip(accs, merges))
+
+            _JIT_CACHE[key] = fn = merge
+        return fn
+
+    @property
+    def _put_jit(self):
+        """(accs, slots, per-leaf values) -> accs with ``a[slots] = v`` —
+        the spill-reload write path: values gathered to host at eviction
+        time are placed back verbatim (identity-masked at the reserved
+        slot 0 pad target)."""
+        idents = tuple(l.identity for l in self.leaves)
+        key = ("put", idents, tuple(l.dtype.str for l in self.leaves))
+        fn = _JIT_CACHE.get(key)
+        if fn is None:
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def put(accs, slots, values):
+                out = []
+                for a, v, i in zip(accs, values, idents):
+                    v = jnp.where(slots == 0, jnp.asarray(i, dtype=v.dtype),
+                                  v)
+                    out.append(a.at[slots].set(v))
+                return tuple(out)
+
+            _JIT_CACHE[key] = fn = put
+        return fn
+
+    @property
     def _reset_jit(self):
         idents = tuple(l.identity for l in self.leaves)
         key = ("reset", idents, tuple(l.dtype.str for l in self.leaves))

@@ -71,6 +71,7 @@ class SessionWindower:
         capacity: int = 1 << 16,
         max_parallelism: int = 128,
         allowed_lateness: int = 0,
+        spill: dict = None,
     ) -> None:
         self.gap = int(gap)
         self.agg = agg
@@ -79,7 +80,8 @@ class SessionWindower:
         # records beyond the allowance are dropped.
         self.allowed_lateness = int(allowed_lateness)
         self.table = SlotTable(agg, capacity=capacity,
-                               max_parallelism=max_parallelism)
+                               max_parallelism=max_parallelism,
+                               **(spill or {}))
         # key -> list of (start, end, sid), sorted by start; usually length 1
         self.sessions: Dict[int, List[Tuple[int, int, int]]] = {}
         self._next_sid = 1
@@ -170,8 +172,13 @@ class SessionWindower:
         ds = np.asarray([p[1] for p in self._merge_dst], dtype=np.int64)
         sk = np.asarray([p[0] for p in self._merge_src], dtype=np.int64)
         ss = np.asarray([p[1] for p in self._merge_src], dtype=np.int64)
-        dst_slots = self.table.lookup_or_insert(dk, ds)
-        src_slots = self.table.lookup_or_insert(sk, ss)
+        # ONE combined lookup: with a spill tier, a second lookup could
+        # evict slots the first just resolved — dst and src must be
+        # resident simultaneously for the merge kernel
+        m = len(dk)
+        both = self.table.lookup_or_insert(
+            np.concatenate([dk, sk]), np.concatenate([ds, ss]))
+        dst_slots, src_slots = both[:m], both[m:]
         size = pad_bucket_size(len(dst_slots))
         self.table.mark_dirty(dst_slots)
         self.table.mark_dirty(src_slots)
@@ -270,21 +277,35 @@ class SessionWindower:
         self.max_fired_watermark = max(self.max_fired_watermark, watermark)
         if not fired_keys:
             return []
-        fired_slots = self.table.lookup_or_insert(
-            np.asarray(fired_keys, dtype=np.int64),
-            np.asarray(fired_sids, dtype=np.int64))
-        matrix = np.asarray(fired_slots, dtype=np.int32)[:, None]
-        results = self.table.fire(matrix)
-        self.table.free_namespaces(fired_sids)
-        m = len(fired_keys)
-        cols = {
-            KEY_ID_FIELD: np.asarray(fired_keys, dtype=np.int64),
-            WINDOW_START_FIELD: np.asarray(fired_starts, dtype=np.int64),
-            WINDOW_END_FIELD: np.asarray(fired_ends, dtype=np.int64),
-            TIMESTAMP_FIELD: np.asarray(fired_ends, dtype=np.int64) - 1,
-        }
-        cols.update(results)
-        return [RecordBatch(cols)]
+        total = len(fired_keys)
+        # with a bounded device table, a mass fire (e.g. end of stream) can
+        # exceed what fits resident at once — fire in budget-sized chunks,
+        # freeing each chunk's sessions before resolving the next
+        chunk = total
+        if self.table.max_device_slots:
+            chunk = max(self.table.max_device_slots // 2, 1024)
+        out: List[RecordBatch] = []
+        for a in range(0, total, chunk):
+            b = min(a + chunk, total)
+            fired_slots = self.table.lookup_or_insert(
+                np.asarray(fired_keys[a:b], dtype=np.int64),
+                np.asarray(fired_sids[a:b], dtype=np.int64))
+            matrix = np.asarray(fired_slots, dtype=np.int32)[:, None]
+            results = self.table.fire(matrix)
+            self.table.free_namespaces(fired_sids[a:b])
+            m = b - a
+            cols = {
+                KEY_ID_FIELD: np.asarray(fired_keys[a:b], dtype=np.int64),
+                WINDOW_START_FIELD: np.asarray(fired_starts[a:b],
+                                               dtype=np.int64),
+                WINDOW_END_FIELD: np.asarray(fired_ends[a:b],
+                                             dtype=np.int64),
+                TIMESTAMP_FIELD: np.asarray(fired_ends[a:b],
+                                            dtype=np.int64) - 1,
+            }
+            cols.update(results)
+            out.append(RecordBatch(cols))
+        return out
 
     # -------------------------------------------------------------- snapshot
 

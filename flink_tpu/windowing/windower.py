@@ -46,11 +46,13 @@ class SliceSharedWindower:
         capacity: int = 1 << 16,
         max_parallelism: int = 128,
         allowed_lateness: int = 0,
+        spill: dict = None,
     ) -> None:
         self.assigner = assigner
         self.agg = agg
         self.table = SlotTable(agg, capacity=capacity,
-                               max_parallelism=max_parallelism)
+                               max_parallelism=max_parallelism,
+                               **(spill or {}))
         self.book = SliceBookkeeper(assigner, allowed_lateness)
 
     @property
@@ -71,8 +73,8 @@ class SliceSharedWindower:
             if len(batch) == 0:
                 return
         self.book.register_slices(slice_ends)
-        slots = self.table.lookup_or_insert(batch.key_ids, slice_ends)
-        self.table.scatter(slots, self.agg.map_input(batch))
+        self.table.upsert(batch.key_ids, slice_ends,
+                          self.agg.map_input(batch))
 
     # ----------------------------------------------------------------- fire
 
@@ -94,6 +96,25 @@ class SliceSharedWindower:
 
     def _fire_window(self, window_end: int) -> Optional[RecordBatch]:
         slice_ends = self.assigner.slice_ends_for_window(window_end)
+        if any(int(se) in self.table.spill for se in slice_ends):
+            # hybrid fire: resident slices merge on device, spilled slices
+            # merge on host — no residency requirement, so the device
+            # budget is independent of the window's slice count
+            keys, results = self.table.fire_hybrid(
+                [int(se) for se in slice_ends])
+            if len(keys) == 0:
+                return None
+            m = len(keys)
+            cols = {
+                KEY_ID_FIELD: keys,
+                WINDOW_START_FIELD: np.full(
+                    m, self.assigner.window_start(window_end),
+                    dtype=np.int64),
+                WINDOW_END_FIELD: np.full(m, window_end, dtype=np.int64),
+                TIMESTAMP_FIELD: np.full(m, window_end - 1, dtype=np.int64),
+            }
+            cols.update(results)
+            return RecordBatch(cols)
         k = len(slice_ends)
         per_slice = [(i, self.table.slots_for_namespace(se))
                      for i, se in enumerate(slice_ends)]

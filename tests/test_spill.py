@@ -1,0 +1,292 @@
+"""Beyond-HBM state: the spill tier (device -> host -> filesystem).
+
+Round-1 verdict item 3: state must not be bounded by device memory. The
+SlotTable becomes an HBM-bounded cache over a host/filesystem SpillTier —
+cold namespaces evict wholesale, reload transparently on access, fire and
+queries tolerate non-resident slices, and snapshots (full + delta) cover
+all tiers.
+
+reference model: RocksDBKeyedStateBackend (state ≫ memory),
+ForStStateExecutor.java:149 (batched state movement).
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.connectors.sinks import CollectSink
+from flink_tpu.connectors.sources import DataGenSource
+from flink_tpu.core.config import Configuration
+from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
+from flink_tpu.datastream.environment import StreamExecutionEnvironment
+from flink_tpu.runtime.watermarks import WatermarkStrategy
+from flink_tpu.state.keygroups import hash_keys_to_i64
+from flink_tpu.state.slot_table import SlotTable, SlotTableFullError
+from flink_tpu.windowing.aggregates import SumAggregate
+from flink_tpu.windowing.assigners import (
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+)
+from flink_tpu.windowing.windower import SliceSharedWindower
+
+
+def table_rows(tbl):
+    return {
+        (int(k), int(n)): float(v)
+        for k, n, v in zip(tbl["key_id"], tbl["namespace"], tbl["leaf_0"])
+    }
+
+
+def fill(table, ns, keys, vals):
+    slots = table.lookup_or_insert(
+        np.asarray(keys, dtype=np.int64),
+        np.full(len(keys), ns, dtype=np.int64))
+    table.scatter(slots, (np.asarray(vals, dtype=np.float32),))
+
+
+class TestSlotTableSpill:
+    def test_eviction_and_transparent_reload(self):
+        t = SlotTable(SumAggregate("v"), capacity=1024,
+                      max_device_slots=1024)
+        keys = np.arange(1, 401, dtype=np.int64)
+        # 5 namespaces x 400 keys = 2000 rows >> 1023 device slots
+        for ns in range(10, 60, 10):
+            fill(t, ns, keys, np.full(400, float(ns)))
+        assert len(t.spill) > 0  # something actually spilled
+        assert set(int(n) for n in t.namespaces) == {10, 20, 30, 40, 50}
+        # writing to a spilled namespace reloads it and accumulates on top
+        fill(t, 10, keys[:5], np.ones(5))
+        q = t.query(int(keys[0]), namespace=10)
+        assert q[10]["sum_v"] == 11.0
+        # full snapshot covers every tier
+        rows = table_rows(t.snapshot())
+        assert len(rows) == 2000
+        assert rows[(int(keys[0]), 10)] == 11.0
+        assert rows[(int(keys[7]), 50)] == 50.0
+
+    def test_budget_exhausted_with_all_protected_fails_loudly(self):
+        t = SlotTable(SumAggregate("v"), capacity=1024,
+                      max_device_slots=1024)
+        with pytest.raises(SlotTableFullError, match="protected"):
+            fill(t, 7, np.arange(1, 1500, dtype=np.int64),
+                 np.ones(1499))
+
+    def test_free_namespaces_drops_spilled_entries_with_tombstones(self):
+        t = SlotTable(SumAggregate("v"), capacity=1024,
+                      max_device_slots=1024)
+        keys = np.arange(1, 401, dtype=np.int64)
+        for ns in (10, 20, 30, 40):
+            fill(t, ns, keys, np.full(400, float(ns)))
+        spilled_ns = [int(n) for n in t.spill.namespaces]
+        assert spilled_ns
+        t.snapshot()  # establish delta base
+        t.free_namespaces([spilled_ns[0]])
+        assert spilled_ns[0] not in t.spill
+        delta = t.snapshot_delta()
+        assert spilled_ns[0] in delta["freed_namespaces"].tolist()
+        assert spilled_ns[0] not in {int(n) for n in t.namespaces}
+
+    def test_delta_includes_dirty_spilled_namespaces(self):
+        from flink_tpu.checkpoint.storage import apply_table_delta
+
+        t = SlotTable(SumAggregate("v"), capacity=1024,
+                      max_device_slots=1024)
+        keys = np.arange(1, 401, dtype=np.int64)
+        fill(t, 10, keys, np.ones(400))
+        base = t.snapshot()
+        # dirty ns 10, then push it out with new namespaces
+        fill(t, 10, keys[:3], np.ones(3))
+        for ns in (20, 30, 40):
+            fill(t, ns, keys, np.full(400, float(ns)))
+        assert 10 in t.spill  # evicted while dirty
+        delta = t.snapshot_delta()
+        merged = table_rows(apply_table_delta(base, delta))
+        full = table_rows(t.snapshot())
+        assert merged == full
+        assert merged[(1, 10)] == 2.0
+
+    def test_filesystem_tier_roundtrip(self, tmp_path):
+        spill_dir = str(tmp_path / "spill")
+        t = SlotTable(SumAggregate("v"), capacity=1024,
+                      max_device_slots=1024,
+                      spill_dir=spill_dir,
+                      spill_host_max_bytes=1)  # everything overflows to fs
+        keys = np.arange(1, 401, dtype=np.int64)
+        for ns in (10, 20, 30, 40, 50):
+            fill(t, ns, keys, np.full(400, float(ns)))
+        import os
+
+        assert t.spill._fs, "nothing reached the filesystem tier"
+        assert os.listdir(spill_dir)
+        # reload from fs on access
+        q = t.query(1, namespace=int(next(iter(t.spill._fs))))
+        assert list(q.values())[0]["sum_v"] > 0
+        rows = table_rows(t.snapshot())
+        assert len(rows) == 2000
+
+    def test_restore_empty_snapshot_into_bounded_table(self):
+        """A checkpoint taken before any state existed must restore cleanly
+        on the spill path (regression: empty-array indexing)."""
+        empty = SlotTable(SumAggregate("v"), capacity=1024).snapshot()
+        t = SlotTable(SumAggregate("v"), capacity=1024,
+                      max_device_slots=1024)
+        t.restore(empty)
+        assert t.num_used == 0
+        fill(t, 10, np.asarray([1, 2]), np.asarray([1.0, 2.0]))
+        assert t.query(1, namespace=10)[10]["sum_v"] == 1.0
+
+    def test_restore_lazy_loads_into_bounded_table(self):
+        """A snapshot far larger than the device budget restores (rows land
+        in the spill tier) and serves reads/writes correctly."""
+        big = SlotTable(SumAggregate("v"), capacity=1 << 13)
+        keys = np.arange(1, 2001, dtype=np.int64)
+        for ns in (10, 20, 30):
+            fill(big, ns, keys, np.full(2000, float(ns)))
+        snap = big.snapshot()
+
+        small = SlotTable(SumAggregate("v"), capacity=1024,
+                          max_device_slots=2048)
+        small.restore(snap)
+        assert table_rows(small.snapshot(reset_dirty=False)) == \
+            table_rows(snap)
+        fill(small, 10, keys[:4], np.ones(4))
+        assert small.query(1, namespace=10)[10]["sum_v"] == 11.0
+
+
+class TestWindowedJobWithSpill:
+    @staticmethod
+    def run_job(extra, total=60_000, num_keys=3000):
+        env = StreamExecutionEnvironment(Configuration(
+            {"execution.micro-batch.size": 1024, **extra}))
+        sink = CollectSink()
+        (env.add_source(
+            DataGenSource(total_records=total, num_keys=num_keys,
+                          events_per_second_of_eventtime=10_000),
+            WatermarkStrategy.for_bounded_out_of_orderness(0))
+            .key_by("key")
+            .window(SlidingEventTimeWindows.of(4000, 1000))
+            .count()
+            .sink_to(sink))
+        env.execute()
+        return {(int(r["key"]), int(r["window_start"])): int(r["count"])
+                for r in sink.rows()}
+
+    def test_sliding_window_job_matches_oracle_under_heavy_spill(self):
+        """Live state (~5 slices x 3000 keys) is several times the device
+        budget; results must equal the unbounded run exactly — including
+        hybrid fires where part of a window's slices are spilled."""
+        unbounded = self.run_job({})
+        spilled = self.run_job({"state.slot-table.max-device-slots": 4096})
+        assert unbounded == spilled
+        # each record lands in 4 sliding windows (size 4000 / slide 1000)
+        assert sum(spilled.values()) == 4 * 60_000
+
+    def test_checkpoint_restore_with_spill(self, tmp_path):
+        """Exactly-once across failover with the spill tier active."""
+        import os
+
+        from flink_tpu.cluster.minicluster import FINISHED, MiniCluster
+        from flink_tpu.connectors.two_phase import ExactlyOnceFileSink
+
+        out = str(tmp_path / "out")
+        ck = str(tmp_path / "ck")
+        flag = str(tmp_path / "crashed.flag")
+        total = 20_000
+
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 256,
+            "state.checkpoints.dir": ck,
+            "execution.checkpointing.every-n-source-batches": 2,
+            "state.slot-table.max-device-slots": 2048,
+            "restart-strategy.max-attempts": 3,
+            "restart-strategy.delay-ms": 10,
+        }))
+
+        def poison_once(b, flag=flag):
+            ts = b.timestamps
+            if len(ts) and ts.max() > 900 and not os.path.exists(flag):
+                open(flag, "w").write("x")
+                raise RuntimeError("injected fault")
+            return b
+
+        (env.add_source(DataGenSource(total_records=total, num_keys=900,
+                                      events_per_second_of_eventtime=10_000),
+                        WatermarkStrategy.for_bounded_out_of_orderness(0))
+            .map(poison_once, name="poison")
+            .key_by("key")
+            .window(SlidingEventTimeWindows.of(2000, 500))
+            .count()
+            .sink_to(ExactlyOnceFileSink(out)))
+
+        cluster = MiniCluster(Configuration({"rest.port": -1}))
+        try:
+            client = cluster.submit(env, "spill-2pc-job")
+            st = client.wait(timeout=120)
+            assert st["status"] == FINISHED
+            assert st["attempt"] >= 1
+        finally:
+            cluster.shutdown()
+        rows = ExactlyOnceFileSink.read_committed_rows(out)
+        per_window = {}
+        for r in rows:
+            k = (int(r["key"]), int(r["window_start"]))
+            assert k not in per_window, f"duplicate committed window {k}"
+            per_window[k] = int(r["count"])
+        # each record lands in 4 sliding windows
+        assert sum(per_window.values()) == 4 * total
+
+    def test_session_job_with_keys_beyond_device_budget(self):
+        """The BASELINE 10M-key session shape, scaled down: live sessions
+        (one per key) far exceed the device slot budget; idle sessions
+        spill and reload on merge/fire. Results must match the unbounded
+        run exactly."""
+        from flink_tpu.windowing.assigners import EventTimeSessionWindows
+
+        def run(extra):
+            env = StreamExecutionEnvironment(Configuration(
+                {"execution.micro-batch.size": 1024, **extra}))
+            sink = CollectSink()
+            (env.add_source(
+                DataGenSource(total_records=40_000, num_keys=5_000,
+                              events_per_second_of_eventtime=2_000),
+                WatermarkStrategy.for_bounded_out_of_orderness(0))
+                .key_by("key")
+                .window(EventTimeSessionWindows.with_gap(800))
+                .count()
+                .sink_to(sink))
+            env.execute()
+            return sorted(
+                (int(r["key"]), int(r["window_start"]),
+                 int(r["window_end"]), int(r["count"]))
+                for r in sink.rows())
+
+        unbounded = run({})
+        spilled = run({"state.slot-table.max-device-slots": 2048})
+        assert unbounded == spilled
+        assert sum(c for _, _, _, c in spilled) == 40_000
+
+    def test_query_windows_spans_tiers(self):
+        assigner = SlidingEventTimeWindows.of(2000, 500)
+        w_spill = SliceSharedWindower(
+            assigner, SumAggregate("v"), capacity=1024,
+            spill={"max_device_slots": 1024})
+        w_ref = SliceSharedWindower(assigner, SumAggregate("v"),
+                                    capacity=1 << 13)
+        rng = np.random.default_rng(5)
+        n = 20_000
+        keys = rng.integers(0, 900, n)
+        batch = RecordBatch.from_pydict({
+            "key": keys,
+            "v": rng.random(n).astype(np.float32),
+            TIMESTAMP_FIELD: rng.integers(0, 3000, n),
+        }).with_column(KEY_ID_FIELD, hash_keys_to_i64(keys))
+        w_spill.process_batch(batch)
+        w_ref.process_batch(batch)
+        assert len(w_spill.table.spill) > 0
+        for key in (1, 57, 899):
+            kid = int(hash_keys_to_i64(np.asarray([key]))[0])
+            a = w_ref.query_windows(kid)
+            b = w_spill.query_windows(kid)
+            assert set(a) == set(b) and a
+            for w in a:
+                np.testing.assert_allclose(a[w]["sum_v"], b[w]["sum_v"],
+                                           rtol=1e-5)
