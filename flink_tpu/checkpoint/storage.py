@@ -26,6 +26,25 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 
+#: Snapshot format version (reference: TypeSerializerSnapshot versioning +
+#: savepoint format versions). Bump when the on-disk layout changes and
+#: register a migration; restore fails precisely on unknown versions.
+#: v1 = round-1 layout (uncompressed, no version field); v2 = same logical
+#: layout, compressed .npz allowed, version field present.
+FORMAT_VERSION = 2
+
+#: from_version -> fn(states: {uid: state}) -> states, migrating one step
+#: forward. Chained until FORMAT_VERSION is reached.
+_MIGRATIONS: Dict[int, Any] = {
+    1: lambda states: states,  # v1 -> v2: layout unchanged, read-compatible
+}
+
+
+def register_migration(from_version: int, fn) -> None:
+    """Install a one-step snapshot migration (from_version -> +1)."""
+    _MIGRATIONS[from_version] = fn
+
+
 @dataclasses.dataclass
 class CheckpointMetadata:
     checkpoint_id: int
@@ -33,6 +52,7 @@ class CheckpointMetadata:
     job_name: str
     operator_states: List[str]  # uids with .npz payloads
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    format_version: int = FORMAT_VERSION
 
 
 # --------------------------------------------------------------------------
@@ -85,7 +105,8 @@ def _merge(dst: Dict[str, Any], src: Dict[str, Any]) -> None:
 
 def write_snapshot_dir(final_dir: str, checkpoint_id: int, job_name: str,
                        operator_states: Dict[str, Dict[str, Any]],
-                       extra: Optional[Dict[str, Any]] = None) -> str:
+                       extra: Optional[Dict[str, Any]] = None,
+                       compress: bool = True) -> str:
     """Write a self-contained snapshot directory (tmp + atomic rename).
 
     An existing target is replaced only if it is itself a snapshot directory
@@ -105,7 +126,10 @@ def write_snapshot_dir(final_dir: str, checkpoint_id: int, job_name: str,
             uids.append(uid)
             arrays, meta = _split_state(state)
             if arrays:
-                np.savez(os.path.join(tmp_dir, f"op-{uid}.npz"), **arrays)
+                # compressed by default (reference compresses state with
+                # lz4/snappy, root pom.xml:168,225); np.load autodetects
+                save = np.savez_compressed if compress else np.savez
+                save(os.path.join(tmp_dir, f"op-{uid}.npz"), **arrays)
             with open(os.path.join(tmp_dir, f"op-{uid}.meta.pkl"), "wb") as f:
                 pickle.dump(meta, f)
         manifest = CheckpointMetadata(
@@ -131,8 +155,18 @@ def read_manifest(snapshot_dir: str) -> Dict[str, Any]:
 
 
 def read_snapshot_dir(snapshot_dir: str) -> Dict[str, Dict[str, Any]]:
-    """Read a snapshot directory back into operator-uid -> state dicts."""
+    """Read a snapshot directory back into operator-uid -> state dicts.
+
+    Prior-version snapshots are migrated forward step by step; a snapshot
+    from a NEWER format fails with a precise error (reference:
+    TypeSerializerSnapshot compatibility resolution)."""
     manifest = read_manifest(snapshot_dir)
+    version = int(manifest.get("format_version", 1))
+    if version > FORMAT_VERSION:
+        raise RuntimeError(
+            f"snapshot {snapshot_dir!r} has format version {version}, but "
+            f"this build reads at most {FORMAT_VERSION} — it was written "
+            "by a newer framework version")
     out: Dict[str, Dict[str, Any]] = {}
     for uid in manifest["operator_states"]:
         state: Dict[str, Any] = {}
@@ -145,6 +179,14 @@ def read_snapshot_dir(snapshot_dir: str) -> Dict[str, Dict[str, Any]]:
             meta = pickle.load(f)["meta"]
         _merge(state, meta)
         out[uid] = state
+    while version < FORMAT_VERSION:
+        migrate = _MIGRATIONS.get(version)
+        if migrate is None:
+            raise RuntimeError(
+                f"snapshot {snapshot_dir!r} has format version {version} "
+                f"and no migration to {version + 1} is registered")
+        out = migrate(out)
+        version += 1
     return out
 
 
@@ -289,8 +331,9 @@ class CheckpointStorage:
     FsCheckpointStorage's exclusive scope + atomic rename semantics).
     """
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, compress: bool = True):
         self.root = root
+        self.compress = compress
         os.makedirs(root, exist_ok=True)
 
     # ------------------------------------------------------------------ write
@@ -299,7 +342,8 @@ class CheckpointStorage:
                          operator_states: Dict[str, Dict[str, Any]],
                          extra: Optional[Dict[str, Any]] = None) -> str:
         return write_snapshot_dir(self._dir(checkpoint_id), checkpoint_id,
-                                  job_name, operator_states, extra)
+                                  job_name, operator_states, extra,
+                                  compress=self.compress)
 
     # ------------------------------------------------------------------- read
 

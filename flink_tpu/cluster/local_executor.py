@@ -117,6 +117,124 @@ class SavepointRequest(_ControlRequest):
         return self.result
 
 
+class _SourcePump:
+    """Bounded-prefetch source reader: a thread that polls one source,
+    assigns timestamps and watermarks, and hands (batch, watermark,
+    position) entries to the task loop through a bounded queue.
+
+    The queue bound IS the backpressure (credit-based flow control,
+    reference: RemoteInputChannel.java:114 unannouncedCredit — here a
+    credit is a queue slot). Each entry carries the source position taken
+    AFTER that batch, so a checkpoint cut at batch boundary N snapshots
+    exactly the consumed prefix — prefetched-but-unprocessed batches are
+    re-read after restore (reference: source offsets ride the same barrier
+    as operator state).
+
+    The pump owns the source object while running (single-owner
+    discipline); the task loop touches the source only after ``stop()``.
+    """
+
+    _EOS = object()
+
+    def __init__(self, transformation, batch_size: int, in_flight: int):
+        import queue as _q
+        import threading
+
+        self.t = transformation
+        self.batch_size = batch_size
+        self.queue: "_q.Queue" = _q.Queue(maxsize=max(in_flight, 1))
+        self.wm_gen = transformation.watermark_strategy.create()
+        self._stop = threading.Event()    # stop reading new batches
+        self._abort = threading.Event()   # discard mode: puts give up
+        self.error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, name=f"source-pump-{transformation.name}",
+            daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        # an already-polled batch advanced the source position, so it must
+        # reach the consumer unless the job is abandoning data outright
+        # (_abort); a mere stop_filling keeps trying while the drain path
+        # consumes
+        import queue as _q
+
+        while not self._abort.is_set():
+            try:
+                self.queue.put(item, timeout=0.05)
+                return True
+            except _q.Full:
+                continue
+        return False
+
+    def _run(self) -> None:
+        src = self.t.source
+        strategy = self.t.watermark_strategy
+        try:
+            while not self._stop.is_set():
+                # batch_size is re-read each poll: the adaptive controller
+                # on the task loop may resize it (benign cross-thread read)
+                batch = src.poll_batch(self.batch_size)
+                if batch is None:
+                    self._put((self._EOS, None, src.snapshot_position()))
+                    return
+                if len(batch) == 0:
+                    continue
+                batch = strategy.assign_timestamps(batch)
+                wm = self.wm_gen.on_batch(batch)
+                pos = src.snapshot_position()
+                if not self._put((batch, wm, pos)):
+                    return
+        except BaseException as e:  # noqa: BLE001 - surfaced to task loop
+            self.error = e
+            self._put((self._EOS, None, None))
+
+    def poll(self, timeout: float = 0.0):
+        """One queue entry or None. Raises the pump's error, if any."""
+        import queue as _q
+
+        try:
+            entry = self.queue.get(timeout=timeout) if timeout \
+                else self.queue.get_nowait()
+        except _q.Empty:
+            return None
+        if entry[0] is self._EOS and self.error is not None:
+            raise self.error
+        return entry
+
+    def stop_filling(self) -> None:
+        """Stop reading new batches; already-queued entries stay consumable
+        (the drain path processes them before the final snapshot)."""
+        self._stop.set()
+
+    def consume_remaining(self):
+        """Yield the queued entries after ``stop_filling`` until the pump
+        thread has exited and the queue is empty."""
+        import queue as _q
+
+        while self._thread.is_alive() or not self.queue.empty():
+            try:
+                yield self.queue.get(timeout=0.05)
+            except _q.Empty:
+                continue
+
+    def stop(self) -> None:
+        """Hard stop: discard prefetched entries (no-drain paths — the
+        consumed-prefix position makes dropped entries re-readable)."""
+        self._stop.set()
+        self._abort.set()
+        import queue as _q
+
+        try:
+            while True:
+                self.queue.get_nowait()
+        except _q.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+
 class LocalExecutor:
     def __init__(self, config: Optional[Configuration] = None):
         self.config = config or Configuration()
@@ -144,7 +262,9 @@ class LocalExecutor:
         if ckpt_dir and (ckpt_interval or ckpt_every_n):
             from flink_tpu.checkpoint.storage import CheckpointStorage
 
-            storage = CheckpointStorage(ckpt_dir)
+            storage = CheckpointStorage(
+                ckpt_dir,
+                compress=self.config.get(CheckpointOptions.COMPRESSION))
 
         # metrics + traces (reference: MetricRegistryImpl + Span reporting;
         # standard task I/O metric names follow the reference's
@@ -198,6 +318,18 @@ class LocalExecutor:
         for t, _ in sources:
             t.source.open(0, 1)
             generators[t.uid] = t.watermark_strategy.create()
+        in_flight = self.config.get(BatchOptions.IN_FLIGHT_BATCHES)
+        latency_target = self.config.get(BatchOptions.LATENCY_TARGET_MS)
+        debloater = None
+        if latency_target > 0:
+            from flink_tpu.runtime.debloater import BatchSizeController
+
+            debloater = BatchSizeController(
+                initial=batch_size,
+                min_size=self.config.get(BatchOptions.MIN_BATCH_SIZE),
+                max_size=batch_size,
+                target_latency_ms=latency_target)
+            batch_size = debloater.size
 
         checkpoint_count = 0
         claimed = None
@@ -245,6 +377,20 @@ class LocalExecutor:
             last_written_id = restored_id
 
         active = {t.uid for t, _ in sources}
+        # host/device overlap: pump threads poll + timestamp the NEXT
+        # batches while this loop drives slot lookups and (async-dispatched)
+        # device kernels for the current one; the bounded queue is the
+        # backpressure (reference: AsyncExecutionController.java:57 overlap,
+        # RemoteInputChannel credit flow). Positions consumed so far are
+        # tracked per source so checkpoint cuts stay exactly aligned.
+        pumps: Dict[int, _SourcePump] = {}
+        source_positions: Dict[int, Any] = {
+            t.uid: t.source.snapshot_position() for t, _ in sources}
+        if in_flight > 0:
+            for t, _ in sources:
+                pumps[t.uid] = _SourcePump(t, batch_size, in_flight)
+            for p in pumps.values():
+                p.start()
         try:
             while active:
                 if cancel_event is not None and cancel_event.is_set():
@@ -253,22 +399,46 @@ class LocalExecutor:
                 for t, node in sources:
                     if t.uid not in active:
                         continue
-                    batch = t.source.poll_batch(batch_size)
-                    if batch is None:
-                        active.discard(t.uid)
-                        self._emit_watermark(node, MAX_WATERMARK)
-                        t.source.close()
-                        continue
-                    if len(batch) == 0:
-                        continue
+                    if pumps:
+                        entry = pumps[t.uid].poll(
+                            timeout=0.002 if not progressed else 0.0)
+                        if entry is None:
+                            continue
+                        batch, wm, pos = entry
+                        if batch is _SourcePump._EOS:
+                            active.discard(t.uid)
+                            if pos is not None:
+                                source_positions[t.uid] = pos
+                            self._emit_watermark(node, MAX_WATERMARK)
+                            t.source.close()
+                            continue
+                    else:
+                        batch = t.source.poll_batch(batch_size)
+                        if batch is None:
+                            active.discard(t.uid)
+                            self._emit_watermark(node, MAX_WATERMARK)
+                            t.source.close()
+                            continue
+                        if len(batch) == 0:
+                            continue
+                        batch = t.watermark_strategy.assign_timestamps(batch)
+                        wm = generators[t.uid].on_batch(batch)
+                        pos = t.source.snapshot_position()
                     progressed = True
                     batches_since_ckpt += 1
-                    batch = t.watermark_strategy.assign_timestamps(batch)
                     total_records += len(batch)
+                    source_positions[t.uid] = pos
+                    tb = time.perf_counter() if debloater else 0.0
                     self._emit_batch(node, batch)
-                    wm = generators[t.uid].on_batch(batch)
                     if wm is not None:
                         self._emit_watermark(node, wm)
+                    if debloater is not None:
+                        new_size = debloater.observe(
+                            len(batch), time.perf_counter() - tb)
+                        if new_size != batch_size:
+                            batch_size = new_size
+                            for p in pumps.values():
+                                p.batch_size = new_size
                 if storage is not None:
                     due = (ckpt_every_n
                            and batches_since_ckpt >= ckpt_every_n) or (
@@ -283,6 +453,7 @@ class LocalExecutor:
                                 "checkpoint",
                                 f"checkpoint-{checkpoint_count}") as sp:
                             snap = self.snapshot_all(graph, nodes,
+                                                     source_positions,
                                                      delta=use_delta)
                             extra = ({"incremental": True,
                                       "base": last_written_id}
@@ -292,6 +463,9 @@ class LocalExecutor:
                                 extra=extra)
                             sp.set_attribute("checkpointId", checkpoint_count)
                             sp.set_attribute("incremental", use_delta)
+                            sp.set_attribute("stateSizeBytes", sum(
+                                e.stat().st_size
+                                for e in os.scandir(new_dir) if e.is_file()))
                         last_written_id = checkpoint_count
                         since_full = since_full + 1 if use_delta else 1
                         if claimed is not None:
@@ -311,12 +485,13 @@ class LocalExecutor:
                 if control_queue is not None:
                     stopped = self._serve_control(
                         control_queue, graph, nodes, sources, active,
-                        job_name, checkpoint_count, traces)
+                        job_name, checkpoint_count, traces,
+                        source_positions, pumps)
                     if stopped is not None:
                         suppress_final_drain = not stopped.drain
                         savepoint_path = stopped.result_path
                         break
-                if not progressed and active:
+                if not progressed and active and not pumps:
                     time.sleep(0.001)
             else:
                 suppress_final_drain = False
@@ -346,6 +521,11 @@ class LocalExecutor:
         except BaseException:
             # failure/cancel path: release resources without emitting
             # (reference: Task.doRun finally -> cancel + releaseResources)
+            for p in pumps.values():
+                try:
+                    p.stop()
+                except Exception:
+                    pass
             for t, _ in sources:
                 try:
                     t.source.close()
@@ -370,6 +550,8 @@ class LocalExecutor:
         metrics = {
             "records_emitted_by_sources": total_records,
             "runtime_s": elapsed,
+            **({"effective_batch_size": batch_size}
+               if debloater is not None else {}),
             "records_per_s": total_records / elapsed if elapsed > 0 else 0.0,
             "checkpoints": checkpoint_count,
             **({"savepoint": savepoint_path} if savepoint_path else {}),
@@ -397,7 +579,8 @@ class LocalExecutor:
     # -------------------------------------------------------------- control
 
     def _serve_control(self, control_queue, graph, nodes, sources, active,
-                       job_name: str, checkpoint_id: int, traces):
+                       job_name: str, checkpoint_id: int, traces,
+                       source_positions, pumps):
         """Serve pending SavepointRequests at a batch boundary. Returns the
         request if it asked the job to stop, else None."""
         import queue as _queue
@@ -405,6 +588,17 @@ class LocalExecutor:
         from flink_tpu.checkpoint.savepoint import write_savepoint
 
         from flink_tpu.checkpoint.savepoint import check_savepoint_target
+
+        def stop_sources():
+            # pumps own the sources while running: stop them first, then
+            # close (single-owner hand-back)
+            for t, node in sources:
+                if t.uid in active:
+                    p = pumps.get(t.uid)
+                    if p is not None:
+                        p.stop()
+                    t.source.close()
+            active.clear()
 
         while True:
             try:
@@ -423,23 +617,36 @@ class LocalExecutor:
                 # written must leave the job running (reference semantics)
                 check_savepoint_target(req.path)
                 if req.stop and req.drain:
-                    # --drain: flush every window/timer downstream before
-                    # the snapshot so results are final (reference:
+                    # --drain: process the pumps' prefetched batches (their
+                    # positions are already consumed-from-source), then
+                    # flush every window/timer downstream before the
+                    # snapshot so results are final (reference:
                     # stop-with-savepoint advanceToEndOfEventTime)
                     for t, node in sources:
-                        if t.uid in active:
-                            self._emit_watermark(node, MAX_WATERMARK)
-                            t.source.close()
-                    active.clear()
+                        if t.uid not in active:
+                            continue
+                        p = pumps.get(t.uid)
+                        if p is not None:
+                            p.stop_filling()
+                            for batch, wm, pos in p.consume_remaining():
+                                if pos is not None:
+                                    source_positions[t.uid] = pos
+                                if batch is _SourcePump._EOS:
+                                    continue
+                                self._emit_batch(node, batch)
+                            if p.error is not None:
+                                # a failed source must not masquerade as a
+                                # clean end-of-stream in a FINAL savepoint
+                                raise p.error
+                        self._emit_watermark(node, MAX_WATERMARK)
+                    stop_sources()
                 with traces.span("savepoint", req.path):
-                    snap = self.snapshot_all(graph, nodes, savepoint=True)
+                    snap = self.snapshot_all(graph, nodes, source_positions,
+                                             savepoint=True)
                     path = write_savepoint(req.path, job_name, snap,
                                            checkpoint_id=checkpoint_id)
                 if req.stop and not req.drain:
-                    for t, node in sources:
-                        if t.uid in active:
-                            t.source.close()
-                    active.clear()
+                    stop_sources()
                 req.finish(path)
             except BaseException as e:  # noqa: BLE001 - reported to caller
                 req.finish(None, e)
@@ -517,6 +724,7 @@ class LocalExecutor:
 
     @staticmethod
     def snapshot_all(graph: StreamGraph, nodes: Dict[int, _Node],
+                     source_positions: Optional[Dict[int, Any]] = None,
                      delta: bool = False,
                      savepoint: bool = False) -> Dict[str, Any]:
         snap: Dict[str, Any] = {}
@@ -524,7 +732,12 @@ class LocalExecutor:
             t = node.transformation
             op = node.operator
             if op is None:
-                state = {"source": t.source.snapshot_position()}
+                # positions of the CONSUMED prefix, not the pump's
+                # prefetched one — the checkpoint cut is the batch boundary
+                if source_positions is not None and uid in source_positions:
+                    state = {"source": source_positions[uid]}
+                else:
+                    state = {"source": t.source.snapshot_position()}
             elif delta and hasattr(op, "snapshot_state_delta"):
                 state = op.snapshot_state_delta()
             elif savepoint and hasattr(op, "snapshot_state_savepoint"):

@@ -157,6 +157,22 @@ class BatchOptions:
     BATCH_TIMEOUT_MS = ConfigOption(
         "execution.micro-batch.timeout-ms", default=10, type=int,
         description="Max time to wait filling a micro-batch before flushing.")
+    LATENCY_TARGET_MS = ConfigOption(
+        "execution.micro-batch.latency-target-ms", default=0, type=int,
+        description="Adaptive batch sizing: hold the per-batch processing "
+        "time to a fraction of this latency budget by resizing the "
+        "micro-batch online from an EMA of observed throughput "
+        "(reference: BufferDebloater). 0 = fixed batch size.")
+    MIN_BATCH_SIZE = ConfigOption(
+        "execution.micro-batch.min-size", default=256, type=int,
+        description="Lower bound for adaptive batch sizing.")
+    IN_FLIGHT_BATCHES = ConfigOption(
+        "execution.pipeline.in-flight-batches", default=2, type=int,
+        description="Bounded prefetch depth per source: a pump thread "
+        "polls/timestamps the next batches while the task loop drives the "
+        "device (credit-style backpressure — the pump blocks when the loop "
+        "falls behind; reference: RemoteInputChannel credit flow control). "
+        "0 = poll sources inline on the task loop.")
 
 
 class StateOptions:
@@ -196,6 +212,10 @@ class CheckpointOptions:
     RETAINED = ConfigOption(
         "execution.checkpointing.retained", default=3, type=int,
         description="How many completed checkpoints to keep.")
+    COMPRESSION = ConfigOption(
+        "execution.checkpointing.compression", default=True, type=bool,
+        description="Compress snapshot arrays (zlib inside .npz; the "
+        "reference uses lz4/snappy for state artifacts).")
     INCREMENTAL = ConfigOption(
         "execution.checkpointing.incremental", default=False, type=bool,
         description="Write delta checkpoints (dirty rows + tombstones) "

@@ -176,19 +176,25 @@ class HostSlotIndex(_NamespaceRegistry):
         return self._free.pop()
 
     def _grow(self) -> None:
+        # grow by doubling, clamped to max_capacity (matches the native
+        # index): refusing a partial last step would make free_headroom
+        # over-report and strand a mid-batch insert
         if not self.growable or (
-                self.max_capacity and self.capacity * 2 > self.max_capacity):
+                self.max_capacity and self.capacity >= self.max_capacity):
             raise SlotTableFullError(
                 f"slot table full (capacity={self.capacity}) and not "
                 f"growable; {self.full_hint}")
         old = self.capacity
         new_capacity = old * 2
+        if self.max_capacity:
+            new_capacity = min(new_capacity, self.max_capacity)
+        extra = new_capacity - old
         self.slot_key = np.concatenate(
-            [self.slot_key, np.zeros(old, dtype=np.int64)])
+            [self.slot_key, np.zeros(extra, dtype=np.int64)])
         self.slot_ns = np.concatenate(
-            [self.slot_ns, np.zeros(old, dtype=np.int64)])
+            [self.slot_ns, np.zeros(extra, dtype=np.int64)])
         self.slot_used = np.concatenate(
-            [self.slot_used, np.zeros(old, dtype=bool)])
+            [self.slot_used, np.zeros(extra, dtype=bool)])
         self._free.extend(range(new_capacity - 1, old - 1, -1))
         self.capacity = new_capacity
         if self.on_grow is not None:
@@ -554,20 +560,26 @@ class SlotTable:
     # ------------------------------------------------------------- main path
 
     def lookup_or_insert(self, key_ids: np.ndarray,
-                         namespaces: np.ndarray) -> np.ndarray:
+                         namespaces: np.ndarray,
+                         _pairs=None) -> np.ndarray:
         if self.max_device_slots:
-            touched = np.unique(np.asarray(namespaces, dtype=np.int64))
+            # ``_pairs`` lets upsert() hand down its already-computed
+            # unique (key, ns) pairs instead of re-sorting the batch
+            if _pairs is None:
+                uk, un, _ = unique_pairs(
+                    np.asarray(key_ids, dtype=np.int64),
+                    np.asarray(namespaces, dtype=np.int64))
+            else:
+                uk, un = _pairs
+            touched = np.unique(un)
             self.ensure_resident(touched.tolist())
             self._touch(touched.tolist())
             # headroom pre-check: lookup_or_insert allocates incrementally,
             # so running out MID-batch would leave the index and the
             # namespace registry inconsistent — make room up front for
-            # exactly the pairs that are genuinely new (a read-only probe)
-            uk, un, _ = unique_pairs(
-                np.asarray(key_ids, dtype=np.int64),
-                np.asarray(namespaces, dtype=np.int64))
-            # under ample headroom (the steady-state common case) skip the
-            # exact probe — len(uk) over-counts but cheaply proves safety
+            # exactly the pairs that are genuinely new (a read-only probe).
+            # Under ample headroom (the steady-state common case) skip the
+            # probe — len(uk) over-counts but cheaply proves safety.
             if self.index.free_headroom() < len(uk):
                 needed = int((self.index.lookup(uk, un) < 0).sum())
                 if needed:
@@ -590,11 +602,11 @@ class SlotTable:
         if self.max_device_slots:
             # slots are consumed per unique (key, ns) PAIR, not per record
             # — chunk only when the pair working set exceeds the budget
-            _, pair_ns, _ = unique_pairs(
+            pair_k, pair_ns, _ = unique_pairs(
                 np.asarray(key_ids, dtype=np.int64), namespaces)
             uniq_ns, counts = np.unique(pair_ns, return_counts=True)
             budget = max(self.max_device_slots // 2, 1024)
-            if len(uniq_ns) > 1 and int(counts.sum()) > budget:
+            if len(uniq_ns) > 1 and len(pair_ns) > budget:
                 groups: List[List[int]] = []
                 cur: List[int] = []
                 cur_n = 0
@@ -607,11 +619,17 @@ class SlotTable:
                 groups.append(cur)
                 for g in groups:
                     mask = np.isin(namespaces, g)
-                    slots = self.lookup_or_insert(key_ids[mask],
-                                                  namespaces[mask])
+                    pmask = np.isin(pair_ns, g)
+                    slots = self.lookup_or_insert(
+                        key_ids[mask], namespaces[mask],
+                        _pairs=(pair_k[pmask], pair_ns[pmask]))
                     self.scatter(slots, tuple(np.asarray(v)[mask]
                                               for v in values))
                 return
+            slots = self.lookup_or_insert(key_ids, namespaces,
+                                          _pairs=(pair_k, pair_ns))
+            self.scatter(slots, values)
+            return
         slots = self.lookup_or_insert(key_ids, namespaces)
         self.scatter(slots, values)
 
@@ -782,6 +800,26 @@ class SlotTable:
         out = self.agg._fire_jit(self.accs, jnp.asarray(padded))
         return {name: np.asarray(col)[:w] for name, col in out.items()}
 
+    def build_slice_matrix(self, slice_ends: List[int]
+                           ) -> Tuple[Optional[np.ndarray],
+                                      Optional[np.ndarray]]:
+        """(keys, [num_keys, k] slot matrix) for the resident slices of a
+        window — missing (key, slice) cells point at the identity slot 0.
+        Shared by the device fire path and the hybrid (spill) fire path."""
+        per_slice = [(i, self.index.slots_for_namespace(se))
+                     for i, se in enumerate(slice_ends)]
+        per_slice = [(i, s) for i, s in per_slice if len(s) > 0]
+        if not per_slice:
+            return None, None
+        all_slots = np.concatenate([s for _, s in per_slice])
+        all_sidx = np.concatenate(
+            [np.full(len(s), i, dtype=np.int32) for i, s in per_slice])
+        keys, inv = np.unique(self.index.slot_key[all_slots],
+                              return_inverse=True)
+        matrix = np.zeros((len(keys), len(slice_ends)), dtype=np.int32)
+        matrix[inv, all_sidx] = all_slots
+        return keys, matrix
+
     def fire_hybrid(self, slice_ends: List[int]
                     ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
         """Window fire tolerating spilled slices: device-resident slices
@@ -799,20 +837,11 @@ class SlotTable:
         key_chunks: List[np.ndarray] = []
         leaf_chunks: List[List[np.ndarray]] = [[] for _ in self.agg.leaves]
         # device part
-        per_slice = [(i, self.index.slots_for_namespace(se))
-                     for i, se in enumerate(resident)]
-        per_slice = [(i, s) for i, s in per_slice if len(s) > 0]
-        if per_slice:
-            all_slots = np.concatenate([s for _, s in per_slice])
-            all_sidx = np.concatenate(
-                [np.full(len(s), i, dtype=np.int32) for i, s in per_slice])
-            keys, inv = np.unique(self.index.slot_key[all_slots],
-                                  return_inverse=True)
-            matrix = np.zeros((len(keys), len(resident)), dtype=np.int32)
-            matrix[inv, all_sidx] = all_slots
+        keys, matrix = self.build_slice_matrix(resident)
+        if keys is not None:
             wp = sticky_bucket(len(keys), self._fire_bucket, minimum=64)
             self._fire_bucket = wp
-            padded = np.zeros((wp, len(resident)), dtype=np.int32)
+            padded = np.zeros((wp, matrix.shape[1]), dtype=np.int32)
             padded[:len(keys)] = matrix
             merged = self.agg._merge_jit(self.accs, jnp.asarray(padded))
             key_chunks.append(keys)
@@ -1069,6 +1098,25 @@ class SlotTable:
         namespaces = np.asarray(snap["namespace"], dtype=np.int64)
         groups = np.asarray(snap["key_group"], dtype=np.int32)
         leaves = [np.asarray(snap[f"leaf_{i}"]) for i in range(len(self.agg.leaves))]
+        # serializer-compatibility check (reference: TypeSerializerSnapshot
+        # resolveSchemaCompatibility): leaf dtypes must match the
+        # aggregate's accumulator layout. A value-preserving cast counts as
+        # compatible-after-migration (bootstrap writers use natural Python
+        # dtypes); anything lossy fails precisely instead of silently
+        # reinterpreting values.
+        for i, (arr, leaf) in enumerate(zip(leaves, self.agg.leaves)):
+            want = np.dtype(leaf.dtype)
+            if len(arr) and arr.dtype != want:
+                cast = arr.astype(want)
+                if not np.array_equal(cast.astype(arr.dtype), arr):
+                    raise RuntimeError(
+                        f"state schema incompatible: snapshot leaf_{i} has "
+                        f"dtype {arr.dtype}, the aggregate expects {want} "
+                        "and the values do not survive the cast — migrate "
+                        "the snapshot (checkpoint.storage."
+                        "register_migration) or restore with the original "
+                        "aggregate types")
+                leaves[i] = cast
         if key_group_filter is not None:
             mask = np.array([g in key_group_filter for g in groups], dtype=bool)
             key_ids, namespaces = key_ids[mask], namespaces[mask]

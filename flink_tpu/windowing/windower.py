@@ -116,23 +116,18 @@ class SliceSharedWindower:
             cols.update(results)
             return RecordBatch(cols)
         k = len(slice_ends)
-        per_slice = [(i, self.table.slots_for_namespace(se))
-                     for i, se in enumerate(slice_ends)]
-        per_slice = [(i, s) for i, s in per_slice if len(s) > 0]
-        if not per_slice:
-            return None
-        if len(per_slice) == 1 and k == 1:
-            slots = per_slice[0][1]
+        if k == 1:
+            # single-slice (tumbling) fast path: no cross-slice unique
+            slots = self.table.slots_for_namespace(slice_ends[0])
+            if len(slots) == 0:
+                return None
             keys = self.table.keys_of_slots(slots)
             matrix = slots[:, None].astype(np.int32)
         else:
-            all_slots = np.concatenate([s for _, s in per_slice])
-            all_slice_idx = np.concatenate(
-                [np.full(len(s), i, dtype=np.int32) for i, s in per_slice])
-            all_keys = self.table.keys_of_slots(all_slots)
-            keys, inv = np.unique(all_keys, return_inverse=True)
-            matrix = np.zeros((len(keys), k), dtype=np.int32)
-            matrix[inv, all_slice_idx] = all_slots
+            keys, matrix = self.table.build_slice_matrix(
+                [int(se) for se in slice_ends])
+            if keys is None:
+                return None
         results = self.table.fire(matrix)
         m = len(keys)
         cols = {

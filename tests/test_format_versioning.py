@@ -1,0 +1,148 @@
+"""Snapshot format versioning + compression.
+
+reference model: TypeSerializerSnapshot compatibility resolution
+(flink-core typeutils) and lz4/snappy state compression (root
+pom.xml:168,225).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from flink_tpu.checkpoint.storage import (
+    FORMAT_VERSION,
+    read_manifest,
+    read_snapshot_dir,
+    register_migration,
+    write_snapshot_dir,
+    _MIGRATIONS,
+)
+from flink_tpu.state.slot_table import SlotTable
+from flink_tpu.windowing.aggregates import SumAggregate
+
+
+def _state():
+    return {"table": {
+        "key_id": np.arange(100, dtype=np.int64),
+        "namespace": np.full(100, 10, dtype=np.int64),
+        "key_group": np.zeros(100, dtype=np.int32),
+        "leaf_0": np.random.default_rng(0).random(100).astype(np.float32),
+    }}
+
+
+class TestFormatVersion:
+    def test_manifest_carries_current_version(self, tmp_path):
+        d = write_snapshot_dir(str(tmp_path / "s"), 1, "job",
+                               {"op": _state()})
+        assert read_manifest(d)["format_version"] == FORMAT_VERSION
+
+    def test_newer_version_fails_precisely(self, tmp_path):
+        d = write_snapshot_dir(str(tmp_path / "s"), 1, "job",
+                               {"op": _state()})
+        m = read_manifest(d)
+        m["format_version"] = FORMAT_VERSION + 7
+        with open(os.path.join(d, "manifest.json"), "w") as f:
+            json.dump(m, f)
+        with pytest.raises(RuntimeError, match="newer framework version"):
+            read_snapshot_dir(d)
+
+    def test_v1_snapshot_migrates_forward(self, tmp_path):
+        """A round-1 snapshot (no version field) reads as v1 and migrates
+        through the registered chain."""
+        d = write_snapshot_dir(str(tmp_path / "s"), 1, "job",
+                               {"op": _state()})
+        m = read_manifest(d)
+        del m["format_version"]
+        with open(os.path.join(d, "manifest.json"), "w") as f:
+            json.dump(m, f)
+        states = read_snapshot_dir(d)
+        np.testing.assert_array_equal(states["op"]["table"]["key_id"],
+                                      np.arange(100))
+
+    def test_custom_migration_hook_runs(self, tmp_path):
+        d = write_snapshot_dir(str(tmp_path / "s"), 1, "job",
+                               {"op": _state()})
+        m = read_manifest(d)
+        del m["format_version"]  # pretend v1
+        with open(os.path.join(d, "manifest.json"), "w") as f:
+            json.dump(m, f)
+        seen = {}
+        old = _MIGRATIONS[1]
+
+        def migrate(states):
+            seen["ran"] = True
+            return states
+
+        register_migration(1, migrate)
+        try:
+            read_snapshot_dir(d)
+        finally:
+            register_migration(1, old)
+        assert seen.get("ran")
+
+    def test_lossy_dtype_restore_fails_lossless_migrates(self):
+        t = SlotTable(SumAggregate("v"), capacity=1024)
+        good = {
+            "key_id": np.asarray([1, 2], dtype=np.int64),
+            "namespace": np.asarray([10, 10], dtype=np.int64),
+            "key_group": np.zeros(2, dtype=np.int32),
+            "leaf_0": np.asarray([1.5, 2.5], dtype=np.float64),  # castable
+        }
+        t.restore(good)  # value-preserving cast float64 -> float32
+        assert t.query(1, namespace=10)[10]["sum_v"] == 1.5
+        bad = dict(good, leaf_0=np.asarray([1.0, 1e300]))  # overflows f32
+        with pytest.raises(RuntimeError, match="schema incompatible"):
+            SlotTable(SumAggregate("v"), capacity=1024).restore(bad)
+
+
+class TestCompression:
+    def test_compressed_snapshot_reads_back_and_is_smaller(self, tmp_path):
+        # highly compressible state
+        state = {"table": {
+            "key_id": np.arange(50_000, dtype=np.int64),
+            "namespace": np.full(50_000, 10, dtype=np.int64),
+            "key_group": np.zeros(50_000, dtype=np.int32),
+            "leaf_0": np.ones(50_000, dtype=np.float32),
+        }}
+        dc = write_snapshot_dir(str(tmp_path / "c"), 1, "job",
+                                {"op": state}, compress=True)
+        du = write_snapshot_dir(str(tmp_path / "u"), 1, "job",
+                                {"op": state}, compress=False)
+
+        def size(d):
+            return sum(e.stat().st_size for e in os.scandir(d))
+
+        assert size(dc) < size(du) / 4
+        a = read_snapshot_dir(dc)["op"]["table"]
+        b = read_snapshot_dir(du)["op"]["table"]
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+
+    def test_checkpoint_span_reports_state_size(self, tmp_path):
+        from flink_tpu.connectors.sinks import CollectSink
+        from flink_tpu.connectors.sources import DataGenSource
+        from flink_tpu.core.config import Configuration
+        from flink_tpu.datastream.environment import (
+            StreamExecutionEnvironment,
+        )
+        from flink_tpu.runtime.watermarks import WatermarkStrategy
+        from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 512,
+            "state.checkpoints.dir": str(tmp_path / "ck"),
+            "execution.checkpointing.every-n-source-batches": 2,
+        }))
+        sink = CollectSink()
+        (env.add_source(DataGenSource(total_records=8_000, num_keys=20,
+                                      events_per_second_of_eventtime=4_000),
+                        WatermarkStrategy.for_bounded_out_of_orderness(0))
+            .key_by("key").window(TumblingEventTimeWindows.of(1000)).count()
+            .sink_to(sink))
+        result = env.execute()
+        spans = result.traces.spans(scope="checkpoint")
+        assert spans
+        assert all(s.attributes.get("stateSizeBytes", 0) > 0
+                   for s in spans)
