@@ -138,7 +138,12 @@ def main():
 
     total = int(os.environ.get("BENCH_RECORDS", 8_000_000))
     try:
-        run(total_records=1 << 18, num_auctions=10_000)  # warmup/compile
+        # Warmup must cover the FIRE path too: at 200k events/s of event
+        # time the first HOP window closes at 2 s, so the warmup needs
+        # >400k records for the watermark to cross a window end and compile
+        # the fire/merge kernels (and it must use the production
+        # num_auctions so the pad buckets match the measured run).
+        run(total_records=1 << 21, num_auctions=100_000)
         stats = run(total_records=total)
     except Exception as e:  # degraded: still emit the JSON line
         print(f"# benchmark run failed: {e!r}", file=sys.stderr)
