@@ -92,7 +92,8 @@ def run(total_records: int, num_auctions: int = 100_000,
     # window ~2M, sized against the 1<<20 slot capacity
     src = BidSource(total_records=total_records, num_auctions=num_auctions,
                     events_per_second_of_eventtime=200_000)
-    build_q5(env, src, size_ms=10_000, slide_ms=2_000).sink_to(sink)
+    build_q5(env, src, size_ms=10_000, slide_ms=2_000,
+             device_top_k=16).sink_to(sink)
     t0 = time.perf_counter()
     result = env.execute("nexmark-q5-hot-items")
     elapsed = time.perf_counter() - t0

@@ -88,14 +88,28 @@ def _window_argmax(field: str):
 
 
 def build_q5(env, source: BidSource, size_ms: int = 10_000,
-             slide_ms: int = 2_000):
-    """Q5 hot items -> stream of (auction, count, window) winners."""
+             slide_ms: int = 2_000, device_top_k: int = 0):
+    """Q5 hot items -> stream of (auction, count, window) winners.
+
+    ``device_top_k`` > 0 fuses a top-k reduction into the window-fire
+    kernel (flink_tpu.windowing.fire_projectors.TopKFireProjector): only k
+    candidate rows cross HBM->host instead of one row per live auction, and
+    the arg-max map scans those k. Exact as long as ties for the max count
+    fit in k; 0 disables the fusion (tests with mass ties use 0).
+    """
+    from flink_tpu.windowing.aggregates import CountAggregate
+
+    projector = None
+    if device_top_k:
+        from flink_tpu.windowing.fire_projectors import TopKFireProjector
+
+        projector = TopKFireProjector("count", k=device_top_k)
     return (
         env.from_source(source,
                         WatermarkStrategy.for_bounded_out_of_orderness(0))
         .key_by("auction")
         .window(SlidingEventTimeWindows.of(size_ms, slide_ms))
-        .count()
+        .aggregate(CountAggregate(), fire_projector=projector)
         .map(_window_argmax("count"), name="hot_items_argmax")
     )
 

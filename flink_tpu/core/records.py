@@ -21,6 +21,23 @@ import numpy as np
 TIMESTAMP_FIELD = "__ts__"  # event-time, int64 epoch millis
 KEY_ID_FIELD = "__key_id__"  # int64 key identity (set by key_by)
 
+#: Changelog row kind (reference: flink-table-common RowKind.java / the
+#: UPDATE_BEFORE/UPDATE_AFTER retraction pairs of GroupAggFunction.java:85).
+#: Absent column == append-only stream (every row an INSERT).
+ROWKIND_FIELD = "__rowkind__"
+ROWKIND_INSERT = 0
+ROWKIND_UPDATE_BEFORE = 1
+ROWKIND_UPDATE_AFTER = 2
+ROWKIND_DELETE = 3
+
+
+def rowkind_signs(kinds: "np.ndarray") -> "np.ndarray":
+    """+1 for accumulate rows (INSERT/UPDATE_AFTER), -1 for retraction rows
+    (UPDATE_BEFORE/DELETE) — the changelog fold direction."""
+    return np.where(
+        (kinds == ROWKIND_UPDATE_BEFORE) | (kinds == ROWKIND_DELETE),
+        np.int8(-1), np.int8(1))
+
 
 @dataclasses.dataclass(frozen=True)
 class Field:
@@ -139,6 +156,11 @@ class RecordBatch:
     @property
     def is_keyed(self) -> bool:
         return KEY_ID_FIELD in self.columns
+
+    @property
+    def row_kinds(self) -> Optional[np.ndarray]:
+        """Changelog kinds column, or None for an append-only batch."""
+        return self.columns.get(ROWKIND_FIELD)
 
     # -- transforms (all return new batches) --------------------------------
 

@@ -413,7 +413,11 @@ class WindowedStream:
         return self
 
     def aggregate(self, agg: AggregateFunction,
-                  name: Optional[str] = None) -> DataStream:
+                  name: Optional[str] = None,
+                  fire_projector=None) -> DataStream:
+        """``fire_projector`` (flink_tpu.windowing.fire_projectors) reduces
+        each fired window's rows ON DEVICE before host transfer — the fused
+        form of a Top-N/arg-max consumer directly downstream."""
         env = self.keyed.env
         capacity = env.state_slot_capacity
         key_field = self.keyed.key_field
@@ -422,6 +426,11 @@ class WindowedStream:
         if getattr(assigner, "is_merging", False):
             from flink_tpu.runtime.operators import SessionWindowAggOperator
 
+            if fire_projector is not None:
+                raise ValueError(
+                    "fire_projector is not supported for merging (session) "
+                    "windows yet — a session fire emits one row per "
+                    "(key, merged window), not one batch per aligned window")
             gap = assigner.gap
             spill = env.state_spill_options
             factory = lambda: SessionWindowAggOperator(  # noqa: E731
@@ -431,7 +440,8 @@ class WindowedStream:
             spill = env.state_spill_options
             factory = lambda: WindowAggOperator(  # noqa: E731
                 assigner, agg, key_field, capacity=capacity,
-                allowed_lateness=lateness, spill=spill)
+                allowed_lateness=lateness, spill=spill,
+                fire_projector=fire_projector)
         t = Transformation(
             name=name or f"window_agg({type(agg).__name__})",
             kind="one_input",

@@ -62,9 +62,15 @@ class MeshWindowEngine:
         capacity_per_shard: int = 1 << 16,
         max_parallelism: int = 128,
         allowed_lateness: int = 0,
+        fire_projector=None,
     ) -> None:
         self.assigner = assigner
         self.agg = agg
+        #: host-side (cross-shard) fired-row reduction; the single-device
+        #: engine fuses this into the fire kernel, here it runs after the
+        #: per-shard results are assembled (the per-shard transfer is
+        #: already bounded by the fire bucket)
+        self.fire_projector = fire_projector
         self.mesh = mesh
         self.P = int(mesh.devices.size)
         self.capacity = max(int(capacity_per_shard), 1024)
@@ -327,6 +333,10 @@ class MeshWindowEngine:
             for name, arr in results.items():
                 res_cols[name].append(arr[p][:m])
         keys = np.concatenate(key_cols)
+        merged = {name: np.concatenate(chunks)
+                  for name, chunks in res_cols.items()}
+        if self.fire_projector is not None:
+            keys, merged = self.fire_projector.project_host(keys, merged)
         m = len(keys)
         cols = {
             KEY_ID_FIELD: keys,
@@ -335,8 +345,7 @@ class MeshWindowEngine:
             WINDOW_END_FIELD: np.full(m, window_end, dtype=np.int64),
             TIMESTAMP_FIELD: np.full(m, window_end - 1, dtype=np.int64),
         }
-        for name, chunks in res_cols.items():
-            cols[name] = np.concatenate(chunks)
+        cols.update(merged)
         return RecordBatch(cols)
 
     def _free_slices(self, ends: List[int]) -> None:

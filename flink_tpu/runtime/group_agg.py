@@ -1,17 +1,28 @@
-"""Unwindowed GROUP BY — running keyed aggregation with upsert emission.
+"""Unwindowed GROUP BY — running keyed aggregation with changelog emission.
 
 reference: flink-table-runtime/.../aggregate/GroupAggFunction.java:85
 (processElement reads accState.value(), folds one record, writes back, and
-emits the updated row downstream) and its MiniBatch variant
-(MiniBatchGroupAggFunction.java:163 finishBundle).
+emits retract+insert pairs downstream; `firstRow` decides INSERT vs
+UPDATE_BEFORE/UPDATE_AFTER, and a row-count accumulator decides DELETE) and
+its MiniBatch variant (MiniBatchGroupAggFunction.java:163 finishBundle).
 
 Re-design: the per-key accumulators live in the device SlotTable under a
 single namespace (namespace 0 — there is no window dimension); a micro-batch
-folds in with ONE scatter kernel per accumulator leaf, then the current value
-of every key *touched by the batch* is read back and emitted as an upsert
-(latest-value-wins, matching the reference's retract+insert pair collapsed
-into one changelog-upsert row — the reference emits UPDATE_BEFORE/UPDATE_AFTER;
-downstream consumers here key on the group columns).
+folds in with ONE scatter kernel per accumulator leaf. Emission is a
+changelog (RowKind column, flink_tpu.core.records.ROWKIND_FIELD):
+
+- first value of a key             -> INSERT
+- updated value                    -> UPDATE_BEFORE(prev) + UPDATE_AFTER(new)
+- row count falls to zero          -> DELETE(prev)
+
+The UPDATE_BEFORE image is the value at the key's previous *emission*
+(tracked in host arrays), so no extra device read is needed — exactly the
+reference's contract, where the retraction carries the previously emitted
+row. Retraction INPUT (a second-level aggregate over an updating stream) is
+consumed by folding each row's contribution with its changelog sign in one
+signed scatter; this requires every accumulator leaf to be additive
+(``AggregateFunction.retractable`` — COUNT/SUM/AVG yes, MAX/MIN no, like
+the reference's retractable agg function family).
 """
 
 from __future__ import annotations
@@ -20,7 +31,17 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
+from flink_tpu.core.records import (
+    KEY_ID_FIELD,
+    ROWKIND_DELETE,
+    ROWKIND_FIELD,
+    ROWKIND_INSERT,
+    ROWKIND_UPDATE_AFTER,
+    ROWKIND_UPDATE_BEFORE,
+    TIMESTAMP_FIELD,
+    RecordBatch,
+    rowkind_signs,
+)
 from flink_tpu.runtime.operators import Operator
 from flink_tpu.state.slot_table import SlotTable
 from flink_tpu.windowing.aggregates import AggregateFunction
@@ -33,22 +54,49 @@ class GroupAggOperator(Operator):
 
     def __init__(self, agg: AggregateFunction, key_field: str,
                  capacity: int = 1 << 16,
-                 emit_on_watermark_only: bool = False):
+                 emit_on_watermark_only: bool = False,
+                 generate_update_before: bool = True):
         self.agg = agg
         self.key_field = key_field
         self.capacity = capacity
-        #: True = suppress per-batch upserts, emit one final table per
-        #: watermark advance (MiniBatch-style deduped emission)
+        #: True = suppress per-batch emission, emit one deduped changelog
+        #: per watermark advance (MiniBatch-style emission)
         self.emit_on_watermark_only = emit_on_watermark_only
+        #: False = upsert mode: UPDATE_AFTER only (no retraction images),
+        #: DELETEs still emitted — for upsert-keyed sinks
+        self.generate_update_before = generate_update_before
         self.table: Optional[SlotTable] = None
         self._key_values: Dict[int, Any] = {}
         self._keys_hashed = False
         self._dirty: set = set()
         self._max_ts = 0
+        # per-slot changelog bookkeeping (host; grown with the table)
+        self._row_counts = np.zeros(0, dtype=np.int64)
+        self._emitted_mask = np.zeros(0, dtype=bool)
+        self._last_emitted: Dict[str, np.ndarray] = {}
 
     def open(self, ctx):
         self.table = SlotTable(self.agg, capacity=self.capacity,
                                max_parallelism=ctx.max_parallelism)
+
+    # ------------------------------------------------------------- host state
+
+    def _ensure_host_capacity(self, n: int) -> None:
+        if n <= len(self._row_counts):
+            return
+        size = max(n, 2 * len(self._row_counts), 1024)
+        grown = np.zeros(size, dtype=np.int64)
+        grown[: len(self._row_counts)] = self._row_counts
+        self._row_counts = grown
+        mask = np.zeros(size, dtype=bool)
+        mask[: len(self._emitted_mask)] = self._emitted_mask
+        self._emitted_mask = mask
+        for name, arr in self._last_emitted.items():
+            g = np.zeros(size, dtype=arr.dtype)
+            g[: len(arr)] = arr
+            self._last_emitted[name] = g
+
+    # ----------------------------------------------------------------- ingest
 
     def process_batch(self, batch: RecordBatch, input_index: int = 0
                       ) -> List[RecordBatch]:
@@ -66,7 +114,27 @@ class GroupAggOperator(Operator):
                     self._key_values.setdefault(i, keys[j])
         namespaces = np.full(len(batch), _GLOBAL_NS, dtype=np.int64)
         slots = self.table.lookup_or_insert(batch.key_ids, namespaces)
-        self.table.scatter(slots, self.agg.map_input(batch))
+        kinds = batch.row_kinds
+        signs = None if kinds is None else rowkind_signs(np.asarray(kinds))
+        if signs is None or not (signs < 0).any():
+            # append-only input (possibly an all-INSERT changelog) — the
+            # plain scatter path works for every aggregate, incl. MAX/MIN
+            self.table.scatter(slots, self.agg.map_input(batch))
+            if signs is not None and not (signs < 0).any():
+                signs = None
+        else:
+            if not self.agg.retractable:
+                raise ValueError(
+                    "aggregate over an updating (retraction) input requires "
+                    "retractable accumulators (COUNT/SUM/AVG); "
+                    f"{type(self.agg).__name__} has MAX/MIN-style leaves "
+                    "(reference: GroupAggFunction requires retract() for "
+                    "update streams)")
+            self.table.scatter_signed(
+                slots, self.agg.map_input_signed(batch, signs))
+        self._ensure_host_capacity(int(slots.max()) + 1)
+        np.add.at(self._row_counts, slots,
+                  1 if signs is None else signs.astype(np.int64))
         if self.emit_on_watermark_only:
             self._dirty.update(np.unique(slots).tolist())
             return []
@@ -81,50 +149,95 @@ class GroupAggOperator(Operator):
         out = self._emit_slots(slots)
         return [out] if out is not None else []
 
+    # --------------------------------------------------------------- emission
+
     def _emit_slots(self, slots: np.ndarray) -> Optional[RecordBatch]:
         if len(slots) == 0:
             return None
         results = self.table.fire(slots[:, None].astype(np.int32))
-        kid = self.table.keys_of_slots(slots)
+        self._ensure_host_capacity(int(slots.max()) + 1)
+        counts = self._row_counts[slots]
+        live = counts > 0
+        was_emitted = self._emitted_mask[slots]
+        # lazily allocate last-emitted storage from the first result dtypes
+        for name, col in results.items():
+            if name not in self._last_emitted:
+                self._last_emitted[name] = np.zeros(
+                    len(self._row_counts), dtype=np.asarray(col).dtype)
+
+        segments: List[Dict[str, np.ndarray]] = []
+
+        def _segment(slot_sel: np.ndarray, kind: int, from_prev: bool):
+            if not slot_sel.any():
+                return
+            sl = slots[slot_sel]
+            if from_prev:
+                vals = {n: self._last_emitted[n][sl] for n in results}
+            else:
+                vals = {n: np.asarray(results[n])[slot_sel] for n in results}
+            segments.append({
+                "slots": sl,
+                ROWKIND_FIELD: np.full(len(sl), kind, dtype=np.int8),
+                **vals,
+            })
+
+        upd = live & was_emitted
+        if self.generate_update_before:
+            _segment(upd, ROWKIND_UPDATE_BEFORE, from_prev=True)
+        _segment(~live & was_emitted, ROWKIND_DELETE, from_prev=True)
+        _segment(live & ~was_emitted, ROWKIND_INSERT, from_prev=False)
+        _segment(upd, ROWKIND_UPDATE_AFTER, from_prev=False)
+
+        # roll the changelog bookkeeping forward
+        for name in results:
+            arr = self._last_emitted[name]
+            arr[slots[live]] = np.asarray(results[name])[live]
+        self._emitted_mask[slots] = live
+
+        if not segments:
+            return None
+        all_slots = np.concatenate([s.pop("slots") for s in segments])
+        kid = self.table.keys_of_slots(all_slots)
         if self._keys_hashed:
             kv = np.array([self._key_values.get(int(i)) for i in kid],
                           dtype=object)
         else:
             kv = kid
-        cols = {
+        cols: Dict[str, np.ndarray] = {
             KEY_ID_FIELD: kid,
             self.key_field: kv,
-            TIMESTAMP_FIELD: np.full(len(slots), self._max_ts, dtype=np.int64),
+            TIMESTAMP_FIELD: np.full(len(all_slots), self._max_ts,
+                                     dtype=np.int64),
         }
-        cols.update(results)
+        for name in segments[0]:
+            cols[name] = np.concatenate([s[name] for s in segments])
         return RecordBatch(cols)
 
-    def snapshot_state(self):
+    # ------------------------------------------------------------- checkpoint
+
+    def _host_state(self):
         return {
-            "table": self.table.snapshot(),
             "key_values": dict(self._key_values),
             "keys_hashed": self._keys_hashed,
             "max_ts": self._max_ts,
+            "row_counts": self._row_counts.copy(),
+            "emitted_mask": self._emitted_mask.copy(),
+            "last_emitted": {n: a.copy()
+                             for n, a in self._last_emitted.items()},
         }
+
+    def snapshot_state(self):
+        return {"table": self.table.snapshot(), **self._host_state()}
 
     def snapshot_state_delta(self):
         """Incremental: dirty rows + tombstones only (see
         SlotTable.snapshot_delta)."""
-        return {
-            "table": self.table.snapshot_delta(),
-            "key_values": dict(self._key_values),
-            "keys_hashed": self._keys_hashed,
-            "max_ts": self._max_ts,
-        }
+        return {"table": self.table.snapshot_delta(), **self._host_state()}
 
     def snapshot_state_savepoint(self):
         """Full state without resetting the incremental base."""
-        return {
-            "table": self.table.snapshot(reset_dirty=False),
-            "key_values": dict(self._key_values),
-            "keys_hashed": self._keys_hashed,
-            "max_ts": self._max_ts,
-        }
+        return {"table": self.table.snapshot(reset_dirty=False),
+                **self._host_state()}
 
     def query_state(self, key_value, namespace=None):
         """Queryable-state point lookup (see WindowAggOperator)."""
@@ -138,3 +251,10 @@ class GroupAggOperator(Operator):
         self._key_values = dict(state.get("key_values", {}))
         self._keys_hashed = state.get("keys_hashed", False)
         self._max_ts = state.get("max_ts", 0)
+        self._row_counts = np.asarray(
+            state.get("row_counts", np.zeros(0, dtype=np.int64)))
+        self._emitted_mask = np.asarray(
+            state.get("emitted_mask", np.zeros(0, dtype=bool)))
+        self._last_emitted = {
+            n: np.asarray(a)
+            for n, a in state.get("last_emitted", {}).items()}

@@ -133,13 +133,15 @@ class WindowAggOperator(Operator):
 
     def __init__(self, assigner: WindowAssigner, agg: AggregateFunction,
                  key_field: str, capacity: int = 1 << 16,
-                 allowed_lateness: int = 0, spill: dict = None):
+                 allowed_lateness: int = 0, spill: dict = None,
+                 fire_projector=None):
         self.assigner = assigner
         self.agg = agg
         self.key_field = key_field
         self.capacity = capacity
         self.allowed_lateness = allowed_lateness
         self.spill = spill
+        self.fire_projector = fire_projector
         self.windower: Optional[SliceSharedWindower] = None
         self._key_values: Dict[int, Any] = {}  # key_id -> original key value
         self._keys_hashed = False
@@ -181,13 +183,15 @@ class WindowAggOperator(Operator):
                 self.assigner, self.agg, mesh,
                 capacity_per_shard=self.capacity,
                 max_parallelism=ctx.max_parallelism,
-                allowed_lateness=self.allowed_lateness)
+                allowed_lateness=self.allowed_lateness,
+                fire_projector=self.fire_projector)
         else:
             self.windower = SliceSharedWindower(
                 self.assigner, self.agg, capacity=self.capacity,
                 max_parallelism=ctx.max_parallelism,
                 allowed_lateness=self.allowed_lateness,
-                spill=self.spill)
+                spill=self.spill,
+                fire_projector=self.fire_projector)
 
     def process_batch(self, batch, input_index=0):
         if self.key_field in batch.columns:

@@ -32,7 +32,12 @@ import numpy as np
 
 from flink_tpu.state.keygroups import assign_key_groups
 from flink_tpu.windowing.aggregates import AggregateFunction
-from flink_tpu.ops.segment_ops import pad_bucket_size, pad_i32, sticky_bucket
+from flink_tpu.ops.segment_ops import (
+    pad_bucket_size,
+    pad_i32,
+    pad_values,
+    sticky_bucket,
+)
 
 
 def unique_pairs(
@@ -771,6 +776,24 @@ class SlotTable:
         padded_vals = self.agg.pad_input_values(values, size)
         self.accs = self.agg._scatter_jit(self.accs, padded_slots, padded_vals)
 
+    def scatter_signed(self, slots: np.ndarray,
+                       values: Tuple[np.ndarray, ...]) -> None:
+        """Changelog fold: values carry their sign (+accumulate /
+        -retract), every leaf valued (see AggregateFunction.map_input_signed).
+        Pad lanes contribute 0 to the reserved identity slot."""
+        n = len(slots)
+        if n == 0:
+            return
+        self._dirty[slots] = True
+        size = sticky_bucket(n, self._scatter_bucket)
+        self._scatter_bucket = size
+        padded_slots = pad_i32(slots, size, fill=0)
+        padded_vals = tuple(
+            pad_values(np.asarray(v, dtype=l.dtype), size, 0)
+            for v, l in zip(values, self.agg.leaves))
+        self.accs = self.agg._scatter_signed_jit(
+            self.accs, padded_slots, padded_vals)
+
     # ------------------------------------------------------------- fire path
 
     def slots_for_namespace(self, ns: int) -> np.ndarray:
@@ -799,6 +822,27 @@ class SlotTable:
         padded[:w] = slot_matrix
         out = self.agg._fire_jit(self.accs, jnp.asarray(padded))
         return {name: np.asarray(col)[:w] for name, col in out.items()}
+
+    def fire_projected(self, slot_matrix: np.ndarray, keys: np.ndarray,
+                       projector) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """Fire with a device-side FireProjector: merge+finish+project in
+        ONE kernel, transferring only the projector's ``num_out`` rows to
+        the host instead of the window's full [num_keys] result set (see
+        flink_tpu.windowing.fire_projectors — the Q5 hot-items fire drops
+        from ~100k transferred rows to k)."""
+        w, k = slot_matrix.shape
+        if w == 0:
+            return np.empty(0, dtype=np.int64), {
+                name: np.empty(0) for name in self.agg.output_names}
+        wp = sticky_bucket(w, self._fire_bucket, minimum=64)
+        self._fire_bucket = wp
+        padded = np.zeros((wp, k), dtype=np.int32)
+        padded[:w] = slot_matrix
+        pidx, pcols, pvalid = self.agg._fire_project_jit(projector)(
+            self.accs, jnp.asarray(padded), w)
+        sel = np.asarray(pvalid)
+        return (keys[np.asarray(pidx)[sel]],
+                {name: np.asarray(c)[sel] for name, c in pcols.items()})
 
     def build_slice_matrix(self, slice_ends: List[int]
                            ) -> Tuple[Optional[np.ndarray],

@@ -12,13 +12,19 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
+from flink_tpu.core.records import (
+    KEY_ID_FIELD,
+    ROWKIND_DELETE,
+    ROWKIND_FIELD,
+    TIMESTAMP_FIELD,
+    RecordBatch,
+)
 from flink_tpu.datastream.environment import StreamExecutionEnvironment
 from flink_tpu.datastream.stream import DataStream
 from flink_tpu.table import sql_parser
 from flink_tpu.table.planner import PlannedTable, PlanError, Planner
 
-_INTERNAL_COLS = (TIMESTAMP_FIELD, KEY_ID_FIELD)
+_INTERNAL_COLS = (TIMESTAMP_FIELD, KEY_ID_FIELD, ROWKIND_FIELD)
 
 
 class Table:
@@ -75,8 +81,10 @@ class TableResult:
     def _materialize(self, batch: RecordBatch) -> RecordBatch:
         t = self.table
         if len(batch) and t.upsert_keys is not None:
-            # changelog upsert stream: last value per key wins. An empty
-            # key list is a global aggregate — one constant key.
+            # changelog upsert stream: last value per key wins, and a key
+            # whose final row is a DELETE has left the table (reference:
+            # RowKind.DELETE applied by upsert sinks). An empty key list is
+            # a global aggregate — one constant key.
             if not t.upsert_keys:
                 batch = batch.slice(len(batch) - 1, len(batch))
             else:
@@ -89,6 +97,9 @@ class TableResult:
                     last[k] = i
                 idx = np.asarray(sorted(last.values()), dtype=np.int64)
                 batch = batch.take(idx)
+            if ROWKIND_FIELD in batch.columns and len(batch):
+                batch = batch.filter(
+                    batch[ROWKIND_FIELD] != ROWKIND_DELETE)
         if len(batch) and t.sort_spec is not None:
             sort_cols = []
             for expr, desc in reversed(t.sort_spec):

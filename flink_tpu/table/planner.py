@@ -25,6 +25,7 @@ import numpy as np
 
 from flink_tpu.core.records import (
     KEY_ID_FIELD,
+    ROWKIND_FIELD,
     TIMESTAMP_FIELD,
     RecordBatch,
 )
@@ -234,6 +235,9 @@ class Planner:
                     for n, e in zip(names, exprs)}
             if batch.has_timestamps:
                 cols[TIMESTAMP_FIELD] = batch.timestamps
+            if ROWKIND_FIELD in batch.columns:
+                # changelog kinds ride through projections untouched
+                cols[ROWKIND_FIELD] = batch[ROWKIND_FIELD]
             return RecordBatch(cols)
 
         out = stream.map(project, name="sql_project")
@@ -245,6 +249,13 @@ class Planner:
                         items: List[SelectItem], group_by: List[Expr],
                         having: Optional[Expr], window: Optional[ast.WindowTVF],
                         stmt: ast.SelectStmt) -> PlannedTable:
+        updating_input = source.upsert_keys is not None
+        if updating_input and window is not None:
+            raise PlanError(
+                "event-time window aggregate over an updating (changelog) "
+                "input is not supported — window state cannot retract "
+                "(reference: StreamPhysicalWindowAggregate requires "
+                "insert-only input)")
         if stmt.distinct and not any(i.expr.aggregates() for i in items) \
                 and not group_by:
             group_by = [i.expr for i in items]
@@ -337,6 +348,12 @@ class Planner:
 
         keyed = stream.key_by(key_field)
         multi = MultiAggregate(agg_fns)
+        if updating_input and not multi.retractable:
+            raise PlanError(
+                "MAX/MIN over an updating (changelog) input requires "
+                "retractable accumulators, which MAX/MIN are not "
+                "(reference: MaxWithRetractAggFunction keeps a sorted "
+                "multiset; use an append-only input or COUNT/SUM/AVG)")
         upsert_keys: Optional[List[str]] = None
         if window is not None:
             assigner = _window_assigner(window)
@@ -384,6 +401,11 @@ class Planner:
                     for n, e in zip(names, exprs)}
             if batch.has_timestamps:
                 cols[TIMESTAMP_FIELD] = batch.timestamps
+            if ROWKIND_FIELD in batch.columns:
+                # the group agg's changelog kinds survive the projection so
+                # downstream consumers (outer aggregates, upsert
+                # materialization) see retractions
+                cols[ROWKIND_FIELD] = batch[ROWKIND_FIELD]
             return RecordBatch(cols)
 
         out = post.map(project, name="sql_agg_project")
@@ -419,6 +441,11 @@ class Planner:
                    stmt: ast.SelectStmt) -> PlannedTable:
         if len(over_items) != 1:
             raise PlanError("exactly one OVER call per SELECT is supported")
+        if source.upsert_keys is not None:
+            raise PlanError(
+                "OVER/Top-N over an updating (changelog) input is not "
+                "supported yet — rank inputs must be insert-only "
+                "(reference: AppendOnlyTopNFunction vs RetractableTopN)")
         item = over_items[0]
         over: OverCall = item.expr
         rank_name = item.alias or over.output_name()
@@ -456,6 +483,10 @@ class Planner:
             raise PlanError(f"{join.kind} JOIN is not supported yet")
         left = self._plan_table_ref(join.left)
         right = self._plan_table_ref(join.right)
+        if left.upsert_keys is not None or right.upsert_keys is not None:
+            raise PlanError(
+                "JOIN over an updating (changelog) input is not supported "
+                "yet — join inputs must be insert-only")
         l_aliases = self._collect_aliases(join.left)
         r_aliases = self._collect_aliases(join.right)
 

@@ -165,6 +165,32 @@ class AggregateFunction:
             _JIT_CACHE[key] = fn = fire
         return fn
 
+    def _fire_project_jit(self, projector):
+        """(accs, slot_matrix [wp, k], w scalar) -> projected (row indices
+        [n], result cols [n], valid [n]) — the fire merge+finish fused with
+        a FireProjector so only n rows cross HBM->host instead of wp. The
+        validity mask is derived on device from the scalar row count and
+        keys never ship at all (the host resolves indices->keys), keeping
+        the fire's host->device traffic to the slot matrix alone (see
+        flink_tpu.windowing.fire_projectors)."""
+        key = ("fire_proj", self.cache_key(), projector.cache_key())
+        fn = _JIT_CACHE.get(key)
+        if fn is None:
+            merges = tuple(MERGE_FN[l.reduce] for l in self.leaves)
+            finish = self.finish
+            project = projector.project
+
+            @jax.jit
+            def fire_proj(accs, slot_matrix, w):
+                valid = jnp.arange(slot_matrix.shape[0]) < w
+                merged = tuple(
+                    m(a[slot_matrix], axis=1) for a, m in zip(accs, merges)
+                )
+                return project(finish(merged), valid)
+
+            _JIT_CACHE[key] = fn = fire_proj
+        return fn
+
     @property
     def _gather_jit(self):
         """(accs, slots) -> per-leaf gathered values — the incremental-
@@ -238,6 +264,53 @@ class AggregateFunction:
                 )
 
             _JIT_CACHE[key] = fn = reset
+        return fn
+
+    # -- retraction (changelog) support -------------------------------------
+
+    @property
+    def retractable(self) -> bool:
+        """True when every accumulator leaf folds by addition — the
+        changelog retract of a row is then the scatter of its negated
+        contribution (reference: AggregateFunction.retract / the
+        *WithRetractAggFunction family). MAX/MIN leaves are not
+        retractable."""
+        return all(l.reduce == "sum" for l in self.leaves)
+
+    def map_input_signed(self, batch: RecordBatch,
+                         signs: np.ndarray) -> Tuple[np.ndarray, ...]:
+        """One SIGNED value array per leaf (const leaves materialized):
+        +v for accumulate rows, -v for retraction rows."""
+        vit = iter(self.map_input(batch))
+        out = []
+        for leaf in self.leaves:
+            if leaf.const is not None:
+                v = np.full(len(batch), leaf.const, dtype=leaf.dtype)
+            else:
+                v = np.asarray(next(vit), dtype=leaf.dtype)
+            out.append(v * signs.astype(leaf.dtype))
+        return tuple(out)
+
+    @property
+    def _scatter_signed_jit(self):
+        """Scatter where EVERY leaf takes a (sign-applied) host value array
+        — the retraction fold. Only valid for retractable aggregates
+        (pure-add leaves), where padding with 0 at the reserved slot is
+        harmless."""
+        if not self.retractable:
+            raise TypeError(
+                f"{type(self).__name__} is not retractable (non-additive "
+                "accumulator leaf); an updating input cannot be folded")
+        key = ("scatter_signed", tuple(l.dtype.str for l in self.leaves))
+        fn = _JIT_CACHE.get(key)
+        if fn is None:
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def scatter_signed(accs, slots, values):
+                return tuple(
+                    a.at[slots].add(v) for a, v in zip(accs, values))
+
+            _JIT_CACHE[key] = fn = scatter_signed
         return fn
 
     # -- convenience --------------------------------------------------------

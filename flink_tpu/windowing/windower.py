@@ -47,6 +47,7 @@ class SliceSharedWindower:
         max_parallelism: int = 128,
         allowed_lateness: int = 0,
         spill: dict = None,
+        fire_projector=None,
     ) -> None:
         self.assigner = assigner
         self.agg = agg
@@ -54,6 +55,9 @@ class SliceSharedWindower:
                                max_parallelism=max_parallelism,
                                **(spill or {}))
         self.book = SliceBookkeeper(assigner, allowed_lateness)
+        #: optional device-side reduction of each fired window's rows
+        #: before host transfer (flink_tpu.windowing.fire_projectors)
+        self.fire_projector = fire_projector
 
     @property
     def late_records_dropped(self) -> int:
@@ -104,6 +108,9 @@ class SliceSharedWindower:
                 [int(se) for se in slice_ends])
             if len(keys) == 0:
                 return None
+            if self.fire_projector is not None:
+                keys, results = self.fire_projector.project_host(
+                    keys, results)
             m = len(keys)
             cols = {
                 KEY_ID_FIELD: keys,
@@ -128,7 +135,11 @@ class SliceSharedWindower:
                 [int(se) for se in slice_ends])
             if keys is None:
                 return None
-        results = self.table.fire(matrix)
+        if self.fire_projector is not None:
+            keys, results = self.table.fire_projected(
+                matrix, keys, self.fire_projector)
+        else:
+            results = self.table.fire(matrix)
         m = len(keys)
         cols = {
             KEY_ID_FIELD: keys,

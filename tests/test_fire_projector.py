@@ -1,0 +1,161 @@
+"""Fire-time device-side Top-N projection (fire_projectors).
+
+The projected fire must agree with the unprojected fire + host Top-N on
+every engine: single-device, spill-hybrid, and the 8-device mesh.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.records import RecordBatch
+from flink_tpu.runtime.watermarks import WatermarkStrategy
+from flink_tpu.windowing.aggregates import CountAggregate, SumAggregate
+from flink_tpu.windowing.assigners import SlidingEventTimeWindows
+from flink_tpu.windowing.fire_projectors import TopKFireProjector
+from flink_tpu.windowing.windower import SliceSharedWindower
+
+
+def _bids(n=5000, keys=200, seed=7, rate=1000):
+    rng = np.random.default_rng(seed)
+    ks = rng.integers(0, keys, n).astype(np.int64)
+    ts = (np.arange(n, dtype=np.int64) * 1000) // rate
+    vals = rng.random(n).astype(np.float32) * 10
+    return RecordBatch.from_pydict(
+        {"__key_id__": ks, "k": ks, "v": vals}, timestamps=ts)
+
+
+def _run_windower(w, batch, wm):
+    w.process_batch(batch)
+    return w.on_watermark(wm)
+
+
+class TestTopKProjector:
+    def test_matches_unprojected_fire(self):
+        batch = _bids()
+        assigner = SlidingEventTimeWindows.of(2000, 500)
+        plain = SliceSharedWindower(assigner, CountAggregate(), capacity=4096)
+        proj = SliceSharedWindower(
+            assigner, CountAggregate(), capacity=4096,
+            fire_projector=TopKFireProjector("count", k=8))
+        out_plain = _run_windower(plain, batch, 10_000)
+        out_proj = _run_windower(proj, batch, 10_000)
+        assert len(out_plain) == len(out_proj)
+        for bp, bq in zip(out_plain, out_proj):
+            assert len(bq) == min(8, len(bp))
+            # top-8 counts of the full fire == the projected batch's counts
+            want = np.sort(bp["count"])[::-1][: len(bq)]
+            got = np.sort(bq["count"])[::-1]
+            np.testing.assert_array_equal(want, got)
+            # the projected keys must be keys achieving those counts
+            kth = want[-1]
+            full = {int(k): int(c)
+                    for k, c in zip(bp["__key_id__"], bp["count"])}
+            for k, c in zip(bq["__key_id__"], bq["count"]):
+                assert full[int(k)] == int(c)
+                assert c >= kth
+
+    def test_ascending_and_sum(self):
+        batch = _bids()
+        assigner = SlidingEventTimeWindows.of(2000, 1000)
+        plain = SliceSharedWindower(
+            assigner, SumAggregate("v", output="s"), capacity=4096)
+        proj = SliceSharedWindower(
+            assigner, SumAggregate("v", output="s"), capacity=4096,
+            fire_projector=TopKFireProjector("s", k=4, descending=False))
+        out_plain = _run_windower(plain, batch, 10_000)
+        out_proj = _run_windower(proj, batch, 10_000)
+        for bp, bq in zip(out_plain, out_proj):
+            want = np.sort(bp["s"])[: len(bq)]
+            np.testing.assert_allclose(np.sort(bq["s"]), want, rtol=1e-5)
+
+    def test_fewer_rows_than_k(self):
+        batch = _bids(n=40, keys=3)
+        assigner = SlidingEventTimeWindows.of(2000, 1000)
+        proj = SliceSharedWindower(
+            assigner, CountAggregate(), capacity=1024,
+            fire_projector=TopKFireProjector("count", k=16))
+        out = _run_windower(proj, batch, 10_000)
+        assert out, "windows must fire"
+        for b in out:
+            # only real rows survive the validity mask
+            assert 0 < len(b) <= 3
+            assert (b["count"] > 0).all()
+
+    def test_hybrid_spill_fire_projects_on_host(self, tmp_path):
+        batch = _bids(n=4000, keys=500)
+        assigner = SlidingEventTimeWindows.of(2000, 500)
+        plain = SliceSharedWindower(assigner, CountAggregate(), capacity=8192)
+        proj = SliceSharedWindower(
+            assigner, CountAggregate(), capacity=8192,
+            spill={"max_device_slots": 1024,
+                   "spill_dir": str(tmp_path / "spill")},
+            fire_projector=TopKFireProjector("count", k=8))
+        out_plain = _run_windower(plain, batch, 10_000)
+        out_proj = _run_windower(proj, batch, 10_000)
+        assert len(out_plain) == len(out_proj)
+        for bp, bq in zip(out_plain, out_proj):
+            want = np.sort(bp["count"])[::-1][: len(bq)]
+            np.testing.assert_array_equal(np.sort(bq["count"])[::-1], want)
+
+
+class TestMeshProjector:
+    def test_mesh_fire_projects(self, eight_device_mesh):
+        from flink_tpu.parallel.sharded_windower import MeshWindowEngine
+
+        batch = _bids(n=8000, keys=300)
+        assigner = SlidingEventTimeWindows.of(2000, 500)
+        plain = MeshWindowEngine(
+            assigner, CountAggregate(), eight_device_mesh,
+            capacity_per_shard=4096)
+        proj = MeshWindowEngine(
+            assigner, CountAggregate(), eight_device_mesh,
+            capacity_per_shard=4096,
+            fire_projector=TopKFireProjector("count", k=8))
+        out_plain = _run_windower(plain, batch, 10_000)
+        out_proj = _run_windower(proj, batch, 10_000)
+        assert len(out_plain) == len(out_proj)
+        for bp, bq in zip(out_plain, out_proj):
+            want = np.sort(bp["count"])[::-1][: len(bq)]
+            np.testing.assert_array_equal(np.sort(bq["count"])[::-1], want)
+
+
+class TestQ5DeviceTopK:
+    def test_q5_fused_matches_oracle(self):
+        from flink_tpu import Configuration, StreamExecutionEnvironment
+        from flink_tpu.benchmarks.nexmark import (
+            BidSource, build_q5, oracle_q5)
+        from flink_tpu.connectors.sinks import CollectSink
+
+        src = BidSource(total_records=60_000, num_auctions=500,
+                        events_per_second_of_eventtime=10_000, seed=3)
+        ref_rows = []
+        probe = BidSource(total_records=60_000, num_auctions=500,
+                          events_per_second_of_eventtime=10_000, seed=3)
+        while True:
+            b = probe.poll_batch(8192)
+            if b is None:
+                break
+            ref_rows.extend(zip(b["auction"].tolist(),
+                                b.timestamps.tolist()))
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 8192,
+            "state.slot-table.capacity": 1 << 14,
+        }))
+        sink = CollectSink()
+        build_q5(env, src, size_ms=2000, slide_ms=500,
+                 device_top_k=16).sink_to(sink)
+        env.execute("q5-fused")
+        oracle = oracle_q5(ref_rows, 2000, 500)
+        got = {}
+        for r in sink.rows():
+            got.setdefault(int(r["window_end"]), set()).add(
+                (int(r["auction"]), int(r["count"])))
+        for w_end, (best, auctions) in oracle.items():
+            if w_end not in got:
+                continue  # incomplete tail windows don't fire
+            want = {(a, best) for a in auctions}
+            assert got[w_end] == want, f"window {w_end}"
+        # every complete window fired
+        last_complete = max(got) if got else 0
+        fired_ends = {w for w in oracle if w <= last_complete}
+        assert fired_ends <= set(got)
