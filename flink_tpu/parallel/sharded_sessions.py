@@ -1,0 +1,436 @@
+"""Mesh-sharded session windows.
+
+The multi-device form of ``flink_tpu.windowing.sessions.SessionWindower``
+(reference: WindowOperator.java:159-162 / MergingWindowSet): session interval
+*metadata* stays global on the host (``SessionIntervalSet``, shared with the
+single-device engine), while accumulator *state* lives in ``[P, capacity]``
+device arrays sharded over the key-group mesh axis.
+
+Why this shards cleanly: sessions are per-key, and keys are routed to exactly
+one shard by the key-group formula (reference:
+KeyGroupRangeAssignment.java:124-127) — so session merges NEVER cross shards.
+Every device step (record scatter, session merge, fire, reset) is ONE jitted
+``shard_map`` program over the whole mesh; the scatter/fire/reset programs are
+the same ones the mesh window engine uses (``build_mesh_steps``), plus one
+session-merge program (``acc[dst] op= acc[src]; acc[src] = identity``).
+
+Snapshots use the same logical format as SessionWindower (key_id / namespace
+/ key_group / leaf columns + interval metadata), so session checkpoints are
+mutually restorable across engines and mesh sizes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
+from flink_tpu.ops.segment_ops import SCATTER_METHOD, sticky_bucket
+from flink_tpu.parallel.mesh import KEY_AXIS
+from flink_tpu.parallel.sharded_windower import _STEP_CACHE, build_mesh_steps
+from flink_tpu.parallel.shuffle import bucket_by_shard, shard_records
+from flink_tpu.state.keygroups import assign_key_groups
+from flink_tpu.windowing.aggregates import AggregateFunction
+from flink_tpu.windowing.session_meta import MergeGroup, SessionIntervalSet
+from flink_tpu.windowing.windower import WINDOW_END_FIELD, WINDOW_START_FIELD
+
+
+def build_session_merge_step(mesh: Mesh, agg: AggregateFunction):
+    """One shard_map program: ``acc[p, dst] op= acc[p, src]`` for [P, M]
+    index blocks, then reset the src slots to identity (the mesh form of
+    sessions._merge_jit). Padded lanes use dst == src == 0 (reserved
+    identity slot) and are pure no-ops."""
+    key = ("session-merge", tuple(d.id for d in mesh.devices.flat),
+           agg.cache_key())
+    cached = _STEP_CACHE.get(key)
+    if cached is not None:
+        return cached
+    methods = tuple(SCATTER_METHOD[l.reduce] for l in agg.leaves)
+    idents = tuple(l.identity for l in agg.leaves)
+    n_leaves = len(agg.leaves)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def merge_step(accs, dst, src):
+        def local(*args):
+            accs_l = args[:n_leaves]
+            d = args[n_leaves][0]
+            s = args[n_leaves + 1][0]
+            out = []
+            for a, m, i in zip(accs_l, methods, idents):
+                moved = a[0][s]
+                a = getattr(a.at[0, d], m)(moved)
+                a = a.at[0, s].set(jnp.asarray(i, dtype=a.dtype))
+                out.append(a)
+            return tuple(out)
+
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(KEY_AXIS),) * (n_leaves + 2),
+            out_specs=(P(KEY_AXIS),) * n_leaves,
+        )(*accs, dst, src)
+
+    _STEP_CACHE[key] = merge_step
+    return merge_step
+
+
+class MeshSessionEngine:
+    """Keyed session windows sharded over a 1-D device mesh."""
+
+    def __init__(
+        self,
+        gap: int,
+        agg: AggregateFunction,
+        mesh: Mesh,
+        capacity_per_shard: int = 1 << 16,
+        max_parallelism: int = 128,
+        allowed_lateness: int = 0,
+    ) -> None:
+        self.gap = int(gap)
+        self.agg = agg
+        self.mesh = mesh
+        self.P = int(mesh.devices.size)
+        self.capacity = max(int(capacity_per_shard), 1024)
+        self.max_parallelism = max_parallelism
+        self.allowed_lateness = int(allowed_lateness)
+        if max_parallelism < self.P:
+            raise ValueError(
+                f"max_parallelism {max_parallelism} < mesh size {self.P}")
+
+        from flink_tpu.state.slot_table import make_slot_index
+
+        self.indexes = [
+            make_slot_index(
+                self.capacity, growable=False,
+                full_hint="raise MeshSessionEngine capacity_per_shard "
+                          "(hot-key skew can concentrate sessions on one "
+                          "shard)")
+            for _ in range(self.P)
+        ]
+        self._sharding = NamedSharding(mesh, P(KEY_AXIS))
+        self.accs: Tuple[jnp.ndarray, ...] = tuple(
+            jax.device_put(
+                jnp.full((self.P, self.capacity), leaf.identity,
+                         dtype=leaf.dtype),
+                self._sharding)
+            for leaf in agg.leaves
+        )
+        (self._scatter_step, self._fire_step, self._reset_step,
+         self._gather_step) = build_mesh_steps(mesh, agg)
+        self._merge_step = build_session_merge_step(mesh, agg)
+        self.meta = SessionIntervalSet(self.gap, self.allowed_lateness)
+        self._dirty = np.zeros((self.P, self.capacity), dtype=bool)
+        self._freed_ns: List[int] = []
+        self._merge_bucket = 0
+        self._fire_bucket = 0
+        self._reset_bucket = 0
+        self._gather_bucket = 0
+
+    @property
+    def late_records_dropped(self) -> int:
+        return self.meta.late_records_dropped
+
+    def _put_sharded(self, host_block: np.ndarray) -> jnp.ndarray:
+        return jax.device_put(host_block, self._sharding)
+
+    # ---------------------------------------------------------------- ingest
+
+    def process_batch(self, batch: RecordBatch) -> None:
+        n = len(batch)
+        if n == 0:
+            return
+        ts = np.asarray(batch.timestamps, dtype=np.int64)
+        keys = np.asarray(batch.key_ids, dtype=np.int64)
+
+        sess_key, sess_sid, rec_to_sess, order, groups = \
+            self.meta.absorb_batch(keys, ts)
+        for g in groups:
+            self._run_merge_group(g)
+
+        live_sess = sess_sid >= 0
+        if not live_sess.all():
+            starts_pos = np.nonzero(
+                np.diff(rec_to_sess, prepend=-1) > 0)[0]
+            sess_counts = np.diff(np.append(starts_pos, n))
+            self.meta.late_records_dropped += int(
+                sess_counts[~live_sess].sum())
+
+        # per-shard slot resolution for the live sessions
+        m = len(sess_key)
+        sess_shard = shard_records(sess_key, self.P, self.max_parallelism)
+        slot_of_sess = np.zeros(m, dtype=np.int32)
+        for p in range(self.P):
+            sel = (sess_shard == p) & live_sess
+            if sel.any():
+                slots = self.indexes[p].lookup_or_insert(
+                    sess_key[sel], sess_sid[sel])
+                slot_of_sess[sel] = slots
+                self._dirty[p, slots] = True
+
+        # route records: each record scatters into its session's slot on
+        # its session's shard (stale records keep slot 0 = identity)
+        rec_slots = np.empty(n, dtype=np.int32)
+        rec_slots[order] = slot_of_sess[rec_to_sess]
+        rec_shards = np.empty(n, dtype=sess_shard.dtype)
+        rec_shards[order] = sess_shard[rec_to_sess]
+        values = self.agg.map_input(batch)
+        in_leaves = self.agg.input_leaves
+        counts, blocked, _ = bucket_by_shard(
+            rec_shards, self.P,
+            columns=[rec_slots,
+                     *[np.asarray(v, dtype=l.dtype)
+                       for v, l in zip(values, in_leaves)]],
+            fills=[0, *[l.identity for l in in_leaves]],
+        )
+        slot_block = blocked[0].astype(np.int32)
+        value_blocks = blocked[1:]
+        self.accs = self._scatter_step(
+            self.accs,
+            self._put_sharded(slot_block),
+            tuple(self._put_sharded(v) for v in value_blocks),
+        )
+
+    def _run_merge_group(self, g: MergeGroup) -> None:
+        gk = np.asarray(g.keys_dst, dtype=np.int64)
+        ds = np.asarray(g.sids_dst, dtype=np.int64)
+        ss = np.asarray(g.sids_src, dtype=np.int64)
+        shards = shard_records(gk, self.P, self.max_parallelism)
+        m_max = 0
+        per_shard: List[Tuple[np.ndarray, np.ndarray]] = []
+        for p in range(self.P):
+            sel = shards == p
+            if not sel.any():
+                per_shard.append((np.empty(0, np.int32),
+                                  np.empty(0, np.int32)))
+                continue
+            # combined dst+src lookup per shard (dst and src share the key,
+            # hence the shard)
+            keys2 = np.concatenate([gk[sel], gk[sel]])
+            sids2 = np.concatenate([ds[sel], ss[sel]])
+            both = self.indexes[p].lookup_or_insert(keys2, sids2)
+            c = int(sel.sum())
+            d_slots, s_slots = both[:c], both[c:]
+            self._dirty[p, d_slots] = True
+            per_shard.append((d_slots.astype(np.int32),
+                              s_slots.astype(np.int32)))
+            m_max = max(m_max, c)
+        if m_max == 0:
+            return
+        M = sticky_bucket(m_max, self._merge_bucket)
+        self._merge_bucket = M
+        dst_block = np.zeros((self.P, M), dtype=np.int32)
+        src_block = np.zeros((self.P, M), dtype=np.int32)
+        for p, (d_slots, s_slots) in enumerate(per_shard):
+            dst_block[p, : len(d_slots)] = d_slots
+            src_block[p, : len(s_slots)] = s_slots
+        self.accs = self._merge_step(
+            self.accs, self._put_sharded(dst_block),
+            self._put_sharded(src_block))
+        # absorbed host slots reusable now that the kernel moved the values;
+        # record tombstones so delta snapshots drop the absorbed rows
+        self._freed_ns.extend(int(s) for s in g.absorbed_sids)
+        for p in range(self.P):
+            self.indexes[p].free_namespaces(g.absorbed_sids)
+
+    # ------------------------------------------------------------------ fire
+
+    def on_watermark(self, watermark: int) -> List[RecordBatch]:
+        keys, starts, ends, sids = self.meta.pop_fired(watermark)
+        if not keys:
+            return []
+        k_arr = np.asarray(keys, dtype=np.int64)
+        sid_arr = np.asarray(sids, dtype=np.int64)
+        shards = shard_records(k_arr, self.P, self.max_parallelism)
+        w_max = 0
+        per_shard_slots: List[np.ndarray] = []
+        per_shard_sel: List[np.ndarray] = []
+        for p in range(self.P):
+            sel = np.nonzero(shards == p)[0]
+            per_shard_sel.append(sel)
+            if len(sel) == 0:
+                per_shard_slots.append(np.empty(0, np.int32))
+                continue
+            slots = self.indexes[p].lookup_or_insert(
+                k_arr[sel], sid_arr[sel]).astype(np.int32)
+            per_shard_slots.append(slots)
+            w_max = max(w_max, len(sel))
+        W = sticky_bucket(w_max, self._fire_bucket, minimum=64)
+        self._fire_bucket = W
+        sm = np.zeros((self.P, W, 1), dtype=np.int32)
+        for p, slots in enumerate(per_shard_slots):
+            sm[p, : len(slots), 0] = slots
+        results = {name: np.asarray(arr)
+                   for name, arr in self._fire_step(
+                       self.accs, self._put_sharded(sm)).items()}
+        # reset fired slots + free their index entries
+        self._freed_ns.extend(int(s) for s in sids)
+        rb = np.zeros((self.P, W), dtype=np.int32)
+        for p, slots in enumerate(per_shard_slots):
+            rb[p, : len(slots)] = slots
+            if len(slots):
+                self._dirty[p, slots] = False
+            self.indexes[p].free_namespaces(
+                [int(sid_arr[i]) for i in per_shard_sel[p]])
+        self.accs = self._reset_step(self.accs, self._put_sharded(rb))
+        # assemble the output batch in shard order
+        st_arr = np.asarray(starts, dtype=np.int64)
+        en_arr = np.asarray(ends, dtype=np.int64)
+        out_idx = np.concatenate([s for s in per_shard_sel if len(s)])
+        cols = {
+            KEY_ID_FIELD: k_arr[out_idx],
+            WINDOW_START_FIELD: st_arr[out_idx],
+            WINDOW_END_FIELD: en_arr[out_idx],
+            TIMESTAMP_FIELD: en_arr[out_idx] - 1,
+        }
+        for name, arr in results.items():
+            chunks = [arr[p][: len(per_shard_sel[p])]
+                      for p in range(self.P) if len(per_shard_sel[p])]
+            cols[name] = np.concatenate(chunks)
+        return [RecordBatch(cols)]
+
+    # ---------------------------------------------------------- point query
+
+    def query_sessions(self, key_id: int) -> Dict[int, Dict[str, float]]:
+        """{session_end -> result columns} for a key's live sessions —
+        read-only point lookup on the owning shard."""
+        intervals = self.meta.sessions.get(int(key_id))
+        if not intervals:
+            return {}
+        shard = int(shard_records(
+            np.asarray([key_id], dtype=np.int64), self.P,
+            self.max_parallelism)[0])
+        sids = np.asarray([iv[2] for iv in intervals], dtype=np.int64)
+        keys = np.full(len(sids), int(key_id), dtype=np.int64)
+        slots = self.indexes[shard].lookup(keys, sids)
+        W = sticky_bucket(len(sids), self._fire_bucket, minimum=64)
+        sm = np.zeros((self.P, W, 1), dtype=np.int32)
+        sm[shard, : len(sids), 0] = np.where(slots >= 0, slots, 0)
+        results = self._fire_step(self.accs, self._put_sharded(sm))
+        return {int(iv[1]): {name: np.asarray(col)[shard][i].item()
+                             for name, col in results.items()}
+                for i, iv in enumerate(intervals)}
+
+    # -------------------------------------------------------------- snapshot
+
+    def snapshot(self, mode: str = "full") -> Dict[str, object]:
+        """Same logical format as SessionWindower.snapshot — restorable
+        across engines and mesh sizes (re-sharded by key group)."""
+        if mode == "delta":
+            return {"table": self._snapshot_delta(), **self.meta.snapshot()}
+        accs_host = [np.asarray(a) for a in self.accs]
+        parts = []
+        for p in range(self.P):
+            idx = self.indexes[p]
+            used = idx.used_slots()
+            key_ids = idx.slot_key[used]
+            parts.append({
+                "key_id": key_ids,
+                "namespace": idx.slot_ns[used],
+                "key_group": assign_key_groups(key_ids,
+                                               self.max_parallelism),
+                **{f"leaf_{i}": accs_host[i][p][used]
+                   for i in range(len(self.accs))},
+            })
+        merged = {
+            k: np.concatenate([pt[k] for pt in parts]) for k in parts[0]
+        } if parts else {}
+        if mode != "savepoint":
+            self._dirty[:] = False
+            self._freed_ns.clear()
+        return {"table": merged, **self.meta.snapshot()}
+
+    def _snapshot_delta(self) -> Dict[str, np.ndarray]:
+        """Dirty rows + freed-session tombstones (same format as
+        SlotTable.snapshot_delta / MeshWindowEngine._snapshot_delta)."""
+        per_shard = []
+        g_max = 0
+        for p in range(self.P):
+            used = self.indexes[p].slot_used[:self.capacity]
+            dirty = np.nonzero(self._dirty[p] & used)[0].astype(np.int32)
+            per_shard.append(dirty)
+            g_max = max(g_max, len(dirty))
+        freed = np.asarray(sorted(set(self._freed_ns)), dtype=np.int64)
+        if g_max == 0:
+            out = {
+                "__delta__": np.asarray(True),
+                "key_id": np.empty(0, dtype=np.int64),
+                "namespace": np.empty(0, dtype=np.int64),
+                "key_group": np.empty(0, dtype=np.int32),
+                "freed_namespaces": freed,
+                **{f"leaf_{i}": np.empty(0, dtype=l.dtype)
+                   for i, l in enumerate(self.agg.leaves)},
+            }
+        else:
+            G = sticky_bucket(g_max, self._gather_bucket)
+            self._gather_bucket = G
+            block = np.zeros((self.P, G), dtype=np.int32)
+            for p, dirty in enumerate(per_shard):
+                block[p, :len(dirty)] = dirty
+            gathered = self._gather_step(self.accs,
+                                         self._put_sharded(block))
+            leaves_host = [np.asarray(g) for g in gathered]
+            key_cols, ns_cols = [], []
+            leaf_cols = [[] for _ in leaves_host]
+            for p, dirty in enumerate(per_shard):
+                mm = len(dirty)
+                if mm == 0:
+                    continue
+                idx = self.indexes[p]
+                key_cols.append(idx.slot_key[dirty])
+                ns_cols.append(idx.slot_ns[dirty])
+                for i, lh in enumerate(leaves_host):
+                    leaf_cols[i].append(lh[p][:mm])
+            key_ids = np.concatenate(key_cols)
+            out = {
+                "__delta__": np.asarray(True),
+                "key_id": key_ids,
+                "namespace": np.concatenate(ns_cols),
+                "key_group": assign_key_groups(key_ids,
+                                               self.max_parallelism),
+                "freed_namespaces": freed,
+                **{f"leaf_{i}": np.concatenate(cols)
+                   for i, cols in enumerate(leaf_cols)},
+            }
+        self._dirty[:] = False
+        self._freed_ns.clear()
+        return out
+
+    def restore(self, snap: Dict[str, object],
+                key_group_filter=None) -> None:
+        """Restore, re-sharding by key group — accepts single-device
+        SessionWindower snapshots and mesh snapshots of any mesh size."""
+        table = snap.get("table", {})
+        key_ids = np.asarray(table.get("key_id", []), dtype=np.int64)
+        namespaces = np.asarray(table.get("namespace", []), dtype=np.int64)
+        if len(key_ids):
+            if key_group_filter is not None:
+                groups = assign_key_groups(key_ids, self.max_parallelism)
+                keep = np.isin(groups, np.asarray(sorted(key_group_filter)))
+                key_ids, namespaces = key_ids[keep], namespaces[keep]
+                leaves = [np.asarray(table[f"leaf_{i}"])[keep]
+                          for i in range(len(self.agg.leaves))]
+            else:
+                leaves = [np.asarray(table[f"leaf_{i}"])
+                          for i in range(len(self.agg.leaves))]
+        if len(key_ids):
+            shards = shard_records(key_ids, self.P, self.max_parallelism)
+            accs_host = [np.array(a) for a in self.accs]
+            for p in range(self.P):
+                mask = shards == p
+                if not mask.any():
+                    continue
+                slots = self.indexes[p].lookup_or_insert(
+                    key_ids[mask], namespaces[mask])
+                for acc, vals in zip(accs_host, leaves):
+                    acc[p][slots] = vals[mask]
+            self.accs = tuple(
+                jax.device_put(jnp.asarray(a), self._sharding)
+                for a in accs_host)
+        self._dirty[:] = False
+        self._freed_ns.clear()
+        self.meta.restore(snap, key_group_filter=key_group_filter,
+                          max_parallelism=self.max_parallelism)

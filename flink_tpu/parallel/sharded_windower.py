@@ -116,110 +116,9 @@ class MeshWindowEngine:
     # -------------------------------------------------------- jitted programs
 
     def _build_steps(self) -> None:
-        cache_key = (tuple(d.id for d in self.mesh.devices.flat),
-                     self.agg.cache_key())
-        cached = _STEP_CACHE.get(cache_key)
-        if cached is not None:
-            (self._scatter_step, self._fire_step, self._reset_step,
-             self._gather_step) = cached
-            return
-        mesh = self.mesh
-        leaves = self.agg.leaves
-        methods = tuple(SCATTER_METHOD[l.reduce] for l in self.agg.leaves)
-        merges = tuple(MERGE_FN[l.reduce] for l in self.agg.leaves)
-        idents = tuple(l.identity for l in self.agg.leaves)
-        finish = self.agg.finish
-        n_leaves = len(self.agg.leaves)
-        n_inputs = len(self.agg.input_leaves)
+        (self._scatter_step, self._fire_step, self._reset_step,
+         self._gather_step) = build_mesh_steps(self.mesh, self.agg)
 
-        @partial(jax.jit, donate_argnums=(0,))
-        def scatter_step(accs, slots, values):
-            # accs: ([P, cap], ...) sharded; slots: [P, B]; values: one
-            # [P, B] block per *input* leaf (const leaves broadcast on device)
-            def local(*args):
-                accs_l = args[:n_leaves]          # each [1, cap]
-                slots_l = args[n_leaves]          # [1, B]
-                vals_l = iter(args[n_leaves + 1:])  # each [1, B]
-                # .at[...].op() returns the full [1, cap] block
-                out = []
-                for a, m, l in zip(accs_l, methods, leaves):
-                    if l.const is not None:
-                        # padded lanes target identity slot 0 — keep it pure
-                        v = jnp.where(
-                            slots_l[0] == 0,
-                            jnp.asarray(l.identity, dtype=l.dtype),
-                            jnp.asarray(l.const, dtype=l.dtype))
-                    else:
-                        v = next(vals_l)[0]
-                    out.append(getattr(a.at[0, slots_l[0]], m)(v))
-                return tuple(out)
-
-            return jax.shard_map(
-                local, mesh=mesh,
-                in_specs=(P(KEY_AXIS),) * (n_leaves + 1 + n_inputs),
-                out_specs=(P(KEY_AXIS),) * n_leaves,
-            )(*accs, slots, *values)
-
-        # hoisted so the jitted closures capture only plain values, never
-        # `self` (the step cache outlives engines; a self-capture would pin
-        # the first engine's device arrays in memory for the process)
-        names = sorted(self.agg.output_names)
-
-        @jax.jit
-        def fire_step(accs, slot_matrix):
-            # slot_matrix: [P, W, k] sharded -> result cols each [P, W]
-            def local(*args):
-                accs_l = args[:n_leaves]          # [1, cap]
-                sm = args[n_leaves][0]            # [W, k]
-                merged = tuple(
-                    m(a[0][sm], axis=1) for a, m in zip(accs_l, merges))
-                out = finish(merged)              # dict name -> [W]
-                return tuple(out[name][None] for name in names)
-
-            outs = jax.shard_map(
-                local, mesh=mesh,
-                in_specs=(P(KEY_AXIS),) * (n_leaves + 1),
-                out_specs=(P(KEY_AXIS),) * len(names),
-            )(*accs, slot_matrix)
-            return dict(zip(names, outs))
-
-        @partial(jax.jit, donate_argnums=(0,))
-        def reset_step(accs, slots):
-            def local(*args):
-                accs_l = args[:n_leaves]
-                slots_l = args[n_leaves]
-                return tuple(
-                    a.at[0, slots_l[0]].set(i)
-                    for a, i in zip(accs_l, idents)
-                )
-
-            return jax.shard_map(
-                local, mesh=mesh,
-                in_specs=(P(KEY_AXIS),) * (n_leaves + 1),
-                out_specs=(P(KEY_AXIS),) * n_leaves,
-            )(*accs, slots)
-
-        @jax.jit
-        def gather_step(accs, slots):
-            # slots: [P, G] sharded -> per-leaf [P, G] raw accumulator
-            # values (delta-snapshot / point-query readback)
-            def local(*args):
-                accs_l = args[:n_leaves]
-                slots_l = args[n_leaves]
-                return tuple(a[0][slots_l[0]][None] for a in accs_l)
-
-            return jax.shard_map(
-                local, mesh=mesh,
-                in_specs=(P(KEY_AXIS),) * (n_leaves + 1),
-                out_specs=(P(KEY_AXIS),) * n_leaves,
-            )(*accs, slots)
-
-        self._scatter_step = scatter_step
-        self._fire_step = fire_step
-        self._reset_step = reset_step
-        self._gather_step = gather_step
-        _STEP_CACHE[cache_key] = (scatter_step, fire_step, reset_step,
-                                  gather_step)
 
     def _put_sharded(self, host_block: np.ndarray) -> jnp.ndarray:
         return jax.device_put(host_block, self._sharding)
@@ -523,3 +422,107 @@ class MeshWindowEngine:
         self._dirty[:] = False
         self._freed_ns.clear()
         self.book.restore(snap)
+
+
+def build_mesh_steps(mesh: Mesh, agg: AggregateFunction):
+    """(scatter, fire, reset, gather) shard_map step programs over a
+    [P, capacity] sharded slot table — shared by the mesh window and mesh
+    session engines (cached per (devices, aggregate layout))."""
+    cache_key = (tuple(d.id for d in mesh.devices.flat), agg.cache_key())
+    cached = _STEP_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
+    leaves = agg.leaves
+    methods = tuple(SCATTER_METHOD[l.reduce] for l in agg.leaves)
+    merges = tuple(MERGE_FN[l.reduce] for l in agg.leaves)
+    idents = tuple(l.identity for l in agg.leaves)
+    finish = agg.finish
+    n_leaves = len(agg.leaves)
+    n_inputs = len(agg.input_leaves)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def scatter_step(accs, slots, values):
+        # accs: ([P, cap], ...) sharded; slots: [P, B]; values: one
+        # [P, B] block per *input* leaf (const leaves broadcast on device)
+        def local(*args):
+            accs_l = args[:n_leaves]          # each [1, cap]
+            slots_l = args[n_leaves]          # [1, B]
+            vals_l = iter(args[n_leaves + 1:])  # each [1, B]
+            # .at[...].op() returns the full [1, cap] block
+            out = []
+            for a, m, l in zip(accs_l, methods, leaves):
+                if l.const is not None:
+                    # padded lanes target identity slot 0 — keep it pure
+                    v = jnp.where(
+                        slots_l[0] == 0,
+                        jnp.asarray(l.identity, dtype=l.dtype),
+                        jnp.asarray(l.const, dtype=l.dtype))
+                else:
+                    v = next(vals_l)[0]
+                out.append(getattr(a.at[0, slots_l[0]], m)(v))
+            return tuple(out)
+
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(KEY_AXIS),) * (n_leaves + 1 + n_inputs),
+            out_specs=(P(KEY_AXIS),) * n_leaves,
+        )(*accs, slots, *values)
+
+    # hoisted so the jitted closures capture only plain values, never
+    # an engine (the step cache outlives engines; a capture would pin
+    # the first engine's device arrays in memory for the process)
+    names = sorted(agg.output_names)
+
+    @jax.jit
+    def fire_step(accs, slot_matrix):
+        # slot_matrix: [P, W, k] sharded -> result cols each [P, W]
+        def local(*args):
+            accs_l = args[:n_leaves]          # [1, cap]
+            sm = args[n_leaves][0]            # [W, k]
+            merged = tuple(
+                m(a[0][sm], axis=1) for a, m in zip(accs_l, merges))
+            out = finish(merged)              # dict name -> [W]
+            return tuple(out[name][None] for name in names)
+
+        outs = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(KEY_AXIS),) * (n_leaves + 1),
+            out_specs=(P(KEY_AXIS),) * len(names),
+        )(*accs, slot_matrix)
+        return dict(zip(names, outs))
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def reset_step(accs, slots):
+        def local(*args):
+            accs_l = args[:n_leaves]
+            slots_l = args[n_leaves]
+            return tuple(
+                a.at[0, slots_l[0]].set(i)
+                for a, i in zip(accs_l, idents)
+            )
+
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(KEY_AXIS),) * (n_leaves + 1),
+            out_specs=(P(KEY_AXIS),) * n_leaves,
+        )(*accs, slots)
+
+    @jax.jit
+    def gather_step(accs, slots):
+        # slots: [P, G] sharded -> per-leaf [P, G] raw accumulator
+        # values (delta-snapshot / point-query readback)
+        def local(*args):
+            accs_l = args[:n_leaves]
+            slots_l = args[n_leaves]
+            return tuple(a[0][slots_l[0]][None] for a in accs_l)
+
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(KEY_AXIS),) * (n_leaves + 1),
+            out_specs=(P(KEY_AXIS),) * n_leaves,
+        )(*accs, slots)
+
+    _STEP_CACHE[cache_key] = steps = (scatter_step, fire_step,
+                                      reset_step, gather_step)
+    return steps
+

@@ -120,8 +120,7 @@ class GroupAggOperator(Operator):
             # append-only input (possibly an all-INSERT changelog) — the
             # plain scatter path works for every aggregate, incl. MAX/MIN
             self.table.scatter(slots, self.agg.map_input(batch))
-            if signs is not None and not (signs < 0).any():
-                signs = None
+            signs = None
         else:
             if not self.agg.retractable:
                 raise ValueError(

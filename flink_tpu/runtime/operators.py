@@ -298,13 +298,38 @@ class SessionWindowAggOperator(WindowAggOperator):
         self.gap = gap
 
     def open(self, ctx):
+        import jax
+
         from flink_tpu.windowing.sessions import SessionWindower
 
-        self.windower = SessionWindower(
-            self.gap, self.agg, capacity=self.capacity,
-            max_parallelism=ctx.max_parallelism,
-            allowed_lateness=self.allowed_lateness,
-            spill=self.spill)
+        effective = min(ctx.parallelism, len(jax.devices()))
+        if effective > 1:
+            # parallelism > 1 selects the mesh-sharded session engine —
+            # session merges are shard-local (keys own their sessions), so
+            # the metadata stays global and only state shards (reference:
+            # keyed state locality of MergingWindowSet state)
+            from flink_tpu.parallel.mesh import make_mesh
+            from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+
+            if self.spill and self.spill.get("max_device_slots"):
+                import warnings
+
+                warnings.warn(
+                    "state.slot-table.max-device-slots is not yet honored "
+                    "by the mesh-parallel session engine — state stays "
+                    "device-resident at parallelism > 1", stacklevel=2)
+            mesh = getattr(ctx, "mesh", None) or make_mesh(effective)
+            self.windower = MeshSessionEngine(
+                self.gap, self.agg, mesh,
+                capacity_per_shard=self.capacity,
+                max_parallelism=ctx.max_parallelism,
+                allowed_lateness=self.allowed_lateness)
+        else:
+            self.windower = SessionWindower(
+                self.gap, self.agg, capacity=self.capacity,
+                max_parallelism=ctx.max_parallelism,
+                allowed_lateness=self.allowed_lateness,
+                spill=self.spill)
 
     def query_state(self, key_value, namespace=None):
         """Session variant: the key's live sessions are host metadata
@@ -314,12 +339,14 @@ class SessionWindowAggOperator(WindowAggOperator):
 
         key_id = int(hash_keys_to_i64(np.asarray([key_value]))[0])
         w = self.windower
-        w._flush_merges()
-        out = {}
-        for start, end, sid in w.sessions.get(key_id, []):
-            per_sid = w.table.query(key_id, namespace=sid)
-            if sid in per_sid:
-                out[int(end)] = per_sid[sid]
+        if hasattr(w, "query_sessions"):  # mesh engine
+            out = w.query_sessions(key_id)
+        else:
+            out = {}
+            for start, end, sid in w.sessions.get(key_id, []):
+                per_sid = w.table.query(key_id, namespace=sid)
+                if sid in per_sid:
+                    out[int(end)] = per_sid[sid]
         if namespace is not None:
             return ({int(namespace): out[int(namespace)]}
                     if int(namespace) in out else {})

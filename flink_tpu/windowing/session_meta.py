@@ -1,0 +1,236 @@
+"""Host-side session interval metadata, shared by the single-device and
+mesh-sharded session engines.
+
+reference: MergingWindowSet + WindowOperator.java:159-162 — merge *metadata*
+(tiny per-key interval lists) lives apart from merged *state* (accumulator
+slots). This module is the metadata half; a device engine supplies the state
+half (slot resolution + merge/scatter/fire kernels).
+
+Key property exploited by the mesh engine: sessions are per-key and keys are
+owned by exactly one shard (key-group routing), so session merging NEVER
+crosses shards — the metadata is engine-global, only slot residency is
+sharded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_NEG_INF = -(1 << 62)
+
+
+@dataclasses.dataclass
+class MergeGroup:
+    """A chain-free batch of accumulator merges: within one group no sid is
+    both a source and a destination, so a single gather/scatter kernel is
+    safe. Groups must execute in order."""
+
+    keys_dst: List[int] = dataclasses.field(default_factory=list)
+    sids_dst: List[int] = dataclasses.field(default_factory=list)
+    keys_src: List[int] = dataclasses.field(default_factory=list)
+    sids_src: List[int] = dataclasses.field(default_factory=list)
+    absorbed_sids: List[int] = dataclasses.field(default_factory=list)
+
+    def __len__(self) -> int:
+        return len(self.sids_dst)
+
+
+class SessionIntervalSet:
+    """Per-key sorted interval lists + lazy fire heap + sid allocator."""
+
+    def __init__(self, gap: int, allowed_lateness: int = 0):
+        self.gap = int(gap)
+        self.allowed_lateness = int(allowed_lateness)
+        # key -> list of (start, end, sid), sorted by start; usually length 1
+        self.sessions: Dict[int, List[Tuple[int, int, int]]] = {}
+        self._next_sid = 1
+        self._fire_heap: List[Tuple[int, int, int]] = []  # (end, key, sid)
+        self.max_fired_watermark = _NEG_INF
+        self.late_records_dropped = 0
+        # merge-group accumulation during absorb_batch
+        self._groups: List[MergeGroup] = []
+        self._cur: Optional[MergeGroup] = None
+        self._cur_dst: set = set()
+        self._cur_src: set = set()
+
+    # ---------------------------------------------------------------- absorb
+
+    def absorb_batch(self, keys: np.ndarray, ts: np.ndarray
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                np.ndarray, List[MergeGroup]]:
+        """Sessionize a batch and merge it into the interval set.
+
+        Returns ``(sess_key, sess_sid, rec_to_sess, order, merge_groups)``:
+        per batch-local session its key and merged sid (-1 = stale on
+        arrival, see below), the sorted-order record->session indirection,
+        the lexsort order itself, and the accumulator merges the metadata
+        merge implied. Records of a stale session must be dropped (counted
+        in ``late_records_dropped`` by the caller via the -1 marker).
+
+        Lateness is decided per *merged session*, not per record — an
+        out-of-order record that merges into a live session is never late
+        (reference: WindowOperator merges first, then isWindowLate).
+        """
+        n = len(keys)
+        # vectorized batch-local sessionization: sort by (key, ts); a new
+        # local session starts at a key change or a gap exceedance
+        order = np.lexsort((ts, keys))
+        ks, tss = keys[order], ts[order]
+        new_sess = np.empty(n, dtype=bool)
+        new_sess[0] = True
+        new_sess[1:] = (ks[1:] != ks[:-1]) | (tss[1:] - tss[:-1] > self.gap)
+        rec_to_sess = np.cumsum(new_sess) - 1
+        starts_pos = np.nonzero(new_sess)[0]
+        m = len(starts_pos)
+        ends_pos = np.empty(m, dtype=np.int64)
+        ends_pos[:-1] = starts_pos[1:] - 1
+        ends_pos[-1] = n - 1
+        sess_key = ks[starts_pos]
+        sess_min = tss[starts_pos]
+        sess_max = tss[ends_pos]
+
+        self._groups, self._cur = [], None
+        self._cur_dst, self._cur_src = set(), set()
+        sess_sid = np.empty(m, dtype=np.int64)
+        for j in range(m):
+            sess_sid[j] = self._merge_session(
+                int(sess_key[j]), int(sess_min[j]),
+                int(sess_max[j]) + self.gap)
+        groups = self._groups
+        if self._cur is not None and len(self._cur):
+            groups.append(self._cur)
+        self._groups, self._cur = [], None
+        return sess_key, sess_sid, rec_to_sess, order, groups
+
+    def _add_merge(self, key: int, dst_sid: int, src_sid: int) -> None:
+        """Queue an accumulator merge. A chain (src was an earlier dst, or
+        dst was an earlier src) would make a single gather/scatter kernel
+        read stale values, so it closes the current group."""
+        if self._cur is None:
+            self._cur = MergeGroup()
+        elif (src_sid in self._cur_dst or src_sid in self._cur_src
+                or dst_sid in self._cur_src):
+            self._groups.append(self._cur)
+            self._cur = MergeGroup()
+            self._cur_dst, self._cur_src = set(), set()
+        g = self._cur
+        g.keys_dst.append(key)
+        g.sids_dst.append(dst_sid)
+        g.keys_src.append(key)
+        g.sids_src.append(src_sid)
+        g.absorbed_sids.append(src_sid)
+        self._cur_dst.add(dst_sid)
+        self._cur_src.add(src_sid)
+
+    def _merge_session(self, key: int, start: int, end: int) -> int:
+        """Merge [start, end) into key's intervals; returns the session id,
+        or -1 if the session is stale on arrival. Mirrors
+        MergingWindowSet.addWindow: overlapping intervals collapse into
+        one; absorbed sessions queue an accumulator merge."""
+        intervals = self.sessions.get(key)
+        if intervals is None:
+            if self._stale(end):
+                return -1
+            sid = self._alloc_sid()
+            self.sessions[key] = [(start, end, sid)]
+            heapq.heappush(self._fire_heap, (end, key, sid))
+            return sid
+
+        overlapping = [iv for iv in intervals
+                       if iv[0] <= end and start <= iv[1]]
+        if not overlapping:
+            if self._stale(end):
+                return -1
+            sid = self._alloc_sid()
+            intervals.append((start, end, sid))
+            intervals.sort()
+            heapq.heappush(self._fire_heap, (end, key, sid))
+            return sid
+
+        # absorb into the first overlapping interval's session
+        keep = overlapping[0]
+        new_start = min(start, keep[0])
+        new_end = max(end, keep[1])
+        for iv in overlapping[1:]:
+            new_start = min(new_start, iv[0])
+            new_end = max(new_end, iv[1])
+            self._add_merge(key, keep[2], iv[2])
+        remaining = [iv for iv in intervals if iv not in overlapping]
+        merged = (new_start, new_end, keep[2])
+        remaining.append(merged)
+        remaining.sort()
+        self.sessions[key] = remaining
+        if new_end != keep[1]:
+            heapq.heappush(self._fire_heap, (new_end, key, keep[2]))
+        return keep[2]
+
+    def _stale(self, end: int) -> bool:
+        return (self.max_fired_watermark > _NEG_INF // 2
+                and end - 1 + self.allowed_lateness
+                <= self.max_fired_watermark)
+
+    def _alloc_sid(self) -> int:
+        sid = self._next_sid
+        self._next_sid += 1
+        return sid
+
+    # ------------------------------------------------------------------ fire
+
+    def pop_fired(self, watermark: int
+                  ) -> Tuple[List[int], List[int], List[int], List[int]]:
+        """All sessions whose end - 1 <= watermark, removed from the set.
+        Returns (keys, starts, ends, sids). Stale heap entries (merged or
+        extended sessions) are skipped lazily."""
+        keys: List[int] = []
+        starts: List[int] = []
+        ends: List[int] = []
+        sids: List[int] = []
+        while self._fire_heap and self._fire_heap[0][0] - 1 <= watermark:
+            end, key, sid = heapq.heappop(self._fire_heap)
+            intervals = self.sessions.get(key)
+            if not intervals:
+                continue
+            cur = next((iv for iv in intervals if iv[2] == sid), None)
+            if cur is None or cur[1] != end:
+                continue  # stale entry
+            keys.append(key)
+            starts.append(cur[0])
+            ends.append(end)
+            sids.append(sid)
+            intervals.remove(cur)
+            if not intervals:
+                del self.sessions[key]
+        self.max_fired_watermark = max(self.max_fired_watermark, watermark)
+        return keys, starts, ends, sids
+
+    # -------------------------------------------------------------- snapshot
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "sessions": {k: list(v) for k, v in self.sessions.items()},
+            "next_sid": self._next_sid,
+            "max_fired_watermark": self.max_fired_watermark,
+        }
+
+    def restore(self, snap: Dict[str, object],
+                key_group_filter=None, max_parallelism: int = 128) -> None:
+        self.sessions = {}
+        self._fire_heap = []
+        for k, ivs in snap.get("sessions", {}).items():
+            kept = [tuple(iv) for iv in ivs]
+            if key_group_filter is not None:
+                from flink_tpu.state.keygroups import assign_key_groups
+
+                g = int(assign_key_groups(np.array([k]),
+                                          max_parallelism)[0])
+                if g not in key_group_filter:
+                    continue
+            self.sessions[int(k)] = kept
+            for start, end, sid in kept:
+                heapq.heappush(self._fire_heap, (end, int(k), sid))
+        self._next_sid = snap.get("next_sid", 1)
+        self.max_fired_watermark = snap.get("max_fired_watermark", _NEG_INF)

@@ -7,7 +7,9 @@ windowMergingState merges namespaces). The TPU re-design keeps exactly that
 split:
 
 - **Host**: per-key sorted interval lists ``key -> [(start, end, sid)]``
-  (tiny per key), a lazy fire heap, and a session-id allocator.
+  (tiny per key), a lazy fire heap, and a session-id allocator — factored
+  into :class:`flink_tpu.windowing.session_meta.SessionIntervalSet`, shared
+  with the mesh-sharded engine.
 - **Device**: one accumulator slot per live session. Batch-local
   sessionization is vectorized (lexsort + gap scan); record values scatter
   straight into their final session slot; merging two sessions is a batched
@@ -21,9 +23,8 @@ end = last_event_ts + gap. Extensions/merges invalidate heap entries lazily
 
 from __future__ import annotations
 
-import heapq
 from functools import partial
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List
 
 import jax
 import numpy as np
@@ -32,9 +33,12 @@ from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
 from flink_tpu.ops.segment_ops import SCATTER_METHOD, pad_bucket_size, pad_i32
 from flink_tpu.state.slot_table import SlotTable
 from flink_tpu.windowing.aggregates import AggregateFunction, _JIT_CACHE
+from flink_tpu.windowing.session_meta import (
+    _NEG_INF,
+    MergeGroup,
+    SessionIntervalSet,
+)
 from flink_tpu.windowing.windower import WINDOW_END_FIELD, WINDOW_START_FIELD
-
-_NEG_INF = -(1 << 62)
 
 
 def _merge_jit(agg: AggregateFunction):
@@ -82,19 +86,19 @@ class SessionWindower:
         self.table = SlotTable(agg, capacity=capacity,
                                max_parallelism=max_parallelism,
                                **(spill or {}))
-        # key -> list of (start, end, sid), sorted by start; usually length 1
-        self.sessions: Dict[int, List[Tuple[int, int, int]]] = {}
-        self._next_sid = 1
-        self._fire_heap: List[Tuple[int, int, int]] = []  # (end, key, sid)
-        self.max_fired_watermark = _NEG_INF
-        self.late_records_dropped = 0
-        # pending accumulator merges (dst, src) + absorbed session ids whose
-        # host slots must stay allocated until the merge kernel has run
-        self._merge_dst: List[int] = []
-        self._merge_src: List[int] = []
-        self._merge_dst_set: set = set()
-        self._merge_src_set: set = set()
-        self._absorbed_sids: List[int] = []
+        self.meta = SessionIntervalSet(self.gap, self.allowed_lateness)
+
+    @property
+    def late_records_dropped(self) -> int:
+        return self.meta.late_records_dropped
+
+    @property
+    def max_fired_watermark(self) -> int:
+        return self.meta.max_fired_watermark
+
+    @property
+    def sessions(self):
+        return self.meta.sessions
 
     # ---------------------------------------------------------------- ingest
 
@@ -105,73 +109,37 @@ class SessionWindower:
         ts = np.asarray(batch.timestamps, dtype=np.int64)
         keys = np.asarray(batch.key_ids, dtype=np.int64)
 
-        # NOTE: lateness is decided per *merged session*, not per record —
-        # an out-of-order record that merges into a live session is never
-        # late (reference: WindowOperator merges first, then isWindowLate).
-        # _merge_session returns sid -1 for sessions that are stale on
-        # arrival; their records route to the identity slot 0.
-
-        # vectorized batch-local sessionization: sort by (key, ts); a new
-        # local session starts at a key change or a gap exceedance
-        order = np.lexsort((ts, keys))
-        ks, tss = keys[order], ts[order]
-        new_sess = np.empty(n, dtype=bool)
-        new_sess[0] = True
-        new_sess[1:] = (ks[1:] != ks[:-1]) | (tss[1:] - tss[:-1] > self.gap)
-        sess_of_sorted = np.cumsum(new_sess) - 1
-        starts_pos = np.nonzero(new_sess)[0]
-        m = len(starts_pos)
-        ends_pos = np.empty(m, dtype=np.int64)
-        ends_pos[:-1] = starts_pos[1:] - 1
-        ends_pos[-1] = n - 1
-        sess_key = ks[starts_pos]
-        sess_min = tss[starts_pos]
-        sess_max = tss[ends_pos]
-
-        # merge each batch-local session into the persistent interval set
-        # (pure metadata — slot lookups are batched below)
-        sess_sid = np.empty(m, dtype=np.int64)
-        for j in range(m):
-            sess_sid[j] = self._merge_session(
-                int(sess_key[j]), int(sess_min[j]),
-                int(sess_max[j]) + self.gap)
+        sess_key, sess_sid, rec_to_sess, order, groups = \
+            self.meta.absorb_batch(keys, ts)
+        for g in groups:
+            self._run_merge_group(g)
 
         live_sess = sess_sid >= 0
         if not live_sess.all():
             # stale-on-arrival sessions: route their records to slot 0
+            starts_pos = np.nonzero(
+                np.diff(rec_to_sess, prepend=-1) > 0)[0]
             sess_counts = np.diff(np.append(starts_pos, n))
-            self.late_records_dropped += int(
+            self.meta.late_records_dropped += int(
                 sess_counts[~live_sess].sum())
         # ONE vectorized lookup for all session slots, then scatter records
+        m = len(sess_key)
         slot_of_sess = np.zeros(m, dtype=np.int32)
         if live_sess.any():
             slot_of_sess[live_sess] = self.table.lookup_or_insert(
                 sess_key[live_sess], sess_sid[live_sess])
         rec_slots = np.empty(n, dtype=np.int32)
-        rec_slots[order] = slot_of_sess[sess_of_sorted]
+        rec_slots[order] = slot_of_sess[rec_to_sess]
         self.table.scatter(rec_slots, self.agg.map_input(batch))
-        self._flush_merges()
 
-    def _add_merge(self, key: int, dst_sid: int, src_sid: int) -> None:
-        """Queue an accumulator merge by session id. A chain (src was an
-        earlier dst, or dst was an earlier src) would make the single
-        gather/scatter kernel read stale values, so flush the pending batch
-        first."""
-        if (src_sid in self._merge_dst_set or src_sid in self._merge_src_set
-                or dst_sid in self._merge_src_set):
-            self._flush_merges()
-        self._merge_dst.append((key, dst_sid))
-        self._merge_src.append((key, src_sid))
-        self._merge_dst_set.add(dst_sid)
-        self._merge_src_set.add(src_sid)
-
-    def _flush_merges(self) -> None:
-        if not self._merge_dst:
-            return
-        dk = np.asarray([p[0] for p in self._merge_dst], dtype=np.int64)
-        ds = np.asarray([p[1] for p in self._merge_dst], dtype=np.int64)
-        sk = np.asarray([p[0] for p in self._merge_src], dtype=np.int64)
-        ss = np.asarray([p[1] for p in self._merge_src], dtype=np.int64)
+    def _run_merge_group(self, g: MergeGroup) -> None:
+        """Resolve a chain-free merge group's slots and move accumulators
+        in one kernel, then free the absorbed host slots (their device
+        slots were reset by the kernel)."""
+        dk = np.asarray(g.keys_dst, dtype=np.int64)
+        ds = np.asarray(g.sids_dst, dtype=np.int64)
+        sk = np.asarray(g.keys_src, dtype=np.int64)
+        ss = np.asarray(g.sids_src, dtype=np.int64)
         # ONE combined lookup: with a spill tier, a second lookup could
         # evict slots the first just resolved — dst and src must be
         # resident simultaneously for the merge kernel
@@ -188,93 +156,13 @@ class SessionWindower:
             pad_i32(src_slots, size, fill=0))
         # absorbed host slots are only reusable once their values have moved
         # (free_index_only: the merge kernel already reset the device slots)
-        if self._absorbed_sids:
-            self.table.free_index_only(self._absorbed_sids)
-            self._absorbed_sids = []
-        self._merge_dst, self._merge_src = [], []
-        self._merge_dst_set, self._merge_src_set = set(), set()
-
-    def _merge_session(self, key: int, start: int, end: int) -> int:
-        """Merge [start, end) into key's intervals; returns the session id,
-        or -1 if the session is stale on arrival (no live session to merge
-        into and its own end is already past the lateness allowance).
-
-        Mirrors MergingWindowSet.addWindow: overlapping intervals collapse
-        into one; absorbed sessions queue an accumulator merge (dst, src).
-        Pure host metadata — device slot lookups are batched by the caller.
-        """
-        intervals = self.sessions.get(key)
-        if intervals is None:
-            if self._stale(end):
-                return -1
-            sid = self._alloc_sid()
-            self.sessions[key] = [(start, end, sid)]
-            heapq.heappush(self._fire_heap, (end, key, sid))
-            return sid
-
-        overlapping = [iv for iv in intervals
-                       if iv[0] <= end and start <= iv[1]]
-        if not overlapping:
-            if self._stale(end):
-                return -1
-            sid = self._alloc_sid()
-            intervals.append((start, end, sid))
-            intervals.sort()
-            heapq.heappush(self._fire_heap, (end, key, sid))
-            return sid
-
-        # absorb into the first overlapping interval's session
-        keep = overlapping[0]
-        new_start = min(start, keep[0])
-        new_end = max(end, keep[1])
-        for iv in overlapping[1:]:
-            new_start = min(new_start, iv[0])
-            new_end = max(new_end, iv[1])
-            self._add_merge(key, keep[2], iv[2])
-            self._absorbed_sids.append(iv[2])
-        remaining = [iv for iv in intervals if iv not in overlapping]
-        merged = (new_start, new_end, keep[2])
-        remaining.append(merged)
-        remaining.sort()
-        self.sessions[key] = remaining
-        if new_end != keep[1]:
-            heapq.heappush(self._fire_heap, (new_end, key, keep[2]))
-        return keep[2]
-
-    def _stale(self, end: int) -> bool:
-        """A (merged) session ending at ``end`` is stale iff the watermark
-        has already passed end - 1 + lateness."""
-        return (self.max_fired_watermark > _NEG_INF // 2
-                and end - 1 + self.allowed_lateness <= self.max_fired_watermark)
-
-    def _alloc_sid(self) -> int:
-        sid = self._next_sid
-        self._next_sid += 1
-        return sid
+        self.table.free_index_only(g.absorbed_sids)
 
     # ------------------------------------------------------------------ fire
 
     def on_watermark(self, watermark: int) -> List[RecordBatch]:
-        fired_keys: List[int] = []
-        fired_starts: List[int] = []
-        fired_ends: List[int] = []
-        fired_sids: List[int] = []
-        while self._fire_heap and self._fire_heap[0][0] - 1 <= watermark:
-            end, key, sid = heapq.heappop(self._fire_heap)
-            intervals = self.sessions.get(key)
-            if not intervals:
-                continue
-            cur = next((iv for iv in intervals if iv[2] == sid), None)
-            if cur is None or cur[1] != end:
-                continue  # stale entry (merged or extended)
-            fired_keys.append(key)
-            fired_starts.append(cur[0])
-            fired_ends.append(end)
-            fired_sids.append(sid)
-            intervals.remove(cur)
-            if not intervals:
-                del self.sessions[key]
-        self.max_fired_watermark = max(self.max_fired_watermark, watermark)
+        fired_keys, fired_starts, fired_ends, fired_sids = \
+            self.meta.pop_fired(watermark)
         if not fired_keys:
             return []
         total = len(fired_keys)
@@ -293,7 +181,6 @@ class SessionWindower:
             matrix = np.asarray(fired_slots, dtype=np.int32)[:, None]
             results = self.table.fire(matrix)
             self.table.free_namespaces(fired_sids[a:b])
-            m = b - a
             cols = {
                 KEY_ID_FIELD: np.asarray(fired_keys[a:b], dtype=np.int64),
                 WINDOW_START_FIELD: np.asarray(fired_starts[a:b],
@@ -310,34 +197,14 @@ class SessionWindower:
     # -------------------------------------------------------------- snapshot
 
     def snapshot(self, mode: str = "full") -> Dict[str, object]:
-        self._flush_merges()  # pending accumulator moves must be material
         if mode == "delta":
             table = self.table.snapshot_delta()
         else:
             table = self.table.snapshot(reset_dirty=(mode != "savepoint"))
-        return {
-            "table": table,
-            "sessions": {k: list(v) for k, v in self.sessions.items()},
-            "next_sid": self._next_sid,
-            "max_fired_watermark": self.max_fired_watermark,
-        }
+        return {"table": table, **self.meta.snapshot()}
 
     def restore(self, snap: Dict[str, object], key_group_filter=None) -> None:
         if "table" in snap:
             self.table.restore(snap["table"], key_group_filter=key_group_filter)
-        self.sessions = {}
-        self._fire_heap = []
-        for k, ivs in snap.get("sessions", {}).items():
-            kept = [tuple(iv) for iv in ivs]
-            if key_group_filter is not None:
-                from flink_tpu.state.keygroups import assign_key_groups
-
-                g = int(assign_key_groups(np.array([k]),
-                                          self.table.max_parallelism)[0])
-                if g not in key_group_filter:
-                    continue
-            self.sessions[int(k)] = kept
-            for start, end, sid in kept:
-                heapq.heappush(self._fire_heap, (end, int(k), sid))
-        self._next_sid = snap.get("next_sid", 1)
-        self.max_fired_watermark = snap.get("max_fired_watermark", _NEG_INF)
+        self.meta.restore(snap, key_group_filter=key_group_filter,
+                          max_parallelism=self.table.max_parallelism)

@@ -1,0 +1,244 @@
+"""Mesh-sharded session windows (MeshSessionEngine) vs the single-device
+engine and the brute-force oracle, including cross-engine snapshot restore
+and the public-API path (parallelism=N session job through env.execute) —
+the BASELINE.json session-clickstream config, scaled to CI."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.records import KEY_ID_FIELD, RecordBatch
+from flink_tpu.windowing.aggregates import CountAggregate, SumAggregate
+from flink_tpu.windowing.sessions import SessionWindower
+
+from tests.test_sessions import fired_to_dict, keyed_batch, oracle_sessions
+
+
+def _random_events(n=6000, keys=300, seed=11, spread=40_000, gap=100,
+                   skew=2000):
+    """Events in roughly time order with bounded out-of-orderness
+    (arrival ts jitters by <= skew), so a lagging watermark never drops
+    records — required for oracle equality under mid-stream fires."""
+    rng = np.random.default_rng(seed)
+    ks = rng.integers(0, keys, n)
+    base = np.sort(rng.integers(0, spread, n))
+    ts = np.maximum(base - rng.integers(0, skew, n), 0)
+    vs = rng.random(n).astype(np.float32) * 5
+    return list(zip(ks.tolist(), vs.tolist(), ts.tolist()))
+
+
+def _drive(engine, events, batch_size=500, wm_every=4):
+    """Feed events in arrival order with periodic watermarks (max seen ts
+    lags by one batch to allow out-of-orderness), then final flush."""
+    fired = []
+    max_ts = 0
+    for i in range(0, len(events), batch_size):
+        chunk = events[i:i + batch_size]
+        ks = [e[0] for e in chunk]
+        vs = [e[1] for e in chunk]
+        ts = [e[2] for e in chunk]
+        engine.process_batch(keyed_batch(ks, vs, ts))
+        max_ts = max([max_ts] + ts)
+        if (i // batch_size) % wm_every == wm_every - 1:
+            fired.extend(engine.on_watermark(max_ts - 5000))
+    fired.extend(engine.on_watermark(1 << 60))
+    return fired
+
+
+class TestMeshSessionEngine:
+    def test_matches_oracle_and_single_device(self, eight_device_mesh):
+        from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+
+        gap = 100
+        events = _random_events(gap=gap)
+        mesh_eng = MeshSessionEngine(gap, SumAggregate("v"),
+                                     eight_device_mesh,
+                                     capacity_per_shard=4096)
+        single = SessionWindower(gap, SumAggregate("v"), capacity=16384)
+        fired_mesh = fired_to_dict(_drive(mesh_eng, events))
+        fired_single = fired_to_dict(_drive(single, events))
+        oracle = oracle_sessions(events, gap)
+        assert set(fired_mesh) == set(oracle)
+        for k, want in oracle.items():
+            assert fired_mesh[k] == pytest.approx(want, rel=1e-4), k
+        assert fired_mesh.keys() == fired_single.keys()
+
+    def test_cross_batch_merge_on_mesh(self, eight_device_mesh):
+        from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+
+        eng = MeshSessionEngine(100, SumAggregate("v"), eight_device_mesh,
+                                capacity_per_shard=1024)
+        # two separated sessions for one key, then a bridge record merges
+        # them (cross-batch, exercises the sharded merge kernel)
+        eng.process_batch(keyed_batch([7, 7], [1.0, 2.0], [0, 180]))
+        eng.process_batch(keyed_batch([7], [4.0], [90]))
+        fired = fired_to_dict(eng.on_watermark(1 << 60))
+        assert fired == {(7, 0, 280): pytest.approx(7.0)}
+
+    def test_high_cardinality_many_shards(self, eight_device_mesh):
+        from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+
+        # BASELINE.json row 5 scaled down: many distinct keys, one session
+        # each (high-cardinality keyed state)
+        n = 20_000
+        eng = MeshSessionEngine(50, CountAggregate(), eight_device_mesh,
+                                capacity_per_shard=8192)
+        ks = np.arange(n, dtype=np.int64)
+        ts = np.zeros(n, dtype=np.int64)
+        eng.process_batch(RecordBatch.from_pydict(
+            {KEY_ID_FIELD: ks, "v": np.ones(n, dtype=np.float32)},
+            timestamps=ts))
+        fired = _sum_counts(eng.on_watermark(1 << 60))
+        assert fired == n
+
+    def test_snapshot_restore_mesh_to_mesh(self, eight_device_mesh):
+        from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+
+        gap = 100
+        events = _random_events(n=2000, keys=100)
+        cut = len(events) // 2
+        a = MeshSessionEngine(gap, SumAggregate("v"), eight_device_mesh,
+                              capacity_per_shard=4096)
+        for i in range(0, cut, 400):
+            chunk = events[i:min(i + 400, cut)]
+            a.process_batch(keyed_batch([e[0] for e in chunk],
+                                        [e[1] for e in chunk],
+                                        [e[2] for e in chunk]))
+        snap = a.snapshot()
+        b = MeshSessionEngine(gap, SumAggregate("v"), eight_device_mesh,
+                              capacity_per_shard=4096)
+        b.restore(snap)
+        for i in range(cut, len(events), 400):
+            chunk = events[i:i + 400]
+            b.process_batch(keyed_batch([e[0] for e in chunk],
+                                        [e[1] for e in chunk],
+                                        [e[2] for e in chunk]))
+        fired = fired_to_dict(b.on_watermark(1 << 60))
+        oracle = oracle_sessions(events, gap)
+        assert fired.keys() == oracle.keys()
+        for k, want in oracle.items():
+            assert fired[k] == pytest.approx(want, rel=1e-4), k
+
+    def test_snapshot_restore_cross_engine(self, eight_device_mesh):
+        """single-device snapshot -> mesh engine and back."""
+        from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+
+        gap = 100
+        events = _random_events(n=1500, keys=80)
+        cut = len(events) // 2
+        single = SessionWindower(gap, SumAggregate("v"), capacity=8192)
+        for i in range(0, cut, 300):
+            chunk = events[i:min(i + 300, cut)]
+            single.process_batch(keyed_batch([e[0] for e in chunk],
+                                             [e[1] for e in chunk],
+                                             [e[2] for e in chunk]))
+        snap = single.snapshot()
+        mesh_eng = MeshSessionEngine(gap, SumAggregate("v"),
+                                     eight_device_mesh,
+                                     capacity_per_shard=4096)
+        mesh_eng.restore(snap)
+        for i in range(cut, len(events), 300):
+            chunk = events[i:i + 300]
+            mesh_eng.process_batch(keyed_batch([e[0] for e in chunk],
+                                               [e[1] for e in chunk],
+                                               [e[2] for e in chunk]))
+        # mesh -> single again before the flush
+        snap2 = mesh_eng.snapshot()
+        single2 = SessionWindower(gap, SumAggregate("v"), capacity=8192)
+        single2.restore(snap2)
+        fired = fired_to_dict(single2.on_watermark(1 << 60))
+        oracle = oracle_sessions(events, gap)
+        assert fired.keys() == oracle.keys()
+        for k, want in oracle.items():
+            assert fired[k] == pytest.approx(want, rel=1e-4), k
+
+    def test_delta_snapshot_tombstones_absorbed_sessions(
+            self, eight_device_mesh):
+        """A session absorbed by a merge AFTER the delta base must ship a
+        freed-namespace tombstone, or restore resurrects an orphan row."""
+        from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+
+        eng = MeshSessionEngine(100, SumAggregate("v"), eight_device_mesh,
+                                capacity_per_shard=1024)
+        eng.process_batch(keyed_batch([7, 7], [1.0, 2.0], [0, 180]))
+        eng.snapshot()  # full base
+        eng.process_batch(keyed_batch([7], [4.0], [90]))  # bridge merges
+        delta = eng.snapshot(mode="delta")
+        freed = set(np.asarray(delta["table"]["freed_namespaces"]).tolist())
+        live_sids = {iv[2] for iv in eng.meta.sessions[7]}
+        assert len(live_sids) == 1
+        # exactly the absorbed sid is tombstoned
+        assert freed, "absorbed session must leave a tombstone"
+        assert freed.isdisjoint(live_sids)
+
+    def test_query_sessions(self, eight_device_mesh):
+        from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+
+        eng = MeshSessionEngine(100, SumAggregate("v"), eight_device_mesh,
+                                capacity_per_shard=1024)
+        eng.process_batch(keyed_batch([3, 3, 9], [1.0, 2.0, 5.0],
+                                      [0, 50, 1000]))
+        got = eng.query_sessions(3)
+        assert got == {150: {"sum_v": pytest.approx(3.0)}}
+        assert eng.query_sessions(9) == {1100: {"sum_v": pytest.approx(5.0)}}
+        assert eng.query_sessions(12345) == {}
+
+
+def _sum_counts(batches):
+    return int(sum(b["count"].sum() for b in batches))
+
+
+class TestMeshSessionPublicApi:
+    def test_session_job_parallelism_8(self):
+        """BASELINE session-clickstream config (scaled): session windows at
+        parallelism=8 through env.execute, vs the oracle."""
+        from flink_tpu import Configuration, StreamExecutionEnvironment
+        from flink_tpu.connectors.sinks import CollectSink
+        from flink_tpu.connectors.sources import Source
+        from flink_tpu.runtime.watermarks import WatermarkStrategy
+        from flink_tpu.windowing.assigners import EventTimeSessionWindows
+
+        gap = 100
+        events = _random_events(n=5000, keys=200, spread=20_000, gap=gap)
+
+        class ListSource(Source):
+            def __init__(self, rows):
+                self.rows = rows
+                self.pos = 0
+
+            def poll_batch(self, max_records):
+                if self.pos >= len(self.rows):
+                    return None
+                chunk = self.rows[self.pos:self.pos + max_records]
+                self.pos += len(chunk)
+                return RecordBatch.from_pydict(
+                    {"user": np.asarray([e[0] for e in chunk],
+                                        dtype=np.int64),
+                     "v": np.asarray([e[1] for e in chunk],
+                                     dtype=np.float32)},
+                    timestamps=[e[2] for e in chunk])
+
+            def snapshot_position(self):
+                return self.pos
+
+            def restore_position(self, pos):
+                self.pos = pos
+
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 512,
+            "parallelism.default": 8,
+            "state.slot-table.capacity": 4096,
+        }))
+        sink = CollectSink()
+        env.add_source(ListSource(events),
+                       WatermarkStrategy.for_bounded_out_of_orderness(5000)) \
+            .key_by("user") \
+            .window(EventTimeSessionWindows.with_gap(gap)) \
+            .sum("v").sink_to(sink)
+        env.execute("mesh-sessions")
+        got = {(r["user"], r["window_start"], r["window_end"]):
+               r["sum_v"] for r in sink.rows()}
+        oracle = {k: v for k, v in
+                  oracle_sessions(events, gap).items()}
+        assert got.keys() == oracle.keys()
+        for k, want in oracle.items():
+            assert got[k] == pytest.approx(want, rel=1e-4), k

@@ -57,15 +57,13 @@ wp = 1 << 17
 padded = np.zeros((wp, K_SLICES), dtype=np.int32)
 padded[: len(kz)] = matrix
 jm = jnp.asarray(padded)
-jk = jnp.asarray(np.resize(kz, wp))
-jv = jnp.asarray(np.arange(wp) < len(kz))
 fp = agg._fire_project_jit(proj)
 ff = agg._fire_jit
 
 timeit("kernel fire only", lambda: jax.block_until_ready(
     ff(table.accs, jm)))
 timeit("kernel fire_proj only", lambda: jax.block_until_ready(
-    fp(table.accs, jm, jk, jv)))
+    fp(table.accs, jm, len(kz))))
 
 # top_k alone
 x = jnp.asarray(rng.random(wp).astype(np.float32))
