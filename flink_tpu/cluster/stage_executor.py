@@ -1,0 +1,906 @@
+"""Stage-parallel execution: ExecutionGraph-style subtask expansion.
+
+reference: the reference expands every JobVertex into `parallelism`
+ExecutionVertex subtasks (executiongraph/DefaultExecutionGraph.java,
+Execution.java:572 deploy()), routes records between them by key group
+(streaming/runtime/partitioner/KeyGroupStreamPartitioner.java:55), and
+aligns checkpoint barriers across input channels
+(streaming/runtime/io/checkpointing/SingleCheckpointBarrierHandler.java).
+
+Re-design: the job splits into two pipelined stages —
+
+  source stage (S subtasks): source + chained stateless operators;
+    each output batch is partitioned by key group into one sub-batch per
+    keyed subtask and emitted through the Shuffle SPI
+    (flink_tpu/runtime/shuffle_spi.py — pluggable transport, credit-based
+    flow control).
+  keyed stage (N subtasks): the keyed operator chain + sink; each subtask
+    owns a key-group range and runs its own single-device engine instance.
+    Watermarks combine per-channel (min across channels, the
+    StatusWatermarkValve role); checkpoint Barriers ALIGN: channels that
+    delivered the barrier are buffered until all channels have, then the
+    subtask snapshots and acks (exactly the reference's aligned barrier
+    dance — the in-flight buffer is bounded by the channel credit).
+
+Checkpoints: a coordinator (the run() thread) triggers sources, collects
+S + N acks, MERGES the per-subtask operator states into the same logical
+format the single-slot executor writes (key-group-indexed rows), and
+commits the manifest — so multi-slot checkpoints restore into single-slot
+jobs, other subtask counts (key-group re-filtering), and vice versa.
+
+This axis is COMPLEMENTARY to mesh parallelism: a keyed subtask could open
+its operator over a device mesh; subtask expansion distributes across
+slots/hosts (the reference's distribution model), the mesh distributes
+across chips within one program (the SPMD model).
+"""
+
+from __future__ import annotations
+
+import queue as _q
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flink_tpu.core.config import (
+    BatchOptions,
+    CheckpointOptions,
+    Configuration,
+    CoreOptions,
+    DeploymentOptions,
+    StateOptions,
+)
+from flink_tpu.core.records import RecordBatch
+from flink_tpu.graph.transformations import StreamGraph, Transformation
+from flink_tpu.runtime.operators import OperatorContext
+from flink_tpu.runtime.shuffle_spi import (
+    END_OF_PARTITION,
+    Barrier,
+    LocalShuffleService,
+    create_shuffle_service,
+)
+from flink_tpu.runtime.elements import MAX_WATERMARK
+from flink_tpu.state.keygroups import (
+    assign_key_groups,
+    compute_key_group_range,
+    key_group_to_operator_index,
+)
+
+__all__ = ["StagePlan", "StagePlanError", "StageParallelExecutor",
+           "plan_stages", "merge_subtask_states"]
+
+
+class StagePlanError(ValueError):
+    """The graph shape is not supported by stage-parallel execution."""
+
+
+class StagePlan:
+    def __init__(self, source: Transformation,
+                 pre_chain: List[Transformation],
+                 keyed_chain: List[Transformation],
+                 key_field: str):
+        self.source = source
+        #: stateless operators chained into the source stage (upstream of
+        #: the keyed exchange)
+        self.pre_chain = pre_chain
+        #: keyed operator + everything downstream incl. the sink, chained
+        #: into each keyed subtask
+        self.keyed_chain = keyed_chain
+        self.key_field = key_field
+
+
+def plan_stages(graph: StreamGraph) -> StagePlan:
+    """Split a linear pipeline at its keyed exchange. Raises StagePlanError
+    for shapes the multi-slot mode doesn't cover yet (multiple sources,
+    joins, side outputs, multiple keyed exchanges) — callers fall back to
+    single-slot execution."""
+    if len(graph.sources) != 1:
+        raise StagePlanError("multi-slot mode requires exactly one source")
+    source = graph.sources[0]
+    pre_chain: List[Transformation] = []
+    keyed_chain: List[Transformation] = []
+    key_field: Optional[str] = None
+    cur = source
+    seen_keyed = False
+    while True:
+        children = graph.children(cur)
+        if not children:
+            break
+        if len(children) != 1:
+            raise StagePlanError(
+                f"multi-slot mode requires a linear pipeline; {cur.name} "
+                f"has {len(children)} consumers")
+        child = children[0]
+        if len(child.inputs) != 1:
+            raise StagePlanError(
+                f"{child.name} has multiple inputs (join/union) — not "
+                "supported in multi-slot mode yet")
+        if child.side_tag is not None or child.broadcast:
+            raise StagePlanError("side outputs / broadcast edges are not "
+                                 "supported in multi-slot mode yet")
+        if child.keyed and not seen_keyed:
+            seen_keyed = True
+            key_field = child.key_field
+        elif child.keyed and seen_keyed and child.key_field != key_field:
+            raise StagePlanError("multiple keyed exchanges are not "
+                                 "supported in multi-slot mode yet")
+        (keyed_chain if seen_keyed else pre_chain).append(child)
+        cur = child
+    if not seen_keyed:
+        raise StagePlanError("no keyed exchange — nothing to expand")
+    if keyed_chain[-1].kind != "sink":
+        raise StagePlanError("pipeline must end in a sink")
+    return StagePlan(source, pre_chain, keyed_chain, key_field)
+
+
+# ---------------------------------------------------------------------------
+# state merge (per-subtask -> logical single-slot format)
+# ---------------------------------------------------------------------------
+
+
+def _merge_changelog(values: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """GroupAgg changelog rows: concatenate, with per-subtask 'last' column
+    sets unioned — a subtask that has not emitted yet has no last-image
+    columns, and its rows (all emitted=False) get identity fill."""
+    kid = [np.asarray(v["key_id"]) for v in values]
+    cols = set()
+    for v in values:
+        cols.update(v.get("last", {}).keys())
+    last: Dict[str, np.ndarray] = {}
+    for c in sorted(cols):
+        dt = next(np.asarray(v["last"][c]).dtype for v in values
+                  if c in v.get("last", {}))
+        last[c] = np.concatenate([
+            np.asarray(v["last"][c]) if c in v.get("last", {})
+            else np.zeros(len(k), dtype=dt)
+            for v, k in zip(values, kid)])
+    return {
+        "key_id": np.concatenate(kid),
+        "count": np.concatenate([np.asarray(v["count"]) for v in values]),
+        "emitted": np.concatenate([np.asarray(v["emitted"])
+                                   for v in values]),
+        "last": last,
+    }
+
+
+def _merge_values(key: str, values: List[Any]):
+    """Merge one state field across subtasks by its semantic kind."""
+    if key in ("watermark", "max_fired_end", "max_ts", "next_sid",
+               "max_fired_watermark"):
+        return max(values)
+    if key == "late_records_dropped":
+        return sum(values)
+    if key == "keys_hashed":
+        return any(values)
+    if key == "pending":
+        return sorted({x for v in values for x in v})
+    if key in ("slice_last_window", "sessions", "key_values"):
+        merged: Dict = {}
+        for v in values:
+            merged.update(v)
+        return merged
+    if key == "changelog":
+        return _merge_changelog(values)
+    if isinstance(values[0], np.ndarray):
+        return np.concatenate([np.asarray(v) for v in values])
+    if isinstance(values[0], dict):
+        # dict-of-arrays (table leaves) / nested metadata: merge per field
+        return {sub: _merge_values(sub, [v[sub] for v in values])
+                for sub in values[0]}
+    # scalars expected identical (e.g. format flags)
+    return values[0]
+
+
+def merge_subtask_states(states: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Union the per-subtask snapshots of ONE operator into the logical
+    single-slot format. Table rows (key-group disjoint across subtasks)
+    concatenate; metadata merges by kind (max watermarks, union dicts)."""
+    states = [s for s in states if s]
+    if not states:
+        return {}
+    if len(states) == 1:
+        return states[0]
+    return {k: _merge_values(k, [s[k] for s in states])
+            for k in states[0]}
+
+
+# ---------------------------------------------------------------------------
+# subtasks
+# ---------------------------------------------------------------------------
+
+
+class _SubtaskFailure(Exception):
+    pass
+
+
+class _OperatorChain:
+    """The fused operator chain of one subtask (reference: OperatorChain —
+    direct method-call hand-off between chained operators)."""
+
+    def __init__(self, transformations: Sequence[Transformation],
+                 ctx: OperatorContext):
+        self.transformations = list(transformations)
+        self.operators = []
+        for t in self.transformations:
+            op = t.operator_factory() if t.operator_factory else None
+            if op is not None:
+                op.open(ctx)
+            self.operators.append(op)
+
+    def process_batch(self, batch: RecordBatch) -> List[RecordBatch]:
+        outs = [batch]
+        for op in self.operators:
+            if op is None:
+                continue
+            nxt: List[RecordBatch] = []
+            for b in outs:
+                nxt.extend(op.process_batch(b))
+            outs = nxt
+            if not outs:
+                break
+        return outs
+
+    def process_watermark(self, wm: int) -> None:
+        carried: List[RecordBatch] = []
+        for op in self.operators:
+            if op is None:
+                continue
+            for b in carried:
+                op.process_batch(b)
+            carried = op.process_watermark(wm)
+        # trailing emissions past the last operator are dropped only if the
+        # last op emitted (sinks emit nothing)
+
+    def close(self) -> None:
+        carried: List[RecordBatch] = []
+        for op in self.operators:
+            if op is None:
+                continue
+            for b in carried:
+                op.process_batch(b)
+            carried = op.close()
+
+    def dispose(self) -> None:
+        for op in self.operators:
+            if op is not None:
+                try:
+                    op.dispose()
+                except Exception:
+                    pass
+
+    def snapshot(self, graph: StreamGraph, savepoint: bool = False
+                 ) -> Dict[str, Any]:
+        snap = {}
+        for t, op in zip(self.transformations, self.operators):
+            if op is None:
+                continue
+            if savepoint and hasattr(op, "snapshot_state_savepoint"):
+                state = op.snapshot_state_savepoint()
+            else:
+                state = op.snapshot_state()
+            if state:
+                snap[graph.stable_id(t)] = state
+        return snap
+
+    def restore(self, graph: StreamGraph, states: Dict[str, Any],
+                key_group_filter=None) -> None:
+        for t, op in zip(self.transformations, self.operators):
+            if op is None:
+                continue
+            state = states.get(graph.stable_id(t))
+            if state is None:
+                continue
+            if key_group_filter is None:
+                op.restore_state(state)
+                continue
+            import inspect
+
+            sig = inspect.signature(op.restore_state)
+            if "key_group_filter" not in sig.parameters:
+                # restoring the FULL merged state into every subtask would
+                # silently duplicate keyed state (N× timer fires, N×
+                # emissions) — fail precisely instead
+                raise RuntimeError(
+                    f"operator {t.name!r} ({type(op).__name__}) does not "
+                    "support key-group-filtered restore; it cannot be "
+                    "restored in stage-parallel mode (reference: keyed "
+                    "state restore is key-group-range scoped)")
+            op.restore_state(state, key_group_filter=key_group_filter)
+
+
+class _SourceSubtask(threading.Thread):
+    """One source-stage subtask: polls its source split, applies the
+    pre-chain, partitions by key group, emits through the shuffle."""
+
+    def __init__(self, index: int, parallelism: int, plan: StagePlan,
+                 graph: StreamGraph, writer, num_keyed: int,
+                 max_parallelism: int, batch_size: int,
+                 coordinator: "_Coordinator", source,
+                 restore_position=None):
+        super().__init__(name=f"source-subtask-{index}", daemon=True)
+        self.index = index
+        self.parallelism = parallelism
+        self.plan = plan
+        self.graph = graph
+        self.writer = writer
+        self.num_keyed = num_keyed
+        self.max_parallelism = max_parallelism
+        self.batch_size = batch_size
+        self.coordinator = coordinator
+        self.source = source
+        self.restore_position = restore_position
+        self.control: _q.Queue = _q.Queue()
+        self.error: Optional[BaseException] = None
+        self.wm_gen = plan.source.watermark_strategy.create()
+        self.chain: Optional[_OperatorChain] = None
+        self.records_out = 0
+        self.batches_polled = 0
+
+    def run(self) -> None:
+        try:
+            self._run()
+        except BaseException as e:  # noqa: BLE001
+            self.error = e
+            self.coordinator.subtask_failed(self, e)
+
+    def _run(self) -> None:
+        plan = self.plan
+        ctx = OperatorContext(operator_index=self.index,
+                              parallelism=1,
+                              max_parallelism=self.max_parallelism)
+        self.chain = _OperatorChain(plan.pre_chain, ctx)
+        self.source.open(self.index, self.parallelism)
+        if self.restore_position is not None:
+            self.source.restore_position(self.restore_position)
+        key_field = plan.key_field
+        stopping = False
+        try:
+            while not stopping:
+                stopping = self._serve_control()
+                if stopping:
+                    break
+                if self.coordinator.cancelled.is_set():
+                    return
+                batch = self.source.poll_batch(self.batch_size)
+                if batch is None:
+                    break
+                if len(batch) == 0:
+                    continue
+                self.batches_polled += 1
+                batch = plan.source.watermark_strategy.assign_timestamps(
+                    batch)
+                wm = self.wm_gen.on_batch(batch)
+                for out in self.chain.process_batch(batch):
+                    self._emit_partitioned(out, key_field)
+                if wm is not None:
+                    self.writer.broadcast_event(int(wm))
+        finally:
+            self.source.close()
+        self.writer.broadcast_event(MAX_WATERMARK)
+        self.writer.close()
+
+    def _emit_partitioned(self, batch: RecordBatch, key_field: str) -> None:
+        from flink_tpu.state.keygroups import hash_keys_to_i64
+
+        if key_field not in batch.columns:
+            raise _SubtaskFailure(
+                f"key field {key_field!r} missing from batch columns "
+                f"{batch.names()}")
+        keys = batch[key_field]
+        key_ids = hash_keys_to_i64(keys)
+        batch = batch.with_column("__key_id__", key_ids)
+        groups = assign_key_groups(key_ids, self.max_parallelism)
+        targets = key_group_to_operator_index(
+            groups, self.max_parallelism, self.num_keyed)
+        for sub in range(self.num_keyed):
+            mask = targets == sub
+            if mask.any():
+                self.writer.emit(sub, batch.filter(mask))
+                self.records_out += int(mask.sum())
+
+    def _serve_control(self) -> bool:
+        """Returns True when the job should stop (stop-with-savepoint)."""
+        stopping = False
+        while True:
+            try:
+                trigger = self.control.get_nowait()
+            except _q.Empty:
+                return stopping
+            barrier: Barrier = trigger
+            snap = {"position": self.source.snapshot_position(),
+                    "operators": self.chain.snapshot(
+                        self.graph, savepoint=barrier.savepoint is not None)}
+            self.coordinator.ack(barrier.checkpoint_id,
+                                 ("source", self.index), snap)
+            self.writer.broadcast_event(barrier)
+            if barrier.stop:
+                stopping = True
+
+
+class _KeyedSubtask(threading.Thread):
+    """One keyed-stage subtask: owns a key-group range, consumes its gate
+    with per-channel watermarking and aligned barriers."""
+
+    def __init__(self, index: int, parallelism: int, plan: StagePlan,
+                 graph: StreamGraph, gate, max_parallelism: int,
+                 coordinator: "_Coordinator", config: Configuration):
+        super().__init__(name=f"keyed-subtask-{index}", daemon=True)
+        self.index = index
+        self.parallelism = parallelism
+        self.plan = plan
+        self.graph = graph
+        self.gate = gate
+        self.max_parallelism = max_parallelism
+        self.coordinator = coordinator
+        self.config = config
+        rng = compute_key_group_range(max_parallelism, parallelism, index)
+        self.key_groups = range(rng.start, rng.end + 1)
+        self.control: _q.Queue = _q.Queue()
+        self.error: Optional[BaseException] = None
+        self.chain: Optional[_OperatorChain] = None
+        self.records_in = 0
+        self._restore_states: Optional[Dict[str, Any]] = None
+
+    def run(self) -> None:
+        try:
+            self._run()
+        except BaseException as e:  # noqa: BLE001
+            self.error = e
+            self.coordinator.subtask_failed(self, e)
+
+    def _run(self) -> None:
+        ctx = OperatorContext(operator_index=self.index, parallelism=1,
+                              max_parallelism=self.max_parallelism)
+        self.chain = _OperatorChain(self.plan.keyed_chain, ctx)
+        if self._restore_states is not None:
+            self.chain.restore(self.graph, self._restore_states,
+                               key_group_filter=set(self.key_groups))
+        n = self.gate.num_channels
+        chan_wm = [-(1 << 62)] * n
+        done = [False] * n
+        combined = -(1 << 62)
+        aligning: Optional[Barrier] = None
+        barriered = [False] * n
+        buffered: List[Tuple[int, Any]] = []
+        stopping = False
+
+        def process(item, ch: int):
+            nonlocal combined, stopping
+            if isinstance(item, RecordBatch):
+                self.records_in += len(item)
+                for out in self.chain.process_batch(item):
+                    pass  # sink is in-chain; trailing output dropped
+            elif isinstance(item, int):
+                chan_wm[ch] = max(chan_wm[ch], item)
+                new = min(
+                    (MAX_WATERMARK if done[c] else chan_wm[c])
+                    for c in range(n))
+                if new > combined:
+                    combined = new
+                    self.chain.process_watermark(combined)
+
+        while True:
+            self._serve_queries()
+            if self.coordinator.cancelled.is_set():
+                return
+            entry = self.gate.poll(timeout=0.05)
+            if entry is None:
+                continue
+            ch, item = entry
+            if isinstance(item, Barrier):
+                if aligning is None:
+                    aligning = item
+                    barriered = [False] * n
+                barriered[ch] = True
+                if all(barriered[c] or done[c] for c in range(n)):
+                    # all channels aligned: snapshot + ack, then drain the
+                    # buffered post-barrier items
+                    snap = {"operators": self.chain.snapshot(
+                        self.graph,
+                        savepoint=aligning.savepoint is not None)}
+                    self.coordinator.ack(aligning.checkpoint_id,
+                                         ("keyed", self.index), snap)
+                    if aligning.stop:
+                        stopping = True
+                    aligning = None
+                    for bch, bitem in buffered:
+                        process(bitem, bch)
+                    buffered = []
+                    if stopping:
+                        self.chain.close()
+                        return
+                continue
+            if item is END_OF_PARTITION:
+                done[ch] = True
+                if aligning is not None and all(
+                        barriered[c] or done[c] for c in range(n)):
+                    snap = {"operators": self.chain.snapshot(
+                        self.graph,
+                        savepoint=aligning.savepoint is not None)}
+                    self.coordinator.ack(aligning.checkpoint_id,
+                                         ("keyed", self.index), snap)
+                    aligning = None
+                    for bch, bitem in buffered:
+                        process(bitem, bch)
+                    buffered = []
+                if all(done):
+                    new = MAX_WATERMARK
+                    if new > combined:
+                        self.chain.process_watermark(new)
+                    self.chain.close()
+                    return
+                # a finished channel no longer constrains the watermark
+                new = min((MAX_WATERMARK if done[c] else chan_wm[c])
+                          for c in range(n))
+                if new > combined:
+                    combined = new
+                    self.chain.process_watermark(combined)
+                continue
+            if aligning is not None and barriered[ch]:
+                # aligned-barrier blocking: post-barrier data waits until
+                # alignment completes (bounded by channel credits)
+                buffered.append((ch, item))
+                continue
+            process(item, ch)
+
+    def _serve_queries(self) -> None:
+        while True:
+            try:
+                req = self.control.get_nowait()
+            except _q.Empty:
+                return
+            op_name, key, namespace, reply = req
+            try:
+                result = None
+                for t, op in zip(self.chain.transformations,
+                                 self.chain.operators):
+                    if op is not None and t.name == op_name and \
+                            hasattr(op, "query_state"):
+                        result = op.query_state(key, namespace)
+                        break
+                reply.put((result, None))
+            except BaseException as e:  # noqa: BLE001
+                reply.put((None, e))
+
+
+class _Coordinator:
+    """Checkpoint + failure coordination for one stage-parallel job run."""
+
+    def __init__(self, num_acks: int):
+        self.num_acks = num_acks
+        self.cancelled = threading.Event()
+        self.failure: Optional[BaseException] = None
+        self._acks: Dict[int, Dict[Tuple[str, int], Dict]] = {}
+        self._complete: Dict[int, threading.Event] = {}
+        self._lock = threading.Lock()
+
+    def expect(self, checkpoint_id: int) -> threading.Event:
+        with self._lock:
+            self._acks[checkpoint_id] = {}
+            ev = self._complete[checkpoint_id] = threading.Event()
+            return ev
+
+    def ack(self, checkpoint_id: int, who: Tuple[str, int],
+            snap: Dict) -> None:
+        with self._lock:
+            acks = self._acks.get(checkpoint_id)
+            if acks is None:
+                return
+            acks[who] = snap
+            if len(acks) >= self.num_acks:
+                self._complete[checkpoint_id].set()
+
+    def collected(self, checkpoint_id: int) -> Dict[Tuple[str, int], Dict]:
+        with self._lock:
+            return self._acks.pop(checkpoint_id, {})
+
+    def subtask_failed(self, subtask, error: BaseException) -> None:
+        self.failure = self.failure or error
+        self.cancelled.set()
+        with self._lock:
+            for ev in self._complete.values():
+                ev.set()
+
+
+# ---------------------------------------------------------------------------
+# executor
+# ---------------------------------------------------------------------------
+
+
+class StageParallelExecutor:
+    """Same run() contract as LocalExecutor, executing via subtask
+    expansion (reference: Execution.deploy — but subtasks here are threads
+    wired by the Shuffle SPI; a cross-process transport plugs in via
+    ``shuffle.service``)."""
+
+    def __init__(self, config: Optional[Configuration] = None,
+                 shuffle_service=None):
+        self.config = config or Configuration()
+        self._shuffle = shuffle_service
+
+    def run(self, graph: StreamGraph, job_name: str = "job",
+            restore_from: Optional[str] = None, cancel_event=None,
+            restore_mode: str = "no-claim", control_queue=None):
+        from flink_tpu.datastream.environment import JobExecutionResult
+
+        plan = plan_stages(graph)
+        cfg = self.config
+        N = cfg.get(DeploymentOptions.STAGE_PARALLELISM)
+        S = cfg.get(DeploymentOptions.SOURCE_PARALLELISM)
+        max_par = cfg.get(CoreOptions.MAX_PARALLELISM)
+        batch_size = cfg.get(BatchOptions.BATCH_SIZE)
+        if N < 1:
+            raise StagePlanError("execution.stage-parallelism must be >= 1")
+
+        shuffle = self._shuffle or create_shuffle_service(
+            cfg.get(DeploymentOptions.SHUFFLE_SERVICE))
+        credits = cfg.get(DeploymentOptions.SHUFFLE_CREDITS)
+
+        ckpt_dir = cfg.get(StateOptions.CHECKPOINT_DIR)
+        ckpt_interval = cfg.get(CheckpointOptions.INTERVAL_MS)
+        ckpt_every_n = cfg.get(CheckpointOptions.EVERY_N_BATCHES)
+        storage = None
+        if ckpt_dir and (ckpt_interval or ckpt_every_n):
+            from flink_tpu.checkpoint.storage import CheckpointStorage
+
+            storage = CheckpointStorage(
+                ckpt_dir, compress=cfg.get(CheckpointOptions.COMPRESSION))
+
+        # restore
+        checkpoint_id = 0
+        restore_states: Dict[str, Any] = {}
+        restore_positions: Dict[int, Any] = {}
+        if restore_from is not None:
+            from flink_tpu.checkpoint.savepoint import prepare_restore
+            from flink_tpu.checkpoint.storage import (
+                read_checkpoint_chain,
+                read_manifest,
+            )
+
+            snap_dir, _ = prepare_restore(restore_from, restore_mode,
+                                          own_checkpoint_root=ckpt_dir)
+            states = read_checkpoint_chain(snap_dir)
+            checkpoint_id = int(read_manifest(snap_dir)["checkpoint_id"])
+            src_id = graph.stable_id(plan.source)
+            known_ids = {graph.stable_id(t)
+                         for t in plan.pre_chain + plan.keyed_chain
+                         if t.operator_factory is not None}
+            for sid, state in states.items():
+                if sid == src_id:
+                    pos = state["source"]
+                    if isinstance(pos, dict) and "__subtasks__" in pos:
+                        restore_positions = {
+                            int(k): v
+                            for k, v in pos["__subtasks__"].items()}
+                    else:
+                        restore_positions = {0: pos}
+                elif sid in known_ids:
+                    restore_states[sid] = state
+                else:
+                    # the reference fails on non-restored state by default
+                    # (allowNonRestoredState opt-in); dropping it silently
+                    # would e.g. restart a renamed source from record 0
+                    raise RuntimeError(
+                        "checkpoint contains state for operators not "
+                        "present in the graph (graph changed since "
+                        f"snapshot?): {sid!r}")
+            if storage is not None:
+                checkpoint_id = max(
+                    checkpoint_id, storage.latest_checkpoint_id() or 0)
+
+        coordinator = _Coordinator(num_acks=S + N)
+
+        # wire partitions: source subtask i owns partition "src-i" with N
+        # subpartitions; keyed subtask j consumes subpartition j of all
+        partition_ids = [f"{job_name}-src-{i}" for i in range(S)]
+        writers = [shuffle.create_partition(pid, N, credits)
+                   for pid in partition_ids]
+        gates = [shuffle.create_gate(partition_ids, j) for j in range(N)]
+
+        sources = []
+        import copy as _copy
+
+        for i in range(S):
+            src = plan.source.source if S == 1 else _copy.deepcopy(
+                plan.source.source)
+            sources.append(_SourceSubtask(
+                i, S, plan, graph, writers[i], N, max_par, batch_size,
+                coordinator, src,
+                restore_position=restore_positions.get(i)))
+        keyed = [_KeyedSubtask(j, N, plan, graph, gates[j], max_par,
+                               coordinator, cfg) for j in range(N)]
+        for k in keyed:
+            if restore_states:
+                k._restore_states = restore_states
+        for t in keyed + sources:
+            t.start()
+
+        t0 = time.perf_counter()
+        savepoint_path = None
+        last_ckpt = time.time() * 1000
+        last_batches = 0
+        try:
+            while any(t.is_alive() for t in sources + keyed):
+                if cancel_event is not None and cancel_event.is_set():
+                    coordinator.cancelled.set()
+                    if isinstance(shuffle, LocalShuffleService):
+                        shuffle.cancel()
+                    from flink_tpu.cluster.local_executor import (
+                        JobCancelledError,
+                    )
+
+                    raise JobCancelledError(job_name)
+                if coordinator.failure is not None:
+                    raise coordinator.failure
+                # user control: savepoints / queries
+                if control_queue is not None:
+                    sp = self._serve_control(
+                        control_queue, plan, graph, sources, keyed,
+                        coordinator, storage, ckpt_dir, job_name,
+                        checkpoint_id)
+                    if sp is not None:
+                        checkpoint_id, savepoint_path, stopped = sp
+                        if stopped:
+                            break
+                # periodic checkpoints (time interval or deterministic
+                # every-N-source-batches, like the single-slot executor)
+                if storage is not None and any(
+                        s.is_alive() for s in sources):
+                    total_batches = sum(s.batches_polled for s in sources)
+                    due = (ckpt_every_n and total_batches - last_batches
+                           >= ckpt_every_n) or (
+                        not ckpt_every_n and ckpt_interval
+                        and time.time() * 1000 - last_ckpt >= ckpt_interval)
+                    if due:
+                        checkpoint_id += 1
+                        self._checkpoint(
+                            checkpoint_id, Barrier(checkpoint_id),
+                            sources, keyed, coordinator, graph, plan,
+                            storage=storage, job_name=job_name)
+                        last_ckpt = time.time() * 1000
+                        last_batches = total_batches
+                time.sleep(0.01)
+            if coordinator.failure is not None:
+                raise coordinator.failure
+            for t in sources + keyed:
+                t.join(timeout=30)
+                if t.error is not None:
+                    raise t.error
+        except BaseException:
+            coordinator.cancelled.set()
+            if isinstance(shuffle, LocalShuffleService):
+                shuffle.cancel()
+            for t in sources + keyed:
+                t.join(timeout=5)
+            for k in keyed:
+                if k.chain is not None:
+                    k.chain.dispose()
+            raise
+        finally:
+            if control_queue is not None:
+                from flink_tpu.cluster.local_executor import _ControlRequest
+
+                try:
+                    while True:
+                        req = control_queue.get_nowait()
+                        if isinstance(req, _ControlRequest):
+                            req.finish(None, RuntimeError(
+                                f"job {job_name!r} terminated"))
+                except _q.Empty:
+                    pass
+
+        elapsed = time.perf_counter() - t0
+        total = sum(s.records_out for s in sources)
+        metrics = {
+            "records": total,
+            "elapsed_s": elapsed,
+            "records_per_s": total / elapsed if elapsed else 0.0,
+            "stage_parallelism": N,
+            "source_parallelism": S,
+            "subtask_records_in": [k.records_in for k in keyed],
+        }
+        if savepoint_path:
+            metrics["savepoint"] = savepoint_path
+        return JobExecutionResult(job_name, metrics)
+
+    # ------------------------------------------------------------- control
+
+    def _serve_control(self, control_queue, plan, graph, sources, keyed,
+                       coordinator, storage, ckpt_dir, job_name,
+                       checkpoint_id):
+        from flink_tpu.cluster.local_executor import (
+            SavepointRequest,
+            StateQueryRequest,
+        )
+
+        try:
+            req = control_queue.get_nowait()
+        except _q.Empty:
+            return None
+        if isinstance(req, StateQueryRequest):
+            try:
+                from flink_tpu.state.keygroups import (
+                    hash_keys_to_i64,
+                )
+
+                key_id = int(hash_keys_to_i64(
+                    np.asarray([req.key]))[0])
+                group = int(assign_key_groups(
+                    np.asarray([key_id]),
+                    self.config.get(CoreOptions.MAX_PARALLELISM))[0])
+                owner = int(key_group_to_operator_index(
+                    np.asarray([group]),
+                    self.config.get(CoreOptions.MAX_PARALLELISM),
+                    len(keyed))[0])
+                reply: _q.Queue = _q.Queue()
+                keyed[owner].control.put(
+                    (req.operator_name, req.key, req.namespace, reply))
+                result, err = reply.get(timeout=30)
+                req.finish(result, err)
+            except BaseException as e:  # noqa: BLE001
+                req.finish(None, e)
+            return None
+        if isinstance(req, SavepointRequest):
+            try:
+                new_id = checkpoint_id + 1
+                path = self._checkpoint(
+                    new_id, Barrier(new_id, savepoint=req.path,
+                                    stop=req.stop),
+                    sources, keyed, coordinator, graph, plan,
+                    savepoint_dir=req.path, job_name=job_name)
+                req.finish(path)
+                return (new_id, path, req.stop)
+            except BaseException as e:  # noqa: BLE001
+                req.finish(None, e)
+                return None
+        req.finish(None, RuntimeError(f"unsupported control {req!r}"))
+        return None
+
+    # ---------------------------------------------------------- checkpoint
+
+    def _checkpoint(self, checkpoint_id: int, barrier: Barrier, sources,
+                    keyed, coordinator, graph, plan,
+                    storage=None, savepoint_dir=None, job_name="job"):
+        """Trigger, await S+N acks, merge subtask states into the logical
+        single-slot snapshot format, commit."""
+        live_sources = [s for s in sources if s.is_alive()]
+        if not live_sources:
+            raise RuntimeError("cannot checkpoint: all sources finished")
+        coordinator.num_acks = len(live_sources) + len(keyed)
+        done = coordinator.expect(checkpoint_id)
+        for s in live_sources:
+            s.control.put(barrier)
+        if not done.wait(timeout=120):
+            raise TimeoutError(f"checkpoint {checkpoint_id} timed out")
+        if coordinator.failure is not None:
+            raise coordinator.failure
+        acks = coordinator.collected(checkpoint_id)
+        # assemble logical snapshot
+        positions = {who[1]: snap["position"]
+                     for who, snap in acks.items() if who[0] == "source"}
+        # a single source subtask stores its position unwrapped, so the
+        # snapshot is restorable by the single-slot executor too; S > 1
+        # wraps per-subtask positions (only stage-mode can restore those)
+        if set(positions) == {0}:
+            source_state = {"source": positions[0]}
+        else:
+            source_state = {"source": {"__subtasks__": {
+                str(i): p for i, p in positions.items()}}}
+        snap: Dict[str, Any] = {
+            graph.stable_id(plan.source): source_state,
+        }
+        per_operator: Dict[str, List[Dict]] = {}
+        for who, sub in acks.items():
+            for sid, state in sub.get("operators", {}).items():
+                per_operator.setdefault(sid, []).append(state)
+        for sid, states in per_operator.items():
+            snap[sid] = merge_subtask_states(states)
+        if savepoint_dir is not None:
+            from flink_tpu.checkpoint.savepoint import write_savepoint
+
+            return write_savepoint(savepoint_dir, job_name, snap,
+                                   checkpoint_id=checkpoint_id)
+        if storage is not None:
+            storage.write_checkpoint(checkpoint_id, job_name, snap)
+        return None

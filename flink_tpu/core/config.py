@@ -175,6 +175,37 @@ class BatchOptions:
         "0 = poll sources inline on the task loop.")
 
 
+class DeploymentOptions:
+    """Subtask-expansion execution (reference: ExecutionGraph parallel
+    expansion — DefaultExecutionGraph / Execution.deploy — where every
+    JobVertex runs `parallelism` subtasks connected by the shuffle)."""
+
+    STAGE_PARALLELISM = ConfigOption(
+        "execution.stage-parallelism", default=0, type=int,
+        description="Subtask count for the keyed stage. 0 (default) runs "
+        "the whole pipeline in one task; N > 0 expands the job into "
+        "source subtasks + N keyed subtasks connected through the shuffle "
+        "service with key-group routing and aligned checkpoint barriers "
+        "(reference: ExecutionJobVertex parallel expansion + "
+        "KeyGroupStreamPartitioner).")
+    SOURCE_PARALLELISM = ConfigOption(
+        "execution.source-parallelism", default=1, type=int,
+        description="Subtask count for the source stage in multi-slot "
+        "mode. Each source subtask receives open(subtask_index, "
+        "parallelism) and must split its input accordingly.")
+    SHUFFLE_SERVICE = ConfigOption(
+        "shuffle.service", default="local", type=str,
+        description="Registered ShuffleService transport connecting "
+        "subtasks: 'local' (in-process bounded queues, credit-based) or "
+        "'grpc' (cross-process batches over gRPC). Reference: "
+        "ShuffleServiceFactory SPI.")
+    SHUFFLE_CREDITS = ConfigOption(
+        "shuffle.credits-per-channel", default=2, type=int,
+        description="In-flight batches allowed per (producer, consumer) "
+        "channel before the producer blocks — the credit-based flow "
+        "control bound (reference: RemoteInputChannel.unannouncedCredit).")
+
+
 class StateOptions:
     BACKEND = ConfigOption(
         "state.backend", default="tpu-slot-table", type=str,

@@ -148,7 +148,33 @@ class StreamExecutionEnvironment:
             restore_mode = os.environ.get("FLINK_TPU_RESTORE_MODE",
                                           restore_mode)
         graph = self.get_stream_graph()
-        executor = LocalExecutor(self._effective_config())
+        config = self._effective_config()
+        from flink_tpu.core.config import DeploymentOptions
+
+        executor = LocalExecutor(config)
+        if config.get(DeploymentOptions.STAGE_PARALLELISM) > 0:
+            # subtask-expansion mode: source subtasks + N keyed subtasks
+            # wired by the shuffle SPI (reference: ExecutionGraph parallel
+            # expansion / Execution.deploy). Graph shapes the stage planner
+            # doesn't cover fall back to single-slot execution with a
+            # warning (reference: scheduler falls back rather than failing
+            # a runnable job).
+            from flink_tpu.cluster.stage_executor import (
+                StagePlanError,
+                StageParallelExecutor,
+                plan_stages,
+            )
+
+            try:
+                plan_stages(graph)
+            except StagePlanError as e:
+                import warnings
+
+                warnings.warn(
+                    f"execution.stage-parallelism set but {e}; running "
+                    "single-slot", stacklevel=2)
+            else:
+                executor = StageParallelExecutor(config)
         result = executor.run(graph, job_name=job_name,
                               restore_from=restore_from,
                               restore_mode=restore_mode)

@@ -215,14 +215,23 @@ class GroupAggOperator(Operator):
     # ------------------------------------------------------------- checkpoint
 
     def _host_state(self):
+        # the changelog bookkeeping is stored LOGICALLY (keyed by key_id,
+        # not by physical slot) so snapshots merge across subtasks and
+        # restore into any slot layout (key-group re-assignment, multi-slot
+        # union — same portability contract as the slot table rows)
+        interesting = np.nonzero((self._row_counts != 0)
+                                 | self._emitted_mask)[0]
         return {
             "key_values": dict(self._key_values),
             "keys_hashed": self._keys_hashed,
             "max_ts": self._max_ts,
-            "row_counts": self._row_counts.copy(),
-            "emitted_mask": self._emitted_mask.copy(),
-            "last_emitted": {n: a.copy()
-                             for n, a in self._last_emitted.items()},
+            "changelog": {
+                "key_id": self.table.keys_of_slots(interesting),
+                "count": self._row_counts[interesting],
+                "emitted": self._emitted_mask[interesting],
+                "last": {n: a[interesting]
+                         for n, a in self._last_emitted.items()},
+            },
         }
 
     def snapshot_state(self):
@@ -245,15 +254,53 @@ class GroupAggOperator(Operator):
         key_id = int(hash_keys_to_i64(np.asarray([key_value]))[0])
         return self.table.query(key_id, namespace)
 
-    def restore_state(self, state):
-        self.table.restore(state["table"])
+    def restore_state(self, state, key_group_filter=None):
+        self.table.restore(state["table"],
+                           key_group_filter=key_group_filter)
         self._key_values = dict(state.get("key_values", {}))
         self._keys_hashed = state.get("keys_hashed", False)
         self._max_ts = state.get("max_ts", 0)
-        self._row_counts = np.asarray(
-            state.get("row_counts", np.zeros(0, dtype=np.int64)))
-        self._emitted_mask = np.asarray(
-            state.get("emitted_mask", np.zeros(0, dtype=bool)))
-        self._last_emitted = {
-            n: np.asarray(a)
-            for n, a in state.get("last_emitted", {}).items()}
+        self._row_counts = np.zeros(0, dtype=np.int64)
+        self._emitted_mask = np.zeros(0, dtype=bool)
+        self._last_emitted = {}
+        cl = state.get("changelog")
+        if cl is None and "row_counts" in state:
+            # legacy (round-2 snapshot) slot-indexed format: only valid
+            # when restoring into the same slot layout, which holds because
+            # the table rows above restored in snapshot order
+            self._row_counts = np.asarray(state["row_counts"],
+                                          dtype=np.int64)
+            self._emitted_mask = np.asarray(state["emitted_mask"],
+                                            dtype=bool)
+            self._last_emitted = {
+                n: np.asarray(a)
+                for n, a in state.get("last_emitted", {}).items()}
+            return
+        if cl is None or len(np.asarray(cl.get("key_id", ()))) == 0:
+            return
+        key_ids = np.asarray(cl["key_id"], dtype=np.int64)
+        counts = np.asarray(cl["count"], dtype=np.int64)
+        emitted = np.asarray(cl["emitted"], dtype=bool)
+        if key_group_filter is not None:
+            from flink_tpu.state.keygroups import assign_key_groups
+
+            groups = assign_key_groups(key_ids, self.table.max_parallelism)
+            keep = np.isin(groups, np.asarray(sorted(key_group_filter)))
+            key_ids, counts, emitted = (key_ids[keep], counts[keep],
+                                        emitted[keep])
+            cl_last = {n: np.asarray(a)[keep]
+                       for n, a in cl.get("last", {}).items()}
+        else:
+            cl_last = {n: np.asarray(a) for n, a in cl.get("last", {}).items()}
+        if len(key_ids) == 0:
+            return
+        # re-key the logical changelog onto this instance's slot layout
+        ns = np.full(len(key_ids), _GLOBAL_NS, dtype=np.int64)
+        slots = self.table.lookup_or_insert(key_ids, ns)
+        self._ensure_host_capacity(int(slots.max()) + 1)
+        self._row_counts[slots] = counts
+        self._emitted_mask[slots] = emitted
+        for n, a in cl_last.items():
+            arr = np.zeros(len(self._row_counts), dtype=a.dtype)
+            arr[slots] = a
+            self._last_emitted[n] = arr

@@ -274,8 +274,15 @@ class WindowAggOperator(Operator):
                     if int(namespace) in out else {})
         return out
 
-    def restore_state(self, state):
-        self.windower.restore(state["windower"])
+    def restore_state(self, state, key_group_filter=None):
+        if key_group_filter is not None:
+            # subtask-expansion restore: keep only this instance's key
+            # groups from the (merged, logical) snapshot (reference:
+            # key-group-range filtered restore on rescale)
+            self.windower.restore(state["windower"],
+                                  key_group_filter=key_group_filter)
+        else:
+            self.windower.restore(state["windower"])
         # empty sub-dicts are pruned by the checkpoint codec
         self._key_values = dict(state.get("key_values", {}))
         self._keys_hashed = state.get("keys_hashed", False)

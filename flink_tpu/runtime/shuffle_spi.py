@@ -1,0 +1,279 @@
+"""Shuffle SPI — the pluggable data plane between subtasks.
+
+reference: flink-runtime/.../runtime/shuffle/ShuffleEnvironment.java (TM-side
+factory for writers/readers), ShuffleServiceFactory.java (pluggability),
+io/network/api/writer/RecordWriter.java:105 (emit -> channel selection),
+io/network/partition/consumer/RemoteInputChannel.java:114,374 (credit-based
+flow control: the receiver grants credits equal to free buffers; the sender
+only sends with credit, bounding in-flight data and producing natural
+backpressure).
+
+TPU re-design: the unit in flight is a columnar RecordBatch (not a serialized
+record), and a "buffer" of credit is one batch. Two built-in transports:
+
+- ``LocalShuffleService`` — bounded in-process queues (threads within one
+  TaskExecutor / process). The credit IS the queue bound.
+- ``RpcShuffleService`` (flink_tpu/cluster/rpc_shuffle.py) — batches travel
+  over gRPC between task executors; credits are granted back over the same
+  channel. Registered under ``shuffle.service: grpc``.
+
+Both implement this SPI, so the execution layer is transport-agnostic — the
+seam a DCN/ICI transport slots into without rewrites (SURVEY §2.8 mapping).
+
+Within one keyed mesh operator, the data plane is NOT this module: records
+reach device shards via sharded device_put + XLA collectives
+(flink_tpu/parallel/shuffle.py). This SPI connects *subtasks* — pipeline
+stages and parallel instances — the role Netty plays in the reference.
+"""
+
+from __future__ import annotations
+
+import queue as _q
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from flink_tpu.core.records import RecordBatch
+
+#: sentinel channel events (travel in-band, like the reference's
+#: EndOfPartitionEvent / CheckpointBarrier)
+END_OF_PARTITION = "__eop__"
+
+
+class Barrier:
+    """Checkpoint barrier riding the data channels (reference:
+    io/network/api/CheckpointBarrier). Aligned handling is the consumer's
+    job (InputGate.poll_aligned)."""
+
+    __slots__ = ("checkpoint_id", "savepoint", "stop")
+
+    def __init__(self, checkpoint_id: int, savepoint: Optional[str] = None,
+                 stop: bool = False):
+        self.checkpoint_id = checkpoint_id
+        self.savepoint = savepoint
+        self.stop = stop
+
+    def __repr__(self):
+        return f"Barrier({self.checkpoint_id})"
+
+
+class ResultPartitionWriter:
+    """One producer subtask's view of its output partition: emit a batch to
+    one subpartition (consumer channel), broadcast events to all."""
+
+    def emit(self, subpartition: int, batch: RecordBatch) -> None:
+        raise NotImplementedError
+
+    def broadcast_event(self, event) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        """Broadcast END_OF_PARTITION and release resources."""
+        raise NotImplementedError
+
+
+class InputGate:
+    """One consumer subtask's view of its inputs: a union of channels, one
+    per producer subtask."""
+
+    num_channels: int
+
+    def poll(self, timeout: float = 0.0):
+        """Next (channel_index, item) where item is a RecordBatch, Barrier,
+        a watermark (int), or END_OF_PARTITION. None on timeout."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class ShuffleService:
+    """SPI: creates the writers/readers connecting subtasks (reference:
+    ShuffleEnvironment.createResultPartitionWriters / createInputGates)."""
+
+    def create_partition(self, partition_id: str, num_subpartitions: int,
+                         credits_per_channel: int = 2
+                         ) -> ResultPartitionWriter:
+        raise NotImplementedError
+
+    def create_gate(self, partition_ids: Sequence[str], subpartition: int
+                    ) -> InputGate:
+        """A gate consuming subpartition ``subpartition`` of every listed
+        partition (one channel per producer)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Local (in-process) transport with credit-based flow control
+# ---------------------------------------------------------------------------
+
+
+class _Subpartition:
+    """One (producer, consumer-channel) pipe. ``credits`` mirrors the
+    reference's buffer-backed credit: the producer blocks once
+    ``credits_per_channel`` items are in flight; consuming an item grants
+    the credit back (RemoteInputChannel.notifyCreditAvailable)."""
+
+    def __init__(self, credits_per_channel: int):
+        self.queue: _q.Queue = _q.Queue()
+        self.credits = threading.Semaphore(credits_per_channel)
+
+    def put(self, item, is_event: bool, cancelled: Callable[[], bool]) -> None:
+        if not is_event:
+            # events (watermarks, barriers, EOP) ride credit-free like the
+            # reference's priority events — only data consumes credit
+            while not self.credits.acquire(timeout=0.05):
+                if cancelled():
+                    return
+        self.queue.put(item)
+
+    def get(self, timeout: float):
+        item = self.queue.get(timeout=timeout) if timeout else \
+            self.queue.get_nowait()
+        if isinstance(item, RecordBatch):
+            self.credits.release()
+        return item
+
+
+class LocalShuffleService(ShuffleService):
+    """In-process transport: subtasks are threads, channels are bounded
+    queues. Also the reference's default for its MiniCluster tests."""
+
+    def __init__(self):
+        self._partitions: Dict[str, "_LocalPartition"] = {}
+        self._lock = threading.Lock()
+        self._cancelled = threading.Event()
+
+    def cancel(self) -> None:
+        """Release all producers blocked on credits (job teardown)."""
+        self._cancelled.set()
+
+    def _partition(self, partition_id: str, num_subpartitions: int
+                   ) -> "_LocalPartition":
+        with self._lock:
+            part = self._partitions.get(partition_id)
+            if part is None:
+                part = _LocalPartition(partition_id, num_subpartitions,
+                                       self._credits)
+                self._partitions[partition_id] = part
+            else:
+                # a gate may materialize the partition before its writer
+                # (the SPI mandates no ordering) — grow to the larger view
+                part.ensure(num_subpartitions, self._credits)
+            return part
+
+    _credits = 2
+
+    def create_partition(self, partition_id: str, num_subpartitions: int,
+                         credits_per_channel: int = 2) -> "LocalWriter":
+        self._credits = credits_per_channel
+        part = self._partition(partition_id, num_subpartitions)
+        return LocalWriter(part, self._cancelled)
+
+    def create_gate(self, partition_ids: Sequence[str], subpartition: int
+                    ) -> "LocalGate":
+        parts = [self._partition(pid, subpartition + 1)
+                 for pid in partition_ids]
+        return LocalGate(parts, subpartition)
+
+
+class _LocalPartition:
+    def __init__(self, partition_id: str, num_subpartitions: int,
+                 credits_per_channel: int):
+        self.partition_id = partition_id
+        self.subpartitions = [
+            _Subpartition(credits_per_channel)
+            for _ in range(num_subpartitions)
+        ]
+
+    def ensure(self, num: int, credits: int) -> None:
+        while len(self.subpartitions) < num:
+            self.subpartitions.append(_Subpartition(credits))
+
+
+class LocalWriter(ResultPartitionWriter):
+    def __init__(self, partition: _LocalPartition, cancelled: threading.Event):
+        self.partition = partition
+        self._cancelled = cancelled
+
+    def emit(self, subpartition: int, batch: RecordBatch) -> None:
+        self.partition.subpartitions[subpartition].put(
+            batch, is_event=False, cancelled=self._cancelled.is_set)
+
+    def broadcast_event(self, event) -> None:
+        for sp in self.partition.subpartitions:
+            sp.put(event, is_event=True, cancelled=self._cancelled.is_set)
+
+    def close(self) -> None:
+        self.broadcast_event(END_OF_PARTITION)
+
+
+class LocalGate(InputGate):
+    """Fair-ish polling over the channels of one subpartition index."""
+
+    def __init__(self, partitions: List[_LocalPartition], subpartition: int):
+        self._chans = [p.subpartitions[subpartition] for p in partitions]
+        self.num_channels = len(self._chans)
+        self._rr = 0
+
+    def poll(self, timeout: float = 0.0):
+        n = self.num_channels
+        deadline = None
+        while True:
+            for i in range(n):
+                ch = (self._rr + i) % n
+                try:
+                    item = self._chans[ch].get(timeout=0)
+                    self._rr = (ch + 1) % n
+                    return ch, item
+                except _q.Empty:
+                    continue
+            if not timeout:
+                return None
+            if deadline is None:
+                import time as _t
+
+                deadline = _t.monotonic() + timeout
+                continue
+            import time as _t
+
+            if _t.monotonic() >= deadline:
+                return None
+            # block briefly on one channel to avoid spinning
+            try:
+                item = self._chans[self._rr].get(timeout=min(
+                    0.01, max(deadline - _t.monotonic(), 0.001)))
+                ch = self._rr
+                self._rr = (ch + 1) % n
+                return ch, item
+            except _q.Empty:
+                continue
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Factory registry (reference: ShuffleServiceFactory discovery)
+# ---------------------------------------------------------------------------
+
+_FACTORIES: Dict[str, Callable[[], ShuffleService]] = {
+    "local": LocalShuffleService,
+}
+
+
+def register_shuffle_service(name: str,
+                             factory: Callable[[], ShuffleService]) -> None:
+    _FACTORIES[name] = factory
+
+
+def create_shuffle_service(name: str = "local") -> ShuffleService:
+    try:
+        factory = _FACTORIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown shuffle.service {name!r}; registered: "
+            f"{sorted(_FACTORIES)}") from None
+    return factory()
