@@ -83,7 +83,9 @@ class TaskExecutorEndpoint(RpcEndpoint):
 
         def run():
             try:
-                executor = LocalExecutor(Configuration(config_dict))
+                from flink_tpu.cluster.stage_executor import make_executor
+
+                executor = make_executor(Configuration(config_dict), graph)
                 result = executor.run(graph, job_name=job_name,
                                       restore_from=restore_from,
                                       cancel_event=cancel,
@@ -360,8 +362,12 @@ class JobMasterThread:
         return None
 
     def _supervise(self) -> None:
+        from flink_tpu.core.config import DeploymentOptions
+
         rm = self.cluster.rm_gateway()
         ckpt_dir = self.config.get(StateOptions.CHECKPOINT_DIR)
+        want_stage_par = self.config.get(
+            DeploymentOptions.STAGE_PARALLELISM)
         while True:
             slot = self._acquire_slot(rm)
             if slot is None:
@@ -378,14 +384,36 @@ class JobMasterThread:
             self._current_address = slot["address"]
             execution_id = f"{self.job_id}-{self.attempt}"
             self._current_execution_id = execution_id
+            # subtask expansion: the keyed stage wants one slot per
+            # subtask. Acquire up to stage-parallelism slots (the primary
+            # hosts the source stage + driver) and scale the stage to what
+            # the cluster can actually give — reactive, like the adaptive
+            # scheduler's scale-to-resources (reference:
+            # SlotSharingExecutionSlotAllocator + AdaptiveScheduler).
+            extra_slots: List[dict] = []
+            config = self.config
+            if want_stage_par > 1:
+                for _ in range(want_stage_par - 1):
+                    extra = rm.request_slot()
+                    if extra is None:
+                        break
+                    extra_slots.append(extra)
+                effective = 1 + len(extra_slots)
+                if effective != want_stage_par:
+                    config = Configuration(
+                        {**self.config.to_dict(),
+                         "execution.stage-parallelism": effective})
+            participating = [slot["executor_id"]] + [
+                s["executor_id"] for s in extra_slots]
             try:
                 te = self.cluster.service.connect(slot["address"],
                                                   slot["executor_id"])
                 restore = self._latest_restore_path(ckpt_dir)
                 self._set_status(RUNNING)
                 te.submit_task(execution_id, self.graph,
-                               self.config.to_dict(), self.job_name, restore)
-                outcome = self._watch(te, execution_id)
+                               config.to_dict(), self.job_name, restore)
+                outcome = self._watch(te, execution_id,
+                                      participating=participating)
                 if outcome == FINISHED:
                     self.result = _result_from_wire(
                         te.task_result(execution_id))
@@ -393,10 +421,11 @@ class JobMasterThread:
                 self.error = e
                 outcome = FAILED
             finally:
-                try:
-                    rm.release_slot(slot["executor_id"])
-                except Exception:
-                    pass
+                for s in [slot] + extra_slots:
+                    try:
+                        rm.release_slot(s["executor_id"])
+                    except Exception:
+                        pass
             if outcome == FINISHED:
                 self._set_status(FINISHED)
                 return
@@ -428,11 +457,17 @@ class JobMasterThread:
             self._set_status(RESTARTING)
             time.sleep(self.restart_strategy.backoff_ms() / 1000.0)
 
-    def _watch(self, te, execution_id: str) -> str:
-        """Poll task status + executor liveness until a terminal outcome."""
+    def _watch(self, te, execution_id: str,
+               participating: Optional[List[str]] = None) -> str:
+        """Poll task status + executor liveness until a terminal outcome.
+
+        ``participating`` lists every executor holding one of this job's
+        slots (subtask expansion spans executors); losing ANY of them fails
+        the attempt — the whole pipeline is one failover region."""
         timeout_s = self.config.get(
             ClusterOptions.HEARTBEAT_TIMEOUT_MS) / 1000.0
         rescaling = False
+        watch_executors = participating or [self._current_executor]
         while True:
             if self._cancel_requested.is_set():
                 try:
@@ -464,16 +499,20 @@ class JobMasterThread:
                     return _RESCALED
                 self.error = st["error"]
                 return st["status"]
-            hb = self.cluster.last_heartbeat(self._current_executor)
-            if hb is not None and time.monotonic() - hb > timeout_s:
-                self.error = RuntimeError(
-                    f"heartbeat timeout for {self._current_executor}")
-                self.cluster.rm_gateway().mark_dead(self._current_executor)
-                try:
-                    te.cancel_task(execution_id)
-                except Exception:
-                    pass
-                return FAILED
+            for eid in watch_executors:
+                hb = self.cluster.last_heartbeat(eid)
+                # a missing record means the executor left the membership
+                # entirely (killed/unregistered) — every registration seeds
+                # a timestamp, so None is as dead as a timed-out beat
+                if hb is None or time.monotonic() - hb > timeout_s:
+                    self.error = RuntimeError(
+                        f"heartbeat timeout for {eid}")
+                    self.cluster.rm_gateway().mark_dead(eid)
+                    try:
+                        te.cancel_task(execution_id)
+                    except Exception:
+                        pass
+                    return FAILED
             time.sleep(0.01)
 
     @staticmethod

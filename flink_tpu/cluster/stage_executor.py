@@ -214,17 +214,59 @@ class _SubtaskFailure(Exception):
     pass
 
 
+class _SharedSink:
+    """Thread-safe facade over ONE sink instance shared by N keyed
+    subtasks: writes serialize under a lock, and the underlying sink opens
+    once / closes only when the last subtask closes (the reference deploys
+    a sink INSTANCE per subtask; collect-style sinks here aggregate in one
+    object, so sharing + refcounting is the honest equivalent)."""
+
+    def __init__(self, sink):
+        self._sink = sink
+        self._lock = threading.Lock()
+        self._opens = 0
+        self._closes = 0
+        self._closed = False
+
+    def open(self, subtask_index: int = 0) -> None:
+        with self._lock:
+            if self._opens == 0:
+                self._sink.open(0)
+            self._opens += 1
+
+    def write(self, batch) -> None:
+        with self._lock:
+            self._sink.write(batch)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closes += 1
+            if self._closes >= self._opens and not self._closed:
+                self._closed = True
+                self._sink.close()
+
+    def __getattr__(self, name):
+        return getattr(self._sink, name)
+
+
 class _OperatorChain:
     """The fused operator chain of one subtask (reference: OperatorChain —
     direct method-call hand-off between chained operators)."""
 
     def __init__(self, transformations: Sequence[Transformation],
-                 ctx: OperatorContext):
+                 ctx: OperatorContext,
+                 shared_sinks: Optional[Dict[int, _SharedSink]] = None):
         self.transformations = list(transformations)
         self.operators = []
         for t in self.transformations:
             op = t.operator_factory() if t.operator_factory else None
             if op is not None:
+                if shared_sinks is not None and hasattr(op, "sink"):
+                    # every subtask's factory captured the same sink
+                    # object — route all of them through one refcounted,
+                    # locked facade (see _SharedSink)
+                    op.sink = shared_sinks.setdefault(
+                        t.uid, _SharedSink(op.sink))
                 op.open(ctx)
             self.operators.append(op)
 
@@ -336,6 +378,9 @@ class _SourceSubtask(threading.Thread):
         self.chain: Optional[_OperatorChain] = None
         self.records_out = 0
         self.batches_polled = 0
+        #: position at exit — checkpoints after this subtask drains its
+        #: split still record where it ended (restore must not replay it)
+        self.final_position = None
 
     def run(self) -> None:
         try:
@@ -376,7 +421,13 @@ class _SourceSubtask(threading.Thread):
                 if wm is not None:
                     self.writer.broadcast_event(int(wm))
         finally:
+            self.final_position = self.source.snapshot_position()
             self.source.close()
+        # a barrier enqueued while this loop was finishing must still be
+        # served (position + ack + in-band broadcast) before EOP — the
+        # coordinator synthesizes acks only for barriers that arrive after
+        # the thread is observably dead
+        self._serve_control()
         self.writer.broadcast_event(MAX_WATERMARK)
         self.writer.close()
 
@@ -424,8 +475,10 @@ class _KeyedSubtask(threading.Thread):
 
     def __init__(self, index: int, parallelism: int, plan: StagePlan,
                  graph: StreamGraph, gate, max_parallelism: int,
-                 coordinator: "_Coordinator", config: Configuration):
+                 coordinator: "_Coordinator", config: Configuration,
+                 shared_sinks: Optional[Dict[int, _SharedSink]] = None):
         super().__init__(name=f"keyed-subtask-{index}", daemon=True)
+        self.shared_sinks = shared_sinks
         self.index = index
         self.parallelism = parallelism
         self.plan = plan
@@ -452,7 +505,8 @@ class _KeyedSubtask(threading.Thread):
     def _run(self) -> None:
         ctx = OperatorContext(operator_index=self.index, parallelism=1,
                               max_parallelism=self.max_parallelism)
-        self.chain = _OperatorChain(self.plan.keyed_chain, ctx)
+        self.chain = _OperatorChain(self.plan.keyed_chain, ctx,
+                                    shared_sinks=self.shared_sinks)
         if self._restore_states is not None:
             self.chain.restore(self.graph, self._restore_states,
                                key_group_filter=set(self.key_groups))
@@ -520,6 +574,13 @@ class _KeyedSubtask(threading.Thread):
                         savepoint=aligning.savepoint is not None)}
                     self.coordinator.ack(aligning.checkpoint_id,
                                          ("keyed", self.index), snap)
+                    if aligning.stop:
+                        # stop-with-savepoint completed by an EOP: stop
+                        # exactly like the barrier-completion branch —
+                        # post-savepoint output would duplicate on resume
+                        aligning = None
+                        self.chain.close()
+                        return
                     aligning = None
                     for bch, bitem in buffered:
                         process(bitem, bch)
@@ -585,7 +646,9 @@ class _Coordinator:
             snap: Dict) -> None:
         with self._lock:
             acks = self._acks.get(checkpoint_id)
-            if acks is None:
+            if acks is None or who in acks:
+                # first ack wins: a synthesized end-of-split ack must never
+                # replace a real barrier-cut ack (their positions differ)
                 return
             acks[who] = snap
             if len(acks) >= self.num_acks:
@@ -624,6 +687,7 @@ class StageParallelExecutor:
             restore_mode: str = "no-claim", control_queue=None):
         from flink_tpu.datastream.environment import JobExecutionResult
 
+        self._cancel_event = cancel_event
         plan = plan_stages(graph)
         cfg = self.config
         N = cfg.get(DeploymentOptions.STAGE_PARALLELISM)
@@ -673,7 +737,19 @@ class StageParallelExecutor:
                         restore_positions = {
                             int(k): v
                             for k, v in pos["__subtasks__"].items()}
+                        if len(restore_positions) != S:
+                            raise RuntimeError(
+                                "snapshot has positions for "
+                                f"{len(restore_positions)} source subtasks "
+                                f"but execution.source-parallelism is {S} "
+                                "— source splits cannot be re-assigned "
+                                "across counts (restore with the original "
+                                "source parallelism)")
                     else:
+                        if S != 1:
+                            raise RuntimeError(
+                                "snapshot has a single source position "
+                                f"but execution.source-parallelism is {S}")
                         restore_positions = {0: pos}
                 elif sid in known_ids:
                     restore_states[sid] = state
@@ -708,8 +784,10 @@ class StageParallelExecutor:
                 i, S, plan, graph, writers[i], N, max_par, batch_size,
                 coordinator, src,
                 restore_position=restore_positions.get(i)))
+        shared_sinks: Dict[int, _SharedSink] = {}
         keyed = [_KeyedSubtask(j, N, plan, graph, gates[j], max_par,
-                               coordinator, cfg) for j in range(N)]
+                               coordinator, cfg, shared_sinks=shared_sinks)
+                 for j in range(N)]
         for k in keyed:
             if restore_states:
                 k._restore_states = restore_states
@@ -871,18 +949,50 @@ class StageParallelExecutor:
         done = coordinator.expect(checkpoint_id)
         for s in live_sources:
             s.control.put(barrier)
-        if not done.wait(timeout=120):
-            raise TimeoutError(f"checkpoint {checkpoint_id} timed out")
+        deadline = time.monotonic() + 120
+        while not done.wait(timeout=0.1):
+            # a source may have drained its split between the is_alive()
+            # check and serving the trigger: synthesize its ack from the
+            # recorded final position (the thread has exited — its chain
+            # is safe to snapshot from here)
+            for s in live_sources:
+                if not s.is_alive() and s.final_position is not None:
+                    coordinator.ack(
+                        checkpoint_id, ("source", s.index),
+                        {"position": s.final_position,
+                         "operators": s.chain.snapshot(graph)
+                         if s.chain else {}})
+            # the run loop is parked here — cancellation and subtask death
+            # must abort the checkpoint, not wait out the full deadline
+            if coordinator.cancelled.is_set() or (
+                    self._cancel_event is not None
+                    and self._cancel_event.is_set()):
+                from flink_tpu.cluster.local_executor import (
+                    JobCancelledError,
+                )
+
+                raise JobCancelledError("cancelled during checkpoint")
+            if coordinator.failure is not None:
+                raise coordinator.failure
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"checkpoint {checkpoint_id} timed out")
         if coordinator.failure is not None:
             raise coordinator.failure
         acks = coordinator.collected(checkpoint_id)
         # assemble logical snapshot
         positions = {who[1]: snap["position"]
                      for who, snap in acks.items() if who[0] == "source"}
-        # a single source subtask stores its position unwrapped, so the
+        # finished subtasks that were not in this trigger round still
+        # contribute their end-of-split position — omitting them would
+        # replay their whole split on restore
+        for s in sources:
+            if s.index not in positions and s.final_position is not None:
+                positions[s.index] = s.final_position
+        # a single-subtask source stores its position unwrapped, so the
         # snapshot is restorable by the single-slot executor too; S > 1
         # wraps per-subtask positions (only stage-mode can restore those)
-        if set(positions) == {0}:
+        if len(sources) == 1:
             source_state = {"source": positions[0]}
         else:
             source_state = {"source": {"__subtasks__": {
@@ -904,3 +1014,25 @@ class StageParallelExecutor:
         if storage is not None:
             storage.write_checkpoint(checkpoint_id, job_name, snap)
         return None
+
+
+def make_executor(config: Configuration, graph: StreamGraph):
+    """LocalExecutor unless ``execution.stage-parallelism`` is set AND the
+    graph is expandable — shared by env.execute() and
+    TaskExecutor.submit_task so local runs and cluster deployments pick
+    the same engine (reference: the scheduler, not the API, decides the
+    execution shape)."""
+    from flink_tpu.cluster.local_executor import LocalExecutor
+
+    if config.get(DeploymentOptions.STAGE_PARALLELISM) > 0:
+        try:
+            plan_stages(graph)
+        except StagePlanError as e:
+            import warnings
+
+            warnings.warn(
+                f"execution.stage-parallelism set but {e}; running "
+                "single-slot", stacklevel=2)
+        else:
+            return StageParallelExecutor(config)
+    return LocalExecutor(config)

@@ -141,40 +141,19 @@ class StreamExecutionEnvironment:
         reference: savepoint/restore CLI flow + claim modes."""
         import os
 
-        from flink_tpu.cluster.local_executor import LocalExecutor
-
         if restore_from is None:  # CLI `run --restore` injects via env
             restore_from = os.environ.get("FLINK_TPU_RESTORE_FROM") or None
             restore_mode = os.environ.get("FLINK_TPU_RESTORE_MODE",
                                           restore_mode)
         graph = self.get_stream_graph()
         config = self._effective_config()
-        from flink_tpu.core.config import DeploymentOptions
+        # subtask-expansion mode (execution.stage-parallelism > 0) expands
+        # the pipeline into source + keyed subtasks wired by the shuffle
+        # SPI; unsupported shapes fall back to single-slot with a warning
+        # (reference: ExecutionGraph parallel expansion / Execution.deploy)
+        from flink_tpu.cluster.stage_executor import make_executor
 
-        executor = LocalExecutor(config)
-        if config.get(DeploymentOptions.STAGE_PARALLELISM) > 0:
-            # subtask-expansion mode: source subtasks + N keyed subtasks
-            # wired by the shuffle SPI (reference: ExecutionGraph parallel
-            # expansion / Execution.deploy). Graph shapes the stage planner
-            # doesn't cover fall back to single-slot execution with a
-            # warning (reference: scheduler falls back rather than failing
-            # a runnable job).
-            from flink_tpu.cluster.stage_executor import (
-                StagePlanError,
-                StageParallelExecutor,
-                plan_stages,
-            )
-
-            try:
-                plan_stages(graph)
-            except StagePlanError as e:
-                import warnings
-
-                warnings.warn(
-                    f"execution.stage-parallelism set but {e}; running "
-                    "single-slot", stacklevel=2)
-            else:
-                executor = StageParallelExecutor(config)
+        executor = make_executor(config, graph)
         result = executor.run(graph, job_name=job_name,
                               restore_from=restore_from,
                               restore_mode=restore_mode)

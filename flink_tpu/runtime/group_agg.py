@@ -267,7 +267,15 @@ class GroupAggOperator(Operator):
         if cl is None and "row_counts" in state:
             # legacy (round-2 snapshot) slot-indexed format: only valid
             # when restoring into the same slot layout, which holds because
-            # the table rows above restored in snapshot order
+            # the table rows above restored in snapshot order — but NOT
+            # under a key-group filter, which compacts the table and
+            # misaligns every slot index
+            if key_group_filter is not None:
+                raise RuntimeError(
+                    "legacy slot-indexed changelog state cannot be "
+                    "restored with a key-group filter (stage-parallel "
+                    "restore) — take a fresh savepoint with the current "
+                    "version first")
             self._row_counts = np.asarray(state["row_counts"],
                                           dtype=np.int64)
             self._emitted_mask = np.asarray(state["emitted_mask"],

@@ -141,35 +141,35 @@ class LocalShuffleService(ShuffleService):
     """In-process transport: subtasks are threads, channels are bounded
     queues. Also the reference's default for its MiniCluster tests."""
 
-    def __init__(self):
+    def __init__(self, default_credits: int = 2):
         self._partitions: Dict[str, "_LocalPartition"] = {}
         self._lock = threading.Lock()
         self._cancelled = threading.Event()
+        self._default_credits = default_credits
 
     def cancel(self) -> None:
         """Release all producers blocked on credits (job teardown)."""
         self._cancelled.set()
 
-    def _partition(self, partition_id: str, num_subpartitions: int
-                   ) -> "_LocalPartition":
+    def _partition(self, partition_id: str, num_subpartitions: int,
+                   credits: Optional[int] = None) -> "_LocalPartition":
         with self._lock:
             part = self._partitions.get(partition_id)
             if part is None:
                 part = _LocalPartition(partition_id, num_subpartitions,
-                                       self._credits)
+                                       credits or self._default_credits)
                 self._partitions[partition_id] = part
             else:
                 # a gate may materialize the partition before its writer
-                # (the SPI mandates no ordering) — grow to the larger view
-                part.ensure(num_subpartitions, self._credits)
+                # (the SPI mandates no ordering) — grow to the larger view,
+                # re-crediting if the writer brought an explicit window
+                part.ensure(num_subpartitions, credits)
             return part
-
-    _credits = 2
 
     def create_partition(self, partition_id: str, num_subpartitions: int,
                          credits_per_channel: int = 2) -> "LocalWriter":
-        self._credits = credits_per_channel
-        part = self._partition(partition_id, num_subpartitions)
+        part = self._partition(partition_id, num_subpartitions,
+                               credits=credits_per_channel)
         return LocalWriter(part, self._cancelled)
 
     def create_gate(self, partition_ids: Sequence[str], subpartition: int
@@ -183,14 +183,26 @@ class _LocalPartition:
     def __init__(self, partition_id: str, num_subpartitions: int,
                  credits_per_channel: int):
         self.partition_id = partition_id
+        self.credits = credits_per_channel
         self.subpartitions = [
             _Subpartition(credits_per_channel)
             for _ in range(num_subpartitions)
         ]
 
-    def ensure(self, num: int, credits: int) -> None:
+    def ensure(self, num: int, credits: Optional[int] = None) -> None:
+        """Grow to ``num`` subpartitions. A WIDER credit window from the
+        writer grants the extra permits to channels materialized
+        gate-first with the default (gates hold channel references, so
+        the semaphore is adjusted in place; narrowing is not supported —
+        outstanding credits cannot be revoked)."""
+        if credits is not None and credits > self.credits:
+            extra = credits - self.credits
+            self.credits = credits
+            for sp in self.subpartitions:
+                for _ in range(extra):
+                    sp.credits.release()
         while len(self.subpartitions) < num:
-            self.subpartitions.append(_Subpartition(credits))
+            self.subpartitions.append(_Subpartition(self.credits))
 
 
 class LocalWriter(ResultPartitionWriter):

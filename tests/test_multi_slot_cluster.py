@@ -1,0 +1,157 @@
+"""Jobs spanning task executors: multi-slot acquisition, reactive scale-to-
+resources, and failover when any participating executor dies (reference:
+SlotSharingExecutionSlotAllocator + region failover, exercised like the
+reference's recovery ITCases)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu import Configuration
+from flink_tpu.cluster.minicluster import MiniCluster
+from flink_tpu.connectors.sinks import CollectSink, JsonLinesFileSink
+from flink_tpu.connectors.sources import DataGenSource
+from flink_tpu.datastream.environment import StreamExecutionEnvironment
+from flink_tpu.runtime.watermarks import WatermarkStrategy
+from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+
+def _graph(env, sink, total=30_000, slow=False):
+    class SlowGen(DataGenSource):
+        def poll_batch(self, n):
+            time.sleep(0.02)
+            return super().poll_batch(n)
+
+    cls = SlowGen if slow else DataGenSource
+    src = cls(total_records=total, num_keys=200,
+              events_per_second_of_eventtime=10_000, seed=9)
+    env.from_source(src,
+                    WatermarkStrategy.for_bounded_out_of_orderness(0),
+                    name="gen") \
+        .key_by("key").window(TumblingEventTimeWindows.of(1000)) \
+        .sum("value").sink_to(sink)
+    return env.get_stream_graph()
+
+
+def _expected(total=30_000):
+    env = StreamExecutionEnvironment(Configuration({
+        "execution.micro-batch.size": 1000}))
+    sink = CollectSink()
+    _graph(env, sink, total=total)
+    env.execute("oracle")
+    return {(r["key"], r["window_start"]): round(r["sum_value"], 3)
+            for r in sink.result().to_rows()}
+
+
+def _rows(path):
+    return {(r["key"], r["window_start"]): round(r["sum_value"], 3)
+            for r in JsonLinesFileSink.read_rows(path)}
+
+
+class TestMultiSlotJobs:
+    def test_job_spans_executors(self, tmp_path):
+        """stage-parallelism 3 on a 2x2-slot cluster: slots come from BOTH
+        executors while the job runs."""
+        cluster = MiniCluster(Configuration({
+            "cluster.task-executors": 2,
+            "taskmanager.numberOfTaskSlots": 2,
+            "rest.port": -1,
+        }))
+        try:
+            out = str(tmp_path / "out.jsonl")
+            env = StreamExecutionEnvironment(Configuration({
+                "execution.micro-batch.size": 1000,
+                "execution.stage-parallelism": 3,
+            }))
+            _graph(env, JsonLinesFileSink(out), slow=True)
+            client = cluster.submit(env, "spanning")
+            # while running, 3 slots must be allocated, necessarily from
+            # both executors (each has only 2 slots)
+            allocated = {}
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                allocated = {
+                    eid: info["allocated"]
+                    for eid, info in cluster.rm._executors.items()}
+                if sum(allocated.values()) >= 3:
+                    break
+                time.sleep(0.02)
+            assert sum(allocated.values()) >= 3, allocated
+            assert sum(1 for v in allocated.values() if v > 0) == 2, \
+                f"job must span both executors: {allocated}"
+            status = client.wait(timeout=120)
+            assert status["status"] == "FINISHED"
+            assert _rows(out) == _expected()
+            # slots released after completion
+            assert sum(i["allocated"]
+                       for i in cluster.rm._executors.values()) == 0
+        finally:
+            cluster.shutdown()
+
+    def test_scales_to_available_slots(self, tmp_path):
+        """stage-parallelism 5 on a cluster with 3 slots total runs at an
+        effective parallelism of 3 (reactive scale-to-resources)."""
+        cluster = MiniCluster(Configuration({
+            "cluster.task-executors": 3,
+            "taskmanager.numberOfTaskSlots": 1,
+            "rest.port": -1,
+        }))
+        try:
+            out = str(tmp_path / "out.jsonl")
+            env = StreamExecutionEnvironment(Configuration({
+                "execution.micro-batch.size": 1000,
+                "execution.stage-parallelism": 5,
+            }))
+            _graph(env, JsonLinesFileSink(out))
+            client = cluster.submit(env, "scaled")
+            status = client.wait(timeout=120)
+            assert status["status"] == "FINISHED"
+            result = client.result()
+            assert result.metrics["stage_parallelism"] == 3
+            assert _rows(out) == _expected()
+        finally:
+            cluster.shutdown()
+
+    def test_participating_executor_death_fails_over(self, tmp_path):
+        """Killing a NON-primary executor holding one of the job's slots
+        restarts the job from the latest checkpoint on the survivors."""
+        ckpt = str(tmp_path / "ckpts")
+        cluster = MiniCluster(Configuration({
+            "cluster.task-executors": 3,
+            "taskmanager.numberOfTaskSlots": 1,
+            "heartbeat.timeout-ms": 400,
+            "rest.port": -1,
+        }))
+        try:
+            out = str(tmp_path / "out.jsonl")
+            env = StreamExecutionEnvironment(Configuration({
+                "execution.micro-batch.size": 1000,
+                "execution.stage-parallelism": 3,
+                "state.checkpoints.dir": ckpt,
+                "execution.checkpointing.every-n-source-batches": 4,
+                "restart-strategy.max-attempts": 3,
+                "restart-strategy.delay-ms": 50,
+            }))
+            _graph(env, JsonLinesFileSink(out), total=60_000, slow=True)
+            client = cluster.submit(env, "failover")
+            # wait until all three slots are held, then kill a non-primary
+            deadline = time.monotonic() + 20
+            master = cluster.dispatcher.master(client.job_id)
+            while time.monotonic() < deadline:
+                if sum(i["allocated"] for i in
+                       cluster.rm._executors.values()) >= 3 and \
+                        master.status == "RUNNING":
+                    break
+                time.sleep(0.02)
+            primary = master._current_executor
+            victim = next(eid for eid in cluster.rm._executors
+                          if eid != primary)
+            time.sleep(0.3)  # let a checkpoint land
+            cluster.kill_task_executor(victim)
+            status = client.wait(timeout=180)
+            assert status["status"] == "FINISHED"
+            assert master.attempt >= 1, "job must have restarted"
+            assert _rows(out) == _expected(total=60_000)
+        finally:
+            cluster.shutdown()
