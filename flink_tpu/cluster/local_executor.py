@@ -253,11 +253,26 @@ class LocalExecutor:
         """
         from flink_tpu.datastream.environment import JobExecutionResult
 
+        from flink_tpu.core.config import ExecutionModeOptions
+
         batch_size = self.config.get(BatchOptions.BATCH_SIZE)
         max_parallelism = self.config.get(CoreOptions.MAX_PARALLELISM)
         ckpt_interval = self.config.get(CheckpointOptions.INTERVAL_MS)
         ckpt_every_n = self.config.get(CheckpointOptions.EVERY_N_BATCHES)
         ckpt_dir = self.config.get(StateOptions.CHECKPOINT_DIR)
+        # bounded/batch mode: no intermediate watermarks — every window
+        # and aggregate fires exactly once at end-of-input (reference:
+        # RuntimeExecutionMode.BATCH; the MAX watermark at source
+        # exhaustion is the single "end of time" event)
+        batch_mode = self.config.get(
+            ExecutionModeOptions.RUNTIME_MODE) == "batch"
+        if batch_mode:
+            for t in graph.sources:
+                if not getattr(t.source, "bounded", True):
+                    raise RuntimeError(
+                        "execution.runtime-mode=batch requires bounded "
+                        f"sources; {t.name!r} is unbounded (reference: "
+                        "batch mode rejects unbounded sources)")
         storage = None
         if ckpt_dir and (ckpt_interval or ckpt_every_n):
             from flink_tpu.checkpoint.storage import CheckpointStorage
@@ -391,10 +406,19 @@ class LocalExecutor:
                 pumps[t.uid] = _SourcePump(t, batch_size, in_flight)
             for p in pumps.values():
                 p.start()
+        # wall-clock tick targets (processing-time windows/timers)
+        pt_nodes = [n for n in nodes.values()
+                    if n.operator is not None
+                    and getattr(n.operator, "uses_processing_time", False)]
         try:
             while active:
                 if cancel_event is not None and cancel_event.is_set():
                     raise JobCancelledError(job_name)
+                if pt_nodes:
+                    now_ms = int(time.time() * 1000)
+                    for n in pt_nodes:
+                        for out in n.operator.on_processing_time(now_ms):
+                            self._forward(n, out)
                 progressed = False
                 for t, node in sources:
                     if t.uid not in active:
@@ -430,7 +454,7 @@ class LocalExecutor:
                     source_positions[t.uid] = pos
                     tb = time.perf_counter() if debloater else 0.0
                     self._emit_batch(node, batch)
-                    if wm is not None:
+                    if wm is not None and not batch_mode:
                         self._emit_watermark(node, wm)
                     if debloater is not None:
                         new_size = debloater.observe(

@@ -160,6 +160,9 @@ def _merge_changelog(values: List[Dict[str, Any]]) -> Dict[str, Any]:
         "count": np.concatenate([np.asarray(v["count"]) for v in values]),
         "emitted": np.concatenate([np.asarray(v["emitted"])
                                    for v in values]),
+        "dirty": np.concatenate([
+            np.asarray(v.get("dirty", np.zeros(len(k), bool)))
+            for v, k in zip(values, kid)]),
         "last": last,
     }
 
@@ -294,6 +297,35 @@ class _OperatorChain:
         # trailing emissions past the last operator are dropped only if the
         # last op emitted (sinks emit nothing)
 
+    @property
+    def uses_processing_time(self) -> bool:
+        return any(getattr(op, "uses_processing_time", False)
+                   for op in self.operators if op is not None)
+
+    def tick_processing_time(self, now_ms: int, emit=None) -> None:
+        """Wall-clock tick: fire processing-time windows/timers and push
+        their output through the rest of the chain. ``emit`` receives
+        batches that survive past the LAST operator (source-stage chains
+        end at the keyed exchange, not a sink)."""
+        for i, op in enumerate(self.operators):
+            if op is None or not getattr(op, "uses_processing_time", False):
+                continue
+            outs = op.on_processing_time(now_ms)
+            for out in outs:
+                cur = [out]
+                for op2 in self.operators[i + 1:]:
+                    if op2 is None:
+                        continue
+                    nxt: List[RecordBatch] = []
+                    for b in cur:
+                        nxt.extend(op2.process_batch(b))
+                    cur = nxt
+                    if not cur:
+                        break
+                if emit is not None:
+                    for b in cur:
+                        emit(b)
+
     def close(self) -> None:
         carried: List[RecordBatch] = []
         for op in self.operators:
@@ -359,8 +391,15 @@ class _SourceSubtask(threading.Thread):
                  graph: StreamGraph, writer, num_keyed: int,
                  max_parallelism: int, batch_size: int,
                  coordinator: "_Coordinator", source,
-                 restore_position=None):
+                 restore_position=None, batch_mode: bool = False):
         super().__init__(name=f"source-subtask-{index}", daemon=True)
+        #: bounded/batch execution: no intermediate watermarks, and
+        #: sub-batches coalesce into bulk blocks per subpartition before
+        #: emission (the SortMergeResultPartition role — batch shuffle
+        #: optimizes for throughput, not latency)
+        self.batch_mode = batch_mode
+        self._pending: Dict[int, List[RecordBatch]] = {}
+        self._pending_rows: Dict[int, int] = {}
         self.index = index
         self.parallelism = parallelism
         self.plan = plan
@@ -400,6 +439,7 @@ class _SourceSubtask(threading.Thread):
             self.source.restore_position(self.restore_position)
         key_field = plan.key_field
         stopping = False
+        ticks_pt = self.chain.uses_processing_time
         try:
             while not stopping:
                 stopping = self._serve_control()
@@ -407,6 +447,13 @@ class _SourceSubtask(threading.Thread):
                     break
                 if self.coordinator.cancelled.is_set():
                     return
+                if ticks_pt:
+                    # pre-chain processing-time timers fire on the wall
+                    # clock even between batches (parity with the
+                    # single-slot executor's tick)
+                    self.chain.tick_processing_time(
+                        int(time.time() * 1000),
+                        emit=lambda b: self._emit_partitioned(b, key_field))
                 batch = self.source.poll_batch(self.batch_size)
                 if batch is None:
                     break
@@ -418,11 +465,12 @@ class _SourceSubtask(threading.Thread):
                 wm = self.wm_gen.on_batch(batch)
                 for out in self.chain.process_batch(batch):
                     self._emit_partitioned(out, key_field)
-                if wm is not None:
+                if wm is not None and not self.batch_mode:
                     self.writer.broadcast_event(int(wm))
         finally:
             self.final_position = self.source.snapshot_position()
             self.source.close()
+        self._flush_pending()
         # a barrier enqueued while this loop was finishing must still be
         # served (position + ack + in-band broadcast) before EOP — the
         # coordinator synthesizes acks only for barriers that arrive after
@@ -446,9 +494,30 @@ class _SourceSubtask(threading.Thread):
             groups, self.max_parallelism, self.num_keyed)
         for sub in range(self.num_keyed):
             mask = targets == sub
-            if mask.any():
-                self.writer.emit(sub, batch.filter(mask))
-                self.records_out += int(mask.sum())
+            if not mask.any():
+                continue
+            part = batch.filter(mask)
+            self.records_out += len(part)
+            if not self.batch_mode:
+                self.writer.emit(sub, part)
+                continue
+            # batch mode: coalesce into bulk blocks (fewer, larger
+            # transfers — the batch-shuffle trade)
+            self._pending.setdefault(sub, []).append(part)
+            n = self._pending_rows.get(sub, 0) + len(part)
+            if n >= self.batch_size:
+                self.writer.emit(sub, RecordBatch.concat(
+                    self._pending.pop(sub)))
+                self._pending_rows[sub] = 0
+            else:
+                self._pending_rows[sub] = n
+
+    def _flush_pending(self) -> None:
+        for sub, parts in sorted(self._pending.items()):
+            if parts:
+                self.writer.emit(sub, RecordBatch.concat(parts))
+        self._pending.clear()
+        self._pending_rows.clear()
 
     def _serve_control(self) -> bool:
         """Returns True when the job should stop (stop-with-savepoint)."""
@@ -464,6 +533,10 @@ class _SourceSubtask(threading.Thread):
                         self.graph, savepoint=barrier.savepoint is not None)}
             self.coordinator.ack(barrier.checkpoint_id,
                                  ("source", self.index), snap)
+            # coalesced batch-mode blocks hold pre-barrier records — they
+            # must reach the channels BEFORE the barrier or they would be
+            # cut out of the snapshot yet covered by the position
+            self._flush_pending()
             self.writer.broadcast_event(barrier)
             if barrier.stop:
                 stopping = True
@@ -534,10 +607,13 @@ class _KeyedSubtask(threading.Thread):
                     combined = new
                     self.chain.process_watermark(combined)
 
+        ticks_pt = self.chain.uses_processing_time
         while True:
             self._serve_queries()
             if self.coordinator.cancelled.is_set():
                 return
+            if ticks_pt:
+                self.chain.tick_processing_time(int(time.time() * 1000))
             entry = self.gate.poll(timeout=0.05)
             if entry is None:
                 continue
@@ -688,12 +764,36 @@ class StageParallelExecutor:
         from flink_tpu.datastream.environment import JobExecutionResult
 
         self._cancel_event = cancel_event
+        from flink_tpu.core.config import ExecutionModeOptions
+
         plan = plan_stages(graph)
         cfg = self.config
         N = cfg.get(DeploymentOptions.STAGE_PARALLELISM)
         S = cfg.get(DeploymentOptions.SOURCE_PARALLELISM)
         max_par = cfg.get(CoreOptions.MAX_PARALLELISM)
         batch_size = cfg.get(BatchOptions.BATCH_SIZE)
+        batch_mode = cfg.get(
+            ExecutionModeOptions.RUNTIME_MODE) == "batch"
+        if batch_mode and not getattr(plan.source.source, "bounded", True):
+            raise RuntimeError(
+                "execution.runtime-mode=batch requires bounded sources; "
+                f"{plan.source.name!r} is unbounded")
+        if N == -1:
+            # adaptive batch parallelism: size the keyed stage from the
+            # estimated source volume (reference: AdaptiveBatchScheduler
+            # decides parallelism from produced data volume)
+            if not batch_mode:
+                raise StagePlanError(
+                    "execution.stage-parallelism=-1 (adaptive) requires "
+                    "execution.runtime-mode=batch")
+            est = plan.source.source.estimate_records()
+            target = cfg.get(
+                ExecutionModeOptions.TARGET_RECORDS_PER_SUBTASK)
+            if target < 1:
+                raise StagePlanError(
+                    "execution.batch.target-records-per-subtask must be "
+                    f">= 1, got {target}")
+            N = max(1, min(-(-int(est) // target) if est else 1, max_par))
         if N < 1:
             raise StagePlanError("execution.stage-parallelism must be >= 1")
 
@@ -783,7 +883,8 @@ class StageParallelExecutor:
             sources.append(_SourceSubtask(
                 i, S, plan, graph, writers[i], N, max_par, batch_size,
                 coordinator, src,
-                restore_position=restore_positions.get(i)))
+                restore_position=restore_positions.get(i),
+                batch_mode=batch_mode))
         shared_sinks: Dict[int, _SharedSink] = {}
         keyed = [_KeyedSubtask(j, N, plan, graph, gates[j], max_par,
                                coordinator, cfg, shared_sinks=shared_sinks)
@@ -1024,7 +1125,8 @@ def make_executor(config: Configuration, graph: StreamGraph):
     execution shape)."""
     from flink_tpu.cluster.local_executor import LocalExecutor
 
-    if config.get(DeploymentOptions.STAGE_PARALLELISM) > 0:
+    sp = config.get(DeploymentOptions.STAGE_PARALLELISM)
+    if sp == -1 or sp > 0:
         try:
             plan_stages(graph)
         except StagePlanError as e:

@@ -22,6 +22,12 @@ class Source:
 
     bounded: bool = True
 
+    def estimate_records(self) -> Optional[int]:
+        """Best-effort size estimate for adaptive batch parallelism
+        (reference: the adaptive batch scheduler sizes parallelism from
+        produced data volume). None = unknown."""
+        return None
+
     def open(self, subtask_index: int = 0, parallelism: int = 1) -> None:
         pass
 
@@ -46,6 +52,9 @@ class CollectionSource(Source):
     def __init__(self, batches: Sequence[RecordBatch]):
         self.batches = list(batches)
         self._i = 0
+
+    def estimate_records(self) -> Optional[int]:
+        return sum(len(b) for b in self.batches)
 
     @staticmethod
     def of_rows(rows: Iterable[dict], batch_size: int = 8192) -> "CollectionSource":
@@ -89,6 +98,9 @@ class DataGenSource(Source):
         self.skew = skew
         self._emitted = 0
         self._rng = np.random.default_rng(seed)
+
+    def estimate_records(self) -> Optional[int]:
+        return self.total
 
     def open(self, subtask_index=0, parallelism=1):
         self._rng = np.random.default_rng(self.seed + subtask_index)

@@ -175,6 +175,29 @@ class BatchOptions:
         "0 = poll sources inline on the task loop.")
 
 
+class ExecutionModeOptions:
+    """Bounded/batch execution (reference: RuntimeExecutionMode.BATCH,
+    the adaptive batch scheduler deciding parallelism from data volume —
+    scheduler/adaptivebatch/AdaptiveBatchScheduler.java — and bulk batch
+    shuffle — SortMergeResultPartition.java)."""
+
+    RUNTIME_MODE = ConfigOption(
+        "execution.runtime-mode", default="streaming", type=str,
+        description="'streaming' (default) or 'batch'. Batch mode requires "
+        "bounded sources, suppresses intermediate watermarks (every "
+        "window/aggregate fires exactly once at end-of-input), and ships "
+        "coalesced bulk blocks through the shuffle instead of "
+        "latency-sized micro-batches.")
+    TARGET_RECORDS_PER_SUBTASK = ConfigOption(
+        "execution.batch.target-records-per-subtask", default=1_000_000,
+        type=int,
+        description="Adaptive batch parallelism: with "
+        "execution.stage-parallelism=-1 in batch mode, the keyed stage "
+        "parallelism is ceil(estimated source records / this target), "
+        "like the reference's adaptive batch scheduler deciding "
+        "parallelism from produced data volume.")
+
+
 class DeploymentOptions:
     """Subtask-expansion execution (reference: ExecutionGraph parallel
     expansion — DefaultExecutionGraph / Execution.deploy — where every

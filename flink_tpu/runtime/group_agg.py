@@ -221,6 +221,13 @@ class GroupAggOperator(Operator):
         # union — same portability contract as the slot table rows)
         interesting = np.nonzero((self._row_counts != 0)
                                  | self._emitted_mask)[0]
+        # minibatch emission state: slots whose change is still pending a
+        # watermark flush must survive a restore or their final rows would
+        # be silently lost (batch mode defers ALL emission to end-of-input)
+        dirty = np.zeros(len(interesting), dtype=bool)
+        if self._dirty:
+            dirty = np.isin(interesting,
+                            np.fromiter(self._dirty, dtype=np.int64))
         return {
             "key_values": dict(self._key_values),
             "keys_hashed": self._keys_hashed,
@@ -229,6 +236,7 @@ class GroupAggOperator(Operator):
                 "key_id": self.table.keys_of_slots(interesting),
                 "count": self._row_counts[interesting],
                 "emitted": self._emitted_mask[interesting],
+                "dirty": dirty,
                 "last": {n: a[interesting]
                          for n, a in self._last_emitted.items()},
             },
@@ -289,13 +297,15 @@ class GroupAggOperator(Operator):
         key_ids = np.asarray(cl["key_id"], dtype=np.int64)
         counts = np.asarray(cl["count"], dtype=np.int64)
         emitted = np.asarray(cl["emitted"], dtype=bool)
+        dirty = np.asarray(cl.get("dirty", np.zeros(len(key_ids), bool)),
+                           dtype=bool)
         if key_group_filter is not None:
             from flink_tpu.state.keygroups import assign_key_groups
 
             groups = assign_key_groups(key_ids, self.table.max_parallelism)
             keep = np.isin(groups, np.asarray(sorted(key_group_filter)))
-            key_ids, counts, emitted = (key_ids[keep], counts[keep],
-                                        emitted[keep])
+            key_ids, counts, emitted, dirty = (
+                key_ids[keep], counts[keep], emitted[keep], dirty[keep])
             cl_last = {n: np.asarray(a)[keep]
                        for n, a in cl.get("last", {}).items()}
         else:
@@ -308,6 +318,7 @@ class GroupAggOperator(Operator):
         self._ensure_host_capacity(int(slots.max()) + 1)
         self._row_counts[slots] = counts
         self._emitted_mask[slots] = emitted
+        self._dirty.update(int(s) for s in slots[dirty])
         for n, a in cl_last.items():
             arr = np.zeros(len(self._row_counts), dtype=a.dtype)
             arr[slots] = a

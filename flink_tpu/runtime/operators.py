@@ -44,6 +44,15 @@ class Operator:
                           ) -> List[RecordBatch]:
         return []
 
+    #: operators that react to wall-clock ticks (processing-time windows /
+    #: timers) set this so the executor loop knows to tick them
+    uses_processing_time: bool = False
+
+    def on_processing_time(self, now_ms: int) -> List[RecordBatch]:
+        """Wall-clock tick (reference: WindowOperator.onProcessingTime:497 /
+        InternalTimerService processing-time timers)."""
+        return []
+
     def close(self) -> List[RecordBatch]:
         return []
 
@@ -142,6 +151,11 @@ class WindowAggOperator(Operator):
         self.allowed_lateness = allowed_lateness
         self.spill = spill
         self.fire_projector = fire_projector
+        #: processing-time assigner: records are stamped with wall-clock
+        #: arrival time; fires come from on_processing_time ticks
+        #: (reference: WindowOperator.onProcessingTime:497)
+        self.uses_processing_time = bool(
+            getattr(assigner, "is_processing_time", False))
         self.windower: Optional[SliceSharedWindower] = None
         self._key_values: Dict[int, Any] = {}  # key_id -> original key value
         self._keys_hashed = False
@@ -205,16 +219,38 @@ class WindowAggOperator(Operator):
                 for i, j in zip(uniq.tolist(), first.tolist()):
                     if i not in kv:
                         kv[i] = keys[j]
+        if self.uses_processing_time:
+            import time as _time
+
+            # arrival time IS the record time in the processing-time
+            # domain — a whole micro-batch arrives at one instant
+            now = int(_time.time() * 1000)
+            batch = batch.with_timestamps(
+                np.full(len(batch), now, dtype=np.int64))
         self.windower.process_batch(batch)
         return []
 
     def process_watermark(self, watermark, input_index=0):
+        from flink_tpu.runtime.elements import MAX_WATERMARK
+
+        if self.uses_processing_time and watermark < MAX_WATERMARK:
+            # event-time watermarks don't drive processing-time windows;
+            # only the end-of-input MAX flushes what remains (reference:
+            # processing-time windows fire on close at endOfInput)
+            return []
         import time as _time
 
         t0 = _time.perf_counter()
         fired = self.windower.on_watermark(watermark)
         if fired:
             self.fire_latencies_ms.append((_time.perf_counter() - t0) * 1e3)
+        return [self._reattach_keys(b) for b in fired]
+
+    def on_processing_time(self, now_ms: int):
+        if not self.uses_processing_time:
+            return []
+        # window [start, end) is complete once the wall clock passes end
+        fired = self.windower.on_watermark(now_ms - 1)
         return [self._reattach_keys(b) for b in fired]
 
     def _reattach_keys(self, batch: RecordBatch) -> RecordBatch:

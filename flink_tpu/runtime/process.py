@@ -282,6 +282,16 @@ class ProcessOperator(Operator):
         self._drain_processing_time(ctx)
         return ctx.out
 
+    #: processing-time timers must fire on an idle stream too — the
+    #: executor's wall-clock tick drives them between batches (reference:
+    #: ProcessingTimeService scheduled triggers)
+    uses_processing_time = True
+
+    def on_processing_time(self, now_ms: int):
+        ctx = self._ctx()
+        self._drain_processing_time(ctx)
+        return ctx.out
+
     def close(self):
         ctx = self._ctx()
         self.fn.close(ctx)

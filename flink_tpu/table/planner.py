@@ -361,10 +361,18 @@ class Planner:
                 multi, name=f"sql_{window.kind.lower()}_agg")
         else:
             capacity = self.env.state_slot_capacity
+            from flink_tpu.core.config import ExecutionModeOptions
+
+            # batch mode: one changelog row per group at end-of-input
+            # instead of per-micro-batch upsert churn (reference: batch
+            # mode runs GROUP BY as a bounded aggregate, emitting finals)
+            batch_final = self.env.config.get(
+                ExecutionModeOptions.RUNTIME_MODE) == "batch"
             t = Transformation(
                 name="sql_group_agg", kind="one_input",
                 operator_factory=lambda: GroupAggOperator(
-                    multi, key_field, capacity=capacity),
+                    multi, key_field, capacity=capacity,
+                    emit_on_watermark_only=batch_final),
                 inputs=[keyed.transformation], keyed=True,
                 key_field=key_field)
             agged = DataStream(self.env, t)

@@ -13,6 +13,8 @@ from flink_tpu.windowing.assigners import (
     SlidingEventTimeWindows,
     CumulativeEventTimeWindows,
     EventTimeSessionWindows,
+    TumblingProcessingTimeWindows,
+    SlidingProcessingTimeWindows,
 )
 
 __all__ = [
@@ -25,6 +27,8 @@ __all__ = [
     "AvgAggregate",
     "MultiAggregate",
     "TumblingEventTimeWindows",
+    "TumblingProcessingTimeWindows",
+    "SlidingProcessingTimeWindows",
     "SlidingEventTimeWindows",
     "CumulativeEventTimeWindows",
     "EventTimeSessionWindows",

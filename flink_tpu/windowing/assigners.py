@@ -34,6 +34,9 @@ class WindowAssigner:
     slide: int           # distance between consecutive window ends (ms)
     slice_width: int     # width of one slice (ms)
     offset: int = 0
+    #: True for wall-clock (arrival-time) assigners — fires are driven by
+    #: processing-time ticks instead of watermarks
+    is_processing_time = False
 
     @property
     def slices_per_window(self) -> int:
@@ -109,6 +112,32 @@ class SlidingEventTimeWindows(WindowAssigner):
     @staticmethod
     def of(size_ms: int, slide_ms: int, offset_ms: int = 0) -> "SlidingEventTimeWindows":
         return SlidingEventTimeWindows(size_ms, slide_ms, offset_ms)
+
+
+class TumblingProcessingTimeWindows(TumblingEventTimeWindows):
+    """Windows over WALL-CLOCK arrival time (reference:
+    TumblingProcessingTimeWindows.java + WindowOperator.onProcessingTime:497).
+    Records are assigned by the time they reach the operator; fires are
+    driven by the executor's processing-time ticks, not watermarks."""
+
+    is_processing_time = True
+
+    @staticmethod
+    def of(size_ms: int, offset_ms: int = 0
+           ) -> "TumblingProcessingTimeWindows":
+        return TumblingProcessingTimeWindows(size_ms, offset_ms)
+
+
+class SlidingProcessingTimeWindows(SlidingEventTimeWindows):
+    """reference: SlidingProcessingTimeWindows.java — HOP over arrival
+    time, slice-shared like the event-time form."""
+
+    is_processing_time = True
+
+    @staticmethod
+    def of(size_ms: int, slide_ms: int, offset_ms: int = 0
+           ) -> "SlidingProcessingTimeWindows":
+        return SlidingProcessingTimeWindows(size_ms, slide_ms, offset_ms)
 
 
 class CumulativeEventTimeWindows(WindowAssigner):

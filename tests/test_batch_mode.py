@@ -1,0 +1,130 @@
+"""Bounded/batch execution mode (reference: RuntimeExecutionMode.BATCH,
+AdaptiveBatchScheduler, SortMergeResultPartition bulk shuffle)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu import Configuration, StreamExecutionEnvironment
+from flink_tpu.connectors.sinks import CollectSink
+from flink_tpu.connectors.sources import DataGenSource, Source
+from flink_tpu.runtime.watermarks import WatermarkStrategy
+from flink_tpu.windowing.assigners import (
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+)
+
+
+def _window_job(env, sink, assigner, total=20_000):
+    src = DataGenSource(total_records=total, num_keys=100,
+                        events_per_second_of_eventtime=10_000, seed=2)
+    env.from_source(src,
+                    WatermarkStrategy.for_bounded_out_of_orderness(0),
+                    name="gen") \
+        .key_by("key").window(assigner).sum("value").sink_to(sink)
+
+
+def _res(sink):
+    return {(r["key"], r["window_start"]): round(r["sum_value"], 3)
+            for r in sink.result().to_rows()}
+
+
+class TestBatchMode:
+    @pytest.mark.parametrize("stage_par", [0, 4])
+    def test_same_results_as_streaming(self, stage_par):
+        stream_sink = CollectSink()
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 1000}))
+        _window_job(env, stream_sink, SlidingEventTimeWindows.of(2000, 500))
+        env.execute("streaming")
+
+        batch_sink = CollectSink()
+        env2 = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 1000,
+            "execution.runtime-mode": "batch",
+            "execution.stage-parallelism": stage_par}))
+        _window_job(env2, batch_sink, SlidingEventTimeWindows.of(2000, 500))
+        env2.execute("batch")
+        assert _res(batch_sink) == _res(stream_sink)
+
+    def test_single_fire_per_window(self):
+        """In batch mode every window fires exactly once (no intermediate
+        watermarks), so the sink sees exactly one row per (key, window)."""
+        sink = CollectSink()
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 1000,
+            "execution.runtime-mode": "batch"}))
+        _window_job(env, sink, TumblingEventTimeWindows.of(1000))
+        env.execute("batch")
+        rows = sink.result().to_rows()
+        keys = [(r["key"], r["window_start"]) for r in rows]
+        assert len(keys) == len(set(keys))
+
+    def test_unbounded_source_rejected(self):
+        class Endless(Source):
+            bounded = False
+
+            def poll_batch(self, n):
+                import numpy as np
+
+                from flink_tpu.core.records import RecordBatch
+
+                return RecordBatch.from_pydict(
+                    {"key": np.zeros(1, dtype=np.int64),
+                     "value": np.ones(1, dtype=np.float32)},
+                    timestamps=[0])
+
+        for stage_par in (0, 2):
+            env = StreamExecutionEnvironment(Configuration({
+                "execution.runtime-mode": "batch",
+                "execution.stage-parallelism": stage_par}))
+            sink = CollectSink()
+            env.from_source(Endless(),
+                            WatermarkStrategy.for_bounded_out_of_orderness(0)) \
+                .key_by("key").window(TumblingEventTimeWindows.of(1000)) \
+                .sum("value").sink_to(sink)
+            with pytest.raises(RuntimeError, match="unbounded"):
+                env.execute("rejected")
+
+    def test_adaptive_batch_parallelism(self):
+        """stage-parallelism=-1 sizes the keyed stage from the source's
+        estimated volume (reference: AdaptiveBatchScheduler)."""
+        sink = CollectSink()
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 1000,
+            "execution.runtime-mode": "batch",
+            "execution.stage-parallelism": -1,
+            "execution.batch.target-records-per-subtask": 5_000}))
+        _window_job(env, sink, TumblingEventTimeWindows.of(1000),
+                    total=20_000)
+        result = env.execute("adaptive")
+        assert result.metrics["stage_parallelism"] == 4  # ceil(20k/5k)
+
+        # streaming mode rejects the adaptive sentinel
+        env2 = StreamExecutionEnvironment(Configuration({
+            "execution.stage-parallelism": -1}))
+        sink2 = CollectSink()
+        _window_job(env2, sink2, TumblingEventTimeWindows.of(1000))
+        with pytest.raises(Exception, match="adaptive"):
+            env2.execute("bad")
+
+    def test_batch_sql_group_agg_emits_finals_only(self):
+        from flink_tpu.table.environment import StreamTableEnvironment
+
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 4,
+            "execution.runtime-mode": "batch"}))
+        t_env = StreamTableEnvironment(env)
+        rows = [{"auction": a, "ts": i * 100}
+                for i, a in enumerate([1, 2, 1, 1, 2, 3])]
+        t_env.create_temporary_view(
+            "bid", t_env.from_collection(rows, timestamp_field="ts"))
+        table = t_env.sql_query(
+            "SELECT auction, COUNT(*) AS n FROM bid GROUP BY auction")
+        sink = CollectSink()
+        table.to_data_stream().sink_to(sink)
+        env.execute("batch-groupby")
+        raw = sink.result().to_rows()
+        # exactly one changelog row per group — no per-micro-batch churn
+        assert len(raw) == 3
+        got = {r["auction"]: r["n"] for r in raw}
+        assert got == {1: 3, 2: 2, 3: 1}
