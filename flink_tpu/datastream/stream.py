@@ -69,6 +69,29 @@ class DataStream:
                            inputs=[self.transformation])
         return DataStream(self.env, t)
 
+    def ml_predict(self, model, input_fields=None, output_prefix: str = "",
+                   asynchronous: bool = False, capacity: int = 4,
+                   name: str = "ml_predict") -> "DataStream":
+        """Batched model inference appending the model's output columns
+        (reference: SQL ML_PREDICT / MLPredictRunner; flink-models). With
+        ``asynchronous=True``, inference overlaps upstream work under a
+        bounded in-flight budget (AsyncMLPredictRunner)."""
+        from flink_tpu.ml.operators import (
+            AsyncMLPredictOperator,
+            MLPredictOperator,
+        )
+
+        if asynchronous:
+            factory = lambda: AsyncMLPredictOperator(  # noqa: E731
+                model, input_fields, output_prefix, capacity=capacity)
+        else:
+            factory = lambda: MLPredictOperator(  # noqa: E731
+                model, input_fields, output_prefix)
+        t = Transformation(name=name, kind="one_input",
+                           operator_factory=factory,
+                           inputs=[self.transformation])
+        return DataStream(self.env, t)
+
     def filter(self, predicate: Callable[[RecordBatch], np.ndarray],
                name: str = "filter") -> "DataStream":
         t = Transformation(name=name, kind="one_input",

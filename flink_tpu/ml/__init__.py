@@ -1,0 +1,42 @@
+"""ML model functions — batched inference inside the pipeline.
+
+reference: flink-models (flink-model-openai chat/embedding client,
+flink-model-triton REST client, ~4.6k LoC) invoked from SQL ``ML_PREDICT``
+via flink-table-runtime/.../operators/ml/MLPredictRunner.java (sync, one
+record per request) and AsyncMLPredictRunner.java (async, bounded
+in-flight), with models declared by ``CREATE MODEL`` DDL.
+
+TPU re-design: a model is a *batched vectorized function* and the natural
+provider is a jitted JAX program running on the SAME device as the keyed
+state — inference fuses into the micro-batch pipeline with zero extra
+host<->device round-trips for the hot path (the reference must RPC every
+record to an external endpoint; here the endpoint form is the fallback,
+not the default):
+
+- :class:`JaxModel` — params + apply_fn under ``jax.jit`` with sticky
+  padding buckets (batch-size changes don't recompile).
+- :class:`FunctionModel` — any vectorized NumPy/Python callable.
+- :class:`RemoteModel` — an external-endpoint client (the reference's
+  OpenAI/Triton role). This environment is zero-egress, so transports are
+  injected; the built-in operator pairs it with bounded-in-flight async
+  execution (AsyncWaitOperator) like AsyncMLPredictRunner.
+"""
+
+from flink_tpu.ml.models import (
+    FunctionModel,
+    JaxModel,
+    Model,
+    ModelRegistry,
+    RemoteModel,
+)
+from flink_tpu.ml.operators import AsyncMLPredictOperator, MLPredictOperator
+
+__all__ = [
+    "Model",
+    "JaxModel",
+    "FunctionModel",
+    "RemoteModel",
+    "ModelRegistry",
+    "MLPredictOperator",
+    "AsyncMLPredictOperator",
+]

@@ -1,0 +1,162 @@
+"""Model abstractions for ML_PREDICT (see flink_tpu.ml package docstring).
+
+reference: flink-models/* providers + the model catalog objects behind
+``CREATE MODEL`` (flink-table: CatalogModel with provider options).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from flink_tpu.ops.segment_ops import sticky_bucket
+
+
+class Model:
+    """A batched inference function: column arrays in, column arrays out.
+
+    ``input_names``/``output_names`` are the declared schema (the
+    reference's CatalogModel input/output schema)."""
+
+    input_names: Sequence[str] = ()
+    output_names: Sequence[str] = ()
+
+    def predict(self, inputs: Dict[str, np.ndarray]
+                ) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def open(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class FunctionModel(Model):
+    """Vectorized Python/NumPy callable as a model."""
+
+    def __init__(self, fn: Callable[[Dict[str, np.ndarray]],
+                                    Dict[str, np.ndarray]],
+                 input_names: Sequence[str],
+                 output_names: Sequence[str]):
+        self.fn = fn
+        self.input_names = tuple(input_names)
+        self.output_names = tuple(output_names)
+
+    def predict(self, inputs):
+        return self.fn(inputs)
+
+
+class JaxModel(Model):
+    """A jitted JAX program as a model — inference runs on the same device
+    as the pipeline's keyed state (the TPU-native provider; where the
+    reference pays one network round-trip per record to OpenAI/Triton,
+    this is one kernel per micro-batch).
+
+    ``apply_fn(params, *inputs) -> output | tuple`` is traced under
+    ``jax.jit``; batches pad to sticky buckets so varying micro-batch
+    sizes reuse one executable.
+    """
+
+    def __init__(self, apply_fn, params,
+                 input_names: Sequence[str],
+                 output_names: Sequence[str]):
+        import jax
+
+        self.params = params
+        self.input_names = tuple(input_names)
+        self.output_names = tuple(output_names)
+        self._jitted = jax.jit(apply_fn)
+        self._bucket = 0
+
+    def predict(self, inputs):
+        n = len(next(iter(inputs.values())))
+        size = sticky_bucket(n, self._bucket)
+        self._bucket = size
+        padded = []
+        for name in self.input_names:
+            v = np.asarray(inputs[name])
+            pad = np.zeros((size - n,) + v.shape[1:], dtype=v.dtype)
+            padded.append(np.concatenate([v, pad]) if size > n else v)
+        out = self._jitted(self.params, *padded)
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        return {name: np.asarray(col)[:n]
+                for name, col in zip(self.output_names, out)}
+
+
+class RemoteModel(Model):
+    """External inference endpoint (the reference's OpenAI/Triton client
+    role). The transport is injected: ``client(inputs) -> outputs`` —
+    typically an HTTP call per micro-batch. Pair with
+    AsyncMLPredictOperator for bounded-in-flight overlap (reference:
+    AsyncMLPredictRunner)."""
+
+    def __init__(self, client: Callable[[Dict[str, np.ndarray]],
+                                        Dict[str, np.ndarray]],
+                 input_names: Sequence[str],
+                 output_names: Sequence[str],
+                 open_fn: Optional[Callable[[], None]] = None,
+                 close_fn: Optional[Callable[[], None]] = None):
+        self.client = client
+        self.input_names = tuple(input_names)
+        self.output_names = tuple(output_names)
+        self._open_fn = open_fn
+        self._close_fn = close_fn
+
+    def open(self):
+        if self._open_fn:
+            self._open_fn()
+
+    def close(self):
+        if self._close_fn:
+            self._close_fn()
+
+    def predict(self, inputs):
+        return self.client(inputs)
+
+
+class ModelRegistry:
+    """Model catalog (the reference's CatalogModel store behind CREATE
+    MODEL / model identifiers in ML_PREDICT)."""
+
+    def __init__(self):
+        self._models: Dict[str, Model] = {}
+
+    def register(self, name: str, model: Model) -> None:
+        self._models[name] = model
+
+    def get(self, name: str) -> Model:
+        try:
+            return self._models[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown model {name!r}; registered: "
+                f"{sorted(self._models)} (register with "
+                "t_env.create_temporary_model or CREATE MODEL)") from None
+
+    def create_from_options(self, name: str,
+                            options: Dict[str, str]) -> None:
+        """CREATE MODEL ... WITH (...) — the 'provider' option selects the
+        factory (reference: model provider discovery). Built-in provider
+        'python' imports ``entry`` = "module:attribute" resolving to a
+        Model or a zero-arg factory."""
+        provider = options.get("provider")
+        if provider != "python":
+            raise ValueError(
+                f"unknown model provider {provider!r} (built-in: 'python'; "
+                "remote providers are injected as RemoteModel instances)")
+        entry = options.get("entry", "")
+        mod_name, _, attr = entry.partition(":")
+        if not mod_name or not attr:
+            raise ValueError(
+                "provider 'python' needs entry='module:attribute'")
+        import importlib
+
+        obj = getattr(importlib.import_module(mod_name), attr)
+        model = obj() if callable(obj) and not isinstance(obj, Model) \
+            else obj
+        if not isinstance(model, Model):
+            raise TypeError(f"{entry} did not resolve to a Model")
+        self.register(name, model)

@@ -116,8 +116,17 @@ class TableResult:
 
 class StreamTableEnvironment:
     def __init__(self, env: Optional[StreamExecutionEnvironment] = None):
+        from flink_tpu.ml.models import ModelRegistry
+
         self.env = env or StreamExecutionEnvironment.get_execution_environment()
         self._catalog: Dict[str, Table] = {}
+        #: CREATE MODEL / ML_PREDICT catalog (reference: CatalogModel)
+        self.models = ModelRegistry()
+
+    def create_temporary_model(self, name: str, model) -> None:
+        """Register a Model object for ML_PREDICT (the programmatic form
+        of CREATE MODEL; reference: createTemporaryModel)."""
+        self.models.register(name, model)
 
     @staticmethod
     def create(env: Optional[StreamExecutionEnvironment] = None
@@ -172,9 +181,13 @@ class StreamTableEnvironment:
         return Table._from_planned(self, planned)
 
     def execute_sql(self, sql: str) -> Optional[TableResult]:
-        """Execute a statement. SELECT returns a TableResult; CREATE VIEW
-        registers and returns None (reference: TableEnvironmentImpl.java:936)."""
+        """Execute a statement. SELECT returns a TableResult; CREATE VIEW /
+        CREATE MODEL register and return None (reference:
+        TableEnvironmentImpl.java:936)."""
         stmt = sql_parser.parse(sql)
+        if isinstance(stmt, sql_parser.CreateModel):
+            self.models.create_from_options(stmt.name, stmt.options)
+            return None
         if isinstance(stmt, sql_parser.CreateView):
             planned = Planner(self).plan_select(stmt.query)
             self._catalog[stmt.name] = Table._from_planned(self, planned)

@@ -155,9 +155,43 @@ class Planner:
         if isinstance(ref, ast.WindowTVF):
             raise PlanError("window TVF only supported directly in FROM of "
                             "an aggregating SELECT")
+        if isinstance(ref, ast.MLPredictTVF):
+            return self._plan_ml_predict(ref)
         if isinstance(ref, ast.Join):
             return self._plan_join(ref)
         raise PlanError(f"unsupported table ref {ref!r}")
+
+    def _plan_ml_predict(self, ref: "ast.MLPredictTVF") -> PlannedTable:
+        """ML_PREDICT(TABLE t, MODEL m, DESCRIPTOR(...)) — one batched
+        inference per micro-batch appending the model's output columns
+        (reference: MLPredictRunner invoked from SQL; flink-models)."""
+        from flink_tpu.ml.operators import MLPredictOperator
+
+        inner = self._plan_table_ref(ref.table)
+        if inner.upsert_keys is not None:
+            raise PlanError("ML_PREDICT over an updating (changelog) "
+                            "input is not supported")
+        model = self.t_env.models.get(ref.model)
+        missing = [f for f in ref.fields if f not in inner.columns]
+        if missing:
+            raise PlanError(
+                f"ML_PREDICT descriptor columns {missing} not in input "
+                f"columns {inner.columns}")
+        if len(ref.fields) != len(model.input_names):
+            raise PlanError(
+                f"model {ref.model!r} expects "
+                f"{len(model.input_names)} inputs "
+                f"{tuple(model.input_names)}, the DESCRIPTOR names "
+                f"{len(ref.fields)}: {tuple(ref.fields)}")
+        t = Transformation(
+            name=f"ml_predict({ref.model})", kind="one_input",
+            operator_factory=lambda: MLPredictOperator(
+                model, input_fields=ref.fields),
+            inputs=[inner.stream.transformation])
+        out_cols = list(inner.columns) + [
+            n for n in model.output_names if n not in inner.columns]
+        return PlannedTable(DataStream(self.env, t), out_cols,
+                            ref.alias or inner.alias, inner.time_field)
 
     def _collect_aliases(self, ref: ast.TableRef,
                          side: str = "") -> Dict[str, str]:
@@ -166,6 +200,13 @@ class Planner:
         if isinstance(ref, ast.Join):
             out.update(self._collect_aliases(ref.left, "_l"))
             out.update(self._collect_aliases(ref.right, "_r"))
+            return out
+        if isinstance(ref, ast.MLPredictTVF):
+            # qualified columns keep resolving by the inner table's name
+            # (same treatment as the WindowTVF branch below)
+            out = self._collect_aliases(ref.table, side)
+            if ref.alias is not None:
+                out[ref.alias] = side
             return out
         alias = getattr(ref, "alias", None)
         if alias is None and isinstance(ref, ast.NamedTable):

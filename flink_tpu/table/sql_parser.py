@@ -70,7 +70,18 @@ class Join:
     condition: Expr
 
 
-TableRef = Union[NamedTable, SubQuery, WindowTVF, Join]
+@dataclasses.dataclass
+class MLPredictTVF:
+    """ML_PREDICT(TABLE t, MODEL m, DESCRIPTOR(f1, f2)) — reference:
+    flink-table's ML_PREDICT table function over a CatalogModel."""
+
+    table: "TableRef"
+    model: str
+    fields: List[str]
+    alias: Optional[str] = None
+
+
+TableRef = Union[NamedTable, SubQuery, WindowTVF, Join, MLPredictTVF]
 
 
 @dataclasses.dataclass
@@ -98,12 +109,21 @@ class CreateView:
 
 
 @dataclasses.dataclass
+class CreateModel:
+    """CREATE MODEL name WITH ('provider'='python', ...) — reference:
+    flink-table CREATE MODEL DDL producing a CatalogModel."""
+
+    name: str
+    options: dict
+
+
+@dataclasses.dataclass
 class InsertInto:
     table: str
     query: SelectStmt
 
 
-Statement = Union[SelectStmt, CreateView, InsertInto]
+Statement = Union[SelectStmt, CreateView, CreateModel, InsertInto]
 
 # ---------------------------------------------------------------------------
 # Lexer
@@ -221,13 +241,34 @@ class Parser:
             raise SqlParseError(f"trailing input at {self.peek().value!r}")
         return stmt
 
-    def _create_view(self) -> CreateView:
+    def _create_view(self) -> Statement:
         self.expect_kw("CREATE")
         self.accept_kw("TEMPORARY")
+        if self.accept_kw("MODEL"):
+            return self._create_model()
         self.expect_kw("VIEW")
         name = self.next().value
         self.expect_kw("AS")
         return CreateView(name, self.parse_select())
+
+    def _create_model(self) -> CreateModel:
+        name = self.next().value
+        self.expect_kw("WITH")
+        self.expect_op("(")
+        options = {}
+        while True:
+            k = self.next()
+            if k.kind != "str":
+                raise SqlParseError("model options are 'key' = 'value'")
+            self.expect_op("=")
+            v = self.next()
+            if v.kind != "str":
+                raise SqlParseError("model options are 'key' = 'value'")
+            options[k.value[1:-1]] = v.value[1:-1]
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return CreateModel(name, options)
 
     def _insert_into(self) -> InsertInto:
         self.expect_kw("INSERT")
@@ -312,6 +353,8 @@ class Parser:
     def _table_primary(self) -> TableRef:
         if self.at_kw("TABLE") and self.peek(1).value == "(":
             return self._window_tvf()
+        if self.peek().upper == "ML_PREDICT" and self.peek(1).value == "(":
+            return self._ml_predict_tvf()
         if self.accept_op("("):
             q = self.parse_select()
             self.expect_op(")")
@@ -362,6 +405,47 @@ class Parser:
             slide, size = first, second
             return WindowTVF(kind, inner, time_col, size, slide, alias)
         return WindowTVF(kind, inner, time_col, first, None, alias)
+
+    def _named_arg(self, *names: str) -> None:
+        """Consume an optional ``NAME =>`` prefix (reference: ML_PREDICT's
+        INPUT/DATA, MODEL, ARGS named arguments)."""
+        if self.peek().upper in names and self.peek(1).value == "=":
+            self.next()
+            self.expect_op("=")
+            self.expect_op(">")
+
+    def _ml_predict_tvf(self) -> MLPredictTVF:
+        """ML_PREDICT([INPUT|DATA =>] TABLE t, [MODEL =>] MODEL? m,
+        [ARGS =>] DESCRIPTOR(f1, f2, ...))."""
+        self.next()  # ML_PREDICT
+        self.expect_op("(")
+        self._named_arg("INPUT", "DATA")
+        self.expect_kw("TABLE")
+        inner: TableRef
+        if self.accept_op("("):
+            q = self.parse_select()
+            self.expect_op(")")
+            inner = SubQuery(q)
+        else:
+            inner = NamedTable(self.next().value)
+        self.expect_op(",")
+        # named form `MODEL => m` has no second MODEL keyword; positional
+        # form is `MODEL m`
+        if self.peek().upper == "MODEL" and self.peek(1).value == "=":
+            self._named_arg("MODEL")
+        else:
+            self.expect_kw("MODEL")
+        model = self.next().value
+        self.expect_op(",")
+        self._named_arg("ARGS")
+        self.expect_kw("DESCRIPTOR")
+        self.expect_op("(")
+        fields = [self.next().value]
+        while self.accept_op(","):
+            fields.append(self.next().value)
+        self.expect_op(")")
+        self.expect_op(")")
+        return MLPredictTVF(inner, model, fields, self._opt_alias())
 
     def _interval_ms(self) -> int:
         self.expect_kw("INTERVAL")
