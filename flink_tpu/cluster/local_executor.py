@@ -35,6 +35,8 @@ from flink_tpu.runtime.process import TaggedBatch
 from flink_tpu.runtime.watermarks import WatermarkValve
 
 
+from flink_tpu.core.annotations import internal
+
 class _Node:
     __slots__ = ("transformation", "operator", "valve", "children",
                  "child_input_idx", "records_in", "records_out")
@@ -235,6 +237,7 @@ class _SourcePump:
         self._thread.join(timeout=5)
 
 
+@internal
 class LocalExecutor:
     def __init__(self, config: Optional[Configuration] = None):
         self.config = config or Configuration()

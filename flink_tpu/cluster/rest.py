@@ -10,6 +10,9 @@ GET  /jobs                   job summaries
 GET  /jobs/<id>              one job's status
 GET  /jobs/<id>/metrics      metric registry snapshot of the running attempt
 GET  /jobs/<id>/state/<op>   queryable-state lookup (?key=K[&namespace=N])
+GET  /jobs/<id>/flamegraph   sample the job's task threads (?duration_ms=N)
+GET  /flamegraph             sample task threads cluster-wide (&all=1: every
+                             thread incl. control plane)
 GET  /taskexecutors          live executors + slots
 POST /jobs/<id>/cancel       cancel the job
 POST /jobs/<id>/savepoints   {"target": path, "stop": bool, "drain": bool}
@@ -106,7 +109,39 @@ class RestServer:
                 return self._job_metrics(job_id)
             if parts[2] == "state" and len(parts) >= 4:
                 return self._query_state(job_id, parts[3], path)
+            if parts[2] == "flamegraph":
+                if self.cluster.dispatcher.job_status(
+                        job_id)["status"] == "UNKNOWN":
+                    raise KeyError(job_id)
+                return self._flamegraph(path, job_id=job_id)
+        if parts == ["flamegraph"]:
+            return self._flamegraph(path)
         raise KeyError(path)
+
+    def _flamegraph(self, raw_path: str, job_id: str = None):
+        """GET /flamegraph[?duration_ms=200&all=1] (cluster-wide task
+        threads) and GET /jobs/<id>/flamegraph (that job's task threads —
+        task threads are named task-<jobid>-<attempt>, so the job id IS
+        the sampling filter). On-demand thread sampling folded into a
+        frame tree (reference: VertexFlameGraph +
+        JobVertexFlameGraphHandler)."""
+        from urllib.parse import parse_qs, urlsplit
+
+        from flink_tpu.metrics.flamegraph import (
+            TASK_THREAD_PREFIXES,
+            sample_flame_graph,
+        )
+
+        q = parse_qs(urlsplit(raw_path).query)
+        duration = min(int(q.get("duration_ms", ["200"])[0]), 10_000)
+        if job_id is not None:
+            prefixes = [f"task-{job_id}"]
+        elif q.get("all", ["0"])[0] == "1":
+            prefixes = None
+        else:
+            prefixes = TASK_THREAD_PREFIXES
+        return sample_flame_graph(duration_ms=duration,
+                                  thread_name_prefixes=prefixes)
 
     def _query_state(self, job_id: str, operator_name: str, raw_path: str):
         """GET /jobs/<id>/state/<operator>?key=K[&namespace=N] — queryable

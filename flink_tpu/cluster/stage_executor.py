@@ -71,6 +71,8 @@ __all__ = ["StagePlan", "StagePlanError", "StageParallelExecutor",
            "plan_stages", "merge_subtask_states"]
 
 
+from flink_tpu.core.annotations import internal
+
 class StagePlanError(ValueError):
     """The graph shape is not supported by stage-parallel execution."""
 
@@ -747,6 +749,7 @@ class _Coordinator:
 # ---------------------------------------------------------------------------
 
 
+@internal
 class StageParallelExecutor:
     """Same run() contract as LocalExecutor, executing via subtask
     expansion (reference: Execution.deploy — but subtasks here are threads

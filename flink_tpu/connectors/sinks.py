@@ -7,6 +7,9 @@ from typing import List, Optional
 from flink_tpu.core.records import RecordBatch
 
 
+from flink_tpu.core.annotations import public
+
+@public
 class Sink:
     def open(self, subtask_index: int = 0) -> None:
         pass
@@ -18,6 +21,7 @@ class Sink:
         pass
 
 
+@public
 class DiscardingSink(Sink):
     """Swallows output (reference: DiscardingSink test utility)."""
 
@@ -25,6 +29,7 @@ class DiscardingSink(Sink):
         pass
 
 
+@public
 class CollectSink(Sink):
     """Collects all batches in memory (tests / execute_and_collect)."""
 
@@ -41,6 +46,7 @@ class CollectSink(Sink):
         return self.result().to_rows()
 
 
+@public
 class PrintSink(Sink):
     def __init__(self, label: str = "", max_rows_per_batch: Optional[int] = 20):
         self.label = label
@@ -55,6 +61,7 @@ class PrintSink(Sink):
             print(f"{self.label}> ... {len(rows) - self.max_rows} more")
 
 
+@public
 class JsonLinesFileSink(Sink):
     """Append rows as JSON lines to a file.
 

@@ -17,6 +17,9 @@ import numpy as np
 from flink_tpu.core.records import RecordBatch
 
 
+from flink_tpu.core.annotations import public
+
+@public
 class Source:
     """A bounded or unbounded batch source."""
 

@@ -1,0 +1,39 @@
+"""API stability annotations + enforcement.
+
+reference: flink-annotations (@Public, @PublicEvolving, @Internal,
+@Experimental) with ArchUnit rules asserting every class reachable from the
+public API surface carries a stability marker. Here the decorators stamp
+``__api_stability__`` and the enforcement lives in
+tests/test_annotations_flamegraph.py (the ArchUnit role): everything
+exported from ``flink_tpu``'s top level must be @public or
+@public_evolving.
+"""
+
+from __future__ import annotations
+
+PUBLIC = "public"
+PUBLIC_EVOLVING = "public-evolving"
+EXPERIMENTAL = "experimental"
+INTERNAL = "internal"
+
+
+def _stamp(level: str):
+    def decorate(obj):
+        obj.__api_stability__ = level
+        return obj
+
+    return decorate
+
+
+#: stable API — breaking changes only at major versions
+public = _stamp(PUBLIC)
+#: public but may evolve between minor versions
+public_evolving = _stamp(PUBLIC_EVOLVING)
+#: may change or vanish at any time
+experimental = _stamp(EXPERIMENTAL)
+#: implementation detail, no compatibility promise
+internal = _stamp(INTERNAL)
+
+
+def stability_of(obj) -> str | None:
+    return getattr(obj, "__api_stability__", None)

@@ -19,6 +19,9 @@ from typing import Any, Dict, Generic, Iterator, List, Optional, Sequence, TypeV
 T = TypeVar("T")
 
 
+from flink_tpu.core.annotations import public
+
+@public
 @dataclasses.dataclass(frozen=True)
 class ConfigOption(Generic[T]):
     """A typed configuration key with a default.
@@ -57,6 +60,7 @@ def _coerce(value: Any, typ: type) -> Any:
     return value
 
 
+@public
 class Configuration:
     """Layered key/value store with typed access through ConfigOptions."""
 

@@ -31,6 +31,8 @@ ROWKIND_UPDATE_AFTER = 2
 ROWKIND_DELETE = 3
 
 
+from flink_tpu.core.annotations import public
+
 def rowkind_signs(kinds: "np.ndarray") -> "np.ndarray":
     """+1 for accumulate rows (INSERT/UPDATE_AFTER), -1 for retraction rows
     (UPDATE_BEFORE/DELETE) — the changelog fold direction."""
@@ -39,6 +41,7 @@ def rowkind_signs(kinds: "np.ndarray") -> "np.ndarray":
         np.int8(-1), np.int8(1))
 
 
+@public
 @dataclasses.dataclass(frozen=True)
 class Field:
     name: str
@@ -48,6 +51,7 @@ class Field:
         object.__setattr__(self, "dtype", np.dtype(self.dtype))
 
 
+@public
 @dataclasses.dataclass(frozen=True)
 class Schema:
     fields: Sequence[Field]
@@ -75,6 +79,7 @@ def _as_array(v) -> np.ndarray:
     return a
 
 
+@public
 class RecordBatch:
     """An immutable columnar batch of records.
 

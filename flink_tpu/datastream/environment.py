@@ -24,6 +24,9 @@ from flink_tpu.graph.transformations import StreamGraph, Transformation
 from flink_tpu.runtime.watermarks import WatermarkStrategy
 
 
+from flink_tpu.core.annotations import public
+
+@public
 class StreamExecutionEnvironment:
     def __init__(self, config: Optional[Configuration] = None):
         self.config = config or Configuration()
@@ -161,6 +164,7 @@ class StreamExecutionEnvironment:
         return result
 
 
+@public
 class JobExecutionResult:
     def __init__(self, job_name: str, metrics: dict):
         self.job_name = job_name

@@ -43,6 +43,9 @@ if TYPE_CHECKING:
     from flink_tpu.datastream.environment import StreamExecutionEnvironment
 
 
+from flink_tpu.core.annotations import public, public_evolving
+
+@public
 class DataStream:
     def __init__(self, env: "StreamExecutionEnvironment",
                  transformation: Transformation):
@@ -318,6 +321,7 @@ class BroadcastConnectedStream:
         return DataStream(self.data.env, t)
 
 
+@public_evolving
 class AsyncDataStream:
     """reference: streaming/api/datastream/AsyncDataStream.java."""
 
@@ -347,6 +351,7 @@ class AsyncDataStream:
                                      "async_wait_unordered")
 
 
+@public
 class KeyedStream(DataStream):
     def __init__(self, env, transformation, key_field: str):
         super().__init__(env, transformation)
@@ -423,6 +428,7 @@ class IntervalJoinBuilder:
     # runtime's GroupAggOperator equivalent.
 
 
+@public
 class WindowedStream:
     """reference: streaming/api/datastream/WindowedStream.java."""
 

@@ -13,6 +13,9 @@ import numpy as np
 from flink_tpu.ops.segment_ops import sticky_bucket
 
 
+from flink_tpu.core.annotations import public_evolving
+
+@public_evolving
 class Model:
     """A batched inference function: column arrays in, column arrays out.
 
@@ -33,6 +36,7 @@ class Model:
         pass
 
 
+@public_evolving
 class FunctionModel(Model):
     """Vectorized Python/NumPy callable as a model."""
 
@@ -48,6 +52,7 @@ class FunctionModel(Model):
         return self.fn(inputs)
 
 
+@public_evolving
 class JaxModel(Model):
     """A jitted JAX program as a model — inference runs on the same device
     as the pipeline's keyed state (the TPU-native provider; where the
@@ -86,6 +91,7 @@ class JaxModel(Model):
                 for name, col in zip(self.output_names, out)}
 
 
+@public_evolving
 class RemoteModel(Model):
     """External inference endpoint (the reference's OpenAI/Triton client
     role). The transport is injected: ``client(inputs) -> outputs`` —
@@ -117,6 +123,7 @@ class RemoteModel(Model):
         return self.client(inputs)
 
 
+@public_evolving
 class ModelRegistry:
     """Model catalog (the reference's CatalogModel store behind CREATE
     MODEL / model identifiers in ML_PREDICT)."""

@@ -30,6 +30,9 @@ from flink_tpu.runtime.operators import Operator
 from flink_tpu.state.keyed_state import KeyedStateStore
 
 
+from flink_tpu.core.annotations import public
+
+@public
 @dataclasses.dataclass(frozen=True)
 class OutputTag:
     """Names a side output (reference: flink-core/.../util/OutputTag.java)."""
@@ -171,6 +174,7 @@ class ProcessContext(Collector):
         return self._store.get_state(descriptor)
 
 
+@public
 class ProcessFunction:
     """Vectorized ProcessFunction: override ``process_batch`` (and
     ``on_timer`` for keyed variants)."""
@@ -192,6 +196,7 @@ class ProcessFunction:
 KeyedProcessFunction = ProcessFunction  # keyed-ness comes from the stream
 
 
+@public
 class CoProcessFunction:
     """Two-input process function (reference: co/CoProcessFunction.java)."""
 
@@ -211,6 +216,7 @@ class CoProcessFunction:
         pass
 
 
+@public
 class BroadcastProcessFunction:
     """reference: co/BroadcastProcessFunction.java +
     KeyedBroadcastProcessFunction.java. ``process_broadcast`` sees every

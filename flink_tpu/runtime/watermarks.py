@@ -18,6 +18,8 @@ from flink_tpu.core.records import RecordBatch
 from flink_tpu.runtime.elements import MIN_WATERMARK
 
 
+from flink_tpu.core.annotations import public
+
 class WatermarkGenerator:
     def on_batch(self, batch: RecordBatch) -> Optional[int]:
         """Observe a batch; return a new watermark value or None."""
@@ -43,6 +45,7 @@ class MonotonousTimestamps(BoundedOutOfOrdernessWatermarks):
         super().__init__(0)
 
 
+@public
 @dataclasses.dataclass
 class WatermarkStrategy:
     """Factory + timestamp assignment, mirroring the reference's

@@ -31,6 +31,9 @@ from flink_tpu.state.slot_table import make_slot_index
 _NS = 0  # process-function state has no window namespace
 
 
+from flink_tpu.core.annotations import public
+
+@public
 @dataclasses.dataclass(frozen=True)
 class ValueStateDescriptor:
     name: str
@@ -38,6 +41,7 @@ class ValueStateDescriptor:
     default: Any = 0
 
 
+@public
 @dataclasses.dataclass(frozen=True)
 class ReducingStateDescriptor:
     """``reduce`` must be a binary NumPy ufunc-like (np.add, np.maximum, ...)
@@ -49,11 +53,13 @@ class ReducingStateDescriptor:
     default: Any = 0
 
 
+@public
 @dataclasses.dataclass(frozen=True)
 class ListStateDescriptor:
     name: str
 
 
+@public
 @dataclasses.dataclass(frozen=True)
 class MapStateDescriptor:
     name: str

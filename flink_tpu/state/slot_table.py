@@ -40,6 +40,8 @@ from flink_tpu.ops.segment_ops import (
 )
 
 
+from flink_tpu.core.annotations import internal
+
 def unique_pairs(
     key_ids: np.ndarray, namespaces: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -495,6 +497,7 @@ class SpillTier:
         self._dirty.clear()
 
 
+@internal
 class SlotTable:
     """Single-device keyed windowed state (host index + device accumulators).
 

@@ -27,6 +27,9 @@ from flink_tpu.table.planner import PlannedTable, PlanError, Planner
 _INTERNAL_COLS = (TIMESTAMP_FIELD, KEY_ID_FIELD, ROWKIND_FIELD)
 
 
+from flink_tpu.core.annotations import public_evolving
+
+@public_evolving
 class Table:
     """A (possibly unbounded) relational view over a DataStream."""
 
@@ -56,6 +59,7 @@ class Table:
         return self.stream
 
 
+@public_evolving
 class TableResult:
     """Bounded materialization of a Table (collect-style; the reference's
     TableResult.collect)."""
@@ -114,6 +118,7 @@ class TableResult:
         return batch
 
 
+@public_evolving
 class StreamTableEnvironment:
     def __init__(self, env: Optional[StreamExecutionEnvironment] = None):
         from flink_tpu.ml.models import ModelRegistry

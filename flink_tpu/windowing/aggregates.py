@@ -33,6 +33,9 @@ from flink_tpu.ops.segment_ops import (
 )
 
 
+from flink_tpu.core.annotations import public
+
+@public
 @dataclasses.dataclass(frozen=True)
 class AccLeaf:
     """One flat component of an accumulator pytree.
@@ -65,6 +68,7 @@ class AccLeaf:
 _JIT_CACHE: Dict[tuple, object] = {}
 
 
+@public
 class AggregateFunction:
     """Base class. Subclasses define ``leaves``, ``map_input`` and ``finish``."""
 
@@ -331,6 +335,7 @@ class AggregateFunction:
 # ---------------------------------------------------------------------------
 
 
+@public
 class SumAggregate(AggregateFunction):
     def __init__(self, field: str, dtype=np.float32, output: str = None):
         self.field = field
@@ -344,6 +349,7 @@ class SumAggregate(AggregateFunction):
         return {self.output_names[0]: merged[0]}
 
 
+@public
 class CountAggregate(AggregateFunction):
     def __init__(self, output: str = "count"):
         self.leaves = (AccLeaf("count", np.int32, "sum", const=1),)
@@ -356,6 +362,7 @@ class CountAggregate(AggregateFunction):
         return {self.output_names[0]: merged[0]}
 
 
+@public
 class MaxAggregate(AggregateFunction):
     def __init__(self, field: str, dtype=np.float32, output: str = None):
         self.field = field
@@ -369,6 +376,7 @@ class MaxAggregate(AggregateFunction):
         return {self.output_names[0]: merged[0]}
 
 
+@public
 class MinAggregate(AggregateFunction):
     def __init__(self, field: str, dtype=np.float32, output: str = None):
         self.field = field
@@ -382,6 +390,7 @@ class MinAggregate(AggregateFunction):
         return {self.output_names[0]: merged[0]}
 
 
+@public
 class AvgAggregate(AggregateFunction):
     def __init__(self, field: str, output: str = None):
         self.field = field
@@ -399,6 +408,7 @@ class AvgAggregate(AggregateFunction):
         return {self.output_names[0]: s / jnp.maximum(c, 1.0)}
 
 
+@public
 class MultiAggregate(AggregateFunction):
     """Compose several aggregates over the same key/window into one state
     table (one scatter pass, multiple result columns)."""

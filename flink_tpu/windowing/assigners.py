@@ -26,6 +26,8 @@ from typing import List
 import numpy as np
 
 
+from flink_tpu.core.annotations import public, public_evolving
+
 @dataclasses.dataclass(frozen=True)
 class WindowAssigner:
     """Base: maps timestamps -> slice ends, and window ends -> slice ranges."""
@@ -86,6 +88,7 @@ def _align_up(t: int, step: int, offset: int = 0) -> int:
     return t if r == 0 else t + (step - r)
 
 
+@public
 class TumblingEventTimeWindows(WindowAssigner):
     """reference: streaming/api/windowing/assigners/TumblingEventTimeWindows.java
     — one slice per window, fire = emit slice."""
@@ -99,6 +102,7 @@ class TumblingEventTimeWindows(WindowAssigner):
         return TumblingEventTimeWindows(size_ms, offset_ms)
 
 
+@public
 class SlidingEventTimeWindows(WindowAssigner):
     """reference: streaming/api/windowing/assigners/SlidingEventTimeWindows.java,
     executed with the HOP slice-sharing strategy
@@ -114,6 +118,7 @@ class SlidingEventTimeWindows(WindowAssigner):
         return SlidingEventTimeWindows(size_ms, slide_ms, offset_ms)
 
 
+@public_evolving
 class TumblingProcessingTimeWindows(TumblingEventTimeWindows):
     """Windows over WALL-CLOCK arrival time (reference:
     TumblingProcessingTimeWindows.java + WindowOperator.onProcessingTime:497).
@@ -128,6 +133,7 @@ class TumblingProcessingTimeWindows(TumblingEventTimeWindows):
         return TumblingProcessingTimeWindows(size_ms, offset_ms)
 
 
+@public_evolving
 class SlidingProcessingTimeWindows(SlidingEventTimeWindows):
     """reference: SlidingProcessingTimeWindows.java — HOP over arrival
     time, slice-shared like the event-time form."""
@@ -140,6 +146,7 @@ class SlidingProcessingTimeWindows(SlidingEventTimeWindows):
         return SlidingProcessingTimeWindows(size_ms, slide_ms, offset_ms)
 
 
+@public
 class CumulativeEventTimeWindows(WindowAssigner):
     """CUMULATE TVF (reference: SliceAssigners.java CumulativeSliceAssigner):
     windows [s, s+step), [s, s+2*step) ... [s, s+max_size)."""
@@ -172,6 +179,7 @@ class CumulativeEventTimeWindows(WindowAssigner):
         return span_start + self.size - self.slice_width
 
 
+@public
 @dataclasses.dataclass(frozen=True)
 class EventTimeSessionWindows:
     """Session windows with a gap; merging happens on host metadata with
