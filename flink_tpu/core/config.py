@@ -249,6 +249,13 @@ class StateOptions:
         "bound). 0 = unbounded (grow by doubling). When the budget is "
         "reached, cold namespaces spill to host memory and reload "
         "transparently on access (the RocksDB/ForSt beyond-memory role).")
+    WINDOW_LAYOUT = ConfigOption(
+        "state.window-layout", default="auto", type=str,
+        description="Keyed window state layout: 'slots' ((key, slice) "
+        "slot table — the general engine: sessions, spill, mesh), "
+        "'panes' (ring-of-slices x key-rows — fires are pure device "
+        "reductions with no per-fire host->device transfer; aligned "
+        "windows on one device only), or 'auto' (panes when eligible).")
     SPILL_DIR = ConfigOption(
         "state.spill.dir", default=None, type=str,
         description="Filesystem tier for spilled state (any core.fs "

@@ -88,6 +88,11 @@ class StreamExecutionEnvironment:
                 StateOptions.SPILL_HOST_MAX_BYTES),
         }
 
+    @property
+    def window_layout(self) -> str:
+        """state.window-layout: 'slots' | 'panes' | 'auto'."""
+        return self.config.get(StateOptions.WINDOW_LAYOUT)
+
     def enable_checkpointing(self, interval_ms: int) -> "StreamExecutionEnvironment":
         self.config.set(CheckpointOptions.INTERVAL_MS, interval_ms)
         return self

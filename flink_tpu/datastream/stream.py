@@ -467,10 +467,11 @@ class WindowedStream:
                 allowed_lateness=lateness, spill=spill)
         else:
             spill = env.state_spill_options
+            layout = env.window_layout
             factory = lambda: WindowAggOperator(  # noqa: E731
                 assigner, agg, key_field, capacity=capacity,
                 allowed_lateness=lateness, spill=spill,
-                fire_projector=fire_projector)
+                fire_projector=fire_projector, window_layout=layout)
         t = Transformation(
             name=name or f"window_agg({type(agg).__name__})",
             kind="one_input",

@@ -143,7 +143,8 @@ class WindowAggOperator(Operator):
     def __init__(self, assigner: WindowAssigner, agg: AggregateFunction,
                  key_field: str, capacity: int = 1 << 16,
                  allowed_lateness: int = 0, spill: dict = None,
-                 fire_projector=None):
+                 fire_projector=None, window_layout: str = "auto"):
+        self.window_layout = window_layout
         self.assigner = assigner
         self.agg = agg
         self.key_field = key_field
@@ -200,12 +201,38 @@ class WindowAggOperator(Operator):
                 allowed_lateness=self.allowed_lateness,
                 fire_projector=self.fire_projector)
         else:
-            self.windower = SliceSharedWindower(
-                self.assigner, self.agg, capacity=self.capacity,
-                max_parallelism=ctx.max_parallelism,
-                allowed_lateness=self.allowed_lateness,
-                spill=self.spill,
-                fire_projector=self.fire_projector)
+            has_spill = bool(self.spill and any(self.spill.values()))
+            # the pane layout is DENSE: [ring_rows, key_capacity] per leaf,
+            # with ring_rows ~ next-pow2(live slices). High-ratio sliding
+            # windows (size >> slide) would multiply HBM by the slice
+            # count, so 'auto' only picks panes for small slice ratios;
+            # an explicit 'panes' trusts the user's arithmetic.
+            small_ring = getattr(self.assigner, "slices_per_window",
+                                 1 << 30) <= 16
+            use_panes = self.window_layout == "panes" or (
+                self.window_layout == "auto" and not has_spill
+                and small_ring)
+            if use_panes and has_spill:
+                raise ValueError(
+                    "state.window-layout=panes has no spill tier — use "
+                    "'slots' (or 'auto') with state.spill.* options")
+            if use_panes:
+                # pane/ring layout: fires are pure device reductions with
+                # no per-fire host->device transfer (state/pane_table.py)
+                from flink_tpu.windowing.windower import PaneWindower
+
+                self.windower = PaneWindower(
+                    self.assigner, self.agg, capacity=self.capacity,
+                    max_parallelism=ctx.max_parallelism,
+                    allowed_lateness=self.allowed_lateness,
+                    fire_projector=self.fire_projector)
+            else:
+                self.windower = SliceSharedWindower(
+                    self.assigner, self.agg, capacity=self.capacity,
+                    max_parallelism=ctx.max_parallelism,
+                    allowed_lateness=self.allowed_lateness,
+                    spill=self.spill,
+                    fire_projector=self.fire_projector)
 
     def process_batch(self, batch, input_index=0):
         if self.key_field in batch.columns:

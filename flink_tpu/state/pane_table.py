@@ -1,0 +1,434 @@
+"""Pane-layout keyed window state: a ring of slices × stable key rows.
+
+The SlotTable (state/slot_table.py) allocates one slot per (key, slice)
+pair, so firing a k-slice window needs a host-built [num_keys, k] slot
+matrix shipped host->device every fire — on a transfer-constrained TPU
+link that dominates the fire cost. This layout removes the matrix
+entirely (reference analog: the pane/slice-sharing idea of
+SliceAssigners.java taken to its natural TPU form):
+
+- device arrays are ``[ring_rows, key_capacity]`` per accumulator leaf;
+- a KEY owns a stable column (key row) across all slices (host index,
+  keyed by key only);
+- a live SLICE owns a ring row (host dict slice_end -> row; row 0 is the
+  reserved always-identity row, the pad target for missing slices);
+- scatter: ``acc[row[i], col[i]] op= v[i]`` — same host->device traffic
+  as the slot layout (indices + values);
+- FIRE: ``merge(acc[rows_of_window], axis=0)`` + finish (+ fused top-k
+  projector) — the only host->device transfer is the [k] ring-row ids;
+- freeing an expired slice is ONE index-free row reset;
+- the incremental-snapshot unit is a slice row, and sealed slices never
+  dirty again — a delta checkpoint ships just the active slice.
+
+A presence plane (int8 max-scatter) distinguishes "key has data in this
+slice" from identity values, so fires emit exactly the keys that
+participated (SUM of 0.0 is not confused with absence).
+
+Scope: aligned (non-merging) assigners on one device without a spill
+tier; sessions, spill, and the mesh keep the slot layout.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.core.annotations import internal
+from flink_tpu.ops.segment_ops import (
+    MERGE_FN,
+    SCATTER_METHOD,
+    pad_i32,
+    sticky_bucket,
+)
+from flink_tpu.state.keygroups import assign_key_groups
+from flink_tpu.state.slot_table import make_slot_index
+from flink_tpu.windowing.aggregates import _JIT_CACHE, AggregateFunction
+
+_INITIAL_RING = 8
+
+
+def _pane_kernels(agg: AggregateFunction, projector=None):
+    """(scatter2d, fire_rows, reset_row, put_row) for [R, C] pane arrays.
+    The presence plane rides as an extra trailing array in ``accs``."""
+    key = ("pane", agg.cache_key(),
+           None if projector is None else projector.cache_key())
+    fns = _JIT_CACHE.get(key)
+    if fns is not None:
+        return fns
+    leaves = agg.leaves
+    methods = tuple(SCATTER_METHOD[l.reduce] for l in leaves)
+    merges = tuple(MERGE_FN[l.reduce] for l in leaves)
+    idents = tuple(l.identity for l in leaves)
+    finish = agg.finish
+    n = len(leaves)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def scatter2d(accs, rows, cols, values):
+        vit = iter(values)
+        out = []
+        for a, m, l in zip(accs[:n], methods, leaves):
+            if l.const is not None:
+                v = jnp.where(cols == 0,
+                              jnp.asarray(l.identity, dtype=l.dtype),
+                              jnp.asarray(l.const, dtype=l.dtype))
+            else:
+                v = next(vit)
+            out.append(getattr(a.at[rows, cols], m)(v))
+        presence = accs[n].at[rows, cols].max(
+            jnp.where(cols == 0, 0, 1).astype(jnp.int8))
+        return tuple(out) + (presence,)
+
+    @jax.jit
+    def fire_rows(accs, rows, used_n):
+        merged = tuple(
+            m(a[rows], axis=0) for a, m in zip(accs[:n], merges))
+        present = accs[n][rows].max(axis=0)
+        cols = finish(merged)
+        valid = (jnp.arange(present.shape[0]) < used_n) & (present > 0)
+        if projector is None:
+            return cols, valid
+        return projector.project(cols, valid)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def reset_row(accs, row):
+        out = [a.at[row].set(jnp.asarray(i, dtype=a.dtype))
+               for a, i in zip(accs[:n], idents)]
+        return tuple(out) + (accs[n].at[row].set(jnp.int8(0)),)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def put_row(accs, row, cols, values):
+        out = [a.at[row, cols].set(v)
+               for a, v in zip(accs[:n], values)]
+        presence = accs[n].at[row, cols].set(
+            jnp.where(cols == 0, 0, 1).astype(jnp.int8))
+        return tuple(out) + (presence,)
+
+    _JIT_CACHE[key] = fns = (scatter2d, fire_rows, reset_row, put_row)
+    return fns
+
+
+@internal
+class PaneTable:
+    """Ring-of-slices × key-rows window state (see module docstring)."""
+
+    def __init__(self, agg: AggregateFunction, capacity: int = 1 << 16,
+                 max_parallelism: int = 128, fire_projector=None):
+        self.agg = agg
+        self.max_parallelism = max_parallelism
+        self.fire_projector = fire_projector
+        self.index = make_slot_index(capacity, on_grow=self._grow_cols)
+        self.capacity = self.index.capacity
+        self.R = _INITIAL_RING
+        self.accs = tuple(
+            jnp.full((self.R, self.capacity), l.identity, dtype=l.dtype)
+            for l in agg.leaves
+        ) + (jnp.zeros((self.R, self.capacity), dtype=jnp.int8),)
+        #: slice_end -> ring row (row 0 reserved identity)
+        self.slice_row: Dict[int, int] = {}
+        self._free_rows: List[int] = list(range(self.R - 1, 0, -1))
+        self._dirty_slices: set = set()
+        self._freed_ns: List[int] = []
+        self._scatter_bucket = 0
+        #: exclusive bound of allocated key rows (keys are never freed, so
+        #: allocations stay contiguous from 1)
+        self._high_water = 1
+        (self._scatter2d, self._fire_rows, self._reset_row,
+         self._put_row) = _pane_kernels(agg, fire_projector)
+
+    # ---------------------------------------------------------------- sizing
+
+    def _grow_cols(self, old: int, new: int) -> None:
+        self.capacity = new
+        grown = []
+        for a, l in zip(self.accs[:-1], self.agg.leaves):
+            pad = jnp.full((self.R, new - old), l.identity, dtype=l.dtype)
+            grown.append(jnp.concatenate([a, pad], axis=1))
+        pad = jnp.zeros((self.R, new - old), dtype=jnp.int8)
+        self.accs = tuple(grown) + (
+            jnp.concatenate([self.accs[-1], pad], axis=1),)
+
+    def _alloc_row(self, slice_end: int) -> int:
+        if not self._free_rows:
+            old = self.R
+            self.R = old * 2
+            grown = []
+            for a, l in zip(self.accs[:-1], self.agg.leaves):
+                pad = jnp.full((old, self.capacity), l.identity,
+                               dtype=l.dtype)
+                grown.append(jnp.concatenate([a, pad], axis=0))
+            pad = jnp.zeros((old, self.capacity), dtype=jnp.int8)
+            self.accs = tuple(grown) + (
+                jnp.concatenate([self.accs[-1], pad], axis=0),)
+            self._free_rows = list(range(self.R - 1, old - 1, -1))
+        row = self._free_rows.pop()
+        self.slice_row[int(slice_end)] = row
+        return row
+
+    @property
+    def used_cols(self) -> int:
+        """High-water key-row bound (exclusive); row 0 is reserved."""
+        return self._high_water
+
+    # ---------------------------------------------------------------- ingest
+
+    def upsert(self, key_ids: np.ndarray, slice_ends: np.ndarray,
+               values: Tuple[np.ndarray, ...]) -> None:
+        cols = self.index.lookup_or_insert(
+            key_ids, np.zeros(len(key_ids), dtype=np.int64))
+        if len(cols):
+            self._high_water = max(self._high_water, int(cols.max()) + 1)
+        # slice -> ring row, vectorized through a small host dict
+        uniq = np.unique(slice_ends)
+        for se in uniq.tolist():
+            if int(se) not in self.slice_row:
+                self._alloc_row(int(se))
+            self._dirty_slices.add(int(se))
+        lut = {se: self.slice_row[int(se)] for se in uniq.tolist()}
+        rows = np.fromiter((lut[int(se)] for se in slice_ends),
+                           dtype=np.int32, count=len(slice_ends))
+        size = sticky_bucket(len(cols), self._scatter_bucket)
+        self._scatter_bucket = size
+        self.accs = self._scatter2d(
+            self.accs,
+            pad_i32(rows, size, fill=0),
+            pad_i32(cols.astype(np.int32), size, fill=0),
+            self.agg.pad_input_values(values, size))
+
+    # ------------------------------------------------------------------ fire
+
+    def fire_window(self, slice_ends: List[int]
+                    ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """(keys, result columns) for one window — missing slices hit the
+        reserved identity row; the ONLY host->device payload is [k] row
+        ids."""
+        rows = np.asarray(
+            [self.slice_row.get(int(se), 0) for se in slice_ends],
+            dtype=np.int32)
+        if not rows.any():
+            return np.empty(0, dtype=np.int64), {}
+        used = self.used_cols
+        out = self._fire_rows(self.accs, jnp.asarray(rows), used)
+        if self.fire_projector is None:
+            cols, valid = out
+            sel = np.asarray(valid)[:used]
+            keys = self.index.slot_key[:used][sel]
+            return keys, {name: np.asarray(c)[:used][sel]
+                          for name, c in cols.items()}
+        pidx, pcols, pvalid = out
+        sel = np.asarray(pvalid)
+        keys = self.index.slot_key[np.asarray(pidx)[sel]]
+        return keys, {name: np.asarray(c)[sel]
+                      for name, c in pcols.items()}
+
+    # ----------------------------------------------------------------- frees
+
+    def free_slices(self, slice_ends: List[int]) -> None:
+        for se in slice_ends:
+            row = self.slice_row.pop(int(se), None)
+            if row is None:
+                continue
+            self.accs = self._reset_row(self.accs, row)
+            self._free_rows.append(row)
+            self._dirty_slices.discard(int(se))
+            self._freed_ns.append(int(se))
+        self._maybe_compact()
+
+    #: alias so PaneWindower shares SliceSharedWindower.on_watermark
+    free_namespaces = free_slices
+
+    #: no spill tier in the pane layout (the slot layout covers that)
+    spill = frozenset()
+
+    _COMPACT_MIN_KEYS = 4096
+
+    def _maybe_compact(self) -> None:
+        """Key columns are never freed inline (a key's column is shared by
+        every live slice), so key churn would grow the table forever —
+        when most allocated columns belong to departed keys, rebuild the
+        table from its own logical snapshot (one state round-trip,
+        amortized rare; the slot layout's free_namespaces analog)."""
+        hw = self._high_water
+        if hw < self._COMPACT_MIN_KEYS:
+            return
+        live = sorted(self.slice_row)
+        if live:
+            rows = np.asarray([self.slice_row[se] for se in live],
+                              dtype=np.int32)
+            alive = int(np.asarray(
+                (self.accs[-1][rows].max(axis=0) > 0)[:hw]).sum())
+        else:
+            alive = 0
+        if alive * 2 > hw:
+            return
+        snap = self.snapshot(reset_dirty=False)
+        dirty, freed = self._dirty_slices, self._freed_ns
+        self.index = make_slot_index(self.index.capacity,
+                                     on_grow=self._grow_cols)
+        self.capacity = self.index.capacity
+        self._high_water = 1
+        self.slice_row = {}
+        self._free_rows = list(range(self.R - 1, 0, -1))
+        self.accs = tuple(
+            jnp.full((self.R, self.capacity), l.identity, dtype=l.dtype)
+            for l in self.agg.leaves
+        ) + (jnp.zeros((self.R, self.capacity), dtype=jnp.int8),)
+        self.restore(snap)
+        # compaction must not eat incremental bookkeeping: every surviving
+        # slice moved, so they are all dirty vs the last base
+        self._dirty_slices = set(dirty) | set(self.slice_row)
+        self._freed_ns = freed
+
+    # ------------------------------------------------------------ point query
+
+    def query_windows(self, key_id: int, assigner) -> Dict[int, dict]:
+        col = self.index.lookup(np.asarray([key_id], dtype=np.int64),
+                                np.zeros(1, dtype=np.int64))[0]
+        if col < 0:
+            return {}
+        live = sorted(self.slice_row)
+        if not live:
+            return {}
+        rows = np.asarray([self.slice_row[se] for se in live],
+                          dtype=np.int32)
+        per_leaf = [np.asarray(a[rows, int(col)]) for a in self.accs[:-1]]
+        present = np.asarray(self.accs[-1][rows, int(col)]) > 0
+        slice_vals = {
+            se: tuple(pl[i] for pl in per_leaf)
+            for i, se in enumerate(live) if present[i]
+        }
+        if not slice_vals:
+            return {}
+        windows = sorted({
+            int(w) for se in slice_vals
+            for w in assigner.window_ends_for_slice(se)})
+        out = {}
+        idents = tuple(l.identity for l in self.agg.leaves)
+        host_merge = {"sum": np.add, "max": np.maximum, "min": np.minimum}
+        for w in windows:
+            acc = list(idents)
+            hit = False
+            for se in assigner.slice_ends_for_window(w):
+                sv = slice_vals.get(int(se))
+                if sv is None:
+                    continue
+                hit = True
+                for i, l in enumerate(self.agg.leaves):
+                    acc[i] = host_merge[l.reduce](acc[i], sv[i])
+            if not hit:
+                continue
+            merged = tuple(np.asarray([a]) for a in acc)
+            finished = self.agg.finish(merged)
+            out[w] = {name: np.asarray(v)[0].item()
+                      for name, v in finished.items()}
+        return out
+
+    # -------------------------------------------------------------- snapshot
+
+    def snapshot(self, reset_dirty: bool = True) -> Dict[str, np.ndarray]:
+        """Logical rows — SAME format as SlotTable.snapshot (key_id /
+        namespace / key_group / leaf_i), so pane and slot checkpoints are
+        mutually restorable."""
+        live = sorted(self.slice_row)
+        return self._snapshot_slices(live, reset_dirty=reset_dirty,
+                                     delta=False)
+
+    def snapshot_delta(self) -> Dict[str, np.ndarray]:
+        """Sealed slices never dirty again — the delta is just the slices
+        touched since the last snapshot plus freed tombstones."""
+        dirty = sorted(self._dirty_slices)
+        out = self._snapshot_slices(dirty, reset_dirty=True, delta=True)
+        return out
+
+    def _snapshot_slices(self, slices: List[int], reset_dirty: bool,
+                         delta: bool) -> Dict[str, np.ndarray]:
+        used = self.used_cols
+        key_cols, ns_cols = [], []
+        leaf_cols: List[List[np.ndarray]] = [[] for _ in self.agg.leaves]
+        for se in slices:
+            row = self.slice_row[se]
+            present = np.asarray(self.accs[-1][row][:used]) > 0
+            if not present.any():
+                continue
+            keys = self.index.slot_key[:used][present]
+            key_cols.append(keys)
+            ns_cols.append(np.full(len(keys), se, dtype=np.int64))
+            for i, a in enumerate(self.accs[:-1]):
+                leaf_cols[i].append(np.asarray(a[row][:used])[present])
+        if key_cols:
+            key_ids = np.concatenate(key_cols)
+            out = {
+                "key_id": key_ids,
+                "namespace": np.concatenate(ns_cols),
+                "key_group": assign_key_groups(key_ids,
+                                               self.max_parallelism),
+                **{f"leaf_{i}": np.concatenate(cols)
+                   for i, cols in enumerate(leaf_cols)},
+            }
+        else:
+            out = {
+                "key_id": np.empty(0, dtype=np.int64),
+                "namespace": np.empty(0, dtype=np.int64),
+                "key_group": np.empty(0, dtype=np.int32),
+                **{f"leaf_{i}": np.empty(0, dtype=l.dtype)
+                   for i, l in enumerate(self.agg.leaves)},
+            }
+        if delta:
+            out["__delta__"] = np.asarray(True)
+            out["freed_namespaces"] = np.asarray(
+                sorted(set(self._freed_ns)), dtype=np.int64)
+        if reset_dirty:
+            self._dirty_slices.clear()
+            self._freed_ns.clear()
+        return out
+
+    def restore(self, snap: Dict[str, np.ndarray],
+                key_group_filter=None) -> None:
+        key_ids = np.asarray(snap["key_id"], dtype=np.int64)
+        namespaces = np.asarray(snap["namespace"], dtype=np.int64)
+        leaves = []
+        for i, leaf in enumerate(self.agg.leaves):
+            arr = np.asarray(snap[f"leaf_{i}"])
+            want = np.dtype(leaf.dtype)
+            if len(arr) and arr.dtype != want:
+                # same schema-compatibility contract as SlotTable.restore:
+                # a value-preserving cast migrates, a lossy one fails
+                cast = arr.astype(want)
+                if not np.array_equal(cast.astype(arr.dtype), arr):
+                    raise RuntimeError(
+                        f"state schema incompatible: snapshot leaf_{i} "
+                        f"has dtype {arr.dtype}, the aggregate expects "
+                        f"{want} and the values do not survive the cast")
+                arr = cast
+            leaves.append(arr.astype(want))
+        if key_group_filter is not None and len(key_ids):
+            groups = assign_key_groups(key_ids, self.max_parallelism)
+            keep = np.isin(groups, np.asarray(sorted(key_group_filter)))
+            key_ids, namespaces = key_ids[keep], namespaces[keep]
+            leaves = [l[keep] for l in leaves]
+        order = np.argsort(namespaces, kind="stable")
+        key_ids, namespaces = key_ids[order], namespaces[order]
+        leaves = [l[order] for l in leaves]
+        bounds = np.nonzero(np.diff(namespaces))[0] + 1
+        starts = np.concatenate(([0], bounds)) if len(key_ids) else []
+        ends = np.concatenate((bounds, [len(key_ids)])) if len(key_ids) \
+            else []
+        for a, b in zip(list(starts), list(ends)):
+            se = int(namespaces[a])
+            row = self.slice_row.get(se)
+            if row is None:
+                row = self._alloc_row(se)
+            cols = self.index.lookup_or_insert(
+                key_ids[a:b], np.zeros(b - a, dtype=np.int64))
+            if len(cols):
+                self._high_water = max(self._high_water,
+                                       int(cols.max()) + 1)
+            self.accs = self._put_row(
+                self.accs, row,
+                jnp.asarray(cols.astype(np.int32)),
+                tuple(jnp.asarray(l[a:b]) for l in leaves))
+        self._dirty_slices.clear()
+        self._freed_ns.clear()

@@ -176,3 +176,53 @@ class SliceSharedWindower:
     def restore(self, snap: Dict[str, object], key_group_filter=None) -> None:
         self.table.restore(snap["table"], key_group_filter=key_group_filter)
         self.book.restore(snap)
+
+
+class PaneWindower(SliceSharedWindower):
+    """SliceSharedWindower over the pane/ring layout (state/pane_table.py):
+    same external contract, but fires are pure device reductions over ring
+    rows — no host-built slot matrix, no per-fire host->device transfer —
+    and freeing an expired slice is one index-free row reset.
+
+    Selected for aligned (non-merging) assigners without a spill tier at
+    parallelism 1 (state.window-layout=auto|panes); the slot layout stays
+    the engine for sessions, spill, and the mesh. Only table construction
+    and the per-window fire differ — ingest, watermark loop, queries and
+    snapshots are inherited.
+    """
+
+    def __init__(
+        self,
+        assigner: WindowAssigner,
+        agg: AggregateFunction,
+        capacity: int = 1 << 16,
+        max_parallelism: int = 128,
+        allowed_lateness: int = 0,
+        fire_projector=None,
+    ) -> None:
+        from flink_tpu.state.pane_table import PaneTable
+
+        self.assigner = assigner
+        self.agg = agg
+        self.table = PaneTable(agg, capacity=capacity,
+                               max_parallelism=max_parallelism,
+                               fire_projector=fire_projector)
+        self.book = SliceBookkeeper(assigner, allowed_lateness)
+        self.fire_projector = fire_projector
+
+    def _fire_window(self, window_end: int) -> Optional[RecordBatch]:
+        keys, results = self.table.fire_window(
+            [int(se)
+             for se in self.assigner.slice_ends_for_window(window_end)])
+        if len(keys) == 0:
+            return None
+        m = len(keys)
+        cols = {
+            KEY_ID_FIELD: keys,
+            WINDOW_START_FIELD: np.full(
+                m, self.assigner.window_start(window_end), dtype=np.int64),
+            WINDOW_END_FIELD: np.full(m, window_end, dtype=np.int64),
+            TIMESTAMP_FIELD: np.full(m, window_end - 1, dtype=np.int64),
+        }
+        cols.update(results)
+        return RecordBatch(cols)
