@@ -324,6 +324,7 @@ class JobMasterThread:
         finally:
             if self.status not in TERMINAL:
                 self.status = FAILED
+            self._archive()
             # globally-terminal jobs leave the HA job graph store; a
             # suspended job (cluster shutdown) stays for the next leader
             # (reference: Dispatcher#jobReachedTerminalState vs SUSPENDED)
@@ -338,6 +339,47 @@ class JobMasterThread:
     def _set_status(self, status: str) -> None:
         self.status = status
         self.state_history.append((status, time.time()))
+
+    def _archive(self) -> None:
+        """Terminal jobs outlive the cluster: write the history-server
+        archive (reference: JobManagers archive REST payloads to
+        jobmanager.archive.fs.dir for the HistoryServer)."""
+        from flink_tpu.cluster.history_server import ARCHIVE_DIR, archive_job
+
+        if self._suspended.is_set():
+            # a suspended job (cluster shutdown / leadership loss) is NOT
+            # globally terminal — it stays in the HA store for the next
+            # leader and must not appear archived (same guard as the
+            # job-graph-store removal; reference:
+            # Dispatcher#jobReachedTerminalState vs SUSPENDED)
+            return
+        # cluster-level setting with a per-job override (reference:
+        # jobmanager.archive.fs.dir is a JobManager option)
+        archive_dir = self.config.get(ARCHIVE_DIR) or \
+            self.cluster.config.get(ARCHIVE_DIR)
+        if not archive_dir:
+            return
+        try:
+            payload = {
+                "job_id": self.job_id,
+                "job_name": self.job_name,
+                "status": self.status,
+                "attempts": self.attempt,
+                "start_time": self.state_history[0][1],
+                "end_time": time.time(),
+                "state_history": [[s, t] for s, t in self.state_history],
+                "error": repr(self.error) if self.error else None,
+            }
+            if self.result is not None:
+                payload["metrics"] = getattr(self.result, "metrics", None)
+                payload["metric_snapshot"] = getattr(
+                    self.result, "metric_snapshot", None)
+                traces = getattr(self.result, "spans", None)
+                if traces is not None:
+                    payload["spans"] = traces
+            archive_job(archive_dir, self.job_id, payload)
+        except Exception:  # noqa: BLE001 - archiving must not fail the job
+            pass
 
     def _acquire_slot(self, rm):
         """Default mode: fail fast without a slot. Adaptive: enter

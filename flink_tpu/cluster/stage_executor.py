@@ -419,6 +419,13 @@ class _SourceSubtask(threading.Thread):
         self.chain: Optional[_OperatorChain] = None
         self.records_out = 0
         self.batches_polled = 0
+        from flink_tpu.runtime.shuffle_spi import KeyGroupPartitioner
+
+        # routes on the pre-hashed __key_id__ column (ints are identity
+        # under hash_keys_to_i64), so routing and downstream state use the
+        # same key identity
+        self._partitioner = KeyGroupPartitioner("__key_id__",
+                                                max_parallelism)
         #: position at exit — checkpoints after this subtask drains its
         #: split still record where it ended (restore must not replay it)
         self.final_position = None
@@ -488,17 +495,12 @@ class _SourceSubtask(threading.Thread):
             raise _SubtaskFailure(
                 f"key field {key_field!r} missing from batch columns "
                 f"{batch.names()}")
-        keys = batch[key_field]
-        key_ids = hash_keys_to_i64(keys)
-        batch = batch.with_column("__key_id__", key_ids)
-        groups = assign_key_groups(key_ids, self.max_parallelism)
-        targets = key_group_to_operator_index(
-            groups, self.max_parallelism, self.num_keyed)
-        for sub in range(self.num_keyed):
-            mask = targets == sub
-            if not mask.any():
-                continue
-            part = batch.filter(mask)
+        batch = batch.with_column("__key_id__",
+                                  hash_keys_to_i64(batch[key_field]))
+        # the ONE keyBy routing implementation (reference:
+        # KeyGroupStreamPartitioner.selectChannel)
+        for sub, part in self._partitioner.partition(batch,
+                                                     self.num_keyed):
             self.records_out += len(part)
             if not self.batch_mode:
                 self.writer.emit(sub, part)

@@ -103,12 +103,12 @@ class MeshSessionEngine:
 
         from flink_tpu.state.slot_table import make_slot_index
 
+        # growable per-shard indexes (see MeshWindowEngine: skew grows the
+        # table instead of failing the job)
         self.indexes = [
             make_slot_index(
-                self.capacity, growable=False,
-                full_hint="raise MeshSessionEngine capacity_per_shard "
-                          "(hot-key skew can concentrate sessions on one "
-                          "shard)")
+                self.capacity, growable=True,
+                on_grow=lambda old, new: self._shard_index_grew(new))
             for _ in range(self.P)
         ]
         self._sharding = NamedSharding(mesh, P(KEY_AXIS))
@@ -133,6 +133,26 @@ class MeshSessionEngine:
     @property
     def late_records_dropped(self) -> int:
         return self.meta.late_records_dropped
+
+    def _shard_index_grew(self, new_capacity: int) -> None:
+        """Uniform-SPMD grow: widen [P, capacity] arrays to the largest
+        shard index (same contract as MeshWindowEngine)."""
+        if new_capacity <= self.capacity:
+            return
+        old = self.capacity
+        self.capacity = new_capacity
+        grown = []
+        for a, leaf in zip(self.accs, self.agg.leaves):
+            host = np.asarray(a)
+            padded = np.full((self.P, new_capacity), leaf.identity,
+                             dtype=leaf.dtype)
+            padded[:, :old] = host
+            grown.append(jax.device_put(jnp.asarray(padded),
+                                        self._sharding))
+        self.accs = tuple(grown)
+        dirty = np.zeros((self.P, new_capacity), dtype=bool)
+        dirty[:, :old] = self._dirty
+        self._dirty = dirty
 
     def _put_sharded(self, host_block: np.ndarray) -> jnp.ndarray:
         return jax.device_put(host_block, self._sharding)
@@ -349,8 +369,9 @@ class MeshSessionEngine:
         per_shard = []
         g_max = 0
         for p in range(self.P):
-            used = self.indexes[p].slot_used[:self.capacity]
-            dirty = np.nonzero(self._dirty[p] & used)[0].astype(np.int32)
+            used = self.indexes[p].slot_used
+            dirty = np.nonzero(self._dirty[p][:len(used)]
+                               & used)[0].astype(np.int32)
             per_shard.append(dirty)
             g_max = max(g_max, len(dirty))
         freed = np.asarray(sorted(set(self._freed_ns)), dtype=np.int64)
@@ -418,13 +439,17 @@ class MeshSessionEngine:
                           for i in range(len(self.agg.leaves))]
         if len(key_ids):
             shards = shard_records(key_ids, self.P, self.max_parallelism)
-            accs_host = [np.array(a) for a in self.accs]
+            # inserts first — growth must settle before the host copy
+            # (same contract as MeshWindowEngine.restore)
+            per_shard_slots: Dict[int, np.ndarray] = {}
             for p in range(self.P):
                 mask = shards == p
-                if not mask.any():
-                    continue
-                slots = self.indexes[p].lookup_or_insert(
-                    key_ids[mask], namespaces[mask])
+                if mask.any():
+                    per_shard_slots[p] = self.indexes[p].lookup_or_insert(
+                        key_ids[mask], namespaces[mask])
+            accs_host = [np.array(a) for a in self.accs]
+            for p, slots in per_shard_slots.items():
+                mask = shards == p
                 for acc, vals in zip(accs_host, leaves):
                     acc[p][slots] = vals[mask]
             self.accs = tuple(

@@ -82,11 +82,14 @@ class MeshWindowEngine:
 
         from flink_tpu.state.slot_table import make_slot_index
 
+        # growable per-shard indexes: hot-key skew concentrating (key,
+        # slice) pairs on one shard grows the table instead of killing the
+        # job (SURVEY hard-part (e)); device arrays stay uniform [P, cap]
+        # sized to the LARGEST shard index (SPMD shape requirement)
         self.indexes = [
             make_slot_index(
-                self.capacity, growable=False,
-                full_hint="raise MeshWindowEngine capacity_per_shard (hot-key "
-                          "skew can concentrate keys on one shard)")
+                self.capacity, growable=True,
+                on_grow=lambda old, new: self._shard_index_grew(new))
             for _ in range(self.P)
         ]
         self._sharding = NamedSharding(mesh, P(KEY_AXIS))
@@ -118,6 +121,28 @@ class MeshWindowEngine:
     def _build_steps(self) -> None:
         (self._scatter_step, self._fire_step, self._reset_step,
          self._gather_step) = build_mesh_steps(self.mesh, self.agg)
+
+    def _shard_index_grew(self, new_capacity: int) -> None:
+        """One shard's index outgrew the device column count: widen the
+        [P, capacity] arrays (all shards — SPMD shapes are uniform; the
+        other shards' indexes keep their smaller capacities and simply
+        address a prefix)."""
+        if new_capacity <= self.capacity:
+            return
+        old = self.capacity
+        self.capacity = new_capacity
+        grown = []
+        for a, leaf in zip(self.accs, self.agg.leaves):
+            host = np.asarray(a)
+            padded = np.full((self.P, new_capacity), leaf.identity,
+                             dtype=leaf.dtype)
+            padded[:, :old] = host
+            grown.append(jax.device_put(jnp.asarray(padded),
+                                        self._sharding))
+        self.accs = tuple(grown)
+        dirty = np.zeros((self.P, new_capacity), dtype=bool)
+        dirty[:, :old] = self._dirty
+        self._dirty = dirty
 
 
     def _put_sharded(self, host_block: np.ndarray) -> jnp.ndarray:
@@ -346,8 +371,9 @@ class MeshWindowEngine:
         per_shard = []
         g_max = 0
         for p in range(self.P):
-            used = self.indexes[p].slot_used[:self.capacity]
-            dirty = np.nonzero(self._dirty[p] & used)[0].astype(np.int32)
+            used = self.indexes[p].slot_used
+            dirty = np.nonzero(self._dirty[p][:len(used)]
+                               & used)[0].astype(np.int32)
             per_shard.append(dirty)
             g_max = max(g_max, len(dirty))
         freed = np.asarray(sorted(set(self._freed_ns)), dtype=np.int64)
@@ -406,13 +432,18 @@ class MeshWindowEngine:
                   for i in range(len(self.agg.leaves))]
         if len(key_ids):
             shards = shard_records(key_ids, self.P, self.max_parallelism)
-            accs_host = [np.array(a) for a in self.accs]
+            # resolve ALL slots first: inserts may grow the table
+            # (on_grow widens self.accs / self.capacity), so the host
+            # copy must be taken only after growth has settled
+            per_shard_slots: Dict[int, np.ndarray] = {}
             for p in range(self.P):
                 mask = shards == p
-                if not mask.any():
-                    continue
-                slots = self.indexes[p].lookup_or_insert(
-                    key_ids[mask], namespaces[mask])
+                if mask.any():
+                    per_shard_slots[p] = self.indexes[p].lookup_or_insert(
+                        key_ids[mask], namespaces[mask])
+            accs_host = [np.array(a) for a in self.accs]
+            for p, slots in per_shard_slots.items():
+                mask = shards == p
                 for acc, vals in zip(accs_host, leaves):
                     acc[p][slots] = vals[mask]
             self.accs = tuple(

@@ -268,6 +268,103 @@ class LocalGate(InputGate):
 
 
 # ---------------------------------------------------------------------------
+# Partitioners (reference: streaming/runtime/partitioner/*)
+# ---------------------------------------------------------------------------
+
+
+class Partitioner:
+    """Routes a batch's records to output subpartitions (reference:
+    StreamPartitioner.selectChannel — but vectorized: one call splits a
+    whole batch into per-channel sub-batches)."""
+
+    def partition(self, batch: RecordBatch, num_channels: int
+                  ) -> List[Tuple[int, RecordBatch]]:
+        raise NotImplementedError
+
+
+class KeyGroupPartitioner(Partitioner):
+    """keyBy routing: key -> key group -> owning subtask (reference:
+    KeyGroupStreamPartitioner.java:55)."""
+
+    def __init__(self, key_field: str, max_parallelism: int = 128):
+        self.key_field = key_field
+        self.max_parallelism = max_parallelism
+
+    def partition(self, batch, num_channels):
+        import numpy as np
+
+        from flink_tpu.state.keygroups import (
+            assign_key_groups,
+            hash_keys_to_i64,
+            key_group_to_operator_index,
+        )
+
+        key_ids = hash_keys_to_i64(batch[self.key_field])
+        groups = assign_key_groups(key_ids, self.max_parallelism)
+        targets = key_group_to_operator_index(
+            groups, self.max_parallelism, num_channels)
+        return [(ch, batch.filter(targets == ch))
+                for ch in np.unique(targets).tolist()]
+
+
+class RebalancePartitioner(Partitioner):
+    """Round-robin at batch granularity: each whole micro-batch goes to
+    the next channel (reference: RebalancePartitioner — per record there;
+    the batch IS the unit here, keeping batches device-sized)."""
+
+    def __init__(self):
+        self._next = 0
+
+    def partition(self, batch, num_channels):
+        ch = self._next
+        self._next = (ch + 1) % num_channels
+        return [(ch, batch)]
+
+
+class BroadcastPartitioner(Partitioner):
+    """Every channel sees every record (reference: BroadcastPartitioner —
+    backs broadcast state)."""
+
+    def partition(self, batch, num_channels):
+        return [(ch, batch) for ch in range(num_channels)]
+
+
+class ForwardPartitioner(Partitioner):
+    """Producer subtask i feeds consumer subtask i only (reference:
+    ForwardPartitioner — the chaining-eligible edge)."""
+
+    def __init__(self, producer_index: int):
+        self.producer_index = producer_index
+
+    def partition(self, batch, num_channels):
+        return [(self.producer_index % num_channels, batch)]
+
+
+class RescalePartitioner(Partitioner):
+    """Round-robin over the consumer subset assigned to this producer
+    (reference: RescalePartitioner — locality-friendly redistribution for
+    producer/consumer parallelism ratios)."""
+
+    def __init__(self, producer_index: int, num_producers: int):
+        self.producer_index = producer_index
+        self.num_producers = num_producers
+        self._i = 0
+
+    def partition(self, batch, num_channels):
+        if num_channels >= self.num_producers:
+            per = num_channels // self.num_producers
+            base = self.producer_index * per
+            span = per if self.producer_index < self.num_producers - 1 \
+                else num_channels - base
+        else:
+            base = self.producer_index * num_channels // self.num_producers
+            span = 1
+        ch = base + (self._i % max(span, 1))
+        self._i += 1
+        return [(ch, batch)]
+
+
+# ---------------------------------------------------------------------------
 # Factory registry (reference: ShuffleServiceFactory discovery)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,87 @@
+"""History server: terminal jobs archived by the JobMaster and served
+after the cluster is gone (reference: HistoryServer +
+jobmanager.archive.fs.dir)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from flink_tpu import Configuration
+from flink_tpu.cluster.history_server import HistoryServer, read_archive
+from flink_tpu.cluster.minicluster import MiniCluster
+from flink_tpu.connectors.sinks import CollectSink, DiscardingSink
+from flink_tpu.connectors.sources import DataGenSource
+from flink_tpu.datastream.environment import StreamExecutionEnvironment
+from flink_tpu.runtime.watermarks import WatermarkStrategy
+from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+
+def _submit(cluster, name, fail=False):
+    env = StreamExecutionEnvironment(Configuration({
+        "execution.micro-batch.size": 1000,
+        "restart-strategy.max-attempts": 1,
+    }))
+    src = DataGenSource(total_records=5000, num_keys=50,
+                        events_per_second_of_eventtime=10_000)
+    ds = env.from_source(
+        src, WatermarkStrategy.for_bounded_out_of_orderness(0))
+    if fail:
+        def boom(batch):
+            raise RuntimeError("kaboom")
+
+        ds = ds.map(boom, name="boom")
+    ds.key_by("key").window(TumblingEventTimeWindows.of(1000)) \
+        .sum("value").sink_to(DiscardingSink())
+    client = cluster.submit(env, name)
+    client.wait(timeout=60)
+    return client
+
+
+class TestHistoryServer:
+    def test_terminal_jobs_archived_and_served(self, tmp_path):
+        archive = str(tmp_path / "history")
+        cluster = MiniCluster(Configuration({
+            "cluster.task-executors": 1,
+            "jobmanager.archive.dir": archive,
+            "rest.port": -1,
+        }))
+        try:
+            ok = _submit(cluster, "good-job")
+            bad = _submit(cluster, "bad-job", fail=True)
+        finally:
+            cluster.shutdown()
+
+        # the cluster is GONE; the archive still answers
+        summaries = read_archive(archive)
+        by_name = {s["job_name"]: s for s in summaries}
+        assert by_name["good-job"]["status"] == "FINISHED"
+        assert by_name["bad-job"]["status"] == "FAILED"
+
+        hs = HistoryServer(archive)
+        try:
+            base = f"http://127.0.0.1:{hs.port}"
+            jobs = json.loads(urllib.request.urlopen(
+                f"{base}/jobs", timeout=10).read())["jobs"]
+            assert {j["job_name"] for j in jobs} == {"good-job", "bad-job"}
+            full = json.loads(urllib.request.urlopen(
+                f"{base}/jobs/{ok.job_id}", timeout=10).read())
+            assert full["status"] == "FINISHED"
+            assert full["metrics"]["records_emitted_by_sources"] == 5000
+            assert "state_history" in full
+            failed = json.loads(urllib.request.urlopen(
+                f"{base}/jobs/{bad.job_id}", timeout=10).read())
+            assert "kaboom" in failed["error"]
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"{base}/jobs/nope", timeout=10)
+        finally:
+            hs.close()
+
+    def test_no_archive_dir_no_files(self, tmp_path):
+        cluster = MiniCluster(Configuration({
+            "cluster.task-executors": 1, "rest.port": -1}))
+        try:
+            _submit(cluster, "unarchived")
+        finally:
+            cluster.shutdown()
+        assert read_archive(str(tmp_path / "never-created")) == []

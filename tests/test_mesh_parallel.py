@@ -191,3 +191,64 @@ class TestMeshWindowEngine:
             for k in idx.slot_key[idx.used_slots()].tolist():
                 assert k not in seen, f"key {k} on shards {seen[k]} and {p}"
                 seen[k] = p
+
+
+class TestSkewGrowth:
+    def test_hot_shard_grows_instead_of_failing(self, eight_device_mesh):
+        """Key concentration beyond capacity_per_shard grows the table
+        (SURVEY hard-part (e)) — previously a hard SlotTableFullError."""
+        from flink_tpu.core.records import RecordBatch
+        from flink_tpu.parallel.sharded_windower import MeshWindowEngine
+        from flink_tpu.windowing.aggregates import CountAggregate
+        from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+        eng = MeshWindowEngine(
+            TumblingEventTimeWindows.of(1000), CountAggregate(),
+            eight_device_mesh, capacity_per_shard=1024)
+        n = 10_000  # ~1250 keys/shard on average > 1024 capacity
+        keys = np.arange(n, dtype=np.int64)
+        eng.process_batch(RecordBatch.from_pydict(
+            {"__key_id__": keys}, timestamps=np.zeros(n, dtype=np.int64)))
+        fired = eng.on_watermark(1 << 40)
+        total = sum(int(b["count"].sum()) for b in fired)
+        assert total == n
+        assert eng.capacity > 1024, "table must have grown"
+
+    def test_session_shard_growth(self, eight_device_mesh):
+        from flink_tpu.core.records import RecordBatch
+        from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+        from flink_tpu.windowing.aggregates import CountAggregate
+
+        eng = MeshSessionEngine(50, CountAggregate(), eight_device_mesh,
+                                capacity_per_shard=1024)
+        n = 10_000
+        keys = np.arange(n, dtype=np.int64)
+        eng.process_batch(RecordBatch.from_pydict(
+            {"__key_id__": keys}, timestamps=np.zeros(n, dtype=np.int64)))
+        fired = eng.on_watermark(1 << 40)
+        total = sum(int(b["count"].sum()) for b in fired)
+        assert total == n
+        assert eng.capacity > 1024
+
+    def test_grown_table_restores_into_fresh_engine(self, eight_device_mesh):
+        """A checkpoint of a GROWN table must restore into an engine at the
+        original configured capacity (restore triggers the same growth)."""
+        from flink_tpu.core.records import RecordBatch
+        from flink_tpu.parallel.sharded_windower import MeshWindowEngine
+        from flink_tpu.windowing.aggregates import CountAggregate
+        from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+        n = 10_000
+        keys = np.arange(n, dtype=np.int64)
+        a = MeshWindowEngine(
+            TumblingEventTimeWindows.of(1000), CountAggregate(),
+            eight_device_mesh, capacity_per_shard=1024)
+        a.process_batch(RecordBatch.from_pydict(
+            {"__key_id__": keys}, timestamps=np.zeros(n, dtype=np.int64)))
+        snap = a.snapshot()
+        b = MeshWindowEngine(
+            TumblingEventTimeWindows.of(1000), CountAggregate(),
+            eight_device_mesh, capacity_per_shard=1024)
+        b.restore(snap)
+        fired = b.on_watermark(1 << 40)
+        assert sum(int(bb["count"].sum()) for bb in fired) == n

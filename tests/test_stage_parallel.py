@@ -401,3 +401,62 @@ class TestStageParallelControl:
         t.join(timeout=120)
         assert result, "live window state for key 7 must be queryable"
         assert all(v.get("sum_value", 0) > 0 for v in result.values())
+
+
+class TestPartitioners:
+    """reference: streaming/runtime/partitioner/* — the channel-selection
+    family, vectorized to batch granularity."""
+
+    def _batch(self, keys):
+        from flink_tpu.core.records import RecordBatch
+
+        return RecordBatch.from_pydict(
+            {"k": np.asarray(keys, dtype=np.int64)})
+
+    def test_key_group_partitioner_routes_like_the_stage(self):
+        from flink_tpu.runtime.shuffle_spi import KeyGroupPartitioner
+        from flink_tpu.state.keygroups import (
+            assign_key_groups,
+            hash_keys_to_i64,
+            key_group_to_operator_index,
+        )
+
+        b = self._batch(np.arange(1000))
+        parts = KeyGroupPartitioner("k", 128).partition(b, 4)
+        assert sum(len(p) for _, p in parts) == 1000
+        for ch, p in parts:
+            kid = hash_keys_to_i64(p["k"])
+            g = assign_key_groups(kid, 128)
+            assert (key_group_to_operator_index(g, 128, 4) == ch).all()
+
+    def test_rebalance_round_robins_batches(self):
+        from flink_tpu.runtime.shuffle_spi import RebalancePartitioner
+
+        p = RebalancePartitioner()
+        seen = [p.partition(self._batch([i]), 3)[0][0] for i in range(6)]
+        assert seen == [0, 1, 2, 0, 1, 2]
+
+    def test_broadcast_hits_every_channel(self):
+        from flink_tpu.runtime.shuffle_spi import BroadcastPartitioner
+
+        parts = BroadcastPartitioner().partition(self._batch([1, 2]), 3)
+        assert [ch for ch, _ in parts] == [0, 1, 2]
+        assert all(len(b) == 2 for _, b in parts)
+
+    def test_forward_pins_the_channel(self):
+        from flink_tpu.runtime.shuffle_spi import ForwardPartitioner
+
+        assert ForwardPartitioner(2).partition(
+            self._batch([1]), 4)[0][0] == 2
+
+    def test_rescale_stays_in_the_producer_span(self):
+        from flink_tpu.runtime.shuffle_spi import RescalePartitioner
+
+        # 2 producers, 4 consumers: producer 0 -> {0,1}, producer 1 -> {2,3}
+        p0 = RescalePartitioner(0, 2)
+        p1 = RescalePartitioner(1, 2)
+        chans0 = {p0.partition(self._batch([i]), 4)[0][0]
+                  for i in range(8)}
+        chans1 = {p1.partition(self._batch([i]), 4)[0][0]
+                  for i in range(8)}
+        assert chans0 == {0, 1} and chans1 == {2, 3}
