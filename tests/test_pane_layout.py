@@ -228,7 +228,9 @@ class TestLayoutSelection:
         with pytest.raises(ValueError, match="no spill tier"):
             op.open(OperatorContext(0, 1, 128))
 
-    def test_auto_picks_panes_without_spill(self):
+    def test_auto_resolves_to_slots_until_measured(self):
+        """'auto' stays on the measured incumbent; explicit 'panes' opts
+        into the pane layout (flip once TPU numbers land)."""
         from flink_tpu.runtime.operators import (
             OperatorContext,
             WindowAggOperator,
@@ -237,4 +239,9 @@ class TestLayoutSelection:
         op = WindowAggOperator(
             TumblingEventTimeWindows.of(1000), CountAggregate(), "k")
         op.open(OperatorContext(0, 1, 128))
-        assert type(op.windower).__name__ == "PaneWindower"
+        assert type(op.windower).__name__ == "SliceSharedWindower"
+        op2 = WindowAggOperator(
+            TumblingEventTimeWindows.of(1000), CountAggregate(), "k",
+            window_layout="panes")
+        op2.open(OperatorContext(0, 1, 128))
+        assert type(op2.windower).__name__ == "PaneWindower"
