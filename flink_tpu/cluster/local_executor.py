@@ -39,7 +39,7 @@ from flink_tpu.core.annotations import internal
 
 class _Node:
     __slots__ = ("transformation", "operator", "valve", "children",
-                 "child_input_idx", "records_in", "records_out")
+                 "child_input_idx", "records_in", "records_out", "held_wm")
 
     def __init__(self, transformation: Transformation,
                  operator: Optional[Operator]):
@@ -50,6 +50,11 @@ class _Node:
         self.child_input_idx: List[int] = []
         self.records_in = 0
         self.records_out = 0
+        #: watermark held back while the operator has in-flight async
+        #: fires — forwarded downstream only after their results are
+        #: (see _drain_pending; reference: watermark must not overtake
+        #: the records it covers)
+        self.held_wm: Optional[int] = None
 
 
 class JobCancelledError(RuntimeError):
@@ -307,7 +312,9 @@ class LocalExecutor:
                 par = t.parallelism if t.parallelism else (
                     default_par if t.keyed else 1)
                 ctx = OperatorContext(operator_index=0, parallelism=par,
-                                      max_parallelism=max_parallelism)
+                                      max_parallelism=max_parallelism,
+                                      async_fires=self.config.get(
+                                          BatchOptions.ASYNC_FIRES))
                 op.open(ctx)
             nodes[t.uid] = node
             g = job_group.add_group(f"{t.name}#{t.uid}")
@@ -417,6 +424,9 @@ class LocalExecutor:
             while active:
                 if cancel_event is not None and cancel_event.is_set():
                     raise JobCancelledError(job_name)
+                # harvest landed async fires + release held watermarks
+                # (cheap is_ready() polls when nothing is pending)
+                self._drain_pending(nodes)
                 if pt_nodes:
                     now_ms = int(time.time() * 1000)
                     for n in pt_nodes:
@@ -476,6 +486,11 @@ class LocalExecutor:
                         use_delta = (incremental and last_written_id
                                      is not None
                                      and since_full < full_every)
+                        # in-flight fire results must reach their sinks
+                        # before the cut — the bookkeeper already marked
+                        # those windows fired, so a snapshot without them
+                        # would lose results on restore
+                        self._drain_pending(nodes, wait=True)
                         with traces.span(
                                 "checkpoint",
                                 f"checkpoint-{checkpoint_count}") as sp:
@@ -528,6 +543,7 @@ class LocalExecutor:
             # stop-with-savepoint without --drain: state was saved, in-flight
             # windows intentionally not fired — they resume from the
             # savepoint)
+            self._drain_pending(nodes, wait=True)
             if not suppress_final_drain:
                 for t in graph.nodes:
                     node = nodes[t.uid]
@@ -667,6 +683,7 @@ class LocalExecutor:
                                 raise p.error
                         self._emit_watermark(node, MAX_WATERMARK)
                     stop_sources()
+                self._drain_pending(nodes, wait=True)
                 with traces.span("savepoint", req.path):
                     snap = self.snapshot_all(graph, nodes, source_positions,
                                              savepoint=True)
@@ -740,7 +757,43 @@ class LocalExecutor:
         outs = node.operator.process_watermark(advanced)
         for out in outs:
             self._forward(node, out)
+        if node.operator.has_pending_output():
+            # async fires in flight: the watermark must not overtake the
+            # results it covers — hold it here; _drain_pending releases it
+            # once the fires land (a later watermark simply supersedes)
+            node.held_wm = advanced
+            return
+        node.held_wm = None
         self._emit_watermark(node, advanced)
+
+    def _drain_pending(self, nodes: Dict[int, "_Node"],
+                       wait: bool = False) -> None:
+        """Forward any landed async-fire results; release held watermarks
+        whose fires have all been emitted. With ``wait``, block until every
+        pending output is drained (checkpoint / drain / close boundaries —
+        a snapshot taken with undelivered results would lose them)."""
+        while True:
+            for node in nodes.values():
+                op = node.operator
+                if op is None:
+                    continue
+                if op.has_pending_output():
+                    for out in op.poll_pending_output(wait=wait):
+                        self._forward(node, out)
+                if node.held_wm is not None and not op.has_pending_output():
+                    wm = node.held_wm
+                    node.held_wm = None
+                    self._emit_watermark(node, wm)
+            if not wait:
+                return
+            # a released watermark can cascade new fires in a downstream
+            # window operator — iterate to the fixpoint before returning
+            if not any(
+                    n.operator is not None
+                    and (n.operator.has_pending_output()
+                         or n.held_wm is not None)
+                    for n in nodes.values()):
+                return
 
     def _forward(self, node: _Node, batch) -> None:
         n = len(batch.batch) if isinstance(batch, TaggedBatch) else len(batch)

@@ -170,6 +170,13 @@ class BatchOptions:
     MIN_BATCH_SIZE = ConfigOption(
         "execution.micro-batch.min-size", default=256, type=int,
         description="Lower bound for adaptive batch sizing.")
+    ASYNC_FIRES = ConfigOption(
+        "execution.window.async-fires", default=True, type=bool,
+        description="Dispatch window fires asynchronously: the fire kernel "
+        "and its device->host copies run while the loop keeps ingesting; "
+        "the executor forwards results (and the covering watermark) once "
+        "they land. Hides the device-link round-trip latency behind "
+        "useful work (reference: AsyncExecutionController overlap).")
     IN_FLIGHT_BATCHES = ConfigOption(
         "execution.pipeline.in-flight-batches", default=2, type=int,
         description="Bounded prefetch depth per source: a pump thread "

@@ -60,6 +60,16 @@ class Operator:
         """Release resources without emitting (failure/cancel path; the
         reference's StreamOperator.close vs dispose split)."""
 
+    # asynchronous outputs (deferred window fires — see
+    # flink_tpu.runtime.pending). The executor holds back this operator's
+    # output watermark while pending outputs exist and polls them each
+    # loop iteration (reference: AsyncExecutionController in-flight drain).
+    def has_pending_output(self) -> bool:
+        return False
+
+    def poll_pending_output(self, wait: bool = False) -> List[RecordBatch]:
+        return []
+
     # checkpointing
     def snapshot_state(self) -> Optional[Dict[str, Any]]:
         return None
@@ -72,11 +82,16 @@ class OperatorContext:
     """Per-operator runtime context (task info, metrics hook)."""
 
     def __init__(self, operator_index: int = 0, parallelism: int = 1,
-                 max_parallelism: int = 128, metrics=None):
+                 max_parallelism: int = 128, metrics=None,
+                 async_fires: bool = False):
         self.operator_index = operator_index
         self.parallelism = parallelism
         self.max_parallelism = max_parallelism
         self.metrics = metrics
+        #: the hosting executor supports deferred fire harvesting +
+        #: watermark holdback (LocalExecutor's loop); executors that
+        #: forward watermarks eagerly must leave this off
+        self.async_fires = async_fires
 
 
 class MapOperator(Operator):
@@ -167,6 +182,13 @@ class WindowAggOperator(Operator):
         from collections import deque
 
         self.fire_latencies_ms = deque(maxlen=8192)
+        #: dispatched-but-unharvested fires (FIFO; see poll_pending_output)
+        self._pending = deque()
+        self._async_fires = False
+        #: bound on in-flight fires: beyond it the oldest is harvested
+        #: synchronously (backpressure — pending results are small, but a
+        #: catch-up burst firing hundreds of windows must not hoard buffers)
+        self._max_pending = 32
 
     def open(self, ctx):
         import jax
@@ -234,6 +256,12 @@ class WindowAggOperator(Operator):
                     allowed_lateness=self.allowed_lateness,
                     spill=self.spill,
                     fire_projector=self.fire_projector)
+        # deferred fire harvesting needs both an engine that can dispatch
+        # async (the single-device slot/pane layouts) and an executor that
+        # holds back watermarks while fires are in flight
+        self._async_fires = bool(
+            getattr(ctx, "async_fires", False)
+            and isinstance(self.windower, SliceSharedWindower))
 
     def process_batch(self, batch, input_index=0):
         if self.key_field in batch.columns:
@@ -268,11 +296,51 @@ class WindowAggOperator(Operator):
             return []
         import time as _time
 
+        from flink_tpu.runtime.pending import PendingFire
+
         t0 = _time.perf_counter()
-        fired = self.windower.on_watermark(watermark)
-        if fired:
+        fired = self.windower.on_watermark(
+            watermark, async_ok=self._async_fires) \
+            if self._async_fires else self.windower.on_watermark(watermark)
+        outs = []
+        fired_sync = False
+        for b in fired:
+            if isinstance(b, PendingFire):
+                self._pending.append(b)
+            else:
+                fired_sync = True
+                outs.append(self._reattach_keys(b))
+        if fired_sync:
+            # one sample per watermark advance, like the async path's one
+            # sample per fire-to-harvest span
             self.fire_latencies_ms.append((_time.perf_counter() - t0) * 1e3)
-        return [self._reattach_keys(b) for b in fired]
+        while len(self._pending) > self._max_pending:
+            outs.extend(self._harvest_one())
+        return outs
+
+    def has_pending_output(self) -> bool:
+        return bool(self._pending)
+
+    def poll_pending_output(self, wait: bool = False):
+        outs = []
+        while self._pending:
+            if not wait and not self._pending[0].ready():
+                break
+            outs.extend(self._harvest_one())
+        return outs
+
+    def _harvest_one(self) -> List[RecordBatch]:
+        import time as _time
+
+        pf = self._pending.popleft()
+        batch = pf.harvest()
+        # fire latency = watermark advance (dispatch) -> results on host,
+        # the same span the synchronous path measures
+        self.fire_latencies_ms.append(
+            (_time.perf_counter() - pf.dispatched_at) * 1e3)
+        if batch is None or len(batch) == 0:
+            return []
+        return [self._reattach_keys(batch)]
 
     def on_processing_time(self, now_ms: int):
         if not self.uses_processing_time:
@@ -293,7 +361,21 @@ class WindowAggOperator(Operator):
     def close(self):
         return []
 
+    def dispose(self):
+        self._pending.clear()
+
+    def _check_no_pending(self) -> None:
+        # the hosting executor must drain (and forward) in-flight fires
+        # before a snapshot — silently dropping them here would lose fired
+        # windows that the bookkeeper already marked fired
+        if self._pending:
+            raise RuntimeError(
+                "snapshot with in-flight async fires; the executor must "
+                "drain pending outputs (poll_pending_output(wait=True)) "
+                "before snapshotting")
+
     def snapshot_state(self):
+        self._check_no_pending()
         return {
             "windower": self.windower.snapshot(),
             "key_values": dict(self._key_values),
@@ -305,6 +387,7 @@ class WindowAggOperator(Operator):
         tombstones; host metadata (bookkeeping, key values) is small and
         written full (reference: incremental checkpoints still write fresh
         metadata, only SSTs are shared)."""
+        self._check_no_pending()
         return {
             "windower": self.windower.snapshot(mode="delta"),
             "key_values": dict(self._key_values),
@@ -315,6 +398,7 @@ class WindowAggOperator(Operator):
         """Savepoint variant: full state, but keeps incremental dirty
         tracking intact — a savepoint is a side artifact and must not
         change what the next delta checkpoint contains."""
+        self._check_no_pending()
         return {
             "windower": self.windower.snapshot(mode="savepoint"),
             "key_values": dict(self._key_values),

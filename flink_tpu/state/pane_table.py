@@ -231,6 +231,45 @@ class PaneTable:
         return keys, {name: np.asarray(c)[sel]
                       for name, c in pcols.items()}
 
+    def fire_window_async(self, slice_ends: List[int]):
+        """Async-dispatch variant of fire_window: returns a PendingFire
+        (or None for a no-op window) whose harvest yields (keys, result
+        columns). The key rows backing the result are snapshotted at
+        dispatch (keys are append-only, so rows < used never mutate, but
+        the copy also survives an index grow/realloc)."""
+        from flink_tpu.runtime.pending import PendingFire
+
+        rows = np.asarray(
+            [self.slice_row.get(int(se), 0) for se in slice_ends],
+            dtype=np.int32)
+        if not rows.any():
+            return None
+        used = self.used_cols
+        out = self._fire_rows(self.accs, jnp.asarray(rows), used)
+        if self.fire_projector is None:
+            cols, valid = out
+            names = list(cols.keys())
+            keys_snap = self.index.slot_key[:used].copy()
+
+            def build(host: List[np.ndarray]):
+                sel = host[0][:used]
+                return keys_snap[sel], {
+                    name: col[:used][sel]
+                    for name, col in zip(names, host[1:])}
+
+            return PendingFire([valid] + [cols[n] for n in names], build)
+        pidx, pcols, pvalid = out
+        names = list(pcols.keys())
+        keys_snap = self.index.slot_key[:used].copy()
+
+        def build(host: List[np.ndarray]):
+            pidx_h, sel = host[0], host[1]
+            return keys_snap[pidx_h[sel]], {
+                name: col[sel] for name, col in zip(names, host[2:])}
+
+        return PendingFire([pidx, pvalid] + [pcols[n] for n in names],
+                           build)
+
     # ----------------------------------------------------------------- frees
 
     def free_slices(self, slice_ends: List[int]) -> None:

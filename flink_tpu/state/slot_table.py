@@ -814,17 +814,19 @@ class SlotTable:
         w, k = slot_matrix.shape
         if w == 0:
             return {name: np.empty(0) for name in self.agg.output_names}
+        out = self.agg._fire_jit(
+            self.accs, jnp.asarray(self._pad_fire_matrix(slot_matrix)))
+        return {name: np.asarray(col)[:w] for name, col in out.items()}
+
+    def _pad_fire_matrix(self, slot_matrix: np.ndarray) -> np.ndarray:
+        """Sticky-bucket zero-pad shared by every fire dispatch (sync and
+        async): one padding policy, one compiled-shape family."""
+        w, k = slot_matrix.shape
         wp = sticky_bucket(w, self._fire_bucket, minimum=64)
         self._fire_bucket = wp
-        return self._fire_padded(slot_matrix, wp)
-
-    def _fire_padded(self, slot_matrix: np.ndarray,
-                     bucket: int) -> Dict[str, np.ndarray]:
-        w, k = slot_matrix.shape
-        padded = np.zeros((bucket, k), dtype=np.int32)
+        padded = np.zeros((wp, k), dtype=np.int32)
         padded[:w] = slot_matrix
-        out = self.agg._fire_jit(self.accs, jnp.asarray(padded))
-        return {name: np.asarray(col)[:w] for name, col in out.items()}
+        return padded
 
     def fire_projected(self, slot_matrix: np.ndarray, keys: np.ndarray,
                        projector) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
@@ -837,15 +839,52 @@ class SlotTable:
         if w == 0:
             return np.empty(0, dtype=np.int64), {
                 name: np.empty(0) for name in self.agg.output_names}
-        wp = sticky_bucket(w, self._fire_bucket, minimum=64)
-        self._fire_bucket = wp
-        padded = np.zeros((wp, k), dtype=np.int32)
-        padded[:w] = slot_matrix
         pidx, pcols, pvalid = self.agg._fire_project_jit(projector)(
-            self.accs, jnp.asarray(padded), w)
+            self.accs, jnp.asarray(self._pad_fire_matrix(slot_matrix)), w)
         sel = np.asarray(pvalid)
         return (keys[np.asarray(pidx)[sel]],
                 {name: np.asarray(c)[sel] for name, c in pcols.items()})
+
+    def fire_async(self, slot_matrix: np.ndarray, keys: np.ndarray):
+        """Dispatch a fire and return a PendingFire whose harvest yields
+        (keys, result columns) — no synchronous device round trip (the
+        tunneled-TPU link makes each blocking read ~100 ms; see
+        flink_tpu.runtime.pending)."""
+        from flink_tpu.runtime.pending import PendingFire
+
+        w, _ = slot_matrix.shape
+        if w == 0:
+            return None
+        out = self.agg._fire_jit(
+            self.accs, jnp.asarray(self._pad_fire_matrix(slot_matrix)))
+        names = list(out.keys())
+
+        def build(host: List[np.ndarray]):
+            return keys, {name: col[:w] for name, col in zip(names, host)}
+
+        return PendingFire([out[n] for n in names], build)
+
+    def fire_projected_async(self, slot_matrix: np.ndarray,
+                             keys: np.ndarray, projector):
+        """Async-dispatch variant of fire_projected: same kernel, but the
+        host read of the projected rows is deferred to harvest time."""
+        from flink_tpu.runtime.pending import PendingFire
+
+        w, _ = slot_matrix.shape
+        if w == 0:
+            return None
+        pidx, pcols, pvalid = self.agg._fire_project_jit(projector)(
+            self.accs, jnp.asarray(self._pad_fire_matrix(slot_matrix)), w)
+        names = list(pcols.keys())
+
+        def build(host: List[np.ndarray]):
+            pidx_h, pvalid_h = host[0], host[1]
+            sel = pvalid_h
+            return (keys[pidx_h[sel]],
+                    {name: col[sel]
+                     for name, col in zip(names, host[2:])})
+
+        return PendingFire([pidx, pvalid] + [pcols[n] for n in names], build)
 
     def build_slice_matrix(self, slice_ends: List[int]
                            ) -> Tuple[Optional[np.ndarray],

@@ -82,15 +82,22 @@ class SliceSharedWindower:
 
     # ----------------------------------------------------------------- fire
 
-    def on_watermark(self, watermark: int) -> List[RecordBatch]:
-        """Fire all windows with end - 1 <= watermark. Returns result batches."""
+    def on_watermark(self, watermark: int,
+                     async_ok: bool = False) -> List[RecordBatch]:
+        """Fire all windows with end - 1 <= watermark. Returns result
+        batches — or, with ``async_ok``, PendingFire handles whose harvest
+        yields the batch (the caller owns watermark holdback; see
+        flink_tpu.runtime.pending). Slice frees dispatched after the fires
+        are device-queue-ordered behind them, so deferring the host read
+        never races the reset."""
         out: List[RecordBatch] = []
         while True:
             w_end = self.book.next_window(watermark)
             if w_end is None:
                 break
-            batch = self._fire_window(w_end)
-            if batch is not None and len(batch) > 0:
+            batch = self._fire_window(w_end, async_ok=async_ok)
+            if batch is not None and (not hasattr(batch, "__len__")
+                                      or len(batch) > 0):
                 out.append(batch)
             self.book.mark_fired(w_end)
         expired = self.book.expired_slices(watermark)
@@ -98,7 +105,33 @@ class SliceSharedWindower:
             self.table.free_namespaces(expired)
         return out
 
-    def _fire_window(self, window_end: int) -> Optional[RecordBatch]:
+    def _wrap_pending(self, pending, window_end: int):
+        """Compose the table-level PendingFire (keys, result cols) with the
+        window-metadata column assembly."""
+        if pending is None:
+            return None
+        inner = pending.build
+        w_start = self.assigner.window_start(window_end)
+
+        def build(host):
+            keys, results = inner(host)
+            m = len(keys)
+            if m == 0:
+                return None
+            cols = {
+                KEY_ID_FIELD: keys,
+                WINDOW_START_FIELD: np.full(m, w_start, dtype=np.int64),
+                WINDOW_END_FIELD: np.full(m, window_end, dtype=np.int64),
+                TIMESTAMP_FIELD: np.full(m, window_end - 1, dtype=np.int64),
+            }
+            cols.update(results)
+            return RecordBatch(cols)
+
+        pending.build = build
+        return pending
+
+    def _fire_window(self, window_end: int,
+                     async_ok: bool = False) -> Optional[RecordBatch]:
         slice_ends = self.assigner.slice_ends_for_window(window_end)
         if any(int(se) in self.table.spill for se in slice_ends):
             # hybrid fire: resident slices merge on device, spilled slices
@@ -136,9 +169,16 @@ class SliceSharedWindower:
             if keys is None:
                 return None
         if self.fire_projector is not None:
+            if async_ok:
+                return self._wrap_pending(
+                    self.table.fire_projected_async(
+                        matrix, keys, self.fire_projector), window_end)
             keys, results = self.table.fire_projected(
                 matrix, keys, self.fire_projector)
         else:
+            if async_ok:
+                return self._wrap_pending(
+                    self.table.fire_async(matrix, keys), window_end)
             results = self.table.fire(matrix)
         m = len(keys)
         cols = {
@@ -212,10 +252,15 @@ class PaneWindower(SliceSharedWindower):
         self.book = SliceBookkeeper(assigner, allowed_lateness)
         self.fire_projector = fire_projector
 
-    def _fire_window(self, window_end: int) -> Optional[RecordBatch]:
-        keys, results = self.table.fire_window(
-            [int(se)
-             for se in self.assigner.slice_ends_for_window(window_end)])
+    def _fire_window(self, window_end: int,
+                     async_ok: bool = False) -> Optional[RecordBatch]:
+        slice_ends = [int(se)
+                      for se in self.assigner.slice_ends_for_window(
+                          window_end)]
+        if async_ok:
+            return self._wrap_pending(
+                self.table.fire_window_async(slice_ends), window_end)
+        keys, results = self.table.fire_window(slice_ends)
         if len(keys) == 0:
             return None
         m = len(keys)
