@@ -385,15 +385,53 @@ class _OperatorChain:
             op.restore_state(state, key_group_filter=key_group_filter)
 
 
+def _local_combiner_factory(plan: StagePlan):
+    """A () -> LocalWindowCombiner factory when the keyed stage starts
+    with an aligned event-time window aggregation, else None. Introspects
+    a throwaway operator instance (construction is cheap; open() is what
+    builds device state)."""
+    from flink_tpu.runtime.local_agg import LocalWindowCombiner
+    from flink_tpu.runtime.operators import KeyByOperator, WindowAggOperator
+
+    # the keyed chain opens with the key_by routing op; the aggregation
+    # is the first operator after it
+    head = None
+    for t in plan.keyed_chain:
+        if t.operator_factory is None:
+            return None
+        probe = t.operator_factory()
+        if isinstance(probe, KeyByOperator):
+            continue
+        head = t
+        break
+    if head is None:
+        return None
+    if type(probe) is not WindowAggOperator:
+        return None  # sessions (merging) and non-window heads: no combine
+    if probe.assigner is None or probe.assigner.is_merging or \
+            getattr(probe, "uses_processing_time", False):
+        return None
+
+    def factory():
+        op = head.operator_factory()
+        return LocalWindowCombiner(op.assigner, op.agg, op.key_field)
+
+    return factory
+
+
 class _SourceSubtask(threading.Thread):
     """One source-stage subtask: polls its source split, applies the
-    pre-chain, partitions by key group, emits through the shuffle."""
+    pre-chain, partitions by key group, emits through the shuffle —
+    optionally collapsing each batch to per-(key, slice) partial
+    aggregates first (two-phase agg; flink_tpu/runtime/local_agg.py)."""
 
     def __init__(self, index: int, parallelism: int, plan: StagePlan,
                  graph: StreamGraph, writer, num_keyed: int,
                  max_parallelism: int, batch_size: int,
                  coordinator: "_Coordinator", source,
-                 restore_position=None, batch_mode: bool = False):
+                 restore_position=None, batch_mode: bool = False,
+                 combiner=None):
+        self.combiner = combiner
         super().__init__(name=f"source-subtask-{index}", daemon=True)
         #: bounded/batch execution: no intermediate watermarks, and
         #: sub-batches coalesce into bulk blocks per subpartition before
@@ -418,6 +456,7 @@ class _SourceSubtask(threading.Thread):
         self.wm_gen = plan.source.watermark_strategy.create()
         self.chain: Optional[_OperatorChain] = None
         self.records_out = 0
+        self.records_polled = 0
         self.batches_polled = 0
         from flink_tpu.runtime.shuffle_spi import KeyGroupPartitioner
 
@@ -469,6 +508,7 @@ class _SourceSubtask(threading.Thread):
                 if len(batch) == 0:
                     continue
                 self.batches_polled += 1
+                self.records_polled += len(batch)
                 batch = plan.source.watermark_strategy.assign_timestamps(
                     batch)
                 wm = self.wm_gen.on_batch(batch)
@@ -495,8 +535,14 @@ class _SourceSubtask(threading.Thread):
             raise _SubtaskFailure(
                 f"key field {key_field!r} missing from batch columns "
                 f"{batch.names()}")
-        batch = batch.with_column("__key_id__",
-                                  hash_keys_to_i64(batch[key_field]))
+        if self.combiner is not None:
+            # two-phase agg, local half: at most one row per (key, slice)
+            # leaves this subtask per batch — hot keys collapse here
+            # before they converge on the owning keyed subtask
+            batch = self.combiner.combine(batch)
+        if "__key_id__" not in batch.columns:
+            batch = batch.with_column("__key_id__",
+                                      hash_keys_to_i64(batch[key_field]))
         # the ONE keyBy routing implementation (reference:
         # KeyGroupStreamPartitioner.selectChannel)
         for sub, part in self._partitioner.partition(batch,
@@ -879,6 +925,10 @@ class StageParallelExecutor:
                    for pid in partition_ids]
         gates = [shuffle.create_gate(partition_ids, j) for j in range(N)]
 
+        combiner_factory = None
+        if cfg.get(DeploymentOptions.LOCAL_AGG):
+            combiner_factory = _local_combiner_factory(plan)
+
         sources = []
         import copy as _copy
 
@@ -889,7 +939,8 @@ class StageParallelExecutor:
                 i, S, plan, graph, writers[i], N, max_par, batch_size,
                 coordinator, src,
                 restore_position=restore_positions.get(i),
-                batch_mode=batch_mode))
+                batch_mode=batch_mode,
+                combiner=combiner_factory() if combiner_factory else None))
         shared_sinks: Dict[int, _SharedSink] = {}
         keyed = [_KeyedSubtask(j, N, plan, graph, gates[j], max_par,
                                coordinator, cfg, shared_sinks=shared_sinks)
@@ -975,13 +1026,16 @@ class StageParallelExecutor:
                     pass
 
         elapsed = time.perf_counter() - t0
-        total = sum(s.records_out for s in sources)
+        total = sum(s.records_polled for s in sources)
         metrics = {
             "records": total,
             "elapsed_s": elapsed,
             "records_per_s": total / elapsed if elapsed else 0.0,
             "stage_parallelism": N,
             "source_parallelism": S,
+            # rows that actually crossed the keyed exchange (< records
+            # when the local combiner collapsed them — the two-phase win)
+            "records_shuffled": sum(s.records_out for s in sources),
             "subtask_records_in": [k.records_in for k in keyed],
         }
         if savepoint_path:

@@ -238,6 +238,14 @@ class DeploymentOptions:
         description="In-flight batches allowed per (producer, consumer) "
         "channel before the producer blocks — the credit-based flow "
         "control bound (reference: RemoteInputChannel.unannouncedCredit).")
+    LOCAL_AGG = ConfigOption(
+        "execution.local-agg", default=True, type=bool,
+        description="Two-phase aggregation: pre-aggregate window "
+        "contributions on the source stage before the keyed shuffle "
+        "(at most one row per (key, slice) per batch), shrinking shuffle "
+        "volume and defusing key skew (reference: "
+        "MiniBatchLocalGroupAggFunction / agg-phase-strategy TWO_PHASE). "
+        "Applies when the keyed stage is an aligned window aggregation.")
 
 
 class StateOptions:

@@ -67,16 +67,18 @@ def _pane_kernels(agg: AggregateFunction, projector=None):
     n = len(leaves)
 
     @partial(jax.jit, donate_argnums=(0,))
-    def scatter2d(accs, rows, cols, values):
-        # flat 1-D scatter indices lower better on TPU than 2-D scatter
-        # (the reshape is a bitcast under jit, not a copy)
+    def scatter2d(accs, flat, values):
+        # ONE flat i32 index array crosses host->device per batch (the
+        # tunneled link's bandwidth is the scarce resource — rows/cols
+        # are pre-fused on host; flat 1-D scatter also lowers better on
+        # TPU than 2-D scatter; the reshape is a bitcast under jit)
         C = accs[0].shape[1]
-        flat = rows.astype(jnp.int32) * C + cols.astype(jnp.int32)
+        pad = (flat % C) == 0  # col 0 is the reserved identity column
         vit = iter(values)
         out = []
         for a, m, l in zip(accs[:n], methods, leaves):
             if l.const is not None:
-                v = jnp.where(cols == 0,
+                v = jnp.where(pad,
                               jnp.asarray(l.identity, dtype=l.dtype),
                               jnp.asarray(l.const, dtype=l.dtype))
             else:
@@ -85,7 +87,7 @@ def _pane_kernels(agg: AggregateFunction, projector=None):
             out.append(
                 getattr(a.reshape(-1).at[flat], m)(v).reshape(shape))
         presence = accs[n].reshape(-1).at[flat].max(
-            jnp.where(cols == 0, 0, 1).astype(jnp.int8)
+            jnp.where(pad, 0, 1).astype(jnp.int8)
         ).reshape(accs[n].shape)
         return tuple(out) + (presence,)
 
@@ -101,6 +103,18 @@ def _pane_kernels(agg: AggregateFunction, projector=None):
         return projector.project(cols, valid)
 
     @partial(jax.jit, donate_argnums=(0,))
+    def scatter2d_valued(accs, flat, values):
+        # every leaf valued (locally pre-aggregated partials), each folded
+        # by its own reduce; pad lanes carry leaf identities at flat 0
+        C = accs[0].shape[1]
+        pad = (flat % C) == 0
+        out = [getattr(a.reshape(-1).at[flat], m)(v).reshape(a.shape)
+               for a, m, v in zip(accs[:n], methods, values)]
+        presence = accs[n].reshape(-1).at[flat].max(
+            jnp.where(pad, 0, 1).astype(jnp.int8)).reshape(accs[n].shape)
+        return tuple(out) + (presence,)
+
+    @partial(jax.jit, donate_argnums=(0,))
     def reset_row(accs, row):
         out = [a.at[row].set(jnp.asarray(i, dtype=a.dtype))
                for a, i in zip(accs[:n], idents)]
@@ -114,7 +128,8 @@ def _pane_kernels(agg: AggregateFunction, projector=None):
             jnp.where(cols == 0, 0, 1).astype(jnp.int8))
         return tuple(out) + (presence,)
 
-    _JIT_CACHE[key] = fns = (scatter2d, fire_rows, reset_row, put_row)
+    _JIT_CACHE[key] = fns = (scatter2d, scatter2d_valued, fire_rows,
+                             reset_row, put_row)
     return fns
 
 
@@ -143,8 +158,8 @@ class PaneTable:
         #: exclusive bound of allocated key rows (keys are never freed, so
         #: allocations stay contiguous from 1)
         self._high_water = 1
-        (self._scatter2d, self._fire_rows, self._reset_row,
-         self._put_row) = _pane_kernels(agg, fire_projector)
+        (self._scatter2d, self._scatter2d_valued, self._fire_rows,
+         self._reset_row, self._put_row) = _pane_kernels(agg, fire_projector)
 
     # ---------------------------------------------------------------- sizing
 
@@ -182,28 +197,58 @@ class PaneTable:
 
     # ---------------------------------------------------------------- ingest
 
-    def upsert(self, key_ids: np.ndarray, slice_ends: np.ndarray,
-               values: Tuple[np.ndarray, ...]) -> None:
+    def _flat_indices(self, key_ids: np.ndarray,
+                      slice_ends: np.ndarray) -> np.ndarray:
+        """[n] fused (ring row, key col) -> flat i32 scatter indices — one
+        index array over the host->device link instead of two (fill 0 =
+        reserved identity row 0 / col 0)."""
         cols = self.index.lookup_or_insert(
             key_ids, np.zeros(len(key_ids), dtype=np.int64))
         if len(cols):
             self._high_water = max(self._high_water, int(cols.max()) + 1)
-        # slice -> ring row, vectorized through a small host dict
-        uniq = np.unique(slice_ends)
+        # slice -> ring row: rows for the (few) unique slices via the host
+        # dict, broadcast back per record with the unique-inverse (no
+        # Python-level per-record loop)
+        uniq, inv = np.unique(slice_ends, return_inverse=True)
         for se in uniq.tolist():
             if int(se) not in self.slice_row:
                 self._alloc_row(int(se))
             self._dirty_slices.add(int(se))
-        lut = {se: self.slice_row[int(se)] for se in uniq.tolist()}
-        rows = np.fromiter((lut[int(se)] for se in slice_ends),
-                           dtype=np.int32, count=len(slice_ends))
-        size = sticky_bucket(len(cols), self._scatter_bucket)
+        uniq_rows = np.fromiter(
+            (self.slice_row[int(se)] for se in uniq.tolist()),
+            dtype=np.int64, count=len(uniq))
+        rows = uniq_rows[inv]
+        if self.R * self.capacity > np.iinfo(np.int32).max:
+            raise RuntimeError(
+                f"pane table exceeds int32 flat-index range "
+                f"(ring={self.R} x capacity={self.capacity}); lower "
+                "state.slot-table.capacity or the window's slice count")
+        return (rows * self.capacity + cols).astype(np.int32)
+
+    def upsert(self, key_ids: np.ndarray, slice_ends: np.ndarray,
+               values: Tuple[np.ndarray, ...]) -> None:
+        flat = self._flat_indices(key_ids, slice_ends)
+        size = sticky_bucket(len(flat), self._scatter_bucket)
         self._scatter_bucket = size
         self.accs = self._scatter2d(
             self.accs,
-            pad_i32(rows, size, fill=0),
-            pad_i32(cols.astype(np.int32), size, fill=0),
+            pad_i32(flat, size, fill=0),
             self.agg.pad_input_values(values, size))
+
+    def upsert_valued(self, key_ids: np.ndarray, slice_ends: np.ndarray,
+                      values: Tuple[np.ndarray, ...]) -> None:
+        """Fold locally pre-aggregated partials (every leaf valued; see
+        flink_tpu.runtime.local_agg)."""
+        from flink_tpu.ops.segment_ops import pad_values
+
+        flat = self._flat_indices(key_ids, slice_ends)
+        size = sticky_bucket(len(flat), self._scatter_bucket)
+        self._scatter_bucket = size
+        self.accs = self._scatter2d_valued(
+            self.accs,
+            pad_i32(flat, size, fill=0),
+            tuple(pad_values(np.asarray(v, dtype=l.dtype), size, l.identity)
+                  for v, l in zip(values, self.agg.leaves)))
 
     # ------------------------------------------------------------------ fire
 

@@ -600,12 +600,18 @@ class SlotTable:
             self._evict_cold(protect=protect)
 
     def upsert(self, key_ids: np.ndarray, namespaces: np.ndarray,
-               values: Tuple[np.ndarray, ...]) -> None:
+               values: Tuple[np.ndarray, ...],
+               valued: bool = False) -> None:
         """Spill-safe accumulate: when one batch's working set exceeds the
         device budget, it is processed in namespace groups so only one
         group must be resident at a time (a single namespace whose key set
         alone exceeds the budget is the irreducible limit of
-        namespace-granular spill and fails loudly)."""
+        namespace-granular spill and fails loudly).
+
+        ``valued`` marks locally pre-aggregated input (one explicit value
+        per leaf per row; see flink_tpu.runtime.local_agg) — folded with
+        scatter_valued instead of the map_input scatter."""
+        emit = self.scatter_valued if valued else self.scatter
         namespaces = np.asarray(namespaces, dtype=np.int64)
         if self.max_device_slots:
             # slots are consumed per unique (key, ns) PAIR, not per record
@@ -631,15 +637,15 @@ class SlotTable:
                     slots = self.lookup_or_insert(
                         key_ids[mask], namespaces[mask],
                         _pairs=(pair_k[pmask], pair_ns[pmask]))
-                    self.scatter(slots, tuple(np.asarray(v)[mask]
-                                              for v in values))
+                    emit(slots, tuple(np.asarray(v)[mask]
+                                      for v in values))
                 return
             slots = self.lookup_or_insert(key_ids, namespaces,
                                           _pairs=(pair_k, pair_ns))
-            self.scatter(slots, values)
+            emit(slots, values)
             return
         slots = self.lookup_or_insert(key_ids, namespaces)
-        self.scatter(slots, values)
+        emit(slots, values)
 
     # ------------------------------------------------------------ spill tier
 
@@ -778,6 +784,32 @@ class SlotTable:
         padded_slots = pad_i32(slots, size, fill=0)
         padded_vals = self.agg.pad_input_values(values, size)
         self.accs = self.agg._scatter_jit(self.accs, padded_slots, padded_vals)
+
+    def scatter_valued(self, slots: np.ndarray,
+                       values: Tuple[np.ndarray, ...]) -> None:
+        """Merge pre-aggregated partials: every leaf valued, each folded
+        by its own reduce kind (two-phase aggregation's global side). Pad
+        lanes carry each leaf's identity into the reserved slot 0."""
+        n = len(slots)
+        if n == 0:
+            return
+        self._dirty[slots] = True
+        size = sticky_bucket(n, self._scatter_bucket)
+        self._scatter_bucket = size
+        padded_slots = pad_i32(slots, size, fill=0)
+        padded_vals = tuple(
+            pad_values(np.asarray(v, dtype=l.dtype), size, l.identity)
+            for v, l in zip(values, self.agg.leaves))
+        self.accs = self.agg._scatter_valued_jit(
+            self.accs, padded_slots, padded_vals)
+
+    def upsert_valued(self, key_ids: np.ndarray, namespaces: np.ndarray,
+                      values: Tuple[np.ndarray, ...]) -> None:
+        """Upsert of locally pre-aggregated rows — upsert() with the
+        valued fold, sharing its spill-safe namespace chunking (coalesced
+        batch-mode blocks can merge combined rows from many batches, so
+        the working set is NOT bounded by one batch's pairs)."""
+        self.upsert(key_ids, namespaces, values, valued=True)
 
     def scatter_signed(self, slots: np.ndarray,
                        values: Tuple[np.ndarray, ...]) -> None:

@@ -281,19 +281,48 @@ class AggregateFunction:
         retractable."""
         return all(l.reduce == "sum" for l in self.leaves)
 
-    def map_input_signed(self, batch: RecordBatch,
-                         signs: np.ndarray) -> Tuple[np.ndarray, ...]:
-        """One SIGNED value array per leaf (const leaves materialized):
-        +v for accumulate rows, -v for retraction rows."""
+    def map_input_valued(self, batch: RecordBatch) -> Tuple[np.ndarray, ...]:
+        """One value array per leaf with const leaves materialized — the
+        form needed when every leaf must carry explicit per-row values
+        (local pre-aggregation, retraction folds)."""
         vit = iter(self.map_input(batch))
         out = []
         for leaf in self.leaves:
             if leaf.const is not None:
-                v = np.full(len(batch), leaf.const, dtype=leaf.dtype)
+                out.append(np.full(len(batch), leaf.const, dtype=leaf.dtype))
             else:
-                v = np.asarray(next(vit), dtype=leaf.dtype)
-            out.append(v * signs.astype(leaf.dtype))
+                out.append(np.asarray(next(vit), dtype=leaf.dtype))
         return tuple(out)
+
+    def map_input_signed(self, batch: RecordBatch,
+                         signs: np.ndarray) -> Tuple[np.ndarray, ...]:
+        """One SIGNED value array per leaf (const leaves materialized):
+        +v for accumulate rows, -v for retraction rows."""
+        return tuple(v * signs.astype(v.dtype)
+                     for v in self.map_input_valued(batch))
+
+    @property
+    def _scatter_valued_jit(self):
+        """Scatter where EVERY leaf takes an explicit value array, each
+        folded by its own reduce method — the merge of locally pre-
+        aggregated partials (two-phase aggregation; reference: the
+        local/global split of MiniBatchLocalGroupAggFunction +
+        MiniBatchGlobalGroupAggFunction). Pad lanes must carry each leaf's
+        identity at the reserved slot 0."""
+        methods = tuple(SCATTER_METHOD[l.reduce] for l in self.leaves)
+        key = ("scatter_valued", methods,
+               tuple(l.dtype.str for l in self.leaves))
+        fn = _JIT_CACHE.get(key)
+        if fn is None:
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def scatter_valued(accs, slots, values):
+                return tuple(
+                    getattr(a.at[slots], m)(v)
+                    for a, m, v in zip(accs, methods, values))
+
+            _JIT_CACHE[key] = fn = scatter_valued
+        return fn
 
     @property
     def _scatter_signed_jit(self):

@@ -27,6 +27,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
+from flink_tpu.runtime.local_agg import is_partial_batch, partial_leaf_values
 from flink_tpu.state.slot_table import SlotTable
 from flink_tpu.windowing.aggregates import AggregateFunction
 from flink_tpu.windowing.assigners import WindowAssigner
@@ -77,8 +78,15 @@ class SliceSharedWindower:
             if len(batch) == 0:
                 return
         self.book.register_slices(slice_ends)
-        self.table.upsert(batch.key_ids, slice_ends,
-                          self.agg.map_input(batch))
+        if is_partial_batch(batch):
+            # locally pre-aggregated rows (two-phase agg): fold explicit
+            # per-leaf partials instead of re-mapping raw inputs
+            self.table.upsert_valued(
+                batch.key_ids, slice_ends,
+                partial_leaf_values(batch, self.agg))
+        else:
+            self.table.upsert(batch.key_ids, slice_ends,
+                              self.agg.map_input(batch))
 
     # ----------------------------------------------------------------- fire
 

@@ -128,10 +128,33 @@ int32_t sm_lookup_or_insert(void* h, int64_t n, const int64_t* keys,
                             uint8_t* out_is_new) {
   SlotMap* m = (SlotMap*)h;
   int32_t grows = 0;
-  for (int64_t r = 0; r < n; r++) {
+  // Chunked software prefetch: the table spans far more than L2, so the
+  // bucket probe and the slot_key/slot_ns verify are each a likely cache
+  // miss. Hash a chunk up front, prefetch every home bucket line, then
+  // peek the (now warm) buckets to prefetch the slot rows. Inserts during
+  // processing only make earlier hints stale — hints are never required
+  // for correctness.
+  constexpr int64_t CHUNK = 256;
+  uint64_t hashes[CHUNK];
+  for (int64_t base = 0; base < n; base += CHUNK) {
+    int64_t end = base + CHUNK < n ? base + CHUNK : n;
+    uint64_t pmask = (uint64_t)m->bucket_count - 1;
+    for (int64_t r = base; r < end; r++) {
+      uint64_t hh = mix_hash((uint64_t)keys[r], (uint64_t)nss[r]);
+      hashes[r - base] = hh;
+      __builtin_prefetch(&m->buckets[hh & pmask], 0, 1);
+    }
+    for (int64_t r = base; r < end; r++) {
+      int32_t b = m->buckets[hashes[r - base] & pmask];
+      if (b >= 0) {
+        __builtin_prefetch(&m->slot_key[b], 0, 1);
+        __builtin_prefetch(&m->slot_ns[b], 0, 1);
+      }
+    }
+  for (int64_t r = base; r < end; r++) {
     int64_t k = keys[r], ns = nss[r];
     uint64_t mask = (uint64_t)m->bucket_count - 1;
-    uint64_t i = mix_hash((uint64_t)k, (uint64_t)ns) & mask;
+    uint64_t i = hashes[r - base] & mask;
     for (;;) {
       int32_t b = m->buckets[i];
       if (b == -1) {
@@ -161,6 +184,7 @@ int32_t sm_lookup_or_insert(void* h, int64_t n, const int64_t* keys,
       i = (i + 1) & mask;
     }
   }
+  }
   return grows;
 }
 
@@ -172,18 +196,35 @@ void sm_lookup(void* h, int64_t n, const int64_t* keys, const int64_t* nss,
                int32_t* out_slots) {
   SlotMap* m = (SlotMap*)h;
   uint64_t mask = (uint64_t)m->bucket_count - 1;
-  for (int64_t r = 0; r < n; r++) {
-    int64_t k = keys[r], ns = nss[r];
-    uint64_t i = mix_hash((uint64_t)k, (uint64_t)ns) & mask;
-    out_slots[r] = -1;
-    for (;;) {
-      int32_t b = m->buckets[i];
-      if (b == -1) break;
-      if (m->slot_key[b] == k && m->slot_ns[b] == ns) {
-        out_slots[r] = b;
-        break;
+  constexpr int64_t CHUNK = 256;
+  uint64_t hashes[CHUNK];
+  for (int64_t base = 0; base < n; base += CHUNK) {
+    int64_t end = base + CHUNK < n ? base + CHUNK : n;
+    for (int64_t r = base; r < end; r++) {
+      uint64_t hh = mix_hash((uint64_t)keys[r], (uint64_t)nss[r]);
+      hashes[r - base] = hh;
+      __builtin_prefetch(&m->buckets[hh & mask], 0, 1);
+    }
+    for (int64_t r = base; r < end; r++) {
+      int32_t b = m->buckets[hashes[r - base] & mask];
+      if (b >= 0) {
+        __builtin_prefetch(&m->slot_key[b], 0, 1);
+        __builtin_prefetch(&m->slot_ns[b], 0, 1);
       }
-      i = (i + 1) & mask;
+    }
+    for (int64_t r = base; r < end; r++) {
+      int64_t k = keys[r], ns = nss[r];
+      uint64_t i = hashes[r - base] & mask;
+      out_slots[r] = -1;
+      for (;;) {
+        int32_t b = m->buckets[i];
+        if (b == -1) break;
+        if (m->slot_key[b] == k && m->slot_ns[b] == ns) {
+          out_slots[r] = b;
+          break;
+        }
+        i = (i + 1) & mask;
+      }
     }
   }
 }

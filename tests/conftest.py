@@ -40,3 +40,14 @@ def eight_device_mesh():
     n = len(jax.devices())
     assert n >= 8, f"expected >=8 virtual devices, got {n}"
     return make_mesh(8)
+
+
+def assert_windows_approx_equal(got, expected, rel=1e-4, abs_tol=1e-3):
+    """Per-window compare with float tolerance: the local (two-phase)
+    combiner and parallel folds change f32 summation order, so sums match
+    to ~1 ulp, not bit-exactly. Shared by the stage/batch/shuffle suites."""
+    import pytest as _pytest
+
+    assert set(got) == set(expected)
+    for k in expected:
+        assert got[k] == _pytest.approx(expected[k], rel=rel, abs=abs_tol), k

@@ -49,6 +49,12 @@ def _rows(path):
             for r in JsonLinesFileSink.read_rows(path)}
 
 
+def _assert_windows_equal(got, expected):
+    from tests.conftest import assert_windows_approx_equal
+
+    assert_windows_approx_equal(got, expected)
+
+
 class TestMultiSlotJobs:
     def test_job_spans_executors(self, tmp_path):
         """stage-parallelism 3 on a 2x2-slot cluster: slots come from BOTH
@@ -82,7 +88,7 @@ class TestMultiSlotJobs:
                 f"job must span both executors: {allocated}"
             status = client.wait(timeout=120)
             assert status["status"] == "FINISHED"
-            assert _rows(out) == _expected()
+            _assert_windows_equal(_rows(out), _expected())
             # slots released after completion
             assert sum(i["allocated"]
                        for i in cluster.rm._executors.values()) == 0
@@ -109,7 +115,7 @@ class TestMultiSlotJobs:
             assert status["status"] == "FINISHED"
             result = client.result()
             assert result.metrics["stage_parallelism"] == 3
-            assert _rows(out) == _expected()
+            _assert_windows_equal(_rows(out), _expected())
         finally:
             cluster.shutdown()
 
@@ -152,6 +158,6 @@ class TestMultiSlotJobs:
             status = client.wait(timeout=180)
             assert status["status"] == "FINISHED"
             assert master.attempt >= 1, "job must have restarted"
-            assert _rows(out) == _expected(total=60_000)
+            _assert_windows_equal(_rows(out), _expected(total=60_000))
         finally:
             cluster.shutdown()

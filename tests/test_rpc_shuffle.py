@@ -157,7 +157,9 @@ class TestStageJobOverGrpcShuffle:
                         round(r["sum_value"], 3)
                         for r in s.result().to_rows()}
 
-            assert res(sink) == res(sink2)
+            from tests.conftest import assert_windows_approx_equal
+
+            assert_windows_approx_equal(res(sink), res(sink2))
         finally:
             rpc_a.stop()
             rpc_b.stop()

@@ -51,6 +51,12 @@ def _results(sink):
     return out
 
 
+def _assert_windows_equal(got, expected):
+    from tests.conftest import assert_windows_approx_equal
+
+    assert_windows_approx_equal(got, expected)
+
+
 class TestShuffleSpi:
     def test_local_credit_flow(self):
         from flink_tpu.core.records import RecordBatch
@@ -122,7 +128,7 @@ class TestStageParallelJobs:
         _pipeline(env2, par_sink, assigner_factory())
         result = env2.execute("parallel")
         assert result.metrics["stage_parallelism"] == 4
-        assert _results(par_sink) == expected
+        _assert_windows_equal(_results(par_sink), expected)
 
     def test_records_route_by_key_group(self):
         """Every subtask processes only records of its key-group range, and
@@ -134,7 +140,10 @@ class TestStageParallelJobs:
         per_subtask = result.metrics["subtask_records_in"]
         assert len(per_subtask) == 4
         assert all(c > 0 for c in per_subtask)
-        assert sum(per_subtask) == result.metrics["records"]
+        # with the local combiner (default on) fewer rows cross the
+        # exchange than were polled; every shuffled row must arrive
+        assert sum(per_subtask) == result.metrics["records_shuffled"]
+        assert result.metrics["records_shuffled"] < result.metrics["records"]
 
     def test_stateless_chain_runs_in_source_stage(self):
         sink = CollectSink()
@@ -202,7 +211,7 @@ class TestStageParallelCheckpointing:
         env3.execute("restored", restore_from=ckpt)
         got = _results(sink2)
         got.update(_results(sink3))
-        assert got == expected
+        _assert_windows_equal(got, expected)
 
     def test_restore_across_subtask_counts(self, tmp_path):
         """Checkpoint at parallelism 4, restore at 2 and at single-slot —
@@ -240,7 +249,7 @@ class TestStageParallelCheckpointing:
             env_r.execute(f"restored-{par}", restore_from=ckpt)
             got = _results(sink)
             got.update(_results(sink_r))
-            assert got == expected, f"restore at parallelism {par}"
+            _assert_windows_equal(got, expected)
 
     def test_group_agg_state_restores_across_subtask_counts(self, tmp_path):
         """GroupAgg changelog state is logical (key-indexed): snapshot at
@@ -356,7 +365,7 @@ class TestStageParallelControl:
         env_c.execute("clean")
         got = _results(sink)
         got.update(_results(sink2))
-        assert got == _results(sink_c)
+        _assert_windows_equal(got, _results(sink_c))
 
     def test_state_query_routed_to_owner(self):
         import queue
