@@ -44,16 +44,29 @@ from flink_tpu.runtime.shuffle_spi import (
 
 def _encode(item) -> bytes:
     if isinstance(item, RecordBatch):
-        return cloudpickle.dumps(("batch", dict(item.columns)))
+        # record batches are the bulk bytes: native framed codec
+        # (compressed + CRC, no pickle on the decode fast path) when the
+        # library is available (flink_tpu/native/codec.py; reference:
+        # compiled fast coders + lz4 buffer compression)
+        from flink_tpu.native.codec import codec_available, encode_batch
+
+        if codec_available():
+            return b"B" + encode_batch(item)
+        return b"P" + cloudpickle.dumps(("batch", dict(item.columns)))
     if isinstance(item, Barrier):
-        return cloudpickle.dumps(
+        return b"P" + cloudpickle.dumps(
             ("barrier", (item.checkpoint_id, item.savepoint, item.stop)))
     if item is END_OF_PARTITION:
-        return cloudpickle.dumps(("eop", None))
-    return cloudpickle.dumps(("event", item))
+        return b"P" + cloudpickle.dumps(("eop", None))
+    return b"P" + cloudpickle.dumps(("event", item))
 
 
 def _decode(payload: bytes):
+    tag, payload = payload[:1], memoryview(payload)[1:]
+    if tag == b"B":
+        from flink_tpu.native.codec import decode_batch
+
+        return decode_batch(payload)
     kind, data = cloudpickle.loads(payload)
     if kind == "batch":
         return RecordBatch(data)

@@ -16,43 +16,52 @@ from typing import Optional
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
-_SRC = os.path.join(_REPO_ROOT, "native", "slotmap.cpp")
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
-_SO_PATH = os.path.join(_BUILD_DIR, "_slotmap.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _compile() -> bool:
-    os.makedirs(_BUILD_DIR, exist_ok=True)
-    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-           _SRC, "-o", _SO_PATH]
+def load_native(src_basename: str, so_basename: str) -> Optional[ctypes.CDLL]:
+    """Compile-on-demand ctypes loader shared by every native component
+    (slotmap, codec). Returns the CDLL, or None when disabled
+    (FLINK_TPU_NO_NATIVE=1) or the toolchain/compile is unavailable.
+    The compile writes to a temp name and os.replace()s it into place so
+    concurrent processes never load a half-written .so."""
+    if os.environ.get("FLINK_TPU_NO_NATIVE") == "1":
+        return None
+    src = os.path.join(_REPO_ROOT, "native", src_basename)
+    so_path = os.path.join(_BUILD_DIR, so_basename)
+    if not os.path.exists(so_path) or (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(so_path)):
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        tmp = so_path + f".tmp.{os.getpid()}"
+        cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+               "-std=c++17", src, "-o", tmp]
+        try:
+            r = subprocess.run(cmd, capture_output=True, timeout=120)
+            if r.returncode != 0 or not os.path.exists(tmp):
+                return None
+            os.replace(tmp, so_path)
+        except Exception:
+            return None
     try:
-        r = subprocess.run(cmd, capture_output=True, timeout=120)
-        return r.returncode == 0 and os.path.exists(_SO_PATH)
-    except Exception:
-        return False
+        return ctypes.CDLL(so_path)
+    except OSError:
+        return None
 
 
 def load_slotmap() -> Optional[ctypes.CDLL]:
     """The slotmap library, or None if unavailable/disabled."""
     global _lib, _tried
-    if os.environ.get("FLINK_TPU_NO_NATIVE") == "1":
-        return None
     with _lock:
         if _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO_PATH) or (
-                os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_SO_PATH)):
-            if not _compile():
-                return None
-        try:
-            lib = ctypes.CDLL(_SO_PATH)
-        except OSError:
+        lib = load_native("slotmap.cpp", "_slotmap.so")
+        if lib is None:
             return None
         c = ctypes
         i64, i32, u8, vp = (c.c_int64, c.c_int32, c.c_uint8, c.c_void_p)

@@ -189,6 +189,11 @@ class WindowAggOperator(Operator):
         #: synchronously (backpressure — pending results are small, but a
         #: catch-up burst firing hundreds of windows must not hoard buffers)
         self._max_pending = 32
+        #: per-batch dispatch fences bounding how far the host runs ahead
+        #: of the device queue — keeps fire kernels (and their latency)
+        #: from queueing behind an unbounded scatter backlog
+        self._fences = deque()
+        self._max_dispatch_ahead = 4
 
     def open(self, ctx):
         import jax
@@ -284,6 +289,14 @@ class WindowAggOperator(Operator):
             batch = batch.with_timestamps(
                 np.full(len(batch), now, dtype=np.int64))
         self.windower.process_batch(batch)
+        if self._async_fires:
+            table = getattr(self.windower, "table", None)
+            fence = table.make_fence() if table is not None and hasattr(
+                table, "make_fence") else None
+            if fence is not None:
+                self._fences.append(fence)
+                while len(self._fences) > self._max_dispatch_ahead:
+                    self._fences.popleft().block_until_ready()
         return []
 
     def process_watermark(self, watermark, input_index=0):
@@ -363,6 +376,7 @@ class WindowAggOperator(Operator):
 
     def dispose(self):
         self._pending.clear()
+        self._fences.clear()
 
     def _check_no_pending(self) -> None:
         # the hosting executor must drain (and forward) in-flight fires

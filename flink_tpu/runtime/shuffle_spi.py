@@ -379,6 +379,10 @@ def register_shuffle_service(name: str,
 
 
 def create_shuffle_service(name: str = "local") -> ShuffleService:
+    if name not in _FACTORIES and name == "grpc":
+        # the gRPC transport registers itself on import; configuring
+        # shuffle.service=grpc must not require the user to import it
+        import flink_tpu.cluster.rpc_shuffle  # noqa: F401
     try:
         factory = _FACTORIES[name]
     except KeyError:

@@ -250,6 +250,16 @@ class PaneTable:
             tuple(pad_values(np.asarray(v, dtype=l.dtype), size, l.identity)
                   for v, l in zip(values, self.agg.leaves)))
 
+    def make_fence(self):
+        """Dispatch-depth fence (see SlotTable.make_fence): a [1, 1] slice
+        of the live accumulator, enqueued behind all prior work."""
+        key = ("pane_fence", self.agg.leaves[0].dtype.str)
+        fn = _JIT_CACHE.get(key)
+        if fn is None:
+            fn = jax.jit(lambda a: a[:1, :1])
+            _JIT_CACHE[key] = fn
+        return fn(self.accs[0])
+
     # ------------------------------------------------------------------ fire
 
     def fire_window(self, slice_ends: List[int]

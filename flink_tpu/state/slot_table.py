@@ -27,11 +27,12 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from flink_tpu.state.keygroups import assign_key_groups
-from flink_tpu.windowing.aggregates import AggregateFunction
+from flink_tpu.windowing.aggregates import _JIT_CACHE, AggregateFunction
 from flink_tpu.ops.segment_ops import (
     pad_bucket_size,
     pad_i32,
@@ -784,6 +785,22 @@ class SlotTable:
         padded_slots = pad_i32(slots, size, fill=0)
         padded_vals = self.agg.pad_input_values(values, size)
         self.accs = self.agg._scatter_jit(self.accs, padded_slots, padded_vals)
+
+    def make_fence(self):
+        """A tiny non-donated device value enqueued AFTER everything
+        dispatched so far: its readiness proves the device (and the
+        host->device copies feeding it) caught up to this point. Used to
+        bound how far the task loop's async dispatch runs ahead — without
+        a bound, fire kernels queue behind seconds of scatter backlog and
+        fire latency grows without limit (reference: checkpoint alignment
+        bounds in-flight data the same way; here the scarce resource is
+        the device queue)."""
+        key = ("fence", self.agg.leaves[0].dtype.str)
+        fn = _JIT_CACHE.get(key)
+        if fn is None:
+            fn = jax.jit(lambda a: a[:1])
+            _JIT_CACHE[key] = fn
+        return fn(self.accs[0])
 
     def scatter_valued(self, slots: np.ndarray,
                        values: Tuple[np.ndarray, ...]) -> None:

@@ -1,0 +1,265 @@
+// Columnar RecordBatch wire codec — the native record (de)serializer of the
+// data plane. Role parity: the reference compiles its hot coders to native
+// code (flink-python/pyflink/fn_execution/coder_impl_fast.pyx) and
+// compresses shuffle/state buffers with lz4/snappy JNI (root pom.xml:168);
+// SURVEY.md §2.10 items 5 and 7.
+//
+// Design: one C call encodes a whole columnar batch payload (concatenated
+// raw column buffers) into a self-contained block:
+//
+//   u32 magic 'FTRB' | u16 version | u16 flags | u64 raw_len | u64 enc_len
+//   | u32 crc32(raw) | enc bytes
+//
+// flags bit0: payload is LZ-compressed (greedy byte-level LZ with a 64Ki
+// hash table — FastLZ-class ratio/speed, no external deps). Encoding falls
+// back to stored form when compression does not help. The CRC is over the
+// raw payload, so corruption in transit OR a bad decompression both fail
+// loudly. Column metadata (names/dtypes/offsets) travels in a small
+// Python-built header next to this block: the *bulk bytes* take the native
+// path, the few dozen metadata bytes do not need C++.
+//
+// No object (de)serialization happens here — unlike pickle, a hostile
+// frame can at worst fail the CRC, not execute code.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+// ---------------------------------------------------------------- crc32
+uint32_t crc_table[256];
+bool crc_init_done = false;
+
+void crc_init() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  crc_init_done = true;
+}
+
+uint32_t crc32(const uint8_t* p, uint64_t n) {
+  if (!crc_init_done) crc_init();
+  uint32_t c = 0xFFFFFFFFu;
+  for (uint64_t i = 0; i < n; i++)
+    c = crc_table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ------------------------------------------------------------- LZ codec
+// Greedy LZ77, byte-oriented. Token stream:
+//   literal run:  0x00..0x1F -> (ctrl+1) literal bytes follow
+//   match:        ctrl >= 0x20: len3 = ctrl >> 5 (1..7), offs_hi = ctrl & 0x1F
+//                 if len3 == 7 an extension byte adds to the length
+//                 next byte: offs_lo; offset = (offs_hi << 8 | offs_lo) + 1
+//                 match length = len3 + 2 (+ext)
+// Max offset 8192, min match 3 — the FastLZ level-1 scheme.
+
+constexpr uint32_t KMAX_OFFSET = 8191;
+
+uint64_t lz_compress(const uint8_t* in, uint64_t n, uint8_t* out,
+                     uint64_t out_cap) {
+  if (n < 16) return 0;  // not worth it
+  uint32_t htab[1 << 16];
+  memset(htab, 0, sizeof(htab));
+  uint64_t ip = 0, op = 0;
+  uint64_t lit_start = 0;
+
+  auto hash3 = [&](uint64_t i) -> uint32_t {
+    uint32_t v;
+    memcpy(&v, in + i, 4);
+    return (v * 2654435761u) >> 16;
+  };
+  auto flush_lits = [&](uint64_t end) -> bool {
+    uint64_t len = end - lit_start;
+    while (len > 0) {
+      uint64_t run = len > 32 ? 32 : len;
+      if (op + 1 + run > out_cap) return false;
+      out[op++] = (uint8_t)(run - 1);
+      memcpy(out + op, in + lit_start, run);
+      op += run;
+      lit_start += run;
+      len -= run;
+    }
+    return true;
+  };
+
+  while (ip + 4 < n) {
+    uint32_t h = hash3(ip);
+    uint64_t ref = htab[h];
+    htab[h] = (uint32_t)ip;
+    uint64_t dist = ip - ref;
+    if (ref < ip && dist <= KMAX_OFFSET &&
+        in[ref] == in[ip] && in[ref + 1] == in[ip + 1] &&
+        in[ref + 2] == in[ip + 2]) {
+      // extend
+      uint64_t len = 3;
+      uint64_t max_len = n - ip;
+      while (len < max_len && in[ref + len] == in[ip + len]) len++;
+      if (!flush_lits(ip)) return 0;
+      uint64_t remaining = len;
+      uint64_t offs = dist - 1;
+      while (remaining >= 3) {
+        uint64_t take = remaining;
+        uint64_t l3 = take - 2;
+        if (l3 >= 7) {
+          uint64_t ext = l3 - 7;
+          if (ext > 255) { ext = 255; take = 7 + 2 + 255; }
+          if (op + 3 > out_cap) return 0;
+          out[op++] = (uint8_t)(0xE0 | (offs >> 8));
+          out[op++] = (uint8_t)ext;
+          out[op++] = (uint8_t)(offs & 0xFF);
+        } else {
+          if (op + 2 > out_cap) return 0;
+          out[op++] = (uint8_t)((l3 << 5) | (offs >> 8));
+          out[op++] = (uint8_t)(offs & 0xFF);
+        }
+        remaining -= take;
+        if (remaining > 0 && remaining < 3) {
+          // tail too short for a match token — emit as literals
+          break;
+        }
+      }
+      ip += len - remaining;
+      lit_start = ip;
+      // re-seed hashes inside the match sparsely (every 8th) for speed
+      for (uint64_t j = ip > 8 ? ip - 8 : 0; j + 4 < ip; j += 2)
+        htab[hash3(j)] = (uint32_t)j;
+    } else {
+      ip++;
+    }
+  }
+  if (!flush_lits(n)) return 0;
+  return op;
+}
+
+int lz_decompress(const uint8_t* in, uint64_t n, uint8_t* out,
+                  uint64_t raw_len) {
+  uint64_t ip = 0, op = 0;
+  while (ip < n) {
+    uint8_t ctrl = in[ip++];
+    if (ctrl < 0x20) {
+      uint64_t run = (uint64_t)ctrl + 1;
+      if (ip + run > n || op + run > raw_len) return -1;
+      memcpy(out + op, in + ip, run);
+      ip += run;
+      op += run;
+    } else {
+      uint64_t l3 = ctrl >> 5;
+      uint64_t len = l3 + 2;
+      if (l3 == 7) {
+        if (ip >= n) return -1;
+        len += in[ip++];
+      }
+      if (ip >= n) return -1;
+      uint64_t offs = (((uint64_t)(ctrl & 0x1F)) << 8 | in[ip++]) + 1;
+      if (offs > op || op + len > raw_len) return -1;
+      // overlapping copy must run forward byte-wise
+      const uint8_t* src = out + op - offs;
+      uint8_t* dst = out + op;
+      for (uint64_t i = 0; i < len; i++) dst[i] = src[i];
+      op += len;
+    }
+  }
+  return op == raw_len ? 0 : -1;
+}
+
+constexpr uint32_t MAGIC = 0x42525446u;  // 'FTRB' little-endian
+constexpr uint16_t VERSION = 1;
+constexpr uint64_t HEADER = 4 + 2 + 2 + 8 + 8 + 4;
+
+void put_header(uint8_t* f, uint16_t flags, uint64_t raw_len,
+                uint64_t enc_len, uint32_t crc) {
+  memcpy(f, &MAGIC, 4);
+  memcpy(f + 4, &VERSION, 2);
+  memcpy(f + 6, &flags, 2);
+  memcpy(f + 8, &raw_len, 8);
+  memcpy(f + 16, &enc_len, 8);
+  memcpy(f + 24, &crc, 4);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Encode a raw payload into a framed block. Returns a malloc'd frame via
+// out/out_len (caller frees with codec_free), or -1 on allocation failure.
+// compress=0 forces stored form.
+int codec_encode(const uint8_t* raw, uint64_t raw_len, int compress,
+                 uint8_t** out, uint64_t* out_len) {
+  uint32_t crc = crc32(raw, raw_len);
+  uint8_t* frame = nullptr;
+  if (compress && raw_len >= 64) {
+    uint64_t cap = raw_len - raw_len / 16;  // only keep wins >= ~6%
+    uint8_t* tmp = (uint8_t*)malloc(cap ? cap : 1);
+    if (!tmp) return -1;
+    uint64_t enc = lz_compress(raw, raw_len, tmp, cap);
+    if (enc > 0 && enc < raw_len) {
+      frame = (uint8_t*)malloc(HEADER + enc);
+      if (!frame) { free(tmp); return -1; }
+      put_header(frame, 1, raw_len, enc, crc);
+      memcpy(frame + HEADER, tmp, enc);
+      free(tmp);
+      *out = frame;
+      *out_len = HEADER + enc;
+      return 0;
+    }
+    free(tmp);
+  }
+  frame = (uint8_t*)malloc(HEADER + raw_len);
+  if (!frame) return -1;
+  put_header(frame, 0, raw_len, raw_len, crc);
+  memcpy(frame + HEADER, raw, raw_len);
+  *out = frame;
+  *out_len = HEADER + raw_len;
+  return 0;
+}
+
+// Peek the raw payload size of a frame (for caller-side allocation).
+// Returns raw_len, or -1 if the frame is malformed.
+int64_t codec_raw_len(const uint8_t* frame, uint64_t frame_len) {
+  if (frame_len < HEADER) return -1;
+  uint32_t magic;
+  uint16_t version;
+  memcpy(&magic, frame, 4);
+  memcpy(&version, frame + 4, 2);
+  if (magic != MAGIC || version != VERSION) return -1;
+  uint64_t raw_len;
+  memcpy(&raw_len, frame + 8, 8);
+  return (int64_t)raw_len;
+}
+
+// Decode into a caller-provided buffer of codec_raw_len() bytes.
+// Returns 0 ok, -1 malformed, -2 length mismatch, -3 CRC mismatch.
+int codec_decode(const uint8_t* frame, uint64_t frame_len, uint8_t* out,
+                 uint64_t out_cap) {
+  if (frame_len < HEADER) return -1;
+  uint32_t magic;
+  uint16_t version, flags;
+  uint64_t raw_len, enc_len;
+  uint32_t crc;
+  memcpy(&magic, frame, 4);
+  memcpy(&version, frame + 4, 2);
+  memcpy(&flags, frame + 6, 2);
+  memcpy(&raw_len, frame + 8, 8);
+  memcpy(&enc_len, frame + 16, 8);
+  memcpy(&crc, frame + 24, 4);
+  if (magic != MAGIC || version != VERSION) return -1;
+  if (HEADER + enc_len != frame_len || out_cap < raw_len) return -2;
+  if (flags & 1) {
+    if (lz_decompress(frame + HEADER, enc_len, out, raw_len) != 0)
+      return -1;
+  } else {
+    if (enc_len != raw_len) return -2;
+    memcpy(out, frame + HEADER, raw_len);
+  }
+  if (crc32(out, raw_len) != crc) return -3;
+  return 0;
+}
+
+void codec_free(uint8_t* p) { free(p); }
+
+}  // extern "C"

@@ -23,10 +23,8 @@ def _window_job(env, sink, assigner, total=20_000):
         .key_by("key").window(assigner).sum("value").sink_to(sink)
 
 
-def _approx_equal(got, expected):
-    from tests.conftest import assert_windows_approx_equal
-
-    assert_windows_approx_equal(got, expected)
+from tests.conftest import \
+    assert_windows_approx_equal as _approx_equal  # noqa: E501
 
 
 def _res(sink):

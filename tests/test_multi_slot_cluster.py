@@ -49,10 +49,8 @@ def _rows(path):
             for r in JsonLinesFileSink.read_rows(path)}
 
 
-def _assert_windows_equal(got, expected):
-    from tests.conftest import assert_windows_approx_equal
-
-    assert_windows_approx_equal(got, expected)
+from tests.conftest import \
+    assert_windows_approx_equal as _assert_windows_equal  # noqa: E501
 
 
 class TestMultiSlotJobs:

@@ -51,10 +51,8 @@ def _results(sink):
     return out
 
 
-def _assert_windows_equal(got, expected):
-    from tests.conftest import assert_windows_approx_equal
-
-    assert_windows_approx_equal(got, expected)
+from tests.conftest import \
+    assert_windows_approx_equal as _assert_windows_equal  # noqa: E501
 
 
 class TestShuffleSpi:
