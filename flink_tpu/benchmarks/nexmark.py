@@ -46,6 +46,9 @@ class BidSource(Source):
         self._rng = np.random.default_rng(seed)
 
     def open(self, subtask_index=0, parallelism=1):
+        # full position reset so a re-executed graph replays the stream
+        # (restore_position runs after open on recovery)
+        self._emitted = 0
         self._rng = np.random.default_rng(self.seed + subtask_index)
 
     def poll_batch(self, max_records):

@@ -66,6 +66,12 @@ class CollectionSource(Source):
                    for i in range(0, len(rows), batch_size)]
         return CollectionSource(batches)
 
+    def open(self, subtask_index: int = 0, parallelism: int = 1) -> None:
+        # each execution re-reads the collection from the start (the
+        # reference's fromCollection deploys a fresh source per job;
+        # restore_position runs AFTER open, so recovery still wins)
+        self._i = 0
+
     def poll_batch(self, max_records):
         if self._i >= len(self.batches):
             return None
@@ -106,6 +112,9 @@ class DataGenSource(Source):
         return self.total
 
     def open(self, subtask_index=0, parallelism=1):
+        # full position reset: a re-executed graph re-generates the same
+        # stream (restore_position runs after open on recovery)
+        self._emitted = 0
         self._rng = np.random.default_rng(self.seed + subtask_index)
 
     def poll_batch(self, max_records):

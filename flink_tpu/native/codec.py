@@ -5,12 +5,15 @@ compiled fast coders and lz4/snappy buffer compression (SURVEY.md §2.10
 items 5 and 7; reference: pyflink/fn_execution/coder_impl_fast.pyx,
 root pom.xml:168 lz4-java).
 
-A RecordBatch crosses the wire as:
+A RecordBatch crosses the wire as ONE C++ codec block whose raw payload is
 
-    u32 meta_len | meta (struct-packed column table incl. shapes) | block
+    u32 meta_len | meta (struct-packed column table incl. shapes) | columns
 
-where ``block`` is the C++ codec's framed payload: every column's raw
-buffer concatenated, LZ-compressed when that wins, CRC-protected. Numeric
+— the column metadata rides INSIDE the CRC-protected (and compressed)
+payload, so a bit flip in a dtype string or shape fails the CRC exactly
+like one in the column bytes; nothing outside the block influences what
+gets materialized. ``columns`` is every column's raw buffer concatenated,
+LZ-compressed when that wins, CRC-protected. Numeric
 columns are zero-copy on decode (np.frombuffer views into one contiguous
 decode buffer). Object columns (e.g. original string key values) ride as
 UTF-8/pickle sub-blobs inside the payload — pickle only for non-string
@@ -167,8 +170,8 @@ def encode_batch(batch: RecordBatch, compress: bool = True) -> bytes:
         meta_parts.append(db)
         meta_parts.append(struct.pack(f"<{len(shape)}Q", *shape))
     meta = b"".join(meta_parts)
-    block = _encode_block(b"".join(chunks), compress)
-    return struct.pack("<I", len(meta)) + meta + block
+    return _encode_block(
+        struct.pack("<I", len(meta)) + meta + b"".join(chunks), compress)
 
 
 def decode_batch(data) -> RecordBatch:
@@ -176,10 +179,10 @@ def decode_batch(data) -> RecordBatch:
     the single decode buffer)."""
     import cloudpickle
 
-    view = memoryview(data)
-    (meta_len,) = struct.unpack_from("<I", view, 0)
-    meta = view[4:4 + meta_len]
-    payload = _decode_block(view[4 + meta_len:])
+    decoded = _decode_block(data)
+    (meta_len,) = struct.unpack_from("<I", decoded, 0)
+    meta = decoded[4:4 + meta_len]
+    payload = decoded[4 + meta_len:]
     (ncols,) = struct.unpack_from("<I", meta, 0)
     pos = 4
     cols = {}

@@ -22,6 +22,7 @@ from flink_tpu.core.records import (
 from flink_tpu.datastream.environment import StreamExecutionEnvironment
 from flink_tpu.datastream.stream import DataStream
 from flink_tpu.table import sql_parser
+from flink_tpu.table.optimizer import optimize
 from flink_tpu.table.planner import PlannedTable, PlanError, Planner
 
 _INTERNAL_COLS = (TIMESTAMP_FIELD, KEY_ID_FIELD, ROWKIND_FIELD)
@@ -125,6 +126,8 @@ class StreamTableEnvironment:
 
         self.env = env or StreamExecutionEnvironment.get_execution_environment()
         self._catalog: Dict[str, Table] = {}
+        #: INSERT INTO targets: name -> (sink, declared columns or None)
+        self._sink_tables: Dict[str, tuple] = {}
         #: CREATE MODEL / ML_PREDICT catalog (reference: CatalogModel)
         self.models = ModelRegistry()
 
@@ -163,6 +166,15 @@ class StreamTableEnvironment:
                 "registering a DataStream as a view requires `columns`")
         self._catalog[name] = Table(self, source, columns, time_field)
 
+    def create_sink_table(self, name: str, sink,
+                          columns: Optional[Sequence[str]] = None) -> None:
+        """Register a sink as an INSERT INTO target (the reference's
+        connector sink table registered via CREATE TABLE ... WITH (...);
+        here the sink object is provided programmatically). ``columns``,
+        when given, validates and orders the inserted projection."""
+        self._sink_tables[name] = (
+            sink, list(columns) if columns is not None else None)
+
     def from_data_stream(self, stream: DataStream,
                          columns: Sequence[str],
                          time_field: Optional[str] = None) -> Table:
@@ -182,7 +194,7 @@ class StreamTableEnvironment:
         stmt = sql_parser.parse(sql)
         if not isinstance(stmt, sql_parser.SelectStmt):
             raise PlanError("sql_query expects a SELECT statement")
-        planned = Planner(self).plan_select(stmt)
+        planned = Planner(self).plan_select(optimize(stmt))
         return Table._from_planned(self, planned)
 
     def execute_sql(self, sql: str) -> Optional[TableResult]:
@@ -194,14 +206,50 @@ class StreamTableEnvironment:
             self.models.create_from_options(stmt.name, stmt.options)
             return None
         if isinstance(stmt, sql_parser.CreateView):
-            planned = Planner(self).plan_select(stmt.query)
+            planned = Planner(self).plan_select(optimize(stmt.query))
             self._catalog[stmt.name] = Table._from_planned(self, planned)
             return None
         if isinstance(stmt, sql_parser.InsertInto):
-            target = self.lookup(stmt.table)
-            raise PlanError(
-                "INSERT INTO requires a registered sink table; register a "
-                "sink with create_temporary_view and use "
-                "Table.to_data_stream().sink_to(...) instead")
-        planned = Planner(self).plan_select(stmt)
+            if stmt.table not in self._sink_tables:
+                raise PlanError(
+                    f"INSERT INTO target {stmt.table!r} is not a "
+                    "registered sink table; register one with "
+                    "create_sink_table(name, sink, columns=...) "
+                    f"(known sinks: {sorted(self._sink_tables)})")
+            sink, sink_cols = self._sink_tables[stmt.table]
+            planned = Planner(self).plan_select(optimize(stmt.query))
+            stream = planned.stream
+            if planned.upsert_keys is not None and not getattr(
+                    sink, "supports_changelog", False):
+                # an updating result written to an append-only sink would
+                # record every intermediate per-key update as a fresh row
+                # (reference: "Table sink doesn't support consuming update
+                # changes" — the planner rejects exactly this)
+                raise PlanError(
+                    f"INSERT INTO {stmt.table}: the query produces an "
+                    "updating (changelog) result but the sink is "
+                    "append-only; use a sink with supports_changelog = "
+                    "True, or make the query append-only (e.g. window "
+                    "aggregation instead of plain GROUP BY)")
+            if sink_cols is not None:
+                missing = [c for c in sink_cols
+                           if c not in planned.columns]
+                if missing:
+                    raise PlanError(
+                        f"INSERT INTO {stmt.table}: query does not "
+                        f"produce sink columns {missing} (query columns: "
+                        f"{planned.columns})")
+                # changelog consumers keep the row-kind column so they can
+                # apply retractions
+                cols = tuple(sink_cols) + (
+                    (ROWKIND_FIELD,)
+                    if planned.upsert_keys is not None else ())
+                stream = stream.map(
+                    lambda b, cols=cols: b.select(
+                        *[c for c in cols if c in b.columns]),
+                    name=f"insert_project({stmt.table})")
+            stream.sink_to(sink)
+            result = self.env.execute(f"insert-into-{stmt.table}")
+            return result
+        planned = Planner(self).plan_select(optimize(stmt))
         return TableResult(Table._from_planned(self, planned))

@@ -28,24 +28,26 @@
 namespace {
 
 // ---------------------------------------------------------------- crc32
-uint32_t crc_table[256];
-bool crc_init_done = false;
-
-void crc_init() {
-  for (uint32_t i = 0; i < 256; i++) {
-    uint32_t c = i;
-    for (int k = 0; k < 8; k++)
-      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-    crc_table[i] = c;
+// Table built by a static initializer: runs before any thread can call
+// into the library (a lazy flag would be a data race under the GIL-free
+// ctypes calls of concurrent shuffle threads).
+struct CrcTable {
+  uint32_t t[256];
+  CrcTable() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
   }
-  crc_init_done = true;
-}
+};
+const CrcTable crc_tbl;
 
 uint32_t crc32(const uint8_t* p, uint64_t n) {
-  if (!crc_init_done) crc_init();
   uint32_t c = 0xFFFFFFFFu;
   for (uint64_t i = 0; i < n; i++)
-    c = crc_table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+    c = crc_tbl.t[(c ^ p[i]) & 0xFF] ^ (c >> 8);
   return c ^ 0xFFFFFFFFu;
 }
 
@@ -248,6 +250,7 @@ int codec_decode(const uint8_t* frame, uint64_t frame_len, uint8_t* out,
   memcpy(&enc_len, frame + 16, 8);
   memcpy(&crc, frame + 24, 4);
   if (magic != MAGIC || version != VERSION) return -1;
+  if (flags & ~1u) return -1;  // unknown flag bits: reject, don't guess
   if (HEADER + enc_len != frame_len || out_cap < raw_len) return -2;
   if (flags & 1) {
     if (lz_decompress(frame + HEADER, enc_len, out, raw_len) != 0)
