@@ -698,6 +698,20 @@ class DispatcherEndpoint(RpcEndpoint):
             recovered.append(job_id)
         return recovered
 
+    def job_plan(self, job_id: str) -> dict:
+        """The chained JobGraph of a submitted job (reference: REST
+        /jobs/:id/plan served from JsonPlanGenerator output)."""
+        m = self._masters.get(job_id)
+        if m is None:
+            raise KeyError(job_id)
+        from flink_tpu.core.config import CoreOptions
+        from flink_tpu.graph.job_graph import build_job_graph
+
+        return build_job_graph(
+            m.graph,
+            default_parallelism=m.config.get(
+                CoreOptions.DEFAULT_PARALLELISM)).to_json()
+
     def job_status(self, job_id: str) -> dict:
         m = self._masters.get(job_id)
         if m is None:

@@ -117,6 +117,9 @@ class RestServer:
                 return dict(st, job_id=job_id)
             if parts[2] == "metrics":
                 return self._job_metrics(job_id)
+            if parts[2] == "plan":
+                return {"job_id": job_id,
+                        "plan": self.cluster.dispatcher.job_plan(job_id)}
             if parts[2] == "state" and len(parts) >= 4:
                 return self._query_state(job_id, parts[3], path)
             if parts[2] == "flamegraph":

@@ -93,47 +93,36 @@ class StagePlan:
 
 
 def plan_stages(graph: StreamGraph) -> StagePlan:
-    """Split a linear pipeline at its keyed exchange. Raises StagePlanError
-    for shapes the multi-slot mode doesn't cover yet (multiple sources,
-    joins, side outputs, multiple keyed exchanges) — callers fall back to
+    """Derive the two-stage split from the chained JobGraph
+    (flink_tpu/graph/job_graph.py — the StreamingJobGraphGenerator role):
+    the supported shape is exactly two job vertices joined by one HASH
+    exchange. Raises StagePlanError for anything else (joins, side
+    outputs, broadcast edges, multiple exchanges) — callers fall back to
     single-slot execution."""
+    from flink_tpu.graph.job_graph import HASH, build_job_graph
+
     if len(graph.sources) != 1:
         raise StagePlanError("multi-slot mode requires exactly one source")
-    source = graph.sources[0]
-    pre_chain: List[Transformation] = []
-    keyed_chain: List[Transformation] = []
-    key_field: Optional[str] = None
-    cur = source
-    seen_keyed = False
-    while True:
-        children = graph.children(cur)
-        if not children:
-            break
-        if len(children) != 1:
-            raise StagePlanError(
-                f"multi-slot mode requires a linear pipeline; {cur.name} "
-                f"has {len(children)} consumers")
-        child = children[0]
-        if len(child.inputs) != 1:
-            raise StagePlanError(
-                f"{child.name} has multiple inputs (join/union) — not "
-                "supported in multi-slot mode yet")
-        if child.side_tag is not None or child.broadcast:
-            raise StagePlanError("side outputs / broadcast edges are not "
-                                 "supported in multi-slot mode yet")
-        if child.keyed and not seen_keyed:
-            seen_keyed = True
-            key_field = child.key_field
-        elif child.keyed and seen_keyed and child.key_field != key_field:
-            raise StagePlanError("multiple keyed exchanges are not "
-                                 "supported in multi-slot mode yet")
-        (keyed_chain if seen_keyed else pre_chain).append(child)
-        cur = child
-    if not seen_keyed:
+    jg = build_job_graph(graph, default_parallelism=1,
+                         respect_parallelism=False)
+    if not any(e.ship == HASH for e in jg.edges):
         raise StagePlanError("no keyed exchange — nothing to expand")
-    if keyed_chain[-1].kind != "sink":
+    if len(jg.vertices) != 2 or len(jg.edges) != 1:
+        raise StagePlanError(
+            "multi-slot mode supports a linear source-stage -> "
+            "keyed-stage pipeline; this job graph has "
+            f"{len(jg.vertices)} vertices / {len(jg.edges)} exchanges: "
+            + "; ".join(f"[{v.name}]" for v in jg.vertices))
+    edge = jg.edges[0]
+    src_v = jg.vertices[edge.source_vid]
+    keyed_v = jg.vertices[edge.target_vid]
+    if not src_v.is_source:
+        raise StagePlanError("the exchange's producer stage must begin "
+                             "at the source")
+    if keyed_v.tail.kind != "sink":
         raise StagePlanError("pipeline must end in a sink")
-    return StagePlan(source, pre_chain, keyed_chain, key_field)
+    return StagePlan(src_v.head, src_v.chained[1:], keyed_v.chained,
+                     edge.key_field)
 
 
 # ---------------------------------------------------------------------------

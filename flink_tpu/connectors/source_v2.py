@@ -188,9 +188,13 @@ class SplitSource(Source):
             st.finished = True
         else:
             reader = self.reader_factory(split)
+            # open BEFORE restore: the framework-wide ordering contract is
+            # open() (re)initializes position, restore_position() then
+            # wins on recovery (sources reset in open so re-executed
+            # graphs replay — see connectors/sources.py)
+            reader.open(self._subtask, self._parallelism)
             if reader_pos is not None:
                 reader.restore_position(reader_pos)
-            reader.open(self._subtask, self._parallelism)
             st = _SplitState(split, reader)
         st.last_data_wall = self.clock()
         st.max_ts = max_ts

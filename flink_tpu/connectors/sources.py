@@ -268,3 +268,9 @@ class BinaryFileSource(Source):
 
     def restore_position(self, pos):
         self._pos = pos["pos"]
+        if self._fh is not None and self._pos:
+            # restore after open (the framework-wide ordering): seek the
+            # live handle; restore before open still works via the seek
+            # open() performs. pos 0 = never polled — the handle already
+            # sits just past the header, which byte 0 is not.
+            self._fh.seek(self._pos)

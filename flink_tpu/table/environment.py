@@ -197,9 +197,10 @@ class StreamTableEnvironment:
         planned = Planner(self).plan_select(optimize(stmt))
         return Table._from_planned(self, planned)
 
-    def execute_sql(self, sql: str) -> Optional[TableResult]:
-        """Execute a statement. SELECT returns a TableResult; CREATE VIEW /
-        CREATE MODEL register and return None (reference:
+    def execute_sql(self, sql: str):
+        """Execute a statement. SELECT returns a TableResult; INSERT INTO
+        runs the job eagerly and returns its JobExecutionResult; CREATE
+        VIEW / CREATE MODEL register and return None (reference:
         TableEnvironmentImpl.java:936)."""
         stmt = sql_parser.parse(sql)
         if isinstance(stmt, sql_parser.CreateModel):

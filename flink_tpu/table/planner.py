@@ -745,7 +745,5 @@ def _window_assigner(tvf: ast.WindowTVF):
     raise PlanError(f"unknown window kind {tvf.kind}")
 
 
-def _split_conjuncts(expr: Expr) -> List[Expr]:
-    if isinstance(expr, BinaryOp) and expr.op == "AND":
-        return _split_conjuncts(expr.left) + _split_conjuncts(expr.right)
-    return [expr]
+# one conjunct-flattening implementation for the whole table layer
+from flink_tpu.table.optimizer import split_conjuncts as _split_conjuncts  # noqa: E402

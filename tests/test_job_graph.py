@@ -1,0 +1,178 @@
+"""JobGraph chaining + ExecutionGraph expansion (flink_tpu/graph/job_graph.py).
+
+reference parity: StreamingJobGraphGenerator.isChainable/createChain,
+DefaultExecutionGraph.attachJobGraph, KeyGroupRangeAssignment,
+REST /jobs/:id/plan (JsonPlanGenerator).
+
+Pins: forward one-to-one edges chain; keyed/broadcast/side edges and
+fan-out break chains; parallelism mismatches break chains; ExecutionGraph
+subtasks partition the key-group space exactly; plan_stages derives the
+same split it used to; the REST plan endpoint serves the chained plan.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu import Configuration, StreamExecutionEnvironment
+from flink_tpu.graph.job_graph import (
+    BROADCAST,
+    FORWARD,
+    HASH,
+    ExecutionGraph,
+    build_job_graph,
+)
+from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+
+def _graph(env):
+    return env.get_stream_graph()
+
+
+def _simple_pipeline(env, sink=None, parallelism=None):
+    from flink_tpu.connectors.sinks import CollectSink
+    from flink_tpu.connectors.sources import DataGenSource
+    from flink_tpu.runtime.watermarks import WatermarkStrategy
+
+    ds = env.add_source(
+        DataGenSource(total_records=100, num_keys=10,
+                      events_per_second_of_eventtime=1000),
+        WatermarkStrategy.for_bounded_out_of_orderness(0))
+    ds = ds.map(lambda b: b, name="m1").map(lambda b: b, name="m2")
+    win = ds.key_by("key").window(TumblingEventTimeWindows.of(1000))
+    s = win.sum("value")
+    if parallelism:
+        s.transformation.parallelism = parallelism
+    s.sink_to(sink or CollectSink())
+    return env
+
+
+class TestChaining:
+    def test_linear_pipeline_chains_into_two_vertices(self):
+        env = _simple_pipeline(StreamExecutionEnvironment(Configuration()))
+        jg = build_job_graph(_graph(env), default_parallelism=1)
+        assert len(jg.vertices) == 2
+        assert len(jg.edges) == 1
+        assert jg.edges[0].ship == HASH
+        assert jg.edges[0].key_field == "key"
+        # source + maps chained; keyed agg + sink chained
+        names = [v.name for v in jg.vertices]
+        assert "m1" in names[0] and "m2" in names[0]
+        assert "sink" in names[1]
+
+    def test_parallelism_mismatch_breaks_chain(self):
+        env = StreamExecutionEnvironment(Configuration())
+        from flink_tpu.connectors.sinks import CollectSink
+        from flink_tpu.connectors.sources import DataGenSource
+        from flink_tpu.runtime.watermarks import WatermarkStrategy
+
+        ds = env.add_source(
+            DataGenSource(total_records=10, num_keys=2,
+                          events_per_second_of_eventtime=100),
+            WatermarkStrategy.for_bounded_out_of_orderness(0))
+        m = ds.map(lambda b: b, name="m1")
+        m.transformation.parallelism = 4
+        m.map(lambda b: b, name="m2").sink_to(CollectSink())
+        jg = build_job_graph(_graph(env), default_parallelism=1)
+        # source(1) | m1..sink(4): m2/sink INHERIT m1's parallelism and
+        # chain with it; the 1->4 boundary is a forward exchange
+        assert len(jg.vertices) == 2
+        assert jg.vertices[0].parallelism == 1
+        assert jg.vertices[1].parallelism == 4
+        assert "m2" in jg.vertices[1].name
+        assert all(e.ship == FORWARD for e in jg.edges)
+
+    def test_plan_json_shape(self):
+        env = _simple_pipeline(StreamExecutionEnvironment(Configuration()))
+        plan = build_job_graph(_graph(env), default_parallelism=8).to_json()
+        assert {n["id"] for n in plan["nodes"]} == {0, 1}
+        keyed = [n for n in plan["nodes"] if n.get("key_field")]
+        assert keyed and keyed[0]["parallelism"] == 8
+        assert plan["edges"][0]["ship_strategy"] == HASH
+
+
+class TestExecutionGraph:
+    def test_key_groups_partition_exactly(self):
+        env = _simple_pipeline(StreamExecutionEnvironment(Configuration()))
+        jg = build_job_graph(_graph(env), default_parallelism=4)
+        eg = ExecutionGraph(jg, max_parallelism=128)
+        keyed = [ev for ev in eg.execution_vertices
+                 if ev.key_group_range is not None]
+        assert len(keyed) == 4
+        covered = []
+        for ev in keyed:
+            r = ev.key_group_range
+            covered.extend(range(r.start, r.end + 1))
+        assert sorted(covered) == list(range(128))
+
+    def test_subtask_naming(self):
+        env = _simple_pipeline(StreamExecutionEnvironment(Configuration()))
+        jg = build_job_graph(_graph(env), default_parallelism=2)
+        eg = ExecutionGraph(jg, max_parallelism=16)
+        keyed_v = [v for v in jg.vertices if v.key_field][0]
+        subs = eg.subtasks_of(keyed_v)
+        assert len(subs) == 2
+        assert subs[0].name.endswith("(1/2)")
+
+
+class TestPlanStagesDerivation:
+    def test_supported_shape_still_plans(self):
+        from flink_tpu.cluster.stage_executor import plan_stages
+
+        env = _simple_pipeline(StreamExecutionEnvironment(Configuration()))
+        plan = plan_stages(_graph(env))
+        assert plan.key_field == "key"
+        assert [t.name for t in plan.pre_chain] == ["m1", "m2"]
+        assert plan.keyed_chain[-1].kind == "sink"
+
+    def test_no_keyed_exchange_message_kept(self):
+        from flink_tpu.cluster.stage_executor import (
+            StagePlanError,
+            plan_stages,
+        )
+        from flink_tpu.connectors.sinks import CollectSink
+        from flink_tpu.connectors.sources import DataGenSource
+        from flink_tpu.runtime.watermarks import WatermarkStrategy
+
+        env = StreamExecutionEnvironment(Configuration())
+        env.add_source(
+            DataGenSource(total_records=10, num_keys=2,
+                          events_per_second_of_eventtime=100),
+            WatermarkStrategy.for_bounded_out_of_orderness(0)) \
+           .map(lambda b: b).sink_to(CollectSink())
+        with pytest.raises(StagePlanError, match="no keyed exchange"):
+            plan_stages(_graph(env))
+
+
+class TestRestPlan:
+    def test_plan_endpoint(self):
+        import json
+        import urllib.request
+
+        from flink_tpu.cluster.minicluster import MiniCluster
+        from flink_tpu.connectors.sinks import CollectSink
+        from flink_tpu.connectors.sources import DataGenSource
+        from flink_tpu.runtime.watermarks import WatermarkStrategy
+
+        cluster = MiniCluster(Configuration({"cluster.task-executors": 1}))
+        try:
+            env = StreamExecutionEnvironment(Configuration(
+                {"execution.micro-batch.size": 64}))
+            sink = CollectSink()
+            env.add_source(
+                DataGenSource(total_records=5000, num_keys=10,
+                              events_per_second_of_eventtime=1000),
+                WatermarkStrategy.for_bounded_out_of_orderness(0)) \
+               .key_by("key") \
+               .window(TumblingEventTimeWindows.of(1000)) \
+               .sum("value").sink_to(sink)
+            client = cluster.submit(env, "plan-job")
+            url = (f"http://127.0.0.1:{cluster.rest_port}"
+                   f"/jobs/{client.job_id}/plan")
+            body = json.loads(urllib.request.urlopen(url).read())
+            assert body["job_id"] == client.job_id
+            nodes = body["plan"]["nodes"]
+            assert any(n.get("key_field") == "key" for n in nodes)
+            assert body["plan"]["edges"][0]["ship_strategy"] == "HASH"
+            client.wait(timeout=60)
+        finally:
+            cluster.shutdown()
