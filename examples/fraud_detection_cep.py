@@ -1,0 +1,68 @@
+"""CEP fraud detection — the reference docs' canonical pattern, extended.
+
+A run of small test-charges NOT followed by a normal purchase, then a big
+withdrawal right after — with a negative guard: no verification event may
+occur in between (the fraudster never completes 2FA).
+
+Run: python examples/fraud_detection_cep.py
+"""
+
+import numpy as np
+
+from flink_tpu import Configuration, StreamExecutionEnvironment
+from flink_tpu.cep.operator import CEP
+from flink_tpu.cep.pattern import AfterMatchSkipStrategy, Pattern
+
+
+def main():
+    env = StreamExecutionEnvironment(Configuration(
+        {"execution.micro-batch.size": 64}))
+
+    tx = []
+    # account 1: classic fraud — probes, no verification, big grab
+    for i, (kind, amount) in enumerate(
+            [("charge", 0.5), ("charge", 0.8), ("withdraw", 900.0)]):
+        tx.append({"account": 1, "kind": kind, "amount": amount,
+                   "t": i * 1000})
+    # account 2: same shape but the user verified in between -> not fraud
+    for i, (kind, amount) in enumerate(
+            [("charge", 0.6), ("verify", 0.0), ("withdraw", 800.0)]):
+        tx.append({"account": 2, "kind": kind, "amount": amount,
+                   "t": i * 1000})
+    # watermark pusher
+    tx.append({"account": 99, "kind": "noop", "amount": 0.0, "t": 60_000})
+
+    # SKIP_PAST_LAST_EVENT: one alert per fraud episode (NO_SKIP would
+    # emit every probe-subset combination)
+    pattern = (
+        Pattern.begin("probe",
+                      skip=AfterMatchSkipStrategy.SKIP_PAST_LAST_EVENT)
+        .where(lambda b: (np.asarray(b["kind"]) == "charge")
+               & (np.asarray(b["amount"]) < 1.0))
+        .one_or_more()
+        .not_followed_by("verified")
+        .where(lambda b: np.asarray(b["kind"]) == "verify")
+        .followed_by("grab")
+        .where(lambda b: (np.asarray(b["kind"]) == "withdraw")
+               & (np.asarray(b["amount"]) > 500.0))
+        .within(10_000)
+    )
+
+    alerts = CEP.pattern(
+        env.from_collection(tx, timestamp_field="t").key_by("account"),
+        pattern,
+    ).select(lambda key, match, events: {
+        "account": key,
+        "probes": len(events["probe"]),
+        "amount": events["grab"][0]["amount"],
+    })
+    rows = alerts.execute_and_collect().to_rows()
+    for r in rows:
+        print(f"FRAUD account={r['account']} probes={r['probes']} "
+              f"amount={r['amount']}")
+    assert [r["account"] for r in rows] == [1], rows
+    print("ok: only the unverified account alerted")
+
+
+if __name__ == "__main__":
+    main()

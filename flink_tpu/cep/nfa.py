@@ -20,7 +20,14 @@ Semantics kept from the reference:
 - a match completes as soon as the remaining suffix is all-optional;
 - ``within`` prunes partials whose span exceeds the window;
 - after-match skip: NO_SKIP emits every combination, SKIP_PAST_LAST_EVENT
-  discards partials and events inside the matched span.
+  discards partials and events inside the matched span;
+- negative patterns compile into GUARDS on the following positive stage
+  (notNext: the first event after arrival must not match; notFollowedBy:
+  no event before the stage's first take may match — reference:
+  NotCondition edges); a TRAILING notFollowedBy holds completed matches
+  until the within-window expires, then emits (reference: timestamped
+  releases of not-followed-by matches);
+- ``until`` gates further loop takes once its condition fires.
 """
 
 from __future__ import annotations
@@ -41,13 +48,45 @@ _VIRTUAL = -(1 << 62)  # start_ts marker for the always-active start state
 class _Partial:
     """One computation state (reference: ComputationState.java)."""
 
-    stage: int  # index into pattern.stages
+    stage: int  # index into the EXEC (positive) stage list
     count: int  # takes in the current stage
-    events: Tuple[Tuple[int, int], ...]  # (stage_idx, event_log_idx)
+    events: Tuple[Tuple[int, int], ...]  # (exec_stage_idx, event_log_idx)
     start_ts: int
+    #: log index of the event whose processing created this partial (the
+    #: strict notNext guard applies only to the event right after it)
+    arrived: int = _VIRTUAL
 
     def key(self):
         return (self.stage, self.count, self.events)
+
+
+@dataclasses.dataclass
+class _ExecStage:
+    """A positive stage with its compiled pre-guards. ``pre_negs`` holds
+    (original stage index, strict) for each negative stage the pattern
+    placed immediately before this one; ``tail_negative`` marks the
+    synthetic wait-state a trailing notFollowedBy compiles into."""
+
+    stage: object  # the positive Stage (None for the synthetic tail)
+    orig_idx: int  # condition column in the operator's hit matrix (-1 tail)
+    pre_negs: List[Tuple[int, bool]] = dataclasses.field(
+        default_factory=list)
+    tail_negative: bool = False
+
+
+def compile_stages(pattern: Pattern) -> List[_ExecStage]:
+    out: List[_ExecStage] = []
+    pending_negs: List[Tuple[int, bool]] = []
+    for i, st in enumerate(pattern.stages):
+        if st.negated:
+            pending_negs.append((i, st.contiguity is Contiguity.STRICT))
+            continue
+        out.append(_ExecStage(st, i, pending_negs))
+        pending_negs = []
+    if pending_negs:
+        # trailing notFollowedBy: a wait-state released by within expiry
+        out.append(_ExecStage(None, -1, pending_negs, tail_negative=True))
+    return out
 
 
 @dataclasses.dataclass
@@ -57,6 +96,9 @@ class Match:
     # stage name -> list of event-log indices
     events_by_stage: Dict[str, List[int]] = dataclasses.field(
         default_factory=dict)
+    #: pre-resolved events for matches released by prune() (their log
+    #: entries may be compacted in the same call); None otherwise
+    resolved_events: Optional[Dict[str, List[dict]]] = None
 
 
 class KeyNFA:
@@ -64,26 +106,40 @@ class KeyNFA:
 
     def __init__(self, pattern: Pattern):
         self.pattern = pattern
+        self.exec_stages = compile_stages(pattern)
         # the SharedBuffer analog: events stored once, referenced by index.
         # Indices are absolute; the log is compacted by rebasing on _log_base
         # (prune()) so long-running keys don't grow without bound.
         self.event_log: List[dict] = []
         self._log_base = 0
         self.partials: List[_Partial] = []
-        # suffix_optional[j] == True iff all stages AFTER j are optional
-        n = len(pattern.stages)
+        # suffix_optional[j] == True iff all exec stages AFTER j are
+        # optional (the synthetic tail-negative stage is NOT optional: it
+        # must be waited out)
+        n = len(self.exec_stages)
         self._suffix_optional = [True] * n
         for j in range(n - 2, -1, -1):
+            nxt = self.exec_stages[j + 1]
             self._suffix_optional[j] = (
                 self._suffix_optional[j + 1]
-                and pattern.stages[j + 1].min_times == 0)
+                and not nxt.tail_negative
+                and nxt.stage.min_times == 0)
+        # exec stage index -> until-condition column offset (appended
+        # after the pattern-stage columns in the operator's hit matrix)
+        self._until_col: Dict[int, int] = {}
+        k = 0
+        for j, es in enumerate(self.exec_stages):
+            if not es.tail_negative \
+                    and es.stage.until_condition is not None:
+                self._until_col[j] = k
+                k += 1
 
     def _start_stages(self) -> List[int]:
-        """Stage indices a fresh match may begin at (0 plus the stages behind
-        an all-optional prefix)."""
+        """Exec-stage indices a fresh match may begin at (0 plus the
+        stages behind an all-optional prefix)."""
         out = [0]
-        for j, st in enumerate(self.pattern.stages[:-1]):
-            if st.min_times == 0:
+        for j, es in enumerate(self.exec_stages[:-1]):
+            if not es.tail_negative and es.stage.min_times == 0:
                 out.append(j + 1)
             else:
                 break
@@ -93,10 +149,12 @@ class KeyNFA:
 
     def advance(self, event: dict, ts: int,
                 stage_hits: List[bool]) -> List[Match]:
-        """Feed one event (with precomputed per-stage condition booleans);
+        """Feed one event (with precomputed per-stage condition booleans;
+        until-condition columns appended after the pattern stages);
         returns completed matches."""
-        stages = self.pattern.stages
+        exec_stages = self.exec_stages
         within = self.pattern.within_ms
+        n_stages = len(self.pattern.stages)
         skip_past = (self.pattern.skip
                      is AfterMatchSkipStrategy.SKIP_PAST_LAST_EVENT)
 
@@ -106,11 +164,12 @@ class KeyNFA:
         new_partials: List[_Partial] = []
         seen = set()
 
-        def emit(start_ts: int, taken) -> None:
+        def emit(start_ts: int, taken, end_ts: int = ts) -> None:
             by_stage: Dict[str, List[int]] = {}
             for si, ei in taken:
-                by_stage.setdefault(stages[si].name, []).append(ei)
-            matches.append(Match(start_ts, ts, by_stage))
+                by_stage.setdefault(exec_stages[si].stage.name,
+                                    []).append(ei)
+            matches.append(Match(start_ts, end_ts, by_stage))
 
         def add(p: _Partial) -> None:
             k = p.key()
@@ -124,42 +183,84 @@ class KeyNFA:
         matched_now = False
         for p in candidates:
             virtual = p.start_ts == _VIRTUAL
+            st = exec_stages[p.stage]
             if (not virtual and within is not None
                     and ts - p.start_ts > within):
+                if st.tail_negative:
+                    # a trailing notFollowedBy survived its whole window:
+                    # the match releases at the expiry timestamp. This
+                    # does NOT trigger skip-past pruning — the released
+                    # span lies entirely before the current event, so
+                    # partials this event starts are outside it.
+                    emit(p.start_ts, p.events, end_ts=p.start_ts + within)
                 continue  # timed out (reference: pruning on within)
-            st = stages[p.stage]
-            hit = bool(stage_hits[p.stage])
-            can_take = hit and (st.max_times is None or p.count < st.max_times)
+            # pre-guards: negative stages compiled onto this stage apply
+            # while it has not taken yet (notNext only to the event right
+            # after arrival — reference: NotCondition edges)
+            if p.count == 0 and st.pre_negs and not virtual:
+                killed = False
+                for neg_idx, strict in st.pre_negs:
+                    if strict and log_idx != p.arrived + 1:
+                        continue
+                    if bool(stage_hits[neg_idx]):
+                        killed = True
+                        break
+                if killed:
+                    continue
+            if st.tail_negative:
+                add(p)  # waiting out the window (guards checked above)
+                continue
+            hit = bool(stage_hits[st.orig_idx])
+            until_hit = (st.stage.until_condition is not None
+                         and bool(stage_hits[n_stages
+                                             + self._until_col[p.stage]]))
+            can_take = hit and not until_hit and (
+                st.stage.max_times is None
+                or p.count < st.stage.max_times)
             if can_take:
                 start_ts = ts if virtual else p.start_ts
                 taken = p.events + ((p.stage, log_idx),)
                 count = p.count + 1
-                if count >= st.min_times and self._suffix_optional[p.stage]:
+                if count >= st.stage.min_times \
+                        and self._suffix_optional[p.stage]:
                     emit(start_ts, taken)
                     matched_now = True
                     if skip_past:
                         break
-                if st.max_times is None or count < st.max_times:
-                    add(_Partial(p.stage, count, taken, start_ts))
-                if count >= st.min_times:
+                if st.stage.max_times is None \
+                        or count < st.stage.max_times:
+                    add(_Partial(p.stage, count, taken, start_ts,
+                                 arrived=log_idx))
+                if count >= st.stage.min_times:
                     # PROCEED: wait in the next stage, chaining past any
                     # optional stages (each may be skipped entirely)
                     j = p.stage + 1
-                    while j < len(stages):
-                        add(_Partial(j, 0, taken, start_ts))
-                        if stages[j].min_times == 0:
+                    while j < len(exec_stages):
+                        add(_Partial(j, 0, taken, start_ts,
+                                     arrived=log_idx))
+                        nxt = exec_stages[j]
+                        if not nxt.tail_negative \
+                                and nxt.stage.min_times == 0:
                             j += 1
                         else:
                             break
-                if st.combinations and not virtual and p.count > 0:
-                    add(p)  # allowCombinations: also skip the matching event
+                if st.stage.combinations and not virtual and p.count > 0:
+                    add(p)  # allowCombinations: also skip the match event
             elif virtual:
                 continue  # a start that doesn't start is nothing
-            elif not hit:
-                if p.count == 0 and st.contiguity is Contiguity.STRICT \
+            elif not hit or until_hit:
+                if until_hit:
+                    # the stop condition closes the loop for good: a
+                    # waiting partial (any count) can never take again —
+                    # satisfied loops live on through the proceed
+                    # branches spawned at their last take (reference:
+                    # until stops accepting elements into the loop)
+                    continue
+                if p.count == 0 \
+                        and st.stage.contiguity is Contiguity.STRICT \
                         and p.stage > 0:
                     continue  # 'next' stage missed its immediate event
-                if p.count > 0 and st.consecutive_internal:
+                if p.count > 0 and st.stage.consecutive_internal:
                     continue  # consecutive() loop broken
                 add(p)  # IGNORE: keep waiting (relaxed)
             else:
@@ -171,7 +272,7 @@ class KeyNFA:
         if matched_now and skip_past:
             # discard every other partial match (the reference's
             # skipPastLastEvent prunes computation states, NOT future
-            # events — the next event starts fresh); the break above also
+            # events — the break above also
             # kept this event out of any new partial
             self.partials = []
             return matches
@@ -181,14 +282,32 @@ class KeyNFA:
     def event(self, abs_idx: int) -> dict:
         return self.event_log[abs_idx - self._log_base]
 
-    def prune(self, watermark: int) -> None:
+    def prune(self, watermark: int) -> List[Match]:
         """Drop timed-out partials and compact the event log below the
         lowest index any live partial still references (the reference
-        SharedBuffer's ref-counting, done as a rebase)."""
+        SharedBuffer's ref-counting, done as a rebase). Returns matches
+        RELEASED by the pruning: a trailing notFollowedBy partial whose
+        window expired without the forbidden event completes here (the
+        reference's timestamped not-followed-by releases)."""
+        matches: List[Match] = []
         within = self.pattern.within_ms
         if within is not None:
-            self.partials = [p for p in self.partials
-                             if watermark - p.start_ts <= within]
+            keep: List[_Partial] = []
+            for p in self.partials:
+                if watermark - p.start_ts <= within:
+                    keep.append(p)
+                elif self.exec_stages[p.stage].tail_negative:
+                    # resolve events NOW — the compaction below may drop
+                    # the log entries this released match references
+                    by_stage: Dict[str, List[int]] = {}
+                    resolved: Dict[str, List[dict]] = {}
+                    for si, ei in p.events:
+                        name = self.exec_stages[si].stage.name
+                        by_stage.setdefault(name, []).append(ei)
+                        resolved.setdefault(name, []).append(self.event(ei))
+                    matches.append(Match(p.start_ts, p.start_ts + within,
+                                         by_stage, resolved))
+            self.partials = keep
         next_idx = self._log_base + len(self.event_log)
         if not self.partials:
             min_ref = next_idx
@@ -197,6 +316,7 @@ class KeyNFA:
         if min_ref > self._log_base:
             del self.event_log[: min_ref - self._log_base]
             self._log_base = min_ref
+        return matches
 
     @property
     def empty(self) -> bool:
@@ -216,5 +336,6 @@ class KeyNFA:
         self._log_base = snap.get("log_base", 0)
         self.partials = [
             _Partial(d["stage"], d["count"],
-                     tuple(tuple(e) for e in d["events"]), d["start_ts"])
+                     tuple(tuple(e) for e in d["events"]), d["start_ts"],
+                     arrived=d.get("arrived", _VIRTUAL))
             for d in snap["partials"]]

@@ -53,9 +53,14 @@ class CepOperator(Operator):
                       ) -> List[RecordBatch]:
         if len(batch) == 0:
             return []
-        # vectorized: one mask per stage over the whole batch
-        hits = np.stack([st.evaluate(batch) for st in self.pattern.stages],
-                        axis=1)  # [n, n_stages]
+        # vectorized: one mask per stage over the whole batch, with
+        # until-condition columns appended (same pattern-order the NFA's
+        # _until_col mapping assumes)
+        cols = [st.evaluate(batch) for st in self.pattern.stages]
+        cols.extend(
+            np.asarray(st.until_condition(batch), dtype=bool)
+            for st in self.pattern.stages if st.until_condition is not None)
+        hits = np.stack(cols, axis=1)  # [n, n_stages + n_untils]
         kids = batch.key_ids
         tss = batch.timestamps
         rows = batch.to_rows()
@@ -95,10 +100,19 @@ class CepOperator(Operator):
                         self._key_values.get(k, k), m, events))
                     out_ts.append(m.end_ts)
         # prune EVERY key (idle keys must release within-expired partials
-        # and their event logs), dropping empty per-key state entirely
+        # and their event logs), dropping empty per-key state entirely.
+        # Pruning can RELEASE matches: a trailing notFollowedBy completes
+        # when its window expires without the forbidden event.
         for k in list(self._nfas):
             nfa = self._nfas[k]
-            nfa.prune(watermark)
+            for m in nfa.prune(watermark):
+                events = {
+                    st.name: list((m.resolved_events or {}).get(st.name,
+                                                                []))
+                    for st in self.pattern.stages}
+                out_rows.append(self.select(
+                    self._key_values.get(k, k), m, events))
+                out_ts.append(m.end_ts)
             if nfa.empty:
                 del self._nfas[k]
         for k in [k for k, v in self._pending.items() if not v]:

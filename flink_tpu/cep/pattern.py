@@ -46,6 +46,14 @@ class Stage:
     # loop, yielding non-adjacent combinations (reference: followedByAny
     # internal strategy)
     combinations: bool = False
+    #: negative pattern (notNext / notFollowedBy): an event matching this
+    #: stage's condition INVALIDATES partial matches instead of extending
+    #: them (reference: Pattern.notNext/notFollowedBy + NotCondition)
+    negated: bool = False
+    #: oneOrMore().until(cond): the loop stops accepting events once an
+    #: event satisfies cond (the until event itself is not consumed by the
+    #: loop; reference: Pattern.until / IterativeCondition stop condition)
+    until_condition: Optional[Callable[[RecordBatch], np.ndarray]] = None
 
     def evaluate(self, batch: RecordBatch) -> np.ndarray:
         if self.condition is None:
@@ -96,6 +104,21 @@ class Pattern:
     def followed_by(self, name: str) -> "Pattern":
         return self._append(Stage(name, contiguity=Contiguity.RELAXED))
 
+    def not_next(self, name: str) -> "Pattern":
+        """The event immediately after the previous stage's match must NOT
+        satisfy this stage (reference: Pattern.notNext)."""
+        return self._append(Stage(name, contiguity=Contiguity.STRICT,
+                                  negated=True))
+
+    def not_followed_by(self, name: str) -> "Pattern":
+        """No event between the previous stage's match and the following
+        stage's match may satisfy this stage (reference:
+        Pattern.notFollowedBy). As the LAST stage it requires within():
+        the match emits once the window expires without the forbidden
+        event."""
+        return self._append(Stage(name, contiguity=Contiguity.RELAXED,
+                                  negated=True))
+
     # -- stage modifiers (apply to the LAST stage) ---------------------------
 
     def where(self, condition: Callable[[RecordBatch], np.ndarray]
@@ -126,6 +149,21 @@ class Pattern:
     def one_or_more(self) -> "Pattern":
         return self._amend_last(min_times=1, max_times=None)
 
+    def times_or_more(self, n: int) -> "Pattern":
+        """At least n takes, unbounded above (reference:
+        Pattern.timesOrMore)."""
+        return self._amend_last(min_times=n, max_times=None)
+
+    def until(self, condition: Callable[[RecordBatch], np.ndarray]
+              ) -> "Pattern":
+        """Stop the last stage's loop once an event satisfies
+        ``condition`` (reference: Pattern.until — only meaningful on an
+        unbounded quantifier)."""
+        if self.stages[-1].max_times is not None:
+            raise ValueError("until() applies to oneOrMore()/"
+                             "timesOrMore() stages")
+        return self._amend_last(until_condition=condition)
+
     def allow_combinations(self) -> "Pattern":
         """reference: Pattern.allowCombinations()."""
         return self._amend_last(combinations=True)
@@ -150,6 +188,38 @@ class Pattern:
         names = [s.name for s in self.stages]
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate stage names: {names}")
-        if all(s.min_times == 0 for s in self.stages):
+        positives = [s for s in self.stages if not s.negated]
+        if not positives:
+            raise ValueError("pattern needs at least one positive stage")
+        if all(s.min_times == 0 for s in positives):
             raise ValueError("pattern cannot be entirely optional")
+        if self.stages[0].negated:
+            raise ValueError("a pattern cannot begin with notNext/"
+                             "notFollowedBy (reference restriction)")
+        for s in self.stages:
+            if s.negated and (s.min_times != 1 or s.max_times != 1
+                              or s.combinations):
+                raise ValueError(
+                    f"negative stage {s.name!r} cannot carry quantifiers "
+                    "(reference: not-patterns reject oneOrMore/times)")
+            if s.negated and s.condition is None:
+                raise ValueError(
+                    f"negative stage {s.name!r} needs a where() condition")
+        for i, s in enumerate(self.stages[:-1]):
+            nxt = self.stages[i + 1]
+            if s.negated and not nxt.negated and nxt.min_times == 0:
+                raise ValueError(
+                    f"negative stage {s.name!r} cannot precede optional "
+                    f"stage {nxt.name!r}: the branch that skips the "
+                    "optional stage would lose the guard (reference: "
+                    "notFollowedBy/notNext before optional is rejected)")
+        if self.stages[-1].negated:
+            if self.stages[-1].contiguity is Contiguity.STRICT:
+                raise ValueError("a pattern cannot end with notNext "
+                                 "(reference restriction)")
+            if self.within_ms is None:
+                raise ValueError(
+                    "a pattern ending with notFollowedBy requires "
+                    "within() — the match emits at window expiry "
+                    "(reference restriction)")
         return self

@@ -115,6 +115,16 @@ def _resolve_parallelisms(graph: StreamGraph,
             out[t.uid] = out[t.inputs[0].uid]
         else:
             out[t.uid] = 1
+    # backward pass: a key_by routing marker without explicit parallelism
+    # adopts its same-key consumer's (the reference has no keyBy operator
+    # at all — partitioning is an edge property; the marker must not
+    # force an extra exchange by disagreeing with the operator it feeds)
+    for t in reversed(graph.nodes):
+        if t.keyed and not t.parallelism:
+            children = graph.children(t)
+            if len(children) == 1 and children[0].keyed \
+                    and children[0].key_field == t.key_field:
+                out[t.uid] = out[children[0].uid]
     return out
 
 
@@ -137,11 +147,15 @@ def _partitioning(graph: StreamGraph) -> Dict[int, Optional[str]]:
 
 
 def _edge_ship(child: Transformation,
-               upstream_partition: Optional[str]
+               upstream_partition: Optional[str],
+               same_parallelism: bool = True
                ) -> Tuple[str, Optional[str]]:
     if child.keyed:
-        if upstream_partition == child.key_field:
-            return FORWARD, None  # already partitioned by this key
+        if upstream_partition == child.key_field and same_parallelism:
+            # already partitioned by this key AND 1:1 subtasks — a
+            # parallelism change re-shuffles even on the same key (the
+            # consumer's key-group ranges differ)
+            return FORWARD, None
         return HASH, child.key_field
     if child.broadcast:
         return BROADCAST, None
@@ -158,10 +172,14 @@ def is_chainable(graph: StreamGraph, up: Transformation,
     forward edge, equal parallelism, single input on the downstream side."""
     if len(down.inputs) != 1 or len(graph.children(up)) != 1:
         return False
-    ship, _ = _edge_ship(down, upstream_partition)
+    # with respect_parallelism off (stage planning), per-operator
+    # parallelism is advisory — stages get their counts from config, so
+    # a same-key edge stays forward regardless of the advisory values
+    same_par = (not respect_parallelism) or par[up.uid] == par[down.uid]
+    ship, _ = _edge_ship(down, upstream_partition, same_parallelism=same_par)
     if ship != FORWARD:
         return False
-    return (not respect_parallelism) or par[up.uid] == par[down.uid]
+    return same_par
 
 
 def build_job_graph(graph: StreamGraph,
@@ -199,7 +217,9 @@ def build_job_graph(graph: StreamGraph,
             sv, tv = vertex_of[inp.uid], vertex_of[t.uid]
             if sv.vid == tv.vid:
                 continue  # chained: direct call, no exchange
-            ship, key = _edge_ship(t, part.get(inp.uid))
+            ship, key = _edge_ship(
+                t, part.get(inp.uid),
+                same_parallelism=par[inp.uid] == par[t.uid])
             edges.append(JobEdge(sv.vid, tv.vid, ship, key, t.side_tag))
     return JobGraph(vertices, edges)
 

@@ -1,0 +1,201 @@
+"""CEP negative patterns, until(), times_or_more (flink_tpu/cep).
+
+reference parity: Pattern.notNext/notFollowedBy (NotCondition edges),
+Pattern.until (loop stop condition), Pattern.timesOrMore, and the
+trailing-notFollowedBy-with-within release semantics.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu import Configuration, StreamExecutionEnvironment
+from flink_tpu.cep.operator import CEP
+from flink_tpu.cep.pattern import Pattern
+
+
+def run_pattern(pattern, rows, select=None):
+    env = StreamExecutionEnvironment(Configuration(
+        {"execution.micro-batch.size": 4}))
+    ds = env.from_collection(rows, timestamp_field="t")
+    stream = CEP.pattern(ds.key_by("k"), pattern).select(select)
+    return stream.execute_and_collect().to_rows()
+
+
+def ev(k, kind, t, amount=0.0):
+    return {"k": k, "kind": kind, "t": t, "amount": amount}
+
+
+def kind_is(x):
+    return lambda b: np.asarray(b["kind"]) == x
+
+
+class TestNotFollowedBy:
+    def test_mid_pattern_kills_on_forbidden(self):
+        # a -> (no c) -> b : sequence a,c,b must NOT match; a,b must
+        p = (Pattern.begin("a").where(kind_is("a"))
+             .not_followed_by("nc").where(kind_is("c"))
+             .followed_by("b").where(kind_is("b")))
+        good = [ev(1, "a", 0), ev(1, "x", 10), ev(1, "b", 20),
+                ev(1, "z", 100)]
+        bad = [ev(2, "a", 0), ev(2, "c", 10), ev(2, "b", 20),
+               ev(2, "z", 100)]
+        out = run_pattern(p, good + bad)
+        assert len(out) == 1 and out[0]["key"] == 1
+
+    def test_trailing_requires_within(self):
+        p = (Pattern.begin("a").where(kind_is("a"))
+             .not_followed_by("nc").where(kind_is("c")))
+        with pytest.raises(ValueError, match="within"):
+            p.validate()
+
+    def test_trailing_releases_at_window_expiry(self):
+        # a NOT followed by c within 50ms: key 1 stays clean -> match at
+        # t=0+50; key 2 sees c at t=30 -> no match
+        p = (Pattern.begin("a").where(kind_is("a"))
+             .not_followed_by("nc").where(kind_is("c"))
+             .within(50))
+        rows = [ev(1, "a", 0), ev(2, "a", 0), ev(2, "c", 30),
+                ev(1, "x", 40),
+                # late traffic pushes the watermark far past both windows
+                ev(3, "z", 500), ev(3, "z", 600)]
+        out = run_pattern(p, rows)
+        assert len(out) == 1
+        assert out[0]["key"] == 1 and out[0]["end_ts"] == 50
+
+    def test_not_condition_event_can_be_next_stage(self):
+        # notFollowedBy(c) then followed_by(b): an event that is b (not c)
+        # satisfies the next stage even while the guard is armed
+        p = (Pattern.begin("a").where(kind_is("a"))
+             .not_followed_by("nc").where(kind_is("c"))
+             .followed_by("b").where(kind_is("b")))
+        rows = [ev(1, "a", 0), ev(1, "b", 5), ev(1, "z", 100)]
+        out = run_pattern(p, rows)
+        assert len(out) == 1
+
+
+class TestNotNext:
+    def test_immediate_event_only(self):
+        # a notNext(c) followedBy(b): c right after a kills; c LATER (after
+        # an innocent event) does not
+        p = (Pattern.begin("a").where(kind_is("a"))
+             .not_next("nc").where(kind_is("c"))
+             .followed_by("b").where(kind_is("b")))
+        killed = [ev(1, "a", 0), ev(1, "c", 10), ev(1, "b", 20),
+                  ev(1, "z", 100)]
+        survived = [ev(2, "a", 0), ev(2, "x", 10), ev(2, "c", 20),
+                    ev(2, "b", 30), ev(2, "z", 100)]
+        out = run_pattern(p, killed + survived)
+        assert [r["key"] for r in out] == [2]
+
+    def test_cannot_end_with_not_next(self):
+        p = (Pattern.begin("a").where(kind_is("a"))
+             .not_next("nc").where(kind_is("c")).within(50))
+        with pytest.raises(ValueError, match="notNext"):
+            p.validate()
+
+
+class TestUntil:
+    def test_until_stops_the_loop(self):
+        # oneOrMore small amounts until a big one; the big event closes
+        # the loop (and is not consumed by it)
+        p = (Pattern.begin("small").where(
+                lambda b: np.asarray(b["amount"]) < 10)
+             .one_or_more().until(lambda b: np.asarray(b["amount"]) > 100)
+             .followed_by("end").where(kind_is("e")))
+        rows = [ev(1, "s", 0, 1.0), ev(1, "s", 10, 2.0),
+                ev(1, "big", 20, 500.0), ev(1, "s", 30, 3.0),
+                ev(1, "e", 40), ev(1, "z", 200)]
+        out = run_pattern(p, rows)
+        # loops of size 1 and 2 formed before the until event; the post-
+        # until small event must NOT extend any loop => max small_count 2
+        assert out and max(r["small_count"] for r in out) == 2
+
+    def test_until_requires_unbounded(self):
+        with pytest.raises(ValueError, match="until"):
+            (Pattern.begin("a").where(kind_is("a"))
+             .times(2).until(lambda b: np.asarray(b["amount"]) > 1))
+
+
+class TestTimesOrMore:
+    def test_min_bound_unbounded_above(self):
+        p = (Pattern.begin("s").where(kind_is("s")).times_or_more(3)
+             .followed_by("e").where(kind_is("e")))
+        rows = [ev(1, "s", 0), ev(1, "s", 10), ev(1, "s", 20),
+                ev(1, "s", 30), ev(1, "e", 40), ev(1, "z", 200)]
+        out = run_pattern(p, rows)
+        counts = sorted(r["s_count"] for r in out)
+        assert counts and counts[0] >= 3 and 4 in counts
+
+    def test_two_takes_insufficient(self):
+        p = (Pattern.begin("s").where(kind_is("s")).times_or_more(3)
+             .followed_by("e").where(kind_is("e")))
+        rows = [ev(1, "s", 0), ev(1, "s", 10), ev(1, "e", 20),
+                ev(1, "z", 200)]
+        assert run_pattern(p, rows) == []
+
+
+class TestReviewRegressions:
+    def test_negative_before_optional_rejected(self):
+        """The skip-the-optional branch would lose the guard; the
+        reference rejects the shape at validation, so do we."""
+        p = (Pattern.begin("a").where(kind_is("a"))
+             .not_followed_by("nc").where(kind_is("c"))
+             .followed_by("b").where(kind_is("b")).optional()
+             .followed_by("d").where(kind_is("d")))
+        with pytest.raises(ValueError, match="optional"):
+            p.validate()
+
+    def test_until_kills_waiting_count0_partial(self):
+        """until fires BEFORE the loop ever took: no later event may
+        start the loop for that partial (reference: no more events are
+        accepted once the stop condition fires)."""
+        p = (Pattern.begin("a").where(kind_is("a"))
+             .followed_by("b").where(kind_is("b"))
+             .one_or_more().until(lambda b: np.asarray(b["kind"]) == "x"))
+        rows = [ev(1, "a", 0), ev(1, "x", 10), ev(1, "b", 20),
+                ev(1, "z", 200)]
+        assert run_pattern(p, rows) == []
+        # without the stop event the same trace matches
+        rows2 = [ev(2, "a", 0), ev(2, "y", 10), ev(2, "b", 20),
+                 ev(2, "z", 200)]
+        assert len(run_pattern(p, rows2)) == 1
+
+    def test_timeout_release_does_not_skip_past_fresh_partials(self):
+        """A trailing-notFollowedBy release triggered by a later event
+        must not wipe the partials that event just started (its span lies
+        entirely before them)."""
+        from flink_tpu.cep.pattern import AfterMatchSkipStrategy
+
+        p = (Pattern.begin("a",
+                           skip=AfterMatchSkipStrategy.SKIP_PAST_LAST_EVENT)
+             .where(kind_is("a"))
+             .not_followed_by("nc").where(kind_is("c"))
+             .within(10))
+        rows = [ev(1, "a", 1), ev(1, "a", 20), ev(1, "z", 200)]
+        out = run_pattern(p, rows)
+        ends = sorted(r["end_ts"] for r in out)
+        assert ends == [11, 30], out  # BOTH windows release
+
+
+class TestCheckpointWithNegatives:
+    def test_snapshot_restore_preserves_guards(self):
+        from flink_tpu.cep.nfa import KeyNFA
+
+        p = (Pattern.begin("a").where(kind_is("a"))
+             .not_followed_by("nc").where(kind_is("c"))
+             .followed_by("b").where(kind_is("b"))).validate()
+        nfa = KeyNFA(p)
+        # a arrives; guard armed
+        nfa.advance({"kind": "a"}, 0, [True, False, False])
+        snap = nfa.snapshot()
+        nfa2 = KeyNFA(p)
+        nfa2.restore(snap)
+        # forbidden c kills the restored partial
+        nfa2.advance({"kind": "c"}, 10, [False, True, False])
+        ms = nfa2.advance({"kind": "b"}, 20, [False, False, True])
+        assert ms == []
+        # sibling timeline without c still matches
+        nfa3 = KeyNFA(p)
+        nfa3.restore(snap)
+        ms = nfa3.advance({"kind": "b"}, 20, [False, False, True])
+        assert len(ms) == 1

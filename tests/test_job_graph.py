@@ -81,6 +81,32 @@ class TestChaining:
         assert "m2" in jg.vertices[1].name
         assert all(e.ship == FORWARD for e in jg.edges)
 
+    def test_same_key_parallelism_change_reshuffles(self):
+        """key_by(k) at parallelism 4 into key_by(k) at parallelism 2:
+        the key-group ranges differ, so the edge must be HASH even though
+        the key is unchanged."""
+        env = StreamExecutionEnvironment(Configuration())
+        from flink_tpu.connectors.sinks import CollectSink
+        from flink_tpu.connectors.sources import DataGenSource
+        from flink_tpu.runtime.watermarks import WatermarkStrategy
+
+        ds = env.add_source(
+            DataGenSource(total_records=10, num_keys=2,
+                          events_per_second_of_eventtime=100),
+            WatermarkStrategy.for_bounded_out_of_orderness(0))
+        agg = ds.key_by("key").window(
+            TumblingEventTimeWindows.of(1000)).sum("value")
+        agg.transformation.parallelism = 4
+        second = agg.key_by("key").window(
+            TumblingEventTimeWindows.of(2000)).sum("sum_value")
+        second.transformation.parallelism = 2
+        second.sink_to(CollectSink())
+        jg = build_job_graph(_graph(env), default_parallelism=1)
+        hash_edges = [e for e in jg.edges if e.ship == HASH]
+        # source->agg AND agg->second both re-shuffle
+        assert len(hash_edges) == 2
+        assert all(e.key_field == "key" for e in hash_edges)
+
     def test_plan_json_shape(self):
         env = _simple_pipeline(StreamExecutionEnvironment(Configuration()))
         plan = build_job_graph(_graph(env), default_parallelism=8).to_json()
