@@ -141,10 +141,80 @@ def cmd_inspect(args) -> int:
     return 0
 
 
+def _props_config(defines):
+    from flink_tpu.core.config import Configuration
+
+    props = {}
+    for d in defines or []:
+        if "=" not in d:
+            raise SystemExit(f"-D expects key=value, got {d!r}")
+        k, v = d.split("=", 1)
+        props[k] = v
+    return Configuration(props)
+
+
+def cmd_jobmanager(args) -> int:
+    """Standalone JobManager process (reference:
+    StandaloneSessionClusterEntrypoint / jobmanager.sh)."""
+    from flink_tpu.cluster.standalone import run_jobmanager
+    from flink_tpu.platform import sync_platform
+
+    sync_platform()  # honor JAX_PLATFORMS even under sitecustomize hooks
+
+    cfg = _props_config(args.define)
+    # explicit flags win; -D wins over the built-in defaults
+    if args.port is not None:
+        cfg.set("rpc.port", args.port)
+    elif cfg.get_raw("rpc.port") is None:
+        cfg.set("rpc.port", 6123)
+    if args.rest_port is not None:
+        cfg.set("rest.port", args.rest_port)
+    elif cfg.get_raw("rest.port") is None:
+        cfg.set("rest.port", 8081)
+    run_jobmanager(cfg)
+    return 0
+
+
+def cmd_taskexecutor(args) -> int:
+    """Standalone TaskExecutor process (reference: TaskManagerRunner /
+    taskmanager.sh)."""
+    from flink_tpu.cluster.standalone import TaskExecutorRunner
+    from flink_tpu.platform import sync_platform
+
+    sync_platform()  # honor JAX_PLATFORMS even under sitecustomize hooks
+
+    cfg = _props_config(args.define)
+    if args.slots is not None:
+        cfg.set("taskmanager.numberOfTaskSlots", args.slots)
+    runner = TaskExecutorRunner(args.jobmanager, cfg)
+    print(f"taskexecutor {runner.executor_id} rpc on {runner.address}, "
+          f"registering with {args.jobmanager}", flush=True)
+    runner.run_forever()
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="flink-tpu",
                                 description=__doc__.split("\n")[0])
     sub = p.add_subparsers(dest="command", required=True)
+
+    pj = sub.add_parser("jobmanager",
+                        help="run a standalone JobManager process")
+    pj.add_argument("--port", type=int, default=None,
+                    help="control-plane gRPC port (default 6123; "
+                    "-D rpc.port=... also works)")
+    pj.add_argument("--rest-port", type=int, default=None,
+                    help="REST port (default 8081)")
+    pj.add_argument("-D", dest="define", action="append", metavar="K=V")
+    pj.set_defaults(fn=cmd_jobmanager)
+
+    pt = sub.add_parser("taskexecutor",
+                        help="run a standalone TaskExecutor process")
+    pt.add_argument("--jobmanager", default="127.0.0.1:6123",
+                    help="JobManager rpc address host:port")
+    pt.add_argument("--slots", type=int, default=None)
+    pt.add_argument("-D", dest="define", action="append", metavar="K=V")
+    pt.set_defaults(fn=cmd_taskexecutor)
 
     pr = sub.add_parser("run", help="run a pipeline script")
     pr.add_argument("script")

@@ -205,12 +205,33 @@ class ResourceManagerEndpoint(RpcEndpoint):
         super().__init__("resourcemanager")
         self._executors: Dict[str, dict] = {}
         self._blocklist: set = set()
+        #: notification hook the hosting process sets to react to remote
+        #: joins (adaptive-scheduler jobs rescale to new resources);
+        #: invoked on the endpoint main thread — implementations must not
+        #: block
+        self.on_register = None
 
     def register_task_executor(self, executor_id: str, address: str,
                                num_slots: int) -> None:
+        fresh = executor_id not in self._executors
+        prev = self._executors.get(executor_id, {})
         self._executors[executor_id] = {
-            "address": address, "slots": num_slots, "allocated": 0,
+            "address": address, "slots": num_slots,
+            "allocated": prev.get("allocated", 0),
             "last_heartbeat": time.monotonic(),
+        }
+        if fresh and self.on_register is not None:
+            self.on_register(executor_id)
+
+    def executor_registry(self) -> Dict[str, dict]:
+        """Membership view: executor_id -> {address, slots, allocated,
+        heartbeat_age_s} (REST /taskexecutors + the heartbeat pump)."""
+        now = time.monotonic()
+        return {
+            eid: {"address": info["address"], "slots": info["slots"],
+                  "allocated": info["allocated"],
+                  "heartbeat_age_s": now - info["last_heartbeat"]}
+            for eid, info in self._executors.items()
         }
 
     def heartbeat_from(self, executor_id: str) -> None:
@@ -805,14 +826,20 @@ class JobClient:
 
 
 class MiniCluster:
-    """Single-process cluster: RM + Dispatcher + N TaskExecutors, real gRPC
-    between the roles, background heartbeat pump."""
+    """RM + Dispatcher control plane with real gRPC between the roles and a
+    background heartbeat pump. With ``cluster.task-executors`` > 0 it hosts
+    that many TaskExecutors in-process (the reference MiniCluster); with 0
+    it is a standalone JobManager — pin ``rpc.port`` and join remote
+    TaskExecutor processes via flink_tpu.cluster.standalone
+    (reference: StandaloneSessionClusterEntrypoint + TaskManagerRunner)."""
 
     def __init__(self, config: Optional[Configuration] = None):
         from flink_tpu.core.config import HighAvailabilityOptions
 
         self.config = config or Configuration()
-        self.service = RpcService()
+        self.service = RpcService(
+            bind_address=self.config.get(ClusterOptions.RPC_BIND_ADDRESS),
+            port=self.config.get(ClusterOptions.RPC_PORT))
         self.rm = ResourceManagerEndpoint()
         self.service.register(self.rm)
         # HA services (reference: HighAvailabilityServices wiring)
@@ -896,6 +923,28 @@ class MiniCluster:
 
             self._rest = RestServer(self, port=rest_port)
 
+        # remote TE joins must wake adaptive-scheduler jobs, exactly like
+        # add_task_executor does for local ones. Wired LAST: the RM is
+        # network-reachable the moment its endpoint registers, and a
+        # keepalive re-registration from a surviving worker must not hit a
+        # callback touching attributes that don't exist yet. (Joins that
+        # land before this line just miss the wake-up; the keepalive
+        # re-register and the heartbeat pump pick them up.)
+        cluster_ref = self
+
+        def _on_remote_register(executor_id: str) -> None:
+            self._heartbeats[executor_id] = time.monotonic()
+
+            def wake():
+                for master in list(
+                        cluster_ref.dispatcher._masters.values()):
+                    master.on_new_resources()
+
+            threading.Thread(target=wake, name="resource-wake",
+                             daemon=True).start()
+
+        self.rm.on_register = _on_remote_register
+
     # -- membership ---------------------------------------------------------
 
     def add_task_executor(self, num_slots: int = 1) -> TaskExecutorEndpoint:
@@ -932,10 +981,16 @@ class MiniCluster:
             ClusterOptions.HEARTBEAT_INTERVAL_MS) / 1000.0
         rm = self.rm_gateway()  # through RPC: keep the main-thread invariant
         while not self._hb_stop.wait(interval):
-            for te in list(self.executors):
-                eid = te.endpoint_id
+            # every registered executor, local AND remote — each pinged at
+            # its own registered address (reference: HeartbeatManager pings
+            # TaskManagers wherever they run)
+            try:
+                registry = rm.executor_registry()
+            except Exception:
+                continue
+            for eid, info in registry.items():
                 try:
-                    gw = self.service.connect(self.service.address, eid)
+                    gw = self.service.connect(info["address"], eid)
                     gw.heartbeat()
                     self._heartbeats[eid] = time.monotonic()
                     rm.heartbeat_from(eid)

@@ -224,8 +224,20 @@ class RestServer:
         }
 
     def _executors(self):
-        return {"executors": [te.heartbeat()
-                              for te in self.cluster.executors]}
+        # RM registry covers local AND remote (standalone) executors;
+        # in-process ones add their live task view
+        local = {te.endpoint_id: te.heartbeat()
+                 for te in self.cluster.executors}
+        out = []
+        # through the RPC gateway: registry reads serialize on the RM main
+        # thread instead of racing its mutations
+        for eid, info in self.cluster.rm_gateway().executor_registry().items():
+            entry = dict(local.get(eid, {"executor_id": eid}))
+            entry.update(address=info["address"], slots=info["slots"],
+                         allocated=info["allocated"],
+                         heartbeat_age_s=round(info["heartbeat_age_s"], 3))
+            out.append(entry)
+        return {"executors": out}
 
     def _job_metrics(self, job_id: str):
         master = self.cluster.dispatcher.master(job_id)

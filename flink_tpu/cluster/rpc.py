@@ -137,6 +137,11 @@ class _GatewayProxy:
         return _GatewayProxy(self._invoke_fn, self._endpoint_id, token)
 
 
+#: process-wide client channels for server-less gateways
+_client_channels: Dict[str, grpc.Channel] = {}
+_client_lock = threading.Lock()
+
+
 class RpcService:
     """Hosts endpoints on a gRPC server; connects gateways to remote ones."""
 
@@ -219,6 +224,37 @@ class RpcService:
     def self_gateway(self, endpoint_id: str,
                      fencing_token: Optional[int] = None) -> _GatewayProxy:
         return self.connect(self.address, endpoint_id, fencing_token)
+
+    @classmethod
+    def client_connect(cls, address: str, endpoint_id: str,
+                       fencing_token: Optional[int] = None) -> _GatewayProxy:
+        """Client-only gateway: a channel to a remote endpoint without
+        hosting a server (drivers submitting to a standalone cluster need
+        no inbound RPC). Channels are cached process-wide."""
+        with _client_lock:
+            ch = _client_channels.get(address)
+            if ch is None:
+                ch = grpc.insecure_channel(
+                    address,
+                    options=[("grpc.max_receive_message_length",
+                              512 * 1024 * 1024),
+                             ("grpc.max_send_message_length",
+                              512 * 1024 * 1024)])
+                _client_channels[address] = ch
+        stub = ch.unary_unary(
+            _METHOD,
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+
+        def invoke(eid, method, args, kwargs, token):
+            payload = cloudpickle.dumps((eid, method, args, kwargs, token))
+            reply = cloudpickle.loads(stub(payload, timeout=120))
+            if reply[0] == "ok":
+                return reply[1]
+            _, exc, tb = reply
+            raise exc
+
+        return _GatewayProxy(invoke, endpoint_id, fencing_token)
 
     def stop(self) -> None:
         for ep in list(self._endpoints.values()):

@@ -335,6 +335,16 @@ class ClusterOptions:
     REST_PORT = ConfigOption(
         "rest.port", default=0, type=int,
         description="REST status endpoint port; 0 = ephemeral, -1 = off.")
+    RPC_PORT = ConfigOption(
+        "rpc.port", default=0, type=int,
+        description="Control-plane gRPC port (0 = ephemeral). Standalone "
+        "deployments pin it so TaskExecutor processes can join "
+        "(reference: jobmanager.rpc.port).")
+    RPC_BIND_ADDRESS = ConfigOption(
+        "rpc.bind-address", default="127.0.0.1", type=str,
+        description="Address the control-plane gRPC server binds; use "
+        "0.0.0.0 for cross-host standalone clusters (reference: "
+        "jobmanager.rpc.address/bind-host).")
 
 
 class SchedulerOptions:

@@ -26,6 +26,7 @@ from flink_tpu.graph.transformations import StreamGraph, Transformation
 #: how records travel along a JobEdge
 FORWARD = "FORWARD"        # same subtask, direct call (chained boundary)
 HASH = "HASH"              # key-group routed exchange
+REBALANCE = "REBALANCE"    # round-robin redistribute (parallelism change)
 BROADCAST = "BROADCAST"    # replicated to every consumer subtask
 SIDE = "SIDE"              # side-output tagged route
 
@@ -161,6 +162,10 @@ def _edge_ship(child: Transformation,
         return BROADCAST, None
     if child.side_tag is not None:
         return SIDE, None
+    if not same_parallelism:
+        # N -> M subtasks cannot be one-to-one (reference renders
+        # REBALANCE/RESCALE for parallelism changes)
+        return REBALANCE, None
     return FORWARD, None
 
 

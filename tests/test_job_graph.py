@@ -74,12 +74,15 @@ class TestChaining:
         m.map(lambda b: b, name="m2").sink_to(CollectSink())
         jg = build_job_graph(_graph(env), default_parallelism=1)
         # source(1) | m1..sink(4): m2/sink INHERIT m1's parallelism and
-        # chain with it; the 1->4 boundary is a forward exchange
+        # chain with it; the 1->4 boundary redistributes (REBALANCE —
+        # one-to-one is impossible across a parallelism change)
+        from flink_tpu.graph.job_graph import REBALANCE
+
         assert len(jg.vertices) == 2
         assert jg.vertices[0].parallelism == 1
         assert jg.vertices[1].parallelism == 4
         assert "m2" in jg.vertices[1].name
-        assert all(e.ship == FORWARD for e in jg.edges)
+        assert [e.ship for e in jg.edges] == [REBALANCE]
 
     def test_same_key_parallelism_change_reshuffles(self):
         """key_by(k) at parallelism 4 into key_by(k) at parallelism 2:
