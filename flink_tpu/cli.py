@@ -50,14 +50,21 @@ def _base(args) -> str:
     return rest.rstrip("/")
 
 
-def cmd_run(args) -> int:
+def _parse_defines(defines) -> dict:
+    """-D key=value pairs; malformed input exits 2 with a message (one
+    parser for every subcommand)."""
     props = {}
-    for d in args.define or []:
+    for d in defines or []:
         if "=" not in d:
             print(f"-D expects key=value, got {d!r}", file=sys.stderr)
-            return 2
+            raise SystemExit(2)
         k, v = d.split("=", 1)
         props[k] = v
+    return props
+
+
+def cmd_run(args) -> int:
+    props = _parse_defines(args.define)
     overrides = {}
     if props:
         overrides[DYNAMIC_PROPS_ENV] = json.dumps(props)
@@ -144,13 +151,7 @@ def cmd_inspect(args) -> int:
 def _props_config(defines):
     from flink_tpu.core.config import Configuration
 
-    props = {}
-    for d in defines or []:
-        if "=" not in d:
-            raise SystemExit(f"-D expects key=value, got {d!r}")
-        k, v = d.split("=", 1)
-        props[k] = v
-    return Configuration(props)
+    return Configuration(_parse_defines(defines))
 
 
 def cmd_jobmanager(args) -> int:

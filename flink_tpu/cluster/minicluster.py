@@ -839,7 +839,9 @@ class MiniCluster:
         self.config = config or Configuration()
         self.service = RpcService(
             bind_address=self.config.get(ClusterOptions.RPC_BIND_ADDRESS),
-            port=self.config.get(ClusterOptions.RPC_PORT))
+            port=self.config.get(ClusterOptions.RPC_PORT),
+            advertised_address=self.config.get(
+                ClusterOptions.RPC_ADVERTISED_ADDRESS))
         self.rm = ResourceManagerEndpoint()
         self.service.register(self.rm)
         # HA services (reference: HighAvailabilityServices wiring)
@@ -977,25 +979,57 @@ class MiniCluster:
     # -- heartbeats ---------------------------------------------------------
 
     def _heartbeat_loop(self) -> None:
+        from concurrent import futures as _futures
+
         interval = self.config.get(
             ClusterOptions.HEARTBEAT_INTERVAL_MS) / 1000.0
+        timeout_s = self.config.get(
+            ClusterOptions.HEARTBEAT_TIMEOUT_MS) / 1000.0
         rm = self.rm_gateway()  # through RPC: keep the main-thread invariant
-        while not self._hb_stop.wait(interval):
-            # every registered executor, local AND remote — each pinged at
-            # its own registered address (reference: HeartbeatManager pings
-            # TaskManagers wherever they run)
-            try:
-                registry = rm.executor_registry()
-            except Exception:
-                continue
-            for eid, info in registry.items():
+        # parallel pings with a short per-RPC deadline: one blackholed
+        # remote worker must not starve every healthy executor's refresh
+        # (serial pings with the default 120s deadline would)
+        pool = _futures.ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="hb-ping")
+        ping_deadline = max(min(timeout_s / 2, 5.0), 0.5)
+
+        def ping(eid: str, address: str) -> None:
+            gw = self.service.connect(address, eid,
+                                      call_timeout=ping_deadline)
+            gw.heartbeat()
+            self._heartbeats[eid] = time.monotonic()
+            rm.heartbeat_from(eid)
+
+        try:
+            while not self._hb_stop.wait(interval):
+                # every registered executor, local AND remote — each
+                # pinged at its own registered address (reference:
+                # HeartbeatManager pings TaskManagers wherever they run)
                 try:
-                    gw = self.service.connect(info["address"], eid)
-                    gw.heartbeat()
-                    self._heartbeats[eid] = time.monotonic()
-                    rm.heartbeat_from(eid)
+                    registry = rm.executor_registry()
                 except Exception:
-                    pass  # missed beat; master-side timeout decides
+                    continue
+                fs = {pool.submit(ping, eid, info["address"]): eid
+                      for eid, info in registry.items()}
+                try:
+                    for f in _futures.as_completed(
+                            fs, timeout=max(timeout_s, ping_deadline) + 1):
+                        try:
+                            f.result()
+                        except Exception:
+                            pass  # missed beat; timeout decides
+                except _futures.TimeoutError:
+                    pass  # stragglers keep running into their deadline
+                # evict executors silent for several timeouts so their
+                # slots stop being offered and their pings stop costing
+                for eid, info in registry.items():
+                    if info["heartbeat_age_s"] > timeout_s * 3:
+                        try:
+                            rm.mark_dead(eid)
+                        except Exception:
+                            pass
+        finally:
+            pool.shutdown(wait=False)
 
     def last_heartbeat(self, executor_id: str) -> Optional[float]:
         return self._heartbeats.get(executor_id)

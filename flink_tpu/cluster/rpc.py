@@ -141,16 +141,50 @@ class _GatewayProxy:
 _client_channels: Dict[str, grpc.Channel] = {}
 _client_lock = threading.Lock()
 
+_CHANNEL_OPTIONS = [
+    ("grpc.max_receive_message_length", 512 * 1024 * 1024),
+    ("grpc.max_send_message_length", 512 * 1024 * 1024),
+]
+
+
+def _cached_channel(address: str, cache: Dict[str, grpc.Channel],
+                    lock: threading.Lock) -> grpc.Channel:
+    with lock:
+        ch = cache.get(address)
+        if ch is None:
+            ch = grpc.insecure_channel(address, options=_CHANNEL_OPTIONS)
+            cache[address] = ch
+        return ch
+
+
+def _make_gateway(channel: grpc.Channel, endpoint_id: str,
+                  fencing_token: Optional[int],
+                  call_timeout: float) -> "_GatewayProxy":
+    stub = channel.unary_unary(
+        _METHOD,
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b)
+
+    def invoke(eid, method, args, kwargs, token):
+        payload = cloudpickle.dumps((eid, method, args, kwargs, token))
+        reply = cloudpickle.loads(stub(payload, timeout=call_timeout))
+        if reply[0] == "ok":
+            return reply[1]
+        _, exc, tb = reply
+        raise exc
+
+    return _GatewayProxy(invoke, endpoint_id, fencing_token)
+
 
 class RpcService:
     """Hosts endpoints on a gRPC server; connects gateways to remote ones."""
 
-    def __init__(self, bind_address: str = "127.0.0.1", port: int = 0):
+    def __init__(self, bind_address: str = "127.0.0.1", port: int = 0,
+                 advertised_address: str = ""):
         self._endpoints: Dict[str, RpcEndpoint] = {}
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=16),
-            options=[("grpc.max_receive_message_length", 512 * 1024 * 1024),
-                     ("grpc.max_send_message_length", 512 * 1024 * 1024)])
+            options=_CHANNEL_OPTIONS)
         handler = grpc.method_handlers_generic_handler(
             "flink_tpu.Rpc",
             {"Invoke": grpc.unary_unary_rpc_method_handler(
@@ -160,7 +194,21 @@ class RpcService:
         self._server.add_generic_rpc_handlers((handler,))
         self.port = self._server.add_insecure_port(f"{bind_address}:{port}")
         self._server.start()
-        self.address = f"{bind_address}:{self.port}"
+        # the address peers CONNECT to, which 0.0.0.0 never is: bind-all
+        # servers advertise their routable host (reference:
+        # taskmanager.host / jobmanager.rpc.address vs bind-host split)
+        if not advertised_address:
+            if bind_address == "0.0.0.0":
+                import socket
+
+                try:
+                    advertised_address = socket.gethostbyname(
+                        socket.gethostname())
+                except OSError:
+                    advertised_address = socket.gethostname()
+            else:
+                advertised_address = bind_address
+        self.address = f"{advertised_address}:{self.port}"
         self._channels: Dict[str, grpc.Channel] = {}
         self._lock = threading.Lock()
 
@@ -191,35 +239,16 @@ class RpcService:
     # -- client side --------------------------------------------------------
 
     def _channel(self, address: str) -> grpc.Channel:
-        with self._lock:
-            ch = self._channels.get(address)
-            if ch is None:
-                ch = grpc.insecure_channel(
-                    address,
-                    options=[("grpc.max_receive_message_length",
-                              512 * 1024 * 1024),
-                             ("grpc.max_send_message_length",
-                              512 * 1024 * 1024)])
-                self._channels[address] = ch
-            return ch
+        return _cached_channel(address, self._channels, self._lock)
 
     def connect(self, address: str, endpoint_id: str,
-                fencing_token: Optional[int] = None) -> _GatewayProxy:
-        channel = self._channel(address)
-        stub = channel.unary_unary(
-            _METHOD,
-            request_serializer=lambda b: b,
-            response_deserializer=lambda b: b)
-
-        def invoke(eid, method, args, kwargs, token):
-            payload = cloudpickle.dumps((eid, method, args, kwargs, token))
-            reply = cloudpickle.loads(stub(payload, timeout=120))
-            if reply[0] == "ok":
-                return reply[1]
-            _, exc, tb = reply
-            raise exc
-
-        return _GatewayProxy(invoke, endpoint_id, fencing_token)
+                fencing_token: Optional[int] = None,
+                call_timeout: float = 120) -> _GatewayProxy:
+        """``call_timeout``: per-RPC deadline in seconds — liveness probes
+        (heartbeats) use short deadlines so one unreachable peer cannot
+        stall the caller for the default two minutes."""
+        return _make_gateway(self._channel(address), endpoint_id,
+                             fencing_token, call_timeout)
 
     def self_gateway(self, endpoint_id: str,
                      fencing_token: Optional[int] = None) -> _GatewayProxy:
@@ -227,34 +256,13 @@ class RpcService:
 
     @classmethod
     def client_connect(cls, address: str, endpoint_id: str,
-                       fencing_token: Optional[int] = None) -> _GatewayProxy:
+                       fencing_token: Optional[int] = None,
+                       call_timeout: float = 120) -> _GatewayProxy:
         """Client-only gateway: a channel to a remote endpoint without
         hosting a server (drivers submitting to a standalone cluster need
         no inbound RPC). Channels are cached process-wide."""
-        with _client_lock:
-            ch = _client_channels.get(address)
-            if ch is None:
-                ch = grpc.insecure_channel(
-                    address,
-                    options=[("grpc.max_receive_message_length",
-                              512 * 1024 * 1024),
-                             ("grpc.max_send_message_length",
-                              512 * 1024 * 1024)])
-                _client_channels[address] = ch
-        stub = ch.unary_unary(
-            _METHOD,
-            request_serializer=lambda b: b,
-            response_deserializer=lambda b: b)
-
-        def invoke(eid, method, args, kwargs, token):
-            payload = cloudpickle.dumps((eid, method, args, kwargs, token))
-            reply = cloudpickle.loads(stub(payload, timeout=120))
-            if reply[0] == "ok":
-                return reply[1]
-            _, exc, tb = reply
-            raise exc
-
-        return _GatewayProxy(invoke, endpoint_id, fencing_token)
+        ch = _cached_channel(address, _client_channels, _client_lock)
+        return _make_gateway(ch, endpoint_id, fencing_token, call_timeout)
 
     def stop(self) -> None:
         for ep in list(self._endpoints.values()):

@@ -41,7 +41,9 @@ class TaskExecutorRunner:
         self.config = config or Configuration()
         self.jm_address = jobmanager_address
         self.service = RpcService(
-            bind_address=self.config.get(ClusterOptions.RPC_BIND_ADDRESS))
+            bind_address=self.config.get(ClusterOptions.RPC_BIND_ADDRESS),
+            advertised_address=self.config.get(
+                ClusterOptions.RPC_ADVERTISED_ADDRESS))
         self.executor_id = executor_id or f"taskexecutor-{uuid.uuid4().hex[:8]}"
         self.num_slots = self.config.get(ClusterOptions.SLOTS_PER_EXECUTOR)
         self.endpoint = TaskExecutorEndpoint(self.executor_id,
@@ -88,8 +90,13 @@ class TaskExecutorRunner:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._thread is not None:
+            # an in-flight keepalive re-register completing AFTER
+            # mark_dead would resurrect a dead entry in the RM registry
+            self._thread.join(timeout=10)
         try:
-            rm = self.service.connect(self.jm_address, "resourcemanager")
+            rm = self.service.connect(self.jm_address, "resourcemanager",
+                                      call_timeout=5)
             rm.mark_dead(self.executor_id)
         except Exception:
             pass
@@ -123,5 +130,9 @@ def remote_submit(jobmanager_address: str, env, job_name: str = "job"):
     dispatcher = RpcService.client_connect(jobmanager_address, "dispatcher")
     graph = env.get_stream_graph()
     env._sinks = []
-    job_id = dispatcher.submit_job(graph, env.config.to_dict(), job_name)
+    # effective config: includes CLI -D dynamic properties and restore
+    # flags, exactly what a local execute() would apply
+    config = env._effective_config() if hasattr(
+        env, "_effective_config") else env.config
+    job_id = dispatcher.submit_job(graph, config.to_dict(), job_name)
     return job_id, dispatcher

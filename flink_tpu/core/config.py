@@ -345,6 +345,12 @@ class ClusterOptions:
         description="Address the control-plane gRPC server binds; use "
         "0.0.0.0 for cross-host standalone clusters (reference: "
         "jobmanager.rpc.address/bind-host).")
+    RPC_ADVERTISED_ADDRESS = ConfigOption(
+        "rpc.advertised-address", default="", type=str,
+        description="Address peers use to CONNECT to this process "
+        "(registered with the ResourceManager, returned in slot offers). "
+        "Empty = the bind address, or the host's resolved IP when binding "
+        "0.0.0.0 (reference: taskmanager.host).")
 
 
 class SchedulerOptions:

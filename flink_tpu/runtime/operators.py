@@ -261,12 +261,16 @@ class WindowAggOperator(Operator):
                     allowed_lateness=self.allowed_lateness,
                     spill=self.spill,
                     fire_projector=self.fire_projector)
-        # deferred fire harvesting needs both an engine that can dispatch
-        # async (the single-device slot/pane layouts) and an executor that
-        # holds back watermarks while fires are in flight
+        self._resolve_async_fires(ctx)
+
+    def _resolve_async_fires(self, ctx) -> None:
+        """Deferred fire harvesting needs both an engine that can dispatch
+        async (single-device slot/pane/session engines declare
+        supports_async_fires) and an executor that holds back watermarks
+        while fires are in flight (ctx.async_fires)."""
         self._async_fires = bool(
             getattr(ctx, "async_fires", False)
-            and isinstance(self.windower, SliceSharedWindower))
+            and getattr(self.windower, "supports_async_fires", False))
 
     def process_batch(self, batch, input_index=0):
         if self.key_field in batch.columns:
@@ -499,6 +503,7 @@ class SessionWindowAggOperator(WindowAggOperator):
                 max_parallelism=ctx.max_parallelism,
                 allowed_lateness=self.allowed_lateness,
                 spill=self.spill)
+        self._resolve_async_fires(ctx)
 
     def query_state(self, key_value, namespace=None):
         """Session variant: the key's live sessions are host metadata

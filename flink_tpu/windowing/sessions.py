@@ -160,7 +160,11 @@ class SessionWindower:
 
     # ------------------------------------------------------------------ fire
 
-    def on_watermark(self, watermark: int) -> List[RecordBatch]:
+    #: fires may be dispatched async (see on_watermark(async_ok=True))
+    supports_async_fires = True
+
+    def on_watermark(self, watermark: int,
+                     async_ok: bool = False) -> List[RecordBatch]:
         fired_keys, fired_starts, fired_ends, fired_sids = \
             self.meta.pop_fired(watermark)
         if not fired_keys:
@@ -179,8 +183,6 @@ class SessionWindower:
                 np.asarray(fired_keys[a:b], dtype=np.int64),
                 np.asarray(fired_sids[a:b], dtype=np.int64))
             matrix = np.asarray(fired_slots, dtype=np.int32)[:, None]
-            results = self.table.fire(matrix)
-            self.table.free_namespaces(fired_sids[a:b])
             cols = {
                 KEY_ID_FIELD: np.asarray(fired_keys[a:b], dtype=np.int64),
                 WINDOW_START_FIELD: np.asarray(fired_starts[a:b],
@@ -190,6 +192,27 @@ class SessionWindower:
                 TIMESTAMP_FIELD: np.asarray(fired_ends[a:b],
                                             dtype=np.int64) - 1,
             }
+            if async_ok:
+                # dispatch the fire and free the sessions immediately —
+                # the reset is device-queue-ordered BEHIND the fire
+                # kernel, so the deferred host read never races it
+                pending = self.table.fire_async(matrix, None)
+                self.table.free_namespaces(fired_sids[a:b])
+                if pending is None:
+                    continue
+                inner = pending.build
+
+                def build(host, inner=inner, cols=cols):
+                    _, results = inner(host)
+                    full = dict(cols)
+                    full.update(results)
+                    return RecordBatch(full)
+
+                pending.build = build
+                out.append(pending)
+                continue
+            results = self.table.fire(matrix)
+            self.table.free_namespaces(fired_sids[a:b])
             cols.update(results)
             out.append(RecordBatch(cols))
         return out

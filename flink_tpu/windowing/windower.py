@@ -40,6 +40,10 @@ WINDOW_END_FIELD = "window_end"
 class SliceSharedWindower:
     """Windowed keyed aggregation over one key-group range / device shard."""
 
+    #: on_watermark(async_ok=True) may return PendingFire handles (the
+    #: hosting operator/executor owns harvest + watermark holdback)
+    supports_async_fires = True
+
     def __init__(
         self,
         assigner: WindowAssigner,

@@ -106,6 +106,35 @@ class TestCascade:
             sum(r["v"] for r in rows))
 
 
+class TestAsyncSessions:
+    def _run(self, async_fires):
+        from flink_tpu.windowing.assigners import EventTimeSessionWindows
+
+        rows = []
+        rng = np.random.default_rng(11)
+        t = 0
+        for i in range(500):
+            t += int(rng.integers(1, 60))  # gaps > 40 split sessions
+            rows.append({"key": int(rng.integers(6)), "v": 1.0, "t": t})
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.window.async-fires": async_fires,
+            "execution.micro-batch.size": 32,
+        }))
+        result = (
+            env.from_collection(rows, timestamp_field="t")
+            .key_by("key")
+            .window(EventTimeSessionWindows.with_gap(40))
+            .sum("v")
+            .execute_and_collect()
+        )
+        return {(r["key"], r["window_start"], r["window_end"]): r["sum_v"]
+                for r in result.to_rows()}
+
+    def test_async_equals_sync(self):
+        sync, asy = self._run(False), self._run(True)
+        assert sync == asy and len(sync) > 5
+
+
 class TestForcedPending:
     def test_fires_stay_pending_then_land(self, monkeypatch):
         """Gate readiness so every fire stays in flight for several polls:
