@@ -60,10 +60,41 @@ class TaskExecutorEndpoint(RpcEndpoint):
     executed by the micro-batch task loop (LocalExecutor.run).
     """
 
-    def __init__(self, executor_id: str, num_slots: int = 1):
+    def __init__(self, executor_id: str, num_slots: int = 1,
+                 master_timeout_s: Optional[float] = None):
         super().__init__(executor_id)
         self.num_slots = num_slots
         self._tasks: Dict[str, dict] = {}  # execution_id -> task record
+        #: wall time of the last master contact (heartbeat ping); with
+        #: ``master_timeout_s`` set, a watchdog cancels running tasks when
+        #: the master goes silent — a partitioned worker must not keep
+        #: writing checkpoints the failed-over attempt races (reference:
+        #: TaskExecutor fails its tasks on heartbeat timeout to the JM)
+        self._last_master_contact = time.monotonic()
+        self._watchdog_stop = threading.Event()
+        if master_timeout_s:
+            def watchdog():
+                while not self._watchdog_stop.wait(master_timeout_s / 4):
+                    if time.monotonic() - self._last_master_contact \
+                            > master_timeout_s:
+                        self._cancel_all_tasks()
+
+            threading.Thread(target=watchdog,
+                             name=f"{executor_id}-master-watchdog",
+                             daemon=True).start()
+
+    def _cancel_all_tasks(self) -> None:
+        for rec in list(self._tasks.values()):
+            if rec["status"] == RUNNING:
+                rec["cancel"].set()
+
+    def on_stop(self) -> None:
+        # a stopping worker takes its tasks down with it (reference:
+        # TaskExecutor shutdown fails running tasks) — otherwise the task
+        # threads keep running (and writing checkpoints) as zombies that
+        # race the failed-over attempt
+        self._watchdog_stop.set()
+        self._cancel_all_tasks()
 
     # -- rpc: lifecycle -----------------------------------------------------
 
@@ -187,6 +218,7 @@ class TaskExecutorEndpoint(RpcEndpoint):
 
     def heartbeat(self) -> dict:
         """reference: TaskExecutor heartbeat payload (slot report)."""
+        self._last_master_contact = time.monotonic()
         running = sum(1 for r in self._tasks.values()
                       if r["status"] == RUNNING)
         return {"id": self.endpoint_id, "slots_total": self.num_slots,
@@ -218,7 +250,13 @@ class ResourceManagerEndpoint(RpcEndpoint):
         self._executors[executor_id] = {
             "address": address, "slots": num_slots,
             "allocated": prev.get("allocated", 0),
-            "last_heartbeat": time.monotonic(),
+            # a keepalive RE-registration must NOT refresh liveness: a
+            # worker that can reach the master while the master cannot
+            # reach it (wrong advertised address, one-way partition) has
+            # to age out of the registry — only actual ping answers
+            # refresh last_heartbeat
+            "last_heartbeat": prev.get("last_heartbeat",
+                                       time.monotonic()),
         }
         if fresh and self.on_register is not None:
             self.on_register(executor_id)
@@ -993,12 +1031,13 @@ class MiniCluster:
             max_workers=4, thread_name_prefix="hb-ping")
         ping_deadline = max(min(timeout_s / 2, 5.0), 0.5)
 
-        def ping(eid: str, address: str) -> None:
+        def ping(eid: str, address: str) -> bool:
             gw = self.service.connect(address, eid,
                                       call_timeout=ping_deadline)
             gw.heartbeat()
             self._heartbeats[eid] = time.monotonic()
             rm.heartbeat_from(eid)
+            return True
 
         try:
             while not self._hb_stop.wait(interval):
@@ -1011,19 +1050,30 @@ class MiniCluster:
                     continue
                 fs = {pool.submit(ping, eid, info["address"]): eid
                       for eid, info in registry.items()}
+                answered = set()
                 try:
                     for f in _futures.as_completed(
                             fs, timeout=max(timeout_s, ping_deadline) + 1):
                         try:
-                            f.result()
+                            if f.result():
+                                answered.add(fs[f])
                         except Exception:
                             pass  # missed beat; timeout decides
                 except _futures.TimeoutError:
                     pass  # stragglers keep running into their deadline
                 # evict executors silent for several timeouts so their
-                # slots stop being offered and their pings stop costing
+                # slots stop being offered and their pings stop costing.
+                # Liveness is re-read AFTER this round's pings: an
+                # executor that just answered (e.g. after the pump itself
+                # was suspended for a while) must never be evicted on a
+                # stale pre-ping snapshot.
+                try:
+                    registry = rm.executor_registry()
+                except Exception:
+                    continue
                 for eid, info in registry.items():
-                    if info["heartbeat_age_s"] > timeout_s * 3:
+                    if eid not in answered \
+                            and info["heartbeat_age_s"] > timeout_s * 3:
                         try:
                             rm.mark_dead(eid)
                         except Exception:

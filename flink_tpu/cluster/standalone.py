@@ -46,8 +46,13 @@ class TaskExecutorRunner:
                 ClusterOptions.RPC_ADVERTISED_ADDRESS))
         self.executor_id = executor_id or f"taskexecutor-{uuid.uuid4().hex[:8]}"
         self.num_slots = self.config.get(ClusterOptions.SLOTS_PER_EXECUTOR)
+        # a worker that loses its master cancels its tasks rather than
+        # keep writing output/checkpoints the failover will race
+        timeout_s = self.config.get(
+            ClusterOptions.HEARTBEAT_TIMEOUT_MS) / 1000.0
         self.endpoint = TaskExecutorEndpoint(self.executor_id,
-                                             self.num_slots)
+                                             self.num_slots,
+                                             master_timeout_s=timeout_s * 3)
         self.service.register(self.endpoint)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -251,7 +251,12 @@ class DeploymentOptions:
 class StateOptions:
     BACKEND = ConfigOption(
         "state.backend", default="tpu-slot-table", type=str,
-        description="State backend: 'tpu-slot-table' (device HBM) or 'host-heap'.")
+        description="Keyed-state backend (flink_tpu.state.backends SPI): "
+        "'tpu-slot-table' commits accumulators to the accelerator (HBM, "
+        "with the spill tier beyond it); 'host-heap' commits them to the "
+        "host CPU device — no accelerator traffic at all, the "
+        "HashMapStateBackend role for small-state jobs. Third-party "
+        "placements register via register_state_backend().")
     SLOT_CAPACITY = ConfigOption(
         "state.slot-table.capacity", default=1 << 20, type=int,
         description="Fixed slot capacity per keyed window state (XLA static shape).")

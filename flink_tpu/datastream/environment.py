@@ -93,6 +93,11 @@ class StreamExecutionEnvironment:
         """state.window-layout: 'slots' | 'panes' | 'auto'."""
         return self.config.get(StateOptions.WINDOW_LAYOUT)
 
+    @property
+    def state_backend(self) -> str:
+        """state.backend: keyed-state placement (flink_tpu.state.backends)."""
+        return self.config.get(StateOptions.BACKEND)
+
     def enable_checkpointing(self, interval_ms: int) -> "StreamExecutionEnvironment":
         self.config.set(CheckpointOptions.INTERVAL_MS, interval_ms)
         return self

@@ -462,16 +462,20 @@ class WindowedStream:
                     "(key, merged window), not one batch per aligned window")
             gap = assigner.gap
             spill = env.state_spill_options
+            backend = env.state_backend
             factory = lambda: SessionWindowAggOperator(  # noqa: E731
                 gap, agg, key_field, capacity=capacity,
-                allowed_lateness=lateness, spill=spill)
+                allowed_lateness=lateness, spill=spill,
+                state_backend=backend)
         else:
             spill = env.state_spill_options
             layout = env.window_layout
+            backend = env.state_backend
             factory = lambda: WindowAggOperator(  # noqa: E731
                 assigner, agg, key_field, capacity=capacity,
                 allowed_lateness=lateness, spill=spill,
-                fire_projector=fire_projector, window_layout=layout)
+                fire_projector=fire_projector, window_layout=layout,
+                state_backend=backend)
         t = Transformation(
             name=name or f"window_agg({type(agg).__name__})",
             kind="one_input",

@@ -158,8 +158,10 @@ class WindowAggOperator(Operator):
     def __init__(self, assigner: WindowAssigner, agg: AggregateFunction,
                  key_field: str, capacity: int = 1 << 16,
                  allowed_lateness: int = 0, spill: dict = None,
-                 fire_projector=None, window_layout: str = "auto"):
+                 fire_projector=None, window_layout: str = "auto",
+                 state_backend: str = "tpu-slot-table"):
         self.window_layout = window_layout
+        self.state_backend = state_backend
         self.assigner = assigner
         self.agg = agg
         self.key_field = key_field
@@ -220,6 +222,13 @@ class WindowAggOperator(Operator):
                     "state.slot-table.max-device-slots is not yet honored "
                     "by the mesh-parallel window engine — state stays "
                     "device-resident at parallelism > 1", stacklevel=2)
+            if self.state_backend not in ("tpu-slot-table",):
+                import warnings
+
+                warnings.warn(
+                    f"state.backend={self.state_backend!r} is ignored at "
+                    "parallelism > 1 — mesh-sharded state is placed by "
+                    "the mesh itself", stacklevel=2)
             mesh = getattr(ctx, "mesh", None) or make_mesh(effective)
             self.windower = MeshWindowEngine(
                 self.assigner, self.agg, mesh,
@@ -228,6 +237,7 @@ class WindowAggOperator(Operator):
                 allowed_lateness=self.allowed_lateness,
                 fire_projector=self.fire_projector)
         else:
+            table_kwargs, placement = self._table_kwargs()
             has_spill = bool(self.spill and any(self.spill.values()))
             # 'auto' currently resolves to the slot layout: the pane
             # layout's dense fires measure SLOWER on CPU, and its win case
@@ -244,6 +254,11 @@ class WindowAggOperator(Operator):
                 raise ValueError(
                     "state.window-layout=panes has no spill tier — use "
                     "'slots' (or 'auto') with state.spill.* options")
+            if use_panes and placement is not None:
+                raise ValueError(
+                    "state.window-layout=panes supports only the default "
+                    "placement; state.backend placements (host-heap) use "
+                    "the slot layout")
             if use_panes:
                 # pane/ring layout: fires are pure device reductions with
                 # no per-fire host->device transfer (state/pane_table.py)
@@ -259,9 +274,21 @@ class WindowAggOperator(Operator):
                     self.assigner, self.agg, capacity=self.capacity,
                     max_parallelism=ctx.max_parallelism,
                     allowed_lateness=self.allowed_lateness,
-                    spill=self.spill,
+                    spill=table_kwargs,
                     fire_projector=self.fire_projector)
         self._resolve_async_fires(ctx)
+
+    def _table_kwargs(self):
+        """(SlotTable kwargs incl. backend placement, placement) — the
+        spill options plus the state backend's device commitment (one
+        implementation for aligned and session windows)."""
+        from flink_tpu.state.backends import resolve_placement
+
+        placement = resolve_placement(self.state_backend)
+        kwargs = dict(self.spill or {})
+        if placement is not None:
+            kwargs["device"] = placement
+        return kwargs, placement
 
     def _resolve_async_fires(self, ctx) -> None:
         """Deferred fire harvesting needs both an engine that can dispatch
@@ -464,10 +491,10 @@ class SessionWindowAggOperator(WindowAggOperator):
 
     def __init__(self, gap: int, agg: AggregateFunction, key_field: str,
                  capacity: int = 1 << 16, allowed_lateness: int = 0,
-                 spill: dict = None):
+                 spill: dict = None, state_backend: str = "tpu-slot-table"):
         super().__init__(assigner=None, agg=agg, key_field=key_field,
                          capacity=capacity, allowed_lateness=allowed_lateness,
-                         spill=spill)
+                         spill=spill, state_backend=state_backend)
         self.gap = gap
 
     def open(self, ctx):
@@ -498,11 +525,12 @@ class SessionWindowAggOperator(WindowAggOperator):
                 max_parallelism=ctx.max_parallelism,
                 allowed_lateness=self.allowed_lateness)
         else:
+            table_kwargs, _ = self._table_kwargs()
             self.windower = SessionWindower(
                 self.gap, self.agg, capacity=self.capacity,
                 max_parallelism=ctx.max_parallelism,
                 allowed_lateness=self.allowed_lateness,
-                spill=self.spill)
+                spill=table_kwargs)
         self._resolve_async_fires(ctx)
 
     def query_state(self, key_value, namespace=None):

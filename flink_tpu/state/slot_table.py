@@ -536,6 +536,11 @@ class SlotTable:
                        else "raise state.slot-table.capacity"))
         self.accs: Tuple[jnp.ndarray, ...] = agg.init_accumulators(
             self.index.capacity)
+        if device is not None:
+            # the state backend's whole decision (state/backends.py):
+            # committing the accumulators pins every kernel that touches
+            # them to this device — XLA computation follows placement
+            self.accs = tuple(jax.device_put(a, device) for a in self.accs)
         # buckets are sticky: once a program of bucket B compiled, nearby
         # smaller batches reuse it instead of compiling a smaller program
         # (XLA compiles dominate cold cost; padded lanes hit identity slot 0;

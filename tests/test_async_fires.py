@@ -165,7 +165,7 @@ class TestCheckpointDrain:
         env = StreamExecutionEnvironment(Configuration({
             "execution.window.async-fires": True,
             "execution.micro-batch.size": 16,
-            "execution.checkpointing.every-n-batches": 2,
+            "execution.checkpointing.every-n-source-batches": 2,
             "state.checkpoints.dir": str(tmp_path / "ckpt"),
         }))
         rows = make_rows(300, keys=7)

@@ -117,7 +117,7 @@ class TestStandaloneCluster:
                 Configuration({"heartbeat.interval-ms": 100})).start()
             env = StreamExecutionEnvironment(Configuration({
                 "execution.micro-batch.size": 256,
-                "execution.checkpointing.every-n-batches": 4,
+                "execution.checkpointing.every-n-source-batches": 4,
                 "state.checkpoints.dir": str(tmp_path / "ckpt"),
                 "restart-strategy.fixed-delay.attempts": 3,
                 "restart-strategy.fixed-delay.delay-ms": 100,
