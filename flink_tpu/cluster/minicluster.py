@@ -101,10 +101,17 @@ class TaskExecutorEndpoint(RpcEndpoint):
     #: terminal task records kept for status queries (bounded history)
     MAX_FINISHED_RECORDS = 32
 
+    def _touch_master(self) -> None:
+        self._last_master_contact = time.monotonic()
+
     def submit_task(self, execution_id: str, graph, config_dict: dict,
                     job_name: str, restore_from: Optional[str]) -> str:
         import queue
 
+        # any master RPC proves the master is alive — a deployment from a
+        # just-recovered master must not be killed by a stale watchdog
+        # before the first heartbeat ping lands
+        self._touch_master()
         cancel = threading.Event()
         control: "queue.Queue" = queue.Queue()
         record = {"status": RUNNING, "cancel": cancel, "result": None,
@@ -157,6 +164,7 @@ class TaskExecutorEndpoint(RpcEndpoint):
             del self._tasks[eid]
 
     def cancel_task(self, execution_id: str) -> None:
+        self._touch_master()
         rec = self._tasks.get(execution_id)
         if rec is not None:
             rec["cancel"].set()
@@ -237,6 +245,12 @@ class ResourceManagerEndpoint(RpcEndpoint):
         super().__init__("resourcemanager")
         self._executors: Dict[str, dict] = {}
         self._blocklist: set = set()
+        #: eviction tombstones: eid -> last_heartbeat at eviction time. A
+        #: re-registration inherits the stale liveness, so a one-way-
+        #: partitioned worker (its keepalive reaches us, our pings don't
+        #: reach it) cannot flap back to "fresh" every eviction; only an
+        #: answered ping (heartbeat_from) clears the tombstone.
+        self._evicted: Dict[str, float] = {}
         #: notification hook the hosting process sets to react to remote
         #: joins (adaptive-scheduler jobs rescale to new resources);
         #: invoked on the endpoint main thread — implementations must not
@@ -247,16 +261,18 @@ class ResourceManagerEndpoint(RpcEndpoint):
                                num_slots: int) -> None:
         fresh = executor_id not in self._executors
         prev = self._executors.get(executor_id, {})
+        # a keepalive RE-registration must NOT refresh liveness: a worker
+        # that can reach the master while the master cannot reach it
+        # (wrong advertised address, one-way partition) has to age out of
+        # the registry — only answered pings (heartbeat_from) refresh.
+        # An evicted worker's re-registration inherits its tombstoned
+        # staleness so it cannot flap back in; a ping answer clears it.
+        hb = prev.get("last_heartbeat",
+                      self._evicted.get(executor_id, time.monotonic()))
         self._executors[executor_id] = {
             "address": address, "slots": num_slots,
             "allocated": prev.get("allocated", 0),
-            # a keepalive RE-registration must NOT refresh liveness: a
-            # worker that can reach the master while the master cannot
-            # reach it (wrong advertised address, one-way partition) has
-            # to age out of the registry — only actual ping answers
-            # refresh last_heartbeat
-            "last_heartbeat": prev.get("last_heartbeat",
-                                       time.monotonic()),
+            "last_heartbeat": hb,
         }
         if fresh and self.on_register is not None:
             self.on_register(executor_id)
@@ -276,9 +292,14 @@ class ResourceManagerEndpoint(RpcEndpoint):
         info = self._executors.get(executor_id)
         if info is not None:
             info["last_heartbeat"] = time.monotonic()
+        self._evicted.pop(executor_id, None)  # reachable again
 
     def mark_dead(self, executor_id: str) -> None:
-        self._executors.pop(executor_id, None)
+        info = self._executors.pop(executor_id, None)
+        if info is not None:
+            self._evicted[executor_id] = info["last_heartbeat"]
+            if len(self._evicted) > 256:  # bounded tombstone memory
+                self._evicted.pop(next(iter(self._evicted)))
 
     def block_node(self, executor_id: str) -> None:
         self._blocklist.add(executor_id)

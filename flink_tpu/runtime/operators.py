@@ -518,6 +518,13 @@ class SessionWindowAggOperator(WindowAggOperator):
                     "state.slot-table.max-device-slots is not yet honored "
                     "by the mesh-parallel session engine — state stays "
                     "device-resident at parallelism > 1", stacklevel=2)
+            if self.state_backend not in ("tpu-slot-table",):
+                import warnings
+
+                warnings.warn(
+                    f"state.backend={self.state_backend!r} is ignored at "
+                    "parallelism > 1 — mesh-sharded state is placed by "
+                    "the mesh itself", stacklevel=2)
             mesh = getattr(ctx, "mesh", None) or make_mesh(effective)
             self.windower = MeshSessionEngine(
                 self.gap, self.agg, mesh,

@@ -59,6 +59,112 @@ class Table:
     def to_data_stream(self) -> DataStream:
         return self.stream
 
+    # -- fluent relational API (reference: Table API DSL; every method
+    # -- builds the SQL AST and plans through the one Planner+optimizer —
+    # -- see flink_tpu/table/fluent.py) --------------------------------------
+
+    _alias: Optional[str] = None
+
+    def alias(self, name: str) -> "Table":
+        """Name this table for qualified references in joins
+        (reference: Table.as)."""
+        out = Table(self.t_env, self.stream, self.columns, self.time_field,
+                    self.upsert_keys, self.sort_spec, self.limit)
+        out._alias = name
+        return out
+
+    def _ref(self):
+        from flink_tpu.table.fluent import _InlineTable
+
+        return _InlineTable(self, self._alias)
+
+    def _plan(self, stmt) -> "Table":
+        from flink_tpu.table.fluent import _plan
+
+        return Table._from_planned(self.t_env, _plan(self.t_env, stmt))
+
+    def select(self, *exprs) -> "Table":
+        from flink_tpu.table import sql_parser as ast
+        from flink_tpu.table.fluent import _items
+
+        return self._plan(ast.SelectStmt(items=_items(exprs),
+                                         table=self._ref()))
+
+    def where(self, predicate) -> "Table":
+        from flink_tpu.table import sql_parser as ast
+        from flink_tpu.table.expressions import SelectItem, Star
+        from flink_tpu.table.fluent import _expr
+
+        return self._plan(ast.SelectStmt(
+            items=[SelectItem(Star(), None)], table=self._ref(),
+            where=_expr(predicate)))
+
+    #: reference spelling
+    filter = where
+
+    def group_by(self, *keys):
+        from flink_tpu.table.fluent import GroupedTable, GroupWindow
+
+        window = None
+        plain = []
+        for k in keys:
+            if isinstance(k, GroupWindow):
+                window = k
+            else:
+                plain.append(k)
+        return GroupedTable(self, plain, window)
+
+    def window(self, group_window):
+        """Attach a group window; follow with .group_by(..).select(..)
+        (reference: Table.window(Tumble...).groupBy(...).select(...))."""
+        from flink_tpu.table.fluent import _WindowedTable
+
+        return _WindowedTable(self, group_window)
+
+    def join(self, other: "Table", on) -> "Table":
+        return self._join(other, on, "INNER")
+
+    def left_outer_join(self, other: "Table", on) -> "Table":
+        return self._join(other, on, "LEFT")
+
+    def _join(self, other: "Table", on, kind: str) -> "Table":
+        from flink_tpu.table import sql_parser as ast
+        from flink_tpu.table.expressions import SelectItem, Star
+        from flink_tpu.table.fluent import _expr
+
+        join = ast.Join(self._ref(), other._ref(), kind, _expr(on))
+        return self._plan(ast.SelectStmt(
+            items=[SelectItem(Star(), None)], table=join))
+
+    def order_by(self, *exprs) -> "Table":
+        """ORDER BY — a materialization-time sort spec on this table
+        (exactly what the planner records for SQL ORDER BY), so chaining
+        .order_by(...).fetch(n) composes instead of re-planning."""
+        from flink_tpu.table.fluent import _order_items
+
+        out = Table(self.t_env, self.stream, self.columns, self.time_field,
+                    self.upsert_keys,
+                    sort_spec=[(o.expr, o.descending)
+                               for o in _order_items(exprs)],
+                    limit=self.limit)
+        out._alias = self._alias
+        return out
+
+    def fetch(self, n: int) -> "Table":
+        """LIMIT n (reference: Table.fetch)."""
+        out = Table(self.t_env, self.stream, self.columns, self.time_field,
+                    self.upsert_keys, sort_spec=self.sort_spec, limit=n)
+        out._alias = self._alias
+        return out
+
+    def distinct(self) -> "Table":
+        from flink_tpu.table import sql_parser as ast
+        from flink_tpu.table.expressions import SelectItem, Star
+
+        return self._plan(ast.SelectStmt(
+            items=[SelectItem(Star(), None)], table=self._ref(),
+            distinct=True))
+
 
 @public_evolving
 class TableResult:

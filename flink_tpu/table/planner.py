@@ -144,6 +144,22 @@ class Planner:
     # ------------------------------------------------------- FROM clause
 
     def _plan_table_ref(self, ref: ast.TableRef) -> PlannedTable:
+        from flink_tpu.table.fluent import _InlineTable
+
+        if isinstance(ref, _InlineTable):
+            # the fluent API's FROM clause: a live Table object instead of
+            # a catalog name (reference: Table API queries never register)
+            t = ref.table
+            if t.sort_spec is not None or t.limit is not None:
+                # ORDER BY / LIMIT are materialization-time decorations in
+                # this engine; further relational ops over them would
+                # silently ignore the sort/limit — fail instead
+                raise PlanError(
+                    "order_by()/fetch() are terminal operations — apply "
+                    "them AFTER the other relational operations (their "
+                    "sort/limit applies when the table materializes)")
+            return PlannedTable(t.stream, list(t.columns), ref.alias,
+                                t.time_field, t.upsert_keys)
         if isinstance(ref, ast.NamedTable):
             t = self.t_env.lookup(ref.name)
             return PlannedTable(t.stream, list(t.columns), ref.alias,

@@ -180,7 +180,9 @@ class TestTrueMultiProcess:
             _pipeline(env, out, total=10_000)
             job_id, dispatcher = remote_submit(jm.service.address, env,
                                                "xproc-job")
-            st = _wait(dispatcher, job_id, timeout=120)
+            # generous deadline: the worker subprocess cold-imports jax
+            # and may compile under full-suite load
+            st = _wait(dispatcher, job_id, timeout=240)
             assert st["status"] == FINISHED, st
             assert sum(1 for _ in
                        JsonLinesFileSink.read_rows(str(out))) > 0

@@ -67,9 +67,59 @@ class TestHostHeap:
         # placement sticks across donated-buffer kernels
         assert all(list(a.devices()) == [cpu] for a in t.accs)
 
-    def test_checkpoint_crosses_backends(self, tmp_path):
+    def test_snapshot_crosses_backends(self):
         """A snapshot taken under one placement restores under another —
-        snapshots are logical rows, not device buffers."""
+        snapshots are logical rows, not device buffers. Ingest half the
+        stream on host-heap, snapshot, restore onto the default
+        placement, ingest the rest: fires must equal a single-placement
+        run."""
+        import jax
+
+        from flink_tpu.windowing.aggregates import SumAggregate
+        from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+        from flink_tpu.windowing.windower import SliceSharedWindower
+        from flink_tpu.core.records import RecordBatch
+
+        rng = np.random.default_rng(2)
+        n = 3000
+        keys = rng.integers(0, 20, n).astype(np.int64)
+        vals = rng.random(n).astype(np.float32)
+        ts = np.arange(n, dtype=np.int64) * 2
+
+        def batch(sl):
+            return RecordBatch(
+                {"__key_id__": keys[sl], "v": vals[sl], "__ts__": ts[sl]})
+
+        assigner = TumblingEventTimeWindows.of(1000)
+        cpu = jax.devices("cpu")[0]
+
+        w1 = SliceSharedWindower(assigner, SumAggregate("v"),
+                                 capacity=1 << 12,
+                                 spill={"device": cpu})
+        w1.process_batch(batch(slice(0, n // 2)))
+        snap = w1.snapshot()
+        w2 = SliceSharedWindower(assigner, SumAggregate("v"),
+                                 capacity=1 << 12)  # default placement
+        w2.restore(snap)
+        w2.process_batch(batch(slice(n // 2, n)))
+        fired = w2.on_watermark(int(ts[-1]) + 1000)
+
+        ref = SliceSharedWindower(assigner, SumAggregate("v"),
+                                  capacity=1 << 12)
+        ref.process_batch(batch(slice(0, n)))
+        expect = ref.on_watermark(int(ts[-1]) + 1000)
+
+        def flat(batches):
+            out = {}
+            for b in batches:
+                for r in b.to_rows():
+                    out[(r["__key_id__"], r["window_start"])] = round(
+                        float(r["sum_v"]), 3)
+            return out
+
+        assert flat(fired) == flat(expect) and len(flat(expect)) > 20
+
+    def test_checkpoints_written_under_host_heap(self, tmp_path):
         rows = _rows(800)
         a = SlidingEventTimeWindows.of(600, 300)
         conf = {"execution.micro-batch.size": 64,
@@ -84,7 +134,7 @@ class TestHostHeap:
 
         chks = [d for d in os.listdir(tmp_path / "ckpt")
                 if d.startswith("chk-")]
-        assert chks  # checkpoints were written under host-heap placement
+        assert chks
 
 
 class TestRegistry:
