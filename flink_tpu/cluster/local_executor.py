@@ -314,7 +314,9 @@ class LocalExecutor:
                 ctx = OperatorContext(operator_index=0, parallelism=par,
                                       max_parallelism=max_parallelism,
                                       async_fires=self.config.get(
-                                          BatchOptions.ASYNC_FIRES))
+                                          BatchOptions.ASYNC_FIRES),
+                                      max_dispatch_ahead=self.config.get(
+                                          BatchOptions.MAX_DISPATCH_AHEAD))
                 op.open(ctx)
             nodes[t.uid] = node
             g = job_group.add_group(f"{t.name}#{t.uid}")

@@ -170,6 +170,13 @@ class BatchOptions:
     MIN_BATCH_SIZE = ConfigOption(
         "execution.micro-batch.min-size", default=256, type=int,
         description="Lower bound for adaptive batch sizing.")
+    MAX_DISPATCH_AHEAD = ConfigOption(
+        "execution.pipeline.max-dispatch-batches", default=4, type=int,
+        description="How many batches of device work the task loop may "
+        "dispatch ahead of completion (per-batch fences). Smaller = "
+        "tighter fire latency (a fire kernel queues behind at most this "
+        "many batches); larger = more overlap headroom on "
+        "high-latency device links.")
     ASYNC_FIRES = ConfigOption(
         "execution.window.async-fires", default=True, type=bool,
         description="Dispatch window fires asynchronously: the fire kernel "

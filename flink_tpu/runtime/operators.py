@@ -83,7 +83,7 @@ class OperatorContext:
 
     def __init__(self, operator_index: int = 0, parallelism: int = 1,
                  max_parallelism: int = 128, metrics=None,
-                 async_fires: bool = False):
+                 async_fires: bool = False, max_dispatch_ahead: int = 4):
         self.operator_index = operator_index
         self.parallelism = parallelism
         self.max_parallelism = max_parallelism
@@ -92,6 +92,8 @@ class OperatorContext:
         #: watermark holdback (LocalExecutor's loop); executors that
         #: forward watermarks eagerly must leave this off
         self.async_fires = async_fires
+        #: per-batch fence depth (execution.pipeline.max-dispatch-batches)
+        self.max_dispatch_ahead = max_dispatch_ahead
 
 
 class MapOperator(Operator):
@@ -195,7 +197,7 @@ class WindowAggOperator(Operator):
         #: of the device queue — keeps fire kernels (and their latency)
         #: from queueing behind an unbounded scatter backlog
         self._fences = deque()
-        self._max_dispatch_ahead = 4
+        self._max_dispatch_ahead = 4  # overridden from ctx in open()
 
     def open(self, ctx):
         import jax
@@ -222,13 +224,7 @@ class WindowAggOperator(Operator):
                     "state.slot-table.max-device-slots is not yet honored "
                     "by the mesh-parallel window engine — state stays "
                     "device-resident at parallelism > 1", stacklevel=2)
-            if self.state_backend not in ("tpu-slot-table",):
-                import warnings
-
-                warnings.warn(
-                    f"state.backend={self.state_backend!r} is ignored at "
-                    "parallelism > 1 — mesh-sharded state is placed by "
-                    "the mesh itself", stacklevel=2)
+            self._warn_backend_ignored_on_mesh()
             mesh = getattr(ctx, "mesh", None) or make_mesh(effective)
             self.windower = MeshWindowEngine(
                 self.assigner, self.agg, mesh,
@@ -278,6 +274,15 @@ class WindowAggOperator(Operator):
                     fire_projector=self.fire_projector)
         self._resolve_async_fires(ctx)
 
+    def _warn_backend_ignored_on_mesh(self) -> None:
+        if self.state_backend not in ("tpu-slot-table",):
+            import warnings
+
+            warnings.warn(
+                f"state.backend={self.state_backend!r} is ignored at "
+                "parallelism > 1 — mesh-sharded state is placed by "
+                "the mesh itself", stacklevel=3)
+
     def _table_kwargs(self):
         """(SlotTable kwargs incl. backend placement, placement) — the
         spill options plus the state backend's device commitment (one
@@ -298,6 +303,8 @@ class WindowAggOperator(Operator):
         self._async_fires = bool(
             getattr(ctx, "async_fires", False)
             and getattr(self.windower, "supports_async_fires", False))
+        self._max_dispatch_ahead = int(
+            getattr(ctx, "max_dispatch_ahead", self._max_dispatch_ahead))
 
     def process_batch(self, batch, input_index=0):
         if self.key_field in batch.columns:
@@ -518,13 +525,7 @@ class SessionWindowAggOperator(WindowAggOperator):
                     "state.slot-table.max-device-slots is not yet honored "
                     "by the mesh-parallel session engine — state stays "
                     "device-resident at parallelism > 1", stacklevel=2)
-            if self.state_backend not in ("tpu-slot-table",):
-                import warnings
-
-                warnings.warn(
-                    f"state.backend={self.state_backend!r} is ignored at "
-                    "parallelism > 1 — mesh-sharded state is placed by "
-                    "the mesh itself", stacklevel=2)
+            self._warn_backend_ignored_on_mesh()
             mesh = getattr(ctx, "mesh", None) or make_mesh(effective)
             self.windower = MeshSessionEngine(
                 self.gap, self.agg, mesh,

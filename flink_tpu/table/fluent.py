@@ -57,20 +57,38 @@ class FluentExpr:
     def _bin(self, op: str, other) -> "FluentExpr":
         return FluentExpr(BinaryOp(op, self.expr, _expr(other)))
 
+    def _rbin(self, op: str, other) -> "FluentExpr":
+        return FluentExpr(BinaryOp(op, _expr(other), self.expr))
+
     def __add__(self, o):
         return self._bin("+", o)
+
+    def __radd__(self, o):
+        return self._rbin("+", o)
 
     def __sub__(self, o):
         return self._bin("-", o)
 
+    def __rsub__(self, o):
+        return self._rbin("-", o)
+
     def __mul__(self, o):
         return self._bin("*", o)
+
+    def __rmul__(self, o):
+        return self._rbin("*", o)
 
     def __truediv__(self, o):
         return self._bin("/", o)
 
+    def __rtruediv__(self, o):
+        return self._rbin("/", o)
+
     def __mod__(self, o):
         return self._bin("%", o)
+
+    def __rmod__(self, o):
+        return self._rbin("%", o)
 
     def __gt__(self, o):
         return self._bin(">", o)
@@ -135,7 +153,12 @@ class FluentExpr:
 
 @public_evolving
 def col(name: str) -> FluentExpr:
-    """Column reference (reference: Expressions.$ / pyflink col)."""
+    """Column reference (reference: Expressions.$ / pyflink col).
+    ``col("L.k")`` builds a table-qualified reference, as the SQL parser
+    would — required to disambiguate same-named join keys."""
+    if "." in name:
+        table, _, column = name.partition(".")
+        return FluentExpr(Column(column, table))
     return FluentExpr(Column(name))
 
 
@@ -292,7 +315,7 @@ class GroupedTable:
         from flink_tpu.table.environment import Table
 
         t = self._table
-        ref: ast.TableRef = _InlineTable(t)
+        ref: ast.TableRef = t._ref()  # preserves the table's alias
         group_by: List[Expr] = []
         items = _items(exprs)
         if self._window is not None:

@@ -110,7 +110,39 @@ class TestGroupWindows:
         assert _sorted(fluent) == _sorted(sql)
 
 
+class TestExpressions:
+    def test_reflected_arithmetic(self):
+        t_env = _t_env()
+        bids = _bids(t_env, 200)
+        rows = (bids.select(col("auction"),
+                            (100 - col("price")).alias("inv"),
+                            (2 * col("price")).alias("dbl"))
+                .execute().collect())
+        sql = t_env.execute_sql(
+            "SELECT auction, 100 - price AS inv, 2 * price AS dbl "
+            "FROM bid").collect()
+        assert _sorted(rows) == _sorted(sql)
+
+
 class TestJoin:
+    def test_same_named_keys_via_qualified_cols(self):
+        """col('L.k') == col('R.k') — the common join shape where both
+        sides share the key column name."""
+        t_env = _t_env()
+        rng = np.random.default_rng(4)
+        left = [{"k": int(rng.integers(6)), "x": float(i), "t": i * 7}
+                for i in range(150)]
+        right = [{"k": int(rng.integers(6)), "y": float(i), "t": i * 7}
+                 for i in range(150)]
+        lt = t_env.from_collection(left, timestamp_field="t").alias("L")
+        rt = t_env.from_collection(right, timestamp_field="t").alias("R")
+        t_env.create_temporary_view("L", lt)
+        t_env.create_temporary_view("R", rt)
+        fluent = lt.join(rt, col("L.k") == col("R.k")).execute().collect()
+        sql = t_env.execute_sql(
+            "SELECT * FROM L JOIN R ON L.k = R.k").collect()
+        assert len(fluent) == len(sql) > 0
+        assert _sorted(fluent) == _sorted(sql)
     def test_inner_join_matches_sql(self):
         t_env = _t_env()
         rng = np.random.default_rng(9)
