@@ -569,7 +569,21 @@ class UnionOperator(Operator):
 
     name = "union"
 
+    def __init__(self):
+        self._timed: Optional[bool] = None
+
     def process_batch(self, batch, input_index=0):
+        # inputs must agree on event time: a mix would feed untimed rows
+        # into downstream windows, failing deep in a kernel instead of
+        # here with the actual cause
+        timed = batch.has_timestamps
+        if self._timed is None:
+            self._timed = timed
+        elif timed != self._timed:
+            raise RuntimeError(
+                "union inputs disagree on event time: some carry "
+                "timestamps and some do not — assign timestamps on every "
+                "branch (or none)")
         return [batch]
 
 

@@ -165,6 +165,15 @@ class Table:
             items=[SelectItem(Star(), None)], table=self._ref(),
             distinct=True))
 
+    def union_all(self, *others: "Table") -> "Table":
+        from flink_tpu.table import sql_parser as ast
+        from flink_tpu.table.expressions import SelectItem, Star
+
+        selects = [ast.SelectStmt(items=[SelectItem(Star(), None)],
+                                  table=t._ref())
+                   for t in (self, *others)]
+        return self._plan(ast.UnionAll(selects))
+
 
 @public_evolving
 class TableResult:
@@ -298,7 +307,8 @@ class StreamTableEnvironment:
 
     def sql_query(self, sql: str) -> Table:
         stmt = sql_parser.parse(sql)
-        if not isinstance(stmt, sql_parser.SelectStmt):
+        if not isinstance(stmt, (sql_parser.SelectStmt,
+                                 sql_parser.UnionAll)):
             raise PlanError("sql_query expects a SELECT statement")
         planned = Planner(self).plan_select(optimize(stmt))
         return Table._from_planned(self, planned)

@@ -222,8 +222,13 @@ def _pushable_subquery(stmt: ast.SelectStmt) -> bool:
     dedup, windowing, row-limiting, or OVER windows between the
     predicate's old and new positions (the rank/Top-N pattern NEEDS its
     ``rownum <= N`` filter to stay above the ROW_NUMBER subquery — that
-    filter is how the planner recognizes Top-N)."""
+    filter is how the planner recognizes Top-N). UNION ALL subqueries
+    are left alone (pushing would have to fan the predicate out per
+    branch)."""
     from flink_tpu.table.expressions import OverCall
+
+    if not isinstance(stmt, ast.SelectStmt):
+        return False
 
     return (not stmt.group_by and not stmt.having and not stmt.distinct
             and stmt.limit is None and not stmt.order_by
@@ -340,7 +345,7 @@ def _optimize_select(stmt: ast.SelectStmt) -> ast.SelectStmt:
 
 def _optimize_ref(ref: ast.TableRef) -> ast.TableRef:
     if isinstance(ref, ast.SubQuery):
-        return ast.SubQuery(_optimize_select(ref.query), ref.alias)
+        return ast.SubQuery(optimize(ref.query), ref.alias)
     if isinstance(ref, ast.Join):
         return ast.Join(_optimize_ref(ref.left), _optimize_ref(ref.right),
                         ref.kind, fold_constants(ref.condition))
@@ -351,8 +356,11 @@ def _optimize_ref(ref: ast.TableRef) -> ast.TableRef:
     return ref
 
 
-def optimize(stmt: ast.SelectStmt) -> ast.SelectStmt:
+def optimize(stmt):
     """The planner's pre-pass: apply the rule set to fixpoint (two passes
     suffice — pushdown exposes at most one new fold opportunity layer,
     and the rules strictly shrink/sink predicates)."""
+    if isinstance(stmt, ast.UnionAll):
+        return dataclasses.replace(
+            stmt, selects=[optimize(s) for s in stmt.selects])
     return _optimize_select(_optimize_select(stmt))

@@ -100,7 +100,9 @@ class Planner:
 
     # ------------------------------------------------------------ entry
 
-    def plan_select(self, stmt: ast.SelectStmt) -> PlannedTable:
+    def plan_select(self, stmt) -> PlannedTable:
+        if isinstance(stmt, ast.UnionAll):
+            return self._plan_union(stmt)
         window = None
         if isinstance(stmt.table, ast.WindowTVF):
             window = stmt.table
@@ -141,6 +143,37 @@ class Planner:
                             "window_end")
         return self._plan_projection(stream, source, items, stmt)
 
+    def _plan_union(self, stmt: "ast.UnionAll") -> PlannedTable:
+        """UNION ALL: plan every branch, require identical output columns,
+        merge the streams (reference: StreamExecUnion — a plain stream
+        merge, no exchange)."""
+        planned = [self.plan_select(s) for s in stmt.selects]
+        cols = planned[0].columns
+        for p in planned:
+            if p.columns != cols:
+                raise PlanError(
+                    "UNION ALL branches must produce identical columns; "
+                    f"got {cols} vs {p.columns}")
+            if p.upsert_keys is not None:
+                # merging changelog streams would alias per-branch keys:
+                # downstream upsert materialization keeps one row per key
+                # ACROSS branches, silently dropping the other branch's
+                raise PlanError(
+                    "UNION ALL over an updating (changelog) branch is "
+                    "not supported — materialize the aggregates first "
+                    "(e.g. windowed aggregation) or union the raw inputs")
+        timed = {p.time_field is not None for p in planned}
+        if len(timed) > 1:
+            raise PlanError(
+                "UNION ALL branches must agree on event time: some "
+                "branches carry timestamps and some do not (a window "
+                "over the union would fail on the untimed rows)")
+        stream = planned[0].stream.union(
+            *[p.stream for p in planned[1:]]) if len(planned) > 1 \
+            else planned[0].stream
+        out = PlannedTable(stream, list(cols), None, planned[0].time_field)
+        return self._apply_order_limit(out, stmt)
+
     # ------------------------------------------------------- FROM clause
 
     def _plan_table_ref(self, ref: ast.TableRef) -> PlannedTable:
@@ -166,6 +199,13 @@ class Planner:
                                 t.time_field, t.upsert_keys)
         if isinstance(ref, ast.SubQuery):
             inner = self.plan_select(ref.query)
+            if inner.sort_spec is not None or inner.limit is not None:
+                # ORDER BY/LIMIT are materialization-time; an enclosing
+                # query would silently ignore them (same contract as the
+                # fluent API's terminal order_by/fetch)
+                raise PlanError(
+                    "ORDER BY / LIMIT inside a subquery is not supported "
+                    "— apply them in the outermost query")
             inner.alias = ref.alias
             return inner
         if isinstance(ref, ast.WindowTVF):

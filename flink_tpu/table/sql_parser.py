@@ -103,6 +103,18 @@ class SelectStmt:
 
 
 @dataclasses.dataclass
+class UnionAll:
+    """SELECT ... UNION ALL SELECT ... (reference: SqlSetOperator UNION
+    ALL; UNION DISTINCT would need a global dedup over an unbounded
+    stream and is rejected at parse time). A trailing ORDER BY/LIMIT
+    binds to the whole union."""
+
+    selects: List["SelectStmt"]
+    order_by: List["OrderItem"] = dataclasses.field(default_factory=list)
+    limit: Optional[int] = None
+
+
+@dataclasses.dataclass
 class CreateView:
     name: str
     query: SelectStmt
@@ -123,7 +135,7 @@ class InsertInto:
     query: SelectStmt
 
 
-Statement = Union[SelectStmt, CreateView, CreateModel, InsertInto]
+Statement = Union[SelectStmt, UnionAll, CreateView, CreateModel, InsertInto]
 
 # ---------------------------------------------------------------------------
 # Lexer
@@ -235,7 +247,7 @@ class Parser:
         elif self.at_kw("INSERT"):
             stmt = self._insert_into()
         else:
-            stmt = self.parse_select()
+            stmt = self.parse_query()
         self.accept_op(";")
         if self.peek().kind != "end":
             raise SqlParseError(f"trailing input at {self.peek().value!r}")
@@ -249,7 +261,7 @@ class Parser:
         self.expect_kw("VIEW")
         name = self.next().value
         self.expect_kw("AS")
-        return CreateView(name, self.parse_select())
+        return CreateView(name, self.parse_query())
 
     def _create_model(self) -> CreateModel:
         name = self.next().value
@@ -274,9 +286,31 @@ class Parser:
         self.expect_kw("INSERT")
         self.expect_kw("INTO")
         name = self.next().value
-        return InsertInto(name, self.parse_select())
+        return InsertInto(name, self.parse_query())
 
     # -- SELECT -------------------------------------------------------------
+
+    def parse_query(self):
+        """One SELECT or a UNION ALL chain."""
+        first = self.parse_select()
+        if not self.at_kw("UNION"):
+            return first
+        selects = [first]
+        while self.accept_kw("UNION"):
+            if not self.accept_kw("ALL"):
+                raise SqlParseError(
+                    "only UNION ALL is supported (UNION DISTINCT would "
+                    "require a global dedup over an unbounded stream)")
+            selects.append(self.parse_select())
+        for s in selects[:-1]:
+            if s.order_by or s.limit is not None:
+                raise SqlParseError(
+                    "ORDER BY / LIMIT inside a UNION branch is not "
+                    "supported; place it after the last branch")
+        last = selects[-1]
+        order_by, limit = last.order_by, last.limit
+        selects[-1] = dataclasses.replace(last, order_by=[], limit=None)
+        return UnionAll(selects, order_by, limit)
 
     def parse_select(self) -> SelectStmt:
         self.expect_kw("SELECT")
@@ -356,7 +390,7 @@ class Parser:
         if self.peek().upper == "ML_PREDICT" and self.peek(1).value == "(":
             return self._ml_predict_tvf()
         if self.accept_op("("):
-            q = self.parse_select()
+            q = self.parse_query()
             self.expect_op(")")
             alias = self._opt_alias()
             return SubQuery(q, alias)

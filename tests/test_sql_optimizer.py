@@ -138,6 +138,109 @@ class TestSemanticsPreserved:
         assert run(True) == run(False) and len(run(True)) > 0
 
 
+class TestUnionAll:
+    def _env(self):
+        from flink_tpu import Configuration, StreamExecutionEnvironment
+        from flink_tpu.table.environment import StreamTableEnvironment
+
+        return StreamTableEnvironment(StreamExecutionEnvironment(
+            Configuration({"execution.micro-batch.size": 64})))
+
+    def test_union_all_sql(self):
+        t_env = self._env()
+        a = [{"k": i, "v": float(i), "t": i * 10} for i in range(50)]
+        b = [{"k": i + 100, "v": float(i), "t": i * 10} for i in range(30)]
+        t_env.create_temporary_view(
+            "A", t_env.from_collection(a, timestamp_field="t"))
+        t_env.create_temporary_view(
+            "B", t_env.from_collection(b, timestamp_field="t"))
+        rows = t_env.execute_sql(
+            "SELECT k, v FROM A WHERE v > 10 UNION ALL "
+            "SELECT k, v FROM B").collect()
+        exp = [r for r in a if r["v"] > 10] + b
+        assert sorted(r["k"] for r in rows) == sorted(r["k"] for r in exp)
+
+    def test_union_trailing_order_limit(self):
+        t_env = self._env()
+        a = [{"k": i, "v": float(i), "t": i * 10} for i in range(20)]
+        b = [{"k": i, "v": float(i + 100), "t": i * 10} for i in range(20)]
+        t_env.create_temporary_view(
+            "A", t_env.from_collection(a, timestamp_field="t"))
+        t_env.create_temporary_view(
+            "B", t_env.from_collection(b, timestamp_field="t"))
+        rows = t_env.execute_sql(
+            "SELECT v FROM A UNION ALL SELECT v FROM B "
+            "ORDER BY v DESC LIMIT 3").collect()
+        assert [r["v"] for r in rows] == [119.0, 118.0, 117.0]
+
+    def test_union_distinct_rejected(self):
+        from flink_tpu.table.sql_parser import SqlParseError, parse
+
+        with pytest.raises(SqlParseError, match="UNION ALL"):
+            parse("SELECT a FROM t UNION SELECT a FROM u")
+
+    def test_mismatched_columns_rejected(self):
+        from flink_tpu.table.planner import PlanError
+
+        t_env = self._env()
+        a = [{"k": 1, "v": 1.0, "t": 0}]
+        t_env.create_temporary_view(
+            "A", t_env.from_collection(a, timestamp_field="t"))
+        with pytest.raises(PlanError, match="identical columns"):
+            t_env.execute_sql(
+                "SELECT k FROM A UNION ALL SELECT v FROM A").collect()
+
+    def test_union_of_changelog_branch_rejected(self):
+        from flink_tpu.table.planner import PlanError
+
+        t_env = self._env()
+        a = [{"k": i % 3, "v": float(i), "t": i * 10} for i in range(30)]
+        t_env.create_temporary_view(
+            "A", t_env.from_collection(a, timestamp_field="t"))
+        with pytest.raises(PlanError, match="changelog"):
+            t_env.execute_sql(
+                "SELECT k, SUM(v) AS s FROM A GROUP BY k UNION ALL "
+                "SELECT k, SUM(v) AS s FROM A GROUP BY k").collect()
+
+    def test_subquery_order_limit_rejected(self):
+        from flink_tpu.table.planner import PlanError
+
+        t_env = self._env()
+        a = [{"k": i, "v": float(i), "t": i * 10} for i in range(20)]
+        t_env.create_temporary_view(
+            "A", t_env.from_collection(a, timestamp_field="t"))
+        with pytest.raises(PlanError, match="outermost"):
+            t_env.execute_sql(
+                "SELECT k FROM (SELECT k FROM A ORDER BY k LIMIT 3)"
+            ).collect()
+
+    def test_mixed_time_branches_rejected(self):
+        t_env = self._env()
+        a = [{"k": 1, "v": 1.0, "t": 0}]
+        b = [{"k": 2, "v": 2.0}]
+        t_env.create_temporary_view(
+            "A", t_env.from_collection(a, timestamp_field="t"))
+        t_env.create_temporary_view(
+            "B", t_env.from_collection(b), columns=["k", "v"])
+        # the union's runtime guard names the cause (plan-time can't see
+        # it: projections legitimately drop the time-field marker while
+        # the timestamp column still rides along)
+        with pytest.raises(Exception, match="event time"):
+            t_env.execute_sql(
+                "SELECT k, v FROM A UNION ALL SELECT k, v FROM B"
+            ).collect()
+
+    def test_fluent_union_all(self):
+        t_env = self._env()
+        a = [{"k": i, "v": float(i), "t": i * 10} for i in range(10)]
+        b = [{"k": i + 50, "v": float(i), "t": i * 10} for i in range(10)]
+        ta = t_env.from_collection(a, timestamp_field="t")
+        tb = t_env.from_collection(b, timestamp_field="t")
+        rows = ta.union_all(tb).execute().collect()
+        assert sorted(r["k"] for r in rows) == sorted(
+            [r["k"] for r in a] + [r["k"] for r in b])
+
+
 class TestInsertInto:
     def test_insert_into_sink(self):
         from flink_tpu.connectors.sinks import CollectSink
