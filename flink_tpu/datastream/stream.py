@@ -109,10 +109,16 @@ class DataStream:
                            inputs=[self.transformation])
         return DataStream(self.env, t)
 
-    def union(self, *others: "DataStream") -> "DataStream":
+    def union(self, *others: "DataStream",
+              _require_consistent_time: bool = False) -> "DataStream":
+        """Merge streams. The DataStream API permits mixing timed and
+        untimed inputs (valid when nothing downstream uses event time);
+        SQL UNION ALL passes the strict flag because its result feeds
+        relational operators that do."""
         t = Transformation(
             name="union", kind="union",
-            operator_factory=UnionOperator,
+            operator_factory=lambda: UnionOperator(
+                require_consistent_time=_require_consistent_time),
             inputs=[self.transformation] + [o.transformation for o in others])
         return DataStream(self.env, t)
 

@@ -569,21 +569,25 @@ class UnionOperator(Operator):
 
     name = "union"
 
-    def __init__(self):
+    def __init__(self, require_consistent_time: bool = False):
+        #: SQL UNION ALL sets this: its output feeds relational operators
+        #: that assume event-time consistency, so a timed/untimed mix
+        #: must fail HERE with the cause, not inside a window kernel.
+        #: The DataStream API leaves it off — mixing is valid when
+        #: nothing downstream uses event time.
+        self._require_consistent_time = require_consistent_time
         self._timed: Optional[bool] = None
 
     def process_batch(self, batch, input_index=0):
-        # inputs must agree on event time: a mix would feed untimed rows
-        # into downstream windows, failing deep in a kernel instead of
-        # here with the actual cause
-        timed = batch.has_timestamps
-        if self._timed is None:
-            self._timed = timed
-        elif timed != self._timed:
-            raise RuntimeError(
-                "union inputs disagree on event time: some carry "
-                "timestamps and some do not — assign timestamps on every "
-                "branch (or none)")
+        if self._require_consistent_time:
+            timed = batch.has_timestamps
+            if self._timed is None:
+                self._timed = timed
+            elif timed != self._timed:
+                raise RuntimeError(
+                    "union inputs disagree on event time: some carry "
+                    "timestamps and some do not — assign timestamps on "
+                    "every branch (or none)")
         return [batch]
 
 

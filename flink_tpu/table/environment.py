@@ -305,6 +305,26 @@ class StreamTableEnvironment:
 
     # ----------------------------------------------------------------- SQL
 
+    def explain_sql(self, sql: str) -> str:
+        """The optimized logical + chained physical plan of a query
+        (reference: TableEnvironment.explainSql)."""
+        stmt = sql_parser.parse(sql)
+        if isinstance(stmt, sql_parser.Explain):
+            stmt = stmt.query
+        if not isinstance(stmt, (sql_parser.SelectStmt,
+                                 sql_parser.UnionAll)):
+            raise PlanError(
+                "EXPLAIN supports queries (SELECT / UNION ALL), not "
+                f"{type(stmt).__name__}")
+        return self.explain_sql_statement(sql_parser.Explain(stmt))
+
+    def explain_sql_statement(self, stmt: "sql_parser.Explain") -> str:
+        from flink_tpu.table.explain import explain
+
+        optimized = optimize(stmt.query)
+        planned = Planner(self).plan_select(optimized)
+        return explain(self, optimized, planned)
+
     def sql_query(self, sql: str) -> Table:
         stmt = sql_parser.parse(sql)
         if not isinstance(stmt, (sql_parser.SelectStmt,
@@ -319,6 +339,8 @@ class StreamTableEnvironment:
         VIEW / CREATE MODEL register and return None (reference:
         TableEnvironmentImpl.java:936)."""
         stmt = sql_parser.parse(sql)
+        if isinstance(stmt, sql_parser.Explain):
+            return self.explain_sql_statement(stmt)
         if isinstance(stmt, sql_parser.CreateModel):
             self.models.create_from_options(stmt.name, stmt.options)
             return None

@@ -162,14 +162,13 @@ class Planner:
                     "UNION ALL over an updating (changelog) branch is "
                     "not supported — materialize the aggregates first "
                     "(e.g. windowed aggregation) or union the raw inputs")
-        timed = {p.time_field is not None for p in planned}
-        if len(timed) > 1:
-            raise PlanError(
-                "UNION ALL branches must agree on event time: some "
-                "branches carry timestamps and some do not (a window "
-                "over the union would fail on the untimed rows)")
+        # event-time agreement cannot be decided here (projections
+        # legitimately drop the time-field marker while the timestamp
+        # column rides along) — the union operator's runtime guard
+        # (strict for SQL unions) names the cause instead
         stream = planned[0].stream.union(
-            *[p.stream for p in planned[1:]]) if len(planned) > 1 \
+            *[p.stream for p in planned[1:]],
+            _require_consistent_time=True) if len(planned) > 1 \
             else planned[0].stream
         out = PlannedTable(stream, list(cols), None, planned[0].time_field)
         return self._apply_order_limit(out, stmt)
@@ -195,6 +194,15 @@ class Planner:
                                 t.time_field, t.upsert_keys)
         if isinstance(ref, ast.NamedTable):
             t = self.t_env.lookup(ref.name)
+            if t.sort_spec is not None or t.limit is not None:
+                # a view/table carrying ORDER BY/LIMIT: those are
+                # materialization-time decorations an enclosing query
+                # would silently discard — same contract as subqueries
+                raise PlanError(
+                    f"table/view {ref.name!r} carries ORDER BY / LIMIT, "
+                    "which only applies when it materializes directly — "
+                    "query the underlying data and apply the sort/limit "
+                    "in the outermost query")
             return PlannedTable(t.stream, list(t.columns), ref.alias,
                                 t.time_field, t.upsert_keys)
         if isinstance(ref, ast.SubQuery):

@@ -115,6 +115,14 @@ class UnionAll:
 
 
 @dataclasses.dataclass
+class Explain:
+    """EXPLAIN <query> (reference: TableEnvironment.explainSql — prints
+    the optimized plan instead of executing)."""
+
+    query: Union["SelectStmt", "UnionAll"]
+
+
+@dataclasses.dataclass
 class CreateView:
     name: str
     query: SelectStmt
@@ -135,7 +143,7 @@ class InsertInto:
     query: SelectStmt
 
 
-Statement = Union[SelectStmt, UnionAll, CreateView, CreateModel, InsertInto]
+Statement = Union[SelectStmt, UnionAll, Explain, CreateView, CreateModel, InsertInto]
 
 # ---------------------------------------------------------------------------
 # Lexer
@@ -242,7 +250,11 @@ class Parser:
     # -- statements ---------------------------------------------------------
 
     def parse_statement(self) -> Statement:
-        if self.at_kw("CREATE"):
+        if self.accept_kw("EXPLAIN"):
+            if self.accept_kw("PLAN"):  # EXPLAIN PLAN FOR ... spelling
+                self.expect_kw("FOR")
+            stmt = Explain(self.parse_query())
+        elif self.at_kw("CREATE"):
             stmt = self._create_view()
         elif self.at_kw("INSERT"):
             stmt = self._insert_into()

@@ -138,6 +138,48 @@ class TestSemanticsPreserved:
         assert run(True) == run(False) and len(run(True)) > 0
 
 
+class TestExplain:
+    def test_explain_shows_optimized_and_physical(self):
+        from flink_tpu import Configuration, StreamExecutionEnvironment
+        from flink_tpu.table.environment import StreamTableEnvironment
+
+        t_env = StreamTableEnvironment(StreamExecutionEnvironment(
+            Configuration({})))
+        rows = [{"auction": 1, "price": 2.0, "t": 0}]
+        t_env.create_temporary_view(
+            "bid", t_env.from_collection(rows, timestamp_field="t"))
+        text = t_env.execute_sql(
+            "EXPLAIN SELECT auction, COUNT(*) AS n FROM TABLE(TUMBLE("
+            "TABLE bid, DESCRIPTOR(t), INTERVAL '10' SECOND)) "
+            "WHERE price > 1 AND 1 = 1 "
+            "GROUP BY auction, window_start, window_end")
+        assert "Optimized Logical Plan" in text
+        assert "1 = 1" not in text          # folded away
+        assert "(price > 1)" in text        # kept
+        assert "Physical Plan" in text
+        assert "HASH key=auction" in text   # the keyed exchange
+        # explain_sql() works without the EXPLAIN keyword too
+        text2 = t_env.explain_sql("SELECT auction FROM bid")
+        assert "Optimized Logical Plan" in text2
+
+    def test_explain_join_pushdown_visible(self):
+        from flink_tpu import Configuration, StreamExecutionEnvironment
+        from flink_tpu.table.environment import StreamTableEnvironment
+
+        t_env = StreamTableEnvironment(StreamExecutionEnvironment(
+            Configuration({})))
+        rows = [{"k": 1, "x": 2.0, "t": 0}]
+        t_env.create_temporary_view(
+            "L", t_env.from_collection(rows, timestamp_field="t"))
+        t_env.create_temporary_view(
+            "R", t_env.from_collection(rows, timestamp_field="t"))
+        text = t_env.execute_sql(
+            "EXPLAIN SELECT L.x FROM L JOIN R ON L.x = R.x "
+            "WHERE L.x > 5")
+        # the one-sided predicate sank into the left branch's subquery
+        assert "JOIN" in text and "WHERE (L.x > 5)" in text
+
+
 class TestUnionAll:
     def _env(self):
         from flink_tpu import Configuration, StreamExecutionEnvironment
