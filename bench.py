@@ -43,7 +43,7 @@ print(devs[0].platform)
 """
 
 
-def probe_backend(timeouts=(90, 150, 240)) -> tuple:
+def probe_backend(timeouts=(45, 90, 180)) -> tuple:
     """Probe the default (TPU) backend in a subprocess with a hard timeout.
 
     Returns (ok, platform_or_error). A hanging or crashing init cannot take

@@ -326,6 +326,15 @@ class WindowAggOperator(Operator):
             now = int(_time.time() * 1000)
             batch = batch.with_timestamps(
                 np.full(len(batch), now, dtype=np.int64))
+        elif not batch.has_timestamps:
+            # validate where timestamps are REQUIRED (covers every
+            # untimed source: raw collections, mixed unions, ...) — the
+            # alternative is a bare KeyError inside the windower
+            raise RuntimeError(
+                f"event-time window {self.name!r} received records "
+                "without timestamps — assign a WatermarkStrategy / "
+                "timestamp_field on every input (or use a "
+                "processing-time window)")
         self.windower.process_batch(batch)
         if self._async_fires:
             table = getattr(self.windower, "table", None)

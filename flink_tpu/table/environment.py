@@ -334,13 +334,26 @@ class StreamTableEnvironment:
         return Table._from_planned(self, planned)
 
     def execute_sql(self, sql: str):
-        """Execute a statement. SELECT returns a TableResult; INSERT INTO
-        runs the job eagerly and returns its JobExecutionResult; CREATE
-        VIEW / CREATE MODEL register and return None (reference:
-        TableEnvironmentImpl.java:936)."""
+        """Execute a statement (reference: TableEnvironmentImpl.java:936).
+        Return value by statement kind: SELECT / UNION ALL -> TableResult;
+        INSERT INTO -> the job's JobExecutionResult (runs eagerly);
+        EXPLAIN -> the plan text (str); SHOW TABLES -> sorted name list;
+        DESCRIBE -> schema dict; CREATE VIEW / CREATE MODEL -> None."""
         stmt = sql_parser.parse(sql)
         if isinstance(stmt, sql_parser.Explain):
             return self.explain_sql_statement(stmt)
+        if isinstance(stmt, sql_parser.ShowTables):
+            return sorted(self._catalog)
+        if isinstance(stmt, sql_parser.Describe):
+            t = self.lookup(stmt.name)
+            return {
+                "name": stmt.name,
+                "columns": list(t.columns),
+                "time_field": t.time_field,
+                "changelog": t.upsert_keys is not None,
+                **({"upsert_keys": t.upsert_keys}
+                   if t.upsert_keys else {}),
+            }
         if isinstance(stmt, sql_parser.CreateModel):
             self.models.create_from_options(stmt.name, stmt.options)
             return None

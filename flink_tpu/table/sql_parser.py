@@ -123,6 +123,18 @@ class Explain:
 
 
 @dataclasses.dataclass
+class ShowTables:
+    """SHOW TABLES (reference: TableEnvironment.listTables via SQL)."""
+
+
+@dataclasses.dataclass
+class Describe:
+    """DESCRIBE <table> (reference: TableEnvironment SQL DESCRIBE)."""
+
+    name: str
+
+
+@dataclasses.dataclass
 class CreateView:
     name: str
     query: SelectStmt
@@ -143,7 +155,7 @@ class InsertInto:
     query: SelectStmt
 
 
-Statement = Union[SelectStmt, UnionAll, Explain, CreateView, CreateModel, InsertInto]
+Statement = Union[SelectStmt, UnionAll, Explain, ShowTables, Describe, CreateView, CreateModel, InsertInto]
 
 # ---------------------------------------------------------------------------
 # Lexer
@@ -254,6 +266,15 @@ class Parser:
             if self.accept_kw("PLAN"):  # EXPLAIN PLAN FOR ... spelling
                 self.expect_kw("FOR")
             stmt = Explain(self.parse_query())
+        elif self.accept_kw("SHOW"):
+            self.expect_kw("TABLES")
+            stmt = ShowTables()
+        elif self.accept_kw("DESCRIBE") or self.accept_kw("DESC"):
+            t = self.peek()
+            if t.kind != "ident":
+                raise SqlParseError(
+                    f"DESCRIBE expects a table name, got {t.value!r}")
+            stmt = Describe(self.next().value)
         elif self.at_kw("CREATE"):
             stmt = self._create_view()
         elif self.at_kw("INSERT"):

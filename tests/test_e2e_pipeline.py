@@ -142,3 +142,18 @@ class TestWatermarkSemantics:
         )
         got = {r["window_start"]: r["sum_v"] for r in result.to_rows()}
         assert got == {0: 2.0, 100: 1.0}
+
+
+class TestUntimedInputGuard:
+    def test_event_time_window_over_untimed_input_names_the_cause(self):
+        """An untimed source (e.g. a mixed union branch) reaching an
+        event-time window must fail with the cause, not a KeyError deep
+        in the windower."""
+        env = make_env()
+        timed = env.from_collection(
+            [{"key": 1, "v": 1.0, "t": 0}], timestamp_field="t")
+        untimed = env.from_collection([{"key": 2, "v": 2.0, "t": 5}])
+        with pytest.raises(RuntimeError, match="without timestamps"):
+            (timed.union(untimed).key_by("key")
+             .window(TumblingEventTimeWindows.of(1000)).sum("v")
+             .execute_and_collect())

@@ -180,6 +180,30 @@ class TestExplain:
         assert "JOIN" in text and "WHERE (L.x > 5)" in text
 
 
+class TestCatalogStatements:
+    def test_show_tables_and_describe(self):
+        from flink_tpu import Configuration, StreamExecutionEnvironment
+        from flink_tpu.table.environment import StreamTableEnvironment
+
+        t_env = StreamTableEnvironment(StreamExecutionEnvironment(
+            Configuration({})))
+        rows = [{"a": 1, "p": 2.0, "t": 0}]
+        t_env.create_temporary_view(
+            "bids", t_env.from_collection(rows, timestamp_field="t"))
+        t_env.create_temporary_view(
+            "asks", t_env.from_collection(rows, timestamp_field="t"))
+        assert t_env.execute_sql("SHOW TABLES") == ["asks", "bids"]
+        d = t_env.execute_sql("DESCRIBE bids")
+        assert d["columns"] == ["a", "p", "t"]
+        assert d["time_field"] == "t" and d["changelog"] is False
+        # DESC shorthand; unknown table fails with the known list
+        assert t_env.execute_sql("DESC asks")["name"] == "asks"
+        from flink_tpu.table.planner import PlanError
+
+        with pytest.raises(PlanError, match="not registered"):
+            t_env.execute_sql("DESCRIBE nope")
+
+
 class TestUnionAll:
     def _env(self):
         from flink_tpu import Configuration, StreamExecutionEnvironment
