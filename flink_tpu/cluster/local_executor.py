@@ -616,6 +616,10 @@ class LocalExecutor:
                 "max": fire_latencies[-1],
                 "count": len(fire_latencies),
             }
+        if getattr(self, "fallback_reason", None):
+            # surfaced in REST job status: the user asked for stage
+            # parallelism but opted into single-slot fallback
+            metrics["stage_fallback"] = self.fallback_reason
         result = JobExecutionResult(job_name, metrics)
         result.registry = registry
         result.traces = traces

@@ -180,6 +180,7 @@ class TaskExecutorEndpoint(RpcEndpoint):
 
         from flink_tpu.cluster.local_executor import SavepointRequest
 
+        self._touch_master()
         rec = self._tasks.get(execution_id)
         if rec is None or rec["status"] != RUNNING:
             raise RuntimeError(
@@ -197,6 +198,7 @@ class TaskExecutorEndpoint(RpcEndpoint):
         next batch boundary."""
         from flink_tpu.cluster.local_executor import StateQueryRequest
 
+        self._touch_master()
         rec = self._tasks.get(execution_id)
         if rec is None or rec["status"] != RUNNING:
             raise RuntimeError(
@@ -206,6 +208,7 @@ class TaskExecutorEndpoint(RpcEndpoint):
         return req.wait(timeout_s)
 
     def savepoint_status(self, execution_id: str, request_id: str) -> dict:
+        self._touch_master()
         rec = self._tasks.get(execution_id)
         req = (rec or {}).get("savepoints", {}).get(request_id)
         if req is None:
@@ -224,13 +227,17 @@ class TaskExecutorEndpoint(RpcEndpoint):
         rec = self._tasks.get(execution_id)
         return None if rec is None else rec["result"]
 
+    def running_count(self) -> int:
+        """Slots currently occupied by running tasks (the registration
+        slot report; also the heartbeat payload's `slots_free` input)."""
+        return sum(1 for r in self._tasks.values()
+                   if r["status"] == RUNNING)
+
     def heartbeat(self) -> dict:
         """reference: TaskExecutor heartbeat payload (slot report)."""
         self._last_master_contact = time.monotonic()
-        running = sum(1 for r in self._tasks.values()
-                      if r["status"] == RUNNING)
         return {"id": self.endpoint_id, "slots_total": self.num_slots,
-                "slots_free": self.num_slots - running,
+                "slots_free": self.num_slots - self.running_count(),
                 "ts": time.monotonic()}
 
 
@@ -258,7 +265,8 @@ class ResourceManagerEndpoint(RpcEndpoint):
         self.on_register = None
 
     def register_task_executor(self, executor_id: str, address: str,
-                               num_slots: int) -> None:
+                               num_slots: int,
+                               running_tasks: int = 0) -> None:
         fresh = executor_id not in self._executors
         prev = self._executors.get(executor_id, {})
         # a keepalive RE-registration must NOT refresh liveness: a worker
@@ -269,9 +277,19 @@ class ResourceManagerEndpoint(RpcEndpoint):
         # staleness so it cannot flap back in; a ping answer clears it.
         hb = prev.get("last_heartbeat",
                       self._evicted.get(executor_id, time.monotonic()))
+        # After a JobManager restart the registry is empty, but a surviving
+        # worker's tasks are still occupying slots. Seed a SEPARATE
+        # `seeded` estimate from the worker's slot report on FRESH
+        # registrations only (reference: TaskExecutor registration carries
+        # a SlotReport) — it must not touch `allocated`, which is the
+        # JobMaster-driven promise count, or a stale keepalive racing a
+        # release would leak slots. `seeded` decays via heartbeat
+        # reconciliation (heartbeat_from) as orphaned tasks finish.
         self._executors[executor_id] = {
             "address": address, "slots": num_slots,
             "allocated": prev.get("allocated", 0),
+            "seeded": prev.get("seeded", running_tasks),
+            "last_alloc": prev.get("last_alloc", 0.0),
             "last_heartbeat": hb,
         }
         if fresh and self.on_register is not None:
@@ -283,15 +301,33 @@ class ResourceManagerEndpoint(RpcEndpoint):
         now = time.monotonic()
         return {
             eid: {"address": info["address"], "slots": info["slots"],
-                  "allocated": info["allocated"],
+                  "allocated": info["allocated"] + info.get("seeded", 0),
                   "heartbeat_age_s": now - info["last_heartbeat"]}
             for eid, info in self._executors.items()
         }
 
-    def heartbeat_from(self, executor_id: str) -> None:
+    #: seconds after a request_slot during which seeded-slot reconciliation
+    #: is suspended: a freshly promised slot is not RUNNING yet, so a
+    #: heartbeat in that window under-reports and would wrongly drain the
+    #: orphan seed (over-committing the worker)
+    SEED_RECONCILE_GRACE_S = 10.0
+
+    def heartbeat_from(self, executor_id: str,
+                       running_tasks: Optional[int] = None) -> None:
         info = self._executors.get(executor_id)
         if info is not None:
             info["last_heartbeat"] = time.monotonic()
+            if (running_tasks is not None and info.get("seeded", 0)
+                    and time.monotonic() - info.get("last_alloc", 0.0)
+                    > self.SEED_RECONCILE_GRACE_S):
+                # reconcile the restart-seeded estimate against the live
+                # slot report: whatever the report covers beyond the
+                # JM-promised slots is the surviving orphan count — it can
+                # only shrink (orphans finishing/cancelled), so the seed
+                # drains to 0 and cannot leak capacity
+                info["seeded"] = min(
+                    info["seeded"],
+                    max(0, running_tasks - info["allocated"]))
         self._evicted.pop(executor_id, None)  # reachable again
 
     def mark_dead(self, executor_id: str) -> None:
@@ -308,8 +344,9 @@ class ResourceManagerEndpoint(RpcEndpoint):
         for eid, info in self._executors.items():
             if eid in self._blocklist or eid in exclude:
                 continue
-            if info["allocated"] < info["slots"]:
+            if info["allocated"] + info.get("seeded", 0) < info["slots"]:
                 info["allocated"] += 1
+                info["last_alloc"] = time.monotonic()
                 return {"executor_id": eid, "address": info["address"]}
         return None
 
@@ -1055,9 +1092,14 @@ class MiniCluster:
         def ping(eid: str, address: str) -> bool:
             gw = self.service.connect(address, eid,
                                       call_timeout=ping_deadline)
-            gw.heartbeat()
+            report = gw.heartbeat()
             self._heartbeats[eid] = time.monotonic()
-            rm.heartbeat_from(eid)
+            # forward the slot report so the RM reconciles its
+            # restart-seeded occupancy estimate against live truth
+            running = (report["slots_total"] - report["slots_free"]
+                       if isinstance(report, dict)
+                       and "slots_free" in report else None)
+            rm.heartbeat_from(eid, running_tasks=running)
             return True
 
         try:

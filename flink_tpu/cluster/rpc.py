@@ -18,6 +18,7 @@ is exactly the shape of the reference's RockRpcInvocation messages.
 
 from __future__ import annotations
 
+import atexit
 import queue
 import threading
 import traceback
@@ -141,6 +142,8 @@ class _GatewayProxy:
 _client_channels: Dict[str, grpc.Channel] = {}
 _client_lock = threading.Lock()
 
+atexit.register(lambda: RpcService.client_close())
+
 _CHANNEL_OPTIONS = [
     ("grpc.max_receive_message_length", 512 * 1024 * 1024),
     ("grpc.max_send_message_length", 512 * 1024 * 1024),
@@ -260,9 +263,27 @@ class RpcService:
                        call_timeout: float = 120) -> _GatewayProxy:
         """Client-only gateway: a channel to a remote endpoint without
         hosting a server (drivers submitting to a standalone cluster need
-        no inbound RPC). Channels are cached process-wide."""
+        no inbound RPC). Channels are cached process-wide; see
+        :func:`client_close` for eviction."""
         ch = _cached_channel(address, _client_channels, _client_lock)
         return _make_gateway(ch, endpoint_id, fencing_token, call_timeout)
+
+    @classmethod
+    def client_close(cls, address: Optional[str] = None) -> None:
+        """Close and evict cached client channels (one address, or all when
+        ``address`` is None) — long-lived drivers rotating across many
+        JobManagers would otherwise hold one channel per address for the
+        process lifetime. Also runs at interpreter exit."""
+        with _client_lock:
+            targets = ([address] if address is not None
+                       else list(_client_channels))
+            for addr in targets:
+                ch = _client_channels.pop(addr, None)
+                if ch is not None:
+                    try:
+                        ch.close()
+                    except Exception:  # noqa: BLE001 - best-effort cleanup
+                        pass
 
     def stop(self) -> None:
         for ep in list(self._endpoints.values()):

@@ -1178,11 +1178,20 @@ def make_executor(config: Configuration, graph: StreamGraph):
         try:
             plan_stages(graph)
         except StagePlanError as e:
+            if not config.get(DeploymentOptions.STAGE_FALLBACK):
+                raise StagePlanError(
+                    f"execution.stage-parallelism={sp} requested but {e}. "
+                    "Set execution.stage-fallback=true to run single-slot "
+                    "instead.") from e
             import warnings
 
             warnings.warn(
                 f"execution.stage-parallelism set but {e}; running "
-                "single-slot", stacklevel=2)
+                "single-slot (execution.stage-fallback=true)",
+                stacklevel=2)
+            ex = LocalExecutor(config)
+            ex.fallback_reason = str(e)
+            return ex
         else:
             return StageParallelExecutor(config)
     return LocalExecutor(config)

@@ -63,8 +63,13 @@ class TaskExecutorRunner:
 
     def register_once(self) -> None:
         rm = self.service.connect(self.jm_address, "resourcemanager")
+        # running_count iterates the endpoint's task dict — read it on the
+        # endpoint main thread (keepalive runs on its own thread; a
+        # concurrent submit_task would otherwise mutate mid-iteration)
+        running = self.endpoint.run_in_main_thread(
+            self.endpoint.running_count).result()
         rm.register_task_executor(self.executor_id, self.service.address,
-                                  self.num_slots)
+                                  self.num_slots, running_tasks=running)
 
     def start(self) -> "TaskExecutorRunner":
         self.register_once()

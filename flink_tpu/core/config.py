@@ -229,6 +229,12 @@ class DeploymentOptions:
         "service with key-group routing and aligned checkpoint barriers "
         "(reference: ExecutionJobVertex parallel expansion + "
         "KeyGroupStreamPartitioner).")
+    STAGE_FALLBACK = ConfigOption(
+        "execution.stage-fallback", default=False, type=bool,
+        description="When execution.stage-parallelism is set but the "
+        "graph shape is not stage-expandable, fall back to single-slot "
+        "execution instead of failing the submission. Off by default: a "
+        "user who asked for parallelism N should not silently get 1.")
     SOURCE_PARALLELISM = ConfigOption(
         "execution.source-parallelism", default=1, type=int,
         description="Subtask count for the source stage in multi-slot "

@@ -178,6 +178,11 @@ class WindowAggOperator(Operator):
             getattr(assigner, "is_processing_time", False))
         self.windower: Optional[SliceSharedWindower] = None
         self._key_values: Dict[int, Any] = {}  # key_id -> original key value
+        #: sorted-array mirror of _key_values for vectorized lookups on the
+        #: fire path (np.searchsorted instead of a per-key Python loop);
+        #: rebuilt lazily whenever the dict has grown
+        self._kv_ids: np.ndarray = np.empty(0, np.int64)
+        self._kv_vals: np.ndarray = np.empty(0, object)
         self._keys_hashed = False
         #: wall-clock ms from watermark advance to fired results on host
         #: (the p99 window-fire latency metric; reference measures this at
@@ -310,7 +315,10 @@ class WindowAggOperator(Operator):
         if self.key_field in batch.columns:
             keys = batch[self.key_field]
             if keys.dtype.kind not in "iu":
-                # remember original key values for emission
+                # remember original key values for emission (dict check is
+                # O(uniques) and does NOT touch the sorted fire-path
+                # mirror — rebuilding that here would cost O(K log K) per
+                # batch while the key space is still growing)
                 self._keys_hashed = True
                 kid = batch.key_ids
                 uniq, first = np.unique(kid, return_index=True)
@@ -409,11 +417,35 @@ class WindowAggOperator(Operator):
         fired = self.windower.on_watermark(now_ms - 1)
         return [self._reattach_keys(b) for b in fired]
 
+    def _kv_sync(self) -> None:
+        """Rebuild the sorted lookup arrays iff _key_values grew (restore,
+        new keys). O(K log K) per rebuild, amortized to nothing once the
+        key set stabilizes."""
+        if len(self._kv_ids) != len(self._key_values):
+            ids = np.fromiter(self._key_values.keys(), np.int64,
+                              len(self._key_values))
+            order = np.argsort(ids, kind="stable")
+            self._kv_ids = ids[order]
+            vals = np.empty(len(ids), object)
+            vals[:] = list(self._key_values.values())
+            self._kv_vals = vals[order]
+
     def _reattach_keys(self, batch: RecordBatch) -> RecordBatch:
         kid = batch.key_ids
         if self._keys_hashed:
-            vals = np.array([self._key_values.get(int(i), None)
-                             for i in kid], dtype=object)
+            # vectorized id -> value: searchsorted on the sorted mirror (no
+            # per-key Python loop on the hot fire path)
+            self._kv_sync()
+            kidv = np.ascontiguousarray(kid, dtype=np.int64)
+            if len(self._kv_ids):
+                pos = np.minimum(np.searchsorted(self._kv_ids, kidv),
+                                 len(self._kv_ids) - 1)
+                vals = self._kv_vals[pos]
+                miss = self._kv_ids[pos] != kidv
+                if miss.any():
+                    vals[miss] = None
+            else:
+                vals = np.full(len(kidv), None, object)
         else:
             vals = kid
         return batch.with_column(self.key_field, vals)
@@ -494,6 +526,8 @@ class WindowAggOperator(Operator):
             self.windower.restore(state["windower"])
         # empty sub-dicts are pruned by the checkpoint codec
         self._key_values = dict(state.get("key_values", {}))
+        self._kv_ids = np.empty(0, np.int64)  # lookup mirror: force rebuild
+        self._kv_vals = np.empty(0, object)
         self._keys_hashed = state.get("keys_hashed", False)
 
 

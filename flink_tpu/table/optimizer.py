@@ -67,7 +67,16 @@ _FOLD_BIN = {
 
 
 def _is_lit(e: Expr, value=None) -> bool:
-    return isinstance(e, Literal) and (value is None or e.value == value)
+    if not isinstance(e, Literal):
+        return False
+    if value is None:
+        return True
+    # Boolean identities must only match genuine booleans: Python's 1 == True
+    # would otherwise fold integer-in-boolean-context SQL (WHERE 1 AND p)
+    # that the unfolded path evaluates differently (or rejects).
+    if isinstance(value, bool) and not isinstance(e.value, bool):
+        return False
+    return e.value == value
 
 
 def fold_constants(expr: Expr) -> Expr:
@@ -163,7 +172,9 @@ def and_all(conjuncts: List[Expr]) -> Optional[Expr]:
 def _ref_aliases(ref: ast.TableRef) -> List[str]:
     """The alias names under which this table ref's columns are qualified."""
     if isinstance(ref, ast.NamedTable):
-        return [ref.alias or ref.name, ref.name]
+        # Standard SQL scoping: an alias hides the base table name, so a
+        # self-join (FROM t a JOIN t b) must not match `t.x` to either side.
+        return [ref.alias] if ref.alias else [ref.name]
     if isinstance(ref, (ast.SubQuery, ast.MLPredictTVF)):
         out = [ref.alias] if ref.alias else []
         if isinstance(ref, ast.MLPredictTVF):

@@ -3,7 +3,8 @@
 reference: the Calcite optimize + translate pipeline
 (flink-table-planner/.../delegation/PlannerBase.scala:175 translate,
 :412 translateToExecNodeGraph; window agg at
-StreamExecWindowAggregate.java:164). Here there is no relational optimizer:
+StreamExecWindowAggregate.java:164). The AST first passes through
+flink_tpu.table.optimizer (constant folding, filter/join pushdown), then
 the supported SQL shapes map 1:1 onto the vectorized operators —
 * window TVF + GROUP BY  -> WindowAggOperator (slice-shared device agg)
 * plain GROUP BY         -> GroupAggOperator (upsert stream)

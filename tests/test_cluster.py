@@ -271,3 +271,66 @@ class TestMiniCluster:
         assert "metrics" in metrics
         execs = get("/taskexecutors")["executors"]
         assert execs
+
+
+# ------------------------------------- restart-seeded slot accounting
+
+
+class TestSeededSlotAccounting:
+    """A surviving worker's occupied slots after a JobManager restart
+    (reference: TaskExecutor registration carries a SlotReport the RM
+    seeds its accounting from)."""
+
+    def _rm(self):
+        from flink_tpu.cluster.minicluster import ResourceManagerEndpoint
+
+        return ResourceManagerEndpoint()
+
+    def test_fresh_registration_seeds_occupied_slots(self):
+        rm = self._rm()
+        # JM restart: empty registry; worker reports 1 orphan on 2 slots
+        rm.register_task_executor("te-1", "addr:1", 2, running_tasks=1)
+        assert rm.executor_registry()["te-1"]["allocated"] == 1
+        # only the one genuinely free slot is offered
+        assert rm.request_slot() is not None
+        assert rm.request_slot() is None
+
+    def test_keepalive_reregistration_does_not_reseed(self):
+        rm = self._rm()
+        rm.register_task_executor("te-1", "addr:1", 2, running_tasks=1)
+        assert rm.request_slot() is not None
+        # keepalive now reports 2 running (orphan + the new task); the
+        # re-registration must keep allocated=1 + seeded=1, not add more
+        rm.register_task_executor("te-1", "addr:1", 2, running_tasks=2)
+        assert rm.executor_registry()["te-1"]["allocated"] == 2
+        assert rm.request_slot() is None
+
+    def test_seed_drains_as_orphans_finish(self):
+        rm = self._rm()
+        rm.register_task_executor("te-1", "addr:1", 2, running_tasks=1)
+        assert rm.request_slot() is not None  # allocated=1, seeded=1
+        # within the grace window after an allocation the report may not
+        # include the promised task yet — reconciliation must not drain
+        rm.heartbeat_from("te-1", running_tasks=1)
+        assert rm.executor_registry()["te-1"]["allocated"] == 2
+        # past the grace window: report says 1 running and 1 is promised,
+        # so the orphan is gone -> seed drains
+        rm._executors["te-1"]["last_alloc"] = 0.0
+        rm.heartbeat_from("te-1", running_tasks=1)
+        assert rm.executor_registry()["te-1"]["allocated"] == 1
+        rm.release_slot("te-1")
+        assert rm.executor_registry()["te-1"]["allocated"] == 0
+        # all capacity available again — no leak
+        assert rm.request_slot() is not None
+        assert rm.request_slot() is not None
+        assert rm.request_slot() is None
+
+    def test_seed_never_grows_from_heartbeat(self):
+        rm = self._rm()
+        rm.register_task_executor("te-1", "addr:1", 4, running_tasks=1)
+        rm._executors["te-1"]["last_alloc"] = 0.0
+        rm.heartbeat_from("te-1", running_tasks=0)  # orphan finished
+        assert rm.executor_registry()["te-1"]["allocated"] == 0
+        rm.heartbeat_from("te-1", running_tasks=3)  # later load says 3
+        # seeded stays 0: only registration seeds, heartbeats only drain
+        assert rm.executor_registry()["te-1"]["allocated"] == 0

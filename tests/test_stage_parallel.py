@@ -161,13 +161,27 @@ class TestStageParallelJobs:
         rows = sink.rows()
         assert rows and all(r["key"] % 2 == 0 for r in rows)
 
-    def test_unsupported_shapes_fall_back_to_single_slot(self):
+    def test_unsupported_shapes_fail_by_default(self):
+        """A user who asked for parallelism N must not silently get 1."""
+        from flink_tpu.cluster.stage_executor import StagePlanError
+
         env = _env(2)
         sink = CollectSink()
         src = DataGenSource(total_records=100, num_keys=5,
                             events_per_second_of_eventtime=100)
-        # no keyed exchange -> the stage planner can't expand; the job must
-        # still run (single-slot) with a warning
+        env.from_source(
+            src, WatermarkStrategy.for_bounded_out_of_orderness(0)) \
+            .map(lambda b: b).sink_to(sink)
+        with pytest.raises(StagePlanError, match="stage-fallback"):
+            env.execute("stateless")
+
+    def test_unsupported_shapes_fall_back_when_opted_in(self):
+        env = _env(2, extra={"execution.stage-fallback": True})
+        sink = CollectSink()
+        src = DataGenSource(total_records=100, num_keys=5,
+                            events_per_second_of_eventtime=100)
+        # no keyed exchange -> the stage planner can't expand; with the
+        # opt-in the job still runs (single-slot) with a warning
         env.from_source(
             src, WatermarkStrategy.for_bounded_out_of_orderness(0)) \
             .map(lambda b: b).sink_to(sink)
