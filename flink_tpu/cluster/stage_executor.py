@@ -77,36 +77,72 @@ class StagePlanError(ValueError):
     """The graph shape is not supported by stage-parallel execution."""
 
 
-class StagePlan:
+class StageInput:
+    """One input branch of the keyed stage: a source, its chained
+    stateless pre-operators (incl. the key_by routing marker), and the
+    key field records are hash-exchanged on."""
+
     def __init__(self, source: Transformation,
-                 pre_chain: List[Transformation],
-                 keyed_chain: List[Transformation],
-                 key_field: str):
+                 pre_chain: List[Transformation], key_field: str):
         self.source = source
-        #: stateless operators chained into the source stage (upstream of
-        #: the keyed exchange)
         self.pre_chain = pre_chain
-        #: keyed operator + everything downstream incl. the sink, chained
-        #: into each keyed subtask
-        self.keyed_chain = keyed_chain
         self.key_field = key_field
 
 
+class StagePlan:
+    """Source stage(s) + one keyed stage. One input is the classic linear
+    pipeline; two inputs is the join shape (two sources hash-exchanging
+    into a two-input keyed operator — reference: DefaultExecutionGraph
+    runs any DAG; this covers the two-input keyed family)."""
+
+    def __init__(self, source: Optional[Transformation] = None,
+                 pre_chain: Optional[List[Transformation]] = None,
+                 keyed_chain: Optional[List[Transformation]] = None,
+                 key_field: Optional[str] = None,
+                 inputs: Optional[List[StageInput]] = None):
+        if inputs is None:
+            inputs = [StageInput(source, pre_chain or [], key_field)]
+        #: one StageInput per keyed-stage input, in the keyed head
+        #: operator's input order
+        self.inputs = inputs
+        #: keyed operator + everything downstream incl. the sink, chained
+        #: into each keyed subtask
+        self.keyed_chain = keyed_chain or []
+
+    # single-input views (the linear pipeline's vocabulary)
+    @property
+    def source(self) -> Transformation:
+        return self.inputs[0].source
+
+    @property
+    def pre_chain(self) -> List[Transformation]:
+        return self.inputs[0].pre_chain
+
+    @property
+    def key_field(self) -> str:
+        return self.inputs[0].key_field
+
+
 def plan_stages(graph: StreamGraph) -> StagePlan:
-    """Derive the two-stage split from the chained JobGraph
-    (flink_tpu/graph/job_graph.py — the StreamingJobGraphGenerator role):
-    the supported shape is exactly two job vertices joined by one HASH
-    exchange. Raises StagePlanError for anything else (joins, side
-    outputs, broadcast edges, multiple exchanges) — callers fall back to
-    single-slot execution."""
+    """Derive the stage split from the chained JobGraph
+    (flink_tpu/graph/job_graph.py — the StreamingJobGraphGenerator role).
+    Supported shapes: a linear source-stage -> keyed-stage pipeline, and
+    the two-input keyed shape (two sources, each key_by-routed into a
+    two-input keyed head — joins/co-process). Raises StagePlanError for
+    anything else (side outputs, broadcast edges, deeper DAGs) — callers
+    fall back to single-slot execution when configured to."""
     from flink_tpu.graph.job_graph import HASH, build_job_graph
 
-    if len(graph.sources) != 1:
-        raise StagePlanError("multi-slot mode requires exactly one source")
     jg = build_job_graph(graph, default_parallelism=1,
                          respect_parallelism=False)
     if not any(e.ship == HASH for e in jg.edges):
         raise StagePlanError("no keyed exchange — nothing to expand")
+    if len(graph.sources) == 2:
+        return _plan_two_input(graph, jg)
+    if len(graph.sources) != 1:
+        raise StagePlanError(
+            "multi-slot mode supports one source (linear pipeline) or "
+            f"two (keyed join); this graph has {len(graph.sources)}")
     if len(jg.vertices) != 2 or len(jg.edges) != 1:
         raise StagePlanError(
             "multi-slot mode supports a linear source-stage -> "
@@ -123,6 +159,63 @@ def plan_stages(graph: StreamGraph) -> StagePlan:
         raise StagePlanError("pipeline must end in a sink")
     return StagePlan(src_v.head, src_v.chained[1:], keyed_v.chained,
                      edge.key_field)
+
+
+def _plan_two_input(graph: StreamGraph, jg) -> StagePlan:
+    """The join shape: src -> key_by(k_l) \\
+                                            two-input keyed op -> sink
+                       src -> key_by(k_r) /
+    Each input's key_by marker (and any stateless ops chained around it)
+    runs source-side; the hash exchange routes on that input's key field;
+    the two-input operator + downstream run in the keyed subtasks."""
+    from flink_tpu.runtime.operators import KeyByOperator
+
+    two_in = [v for v in jg.vertices if v.head.kind == "two_input"]
+    if len(two_in) != 1:
+        raise StagePlanError(
+            "two-source stage mode requires exactly one two-input keyed "
+            f"operator; found {len(two_in)}")
+    kv = two_in[0]
+    if kv.tail.kind != "sink":
+        raise StagePlanError("pipeline must end in a sink")
+    head = kv.head
+    if not head.keyed:
+        raise StagePlanError(
+            f"two-input operator {head.name!r} is not keyed — only keyed "
+            "two-input stages shard by key group")
+    if len(jg.vertices) != 5:
+        raise StagePlanError(
+            "two-source stage mode supports exactly src -> key_by -> "
+            f"join -> sink per branch; this job graph has "
+            f"{len(jg.vertices)} vertices: "
+            + "; ".join(f"[{v.name}]" for v in jg.vertices))
+    inputs: List[StageInput] = []
+    for in_t in head.inputs:
+        mv = jg.vertex_of(in_t)
+        if mv.vid == kv.vid or mv.tail.uid != in_t.uid:
+            raise StagePlanError(
+                f"join input {in_t.name!r} is not the tail of its own "
+                "stage vertex")
+        probe = (mv.head.operator_factory()
+                 if mv.head.operator_factory else None)
+        if not isinstance(probe, KeyByOperator) or \
+                mv.head.key_field is None:
+            raise StagePlanError(
+                "each join input must be keyed (key_by -> join); input "
+                f"vertex [{mv.name}] does not start at a key_by marker")
+        feeders = [e for e in jg.edges if e.target_vid == mv.vid]
+        if len(feeders) != 1:
+            raise StagePlanError(
+                f"join input vertex [{mv.name}] must have exactly one "
+                "producer")
+        sv = jg.vertices[feeders[0].source_vid]
+        if not sv.is_source:
+            raise StagePlanError(
+                f"join input [{mv.name}] must begin at a source")
+        inputs.append(StageInput(sv.head,
+                                 sv.chained[1:] + mv.chained,
+                                 mv.head.key_field))
+    return StagePlan(inputs=inputs, keyed_chain=kv.chained)
 
 
 # ---------------------------------------------------------------------------
@@ -176,6 +269,20 @@ def _merge_values(key: str, values: List[Any]):
         return merged
     if key == "changelog":
         return _merge_changelog(values)
+    if key in ("left", "right"):
+        # interval-join side buffers: lists of column dicts, key-group
+        # disjoint across subtasks — union by concatenating the lists
+        return [c for v in values for c in v]
+    if key == "buf":
+        # window-join per-slice side buffers: {slice_end: ([left column
+        # dicts], [right column dicts])} — union per slice end
+        out: Dict[int, Tuple[List, List]] = {}
+        for v in values:
+            for se, (l, r) in v.items():
+                cur = out.setdefault(se, ([], []))
+                cur[0].extend(l)
+                cur[1].extend(r)
+        return out
     if isinstance(values[0], np.ndarray):
         return np.concatenate([np.asarray(v) for v in values])
     if isinstance(values[0], dict):
@@ -264,14 +371,20 @@ class _OperatorChain:
                 op.open(ctx)
             self.operators.append(op)
 
-    def process_batch(self, batch: RecordBatch) -> List[RecordBatch]:
+    def process_batch(self, batch: RecordBatch,
+                      input_index: int = 0) -> List[RecordBatch]:
         outs = [batch]
+        head = True
         for op in self.operators:
             if op is None:
                 continue
             nxt: List[RecordBatch] = []
             for b in outs:
-                nxt.extend(op.process_batch(b))
+                # only the chain HEAD can be multi-input (a two-input
+                # keyed op); everything downstream consumes its single
+                # output stream
+                nxt.extend(op.process_batch(b, input_index if head else 0))
+            head = False
             outs = nxt
             if not outs:
                 break
@@ -414,14 +527,17 @@ class _SourceSubtask(threading.Thread):
     optionally collapsing each batch to per-(key, slice) partial
     aggregates first (two-phase agg; flink_tpu/runtime/local_agg.py)."""
 
-    def __init__(self, index: int, parallelism: int, plan: StagePlan,
+    def __init__(self, index: int, parallelism: int, spec: StageInput,
                  graph: StreamGraph, writer, num_keyed: int,
                  max_parallelism: int, batch_size: int,
                  coordinator: "_Coordinator", source,
                  restore_position=None, batch_mode: bool = False,
-                 combiner=None):
+                 combiner=None, input_index: int = 0):
         self.combiner = combiner
-        super().__init__(name=f"source-subtask-{index}", daemon=True)
+        self.spec = spec
+        self.input_index = input_index
+        super().__init__(
+            name=f"source-subtask-in{input_index}-{index}", daemon=True)
         #: bounded/batch execution: no intermediate watermarks, and
         #: sub-batches coalesce into bulk blocks per subpartition before
         #: emission (the SortMergeResultPartition role — batch shuffle
@@ -431,7 +547,6 @@ class _SourceSubtask(threading.Thread):
         self._pending_rows: Dict[int, int] = {}
         self.index = index
         self.parallelism = parallelism
-        self.plan = plan
         self.graph = graph
         self.writer = writer
         self.num_keyed = num_keyed
@@ -442,7 +557,7 @@ class _SourceSubtask(threading.Thread):
         self.restore_position = restore_position
         self.control: _q.Queue = _q.Queue()
         self.error: Optional[BaseException] = None
-        self.wm_gen = plan.source.watermark_strategy.create()
+        self.wm_gen = spec.source.watermark_strategy.create()
         self.chain: Optional[_OperatorChain] = None
         self.records_out = 0
         self.records_polled = 0
@@ -466,15 +581,15 @@ class _SourceSubtask(threading.Thread):
             self.coordinator.subtask_failed(self, e)
 
     def _run(self) -> None:
-        plan = self.plan
+        spec = self.spec
         ctx = OperatorContext(operator_index=self.index,
                               parallelism=1,
                               max_parallelism=self.max_parallelism)
-        self.chain = _OperatorChain(plan.pre_chain, ctx)
+        self.chain = _OperatorChain(spec.pre_chain, ctx)
         self.source.open(self.index, self.parallelism)
         if self.restore_position is not None:
             self.source.restore_position(self.restore_position)
-        key_field = plan.key_field
+        key_field = spec.key_field
         stopping = False
         ticks_pt = self.chain.uses_processing_time
         try:
@@ -498,7 +613,7 @@ class _SourceSubtask(threading.Thread):
                     continue
                 self.batches_polled += 1
                 self.records_polled += len(batch)
-                batch = plan.source.watermark_strategy.assign_timestamps(
+                batch = spec.source.watermark_strategy.assign_timestamps(
                     batch)
                 wm = self.wm_gen.on_batch(batch)
                 for out in self.chain.process_batch(batch):
@@ -571,7 +686,8 @@ class _SourceSubtask(threading.Thread):
                     "operators": self.chain.snapshot(
                         self.graph, savepoint=barrier.savepoint is not None)}
             self.coordinator.ack(barrier.checkpoint_id,
-                                 ("source", self.index), snap)
+                                 ("source", self.input_index, self.index),
+                                 snap)
             # coalesced batch-mode blocks hold pre-barrier records — they
             # must reach the channels BEFORE the barrier or they would be
             # cut out of the snapshot yet covered by the position
@@ -582,11 +698,14 @@ class _SourceSubtask(threading.Thread):
 
 
 class _KeyedSubtask(threading.Thread):
-    """One keyed-stage subtask: owns a key-group range, consumes its gate
-    with per-channel watermarking and aligned barriers."""
+    """One keyed-stage subtask: owns a key-group range, consumes one gate
+    PER INPUT with per-channel watermarking and aligned barriers spanning
+    every channel of every gate (reference:
+    SingleCheckpointBarrierHandler aligns across all input channels of a
+    multi-input task)."""
 
     def __init__(self, index: int, parallelism: int, plan: StagePlan,
-                 graph: StreamGraph, gate, max_parallelism: int,
+                 graph: StreamGraph, gates, max_parallelism: int,
                  coordinator: "_Coordinator", config: Configuration,
                  shared_sinks: Optional[Dict[int, _SharedSink]] = None):
         super().__init__(name=f"keyed-subtask-{index}", daemon=True)
@@ -595,7 +714,9 @@ class _KeyedSubtask(threading.Thread):
         self.parallelism = parallelism
         self.plan = plan
         self.graph = graph
-        self.gate = gate
+        #: one gate per keyed-stage input, in head-operator input order
+        self.gates = list(gates) if isinstance(gates, (list, tuple)) \
+            else [gates]
         self.max_parallelism = max_parallelism
         self.coordinator = coordinator
         self.config = config
@@ -622,29 +743,45 @@ class _KeyedSubtask(threading.Thread):
         if self._restore_states is not None:
             self.chain.restore(self.graph, self._restore_states,
                                key_group_filter=set(self.key_groups))
-        n = self.gate.num_channels
-        chan_wm = [-(1 << 62)] * n
-        done = [False] * n
+        gates = self.gates
+        K = len(gates)
+        # flat channel addressing across gates: (gate, ch) -> slot
+        nch = [g.num_channels for g in gates]
+        total = sum(nch)
+        base = [sum(nch[:g]) for g in range(K)]
+        chan_wm = [-(1 << 62)] * total
+        done = [False] * total
         combined = -(1 << 62)
         aligning: Optional[Barrier] = None
-        barriered = [False] * n
-        buffered: List[Tuple[int, Any]] = []
+        barriered = [False] * total
+        buffered: List[Tuple[int, int, Any]] = []
         stopping = False
+        poll_at = 0
 
-        def process(item, ch: int):
+        def combined_wm() -> int:
+            return min((MAX_WATERMARK if done[c] else chan_wm[c])
+                       for c in range(total))
+
+        def process(item, gi: int, slot: int):
             nonlocal combined, stopping
             if isinstance(item, RecordBatch):
                 self.records_in += len(item)
-                for out in self.chain.process_batch(item):
+                for out in self.chain.process_batch(item, input_index=gi):
                     pass  # sink is in-chain; trailing output dropped
             elif isinstance(item, int):
-                chan_wm[ch] = max(chan_wm[ch], item)
-                new = min(
-                    (MAX_WATERMARK if done[c] else chan_wm[c])
-                    for c in range(n))
+                chan_wm[slot] = max(chan_wm[slot], item)
+                new = combined_wm()
                 if new > combined:
                     combined = new
                     self.chain.process_watermark(combined)
+
+        def aligned_snapshot_ack() -> bool:
+            """Snapshot + ack the aligning barrier; returns stop flag."""
+            snap = {"operators": self.chain.snapshot(
+                self.graph, savepoint=aligning.savepoint is not None)}
+            self.coordinator.ack(aligning.checkpoint_id,
+                                 ("keyed", self.index), snap)
+            return aligning.stop
 
         ticks_pt = self.chain.uses_processing_time
         while True:
@@ -653,43 +790,49 @@ class _KeyedSubtask(threading.Thread):
                 return
             if ticks_pt:
                 self.chain.tick_processing_time(int(time.time() * 1000))
-            entry = self.gate.poll(timeout=0.05)
+            # non-blocking sweep of every gate first — an idle/exhausted
+            # input must not throttle a live one; only when ALL gates are
+            # empty does one (rotating) gate take a short blocking poll
+            entry = None
+            gi = poll_at
+            for off in range(K):
+                g = (poll_at + off) % K
+                entry = gates[g].poll(timeout=0)
+                if entry is not None:
+                    gi = g
+                    break
+            if entry is None:
+                gi = poll_at
+                entry = gates[gi].poll(timeout=0.05)
+            poll_at = (gi + 1) % K
             if entry is None:
                 continue
             ch, item = entry
+            slot = base[gi] + ch
             if isinstance(item, Barrier):
                 if aligning is None:
                     aligning = item
-                    barriered = [False] * n
-                barriered[ch] = True
-                if all(barriered[c] or done[c] for c in range(n)):
-                    # all channels aligned: snapshot + ack, then drain the
-                    # buffered post-barrier items
-                    snap = {"operators": self.chain.snapshot(
-                        self.graph,
-                        savepoint=aligning.savepoint is not None)}
-                    self.coordinator.ack(aligning.checkpoint_id,
-                                         ("keyed", self.index), snap)
-                    if aligning.stop:
+                    barriered = [False] * total
+                barriered[slot] = True
+                if all(barriered[c] or done[c] for c in range(total)):
+                    # all channels of all gates aligned: snapshot + ack,
+                    # then drain the buffered post-barrier items
+                    if aligned_snapshot_ack():
                         stopping = True
                     aligning = None
-                    for bch, bitem in buffered:
-                        process(bitem, bch)
+                    for bgi, bslot, bitem in buffered:
+                        process(bitem, bgi, bslot)
                     buffered = []
                     if stopping:
                         self.chain.close()
                         return
                 continue
             if item is END_OF_PARTITION:
-                done[ch] = True
+                done[slot] = True
                 if aligning is not None and all(
-                        barriered[c] or done[c] for c in range(n)):
-                    snap = {"operators": self.chain.snapshot(
-                        self.graph,
-                        savepoint=aligning.savepoint is not None)}
-                    self.coordinator.ack(aligning.checkpoint_id,
-                                         ("keyed", self.index), snap)
-                    if aligning.stop:
+                        barriered[c] or done[c] for c in range(total)):
+                    stop = aligned_snapshot_ack()
+                    if stop:
                         # stop-with-savepoint completed by an EOP: stop
                         # exactly like the barrier-completion branch —
                         # post-savepoint output would duplicate on resume
@@ -697,8 +840,8 @@ class _KeyedSubtask(threading.Thread):
                         self.chain.close()
                         return
                     aligning = None
-                    for bch, bitem in buffered:
-                        process(bitem, bch)
+                    for bgi, bslot, bitem in buffered:
+                        process(bitem, bgi, bslot)
                     buffered = []
                 if all(done):
                     new = MAX_WATERMARK
@@ -707,18 +850,17 @@ class _KeyedSubtask(threading.Thread):
                     self.chain.close()
                     return
                 # a finished channel no longer constrains the watermark
-                new = min((MAX_WATERMARK if done[c] else chan_wm[c])
-                          for c in range(n))
+                new = combined_wm()
                 if new > combined:
                     combined = new
                     self.chain.process_watermark(combined)
                 continue
-            if aligning is not None and barriered[ch]:
+            if aligning is not None and barriered[slot]:
                 # aligned-barrier blocking: post-barrier data waits until
                 # alignment completes (bounded by channel credits)
-                buffered.append((ch, item))
+                buffered.append((gi, slot, item))
                 continue
-            process(item, ch)
+            process(item, gi, slot)
 
     def _serve_queries(self) -> None:
         while True:
@@ -807,6 +949,8 @@ class StageParallelExecutor:
         from flink_tpu.core.config import ExecutionModeOptions
 
         plan = plan_stages(graph)
+        specs = plan.inputs
+        K = len(specs)
         cfg = self.config
         N = cfg.get(DeploymentOptions.STAGE_PARALLELISM)
         S = cfg.get(DeploymentOptions.SOURCE_PARALLELISM)
@@ -814,10 +958,12 @@ class StageParallelExecutor:
         batch_size = cfg.get(BatchOptions.BATCH_SIZE)
         batch_mode = cfg.get(
             ExecutionModeOptions.RUNTIME_MODE) == "batch"
-        if batch_mode and not getattr(plan.source.source, "bounded", True):
-            raise RuntimeError(
-                "execution.runtime-mode=batch requires bounded sources; "
-                f"{plan.source.name!r} is unbounded")
+        for spec in specs:
+            if batch_mode and not getattr(spec.source.source, "bounded",
+                                          True):
+                raise RuntimeError(
+                    "execution.runtime-mode=batch requires bounded "
+                    f"sources; {spec.source.name!r} is unbounded")
         if N == -1:
             # adaptive batch parallelism: size the keyed stage from the
             # estimated source volume (reference: AdaptiveBatchScheduler
@@ -826,7 +972,9 @@ class StageParallelExecutor:
                 raise StagePlanError(
                     "execution.stage-parallelism=-1 (adaptive) requires "
                     "execution.runtime-mode=batch")
-            est = plan.source.source.estimate_records()
+            est = sum(
+                int(spec.source.source.estimate_records() or 0)
+                for spec in specs)
             target = cfg.get(
                 ExecutionModeOptions.TARGET_RECORDS_PER_SUBTASK)
             if target < 1:
@@ -866,21 +1014,23 @@ class StageParallelExecutor:
                                           own_checkpoint_root=ckpt_dir)
             states = read_checkpoint_chain(snap_dir)
             checkpoint_id = int(read_manifest(snap_dir)["checkpoint_id"])
-            src_id = graph.stable_id(plan.source)
+            src_ids = {graph.stable_id(spec.source): i
+                       for i, spec in enumerate(specs)}
             known_ids = {graph.stable_id(t)
-                         for t in plan.pre_chain + plan.keyed_chain
+                         for spec in specs for t in spec.pre_chain
                          if t.operator_factory is not None}
+            known_ids.update(graph.stable_id(t) for t in plan.keyed_chain
+                             if t.operator_factory is not None)
             for sid, state in states.items():
-                if sid == src_id:
+                if sid in src_ids:
                     pos = state["source"]
                     if isinstance(pos, dict) and "__subtasks__" in pos:
-                        restore_positions = {
-                            int(k): v
-                            for k, v in pos["__subtasks__"].items()}
-                        if len(restore_positions) != S:
+                        per_sub = {int(k): v
+                                   for k, v in pos["__subtasks__"].items()}
+                        if len(per_sub) != S:
                             raise RuntimeError(
                                 "snapshot has positions for "
-                                f"{len(restore_positions)} source subtasks "
+                                f"{len(per_sub)} source subtasks "
                                 f"but execution.source-parallelism is {S} "
                                 "— source splits cannot be re-assigned "
                                 "across counts (restore with the original "
@@ -890,7 +1040,8 @@ class StageParallelExecutor:
                             raise RuntimeError(
                                 "snapshot has a single source position "
                                 f"but execution.source-parallelism is {S}")
-                        restore_positions = {0: pos}
+                        per_sub = {0: pos}
+                    restore_positions[src_ids[sid]] = per_sub
                 elif sid in known_ids:
                     restore_states[sid] = state
                 else:
@@ -905,31 +1056,43 @@ class StageParallelExecutor:
                 checkpoint_id = max(
                     checkpoint_id, storage.latest_checkpoint_id() or 0)
 
-        coordinator = _Coordinator(num_acks=S + N)
+        coordinator = _Coordinator(num_acks=K * S + N)
 
-        # wire partitions: source subtask i owns partition "src-i" with N
-        # subpartitions; keyed subtask j consumes subpartition j of all
-        partition_ids = [f"{job_name}-src-{i}" for i in range(S)]
-        writers = [shuffle.create_partition(pid, N, credits)
-                   for pid in partition_ids]
-        gates = [shuffle.create_gate(partition_ids, j) for j in range(N)]
+        # wire partitions: source subtask s of input i owns one partition
+        # with N subpartitions; keyed subtask j consumes subpartition j of
+        # every partition of every input through one gate PER input
+        def pid(i: int, s: int) -> str:
+            # keep the legacy id format for the linear pipeline (external
+            # shuffle services key their buffers by these names)
+            return (f"{job_name}-src-{s}" if K == 1
+                    else f"{job_name}-in{i}-src-{s}")
+
+        writers = {(i, s): shuffle.create_partition(pid(i, s), N, credits)
+                   for i in range(K) for s in range(S)}
+        gates = [[shuffle.create_gate([pid(i, s) for s in range(S)], j)
+                  for i in range(K)]
+                 for j in range(N)]
 
         combiner_factory = None
-        if cfg.get(DeploymentOptions.LOCAL_AGG):
+        if K == 1 and cfg.get(DeploymentOptions.LOCAL_AGG):
             combiner_factory = _local_combiner_factory(plan)
 
         sources = []
         import copy as _copy
 
-        for i in range(S):
-            src = plan.source.source if S == 1 else _copy.deepcopy(
-                plan.source.source)
-            sources.append(_SourceSubtask(
-                i, S, plan, graph, writers[i], N, max_par, batch_size,
-                coordinator, src,
-                restore_position=restore_positions.get(i),
-                batch_mode=batch_mode,
-                combiner=combiner_factory() if combiner_factory else None))
+        for i, spec in enumerate(specs):
+            per_input_pos = restore_positions.get(i, {})
+            for s in range(S):
+                src = spec.source.source if S == 1 else _copy.deepcopy(
+                    spec.source.source)
+                sources.append(_SourceSubtask(
+                    s, S, spec, graph, writers[(i, s)], N, max_par,
+                    batch_size, coordinator, src,
+                    restore_position=per_input_pos.get(s),
+                    batch_mode=batch_mode,
+                    combiner=combiner_factory() if combiner_factory
+                    else None,
+                    input_index=i))
         shared_sinks: Dict[int, _SharedSink] = {}
         keyed = [_KeyedSubtask(j, N, plan, graph, gates[j], max_par,
                                coordinator, cfg, shared_sinks=shared_sinks)
@@ -1107,7 +1270,8 @@ class StageParallelExecutor:
             for s in live_sources:
                 if not s.is_alive() and s.final_position is not None:
                     coordinator.ack(
-                        checkpoint_id, ("source", s.index),
+                        checkpoint_id,
+                        ("source", s.input_index, s.index),
                         {"position": s.final_position,
                          "operators": s.chain.snapshot(graph)
                          if s.chain else {}})
@@ -1129,26 +1293,33 @@ class StageParallelExecutor:
         if coordinator.failure is not None:
             raise coordinator.failure
         acks = coordinator.collected(checkpoint_id)
-        # assemble logical snapshot
-        positions = {who[1]: snap["position"]
-                     for who, snap in acks.items() if who[0] == "source"}
+        # assemble logical snapshot: per-input source positions under each
+        # input's own source transformation id
+        positions: Dict[int, Dict[int, Any]] = {}
+        for who, sub in acks.items():
+            if who[0] == "source":
+                positions.setdefault(who[1], {})[who[2]] = sub["position"]
         # finished subtasks that were not in this trigger round still
         # contribute their end-of-split position — omitting them would
         # replay their whole split on restore
         for s in sources:
-            if s.index not in positions and s.final_position is not None:
-                positions[s.index] = s.final_position
-        # a single-subtask source stores its position unwrapped, so the
-        # snapshot is restorable by the single-slot executor too; S > 1
-        # wraps per-subtask positions (only stage-mode can restore those)
-        if len(sources) == 1:
-            source_state = {"source": positions[0]}
-        else:
-            source_state = {"source": {"__subtasks__": {
-                str(i): p for i, p in positions.items()}}}
-        snap: Dict[str, Any] = {
-            graph.stable_id(plan.source): source_state,
-        }
+            per_input = positions.setdefault(s.input_index, {})
+            if s.index not in per_input and s.final_position is not None:
+                per_input[s.index] = s.final_position
+        snap: Dict[str, Any] = {}
+        per_input_subtasks = max(
+            (len(p) for p in positions.values()), default=1)
+        for i, spec in enumerate(plan.inputs):
+            per_input = positions.get(i, {})
+            # a single-subtask source stores its position unwrapped, so
+            # the snapshot is restorable by the single-slot executor too;
+            # S > 1 wraps per-subtask positions (stage-mode restore only)
+            if per_input_subtasks == 1:
+                source_state = {"source": per_input.get(0)}
+            else:
+                source_state = {"source": {"__subtasks__": {
+                    str(s): p for s, p in per_input.items()}}}
+            snap[graph.stable_id(spec.source)] = source_state
         per_operator: Dict[str, List[Dict]] = {}
         for who, sub in acks.items():
             for sid, state in sub.get("operators", {}).items():

@@ -86,11 +86,28 @@ class CollectionSource(Source):
         self._i = pos["i"]
 
 
+def _splitmix64(idx: np.ndarray, salt: int) -> np.ndarray:
+    """Vectorized counter-based hash (splitmix64): record content derives
+    from the GLOBAL record index, so the stream is identical under any
+    batch size or source parallelism — subtasks own disjoint index ranges
+    of one well-defined stream (the reference's datagen splits the same
+    way: a partitioned sequence, not N independent generators)."""
+    with np.errstate(over="ignore"):
+        z = idx.astype(np.uint64) + np.uint64(
+            (salt * 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF)
+        z = (z + np.uint64(0x9E3779B97F4A7C15))
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return z ^ (z >> np.uint64(31))
+
+
 class DataGenSource(Source):
     """Deterministic synthetic event generator (keys, values, event time),
     the analog of the reference's datagen connector
     (docs/content/docs/connectors/datastream/datagen.md) but batch-granular
-    and seedable for benchmarks."""
+    and seedable for benchmarks. Content is a pure function of the global
+    record index (counter-based hashing), so re-reads, re-batching, and
+    parallel splits all observe the same logical stream."""
 
     def __init__(self, total_records: int, num_keys: int,
                  events_per_second_of_eventtime: int = 10000,
@@ -105,43 +122,57 @@ class DataGenSource(Source):
         self.seed = seed
         self.start_ts = start_ts
         self.skew = skew
-        self._emitted = 0
-        self._rng = np.random.default_rng(seed)
+        self._emitted = 0  # within this subtask's range
+        self._start = 0
+        self._end = self.total
 
     def estimate_records(self) -> Optional[int]:
         return self.total
 
     def open(self, subtask_index=0, parallelism=1):
-        # full position reset: a re-executed graph re-generates the same
-        # stream (restore_position runs after open on recovery)
+        # contiguous split of the global index space; position reset so a
+        # re-executed graph re-generates the same stream (restore_position
+        # runs after open on recovery)
+        per = -(-self.total // max(parallelism, 1))
+        self._start = min(subtask_index * per, self.total)
+        self._end = min(self._start + per, self.total)
         self._emitted = 0
-        self._rng = np.random.default_rng(self.seed + subtask_index)
 
-    def poll_batch(self, max_records):
-        if self._emitted >= self.total:
-            return None
-        n = min(max_records, self.total - self._emitted)
+    def _generate(self, idx: np.ndarray) -> RecordBatch:
+        u_key = _splitmix64(idx, self.seed * 2 + 1)
         if self.skew > 0.0:
-            # zipf-ish skew for hot-key benchmarks (Nexmark Q5 style)
-            raw = self._rng.zipf(1.0 + self.skew, size=n)
-            keys = (raw % self.num_keys).astype(np.int64)
+            # zipf-ish skew via inverse power transform of the uniform
+            # hash (hot-key benchmarks, Nexmark Q5 style)
+            u = (u_key >> np.uint64(11)).astype(np.float64) / (1 << 53)
+            raw = np.maximum(
+                1.0, np.power(np.maximum(u, 1e-12), -1.0 / self.skew))
+            raw = np.minimum(raw, 1e18)
+            keys = (raw.astype(np.int64) % self.num_keys)
         else:
-            keys = self._rng.integers(0, self.num_keys, size=n, dtype=np.int64)
-        values = self._rng.random(n).astype(np.float32)
-        # event time advances deterministically with the record index
-        idx = np.arange(self._emitted, self._emitted + n, dtype=np.int64)
+            keys = (u_key % np.uint64(self.num_keys)).astype(np.int64)
+        u_val = _splitmix64(idx, self.seed * 2 + 2)
+        values = ((u_val >> np.uint64(11)).astype(np.float64)
+                  / (1 << 53)).astype(np.float32)
+        # event time advances deterministically with the GLOBAL index
         ts = self.start_ts + (idx * 1000) // max(self.rate, 1)
-        self._emitted += n
         return RecordBatch.from_pydict(
             {self.key_field: keys, self.value_field: values}, timestamps=ts)
 
+    def poll_batch(self, max_records):
+        own = self._end - self._start
+        if self._emitted >= own:
+            return None
+        n = min(max_records, own - self._emitted)
+        idx = np.arange(self._start + self._emitted,
+                        self._start + self._emitted + n, dtype=np.int64)
+        self._emitted += n
+        return self._generate(idx)
+
     def snapshot_position(self):
-        return {"emitted": self._emitted,
-                "rng": self._rng.bit_generator.state}
+        return {"emitted": self._emitted}
 
     def restore_position(self, pos):
         self._emitted = pos["emitted"]
-        self._rng.bit_generator.state = pos["rng"]
 
 
 class SocketSource(Source):

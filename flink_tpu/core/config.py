@@ -281,7 +281,10 @@ class StateOptions:
         description="Device-resident slot budget per keyed state (HBM "
         "bound). 0 = unbounded (grow by doubling). When the budget is "
         "reached, cold namespaces spill to host memory and reload "
-        "transparently on access (the RocksDB/ForSt beyond-memory role).")
+        "transparently on access (the RocksDB/ForSt beyond-memory role). "
+        "At parallelism > 1 the budget applies PER DEVICE (each mesh "
+        "shard owns one device's HBM), so total capacity scales with the "
+        "mesh while each chip stays bounded.")
     WINDOW_LAYOUT = ConfigOption(
         "state.window-layout", default="auto", type=str,
         description="Keyed window state layout: 'slots' ((key, slice) "

@@ -32,7 +32,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
 from flink_tpu.ops.segment_ops import SCATTER_METHOD, sticky_bucket
 from flink_tpu.parallel.mesh import KEY_AXIS
-from flink_tpu.parallel.sharded_windower import _STEP_CACHE, build_mesh_steps
+from flink_tpu.parallel.sharded_windower import (
+    _STEP_CACHE,
+    MeshSpillSupport,
+    build_mesh_steps,
+)
 from flink_tpu.parallel.shuffle import bucket_by_shard, shard_records
 from flink_tpu.state.keygroups import assign_key_groups
 from flink_tpu.windowing.aggregates import AggregateFunction
@@ -78,7 +82,7 @@ def build_session_merge_step(mesh: Mesh, agg: AggregateFunction):
     return merge_step
 
 
-class MeshSessionEngine:
+class MeshSessionEngine(MeshSpillSupport):
     """Keyed session windows sharded over a 1-D device mesh."""
 
     def __init__(
@@ -89,12 +93,22 @@ class MeshSessionEngine:
         capacity_per_shard: int = 1 << 16,
         max_parallelism: int = 128,
         allowed_lateness: int = 0,
+        max_device_slots: int = 0,
+        spill_dir: Optional[str] = None,
+        spill_host_max_bytes: int = 0,
     ) -> None:
         self.gap = int(gap)
         self.agg = agg
         self.mesh = mesh
         self.P = int(mesh.devices.size)
+        #: per-SHARD HBM slot budget; cold sessions spill per shard and
+        #: reload on access (see MeshSpillSupport — the 10M-key session
+        #: capacity of BASELINE row 5 cannot be device-resident)
+        self.max_device_slots = int(max_device_slots or 0)
         self.capacity = max(int(capacity_per_shard), 1024)
+        if self.max_device_slots:
+            self.max_device_slots = max(self.max_device_slots, 1024)
+            self.capacity = min(self.capacity, self.max_device_slots)
         self.max_parallelism = max_parallelism
         self.allowed_lateness = int(allowed_lateness)
         if max_parallelism < self.P:
@@ -108,9 +122,15 @@ class MeshSessionEngine:
         self.indexes = [
             make_slot_index(
                 self.capacity, growable=True,
-                on_grow=lambda old, new: self._shard_index_grew(new))
+                on_grow=lambda old, new: self._shard_index_grew(new),
+                max_capacity=self.max_device_slots,
+                full_hint=("state spills to host beyond "
+                           "state.slot-table.max-device-slots"
+                           if self.max_device_slots
+                           else "raise state.slot-table.capacity"))
             for _ in range(self.P)
         ]
+        self._init_spill(spill_dir, spill_host_max_bytes)
         self._sharding = NamedSharding(mesh, P(KEY_AXIS))
         self.accs: Tuple[jnp.ndarray, ...] = tuple(
             jax.device_put(
@@ -120,7 +140,8 @@ class MeshSessionEngine:
             for leaf in agg.leaves
         )
         (self._scatter_step, self._fire_step, self._reset_step,
-         self._gather_step) = build_mesh_steps(mesh, agg)
+         self._gather_step, self._put_step,
+         self._merge_leaves_step) = build_mesh_steps(mesh, agg)
         self._merge_step = build_session_merge_step(mesh, agg)
         self.meta = SessionIntervalSet(self.gap, self.allowed_lateness)
         self._dirty = np.zeros((self.P, self.capacity), dtype=bool)
@@ -165,6 +186,17 @@ class MeshSessionEngine:
             return
         ts = np.asarray(batch.timestamps, dtype=np.int64)
         keys = np.asarray(batch.key_ids, dtype=np.int64)
+        if self._spill_active and n > 1:
+            # bound one batch's per-shard session working set by the
+            # budget: unique keys upper-bounds touched sessions; halving
+            # is safe because absorb_batch is incremental
+            budget = max(self.max_device_slots // 2, 1024)
+            if len(np.unique(keys)) > budget:
+                half = np.zeros(n, dtype=bool)
+                half[: n // 2] = True
+                self.process_batch(batch.filter(half))
+                self.process_batch(batch.filter(~half))
+                return
 
         sess_key, sess_sid, rec_to_sess, order, groups = \
             self.meta.absorb_batch(keys, ts)
@@ -182,10 +214,19 @@ class MeshSessionEngine:
         # per-shard slot resolution for the live sessions
         m = len(sess_key)
         sess_shard = shard_records(sess_key, self.P, self.max_parallelism)
+        if self._spill_active:
+            touched = {
+                p: np.unique(sess_sid[(sess_shard == p) & live_sess])
+                for p in range(self.P)
+                if ((sess_shard == p) & live_sess).any()}
+            self._ensure_resident(touched)
+            for p, sids in touched.items():
+                self._touch(p, sids.tolist())
         slot_of_sess = np.zeros(m, dtype=np.int32)
         for p in range(self.P):
             sel = (sess_shard == p) & live_sess
             if sel.any():
+                self._reserve(p, sess_key[sel], sess_sid[sel])
                 slots = self.indexes[p].lookup_or_insert(
                     sess_key[sel], sess_sid[sel])
                 slot_of_sess[sel] = slots
@@ -219,6 +260,18 @@ class MeshSessionEngine:
         ds = np.asarray(g.sids_dst, dtype=np.int64)
         ss = np.asarray(g.sids_src, dtype=np.int64)
         shards = shard_records(gk, self.P, self.max_parallelism)
+        if self._spill_active:
+            # merging sessions may be cold (spilled): both sides must be
+            # device-resident before the merge kernel moves values
+            touched = {}
+            for p in range(self.P):
+                sel = shards == p
+                if sel.any():
+                    touched[p] = np.unique(
+                        np.concatenate([ds[sel], ss[sel]]))
+            self._ensure_resident(touched)
+            for p, sids in touched.items():
+                self._touch(p, sids.tolist())
         m_max = 0
         per_shard: List[Tuple[np.ndarray, np.ndarray]] = []
         for p in range(self.P):
@@ -231,6 +284,7 @@ class MeshSessionEngine:
             # hence the shard)
             keys2 = np.concatenate([gk[sel], gk[sel]])
             sids2 = np.concatenate([ds[sel], ss[sel]])
+            self._reserve(p, keys2, sids2)
             both = self.indexes[p].lookup_or_insert(keys2, sids2)
             c = int(sel.sum())
             d_slots, s_slots = both[:c], both[c:]
@@ -253,6 +307,7 @@ class MeshSessionEngine:
         # absorbed host slots reusable now that the kernel moved the values;
         # record tombstones so delta snapshots drop the absorbed rows
         self._freed_ns.extend(int(s) for s in g.absorbed_sids)
+        self._drop_spilled(g.absorbed_sids)
         for p in range(self.P):
             self.indexes[p].free_namespaces(g.absorbed_sids)
 
@@ -262,9 +317,34 @@ class MeshSessionEngine:
         keys, starts, ends, sids = self.meta.pop_fired(watermark)
         if not keys:
             return []
+        if self._spill_active:
+            # a catch-up fire can exceed the device budget; chunking keeps
+            # each fire's working set (1 slot per session) under it —
+            # fired slots free immediately, so chunks reuse the space
+            chunk = max(self.max_device_slots // 2, 1024)
+            if len(keys) > chunk:
+                out: List[RecordBatch] = []
+                for a in range(0, len(keys), chunk):
+                    out.extend(self._fire_sessions(
+                        keys[a:a + chunk], starts[a:a + chunk],
+                        ends[a:a + chunk], sids[a:a + chunk]))
+                return out
+        return self._fire_sessions(keys, starts, ends, sids)
+
+    def _fire_sessions(self, keys, starts, ends,
+                       sids) -> List[RecordBatch]:
         k_arr = np.asarray(keys, dtype=np.int64)
         sid_arr = np.asarray(sids, dtype=np.int64)
         shards = shard_records(k_arr, self.P, self.max_parallelism)
+        if self._spill_active:
+            # cold (spilled) sessions must be resident to fire from the
+            # device table
+            touched = {p: np.unique(sid_arr[shards == p])
+                       for p in range(self.P) if (shards == p).any()}
+            self._ensure_resident(touched)
+            for p in touched:
+                sel = shards == p
+                self._reserve(p, k_arr[sel], sid_arr[sel])
         w_max = 0
         per_shard_slots: List[np.ndarray] = []
         per_shard_sel: List[np.ndarray] = []
@@ -326,13 +406,38 @@ class MeshSessionEngine:
         sids = np.asarray([iv[2] for iv in intervals], dtype=np.int64)
         keys = np.full(len(sids), int(key_id), dtype=np.int64)
         slots = self.indexes[shard].lookup(keys, sids)
+        out: Dict[int, Dict[str, float]] = {}
+        if self._spill_active and (slots < 0).any():
+            # cold sessions answer from the spill tier (read-only — a
+            # query must not thrash residency)
+            sp = self.spills[shard]
+            for i, iv in enumerate(intervals):
+                if slots[i] >= 0:
+                    continue
+                entry = sp.peek(int(sids[i]))
+                if entry is None:
+                    continue
+                pos = np.nonzero(np.asarray(
+                    entry["key_id"], dtype=np.int64) == int(key_id))[0]
+                if len(pos) == 0:
+                    continue
+                j = int(pos[0])
+                leaves = tuple(
+                    np.asarray(entry[f"leaf_{k}"], dtype=l.dtype)[j:j + 1]
+                    for k, l in enumerate(self.agg.leaves))
+                finished = self.agg.finish(leaves)
+                out[int(iv[1])] = {name: np.asarray(col).item()
+                                   for name, col in finished.items()}
         W = sticky_bucket(len(sids), self._fire_bucket, minimum=64)
         sm = np.zeros((self.P, W, 1), dtype=np.int32)
         sm[shard, : len(sids), 0] = np.where(slots >= 0, slots, 0)
         results = self._fire_step(self.accs, self._put_sharded(sm))
-        return {int(iv[1]): {name: np.asarray(col)[shard][i].item()
-                             for name, col in results.items()}
-                for i, iv in enumerate(intervals)}
+        for i, iv in enumerate(intervals):
+            if slots[i] < 0:
+                continue
+            out[int(iv[1])] = {name: np.asarray(col)[shard][i].item()
+                               for name, col in results.items()}
+        return out
 
     # -------------------------------------------------------------- snapshot
 
@@ -355,12 +460,16 @@ class MeshSessionEngine:
                 **{f"leaf_{i}": accs_host[i][p][used]
                    for i in range(len(self.accs))},
             })
+        # spilled sessions are part of the logical state
+        parts.extend(self._spill_snapshot_parts())
         merged = {
             k: np.concatenate([pt[k] for pt in parts]) for k in parts[0]
         } if parts else {}
         if mode != "savepoint":
             self._dirty[:] = False
             self._freed_ns.clear()
+            for sp in self.spills:
+                sp.clear_dirty()
         return {"table": merged, **self.meta.snapshot()}
 
     def _snapshot_delta(self) -> Dict[str, np.ndarray]:
@@ -416,6 +525,7 @@ class MeshSessionEngine:
                 **{f"leaf_{i}": np.concatenate(cols)
                    for i, cols in enumerate(leaf_cols)},
             }
+        self._spill_delta_append(out)
         self._dirty[:] = False
         self._freed_ns.clear()
         return out
@@ -437,7 +547,9 @@ class MeshSessionEngine:
             else:
                 leaves = [np.asarray(table[f"leaf_{i}"])
                           for i in range(len(self.agg.leaves))]
-        if len(key_ids):
+        if self._spill_active and len(key_ids):
+            self._spill_restore_rows(key_ids, namespaces, leaves)
+        elif len(key_ids):
             shards = shard_records(key_ids, self.P, self.max_parallelism)
             # inserts first — growth must settle before the host copy
             # (same contract as MeshWindowEngine.restore)
@@ -457,5 +569,7 @@ class MeshSessionEngine:
                 for a in accs_host)
         self._dirty[:] = False
         self._freed_ns.clear()
+        for sp in self.spills:
+            sp.clear_dirty()
         self.meta.restore(snap, key_group_filter=key_group_filter,
                           max_parallelism=self.max_parallelism)

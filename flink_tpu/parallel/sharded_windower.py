@@ -51,7 +51,285 @@ from flink_tpu.windowing.windower import WINDOW_END_FIELD, WINDOW_START_FIELD
 _STEP_CACHE: Dict[tuple, tuple] = {}
 
 
-class MeshWindowEngine:
+class MeshSpillSupport:
+    """Per-shard spill tier shared by the mesh window and mesh session
+    engines: LRU namespace eviction under a per-device slot budget, batched
+    reload, and the bookkeeping both engines need. Hosts must provide
+    ``P, indexes, spills, agg, accs, _dirty, _ns_touch, _put_sharded`` and
+    the ``_gather_step/_reset_step/_put_step`` programs."""
+
+    max_device_slots: int = 0
+
+    def _init_spill(self, spill_dir: Optional[str],
+                    spill_host_max_bytes: int) -> None:
+        from flink_tpu.state.slot_table import SpillTier
+
+        #: one spill tier per shard (keys never move between shards, so
+        #: spilled namespaces are shard-local like the device rows)
+        self.spills = [
+            SpillTier(
+                f"{spill_dir.rstrip('/')}/shard-{p}" if spill_dir else None,
+                spill_host_max_bytes // self.P
+                if spill_host_max_bytes else 0)
+            for p in range(self.P)
+        ]
+        self._ns_touch: List[Dict[int, int]] = [{} for _ in range(self.P)]
+        self._touch_clock = 0
+        self._reload_bucket = 0
+
+    @property
+    def _spill_active(self) -> bool:
+        return self.max_device_slots > 0
+
+    def _any_spilled(self, slice_ends) -> bool:
+        return self._spill_active and any(
+            int(se) in self.spills[p]
+            for p in range(self.P) for se in slice_ends)
+
+    def _touch(self, p: int, namespaces) -> None:
+        self._touch_clock += 1
+        clock = self._touch_clock
+        touch = self._ns_touch[p]
+        for ns in namespaces:
+            touch[int(ns)] = clock
+
+    def _make_headroom(self, p: int, needed: int, protect: set) -> None:
+        while self.indexes[p].free_headroom() < needed:
+            self._evict_cold(p, protect)
+
+    def _reserve(self, p: int, keys: np.ndarray, nss: np.ndarray) -> None:
+        """Ensure shard ``p`` can absorb the genuinely NEW (key, ns)
+        pairs among (keys, nss): under ample headroom this is one cheap
+        over-counting check; otherwise a read-only probe counts the
+        misses and cold namespaces are evicted to make room, protecting
+        the namespaces this batch touches."""
+        if not self._spill_active:
+            return
+        from flink_tpu.state.slot_table import unique_pairs
+
+        uk, un, _ = unique_pairs(np.asarray(keys, dtype=np.int64),
+                                 np.asarray(nss, dtype=np.int64))
+        if self.indexes[p].free_headroom() >= len(uk):
+            return
+        needed = int((self.indexes[p].lookup(uk, un) < 0).sum())
+        if needed:
+            self._make_headroom(
+                p, needed, protect={int(x) for x in np.unique(un)})
+
+    def _evict_cold(self, p: int, protect: set) -> None:
+        """Evict shard ``p``'s least-recently-touched namespaces to its
+        spill tier until a workable fraction of the shard's slots is free —
+        one gather + one reset kernel for the whole eviction batch (the
+        other shards' rows in the [P, G] blocks are identity no-ops)."""
+        from flink_tpu.state.slot_table import SlotTableFullError
+
+        idx = self.indexes[p]
+        target_free = max(idx.capacity // 8, 1024)
+        touch = self._ns_touch[p]
+        candidates = sorted(
+            (ns for ns in idx.namespaces if int(ns) not in protect),
+            key=lambda ns: touch.get(int(ns), 0))
+        if not candidates:
+            raise SlotTableFullError(
+                f"shard {p}: device slot budget exhausted and every "
+                "namespace in the current batch is protected — raise "
+                "state.slot-table.max-device-slots or reduce batch size")
+        chosen: List[Tuple[int, np.ndarray]] = []
+        freed = 0
+        for ns in candidates:
+            if freed >= target_free:
+                break
+            slots = idx.slots_for_namespace(int(ns))
+            chosen.append((int(ns), slots))
+            freed += len(slots)
+        empty = [ns for ns, s in chosen if len(s) == 0]
+        if empty:
+            idx.free_namespaces(empty)
+        chosen = [(ns, s) for ns, s in chosen if len(s) > 0]
+        if not chosen:
+            return
+        all_slots = np.concatenate([s for _, s in chosen])
+        n = len(all_slots)
+        G = sticky_bucket(n, self._gather_bucket)
+        self._gather_bucket = G
+        block = np.zeros((self.P, G), dtype=np.int32)
+        block[p, :n] = all_slots
+        gathered = self._gather_step(self.accs, self._put_sharded(block))
+        leaves_host = [np.asarray(g)[p][:n] for g in gathered]
+        off = 0
+        for ns, slots in chosen:
+            m = len(slots)
+            entry = {
+                "key_id": np.asarray(idx.slot_key[slots]),
+                **{f"leaf_{i}": leaves_host[i][off:off + m]
+                   for i in range(len(leaves_host))},
+            }
+            self.spills[p].put(ns, entry,
+                               dirty=bool(self._dirty[p, slots].any()))
+            off += m
+            self._ns_touch[p].pop(ns, None)
+        idx.free_namespaces([ns for ns, _ in chosen])
+        self._dirty[p, all_slots] = False
+        R = sticky_bucket(n, getattr(self, "_reset_bucket", 0))
+        self._reset_bucket = R
+        rb = np.zeros((self.P, R), dtype=np.int32)
+        rb[p, :n] = all_slots
+        self.accs = self._reset_step(self.accs, self._put_sharded(rb))
+
+    def _ensure_resident(self, per_shard: Dict[int, np.ndarray]) -> None:
+        """Reload any spilled namespaces among each shard's touched set
+        back onto the device — ALL shards' reloads batch into one insert
+        pass + ONE put kernel."""
+        if not self._spill_active:
+            return
+        entries: Dict[int, List[Tuple[int, Dict[str, np.ndarray]]]] = {}
+        rows: Dict[int, int] = {}
+        for p, nss in per_shard.items():
+            sp = self.spills[p]
+            if len(sp) == 0:
+                continue
+            es = []
+            for ns in nss:
+                ns = int(ns)
+                if ns in sp:
+                    e = sp.pop(ns)
+                    if e is not None and len(e["key_id"]):
+                        es.append((ns, e))
+            if es:
+                entries[p] = es
+                rows[p] = sum(len(e["key_id"]) for _, e in es)
+        if not entries:
+            return
+        # headroom first, for every shard (evictions dispatch their own
+        # kernels; slots resolved after growth/eviction settle)
+        for p, need in rows.items():
+            self._make_headroom(
+                p, need, protect={int(n) for n in per_shard[p]})
+        B = sticky_bucket(max(rows.values()), self._reload_bucket)
+        self._reload_bucket = B
+        slot_block = np.zeros((self.P, B), dtype=np.int32)
+        val_blocks = [np.full((self.P, B), l.identity, dtype=l.dtype)
+                      for l in self.agg.leaves]
+        for p, es in entries.items():
+            keys = np.concatenate([
+                np.asarray(e["key_id"], dtype=np.int64) for _, e in es])
+            nss = np.concatenate([
+                np.full(len(e["key_id"]), ns, dtype=np.int64)
+                for ns, e in es])
+            n = len(keys)
+            slots = self.indexes[p].lookup_or_insert(keys, nss)
+            slot_block[p, :n] = slots
+            for i, l in enumerate(self.agg.leaves):
+                val_blocks[i][p, :n] = np.concatenate([
+                    np.asarray(e[f"leaf_{i}"], dtype=l.dtype)
+                    for _, e in es])
+            # reloaded rows keep their dirtiness: rows dirty at spill time
+            # have not been in any snapshot since
+            was_dirty = np.concatenate([
+                np.full(len(e["key_id"]),
+                        bool(e.get("__was_dirty__", False)), dtype=bool)
+                for _, e in es])
+            self._dirty[p, slots] = was_dirty
+            self._touch(p, [ns for ns, _ in es])
+        self.accs = self._put_step(
+            self.accs, self._put_sharded(slot_block),
+            tuple(self._put_sharded(v) for v in val_blocks))
+
+    def _drop_spilled(self, ends, freed_touch: bool = True) -> None:
+        """Discard spilled namespaces (fully fired/expired elsewhere)."""
+        if not self._spill_active:
+            return
+        for p in range(self.P):
+            sp = self.spills[p]
+            if len(sp):
+                for e in ends:
+                    if int(e) in sp:
+                        sp.drop(int(e))
+            if freed_touch:
+                touch = self._ns_touch[p]
+                for e in ends:
+                    touch.pop(int(e), None)
+
+    def _spill_snapshot_parts(self) -> List[Dict[str, np.ndarray]]:
+        """Logical-snapshot rows for every spilled namespace."""
+        parts: List[Dict[str, np.ndarray]] = []
+        for p in range(self.P):
+            sp = self.spills[p]
+            for ns in sp.namespaces:
+                entry = sp.peek(int(ns))
+                m = len(entry["key_id"])
+                ekeys = np.asarray(entry["key_id"], dtype=np.int64)
+                parts.append({
+                    "key_id": ekeys,
+                    "namespace": np.full(m, int(ns), dtype=np.int64),
+                    "key_group": assign_key_groups(
+                        ekeys, self.max_parallelism),
+                    **{f"leaf_{i}": np.asarray(
+                        entry[f"leaf_{i}"],
+                        dtype=self.agg.leaves[i].dtype)
+                       for i in range(len(self.agg.leaves))},
+                })
+        return parts
+
+    def _spill_delta_append(self, out: Dict[str, np.ndarray]) -> None:
+        """Append spilled-but-dirty namespaces to a delta snapshot and
+        clear their dirtiness."""
+        if not self._spill_active:
+            return
+        for p in range(self.P):
+            sp = self.spills[p]
+            for ns in sp.dirty_namespaces():
+                entry = sp.peek(int(ns))
+                if entry is None:
+                    continue
+                ekeys = np.asarray(entry["key_id"], dtype=np.int64)
+                m = len(ekeys)
+                out["key_id"] = np.concatenate([out["key_id"], ekeys])
+                out["namespace"] = np.concatenate([
+                    out["namespace"],
+                    np.full(m, int(ns), dtype=np.int64)])
+                out["key_group"] = np.concatenate([
+                    out["key_group"],
+                    assign_key_groups(ekeys, self.max_parallelism)])
+                for i, l in enumerate(self.agg.leaves):
+                    out[f"leaf_{i}"] = np.concatenate([
+                        out[f"leaf_{i}"],
+                        np.asarray(entry[f"leaf_{i}"], dtype=l.dtype)])
+            sp.clear_dirty()
+
+    def _spill_restore_rows(self, key_ids: np.ndarray,
+                            namespaces: np.ndarray,
+                            leaves: List[np.ndarray]) -> None:
+        """Spill-enabled restore: rows land in each shard's spill tier
+        grouped by namespace and reload lazily on first access — a
+        snapshot far larger than the HBM budget restores with bounded
+        device memory (same contract as SlotTable.restore)."""
+        shards = shard_records(key_ids, self.P, self.max_parallelism)
+        for p in range(self.P):
+            mask = shards == p
+            if not mask.any():
+                continue
+            ns_p = namespaces[mask]
+            keys_p = key_ids[mask]
+            leaves_p = [l[mask] for l in leaves]
+            order = np.argsort(ns_p, kind="stable")
+            s_ns, s_keys = ns_p[order], keys_p[order]
+            s_leaves = [l[order] for l in leaves_p]
+            bounds = np.nonzero(np.diff(s_ns))[0] + 1
+            starts = np.concatenate(([0], bounds))
+            ends = np.concatenate((bounds, [len(s_ns)]))
+            sp = self.spills[p]
+            for a, b in zip(starts.tolist(), ends.tolist()):
+                ns = int(s_ns[a])
+                entry = {"key_id": s_keys[a:b],
+                         **{f"leaf_{i}": s_leaves[i][a:b]
+                            for i in range(len(s_leaves))}}
+                if ns in sp:
+                    sp.drop(ns)
+                sp.put(ns, entry, dirty=False)
+
+
+class MeshWindowEngine(MeshSpillSupport):
     """Windowed keyed aggregation sharded over a 1-D device mesh."""
 
     def __init__(
@@ -63,6 +341,9 @@ class MeshWindowEngine:
         max_parallelism: int = 128,
         allowed_lateness: int = 0,
         fire_projector=None,
+        max_device_slots: int = 0,
+        spill_dir: Optional[str] = None,
+        spill_host_max_bytes: int = 0,
     ) -> None:
         self.assigner = assigner
         self.agg = agg
@@ -73,14 +354,25 @@ class MeshWindowEngine:
         self.fire_projector = fire_projector
         self.mesh = mesh
         self.P = int(mesh.devices.size)
+        #: per-SHARD HBM slot budget — the raw
+        #: state.slot-table.max-device-slots value, which is PER DEVICE
+        #: (each shard owns one chip's HBM, so total capacity scales with
+        #: the mesh while each chip stays bounded): beyond it, cold
+        #: namespaces spill to the per-shard host/fs tier and reload on
+        #: access (reference: RocksDBKeyedStateBackend.java — RocksDB
+        #: state was never bounded by memory either)
+        self.max_device_slots = int(max_device_slots or 0)
         self.capacity = max(int(capacity_per_shard), 1024)
+        if self.max_device_slots:
+            self.max_device_slots = max(self.max_device_slots, 1024)
+            self.capacity = min(self.capacity, self.max_device_slots)
         self.max_parallelism = max_parallelism
         self.allowed_lateness = allowed_lateness
         if max_parallelism < self.P:
             raise ValueError(
                 f"max_parallelism {max_parallelism} < mesh size {self.P}")
 
-        from flink_tpu.state.slot_table import make_slot_index
+        from flink_tpu.state.slot_table import SpillTier, make_slot_index
 
         # growable per-shard indexes: hot-key skew concentrating (key,
         # slice) pairs on one shard grows the table instead of killing the
@@ -89,9 +381,15 @@ class MeshWindowEngine:
         self.indexes = [
             make_slot_index(
                 self.capacity, growable=True,
-                on_grow=lambda old, new: self._shard_index_grew(new))
+                on_grow=lambda old, new: self._shard_index_grew(new),
+                max_capacity=self.max_device_slots,
+                full_hint=("state spills to host beyond "
+                           "state.slot-table.max-device-slots"
+                           if self.max_device_slots
+                           else "raise state.slot-table.capacity"))
             for _ in range(self.P)
         ]
+        self._init_spill(spill_dir, spill_host_max_bytes)
         self._sharding = NamedSharding(mesh, P(KEY_AXIS))
         self._replicated = NamedSharding(mesh, P())
         self.accs: Tuple[jnp.ndarray, ...] = tuple(
@@ -120,7 +418,8 @@ class MeshWindowEngine:
 
     def _build_steps(self) -> None:
         (self._scatter_step, self._fire_step, self._reset_step,
-         self._gather_step) = build_mesh_steps(self.mesh, self.agg)
+         self._gather_step, self._put_step,
+         self._merge_step) = build_mesh_steps(self.mesh, self.agg)
 
     def _shard_index_grew(self, new_capacity: int) -> None:
         """One shard's index outgrew the device column count: widen the
@@ -150,12 +449,70 @@ class MeshWindowEngine:
 
     # ---------------------------------------------------------------- ingest
 
+    def _ns_group_plan(self, key_ids: np.ndarray,
+                       slice_ends: np.ndarray) -> Optional[List[List[int]]]:
+        """When one batch's touched-namespace working set exceeds the
+        per-shard budget, plan namespace groups so only one group must be
+        resident at a time (the mesh form of SlotTable.upsert's chunking;
+        a single namespace whose per-shard key set alone exceeds the
+        budget is the irreducible limit and fails loudly downstream).
+
+        Cost of a namespace = max over shards of (resident rows + spilled
+        rows + this batch's new pairs) — the slots it needs while its
+        group is being scattered. Returns None when no chunking is needed.
+        """
+        from flink_tpu.state.slot_table import unique_pairs
+
+        pk, pns, _ = unique_pairs(
+            np.asarray(key_ids, dtype=np.int64),
+            np.asarray(slice_ends, dtype=np.int64))
+        uniq_ns = np.unique(pns)
+        if len(uniq_ns) <= 1:
+            return None
+        budget = max(self.max_device_slots // 2, 1024)
+        pshards = shard_records(pk, self.P, self.max_parallelism)
+        costs: Dict[int, int] = {}
+        for ns in uniq_ns.tolist():
+            ns = int(ns)
+            sel = pns == ns
+            per_shard_new = np.bincount(pshards[sel], minlength=self.P)
+            worst = 0
+            for p in range(self.P):
+                worst = max(
+                    worst,
+                    len(self.indexes[p].slots_for_namespace(ns))
+                    + self.spills[p].rows(ns)
+                    + int(per_shard_new[p]))
+            costs[ns] = worst
+        if sum(costs.values()) <= budget:
+            return None
+        groups: List[List[int]] = []
+        cur: List[int] = []
+        cur_cost = 0
+        for ns in sorted(costs):
+            c = costs[ns]
+            if cur and cur_cost + c > budget:
+                groups.append(cur)
+                cur, cur_cost = [], 0
+            cur.append(ns)
+            cur_cost += c
+        groups.append(cur)
+        return groups if len(groups) > 1 else None
+
     def process_batch(self, batch: RecordBatch) -> None:
         n = len(batch)
         if n == 0:
             return
         key_ids = batch.key_ids
         slice_ends = self.assigner.assign_slice_ends(batch.timestamps)
+        if self._spill_active and n > 1:
+            groups = self._ns_group_plan(key_ids, slice_ends)
+            if groups is not None:
+                for g in groups:
+                    mask = np.isin(slice_ends, np.asarray(g))
+                    if mask.any():
+                        self.process_batch(batch.filter(mask))
+                return
         live = self.book.live_mask(slice_ends)
         if live is not None:
             key_ids, slice_ends = key_ids[live], slice_ends[live]
@@ -178,15 +535,26 @@ class MeshWindowEngine:
         key_block, ns_block = blocked[0], blocked[1]
         value_blocks = blocked[2:]
 
+        if self._spill_active:
+            # reload spilled namespaces this batch touches (batched across
+            # shards), then refresh recency
+            touched = {p: np.unique(ns_block[p, :int(counts[p])])
+                       for p in range(self.P) if int(counts[p])}
+            self._ensure_resident(touched)
+            for p, nss in touched.items():
+                self._touch(p, nss.tolist())
+
         # per-shard slot assignment (host)
         B = key_block.shape[1]
         slot_block = np.zeros((self.P, B), dtype=np.int32)
         for p in range(self.P):
             c = int(counts[p])
-            if c:
-                slot_block[p, :c] = self.indexes[p].lookup_or_insert(
-                    key_block[p, :c], ns_block[p, :c])
-                self._dirty[p, slot_block[p, :c]] = True
+            if not c:
+                continue
+            self._reserve(p, key_block[p, :c], ns_block[p, :c])
+            slot_block[p, :c] = self.indexes[p].lookup_or_insert(
+                key_block[p, :c], ns_block[p, :c])
+            self._dirty[p, slot_block[p, :c]] = True
 
         self.accs = self._scatter_step(
             self.accs,
@@ -213,6 +581,12 @@ class MeshWindowEngine:
 
     def _fire_window(self, window_end: int) -> Optional[RecordBatch]:
         slice_ends = self.assigner.slice_ends_for_window(window_end)
+        if self._any_spilled(slice_ends):
+            # hybrid fire: resident slices merge on device (one kernel),
+            # spilled slices merge on host — the device budget stays
+            # independent of the window's slice count (the mesh form of
+            # SlotTable.fire_hybrid)
+            return self._fire_window_hybrid(window_end, slice_ends)
         k = len(slice_ends)
         per_shard_mats: List[np.ndarray] = []
         per_shard_keys: List[np.ndarray] = []
@@ -272,10 +646,101 @@ class MeshWindowEngine:
         cols.update(merged)
         return RecordBatch(cols)
 
+    def _fire_window_hybrid(self, window_end: int,
+                            slice_ends) -> Optional[RecordBatch]:
+        from flink_tpu.ops.segment_ops import HOST_COMBINE
+
+        k = len(slice_ends)
+        leaves = self.agg.leaves
+        # device part: per-shard slot matrices over RESIDENT slices (the
+        # index only knows resident namespaces), merged raw on device
+        per_shard_mats: List[np.ndarray] = []
+        per_shard_keys: List[np.ndarray] = []
+        w_max = 0
+        for p in range(self.P):
+            idx = self.indexes[p]
+            chunks = [(i, idx.slots_for_namespace(int(se)))
+                      for i, se in enumerate(slice_ends)]
+            chunks = [(i, s) for i, s in chunks if len(s) > 0]
+            if not chunks:
+                per_shard_mats.append(np.zeros((0, k), dtype=np.int32))
+                per_shard_keys.append(np.empty(0, dtype=np.int64))
+                continue
+            all_slots = np.concatenate([s for _, s in chunks])
+            all_sidx = np.concatenate(
+                [np.full(len(s), i, dtype=np.int32) for i, s in chunks])
+            all_keys = idx.slot_key[all_slots]
+            keys, inv = np.unique(all_keys, return_inverse=True)
+            mat = np.zeros((len(keys), k), dtype=np.int32)
+            mat[inv, all_sidx] = all_slots
+            per_shard_mats.append(mat)
+            per_shard_keys.append(keys)
+            w_max = max(w_max, len(keys))
+        key_chunks: List[np.ndarray] = []
+        leaf_chunks: List[List[np.ndarray]] = [[] for _ in leaves]
+        if w_max:
+            W = sticky_bucket(w_max, getattr(self, "_fire_bucket", 0),
+                              minimum=64)
+            self._fire_bucket = W
+            sm = np.zeros((self.P, W, k), dtype=np.int32)
+            for p, mat in enumerate(per_shard_mats):
+                sm[p, : len(mat)] = mat
+            merged = self._merge_step(self.accs, self._put_sharded(sm))
+            merged_host = [np.asarray(m) for m in merged]
+            for p in range(self.P):
+                m = len(per_shard_keys[p])
+                if m == 0:
+                    continue
+                key_chunks.append(per_shard_keys[p])
+                for i in range(len(leaves)):
+                    leaf_chunks[i].append(merged_host[i][p][:m])
+        # host part: spilled slices of this window, every shard
+        for p in range(self.P):
+            sp = self.spills[p]
+            for se in slice_ends:
+                entry = sp.peek(int(se))
+                if entry is None or len(entry["key_id"]) == 0:
+                    continue
+                key_chunks.append(
+                    np.asarray(entry["key_id"], dtype=np.int64))
+                for i, l in enumerate(leaves):
+                    leaf_chunks[i].append(
+                        np.asarray(entry[f"leaf_{i}"], dtype=l.dtype))
+        if not key_chunks:
+            return None
+        all_keys = np.concatenate(key_chunks)
+        uniq, inv = np.unique(all_keys, return_inverse=True)
+        out_leaves = []
+        for i, l in enumerate(leaves):
+            acc = np.full(len(uniq), l.identity, dtype=l.dtype)
+            HOST_COMBINE[l.reduce].at(acc, inv,
+                                      np.concatenate(leaf_chunks[i]))
+            out_leaves.append(acc)
+        finished = self.agg.finish(tuple(out_leaves))
+        merged_cols = {name: np.asarray(col)
+                       for name, col in finished.items()}
+        keys = uniq
+        if self.fire_projector is not None:
+            keys, merged_cols = self.fire_projector.project_host(
+                keys, merged_cols)
+        m = len(keys)
+        if m == 0:
+            return None
+        cols = {
+            KEY_ID_FIELD: keys,
+            WINDOW_START_FIELD: np.full(
+                m, self.assigner.window_start(window_end), dtype=np.int64),
+            WINDOW_END_FIELD: np.full(m, window_end, dtype=np.int64),
+            TIMESTAMP_FIELD: np.full(m, window_end - 1, dtype=np.int64),
+        }
+        cols.update(merged_cols)
+        return RecordBatch(cols)
+
     def _free_slices(self, ends: List[int]) -> None:
         f_max = 0
         freed: List[Optional[np.ndarray]] = []
         self._freed_ns.extend(int(e) for e in ends)
+        self._drop_spilled(ends)
         for p in range(self.P):
             slots = self.indexes[p].free_namespaces(ends)
             freed.append(slots)
@@ -298,40 +763,70 @@ class MeshWindowEngine:
         """Queryable-state point lookup, mesh form: route the key to its
         owning shard (the same key-group formula the data path uses), probe
         that shard's host index, gather its slice accumulators off the
-        device, and compose window results on host (slice sharing, as
-        SlotTable.query_windows). Read-only."""
+        device (spilled slices read from the shard's spill tier), and
+        compose window results on host (slice sharing, as
+        SlotTable.query_windows). Read-only — no residency change."""
+        from flink_tpu.ops.segment_ops import HOST_COMBINE
+
         shard = int(shard_records(
             np.asarray([key_id], dtype=np.int64), self.P,
             self.max_parallelism)[0])
         idx = self.indexes[shard]
+        leaves = self.agg.leaves
+        #: slice end -> per-leaf 1-element raw values for this key
+        slice_vals: Dict[int, Tuple[np.ndarray, ...]] = {}
         live_ns = np.asarray([int(n) for n in idx.namespaces],
                              dtype=np.int64)
-        if len(live_ns) == 0:
+        if len(live_ns):
+            keys = np.full(len(live_ns), int(key_id), dtype=np.int64)
+            slots = idx.lookup(keys, live_ns)
+            hit = slots >= 0
+            if hit.any():
+                hs = slots[hit].astype(np.int32)
+                G = pad_bucket_size(len(hs), minimum=64)
+                block = np.zeros((self.P, G), dtype=np.int32)
+                block[shard, : len(hs)] = hs
+                gathered = self._gather_step(self.accs,
+                                             self._put_sharded(block))
+                g_host = [np.asarray(g)[shard][: len(hs)] for g in gathered]
+                for j, ns in enumerate(n for n, h in zip(live_ns, hit)
+                                       if h):
+                    slice_vals[int(ns)] = tuple(
+                        g[j:j + 1] for g in g_host)
+        if self._spill_active:
+            sp = self.spills[shard]
+            for ns in sp.namespaces:
+                entry = sp.peek(int(ns))
+                if entry is None:
+                    continue
+                pos = np.nonzero(np.asarray(
+                    entry["key_id"], dtype=np.int64) == int(key_id))[0]
+                if len(pos) == 0:
+                    continue
+                j = int(pos[0])
+                slice_vals[int(ns)] = tuple(
+                    np.asarray(entry[f"leaf_{i}"], dtype=l.dtype)[j:j + 1]
+                    for i, l in enumerate(leaves))
+        if not slice_vals:
             return {}
-        keys = np.full(len(live_ns), int(key_id), dtype=np.int64)
-        slots = idx.lookup(keys, live_ns)
-        hit = slots >= 0
-        if not hit.any():
-            return {}
-        slice_slot = {int(n): int(s)
-                      for n, s, h in zip(live_ns, slots, hit) if h}
         assigner = self.assigner
         windows = sorted({
             int(w)
-            for se in slice_slot
+            for se in slice_vals
             for w in assigner.window_ends_for_slice(se)})
-        k = max(len(assigner.slice_ends_for_window(w)) for w in windows)
-        # pad W to a bucket (slot 0 = reserved identity) — exact shapes
-        # would recompile fire_step per distinct live-window count
-        W = pad_bucket_size(len(windows), minimum=64)
-        sm = np.zeros((self.P, W, k), dtype=np.int32)
-        for i, w in enumerate(windows):
-            for j, se in enumerate(assigner.slice_ends_for_window(w)):
-                sm[shard, i, j] = slice_slot.get(int(se), 0)
-        results = self._fire_step(self.accs, self._put_sharded(sm))
-        return {w: {name: np.asarray(col)[shard][i].item()
-                    for name, col in results.items()}
-                for i, w in enumerate(windows)}
+        out: Dict[int, Dict[str, float]] = {}
+        for w in windows:
+            acc = [np.full(1, l.identity, dtype=l.dtype) for l in leaves]
+            for se in assigner.slice_ends_for_window(w):
+                sv = slice_vals.get(int(se))
+                if sv is None:
+                    continue
+                acc = [HOST_COMBINE[l.reduce](a, v)
+                       for a, v, l in zip(acc, sv, leaves)]
+            finished = self.agg.finish(tuple(acc))
+            out[w] = {name: np.asarray(col).item()
+                      for name, col in finished.items()}
+        return out
 
     # -------------------------------------------------------------- snapshot
 
@@ -357,12 +852,16 @@ class MeshWindowEngine:
                 **{f"leaf_{i}": accs_host[i][p][used]
                    for i in range(len(self.accs))},
             })
+        # spilled namespaces are part of the logical state
+        parts.extend(self._spill_snapshot_parts())
         merged = {
             k: np.concatenate([pt[k] for pt in parts]) for k in parts[0]
         } if parts else {}
         if mode != "savepoint":
             self._dirty[:] = False
             self._freed_ns.clear()
+            for sp in self.spills:
+                sp.clear_dirty()
         return {"table": merged, **self.book.snapshot()}
 
     def _snapshot_delta(self) -> Dict[str, np.ndarray]:
@@ -419,6 +918,7 @@ class MeshWindowEngine:
                 **{f"leaf_{i}": np.concatenate(cols)
                    for i, cols in enumerate(leaf_cols)},
             }
+        self._spill_delta_append(out)
         self._dirty[:] = False
         self._freed_ns.clear()
         return out
@@ -430,7 +930,9 @@ class MeshWindowEngine:
         namespaces = np.asarray(table["namespace"], dtype=np.int64)
         leaves = [np.asarray(table[f"leaf_{i}"])
                   for i in range(len(self.agg.leaves))]
-        if len(key_ids):
+        if self._spill_active and len(key_ids):
+            self._spill_restore_rows(key_ids, namespaces, leaves)
+        elif len(key_ids):
             shards = shard_records(key_ids, self.P, self.max_parallelism)
             # resolve ALL slots first: inserts may grow the table
             # (on_grow widens self.accs / self.capacity), so the host
@@ -452,13 +954,20 @@ class MeshWindowEngine:
         # restored state IS the new incremental base
         self._dirty[:] = False
         self._freed_ns.clear()
+        for sp in self.spills:
+            sp.clear_dirty()
         self.book.restore(snap)
 
 
 def build_mesh_steps(mesh: Mesh, agg: AggregateFunction):
-    """(scatter, fire, reset, gather) shard_map step programs over a
-    [P, capacity] sharded slot table — shared by the mesh window and mesh
-    session engines (cached per (devices, aggregate layout))."""
+    """(scatter, fire, reset, gather, put, merge) shard_map step programs
+    over a [P, capacity] sharded slot table — shared by the mesh window and
+    mesh session engines (cached per (devices, aggregate layout)).
+
+    ``put`` overwrites slots with explicit per-leaf values (spill reload);
+    ``merge`` is fire without the finish — raw merged leaves come back to
+    the host so spilled slices can be combined there (the mesh form of
+    SlotTable.fire_hybrid)."""
     cache_key = (tuple(d.id for d in mesh.devices.flat), agg.cache_key())
     cached = _STEP_CACHE.get(cache_key)
     if cached is not None:
@@ -553,7 +1062,43 @@ def build_mesh_steps(mesh: Mesh, agg: AggregateFunction):
             out_specs=(P(KEY_AXIS),) * n_leaves,
         )(*accs, slots)
 
+    @partial(jax.jit, donate_argnums=(0,))
+    def put_step(accs, slots, values):
+        # slots: [P, B]; values: one [P, B] block per LEAF — overwrite
+        # semantics (spill reload into slots just reset to identity).
+        # Padded lanes target slot 0 with identity values: harmless.
+        def local(*args):
+            accs_l = args[:n_leaves]
+            slots_l = args[n_leaves]
+            vals_l = args[n_leaves + 1:]
+            return tuple(a.at[0, slots_l[0]].set(v[0])
+                         for a, v in zip(accs_l, vals_l))
+
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(KEY_AXIS),) * (2 * n_leaves + 1),
+            out_specs=(P(KEY_AXIS),) * n_leaves,
+        )(*accs, slots, *values)
+
+    @jax.jit
+    def merge_step(accs, slot_matrix):
+        # slot_matrix: [P, W, k] sharded -> per-leaf [P, W] RAW merged
+        # accumulators (no finish) for host-side hybrid-fire composition
+        def local(*args):
+            accs_l = args[:n_leaves]
+            sm = args[n_leaves][0]
+            return tuple(
+                m(a[0][sm], axis=1)[None]
+                for a, m in zip(accs_l, merges))
+
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(KEY_AXIS),) * (n_leaves + 1),
+            out_specs=(P(KEY_AXIS),) * n_leaves,
+        )(*accs, slot_matrix)
+
     _STEP_CACHE[cache_key] = steps = (scatter_step, fire_step,
-                                      reset_step, gather_step)
+                                      reset_step, gather_step,
+                                      put_step, merge_step)
     return steps
 

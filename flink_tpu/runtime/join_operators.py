@@ -50,6 +50,21 @@ def equi_join_indices(left_keys: np.ndarray, right_keys: np.ndarray
     return l_idx, r_idx
 
 
+def _filter_by_key_groups(cols: Dict[str, np.ndarray], key_group_filter,
+                          max_parallelism: int) -> Dict[str, np.ndarray]:
+    """Keep only rows whose key belongs to the owned key groups — the
+    key-group-range-scoped restore of buffered join state (reference:
+    keyed state restore is key-group scoped; join buffers are keyed
+    state)."""
+    from flink_tpu.state.keygroups import assign_key_groups
+
+    kid = np.asarray(cols[KEY_ID_FIELD], dtype=np.int64)
+    groups = assign_key_groups(kid, max_parallelism)
+    keep = np.isin(groups, np.fromiter(key_group_filter, dtype=np.int32,
+                                       count=len(key_group_filter)))
+    return {k: np.asarray(v)[keep] for k, v in cols.items()}
+
+
 def _merge_columns(left: RecordBatch, right: RecordBatch,
                    l_idx: np.ndarray, r_idx: np.ndarray,
                    suffixes=("_l", "_r")) -> Dict[str, np.ndarray]:
@@ -84,6 +99,10 @@ class WindowJoinOperator(Operator):
         self.book = SliceBookkeeper(assigner)
         # slice_end -> [left batches], [right batches]
         self._buf: Dict[int, Tuple[List[RecordBatch], List[RecordBatch]]] = {}
+        self._max_parallelism = 128
+
+    def open(self, ctx):
+        self._max_parallelism = getattr(ctx, "max_parallelism", 128)
 
     def process_batch(self, batch, input_index=0):
         if len(batch) == 0:
@@ -159,11 +178,22 @@ class WindowJoinOperator(Operator):
             },
         }
 
-    def restore_state(self, state):
+    def restore_state(self, state, key_group_filter=None):
         self.book.restore(state["book"])
+        buf = state.get("buf", {})
+        if key_group_filter is not None:
+            buf = {
+                se: ([_filter_by_key_groups(c, key_group_filter,
+                                            self._max_parallelism)
+                      for c in l],
+                     [_filter_by_key_groups(c, key_group_filter,
+                                            self._max_parallelism)
+                      for c in r])
+                for se, (l, r) in buf.items()
+            }
         self._buf = {
             se: ([RecordBatch(c) for c in l], [RecordBatch(c) for c in r])
-            for se, (l, r) in state.get("buf", {}).items()
+            for se, (l, r) in buf.items()
         }
 
 
@@ -184,6 +214,10 @@ class IntervalJoinOperator(Operator):
         self.suffixes = suffixes
         self._left: List[RecordBatch] = []
         self._right: List[RecordBatch] = []
+        self._max_parallelism = 128
+
+    def open(self, ctx):
+        self._max_parallelism = getattr(ctx, "max_parallelism", 128)
 
     def process_batch(self, batch, input_index=0):
         if len(batch) == 0:
@@ -252,6 +286,15 @@ class IntervalJoinOperator(Operator):
             "right": [dict(b.columns) for b in self._right],
         }
 
-    def restore_state(self, state):
-        self._left = [RecordBatch(c) for c in state.get("left", [])]
-        self._right = [RecordBatch(c) for c in state.get("right", [])]
+    def restore_state(self, state, key_group_filter=None):
+        left = state.get("left", [])
+        right = state.get("right", [])
+        if key_group_filter is not None:
+            left = [_filter_by_key_groups(c, key_group_filter,
+                                          self._max_parallelism)
+                    for c in left]
+            right = [_filter_by_key_groups(c, key_group_filter,
+                                           self._max_parallelism)
+                     for c in right]
+        self._left = [RecordBatch(c) for c in left]
+        self._right = [RecordBatch(c) for c in right]

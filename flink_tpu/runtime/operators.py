@@ -222,21 +222,21 @@ class WindowAggOperator(Operator):
             from flink_tpu.parallel.mesh import make_mesh
             from flink_tpu.parallel.sharded_windower import MeshWindowEngine
 
-            if self.spill and self.spill.get("max_device_slots"):
-                import warnings
-
-                warnings.warn(
-                    "state.slot-table.max-device-slots is not yet honored "
-                    "by the mesh-parallel window engine — state stays "
-                    "device-resident at parallelism > 1", stacklevel=2)
             self._warn_backend_ignored_on_mesh()
             mesh = getattr(ctx, "mesh", None) or make_mesh(effective)
+            spill = dict(self.spill or {})
             self.windower = MeshWindowEngine(
                 self.assigner, self.agg, mesh,
                 capacity_per_shard=self.capacity,
                 max_parallelism=ctx.max_parallelism,
                 allowed_lateness=self.allowed_lateness,
-                fire_projector=self.fire_projector)
+                fire_projector=self.fire_projector,
+                # the budget is per device: every mesh shard owns one
+                # chip's HBM (state capacity ⟂ parallelism, the RocksDB
+                # contract)
+                max_device_slots=spill.get("max_device_slots", 0),
+                spill_dir=spill.get("spill_dir"),
+                spill_host_max_bytes=spill.get("spill_host_max_bytes", 0))
         else:
             table_kwargs, placement = self._table_kwargs()
             has_spill = bool(self.spill and any(self.spill.values()))
@@ -561,20 +561,18 @@ class SessionWindowAggOperator(WindowAggOperator):
             from flink_tpu.parallel.mesh import make_mesh
             from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
 
-            if self.spill and self.spill.get("max_device_slots"):
-                import warnings
-
-                warnings.warn(
-                    "state.slot-table.max-device-slots is not yet honored "
-                    "by the mesh-parallel session engine — state stays "
-                    "device-resident at parallelism > 1", stacklevel=2)
             self._warn_backend_ignored_on_mesh()
             mesh = getattr(ctx, "mesh", None) or make_mesh(effective)
+            spill = dict(self.spill or {})
             self.windower = MeshSessionEngine(
                 self.gap, self.agg, mesh,
                 capacity_per_shard=self.capacity,
                 max_parallelism=ctx.max_parallelism,
-                allowed_lateness=self.allowed_lateness)
+                allowed_lateness=self.allowed_lateness,
+                # per-device budget, same contract as the window engine
+                max_device_slots=spill.get("max_device_slots", 0),
+                spill_dir=spill.get("spill_dir"),
+                spill_host_max_bytes=spill.get("spill_host_max_bytes", 0))
         else:
             table_kwargs, _ = self._table_kwargs()
             self.windower = SessionWindower(

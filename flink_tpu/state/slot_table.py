@@ -403,6 +403,9 @@ class SpillTier:
         self._fs: Dict[int, str] = {}  # ns -> file path
         self._dirty: set = set()  # namespaces changed since last snapshot
         self._seq = 0
+        #: ns -> row count, maintained across host/fs moves so batch
+        #: planners can estimate reload cost without touching the fs
+        self._rows: Dict[int, int] = {}
 
     def __len__(self) -> int:
         return len(self._host) + len(self._fs)
@@ -423,9 +426,15 @@ class SpillTier:
         assert ns not in self, f"namespace {ns} spilled twice"
         self._host[ns] = entry
         self._host_bytes += self._entry_bytes(entry)
+        self._rows[ns] = len(entry["key_id"])
         if dirty:
             self._dirty.add(ns)
         self._maybe_overflow_to_fs()
+
+    def rows(self, ns: int) -> int:
+        """Row count of a spilled namespace (0 if absent) — an O(1) read
+        that never touches the filesystem."""
+        return self._rows.get(ns, 0)
 
     def _maybe_overflow_to_fs(self) -> None:
         if not self.spill_dir or self.host_max_bytes <= 0:
@@ -469,6 +478,7 @@ class SpillTier:
             fs.delete(local)
         was_dirty = ns in self._dirty
         self._dirty.discard(ns)
+        self._rows.pop(ns, None)
         if entry is not None:
             entry["__was_dirty__"] = np.asarray(was_dirty)
         return entry

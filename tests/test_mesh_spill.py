@@ -1,0 +1,359 @@
+"""Mesh-sharded spill tier: state capacity independent of parallelism.
+
+The budgeted [P, capacity] device table evicts cold namespaces per shard to
+a host/fs SpillTier and reloads them on access — the mesh form of the
+single-device SlotTable spill (reference: RocksDBKeyedStateBackend.java —
+RocksDB state capacity was never bounded by memory, at any parallelism).
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.records import KEY_ID_FIELD, RecordBatch
+from flink_tpu.parallel.sharded_windower import MeshWindowEngine
+from flink_tpu.windowing.aggregates import (
+    CountAggregate,
+    MultiAggregate,
+    SumAggregate,
+)
+from flink_tpu.windowing.assigners import (
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+)
+
+
+def keyed_batch(keys, values, ts):
+    return RecordBatch.from_pydict(
+        {KEY_ID_FIELD: np.asarray(keys, dtype=np.int64),
+         "v": np.asarray(values, dtype=np.float32)},
+        timestamps=ts)
+
+
+def fired_to_dict(batches, fields=("sum_v",)):
+    out = {}
+    for b in batches:
+        for row in b.to_rows():
+            out[(row[KEY_ID_FIELD], row["window_start"],
+                 row["window_end"])] = tuple(row[f] for f in fields)
+    return out
+
+
+def _steps(num_keys=600, per_step=800, n_steps=6, seed=11, span=4000):
+    """A stream whose live (key, slice) working set exceeds a small
+    per-shard budget: many keys across many open slices."""
+    rng = np.random.default_rng(seed)
+    steps = []
+    for s in range(n_steps):
+        keys = rng.integers(0, num_keys, per_step).astype(np.int64)
+        vals = rng.random(per_step).astype(np.float32)
+        ts = rng.integers(s * 1000, s * 1000 + span, per_step).astype(
+            np.int64)
+        steps.append((keys, vals, ts, s * 1000))
+    steps.append((np.array([0], dtype=np.int64),
+                  np.array([0.0], dtype=np.float32),
+                  np.array([n_steps * 1000 + span + 5000], dtype=np.int64),
+                  10 ** 9))
+    return steps
+
+
+def _run(engine, steps):
+    fired = []
+    for keys, vals, ts, wm in steps:
+        engine.process_batch(keyed_batch(keys, vals, ts))
+        fired.extend(engine.on_watermark(wm))
+    return fired
+
+
+class TestMeshSpill:
+    def test_spilled_equals_unspilled(self, eight_device_mesh, tmp_path):
+        """Forcing eviction with a tiny per-shard budget must not change
+        any window result."""
+        assigner = SlidingEventTimeWindows.of(2000, 1000)
+        steps = _steps()
+        ref = MeshWindowEngine(assigner, SumAggregate("v"),
+                               eight_device_mesh,
+                               capacity_per_shard=1 << 14)
+        budgeted = MeshWindowEngine(
+            assigner, SumAggregate("v"), eight_device_mesh,
+            capacity_per_shard=1 << 14,
+            max_device_slots=1024,  # floor — forces eviction per shard
+            spill_dir=str(tmp_path / "spill"))
+        d_ref = fired_to_dict(_run(ref, steps))
+        d_bud = fired_to_dict(_run(budgeted, steps))
+        assert len(d_ref) > 0
+        assert set(d_ref) == set(d_bud)
+        for k in d_ref:
+            assert d_ref[k][0] == pytest.approx(d_bud[k][0], rel=1e-4), k
+        # the budget was actually binding: something spilled at some point
+        assert budgeted._touch_clock > 0
+
+    def test_eviction_actually_happens(self, eight_device_mesh):
+        assigner = TumblingEventTimeWindows.of(1000)
+        eng = MeshWindowEngine(
+            assigner, SumAggregate("v"), eight_device_mesh,
+            capacity_per_shard=1 << 14, max_device_slots=1024)
+        rng = np.random.default_rng(2)
+        spilled_seen = 0
+        for s in range(10):
+            keys = rng.integers(0, 3000, 2000).astype(np.int64)
+            vals = rng.random(2000).astype(np.float32)
+            # many concurrent open windows: ts spread over 8 slices
+            ts = rng.integers(s * 500, s * 500 + 8000, 2000).astype(
+                np.int64)
+            eng.process_batch(keyed_batch(keys, vals, ts))
+            spilled_seen = max(spilled_seen,
+                               sum(len(sp) for sp in eng.spills))
+        assert spilled_seen > 0, "budget never became binding"
+        # no shard's index exceeded the budget
+        for idx in eng.indexes:
+            assert idx.capacity <= 1024
+
+    def test_multi_agg_with_spill(self, eight_device_mesh):
+        assigner = SlidingEventTimeWindows.of(2000, 1000)
+        steps = _steps(num_keys=400, per_step=600, n_steps=5)
+        agg = lambda: MultiAggregate(  # noqa: E731
+            [CountAggregate(), SumAggregate("v")])
+        ref = MeshWindowEngine(assigner, agg(), eight_device_mesh,
+                               capacity_per_shard=1 << 14)
+        bud = MeshWindowEngine(assigner, agg(), eight_device_mesh,
+                               capacity_per_shard=1 << 14,
+                               max_device_slots=1024)
+        d_ref = fired_to_dict(_run(ref, steps), ("count", "sum_v"))
+        d_bud = fired_to_dict(_run(bud, steps), ("count", "sum_v"))
+        assert set(d_ref) == set(d_bud) and len(d_ref) > 0
+        for k in d_ref:
+            assert d_ref[k][0] == d_bud[k][0]
+            assert d_ref[k][1] == pytest.approx(d_bud[k][1], rel=1e-4)
+
+    def test_snapshot_restore_with_spill(self, eight_device_mesh,
+                                         tmp_path):
+        """A snapshot taken mid-run with spilled state restores onto a
+        fresh budgeted engine and finishes with the same results."""
+        assigner = SlidingEventTimeWindows.of(2000, 1000)
+        steps = _steps(num_keys=500, per_step=700, n_steps=6)
+        cut = 3
+
+        ref = MeshWindowEngine(assigner, SumAggregate("v"),
+                               eight_device_mesh,
+                               capacity_per_shard=1 << 14)
+        d_ref = fired_to_dict(_run(ref, steps))
+
+        a = MeshWindowEngine(assigner, SumAggregate("v"),
+                             eight_device_mesh,
+                             capacity_per_shard=1 << 14,
+                             max_device_slots=1024,
+                             spill_dir=str(tmp_path / "a"))
+        fired = _run(a, steps[:cut])
+        snap = a.snapshot()
+        b = MeshWindowEngine(assigner, SumAggregate("v"),
+                             eight_device_mesh,
+                             capacity_per_shard=1 << 14,
+                             max_device_slots=1024,
+                             spill_dir=str(tmp_path / "b"))
+        b.restore(snap)
+        fired.extend(_run(b, steps[cut:]))
+        d_got = fired_to_dict(fired)
+        assert set(d_got) == set(d_ref)
+        for k in d_ref:
+            assert d_ref[k][0] == pytest.approx(d_got[k][0], rel=1e-4), k
+
+    def test_budgeted_snapshot_restores_on_unbudgeted(
+            self, eight_device_mesh):
+        """Spilled rows are part of the logical snapshot — engines with
+        and without a budget are mutually restorable."""
+        assigner = TumblingEventTimeWindows.of(10_000)
+        a = MeshWindowEngine(assigner, SumAggregate("v"),
+                             eight_device_mesh,
+                             capacity_per_shard=1 << 14,
+                             max_device_slots=1024)
+        rng = np.random.default_rng(7)
+        keys = rng.integers(0, 4000, 6000).astype(np.int64)
+        vals = rng.random(6000).astype(np.float32)
+        ts = rng.integers(0, 10_000, 6000).astype(np.int64)
+        a.process_batch(keyed_batch(keys, vals, ts))
+        snap = a.snapshot()
+        b = MeshWindowEngine(assigner, SumAggregate("v"),
+                             eight_device_mesh,
+                             capacity_per_shard=1 << 14)
+        b.restore(snap)
+        da = {}
+        for k in (10, 500, 3999):
+            da[k] = b.query_windows(int(keys[k]))
+        fired = b.on_watermark(10**9)
+        d = fired_to_dict(fired)
+        # oracle
+        want = {}
+        for k, v in zip(keys.tolist(), vals.tolist()):
+            want[k] = want.get(k, 0.0) + v
+        assert len(d) == len(want)
+        for (k, _, _), (s,) in d.items():
+            assert s == pytest.approx(want[k], rel=1e-4)
+
+    def test_query_windows_sees_spilled_state(self, eight_device_mesh):
+        assigner = TumblingEventTimeWindows.of(1000)
+        eng = MeshWindowEngine(
+            assigner, SumAggregate("v"), eight_device_mesh,
+            capacity_per_shard=1 << 14, max_device_slots=1024)
+        rng = np.random.default_rng(9)
+        want = {}
+        for s in range(8):
+            keys = rng.integers(0, 2500, 1500).astype(np.int64)
+            vals = rng.random(1500).astype(np.float32)
+            ts = rng.integers(0, 6000, 1500).astype(np.int64)
+            eng.process_batch(keyed_batch(keys, vals, ts))
+            for k, v, t in zip(keys.tolist(), vals.tolist(), ts.tolist()):
+                w = (t // 1000 + 1) * 1000
+                want[(k, w)] = want.get((k, w), 0.0) + v
+        assert sum(len(sp) for sp in eng.spills) > 0
+        probe = sorted({k for k, _ in want})[:5]
+        for key in probe:
+            got = eng.query_windows(int(key))
+            for w, cols in got.items():
+                assert cols["sum_v"] == pytest.approx(
+                    want[(key, w)], rel=1e-4), (key, w)
+
+
+class TestMeshSessionSpill:
+    """Budgeted mesh session engine: cold sessions spill per shard and
+    reload for merges/fires (BASELINE row 5 — 10M-key sessions cannot be
+    device-resident)."""
+
+    def _engine(self, mesh, **kw):
+        from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+        from flink_tpu.windowing.aggregates import SumAggregate
+
+        return MeshSessionEngine(gap=100, agg=SumAggregate("v"),
+                                 mesh=mesh, capacity_per_shard=1 << 14,
+                                 **kw)
+
+    def _stream(self, num_keys=3000, n_steps=6, per_step=2000, seed=21):
+        rng = np.random.default_rng(seed)
+        steps = []
+        for s in range(n_steps):
+            keys = rng.integers(0, num_keys, per_step).astype(np.int64)
+            vals = rng.random(per_step).astype(np.float32)
+            # sessions stay open across steps (events every < gap for a
+            # key subset), others go cold and eventually fire
+            ts = rng.integers(s * 80, s * 80 + 60, per_step).astype(
+                np.int64)
+            steps.append((keys, vals, ts, s * 80))
+        steps.append((np.array([0], dtype=np.int64),
+                      np.array([0.0], dtype=np.float32),
+                      np.array([n_steps * 80 + 10_000], dtype=np.int64),
+                      10 ** 9))
+        return steps
+
+    def session_dict(self, batches):
+        out = {}
+        for b in batches:
+            for r in b.to_rows():
+                out[(r[KEY_ID_FIELD], r["window_start"],
+                     r["window_end"])] = r["sum_v"]
+        return out
+
+    def test_budgeted_sessions_equal_unbounded(self, eight_device_mesh):
+        steps = self._stream()
+        ref = self._engine(eight_device_mesh)
+        bud = self._engine(eight_device_mesh, max_device_slots=1024)
+        f_ref, f_bud = [], []
+        for keys, vals, ts, wm in steps:
+            ref.process_batch(keyed_batch(keys, vals, ts))
+            bud.process_batch(keyed_batch(keys, vals, ts))
+            f_ref.extend(ref.on_watermark(wm))
+            f_bud.extend(bud.on_watermark(wm))
+        d_ref = self.session_dict(f_ref)
+        d_bud = self.session_dict(f_bud)
+        assert len(d_ref) > 0
+        assert set(d_ref) == set(d_bud)
+        for k in d_ref:
+            assert d_ref[k] == pytest.approx(d_bud[k], rel=1e-4), k
+        for idx in bud.indexes:
+            assert idx.capacity <= 1024
+
+    def test_session_snapshot_restore_with_spill(self, eight_device_mesh):
+        steps = self._stream(num_keys=2500, n_steps=6, per_step=1500)
+        cut = 3
+        ref = self._engine(eight_device_mesh)
+        f_ref = []
+        for keys, vals, ts, wm in steps:
+            ref.process_batch(keyed_batch(keys, vals, ts))
+            f_ref.extend(ref.on_watermark(wm))
+
+        a = self._engine(eight_device_mesh, max_device_slots=1024)
+        fired = []
+        for keys, vals, ts, wm in steps[:cut]:
+            a.process_batch(keyed_batch(keys, vals, ts))
+            fired.extend(a.on_watermark(wm))
+        snap = a.snapshot()
+        b = self._engine(eight_device_mesh, max_device_slots=1024)
+        b.restore(snap)
+        for keys, vals, ts, wm in steps[cut:]:
+            b.process_batch(keyed_batch(keys, vals, ts))
+            fired.extend(b.on_watermark(wm))
+        d_ref = self.session_dict(f_ref)
+        d_got = self.session_dict(fired)
+        assert set(d_ref) == set(d_got)
+        for k in d_ref:
+            assert d_ref[k] == pytest.approx(d_got[k], rel=1e-4), k
+
+
+class TestPublicSessionSpill:
+    """BASELINE row 5 shape: high-cardinality session windows at
+    parallelism 8 within a device budget, through the public API."""
+
+    def test_high_cardinality_sessions_under_budget(self):
+        from flink_tpu.connectors.sinks import CollectSink
+        from flink_tpu.connectors.sources import DataGenSource
+        from flink_tpu.core.config import Configuration
+        from flink_tpu.datastream.environment import (
+            StreamExecutionEnvironment,
+        )
+        from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+        from flink_tpu.runtime.operators import SessionWindowAggOperator
+        from flink_tpu.runtime.watermarks import WatermarkStrategy
+        from flink_tpu.windowing.assigners import EventTimeSessionWindows
+
+        def run(extra):
+            conf = {"execution.micro-batch.size": 8192,
+                    "parallelism.default": 8}
+            conf.update(extra)
+            env = StreamExecutionEnvironment(Configuration(conf))
+            sink = CollectSink()
+            # scaled-down row-5 shape: many distinct keys, sparse events
+            # -> sessions go cold (spill) and fire on gap expiry
+            (env.add_source(
+                DataGenSource(total_records=50_000, num_keys=20_000,
+                              events_per_second_of_eventtime=10_000),
+                WatermarkStrategy.for_bounded_out_of_orderness(0))
+                .key_by("key")
+                .window(EventTimeSessionWindows.with_gap(500))
+                .sum("value").sink_to(sink))
+            env.execute()
+            return sink
+
+        engines = []
+        orig_open = SessionWindowAggOperator.open
+
+        def spy_open(self, ctx):
+            orig_open(self, ctx)
+            engines.append(self.windower)
+
+        SessionWindowAggOperator.open = spy_open
+        try:
+            ref = run({})
+            got = run({"state.slot-table.max-device-slots": 1024})
+        finally:
+            SessionWindowAggOperator.open = orig_open
+
+        assert isinstance(engines[-1], MeshSessionEngine)
+        assert engines[-1].max_device_slots == 1024
+
+        def d(sink):
+            return {(r["key"], r["window_start"], r["window_end"]):
+                    round(r["sum_value"], 3) for r in sink.rows()}
+
+        d_ref, d_got = d(ref), d(got)
+        assert len(d_ref) > 0
+        assert d_ref == d_got
+        for idx in engines[-1].indexes:
+            assert idx.capacity <= 1024
