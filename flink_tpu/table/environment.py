@@ -290,6 +290,19 @@ class StreamTableEnvironment:
         self._sink_tables[name] = (
             sink, list(columns) if columns is not None else None)
 
+    def _create_connector_table(self, stmt) -> None:
+        """CREATE TABLE ... WITH ('connector'='...') resolved through the
+        connector registry (reference: DynamicTableFactory SPI discovered
+        by the 'connector' option)."""
+        from flink_tpu.table.connectors import resolve_connector
+
+        connector = stmt.options.get("connector")
+        if not connector:
+            raise PlanError(
+                f"CREATE TABLE {stmt.name}: missing 'connector' option")
+        factory = resolve_connector(connector)
+        factory(self, stmt)
+
     def from_data_stream(self, stream: DataStream,
                          columns: Sequence[str],
                          time_field: Optional[str] = None) -> Table:
@@ -356,6 +369,9 @@ class StreamTableEnvironment:
             }
         if isinstance(stmt, sql_parser.CreateModel):
             self.models.create_from_options(stmt.name, stmt.options)
+            return None
+        if isinstance(stmt, sql_parser.CreateTable):
+            self._create_connector_table(stmt)
             return None
         if isinstance(stmt, sql_parser.CreateView):
             planned = Planner(self).plan_select(optimize(stmt.query))

@@ -150,12 +150,25 @@ class CreateModel:
 
 
 @dataclasses.dataclass
+class CreateTable:
+    """CREATE TABLE name (col [TYPE], ..., WATERMARK FOR ts AS ts -
+    INTERVAL 'n' UNIT) WITH ('connector'='...', ...) — reference:
+    connector DDL resolved through the DynamicTableFactory SPI."""
+
+    name: str
+    columns: list  # of (name, type-or-None)
+    options: dict
+    watermark_field: "str | None" = None
+    watermark_delay_ms: int = 0
+
+
+@dataclasses.dataclass
 class InsertInto:
     table: str
     query: SelectStmt
 
 
-Statement = Union[SelectStmt, UnionAll, Explain, ShowTables, Describe, CreateView, CreateModel, InsertInto]
+Statement = Union[SelectStmt, UnionAll, Explain, ShowTables, Describe, CreateView, CreateModel, CreateTable, InsertInto]
 
 # ---------------------------------------------------------------------------
 # Lexer
@@ -291,10 +304,85 @@ class Parser:
         self.accept_kw("TEMPORARY")
         if self.accept_kw("MODEL"):
             return self._create_model()
+        if self.accept_kw("TABLE"):
+            return self._create_table()
         self.expect_kw("VIEW")
         name = self.next().value
         self.expect_kw("AS")
         return CreateView(name, self.parse_query())
+
+    def _create_table(self) -> CreateTable:
+        name = self.next().value
+        columns: list = []
+        wm_field = None
+        wm_delay = 0
+        self.expect_op("(")
+        while True:
+            if self.accept_kw("WATERMARK"):
+                self.expect_kw("FOR")
+                wm_field = self.next().value
+                self.expect_kw("AS")
+                # accept `ts` or `ts - INTERVAL 'n' UNIT`
+                ref = self.next().value
+                if ref != wm_field:
+                    raise SqlParseError(
+                        "WATERMARK expression must reference the "
+                        f"watermark column {wm_field!r}")
+                if self.accept_op("-"):
+                    self.expect_kw("INTERVAL")
+                    t = self.next()
+                    if t.kind not in ("str", "num"):
+                        raise SqlParseError(
+                            "INTERVAL expects a quoted amount")
+                    amount = float(t.value[1:-1] if t.kind == "str"
+                                   else t.value)
+                    unit = self.next().upper
+                    if unit not in _INTERVAL_MS:
+                        raise SqlParseError(
+                            f"unknown interval unit {unit!r}")
+                    wm_delay = int(amount * _INTERVAL_MS[unit])
+            else:
+                col = self.next()
+                if col.kind != "ident":
+                    raise SqlParseError(
+                        f"expected a column name, got {col.value!r}")
+                # optional type + modifiers (BIGINT, DECIMAL(10, 2),
+                # TIMESTAMP(3), NOT NULL ...): consumed and recorded but
+                # not enforced — the runtime is dtype-driven
+                ctype_parts = []
+                while self.peek().kind == "ident":
+                    ctype_parts.append(self.next().value)
+                    if self.accept_op("("):
+                        depth = 1
+                        while depth:
+                            tok = self.next()
+                            if tok.kind == "op" and tok.value == "(":
+                                depth += 1
+                            elif tok.kind == "op" and tok.value == ")":
+                                depth -= 1
+                columns.append((col.value,
+                                " ".join(ctype_parts) or None))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        self.expect_kw("WITH")
+        self.expect_op("(")
+        options = {}
+        while True:
+            k = self.next()
+            if k.kind != "str":
+                raise SqlParseError("table options are 'key' = 'value'")
+            self.expect_op("=")
+            v = self.next()
+            if v.kind != "str":
+                raise SqlParseError("table options are 'key' = 'value'")
+            options[k.value[1:-1]] = v.value[1:-1]
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return CreateTable(name, columns, options,
+                           watermark_field=wm_field,
+                           watermark_delay_ms=wm_delay)
 
     def _create_model(self) -> CreateModel:
         name = self.next().value
