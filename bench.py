@@ -78,21 +78,26 @@ def probe_backend(timeouts=(45, 90, 180)) -> tuple:
 
 
 def run(total_records: int, num_auctions: int = 100_000,
-        batch_size: int = 1 << 17, layout: str = "slots") -> dict:
+        batch_size: int = None, layout: str = "slots") -> dict:
     from flink_tpu import Configuration, StreamExecutionEnvironment
     from flink_tpu.benchmarks.nexmark import BidSource, build_q5
     from flink_tpu.connectors.sinks import CollectSink
 
+    if batch_size is None:
+        batch_size = int(os.environ.get("BENCH_BATCH_SIZE", 1 << 17))
     env = StreamExecutionEnvironment(Configuration({
         "execution.micro-batch.size": batch_size,
         "state.slot-table.capacity": 1 << 20,
         "state.window-layout": layout,
     }))
     sink = CollectSink()
-    # 200k events/s of event time -> a 2 s slide covers ~400k events, a 10 s
-    # window ~2M, sized against the 1<<20 slot capacity
+    # 100k events/s of event time -> a 2 s slide covers ~200k events, a 10 s
+    # window ~1M; the default 40M records span 400 s of event time = 200 HOP
+    # slide boundaries, so the fire-latency p99 is over >=200 fire samples
+    # (one per watermark advance that closes windows) rather than the ~24
+    # the old geometry produced.
     src = BidSource(total_records=total_records, num_auctions=num_auctions,
-                    events_per_second_of_eventtime=200_000)
+                    events_per_second_of_eventtime=100_000)
     build_q5(env, src, size_ms=10_000, slide_ms=2_000,
              device_top_k=16).sink_to(sink)
     t0 = time.perf_counter()
@@ -138,7 +143,7 @@ def main():
 
     sync_platform()
 
-    total = int(os.environ.get("BENCH_RECORDS", 8_000_000))
+    total = int(os.environ.get("BENCH_RECORDS", 40_000_000))
     # Measure BOTH window-state layouts and report the better one: the
     # pane layout removes the per-fire host->device slot matrix (designed
     # for the tunneled-TPU transfer cost), the slot layout is the measured
@@ -147,9 +152,9 @@ def main():
     best_layout = None
     for layout in ("panes", "slots"):
         try:
-            # Warmup must cover the FIRE path too: at 200k events/s of
+            # Warmup must cover the FIRE path too: at 100k events/s of
             # event time the first HOP window closes at 2 s, so the warmup
-            # needs >400k records for the watermark to cross a window end
+            # needs >200k records for the watermark to cross a window end
             # and compile the fire/merge kernels (at the production
             # num_auctions so the pad buckets match the measured run).
             run(total_records=1 << 21, num_auctions=100_000, layout=layout)

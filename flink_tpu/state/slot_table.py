@@ -43,6 +43,45 @@ from flink_tpu.ops.segment_ops import (
 
 from flink_tpu.core.annotations import internal
 
+
+def _coerce_snapshot_leaf(
+        arr: np.ndarray, want: np.dtype) -> Optional[np.ndarray]:
+    """Cast a snapshot leaf to the aggregate's dtype iff value-preserving.
+
+    Returns the cast array, or None when the cast would lose values.
+    Integer targets get an exact range (and integrality) check instead of
+    relying on numpy's overflow-on-cast side effect; float targets use
+    roundtrip equality (NaN-tolerant) with overflow warnings suppressed —
+    an out-of-range value becomes inf and fails the roundtrip.
+    """
+    if np.issubdtype(want, np.integer):
+        info = np.iinfo(want)
+        if np.issubdtype(arr.dtype, np.floating):
+            if not np.all(np.isfinite(arr)):
+                return None
+            if not np.all(np.trunc(arr) == arr):
+                return None
+            # exact endpoints in float space: info.min and info.max + 1 are
+            # +-2**(bits-1), exactly representable in float64 — a plain
+            # `arr <= info.max` would round the bound UP and let 2**63 wrap
+            lo, hi = float(info.min), float(info.max + 1)
+            if not np.all((arr >= lo) & (arr < hi)):
+                return None
+        else:
+            # integer -> integer: compare extremes as Python ints (exact,
+            # immune to uint64/int64 promotion pitfalls)
+            if int(arr.min()) < info.min or int(arr.max()) > info.max:
+                return None
+        return arr.astype(want)
+    with np.errstate(over="ignore", invalid="ignore"):
+        cast = arr.astype(want)
+        equal_nan = np.issubdtype(arr.dtype, np.inexact)
+        back = cast.astype(arr.dtype)
+        ok = (np.array_equal(back, arr, equal_nan=True) if equal_nan
+              else np.array_equal(back, arr))
+        return cast if ok else None
+
+
 def unique_pairs(
     key_ids: np.ndarray, namespaces: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -1257,8 +1296,8 @@ class SlotTable:
         for i, (arr, leaf) in enumerate(zip(leaves, self.agg.leaves)):
             want = np.dtype(leaf.dtype)
             if len(arr) and arr.dtype != want:
-                cast = arr.astype(want)
-                if not np.array_equal(cast.astype(arr.dtype), arr):
+                cast = _coerce_snapshot_leaf(arr, want)
+                if cast is None:
                     raise RuntimeError(
                         f"state schema incompatible: snapshot leaf_{i} has "
                         f"dtype {arr.dtype}, the aggregate expects {want} "

@@ -251,10 +251,9 @@ class TestKafkaSQL:
                            INTERVAL '1' SECOND, INTERVAL '2' SECONDS))
             GROUP BY key, window_start, window_end
         """)
-        batch = result.collect()
         oracle = _oracle_hop(rows, 2000, 1000)
         got = {}
-        for r in batch.to_rows():
+        for r in result.collect():
             got[(r["key"], r["window_end"])] = r["total"]
         assert set(got) == set(oracle)
         for k in oracle:

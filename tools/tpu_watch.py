@@ -1,0 +1,97 @@
+"""Persistent TPU-backend watcher.
+
+The tunneled TPU backend in this environment comes and goes (it answered in
+round 1, hung in rounds 2-3). This watcher probes it on a loop; the moment a
+probe succeeds it captures the full benchmark playbook on hardware — both
+window layouts, a micro-batch sweep, and a cProfile — and writes everything
+under ``tpu_results/``. Run it in the background for the whole round:
+
+    python tools/tpu_watch.py >> /tmp/tpu_watch.log 2>&1 &
+
+Exit conditions: after a successful capture it keeps probing (a later capture
+overwrites with fresher numbers) unless TPU_WATCH_ONCE=1.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "tpu_results")
+sys.path.insert(0, REPO)
+
+from bench import probe_backend  # noqa: E402  (single probe implementation)
+
+
+def probe(timeout_s=120):
+    t0 = time.time()
+    ok, info = probe_backend(timeouts=(timeout_s,))
+    return ok and info in ("tpu", "axon"), info, time.time() - t0
+
+
+def capture():
+    os.makedirs(OUT, exist_ok=True)
+    stamp = time.strftime("%Y%m%d_%H%M%S")
+    env = dict(os.environ, BENCH_SKIP_PROBE="1")
+    results = {"stamp": stamp, "runs": []}
+
+    # 1. headline bench, both layouts (bench.py does this internally)
+    try:
+        p = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
+                           capture_output=True, text=True, timeout=2400)
+        results["runs"].append({"name": "bench_default", "rc": p.returncode,
+                                "stdout": p.stdout, "stderr": p.stderr[-8000:]})
+    except subprocess.TimeoutExpired:
+        results["runs"].append({"name": "bench_default", "error": "timeout"})
+
+    # 2. micro-batch sweep (smaller record count per point to bound time)
+    for bs in (1 << 15, 1 << 16, 1 << 17, 1 << 18, 1 << 19):
+        e = dict(env, BENCH_RECORDS=str(10_000_000),
+                 BENCH_BATCH_SIZE=str(bs))
+        try:
+            p = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=e,
+                               capture_output=True, text=True, timeout=1200)
+            results["runs"].append({"name": f"sweep_bs_{bs}",
+                                    "rc": p.returncode, "stdout": p.stdout,
+                                    "stderr": p.stderr[-4000:]})
+        except subprocess.TimeoutExpired:
+            results["runs"].append({"name": f"sweep_bs_{bs}",
+                                    "error": "timeout"})
+        with open(os.path.join(OUT, f"capture_{stamp}.json"), "w") as f:
+            json.dump(results, f, indent=1)
+
+    # 3. profile
+    try:
+        p = subprocess.run(
+            [sys.executable, "tools/profile_bench.py", "8000000"], cwd=REPO,
+            env=env, capture_output=True, text=True, timeout=1800)
+        with open(os.path.join(OUT, f"profile_{stamp}.txt"), "w") as f:
+            f.write(p.stderr)
+        results["runs"].append({"name": "profile", "rc": p.returncode})
+    except subprocess.TimeoutExpired:
+        results["runs"].append({"name": "profile", "error": "timeout"})
+
+    with open(os.path.join(OUT, f"capture_{stamp}.json"), "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"[tpu_watch] capture complete -> {OUT}/capture_{stamp}.json",
+          flush=True)
+
+
+def main():
+    interval = int(os.environ.get("TPU_WATCH_INTERVAL_S", "300"))
+    while True:
+        ok, info, dt = probe()
+        print(f"[tpu_watch] {time.strftime('%H:%M:%S')} probe: ok={ok} "
+              f"info={info} dt={dt:.1f}s", flush=True)
+        if ok:
+            with open("/tmp/TPU_UP", "w") as f:
+                f.write(time.strftime("%Y%m%d_%H%M%S"))
+            capture()
+            if os.environ.get("TPU_WATCH_ONCE") == "1":
+                return
+        time.sleep(interval)
+
+
+if __name__ == "__main__":
+    main()
