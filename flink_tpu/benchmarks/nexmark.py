@@ -29,7 +29,11 @@ class BidSource(Source):
     """Synthetic Nexmark bid stream: (auction, bidder, price, ts).
 
     Deterministic and seedable; auction popularity follows a zipf-ish skew
-    like the Nexmark generator's hot-auction bias.
+    like the Nexmark generator's hot-auction bias. Content is a pure
+    function of the GLOBAL record index (counter-based hashing, like
+    DataGenSource), so re-reads, re-batching, and parallel splits all
+    observe the same logical stream — subtasks own disjoint index ranges
+    instead of running N independent generators.
     """
 
     def __init__(self, total_records: int, num_auctions: int = 10_000,
@@ -42,40 +46,64 @@ class BidSource(Source):
         self.rate = events_per_second_of_eventtime
         self.hot_ratio = hot_ratio
         self.seed = seed
-        self._emitted = 0
-        self._rng = np.random.default_rng(seed)
+        self._emitted = 0  # within this subtask's stride
+        self._stride = 1
+        self._offset = 0
+
+    def estimate_records(self):
+        return self.total
 
     def open(self, subtask_index=0, parallelism=1):
-        # full position reset so a re-executed graph replays the stream
-        # (restore_position runs after open on recovery)
+        # STRIDED split of the global index space (subtask k owns indices
+        # k, k+P, k+2P, ...): event time is a function of the global
+        # index, so striding keeps every subtask's watermark advancing
+        # together — a contiguous split would hand each subtask a
+        # disjoint event-time range and stall the combined watermark at
+        # subtask 0's range until end of input. Position reset so a
+        # re-executed graph replays the stream (restore_position runs
+        # after open on recovery).
+        self._stride = max(parallelism, 1)
+        self._offset = subtask_index
         self._emitted = 0
-        self._rng = np.random.default_rng(self.seed + subtask_index)
+
+    def _uniform(self, idx: np.ndarray, salt: int) -> np.ndarray:
+        from flink_tpu.connectors.sources import _splitmix64
+
+        u = _splitmix64(idx, self.seed * 4 + salt)
+        return (u >> np.uint64(11)).astype(np.float64) / (1 << 53)
 
     def poll_batch(self, max_records):
-        if self._emitted >= self.total:
+        own = (self.total - self._offset + self._stride - 1) \
+            // self._stride
+        if self._emitted >= own:
             return None
-        n = min(max_records, self.total - self._emitted)
-        rng = self._rng
-        hot = rng.random(n) < self.hot_ratio
+        n = min(max_records, own - self._emitted)
+        idx = (np.arange(self._emitted, self._emitted + n,
+                         dtype=np.int64) * self._stride + self._offset)
+        self._emitted += n
+        hot = self._uniform(idx, 1) < self.hot_ratio
+        u_auction = self._uniform(idx, 2)
         auctions = np.where(
             hot,
-            rng.integers(0, max(self.num_auctions // 100, 1), n),
-            rng.integers(0, self.num_auctions, n)).astype(np.int64)
-        bidders = rng.integers(0, self.num_bidders, n, dtype=np.int64)
-        prices = (rng.pareto(3.0, n) * 100 + 1).astype(np.float32)
-        idx = np.arange(self._emitted, self._emitted + n, dtype=np.int64)
+            (u_auction * max(self.num_auctions // 100, 1)),
+            (u_auction * self.num_auctions)).astype(np.int64)
+        bidders = (self._uniform(idx, 3)
+                   * self.num_bidders).astype(np.int64)
+        # Pareto(a=3) via inverse transform of the uniform hash — the
+        # same price distribution the Nexmark-style generator used
+        u_price = np.maximum(self._uniform(idx, 4), 1e-12)
+        prices = ((np.power(u_price, -1.0 / 3.0) - 1.0) * 100 + 1
+                  ).astype(np.float32)
         ts = (idx * 1000) // max(self.rate, 1)
-        self._emitted += n
         return RecordBatch.from_pydict(
             {"auction": auctions, "bidder": bidders, "price": prices},
             timestamps=ts)
 
     def snapshot_position(self):
-        return {"emitted": self._emitted, "rng": self._rng.bit_generator.state}
+        return {"emitted": self._emitted}
 
     def restore_position(self, pos):
         self._emitted = pos["emitted"]
-        self._rng.bit_generator.state = pos["rng"]
 
 
 def _window_argmax(field: str):

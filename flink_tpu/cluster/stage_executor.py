@@ -89,30 +89,106 @@ class StageInput:
         self.key_field = key_field
 
 
+class OutSpec:
+    """One outgoing keyed exchange of a producer (a source or a keyed
+    stage): optional stateless branch transformations applied in the
+    producer subtask (key_by routing markers, maps after a fan-out
+    point), then records hash-route on ``key_field`` to ``target_input``
+    of stage ``target_stage``."""
+
+    def __init__(self, key_field: str, target_stage: int,
+                 target_input: int = 0,
+                 branch: Optional[List[Transformation]] = None):
+        self.key_field = key_field
+        self.target_stage = target_stage
+        self.target_input = target_input
+        self.branch = branch or []
+
+
+class SourceSpec:
+    """One physical source: the source transformation, its chained
+    stateless pre-operators (shared by every output), and the outgoing
+    exchanges. Fan-out (multiple outputs) duplicates the stream to every
+    exchange — one subtask reads the split once and routes it everywhere
+    (reference: a source vertex with multiple output JobEdges)."""
+
+    def __init__(self, source: Transformation,
+                 chain: List[Transformation], outputs: List[OutSpec]):
+        self.source = source
+        self.chain = chain
+        self.outputs = outputs
+
+    @property
+    def transformations(self) -> List[Transformation]:
+        out = list(self.chain)
+        for o in self.outputs:
+            out.extend(o.branch)
+        return out
+
+
+class KeyedStage:
+    """One keyed stage of the DAG: the main operator chain (head is the
+    key_by routing marker or a two-input keyed op), optional side-output
+    branches executed in the same subtask, and the outgoing exchanges
+    (empty = terminal, the chain ends in the sink)."""
+
+    def __init__(self, chain: List[Transformation],
+                 side_chains: Optional[
+                     List[Tuple[str, List[Transformation]]]] = None,
+                 num_inputs: int = 1,
+                 outputs: Optional[List[OutSpec]] = None):
+        self.chain = chain
+        #: (tag, chain) branches fed by TaggedBatch outputs of main-chain
+        #: operators; stateless + sink, run inside each subtask
+        self.side_chains = side_chains or []
+        self.num_inputs = num_inputs
+        self.outputs = outputs or []
+
+    @property
+    def out_key_field(self) -> Optional[str]:
+        return self.outputs[0].key_field if self.outputs else None
+
+    @property
+    def operator_transformations(self) -> List[Transformation]:
+        out = list(self.chain)
+        for _, sc in self.side_chains:
+            out.extend(sc)
+        for o in self.outputs:
+            out.extend(o.branch)
+        return out
+
+
 class StagePlan:
-    """Source stage(s) + one keyed stage. One input is the classic linear
-    pipeline; two inputs is the join shape (two sources hash-exchanging
-    into a two-input keyed operator — reference: DefaultExecutionGraph
-    runs any DAG; this covers the two-input keyed family)."""
+    """Source(s) + keyed stages connected by hash exchanges, as a DAG
+    (reference: DefaultExecutionGraph runs any DAG at any per-vertex
+    parallelism). Supported: any number of physical sources with output
+    fan-out, chains of keyed exchanges, one- and two-input keyed stages
+    (joins — fed by sources and/or upstream stages, Q7's diamond), and
+    side-output branches."""
 
-    def __init__(self, source: Optional[Transformation] = None,
-                 pre_chain: Optional[List[Transformation]] = None,
-                 keyed_chain: Optional[List[Transformation]] = None,
-                 key_field: Optional[str] = None,
-                 inputs: Optional[List[StageInput]] = None):
-        if inputs is None:
-            inputs = [StageInput(source, pre_chain or [], key_field)]
-        #: one StageInput per keyed-stage input, in the keyed head
-        #: operator's input order
-        self.inputs = inputs
-        #: keyed operator + everything downstream incl. the sink, chained
-        #: into each keyed subtask
-        self.keyed_chain = keyed_chain or []
+    def __init__(self, source_specs: List[SourceSpec],
+                 stages: List[KeyedStage]):
+        #: one per physical source
+        self.source_specs = source_specs
+        #: keyed stages in topological order; terminal stages end in sinks
+        self.stages = stages
 
-    # single-input views (the linear pipeline's vocabulary)
+    # -- single-input / single-stage compat views (the linear pipeline's
+    # -- and the two-input join's vocabulary, kept for callers/tests)
+    @property
+    def inputs(self) -> List[StageInput]:
+        outs = []
+        for spec in self.source_specs:
+            for o in spec.outputs:
+                if o.target_stage == 0:
+                    outs.append((o.target_input, StageInput(
+                        spec.source, spec.chain + o.branch, o.key_field)))
+        outs.sort(key=lambda x: x[0])
+        return [si for _, si in outs]
+
     @property
     def source(self) -> Transformation:
-        return self.inputs[0].source
+        return self.source_specs[0].source
 
     @property
     def pre_chain(self) -> List[Transformation]:
@@ -122,100 +198,232 @@ class StagePlan:
     def key_field(self) -> str:
         return self.inputs[0].key_field
 
+    @property
+    def keyed_chain(self) -> List[Transformation]:
+        return self.stages[0].chain
+
 
 def plan_stages(graph: StreamGraph) -> StagePlan:
-    """Derive the stage split from the chained JobGraph
+    """Derive the stage DAG from the chained JobGraph
     (flink_tpu/graph/job_graph.py — the StreamingJobGraphGenerator role).
-    Supported shapes: a linear source-stage -> keyed-stage pipeline, and
-    the two-input keyed shape (two sources, each key_by-routed into a
-    two-input keyed head — joins/co-process). Raises StagePlanError for
-    anything else (side outputs, broadcast edges, deeper DAGs) — callers
-    fall back to single-slot execution when configured to."""
-    from flink_tpu.graph.job_graph import HASH, build_job_graph
+
+    Supported shapes: any DAG of physical sources (with output fan-out)
+    and keyed stages connected by hash exchanges — linear pipelines,
+    chains of keyed exchanges (agg -> re-key -> agg), one- and two-input
+    keyed stages (joins fed by sources and/or upstream stages, incl.
+    Q7's diamond), and side-output branches off keyed stages (stateless
+    + sink, executed inside the owning subtask). A ``key_by`` routing
+    marker that could not chain into a two-input consumer becomes a
+    ROUTING vertex: its chain runs producer-side and its key names the
+    exchange (the reference's partitioner-on-the-edge model). Raises
+    StagePlanError for anything else (broadcast edges, rebalance,
+    exchange unions) — callers fall back to single-slot execution when
+    configured to."""
+    from flink_tpu.graph.job_graph import FORWARD, HASH, SIDE, \
+        build_job_graph
+    from flink_tpu.runtime.operators import KeyByOperator
 
     jg = build_job_graph(graph, default_parallelism=1,
                          respect_parallelism=False)
     if not any(e.ship == HASH for e in jg.edges):
         raise StagePlanError("no keyed exchange — nothing to expand")
-    if len(graph.sources) == 2:
-        return _plan_two_input(graph, jg)
-    if len(graph.sources) != 1:
-        raise StagePlanError(
-            "multi-slot mode supports one source (linear pipeline) or "
-            f"two (keyed join); this graph has {len(graph.sources)}")
-    if len(jg.vertices) != 2 or len(jg.edges) != 1:
-        raise StagePlanError(
-            "multi-slot mode supports a linear source-stage -> "
-            "keyed-stage pipeline; this job graph has "
-            f"{len(jg.vertices)} vertices / {len(jg.edges)} exchanges: "
-            + "; ".join(f"[{v.name}]" for v in jg.vertices))
-    edge = jg.edges[0]
-    src_v = jg.vertices[edge.source_vid]
-    keyed_v = jg.vertices[edge.target_vid]
-    if not src_v.is_source:
-        raise StagePlanError("the exchange's producer stage must begin "
-                             "at the source")
-    if keyed_v.tail.kind != "sink":
-        raise StagePlanError("pipeline must end in a sink")
-    return StagePlan(src_v.head, src_v.chained[1:], keyed_v.chained,
-                     edge.key_field)
+    out_edges: Dict[int, List] = {v.vid: [] for v in jg.vertices}
+    in_edges: Dict[int, List] = {v.vid: [] for v in jg.vertices}
+    for e in jg.edges:
+        out_edges[e.source_vid].append(e)
+        in_edges[e.target_vid].append(e)
 
+    def _is_routing_vertex(v) -> bool:
+        """A key_by marker vertex whose single consumer is a two-input
+        stage: it exists only because markers cannot chain into a
+        multi-input head — its chain runs producer-side."""
+        if v.is_source or v.head.kind == "two_input":
+            return False
+        if not v.head.keyed or v.head.key_field is None:
+            return False
+        probe = (v.head.operator_factory()
+                 if v.head.operator_factory else None)
+        if not isinstance(probe, KeyByOperator):
+            return False
+        cons = out_edges[v.vid]
+        return len(cons) == 1 and \
+            jg.vertices[cons[0].target_vid].head.kind == "two_input"
 
-def _plan_two_input(graph: StreamGraph, jg) -> StagePlan:
-    """The join shape: src -> key_by(k_l) \\
-                                            two-input keyed op -> sink
-                       src -> key_by(k_r) /
-    Each input's key_by marker (and any stateless ops chained around it)
-    runs source-side; the hash exchange routes on that input's key field;
-    the two-input operator + downstream run in the keyed subtasks."""
-    from flink_tpu.runtime.operators import KeyByOperator
+    routing = {v.vid: v for v in jg.vertices if _is_routing_vertex(v)}
+    # stage heads: every vertex entered through a hash exchange that is
+    # not a routing vertex, in topological (vid) order
+    stage_heads = []
+    for v in jg.vertices:
+        if v.is_source or v.vid in routing:
+            continue
+        ins = in_edges[v.vid]
+        if ins and all(e.ship == HASH for e in ins):
+            if not (v.head.keyed or v.head.kind == "two_input"):
+                raise StagePlanError(
+                    f"exchange target [{v.name}] does not start at a "
+                    "keyed operator — only keyed stages shard by key "
+                    "group")
+            stage_heads.append(v)
+    stage_index = {v.vid: m for m, v in enumerate(stage_heads)}
+    used: set = set(routing)
 
-    two_in = [v for v in jg.vertices if v.head.kind == "two_input"]
-    if len(two_in) != 1:
+    def _resolve_exchange(e) -> OutSpec:
+        """A HASH (or partition-preserving FORWARD) edge out of a
+        producer -> the OutSpec it denotes: either directly into a
+        one-input stage head, or through a routing vertex into one input
+        of a two-input stage."""
+        tv = jg.vertices[e.target_vid]
+        if tv.vid in routing:
+            kv2 = jg.vertices[out_edges[tv.vid][0].target_vid]
+            if kv2.vid not in stage_index:
+                raise StagePlanError(
+                    f"routing vertex [{tv.name}] feeds [{kv2.name}], "
+                    "which is not a keyed stage")
+            idx = next((i for i, it in enumerate(kv2.head.inputs)
+                        if it.uid == tv.tail.uid), None)
+            if idx is None:
+                raise StagePlanError(
+                    f"routing vertex [{tv.name}] is not an input of "
+                    f"[{kv2.name}]")
+            return OutSpec(e.key_field or tv.head.key_field,
+                           stage_index[kv2.vid], idx,
+                           branch=list(tv.chained))
+        if tv.vid in stage_index:
+            if tv.head.kind == "two_input":
+                raise StagePlanError(
+                    f"two-input stage [{tv.name}] must be fed through "
+                    "key_by routing vertices (one per input)")
+            if e.key_field is None:
+                raise StagePlanError(
+                    f"keyed exchange into [{tv.name}] has no key field")
+            return OutSpec(e.key_field, stage_index[tv.vid], 0)
         raise StagePlanError(
-            "two-source stage mode requires exactly one two-input keyed "
-            f"operator; found {len(two_in)}")
-    kv = two_in[0]
-    if kv.tail.kind != "sink":
-        raise StagePlanError("pipeline must end in a sink")
-    head = kv.head
-    if not head.keyed:
+            f"unsupported exchange target [{tv.name}]")
+
+    def _walk_outputs(head_v):
+        """From a stage head, absorb FORWARD continuations into the
+        chain and SIDE branches into side_chains; every HASH edge (and
+        FORWARD edge into a routing vertex) becomes an outgoing
+        exchange. Returns (chain, side_chains, outputs)."""
+        chain = list(head_v.chained)
+        side_chains: List[Tuple[str, List[Transformation]]] = []
+        exchange_edges = []
+        cur = head_v
+        used.add(cur.vid)
+        while True:
+            outs = out_edges[cur.vid]
+            fwd, side, hashed, other = [], [], [], []
+            for e in outs:
+                if e.ship == HASH or (
+                        e.ship == FORWARD and e.target_vid in routing):
+                    hashed.append(e)
+                elif e.ship == FORWARD:
+                    fwd.append(e)
+                elif e.ship == SIDE:
+                    side.append(e)
+                else:
+                    other.append(e)
+            if other:
+                raise StagePlanError(
+                    f"unsupported exchange {other[0].ship} out of "
+                    f"[{cur.name}]")
+            for e in side:
+                sv = jg.vertices[e.target_vid]
+                if out_edges[sv.vid]:
+                    raise StagePlanError(
+                        f"side-output branch [{sv.name}] must end in a "
+                        "sink (no further exchanges)")
+                if sv.tail.kind != "sink":
+                    raise StagePlanError(
+                        f"side-output branch [{sv.name}] must end in a "
+                        "sink")
+                if any(t.keyed for t in sv.chained):
+                    raise StagePlanError(
+                        f"side-output branch [{sv.name}] re-keys — "
+                        "keyed side branches are not supported in stage "
+                        "mode")
+                used.add(sv.vid)
+                side_chains.append((sv.head.side_tag, sv.chained))
+            exchange_edges.extend(hashed)
+            if len(fwd) > 1:
+                raise StagePlanError(
+                    f"[{cur.name}] has multiple forward continuations — "
+                    "not a supported DAG shape")
+            if fwd:
+                cur = jg.vertices[fwd[0].target_vid]
+                used.add(cur.vid)
+                chain.extend(cur.chained)
+                continue
+            break
+        return chain, side_chains, [
+            _resolve_exchange(e) for e in exchange_edges]
+
+    # physical sources
+    source_specs: List[SourceSpec] = []
+    for v in jg.vertices:
+        if not v.is_source:
+            continue
+        chain, side_chains, outputs = _walk_outputs(v)
+        if side_chains:
+            raise StagePlanError(
+                f"side outputs on the source stage [{v.name}] are not "
+                "supported — move the split after the keyed exchange")
+        if not outputs:
+            raise StagePlanError(
+                f"source [{v.name}] feeds no keyed exchange")
+        if chain[-1].kind == "sink":
+            raise StagePlanError(
+                f"source stage [{v.name}] ends in a sink — nothing to "
+                "expand on that branch")
+        source_specs.append(SourceSpec(v.head, chain[1:], outputs))
+
+    # keyed stages
+    stages: List[KeyedStage] = []
+    for m, head_v in enumerate(stage_heads):
+        chain, side_chains, outputs = _walk_outputs(head_v)
+        num_inputs = 2 if head_v.head.kind == "two_input" else 1
+        if num_inputs == 1 and len(in_edges[head_v.vid]) != 1:
+            raise StagePlanError(
+                f"stage [{head_v.name}] has {len(in_edges[head_v.vid])} "
+                "producers — unioning exchanges into one keyed input is "
+                "not supported")
+        if not outputs and chain[-1].kind != "sink":
+            raise StagePlanError("pipeline must end in a sink")
+        stages.append(KeyedStage(chain, side_chains=side_chains,
+                                 num_inputs=num_inputs, outputs=outputs))
+    if not stages:
+        raise StagePlanError("no keyed stage")
+
+    # every stage input must be fed exactly once
+    feeds: Dict[Tuple[int, int], int] = {}
+    for spec in source_specs:
+        for o in spec.outputs:
+            feeds[(o.target_stage, o.target_input)] = feeds.get(
+                (o.target_stage, o.target_input), 0) + 1
+    for m, stage in enumerate(stages):
+        for o in stage.outputs:
+            if o.target_stage <= m:
+                raise StagePlanError(
+                    "exchange cycles are not supported")
+            feeds[(o.target_stage, o.target_input)] = feeds.get(
+                (o.target_stage, o.target_input), 0) + 1
+    for m, stage in enumerate(stages):
+        for i in range(stage.num_inputs):
+            if feeds.get((m, i), 0) != 1:
+                raise StagePlanError(
+                    f"stage {m} input {i} is fed by "
+                    f"{feeds.get((m, i), 0)} exchanges (must be exactly "
+                    "one)")
+
+    # every vertex must be part of the plan — an unreachable/unsupported
+    # branch must fail, not silently drop
+    missing = [v for v in jg.vertices if v.vid not in used]
+    if missing:
         raise StagePlanError(
-            f"two-input operator {head.name!r} is not keyed — only keyed "
-            "two-input stages shard by key group")
-    if len(jg.vertices) != 5:
-        raise StagePlanError(
-            "two-source stage mode supports exactly src -> key_by -> "
-            f"join -> sink per branch; this job graph has "
-            f"{len(jg.vertices)} vertices: "
-            + "; ".join(f"[{v.name}]" for v in jg.vertices))
-    inputs: List[StageInput] = []
-    for in_t in head.inputs:
-        mv = jg.vertex_of(in_t)
-        if mv.vid == kv.vid or mv.tail.uid != in_t.uid:
-            raise StagePlanError(
-                f"join input {in_t.name!r} is not the tail of its own "
-                "stage vertex")
-        probe = (mv.head.operator_factory()
-                 if mv.head.operator_factory else None)
-        if not isinstance(probe, KeyByOperator) or \
-                mv.head.key_field is None:
-            raise StagePlanError(
-                "each join input must be keyed (key_by -> join); input "
-                f"vertex [{mv.name}] does not start at a key_by marker")
-        feeders = [e for e in jg.edges if e.target_vid == mv.vid]
-        if len(feeders) != 1:
-            raise StagePlanError(
-                f"join input vertex [{mv.name}] must have exactly one "
-                "producer")
-        sv = jg.vertices[feeders[0].source_vid]
-        if not sv.is_source:
-            raise StagePlanError(
-                f"join input [{mv.name}] must begin at a source")
-        inputs.append(StageInput(sv.head,
-                                 sv.chained[1:] + mv.chained,
-                                 mv.head.key_field))
-    return StagePlan(inputs=inputs, keyed_chain=kv.chained)
+            "graph has vertices outside the supported source -> keyed-"
+            "stage DAG shape: "
+            + "; ".join(f"[{v.name}]" for v in missing))
+    return StagePlan(source_specs, stages)
 
 
 # ---------------------------------------------------------------------------
@@ -262,6 +470,9 @@ def _merge_values(key: str, values: List[Any]):
         return any(values)
     if key == "pending":
         return sorted({x for v in values for x in v})
+    if key in ("um_keys", "um_rows"):
+        # upsert-materializer images: key-disjoint lists across subtasks
+        return [x for v in values for x in v]
     if key in ("slice_last_window", "sessions", "key_values"):
         merged: Dict = {}
         for v in values:
@@ -352,24 +563,58 @@ class _SharedSink:
 
 class _OperatorChain:
     """The fused operator chain of one subtask (reference: OperatorChain —
-    direct method-call hand-off between chained operators)."""
+    direct method-call hand-off between chained operators).
+
+    ``side_chains`` maps side-output tags to branch chains run in the same
+    subtask: a TaggedBatch emitted by any main-chain operator is diverted
+    to the matching branch (reference: OutputTag routing in OperatorChain)
+    instead of continuing down the main chain. process_batch /
+    process_watermark / close RETURN the batches that survive past the
+    last main-chain operator — empty when the tail is a sink, the
+    downstream-exchange payload for intermediate keyed stages."""
 
     def __init__(self, transformations: Sequence[Transformation],
                  ctx: OperatorContext,
-                 shared_sinks: Optional[Dict[int, _SharedSink]] = None):
+                 shared_sinks: Optional[Dict[int, _SharedSink]] = None,
+                 side_chains: Optional[
+                     List[Tuple[str, Sequence[Transformation]]]] = None):
         self.transformations = list(transformations)
         self.operators = []
+        self._shared_sinks = shared_sinks
         for t in self.transformations:
-            op = t.operator_factory() if t.operator_factory else None
-            if op is not None:
-                if shared_sinks is not None and hasattr(op, "sink"):
-                    # every subtask's factory captured the same sink
-                    # object — route all of them through one refcounted,
-                    # locked facade (see _SharedSink)
-                    op.sink = shared_sinks.setdefault(
-                        t.uid, _SharedSink(op.sink))
-                op.open(ctx)
-            self.operators.append(op)
+            self.operators.append(self._make_operator(t, ctx))
+        self.side_chains: Dict[str, _OperatorChain] = {}
+        for tag, sc in (side_chains or []):
+            self.side_chains[tag] = _OperatorChain(
+                sc, ctx, shared_sinks=shared_sinks)
+
+    def _make_operator(self, t: Transformation, ctx: OperatorContext):
+        op = t.operator_factory() if t.operator_factory else None
+        if op is not None:
+            if self._shared_sinks is not None and hasattr(op, "sink"):
+                # every subtask's factory captured the same sink
+                # object — route all of them through one refcounted,
+                # locked facade (see _SharedSink)
+                op.sink = self._shared_sinks.setdefault(
+                    t.uid, _SharedSink(op.sink))
+            op.open(ctx)
+        return op
+
+    def _route(self, outs: List) -> List[RecordBatch]:
+        """Divert TaggedBatch outputs to their side branch; return the
+        main-stream batches."""
+        from flink_tpu.runtime.process import TaggedBatch
+
+        main: List[RecordBatch] = []
+        for b in outs:
+            if isinstance(b, TaggedBatch):
+                branch = self.side_chains.get(b.tag.name)
+                if branch is not None:
+                    branch.process_batch(b.batch)
+                # unmatched tags drop, like the single-slot router
+            else:
+                main.append(b)
+        return main
 
     def process_batch(self, batch: RecordBatch,
                       input_index: int = 0) -> List[RecordBatch]:
@@ -385,21 +630,25 @@ class _OperatorChain:
                 # output stream
                 nxt.extend(op.process_batch(b, input_index if head else 0))
             head = False
-            outs = nxt
+            outs = self._route(nxt)
             if not outs:
                 break
         return outs
 
-    def process_watermark(self, wm: int) -> None:
+    def process_watermark(self, wm: int) -> List[RecordBatch]:
+        """Advance the watermark through the chain; batches an operator
+        fires are fed to the operators AFTER it (then the watermark), and
+        whatever survives past the tail is returned."""
         carried: List[RecordBatch] = []
         for op in self.operators:
             if op is None:
                 continue
+            nxt: List[RecordBatch] = []
             for b in carried:
-                op.process_batch(b)
-            carried = op.process_watermark(wm)
-        # trailing emissions past the last operator are dropped only if the
-        # last op emitted (sinks emit nothing)
+                nxt.extend(op.process_batch(b))
+            nxt.extend(op.process_watermark(wm))
+            carried = self._route(nxt)
+        return carried
 
     @property
     def uses_processing_time(self) -> bool:
@@ -423,21 +672,26 @@ class _OperatorChain:
                     nxt: List[RecordBatch] = []
                     for b in cur:
                         nxt.extend(op2.process_batch(b))
-                    cur = nxt
+                    cur = self._route(nxt)
                     if not cur:
                         break
                 if emit is not None:
                     for b in cur:
                         emit(b)
 
-    def close(self) -> None:
+    def close(self) -> List[RecordBatch]:
         carried: List[RecordBatch] = []
         for op in self.operators:
             if op is None:
                 continue
+            nxt: List[RecordBatch] = []
             for b in carried:
-                op.process_batch(b)
-            carried = op.close()
+                nxt.extend(op.process_batch(b))
+            nxt.extend(op.close())
+            carried = self._route(nxt)
+        for branch in self.side_chains.values():
+            branch.close()
+        return carried
 
     def dispose(self) -> None:
         for op in self.operators:
@@ -446,6 +700,8 @@ class _OperatorChain:
                     op.dispose()
                 except Exception:
                     pass
+        for branch in self.side_chains.values():
+            branch.dispose()
 
     def snapshot(self, graph: StreamGraph, savepoint: bool = False
                  ) -> Dict[str, Any]:
@@ -459,10 +715,14 @@ class _OperatorChain:
                 state = op.snapshot_state()
             if state:
                 snap[graph.stable_id(t)] = state
+        for branch in self.side_chains.values():
+            snap.update(branch.snapshot(graph, savepoint=savepoint))
         return snap
 
     def restore(self, graph: StreamGraph, states: Dict[str, Any],
                 key_group_filter=None) -> None:
+        for branch in self.side_chains.values():
+            branch.restore(graph, states, key_group_filter=key_group_filter)
         for t, op in zip(self.transformations, self.operators):
             if op is None:
                 continue
@@ -521,35 +781,129 @@ def _local_combiner_factory(plan: StagePlan):
     return factory
 
 
+class _OutputRoute:
+    """One outgoing keyed exchange of a producer subtask (source or
+    keyed): optional stateless branch operators (key_by routing markers,
+    post-fan-out maps) run here, then records hash-route on the exchange
+    key to the consuming stage's subtasks — the ONE keyBy routing
+    implementation (reference: KeyGroupStreamPartitioner.selectChannel +
+    RecordWriter). In batch mode sub-batches coalesce into bulk blocks
+    per subpartition (the SortMergeResultPartition role)."""
+
+    def __init__(self, out: OutSpec, writer, num_channels: int,
+                 max_parallelism: int, ctx: OperatorContext,
+                 batch_mode: bool = False, batch_size: int = 0,
+                 combiner=None, recompute_key_id: bool = False):
+        from flink_tpu.runtime.shuffle_spi import KeyGroupPartitioner
+
+        self.out = out
+        self.writer = writer
+        self.num_channels = num_channels
+        self.batch_mode = batch_mode
+        self.batch_size = batch_size
+        #: two-phase agg, local half: at most one row per (key, slice)
+        #: leaves this subtask per batch (flink_tpu/runtime/local_agg.py)
+        self.combiner = combiner
+        #: routes OUT OF a keyed stage must re-hash: the batch carries
+        #: the PREVIOUS exchange's __key_id__. Source routes reuse a
+        #: present __key_id__ (the key_by marker / local combiner
+        #: computed it from this same key field — local_agg.py:95), and
+        #: a branch whose own key_by marker re-keys on THIS exchange's
+        #: field has already rewritten __key_id__ — recomputing would
+        #: hash every row twice
+        if recompute_key_id and any(
+                t.keyed and t.key_field == out.key_field
+                for t in out.branch):
+            recompute_key_id = False
+        self.recompute_key_id = recompute_key_id
+        self.chain = _OperatorChain(out.branch, ctx) if out.branch \
+            else None
+        self._partitioner = KeyGroupPartitioner("__key_id__",
+                                                max_parallelism)
+        self._pending: Dict[int, List[RecordBatch]] = {}
+        self._pending_rows: Dict[int, int] = {}
+        self.records_out = 0
+
+    def process(self, batch: RecordBatch) -> None:
+        from flink_tpu.state.keygroups import hash_keys_to_i64
+
+        batches = self.chain.process_batch(batch) if self.chain \
+            else [batch]
+        for b in batches:
+            if self.combiner is not None:
+                b = self.combiner.combine(b)
+            if self.out.key_field not in b.columns:
+                raise _SubtaskFailure(
+                    f"exchange key field {self.out.key_field!r} missing "
+                    f"from batch columns {b.names()}")
+            if self.recompute_key_id or "__key_id__" not in b.columns:
+                # ints are identity under hash_keys_to_i64, so routing
+                # and downstream state share one key identity
+                b = b.with_column(
+                    "__key_id__",
+                    hash_keys_to_i64(b[self.out.key_field]))
+            for sub, part in self._partitioner.partition(
+                    b, self.num_channels):
+                self.records_out += len(part)
+                if not self.batch_mode:
+                    self.writer.emit(sub, part)
+                    continue
+                # batch mode: coalesce into bulk blocks (fewer, larger
+                # transfers — the batch-shuffle trade)
+                self._pending.setdefault(sub, []).append(part)
+                n = self._pending_rows.get(sub, 0) + len(part)
+                if n >= self.batch_size:
+                    self.writer.emit(sub, RecordBatch.concat(
+                        self._pending.pop(sub)))
+                    self._pending_rows[sub] = 0
+                else:
+                    self._pending_rows[sub] = n
+
+    def flush(self) -> None:
+        for sub, parts in sorted(self._pending.items()):
+            if parts:
+                self.writer.emit(sub, RecordBatch.concat(parts))
+        self._pending.clear()
+        self._pending_rows.clear()
+
+    def broadcast(self, event) -> None:
+        self.writer.broadcast_event(event)
+
+    def close(self) -> None:
+        self.writer.close()
+
+    def snapshot(self, graph, savepoint: bool = False) -> Dict[str, Any]:
+        return self.chain.snapshot(graph, savepoint=savepoint) \
+            if self.chain else {}
+
+    def restore(self, graph, states, key_group_filter=None) -> None:
+        if self.chain:
+            self.chain.restore(graph, states,
+                               key_group_filter=key_group_filter)
+
+
 class _SourceSubtask(threading.Thread):
     """One source-stage subtask: polls its source split, applies the
-    pre-chain, partitions by key group, emits through the shuffle —
-    optionally collapsing each batch to per-(key, slice) partial
-    aggregates first (two-phase agg; flink_tpu/runtime/local_agg.py)."""
+    shared pre-chain, and emits every batch through each of its output
+    routes (fan-out duplicates the stream; each route applies its branch
+    ops and hash-partitions on its own exchange key)."""
 
-    def __init__(self, index: int, parallelism: int, spec: StageInput,
-                 graph: StreamGraph, writer, num_keyed: int,
+    def __init__(self, index: int, parallelism: int, spec: SourceSpec,
+                 graph: StreamGraph, routes: List[_OutputRoute],
                  max_parallelism: int, batch_size: int,
                  coordinator: "_Coordinator", source,
                  restore_position=None, batch_mode: bool = False,
-                 combiner=None, input_index: int = 0):
-        self.combiner = combiner
+                 source_index: int = 0):
         self.spec = spec
-        self.input_index = input_index
+        self.source_index = source_index
         super().__init__(
-            name=f"source-subtask-in{input_index}-{index}", daemon=True)
-        #: bounded/batch execution: no intermediate watermarks, and
-        #: sub-batches coalesce into bulk blocks per subpartition before
-        #: emission (the SortMergeResultPartition role — batch shuffle
-        #: optimizes for throughput, not latency)
+            name=f"source-subtask-s{source_index}-{index}", daemon=True)
+        #: bounded/batch execution: no intermediate watermarks
         self.batch_mode = batch_mode
-        self._pending: Dict[int, List[RecordBatch]] = {}
-        self._pending_rows: Dict[int, int] = {}
         self.index = index
         self.parallelism = parallelism
         self.graph = graph
-        self.writer = writer
-        self.num_keyed = num_keyed
+        self.routes = routes
         self.max_parallelism = max_parallelism
         self.batch_size = batch_size
         self.coordinator = coordinator
@@ -559,19 +913,15 @@ class _SourceSubtask(threading.Thread):
         self.error: Optional[BaseException] = None
         self.wm_gen = spec.source.watermark_strategy.create()
         self.chain: Optional[_OperatorChain] = None
-        self.records_out = 0
         self.records_polled = 0
         self.batches_polled = 0
-        from flink_tpu.runtime.shuffle_spi import KeyGroupPartitioner
-
-        # routes on the pre-hashed __key_id__ column (ints are identity
-        # under hash_keys_to_i64), so routing and downstream state use the
-        # same key identity
-        self._partitioner = KeyGroupPartitioner("__key_id__",
-                                                max_parallelism)
         #: position at exit — checkpoints after this subtask drains its
         #: split still record where it ended (restore must not replay it)
         self.final_position = None
+
+    @property
+    def records_out(self) -> int:
+        return sum(r.records_out for r in self.routes)
 
     def run(self) -> None:
         try:
@@ -580,16 +930,19 @@ class _SourceSubtask(threading.Thread):
             self.error = e
             self.coordinator.subtask_failed(self, e)
 
+    def _emit(self, batch: RecordBatch) -> None:
+        for r in self.routes:
+            r.process(batch)
+
     def _run(self) -> None:
         spec = self.spec
         ctx = OperatorContext(operator_index=self.index,
                               parallelism=1,
                               max_parallelism=self.max_parallelism)
-        self.chain = _OperatorChain(spec.pre_chain, ctx)
+        self.chain = _OperatorChain(spec.chain, ctx)
         self.source.open(self.index, self.parallelism)
         if self.restore_position is not None:
             self.source.restore_position(self.restore_position)
-        key_field = spec.key_field
         stopping = False
         ticks_pt = self.chain.uses_processing_time
         try:
@@ -604,8 +957,7 @@ class _SourceSubtask(threading.Thread):
                     # clock even between batches (parity with the
                     # single-slot executor's tick)
                     self.chain.tick_processing_time(
-                        int(time.time() * 1000),
-                        emit=lambda b: self._emit_partitioned(b, key_field))
+                        int(time.time() * 1000), emit=self._emit)
                 batch = self.source.poll_batch(self.batch_size)
                 if batch is None:
                     break
@@ -617,61 +969,31 @@ class _SourceSubtask(threading.Thread):
                     batch)
                 wm = self.wm_gen.on_batch(batch)
                 for out in self.chain.process_batch(batch):
-                    self._emit_partitioned(out, key_field)
+                    self._emit(out)
                 if wm is not None and not self.batch_mode:
-                    self.writer.broadcast_event(int(wm))
+                    for r in self.routes:
+                        r.broadcast(int(wm))
         finally:
             self.final_position = self.source.snapshot_position()
             self.source.close()
-        self._flush_pending()
+        for r in self.routes:
+            r.flush()
         # a barrier enqueued while this loop was finishing must still be
         # served (position + ack + in-band broadcast) before EOP — the
         # coordinator synthesizes acks only for barriers that arrive after
         # the thread is observably dead
         self._serve_control()
-        self.writer.broadcast_event(MAX_WATERMARK)
-        self.writer.close()
+        for r in self.routes:
+            r.broadcast(MAX_WATERMARK)
+            r.close()
 
-    def _emit_partitioned(self, batch: RecordBatch, key_field: str) -> None:
-        from flink_tpu.state.keygroups import hash_keys_to_i64
-
-        if key_field not in batch.columns:
-            raise _SubtaskFailure(
-                f"key field {key_field!r} missing from batch columns "
-                f"{batch.names()}")
-        if self.combiner is not None:
-            # two-phase agg, local half: at most one row per (key, slice)
-            # leaves this subtask per batch — hot keys collapse here
-            # before they converge on the owning keyed subtask
-            batch = self.combiner.combine(batch)
-        if "__key_id__" not in batch.columns:
-            batch = batch.with_column("__key_id__",
-                                      hash_keys_to_i64(batch[key_field]))
-        # the ONE keyBy routing implementation (reference:
-        # KeyGroupStreamPartitioner.selectChannel)
-        for sub, part in self._partitioner.partition(batch,
-                                                     self.num_keyed):
-            self.records_out += len(part)
-            if not self.batch_mode:
-                self.writer.emit(sub, part)
-                continue
-            # batch mode: coalesce into bulk blocks (fewer, larger
-            # transfers — the batch-shuffle trade)
-            self._pending.setdefault(sub, []).append(part)
-            n = self._pending_rows.get(sub, 0) + len(part)
-            if n >= self.batch_size:
-                self.writer.emit(sub, RecordBatch.concat(
-                    self._pending.pop(sub)))
-                self._pending_rows[sub] = 0
-            else:
-                self._pending_rows[sub] = n
-
-    def _flush_pending(self) -> None:
-        for sub, parts in sorted(self._pending.items()):
-            if parts:
-                self.writer.emit(sub, RecordBatch.concat(parts))
-        self._pending.clear()
-        self._pending_rows.clear()
+    def snapshot_operators(self, graph, savepoint: bool = False
+                           ) -> Dict[str, Any]:
+        snap = self.chain.snapshot(graph, savepoint=savepoint) \
+            if self.chain else {}
+        for r in self.routes:
+            snap.update(r.snapshot(graph, savepoint=savepoint))
+        return snap
 
     def _serve_control(self) -> bool:
         """Returns True when the job should stop (stop-with-savepoint)."""
@@ -683,16 +1005,18 @@ class _SourceSubtask(threading.Thread):
                 return stopping
             barrier: Barrier = trigger
             snap = {"position": self.source.snapshot_position(),
-                    "operators": self.chain.snapshot(
-                        self.graph, savepoint=barrier.savepoint is not None)}
+                    "operators": self.snapshot_operators(
+                        self.graph,
+                        savepoint=barrier.savepoint is not None)}
             self.coordinator.ack(barrier.checkpoint_id,
-                                 ("source", self.input_index, self.index),
+                                 ("source", self.source_index, self.index),
                                  snap)
             # coalesced batch-mode blocks hold pre-barrier records — they
             # must reach the channels BEFORE the barrier or they would be
             # cut out of the snapshot yet covered by the position
-            self._flush_pending()
-            self.writer.broadcast_event(barrier)
+            for r in self.routes:
+                r.flush()
+                r.broadcast(barrier)
             if barrier.stop:
                 stopping = True
 
@@ -702,17 +1026,31 @@ class _KeyedSubtask(threading.Thread):
     PER INPUT with per-channel watermarking and aligned barriers spanning
     every channel of every gate (reference:
     SingleCheckpointBarrierHandler aligns across all input channels of a
-    multi-input task)."""
+    multi-input task). An INTERMEDIATE stage's subtask additionally owns a
+    downstream partition: main-chain output is re-keyed on the stage's
+    out_key_field and hash-exchanged to the next stage, and watermarks /
+    aligned barriers / end-of-partition forward in-band (reference: a
+    non-sink Task's RecordWriter + barrier forwarding)."""
 
-    def __init__(self, index: int, parallelism: int, plan: StagePlan,
+    def __init__(self, index: int, parallelism: int, stage: KeyedStage,
                  graph: StreamGraph, gates, max_parallelism: int,
                  coordinator: "_Coordinator", config: Configuration,
-                 shared_sinks: Optional[Dict[int, _SharedSink]] = None):
-        super().__init__(name=f"keyed-subtask-{index}", daemon=True)
+                 shared_sinks: Optional[Dict[int, _SharedSink]] = None,
+                 stage_index: int = 0,
+                 routes: Optional[List[_OutputRoute]] = None,
+                 mesh_devices: int = 0):
+        super().__init__(
+            name=f"keyed-subtask-st{stage_index}-{index}", daemon=True)
         self.shared_sinks = shared_sinks
         self.index = index
         self.parallelism = parallelism
-        self.plan = plan
+        self.stage = stage
+        self.stage_index = stage_index
+        #: outgoing exchanges (empty: terminal stage, sink in-chain)
+        self.routes = routes or []
+        #: devices per subtask for the mesh x stage composition (0 = one
+        #: device per subtask)
+        self.mesh_devices = mesh_devices
         self.graph = graph
         #: one gate per keyed-stage input, in head-operator input order
         self.gates = list(gates) if isinstance(gates, (list, tuple)) \
@@ -728,6 +1066,14 @@ class _KeyedSubtask(threading.Thread):
         self.records_in = 0
         self._restore_states: Optional[Dict[str, Any]] = None
 
+    @property
+    def records_out(self) -> int:
+        return sum(r.records_out for r in self.routes)
+
+    def _emit_downstream(self, batch: RecordBatch) -> None:
+        for r in self.routes:
+            r.process(batch)
+
     def run(self) -> None:
         try:
             self._run()
@@ -738,11 +1084,43 @@ class _KeyedSubtask(threading.Thread):
     def _run(self) -> None:
         ctx = OperatorContext(operator_index=self.index, parallelism=1,
                               max_parallelism=self.max_parallelism)
-        self.chain = _OperatorChain(self.plan.keyed_chain, ctx,
-                                    shared_sinks=self.shared_sinks)
+        if self.mesh_devices > 1:
+            # mesh x stage composition: this subtask opens its keyed
+            # engine over a private sub-mesh — subtasks distribute across
+            # slots/hosts, the sub-mesh distributes across chips within
+            # the subtask (see MeshWindowEngine key_group_range)
+            import jax
+
+            from flink_tpu.parallel.mesh import make_mesh
+
+            devs = jax.devices()
+            # reactive clamp (a mesh must not contain one device twice):
+            # at most len(devs) distinct devices per sub-mesh; subtasks
+            # whose windows overlap simply share devices across their
+            # separate meshes, which is fine
+            D = min(self.mesh_devices, len(devs))
+            if D < self.mesh_devices:
+                import warnings
+
+                warnings.warn(
+                    f"execution.stage-mesh-devices={self.mesh_devices} "
+                    f"clamped to the {len(devs)} available devices",
+                    stacklevel=2)
+            lo = (self.index * D) % len(devs)
+            sub_devs = [devs[(lo + d) % len(devs)] for d in range(D)]
+            ctx.parallelism = D
+            ctx.mesh = make_mesh(devices=sub_devs)
+            ctx.key_group_range = (self.key_groups.start,
+                                   self.key_groups[-1])
+        self.chain = _OperatorChain(self.stage.chain, ctx,
+                                    shared_sinks=self.shared_sinks,
+                                    side_chains=self.stage.side_chains)
         if self._restore_states is not None:
             self.chain.restore(self.graph, self._restore_states,
                                key_group_filter=set(self.key_groups))
+            for r in self.routes:
+                r.restore(self.graph, self._restore_states,
+                          key_group_filter=set(self.key_groups))
         gates = self.gates
         K = len(gates)
         # flat channel addressing across gates: (gate, ch) -> slot
@@ -762,26 +1140,56 @@ class _KeyedSubtask(threading.Thread):
             return min((MAX_WATERMARK if done[c] else chan_wm[c])
                        for c in range(total))
 
+        downstream = bool(self.routes)
+
+        def forward(outs) -> None:
+            if downstream:
+                for b in outs:
+                    if len(b):
+                        self._emit_downstream(b)
+            # terminal stage: sink is in-chain; trailing output dropped
+
         def process(item, gi: int, slot: int):
             nonlocal combined, stopping
             if isinstance(item, RecordBatch):
                 self.records_in += len(item)
-                for out in self.chain.process_batch(item, input_index=gi):
-                    pass  # sink is in-chain; trailing output dropped
+                forward(self.chain.process_batch(item, input_index=gi))
             elif isinstance(item, int):
                 chan_wm[slot] = max(chan_wm[slot], item)
                 new = combined_wm()
                 if new > combined:
                     combined = new
-                    self.chain.process_watermark(combined)
+                    forward(self.chain.process_watermark(combined))
+                    # results precede the watermark that fired them
+                    for r in self.routes:
+                        r.broadcast(int(combined))
 
         def aligned_snapshot_ack() -> bool:
-            """Snapshot + ack the aligning barrier; returns stop flag."""
-            snap = {"operators": self.chain.snapshot(
-                self.graph, savepoint=aligning.savepoint is not None)}
+            """Snapshot + ack the aligning barrier, then forward it
+            downstream (barriers flow through the whole pipeline before
+            any post-barrier data); returns stop flag."""
+            snap = self.chain.snapshot(
+                self.graph, savepoint=aligning.savepoint is not None)
+            for r in self.routes:
+                snap.update(r.snapshot(
+                    self.graph, savepoint=aligning.savepoint is not None))
             self.coordinator.ack(aligning.checkpoint_id,
-                                 ("keyed", self.index), snap)
+                                 ("keyed", self.stage_index, self.index),
+                                 {"operators": snap})
+            for r in self.routes:
+                r.flush()
+                r.broadcast(aligning)
             return aligning.stop
+
+        def finish() -> None:
+            """End of all inputs: flush remaining windows through the
+            chain, forward downstream, and close the exchanges."""
+            outs = self.chain.close()
+            forward(outs)
+            for r in self.routes:
+                r.flush()
+                r.broadcast(MAX_WATERMARK)
+                r.close()
 
         ticks_pt = self.chain.uses_processing_time
         while True:
@@ -789,7 +1197,9 @@ class _KeyedSubtask(threading.Thread):
             if self.coordinator.cancelled.is_set():
                 return
             if ticks_pt:
-                self.chain.tick_processing_time(int(time.time() * 1000))
+                self.chain.tick_processing_time(
+                    int(time.time() * 1000),
+                    emit=(self._emit_downstream if downstream else None))
             # non-blocking sweep of every gate first — an idle/exhausted
             # input must not throttle a live one; only when ALL gates are
             # empty does one (rotating) gate take a short blocking poll
@@ -824,7 +1234,11 @@ class _KeyedSubtask(threading.Thread):
                         process(bitem, bgi, bslot)
                     buffered = []
                     if stopping:
+                        # stop-with-savepoint: close WITHOUT forwarding —
+                        # post-savepoint output would duplicate on resume
                         self.chain.close()
+                        for r in self.routes:
+                            r.close()
                         return
                 continue
             if item is END_OF_PARTITION:
@@ -838,22 +1252,26 @@ class _KeyedSubtask(threading.Thread):
                         # post-savepoint output would duplicate on resume
                         aligning = None
                         self.chain.close()
+                        for r in self.routes:
+                            r.close()
                         return
                     aligning = None
                     for bgi, bslot, bitem in buffered:
                         process(bitem, bgi, bslot)
                     buffered = []
                 if all(done):
-                    new = MAX_WATERMARK
-                    if new > combined:
-                        self.chain.process_watermark(new)
-                    self.chain.close()
+                    if MAX_WATERMARK > combined:
+                        forward(self.chain.process_watermark(
+                            MAX_WATERMARK))
+                    finish()
                     return
                 # a finished channel no longer constrains the watermark
                 new = combined_wm()
                 if new > combined:
                     combined = new
-                    self.chain.process_watermark(combined)
+                    forward(self.chain.process_watermark(combined))
+                    for r in self.routes:
+                        r.broadcast(int(combined))
                 continue
             if aligning is not None and barriered[slot]:
                 # aligned-barrier blocking: post-barrier data waits until
@@ -949,8 +1367,8 @@ class StageParallelExecutor:
         from flink_tpu.core.config import ExecutionModeOptions
 
         plan = plan_stages(graph)
-        specs = plan.inputs
-        K = len(specs)
+        src_specs = plan.source_specs
+        K = len(src_specs)
         cfg = self.config
         N = cfg.get(DeploymentOptions.STAGE_PARALLELISM)
         S = cfg.get(DeploymentOptions.SOURCE_PARALLELISM)
@@ -958,7 +1376,7 @@ class StageParallelExecutor:
         batch_size = cfg.get(BatchOptions.BATCH_SIZE)
         batch_mode = cfg.get(
             ExecutionModeOptions.RUNTIME_MODE) == "batch"
-        for spec in specs:
+        for spec in src_specs:
             if batch_mode and not getattr(spec.source.source, "bounded",
                                           True):
                 raise RuntimeError(
@@ -974,7 +1392,7 @@ class StageParallelExecutor:
                     "execution.runtime-mode=batch")
             est = sum(
                 int(spec.source.source.estimate_records() or 0)
-                for spec in specs)
+                for spec in src_specs)
             target = cfg.get(
                 ExecutionModeOptions.TARGET_RECORDS_PER_SUBTASK)
             if target < 1:
@@ -1015,12 +1433,16 @@ class StageParallelExecutor:
             states = read_checkpoint_chain(snap_dir)
             checkpoint_id = int(read_manifest(snap_dir)["checkpoint_id"])
             src_ids = {graph.stable_id(spec.source): i
-                       for i, spec in enumerate(specs)}
+                       for i, spec in enumerate(src_specs)}
             known_ids = {graph.stable_id(t)
-                         for spec in specs for t in spec.pre_chain
+                         for spec in src_specs
+                         for t in spec.transformations
                          if t.operator_factory is not None}
-            known_ids.update(graph.stable_id(t) for t in plan.keyed_chain
-                             if t.operator_factory is not None)
+            known_ids.update(
+                graph.stable_id(t)
+                for stage in plan.stages
+                for t in stage.operator_transformations
+                if t.operator_factory is not None)
             for sid, state in states.items():
                 if sid in src_ids:
                     pos = state["source"]
@@ -1056,47 +1478,100 @@ class StageParallelExecutor:
                 checkpoint_id = max(
                     checkpoint_id, storage.latest_checkpoint_id() or 0)
 
-        coordinator = _Coordinator(num_acks=K * S + N)
+        M = len(plan.stages)
+        coordinator = _Coordinator(num_acks=K * S + M * N)
 
-        # wire partitions: source subtask s of input i owns one partition
-        # with N subpartitions; keyed subtask j consumes subpartition j of
-        # every partition of every input through one gate PER input
-        def pid(i: int, s: int) -> str:
-            # keep the legacy id format for the linear pipeline (external
-            # shuffle services key their buffers by these names)
-            return (f"{job_name}-src-{s}" if K == 1
-                    else f"{job_name}-in{i}-src-{s}")
+        # wire exchanges: every OutSpec of every producer is one
+        # exchange; producer subtask p owns one partition with N
+        # subpartitions, and the consuming stage's subtask j reads
+        # subpartition j of every producer partition through one gate
+        # per stage INPUT (ordered by the head operator's input index).
+        # (reference: IntermediateResultPartition / InputGate wiring in
+        # the ExecutionGraph.)
+        exchanges = []  # (producer kind, producer idx, out_spec)
+        for i, spec in enumerate(src_specs):
+            for o in spec.outputs:
+                exchanges.append(("src", i, o))
+        for m, stage in enumerate(plan.stages):
+            for o in stage.outputs:
+                exchanges.append(("stage", m, o))
 
-        writers = {(i, s): shuffle.create_partition(pid(i, s), N, credits)
-                   for i in range(K) for s in range(S)}
-        gates = [[shuffle.create_gate([pid(i, s) for s in range(S)], j)
-                  for i in range(K)]
-                 for j in range(N)]
+        def xpid(eid: int, p: int) -> str:
+            return f"{job_name}-x{eid}-{p}"
+
+        #: eid -> list of per-producer-subtask partition writers
+        x_writers: Dict[int, list] = {}
+        #: (target_stage, target_input) -> eid
+        x_target: Dict[Tuple[int, int], int] = {}
+        for eid, (kind, idx, o) in enumerate(exchanges):
+            p_count = S if kind == "src" else N
+            x_writers[eid] = [
+                shuffle.create_partition(xpid(eid, p), N, credits)
+                for p in range(p_count)]
+            x_target[(o.target_stage, o.target_input)] = eid
+        #: stage m, subtask j -> gates ordered by input index
+        stage_gates = {
+            m: [[shuffle.create_gate(
+                [xpid(x_target[(m, i)], p)
+                 for p in range(len(x_writers[x_target[(m, i)]]))], j)
+                for i in range(stage.num_inputs)]
+                for j in range(N)]
+            for m, stage in enumerate(plan.stages)}
 
         combiner_factory = None
-        if K == 1 and cfg.get(DeploymentOptions.LOCAL_AGG):
+        if K == 1 and len(src_specs[0].outputs) == 1 and \
+                src_specs[0].outputs[0].target_stage == 0 and \
+                not src_specs[0].outputs[0].branch and \
+                cfg.get(DeploymentOptions.LOCAL_AGG):
             combiner_factory = _local_combiner_factory(plan)
+
+        def make_routes(kind: str, idx: int, outs: List[OutSpec],
+                        sub: int, ctx: OperatorContext,
+                        with_combiner: bool = False) -> List[_OutputRoute]:
+            routes = []
+            for o in outs:
+                eid = next(e for e, (k2, i2, o2) in enumerate(exchanges)
+                           if k2 == kind and i2 == idx and o2 is o)
+                routes.append(_OutputRoute(
+                    o, x_writers[eid][sub], N, max_par, ctx,
+                    batch_mode=batch_mode, batch_size=batch_size,
+                    combiner=(combiner_factory()
+                              if with_combiner and combiner_factory
+                              else None),
+                    recompute_key_id=(kind == "stage")))
+            return routes
 
         sources = []
         import copy as _copy
 
-        for i, spec in enumerate(specs):
-            per_input_pos = restore_positions.get(i, {})
+        for i, spec in enumerate(src_specs):
+            per_src_pos = restore_positions.get(i, {})
             for s in range(S):
                 src = spec.source.source if S == 1 else _copy.deepcopy(
                     spec.source.source)
+                ctx = OperatorContext(operator_index=s, parallelism=1,
+                                      max_parallelism=max_par)
                 sources.append(_SourceSubtask(
-                    s, S, spec, graph, writers[(i, s)], N, max_par,
-                    batch_size, coordinator, src,
-                    restore_position=per_input_pos.get(s),
+                    s, S, spec, graph,
+                    make_routes("src", i, spec.outputs, s, ctx,
+                                with_combiner=(i == 0)),
+                    max_par, batch_size, coordinator, src,
+                    restore_position=per_src_pos.get(s),
                     batch_mode=batch_mode,
-                    combiner=combiner_factory() if combiner_factory
-                    else None,
-                    input_index=i))
+                    source_index=i))
         shared_sinks: Dict[int, _SharedSink] = {}
-        keyed = [_KeyedSubtask(j, N, plan, graph, gates[j], max_par,
-                               coordinator, cfg, shared_sinks=shared_sinks)
-                 for j in range(N)]
+        mesh_devices = cfg.get(DeploymentOptions.STAGE_MESH_DEVICES)
+        keyed: List[_KeyedSubtask] = []
+        for m, stage in enumerate(plan.stages):
+            for j in range(N):
+                ctx = OperatorContext(operator_index=j, parallelism=1,
+                                      max_parallelism=max_par)
+                keyed.append(_KeyedSubtask(
+                    j, N, stage, graph, stage_gates[m][j],
+                    max_par, coordinator, cfg,
+                    shared_sinks=shared_sinks, stage_index=m,
+                    routes=make_routes("stage", m, stage.outputs, j, ctx),
+                    mesh_devices=mesh_devices))
         for k in keyed:
             if restore_states:
                 k._restore_states = restore_states
@@ -1188,7 +1663,12 @@ class StageParallelExecutor:
             # rows that actually crossed the keyed exchange (< records
             # when the local combiner collapsed them — the two-phase win)
             "records_shuffled": sum(s.records_out for s in sources),
-            "subtask_records_in": [k.records_in for k in keyed],
+            "subtask_records_in": [k.records_in for k in keyed
+                                   if k.stage_index == 0],
+            **({"keyed_stages": M,
+                "per_stage_records_in": [
+                    [k.records_in for k in keyed if k.stage_index == m]
+                    for m in range(M)]} if M > 1 else {}),
         }
         if savepoint_path:
             metrics["savepoint"] = savepoint_path
@@ -1214,6 +1694,15 @@ class StageParallelExecutor:
                     hash_keys_to_i64,
                 )
 
+                # the operator names ONE stage; route to that stage's
+                # owning subtask (keyed is stage-major: m * N + j)
+                stage_index = 0
+                for m, stage in enumerate(plan.stages):
+                    if any(t.name == req.operator_name
+                           for t in stage.operator_transformations):
+                        stage_index = m
+                        break
+                N = sum(1 for k in keyed if k.stage_index == stage_index)
                 key_id = int(hash_keys_to_i64(
                     np.asarray([req.key]))[0])
                 group = int(assign_key_groups(
@@ -1222,9 +1711,9 @@ class StageParallelExecutor:
                 owner = int(key_group_to_operator_index(
                     np.asarray([group]),
                     self.config.get(CoreOptions.MAX_PARALLELISM),
-                    len(keyed))[0])
+                    N)[0])
                 reply: _q.Queue = _q.Queue()
-                keyed[owner].control.put(
+                keyed[stage_index * N + owner].control.put(
                     (req.operator_name, req.key, req.namespace, reply))
                 result, err = reply.get(timeout=30)
                 req.finish(result, err)
@@ -1271,10 +1760,9 @@ class StageParallelExecutor:
                 if not s.is_alive() and s.final_position is not None:
                     coordinator.ack(
                         checkpoint_id,
-                        ("source", s.input_index, s.index),
+                        ("source", s.source_index, s.index),
                         {"position": s.final_position,
-                         "operators": s.chain.snapshot(graph)
-                         if s.chain else {}})
+                         "operators": s.snapshot_operators(graph)})
             # the run loop is parked here — cancellation and subtask death
             # must abort the checkpoint, not wait out the full deadline
             if coordinator.cancelled.is_set() or (
@@ -1293,8 +1781,8 @@ class StageParallelExecutor:
         if coordinator.failure is not None:
             raise coordinator.failure
         acks = coordinator.collected(checkpoint_id)
-        # assemble logical snapshot: per-input source positions under each
-        # input's own source transformation id
+        # assemble logical snapshot: per-source positions under each
+        # physical source's own transformation id
         positions: Dict[int, Dict[int, Any]] = {}
         for who, sub in acks.items():
             if who[0] == "source":
@@ -1303,18 +1791,28 @@ class StageParallelExecutor:
         # contribute their end-of-split position — omitting them would
         # replay their whole split on restore
         for s in sources:
-            per_input = positions.setdefault(s.input_index, {})
+            per_input = positions.setdefault(s.source_index, {})
             if s.index not in per_input and s.final_position is not None:
                 per_input[s.index] = s.final_position
         snap: Dict[str, Any] = {}
-        per_input_subtasks = max(
-            (len(p) for p in positions.values()), default=1)
-        for i, spec in enumerate(plan.inputs):
+        source_parallelism = self.config.get(
+            DeploymentOptions.SOURCE_PARALLELISM)
+        for i, spec in enumerate(plan.source_specs):
             per_input = positions.get(i, {})
+            # the wrap decision is per input from the CONFIGURED source
+            # parallelism, not the observed position count — a missing
+            # subtask position must fail the checkpoint precisely, not
+            # produce a snapshot that later fails restore with a
+            # misleading cross-count error
+            if len(per_input) != source_parallelism:
+                raise RuntimeError(
+                    f"checkpoint {checkpoint_id} incomplete: input {i} "
+                    f"has positions for {sorted(per_input)} but "
+                    f"execution.source-parallelism is {source_parallelism}")
             # a single-subtask source stores its position unwrapped, so
             # the snapshot is restorable by the single-slot executor too;
             # S > 1 wraps per-subtask positions (stage-mode restore only)
-            if per_input_subtasks == 1:
+            if source_parallelism == 1:
                 source_state = {"source": per_input.get(0)}
             else:
                 source_state = {"source": {"__subtasks__": {

@@ -273,22 +273,51 @@ class KafkaSource(SplitSource):
 
 
 class KafkaSink:
-    """Partitioned append sink: rows route to partitions by a key field
-    (hash) or round-robin (reference: KafkaSink with a key-hash
-    partitioner). Append-only."""
+    """Partitioned sink: rows route to partitions by a key field (hash)
+    or round-robin (reference: KafkaSink with a key-hash partitioner).
+
+    Delivery is AT-LEAST-ONCE: writes are not transactional, so batches
+    appended after the last completed checkpoint are re-appended on
+    crash-restore (the reference's KafkaSink defaults to the same
+    guarantee; its EXACTLY_ONCE mode needs broker transactions, which
+    the in-process FakeBroker does not model).
+
+    ``upsert_keys`` switches the sink to UPSERT mode (reference:
+    upsert-kafka): it accepts a changelog (rows keep their
+    ``__rowkind__``), always partitions by the primary key so a key's
+    updates stay ordered within one partition, and duplicates from
+    at-least-once replay are idempotent after consumer-side last-wins
+    compaction — the same effective-exactly-once argument upsert-kafka
+    makes."""
 
     def __init__(self, topic: str, broker: Optional[FakeBroker] = None,
                  broker_name: str = "default",
                  partition_by: Optional[str] = None,
-                 num_partitions: int = 1):
+                 num_partitions: int = 1,
+                 upsert_keys: Optional[list] = None):
         self.broker = broker or FakeBroker.get(broker_name)
         self.topic = topic
+        self.upsert_keys = list(upsert_keys) if upsert_keys else None
+        if self.upsert_keys and not partition_by:
+            # a key's upserts must stay ordered: route by the key
+            partition_by = self.upsert_keys[0]
         self.partition_by = partition_by
         self.num_partitions = int(num_partitions)
         self._rr = 0
 
+    @property
+    def supports_changelog(self) -> bool:
+        return self.upsert_keys is not None
+
     def open(self, subtask_index: int = 0) -> None:
         self.broker.create_topic(self.topic, self.num_partitions)
+
+    def snapshot_state(self) -> dict:
+        # round-robin rotation is deterministic across restore
+        return {"rr": self._rr}
+
+    def restore_state(self, state: dict) -> None:
+        self._rr = int(state.get("rr", 0))
 
     def write(self, batch: RecordBatch) -> None:
         if len(batch) == 0:

@@ -240,6 +240,14 @@ class DeploymentOptions:
         description="Subtask count for the source stage in multi-slot "
         "mode. Each source subtask receives open(subtask_index, "
         "parallelism) and must split its input accordingly.")
+    STAGE_MESH_DEVICES = ConfigOption(
+        "execution.stage-mesh-devices", default=0, type=int,
+        description="Mesh x stage composition: devices each KEYED subtask "
+        "opens its window engine over (a private sub-mesh sharded within "
+        "the subtask's key-group range). 0 (default) = one device per "
+        "subtask. Subtask expansion distributes across slots/hosts (the "
+        "reference's distribution model); the sub-mesh distributes across "
+        "chips within one subtask's jitted program (the SPMD model).")
     SHUFFLE_SERVICE = ConfigOption(
         "shuffle.service", default="local", type=str,
         description="Registered ShuffleService transport connecting "

@@ -96,9 +96,13 @@ class MeshSessionEngine(MeshSpillSupport):
         max_device_slots: int = 0,
         spill_dir: Optional[str] = None,
         spill_host_max_bytes: int = 0,
+        key_group_range: Optional[Tuple[int, int]] = None,
     ) -> None:
         self.gap = int(gap)
         self.agg = agg
+        #: (first, last) inclusive GLOBAL key groups this engine owns; the
+        #: mesh shards within the range (mesh x stage — see shard_records)
+        self.key_group_range = key_group_range
         self.mesh = mesh
         self.P = int(mesh.devices.size)
         #: per-SHARD HBM slot budget; cold sessions spill per shard and
@@ -140,8 +144,8 @@ class MeshSessionEngine(MeshSpillSupport):
             for leaf in agg.leaves
         )
         (self._scatter_step, self._fire_step, self._reset_step,
-         self._gather_step, self._put_step,
-         self._merge_leaves_step) = build_mesh_steps(mesh, agg)
+         self._gather_step, self._put_step, self._merge_leaves_step,
+         self._valued_scatter_step) = build_mesh_steps(mesh, agg)
         self._merge_step = build_session_merge_step(mesh, agg)
         self.meta = SessionIntervalSet(self.gap, self.allowed_lateness)
         self._dirty = np.zeros((self.P, self.capacity), dtype=bool)
@@ -213,7 +217,8 @@ class MeshSessionEngine(MeshSpillSupport):
 
         # per-shard slot resolution for the live sessions
         m = len(sess_key)
-        sess_shard = shard_records(sess_key, self.P, self.max_parallelism)
+        sess_shard = shard_records(sess_key, self.P,
+            self.max_parallelism, self.key_group_range)
         if self._spill_active:
             touched = {
                 p: np.unique(sess_sid[(sess_shard == p) & live_sess])
@@ -259,7 +264,8 @@ class MeshSessionEngine(MeshSpillSupport):
         gk = np.asarray(g.keys_dst, dtype=np.int64)
         ds = np.asarray(g.sids_dst, dtype=np.int64)
         ss = np.asarray(g.sids_src, dtype=np.int64)
-        shards = shard_records(gk, self.P, self.max_parallelism)
+        shards = shard_records(gk, self.P,
+            self.max_parallelism, self.key_group_range)
         if self._spill_active:
             # merging sessions may be cold (spilled): both sides must be
             # device-resident before the merge kernel moves values
@@ -335,7 +341,8 @@ class MeshSessionEngine(MeshSpillSupport):
                        sids) -> List[RecordBatch]:
         k_arr = np.asarray(keys, dtype=np.int64)
         sid_arr = np.asarray(sids, dtype=np.int64)
-        shards = shard_records(k_arr, self.P, self.max_parallelism)
+        shards = shard_records(k_arr, self.P,
+            self.max_parallelism, self.key_group_range)
         if self._spill_active:
             # cold (spilled) sessions must be resident to fire from the
             # device table
@@ -402,7 +409,7 @@ class MeshSessionEngine(MeshSpillSupport):
             return {}
         shard = int(shard_records(
             np.asarray([key_id], dtype=np.int64), self.P,
-            self.max_parallelism)[0])
+            self.max_parallelism, self.key_group_range)[0])
         sids = np.asarray([iv[2] for iv in intervals], dtype=np.int64)
         keys = np.full(len(sids), int(key_id), dtype=np.int64)
         slots = self.indexes[shard].lookup(keys, sids)
@@ -550,7 +557,8 @@ class MeshSessionEngine(MeshSpillSupport):
         if self._spill_active and len(key_ids):
             self._spill_restore_rows(key_ids, namespaces, leaves)
         elif len(key_ids):
-            shards = shard_records(key_ids, self.P, self.max_parallelism)
+            shards = shard_records(key_ids, self.P,
+            self.max_parallelism, self.key_group_range)
             # inserts first — growth must settle before the host copy
             # (same contract as MeshWindowEngine.restore)
             per_shard_slots: Dict[int, np.ndarray] = {}

@@ -304,7 +304,8 @@ class MeshSpillSupport:
         grouped by namespace and reload lazily on first access — a
         snapshot far larger than the HBM budget restores with bounded
         device memory (same contract as SlotTable.restore)."""
-        shards = shard_records(key_ids, self.P, self.max_parallelism)
+        shards = shard_records(key_ids, self.P,
+            self.max_parallelism, self.key_group_range)
         for p in range(self.P):
             mask = shards == p
             if not mask.any():
@@ -344,9 +345,13 @@ class MeshWindowEngine(MeshSpillSupport):
         max_device_slots: int = 0,
         spill_dir: Optional[str] = None,
         spill_host_max_bytes: int = 0,
+        key_group_range: Optional[Tuple[int, int]] = None,
     ) -> None:
         self.assigner = assigner
         self.agg = agg
+        #: (first, last) inclusive GLOBAL key groups this engine owns; the
+        #: mesh shards within the range (mesh x stage — see shard_records)
+        self.key_group_range = key_group_range
         #: host-side (cross-shard) fired-row reduction; the single-device
         #: engine fuses this into the fire kernel, here it runs after the
         #: per-shard results are assembled (the per-shard transfer is
@@ -418,8 +423,8 @@ class MeshWindowEngine(MeshSpillSupport):
 
     def _build_steps(self) -> None:
         (self._scatter_step, self._fire_step, self._reset_step,
-         self._gather_step, self._put_step,
-         self._merge_step) = build_mesh_steps(self.mesh, self.agg)
+         self._gather_step, self._put_step, self._merge_step,
+         self._valued_scatter_step) = build_mesh_steps(self.mesh, self.agg)
 
     def _shard_index_grew(self, new_capacity: int) -> None:
         """One shard's index outgrew the device column count: widen the
@@ -470,7 +475,8 @@ class MeshWindowEngine(MeshSpillSupport):
         if len(uniq_ns) <= 1:
             return None
         budget = max(self.max_device_slots // 2, 1024)
-        pshards = shard_records(pk, self.P, self.max_parallelism)
+        pshards = shard_records(pk, self.P,
+            self.max_parallelism, self.key_group_range)
         costs: Dict[int, int] = {}
         for ns in uniq_ns.tolist():
             ns = int(ns)
@@ -522,15 +528,29 @@ class MeshWindowEngine(MeshSpillSupport):
         self.book.register_slices(slice_ends)
 
         # route to owning shard, bucket into [P, B] blocks
-        shards = shard_records(key_ids, self.P, self.max_parallelism)
-        values = self.agg.map_input(batch)
-        in_leaves = self.agg.input_leaves
+        shards = shard_records(key_ids, self.P,
+            self.max_parallelism, self.key_group_range)
+        from flink_tpu.runtime.local_agg import (
+            is_partial_batch,
+            partial_leaf_values,
+        )
+
+        partial = is_partial_batch(batch)
+        if partial:
+            # locally pre-aggregated rows (two-phase agg): one explicit
+            # value per ACC leaf, folded with the valued scatter (the
+            # mesh form of SlotTable.upsert_valued)
+            values = partial_leaf_values(batch, self.agg)
+            leaves = self.agg.leaves
+        else:
+            values = self.agg.map_input(batch)
+            leaves = self.agg.input_leaves
         counts, blocked, order = bucket_by_shard(
             shards, self.P,
             columns=[key_ids, slice_ends,
                      *[np.asarray(v, dtype=l.dtype)
-                       for v, l in zip(values, in_leaves)]],
-            fills=[0, 0, *[l.identity for l in in_leaves]],
+                       for v, l in zip(values, leaves)]],
+            fills=[0, 0, *[l.identity for l in leaves]],
         )
         key_block, ns_block = blocked[0], blocked[1]
         value_blocks = blocked[2:]
@@ -556,7 +576,8 @@ class MeshWindowEngine(MeshSpillSupport):
                 key_block[p, :c], ns_block[p, :c])
             self._dirty[p, slot_block[p, :c]] = True
 
-        self.accs = self._scatter_step(
+        step = self._valued_scatter_step if partial else self._scatter_step
+        self.accs = step(
             self.accs,
             self._put_sharded(slot_block),
             tuple(self._put_sharded(v) for v in value_blocks),
@@ -770,7 +791,7 @@ class MeshWindowEngine(MeshSpillSupport):
 
         shard = int(shard_records(
             np.asarray([key_id], dtype=np.int64), self.P,
-            self.max_parallelism)[0])
+            self.max_parallelism, self.key_group_range)[0])
         idx = self.indexes[shard]
         leaves = self.agg.leaves
         #: slice end -> per-leaf 1-element raw values for this key
@@ -923,17 +944,29 @@ class MeshWindowEngine(MeshSpillSupport):
         self._freed_ns.clear()
         return out
 
-    def restore(self, snap: Dict[str, object]) -> None:
-        """Restore, re-sharding by key group (works across mesh sizes)."""
+    def restore(self, snap: Dict[str, object],
+                key_group_filter=None) -> None:
+        """Restore, re-sharding by key group (works across mesh sizes).
+
+        ``key_group_filter``: keep only rows in these GLOBAL key groups
+        (subtask-expansion restore — the mesh x stage composition
+        restores the merged logical snapshot into each subtask's owned
+        range)."""
         table = snap["table"]
         key_ids = np.asarray(table["key_id"], dtype=np.int64)
         namespaces = np.asarray(table["namespace"], dtype=np.int64)
         leaves = [np.asarray(table[f"leaf_{i}"])
                   for i in range(len(self.agg.leaves))]
+        if key_group_filter is not None and len(key_ids):
+            groups = assign_key_groups(key_ids, self.max_parallelism)
+            mask = np.isin(groups, np.asarray(sorted(key_group_filter)))
+            key_ids, namespaces = key_ids[mask], namespaces[mask]
+            leaves = [v[mask] for v in leaves]
         if self._spill_active and len(key_ids):
             self._spill_restore_rows(key_ids, namespaces, leaves)
         elif len(key_ids):
-            shards = shard_records(key_ids, self.P, self.max_parallelism)
+            shards = shard_records(key_ids, self.P,
+            self.max_parallelism, self.key_group_range)
             # resolve ALL slots first: inserts may grow the table
             # (on_grow widens self.accs / self.capacity), so the host
             # copy must be taken only after growth has settled
@@ -1097,8 +1130,31 @@ def build_mesh_steps(mesh: Mesh, agg: AggregateFunction):
             out_specs=(P(KEY_AXIS),) * n_leaves,
         )(*accs, slot_matrix)
 
+    @partial(jax.jit, donate_argnums=(0,))
+    def valued_scatter_step(accs, slots, values):
+        # slots: [P, B]; values: one explicit [P, B] block per ACC LEAF
+        # (locally pre-aggregated partials, flink_tpu/runtime/local_agg) —
+        # folded with each leaf's own reduce; no const shortcut (a
+        # partial COUNT is the combined count, not 1). The mesh form of
+        # SlotTable.scatter_valued; decomposability guarantees the
+        # per-leaf reduce merges partials exactly.
+        def local(*args):
+            accs_l = args[:n_leaves]
+            slots_l = args[n_leaves]
+            vals_l = args[n_leaves + 1:]
+            return tuple(
+                getattr(a.at[0, slots_l[0]], m)(v[0])
+                for a, m, v in zip(accs_l, methods, vals_l))
+
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(KEY_AXIS),) * (2 * n_leaves + 1),
+            out_specs=(P(KEY_AXIS),) * n_leaves,
+        )(*accs, slots, *values)
+
     _STEP_CACHE[cache_key] = steps = (scatter_step, fire_step,
                                       reset_step, gather_step,
-                                      put_step, merge_step)
+                                      put_step, merge_step,
+                                      valued_scatter_step)
     return steps
 

@@ -76,13 +76,25 @@ def shard_records(
     key_ids: np.ndarray,
     num_shards: int,
     max_parallelism: int,
+    key_group_range=None,
 ) -> np.ndarray:
     """key id -> owning shard (the keyBy routing decision).
 
     reference: KeyGroupStreamPartitioner.java:55 selectChannel =
     operator index of the key's group.
+
+    ``key_group_range`` = (first, last) inclusive global key groups this
+    mesh owns (the mesh x stage composition: a keyed SUBTASK owns a range
+    of the global key-group space and shards it across its private
+    sub-mesh). The reference formula applied to the LOCAL group space —
+    without the remap, a sub-range would collapse onto a couple of shards.
     """
     groups = assign_key_groups(key_ids, max_parallelism)
+    if key_group_range is not None:
+        first, last = key_group_range
+        local = (np.asarray(groups, dtype=np.int64) - int(first))
+        local_max = int(last) - int(first) + 1
+        return ((local * num_shards) // local_max).astype(np.int64)
     return key_group_to_operator_index(groups, max_parallelism, num_shards)
 
 

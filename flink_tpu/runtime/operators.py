@@ -83,11 +83,18 @@ class OperatorContext:
 
     def __init__(self, operator_index: int = 0, parallelism: int = 1,
                  max_parallelism: int = 128, metrics=None,
-                 async_fires: bool = False, max_dispatch_ahead: int = 4):
+                 async_fires: bool = False, max_dispatch_ahead: int = 4,
+                 mesh=None, key_group_range=None):
         self.operator_index = operator_index
         self.parallelism = parallelism
         self.max_parallelism = max_parallelism
         self.metrics = metrics
+        #: explicit device mesh for the keyed engine (mesh x stage: a
+        #: keyed subtask opens its engine over a private sub-mesh)
+        self.mesh = mesh
+        #: (first, last) key groups this task owns — the mesh engine
+        #: shards WITHIN this range when set (None: the full key space)
+        self.key_group_range = key_group_range
         #: the hosting executor supports deferred fire harvesting +
         #: watermark holdback (LocalExecutor's loop); executors that
         #: forward watermarks eagerly must leave this off
@@ -236,7 +243,8 @@ class WindowAggOperator(Operator):
                 # contract)
                 max_device_slots=spill.get("max_device_slots", 0),
                 spill_dir=spill.get("spill_dir"),
-                spill_host_max_bytes=spill.get("spill_host_max_bytes", 0))
+                spill_host_max_bytes=spill.get("spill_host_max_bytes", 0),
+                key_group_range=getattr(ctx, "key_group_range", None))
         else:
             table_kwargs, placement = self._table_kwargs()
             has_spill = bool(self.spill and any(self.spill.values()))
@@ -572,7 +580,8 @@ class SessionWindowAggOperator(WindowAggOperator):
                 # per-device budget, same contract as the window engine
                 max_device_slots=spill.get("max_device_slots", 0),
                 spill_dir=spill.get("spill_dir"),
-                spill_host_max_bytes=spill.get("spill_host_max_bytes", 0))
+                spill_host_max_bytes=spill.get("spill_host_max_bytes", 0),
+                key_group_range=getattr(ctx, "key_group_range", None))
         else:
             table_kwargs, _ = self._table_kwargs()
             self.windower = SessionWindower(
@@ -647,6 +656,17 @@ class SinkOperator(Operator):
     def process_batch(self, batch, input_index=0):
         self.sink.write(batch)
         return []
+
+    def snapshot_state(self):
+        # sinks with writer state (e.g. KafkaSink's round-robin cursor)
+        # participate in checkpoints (reference: SinkWriter state)
+        snap = getattr(self.sink, "snapshot_state", None)
+        return snap() if snap else None
+
+    def restore_state(self, state, key_group_filter=None):
+        restore = getattr(self.sink, "restore_state", None)
+        if restore:
+            restore(state)
 
     def close(self):
         self.sink.close()

@@ -63,11 +63,19 @@ def _kafka_factory(tenv, stmt) -> None:
     stream = tenv.env.from_source(source, strategy)
     tenv.create_temporary_view(stmt.name, stream, columns=cols,
                                time_field=wm_field)
+    pk = getattr(stmt, "primary_key", None)
+    if pk:
+        bad = [k for k in pk if k not in cols]
+        if bad:
+            raise PlanError(
+                f"CREATE TABLE {stmt.name}: PRIMARY KEY columns {bad} "
+                f"are not table columns {cols}")
     tenv.create_sink_table(
         stmt.name,
         KafkaSink(topic, broker_name=broker_name,
                   partition_by=opts.get("sink.partition-by"),
-                  num_partitions=int(opts.get("sink.partitions", "1"))),
+                  num_partitions=int(opts.get("sink.partitions", "1")),
+                  upsert_keys=pk),
         columns=cols)
 
 

@@ -387,7 +387,31 @@ class StreamTableEnvironment:
             sink, sink_cols = self._sink_tables[stmt.table]
             planned = Planner(self).plan_select(optimize(stmt.query))
             stream = planned.stream
-            if planned.upsert_keys is not None and not getattr(
+            sink_pk = getattr(sink, "upsert_keys", None)
+            if planned.upsert_keys is not None and sink_pk:
+                # upsert sink (PRIMARY KEY ... NOT ENFORCED): materialize
+                # the changelog per sink key FIRST — the
+                # SinkUpsertMaterializer operator (reference:
+                # flink-table-runtime/.../sink/SinkUpsertMaterializer.java).
+                # Its list-based algorithm is what makes a changelog
+                # whose own key differs from the sink PRIMARY KEY (the
+                # reference's main materializer trigger) come out right.
+                from flink_tpu.datastream.stream import DataStream
+                from flink_tpu.graph.transformations import Transformation
+                from flink_tpu.table.upsert_materializer import (
+                    UpsertMaterializeOperator,
+                )
+
+                keys = list(sink_pk)
+                t = Transformation(
+                    name=f"upsert_materialize({stmt.table})",
+                    kind="one_input",
+                    operator_factory=lambda keys=keys:
+                        UpsertMaterializeOperator(keys),
+                    inputs=[stream.transformation],
+                    keyed=True, key_field=keys[0])
+                stream = DataStream(self.env, t)
+            elif planned.upsert_keys is not None and not getattr(
                     sink, "supports_changelog", False):
                 # an updating result written to an append-only sink would
                 # record every intermediate per-key update as a fresh row
@@ -397,8 +421,9 @@ class StreamTableEnvironment:
                     f"INSERT INTO {stmt.table}: the query produces an "
                     "updating (changelog) result but the sink is "
                     "append-only; use a sink with supports_changelog = "
-                    "True, or make the query append-only (e.g. window "
-                    "aggregation instead of plain GROUP BY)")
+                    "True or a PRIMARY KEY (upsert) table, or make the "
+                    "query append-only (e.g. window aggregation instead "
+                    "of plain GROUP BY)")
             if sink_cols is not None:
                 missing = [c for c in sink_cols
                            if c not in planned.columns]

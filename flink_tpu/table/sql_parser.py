@@ -160,6 +160,9 @@ class CreateTable:
     options: dict
     watermark_field: "str | None" = None
     watermark_delay_ms: int = 0
+    #: PRIMARY KEY (...) NOT ENFORCED — the upsert key (reference:
+    #: upsert-kafka's mandatory primary key)
+    primary_key: "list | None" = None
 
 
 @dataclasses.dataclass
@@ -316,9 +319,24 @@ class Parser:
         columns: list = []
         wm_field = None
         wm_delay = 0
+        primary_key: list = []
         self.expect_op("(")
         while True:
-            if self.accept_kw("WATERMARK"):
+            if self.accept_kw("PRIMARY"):
+                # PRIMARY KEY (k [, ...]) NOT ENFORCED — declares the
+                # upsert key (reference: upsert-kafka requires a PRIMARY
+                # KEY; enforcement is impossible on a changelog, hence
+                # the mandatory NOT ENFORCED)
+                self.expect_kw("KEY")
+                self.expect_op("(")
+                while True:
+                    primary_key.append(self.next().value)
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                self.expect_kw("NOT")
+                self.expect_kw("ENFORCED")
+            elif self.accept_kw("WATERMARK"):
                 self.expect_kw("FOR")
                 wm_field = self.next().value
                 self.expect_kw("AS")
@@ -382,7 +400,8 @@ class Parser:
         self.expect_op(")")
         return CreateTable(name, columns, options,
                            watermark_field=wm_field,
-                           watermark_delay_ms=wm_delay)
+                           watermark_delay_ms=wm_delay,
+                           primary_key=primary_key or None)
 
     def _create_model(self) -> CreateModel:
         name = self.next().value
