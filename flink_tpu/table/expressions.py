@@ -343,6 +343,41 @@ class OverCall(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class OverAgg(Expr):
+    """agg(x) OVER (PARTITION BY k ORDER BY rowtime ROWS|RANGE BETWEEN n
+    PRECEDING AND CURRENT ROW) — planned as an OverAggOperator
+    (reference: StreamExecOverAggregate -> RowTimeRowsBoundedPrecedingFunction
+    and friends in flink-table-runtime/.../over/)."""
+
+    func: str                          # one of AGG_NAMES
+    arg: Optional[Expr]                # None for COUNT(*)
+    partition_by: Tuple[Expr, ...]
+    order_by: Tuple[Tuple[Expr, bool], ...]  # (expr, descending)
+    mode: str = "ROWS"                 # ROWS | RANGE
+    #: frame reach before the current row: row count (ROWS) or
+    #: milliseconds (RANGE); None = UNBOUNDED PRECEDING
+    preceding: Optional[int] = None
+
+    def eval(self, batch):
+        raise RuntimeError("OVER window must be planned, not evaluated")
+
+    def children(self):
+        out = tuple(self.partition_by) + tuple(
+            e for e, _ in self.order_by)
+        return out + ((self.arg,) if self.arg is not None else ())
+
+    def aggregates(self):
+        # an OVER aggregate is NOT a grouping aggregate — it adds a
+        # column per input row (the planner routes it separately)
+        return []
+
+    def output_name(self):
+        base = (self.func.lower() if self.arg is None
+                else f"{self.func.lower()}_{self.arg.output_name()}")
+        return f"{base}_over"
+
+
+@dataclasses.dataclass(frozen=True)
 class SelectItem:
     expr: Expr
     alias: Optional[str] = None

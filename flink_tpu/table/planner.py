@@ -47,6 +47,7 @@ from flink_tpu.table.expressions import (
     Column,
     Expr,
     Literal,
+    OverAgg,
     OverCall,
     SelectItem,
     Star,
@@ -130,7 +131,25 @@ class Planner:
         has_aggs = bool(group_by) or any(i.expr.aggregates() for i in items) \
             or stmt.distinct
         over_calls = [i for i in items if isinstance(i.expr, OverCall)]
+        over_aggs = [i for i in items if isinstance(i.expr, OverAgg)]
+        for i in items:
+            if isinstance(i.expr, (OverCall, OverAgg)):
+                continue
+            nested = [n for n in i.expr.walk()
+                      if isinstance(n, (OverCall, OverAgg))]
+            if nested:
+                raise PlanError(
+                    "an OVER window must be a top-level SELECT item "
+                    f"(found one nested inside {i.name!r}); compute it "
+                    "in a subquery first")
 
+        if over_aggs:
+            if has_aggs or over_calls:
+                raise PlanError(
+                    "OVER aggregates cannot mix with GROUP BY or "
+                    "ROW_NUMBER in one SELECT; use a subquery")
+            return self._plan_over_agg(stream, source, items, over_aggs,
+                                       stmt)
         if over_calls:
             if has_aggs:
                 raise PlanError("OVER and GROUP BY in one SELECT "
@@ -307,6 +326,16 @@ class Planner:
                       for e in expr.partition_by),
                 tuple((self._resolve(e, columns, aliases), d)
                       for e, d in expr.order_by))
+        if isinstance(expr, OverAgg):
+            return OverAgg(
+                expr.func,
+                self._resolve(expr.arg, columns, aliases)
+                if expr.arg is not None else None,
+                tuple(self._resolve(e, columns, aliases)
+                      for e in expr.partition_by),
+                tuple((self._resolve(e, columns, aliases), d)
+                      for e, d in expr.order_by),
+                mode=expr.mode, preceding=expr.preceding)
         mapping = {
             node: self._resolve(node, columns, aliases)
             for node in expr.walk()
@@ -588,6 +617,110 @@ class Planner:
             return RecordBatch(cols)
 
         out = ranked.map(project, name="sql_rank_project")
+        return self._finish(out, names, source, stmt)
+
+    # ----------------------------------------------------- OVER aggregates
+
+    def _plan_over_agg(self, stream: DataStream, source: PlannedTable,
+                       items: List[SelectItem],
+                       over_items: List[SelectItem],
+                       stmt: ast.SelectStmt) -> PlannedTable:
+        """agg(x) OVER (PARTITION BY k ORDER BY rowtime frame) —
+        reference: StreamExecOverAggregate. Every OVER call in one
+        SELECT must share one window spec (the reference's
+        single-over-window-per-operator restriction)."""
+        from flink_tpu.runtime.over_agg import OverAggOperator
+
+        if source.upsert_keys is not None:
+            raise PlanError(
+                "OVER aggregation over an updating (changelog) input is "
+                "not supported — inputs must be insert-only")
+        first: OverAgg = over_items[0].expr
+        for i in over_items[1:]:
+            o: OverAgg = i.expr
+            if (o.partition_by, o.order_by, o.mode, o.preceding) != (
+                    first.partition_by, first.order_by, first.mode,
+                    first.preceding):
+                raise PlanError(
+                    "all OVER aggregates in one SELECT must share the "
+                    "same window (PARTITION BY / ORDER BY / frame)")
+        if len(first.partition_by) != 1 or not isinstance(
+                first.partition_by[0], Column):
+            raise PlanError(
+                "OVER requires PARTITION BY exactly one column")
+        key_col = first.partition_by[0].name
+        if len(first.order_by) != 1 or first.order_by[0][1]:
+            raise PlanError(
+                "OVER requires ORDER BY the event-time column ASC")
+        order_col = first.order_by[0][0]
+        if source.time_field is None:
+            # the operator orders frames by the rows' event time — with
+            # no declared time attribute an arbitrary ORDER BY column
+            # would be silently ignored (reference: streaming OVER
+            # requires a time attribute order)
+            raise PlanError(
+                "OVER requires the table to declare an event-time "
+                "column (WATERMARK FOR ...) and ORDER BY it")
+        if not isinstance(order_col, Column) or \
+                order_col.name != source.time_field:
+            raise PlanError(
+                "OVER must ORDER BY the table's event-time column "
+                f"({source.time_field!r}); got "
+                f"{order_col.output_name()!r} (reference: streaming OVER "
+                "windows are rowtime-ordered)")
+
+        # materialize non-column arguments as temp columns first; the
+        # operator writes INTERNAL output names so a user alias can
+        # never clobber a source column another select item still reads
+        specs = []
+        out_names: Dict[int, str] = {}
+        pre_cols: List[Tuple[str, Expr]] = []
+        for j, item in enumerate(over_items):
+            o: OverAgg = item.expr
+            internal = f"__over_out_{j}__"
+            out_names[id(item)] = internal
+            if o.arg is None:
+                specs.append((o.func, None, internal))
+            elif isinstance(o.arg, Column):
+                specs.append((o.func, o.arg.name, internal))
+            else:
+                tmp = f"__over_arg_{j}__"
+                pre_cols.append((tmp, o.arg))
+                specs.append((o.func, tmp, internal))
+        if pre_cols:
+            def add_args(batch, pre=tuple(pre_cols)):
+                for name, e in pre:
+                    batch = batch.with_column(
+                        name, np.asarray(e.eval(batch)))
+                return batch
+
+            stream = stream.map(add_args, name="sql_over_args")
+        mode, preceding = first.mode, first.preceding
+        t = Transformation(
+            name="sql_over_agg", kind="one_input",
+            operator_factory=lambda key_col=key_col, specs=tuple(specs),
+            mode=mode, preceding=preceding: OverAggOperator(
+                key_col, list(specs), mode=mode, preceding=preceding),
+            inputs=[stream.key_by(key_col).transformation])
+        over_stream = DataStream(self.env, t)
+
+        names, exprs = [], []
+        for i in items:
+            if i in over_items:
+                names.append(i.alias or i.expr.output_name())
+                exprs.append(Column(out_names[id(i)]))
+            else:
+                names.append(i.name)
+                exprs.append(i.expr)
+
+        def project(batch, exprs=tuple(exprs), names=tuple(names)):
+            cols = {n: np.asarray(e.eval(batch))
+                    for n, e in zip(names, exprs)}
+            if batch.has_timestamps:
+                cols[TIMESTAMP_FIELD] = batch.timestamps
+            return RecordBatch(cols)
+
+        out = over_stream.map(project, name="sql_over_project")
         return self._finish(out, names, source, stmt)
 
     # --------------------------------------------------------------- joins

@@ -776,9 +776,16 @@ class Parser:
                 distinct = self.accept_kw("DISTINCT")
                 if self.accept_op("*"):
                     self.expect_op(")")
-                    return AggCall(upper, None, distinct)
-                arg = self.parse_expr()
-                self.expect_op(")")
+                    arg = None
+                else:
+                    arg = self.parse_expr()
+                    self.expect_op(")")
+                if self.at_kw("OVER"):
+                    if distinct:
+                        raise SqlParseError(
+                            "DISTINCT is not supported in OVER "
+                            "aggregates")
+                    return self._over_agg_clause(upper, arg)
                 return AggCall(upper, arg, distinct)
             if upper in ("ROW_NUMBER", "RANK"):
                 self.expect_op(")")
@@ -795,9 +802,9 @@ class Parser:
             return Column(col, table=name)
         return Column(name)
 
-    def _over_clause(self, func: str) -> OverCall:
-        self.expect_kw("OVER")
-        self.expect_op("(")
+    def _partition_order(self):
+        """The shared OVER-window prefix: PARTITION BY ... ORDER BY ...
+        (caller has consumed OVER and the opening paren)."""
         partition: List[Expr] = []
         order: List[Tuple[Expr, bool]] = []
         if self.accept_kw("PARTITION"):
@@ -817,8 +824,58 @@ class Parser:
                 order.append((e, desc))
                 if not self.accept_op(","):
                     break
+        return tuple(partition), tuple(order)
+
+    def _over_clause(self, func: str) -> OverCall:
+        self.expect_kw("OVER")
+        self.expect_op("(")
+        partition, order = self._partition_order()
         self.expect_op(")")
-        return OverCall(func, tuple(partition), tuple(order))
+        return OverCall(func, partition, order)
+
+    def _over_agg_clause(self, func: str, arg):
+        """agg(x) OVER (PARTITION BY ... ORDER BY rowtime
+        [ROWS|RANGE BETWEEN <n | INTERVAL 'x' UNIT | UNBOUNDED>
+        PRECEDING AND CURRENT ROW]) — reference:
+        StreamExecOverAggregate. No frame clause = RANGE UNBOUNDED
+        PRECEDING (the SQL default)."""
+        from flink_tpu.table.expressions import OverAgg
+
+        self.expect_kw("OVER")
+        self.expect_op("(")
+        partition, order = self._partition_order()
+        mode, preceding = "RANGE", None
+        if self.at_kw("ROWS", "RANGE"):
+            mode = self.next().upper
+            self.expect_kw("BETWEEN")
+            if self.accept_kw("UNBOUNDED"):
+                preceding = None
+            elif mode == "ROWS":
+                t = self.next()
+                if t.kind != "num" or not float(t.value).is_integer():
+                    raise SqlParseError(
+                        "ROWS BETWEEN expects a whole row count, got "
+                        f"{t.value!r}")
+                preceding = int(float(t.value))
+            else:
+                self.expect_kw("INTERVAL")
+                t = self.next()
+                if t.kind not in ("str", "num"):
+                    raise SqlParseError("INTERVAL expects a quoted amount")
+                amount = float(t.value[1:-1] if t.kind == "str"
+                               else t.value)
+                unit = self.next().upper
+                if unit not in _INTERVAL_MS:
+                    raise SqlParseError(
+                        f"unknown interval unit {unit!r}")
+                preceding = int(amount * _INTERVAL_MS[unit])
+            self.expect_kw("PRECEDING")
+            self.expect_kw("AND")
+            self.expect_kw("CURRENT")
+            self.expect_kw("ROW")
+        self.expect_op(")")
+        return OverAgg(func, arg, partition, order,
+                       mode=mode, preceding=preceding)
 
 
 _CLAUSE_KWS = {

@@ -1,0 +1,256 @@
+"""OVER windowed aggregations (ROWS/RANGE BETWEEN ... PRECEDING).
+
+reference: StreamExecOverAggregate ->
+RowTimeRowsBoundedPrecedingFunction / RowTimeRangeBoundedPrecedingFunction /
+RowTimeRangeUnboundedPrecedingFunction in flink-table-runtime."""
+
+import collections
+
+import numpy as np
+import pytest
+
+from flink_tpu import Configuration, StreamExecutionEnvironment
+from flink_tpu.core.records import RecordBatch
+from flink_tpu.table.environment import StreamTableEnvironment
+
+
+def _mk_table(tenv, topic, ks, vs, ts, parts=2):
+    from flink_tpu.connectors.kafka import FakeBroker
+
+    broker = FakeBroker.get("default")
+    broker.create_topic(topic, parts)
+    for p in range(parts):
+        m = ks % parts == p
+        broker.append(topic, p, RecordBatch.from_pydict(
+            {"key": ks[m], "value": vs[m], "ts": ts[m]},
+            timestamps=ts[m]))
+    tenv.execute_sql(
+        f"CREATE TABLE {topic} (key BIGINT, value DOUBLE, ts BIGINT, "
+        "WATERMARK FOR ts AS ts) "
+        f"WITH ('connector'='kafka', 'topic'='{topic}')")
+
+
+def _data(n=3000, keys=25, seed=11):
+    rng = np.random.default_rng(seed)
+    ks = rng.integers(0, keys, n).astype(np.int64)
+    vs = np.round(rng.random(n), 4)
+    ts = (np.arange(n, dtype=np.int64) * 7)  # unique, ordered
+    return ks, vs, ts
+
+
+def _oracle(ks, vs, ts, mode, preceding, func):
+    """Frames per key over (ts-sorted) rows; unique ts -> unambiguous."""
+    per_key = collections.defaultdict(list)
+    for k, v, t in sorted(zip(ks, vs, ts), key=lambda r: (r[0], r[2])):
+        per_key[k].append((t, v))
+    out = {}
+    for k, rows in per_key.items():
+        tss = [t for t, _ in rows]
+        vals = [v for _, v in rows]
+        for i in range(len(rows)):
+            if preceding is None:
+                lo = 0
+            elif mode == "ROWS":
+                lo = max(i - preceding, 0)
+            else:
+                lo = next(j for j in range(i + 1)
+                          if tss[j] >= tss[i] - preceding)
+            frame = vals[lo:i + 1]
+            if func == "SUM":
+                r = sum(frame)
+            elif func == "COUNT":
+                r = float(len(frame))
+            elif func == "AVG":
+                r = sum(frame) / len(frame)
+            elif func == "MIN":
+                r = min(frame)
+            else:
+                r = max(frame)
+            out[(k, tss[i])] = r
+    return out
+
+
+def _run(sql, topic_data, conf=None):
+    base = {"execution.micro-batch.size": 257}
+    base.update(conf or {})
+    env = StreamExecutionEnvironment(Configuration(base))
+    tenv = StreamTableEnvironment(env)
+    _mk_table(tenv, *topic_data)
+    return tenv.execute_sql(sql).collect()
+
+
+class TestOverAgg:
+    @pytest.mark.parametrize("func", ["SUM", "AVG", "MIN", "MAX"])
+    def test_rows_preceding(self, func):
+        ks, vs, ts = _data()
+        rows = _run(
+            f"SELECT key, ts, {func}(value) OVER (PARTITION BY key "
+            "ORDER BY ts ROWS BETWEEN 10 PRECEDING AND CURRENT ROW) "
+            f"AS r FROM t_{func.lower()}",
+            (f"t_{func.lower()}", ks, vs, ts))
+        oracle = _oracle(ks, vs, ts, "ROWS", 10, func)
+        assert len(rows) == len(ks)
+        for r in rows:
+            assert r["r"] == pytest.approx(
+                oracle[(r["key"], r["ts"])], rel=1e-6), r
+
+    def test_range_interval_preceding(self):
+        ks, vs, ts = _data()
+        rows = _run(
+            "SELECT key, ts, SUM(value) OVER (PARTITION BY key "
+            "ORDER BY ts RANGE BETWEEN INTERVAL '1' SECOND PRECEDING "
+            "AND CURRENT ROW) AS r FROM t_range",
+            ("t_range", ks, vs, ts))
+        oracle = _oracle(ks, vs, ts, "RANGE", 1000, "SUM")
+        for r in rows:
+            assert r["r"] == pytest.approx(
+                oracle[(r["key"], r["ts"])], rel=1e-6), r
+
+    def test_unbounded_preceding_default_frame(self):
+        ks, vs, ts = _data(n=1500)
+        # no frame clause -> RANGE UNBOUNDED PRECEDING (SQL default)
+        rows = _run(
+            "SELECT key, ts, COUNT(*) OVER (PARTITION BY key "
+            "ORDER BY ts) AS r FROM t_unb",
+            ("t_unb", ks, vs, ts))
+        oracle = _oracle(ks, vs, ts, "RANGE", None, "COUNT")
+        for r in rows:
+            assert r["r"] == pytest.approx(
+                oracle[(r["key"], r["ts"])]), r
+
+    def test_multiple_aggs_one_window(self):
+        ks, vs, ts = _data(n=1200)
+        rows = _run(
+            "SELECT key, ts, "
+            "SUM(value) OVER (PARTITION BY key ORDER BY ts "
+            "ROWS BETWEEN 4 PRECEDING AND CURRENT ROW) AS s, "
+            "COUNT(*) OVER (PARTITION BY key ORDER BY ts "
+            "ROWS BETWEEN 4 PRECEDING AND CURRENT ROW) AS c "
+            "FROM t_multi",
+            ("t_multi", ks, vs, ts))
+        o_s = _oracle(ks, vs, ts, "ROWS", 4, "SUM")
+        o_c = _oracle(ks, vs, ts, "ROWS", 4, "COUNT")
+        for r in rows:
+            assert r["s"] == pytest.approx(
+                o_s[(r["key"], r["ts"])], rel=1e-6)
+            assert r["c"] == pytest.approx(o_c[(r["key"], r["ts"])])
+
+    def test_range_peer_rows_share_frames(self):
+        """SQL RANGE frames include the current row's rowtime PEERS."""
+        from flink_tpu.runtime.over_agg import OverAggOperator
+
+        op = OverAggOperator("k", [("SUM", "v", "s")], mode="RANGE",
+                             preceding=10_000)
+
+        class _Ctx:
+            max_parallelism = 128
+
+        op.open(_Ctx())
+        b = RecordBatch.from_pydict(
+            {"k": np.asarray([1, 1, 1]),
+             "v": np.asarray([1.0, 2.0, 4.0])},
+            timestamps=np.asarray([100, 100, 200]))
+        op.process_batch(b)
+        out = op.process_watermark(10_000)[0]
+        got = dict(zip(out.timestamps.tolist(), out["s"].tolist()))
+        # both ts=100 peers see 1+2; ts=200 sees all three
+        assert got == {100: 3.0, 200: 7.0}
+        rows_s = out["s"].tolist()
+        assert rows_s[0] == rows_s[1] == 3.0
+
+    def test_mixed_window_specs_rejected(self):
+        from flink_tpu.table.environment import PlanError
+
+        ks, vs, ts = _data(n=100)
+        with pytest.raises(PlanError, match="same window"):
+            _run(
+                "SELECT key, "
+                "SUM(value) OVER (PARTITION BY key ORDER BY ts "
+                "ROWS BETWEEN 4 PRECEDING AND CURRENT ROW) AS a, "
+                "SUM(value) OVER (PARTITION BY key ORDER BY ts "
+                "ROWS BETWEEN 9 PRECEDING AND CURRENT ROW) AS b "
+                "FROM t_mix",
+                ("t_mix", ks, vs, ts))
+
+    def test_order_by_non_time_rejected(self):
+        from flink_tpu.table.environment import PlanError
+
+        ks, vs, ts = _data(n=100)
+        with pytest.raises(PlanError, match="event-time"):
+            _run(
+                "SELECT key, SUM(value) OVER (PARTITION BY key "
+                "ORDER BY value ROWS BETWEEN 4 PRECEDING AND "
+                "CURRENT ROW) AS a FROM t_ord",
+                ("t_ord", ks, vs, ts))
+
+    def test_no_time_attribute_rejected(self):
+        from flink_tpu.connectors.kafka import FakeBroker
+        from flink_tpu.table.environment import PlanError
+
+        ks, vs, ts = _data(n=50)
+        broker = FakeBroker.get("default")
+        broker.create_topic("t_nowm", 1)
+        broker.append("t_nowm", 0, RecordBatch.from_pydict(
+            {"key": ks, "value": vs, "ts": ts}, timestamps=ts))
+        env = StreamExecutionEnvironment(Configuration({}))
+        tenv = StreamTableEnvironment(env)
+        tenv.execute_sql(
+            "CREATE TABLE t_nowm (key BIGINT, value DOUBLE, ts BIGINT) "
+            "WITH ('connector'='kafka', 'topic'='t_nowm')")
+        with pytest.raises(PlanError, match="event-time"):
+            tenv.execute_sql(
+                "SELECT key, MAX(value) OVER (PARTITION BY key "
+                "ORDER BY value) AS m FROM t_nowm")
+
+    def test_alias_cannot_clobber_source_column(self):
+        ks, vs, ts = _data(n=200)
+        rows = _run(
+            "SELECT value AS v, SUM(value) OVER (PARTITION BY key "
+            "ORDER BY ts ROWS BETWEEN 3 PRECEDING AND CURRENT ROW) "
+            "AS value FROM t_alias",
+            ("t_alias", ks, vs, ts))
+        src = {(k, t): v for k, v, t in zip(ks, vs, ts)}
+        # v must be the SOURCE value, untouched by the alias 'value'
+        got_vs = sorted(round(r["v"], 4) for r in rows)
+        assert got_vs == sorted(np.round(vs, 4).tolist())
+
+    def test_nested_over_rejected_at_plan_time(self):
+        from flink_tpu.table.environment import PlanError
+
+        ks, vs, ts = _data(n=50)
+        with pytest.raises(PlanError, match="top-level"):
+            _run(
+                "SELECT key, SUM(value) OVER (PARTITION BY key "
+                "ORDER BY ts) + 1 AS r FROM t_nest",
+                ("t_nest", ks, vs, ts))
+
+    def test_fractional_rows_frame_rejected(self):
+        from flink_tpu.table.sql_parser import SqlParseError, parse
+
+        with pytest.raises(SqlParseError, match="whole row count"):
+            parse("SELECT SUM(v) OVER (PARTITION BY k ORDER BY ts "
+                  "ROWS BETWEEN 2.7 PRECEDING AND CURRENT ROW) FROM t")
+
+    def test_stage_parallel_matches_single_slot(self):
+        ks, vs, ts = _data(n=3000, keys=40)
+        sql = ("SELECT key, ts, SUM(value) OVER (PARTITION BY key "
+               "ORDER BY ts ROWS BETWEEN 7 PRECEDING AND CURRENT ROW) "
+               "AS r FROM t_sp")
+        single = _run(sql, ("t_sp", ks, vs, ts))
+
+        def rows_map(rows):
+            return {(r["key"], r["ts"]): round(r["r"], 6) for r in rows}
+
+        # fresh broker topic content persists; rerun staged on same topic
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 257,
+            "execution.stage-parallelism": 4,
+            "execution.source-parallelism": 1}))
+        tenv = StreamTableEnvironment(env)
+        tenv.execute_sql(
+            "CREATE TABLE t_sp (key BIGINT, value DOUBLE, ts BIGINT, "
+            "WATERMARK FOR ts AS ts) "
+            "WITH ('connector'='kafka', 'topic'='t_sp')")
+        staged = tenv.execute_sql(sql).collect()
+        assert rows_map(staged) == rows_map(single)
+        assert len(staged) == len(ks)
