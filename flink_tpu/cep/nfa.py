@@ -89,6 +89,21 @@ def compile_stages(pattern: Pattern) -> List[_ExecStage]:
     return out
 
 
+class IterativeContext:
+    """What an iterative condition may read: the events the partial match
+    has already taken, by stage name (reference:
+    IterativeCondition.Context.getEventsForPattern)."""
+
+    def __init__(self, nfa: "KeyNFA", partial: "_Partial"):
+        self._nfa = nfa
+        self._partial = partial
+
+    def events_for(self, name: str) -> List[dict]:
+        nfa = self._nfa
+        return [nfa.event(ei) for si, ei in self._partial.events
+                if nfa.exec_stages[si].stage.name == name]
+
+
 @dataclasses.dataclass
 class Match:
     start_ts: int
@@ -210,7 +225,45 @@ class KeyNFA:
             if st.tail_negative:
                 add(p)  # waiting out the window (guards checked above)
                 continue
+            if not virtual and p.count == 0 and p.stage > 0:
+                prev = exec_stages[p.stage - 1]
+                if not prev.tail_negative and prev.stage.greedy:
+                    # the gate only applies while the loop can still TAKE
+                    # (reference: greedy guards edges of live loop
+                    # states): a saturated loop (taken == max_times)
+                    # cannot claim the event, so the waiting state must
+                    # keep its normal take/ignore behavior
+                    taken_in_loop = sum(
+                        1 for si, _ in p.events if si == p.stage - 1)
+                    saturated = (prev.stage.max_times is not None
+                                 and taken_in_loop
+                                 >= prev.stage.max_times)
+                    prev_hit = (not saturated
+                                and bool(stage_hits[prev.orig_idx]))
+                    if prev_hit and \
+                            prev.stage.iterative_condition is not None:
+                        # the proceed partial carries the loop's taken
+                        # events, so its context evaluates the loop's
+                        # match-dependent condition exactly
+                        prev_hit = bool(prev.stage.iterative_condition(
+                            event, IterativeContext(self, p)))
+                    if prev_hit and not (
+                            prev.stage.until_condition is not None
+                            and bool(stage_hits[
+                                n_stages
+                                + self._until_col[p.stage - 1]])):
+                        # greedy loop behind this fresh waiting state
+                        # claims the event: the shorter-prefix branch can
+                        # neither take nor ignore it — it dies, and the
+                        # loop's own take spawns the longer-prefix
+                        # replacement (reference:
+                        # NFACompiler.updateWithGreedyCondition guards
+                        # both edges with not(loop condition))
+                        continue
             hit = bool(stage_hits[st.orig_idx])
+            if hit and st.stage.iterative_condition is not None:
+                hit = bool(st.stage.iterative_condition(
+                    event, IterativeContext(self, p)))
             until_hit = (st.stage.until_condition is not None
                          and bool(stage_hits[n_stages
                                              + self._until_col[p.stage]]))

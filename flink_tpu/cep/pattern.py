@@ -54,6 +54,15 @@ class Stage:
     #: event satisfies cond (the until event itself is not consumed by the
     #: loop; reference: Pattern.until / IterativeCondition stop condition)
     until_condition: Optional[Callable[[RecordBatch], np.ndarray]] = None
+    #: greedy(): the loop consumes as many matching events as possible —
+    #: an event matching the loop condition can neither be taken nor
+    #: ignored by the FOLLOWING stage's fresh waiting state (reference:
+    #: Quantifier.greedy + NFACompiler.updateWithGreedyCondition)
+    greedy: bool = False
+    #: iterative (match-context) condition evaluated per (event, partial)
+    #: with access to the events already taken — reference:
+    #: IterativeCondition.filter(event, ctx). ANDed with ``condition``.
+    iterative_condition: Optional[Callable] = None
 
     def evaluate(self, batch: RecordBatch) -> np.ndarray:
         if self.condition is None:
@@ -167,6 +176,35 @@ class Pattern:
     def allow_combinations(self) -> "Pattern":
         """reference: Pattern.allowCombinations()."""
         return self._amend_last(combinations=True)
+
+    def greedy(self) -> "Pattern":
+        """The loop consumes as many matching events as possible before
+        the next stage may proceed (reference: Pattern.greedy() — only
+        meaningful on a times()/oneOrMore() loop whose condition overlaps
+        the following stage's)."""
+        last = self.stages[-1]
+        if last.max_times == 1:
+            raise ValueError(
+                "greedy() applies to times()/oneOrMore() loop stages")
+        if last.combinations:
+            raise ValueError(
+                "greedy() cannot combine with allowCombinations() "
+                "(reference restriction)")
+        return self._amend_last(greedy=True)
+
+    def where_iterative(self, condition: Callable) -> "Pattern":
+        """Match-context condition ``fn(event_row, ctx) -> bool`` where
+        ``ctx.events_for(stage_name)`` returns the events the partial
+        match has already taken for a stage (reference:
+        IterativeCondition.filter(value, ctx) /
+        ctx.getEventsForPattern). ANDed with any vectorized where()."""
+        prev = self.stages[-1].iterative_condition
+        if prev is None:
+            combined = condition
+        else:
+            def combined(ev, ctx, prev=prev, cond=condition):
+                return bool(prev(ev, ctx)) and bool(cond(ev, ctx))
+        return self._amend_last(iterative_condition=combined)
 
     def consecutive(self) -> "Pattern":
         """reference: Pattern.consecutive() — strict contiguity inside a

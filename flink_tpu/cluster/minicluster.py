@@ -289,7 +289,7 @@ class ResourceManagerEndpoint(RpcEndpoint):
             "address": address, "slots": num_slots,
             "allocated": prev.get("allocated", 0),
             "seeded": prev.get("seeded", running_tasks),
-            "last_alloc": prev.get("last_alloc", 0.0),
+            "alloc_times": prev.get("alloc_times", []),
             "last_heartbeat": hb,
         }
         if fresh and self.on_register is not None:
@@ -306,10 +306,10 @@ class ResourceManagerEndpoint(RpcEndpoint):
             for eid, info in self._executors.items()
         }
 
-    #: seconds after a request_slot during which seeded-slot reconciliation
-    #: is suspended: a freshly promised slot is not RUNNING yet, so a
-    #: heartbeat in that window under-reports and would wrongly drain the
-    #: orphan seed (over-committing the worker)
+    #: seconds a freshly promised slot may take to show up in the
+    #: worker's running-task report; reconciliation credits promises
+    #: younger than this instead of suspending entirely, so steady
+    #: allocation churn cannot keep a stale orphan seed alive forever
     SEED_RECONCILE_GRACE_S = 10.0
 
     def heartbeat_from(self, executor_id: str,
@@ -317,17 +317,22 @@ class ResourceManagerEndpoint(RpcEndpoint):
         info = self._executors.get(executor_id)
         if info is not None:
             info["last_heartbeat"] = time.monotonic()
-            if (running_tasks is not None and info.get("seeded", 0)
-                    and time.monotonic() - info.get("last_alloc", 0.0)
-                    > self.SEED_RECONCILE_GRACE_S):
+            if running_tasks is not None and info.get("seeded", 0):
                 # reconcile the restart-seeded estimate against the live
-                # slot report: whatever the report covers beyond the
-                # JM-promised slots is the surviving orphan count — it can
-                # only shrink (orphans finishing/cancelled), so the seed
-                # drains to 0 and cannot leak capacity
+                # slot report. Slots promised within the grace window may
+                # not be RUNNING yet, so give the report the benefit of
+                # exactly that many tasks — under steady churn the seed
+                # still drains (orphans finishing can only shrink it),
+                # instead of reconciliation being suspended whenever the
+                # LAST allocation was recent.
+                now = time.monotonic()
+                recent = [t for t in info.get("alloc_times", [])
+                          if now - t <= self.SEED_RECONCILE_GRACE_S]
+                info["alloc_times"] = recent
                 info["seeded"] = min(
                     info["seeded"],
-                    max(0, running_tasks - info["allocated"]))
+                    max(0, running_tasks + len(recent)
+                        - info["allocated"]))
         self._evicted.pop(executor_id, None)  # reachable again
 
     def mark_dead(self, executor_id: str) -> None:
@@ -346,7 +351,12 @@ class ResourceManagerEndpoint(RpcEndpoint):
                 continue
             if info["allocated"] + info.get("seeded", 0) < info["slots"]:
                 info["allocated"] += 1
-                info["last_alloc"] = time.monotonic()
+                now = time.monotonic()
+                # pending-promise timestamps for seed reconciliation
+                # (bounded: entries older than the grace window drop)
+                info["alloc_times"] = [
+                    t for t in info.get("alloc_times", [])
+                    if now - t <= self.SEED_RECONCILE_GRACE_S] + [now]
                 return {"executor_id": eid, "address": info["address"]}
         return None
 

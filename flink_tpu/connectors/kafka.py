@@ -89,6 +89,20 @@ class FakeBroker:
             part.append((base, batch))
             return base
 
+    RAW_FIELD = "__raw__"
+
+    def append_raw(self, topic: str, partition: int, records,
+                   timestamps=None) -> int:
+        """Append RAW byte records (what a real producer writes); a
+        format's DeserializationSchema turns them into columns on the
+        consumer side."""
+        arr = np.empty(len(records), dtype=object)
+        arr[:] = list(records)
+        return self.append(topic, partition, RecordBatch.from_pydict(
+            {self.RAW_FIELD: arr},
+            timestamps=np.asarray(timestamps, dtype=np.int64)
+            if timestamps is not None else None))
+
     def produce_rows(self, topic: str, rows, partition_by=None,
                      num_partitions: Optional[int] = None,
                      timestamp_field: Optional[str] = None) -> None:
@@ -158,11 +172,15 @@ class KafkaPartitionReader(Source):
     stores per-split offsets in checkpoints, not in the broker)."""
 
     def __init__(self, broker: FakeBroker, topic: str, partition: int,
-                 bounded: bool, start_offset: int = 0):
+                 bounded: bool, start_offset: int = 0,
+                 deserializer=None):
         self.broker = broker
         self.topic = topic
         self.partition = partition
         self.bounded = bounded
+        #: DeserializationSchema applied to raw byte records (the
+        #: format seam — flink_tpu/connectors/formats.py)
+        self.deserializer = deserializer
         self._offset = int(start_offset)
         self._stop_at: Optional[int] = None
 
@@ -185,6 +203,12 @@ class KafkaPartitionReader(Source):
             # unbounded: stay live (new appends show up on a later poll)
             return None if self._stop_at is not None else RecordBatch({})
         self._offset = next_off
+        if self.deserializer is not None \
+                and FakeBroker.RAW_FIELD in batch.columns:
+            # offsets count RAW records (committed above); parse errors
+            # the schema skips do not affect the committed position
+            batch = self.deserializer.deserialize_batch(
+                list(batch[FakeBroker.RAW_FIELD]))
         return batch
 
     def snapshot_position(self) -> Dict[str, Any]:
@@ -251,7 +275,7 @@ class KafkaSource(SplitSource):
                  broker_name: str = "default", bounded: bool = True,
                  timestamp_field: Optional[str] = None,
                  start_offsets: Optional[Dict[int, int]] = None,
-                 **kwargs):
+                 value_format=None, **kwargs):
         broker = broker or FakeBroker.get(broker_name)
         self.topic = topic
         self.broker = broker
@@ -260,7 +284,8 @@ class KafkaSource(SplitSource):
         def reader_factory(split: SourceSplit) -> KafkaPartitionReader:
             return KafkaPartitionReader(
                 broker, topic, int(split.payload), bounded,
-                start_offset=start_offsets.get(int(split.payload), 0))
+                start_offset=start_offsets.get(int(split.payload), 0),
+                deserializer=value_format)
 
         super().__init__(
             KafkaPartitionEnumerator(broker, topic, bounded),
@@ -294,7 +319,8 @@ class KafkaSink:
                  broker_name: str = "default",
                  partition_by: Optional[str] = None,
                  num_partitions: int = 1,
-                 upsert_keys: Optional[list] = None):
+                 upsert_keys: Optional[list] = None,
+                 value_format=None):
         self.broker = broker or FakeBroker.get(broker_name)
         self.topic = topic
         self.upsert_keys = list(upsert_keys) if upsert_keys else None
@@ -303,6 +329,8 @@ class KafkaSink:
             partition_by = self.upsert_keys[0]
         self.partition_by = partition_by
         self.num_partitions = int(num_partitions)
+        #: SerializationSchema — rows leave as raw encoded records
+        self.value_format = value_format
         self._rr = 0
 
     @property
@@ -319,6 +347,16 @@ class KafkaSink:
     def restore_state(self, state: dict) -> None:
         self._rr = int(state.get("rr", 0))
 
+    def _append(self, partition: int, batch: RecordBatch) -> None:
+        if self.value_format is not None:
+            self.broker.append_raw(
+                self.topic, partition,
+                self.value_format.serialize_batch(batch),
+                timestamps=batch.timestamps
+                if batch.has_timestamps else None)
+        else:
+            self.broker.append(self.topic, partition, batch)
+
     def write(self, batch: RecordBatch) -> None:
         if len(batch) == 0:
             return
@@ -330,10 +368,9 @@ class KafkaSink:
             for p in range(self.num_partitions):
                 mask = parts == p
                 if mask.any():
-                    self.broker.append(self.topic, p, batch.filter(mask))
+                    self._append(p, batch.filter(mask))
         else:
-            self.broker.append(self.topic,
-                               self._rr % self.num_partitions, batch)
+            self._append(self._rr % self.num_partitions, batch)
             self._rr += 1
 
     def close(self) -> None:

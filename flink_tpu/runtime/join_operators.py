@@ -298,3 +298,160 @@ class IntervalJoinOperator(Operator):
                      for c in right]
         self._left = [RecordBatch(c) for c in left]
         self._right = [RecordBatch(c) for c in right]
+
+
+class TemporalJoinOperator(Operator):
+    """Event-time temporal join: each left row joins the RIGHT VERSION
+    valid at the left row's event time.
+
+    reference: flink-table/flink-table-runtime/.../operators/join/temporal/
+    TemporalRowTimeJoinOperator.java (and the planner's
+    StreamExecTemporalJoin) — the right input is a versioned stream keyed
+    by the join key, versioned by its rowtime; a left row at t matches
+    the latest right version with version_ts <= t. Correctness needs
+    version completeness, so left rows wait for the COMBINED watermark
+    (the valve min across both inputs) before joining; late left rows
+    drop.
+
+    Re-design: per watermark advance, ready left rows sort once by
+    (key, ts) and each key segment binary-searches its version history
+    (columnar, sorted) — no per-row state lookups. Version state
+    compacts to {versions newer than the watermark} + {the single
+    latest version at-or-before it} per key, the reference's
+    cleanupState contract.
+    """
+
+    name = "temporal_join"
+
+    def __init__(self, suffixes=("_l", "_r")):
+        self.suffixes = suffixes
+        self._left: List[RecordBatch] = []
+        self._versions: List[RecordBatch] = []
+        self._max_parallelism = 128
+        self.late_left_dropped = 0
+        self._emitted_wm = -(1 << 62)
+
+    def open(self, ctx):
+        self._max_parallelism = getattr(ctx, "max_parallelism", 128)
+
+    def process_batch(self, batch, input_index=0):
+        if len(batch) == 0:
+            return []
+        if input_index == 0:
+            late = batch.timestamps <= self._emitted_wm
+            if late.any():
+                self.late_left_dropped += int(late.sum())
+                batch = batch.filter(~late)
+            if len(batch):
+                self._left.append(batch)
+        else:
+            self._versions.append(batch)
+        return []
+
+    def process_watermark(self, watermark, input_index=0):
+        self._emitted_wm = max(self._emitted_wm, watermark)
+        if not self._left:
+            self._compact(watermark)
+            return []
+        left = RecordBatch.concat(self._left)
+        ready_mask = left.timestamps <= watermark
+        self._left = [left.filter(~ready_mask)] \
+            if (~ready_mask).any() else []
+        ready = left.filter(ready_mask)
+        if len(ready) == 0:
+            self._compact(watermark)
+            return []
+        out = self._join(ready)
+        self._compact(watermark)
+        return [out] if out is not None and len(out) else []
+
+    def close(self):
+        from flink_tpu.runtime.elements import MAX_WATERMARK
+
+        return self.process_watermark(MAX_WATERMARK)
+
+    def _sorted_versions(self):
+        if not self._versions:
+            return None
+        v = RecordBatch.concat(self._versions)
+        if len(v) == 0:
+            return None
+        order = np.lexsort((v.timestamps, v.key_ids))
+        v = v.take(order)
+        self._versions = [v]
+        return v
+
+    def _join(self, ready: RecordBatch) -> Optional[RecordBatch]:
+        v = self._sorted_versions()
+        if v is None:
+            return None
+        order = np.lexsort((ready.timestamps, ready.key_ids))
+        ready = ready.take(order)
+        lk, lt = ready.key_ids, ready.timestamps
+        vk, vt = v.key_ids, v.timestamps
+        # per-key version segment for every ready row (both sides sorted
+        # by key, so one vectorized searchsorted each)
+        lo = np.searchsorted(vk, lk, side="left")
+        hi = np.searchsorted(vk, lk, side="right")
+        pick = np.full(len(ready), -1, dtype=np.int64)
+        # binary-search each key segment once for all its ready rows
+        starts = np.flatnonzero(np.r_[True, lk[1:] != lk[:-1]])
+        bounds = np.r_[starts, len(lk)]
+        for s in range(len(bounds) - 1):
+            a, b = bounds[s], bounds[s + 1]
+            if lo[a] >= hi[a]:
+                continue  # no versions for this key
+            seg = vt[lo[a]:hi[a]]
+            pos = np.searchsorted(seg, lt[a:b], side="right") - 1
+            ok = pos >= 0
+            pick[a:b][ok] = lo[a] + pos[ok]
+        matched = pick >= 0
+        l_idx = np.flatnonzero(matched)
+        r_idx = pick[matched]
+        if len(l_idx) == 0:
+            return None  # INNER: left rows with no valid version drop
+        lts = lt[l_idx]
+        cols = _merge_columns(ready.drop(TIMESTAMP_FIELD),
+                              v.drop(TIMESTAMP_FIELD),
+                              l_idx, r_idx, self.suffixes)
+        cols[TIMESTAMP_FIELD] = lts
+        return RecordBatch(cols)
+
+    def _compact(self, watermark: int) -> None:
+        """Keep versions newer than the watermark plus each key's single
+        latest version at-or-before it (any future left row joins one of
+        those)."""
+        v = self._sorted_versions()
+        if v is None:
+            return
+        vk, vt = v.key_ids, v.timestamps
+        future = vt > watermark
+        # latest at-or-before per key: the last index of each key's
+        # prefix segment (vt sorted within key)
+        is_last_of_prefix = np.r_[
+            (vk[1:] != vk[:-1]) | future[1:], True] & ~future
+        keep = future | is_last_of_prefix
+        if not keep.all():
+            self._versions = [v.filter(keep)]
+
+    def snapshot_state(self):
+        return {
+            "left": [dict(b.columns) for b in self._left],
+            "tj_versions": [dict(b.columns) for b in self._versions],
+            "max_ts": self._emitted_wm,
+        }
+
+    def restore_state(self, state, key_group_filter=None):
+        def rebuild(cols_list):
+            out = []
+            for cols in cols_list:
+                cols = {k: np.asarray(c) for k, c in cols.items()}
+                if key_group_filter is not None:
+                    cols = _filter_by_key_groups(
+                        cols, key_group_filter, self._max_parallelism)
+                out.append(RecordBatch(cols))
+            return out
+
+        self._left = rebuild(state.get("left", []))
+        self._versions = rebuild(state.get("tj_versions", []))
+        self._emitted_wm = state.get("max_ts", -(1 << 62))

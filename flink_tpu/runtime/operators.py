@@ -289,12 +289,17 @@ class WindowAggOperator(Operator):
 
     def _warn_backend_ignored_on_mesh(self) -> None:
         if self.state_backend not in ("tpu-slot-table",):
-            import warnings
-
-            warnings.warn(
-                f"state.backend={self.state_backend!r} is ignored at "
-                "parallelism > 1 — mesh-sharded state is placed by "
-                "the mesh itself", stacklevel=3)
+            # fail loudly, never degrade silently (same contract as
+            # execution.stage-fallback): the mesh engine shards state
+            # over the device mesh — a placement backend cannot apply
+            raise ValueError(
+                f"state.backend={self.state_backend!r} is not supported "
+                "at operator parallelism > 1: mesh-sharded state is "
+                "placed by the device mesh itself. Use the default "
+                "'tpu-slot-table' backend, or run placement-backed "
+                "state at parallelism 1 / stage-parallel subtasks "
+                "(execution.stage-parallelism), where each subtask owns "
+                "a single-device engine that honors the placement")
 
     def _table_kwargs(self):
         """(SlotTable kwargs incl. backend placement, placement) — the

@@ -56,9 +56,19 @@ def _kafka_factory(tenv, stmt) -> None:
     broker_name = opts.get("broker", "default")
     bounded = _opt_bool(opts, "scan.bounded", True)
     cols = [c for c, _ in stmt.columns]
+    col_types = [t for _, t in stmt.columns]
     wm_field = stmt.watermark_field
+    deser = ser = None
+    fmt = opts.get("format")
+    if fmt:
+        # the format seam: raw byte records <-> typed columns
+        # (reference: 'format' = 'json' resolved through the
+        # DeserializationFormatFactory SPI)
+        from flink_tpu.connectors.formats import resolve_format
+
+        deser, ser = resolve_format(fmt, cols, col_types, opts)
     source = KafkaSource(topic, broker_name=broker_name, bounded=bounded,
-                         timestamp_field=wm_field)
+                         timestamp_field=wm_field, value_format=deser)
     strategy = source.watermark_strategy(stmt.watermark_delay_ms)
     stream = tenv.env.from_source(source, strategy)
     tenv.create_temporary_view(stmt.name, stream, columns=cols,
@@ -75,7 +85,7 @@ def _kafka_factory(tenv, stmt) -> None:
         KafkaSink(topic, broker_name=broker_name,
                   partition_by=opts.get("sink.partition-by"),
                   num_partitions=int(opts.get("sink.partitions", "1")),
-                  upsert_keys=pk),
+                  upsert_keys=pk, value_format=ser),
         columns=cols)
 
 

@@ -341,7 +341,8 @@ def _optimize_select(stmt: ast.SelectStmt) -> ast.SelectStmt:
             else table.left
         right = _wrap_with_filter(table.right, push_r) if push_r \
             else table.right
-        table = ast.Join(left, right, table.kind, table.condition)
+        table = ast.Join(left, right, table.kind, table.condition,
+                         temporal=table.temporal)
         where = and_all(kept)
     elif isinstance(table, ast.SubQuery) and conjuncts \
             and _pushable_subquery(table.query):
@@ -359,7 +360,8 @@ def _optimize_ref(ref: ast.TableRef) -> ast.TableRef:
         return ast.SubQuery(optimize(ref.query), ref.alias)
     if isinstance(ref, ast.Join):
         return ast.Join(_optimize_ref(ref.left), _optimize_ref(ref.right),
-                        ref.kind, fold_constants(ref.condition))
+                        ref.kind, fold_constants(ref.condition),
+                        temporal=ref.temporal)
     if isinstance(ref, ast.WindowTVF):
         return dataclasses.replace(ref, table=_optimize_ref(ref.table))
     if isinstance(ref, ast.MLPredictTVF):

@@ -243,7 +243,167 @@ class Planner:
             return self._plan_ml_predict(ref)
         if isinstance(ref, ast.Join):
             return self._plan_join(ref)
+        if isinstance(ref, ast.MatchRecognize):
+            return self._plan_match_recognize(ref)
         raise PlanError(f"unsupported table ref {ref!r}")
+
+    # --------------------------------------------------- MATCH_RECOGNIZE
+
+    def _plan_match_recognize(self, mr: "ast.MatchRecognize"
+                              ) -> PlannedTable:
+        """MATCH_RECOGNIZE -> the CEP engine (reference: StreamExecMatch
+        compiles the row pattern onto flink-cep's NFA). Row-pattern
+        semantics: variables bind CONSECUTIVE rows of the partition in
+        rowtime order (strict contiguity; loops are consecutive), and
+        SQL quantifiers are greedy unless marked reluctant with '?'."""
+        from flink_tpu.cep.operator import CepOperator
+        from flink_tpu.cep.pattern import (
+            AfterMatchSkipStrategy,
+            Pattern,
+        )
+
+        source = self._plan_table_ref(mr.table)
+        if source.upsert_keys is not None:
+            raise PlanError(
+                "MATCH_RECOGNIZE over an updating (changelog) input is "
+                "not supported — inputs must be insert-only")
+        if source.time_field is None:
+            raise PlanError(
+                "MATCH_RECOGNIZE requires the table to declare an "
+                "event-time column (WATERMARK FOR ...)")
+        if mr.order_by is None or mr.order_by != source.time_field:
+            raise PlanError(
+                "MATCH_RECOGNIZE must ORDER BY the table's event-time "
+                f"column ({source.time_field!r}); got {mr.order_by!r}")
+        if len(mr.partition_by) != 1:
+            raise PlanError(
+                "MATCH_RECOGNIZE supports PARTITION BY exactly one "
+                "column")
+        key_col = mr.partition_by[0]
+        if key_col not in source.columns:
+            raise PlanError(
+                f"PARTITION BY column {key_col!r} is not a column of "
+                f"the input ({source.columns})")
+        if not mr.pattern:
+            raise PlanError("PATTERN () is empty")
+        var_names = [v for v, _, _, _ in mr.pattern]
+        if len(set(var_names)) != len(var_names):
+            raise PlanError(
+                f"duplicate pattern variables: {var_names}")
+        unknown = [v for v in mr.define if v not in var_names]
+        if unknown:
+            raise PlanError(
+                f"DEFINE names unknown pattern variables: {unknown}")
+        for func, var, col, alias in mr.measures:
+            if var not in var_names:
+                raise PlanError(
+                    f"measure references unknown pattern variable "
+                    f"{var!r}")
+            if col not in source.columns:
+                raise PlanError(
+                    f"measure column {col!r} is not an input column")
+
+        pat = None
+        for var, mn, mx, greedy in mr.pattern:
+            if pat is None:
+                pat = Pattern.begin(var)
+            else:
+                pat = pat.next(var)
+            if (mn, mx) != (1, 1):
+                if mx is None:
+                    pat = pat.times_or_more(mn)
+                else:
+                    pat = pat.times(mn, mx)
+                # row-pattern loops bind consecutive rows
+                pat = pat.consecutive()
+                if greedy and (mx is None or mx > 1):
+                    pat = pat.greedy()
+            cond = mr.define.get(var)
+            if cond is not None:
+                pat = self._compile_define(pat, cond, var, var_names)
+        if mr.within_ms is not None:
+            pat = pat.within(mr.within_ms)
+        if mr.after_match == "PAST_LAST_ROW":
+            pat = pat.with_skip_strategy(
+                AfterMatchSkipStrategy.SKIP_PAST_LAST_EVENT)
+
+        measures = list(mr.measures)
+
+        def select(key_value, match, events_by_stage,
+                   _measures=tuple(measures), _key_col=key_col):
+            row = {_key_col: key_value}
+            for func, var, col, alias in _measures:
+                evs = events_by_stage.get(var, [])
+                vals = [e[col] for e in evs]
+                if not vals:
+                    row[alias] = (0 if func == "COUNT" else np.nan)
+                elif func == "FIRST":
+                    row[alias] = vals[0]
+                elif func == "LAST":
+                    row[alias] = vals[-1]
+                elif func == "COUNT":
+                    row[alias] = len(vals)
+                elif func == "SUM":
+                    row[alias] = float(np.sum(vals))
+                elif func == "AVG":
+                    row[alias] = float(np.mean(vals))
+                elif func == "MIN":
+                    row[alias] = min(vals)
+                else:
+                    row[alias] = max(vals)
+            return row
+
+        keyed = source.stream.key_by(key_col)
+        pat = pat.validate()
+        t = Transformation(
+            name="sql_match_recognize", kind="one_input",
+            operator_factory=lambda pat=pat, key_col=key_col, sel=select:
+                CepOperator(pat, key_col, select=sel),
+            inputs=[keyed.transformation], keyed=True, key_field=key_col)
+        out_cols = [key_col] + [alias for _, _, _, alias in measures]
+        return PlannedTable(DataStream(self.env, t), out_cols, mr.alias,
+                            None)
+
+    def _compile_define(self, pat, expr: Expr, var: str,
+                        var_names: List[str]):
+        """A DEFINE condition: references to the variable's OWN columns
+        vectorize (one mask per batch); references to OTHER variables'
+        events (B.price < A.price) become an iterative condition reading
+        the partial match (reference: MATCH_RECOGNIZE DEFINE lowering to
+        IterativeCondition)."""
+        cross = [n for n in expr.walk()
+                 if isinstance(n, Column) and n.table
+                 and n.table.upper() in var_names
+                 and n.table.upper() != var]
+        own_refs = {n: Column(n.name) for n in expr.walk()
+                    if isinstance(n, Column) and n.table
+                    and n.table.upper() == var}
+        if not cross:
+            cond_expr = expr.rewrite(own_refs) if own_refs else expr
+
+            def vcond(b, e=cond_expr):
+                return np.asarray(e.eval(b), dtype=bool)
+
+            return pat.where(vcond)
+
+        def icond(event_row, ctx, e=expr, cross=tuple(cross),
+                  own=dict(own_refs)):
+            mapping = dict(own)
+            for r in cross:
+                evs = ctx.events_for(r.table.upper())
+                if not evs:
+                    # LAST(X.col) over no events is NULL; a NULL
+                    # comparison is not satisfied (SQL three-valued
+                    # logic collapses to false here)
+                    return False
+                mapping[r] = Literal(evs[-1][r.name])
+            e2 = e.rewrite(mapping)
+            batch = RecordBatch.from_pydict(
+                {k: np.asarray([v]) for k, v in event_row.items()
+                 if not k.startswith("__")})
+            return bool(np.asarray(e2.eval(batch))[0])
+
+        return pat.where_iterative(icond)
 
     def _plan_ml_predict(self, ref: "ast.MLPredictTVF") -> PlannedTable:
         """ML_PREDICT(TABLE t, MODEL m, DESCRIPTOR(...)) — one batched
@@ -726,6 +886,8 @@ class Planner:
     # --------------------------------------------------------------- joins
 
     def _plan_join(self, join: ast.Join) -> PlannedTable:
+        if join.temporal is not None:
+            return self._plan_temporal_join(join)
         if join.kind != "INNER":
             raise PlanError(f"{join.kind} JOIN is not supported yet")
         left = self._plan_table_ref(join.left)
@@ -759,16 +921,28 @@ class Planner:
         if not equi:
             raise PlanError("JOIN requires at least one equality predicate")
 
-        l_stream = self._key_for_join(left, [l for l, _ in equi])
-        r_stream = self._key_for_join(right, [r for _, r in equi])
         lower, upper = time_bounds if time_bounds is not None \
             else (-_UNBOUNDED, _UNBOUNDED)
         from flink_tpu.runtime.join_operators import IntervalJoinOperator
 
+        return self._lower_keyed_join(
+            left, right, l_aliases, r_aliases, equi, residual,
+            lambda: IntervalJoinOperator(lower, upper,
+                                         suffixes=("_l", "_r")),
+            "sql_join")
+
+    def _lower_keyed_join(self, left: PlannedTable, right: PlannedTable,
+                          l_aliases, r_aliases,
+                          equi: List[Tuple[Expr, Expr]],
+                          residual: List[Expr], op_factory,
+                          name: str) -> PlannedTable:
+        """Shared two-input keyed-join lowering: key both sides on the
+        equi columns, wire the operator, suffix colliding output
+        columns, and apply non-equi conjuncts as a post-filter."""
+        l_stream = self._key_for_join(left, [l for l, _ in equi])
+        r_stream = self._key_for_join(right, [r for _, r in equi])
         t = Transformation(
-            name="sql_join", kind="two_input",
-            operator_factory=lambda: IntervalJoinOperator(
-                lower, upper, suffixes=("_l", "_r")),
+            name=name, kind="two_input", operator_factory=op_factory,
             inputs=[l_stream.transformation, r_stream.transformation],
             keyed=True)
         joined = DataStream(self.env, t)
@@ -780,8 +954,9 @@ class Planner:
             out_cols.append(c + "_r" if c in left.columns else c)
 
         if residual:
-            aliases = dict(l_aliases)
-            aliases.update({k: "_r" for k in r_aliases})
+            # on an alias collision (self-join without aliases) the left
+            # mapping wins, matching the historical behavior
+            aliases = {k: "_r" for k in r_aliases}
             aliases.update({k: "_l" for k in l_aliases})
             res = [self._resolve(c, out_cols, aliases) for c in residual]
 
@@ -791,8 +966,61 @@ class Planner:
                     mask &= np.asarray(e.eval(batch)).astype(bool)
                 return mask
 
-            joined = joined.filter(res_filter, name="sql_join_residual")
+            joined = joined.filter(res_filter, name=f"{name}_residual")
         return PlannedTable(joined, out_cols, None, None)
+
+    def _plan_temporal_join(self, join: ast.Join) -> PlannedTable:
+        """JOIN versioned FOR SYSTEM_TIME AS OF left.rowtime ON k = k —
+        each left row joins the right VERSION valid at its event time
+        (reference: StreamExecTemporalJoin ->
+        TemporalRowTimeJoinOperator; the right side is a versioned
+        stream: its rows are versions keyed by the join key, versioned
+        by their rowtime)."""
+        from flink_tpu.runtime.join_operators import TemporalJoinOperator
+
+        if join.kind != "INNER":
+            raise PlanError(
+                "temporal join supports INNER only (the reference "
+                "default); LEFT temporal join is not supported yet")
+        left = self._plan_table_ref(join.left)
+        right = self._plan_table_ref(join.right)
+        if left.upsert_keys is not None or right.upsert_keys is not None:
+            raise PlanError(
+                "temporal join inputs must be insert-only streams")
+        if left.time_field is None or right.time_field is None:
+            raise PlanError(
+                "temporal join requires event-time (WATERMARK) on both "
+                "sides: the left drives the as-of instant, the right's "
+                "rowtime versions its rows")
+        l_aliases = self._collect_aliases(join.left)
+        r_aliases = self._collect_aliases(join.right)
+        # the AS OF expression must be the LEFT side's rowtime
+        as_of = join.temporal
+        as_of_side = self._side_of(as_of, left, right,
+                                   l_aliases, r_aliases)
+        as_of_col = self._strip(as_of, left, l_aliases)
+        if as_of_side != "l" or not isinstance(as_of_col, Column) \
+                or as_of_col.name != left.time_field:
+            raise PlanError(
+                "FOR SYSTEM_TIME AS OF must reference the left input's "
+                f"event-time column ({left.time_field!r})")
+        conjuncts = _split_conjuncts(join.condition)
+        equi: List[Tuple[Expr, Expr]] = []
+        residual: List[Expr] = []
+        for c in conjuncts:
+            pair = self._match_equi(c, left, right, l_aliases, r_aliases)
+            if pair is not None:
+                equi.append(pair)
+            else:
+                residual.append(c)
+        if not equi:
+            raise PlanError(
+                "temporal join requires an equality predicate on the "
+                "version key")
+        return self._lower_keyed_join(
+            left, right, l_aliases, r_aliases, equi, residual,
+            lambda: TemporalJoinOperator(suffixes=("_l", "_r")),
+            "sql_temporal_join")
 
     def _side_of(self, expr: Expr, left: PlannedTable, right: PlannedTable,
                  l_aliases, r_aliases) -> Optional[str]:

@@ -68,6 +68,10 @@ class Join:
     right: "TableRef"
     kind: str            # INNER | LEFT
     condition: Expr
+    #: FOR SYSTEM_TIME AS OF <left rowtime> — an event-time TEMPORAL
+    #: join against the right side's versions (reference:
+    #: StreamExecTemporalJoin); None = regular join
+    temporal: "Expr | None" = None
 
 
 @dataclasses.dataclass
@@ -81,7 +85,28 @@ class MLPredictTVF:
     alias: Optional[str] = None
 
 
-TableRef = Union[NamedTable, SubQuery, WindowTVF, Join, MLPredictTVF]
+@dataclasses.dataclass
+class MatchRecognize:
+    """FROM t MATCH_RECOGNIZE (PARTITION BY ... ORDER BY rowtime
+    MEASURES ... PATTERN (...) DEFINE ...) — reference:
+    StreamExecMatch (flink-table-planner/.../stream/StreamExecMatch.java)
+    lowering onto the CEP library's NFA."""
+
+    table: "TableRef"
+    partition_by: list            # column names
+    order_by: "str | None"        # rowtime column
+    #: (func, var, col, alias); func in FIRST/LAST/SUM/AVG/MIN/MAX/COUNT
+    measures: list
+    #: (var, min_times, max_times-or-None, greedy)
+    pattern: list
+    define: dict                  # var -> Expr (bool condition)
+    after_match: str = "PAST_LAST_ROW"   # or "TO_NEXT_ROW"
+    within_ms: "int | None" = None
+    alias: "str | None" = None
+
+
+TableRef = Union[NamedTable, SubQuery, WindowTVF, Join, MLPredictTVF,
+                 MatchRecognize]
 
 
 @dataclasses.dataclass
@@ -183,7 +208,7 @@ _TOKEN_RE = re.compile(
   | (?P<num>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
   | (?P<str>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z_0-9]*|`[^`]+`)
-  | (?P<op><>|!=|<=|>=|\|\||[-+*/%(),.<>=])
+  | (?P<op><>|!=|<=|>=|\|\||[-+*/%(),.<>={}?])
     """,
     re.VERBOSE,
 )
@@ -520,9 +545,22 @@ class Parser:
             else:
                 return left
             right = self._table_primary()
+            temporal = None
+            if self.accept_kw("FOR"):
+                # JOIN versioned FOR SYSTEM_TIME AS OF o.rowtime AS v
+                self.expect_kw("SYSTEM_TIME")
+                self.expect_kw("AS")
+                self.expect_kw("OF")
+                temporal = self.parse_expr()
+                alias = self._opt_alias()
+                if alias is not None:
+                    if not hasattr(right, "alias"):
+                        raise SqlParseError(
+                            "cannot alias this temporal join input")
+                    right = dataclasses.replace(right, alias=alias)
             self.expect_kw("ON")
             cond = self.parse_expr()
-            left = Join(left, right, kind, cond)
+            left = Join(left, right, kind, cond, temporal=temporal)
 
     def _table_primary(self) -> TableRef:
         if self.at_kw("TABLE") and self.peek(1).value == "(":
@@ -535,7 +573,148 @@ class Parser:
             alias = self._opt_alias()
             return SubQuery(q, alias)
         name = self.next().value
-        return NamedTable(name, self._opt_alias())
+        ref = NamedTable(name, self._opt_alias())
+        if self.at_kw("MATCH_RECOGNIZE"):
+            return self._match_recognize(ref)
+        return ref
+
+    def _match_recognize(self, table: TableRef) -> MatchRecognize:
+        """MATCH_RECOGNIZE (PARTITION BY ... ORDER BY ... MEASURES ...
+        [ONE ROW PER MATCH] [AFTER MATCH SKIP ...] PATTERN (...)
+        [WITHIN INTERVAL ...] DEFINE ...) [AS alias]."""
+        self.expect_kw("MATCH_RECOGNIZE")
+        self.expect_op("(")
+        partition: List[str] = []
+        order = None
+        measures: List[tuple] = []
+        after = "PAST_LAST_ROW"
+        pattern: List[tuple] = []
+        define: dict = {}
+        within = None
+        if self.accept_kw("PARTITION"):
+            self.expect_kw("BY")
+            partition.append(self.next().value)
+            while self.accept_op(","):
+                partition.append(self.next().value)
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            order = self.next().value
+            self.accept_kw("ASC")
+        if self.accept_kw("MEASURES"):
+            while True:
+                measures.append(self._measure_item())
+                if not self.accept_op(","):
+                    break
+        if self.accept_kw("ONE"):
+            self.expect_kw("ROW")
+            self.expect_kw("PER")
+            self.expect_kw("MATCH")
+        if self.accept_kw("AFTER"):
+            self.expect_kw("MATCH")
+            self.expect_kw("SKIP")
+            if self.accept_kw("PAST"):
+                self.expect_kw("LAST")
+                self.expect_kw("ROW")
+                after = "PAST_LAST_ROW"
+            elif self.accept_kw("TO"):
+                self.expect_kw("NEXT")
+                self.expect_kw("ROW")
+                after = "TO_NEXT_ROW"
+            else:
+                raise SqlParseError(
+                    "AFTER MATCH SKIP supports PAST LAST ROW / "
+                    "TO NEXT ROW")
+        self.expect_kw("PATTERN")
+        self.expect_op("(")
+        while not self.accept_op(")"):
+            pattern.append(self._pattern_var())
+        if self.accept_kw("WITHIN"):
+            self.expect_kw("INTERVAL")
+            t = self.next()
+            if t.kind not in ("str", "num"):
+                raise SqlParseError("INTERVAL expects a quoted amount")
+            amount = float(t.value[1:-1] if t.kind == "str" else t.value)
+            unit = self.next().upper
+            if unit not in _INTERVAL_MS:
+                raise SqlParseError(f"unknown interval unit {unit!r}")
+            within = int(amount * _INTERVAL_MS[unit])
+        if self.accept_kw("DEFINE"):
+            while True:
+                var = self.next().value
+                self.expect_kw("AS")
+                define[var.upper()] = self.parse_expr()
+                if not self.accept_op(","):
+                    break
+        self.expect_op(")")
+        return MatchRecognize(table, partition, order, measures, pattern,
+                              define, after_match=after,
+                              within_ms=within, alias=self._opt_alias())
+
+    def _measure_item(self) -> tuple:
+        """FIRST(V.c) | LAST(V.c) | SUM/AVG/MIN/MAX/COUNT(V.c) | V.c,
+        each AS alias."""
+        name = self.next()
+        func = "LAST"
+        if name.upper in ("FIRST", "LAST", "SUM", "AVG", "MIN", "MAX",
+                          "COUNT") and self.peek().value == "(":
+            func = name.upper
+            self.expect_op("(")
+            var = self.next().value
+            self.expect_op(".")
+            col = self.next().value
+            self.expect_op(")")
+        else:
+            var = name.value
+            self.expect_op(".")
+            col = self.next().value
+        self.expect_kw("AS")
+        alias = self.next().value
+        return (func, var.upper(), col, alias)
+
+    def _pattern_var(self) -> tuple:
+        """A pattern variable with its quantifier: V V* V+ V? V{n} V{n,}
+        V{n,m}, with a trailing '?' marking RELUCTANT (SQL row-pattern
+        quantifiers are greedy by default)."""
+        var = self.next()
+        if var.kind != "ident":
+            raise SqlParseError(
+                f"expected a pattern variable, got {var.value!r}")
+        mn, mx = 1, 1
+        loop = False
+        if self.accept_op("*"):
+            mn, mx, loop = 0, None, True
+        elif self.accept_op("+"):
+            mn, mx, loop = 1, None, True
+        elif self.accept_op("?"):
+            mn, mx = 0, 1
+        elif self.accept_op("{"):
+            t = self.next()
+            if t.kind != "num":
+                raise SqlParseError("pattern quantifier expects a count")
+            mn = int(float(t.value))
+            mx = mn
+            if self.accept_op(","):
+                if self.accept_op("}"):
+                    mx, loop = None, True
+                else:
+                    t2 = self.next()
+                    if t2.kind != "num":
+                        raise SqlParseError(
+                            "pattern quantifier expects a count")
+                    mx = int(float(t2.value))
+                    # exact {n} has no take/stop freedom — greedy is
+                    # meaningless (and harmful) for it
+                    loop = mx != mn
+                    self.expect_op("}")
+            else:
+                loop = False
+                self.expect_op("}")
+        else:
+            return (var.value.upper(), 1, 1, False)
+        greedy = loop
+        if self.accept_op("?"):
+            greedy = False  # reluctant quantifier
+        return (var.value.upper(), mn, mx, greedy)
 
     def _opt_alias(self) -> Optional[str]:
         if self.accept_kw("AS"):
@@ -883,6 +1062,7 @@ _CLAUSE_KWS = {
     "LEFT", "RIGHT", "FULL", "OUTER", "ON", "AS", "AND", "OR", "NOT",
     "UNION", "SELECT", "BY", "ASC", "DESC", "BETWEEN", "IN", "CASE", "WHEN",
     "THEN", "ELSE", "END", "TABLE", "INTERVAL", "HAVING", "CROSS",
+    "MATCH_RECOGNIZE", "FOR",
 }
 
 

@@ -315,7 +315,7 @@ class TestSeededSlotAccounting:
         assert rm.executor_registry()["te-1"]["allocated"] == 2
         # past the grace window: report says 1 running and 1 is promised,
         # so the orphan is gone -> seed drains
-        rm._executors["te-1"]["last_alloc"] = 0.0
+        rm._executors["te-1"]["alloc_times"] = []  # promise aged out
         rm.heartbeat_from("te-1", running_tasks=1)
         assert rm.executor_registry()["te-1"]["allocated"] == 1
         rm.release_slot("te-1")
@@ -325,10 +325,28 @@ class TestSeededSlotAccounting:
         assert rm.request_slot() is not None
         assert rm.request_slot() is None
 
+    def test_seed_drains_under_steady_allocation_churn(self):
+        """Reconciliation credits only promises YOUNGER than the grace
+        window instead of suspending outright — a stale orphan seed
+        drains even while allocations keep arriving (< grace apart)."""
+        rm = self._rm()
+        rm.register_task_executor("te-1", "addr:1", 8, running_tasks=3)
+        assert rm.request_slot() is not None  # allocated=1
+        assert rm.request_slot() is not None  # allocated=2 (both recent)
+        # all 3 orphans finished; both fresh promises already running:
+        # report = 2. Old behavior: reconciliation suspended (last alloc
+        # is recent) -> seed stuck at 3. New: seed <= 2 + 2 - 2 = 2.
+        rm.heartbeat_from("te-1", running_tasks=2)
+        assert rm.executor_registry()["te-1"]["allocated"] == 2 + 2
+        # promises age out of the grace window -> full drain
+        rm._executors["te-1"]["alloc_times"] = []
+        rm.heartbeat_from("te-1", running_tasks=2)
+        assert rm.executor_registry()["te-1"]["allocated"] == 2
+
     def test_seed_never_grows_from_heartbeat(self):
         rm = self._rm()
         rm.register_task_executor("te-1", "addr:1", 4, running_tasks=1)
-        rm._executors["te-1"]["last_alloc"] = 0.0
+        rm._executors["te-1"]["alloc_times"] = []  # promise aged out
         rm.heartbeat_from("te-1", running_tasks=0)  # orphan finished
         assert rm.executor_registry()["te-1"]["allocated"] == 0
         rm.heartbeat_from("te-1", running_tasks=3)  # later load says 3

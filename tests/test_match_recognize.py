@@ -1,0 +1,276 @@
+"""MATCH_RECOGNIZE + CEP greedy()/iterative conditions.
+
+reference: StreamExecMatch (flink-table-planner) lowering row patterns
+onto flink-cep; Pattern.greedy() (Quantifier.greedy +
+NFACompiler.updateWithGreedyCondition); IterativeCondition.filter(ctx).
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu import Configuration, StreamExecutionEnvironment
+from flink_tpu.cep import KeyNFA, Pattern
+from flink_tpu.core.records import RecordBatch
+from flink_tpu.table.environment import StreamTableEnvironment
+
+
+def _advance_all(pattern, events):
+    nfa = KeyNFA(pattern)
+    out = []
+    for ts, row in events:
+        hits = [bool(st.evaluate(RecordBatch.from_pydict(
+            {k: [v] for k, v in row.items()}))[0])
+            for st in pattern.stages]
+        for m in nfa.advance(row, ts, hits):
+            out.append({name: [nfa.event(i)["v"] for i in idxs]
+                        for name, idxs in m.events_by_stage.items()})
+    return out
+
+
+def _ev(*vs):
+    return [(i * 10, {"v": v}) for i, v in enumerate(vs)]
+
+
+def is_a(b):
+    return np.char.startswith(np.asarray(b["v"], dtype=str), "a")
+
+
+def is_b(b):
+    return np.char.startswith(np.asarray(b["v"], dtype=str), "b")
+
+
+def is_c(b):
+    return np.char.startswith(np.asarray(b["v"], dtype=str), "c")
+
+
+class TestGreedy:
+    def test_greedy_emits_only_the_maximal_loop(self):
+        """a b+ c (relaxed next via followedBy): non-greedy emits every
+        prefix combination; greedy only the maximal one."""
+        base = Pattern.begin("A").where(is_a) \
+            .followed_by("B").where(is_b).one_or_more() \
+            .followed_by("C").where(is_c)
+        got = _advance_all(base, _ev("a", "b1", "b2", "c"))
+        assert len(got) == 2
+        assert {"A": ["a"], "B": ["b1"], "C": ["c"]} in got
+        assert {"A": ["a"], "B": ["b1", "b2"], "C": ["c"]} in got
+        greedy = Pattern.begin("A").where(is_a) \
+            .followed_by("B").where(is_b).one_or_more().greedy() \
+            .followed_by("C").where(is_c)
+        assert _advance_all(greedy, _ev("a", "b1", "b2", "c")) == [
+            {"A": ["a"], "B": ["b1", "b2"], "C": ["c"]},
+        ]
+
+    def test_greedy_claims_overlapping_event(self):
+        """When an event matches BOTH the greedy loop and the next stage,
+        the loop consumes it (reference: the greedy condition guards the
+        next state's take/ignore edges with not(loop condition))."""
+        def is_bc(b):
+            return is_b(b) | is_c(b)
+
+        greedy = Pattern.begin("A").where(is_a) \
+            .followed_by("B").where(is_bc).one_or_more().greedy() \
+            .followed_by("C").where(is_c)
+        # c1 matches the loop too -> consumed by B; no C left -> no match
+        assert _advance_all(greedy, _ev("a", "b1", "c1")) == []
+        # a non-overlapping terminator still completes maximally
+        def is_d(b):
+            return np.char.startswith(np.asarray(b["v"], dtype=str), "d")
+
+        greedy2 = Pattern.begin("A").where(is_a) \
+            .followed_by("B").where(is_bc).one_or_more().greedy() \
+            .followed_by("D").where(is_d)
+        assert _advance_all(greedy2, _ev("a", "b1", "c1", "d")) == [
+            {"A": ["a"], "B": ["b1", "c1"], "D": ["d"]},
+        ]
+
+    def test_greedy_requires_a_loop(self):
+        with pytest.raises(ValueError, match="greedy"):
+            Pattern.begin("A").where(is_a).greedy()
+
+
+class TestIterativeConditions:
+    def test_loop_condition_sees_taken_events(self):
+        """B+ where each B must exceed the previously taken B
+        (reference: IterativeCondition ctx.getEventsForPattern)."""
+        p = Pattern.begin("A").where(
+                lambda b: np.asarray(b["x"]) == 0) \
+            .followed_by("B").where(
+                lambda b: np.asarray(b["x"]) > 0).one_or_more() \
+            .where_iterative(
+                lambda ev, ctx: (not ctx.events_for("B"))
+                or ev["x"] > ctx.events_for("B")[-1]["x"]) \
+            .next("C").where(lambda b: np.asarray(b["x"]) == 99)
+
+        nfa = KeyNFA(p)
+        out = []
+        for i, row in enumerate([{"x": 0}, {"x": 5}, {"x": 3},
+                                 {"x": 7}, {"x": 99}]):
+            hits = [bool(st.evaluate(RecordBatch.from_pydict(
+                {k: [v] for k, v in row.items()}))[0])
+                for st in p.stages]
+            for m in nfa.advance(row, i * 10, hits):
+                out.append({name: [nfa.event(j)["x"] for j in idxs]
+                            for name, idxs in m.events_by_stage.items()})
+        # 3 is rejected (not > 5); the increasing run 5, 7 matches
+        assert {"A": [0], "B": [5, 7], "C": [99]} in out
+
+    def test_cross_stage_condition(self):
+        """B's condition reads the event A matched."""
+        p = Pattern.begin("A").where(
+                lambda b: np.asarray(b["x"]) < 10) \
+            .followed_by("B").where_iterative(
+                lambda ev, ctx: ev["x"] > ctx.events_for("A")[0]["x"] * 2)
+
+        nfa = KeyNFA(p)
+        out = []
+        for i, row in enumerate([{"x": 4}, {"x": 7}, {"x": 9}]):
+            hits = [bool(st.evaluate(RecordBatch.from_pydict(
+                {k: [v] for k, v in row.items()}))[0])
+                for st in p.stages]
+            for m in nfa.advance(row, i * 10, hits):
+                out.append({name: [nfa.event(j)["x"] for j in idxs]
+                            for name, idxs in m.events_by_stage.items()})
+        # 7 < 2*4=8 rejected for A=4; 9 > 8 matches A=4; 9 <= 14 for A=7
+        assert {"A": [4], "B": [9]} in out
+        assert {"A": [4], "B": [7]} not in out
+        assert {"A": [7], "B": [9]} not in out
+
+
+def _ticks(topic, prices, syms=None):
+    from flink_tpu.connectors.kafka import FakeBroker
+
+    broker = FakeBroker.get("default")
+    broker.create_topic(topic, 1)
+    n = len(prices)
+    ts = np.arange(n, dtype=np.int64) * 1000
+    broker.append(topic, 0, RecordBatch.from_pydict(
+        {"sym": np.asarray(syms if syms is not None
+                           else np.zeros(n), dtype=np.int64),
+         "price": np.asarray(prices, dtype=np.float64),
+         "ts": ts}, timestamps=ts))
+    return ts
+
+
+class TestMatchRecognizeSQL:
+    def _env(self):
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 4}))
+        return StreamTableEnvironment(env)
+
+    def _ddl(self, tenv, topic):
+        tenv.execute_sql(
+            f"CREATE TABLE {topic} (sym BIGINT, price DOUBLE, ts BIGINT, "
+            "WATERMARK FOR ts AS ts) "
+            f"WITH ('connector'='kafka', 'topic'='{topic}')")
+
+    def test_v_shape_pattern(self):
+        """The reference docs' canonical falling-then-rising query."""
+        _ticks("mr1", [10, 9, 8, 7, 8, 9, 12, 11, 10, 13, 14])
+        tenv = self._env()
+        self._ddl(tenv, "mr1")
+        rows = tenv.execute_sql("""
+            SELECT sym, start_p, bottom_p, end_p FROM mr1
+            MATCH_RECOGNIZE (
+              PARTITION BY sym ORDER BY ts
+              MEASURES FIRST(A.price) AS start_p,
+                       LAST(B.price) AS bottom_p,
+                       LAST(C.price) AS end_p
+              AFTER MATCH SKIP PAST LAST ROW
+              PATTERN (A B+ C+)
+              DEFINE B AS B.price < A.price,
+                     C AS C.price > B.price
+            ) AS m
+        """).collect()
+        assert rows == [
+            {"sym": 0, "start_p": 8.0, "bottom_p": 7.0, "end_p": 8.0},
+            {"sym": 0, "start_p": 12.0, "bottom_p": 10.0,
+             "end_p": 13.0},
+        ]
+
+    def test_partitioned_and_quantified(self):
+        """Per-partition matching with an exact {n} quantifier and
+        aggregate measures."""
+        prices = [1, 5, 6, 2, 1, 5, 6, 7, 2]
+        syms = [0, 0, 0, 0, 1, 1, 1, 1, 1]
+        _ticks("mr2", prices, syms)
+        tenv = self._env()
+        self._ddl(tenv, "mr2")
+        rows = tenv.execute_sql("""
+            SELECT sym, n_up, total FROM mr2 MATCH_RECOGNIZE (
+              PARTITION BY sym ORDER BY ts
+              MEASURES COUNT(UP.price) AS n_up, SUM(UP.price) AS total
+              AFTER MATCH SKIP PAST LAST ROW
+              PATTERN (LO UP{2})
+              DEFINE LO AS LO.price < 2,
+                     UP AS UP.price > 4
+            ) AS m
+        """).collect()
+        got = {(r["sym"], r["n_up"], r["total"]) for r in rows}
+        assert got == {(0, 2, 11.0), (1, 2, 11.0)}
+
+    def test_within_prunes_slow_patterns(self):
+        _ticks("mr3", [1, 5, 6])  # ts: 0, 1000, 2000
+        tenv = self._env()
+        self._ddl(tenv, "mr3")
+        rows = tenv.execute_sql("""
+            SELECT sym, total FROM mr3 MATCH_RECOGNIZE (
+              PARTITION BY sym ORDER BY ts
+              MEASURES SUM(UP.price) AS total
+              PATTERN (LO UP{2})
+              WITHIN INTERVAL '1' SECOND
+              DEFINE LO AS LO.price < 2, UP AS UP.price > 4
+            ) AS m
+        """).collect()
+        assert rows == []  # the 2 s span exceeds within 1 s
+
+    def test_reluctant_quantifier(self):
+        """B+? (reluctant) emits the shortest loop; the SQL default is
+        greedy (maximal)."""
+        _ticks("mr4", [1, 5, 6, 9])
+        tenv = self._env()
+        self._ddl(tenv, "mr4")
+        greedy_rows = tenv.execute_sql("""
+            SELECT sym, cnt FROM mr4 MATCH_RECOGNIZE (
+              PARTITION BY sym ORDER BY ts
+              MEASURES COUNT(UP.price) AS cnt
+              AFTER MATCH SKIP PAST LAST ROW
+              PATTERN (LO UP+ HI)
+              DEFINE LO AS LO.price < 2,
+                     UP AS UP.price > 4 AND UP.price < 9,
+                     HI AS HI.price >= 9
+            ) AS m
+        """).collect()
+        assert [r["cnt"] for r in greedy_rows] == [2]
+
+    def test_unknown_variable_rejected(self):
+        from flink_tpu.table.environment import PlanError
+
+        _ticks("mr5", [1, 2])
+        tenv = self._env()
+        self._ddl(tenv, "mr5")
+        with pytest.raises(PlanError, match="unknown pattern variable"):
+            tenv.execute_sql("""
+                SELECT sym, x FROM mr5 MATCH_RECOGNIZE (
+                  PARTITION BY sym ORDER BY ts
+                  MEASURES FIRST(Z.price) AS x
+                  PATTERN (A B)
+                  DEFINE A AS A.price < 2
+                ) AS m
+            """)
+
+    def test_order_by_must_be_rowtime(self):
+        from flink_tpu.table.environment import PlanError
+
+        _ticks("mr6", [1, 2])
+        tenv = self._env()
+        self._ddl(tenv, "mr6")
+        with pytest.raises(PlanError, match="event-time"):
+            tenv.execute_sql("""
+                SELECT sym, x FROM mr6 MATCH_RECOGNIZE (
+                  PARTITION BY sym ORDER BY price
+                  MEASURES FIRST(A.price) AS x
+                  PATTERN (A)
+                  DEFINE A AS A.price < 2
+                ) AS m
+            """)

@@ -154,3 +154,30 @@ class TestRegistry:
         with pytest.raises(ValueError, match="panes"):
             _run("host-heap", SlidingEventTimeWindows.of(600, 300), rows,
                  extra={"state.window-layout": "panes"})
+
+    def test_placement_on_mesh_path_fails_loudly(self):
+        """A placement backend at operator parallelism > 1 must raise,
+        never silently degrade (the mesh places state itself)."""
+        from flink_tpu.runtime.operators import (
+            OperatorContext,
+            WindowAggOperator,
+        )
+        from flink_tpu.windowing.aggregates import SumAggregate
+
+        op = WindowAggOperator(
+            SlidingEventTimeWindows.of(600, 300), SumAggregate("v"),
+            "key", state_backend="host-heap")
+        with pytest.raises(ValueError, match="parallelism > 1"):
+            op.open(OperatorContext(parallelism=8, max_parallelism=128))
+
+    def test_placement_honored_by_stage_parallel_subtasks(self):
+        """Stage-parallel subtasks open single-device engines — the
+        placement applies there (the supported parallel form)."""
+        rows = _rows(600)
+        base = _run("tpu-slot-table",
+                    SlidingEventTimeWindows.of(600, 300), rows)
+        got = _run("host-heap", SlidingEventTimeWindows.of(600, 300),
+                   rows, extra={"execution.stage-parallelism": 2})
+        assert got.keys() == base.keys()
+        for k in base:
+            assert got[k] == pytest.approx(base[k], rel=1e-5)

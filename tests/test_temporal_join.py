@@ -1,0 +1,180 @@
+"""Event-time temporal join (FOR SYSTEM_TIME AS OF).
+
+reference: StreamExecTemporalJoin ->
+flink-table-runtime/.../operators/join/temporal/
+TemporalRowTimeJoinOperator.java — each left row joins the right VERSION
+valid at its event time; version state compacts past the watermark."""
+
+import numpy as np
+import pytest
+
+from flink_tpu import Configuration, StreamExecutionEnvironment
+from flink_tpu.core.records import RecordBatch
+from flink_tpu.runtime.join_operators import TemporalJoinOperator
+from flink_tpu.state.keygroups import hash_keys_to_i64
+from flink_tpu.table.environment import StreamTableEnvironment
+
+
+class _Ctx:
+    max_parallelism = 128
+
+
+def _kb(cols, ts):
+    b = RecordBatch.from_pydict(
+        cols, timestamps=np.asarray(ts, dtype=np.int64))
+    return b.with_column("__key_id__", hash_keys_to_i64(b["cur"]))
+
+
+class TestOperator:
+    def _orders(self):
+        return _kb({"cur": np.asarray([1, 1, 2, 1], dtype=np.int64),
+                    "amount": np.asarray([10.0, 20.0, 30.0, 40.0])},
+                   [1000, 2500, 4000, 5500])
+
+    def _rates(self):
+        return _kb({"cur": np.asarray([1, 1, 2, 1], dtype=np.int64),
+                    "rate": np.asarray([1.0, 1.1, 2.0, 1.2])},
+                   [0, 2000, 3000, 5000])
+
+    def _joined(self, outs):
+        rows = {}
+        for b in outs:
+            for r in b.to_rows():
+                rows[(r["amount"], r["__ts__"])] = r["rate"]
+        return rows
+
+    def test_each_left_row_joins_the_valid_version(self):
+        op = TemporalJoinOperator()
+        op.open(_Ctx())
+        op.process_batch(self._rates(), input_index=1)
+        op.process_batch(self._orders(), input_index=0)
+        got = self._joined(op.process_watermark(10_000))
+        assert got == {(10.0, 1000): 1.0, (20.0, 2500): 1.1,
+                       (30.0, 4000): 2.0, (40.0, 5500): 1.2}
+
+    def test_left_rows_wait_for_the_watermark(self):
+        """A left row must not join until the combined watermark covers
+        its timestamp (version completeness)."""
+        op = TemporalJoinOperator()
+        op.open(_Ctx())
+        op.process_batch(_kb({"cur": np.asarray([1]),
+                              "rate": np.asarray([1.0])}, [0]),
+                         input_index=1)
+        op.process_batch(_kb({"cur": np.asarray([1]),
+                              "amount": np.asarray([10.0])}, [2500]),
+                         input_index=0)
+        assert op.process_watermark(2000) == []  # not ripe yet
+        # the newer version arrives BEFORE the row's watermark — it wins
+        op.process_batch(_kb({"cur": np.asarray([1]),
+                              "rate": np.asarray([1.5])}, [2400]),
+                         input_index=1)
+        got = self._joined(op.process_watermark(3000))
+        assert got == {(10.0, 2500): 1.5}
+
+    def test_no_version_drops_inner(self):
+        op = TemporalJoinOperator()
+        op.open(_Ctx())
+        op.process_batch(_kb({"cur": np.asarray([7]),
+                              "amount": np.asarray([1.0])}, [100]),
+                         input_index=0)
+        assert op.process_watermark(10_000) == []
+
+    def test_version_state_compacts(self):
+        op = TemporalJoinOperator()
+        op.open(_Ctx())
+        op.process_batch(self._rates(), input_index=1)
+        op.process_watermark(10_000)
+        # all versions <= watermark except the latest per key drop
+        v = op._sorted_versions()
+        assert len(v) == 2  # latest of cur=1 (5000) + latest of cur=2
+        # and a late-arriving left row for an OLD instant is dropped
+        op.process_batch(_kb({"cur": np.asarray([1]),
+                              "amount": np.asarray([9.0])}, [1500]),
+                         input_index=0)
+        assert op.late_left_dropped == 1
+
+    def test_snapshot_restore_key_group_filter(self):
+        op = TemporalJoinOperator()
+        op.open(_Ctx())
+        op.process_batch(self._rates(), input_index=1)
+        op.process_batch(self._orders(), input_index=0)
+        snap = op.snapshot_state()
+        from flink_tpu.state.keygroups import assign_key_groups
+
+        g1 = int(assign_key_groups(np.asarray([1]), 128)[0])
+        op2 = TemporalJoinOperator()
+        op2.open(_Ctx())
+        op2.restore_state(snap, key_group_filter={g1})
+        got = self._joined(op2.process_watermark(10_000))
+        # only cur=1 rows survived the filter
+        assert got == {(10.0, 1000): 1.0, (20.0, 2500): 1.1,
+                       (40.0, 5500): 1.2}
+
+
+class TestTemporalJoinSQL:
+    def _setup(self, tenv, suffix=""):
+        from flink_tpu.connectors.kafka import FakeBroker
+
+        broker = FakeBroker.get("default")
+        o, r = f"ord{suffix}", f"rate{suffix}"
+        broker.create_topic(o, 1)
+        broker.create_topic(r, 1)
+        o_ts = np.asarray([1000, 2500, 4000, 5500], dtype=np.int64)
+        broker.append(o, 0, RecordBatch.from_pydict(
+            {"cur": np.asarray([1, 1, 2, 1], dtype=np.int64),
+             "amount": np.asarray([10.0, 20.0, 30.0, 40.0]),
+             "ots": o_ts}, timestamps=o_ts))
+        r_ts = np.asarray([0, 2000, 3000, 5000], dtype=np.int64)
+        broker.append(r, 0, RecordBatch.from_pydict(
+            {"cur": np.asarray([1, 1, 2, 1], dtype=np.int64),
+             "rate": np.asarray([1.0, 1.1, 2.0, 1.2]),
+             "rts": r_ts}, timestamps=r_ts))
+        tenv.execute_sql(
+            f"CREATE TABLE {o} (cur BIGINT, amount DOUBLE, ots BIGINT, "
+            "WATERMARK FOR ots AS ots) "
+            f"WITH ('connector'='kafka', 'topic'='{o}')")
+        tenv.execute_sql(
+            f"CREATE TABLE {r} (cur BIGINT, rate DOUBLE, rts BIGINT, "
+            "WATERMARK FOR rts AS rts) "
+            f"WITH ('connector'='kafka', 'topic'='{r}')")
+        return o, r
+
+    def test_for_system_time_as_of(self):
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 2}))
+        tenv = StreamTableEnvironment(env)
+        o, r = self._setup(tenv, "1")
+        rows = tenv.execute_sql(f"""
+            SELECT o.amount, r.rate, o.ots FROM {o} AS o
+            JOIN {r} FOR SYSTEM_TIME AS OF o.ots AS r
+            ON o.cur = r.cur
+        """).collect()
+        got = {(x["amount"], x["ots"]): x["rate"] for x in rows}
+        assert got == {(10.0, 1000): 1.0, (20.0, 2500): 1.1,
+                       (30.0, 4000): 2.0, (40.0, 5500): 1.2}
+
+    def test_converted_amounts(self):
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 3}))
+        tenv = StreamTableEnvironment(env)
+        o, r = self._setup(tenv, "2")
+        rows = tenv.execute_sql(f"""
+            SELECT o.amount * r.rate AS converted FROM {o} AS o
+            JOIN {r} FOR SYSTEM_TIME AS OF o.ots AS r
+            ON o.cur = r.cur
+        """).collect()
+        assert sorted(round(x["converted"], 2) for x in rows) == \
+            [10.0, 22.0, 48.0, 60.0]
+
+    def test_as_of_must_be_left_rowtime(self):
+        from flink_tpu.table.environment import PlanError
+
+        env = StreamExecutionEnvironment(Configuration({}))
+        tenv = StreamTableEnvironment(env)
+        o, r = self._setup(tenv, "3")
+        with pytest.raises(PlanError, match="event-time"):
+            tenv.execute_sql(f"""
+                SELECT o.amount FROM {o} AS o
+                JOIN {r} FOR SYSTEM_TIME AS OF r.rts AS r
+                ON o.cur = r.cur
+            """)
