@@ -67,6 +67,37 @@ class SerializationSchema:
         raise NotImplementedError
 
 
+def _coerce(v, dt):
+    """One field to its declared dtype; raises ValueError/TypeError on a
+    lossy/unparseable value (callers decide skip-vs-fail per record)."""
+    if dt is np.int64:
+        return 0 if v is None else int(v)
+    if dt is np.float64:
+        return np.nan if v is None else float(v)
+    if dt is np.bool_:
+        return (v.lower() in ("true", "1")
+                if isinstance(v, str) else bool(v))
+    if dt is object:
+        return "" if v is None else str(v)
+    return v
+
+
+def _columns_from_rows(rows: List[tuple], columns: Sequence[str],
+                       dts) -> Dict[str, np.ndarray]:
+    cols: Dict[str, np.ndarray] = {}
+    for j, (name, dt) in enumerate(zip(columns, dts)):
+        vals = [r[j] for r in rows]
+        if dt is object:
+            arr = np.empty(len(vals), dtype=object)
+            arr[:] = vals
+            cols[name] = arr
+        elif dt is None:
+            cols[name] = np.asarray(vals)
+        else:
+            cols[name] = np.asarray(vals, dtype=dt)
+    return cols
+
+
 def _np_dtype(sql_type: Optional[str]):
     t = (sql_type or "").upper().split("(")[0].strip()
     if t in ("BIGINT", "INT", "INTEGER", "SMALLINT", "TINYINT"):
@@ -94,14 +125,22 @@ class JsonRowDeserializationSchema(DeserializationSchema):
         self.ignore_parse_errors = ignore_parse_errors
 
     def deserialize_batch(self, raw: Sequence[bytes]) -> RecordBatch:
-        rows: List[dict] = []
+        dts = [_np_dtype(t) for t in self.types]
+        rows: List[tuple] = []
         for rec in raw:
             if isinstance(rec, (bytes, bytearray)):
                 rec = rec.decode("utf-8", errors="replace")
+            # parse AND type-coerce inside the guarded path: the
+            # reference's ignore-parse-errors covers conversion failures
+            # too, so one bad-typed field skips ONE record, never the
+            # batch
             try:
                 obj = json.loads(rec)
                 if not isinstance(obj, dict):
                     raise ValueError("JSON record is not an object")
+                rows.append(tuple(
+                    _coerce(obj.get(name), dt)
+                    for name, dt in zip(self.columns, dts)))
             except (ValueError, TypeError) as e:
                 if self.ignore_parse_errors:
                     continue
@@ -109,29 +148,8 @@ class JsonRowDeserializationSchema(DeserializationSchema):
                     f"failed to deserialize JSON record {rec!r}: {e} "
                     "(set 'json.ignore-parse-errors'='true' to skip "
                     "corrupt records)") from e
-            rows.append(obj)
-        cols: Dict[str, np.ndarray] = {}
-        for name, sql_t in zip(self.columns, self.types):
-            dt = _np_dtype(sql_t)
-            vals = [r.get(name) for r in rows]
-            if dt is np.int64:
-                cols[name] = np.asarray(
-                    [0 if v is None else int(v) for v in vals],
-                    dtype=np.int64)
-            elif dt is np.float64:
-                cols[name] = np.asarray(
-                    [np.nan if v is None else float(v) for v in vals],
-                    dtype=np.float64)
-            elif dt is np.bool_:
-                cols[name] = np.asarray(
-                    [bool(v) for v in vals], dtype=np.bool_)
-            elif dt is object:
-                arr = np.empty(len(vals), dtype=object)
-                arr[:] = ["" if v is None else str(v) for v in vals]
-                cols[name] = arr
-            else:
-                cols[name] = np.asarray(vals)
-        return RecordBatch.from_pydict(cols)
+        return RecordBatch.from_pydict(
+            _columns_from_rows(rows, self.columns, dts))
 
 
 class JsonRowSerializationSchema(SerializationSchema):
@@ -197,42 +215,34 @@ class CsvRowDeserializationSchema(DeserializationSchema):
     def deserialize_batch(self, raw: Sequence[bytes]) -> RecordBatch:
         import csv as _csv
 
-        rows: List[List[str]] = []
+        dts = [_np_dtype(t) for t in self.types]
+        rows: List[tuple] = []
         for rec in raw:
             if isinstance(rec, (bytes, bytearray)):
                 rec = rec.decode("utf-8", errors="replace")
             # RFC-4180 parsing (quoted fields may hold the delimiter,
-            # quotes, newlines) — symmetric with the serializer
-            parts = next(_csv.reader([rec.rstrip("\r\n")],
-                                     delimiter=self.delimiter), [])
-            if len(parts) != len(self.columns):
+            # quotes, newlines) — symmetric with the serializer; type
+            # coercion happens here too so a bad field skips ONE record
+            try:
+                parts = next(_csv.reader([rec.rstrip("\r\n")],
+                                         delimiter=self.delimiter), [])
+                if len(parts) != len(self.columns):
+                    raise ValueError(
+                        f"CSV record has {len(parts)} fields, expected "
+                        f"{len(self.columns)}")
+                rows.append(tuple(
+                    _coerce(int(float(p)) if dt is np.int64 and p
+                            else (p or None), dt)
+                    for p, dt in zip(parts, dts)))
+            except (ValueError, TypeError) as e:
                 if self.ignore_parse_errors:
                     continue
                 raise RuntimeError(
-                    f"CSV record has {len(parts)} fields, expected "
-                    f"{len(self.columns)}: {rec!r}")
-            rows.append(parts)
-        cols: Dict[str, np.ndarray] = {}
-        for j, (name, sql_t) in enumerate(zip(self.columns, self.types)):
-            dt = _np_dtype(sql_t)
-            vals = [r[j] for r in rows]
-            if dt is np.int64:
-                cols[name] = np.asarray(
-                    [int(float(v)) if v else 0 for v in vals],
-                    dtype=np.int64)
-            elif dt is np.float64:
-                cols[name] = np.asarray(
-                    [float(v) if v else np.nan for v in vals],
-                    dtype=np.float64)
-            elif dt is np.bool_:
-                cols[name] = np.asarray(
-                    [v.lower() in ("true", "1") for v in vals],
-                    dtype=np.bool_)
-            else:
-                arr = np.empty(len(vals), dtype=object)
-                arr[:] = vals
-                cols[name] = arr
-        return RecordBatch.from_pydict(cols)
+                    f"failed to deserialize CSV record {rec!r}: {e} "
+                    "(set 'csv.ignore-parse-errors'='true' to skip "
+                    "corrupt records)") from e
+        return RecordBatch.from_pydict(
+            _columns_from_rows(rows, self.columns, dts))
 
 
 class CsvRowSerializationSchema(SerializationSchema):

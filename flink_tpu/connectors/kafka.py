@@ -207,8 +207,14 @@ class KafkaPartitionReader(Source):
                 and FakeBroker.RAW_FIELD in batch.columns:
             # offsets count RAW records (committed above); parse errors
             # the schema skips do not affect the committed position
+            raw_ts = batch.timestamps if batch.has_timestamps else None
             batch = self.deserializer.deserialize_batch(
                 list(batch[FakeBroker.RAW_FIELD]))
+            if raw_ts is not None and len(batch) == len(raw_ts):
+                # broker (log-append) timestamps survive the format seam
+                # — a schema that skipped corrupt records loses the
+                # per-record alignment, so only a full batch reattaches
+                batch = batch.with_timestamps(raw_ts)
         return batch
 
     def snapshot_position(self) -> Dict[str, Any]:

@@ -1,0 +1,171 @@
+"""Lookup tables — point lookups against external systems at join time.
+
+reference: LookupTableSource / LookupFunction
+(flink-table/flink-table-common/src/main/java/org/apache/flink/table/
+connector/source/LookupTableSource.java, .../functions/LookupFunction.java)
+and the lookup join
+(flink-table-runtime/.../operators/join/lookup/LookupJoinRunner.java) —
+the dimension-table enrichment pattern: each stream row fetches the
+external row for its key at processing time, with an optional cache
+(FLIP-221 'lookup.cache').
+
+Re-design: lookups are BATCHED — one ``lookup(keys)`` call per distinct
+key set per micro-batch (the expensive boundary crossed once per batch,
+like every other connector seam here), fronted by an LRU cache.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from flink_tpu.core.records import TIMESTAMP_FIELD, RecordBatch
+from flink_tpu.runtime.operators import Operator
+
+
+class LookupFunction:
+    """The lookup seam: ``lookup(keys) -> {column: array}`` returning one
+    row per FOUND key, keyed by the first output column matching the
+    lookup key. Misses are simply absent. Implementations wrap real
+    clients (JDBC, HBase, REST); tests use ``TableLookupFunction``."""
+
+    #: the key column name in the returned rows
+    key_column: str = "key"
+
+    def open(self) -> None:
+        pass
+
+    def lookup(self, keys: np.ndarray) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class TableLookupFunction(LookupFunction):
+    """In-memory dimension table (tests / static enrichment data)."""
+
+    def __init__(self, rows: Sequence[dict], key_column: str):
+        self.key_column = key_column
+        self._by_key = {r[key_column]: r for r in rows}
+        self._columns = list(rows[0].keys()) if rows else [key_column]
+
+    def lookup(self, keys: np.ndarray) -> Dict[str, np.ndarray]:
+        hits = [self._by_key[k] for k in keys.tolist()
+                if k in self._by_key]
+        if not hits:
+            return {c: np.empty(0) for c in self._columns}
+        return {c: np.asarray([r[c] for r in hits])
+                for c in self._columns}
+
+
+class LookupJoinOperator(Operator):
+    """Enrich each row with its key's external row (INNER or LEFT).
+
+    reference: LookupJoinRunner + the FLIP-221 caching layer. Per batch:
+    distinct keys split into cache hits and misses, ONE lookup() fetches
+    the misses, results join back positionally. A cached miss is cached
+    too (negative caching, like the reference's missing-key cache)."""
+
+    name = "lookup_join"
+
+    def __init__(self, fn: LookupFunction, key_field: str,
+                 right_columns: Optional[Sequence[str]] = None,
+                 suffixes=("_l", "_r"), cache_size: int = 10_000,
+                 left_outer: bool = False):
+        self.fn = fn
+        self.key_field = key_field
+        #: the DECLARED dimension-table columns — always emitted, so
+        #: every output batch shares one schema even when a batch's
+        #: lookups all miss
+        self.right_columns = list(right_columns) if right_columns \
+            else None
+        self.suffixes = suffixes
+        self.cache_size = int(cache_size)
+        self.left_outer = left_outer
+        #: key value -> row dict or None (negative cache)
+        self._cache: OrderedDict = OrderedDict()
+        self.lookups = 0
+        self.cache_hits = 0
+
+    def open(self, ctx) -> None:
+        self.fn.open()
+
+    def _fetch(self, key_vals: np.ndarray) -> Dict[object, Optional[dict]]:
+        out: Dict[object, Optional[dict]] = {}
+        misses: List[object] = []
+        for k in dict.fromkeys(key_vals.tolist()):
+            if self.cache_size and k in self._cache:
+                self._cache.move_to_end(k)
+                out[k] = self._cache[k]
+                self.cache_hits += 1
+            else:
+                misses.append(k)
+        if misses:
+            self.lookups += 1
+            cols = self.fn.lookup(np.asarray(misses))
+            kc = self.fn.key_column
+            found = {}
+            if cols and len(next(iter(cols.values()))):
+                n = len(next(iter(cols.values())))
+                for i in range(n):
+                    row = {c: cols[c][i] for c in cols}
+                    found[row[kc]] = row
+            for k in misses:
+                row = found.get(k)
+                out[k] = row
+                if self.cache_size:
+                    self._cache[k] = row
+                    while len(self._cache) > self.cache_size:
+                        self._cache.popitem(last=False)
+        return out
+
+    def process_batch(self, batch: RecordBatch,
+                      input_index: int = 0) -> List[RecordBatch]:
+        n = len(batch)
+        if n == 0:
+            return []
+        if self.key_field not in batch.columns:
+            raise RuntimeError(
+                f"lookup join key {self.key_field!r} missing from batch "
+                f"columns {batch.names()}")
+        key_vals = np.asarray(batch[self.key_field])
+        rows = self._fetch(key_vals)
+        hit = np.asarray([rows[k] is not None
+                          for k in key_vals.tolist()], dtype=bool)
+        if not self.left_outer:
+            batch = batch.filter(hit)
+            key_vals = key_vals[hit]
+            if len(batch) == 0:
+                return []
+        kc = self.fn.key_column
+        names = self.right_columns
+        if names is None:
+            # undeclared schema: derive from observed rows (programmatic
+            # use); declared columns are preferred for a stable schema
+            seen = {c for k in key_vals.tolist()
+                    for c in (rows[k] or {})}
+            names = sorted(seen) or [kc]
+        vals: Dict[str, List] = {c: [] for c in names}
+        for k in key_vals.tolist():
+            row = rows[k] or {}
+            for c in names:
+                vals[c].append(row.get(c, np.nan))
+        out = {}
+        lcols = batch.columns
+        for c, v in lcols.items():
+            if c in names and c not in (TIMESTAMP_FIELD,):
+                out[c + self.suffixes[0]] = v
+            else:
+                out[c] = v
+        for c in names:
+            arr = np.asarray(vals[c])
+            name = c + self.suffixes[1] if c in lcols else c
+            out[name] = arr
+        return [RecordBatch(out)]
+
+    def close(self) -> List[RecordBatch]:
+        self.fn.close()
+        return []

@@ -229,7 +229,7 @@ class WindowAggOperator(Operator):
             from flink_tpu.parallel.mesh import make_mesh
             from flink_tpu.parallel.sharded_windower import MeshWindowEngine
 
-            self._warn_backend_ignored_on_mesh()
+            self._reject_backend_on_mesh()
             mesh = getattr(ctx, "mesh", None) or make_mesh(effective)
             spill = dict(self.spill or {})
             self.windower = MeshWindowEngine(
@@ -287,7 +287,7 @@ class WindowAggOperator(Operator):
                     fire_projector=self.fire_projector)
         self._resolve_async_fires(ctx)
 
-    def _warn_backend_ignored_on_mesh(self) -> None:
+    def _reject_backend_on_mesh(self) -> None:
         if self.state_backend not in ("tpu-slot-table",):
             # fail loudly, never degrade silently (same contract as
             # execution.stage-fallback): the mesh engine shards state
@@ -574,7 +574,7 @@ class SessionWindowAggOperator(WindowAggOperator):
             from flink_tpu.parallel.mesh import make_mesh
             from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
 
-            self._warn_backend_ignored_on_mesh()
+            self._reject_backend_on_mesh()
             mesh = getattr(ctx, "mesh", None) or make_mesh(effective)
             spill = dict(self.spill or {})
             self.windower = MeshSessionEngine(

@@ -243,8 +243,21 @@ class StreamTableEnvironment:
         self._catalog: Dict[str, Table] = {}
         #: INSERT INTO targets: name -> (sink, declared columns or None)
         self._sink_tables: Dict[str, tuple] = {}
+        #: lookup (dimension) tables: name -> (LookupFunction, columns)
+        #: joined via FOR SYSTEM_TIME AS OF (reference: LookupTableSource)
+        self._lookup_tables: Dict[str, tuple] = {}
         #: CREATE MODEL / ML_PREDICT catalog (reference: CatalogModel)
         self.models = ModelRegistry()
+
+    def create_lookup_table(self, name: str, lookup_fn,
+                            columns: Sequence[str],
+                            cache_size: int = 10_000) -> None:
+        """Register a LookupFunction as a dimension table for lookup
+        joins: ``JOIN name FOR SYSTEM_TIME AS OF o.rowtime ON ...``
+        (reference: a LookupTableSource-backed catalog table; the cache
+        maps FLIP-221 'lookup.cache')."""
+        self._lookup_tables[name] = (lookup_fn, list(columns),
+                                     int(cache_size))
 
     def create_temporary_model(self, name: str, model) -> None:
         """Register a Model object for ML_PREDICT (the programmatic form

@@ -931,6 +931,92 @@ class Planner:
                                          suffixes=("_l", "_r")),
             "sql_join")
 
+    def _plan_lookup_join(self, join: ast.Join) -> PlannedTable:
+        """JOIN dim FOR SYSTEM_TIME AS OF o.rowtime ON o.k = dim.k where
+        ``dim`` is a registered lookup table — the enrichment pattern
+        (reference: StreamExecLookupJoin -> LookupJoinRunner; the
+        reference's AS OF proctime instant maps to lookup-at-arrival
+        here, with the left rowtime column naming the stream side)."""
+        from flink_tpu.connectors.lookup import LookupJoinOperator
+
+        fn, r_columns, cache_size = \
+            self.t_env._lookup_tables[join.right.name]
+        left = self._plan_table_ref(join.left)
+        if left.upsert_keys is not None:
+            raise PlanError(
+                "lookup join over an updating (changelog) input is not "
+                "supported — the stream side must be insert-only")
+        l_aliases = self._collect_aliases(join.left)
+        r_alias = join.right.alias or join.right.name
+        left_outer = join.kind == "LEFT"
+        # the AS OF instant must be the stream side's time attribute
+        # (the reference requires a proctime attribute; here the left
+        # rowtime column names the lookup-at-arrival instant)
+        as_of = self._strip(join.temporal, left, l_aliases)
+        if left.time_field is None or not isinstance(as_of, Column) \
+                or as_of.name != left.time_field:
+            raise PlanError(
+                "lookup join FOR SYSTEM_TIME AS OF must reference the "
+                "stream side's event-time column "
+                f"({left.time_field!r})")
+        # the ON clause: exactly one equality between a left column and
+        # the lookup table's key column
+        conjuncts = _split_conjuncts(join.condition)
+        if len(conjuncts) != 1 or not (
+                isinstance(conjuncts[0], BinaryOp)
+                and conjuncts[0].op == "="):
+            raise PlanError(
+                "lookup join requires exactly one equality predicate "
+                "(left_col = dim_key)")
+        eq = conjuncts[0]
+
+        def _unqualify(e: Expr) -> Optional[Column]:
+            if not isinstance(e, Column):
+                return None
+            return Column(e.name)
+
+        sides = {}
+        for e in (eq.left, eq.right):
+            c = _unqualify(e)
+            if c is None:
+                raise PlanError(
+                    "lookup join ON sides must be plain columns")
+            q = e.table
+            if q == r_alias or (q is None and c.name in r_columns
+                                and c.name not in left.columns):
+                sides["r"] = c
+            else:
+                sides["l"] = c
+        if set(sides) != {"l", "r"}:
+            raise PlanError(
+                "lookup join ON must equate a stream column with the "
+                "lookup table's key column")
+        if sides["r"].name != fn.key_column:
+            raise PlanError(
+                f"lookup table {join.right.name!r} is keyed by "
+                f"{fn.key_column!r}; ON references {sides['r'].name!r}")
+        key_field = sides["l"].name
+        if key_field not in left.columns:
+            raise PlanError(
+                f"lookup join key {key_field!r} is not a column of the "
+                "stream side")
+        t = Transformation(
+            name="sql_lookup_join", kind="one_input",
+            operator_factory=lambda: LookupJoinOperator(
+                fn, key_field, right_columns=r_columns,
+                suffixes=("_l", "_r"),
+                cache_size=cache_size, left_outer=left_outer),
+            inputs=[left.stream.transformation])
+        joined = DataStream(self.env, t)
+        out_cols: List[str] = []
+        for c in left.columns:
+            out_cols.append(c + "_l" if c in r_columns else c)
+        for c in r_columns:
+            out_cols.append(c + "_r" if c in left.columns else c)
+        return PlannedTable(joined, out_cols, None,
+                            left.time_field
+                            if left.time_field in out_cols else None)
+
     def _lower_keyed_join(self, left: PlannedTable, right: PlannedTable,
                           l_aliases, r_aliases,
                           equi: List[Tuple[Expr, Expr]],
@@ -978,6 +1064,9 @@ class Planner:
         by their rowtime)."""
         from flink_tpu.runtime.join_operators import TemporalJoinOperator
 
+        if isinstance(join.right, ast.NamedTable) and \
+                join.right.name in self.t_env._lookup_tables:
+            return self._plan_lookup_join(join)
         if join.kind != "INNER":
             raise PlanError(
                 "temporal join supports INNER only (the reference "

@@ -44,6 +44,36 @@ class TestJsonSchema:
                                   b'{"k": 2}'])
         assert b["k"].tolist() == [1, 2]
 
+    def test_type_coercion_failures_skippable(self):
+        """ignore-parse-errors covers CONVERSION failures too (the
+        reference's contract): one bad-typed field skips one record."""
+        s = JsonRowDeserializationSchema(["k"], ["BIGINT"],
+                                         ignore_parse_errors=True)
+        b = s.deserialize_batch([b'{"k": 1}', b'{"k": "abc"}',
+                                 b'{"k": 2}'])
+        assert b["k"].tolist() == [1, 2]
+        s2 = JsonRowDeserializationSchema(["k"], ["BIGINT"])
+        with pytest.raises(RuntimeError, match="deserialize"):
+            s2.deserialize_batch([b'{"k": "abc"}'])
+
+    def test_broker_timestamps_survive_the_format_seam(self):
+        from flink_tpu.connectors.kafka import FakeBroker, KafkaSource
+
+        broker = FakeBroker.get("default")
+        broker.create_topic("jts", 1)
+        ts = np.asarray([5, 6, 7], dtype=np.int64)
+        broker.append_raw("jts", 0,
+                          [b'{"k": 1}', b'{"k": 2}', b'{"k": 3}'],
+                          timestamps=ts)
+        from flink_tpu.connectors.formats import (
+            JsonRowDeserializationSchema as J,
+        )
+
+        src = KafkaSource("jts", value_format=J(["k"], ["BIGINT"]))
+        src.open(0, 1)
+        b = src.poll_batch(10)
+        assert b.has_timestamps and b.timestamps.tolist() == [5, 6, 7]
+
     def test_serialize_roundtrip(self):
         ser = JsonRowSerializationSchema(["k", "v"])
         de = JsonRowDeserializationSchema(["k", "v"],

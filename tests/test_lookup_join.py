@@ -1,0 +1,118 @@
+"""Lookup joins (dimension-table enrichment).
+
+reference: LookupTableSource / LookupFunction + StreamExecLookupJoin ->
+LookupJoinRunner, with the FLIP-221 lookup cache."""
+
+import numpy as np
+import pytest
+
+from flink_tpu import Configuration, StreamExecutionEnvironment
+from flink_tpu.connectors.lookup import (
+    LookupJoinOperator,
+    TableLookupFunction,
+)
+from flink_tpu.core.records import RecordBatch
+from flink_tpu.table.environment import StreamTableEnvironment
+
+
+def _dim():
+    return TableLookupFunction(
+        [{"cur": 1, "name": "EUR", "factor": 1.1},
+         {"cur": 2, "name": "GBP", "factor": 1.3}],
+        key_column="cur")
+
+
+class _Ctx:
+    max_parallelism = 128
+    operator_index = 0
+
+
+class TestOperator:
+    def _batch(self, curs):
+        return RecordBatch.from_pydict(
+            {"cur": np.asarray(curs, dtype=np.int64),
+             "amount": np.arange(len(curs), dtype=np.float64)})
+
+    def test_inner_drops_misses(self):
+        op = LookupJoinOperator(_dim(), "cur")
+        op.open(_Ctx())
+        out = op.process_batch(self._batch([1, 9, 2]))[0]
+        assert list(out["name"]) == ["EUR", "GBP"]
+        assert out["amount"].tolist() == [0.0, 2.0]
+
+    def test_left_outer_pads_misses(self):
+        op = LookupJoinOperator(_dim(), "cur", left_outer=True)
+        op.open(_Ctx())
+        out = op.process_batch(self._batch([1, 9]))[0]
+        assert len(out) == 2
+        assert out["amount"].tolist() == [0.0, 1.0]
+        assert list(out["name"])[0] == "EUR"
+
+    def test_declared_schema_stable_across_all_miss_batches(self):
+        """With declared columns, an all-miss LEFT batch still emits
+        every right column (one schema across batches)."""
+        op = LookupJoinOperator(_dim(), "cur",
+                                right_columns=["cur", "name", "factor"],
+                                left_outer=True)
+        op.open(_Ctx())
+        hit = op.process_batch(self._batch([1]))[0]
+        miss = op.process_batch(self._batch([9]))[0]
+        assert set(hit.names()) == set(miss.names())
+        assert "name" in miss.names() and "factor" in miss.names()
+
+    def test_cache_bounds_lookup_calls(self):
+        op = LookupJoinOperator(_dim(), "cur", cache_size=10)
+        op.open(_Ctx())
+        op.process_batch(self._batch([1, 2, 1, 2]))
+        assert op.lookups == 1
+        op.process_batch(self._batch([2, 1]))
+        assert op.lookups == 1  # all cached (incl. per-batch dedup)
+        op.process_batch(self._batch([9]))  # miss -> negative cached
+        assert op.lookups == 2
+        op.process_batch(self._batch([9]))
+        assert op.lookups == 2
+
+
+class TestLookupJoinSQL:
+    def _env(self):
+        from flink_tpu.connectors.kafka import FakeBroker
+
+        broker = FakeBroker.get("default")
+        broker.create_topic("lkp_orders", 1)
+        ts = np.asarray([1000, 2000, 3000], dtype=np.int64)
+        broker.append("lkp_orders", 0, RecordBatch.from_pydict(
+            {"cur": np.asarray([1, 9, 2], dtype=np.int64),
+             "amount": np.asarray([10.0, 20.0, 30.0]),
+             "ts": ts}, timestamps=ts))
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 2}))
+        tenv = StreamTableEnvironment(env)
+        tenv.execute_sql(
+            "CREATE TABLE lkp_orders (cur BIGINT, amount DOUBLE, "
+            "ts BIGINT, WATERMARK FOR ts AS ts) "
+            "WITH ('connector'='kafka', 'topic'='lkp_orders')")
+        tenv.create_lookup_table("rates_dim", _dim(),
+                                 ["cur", "name", "factor"])
+        return tenv
+
+    def test_enrichment_query(self):
+        tenv = self._env()
+        rows = tenv.execute_sql("""
+            SELECT o.amount * r.factor AS conv, r.name
+            FROM lkp_orders AS o
+            JOIN rates_dim FOR SYSTEM_TIME AS OF o.ts AS r
+            ON o.cur = r.cur
+        """).collect()
+        got = sorted((round(r["conv"], 2), r["name"]) for r in rows)
+        assert got == [(11.0, "EUR"), (39.0, "GBP")]
+
+    def test_wrong_key_column_rejected(self):
+        from flink_tpu.table.environment import PlanError
+
+        tenv = self._env()
+        with pytest.raises(PlanError, match="keyed by"):
+            tenv.execute_sql("""
+                SELECT o.amount FROM lkp_orders AS o
+                JOIN rates_dim FOR SYSTEM_TIME AS OF o.ts AS r
+                ON o.cur = r.name
+            """)
