@@ -89,6 +89,11 @@ def run(total_records: int, num_auctions: int = 100_000,
         "execution.micro-batch.size": batch_size,
         "state.slot-table.capacity": 1 << 20,
         "state.window-layout": layout,
+        # dispatch pipelining depth — the lever for a high-RTT device
+        # link (the tunneled TPU): deeper hides the RTT per batch,
+        # shallower keeps fire kernels from queueing behind scatters
+        "execution.pipeline.max-dispatch-batches": int(
+            os.environ.get("BENCH_DISPATCH_AHEAD", 4)),
     }))
     sink = CollectSink()
     # 100k events/s of event time -> a 2 s slide covers ~200k events, a 10 s

@@ -48,7 +48,15 @@ def resolve_format(name: str, columns: Sequence[str],
 
 
 class DeserializationSchema:
-    """raw byte records -> one typed columnar batch."""
+    """raw byte records -> one typed columnar batch.
+
+    After ``deserialize_batch``, ``last_surviving`` holds the RAW
+    indices of the records that made it into the batch (None = all of
+    them) — what lets per-record metadata attached to the raw stream
+    (broker timestamps) stay aligned when ignore-parse-errors skips
+    records."""
+
+    last_surviving: Optional[List[int]] = None
 
     def open(self) -> None:
         pass
@@ -92,7 +100,15 @@ def _columns_from_rows(rows: List[tuple], columns: Sequence[str],
             arr[:] = vals
             cols[name] = arr
         elif dt is None:
-            cols[name] = np.asarray(vals)
+            # untyped: numeric values infer their dtype; text stays an
+            # OBJECT array (a '<U' array would change equality and
+            # fill semantics batch-to-batch)
+            arr = np.asarray(vals)
+            if arr.dtype.kind in ("U", "S"):
+                obj = np.empty(len(vals), dtype=object)
+                obj[:] = vals
+                arr = obj
+            cols[name] = arr
         else:
             cols[name] = np.asarray(vals, dtype=dt)
     return cols
@@ -127,7 +143,8 @@ class JsonRowDeserializationSchema(DeserializationSchema):
     def deserialize_batch(self, raw: Sequence[bytes]) -> RecordBatch:
         dts = [_np_dtype(t) for t in self.types]
         rows: List[tuple] = []
-        for rec in raw:
+        surviving: List[int] = []
+        for i, rec in enumerate(raw):
             if isinstance(rec, (bytes, bytearray)):
                 rec = rec.decode("utf-8", errors="replace")
             # parse AND type-coerce inside the guarded path: the
@@ -141,6 +158,7 @@ class JsonRowDeserializationSchema(DeserializationSchema):
                 rows.append(tuple(
                     _coerce(obj.get(name), dt)
                     for name, dt in zip(self.columns, dts)))
+                surviving.append(i)
             except (ValueError, TypeError) as e:
                 if self.ignore_parse_errors:
                     continue
@@ -148,6 +166,8 @@ class JsonRowDeserializationSchema(DeserializationSchema):
                     f"failed to deserialize JSON record {rec!r}: {e} "
                     "(set 'json.ignore-parse-errors'='true' to skip "
                     "corrupt records)") from e
+        self.last_surviving = (None if len(surviving) == len(raw)
+                               else surviving)
         return RecordBatch.from_pydict(
             _columns_from_rows(rows, self.columns, dts))
 
@@ -217,12 +237,14 @@ class CsvRowDeserializationSchema(DeserializationSchema):
 
         dts = [_np_dtype(t) for t in self.types]
         rows: List[tuple] = []
-        for rec in raw:
+        surviving: List[int] = []
+        for i, rec in enumerate(raw):
             if isinstance(rec, (bytes, bytearray)):
                 rec = rec.decode("utf-8", errors="replace")
             # RFC-4180 parsing (quoted fields may hold the delimiter,
             # quotes, newlines) — symmetric with the serializer; type
-            # coercion happens here too so a bad field skips ONE record
+            # coercion happens here too so a bad field skips ONE record.
+            # Untyped columns keep their raw field text verbatim.
             try:
                 parts = next(_csv.reader([rec.rstrip("\r\n")],
                                          delimiter=self.delimiter), [])
@@ -231,9 +253,11 @@ class CsvRowDeserializationSchema(DeserializationSchema):
                         f"CSV record has {len(parts)} fields, expected "
                         f"{len(self.columns)}")
                 rows.append(tuple(
-                    _coerce(int(float(p)) if dt is np.int64 and p
-                            else (p or None), dt)
+                    p if dt is None
+                    else _coerce(int(float(p)) if dt is np.int64 and p
+                                 else (p or None), dt)
                     for p, dt in zip(parts, dts)))
+                surviving.append(i)
             except (ValueError, TypeError) as e:
                 if self.ignore_parse_errors:
                     continue
@@ -241,6 +265,8 @@ class CsvRowDeserializationSchema(DeserializationSchema):
                     f"failed to deserialize CSV record {rec!r}: {e} "
                     "(set 'csv.ignore-parse-errors'='true' to skip "
                     "corrupt records)") from e
+        self.last_surviving = (None if len(surviving) == len(raw)
+                               else surviving)
         return RecordBatch.from_pydict(
             _columns_from_rows(rows, self.columns, dts))
 

@@ -210,11 +210,16 @@ class KafkaPartitionReader(Source):
             raw_ts = batch.timestamps if batch.has_timestamps else None
             batch = self.deserializer.deserialize_batch(
                 list(batch[FakeBroker.RAW_FIELD]))
-            if raw_ts is not None and len(batch) == len(raw_ts):
-                # broker (log-append) timestamps survive the format seam
-                # — a schema that skipped corrupt records loses the
-                # per-record alignment, so only a full batch reattaches
-                batch = batch.with_timestamps(raw_ts)
+            if raw_ts is not None:
+                # broker (log-append) timestamps survive the format
+                # seam; the schema reports which raw records survived
+                # so skipped (corrupt) records keep the rest aligned
+                surviving = getattr(self.deserializer,
+                                    "last_surviving", None)
+                if surviving is not None:
+                    raw_ts = raw_ts[np.asarray(surviving, dtype=np.int64)]
+                if len(batch) == len(raw_ts):
+                    batch = batch.with_timestamps(raw_ts)
         return batch
 
     def snapshot_position(self) -> Dict[str, Any]:

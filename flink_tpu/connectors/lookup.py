@@ -148,11 +148,15 @@ class LookupJoinOperator(Operator):
             seen = {c for k in key_vals.tolist()
                     for c in (rows[k] or {})}
             names = sorted(seen) or [kc]
-        vals: Dict[str, List] = {c: [] for c in names}
-        for k in key_vals.tolist():
-            row = rows[k] or {}
-            for c in names:
-                vals[c].append(row.get(c, np.nan))
+        # columnar assembly: per-UNIQUE-key right values, gathered back
+        # to row positions with one inverse-index fancy index per column
+        # (K distinct keys per batch, not N rows, touch Python)
+        uniq, inv = np.unique(key_vals, return_inverse=True)
+        vals: Dict[str, np.ndarray] = {}
+        for c in names:
+            per_key = [(rows[k] or {}).get(c, np.nan)
+                       for k in uniq.tolist()]
+            vals[c] = np.asarray(per_key)[inv]
         out = {}
         lcols = batch.columns
         for c, v in lcols.items():
@@ -161,9 +165,8 @@ class LookupJoinOperator(Operator):
             else:
                 out[c] = v
         for c in names:
-            arr = np.asarray(vals[c])
             name = c + self.suffixes[1] if c in lcols else c
-            out[name] = arr
+            out[name] = vals[c]
         return [RecordBatch(out)]
 
     def close(self) -> List[RecordBatch]:

@@ -86,9 +86,37 @@ def load_slotmap() -> Optional[ctypes.CDLL]:
         lib.sm_erase.argtypes = [vp, i64, P(i64), P(i64), P(i32)]
         lib.sm_lookup.restype = None
         lib.sm_lookup.argtypes = [vp, i64, P(i64), P(i64), P(i32)]
+        lib.sm_group_rows.restype = i64
+        lib.sm_group_rows.argtypes = [P(i64), i64, P(i64), P(i32)]
         _lib = lib
         return _lib
 
 
 def slotmap_available() -> bool:
     return load_slotmap() is not None
+
+
+def group_matrix(keys, slots, sidx, n_slices: int):
+    """(unique keys, [K, n_slices] slot matrix) grouped by key in O(n)
+    via the native hash table — the window-fire matrix build (absent
+    cells stay at identity slot 0). The matrix is allocated RIGHT-SIZED
+    at K distinct keys (the native call only assigns row ids), so the
+    memory cost matches the np.unique path it replaces. Returns None
+    when the native library is unavailable (callers fall back)."""
+    import numpy as np
+
+    lib = load_slotmap()
+    if lib is None:
+        return None
+    n = len(keys)
+    keys = np.ascontiguousarray(keys, dtype=np.int64)
+    out_keys = np.empty(n, dtype=np.int64)
+    row_of = np.empty(n, dtype=np.int32)
+    c = ctypes
+    rows = lib.sm_group_rows(
+        keys.ctypes.data_as(c.POINTER(c.c_int64)), n,
+        out_keys.ctypes.data_as(c.POINTER(c.c_int64)),
+        row_of.ctypes.data_as(c.POINTER(c.c_int32)))
+    matrix = np.zeros((rows, n_slices), dtype=np.int32)
+    matrix[row_of, np.asarray(sidx)] = slots
+    return out_keys[:rows], matrix

@@ -1003,8 +1003,17 @@ class SlotTable:
         all_slots = np.concatenate([s for _, s in per_slice])
         all_sidx = np.concatenate(
             [np.full(len(s), i, dtype=np.int32) for i, s in per_slice])
-        keys, inv = np.unique(self.index.slot_key[all_slots],
-                              return_inverse=True)
+        all_keys = self.index.slot_key[all_slots]
+        from flink_tpu.native import group_matrix
+
+        # O(n) native hash grouping beats np.unique's O(n log n) sort on
+        # the per-fire hot path; keys come back in first-seen order (the
+        # fire result order is key-insensitive)
+        native = group_matrix(all_keys, all_slots.astype(np.int32),
+                              all_sidx, len(slice_ends))
+        if native is not None:
+            return native
+        keys, inv = np.unique(all_keys, return_inverse=True)
         matrix = np.zeros((len(keys), len(slice_ends)), dtype=np.int32)
         matrix[inv, all_sidx] = all_slots
         return keys, matrix

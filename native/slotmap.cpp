@@ -276,4 +276,39 @@ int64_t sm_erase(void* h, int64_t n, const int64_t* keys, const int64_t* nss,
   return erased;
 }
 
+// Assign a dense row id per DISTINCT key (first-seen order) — the O(n)
+// replacement for np.unique(..., return_inverse=True) on the per-fire
+// hot path. out_keys needs n int64s (only the first K are written),
+// out_row_of needs n int32s. Returns K, the number of distinct keys;
+// the caller allocates the [K, n_slices] fire matrix right-sized and
+// scatters with one vectorized numpy assignment.
+int64_t sm_group_rows(const int64_t* keys, int64_t n, int64_t* out_keys,
+                      int32_t* out_row_of) {
+  if (n == 0) return 0;
+  uint64_t nb = 1;
+  while (nb < (uint64_t)n * 2) nb <<= 1;
+  int64_t* tbl_key = (int64_t*)malloc(sizeof(int64_t) * nb);
+  int32_t* tbl_row = (int32_t*)malloc(sizeof(int32_t) * nb);
+  memset(tbl_row, 0xff, sizeof(int32_t) * nb);  // -1 = empty
+  int64_t rows = 0;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t k = keys[i];
+    uint64_t b = mix_hash((uint64_t)k, 0) & (nb - 1);
+    for (;;) {
+      if (tbl_row[b] < 0) {
+        tbl_key[b] = k;
+        tbl_row[b] = (int32_t)rows;
+        out_keys[rows++] = k;
+        break;
+      }
+      if (tbl_key[b] == k) break;
+      b = (b + 1) & (nb - 1);
+    }
+    out_row_of[i] = tbl_row[b];
+  }
+  free(tbl_key);
+  free(tbl_row);
+  return rows;
+}
+
 }  // extern "C"

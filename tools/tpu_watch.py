@@ -45,18 +45,22 @@ def capture():
     except subprocess.TimeoutExpired:
         results["runs"].append({"name": "bench_default", "error": "timeout"})
 
-    # 2. micro-batch sweep (smaller record count per point to bound time)
-    for bs in (1 << 15, 1 << 16, 1 << 17, 1 << 18, 1 << 19):
+    # 2. micro-batch x dispatch-depth sweep (smaller record count per
+    # point to bound time; depth is THE lever for the tunneled high-RTT
+    # device link)
+    for bs, da in ((1 << 16, 4), (1 << 17, 4), (1 << 18, 4),
+                   (1 << 19, 8), (1 << 17, 2), (1 << 17, 8),
+                   (1 << 18, 16)):
         e = dict(env, BENCH_RECORDS=str(10_000_000),
-                 BENCH_BATCH_SIZE=str(bs))
+                 BENCH_BATCH_SIZE=str(bs), BENCH_DISPATCH_AHEAD=str(da))
         try:
             p = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=e,
                                capture_output=True, text=True, timeout=1200)
-            results["runs"].append({"name": f"sweep_bs_{bs}",
+            results["runs"].append({"name": f"sweep_bs{bs}_da{da}",
                                     "rc": p.returncode, "stdout": p.stdout,
                                     "stderr": p.stderr[-4000:]})
         except subprocess.TimeoutExpired:
-            results["runs"].append({"name": f"sweep_bs_{bs}",
+            results["runs"].append({"name": f"sweep_bs{bs}_da{da}",
                                     "error": "timeout"})
         with open(os.path.join(OUT, f"capture_{stamp}.json"), "w") as f:
             json.dump(results, f, indent=1)
