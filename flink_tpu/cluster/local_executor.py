@@ -301,6 +301,15 @@ class LocalExecutor:
         # build nodes
         nodes: Dict[int, _Node] = {}
         default_par = self.config.get(CoreOptions.DEFAULT_PARALLELISM)
+        memory_manager = None
+        device_budget = self.config.get(StateOptions.DEVICE_MEMORY_BUDGET)
+        if device_budget:
+            from flink_tpu.core.memory import MemoryManager
+
+            # ONE managed pool for the whole job: every stateful
+            # operator's device footprint reserves from it (reference:
+            # MemoryManager.java per-slot managed memory)
+            memory_manager = MemoryManager(device_budget)
         for t in graph.nodes:
             op = t.operator_factory() if t.operator_factory else None
             node = _Node(t, op)
@@ -316,7 +325,8 @@ class LocalExecutor:
                                       async_fires=self.config.get(
                                           BatchOptions.ASYNC_FIRES),
                                       max_dispatch_ahead=self.config.get(
-                                          BatchOptions.MAX_DISPATCH_AHEAD))
+                                          BatchOptions.MAX_DISPATCH_AHEAD),
+                                      memory_manager=memory_manager)
                 op.open(ctx)
             nodes[t.uid] = node
             g = job_group.add_group(f"{t.name}#{t.uid}")

@@ -1038,9 +1038,11 @@ class _KeyedSubtask(threading.Thread):
                  shared_sinks: Optional[Dict[int, _SharedSink]] = None,
                  stage_index: int = 0,
                  routes: Optional[List[_OutputRoute]] = None,
-                 mesh_devices: int = 0):
+                 mesh_devices: int = 0, memory_manager=None):
         super().__init__(
             name=f"keyed-subtask-st{stage_index}-{index}", daemon=True)
+        #: managed device-memory pool shared across the job's subtasks
+        self.memory_manager = memory_manager
         self.shared_sinks = shared_sinks
         self.index = index
         self.parallelism = parallelism
@@ -1083,7 +1085,8 @@ class _KeyedSubtask(threading.Thread):
 
     def _run(self) -> None:
         ctx = OperatorContext(operator_index=self.index, parallelism=1,
-                              max_parallelism=self.max_parallelism)
+                              max_parallelism=self.max_parallelism,
+                              memory_manager=self.memory_manager)
         if self.mesh_devices > 1:
             # mesh x stage composition: this subtask opens its keyed
             # engine over a private sub-mesh — subtasks distribute across
@@ -1561,6 +1564,14 @@ class StageParallelExecutor:
                     source_index=i))
         shared_sinks: Dict[int, _SharedSink] = {}
         mesh_devices = cfg.get(DeploymentOptions.STAGE_MESH_DEVICES)
+        memory_manager = None
+        device_budget = cfg.get(StateOptions.DEVICE_MEMORY_BUDGET)
+        if device_budget:
+            from flink_tpu.core.memory import MemoryManager
+
+            # one pool across every subtask of the job (they share the
+            # process's device)
+            memory_manager = MemoryManager(device_budget)
         keyed: List[_KeyedSubtask] = []
         for m, stage in enumerate(plan.stages):
             for j in range(N):
@@ -1571,7 +1582,8 @@ class StageParallelExecutor:
                     max_par, coordinator, cfg,
                     shared_sinks=shared_sinks, stage_index=m,
                     routes=make_routes("stage", m, stage.outputs, j, ctx),
-                    mesh_devices=mesh_devices))
+                    mesh_devices=mesh_devices,
+                    memory_manager=memory_manager))
         for k in keyed:
             if restore_states:
                 k._restore_states = restore_states

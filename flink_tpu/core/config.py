@@ -270,6 +270,16 @@ class DeploymentOptions:
 
 
 class StateOptions:
+    DEVICE_MEMORY_BUDGET = ConfigOption(
+        "memory.device.size", default=0, type=int,
+        description="Managed device (HBM) memory budget in BYTES shared "
+        "by every stateful operator of a job — the "
+        "taskmanager.memory.managed.size role (reference: "
+        "MemoryManager.java). Slot tables and pane rings reserve their "
+        "accumulator footprint from this pool at creation and each "
+        "growth; an over-budget reservation fails with a per-operator "
+        "breakdown instead of an opaque device OOM. 0 (default) = "
+        "unlimited.")
     BACKEND = ConfigOption(
         "state.backend", default="tpu-slot-table", type=str,
         description="Keyed-state backend (flink_tpu.state.backends SPI): "

@@ -97,12 +97,15 @@ class MeshSessionEngine(MeshSpillSupport):
         spill_dir: Optional[str] = None,
         spill_host_max_bytes: int = 0,
         key_group_range: Optional[Tuple[int, int]] = None,
+        memory=None,
     ) -> None:
         self.gap = int(gap)
         self.agg = agg
         #: (first, last) inclusive GLOBAL key groups this engine owns; the
         #: mesh shards within the range (mesh x stage — see shard_records)
         self.key_group_range = key_group_range
+        #: (MemoryManager, owner) — managed [P, capacity] accounting
+        self._memory = memory
         self.mesh = mesh
         self.P = int(mesh.devices.size)
         #: per-SHARD HBM slot budget; cold sessions spill per shard and
@@ -136,6 +139,7 @@ class MeshSessionEngine(MeshSpillSupport):
         ]
         self._init_spill(spill_dir, spill_host_max_bytes)
         self._sharding = NamedSharding(mesh, P(KEY_AXIS))
+        self._reserve_rows(self.P * self.capacity)
         self.accs: Tuple[jnp.ndarray, ...] = tuple(
             jax.device_put(
                 jnp.full((self.P, self.capacity), leaf.identity,
@@ -164,6 +168,7 @@ class MeshSessionEngine(MeshSpillSupport):
         shard index (same contract as MeshWindowEngine)."""
         if new_capacity <= self.capacity:
             return
+        self._reserve_rows(self.P * (new_capacity - self.capacity))
         old = self.capacity
         self.capacity = new_capacity
         grown = []

@@ -59,6 +59,21 @@ class MeshSpillSupport:
     the ``_gather_step/_reset_step/_put_step`` programs."""
 
     max_device_slots: int = 0
+    #: (MemoryManager, owner) — managed accounting of the [P, capacity]
+    #: device footprint (flink_tpu/core/memory.py); None = unmanaged
+    _memory = None
+
+    def _reserve_rows(self, rows: int) -> None:
+        if self._memory is not None:
+            manager, owner = self._memory
+            manager.reserve(owner, rows * sum(
+                np.dtype(leaf.dtype).itemsize
+                for leaf in self.agg.leaves))
+
+    def release_memory(self) -> None:
+        if self._memory is not None:
+            manager, owner = self._memory
+            manager.release_all(owner)
 
     def _init_spill(self, spill_dir: Optional[str],
                     spill_host_max_bytes: int) -> None:
@@ -346,12 +361,16 @@ class MeshWindowEngine(MeshSpillSupport):
         spill_dir: Optional[str] = None,
         spill_host_max_bytes: int = 0,
         key_group_range: Optional[Tuple[int, int]] = None,
+        memory=None,
     ) -> None:
         self.assigner = assigner
         self.agg = agg
         #: (first, last) inclusive GLOBAL key groups this engine owns; the
         #: mesh shards within the range (mesh x stage — see shard_records)
         self.key_group_range = key_group_range
+        #: (MemoryManager, owner) — the [P, capacity] accumulator
+        #: footprint is managed like the single-device table's
+        self._memory = memory
         #: host-side (cross-shard) fired-row reduction; the single-device
         #: engine fuses this into the fire kernel, here it runs after the
         #: per-shard results are assembled (the per-shard transfer is
@@ -397,6 +416,7 @@ class MeshWindowEngine(MeshSpillSupport):
         self._init_spill(spill_dir, spill_host_max_bytes)
         self._sharding = NamedSharding(mesh, P(KEY_AXIS))
         self._replicated = NamedSharding(mesh, P())
+        self._reserve_rows(self.P * self.capacity)
         self.accs: Tuple[jnp.ndarray, ...] = tuple(
             jax.device_put(
                 jnp.full((self.P, self.capacity), leaf.identity,
@@ -433,6 +453,7 @@ class MeshWindowEngine(MeshSpillSupport):
         address a prefix)."""
         if new_capacity <= self.capacity:
             return
+        self._reserve_rows(self.P * (new_capacity - self.capacity))
         old = self.capacity
         self.capacity = new_capacity
         grown = []

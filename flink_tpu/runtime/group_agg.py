@@ -76,8 +76,15 @@ class GroupAggOperator(Operator):
         self._last_emitted: Dict[str, np.ndarray] = {}
 
     def open(self, ctx):
-        self.table = SlotTable(self.agg, capacity=self.capacity,
-                               max_parallelism=ctx.max_parallelism)
+        mm = getattr(ctx, "memory_manager", None)
+        self.table = SlotTable(
+            self.agg, capacity=self.capacity,
+            max_parallelism=ctx.max_parallelism,
+            memory=(mm, f"{self.name}#{id(self):x}") if mm else None)
+
+    def dispose(self):
+        if self.table is not None:
+            self.table.release_memory()
 
     # ------------------------------------------------------------- host state
 

@@ -203,16 +203,34 @@ class IntervalJoinOperator(Operator):
     reference: streaming/api/operators/co/IntervalJoinOperator.java —
     re-designed over columnar side buffers pruned by watermark instead of
     per-key MapState buckets + per-record timers.
-    """
+
+    ``left_outer`` (LEFT JOIN): a left row whose interval expires without
+    any match emits once, null-padded on the right, at the moment the
+    watermark proves no match can still arrive (t + upper) — the
+    reference's outer interval-join semantics. ``right_columns`` names
+    the right schema so null-padded rows keep one stable shape even
+    before any right row is seen."""
 
     name = "interval_join"
 
-    def __init__(self, lower: int, upper: int, suffixes=("_l", "_r")):
+    def __init__(self, lower: int, upper: int, suffixes=("_l", "_r"),
+                 left_outer: bool = False,
+                 right_columns: Optional[List[str]] = None):
         assert lower <= upper
         self.lower = lower
         self.upper = upper
         self.suffixes = suffixes
+        self.left_outer = left_outer
+        if left_outer and right_columns is None:
+            raise ValueError(
+                "LEFT interval join needs the right-side column names "
+                "(null padding must have a stable schema)")
+        self.right_columns = list(right_columns) if right_columns \
+            else None
         self._left: List[RecordBatch] = []
+        #: per-buffered-left-row "has matched" flags, parallel to the
+        #: concatenation of self._left (only maintained when left_outer)
+        self._left_matched: List[np.ndarray] = []
         self._right: List[RecordBatch] = []
         self._max_parallelism = 128
 
@@ -224,24 +242,37 @@ class IntervalJoinOperator(Operator):
             return []
         out = []
         if input_index == 0:
-            matches = self._join(batch, RecordBatch.concat(self._right),
-                                 left_is_new=True)
+            matches, l_hit = self._join(
+                batch, RecordBatch.concat(self._right), left_is_new=True)
             self._left.append(batch)
+            if self.left_outer:
+                flags = np.zeros(len(batch), dtype=bool)
+                if l_hit is not None:
+                    flags[l_hit] = True
+                self._left_matched.append(flags)
         else:
-            matches = self._join(RecordBatch.concat(self._left), batch,
-                                 left_is_new=False)
+            matches, l_hit = self._join(
+                RecordBatch.concat(self._left), batch, left_is_new=False)
             self._right.append(batch)
+            if self.left_outer and l_hit is not None and \
+                    len(self._left_matched):
+                merged = (self._left_matched[0]
+                          if len(self._left_matched) == 1
+                          else np.concatenate(self._left_matched))
+                merged[l_hit] = True
+                self._left_matched = [merged]
         if matches is not None and len(matches):
             out.append(matches)
         return out
 
     def _join(self, left: RecordBatch, right: RecordBatch,
-              left_is_new: bool) -> Optional[RecordBatch]:
+              left_is_new: bool):
+        """(matched batch or None, matching LEFT row indices or None)."""
         if len(left) == 0 or len(right) == 0:
-            return None
+            return None, None
         l_idx, r_idx = equi_join_indices(left.key_ids, right.key_ids)
         if len(l_idx) == 0:
-            return None
+            return None, None
         lt = left.timestamps[l_idx]
         rt = right.timestamps[r_idx]
         ok = (rt >= lt + self.lower) & (rt <= lt + self.upper)
@@ -255,18 +286,62 @@ class IntervalJoinOperator(Operator):
         # other side's buffer, never its own)
         l_idx, r_idx = l_idx[ok], r_idx[ok]
         if len(l_idx) == 0:
-            return None
+            return None, None
         cols = _merge_columns(left, right, l_idx, r_idx, self.suffixes)
         cols[TIMESTAMP_FIELD] = np.maximum(lt[ok], rt[ok])
+        return RecordBatch(cols), l_idx
+
+    def _pad_unmatched(self, rows: RecordBatch) -> RecordBatch:
+        """Null-extend expired unmatched left rows with the SAME column
+        naming _merge_columns produces for matches."""
+        lts = rows.timestamps
+        left_b = rows.drop(TIMESTAMP_FIELD)
+        rset = set(self.right_columns)
+        cols: Dict[str, np.ndarray] = {}
+        for k, v in left_b.columns.items():
+            if k in rset and k != KEY_ID_FIELD:
+                cols[k + self.suffixes[0]] = v
+            else:
+                cols[k] = v
+        n = len(rows)
+        for k in self.right_columns:
+            if k in (KEY_ID_FIELD, TIMESTAMP_FIELD):
+                continue
+            name = k + self.suffixes[1] if k in left_b.columns else k
+            cols[name] = np.full(n, np.nan)
+        cols[TIMESTAMP_FIELD] = lts
         return RecordBatch(cols)
 
     def process_watermark(self, watermark, input_index=0):
         # prune buffers: left rows can only match right in
-        # [t+lower, t+upper]; once watermark passes t+upper the left row is
-        # dead (and symmetrically for right)
-        self._left = self._prune(self._left, watermark - self.upper)
+        # [t+lower, t+upper]; once watermark passes t+upper the left row
+        # is dead (and symmetrically for right). A dead UNMATCHED left
+        # row is exactly when LEFT JOIN null-extends.
+        out: List[RecordBatch] = []
+        min_left_ts = watermark - self.upper
+        if self.left_outer and self._left:
+            merged = RecordBatch.concat(self._left)
+            matched = (self._left_matched[0]
+                       if len(self._left_matched) == 1
+                       else np.concatenate(self._left_matched)) \
+                if self._left_matched else np.zeros(len(merged), bool)
+            dead = merged.timestamps < min_left_ts
+            expired = dead & ~matched
+            if expired.any():
+                out.append(self._pad_unmatched(merged.filter(expired)))
+            keep = ~dead
+            self._left = [merged.filter(keep)] if keep.any() else []
+            self._left_matched = [matched[keep]] if keep.any() else []
+        else:
+            self._left = self._prune(self._left, min_left_ts)
         self._right = self._prune(self._right, watermark + self.lower)
-        return []
+        return out
+
+    def close(self):
+        from flink_tpu.runtime.elements import MAX_WATERMARK
+
+        # end of input: every buffered left row's interval has expired
+        return self.process_watermark(MAX_WATERMARK)
 
     @staticmethod
     def _prune(batches: List[RecordBatch], min_ts: int) -> List[RecordBatch]:
@@ -281,22 +356,57 @@ class IntervalJoinOperator(Operator):
         return [merged.filter(keep)]
 
     def snapshot_state(self):
-        return {
+        snap = {
             "left": [dict(b.columns) for b in self._left],
             "right": [dict(b.columns) for b in self._right],
         }
+        if self.left_outer:
+            # ONE flags array aligned to the CONCATENATION of the left
+            # buffers — a right-side match merges the per-batch arrays,
+            # so batch-parallel storage would misalign on restore
+            if self._left_matched:
+                snap["ij_matched"] = np.concatenate(
+                    [np.asarray(m) for m in self._left_matched])
+            else:
+                snap["ij_matched"] = np.zeros(
+                    sum(len(b) for b in self._left), dtype=bool)
+        return snap
 
     def restore_state(self, state, key_group_filter=None):
         left = state.get("left", [])
         right = state.get("right", [])
+        if self.left_outer and left:
+            # normalize the left side to ONE batch + one flags array so
+            # the key-group filter applies to both identically
+            merged = RecordBatch.concat([RecordBatch(
+                {k: np.asarray(v) for k, v in c.items()}) for c in left])
+            matched = np.asarray(
+                state.get("ij_matched",
+                          np.zeros(len(merged), dtype=bool)), dtype=bool)
+            if key_group_filter is not None:
+                from flink_tpu.state.keygroups import assign_key_groups
+
+                kid = np.asarray(merged.key_ids, dtype=np.int64)
+                groups = assign_key_groups(kid, self._max_parallelism)
+                keep = np.isin(groups,
+                               np.asarray(sorted(key_group_filter)))
+                merged = merged.filter(keep)
+                matched = matched[keep]
+            self._left = [merged] if len(merged) else []
+            self._left_matched = [matched] if len(merged) else []
+        else:
+            if key_group_filter is not None:
+                left = [_filter_by_key_groups(c, key_group_filter,
+                                              self._max_parallelism)
+                        for c in left]
+            self._left = [RecordBatch(c) for c in left]
+            if self.left_outer:
+                self._left_matched = [np.zeros(len(b), dtype=bool)
+                                      for b in self._left]
         if key_group_filter is not None:
-            left = [_filter_by_key_groups(c, key_group_filter,
-                                          self._max_parallelism)
-                    for c in left]
             right = [_filter_by_key_groups(c, key_group_filter,
                                            self._max_parallelism)
                      for c in right]
-        self._left = [RecordBatch(c) for c in left]
         self._right = [RecordBatch(c) for c in right]
 
 

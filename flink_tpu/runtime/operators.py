@@ -84,11 +84,14 @@ class OperatorContext:
     def __init__(self, operator_index: int = 0, parallelism: int = 1,
                  max_parallelism: int = 128, metrics=None,
                  async_fires: bool = False, max_dispatch_ahead: int = 4,
-                 mesh=None, key_group_range=None):
+                 mesh=None, key_group_range=None, memory_manager=None):
         self.operator_index = operator_index
         self.parallelism = parallelism
         self.max_parallelism = max_parallelism
         self.metrics = metrics
+        #: managed device-memory pool shared by the job's stateful
+        #: operators (flink_tpu/core/memory.py; None = unlimited)
+        self.memory_manager = memory_manager
         #: explicit device mesh for the keyed engine (mesh x stage: a
         #: keyed subtask opens its engine over a private sub-mesh)
         self.mesh = mesh
@@ -244,9 +247,12 @@ class WindowAggOperator(Operator):
                 max_device_slots=spill.get("max_device_slots", 0),
                 spill_dir=spill.get("spill_dir"),
                 spill_host_max_bytes=spill.get("spill_host_max_bytes", 0),
-                key_group_range=getattr(ctx, "key_group_range", None))
+                key_group_range=getattr(ctx, "key_group_range", None),
+                memory=self._managed_memory(ctx))
         else:
             table_kwargs, placement = self._table_kwargs()
+            if self._managed_memory(ctx) is not None:
+                table_kwargs["memory"] = self._managed_memory(ctx)
             has_spill = bool(self.spill and any(self.spill.values()))
             # 'auto' currently resolves to the slot layout: the pane
             # layout's dense fires measure SLOWER on CPU, and its win case
@@ -277,7 +283,8 @@ class WindowAggOperator(Operator):
                     self.assigner, self.agg, capacity=self.capacity,
                     max_parallelism=ctx.max_parallelism,
                     allowed_lateness=self.allowed_lateness,
-                    fire_projector=self.fire_projector)
+                    fire_projector=self.fire_projector,
+                    memory=self._managed_memory(ctx))
             else:
                 self.windower = SliceSharedWindower(
                     self.assigner, self.agg, capacity=self.capacity,
@@ -286,6 +293,14 @@ class WindowAggOperator(Operator):
                     spill=table_kwargs,
                     fire_projector=self.fire_projector)
         self._resolve_async_fires(ctx)
+
+    def _managed_memory(self, ctx):
+        """(MemoryManager, unique owner) for device-state accounting, or
+        None when no budget is configured (flink_tpu/core/memory.py)."""
+        mm = getattr(ctx, "memory_manager", None)
+        if mm is None:
+            return None
+        return (mm, f"{self.name}#{id(self):x}")
 
     def _reject_backend_on_mesh(self) -> None:
         if self.state_backend not in ("tpu-slot-table",):
@@ -469,6 +484,12 @@ class WindowAggOperator(Operator):
     def dispose(self):
         self._pending.clear()
         self._fences.clear()
+        release = getattr(self.windower, "release_memory", None)
+        if release is None:
+            table = getattr(self.windower, "table", None)
+            release = getattr(table, "release_memory", None)
+        if release is not None:
+            release()
 
     def _check_no_pending(self) -> None:
         # the hosting executor must drain (and forward) in-flight fires
@@ -586,9 +607,12 @@ class SessionWindowAggOperator(WindowAggOperator):
                 max_device_slots=spill.get("max_device_slots", 0),
                 spill_dir=spill.get("spill_dir"),
                 spill_host_max_bytes=spill.get("spill_host_max_bytes", 0),
-                key_group_range=getattr(ctx, "key_group_range", None))
+                key_group_range=getattr(ctx, "key_group_range", None),
+                memory=self._managed_memory(ctx))
         else:
             table_kwargs, _ = self._table_kwargs()
+            if self._managed_memory(ctx) is not None:
+                table_kwargs["memory"] = self._managed_memory(ctx)
             self.windower = SessionWindower(
                 self.gap, self.agg, capacity=self.capacity,
                 max_parallelism=ctx.max_parallelism,

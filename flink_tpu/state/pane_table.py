@@ -138,13 +138,20 @@ class PaneTable:
     """Ring-of-slices × key-rows window state (see module docstring)."""
 
     def __init__(self, agg: AggregateFunction, capacity: int = 1 << 16,
-                 max_parallelism: int = 128, fire_projector=None):
+                 max_parallelism: int = 128, fire_projector=None,
+                 memory=None):
         self.agg = agg
         self.max_parallelism = max_parallelism
         self.fire_projector = fire_projector
+        #: (MemoryManager, owner) — the DENSE [R, capacity] per-leaf
+        #: footprint (plus the int8 presence plane) is managed
+        #: (flink_tpu/core/memory.py), the layout most likely to exhaust
+        #: HBM on high-ratio sliding windows
+        self._memory = memory
         self.index = make_slot_index(capacity, on_grow=self._grow_cols)
         self.capacity = self.index.capacity
         self.R = _INITIAL_RING
+        self._reserve_cells(self.R * self.capacity)
         self.accs = tuple(
             jnp.full((self.R, self.capacity), l.identity, dtype=l.dtype)
             for l in agg.leaves
@@ -163,7 +170,22 @@ class PaneTable:
 
     # ---------------------------------------------------------------- sizing
 
+    def _cell_bytes(self) -> int:
+        return sum(np.dtype(l.dtype).itemsize
+                   for l in self.agg.leaves) + 1  # + presence plane
+
+    def _reserve_cells(self, cells: int) -> None:
+        if self._memory is not None:
+            manager, owner = self._memory
+            manager.reserve(owner, cells * self._cell_bytes())
+
+    def release_memory(self) -> None:
+        if self._memory is not None:
+            manager, owner = self._memory
+            manager.release_all(owner)
+
     def _grow_cols(self, old: int, new: int) -> None:
+        self._reserve_cells(self.R * (new - old))
         self.capacity = new
         grown = []
         for a, l in zip(self.accs[:-1], self.agg.leaves):
@@ -176,6 +198,7 @@ class PaneTable:
     def _alloc_row(self, slice_end: int) -> int:
         if not self._free_rows:
             old = self.R
+            self._reserve_cells(old * self.capacity)  # doubling the ring
             self.R = old * 2
             grown = []
             for a, l in zip(self.accs[:-1], self.agg.leaves):

@@ -566,6 +566,7 @@ class SlotTable:
         max_device_slots: int = 0,
         spill_dir: Optional[str] = None,
         spill_host_max_bytes: int = 0,
+        memory=None,
     ) -> None:
         self.agg = agg
         self.max_parallelism = max_parallelism
@@ -573,6 +574,10 @@ class SlotTable:
         self.max_device_slots = int(max_device_slots or 0)
         if self.max_device_slots:
             capacity = min(capacity, self.max_device_slots)
+        #: (MemoryManager, owner) — managed accounting of the device
+        #: accumulator footprint (reference: MemoryManager.java pages;
+        #: here bytes, reserved at creation and each growth)
+        self._memory = memory
         self.spill = SpillTier(spill_dir, spill_host_max_bytes)
         self._ns_touch: Dict[int, int] = {}
         self._touch_clock = 0
@@ -583,6 +588,7 @@ class SlotTable:
                        "state.slot-table.max-device-slots"
                        if self.max_device_slots
                        else "raise state.slot-table.capacity"))
+        self._reserve_rows(self.index.capacity)
         self.accs: Tuple[jnp.ndarray, ...] = agg.init_accumulators(
             self.index.capacity)
         if device is not None:
@@ -604,6 +610,24 @@ class SlotTable:
         self._dirty = np.zeros(self.index.capacity, dtype=bool)
         self._freed_ns: List[int] = []
         self._gather_bucket = 0
+
+    # ------------------------------------------------------------- memory
+
+    def _row_bytes(self) -> int:
+        return sum(np.dtype(leaf.dtype).itemsize
+                   for leaf in self.agg.leaves)
+
+    def _reserve_rows(self, rows: int) -> None:
+        if self._memory is not None:
+            manager, owner = self._memory
+            manager.reserve(owner, rows * self._row_bytes())
+
+    def release_memory(self) -> None:
+        """Return this table's reservation to the pool (dispose path)."""
+        if self._memory is not None:
+            manager, owner = self._memory
+            manager.release(owner, self.index.capacity
+                            * self._row_bytes())
 
     # ------------------------------------------------------------------ info
 
@@ -820,6 +844,7 @@ class SlotTable:
             self.accs, pad_i32(all_slots, rsize, fill=0))
 
     def _grow_device(self, old: int, new: int) -> None:
+        self._reserve_rows(new - old)
         self.accs = tuple(
             jnp.concatenate(
                 [a, jnp.full((new - old,), leaf.identity, dtype=leaf.dtype)])

@@ -888,7 +888,7 @@ class Planner:
     def _plan_join(self, join: ast.Join) -> PlannedTable:
         if join.temporal is not None:
             return self._plan_temporal_join(join)
-        if join.kind != "INNER":
+        if join.kind not in ("INNER", "LEFT"):
             raise PlanError(f"{join.kind} JOIN is not supported yet")
         left = self._plan_table_ref(join.left)
         right = self._plan_table_ref(join.right)
@@ -920,15 +920,37 @@ class Planner:
             residual.append(c)
         if not equi:
             raise PlanError("JOIN requires at least one equality predicate")
+        left_outer = join.kind == "LEFT"
+        if left_outer and residual:
+            # a residual applied as a post-filter would DROP null-padded
+            # rows instead of null-extending when the predicate fails on
+            # a matched pair — reject rather than silently change LEFT
+            # semantics (reference: non-equi conditions are part of the
+            # join for outer joins)
+            raise PlanError(
+                "LEFT JOIN supports only equality and event-time-bound "
+                "conditions; move other predicates to WHERE (changing "
+                "the null-extension semantics) or use INNER JOIN")
+        if left_outer and time_bounds is None:
+            raise PlanError(
+                "streaming LEFT JOIN requires event-time bounds (an "
+                "interval join) so expiry is decidable — add a BETWEEN "
+                "over the two rowtimes")
 
         lower, upper = time_bounds if time_bounds is not None \
             else (-_UNBOUNDED, _UNBOUNDED)
         from flink_tpu.runtime.join_operators import IntervalJoinOperator
 
+        # the padded-row schema must match _merge_columns' matched-row
+        # schema exactly, including the synthetic join-key column both
+        # sides carry after _key_for_join
+        pad_cols = tuple(right.columns) + (GROUP_KEY_FIELD,)
         return self._lower_keyed_join(
             left, right, l_aliases, r_aliases, equi, residual,
-            lambda: IntervalJoinOperator(lower, upper,
-                                         suffixes=("_l", "_r")),
+            lambda pad_cols=pad_cols: IntervalJoinOperator(
+                lower, upper, suffixes=("_l", "_r"),
+                left_outer=left_outer,
+                right_columns=list(pad_cols)),
             "sql_join")
 
     def _plan_lookup_join(self, join: ast.Join) -> PlannedTable:

@@ -253,6 +253,7 @@ class PaneWindower(SliceSharedWindower):
         max_parallelism: int = 128,
         allowed_lateness: int = 0,
         fire_projector=None,
+        memory=None,
     ) -> None:
         from flink_tpu.state.pane_table import PaneTable
 
@@ -260,7 +261,8 @@ class PaneWindower(SliceSharedWindower):
         self.agg = agg
         self.table = PaneTable(agg, capacity=capacity,
                                max_parallelism=max_parallelism,
-                               fire_projector=fire_projector)
+                               fire_projector=fire_projector,
+                               memory=memory)
         self.book = SliceBookkeeper(assigner, allowed_lateness)
         self.fire_projector = fire_projector
 

@@ -1,0 +1,173 @@
+"""LEFT (outer) interval joins.
+
+reference: IntervalJoinOperator outer semantics — an expired unmatched
+left row null-extends exactly once, when the watermark proves no match
+can still arrive."""
+
+import numpy as np
+import pytest
+
+from flink_tpu import Configuration, StreamExecutionEnvironment
+from flink_tpu.core.records import RecordBatch
+from flink_tpu.runtime.join_operators import IntervalJoinOperator
+from flink_tpu.state.keygroups import hash_keys_to_i64
+from flink_tpu.table.environment import StreamTableEnvironment
+
+
+class _Ctx:
+    max_parallelism = 128
+
+
+def _kb(cols, ts):
+    b = RecordBatch.from_pydict(
+        cols, timestamps=np.asarray(ts, dtype=np.int64))
+    return b.with_column("__key_id__", hash_keys_to_i64(b["k"]))
+
+
+class TestOperator:
+    def _op(self):
+        op = IntervalJoinOperator(-100, 100, left_outer=True,
+                                  right_columns=["k", "vb"])
+        op.open(_Ctx())
+        return op
+
+    def test_unmatched_left_pads_after_expiry(self):
+        op = self._op()
+        op.process_batch(_kb({"k": np.asarray([1, 2]),
+                              "va": np.asarray([10.0, 20.0])},
+                             [1000, 1000]), input_index=0)
+        op.process_batch(_kb({"k": np.asarray([1]),
+                              "vb": np.asarray([1.5])}, [1050]),
+                         input_index=1)
+        # before expiry: nothing pads
+        assert op.process_watermark(1050) == []
+        outs = op.process_watermark(5000)
+        assert len(outs) == 1
+        rows = outs[0].to_rows()
+        assert len(rows) == 1
+        assert rows[0]["va"] == 20.0 and np.isnan(rows[0]["vb"])
+
+    def test_matched_left_never_pads(self):
+        op = self._op()
+        op.process_batch(_kb({"k": np.asarray([1]),
+                              "va": np.asarray([10.0])}, [1000]),
+                         input_index=0)
+        op.process_batch(_kb({"k": np.asarray([1]),
+                              "vb": np.asarray([1.5])}, [1050]),
+                         input_index=1)
+        assert op.process_watermark(10_000) == []
+
+    def test_close_flushes_remaining_unmatched(self):
+        op = self._op()
+        op.process_batch(_kb({"k": np.asarray([9]),
+                              "va": np.asarray([1.0])}, [100]),
+                         input_index=0)
+        outs = op.close()
+        assert len(outs) == 1 and np.isnan(outs[0].to_rows()[0]["vb"])
+
+    def test_restore_with_key_group_filter_after_merge(self):
+        """Regression: a right-side match merges the per-batch flag
+        arrays into one — restore with a key-group filter must stay
+        aligned (and not crash) with multiple buffered left batches."""
+        op = self._op()
+        op.process_batch(_kb({"k": np.asarray([1, 2]),
+                              "va": np.asarray([10.0, 20.0])},
+                             [1000, 1000]), input_index=0)
+        op.process_batch(_kb({"k": np.asarray([3]),
+                              "va": np.asarray([30.0])}, [1100]),
+                         input_index=0)
+        op.process_batch(_kb({"k": np.asarray([1]),
+                              "vb": np.asarray([1.5])}, [1050]),
+                         input_index=1)
+        snap = op.snapshot_state()
+        from flink_tpu.state.keygroups import assign_key_groups
+
+        kids = hash_keys_to_i64(np.asarray([1, 2, 3]))
+        groups = assign_key_groups(kids, 128)
+        keep = {int(g) for g in groups}  # all groups: full restore
+        op2 = self._op()
+        op2.restore_state(snap, key_group_filter=keep)
+        outs = op2.process_watermark(10_000)
+        vas = sorted(r["va"] for b in outs for r in b.to_rows())
+        assert vas == [20.0, 30.0]  # key 1 stayed matched
+
+    def test_matched_flags_survive_snapshot_restore(self):
+        op = self._op()
+        op.process_batch(_kb({"k": np.asarray([1, 2]),
+                              "va": np.asarray([10.0, 20.0])},
+                             [1000, 1000]), input_index=0)
+        op.process_batch(_kb({"k": np.asarray([1]),
+                              "vb": np.asarray([1.5])}, [1050]),
+                         input_index=1)
+        snap = op.snapshot_state()
+        op2 = self._op()
+        op2.restore_state(snap)
+        outs = op2.process_watermark(10_000)
+        rows = [r for b in outs for r in b.to_rows()]
+        # only key 2 pads — key 1's match was remembered in the snapshot
+        assert [r["va"] for r in rows] == [20.0]
+
+
+class TestLeftJoinSQL:
+    def _setup(self, suffix):
+        from flink_tpu.connectors.kafka import FakeBroker
+
+        broker = FakeBroker.get("default")
+        a, b = f"lja{suffix}", f"ljb{suffix}"
+        broker.create_topic(a, 1)
+        broker.create_topic(b, 1)
+        a_ts = np.asarray([1000, 2000, 3000, 4000], dtype=np.int64)
+        broker.append(a, 0, RecordBatch.from_pydict(
+            {"k": np.asarray([1, 2, 3, 1], dtype=np.int64),
+             "va": np.asarray([10.0, 20.0, 30.0, 40.0]),
+             "ats": a_ts}, timestamps=a_ts))
+        b_ts = np.asarray([1050, 3100], dtype=np.int64)
+        broker.append(b, 0, RecordBatch.from_pydict(
+            {"k": np.asarray([1, 3], dtype=np.int64),
+             "vb": np.asarray([1.5, 3.5]), "bts": b_ts},
+            timestamps=b_ts))
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 2}))
+        tenv = StreamTableEnvironment(env)
+        for name, cols in ((a, "k BIGINT, va DOUBLE, ats BIGINT, "
+                            "WATERMARK FOR ats AS ats"),
+                           (b, "k BIGINT, vb DOUBLE, bts BIGINT, "
+                            "WATERMARK FOR bts AS bts")):
+            tenv.execute_sql(
+                f"CREATE TABLE {name} ({cols}) "
+                f"WITH ('connector'='kafka', 'topic'='{name}')")
+        return tenv, a, b
+
+    def test_left_interval_join(self):
+        tenv, a, b = self._setup("1")
+        rows = tenv.execute_sql(f"""
+            SELECT x.va, y.vb FROM {a} AS x
+            LEFT JOIN {b} AS y ON x.k = y.k
+            AND y.bts BETWEEN x.ats - INTERVAL '0.2' SECOND
+                          AND x.ats + INTERVAL '0.2' SECOND
+        """).collect()
+        got = {r["va"]: r["vb"] for r in rows}
+        assert got[10.0] == 1.5 and got[30.0] == 3.5
+        assert np.isnan(got[20.0]) and np.isnan(got[40.0])
+        assert len(rows) == 4
+
+    def test_left_join_without_time_bounds_rejected(self):
+        from flink_tpu.table.environment import PlanError
+
+        tenv, a, b = self._setup("2")
+        with pytest.raises(PlanError, match="event-time bounds"):
+            tenv.execute_sql(
+                f"SELECT x.va FROM {a} AS x LEFT JOIN {b} AS y "
+                "ON x.k = y.k")
+
+    def test_left_join_with_residual_rejected(self):
+        from flink_tpu.table.environment import PlanError
+
+        tenv, a, b = self._setup("3")
+        with pytest.raises(PlanError, match="LEFT JOIN"):
+            tenv.execute_sql(f"""
+                SELECT x.va FROM {a} AS x
+                LEFT JOIN {b} AS y ON x.k = y.k AND x.va > y.vb
+                AND y.bts BETWEEN x.ats - INTERVAL '0.2' SECOND
+                              AND x.ats + INTERVAL '0.2' SECOND
+            """)

@@ -1,0 +1,108 @@
+"""Managed device-memory accounting (flink_tpu/core/memory.py).
+
+reference: flink-runtime/.../memory/MemoryManager.java — one managed
+pool per slot; reservations fail with a breakdown, never an opaque OOM."""
+
+import numpy as np
+import pytest
+
+from flink_tpu import Configuration, StreamExecutionEnvironment
+from flink_tpu.connectors.sinks import CollectSink
+from flink_tpu.connectors.sources import DataGenSource
+from flink_tpu.core.memory import MemoryManager, MemoryReservationError
+from flink_tpu.runtime.watermarks import WatermarkStrategy
+from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+
+class TestPool:
+    def test_reserve_release(self):
+        m = MemoryManager(1000)
+        m.reserve("a", 400)
+        m.reserve("b", 500)
+        assert m.reserved_bytes == 900
+        with pytest.raises(MemoryReservationError, match="a=400"):
+            m.reserve("c", 200)
+        m.release("a", 400)
+        m.reserve("c", 200)
+        assert m.usage() == {"b": 500, "c": 200}
+        assert m.release_all("b") == 500
+        assert m.reserved_bytes == 200
+
+    def test_unlimited_by_default(self):
+        m = MemoryManager(0)
+        m.reserve("x", 1 << 40)
+        assert m.reserved_bytes == 1 << 40
+
+
+def _pipeline(env, capacity=1 << 14):
+    sink = CollectSink()
+    src = DataGenSource(total_records=30_000, num_keys=200,
+                        events_per_second_of_eventtime=10_000, seed=5)
+    (env.from_source(src,
+                     WatermarkStrategy.for_bounded_out_of_orderness(0))
+       .key_by("key").window(TumblingEventTimeWindows.of(1000))
+       .sum("value").sink_to(sink))
+    return sink
+
+
+class TestJobAccounting:
+    def test_job_runs_inside_budget(self):
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 1000,
+            "state.slot-table.capacity": 4096,
+            "memory.device.size": 64 * 1024 * 1024}))
+        sink = _pipeline(env)
+        env.execute("budgeted")
+        assert len(sink.result()) > 0
+
+    def test_over_budget_fails_with_breakdown(self):
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 1000,
+            "state.slot-table.capacity": 1 << 16,
+            "memory.device.size": 1024}))  # absurdly small
+        _pipeline(env)
+        with pytest.raises(MemoryReservationError,
+                           match="memory.device.size"):
+            env.execute("starved")
+
+    def test_pane_layout_accounted_too(self):
+        from flink_tpu.core.records import RecordBatch  # noqa: F401
+
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 1000,
+            "state.slot-table.capacity": 1 << 16,
+            "state.window-layout": "panes",
+            "memory.device.size": 1024}))
+        _pipeline(env)
+        with pytest.raises(MemoryReservationError,
+                           match="memory.device.size"):
+            env.execute("panes-starved")
+
+    def test_growth_reserves_and_dispose_releases(self):
+        from flink_tpu.runtime.operators import (
+            OperatorContext,
+            WindowAggOperator,
+        )
+        from flink_tpu.windowing.aggregates import SumAggregate
+
+        mm = MemoryManager(1 << 30)
+        op = WindowAggOperator(TumblingEventTimeWindows.of(1000),
+                               SumAggregate("v"), "key", capacity=1024)
+        op.open(OperatorContext(max_parallelism=128,
+                                memory_manager=mm))
+        base = mm.reserved_bytes
+        assert base > 0
+        # force index growth past the initial capacity
+        from flink_tpu.core.records import RecordBatch
+        from flink_tpu.state.keygroups import hash_keys_to_i64
+
+        n = 5000
+        b = RecordBatch.from_pydict(
+            {"key": np.arange(n, dtype=np.int64),
+             "v": np.ones(n)},
+            timestamps=np.zeros(n, dtype=np.int64))
+        b = b.with_column("__key_id__", hash_keys_to_i64(b["key"]))
+        op.process_batch(b)
+        assert mm.reserved_bytes > base  # grew
+        op.dispose()
+        assert mm.reserved_bytes == 0
