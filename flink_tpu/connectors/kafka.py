@@ -166,6 +166,68 @@ class FakeBroker:
             return (part[-1][0] + len(part[-1][1])) if part else 0
 
 
+class KafkaClientBroker:
+    """Adapter template for a REAL Kafka cluster behind the same
+    four-method surface ``FakeBroker`` exposes — what the source/sink
+    stack actually depends on (reference: the KafkaConsumer/KafkaProducer
+    calls inside flink-connector-kafka's split reader and writer).
+
+    Wire it with any client library (kafka-python, confluent-kafka):
+
+    - ``partitions(topic)``      -> consumer.partitions_for_topic
+    - ``fetch(topic, p, offset, max_records)``
+                                 -> seek(TopicPartition(topic, p), offset)
+                                    + poll(); return a columnar batch
+                                    (apply the table's
+                                    DeserializationSchema to the raw
+                                    values) and the next offset
+    - ``end_offset(topic, p)``   -> consumer.end_offsets
+    - ``append(topic, p, batch)`` / ``append_raw`` -> producer.send per
+                                    record (serialized values)
+
+    Offsets stay in THIS framework's checkpoints (the split position),
+    never in Kafka's consumer-group storage — the same
+    exactly-once-ownership decision the reference makes. This class
+    raises until a client is injected; it exists so the seam is explicit
+    and testable, not discovered by reverse-engineering FakeBroker."""
+
+    def __init__(self, client=None):
+        if client is None:
+            raise RuntimeError(
+                "KafkaClientBroker needs a client object implementing "
+                "partitions_for/seek/poll/end_offsets/send (no Kafka "
+                "client library ships in this environment; FakeBroker "
+                "provides the in-process surface)")
+        self.client = client
+
+    def create_topic(self, topic: str, partitions: int) -> None:
+        raise NotImplementedError("topic administration is external")
+
+    def partitions(self, topic: str) -> int:
+        return len(self.client.partitions_for(topic))
+
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_records: int):
+        raise NotImplementedError(
+            "implement against your client: seek + poll -> "
+            "(RecordBatch, next_offset)")
+
+    def end_offset(self, topic: str, partition: int) -> int:
+        raise NotImplementedError(
+            "implement against your client: end_offsets")
+
+    def append(self, topic: str, partition: int, batch) -> int:
+        raise NotImplementedError(
+            "implement against your client: producer.send")
+
+    def append_raw(self, topic: str, partition: int, records,
+                   timestamps=None) -> int:
+        raise NotImplementedError(
+            "implement against your client: producer.send per raw "
+            "serialized record (a sink with a value_format writes "
+            "through THIS method)")
+
+
 class KafkaPartitionReader(Source):
     """Reads ONE partition from an offset — the per-split reader. Its
     snapshot position is the committed offset (reference: KafkaSource
@@ -246,6 +308,10 @@ class KafkaPartitionEnumerator(SplitEnumerator):
                for p in range(self._known, total)]
         self._known = total
         return new
+
+    def reset(self) -> None:
+        # a RE-opened source replays from scratch (see SplitSource.open)
+        self._known = 0
 
     def snapshot_state(self):
         return {"known": self._known}

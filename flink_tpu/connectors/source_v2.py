@@ -52,12 +52,20 @@ class SourceSplit:
 class SplitEnumerator:
     """Discovers splits. ``discover()`` returns only NEW splits since the
     previous call (the reference's enumerator sends incremental
-    assignments). ``bounded`` declares whether discovery ever finishes."""
+    assignments). ``bounded`` declares whether discovery ever finishes.
+    ``reset()`` forgets the discovery state so a RE-opened source replays
+    the whole stream (part of the contract: SplitSource.open calls it on
+    re-execution; restore_state then wins on recovery)."""
 
     bounded: bool = True
 
     def discover(self) -> List[SourceSplit]:
         raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement reset() so a "
+            "re-executed graph replays its splits")
 
     def snapshot_state(self) -> Dict[str, Any]:
         return {}
@@ -83,6 +91,9 @@ class FileSplitEnumerator(SplitEnumerator):
                 self._seen.add(path)
                 new.append(SourceSplit(split_id=path, payload=path))
         return new
+
+    def reset(self) -> None:
+        self._seen.clear()
 
     def snapshot_state(self):
         return {"seen": sorted(self._seen)}
@@ -172,6 +183,25 @@ class SplitSource(Source):
         self._parallelism = parallelism
         if self.coordinator is None:
             self.coordinator = SourceCoordinator(parallelism)
+        if self._opened:
+            # RE-execution of the same graph (a registered table view
+            # queried twice, a restarted job): the framework-wide
+            # contract is that open() resets position so the stream
+            # replays (see connectors/sources.py) — the enumerator and
+            # per-split readers must start over, not resume the previous
+            # run's consumed state (restore_position, applied below,
+            # then wins on recovery). The coordinator is rebuilt at the
+            # NEW parallelism so splits rebalance (its own documented
+            # contract), and the previous run's unfinished readers close
+            # first (same discipline as _apply_restore).
+            self.enumerator.reset()
+            for st in self._states.values():
+                if st.reader is not None and not st.finished:
+                    st.reader.close()
+            self._states.clear()
+            self._order.clear()
+            self._rr = 0
+            self.coordinator = type(self.coordinator)(parallelism)
         self._opened = True
         if self._parked_restore is not None:
             self._apply_restore(self._parked_restore)

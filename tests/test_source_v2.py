@@ -229,3 +229,54 @@ def test_valve_idleness():
     assert v.advance(1, 150) is None  # reactivates below combined: no emit
     assert v.advance(1, 300) is None  # min(200, 300) = 200, no advance
     assert v.advance(0, 300) == 300
+
+
+def test_reopen_replays_the_whole_stream():
+    """Re-executing a graph that reuses ONE SplitSource object (a
+    registered table view queried twice) must replay: open() resets the
+    enumerator, closes the previous run's readers, and rebuilds the
+    coordinator at the new parallelism (regression: the second run
+    discovered no splits and returned nothing)."""
+    import tempfile
+
+    import numpy as np
+
+    d = tempfile.mkdtemp()
+    for i in range(3):
+        with open(f"{d}/r{i}.txt", "w") as f:
+            f.write("x")
+
+    made = []
+
+    class CountingReader(Source):
+        def __init__(self, split):
+            self.split = split
+            self.done = False
+            self.closed = False
+            made.append(self)
+
+        def poll_batch(self, max_records):
+            if self.done:
+                return None
+            self.done = True
+            return RecordBatch.from_pydict(
+                {"v": np.asarray([1])}, timestamps=np.asarray([0]))
+
+    def close(self):
+        self.closed = True
+
+    CountingReader.close = close
+
+    src = SplitSource(FileSplitEnumerator(f"{d}/*.txt"),
+                      CountingReader)
+
+    def drain():
+        src.open(0, 1)
+        n = 0
+        while (b := src.poll_batch(10)) is not None:
+            n += len(b)
+        return n
+
+    assert drain() == 3
+    assert drain() == 3  # replay, not an empty second run
+    assert len(made) == 6  # fresh readers per run

@@ -1,0 +1,274 @@
+"""Measure every BASELINE.md row on the active backend.
+
+Rows (BASELINE.json):
+  1. WordCount, 5 s tumbling window, socket source
+  2. Nexmark Q5 — sliding-window (HOP) hot-items COUNT   (bench.py's row)
+  3. Nexmark Q7 — tumbling-window MAX + join
+  4. Flink SQL GROUP BY HOP over Kafka
+  5. Session-window clickstream, 10M distinct keys (spill tier)
+
+Prints one JSON line per row and rewrites BENCHMARKS.md. Usage:
+
+    BENCH_SKIP_PROBE=1 JAX_PLATFORMS=cpu python tools/bench_suite.py
+    python tools/bench_suite.py          # probes the TPU first
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("BENCH_PROBE_TIMEOUTS", "45,120")
+
+SCALE = float(os.environ.get("BENCH_SUITE_SCALE", "1.0"))
+
+
+def _platform():
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def row1_wordcount():
+    """Socket-source WordCount (the reference's WindowWordCount)."""
+    import socket
+    import threading
+
+    from flink_tpu import Configuration, StreamExecutionEnvironment
+    from flink_tpu.connectors.sinks import CollectSink
+    from flink_tpu.connectors.sources import SocketSource
+    from flink_tpu.windowing.assigners import TumblingProcessingTimeWindows
+
+    n_lines = int(200_000 * SCALE)
+    line = b"to be or not to be that is the question\n"
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def feed():
+        conn, _ = srv.accept()
+        chunk = line * 512
+        sent = 0
+        while sent < n_lines:
+            conn.sendall(chunk)
+            sent += 512
+        conn.close()
+
+    t = threading.Thread(target=feed, daemon=True)
+    t.start()
+    env = StreamExecutionEnvironment(Configuration({
+        "execution.micro-batch.size": 1 << 15}))
+    sink = CollectSink()
+
+    def split(batch):
+        import numpy as np
+
+        from flink_tpu.core.records import RecordBatch
+
+        words = []
+        for ln in batch["line"]:
+            words.extend(str(ln).split())
+        arr = __import__("numpy").empty(len(words), dtype=object)
+        arr[:] = words
+        return RecordBatch({"word": arr,
+                            "one": np.ones(len(words), dtype=np.int64)})
+
+    (env.add_source(SocketSource("127.0.0.1", port))
+        .flat_map(lambda b: [split(b)])
+        .key_by("word")
+        .window(TumblingProcessingTimeWindows.of(5_000))
+        .sum("one").sink_to(sink))
+    t0 = time.perf_counter()
+    env.execute("wordcount")
+    dt = time.perf_counter() - t0
+    words = n_lines * 10
+    return {"metric": "wordcount_socket_words_per_sec",
+            "value": round(words / dt, 1), "unit": "words/s"}
+
+
+def row2_q5():
+    from bench import run
+
+    run(total_records=1 << 21)  # warm
+    s = run(total_records=int(20_000_000 * SCALE))
+    return {"metric": "nexmark_q5_hop_hot_items_events_per_sec_per_chip",
+            "value": round(s["events_per_s"], 1), "unit": "events/s",
+            "fire_latency_ms": s["fire_latency_ms"]}
+
+
+def row3_q7():
+    from flink_tpu import Configuration, StreamExecutionEnvironment
+    from flink_tpu.benchmarks.nexmark import BidSource, build_q7
+    from flink_tpu.connectors.sinks import CollectSink
+
+    def run(total):
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 1 << 16,
+            "state.slot-table.capacity": 1 << 20}))
+        sink = CollectSink()
+        src = BidSource(total_records=total, num_auctions=10_000,
+                        events_per_second_of_eventtime=100_000)
+        build_q7(env, src, size_ms=10_000).sink_to(sink)
+        t0 = time.perf_counter()
+        env.execute("q7")
+        return total / (time.perf_counter() - t0)
+
+    run(1 << 20)  # warm
+    total = int(10_000_000 * SCALE)
+    return {"metric": "nexmark_q7_max_join_events_per_sec_per_chip",
+            "value": round(run(total), 1), "unit": "events/s"}
+
+
+def row4_sql_hop_kafka():
+    import numpy as np
+
+    from flink_tpu import Configuration, StreamExecutionEnvironment
+    from flink_tpu.connectors.kafka import FakeBroker
+    from flink_tpu.core.records import RecordBatch
+    from flink_tpu.table.environment import StreamTableEnvironment
+
+    total = int(8_000_000 * SCALE)
+    parts = 4
+    broker = FakeBroker.get("bench")
+    broker.create_topic("bench_bids", parts)
+    rng = np.random.default_rng(1)
+    chunk = 1 << 18
+    produced = 0
+    while produced < total:
+        n = min(chunk, total - produced)
+        ks = rng.integers(0, 10_000, n).astype(np.int64)
+        vs = rng.random(n)
+        ts = (np.arange(produced, produced + n, dtype=np.int64)
+              * 1000) // 100_000
+        for p in range(parts):
+            m = ks % parts == p
+            broker.append("bench_bids", p, RecordBatch.from_pydict(
+                {"key": ks[m], "value": vs[m], "ts": ts[m]},
+                timestamps=ts[m]))
+        produced += n
+
+    def run():
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 1 << 16}))
+        tenv = StreamTableEnvironment(env)
+        tenv.execute_sql(
+            "CREATE TABLE bench_bids (key BIGINT, value DOUBLE, "
+            "ts BIGINT, WATERMARK FOR ts AS ts) "
+            "WITH ('connector'='kafka', 'topic'='bench_bids', "
+            "'broker'='bench')")
+        t0 = time.perf_counter()
+        rows = tenv.execute_sql("""
+            SELECT key, window_end, SUM(value) AS total
+            FROM TABLE(HOP(TABLE bench_bids, DESCRIPTOR(ts),
+                           INTERVAL '2' SECOND, INTERVAL '10' SECONDS))
+            GROUP BY key, window_start, window_end
+        """).collect()
+        dt = time.perf_counter() - t0
+        assert len(rows) > 0
+        return total / dt
+
+    run()  # warm
+    return {"metric": "sql_group_by_hop_over_kafka_events_per_sec",
+            "value": round(run(), 1), "unit": "events/s"}
+
+
+def row5_sessions_10m_keys():
+    from flink_tpu import Configuration, StreamExecutionEnvironment
+    from flink_tpu.connectors.sinks import CollectSink
+    from flink_tpu.connectors.sources import DataGenSource
+    from flink_tpu.runtime.watermarks import WatermarkStrategy
+    from flink_tpu.windowing.assigners import EventTimeSessionWindows
+
+    total = int(12_000_000 * SCALE)
+    keys = 10_000_000
+
+    def run(n):
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 1 << 16,
+            "state.slot-table.capacity": 1 << 19,
+            "state.slot-table.max-device-slots": 1 << 19,
+        }))
+        sink = CollectSink()
+        src = DataGenSource(total_records=n, num_keys=keys,
+                            events_per_second_of_eventtime=400_000,
+                            seed=3)
+        (env.from_source(
+            src, WatermarkStrategy.for_bounded_out_of_orderness(0))
+           .key_by("key")
+           .window(EventTimeSessionWindows.with_gap(2_000))
+           .sum("value").sink_to(sink))
+        t0 = time.perf_counter()
+        env.execute("sessions")
+        dt = time.perf_counter() - t0
+        assert len(sink.result()) > 0
+        return n / dt
+
+    run(1 << 20)  # warm
+    return {"metric":
+            "session_clickstream_10m_keys_events_per_sec_per_chip",
+            "value": round(run(total), 1), "unit": "events/s"}
+
+
+ROWS = [("wordcount_socket", row1_wordcount),
+        ("nexmark_q5", row2_q5),
+        ("nexmark_q7", row3_q7),
+        ("sql_hop_kafka", row4_sql_hop_kafka),
+        ("sessions_10m_keys", row5_sessions_10m_keys)]
+
+
+def main():
+    import warnings
+
+    warnings.filterwarnings("ignore")
+    if os.environ.get("BENCH_SKIP_PROBE") != "1":
+        from bench import probe_backend
+
+        ok, info = probe_backend()
+        if not ok:
+            os.environ["JAX_PLATFORMS"] = "cpu"
+    from flink_tpu.platform import sync_platform
+
+    sync_platform()
+    platform = _platform()
+    results = []
+    for name, fn in ROWS:
+        try:
+            r = fn()
+        except Exception as e:  # noqa: BLE001 — a row must not kill the suite
+            r = {"metric": name, "error": repr(e)}
+        r["backend"] = platform
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    lines = [
+        "# BENCHMARKS — all BASELINE.md rows",
+        "",
+        f"Backend: **{platform}** · scale {SCALE} · "
+        f"{time.strftime('%Y-%m-%d %H:%M')}",
+        "",
+        "| Row | Metric | Value | Unit |",
+        "|---|---|---|---|",
+    ]
+    for (name, _), r in zip(ROWS, results):
+        val = (f"{r['value']:,.0f}" if "value" in r
+               else f"error: {r.get('error', '?')[:60]}")
+        extra = ""
+        if r.get("fire_latency_ms"):
+            lat = r["fire_latency_ms"]
+            extra = (f" (fire p50 {lat['p50']:.0f} ms / "
+                     f"p99 {lat['p99']:.0f} ms, n={lat['count']})")
+        lines.append(f"| {name} | {r['metric']} | {val}{extra} | "
+                     f"{r.get('unit', '')} |")
+    lines.append("")
+    lines.append("Generated by `tools/bench_suite.py`; the proxy "
+                 "baseline discussion lives in `BASELINE.md`.")
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCHMARKS.md")
+    with open(out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"# wrote {out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
