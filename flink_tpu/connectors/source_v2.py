@@ -112,6 +112,15 @@ class SourceCoordinator:
         self._assignment: Dict[str, int] = {}
         self._next = 0
 
+    def reset(self, parallelism: int) -> None:
+        """Re-open of the owning source: forget sticky assignments and
+        adopt the NEW parallelism so splits rebalance — in place, so an
+        injected custom coordinator keeps its construction-time
+        configuration."""
+        self.parallelism = max(int(parallelism), 1)
+        self._assignment.clear()
+        self._next = 0
+
     def assign(self, splits: Sequence[SourceSplit]) -> Dict[str, int]:
         for s in splits:
             if s.split_id not in self._assignment:
@@ -201,7 +210,7 @@ class SplitSource(Source):
             self._states.clear()
             self._order.clear()
             self._rr = 0
-            self.coordinator = type(self.coordinator)(parallelism)
+            self.coordinator.reset(parallelism)
         self._opened = True
         if self._parked_restore is not None:
             self._apply_restore(self._parked_restore)

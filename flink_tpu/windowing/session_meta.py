@@ -15,7 +15,6 @@ sharded.
 from __future__ import annotations
 
 import dataclasses
-import heapq
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -48,7 +47,15 @@ class SessionIntervalSet:
         # key -> list of (start, end, sid), sorted by start; usually length 1
         self.sessions: Dict[int, List[Tuple[int, int, int]]] = {}
         self._next_sid = 1
-        self._fire_heap: List[Tuple[int, int, int]] = []  # (end, key, sid)
+        #: fire candidates as COLUMNAR chunks [(ends, keys, sids), ...] —
+        #: the heap's role, but pushes are array appends and the
+        #: watermark cut is one vectorized mask (the 10M-key clickstream
+        #: creates ~one session per record; per-session heappush/heappop
+        #: dominated that profile)
+        self._fire_chunks: List[Tuple[np.ndarray, np.ndarray,
+                                      np.ndarray]] = []
+        #: scalar push buffer (slow-path merges), drained into a chunk
+        self._fire_buf: List[Tuple[int, int, int]] = []
         self.max_fired_watermark = _NEG_INF
         self.late_records_dropped = 0
         # merge-group accumulation during absorb_batch
@@ -56,6 +63,34 @@ class SessionIntervalSet:
         self._cur: Optional[MergeGroup] = None
         self._cur_dst: set = set()
         self._cur_src: set = set()
+
+    # ------------------------------------------------------- fire pending
+
+    def _push_fire(self, end: int, key: int, sid: int) -> None:
+        self._fire_buf.append((end, key, sid))
+
+    def _push_fires(self, ends: np.ndarray, keys: np.ndarray,
+                    sids: np.ndarray) -> None:
+        if len(ends):
+            self._fire_chunks.append((
+                np.asarray(ends, dtype=np.int64),
+                np.asarray(keys, dtype=np.int64),
+                np.asarray(sids, dtype=np.int64)))
+
+    def _pending_arrays(self):
+        if self._fire_buf:
+            buf = np.asarray(self._fire_buf, dtype=np.int64)
+            self._fire_chunks.append((buf[:, 0], buf[:, 1], buf[:, 2]))
+            self._fire_buf = []
+        if not self._fire_chunks:
+            e = np.empty(0, dtype=np.int64)
+            return e, e.copy(), e.copy()
+        if len(self._fire_chunks) > 1:
+            ends = np.concatenate([c[0] for c in self._fire_chunks])
+            keys = np.concatenate([c[1] for c in self._fire_chunks])
+            sids = np.concatenate([c[2] for c in self._fire_chunks])
+            self._fire_chunks = [(ends, keys, sids)]
+        return self._fire_chunks[0]
 
     # ---------------------------------------------------------------- absorb
 
@@ -96,10 +131,46 @@ class SessionIntervalSet:
         self._groups, self._cur = [], None
         self._cur_dst, self._cur_src = set(), set()
         sess_sid = np.empty(m, dtype=np.int64)
-        for j in range(m):
+
+        # FAST PATH (the 10M-key clickstream shape): a key with exactly
+        # one local session and no stored intervals registers in bulk —
+        # sid allocation, interval store, and fire-candidate push all
+        # vectorized; only overlapping/merging sessions take the
+        # per-session path below
+        first_of_key = np.empty(m, dtype=bool)
+        first_of_key[0] = True
+        first_of_key[1:] = sess_key[1:] != sess_key[:-1]
+        only_of_key = first_of_key.copy()
+        only_of_key[:-1] &= first_of_key[1:]
+        sessions = self.sessions
+        exists = np.fromiter((k in sessions for k in sess_key.tolist()),
+                             np.bool_, m)
+        ends_all = sess_max + self.gap
+        if self.max_fired_watermark > _NEG_INF // 2:
+            stale = (ends_all - 1 + self.allowed_lateness
+                     <= self.max_fired_watermark)
+        else:
+            stale = np.zeros(m, dtype=bool)
+        fast = only_of_key & ~exists
+        fresh_stale = fast & stale
+        fast &= ~stale
+        cnt = int(fast.sum())
+        if cnt:
+            sids_fast = np.arange(self._next_sid, self._next_sid + cnt,
+                                  dtype=np.int64)
+            self._next_sid += cnt
+            sess_sid[fast] = sids_fast
+            fk = sess_key[fast].tolist()
+            fs = sess_min[fast].tolist()
+            fe = ends_all[fast].tolist()
+            for k, s, e, sid in zip(fk, fs, fe, sids_fast.tolist()):
+                sessions[k] = [(s, e, sid)]
+            self._push_fires(ends_all[fast], sess_key[fast], sids_fast)
+        sess_sid[fresh_stale] = -1  # stale on arrival (never stored)
+        slow = np.nonzero(~fast & ~fresh_stale)[0]
+        for j in slow:
             sess_sid[j] = self._merge_session(
-                int(sess_key[j]), int(sess_min[j]),
-                int(sess_max[j]) + self.gap)
+                int(sess_key[j]), int(sess_min[j]), int(ends_all[j]))
         groups = self._groups
         if self._cur is not None and len(self._cur):
             groups.append(self._cur)
@@ -137,7 +208,7 @@ class SessionIntervalSet:
                 return -1
             sid = self._alloc_sid()
             self.sessions[key] = [(start, end, sid)]
-            heapq.heappush(self._fire_heap, (end, key, sid))
+            self._push_fire(end, key, sid)
             return sid
 
         overlapping = [iv for iv in intervals
@@ -148,7 +219,7 @@ class SessionIntervalSet:
             sid = self._alloc_sid()
             intervals.append((start, end, sid))
             intervals.sort()
-            heapq.heappush(self._fire_heap, (end, key, sid))
+            self._push_fire(end, key, sid)
             return sid
 
         # absorb into the first overlapping interval's session
@@ -165,7 +236,7 @@ class SessionIntervalSet:
         remaining.sort()
         self.sessions[key] = remaining
         if new_end != keep[1]:
-            heapq.heappush(self._fire_heap, (new_end, key, keep[2]))
+            self._push_fire(new_end, key, keep[2])
         return keep[2]
 
     def _stale(self, end: int) -> bool:
@@ -183,15 +254,37 @@ class SessionIntervalSet:
     def pop_fired(self, watermark: int
                   ) -> Tuple[List[int], List[int], List[int], List[int]]:
         """All sessions whose end - 1 <= watermark, removed from the set.
-        Returns (keys, starts, ends, sids). Stale heap entries (merged or
-        extended sessions) are skipped lazily."""
+        Returns (keys, starts, ends, sids) in end order. Stale candidates
+        (merged or extended sessions) are skipped lazily — one vectorized
+        watermark cut selects the due candidates, per-session validation
+        runs only over those."""
+        p_ends, p_keys, p_sids = self._pending_arrays()
+        if not len(p_ends):
+            self.max_fired_watermark = max(self.max_fired_watermark,
+                                           watermark)
+            return [], [], [], []
+        due = p_ends - 1 <= watermark
+        if due.any():
+            keep = ~due
+            d_ends = p_ends[due]
+            d_keys = p_keys[due]
+            d_sids = p_sids[due]
+            self._fire_chunks = (
+                [(p_ends[keep], p_keys[keep], p_sids[keep])]
+                if keep.any() else [])
+            order = np.argsort(d_ends, kind="stable")  # heap pop order
+            d_ends, d_keys, d_sids = (d_ends[order], d_keys[order],
+                                      d_sids[order])
+        else:
+            d_ends = d_keys = d_sids = np.empty(0, dtype=np.int64)
         keys: List[int] = []
         starts: List[int] = []
         ends: List[int] = []
         sids: List[int] = []
-        while self._fire_heap and self._fire_heap[0][0] - 1 <= watermark:
-            end, key, sid = heapq.heappop(self._fire_heap)
-            intervals = self.sessions.get(key)
+        sessions = self.sessions
+        for end, key, sid in zip(d_ends.tolist(), d_keys.tolist(),
+                                 d_sids.tolist()):
+            intervals = sessions.get(key)
             if not intervals:
                 continue
             cur = next((iv for iv in intervals if iv[2] == sid), None)
@@ -201,9 +294,10 @@ class SessionIntervalSet:
             starts.append(cur[0])
             ends.append(end)
             sids.append(sid)
-            intervals.remove(cur)
-            if not intervals:
-                del self.sessions[key]
+            if len(intervals) == 1:
+                del sessions[key]
+            else:
+                intervals.remove(cur)
         self.max_fired_watermark = max(self.max_fired_watermark, watermark)
         return keys, starts, ends, sids
 
@@ -219,7 +313,8 @@ class SessionIntervalSet:
     def restore(self, snap: Dict[str, object],
                 key_group_filter=None, max_parallelism: int = 128) -> None:
         self.sessions = {}
-        self._fire_heap = []
+        self._fire_chunks = []
+        self._fire_buf = []
         for k, ivs in snap.get("sessions", {}).items():
             kept = [tuple(iv) for iv in ivs]
             if key_group_filter is not None:
@@ -231,6 +326,6 @@ class SessionIntervalSet:
                     continue
             self.sessions[int(k)] = kept
             for start, end, sid in kept:
-                heapq.heappush(self._fire_heap, (end, int(k), sid))
+                self._push_fire(end, int(k), sid)
         self._next_sid = snap.get("next_sid", 1)
         self.max_fired_watermark = snap.get("max_fired_watermark", _NEG_INF)
