@@ -553,22 +553,47 @@ class JobMasterThread:
             self._current_address = slot["address"]
             execution_id = f"{self.job_id}-{self.attempt}"
             self._current_execution_id = execution_id
-            # subtask expansion: the keyed stage wants one slot per
-            # subtask. Acquire up to stage-parallelism slots (the primary
-            # hosts the source stage + driver) and scale the stage to what
-            # the cluster can actually give — reactive, like the adaptive
-            # scheduler's scale-to-resources (reference:
-            # SlotSharingExecutionSlotAllocator + AdaptiveScheduler).
+            # slot demand = SUM over slot sharing groups of the group's
+            # max parallelism (reference:
+            # SlotSharingExecutionSlotAllocator): a group containing the
+            # keyed stage needs stage-parallelism slots, any other group
+            # needs one. Acquire what the cluster can actually give,
+            # release any surplus immediately, and scale the stage to
+            # the remainder — reactive, like the adaptive scheduler.
             extra_slots: List[dict] = []
             config = self.config
-            if want_stage_par > 1:
-                for _ in range(want_stage_par - 1):
+            per_group = max(want_stage_par, 1)
+            keyed_count, plain_count = 1, 0
+            if hasattr(self.graph, "slot_groups"):
+                resolved = self.graph.slot_groups()
+                keyed_groups = {resolved[t.uid]
+                                for t in self.graph.nodes if t.keyed}
+                all_groups = set(resolved.values()) or {"default"}
+                keyed_count = len(keyed_groups)
+                plain_count = len(all_groups) - keyed_count
+            want_slots = per_group * keyed_count + plain_count
+            if want_slots > 1:
+                for _ in range(want_slots - 1):
                     extra = rm.request_slot()
                     if extra is None:
                         break
                     extra_slots.append(extra)
-                effective = 1 + len(extra_slots)
-                if effective != want_stage_par:
+                total = 1 + len(extra_slots)
+                effective = (max(1, min(per_group,
+                                        (total - plain_count)
+                                        // keyed_count))
+                             if keyed_count else 1)
+                used = effective * keyed_count + plain_count
+                while len(extra_slots) + 1 > used:
+                    # surplus from the floor division: give it back now
+                    # (a held-but-unused slot starves other jobs AND
+                    # joins the failover region for no benefit)
+                    surplus = extra_slots.pop()
+                    try:
+                        rm.release_slot(surplus["executor_id"])
+                    except Exception:
+                        pass
+                if want_stage_par > 1 and effective != want_stage_par:
                     config = Configuration(
                         {**self.config.to_dict(),
                          "execution.stage-parallelism": effective})

@@ -135,6 +135,14 @@ class DataStream:
                            inputs=[self.transformation])
         return DataStream(self.env, t)
 
+    def slot_sharing_group(self, name: str) -> "DataStream":
+        """Put this transformation (and, by inheritance, its downstream
+        chain) into slot sharing group ``name`` — subtasks of the SAME
+        group share a slot, a distinct group forces additional slots
+        (reference: DataStream.slotSharingGroup / SlotSharingGroup)."""
+        self.transformation.slot_group = name
+        return self
+
     def get_side_output(self, tag) -> "DataStream":
         """reference: SingleOutputStreamOperator.getSideOutput(OutputTag)."""
         from flink_tpu.runtime.process import OutputTag, SideOutputSelectOperator

@@ -56,6 +56,9 @@ class SessionIntervalSet:
                                       np.ndarray]] = []
         #: scalar push buffer (slow-path merges), drained into a chunk
         self._fire_buf: List[Tuple[int, int, int]] = []
+        #: earliest pending candidate end — pop_fired returns O(1) when
+        #: the watermark has not reached it (the heap's cheap peek)
+        self._min_pending_end = 1 << 62
         self.max_fired_watermark = _NEG_INF
         self.late_records_dropped = 0
         # merge-group accumulation during absorb_batch
@@ -68,6 +71,8 @@ class SessionIntervalSet:
 
     def _push_fire(self, end: int, key: int, sid: int) -> None:
         self._fire_buf.append((end, key, sid))
+        if end < self._min_pending_end:
+            self._min_pending_end = end
 
     def _push_fires(self, ends: np.ndarray, keys: np.ndarray,
                     sids: np.ndarray) -> None:
@@ -76,6 +81,9 @@ class SessionIntervalSet:
                 np.asarray(ends, dtype=np.int64),
                 np.asarray(keys, dtype=np.int64),
                 np.asarray(sids, dtype=np.int64)))
+            lo = int(ends.min())
+            if lo < self._min_pending_end:
+                self._min_pending_end = lo
 
     def _pending_arrays(self):
         if self._fire_buf:
@@ -258,8 +266,14 @@ class SessionIntervalSet:
         (merged or extended sessions) are skipped lazily — one vectorized
         watermark cut selects the due candidates, per-session validation
         runs only over those."""
+        if watermark < self._min_pending_end - 1:
+            # nothing can be due yet — O(1), the heap's cheap peek
+            self.max_fired_watermark = max(self.max_fired_watermark,
+                                           watermark)
+            return [], [], [], []
         p_ends, p_keys, p_sids = self._pending_arrays()
         if not len(p_ends):
+            self._min_pending_end = 1 << 62
             self.max_fired_watermark = max(self.max_fired_watermark,
                                            watermark)
             return [], [], [], []
@@ -272,6 +286,8 @@ class SessionIntervalSet:
             self._fire_chunks = (
                 [(p_ends[keep], p_keys[keep], p_sids[keep])]
                 if keep.any() else [])
+            self._min_pending_end = (int(p_ends[keep].min())
+                                     if keep.any() else 1 << 62)
             order = np.argsort(d_ends, kind="stable")  # heap pop order
             d_ends, d_keys, d_sids = (d_ends[order], d_keys[order],
                                       d_sids[order])
@@ -315,6 +331,7 @@ class SessionIntervalSet:
         self.sessions = {}
         self._fire_chunks = []
         self._fire_buf = []
+        self._min_pending_end = 1 << 62
         for k, ivs in snap.get("sessions", {}).items():
             kept = [tuple(iv) for iv in ivs]
             if key_group_filter is not None:

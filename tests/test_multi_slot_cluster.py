@@ -159,3 +159,78 @@ class TestMultiSlotJobs:
             _assert_windows_equal(_rows(out), _expected(total=60_000))
         finally:
             cluster.shutdown()
+
+
+class TestSlotSharingGroups:
+    """reference: DataStream.slotSharingGroup — same-group subtasks share
+    a slot; a distinct group forces its own slots, multiplying the job's
+    slot request."""
+
+    def _graph_with_group(self, env, sink, group=None):
+        src = DataGenSource(total_records=8_000, num_keys=50,
+                            events_per_second_of_eventtime=10_000, seed=9)
+        ds = env.from_source(
+            src, WatermarkStrategy.for_bounded_out_of_orderness(0),
+            name="gen")
+        agg = (ds.key_by("key")
+                 .window(TumblingEventTimeWindows.of(1000)).sum("value"))
+        if group is not None:
+            agg = agg.slot_sharing_group(group)
+        agg.sink_to(sink)
+        return env.get_stream_graph()
+
+    def test_groups_resolve_by_inheritance(self):
+        env = StreamExecutionEnvironment(Configuration({}))
+        sink = CollectSink()
+        g = self._graph_with_group(env, sink, group="heavy")
+        groups = g.distinct_slot_groups()
+        assert groups == ["default", "heavy"]
+        resolved = g.slot_groups()
+        # the sink inherits its input's (the agg's) group
+        sink_t = [t for t in g.nodes if t.kind == "sink"][0]
+        assert resolved[sink_t.uid] == "heavy"
+        src_t = [t for t in g.nodes if t.kind == "source"][0]
+        assert resolved[src_t.uid] == "default"
+
+    def test_extra_group_holds_an_extra_slot(self, tmp_path):
+        cluster = MiniCluster(Configuration({
+            "cluster.task-executors": 1,
+            "taskmanager.numberOfTaskSlots": 2,
+            "rest.port": -1,
+        }))
+        try:
+            out = str(tmp_path / "out.jsonl")
+            env = StreamExecutionEnvironment(Configuration({
+                "execution.micro-batch.size": 500}))
+            class Slow(DataGenSource):
+                def poll_batch(self, n):
+                    time.sleep(0.02)
+                    return super().poll_batch(n)
+
+            src = Slow(total_records=30_000, num_keys=50,
+                       events_per_second_of_eventtime=10_000, seed=9)
+            ds = env.from_source(
+                src, WatermarkStrategy.for_bounded_out_of_orderness(0),
+                name="gen")
+            (ds.key_by("key")
+               .window(TumblingEventTimeWindows.of(1000)).sum("value")
+               .slot_sharing_group("isolated")
+               .sink_to(JsonLinesFileSink(out)))
+            client = cluster.submit(env, "grouped")
+            allocated = {}
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                allocated = {
+                    eid: info["allocated"]
+                    for eid, info in cluster.rm._executors.items()}
+                if sum(allocated.values()) >= 2:
+                    break
+                time.sleep(0.02)
+            # two sharing groups -> two slots held while running
+            assert sum(allocated.values()) >= 2, allocated
+            status = client.wait(timeout=120)
+            assert status["status"] == "FINISHED"
+            assert sum(i["allocated"]
+                       for i in cluster.rm._executors.values()) == 0
+        finally:
+            cluster.shutdown()

@@ -214,7 +214,12 @@ def row5_sessions_10m_keys():
     run(1 << 20)  # warm
     return {"metric":
             "session_clickstream_10m_keys_events_per_sec_per_chip",
-            "value": round(run(total), 1), "unit": "events/s"}
+            "value": round(run(total), 1), "unit": "events/s",
+            # rounds <= 3 generated 400k ev/s of event time, whose ~800k
+            # live sessions exceeded the 512k device budget and thrashed
+            # the spill tier — cross-round numbers are NOT comparable
+            "shape": "200k ev/s event time, 2 s gap, ~400k live "
+                     "sessions (in budget), 10M distinct keys"}
 
 
 ROWS = [("wordcount_socket", row1_wordcount),
@@ -260,6 +265,8 @@ def main():
         val = (f"{r['value']:,.0f}" if "value" in r
                else f"error: {r.get('error', '?')[:60]}")
         extra = ""
+        if r.get("shape"):
+            extra = f" — {r['shape']}"
         if r.get("fire_latency_ms"):
             lat = r["fire_latency_ms"]
             extra = (f" (fire p50 {lat['p50']:.0f} ms / "
