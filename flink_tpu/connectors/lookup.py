@@ -16,6 +16,7 @@ like every other connector seam here), fronted by an LRU cache.
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -67,13 +68,21 @@ class LookupJoinOperator(Operator):
     reference: LookupJoinRunner + the FLIP-221 caching layer. Per batch:
     distinct keys split into cache hits and misses, ONE lookup() fetches
     the misses, results join back positionally. A cached miss is cached
-    too (negative caching, like the reference's missing-key cache)."""
+    too (negative caching, like the reference's missing-key cache).
+
+    Caching is OPT-IN (``cache_size=0`` by default), matching FLIP-221
+    where ``lookup.cache`` defaults to NONE — a dimension row updated
+    after first access would otherwise never be observed while its key
+    sits in the LRU. When enabled, ``cache_ttl_ms`` bounds staleness
+    (the reference's partial-cache ``expireAfterWrite``); ``None``
+    means entries never expire (static dimension data only)."""
 
     name = "lookup_join"
 
     def __init__(self, fn: LookupFunction, key_field: str,
                  right_columns: Optional[Sequence[str]] = None,
-                 suffixes=("_l", "_r"), cache_size: int = 10_000,
+                 suffixes=("_l", "_r"), cache_size: int = 0,
+                 cache_ttl_ms: Optional[int] = None,
                  left_outer: bool = False):
         self.fn = fn
         self.key_field = key_field
@@ -84,8 +93,10 @@ class LookupJoinOperator(Operator):
             else None
         self.suffixes = suffixes
         self.cache_size = int(cache_size)
+        self.cache_ttl_ms = cache_ttl_ms
         self.left_outer = left_outer
-        #: key value -> row dict or None (negative cache)
+        #: key value -> (row dict or None, write-time ms) — None row is
+        #: the negative cache
         self._cache: OrderedDict = OrderedDict()
         self.lookups = 0
         self.cache_hits = 0
@@ -94,14 +105,20 @@ class LookupJoinOperator(Operator):
         self.fn.open()
 
     def _fetch(self, key_vals: np.ndarray) -> Dict[object, Optional[dict]]:
+        now_ms = time.monotonic() * 1e3
         out: Dict[object, Optional[dict]] = {}
         misses: List[object] = []
         for k in dict.fromkeys(key_vals.tolist()):
-            if self.cache_size and k in self._cache:
+            entry = self._cache.get(k) if self.cache_size else None
+            if entry is not None and (
+                    self.cache_ttl_ms is None
+                    or now_ms - entry[1] < self.cache_ttl_ms):
                 self._cache.move_to_end(k)
-                out[k] = self._cache[k]
+                out[k] = entry[0]
                 self.cache_hits += 1
             else:
+                if entry is not None:  # expired — refetch
+                    del self._cache[k]
                 misses.append(k)
         if misses:
             self.lookups += 1
@@ -117,7 +134,7 @@ class LookupJoinOperator(Operator):
                 row = found.get(k)
                 out[k] = row
                 if self.cache_size:
-                    self._cache[k] = row
+                    self._cache[k] = (row, now_ms)
                     while len(self._cache) > self.cache_size:
                         self._cache.popitem(last=False)
         return out

@@ -232,7 +232,46 @@ class IntervalJoinOperator(Operator):
         #: concatenation of self._left (only maintained when left_outer)
         self._left_matched: List[np.ndarray] = []
         self._right: List[RecordBatch] = []
+        #: right column -> observed dtype (set at the first right batch;
+        #: drives type-correct null padding in _pad_unmatched)
+        self._right_dtypes: Dict[str, np.dtype] = {}
         self._max_parallelism = 128
+
+    def _observe_right(self, batch: RecordBatch) -> RecordBatch:
+        """First-right-batch schema contract (LEFT JOIN only).
+
+        The declared ``right_columns`` drive the null-padded schema, so
+        a drift between declaration and the actual right batches would
+        silently give matched and padded rows different schemas — raise
+        instead. Integer/bool right columns are coerced to float64 at
+        the buffering boundary: SQL NULL has no integer representation
+        in a dense column, so BOTH matched and padded emissions carry
+        float64 (one schema), rather than int in matched and float-NaN
+        in padded."""
+        if not self.left_outer:
+            return batch
+        observed = [c for c in batch.names()
+                    if c not in (KEY_ID_FIELD, TIMESTAMP_FIELD)]
+        declared = [c for c in self.right_columns
+                    if c not in (KEY_ID_FIELD, TIMESTAMP_FIELD)]
+        if set(observed) != set(declared):
+            raise RuntimeError(
+                "LEFT interval join: declared right columns "
+                f"{sorted(declared)} != right batch columns "
+                f"{sorted(observed)} — null padding would produce "
+                "a different schema than matches")
+        cols = dict(batch.columns)
+        for c in observed:
+            v = np.asarray(cols[c])
+            if v.dtype.kind in "iub":
+                v = v.astype(np.float64)
+            elif v.dtype.kind in "US":
+                # fixed-width numpy strings can't hold a None pad —
+                # carry strings as object so NULL is representable
+                v = v.astype(object)
+            cols[c] = v
+            self._right_dtypes.setdefault(c, v.dtype)
+        return RecordBatch(cols)
 
     def open(self, ctx):
         self._max_parallelism = getattr(ctx, "max_parallelism", 128)
@@ -251,6 +290,7 @@ class IntervalJoinOperator(Operator):
                     flags[l_hit] = True
                 self._left_matched.append(flags)
         else:
+            batch = self._observe_right(batch)
             matches, l_hit = self._join(
                 RecordBatch.concat(self._left), batch, left_is_new=False)
             self._right.append(batch)
@@ -308,7 +348,14 @@ class IntervalJoinOperator(Operator):
             if k in (KEY_ID_FIELD, TIMESTAMP_FIELD):
                 continue
             name = k + self.suffixes[1] if k in left_b.columns else k
-            cols[name] = np.full(n, np.nan)
+            dt = self._right_dtypes.get(k)
+            if dt is not None and dt.kind in "OUS":
+                # string/object right column: SQL NULL is None, not NaN
+                cols[name] = np.full(n, None, dtype=object)
+            else:
+                cols[name] = np.full(n, np.nan,
+                                     dtype=dt if dt is not None
+                                     else np.float64)
         cols[TIMESTAMP_FIELD] = lts
         return RecordBatch(cols)
 
@@ -370,6 +417,11 @@ class IntervalJoinOperator(Operator):
             else:
                 snap["ij_matched"] = np.zeros(
                     sum(len(b) for b in self._left), dtype=bool)
+            # padding dtypes must survive a restore even when the right
+            # buffer was pruned empty — else a post-restore pad of a
+            # string column would fall back to float NaN
+            snap["ij_right_dtypes"] = {
+                k: str(v) for k, v in self._right_dtypes.items()}
         return snap
 
     def restore_state(self, state, key_group_filter=None):
@@ -408,6 +460,9 @@ class IntervalJoinOperator(Operator):
                                            self._max_parallelism)
                      for c in right]
         self._right = [RecordBatch(c) for c in right]
+        self._right_dtypes = {
+            k: np.dtype(v)
+            for k, v in state.get("ij_right_dtypes", {}).items()}
 
 
 class TemporalJoinOperator(Operator):

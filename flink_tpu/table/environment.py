@@ -251,13 +251,16 @@ class StreamTableEnvironment:
 
     def create_lookup_table(self, name: str, lookup_fn,
                             columns: Sequence[str],
-                            cache_size: int = 10_000) -> None:
+                            cache_size: int = 0,
+                            cache_ttl_ms=None) -> None:
         """Register a LookupFunction as a dimension table for lookup
         joins: ``JOIN name FOR SYSTEM_TIME AS OF o.rowtime ON ...``
         (reference: a LookupTableSource-backed catalog table; the cache
-        maps FLIP-221 'lookup.cache')."""
+        maps FLIP-221 'lookup.cache' — opt-in like the reference, with
+        ``cache_ttl_ms`` as expireAfterWrite so live dimension updates
+        are eventually observed)."""
         self._lookup_tables[name] = (lookup_fn, list(columns),
-                                     int(cache_size))
+                                     int(cache_size), cache_ttl_ms)
 
     def create_temporary_model(self, name: str, model) -> None:
         """Register a Model object for ML_PREDICT (the programmatic form

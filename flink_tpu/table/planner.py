@@ -961,7 +961,7 @@ class Planner:
         here, with the left rowtime column naming the stream side)."""
         from flink_tpu.connectors.lookup import LookupJoinOperator
 
-        fn, r_columns, cache_size = \
+        fn, r_columns, cache_size, cache_ttl_ms = \
             self.t_env._lookup_tables[join.right.name]
         left = self._plan_table_ref(join.left)
         if left.upsert_keys is not None:
@@ -1027,7 +1027,8 @@ class Planner:
             operator_factory=lambda: LookupJoinOperator(
                 fn, key_field, right_columns=r_columns,
                 suffixes=("_l", "_r"),
-                cache_size=cache_size, left_outer=left_outer),
+                cache_size=cache_size, cache_ttl_ms=cache_ttl_ms,
+                left_outer=left_outer),
             inputs=[left.stream.transformation])
         joined = DataStream(self.env, t)
         out_cols: List[str] = []

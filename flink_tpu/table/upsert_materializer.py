@@ -30,6 +30,7 @@ from flink_tpu.core.records import (
     ROWKIND_INSERT,
     ROWKIND_UPDATE_AFTER,
     ROWKIND_UPDATE_BEFORE,
+    TIMESTAMP_FIELD,
     RecordBatch,
 )
 from flink_tpu.runtime.operators import Operator
@@ -58,6 +59,17 @@ class UpsertMaterializeOperator(Operator):
         self._rows: Dict[Tuple, List[Tuple]] = {}
         #: column order of the row-value tuples (fixed at first batch)
         self._cols: List[str] = []
+        #: positions of _cols compared when matching a retraction —
+        #: everything except the event-time stamp. Upstream GroupAgg
+        #: re-stamps every emission (including -U pre-images) with its
+        #: CURRENT watermark-side max_ts, so a -U's __ts__ never equals
+        #: the stored image's once event time advances; matching on the
+        #: full tuple would then fall to the drop-oldest path and remove
+        #: the WRONG image when several changelog keys feed one sink key
+        #: (the reference removes by row equality over VALUES —
+        #: SinkUpsertMaterializer.java's equaliser compares row fields,
+        #: not system timestamps).
+        self._match_idx: List[int] = []
 
     def open(self, ctx) -> None:
         self.max_parallelism = getattr(ctx, "max_parallelism", 128)
@@ -77,6 +89,9 @@ class UpsertMaterializeOperator(Operator):
         value_cols = [c for c in batch.names() if c != ROWKIND_FIELD]
         if not self._cols:
             self._cols = value_cols
+        if not self._match_idx:
+            self._match_idx = [i for i, c in enumerate(self._cols)
+                               if c != TIMESTAMP_FIELD]
         kinds = (np.asarray(batch[ROWKIND_FIELD])
                  if ROWKIND_FIELD in batch.columns
                  else np.full(n, ROWKIND_INSERT, dtype=np.int8))
@@ -102,8 +117,9 @@ class UpsertMaterializeOperator(Operator):
             # tolerated by dropping the oldest)
             if not lst:
                 continue
+            probe = tuple(row[i] for i in self._match_idx)
             for i in range(len(lst) - 1, -1, -1):
-                if lst[i] == row:
+                if tuple(lst[i][j] for j in self._match_idx) == probe:
                     del lst[i]
                     break
             else:
@@ -123,7 +139,10 @@ class UpsertMaterializeOperator(Operator):
             if prev is None:
                 out_rows.append(cur)
                 out_kinds.append(ROWKIND_INSERT)
-            elif cur != prev:
+            elif (tuple(cur[j] for j in self._match_idx)
+                  != tuple(prev[j] for j in self._match_idx)):
+                # value columns changed (the restamped __ts__ alone is
+                # not a change — same masking as retraction matching)
                 out_rows.append(cur)
                 out_kinds.append(ROWKIND_UPDATE_AFTER)
             # unchanged: suppress the duplicate upsert
@@ -154,6 +173,8 @@ class UpsertMaterializeOperator(Operator):
     def restore_state(self, state: Dict[str, Any],
                       key_group_filter=None) -> None:
         self._cols = list(state.get("um_cols", []))
+        self._match_idx = [i for i, c in enumerate(self._cols)
+                           if c != TIMESTAMP_FIELD]
         keys = [tuple(k) if isinstance(k, (list, tuple)) else (k,)
                 for k in state.get("um_keys", [])]
         rows = [[tuple(r) for r in lst]

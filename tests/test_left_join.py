@@ -65,6 +65,43 @@ class TestOperator:
         outs = op.close()
         assert len(outs) == 1 and np.isnan(outs[0].to_rows()[0]["vb"])
 
+    def test_declared_right_schema_mismatch_raises(self):
+        """Advisor r4 (low): a right batch whose columns drift from the
+        declared right_columns must raise, not silently give matched
+        and padded batches different schemas."""
+        op = self._op()  # declares ["k", "vb"]
+        op.process_batch(_kb({"k": np.asarray([1]),
+                              "va": np.asarray([1.0])}, [100]),
+                         input_index=0)
+        with pytest.raises(RuntimeError, match="declared right columns"):
+            op.process_batch(_kb({"k": np.asarray([1]),
+                                  "OTHER": np.asarray([1.5])}, [100]),
+                             input_index=1)
+
+    def test_padded_and_matched_share_dtype_for_int_and_str(self):
+        """Integer right columns carry float64 in BOTH matched and
+        padded emissions (SQL NULL needs a representation); string
+        right columns pad with None, not float NaN."""
+        op = IntervalJoinOperator(-100, 100, left_outer=True,
+                                  right_columns=["k", "cnt", "tag"])
+        op.open(_Ctx())
+        op.process_batch(_kb({"k": np.asarray([1, 2]),
+                              "va": np.asarray([10.0, 20.0])},
+                             [1000, 1000]), input_index=0)
+        out = op.process_batch(
+            _kb({"k": np.asarray([1]),
+                 "cnt": np.asarray([7], dtype=np.int64),
+                 "tag": np.asarray(["x"])}, [1050]),
+            input_index=1)
+        matched = out[0]
+        assert matched["cnt"].dtype == np.float64
+        assert matched["cnt"][0] == 7.0
+        padded = op.process_watermark(5000)[0]
+        assert padded["cnt"].dtype == matched["cnt"].dtype
+        assert np.isnan(padded["cnt"][0])
+        assert padded["tag"].dtype == matched["tag"].dtype == object
+        assert padded["tag"][0] is None and matched["tag"][0] == "x"
+
     def test_restore_with_key_group_filter_after_merge(self):
         """Regression: a right-side match merges the per-batch flag
         arrays into one — restore with a key-group filter must stay

@@ -72,6 +72,40 @@ class TestOperator:
         op.process_batch(self._batch([9]))
         assert op.lookups == 2
 
+    def test_cache_off_by_default_sees_live_updates(self):
+        """FLIP-221: caching is opt-in. A dimension row inserted after
+        the first (missed) access must be observed (advisor r4, low)."""
+        fn = _dim()
+        op = LookupJoinOperator(fn, "cur",
+                                right_columns=["cur", "name", "factor"],
+                                left_outer=True)
+        op.open(_Ctx())
+        out = op.process_batch(self._batch([9]))[0]
+        assert str(out["name"][0]) in ("nan", "None")  # miss padded
+        fn._by_key[9] = {"cur": 9, "name": "JPY", "factor": 0.007}
+        out = op.process_batch(self._batch([9]))[0]
+        assert list(out["name"]) == ["JPY"]  # no stale negative cache
+
+    def test_cache_ttl_expires_entries(self, monkeypatch):
+        import time as _time
+
+        clock = [0.0]
+        monkeypatch.setattr(_time, "monotonic", lambda: clock[0])
+        fn = _dim()
+        op = LookupJoinOperator(fn, "cur", cache_size=10,
+                                cache_ttl_ms=1000)
+        op.open(_Ctx())
+        op.process_batch(self._batch([1]))
+        assert op.lookups == 1
+        clock[0] = 0.5  # within TTL: served from cache
+        op.process_batch(self._batch([1]))
+        assert op.lookups == 1
+        fn._by_key[1] = {"cur": 1, "name": "EUR2", "factor": 2.0}
+        clock[0] = 1.5  # past TTL: refetched, update observed
+        out = op.process_batch(self._batch([1]))[0]
+        assert op.lookups == 2
+        assert list(out["name"]) == ["EUR2"]
+
 
 class TestLookupJoinSQL:
     def _env(self):

@@ -76,6 +76,40 @@ class TestMaterializeOperator:
             {"k": 1, "v": 4.0, ROWKIND_FIELD: ROWKIND_UPDATE_AFTER}]))
         assert out == []
 
+    def test_retraction_matches_despite_restamped_ts(self):
+        """Upstream GroupAgg re-stamps -U pre-images with the CURRENT
+        max_ts, so retraction matching must ignore __ts__ — otherwise a
+        miss falls to drop-oldest and removes the WRONG key's image when
+        two changelog keys feed one sink-key value (advisor r4, high)."""
+        from flink_tpu.core.records import TIMESTAMP_FIELD
+
+        op = UpsertMaterializeOperator(["v"])
+        op.open(_Ctx())
+        # two changelog keys (g=1, g=2) both currently at v=5 — with
+        # sink PRIMARY KEY (v), key (5.0,) holds two images
+        op.process_batch(_batch([
+            {"g": 2, "v": 5.0, TIMESTAMP_FIELD: 100,
+             ROWKIND_FIELD: ROWKIND_INSERT},
+            {"g": 1, "v": 5.0, TIMESTAMP_FIELD: 200,
+             ROWKIND_FIELD: ROWKIND_INSERT},
+        ]))
+        assert len(op._rows[(5.0,)]) == 2
+        # g=1 retracts its v=5 image later: the -U carries the NEW
+        # stamp (999), not the stored 100. It must remove g=1's image,
+        # leaving g=2's as the current one.
+        out = op.process_batch(_batch([
+            {"g": 1, "v": 5.0, TIMESTAMP_FIELD: 999,
+             ROWKIND_FIELD: ROWKIND_UPDATE_BEFORE},
+            {"g": 1, "v": 6.0, TIMESTAMP_FIELD: 999,
+             ROWKIND_FIELD: ROWKIND_UPDATE_AFTER},
+        ]))
+        remaining = op._rows[(5.0,)]
+        assert len(remaining) == 1
+        g_idx = op._cols.index("g")
+        assert remaining[0][g_idx] == 2  # g=2's image survived
+        rows = {r["v"]: r for r in out[0].to_rows()}
+        assert rows[6.0][ROWKIND_FIELD] == ROWKIND_INSERT
+
     def test_snapshot_restore_key_group_filter(self):
         op = UpsertMaterializeOperator(["k"])
         op.open(_Ctx())
