@@ -1,0 +1,10 @@
+"""Make the repo importable when an example is run by path from any cwd
+(``python examples/foo.py``): Python puts examples/ on sys.path, not the
+repo root. Imported for its side effect: ``import _bootstrap``."""
+
+import os
+import sys
+
+_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _root not in sys.path:
+    sys.path.insert(0, _root)

@@ -7,6 +7,10 @@ occur in between (the fraudster never completes 2FA).
 Run: python examples/fraud_detection_cep.py
 """
 
+try:
+    import _bootstrap  # noqa: F401  (repo-root sys.path when run by file path)
+except ImportError:  # exec'd / repo already importable
+    pass
 import numpy as np
 
 from flink_tpu import Configuration, StreamExecutionEnvironment

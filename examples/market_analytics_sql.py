@@ -11,6 +11,10 @@
 Run: python examples/market_analytics_sql.py
 """
 
+try:
+    import _bootstrap  # noqa: F401  (repo-root sys.path when run by file path)
+except ImportError:  # exec'd / repo already importable
+    pass
 import json
 
 import numpy as np

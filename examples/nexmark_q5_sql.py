@@ -4,6 +4,10 @@ bid stream, Top-N per window via ROW_NUMBER, INSERT INTO a sink table.
 Run: python examples/nexmark_q5_sql.py
 """
 
+try:
+    import _bootstrap  # noqa: F401  (repo-root sys.path when run by file path)
+except ImportError:  # exec'd / repo already importable
+    pass
 from flink_tpu import Configuration, StreamExecutionEnvironment
 from flink_tpu.benchmarks.nexmark import BidSource
 from flink_tpu.connectors.sinks import CollectSink

@@ -8,6 +8,10 @@ through it, so the whole flow (socket -> flat_map split -> key_by ->
 tumbling window count -> print) runs end to end with no external setup.
 """
 
+try:
+    import _bootstrap  # noqa: F401  (repo-root sys.path when run by file path)
+except ImportError:  # exec'd / repo already importable
+    pass
 import argparse
 import socket
 import threading

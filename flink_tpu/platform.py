@@ -51,3 +51,150 @@ def sync_platform() -> None:
         except Exception:
             pass
     enable_compilation_cache()
+
+
+#: memoized ensure_live_backend decision ("<platform>" once probed)
+_live_backend = None
+
+
+def _probe_cache_path(selection: str) -> str:
+    import hashlib
+    import tempfile
+
+    h = hashlib.sha1(selection.encode()).hexdigest()[:12]
+    return os.path.join(tempfile.gettempdir(),
+                        f"flink_tpu_backend_probe_{h}.json")
+
+
+def _read_probe_cache(selection: str):
+    """Cross-process probe verdict ("live"/"dead") if fresh, else None."""
+    import json
+    import time
+
+    ttl = float(os.environ.get("FLINK_TPU_BACKEND_PROBE_CACHE_TTL", 300))
+    if ttl <= 0:
+        return None
+    try:
+        with open(_probe_cache_path(selection)) as f:
+            d = json.load(f)
+        if time.time() - d["ts"] <= ttl and d.get("selection") == selection:
+            return d["verdict"]
+    except Exception:
+        pass
+    return None
+
+
+def _write_probe_cache(selection: str, verdict: str) -> None:
+    import json
+    import time
+
+    try:
+        path = _probe_cache_path(selection)
+        tmp = path + f".{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"selection": selection, "verdict": verdict,
+                       "ts": time.time()}, f)
+        os.replace(tmp, path)
+    except Exception:
+        pass
+
+
+def ensure_live_backend(timeout: float = 45.0) -> str:
+    """Bounded accelerator-backend probe with CPU fallback.
+
+    Remote/tunneled accelerator plugins can hang *indefinitely* inside
+    native client creation when their transport is down (observed here:
+    the relay refusing TCP while the plugin retries forever —
+    ``tpu_results/diagnose_latest.json``). An ``env.execute()`` that
+    trusts the configured platform then hangs before the first batch.
+
+    This probes backend init in a SUBPROCESS (a hung native call cannot
+    be cancelled in-process) with a bounded timeout; on failure it
+    falls back to CPU via ``jax.config`` and returns "cpu". The result
+    is memoized per process — callers can invoke it on every execute().
+
+    Environment knobs: ``FLINK_TPU_BACKEND_PROBE_TIMEOUT`` overrides
+    the timeout (seconds); ``FLINK_TPU_BACKEND_PROBE=off`` trusts the
+    configured platform without probing (production clusters where the
+    backend is known-good and first-init cost is owned elsewhere);
+    ``FLINK_TPU_BACKEND_PROBE_CACHE_TTL`` (seconds, default 300)
+    bounds how long a probe verdict is shared across processes via a
+    marker file — so a fleet of short-lived processes pays the dead-
+    backend timeout once per machine per TTL window, not once each.
+
+    Returns the platform name compute will run on.
+
+    reference analog: a TaskExecutor that cannot reach its accelerator
+    fails fast and lets the scheduler reroute, rather than wedging the
+    task thread (flink-runtime TaskExecutor startup fails loudly on
+    unavailable managed memory/devices).
+    """
+    global _live_backend
+    if _live_backend is not None:
+        return _live_backend
+    sync_platform()
+    import jax
+
+    if os.environ.get("FLINK_TPU_BACKEND_PROBE", "").lower() in (
+            "off", "0", "false"):
+        _live_backend = "unprobed"
+        return _live_backend
+    selection = os.environ.get("JAX_PLATFORMS") or ""
+    try:
+        selection = selection or (jax.config.jax_platforms or "")
+    except Exception:
+        pass
+    first = selection.split(",")[0].strip().lower() if selection else ""
+    if first in ("", "cpu"):
+        _live_backend = first or "default"
+        return _live_backend
+    import subprocess
+    import sys
+
+    timeout = float(os.environ.get("FLINK_TPU_BACKEND_PROBE_TIMEOUT",
+                                   timeout))
+    cached = _read_probe_cache(selection)
+    if cached is not None:
+        if cached == "dead":
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+            _live_backend = "cpu"
+        else:
+            _live_backend = first
+        return _live_backend
+    # the probe re-asserts the selection after import because
+    # sitecustomize hooks may override it via jax.config (the exact
+    # failure mode sync_platform exists for)
+    code = (
+        "import os, jax\n"
+        f"jax.config.update('jax_platforms', {selection!r})\n"
+        "jax.devices()\n"
+        "print('BACKEND_LIVE')\n")
+    ok = False
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout)
+        ok = proc.returncode == 0 and "BACKEND_LIVE" in proc.stdout
+    except Exception:
+        ok = False
+    _write_probe_cache(selection, "live" if ok else "dead")
+    if ok:
+        _live_backend = first
+    else:
+        import warnings
+
+        warnings.warn(
+            f"backend {first!r} failed to initialize within {timeout:.0f}s"
+            " — falling back to CPU for this process (set "
+            "FLINK_TPU_BACKEND_PROBE=off to trust the configured "
+            "platform, FLINK_TPU_BACKEND_PROBE_TIMEOUT to wait longer)",
+            RuntimeWarning, stacklevel=2)
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        _live_backend = "cpu"
+    return _live_backend

@@ -264,13 +264,27 @@ class IntervalJoinOperator(Operator):
         for c in observed:
             v = np.asarray(cols[c])
             if v.dtype.kind in "iub":
-                v = v.astype(np.float64)
+                # float64 only round-trips integers up to 2^53 — larger
+                # values (snowflake-style IDs) must go through object
+                # dtype or they'd be silently rounded
+                if v.dtype.itemsize >= 8 and len(v) and \
+                        np.abs(v.astype(np.int64)).max() > (1 << 53):
+                    v = v.astype(object)
+                else:
+                    v = v.astype(np.float64)
             elif v.dtype.kind in "US":
                 # fixed-width numpy strings can't hold a None pad —
                 # carry strings as object so NULL is representable
                 v = v.astype(object)
             cols[c] = v
-            self._right_dtypes.setdefault(c, v.dtype)
+            prev = self._right_dtypes.setdefault(c, v.dtype)
+            if prev != v.dtype:
+                raise RuntimeError(
+                    f"LEFT interval join: right column {c!r} changed "
+                    f"carry dtype across batches ({prev} -> {v.dtype}; "
+                    "an int64 value above 2^53 arrived after the column "
+                    "was established as float64) — emitted schemas "
+                    "would diverge")
         return RecordBatch(cols)
 
     def open(self, ctx):

@@ -1,0 +1,86 @@
+"""Platform selection: bounded backend probe + CPU fallback.
+
+The execution path must survive an environment whose configured
+accelerator backend has a dead transport (plugin hangs in native init) —
+``env.execute()`` degrades to CPU after a bounded probe instead of
+hanging forever. See tools/tpu_diagnose.py + tpu_results/ for the
+committed failure-layer evidence this guards against."""
+
+import os
+
+import pytest
+
+import flink_tpu.platform as platform
+
+
+@pytest.fixture(autouse=True)
+def _reset_memo():
+    platform._live_backend = None
+    yield
+    platform._live_backend = None
+
+
+def test_cpu_selection_skips_probe(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert platform.ensure_live_backend() == "cpu"
+
+
+def test_probe_off_trusts_configuration(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.setenv("FLINK_TPU_BACKEND_PROBE", "off")
+    assert platform.ensure_live_backend() == "unprobed"
+
+
+def test_dead_backend_falls_back_to_cpu(monkeypatch, tmp_path):
+    """A selection whose init can't succeed within the bound degrades
+    to CPU with a warning — and jax keeps working afterwards."""
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")  # no TPU in CI
+    monkeypatch.setenv("FLINK_TPU_BACKEND_PROBE_TIMEOUT", "8")
+    monkeypatch.setenv("FLINK_TPU_BACKEND_PROBE_CACHE_TTL", "0")
+    with pytest.warns(RuntimeWarning, match="falling back to CPU"):
+        got = platform.ensure_live_backend()
+    assert got == "cpu"
+    import jax
+    import jax.numpy as jnp
+
+    out = jax.jit(lambda x: x * 2)(jnp.arange(3))
+    assert out.tolist() == [0, 2, 4]
+    # memoized: second call must not probe again (would re-warn)
+    assert platform.ensure_live_backend() == "cpu"
+
+
+def test_probe_verdict_cached_across_processes(monkeypatch):
+    """A fresh process (reset memo) reuses the marker-file verdict
+    instead of re-paying the probe timeout."""
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.setenv("FLINK_TPU_BACKEND_PROBE_CACHE_TTL", "300")
+    platform._write_probe_cache("tpu", "dead")
+    import time
+
+    t0 = time.monotonic()
+    got = platform.ensure_live_backend()
+    assert got == "cpu"
+    assert time.monotonic() - t0 < 2.0  # no subprocess probe ran
+    os.remove(platform._probe_cache_path("tpu"))
+
+
+def test_execute_calls_probe(monkeypatch):
+    """env.execute() consults the probe before touching the device."""
+    calls = []
+    monkeypatch.setattr(platform, "ensure_live_backend",
+                        lambda timeout=45.0: calls.append(1) or "cpu")
+    from flink_tpu import Configuration, StreamExecutionEnvironment
+    from flink_tpu.connectors.sinks import CollectSink
+    from flink_tpu.connectors.sources import DataGenSource
+    from flink_tpu.runtime.watermarks import WatermarkStrategy
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+    env = StreamExecutionEnvironment(Configuration())
+    sink = CollectSink()
+    env.add_source(DataGenSource(total_records=100, num_keys=3,
+                                 events_per_second_of_eventtime=100),
+                   WatermarkStrategy.for_bounded_out_of_orderness(0)) \
+        .key_by("key").window(TumblingEventTimeWindows.of(1000)) \
+        .sum("value").sink_to(sink)
+    env.execute()
+    assert calls, "execute() must invoke ensure_live_backend"

@@ -146,9 +146,12 @@ def main() -> None:
         report["listening_sockets"] = [f"ss failed: {e}"]
 
     # --- layer 2: probe matrix -------------------------------------------
+    # strip only the axon sitecustomize dir (basename match — a bare
+    # "axon" substring would also drop e.g. /home/x/taxonomy-lib)
     axon_site = os.environ.get("PYTHONPATH", "")
-    no_axon_path = ":".join(p for p in axon_site.split(":")
-                            if "axon" not in p) or None
+    no_axon_path = ":".join(
+        p for p in axon_site.split(":")
+        if os.path.basename(p.rstrip("/")) != ".axon_site") or None
     matrix = [
         # resync=True: re-assert JAX_PLATFORMS after import, since the
         # axon sitecustomize overrides it via jax.config — this cell
