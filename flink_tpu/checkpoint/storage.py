@@ -212,8 +212,11 @@ def _pack_rows(key_ids, namespaces) -> np.ndarray:
 
 def apply_table_delta(base: Optional[Dict[str, Any]],
                       delta: Dict[str, Any]) -> Dict[str, Any]:
-    """Materialize base rows + delta upserts - tombstoned namespaces."""
-    cols = [k for k in delta if k not in ("__delta__", "freed_namespaces")]
+    """Materialize base rows + delta upserts - tombstones (whole freed
+    namespaces and TTL-expired (key, ns) pairs)."""
+    meta = ("__delta__", "freed_namespaces",
+            "tombstone_key_id", "tombstone_namespace")
+    cols = [k for k in delta if k not in meta]
     delta_rows = {c: np.asarray(delta[c]) for c in cols}
     if base is None or len(np.asarray(base.get("key_id", ()))) == 0:
         return delta_rows
@@ -222,9 +225,16 @@ def apply_table_delta(base: Optional[Dict[str, Any]],
     if len(freed):
         keep &= ~np.isin(np.asarray(base["namespace"], dtype=np.int64),
                          freed)
+    tomb_k = np.asarray(delta.get("tombstone_key_id", ()), dtype=np.int64)
+    packed_base = None  # built once; base can be millions of rows
+    if len(tomb_k) or len(delta_rows["key_id"]):
+        packed_base = _pack_rows(base["key_id"], base["namespace"])
+    if len(tomb_k):
+        tomb_n = np.asarray(delta["tombstone_namespace"], dtype=np.int64)
+        keep &= ~np.isin(packed_base, _pack_rows(tomb_k, tomb_n))
     if len(delta_rows["key_id"]):
         keep &= ~np.isin(
-            _pack_rows(base["key_id"], base["namespace"]),
+            packed_base,
             _pack_rows(delta_rows["key_id"], delta_rows["namespace"]))
     return {
         c: np.concatenate([np.asarray(base[c])[keep], delta_rows[c]])

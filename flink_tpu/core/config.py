@@ -270,6 +270,15 @@ class DeploymentOptions:
 
 
 class StateOptions:
+    TABLE_EXEC_STATE_TTL = ConfigOption(
+        "table.exec.state.ttl", default=0, type=int,
+        description="Idle-state retention for SQL operators, in ms: a "
+        "GROUP BY accumulator or upsert-materializer key untouched this "
+        "long is dropped (slot freed, snapshots shrink); a later arrival "
+        "re-INSERTs. 0 (default) = keep state forever. The reference's "
+        "table.exec.state.ttl / StateTtlConfig semantics (reference: "
+        "flink-core/.../api/common/state/StateTtlConfig.java:1, "
+        "flink-runtime/.../runtime/state/ttl/TtlStateFactory.java:1).")
     DEVICE_MEMORY_BUDGET = ConfigOption(
         "memory.device.size", default=0, type=int,
         description="Managed device (HBM) memory budget in BYTES shared "

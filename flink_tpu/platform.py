@@ -88,6 +88,9 @@ def _write_probe_cache(selection: str, verdict: str) -> None:
     import json
     import time
 
+    ttl = float(os.environ.get("FLINK_TPU_BACKEND_PROBE_CACHE_TTL", 300))
+    if ttl <= 0:  # cache disabled: don't poison other processes either
+        return
     try:
         path = _probe_cache_path(selection)
         tmp = path + f".{os.getpid()}"

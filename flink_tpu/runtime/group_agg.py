@@ -55,7 +55,8 @@ class GroupAggOperator(Operator):
     def __init__(self, agg: AggregateFunction, key_field: str,
                  capacity: int = 1 << 16,
                  emit_on_watermark_only: bool = False,
-                 generate_update_before: bool = True):
+                 generate_update_before: bool = True,
+                 ttl_ms: Optional[int] = None, clock=None):
         self.agg = agg
         self.key_field = key_field
         self.capacity = capacity
@@ -65,6 +66,17 @@ class GroupAggOperator(Operator):
         #: False = upsert mode: UPDATE_AFTER only (no retraction images),
         #: DELETEs still emitted — for upsert-keyed sinks
         self.generate_update_before = generate_update_before
+        #: idle-state retention: accumulators untouched for ttl_ms are
+        #: dropped (slot freed, snapshot shrinks); a key arriving after
+        #: expiry re-INSERTs — the reference's documented
+        #: `table.exec.state.ttl` semantics (reference: StateTtlConfig +
+        #: GroupAggFunction's stateRetentionTime cleanup timer). Silent
+        #: drop, no DELETE emission, like the reference.
+        self.ttl_ms = ttl_ms
+        from flink_tpu.state.ttl import SweepGate, default_clock
+
+        self._clock = clock or default_clock
+        self._sweep_gate = SweepGate(ttl_ms) if ttl_ms else None
         self.table: Optional[SlotTable] = None
         self._key_values: Dict[int, Any] = {}
         self._keys_hashed = False
@@ -74,6 +86,8 @@ class GroupAggOperator(Operator):
         self._row_counts = np.zeros(0, dtype=np.int64)
         self._emitted_mask = np.zeros(0, dtype=bool)
         self._last_emitted: Dict[str, np.ndarray] = {}
+        #: per-slot last-update processing-time stamp (-1 = free)
+        self._last_update = np.zeros(0, dtype=np.int64)
 
     def open(self, ctx):
         mm = getattr(ctx, "memory_manager", None)
@@ -98,10 +112,42 @@ class GroupAggOperator(Operator):
         mask = np.zeros(size, dtype=bool)
         mask[: len(self._emitted_mask)] = self._emitted_mask
         self._emitted_mask = mask
+        stamps = np.full(size, -1, dtype=np.int64)
+        stamps[: len(self._last_update)] = self._last_update
+        self._last_update = stamps
         for name, arr in self._last_emitted.items():
             g = np.zeros(size, dtype=arr.dtype)
             g[: len(arr)] = arr
             self._last_emitted[name] = g
+
+    # --------------------------------------------------------------- TTL
+
+    def _maybe_sweep_ttl(self) -> None:
+        """Vectorized idle-state expiry: one masked scan per sweep
+        interval (ttl/4, floor 1 ms) instead of the reference's
+        per-key cleanup timers."""
+        if not self.ttl_ms:
+            return
+        now = self._clock()
+        if not self._sweep_gate.should_sweep(now):
+            return
+        n = len(self._last_update)
+        if n == 0:
+            return
+        stamps = self._last_update
+        expired = np.nonzero((stamps != -1)
+                             & (now - stamps > self.ttl_ms))[0]
+        if not len(expired):
+            return
+        if self._keys_hashed:
+            for kid in self.table.keys_of_slots(expired).tolist():
+                self._key_values.pop(int(kid), None)
+        self.table.free_slots(expired)
+        self._row_counts[expired] = 0
+        self._emitted_mask[expired] = False
+        stamps[expired] = -1
+        if self._dirty:
+            self._dirty.difference_update(expired.tolist())
 
     # ----------------------------------------------------------------- ingest
 
@@ -141,6 +187,9 @@ class GroupAggOperator(Operator):
         self._ensure_host_capacity(int(slots.max()) + 1)
         np.add.at(self._row_counts, slots,
                   1 if signs is None else signs.astype(np.int64))
+        if self.ttl_ms:
+            self._last_update[slots] = self._clock()
+            self._maybe_sweep_ttl()
         if self.emit_on_watermark_only:
             self._dirty.update(np.unique(slots).tolist())
             return []
@@ -149,10 +198,12 @@ class GroupAggOperator(Operator):
 
     def process_watermark(self, watermark, input_index=0):
         if not self.emit_on_watermark_only or not self._dirty:
+            self._maybe_sweep_ttl()
             return []
         slots = np.fromiter(self._dirty, dtype=np.int64)
         self._dirty.clear()
         out = self._emit_slots(slots)
+        self._maybe_sweep_ttl()
         return [out] if out is not None else []
 
     # --------------------------------------------------------------- emission
@@ -235,18 +286,24 @@ class GroupAggOperator(Operator):
         if self._dirty:
             dirty = np.isin(interesting,
                             np.fromiter(self._dirty, dtype=np.int64))
+        cl = {
+            "key_id": self.table.keys_of_slots(interesting),
+            "count": self._row_counts[interesting],
+            "emitted": self._emitted_mask[interesting],
+            "dirty": dirty,
+            "last": {n: a[interesting]
+                     for n, a in self._last_emitted.items()},
+        }
+        if self.ttl_ms:
+            # stamps travel logically so restore resumes each key's
+            # REMAINING lifetime (reference: TTL state restores with its
+            # original timestamps)
+            cl["ttl_last_update"] = self._last_update[interesting]
         return {
             "key_values": dict(self._key_values),
             "keys_hashed": self._keys_hashed,
             "max_ts": self._max_ts,
-            "changelog": {
-                "key_id": self.table.keys_of_slots(interesting),
-                "count": self._row_counts[interesting],
-                "emitted": self._emitted_mask[interesting],
-                "dirty": dirty,
-                "last": {n: a[interesting]
-                         for n, a in self._last_emitted.items()},
-            },
+            "changelog": cl,
         }
 
     def snapshot_state(self):
@@ -277,6 +334,7 @@ class GroupAggOperator(Operator):
         self._max_ts = state.get("max_ts", 0)
         self._row_counts = np.zeros(0, dtype=np.int64)
         self._emitted_mask = np.zeros(0, dtype=bool)
+        self._last_update = np.zeros(0, dtype=np.int64)
         self._last_emitted = {}
         cl = state.get("changelog")
         if cl is None and "row_counts" in state:
@@ -326,6 +384,11 @@ class GroupAggOperator(Operator):
         self._row_counts[slots] = counts
         self._emitted_mask[slots] = emitted
         self._dirty.update(int(s) for s in slots[dirty])
+        if self.ttl_ms and "ttl_last_update" in cl:
+            stamps = np.asarray(cl["ttl_last_update"], dtype=np.int64)
+            if key_group_filter is not None:
+                stamps = stamps[keep]
+            self._last_update[slots] = stamps
         for n, a in cl_last.items():
             arr = np.zeros(len(self._row_counts), dtype=a.dtype)
             arr[slots] = a

@@ -266,12 +266,13 @@ class IntervalJoinOperator(Operator):
             if v.dtype.kind in "iub":
                 # float64 only round-trips integers up to 2^53 — larger
                 # values (snowflake-style IDs) must go through object
-                # dtype or they'd be silently rounded
-                if v.dtype.itemsize >= 8 and len(v) and \
-                        np.abs(v.astype(np.int64)).max() > (1 << 53):
-                    v = v.astype(object)
-                else:
-                    v = v.astype(np.float64)
+                # dtype or they'd be silently rounded. Compare in the
+                # ORIGINAL dtype: casting uint64 >= 2^63 to int64 first
+                # would wrap and sneak past the guard.
+                big = (v.dtype.itemsize >= 8 and len(v)
+                       and (int(v.max()) > (1 << 53)
+                            or int(v.min()) < -(1 << 53)))
+                v = v.astype(object) if big else v.astype(np.float64)
             elif v.dtype.kind in "US":
                 # fixed-width numpy strings can't hold a None pad —
                 # carry strings as object so NULL is representable

@@ -261,7 +261,9 @@ class ProcessOperator(Operator):
 
     def open(self, ctx):
         self.timer_service = TimerService(clock=self._clock)
-        self.store = KeyedStateStore(self.state_capacity) if self.keyed else None
+        self.store = KeyedStateStore(
+            self.state_capacity,
+            clock=self._clock) if self.keyed else None
         self.fn.open(self._ctx())
 
     def _ctx(self) -> ProcessContext:
@@ -286,6 +288,11 @@ class ProcessOperator(Operator):
         if len(keys):
             self.fn.on_timer(keys, tss, ctx)
         self._drain_processing_time(ctx)
+        if self.store is not None:
+            # TTL sweep rides watermark advance (processing-time based;
+            # the watermark is just the cadence, like the reference's
+            # background cleanup riding other activity)
+            self.store.sweep_expired()
         return ctx.out
 
     #: processing-time timers must fire on an idle stream too — the

@@ -145,6 +145,22 @@ class _NamespaceRegistry:
             return None
         return np.concatenate(freed)
 
+    def _registry_remove_slots(self, slots: np.ndarray,
+                               namespaces: np.ndarray) -> None:
+        """Remove individual slots from their namespaces' chunk lists
+        (TTL expiry frees by slot, not by whole namespace)."""
+        for ns in np.unique(namespaces).tolist():
+            chunks = self._ns_slots.get(int(ns))
+            if not chunks:
+                continue
+            merged = np.concatenate(chunks) if len(chunks) > 1 \
+                else chunks[0]
+            kept = merged[~np.isin(merged, slots)]
+            if len(kept):
+                self._ns_slots[int(ns)] = [kept]
+            else:
+                self._ns_slots.pop(int(ns), None)
+
 
 class HostSlotIndex(_NamespaceRegistry):
     """Host half of the state table: (key, ns) -> slot mapping + metadata.
@@ -259,6 +275,20 @@ class HostSlotIndex(_NamespaceRegistry):
         self.slot_used[slots] = False
         self._free.extend(slots.tolist())
         return slots
+
+    def free_slots(self, slots: np.ndarray) -> None:
+        """Release individual slots (TTL expiry — by entry, not by
+        namespace)."""
+        slots = np.asarray(slots, dtype=np.int32)
+        if not len(slots):
+            return
+        self._registry_remove_slots(slots, self.slot_ns[slots])
+        index = self._index
+        sk, sn = self.slot_key, self.slot_ns
+        for s in slots.tolist():
+            index.pop((int(sk[s]), int(sn[s])), None)
+        self.slot_used[slots] = False
+        self._free.extend(slots.tolist())
 
     def used_slots(self) -> np.ndarray:
         return np.nonzero(self.slot_used)[0]
@@ -397,6 +427,24 @@ class NativeSlotIndex(_NamespaceRegistry):
             keys.ctypes.data_as(i64p), nss.ctypes.data_as(i64p),
             out.ctypes.data_as(i32p))
         return out[:n]
+
+    def free_slots(self, slots: np.ndarray) -> None:
+        """Release individual slots (TTL expiry) via the native erase."""
+        import ctypes
+
+        slots = np.ascontiguousarray(slots, dtype=np.int32)
+        if not len(slots):
+            return
+        self._registry_remove_slots(slots, self.slot_ns[slots])
+        keys = np.ascontiguousarray(self.slot_key[slots])
+        nss = np.ascontiguousarray(self.slot_ns[slots])
+        out = np.empty(len(slots), dtype=np.int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        self._lib.sm_erase(
+            self._h, len(slots),
+            keys.ctypes.data_as(i64p), nss.ctypes.data_as(i64p),
+            out.ctypes.data_as(i32p))
 
     def used_slots(self) -> np.ndarray:
         return np.nonzero(self.slot_used)[0]
@@ -609,6 +657,9 @@ class SlotTable:
         # touched since the last snapshot + the namespaces freed since)
         self._dirty = np.zeros(self.index.capacity, dtype=bool)
         self._freed_ns: List[int] = []
+        #: per-(key, ns) tombstones from TTL expiry (free_slots) — the
+        #: entry-granular analog of _freed_ns for incremental snapshots
+        self._freed_pairs: List[Tuple[np.ndarray, np.ndarray]] = []
         self._gather_bucket = 0
 
     # ------------------------------------------------------------- memory
@@ -1108,6 +1159,25 @@ class SlotTable:
             self._dirty[slots] = False
         return slots
 
+    def free_slots(self, slots: np.ndarray) -> None:
+        """Release individual entries (TTL expiry of idle keys).
+
+        Unlike free_namespaces (whole windows), this frees by (key, ns)
+        pair and records entry-granular tombstones so incremental
+        snapshot chains don't resurrect expired keys (reference:
+        TtlStateFactory + RocksDB compaction-filter cleanup)."""
+        slots = np.asarray(slots, dtype=np.int32)
+        if not len(slots):
+            return
+        self._freed_pairs.append((self.index.slot_key[slots].copy(),
+                                  self.index.slot_ns[slots].copy()))
+        self.index.free_slots(slots)
+        self._dirty[slots] = False
+        size = sticky_bucket(len(slots), self._reset_bucket)
+        self._reset_bucket = size
+        self.accs = self.agg._reset_jit(self.accs,
+                                        pad_i32(slots, size, fill=0))
+
     def free_namespaces(self, namespaces: List[int]) -> None:
         """Release all slots of the given namespaces (windows fully fired)."""
         slots = self.index.free_namespaces(namespaces)
@@ -1263,6 +1333,7 @@ class SlotTable:
         if reset_dirty:
             self._dirty[:] = False
             self._freed_ns.clear()
+            self._freed_pairs.clear()
             self.spill.clear_dirty()
         return out
 
@@ -1301,16 +1372,25 @@ class SlotTable:
                 np.asarray(entry[f"leaf_{i}"],
                            dtype=self.agg.leaves[i].dtype)])
                 for i in range(len(leaves))]
+        if self._freed_pairs:
+            tomb_k = np.concatenate([p[0] for p in self._freed_pairs])
+            tomb_n = np.concatenate([p[1] for p in self._freed_pairs])
+        else:
+            tomb_k = np.empty(0, dtype=np.int64)
+            tomb_n = np.empty(0, dtype=np.int64)
         out = {
             "__delta__": np.asarray(True),
             "key_id": key_ids,
             "namespace": namespaces,
             "key_group": assign_key_groups(key_ids, self.max_parallelism),
             "freed_namespaces": freed,
+            "tombstone_key_id": tomb_k,
+            "tombstone_namespace": tomb_n,
             **{f"leaf_{i}": leaves[i] for i in range(len(leaves))},
         }
         self._dirty[:] = False
         self._freed_ns.clear()
+        self._freed_pairs.clear()
         self.spill.clear_dirty()
         return out
 

@@ -418,12 +418,16 @@ class StreamTableEnvironment:
                     UpsertMaterializeOperator,
                 )
 
+                from flink_tpu.core.config import StateOptions
+
                 keys = list(sink_pk)
+                ttl = self.env.config.get(
+                    StateOptions.TABLE_EXEC_STATE_TTL) or None
                 t = Transformation(
                     name=f"upsert_materialize({stmt.table})",
                     kind="one_input",
-                    operator_factory=lambda keys=keys:
-                        UpsertMaterializeOperator(keys),
+                    operator_factory=lambda keys=keys, ttl=ttl:
+                        UpsertMaterializeOperator(keys, ttl_ms=ttl),
                     inputs=[stream.transformation],
                     keyed=True, key_field=keys[0])
                 stream = DataStream(self.env, t)

@@ -663,11 +663,20 @@ class Planner:
             # mode runs GROUP BY as a bounded aggregate, emitting finals)
             batch_final = self.env.config.get(
                 ExecutionModeOptions.RUNTIME_MODE) == "batch"
+            from flink_tpu.core.config import StateOptions
+
+            # TTL applies to STREAMING only — in batch mode emission is
+            # deferred to end-of-input, and a mid-ingest sweep would
+            # silently delete groups from the final result (the
+            # reference's table.exec.state.ttl is likewise stream-only)
+            ttl = None if batch_final else (self.env.config.get(
+                StateOptions.TABLE_EXEC_STATE_TTL) or None)
             t = Transformation(
                 name="sql_group_agg", kind="one_input",
                 operator_factory=lambda: GroupAggOperator(
                     multi, key_field, capacity=capacity,
-                    emit_on_watermark_only=batch_final),
+                    emit_on_watermark_only=batch_final,
+                    ttl_ms=ttl),
                 inputs=[keyed.transformation], keyed=True,
                 key_field=key_field)
             agged = DataStream(self.env, t)

@@ -51,10 +51,21 @@ class UpsertMaterializeOperator(Operator):
 
     name = "sink_upsert_materializer"
 
-    def __init__(self, upsert_keys: List[str]):
+    def __init__(self, upsert_keys: List[str],
+                 ttl_ms: Optional[int] = None, clock=None):
         if not upsert_keys:
             raise ValueError("upsert materializer requires upsert keys")
+        from flink_tpu.state.ttl import SweepGate, default_clock
+
         self.upsert_keys = list(upsert_keys)
+        #: table.exec.state.ttl: a sink key untouched this long drops its
+        #: image list (reference: SinkUpsertMaterializer registers a
+        #: state-retention cleanup timer per key)
+        self.ttl_ms = ttl_ms
+        self._clock = clock or default_clock
+        self._sweep_gate = SweepGate(ttl_ms) if ttl_ms else None
+        #: sink-key tuple -> last-touch processing time (TTL only)
+        self._access: Dict[Tuple, int] = {}
         #: sink-key tuple -> list of contributing row-value tuples
         self._rows: Dict[Tuple, List[Tuple]] = {}
         #: column order of the row-value tuples (fixed at first batch)
@@ -98,12 +109,15 @@ class UpsertMaterializeOperator(Operator):
         col_lists = [batch[c].tolist() for c in self._cols]
         rows = list(zip(*col_lists))
         key_idx = [self._cols.index(k) for k in self.upsert_keys]
+        now = self._clock() if self.ttl_ms else 0
         #: key -> image before this batch (None = absent), captured at
         #: the key's first touch so the batch collapses to one emission
         before: Dict[Tuple, Any] = {}
         for row, kind in zip(rows, kinds):
             k = tuple(row[i] for i in key_idx)
             lst = self._rows.get(k)
+            if self.ttl_ms:
+                self._access[k] = now
             if k not in before:
                 before[k] = lst[-1] if lst else None
             if int(kind) in (ROWKIND_INSERT, ROWKIND_UPDATE_AFTER):
@@ -154,6 +168,24 @@ class UpsertMaterializeOperator(Operator):
         ts = cols.pop("__ts__", None)
         return [RecordBatch.from_pydict(cols, timestamps=ts)]
 
+    # ------------------------------------------------------------------ TTL
+
+    def process_watermark(self, watermark, input_index=0):
+        self._maybe_sweep_ttl()
+        return []
+
+    def _maybe_sweep_ttl(self) -> None:
+        if not self.ttl_ms:
+            return
+        now = self._clock()
+        if not self._sweep_gate.should_sweep(now):
+            return
+        dead = [k for k, s in self._access.items()
+                if now - s > self.ttl_ms]
+        for k in dead:
+            del self._access[k]
+            self._rows.pop(k, None)
+
     # --------------------------------------------------------------- state
 
     def _key_ids(self, keys: List[Tuple]) -> np.ndarray:
@@ -164,11 +196,14 @@ class UpsertMaterializeOperator(Operator):
 
     def snapshot_state(self) -> Dict[str, Any]:
         keys = list(self._rows.keys())
-        return {
+        snap = {
             "um_cols": list(self._cols),
             "um_keys": keys,
             "um_rows": [self._rows[k] for k in keys],
         }
+        if self.ttl_ms:
+            snap["um_access"] = [self._access.get(k, 0) for k in keys]
+        return snap
 
     def restore_state(self, state: Dict[str, Any],
                       key_group_filter=None) -> None:
@@ -179,6 +214,7 @@ class UpsertMaterializeOperator(Operator):
                 for k in state.get("um_keys", [])]
         rows = [[tuple(r) for r in lst]
                 for lst in state.get("um_rows", [])]
+        access = list(state.get("um_access", []))
         if key_group_filter is not None and keys:
             from flink_tpu.state.keygroups import assign_key_groups
 
@@ -187,7 +223,10 @@ class UpsertMaterializeOperator(Operator):
             keep = [g in key_group_filter for g in groups]
             keys = [k for k, ok in zip(keys, keep) if ok]
             rows = [r for r, ok in zip(rows, keep) if ok]
+            if access:
+                access = [a for a, ok in zip(access, keep) if ok]
         self._rows = dict(zip(keys, rows))
+        self._access = dict(zip(keys, access)) if access else {}
 
     def close(self) -> List[RecordBatch]:
         return []

@@ -37,6 +37,12 @@ def test_dead_backend_falls_back_to_cpu(monkeypatch, tmp_path):
     monkeypatch.setenv("JAX_PLATFORMS", "tpu")  # no TPU in CI
     monkeypatch.setenv("FLINK_TPU_BACKEND_PROBE_TIMEOUT", "8")
     monkeypatch.setenv("FLINK_TPU_BACKEND_PROBE_CACHE_TTL", "0")
+    # keep the machine-wide marker file out of the real tempdir — a
+    # 'dead' verdict from this deliberately-short probe must not
+    # degrade a real job on the same box
+    monkeypatch.setattr(
+        platform, "_probe_cache_path",
+        lambda sel: str(tmp_path / f"probe_{sel}.json"))
     with pytest.warns(RuntimeWarning, match="falling back to CPU"):
         got = platform.ensure_live_backend()
     assert got == "cpu"
@@ -49,11 +55,14 @@ def test_dead_backend_falls_back_to_cpu(monkeypatch, tmp_path):
     assert platform.ensure_live_backend() == "cpu"
 
 
-def test_probe_verdict_cached_across_processes(monkeypatch):
+def test_probe_verdict_cached_across_processes(monkeypatch, tmp_path):
     """A fresh process (reset memo) reuses the marker-file verdict
     instead of re-paying the probe timeout."""
     monkeypatch.setenv("JAX_PLATFORMS", "tpu")
     monkeypatch.setenv("FLINK_TPU_BACKEND_PROBE_CACHE_TTL", "300")
+    monkeypatch.setattr(
+        platform, "_probe_cache_path",
+        lambda sel: str(tmp_path / f"probe_{sel}.json"))
     platform._write_probe_cache("tpu", "dead")
     import time
 
@@ -61,7 +70,6 @@ def test_probe_verdict_cached_across_processes(monkeypatch):
     got = platform.ensure_live_backend()
     assert got == "cpu"
     assert time.monotonic() - t0 < 2.0  # no subprocess probe ran
-    os.remove(platform._probe_cache_path("tpu"))
 
 
 def test_execute_calls_probe(monkeypatch):

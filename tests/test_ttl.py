@@ -1,0 +1,379 @@
+"""State TTL (StateTtlConfig analog).
+
+reference: flink-core/.../api/common/state/StateTtlConfig.java (builder,
+UpdateType, StateVisibility) and flink-runtime/.../runtime/state/ttl/
+TtlStateFactory.java (wrapping factory; expired reads filtered; cleanup
+strategies). Here TTL is a last-access column per state with a
+vectorized sweep; idle GROUP BY accumulators and upsert-materializer
+keys are dropped via table.exec.state.ttl."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.state.keyed_state import (
+    KeyedStateStore,
+    ListStateDescriptor,
+    MapStateDescriptor,
+    ReducingStateDescriptor,
+    ValueStateDescriptor,
+)
+from flink_tpu.state.ttl import (
+    NEVER_RETURN_EXPIRED,
+    ON_CREATE_AND_WRITE,
+    ON_READ_AND_WRITE,
+    RETURN_EXPIRED_IF_NOT_CLEANED_UP,
+    StateTtlConfig,
+)
+
+
+class Clock:
+    def __init__(self, t=0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _store(clock):
+    return KeyedStateStore(capacity=1 << 10, clock=clock)
+
+
+K = np.asarray([1, 2, 3], dtype=np.int64)
+
+
+class TestConfig:
+    def test_builder_mirrors_reference(self):
+        cfg = (StateTtlConfig.new_builder(5000)
+               .update_ttl_on_read_and_write()
+               .return_expired_if_not_cleaned_up()
+               .build())
+        assert cfg.ttl_ms == 5000
+        assert cfg.update_type == ON_READ_AND_WRITE
+        assert cfg.visibility == RETURN_EXPIRED_IF_NOT_CLEANED_UP
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            StateTtlConfig(0)
+        with pytest.raises(ValueError):
+            StateTtlConfig(10, update_type="sometimes")
+        with pytest.raises(ValueError):
+            StateTtlConfig(10, visibility="maybe")
+
+
+class TestValueState:
+    def test_on_create_and_write_expires(self):
+        clock = Clock()
+        st = _store(clock).get_state(
+            ValueStateDescriptor("v", ttl=StateTtlConfig(100)))
+        st.put(K, [10.0, 20.0, 30.0])
+        clock.t = 99
+        assert st.get(K).tolist() == [10.0, 20.0, 30.0]
+        clock.t = 101
+        assert st.get(K).tolist() == [0.0, 0.0, 0.0]  # NeverReturnExpired
+
+    def test_read_does_not_extend_by_default(self):
+        clock = Clock()
+        st = _store(clock).get_state(
+            ValueStateDescriptor("v", ttl=StateTtlConfig(100)))
+        st.put(K, [1.0, 1.0, 1.0])
+        clock.t = 90
+        st.get(K)  # OnCreateAndWrite: a read must NOT refresh
+        clock.t = 150
+        assert st.get(K).tolist() == [0.0, 0.0, 0.0]
+
+    def test_on_read_and_write_extends(self):
+        clock = Clock()
+        cfg = StateTtlConfig(100, update_type=ON_READ_AND_WRITE)
+        st = _store(clock).get_state(ValueStateDescriptor("v", ttl=cfg))
+        st.put(K, [1.0, 2.0, 3.0])
+        clock.t = 90
+        st.get(K)  # refreshes lifetime to t=90
+        clock.t = 150  # would be expired without the read refresh
+        assert st.get(K).tolist() == [1.0, 2.0, 3.0]
+
+    def test_write_refreshes(self):
+        clock = Clock()
+        st = _store(clock).get_state(
+            ValueStateDescriptor("v", ttl=StateTtlConfig(100)))
+        st.put(K, [1.0, 1.0, 1.0])
+        clock.t = 90
+        st.put(K[:1], [2.0])
+        clock.t = 150
+        got = st.get(K)
+        assert got[0] == 2.0 and got[1] == 0.0
+
+    def test_return_expired_if_not_cleaned_up(self):
+        clock = Clock()
+        cfg = StateTtlConfig(
+            100, visibility=RETURN_EXPIRED_IF_NOT_CLEANED_UP)
+        store = _store(clock)
+        st = store.get_state(ValueStateDescriptor("v", ttl=cfg))
+        st.put(K, [7.0, 7.0, 7.0])
+        clock.t = 200
+        assert st.get(K).tolist() == [7.0, 7.0, 7.0]  # not swept yet
+        store.sweep_expired()
+        assert st.get(K).tolist() == [0.0, 0.0, 0.0]
+
+    def test_expired_read_does_not_resurrect(self):
+        """Reading an expired entry under ON_READ_AND_WRITE must not
+        refresh its stamp back to life."""
+        clock = Clock()
+        cfg = StateTtlConfig(100, update_type=ON_READ_AND_WRITE)
+        st = _store(clock).get_state(ValueStateDescriptor("v", ttl=cfg))
+        st.put(K, [5.0, 5.0, 5.0])
+        clock.t = 150
+        assert st.get(K).tolist() == [0.0, 0.0, 0.0]
+        clock.t = 160
+        assert st.get(K).tolist() == [0.0, 0.0, 0.0]
+
+    def test_sweep_clears_values(self):
+        clock = Clock()
+        store = _store(clock)
+        st = store.get_state(
+            ValueStateDescriptor("v", ttl=StateTtlConfig(100)))
+        st.put(K, [9.0, 9.0, 9.0])
+        clock.t = 101
+        assert store.sweep_expired() == 3
+        clock.t = 0  # even rewinding the clock: values are gone
+        assert st.get(K).tolist() == [0.0, 0.0, 0.0]
+
+    def test_restore_honors_remaining_ttl(self):
+        clock = Clock()
+        store = _store(clock)
+        st = store.get_state(
+            ValueStateDescriptor("v", ttl=StateTtlConfig(100)))
+        st.put(K, [4.0, 4.0, 4.0])
+        clock.t = 60
+        snap = store.snapshot()
+
+        clock2 = Clock(60)
+        store2 = _store(clock2)
+        store2.restore(snap)
+        st2 = store2.get_state(
+            ValueStateDescriptor("v", ttl=StateTtlConfig(100)))
+        assert st2.get(K).tolist() == [4.0, 4.0, 4.0]
+        clock2.t = 101  # written at 0 -> expires at 100, not 160
+        assert st2.get(K).tolist() == [0.0, 0.0, 0.0]
+
+
+class TestReducingState:
+    def test_fold_restarts_after_expiry(self):
+        clock = Clock()
+        st = _store(clock).get_state(ReducingStateDescriptor(
+            "sum", reduce=np.add, ttl=StateTtlConfig(100)))
+        st.add(K, [1.0, 1.0, 1.0])
+        clock.t = 50
+        st.add(K, [2.0, 2.0, 2.0])
+        assert st.get(K).tolist() == [3.0, 3.0, 3.0]
+        clock.t = 200  # expired (last write 50 + 100 < 200)
+        st.add(K, [5.0, 5.0, 5.0])
+        assert st.get(K).tolist() == [5.0, 5.0, 5.0]  # not 8.0
+
+
+class TestHostStates:
+    def test_list_state_ttl_and_snapshot_shrink(self):
+        clock = Clock()
+        store = _store(clock)
+        st = store.get_state(
+            ListStateDescriptor("l", ttl=StateTtlConfig(100)))
+        st.add(K, [1.0, 2.0, 3.0])
+        clock.t = 150
+        assert st.get(1) == []  # hidden
+        assert store.sweep_expired() == 3
+        assert st.snapshot()["lists"] == {}  # snapshot SHRANK
+
+    def test_list_append_after_expiry_starts_fresh(self):
+        clock = Clock()
+        st = _store(clock).get_state(
+            ListStateDescriptor("l", ttl=StateTtlConfig(100)))
+        st.add(K[:1], [1.0])
+        clock.t = 200
+        st.add(K[:1], [9.0])
+        assert st.get(1) == [9.0]
+
+    def test_list_keys_agree_with_get_visibility(self):
+        """keys() must not list expired-but-unswept phantom keys whose
+        get() already returns []."""
+        clock = Clock()
+        st = _store(clock).get_state(
+            ListStateDescriptor("l", ttl=StateTtlConfig(100)))
+        st.add(K, [1.0, 2.0, 3.0])
+        assert sorted(st.keys()) == [1, 2, 3]
+        clock.t = 150
+        assert st.keys() == [] and st.get(1) == []
+
+    def test_map_state_ttl(self):
+        clock = Clock()
+        store = _store(clock)
+        st = store.get_state(
+            MapStateDescriptor("m", ttl=StateTtlConfig(100)))
+        st.put(1, "a", 10)
+        clock.t = 90
+        st.put(1, "b", 20)  # write refreshes the KEY's lifetime
+        clock.t = 180
+        assert st.get(1, "a") == 10
+        clock.t = 300
+        assert st.get(1, "a") is None
+        store.sweep_expired()
+        assert st.snapshot()["maps"] == {}
+
+
+class TestGroupAggTtl:
+    def _op(self, clock, ttl=1000):
+        from flink_tpu.runtime.group_agg import GroupAggOperator
+        from flink_tpu.windowing.aggregates import CountAggregate
+
+        class Ctx:
+            max_parallelism = 128
+            memory_manager = None
+
+        op = GroupAggOperator(CountAggregate(), "k", capacity=1 << 12,
+                              ttl_ms=ttl, clock=clock)
+        op.open(Ctx())
+        return op
+
+    def _batch(self, keys, ts=0):
+        from flink_tpu.core.records import RecordBatch
+        from flink_tpu.state.keygroups import hash_keys_to_i64
+
+        arr = np.asarray(keys, dtype=np.int64)
+        b = RecordBatch.from_pydict(
+            {"k": arr},
+            timestamps=np.full(len(arr), ts, dtype=np.int64))
+        return b.with_column("__key_id__", hash_keys_to_i64(arr))
+
+    def test_idle_keys_dropped_and_snapshot_shrinks(self):
+        clock = Clock()
+        op = self._op(clock, ttl=1000)
+        op.process_batch(self._batch([1, 2, 3]))
+        assert op.table.num_used == 3
+        clock.t = 500
+        op.process_batch(self._batch([1]))  # key 1 refreshed
+        clock.t = 1400  # keys 2,3 idle > 1000
+        op.process_watermark(10)
+        assert op.table.num_used == 1
+        snap = op.snapshot_state()
+        assert len(snap["table"]["key_id"]) == 1
+        assert len(snap["changelog"]["key_id"]) == 1
+
+    def test_rearrival_after_expiry_emits_insert(self):
+        from flink_tpu.core.records import (
+            ROWKIND_FIELD,
+            ROWKIND_INSERT,
+        )
+
+        clock = Clock()
+        op = self._op(clock, ttl=1000)
+        first = op.process_batch(self._batch([7]))
+        assert first[0][ROWKIND_FIELD].tolist() == [ROWKIND_INSERT]
+        clock.t = 2000
+        op.process_watermark(10)  # sweeps key 7
+        out = op.process_batch(self._batch([7]))
+        kinds = out[0][ROWKIND_FIELD].tolist()
+        # fresh INSERT with a count restarted at 1, not an update of
+        # the expired accumulator (reference idle-state semantics)
+        assert kinds == [ROWKIND_INSERT]
+        assert float(out[0]["count"][0]) == 1.0
+
+    def test_restore_honors_remaining_ttl(self):
+        clock = Clock()
+        op = self._op(clock, ttl=1000)
+        op.process_batch(self._batch([1, 2]))
+        clock.t = 600
+        snap = op.snapshot_state()
+
+        clock2 = Clock(600)
+        op2 = self._op(clock2, ttl=1000)
+        op2.restore_state(snap)
+        assert op2.table.num_used == 2
+        clock2.t = 1100  # written at 0 -> expired at 1000
+        op2.process_watermark(10)
+        assert op2.table.num_used == 0
+
+    def test_incremental_chain_does_not_resurrect_expired(self):
+        from flink_tpu.checkpoint.storage import apply_table_delta
+
+        clock = Clock()
+        op = self._op(clock, ttl=1000)
+        op.process_batch(self._batch([1, 2, 3]))
+        base = op.snapshot_state()["table"]  # full base
+        clock.t = 500
+        op.process_batch(self._batch([1]))  # refresh key 1
+        clock.t = 1400
+        op.process_watermark(10)  # expire 2, 3 (1 refreshed at 500)
+        delta = op.snapshot_state_delta()["table"]
+        assert len(delta["tombstone_key_id"]) == 2
+        merged = apply_table_delta(base, delta)
+        live = op.table.keys_of_slots(op.table.index.used_slots())
+        assert set(merged["key_id"].tolist()) == set(live.tolist())
+        assert len(merged["key_id"]) == 1
+
+
+class TestSqlWiring:
+    def test_table_exec_state_ttl_reaches_operators(self, monkeypatch):
+        import flink_tpu.table.planner as planner_mod
+        from flink_tpu import Configuration, StreamExecutionEnvironment
+        from flink_tpu.core.records import RecordBatch
+        from flink_tpu.table.environment import StreamTableEnvironment
+
+        created = []
+        real = planner_mod.GroupAggOperator
+
+        def spy(*a, **kw):
+            op = real(*a, **kw)
+            created.append(op)
+            return op
+
+        monkeypatch.setattr(planner_mod, "GroupAggOperator", spy)
+        env = StreamExecutionEnvironment(Configuration({
+            "table.exec.state.ttl": 60_000,
+            "execution.micro-batch.size": 1024}))
+        tenv = StreamTableEnvironment(env)
+        ts = np.asarray([1000, 2000], dtype=np.int64)
+        from flink_tpu.connectors.kafka import FakeBroker
+
+        broker = FakeBroker.get("default")
+        broker.create_topic("ttl_t", 1)
+        broker.append("ttl_t", 0, RecordBatch.from_pydict(
+            {"k": np.asarray([1, 1], dtype=np.int64), "ts": ts},
+            timestamps=ts))
+        tenv.execute_sql(
+            "CREATE TABLE ttl_t (k BIGINT, ts BIGINT, "
+            "WATERMARK FOR ts AS ts) "
+            "WITH ('connector'='kafka', 'topic'='ttl_t')")
+        rows = tenv.execute_sql(
+            "SELECT k, COUNT(*) AS c FROM ttl_t GROUP BY k").collect()
+        assert created and created[0].ttl_ms == 60_000
+        assert any(r["c"] == 2 for r in rows)
+
+
+class TestUpsertMaterializerTtl:
+    def test_idle_sink_keys_dropped(self):
+        from flink_tpu.core.records import (
+            ROWKIND_FIELD,
+            ROWKIND_INSERT,
+            RecordBatch,
+        )
+        from flink_tpu.table.upsert_materializer import (
+            UpsertMaterializeOperator,
+        )
+
+        clock = Clock()
+
+        class Ctx:
+            max_parallelism = 128
+
+        op = UpsertMaterializeOperator(["k"], ttl_ms=1000, clock=clock)
+        op.open(Ctx())
+        op.process_batch(RecordBatch.from_pydict({
+            "k": np.asarray([1, 2]), "v": np.asarray([10.0, 20.0]),
+            ROWKIND_FIELD: np.asarray(
+                [ROWKIND_INSERT, ROWKIND_INSERT], dtype=np.int8)}))
+        clock.t = 500
+        op.process_batch(RecordBatch.from_pydict({
+            "k": np.asarray([1]), "v": np.asarray([11.0]),
+            ROWKIND_FIELD: np.asarray([ROWKIND_INSERT], dtype=np.int8)}))
+        clock.t = 1400  # key 2 idle 1400 > 1000; key 1 idle 900
+        op.process_watermark(10)
+        assert set(op._rows) == {(1,)}
+        assert len(op.snapshot_state()["um_keys"]) == 1
