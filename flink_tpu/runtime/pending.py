@@ -48,5 +48,14 @@ class PendingFire:
 
     def harvest(self) -> Optional[object]:
         """Materialize host values and build the result (blocks only on
-        buffers whose async copy has not yet landed)."""
-        return self.build([np.asarray(a) for a in self.arrays])
+        buffers whose async copy has not yet landed).
+
+        All buffers are fetched in ONE ``jax.device_get`` call: on the
+        tunneled link each device->host read pays the full RTT, but
+        concurrent reads pipeline (measured: 8 serial fetches 526 ms, one
+        batched device_get 68 ms), so a fire with k output columns costs
+        one RTT instead of k."""
+        import jax
+
+        host = jax.device_get(self.arrays)
+        return self.build([np.asarray(a) for a in host])

@@ -220,8 +220,14 @@ class PaneTable:
 
     # ---------------------------------------------------------------- ingest
 
+    #: upsert()/upsert_valued() take a precomputed ``slice_plan``
+    #: (uniq, inverse) from WindowAssigner.slice_plan — saves a full
+    #: sort of the batch (see SliceSharedWindower.process_batch)
+    accepts_slice_plan = True
+
     def _flat_indices(self, key_ids: np.ndarray,
-                      slice_ends: np.ndarray) -> np.ndarray:
+                      slice_ends: np.ndarray,
+                      slice_plan=None) -> np.ndarray:
         """[n] fused (ring row, key col) -> flat i32 scatter indices — one
         index array over the host->device link instead of two (fill 0 =
         reserved identity row 0 / col 0)."""
@@ -232,7 +238,8 @@ class PaneTable:
         # slice -> ring row: rows for the (few) unique slices via the host
         # dict, broadcast back per record with the unique-inverse (no
         # Python-level per-record loop)
-        uniq, inv = np.unique(slice_ends, return_inverse=True)
+        uniq, inv = slice_plan if slice_plan is not None else \
+            np.unique(slice_ends, return_inverse=True)
         for se in uniq.tolist():
             if int(se) not in self.slice_row:
                 self._alloc_row(int(se))
@@ -249,8 +256,8 @@ class PaneTable:
         return (rows * self.capacity + cols).astype(np.int32)
 
     def upsert(self, key_ids: np.ndarray, slice_ends: np.ndarray,
-               values: Tuple[np.ndarray, ...]) -> None:
-        flat = self._flat_indices(key_ids, slice_ends)
+               values: Tuple[np.ndarray, ...], slice_plan=None) -> None:
+        flat = self._flat_indices(key_ids, slice_ends, slice_plan)
         size = sticky_bucket(len(flat), self._scatter_bucket)
         self._scatter_bucket = size
         self.accs = self._scatter2d(
@@ -259,12 +266,13 @@ class PaneTable:
             self.agg.pad_input_values(values, size))
 
     def upsert_valued(self, key_ids: np.ndarray, slice_ends: np.ndarray,
-                      values: Tuple[np.ndarray, ...]) -> None:
+                      values: Tuple[np.ndarray, ...],
+                      slice_plan=None) -> None:
         """Fold locally pre-aggregated partials (every leaf valued; see
         flink_tpu.runtime.local_agg)."""
         from flink_tpu.ops.segment_ops import pad_values
 
-        flat = self._flat_indices(key_ids, slice_ends)
+        flat = self._flat_indices(key_ids, slice_ends, slice_plan)
         size = sticky_bucket(len(flat), self._scatter_bucket)
         self._scatter_bucket = size
         self.accs = self._scatter2d_valued(
@@ -297,17 +305,23 @@ class PaneTable:
             return np.empty(0, dtype=np.int64), {}
         used = self.used_cols
         out = self._fire_rows(self.accs, jnp.asarray(rows), used)
+        # one batched device_get: each independent read costs a full link
+        # RTT, batched reads pipeline into ~one
         if self.fire_projector is None:
             cols, valid = out
-            sel = np.asarray(valid)[:used]
+            names = list(cols)
+            host = jax.device_get([valid] + [cols[n] for n in names])
+            sel = host[0][:used]
             keys = self.index.slot_key[:used][sel]
-            return keys, {name: np.asarray(c)[:used][sel]
-                          for name, c in cols.items()}
+            return keys, {name: c[:used][sel]
+                          for name, c in zip(names, host[1:])}
         pidx, pcols, pvalid = out
-        sel = np.asarray(pvalid)
-        keys = self.index.slot_key[np.asarray(pidx)[sel]]
-        return keys, {name: np.asarray(c)[sel]
-                      for name, c in pcols.items()}
+        names = list(pcols)
+        host = jax.device_get([pidx, pvalid] + [pcols[n] for n in names])
+        pidx_h, sel = host[0], host[1]
+        keys = self.index.slot_key[pidx_h[sel]]
+        return keys, {name: c[sel]
+                      for name, c in zip(names, host[2:])}
 
     def fire_window_async(self, slice_ends: List[int]):
         """Async-dispatch variant of fire_window: returns a PendingFire
@@ -374,10 +388,20 @@ class PaneTable:
         every live slice), so key churn would grow the table forever —
         when most allocated columns belong to departed keys, rebuild the
         table from its own logical snapshot (one state round-trip,
-        amortized rare; the slot layout's free_namespaces analog)."""
+        amortized rare; the slot layout's free_namespaces analog).
+
+        The aliveness probe reads a device reduction (one link RTT), so it
+        only runs when the key high-water mark has grown >=1.5x since the
+        last probe: compaction exists to reclaim columns as the table
+        GROWS toward capacity — a stable keyset (hw flat) needs neither
+        the probe nor the rebuild, and previously paid one blocking fetch
+        per watermark advance for it."""
         hw = self._high_water
         if hw < self._COMPACT_MIN_KEYS:
             return
+        if hw < getattr(self, "_compact_probed_hw", 0) * 3 // 2:
+            return
+        self._compact_probed_hw = hw
         live = sorted(self.slice_row)
         if live:
             rows = np.asarray([self.slice_row[se] for se in live],

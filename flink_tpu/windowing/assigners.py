@@ -55,6 +55,31 @@ class WindowAssigner:
         start = ts - np.remainder(ts - self.offset, w)
         return start + w
 
+    def slice_plan(self, slice_ends: np.ndarray):
+        """(unique_ends, inverse) without sorting the batch.
+
+        ``np.unique(return_inverse=True)`` sorts all n rows (~50 ms per
+        1M-row batch) to find what is typically a handful of distinct
+        slice ends. Slice ends are multiples of ``slice_width`` in a
+        narrow range per batch, so bucketing by ``(se - min) // width``
+        gets the same answer in O(n) passes. Falls back to ``np.unique``
+        for pathological spreads (wildly out-of-order timestamps)."""
+        se = np.asarray(slice_ends, dtype=np.int64)
+        base = int(se.min())
+        w = self.slice_width
+        span = (int(se.max()) - base) // w + 1
+        if span > (1 << 16):
+            uniq, inv = np.unique(se, return_inverse=True)
+            return uniq, inv
+        sidx = (se - base) // w
+        counts = np.bincount(sidx, minlength=span)
+        present = np.nonzero(counts)[0]
+        uniq = base + present * w
+        if len(present) == span:
+            return uniq, sidx
+        remap = np.cumsum(counts > 0) - 1
+        return uniq, remap[sidx]
+
     def window_ends_for_slice(self, slice_end: int) -> List[int]:
         """All window ends this slice contributes to (ascending)."""
         first = _align_up(slice_end, self.slide, self.offset)

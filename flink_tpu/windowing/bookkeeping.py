@@ -51,6 +51,15 @@ class SliceBookkeeper:
         Returns a boolean mask if any record must be dropped, else None."""
         if self.watermark <= _NEG_INF // 2:
             return None
+        # scalar early-out: the OLDEST slice in the batch decides whether a
+        # full vectorized pass is needed at all — for in-order streams the
+        # oldest slice is always live, so the common case costs one .min()
+        # instead of three passes over the batch
+        oldest = int(np.asarray(slice_ends).min())
+        oldest_last = int(self.assigner.last_window_ends(
+            np.asarray([oldest], dtype=np.int64))[0])
+        if oldest_last - 1 + self.allowed_lateness > self.watermark:
+            return None
         last_ends = self.assigner.last_window_ends(slice_ends)
         live = last_ends - 1 + self.allowed_lateness > self.watermark
         dropped = len(live) - int(live.sum())
@@ -59,14 +68,19 @@ class SliceBookkeeper:
         self.late_records_dropped += dropped
         return live
 
-    def register_slices(self, slice_ends: np.ndarray) -> None:
+    def register_slices(self, slice_ends: np.ndarray,
+                        uniq: Optional[np.ndarray] = None) -> None:
         """Track new slices and (re-)schedule their windows.
 
         A window is scheduled iff it can still produce output:
         w - 1 + lateness > watermark. For an already-fired window inside the
-        lateness allowance this is a late re-firing."""
+        lateness allowance this is a late re-firing. ``uniq`` lets the
+        caller supply the already-computed distinct slice ends (see
+        WindowAssigner.slice_plan) instead of re-sorting the batch."""
         lateness = self.allowed_lateness
-        for se in np.unique(slice_ends).tolist():
+        if uniq is None:
+            uniq = np.unique(slice_ends)
+        for se in uniq.tolist():
             ends = None
             if se not in self._slice_last_window:
                 ends = self.assigner.window_ends_for_slice(se)

@@ -81,16 +81,21 @@ class SliceSharedWindower:
             batch = batch.filter(live)
             if len(batch) == 0:
                 return
-        self.book.register_slices(slice_ends)
+        # one O(n) pass finds the distinct slice ends + inverse; shared by
+        # the bookkeeper AND the state table so neither re-sorts the batch
+        plan = self.assigner.slice_plan(slice_ends)
+        self.book.register_slices(slice_ends, uniq=plan[0])
+        accepts_plan = getattr(self.table, "accepts_slice_plan", False)
+        kw = {"slice_plan": plan} if accepts_plan else {}
         if is_partial_batch(batch):
             # locally pre-aggregated rows (two-phase agg): fold explicit
             # per-leaf partials instead of re-mapping raw inputs
             self.table.upsert_valued(
                 batch.key_ids, slice_ends,
-                partial_leaf_values(batch, self.agg))
+                partial_leaf_values(batch, self.agg), **kw)
         else:
             self.table.upsert(batch.key_ids, slice_ends,
-                              self.agg.map_input(batch))
+                              self.agg.map_input(batch), **kw)
 
     # ----------------------------------------------------------------- fire
 
