@@ -84,16 +84,21 @@ def run(total_records: int, num_auctions: int = 100_000,
     from flink_tpu.connectors.sinks import CollectSink
 
     if batch_size is None:
-        batch_size = int(os.environ.get("BENCH_BATCH_SIZE", 1 << 17))
+        # 1M-row micro-batches amortize the tunneled link's ~64 ms
+        # per-round-trip latency (measured 2026-07-30: 131k-row batches
+        # cap at ~0.9M ev/s, 1M-row at ~4M ev/s on the same chip)
+        batch_size = int(os.environ.get("BENCH_BATCH_SIZE", 1 << 20))
     env = StreamExecutionEnvironment(Configuration({
         "execution.micro-batch.size": batch_size,
-        "state.slot-table.capacity": 1 << 20,
+        # headroom above the live (key x slice) footprint so ring/column
+        # growth never interrupts the measured run
+        "state.slot-table.capacity": 1 << 22,
         "state.window-layout": layout,
         # dispatch pipelining depth — the lever for a high-RTT device
         # link (the tunneled TPU): deeper hides the RTT per batch,
         # shallower keeps fire kernels from queueing behind scatters
         "execution.pipeline.max-dispatch-batches": int(
-            os.environ.get("BENCH_DISPATCH_AHEAD", 4)),
+            os.environ.get("BENCH_DISPATCH_AHEAD", 8)),
     }))
     sink = CollectSink()
     # 100k events/s of event time -> a 2 s slide covers ~200k events, a 10 s

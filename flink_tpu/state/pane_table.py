@@ -255,6 +255,56 @@ class PaneTable:
                 "state.slot-table.capacity or the window's slice count")
         return (rows * self.capacity + cols).astype(np.int32)
 
+    def ingest_indices(self, key_ids: np.ndarray, timestamps: np.ndarray,
+                       offset: int, width: int):
+        """Fused index build: ONE native sweep (sm_pane_ingest) replaces
+        assign_slice_ends + slice_plan + lookup_or_insert + the flat
+        fuse — the five memory-bound numpy passes that dominated ingest
+        on large micro-batches. Returns (flat, uniq_ends, sinv) or None
+        when the native library is absent or the batch has pathologically
+        many distinct slice ends (callers fall back to the numpy path)."""
+        ingest = getattr(self.index, "pane_ingest", None)
+        if ingest is None:
+            return None
+        res = ingest(key_ids, timestamps, offset, width)
+        if res is None:
+            return None
+        cols, sinv, uniq, max_col = res
+        self._high_water = max(self._high_water, max_col + 1)
+        rowmap = np.empty(len(uniq), dtype=np.int64)
+        for j, se in enumerate(uniq.tolist()):
+            se = int(se)
+            if se not in self.slice_row:
+                self._alloc_row(se)
+            self._dirty_slices.add(se)
+            rowmap[j] = self.slice_row[se]
+        if self.R * self.capacity > np.iinfo(np.int32).max:
+            raise RuntimeError(
+                f"pane table exceeds int32 flat-index range "
+                f"(ring={self.R} x capacity={self.capacity}); lower "
+                "state.slot-table.capacity or the window's slice count")
+        flat = self.index.flat_fuse(cols, sinv, rowmap, self.capacity)
+        return flat, uniq, sinv
+
+    def scatter_flat(self, flat: np.ndarray,
+                     values: Tuple[np.ndarray, ...],
+                     valued: bool = False) -> None:
+        """Scatter with a prebuilt flat index (see ingest_indices)."""
+        size = sticky_bucket(len(flat), self._scatter_bucket)
+        self._scatter_bucket = size
+        if valued:
+            from flink_tpu.ops.segment_ops import pad_values
+
+            self.accs = self._scatter2d_valued(
+                self.accs, pad_i32(flat, size, fill=0),
+                tuple(pad_values(np.asarray(v, dtype=l.dtype), size,
+                                 l.identity)
+                      for v, l in zip(values, self.agg.leaves)))
+        else:
+            self.accs = self._scatter2d(
+                self.accs, pad_i32(flat, size, fill=0),
+                self.agg.pad_input_values(values, size))
+
     def upsert(self, key_ids: np.ndarray, slice_ends: np.ndarray,
                values: Tuple[np.ndarray, ...], slice_plan=None) -> None:
         flat = self._flat_indices(key_ids, slice_ends, slice_plan)

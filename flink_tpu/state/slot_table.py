@@ -394,6 +394,68 @@ class NativeSlotIndex(_NamespaceRegistry):
                 reg.setdefault(ns, []).append(chunk)
         return out
 
+    def pane_ingest(self, key_ids: np.ndarray, timestamps: np.ndarray,
+                    offset: int, width: int, max_uniq: int = 4096):
+        """Fused pane-table ingest (native/slotmap.cpp sm_pane_ingest):
+        one native sweep computes slice ends, the key -> column probe
+        (namespace 0) and the distinct-slice-end plan that previously
+        took five separate numpy passes. Returns (cols, sinv, uniq,
+        max_col) or None when the batch has pathologically many distinct
+        slice ends (caller falls back to the unfused path)."""
+        import ctypes
+
+        keys = np.ascontiguousarray(key_ids, dtype=np.int64)
+        ts = np.ascontiguousarray(timestamps, dtype=np.int64)
+        n = len(keys)
+        cols = np.empty(n, dtype=np.int32)
+        is_new = np.empty(n, dtype=np.uint8)
+        sinv = np.empty(n, dtype=np.int32)
+        uniq = np.empty(max_uniq, dtype=np.int64)
+        out_k = ctypes.c_int64()
+        out_max_col = ctypes.c_int64()
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        old_cap = self.capacity
+        rc = self._lib.sm_pane_ingest(
+            self._h, n, keys.ctypes.data_as(i64p), ts.ctypes.data_as(i64p),
+            int(offset), int(width), int(max_uniq),
+            cols.ctypes.data_as(i32p), is_new.ctypes.data_as(u8p),
+            sinv.ctypes.data_as(i32p), uniq.ctypes.data_as(i64p),
+            ctypes.byref(out_k), ctypes.byref(out_max_col))
+        if rc == -2:
+            return None
+        if rc < 0:
+            raise SlotTableFullError(
+                f"slot table full (capacity={self.capacity}) and not "
+                f"growable; {self.full_hint}")
+        if rc > 0:
+            self._wrap_views()
+            if self.on_grow is not None:
+                self.on_grow(old_cap, self.capacity)
+        new_mask = is_new.view(bool)
+        if new_mask.any():
+            # all pane-table entries live in namespace 0
+            self._ns_slots.setdefault(0, []).append(cols[new_mask])
+        return cols, sinv, uniq[:out_k.value], int(out_max_col.value)
+
+    def flat_fuse(self, cols: np.ndarray, sinv: np.ndarray,
+                  rowmap: np.ndarray, capacity: int) -> np.ndarray:
+        """flat[i] = rowmap[sinv[i]] * capacity + cols[i] as int32, in one
+        native pass (sm_flat_fuse)."""
+        import ctypes
+
+        n = len(cols)
+        out = np.empty(n, dtype=np.int32)
+        rowmap = np.ascontiguousarray(rowmap, dtype=np.int64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        self._lib.sm_flat_fuse(
+            n, cols.ctypes.data_as(i32p), sinv.ctypes.data_as(i32p),
+            rowmap.ctypes.data_as(i64p), int(capacity),
+            out.ctypes.data_as(i32p))
+        return out
+
     def lookup(self, key_ids: np.ndarray,
                namespaces: np.ndarray) -> np.ndarray:
         """Read-only probe via the native table: -1 where absent."""

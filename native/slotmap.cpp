@@ -276,6 +276,127 @@ int64_t sm_erase(void* h, int64_t n, const int64_t* keys, const int64_t* nss,
   return erased;
 }
 
+// Fused pane-table ingest, pass A — ONE sweep over the micro-batch doing
+// what previously took five numpy passes plus a separate native probe:
+//   - slice end per record from its timestamp (aligned windows, floor-mod
+//     so pre-epoch timestamps match numpy's np.remainder semantics):
+//       se = ts - floormod(ts - offset, width) + width
+//   - key -> dense column via the same probe as sm_lookup_or_insert
+//     (namespace fixed at 0: a pane-table column is keyed by key only)
+//   - distinct slice ends tracked first-seen through a small open hash
+// Outputs: out_cols[n] (i32 column ids), out_is_new[n], out_sinv[n]
+// (i32 index into out_uniq), out_uniq[maxu] (i64 distinct slice ends,
+// first-seen order), *out_k (distinct count), *out_max_col.
+// Returns grows (>=0), -1 table full, -2 more than maxu distinct slice
+// ends (caller falls back to the unfused path).
+int32_t sm_pane_ingest(void* h, int64_t n, const int64_t* keys,
+                       const int64_t* ts, int64_t offset, int64_t width,
+                       int64_t maxu, int32_t* out_cols, uint8_t* out_is_new,
+                       int32_t* out_sinv, int64_t* out_uniq, int64_t* out_k,
+                       int64_t* out_max_col) {
+  SlotMap* m = (SlotMap*)h;
+  int32_t grows = 0;
+  // distinct-slice-end scratch hash (tiny: slices per batch is a handful)
+  uint64_t nb = 64;
+  while (nb < (uint64_t)maxu * 2) nb <<= 1;
+  int64_t* se_key = (int64_t*)malloc(sizeof(int64_t) * nb);
+  int32_t* se_idx = (int32_t*)malloc(sizeof(int32_t) * nb);
+  memset(se_idx, 0xff, sizeof(int32_t) * nb);
+  int64_t k_count = 0;
+  int64_t max_col = 0;
+  constexpr int64_t CHUNK = 256;
+  uint64_t hashes[CHUNK];
+  for (int64_t base = 0; base < n; base += CHUNK) {
+    int64_t end = base + CHUNK < n ? base + CHUNK : n;
+    uint64_t pmask = (uint64_t)m->bucket_count - 1;
+    for (int64_t r = base; r < end; r++) {
+      uint64_t hh = mix_hash((uint64_t)keys[r], 0);
+      hashes[r - base] = hh;
+      __builtin_prefetch(&m->buckets[hh & pmask], 0, 1);
+    }
+    for (int64_t r = base; r < end; r++) {
+      int32_t b = m->buckets[hashes[r - base] & pmask];
+      if (b >= 0) __builtin_prefetch(&m->slot_key[b], 0, 1);
+    }
+    for (int64_t r = base; r < end; r++) {
+      // slice end (floor-mod)
+      int64_t x = ts[r] - offset;
+      int64_t rem = x % width;
+      if (rem < 0) rem += width;
+      int64_t se = ts[r] - rem + width;
+      uint64_t sb = mix_hash((uint64_t)se, 0) & (nb - 1);
+      for (;;) {
+        if (se_idx[sb] < 0) {
+          if (k_count >= maxu) {
+            free(se_key);
+            free(se_idx);
+            return -2;
+          }
+          se_key[sb] = se;
+          se_idx[sb] = (int32_t)k_count;
+          out_uniq[k_count++] = se;
+          break;
+        }
+        if (se_key[sb] == se) break;
+        sb = (sb + 1) & (nb - 1);
+      }
+      out_sinv[r] = se_idx[sb];
+      // key -> column (lookup-or-insert, ns = 0)
+      int64_t k = keys[r];
+      uint64_t mask = (uint64_t)m->bucket_count - 1;
+      uint64_t i = hashes[r - base] & mask;
+      for (;;) {
+        int32_t b = m->buckets[i];
+        if (b == -1) {
+          if (m->free_top == 0) {
+            if (grow(m) != 0) {
+              free(se_key);
+              free(se_idx);
+              return -1;
+            }
+            grows++;
+            mask = (uint64_t)m->bucket_count - 1;
+            i = mix_hash((uint64_t)k, 0) & mask;
+            continue;
+          }
+          int32_t slot = m->free_stack[--m->free_top];
+          m->buckets[i] = slot;
+          m->slot_key[slot] = k;
+          m->slot_ns[slot] = 0;
+          m->slot_used[slot] = 1;
+          m->used++;
+          out_cols[r] = slot;
+          out_is_new[r] = 1;
+          if (slot > max_col) max_col = slot;
+          break;
+        } else if (m->slot_key[b] == k && m->slot_ns[b] == 0) {
+          out_cols[r] = b;
+          out_is_new[r] = 0;
+          if (b > max_col) max_col = b;
+          break;
+        }
+        i = (i + 1) & mask;
+      }
+    }
+  }
+  free(se_key);
+  free(se_idx);
+  *out_k = k_count;
+  *out_max_col = max_col;
+  return grows;
+}
+
+// Fused pane-table ingest, pass B: the flat i32 scatter index from the
+// pass-A columns + the ring rows Python allocated for the distinct slice
+// ends (row allocation may grow device arrays, so it stays in Python).
+void sm_flat_fuse(int64_t n, const int32_t* cols, const int32_t* sinv,
+                  const int64_t* rowmap, int64_t capacity,
+                  int32_t* out_flat) {
+  for (int64_t i = 0; i < n; i++) {
+    out_flat[i] = (int32_t)(rowmap[sinv[i]] * capacity + (int64_t)cols[i]);
+  }
+}
+
 // Assign a dense row id per DISTINCT key (first-seen order) — the O(n)
 // replacement for np.unique(..., return_inverse=True) on the per-fire
 // hot path. out_keys needs n int64s (only the first K are written),
