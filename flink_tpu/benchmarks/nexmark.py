@@ -78,6 +78,34 @@ class BidSource(Source):
         if self._emitted >= own:
             return None
         n = min(max_records, own - self._emitted)
+        first = self._emitted * self._stride + self._offset
+        # native single-pass generator when available (the measured path
+        # runs on ONE host core here — generator cost is engine cost);
+        # bit-identical to the numpy fallback below, so checkpoints replay
+        # across either
+        from flink_tpu.native import load_datagen
+
+        lib = load_datagen()
+        if lib is not None:
+            import ctypes
+
+            auctions = np.empty(n, dtype=np.int64)
+            bidders = np.empty(n, dtype=np.int64)
+            prices = np.empty(n, dtype=np.float32)
+            ts = np.empty(n, dtype=np.int64)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            lib.ngen_bids(
+                n, first, self._stride, self.seed * 4 + 1,
+                self.num_auctions, self.num_bidders,
+                int(self.hot_ratio * 1024), max(self.rate, 1),
+                auctions.ctypes.data_as(i64p),
+                bidders.ctypes.data_as(i64p),
+                prices.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                ts.ctypes.data_as(i64p))
+            self._emitted += n
+            return RecordBatch.from_pydict(
+                {"auction": auctions, "bidder": bidders, "price": prices},
+                timestamps=ts)
         idx = (np.arange(self._emitted, self._emitted + n,
                          dtype=np.int64) * self._stride + self._offset)
         self._emitted += n

@@ -103,6 +103,31 @@ def slotmap_available() -> bool:
     return load_slotmap() is not None
 
 
+_datagen_lib: Optional[ctypes.CDLL] = None
+_datagen_tried = False
+
+
+def load_datagen() -> Optional[ctypes.CDLL]:
+    """The native stream generator (native/datagen.cpp), or None."""
+    global _datagen_lib, _datagen_tried
+    with _lock:
+        if _datagen_tried:
+            return _datagen_lib
+        _datagen_tried = True
+        lib = load_native("datagen.cpp", "_datagen.so")
+        if lib is None:
+            return None
+        c = ctypes
+        i64, f32p = c.c_int64, c.POINTER(c.c_float)
+        P = c.POINTER
+        lib.ngen_bids.restype = None
+        lib.ngen_bids.argtypes = [i64, i64, i64, i64, i64, i64, i64, i64,
+                                  P(c.c_int64), P(c.c_int64), f32p,
+                                  P(c.c_int64)]
+        _datagen_lib = lib
+        return _datagen_lib
+
+
 def group_matrix(keys, slots, sidx, n_slices: int):
     """(unique keys, [K, n_slices] slot matrix) grouped by key in O(n)
     via the native hash table — the window-fire matrix build (absent

@@ -1,0 +1,61 @@
+// Native synthetic-stream generator: the data-loader half of the benchmark
+// harness. The reference generates its Nexmark-style load in JVM code
+// (reference: flink-examples / the TableEnvironment datagen connector,
+// flink-table/flink-table-runtime DataGeneratorSource analog); here the
+// generator is one C pass so the measured path spends its single host core
+// on the engine, not on producing the input.
+//
+// Determinism contract: bid i is a pure function of its global index
+// (splitmix64), so checkpoint replay and strided multi-subtask splits
+// produce identical streams (see flink_tpu/benchmarks/nexmark.py).
+
+#include <cstdint>
+#include <cmath>
+
+namespace {
+
+// Bit-exact mirror of flink_tpu.connectors.sources._splitmix64(idx, salt):
+// z = idx + salt*PHI; then one splitmix64 finalization round. The native
+// and numpy generators MUST produce identical streams — a checkpoint taken
+// with one must replay identically under the other.
+inline uint64_t splitmix64_salted(uint64_t idx, uint64_t salt) {
+  uint64_t z = idx + salt * 0x9E3779B97F4A7C15ull;
+  z += 0x9E3779B97F4A7C15ull;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Generates n bids for global indices idx[i] = first + i * stride.
+// Field derivation mirrors BidSource.poll_batch (one hash per record,
+// fields sliced from its 64 bits): hot flag 10 bits, auction uniform 22,
+// bidder 16, price 16 (Pareto a=3 by inverse transform).
+void ngen_bids(int64_t n, int64_t first, int64_t stride, int64_t seed,
+               int64_t num_auctions, int64_t num_bidders,
+               int64_t hot_ratio_1024, int64_t rate,
+               int64_t* out_auction, int64_t* out_bidder,
+               float* out_price, int64_t* out_ts) {
+  int64_t hot_span = num_auctions / 100;
+  if (hot_span < 1) hot_span = 1;
+  double inv22 = 1.0 / (double)(1 << 22);
+  double inv16 = 1.0 / (double)(1 << 16);
+  for (int64_t i = 0; i < n; i++) {
+    int64_t idx = first + i * stride;
+    uint64_t u = splitmix64_salted((uint64_t)idx, (uint64_t)seed);
+    bool hot = (int64_t)(u & 0x3FF) < hot_ratio_1024;
+    double ua = (double)((u >> 10) & 0x3FFFFF) * inv22;
+    out_auction[i] = (int64_t)(ua * (double)(hot ? hot_span : num_auctions));
+    out_bidder[i] = (int64_t)(((u >> 32) & 0xFFFF) * num_bidders) >> 16;
+    double up = (double)(u >> 48) * inv16;
+    if (up < 1e-12) up = 1e-12;
+    // ::pow, not cbrt(1/x): must round identically to np.power(u, -1/3)
+    out_price[i] = (float)((::pow(up, -1.0 / 3.0) - 1.0) * 100.0 + 1.0);
+    out_ts[i] = (idx * 1000) / rate;
+  }
+}
+
+}  // extern "C"
