@@ -7,9 +7,13 @@ Re-design for the micro-batch engine: a checkpoint is a directory holding
 (key_id / namespace / key_group / leaf arrays) — key-group indexed so restore
 can re-shard (the rescale contract), and (b) a JSON manifest with source
 positions and job metadata. Barrier alignment is structural (snapshot happens
-between micro-batches), so exactly-once needs no channel state
-(the unaligned-checkpoint machinery of the reference is unnecessary here by
-construction).
+between micro-batches), so ALIGNMENT costs nothing — but a barrier queued
+behind a credit-stalled exchange still waits for the backlog, so the
+stage-parallel executor supports unaligned checkpoints
+(execution.checkpointing.unaligned): barriers overtake queued batches and
+the overtaken data is stored under ``__channel_state__.*`` entries, replayed
+through the consumer on restore (reference:
+runtime/checkpoint/channel/ChannelStateWriterImpl.java).
 """
 
 from __future__ import annotations

@@ -1067,6 +1067,8 @@ class _KeyedSubtask(threading.Thread):
         self.chain: Optional[_OperatorChain] = None
         self.records_in = 0
         self._restore_states: Optional[Dict[str, Any]] = None
+        #: slot -> {"b0": {col: arr}, ...} from an unaligned checkpoint
+        self._restore_channel_state: Dict[str, Any] = {}
 
     @property
     def records_out(self) -> int:
@@ -1136,6 +1138,15 @@ class _KeyedSubtask(threading.Thread):
         aligning: Optional[Barrier] = None
         barriered = [False] * total
         buffered: List[Tuple[int, int, Any]] = []
+        # unaligned-checkpoint mode (reference: CheckpointedInputGate's
+        # priority-barrier path + ChannelStateWriter): operator state is
+        # snapshotted at the FIRST barrier, data keeps flowing, and
+        # pre-barrier batches from not-yet-barriered channels are copied
+        # into channel state while being processed
+        ua: Optional[Barrier] = None
+        ua_snap: Optional[Dict] = None
+        ua_barriered = [False] * total
+        ua_chan_state: Dict[int, List] = {}
         stopping = False
         poll_at = 0
 
@@ -1194,6 +1205,59 @@ class _KeyedSubtask(threading.Thread):
                 r.broadcast(MAX_WATERMARK)
                 r.close()
 
+        def gate_slot(slot: int) -> Tuple[int, int]:
+            for g in range(K - 1, -1, -1):
+                if slot >= base[g]:
+                    return g, slot - base[g]
+            return 0, slot
+
+        def ua_begin(item: Barrier) -> None:
+            nonlocal ua, ua_snap, ua_barriered, ua_chan_state
+            ua = item
+            ua_barriered = [False] * total
+            ua_chan_state = {}
+            snap = self.chain.snapshot(self.graph, savepoint=False)
+            for r in self.routes:
+                snap.update(r.snapshot(self.graph, savepoint=False))
+            ua_snap = snap
+            # forward immediately: the barrier overtakes this subtask's
+            # own output queues too, so downstream starts ITS unaligned
+            # snapshot without waiting behind the exchange backlog
+            for r in self.routes:
+                r.flush()
+                r.broadcast(item)
+
+        def ua_maybe_complete() -> None:
+            nonlocal ua, ua_snap
+            if ua is None or not all(
+                    ua_barriered[c] or done[c] for c in range(total)):
+                return
+            payload = {"operators": ua_snap}
+            if ua_chan_state:
+                payload["channel_state"] = {
+                    str(slot): {f"b{i}": dict(b.columns)
+                                for i, b in enumerate(batches)}
+                    for slot, batches in ua_chan_state.items() if batches}
+            self.coordinator.ack(ua.checkpoint_id,
+                                 ("keyed", self.stage_index, self.index),
+                                 payload)
+            ua = None
+            ua_snap = None
+
+        if self._restore_channel_state:
+            # in-flight batches an unaligned checkpoint persisted: they
+            # were consumed from the channels AFTER the snapshot cut, so
+            # on restore they replay through the operator first —
+            # upstream's positions are already past them (no duplication)
+            from flink_tpu.core.records import RecordBatch as _RB
+
+            for slot_str in sorted(self._restore_channel_state, key=int):
+                slot = int(slot_str)
+                gi0, _ = gate_slot(slot)
+                entry = self._restore_channel_state[slot_str]
+                for bk in sorted(entry, key=lambda s: int(s[1:])):
+                    process(_RB(entry[bk]), gi0, slot)
+
         ticks_pt = self.chain.uses_processing_time
         while True:
             self._serve_queries()
@@ -1222,6 +1286,14 @@ class _KeyedSubtask(threading.Thread):
                 continue
             ch, item = entry
             slot = base[gi] + ch
+            if isinstance(item, Barrier) and item.unaligned:
+                if ua is None or ua.checkpoint_id != item.checkpoint_id:
+                    ua_begin(item)
+                ua_barriered[slot] = True
+                ua_chan_state.setdefault(slot, []).extend(
+                    gates[gi].take_inflight(ch, item.checkpoint_id))
+                ua_maybe_complete()
+                continue
             if isinstance(item, Barrier):
                 if aligning is None:
                     aligning = item
@@ -1246,6 +1318,7 @@ class _KeyedSubtask(threading.Thread):
                 continue
             if item is END_OF_PARTITION:
                 done[slot] = True
+                ua_maybe_complete()
                 if aligning is not None and all(
                         barriered[c] or done[c] for c in range(total)):
                     stop = aligned_snapshot_ack()
@@ -1281,6 +1354,13 @@ class _KeyedSubtask(threading.Thread):
                 # alignment completes (bounded by channel credits)
                 buffered.append((gi, slot, item))
                 continue
+            if ua is not None and not ua_barriered[slot] and \
+                    isinstance(item, RecordBatch):
+                # unaligned in progress: pre-barrier data from channels
+                # whose barrier has not arrived is BOTH processed (live
+                # run continues) and copied into channel state (it is not
+                # covered by the already-taken operator snapshot)
+                ua_chan_state.setdefault(slot, []).append(item)
             process(item, gi, slot)
 
     def _serve_queries(self) -> None:
@@ -1424,6 +1504,7 @@ class StageParallelExecutor:
         checkpoint_id = 0
         restore_states: Dict[str, Any] = {}
         restore_positions: Dict[int, Any] = {}
+        restore_channel_state: Dict[Tuple[int, int], Dict[str, Any]] = {}
         if restore_from is not None:
             from flink_tpu.checkpoint.savepoint import prepare_restore
             from flink_tpu.checkpoint.storage import (
@@ -1447,6 +1528,18 @@ class StageParallelExecutor:
                 for t in stage.operator_transformations
                 if t.operator_factory is not None)
             for sid, state in states.items():
+                if sid.startswith("__channel_state__."):
+                    _, m_s, j_s, slot_s = sid.rsplit(".", 3)
+                    m_i, j_i = int(m_s), int(j_s)
+                    if j_i >= N:
+                        raise RuntimeError(
+                            "unaligned checkpoint holds channel state for "
+                            f"subtask {j_i} but execution.stage-parallelism "
+                            f"is {N} — restore with the original "
+                            "parallelism")
+                    restore_channel_state.setdefault(
+                        (m_i, j_i), {})[slot_s] = state
+                    continue
                 if sid in src_ids:
                     pos = state["source"]
                     if isinstance(pos, dict) and "__subtasks__" in pos:
@@ -1587,6 +1680,9 @@ class StageParallelExecutor:
         for k in keyed:
             if restore_states:
                 k._restore_states = restore_states
+            cs = restore_channel_state.get((k.stage_index, k.index))
+            if cs:
+                k._restore_channel_state = cs
         for t in keyed + sources:
             t.start()
 
@@ -1629,7 +1725,10 @@ class StageParallelExecutor:
                     if due:
                         checkpoint_id += 1
                         self._checkpoint(
-                            checkpoint_id, Barrier(checkpoint_id),
+                            checkpoint_id,
+                            Barrier(checkpoint_id,
+                                    unaligned=cfg.get(
+                                        CheckpointOptions.UNALIGNED)),
                             sources, keyed, coordinator, graph, plan,
                             storage=storage, job_name=job_name)
                         last_ckpt = time.time() * 1000
@@ -1834,6 +1933,12 @@ class StageParallelExecutor:
         for who, sub in acks.items():
             for sid, state in sub.get("operators", {}).items():
                 per_operator.setdefault(sid, []).append(state)
+            if who[0] == "keyed" and sub.get("channel_state"):
+                # in-flight batches an unaligned barrier overtook, keyed
+                # by (stage, subtask, flat channel) — replayed on restore
+                for slot, payload in sub["channel_state"].items():
+                    snap[f"__channel_state__.{who[1]}.{who[2]}.{slot}"] = \
+                        payload
         for sid, states in per_operator.items():
             snap[sid] = merge_subtask_states(states)
         if savepoint_dir is not None:

@@ -357,6 +357,13 @@ class CheckpointOptions:
         "bounding restore-chain length.")
     MODE = ConfigOption(
         "execution.checkpointing.mode", default="exactly-once", type=str)
+    UNALIGNED = ConfigOption(
+        "execution.checkpointing.unaligned", default=False, type=bool,
+        description="Barriers overtake in-flight data; overtaken batches "
+        "are persisted as channel state so a checkpoint completes in "
+        "bounded time under backpressure (reference: "
+        "ExecutionCheckpointingOptions.ENABLE_UNALIGNED). Savepoints "
+        "remain aligned. Stage-parallel executor only.")
 
 
 class RestartOptions:

@@ -42,15 +42,21 @@ END_OF_PARTITION = "__eop__"
 class Barrier:
     """Checkpoint barrier riding the data channels (reference:
     io/network/api/CheckpointBarrier). Aligned handling is the consumer's
-    job (InputGate.poll_aligned)."""
+    job; ``unaligned`` barriers instead OVERTAKE queued data (reference:
+    CheckpointBarrier.asUnaligned + the priority-event path of
+    CheckpointedInputGate) — the overtaken batches become channel state
+    in the snapshot so the checkpoint never waits behind a backpressured
+    backlog. Savepoints are always aligned (reference: savepoints force
+    alignment)."""
 
-    __slots__ = ("checkpoint_id", "savepoint", "stop")
+    __slots__ = ("checkpoint_id", "savepoint", "stop", "unaligned")
 
     def __init__(self, checkpoint_id: int, savepoint: Optional[str] = None,
-                 stop: bool = False):
+                 stop: bool = False, unaligned: bool = False):
         self.checkpoint_id = checkpoint_id
         self.savepoint = savepoint
         self.stop = stop
+        self.unaligned = unaligned and savepoint is None
 
     def __repr__(self):
         return f"Barrier({self.checkpoint_id})"
@@ -81,6 +87,12 @@ class InputGate:
         """Next (channel_index, item) where item is a RecordBatch, Barrier,
         a watermark (int), or END_OF_PARTITION. None on timeout."""
         raise NotImplementedError
+
+    def take_inflight(self, channel: int, checkpoint_id: int) -> list:
+        """Batches an unaligned barrier overtook on ``channel`` (channel
+        state). Transports without overtaking return [] — the consumer's
+        capture-while-polling then covers all pre-barrier data."""
+        return []
 
     def close(self) -> None:
         raise NotImplementedError
@@ -114,11 +126,23 @@ class _Subpartition:
     """One (producer, consumer-channel) pipe. ``credits`` mirrors the
     reference's buffer-backed credit: the producer blocks once
     ``credits_per_channel`` items are in flight; consuming an item grants
-    the credit back (RemoteInputChannel.notifyCreditAvailable)."""
+    the credit back (RemoteInputChannel.notifyCreditAvailable).
+
+    Unaligned barriers use ``put_front``: the barrier jumps ahead of the
+    queued data batches, and those overtaken batches are recorded as the
+    channel's in-flight state for that checkpoint (reference:
+    ChannelStateWriterImpl persisting the buffers a priority barrier
+    overtook)."""
 
     def __init__(self, credits_per_channel: int):
-        self.queue: _q.Queue = _q.Queue()
+        import collections
+
+        self._data = collections.deque()
+        self._prio = collections.deque()
+        self._cond = threading.Condition()
         self.credits = threading.Semaphore(credits_per_channel)
+        #: checkpoint_id -> [overtaken RecordBatches] (consumer pops)
+        self._inflight: Dict[int, list] = {}
 
     def put(self, item, is_event: bool, cancelled: Callable[[], bool]) -> None:
         if not is_event:
@@ -127,11 +151,31 @@ class _Subpartition:
             while not self.credits.acquire(timeout=0.05):
                 if cancelled():
                     return
-        self.queue.put(item)
+        with self._cond:
+            self._data.append(item)
+            self._cond.notify()
+
+    def put_front(self, barrier) -> None:
+        """Unaligned barrier: overtake queued data, snapshotting the
+        overtaken batches as this channel's in-flight state."""
+        with self._cond:
+            self._inflight.setdefault(barrier.checkpoint_id, []).extend(
+                b for b in self._data if isinstance(b, RecordBatch))
+            self._prio.append(barrier)
+            self._cond.notify()
+
+    def take_inflight(self, checkpoint_id: int) -> list:
+        with self._cond:
+            return self._inflight.pop(checkpoint_id, [])
 
     def get(self, timeout: float):
-        item = self.queue.get(timeout=timeout) if timeout else \
-            self.queue.get_nowait()
+        with self._cond:
+            if not self._prio and not self._data:
+                if not timeout or not self._cond.wait_for(
+                        lambda: self._prio or self._data, timeout):
+                    raise _q.Empty
+            item = self._prio.popleft() if self._prio else \
+                self._data.popleft()
         if isinstance(item, RecordBatch):
             self.credits.release()
         return item
@@ -215,6 +259,10 @@ class LocalWriter(ResultPartitionWriter):
             batch, is_event=False, cancelled=self._cancelled.is_set)
 
     def broadcast_event(self, event) -> None:
+        if isinstance(event, Barrier) and event.unaligned:
+            for sp in self.partition.subpartitions:
+                sp.put_front(event)
+            return
         for sp in self.partition.subpartitions:
             sp.put(event, is_event=True, cancelled=self._cancelled.is_set)
 
@@ -262,6 +310,9 @@ class LocalGate(InputGate):
                 return ch, item
             except _q.Empty:
                 continue
+
+    def take_inflight(self, channel: int, checkpoint_id: int) -> list:
+        return self._chans[channel].take_inflight(checkpoint_id)
 
     def close(self) -> None:
         pass

@@ -1,0 +1,233 @@
+"""Unaligned (overtaking) checkpoints under backpressure.
+
+reference: runtime/checkpoint/channel/ChannelStateWriterImpl.java (persisting
+overtaken in-flight buffers), runtime/io/network/api/CheckpointBarrier
+asUnaligned + CheckpointedInputGate's priority-event path,
+ExecutionCheckpointingOptions.ENABLE_UNALIGNED.
+
+TPU re-design under test: the barrier jumps the columnar batch queue
+(put_front), the overtaken batches ride the snapshot as channel state, the
+keyed subtask snapshots at the FIRST barrier without alignment blocking, and
+restore replays channel state through the operator before new input.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu import Configuration, StreamExecutionEnvironment
+from flink_tpu.connectors.sinks import CollectSink, Sink
+from flink_tpu.connectors.sources import DataGenSource
+from flink_tpu.core.records import RecordBatch
+from flink_tpu.runtime.watermarks import WatermarkStrategy
+from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+from tests.conftest import \
+    assert_windows_approx_equal as _assert_windows_equal  # noqa: E501
+
+
+class SlowCollectSink(Sink):
+    """Collects results, sleeping per write — a backpressuring consumer."""
+
+    def __init__(self, delay_s: float):
+        self.delay_s = delay_s
+        self.batches = []
+
+    def write(self, batch):
+        time.sleep(self.delay_s)
+        self.batches.append(batch)
+
+    def result(self):
+        return RecordBatch.concat(self.batches)
+
+
+def _env(extra):
+    conf = {
+        "execution.micro-batch.size": 500,
+        "execution.stage-parallelism": 1,
+        "state.slot-table.capacity": 8192,
+        "shuffle.credits-per-channel": 8,
+    }
+    conf.update(extra or {})
+    return StreamExecutionEnvironment(Configuration(conf))
+
+
+def _pipeline(env, sink, total=20_000, keys=50):
+    src = DataGenSource(total_records=total, num_keys=keys,
+                        events_per_second_of_eventtime=10_000, seed=11)
+    ds = env.from_source(
+        src, WatermarkStrategy.for_bounded_out_of_orderness(0))
+    # 200 ms windows at 10k events/s of event time and 500-row batches:
+    # every ~4th batch closes a window and pays the slow sink's delay,
+    # so the exchange backlog (8 credits) holds multiple window fires
+    ds.key_by("key").window(
+        TumblingEventTimeWindows.of(200)).sum("value").sink_to(sink)
+
+
+def _results(sink):
+    out = {}
+    for r in sink.result().to_rows():
+        out[(r["key"], r["window_start"], r["window_end"])] = round(
+            r["sum_value"], 3)
+    return out
+
+
+def _timed_checkpoints(monkeypatch):
+    """Record the wall duration of every stage-executor checkpoint."""
+    from flink_tpu.cluster.stage_executor import StageParallelExecutor
+
+    durations = []
+    orig = StageParallelExecutor._checkpoint
+
+    def timed(self, *a, **k):
+        t0 = time.perf_counter()
+        try:
+            return orig(self, *a, **k)
+        finally:
+            durations.append(time.perf_counter() - t0)
+
+    monkeypatch.setattr(StageParallelExecutor, "_checkpoint", timed)
+    return durations
+
+
+class TestUnalignedCompletesUnderBackpressure:
+    def test_barrier_overtakes_backlog(self, tmp_path, monkeypatch):
+        """With a slow sink and saturated credits, an unaligned checkpoint
+        completes in ~one consumer step; an aligned one must wait for the
+        whole in-flight backlog to drain first. Documented bound: the
+        unaligned checkpoint is independent of the backlog depth."""
+        durations = _timed_checkpoints(monkeypatch)
+        delay = 0.25
+        base = {
+            "state.checkpoints.dir": str(tmp_path / "ua"),
+            "execution.checkpointing.every-n-source-batches": 10,
+            "execution.checkpointing.unaligned": True,
+        }
+        env = _env(base)
+        sink = SlowCollectSink(delay)
+        _pipeline(env, sink)
+        env.execute("unaligned-backpressure")
+        assert durations, "no checkpoint was triggered"
+        ua_max = max(durations)
+
+        durations.clear()
+        aligned = dict(base)
+        aligned["state.checkpoints.dir"] = str(tmp_path / "al")
+        aligned["execution.checkpointing.unaligned"] = False
+        env2 = _env(aligned)
+        sink2 = SlowCollectSink(delay)
+        _pipeline(env2, sink2)
+        env2.execute("aligned-backpressure")
+        assert durations
+        al_max = max(durations)
+
+        # the aligned barrier sits behind the credit-deep backlog of
+        # window fires; the unaligned one overtakes it. The factor is
+        # the point, the absolute bound is the regression guard.
+        assert ua_max < 2.0, f"unaligned checkpoint took {ua_max:.2f}s"
+        assert ua_max < al_max / 2, (
+            f"overtaking gained nothing: unaligned {ua_max:.2f}s vs "
+            f"aligned {al_max:.2f}s")
+
+    def test_results_unaffected_by_unaligned_mode(self, tmp_path):
+        env = _env({})
+        clean = CollectSink()
+        _pipeline(env, clean)
+        env.execute("clean")
+        expected = _results(clean)
+
+        env2 = _env({
+            "state.checkpoints.dir": str(tmp_path / "ck"),
+            "execution.checkpointing.every-n-source-batches": 7,
+            "execution.checkpointing.unaligned": True,
+        })
+        sink2 = CollectSink()
+        _pipeline(env2, sink2)
+        env2.execute("with-unaligned-checkpoints")
+        _assert_windows_equal(_results(sink2), expected)
+
+
+class TestUnalignedRestore:
+    def test_crash_restore_replays_channel_state(self, tmp_path):
+        """Crash after an unaligned checkpoint whose snapshot holds
+        in-flight batches; restore must replay them through the operator
+        (exactly-once end to end vs a clean run)."""
+        ckpt = str(tmp_path / "ckpts")
+
+        env = _env({})
+        clean = CollectSink()
+        _pipeline(env, clean)
+        env.execute("clean")
+        expected = _results(clean)
+
+        from tests.test_checkpointing import FailingMap
+
+        conf = {
+            "state.checkpoints.dir": ckpt,
+            "execution.checkpointing.every-n-source-batches": 7,
+            "execution.checkpointing.unaligned": True,
+        }
+        env2 = _env(conf)
+        sink2 = SlowCollectSink(0.05)
+        src = DataGenSource(total_records=20_000, num_keys=50,
+                            events_per_second_of_eventtime=10_000, seed=11)
+        ds = env2.from_source(
+            src, WatermarkStrategy.for_bounded_out_of_orderness(0))
+        ds = ds.map(FailingMap(12_000), name="failmap")
+        ds.key_by("key").window(
+            TumblingEventTimeWindows.of(200)).sum("value").sink_to(sink2)
+        with pytest.raises(RuntimeError, match="injected failure"):
+            env2.execute("crashing")
+        from flink_tpu.checkpoint.storage import CheckpointStorage
+
+        assert CheckpointStorage(ckpt).latest_checkpoint_id() is not None
+
+        env3 = _env(conf)
+        sink3 = CollectSink()
+        src = DataGenSource(total_records=20_000, num_keys=50,
+                            events_per_second_of_eventtime=10_000, seed=11)
+        ds = env3.from_source(
+            src, WatermarkStrategy.for_bounded_out_of_orderness(0))
+        ds = ds.map(lambda b: b, name="failmap")
+        ds.key_by("key").window(
+            TumblingEventTimeWindows.of(200)).sum("value").sink_to(sink3)
+        env3.execute("restored", restore_from=ckpt)
+
+        got = {}
+        if sink2.batches:
+            got.update(_results(sink2))
+        got.update(_results(sink3))
+        _assert_windows_equal(got, expected)
+
+
+class TestTransportPrimitives:
+    def test_put_front_overtakes_and_captures(self):
+        from flink_tpu.runtime.shuffle_spi import (
+            Barrier,
+            LocalShuffleService,
+        )
+
+        svc = LocalShuffleService()
+        writer = svc.create_partition("p", 1, credits_per_channel=4)
+        gate = svc.create_gate(["p"], 0)
+        b1 = RecordBatch.from_pydict({"x": np.arange(3)})
+        b2 = RecordBatch.from_pydict({"x": np.arange(5)})
+        writer.emit(0, b1)
+        writer.emit(0, b2)
+        bar = Barrier(7, unaligned=True)
+        writer.broadcast_event(bar)
+        ch, first = gate.poll(timeout=1.0)
+        assert isinstance(first, Barrier) and first.checkpoint_id == 7
+        captured = gate.take_inflight(0, 7)
+        assert [len(b) for b in captured] == [3, 5]
+        # the overtaken data still flows after the barrier
+        _, nxt = gate.poll(timeout=1.0)
+        assert len(nxt) == 3
+        _, nxt = gate.poll(timeout=1.0)
+        assert len(nxt) == 5
+
+    def test_savepoint_barriers_stay_aligned(self):
+        from flink_tpu.runtime.shuffle_spi import Barrier
+
+        assert not Barrier(1, savepoint="/sp", unaligned=True).unaligned

@@ -48,9 +48,8 @@ def capture():
     # 2. micro-batch x dispatch-depth sweep (smaller record count per
     # point to bound time; depth is THE lever for the tunneled high-RTT
     # device link)
-    for bs, da in ((1 << 16, 4), (1 << 17, 4), (1 << 18, 4),
-                   (1 << 19, 8), (1 << 17, 2), (1 << 17, 8),
-                   (1 << 18, 16)):
+    for bs, da in ((1 << 20, 8), (1 << 19, 8), (1 << 21, 8),
+                   (1 << 20, 16), (1 << 20, 4)):
         e = dict(env, BENCH_RECORDS=str(10_000_000),
                  BENCH_BATCH_SIZE=str(bs), BENCH_DISPATCH_AHEAD=str(da))
         try:
