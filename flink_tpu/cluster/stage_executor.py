@@ -1466,22 +1466,41 @@ class StageParallelExecutor:
                     "execution.runtime-mode=batch requires bounded "
                     f"sources; {spec.source.name!r} is unbounded")
         if N == -1:
-            # adaptive batch parallelism: size the keyed stage from the
-            # estimated source volume (reference: AdaptiveBatchScheduler
-            # decides parallelism from produced data volume)
+            # adaptive batch parallelism (reference:
+            # AdaptiveBatchScheduler decides downstream parallelism from
+            # PRODUCED partition volume, not a plan-time guess). Bounded
+            # sources are replayable by contract (open() rewinds — see
+            # connectors/source_v2.py reset + tests/test_source_v2.py),
+            # so the volume is MEASURED with a metering pass through each
+            # source; estimate_records() is only the fallback when a
+            # source cannot be metered. A wrong or absent estimate
+            # therefore cannot missize the stage (it previously silently
+            # fell to N=1).
             if not batch_mode:
                 raise StagePlanError(
                     "execution.stage-parallelism=-1 (adaptive) requires "
                     "execution.runtime-mode=batch")
-            est = sum(
-                int(spec.source.source.estimate_records() or 0)
-                for spec in src_specs)
             target = cfg.get(
                 ExecutionModeOptions.TARGET_RECORDS_PER_SUBTASK)
             if target < 1:
                 raise StagePlanError(
                     "execution.batch.target-records-per-subtask must be "
                     f">= 1, got {target}")
+            est = 0
+            for spec in src_specs:
+                src = spec.source.source
+                try:
+                    src.open(0, 1)
+                    meter = 0
+                    while True:
+                        b = src.poll_batch(1 << 16)
+                        if b is None:
+                            break
+                        meter += len(b)
+                    est += meter
+                except Exception:
+                    est += int(getattr(src, "estimate_records",
+                                       lambda: 0)() or 0)
             N = max(1, min(-(-int(est) // target) if est else 1, max_par))
         if N < 1:
             raise StagePlanError("execution.stage-parallelism must be >= 1")

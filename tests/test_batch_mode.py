@@ -111,6 +111,39 @@ class TestBatchMode:
         with pytest.raises(Exception, match="adaptive"):
             env2.execute("bad")
 
+    @pytest.mark.parametrize("lie", [lambda: 50, lambda: 2_000_000, None])
+    def test_adaptive_parallelism_is_measured_not_estimated(self, lie):
+        """The keyed-stage parallelism comes from a metering pass through
+        the bounded source (reference: AdaptiveBatchScheduler sizes from
+        PRODUCED volume) — an estimate_records() that lies by 100x in
+        either direction, or does not exist at all, changes nothing."""
+        from flink_tpu.connectors.sources import DataGenSource
+        from flink_tpu.runtime.watermarks import WatermarkStrategy
+
+        class LyingSource(DataGenSource):
+            pass
+
+        src = LyingSource(total_records=20_000, num_keys=100,
+                          events_per_second_of_eventtime=10_000, seed=3)
+        if lie is None:
+            # estimate_records not usable at all
+            LyingSource.estimate_records = None
+        else:
+            LyingSource.estimate_records = staticmethod(lie)
+        sink = CollectSink()
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 1000,
+            "execution.runtime-mode": "batch",
+            "execution.stage-parallelism": -1,
+            "execution.batch.target-records-per-subtask": 5_000}))
+        env.from_source(
+            src, WatermarkStrategy.for_bounded_out_of_orderness(0)) \
+            .key_by("key").window(TumblingEventTimeWindows.of(1000)) \
+            .sum("value").sink_to(sink)
+        result = env.execute("adaptive-measured")
+        assert result.metrics["stage_parallelism"] == 4  # ceil(20k/5k)
+        assert len(sink.result()) > 0
+
     def test_batch_sql_group_agg_emits_finals_only(self):
         from flink_tpu.table.environment import StreamTableEnvironment
 
