@@ -1,0 +1,489 @@
+"""Avro binary format: registry-less encode/decode with schema resolution.
+
+reference: flink-formats/flink-avro/src/main/java/org/apache/flink/formats/
+avro/AvroRowDataDeserializationSchema.java:1 (record bytes -> rows under a
+reader schema), AvroRowDataSerializationSchema.java, and the schema-
+resolution rules of the Avro spec the reference delegates to the Avro
+runtime (matching fields by name, defaults for added fields, numeric
+promotions, union resolution).
+
+Re-design notes: this is a from-scratch Avro *binary encoding* core (no
+avro/fastavro dependency — neither is in the image), scoped to the part the
+reference's format actually uses: single-record binary payloads (Kafka
+value bytes), NOT the object-container file layout. The batch-granular
+seam (formats.DeserializationSchema) turns the decoded rows into one
+columnar RecordBatch, so row-oriented Avro stays at the connector boundary
+and everything inside the engine remains columnar.
+
+Supported schema forms: null, boolean, int, long, float, double, bytes,
+string, fixed, enum, array, map, union, record (nested records included).
+Resolution: field match by name or aliases, reader defaults for missing
+fields, promotions int->long->float->double and string<->bytes, writer
+union branch resolved against the reader schema.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flink_tpu.connectors.formats import (
+    DeserializationSchema,
+    SerializationSchema,
+    _columns_from_rows,
+    _np_dtype,
+    register_format,
+)
+from flink_tpu.core.records import RecordBatch
+
+_PRIMITIVES = {"null", "boolean", "int", "long", "float", "double",
+               "bytes", "string"}
+
+
+def parse_schema(schema) -> Any:
+    """JSON text / dict / list -> normalized schema tree (dicts with
+    'type'; primitives stay strings; named types resolvable by name)."""
+    if isinstance(schema, str) and schema.lstrip()[:1] in "[{\"":
+        schema = json.loads(schema)
+    names: Dict[str, Any] = {}
+
+    def norm(s):
+        if isinstance(s, str):
+            if s in _PRIMITIVES:
+                return s
+            if s in names:
+                return names[s]
+            raise ValueError(f"unknown Avro type {s!r}")
+        if isinstance(s, list):
+            return {"type": "union", "branches": [norm(b) for b in s]}
+        t = s["type"]
+        if t in _PRIMITIVES and len(s) <= 2:
+            return t
+        if t == "record":
+            out = {"type": "record", "name": s["name"],
+                   "aliases": s.get("aliases", []), "fields": []}
+            names[s["name"]] = out
+            for f in s["fields"]:
+                fld = {"name": f["name"],
+                       "aliases": f.get("aliases", []),
+                       "schema": norm(f["type"])}
+                if "default" in f:
+                    fld["default"] = f["default"]
+                out["fields"].append(fld)
+            return out
+        if t == "enum":
+            out = {"type": "enum", "name": s["name"],
+                   "symbols": list(s["symbols"]),
+                   "default": s.get("default")}
+            names[s["name"]] = out
+            return out
+        if t == "fixed":
+            out = {"type": "fixed", "name": s["name"],
+                   "size": int(s["size"])}
+            names[s["name"]] = out
+            return out
+        if t == "array":
+            return {"type": "array", "items": norm(s["items"])}
+        if t == "map":
+            return {"type": "map", "values": norm(s["values"])}
+        if isinstance(t, (dict, list)):
+            return norm(t)
+        raise ValueError(f"unsupported Avro schema: {s!r}")
+
+    return norm(schema)
+
+
+def _type_name(s) -> str:
+    return s if isinstance(s, str) else s["type"]
+
+
+# --------------------------------------------------------------------------
+# binary encoding (Avro spec: zigzag varints, length-prefixed payloads,
+# block-encoded arrays/maps)
+# --------------------------------------------------------------------------
+
+
+class _Writer:
+    __slots__ = ("parts",)
+
+    def __init__(self):
+        self.parts: List[bytes] = []
+
+    def long(self, v: int) -> None:
+        v = (v << 1) ^ (v >> 63)  # zigzag
+        out = bytearray()
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                break
+        self.parts.append(bytes(out))
+
+    def raw(self, b: bytes) -> None:
+        self.parts.append(b)
+
+    def getvalue(self) -> bytes:
+        return b"".join(self.parts)
+
+
+class _Reader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def long(self) -> int:
+        shift = 0
+        acc = 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            acc |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        return (acc >> 1) ^ -(acc & 1)  # un-zigzag
+
+    def raw(self, n: int) -> bytes:
+        out = self.buf[self.pos:self.pos + n]
+        if len(out) != n:
+            raise ValueError("truncated Avro payload")
+        self.pos += n
+        return out
+
+
+def _write(s, v, w: _Writer) -> None:
+    t = _type_name(s)
+    if t == "null":
+        return
+    if t == "boolean":
+        w.raw(b"\x01" if v else b"\x00")
+    elif t in ("int", "long"):
+        w.long(int(v))
+    elif t == "float":
+        w.raw(struct.pack("<f", float(v)))
+    elif t == "double":
+        w.raw(struct.pack("<d", float(v)))
+    elif t == "bytes":
+        b = bytes(v)
+        w.long(len(b))
+        w.raw(b)
+    elif t == "string":
+        b = str(v).encode("utf-8")
+        w.long(len(b))
+        w.raw(b)
+    elif t == "fixed":
+        b = bytes(v)
+        if len(b) != s["size"]:
+            raise ValueError(f"fixed size {s['size']} != {len(b)}")
+        w.raw(b)
+    elif t == "enum":
+        w.long(s["symbols"].index(v))
+    elif t == "array":
+        items = list(v)
+        if items:
+            w.long(len(items))
+            for it in items:
+                _write(s["items"], it, w)
+        w.long(0)
+    elif t == "map":
+        if v:
+            w.long(len(v))
+            for k, mv in v.items():
+                _write("string", k, w)
+                _write(s["values"], mv, w)
+        w.long(0)
+    elif t == "union":
+        for i, branch in enumerate(s["branches"]):
+            if _union_accepts(branch, v):
+                w.long(i)
+                _write(branch, v, w)
+                return
+        raise ValueError(f"no union branch for {v!r}")
+    elif t == "record":
+        for f in s["fields"]:
+            _write(f["schema"], v[f["name"]], w)
+    else:
+        raise ValueError(f"unsupported Avro type {t!r}")
+
+
+def _union_accepts(branch, v) -> bool:
+    t = _type_name(branch)
+    if v is None:
+        return t == "null"
+    if isinstance(v, bool):
+        return t == "boolean"
+    if isinstance(v, (int, np.integer)):
+        return t in ("int", "long", "float", "double")
+    if isinstance(v, (float, np.floating)):
+        return t in ("float", "double")
+    if isinstance(v, str):
+        return t in ("string", "enum")
+    if isinstance(v, (bytes, bytearray)):
+        return t in ("bytes", "fixed")
+    if isinstance(v, dict):
+        return t in ("record", "map")
+    if isinstance(v, (list, tuple)):
+        return t == "array"
+    return False
+
+
+_PROMOTIONS = {
+    ("int", "long"), ("int", "float"), ("int", "double"),
+    ("long", "float"), ("long", "double"), ("float", "double"),
+    ("string", "bytes"), ("bytes", "string"),
+}
+
+
+def _read(writer_s, reader_s, r: _Reader):
+    """Decode per the WRITER schema, resolving into the READER schema
+    (Avro spec 'Schema Resolution')."""
+    wt, rt = _type_name(writer_s), _type_name(reader_s)
+    if wt == "union" and rt != "union":
+        branch = writer_s["branches"][r.long()]
+        return _read(branch, reader_s, r)
+    if rt == "union":
+        if wt == "union":
+            branch = writer_s["branches"][r.long()]
+        else:
+            branch = writer_s
+        bt = _type_name(branch)
+        for rb in reader_s["branches"]:
+            if _type_name(rb) == bt or (bt, _type_name(rb)) in _PROMOTIONS:
+                return _read(branch, rb, r)
+        raise ValueError(
+            f"writer branch {bt!r} not in reader union")
+    if wt != rt and (wt, rt) not in _PROMOTIONS:
+        raise ValueError(f"cannot resolve writer {wt!r} as reader {rt!r}")
+    if wt == "null":
+        return None
+    if wt == "boolean":
+        return r.raw(1) == b"\x01"
+    if wt in ("int", "long"):
+        v = r.long()
+        return float(v) if rt in ("float", "double") else v
+    if wt == "float":
+        return struct.unpack("<f", r.raw(4))[0]
+    if wt == "double":
+        return struct.unpack("<d", r.raw(8))[0]
+    if wt == "bytes":
+        b = r.raw(r.long())
+        return b.decode("utf-8") if rt == "string" else b
+    if wt == "string":
+        b = r.raw(r.long())
+        return b if rt == "bytes" else b.decode("utf-8")
+    if wt == "fixed":
+        return r.raw(writer_s["size"])
+    if wt == "enum":
+        sym = writer_s["symbols"][r.long()]
+        if sym not in reader_s["symbols"]:
+            if reader_s.get("default") is not None:
+                return reader_s["default"]
+            raise ValueError(f"enum symbol {sym!r} unknown to reader")
+        return sym
+    if wt == "array":
+        out = []
+        while True:
+            n = r.long()
+            if n == 0:
+                break
+            if n < 0:  # block with byte size prefix
+                n = -n
+                r.long()
+            for _ in range(n):
+                out.append(_read(writer_s["items"], reader_s["items"], r))
+        return out
+    if wt == "map":
+        out = {}
+        while True:
+            n = r.long()
+            if n == 0:
+                break
+            if n < 0:
+                n = -n
+                r.long()
+            for _ in range(n):
+                k = r.raw(r.long()).decode("utf-8")
+                out[k] = _read(writer_s["values"], reader_s["values"], r)
+        return out
+    if wt == "record":
+        reader_fields = {}
+        for f in reader_s["fields"]:
+            reader_fields[f["name"]] = f
+            for a in f.get("aliases", []):
+                reader_fields[a] = f
+        out = {}
+        seen = set()
+        for wf in writer_s["fields"]:
+            rf = reader_fields.get(wf["name"])
+            if rf is None:
+                _skip(wf["schema"], r)  # writer-only field
+                continue
+            out[rf["name"]] = _read(wf["schema"], rf["schema"], r)
+            seen.add(rf["name"])
+        for rf in reader_s["fields"]:
+            if rf["name"] in seen:
+                continue
+            if "default" not in rf:
+                raise ValueError(
+                    f"reader field {rf['name']!r} missing from writer "
+                    "data and has no default")
+            out[rf["name"]] = rf["default"]
+        return out
+    raise ValueError(f"unsupported Avro type {wt!r}")
+
+
+def _skip(s, r: _Reader) -> None:
+    t = _type_name(s)
+    if t == "null":
+        return
+    if t == "boolean":
+        r.raw(1)
+    elif t in ("int", "long", "enum"):
+        r.long()
+    elif t == "float":
+        r.raw(4)
+    elif t == "double":
+        r.raw(8)
+    elif t in ("bytes", "string"):
+        r.raw(r.long())
+    elif t == "fixed":
+        r.raw(s["size"])
+    elif t == "union":
+        _skip(s["branches"][r.long()], r)
+    elif t == "record":
+        for f in s["fields"]:
+            _skip(f["schema"], r)
+    elif t == "array":
+        while True:
+            n = r.long()
+            if n == 0:
+                break
+            if n < 0:
+                r.raw(r.long())
+                continue
+            for _ in range(n):
+                _skip(s["items"], r)
+    elif t == "map":
+        while True:
+            n = r.long()
+            if n == 0:
+                break
+            if n < 0:
+                r.raw(r.long())
+                continue
+            for _ in range(n):
+                r.raw(r.long())
+                _skip(s["values"], r)
+
+
+def encode_record(schema, datum: dict) -> bytes:
+    w = _Writer()
+    _write(schema, datum, w)
+    return w.getvalue()
+
+
+def decode_record(writer_schema, reader_schema, payload: bytes) -> dict:
+    return _read(writer_schema, reader_schema, _Reader(payload))
+
+
+# --------------------------------------------------------------------------
+# DDL integration: 'format' = 'avro'
+# --------------------------------------------------------------------------
+
+_SQL_TO_AVRO = {
+    "tinyint": "int", "smallint": "int", "int": "int", "integer": "int",
+    "bigint": "long", "float": "float", "double": "double",
+    "string": "string", "varchar": "string", "char": "string",
+    "boolean": "boolean", "bytes": "bytes", "binary": "bytes",
+    "timestamp": "long", "timestamp_ltz": "long", "date": "int",
+}
+
+
+def schema_from_ddl(name: str, columns: Sequence[str],
+                    types: Sequence[Optional[str]]):
+    """Derive a record schema from the DDL column list (the reference's
+    AvroSchemaConverter.convertToSchema role)."""
+    fields = []
+    for c, t in zip(columns, types):
+        base = (t or "string").lower().split("(")[0].strip()
+        avro_t = _SQL_TO_AVRO.get(base, "string")
+        fields.append({"name": c, "type": ["null", avro_t],
+                       "default": None})
+    return parse_schema({"type": "record", "name": name, "fields": fields})
+
+
+class AvroRowDeserializationSchema(DeserializationSchema):
+    """Single-record Avro binary payloads -> one typed columnar batch,
+    decoding with the WRITER schema resolved into the READER schema."""
+
+    def __init__(self, columns: Sequence[str],
+                 types: Sequence[Optional[str]],
+                 reader_schema, writer_schema=None,
+                 ignore_parse_errors: bool = False):
+        self.columns = list(columns)
+        self.dts = [_np_dtype(t) for t in types]
+        self.reader = reader_schema
+        self.writer = writer_schema or reader_schema
+        self.ignore = ignore_parse_errors
+
+    def deserialize_batch(self, raw: Sequence[bytes]) -> RecordBatch:
+        rows: List[tuple] = []
+        surviving: List[int] = []
+        for i, payload in enumerate(raw):
+            try:
+                d = decode_record(self.writer, self.reader, payload)
+                rows.append(tuple(d.get(c) for c in self.columns))
+            except Exception:
+                if not self.ignore:
+                    raise
+                continue
+            surviving.append(i)
+        self.last_surviving = surviving if len(surviving) != len(raw) \
+            else None
+        return RecordBatch(_columns_from_rows(rows, self.columns,
+                                              self.dts))
+
+
+class AvroRowSerializationSchema(SerializationSchema):
+    def __init__(self, columns: Sequence[str], schema):
+        self.columns = list(columns)
+        self.schema = schema
+
+    def serialize_batch(self, batch: RecordBatch) -> List[bytes]:
+        cols = [np.asarray(batch[c]) if c in batch.columns else None
+                for c in self.columns]
+        out = []
+        for i in range(len(batch)):
+            datum = {}
+            for c, col in zip(self.columns, cols):
+                v = None if col is None else col[i]
+                if isinstance(v, np.generic):
+                    v = v.item()
+                datum[c] = v
+            out.append(encode_record(self.schema, datum))
+        return out
+
+
+def _avro_factory(columns, types, options):
+    reader_json = options.get("avro.schema")
+    reader = parse_schema(reader_json) if reader_json else \
+        schema_from_ddl("row", columns, types)
+    writer_json = options.get("avro.writer-schema")
+    writer = parse_schema(writer_json) if writer_json else None
+    ignore = str(options.get("avro.ignore-parse-errors",
+                             "false")).lower() == "true"
+    return (AvroRowDeserializationSchema(columns, types, reader,
+                                         writer_schema=writer,
+                                         ignore_parse_errors=ignore),
+            AvroRowSerializationSchema(columns, reader))
+
+
+register_format("avro", _avro_factory)

@@ -38,6 +38,10 @@ def resolve_format(name: str, columns: Sequence[str],
                    options: Optional[dict] = None
                    ) -> Tuple["DeserializationSchema",
                               "SerializationSchema"]:
+    if name.lower() == "avro" and "avro" not in _FORMATS:
+        # self-registers on import; 'format' = 'avro' in DDL must not
+        # require a user-level import (same pattern as shuffle.service)
+        import flink_tpu.connectors.avro  # noqa: F401
     factory = _FORMATS.get(name.lower())
     if factory is None:
         raise ValueError(
