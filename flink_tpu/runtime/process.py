@@ -155,10 +155,12 @@ class ProcessContext(Collector):
     """Runtime context handed to process functions."""
 
     def __init__(self, timer_service: TimerService,
-                 state_store: Optional[KeyedStateStore] = None):
+                 state_store: Optional[KeyedStateStore] = None,
+                 aec=None):
         super().__init__()
         self._timers = timer_service
         self._store = state_store
+        self._aec = aec
 
     def timer_service(self) -> TimerService:
         return self._timers
@@ -172,6 +174,19 @@ class ProcessContext(Collector):
             raise RuntimeError(
                 "keyed state requires a KeyedStream (use key_by first)")
         return self._store.get_state(descriptor)
+
+    def async_state(self, descriptor):
+        """StateFuture-returning view of a keyed state (State V2 analog;
+        reference: runtime/state/v2/). Ops queue into the operator's
+        AsyncExecutionController and execute in coalesced waves — drained
+        automatically at the end of every invocation and before every
+        snapshot, or on any ``StateFuture.value()``."""
+        from flink_tpu.state.async_state import make_async_view
+
+        if self._aec is None:
+            raise RuntimeError(
+                "async state requires a keyed process operator")
+        return make_async_view(self._aec, self.state(descriptor))
 
 
 @public
@@ -264,10 +279,16 @@ class ProcessOperator(Operator):
         self.store = KeyedStateStore(
             self.state_capacity,
             clock=self._clock) if self.keyed else None
+        if self.keyed:
+            from flink_tpu.state.async_state import AsyncExecutionController
+
+            self.aec = AsyncExecutionController()
+        else:
+            self.aec = None
         self.fn.open(self._ctx())
 
     def _ctx(self) -> ProcessContext:
-        return ProcessContext(self.timer_service, self.store)
+        return ProcessContext(self.timer_service, self.store, aec=self.aec)
 
     def _drain_processing_time(self, ctx: ProcessContext) -> None:
         if self.timer_service.has_processing_time_timers():
@@ -276,10 +297,18 @@ class ProcessOperator(Operator):
             if len(keys):
                 self.fn.on_timer(keys, tss, ctx)
 
+    def _drain_async(self) -> None:
+        # every invocation boundary is a drain point: no async state op
+        # survives past the call that submitted it (reference:
+        # AsyncExecutionController.drainInflightRecords before barriers)
+        if self.aec is not None:
+            self.aec.drain()
+
     def process_batch(self, batch, input_index=0):
         ctx = self._ctx()
         self.fn.process_batch(batch, ctx)
         self._drain_processing_time(ctx)
+        self._drain_async()
         return ctx.out
 
     def process_watermark(self, watermark, input_index=0):
@@ -288,6 +317,7 @@ class ProcessOperator(Operator):
         if len(keys):
             self.fn.on_timer(keys, tss, ctx)
         self._drain_processing_time(ctx)
+        self._drain_async()
         if self.store is not None:
             # TTL sweep rides watermark advance (processing-time based;
             # the watermark is just the cadence, like the reference's
@@ -303,14 +333,17 @@ class ProcessOperator(Operator):
     def on_processing_time(self, now_ms: int):
         ctx = self._ctx()
         self._drain_processing_time(ctx)
+        self._drain_async()
         return ctx.out
 
     def close(self):
         ctx = self._ctx()
         self.fn.close(ctx)
+        self._drain_async()
         return ctx.out
 
     def snapshot_state(self):
+        self._drain_async()
         snap = {"timers": self.timer_service.snapshot()}
         if self.store is not None:
             snap["keyed_state"] = self.store.snapshot()
@@ -341,6 +374,7 @@ class CoProcessOperator(ProcessOperator):
         else:
             self.fn.process_batch2(batch, ctx)
         self._drain_processing_time(ctx)
+        self._drain_async()
         return ctx.out
 
 
@@ -365,6 +399,7 @@ class BroadcastProcessOperator(ProcessOperator):
         else:
             self.fn.process_batch(batch, ctx, self.broadcast_state)
         self._drain_processing_time(ctx)
+        self._drain_async()
         return ctx.out
 
     def snapshot_state(self):

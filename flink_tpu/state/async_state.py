@@ -1,0 +1,464 @@
+"""Async keyed state — StateFuture-returning handles for process functions.
+
+reference: State V2 (flink-runtime/.../runtime/state/v2/, 55 files) exposes
+StateFuture-returning Value/List/Map/Reducing states; the
+AsyncExecutionController (runtime/asyncprocessing/AsyncExecutionController.java:57)
+buffers StateRequests (batchSize/bufferTimeout, :67,364-369), serializes
+same-key accesses via KeyAccountingUnit, and executes batches through
+StateExecutor.executeBatchRequests (the ForSt backend groups them into one
+multiGet / write-batch — ForStStateExecutor.java:149).
+
+Batched re-design: the reference buffers *per-record scalar* requests to
+recover batching the record-at-a-time API destroyed. This engine is already
+batch-native — a single async op carries a whole key VECTOR — so the
+controller's job shifts one level up: coalesce *independent op vectors*
+into single fused kernels while preserving the reference's ordering
+contract (same-key ops serialize in submission order; disjoint-key ops
+merge freely). Ops queue into WAVES: an op joins the open wave unless one
+of its keys conflicts with an earlier op in that wave (read-after-write,
+write-after-read, or cross-kind write-after-write); a conflict seals the
+wave. At drain, each wave executes one vectorized kernel per
+(state, op-kind) group — N same-kind ops on disjoint keys cost one gather
+or one scatter regardless of N.
+
+Two executors sit under the same future API:
+- host states (ValueState/ReducingState/MapState of keyed_state.py) — the
+  win is kernel coalescing;
+- DeviceValueState — accumulators committed to the accelerator
+  (state.backend placement, backends.py); wave execution *dispatches*
+  gathers/scatters without blocking, so device latency overlaps host
+  processing exactly the way window fires already overlap
+  (runtime/pending.py). Only ``StateFuture.value()`` forces a transfer.
+
+Drain points follow the reference: end of every operator invocation and
+before every snapshot (AsyncExecutionController.drainInflightRecords) — a
+checkpoint never captures un-executed state ops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.core.annotations import public
+from flink_tpu.state.keyed_state import (
+    ListState,
+    MapState,
+    MapStateDescriptor,
+    ReducingState,
+    ReducingStateDescriptor,
+    ValueState,
+    ValueStateDescriptor,
+)
+
+# op kinds
+_GET, _PUT, _ADD, _CLEAR = "get", "put", "add", "clear"
+_READS = (_GET,)
+_WRITES = (_PUT, _ADD, _CLEAR)
+
+
+@public
+class StateFuture:
+    """Result of one async state op.
+
+    reference: api/common/state/v2/StateFuture.java — thenAccept /
+    thenApply composition; completion happens on the task thread at
+    drain, never concurrently with user code.
+    """
+
+    __slots__ = ("_controller", "_done", "_value", "_callbacks")
+
+    def __init__(self, controller: "AsyncExecutionController"):
+        self._controller = controller
+        self._done = False
+        self._value = None
+        self._callbacks: List[Tuple[Callable, "StateFuture"]] = []
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    def value(self):
+        """Force: drains the controller if this op hasn't executed yet.
+        Device-backed results materialize to host NumPy here (the one
+        place a device transfer is allowed to block)."""
+        if not self._done:
+            self._controller.drain()
+        v = self._value
+        if v is not None and not isinstance(v, np.ndarray) \
+                and hasattr(v, "__array__"):
+            v = np.asarray(v)  # force a lazily-sliced device array
+            self._value = v
+        return v
+
+    def then(self, fn: Callable[[Any], Any]) -> "StateFuture":
+        """Chain ``fn(result)``; returns a future for fn's return value.
+        Runs at completion on the task thread (reference: thenApply —
+        callbacks re-enqueued as mail on the mailbox thread)."""
+        out = StateFuture(self._controller)
+        if self._done:
+            out._complete(fn(self.value()))
+        else:
+            self._callbacks.append((fn, out))
+        return out
+
+    def _complete(self, value) -> None:
+        self._done = True
+        self._value = value
+        cbs, self._callbacks = self._callbacks, []
+        for fn, out in cbs:
+            out._complete(fn(self.value()))
+
+
+@dataclasses.dataclass
+class _Op:
+    state: Any          # executor adapter (async handle)
+    kind: str
+    key_ids: np.ndarray
+    payload: Any        # values (put/add) / map_keys tuple (map ops) / None
+    future: StateFuture
+
+
+class _Wave:
+    """One conflict-free group of ops: executes as one kernel per
+    (state, kind) group."""
+
+    def __init__(self):
+        self.ops: List[_Op] = []
+        # per-state key footprints for conflict checks
+        self._reads: Dict[int, set] = {}
+        self._writes: Dict[int, set] = {}       # keys written, any kind
+        self._write_kind: Dict[int, str] = {}   # state id -> sole write kind
+
+    def admits(self, op: _Op, keys: set) -> bool:
+        sid = id(op.state)
+        if op.kind in _READS:
+            # read-after-write in the same wave would see stale values
+            return not (self._writes.get(sid) and
+                        keys & self._writes[sid])
+        # writes: conflict with earlier reads (order would flip) and with
+        # earlier writes of a DIFFERENT kind (put vs add don't commute);
+        # same-kind writes merge — concatenation preserves submission
+        # order (NumPy scatter is last-wins in array order, ufunc.at
+        # accumulates), so duplicates stay correct.
+        if self._reads.get(sid) and keys & self._reads[sid]:
+            return False
+        if self._writes.get(sid) and self._write_kind.get(sid) != op.kind \
+                and keys & self._writes[sid]:
+            return False
+        return True
+
+    def add(self, op: _Op, keys: set) -> None:
+        sid = id(op.state)
+        if op.kind in _READS:
+            self._reads.setdefault(sid, set()).update(keys)
+        else:
+            self._writes.setdefault(sid, set()).update(keys)
+            self._write_kind[sid] = op.kind \
+                if self._write_kind.get(sid, op.kind) == op.kind else "mixed"
+        self.ops.append(op)
+
+
+class AsyncExecutionController:
+    """Buffers async state ops and executes them in coalesced waves.
+
+    reference: runtime/asyncprocessing/AsyncExecutionController.java:57
+    (StateRequestBuffer + KeyAccountingUnit + StateExecutor). ``stats``
+    counts ops/waves/kernel calls so tests can assert the coalescing
+    contract instead of trusting it.
+    """
+
+    def __init__(self):
+        self._waves: List[_Wave] = []
+        self.stats = {"ops": 0, "waves": 0, "kernel_calls": 0}
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, state, kind: str, key_ids, payload=None) -> StateFuture:
+        op = _Op(state, kind, np.atleast_1d(
+            np.asarray(key_ids, dtype=np.int64)), payload,
+            StateFuture(self))
+        keys = set(op.key_ids.tolist())
+        if not self._waves or not self._waves[-1].admits(op, keys):
+            self._waves.append(_Wave())
+        self._waves[-1].add(op, keys)
+        self.stats["ops"] += 1
+        return op.future
+
+    @property
+    def pending(self) -> int:
+        return sum(len(w.ops) for w in self._waves)
+
+    # -- execution -----------------------------------------------------------
+
+    def drain(self) -> None:
+        """Execute everything pending, in wave order. Callbacks may submit
+        new ops; the loop runs until the queue is empty (reference:
+        drainInflightRecords loops until allRequestsDone)."""
+        while self._waves:
+            waves, self._waves = self._waves, []
+            for wave in waves:
+                self._execute(wave)
+
+    def _execute(self, wave: _Wave) -> None:
+        self.stats["waves"] += 1
+        # group by (state, kind) in first-appearance order
+        groups: Dict[Tuple[int, str], List[_Op]] = {}
+        for op in wave.ops:
+            groups.setdefault((id(op.state), op.kind), []).append(op)
+        for ops in groups.values():
+            state, kind = ops[0].state, ops[0].kind
+            keys = np.concatenate([o.key_ids for o in ops])
+            self.stats["kernel_calls"] += 1
+            if kind == _GET:
+                res = state._exec_get(keys, ops)
+                # split the batched result back per op
+                offs = np.cumsum([len(o.key_ids) for o in ops])[:-1]
+                parts = (res if isinstance(res, list)
+                         else _split(res, offs))
+                for o, part in zip(ops, parts):
+                    o.future._complete(part)
+            elif kind == _PUT:
+                state._exec_put(keys, ops)
+                for o in ops:
+                    o.future._complete(None)
+            elif kind == _ADD:
+                state._exec_add(keys, ops)
+                for o in ops:
+                    o.future._complete(None)
+            else:  # _CLEAR
+                state._exec_clear(keys)
+                for o in ops:
+                    o.future._complete(None)
+
+
+def _split(arr, offsets):
+    return np.split(arr, offsets) if isinstance(arr, np.ndarray) \
+        else [arr[a:b] for a, b in _ranges(offsets, _len(arr))]
+
+
+def _ranges(offsets, n):
+    starts = [0] + list(offsets)
+    ends = list(offsets) + [n]
+    return zip(starts, ends)
+
+
+def _len(arr):
+    return arr.shape[0]
+
+
+def _concat_payload(ops: List[_Op]) -> np.ndarray:
+    return np.concatenate([
+        np.broadcast_to(np.asarray(o.payload), o.key_ids.shape)
+        for o in ops])
+
+
+# --------------------------------------------------------------------------
+# Async handles over the host states
+# --------------------------------------------------------------------------
+
+
+@public
+class AsyncValueState:
+    """StateFuture-returning view of a (host or device) value state.
+
+    reference: runtime/state/v2/ValueState.java — asyncValue()/
+    asyncUpdate(); here vectorized per the engine's batch contract.
+    """
+
+    def __init__(self, controller: AsyncExecutionController, sync: ValueState):
+        self._aec = controller
+        self._sync = sync
+
+    # async API
+    def get(self, key_ids) -> StateFuture:
+        return self._aec.submit(self, _GET, key_ids)
+
+    def put(self, key_ids, values) -> StateFuture:
+        return self._aec.submit(self, _PUT, key_ids, values)
+
+    def clear(self, key_ids) -> StateFuture:
+        return self._aec.submit(self, _CLEAR, key_ids)
+
+    # executor hooks (one vectorized sync call == one kernel)
+    def _exec_get(self, keys, ops):
+        return self._sync.get(keys)
+
+    def _exec_put(self, keys, ops):
+        self._sync.put(keys, _concat_payload(ops))
+
+    def _exec_clear(self, keys):
+        self._sync.clear(keys)
+
+
+@public
+class AsyncReducingState(AsyncValueState):
+    """reference: runtime/state/v2/ReducingState.java asyncAdd()."""
+
+    def add(self, key_ids, values) -> StateFuture:
+        return self._aec.submit(self, _ADD, key_ids, values)
+
+    def _exec_add(self, keys, ops):
+        self._sync.add(keys, _concat_payload(ops))
+
+
+@public
+class AsyncMapState:
+    """reference: runtime/state/v2/MapState.java asyncGet/asyncPut.
+    Vectorized over (key_id, map_key) pairs; executes through the host
+    MapState (variable-size state never hits the device), so the async
+    win here is ordering + batching with other states' ops, not kernels.
+    """
+
+    def __init__(self, controller: AsyncExecutionController, sync: MapState):
+        self._aec = controller
+        self._sync = sync
+
+    def get(self, key_ids, map_keys, default=None) -> StateFuture:
+        return self._aec.submit(self, _GET, key_ids,
+                                (list(map_keys), default))
+
+    def put(self, key_ids, map_keys, values) -> StateFuture:
+        return self._aec.submit(self, _PUT, key_ids,
+                                (list(map_keys), list(values)))
+
+    def clear(self, key_ids) -> StateFuture:
+        return self._aec.submit(self, _CLEAR, key_ids)
+
+    def _exec_get(self, keys, ops):
+        out = []
+        for o in ops:
+            mkeys, default = o.payload
+            out.append([self._sync.get(int(k), mk, default)
+                        for k, mk in zip(o.key_ids.tolist(), mkeys)])
+        return out
+
+    def _exec_put(self, keys, ops):
+        for o in ops:
+            mkeys, vals = o.payload
+            for k, mk, v in zip(o.key_ids.tolist(), mkeys, vals):
+                self._sync.put(k, mk, v)
+
+    def _exec_clear(self, keys):
+        self._sync.clear(keys)
+
+
+# --------------------------------------------------------------------------
+# Device-resident value state
+# --------------------------------------------------------------------------
+
+
+class DeviceValueState(ValueState):
+    """ValueState whose dense array lives on the accelerator.
+
+    The snapshot/restore/grow/TTL machinery is inherited; only the
+    storage and the batched kernels differ: values are a jax array
+    committed to the state backend's placement (backends.py), gathers and
+    scatters are jitted device kernels, and — the point — gathers
+    DISPATCH asynchronously. A wave of async gets costs one device
+    round-trip that overlaps whatever the host does next; results only
+    materialize at ``StateFuture.value()``.
+
+    reference: the ForSt backend's executeBatchRequests
+    (ForStStateExecutor.java:149) — one multiGet per request batch
+    against storage that is not the JVM heap.
+    """
+
+    def __init__(self, store, desc: ValueStateDescriptor, device=None):
+        if getattr(desc, "ttl", None) is not None:
+            raise ValueError(
+                "DeviceValueState does not support TTL yet; keep TTL'd "
+                "state on the host backend (state.backend=host-heap)")
+        super().__init__(store, dataclasses.replace(desc, ttl=None))
+        import jax
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+        dtype = np.dtype(desc.dtype)
+        arr = jnp.full(store.capacity, desc.default, dtype=dtype)
+        self._device = device
+        self._dvals = jax.device_put(arr, device) if device is not None \
+            else arr
+        self._gather = jax.jit(
+            lambda v, s: jnp.take(v, s, axis=0, mode="clip"))
+        self._scatter = jax.jit(
+            lambda v, s, x: v.at[s].set(x), donate_argnums=0)
+        self._host_dirty = False  # host mirror (self._values) staleness
+
+    # -- device kernels ------------------------------------------------------
+
+    def _slots(self, key_ids):
+        return self._store.slots(key_ids)
+
+    def get(self, key_ids):
+        """Sync get: gather + materialize (blocks on the device)."""
+        return np.asarray(self._gather(self._dvals, self._slots(key_ids)))
+
+    def put(self, key_ids, values) -> None:
+        vals = np.broadcast_to(
+            np.asarray(values, dtype=self._values.dtype),
+            np.atleast_1d(np.asarray(key_ids)).shape)
+        self._dvals = self._scatter(self._dvals, self._slots(key_ids), vals)
+        self._host_dirty = True
+
+    def clear(self, key_ids) -> None:
+        self.put(key_ids, self.desc.default)
+
+    # executor hooks: gather returns the DEVICE array (no block); the
+    # controller slices it per op and only value() forces a transfer.
+    def _exec_get(self, keys, ops):
+        return self._gather(self._dvals, self._slots(keys))
+
+    def _exec_put(self, keys, ops):
+        self.put(keys, _concat_payload(ops))
+
+    def _exec_clear(self, keys):
+        self.put(keys, self.desc.default)
+
+    # -- growth / checkpoint -------------------------------------------------
+
+    def _on_grow(self, old: int, new: int) -> None:
+        super()._on_grow(old, new)
+        jnp = self._jnp
+        grown = jnp.full(new, self.desc.default,
+                         dtype=self._values.dtype)
+        self._dvals = grown.at[:old].set(self._dvals)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"values": np.asarray(self._dvals).copy()}
+
+    def restore(self, snap, slot_remap=None) -> None:
+        super().restore(snap, slot_remap=slot_remap)
+        import jax
+
+        arr = self._jnp.asarray(self._values)
+        self._dvals = jax.device_put(arr, self._device) \
+            if self._device is not None else arr
+
+
+@public
+@dataclasses.dataclass(frozen=True)
+class DeviceValueStateDescriptor(ValueStateDescriptor):
+    """ValueStateDescriptor whose storage commits to the accelerator."""
+
+
+# register with the store's descriptor dispatch
+from flink_tpu.state import keyed_state as _ks  # noqa: E402
+
+_ks._STATE_TYPES[DeviceValueStateDescriptor] = DeviceValueState
+
+
+def make_async_view(controller: AsyncExecutionController, sync_state):
+    """Wrap a sync state handle in its async view."""
+    if isinstance(sync_state, ReducingState):
+        return AsyncReducingState(controller, sync_state)
+    if isinstance(sync_state, ValueState):  # incl. DeviceValueState
+        return AsyncValueState(controller, sync_state)
+    if isinstance(sync_state, MapState):
+        return AsyncMapState(controller, sync_state)
+    raise TypeError(
+        f"no async view for state type {type(sync_state).__name__} "
+        "(ListState stays sync: append-only host logs gain nothing "
+        "from coalescing)")

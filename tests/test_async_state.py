@@ -1,0 +1,293 @@
+"""Async keyed state (State V2 analog) — ordering, coalescing, device
+path, checkpoint drain.
+
+reference contract: runtime/asyncprocessing/AsyncExecutionController.java
+(same-key ops serialize in submission order via KeyAccountingUnit;
+different-key ops batch into one executor call; everything drains before a
+snapshot) and runtime/state/v2/ (StateFuture-returning handles).
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
+from flink_tpu.runtime.process import ProcessFunction, ProcessOperator
+from flink_tpu.state.async_state import (
+    AsyncExecutionController,
+    DeviceValueState,
+    DeviceValueStateDescriptor,
+    make_async_view,
+)
+from flink_tpu.state.keyed_state import (
+    KeyedStateStore,
+    MapStateDescriptor,
+    ReducingStateDescriptor,
+    ValueStateDescriptor,
+)
+
+
+def _aec_and_state(desc=None):
+    aec = AsyncExecutionController()
+    store = KeyedStateStore(64)
+    desc = desc or ValueStateDescriptor("v", np.int64, 0)
+    return aec, make_async_view(aec, store.get_state(desc)), store
+
+
+# -- ordering ---------------------------------------------------------------
+
+
+def test_same_key_ops_serialize_in_submission_order():
+    aec, st, _ = _aec_and_state()
+    st.put([1], 5)
+    f1 = st.get([1])
+    st.put([1], 7)
+    f2 = st.get([1])
+    assert f1.value() == [5]      # sees the first put, not the second
+    assert f2.value() == [7]
+    # four ops on one key cannot coalesce: four waves
+    assert aec.stats["waves"] == 4
+
+
+def test_read_before_write_sees_old_value():
+    aec, st, _ = _aec_and_state()
+    st.put([3], 10)
+    aec.drain()
+    f_old = st.get([3])
+    st.put([3], 20)
+    f_new = st.get([3])
+    assert f_old.value() == [10]
+    assert f_new.value() == [20]
+
+
+def test_cross_key_gets_coalesce_into_one_kernel():
+    aec, st, _ = _aec_and_state()
+    futs = [st.get([k, k + 100]) for k in range(10)]  # 10 disjoint gets
+    aec.drain()
+    assert aec.stats["ops"] == 10
+    assert aec.stats["waves"] == 1
+    assert aec.stats["kernel_calls"] == 1             # ONE batched gather
+    assert all(np.array_equal(f.value(), [0, 0]) for f in futs)
+
+
+def test_cross_key_puts_coalesce_then_gets_read_them():
+    aec, st, _ = _aec_and_state()
+    for k in range(8):
+        st.put([k], k * 11)
+    futs = [st.get([k]) for k in range(8)]
+    aec.drain()
+    # wave 1: all puts (one scatter); wave 2: all gets (one gather)
+    assert aec.stats["waves"] == 2
+    assert aec.stats["kernel_calls"] == 2
+    assert [int(f.value()[0]) for f in futs] == [k * 11 for k in range(8)]
+
+
+def test_same_kind_writes_to_same_key_merge_last_wins():
+    aec, st, _ = _aec_and_state()
+    st.put([5], 1)
+    st.put([5], 2)   # same kind, same key: merges, submission order holds
+    f = st.get([5])
+    assert f.value() == [2]
+    assert aec.stats["waves"] == 2  # puts merged into one wave
+
+
+def test_reducing_adds_accumulate_across_coalesced_ops():
+    desc = ReducingStateDescriptor("r", np.add, np.int64, 0)
+    aec, st, _ = _aec_and_state(desc)
+    for _ in range(5):
+        st.add([7], 3)           # same key, same kind: one wave, in order
+    f = st.get([7])
+    assert f.value() == [15]
+    assert aec.stats["waves"] == 2
+
+
+def test_put_then_add_same_key_do_not_commute_so_split_waves():
+    desc = ReducingStateDescriptor("r", np.add, np.int64, 0)
+    aec, st, _ = _aec_and_state(desc)
+    st.put([2], 100)
+    st.add([2], 1)
+    assert st.get([2]).value() == [101]
+    assert aec.stats["waves"] >= 3
+
+
+# -- futures ----------------------------------------------------------------
+
+
+def test_value_forces_drain_lazily():
+    aec, st, _ = _aec_and_state()
+    f = st.get([1])
+    assert not f.done and aec.pending == 1
+    assert np.array_equal(f.value(), [0])
+    assert f.done and aec.pending == 0
+
+
+def test_then_chains_and_may_submit_more_ops():
+    aec, st, _ = _aec_and_state()
+    st.put([1], 41)
+    # callback issues a follow-up write; drain loops until empty
+    st.get([1]).then(lambda v: st.put([2], int(v[0]) + 1))
+    aec.drain()
+    assert st.get([2]).value() == [42]
+
+
+def test_then_on_done_future_runs_immediately():
+    aec, st, _ = _aec_and_state()
+    f = st.get([1])
+    aec.drain()
+    seen = []
+    f.then(lambda v: seen.append(int(v[0])))
+    assert seen == [0]
+
+
+# -- map state --------------------------------------------------------------
+
+
+def test_async_map_state_orders_and_reads():
+    aec = AsyncExecutionController()
+    store = KeyedStateStore(64)
+    st = make_async_view(aec, store.get_state(MapStateDescriptor("m")))
+    st.put([1, 2], ["a", "a"], [10, 20])
+    f = st.get([1, 2, 3], ["a", "a", "a"], default=-1)
+    assert f.value() == [10, 20, -1]
+
+
+# -- equality: async == sync on a random op sequence ------------------------
+
+
+def test_async_matches_sync_on_random_sequence():
+    rng = np.random.default_rng(7)
+    aec = AsyncExecutionController()
+    store_a, store_s = KeyedStateStore(256), KeyedStateStore(256)
+    desc = ValueStateDescriptor("v", np.float64, 0.0)
+    a = make_async_view(aec, store_a.get_state(desc))
+    s = store_s.get_state(desc)
+    futs = []
+    for _ in range(200):
+        keys = rng.integers(0, 30, size=rng.integers(1, 6))
+        if rng.random() < 0.5:
+            vals = rng.normal(size=len(keys))
+            a.put(keys, vals)
+            s.put(keys, vals)
+        else:
+            futs.append((a.get(keys), s.get(keys).copy()))
+    aec.drain()
+    for fa, expect in futs:
+        np.testing.assert_allclose(fa.value(), expect)
+
+
+# -- device path ------------------------------------------------------------
+
+
+def test_device_value_state_matches_host_and_defers_transfer():
+    aec = AsyncExecutionController()
+    store = KeyedStateStore(128)
+    dd = DeviceValueStateDescriptor("dv", np.float32, 0.0)
+    dv = make_async_view(aec, store.get_state(dd))
+    assert isinstance(store.get_state(dd), DeviceValueState)
+    dv.put(np.arange(16), np.arange(16, dtype=np.float32) * 2)
+    f = dv.get(np.arange(16))
+    aec.drain()
+    # completed, but the result may still be a device array: value()
+    # materializes it
+    assert f.done
+    np.testing.assert_allclose(f.value(), np.arange(16) * 2.0)
+
+
+def test_device_state_checkpoint_restore_roundtrip():
+    store = KeyedStateStore(64)
+    dd = DeviceValueStateDescriptor("dv", np.int64, 0)
+    st = store.get_state(dd)
+    st.put(np.array([3, 5, 9]), np.array([30, 50, 90]))
+    snap = store.snapshot()
+
+    store2 = KeyedStateStore(64)
+    store2.restore(snap)
+    st2 = store2.get_state(dd)
+    assert isinstance(st2, DeviceValueState)
+    np.testing.assert_array_equal(
+        st2.get(np.array([3, 5, 9])), [30, 50, 90])
+
+
+def test_device_state_grows_with_index():
+    store = KeyedStateStore(8)
+    dd = DeviceValueStateDescriptor("dv", np.int64, -1)
+    st = store.get_state(dd)
+    keys = np.arange(100)
+    st.put(keys, keys * 3)
+    np.testing.assert_array_equal(st.get(keys), keys * 3)
+
+
+def test_device_state_rejects_ttl():
+    from flink_tpu.state.ttl import StateTtlConfig
+
+    store = KeyedStateStore(8)
+    dd = DeviceValueStateDescriptor(
+        "dv", np.int64, 0, ttl=StateTtlConfig(1000))
+    with pytest.raises(ValueError, match="TTL"):
+        store.get_state(dd)
+
+
+# -- operator integration ---------------------------------------------------
+
+
+class _AsyncCounter(ProcessFunction):
+    """Counts per key with async state; emits nothing until on_timer."""
+
+    def open(self, ctx):
+        self.desc = ReducingStateDescriptor("n", np.add, np.int64, 0)
+
+    def process_batch(self, batch, ctx):
+        st = ctx.async_state(self.desc)
+        keys = batch[KEY_ID_FIELD]
+        st.add(keys, np.ones(len(keys), dtype=np.int64))
+        ctx.timer_service().register_event_time_timers(
+            keys, np.full(len(keys), 100))
+
+    def on_timer(self, key_ids, timestamps, ctx):
+        counts = ctx.async_state(self.desc).get(key_ids)
+        ctx.collect(RecordBatch({
+            KEY_ID_FIELD: key_ids,
+            TIMESTAMP_FIELD: timestamps,
+            "count": counts.value(),
+        }))
+
+
+def _batch(keys, ts=0):
+    keys = np.asarray(keys, dtype=np.int64)
+    return RecordBatch({
+        KEY_ID_FIELD: keys,
+        TIMESTAMP_FIELD: np.full(len(keys), ts, dtype=np.int64),
+    })
+
+
+def test_process_operator_async_state_end_to_end():
+    op = ProcessOperator(_AsyncCounter(), keyed=True)
+    op.open(None)
+    op.process_batch(_batch([1, 2, 1, 1, 2, 3]))
+    op.process_batch(_batch([1, 3]))
+    outs = op.process_watermark(200)
+    assert len(outs) == 1
+    got = dict(zip(outs[0][KEY_ID_FIELD].tolist(),
+                   outs[0]["count"].tolist()))
+    assert got == {1: 4, 2: 2, 3: 2}
+    # invocation boundaries drained everything
+    assert op.aec.pending == 0
+
+
+def test_snapshot_drains_pending_async_ops():
+    op = ProcessOperator(_AsyncCounter(), keyed=True)
+    op.open(None)
+    op.process_batch(_batch([5, 5, 6]))
+    # simulate ops submitted but not yet drained (mid-invocation barrier)
+    st = op._ctx().async_state(
+        ReducingStateDescriptor("n", np.add, np.int64, 0))
+    st.add(np.array([5]), np.array([10]))
+    assert op.aec.pending == 1
+    snap = op.snapshot_state()
+    assert op.aec.pending == 0  # drained before capture
+
+    op2 = ProcessOperator(_AsyncCounter(), keyed=True)
+    op2.open(None)
+    op2.restore_state(snap)
+    st2 = op2._ctx().async_state(
+        ReducingStateDescriptor("n", np.add, np.int64, 0))
+    assert st2.get(np.array([5])).value() == [12]  # 2 adds + the 10
