@@ -270,6 +270,16 @@ class DeploymentOptions:
 
 
 class StateOptions:
+    TABLE_EXEC_OVER_ENGINE = ConfigOption(
+        "table.exec.over.engine", default="auto", type=str,
+        description="Compute engine for OVER windowed aggregations: "
+        "'device' = one fused jitted kernel computes every frame of "
+        "every key per fire (segmented scans + monotonicized "
+        "searchsorted, runtime/over_device.py); 'host' = per-key-segment "
+        "NumPy prefix scans (runtime/over_agg.py); 'auto' (default) = "
+        "device when the frame family supports it (bounded RANGE "
+        "MIN/MAX stays host). Reference operators: "
+        "flink-table-runtime/.../over/RowTimeRowsBoundedPrecedingFunction.java:1.")
     TABLE_EXEC_STATE_TTL = ConfigOption(
         "table.exec.state.ttl", default=0, type=int,
         description="Idle-state retention for SQL operators, in ms: a "

@@ -1,0 +1,360 @@
+"""Device OVER aggregation — every frame of every key in one fused kernel.
+
+reference: the flink-table-runtime over-window functions
+(RowTimeRowsBoundedPrecedingFunction.java:1,
+RowTimeRangeBoundedPrecedingFunction.java,
+RowTimeRangeUnboundedPrecedingFunction.java) process one row at a time
+against per-key MapState frame buffers.
+
+Re-design: the host engine (over_agg.py) already collapsed that to one
+vectorized pass per key segment — but it still loops Python/NumPy per
+key. This engine removes the loop: one jitted XLA kernel computes every
+frame of every key in the fire:
+
+- segments ride a boundary-flag column (no key values enter the kernel);
+- SUM / COUNT / AVG: global prefix sums, frame totals by gather
+  (``cs[end] - cs[start]``) — segment bases cancel;
+- ROWS MIN/MAX: a segmented running-min (``lax.associative_scan`` with a
+  (flag, value) combiner) covers frames clipped at the segment start;
+  full-width frames use the classic two-overlapping-power-of-two-block
+  trick (static window => static shift/depth, fully unrolled by XLA);
+- RANGE bounds: timestamps are monotonicized across segments
+  (``g = seg_idx * 2^41 + ts_rel``) so ONE global ``searchsorted``
+  yields every per-segment frame bound; peers (equal rowtime) fall out
+  of the right-bound search;
+- UNBOUNDED accumulators are synthetic context rows (value = running
+  aggregate, weight = running count) prepended to their segment, so
+  carry-over costs nothing in the kernel.
+
+Context rows (the last ``n`` rows / interval tail per key, or the
+accumulator rows) live in FLAT host arrays, filtered per fire with
+``np.isin`` and merged back vectorized — no per-key Python anywhere.
+
+Falls back to the host engine (engine='host' or unsupported shapes —
+bounded RANGE MIN/MAX, oversized timestamp spans) at plan time.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
+from flink_tpu.runtime.over_agg import OverAggOperator, OverSpec
+
+#: per-segment timestamp offset for the monotonicized RANGE search; spans
+#: (ts range + preceding) must stay below it — guarded at fire time
+_TS_OFFSET = np.int64(1) << 41
+
+_SUMLIKE = ("SUM", "AVG", "COUNT")
+
+
+def device_supported(specs: List[OverSpec], mode: str,
+                     preceding: Optional[int]) -> bool:
+    """Bounded RANGE MIN/MAX needs variable-width window reductions —
+    the one frame family without a clean scan/gather form; keep it on
+    the host engine."""
+    if mode == "RANGE" and preceding is not None:
+        return all(f in _SUMLIKE for f, _, _ in specs)
+    return True
+
+
+def _floor_log2(w: int) -> int:
+    return max(w.bit_length() - 1, 0)
+
+
+def _build_kernel(funcs: Tuple[str, ...], mode: str,
+                  preceding: Optional[int]):
+    """Returns jit(boundary, seg_start, starts, ends, peer_last,
+    vals[S,n], wts[S,n]) -> (outs[S,n], run_sums[S,n], run_cnts[S,n]).
+
+    Index arrays (frame bounds, peer positions, segment starts) arrive
+    precomputed from the host — they are int64 searchsorted/accumulate
+    over tiny arrays, which NumPy does in microseconds, while the
+    float scans/gathers (the FLOP- and bandwidth-heavy part) fuse into
+    one XLA program. This split also sidesteps 32-bit-int truncation
+    under the default JAX_ENABLE_X64=0."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    unbounded = preceding is None
+
+    def seg_scan(op, boundary, x):
+        """Segmented running reduce: op over each segment prefix."""
+
+        def combine(a, b):
+            f1, v1 = a
+            f2, v2 = b
+            return f1 | f2, jnp.where(f2, v2, op(v1, v2))
+
+        _, out = lax.associative_scan(combine, (boundary, x))
+        return out
+
+    def kernel(boundary, seg_start, starts, ends, peer_last, vals, wts):
+        n = boundary.shape[0]
+        idx = jnp.arange(n)
+        outs, run_sums, run_cnts = [], [], []
+        for i, func in enumerate(funcs):
+            v, w = vals[i], wts[i]
+            cs = jnp.concatenate([jnp.zeros(1, v.dtype), jnp.cumsum(v)])
+            cw = jnp.concatenate([jnp.zeros(1, w.dtype), jnp.cumsum(w)])
+            if unbounded:
+                # prefix aggregate from segment start (bases cancel via
+                # the gather at seg_start); peers via peer_last gather
+                run_s = jnp.take(cs, idx + 1) - jnp.take(cs, seg_start)
+                run_c = jnp.take(cw, idx + 1) - jnp.take(cw, seg_start)
+                if func in _SUMLIKE:
+                    row = (run_s if func == "SUM"
+                           else run_c if func == "COUNT"
+                           else run_s / run_c)
+                else:
+                    op = jnp.minimum if func == "MIN" else jnp.maximum
+                    row = seg_scan(op, boundary, v)
+                outs.append(jnp.take(row, peer_last))
+                run_sums.append(row if func in ("MIN", "MAX") else run_s)
+                run_cnts.append(run_c)
+            else:
+                if func in _SUMLIKE:
+                    tot = jnp.take(cs, ends) - jnp.take(cs, starts)
+                    cnt = jnp.take(cw, ends) - jnp.take(cw, starts)
+                    outs.append(tot if func == "SUM"
+                                else cnt if func == "COUNT"
+                                else tot / cnt)
+                else:  # ROWS MIN/MAX (RANGE MIN/MAX is host-only)
+                    op = jnp.minimum if func == "MIN" else jnp.maximum
+                    ident = np.inf if func == "MIN" else -np.inf
+                    run = seg_scan(op, boundary, v)
+                    wwin = preceding + 1
+                    k = _floor_log2(wwin)
+                    # m covers [j - 2^k + 1, j] after k doubling steps
+                    m = v
+                    for step in range(k):
+                        sh = 1 << step
+                        m = op(m, jnp.concatenate(
+                            [jnp.full(sh, ident, m.dtype), m[:-sh]]))
+                    rest = wwin - (1 << k)
+                    two_block = op(m, jnp.take(
+                        m, jnp.maximum(idx - rest, 0)))
+                    pos = idx - seg_start
+                    outs.append(jnp.where(pos >= wwin - 1,
+                                          two_block, run))
+                run_sums.append(jnp.take(cs, idx + 1))
+                run_cnts.append(jnp.take(cw, idx + 1))
+        return (jnp.stack(outs), jnp.stack(run_sums),
+                jnp.stack(run_cnts))
+
+    return jax.jit(kernel)
+
+
+class DeviceOverAggOperator(OverAggOperator):
+    """OverAggOperator with the fused device compute path.
+
+    Inherits ingest/late-row/watermark handling; replaces ``_compute``
+    and keeps context in flat arrays (kid, ts, per-spec val/weight)
+    instead of per-key dicts.
+    """
+
+    name = "over_agg_device"
+
+    def __init__(self, key_field: str, specs: List[OverSpec],
+                 mode: str = "ROWS", preceding: Optional[int] = None):
+        super().__init__(key_field, specs, mode=mode, preceding=preceding)
+        if not device_supported(specs, mode, preceding):
+            raise ValueError(
+                "bounded RANGE MIN/MAX has no device form — use the "
+                "host engine (table.exec.over.engine=host)")
+        S = len(specs)
+        self._ctx_kid = np.empty(0, dtype=np.int64)
+        self._ctx_ts = np.empty(0, dtype=np.int64)
+        self._ctx_val = [np.empty(0) for _ in range(S)]
+        self._ctx_wt = [np.empty(0) for _ in range(S)]
+        self._fallback = False
+        self._kernel = _build_kernel(
+            tuple(f for f, _, _ in specs), mode, preceding)
+
+    def _degrade_to_host(self) -> None:
+        if not self._fallback:
+            self._fallback = True
+            for k in np.unique(self._ctx_kid).tolist():
+                mask = self._ctx_kid == k
+                if self.preceding is None:
+                    self._accs[int(k)] = [
+                        (float(self._ctx_val[i][mask][0]),
+                         float(self._ctx_wt[i][mask][0]))
+                        for i in range(len(self.specs))]
+                else:
+                    o = np.argsort(self._ctx_ts[mask], kind="stable")
+                    ctx = {"ts": self._ctx_ts[mask][o]}
+                    for i in range(len(self.specs)):
+                        ctx[f"v{i}"] = self._ctx_val[i][mask][o]
+                    self._context[int(k)] = ctx
+            self._ctx_kid = np.empty(0, dtype=np.int64)
+            self._ctx_ts = np.empty(0, dtype=np.int64)
+            self._ctx_val = [np.empty(0) for _ in self.specs]
+            self._ctx_wt = [np.empty(0) for _ in self.specs]
+
+    # ------------------------------------------------------------ compute
+
+    def _compute(self, ready: RecordBatch) -> Optional[RecordBatch]:
+        n = len(ready)
+        S = len(self.specs)
+        kid = self._key_ids(ready)
+        ts = np.asarray(ready.timestamps, dtype=np.int64)
+        order = np.lexsort((ts, kid))
+        ready = ready.take(order)
+        kid, ts = kid[order], ts[order]
+        vals = self._arg_values(ready, n)
+        wts = [np.ones(n) for _ in range(S)]
+
+        # pull context rows of the keys present in this fire
+        hit = np.isin(self._ctx_kid, kid)
+        c_kid, c_ts = self._ctx_kid[hit], self._ctx_ts[hit]
+        c_val = [v[hit] for v in self._ctx_val]
+        c_wt = [w[hit] for w in self._ctx_wt]
+
+        all_kid = np.concatenate([c_kid, kid])
+        all_ts = np.concatenate([c_ts, ts])
+        is_new = np.r_[np.zeros(len(c_kid), bool), np.ones(n, bool)]
+        # context ts <= emitted watermark < new-row ts, so a stable sort
+        # by (kid, ts) lands context first and keeps the emitted rows in
+        # ready order
+        o2 = np.lexsort((all_ts, all_kid))
+        all_kid, all_ts, is_new = all_kid[o2], all_ts[o2], is_new[o2]
+        all_val = [np.concatenate([cv, v])[o2]
+                   for cv, v in zip(c_val, vals)]
+        all_wt = [np.concatenate([cw, w])[o2] for cw, w in zip(c_wt, wts)]
+
+        m = len(all_kid)
+        boundary = np.r_[True, all_kid[1:] != all_kid[:-1]]
+        ts_rel = all_ts - all_ts.min() + 1
+        if self._fallback or (self.mode == "RANGE" and (
+                int(ts_rel.max()) + (self.preceding or 0) >= _TS_OFFSET
+                or int(boundary.sum()) >= (1 << 21))):
+            # the fire exceeds the monotonicized search's span budget
+            # (ts range + preceding >= 2^41, or >= 2M segments): degrade
+            # PERMANENTLY to the host engine, converting flat context to
+            # its per-key form first so no frame history is lost
+            self._degrade_to_host()
+            return super()._compute(ready)
+
+        # host-side index arrays (vectorized int64; see _build_kernel)
+        idx = np.arange(m, dtype=np.int64)
+        seg_start = np.maximum.accumulate(np.where(boundary, idx, 0))
+        if self.mode == "RANGE":
+            # monotonicize timestamps across segments so ONE global
+            # searchsorted yields every per-segment frame bound
+            g = np.cumsum(boundary.astype(np.int64)) * _TS_OFFSET + ts_rel
+            ends = np.searchsorted(g, g, side="right")
+            starts = (np.searchsorted(
+                g, g - np.int64(self.preceding), side="left")
+                if self.preceding is not None else idx * 0)
+            peer_last = ends - 1
+        else:
+            ends = idx + 1
+            starts = (np.maximum(idx - self.preceding, seg_start)
+                      if self.preceding is not None else idx * 0)
+            peer_last = idx
+
+        # pad to a power of two (bounded compilation count); the pad is
+        # its own trailing segment and never emitted
+        mp = max(1 << math.ceil(math.log2(max(m, 16))), 16)
+        pad = mp - m
+
+        def p(a, fill=0):
+            return np.r_[a, np.full(pad, fill, dtype=a.dtype)] \
+                if pad else a
+
+        boundary_p = np.r_[boundary, np.zeros(pad, bool)]
+        if pad:
+            boundary_p[m] = True
+        pad_idx = np.arange(m, mp, dtype=np.int64)
+        i32 = np.int32
+        outs, run_s, run_c = self._kernel(
+            boundary_p,
+            np.r_[seg_start, pad_idx].astype(i32),
+            np.r_[starts, pad_idx].astype(i32),
+            np.r_[ends, pad_idx + 1].astype(i32),
+            np.r_[peer_last, pad_idx].astype(i32),
+            np.stack([p(v) for v in all_val]),
+            np.stack([p(w) for w in all_wt]))
+        outs = np.asarray(outs)[:, :m]
+
+        out = ready
+        for (_, _, out_name), col in zip(self.specs, outs):
+            out = out.with_column(out_name, col[is_new])
+
+        self._update_context(all_kid, all_ts, all_val, boundary,
+                             np.asarray(run_s)[:, :m],
+                             np.asarray(run_c)[:, :m], hit)
+        return out
+
+    # ------------------------------------------------------- context upkeep
+
+    def _update_context(self, all_kid, all_ts, all_val, boundary,
+                        run_s, run_c, hit) -> None:
+        m = len(all_kid)
+        seg_last = np.r_[np.flatnonzero(boundary)[1:] - 1, m - 1]
+        if self.preceding is None:
+            # one accumulator row per key: value = running aggregate at
+            # the segment's last row, weight = running count; ts below
+            # every real row so it sorts first next fire
+            keep_kid = all_kid[seg_last]
+            keep_ts = np.full(len(seg_last), -(1 << 60), dtype=np.int64)
+            keep_val = [run_s[i][seg_last] for i in range(len(self.specs))]
+            keep_wt = [run_c[i][seg_last] for i in range(len(self.specs))]
+        else:
+            # broadcast each segment's last index over its rows
+            starts = np.flatnonzero(boundary)
+            lengths = np.diff(np.r_[starts, m])
+            seg_end = np.repeat(seg_last, lengths)
+            if self.mode == "ROWS":
+                # the last `preceding` rows of each segment stay in reach
+                keep = (seg_end - np.arange(m)) < self.preceding
+            else:
+                keep = all_ts >= all_ts[seg_end] - self.preceding
+            keep_kid = all_kid[keep]
+            keep_ts = all_ts[keep]
+            keep_val = [v[keep] for v in all_val]
+            keep_wt = [np.ones(int(keep.sum()))
+                       for _ in range(len(self.specs))]
+        # merge with untouched context (keys absent from this fire)
+        miss = ~hit
+        self._ctx_kid = np.concatenate([self._ctx_kid[miss], keep_kid])
+        self._ctx_ts = np.concatenate([self._ctx_ts[miss], keep_ts])
+        self._ctx_val = [np.concatenate([cv[miss], kv])
+                         for cv, kv in zip(self._ctx_val, keep_val)]
+        self._ctx_wt = [np.concatenate([cw[miss], kw])
+                        for cw, kw in zip(self._ctx_wt, keep_wt)]
+
+    # --------------------------------------------------------------- state
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        snap = super().snapshot_state()
+        snap["over_device_ctx"] = {
+            "kid": self._ctx_kid.copy(),
+            "ts": self._ctx_ts.copy(),
+            "val": [v.copy() for v in self._ctx_val],
+            "wt": [w.copy() for w in self._ctx_wt],
+        }
+        return snap
+
+    def restore_state(self, state: Dict[str, Any],
+                      key_group_filter=None) -> None:
+        super().restore_state(state, key_group_filter=key_group_filter)
+        ctx = state.get("over_device_ctx")
+        if ctx is None:
+            return
+        kid = np.asarray(ctx["kid"], dtype=np.int64)
+        keep = np.ones(len(kid), bool)
+        if key_group_filter is not None and len(kid):
+            from flink_tpu.state.keygroups import assign_key_groups
+
+            groups = assign_key_groups(kid, self.max_parallelism)
+            keep = np.isin(groups, np.asarray(sorted(key_group_filter)))
+        self._ctx_kid = kid[keep]
+        self._ctx_ts = np.asarray(ctx["ts"], dtype=np.int64)[keep]
+        self._ctx_val = [np.asarray(v)[keep] for v in ctx["val"]]
+        self._ctx_wt = [np.asarray(w)[keep] for w in ctx["wt"]]

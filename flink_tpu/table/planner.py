@@ -865,10 +865,38 @@ class Planner:
 
             stream = stream.map(add_args, name="sql_over_args")
         mode, preceding = first.mode, first.preceding
+        from flink_tpu.core.config import StateOptions
+
+        engine = self.env.config.get(StateOptions.TABLE_EXEC_OVER_ENGINE)
+        from flink_tpu.runtime.over_device import (
+            DeviceOverAggOperator, device_supported)
+
+        if engine not in ("auto", "device", "host"):
+            raise PlanError(
+                f"table.exec.over.engine must be auto/device/host, got "
+                f"{engine!r}")
+
+        def _x64() -> bool:
+            import jax
+
+            return bool(jax.config.jax_enable_x64)
+
+        # auto only picks the device engine when it computes in f64
+        # (JAX x64 on) — silently downgrading SQL DOUBLE aggregates to
+        # f32 needs an explicit engine=device opt-in
+        use_device = (engine == "device"
+                      or (engine == "auto" and device_supported(
+                          specs, mode, preceding) and _x64()))
+        if engine == "device" and not device_supported(
+                specs, mode, preceding):
+            raise PlanError(
+                "table.exec.over.engine=device: bounded RANGE MIN/MAX "
+                "frames have no device form — use engine=host or auto")
+        op_cls = DeviceOverAggOperator if use_device else OverAggOperator
         t = Transformation(
             name="sql_over_agg", kind="one_input",
             operator_factory=lambda key_col=key_col, specs=tuple(specs),
-            mode=mode, preceding=preceding: OverAggOperator(
+            mode=mode, preceding=preceding, op_cls=op_cls: op_cls(
                 key_col, list(specs), mode=mode, preceding=preceding),
             inputs=[stream.key_by(key_col).transformation])
         over_stream = DataStream(self.env, t)

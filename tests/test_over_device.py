@@ -1,0 +1,238 @@
+"""Device OVER engine == host OVER engine, on randomized streams.
+
+The host path (runtime/over_agg.py) is the oracle: it was validated
+against hand-computed frames in test_over_agg.py. The device path
+(runtime/over_device.py) must produce identical numbers on every frame
+family it claims, across multi-fire streams with per-key context
+carry-over, checkpoints, and the degrade path.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
+from flink_tpu.runtime.over_agg import OverAggOperator
+from flink_tpu.runtime.over_device import (
+    DeviceOverAggOperator,
+    device_supported,
+)
+
+FUNCS = ["SUM", "COUNT", "AVG", "MIN", "MAX"]
+
+
+def _stream(rng, n_batches=6, rows_per_batch=40, n_keys=7, ts_step=50):
+    """Random batches with monotonically advancing watermarks; rows get
+    timestamps strictly above the previous watermark (matching the
+    operator's late-row contract)."""
+    batches, wms = [], []
+    wm = 0
+    for b in range(n_batches):
+        new_wm = wm + ts_step * 10
+        ts = rng.integers(wm + 1, new_wm + ts_step * 3,
+                          size=rows_per_batch)
+        keys = rng.integers(0, n_keys, size=rows_per_batch)
+        batches.append(RecordBatch(
+            {KEY_ID_FIELD: keys.astype(np.int64),
+             "k": keys.astype(np.int64),
+             "x": rng.normal(size=rows_per_batch).round(3),
+             TIMESTAMP_FIELD: ts.astype(np.int64)}))
+        wms.append(new_wm)
+        wm = new_wm
+    return batches, wms
+
+
+def _run(op, batches, wms):
+    outs = []
+    op.open(None)
+    for b, wm in zip(batches, wms):
+        op.process_batch(b)
+        outs.extend(op.process_watermark(wm))
+    outs.extend(op.close())
+    return RecordBatch.concat(outs) if outs else None
+
+
+def _assert_equal(host_out, dev_out, specs):
+    assert (host_out is None) == (dev_out is None)
+    if host_out is None:
+        return
+    assert len(host_out) == len(dev_out)
+    # both engines emit fire-by-fire in ready-sorted order with the same
+    # stable tie-breaking, so rows align positionally; aggregates compare
+    # with f32 tolerance (the device kernel runs in the platform dtype —
+    # float32 unless JAX_ENABLE_X64)
+    np.testing.assert_array_equal(host_out[KEY_ID_FIELD],
+                                  dev_out[KEY_ID_FIELD])
+    np.testing.assert_array_equal(host_out.timestamps, dev_out.timestamps)
+    np.testing.assert_array_equal(host_out["x"], dev_out["x"])
+    for _, _, name in specs:
+        np.testing.assert_allclose(
+            np.asarray(dev_out[name], dtype=np.float64),
+            np.asarray(host_out[name], dtype=np.float64),
+            rtol=2e-4, atol=1e-5, err_msg=name)
+
+
+def _specs(funcs=FUNCS):
+    return [(f, None if f == "COUNT" else "x", f"__o{i}__")
+            for i, f in enumerate(funcs)]
+
+
+@pytest.mark.parametrize("mode,preceding,funcs", [
+    ("ROWS", None, FUNCS),          # UNBOUNDED ROWS, all funcs
+    ("RANGE", None, FUNCS),         # UNBOUNDED RANGE (peers), all funcs
+    ("ROWS", 5, FUNCS),             # bounded ROWS incl. MIN/MAX doubling
+    ("ROWS", 1, FUNCS),             # window of 2 (k=0 edge)
+    ("ROWS", 0, FUNCS),             # degenerate: current row only
+    ("RANGE", 300, ["SUM", "COUNT", "AVG"]),   # bounded RANGE sum-family
+    ("RANGE", 1, ["SUM", "AVG"]),
+])
+def test_device_matches_host(mode, preceding, funcs):
+    rng = np.random.default_rng(42)
+    batches, wms = _stream(rng)
+    specs = _specs(funcs)
+    host = _run(OverAggOperator("k", specs, mode=mode,
+                                preceding=preceding), batches, wms)
+    dev = _run(DeviceOverAggOperator("k", specs, mode=mode,
+                                     preceding=preceding), batches, wms)
+    _assert_equal(host, dev, specs)
+
+
+def test_device_matches_host_single_key_and_many_keys():
+    for n_keys, seed in [(1, 1), (100, 2)]:
+        rng = np.random.default_rng(seed)
+        batches, wms = _stream(rng, n_batches=4, rows_per_batch=60,
+                               n_keys=n_keys)
+        specs = _specs()
+        host = _run(OverAggOperator("k", specs, "ROWS", 3), batches, wms)
+        dev = _run(DeviceOverAggOperator("k", specs, "ROWS", 3),
+                   batches, wms)
+        _assert_equal(host, dev, specs)
+
+
+def test_device_matches_host_with_duplicate_timestamps():
+    # RANGE peers: rows sharing (key, ts) must all take the peer-group
+    # aggregate
+    rng = np.random.default_rng(3)
+    batches, wms = _stream(rng, ts_step=2)  # dense ts -> many duplicates
+    specs = _specs(["SUM", "COUNT", "MIN"])
+    host = _run(OverAggOperator("k", specs, "RANGE", None), batches, wms)
+    dev = _run(DeviceOverAggOperator("k", specs, "RANGE", None),
+               batches, wms)
+    _assert_equal(host, dev, specs)
+
+
+def test_device_supported_matrix():
+    assert device_supported(_specs(["SUM"]), "RANGE", 10)
+    assert not device_supported(_specs(["MIN"]), "RANGE", 10)
+    assert device_supported(_specs(["MIN"]), "RANGE", None)
+    assert device_supported(_specs(["MIN"]), "ROWS", 10)
+
+
+def test_device_engine_rejects_range_min_bounded():
+    with pytest.raises(ValueError, match="RANGE MIN/MAX"):
+        DeviceOverAggOperator("k", _specs(["MIN"]), "RANGE", 10)
+
+
+def test_checkpoint_restore_midstream_matches():
+    rng = np.random.default_rng(9)
+    batches, wms = _stream(rng)
+    specs = _specs()
+    ref = _run(DeviceOverAggOperator("k", specs, "ROWS", 4),
+               batches, wms)
+
+    op = DeviceOverAggOperator("k", specs, "ROWS", 4)
+    op.open(None)
+    outs = []
+    for b, wm in zip(batches[:3], wms[:3]):
+        op.process_batch(b)
+        outs.extend(op.process_watermark(wm))
+    snap = op.snapshot_state()
+    op2 = DeviceOverAggOperator("k", specs, "ROWS", 4)
+    op2.open(None)
+    op2.restore_state(snap)
+    for b, wm in zip(batches[3:], wms[3:]):
+        op2.process_batch(b)
+        outs.extend(op2.process_watermark(wm))
+    outs.extend(op2.close())
+    _assert_equal(ref, RecordBatch.concat(outs), specs)
+
+
+def test_degrade_to_host_keeps_context():
+    """A fire exceeding the span budget converts flat context to the
+    host form and continues bit-identically."""
+    rng = np.random.default_rng(5)
+    batches, wms = _stream(rng, n_batches=6)
+    specs = _specs(["SUM", "AVG"])
+    host = _run(OverAggOperator("k", specs, "RANGE", 300), batches, wms)
+
+    op = DeviceOverAggOperator("k", specs, "RANGE", 300)
+    op.open(None)
+    outs = []
+    for i, (b, wm) in enumerate(zip(batches, wms)):
+        if i == 3:
+            op._degrade_to_host()   # simulate the span guard tripping
+            assert op._fallback
+        op.process_batch(b)
+        outs.extend(op.process_watermark(wm))
+    outs.extend(op.close())
+    _assert_equal(host, RecordBatch.concat(outs), specs)
+
+
+def test_degrade_unbounded_keeps_accumulators():
+    rng = np.random.default_rng(6)
+    batches, wms = _stream(rng)
+    specs = _specs()
+    host = _run(OverAggOperator("k", specs, "RANGE", None), batches, wms)
+
+    op = DeviceOverAggOperator("k", specs, "RANGE", None)
+    op.open(None)
+    outs = []
+    for i, (b, wm) in enumerate(zip(batches, wms)):
+        if i == 2:
+            op._degrade_to_host()
+        op.process_batch(b)
+        outs.extend(op.process_watermark(wm))
+    outs.extend(op.close())
+    _assert_equal(host, RecordBatch.concat(outs), specs)
+
+
+def test_sql_over_engine_config():
+    """table.exec.over.engine selects the operator family end-to-end
+    through SQL, with identical results."""
+    from flink_tpu import Configuration, StreamExecutionEnvironment
+    from flink_tpu.connectors.kafka import FakeBroker
+    from flink_tpu.table.environment import StreamTableEnvironment
+
+    rng = np.random.default_rng(13)
+    n = 400
+    ks = rng.integers(0, 9, n).astype(np.int64)
+    vs = np.round(rng.random(n), 4)
+    ts = np.arange(n, dtype=np.int64) * 7
+    results = {}
+    for engine in ("host", "device", "auto"):
+        topic = f"over_cfg_{engine}"
+        broker = FakeBroker.get("default")
+        broker.create_topic(topic, 1)
+        broker.append(topic, 0, RecordBatch.from_pydict(
+            {"key": ks, "value": vs, "ts": ts}, timestamps=ts))
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 101,
+            "table.exec.over.engine": engine}))
+        tenv = StreamTableEnvironment(env)
+        tenv.execute_sql(
+            f"CREATE TABLE {topic} (key BIGINT, value DOUBLE, ts BIGINT, "
+            "WATERMARK FOR ts AS ts) "
+            f"WITH ('connector'='kafka', 'topic'='{topic}')")
+        rows = tenv.execute_sql(
+            "SELECT key, ts, SUM(value) OVER (PARTITION BY key "
+            "ORDER BY ts ROWS BETWEEN 10 PRECEDING AND CURRENT ROW) "
+            f"AS r FROM {topic}").collect()
+        results[engine] = sorted(
+            (int(r["key"]), int(r["ts"]), float(r["r"])) for r in rows)
+    # auto == host exactly (x64 off in CI -> auto stays on the host
+    # engine); device matches within f32 tolerance
+    assert results["auto"] == results["host"]
+    assert len(results["host"]) == n == len(results["device"])
+    for (hk, ht, hr), (dk, dt, dr) in zip(results["host"],
+                                          results["device"]):
+        assert (hk, ht) == (dk, dt)
+        assert dr == pytest.approx(hr, rel=2e-4, abs=1e-5)
