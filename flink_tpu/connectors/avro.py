@@ -60,7 +60,10 @@ def parse_schema(schema) -> Any:
         if isinstance(s, list):
             return {"type": "union", "branches": [norm(b) for b in s]}
         t = s["type"]
-        if t in _PRIMITIVES and len(s) <= 2:
+        if t in _PRIMITIVES:
+            # annotated primitive ({"type": "bytes", "logicalType":
+            # "decimal", ...}): logical-type annotations read as their
+            # underlying primitive (the spec's required fallback)
             return t
         if t == "record":
             out = {"type": "record", "name": s["name"],

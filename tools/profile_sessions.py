@@ -1,0 +1,67 @@
+"""Profile the 10M-key sessions row at the THRASHING shape (live
+sessions > device slot budget) — the BASELINE row-5 workload the round-4
+bench moved out of measurement. Used to attack the spill-tier bound.
+
+Usage: python tools/profile_sessions.py [n_records] [evps] [--cprofile]
+"""
+
+import cProfile
+import io
+import pstats
+import sys
+import time
+
+sys.path.insert(0, ".")
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def run(n, evps):
+    from flink_tpu import Configuration, StreamExecutionEnvironment
+    from flink_tpu.connectors.sinks import CollectSink
+    from flink_tpu.connectors.sources import DataGenSource
+    from flink_tpu.runtime.watermarks import WatermarkStrategy
+    from flink_tpu.windowing.assigners import EventTimeSessionWindows
+
+    env = StreamExecutionEnvironment(Configuration({
+        "execution.micro-batch.size": 1 << 16,
+        "state.slot-table.capacity": 1 << 19,
+        "state.slot-table.max-device-slots": 1 << 19,
+    }))
+    sink = CollectSink()
+    # evps of event time x 2 s gap = 2*evps live sessions; at 400k ev/s
+    # that is ~800k live vs the 512k budget -> sustained spill pressure
+    src = DataGenSource(total_records=n, num_keys=10_000_000,
+                        events_per_second_of_eventtime=evps, seed=3)
+    (env.from_source(
+        src, WatermarkStrategy.for_bounded_out_of_orderness(0))
+       .key_by("key")
+       .window(EventTimeSessionWindows.with_gap(2_000))
+       .sum("value").sink_to(sink))
+    t0 = time.perf_counter()
+    env.execute("sessions-thrash")
+    dt = time.perf_counter() - t0
+    print(f"{n} records in {dt:.1f}s = {n / dt:,.0f} ev/s "
+          f"(real-time bar: {evps:,}/s), results={len(sink.result())}")
+    return n / dt
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    n = int(args[0]) if args else 2_000_000
+    evps = int(args[1]) if len(args) > 1 else 400_000
+    if "--cprofile" in sys.argv:
+        pr = cProfile.Profile()
+        pr.enable()
+        run(n, evps)
+        pr.disable()
+        s = io.StringIO()
+        pstats.Stats(pr, stream=s).sort_stats("cumulative").print_stats(40)
+        print(s.getvalue())
+    else:
+        run(n, evps)
+
+
+if __name__ == "__main__":
+    main()
