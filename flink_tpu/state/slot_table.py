@@ -117,8 +117,13 @@ class _NamespaceRegistry:
     chunk-merge bookkeeping exist exactly once.
     """
 
-    def _init_registry(self) -> None:
+    def _init_registry(self, track: bool = True) -> None:
         self._ns_slots: Dict[int, List[np.ndarray]] = {}
+        #: False = the owner frees by SLOT and never asks for a
+        #: namespace's slot list — skip the per-namespace bookkeeping
+        #: entirely (the session tables: one row per ns, millions of ns;
+        #: registry upkeep was O(sessions) Python per batch)
+        self._track_ns = track
 
     @property
     def namespaces(self) -> List[int]:
@@ -148,10 +153,22 @@ class _NamespaceRegistry:
     def _registry_remove_slots(self, slots: np.ndarray,
                                namespaces: np.ndarray) -> None:
         """Remove individual slots from their namespaces' chunk lists
-        (TTL expiry frees by slot, not by whole namespace)."""
-        for ns in np.unique(namespaces).tolist():
+        (TTL expiry and paged eviction free by slot, not by whole
+        namespace)."""
+        if not self._track_ns:
+            return
+        uniq, counts = np.unique(namespaces, return_counts=True)
+        slots_per_ns = dict(zip(uniq.tolist(), counts.tolist()))
+        for ns, freed_here in slots_per_ns.items():
             chunks = self._ns_slots.get(int(ns))
             if not chunks:
+                continue
+            total = (len(chunks[0]) if len(chunks) == 1
+                     else sum(len(c) for c in chunks))
+            if total <= freed_here:
+                # every slot of the namespace is being freed (the session
+                # case: one slot per sid) — O(1), no membership scan
+                self._ns_slots.pop(int(ns), None)
                 continue
             merged = np.concatenate(chunks) if len(chunks) > 1 \
                 else chunks[0]
@@ -173,7 +190,8 @@ class HostSlotIndex(_NamespaceRegistry):
                  on_grow: Optional[Callable[[int, int], None]] = None,
                  growable: bool = True,
                  full_hint: str = "raise state.slot-table.capacity",
-                 max_capacity: int = 0) -> None:
+                 max_capacity: int = 0,
+                 track_namespaces: bool = True) -> None:
         self.capacity = max(int(capacity), 1024)
         self.on_grow = on_grow
         self.growable = growable
@@ -184,7 +202,7 @@ class HostSlotIndex(_NamespaceRegistry):
         self.slot_ns = np.zeros(self.capacity, dtype=np.int64)
         self.slot_used = np.zeros(self.capacity, dtype=bool)
         self._free: List[int] = list(range(self.capacity - 1, 0, -1))
-        self._init_registry()
+        self._init_registry(track_namespaces)
 
     @property
     def num_used(self) -> int:
@@ -216,9 +234,10 @@ class HostSlotIndex(_NamespaceRegistry):
                 self.slot_used[slot] = True
                 new_by_ns.setdefault(pair[1], []).append(slot)
             uslots[j] = slot
-        for ns, slots in new_by_ns.items():
-            self._ns_slots.setdefault(ns, []).append(
-                np.asarray(slots, dtype=np.int32))
+        if self._track_ns:
+            for ns, slots in new_by_ns.items():
+                self._ns_slots.setdefault(ns, []).append(
+                    np.asarray(slots, dtype=np.int32))
         return uslots[inverse]
 
     def lookup(self, key_ids: np.ndarray,
@@ -314,7 +333,8 @@ class NativeSlotIndex(_NamespaceRegistry):
                  on_grow: Optional[Callable[[int, int], None]] = None,
                  growable: bool = True,
                  full_hint: str = "raise state.slot-table.capacity",
-                 max_capacity: int = 0) -> None:
+                 max_capacity: int = 0,
+                 track_namespaces: bool = True) -> None:
         from flink_tpu.native import load_slotmap
 
         self._lib = load_slotmap()
@@ -328,7 +348,7 @@ class NativeSlotIndex(_NamespaceRegistry):
             else self.capacity
         self._h = self._lib.sm_create(self.capacity, max_cap)
         self._wrap_views()
-        self._init_registry()
+        self._init_registry(track_namespaces)
 
     def _wrap_views(self) -> None:
         import ctypes
@@ -378,7 +398,7 @@ class NativeSlotIndex(_NamespaceRegistry):
             if self.on_grow is not None:
                 self.on_grow(old_cap, self.capacity)
         new_mask = is_new.view(bool)
-        if new_mask.any():
+        if new_mask.any() and self._track_ns:
             new_slots = out[new_mask]
             new_ns = nss[new_mask]
             # group new slots by namespace: sort + split (O(n log n), not a
@@ -522,13 +542,15 @@ class NativeSlotIndex(_NamespaceRegistry):
 
 def make_slot_index(capacity: int, on_grow=None, growable: bool = True,
                     full_hint: str = "raise state.slot-table.capacity",
-                    max_capacity: int = 0):
+                    max_capacity: int = 0,
+                    track_namespaces: bool = True):
     """Native index when the C++ library is available, else pure Python."""
     from flink_tpu.native import slotmap_available
 
     cls = NativeSlotIndex if slotmap_available() else HostSlotIndex
     return cls(capacity, on_grow=on_grow, growable=growable,
-               full_hint=full_hint, max_capacity=max_capacity)
+               full_hint=full_hint, max_capacity=max_capacity,
+               track_namespaces=track_namespaces)
 
 
 class SpillTier:
@@ -677,6 +699,8 @@ class SlotTable:
         spill_dir: Optional[str] = None,
         spill_host_max_bytes: int = 0,
         memory=None,
+        spill_layout: str = "namespaces",
+        track_namespaces: bool = True,
     ) -> None:
         self.agg = agg
         self.max_parallelism = max_parallelism
@@ -691,14 +715,49 @@ class SlotTable:
         self.spill = SpillTier(spill_dir, spill_host_max_bytes)
         self._ns_touch: Dict[int, int] = {}
         self._touch_clock = 0
+        # Spill layout (reference: RocksDBKeyedStateBackend.java —
+        # block-granular storage under a small memory budget):
+        # - "namespaces" (default): the unit of movement is one namespace
+        #   (a window slice shared by many keys) — right when namespaces
+        #   are large and few.
+        # - "pages": the unit is an EVICTION COHORT of many rows —
+        #   right when namespaces are tiny and numerous (sessions: one
+        #   row per session id). Residency tracking is slot-granular
+        #   (a per-slot touch clock), membership is a sorted array
+        #   binary-searched per batch, and spill/reload moves tens of
+        #   thousands of rows per entry instead of one. REQUIRES
+        #   single-row namespaces (eviction would otherwise split a
+        #   namespace across the device/page boundary).
+        if spill_layout not in ("namespaces", "pages"):
+            raise ValueError(
+                f"spill_layout must be 'namespaces' or 'pages', got "
+                f"{spill_layout!r}")
+        self.spill_layout = spill_layout
+        self._paged = spill_layout == "pages" and self.max_device_slots > 0
+        if self._paged:
+            #: spilled (ns -> page) mapping as parallel arrays; kept
+            #: sorted by ns lazily (evictions append, reloads rebuild)
+            self._sp_ns = np.empty(0, dtype=np.int64)
+            self._sp_page = np.empty(0, dtype=np.int64)
+            self._sp_sorted = True
+            #: sessions freed while spilled (rare: fires reload first) —
+            #: their page rows are dropped on reload/snapshot
+            self._dead_spilled: set = set()
+            self._next_page = 1
         self.index = make_slot_index(
             capacity, on_grow=self._grow_device,
             max_capacity=self.max_device_slots,
+            track_namespaces=track_namespaces,
             full_hint=("state spills to host beyond "
                        "state.slot-table.max-device-slots"
                        if self.max_device_slots
                        else "raise state.slot-table.capacity"))
         self._reserve_rows(self.index.capacity)
+        if self._paged:
+            # sized AFTER index creation: the index clamps capacity up
+            # (>= 1024), and the touch clock must cover every slot
+            self._slot_touch = np.zeros(self.index.capacity,
+                                        dtype=np.int64)
         self.accs: Tuple[jnp.ndarray, ...] = agg.init_accumulators(
             self.index.capacity)
         if device is not None:
@@ -755,13 +814,23 @@ class SlotTable:
     @property
     def namespaces(self) -> List[int]:
         """All live namespaces — device-resident AND spilled."""
-        return self.index.namespaces + self.spill.namespaces
+        if getattr(self.index, "_track_ns", True):
+            resident = self.index.namespaces
+        else:  # registry-free: derive from the used-slot metadata
+            used = self.index.used_slots()
+            resident = np.unique(self.index.slot_ns[used]).tolist()
+        if self._paged:
+            return resident + self._sp_ns.tolist()
+        return resident + self.spill.namespaces
 
     # ------------------------------------------------------------- main path
 
     def lookup_or_insert(self, key_ids: np.ndarray,
                          namespaces: np.ndarray,
                          _pairs=None) -> np.ndarray:
+        if self.max_device_slots and self._paged:
+            return self._lookup_or_insert_paged(key_ids, namespaces,
+                                                _pairs)
         if self.max_device_slots:
             # ``_pairs`` lets upsert() hand down its already-computed
             # unique (key, ns) pairs instead of re-sorting the batch
@@ -790,6 +859,217 @@ class SlotTable:
     def _make_headroom(self, needed: int, protect: set) -> None:
         while self.index.free_headroom() < needed:
             self._evict_cold(protect=protect)
+
+    # --------------------------------------------------- paged spill layout
+
+    def _lookup_or_insert_paged(self, key_ids, namespaces,
+                                _pairs=None) -> np.ndarray:
+        """Slot-clock variant of the spill-aware lookup: resident rows of
+        THIS batch are stamped with a fresh clock (protecting them from
+        the eviction the batch itself triggers), missing pairs reload by
+        page, then the plain index insert runs."""
+        key_ids = np.asarray(key_ids, dtype=np.int64)
+        namespaces = np.asarray(namespaces, dtype=np.int64)
+        if _pairs is None:
+            uk, un, _ = unique_pairs(key_ids, namespaces)
+        else:
+            uk, un = _pairs
+        self._touch_clock += 1
+        clock = self._touch_clock
+        pre = self.index.lookup(uk, un)
+        hit = pre >= 0
+        self._slot_touch[pre[hit]] = clock
+        missing = ~hit
+        if missing.any() and len(self._sp_ns):
+            self._reload_pages_for(un[missing], clock)
+            # re-probe: reloaded rows are resident now (fresh sessions
+            # stay missing); skipping this when the reload happened to
+            # drain the spilled map would overcount `needed` and evict
+            # or fail spuriously
+            pre = self.index.lookup(uk, un)
+            missing = pre < 0
+        needed = int(missing.sum())
+        if needed and self.index.free_headroom() < needed:
+            self._make_headroom_paged(needed)
+        slots = self.index.lookup_or_insert(key_ids, namespaces)
+        self._slot_touch[slots] = clock
+        return slots
+
+    def _sp_sort(self) -> None:
+        if not self._sp_sorted:
+            o = np.argsort(self._sp_ns, kind="stable")
+            self._sp_ns = self._sp_ns[o]
+            self._sp_page = self._sp_page[o]
+            self._sp_sorted = True
+
+    def _spilled_mask(self, nss: np.ndarray) -> np.ndarray:
+        """Vectorized membership: which of ``nss`` are spilled."""
+        if not len(self._sp_ns):
+            return np.zeros(len(nss), dtype=bool)
+        self._sp_sort()
+        pos = np.searchsorted(self._sp_ns, nss)
+        pos = np.minimum(pos, len(self._sp_ns) - 1)
+        return self._sp_ns[pos] == nss
+
+    def _reload_pages_for(self, nss: np.ndarray, clock: int) -> None:
+        """Reload every page containing any of ``nss`` — whole pages (the
+        block-cache bet: rows evicted together in one cohort become due
+        together, so a fire's reload mostly pulls rows it needs)."""
+        self._sp_sort()
+        pos = np.searchsorted(self._sp_ns, nss)
+        pos = np.minimum(pos, max(len(self._sp_ns) - 1, 0))
+        hit = len(self._sp_ns) > 0
+        hit = self._sp_ns[pos] == nss if hit else np.zeros(0, bool)
+        pages = np.unique(self._sp_page[pos[hit]]) if hit.any() else ()
+        if not len(pages):
+            return
+        key_chunks, ns_chunks, dirty_chunks = [], [], []
+        leaf_chunks: List[List[np.ndarray]] = [
+            [] for _ in self.agg.leaves]
+        for page in pages.tolist():
+            entry = self.spill.pop(int(page))
+            if entry is None:
+                continue
+            key_chunks.append(np.asarray(entry["key_id"],
+                                         dtype=np.int64))
+            ns_chunks.append(np.asarray(entry["ns"], dtype=np.int64))
+            dirty_chunks.append(np.asarray(entry["dirty"], dtype=bool))
+            for i, l in enumerate(self.agg.leaves):
+                leaf_chunks[i].append(
+                    np.asarray(entry[f"leaf_{i}"], dtype=l.dtype))
+        keys = np.concatenate(key_chunks)
+        rns = np.concatenate(ns_chunks)
+        dirty = np.concatenate(dirty_chunks)
+        vals = [np.concatenate(c) for c in leaf_chunks]
+        if self._dead_spilled:
+            dead = np.asarray(sorted(self._dead_spilled), dtype=np.int64)
+            alive = ~np.isin(rns, dead)
+            if not alive.all():
+                gone = rns[~alive]
+                self._dead_spilled.difference_update(gone.tolist())
+                keys, rns, dirty = keys[alive], rns[alive], dirty[alive]
+                vals = [v[alive] for v in vals]
+        # drop the reloaded pages from the spilled map
+        keep = ~np.isin(self._sp_page, pages)
+        self._sp_ns = self._sp_ns[keep]
+        self._sp_page = self._sp_page[keep]
+        # only the REQUESTED rows go to the device; the popped pages'
+        # other rows re-bundle into a fresh page host-side (pure NumPy —
+        # no device traffic). Without this split, page churn mixes
+        # cohorts over time and a fire's reload would drag in whole
+        # pages of not-yet-due sessions, read-amplifying past the
+        # device budget.
+        want = np.isin(rns, np.unique(nss))
+        rest = ~want
+        if rest.any():
+            r_entry = {"key_id": keys[rest], "ns": rns[rest],
+                       "dirty": dirty[rest],
+                       **{f"leaf_{i}": v[rest]
+                          for i, v in enumerate(vals)}}
+            page = self._next_page
+            self._next_page += 1
+            self.spill.put(page, r_entry,
+                           dirty=bool(r_entry["dirty"].any()))
+            self._sp_ns = np.concatenate([self._sp_ns, r_entry["ns"]])
+            self._sp_page = np.concatenate([
+                self._sp_page,
+                np.full(int(rest.sum()), page, dtype=np.int64)])
+            self._sp_sorted = False
+            keys, rns, dirty = keys[want], rns[want], dirty[want]
+            vals = [v[want] for v in vals]
+        n = len(keys)
+        if n == 0:
+            return
+        if self.index.free_headroom() < n:
+            self._make_headroom_paged(n)
+        slots = self.index.lookup_or_insert(keys, rns)
+        size = sticky_bucket(n, self._scatter_bucket)
+        self._scatter_bucket = size
+        padded_slots = pad_i32(slots, size, fill=0)
+        pvals = tuple(
+            np.concatenate([v, np.full(size - n, l.identity,
+                                       dtype=l.dtype)])
+            for v, l in zip(vals, self.agg.leaves))
+        self.accs = self.agg._put_jit(
+            self.accs, jnp.asarray(padded_slots),
+            tuple(jnp.asarray(v) for v in pvals))
+        # reloaded rows keep their dirtiness (not snapshotted since) and
+        # take the current clock — the cohort is likely about to fire
+        self._dirty[slots] = dirty
+        self._slot_touch[slots] = clock
+
+    def _make_headroom_paged(self, needed: int) -> None:
+        while self.index.free_headroom() < needed:
+            self._evict_cold_paged()
+
+    def _drop_spilled_sessions(self, nss: np.ndarray) -> None:
+        """Mark spilled sessions dead; reap pages left with no live
+        mapping entries (they could never reload — their storage and
+        dead-set entries would otherwise leak for the rest of the run)."""
+        if not (self._paged and len(self._sp_ns)):
+            return
+        nss = np.asarray(nss, dtype=np.int64)
+        dead = nss[self._spilled_mask(nss)]
+        if not len(dead):
+            return
+        self._dead_spilled.update(dead.tolist())
+        kill = np.isin(self._sp_ns, dead)
+        dead_pages = np.unique(self._sp_page[kill])
+        keep = ~kill
+        self._sp_ns = self._sp_ns[keep]
+        self._sp_page = self._sp_page[keep]
+        gone = dead_pages[~np.isin(dead_pages, np.unique(self._sp_page))]
+        for p in gone.tolist():
+            entry = self.spill.pop(int(p))
+            if entry is not None:
+                self._dead_spilled.difference_update(
+                    np.asarray(entry["ns"], dtype=np.int64).tolist())
+
+    def _evict_cold_paged(self) -> None:
+        """Evict the coldest slots (touch < current clock) as ONE page:
+        one gather + one reset kernel + one spill entry, however many
+        sessions the cohort spans."""
+        used = self.index.used_slots()
+        touch = self._slot_touch[used]
+        evictable = used[touch < self._touch_clock]
+        if len(evictable) == 0:
+            raise SlotTableFullError(
+                "device slot budget exhausted and every resident row was "
+                "touched by the current batch — raise "
+                "state.slot-table.max-device-slots or reduce batch size")
+        target = min(max(self.index.capacity // 8, 1024), len(evictable))
+        et = self._slot_touch[evictable]
+        if target < len(evictable):
+            sel = np.argpartition(et, target - 1)[:target]
+            chosen = evictable[sel]
+        else:
+            chosen = evictable
+        chosen = np.asarray(chosen, dtype=np.int32)
+        n = len(chosen)
+        size = sticky_bucket(n, self._gather_bucket)
+        self._gather_bucket = size
+        gathered = self.agg._gather_jit(
+            self.accs, jnp.asarray(pad_i32(chosen, size, fill=0)))
+        entry = {
+            "key_id": np.asarray(self.index.slot_key[chosen]),
+            "ns": np.asarray(self.index.slot_ns[chosen]),
+            "dirty": self._dirty[chosen].copy(),
+            **{f"leaf_{i}": np.asarray(g)[:n]
+               for i, g in enumerate(gathered)},
+        }
+        page = self._next_page
+        self._next_page += 1
+        self.spill.put(page, entry, dirty=bool(entry["dirty"].any()))
+        self._sp_ns = np.concatenate([self._sp_ns, entry["ns"]])
+        self._sp_page = np.concatenate([
+            self._sp_page, np.full(n, page, dtype=np.int64)])
+        self._sp_sorted = False
+        self.index.free_slots(chosen)
+        self._dirty[chosen] = False
+        rsize = sticky_bucket(n, self._reset_bucket)
+        self._reset_bucket = rsize
+        self.accs = self.agg._reset_jit(
+            self.accs, pad_i32(chosen, rsize, fill=0))
 
     def upsert(self, key_ids: np.ndarray, namespaces: np.ndarray,
                values: Tuple[np.ndarray, ...],
@@ -965,6 +1245,9 @@ class SlotTable:
         )
         self._dirty = np.concatenate(
             [self._dirty, np.zeros(new - old, dtype=bool)])
+        if self._paged:
+            self._slot_touch = np.concatenate(
+                [self._slot_touch, np.zeros(new - old, dtype=np.int64)])
 
     def scatter(self, slots: np.ndarray, values: Tuple[np.ndarray, ...]) -> None:
         """Accumulate a batch: one donated XLA scatter per leaf."""
@@ -1221,6 +1504,34 @@ class SlotTable:
             self._dirty[slots] = False
         return slots
 
+    def free_index_only_slots(self, slots: np.ndarray,
+                              namespaces) -> None:
+        """Slot-addressed free_index_only for registry-free tables: the
+        caller (session merge path) already holds the absorbed rows'
+        slots; device values were neutralized by its merge kernel."""
+        slots = np.asarray(slots, dtype=np.int32)
+        self._freed_ns.extend(np.asarray(namespaces,
+                                         dtype=np.int64).tolist())
+        self.index.free_slots(slots)
+        self._dirty[slots] = False
+
+    def free_rows(self, slots: np.ndarray, namespaces) -> None:
+        """Slot-addressed free_namespaces (fired sessions): the caller
+        resolved the rows this batch, so no registry walk is needed.
+        Resets the device values and records namespace tombstones."""
+        slots = np.asarray(slots, dtype=np.int32)
+        if not len(slots):
+            return
+        nss = np.asarray(namespaces, dtype=np.int64)
+        self._freed_ns.extend(nss.tolist())
+        self._drop_spilled_sessions(nss)
+        self.index.free_slots(slots)
+        self._dirty[slots] = False
+        size = sticky_bucket(len(slots), self._reset_bucket)
+        self._reset_bucket = size
+        self.accs = self.agg._reset_jit(
+            self.accs, pad_i32(slots, size, fill=0))
+
     def free_slots(self, slots: np.ndarray) -> None:
         """Release individual entries (TTL expiry of idle keys).
 
@@ -1244,12 +1555,16 @@ class SlotTable:
         """Release all slots of the given namespaces (windows fully fired)."""
         slots = self.index.free_namespaces(namespaces)
         self._freed_ns.extend(int(n) for n in namespaces)
-        if len(self.spill):
+        if self._paged:
+            self._drop_spilled_sessions(
+                np.asarray(namespaces, dtype=np.int64))
+        elif len(self.spill):
             for ns in namespaces:
                 if int(ns) in self.spill:
                     self.spill.drop(int(ns))
-        for ns in namespaces:
-            self._ns_touch.pop(int(ns), None)
+        if not self._paged:
+            for ns in namespaces:
+                self._ns_touch.pop(int(ns), None)
         if slots is None:
             return
         self._dirty[slots] = False
@@ -1283,8 +1598,13 @@ class SlotTable:
         """One key's raw accumulator leaves per namespace — device-resident
         namespaces read via one gather kernel, spilled ones from their host
         entries (no residency change: queries must not thrash the cache)."""
-        resident = [ns for ns in nss if int(ns) not in self.spill]
-        spilled = [ns for ns in nss if int(ns) in self.spill]
+        if self._paged:
+            sp = self._spilled_mask(np.asarray(nss, dtype=np.int64))
+            resident = [ns for ns, s in zip(nss, sp) if not s]
+            spilled = [ns for ns, s in zip(nss, sp) if s]
+        else:
+            resident = [ns for ns in nss if int(ns) not in self.spill]
+            spilled = [ns for ns in nss if int(ns) in self.spill]
         out: Dict[int, Tuple[np.ndarray, ...]] = {}
         if resident:
             keys = np.full(len(resident), key_id, dtype=np.int64)
@@ -1301,11 +1621,25 @@ class SlotTable:
                                        if h):
                     out[int(ns)] = tuple(l[j:j + 1] for l in leaves)
         for ns in spilled:
-            entry = self.spill.peek(int(ns))
-            if entry is None:
-                continue
-            pos = np.nonzero(np.asarray(entry["key_id"],
-                                        dtype=np.int64) == key_id)[0]
+            if self._paged:
+                # session id -> its page (read-only: queries must not
+                # change residency)
+                self._sp_sort()
+                p = int(np.searchsorted(self._sp_ns, int(ns)))
+                entry = self.spill.peek(int(self._sp_page[p]))
+                if entry is None:
+                    continue
+                pos = np.nonzero(
+                    (np.asarray(entry["key_id"], dtype=np.int64)
+                     == key_id)
+                    & (np.asarray(entry["ns"], dtype=np.int64)
+                       == int(ns)))[0]
+            else:
+                entry = self.spill.peek(int(ns))
+                if entry is None:
+                    continue
+                pos = np.nonzero(np.asarray(entry["key_id"],
+                                            dtype=np.int64) == key_id)[0]
             if len(pos) == 0:
                 continue
             j = int(pos[0])
@@ -1377,15 +1711,27 @@ class SlotTable:
         key_chunks = [out["key_id"]]
         ns_chunks = [out["namespace"]]
         leaf_chunks = [[out[f"leaf_{i}"]] for i in range(len(self.accs))]
-        for ns in self.spill.namespaces:
-            entry = self.spill.peek(int(ns))
-            m = len(entry["key_id"])
-            key_chunks.append(np.asarray(entry["key_id"], dtype=np.int64))
-            ns_chunks.append(np.full(m, int(ns), dtype=np.int64))
+        for pid_or_ns in self.spill.namespaces:
+            entry = self.spill.peek(int(pid_or_ns))
+            keys = np.asarray(entry["key_id"], dtype=np.int64)
+            if "ns" in entry:  # paged layout: entry carries its ns column
+                rns = np.asarray(entry["ns"], dtype=np.int64)
+                if self._paged and self._dead_spilled:
+                    alive = ~np.isin(rns, np.asarray(
+                        sorted(self._dead_spilled), dtype=np.int64))
+                    keys, rns = keys[alive], rns[alive]
+                    sel = alive
+                else:
+                    sel = slice(None)
+            else:
+                rns = np.full(len(keys), int(pid_or_ns), dtype=np.int64)
+                sel = slice(None)
+            key_chunks.append(keys)
+            ns_chunks.append(rns)
             for i in range(len(self.accs)):
                 leaf_chunks[i].append(
                     np.asarray(entry[f"leaf_{i}"],
-                               dtype=self.agg.leaves[i].dtype))
+                               dtype=self.agg.leaves[i].dtype)[sel])
         out["key_id"] = np.concatenate(key_chunks)
         out["namespace"] = np.concatenate(ns_chunks)
         for i in range(len(self.accs)):
@@ -1420,19 +1766,32 @@ class SlotTable:
         key_ids = self.index.slot_key[dirty_used]
         namespaces = self.index.slot_ns[dirty_used]
         # spilled-but-dirty namespaces were changed since the last snapshot
-        # and must travel in this delta too
-        for ns in self.spill.dirty_namespaces():
-            entry = self.spill.peek(int(ns))
+        # and must travel in this delta too (paged layout: only the dirty
+        # ROWS of a dirty page — pages are immutable once spilled, so the
+        # per-row dirty column captured at eviction stays authoritative)
+        for pid_or_ns in self.spill.dirty_namespaces():
+            entry = self.spill.peek(int(pid_or_ns))
             if entry is None:
                 continue
-            m = len(entry["key_id"])
-            key_ids = np.concatenate([key_ids, entry["key_id"]])
-            namespaces = np.concatenate(
-                [namespaces, np.full(m, int(ns), dtype=np.int64)])
+            keys = np.asarray(entry["key_id"], dtype=np.int64)
+            if "ns" in entry:
+                sel = np.asarray(entry["dirty"], dtype=bool)
+                if self._paged and self._dead_spilled:
+                    sel &= ~np.isin(
+                        np.asarray(entry["ns"], dtype=np.int64),
+                        np.asarray(sorted(self._dead_spilled),
+                                   dtype=np.int64))
+                keys = keys[sel]
+                rns = np.asarray(entry["ns"], dtype=np.int64)[sel]
+            else:
+                sel = slice(None)
+                rns = np.full(len(keys), int(pid_or_ns), dtype=np.int64)
+            key_ids = np.concatenate([key_ids, keys])
+            namespaces = np.concatenate([namespaces, rns])
             leaves = [np.concatenate([
                 leaves[i],
                 np.asarray(entry[f"leaf_{i}"],
-                           dtype=self.agg.leaves[i].dtype)])
+                           dtype=self.agg.leaves[i].dtype)[sel]])
                 for i in range(len(leaves))]
         if self._freed_pairs:
             tomb_k = np.concatenate([p[0] for p in self._freed_pairs])
@@ -1486,7 +1845,42 @@ class SlotTable:
             mask = np.array([g in key_group_filter for g in groups], dtype=bool)
             key_ids, namespaces = key_ids[mask], namespaces[mask]
             leaves = [l[mask] for l in leaves]
-        if self.max_device_slots and len(key_ids):
+        if self.max_device_slots and self._paged and len(key_ids):
+            # paged restore: rows land in page-sized spill entries (ns
+            # column per row) and reload lazily by page — same bounded-
+            # device contract, thousands of sessions per entry
+            order = np.argsort(namespaces, kind="stable")
+            s_ns = namespaces[order]
+            s_keys = key_ids[order]
+            s_leaves = [l[order] for l in leaves]
+            total = len(s_ns)
+            page_rows = max(self.index.capacity // 8, 1024)
+            if len(self._sp_ns):  # re-restore: drop stale pages first
+                for p in np.unique(self._sp_page).tolist():
+                    self.spill.drop(int(p))
+                self._sp_ns = np.empty(0, dtype=np.int64)
+                self._sp_page = np.empty(0, dtype=np.int64)
+            a = 0
+            while a < total:
+                b = min(a + page_rows, total)
+                # never split one namespace across pages
+                while b < total and s_ns[b] == s_ns[b - 1]:
+                    b += 1
+                entry = {"key_id": s_keys[a:b],
+                         "ns": s_ns[a:b],
+                         "dirty": np.zeros(b - a, dtype=bool),
+                         **{f"leaf_{i}": s_leaves[i][a:b]
+                            for i in range(len(s_leaves))}}
+                page = self._next_page
+                self._next_page += 1
+                self.spill.put(page, entry, dirty=False)
+                self._sp_ns = np.concatenate([self._sp_ns, s_ns[a:b]])
+                self._sp_page = np.concatenate([
+                    self._sp_page, np.full(b - a, page, dtype=np.int64)])
+                a = b
+            self._sp_sorted = False
+            self._dead_spilled.clear()
+        elif self.max_device_slots and len(key_ids):
             # spill-enabled restore: rows land in the spill tier grouped by
             # namespace and reload lazily on first access — a snapshot far
             # larger than HBM restores with bounded device memory

@@ -10,6 +10,21 @@ Key property exploited by the mesh engine: sessions are per-key and keys are
 owned by exactly one shard (key-group routing), so session merging NEVER
 crosses shards — the metadata is engine-global, only slot residency is
 sharded.
+
+Columnar store (round 5): the clickstream shape holds ~one live session
+per key across millions of keys, and a dict of per-key interval lists
+priced every operation at a Python allocation. The store is now hybrid:
+
+- **singles** (the overwhelming case): a slot index (the same native
+  hash map the state plane uses) maps key -> slot into dense
+  ``start/end/sid`` arrays. Registration, overlap-extend, fire
+  validation, and removal are all vectorized batch operations.
+- **multi**: keys holding >= 2 concurrently-live sessions fall back to
+  the reference-shaped interval lists (``key -> [(start, end, sid)]``)
+  — exact merge semantics, including accumulator merge groups.
+
+A key lives in exactly one of the two stores; promotion/demotion happens
+in the slow path that needed it.
 """
 
 from __future__ import annotations
@@ -18,6 +33,8 @@ import dataclasses
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+from flink_tpu.state.slot_table import make_slot_index
 
 _NEG_INF = -(1 << 62)
 
@@ -38,20 +55,64 @@ class MergeGroup:
         return len(self.sids_dst)
 
 
+class _SessionsView:
+    """Read-only dict-like view over the hybrid store — keeps the
+    ``meta.sessions`` surface (query paths, tests) unchanged."""
+
+    def __init__(self, meta: "SessionIntervalSet"):
+        self._m = meta
+
+    def get(self, key, default=None):
+        ivs = self._m._intervals_of(int(key))
+        return ivs if ivs is not None else default
+
+    def __getitem__(self, key):
+        ivs = self._m._intervals_of(int(key))
+        if ivs is None:
+            raise KeyError(key)
+        return ivs
+
+    def __contains__(self, key) -> bool:
+        return self._m._intervals_of(int(key)) is not None
+
+    def __len__(self) -> int:
+        return int(self._m._idx.num_used) + len(self._m._multi)
+
+    def items(self):
+        m = self._m
+        used = m._idx.used_slots()
+        keys = m._idx.slot_key[used]
+        for k, s, e, sid in zip(keys.tolist(),
+                                m._s_start[used].tolist(),
+                                m._s_end[used].tolist(),
+                                m._s_sid[used].tolist()):
+            yield int(k), [(int(s), int(e), int(sid))]
+        for k, ivs in m._multi.items():
+            yield int(k), list(ivs)
+
+    def keys(self):
+        for k, _ in self.items():
+            yield k
+
+
 class SessionIntervalSet:
-    """Per-key sorted interval lists + lazy fire heap + sid allocator."""
+    """Per-key session intervals + lazy fire candidates + sid allocator."""
 
     def __init__(self, gap: int, allowed_lateness: int = 0):
         self.gap = int(gap)
         self.allowed_lateness = int(allowed_lateness)
-        # key -> list of (start, end, sid), sorted by start; usually length 1
-        self.sessions: Dict[int, List[Tuple[int, int, int]]] = {}
+        cap = 1 << 16
+        self._idx = make_slot_index(cap, on_grow=self._on_grow,
+                                    track_namespaces=False)
+        cap = self._idx.capacity
+        self._s_start = np.zeros(cap, dtype=np.int64)
+        self._s_end = np.zeros(cap, dtype=np.int64)
+        self._s_sid = np.zeros(cap, dtype=np.int64)
+        #: keys with >= 2 live sessions: reference-shaped interval lists
+        self._multi: Dict[int, List[Tuple[int, int, int]]] = {}
         self._next_sid = 1
         #: fire candidates as COLUMNAR chunks [(ends, keys, sids), ...] —
-        #: the heap's role, but pushes are array appends and the
-        #: watermark cut is one vectorized mask (the 10M-key clickstream
-        #: creates ~one session per record; per-session heappush/heappop
-        #: dominated that profile)
+        #: pushes are array appends, the watermark cut one vectorized mask
         self._fire_chunks: List[Tuple[np.ndarray, np.ndarray,
                                       np.ndarray]] = []
         #: scalar push buffer (slow-path merges), drained into a chunk
@@ -66,6 +127,51 @@ class SessionIntervalSet:
         self._cur: Optional[MergeGroup] = None
         self._cur_dst: set = set()
         self._cur_src: set = set()
+
+    def _on_grow(self, old: int, new: int) -> None:
+        for name in ("_s_start", "_s_end", "_s_sid"):
+            arr = getattr(self, name)
+            grown = np.zeros(new, dtype=np.int64)
+            grown[:old] = arr
+            setattr(self, name, grown)
+
+    # --------------------------------------------------------- store access
+
+    @property
+    def sessions(self) -> _SessionsView:
+        return _SessionsView(self)
+
+    def _intervals_of(self, key: int
+                      ) -> Optional[List[Tuple[int, int, int]]]:
+        ivs = self._multi.get(key)
+        if ivs is not None:
+            return ivs
+        a = np.asarray([key], dtype=np.int64)
+        slot = int(self._idx.lookup(a, a)[0])
+        if slot < 0:
+            return None
+        return [(int(self._s_start[slot]), int(self._s_end[slot]),
+                 int(self._s_sid[slot]))]
+
+    def _store_intervals(self, key: int,
+                         ivs: List[Tuple[int, int, int]]) -> None:
+        """Write a key's (possibly merged) interval list back to the
+        hybrid store, moving it between singles and multi as needed."""
+        a = np.asarray([key], dtype=np.int64)
+        slot = int(self._idx.lookup(a, a)[0])
+        if len(ivs) == 1:
+            self._multi.pop(key, None)
+            if slot < 0:
+                slot = int(self._idx.lookup_or_insert(a, a)[0])
+            s, e, sid = ivs[0]
+            self._s_start[slot] = s
+            self._s_end[slot] = e
+            self._s_sid[slot] = sid
+        else:
+            if slot >= 0:
+                self._idx.free_slots(np.asarray([slot], dtype=np.int32))
+            ivs.sort()
+            self._multi[key] = ivs
 
     # ------------------------------------------------------- fire pending
 
@@ -139,27 +245,33 @@ class SessionIntervalSet:
         self._groups, self._cur = [], None
         self._cur_dst, self._cur_src = set(), set()
         sess_sid = np.empty(m, dtype=np.int64)
-
-        # FAST PATH (the 10M-key clickstream shape): a key with exactly
-        # one local session and no stored intervals registers in bulk —
-        # sid allocation, interval store, and fire-candidate push all
-        # vectorized; only overlapping/merging sessions take the
-        # per-session path below
-        first_of_key = np.empty(m, dtype=bool)
-        first_of_key[0] = True
-        first_of_key[1:] = sess_key[1:] != sess_key[:-1]
-        only_of_key = first_of_key.copy()
-        only_of_key[:-1] &= first_of_key[1:]
-        sessions = self.sessions
-        exists = np.fromiter((k in sessions for k in sess_key.tolist()),
-                             np.bool_, m)
         ends_all = sess_max + self.gap
+
         if self.max_fired_watermark > _NEG_INF // 2:
             stale = (ends_all - 1 + self.allowed_lateness
                      <= self.max_fired_watermark)
         else:
             stale = np.zeros(m, dtype=bool)
-        fast = only_of_key & ~exists
+
+        first_of_key = np.empty(m, dtype=bool)
+        first_of_key[0] = True
+        first_of_key[1:] = sess_key[1:] != sess_key[:-1]
+        only_of_key = first_of_key.copy()
+        only_of_key[:-1] &= first_of_key[1:]
+
+        slots = self._idx.lookup(sess_key, sess_key)
+        found = slots >= 0
+        in_multi = np.zeros(m, dtype=bool)
+        if self._multi:
+            probe = ~found
+            if probe.any():
+                pk = sess_key[probe]
+                in_multi[probe] = np.fromiter(
+                    (int(k) in self._multi for k in pk.tolist()),
+                    np.bool_, len(pk))
+
+        # A: fresh singles (no stored state) — bulk registration
+        fast = only_of_key & ~found & ~in_multi
         fresh_stale = fast & stale
         fast &= ~stale
         cnt = int(fast.sum())
@@ -168,14 +280,46 @@ class SessionIntervalSet:
                                   dtype=np.int64)
             self._next_sid += cnt
             sess_sid[fast] = sids_fast
-            fk = sess_key[fast].tolist()
-            fs = sess_min[fast].tolist()
-            fe = ends_all[fast].tolist()
-            for k, s, e, sid in zip(fk, fs, fe, sids_fast.tolist()):
-                sessions[k] = [(s, e, sid)]
-            self._push_fires(ends_all[fast], sess_key[fast], sids_fast)
+            fk = sess_key[fast]
+            fslots = self._idx.lookup_or_insert(fk, fk)
+            self._s_start[fslots] = sess_min[fast]
+            self._s_end[fslots] = ends_all[fast]
+            self._s_sid[fslots] = sids_fast
+            self._push_fires(ends_all[fast], fk, sids_fast)
         sess_sid[fresh_stale] = -1  # stale on arrival (never stored)
-        slow = np.nonzero(~fast & ~fresh_stale)[0]
+
+        # B: sole local session meeting a stored SINGLE — vectorized
+        # overlap-extend; disjoint ones (a second live session) and
+        # everything multi-flavored go to the exact slow path
+        b = only_of_key & found
+        slow_extra = None
+        if b.any():
+            bi = np.nonzero(b)[0]
+            bs = slots[bi]
+            ex_s = self._s_start[bs]
+            ex_e = self._s_end[bs]
+            ov = (sess_min[bi] <= ex_e) & (ex_s <= ends_all[bi])
+            b1 = bi[ov]
+            if len(b1):
+                s1 = slots[b1]
+                ns_ = np.minimum(self._s_start[s1], sess_min[b1])
+                ne_ = np.maximum(self._s_end[s1], ends_all[b1])
+                changed = ne_ != self._s_end[s1]
+                self._s_start[s1] = ns_
+                self._s_end[s1] = ne_
+                sess_sid[b1] = self._s_sid[s1]
+                if changed.any():
+                    self._push_fires(ne_[changed],
+                                     sess_key[b1][changed],
+                                     self._s_sid[s1][changed])
+            slow_extra = bi[~ov]
+
+        # slow path: multi-flavored rows (everything not covered above)
+        # plus B2 (disjoint second sessions), in ascending (key, ts) order
+        covered = fast | fresh_stale | b
+        slow = np.nonzero(~covered)[0]
+        if slow_extra is not None and len(slow_extra):
+            slow = np.sort(np.concatenate([slow, slow_extra]))
         for j in slow:
             sess_sid[j] = self._merge_session(
                 int(sess_key[j]), int(sess_min[j]), int(ends_all[j]))
@@ -210,12 +354,12 @@ class SessionIntervalSet:
         or -1 if the session is stale on arrival. Mirrors
         MergingWindowSet.addWindow: overlapping intervals collapse into
         one; absorbed sessions queue an accumulator merge."""
-        intervals = self.sessions.get(key)
+        intervals = self._intervals_of(key)
         if intervals is None:
             if self._stale(end):
                 return -1
             sid = self._alloc_sid()
-            self.sessions[key] = [(start, end, sid)]
+            self._store_intervals(key, [(start, end, sid)])
             self._push_fire(end, key, sid)
             return sid
 
@@ -226,7 +370,7 @@ class SessionIntervalSet:
                 return -1
             sid = self._alloc_sid()
             intervals.append((start, end, sid))
-            intervals.sort()
+            self._store_intervals(key, intervals)
             self._push_fire(end, key, sid)
             return sid
 
@@ -239,10 +383,8 @@ class SessionIntervalSet:
             new_end = max(new_end, iv[1])
             self._add_merge(key, keep[2], iv[2])
         remaining = [iv for iv in intervals if iv not in overlapping]
-        merged = (new_start, new_end, keep[2])
-        remaining.append(merged)
-        remaining.sort()
-        self.sessions[key] = remaining
+        remaining.append((new_start, new_end, keep[2]))
+        self._store_intervals(key, remaining)
         if new_end != keep[1]:
             self._push_fire(new_end, key, keep[2])
         return keep[2]
@@ -264,8 +406,9 @@ class SessionIntervalSet:
         """All sessions whose end - 1 <= watermark, removed from the set.
         Returns (keys, starts, ends, sids) in end order. Stale candidates
         (merged or extended sessions) are skipped lazily — one vectorized
-        watermark cut selects the due candidates, per-session validation
-        runs only over those."""
+        watermark cut selects the due candidates, one vectorized
+        (sid, end) compare validates the single-store ones; only
+        multi-key candidates walk interval lists."""
         if watermark < self._min_pending_end - 1:
             # nothing can be due yet — O(1), the heap's cheap peek
             self.max_fired_watermark = max(self.max_fired_watermark,
@@ -293,29 +436,71 @@ class SessionIntervalSet:
                                       d_sids[order])
         else:
             d_ends = d_keys = d_sids = np.empty(0, dtype=np.int64)
-        keys: List[int] = []
-        starts: List[int] = []
-        ends: List[int] = []
-        sids: List[int] = []
-        sessions = self.sessions
-        for end, key, sid in zip(d_ends.tolist(), d_keys.tolist(),
-                                 d_sids.tolist()):
-            intervals = sessions.get(key)
-            if not intervals:
-                continue
-            cur = next((iv for iv in intervals if iv[2] == sid), None)
-            if cur is None or cur[1] != end:
-                continue  # stale entry
-            keys.append(key)
-            starts.append(cur[0])
-            ends.append(end)
-            sids.append(sid)
-            if len(intervals) == 1:
-                del sessions[key]
-            else:
-                intervals.remove(cur)
         self.max_fired_watermark = max(self.max_fired_watermark, watermark)
-        return keys, starts, ends, sids
+        if not len(d_ends):
+            return [], [], [], []
+
+        slots = self._idx.lookup(d_keys, d_keys)
+        sing = slots >= 0
+        valid = sing.copy()
+        if sing.any():
+            vs = slots[sing]
+            valid[sing] = ((self._s_sid[vs] == d_sids[sing])
+                           & (self._s_end[vs] == d_ends[sing]))
+        out_keys = d_keys[valid]
+        out_starts = self._s_start[slots[valid]]
+        out_ends = d_ends[valid]
+        out_sids = d_sids[valid]
+        if valid.any():
+            self._idx.free_slots(slots[valid].astype(np.int32))
+
+        rest = np.nonzero(~sing)[0]
+        if self._multi and len(rest):
+            ek, es, ee, esid = [], [], [], []
+            for j in rest.tolist():
+                key = int(d_keys[j])
+                sid, end = int(d_sids[j]), int(d_ends[j])
+                ivs = self._multi.get(key)
+                if not ivs:
+                    # the key may have demoted to the single store
+                    # earlier in THIS pop (a sibling session fired and
+                    # left exactly one) — validate there
+                    a = np.asarray([key], dtype=np.int64)
+                    slot = int(self._idx.lookup(a, a)[0])
+                    if (slot >= 0 and self._s_sid[slot] == sid
+                            and self._s_end[slot] == end):
+                        ek.append(key)
+                        es.append(int(self._s_start[slot]))
+                        ee.append(end)
+                        esid.append(sid)
+                        self._idx.free_slots(
+                            np.asarray([slot], dtype=np.int32))
+                    continue
+                cur = next((iv for iv in ivs if iv[2] == sid), None)
+                if cur is None or cur[1] != end:
+                    continue
+                ek.append(key)
+                es.append(cur[0])
+                ee.append(end)
+                esid.append(sid)
+                ivs.remove(cur)
+                if len(ivs) == 1:
+                    del self._multi[key]
+                    self._store_intervals(key, ivs)
+            if ek:
+                out_keys = np.concatenate([
+                    out_keys, np.asarray(ek, dtype=np.int64)])
+                out_starts = np.concatenate([
+                    out_starts, np.asarray(es, dtype=np.int64)])
+                out_ends = np.concatenate([
+                    out_ends, np.asarray(ee, dtype=np.int64)])
+                out_sids = np.concatenate([
+                    out_sids, np.asarray(esid, dtype=np.int64)])
+                o = np.argsort(out_ends, kind="stable")
+                out_keys, out_starts = out_keys[o], out_starts[o]
+                out_ends, out_sids = out_ends[o], out_sids[o]
+        return (out_keys.tolist(), out_starts.tolist(),
+                out_ends.tolist(), out_sids.tolist())
 
     # -------------------------------------------------------------- snapshot
 
@@ -328,10 +513,17 @@ class SessionIntervalSet:
 
     def restore(self, snap: Dict[str, object],
                 key_group_filter=None, max_parallelism: int = 128) -> None:
-        self.sessions = {}
+        self._idx = make_slot_index(1 << 16, on_grow=self._on_grow,
+                                    track_namespaces=False)
+        cap = self._idx.capacity
+        self._s_start = np.zeros(cap, dtype=np.int64)
+        self._s_end = np.zeros(cap, dtype=np.int64)
+        self._s_sid = np.zeros(cap, dtype=np.int64)
+        self._multi = {}
         self._fire_chunks = []
         self._fire_buf = []
         self._min_pending_end = 1 << 62
+        sk, ss, se, ssid = [], [], [], []
         for k, ivs in snap.get("sessions", {}).items():
             kept = [tuple(iv) for iv in ivs]
             if key_group_filter is not None:
@@ -341,8 +533,23 @@ class SessionIntervalSet:
                                           max_parallelism)[0])
                 if g not in key_group_filter:
                     continue
-            self.sessions[int(k)] = kept
-            for start, end, sid in kept:
-                self._push_fire(end, int(k), sid)
+            if len(kept) == 1:
+                s, e, sid = kept[0]
+                sk.append(int(k))
+                ss.append(int(s))
+                se.append(int(e))
+                ssid.append(int(sid))
+            else:
+                self._multi[int(k)] = sorted(kept)
+                for start, end, sid in kept:
+                    self._push_fire(end, int(k), sid)
+        if sk:
+            keys = np.asarray(sk, dtype=np.int64)
+            slots = self._idx.lookup_or_insert(keys, keys)
+            self._s_start[slots] = ss
+            self._s_end[slots] = se
+            self._s_sid[slots] = ssid
+            self._push_fires(np.asarray(se, dtype=np.int64), keys,
+                             np.asarray(ssid, dtype=np.int64))
         self._next_sid = snap.get("next_sid", 1)
         self.max_fired_watermark = snap.get("max_fired_watermark", _NEG_INF)

@@ -83,9 +83,23 @@ class SessionWindower:
         # additional partial result) since fired sessions are freed eagerly;
         # records beyond the allowance are dropped.
         self.allowed_lateness = int(allowed_lateness)
+        spill_kwargs = dict(spill or {})
+        if spill_kwargs.get("max_device_slots"):
+            # sessions are one row per namespace (sid) — the paged spill
+            # layout moves eviction cohorts instead of per-session
+            # entries (reference: RocksDB block granularity;
+            # slot_table.py spill_layout="pages")
+            spill_kwargs.setdefault("spill_layout", "pages")
+        if spill_kwargs.get("spill_layout", "pages") == "pages":
+            # this windower frees by SLOT (free_rows /
+            # free_index_only_slots) — skip the per-namespace registry,
+            # which costs O(sessions) Python per batch at one row per
+            # sid. An explicit spill_layout="namespaces" keeps the
+            # registry: its eviction path walks it.
+            spill_kwargs.setdefault("track_namespaces", False)
         self.table = SlotTable(agg, capacity=capacity,
                                max_parallelism=max_parallelism,
-                               **(spill or {}))
+                               **spill_kwargs)
         self.meta = SessionIntervalSet(self.gap, self.allowed_lateness)
 
     @property
@@ -154,9 +168,10 @@ class SessionWindower:
             self.table.accs,
             pad_i32(dst_slots, size, fill=0),
             pad_i32(src_slots, size, fill=0))
-        # absorbed host slots are only reusable once their values have moved
-        # (free_index_only: the merge kernel already reset the device slots)
-        self.table.free_index_only(g.absorbed_sids)
+        # absorbed host slots are only reusable once their values have
+        # moved (the merge kernel already reset the device slots); the
+        # slots are in hand, so the free needs no registry walk
+        self.table.free_index_only_slots(src_slots, g.absorbed_sids)
 
     # ------------------------------------------------------------------ fire
 
@@ -197,7 +212,7 @@ class SessionWindower:
                 # the reset is device-queue-ordered BEHIND the fire
                 # kernel, so the deferred host read never races it
                 pending = self.table.fire_async(matrix, None)
-                self.table.free_namespaces(fired_sids[a:b])
+                self.table.free_rows(fired_slots, fired_sids[a:b])
                 if pending is None:
                     continue
                 inner = pending.build
@@ -212,7 +227,7 @@ class SessionWindower:
                 out.append(pending)
                 continue
             results = self.table.fire(matrix)
-            self.table.free_namespaces(fired_sids[a:b])
+            self.table.free_rows(fired_slots, fired_sids[a:b])
             cols.update(results)
             out.append(RecordBatch(cols))
         return out

@@ -1,0 +1,167 @@
+"""Paged spill layout (spill_layout="pages") — session-shaped state
+(one row per namespace, millions of namespaces) under a device budget.
+
+reference: RocksDBKeyedStateBackend.java — block-granular storage under
+a small memory budget; the unit of movement is an eviction cohort, not
+one namespace.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
+from flink_tpu.state.slot_table import SlotTable, SlotTableFullError
+from flink_tpu.windowing.aggregates import SumAggregate
+from flink_tpu.windowing.sessions import SessionWindower
+
+
+def mk(capacity=2048, **kw):
+    return SlotTable(SumAggregate("v"), capacity=capacity,
+                     max_device_slots=capacity, spill_layout="pages",
+                     track_namespaces=False, **kw)
+
+
+def put(t, keys, sids, vals, chunk=1024):
+    """Feed in sub-budget chunks (one batch's working set must fit the
+    device — the irreducible contract of a bounded table)."""
+    keys = np.asarray(keys, dtype=np.int64)
+    sids = np.asarray(sids, dtype=np.int64)
+    vals = np.asarray(vals, dtype=np.float32)
+    slots = None
+    for a in range(0, len(keys), chunk):
+        slots = t.lookup_or_insert(keys[a:a + chunk], sids[a:a + chunk])
+        t.scatter(slots, (vals[a:a + chunk],))
+    return slots
+
+
+class TestPagedSlotTable:
+    def test_eviction_and_transparent_reload(self):
+        t = mk()
+        # 8k session rows >> 2047 device slots: cold cohorts page out
+        n = 8192
+        keys = np.arange(1, n + 1, dtype=np.int64)
+        sids = np.arange(1, n + 1, dtype=np.int64)
+        for a in range(0, n, 1024):
+            put(t, keys[a:a + 1024], sids[a:a + 1024],
+                np.full(1024, 2.0))
+        assert len(t.spill) > 0
+        # touching early (spilled) rows reloads them with values intact
+        slots = t.lookup_or_insert(keys[:64], sids[:64])
+        t.scatter(slots, (np.ones(64, dtype=np.float32),))
+        q = t.query(int(keys[0]), namespace=int(sids[0]))
+        assert q[int(sids[0])]["sum_v"] == 3.0
+
+    def test_snapshot_covers_all_tiers_and_restores(self):
+        t = mk()
+        n = 6000
+        keys = np.arange(1, n + 1, dtype=np.int64)
+        sids = keys * 7
+        put(t, keys, sids, np.full(n, 1.5))
+        snap = t.snapshot()
+        assert len(snap["key_id"]) == n  # resident + paged rows
+
+        t2 = mk()
+        t2.restore(snap)
+        # every row readable after restore (reload by page)
+        for k in (1, 2999, 5999):
+            q = t2.query(k, namespace=k * 7)
+            assert q[k * 7]["sum_v"] == 1.5
+
+    def test_free_rows_drops_sessions_everywhere(self):
+        t = mk()
+        n = 6000
+        keys = np.arange(1, n + 1, dtype=np.int64)
+        sids = keys + 10
+        put(t, keys, sids, np.full(n, 1.0))
+        # free a resident chunk (the most recent rows stay resident)
+        slots = t.lookup_or_insert(keys[-100:], sids[-100:])
+        t.free_rows(slots, sids[-100:])
+        # free spilled sessions (dead set): the oldest rows paged out
+        spilled_mask = t._spilled_mask(sids[:100])
+        assert spilled_mask.any()
+        dead = sids[:100][spilled_mask]
+        # paged free of non-resident sessions records them dead
+        t._dead_spilled.update(dead.tolist())
+        keep = ~np.isin(t._sp_ns, dead)
+        t._sp_ns, t._sp_page = t._sp_ns[keep], t._sp_page[keep]
+        snap = t.snapshot()
+        got = set(int(x) for x in snap["namespace"])
+        assert not (set(dead.tolist()) & got)
+        assert not (set(int(s) for s in sids[-100:]) & got)
+
+    def test_reload_rebundles_unrequested_rows(self):
+        t = mk()
+        n = 6000
+        keys = np.arange(1, n + 1, dtype=np.int64)
+        sids = keys
+        put(t, keys, sids, np.full(n, 4.0))
+        pages_before = len(t.spill)
+        assert pages_before > 0
+        # request ONE old session: its page pops, the sibling rows
+        # re-bundle into a fresh page instead of flooding the device
+        t.lookup_or_insert(keys[:1], sids[:1])
+        assert len(t.spill) >= pages_before  # rest re-bundled
+        # and the sibling rows are still intact
+        q = t.query(2, namespace=2)
+        assert q[2]["sum_v"] == 4.0
+
+    def test_budget_exhaustion_raises(self):
+        t = mk(capacity=1024)
+        keys = np.arange(1, 1200, dtype=np.int64)
+        with pytest.raises(SlotTableFullError):
+            put(t, keys, keys, np.ones(len(keys)))
+
+    def test_incremental_delta_covers_dirty_page_rows(self):
+        t = mk()
+        n = 4000
+        keys = np.arange(1, n + 1, dtype=np.int64)
+        put(t, keys, keys, np.full(n, 1.0))  # dirty rows page out
+        delta = t.snapshot_delta()
+        got = {(int(k), int(ns)): float(v) for k, ns, v in zip(
+            delta["key_id"], delta["namespace"], delta["leaf_0"])}
+        # every row (resident or paged) was dirty since job start
+        assert len(got) == n
+        assert got[(1, 1)] == 1.0
+
+
+def _sessions_run(spill):
+    w = SessionWindower(2000, SumAggregate("value", np.float64),
+                        capacity=1 << 12, spill=spill)
+    rng = np.random.default_rng(5)
+    outs = []
+    wm = 0
+    for i in range(12):
+        B = 4096
+        ts = np.sort(rng.integers(wm + 1, wm + 40_000, size=B))
+        keys = rng.integers(0, 200_000, size=B)
+        b = RecordBatch({KEY_ID_FIELD: keys.astype(np.int64),
+                         "value": np.ones(B),
+                         TIMESTAMP_FIELD: ts.astype(np.int64)})
+        w.process_batch(b)
+        wm += 40_000
+        outs.extend(w.on_watermark(wm))
+    outs.extend(w.on_watermark(1 << 60))
+    rows = {}
+    for o in outs:
+        for k, s, v in zip(o[KEY_ID_FIELD].tolist(),
+                           o["window_start"].tolist(),
+                           o["sum_value"].tolist()):
+            rows[(int(k), int(s))] = rows.get((int(k), int(s)), 0) + v
+    return rows
+
+
+def test_session_windower_paged_equals_unbounded():
+    """Sessions through the paged spill tier == sessions with no budget,
+    at a live set far beyond the device slots."""
+    bounded = _sessions_run({"max_device_slots": 1 << 12})
+    unbounded = _sessions_run(None)
+    assert bounded == unbounded
+
+
+def test_session_windower_explicit_namespaces_layout_still_works():
+    """An explicit spill_layout='namespaces' keeps the registry-driven
+    eviction path functional (track_namespaces must stay on for it)."""
+    bounded = _sessions_run({"max_device_slots": 1 << 12,
+                             "spill_layout": "namespaces"})
+    unbounded = _sessions_run(None)
+    assert bounded == unbounded
