@@ -83,11 +83,20 @@ def run(total_records: int, num_auctions: int = 100_000,
     from flink_tpu.benchmarks.nexmark import BidSource, build_q5
     from flink_tpu.connectors.sinks import CollectSink
 
+    import jax
+
+    on_tpu = jax.default_backend() not in ("cpu",)
     if batch_size is None:
-        # 1M-row micro-batches amortize the tunneled link's ~64 ms
-        # per-round-trip latency (measured 2026-07-30: 131k-row batches
-        # cap at ~0.9M ev/s, 1M-row at ~4M ev/s on the same chip)
-        batch_size = int(os.environ.get("BENCH_BATCH_SIZE", 1 << 20))
+        # Platform-conditional defaults (swept 2026-07-30/31):
+        # - TPU behind the tunnel (~64 ms RTT): 1M-row batches amortize
+        #   the round trip (131k-row batches cap at ~0.9M ev/s, 1M-row
+        #   at ~5.8M on the same chip); dispatch-ahead 8 hides the RTT.
+        # - CPU: 64k-row batches + dispatch-ahead 1 measured BOTH the
+        #   best throughput (3.28M ev/s) and fire p50/p99 = 41/91 ms
+        #   over 204 samples — deep pipelining only queues fires behind
+        #   scatter work when the "device" is the same core.
+        batch_size = int(os.environ.get(
+            "BENCH_BATCH_SIZE", 1 << 20 if on_tpu else 1 << 16))
     env = StreamExecutionEnvironment(Configuration({
         "execution.micro-batch.size": batch_size,
         # headroom above the live (key x slice) footprint so ring/column
@@ -98,7 +107,7 @@ def run(total_records: int, num_auctions: int = 100_000,
         # link (the tunneled TPU): deeper hides the RTT per batch,
         # shallower keeps fire kernels from queueing behind scatters
         "execution.pipeline.max-dispatch-batches": int(
-            os.environ.get("BENCH_DISPATCH_AHEAD", 8)),
+            os.environ.get("BENCH_DISPATCH_AHEAD", 8 if on_tpu else 1)),
     }))
     sink = CollectSink()
     # 100k events/s of event time -> a 2 s slide covers ~200k events, a 10 s
@@ -160,7 +169,15 @@ def main():
     # incumbent — the headline must never regress on an unmeasured layout.
     stats = None
     best_layout = None
-    for layout in ("panes", "slots"):
+    import jax as _jax
+
+    # On CPU the pane layout is not competitive (measured 2026-07-31:
+    # 185k ev/s vs slots' 3.28M — its dense per-fire reductions only pay
+    # off when they delete host->device transfers); don't spend minutes
+    # measuring it there.
+    layouts = (("panes", "slots")
+               if _jax.default_backend() not in ("cpu",) else ("slots",))
+    for layout in layouts:
         try:
             # Warmup must cover the FIRE path too: at 100k events/s of
             # event time the first HOP window closes at 2 s, so the warmup
