@@ -429,11 +429,19 @@ def register_shuffle_service(name: str,
     _FACTORIES[name] = factory
 
 
+#: built-in services that register themselves on import — configuring
+#: shuffle.service must not require the user to import the module
+_LAZY_MODULES = {
+    "grpc": "flink_tpu.cluster.rpc_shuffle",
+    "sort-merge": "flink_tpu.runtime.sort_merge_shuffle",
+}
+
+
 def create_shuffle_service(name: str = "local") -> ShuffleService:
-    if name not in _FACTORIES and name == "grpc":
-        # the gRPC transport registers itself on import; configuring
-        # shuffle.service=grpc must not require the user to import it
-        import flink_tpu.cluster.rpc_shuffle  # noqa: F401
+    if name not in _FACTORIES and name in _LAZY_MODULES:
+        import importlib
+
+        importlib.import_module(_LAZY_MODULES[name])
     try:
         factory = _FACTORIES[name]
     except KeyError:

@@ -1,0 +1,274 @@
+"""Sort-merge (blocking, file-backed) shuffle — the batch data plane.
+
+reference: flink-runtime/.../io/network/partition/SortMergeResultPartition.java:1
+— for high-parallelism batch jobs the pipelined per-subpartition buffers
+are replaced by ONE spill file per producer partition: records buffer in
+memory, sort by subpartition when the budget fills, and append as a
+*region* whose per-subpartition byte ranges go into an index
+(PartitionedFileWriter). Consumers read their subpartition's ranges
+sequentially (SortMergePartitionedFileReader), turning P x C random
+small reads into a few sequential scans.
+
+Columnar re-design: the buffered unit is a RecordBatch, so "sorting by
+subpartition" is grouping already-split batches — no per-record sort at
+all. A region flush concatenates each subpartition's buffered batches,
+encodes them with the native framed codec (LZ + CRC,
+flink_tpu/native/codec.py), and appends one contiguous range per
+subpartition. Events (barriers, END_OF_PARTITION) keep their order
+relative to data: an event forces a region flush and is recorded in
+each subpartition's item stream.
+
+The transport is BLOCKING in the reference sense — data is readable as
+soon as its region is flushed (the hybrid-shuffle property), and
+backpressure is the disk, not credits. Select with
+``shuffle.service: sort-merge`` (stage/batch pipelines).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from flink_tpu.core.records import RecordBatch
+from flink_tpu.runtime.shuffle_spi import (
+    END_OF_PARTITION,
+    InputGate,
+    ResultPartitionWriter,
+    ShuffleService,
+    register_shuffle_service,
+)
+
+
+def _encode(batch: RecordBatch) -> bytes:
+    from flink_tpu.native.codec import codec_available, encode_batch
+
+    if codec_available():
+        return b"C" + encode_batch(batch)
+    import pickle
+
+    return b"P" + pickle.dumps(dict(batch.columns))
+
+
+def _decode(data: bytes) -> RecordBatch:
+    if data[:1] == b"C":
+        from flink_tpu.native.codec import decode_batch
+
+        return decode_batch(data[1:])
+    import pickle
+
+    return RecordBatch(pickle.loads(data[1:]))
+
+
+class _SMPartition:
+    """One producer partition: a single spill file + per-subpartition
+    item streams (byte ranges and in-band events, in emission order)."""
+
+    def __init__(self, partition_id: str, num_subpartitions: int,
+                 directory: str):
+        self.partition_id = partition_id
+        self.num_subpartitions = num_subpartitions
+        self.path = os.path.join(
+            directory, f"{partition_id.replace('/', '_')}.data")
+        self._f = open(self.path, "wb")
+        self._offset = 0
+        #: per subpartition: [("range", offset, length) | ("event", ev)]
+        self.items: List[List[Tuple]] = [
+            [] for _ in range(num_subpartitions)]
+        self.finished = False
+        self.lock = threading.Lock()
+        self.grew = threading.Condition(self.lock)
+        self.regions = 0
+
+    def ensure(self, num: int) -> None:
+        with self.lock:
+            while len(self.items) < num:
+                self.items.append([])
+            self.num_subpartitions = max(self.num_subpartitions, num)
+
+    def append_region(self, per_sub: Dict[int, List[RecordBatch]]) -> None:
+        """Write one region: each subpartition's buffered batches become
+        one contiguous encoded range (the PartitionedFileWriter step)."""
+        blobs = []
+        for sub in sorted(per_sub):
+            batches = per_sub[sub]
+            if not batches:
+                continue
+            merged = (batches[0] if len(batches) == 1
+                      else RecordBatch.concat(batches))
+            blobs.append((sub, _encode(merged)))
+        with self.lock:
+            for sub, blob in blobs:
+                self._f.write(blob)
+                self.items[sub].append(
+                    ("range", self._offset, len(blob)))
+                self._offset += len(blob)
+            if blobs:
+                self._f.flush()  # readable as soon as flushed (hybrid)
+                self.regions += 1
+            self.grew.notify_all()
+
+    def append_event(self, event) -> None:
+        with self.lock:
+            for stream in self.items:
+                stream.append(("event", event))
+            self.grew.notify_all()
+
+    def finish(self) -> None:
+        with self.lock:
+            self.finished = True
+            self._f.close()
+            self.grew.notify_all()
+
+
+class SortMergeWriter(ResultPartitionWriter):
+    """Buffers emitted batches up to a byte budget, then flushes a
+    region (reference: SortBuffer + flush at capacity)."""
+
+    def __init__(self, partition: _SMPartition, budget_bytes: int):
+        self.partition = partition
+        self.budget = budget_bytes
+        self._buf: Dict[int, List[RecordBatch]] = {}
+        self._buffered = 0
+
+    def emit(self, subpartition: int, batch: RecordBatch) -> None:
+        if batch is None or len(batch) == 0:
+            return
+        self._buf.setdefault(subpartition, []).append(batch)
+        self._buffered += sum(
+            getattr(c, "nbytes", 64) for c in batch.columns.values())
+        if self._buffered >= self.budget:
+            self._flush()
+
+    def _flush(self) -> None:
+        if self._buf:
+            self.partition.append_region(self._buf)
+            self._buf = {}
+            self._buffered = 0
+
+    def broadcast_event(self, event) -> None:
+        # order-preserving: pending data must land before the event
+        self._flush()
+        self.partition.append_event(event)
+
+    def close(self) -> None:
+        self.broadcast_event(END_OF_PARTITION)
+        self.partition.finish()
+
+
+class SortMergeGate(InputGate):
+    """Sequential reader over each producer's subpartition ranges."""
+
+    def __init__(self, partitions: List[_SMPartition], subpartition: int):
+        self._parts = partitions
+        self._sub = subpartition
+        self.num_channels = len(partitions)
+        self._cursor = [0] * len(partitions)
+        self._files: List[Optional[object]] = [None] * len(partitions)
+        self._rr = 0
+
+    def _read(self, ch: int, item) -> object:
+        kind = item[0]
+        if kind == "event":
+            return item[1]
+        _, offset, length = item
+        f = self._files[ch]
+        if f is None:
+            f = open(self._parts[ch].path, "rb")
+            self._files[ch] = f
+        f.seek(offset)
+        return _decode(f.read(length))
+
+    def poll(self, timeout: float = 0.0):
+        import time as _t
+
+        deadline = _t.monotonic() + timeout if timeout else None
+        while True:
+            for i in range(self.num_channels):
+                ch = (self._rr + i) % self.num_channels
+                part = self._parts[ch]
+                with part.lock:
+                    cur = self._cursor[ch]
+                    stream = part.items[self._sub] \
+                        if self._sub < len(part.items) else []
+                    if cur >= len(stream):
+                        continue
+                    item = stream[cur]
+                    self._cursor[ch] = cur + 1
+                self._rr = (ch + 1) % self.num_channels
+                return ch, self._read(ch, item)
+            if deadline is None:
+                return None
+            remaining = deadline - _t.monotonic()
+            if remaining <= 0:
+                return None
+            # wait for any producer to flush a region or finish
+            part = self._parts[self._rr]
+            with part.lock:
+                if self._cursor[self._rr] >= len(
+                        part.items[self._sub]
+                        if self._sub < len(part.items) else []):
+                    part.grew.wait(timeout=min(0.05, remaining))
+
+    def take_inflight(self, channel: int, checkpoint_id: int) -> list:
+        return []  # blocking shuffle: nothing is in flight to persist
+
+    def close(self) -> None:
+        for f in self._files:
+            if f is not None:
+                f.close()
+
+
+class SortMergeShuffleService(ShuffleService):
+    """reference: SortMergeResultPartition + its ShuffleServiceFactory
+    wiring. One spill directory per service instance; partitions create
+    lazily from either side (producer or consumer may register first)."""
+
+    def __init__(self, spill_dir: Optional[str] = None,
+                 memory_budget_bytes: int = 16 << 20):
+        self._own_dir = spill_dir is None
+        self.directory = spill_dir or tempfile.mkdtemp(
+            prefix="flink-tpu-sort-merge-")
+        os.makedirs(self.directory, exist_ok=True)
+        self.budget = int(memory_budget_bytes)
+        self._parts: Dict[str, _SMPartition] = {}
+        self._lock = threading.Lock()
+
+    def _partition(self, partition_id: str,
+                   num_subpartitions: int) -> _SMPartition:
+        with self._lock:
+            part = self._parts.get(partition_id)
+            if part is None:
+                part = _SMPartition(partition_id, num_subpartitions,
+                                    self.directory)
+                self._parts[partition_id] = part
+            else:
+                part.ensure(num_subpartitions)
+            return part
+
+    def create_partition(self, partition_id: str, num_subpartitions: int,
+                         credits_per_channel: Optional[int] = None
+                         ) -> ResultPartitionWriter:
+        return SortMergeWriter(
+            self._partition(partition_id, num_subpartitions), self.budget)
+
+    def create_gate(self, partition_ids: Sequence[str], subpartition: int
+                    ) -> InputGate:
+        parts = [self._partition(pid, subpartition + 1)
+                 for pid in partition_ids]
+        return SortMergeGate(parts, subpartition)
+
+    def cancel(self) -> None:
+        pass
+
+    def close(self) -> None:
+        for part in self._parts.values():
+            if not part.finished:
+                part.finish()
+        if self._own_dir:
+            shutil.rmtree(self.directory, ignore_errors=True)
+
+
+register_shuffle_service("sort-merge", SortMergeShuffleService)
