@@ -5,7 +5,8 @@ dashboard. Scope here: the JSON monitoring surface the reference's dashboard
 reads — cluster overview, job list, per-job status/metrics — served from a
 background http.server thread.
 
-GET  /ui                     single-file HTML dashboard over this surface
+GET  /ui, /ui/<asset>        the web dashboard (multi-view SPA,
+                             flink_tpu/web/ — the flink-runtime-web role)
 GET  /overview               cluster totals
 GET  /jobs                   job summaries
 GET  /jobs/<id>              one job's status
@@ -34,11 +35,18 @@ class RestServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
-                if self.path.split("?")[0] in ("/ui", "/index.html"):
-                    body = _DASHBOARD_HTML.encode()
+                # "/" keeps serving the overview JSON (API compat);
+                # the SPA lives under /ui
+                clean = self.path.split("?")[0]
+                if clean in ("/ui", "/index.html") \
+                        or clean.startswith("/ui/"):
+                    body, ctype = rest._static(clean)
+                    if body is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
                     self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/html; charset=utf-8")
+                    self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
@@ -99,6 +107,28 @@ class RestServer:
         self._thread.start()
 
     # ------------------------------------------------------------- routing
+
+    #: dashboard assets (flink_tpu/web — the flink-runtime-web
+    #: web-dashboard role: a real multi-view SPA over this REST surface)
+    _STATIC_TYPES = {".html": "text/html; charset=utf-8",
+                     ".js": "application/javascript; charset=utf-8",
+                     ".css": "text/css; charset=utf-8"}
+
+    def _static(self, clean_path: str):
+        import os
+
+        name = clean_path[len("/ui/"):] if clean_path.startswith("/ui/") \
+            else "index.html"
+        if not name or "/" in name or name.startswith("."):
+            return None, None
+        web = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "web")
+        path = os.path.join(web, name)
+        ext = os.path.splitext(name)[1]
+        if ext not in self._STATIC_TYPES or not os.path.isfile(path):
+            return None, None
+        with open(path, "rb") as f:
+            return f.read(), self._STATIC_TYPES[ext]
 
     def _route(self, path: str):
         parts = [p for p in path.split("?")[0].split("/") if p]
@@ -265,62 +295,3 @@ def _version() -> str:
         return __version__
     except Exception:  # pragma: no cover
         return "unknown"
-
-
-#: Minimal single-file dashboard over the JSON surface (the reference
-#: ships an Angular app in flink-runtime-web; this is the same monitoring
-#: content — overview, executors, jobs, drill-down metrics, flame graph
-#: links — rendered client-side from the REST endpoints above).
-_DASHBOARD_HTML = """<!doctype html>
-<html><head><meta charset="utf-8"><title>flink_tpu dashboard</title>
-<style>
- body{font-family:system-ui,sans-serif;margin:2rem;max-width:70rem}
- h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.6rem}
- table{border-collapse:collapse;width:100%;font-size:.9rem}
- td,th{border:1px solid #ccc;padding:.3rem .6rem;text-align:left}
- th{background:#f2f2f2} code{background:#f6f6f6;padding:0 .2rem}
- .FINISHED{color:#1a7f37}.RUNNING{color:#0969da}.FAILED{color:#cf222e}
-</style></head><body>
-<h1>flink_tpu cluster</h1>
-<div id="overview"></div>
-<h2>Task executors</h2><table id="executors"></table>
-<h2>Jobs</h2><table id="jobs"></table>
-<h2>Job detail</h2><pre id="detail">click a job id above</pre>
-<script>
-async function j(p){return (await fetch(p)).json()}
-function esc(x){return String(x).replace(/[&<>"']/g,
-  c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]))}
-function row(cells,tag){return "<tr>"+cells.map(c=>`<${tag}>${c}</${tag}>`).join("")+"</tr>"}
-async function refresh(){
-  const o=await j("/overview");
-  const ex=await j("/taskexecutors");
-  const free=(ex.taskexecutors||[]).reduce((a,e)=>a+(e.slots_free||0),0);
-  document.getElementById("overview").innerHTML=
-    `<p>slots: ${free} free / ${o.slots_total??"?"} total — `+
-    `jobs running: ${(o.jobs&&o.jobs.RUNNING)||0}</p>`;
-  document.getElementById("executors").innerHTML=
-    row(["id","slots free","slots total"],"th")+
-    (ex.taskexecutors||[]).map(e=>row(
-      [esc(e.id),esc(e.slots_free),esc(e.slots_total)],"td")).join("");
-  const js=await j("/jobs");
-  document.getElementById("jobs").innerHTML=
-    row(["job id","name","status"],"th")+
-    (js.jobs||[]).map(x=>{
-      const id=encodeURIComponent(x.job_id);
-      return row(
-      [`<a href="#" onclick="detail('${id}');return false">${esc(x.job_id)}</a>`,
-       esc(x.job_name??x.name??""),
-       `<span class="${esc(x.status)}">${esc(x.status)}</span>`+
-       ` <a href="/jobs/${id}/flamegraph?duration_ms=150">flame</a>`],
-      "td")}).join("");
-}
-async function detail(id){
-  const d=await j("/jobs/"+id);
-  let m={};
-  try{m=await j("/jobs/"+id+"/metrics")}catch(e){}
-  document.getElementById("detail").textContent=
-    JSON.stringify({status:d,metrics:m},null,2);
-}
-refresh();setInterval(refresh,2000);
-</script></body></html>
-"""

@@ -126,8 +126,32 @@ class TestDashboard:
             with urllib.request.urlopen(f"{base}/ui", timeout=10) as resp:
                 assert "text/html" in resp.headers["Content-Type"]
                 html = resp.read().decode()
-            assert "flink_tpu cluster" in html
-            assert "/taskexecutors" in html  # renders from the JSON surface
+            assert "flink_tpu dashboard" in html
+            assert "/ui/app.js" in html  # the SPA shell loads the app
+            # the app and stylesheet serve with correct types
+            with urllib.request.urlopen(f"{base}/ui/app.js",
+                                        timeout=10) as resp:
+                assert "javascript" in resp.headers["Content-Type"]
+                js = resp.read().decode()
+            assert "/taskexecutors" in js  # renders from the JSON surface
+            assert "flamegraph" in js
+            with urllib.request.urlopen(f"{base}/ui/style.css",
+                                        timeout=10) as resp:
+                assert "text/css" in resp.headers["Content-Type"]
+            # path traversal / hidden files are rejected even with an
+            # allowed extension (pins the guard, not the type filter)
+            import urllib.error
+
+            for probe in ("/ui/..%2Fweb%2Fapp.js", "/ui/.hidden.js",
+                          "/ui/..%2Frest.py"):
+                try:
+                    urllib.request.urlopen(base + probe, timeout=10)
+                    assert False, f"{probe} should 404"
+                except urllib.error.HTTPError as e:
+                    assert e.code == 404
+            # "/" still serves the overview JSON (API compat)
+            with urllib.request.urlopen(f"{base}/", timeout=10) as resp:
+                assert "application/json" in resp.headers["Content-Type"]
             # the JSON surface itself is untouched
             import json
 
