@@ -456,6 +456,8 @@ class AvroRowDeserializationSchema(DeserializationSchema):
 
 
 class AvroRowSerializationSchema(SerializationSchema):
+    binary = True  # varint-encoded payloads may contain any byte
+
     def __init__(self, columns: Sequence[str], schema):
         self.columns = list(columns)
         self.schema = schema

@@ -72,6 +72,10 @@ class DeserializationSchema:
 class SerializationSchema:
     """one columnar batch -> raw byte records."""
 
+    #: True when records are arbitrary binary (may contain newlines) —
+    #: file sinks must length-prefix instead of newline-framing them
+    binary = False
+
     def open(self) -> None:
         pass
 
