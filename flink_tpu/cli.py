@@ -194,6 +194,48 @@ def cmd_taskexecutor(args) -> int:
     return 0
 
 
+def cmd_deploy(args) -> int:
+    """Kubernetes deployment driver (reference:
+    KubernetesClusterDescriptor / KubernetesResourceManagerDriver)."""
+    import json as _json
+
+    from flink_tpu.cluster.deployment import (
+        KubectlClient,
+        KubernetesDeployment,
+    )
+
+    if args.action == "scale" and args.task_executors is None:
+        print("deploy scale requires an explicit --task-executors count "
+              "(refusing to silently scale to a default)",
+              file=sys.stderr)
+        return 2
+    dep = KubernetesDeployment(
+        args.cluster_id, config=_props_config(args.define),
+        image=args.image,
+        task_executors=(args.task_executors
+                        if args.task_executors is not None else 2),
+        slots_per_executor=args.slots,
+        tpus_per_executor=args.tpus_per_executor,
+        tpu_accelerator=args.tpu_accelerator,
+        tpu_topology=args.tpu_topology,
+        client=KubectlClient(namespace=args.namespace))
+    if args.action == "kubernetes":
+        if args.dry_run:
+            for m in dep.manifests():
+                print(_json.dumps(m, indent=2))
+            return 0
+        dep.deploy()
+        print(f"deployed {dep.jm_name} + {dep.te_name} "
+              f"(x{args.task_executors})")
+    elif args.action == "scale":
+        dep.scale_task_executors(args.task_executors)
+        print(f"scaled {dep.te_name} to {args.task_executors}")
+    else:
+        dep.teardown()
+        print(f"tore down cluster {args.cluster_id}")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="flink-tpu",
                                 description=__doc__.split("\n")[0])
@@ -216,6 +258,26 @@ def main(argv=None) -> int:
     pt.add_argument("--slots", type=int, default=None)
     pt.add_argument("-D", dest="define", action="append", metavar="K=V")
     pt.set_defaults(fn=cmd_taskexecutor)
+
+    pk = sub.add_parser(
+        "deploy", help="deploy / scale / tear down a Kubernetes cluster "
+        "(reference: flink-kubernetes session deployment)")
+    pk.add_argument("action", choices=["kubernetes", "scale", "teardown"])
+    pk.add_argument("cluster_id")
+    pk.add_argument("--image", default="flink-tpu:latest")
+    pk.add_argument("--task-executors", type=int, default=None,
+                    help="worker replica count (default 2 for deploy; "
+                    "REQUIRED for scale)")
+    pk.add_argument("--slots", type=int, default=1)
+    pk.add_argument("--tpus-per-executor", type=int, default=0,
+                    help="google.com/tpu devices each worker pod requests")
+    pk.add_argument("--tpu-accelerator", default="tpu-v5-lite-podslice")
+    pk.add_argument("--tpu-topology", default="1x1")
+    pk.add_argument("--namespace", default="default")
+    pk.add_argument("--dry-run", action="store_true",
+                    help="print the manifests instead of applying them")
+    pk.add_argument("-D", dest="define", action="append", metavar="K=V")
+    pk.set_defaults(fn=cmd_deploy)
 
     pr = sub.add_parser("run", help="run a pipeline script")
     pr.add_argument("script")

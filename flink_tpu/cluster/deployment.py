@@ -1,0 +1,242 @@
+"""Container deployment drivers — Kubernetes manifests + active scaling.
+
+reference: flink-kubernetes (KubernetesResourceManagerDriver.java:1 —
+the active RM requesting/releasing worker pods through the k8s API;
+KubernetesClusterDescriptor deploying the JobManager Deployment +
+Service + ConfigMap; taskmanager pod templates). The YARN driver plays
+the same role on that stack; here Kubernetes is the container target.
+
+TPU re-design: a TaskExecutor pod is a TPU-host pod — the worker spec
+requests ``google.com/tpu`` device resources and pins the accelerator
+type via the TPU nodeSelectors GKE uses, so "give me a worker" means
+"give me chips". The control plane stays the standalone entrypoints
+(``flink-tpu jobmanager`` / ``flink-tpu taskexecutor``): Kubernetes
+only *schedules* them, exactly like the reference's native-k8s mode
+runs the same entrypoints in pods.
+
+Two layers:
+- :class:`KubernetesDeployment` — renders the full manifest set and
+  applies/scales/tears it down through a ``KubectlClient`` seam
+  (subprocess ``kubectl`` in production; faked in tests — this
+  environment has no cluster to talk to, so the seam IS the contract).
+- :class:`ElasticScaler` — the ResourceManagerDriver role: watches
+  unfulfilled slot demand and scales the TaskExecutor replica count,
+  the reference's requestResource/releaseResource loop expressed as
+  reconciliation (declarative replicas, like its
+  KubernetesResourceManagerDriver requesting pods to match declared
+  resources).
+"""
+
+from __future__ import annotations
+
+import json
+import shlex
+import subprocess
+from typing import Callable, Dict, List, Optional
+
+from flink_tpu.core.config import Configuration
+
+
+class KubectlClient:
+    """Thin seam over ``kubectl`` (the k8s API client role). Everything
+    the drivers need: apply JSON manifests, patch replicas, delete."""
+
+    def __init__(self, context: Optional[str] = None,
+                 namespace: str = "default"):
+        self.context = context
+        self.namespace = namespace
+
+    def _base(self) -> List[str]:
+        cmd = ["kubectl", "-n", self.namespace]
+        if self.context:
+            cmd += ["--context", self.context]
+        return cmd
+
+    def apply(self, manifest: dict) -> None:
+        subprocess.run(self._base() + ["apply", "-f", "-"],
+                       input=json.dumps(manifest).encode(), check=True)
+
+    def scale(self, deployment: str, replicas: int) -> None:
+        subprocess.run(self._base() + [
+            "scale", "deployment", deployment,
+            f"--replicas={int(replicas)}"], check=True)
+
+    def delete(self, kind: str, name: str) -> None:
+        subprocess.run(self._base() + [
+            "delete", kind, name, "--ignore-not-found=true"], check=True)
+
+
+def _config_args(config: Configuration) -> List[str]:
+    return [f"-D{k}={v}" for k, v in sorted(config.to_dict().items())]
+
+
+class KubernetesDeployment:
+    """Render + drive the cluster's Kubernetes resources (reference:
+    KubernetesClusterDescriptor.deploySessionCluster)."""
+
+    def __init__(self, cluster_id: str, config: Optional[Configuration]
+                 = None, image: str = "flink-tpu:latest",
+                 task_executors: int = 2, slots_per_executor: int = 1,
+                 tpus_per_executor: int = 0,
+                 tpu_accelerator: str = "tpu-v5-lite-podslice",
+                 tpu_topology: str = "1x1",
+                 client: Optional[KubectlClient] = None):
+        self.cluster_id = cluster_id
+        self.config = config or Configuration({})
+        self.image = image
+        self.task_executors = int(task_executors)
+        self.slots_per_executor = int(slots_per_executor)
+        self.tpus_per_executor = int(tpus_per_executor)
+        self.tpu_accelerator = tpu_accelerator
+        self.tpu_topology = tpu_topology
+        self.client = client or KubectlClient()
+
+    # ------------------------------------------------------- manifests
+
+    @property
+    def jm_name(self) -> str:
+        return f"{self.cluster_id}-jobmanager"
+
+    @property
+    def te_name(self) -> str:
+        return f"{self.cluster_id}-taskexecutor"
+
+    def _labels(self, component: str) -> Dict[str, str]:
+        return {"app": "flink-tpu", "cluster": self.cluster_id,
+                "component": component}
+
+    def jobmanager_manifests(self) -> List[dict]:
+        """JM Deployment (replicas=1) + Service exposing RPC + REST
+        (reference: the JM Deployment/rest-service the descriptor
+        creates)."""
+        labels = self._labels("jobmanager")
+        container = {
+            "name": "jobmanager",
+            "image": self.image,
+            "args": ["flink-tpu", "jobmanager",
+                     "--port", "6123", "--rest-port", "8081",
+                     *_config_args(self.config)],
+            "ports": [{"containerPort": 6123, "name": "rpc"},
+                      {"containerPort": 8081, "name": "rest"}],
+        }
+        deployment = {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": self.jm_name, "labels": labels},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": labels},
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": {"containers": [container]},
+                },
+            },
+        }
+        service = {
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": self.jm_name, "labels": labels},
+            "spec": {
+                "selector": labels,
+                "ports": [
+                    {"name": "rpc", "port": 6123, "targetPort": 6123},
+                    {"name": "rest", "port": 8081, "targetPort": 8081},
+                ],
+            },
+        }
+        return [deployment, service]
+
+    def taskexecutor_manifest(self) -> dict:
+        """TE Deployment: each replica is one worker registering with the
+        JM service; TPU workers request ``google.com/tpu`` devices and
+        pin the slice type/topology via the GKE TPU nodeSelectors
+        (reference: the worker pod template
+        KubernetesResourceManagerDriver requests)."""
+        labels = self._labels("taskexecutor")
+        container: dict = {
+            "name": "taskexecutor",
+            "image": self.image,
+            "args": ["flink-tpu", "taskexecutor",
+                     "--jobmanager", f"{self.jm_name}:6123",
+                     "--slots", str(self.slots_per_executor),
+                     *_config_args(self.config)],
+        }
+        pod_spec: dict = {"containers": [container]}
+        if self.tpus_per_executor:
+            container["resources"] = {
+                "requests": {"google.com/tpu": self.tpus_per_executor},
+                "limits": {"google.com/tpu": self.tpus_per_executor},
+            }
+            pod_spec["nodeSelector"] = {
+                "cloud.google.com/gke-tpu-accelerator":
+                    self.tpu_accelerator,
+                "cloud.google.com/gke-tpu-topology": self.tpu_topology,
+            }
+        return {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": self.te_name, "labels": labels},
+            "spec": {
+                "replicas": self.task_executors,
+                "selector": {"matchLabels": labels},
+                "template": {"metadata": {"labels": labels},
+                             "spec": pod_spec},
+            },
+        }
+
+    def manifests(self) -> List[dict]:
+        return self.jobmanager_manifests() + [self.taskexecutor_manifest()]
+
+    # --------------------------------------------------------- actions
+
+    def deploy(self) -> None:
+        for m in self.manifests():
+            self.client.apply(m)
+
+    def scale_task_executors(self, replicas: int) -> None:
+        self.task_executors = int(replicas)
+        self.client.scale(self.te_name, replicas)
+
+    def teardown(self) -> None:
+        self.client.delete("deployment", self.te_name)
+        self.client.delete("deployment", self.jm_name)
+        self.client.delete("service", self.jm_name)
+
+
+class ElasticScaler:
+    """The active ResourceManagerDriver role (reference:
+    KubernetesResourceManagerDriver.requestResource): reconcile the
+    worker replica count against observed slot demand.
+
+    ``demand_fn`` returns (slots_required, slots_in_use) — e.g. pending
+    slot requests and currently-allocated slots read over the RM's
+    gateway. The scaler converts shortage or surplus into ONE
+    declarative ``scale_task_executors`` call per reconcile, bounded by
+    [min_workers, max_workers]. Scale-down never drops below the
+    workers needed to hold the slots still IN USE — a bare
+    ``kubectl scale`` kills arbitrary pods, so the floor is what keeps
+    busy workers alive (the reference releases only idle-timed-out
+    workers; declaratively that is the same floor)."""
+
+    def __init__(self, deployment: KubernetesDeployment,
+                 demand_fn: Callable[[], tuple],
+                 slots_per_executor: Optional[int] = None,
+                 min_workers: int = 1, max_workers: int = 64):
+        self.deployment = deployment
+        self.demand_fn = demand_fn
+        self.slots_per = (slots_per_executor
+                          or deployment.slots_per_executor or 1)
+        self.min_workers = int(min_workers)
+        self.max_workers = int(max_workers)
+
+    def reconcile(self) -> Optional[int]:
+        """One reconcile step; returns the new replica count when a
+        scale was issued, None when already converged."""
+        required, in_use = self.demand_fn()
+
+        def ceil_workers(slots: int) -> int:
+            return -(-max(int(slots), 0) // self.slots_per)
+
+        want = max(ceil_workers(required), ceil_workers(in_use))
+        want = min(max(want, self.min_workers), self.max_workers)
+        if want != self.deployment.task_executors:
+            self.deployment.scale_task_executors(want)
+            return want
+        return None

@@ -4,7 +4,20 @@
 
 const $view = document.getElementById("view");
 let timer = null;          // per-view auto-refresh
+let navSeq = 0;            // navigation token: stale renders must not land
 const sparkHistory = {};   // metric -> ring of recent values (client-side)
+
+function renderGate() {
+  // capture at render start; check before writing $view — an await that
+  // resolves after the user navigated away must not clobber the new view
+  const seq = navSeq;
+  return () => seq === navSeq;
+}
+function editingInView() {
+  const el = document.activeElement;
+  return el && $view.contains(el) &&
+    /INPUT|TEXTAREA|SELECT/.test(el.tagName);
+}
 
 function esc(x) {
   return String(x).replace(/[&<>"']/g, c => ({
@@ -42,8 +55,10 @@ function refreshEvery(ms, fn) {
 async function viewOverview() {
   setNav("overview");
   const render = async () => {
+    const live = renderGate();
     const [ov, jobs] = await Promise.all([
       getJSON("/overview"), getJSON("/jobs")]);
+    if (!live()) return;
     document.getElementById("version").textContent =
       "v" + (ov.flink_tpu_version || "?");
     const counts = ov.jobs || {};
@@ -92,7 +107,9 @@ function bindJobRows() {
 async function viewExecutors() {
   setNav("executors");
   const render = async () => {
+    const live = renderGate();
     const data = await getJSON("/taskexecutors");
+    if (!live()) return;
     // in-process executors seed from heartbeat() ({id, slots_total,
     // slots_free}); remote ones from the RM registry ({executor_id,
     // slots, allocated, address}) — accept both shapes
@@ -119,6 +136,8 @@ async function viewExecutors() {
 async function viewJob(jobId) {
   setNav("");
   const render = async () => {
+    const live = renderGate();
+    if (editingInView()) return;  // don't destroy a focused form
     let job, plan, metrics;
     try {
       [job, plan, metrics] = await Promise.all([
@@ -126,9 +145,11 @@ async function viewJob(jobId) {
         getJSON(`/jobs/${jobId}/plan`).catch(() => null),
         getJSON(`/jobs/${jobId}/metrics`).catch(() => null)]);
     } catch (e) {
-      $view.innerHTML = `<p class="error">${esc(e.message)}</p>`;
+      if (live()) $view.innerHTML =
+        `<p class="error">${esc(e.message)}</p>`;
       return;
     }
+    if (!live() || editingInView()) return;
     const hist = job.state_history || [];
     const started = hist.length ? hist[0].ts : null;
     const uptime = started ? ((Date.now() / 1000) - started) : null;
@@ -288,12 +309,14 @@ async function viewFlame(jobId) {
   $view.innerHTML = `<h1>Flame graph${jobId ?
     ` — <code>${esc(jobId)}</code>` : " — cluster"}</h1>
     <p class="hint">sampling 400 ms…</p>`;
+  const live = renderGate();
   let data;
   try { data = await getJSON(path); }
   catch (e) {
-    $view.innerHTML += `<p class="error">${esc(e.message)}</p>`;
+    if (live()) $view.innerHTML += `<p class="error">${esc(e.message)}</p>`;
     return;
   }
+  if (!live()) return;
   const total = data.samples || (data.root && data.root.value) || 1;
   const root = data.root || data;
   $view.innerHTML = `
@@ -352,6 +375,7 @@ async function viewState(jobId) {
 /* ------------------------------------------------------------- router */
 
 function route() {
+  navSeq += 1;
   clearInterval(timer);
   const h = location.hash.replace(/^#\/?/, "");
   const parts = h.split("/").filter(Boolean);

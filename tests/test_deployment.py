@@ -1,0 +1,151 @@
+"""Kubernetes deployment driver — manifests, apply/scale/teardown seam,
+and the active-scaling (ResourceManagerDriver) reconcile loop.
+
+reference: flink-kubernetes — KubernetesClusterDescriptor (JM
+Deployment + Service), KubernetesResourceManagerDriver (worker pods to
+match declared resources). No cluster exists in CI, so the kubectl seam
+is faked — the contract under test is the manifests and the driver
+protocol.
+"""
+
+import json
+import subprocess
+import sys
+
+from flink_tpu.cluster.deployment import (
+    ElasticScaler,
+    KubernetesDeployment,
+)
+from flink_tpu.core.config import Configuration
+
+
+class FakeKubectl:
+    def __init__(self):
+        self.applied = []
+        self.scaled = []
+        self.deleted = []
+
+    def apply(self, manifest):
+        self.applied.append(manifest)
+
+    def scale(self, deployment, replicas):
+        self.scaled.append((deployment, replicas))
+
+    def delete(self, kind, name):
+        self.deleted.append((kind, name))
+
+
+def mk(**kw):
+    client = FakeKubectl()
+    dep = KubernetesDeployment(
+        "bench", config=Configuration({"state.checkpoints.dir":
+                                       "gs://ck/bench"}),
+        task_executors=3, slots_per_executor=2, client=client, **kw)
+    return dep, client
+
+
+class TestManifests:
+    def test_deploy_applies_jm_service_and_te(self):
+        dep, client = mk()
+        dep.deploy()
+        kinds = [(m["kind"], m["metadata"]["name"]) for m in client.applied]
+        assert kinds == [("Deployment", "bench-jobmanager"),
+                         ("Service", "bench-jobmanager"),
+                         ("Deployment", "bench-taskexecutor")]
+        te = client.applied[-1]
+        assert te["spec"]["replicas"] == 3
+        args = te["spec"]["template"]["spec"]["containers"][0]["args"]
+        # workers register with the JM service and carry the config
+        assert "--jobmanager" in args
+        assert args[args.index("--jobmanager") + 1] == \
+            "bench-jobmanager:6123"
+        assert "--slots" in args and args[args.index("--slots") + 1] == "2"
+        assert "-Dstate.checkpoints.dir=gs://ck/bench" in args
+
+    def test_tpu_workers_request_devices_and_pin_slice(self):
+        dep, client = mk(tpus_per_executor=4,
+                         tpu_accelerator="tpu-v5p-slice",
+                         tpu_topology="2x2x1")
+        te = dep.taskexecutor_manifest()
+        spec = te["spec"]["template"]["spec"]
+        res = spec["containers"][0]["resources"]
+        assert res["requests"]["google.com/tpu"] == 4
+        assert res["limits"]["google.com/tpu"] == 4
+        assert spec["nodeSelector"] == {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice",
+            "cloud.google.com/gke-tpu-topology": "2x2x1"}
+
+    def test_cpu_workers_carry_no_tpu_fields(self):
+        dep, _ = mk()
+        spec = dep.taskexecutor_manifest()["spec"]["template"]["spec"]
+        assert "nodeSelector" not in spec
+        assert "resources" not in spec["containers"][0]
+
+    def test_jm_service_exposes_rpc_and_rest(self):
+        dep, _ = mk()
+        svc = dep.jobmanager_manifests()[1]
+        ports = {p["name"]: p["port"] for p in svc["spec"]["ports"]}
+        assert ports == {"rpc": 6123, "rest": 8081}
+
+    def test_scale_and_teardown(self):
+        dep, client = mk()
+        dep.scale_task_executors(7)
+        assert client.scaled == [("bench-taskexecutor", 7)]
+        dep.teardown()
+        assert ("deployment", "bench-taskexecutor") in client.deleted
+        assert ("service", "bench-jobmanager") in client.deleted
+
+
+class TestElasticScaler:
+    def test_scales_up_to_meet_demand(self):
+        dep, client = mk()  # 3 workers x 2 slots
+        demand = [(10, 6)]  # 10 slots required, 6 registered
+        scaler = ElasticScaler(dep, lambda: demand[0], max_workers=8)
+        assert scaler.reconcile() == 5  # ceil(10/2)
+        assert client.scaled == [("bench-taskexecutor", 5)]
+        # converged: demand met -> no further scaling
+        demand[0] = (10, 10)
+        assert scaler.reconcile() is None
+
+    def test_scales_down_but_respects_minimum(self):
+        dep, client = mk()
+        scaler = ElasticScaler(dep, lambda: (0, 0), min_workers=1)
+        assert scaler.reconcile() == 1
+        assert client.scaled == [("bench-taskexecutor", 1)]
+
+    def test_scale_down_never_kills_busy_workers(self):
+        # 0 slots REQUIRED but 6 still IN USE across 3 workers x 2
+        # slots: the floor is the busy workers, not min_workers
+        dep, client = mk()
+        scaler = ElasticScaler(dep, lambda: (0, 6), min_workers=1)
+        assert scaler.reconcile() is None  # 3 workers already = ceil(6/2)
+        assert client.scaled == []
+        # one worker drains -> only then scale down
+        scaler2 = ElasticScaler(dep, lambda: (0, 4), min_workers=1)
+        assert scaler2.reconcile() == 2
+
+    def test_bounded_by_max_workers(self):
+        dep, client = mk()
+        scaler = ElasticScaler(dep, lambda: (1000, 0), max_workers=8)
+        assert scaler.reconcile() == 8
+
+
+def test_cli_scale_requires_explicit_count():
+    out = subprocess.run(
+        [sys.executable, "-m", "flink_tpu.cli", "deploy", "scale", "prod"],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 2
+    assert "--task-executors" in out.stderr
+
+
+def test_cli_dry_run_prints_manifests():
+    out = subprocess.run(
+        [sys.executable, "-m", "flink_tpu.cli", "deploy", "kubernetes",
+         "demo", "--task-executors", "4", "--tpus-per-executor", "1",
+         "--dry-run"],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr
+    # three JSON documents; the TE one carries the TPU request
+    assert '"google.com/tpu": 1' in out.stdout
+    assert '"name": "demo-jobmanager"' in out.stdout
+    assert '"replicas": 4' in out.stdout
