@@ -135,6 +135,15 @@ class _Part:
             if self.binary:
                 self.fh.write(struct.pack("<I", len(r)))
                 self.bytes += 4
+            elif b"\n" in r:
+                # newline framing cannot represent this record — failing
+                # loudly beats committing a file that splits mid-record
+                # on read (csv quoting keeps raw 0x0A inside fields)
+                raise ValueError(
+                    "record contains a raw newline, which the text "
+                    "framing cannot represent — use 'format'='json' "
+                    "(escapes control characters) or a binary format "
+                    "(length-prefixed)")
             self.fh.write(r)
             self.bytes += len(r)
             if not self.binary:
@@ -268,29 +277,123 @@ class FileSink(TwoPhaseCommitSink):
         self.__dict__.update(state)
 
 
+from flink_tpu.connectors.sources import Source
+
+
+def _walk_committed(base_path: str) -> List[str]:
+    """All committed part files under ``base_path``, in bucket/file
+    order (readers must never see ``.inprogress`` data)."""
+    out = []
+    for root, _dirs, files in sorted(
+            (r, d, f) for r, d, f in os.walk(base_path)):
+        for f in sorted(files):
+            if not f.endswith(".inprogress"):
+                out.append(os.path.join(root, f))
+    return out
+
+
+def _decode_file_rows(path: str, binary: bool) -> List[bytes]:
+    """One part file -> raw rows, undoing the framing _Part.append
+    wrote (newline-delimited text / u32-length-prefixed binary). THE
+    single copy of the read-side framing rule."""
+    import struct
+
+    with open(path, "rb") as fh:
+        data = fh.read()
+    if binary:
+        rows, off = [], 0
+        while off < len(data):
+            (n,) = struct.unpack_from("<I", data, off)
+            off += 4
+            rows.append(data[off:off + n])
+            off += n
+        return rows
+    return [line for line in data.split(b"\n") if line]
+
+
+class FileSource(Source):
+    """Bounded scan over COMMITTED part files (reference:
+    flink-connector-files FileSource / the filesystem table source).
+    Readers never observe ``.inprogress`` data — the other half of the
+    FileSink's exactly-once contract. Rows decode through the
+    DeserializationSchema seam in file order; buckets are directories,
+    so a partitioned layout reads back transparently.
+
+    Exactly-once on the reader side: the checkpoint carries the
+    REMAINING FILE PATHS and the row offset inside the current file
+    (reference: FileSource snapshots its splits) — never an index into
+    a list re-discovered from a directory that may have changed."""
+
+    def __init__(self, path: str, deserializer,
+                 timestamp_field: Optional[str] = None):
+        self.path = path
+        self._deser = deserializer
+        self.timestamp_field = timestamp_field
+        self._files: List[str] = []
+        self._next_file = 0
+        self._row = 0            # rows of the CURRENT file already emitted
+        self._cur_rows: Optional[List[bytes]] = None
+        self._restored = False
+
+    def estimate_records(self) -> Optional[int]:
+        return None  # unknowable without reading; batch mode meters
+
+    def open(self, subtask_index: int = 0, parallelism: int = 1) -> None:
+        self._deser.open()
+        if self._restored:
+            return  # the checkpointed file list IS the split
+        files = _walk_committed(self.path)
+        per = -(-len(files) // max(parallelism, 1))
+        self._files = files[subtask_index * per:(subtask_index + 1) * per]
+        self._next_file = 0
+        self._row = 0
+
+    def poll_batch(self, max_records: int):
+        binary = getattr(self._deser, "binary", False)
+        while self._next_file < len(self._files):
+            if self._cur_rows is None:
+                self._cur_rows = _decode_file_rows(
+                    self._files[self._next_file], binary)
+            if self._row >= len(self._cur_rows):
+                self._cur_rows = None
+                self._next_file += 1
+                self._row = 0
+                continue
+            chunk = self._cur_rows[self._row:self._row + max_records]
+            self._row += len(chunk)
+            batch = self._deser.deserialize_batch(chunk)
+            if self.timestamp_field and \
+                    self.timestamp_field in batch.columns:
+                batch = batch.with_column(
+                    TIMESTAMP_FIELD,
+                    np.asarray(batch[self.timestamp_field],
+                               dtype=np.int64))
+            return batch
+        return None
+
+    def close(self) -> None:
+        pass
+
+    def snapshot_position(self) -> Dict[str, Any]:
+        return {"files": list(self._files[self._next_file:]),
+                "row": self._row}
+
+    def restore_position(self, pos) -> None:
+        if "files" in pos:
+            self._files = list(pos["files"])
+            self._next_file = 0
+            self._row = int(pos.get("row", 0))
+            self._cur_rows = None
+            self._restored = True
+
+
 def read_committed_rows(base_path: str,
                         binary: bool = False) -> List[bytes]:
     """All rows of committed part files under ``base_path``, in
     bucket/file order (test/validation helper — readers must never see
     ``.inprogress`` data). ``binary`` selects the length-prefixed
     framing binary formats (avro) write."""
-    import struct
-
     rows: List[bytes] = []
-    for root, _dirs, files in sorted(
-            (r, d, f) for r, d, f in os.walk(base_path)):
-        for f in sorted(files):
-            if f.endswith(".inprogress"):
-                continue
-            with open(os.path.join(root, f), "rb") as fh:
-                data = fh.read()
-            if binary:
-                off = 0
-                while off < len(data):
-                    (n,) = struct.unpack_from("<I", data, off)
-                    off += 4
-                    rows.append(data[off:off + n])
-                    off += n
-            else:
-                rows.extend(line for line in data.split(b"\n") if line)
+    for path in _walk_committed(base_path):
+        rows.extend(_decode_file_rows(path, binary))
     return rows
