@@ -1,0 +1,259 @@
+"""DataStream V2 API facade.
+
+reference: flink-datastream-api — partitioning as stream types,
+``process`` everywhere, two-output functions, connectAndProcess,
+broadcast — mapped onto the same engine as V1.
+"""
+
+import numpy as np
+
+from flink_tpu import Configuration
+from flink_tpu.connectors.sinks import CollectSink
+from flink_tpu.connectors.sources import DataGenSource
+from flink_tpu.core.records import KEY_ID_FIELD, RecordBatch
+from flink_tpu.datastream.v2 import (
+    ExecutionEnvironment,
+    OneInputStreamProcessFunction,
+    TwoInputBroadcastStreamProcessFunction,
+    TwoInputNonBroadcastStreamProcessFunction,
+    TwoOutputStreamProcessFunction,
+)
+from flink_tpu.state.keyed_state import ReducingStateDescriptor
+
+
+def _env():
+    return ExecutionEnvironment.get_instance(Configuration({
+        "execution.micro-batch.size": 4096}))
+
+
+def _src(n=20_000, keys=50):
+    return DataGenSource(total_records=n, num_keys=keys,
+                         events_per_second_of_eventtime=10_000)
+
+
+class Doubler(OneInputStreamProcessFunction):
+    def process_batch(self, batch, out, ctx):
+        out.collect(batch.with_column(
+            "value", np.asarray(batch["value"]) * 2))
+
+
+class KeyedCounter(OneInputStreamProcessFunction):
+    """Counts per key with keyed state + an event-time timer through
+    the V2 context."""
+
+    def open(self, ctx):
+        self.desc = ReducingStateDescriptor("n", np.add, np.int64, 0)
+
+    def process_batch(self, batch, out, ctx):
+        keys = batch[KEY_ID_FIELD]
+        ctx.state(self.desc).add(keys, np.ones(len(keys), dtype=np.int64))
+        ctx.timer_service().register_event_time_timers(
+            keys, np.full(len(keys), 1 << 50))
+
+    def on_timer(self, key_ids, timestamps, out, ctx):
+        counts = ctx.state(self.desc).get(key_ids)
+        out.collect(RecordBatch({KEY_ID_FIELD: key_ids,
+                                 "count": counts}))
+
+
+def test_process_and_keyed_state_end_to_end():
+    env = _env()
+    sink = CollectSink()
+    (env.from_source(_src())
+        .process(Doubler())
+        .key_by("key")
+        .process(KeyedCounter())
+        .to_sink(sink))
+    env.execute("v2-counts")
+    b = sink.result()
+    got = dict(zip(b[KEY_ID_FIELD].tolist(), b["count"].tolist()))
+    assert len(got) == 50
+    assert sum(got.values()) == 20_000
+
+
+class Splitter(TwoOutputStreamProcessFunction):
+    """Evens to output 1, odds to output 2 — V2's typed second output."""
+
+    def process_batch(self, batch, out1, out2, ctx):
+        v = np.asarray(batch["key"])
+        out1.collect(batch.filter(v % 2 == 0))
+        out2.collect(batch.filter(v % 2 == 1))
+
+
+def test_two_output_process_function():
+    env = _env()
+    evens, odds = CollectSink(), CollectSink()
+    main, side = env.from_source(_src(n=8000)).process_two_output(
+        Splitter())
+    main.to_sink(evens)
+    side.to_sink(odds)
+    env.execute("v2-split")
+    e = evens.result()["key"]
+    o = odds.result()["key"]
+    assert len(e) + len(o) == 8000
+    assert (np.asarray(e) % 2 == 0).all()
+    assert (np.asarray(o) % 2 == 1).all()
+
+
+class Zipper(TwoInputNonBroadcastStreamProcessFunction):
+    def open(self, ctx):
+        self.seen = {"first": 0, "second": 0}
+
+    def process_batch_first(self, batch, out, ctx):
+        self.seen["first"] += len(batch)
+        out.collect(batch.with_column("side", np.zeros(len(batch))))
+
+    def process_batch_second(self, batch, out, ctx):
+        self.seen["second"] += len(batch)
+        out.collect(batch.with_column("side", np.ones(len(batch))))
+
+
+def test_connect_and_process_two_inputs():
+    env = _env()
+    sink = CollectSink()
+    a = env.from_source(_src(n=5000))
+    b = env.from_source(_src(n=3000))
+    a.connect_and_process(b, Zipper()).to_sink(sink)
+    env.execute("v2-connect")
+    sides = np.asarray(sink.result()["side"])
+    assert (sides == 0).sum() == 5000
+    assert (sides == 1).sum() == 3000
+
+
+class Enricher(TwoInputBroadcastStreamProcessFunction):
+    """Broadcast side fills a dimension map; data side joins it."""
+
+    def process_broadcast_batch(self, batch, out, ctx, bstate):
+        for k, v in zip(batch["key"].tolist(), batch["value"].tolist()):
+            bstate[int(k) % 10] = v
+
+    def process_batch(self, batch, out, ctx, bstate):
+        dims = np.asarray([bstate.get(int(k) % 10, -1.0)
+                           for k in batch["key"].tolist()])
+        out.collect(batch.with_column("dim", dims))
+
+
+def test_broadcast_connect():
+    env = _env()
+    sink = CollectSink()
+    data = env.from_source(_src(n=4000))
+    dim = env.from_source(_src(n=1000)).broadcast()
+    data.connect_and_process(dim, Enricher()).to_sink(sink)
+    env.execute("v2-broadcast")
+    b = sink.result()
+    assert len(b) == 4000
+    assert "dim" in b.columns
+
+
+def test_non_keyed_context_rejects_state_and_timers():
+    import pytest
+
+    class Bad(OneInputStreamProcessFunction):
+        def process_batch(self, batch, out, ctx):
+            ctx.state(ReducingStateDescriptor("n", np.add))
+
+    env = _env()
+    sink = CollectSink()
+    env.from_source(_src(n=1000)).process(Bad()).to_sink(sink)
+    with pytest.raises(RuntimeError, match="KeyedPartitionStream"):
+        env.execute("v2-bad")
+
+
+def test_windows_on_keyed_streams():
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+    env = _env()
+    sink = CollectSink()
+    (env.from_source(_src(n=10_000))
+        .key_by("key")
+        .window(TumblingEventTimeWindows.of(1000))
+        .sum("value").sink_to(sink))
+    env.execute("v2-windows")
+    assert len(sink.result()) > 0
+
+
+class KeyedSplitCounter(TwoOutputStreamProcessFunction):
+    """Two-output on a KEYED stream using keyed state (review repro)."""
+
+    def open(self, ctx):
+        self.desc = ReducingStateDescriptor("n", np.add, np.int64, 0)
+
+    def process_batch(self, batch, out1, out2, ctx):
+        keys = batch[KEY_ID_FIELD]
+        ctx.state(self.desc).add(keys, np.ones(len(keys), dtype=np.int64))
+        counts = ctx.state(self.desc).get(keys)
+        out1.collect(batch.filter(counts % 2 == 1))
+        out2.collect(batch.filter(counts % 2 == 0))
+
+
+def test_keyed_two_output_with_state():
+    env = _env()
+    s1, s2 = CollectSink(), CollectSink()
+    main, side = env.from_source(_src(n=6000)).key_by("key") \
+        .process_two_output(KeyedSplitCounter())
+    main.to_sink(s1)
+    side.to_sink(s2)
+    env.execute("v2-keyed-split")
+    assert len(s1.result()) + len(s2.result()) == 6000
+    assert len(s1.result()) > 0 and len(s2.result()) > 0
+
+
+class KeyedZip(TwoInputNonBroadcastStreamProcessFunction):
+    """Keyed connect: per-key tallies from both inputs (review repro)."""
+
+    def open(self, ctx):
+        self.desc = ReducingStateDescriptor("n", np.add, np.int64, 0)
+
+    def process_batch_first(self, batch, out, ctx):
+        keys = batch[KEY_ID_FIELD]
+        ctx.state(self.desc).add(keys, np.ones(len(keys), dtype=np.int64))
+
+    def process_batch_second(self, batch, out, ctx):
+        keys = batch[KEY_ID_FIELD]
+        ctx.state(self.desc).add(keys, np.ones(len(keys), dtype=np.int64))
+        counts = ctx.state(self.desc).get(keys)
+        out.collect(batch.with_column("tally", counts))
+
+
+def test_keyed_connect_and_process_shares_state():
+    env = _env()
+    sink = CollectSink()
+    a = env.from_source(_src(n=4000)).key_by("key")
+    b = env.from_source(_src(n=4000)).key_by("key")
+    a.connect_and_process(b, KeyedZip()).to_sink(sink)
+    env.execute("v2-keyed-connect")
+    out = sink.result()
+    assert len(out) == 4000
+    # tallies grow past 1: both inputs fold into ONE keyed state
+    assert int(np.asarray(out["tally"]).max()) > 1
+
+
+def test_mixed_keyedness_connect_rejected():
+    import pytest
+
+    env = _env()
+    a = env.from_source(_src(n=100)).key_by("key")
+    b = env.from_source(_src(n=100))
+    with pytest.raises(TypeError, match="both streams keyed"):
+        a.connect_and_process(b, KeyedZip())
+
+
+def test_keyed_process_rejects_two_output_function():
+    import pytest
+
+    env = _env()
+    with pytest.raises(TypeError, match="process_two_output"):
+        env.from_source(_src(n=100)).key_by("key").process(
+            KeyedSplitCounter())
+
+
+def test_from_source_name_reaches_the_graph():
+    env = _env()
+    sink = CollectSink()
+    env.from_source(_src(n=100), name="orders").process(
+        Doubler()).to_sink(sink)
+    names = [t.name for t in env._env.transformations] \
+        if hasattr(env._env, "transformations") else []
+    r = env.execute("v2-named")
+    ops = r.metrics.get("per_operator", {})
+    assert any("orders" in k for k in ops), ops
