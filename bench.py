@@ -130,13 +130,15 @@ def run(total_records: int, num_auctions: int = 100_000,
     }
 
 
-def emit(value: float, error: str = None) -> None:
+def emit(value: float, error: str = None, extra: dict = None) -> None:
     line = {
         "metric": "nexmark_q5_hop_hot_items_events_per_sec_per_chip",
         "value": round(value, 1),
         "unit": "events/s",
         "vs_baseline": round(value / PROXY_BASELINE_EVENTS_PER_S, 3),
     }
+    if extra:
+        line.update(extra)
     if error:
         line["error"] = error
     print(json.dumps(line))
@@ -185,20 +187,26 @@ def main():
             # and compile the fire/merge kernels (at the production
             # num_auctions so the pad buckets match the measured run).
             run(total_records=1 << 21, num_auctions=100_000, layout=layout)
-            # Steady-state: repeat the measured pass and report the best
-            # rep. Measured 2026-07-30 on live TPU: identical 40M-record
-            # reps warm monotonically (4.07M -> 4.47M -> 5.02M ev/s) as
-            # host/tunnel caches settle, so a single pass under-reports
-            # the sustained rate the chip actually delivers.
-            s = None
+            # Steady-state: repeat the measured pass and take the MEDIAN
+            # rep as the headline (best-of overstates sustained
+            # throughput; the warm-up pass above already covers the
+            # compile/cache-settling argument). Best and all reps stay
+            # in the JSON as secondary fields — tunnel-throughput
+            # variance across sessions remains visible there.
+            reps = []
             for rep in range(max(int(os.environ.get("BENCH_REPS", 3)), 1)):
                 r = run(total_records=total, layout=layout)
                 print(f"# layout={layout} rep {rep}: "
                       f"{r['events_per_s']:.0f} events/s, "
                       f"fire_latency={r['fire_latency_ms']}",
                       file=sys.stderr)
-                if s is None or r["events_per_s"] > s["events_per_s"]:
-                    s = r
+                reps.append(r)
+            by_rate = sorted(reps, key=lambda r: r["events_per_s"])
+            s = by_rate[len(by_rate) // 2]  # median (upper-mid for even)
+            s["rep_events_per_s"] = [round(r["events_per_s"], 1)
+                                     for r in reps]
+            s["best_events_per_s"] = round(
+                by_rate[-1]["events_per_s"], 1)
             if stats is None or s["events_per_s"] > stats["events_per_s"]:
                 stats, best_layout = s, layout
         except Exception as e:  # degraded: keep trying the other layout
@@ -216,7 +224,10 @@ def main():
             return
     print(f"# q5 best layout={best_layout}: {stats['results']} winner "
           f"rows, fire_latency={stats['fire_latency_ms']}", file=sys.stderr)
-    emit(stats["events_per_s"], error)
+    emit(stats["events_per_s"], error,
+         extra={k: stats[k]
+                for k in ("rep_events_per_s", "best_events_per_s")
+                if k in stats})
 
 
 if __name__ == "__main__":

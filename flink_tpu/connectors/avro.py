@@ -427,6 +427,11 @@ class AvroRowDeserializationSchema(DeserializationSchema):
     """Single-record Avro binary payloads -> one typed columnar batch,
     decoding with the WRITER schema resolved into the READER schema."""
 
+    #: varint payloads may contain any byte (0x0A included): file
+    #: sources must undo the length-prefix framing the sink wrote —
+    #: newline-splitting silently corrupts rows
+    binary = True
+
     def __init__(self, columns: Sequence[str],
                  types: Sequence[Optional[str]],
                  reader_schema, writer_schema=None,

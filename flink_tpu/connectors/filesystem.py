@@ -255,11 +255,16 @@ class FileSink(TwoPhaseCommitSink):
         self._pending = []
 
     def abort_uncommitted(self, exclude: List[Any]) -> None:
+        # only THIS subtask's parts: parallel sinks share base_path, and
+        # restore-time cleanup racing a peer's open/committable part
+        # would delete live data (part names embed the subtask index)
         keep = {c["inprogress"] for c in exclude}
+        own = f"part-{self._subtask}-"
         for root, _, files in os.walk(self.base_path):
             for f in files:
                 p = os.path.join(root, f)
-                if p.endswith(".inprogress") and p not in keep:
+                if (f.startswith(own) and p.endswith(".inprogress")
+                        and p not in keep):
                     os.unlink(p)
 
     def close(self) -> None:

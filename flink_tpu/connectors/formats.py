@@ -62,6 +62,12 @@ class DeserializationSchema:
 
     last_surviving: Optional[List[int]] = None
 
+    #: True when records are arbitrary binary (may contain newlines) —
+    #: file sources must undo u32-length-prefix framing instead of
+    #: newline-splitting (the read-side mirror of
+    #: SerializationSchema.binary; the two MUST agree per format)
+    binary = False
+
     def open(self) -> None:
         pass
 

@@ -18,6 +18,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 KEY_AXIS = "keygroups"
 
+try:  # jax >= 0.5 exposes shard_map at the top level
+    shard_map = jax.shard_map
+except AttributeError:  # 0.4.x keeps it in jax.experimental
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
 
 def make_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
     """A 1-D mesh over the key-group axis."""

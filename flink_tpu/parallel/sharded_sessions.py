@@ -31,10 +31,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
 from flink_tpu.ops.segment_ops import SCATTER_METHOD, sticky_bucket
-from flink_tpu.parallel.mesh import KEY_AXIS
+from flink_tpu.parallel.mesh import KEY_AXIS, shard_map
 from flink_tpu.parallel.sharded_windower import (
     _STEP_CACHE,
-    MeshSpillSupport,
+    MeshPagedSpillSupport,
     build_mesh_steps,
 )
 from flink_tpu.parallel.shuffle import bucket_by_shard, shard_records
@@ -72,7 +72,7 @@ def build_session_merge_step(mesh: Mesh, agg: AggregateFunction):
                 out.append(a)
             return tuple(out)
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh,
             in_specs=(P(KEY_AXIS),) * (n_leaves + 2),
             out_specs=(P(KEY_AXIS),) * n_leaves,
@@ -82,8 +82,15 @@ def build_session_merge_step(mesh: Mesh, agg: AggregateFunction):
     return merge_step
 
 
-class MeshSessionEngine(MeshSpillSupport):
-    """Keyed session windows sharded over a 1-D device mesh."""
+class MeshSessionEngine(MeshPagedSpillSupport):
+    """Keyed session windows sharded over a 1-D device mesh.
+
+    Spill layout (mirrors ``SessionWindower``): sessions are one row per
+    namespace (sid), so the default ``spill_layout="pages"`` moves
+    eviction COHORTS per shard (slot-granular touch clocks,
+    split-on-reload — see flink_tpu.state.paged_spill) and runs the host
+    indexes registry-free. An explicit ``spill_layout="namespaces"``
+    keeps the registry-driven per-namespace eviction."""
 
     def __init__(
         self,
@@ -98,9 +105,20 @@ class MeshSessionEngine(MeshSpillSupport):
         spill_host_max_bytes: int = 0,
         key_group_range: Optional[Tuple[int, int]] = None,
         memory=None,
+        spill_layout: str = "pages",
     ) -> None:
         self.gap = int(gap)
         self.agg = agg
+        if spill_layout not in ("namespaces", "pages"):
+            raise ValueError(
+                f"spill_layout must be 'namespaces' or 'pages', got "
+                f"{spill_layout!r}")
+        self.spill_layout = spill_layout
+        #: registry-backed namespace bookkeeping only for the explicit
+        #: "namespaces" layout; the paged layout frees by SLOT and the
+        #: per-namespace registry would cost O(live sessions) Python
+        #: per batch at one row per sid
+        self._track_ns = spill_layout == "namespaces"
         #: (first, last) inclusive GLOBAL key groups this engine owns; the
         #: mesh shards within the range (mesh x stage — see shard_records)
         self.key_group_range = key_group_range
@@ -131,6 +149,7 @@ class MeshSessionEngine(MeshSpillSupport):
                 self.capacity, growable=True,
                 on_grow=lambda old, new: self._shard_index_grew(new),
                 max_capacity=self.max_device_slots,
+                track_namespaces=self._track_ns,
                 full_hint=("state spills to host beyond "
                            "state.slot-table.max-device-slots"
                            if self.max_device_slots
@@ -138,6 +157,10 @@ class MeshSessionEngine(MeshSpillSupport):
             for _ in range(self.P)
         ]
         self._init_spill(spill_dir, spill_host_max_bytes)
+        self._paged = (spill_layout == "pages"
+                       and self.max_device_slots > 0)
+        if self._paged:
+            self._init_paged()
         self._sharding = NamedSharding(mesh, P(KEY_AXIS))
         self._reserve_rows(self.P * self.capacity)
         self.accs: Tuple[jnp.ndarray, ...] = tuple(
@@ -183,6 +206,8 @@ class MeshSessionEngine(MeshSpillSupport):
         dirty = np.zeros((self.P, new_capacity), dtype=bool)
         dirty[:, :old] = self._dirty
         self._dirty = dirty
+        if self._paged:
+            self._paged_grow(new_capacity)
 
     def _put_sharded(self, host_block: np.ndarray) -> jnp.ndarray:
         return jax.device_put(host_block, self._sharding)
@@ -224,18 +249,27 @@ class MeshSessionEngine(MeshSpillSupport):
         m = len(sess_key)
         sess_shard = shard_records(sess_key, self.P,
             self.max_parallelism, self.key_group_range)
-        if self._spill_active:
-            touched = {
-                p: np.unique(sess_sid[(sess_shard == p) & live_sess])
-                for p in range(self.P)
-                if ((sess_shard == p) & live_sess).any()}
-            self._ensure_resident(touched)
-            for p, sids in touched.items():
-                self._touch(p, sids.tolist())
-        slot_of_sess = np.zeros(m, dtype=np.int32)
+        per_shard_sel = {}
         for p in range(self.P):
             sel = (sess_shard == p) & live_sess
             if sel.any():
+                per_shard_sel[p] = sel
+        slot_of_sess = np.zeros(m, dtype=np.int32)
+        if self._paged:
+            resolved = self._resolve_slots_paged({
+                p: (sess_key[sel], sess_sid[sel])
+                for p, sel in per_shard_sel.items()})
+            for p, sel in per_shard_sel.items():
+                slot_of_sess[sel] = resolved[p]
+                self._dirty[p, resolved[p]] = True
+        else:
+            if self._spill_active:
+                touched = {p: np.unique(sess_sid[sel])
+                           for p, sel in per_shard_sel.items()}
+                self._ensure_resident(touched)
+                for p, sids in touched.items():
+                    self._touch(p, sids.tolist())
+            for p, sel in per_shard_sel.items():
                 self._reserve(p, sess_key[sel], sess_sid[sel])
                 slots = self.indexes[p].lookup_or_insert(
                     sess_key[sel], sess_sid[sel])
@@ -271,33 +305,38 @@ class MeshSessionEngine(MeshSpillSupport):
         ss = np.asarray(g.sids_src, dtype=np.int64)
         shards = shard_records(gk, self.P,
             self.max_parallelism, self.key_group_range)
-        if self._spill_active:
-            # merging sessions may be cold (spilled): both sides must be
-            # device-resident before the merge kernel moves values
-            touched = {}
-            for p in range(self.P):
-                sel = shards == p
-                if sel.any():
-                    touched[p] = np.unique(
-                        np.concatenate([ds[sel], ss[sel]]))
-            self._ensure_resident(touched)
-            for p, sids in touched.items():
-                self._touch(p, sids.tolist())
+        # combined dst+src pairs per shard (dst and src share the key,
+        # hence the shard): with a spill tier, both sides must be
+        # device-resident simultaneously for the merge kernel
+        pairs: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        for p in range(self.P):
+            sel = shards == p
+            if sel.any():
+                pairs[p] = (np.concatenate([gk[sel], gk[sel]]),
+                            np.concatenate([ds[sel], ss[sel]]))
+        resolved: Dict[int, np.ndarray] = {}
+        if self._paged:
+            resolved = self._resolve_slots_paged(pairs)
+        else:
+            if self._spill_active:
+                touched = {p: np.unique(sids2)
+                           for p, (_, sids2) in pairs.items()}
+                self._ensure_resident(touched)
+                for p, sids in touched.items():
+                    self._touch(p, sids.tolist())
+            for p, (keys2, sids2) in pairs.items():
+                self._reserve(p, keys2, sids2)
+                resolved[p] = self.indexes[p].lookup_or_insert(
+                    keys2, sids2)
         m_max = 0
         per_shard: List[Tuple[np.ndarray, np.ndarray]] = []
         for p in range(self.P):
-            sel = shards == p
-            if not sel.any():
+            if p not in pairs:
                 per_shard.append((np.empty(0, np.int32),
                                   np.empty(0, np.int32)))
                 continue
-            # combined dst+src lookup per shard (dst and src share the key,
-            # hence the shard)
-            keys2 = np.concatenate([gk[sel], gk[sel]])
-            sids2 = np.concatenate([ds[sel], ss[sel]])
-            self._reserve(p, keys2, sids2)
-            both = self.indexes[p].lookup_or_insert(keys2, sids2)
-            c = int(sel.sum())
+            both = resolved[p]
+            c = len(both) // 2
             d_slots, s_slots = both[:c], both[c:]
             self._dirty[p, d_slots] = True
             per_shard.append((d_slots.astype(np.int32),
@@ -318,9 +357,22 @@ class MeshSessionEngine(MeshSpillSupport):
         # absorbed host slots reusable now that the kernel moved the values;
         # record tombstones so delta snapshots drop the absorbed rows
         self._freed_ns.extend(int(s) for s in g.absorbed_sids)
-        self._drop_spilled(g.absorbed_sids)
-        for p in range(self.P):
-            self.indexes[p].free_namespaces(g.absorbed_sids)
+        if self._track_ns:
+            self._drop_spilled(g.absorbed_sids)
+            for p in range(self.P):
+                self.indexes[p].free_namespaces(g.absorbed_sids)
+        else:
+            # registry-free: the absorbed rows' slots are in hand (the
+            # src half of each shard's combined lookup)
+            for p, (_, s_slots) in enumerate(per_shard):
+                if p not in pairs:
+                    continue
+                src_sids = pairs[p][1][len(s_slots):]
+                if self._paged:
+                    self._free_rows_paged(p, s_slots, src_sids)
+                else:
+                    self.indexes[p].free_slots(s_slots)
+                    self._dirty[p, s_slots] = False
 
     # ------------------------------------------------------------------ fire
 
@@ -348,27 +400,36 @@ class MeshSessionEngine(MeshSpillSupport):
         sid_arr = np.asarray(sids, dtype=np.int64)
         shards = shard_records(k_arr, self.P,
             self.max_parallelism, self.key_group_range)
-        if self._spill_active:
-            # cold (spilled) sessions must be resident to fire from the
-            # device table
-            touched = {p: np.unique(sid_arr[shards == p])
-                       for p in range(self.P) if (shards == p).any()}
-            self._ensure_resident(touched)
-            for p in touched:
-                sel = shards == p
-                self._reserve(p, k_arr[sel], sid_arr[sel])
+        per_shard_sel: List[np.ndarray] = [
+            np.nonzero(shards == p)[0] for p in range(self.P)]
+        resolved: Dict[int, np.ndarray] = {}
+        if self._paged:
+            # cold (spilled) sessions reload by page to fire from the
+            # device table (the cohort bet: rows evicted together come
+            # due together, so the reload mostly pulls rows it needs)
+            resolved = self._resolve_slots_paged({
+                p: (k_arr[sel], sid_arr[sel])
+                for p, sel in enumerate(per_shard_sel) if len(sel)})
+        else:
+            if self._spill_active:
+                touched = {p: np.unique(sid_arr[sel])
+                           for p, sel in enumerate(per_shard_sel)
+                           if len(sel)}
+                self._ensure_resident(touched)
+                for p in touched:
+                    sel = per_shard_sel[p]
+                    self._reserve(p, k_arr[sel], sid_arr[sel])
+            for p, sel in enumerate(per_shard_sel):
+                if len(sel):
+                    resolved[p] = self.indexes[p].lookup_or_insert(
+                        k_arr[sel], sid_arr[sel])
         w_max = 0
         per_shard_slots: List[np.ndarray] = []
-        per_shard_sel: List[np.ndarray] = []
-        for p in range(self.P):
-            sel = np.nonzero(shards == p)[0]
-            per_shard_sel.append(sel)
+        for p, sel in enumerate(per_shard_sel):
             if len(sel) == 0:
                 per_shard_slots.append(np.empty(0, np.int32))
                 continue
-            slots = self.indexes[p].lookup_or_insert(
-                k_arr[sel], sid_arr[sel]).astype(np.int32)
-            per_shard_slots.append(slots)
+            per_shard_slots.append(resolved[p].astype(np.int32))
             w_max = max(w_max, len(sel))
         W = sticky_bucket(w_max, self._fire_bucket, minimum=64)
         self._fire_bucket = W
@@ -385,8 +446,17 @@ class MeshSessionEngine(MeshSpillSupport):
             rb[p, : len(slots)] = slots
             if len(slots):
                 self._dirty[p, slots] = False
-            self.indexes[p].free_namespaces(
-                [int(sid_arr[i]) for i in per_shard_sel[p]])
+            if self._track_ns:
+                self.indexes[p].free_namespaces(
+                    [int(sid_arr[i]) for i in per_shard_sel[p]])
+            elif len(slots):
+                # registry-free: slot-addressed free (the fire resolved
+                # the rows, so no registry walk is needed)
+                if self._paged:
+                    self._free_rows_paged(p, slots,
+                                          sid_arr[per_shard_sel[p]])
+                else:
+                    self.indexes[p].free_slots(slots)
         self.accs = self._reset_step(self.accs, self._put_sharded(rb))
         # assemble the output batch in shard order
         st_arr = np.asarray(starts, dtype=np.int64)
@@ -421,16 +491,29 @@ class MeshSessionEngine(MeshSpillSupport):
         out: Dict[int, Dict[str, float]] = {}
         if self._spill_active and (slots < 0).any():
             # cold sessions answer from the spill tier (read-only — a
-            # query must not thrash residency)
+            # query must not thrash residency); paged: sid -> its page,
+            # then the (key, sid) row inside it
             sp = self.spills[shard]
             for i, iv in enumerate(intervals):
                 if slots[i] >= 0:
                     continue
-                entry = sp.peek(int(sids[i]))
-                if entry is None:
-                    continue
-                pos = np.nonzero(np.asarray(
-                    entry["key_id"], dtype=np.int64) == int(key_id))[0]
+                if self._paged:
+                    page = self._pmaps[shard].page_of(int(sids[i]))
+                    entry = sp.peek(page) if page is not None else None
+                    if entry is None:
+                        continue
+                    pos = np.nonzero(
+                        (np.asarray(entry["key_id"], dtype=np.int64)
+                         == int(key_id))
+                        & (np.asarray(entry["ns"], dtype=np.int64)
+                           == int(sids[i])))[0]
+                else:
+                    entry = sp.peek(int(sids[i]))
+                    if entry is None:
+                        continue
+                    pos = np.nonzero(np.asarray(
+                        entry["key_id"],
+                        dtype=np.int64) == int(key_id))[0]
                 if len(pos) == 0:
                     continue
                 j = int(pos[0])
@@ -560,7 +643,10 @@ class MeshSessionEngine(MeshSpillSupport):
                 leaves = [np.asarray(table[f"leaf_{i}"])
                           for i in range(len(self.agg.leaves))]
         if self._spill_active and len(key_ids):
-            self._spill_restore_rows(key_ids, namespaces, leaves)
+            if self._paged:
+                self._paged_restore_rows(key_ids, namespaces, leaves)
+            else:
+                self._spill_restore_rows(key_ids, namespaces, leaves)
         elif len(key_ids):
             shards = shard_records(key_ids, self.P,
             self.max_parallelism, self.key_group_range)

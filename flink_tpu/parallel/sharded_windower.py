@@ -36,7 +36,7 @@ from flink_tpu.ops.segment_ops import (
     pad_bucket_size,
     sticky_bucket,
 )
-from flink_tpu.parallel.mesh import KEY_AXIS
+from flink_tpu.parallel.mesh import KEY_AXIS, shard_map
 from flink_tpu.parallel.shuffle import bucket_by_shard, shard_records
 from flink_tpu.state.keygroups import assign_key_groups
 from flink_tpu.state.slot_table import HostSlotIndex
@@ -266,50 +266,84 @@ class MeshSpillSupport:
                     touch.pop(int(e), None)
 
     def _spill_snapshot_parts(self) -> List[Dict[str, np.ndarray]]:
-        """Logical-snapshot rows for every spilled namespace."""
+        """Logical-snapshot rows for every spilled namespace. Paged
+        entries (the mesh session engine) carry their own ``ns`` column
+        and one entry spans many sessions; dead rows are dropped."""
         parts: List[Dict[str, np.ndarray]] = []
+        pmaps = getattr(self, "_pmaps", None)
         for p in range(self.P):
             sp = self.spills[p]
+            dead = pmaps[p].dead if pmaps is not None else None
             for ns in sp.namespaces:
                 entry = sp.peek(int(ns))
-                m = len(entry["key_id"])
+                if entry is None:
+                    continue
                 ekeys = np.asarray(entry["key_id"], dtype=np.int64)
+                if "ns" in entry:  # paged entry: per-row namespaces
+                    rns = np.asarray(entry["ns"], dtype=np.int64)
+                    if dead:
+                        alive = ~np.isin(rns, np.asarray(
+                            sorted(dead), dtype=np.int64))
+                        ekeys, rns = ekeys[alive], rns[alive]
+                        sel = alive
+                    else:
+                        sel = slice(None)
+                else:
+                    rns = np.full(len(ekeys), int(ns), dtype=np.int64)
+                    sel = slice(None)
+                if len(ekeys) == 0:
+                    continue
                 parts.append({
                     "key_id": ekeys,
-                    "namespace": np.full(m, int(ns), dtype=np.int64),
+                    "namespace": rns,
                     "key_group": assign_key_groups(
                         ekeys, self.max_parallelism),
                     **{f"leaf_{i}": np.asarray(
                         entry[f"leaf_{i}"],
-                        dtype=self.agg.leaves[i].dtype)
+                        dtype=self.agg.leaves[i].dtype)[sel]
                        for i in range(len(self.agg.leaves))},
                 })
         return parts
 
     def _spill_delta_append(self, out: Dict[str, np.ndarray]) -> None:
         """Append spilled-but-dirty namespaces to a delta snapshot and
-        clear their dirtiness."""
+        clear their dirtiness. For paged entries only the dirty ROWS of
+        a dirty page travel (pages are immutable once spilled, so the
+        per-row dirty column captured at eviction stays authoritative)."""
         if not self._spill_active:
             return
+        pmaps = getattr(self, "_pmaps", None)
         for p in range(self.P):
             sp = self.spills[p]
+            dead = pmaps[p].dead if pmaps is not None else None
             for ns in sp.dirty_namespaces():
                 entry = sp.peek(int(ns))
                 if entry is None:
                     continue
                 ekeys = np.asarray(entry["key_id"], dtype=np.int64)
-                m = len(ekeys)
+                if "ns" in entry:  # paged entry
+                    sel = np.asarray(entry["dirty"], dtype=bool)
+                    if dead:
+                        sel = sel & ~np.isin(
+                            np.asarray(entry["ns"], dtype=np.int64),
+                            np.asarray(sorted(dead), dtype=np.int64))
+                    ekeys = ekeys[sel]
+                    rns = np.asarray(entry["ns"], dtype=np.int64)[sel]
+                else:
+                    sel = slice(None)
+                    rns = np.full(len(ekeys), int(ns), dtype=np.int64)
+                if len(ekeys) == 0:
+                    continue
                 out["key_id"] = np.concatenate([out["key_id"], ekeys])
-                out["namespace"] = np.concatenate([
-                    out["namespace"],
-                    np.full(m, int(ns), dtype=np.int64)])
+                out["namespace"] = np.concatenate([out["namespace"], rns])
                 out["key_group"] = np.concatenate([
                     out["key_group"],
                     assign_key_groups(ekeys, self.max_parallelism)])
                 for i, l in enumerate(self.agg.leaves):
                     out[f"leaf_{i}"] = np.concatenate([
                         out[f"leaf_{i}"],
-                        np.asarray(entry[f"leaf_{i}"], dtype=l.dtype)])
+                        np.asarray(entry[f"leaf_{i}"],
+                                   dtype=l.dtype)[sel]])
             sp.clear_dirty()
 
     def _spill_restore_rows(self, key_ids: np.ndarray,
@@ -343,6 +377,210 @@ class MeshSpillSupport:
                 if ns in sp:
                     sp.drop(ns)
                 sp.put(ns, entry, dirty=False)
+
+
+class MeshPagedSpillSupport(MeshSpillSupport):
+    """Paged (cohort) spill for session-shaped mesh state — the mesh form
+    of the single-device ``spill_layout="pages"`` machinery
+    (flink_tpu.state.paged_spill, shared): per shard, the unit of
+    movement is an eviction cohort of the coldest rows (slot-granular
+    touch clocks, not namespace recency), reloads pop whole pages and
+    split the requested rows from the re-bundled rest, and the host
+    index runs registry-free (``track_namespaces=False`` — one row per
+    session id makes the per-namespace registry O(live sessions) Python
+    per batch).
+
+    Device traffic stays batched across shards: all shards' page reloads
+    land in ONE put program; evictions are per-shard (one gather + one
+    reset program each, the other shards' rows identity no-ops)."""
+
+    def _init_paged(self) -> None:
+        from flink_tpu.state.paged_spill import PagedSpillMap
+
+        #: one membership map (+ counters) per shard — spilled pages are
+        #: shard-local like the device rows
+        self._pmaps = [PagedSpillMap() for _ in range(self.P)]
+        #: [P, capacity] per-slot touch clocks (the paged analog of the
+        #: namespace recency map)
+        self._slot_touch = np.zeros((self.P, self.capacity),
+                                    dtype=np.int64)
+
+    def _paged_grow(self, new_capacity: int) -> None:
+        if new_capacity <= self._slot_touch.shape[1]:
+            return
+        grown = np.zeros((self.P, new_capacity), dtype=np.int64)
+        grown[:, : self._slot_touch.shape[1]] = self._slot_touch
+        self._slot_touch = grown
+
+    def spill_counters(self) -> Dict[str, int]:
+        """Spill traffic summed over shards (zeros when unbudgeted)."""
+        from flink_tpu.state.paged_spill import PagedSpillMap
+
+        out = PagedSpillMap.zero_counters()
+        for pm in getattr(self, "_pmaps", ()):
+            for k, v in pm.counters().items():
+                out[k] += v
+        return out
+
+    def _resolve_slots_paged(
+            self, per_shard: Dict[int, Tuple[np.ndarray, np.ndarray]]
+    ) -> Dict[int, np.ndarray]:
+        """Batched lookup_or_insert over shards with page reload and
+        cohort eviction: resident rows of THIS batch get a fresh clock
+        (protecting them from the eviction the batch itself triggers),
+        missing pairs reload by page (ONE put program for all shards),
+        then the plain per-shard inserts run."""
+        from flink_tpu.state.paged_spill import reload_rows_for
+        from flink_tpu.state.slot_table import unique_pairs
+
+        self._touch_clock += 1
+        clock = self._touch_clock
+        leaf_dtypes = [l.dtype for l in self.agg.leaves]
+        reloads: Dict[int, Tuple[np.ndarray, List[np.ndarray]]] = {}
+        pending: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        for p, (keys, nss) in per_shard.items():
+            keys = np.asarray(keys, dtype=np.int64)
+            nss = np.asarray(nss, dtype=np.int64)
+            idx = self.indexes[p]
+            uk, un, _ = unique_pairs(keys, nss)
+            pre = idx.lookup(uk, un)
+            hit = pre >= 0
+            self._slot_touch[p][pre[hit]] = clock
+            missing = ~hit
+            rl = None
+            if missing.any() and len(self._pmaps[p]):
+                rl = reload_rows_for(self.spills[p], self._pmaps[p],
+                                     un[missing], leaf_dtypes)
+            if rl is not None:
+                rkeys, rns, rdirty, rvals = rl
+                fresh = int((~np.isin(un[missing],
+                                      np.unique(rns))).sum())
+                needed = len(rkeys) + fresh
+            else:
+                rkeys = None
+                needed = int(missing.sum())
+            if needed and idx.free_headroom() < needed:
+                self._make_headroom_paged(p, needed)
+            if rkeys is not None:
+                rslots = idx.lookup_or_insert(rkeys, rns)
+                # reloaded rows keep their dirtiness (not snapshotted
+                # since) and take the current clock — the cohort is
+                # likely about to fire
+                self._dirty[p, rslots] = rdirty
+                self._slot_touch[p][rslots] = clock
+                reloads[p] = (rslots.astype(np.int32), rvals)
+            pending[p] = (keys, nss)
+        if reloads:
+            B = sticky_bucket(max(len(r[0]) for r in reloads.values()),
+                              self._reload_bucket)
+            self._reload_bucket = B
+            slot_block = np.zeros((self.P, B), dtype=np.int32)
+            val_blocks = [np.full((self.P, B), l.identity, dtype=l.dtype)
+                          for l in self.agg.leaves]
+            for p, (rslots, rvals) in reloads.items():
+                n = len(rslots)
+                slot_block[p, :n] = rslots
+                for i in range(len(val_blocks)):
+                    val_blocks[i][p, :n] = rvals[i]
+            self.accs = self._put_step(
+                self.accs, self._put_sharded(slot_block),
+                tuple(self._put_sharded(v) for v in val_blocks))
+        out: Dict[int, np.ndarray] = {}
+        for p, (keys, nss) in pending.items():
+            slots = self.indexes[p].lookup_or_insert(keys, nss)
+            self._slot_touch[p][slots] = clock
+            out[p] = slots
+        return out
+
+    def _make_headroom_paged(self, p: int, needed: int) -> None:
+        while self.indexes[p].free_headroom() < needed:
+            self._evict_cold_paged(p)
+
+    def _evict_cold_paged(self, p: int) -> None:
+        """Evict shard ``p``'s coldest slots (touch < current clock) as
+        ONE page: one gather + one reset program + one spill entry,
+        however many sessions the cohort spans."""
+        from flink_tpu.state.paged_spill import spill_page
+        from flink_tpu.state.slot_table import SlotTableFullError
+
+        idx = self.indexes[p]
+        used = idx.used_slots()
+        touch = self._slot_touch[p][used]
+        evictable = used[touch < self._touch_clock]
+        if len(evictable) == 0:
+            raise SlotTableFullError(
+                f"shard {p}: device slot budget exhausted and every "
+                "resident row was touched by the current batch — raise "
+                "state.slot-table.max-device-slots or reduce batch size")
+        target = min(max(idx.capacity // 8, 1024), len(evictable))
+        et = self._slot_touch[p][evictable]
+        if target < len(evictable):
+            sel = np.argpartition(et, target - 1)[:target]
+            chosen = evictable[sel]
+        else:
+            chosen = evictable
+        chosen = np.asarray(chosen, dtype=np.int32)
+        n = len(chosen)
+        G = sticky_bucket(n, self._gather_bucket)
+        self._gather_bucket = G
+        block = np.zeros((self.P, G), dtype=np.int32)
+        block[p, :n] = chosen
+        gathered = self._gather_step(self.accs, self._put_sharded(block))
+        entry = {
+            "key_id": np.asarray(idx.slot_key[chosen]),
+            "ns": np.asarray(idx.slot_ns[chosen]),
+            "dirty": self._dirty[p, chosen].copy(),
+            **{f"leaf_{i}": np.asarray(g)[p][:n]
+               for i, g in enumerate(gathered)},
+        }
+        spill_page(self.spills[p], self._pmaps[p], entry)
+        idx.free_slots(chosen)
+        self._dirty[p, chosen] = False
+        R = sticky_bucket(n, getattr(self, "_reset_bucket", 0))
+        self._reset_bucket = R
+        rb = np.zeros((self.P, R), dtype=np.int32)
+        rb[p, :n] = chosen
+        self.accs = self._reset_step(self.accs, self._put_sharded(rb))
+
+    def _free_rows_paged(self, p: int, slots: np.ndarray,
+                         nss) -> None:
+        """Slot-addressed free for the registry-free index (the caller
+        resolved the rows this batch); spilled copies — rare, resolves
+        reload first — are marked dead and their empty pages reaped."""
+        from flink_tpu.state.paged_spill import drop_spilled_sessions
+
+        if self._spill_active and len(self._pmaps[p]):
+            drop_spilled_sessions(self.spills[p], self._pmaps[p],
+                                  np.asarray(nss, dtype=np.int64))
+        slots = np.asarray(slots, dtype=np.int32)
+        if len(slots):
+            self.indexes[p].free_slots(slots)
+            self._dirty[p, slots] = False
+
+    def _paged_restore_rows(self, key_ids: np.ndarray,
+                            namespaces: np.ndarray,
+                            leaves: List[np.ndarray]) -> None:
+        """Paged restore: rows land in each shard's spill tier as
+        page-sized entries and reload lazily by page."""
+        from flink_tpu.state.paged_spill import restore_into_pages
+
+        shards = shard_records(key_ids, self.P,
+            self.max_parallelism, self.key_group_range)
+        for p in range(self.P):
+            mask = shards == p
+            if not mask.any():
+                if len(self._pmaps[p]):
+                    restore_into_pages(  # clears stale pages
+                        self.spills[p], self._pmaps[p],
+                        np.empty(0, dtype=np.int64),
+                        np.empty(0, dtype=np.int64),
+                        [np.empty(0, dtype=l.dtype)
+                         for l in self.agg.leaves], 1024)
+                continue
+            restore_into_pages(
+                self.spills[p], self._pmaps[p], key_ids[mask],
+                namespaces[mask], [l[mask] for l in leaves],
+                page_rows=max(self.indexes[p].capacity // 8, 1024))
 
 
 class MeshWindowEngine(MeshSpillSupport):
@@ -1056,7 +1294,7 @@ def build_mesh_steps(mesh: Mesh, agg: AggregateFunction):
                 out.append(getattr(a.at[0, slots_l[0]], m)(v))
             return tuple(out)
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh,
             in_specs=(P(KEY_AXIS),) * (n_leaves + 1 + n_inputs),
             out_specs=(P(KEY_AXIS),) * n_leaves,
@@ -1078,7 +1316,7 @@ def build_mesh_steps(mesh: Mesh, agg: AggregateFunction):
             out = finish(merged)              # dict name -> [W]
             return tuple(out[name][None] for name in names)
 
-        outs = jax.shard_map(
+        outs = shard_map(
             local, mesh=mesh,
             in_specs=(P(KEY_AXIS),) * (n_leaves + 1),
             out_specs=(P(KEY_AXIS),) * len(names),
@@ -1095,7 +1333,7 @@ def build_mesh_steps(mesh: Mesh, agg: AggregateFunction):
                 for a, i in zip(accs_l, idents)
             )
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh,
             in_specs=(P(KEY_AXIS),) * (n_leaves + 1),
             out_specs=(P(KEY_AXIS),) * n_leaves,
@@ -1110,7 +1348,7 @@ def build_mesh_steps(mesh: Mesh, agg: AggregateFunction):
             slots_l = args[n_leaves]
             return tuple(a[0][slots_l[0]][None] for a in accs_l)
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh,
             in_specs=(P(KEY_AXIS),) * (n_leaves + 1),
             out_specs=(P(KEY_AXIS),) * n_leaves,
@@ -1128,7 +1366,7 @@ def build_mesh_steps(mesh: Mesh, agg: AggregateFunction):
             return tuple(a.at[0, slots_l[0]].set(v[0])
                          for a, v in zip(accs_l, vals_l))
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh,
             in_specs=(P(KEY_AXIS),) * (2 * n_leaves + 1),
             out_specs=(P(KEY_AXIS),) * n_leaves,
@@ -1145,7 +1383,7 @@ def build_mesh_steps(mesh: Mesh, agg: AggregateFunction):
                 m(a[0][sm], axis=1)[None]
                 for a, m in zip(accs_l, merges))
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh,
             in_specs=(P(KEY_AXIS),) * (n_leaves + 1),
             out_specs=(P(KEY_AXIS),) * n_leaves,
@@ -1167,7 +1405,7 @@ def build_mesh_steps(mesh: Mesh, agg: AggregateFunction):
                 getattr(a.at[0, slots_l[0]], m)(v[0])
                 for a, m, v in zip(accs_l, methods, vals_l))
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh,
             in_specs=(P(KEY_AXIS),) * (2 * n_leaves + 1),
             out_specs=(P(KEY_AXIS),) * n_leaves,

@@ -32,7 +32,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flink_tpu.ops.segment_ops import pad_bucket_size
-from flink_tpu.parallel.mesh import KEY_AXIS
+from flink_tpu.parallel.mesh import KEY_AXIS, shard_map
 from flink_tpu.state.keygroups import (
     assign_key_groups,
     key_group_to_operator_index,
@@ -117,7 +117,7 @@ def make_all_to_all_repartition(mesh: Mesh):
             # exchange blocks: after this, dim1 is indexed by SOURCE shard
             return jax.lax.all_to_all(x, KEY_AXIS, split_axis=1, concat_axis=1)
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh,
             in_specs=P(KEY_AXIS), out_specs=P(KEY_AXIS))(block)
 
@@ -137,7 +137,7 @@ def make_global_combine(mesh: Mesh, reduce: str = "sum"):
         def local(x):  # [1, ...] per shard
             return op(local_reduce(x, axis=0), KEY_AXIS)
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh,
             in_specs=P(KEY_AXIS), out_specs=P())(partials)
 

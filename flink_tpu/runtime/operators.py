@@ -608,7 +608,10 @@ class SessionWindowAggOperator(WindowAggOperator):
                 spill_dir=spill.get("spill_dir"),
                 spill_host_max_bytes=spill.get("spill_host_max_bytes", 0),
                 key_group_range=getattr(ctx, "key_group_range", None),
-                memory=self._managed_memory(ctx))
+                memory=self._managed_memory(ctx),
+                # sessions default to the paged (cohort) spill layout,
+                # same as the single-device engine
+                spill_layout=spill.get("spill_layout", "pages"))
         else:
             table_kwargs, _ = self._table_kwargs()
             if self._managed_memory(ctx) is not None:

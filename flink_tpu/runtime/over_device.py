@@ -48,6 +48,11 @@ from flink_tpu.runtime.over_agg import OverAggOperator, OverSpec
 #: (ts range + preceding) must stay below it — guarded at fire time
 _TS_OFFSET = np.int64(1) << 41
 
+#: timestamp sentinel of synthetic accumulator context rows (UNBOUNDED
+#: carry-over) — below every real timestamp so they sort to their
+#: segment's head
+_SYNTH_TS = -(np.int64(1) << 60)
+
 _SUMLIKE = ("SUM", "AVG", "COUNT")
 
 
@@ -229,7 +234,17 @@ class DeviceOverAggOperator(OverAggOperator):
 
         m = len(all_kid)
         boundary = np.r_[True, all_kid[1:] != all_kid[:-1]]
-        ts_rel = all_ts - all_ts.min() + 1
+        # synthetic accumulator rows (ts = _SYNTH_TS) sit at their
+        # segment head by construction; clamping them to ts_rel = 0
+        # (below every real row's >= 1) keeps the monotonicized search
+        # exact while the span guard sees only REAL timestamps —
+        # otherwise the 2^60 sentinel trips the guard on the second fire
+        # and RANGE UNBOUNDED silently degrades to the host engine
+        # forever
+        synth = all_ts == _SYNTH_TS
+        real_ts = all_ts[~synth]
+        base = real_ts.min() if len(real_ts) else np.int64(0)
+        ts_rel = np.where(synth, np.int64(0), all_ts - base + 1)
         if self._fallback or (self.mode == "RANGE" and (
                 int(ts_rel.max()) + (self.preceding or 0) >= _TS_OFFSET
                 or int(boundary.sum()) >= (1 << 21))):
@@ -302,7 +317,7 @@ class DeviceOverAggOperator(OverAggOperator):
             # the segment's last row, weight = running count; ts below
             # every real row so it sorts first next fire
             keep_kid = all_kid[seg_last]
-            keep_ts = np.full(len(seg_last), -(1 << 60), dtype=np.int64)
+            keep_ts = np.full(len(seg_last), _SYNTH_TS, dtype=np.int64)
             keep_val = [run_s[i][seg_last] for i in range(len(self.specs))]
             keep_wt = [run_c[i][seg_last] for i in range(len(self.specs))]
         else:

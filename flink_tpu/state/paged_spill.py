@@ -1,0 +1,264 @@
+"""Paged (cohort-granular) spill bookkeeping, shared across engines.
+
+The paged layout (``spill_layout="pages"``) targets session-shaped state:
+one row per namespace, millions of namespaces. Evicting namespace-by-
+namespace would mean one spill entry per session; instead the unit of
+movement is an EVICTION COHORT — the coldest rows of the device table,
+however many sessions they span — stored as one page entry carrying its
+own ``ns`` column (reference: RocksDB block granularity — state moves in
+blocks, not per-key records).
+
+This module owns the host bookkeeping every paged table needs:
+
+- the (namespace -> page) membership map as lazily-sorted parallel
+  arrays (binary-searched per batch, no per-session Python),
+- the dead-spilled set (sessions freed while spilled; their rows are
+  dropped on reload/snapshot and their empty pages reaped),
+- split-on-reload: a reload pops whole pages but only the REQUESTED
+  rows go back to the device; the pages' other rows re-bundle into a
+  fresh page host-side, so page churn cannot read-amplify past the
+  device budget,
+- spill traffic counters (pages/rows evicted and reloaded, rows split
+  on reload) for benchmarks and observability.
+
+The single-device ``SlotTable`` uses one ``PagedSpillMap``; the
+mesh-sharded session engine keeps one per shard (keys never migrate
+between shards, so spilled pages are shard-local like the device rows).
+Device-side data movement (gather/reset on evict, put on reload) stays
+with the owning engine — flat kernels on one device, ``shard_map``
+programs on the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+COUNTER_NAMES = ("pages_evicted", "pages_reloaded", "rows_evicted",
+                 "rows_reloaded", "rows_split_on_reload")
+
+
+class PagedSpillMap:
+    """Membership + lifecycle bookkeeping for one paged spill tier."""
+
+    def __init__(self) -> None:
+        #: spilled (ns -> page) mapping as parallel arrays; kept sorted
+        #: by ns lazily (evictions append, reloads filter)
+        self.sp_ns = np.empty(0, dtype=np.int64)
+        self.sp_page = np.empty(0, dtype=np.int64)
+        self.sorted = True
+        #: sessions freed while spilled (rare: fires reload first) —
+        #: their page rows are dropped on reload/snapshot
+        self.dead: set = set()
+        self.next_page = 1
+        self.pages_evicted = 0
+        self.pages_reloaded = 0
+        self.rows_evicted = 0
+        self.rows_reloaded = 0
+        self.rows_split_on_reload = 0
+
+    def __len__(self) -> int:
+        return len(self.sp_ns)
+
+    def counters(self) -> Dict[str, int]:
+        return {name: int(getattr(self, name)) for name in COUNTER_NAMES}
+
+    @staticmethod
+    def zero_counters() -> Dict[str, int]:
+        return {name: 0 for name in COUNTER_NAMES}
+
+    # ------------------------------------------------------------ membership
+
+    def sort(self) -> None:
+        if not self.sorted:
+            o = np.argsort(self.sp_ns, kind="stable")
+            self.sp_ns = self.sp_ns[o]
+            self.sp_page = self.sp_page[o]
+            self.sorted = True
+
+    def spilled_mask(self, nss: np.ndarray) -> np.ndarray:
+        """Vectorized membership: which of ``nss`` are spilled."""
+        if not len(self.sp_ns):
+            return np.zeros(len(nss), dtype=bool)
+        self.sort()
+        pos = np.searchsorted(self.sp_ns, nss)
+        pos = np.minimum(pos, len(self.sp_ns) - 1)
+        return self.sp_ns[pos] == nss
+
+    def pages_for(self, nss: np.ndarray) -> np.ndarray:
+        """Unique page ids containing any of ``nss``."""
+        if not len(self.sp_ns):
+            return np.empty(0, dtype=np.int64)
+        self.sort()
+        nss = np.asarray(nss, dtype=np.int64)
+        pos = np.searchsorted(self.sp_ns, nss)
+        pos = np.minimum(pos, len(self.sp_ns) - 1)
+        hit = self.sp_ns[pos] == nss
+        if not hit.any():
+            return np.empty(0, dtype=np.int64)
+        return np.unique(self.sp_page[pos[hit]])
+
+    def page_of(self, ns: int) -> Optional[int]:
+        """The page holding ``ns``, or None (read-only point probe)."""
+        if not len(self.sp_ns):
+            return None
+        self.sort()
+        p = int(np.searchsorted(self.sp_ns, int(ns)))
+        if p >= len(self.sp_ns) or int(self.sp_ns[p]) != int(ns):
+            return None
+        return int(self.sp_page[p])
+
+    def record(self, nss: np.ndarray, page: int) -> None:
+        self.sp_ns = np.concatenate([self.sp_ns, nss])
+        self.sp_page = np.concatenate([
+            self.sp_page, np.full(len(nss), page, dtype=np.int64)])
+        self.sorted = False
+
+    def remove_pages(self, pages: np.ndarray) -> None:
+        keep = ~np.isin(self.sp_page, pages)
+        self.sp_ns = self.sp_ns[keep]
+        self.sp_page = self.sp_page[keep]
+
+    def clear(self) -> None:
+        self.sp_ns = np.empty(0, dtype=np.int64)
+        self.sp_page = np.empty(0, dtype=np.int64)
+        self.sorted = True
+        self.dead.clear()
+
+
+def spill_page(spill, pmap: PagedSpillMap, entry: Dict[str, np.ndarray],
+               count: bool = True) -> int:
+    """Store one eviction cohort as a page entry; returns the page id.
+
+    ``entry`` carries ``key_id`` / ``ns`` / ``dirty`` / ``leaf_i``
+    columns. ``count=False`` for internal re-bundling and restore, which
+    are not evictions.
+    """
+    page = pmap.next_page
+    pmap.next_page += 1
+    spill.put(page, entry, dirty=bool(entry["dirty"].any()))
+    pmap.record(np.asarray(entry["ns"], dtype=np.int64), page)
+    if count:
+        pmap.pages_evicted += 1
+        pmap.rows_evicted += len(entry["ns"])
+    return page
+
+
+def reload_rows_for(spill, pmap: PagedSpillMap, nss: np.ndarray,
+                    leaf_dtypes: Sequence) -> Optional[
+                        Tuple[np.ndarray, np.ndarray, np.ndarray,
+                              List[np.ndarray]]]:
+    """Pop every page containing any of ``nss``; return the requested
+    rows as ``(keys, rns, dirty, leaf_values)`` for the caller's device
+    put, or None when nothing relevant was spilled.
+
+    Only the REQUESTED rows leave; the popped pages' other rows
+    re-bundle into a fresh page host-side (pure NumPy — no device
+    traffic). Without this split, page churn mixes cohorts over time and
+    a fire's reload would drag in whole pages of not-yet-due sessions,
+    read-amplifying past the device budget. Dead rows (sessions freed
+    while spilled) are dropped here.
+    """
+    nss = np.asarray(nss, dtype=np.int64)
+    pages = pmap.pages_for(nss)
+    if not len(pages):
+        return None
+    key_chunks, ns_chunks, dirty_chunks = [], [], []
+    leaf_chunks: List[List[np.ndarray]] = [[] for _ in leaf_dtypes]
+    for page in pages.tolist():
+        entry = spill.pop(int(page))
+        if entry is None:
+            continue
+        key_chunks.append(np.asarray(entry["key_id"], dtype=np.int64))
+        ns_chunks.append(np.asarray(entry["ns"], dtype=np.int64))
+        dirty_chunks.append(np.asarray(entry["dirty"], dtype=bool))
+        for i, dt in enumerate(leaf_dtypes):
+            leaf_chunks[i].append(np.asarray(entry[f"leaf_{i}"], dtype=dt))
+    if not key_chunks:
+        return None
+    keys = np.concatenate(key_chunks)
+    rns = np.concatenate(ns_chunks)
+    dirty = np.concatenate(dirty_chunks)
+    vals = [np.concatenate(c) for c in leaf_chunks]
+    if pmap.dead:
+        dead = np.asarray(sorted(pmap.dead), dtype=np.int64)
+        alive = ~np.isin(rns, dead)
+        if not alive.all():
+            gone = rns[~alive]
+            pmap.dead.difference_update(gone.tolist())
+            keys, rns, dirty = keys[alive], rns[alive], dirty[alive]
+            vals = [v[alive] for v in vals]
+    pmap.remove_pages(pages)
+    pmap.pages_reloaded += len(pages)
+    want = np.isin(rns, np.unique(nss))
+    rest = ~want
+    if rest.any():
+        r_entry = {"key_id": keys[rest], "ns": rns[rest],
+                   "dirty": dirty[rest],
+                   **{f"leaf_{i}": v[rest] for i, v in enumerate(vals)}}
+        spill_page(spill, pmap, r_entry, count=False)
+        pmap.rows_split_on_reload += int(rest.sum())
+        keys, rns, dirty = keys[want], rns[want], dirty[want]
+        vals = [v[want] for v in vals]
+    if len(keys) == 0:
+        return None
+    pmap.rows_reloaded += len(keys)
+    return keys, rns, dirty, vals
+
+
+def drop_spilled_sessions(spill, pmap: PagedSpillMap,
+                          nss: np.ndarray) -> None:
+    """Mark spilled sessions dead; reap pages left with no live mapping
+    entries (they could never reload — their storage and dead-set
+    entries would otherwise leak for the rest of the run)."""
+    if not len(pmap.sp_ns):
+        return
+    nss = np.asarray(nss, dtype=np.int64)
+    dead = nss[pmap.spilled_mask(nss)]
+    if not len(dead):
+        return
+    pmap.dead.update(dead.tolist())
+    kill = np.isin(pmap.sp_ns, dead)
+    dead_pages = np.unique(pmap.sp_page[kill])
+    keep = ~kill
+    pmap.sp_ns = pmap.sp_ns[keep]
+    pmap.sp_page = pmap.sp_page[keep]
+    gone = dead_pages[~np.isin(dead_pages, np.unique(pmap.sp_page))]
+    for page in gone.tolist():
+        entry = spill.pop(int(page))
+        if entry is not None:
+            pmap.dead.difference_update(
+                np.asarray(entry["ns"], dtype=np.int64).tolist())
+
+
+def restore_into_pages(spill, pmap: PagedSpillMap, key_ids: np.ndarray,
+                       namespaces: np.ndarray, leaves: List[np.ndarray],
+                       page_rows: int) -> None:
+    """Pack restored logical rows into page-sized spill entries (sorted
+    by ns, never splitting one namespace across pages) — a snapshot far
+    larger than the device budget restores with bounded device memory
+    and reloads lazily by page. Clears any stale pages first
+    (re-restore)."""
+    if len(pmap.sp_ns):
+        for page in np.unique(pmap.sp_page).tolist():
+            spill.drop(int(page))
+    pmap.clear()
+    order = np.argsort(namespaces, kind="stable")
+    s_ns = namespaces[order]
+    s_keys = key_ids[order]
+    s_leaves = [l[order] for l in leaves]
+    total = len(s_ns)
+    a = 0
+    while a < total:
+        b = min(a + page_rows, total)
+        while b < total and s_ns[b] == s_ns[b - 1]:
+            b += 1
+        entry = {"key_id": s_keys[a:b], "ns": s_ns[a:b],
+                 "dirty": np.zeros(b - a, dtype=bool),
+                 **{f"leaf_{i}": s_leaves[i][a:b]
+                    for i in range(len(s_leaves))}}
+        spill_page(spill, pmap, entry, count=False)
+        a = b
+    # pages were appended in ascending-ns order: the map is sorted
+    pmap.sorted = True

@@ -735,15 +735,11 @@ class SlotTable:
         self.spill_layout = spill_layout
         self._paged = spill_layout == "pages" and self.max_device_slots > 0
         if self._paged:
-            #: spilled (ns -> page) mapping as parallel arrays; kept
-            #: sorted by ns lazily (evictions append, reloads rebuild)
-            self._sp_ns = np.empty(0, dtype=np.int64)
-            self._sp_page = np.empty(0, dtype=np.int64)
-            self._sp_sorted = True
-            #: sessions freed while spilled (rare: fires reload first) —
-            #: their page rows are dropped on reload/snapshot
-            self._dead_spilled: set = set()
-            self._next_page = 1
+            from flink_tpu.state.paged_spill import PagedSpillMap
+
+            #: membership map + dead set + counters for the paged layout
+            #: (flink_tpu.state.paged_spill — shared with the mesh engine)
+            self._pmap = PagedSpillMap()
         self.index = make_slot_index(
             capacity, on_grow=self._grow_device,
             max_capacity=self.max_device_slots,
@@ -895,91 +891,61 @@ class SlotTable:
         self._slot_touch[slots] = clock
         return slots
 
+    # compat views over the PagedSpillMap (tests and older callers poke
+    # the raw arrays; the map itself is the shared implementation)
+    @property
+    def _sp_ns(self) -> np.ndarray:
+        return self._pmap.sp_ns
+
+    @_sp_ns.setter
+    def _sp_ns(self, v: np.ndarray) -> None:
+        self._pmap.sp_ns = v
+
+    @property
+    def _sp_page(self) -> np.ndarray:
+        return self._pmap.sp_page
+
+    @_sp_page.setter
+    def _sp_page(self, v: np.ndarray) -> None:
+        self._pmap.sp_page = v
+
+    @property
+    def _dead_spilled(self) -> set:
+        return self._pmap.dead
+
+    @_dead_spilled.setter
+    def _dead_spilled(self, v) -> None:
+        self._pmap.dead = set(v)
+
+    def spill_counters(self) -> Dict[str, int]:
+        """Paged spill traffic counters (zeros when not paged)."""
+        from flink_tpu.state.paged_spill import PagedSpillMap
+
+        if self._paged:
+            return self._pmap.counters()
+        return PagedSpillMap.zero_counters()
+
     def _sp_sort(self) -> None:
-        if not self._sp_sorted:
-            o = np.argsort(self._sp_ns, kind="stable")
-            self._sp_ns = self._sp_ns[o]
-            self._sp_page = self._sp_page[o]
-            self._sp_sorted = True
+        self._pmap.sort()
 
     def _spilled_mask(self, nss: np.ndarray) -> np.ndarray:
         """Vectorized membership: which of ``nss`` are spilled."""
-        if not len(self._sp_ns):
-            return np.zeros(len(nss), dtype=bool)
-        self._sp_sort()
-        pos = np.searchsorted(self._sp_ns, nss)
-        pos = np.minimum(pos, len(self._sp_ns) - 1)
-        return self._sp_ns[pos] == nss
+        return self._pmap.spilled_mask(nss)
 
     def _reload_pages_for(self, nss: np.ndarray, clock: int) -> None:
         """Reload every page containing any of ``nss`` — whole pages (the
         block-cache bet: rows evicted together in one cohort become due
-        together, so a fire's reload mostly pulls rows it needs)."""
-        self._sp_sort()
-        pos = np.searchsorted(self._sp_ns, nss)
-        pos = np.minimum(pos, max(len(self._sp_ns) - 1, 0))
-        hit = len(self._sp_ns) > 0
-        hit = self._sp_ns[pos] == nss if hit else np.zeros(0, bool)
-        pages = np.unique(self._sp_page[pos[hit]]) if hit.any() else ()
-        if not len(pages):
+        together, so a fire's reload mostly pulls rows it needs); the
+        pages' other rows re-bundle host-side (split-on-reload, see
+        flink_tpu.state.paged_spill)."""
+        from flink_tpu.state.paged_spill import reload_rows_for
+
+        rl = reload_rows_for(self.spill, self._pmap, nss,
+                             [l.dtype for l in self.agg.leaves])
+        if rl is None:
             return
-        key_chunks, ns_chunks, dirty_chunks = [], [], []
-        leaf_chunks: List[List[np.ndarray]] = [
-            [] for _ in self.agg.leaves]
-        for page in pages.tolist():
-            entry = self.spill.pop(int(page))
-            if entry is None:
-                continue
-            key_chunks.append(np.asarray(entry["key_id"],
-                                         dtype=np.int64))
-            ns_chunks.append(np.asarray(entry["ns"], dtype=np.int64))
-            dirty_chunks.append(np.asarray(entry["dirty"], dtype=bool))
-            for i, l in enumerate(self.agg.leaves):
-                leaf_chunks[i].append(
-                    np.asarray(entry[f"leaf_{i}"], dtype=l.dtype))
-        keys = np.concatenate(key_chunks)
-        rns = np.concatenate(ns_chunks)
-        dirty = np.concatenate(dirty_chunks)
-        vals = [np.concatenate(c) for c in leaf_chunks]
-        if self._dead_spilled:
-            dead = np.asarray(sorted(self._dead_spilled), dtype=np.int64)
-            alive = ~np.isin(rns, dead)
-            if not alive.all():
-                gone = rns[~alive]
-                self._dead_spilled.difference_update(gone.tolist())
-                keys, rns, dirty = keys[alive], rns[alive], dirty[alive]
-                vals = [v[alive] for v in vals]
-        # drop the reloaded pages from the spilled map
-        keep = ~np.isin(self._sp_page, pages)
-        self._sp_ns = self._sp_ns[keep]
-        self._sp_page = self._sp_page[keep]
-        # only the REQUESTED rows go to the device; the popped pages'
-        # other rows re-bundle into a fresh page host-side (pure NumPy —
-        # no device traffic). Without this split, page churn mixes
-        # cohorts over time and a fire's reload would drag in whole
-        # pages of not-yet-due sessions, read-amplifying past the
-        # device budget.
-        want = np.isin(rns, np.unique(nss))
-        rest = ~want
-        if rest.any():
-            r_entry = {"key_id": keys[rest], "ns": rns[rest],
-                       "dirty": dirty[rest],
-                       **{f"leaf_{i}": v[rest]
-                          for i, v in enumerate(vals)}}
-            page = self._next_page
-            self._next_page += 1
-            self.spill.put(page, r_entry,
-                           dirty=bool(r_entry["dirty"].any()))
-            self._sp_ns = np.concatenate([self._sp_ns, r_entry["ns"]])
-            self._sp_page = np.concatenate([
-                self._sp_page,
-                np.full(int(rest.sum()), page, dtype=np.int64)])
-            self._sp_sorted = False
-            keys, rns, dirty = keys[want], rns[want], dirty[want]
-            vals = [v[want] for v in vals]
+        keys, rns, dirty, vals = rl
         n = len(keys)
-        if n == 0:
-            return
         if self.index.free_headroom() < n:
             self._make_headroom_paged(n)
         slots = self.index.lookup_or_insert(keys, rns)
@@ -1004,26 +970,13 @@ class SlotTable:
 
     def _drop_spilled_sessions(self, nss: np.ndarray) -> None:
         """Mark spilled sessions dead; reap pages left with no live
-        mapping entries (they could never reload — their storage and
-        dead-set entries would otherwise leak for the rest of the run)."""
-        if not (self._paged and len(self._sp_ns)):
+        mapping entries (flink_tpu.state.paged_spill)."""
+        if not self._paged:
             return
-        nss = np.asarray(nss, dtype=np.int64)
-        dead = nss[self._spilled_mask(nss)]
-        if not len(dead):
-            return
-        self._dead_spilled.update(dead.tolist())
-        kill = np.isin(self._sp_ns, dead)
-        dead_pages = np.unique(self._sp_page[kill])
-        keep = ~kill
-        self._sp_ns = self._sp_ns[keep]
-        self._sp_page = self._sp_page[keep]
-        gone = dead_pages[~np.isin(dead_pages, np.unique(self._sp_page))]
-        for p in gone.tolist():
-            entry = self.spill.pop(int(p))
-            if entry is not None:
-                self._dead_spilled.difference_update(
-                    np.asarray(entry["ns"], dtype=np.int64).tolist())
+        from flink_tpu.state.paged_spill import drop_spilled_sessions
+
+        drop_spilled_sessions(self.spill, self._pmap,
+                              np.asarray(nss, dtype=np.int64))
 
     def _evict_cold_paged(self) -> None:
         """Evict the coldest slots (touch < current clock) as ONE page:
@@ -1050,6 +1003,8 @@ class SlotTable:
         self._gather_bucket = size
         gathered = self.agg._gather_jit(
             self.accs, jnp.asarray(pad_i32(chosen, size, fill=0)))
+        from flink_tpu.state.paged_spill import spill_page
+
         entry = {
             "key_id": np.asarray(self.index.slot_key[chosen]),
             "ns": np.asarray(self.index.slot_ns[chosen]),
@@ -1057,13 +1012,7 @@ class SlotTable:
             **{f"leaf_{i}": np.asarray(g)[:n]
                for i, g in enumerate(gathered)},
         }
-        page = self._next_page
-        self._next_page += 1
-        self.spill.put(page, entry, dirty=bool(entry["dirty"].any()))
-        self._sp_ns = np.concatenate([self._sp_ns, entry["ns"]])
-        self._sp_page = np.concatenate([
-            self._sp_page, np.full(n, page, dtype=np.int64)])
-        self._sp_sorted = False
+        spill_page(self.spill, self._pmap, entry)
         self.index.free_slots(chosen)
         self._dirty[chosen] = False
         rsize = sticky_bucket(n, self._reset_bucket)
@@ -1624,9 +1573,8 @@ class SlotTable:
             if self._paged:
                 # session id -> its page (read-only: queries must not
                 # change residency)
-                self._sp_sort()
-                p = int(np.searchsorted(self._sp_ns, int(ns)))
-                entry = self.spill.peek(int(self._sp_page[p]))
+                page = self._pmap.page_of(int(ns))
+                entry = self.spill.peek(page) if page is not None else None
                 if entry is None:
                     continue
                 pos = np.nonzero(
@@ -1849,37 +1797,11 @@ class SlotTable:
             # paged restore: rows land in page-sized spill entries (ns
             # column per row) and reload lazily by page — same bounded-
             # device contract, thousands of sessions per entry
-            order = np.argsort(namespaces, kind="stable")
-            s_ns = namespaces[order]
-            s_keys = key_ids[order]
-            s_leaves = [l[order] for l in leaves]
-            total = len(s_ns)
-            page_rows = max(self.index.capacity // 8, 1024)
-            if len(self._sp_ns):  # re-restore: drop stale pages first
-                for p in np.unique(self._sp_page).tolist():
-                    self.spill.drop(int(p))
-                self._sp_ns = np.empty(0, dtype=np.int64)
-                self._sp_page = np.empty(0, dtype=np.int64)
-            a = 0
-            while a < total:
-                b = min(a + page_rows, total)
-                # never split one namespace across pages
-                while b < total and s_ns[b] == s_ns[b - 1]:
-                    b += 1
-                entry = {"key_id": s_keys[a:b],
-                         "ns": s_ns[a:b],
-                         "dirty": np.zeros(b - a, dtype=bool),
-                         **{f"leaf_{i}": s_leaves[i][a:b]
-                            for i in range(len(s_leaves))}}
-                page = self._next_page
-                self._next_page += 1
-                self.spill.put(page, entry, dirty=False)
-                self._sp_ns = np.concatenate([self._sp_ns, s_ns[a:b]])
-                self._sp_page = np.concatenate([
-                    self._sp_page, np.full(b - a, page, dtype=np.int64)])
-                a = b
-            self._sp_sorted = False
-            self._dead_spilled.clear()
+            from flink_tpu.state.paged_spill import restore_into_pages
+
+            restore_into_pages(
+                self.spill, self._pmap, key_ids, namespaces, leaves,
+                page_rows=max(self.index.capacity // 8, 1024))
         elif self.max_device_slots and len(key_ids):
             # spill-enabled restore: rows land in the spill tier grouped by
             # namespace and reload lazily on first access — a snapshot far

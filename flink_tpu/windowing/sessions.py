@@ -114,6 +114,11 @@ class SessionWindower:
     def sessions(self):
         return self.meta.sessions
 
+    def spill_counters(self):
+        """Paged spill traffic (pages/rows evicted+reloaded, rows split
+        on reload); zeros when the table is unbounded."""
+        return self.table.spill_counters()
+
     # ---------------------------------------------------------------- ingest
 
     def process_batch(self, batch: RecordBatch) -> None:

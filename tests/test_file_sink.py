@@ -101,6 +101,26 @@ class TestFileSinkUnit:
         got = deser.deserialize_batch(raw)
         assert got["v"].tolist() == [5, 7, 1000]
 
+    def test_avro_filesource_roundtrip_default_framing(self, tmp_path):
+        """FileSource must derive the length-prefix framing from the
+        deserializer itself — NO explicit binary flag anywhere. A
+        text-framed read of avro parts newline-splits on 0x0A payload
+        bytes (v=5 zigzag-encodes to 0x0A) and silently corrupts rows."""
+        from flink_tpu.connectors.filesystem import FileSource
+        from flink_tpu.connectors.formats import resolve_format
+
+        d = str(tmp_path / "out")
+        sink = FileSink(d, ["v"], fmt="avro", types=["BIGINT"])
+        sink.open(0)
+        sink.write(_batch([5, 7, 1000]))
+        sink.commit(sink.prepare_commit())
+        deser, _ = resolve_format("avro", ["v"], ["BIGINT"])
+        src = FileSource(d, deser)
+        src.open()
+        got = src.poll_batch(100)
+        assert got is not None and got["v"].tolist() == [5, 7, 1000]
+        assert src.poll_batch(100) is None
+
     def test_csv_format_through_the_seam(self, tmp_path):
         d = str(tmp_path / "out")
         sink = FileSink(d, ["v"], fmt="csv")
@@ -122,6 +142,33 @@ class TestFileSinkUnit:
         sink2.commit(pend)
         rows = [json.loads(r) for r in read_committed_rows(d)]
         assert [r["v"] for r in rows] == [1]         # the 2 never commits
+
+    def test_abort_uncommitted_spares_peer_subtasks(self, tmp_path):
+        """Parallel sinks share one base_path: subtask 0's restore-time
+        cleanup must only touch its OWN part-0-* leftovers, never a
+        peer's committable or freshly opened in-progress part."""
+        d = str(tmp_path / "out")
+        peer = FileSink(d, ["v"], fmt="json")
+        peer.open(1)
+        peer.write(_batch([10]))
+        peer_pend = peer.prepare_commit()            # sealed, uncommitted
+        peer.write(_batch([11]))                     # freshly open part
+
+        own = FileSink(d, ["v"], fmt="json")
+        own.open(0)
+        own.write(_batch([1]))                       # own leftover
+
+        restored = FileSink(d, ["v"], fmt="json")
+        restored.open(0)
+        restored.abort_uncommitted([])               # subtask 0 restores
+        # own leftover cleaned, both peer files intact
+        leftovers = [f for r, _, fs in os.walk(d) for f in fs
+                     if f.endswith(".inprogress")]
+        assert not any(f.startswith("part-0-") for f in leftovers)
+        assert len([f for f in leftovers if f.startswith("part-1-")]) == 2
+        peer.commit(peer_pend)                       # still committable
+        rows = [json.loads(r) for r in read_committed_rows(d)]
+        assert [r["v"] for r in rows] == [10]
 
 
 def test_exactly_once_under_failover(tmp_path):

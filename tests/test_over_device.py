@@ -120,6 +120,40 @@ def test_device_matches_host_with_duplicate_timestamps():
     _assert_equal(host, dev, specs)
 
 
+def test_range_unbounded_stays_on_device_across_fires():
+    """RANGE UNBOUNDED carries synthetic accumulator context rows
+    (ts = -2^60) into the next fire; the span guard must see only REAL
+    timestamps, or the sentinel trips it after the FIRST fire and the
+    frame family this engine claims silently runs on the host forever
+    (ADVICE round 5, over_device.py)."""
+    rng = np.random.default_rng(7)
+    batches, wms = _stream(rng, n_batches=5)
+    specs = _specs(["SUM", "AVG", "COUNT"])
+    host = OverAggOperator("k", specs, mode="RANGE", preceding=None)
+    dev = DeviceOverAggOperator("k", specs, mode="RANGE", preceding=None)
+    host.open(None)
+    dev.open(None)
+    fires = 0
+    outs_h, outs_d = [], []
+    for b, wm in zip(batches, wms):
+        host.process_batch(b)
+        dev.process_batch(b)
+        oh = host.process_watermark(wm)
+        od = dev.process_watermark(wm)
+        outs_h.extend(oh)
+        outs_d.extend(od)
+        if od:
+            fires += 1
+        # the accelerated path must SURVIVE each fire, not just the first
+        assert not dev._fallback, f"degraded to host after fire {fires}"
+    assert fires >= 2, "stream must produce at least two device fires"
+    outs_h.extend(host.close())
+    outs_d.extend(dev.close())
+    assert not dev._fallback
+    _assert_equal(RecordBatch.concat(outs_h), RecordBatch.concat(outs_d),
+                  specs)
+
+
 def test_device_supported_matrix():
     assert device_supported(_specs(["SUM"]), "RANGE", 10)
     assert not device_supported(_specs(["MIN"]), "RANGE", 10)

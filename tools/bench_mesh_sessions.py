@@ -1,0 +1,104 @@
+"""High-cardinality mesh-sessions benchmark (BASELINE row 5, MESH engine).
+
+Drives ``MeshSessionEngine`` directly at the thrashing shape: 400k ev/s
+of event time x 2 s gap ~= 800k concurrently-live sessions against a
+512k total device budget (64k slots x 8 shards) over 10M distinct keys —
+the live set EXCEEDS the device, so the run exercises the PAGED spill
+tier per shard (spill_layout="pages", the port of the single-device
+machinery that took row 5 from 9.3k to ~260k ev/s in round 5).
+
+Emits ONE JSON line with events/s and the spill counters (pages
+evicted/reloaded, rows split on reload). On CPU the mesh is 8 virtual
+host devices (the tests' layout); on TPU the real chips form the mesh.
+
+    BENCH_SKIP_PROBE=1 JAX_PLATFORMS=cpu python tools/bench_mesh_sessions.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# must precede the first jax import: on CPU the mesh needs virtual devices
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+GAP_MS = 2_000
+EVENTS_PER_S_OF_EVENTTIME = 400_000
+NUM_KEYS = 10_000_000
+BUDGET_PER_SHARD = 1 << 16  # x8 shards = the row-5 512k total budget
+
+
+def run(total: int, mesh, batch: int = 1 << 16):
+    import numpy as np
+
+    from flink_tpu.core.records import (
+        KEY_ID_FIELD,
+        TIMESTAMP_FIELD,
+        RecordBatch,
+    )
+    from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+    from flink_tpu.windowing.aggregates import SumAggregate
+
+    eng = MeshSessionEngine(GAP_MS, SumAggregate("v"), mesh,
+                            capacity_per_shard=BUDGET_PER_SHARD,
+                            max_device_slots=BUDGET_PER_SHARD)
+    rng = np.random.default_rng(3)
+    produced = 0
+    fired = 0
+    t0 = time.perf_counter()
+    while produced < total:
+        b = min(batch, total - produced)
+        keys = rng.integers(0, NUM_KEYS, b).astype(np.int64)
+        ts = ((produced + np.arange(b, dtype=np.int64)) * 1000
+              // EVENTS_PER_S_OF_EVENTTIME)
+        eng.process_batch(RecordBatch({
+            KEY_ID_FIELD: keys,
+            "v": np.ones(b, dtype=np.float32),
+            TIMESTAMP_FIELD: ts}))
+        produced += b
+        fired += sum(len(x) for x in eng.on_watermark(int(ts[-1])))
+    fired += sum(len(x) for x in eng.on_watermark(1 << 60))
+    dt = time.perf_counter() - t0
+    return total / dt, fired, eng.spill_counters()
+
+
+def main():
+    import warnings
+
+    warnings.filterwarnings("ignore")
+    from flink_tpu.platform import sync_platform
+
+    sync_platform()
+    import jax
+
+    from flink_tpu.parallel.mesh import make_mesh
+
+    P = min(len(jax.devices()), 8)
+    mesh = make_mesh(P)
+    total = int(os.environ.get("BENCH_MESH_SESSION_RECORDS", 4_000_000))
+    run(min(total, 1 << 20), mesh)  # warm: compile the step programs
+    eps, fired, counters = run(total, mesh)
+    line = {
+        "metric": "mesh_sessions_10m_keys_events_per_sec",
+        "value": round(eps, 1),
+        "unit": "events/s",
+        "backend": jax.devices()[0].platform,
+        "mesh_shards": P,
+        "sessions_fired": fired,
+        "spill": counters,
+        "shape": (f"400k ev/s event time, 2 s gap, ~800k live sessions "
+                  f"vs {P}x{BUDGET_PER_SHARD // 1024}k device slots "
+                  f"(paged spill per shard), 10M distinct keys"),
+    }
+    print(json.dumps(line))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

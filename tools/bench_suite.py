@@ -221,11 +221,33 @@ def row5_sessions_10m_keys():
                      "10M distinct keys"}
 
 
+def row5b_mesh_sessions():
+    """Row 5 on the MESH session engine (paged spill per shard) — runs
+    in a subprocess so the CPU virtual-device flag the mesh needs cannot
+    perturb the single-device rows' XLA threading."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.setdefault("BENCH_MESH_SESSION_RECORDS",
+                   str(int(4_000_000 * SCALE)))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "bench_mesh_sessions.py")],
+        capture_output=True, text=True, env=env, timeout=3600)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("{")]
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError((proc.stderr or proc.stdout).strip()[-300:])
+    return json.loads(lines[-1])
+
+
 ROWS = [("wordcount_socket", row1_wordcount),
         ("nexmark_q5", row2_q5),
         ("nexmark_q7", row3_q7),
         ("sql_hop_kafka", row4_sql_hop_kafka),
-        ("sessions_10m_keys", row5_sessions_10m_keys)]
+        ("sessions_10m_keys", row5_sessions_10m_keys),
+        ("mesh_sessions_10m_keys", row5b_mesh_sessions)]
 
 
 def main():
@@ -266,10 +288,16 @@ def main():
         extra = ""
         if r.get("shape"):
             extra = f" — {r['shape']}"
+        if r.get("spill"):
+            sp = r["spill"]
+            extra += (f" — spill: {sp['pages_evicted']} pages evicted, "
+                      f"{sp['pages_reloaded']} reloaded, "
+                      f"{sp['rows_split_on_reload']} rows split on "
+                      "reload")
         if r.get("fire_latency_ms"):
             lat = r["fire_latency_ms"]
-            extra = (f" (fire p50 {lat['p50']:.0f} ms / "
-                     f"p99 {lat['p99']:.0f} ms, n={lat['count']})")
+            extra += (f" (fire p50 {lat['p50']:.0f} ms / "
+                      f"p99 {lat['p99']:.0f} ms, n={lat['count']})")
         lines.append(f"| {name} | {r['metric']} | {val}{extra} | "
                      f"{r.get('unit', '')} |")
     lines.append("")

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Tier-1 gate — the ONE command builders and CI both run, pinned to the
+# exact ROADMAP.md verify invocation (JAX_PLATFORMS=cpu, timeout, marker
+# filter) plus a CPU bench smoke, so the gate never drifts between
+# environments.
+#
+#   bash tools/tier1.sh            # tests + bench smoke
+#   SKIP_BENCH_SMOKE=1 bash tools/tier1.sh   # tests only
+
+set -u
+cd "$(dirname "$0")/.."
+
+set -o pipefail
+log="${T1_LOG:-/tmp/_t1.$$.log}"   # unique per run: concurrent gates must not clobber
+rm -f "$log"
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+  -p no:xdist -p no:randomly 2>&1 | tee "$log"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$log" \
+  | tr -cd . | wc -c)"
+if [ "$rc" -ne 0 ]; then
+  exit "$rc"
+fi
+
+if [ "${SKIP_BENCH_SMOKE:-0}" != "1" ]; then
+  # CPU bench smoke: a reduced Q5 run must still emit its JSON line
+  # (catches import/config regressions the unit tests cannot)
+  BENCH_SKIP_PROBE=1 BENCH_RECORDS=$((1 << 20)) BENCH_REPS=1 \
+    JAX_PLATFORMS=cpu timeout -k 10 600 python bench.py || exit 1
+fi
