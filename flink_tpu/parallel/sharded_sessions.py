@@ -106,9 +106,14 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         key_group_range: Optional[Tuple[int, int]] = None,
         memory=None,
         spill_layout: str = "pages",
+        max_dispatch_ahead: int = 2,
     ) -> None:
         self.gap = int(gap)
         self.agg = agg
+        #: dispatch-ahead depth: how many batches' device work may be in
+        #: flight while the host preps the next (double-buffered by
+        #: default; see MeshSpillSupport._init_pipeline)
+        self.max_dispatch_ahead = max(int(max_dispatch_ahead or 1), 1)
         if spill_layout not in ("namespaces", "pages"):
             raise ValueError(
                 f"spill_layout must be 'namespaces' or 'pages', got "
@@ -245,15 +250,17 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             self.meta.late_records_dropped += int(
                 sess_counts[~live_sess].sum())
 
-        # per-shard slot resolution for the live sessions
+        # per-shard slot resolution for the live sessions (ONE bincount
+        # plan instead of P boolean mask scans)
         m = len(sess_key)
         sess_shard = shard_records(sess_key, self.P,
             self.max_parallelism, self.key_group_range)
+        shard_counts = np.bincount(sess_shard[live_sess],
+                                   minlength=self.P) if m else \
+            np.zeros(self.P, dtype=np.int64)
         per_shard_sel = {}
-        for p in range(self.P):
-            sel = (sess_shard == p) & live_sess
-            if sel.any():
-                per_shard_sel[p] = sel
+        for p in np.nonzero(shard_counts)[0].tolist():
+            per_shard_sel[p] = (sess_shard == p) & live_sess
         slot_of_sess = np.zeros(m, dtype=np.int32)
         if self._paged:
             resolved = self._resolve_slots_paged({
@@ -284,20 +291,27 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         rec_shards[order] = sess_shard[rec_to_sess]
         values = self.agg.map_input(batch)
         in_leaves = self.agg.input_leaves
+        # pipelining: claim a dispatch slot BEFORE rewriting the pooled
+        # staging buffers (their previous consumer must have finished),
+        # then bucket batch k+1 while the device still runs batch k
+        self._await_dispatch_slot()
+        self._shuffle_pool.flip()
         counts, blocked, _ = bucket_by_shard(
             rec_shards, self.P,
-            columns=[rec_slots,
+            columns=[np.asarray(rec_slots, dtype=np.int32),
                      *[np.asarray(v, dtype=l.dtype)
                        for v, l in zip(values, in_leaves)]],
             fills=[0, *[l.identity for l in in_leaves]],
+            pool=self._shuffle_pool,
         )
-        slot_block = blocked[0].astype(np.int32)
+        slot_block = blocked[0]
         value_blocks = blocked[1:]
         self.accs = self._scatter_step(
             self.accs,
             self._put_sharded(slot_block),
             tuple(self._put_sharded(v) for v in value_blocks),
         )
+        self._push_dispatch_fence()
 
     def _run_merge_group(self, g: MergeGroup) -> None:
         gk = np.asarray(g.keys_dst, dtype=np.int64)
@@ -356,7 +370,8 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             self._put_sharded(src_block))
         # absorbed host slots reusable now that the kernel moved the values;
         # record tombstones so delta snapshots drop the absorbed rows
-        self._freed_ns.extend(int(s) for s in g.absorbed_sids)
+        self._freed_ns.extend(
+            np.asarray(g.absorbed_sids, dtype=np.int64).tolist())
         if self._track_ns:
             self._drop_spilled(g.absorbed_sids)
             for p in range(self.P):
@@ -376,53 +391,66 @@ class MeshSessionEngine(MeshPagedSpillSupport):
 
     # ------------------------------------------------------------------ fire
 
-    def on_watermark(self, watermark: int) -> List[RecordBatch]:
+    #: fires may be dispatched async (on_watermark(async_ok=True)
+    #: returns PendingFire handles) — the pipelined driver overlaps the
+    #: device fire + D2H copy with the next batches' host bucketing and
+    #: harvests coalesced, in dispatch order (no reordering)
+    supports_async_fires = True
+
+    def on_watermark(self, watermark: int,
+                     async_ok: bool = False) -> List[RecordBatch]:
         keys, starts, ends, sids = self.meta.pop_fired(watermark)
         if not keys:
             return []
         if self._spill_active:
             # a catch-up fire can exceed the device budget; chunking keeps
-            # each fire's working set (1 slot per session) under it —
-            # fired slots free immediately, so chunks reuse the space
+            # each fire's working set under it — fired slots free
+            # immediately, so chunks reuse the space. The hybrid (paged)
+            # fire touches the device only for already-RESIDENT rows, so
+            # its device working set is bounded by the table itself and
+            # the chunk merely bounds host-side assembly — chunking
+            # per half-budget there would re-read the same pages once
+            # per chunk for nothing.
             chunk = max(self.max_device_slots // 2, 1024)
+            if self._paged:
+                chunk = max(chunk, 1 << 20)
             if len(keys) > chunk:
                 out: List[RecordBatch] = []
                 for a in range(0, len(keys), chunk):
                     out.extend(self._fire_sessions(
                         keys[a:a + chunk], starts[a:a + chunk],
-                        ends[a:a + chunk], sids[a:a + chunk]))
+                        ends[a:a + chunk], sids[a:a + chunk],
+                        async_ok=async_ok))
                 return out
-        return self._fire_sessions(keys, starts, ends, sids)
+        return self._fire_sessions(keys, starts, ends, sids,
+                                   async_ok=async_ok)
 
-    def _fire_sessions(self, keys, starts, ends,
-                       sids) -> List[RecordBatch]:
+    def _fire_sessions(self, keys, starts, ends, sids,
+                       async_ok: bool = False) -> List[RecordBatch]:
         k_arr = np.asarray(keys, dtype=np.int64)
         sid_arr = np.asarray(sids, dtype=np.int64)
         shards = shard_records(k_arr, self.P,
             self.max_parallelism, self.key_group_range)
         per_shard_sel: List[np.ndarray] = [
             np.nonzero(shards == p)[0] for p in range(self.P)]
-        resolved: Dict[int, np.ndarray] = {}
         if self._paged:
-            # cold (spilled) sessions reload by page to fire from the
-            # device table (the cohort bet: rows evicted together come
-            # due together, so the reload mostly pulls rows it needs)
-            resolved = self._resolve_slots_paged({
-                p: (k_arr[sel], sid_arr[sel])
-                for p, sel in enumerate(per_shard_sel) if len(sel)})
-        else:
-            if self._spill_active:
-                touched = {p: np.unique(sid_arr[sel])
-                           for p, sel in enumerate(per_shard_sel)
-                           if len(sel)}
-                self._ensure_resident(touched)
-                for p in touched:
-                    sel = per_shard_sel[p]
-                    self._reserve(p, k_arr[sel], sid_arr[sel])
-            for p, sel in enumerate(per_shard_sel):
-                if len(sel):
-                    resolved[p] = self.indexes[p].lookup_or_insert(
-                        k_arr[sel], sid_arr[sel])
+            return self._fire_sessions_hybrid(
+                k_arr, np.asarray(starts, dtype=np.int64),
+                np.asarray(ends, dtype=np.int64), sid_arr,
+                per_shard_sel, async_ok)
+        resolved: Dict[int, np.ndarray] = {}
+        if self._spill_active:
+            touched = {p: np.unique(sid_arr[sel])
+                       for p, sel in enumerate(per_shard_sel)
+                       if len(sel)}
+            self._ensure_resident(touched)
+            for p in touched:
+                sel = per_shard_sel[p]
+                self._reserve(p, k_arr[sel], sid_arr[sel])
+        for p, sel in enumerate(per_shard_sel):
+            if len(sel):
+                resolved[p] = self.indexes[p].lookup_or_insert(
+                    k_arr[sel], sid_arr[sel])
         w_max = 0
         per_shard_slots: List[np.ndarray] = []
         for p, sel in enumerate(per_shard_sel):
@@ -436,11 +464,11 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         sm = np.zeros((self.P, W, 1), dtype=np.int32)
         for p, slots in enumerate(per_shard_slots):
             sm[p, : len(slots), 0] = slots
-        results = {name: np.asarray(arr)
-                   for name, arr in self._fire_step(
-                       self.accs, self._put_sharded(sm)).items()}
-        # reset fired slots + free their index entries
-        self._freed_ns.extend(int(s) for s in sids)
+        fire_out = self._fire_step(self.accs, self._put_sharded(sm))
+        # reset fired slots + free their index entries; the donated
+        # reset is device-queue-ordered BEHIND the fire kernel, so a
+        # deferred (async) host read never races it
+        self._freed_ns.extend(sid_arr.tolist())
         rb = np.zeros((self.P, W), dtype=np.int32)
         for p, slots in enumerate(per_shard_slots):
             rb[p, : len(slots)] = slots
@@ -468,11 +496,148 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             WINDOW_END_FIELD: en_arr[out_idx],
             TIMESTAMP_FIELD: en_arr[out_idx] - 1,
         }
-        for name, arr in results.items():
-            chunks = [arr[p][: len(per_shard_sel[p])]
-                      for p in range(self.P) if len(per_shard_sel[p])]
-            cols[name] = np.concatenate(chunks)
-        return [RecordBatch(cols)]
+        per_shard_counts = [len(s) for s in per_shard_sel]
+        names = sorted(fire_out.keys())
+
+        def build(host: List[np.ndarray]) -> RecordBatch:
+            full = dict(cols)
+            for name, arr in zip(names, host):
+                chunks = [arr[p][:m]
+                          for p, m in enumerate(per_shard_counts) if m]
+                full[name] = np.concatenate(chunks)
+            return RecordBatch(full)
+
+        if async_ok:
+            from flink_tpu.runtime.pending import PendingFire
+
+            return [PendingFire([fire_out[n] for n in names], build)]
+        return [build([np.asarray(fire_out[n]) for n in names])]
+
+    def _fire_sessions_hybrid(self, k_arr, st_arr, en_arr, sid_arr,
+                              per_shard_sel, async_ok: bool
+                              ) -> List[RecordBatch]:
+        """Paged-layout fire: RESIDENT sessions merge+finish on device
+        (one fire kernel over the whole mesh), COLD sessions fire
+        straight from page storage — their accumulators are already on
+        the host, and a fired session frees immediately, so reloading
+        it into the device table (the old path) bought nothing and cost
+        everything: at the thrashing benchmark shape ~90% of fires were
+        cold, and every reload evicted resident rows that later fired
+        cold themselves (reload->evict churn: rows_evicted tracked
+        rows_reloaded 1:1). Extraction tombstones the page rows (see
+        paged_spill.reload_rows_for) — no device traffic at all."""
+        from flink_tpu.state.paged_spill import (
+            reload_rows_for,
+            sorted_match,
+        )
+
+        leaves = self.agg.leaves
+        n = len(k_arr)
+        self._freed_ns.extend(sid_arr.tolist())
+        leaf_dtypes = [l.dtype for l in leaves]
+        res_pos: List[np.ndarray] = []   # positions fired on device
+        res_slots: List[np.ndarray] = []
+        cold_chunks: List[np.ndarray] = []  # positions fired from pages
+        cold_vals: List[List[np.ndarray]] = [[] for _ in leaves]
+        w_max = 0
+        for p, sel in enumerate(per_shard_sel):
+            if len(sel) == 0:
+                res_pos.append(np.empty(0, dtype=np.int64))
+                res_slots.append(np.empty(0, dtype=np.int32))
+                continue
+            idx = self.indexes[p]
+            ks, ss = k_arr[sel], sid_arr[sel]
+            slots = idx.lookup(ks, ss)  # read-only: no insert, no evict
+            hit = slots >= 0
+            rslots = slots[hit].astype(np.int32)
+            res_pos.append(sel[hit])
+            res_slots.append(rslots)
+            w_max = max(w_max, len(rslots))
+            cold = ~hit
+            if cold.any():
+                cpos = sel[cold]
+                # identity where no state exists (matching the old
+                # path's fire of a freshly-inserted identity row)
+                vals_p = [np.full(len(cpos), l.identity, dtype=l.dtype)
+                          for l in leaves]
+                rl = reload_rows_for(self.spills[p], self._pmaps[p],
+                                     ss[cold], leaf_dtypes) \
+                    if len(self._pmaps[p]) else None
+                if rl is not None:
+                    _, rns, _, rvals = rl
+                    # align extracted rows (unordered) to their fired
+                    # positions; sids are unique, misses keep identity
+                    order = np.argsort(rns)
+                    found, pos = sorted_match(rns[order], ss[cold])
+                    src = order[pos[found]]
+                    for i in range(len(leaves)):
+                        vals_p[i][found] = rvals[i][src]
+                cold_chunks.append(cpos)
+                for i in range(len(leaves)):
+                    cold_vals[i].append(vals_p[i])
+            # slot-addressed free of the resident fired rows (their
+            # cold siblings were unmapped by the extraction above)
+            if len(rslots):
+                idx.free_slots(rslots)
+                self._dirty[p, rslots] = False
+        # device part: fire + reset over resident rows only (the reset
+        # is queue-ordered behind the fire, so async reads never race)
+        fire_out = None
+        if w_max:
+            W = sticky_bucket(w_max, self._fire_bucket, minimum=64)
+            self._fire_bucket = W
+            sm = np.zeros((self.P, W, 1), dtype=np.int32)
+            rb = np.zeros((self.P, W), dtype=np.int32)
+            for p, rslots in enumerate(res_slots):
+                m = len(rslots)
+                sm[p, :m, 0] = rslots
+                rb[p, :m] = rslots
+            fire_out = self._fire_step(self.accs, self._put_sharded(sm))
+            self.accs = self._reset_step(self.accs,
+                                         self._put_sharded(rb))
+        # host finish over the COLD positions only (the resident
+        # majority's finish already ran inside the device fire kernel)
+        names = sorted(self.agg.output_names)
+        if cold_chunks:
+            cold_pos = np.concatenate(cold_chunks)
+            finished = self.agg.finish(tuple(
+                np.concatenate(c) for c in cold_vals))
+            cold_out = {name: np.asarray(col)
+                        for name, col in finished.items()}
+        else:
+            cold_pos = None
+            cold_out = {}
+        out_idx = np.concatenate([s for s in per_shard_sel if len(s)])
+        cols = {
+            KEY_ID_FIELD: k_arr[out_idx],
+            WINDOW_START_FIELD: st_arr[out_idx],
+            WINDOW_END_FIELD: en_arr[out_idx],
+            TIMESTAMP_FIELD: en_arr[out_idx] - 1,
+        }
+
+        def build(host: List[np.ndarray]) -> RecordBatch:
+            full = dict(cols)
+            for i, name in enumerate(names):
+                if cold_pos is not None:
+                    vals = np.empty(n, dtype=cold_out[name].dtype)
+                    vals[cold_pos] = cold_out[name]
+                else:
+                    vals = np.empty(n, dtype=host[i].dtype)
+                if host:
+                    arr = host[i]
+                    for p, rpos in enumerate(res_pos):
+                        m = len(rpos)
+                        if m:
+                            vals[rpos] = arr[p][:m]
+                full[name] = vals[out_idx]
+            return RecordBatch(full)
+
+        arrays = [fire_out[nm] for nm in names] if fire_out else []
+        if async_ok:
+            from flink_tpu.runtime.pending import PendingFire
+
+            return [PendingFire(arrays, build)]
+        return [build([np.asarray(a) for a in arrays])]
 
     # ---------------------------------------------------------- point query
 

@@ -50,6 +50,11 @@ from flink_tpu.windowing.windower import WINDOW_END_FIELD, WINDOW_START_FIELD
 # repeated engines (warmup + measured runs, restarted jobs) share executables.
 _STEP_CACHE: Dict[tuple, tuple] = {}
 
+# Tiny non-donated slice dispatched after everything queued so far: its
+# readiness proves the device consumed every earlier host buffer (the
+# mesh form of SlotTable.make_fence). jit caches per input sharding.
+_FENCE_STEP = jax.jit(lambda a: a[:1, :1])
+
 
 class MeshSpillSupport:
     """Per-shard spill tier shared by the mesh window and mesh session
@@ -91,6 +96,42 @@ class MeshSpillSupport:
         self._ns_touch: List[Dict[int, int]] = [{} for _ in range(self.P)]
         self._touch_clock = 0
         self._reload_bucket = 0
+        self._init_pipeline(getattr(self, "max_dispatch_ahead", 2))
+
+    # ------------------------------------------------- host/device pipelining
+
+    def _init_pipeline(self, depth: int) -> None:
+        """Double-buffered dispatch-ahead: the host preps (and buckets)
+        batch k+1 while the device still runs batch k. ``depth`` bounds
+        how many dispatched-but-unfenced batches may be in flight; the
+        shuffle pool rotates the same number of buffer generations, so a
+        staging buffer is only rewritten after the dispatch that read it
+        has provably completed (fence discipline — device_put from a
+        host buffer is NOT synchronous on a real accelerator link)."""
+        from collections import deque
+
+        from flink_tpu.parallel.shuffle import ShuffleBufferPool
+
+        self._pipeline_depth = max(int(depth or 1), 1)
+        self._shuffle_pool = ShuffleBufferPool(
+            generations=self._pipeline_depth)
+        self._dispatch_fences = deque()
+
+    def make_fence(self):
+        """A tiny non-donated device value enqueued AFTER everything
+        dispatched so far — used by the engine's own dispatch-ahead
+        bound and by the task loop's pipelining fences
+        (runtime/operators.py)."""
+        return _FENCE_STEP(self.accs[0])
+
+    def _await_dispatch_slot(self) -> None:
+        """Block until < depth dispatches are outstanding. MUST run
+        before this batch's staging buffers are (re)written."""
+        while len(self._dispatch_fences) >= self._pipeline_depth:
+            self._dispatch_fences.popleft().block_until_ready()
+
+    def _push_dispatch_fence(self) -> None:
+        self._dispatch_fences.append(self.make_fence())
 
     @property
     def _spill_active(self) -> bool:
@@ -235,9 +276,12 @@ class MeshSpillSupport:
             slots = self.indexes[p].lookup_or_insert(keys, nss)
             slot_block[p, :n] = slots
             for i, l in enumerate(self.agg.leaves):
-                val_blocks[i][p, :n] = np.concatenate([
-                    np.asarray(e[f"leaf_{i}"], dtype=l.dtype)
-                    for _, e in es])
+                # assemble straight into the staged block row (one
+                # concatenate per leaf, no intermediate copy)
+                np.concatenate(
+                    [np.asarray(e[f"leaf_{i}"], dtype=l.dtype)
+                     for _, e in es],
+                    out=val_blocks[i][p, :n])
             # reloaded rows keep their dirtiness: rows dirty at spill time
             # have not been in any snapshot since
             was_dirty = np.concatenate([
@@ -273,7 +317,6 @@ class MeshSpillSupport:
         pmaps = getattr(self, "_pmaps", None)
         for p in range(self.P):
             sp = self.spills[p]
-            dead = pmaps[p].dead if pmaps is not None else None
             for ns in sp.namespaces:
                 entry = sp.peek(int(ns))
                 if entry is None:
@@ -281,13 +324,11 @@ class MeshSpillSupport:
                 ekeys = np.asarray(entry["key_id"], dtype=np.int64)
                 if "ns" in entry:  # paged entry: per-row namespaces
                     rns = np.asarray(entry["ns"], dtype=np.int64)
-                    if dead:
-                        alive = ~np.isin(rns, np.asarray(
-                            sorted(dead), dtype=np.int64))
-                        ekeys, rns = ekeys[alive], rns[alive]
-                        sel = alive
-                    else:
-                        sel = slice(None)
+                    # lazy tombstones: only rows still mapped to this
+                    # page are logical state (paged_spill)
+                    alive = pmaps[p].live_row_mask(int(ns), rns)
+                    ekeys, rns = ekeys[alive], rns[alive]
+                    sel = alive
                 else:
                     rns = np.full(len(ekeys), int(ns), dtype=np.int64)
                     sel = slice(None)
@@ -315,20 +356,19 @@ class MeshSpillSupport:
         pmaps = getattr(self, "_pmaps", None)
         for p in range(self.P):
             sp = self.spills[p]
-            dead = pmaps[p].dead if pmaps is not None else None
             for ns in sp.dirty_namespaces():
                 entry = sp.peek(int(ns))
                 if entry is None:
                     continue
                 ekeys = np.asarray(entry["key_id"], dtype=np.int64)
                 if "ns" in entry:  # paged entry
-                    sel = np.asarray(entry["dirty"], dtype=bool)
-                    if dead:
-                        sel = sel & ~np.isin(
-                            np.asarray(entry["ns"], dtype=np.int64),
-                            np.asarray(sorted(dead), dtype=np.int64))
+                    rns_all = np.asarray(entry["ns"], dtype=np.int64)
+                    # dirty AND live: a tombstoned row is resident again
+                    # (its device copy travels) or freed
+                    sel = (np.asarray(entry["dirty"], dtype=bool)
+                           & pmaps[p].live_row_mask(int(ns), rns_all))
                     ekeys = ekeys[sel]
-                    rns = np.asarray(entry["ns"], dtype=np.int64)[sel]
+                    rns = rns_all[sel]
                 else:
                     sel = slice(None)
                     rns = np.full(len(ekeys), int(ns), dtype=np.int64)
@@ -384,15 +424,17 @@ class MeshPagedSpillSupport(MeshSpillSupport):
     of the single-device ``spill_layout="pages"`` machinery
     (flink_tpu.state.paged_spill, shared): per shard, the unit of
     movement is an eviction cohort of the coldest rows (slot-granular
-    touch clocks, not namespace recency), reloads pop whole pages and
-    split the requested rows from the re-bundled rest, and the host
-    index runs registry-free (``track_namespaces=False`` — one row per
-    session id makes the per-namespace registry O(live sessions) Python
-    per batch).
+    touch clocks, not namespace recency), reloads extract exactly the
+    requested rows by stored row index and leave LAZY TOMBSTONES in
+    their pages (space comes back via threshold compaction, never
+    read-path rewrites), and the host index runs registry-free
+    (``track_namespaces=False`` — one row per session id makes the
+    per-namespace registry O(live sessions) Python per batch).
 
     Device traffic stays batched across shards: all shards' page reloads
-    land in ONE put program; evictions are per-shard (one gather + one
-    reset program each, the other shards' rows identity no-ops)."""
+    land in ONE put program, and all shards short on headroom evict in
+    ONE gather + ONE reset program per round (the other shards' rows
+    identity no-ops)."""
 
     def _init_paged(self) -> None:
         from flink_tpu.state.paged_spill import PagedSpillMap
@@ -425,51 +467,62 @@ class MeshPagedSpillSupport(MeshSpillSupport):
     def _resolve_slots_paged(
             self, per_shard: Dict[int, Tuple[np.ndarray, np.ndarray]]
     ) -> Dict[int, np.ndarray]:
-        """Batched lookup_or_insert over shards with page reload and
+        """Batched slot resolution over shards with page reload and
         cohort eviction: resident rows of THIS batch get a fresh clock
         (protecting them from the eviction the batch itself triggers),
         missing pairs reload by page (ONE put program for all shards),
-        then the plain per-shard inserts run."""
+        then only the still-missing pairs insert.
+
+        Callers pass session-shaped pairs (one row per globally-unique
+        sid), so no dedup pass runs here and the insert probe is
+        restricted to the pre-lookup's misses — the resident-majority
+        steady state pays ONE native hash probe per row. Duplicate
+        pairs stay correct (the insert dedups); they only overcount the
+        eviction headroom."""
         from flink_tpu.state.paged_spill import reload_rows_for
-        from flink_tpu.state.slot_table import unique_pairs
 
         self._touch_clock += 1
         clock = self._touch_clock
         leaf_dtypes = [l.dtype for l in self.agg.leaves]
         reloads: Dict[int, Tuple[np.ndarray, List[np.ndarray]]] = {}
-        pending: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        extracted: Dict[int, Tuple] = {}
+        out: Dict[int, np.ndarray] = {}
+        missing_by_shard: Dict[int, np.ndarray] = {}
+        needs: Dict[int, int] = {}
         for p, (keys, nss) in per_shard.items():
             keys = np.asarray(keys, dtype=np.int64)
             nss = np.asarray(nss, dtype=np.int64)
             idx = self.indexes[p]
-            uk, un, _ = unique_pairs(keys, nss)
-            pre = idx.lookup(uk, un)
+            pre = idx.lookup(keys, nss)
             hit = pre >= 0
             self._slot_touch[p][pre[hit]] = clock
             missing = ~hit
-            rl = None
-            if missing.any() and len(self._pmaps[p]):
-                rl = reload_rows_for(self.spills[p], self._pmaps[p],
-                                     un[missing], leaf_dtypes)
-            if rl is not None:
-                rkeys, rns, rdirty, rvals = rl
-                fresh = int((~np.isin(un[missing],
-                                      np.unique(rns))).sum())
-                needed = len(rkeys) + fresh
-            else:
-                rkeys = None
-                needed = int(missing.sum())
-            if needed and idx.free_headroom() < needed:
-                self._make_headroom_paged(p, needed)
-            if rkeys is not None:
-                rslots = idx.lookup_or_insert(rkeys, rns)
-                # reloaded rows keep their dirtiness (not snapshotted
-                # since) and take the current clock — the cohort is
-                # likely about to fire
-                self._dirty[p, rslots] = rdirty
-                self._slot_touch[p][rslots] = clock
-                reloads[p] = (rslots.astype(np.int32), rvals)
-            pending[p] = (keys, nss)
+            n_missing = int(missing.sum())
+            if n_missing:
+                if len(self._pmaps[p]):
+                    # pure host work: rows leave their pages by index
+                    # (lazy tombstones — see paged_spill)
+                    rl = reload_rows_for(self.spills[p], self._pmaps[p],
+                                         nss[missing], leaf_dtypes)
+                    if rl is not None:
+                        extracted[p] = rl
+                missing_by_shard[p] = missing
+                needs[p] = n_missing
+            out[p] = pre
+            per_shard[p] = (keys, nss)
+        # one batched eviction round covers every shard short on
+        # headroom (one gather + one reset, not one pair per shard)
+        if needs:
+            self._make_headroom_paged_multi(needs)
+        for p, rl in extracted.items():
+            rkeys, rns, rdirty, rvals = rl
+            rslots = self.indexes[p].lookup_or_insert(rkeys, rns)
+            # reloaded rows keep their dirtiness (not snapshotted
+            # since) and take the current clock — the cohort is
+            # likely about to fire
+            self._dirty[p, rslots] = rdirty
+            self._slot_touch[p][rslots] = clock
+            reloads[p] = (rslots.astype(np.int32), rvals)
         if reloads:
             B = sticky_bucket(max(len(r[0]) for r in reloads.values()),
                               self._reload_bucket)
@@ -485,22 +538,41 @@ class MeshPagedSpillSupport(MeshSpillSupport):
             self.accs = self._put_step(
                 self.accs, self._put_sharded(slot_block),
                 tuple(self._put_sharded(v) for v in val_blocks))
-        out: Dict[int, np.ndarray] = {}
-        for p, (keys, nss) in pending.items():
-            slots = self.indexes[p].lookup_or_insert(keys, nss)
-            self._slot_touch[p][slots] = clock
-            out[p] = slots
+        for p, missing in missing_by_shard.items():
+            keys, nss = per_shard[p]
+            # insert ONLY the pre-lookup misses (reloaded rows resolve
+            # as hits here; genuinely fresh sids insert)
+            slots = out[p]
+            slots[missing] = self.indexes[p].lookup_or_insert(
+                keys[missing], nss[missing])
+            self._slot_touch[p][slots[missing]] = clock
         return out
 
     def _make_headroom_paged(self, p: int, needed: int) -> None:
-        while self.indexes[p].free_headroom() < needed:
-            self._evict_cold_paged(p)
+        self._make_headroom_paged_multi({p: needed})
+
+    def _make_headroom_paged_multi(self, needs: Dict[int, int]) -> None:
+        """Evict cold cohorts for EVERY shard short on headroom in one
+        round: however many shards must evict, the batch costs one
+        gather + one reset program (per-shard eviction paid a dispatch
+        + device sync per shard — at the thrashing shape most batches
+        evict on ~6 of 8 shards, so batching cuts the eviction syncs
+        ~6x)."""
+        pending = {p: n for p, n in needs.items()
+                   if self.indexes[p].free_headroom() < n}
+        while pending:
+            self._evict_cohorts({p: self._choose_eviction_cohort(p)
+                                 for p in pending})
+            pending = {p: n for p, n in pending.items()
+                       if self.indexes[p].free_headroom() < n}
 
     def _evict_cold_paged(self, p: int) -> None:
-        """Evict shard ``p``'s coldest slots (touch < current clock) as
-        ONE page: one gather + one reset program + one spill entry,
-        however many sessions the cohort spans."""
-        from flink_tpu.state.paged_spill import spill_page
+        """Single-shard form (kept for tests/direct callers)."""
+        self._evict_cohorts({p: self._choose_eviction_cohort(p)})
+
+    def _choose_eviction_cohort(self, p: int) -> np.ndarray:
+        """Shard ``p``'s coldest slots (touch < current clock) — the
+        rows this round's page will carry."""
         from flink_tpu.state.slot_table import SlotTableFullError
 
         idx = self.indexes[p]
@@ -513,33 +585,46 @@ class MeshPagedSpillSupport(MeshSpillSupport):
                 "resident row was touched by the current batch — raise "
                 "state.slot-table.max-device-slots or reduce batch size")
         target = min(max(idx.capacity // 8, 1024), len(evictable))
-        et = self._slot_touch[p][evictable]
         if target < len(evictable):
+            et = self._slot_touch[p][evictable]
             sel = np.argpartition(et, target - 1)[:target]
             chosen = evictable[sel]
         else:
             chosen = evictable
-        chosen = np.asarray(chosen, dtype=np.int32)
-        n = len(chosen)
-        G = sticky_bucket(n, self._gather_bucket)
+        return np.asarray(chosen, dtype=np.int32)
+
+    def _evict_cohorts(self, cohorts: Dict[int, np.ndarray]) -> None:
+        """Move each shard's chosen cohort to its spill tier as one
+        page — ONE gather + ONE reset program for all shards (rows of
+        non-evicting shards are identity no-ops)."""
+        from flink_tpu.state.paged_spill import spill_page
+
+        n_max = max(len(c) for c in cohorts.values())
+        G = sticky_bucket(n_max, self._gather_bucket)
         self._gather_bucket = G
         block = np.zeros((self.P, G), dtype=np.int32)
-        block[p, :n] = chosen
+        for p, chosen in cohorts.items():
+            block[p, : len(chosen)] = chosen
         gathered = self._gather_step(self.accs, self._put_sharded(block))
-        entry = {
-            "key_id": np.asarray(idx.slot_key[chosen]),
-            "ns": np.asarray(idx.slot_ns[chosen]),
-            "dirty": self._dirty[p, chosen].copy(),
-            **{f"leaf_{i}": np.asarray(g)[p][:n]
-               for i, g in enumerate(gathered)},
-        }
-        spill_page(self.spills[p], self._pmaps[p], entry)
-        idx.free_slots(chosen)
-        self._dirty[p, chosen] = False
-        R = sticky_bucket(n, getattr(self, "_reset_bucket", 0))
+        gathered_host = [np.asarray(g) for g in gathered]
+        for p, chosen in cohorts.items():
+            idx = self.indexes[p]
+            n = len(chosen)
+            entry = {
+                "key_id": np.asarray(idx.slot_key[chosen]),
+                "ns": np.asarray(idx.slot_ns[chosen]),
+                "dirty": self._dirty[p, chosen].copy(),
+                **{f"leaf_{i}": g[p][:n]
+                   for i, g in enumerate(gathered_host)},
+            }
+            spill_page(self.spills[p], self._pmaps[p], entry)
+            idx.free_slots(chosen)
+            self._dirty[p, chosen] = False
+        R = sticky_bucket(n_max, getattr(self, "_reset_bucket", 0))
         self._reset_bucket = R
         rb = np.zeros((self.P, R), dtype=np.int32)
-        rb[p, :n] = chosen
+        for p, chosen in cohorts.items():
+            rb[p, : len(chosen)] = chosen
         self.accs = self._reset_step(self.accs, self._put_sharded(rb))
 
     def _free_rows_paged(self, p: int, slots: np.ndarray,
@@ -600,9 +685,13 @@ class MeshWindowEngine(MeshSpillSupport):
         spill_host_max_bytes: int = 0,
         key_group_range: Optional[Tuple[int, int]] = None,
         memory=None,
+        max_dispatch_ahead: int = 2,
     ) -> None:
         self.assigner = assigner
         self.agg = agg
+        #: dispatch-ahead depth (double-buffered by default; see
+        #: MeshSpillSupport._init_pipeline)
+        self.max_dispatch_ahead = max(int(max_dispatch_ahead or 1), 1)
         #: (first, last) inclusive GLOBAL key groups this engine owns; the
         #: mesh shards within the range (mesh x stage — see shard_records)
         self.key_group_range = key_group_range
@@ -804,12 +893,18 @@ class MeshWindowEngine(MeshSpillSupport):
         else:
             values = self.agg.map_input(batch)
             leaves = self.agg.input_leaves
+        # pipelining: wait for a dispatch slot BEFORE rewriting the
+        # pooled staging buffers, then bucket while the device still
+        # runs the previous batches
+        self._await_dispatch_slot()
+        self._shuffle_pool.flip()
         counts, blocked, order = bucket_by_shard(
             shards, self.P,
             columns=[key_ids, slice_ends,
                      *[np.asarray(v, dtype=l.dtype)
                        for v, l in zip(values, leaves)]],
             fills=[0, 0, *[l.identity for l in leaves]],
+            pool=self._shuffle_pool,
         )
         key_block, ns_block = blocked[0], blocked[1]
         value_blocks = blocked[2:]
@@ -841,6 +936,7 @@ class MeshWindowEngine(MeshSpillSupport):
             self._put_sharded(slot_block),
             tuple(self._put_sharded(v) for v in value_blocks),
         )
+        self._push_dispatch_fence()
 
     # ------------------------------------------------------------------ fire
 

@@ -248,7 +248,10 @@ class WindowAggOperator(Operator):
                 spill_dir=spill.get("spill_dir"),
                 spill_host_max_bytes=spill.get("spill_host_max_bytes", 0),
                 key_group_range=getattr(ctx, "key_group_range", None),
-                memory=self._managed_memory(ctx))
+                memory=self._managed_memory(ctx),
+                # engine-level dispatch-ahead follows the task's
+                # pipeline depth (execution.pipeline.max-dispatch-batches)
+                max_dispatch_ahead=getattr(ctx, "max_dispatch_ahead", 2))
         else:
             table_kwargs, placement = self._table_kwargs()
             if self._managed_memory(ctx) is not None:
@@ -373,9 +376,15 @@ class WindowAggOperator(Operator):
                 "processing-time window)")
         self.windower.process_batch(batch)
         if self._async_fires:
-            table = getattr(self.windower, "table", None)
-            fence = table.make_fence() if table is not None and hasattr(
-                table, "make_fence") else None
+            # the mesh engines fence on the engine itself (their state
+            # is the sharded [P, cap] arrays, not a .table); the
+            # single-device engines fence on their slot/pane table
+            fence_src = getattr(self.windower, "make_fence", None)
+            if fence_src is None:
+                table = getattr(self.windower, "table", None)
+                fence_src = getattr(table, "make_fence", None) \
+                    if table is not None else None
+            fence = fence_src() if fence_src is not None else None
             if fence is not None:
                 self._fences.append(fence)
                 while len(self._fences) > self._max_dispatch_ahead:
@@ -611,7 +620,10 @@ class SessionWindowAggOperator(WindowAggOperator):
                 memory=self._managed_memory(ctx),
                 # sessions default to the paged (cohort) spill layout,
                 # same as the single-device engine
-                spill_layout=spill.get("spill_layout", "pages"))
+                spill_layout=spill.get("spill_layout", "pages"),
+                # engine-level dispatch-ahead follows the task's
+                # pipeline depth (execution.pipeline.max-dispatch-batches)
+                max_dispatch_ahead=getattr(ctx, "max_dispatch_ahead", 2))
         else:
             table_kwargs, _ = self._table_kwargs()
             if self._managed_memory(ctx) is not None:

@@ -672,6 +672,22 @@ class SpillTier:
         """Discard a spilled namespace (window fully fired elsewhere)."""
         self.pop(ns)
 
+    def discard(self, ns: int) -> None:
+        """Delete a spilled namespace WITHOUT loading it — a page in
+        the fs tier is unlinked, never read/deserialized (the hot-path
+        reap of fully-dead pages must not pay a wasted disk read)."""
+        entry = self._host.pop(ns, None)
+        if entry is not None:
+            self._host_bytes -= self._entry_bytes(entry)
+        elif ns in self._fs:
+            from flink_tpu.core.fs import get_filesystem
+
+            path = self._fs.pop(ns)
+            fs, local = get_filesystem(path)
+            fs.delete(local)
+        self._dirty.discard(ns)
+        self._rows.pop(ns, None)
+
     def dirty_namespaces(self) -> List[int]:
         return list(self._dirty)
 
@@ -909,14 +925,6 @@ class SlotTable:
     def _sp_page(self, v: np.ndarray) -> None:
         self._pmap.sp_page = v
 
-    @property
-    def _dead_spilled(self) -> set:
-        return self._pmap.dead
-
-    @_dead_spilled.setter
-    def _dead_spilled(self, v) -> None:
-        self._pmap.dead = set(v)
-
     def spill_counters(self) -> Dict[str, int]:
         """Paged spill traffic counters (zeros when not paged)."""
         from flink_tpu.state.paged_spill import PagedSpillMap
@@ -933,11 +941,10 @@ class SlotTable:
         return self._pmap.spilled_mask(nss)
 
     def _reload_pages_for(self, nss: np.ndarray, clock: int) -> None:
-        """Reload every page containing any of ``nss`` — whole pages (the
-        block-cache bet: rows evicted together in one cohort become due
-        together, so a fire's reload mostly pulls rows it needs); the
-        pages' other rows re-bundle host-side (split-on-reload, see
-        flink_tpu.state.paged_spill)."""
+        """Reload the requested rows from their pages — extraction by
+        stored row index; the pages' other rows stay put as lazy
+        tombstones and compact only past the dead-fraction threshold
+        (see flink_tpu.state.paged_spill)."""
         from flink_tpu.state.paged_spill import reload_rows_for
 
         rl = reload_rows_for(self.spill, self._pmap, nss,
@@ -1664,13 +1671,11 @@ class SlotTable:
             keys = np.asarray(entry["key_id"], dtype=np.int64)
             if "ns" in entry:  # paged layout: entry carries its ns column
                 rns = np.asarray(entry["ns"], dtype=np.int64)
-                if self._paged and self._dead_spilled:
-                    alive = ~np.isin(rns, np.asarray(
-                        sorted(self._dead_spilled), dtype=np.int64))
-                    keys, rns = keys[alive], rns[alive]
-                    sel = alive
-                else:
-                    sel = slice(None)
+                # lazy tombstones: reloaded/freed rows stay physically
+                # in the page; only rows still MAPPED to it are state
+                alive = self._pmap.live_row_mask(int(pid_or_ns), rns)
+                keys, rns = keys[alive], rns[alive]
+                sel = alive
             else:
                 rns = np.full(len(keys), int(pid_or_ns), dtype=np.int64)
                 sel = slice(None)
@@ -1723,14 +1728,15 @@ class SlotTable:
                 continue
             keys = np.asarray(entry["key_id"], dtype=np.int64)
             if "ns" in entry:
-                sel = np.asarray(entry["dirty"], dtype=bool)
-                if self._paged and self._dead_spilled:
-                    sel &= ~np.isin(
-                        np.asarray(entry["ns"], dtype=np.int64),
-                        np.asarray(sorted(self._dead_spilled),
-                                   dtype=np.int64))
+                rns_all = np.asarray(entry["ns"], dtype=np.int64)
+                # dirty rows that are also LIVE (tombstoned rows are
+                # either resident again — the resident copy travels —
+                # or freed, so their stale page copy must not)
+                sel = (np.asarray(entry["dirty"], dtype=bool)
+                       & self._pmap.live_row_mask(int(pid_or_ns),
+                                                  rns_all))
                 keys = keys[sel]
-                rns = np.asarray(entry["ns"], dtype=np.int64)[sel]
+                rns = rns_all[sel]
             else:
                 sel = slice(None)
                 rns = np.full(len(keys), int(pid_or_ns), dtype=np.int64)

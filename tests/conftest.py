@@ -32,6 +32,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: wall-clock-sensitive or long tests, excluded from the "
+        "tier-1 gate (-m 'not slow' — see tools/tier1.sh)")
+
+
 @pytest.fixture
 def eight_device_mesh():
     import jax

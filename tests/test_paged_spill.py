@@ -76,20 +76,21 @@ class TestPagedSlotTable:
         # free a resident chunk (the most recent rows stay resident)
         slots = t.lookup_or_insert(keys[-100:], sids[-100:])
         t.free_rows(slots, sids[-100:])
-        # free spilled sessions (dead set): the oldest rows paged out
+        # free spilled sessions: the oldest rows paged out; dropping
+        # them tombstones their page rows (no rewrite)
         spilled_mask = t._spilled_mask(sids[:100])
         assert spilled_mask.any()
         dead = sids[:100][spilled_mask]
-        # paged free of non-resident sessions records them dead
-        t._dead_spilled.update(dead.tolist())
-        keep = ~np.isin(t._sp_ns, dead)
-        t._sp_ns, t._sp_page = t._sp_ns[keep], t._sp_page[keep]
+        t._drop_spilled_sessions(dead)
         snap = t.snapshot()
         got = set(int(x) for x in snap["namespace"])
         assert not (set(dead.tolist()) & got)
         assert not (set(int(s) for s in sids[-100:]) & got)
 
-    def test_reload_rebundles_unrequested_rows(self):
+    def test_reload_leaves_remainder_unrewritten(self):
+        """Lazy tombstones: reloading a subset extracts exactly the
+        requested rows by index — the page's sibling rows are NOT
+        rewritten (rows_split_on_reload stays 0) and stay readable."""
         t = mk()
         n = 6000
         keys = np.arange(1, n + 1, dtype=np.int64)
@@ -97,13 +98,54 @@ class TestPagedSlotTable:
         put(t, keys, sids, np.full(n, 4.0))
         pages_before = len(t.spill)
         assert pages_before > 0
-        # request ONE old session: its page pops, the sibling rows
-        # re-bundle into a fresh page instead of flooding the device
+        # request ONE old session: only its row leaves its page
         t.lookup_or_insert(keys[:1], sids[:1])
-        assert len(t.spill) >= pages_before  # rest re-bundled
+        c = t.spill_counters()
+        assert c["rows_reloaded"] == 1
+        assert c["rows_split_on_reload"] == 0, \
+            "reload must not rewrite the cohort remainder"
+        assert c["rows_compacted"] == 0, \
+            "one tombstone is far below the compaction threshold"
+        assert len(t.spill) == pages_before  # nothing re-bundled
         # and the sibling rows are still intact
         q = t.query(2, namespace=2)
         assert q[2]["sum_v"] == 4.0
+
+    def test_compaction_only_after_dead_fraction_threshold(self):
+        """A page compacts (rewrites its live remainder) only once its
+        dead fraction crosses the threshold; a fully-dead page drops
+        without any rewrite."""
+        t = mk()
+        n = 6000
+        keys = np.arange(1, n + 1, dtype=np.int64)
+        put(t, keys, keys, np.full(n, 1.0))
+        pmap = t._pmap
+        # pick the largest spilled page and reload just under half of
+        # its rows, one chunk at a time: never compacts
+        page = max(pmap.page_rows, key=pmap.page_rows.get)
+        page_sids = np.sort(pmap.sp_ns[pmap.sp_page == page])
+        rows = len(page_sids)
+        assert rows >= 64
+        just_under = page_sids[: rows // 2]  # dead fraction <= 0.5
+        for a in range(0, len(just_under), 32):
+            chunk = just_under[a:a + 32]
+            t.lookup_or_insert(chunk, chunk)
+        assert t.spill_counters()["rows_compacted"] == 0
+        assert int(pmap.page_rows[page]) == rows, \
+            "page must keep its tombstones until the threshold"
+        # one more chunk pushes the dead fraction over the threshold:
+        # the page rewrites with ONLY its live rows
+        over = page_sids[rows // 2: rows // 2 + 32]
+        t.lookup_or_insert(over, over)
+        c = t.spill_counters()
+        live = rows - len(just_under) - len(over)
+        assert c["rows_compacted"] == live
+        assert c["rows_split_on_reload"] == 0
+        assert page not in pmap.page_rows  # old page gone
+        # the compacted copy still answers queries
+        survivor = int(page_sids[-1])
+        q = t.query(survivor, namespace=survivor)
+        assert q[survivor]["sum_v"] == 1.0
 
     def test_budget_exhaustion_raises(self):
         t = mk(capacity=1024)

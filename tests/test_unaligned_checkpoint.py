@@ -92,6 +92,14 @@ def _timed_checkpoints(monkeypatch):
 
 
 class TestUnalignedCompletesUnderBackpressure:
+    # slow: this test asserts WALL-CLOCK bounds (unaligned checkpoint
+    # < 2 s and < aligned/2) around real time.sleep backpressure — the
+    # assertion is inherently load-sensitive and flaked in the tier-1
+    # gate since the seed whenever the CI host stalled mid-run. The
+    # semantic coverage (results + restore correctness of unaligned
+    # mode) lives in the fast tests below; the timing CLAIM needs a
+    # quiet machine, so it runs in the slow lane only.
+    @pytest.mark.slow
     def test_barrier_overtakes_backlog(self, tmp_path, monkeypatch):
         """With a slow sink and saturated credits, an unaligned checkpoint
         completes in ~one consumer step; an aligned one must wait for the

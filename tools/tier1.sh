@@ -28,4 +28,17 @@ if [ "${SKIP_BENCH_SMOKE:-0}" != "1" ]; then
   # (catches import/config regressions the unit tests cannot)
   BENCH_SKIP_PROBE=1 BENCH_RECORDS=$((1 << 20)) BENCH_REPS=1 \
     JAX_PLATFORMS=cpu timeout -k 10 600 python bench.py || exit 1
+
+  # Mesh-sessions smoke with the page-rewrite amplification gate
+  # pinned: the run FAILS if (rows_split_on_reload + rows_compacted) /
+  # rows_reloaded exceeds the budget. The lazy tombstone design's only
+  # rewrites are threshold compactions (~0.16x measured); the old
+  # split-on-reload path sat at ~16x and cost half the mesh engine's
+  # throughput. 2M records so the live session set genuinely exceeds
+  # the 512k device budget — below ~1M the tier never spills and the
+  # gate would be vacuous.
+  BENCH_SKIP_PROBE=1 BENCH_MESH_SESSION_RECORDS=$((1 << 21)) \
+    BENCH_MESH_REPS=1 BENCH_MESH_AMP_BUDGET=0.5 \
+    JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python tools/bench_mesh_sessions.py || exit 1
 fi
