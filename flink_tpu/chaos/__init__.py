@@ -1,0 +1,38 @@
+"""Chaos engine: deterministic fault injection + crash-restore-verify.
+
+The subsystem that makes the failure story EXECUTABLE: named fault
+points threaded through the shuffle, spill, checkpoint, mesh-engine and
+cluster layers (``injection``), and a harness that kills a pipeline at
+those points, restores from the latest complete checkpoint and diffs
+the final output against a fault-free oracle (``harness``) — the same
+crash/preemption-tolerance contract the reference proves with its
+checkpoint/failover ITCases (flink-runtime checkpoint + failover
+layers), rebuilt for the micro-batch mesh engines.
+
+Everything is reproducible from ``(FaultPlan, seed)``: schedules are
+hit-counted, randomness comes from a dedicated PRNG, and the controller
+is a strict no-op while disarmed (the hot path pays one module-global
+None check).
+"""
+
+from flink_tpu.chaos.injection import (  # noqa: F401
+    ChaosController,
+    FaultPlan,
+    FaultRule,
+    InjectedFault,
+    arm,
+    armed,
+    chaos_active,
+    controller,
+    disarm,
+    fault_point,
+    io_point,
+    payload_action,
+    register_chaos_metrics,
+    run_recoverable,
+)
+from flink_tpu.chaos.harness import (  # noqa: F401
+    ChaosDivergenceError,
+    ChaosReport,
+    run_crash_restore_verify,
+)

@@ -25,9 +25,20 @@ import pickle
 import shutil
 import tempfile
 import time
+import zlib
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+from flink_tpu.chaos import injection as chaos
+
+
+class CheckpointCorruptedError(RuntimeError):
+    """A snapshot failed integrity verification (torn write, bit rot,
+    truncation). Callers fall back to an older complete checkpoint —
+    silently restoring corrupt state is the one unforgivable failure
+    mode (reference: Flink checkpoints fail loudly on corrupt streams;
+    RocksDB verifies block checksums on read)."""
 
 
 #: Snapshot format version (reference: TypeSerializerSnapshot versioning +
@@ -57,6 +68,11 @@ class CheckpointMetadata:
     operator_states: List[str]  # uids with .npz payloads
     extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
     format_version: int = FORMAT_VERSION
+    #: filename -> CRC32 of every payload file, computed before the
+    #: atomic rename; verified on read so a torn/corrupted snapshot is
+    #: DETECTED instead of silently restored. Empty for pre-CRC
+    #: snapshots (read-compatible: verification simply skips).
+    file_crcs: Dict[str, int] = dataclasses.field(default_factory=dict)
 
 
 # --------------------------------------------------------------------------
@@ -120,6 +136,10 @@ def write_snapshot_dir(final_dir: str, checkpoint_id: int, job_name: str,
             not os.path.exists(os.path.join(final_dir, "manifest.json")):
         raise FileExistsError(
             f"refusing to replace non-snapshot directory {final_dir!r}")
+    # chaos: a raise here models a write that failed before anything
+    # became visible; the tmp-dir discipline below guarantees no
+    # half-written chk dir appears (recoverable faults retry in place)
+    chaos.io_point("checkpoint.write", checkpoint_id=checkpoint_id)
     parent = os.path.dirname(os.path.abspath(final_dir)) or "."
     os.makedirs(parent, exist_ok=True)
     tmp_dir = tempfile.mkdtemp(
@@ -136,21 +156,91 @@ def write_snapshot_dir(final_dir: str, checkpoint_id: int, job_name: str,
                 save(os.path.join(tmp_dir, f"op-{uid}.npz"), **arrays)
             with open(os.path.join(tmp_dir, f"op-{uid}.meta.pkl"), "wb") as f:
                 pickle.dump(meta, f)
+        file_crcs = {
+            name: _file_crc32(os.path.join(tmp_dir, name))
+            for name in sorted(os.listdir(tmp_dir))
+        }
         manifest = CheckpointMetadata(
             checkpoint_id=checkpoint_id,
             timestamp_ms=int(time.time() * 1000),
             job_name=job_name,
             operator_states=uids,
-            extra=extra or {})
+            extra=extra or {},
+            file_crcs=file_crcs)
         with open(os.path.join(tmp_dir, "manifest.json"), "w") as f:
             json.dump(dataclasses.asdict(manifest), f)
         if os.path.exists(final_dir):
             shutil.rmtree(final_dir)
         os.rename(tmp_dir, final_dir)
-        return final_dir
     except BaseException:
         shutil.rmtree(tmp_dir, ignore_errors=True)
         raise
+    # chaos: a TORN write — the rename was durable but a payload file's
+    # contents were not (lost page-cache flush on power loss; the
+    # failure mode fsync-less storage actually exhibits). kind="drop"
+    # truncates a file, kind="corrupt" flips one byte; either way the
+    # manifest CRCs make the snapshot detectably — not silently — bad.
+    # Tear kinds ONLY: raising after the rename would model a failure
+    # of a checkpoint that is in fact durable (the caller would discard
+    # its committed epoch while restore skips the replay — a harness
+    # false positive, not a real failure mode). Pre-visibility crashes
+    # belong to the checkpoint.write point above.
+    rule = chaos.payload_action("checkpoint.write.torn",
+                                kinds=("drop", "corrupt"),
+                                checkpoint_id=checkpoint_id)
+    if rule is not None:
+        _tear_snapshot_file(final_dir, truncate=(rule.kind == "drop"))
+    return final_dir
+
+
+def _file_crc32(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            data = f.read(chunk)
+            if not data:
+                return crc
+            crc = zlib.crc32(data, crc)
+
+
+def _tear_snapshot_file(snapshot_dir: str, truncate: bool) -> None:
+    """Damage the first payload file (chaos-only helper): truncate to
+    half, or flip one byte in the middle."""
+    victims = sorted(n for n in os.listdir(snapshot_dir)
+                     if n != "manifest.json")
+    if not victims:
+        return
+    path = os.path.join(snapshot_dir, victims[0])
+    size = os.path.getsize(path)
+    if truncate:
+        with open(path, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+    else:
+        with open(path, "r+b") as f:
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+
+
+def verify_snapshot_files(snapshot_dir: str,
+                          file_crcs: Dict[str, int]) -> None:
+    """Check every manifest-recorded file exists and matches its CRC32;
+    raises :class:`CheckpointCorruptedError` naming the first bad file.
+    Pre-CRC snapshots (empty dict) verify vacuously."""
+    for name, want in file_crcs.items():
+        path = os.path.join(snapshot_dir, name)
+        if not os.path.exists(path):
+            raise CheckpointCorruptedError(
+                f"snapshot {snapshot_dir!r} is incomplete: {name!r} is "
+                "missing (torn write?) — restore from an older complete "
+                "checkpoint")
+        got = _file_crc32(path)
+        if got != int(want):
+            raise CheckpointCorruptedError(
+                f"snapshot {snapshot_dir!r} is corrupt: {name!r} CRC32 "
+                f"{got:#010x} != manifest {int(want):#010x} (torn write "
+                "or bit rot) — restore from an older complete checkpoint")
 
 
 def read_manifest(snapshot_dir: str) -> Dict[str, Any]:
@@ -158,13 +248,24 @@ def read_manifest(snapshot_dir: str) -> Dict[str, Any]:
         return json.load(f)
 
 
-def read_snapshot_dir(snapshot_dir: str) -> Dict[str, Dict[str, Any]]:
+def read_snapshot_dir(snapshot_dir: str,
+                      verify: bool = True) -> Dict[str, Dict[str, Any]]:
     """Read a snapshot directory back into operator-uid -> state dicts.
 
-    Prior-version snapshots are migrated forward step by step; a snapshot
-    from a NEWER format fails with a precise error (reference:
-    TypeSerializerSnapshot compatibility resolution)."""
+    Integrity first: with ``verify`` (the default) every payload file is
+    CRC-checked against the manifest before any state is materialized —
+    a torn or corrupted snapshot raises :class:`CheckpointCorruptedError`
+    instead of restoring garbage. Prior-version snapshots are migrated
+    forward step by step; a snapshot from a NEWER format fails with a
+    precise error (reference: TypeSerializerSnapshot compatibility
+    resolution)."""
+    # chaos: transient read failures retry with backoff in place
+    # (storage I/O is a recoverable site); persistent ones crash
+    chaos.io_point("checkpoint.read", path=snapshot_dir)
     manifest = read_manifest(snapshot_dir)
+    if verify:
+        verify_snapshot_files(snapshot_dir,
+                              manifest.get("file_crcs") or {})
     version = int(manifest.get("format_version", 1))
     if version > FORMAT_VERSION:
         raise RuntimeError(
@@ -361,10 +462,24 @@ class CheckpointStorage:
 
     # ------------------------------------------------------------------- read
 
-    def read_checkpoint(self, checkpoint_id: int) -> Dict[str, Dict[str, Any]]:
-        return read_snapshot_dir(self._dir(checkpoint_id))
+    def read_checkpoint(self, checkpoint_id: int,
+                        verify: bool = True) -> Dict[str, Dict[str, Any]]:
+        """``verify=False`` skips the CRC pass — for callers that just
+        verified this id via ``latest_checkpoint_id(verify=True)`` and
+        would otherwise read every payload file twice."""
+        return read_snapshot_dir(self._dir(checkpoint_id), verify=verify)
 
-    def latest_checkpoint_id(self) -> Optional[int]:
+    def latest_checkpoint_id(self,
+                             verify: bool = False) -> Optional[int]:
+        """Newest COMPLETE checkpoint id, or None.
+
+        A chk dir without a manifest.json (crash mid-write outside the
+        atomic-rename discipline, or external tampering) is never
+        complete and is always skipped. With ``verify``, every payload
+        file is additionally CRC-checked against the manifest, so torn
+        and bit-flipped snapshots are skipped too and the newest id
+        that PASSES wins — the fallback the crash-restore harness
+        relies on."""
         ids = []
         for name in os.listdir(self.root):
             if name.startswith("chk-"):
@@ -372,7 +487,19 @@ class CheckpointStorage:
                     ids.append(int(name[4:]))
                 except ValueError:
                     pass
-        return max(ids) if ids else None
+        for i in sorted(ids, reverse=True):
+            d = self._dir(i)
+            if not os.path.exists(os.path.join(d, "manifest.json")):
+                continue
+            if verify:
+                try:
+                    verify_snapshot_files(
+                        d, read_manifest(d).get("file_crcs") or {})
+                except (CheckpointCorruptedError, OSError,
+                        ValueError):
+                    continue
+            return i
+        return None
 
     def retain(self, keep: int) -> None:
         """Drop all but the newest ``keep`` checkpoints — never a checkpoint

@@ -27,6 +27,7 @@ from flink_tpu.core.config import (
     CoreOptions,
     StateOptions,
 )
+from flink_tpu.chaos import injection as chaos
 from flink_tpu.core.records import RecordBatch
 from flink_tpu.graph.transformations import StreamGraph, Transformation
 from flink_tpu.runtime.elements import MAX_WATERMARK, Watermark
@@ -297,6 +298,9 @@ class LocalExecutor:
         registry = MetricRegistry()
         traces = TraceCollector()
         job_group = registry.root_group("job", job_name)
+        # chaos counters ride the job's metric tree when a fault plan is
+        # armed (job.<name>.chaos.faults_injected / retries / recoveries)
+        chaos.register_chaos_metrics(job_group)
 
         # build nodes
         nodes: Dict[int, _Node] = {}
@@ -761,6 +765,10 @@ class LocalExecutor:
             self._process_watermark(child, wm, idx)
 
     def _process(self, node: _Node, batch: RecordBatch, input_idx: int) -> None:
+        # chaos: a task crash mid-batch — surfaces through the normal
+        # failure path (job fails, RestartStrategy decides, restore from
+        # the latest checkpoint), exactly like a real UDF/executor death
+        chaos.fault_point("task.batch", op=node.transformation.name)
         node.records_in += len(batch)
         outs = node.operator.process_batch(batch, input_idx)
         for out in outs:

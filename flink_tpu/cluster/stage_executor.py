@@ -51,6 +51,7 @@ from flink_tpu.core.config import (
     DeploymentOptions,
     StateOptions,
 )
+from flink_tpu.chaos import injection as chaos
 from flink_tpu.core.records import RecordBatch
 from flink_tpu.graph.transformations import StreamGraph, Transformation
 from flink_tpu.runtime.operators import OperatorContext
@@ -1166,6 +1167,13 @@ class _KeyedSubtask(threading.Thread):
         def process(item, gi: int, slot: int):
             nonlocal combined, stopping
             if isinstance(item, RecordBatch):
+                # chaos: kill one keyed subtask mid-batch; the
+                # coordinator fails the attempt and the job-level
+                # restart/restore machinery takes over (one pipeline =
+                # one failover region)
+                chaos.fault_point("task.subtask_batch",
+                                  stage=self.stage_index,
+                                  subtask=self.index)
                 self.records_in += len(item)
                 forward(self.chain.process_batch(item, input_index=gi))
             elif isinstance(item, int):

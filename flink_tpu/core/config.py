@@ -386,6 +386,28 @@ class RestartOptions:
         "restart-strategy.max-attempts", default=3, type=int)
     DELAY_MS = ConfigOption(
         "restart-strategy.delay-ms", default=100, type=int)
+    MAX_BACKOFF_MS = ConfigOption(
+        "restart-strategy.exponential-delay.max-backoff-ms",
+        default=60_000, type=int,
+        description="Backoff ceiling for exponential-delay.")
+    BACKOFF_MULTIPLIER = ConfigOption(
+        "restart-strategy.exponential-delay.backoff-multiplier",
+        default=2.0, type=float)
+    JITTER_FACTOR = ConfigOption(
+        "restart-strategy.exponential-delay.jitter-factor",
+        default=0.0, type=float,
+        description="Spread each backoff by +/- this fraction "
+        "(thundering-herd protection across concurrent restarts).")
+    RESET_BACKOFF_THRESHOLD_MS = ConfigOption(
+        "restart-strategy.exponential-delay.reset-backoff-threshold-ms",
+        default=0, type=int,
+        description="After this long without failures the backoff and "
+        "attempt budget reset to initial (0 = never reset; reference: "
+        "ExponentialDelayRestartBackoffTimeStrategy).")
+    FAILURE_RATE_INTERVAL_MS = ConfigOption(
+        "restart-strategy.failure-rate.failure-rate-interval-ms",
+        default=60_000, type=int,
+        description="Sliding window for failure-rate counting.")
 
 
 class ClusterOptions:

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from flink_tpu.chaos import injection as chaos
 from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
 from flink_tpu.ops.segment_ops import SCATTER_METHOD, sticky_bucket
 from flink_tpu.parallel.mesh import KEY_AXIS, shard_map
@@ -427,6 +428,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
 
     def _fire_sessions(self, keys, starts, ends, sids,
                        async_ok: bool = False) -> List[RecordBatch]:
+        chaos.fault_point("mesh.session_fire", sessions=len(keys))
         k_arr = np.asarray(keys, dtype=np.int64)
         sid_arr = np.asarray(sids, dtype=np.int64)
         shards = shard_records(k_arr, self.P,

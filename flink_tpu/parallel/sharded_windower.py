@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from flink_tpu.chaos import injection as chaos
 from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
 from flink_tpu.ops.segment_ops import (
     SCATTER_METHOD,
@@ -131,6 +132,12 @@ class MeshSpillSupport:
             self._dispatch_fences.popleft().block_until_ready()
 
     def _push_dispatch_fence(self) -> None:
+        # chaos: a fence failure mid-dispatch-ahead — the batch's device
+        # work is enqueued but its completion proof is lost, which in a
+        # real stack is a device reset/preemption: the engine dies here
+        # with up to `depth` batches in flight (the hardest restore case)
+        chaos.fault_point("mesh.dispatch_fence",
+                          in_flight=len(self._dispatch_fences))
         self._dispatch_fences.append(self.make_fence())
 
     @property
@@ -956,6 +963,7 @@ class MeshWindowEngine(MeshSpillSupport):
         return out
 
     def _fire_window(self, window_end: int) -> Optional[RecordBatch]:
+        chaos.fault_point("mesh.window_fire", window_end=window_end)
         slice_ends = self.assigner.slice_ends_for_window(window_end)
         if self._any_spilled(slice_ends):
             # hybrid fire: resident slices merge on device (one kernel),

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from flink_tpu.chaos import injection as chaos
 from flink_tpu.ops.segment_ops import pad_bucket_size
 from flink_tpu.parallel.mesh import KEY_AXIS, shard_map
 from flink_tpu.state.keygroups import (
@@ -99,7 +100,26 @@ def bucket_by_shard(
     shard_of_record = np.asarray(shard_of_record)
     n = len(shard_of_record)
     counts = np.bincount(shard_of_record, minlength=num_shards)
-    B = pad_bucket_size(int(counts.max()) if n else 0, minimum=min_bucket)
+    # chaos (armed-only — the disarmed path pays one module check):
+    # per-shard bucket faults model a lossy exchange. drop re-fills the
+    # shard's rows (they then scatter identities into slot 0, i.e. the
+    # records vanish in flight), duplicate replays them (B is padded to
+    # hold the copy), delay/raise apply inside payload_action.
+    mutations: Dict[int, str] = {}
+    if chaos.armed():
+        chaos.fault_point("shuffle.bucket_prep", num_shards=num_shards)
+        for p in np.nonzero(counts)[0].tolist():
+            rule = chaos.payload_action("shuffle.bucket_send", shard=p)
+            if rule is not None and rule.kind in ("drop", "duplicate"):
+                mutations[p] = rule.kind
+    eff_counts = counts
+    if mutations:
+        eff_counts = counts.copy()
+        for p, kind in mutations.items():
+            if kind == "duplicate":
+                eff_counts[p] = counts[p] * 2
+    B = pad_bucket_size(int(eff_counts.max()) if n else 0,
+                        minimum=min_bucket)
     order = np.argsort(shard_of_record, kind="stable")
     offsets = np.zeros(num_shards + 1, dtype=np.int64)
     np.cumsum(counts, out=offsets[1:])
@@ -119,6 +139,16 @@ def bucket_by_shard(
         block.reshape((num_shards * B,) + col.shape[1:])[flat_dst] = \
             col[order]
         blocked.append(block)
+    if mutations:
+        for p, kind in mutations.items():
+            c = int(counts[p])
+            for block, fill in zip(blocked, fills):
+                if kind == "drop":
+                    block[p, :c] = fill
+                else:  # duplicate: replay the bucket's rows
+                    block[p, c:2 * c] = block[p, :c]
+            eff_counts[p] = 0 if kind == "drop" else 2 * c
+        counts = eff_counts
     return counts, blocked, order
 
 

@@ -57,5 +57,11 @@ class PendingFire:
         one RTT instead of k."""
         import jax
 
+        from flink_tpu.chaos import injection as chaos
+
+        # chaos: a harvest failure — the fire was dispatched but its
+        # D2H results never land (link loss mid-coalesced-harvest)
+        chaos.fault_point("harvest.pending_fire",
+                          arrays=len(self.arrays))
         host = jax.device_get(self.arrays)
         return self.build([np.asarray(a) for a in host])

@@ -50,6 +50,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from flink_tpu.chaos import injection as chaos
+
 COUNTER_NAMES = ("pages_evicted", "pages_reloaded", "rows_evicted",
                  "rows_reloaded", "rows_split_on_reload", "rows_compacted")
 
@@ -247,6 +249,21 @@ def _sweep_pages(spill, pmap: PagedSpillMap, pages: Sequence[int]) -> None:
 def _compact_page(spill, pmap: PagedSpillMap, page: int) -> None:
     """Rewrite one page with only its live rows; remaps its membership
     entries to the fresh page in place."""
+    if chaos.armed():
+        # a failed compaction is SAFE to skip: tombstones stay valid
+        # and the page re-qualifies next sweep (the RocksDB analogy —
+        # a lost compaction costs space, never correctness). Only a
+        # recoverable injected fault defers; a hard one crashes here,
+        # BEFORE the pop, so no page is half-moved.
+        try:
+            chaos.fault_point("spill.page_compact", page=page)
+        except chaos.InjectedFault as f:
+            if f.recoverable:
+                c = chaos.controller()
+                if c is not None:
+                    c.note_recovery()
+                return
+            raise
     entry = spill.pop(page)
     pmap.page_rows.pop(page, None)
     pmap.page_live.pop(page, None)
@@ -281,6 +298,21 @@ def _compact_page(spill, pmap: PagedSpillMap, page: int) -> None:
     pmap.rows_compacted += n
 
 
+def _peek_page(spill, page: int):
+    """One page read on the reload path. Under chaos, a transient
+    injected reload failure retries with restart-strategy backoff in
+    place (the I/O-retry contract shared with checkpoint storage); a
+    persistent one propagates as the engine crash it would be."""
+    if not chaos.armed():
+        return spill.peek(page)
+
+    def attempt():
+        chaos.fault_point("spill.page_reload", page=page)
+        return spill.peek(page)
+
+    return chaos.run_recoverable("spill.page_reload", attempt)
+
+
 def reload_rows_for(spill, pmap: PagedSpillMap, nss: np.ndarray,
                     leaf_dtypes: Sequence) -> Optional[
                         Tuple[np.ndarray, np.ndarray, np.ndarray,
@@ -313,7 +345,7 @@ def reload_rows_for(spill, pmap: PagedSpillMap, nss: np.ndarray,
     pages_read = 0
     for a, b in zip(starts.tolist(), ends.tolist()):
         page = int(hit_pages[a])
-        entry = spill.peek(page)
+        entry = _peek_page(spill, page)
         if entry is None:
             continue
         pages_read += 1

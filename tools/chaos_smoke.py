@@ -1,0 +1,127 @@
+"""Chaos smoke: a seeded crash-restore-verify run for the tier-1 gate.
+
+Drives the mesh session engine (paged spill, forced eviction, dispatch-
+ahead) through a keyed-session stream with periodic checkpoints while a
+fault plan injects TWO engine crashes and ONE torn checkpoint write.
+The run FAILS (non-zero exit) if
+
+- the committed output diverges from the fault-free single-device
+  oracle by even one window (the exactly-once claim), or
+- any planned fault was never injected (the plan went stale — a fault
+  point moved or a schedule stopped being reachable), or
+- the torn checkpoint was restored instead of skipped.
+
+Everything is reproducible from the pinned (plan, seed): rerunning
+this script reproduces the same crashes at the same hits. Runtime is a
+few seconds on CPU (budgeted well under 60 s in tools/tier1.sh).
+
+    JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# must precede the first jax import: on CPU the mesh needs virtual devices
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+GAP = 100
+SEED = 7
+NUM_KEYS = int(os.environ.get("CHAOS_SMOKE_KEYS", 6000))
+N_STEPS = int(os.environ.get("CHAOS_SMOKE_STEPS", 8))
+PER_STEP = int(os.environ.get("CHAOS_SMOKE_PER_STEP", 1500))
+
+
+def _steps():
+    """~12k events, live session set far beyond the 1024-slot/shard
+    budget so page eviction + reload are genuinely on the path."""
+    rng = np.random.default_rng(17)
+    out = []
+    for s in range(N_STEPS):
+        keys = rng.integers(0, NUM_KEYS, PER_STEP).astype(np.int64)
+        vals = rng.random(PER_STEP).astype(np.float32)
+        ts = rng.integers(s * 80, s * 80 + 60, PER_STEP).astype(np.int64)
+        out.append((keys, vals, ts, (s - 1) * 80))
+    return out
+
+
+def main() -> int:
+    from flink_tpu.chaos.harness import (
+        ChaosDivergenceError,
+        run_crash_restore_verify,
+    )
+    from flink_tpu.chaos.injection import FaultPlan, FaultRule
+    from flink_tpu.parallel.mesh import make_mesh
+    from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+    from flink_tpu.windowing.aggregates import SumAggregate
+    from flink_tpu.windowing.sessions import SessionWindower
+
+    mesh = make_mesh(8)
+    plan = FaultPlan(rules=[
+        # crash 1: fence failure mid-dispatch-ahead (batches in flight)
+        FaultRule(pattern="mesh.dispatch_fence", nth=9, kind="raise"),
+        # crash 2: a page reload that stays broken past the retry budget
+        FaultRule(pattern="spill.page_reload", nth=4, kind="raise"),
+        # the torn write: 2nd checkpoint's rename lands, its bytes don't
+        FaultRule(pattern="checkpoint.write.torn", nth=2, kind="drop"),
+    ])
+
+    def make_engine():
+        return MeshSessionEngine(
+            GAP, SumAggregate("v"), mesh,
+            capacity_per_shard=1 << 14, max_device_slots=1024,
+            max_dispatch_ahead=2)
+
+    def make_oracle():
+        return SessionWindower(GAP, SumAggregate("v"), capacity=1 << 15)
+
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="chaos-smoke-") as tmp:
+        try:
+            report = run_crash_restore_verify(
+                make_engine, make_oracle, _steps(), plan, seed=SEED,
+                ckpt_root=os.path.join(tmp, "ckpt"), checkpoint_every=2)
+        except ChaosDivergenceError as e:
+            print(f"CHAOS SMOKE FAILED: output diverged\n{e}",
+                  file=sys.stderr)
+            return 1
+    row = {
+        "bench": "chaos_smoke",
+        "seconds": round(time.perf_counter() - t0, 2),
+        "events": report.events,
+        "windows": report.windows,
+        **report.signature(),
+        "corrupt_checkpoints_skipped": report.corrupt_checkpoints_skipped,
+        "retries": report.retries,
+        "recoveries": report.recoveries,
+    }
+    print(json.dumps(row))
+    failures = []
+    want_points = {"mesh.dispatch_fence", "spill.page_reload",
+                   "checkpoint.write.torn"}
+    missed = want_points - set(report.faults_injected)
+    if missed:
+        failures.append(f"planned faults never injected: {sorted(missed)}")
+    if report.crashes != 2:
+        failures.append(f"expected exactly 2 crashes, got {report.crashes}")
+    if report.corrupt_checkpoints_skipped < 1:
+        failures.append("the torn checkpoint was never detected/skipped")
+    if failures:
+        print("CHAOS SMOKE FAILED: " + "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,4 +41,11 @@ if [ "${SKIP_BENCH_SMOKE:-0}" != "1" ]; then
     BENCH_MESH_REPS=1 BENCH_MESH_AMP_BUDGET=0.5 \
     JAX_PLATFORMS=cpu timeout -k 10 600 \
     python tools/bench_mesh_sessions.py || exit 1
+
+  # Chaos smoke: seeded crash-restore-verify (2 injected engine crashes
+  # + 1 torn checkpoint write over ~12k events) — FAILS on any output
+  # divergence from the fault-free oracle, on a missed injection, or if
+  # the torn checkpoint is restored instead of skipped. ~5 s on CPU.
+  JAX_PLATFORMS=cpu timeout -k 10 120 \
+    python tools/chaos_smoke.py || exit 1
 fi
