@@ -1,0 +1,19 @@
+"""Autoscaler: metrics-driven elastic rescaling of the keyed plane.
+
+``policy`` decides *how many* shards a keyed operator should have
+(DS2-style true-rate estimation with hysteresis, cooldown and bounds);
+``controller`` decides *how to get there* — live key-group migration
+for the mesh engines (``MeshWindowEngine.reshard`` /
+``MeshSessionEngine.reshard``), the minicluster's reactive redeploy
+(checkpoint-restore-at-new-parallelism) as the cold fallback.
+"""
+
+from flink_tpu.autoscale.policy import (  # noqa: F401
+    Decision,
+    PolicyInput,
+    ScalingPolicy,
+)
+from flink_tpu.autoscale.controller import (  # noqa: F401
+    AutoscaleController,
+    RescaleEvent,
+)

@@ -59,6 +59,9 @@ class ChaosReport:
     points_hit: Dict[str, int] = dataclasses.field(default_factory=dict)
     retries: int = 0
     recoveries: int = 0
+    #: completed LIVE key-group migrations (engine.reshard) — replays
+    #: past an already-applied rescale position do not re-count
+    live_handoffs: int = 0
     divergences: List[str] = dataclasses.field(default_factory=list)
 
     @property
@@ -75,6 +78,7 @@ class ChaosReport:
             "checkpoints_written": self.checkpoints_written,
             "faults_injected": dict(self.faults_injected),
             "windows": self.windows,
+            "live_handoffs": self.live_handoffs,
             "diverged": self.diverged,
         }
 
@@ -152,13 +156,24 @@ def run_crash_restore_verify(
     rel_tol: float = 1e-4,
     abs_tol: float = 1e-3,
     check: bool = True,
+    rescales: Optional[Dict[int, int]] = None,
 ) -> ChaosReport:
     """Run ``steps`` (list of ``(keys, values, timestamps, watermark)``)
     through a chaotic engine with periodic checkpoints and through a
     fault-free oracle; crash, restore, replay; diff the committed
     output. Raises :class:`ChaosDivergenceError` on any divergence
     (``check=False`` returns the report instead — for tests that PROVE
-    the harness catches genuinely lossy faults)."""
+    the harness catches genuinely lossy faults).
+
+    ``rescales``: {step position -> shard count} — before processing
+    that step, the engine LIVE-migrates its key groups
+    (``engine.reshard``), proving a mid-stream rescale (optionally
+    crashed by a ``rescale.handoff`` fault) stays oracle-identical.
+    After a crash-restore, the replayed engine reshards again when it
+    re-reaches a scheduled position (the shard count is an
+    implementation detail — output equivalence is what the diff pins);
+    a position already past the restored source position simply stays
+    at the restored engine's default mesh size."""
     from flink_tpu.checkpoint.storage import (
         CheckpointStorage,
         read_manifest,
@@ -218,6 +233,10 @@ def run_crash_restore_verify(
                         report.restores += 1
                     need_restore = False
                     continue
+                if rescales and pos in rescales and \
+                        int(getattr(engine, "P", 0)) != rescales[pos]:
+                    engine.reshard(rescales[pos])
+                    report.live_handoffs += 1
                 if pos == n_steps:
                     # end of input: flush every remaining window
                     _collect(engine.on_watermark(

@@ -40,7 +40,8 @@ from flink_tpu.core.annotations import internal
 
 class _Node:
     __slots__ = ("transformation", "operator", "valve", "children",
-                 "child_input_idx", "records_in", "records_out", "held_wm")
+                 "child_input_idx", "records_in", "records_out", "held_wm",
+                 "busy_s")
 
     def __init__(self, transformation: Transformation,
                  operator: Optional[Operator]):
@@ -51,6 +52,10 @@ class _Node:
         self.child_input_idx: List[int] = []
         self.records_in = 0
         self.records_out = 0
+        #: wall time spent inside THIS operator's batch/watermark hooks
+        #: (excludes downstream forwarding) — the DS2 busy-fraction
+        #: numerator the autoscale policy differentiates
+        self.busy_s = 0.0
         #: watermark held back while the operator has in-flight async
         #: fires — forwarded downstream only after their results are
         #: (see _drain_pending; reference: watermark must not overtake
@@ -338,6 +343,23 @@ class LocalExecutor:
             g.gauge("numRecordsOut", lambda n=node: n.records_out)
             g.gauge("currentInputWatermark",
                     lambda n=node: n.valve.combined)
+            g.gauge("busyTimeMsTotal", lambda n=node: n.busy_s * 1000.0)
+            if op is not None and hasattr(op, "spill_counters"):
+                # the `state` group: the same numbers spill_counters()
+                # reports, on the metric tree the autoscaler reads
+                counters = op.spill_counters()
+                if counters is not None:
+                    sg = g.add_group("state")
+                    for cname in counters:
+                        sg.gauge(cname,
+                                 lambda o=op, c=cname:
+                                 (o.spill_counters() or {}).get(c, 0))
+                    sg.gauge("resident_rows_per_shard",
+                             lambda o=op: list(o.shard_resident_rows()))
+                    sg.gauge("resident_rows",
+                             lambda o=op: sum(o.shard_resident_rows()))
+                    sg.gauge("key_imbalance",
+                             lambda o=op: o.key_imbalance())
             if op is not None and hasattr(op, "fire_latencies_ms"):
                 from flink_tpu.metrics.core import quantile_sorted
 
@@ -432,6 +454,14 @@ class LocalExecutor:
                 pumps[t.uid] = _SourcePump(t, batch_size, in_flight)
             for p in pumps.values():
                 p.start()
+        # backlog signal: records prefetched-but-unprocessed in the pump
+        # queues (the credit-based flow-control depth, estimated from
+        # queued batches x current batch size) — feeds the autoscaler
+        job_group.gauge(
+            "sourceBacklogRecordsEstimate",
+            lambda: sum(p.queue.qsize() * p.batch_size
+                        for p in pumps.values()))
+        autoscale = self._setup_autoscale(nodes, job_group, pumps)
         # wall-clock tick targets (processing-time windows/timers)
         pt_nodes = [n for n in nodes.values()
                     if n.operator is not None
@@ -443,6 +473,8 @@ class LocalExecutor:
                 # harvest landed async fires + release held watermarks
                 # (cheap is_ready() polls when nothing is pending)
                 self._drain_pending(nodes)
+                if autoscale is not None:
+                    autoscale.tick()
                 if pt_nodes:
                     now_ms = int(time.time() * 1000)
                     for n in pt_nodes:
@@ -634,10 +666,94 @@ class LocalExecutor:
             # surfaced in REST job status: the user asked for stage
             # parallelism but opted into single-slot fallback
             metrics["stage_fallback"] = self.fallback_reason
+        if autoscale is not None and autoscale.events:
+            metrics["autoscale"] = {
+                "rescales": len(autoscale.events),
+                "live_handoffs": autoscale.live_handoffs,
+                "path": [(e.source, e.target) for e in autoscale.events],
+                "handoff_ms": [round(e.handoff_s * 1e3, 3)
+                               for e in autoscale.events
+                               if e.mode == "live"],
+            }
         result = JobExecutionResult(job_name, metrics)
         result.registry = registry
         result.traces = traces
         return result
+
+    # ------------------------------------------------------------ autoscale
+
+    def _setup_autoscale(self, nodes, job_group, pumps):
+        """Build the in-loop autoscale controller for the first keyed
+        operator that supports LIVE reshard (mesh engine), when
+        autoscale.enabled. The controller ticks at batch boundaries on
+        the task loop — the single-owner point where migrating device
+        state is race-free."""
+        from flink_tpu.core.config import AutoscaleOptions
+
+        if not self.config.get(AutoscaleOptions.ENABLED):
+            return None
+        target = None
+        for node in nodes.values():
+            op = node.operator
+            if op is not None and getattr(op, "supports_live_rescale",
+                                          False):
+                target = node
+                break
+        if target is None:
+            return None
+        import jax
+
+        from flink_tpu.autoscale.controller import (
+            AutoscaleController,
+            SignalSample,
+        )
+        from flink_tpu.autoscale.policy import ScalingPolicy
+
+        engine = target.operator.windower
+        # clamp the configured bounds to what reshard() can actually do
+        # (devices, the key-group space, the engine's owned range) — a
+        # policy allowed to target beyond them would turn a load spike
+        # into a ValueError on the task loop, i.e. a job crash
+        max_shards = self.config.get(AutoscaleOptions.MAX_SHARDS) \
+            or len(jax.devices())
+        max_shards = min(max_shards, len(jax.devices()),
+                         int(engine.max_parallelism))
+        kgr = getattr(engine, "key_group_range", None)
+        if kgr is not None:
+            max_shards = min(max_shards, int(kgr[1]) - int(kgr[0]) + 1)
+        min_shards = min(self.config.get(AutoscaleOptions.MIN_SHARDS),
+                         max_shards)
+        policy = ScalingPolicy(
+            utilization_target=self.config.get(
+                AutoscaleOptions.UTILIZATION_TARGET),
+            hysteresis=self.config.get(AutoscaleOptions.HYSTERESIS),
+            cooldown_s=self.config.get(
+                AutoscaleOptions.COOLDOWN_MS) / 1000.0,
+            min_shards=min_shards,
+            max_shards=max_shards,
+            imbalance_limit=self.config.get(
+                AutoscaleOptions.IMBALANCE_LIMIT))
+
+        def sample(node=target):
+            return SignalSample(
+                records_total=node.records_in,
+                busy_ms_total=node.busy_s * 1000.0,
+                backlog=sum(p.queue.qsize() * p.batch_size
+                            for p in pumps.values()),
+                shard_resident_rows=node.operator.shard_resident_rows())
+
+        def apply(new_shards, node=target):
+            # in-flight fires reference the pre-reshard device arrays —
+            # the drain boundary is the same one checkpoints use
+            self._drain_pending(nodes, wait=True)
+            return node.operator.reshard(new_shards)
+
+        return AutoscaleController(
+            policy, sample_fn=sample, apply_fn=apply,
+            current_shards_fn=lambda: int(target.operator.windower.P),
+            interval_s=self.config.get(
+                AutoscaleOptions.INTERVAL_MS) / 1000.0,
+            metrics_group=job_group)
 
     # -------------------------------------------------------------- control
 
@@ -770,7 +886,9 @@ class LocalExecutor:
         # the latest checkpoint), exactly like a real UDF/executor death
         chaos.fault_point("task.batch", op=node.transformation.name)
         node.records_in += len(batch)
+        t0 = time.perf_counter()
         outs = node.operator.process_batch(batch, input_idx)
+        node.busy_s += time.perf_counter() - t0
         for out in outs:
             self._forward(node, out)
 
@@ -778,7 +896,9 @@ class LocalExecutor:
         advanced = node.valve.advance(input_idx, wm)
         if advanced is None:
             return
+        t0 = time.perf_counter()
         outs = node.operator.process_watermark(advanced)
+        node.busy_s += time.perf_counter() - t0
         for out in outs:
             self._forward(node, out)
         if node.operator.has_pending_output():

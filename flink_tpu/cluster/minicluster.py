@@ -34,6 +34,7 @@ from flink_tpu.core.config import (
     CheckpointOptions,
     ClusterOptions,
     Configuration,
+    DeploymentOptions,
     SchedulerOptions,
     StateOptions,
 )
@@ -531,13 +532,13 @@ class JobMasterThread:
         return None
 
     def _supervise(self) -> None:
-        from flink_tpu.core.config import DeploymentOptions
-
         rm = self.cluster.rm_gateway()
         ckpt_dir = self.config.get(StateOptions.CHECKPOINT_DIR)
-        want_stage_par = self.config.get(
-            DeploymentOptions.STAGE_PARALLELISM)
         while True:
+            # re-read each attempt: request_rescale() retargets the
+            # stage parallelism between attempts (the cold rescale path)
+            want_stage_par = self.config.get(
+                DeploymentOptions.STAGE_PARALLELISM)
             slot = self._acquire_slot(rm)
             if slot is None:
                 if self._cancel_requested.is_set():
@@ -731,11 +732,47 @@ class JobMasterThread:
         reference's reactive mode likewise requires checkpointing)."""
         if not (self.adaptive and self.status == RUNNING):
             return
-        has_ckpt = bool(self.config.get(StateOptions.CHECKPOINT_DIR)) and (
+        if self._can_rescale():
+            self._rescale_requested.set()
+
+    def _can_rescale(self) -> bool:
+        """A rescale redeploy replays from the latest checkpoint; without
+        checkpointing it would replay from record 0 and double-emit."""
+        return bool(self.config.get(StateOptions.CHECKPOINT_DIR)) and bool(
             self.config.get(CheckpointOptions.INTERVAL_MS)
             or self.config.get(CheckpointOptions.EVERY_N_BATCHES))
-        if has_ckpt:
-            self._rescale_requested.set()
+
+    def request_rescale(self, parallelism: int) -> bool:
+        """Autoscaler entry point — the COLD rescale path: retarget the
+        keyed stage parallelism and redeploy from the latest checkpoint
+        (key-group-range filtered restore re-shards the state; no
+        restart budget is consumed — a rescale is not a failure).
+        Returns False when the job cannot rescale right now (not
+        running, or no checkpointing to resume from); the mesh engines'
+        LIVE path (engine.reshard) never stops the job at all.
+
+        reference: AdaptiveScheduler Executing -> Restarting on a
+        resource-requirements change (the externally-driven form of
+        on_new_resources)."""
+        parallelism = int(parallelism)
+        if parallelism < 1:
+            raise ValueError(f"parallelism must be >= 1: {parallelism}")
+        if self.status != RUNNING or not self._can_rescale():
+            return False
+        if parallelism == self.config.get(
+                DeploymentOptions.STAGE_PARALLELISM):
+            return False
+        self.config = Configuration({
+            **self.config.to_dict(),
+            DeploymentOptions.STAGE_PARALLELISM.key: parallelism})
+        self._rescale_requested.set()
+        return True
+
+    @property
+    def current_parallelism(self) -> int:
+        """The stage parallelism the current/next attempt deploys with
+        (the autoscale controller's current_shards view)."""
+        return int(self.config.get(DeploymentOptions.STAGE_PARALLELISM))
 
     # -- client surface -----------------------------------------------------
 

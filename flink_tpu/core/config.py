@@ -341,6 +341,45 @@ class StateOptions:
         "overflow to state.spill.dir. 0 = unbounded host tier.")
 
 
+class AutoscaleOptions:
+    """Elastic rescaling of the keyed mesh (flink_tpu/autoscale/): a
+    DS2-style policy reads the job metric tree and live-migrates key
+    groups between mesh shards (reference: the reactive/adaptive
+    scheduler pair + the k8s autoscaler's ScalingMetricEvaluator)."""
+
+    ENABLED = ConfigOption(
+        "autoscale.enabled", default=False, type=bool,
+        description="Tick a scaling policy inside the task loop and "
+        "LIVE-rescale mesh-sharded keyed operators (no stop-redeploy). "
+        "Requires an operator running a mesh engine (parallelism > 1).")
+    INTERVAL_MS = ConfigOption(
+        "autoscale.interval-ms", default=1000, type=int,
+        description="Policy sampling/decision interval.")
+    UTILIZATION_TARGET = ConfigOption(
+        "autoscale.utilization-target", default=0.7, type=float,
+        description="Size the operator so busy fraction lands here; the "
+        "headroom absorbs bursts without rescaling (DS2 utilization).")
+    MIN_SHARDS = ConfigOption(
+        "autoscale.min-shards", default=1, type=int,
+        description="Lower bound on the mesh size.")
+    MAX_SHARDS = ConfigOption(
+        "autoscale.max-shards", default=0, type=int,
+        description="Upper bound on the mesh size; 0 = the number of "
+        "visible devices.")
+    COOLDOWN_MS = ConfigOption(
+        "autoscale.cooldown-ms", default=30_000, type=int,
+        description="Minimum time between rescales.")
+    HYSTERESIS = ConfigOption(
+        "autoscale.hysteresis", default=0.25, type=float,
+        description="Relative dead band: targets within this fraction of "
+        "the current size are noise and ignored.")
+    IMBALANCE_LIMIT = ConfigOption(
+        "autoscale.imbalance-limit", default=2.0, type=float,
+        description="Refuse to scale DOWN while max/mean resident rows "
+        "per shard exceeds this — a hot shard under key skew is not "
+        "spare capacity.")
+
+
 class CheckpointOptions:
     INTERVAL_MS = ConfigOption(
         "execution.checkpointing.interval-ms", default=0, type=int,

@@ -146,22 +146,9 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             raise ValueError(
                 f"max_parallelism {max_parallelism} < mesh size {self.P}")
 
-        from flink_tpu.state.slot_table import make_slot_index
-
         # growable per-shard indexes (see MeshWindowEngine: skew grows the
         # table instead of failing the job)
-        self.indexes = [
-            make_slot_index(
-                self.capacity, growable=True,
-                on_grow=lambda old, new: self._shard_index_grew(new),
-                max_capacity=self.max_device_slots,
-                track_namespaces=self._track_ns,
-                full_hint=("state spills to host beyond "
-                           "state.slot-table.max-device-slots"
-                           if self.max_device_slots
-                           else "raise state.slot-table.capacity"))
-            for _ in range(self.P)
-        ]
+        self.indexes = self._make_shard_indexes()
         self._init_spill(spill_dir, spill_host_max_bytes)
         self._paged = (spill_layout == "pages"
                        and self.max_device_slots > 0)
@@ -176,10 +163,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                 self._sharding)
             for leaf in agg.leaves
         )
-        (self._scatter_step, self._fire_step, self._reset_step,
-         self._gather_step, self._put_step, self._merge_leaves_step,
-         self._valued_scatter_step) = build_mesh_steps(mesh, agg)
-        self._merge_step = build_session_merge_step(mesh, agg)
+        self._build_steps()
         self.meta = SessionIntervalSet(self.gap, self.allowed_lateness)
         self._dirty = np.zeros((self.P, self.capacity), dtype=bool)
         self._freed_ns: List[int] = []
@@ -191,6 +175,12 @@ class MeshSessionEngine(MeshPagedSpillSupport):
     @property
     def late_records_dropped(self) -> int:
         return self.meta.late_records_dropped
+
+    def _build_steps(self) -> None:
+        (self._scatter_step, self._fire_step, self._reset_step,
+         self._gather_step, self._put_step, self._merge_leaves_step,
+         self._valued_scatter_step) = build_mesh_steps(self.mesh, self.agg)
+        self._merge_step = build_session_merge_step(self.mesh, self.agg)
 
     def _shard_index_grew(self, new_capacity: int) -> None:
         """Uniform-SPMD grow: widen [P, capacity] arrays to the largest

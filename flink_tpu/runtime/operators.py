@@ -573,6 +573,66 @@ class WindowAggOperator(Operator):
         self._kv_vals = np.empty(0, object)
         self._keys_hashed = state.get("keys_hashed", False)
 
+    # ------------------------------------------------------ elastic rescale
+
+    @property
+    def supports_live_rescale(self) -> bool:
+        """True when the hosting engine can migrate key groups in place
+        (mesh engines); False means the cold path — checkpoint-restore
+        at the new parallelism (restore_state(key_group_filter=...))."""
+        return hasattr(self.windower, "reshard")
+
+    def reshard(self, new_shards: int) -> Dict[str, Any]:
+        """Live rescale of the mesh engine between mesh shard counts —
+        drain in-flight async fires FIRST (their device buffers
+        reference the pre-reshard arrays); the hosting executor's
+        _drain_pending(wait=True) boundary does exactly that."""
+        if not self.supports_live_rescale:
+            raise RuntimeError(
+                f"operator {self.name!r} runs a single-device engine — "
+                "live reshard needs the mesh engine (parallelism > 1); "
+                "rescale it cold via checkpoint-restore-at-new-"
+                "parallelism")
+        if self._pending:
+            raise RuntimeError(
+                "reshard with in-flight async fires; the executor must "
+                "drain pending outputs (poll_pending_output(wait=True)) "
+                "before rescaling")
+        # operator-held fences reference the old plane; the engine
+        # drains its own dispatch fences (a superset) inside reshard
+        self._fences.clear()
+        return self.windower.reshard(new_shards)
+
+    # ----------------------------------------------------- state observability
+
+    def spill_counters(self) -> Optional[Dict[str, int]]:
+        """The engine's spill traffic counters (None when the engine has
+        none) — surfaced as the job metric tree's ``state`` group."""
+        eng = self.windower
+        fn = getattr(eng, "spill_counters", None)
+        if fn is None:
+            table = getattr(eng, "table", None)
+            fn = getattr(table, "spill_counters", None)
+        return fn() if fn is not None else None
+
+    def shard_resident_rows(self) -> List[int]:
+        """Resident rows per shard (one entry for single-device engines)."""
+        eng = self.windower
+        fn = getattr(eng, "shard_resident_rows", None)
+        if fn is not None:
+            return fn()
+        table = getattr(eng, "table", None)
+        index = getattr(table, "index", None)
+        if index is not None:
+            return [int(index.slot_used.sum())]
+        return []
+
+    def key_imbalance(self) -> float:
+        """max/mean resident rows per shard (1.0 for single-device)."""
+        eng = self.windower
+        fn = getattr(eng, "key_imbalance", None)
+        return float(fn()) if fn is not None else 1.0
+
 
 class SessionWindowAggOperator(WindowAggOperator):
     """Merging session windows (reference: WindowOperator + MergingWindowSet;

@@ -387,12 +387,19 @@ def drop_spilled_sessions(spill, pmap: PagedSpillMap,
 
 def restore_into_pages(spill, pmap: PagedSpillMap, key_ids: np.ndarray,
                        namespaces: np.ndarray, leaves: List[np.ndarray],
-                       page_rows: int) -> None:
+                       page_rows: int,
+                       dirty: Optional[np.ndarray] = None) -> None:
     """Pack restored logical rows into page-sized spill entries (sorted
     by ns, never splitting one namespace across pages) — a snapshot far
     larger than the device budget restores with bounded device memory
     and reloads lazily by page. Clears any stale pages first
-    (re-restore)."""
+    (re-restore).
+
+    ``dirty``: optional per-row dirtiness to carry into the pages — the
+    live-rescale handoff re-homes rows that have NOT been checkpointed
+    since they changed, and the next delta snapshot must still ship
+    them. A checkpoint restore passes None (restored state is the new
+    incremental base, nothing is dirty)."""
     if len(pmap.sp_ns):
         for page in np.unique(pmap.sp_page).tolist():
             spill.discard(int(page))
@@ -401,6 +408,8 @@ def restore_into_pages(spill, pmap: PagedSpillMap, key_ids: np.ndarray,
     s_ns = namespaces[order]
     s_keys = key_ids[order]
     s_leaves = [l[order] for l in leaves]
+    s_dirty = (np.asarray(dirty, dtype=bool)[order]
+               if dirty is not None else None)
     total = len(s_ns)
     a = 0
     while a < total:
@@ -408,7 +417,8 @@ def restore_into_pages(spill, pmap: PagedSpillMap, key_ids: np.ndarray,
         while b < total and s_ns[b] == s_ns[b - 1]:
             b += 1
         entry = {"key_id": s_keys[a:b], "ns": s_ns[a:b],
-                 "dirty": np.zeros(b - a, dtype=bool),
+                 "dirty": (s_dirty[a:b] if s_dirty is not None
+                           else np.zeros(b - a, dtype=bool)),
                  **{f"leaf_{i}": s_leaves[i][a:b]
                     for i in range(len(s_leaves))}}
         spill_page(spill, pmap, entry, count=False)

@@ -1,0 +1,172 @@
+"""Autoscale smoke: a deterministic load ramp through the policy +
+live-reshard path, for the tier-1 gate.
+
+Drives the mesh session engine (paged spill, forced eviction) through a
+low -> high -> low synthetic load ramp while an
+:class:`AutoscaleController` ticks a DS2-style policy on a FAKE clock
+(signals are derived from the scripted ramp, so every decision is
+reproducible). The run FAILS (non-zero exit) if
+
+- the policy never scales 2 -> 4 on the ramp-up or back to 2 on the
+  ramp-down (the decision loop went stale), or
+- fewer than two LIVE handoffs happened (the rescales took some other
+  path), or
+- the final output diverges from the fault-free single-device oracle by
+  even one window (live migration lost/duplicated state).
+
+    JAX_PLATFORMS=cpu python tools/autoscale_smoke.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# must precede the first jax import: on CPU the mesh needs virtual devices
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+GAP = 100
+NUM_KEYS = int(os.environ.get("AUTOSCALE_SMOKE_KEYS", 6000))
+#: events per step: low -> high (the ramp) -> low again
+PHASES = [1500] * 3 + [6000] * 4 + [1500] * 4
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _steps():
+    rng = np.random.default_rng(23)
+    out = []
+    for s, per_step in enumerate(PHASES):
+        keys = rng.integers(0, NUM_KEYS, per_step).astype(np.int64)
+        vals = rng.random(per_step).astype(np.float32)
+        ts = rng.integers(s * 80, s * 80 + 60, per_step).astype(np.int64)
+        out.append((keys, vals, ts, (s - 1) * 80))
+    return out
+
+
+def _keyed(keys, vals, ts):
+    from flink_tpu.core.records import KEY_ID_FIELD, RecordBatch
+
+    return RecordBatch.from_pydict(
+        {KEY_ID_FIELD: keys, "v": vals},
+        timestamps=ts)
+
+
+def _collect(fired, out):
+    from flink_tpu.core.records import KEY_ID_FIELD
+
+    for b in fired:
+        for r in b.to_rows():
+            out[(r[KEY_ID_FIELD], r["window_start"],
+                 r["window_end"])] = r["sum_v"]
+
+
+def main() -> int:
+    from flink_tpu.autoscale.controller import (
+        AutoscaleController,
+        SignalSample,
+    )
+    from flink_tpu.autoscale.policy import ScalingPolicy
+    from flink_tpu.parallel.mesh import make_mesh
+    from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+    from flink_tpu.windowing.aggregates import SumAggregate
+    from flink_tpu.windowing.sessions import SessionWindower
+
+    t0 = time.perf_counter()
+    steps = _steps()
+
+    # oracle: fault-free, never rescaled, single device
+    expected = {}
+    oracle = SessionWindower(GAP, SumAggregate("v"), capacity=1 << 15)
+    for keys, vals, ts, wm in steps:
+        oracle.process_batch(_keyed(keys, vals, ts))
+        _collect(oracle.on_watermark(wm), expected)
+    _collect(oracle.on_watermark(1 << 60), expected)
+
+    engine = MeshSessionEngine(
+        GAP, SumAggregate("v"), make_mesh(2),
+        capacity_per_shard=1 << 14, max_device_slots=1024)
+    clk = FakeClock()
+    # signals are scripted off the ramp: busy fraction = load / peak.
+    # At 1500 ev/step busy=0.25 -> target 1, clamped to min 2; at 6000
+    # busy=1.0 -> target ceil(2 * 1.0 / 0.5) = 4.
+    cum = {"records": 0.0, "busy_ms": 0.0}
+    controller = AutoscaleController(
+        ScalingPolicy(utilization_target=0.5, hysteresis=0.25,
+                      cooldown_s=2.0, min_shards=2, max_shards=4,
+                      clock=clk),
+        sample_fn=lambda: SignalSample(
+            records_total=cum["records"],
+            busy_ms_total=cum["busy_ms"],
+            shard_resident_rows=engine.shard_resident_rows()),
+        engine=engine, interval_s=0.0, clock=clk)
+
+    got = {}
+    for keys, vals, ts, wm in steps:
+        n = len(keys)
+        cum["records"] += n
+        cum["busy_ms"] += min(n / 6000.0, 1.0) * 1000.0
+        clk.t += 1.0
+        controller.tick()
+        engine.process_batch(_keyed(keys, vals, ts))
+        _collect(engine.on_watermark(wm), got)
+    _collect(engine.on_watermark(1 << 60), got)
+
+    path = [(e.source, e.target) for e in controller.events]
+    handoff_ms = [round(e.handoff_s * 1e3, 2) for e in controller.events
+                  if e.mode == "live"]
+    row = {
+        "bench": "autoscale_smoke",
+        "seconds": round(time.perf_counter() - t0, 2),
+        "events": int(sum(len(s[0]) for s in steps)),
+        "windows": len(expected),
+        "path": path,
+        "live_handoffs": controller.live_handoffs,
+        "handoff_ms": handoff_ms,
+        "final_shards": int(engine.P),
+        "spill": engine.spill_counters(),
+    }
+    print(json.dumps(row))
+
+    failures = []
+    if (2, 4) not in path:
+        failures.append(f"policy never scaled 2 -> 4 on the ramp: {path}")
+    if (4, 2) not in path:
+        failures.append(f"policy never scaled 4 -> 2 back down: {path}")
+    if controller.live_handoffs < 2:
+        failures.append(
+            f"expected >= 2 live handoffs, got {controller.live_handoffs}")
+    if set(got) != set(expected):
+        failures.append(
+            f"window sets differ: {len(got)} vs {len(expected)}")
+    else:
+        diverged = sum(
+            1 for k in expected
+            if abs(got[k] - expected[k]) > max(1e-3,
+                                               1e-4 * abs(expected[k])))
+        if diverged:
+            failures.append(
+                f"{diverged} windows diverged from the oracle")
+    if failures:
+        print("AUTOSCALE SMOKE FAILED: " + "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,4 +48,12 @@ if [ "${SKIP_BENCH_SMOKE:-0}" != "1" ]; then
   # the torn checkpoint is restored instead of skipped. ~5 s on CPU.
   JAX_PLATFORMS=cpu timeout -k 10 120 \
     python tools/chaos_smoke.py || exit 1
+
+  # Autoscale smoke: deterministic load ramp through the DS2 policy —
+  # the mesh session engine must LIVE-rescale 2 -> 4 -> 2 (key-group
+  # migration, no stop-redeploy) and finish bit-identical to the
+  # single-device oracle. FAILS if the policy never scales, a rescale
+  # takes a non-live path, or any window diverges. ~3 s on CPU.
+  JAX_PLATFORMS=cpu timeout -k 10 120 \
+    python tools/autoscale_smoke.py || exit 1
 fi
