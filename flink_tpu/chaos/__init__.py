@@ -15,7 +15,31 @@ is a strict no-op while disarmed (the hot path pays one module-global
 None check).
 """
 
-from flink_tpu.chaos.injection import (  # noqa: F401
+#: Canonical fault-point inventory — THE single source of truth shared
+#: by the test suite's "every fault point reachable" ledger
+#: (tests/test_chaos.py) and flint's REG01 registry check (tools/flint).
+#: Adding an injection site means adding its name here (and its row to
+#: the NOTES inventory table); a typo in either direction — a call site
+#: not listed, or a listed name with no call site — fails both gates.
+#: Keep this a plain literal tuple: flint parses it statically.
+KNOWN_FAULT_POINTS = (
+    "shuffle.bucket_prep",
+    "shuffle.bucket_send",
+    "spill.page_reload",
+    "spill.page_compact",
+    "checkpoint.write",
+    "checkpoint.write.torn",
+    "checkpoint.read",
+    "mesh.dispatch_fence",
+    "mesh.session_fire",
+    "mesh.window_fire",
+    "rescale.handoff",
+    "harvest.pending_fire",
+    "task.batch",
+    "task.subtask_batch",
+)
+
+from flink_tpu.chaos.injection import (  # noqa: E402,F401
     ChaosController,
     FaultPlan,
     FaultRule,
@@ -31,7 +55,7 @@ from flink_tpu.chaos.injection import (  # noqa: F401
     register_chaos_metrics,
     run_recoverable,
 )
-from flink_tpu.chaos.harness import (  # noqa: F401
+from flink_tpu.chaos.harness import (  # noqa: E402,F401
     ChaosDivergenceError,
     ChaosReport,
     run_crash_restore_verify,

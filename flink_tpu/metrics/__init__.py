@@ -1,4 +1,15 @@
-from flink_tpu.metrics.core import (  # noqa: F401
+#: Canonical cross-cutting metric-group names (the per-operator scopes
+#: like ``job.<name>#<uid>`` are dynamic and not listed). Producers call
+#: ``group.add_group(<one of these>)``; flint's REG02 cross-checks every
+#: literal producer against this tuple and flags stale entries. Keep it
+#: a plain literal tuple: flint parses it statically.
+KNOWN_METRIC_GROUPS = (
+    "autoscale",
+    "chaos",
+    "state",
+)
+
+from flink_tpu.metrics.core import (  # noqa: E402,F401
     Counter,
     Gauge,
     Histogram,
@@ -6,8 +17,12 @@ from flink_tpu.metrics.core import (  # noqa: F401
     MetricGroup,
     MetricRegistry,
 )
-from flink_tpu.metrics.reporters import (  # noqa: F401
+from flink_tpu.metrics.reporters import (  # noqa: E402,F401
     LoggingReporter,
     PrometheusReporter,
 )
-from flink_tpu.metrics.traces import Span, SpanBuilder, TraceCollector  # noqa: F401
+from flink_tpu.metrics.traces import (  # noqa: E402,F401
+    Span,
+    SpanBuilder,
+    TraceCollector,
+)

@@ -1,0 +1,16 @@
+"""Runtime observability probes for the compiled hot path.
+
+Static analysis (tools/flint) proves the *source* cannot host-sync or
+destabilize jit identities; this package proves the *running program*
+behaves: :mod:`~flink_tpu.observe.recompile_sentinel` counts actual XLA
+backend compiles and device->host materializations around an engine
+run and turns "the steady state recompiles" into an exception instead
+of a silent 2-5x throughput loss.
+"""
+
+from flink_tpu.observe.recompile_sentinel import (  # noqa: F401
+    RecompileSentinel,
+    SteadyStateViolation,
+    compile_count,
+    transfer_count,
+)

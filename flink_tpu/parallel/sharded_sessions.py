@@ -191,8 +191,8 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         old = self.capacity
         self.capacity = new_capacity
         grown = []
-        for a, leaf in zip(self.accs, self.agg.leaves):
-            host = np.asarray(a)
+        accs_host = jax.device_get(list(self.accs))  # ONE batched D2H
+        for host, leaf in zip(accs_host, self.agg.leaves):
             padded = np.full((self.P, new_capacity), leaf.identity,
                              dtype=leaf.dtype)
             padded[:, :old] = host
@@ -503,7 +503,8 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             from flink_tpu.runtime.pending import PendingFire
 
             return [PendingFire([fire_out[n] for n in names], build)]
-        return [build([np.asarray(fire_out[n]) for n in names])]
+        # sync path still batches all columns into ONE device_get
+        return [build(jax.device_get([fire_out[n] for n in names]))]
 
     def _fire_sessions_hybrid(self, k_arr, st_arr, en_arr, sid_arr,
                               per_shard_sel, async_ok: bool
@@ -629,7 +630,8 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             from flink_tpu.runtime.pending import PendingFire
 
             return [PendingFire(arrays, build)]
-        return [build([np.asarray(a) for a in arrays])]
+        # sync path still batches all columns into ONE device_get
+        return [build(jax.device_get(arrays))]
 
     # ---------------------------------------------------------- point query
 
@@ -683,11 +685,14 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         W = sticky_bucket(len(sids), self._fire_bucket, minimum=64)
         sm = np.zeros((self.P, W, 1), dtype=np.int32)
         sm[shard, : len(sids), 0] = np.where(slots >= 0, slots, 0)
-        results = self._fire_step(self.accs, self._put_sharded(sm))
+        # ONE batched D2H for all result columns (a per-interval
+        # np.asarray would pay one round-trip per session AND column)
+        results = jax.device_get(
+            self._fire_step(self.accs, self._put_sharded(sm)))
         for i, iv in enumerate(intervals):
             if slots[i] < 0:
                 continue
-            out[int(iv[1])] = {name: np.asarray(col)[shard][i].item()
+            out[int(iv[1])] = {name: col[shard][i].item()
                                for name, col in results.items()}
         return out
 
@@ -698,7 +703,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         across engines and mesh sizes (re-sharded by key group)."""
         if mode == "delta":
             return {"table": self._snapshot_delta(), **self.meta.snapshot()}
-        accs_host = [np.asarray(a) for a in self.accs]
+        accs_host = jax.device_get(list(self.accs))  # ONE batched D2H
         parts = []
         for p in range(self.P):
             idx = self.indexes[p]
@@ -754,7 +759,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                 block[p, :len(dirty)] = dirty
             gathered = self._gather_step(self.accs,
                                          self._put_sharded(block))
-            leaves_host = [np.asarray(g) for g in gathered]
+            leaves_host = jax.device_get(list(gathered))  # ONE batched D2H
             key_cols, ns_cols = [], []
             leaf_cols = [[] for _ in leaves_host]
             for p, dirty in enumerate(per_shard):
@@ -815,7 +820,10 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                 if mask.any():
                     per_shard_slots[p] = self.indexes[p].lookup_or_insert(
                         key_ids[mask], namespaces[mask])
-            accs_host = [np.array(a) for a in self.accs]
+            # one batched D2H read, then writable copies (restore
+            # mutates them in place before re-uploading)
+            accs_host = [np.array(a)
+                         for a in jax.device_get(list(self.accs))]
             for p, slots in per_shard_slots.items():
                 mask = shards == p
                 for acc, vals in zip(accs_host, leaves):

@@ -140,6 +140,9 @@ class MeshSpillSupport:
         """Block until < depth dispatches are outstanding. MUST run
         before this batch's staging buffers are (re)written."""
         while len(self._dispatch_fences) >= self._pipeline_depth:
+            # flint: disable=TRC01 -- the depth-bounded fence drain IS
+            # the dispatch-ahead backpressure point: it blocks only when
+            # the host ran a full pipeline depth ahead of the device
             self._dispatch_fences.popleft().block_until_ready()
 
     def _push_dispatch_fence(self) -> None:
@@ -229,7 +232,9 @@ class MeshSpillSupport:
         block = np.zeros((self.P, G), dtype=np.int32)
         block[p, :n] = all_slots
         gathered = self._gather_step(self.accs, self._put_sharded(block))
-        leaves_host = [np.asarray(g)[p][:n] for g in gathered]
+        # ONE batched D2H read for all leaves (per-array np.asarray pays
+        # one link round-trip per leaf — see runtime/pending.py)
+        leaves_host = [g[p][:n] for g in jax.device_get(gathered)]
         off = 0
         for ns, slots in chosen:
             m = len(slots)
@@ -553,6 +558,8 @@ class MeshSpillSupport:
         # quiesce: prove the device consumed every staged host buffer
         # before the staging pool and the accumulator plane are replaced
         while self._dispatch_fences:
+            # flint: disable=TRC01 -- reshard quiesce: the mesh plane is
+            # about to be torn down, every in-flight dispatch must land
             self._dispatch_fences.popleft().block_until_ready()
         chaos.fault_point("rescale.handoff", stage="drain",
                           from_shards=self.P, to_shards=new_shards)
@@ -581,7 +588,7 @@ class MeshSpillSupport:
         (who stays resident on a scale-down), and residency."""
         leaves = self.agg.leaves
         paged = bool(getattr(self, "_paged", False))
-        accs_host = [np.asarray(a) for a in self.accs]
+        accs_host = jax.device_get(list(self.accs))  # ONE batched D2H
         keys: List[np.ndarray] = []
         nss: List[np.ndarray] = []
         dirty: List[np.ndarray] = []
@@ -1031,7 +1038,7 @@ class MeshPagedSpillSupport(MeshSpillSupport):
         for p, chosen in cohorts.items():
             block[p, : len(chosen)] = chosen
         gathered = self._gather_step(self.accs, self._put_sharded(block))
-        gathered_host = [np.asarray(g) for g in gathered]
+        gathered_host = jax.device_get(gathered)  # ONE batched D2H
         for p, chosen in cohorts.items():
             idx = self.indexes[p]
             n = len(chosen)
@@ -1197,8 +1204,8 @@ class MeshWindowEngine(MeshSpillSupport):
         old = self.capacity
         self.capacity = new_capacity
         grown = []
-        for a, leaf in zip(self.accs, self.agg.leaves):
-            host = np.asarray(a)
+        accs_host = jax.device_get(list(self.accs))  # ONE batched D2H
+        for host, leaf in zip(accs_host, self.agg.leaves):
             padded = np.full((self.P, new_capacity), leaf.identity,
                              dtype=leaf.dtype)
             padded[:, :old] = host
@@ -1407,9 +1414,10 @@ class MeshWindowEngine(MeshSpillSupport):
         sm = np.zeros((self.P, W, k), dtype=np.int32)
         for p, mat in enumerate(per_shard_mats):
             sm[p, : len(mat)] = mat
-        results = {name: np.asarray(arr)
-                   for name, arr in self._fire_step(
-                       self.accs, self._put_sharded(sm)).items()}
+        # ONE batched D2H for all result columns (device_get over the
+        # whole pytree; per-column np.asarray pays one RTT per column)
+        results = jax.device_get(
+            self._fire_step(self.accs, self._put_sharded(sm)))
         # assemble host batch
         key_cols: List[np.ndarray] = []
         res_cols: Dict[str, List[np.ndarray]] = {n: [] for n in results}
@@ -1476,7 +1484,7 @@ class MeshWindowEngine(MeshSpillSupport):
             for p, mat in enumerate(per_shard_mats):
                 sm[p, : len(mat)] = mat
             merged = self._merge_step(self.accs, self._put_sharded(sm))
-            merged_host = [np.asarray(m) for m in merged]
+            merged_host = jax.device_get(merged)  # ONE batched D2H
             for p in range(self.P):
                 m = len(per_shard_keys[p])
                 if m == 0:
@@ -1578,7 +1586,8 @@ class MeshWindowEngine(MeshSpillSupport):
                 block[shard, : len(hs)] = hs
                 gathered = self._gather_step(self.accs,
                                              self._put_sharded(block))
-                g_host = [np.asarray(g)[shard][: len(hs)] for g in gathered]
+                g_host = [g[shard][: len(hs)]
+                          for g in jax.device_get(gathered)]
                 for j, ns in enumerate(n for n, h in zip(live_ns, hit)
                                        if h):
                     slice_vals[int(ns)] = tuple(
@@ -1629,7 +1638,7 @@ class MeshWindowEngine(MeshSpillSupport):
         single-device checkpoints are mutually restorable."""
         if mode == "delta":
             return {"table": self._snapshot_delta(), **self.book.snapshot()}
-        accs_host = [np.asarray(a) for a in self.accs]
+        accs_host = jax.device_get(list(self.accs))  # ONE batched D2H
         parts = []
         for p in range(self.P):
             idx = self.indexes[p]
@@ -1685,7 +1694,7 @@ class MeshWindowEngine(MeshSpillSupport):
                 block[p, :len(dirty)] = dirty
             gathered = self._gather_step(self.accs,
                                          self._put_sharded(block))
-            leaves_host = [np.asarray(g) for g in gathered]
+            leaves_host = jax.device_get(list(gathered))  # ONE batched D2H
             key_cols, ns_cols = [], []
             leaf_cols = [[] for _ in leaves_host]
             for p, dirty in enumerate(per_shard):
@@ -1745,7 +1754,10 @@ class MeshWindowEngine(MeshSpillSupport):
                 if mask.any():
                     per_shard_slots[p] = self.indexes[p].lookup_or_insert(
                         key_ids[mask], namespaces[mask])
-            accs_host = [np.array(a) for a in self.accs]
+            # one batched D2H read, then writable copies (restore
+            # mutates them in place before re-uploading)
+            accs_host = [np.array(a)
+                         for a in jax.device_get(list(self.accs))]
             for p, slots in per_shard_slots.items():
                 mask = shards == p
                 for acc, vals in zip(accs_host, leaves):

@@ -388,6 +388,9 @@ class WindowAggOperator(Operator):
             if fence is not None:
                 self._fences.append(fence)
                 while len(self._fences) > self._max_dispatch_ahead:
+                    # flint: disable=TRC01 -- the depth-bounded fence
+                    # drain IS the task loop's dispatch-ahead
+                    # backpressure point (blocks only past the bound)
                     self._fences.popleft().block_until_ready()
         return []
 

@@ -287,23 +287,26 @@ class DeviceOverAggOperator(OverAggOperator):
             boundary_p[m] = True
         pad_idx = np.arange(m, mp, dtype=np.int64)
         i32 = np.int32
-        outs, run_s, run_c = self._kernel(
+        import jax
+
+        # ONE batched D2H for all three kernel outputs (per-array
+        # np.asarray pays one link round-trip per output)
+        outs, run_s, run_c = jax.device_get(self._kernel(
             boundary_p,
             np.r_[seg_start, pad_idx].astype(i32),
             np.r_[starts, pad_idx].astype(i32),
             np.r_[ends, pad_idx + 1].astype(i32),
             np.r_[peer_last, pad_idx].astype(i32),
             np.stack([p(v) for v in all_val]),
-            np.stack([p(w) for w in all_wt]))
-        outs = np.asarray(outs)[:, :m]
+            np.stack([p(w) for w in all_wt])))
+        outs = outs[:, :m]
 
         out = ready
         for (_, _, out_name), col in zip(self.specs, outs):
             out = out.with_column(out_name, col[is_new])
 
         self._update_context(all_kid, all_ts, all_val, boundary,
-                             np.asarray(run_s)[:, :m],
-                             np.asarray(run_c)[:, :m], hit)
+                             run_s[:, :m], run_c[:, :m], hit)
         return out
 
     # ------------------------------------------------------- context upkeep

@@ -349,6 +349,26 @@ class AsyncMapState:
 # Device-resident value state
 # --------------------------------------------------------------------------
 
+#: gather/scatter kernels shared by EVERY DeviceValueState — a
+#: per-instance ``jax.jit(lambda ...)`` is a fresh jit identity per
+#: state object, i.e. a full XLA recompile for each (flint JIT01);
+#: built lazily because jax imports are deferred in this module
+_DEVICE_KERNEL_CACHE: dict = {}
+
+
+def _device_value_kernels():
+    fns = _DEVICE_KERNEL_CACHE.get("kernels")
+    if fns is None:
+        import jax
+        import jax.numpy as jnp
+
+        fns = (
+            jax.jit(lambda v, s: jnp.take(v, s, axis=0, mode="clip")),
+            jax.jit(lambda v, s, x: v.at[s].set(x), donate_argnums=0),
+        )
+        _DEVICE_KERNEL_CACHE["kernels"] = fns
+    return fns
+
 
 class DeviceValueState(ValueState):
     """ValueState whose dense array lives on the accelerator.
@@ -381,10 +401,7 @@ class DeviceValueState(ValueState):
         self._device = device
         self._dvals = jax.device_put(arr, device) if device is not None \
             else arr
-        self._gather = jax.jit(
-            lambda v, s: jnp.take(v, s, axis=0, mode="clip"))
-        self._scatter = jax.jit(
-            lambda v, s, x: v.at[s].set(x), donate_argnums=0)
+        self._gather, self._scatter = _device_value_kernels()
         self._host_dirty = False  # host mirror (self._values) staleness
 
     # -- device kernels ------------------------------------------------------

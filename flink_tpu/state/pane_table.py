@@ -492,8 +492,10 @@ class PaneTable:
             return {}
         rows = np.asarray([self.slice_row[se] for se in live],
                           dtype=np.int32)
-        per_leaf = [np.asarray(a[rows, int(col)]) for a in self.accs[:-1]]
-        present = np.asarray(self.accs[-1][rows, int(col)]) > 0
+        # ONE batched D2H for every leaf plane (per-plane np.asarray
+        # pays one link round-trip per leaf)
+        picked = jax.device_get([a[rows, int(col)] for a in self.accs])
+        per_leaf, present = picked[:-1], picked[-1] > 0
         slice_vals = {
             se: tuple(pl[i] for pl in per_leaf)
             for i, se in enumerate(live) if present[i]
@@ -518,7 +520,7 @@ class PaneTable:
                     acc[i] = host_merge[l.reduce](acc[i], sv[i])
             if not hit:
                 continue
-            merged = tuple(np.asarray([a]) for a in acc)
+            merged = tuple(np.asarray([v]) for v in acc)
             finished = self.agg.finish(merged)
             out[w] = {name: np.asarray(v)[0].item()
                       for name, v in finished.items()}
@@ -546,16 +548,23 @@ class PaneTable:
         used = self.used_cols
         key_cols, ns_cols = [], []
         leaf_cols: List[List[np.ndarray]] = [[] for _ in self.agg.leaves]
-        for se in slices:
-            row = self.slice_row[se]
-            present = np.asarray(self.accs[-1][row][:used]) > 0
+        if slices:
+            # ONE batched gather + D2H for every snapshotted slice row
+            # (the per-slice-per-leaf np.asarray loop paid one link
+            # round-trip for each)
+            row_ids = np.asarray([self.slice_row[se] for se in slices],
+                                 dtype=np.int32)
+            rows_host = jax.device_get(
+                [a[row_ids, :used] for a in self.accs])
+        for j, se in enumerate(slices):
+            present = rows_host[-1][j] > 0
             if not present.any():
                 continue
             keys = self.index.slot_key[:used][present]
             key_cols.append(keys)
             ns_cols.append(np.full(len(keys), se, dtype=np.int64))
-            for i, a in enumerate(self.accs[:-1]):
-                leaf_cols[i].append(np.asarray(a[row][:used])[present])
+            for i in range(len(self.agg.leaves)):
+                leaf_cols[i].append(rows_host[i][j][present])
         if key_cols:
             key_ids = np.concatenate(key_cols)
             out = {

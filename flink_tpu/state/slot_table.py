@@ -1012,12 +1012,13 @@ class SlotTable:
             self.accs, jnp.asarray(pad_i32(chosen, size, fill=0)))
         from flink_tpu.state.paged_spill import spill_page
 
+        gathered_host = jax.device_get(gathered)  # ONE batched D2H
         entry = {
             "key_id": np.asarray(self.index.slot_key[chosen]),
             "ns": np.asarray(self.index.slot_ns[chosen]),
             "dirty": self._dirty[chosen].copy(),
-            **{f"leaf_{i}": np.asarray(g)[:n]
-               for i, g in enumerate(gathered)},
+            **{f"leaf_{i}": g[:n]
+               for i, g in enumerate(gathered_host)},
         }
         spill_page(self.spill, self._pmap, entry)
         self.index.free_slots(chosen)
@@ -1169,7 +1170,8 @@ class SlotTable:
         self._gather_bucket = size
         gathered = self.agg._gather_jit(
             self.accs, jnp.asarray(pad_i32(all_slots, size, fill=0)))
-        leaves_host = [np.asarray(g)[:n] for g in gathered]
+        # ONE batched D2H read for all leaves
+        leaves_host = [g[:n] for g in jax.device_get(gathered)]
         off = 0
         for ns, slots in chosen:
             m = len(slots)
@@ -1296,7 +1298,9 @@ class SlotTable:
             return {name: np.empty(0) for name in self.agg.output_names}
         out = self.agg._fire_jit(
             self.accs, jnp.asarray(self._pad_fire_matrix(slot_matrix)))
-        return {name: np.asarray(col)[:w] for name, col in out.items()}
+        # ONE batched D2H for all result columns
+        return {name: col[:w]
+                for name, col in jax.device_get(out).items()}
 
     def _pad_fire_matrix(self, slot_matrix: np.ndarray) -> np.ndarray:
         """Sticky-bucket zero-pad shared by every fire dispatch (sync and
@@ -1420,8 +1424,8 @@ class SlotTable:
             padded[:len(keys)] = matrix
             merged = self.agg._merge_jit(self.accs, jnp.asarray(padded))
             key_chunks.append(keys)
-            for i, m in enumerate(merged):
-                leaf_chunks[i].append(np.asarray(m)[:len(keys)])
+            for i, m in enumerate(jax.device_get(merged)):
+                leaf_chunks[i].append(m[:len(keys)])
         # host part (spilled slices)
         for se in spilled:
             entry = self.spill.peek(int(se))
@@ -1572,7 +1576,7 @@ class SlotTable:
                 size = pad_bucket_size(len(hs), minimum=64)
                 gathered = self.agg._gather_jit(
                     self.accs, jnp.asarray(pad_i32(hs, size, fill=0)))
-                leaves = [np.asarray(g)[:len(hs)] for g in gathered]
+                leaves = [g[:len(hs)] for g in jax.device_get(gathered)]
                 for j, ns in enumerate(n for n, h in zip(resident, hit)
                                        if h):
                     out[int(ns)] = tuple(l[j:j + 1] for l in leaves)
@@ -1650,7 +1654,7 @@ class SlotTable:
         not silently shrink the next delta checkpoint's contents.
         """
         used = self.index.used_slots()
-        accs_host = [np.asarray(a) for a in self.accs]
+        accs_host = jax.device_get(list(self.accs))  # ONE batched D2H
         key_ids = self.index.slot_key[used]
         out = {
             "key_id": key_ids,
@@ -1713,7 +1717,7 @@ class SlotTable:
             self._gather_bucket = size
             gathered = self.agg._gather_jit(
                 self.accs, jnp.asarray(pad_i32(dirty_used, size, fill=0)))
-            leaves = [np.asarray(g)[:n] for g in gathered]
+            leaves = [g[:n] for g in jax.device_get(gathered)]
         else:
             leaves = [np.empty(0, dtype=l.dtype) for l in self.agg.leaves]
         key_ids = self.index.slot_key[dirty_used]
@@ -1831,7 +1835,9 @@ class SlotTable:
                 # windows; registry entries are created on reload
         elif len(key_ids):
             slots = self.lookup_or_insert(key_ids, namespaces)
-            accs_host = [np.array(a) for a in self.accs]  # writable copies
+            # one batched D2H read, then writable copies (mutated below)
+            accs_host = [np.array(a)
+                         for a in jax.device_get(list(self.accs))]
             for acc, vals in zip(accs_host, leaves):
                 acc[slots] = vals
             self.accs = tuple(jnp.asarray(a) for a in accs_host)

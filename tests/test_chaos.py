@@ -8,9 +8,11 @@ mesh session engine (paged spill under forced eviction), the tumbling
 mesh window engine and the async-fire/dispatch-ahead pipeline path, and
 (4) the cluster restart path (task crash -> RestartStrategy -> restore).
 
-The LAST test asserts every fault point in the inventory was injected
-at least once across this suite (NOTES_r7.md keeps the inventory) —
-the tier-1 guarantee that no injection site silently goes stale.
+The LAST test asserts every fault point in the CANONICAL inventory
+(``flink_tpu.chaos.KNOWN_FAULT_POINTS`` — one source of truth, shared
+with flint's REG01 registry check; NOTES_r7.md documents each row) was
+injected at least once across this suite — the tier-1 guarantee that no
+injection site silently goes stale.
 """
 
 import os
@@ -18,6 +20,7 @@ import os
 import numpy as np
 import pytest
 
+from flink_tpu.chaos import KNOWN_FAULT_POINTS
 from flink_tpu.chaos import injection as chaos
 from flink_tpu.chaos.harness import (
     ChaosDivergenceError,
@@ -28,24 +31,8 @@ from flink_tpu.chaos.injection import FaultPlan, FaultRule, InjectedFault
 GAP = 100
 
 #: fault points injected so far across this suite (reachability ledger;
-#: asserted by the final test — keep in sync with NOTES_r7.md)
+#: asserted by the final test against chaos.KNOWN_FAULT_POINTS)
 REACHED = {}
-
-FAULT_POINT_INVENTORY = (
-    "shuffle.bucket_prep",
-    "shuffle.bucket_send",
-    "spill.page_reload",
-    "spill.page_compact",
-    "checkpoint.write",
-    "checkpoint.write.torn",
-    "checkpoint.read",
-    "mesh.dispatch_fence",
-    "mesh.session_fire",
-    "mesh.window_fire",
-    "harvest.pending_fire",
-    "task.batch",
-    "task.subtask_batch",
-)
 
 
 def _note_reached(injected):
@@ -109,7 +96,7 @@ class TestInjectionCore:
         assert run(43) != a or run(44) != a  # not constant across seeds
 
     def test_arming_twice_fails(self):
-        plan = FaultPlan(rules=[FaultRule(pattern="x", nth=1)])
+        plan = FaultPlan(rules=[FaultRule(pattern="task.batch", nth=1)])
         with chaos.chaos_active(plan, seed=0):
             with pytest.raises(RuntimeError, match="already armed"):
                 chaos.arm(plan, 0)
@@ -117,9 +104,9 @@ class TestInjectionCore:
 
     def test_rule_validation(self):
         with pytest.raises(ValueError, match="no schedule"):
-            FaultRule(pattern="x")
+            FaultRule(pattern="task.batch")
         with pytest.raises(ValueError, match="unknown fault kind"):
-            FaultRule(pattern="x", nth=1, kind="explode")
+            FaultRule(pattern="task.batch", nth=1, kind="explode")
 
     def test_recoverable_retry_then_recover(self):
         plan = FaultPlan(rules=[
@@ -769,15 +756,46 @@ class TestClusterRestartPath:
 # ---------------------------------------------------------- reachability
 
 
+class TestRescaleHandoffPoint:
+    """The autoscaler's live-migration fault point, injected at its real
+    production site (MeshSpillSupport.reshard) so the canonical
+    inventory's reachability ledger covers it in THIS suite too (the
+    full crash-restore-verify exercise lives in tests/test_autoscale.py)."""
+
+    def test_handoff_drain_crash_at_real_site(self):
+        from flink_tpu.parallel.mesh import make_mesh
+        from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+        from flink_tpu.windowing.aggregates import SumAggregate
+
+        from tests.test_sessions import keyed_batch
+
+        eng = MeshSessionEngine(GAP, SumAggregate("v"), make_mesh(2),
+                                capacity_per_shard=1024)
+        eng.process_batch(keyed_batch([1, 2, 3], [1.0, 2.0, 3.0],
+                                      [0, 10, 20]))
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="rescale.handoff", nth=1,
+                      where={"stage": "drain"})])
+        with chaos.chaos_active(plan, seed=0) as c:
+            with pytest.raises(InjectedFault):
+                eng.reshard(4)
+            assert c.faults_injected.get("rescale.handoff", 0) == 1
+            _note_reached(c.faults_injected)
+        # reshard is not exception-atomic: the engine is dead here; the
+        # recovery path (restore at the new parallelism) is proven by
+        # tests/test_autoscale.py's chaos crash test
+
+
 class TestZZFaultPointReachability:
     """Must run LAST in this file (pytest preserves definition order):
-    every inventoried fault point was injected somewhere above."""
+    every fault point of the CANONICAL inventory was injected somewhere
+    above."""
 
     def test_every_fault_point_injected_at_least_once(self):
-        missing = [p for p in FAULT_POINT_INVENTORY
+        missing = [p for p in KNOWN_FAULT_POINTS
                    if REACHED.get(p, 0) < 1]
         assert not missing, (
             f"fault points never injected across the suite: {missing} "
             f"(reached: {REACHED}) — an injection site moved or a "
-            "schedule went stale; update tests/test_chaos.py and "
-            "NOTES_r7.md together")
+            "schedule went stale; update chaos.KNOWN_FAULT_POINTS, "
+            "tests/test_chaos.py and NOTES_r7.md together")

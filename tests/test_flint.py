@@ -1,0 +1,456 @@
+"""flint (tools/flint) — the TPU-tracing static analyzer — and the
+recompile sentinel (flink_tpu/observe).
+
+Covers: a failing fixture per rule (TRC01/TRC02/JIT01/REG01/REG02), the
+suppression protocol (reason mandatory), the clean-tree invariant
+(flint exits 0 over flink_tpu/ at HEAD — the same gate tools/tier1.sh
+runs), the sentinel's compile/transfer accounting, and the
+slow-lane bookkeeping of the known-flaky unaligned-checkpoint timing
+test (deflake follow-up)."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tools.flint.core import Project, discover, run_checks
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def run_fixture(tmp_path, files, select):
+    """Write a throwaway mini-package and run the selected rules."""
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text, encoding="utf-8")
+    project = Project(discover(["flink_tpu/"], tmp_path), tmp_path)
+    return run_checks(project, select=select)
+
+
+# ------------------------------------------------------------------- TRC01
+
+
+class TestTRC01HostSync:
+    FILES = {
+        "flink_tpu/__init__.py": "",
+        "flink_tpu/eng.py": (
+            "import numpy as np\n"
+            "\n"
+            "class MeshWindowEngine:\n"
+            "    def process_batch(self, batch):\n"
+            "        out = self._gather_step(batch)\n"
+            "        return [np.asarray(g) for g in out]\n"
+        ),
+    }
+
+    def test_per_array_read_on_step_result_trips(self, tmp_path):
+        active, _ = run_fixture(tmp_path, self.FILES, ["TRC01"])
+        assert [v.rule for v in active] == ["TRC01"]
+        assert "np.asarray" in active[0].message
+        assert active[0].path == "flink_tpu/eng.py"
+
+    def test_reachability_is_required(self, tmp_path):
+        # same sync, but in a class/method no hot root reaches: clean
+        files = dict(self.FILES)
+        files["flink_tpu/eng.py"] = files["flink_tpu/eng.py"].replace(
+            "MeshWindowEngine", "SomeColdHelper")
+        active, _ = run_fixture(tmp_path, files, ["TRC01"])
+        assert active == []
+
+    def test_block_until_ready_trips_transitively(self, tmp_path):
+        files = {
+            "flink_tpu/__init__.py": "",
+            "flink_tpu/eng.py": (
+                "class MeshSessionEngine:\n"
+                "    def on_watermark(self, wm):\n"
+                "        self._drain()\n"
+                "    def _drain(self):\n"
+                "        self.fence.block_until_ready()\n"
+            ),
+        }
+        active, _ = run_fixture(tmp_path, files, ["TRC01"])
+        assert len(active) == 1
+        assert "block_until_ready" in active[0].message
+
+    def test_scalar_cast_of_device_value_trips(self, tmp_path):
+        files = {
+            "flink_tpu/__init__.py": "",
+            "flink_tpu/eng.py": (
+                "class SlotTable:\n"
+                "    def fire(self, sm):\n"
+                "        merged = self._fire_jit(self.accs, sm)\n"
+                "        return int(merged[0])\n"
+            ),
+        }
+        active, _ = run_fixture(tmp_path, files, ["TRC01"])
+        assert len(active) == 1
+        assert "int() on a device value" in active[0].message
+
+
+# ------------------------------------------------------------------- TRC02
+
+
+class TestTRC02TracerControlFlow:
+    def test_if_on_jit_argument_trips(self, tmp_path):
+        files = {
+            "flink_tpu/__init__.py": "",
+            "flink_tpu/k.py": (
+                "import jax\n"
+                "\n"
+                "@jax.jit\n"
+                "def step(x):\n"
+                "    if x > 0:\n"
+                "        return x\n"
+                "    return -x\n"
+            ),
+        }
+        active, _ = run_fixture(tmp_path, files, ["TRC02"])
+        assert [v.rule for v in active] == ["TRC02"]
+        assert "data-dependent" in active[0].message
+
+    def test_shape_checks_are_trace_time_static(self, tmp_path):
+        files = {
+            "flink_tpu/__init__.py": "",
+            "flink_tpu/k.py": (
+                "import jax\n"
+                "\n"
+                "@jax.jit\n"
+                "def step(x):\n"
+                "    if x.shape[0] > 4:\n"
+                "        return x[:4]\n"
+                "    return x\n"
+            ),
+        }
+        active, _ = run_fixture(tmp_path, files, ["TRC02"])
+        assert active == []
+
+    def test_while_on_derived_value_in_wrapped_fn(self, tmp_path):
+        files = {
+            "flink_tpu/__init__.py": "",
+            "flink_tpu/k.py": (
+                "import jax\n"
+                "\n"
+                "def body(x):\n"
+                "    y = x * 2\n"
+                "    while y < 10:\n"
+                "        y = y + 1\n"
+                "    return y\n"
+                "\n"
+                "stepped = jax.jit(body)\n"
+            ),
+        }
+        active, _ = run_fixture(tmp_path, files, ["TRC02"])
+        assert len(active) == 1
+        assert "while" in active[0].message
+
+
+# ------------------------------------------------------------------- JIT01
+
+
+class TestJIT01UnstableIdentity:
+    def test_jit_lambda_per_call_trips(self, tmp_path):
+        files = {
+            "flink_tpu/__init__.py": "",
+            "flink_tpu/k.py": (
+                "import jax\n"
+                "\n"
+                "def step(v):\n"
+                "    return jax.jit(lambda a: a + 1)(v)\n"
+            ),
+        }
+        active, _ = run_fixture(tmp_path, files, ["JIT01"])
+        assert [v.rule for v in active] == ["JIT01"]
+        assert "fresh jit identity" in active[0].message
+
+    def test_jit_local_def_in_loop_trips(self, tmp_path):
+        files = {
+            "flink_tpu/__init__.py": "",
+            "flink_tpu/k.py": (
+                "import jax\n"
+                "\n"
+                "def build(xs):\n"
+                "    out = []\n"
+                "    for x in xs:\n"
+                "        def k(a):\n"
+                "            return a * 2\n"
+                "        out.append(jax.jit(k)(x))\n"
+                "    return out\n"
+            ),
+        }
+        active, _ = run_fixture(tmp_path, files, ["JIT01"])
+        assert len(active) == 1
+        assert "loop" in active[0].message
+
+    def test_module_level_and_cached_builders_pass(self, tmp_path):
+        files = {
+            "flink_tpu/__init__.py": "",
+            "flink_tpu/k.py": (
+                "import jax\n"
+                "\n"
+                "_FENCE = jax.jit(lambda a: a[:1])\n"
+                "_JIT_CACHE = {}\n"
+                "\n"
+                "def make_fence(acc):\n"
+                "    fn = _JIT_CACHE.get('fence')\n"
+                "    if fn is None:\n"
+                "        fn = jax.jit(lambda a: a[:1, :1])\n"
+                "        _JIT_CACHE['fence'] = fn\n"
+                "    return fn(acc)\n"
+            ),
+        }
+        active, _ = run_fixture(tmp_path, files, ["JIT01"])
+        assert active == []
+
+
+# ------------------------------------------------------------------- REG01
+
+
+class TestREG01FaultPointRegistry:
+    FILES = {
+        "flink_tpu/__init__.py": "",
+        "flink_tpu/chaos/__init__.py": (
+            'KNOWN_FAULT_POINTS = ("good.point", "stale.point")\n'
+        ),
+        "flink_tpu/mod.py": (
+            "from flink_tpu.chaos import injection as chaos\n"
+            "\n"
+            "def f():\n"
+            '    chaos.fault_point("good.point")\n'
+            '    chaos.fault_point("typo.poimt")\n'
+        ),
+        "tests/__init__.py": "",
+        "tests/test_x.py": (
+            "from flink_tpu.chaos.injection import FaultRule\n"
+            "\n"
+            'R1 = FaultRule(pattern="good.*", nth=1)\n'
+            'R2 = FaultRule(pattern="zzz.never", nth=1)\n'
+        ),
+    }
+
+    def test_typos_stales_and_dead_patterns_trip(self, tmp_path):
+        active, _ = run_fixture(tmp_path, self.FILES, ["REG01"])
+        msgs = "\n".join(v.message for v in active)
+        assert "'typo.poimt' is not in" in msgs
+        assert "'stale.point' has no" in msgs
+        assert "'zzz.never' matches no known fault point" in msgs
+        assert len(active) == 3
+
+    def test_clean_registry_passes(self, tmp_path):
+        files = dict(self.FILES)
+        files["flink_tpu/chaos/__init__.py"] = \
+            'KNOWN_FAULT_POINTS = ("good.point", "typo.poimt")\n'
+        files["tests/test_x.py"] = (
+            "from flink_tpu.chaos.injection import FaultRule\n"
+            'R1 = FaultRule(pattern="good.*", nth=1)\n'
+        )
+        active, _ = run_fixture(tmp_path, files, ["REG01"])
+        assert active == []
+
+
+# ------------------------------------------------------------------- REG02
+
+
+class TestREG02MetricCounterRegistry:
+    FILES = {
+        "flink_tpu/__init__.py": "",
+        "flink_tpu/state/__init__.py": "",
+        "flink_tpu/state/paged_spill.py": (
+            'COUNTER_NAMES = ("rows_ok",)\n'
+        ),
+        "flink_tpu/metrics/__init__.py": (
+            'KNOWN_METRIC_GROUPS = ("good", "unproduced")\n'
+        ),
+        "flink_tpu/prod.py": (
+            "def bump(counters, g):\n"
+            '    counters["rows_ok"] += 1\n'
+            '    counters["rows_typo"] += 1\n'
+            '    g.add_group("good")\n'
+            '    g.add_group("bogus")\n'
+        ),
+    }
+
+    def test_counter_and_group_drift_trips(self, tmp_path):
+        active, _ = run_fixture(tmp_path, self.FILES, ["REG02"])
+        msgs = "\n".join(v.message for v in active)
+        assert "'rows_typo' is not in" in msgs
+        assert "'bogus' is not in" in msgs
+        assert "'unproduced' has no add_group producer" in msgs
+        assert len(active) == 3
+
+
+# ------------------------------------------------------------- suppressions
+
+
+class TestSuppressions:
+    BAD = (
+        "import numpy as np\n"
+        "\n"
+        "class MeshWindowEngine:\n"
+        "    def process_batch(self, batch):\n"
+        "        out = self._gather_step(batch)\n"
+        "{directive}"
+        "        return [np.asarray(g) for g in out]\n"
+    )
+
+    def test_reasoned_suppression_silences(self, tmp_path):
+        files = {
+            "flink_tpu/__init__.py": "",
+            "flink_tpu/eng.py": self.BAD.format(directive=(
+                "        # flint: disable=TRC01 -- fixture: deliberate\n"
+            )),
+        }
+        active, suppressed = run_fixture(tmp_path, files,
+                                         ["TRC01", "SUP01"])
+        assert active == []
+        assert len(suppressed) == 1
+        assert suppressed[0].reason == "fixture: deliberate"
+
+    def test_suppression_without_reason_is_a_violation(self, tmp_path):
+        files = {
+            "flink_tpu/__init__.py": "",
+            "flink_tpu/eng.py": self.BAD.format(directive=(
+                "        # flint: disable=TRC01\n"
+            )),
+        }
+        active, suppressed = run_fixture(tmp_path, files,
+                                         ["TRC01", "SUP01"])
+        assert [v.rule for v in active] == ["SUP01"]
+        assert "without a reason" in active[0].message
+        assert len(suppressed) == 1  # suppressed, but the gate still fails
+
+    def test_unknown_rule_in_directive_is_flagged(self, tmp_path):
+        files = {
+            "flink_tpu/__init__.py": "",
+            "flink_tpu/eng.py": (
+                "x = 1  # flint: disable=NOPE99 -- misguided\n"
+            ),
+        }
+        active, _ = run_fixture(tmp_path, files, ["SUP01"])
+        assert [v.rule for v in active] == ["SUP01"]
+        assert "unknown rule" in active[0].message
+
+
+# --------------------------------------------------------------- clean tree
+
+
+class TestCleanTree:
+    def test_flint_exits_zero_on_head(self, tmp_path):
+        """The acceptance invariant tier-1 enforces: the real package is
+        flint-clean and every suppression carries a reason."""
+        from tools.flint.cli import main
+
+        report = tmp_path / "flint_report.json"
+        rc = main([str(REPO_ROOT / "flink_tpu"), "--json", str(report)])
+        data = json.loads(report.read_text())
+        assert rc == 0, data["violations"]
+        assert data["violations"] == []
+        assert {"TRC01", "TRC02", "JIT01", "REG01", "REG02"} <= set(
+            data["rules"])
+        for s in data["suppressed"]:
+            assert s["reason"], f"reasonless suppression: {s}"
+
+    def test_nonexistent_target_is_a_usage_error(self, capsys):
+        """A typo'd path must exit 2 with a diagnostic, not traceback."""
+        from tools.flint.cli import main
+
+        rc = main([str(REPO_ROOT / "flink_tpu" / "nonexistent.py")])
+        assert rc == 2
+        assert "no such file" in capsys.readouterr().err
+
+    def test_known_fault_points_matches_runtime_registry(self):
+        """flint parses the tuple statically; the import path must agree."""
+        import ast
+
+        from flink_tpu.chaos import KNOWN_FAULT_POINTS
+
+        src = (REPO_ROOT / "flink_tpu/chaos/__init__.py").read_text()
+        tree = ast.parse(src)
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    getattr(t, "id", None) == "KNOWN_FAULT_POINTS"
+                    for t in node.targets):
+                parsed = tuple(e.value for e in node.value.elts)
+                assert parsed == KNOWN_FAULT_POINTS
+                return
+        pytest.fail("KNOWN_FAULT_POINTS literal not found")
+
+
+# ----------------------------------------------------------- the sentinel
+
+
+class TestRecompileSentinel:
+    def test_counts_fresh_compile_and_passes_cache_hits(self):
+        import jax
+        import jax.numpy as jnp
+
+        from flink_tpu.observe import RecompileSentinel
+
+        with RecompileSentinel(max_compiles=None) as warm:
+            f = jax.jit(lambda x: x * 3 + 1)
+            f(jnp.ones(17))
+        assert warm.compiles >= 1  # fresh identity + shape => compiled
+        with RecompileSentinel(max_compiles=0, label="steady") as s:
+            f(jnp.ones(17))  # cache hit: same identity, same shape
+        assert s.compiles == 0
+
+    def test_raises_on_budget_violation(self):
+        import jax
+        import jax.numpy as jnp
+
+        from flink_tpu.observe import (
+            RecompileSentinel,
+            SteadyStateViolation,
+        )
+
+        with pytest.raises(SteadyStateViolation, match="jit identity"):
+            with RecompileSentinel(max_compiles=0, label="fixture"):
+                jax.jit(lambda x: x - 7)(jnp.ones(9))
+
+    def test_transfer_budget(self):
+        import jax
+        import jax.numpy as jnp
+
+        from flink_tpu.observe import (
+            RecompileSentinel,
+            SteadyStateViolation,
+        )
+
+        x = jnp.arange(8)
+        with RecompileSentinel(max_compiles=None) as s:
+            jax.device_get(x)
+        assert s.transfers >= 1
+        with pytest.raises(SteadyStateViolation, match="transfer"):
+            with RecompileSentinel(max_compiles=None, max_transfers=0):
+                jax.device_get(x)
+
+    def test_never_masks_region_exception(self):
+        from flink_tpu.observe import RecompileSentinel
+
+        with pytest.raises(ValueError, match="inner"):
+            with RecompileSentinel(max_compiles=0):
+                raise ValueError("inner")
+
+
+# --------------------------------------------- deflake bookkeeping (satellite)
+
+
+class TestSlowLaneBookkeeping:
+    def test_unaligned_timing_test_stays_in_slow_lane(self):
+        """The known-flaky wall-clock assertion must keep its slow
+        marker, keep the justification comment explaining WHY, and the
+        tier-1 gate must keep excluding the slow lane."""
+        src = (REPO_ROOT / "tests/test_unaligned_checkpoint.py") \
+            .read_text()
+        i_mark = src.index("@pytest.mark.slow")
+        i_test = src.index("def test_barrier_overtakes_backlog")
+        assert i_mark < i_test, "slow marker must precede the timing test"
+        justification = src[:i_mark]
+        assert "WALL-CLOCK" in justification and "flaked" in justification, \
+            "the slow marker lost its justification comment"
+        tier1 = (REPO_ROOT / "tools/tier1.sh").read_text()
+        assert "not slow" in tier1, "tier-1 no longer excludes slow tests"
+
+    def test_slow_marker_is_registered(self):
+        src = (REPO_ROOT / "tests/conftest.py").read_text()
+        assert '"markers"' in src and "slow:" in src

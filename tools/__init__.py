@@ -1,0 +1,1 @@
+# Makes `python -m tools.flint` resolvable from the repo root.

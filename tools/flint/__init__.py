@@ -1,0 +1,39 @@
+"""flint — TPU-tracing static analysis for the flink_tpu hot path.
+
+The framework's performance claim rests on the ``keyBy -> window ->
+aggregate`` loop staying inside compiled XLA programs: one silent host
+sync, one tracer leaking into Python control flow, or one jit identity
+that varies per call erases the pipelining wins invisibly (no test
+fails — throughput just drops 2-5x). flint makes those regressions a
+CI failure instead of a benchmark archaeology project.
+
+Five rules:
+
+- **TRC01 host-sync-in-hot-path** — ``.item()``, ``float()/int()/
+  bool()`` on device-tainted values, per-array ``np.asarray`` reads and
+  ``block_until_ready()`` inside functions reachable from the engines'
+  step/dispatch/harvest entry points (call-graph walk rooted at
+  ``MeshSessionEngine`` / ``MeshWindowEngine`` / ``SlotTable``).
+- **TRC02 tracer-unsafe-control-flow** — Python ``if``/``while`` on
+  values data-dependent on jit arguments inside jitted functions.
+- **JIT01 unstable-jit-identity** — ``jax.jit``/``pjit`` applied to a
+  lambda or loop-local def on a per-call path (recompiles every
+  invocation).
+- **REG01 fault-point-registry** — every ``chaos.fault_point("name")``
+  literal cross-checked against ``flink_tpu.chaos.KNOWN_FAULT_POINTS``
+  and the fnmatch patterns used by tests (typos in either direction
+  fail).
+- **REG02 metric-counter-registry** — spill-counter and metric-group
+  name literals consistent between producers (``state/``,
+  ``parallel/``) and consumers (``autoscale/``, ``tools/``).
+
+False positives are silenced in place with a reviewed suppression that
+MUST carry a reason::
+
+    x.block_until_ready()  # flint: disable=TRC01 -- fence drain is the
+                           # pipelining backpressure point
+
+Run ``python -m tools.flint flink_tpu/ --json flint_report.json``.
+"""
+
+from tools.flint.cli import main  # noqa: F401

@@ -1,0 +1,3 @@
+from tools.flint.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,213 @@
+"""Package-wide AST index and a conservative call graph.
+
+Resolution is name-based and deliberately over-approximate — a linter
+must never *miss* a reachable host sync, so ambiguity resolves to
+"could be called":
+
+- ``self.m(...)`` -> every method named ``m`` in the caller's class
+  FAMILY (the inheritance-connected component: the mesh engines call
+  through ``MeshSpillSupport`` mixin methods that subclasses override).
+- ``obj.m(...)`` on anything else -> every method named ``m`` anywhere
+  in the package (duck typing: ``self.windower.on_watermark`` must
+  reach all four windower implementations).
+- ``f(...)`` -> module-level ``f`` in the same module, else whatever a
+  ``from X import f`` in the module points at.
+- ``mod.f(...)`` where ``mod``/alias imports a package module -> that
+  module's ``f``.
+
+Nested defs and lambdas are folded into their enclosing function: their
+bodies execute (if at all) as part of its dynamic extent, and the walk
+must see callbacks like ``build`` closures handed to ``PendingFire``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.flint.core import Project, SourceFile
+
+
+class FunctionInfo:
+    __slots__ = ("sf", "module", "cls", "name", "node", "qualname")
+
+    def __init__(self, sf: SourceFile, module: str, cls: Optional[str],
+                 name: str, node: ast.AST):
+        self.sf = sf
+        self.module = module
+        self.cls = cls
+        self.name = name
+        self.node = node
+        self.qualname = f"{module}:{cls}.{name}" if cls else f"{module}:{name}"
+
+
+def _module_name(relpath: str) -> str:
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+class PackageIndex:
+    """Functions, classes, imports and inheritance families of one
+    package's files."""
+
+    def __init__(self, files: Iterable[SourceFile]):
+        #: qualname -> FunctionInfo
+        self.functions: Dict[str, FunctionInfo] = {}
+        #: method name -> [FunctionInfo] across all classes
+        self.methods_by_name: Dict[str, List[FunctionInfo]] = {}
+        #: (module, func name) -> FunctionInfo (module level)
+        self.module_funcs: Dict[Tuple[str, str], FunctionInfo] = {}
+        #: func name -> [FunctionInfo] (module level, all modules)
+        self.funcs_by_name: Dict[str, List[FunctionInfo]] = {}
+        #: class name -> [class's method dict] (name collisions keep all)
+        self.class_methods: Dict[str, List[Dict[str, FunctionInfo]]] = {}
+        #: module -> {local alias -> imported module or module:attr}
+        self.imports: Dict[str, Dict[str, str]] = {}
+        #: class name -> set of class names in its inheritance family
+        self.family: Dict[str, Set[str]] = {}
+
+        edges: List[Tuple[str, str]] = []
+        for sf in files:
+            if sf.tree is None:
+                continue
+            module = _module_name(sf.path)
+            imp = self.imports.setdefault(module, {})
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        imp[a.asname or a.name.split(".")[0]] = a.name
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    src = node.module
+                    if node.level:  # relative: resolve against module pkg
+                        base = module.split(".")[: -node.level]
+                        src = ".".join(base + [src]) if base else src
+                    for a in node.names:
+                        imp[a.asname or a.name] = f"{src}:{a.name}"
+            for node in sf.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fi = FunctionInfo(sf, module, None, node.name, node)
+                    self.functions[fi.qualname] = fi
+                    self.module_funcs[(module, node.name)] = fi
+                    self.funcs_by_name.setdefault(node.name, []).append(fi)
+                elif isinstance(node, ast.ClassDef):
+                    methods: Dict[str, FunctionInfo] = {}
+                    for item in node.body:
+                        if isinstance(item, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                            fi = FunctionInfo(sf, module, node.name,
+                                              item.name, item)
+                            self.functions[fi.qualname] = fi
+                            methods[item.name] = fi
+                            self.methods_by_name.setdefault(
+                                item.name, []).append(fi)
+                    self.class_methods.setdefault(node.name, []).append(
+                        methods)
+                    for b in node.bases:
+                        base = b.id if isinstance(b, ast.Name) else (
+                            b.attr if isinstance(b, ast.Attribute) else None)
+                        if base:
+                            edges.append((node.name, base))
+
+        # inheritance families: union-find over class-name edges
+        parent: Dict[str, str] = {}
+
+        def find(x: str) -> str:
+            parent.setdefault(x, x)
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        for a, b in edges:
+            ra, rb = find(a), find(b)
+            if ra != rb:
+                parent[ra] = rb
+        groups: Dict[str, Set[str]] = {}
+        for cls in set(self.class_methods) | {c for e in edges for c in e}:
+            groups.setdefault(find(cls), set()).add(cls)
+        for members in groups.values():
+            for cls in members:
+                self.family[cls] = members
+
+    # ------------------------------------------------------------- resolution
+
+    def _family_methods(self, cls: Optional[str],
+                        name: str) -> List[FunctionInfo]:
+        if cls is None:
+            return []
+        out = []
+        for member in self.family.get(cls, {cls}):
+            for methods in self.class_methods.get(member, []):
+                if name in methods:
+                    out.append(methods[name])
+        return out
+
+    def resolve_call(self, caller: FunctionInfo,
+                     call: ast.Call) -> List[FunctionInfo]:
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            base = fn.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                hits = self._family_methods(caller.cls, fn.attr)
+                if hits:
+                    return hits
+            if isinstance(base, ast.Name):
+                target = self.imports.get(caller.module, {}).get(base.id)
+                if target and ":" not in target:
+                    fi = self.module_funcs.get((target, fn.attr))
+                    if fi is not None:
+                        return [fi]
+            # duck-typed: any method of this name, anywhere
+            return list(self.methods_by_name.get(fn.attr, []))
+        if isinstance(fn, ast.Name):
+            fi = self.module_funcs.get((caller.module, fn.id))
+            if fi is not None:
+                return [fi]
+            target = self.imports.get(caller.module, {}).get(fn.id)
+            if target and ":" in target:
+                mod, attr = target.split(":", 1)
+                fi = self.module_funcs.get((mod, attr))
+                if fi is not None:
+                    return [fi]
+                # from X import Name could be a class: constructor
+                for methods in self.class_methods.get(attr, []):
+                    if "__init__" in methods:
+                        return [methods["__init__"]]
+            # class constructor referenced by bare name in-module
+            for methods in self.class_methods.get(fn.id, []):
+                if "__init__" in methods:
+                    return [methods["__init__"]]
+        return []
+
+    # ----------------------------------------------------------- reachability
+
+    def reachable(self, roots: Dict[str, Iterable[str]]
+                  ) -> Dict[str, FunctionInfo]:
+        """BFS over the call graph from {class name: [method, ...]}
+        roots. Returns {qualname: FunctionInfo} of every function that
+        can run as part of those entry points."""
+        frontier: List[FunctionInfo] = []
+        for cls, names in roots.items():
+            for name in names:
+                # exact class only: rooting a family-wide name match
+                # would pull every Operator subclass into the walk —
+                # `self.m()` dispatch during the BFS still resolves
+                # through the whole inheritance family
+                for methods in self.class_methods.get(cls, []):
+                    if name in methods:
+                        frontier.append(methods[name])
+        seen: Dict[str, FunctionInfo] = {}
+        while frontier:
+            fi = frontier.pop()
+            if fi.qualname in seen:
+                continue
+            seen[fi.qualname] = fi
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Call):
+                    for callee in self.resolve_call(fi, node):
+                        if callee.qualname not in seen:
+                            frontier.append(callee)
+        return seen

@@ -1,0 +1,100 @@
+"""flint command line.
+
+    python -m tools.flint flink_tpu/ [--json flint_report.json]
+                                     [--select TRC01,REG01]
+                                     [--no-fail] [--verbose]
+
+Exit codes: 0 clean, 1 violations found (gating — the tier-1 default),
+2 usage/internal error. ``--fail-on-violation`` names the gating
+behavior explicitly for CI scripts; it is already the default.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+# import for side effect: checker registration
+from tools.flint import rules_registry  # noqa: F401
+from tools.flint import rules_trace  # noqa: F401
+from tools.flint.core import (
+    CHECKERS,
+    SUP01_TITLE,
+    Project,
+    UsageError,
+    discover,
+    print_human,
+    run_checks,
+    write_report,
+)
+
+
+def _find_root(paths) -> Path:
+    """The repo root: the nearest ancestor of the first target that
+    contains the flink_tpu package (aux scans of tests/ and tools/
+    resolve against it)."""
+    first = Path(paths[0]).resolve()
+    probe = first if first.is_dir() else first.parent
+    for cand in (probe, *probe.parents):
+        if (cand / "flink_tpu" / "__init__.py").is_file():
+            return cand
+    return Path.cwd()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="flint",
+        description="TPU-tracing static analysis for flink_tpu")
+    ap.add_argument("paths", nargs="*", default=["flink_tpu/"],
+                    help="files or directories to analyze "
+                         "(default: flink_tpu/)")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the machine-readable report here")
+    ap.add_argument("--select", metavar="RULES",
+                    help="comma-separated rule ids to run "
+                         "(default: all)")
+    ap.add_argument("--fail-on-violation", action="store_true",
+                    help="exit 1 when violations remain (the default; "
+                         "spelled out for CI scripts)")
+    ap.add_argument("--no-fail", action="store_true",
+                    help="always exit 0 (report-only mode)")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also print suppressed findings with reasons")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(CHECKERS):
+            print(f"{rule}  {CHECKERS[rule].title}")
+        print(f"SUP01  {SUP01_TITLE}")
+        return 0
+
+    paths = args.paths or ["flink_tpu/"]
+    root = _find_root(paths)
+    try:
+        files = discover(paths, root)
+    except UsageError as e:
+        print(f"flint: {e}", file=sys.stderr)
+        return 2
+    if not files:
+        print(f"flint: no python files under {paths}", file=sys.stderr)
+        return 2
+    select = None
+    if args.select:
+        select = [r.strip() for r in args.select.split(",") if r.strip()]
+        known = set(CHECKERS) | {"SUP01"}
+        unknown = [r for r in select if r not in known]
+        if unknown:
+            print(f"flint: unknown rule(s) {unknown}; known: "
+                  f"{sorted(known)}", file=sys.stderr)
+            return 2
+
+    project = Project(files, root)
+    active, suppressed = run_checks(project, select)
+    if args.json:
+        write_report(args.json, active, suppressed, len(files))
+    print_human(active, suppressed, len(files), verbose=args.verbose)
+    if active and not args.no_fail:
+        return 1
+    return 0

@@ -1,0 +1,296 @@
+"""flint framework: source model, suppressions, checker registry, report.
+
+A checker is a class with a ``rule`` id and a ``check(project)``
+generator of :class:`Violation`. The framework owns everything else:
+file discovery, AST parsing, the suppression protocol
+(``# flint: disable=<RULE>[,<RULE>...] -- <reason>`` — the reason is
+MANDATORY; a bare disable is itself a violation), human/JSON output and
+exit-code gating.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+#: directive grammar; the reason separator is a literal " -- " so rule
+#: lists and prose never ambiguate
+_DIRECTIVE = re.compile(
+    r"#\s*flint:\s*disable=(?P<rules>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+    r"(?:\s+--\s*(?P<reason>\S.*))?")
+
+#: a line that is nothing but (indentation +) comment: its directives
+#: apply to the next source line, so long reasons can sit above the code
+_COMMENT_ONLY = re.compile(r"^\s*#")
+
+
+@dataclasses.dataclass
+class Violation:
+    rule: str
+    path: str              # repo-relative, forward slashes
+    line: int              # 1-based
+    col: int               # 0-based (ast convention)
+    message: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def format(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: " \
+               f"{self.rule} {self.message}{tag}"
+
+    def to_json(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+class Suppressions:
+    """Per-file map of line -> {rule -> reason | None}.
+
+    A directive on a code line covers that line; a directive on a
+    comment-only line covers the next non-comment-only line (comment
+    blocks stack — every directive line in the block covers the same
+    target line).
+    """
+
+    def __init__(self, lines: List[str]):
+        self.by_line: Dict[int, Dict[str, Optional[str]]] = {}
+        self.directive_lines: List[Tuple[int, List[str], Optional[str]]] = []
+        pending: List[Tuple[int, List[str], Optional[str]]] = []
+        for i, text in enumerate(lines, start=1):
+            m = _DIRECTIVE.search(text)
+            if m:
+                rules = [r.strip() for r in m.group("rules").split(",")]
+                reason = m.group("reason")
+                self.directive_lines.append((i, rules, reason))
+                if _COMMENT_ONLY.match(text):
+                    pending.append((i, rules, reason))
+                    continue
+                self._apply(i, rules, reason)
+            if not _COMMENT_ONLY.match(text) and text.strip():
+                for _, rules, reason in pending:
+                    self._apply(i, rules, reason)
+                pending = []
+        # trailing comment-only directives cover nothing; keep them in
+        # directive_lines so the no-reason check still sees them
+
+    def _apply(self, line: int, rules: List[str],
+               reason: Optional[str]) -> None:
+        slot = self.by_line.setdefault(line, {})
+        for r in rules:
+            slot[r] = reason
+
+    def lookup(self, rule: str, line: int) -> Tuple[bool, Optional[str]]:
+        slot = self.by_line.get(line)
+        if slot is None or rule not in slot:
+            return False, None
+        return True, slot[rule]
+
+
+class SourceFile:
+    def __init__(self, abspath: Path, relpath: str):
+        self.abspath = abspath
+        self.path = relpath
+        self.text = abspath.read_text(encoding="utf-8")
+        self.lines = self.text.splitlines()
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.Module] = ast.parse(
+                self.text, filename=str(abspath))
+        except SyntaxError as e:  # surfaced as a PARSE violation
+            self.tree = None
+            self.parse_error = e
+        self.suppressions = Suppressions(self.lines)
+
+
+class Project:
+    """The files under analysis plus the repo root for aux scans
+    (checkers that need tests/ or tools/ regardless of the target)."""
+
+    def __init__(self, files: List[SourceFile], root: Path):
+        self.files = files
+        self.root = root
+        self._by_path = {f.path: f for f in files}
+        self._aux_cache: Dict[str, Optional[SourceFile]] = {}
+
+    def get(self, relpath: str) -> Optional[SourceFile]:
+        """A file by repo-relative path — from the target set if
+        present, else parsed on demand from the repo root (aux file)."""
+        if relpath in self._by_path:
+            return self._by_path[relpath]
+        if relpath not in self._aux_cache:
+            p = self.root / relpath
+            self._aux_cache[relpath] = (
+                SourceFile(p, relpath) if p.is_file() else None)
+        return self._aux_cache[relpath]
+
+    def aux_glob(self, pattern: str) -> List[SourceFile]:
+        out = []
+        for p in sorted(self.root.glob(pattern)):
+            if p.is_file() and p.suffix == ".py":
+                rel = p.relative_to(self.root).as_posix()
+                sf = self.get(rel)
+                if sf is not None:
+                    out.append(sf)
+        return out
+
+    def package_files(self, package: str = "flink_tpu") -> List[SourceFile]:
+        """Every file of the named package: target files under the
+        package plus any the target set is missing (a partial-target run
+        must still see the whole package for cross-file rules)."""
+        seen = {f.path for f in self.files if f.path.startswith(package + "/")}
+        out = [f for f in self.files if f.path.startswith(package + "/")]
+        for p in sorted((self.root / package).rglob("*.py")):
+            rel = p.relative_to(self.root).as_posix()
+            if rel not in seen:
+                sf = self.get(rel)
+                if sf is not None:
+                    out.append(sf)
+        return out
+
+
+# ------------------------------------------------------------------ registry
+
+CHECKERS: Dict[str, type] = {}
+
+
+def register(cls):
+    """Class decorator: adds the checker to the global registry."""
+    rule = getattr(cls, "rule", None)
+    if not rule:
+        raise ValueError(f"checker {cls.__name__} has no rule id")
+    if rule in CHECKERS:
+        raise ValueError(f"duplicate checker rule {rule}")
+    CHECKERS[rule] = cls
+    return cls
+
+
+class Checker:
+    rule: str = ""
+    title: str = ""
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        raise NotImplementedError
+
+
+# -------------------------------------------------------------------- runner
+
+class UsageError(Exception):
+    """Bad invocation (nonexistent target, ...) — exit 2, not a crash."""
+
+
+def discover(paths: Iterable[str], root: Path) -> List[SourceFile]:
+    files: List[SourceFile] = []
+    seen = set()
+    for raw in paths:
+        p = Path(raw)
+        if not p.is_absolute():
+            p = root / p
+        if p.is_dir():
+            candidates = sorted(p.rglob("*.py"))
+        elif not p.is_file():
+            raise UsageError(f"no such file or directory: {raw}")
+        else:
+            candidates = [p]
+        for c in candidates:
+            if "__pycache__" in c.parts or c.suffix != ".py":
+                continue
+            try:
+                rel = c.resolve().relative_to(root).as_posix()
+            except ValueError:
+                rel = c.as_posix()
+            if rel in seen:
+                continue
+            seen.add(rel)
+            files.append(SourceFile(c, rel))
+    return files
+
+
+def run_checks(project: Project,
+               select: Optional[Iterable[str]] = None
+               ) -> Tuple[List[Violation], List[Violation]]:
+    """Returns (active_violations, suppressed_violations)."""
+    active: List[Violation] = []
+    suppressed: List[Violation] = []
+
+    # parse failures gate everything (an unparsable file is unanalyzed)
+    for f in project.files:
+        if f.parse_error is not None:
+            active.append(Violation(
+                rule="PARSE", path=f.path,
+                line=f.parse_error.lineno or 1, col=0,
+                message=f"syntax error: {f.parse_error.msg}"))
+
+    rules = sorted(CHECKERS) if select is None else [
+        r for r in sorted(CHECKERS) if r in set(select)]
+    for rule in rules:
+        checker = CHECKERS[rule]()
+        for v in checker.check(project):
+            sf = project.get(v.path)
+            if sf is None:
+                active.append(v)
+                continue
+            hit, reason = sf.suppressions.lookup(v.rule, v.line)
+            if hit:
+                v.suppressed = True
+                v.reason = reason or ""
+                suppressed.append(v)
+            else:
+                active.append(v)
+
+    # the suppression protocol itself: every directive needs a reason,
+    # and directives naming unknown rules are dead weight (typo guard)
+    if select is None or "SUP01" in set(select):
+        for f in project.files:
+            for line, rules_, reason in f.suppressions.directive_lines:
+                if reason is None:
+                    active.append(Violation(
+                        rule="SUP01", path=f.path, line=line, col=0,
+                        message="suppression without a reason — write "
+                                "'# flint: disable=<RULE> -- <why>'"))
+                for r in rules_:
+                    if r not in CHECKERS and r != "PARSE":
+                        active.append(Violation(
+                            rule="SUP01", path=f.path, line=line, col=0,
+                            message=f"suppression names unknown rule "
+                                    f"{r!r} (known: "
+                                    f"{', '.join(sorted(CHECKERS))})"))
+
+    key = (lambda v: (v.path, v.line, v.col, v.rule))
+    return sorted(active, key=key), sorted(suppressed, key=key)
+
+
+#: the framework's built-in rule (suppression protocol) — not a
+#: Checker subclass, but selectable and reported like one
+SUP01_TITLE = ("suppression protocol: every '# flint: disable' needs "
+               "' -- <reason>' and must name known rules")
+
+
+def write_report(path: str, active: List[Violation],
+                 suppressed: List[Violation], files: int) -> None:
+    report = {
+        "tool": "flint",
+        "checked_files": files,
+        "rules": {**{r: CHECKERS[r].title for r in sorted(CHECKERS)},
+                  "SUP01": SUP01_TITLE},
+        "violations": [v.to_json() for v in active],
+        "suppressed": [v.to_json() for v in suppressed],
+    }
+    Path(path).write_text(json.dumps(report, indent=2) + "\n",
+                          encoding="utf-8")
+
+
+def print_human(active: List[Violation], suppressed: List[Violation],
+                files: int, verbose: bool = False,
+                out=sys.stdout) -> None:
+    for v in active:
+        print(v.format(), file=out)
+    if verbose:
+        for v in suppressed:
+            print(v.format() + f" [reason: {v.reason}]", file=out)
+    print(f"flint: {files} files, {len(active)} violation(s), "
+          f"{len(suppressed)} suppressed", file=out)

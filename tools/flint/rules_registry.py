@@ -1,0 +1,269 @@
+"""REG01 / REG02 — the stringly-typed registry rules.
+
+The codebase carries three name registries that only stay consistent by
+convention: chaos fault points, spill counters and metric groups. Each
+now has ONE canonical tuple in the package; these rules statically
+cross-check every literal producer and consumer against it, so a typo
+on either side fails CI instead of silently never injecting / never
+reporting.
+"""
+
+from __future__ import annotations
+
+import ast
+from fnmatch import fnmatchcase
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.flint.core import Checker, Project, SourceFile, Violation, register
+
+
+def _string_tuple(sf: SourceFile, name: str
+                  ) -> Optional[Tuple[int, Tuple[str, ...]]]:
+    """(line, values) of a module-level ``NAME = ("a", "b", ...)``
+    literal assignment, parsed statically (flint never imports the
+    package under analysis)."""
+    if sf.tree is None:
+        return None
+    for node in sf.tree.body:
+        targets = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == name:
+                if isinstance(value, (ast.Tuple, ast.List)):
+                    vals = []
+                    for e in value.elts:
+                        if isinstance(e, ast.Constant) and isinstance(
+                                e.value, str):
+                            vals.append(e.value)
+                        else:
+                            return (node.lineno, tuple())
+                    return (node.lineno, tuple(vals))
+    return None
+
+
+def _literal_call_arg(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+# --------------------------------------------------------------------- REG01
+
+_CHAOS_REGISTRY_FILE = "flink_tpu/chaos/__init__.py"
+_CHAOS_CALLS = ("fault_point", "io_point", "payload_action")
+
+
+@register
+class FaultPointRegistry(Checker):
+    rule = "REG01"
+    title = ("chaos fault-point literals cross-checked against "
+             "chaos.KNOWN_FAULT_POINTS and test fnmatch patterns")
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        reg_sf = project.get(_CHAOS_REGISTRY_FILE)
+        known: Set[str] = set()
+        reg_line = 1
+        if reg_sf is None:
+            yield Violation(
+                rule=self.rule, path=_CHAOS_REGISTRY_FILE, line=1, col=0,
+                message="chaos package not found — cannot check fault "
+                        "points")
+            return
+        parsed = _string_tuple(reg_sf, "KNOWN_FAULT_POINTS")
+        if parsed is None:
+            yield Violation(
+                rule=self.rule, path=reg_sf.path, line=1, col=0,
+                message="no literal KNOWN_FAULT_POINTS tuple — the "
+                        "canonical fault-point inventory must be a "
+                        "module-level string tuple here")
+            return
+        reg_line, names = parsed
+        known = set(names)
+        if len(names) != len(known):
+            yield Violation(
+                rule=self.rule, path=reg_sf.path, line=reg_line, col=0,
+                message="KNOWN_FAULT_POINTS contains duplicates")
+
+        # production literals: every chaos.<call>("name") in the package
+        produced: Dict[str, List[Tuple[SourceFile, int, int]]] = {}
+        for sf in project.package_files("flink_tpu"):
+            if sf.tree is None or sf.path == "flink_tpu/chaos/injection.py":
+                continue  # the defining module's own docs/plumbing
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute) \
+                        and node.func.attr in _CHAOS_CALLS:
+                    lit = _literal_call_arg(node)
+                    if lit is not None:
+                        produced.setdefault(lit, []).append(
+                            (sf, node.lineno, node.col_offset))
+        for name, sites in sorted(produced.items()):
+            if name not in known:
+                sf, line, col = sites[0]
+                yield Violation(
+                    rule=self.rule, path=sf.path, line=line, col=col,
+                    message=f"fault point {name!r} is not in "
+                            "chaos.KNOWN_FAULT_POINTS — add it to the "
+                            "inventory (and NOTES) or fix the typo")
+        for name in sorted(known - set(produced)):
+            yield Violation(
+                rule=self.rule, path=reg_sf.path, line=reg_line, col=0,
+                message=f"KNOWN_FAULT_POINTS entry {name!r} has no "
+                        "chaos.fault_point/io_point/payload_action call "
+                        "site — the injection point went stale")
+
+        # fnmatch patterns used by tests/tools must match something: the
+        # universe is the inventory plus any synthetic points the SAME
+        # file exercises directly (unit tests of the injection machinery
+        # invent points like "a.b")
+        for sf in project.aux_glob("tests/*.py") \
+                + project.aux_glob("tools/*.py"):
+            if sf.tree is None:
+                continue
+            local_points: Set[str] = set()
+            patterns: List[Tuple[str, int, int]] = []
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = node.func.attr if isinstance(
+                    node.func, ast.Attribute) else (
+                    node.func.id if isinstance(node.func, ast.Name)
+                    else "")
+                if fname in _CHAOS_CALLS:
+                    lit = _literal_call_arg(node)
+                    if lit is not None:
+                        local_points.add(lit)
+                elif fname == "FaultRule":
+                    pat = _literal_call_arg(node)
+                    if pat is None:
+                        for kw in node.keywords:
+                            if kw.arg == "pattern" and isinstance(
+                                    kw.value, ast.Constant) and isinstance(
+                                    kw.value.value, str):
+                                pat = kw.value.value
+                    if pat is not None:
+                        patterns.append((pat, node.lineno,
+                                         node.col_offset))
+            universe = known | local_points
+            for pat, line, col in patterns:
+                if not any(fnmatchcase(p, pat) for p in universe):
+                    yield Violation(
+                        rule=self.rule, path=sf.path, line=line, col=col,
+                        message=f"FaultRule pattern {pat!r} matches no "
+                                "known fault point — the plan would arm "
+                                "and never inject (typo or stale point)")
+
+
+# --------------------------------------------------------------------- REG02
+
+_COUNTER_REGISTRY_FILE = "flink_tpu/state/paged_spill.py"
+_METRIC_REGISTRY_FILE = "flink_tpu/metrics/__init__.py"
+#: gauges the executor derives from engine state next to the raw spill
+#: counters on the same `state` metric group
+_DERIVED_STATE_GAUGES = {"resident_rows", "resident_rows_per_shard",
+                         "key_imbalance"}
+#: variables treated as spill-counter dicts by naming convention
+_COUNTERISH = ("counters", "_ns_counters")
+
+
+@register
+class MetricCounterRegistry(Checker):
+    rule = "REG02"
+    title = ("spill-counter and metric-group literals consistent with "
+             "paged_spill.COUNTER_NAMES / metrics.KNOWN_METRIC_GROUPS")
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        yield from self._check_counters(project)
+        yield from self._check_groups(project)
+
+    # ------------------------------------------------------------- counters
+
+    def _check_counters(self, project: Project) -> Iterator[Violation]:
+        reg_sf = project.get(_COUNTER_REGISTRY_FILE)
+        if reg_sf is None:
+            return
+        parsed = _string_tuple(reg_sf, "COUNTER_NAMES")
+        if parsed is None:
+            yield Violation(
+                rule=self.rule, path=reg_sf.path, line=1, col=0,
+                message="no literal COUNTER_NAMES tuple — the canonical "
+                        "spill-counter registry must live here")
+            return
+        _, names = parsed
+        known = set(names) | _DERIVED_STATE_GAUGES
+        scan = project.package_files("flink_tpu") \
+            + project.aux_glob("tools/*.py")
+        for sf in scan:
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                lit: Optional[str] = None
+                if isinstance(node, ast.Subscript) \
+                        and self._counterish(node.value) \
+                        and isinstance(node.slice, ast.Constant) \
+                        and isinstance(node.slice.value, str):
+                    lit = node.slice.value
+                elif isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute) \
+                        and node.func.attr == "get" \
+                        and self._counterish(node.func.value):
+                    lit = _literal_call_arg(node)
+                if lit is not None and lit not in known:
+                    yield Violation(
+                        rule=self.rule, path=sf.path, line=node.lineno,
+                        col=node.col_offset,
+                        message=f"spill counter {lit!r} is not in "
+                                "paged_spill.COUNTER_NAMES — producers "
+                                "and consumers share that one registry")
+
+    @staticmethod
+    def _counterish(node: ast.AST) -> bool:
+        name = node.id if isinstance(node, ast.Name) else (
+            node.attr if isinstance(node, ast.Attribute) else "")
+        return any(name == c or name.endswith(c) for c in _COUNTERISH)
+
+    # -------------------------------------------------------------- groups
+
+    def _check_groups(self, project: Project) -> Iterator[Violation]:
+        reg_sf = project.get(_METRIC_REGISTRY_FILE)
+        if reg_sf is None:
+            return
+        parsed = _string_tuple(reg_sf, "KNOWN_METRIC_GROUPS")
+        if parsed is None:
+            yield Violation(
+                rule=self.rule, path=reg_sf.path, line=1, col=0,
+                message="no literal KNOWN_METRIC_GROUPS tuple — the "
+                        "canonical metric-group registry must live here")
+            return
+        reg_line, names = parsed
+        known = set(names)
+        produced: Set[str] = set()
+        for sf in project.package_files("flink_tpu"):
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute) \
+                        and node.func.attr == "add_group":
+                    lit = _literal_call_arg(node)
+                    if lit is None:  # dynamic names (f-strings) are the
+                        continue     # per-operator scopes, out of scope
+                    produced.add(lit)
+                    if lit not in known:
+                        yield Violation(
+                            rule=self.rule, path=sf.path,
+                            line=node.lineno, col=node.col_offset,
+                            message=f"metric group {lit!r} is not in "
+                                    "metrics.KNOWN_METRIC_GROUPS — "
+                                    "register it or fix the typo")
+        for name in sorted(known - produced):
+            yield Violation(
+                rule=self.rule, path=reg_sf.path, line=reg_line, col=0,
+                message=f"KNOWN_METRIC_GROUPS entry {name!r} has no "
+                        "add_group producer in the package — stale "
+                        "registry entry")

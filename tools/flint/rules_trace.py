@@ -1,0 +1,536 @@
+"""TRC01 / TRC02 / JIT01 — the TPU-tracing rules.
+
+Shared machinery: a small forward local-taint pass over a function
+body. "Tainted" means *derives from a device value* (TRC01) or *derives
+from a jit argument, i.e. is a tracer* (TRC02). The pass is
+intentionally simple — straight-line propagation through assignments,
+loop targets, comprehensions, subscripts and attribute access, iterated
+to a fixpoint — because linter taint must be cheap and predictable, and
+anything it cannot see resolves to "untainted" (precision comes from
+the reviewed suppressions, recall from the generous device-source
+list).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.flint.callgraph import FunctionInfo, PackageIndex
+from tools.flint.core import Checker, Project, Violation, register
+
+#: attribute names whose CALL RESULT lives on device: the engines' step
+#: programs and jit builders follow a strict naming convention
+#: (_*_step / _*_jit / _*_kernel), which this rule locks in
+_DEVICE_CALL_SUFFIXES = ("_step", "_jit", "_kernel")
+#: attribute/function calls that land values on device
+_DEVICE_CALLS = {"device_put", "_put_sharded", "make_fence"}
+#: attribute paths that ARE device state
+_DEVICE_ATTRS = {"accs"}
+#: reading shape metadata off a device value / tracer is trace-time
+#: static, never a sync
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "dtypes"}
+#: calls whose result is host-side even when fed tainted values (they
+#: are the flag points themselves, or sanctioned batched reads)
+_SYNC_SINKS = {"asarray", "array", "ascontiguousarray"}
+
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    """['self', 'accs'] for ``self.accs``; [] when not a pure chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+class TaintPass(ast.NodeVisitor):
+    """Forward may-taint over one function body (nested defs included —
+    they run, if at all, within the enclosing function's extent)."""
+
+    def __init__(self, seeds: Set[str], device_mode: bool):
+        #: tainted local names
+        self.tainted: Set[str] = set(seeds)
+        #: whether device-source CALLS seed taint (TRC01) — TRC02 seeds
+        #: only from jit parameters
+        self.device_mode = device_mode
+        self.changed = False
+
+    # -------------------------------------------------------------- queries
+
+    def is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            chain = _attr_chain(node)
+            if chain and chain[-1] in _STATIC_ATTRS:
+                return False
+            if self.device_mode and chain and chain[-1] in _DEVICE_ATTRS:
+                return True
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            return self._call_is_device(node) or self._call_propagates(node)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.BinOp):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.Compare):
+            return self.is_tainted(node.left) or any(
+                self.is_tainted(c) for c in node.comparators)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_tainted(v) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return self.is_tainted(node.body) or self.is_tainted(node.orelse)
+        if isinstance(node, ast.Starred):
+            return self.is_tainted(node.value)
+        if isinstance(node, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
+            # tainted iterable -> tainted elements
+            return any(self.is_tainted(g.iter) for g in node.generators) \
+                or self.is_tainted(node.elt)
+        return False
+
+    def _call_is_device(self, call: ast.Call) -> bool:
+        if not self.device_mode:
+            return False
+        fn = call.func
+        chain = _attr_chain(fn)
+        if not chain:
+            return False
+        last = chain[-1]
+        if last in _DEVICE_CALLS:
+            return True
+        if any(last.endswith(s) for s in _DEVICE_CALL_SUFFIXES):
+            return True
+        # jnp.* builds device values; of jax.* only device_put does
+        # (jax.devices() / jax.jit(...) etc. return host objects)
+        if chain[0] == "jnp":
+            return True
+        return False
+
+    def _call_propagates(self, call: ast.Call) -> bool:
+        """tuple(tainted) / zip(tainted) / enumerate / sorted / .items()
+        keep taint; the sync sinks (asarray & friends, the scalar
+        casts) return HOST values."""
+        fn = call.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else "")
+        if name in _SYNC_SINKS or name in ("int", "float", "bool", "len",
+                                           "device_get", "item",
+                                           "block_until_ready"):
+            return False
+        if name in ("tuple", "list", "zip", "enumerate", "sorted",
+                    "reversed", "iter", "next", "items", "values"):
+            return any(self.is_tainted(a) for a in call.args) or (
+                isinstance(fn, ast.Attribute) and self.is_tainted(fn.value))
+        if isinstance(fn, ast.Attribute) and name in ("copy", "astype",
+                                                      "reshape", "get"):
+            return self.is_tainted(fn.value)
+        return False
+
+    # ---------------------------------------------------------- propagation
+
+    def _taint_target(self, target: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            if target.id not in self.tainted:
+                self.tainted.add(target.id)
+                self.changed = True
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._taint_target(e)
+        elif isinstance(target, ast.Starred):
+            self._taint_target(target.value)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self.is_tainted(node.value):
+            for t in node.targets:
+                self._taint_target(t)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None and self.is_tainted(node.value):
+            self._taint_target(node.target)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if self.is_tainted(node.value):
+            self._taint_target(node.target)
+        self.generic_visit(node)
+
+    def _taint_loop_target(self, target: ast.AST, it: ast.AST) -> None:
+        """zip-aware: ``for a, m in zip(accs, methods)`` taints only the
+        targets whose zip operand is tainted — blanket tuple smearing
+        would drag closure constants into the tainted set."""
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id == "zip" \
+                and isinstance(target, (ast.Tuple, ast.List)) \
+                and len(target.elts) == len(it.args):
+            for t, a in zip(target.elts, it.args):
+                if self.is_tainted(a):
+                    self._taint_target(t)
+            return
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id == "enumerate" and it.args \
+                and isinstance(target, (ast.Tuple, ast.List)) \
+                and len(target.elts) == 2:
+            if self.is_tainted(it.args[0]):
+                self._taint_target(target.elts[1])
+            return
+        if self.is_tainted(it):
+            self._taint_target(target)
+
+    def visit_For(self, node: ast.For) -> None:
+        self._taint_loop_target(node.target, node.iter)
+        self.generic_visit(node)
+
+    def visit_comprehension_generators(self, node) -> None:
+        for g in node.generators:
+            self._taint_loop_target(g.target, g.iter)
+
+    def visit_ListComp(self, node):
+        self.visit_comprehension_generators(node)
+        self.generic_visit(node)
+
+    def visit_SetComp(self, node):
+        self.visit_comprehension_generators(node)
+        self.generic_visit(node)
+
+    def visit_DictComp(self, node):
+        self.visit_comprehension_generators(node)
+        self.generic_visit(node)
+
+    def visit_GeneratorExp(self, node):
+        self.visit_comprehension_generators(node)
+        self.generic_visit(node)
+
+    def visit_withitem(self, node):
+        if node.optional_vars is not None and self.is_tainted(
+                node.context_expr):
+            self._taint_target(node.optional_vars)
+
+    def run(self, body: List[ast.stmt]) -> None:
+        for _ in range(4):  # tiny fixpoint: chains are short
+            self.changed = False
+            for stmt in body:
+                self.visit(stmt)
+            if not self.changed:
+                return
+
+
+def taint_function(node, seeds: Set[str], device_mode: bool) -> TaintPass:
+    tp = TaintPass(seeds, device_mode)
+    tp.run(node.body)
+    return tp
+
+
+# --------------------------------------------------------------------- TRC01
+
+#: the hot-path entry points: the engines' step/dispatch/harvest
+#: surface. Everything transitively callable from here runs per batch,
+#: per watermark or per harvest — one host sync stalls the XLA queue.
+HOT_ROOTS: Dict[str, Tuple[str, ...]] = {
+    "MeshWindowEngine": ("process_batch", "on_watermark"),
+    "MeshSessionEngine": ("process_batch", "on_watermark"),
+    "SlotTable": ("upsert", "upsert_valued", "scatter", "scatter_valued",
+                  "scatter_signed", "fire", "fire_hybrid", "fire_async",
+                  "fire_projected", "fire_projected_async", "make_fence"),
+    "WindowAggOperator": ("process_batch", "process_watermark",
+                          "poll_pending_output"),
+    "SessionWindowAggOperator": ("process_batch", "process_watermark"),
+    "PendingFire": ("harvest", "ready"),
+}
+
+
+@register
+class HostSyncInHotPath(Checker):
+    rule = "TRC01"
+    title = ("host sync on the hot path: .item()/scalar casts/per-array "
+             "reads/block_until_ready reachable from engine step paths")
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        files = project.package_files("flink_tpu")
+        index = PackageIndex(files)
+        reachable = index.reachable(
+            {c: list(m) for c, m in HOT_ROOTS.items()})
+        for fi in reachable.values():
+            tp = taint_function(fi.node, set(), device_mode=True)
+            yield from self._scan(fi, tp)
+
+    def _scan(self, fi: FunctionInfo, tp: TaintPass) -> Iterator[Violation]:
+        in_loop: Set[int] = set()
+        for node in ast.walk(fi.node):
+            # a For's iterator expression evaluates ONCE — only the body
+            # (and a While's test) repeats
+            if isinstance(node, ast.For):
+                repeat = node.body + node.orelse
+            elif isinstance(node, ast.While):
+                repeat = [node.test] + node.body + node.orelse
+            else:
+                continue
+            for part in repeat:
+                for sub in ast.walk(part):
+                    in_loop.add(id(sub))
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            path = fi.sf.path
+            if isinstance(fn, ast.Attribute):
+                if name == "block_until_ready":
+                    yield Violation(
+                        rule=self.rule, path=path, line=node.lineno,
+                        col=node.col_offset,
+                        message="block_until_ready() on the hot path "
+                                "stalls the host behind the device "
+                                "queue (reachable from "
+                                f"{fi.qualname})")
+                    continue
+                if name == "item" and not node.args \
+                        and tp.is_tainted(fn.value):
+                    yield Violation(
+                        rule=self.rule, path=path, line=node.lineno,
+                        col=node.col_offset,
+                        message=".item() on a device value is a "
+                                "blocking per-element D2H round-trip "
+                                "(reachable from "
+                                f"{fi.qualname})")
+                    continue
+                if name == "device_get" and id(node) in in_loop:
+                    yield Violation(
+                        rule=self.rule, path=path, line=node.lineno,
+                        col=node.col_offset,
+                        message="jax.device_get inside a loop pays one "
+                                "link round-trip per iteration — batch "
+                                "all arrays into ONE device_get "
+                                "(reachable from "
+                                f"{fi.qualname})")
+                    continue
+            chain = _attr_chain(fn)
+            is_np_read = (name in _SYNC_SINKS
+                          and (len(chain) != 2
+                               or chain[0] in ("np", "numpy")))
+            if is_np_read and node.args \
+                    and tp.is_tainted(node.args[0]):
+                verb = ("serializes one D2H round-trip per array"
+                        if id(node) in in_loop else
+                        "synchronously reads a device value")
+                yield Violation(
+                    rule=self.rule, path=fi.sf.path, line=node.lineno,
+                    col=node.col_offset,
+                    message=f"np.{name} on a device value {verb} — "
+                            "batch via one jax.device_get "
+                            f"(reachable from {fi.qualname})")
+                continue
+            if isinstance(fn, ast.Name) and name in ("int", "float", "bool") \
+                    and len(node.args) == 1 \
+                    and tp.is_tainted(node.args[0]):
+                yield Violation(
+                    rule=self.rule, path=fi.sf.path, line=node.lineno,
+                    col=node.col_offset,
+                    message=f"{name}() on a device value forces a "
+                            "blocking host sync (reachable from "
+                            f"{fi.qualname})")
+
+
+# --------------------------------------------------------------------- TRC02
+
+def _jit_decorated(node) -> bool:
+    """@jit / @jax.jit / @pjit / @partial(jax.jit, ...) decorators."""
+    for dec in getattr(node, "decorator_list", []):
+        target = dec
+        if isinstance(dec, ast.Call):
+            fn = dec.func
+            fname = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if fname == "partial" and dec.args:
+                target = dec.args[0]
+            else:
+                target = fn
+        chain = _attr_chain(target)
+        if chain and chain[-1] in ("jit", "pjit"):
+            return True
+    return False
+
+
+def _static_params(node) -> Set[str]:
+    """Parameter names marked static in a partial(jax.jit,
+    static_argnums/static_argnames=...) decorator — not tracers."""
+    out: Set[str] = set()
+    args = [a.arg for a in node.args.posonlyargs + node.args.args]
+    for dec in getattr(node, "decorator_list", []):
+        if not isinstance(dec, ast.Call):
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                for v in ast.walk(kw.value):
+                    if isinstance(v, ast.Constant) and isinstance(
+                            v.value, str):
+                        out.add(v.value)
+            elif kw.arg == "static_argnums":
+                for v in ast.walk(kw.value):
+                    if isinstance(v, ast.Constant) and isinstance(
+                            v.value, int) and 0 <= v.value < len(args):
+                        out.add(args[v.value])
+    return out
+
+
+@register
+class TracerUnsafeControlFlow(Checker):
+    rule = "TRC02"
+    title = ("Python if/while on values data-dependent on jit arguments "
+             "inside jitted functions")
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        for sf in project.package_files("flink_tpu"):
+            if sf.tree is None:
+                continue
+            #: names jit-wrapped at call sites in this module:
+            #: f = jax.jit(g) / return jax.jit(kernel)
+            wrapped: Set[str] = set()
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call):
+                    chain = _attr_chain(node.func)
+                    if chain and chain[-1] in ("jit", "pjit") \
+                            and node.args \
+                            and isinstance(node.args[0], ast.Name):
+                        wrapped.add(node.args[0].id)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if not (_jit_decorated(node) or node.name in wrapped):
+                    continue
+                params = {a.arg for a in (node.args.posonlyargs
+                                          + node.args.args
+                                          + node.args.kwonlyargs)}
+                if node.args.vararg:
+                    params.add(node.args.vararg.arg)
+                # nested defs (shard_map locals) receive tracers too
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)) \
+                            and sub is not node:
+                        params.update(a.arg for a in sub.args.args)
+                        if sub.args.vararg:
+                            params.add(sub.args.vararg.arg)
+                params -= _static_params(node)
+                params.discard("self")
+                tp = taint_function(node, params, device_mode=False)
+                yield from self._scan(sf, node, tp)
+
+    def _scan(self, sf, fn_node, tp: TaintPass) -> Iterator[Violation]:
+        for node in ast.walk(fn_node):
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+                kind = "if" if isinstance(node, ast.If) else "while"
+            elif isinstance(node, ast.IfExp):
+                test, kind = node.test, "conditional expression"
+            elif isinstance(node, ast.Assert):
+                test, kind = node.test, "assert"
+            else:
+                continue
+            if tp.is_tainted(test):
+                yield Violation(
+                    rule=self.rule, path=sf.path, line=test.lineno,
+                    col=test.col_offset,
+                    message=f"Python {kind} on a value data-dependent "
+                            f"on jit arguments of {fn_node.name!r} — "
+                            "inside jit this either crashes "
+                            "(ConcretizationTypeError) or forces a "
+                            "trace-time constant; use lax.cond / "
+                            "lax.while_loop / jnp.where")
+
+
+# --------------------------------------------------------------------- JIT01
+
+@register
+class UnstableJitIdentity(Checker):
+    rule = "JIT01"
+    title = ("jax.jit/pjit of a lambda or loop-local def on a per-call "
+             "path — a fresh jit identity recompiles every invocation")
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        for sf in project.package_files("flink_tpu"):
+            if sf.tree is None:
+                continue
+            yield from self._scan_module(sf)
+
+    def _scan_module(self, sf) -> Iterator[Violation]:
+        # classify every node's enclosure: module level / function /
+        # loop (a jit at module level runs once; inside a function or
+        # loop it runs per call / per iteration)
+        enclosure: Dict[int, str] = {}
+
+        def mark(nodes, kind):
+            for n in nodes:
+                for sub in ast.walk(n):
+                    enclosure.setdefault(id(sub), kind)
+
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.For, ast.While)):
+                mark(node.body + node.orelse, "loop")
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                body = node.body if isinstance(node.body, list) \
+                    else [node.body]
+                mark(body, "function")
+
+        # local def names per function (jit(local_def) in a loop is the
+        # classic recompile-per-iteration bug) + the innermost enclosing
+        # function of every node, for the memo-cache exemption below
+        local_defs: Set[str] = set()
+        enclosing_fn: Dict[int, ast.AST] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)) \
+                            and sub is not node:
+                        local_defs.add(sub.name)
+                    # ast.walk is top-down, so later (inner) functions
+                    # overwrite outer ones: innermost wins
+                    enclosing_fn[id(sub)] = node
+
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain or chain[-1] not in ("jit", "pjit"):
+                continue
+            if not node.args:
+                continue
+            target = node.args[0]
+            where = enclosure.get(id(node))
+            # the memoized-builder idiom: a jit(lambda) whose enclosing
+            # function stores it through a *CACHE* name runs once per
+            # cache key, not per call (SlotTable.make_fence & friends)
+            host = enclosing_fn.get(id(node))
+            if host is not None and any(
+                    isinstance(n, ast.Name) and "CACHE" in n.id
+                    for n in ast.walk(host)):
+                continue
+            if isinstance(target, ast.Lambda) and where is not None:
+                yield Violation(
+                    rule=self.rule, path=sf.path, line=node.lineno,
+                    col=node.col_offset,
+                    message="jit(lambda) on a per-call path creates a "
+                            "fresh jit identity (new cache entry) every "
+                            "evaluation — hoist to module level or "
+                            "cache the wrapped callable")
+            elif isinstance(target, ast.Name) and where == "loop" \
+                    and target.id in local_defs:
+                yield Violation(
+                    rule=self.rule, path=sf.path, line=node.lineno,
+                    col=node.col_offset,
+                    message=f"jit({target.id}) inside a loop re-wraps a "
+                            "local def per iteration — every wrap is a "
+                            "new jit identity and a full recompile")

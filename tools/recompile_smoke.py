@@ -1,0 +1,186 @@
+"""Recompile-sentinel smoke: ZERO steady-state XLA recompiles for both
+mesh engines at the bench shape (tier-1 gate).
+
+Methodology: one warmup rep per engine compiles every step program
+(scatter / merge / fire / reset / gather / put at their sticky-bucket
+padded shapes), then each measured rep builds a FRESH engine over the
+same mesh and replays the same stream shape (timestamps shifted so
+event time advances and sessions/windows genuinely fire). Fresh engines
+make the assertion strict: a step cache keyed on anything unstable
+(engine identity, per-instance lambda, device object vs id) recompiles
+on rep 2 and fails here. The sentinel also enforces a device->host
+transfer budget — an unbatched per-leaf host read multiplies the
+transfer count and trips it.
+
+Spill is ON (max_device_slots below the live set) so the eviction /
+page-reload / hybrid-fire kernels are part of the steady state too,
+exactly like the mesh bench rows.
+
+    JAX_PLATFORMS=cpu python tools/recompile_smoke.py
+    RECOMPILE_SMOKE_RECORDS=... RECOMPILE_SMOKE_REPS=... to scale.
+
+Exits non-zero on any steady-state compile, on a blown transfer
+budget, or on zero fired windows (a vacuous run must not pass).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+GAP_MS = 16_000
+WINDOW_MS = 5_000
+NUM_KEYS = 50_000
+BATCH = 8_192
+#: records per ms of event time — slow event time is what keeps the
+#: concurrent live set (keys per open window / sessions inside the gap)
+#: ABOVE the per-shard device budget, so the evict/reload kernels run
+RECORDS_PER_MS = 4
+
+
+def _batches(total, rep, rng_seed=7):
+    """The rep's record stream: identical SHAPE every rep (same batch
+    sizes, same key multiset), event time shifted per rep so watermarks
+    advance and windows/sessions close instead of being dropped late."""
+    from flink_tpu.core.records import (
+        KEY_ID_FIELD,
+        TIMESTAMP_FIELD,
+        RecordBatch,
+    )
+
+    span = total // RECORDS_PER_MS  # ms of event time per rep
+    # shift each rep by WHOLE windows: a non-aligned offset would slide
+    # the tumbling-window phase, change how many windows close per
+    # watermark, and walk the sticky fire buckets through new shapes
+    stride = span + 10 * GAP_MS
+    stride += -stride % WINDOW_MS
+    offset = rep * stride
+    rng = np.random.default_rng(rng_seed)  # same seed: same shapes
+    produced = 0
+    while produced < total:
+        b = min(BATCH, total - produced)
+        keys = rng.integers(0, NUM_KEYS, b).astype(np.int64)
+        ts = offset + (produced
+                       + np.arange(b, dtype=np.int64)) // RECORDS_PER_MS
+        yield RecordBatch({
+            KEY_ID_FIELD: keys,
+            "v": np.ones(b, dtype=np.float32),
+            TIMESTAMP_FIELD: ts,
+        }), int(ts[-1])
+        produced += b
+
+
+def _drive(engine, total, rep):
+    fired = 0
+    last = 0
+    for rb, last in _batches(total, rep):
+        engine.process_batch(rb)
+        fired += sum(len(b) for b in engine.on_watermark(last - GAP_MS))
+    fired += sum(len(b) for b in engine.on_watermark(last + 100 * GAP_MS))
+    return fired
+
+
+def _make_sessions(mesh, budget):
+    from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+    from flink_tpu.windowing.aggregates import SumAggregate
+
+    return MeshSessionEngine(GAP_MS, SumAggregate("v"), mesh,
+                             capacity_per_shard=budget,
+                             max_device_slots=budget)
+
+
+def _make_windows(mesh, budget):
+    from flink_tpu.parallel.sharded_windower import MeshWindowEngine
+    from flink_tpu.windowing.aggregates import SumAggregate
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+    return MeshWindowEngine(TumblingEventTimeWindows.of(WINDOW_MS),
+                            SumAggregate("v"), mesh,
+                            capacity_per_shard=budget,
+                            max_device_slots=budget)
+
+
+def check_engine(name, make, mesh, total, reps, budget):
+    from flink_tpu.observe import RecompileSentinel
+
+    # warmup: compiles the whole step-program family at the padded
+    # shapes the measured reps will reuse
+    warm_fired = _drive(make(mesh, budget), total, rep=0)
+    ok = True
+    for rep in range(1, reps + 1):
+        # FRESH engine per rep: the step caches must hit across engine
+        # rebuilds (restarts, rescales), not just across batches.
+        # Transfer budget: each watermark advance harvests one batched
+        # result read, evictions/reloads add a bounded few more.
+        engine = make(mesh, budget)
+        with RecompileSentinel(
+                max_compiles=0,
+                max_transfers=max((total // BATCH) * 8, 64),
+                label=f"{name} rep {rep}") as s:
+            fired = _drive(engine, total, rep)
+        evicted = int(engine.spill_counters().get("rows_evicted", 0))
+        print(f"  {name} rep {rep}: fired={fired} compiles={s.compiles} "
+              f"transfers={s.transfers} rows_evicted={evicted}")
+        if fired == 0:
+            print(f"FAIL: {name}: zero windows fired — vacuous run")
+            ok = False
+        if evicted == 0:
+            # the gate's claim is that evict/reload/hybrid-fire kernels
+            # are part of the guarded steady state — a shape change that
+            # stops spill from engaging would silently shrink coverage
+            print(f"FAIL: {name}: spill never engaged — the "
+                  "evict/reload kernels were not covered")
+            ok = False
+    if warm_fired == 0:
+        print(f"FAIL: {name}: zero windows fired in warmup")
+        ok = False
+    return ok
+
+
+def main():
+    import warnings
+
+    warnings.filterwarnings("ignore")
+    import jax
+
+    from flink_tpu.observe.recompile_sentinel import compile_count
+    from flink_tpu.parallel.mesh import make_mesh
+
+    total = int(os.environ.get("RECOMPILE_SMOKE_RECORDS", 1 << 16))
+    reps = max(int(os.environ.get("RECOMPILE_SMOKE_REPS", 2)), 1)
+    P = min(len(jax.devices()), 8)
+    mesh = make_mesh(P)
+    # budgets well BELOW the concurrent live set per shard (thousands
+    # of keys per open window x ~4 live slices, sessions alive inside
+    # the 16 s gap) so the evict/reload/hybrid-fire kernels genuinely
+    # run — check_engine FAILS if rows_evicted stays 0 (vacuous-coverage
+    # guard). The window engine's floor is one slice's per-shard key set
+    # (~2.1k here): a batch's touched namespaces are eviction-protected,
+    # so a budget under that is an irreducible SlotTableFullError.
+    budgets = {"mesh-sessions": 2048, "mesh-windows": 4096}
+    ok = True
+    for name, make in (("mesh-sessions", _make_sessions),
+                       ("mesh-windows", _make_windows)):
+        try:
+            ok = check_engine(name, make, mesh, total, reps,
+                              budgets[name]) and ok
+        except Exception as e:  # SteadyStateViolation included
+            print(f"FAIL: {name}: {e}")
+            ok = False
+    print(f"recompile smoke: shards={P} records={total} reps={reps} "
+          f"process_compiles={compile_count()} "
+          f"=> {'OK' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

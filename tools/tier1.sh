@@ -10,6 +10,14 @@
 set -u
 cd "$(dirname "$0")/.."
 
+# flint: TPU-tracing static analysis over the whole package (host syncs
+# on the hot path, tracer-unsafe control flow, unstable jit identities,
+# fault-point/metric registry drift). Pure AST — runs in ~2 s, gates
+# first so a hot-path regression fails before the long test run.
+# flint_report.json is the machine-readable artifact.
+python -m tools.flint flink_tpu/ --fail-on-violation \
+  --json flint_report.json || exit 1
+
 set -o pipefail
 log="${T1_LOG:-/tmp/_t1.$$.log}"   # unique per run: concurrent gates must not clobber
 rm -f "$log"
@@ -56,4 +64,13 @@ if [ "${SKIP_BENCH_SMOKE:-0}" != "1" ]; then
   # takes a non-live path, or any window diverges. ~3 s on CPU.
   JAX_PLATFORMS=cpu timeout -k 10 120 \
     python tools/autoscale_smoke.py || exit 1
+
+  # Recompile sentinel: after one warmup rep, 2 measured reps on FRESH
+  # engines (both mesh engines, spill armed, disarmed chaos) must show
+  # ZERO XLA backend compiles and bounded device->host transfers —
+  # jax.monitoring counts real compilations, so a jit identity or
+  # padded shape varying per step fails here even though every
+  # correctness test still passes. ~15 s on CPU.
+  JAX_PLATFORMS=cpu timeout -k 10 300 \
+    python tools/recompile_smoke.py || exit 1
 fi
