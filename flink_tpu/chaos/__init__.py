@@ -34,6 +34,7 @@ KNOWN_FAULT_POINTS = (
     "mesh.session_fire",
     "mesh.window_fire",
     "rescale.handoff",
+    "serving.lookup",
     "harvest.pending_fire",
     "task.batch",
     "task.subtask_batch",
@@ -59,4 +60,5 @@ from flink_tpu.chaos.harness import (  # noqa: E402,F401
     ChaosDivergenceError,
     ChaosReport,
     run_crash_restore_verify,
+    run_crash_restore_verify_multi,
 )

@@ -109,6 +109,34 @@ class StateQueryRequest(_ControlRequest):
         self.namespace = namespace
 
 
+class StateQueryBatchRequest(_ControlRequest):
+    """Batched queryable-state lookup: ALL keys served in one pass —
+    one gather program + ONE device read for the whole batch (the
+    serving-plane contract; the one-RTT-per-key path is gone). The
+    single-key StateQueryRequest is now a thin wrapper over this."""
+
+    timeout_message = "state query batch not served"
+
+    def __init__(self, operator_name: str, keys, namespace=None):
+        super().__init__()
+        self.operator_name = operator_name
+        self.keys = list(keys)
+        self.namespace = namespace
+
+
+class RescaleRequest(_ControlRequest):
+    """Cross-job shard arbitration lands here: the tenancy arbiter posts
+    its per-job allocation, the task loop serves it at a batch boundary
+    (pending fires drained first — their buffers reference the
+    pre-reshard plane) and drives the operator's LIVE ``reshard``."""
+
+    timeout_message = "rescale not served"
+
+    def __init__(self, new_shards: int):
+        super().__init__()
+        self.new_shards = int(new_shards)
+
+
 class SavepointRequest(_ControlRequest):
     """A user-triggered savepoint (optionally stop-with-savepoint).
 
@@ -248,6 +276,49 @@ class _SourcePump:
         self._thread.join(timeout=5)
 
 
+class JobHandle:
+    """Setup artifacts of one stepwise job run — the first value yielded
+    by :meth:`LocalExecutor.run_stepwise`. The tenancy session cluster
+    uses it to bind per-job quotas to the stateful operators, register
+    the job's row in the ``tenancy`` metric group, and read the
+    fairness/arbitration signals (busy time, backlog, resident rows)."""
+
+    def __init__(self, job_name, graph, nodes, registry, traces,
+                 job_group, pumps, sources):
+        self.job_name = job_name
+        self.graph = graph
+        self.nodes = nodes
+        self.registry = registry
+        self.traces = traces
+        self.job_group = job_group
+        self.pumps = pumps
+        self.sources = sources
+
+    def stateful_operators(self):
+        """Operators owning keyed device state (spill_counters is the
+        capability marker the metric tree already keys on)."""
+        return [n.operator for n in self.nodes.values()
+                if n.operator is not None
+                and hasattr(n.operator, "spill_counters")]
+
+    def busy_ms(self) -> float:
+        """Wall time spent inside this job's operator hooks — the per-job
+        ``busyTimeMsTotal`` the deficit-round-robin scheduler reports."""
+        return sum(n.busy_s for n in self.nodes.values()) * 1000.0
+
+    def backlog_records(self) -> int:
+        """Prefetched-but-unprocessed records in the job's pump queues
+        (the arbitration demand signal)."""
+        return sum(p.queue.qsize() * p.batch_size
+                   for p in self.pumps.values())
+
+    def resident_rows(self) -> int:
+        """Device-resident state rows across the job's engines."""
+        return sum(sum(op.shard_resident_rows())
+                   for op in self.stateful_operators()
+                   if hasattr(op, "shard_resident_rows"))
+
+
 @internal
 class LocalExecutor:
     def __init__(self, config: Optional[Configuration] = None):
@@ -265,7 +336,34 @@ class LocalExecutor:
         snapshot their positions in the same cut, giving exactly-once state
         on restore.
         """
+        gen = self.run_stepwise(graph, job_name, restore_from,
+                                cancel_event, restore_mode, control_queue)
+        try:
+            while True:
+                next(gen)
+        except StopIteration as done:
+            return done.value
+
+    def run_stepwise(self, graph: StreamGraph, job_name: str = "job",
+                     restore_from: Optional[str] = None, cancel_event=None,
+                     restore_mode="no-claim", control_queue=None,
+                     cooperative: bool = False):
+        """Generator form of :meth:`run` — the multi-tenant scheduling
+        surface. First yields a :class:`JobHandle` (setup artifacts: the
+        tenancy session cluster binds quotas and metric gauges through
+        it), then yields the number of source records processed per loop
+        iteration (the deficit-round-robin accounting unit); the
+        StopIteration value is the JobExecutionResult.
+
+        ``cooperative=True`` skips the idle 1 ms sleep — the hosting
+        scheduler owns pacing, and one starved job must not stall its
+        siblings' quanta. Closing/throwing into the generator runs the
+        same resource-release path an in-loop failure does."""
         from flink_tpu.datastream.environment import JobExecutionResult
+
+        #: chaos context: fault plans on a multi-job cluster can target
+        #: ONE tenant (where={"job": ...}) — the executor is per-job
+        self._chaos_job = job_name
 
         from flink_tpu.core.config import ExecutionModeOptions
 
@@ -467,7 +565,12 @@ class LocalExecutor:
                     if n.operator is not None
                     and getattr(n.operator, "uses_processing_time", False)]
         try:
+            yield JobHandle(job_name=job_name, graph=graph, nodes=nodes,
+                            registry=registry, traces=traces,
+                            job_group=job_group, pumps=pumps,
+                            sources=sources)
             while active:
+                step_records = 0
                 if cancel_event is not None and cancel_event.is_set():
                     raise JobCancelledError(job_name)
                 # harvest landed async fires + release held watermarks
@@ -512,6 +615,7 @@ class LocalExecutor:
                     progressed = True
                     batches_since_ckpt += 1
                     total_records += len(batch)
+                    step_records += len(batch)
                     source_positions[t.uid] = pos
                     tb = time.perf_counter() if debloater else 0.0
                     self._emit_batch(node, batch)
@@ -581,8 +685,10 @@ class LocalExecutor:
                         suppress_final_drain = not stopped.drain
                         savepoint_path = stopped.result_path
                         break
-                if not progressed and active and not pumps:
+                if not progressed and active and not pumps \
+                        and not cooperative:
                     time.sleep(0.001)
+                yield step_records
             else:
                 suppress_final_drain = False
                 savepoint_path = None
@@ -779,14 +885,42 @@ class LocalExecutor:
                     t.source.close()
             active.clear()
 
-        while True:
+        # serve at most the requests ALREADY QUEUED at this boundary:
+        # under sustained lookup load, clients re-submit while a served
+        # request's device read releases the GIL — an unbounded drain
+        # would keep serving forever and starve the data path (observed
+        # as a livelock in the serving smoke's batched mode)
+        budget = max(control_queue.qsize(), 1)
+        while budget > 0:
+            budget -= 1
             try:
                 req = control_queue.get_nowait()
             except _queue.Empty:
                 return None
-            if isinstance(req, StateQueryRequest):
+            if isinstance(req, (StateQueryRequest, StateQueryBatchRequest)):
                 try:
                     req.finish(self._serve_query(graph, nodes, req))
+                except BaseException as e:  # noqa: BLE001
+                    req.finish(None, e)
+                continue
+            if isinstance(req, RescaleRequest):
+                # the arbiter's per-job allocation: drain in-flight fires
+                # (their buffers reference the pre-reshard plane), then
+                # live-migrate — the same boundary checkpoints use
+                try:
+                    self._drain_pending(nodes, wait=True)
+                    target = None
+                    for node in nodes.values():
+                        op = node.operator
+                        if op is not None and getattr(
+                                op, "supports_live_rescale", False):
+                            target = op
+                            break
+                    if target is None:
+                        raise RuntimeError(
+                            f"job {job_name!r} has no live-rescalable "
+                            "operator (mesh engine required)")
+                    req.finish(target.reshard(req.new_shards))
                 except BaseException as e:  # noqa: BLE001
                     req.finish(None, e)
                 continue
@@ -834,17 +968,39 @@ class LocalExecutor:
             if req.stop:
                 return req
 
-    @staticmethod
-    def _serve_query(graph, nodes, req: "StateQueryRequest"):
+    def _serve_query(self, graph, nodes, req):
+        """Serve a single-key or batched state lookup. ALL reads route
+        through the batched path: one gather program + ONE device read
+        per request batch (a single key is a batch of one) — the old
+        one-RTT-per-key loop is gone. Injected ``serving.lookup`` faults
+        retry in place: lookups are read-only, so a retry cannot corrupt
+        engine state (regression-pinned in tests/test_tenancy.py)."""
+        keys = req.keys if isinstance(req, StateQueryBatchRequest) \
+            else [req.key]
         for uid, node in nodes.items():
             t = node.transformation
             if req.operator_name in (t.name, graph.stable_id(t)):
                 op = node.operator
-                if op is None or not hasattr(op, "query_state"):
+                if op is None or not (hasattr(op, "query_state_batch")
+                                      or hasattr(op, "query_state")):
                     raise RuntimeError(
                         f"operator {req.operator_name!r} has no queryable "
                         "state")
-                return op.query_state(req.key, req.namespace)
+
+                def _lookup(op=op):
+                    chaos.fault_point("serving.lookup",
+                                      operator=req.operator_name,
+                                      keys=len(keys),
+                                      job=getattr(self, "_chaos_job",
+                                                  None))
+                    if hasattr(op, "query_state_batch"):
+                        return op.query_state_batch(keys, req.namespace)
+                    return [op.query_state(k, req.namespace)
+                            for k in keys]
+
+                out = chaos.run_recoverable("serving.lookup", _lookup)
+                return out if isinstance(req, StateQueryBatchRequest) \
+                    else out[0]
         raise KeyError(f"no operator named {req.operator_name!r}; "
                        f"available: "
                        f"{sorted(n.transformation.name for n in nodes.values())}")
@@ -884,7 +1040,8 @@ class LocalExecutor:
         # chaos: a task crash mid-batch — surfaces through the normal
         # failure path (job fails, RestartStrategy decides, restore from
         # the latest checkpoint), exactly like a real UDF/executor death
-        chaos.fault_point("task.batch", op=node.transformation.name)
+        chaos.fault_point("task.batch", op=node.transformation.name,
+                          job=getattr(self, "_chaos_job", None))
         node.records_in += len(batch)
         t0 = time.perf_counter()
         outs = node.operator.process_batch(batch, input_idx)

@@ -208,6 +208,22 @@ class TaskExecutorEndpoint(RpcEndpoint):
         rec["control"].put(req)
         return req.wait(timeout_s)
 
+    def query_state_batch(self, execution_id: str, operator_name: str,
+                          keys, namespace=None, timeout_s: float = 10.0):
+        """Batched lookup: the whole key list is served in one pass at
+        the task's next batch boundary — one gather program + ONE device
+        read (see LocalExecutor._serve_query)."""
+        from flink_tpu.cluster.local_executor import StateQueryBatchRequest
+
+        self._touch_master()
+        rec = self._tasks.get(execution_id)
+        if rec is None or rec["status"] != RUNNING:
+            raise RuntimeError(
+                f"no running task {execution_id!r} to query")
+        req = StateQueryBatchRequest(operator_name, keys, namespace)
+        rec["control"].put(req)
+        return req.wait(timeout_s)
+
     def savepoint_status(self, execution_id: str, request_id: str) -> dict:
         self._touch_master()
         rec = self._tasks.get(execution_id)
@@ -811,6 +827,15 @@ class JobMasterThread:
         return te.query_state(self._current_execution_id, operator_name,
                               key, namespace)
 
+    def query_state_batch(self, operator_name: str, keys, namespace=None):
+        if self.status != RUNNING or self._current_executor is None:
+            raise RuntimeError(
+                f"job {self.job_id} is {self.status}, cannot query state")
+        te = self.cluster.service.connect(self._current_address,
+                                          self._current_executor)
+        return te.query_state_batch(self._current_execution_id,
+                                    operator_name, keys, namespace)
+
     def wait(self, timeout: Optional[float] = None) -> str:
         self._done.wait(timeout)
         return self.status
@@ -933,6 +958,13 @@ class DispatcherEndpoint(RpcEndpoint):
         if m is None:
             raise RuntimeError(f"unknown job {job_id}")
         return m.query_state(operator_name, key, namespace)
+
+    def query_state_batch(self, job_id: str, operator_name: str, keys,
+                          namespace=None):
+        m = self._masters.get(job_id)
+        if m is None:
+            raise RuntimeError(f"unknown job {job_id}")
+        return m.query_state_batch(operator_name, keys, namespace)
 
     # local-only helpers (not serializable across processes)
     def master(self, job_id: str) -> Optional[JobMasterThread]:

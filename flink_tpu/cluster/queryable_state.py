@@ -7,27 +7,107 @@ the owning task, and are served ON the task loop at a batch boundary — so
 they read a consistent cut without the reference's concurrent-access
 caveats, at the cost of up to one micro-batch of latency.
 
+Serving-path contract (the tenancy rework): EVERY read — single key or
+batch — travels as a :class:`StateQueryBatchRequest` and is served by one
+gather program + ONE ``jax.device_get`` for the whole batch; the old
+one-RTT-per-key path is gone. On top, concurrent ``get_state`` callers
+from different threads COALESCE into shared device batches
+(:class:`~flink_tpu.tenancy.serving.LookupCoalescer`), so a high-QPS
+serving workload pays one device round trip per request batch, not per
+lookup.
+
 Usage::
 
     client = QueryableStateClient(cluster)
     result = client.get_state(job_id, "window_agg(SumAggregate)", key=7)
     # -> {namespace -> {output column -> value}}
+    results = client.get_state_batch(job_id, "window_agg(SumAggregate)",
+                                     keys=[7, 8, 9])
+    # -> one result dict per key, request order
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Dict, List, Optional
 
 
 class QueryableStateClient:
-    def __init__(self, cluster):
+    #: default ride-collection window 0: flush immediately — the drain
+    #: loop still coalesces whatever concurrent callers queued, but a
+    #: SEQUENTIAL caller (always the lone flusher) pays no wait at all;
+    #: a nonzero window only helps sustained multi-thread load, where
+    #: the ServingPlane (which keeps one) is the intended surface.
+    def __init__(self, cluster, coalesce_window_ms: float = 0.0,
+                 max_batch: int = 512):
+        from flink_tpu.tenancy.serving import CoalescerPool
+
         self.cluster = cluster
+
+        def make_flush(key):
+            # the RAW rpc: the coalescer's _drain already records the
+            # batch against its counters — routing through
+            # get_state_batch here would double-count coalesced lookups
+            def flush(keys, namespace, _j=key[0], _o=key[1]):
+                return self._query_batch_rpc(_j, _o, keys, namespace)
+
+            return flush
+
+        #: the shared coalescer lifecycle (creation race, retirement
+        #: accounting, stats shape) — one behavior with ServingPlane
+        self._pool = CoalescerPool(make_flush, max_batch=int(max_batch),
+                                   window_ms=float(coalesce_window_ms))
+
+    # ------------------------------------------------------------------ API
 
     def get_state(self, job_id: str, operator_name: str, key,
                   namespace: Optional[int] = None
                   ) -> Dict[int, Dict[str, Any]]:
         """Finished result columns for ``key`` in the named stateful
         operator; one entry per live namespace (window), or just the one
-        requested."""
-        return self.cluster.dispatcher_gateway().query_state(
-            job_id, operator_name, key, namespace)
+        requested. Thin wrapper over the batched path: the lookup rides
+        whatever device batch concurrent callers are forming."""
+        return self._coalescer(job_id, operator_name).lookup(
+            key, namespace)
+
+    def get_state_batch(self, job_id: str, operator_name: str, keys,
+                        namespace: Optional[int] = None
+                        ) -> List[Dict[int, Dict[str, Any]]]:
+        """One result dict per key, request order — a single RPC and a
+        single device batch for the whole list. Recorded against the
+        (job, operator) coalescer's counters (as ServingPlane's
+        ``lookup_batch``) so :meth:`stats` covers the explicit-batch
+        shape too, not just coalesced ``get_state`` traffic."""
+        t0 = time.perf_counter()
+        out = self._query_batch_rpc(job_id, operator_name, keys,
+                                    namespace)
+        self._coalescer(job_id, operator_name).note_batch(
+            len(out), (time.perf_counter() - t0) * 1e3)
+        return out
+
+    def _query_batch_rpc(self, job_id: str, operator_name: str, keys,
+                         namespace: Optional[int] = None):
+        return self.cluster.dispatcher_gateway().query_state_batch(
+            job_id, operator_name, list(keys), namespace)
+
+    # ------------------------------------------------------------ coalescing
+
+    def _coalescer(self, job_id: str, operator_name: str):
+        return self._pool.get((job_id, operator_name))
+
+    def forget_job(self, job_id: str) -> None:
+        """Drop the job's coalescers — a long-lived client querying
+        many short-lived jobs grows one coalescer (and its latency
+        reservoir) per (job, operator) forever otherwise. Counters
+        fold into retained totals so :meth:`stats` stays cumulative —
+        including a lookup racing the forget (retired coalescers
+        redirect late counts into the pool). Querying the job again
+        AFTER forgetting re-creates its tracking (by design — the job
+        may still be running); forget again when done."""
+        self._pool.retire(lambda k: k[0] == job_id)
+
+    def stats(self) -> Dict[str, float]:
+        """Client-side amortization evidence: lookups vs device batches
+        and the p99 end-to-end lookup latency (retained totals from
+        forgotten jobs included)."""
+        return self._pool.stats()

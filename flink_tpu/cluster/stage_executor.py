@@ -1382,10 +1382,27 @@ class _KeyedSubtask(threading.Thread):
                 result = None
                 for t, op in zip(self.chain.transformations,
                                  self.chain.operators):
-                    if op is not None and t.name == op_name and \
-                            hasattr(op, "query_state"):
+                    if t.name != op_name:
+                        continue
+                    if op is None or not hasattr(op, "query_state"):
+                        # same contract as LocalExecutor._serve_query:
+                        # a known-but-stateless operator is an ERROR,
+                        # not a silent [None]*n answer
+                        raise RuntimeError(
+                            f"operator {op_name!r} has no queryable "
+                            "state")
+                    if isinstance(key, list):
+                        # batched form: this subtask's whole slice of
+                        # the request served by one gather + one
+                        # device read (query_state_batch)
+                        if hasattr(op, "query_state_batch"):
+                            result = op.query_state_batch(key, namespace)
+                        else:
+                            result = [op.query_state(k, namespace)
+                                      for k in key]
+                    else:
                         result = op.query_state(key, namespace)
-                        break
+                    break
                 reply.put((result, None))
             except BaseException as e:  # noqa: BLE001
                 reply.put((None, e))
@@ -1819,12 +1836,62 @@ class StageParallelExecutor:
                        checkpoint_id):
         from flink_tpu.cluster.local_executor import (
             SavepointRequest,
+            StateQueryBatchRequest,
             StateQueryRequest,
         )
 
         try:
             req = control_queue.get_nowait()
         except _q.Empty:
+            return None
+
+        def _stage_of(operator_name: str) -> int:
+            # same contract as LocalExecutor._serve_query: an unknown
+            # operator raises (naming what exists) rather than silently
+            # routing to stage 0 and answering [None]*n — "no such
+            # operator" and "key has no state" must stay distinct errors
+            for m, stage in enumerate(plan.stages):
+                if any(t.name == operator_name
+                       for t in stage.operator_transformations):
+                    return m
+            raise KeyError(
+                f"no operator named {operator_name!r}; available: "
+                f"{sorted(t.name for stage in plan.stages for t in stage.operator_transformations)}")
+
+        if isinstance(req, StateQueryBatchRequest):
+            try:
+                from flink_tpu.state.keygroups import hash_keys_to_i64
+
+                stage_index = _stage_of(req.operator_name)
+                N = sum(1 for k in keyed if k.stage_index == stage_index)
+                mp = self.config.get(CoreOptions.MAX_PARALLELISM)
+                key_ids = hash_keys_to_i64(np.asarray(req.keys))
+                owners = key_group_to_operator_index(
+                    assign_key_groups(key_ids, mp), mp, N)
+                # one batched control message per OWNING subtask: each
+                # serves its slice with one gather + one device read
+                results: list = [None] * len(req.keys)
+                pending = []
+                for owner in sorted(set(int(o) for o in owners)):
+                    sel = [i for i, o in enumerate(owners)
+                           if int(o) == owner]
+                    reply: _q.Queue = _q.Queue()
+                    keyed[stage_index * N + owner].control.put(
+                        (req.operator_name,
+                         [req.keys[i] for i in sel],
+                         req.namespace, reply))
+                    pending.append((sel, reply))
+                err = None
+                for sel, reply in pending:
+                    part, e = reply.get(timeout=30)
+                    if e is not None:
+                        err = err or e
+                        continue
+                    for i, r in zip(sel, part or []):
+                        results[i] = r
+                req.finish(None if err else results, err)
+            except BaseException as e:  # noqa: BLE001
+                req.finish(None, e)
             return None
         if isinstance(req, StateQueryRequest):
             try:
@@ -1834,12 +1901,7 @@ class StageParallelExecutor:
 
                 # the operator names ONE stage; route to that stage's
                 # owning subtask (keyed is stage-major: m * N + j)
-                stage_index = 0
-                for m, stage in enumerate(plan.stages):
-                    if any(t.name == req.operator_name
-                           for t in stage.operator_transformations):
-                        stage_index = m
-                        break
+                stage_index = _stage_of(req.operator_name)
                 N = sum(1 for k in keyed if k.stage_index == stage_index)
                 key_id = int(hash_keys_to_i64(
                     np.asarray([req.key]))[0])

@@ -7,6 +7,7 @@ KNOWN_METRIC_GROUPS = (
     "autoscale",
     "chaos",
     "state",
+    "tenancy",
 )
 
 from flink_tpu.metrics.core import (  # noqa: E402,F401

@@ -31,13 +31,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flink_tpu.chaos import injection as chaos
 from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
-from flink_tpu.ops.segment_ops import SCATTER_METHOD, sticky_bucket
+from flink_tpu.ops.segment_ops import (
+    SCATTER_METHOD,
+    pad_bucket_size,
+    sticky_bucket,
+)
 from flink_tpu.parallel.mesh import KEY_AXIS, shard_map
 from flink_tpu.parallel.sharded_windower import (
-    _STEP_CACHE,
     MeshPagedSpillSupport,
     build_mesh_steps,
 )
+from flink_tpu.tenancy.program_cache import PROGRAM_CACHE
 from flink_tpu.parallel.shuffle import bucket_by_shard, shard_records
 from flink_tpu.state.keygroups import assign_key_groups
 from flink_tpu.windowing.aggregates import AggregateFunction
@@ -50,11 +54,12 @@ def build_session_merge_step(mesh: Mesh, agg: AggregateFunction):
     index blocks, then reset the src slots to identity (the mesh form of
     sessions._merge_jit). Padded lanes use dst == src == 0 (reserved
     identity slot) and are pure no-ops."""
-    key = ("session-merge", tuple(d.id for d in mesh.devices.flat),
-           agg.cache_key())
-    cached = _STEP_CACHE.get(key)
-    if cached is not None:
-        return cached
+    key = (tuple(d.id for d in mesh.devices.flat), agg.cache_key())
+    return PROGRAM_CACHE.get_or_build(
+        "session-merge", key, lambda: _build_session_merge_step(mesh, agg))
+
+
+def _build_session_merge_step(mesh: Mesh, agg: AggregateFunction):
     methods = tuple(SCATTER_METHOD[l.reduce] for l in agg.leaves)
     idents = tuple(l.identity for l in agg.leaves)
     n_leaves = len(agg.leaves)
@@ -79,7 +84,6 @@ def build_session_merge_step(mesh: Mesh, agg: AggregateFunction):
             out_specs=(P(KEY_AXIS),) * n_leaves,
         )(*accs, dst, src)
 
-    _STEP_CACHE[key] = merge_step
     return merge_step
 
 
@@ -637,64 +641,91 @@ class MeshSessionEngine(MeshPagedSpillSupport):
 
     def query_sessions(self, key_id: int) -> Dict[int, Dict[str, float]]:
         """{session_end -> result columns} for a key's live sessions —
-        read-only point lookup on the owning shard."""
-        intervals = self.meta.sessions.get(int(key_id))
-        if not intervals:
-            return {}
-        shard = int(shard_records(
-            np.asarray([key_id], dtype=np.int64), self.P,
-            self.max_parallelism, self.key_group_range)[0])
-        sids = np.asarray([iv[2] for iv in intervals], dtype=np.int64)
-        keys = np.full(len(sids), int(key_id), dtype=np.int64)
-        slots = self.indexes[shard].lookup(keys, sids)
-        out: Dict[int, Dict[str, float]] = {}
-        if self._spill_active and (slots < 0).any():
-            # cold sessions answer from the spill tier (read-only — a
-            # query must not thrash residency); paged: sid -> its page,
-            # then the (key, sid) row inside it
-            sp = self.spills[shard]
-            for i, iv in enumerate(intervals):
-                if slots[i] >= 0:
-                    continue
-                if self._paged:
-                    page = self._pmaps[shard].page_of(int(sids[i]))
-                    entry = sp.peek(page) if page is not None else None
-                    if entry is None:
-                        continue
-                    pos = np.nonzero(
-                        (np.asarray(entry["key_id"], dtype=np.int64)
-                         == int(key_id))
-                        & (np.asarray(entry["ns"], dtype=np.int64)
-                           == int(sids[i])))[0]
-                else:
-                    entry = sp.peek(int(sids[i]))
-                    if entry is None:
-                        continue
-                    pos = np.nonzero(np.asarray(
-                        entry["key_id"],
-                        dtype=np.int64) == int(key_id))[0]
-                if len(pos) == 0:
-                    continue
-                j = int(pos[0])
-                leaves = tuple(
-                    np.asarray(entry[f"leaf_{k}"], dtype=l.dtype)[j:j + 1]
-                    for k, l in enumerate(self.agg.leaves))
-                finished = self.agg.finish(leaves)
-                out[int(iv[1])] = {name: np.asarray(col).item()
-                                   for name, col in finished.items()}
-        W = sticky_bucket(len(sids), self._fire_bucket, minimum=64)
-        sm = np.zeros((self.P, W, 1), dtype=np.int32)
-        sm[shard, : len(sids), 0] = np.where(slots >= 0, slots, 0)
-        # ONE batched D2H for all result columns (a per-interval
-        # np.asarray would pay one round-trip per session AND column)
-        results = jax.device_get(
-            self._fire_step(self.accs, self._put_sharded(sm)))
-        for i, iv in enumerate(intervals):
-            if slots[i] < 0:
+        a batch of one (all reads route through :meth:`query_batch`)."""
+        return self.query_batch(
+            np.asarray([key_id], dtype=np.int64))[0]
+
+    def query_batch(self, key_ids) -> List[Dict[int, Dict[str, float]]]:
+        """Batched point lookup: one ``{session_end -> result columns}``
+        dict per requested key, request order. The keys' live sessions
+        come from the global host metadata; ALL resident accumulators of
+        the batch come back through ONE gather program + ONE batched
+        device read (the serving-plane cost model — a per-key fire paid
+        one dispatch + one D2H per request), cold sessions answer from
+        their shards' page tiers. Read-only — no residency change."""
+        key_ids = np.asarray(key_ids, dtype=np.int64)
+        n = len(key_ids)
+        results: List[Dict[int, Dict[str, float]]] = [
+            {} for _ in range(n)]
+        if n == 0:
+            return results
+        rows: List[Tuple[int, int, int]] = []  # (request row, sid, end)
+        for r in range(n):
+            for iv in self.meta.sessions.get(int(key_ids[r]), ()):
+                rows.append((r, int(iv[2]), int(iv[1])))
+        if not rows:
+            return results
+        m = len(rows)
+        rr = np.asarray([t[0] for t in rows], dtype=np.int64)
+        sids = np.asarray([t[1] for t in rows], dtype=np.int64)
+        keys_r = key_ids[rr]
+        shards = shard_records(keys_r, self.P,
+                               self.max_parallelism, self.key_group_range)
+        leaves = self.agg.leaves
+        leaf_rows = [np.full(m, l.identity, dtype=l.dtype)
+                     for l in leaves]
+        have = np.zeros(m, dtype=bool)
+        lanes: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        g_max = 0
+        cold: Dict[int, np.ndarray] = {}
+        for p in range(self.P):
+            sel = np.nonzero(shards == p)[0]
+            if not len(sel):
                 continue
-            out[int(iv[1])] = {name: col[shard][i].item()
-                               for name, col in results.items()}
-        return out
+            slots = self.indexes[p].lookup(keys_r[sel], sids[sel])
+            hit = slots >= 0
+            if hit.any():
+                lanes[p] = (sel[hit], slots[hit].astype(np.int32))
+                g_max = max(g_max, int(hit.sum()))
+            if (~hit).any() and self._spill_active:
+                cold[p] = sel[~hit]
+        if g_max:
+            G = pad_bucket_size(g_max, minimum=64)
+            block = np.zeros((self.P, G), dtype=np.int32)
+            for p, (_, hs) in lanes.items():
+                block[p, : len(hs)] = hs
+            gathered = self._gather_step(self.accs,
+                                         self._put_sharded(block))
+            g_host = jax.device_get(gathered)  # ONE batched D2H
+            for p, (sel_hit, hs) in lanes.items():
+                for i in range(len(leaves)):
+                    leaf_rows[i][sel_hit] = g_host[i][p][: len(hs)]
+                have[sel_hit] = True
+        # cold sessions: read their rows out of the page tier (host-only,
+        # one peek per touched page — see read_spilled_rows)
+        from flink_tpu.state.paged_spill import read_spilled_rows
+
+        def _take_row(j, entry, src):
+            for i, l in enumerate(leaves):
+                leaf_rows[i][j] = np.asarray(
+                    entry[f"leaf_{i}"], dtype=l.dtype)[src]
+            have[j] = True
+
+        for p, sel_cold in cold.items():
+            read_spilled_rows(
+                self.spills[p],
+                self._pmaps[p] if self._paged else None, self._paged,
+                [(j, int(keys_r[j]), int(sids[j]))
+                 for j in sel_cold.tolist()],
+                _take_row)
+        # one host finish over every found row at once
+        finished = self.agg.finish(tuple(leaf_rows))
+        cols = {name: np.asarray(col) for name, col in finished.items()}
+        for j, (r, _sid, end) in enumerate(rows):
+            if have[j]:
+                results[r][end] = {name: col[j].item()
+                                   for name, col in cols.items()}
+        return results
 
     # -------------------------------------------------------------- snapshot
 

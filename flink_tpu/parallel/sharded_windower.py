@@ -48,8 +48,11 @@ from flink_tpu.windowing.windower import WINDOW_END_FIELD, WINDOW_START_FIELD
 
 
 # Compiled step programs cached by (mesh devices, aggregate layout) so
-# repeated engines (warmup + measured runs, restarted jobs) share executables.
-_STEP_CACHE: Dict[tuple, tuple] = {}
+# repeated engines (warmup + measured runs, restarted jobs) AND
+# concurrent jobs on one mesh share executables — the cache lives in the
+# tenancy layer's SharedProgramCache (per-job hit/miss attribution; see
+# flink_tpu/tenancy/program_cache.py).
+from flink_tpu.tenancy.program_cache import PROGRAM_CACHE
 
 # Tiny non-donated slice dispatched after everything queued so far: its
 # readiness proves the device consumed every earlier host buffer (the
@@ -474,6 +477,51 @@ class MeshSpillSupport:
         from flink_tpu.autoscale.policy import key_imbalance
 
         return key_imbalance(self.shard_resident_rows())
+
+    # ---------------------------------------------------------- tenant quota
+
+    def enforce_resident_budget(self, max_total_rows: int) -> int:
+        """Quota backstop (flink_tpu.tenancy.quotas): evict this
+        engine's OWN coldest rows until its device-resident total is at
+        most ``max_total_rows`` — rows land in the engine's private
+        spill tier, exactly like steady-state eviction. Structural
+        isolation: the method only walks ``self``'s shards, so one
+        job's enforcement can never reclaim another job's rows.
+        Returns rows shed. Raises when no spill tier is configured
+        (nowhere to shed to — the ledger counts a quota violation)."""
+        from flink_tpu.state.slot_table import SlotTableFullError
+
+        if not self._spill_active:
+            raise RuntimeError(
+                "engine has no spill tier — a resident-row quota needs "
+                "state.slot-table.max-device-slots (+ spill dir) so "
+                "over-budget rows have somewhere to go")
+        max_total_rows = max(int(max_total_rows), 0)
+        if getattr(self, "_paged", False):
+            # the current batch's rows carry the live clock; the backstop
+            # runs between scheduling quanta, so advancing it makes every
+            # resident row evictable
+            self._touch_clock += 1
+        per = self.shard_resident_rows()
+        shed = 0
+        while sum(per) > max_total_rows:
+            p = int(np.argmax(np.asarray(per)))
+            if per[p] <= 0:
+                break
+            try:
+                if getattr(self, "_paged", False):
+                    self._evict_cold_paged(p)
+                else:
+                    self._evict_cold(p, protect=set())
+            except SlotTableFullError:
+                break
+            new = self.shard_resident_rows()
+            freed = sum(per) - sum(new)
+            if freed <= 0:
+                break
+            shed += freed
+            per = new
+        return shed
 
     # ------------------------------------------------- live rescale (reshard)
 
@@ -1558,74 +1606,99 @@ class MeshWindowEngine(MeshSpillSupport):
     # ---------------------------------------------------------- point query
 
     def query_windows(self, key_id: int) -> Dict[int, Dict[str, float]]:
-        """Queryable-state point lookup, mesh form: route the key to its
-        owning shard (the same key-group formula the data path uses), probe
-        that shard's host index, gather its slice accumulators off the
-        device (spilled slices read from the shard's spill tier), and
-        compose window results on host (slice sharing, as
-        SlotTable.query_windows). Read-only — no residency change."""
-        from flink_tpu.ops.segment_ops import HOST_COMBINE
+        """Queryable-state point lookup — a batch of one (the serving
+        plane routes ALL reads through :meth:`query_batch`)."""
+        return self.query_batch(
+            np.asarray([key_id], dtype=np.int64))[0]
 
-        shard = int(shard_records(
-            np.asarray([key_id], dtype=np.int64), self.P,
-            self.max_parallelism, self.key_group_range)[0])
-        idx = self.indexes[shard]
+    def query_batch(self, key_ids) -> List[Dict[int, Dict[str, float]]]:
+        """Batched point lookup, mesh form: every requested key routes to
+        its owning shard (the key-group formula the data path uses), the
+        whole batch's resident slice accumulators come back through ONE
+        gather program + ONE batched device read, spilled slices answer
+        from their shards' host tiers, and window results compose on host
+        (slice sharing, as SlotTable.query_windows). Read-only — no
+        residency change, no sticky-bucket mutation. One result dict
+        ({window_end -> columns}) per requested key, request order."""
+        from flink_tpu.windowing.windower import compose_windows
+
+        key_ids = np.asarray(key_ids, dtype=np.int64)
+        n = len(key_ids)
+        if n == 0:
+            return []
         leaves = self.agg.leaves
-        #: slice end -> per-leaf 1-element raw values for this key
-        slice_vals: Dict[int, Tuple[np.ndarray, ...]] = {}
-        live_ns = np.asarray([int(n) for n in idx.namespaces],
-                             dtype=np.int64)
-        if len(live_ns):
-            keys = np.full(len(live_ns), int(key_id), dtype=np.int64)
-            slots = idx.lookup(keys, live_ns)
+        shards = shard_records(key_ids, self.P,
+                               self.max_parallelism, self.key_group_range)
+        #: per request row: slice end -> per-leaf 1-element raw values
+        slice_vals: List[Dict[int, Tuple[np.ndarray, ...]]] = [
+            {} for _ in range(n)]
+        # resident probe: (requested keys on shard) x (live namespaces),
+        # all shards' hits land in one [P, G] gather block
+        lanes: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
+        g_max = 0
+        for p in range(self.P):
+            rows_p = np.nonzero(shards == p)[0]
+            if not len(rows_p):
+                continue
+            idx = self.indexes[p]
+            live_ns = np.asarray([int(x) for x in idx.namespaces],
+                                 dtype=np.int64)
+            if not len(live_ns):
+                continue
+            pk = np.repeat(key_ids[rows_p], len(live_ns))
+            pn = np.tile(live_ns, len(rows_p))
+            prow = np.repeat(rows_p, len(live_ns))
+            slots = idx.lookup(pk, pn)
             hit = slots >= 0
             if hit.any():
-                hs = slots[hit].astype(np.int32)
-                G = pad_bucket_size(len(hs), minimum=64)
-                block = np.zeros((self.P, G), dtype=np.int32)
-                block[shard, : len(hs)] = hs
-                gathered = self._gather_step(self.accs,
-                                             self._put_sharded(block))
-                g_host = [g[shard][: len(hs)]
-                          for g in jax.device_get(gathered)]
-                for j, ns in enumerate(n for n, h in zip(live_ns, hit)
-                                       if h):
-                    slice_vals[int(ns)] = tuple(
-                        g[j:j + 1] for g in g_host)
+                lanes[p] = (slots[hit].astype(np.int32), prow[hit],
+                            pn[hit])
+                g_max = max(g_max, int(hit.sum()))
+        if lanes:
+            G = pad_bucket_size(g_max, minimum=64)
+            block = np.zeros((self.P, G), dtype=np.int32)
+            for p, (hs, _, _) in lanes.items():
+                block[p, : len(hs)] = hs
+            gathered = self._gather_step(self.accs,
+                                         self._put_sharded(block))
+            g_host = jax.device_get(gathered)  # ONE batched D2H
+            for p, (hs, prow, pn) in lanes.items():
+                shard_leaves = [g[p] for g in g_host]
+                for j in range(len(hs)):
+                    slice_vals[int(prow[j])][int(pn[j])] = tuple(
+                        g[j:j + 1] for g in shard_leaves)
         if self._spill_active:
-            sp = self.spills[shard]
-            for ns in sp.namespaces:
-                entry = sp.peek(int(ns))
-                if entry is None:
+            for p in range(self.P):
+                rows_p = np.nonzero(shards == p)[0]
+                if not len(rows_p):
                     continue
-                pos = np.nonzero(np.asarray(
-                    entry["key_id"], dtype=np.int64) == int(key_id))[0]
-                if len(pos) == 0:
+                sp = self.spills[p]
+                if len(sp) == 0:
                     continue
-                j = int(pos[0])
-                slice_vals[int(ns)] = tuple(
-                    np.asarray(entry[f"leaf_{i}"], dtype=l.dtype)[j:j + 1]
-                    for i, l in enumerate(leaves))
-        if not slice_vals:
-            return {}
-        assigner = self.assigner
-        windows = sorted({
-            int(w)
-            for se in slice_vals
-            for w in assigner.window_ends_for_slice(se)})
-        out: Dict[int, Dict[str, float]] = {}
-        for w in windows:
-            acc = [np.full(1, l.identity, dtype=l.dtype) for l in leaves]
-            for se in assigner.slice_ends_for_window(w):
-                sv = slice_vals.get(int(se))
-                if sv is None:
-                    continue
-                acc = [HOST_COMBINE[l.reduce](a, v)
-                       for a, v, l in zip(acc, sv, leaves)]
-            finished = self.agg.finish(tuple(acc))
-            out[w] = {name: np.asarray(col).item()
-                      for name, col in finished.items()}
-        return out
+                want = key_ids[rows_p]
+                for ns in sp.namespaces:
+                    entry = sp.peek(int(ns))
+                    if entry is None:
+                        continue
+                    ek = np.asarray(entry["key_id"], dtype=np.int64)
+                    if not len(ek):
+                        continue
+                    order = np.argsort(ek, kind="stable")
+                    pos = np.searchsorted(ek[order], want)
+                    pos = np.minimum(pos, len(ek) - 1)
+                    ok = ek[order][pos] == want
+                    for j in np.nonzero(ok)[0].tolist():
+                        src = int(order[pos[j]])
+                        slice_vals[int(rows_p[j])][int(ns)] = tuple(
+                            np.asarray(entry[f"leaf_{i}"],
+                                       dtype=l.dtype)[src:src + 1]
+                            for i, l in enumerate(leaves))
+        results: List[Dict[int, Dict[str, float]]] = []
+        for r in range(n):
+            sv = slice_vals[r]
+            results.append(compose_windows(self.assigner, self.agg, sv)
+                           if sv else {})
+        return results
 
     # -------------------------------------------------------------- snapshot
 
@@ -1783,9 +1856,11 @@ def build_mesh_steps(mesh: Mesh, agg: AggregateFunction):
     the host so spilled slices can be combined there (the mesh form of
     SlotTable.fire_hybrid)."""
     cache_key = (tuple(d.id for d in mesh.devices.flat), agg.cache_key())
-    cached = _STEP_CACHE.get(cache_key)
-    if cached is not None:
-        return cached
+    return PROGRAM_CACHE.get_or_build(
+        "mesh-steps", cache_key, lambda: _build_mesh_steps(mesh, agg))
+
+
+def _build_mesh_steps(mesh: Mesh, agg: AggregateFunction):
     leaves = agg.leaves
     methods = tuple(SCATTER_METHOD[l.reduce] for l in agg.leaves)
     merges = tuple(MERGE_FN[l.reduce] for l in agg.leaves)
@@ -1933,9 +2008,6 @@ def build_mesh_steps(mesh: Mesh, agg: AggregateFunction):
             out_specs=(P(KEY_AXIS),) * n_leaves,
         )(*accs, slots, *values)
 
-    _STEP_CACHE[cache_key] = steps = (scatter_step, fire_step,
-                                      reset_step, gather_step,
-                                      put_step, merge_step,
-                                      valued_scatter_step)
-    return steps
+    return (scatter_step, fire_step, reset_step, gather_step,
+            put_step, merge_step, valued_scatter_step)
 

@@ -546,20 +546,36 @@ class WindowAggOperator(Operator):
 
     def query_state(self, key_value, namespace=None):
         """Queryable-state point lookup: {window_end -> result columns} for
-        one key — window values are composed from per-slice partial
-        accumulators, so sliding/cumulative windows return true window
-        results, not slice fragments (reference: queryable state KvState
-        lookup). Served on the task loop at a batch boundary, so reads are
-        race-free (single-owner discipline, like the reference's mailbox).
-        ``namespace`` restricts to one window end."""
+        one key — a batch of one (thin wrapper; every read routes through
+        :meth:`query_state_batch`, so a single lookup costs the same one
+        gather + one device read a batch does, never one RTT per key)."""
+        return self.query_state_batch([key_value], namespace)[0]
+
+    def query_state_batch(self, key_values, namespace=None):
+        """Batched queryable-state lookup: one {window_end -> result
+        columns} dict per requested key, request order — window values
+        composed from per-slice partial accumulators, so sliding/
+        cumulative windows return true window results, not slice
+        fragments (reference: queryable state KvState lookup). The whole
+        batch is served by ONE gather program + ONE device read (the
+        serving-plane contract). Served on the task loop at a batch
+        boundary, so reads are race-free (single-owner discipline, like
+        the reference's mailbox). ``namespace`` restricts every key to
+        one window end."""
         from flink_tpu.state.keygroups import hash_keys_to_i64
 
-        key_id = int(hash_keys_to_i64(np.asarray([key_value]))[0])
-        out = self.windower.query_windows(key_id)
+        key_ids = hash_keys_to_i64(np.asarray(key_values))
+        w = self.windower
+        if hasattr(w, "query_batch"):            # mesh engines
+            outs = w.query_batch(key_ids)
+        elif hasattr(w, "query_windows_batch"):  # slot-table windower
+            outs = w.query_windows_batch(key_ids)
+        else:                                    # pane layout: per key
+            outs = [w.query_windows(int(k)) for k in key_ids]
         if namespace is not None:
-            return ({int(namespace): out[int(namespace)]}
-                    if int(namespace) in out else {})
-        return out
+            ns = int(namespace)
+            outs = [({ns: out[ns]} if ns in out else {}) for out in outs]
+        return outs
 
     def restore_state(self, state, key_group_filter=None):
         if key_group_filter is not None:
@@ -698,26 +714,23 @@ class SessionWindowAggOperator(WindowAggOperator):
                 spill=table_kwargs)
         self._resolve_async_fires(ctx)
 
-    def query_state(self, key_value, namespace=None):
-        """Session variant: the key's live sessions are host metadata
-        ({key -> [(start, end, sid)]}); each session's accumulator lives
-        under its session id. Returns {session_end -> result columns}."""
+    def query_state_batch(self, key_values, namespace=None):
+        """Session variant: the keys' live sessions are host metadata
+        ({key -> [(start, end, sid)]}); their accumulators are read
+        through ONE gather + ONE device read for the whole batch. One
+        {session_end -> result columns} dict per key, request order."""
         from flink_tpu.state.keygroups import hash_keys_to_i64
 
-        key_id = int(hash_keys_to_i64(np.asarray([key_value]))[0])
+        key_ids = hash_keys_to_i64(np.asarray(key_values))
         w = self.windower
-        if hasattr(w, "query_sessions"):  # mesh engine
-            out = w.query_sessions(key_id)
-        else:
-            out = {}
-            for start, end, sid in w.sessions.get(key_id, []):
-                per_sid = w.table.query(key_id, namespace=sid)
-                if sid in per_sid:
-                    out[int(end)] = per_sid[sid]
+        if hasattr(w, "query_batch"):              # mesh engine
+            outs = w.query_batch(key_ids)
+        else:                                      # single-device engine
+            outs = w.query_sessions_batch(key_ids)
         if namespace is not None:
-            return ({int(namespace): out[int(namespace)]}
-                    if int(namespace) in out else {})
-        return out
+            ns = int(namespace)
+            outs = [({ns: out[ns]} if ns in out else {}) for out in outs]
+        return outs
 
 
 class UnionOperator(Operator):

@@ -313,6 +313,40 @@ def _peek_page(spill, page: int):
     return chaos.run_recoverable("spill.page_reload", attempt)
 
 
+def read_spilled_rows(spill, pmap: Optional[PagedSpillMap], paged: bool,
+                      wants, on_row) -> None:
+    """Serving-path cold read: resolve ``wants`` — an iterable of
+    ``(tag, key_id, ns)`` — against the tier, grouping by tier entry so
+    each page is peeked (and, for the fs tier, loaded from disk) ONCE
+    per batch, however many of the batch's rows it holds. Calls
+    ``on_row(tag, entry, src_row)`` for each row found. Read-only: no
+    residency change, no membership mutation. The ONE copy of the
+    miss-scan for both layouts — ``SlotTable.query_batch_pairs`` and
+    ``MeshSessionEngine.query_batch`` read through it."""
+    by_entry: Dict[int, list] = {}
+    for tag, key_id, ns in wants:
+        ek = (pmap.page_of(int(ns)) if paged
+              else (int(ns) if int(ns) in spill else None))
+        if ek is not None:
+            by_entry.setdefault(int(ek), []).append(
+                (tag, int(key_id), int(ns)))
+    for ek, rows in by_entry.items():
+        entry = spill.peek(ek)
+        if entry is None:
+            continue
+        entry_keys = np.asarray(entry["key_id"], dtype=np.int64)
+        entry_ns = (np.asarray(entry["ns"], dtype=np.int64)
+                    if paged else None)
+        for tag, key_id, ns in rows:
+            if paged:
+                pos = np.nonzero((entry_keys == key_id)
+                                 & (entry_ns == ns))[0]
+            else:
+                pos = np.nonzero(entry_keys == key_id)[0]
+            if len(pos):
+                on_row(tag, entry, int(pos[0]))
+
+
 def reload_rows_for(spill, pmap: PagedSpillMap, nss: np.ndarray,
                     leaf_dtypes: Sequence) -> Optional[
                         Tuple[np.ndarray, np.ndarray, np.ndarray,

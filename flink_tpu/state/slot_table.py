@@ -1607,6 +1607,54 @@ class SlotTable:
                 for i, l in enumerate(self.agg.leaves))
         return out
 
+    def query_batch_pairs(
+            self, key_ids: np.ndarray, namespaces: np.ndarray
+    ) -> Tuple[np.ndarray, List[np.ndarray]]:
+        """Raw accumulator leaves for N ``(key, namespace)`` pairs — the
+        serving-plane primitive: device-resident pairs read through ONE
+        gather kernel + ONE batched device read for the whole batch
+        (per-pair reads pay one link round-trip each — the TRC01 class),
+        spilled pairs from their host tiers. Returns ``(found, leaves)``
+        where ``found`` is the per-pair hit mask and ``leaves`` are
+        [N]-shaped per-leaf value arrays (identity where not found).
+        Read-only: no residency change, no sticky-bucket mutation."""
+        key_ids = np.asarray(key_ids, dtype=np.int64)
+        namespaces = np.asarray(namespaces, dtype=np.int64)
+        n = len(key_ids)
+        leaves_out = [np.full(n, l.identity, dtype=l.dtype)
+                      for l in self.agg.leaves]
+        found = np.zeros(n, dtype=bool)
+        if n == 0:
+            return found, leaves_out
+        slots = self.index.lookup(key_ids, namespaces)
+        hit = slots >= 0
+        if hit.any():
+            hs = slots[hit].astype(np.int32)
+            size = pad_bucket_size(len(hs), minimum=64)
+            gathered = self.agg._gather_jit(
+                self.accs, jnp.asarray(pad_i32(hs, size, fill=0)))
+            g_host = jax.device_get(gathered)  # ONE batched D2H
+            for i, g in enumerate(g_host):
+                leaves_out[i][hit] = g[:int(hit.sum())]
+            found |= hit
+        miss = np.nonzero(~hit)[0]
+        if len(miss) and (self._paged or len(self.spill)):
+            from flink_tpu.state.paged_spill import read_spilled_rows
+
+            def _take_row(j, entry, src):
+                for i, l in enumerate(self.agg.leaves):
+                    leaves_out[i][j] = np.asarray(
+                        entry[f"leaf_{i}"], dtype=l.dtype)[src]
+                found[j] = True
+
+            read_spilled_rows(
+                self.spill, self._pmap if self._paged else None,
+                self._paged,
+                [(j, int(key_ids[j]), int(namespaces[j]))
+                 for j in miss.tolist()],
+                _take_row)
+        return found, leaves_out
+
     def query_windows(self, key_id: int, assigner
                       ) -> Dict[int, Dict[str, float]]:
         """Point lookup composing WINDOW results from per-slice partial

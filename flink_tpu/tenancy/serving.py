@@ -1,0 +1,446 @@
+"""High-QPS queryable-state serving: coalesce lookups into device batches.
+
+The cost model of a point lookup against device-resident state is fixed:
+one gather program dispatch + ONE ``jax.device_get`` round trip (the
+flint TRC01 discipline). At serving QPS the only lever is AMORTIZATION:
+concurrent lookups for the same (job, operator) coalesce into one
+request batch, so a burst of N lookups pays one device round trip, not
+N. That is this module:
+
+- :class:`LookupCoalescer` — the generic client-side combiner: callers
+  from any thread enqueue ``(key, namespace)`` and block on their slice
+  of the batch result; the first enqueuer becomes the flusher after a
+  short window (or when the batch is full) and issues ONE batched call.
+- :class:`ServingPlane` — the cluster-side plane the tenancy session
+  cluster owns: per-(job, operator) coalescers whose flush posts a
+  :class:`~flink_tpu.cluster.local_executor.StateQueryBatchRequest` to
+  the job's control queue (served on the task loop at a batch boundary,
+  race-free), plus the serving metrics (lookups/s, batch sizes, p99).
+
+reference: flink-queryable-state's KvStateClientProxy pipelines requests
+per TM connection; here the pipeline depth becomes an explicit device
+batch, which is what the accelerator link rewards.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+def reservoir_p99_ms(latencies) -> float:
+    """p99 of a latency reservoir (ms); 0.0 when empty. Pays the one
+    sort, then reads through ``metrics.core.quantile_sorted`` — the
+    shared percentile-index formula (also the fire-latency p99's)."""
+    from flink_tpu.metrics.core import quantile_sorted
+
+    return quantile_sorted(sorted(latencies), 0.99)
+
+
+def lookup_stats_dict(lookups: int, batches: int,
+                      latencies) -> Dict[str, float]:
+    """The canonical serving-stats dict shape, built in ONE place (pays
+    the one p99 sort) — every aggregation path returns through here so
+    field names and avg_batch_size semantics cannot drift."""
+    return {
+        "lookups_total": lookups,
+        "lookup_batches_total": batches,
+        "avg_batch_size": lookups / batches if batches else 0.0,
+        "lookup_p99_ms": reservoir_p99_ms(latencies),
+    }
+
+
+def aggregate_lookup_stats(coalescers) -> Dict[str, float]:
+    """Merge coalescer counters + latency reservoirs into the canonical
+    serving-stats dict (one sort, for the p99). Reads go through each
+    coalescer's locked snapshot — client threads append concurrently,
+    and iterating a deque mid-append raises."""
+    lookups = 0
+    batches = 0
+    lat: List[float] = []
+    for c in coalescers:
+        n, b, ms = c.stats_snapshot()
+        lookups += n
+        batches += b
+        lat.extend(ms)
+    return lookup_stats_dict(lookups, batches, lat)
+
+
+class _Pending:
+    __slots__ = ("key", "namespace", "result", "error", "done")
+
+    def __init__(self, key, namespace):
+        self.key = key
+        self.namespace = namespace
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+
+
+class LookupCoalescer:
+    """Combine concurrent point lookups into batched flushes.
+
+    ``flush_fn(keys, namespace) -> list_of_results`` executes one device
+    batch. Entries sharing a namespace filter batch together; distinct
+    namespaces flush as separate batches within one drain (rare — the
+    common serving path passes ``namespace=None``).
+
+    ``window_ms`` — how long the first enqueuer waits for riders before
+    flushing (0 = flush immediately, still coalescing whatever arrived
+    concurrently); ``max_batch`` — flush early when full.
+    """
+
+    def __init__(self, flush_fn: Callable[[List[Any], Any], List[Any]],
+                 max_batch: int = 512, window_ms: float = 1.0):
+        self._flush_fn = flush_fn
+        self.max_batch = int(max_batch)
+        self.window_s = float(window_ms) / 1000.0
+        self._lock = threading.Lock()
+        self._queue: deque = deque()
+        self._flusher_active = False
+        #: served lookups / flush batches (the amortization evidence)
+        self.lookups_total = 0
+        self.batches_total = 0
+        #: bounded reservoir of per-lookup latencies (ms)
+        self.latencies_ms: deque = deque(maxlen=8192)
+        #: set by CoalescerPool.retire: post-retirement counts redirect
+        #: into the pool's retained totals, so a lookup racing a
+        #: retire (forget_job / unbind_job) is never silently dropped
+        #: from cumulative stats
+        self._fold_into = None
+
+    def _record(self, n_lookups: int = 0, batches: int = 0,
+                lat=()) -> None:
+        with self._lock:
+            sink = self._fold_into
+            if sink is None:
+                self.lookups_total += n_lookups
+                self.batches_total += batches
+                self.latencies_ms.extend(lat)
+                return
+        # release our lock before _absorb takes the pool's: no path
+        # ever holds both locks at once (retire also staggers them)
+        sink._absorb(n_lookups, batches, lat)
+
+    def lookup(self, key, namespace=None, timeout_s: float = 30.0):
+        """Enqueue one lookup and block until its batch lands."""
+        t0 = time.perf_counter()
+        entry = _Pending(key, namespace)
+        flush_now = False
+        with self._lock:
+            self._queue.append(entry)
+            if not self._flusher_active:
+                # first in line becomes the flusher for this window
+                self._flusher_active = True
+                flush_now = True
+        if flush_now:
+            if self.window_s > 0:
+                # ride-collection window: let concurrent callers pile on
+                deadline = time.monotonic() + self.window_s
+                while time.monotonic() < deadline:
+                    with self._lock:
+                        if len(self._queue) >= self.max_batch:
+                            break
+                    time.sleep(self.window_s / 4)
+            self._drain()
+        if not entry.done.wait(timeout_s):
+            raise TimeoutError("queryable-state lookup not served")
+        self._record(lat=((time.perf_counter() - t0) * 1e3,))
+        if entry.error is not None:
+            raise entry.error
+        return entry.result
+
+    def _drain(self) -> None:
+        """Flush everything queued, in (at most max_batch)-sized device
+        batches, grouped by namespace filter. Runs on the flusher's
+        thread; errors fan out to every rider of the failed batch."""
+        while True:
+            try:
+                while True:
+                    with self._lock:
+                        if not self._queue:
+                            break
+                        batch = [self._queue.popleft()
+                                 for _ in range(min(len(self._queue),
+                                                    self.max_batch))]
+                    by_ns: Dict[Any, List[_Pending]] = {}
+                    for e in batch:
+                        by_ns.setdefault(e.namespace, []).append(e)
+                    for ns, entries in by_ns.items():
+                        try:
+                            results = self._flush_fn(
+                                [e.key for e in entries], ns)
+                            if len(results) != len(entries):
+                                # a short reply must be an ERROR for
+                                # every rider — zip-truncating would
+                                # hand the tail result=None, which
+                                # reads as "key has no state"
+                                raise RuntimeError(
+                                    f"lookup flush returned "
+                                    f"{len(results)} results for "
+                                    f"{len(entries)} keys")
+                            for e, r in zip(entries, results):
+                                e.result = r
+                        except BaseException as err:  # noqa: BLE001
+                            for e in entries:
+                                e.error = err
+                        finally:
+                            self._record(n_lookups=len(entries),
+                                         batches=1)
+                            for e in entries:
+                                e.done.set()
+            except BaseException:
+                # release flusher duty before propagating: the next
+                # lookup() claims it and drains whatever is queued
+                with self._lock:
+                    self._flusher_active = False
+                raise
+            with self._lock:
+                if not self._queue:
+                    self._flusher_active = False
+                    return
+                # entries raced in after our last empty check: keep
+                # flusher duty and loop — a loop, not tail-recursion, so
+                # a one-rider-per-round arrival pattern cannot grow the
+                # stack
+
+    def stats_snapshot(self) -> Tuple[int, int, List[float]]:
+        """(lookups_total, batches_total, latencies) under the lock —
+        the only safe way to read the counters and the reservoir while
+        client threads serve."""
+        with self._lock:
+            return (self.lookups_total, self.batches_total,
+                    list(self.latencies_ms))
+
+    def note_batch(self, n_lookups: int, elapsed_ms: float) -> None:
+        """Record an externally-flushed batch (ServingPlane's explicit
+        ``lookup_batch`` path) against this coalescer's counters."""
+        self._record(n_lookups=n_lookups, batches=1, lat=(elapsed_ms,))
+
+    def p99_ms(self) -> float:
+        with self._lock:
+            lat = list(self.latencies_ms)
+        return reservoir_p99_ms(lat)
+
+
+class CoalescerPool:
+    """Per-key pool of :class:`LookupCoalescer`\\ s: double-checked
+    creation, retirement, cumulative stats. The ONE copy of the
+    coalescer lifecycle — the serving plane (keys = (job, operator))
+    and the queryable-state client share it, so the creation race,
+    retirement accounting, and stats shape can't drift between them.
+    Retired members fold their counters (and bounded latency
+    reservoirs) into retained totals, so cumulative stats survive
+    member churn (jobs finishing, clients forgetting)."""
+
+    def __init__(self, make_flush: Callable[[Any], Callable],
+                 max_batch: int = 512, window_ms: float = 1.0):
+        self._make_flush = make_flush
+        self._max_batch = int(max_batch)
+        self._window_ms = float(window_ms)
+        self._members: Dict[Any, LookupCoalescer] = {}
+        self._lock = threading.Lock()
+        self._retired_lookups = 0
+        self._retired_batches = 0
+        self._retired_lat: deque = deque(maxlen=8192)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._members)
+
+    def get(self, key) -> LookupCoalescer:
+        # fully locked (construction is cheap): an unlocked fast path
+        # would let get and retire interleave mid-read
+        with self._lock:
+            co = self._members.get(key)
+            if co is None:
+                co = self._members[key] = LookupCoalescer(
+                    self._make_flush(key),
+                    max_batch=self._max_batch,
+                    window_ms=self._window_ms)
+            return co
+
+    def retire(self, match: Callable[[Any], bool]) -> None:
+        with self._lock:
+            popped = [self._members.pop(k)
+                      for k in [k for k in self._members if match(k)]]
+        for co in popped:
+            # fold the counters AND flag the coalescer: a lookup that
+            # already holds a reference (raced the pop) records its
+            # counts into our retained totals via _record/_absorb —
+            # nothing is silently dropped from cumulative stats. Locks
+            # are taken one at a time (pool, then co, then pool again),
+            # never nested.
+            with co._lock:
+                n, b = co.lookups_total, co.batches_total
+                ms = list(co.latencies_ms)
+                co.lookups_total = 0
+                co.batches_total = 0
+                co.latencies_ms.clear()
+                co._fold_into = self
+            self._absorb(n, b, ms)
+
+    def _absorb(self, n_lookups: int, batches: int, lat) -> None:
+        with self._lock:
+            self._retired_lookups += n_lookups
+            self._retired_batches += batches
+            self._retired_lat.extend(lat)
+
+    def snapshot(self) -> List[LookupCoalescer]:
+        # under the lock: client threads insert concurrently, and dict
+        # iteration during an insert raises
+        with self._lock:
+            return list(self._members.values())
+
+    def lookups_total(self) -> int:
+        """One counter, one walk — what a per-scrape gauge reads."""
+        with self._lock:
+            n = self._retired_lookups
+        for c in self.snapshot():
+            with c._lock:
+                n += c.lookups_total
+        return n
+
+    def batches_total(self) -> int:
+        with self._lock:
+            n = self._retired_batches
+        for c in self.snapshot():
+            with c._lock:
+                n += c.batches_total
+        return n
+
+    def latencies(self) -> List[float]:
+        with self._lock:
+            lat: List[float] = list(self._retired_lat)
+        for c in self.snapshot():
+            lat.extend(c.stats_snapshot()[2])
+        return lat
+
+    def stats(self) -> Dict[str, float]:
+        """The canonical serving-stats dict, retained totals included
+        (pays the one p99 sort)."""
+        with self._lock:
+            lookups = self._retired_lookups
+            batches = self._retired_batches
+            lat = list(self._retired_lat)
+        for c in self.snapshot():
+            n, b, ms = c.stats_snapshot()
+            lookups += n
+            batches += b
+            lat.extend(ms)
+        return lookup_stats_dict(lookups, batches, lat)
+
+
+class ServingPlane:
+    """The session cluster's lookup surface: per-(job, operator)
+    coalescers flushing batched StateQueryBatchRequests onto the owning
+    job's control queue."""
+
+    def __init__(self, max_batch: int = 512, window_ms: float = 1.0,
+                 timeout_s: float = 30.0):
+        self.max_batch = int(max_batch)
+        self.window_ms = float(window_ms)
+        self.timeout_s = float(timeout_s)
+
+        def make_flush(key):
+            def flush(keys, namespace, _job=key[0], _op=key[1]):
+                return self._flush(_job, _op, keys, namespace)
+
+            return flush
+
+        self._pool = CoalescerPool(make_flush, max_batch=self.max_batch,
+                                   window_ms=self.window_ms)
+        #: job name -> control queue (bound by the session cluster)
+        self._queues: Dict[str, Any] = {}
+
+    def bind_job(self, job_name: str, control_queue) -> None:
+        self._queues[job_name] = control_queue
+
+    def unbind_job(self, job_name: str) -> None:
+        self._queues.pop(job_name, None)
+        # retire the job's coalescers: a cluster churning many short
+        # jobs would otherwise grow the pool (and its latency
+        # reservoirs, and every scrape's walk) per HISTORICAL job
+        self._pool.retire(lambda k: k[0] == job_name)
+
+    def _coalescer(self, job_name: str, operator: str) -> LookupCoalescer:
+        # bound-check BEFORE pool.get: a client still polling a finished
+        # job would otherwise re-create the retired coalescer (plus its
+        # latency reservoir) on every lookup, with no future unbind to
+        # retire it — the per-historical-job leak, deterministically
+        if job_name not in self._queues:
+            raise RuntimeError(
+                f"job {job_name!r} is not serving (not running, or "
+                "finished)")
+        co = self._pool.get((job_name, operator))
+        if job_name not in self._queues:
+            # unbind raced our get: retire what we may have re-created
+            self._pool.retire(lambda k: k == (job_name, operator))
+            raise RuntimeError(
+                f"job {job_name!r} is not serving (not running, or "
+                "finished)")
+        return co
+
+    def _flush(self, job_name: str, operator: str, keys, namespace):
+        from flink_tpu.cluster.local_executor import (
+            StateQueryBatchRequest,
+        )
+
+        q = self._queues.get(job_name)
+        if q is None:
+            raise RuntimeError(
+                f"job {job_name!r} is not serving (not running, or "
+                "finished)")
+        req = StateQueryBatchRequest(operator, keys, namespace)
+        q.put(req)
+        if self._queues.get(job_name) is not q:
+            # the job terminated between our bound-queue check and the
+            # put: the executor's terminal drain (and the cluster's
+            # post-unbind drain) may both have missed this request, and
+            # nothing will ever serve the dead queue — fail whatever is
+            # still on it (every entry is equally stranded) so riders
+            # get the prompt not-serving error instead of a timeout
+            import queue as _queue
+
+            while True:
+                try:
+                    stranded = q.get_nowait()
+                except _queue.Empty:
+                    break
+                stranded.finish(None, RuntimeError(
+                    f"job {job_name!r} is not serving (not running, or "
+                    "finished)"))
+        return req.wait(self.timeout_s)
+
+    def lookup(self, job_name: str, operator: str, key,
+               namespace=None):
+        """One point lookup; rides whatever batch is forming."""
+        return self._coalescer(job_name, operator).lookup(
+            key, namespace, timeout_s=self.timeout_s)
+
+    def lookup_batch(self, job_name: str, operator: str, keys,
+                     namespace=None) -> List[Any]:
+        """An explicit batch: bypasses the window, one request batch."""
+        co = self._coalescer(job_name, operator)
+        t0 = time.perf_counter()
+        out = self._flush(job_name, operator, list(keys), namespace)
+        co.note_batch(len(out), (time.perf_counter() - t0) * 1e3)
+        return out
+
+    # ---------------------------------------------------------------- metrics
+
+    def lookups_total(self) -> int:
+        """One counter, one walk — what the per-scrape gauge reads."""
+        return self._pool.lookups_total()
+
+    def lookup_batches_total(self) -> int:
+        return self._pool.batches_total()
+
+    def lookup_p99_ms(self) -> float:
+        """p99 over every coalescer's latency reservoir (pays one sort)."""
+        return reservoir_p99_ms(self._pool.latencies())
+
+    def metrics(self) -> Dict[str, float]:
+        return self._pool.stats()

@@ -24,7 +24,7 @@ end = last_event_ts + gap. Extensions/merges invalidate heap entries lazily
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, List
+from typing import Dict, List, Tuple
 
 import jax
 import numpy as np
@@ -118,6 +118,41 @@ class SessionWindower:
         """Paged spill traffic (pages/rows evicted+reloaded, rows split
         on reload); zeros when the table is unbounded."""
         return self.table.spill_counters()
+
+    # ---------------------------------------------------------- point query
+
+    def query_sessions_batch(self, key_ids):
+        """Batched point lookup: {session_end -> result columns} per
+        requested key. The keys' live sessions come from host metadata;
+        their accumulators are read through ONE gather kernel + ONE
+        device read for the whole batch (SlotTable.query_batch_pairs) —
+        spilled sessions answer from the page tier, read-only."""
+        key_ids = np.asarray(key_ids, dtype=np.int64)
+        n = len(key_ids)
+        results = [dict() for _ in range(n)]
+        rows: List[Tuple[int, int, int]] = []  # (request row, sid, end)
+        for r in range(n):
+            for _start, end, sid in self.meta.sessions.get(
+                    int(key_ids[r]), []):
+                rows.append((r, int(sid), int(end)))
+        if not rows:
+            return results
+        rr = np.asarray([t[0] for t in rows], dtype=np.int64)
+        sids = np.asarray([t[1] for t in rows], dtype=np.int64)
+        found, leaves = self.table.query_batch_pairs(key_ids[rr], sids)
+        finished = self.agg.finish(tuple(leaves))
+        cols = {name: np.asarray(col) for name, col in finished.items()}
+        for j, (r, _sid, end) in enumerate(rows):
+            if found[j]:
+                results[r][end] = {name: col[j].item()
+                                   for name, col in cols.items()}
+        return results
+
+    def query_sessions(self, key_id: int):
+        """Single-key form — a batch of one (same contract as
+        MeshSessionEngine.query_sessions)."""
+        return self.query_sessions_batch(
+            np.asarray([key_id], dtype=np.int64))[0]
 
     # ---------------------------------------------------------------- ingest
 

@@ -37,6 +37,36 @@ WINDOW_START_FIELD = "window_start"
 WINDOW_END_FIELD = "window_end"
 
 
+def compose_windows(assigner, agg, slice_vals: Dict[int, tuple]
+                    ) -> Dict[int, Dict[str, float]]:
+    """Slice sharing, host side: one key's ``{slice_end -> per-leaf
+    1-element raw accumulator arrays}`` composed into ``{window_end ->
+    finished result columns}`` (a sliding window's value = merge of its
+    k slices). The ONE copy of the serving-path compose loop —
+    ``SliceSharedWindower.query_windows_batch`` and
+    ``MeshWindowEngine.query_batch`` read through it, so window/slice
+    mapping semantics cannot drift between layouts."""
+    from flink_tpu.ops.segment_ops import HOST_COMBINE
+
+    leaves = agg.leaves
+    windows = sorted({
+        int(w) for se in slice_vals
+        for w in assigner.window_ends_for_slice(se)})
+    out: Dict[int, Dict[str, float]] = {}
+    for w in windows:
+        acc = [np.full(1, l.identity, dtype=l.dtype) for l in leaves]
+        for se in assigner.slice_ends_for_window(w):
+            v = slice_vals.get(int(se))
+            if v is None:
+                continue
+            acc = [HOST_COMBINE[l.reduce](a, x)
+                   for a, x, l in zip(acc, v, leaves)]
+        finished = agg.finish(tuple(acc))
+        out[w] = {name: np.asarray(col).item()
+                  for name, col in finished.items()}
+    return out
+
+
 class SliceSharedWindower:
     """Windowed keyed aggregation over one key-group range / device shard."""
 
@@ -246,6 +276,37 @@ class SliceSharedWindower:
         """Queryable-state point lookup: {window_end -> result columns} —
         same contract as MeshWindowEngine.query_windows."""
         return self.table.query_windows(key_id, self.assigner)
+
+    def query_windows_batch(self, key_ids) -> List[Dict[int, Dict[str, float]]]:
+        """Batched point lookup: one result dict per requested key, the
+        whole batch served by ONE gather kernel + ONE device read
+        (``SlotTable.query_batch_pairs`` over keys x live slices) —
+        the serving plane's per-request-batch cost model."""
+        key_ids = np.asarray(key_ids, dtype=np.int64)
+        n = len(key_ids)
+        if n == 0:
+            return []
+        if not hasattr(self.table, "query_batch_pairs"):
+            # pane/ring layout: no pair-gather primitive — per key
+            return [self.query_windows(int(k)) for k in key_ids]
+        live_ns = np.asarray([int(x) for x in self.table.namespaces],
+                             dtype=np.int64)
+        if len(live_ns) == 0:
+            return [{} for _ in range(n)]
+        pair_keys = np.repeat(key_ids, len(live_ns))
+        pair_ns = np.tile(live_ns, n)
+        found, leaves = self.table.query_batch_pairs(pair_keys, pair_ns)
+        agg = self.agg
+        results: List[Dict[int, Dict[str, float]]] = []
+        k = len(live_ns)
+        for r in range(n):
+            base = r * k
+            sv = {int(pair_ns[base + j]):
+                  tuple(l[base + j:base + j + 1] for l in leaves)
+                  for j in range(k) if found[base + j]}
+            results.append(compose_windows(self.assigner, agg, sv)
+                           if sv else {})
+        return results
 
     # ------------------------------------------------------------- snapshot
 

@@ -786,6 +786,49 @@ class TestRescaleHandoffPoint:
         # tests/test_autoscale.py's chaos crash test
 
 
+class TestServingLookupPoint:
+    """The serving plane's fault point, injected at its real site (the
+    batched queryable-state lookup wrapped in run_recoverable): a
+    transient fault retries in place — lookups are read-only, so a
+    retry cannot corrupt engine state (the full two-job serving-burst
+    exercise lives in tests/test_tenancy.py)."""
+
+    def test_serving_lookup_retries_at_real_site(self, tmp_path):
+        from flink_tpu.chaos.harness import run_crash_restore_verify_multi
+        from flink_tpu.parallel.mesh import make_mesh
+        from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+        from flink_tpu.windowing.aggregates import SumAggregate
+        from flink_tpu.windowing.sessions import SessionWindower
+
+        def mk_mesh():
+            return MeshSessionEngine(GAP, SumAggregate("v"),
+                                     make_mesh(2),
+                                     capacity_per_shard=1024)
+
+        def mk_oracle():
+            return SessionWindower(GAP, SumAggregate("v"))
+
+        rng = np.random.default_rng(0)
+        steps = []
+        for i in range(4):
+            ks = rng.integers(0, 50, 128)
+            steps.append((ks, np.ones(128, dtype=np.float32),
+                          i * 300 + np.sort(rng.integers(0, 200, 128)),
+                          i * 300 - 2 * GAP))
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="serving.lookup", nth=1,
+                      recoverable=True)])
+        reports = run_crash_restore_verify_multi(
+            make_engines={"j": mk_mesh}, make_oracles={"j": mk_oracle},
+            steps_by_job={"j": steps}, plan=plan, seed=3,
+            ckpt_root=str(tmp_path), serve_keys={"j": [1, 2, 3]})
+        r = reports["j"]
+        assert r.faults_injected.get("serving.lookup", 0) >= 1
+        assert r.retries >= 1 and r.recoveries >= 1
+        assert r.crashes == 0 and not r.diverged
+        _note_reached(r.faults_injected)
+
+
 class TestZZFaultPointReachability:
     """Must run LAST in this file (pytest preserves definition order):
     every fault point of the CANONICAL inventory was injected somewhere

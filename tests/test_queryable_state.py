@@ -116,6 +116,40 @@ class TestQueryableStateE2E:
         finally:
             cluster.shutdown()
 
+    def test_query_unknown_operator_fails_stage_parallel(self):
+        """Regression: the stage-parallel control path silently routed an
+        unknown operator name to stage 0 and answered [None]*n — "no
+        such operator" and "key has no state" must stay distinct errors,
+        matching the LocalExecutor path's KeyError."""
+        cluster = MiniCluster(Configuration({"rest.port": -1}))
+        try:
+            env = StreamExecutionEnvironment(Configuration(
+                {"execution.micro-batch.size": 256,
+                 "execution.stage-parallelism": 2}))
+            (env.add_source(
+                SlowDataGen(total_records=40_000, num_keys=4,
+                            events_per_second_of_eventtime=5_000),
+                WatermarkStrategy.for_bounded_out_of_orderness(0))
+                .key_by("key")
+                .window(TumblingEventTimeWindows.of(100_000))
+                .count().sink_to(DiscardingSink()))
+            client = cluster.submit(env, "qs-unknown-stages")
+            qs = QueryableStateClient(cluster)
+            deadline = time.monotonic() + 15
+            matched = False
+            while time.monotonic() < deadline:
+                try:
+                    with pytest.raises(KeyError, match="available"):
+                        qs.get_state_batch(client.job_id, "nope", [3, 4])
+                    matched = True
+                    break
+                except RuntimeError:
+                    time.sleep(0.05)
+            assert matched, "job never became queryable within deadline"
+            client.cancel()
+        finally:
+            cluster.shutdown()
+
     def test_query_unknown_operator_fails(self):
         cluster = MiniCluster(Configuration({"rest.port": -1}))
         try:
@@ -131,16 +165,81 @@ class TestQueryableStateE2E:
             client = cluster.submit(env, "qs-unknown")
             qs = QueryableStateClient(cluster)
             deadline = time.monotonic() + 15
+            matched = False
             while time.monotonic() < deadline:
                 try:
                     with pytest.raises(KeyError):
                         qs.get_state(client.job_id, "nope", 3)
+                    matched = True
                     break
                 except RuntimeError:
                     time.sleep(0.05)
+            assert matched, "job never became queryable within deadline"
             client.cancel()
         finally:
             cluster.shutdown()
+
+
+class TestClientCoalescerRetirement:
+    def test_forget_job_drops_coalescers_keeps_totals(self):
+        """Regression: a long-lived client querying many short-lived
+        jobs grew one coalescer (+ latency reservoir) per (job,
+        operator) forever; forget_job retires them with cumulative
+        stats intact."""
+        from flink_tpu.cluster.queryable_state import (
+            QueryableStateClient,
+        )
+
+        client = QueryableStateClient(cluster=None)
+        for i in range(4):
+            jid = f"job-{i}"
+            client._coalescer(jid, "op").note_batch(3, 1.0)
+            client.forget_job(jid)
+        assert len(client._pool) == 0
+        s = client.stats()
+        assert s["lookups_total"] == 12
+        assert s["lookup_batches_total"] == 4
+        assert s["avg_batch_size"] == 3.0
+
+    def test_lookup_racing_retire_folds_into_retained_totals(self):
+        """Regression: a lookup that already held the coalescer when
+        forget_job/unbind_job retired it recorded its counts on the
+        orphaned object — silently dropped from cumulative stats. A
+        retired coalescer now redirects post-retirement counts into the
+        pool's retained totals."""
+        from flink_tpu.cluster.queryable_state import (
+            QueryableStateClient,
+        )
+
+        client = QueryableStateClient(cluster=None)
+        co = client._coalescer("job", "op")
+        co.note_batch(2, 1.0)
+        client.forget_job("job")        # retires + folds: 2 lookups
+        co.note_batch(3, 1.0)           # in-flight rider lands late
+        assert len(client._pool) == 0   # not resurrected
+        s = client.stats()
+        assert s["lookups_total"] == 5  # nothing dropped
+        assert s["lookup_batches_total"] == 2
+
+    def test_explicit_batch_recorded_in_client_stats(self):
+        """Regression: get_state_batch bypassed the coalescer counters,
+        so a client doing only explicit batches (the documented
+        high-QPS shape) reported zero 'amortization evidence'."""
+        import types
+
+        from flink_tpu.cluster.queryable_state import (
+            QueryableStateClient,
+        )
+
+        gw = types.SimpleNamespace(
+            query_state_batch=lambda j, o, keys, ns: [{}] * len(keys))
+        cluster = types.SimpleNamespace(dispatcher_gateway=lambda: gw)
+        client = QueryableStateClient(cluster)
+        client.get_state_batch("j", "op", [1, 2, 3])
+        s = client.stats()
+        assert s["lookups_total"] == 3
+        assert s["lookup_batches_total"] == 1
+        assert s["avg_batch_size"] == 3.0
 
 
 class TestSlidingWindowQuery:

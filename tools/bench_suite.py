@@ -242,12 +242,38 @@ def row5b_mesh_sessions():
     return json.loads(lines[-1])
 
 
+def row6_queryable_lookups():
+    """High-QPS queryable-state serving: 2 concurrent jobs on one mesh,
+    client threads issuing 256-key batched point lookups (the tenancy
+    serving plane). Subprocess for the virtual-device flag, like the
+    mesh row."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.setdefault("SERVING_SMOKE_RECORDS",
+                   str(int(400_000 * SCALE)))
+    env.setdefault("SERVING_SMOKE_CLIENTS", "16")
+    env.setdefault("SERVING_SMOKE_LOOKUP_BATCH", "256")
+    env.setdefault("SERVING_SMOKE_KEYS", "4096")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "serving_smoke.py")],
+        capture_output=True, text=True, env=env, timeout=3600)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("{")]
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError((proc.stderr or proc.stdout).strip()[-300:])
+    return json.loads(lines[-1])
+
+
 ROWS = [("wordcount_socket", row1_wordcount),
         ("nexmark_q5", row2_q5),
         ("nexmark_q7", row3_q7),
         ("sql_hop_kafka", row4_sql_hop_kafka),
         ("sessions_10m_keys", row5_sessions_10m_keys),
-        ("mesh_sessions_10m_keys", row5b_mesh_sessions)]
+        ("mesh_sessions_10m_keys", row5b_mesh_sessions),
+        ("queryable_lookups", row6_queryable_lookups)]
 
 
 def main():
@@ -321,6 +347,14 @@ def main():
         "(NOTES_r6.md): `rows_split_on_reload` stays ~0 by design, and "
         "`tools/tier1.sh` gates on the page-rewrite amplification "
         "`(rows_split_on_reload + rows_compacted) / rows_reloaded`.")
+    lines.append("")
+    lines.append(
+        "The queryable-lookups row is `tools/serving_smoke.py` at bench "
+        "scale: two concurrent jobs share one mesh and the compiled-"
+        "program cache while client threads issue batched point lookups "
+        "against live keyed state; the tier-1 smoke runs the same "
+        "script smaller and FAILS on any steady-state compile, p99 over "
+        "budget, or quota violation (design note in NOTES_r10.md).")
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "BENCHMARKS.md")
     with open(out, "w") as f:

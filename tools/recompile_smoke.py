@@ -146,6 +146,63 @@ def check_engine(name, make, mesh, total, reps, budget):
     return ok
 
 
+def _drive_interleaved(engines, total, rep, serve_keys):
+    """Multiplex the same stream shape across N 'jobs' (one engine
+    each), the session cluster's interleave collapsed to its essence,
+    with a batched queryable-state lookup per engine per batch — the
+    serving path is part of the guarded steady state too."""
+    import numpy as np
+
+    fired = 0
+    last = 0
+    for rb, last in _batches(total, rep):
+        for eng in engines:
+            eng.process_batch(rb)
+            fired += sum(len(b) for b in eng.on_watermark(last - GAP_MS))
+            eng.query_batch(np.asarray(serve_keys, dtype=np.int64))
+    for eng in engines:
+        fired += sum(len(b)
+                     for b in eng.on_watermark(last + 100 * GAP_MS))
+    return fired
+
+
+def check_second_job_on_warm_cluster(mesh, total, budget):
+    """The tenancy contract: after job A warms the cluster (ingest,
+    fire, evict AND serving programs), a SECOND job's fresh engines on
+    the same mesh — interleaved with a third, plus concurrent batched
+    lookups — compile NOTHING."""
+    from flink_tpu.observe import RecompileSentinel
+    from flink_tpu.tenancy.program_cache import PROGRAM_CACHE
+
+    serve_keys = list(range(0, NUM_KEYS, NUM_KEYS // 16))
+    with PROGRAM_CACHE.job_scope("smoke-warm"):
+        warm_fired = _drive_interleaved(
+            [_make_sessions(mesh, budget)], total, rep=0,
+            serve_keys=serve_keys)
+    PROGRAM_CACHE.reset_stats()
+    ok = True
+    with PROGRAM_CACHE.job_scope("smoke-job2"):
+        with RecompileSentinel(
+                max_compiles=0,
+                max_transfers=max((total // BATCH) * 24, 64),
+                label="2 jobs on warm cluster") as s:
+            fired = _drive_interleaved(
+                [_make_sessions(mesh, budget),
+                 _make_sessions(mesh, budget)],
+                total, rep=1, serve_keys=serve_keys)
+    misses = PROGRAM_CACHE.stats_for("smoke-job2")["misses"]
+    print(f"  multi-tenant: fired={fired} compiles={s.compiles} "
+          f"transfers={s.transfers} cache_misses={misses}")
+    if fired == 0 or warm_fired == 0:
+        print("FAIL: multi-tenant: zero windows fired — vacuous run")
+        ok = False
+    if misses:
+        print(f"FAIL: multi-tenant: second job paid {misses} program-"
+              "cache misses on a warm cluster")
+        ok = False
+    return ok
+
+
 def main():
     import warnings
 
@@ -176,6 +233,12 @@ def main():
         except Exception as e:  # SteadyStateViolation included
             print(f"FAIL: {name}: {e}")
             ok = False
+    try:
+        ok = check_second_job_on_warm_cluster(
+            mesh, total, budgets["mesh-sessions"]) and ok
+    except Exception as e:  # SteadyStateViolation included
+        print(f"FAIL: multi-tenant: {e}")
+        ok = False
     print(f"recompile smoke: shards={P} records={total} reps={reps} "
           f"process_compiles={compile_count()} "
           f"=> {'OK' if ok else 'FAIL'}")

@@ -1,0 +1,221 @@
+"""Multi-tenant serving smoke: 2 jobs + concurrent lookup load (tier-1).
+
+The executable form of the tenancy acceptance criteria:
+
+1. **Warm phase** — job-1 runs alone on the session cluster and compiles
+   the step-program family.
+2. **Measured phase** — a FRESH cluster runs TWO fresh jobs (new engine
+   instances, same mesh/layout) under the recompile sentinel while
+   client threads hammer batched queryable-state lookups. The run FAILS
+   on:
+   - ANY steady-state XLA compile (the shared program cache must serve
+     both jobs — a cache key leaking engine/job identity compiles per
+     job and trips the sentinel),
+   - per-job program-cache misses > 0 (the diagnostic twin of the
+     sentinel signal),
+   - lookup p99 over budget (``SERVING_SMOKE_P99_BUDGET_MS``, default
+     500 ms on CPU — the coalescer + batched gather path must hold it
+     under concurrent load),
+   - any quota violation (job-2 runs under a resident-row quota with a
+     spill tier; enforcement must shed, never violate),
+   - zero served lookups (a vacuous run must not pass).
+
+Prints a JSON line with ``queryable_lookups_per_s`` — `tools/bench_suite.py`
+runs this script at bench scale for the BENCHMARKS.md serving row.
+
+    JAX_PLATFORMS=cpu python tools/serving_smoke.py
+    SERVING_SMOKE_RECORDS=... SERVING_SMOKE_CLIENTS=... to scale.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+RECORDS = int(os.environ.get("SERVING_SMOKE_RECORDS", 200_000))
+CLIENTS = int(os.environ.get("SERVING_SMOKE_CLIENTS", 8))
+KEYS = int(os.environ.get("SERVING_SMOKE_KEYS", 512))
+P99_BUDGET_MS = float(os.environ.get("SERVING_SMOKE_P99_BUDGET_MS", 500))
+QUOTA_ROWS = int(os.environ.get("SERVING_SMOKE_QUOTA_ROWS", 4096))
+#: keys per client request: 1 = coalesced point lookups (the smoke
+#: default), >1 = explicit request batches (the high-QPS bench shape —
+#: a serving frontend amortizes its fan-in into device batches)
+LOOKUP_BATCH = int(os.environ.get("SERVING_SMOKE_LOOKUP_BATCH", 1))
+#: client inter-request pause: models request interarrival AND keeps
+#: unthrottled client spin from GIL-starving the single scheduler
+#: thread (point-lookup mode is implicitly paced by the coalescer's
+#: ride-collection window; explicit batches are not)
+CLIENT_PAUSE_MS = float(os.environ.get(
+    "SERVING_SMOKE_CLIENT_PAUSE_MS", 5.0 if LOOKUP_BATCH > 1 else 0.0))
+
+
+def _pipeline(sink):
+    from flink_tpu.connectors.sources import DataGenSource
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.datastream.environment import StreamExecutionEnvironment
+    from flink_tpu.runtime.watermarks import WatermarkStrategy
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+    from flink_tpu.tenancy.quotas import TenantQuota
+
+    env = StreamExecutionEnvironment(Configuration({
+        "execution.micro-batch.size": 4096,
+        "parallelism.default": 4,
+        # spill tier sized to the quota's per-shard slice (so the quota
+        # has somewhere to shed and steady state stays under it)
+        "state.slot-table.max-device-slots": TenantQuota(
+            max_resident_rows=QUOTA_ROWS).per_shard_slots(4),
+    }))
+    (env.add_source(
+        DataGenSource(total_records=RECORDS, num_keys=KEYS,
+                      events_per_second_of_eventtime=50_000, seed=13),
+        WatermarkStrategy.for_bounded_out_of_orderness(0))
+        .key_by("key")
+        .window(TumblingEventTimeWindows.of(60_000))
+        .sum("value").sink_to(sink))
+    return env
+
+
+def main():
+    import warnings
+
+    warnings.filterwarnings("ignore")
+    from flink_tpu.connectors.sinks import CollectSink
+    from flink_tpu.observe import RecompileSentinel
+    from flink_tpu.tenancy.program_cache import PROGRAM_CACHE
+    from flink_tpu.tenancy.quotas import TenantQuota
+    from flink_tpu.tenancy.session_cluster import SessionCluster
+
+    operator = "window_agg(SumAggregate)"
+
+    def run_with_lookups(cluster, job_names, n_clients):
+        """Drive the cluster while client threads hammer lookups;
+        returns (elapsed_s, errors)."""
+        stop = threading.Event()
+        errors = []
+
+        def client(i):
+            import numpy as np
+
+            rng = np.random.default_rng(100 + i)
+            while not stop.is_set():
+                try:
+                    job = job_names[i % len(job_names)]
+                    if LOOKUP_BATCH > 1:
+                        cluster.lookup_batch(
+                            job, operator,
+                            rng.integers(0, KEYS,
+                                         LOOKUP_BATCH).tolist())
+                    else:
+                        cluster.lookup(job, operator,
+                                       int(rng.integers(0, KEYS)))
+                except RuntimeError as e:
+                    if ("is not serving" in str(e)
+                            or "already terminated" in str(e)):
+                        # both clean-shutdown shapes: the plane's
+                        # unbound-job error and the executor's
+                        # terminal control-queue drain
+                        return  # job finished: lookups drain off
+                    # any OTHER RuntimeError is a serving-path
+                    # regression: swallowing it here would kill every
+                    # client early while the gate still printed OK
+                    errors.append(f"client {i}: {e!r}")
+                    return
+                except TimeoutError:
+                    errors.append(f"client {i}: lookup timed out")
+                    return
+                if CLIENT_PAUSE_MS:
+                    time.sleep(CLIENT_PAUSE_MS / 1e3)
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True)
+                   for i in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        cluster.run(timeout_s=600)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        return time.perf_counter() - t0, errors
+
+    # ---- phase 1: job-1 warms the cluster — ingest, fire AND serving
+    # programs all compile here (compiles are expected)
+    warm = SessionCluster(quantum_records=8192)
+    warm.submit(_pipeline(CollectSink()), "job-1")
+    run_with_lookups(warm, ["job-1"], 2)
+
+    # ---- phase 2: two FRESH jobs on a fresh cluster + lookup load,
+    # zero compiles allowed
+    PROGRAM_CACHE.reset_stats()
+    cluster = SessionCluster(quantum_records=8192)
+    s2, s3 = CollectSink(), CollectSink()
+    cluster.submit(_pipeline(s2), "job-2",
+                   quota=TenantQuota(max_resident_rows=QUOTA_ROWS))
+    cluster.submit(_pipeline(s3), "job-3")
+    with RecompileSentinel(max_compiles=0,
+                           label="second job on warm cluster") as s:
+        elapsed, errors = run_with_lookups(
+            cluster, ["job-2", "job-3"], CLIENTS)
+
+    ok = True
+    if errors:
+        print(f"FAIL: {errors[:3]}")
+        ok = False
+    metrics = cluster.serving.metrics()
+    lookups = int(metrics["lookups_total"])
+    p99 = float(metrics["lookup_p99_ms"])
+    lookups_per_s = lookups / elapsed if elapsed > 0 else 0.0
+    for job in ("job-2", "job-3"):
+        misses = PROGRAM_CACHE.stats_for(job)["misses"]
+        if misses:
+            print(f"FAIL: {job} paid {misses} program-cache misses on a "
+                  "warm cluster (cache key leaking engine/job identity?)")
+            ok = False
+    if lookups == 0:
+        print("FAIL: zero lookups served — vacuous run")
+        ok = False
+    if p99 > P99_BUDGET_MS:
+        print(f"FAIL: lookup p99 {p99:.1f} ms over the "
+              f"{P99_BUDGET_MS:.0f} ms budget")
+        ok = False
+    viol = cluster.jobs["job-2"].ledger.quota_violations
+    if viol:
+        print(f"FAIL: {viol} quota violations on job-2")
+        ok = False
+    for name, sink in (("job-2", s2), ("job-3", s3)):
+        if len(sink.result()) == 0:
+            print(f"FAIL: {name} produced no output")
+            ok = False
+    print(json.dumps({
+        "metric": "queryable_lookups_per_s",
+        "value": round(lookups_per_s, 1),
+        "unit": "lookups/s",
+        "shape": f"{CLIENTS} client threads x "
+                 f"{'point lookups' if LOOKUP_BATCH == 1 else f'{LOOKUP_BATCH}-key request batches'} "
+                 f"against 2 concurrent jobs "
+                 f"({RECORDS} records each, mesh of 4) "
+                 f"— coalesced device batches "
+                 f"(avg {metrics['avg_batch_size']:.1f} lookups/batch), "
+                 f"p99 {p99:.1f} ms, 0 steady-state compiles "
+                 f"(compiles={s.compiles})",
+    }), flush=True)
+    print(f"serving smoke: lookups={lookups} "
+          f"batches={int(metrics['lookup_batches_total'])} "
+          f"p99={p99:.1f}ms compiles={s.compiles} quota_violations={viol} "
+          f"=> {'OK' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,7 +70,18 @@ if [ "${SKIP_BENCH_SMOKE:-0}" != "1" ]; then
   # ZERO XLA backend compiles and bounded device->host transfers —
   # jax.monitoring counts real compilations, so a jit identity or
   # padded shape varying per step fails here even though every
-  # correctness test still passes. ~15 s on CPU.
+  # correctness test still passes. Includes the multi-tenant phase: a
+  # SECOND job's fresh engines interleaved on the warm cluster (plus
+  # batched serving lookups) must also compile nothing. ~20 s on CPU.
   JAX_PLATFORMS=cpu timeout -k 10 300 \
     python tools/recompile_smoke.py || exit 1
+
+  # Serving smoke: 2 concurrent jobs on one mesh + client threads
+  # hammering coalesced queryable-state lookups. FAILS on any
+  # steady-state XLA compile after job-1 warms the shared program
+  # cache, on a per-job program-cache miss, on lookup p99 over budget,
+  # or on a quota violation. ~60 s on CPU.
+  SERVING_SMOKE_RECORDS=$((1 << 17)) \
+    JAX_PLATFORMS=cpu timeout -k 10 300 \
+    python tools/serving_smoke.py || exit 1
 fi
