@@ -25,6 +25,7 @@ None check).
 KNOWN_FAULT_POINTS = (
     "shuffle.bucket_prep",
     "shuffle.bucket_send",
+    "shuffle.device_exchange",
     "spill.page_reload",
     "spill.page_compact",
     "checkpoint.write",

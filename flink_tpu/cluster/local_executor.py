@@ -25,6 +25,7 @@ from flink_tpu.core.config import (
     CheckpointOptions,
     Configuration,
     CoreOptions,
+    DeploymentOptions,
     StateOptions,
 )
 from flink_tpu.chaos import injection as chaos
@@ -433,7 +434,9 @@ class LocalExecutor:
                                           BatchOptions.ASYNC_FIRES),
                                       max_dispatch_ahead=self.config.get(
                                           BatchOptions.MAX_DISPATCH_AHEAD),
-                                      memory_manager=memory_manager)
+                                      memory_manager=memory_manager,
+                                      shuffle_mode=self.config.get(
+                                          DeploymentOptions.SHUFFLE_MODE))
                 op.open(ctx)
             nodes[t.uid] = node
             g = job_group.add_group(f"{t.name}#{t.uid}")

@@ -1089,7 +1089,9 @@ class _KeyedSubtask(threading.Thread):
     def _run(self) -> None:
         ctx = OperatorContext(operator_index=self.index, parallelism=1,
                               max_parallelism=self.max_parallelism,
-                              memory_manager=self.memory_manager)
+                              memory_manager=self.memory_manager,
+                              shuffle_mode=self.config.get(
+                                  DeploymentOptions.SHUFFLE_MODE))
         if self.mesh_devices > 1:
             # mesh x stage composition: this subtask opens its keyed
             # engine over a private sub-mesh — subtasks distribute across

@@ -248,6 +248,16 @@ class DeploymentOptions:
         "subtask. Subtask expansion distributes across slots/hosts (the "
         "reference's distribution model); the sub-mesh distributes across "
         "chips within one subtask's jitted program (the SPMD model).")
+    SHUFFLE_MODE = ConfigOption(
+        "shuffle.mode", default="device", type=str,
+        description="keyBy data plane for the mesh engines: 'device' "
+        "(default) computes shard routing, segment sort and the record "
+        "exchange INSIDE the compiled program (one flat device_put + "
+        "all_to_all over the mesh axis, fused with the aggregate "
+        "scatter — keyBy -> window -> aggregate is one XLA program); "
+        "'host' keeps the explicit fallback: [shards, B] bucketing in "
+        "host numpy + a sharded device_put per block. See "
+        "flink_tpu/parallel/shuffle.py.")
     SHUFFLE_SERVICE = ConfigOption(
         "shuffle.service", default="local", type=str,
         description="Registered ShuffleService transport connecting "

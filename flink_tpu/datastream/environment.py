@@ -15,6 +15,7 @@ from typing import Any, Iterable, List, Optional, Sequence
 from flink_tpu.core.config import (
     BatchOptions,
     CheckpointOptions,
+    DeploymentOptions,
     Configuration,
     CoreOptions,
     StateOptions,
@@ -92,6 +93,12 @@ class StreamExecutionEnvironment:
     def window_layout(self) -> str:
         """state.window-layout: 'slots' | 'panes' | 'auto'."""
         return self.config.get(StateOptions.WINDOW_LAYOUT)
+
+    @property
+    def shuffle_mode(self) -> str:
+        """shuffle.mode: 'device' (in-program keyBy exchange, default)
+        | 'host' (explicit [shards, B] bucketing fallback)."""
+        return self.config.get(DeploymentOptions.SHUFFLE_MODE)
 
     @property
     def state_backend(self) -> str:

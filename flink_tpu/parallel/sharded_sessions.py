@@ -42,7 +42,12 @@ from flink_tpu.parallel.sharded_windower import (
     build_mesh_steps,
 )
 from flink_tpu.tenancy.program_cache import PROGRAM_CACHE
-from flink_tpu.parallel.shuffle import bucket_by_shard, shard_records
+from flink_tpu.parallel.shuffle import (
+    bucket_by_shard,
+    build_exchange_scatter,
+    shard_records,
+    stage_device_exchange,
+)
 from flink_tpu.state.keygroups import assign_key_groups
 from flink_tpu.windowing.aggregates import AggregateFunction
 from flink_tpu.windowing.session_meta import MergeGroup, SessionIntervalSet
@@ -112,9 +117,11 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         memory=None,
         spill_layout: str = "pages",
         max_dispatch_ahead: int = 2,
+        shuffle_mode: str = "device",
     ) -> None:
         self.gap = int(gap)
         self.agg = agg
+        self.shuffle_mode = self._check_shuffle_mode(shuffle_mode)
         #: dispatch-ahead depth: how many batches' device work may be in
         #: flight while the host preps the next (double-buffered by
         #: default; see MeshSpillSupport._init_pipeline)
@@ -170,7 +177,9 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         self._build_steps()
         self.meta = SessionIntervalSet(self.gap, self.allowed_lateness)
         self._dirty = np.zeros((self.P, self.capacity), dtype=bool)
-        self._freed_ns: List[int] = []
+        #: freed-session tombstone chunks (int64 arrays, deduped at
+        #: snapshot time — per-fire tolist round-trips were measurable)
+        self._freed_ns: List[np.ndarray] = []
         self._merge_bucket = 0
         self._fire_bucket = 0
         self._reset_bucket = 0
@@ -185,6 +194,11 @@ class MeshSessionEngine(MeshPagedSpillSupport):
          self._gather_step, self._put_step, self._merge_leaves_step,
          self._valued_scatter_step) = build_mesh_steps(self.mesh, self.agg)
         self._merge_step = build_session_merge_step(self.mesh, self.agg)
+        # fused exchange+scatter (device shuffle mode) — built through
+        # the shared program cache regardless of mode (cheap closure;
+        # compiles lazily on first use)
+        self._exchange_scatter_step = build_exchange_scatter(
+            self.mesh, self.agg, valued=False)
 
     def _shard_index_grew(self, new_capacity: int) -> None:
         """Uniform-SPMD grow: widen [P, capacity] arrays to the largest
@@ -221,17 +235,40 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         ts = np.asarray(batch.timestamps, dtype=np.int64)
         keys = np.asarray(batch.key_ids, dtype=np.int64)
         if self._spill_active and n > 1:
-            # bound one batch's per-shard session working set by the
-            # budget: unique keys upper-bounds touched sessions; halving
-            # is safe because absorb_batch is incremental
+            # bound one batch's PER-SHARD session working set by the
+            # budget (the budget is per device): unique keys per shard
+            # upper-bounds the touched sessions there; halving is safe
+            # because absorb_batch is incremental. The bound used to
+            # compare GLOBAL uniques against the per-shard budget,
+            # splitting every batch whose key cardinality exceeded one
+            # shard's slots even though each shard only sees ~1/P of
+            # them — at the 10M-key bench shape that halved every batch
+            # and doubled the per-batch host fixed costs (absorb, slot
+            # resolution, dispatch).
             budget = max(self.max_device_slots // 2, 1024)
-            if len(np.unique(keys)) > budget:
-                half = np.zeros(n, dtype=bool)
-                half[: n // 2] = True
-                self.process_batch(batch.filter(half))
-                self.process_batch(batch.filter(~half))
-                return
+            if n > budget:
+                # cheapest sufficient bound first: per-shard RECORD
+                # counts dominate per-shard uniques (one hash pass, no
+                # sort); only a shard actually over the record bound
+                # pays the np.unique refinement
+                rec_per_shard = np.bincount(
+                    shard_records(keys, self.P, self.max_parallelism,
+                                  self.key_group_range),
+                    minlength=self.P)
+                if int(rec_per_shard.max()) > budget:
+                    uniq = np.unique(keys)
+                    per_shard = np.bincount(
+                        shard_records(uniq, self.P, self.max_parallelism,
+                                      self.key_group_range),
+                        minlength=self.P)
+                    if int(per_shard.max()) > budget:
+                        half = np.zeros(n, dtype=bool)
+                        half[: n // 2] = True
+                        self.process_batch(batch.filter(half))
+                        self.process_batch(batch.filter(~half))
+                        return
 
+        sid_floor = self.meta.sid_watermark  # sids below exist already
         sess_key, sess_sid, rec_to_sess, order, groups = \
             self.meta.absorb_batch(keys, ts)
         for g in groups:
@@ -258,9 +295,25 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             per_shard_sel[p] = (sess_shard == p) & live_sess
         slot_of_sess = np.zeros(m, dtype=np.int32)
         if self._paged:
-            resolved = self._resolve_slots_paged({
-                p: (sess_key[sel], sess_sid[sel])
-                for p, sel in per_shard_sel.items()})
+            # sessions CREATED by this absorb (sid >= the pre-absorb
+            # allocator watermark) cannot be resident or paged — the
+            # resolve skips their index probe and page query. A fresh
+            # sid that was a MERGE DESTINATION is excluded: the merge
+            # group already inserted it (older touch clock), and
+            # skipping its probe would leave it eviction-unprotected
+            # inside this very resolve.
+            sess_fresh = sess_sid >= sid_floor
+            if groups:
+                merged_dst = np.unique(np.concatenate(
+                    [np.asarray(g.sids_dst, dtype=np.int64)
+                     for g in groups]))
+                if len(merged_dst):
+                    sess_fresh &= ~np.isin(sess_sid, merged_dst)
+            resolved = self._resolve_slots_paged(
+                {p: (sess_key[sel], sess_sid[sel])
+                 for p, sel in per_shard_sel.items()},
+                fresh={p: sess_fresh[sel]
+                       for p, sel in per_shard_sel.items()})
             for p, sel in per_shard_sel.items():
                 slot_of_sess[sel] = resolved[p]
                 self._dirty[p, resolved[p]] = True
@@ -288,24 +341,38 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         in_leaves = self.agg.input_leaves
         # pipelining: claim a dispatch slot BEFORE rewriting the pooled
         # staging buffers (their previous consumer must have finished),
-        # then bucket batch k+1 while the device still runs batch k
+        # then stage batch k+1 while the device still runs batch k
         self._await_dispatch_slot()
         self._shuffle_pool.flip()
-        counts, blocked, _ = bucket_by_shard(
-            rec_shards, self.P,
-            columns=[np.asarray(rec_slots, dtype=np.int32),
-                     *[np.asarray(v, dtype=l.dtype)
-                       for v, l in zip(values, in_leaves)]],
-            fills=[0, *[l.identity for l in in_leaves]],
-            pool=self._shuffle_pool,
-        )
-        slot_block = blocked[0]
-        value_blocks = blocked[1:]
-        self.accs = self._scatter_step(
-            self.accs,
-            self._put_sharded(slot_block),
-            tuple(self._put_sharded(v) for v in value_blocks),
-        )
+        columns = [np.asarray(rec_slots, dtype=np.int32),
+                   *[np.asarray(v, dtype=l.dtype)
+                     for v, l in zip(values, in_leaves)]]
+        fills = [0, *[l.identity for l in in_leaves]]
+        if self.shuffle_mode == "device":
+            dst, staged, width = stage_device_exchange(
+                rec_shards, self.P, columns=columns, fills=fills,
+                pool=self._shuffle_pool)
+            with self._device_span():
+                # ONE host->device hop: all flat columns in a single
+                # device_put, then the fused exchange+scatter program
+                put = jax.device_put((dst, *staged), self._sharding)
+                self.accs = self._exchange_scatter_step(
+                    self.accs, put[0], put[1], tuple(put[2:]), width)
+            # "crash mid-batch after the fused dispatch" — the scatter
+            # is on the device queue, the host dies before the fence
+            chaos.fault_point("shuffle.device_exchange", records=n)
+        else:
+            counts, blocked = bucket_by_shard(
+                rec_shards, self.P, columns=columns, fills=fills,
+                pool=self._shuffle_pool)
+            slot_block = blocked[0]
+            value_blocks = blocked[1:]
+            with self._device_span():
+                self.accs = self._scatter_step(
+                    self.accs,
+                    self._put_sharded(slot_block),
+                    tuple(self._put_sharded(v) for v in value_blocks),
+                )
         self._push_dispatch_fence()
 
     def _run_merge_group(self, g: MergeGroup) -> None:
@@ -360,13 +427,14 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         for p, (d_slots, s_slots) in enumerate(per_shard):
             dst_block[p, : len(d_slots)] = d_slots
             src_block[p, : len(s_slots)] = s_slots
-        self.accs = self._merge_step(
-            self.accs, self._put_sharded(dst_block),
-            self._put_sharded(src_block))
+        with self._device_span():
+            self.accs = self._merge_step(
+                self.accs, self._put_sharded(dst_block),
+                self._put_sharded(src_block))
         # absorbed host slots reusable now that the kernel moved the values;
         # record tombstones so delta snapshots drop the absorbed rows
-        self._freed_ns.extend(
-            np.asarray(g.absorbed_sids, dtype=np.int64).tolist())
+        self._freed_ns.append(
+            np.asarray(g.absorbed_sids, dtype=np.int64))
         if self._track_ns:
             self._drop_spilled(g.absorbed_sids)
             for p in range(self.P):
@@ -395,7 +463,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
     def on_watermark(self, watermark: int,
                      async_ok: bool = False) -> List[RecordBatch]:
         keys, starts, ends, sids = self.meta.pop_fired(watermark)
-        if not keys:
+        if not len(keys):
             return []
         if self._spill_active:
             # a catch-up fire can exceed the device budget; chunking keeps
@@ -464,7 +532,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         # reset fired slots + free their index entries; the donated
         # reset is device-queue-ordered BEHIND the fire kernel, so a
         # deferred (async) host read never races it
-        self._freed_ns.extend(sid_arr.tolist())
+        self._freed_ns.append(sid_arr)
         rb = np.zeros((self.P, W), dtype=np.int32)
         for p, slots in enumerate(per_shard_slots):
             rb[p, : len(slots)] = slots
@@ -530,7 +598,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
 
         leaves = self.agg.leaves
         n = len(k_arr)
-        self._freed_ns.extend(sid_arr.tolist())
+        self._freed_ns.append(sid_arr)
         leaf_dtypes = [l.dtype for l in leaves]
         res_pos: List[np.ndarray] = []   # positions fired on device
         res_slots: List[np.ndarray] = []
@@ -771,7 +839,8 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                                & used)[0].astype(np.int32)
             per_shard.append(dirty)
             g_max = max(g_max, len(dirty))
-        freed = np.asarray(sorted(set(self._freed_ns)), dtype=np.int64)
+        freed = (np.unique(np.concatenate(self._freed_ns))
+                 if self._freed_ns else np.empty(0, dtype=np.int64))
         if g_max == 0:
             out = {
                 "__delta__": np.asarray(True),

@@ -21,6 +21,7 @@ stages or doing global two-phase aggregation.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -38,7 +39,12 @@ from flink_tpu.ops.segment_ops import (
     sticky_bucket,
 )
 from flink_tpu.parallel.mesh import KEY_AXIS, shard_map
-from flink_tpu.parallel.shuffle import bucket_by_shard, shard_records
+from flink_tpu.parallel.shuffle import (
+    bucket_by_shard,
+    build_exchange_scatter,
+    shard_records,
+    stage_device_exchange,
+)
 from flink_tpu.state.keygroups import assign_key_groups
 from flink_tpu.state.slot_table import HostSlotIndex
 from flink_tpu.windowing.aggregates import AggregateFunction
@@ -60,6 +66,23 @@ from flink_tpu.tenancy.program_cache import PROGRAM_CACHE
 _FENCE_STEP = jax.jit(lambda a: a[:1, :1])
 
 
+class _DeviceSpan:
+    """Times a device-interaction block into the owner's
+    ``device_inline_s`` (see MeshSpillSupport._init_pipeline)."""
+
+    __slots__ = ("_owner", "_t0")
+
+    def __init__(self, owner) -> None:
+        self._owner = owner
+
+    def __enter__(self) -> "_DeviceSpan":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._owner.device_inline_s += time.perf_counter() - self._t0
+
+
 class MeshSpillSupport:
     """Per-shard spill tier shared by the mesh window and mesh session
     engines: LRU namespace eviction under a per-device slot budget, batched
@@ -71,6 +94,18 @@ class MeshSpillSupport:
     #: (MemoryManager, owner) — managed accounting of the [P, capacity]
     #: device footprint (flink_tpu/core/memory.py); None = unmanaged
     _memory = None
+    #: the ingest data plane: "device" routes records through the fused
+    #: in-program exchange (one flat device_put + all_to_all + scatter
+    #: in ONE compiled program), "host" through the [P, B] bucketing +
+    #: sharded device_put (the explicit fallback — see parallel.shuffle)
+    shuffle_mode: str = "device"
+
+    @staticmethod
+    def _check_shuffle_mode(mode: str) -> str:
+        if mode not in ("host", "device"):
+            raise ValueError(
+                f"shuffle_mode must be 'host' or 'device', got {mode!r}")
+        return mode
 
     def _reserve_rows(self, rows: int) -> None:
         if self._memory is not None:
@@ -131,6 +166,26 @@ class MeshSpillSupport:
         self._shuffle_pool = ShuffleBufferPool(
             generations=self._pipeline_depth)
         self._dispatch_fences = deque()
+        #: wall seconds the host spent BLOCKED on dispatch fences (the
+        #: in-flight device work the pipeline could not hide) — the
+        #: bench reads this to attribute fence waits to device time
+        #: instead of host prep; survives reshard like the counters
+        if not hasattr(self, "pipeline_wait_s"):
+            self.pipeline_wait_s = 0.0
+        #: wall seconds spent INSIDE device interactions on the ingest
+        #: path (H2D puts, the fused exchange / scatter / merge / put
+        #: dispatches, eviction gathers + their D2H reads). On an
+        #: async accelerator link these overlap host prep; on the CPU
+        #: backend they execute inline, so the bench subtracts them
+        #: from process_batch wall time to report genuine host prep.
+        if not hasattr(self, "device_inline_s"):
+            self.device_inline_s = 0.0
+
+    def _device_span(self) -> "_DeviceSpan":
+        """Context manager accumulating into ``device_inline_s`` —
+        a slotted object, not a per-call generator (this sits on the
+        per-batch path the host-prep gate measures)."""
+        return _DeviceSpan(self)
 
     def make_fence(self):
         """A tiny non-donated device value enqueued AFTER everything
@@ -142,11 +197,15 @@ class MeshSpillSupport:
     def _await_dispatch_slot(self) -> None:
         """Block until < depth dispatches are outstanding. MUST run
         before this batch's staging buffers are (re)written."""
+        if len(self._dispatch_fences) < self._pipeline_depth:
+            return
+        t0 = time.perf_counter()
         while len(self._dispatch_fences) >= self._pipeline_depth:
             # flint: disable=TRC01 -- the depth-bounded fence drain IS
             # the dispatch-ahead backpressure point: it blocks only when
             # the host ran a full pipeline depth ahead of the device
             self._dispatch_fences.popleft().block_until_ready()
+        self.pipeline_wait_s += time.perf_counter() - t0
 
     def _push_dispatch_fence(self) -> None:
         # chaos: a fence failure mid-dispatch-ahead — the batch's device
@@ -576,8 +635,6 @@ class MeshSpillSupport:
         the failover path is checkpoint-restore-at-new-parallelism,
         exactly how the chaos harness recovers.
         """
-        import time as _time
-
         new_shards = int(new_shards)
         if new_shards < 1:
             raise ValueError(f"new_shards must be >= 1, got {new_shards}")
@@ -602,7 +659,7 @@ class MeshSpillSupport:
             raise ValueError(
                 f"cannot reshard to {new_shards} shards: only "
                 f"{len(jax.devices())} devices exist")
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         # quiesce: prove the device consumed every staged host buffer
         # before the staging pool and the accumulator plane are replaced
         while self._dispatch_fences:
@@ -625,7 +682,7 @@ class MeshSpillSupport:
             "rows_moved": int(len(rows["key_id"])),
             "resident_rows": resident_rows,
             "spilled_rows": spilled_rows,
-            "seconds": _time.perf_counter() - t0,
+            "seconds": time.perf_counter() - t0,
         }
         return self.last_reshard
 
@@ -945,13 +1002,22 @@ class MeshPagedSpillSupport(MeshSpillSupport):
         return out
 
     def _resolve_slots_paged(
-            self, per_shard: Dict[int, Tuple[np.ndarray, np.ndarray]]
+            self, per_shard: Dict[int, Tuple[np.ndarray, np.ndarray]],
+            fresh: Optional[Dict[int, np.ndarray]] = None,
     ) -> Dict[int, np.ndarray]:
         """Batched slot resolution over shards with page reload and
         cohort eviction: resident rows of THIS batch get a fresh clock
         (protecting them from the eviction the batch itself triggers),
         missing pairs reload by page (ONE put program for all shards),
         then only the still-missing pairs insert.
+
+        ``fresh``: optional per-shard bool masks marking pairs the
+        caller KNOWS were allocated this batch (fresh session ids from
+        the monotonic allocator) — they cannot be resident or paged, so
+        they skip both the index probe and the page query and go
+        straight to insert. At high-cardinality shapes most of a
+        batch's sessions are fresh, and the skipped page query is a
+        sorted-match over the full spilled-row map.
 
         Callers pass session-shaped pairs (one row per globally-unique
         sid), so no dedup pass runs here and the insert probe is
@@ -973,7 +1039,15 @@ class MeshPagedSpillSupport(MeshSpillSupport):
             keys = np.asarray(keys, dtype=np.int64)
             nss = np.asarray(nss, dtype=np.int64)
             idx = self.indexes[p]
-            pre = idx.lookup(keys, nss)
+            fr = fresh.get(p) if fresh is not None else None
+            if fr is not None and fr.any():
+                pre = np.full(len(keys), -1, dtype=np.int32)
+                probe = ~fr
+                if probe.any():
+                    pre[probe] = idx.lookup(keys[probe], nss[probe])
+            else:
+                pre = idx.lookup(keys, nss)
+                fr = None
             hit = pre >= 0
             self._slot_touch[p][pre[hit]] = clock
             missing = ~hit
@@ -981,9 +1055,13 @@ class MeshPagedSpillSupport(MeshSpillSupport):
             if n_missing:
                 if len(self._pmaps[p]):
                     # pure host work: rows leave their pages by index
-                    # (lazy tombstones — see paged_spill)
+                    # (lazy tombstones — see paged_spill); fresh pairs
+                    # are never spilled, so only the non-fresh misses
+                    # query the page map
+                    q = missing if fr is None else (missing & ~fr)
                     rl = reload_rows_for(self.spills[p], self._pmaps[p],
-                                         nss[missing], leaf_dtypes)
+                                         nss[q], leaf_dtypes) \
+                        if q.any() else None
                     if rl is not None:
                         extracted[p] = rl
                 missing_by_shard[p] = missing
@@ -1015,9 +1093,10 @@ class MeshPagedSpillSupport(MeshSpillSupport):
                 slot_block[p, :n] = rslots
                 for i in range(len(val_blocks)):
                     val_blocks[i][p, :n] = rvals[i]
-            self.accs = self._put_step(
-                self.accs, self._put_sharded(slot_block),
-                tuple(self._put_sharded(v) for v in val_blocks))
+            with self._device_span():
+                self.accs = self._put_step(
+                    self.accs, self._put_sharded(slot_block),
+                    tuple(self._put_sharded(v) for v in val_blocks))
         for p, missing in missing_by_shard.items():
             keys, nss = per_shard[p]
             # insert ONLY the pre-lookup misses (reloaded rows resolve
@@ -1064,7 +1143,12 @@ class MeshPagedSpillSupport(MeshSpillSupport):
                 f"shard {p}: device slot budget exhausted and every "
                 "resident row was touched by the current batch — raise "
                 "state.slot-table.max-device-slots or reduce batch size")
-        target = min(max(idx.capacity // 8, 1024), len(evictable))
+        # a quarter of the table per round: every round pays one
+        # gather + one D2H sync + a cohort-choice pass over the used
+        # set, so fewer/larger cohorts amortize the fixed costs; the
+        # lazy-tombstone tier keeps over-eviction cheap (a re-touched
+        # row reloads by index, no page rewrite)
+        target = min(max(idx.capacity // 4, 1024), len(evictable))
         if target < len(evictable):
             et = self._slot_touch[p][evictable]
             sel = np.argpartition(et, target - 1)[:target]
@@ -1085,8 +1169,10 @@ class MeshPagedSpillSupport(MeshSpillSupport):
         block = np.zeros((self.P, G), dtype=np.int32)
         for p, chosen in cohorts.items():
             block[p, : len(chosen)] = chosen
-        gathered = self._gather_step(self.accs, self._put_sharded(block))
-        gathered_host = jax.device_get(gathered)  # ONE batched D2H
+        with self._device_span():
+            gathered = self._gather_step(self.accs,
+                                         self._put_sharded(block))
+            gathered_host = jax.device_get(gathered)  # ONE batched D2H
         for p, chosen in cohorts.items():
             idx = self.indexes[p]
             n = len(chosen)
@@ -1105,7 +1191,9 @@ class MeshPagedSpillSupport(MeshSpillSupport):
         rb = np.zeros((self.P, R), dtype=np.int32)
         for p, chosen in cohorts.items():
             rb[p, : len(chosen)] = chosen
-        self.accs = self._reset_step(self.accs, self._put_sharded(rb))
+        with self._device_span():
+            self.accs = self._reset_step(self.accs,
+                                         self._put_sharded(rb))
 
     def _free_rows_paged(self, p: int, slots: np.ndarray,
                          nss) -> None:
@@ -1166,9 +1254,11 @@ class MeshWindowEngine(MeshSpillSupport):
         key_group_range: Optional[Tuple[int, int]] = None,
         memory=None,
         max_dispatch_ahead: int = 2,
+        shuffle_mode: str = "device",
     ) -> None:
         self.assigner = assigner
         self.agg = agg
+        self.shuffle_mode = self._check_shuffle_mode(shuffle_mode)
         #: dispatch-ahead depth (double-buffered by default; see
         #: MeshSpillSupport._init_pipeline)
         self.max_dispatch_ahead = max(int(max_dispatch_ahead or 1), 1)
@@ -1227,7 +1317,9 @@ class MeshWindowEngine(MeshSpillSupport):
         # SlotTable._dirty: a [P, capacity] host bitmap of slots touched
         # since the last snapshot + namespaces freed since (tombstones)
         self._dirty = np.zeros((self.P, self.capacity), dtype=bool)
-        self._freed_ns: List[int] = []
+        #: freed-namespace tombstone chunks (int64 arrays, deduped at
+        #: snapshot time)
+        self._freed_ns: List[np.ndarray] = []
         self._gather_bucket = 0
 
     @property
@@ -1240,6 +1332,13 @@ class MeshWindowEngine(MeshSpillSupport):
         (self._scatter_step, self._fire_step, self._reset_step,
          self._gather_step, self._put_step, self._merge_step,
          self._valued_scatter_step) = build_mesh_steps(self.mesh, self.agg)
+        # the fused exchange+scatter pair (device shuffle mode); built
+        # through the shared program cache regardless of mode so a
+        # mode flip or a second tenant never pays a family build
+        self._exchange_scatter_step = build_exchange_scatter(
+            self.mesh, self.agg, valued=False)
+        self._exchange_valued_step = build_exchange_scatter(
+            self.mesh, self.agg, valued=True)
 
     def _shard_index_grew(self, new_capacity: int) -> None:
         """One shard's index outgrew the device column count: widen the
@@ -1361,12 +1460,16 @@ class MeshWindowEngine(MeshSpillSupport):
         else:
             values = self.agg.map_input(batch)
             leaves = self.agg.input_leaves
+        if self.shuffle_mode == "device":
+            self._process_batch_device(key_ids, slice_ends, shards,
+                                       values, leaves, partial)
+            return
         # pipelining: wait for a dispatch slot BEFORE rewriting the
         # pooled staging buffers, then bucket while the device still
         # runs the previous batches
         self._await_dispatch_slot()
         self._shuffle_pool.flip()
-        counts, blocked, order = bucket_by_shard(
+        counts, blocked = bucket_by_shard(
             shards, self.P,
             columns=[key_ids, slice_ends,
                      *[np.asarray(v, dtype=l.dtype)
@@ -1399,11 +1502,75 @@ class MeshWindowEngine(MeshSpillSupport):
             self._dirty[p, slot_block[p, :c]] = True
 
         step = self._valued_scatter_step if partial else self._scatter_step
-        self.accs = step(
-            self.accs,
-            self._put_sharded(slot_block),
-            tuple(self._put_sharded(v) for v in value_blocks),
+        with self._device_span():
+            self.accs = step(
+                self.accs,
+                self._put_sharded(slot_block),
+                tuple(self._put_sharded(v) for v in value_blocks),
+            )
+        self._push_dispatch_fence()
+
+    def _process_batch_device(self, key_ids, slice_ends, shards, values,
+                              leaves, partial: bool) -> None:
+        """Device-shuffle ingest: the host resolves slots (the index is
+        host state) but never sorts or blocks the record columns — flat
+        padded columns go up in ONE device_put and the fused
+        exchange+scatter program (segment sort + all_to_all + scatter,
+        one XLA program) routes them to their owner shards."""
+        n = len(key_ids)
+        # per-shard grouping for the HOST index work only: one stable
+        # argsort over the destinations, contiguous slices per shard
+        order = np.argsort(shards, kind="stable")
+        counts = np.bincount(shards, minlength=self.P)
+        offsets = np.zeros(self.P + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        s_keys = key_ids[order]
+        s_ns = slice_ends[order]
+        if self._spill_active:
+            touched = {
+                p: np.unique(s_ns[offsets[p]:offsets[p + 1]])
+                for p in range(self.P) if counts[p]}
+            self._ensure_resident(touched)
+            for p, nss in touched.items():
+                self._touch(p, nss.tolist())
+        slots_sorted = np.empty(n, dtype=np.int32)
+        for p in range(self.P):
+            a, b = int(offsets[p]), int(offsets[p + 1])
+            if a == b:
+                continue
+            self._reserve(p, s_keys[a:b], s_ns[a:b])
+            slots = self.indexes[p].lookup_or_insert(
+                s_keys[a:b], s_ns[a:b])
+            slots_sorted[a:b] = slots
+            self._dirty[p, slots] = True
+        rec_slots = np.empty(n, dtype=np.int32)
+        rec_slots[order] = slots_sorted
+        # pipelining: claim a dispatch slot BEFORE rewriting the pooled
+        # flat staging buffers (their previous consumer must have
+        # finished — the same fence discipline as the host blocks)
+        self._await_dispatch_slot()
+        self._shuffle_pool.flip()
+        dst, staged, width = stage_device_exchange(
+            shards, self.P,
+            columns=[rec_slots,
+                     *[np.asarray(v, dtype=l.dtype)
+                       for v, l in zip(values, leaves)]],
+            fills=[0, *[l.identity for l in leaves]],
+            pool=self._shuffle_pool,
         )
+        with self._device_span():
+            # ONE host->device hop for the whole batch: every flat
+            # column in a single device_put against the key-group
+            # sharding
+            put = jax.device_put((dst, *staged), self._sharding)
+            step = (self._exchange_valued_step if partial
+                    else self._exchange_scatter_step)
+            self.accs = step(self.accs, put[0], put[1], tuple(put[2:]),
+                             width)
+        # "crash mid-batch after the fused dispatch": the scatter is in
+        # flight on the device queue, the host dies before the fence —
+        # the hardest restore case for the device data plane
+        chaos.fault_point("shuffle.device_exchange", records=n)
         self._push_dispatch_fence()
 
     # ------------------------------------------------------------------ fire
@@ -1585,7 +1752,7 @@ class MeshWindowEngine(MeshSpillSupport):
     def _free_slices(self, ends: List[int]) -> None:
         f_max = 0
         freed: List[Optional[np.ndarray]] = []
-        self._freed_ns.extend(int(e) for e in ends)
+        self._freed_ns.append(np.asarray(list(ends), dtype=np.int64))
         self._drop_spilled(ends)
         for p in range(self.P):
             slots = self.indexes[p].free_namespaces(ends)
@@ -1747,7 +1914,8 @@ class MeshWindowEngine(MeshSpillSupport):
                                & used)[0].astype(np.int32)
             per_shard.append(dirty)
             g_max = max(g_max, len(dirty))
-        freed = np.asarray(sorted(set(self._freed_ns)), dtype=np.int64)
+        freed = (np.unique(np.concatenate(self._freed_ns))
+                 if self._freed_ns else np.empty(0, dtype=np.int64))
         if g_max == 0:
             empty = {f"leaf_{i}": np.empty(0, dtype=l.dtype)
                      for i, l in enumerate(self.agg.leaves)}

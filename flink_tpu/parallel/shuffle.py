@@ -5,17 +5,28 @@ flink-runtime/.../io/network/ — RecordWriter.emit:105 -> KeyGroupStreamPartiti
 .selectChannel:55 -> PipelinedSubpartition -> Netty TCP with credit-based flow
 control) with two TPU-native mechanisms:
 
-1. **Host-side bucketing** for source->device ingestion: records are grouped
-   by owning shard (key_group -> shard via the reference's operator-index
-   formula) into a dense ``[num_shards, B]`` block that is laid out with the
-   leading axis sharded over the mesh — the "shuffle" is then just a sharded
-   device_put.
-2. **``all_to_all`` over ICI** for device->device repartitioning between
-   chained keyed stages (each shard holds records destined for every other
-   shard; one collective delivers them), and **``psum``** for two-phase
-   local/global aggregation (the MiniBatch local/global pattern, reference:
-   flink-table-runtime/.../aggregate/MiniBatchLocalGroupAggFunction.java /
-   MiniBatchGlobalGroupAggFunction.java).
+1. **The in-program device exchange** (``shuffle.mode=device``, the
+   default): a batch goes host->device ONCE as flat padded columns (one
+   ``device_put`` of the whole column pytree against the key-group
+   sharding), and a single jitted shard_map program segment-sorts each
+   shard's chunk into per-destination buckets, exchanges them with
+   ``all_to_all`` over the mesh axis, and feeds the segment-reduce
+   scatter in the SAME program — ``keyBy -> window -> aggregate`` is one
+   XLA program end to end (``build_exchange_scatter``). The collective
+   runs over ICI on real hardware; there is no host argsort and no
+   ``[num_shards, B]`` staging block.
+2. **Host-side bucketing** (``shuffle.mode=host``, the explicit
+   fallback): records are grouped by owning shard (key_group -> shard
+   via the reference's operator-index formula) into a dense
+   ``[num_shards, B]`` block that is laid out with the leading axis
+   sharded over the mesh — the "shuffle" is then just a sharded
+   device_put (``bucket_by_shard``).
+
+``all_to_all`` also repartitions between chained keyed stages
+(``make_all_to_all_repartition``), and **``psum``** handles two-phase
+local/global aggregation (the MiniBatch local/global pattern, reference:
+flink-table-runtime/.../aggregate/MiniBatchLocalGroupAggFunction.java /
+MiniBatchGlobalGroupAggFunction.java).
 
 Backpressure (credit-based flow control) maps to the bounded micro-batch
 queue feeding the device — see flink_tpu.runtime.
@@ -32,8 +43,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flink_tpu.chaos import injection as chaos
-from flink_tpu.ops.segment_ops import pad_bucket_size
+from flink_tpu.ops.segment_ops import SCATTER_METHOD, pad_bucket_size
 from flink_tpu.parallel.mesh import KEY_AXIS, shard_map
+from flink_tpu.tenancy.program_cache import PROGRAM_CACHE
 from flink_tpu.state.keygroups import (
     assign_key_groups,
     key_group_to_operator_index,
@@ -84,12 +96,16 @@ def bucket_by_shard(
     fills: Sequence,
     min_bucket: int = 256,
     pool: Optional[ShuffleBufferPool] = None,
-) -> Tuple[np.ndarray, List[np.ndarray], np.ndarray]:
+    want_order: bool = False,
+):
     """Group records into a dense [num_shards, B] block (host side).
 
-    Returns (counts[num_shards], blocked_columns each [num_shards, B],
-    order) where order is the permutation applied to the input records
-    (records of shard p occupy block[p, :counts[p]]).
+    Returns ``(counts[num_shards], blocked_columns each [num_shards,
+    B])`` — records of shard p occupy ``block[p, :counts[p]]`` in
+    stream order. With ``want_order=True`` the applied permutation is
+    returned as a third element; the engines pre-permute their columns
+    and never need it, so the default return shape is explicit about
+    that (no silently-discarded values at the call sites).
 
     Fully vectorized: one argsort for the permutation, then ONE fancy
     scatter per column through a precomputed flat index (record i of the
@@ -149,7 +165,9 @@ def bucket_by_shard(
                     block[p, c:2 * c] = block[p, :c]
             eff_counts[p] = 0 if kind == "drop" else 2 * c
         counts = eff_counts
-    return counts, blocked, order
+    if want_order:
+        return counts, blocked, order
+    return counts, blocked
 
 
 def shard_records(
@@ -176,6 +194,207 @@ def shard_records(
         local_max = int(last) - int(first) + 1
         return ((local * num_shards) // local_max).astype(np.int64)
     return key_group_to_operator_index(groups, max_parallelism, num_shards)
+
+
+# ---------------------------------------------------------------------------
+# The in-program exchange (shuffle.mode=device)
+# ---------------------------------------------------------------------------
+
+
+def exchange_chunk_size(n: int, num_shards: int,
+                        min_bucket: int = 256) -> int:
+    """Per-shard flat-column chunk length for ``n`` records: the
+    ``pad_bucket_size`` tier of ``ceil(n / num_shards)``, so the fused
+    exchange program compiles once per tier (the same bounded shape set
+    the host blocks use) and the staged length ``num_shards * C`` is
+    always divisible by the mesh."""
+    per = -(-max(int(n), 1) // num_shards)
+    return pad_bucket_size(per, minimum=min_bucket)
+
+
+def stage_device_exchange(
+    shard_of_record: np.ndarray,
+    num_shards: int,
+    columns: Sequence[np.ndarray],
+    fills: Sequence,
+    min_bucket: int = 256,
+    pool: Optional[ShuffleBufferPool] = None,
+) -> Tuple[np.ndarray, List[np.ndarray], int]:
+    """Stage flat record columns for the in-program exchange.
+
+    Unlike :func:`bucket_by_shard` there is NO host argsort and NO
+    [num_shards, B] scatter: each column is copied once into a padded
+    flat buffer of length ``num_shards * C`` (``C`` =
+    :func:`exchange_chunk_size` — a ``pad_bucket_size`` tier, so the
+    fused program's shape set stays bounded) and the segment sort +
+    exchange happen inside the compiled program. Padded lanes carry the
+    out-of-range destination ``num_shards``; the program drops them
+    before the collective.
+
+    Returns ``(dst, staged_columns, bucket_width)``, columns all length
+    ``num_shards * C``. ``bucket_width`` is the ``pad_bucket_size`` tier
+    of the batch's densest (source chunk, destination) pair count — the
+    static per-pair bucket capacity the fused program allocates. Sizing
+    it to the worst case (``C``) would make every shard's received
+    block ``num_shards`` times wider than the data; the O(n) host
+    bincount buys the compiled program a ~P-fold smaller exchange
+    payload at the cost of one more bounded shape dimension.
+
+    The chaos payload point ``shuffle.device_exchange`` models a lossy
+    exchange like ``shuffle.bucket_send`` does for the host path: drop
+    re-routes one shard's records to the padding destination (they
+    vanish before the collective), duplicate replays them.
+    """
+    shard_of_record = np.asarray(shard_of_record)
+    n = len(shard_of_record)
+    columns = [np.asarray(c) for c in columns]
+    if chaos.armed():
+        # payload kinds only — raise/delay fire at the engines'
+        # post-dispatch fault point, so a "crash mid-batch" lands AFTER
+        # the fused program was dispatched (the hardest restore case)
+        mutations: Dict[int, str] = {}
+        present = np.unique(shard_of_record) if n else ()
+        for p in present:
+            rule = chaos.payload_action(
+                "shuffle.device_exchange",
+                kinds=("drop", "duplicate", "delay"), shard=int(p))
+            if rule is not None and rule.kind in ("drop", "duplicate"):
+                mutations[int(p)] = rule.kind
+        for p, kind in mutations.items():
+            sel = shard_of_record == p
+            if kind == "drop":
+                shard_of_record = np.where(sel, num_shards,
+                                           shard_of_record)
+            else:  # duplicate: replay the shard's records
+                shard_of_record = np.concatenate(
+                    [shard_of_record, shard_of_record[sel]])
+                columns = [np.concatenate([c, c[sel]]) for c in columns]
+                n = len(shard_of_record)
+    C = exchange_chunk_size(n, num_shards, min_bucket)
+    N = num_shards * C
+    dst = (pool.get((N,), np.int32, num_shards, tag=("xchg", "dst"))
+           if pool is not None
+           else np.full(N, num_shards, dtype=np.int32))
+    dst[:n] = shard_of_record
+    staged: List[np.ndarray] = []
+    for ci, (col, fill) in enumerate(zip(columns, fills)):
+        shape = (N,) + col.shape[1:]
+        if pool is not None:
+            buf = pool.get(shape, col.dtype, fill, tag=("xchg", ci))
+        else:
+            buf = np.full(shape, fill, dtype=col.dtype)
+        buf[:n] = col
+        staged.append(buf)
+    # densest (source chunk, destination) pair: one flat bincount over
+    # the real records (padding lanes land in the excluded column)
+    if n:
+        chunk_of = np.arange(n, dtype=np.int64) // C
+        pair_max = int(np.bincount(
+            chunk_of * (num_shards + 1)
+            + np.minimum(dst[:n], num_shards),
+            minlength=num_shards * (num_shards + 1))
+            .reshape(num_shards, num_shards + 1)[:, :num_shards].max())
+    else:
+        pair_max = 0
+    bucket_width = min(pad_bucket_size(pair_max, minimum=min_bucket), C)
+    return dst, staged, bucket_width
+
+
+def build_exchange_scatter(mesh: Mesh, agg, valued: bool = False):
+    """The fused exchange+scatter program: ONE jitted shard_map over the
+    whole mesh that (a) segment-sorts each shard's flat record chunk
+    into per-destination buckets, (b) exchanges the buckets with
+    ``all_to_all`` over the mesh axis, and (c) scatters the received
+    rows into the [P, capacity] accumulator plane — the keyBy exchange
+    and the aggregate step as one XLA program.
+
+    ``valued=False`` folds raw input-leaf values (const leaves derive on
+    device, like ``scatter_step``); ``valued=True`` folds explicit
+    per-ACC-leaf partials (the two-phase local/global path, like
+    ``valued_scatter_step``). Cached in the shared program cache per
+    ``(device ids, aggregate layout, variant)`` — jobs and rebuilt
+    engines share the executable (the multi-tenant zero-recompile
+    contract), shapes one level down via jit + the pad_bucket_size
+    tiers."""
+    key = (tuple(d.id for d in mesh.devices.flat), agg.cache_key(),
+           bool(valued))
+    return PROGRAM_CACHE.get_or_build(
+        "exchange-scatter", key,
+        lambda: _build_exchange_scatter(mesh, agg, valued))
+
+
+def _build_exchange_scatter(mesh: Mesh, agg, valued: bool):
+    leaves = agg.leaves
+    methods = tuple(SCATTER_METHOD[l.reduce] for l in leaves)
+    n_leaves = len(leaves)
+    num_shards = int(mesh.devices.size)
+
+    def _exchange(block):
+        # [P, W] local block, dim0 = destination shard -> [P, W] with
+        # dim0 = source shard (the ICI hop; identity on a 1-mesh)
+        if num_shards == 1:
+            return block
+        return jax.lax.all_to_all(block, KEY_AXIS,
+                                  split_axis=0, concat_axis=0)
+
+    @partial(jax.jit, static_argnums=(4,), donate_argnums=(0,))
+    def exchange_scatter(accs, dst, slots, values, bucket_width):
+        W = int(bucket_width)
+
+        def local(*args):
+            accs_l = args[:n_leaves]         # each [1, cap]
+            d = args[n_leaves]               # [C] destination shard
+            s = args[n_leaves + 1]           # [C] destination slot
+            vals_l = iter(args[n_leaves + 2:])
+            # rank of record i within its destination = count of prior
+            # same-destination records: preserves STREAM ORDER per
+            # destination (chunks partition the stream contiguously, so
+            # the received (source, rank) flattening is stream order —
+            # the same order the host bucketing produces, which keeps
+            # float folds bit-identical across modes)
+            oh = jax.nn.one_hot(d, num_shards, dtype=jnp.int32)
+            rank = jnp.cumsum(oh, axis=0) - oh
+            rank_d = jnp.take_along_axis(
+                rank, jnp.clip(d, 0, num_shards - 1)[:, None],
+                axis=1)[:, 0]
+            # padded / dropped lanes (dst == num_shards) target the
+            # out-of-range flat index and are dropped by the scatter.
+            # The host sized W to the batch's densest pair, so rank
+            # never reaches W for a real record; the guard only bounds
+            # the failure mode of a miscount to a drop (-> oracle
+            # divergence) instead of silent row corruption.
+            ok = (d < num_shards) & (rank_d < W)
+            flat = jnp.where(ok, d * W + rank_d, num_shards * W)
+            recv_s = _exchange(
+                jnp.zeros((num_shards * W,), jnp.int32)
+                .at[flat].set(s, mode="drop")
+                .reshape(num_shards, W)).reshape(-1)
+            out = []
+            for a, m, l in zip(accs_l, methods, leaves):
+                if not valued and l.const is not None:
+                    # bucket lanes that received no record hold slot 0
+                    # (the reserved identity slot) — keep it pure
+                    v = jnp.where(
+                        recv_s == 0,
+                        jnp.asarray(l.identity, dtype=l.dtype),
+                        jnp.asarray(l.const, dtype=l.dtype))
+                else:
+                    v = _exchange(
+                        jnp.full((num_shards * W,), l.identity,
+                                 dtype=l.dtype)
+                        .at[flat].set(next(vals_l), mode="drop")
+                        .reshape(num_shards, W)).reshape(-1)
+                out.append(getattr(a.at[0, recv_s], m)(v))
+            return tuple(out)
+
+        n_vals = len(values)
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P(KEY_AXIS),) * (n_leaves + 2 + n_vals),
+            out_specs=(P(KEY_AXIS),) * n_leaves,
+        )(*accs, dst, slots, *values)
+
+    return exchange_scatter
 
 
 # ---------------------------------------------------------------------------

@@ -84,7 +84,8 @@ class OperatorContext:
     def __init__(self, operator_index: int = 0, parallelism: int = 1,
                  max_parallelism: int = 128, metrics=None,
                  async_fires: bool = False, max_dispatch_ahead: int = 4,
-                 mesh=None, key_group_range=None, memory_manager=None):
+                 mesh=None, key_group_range=None, memory_manager=None,
+                 shuffle_mode: str = "device"):
         self.operator_index = operator_index
         self.parallelism = parallelism
         self.max_parallelism = max_parallelism
@@ -104,6 +105,9 @@ class OperatorContext:
         self.async_fires = async_fires
         #: per-batch fence depth (execution.pipeline.max-dispatch-batches)
         self.max_dispatch_ahead = max_dispatch_ahead
+        #: keyBy data plane for mesh engines (shuffle.mode):
+        #: "device" = in-program exchange, "host" = explicit fallback
+        self.shuffle_mode = shuffle_mode
 
 
 class MapOperator(Operator):
@@ -251,7 +255,10 @@ class WindowAggOperator(Operator):
                 memory=self._managed_memory(ctx),
                 # engine-level dispatch-ahead follows the task's
                 # pipeline depth (execution.pipeline.max-dispatch-batches)
-                max_dispatch_ahead=getattr(ctx, "max_dispatch_ahead", 2))
+                max_dispatch_ahead=getattr(ctx, "max_dispatch_ahead", 2),
+                # keyBy data plane (shuffle.mode): in-program device
+                # exchange by default, host bucketing as the fallback
+                shuffle_mode=getattr(ctx, "shuffle_mode", "device"))
         else:
             table_kwargs, placement = self._table_kwargs()
             if self._managed_memory(ctx) is not None:
@@ -702,7 +709,9 @@ class SessionWindowAggOperator(WindowAggOperator):
                 spill_layout=spill.get("spill_layout", "pages"),
                 # engine-level dispatch-ahead follows the task's
                 # pipeline depth (execution.pipeline.max-dispatch-batches)
-                max_dispatch_ahead=getattr(ctx, "max_dispatch_ahead", 2))
+                max_dispatch_ahead=getattr(ctx, "max_dispatch_ahead", 2),
+                # keyBy data plane (shuffle.mode)
+                shuffle_mode=getattr(ctx, "shuffle_mode", "device"))
         else:
             table_kwargs, _ = self._table_kwargs()
             if self._managed_memory(ctx) is not None:

@@ -86,11 +86,19 @@ class PagedSpillMap:
                  ) -> None:
         #: spilled (ns -> page, row-within-page) mapping as parallel
         #: arrays; kept sorted by ns lazily (evictions append, reloads
-        #: filter). ``sp_row`` is stable: pages are immutable once
+        #: tombstone). ``sp_row`` is stable: pages are immutable once
         #: written — compaction assigns fresh row indexes.
         self.sp_ns = np.empty(0, dtype=np.int64)
         self.sp_page = np.empty(0, dtype=np.int64)
         self.sp_row = np.empty(0, dtype=np.int64)
+        #: map-entry tombstones: an unmap only FLAGS its entries dead
+        #: (O(extracted)); dead entries purge in bulk at the next
+        #: sort()/compress cycle. Compressing the parallel arrays on
+        #: every unmap cost three O(map) copies per extraction round —
+        #: at the session-thrashing shape that was the single largest
+        #: spill-bookkeeping term.
+        self.sp_dead = np.empty(0, dtype=bool)
+        self._dead_count = 0
         self.sorted = True
         self.compact_dead_fraction = float(compact_dead_fraction)
         #: per-page physical row count (as stored) and live row count
@@ -106,7 +114,7 @@ class PagedSpillMap:
         self.rows_compacted = 0
 
     def __len__(self) -> int:
-        return len(self.sp_ns)
+        return len(self.sp_ns) - self._dead_count
 
     def counters(self) -> Dict[str, int]:
         return {name: int(getattr(self, name)) for name in COUNTER_NAMES}
@@ -117,18 +125,36 @@ class PagedSpillMap:
 
     # ------------------------------------------------------------ membership
 
+    def _compress(self, keep: np.ndarray) -> None:
+        self.sp_ns = self.sp_ns[keep]
+        self.sp_page = self.sp_page[keep]
+        self.sp_row = self.sp_row[keep]
+        self.sp_dead = self.sp_dead[keep]
+        self._dead_count = int(self.sp_dead.sum())
+
     def sort(self) -> None:
+        """Settle the map for reads: purge dead entries when appends
+        arrived (the at-most-one-entry-per-ns invariant the searchsorted
+        probes rely on) or when tombstones dominate, then re-sort."""
         if not self.sorted:
+            if self._dead_count:
+                self._compress(~self.sp_dead)
             o = np.argsort(self.sp_ns, kind="stable")
             self.sp_ns = self.sp_ns[o]
             self.sp_page = self.sp_page[o]
             self.sp_row = self.sp_row[o]
+            self.sp_dead = self.sp_dead[o]
             self.sorted = True
+        elif self._dead_count * 2 > len(self.sp_ns):
+            # bound tombstone memory; a mask compress keeps sort order
+            self._compress(~self.sp_dead)
 
     def spilled_mask(self, nss: np.ndarray) -> np.ndarray:
         """Vectorized membership: which of ``nss`` are spilled."""
         self.sort()
-        mask, _ = sorted_match(self.sp_ns, nss)
+        mask, pos = sorted_match(self.sp_ns, nss)
+        if self._dead_count:
+            mask &= ~self.sp_dead[pos]
         return mask
 
     def positions_for(self, nss: np.ndarray) -> np.ndarray:
@@ -136,6 +162,8 @@ class PagedSpillMap:
         self.sort()
         mask, pos = sorted_match(
             self.sp_ns, np.unique(np.asarray(nss, dtype=np.int64)))
+        if self._dead_count:
+            mask &= ~self.sp_dead[pos]
         return pos[mask]
 
     def page_of(self, ns: int) -> Optional[int]:
@@ -144,9 +172,18 @@ class PagedSpillMap:
             return None
         self.sort()
         p = int(np.searchsorted(self.sp_ns, int(ns)))
-        if p >= len(self.sp_ns) or int(self.sp_ns[p]) != int(ns):
+        if p >= len(self.sp_ns) or int(self.sp_ns[p]) != int(ns) \
+                or bool(self.sp_dead[p]):
             return None
         return int(self.sp_page[p])
+
+    def live_ns(self) -> np.ndarray:
+        """The live (non-tombstoned) spilled namespaces — the listing
+        external readers use instead of the raw ``sp_ns`` array."""
+        self.sort()
+        if self._dead_count:
+            return self.sp_ns[~self.sp_dead]
+        return self.sp_ns
 
     def live_row_mask(self, page: int, rns: np.ndarray) -> np.ndarray:
         """Which rows of a stored page entry are still live: a row is
@@ -158,6 +195,8 @@ class PagedSpillMap:
             return np.zeros(len(rns), dtype=bool)
         self.sort()
         mask, pos = sorted_match(self.sp_ns, rns)
+        if self._dead_count:
+            mask &= ~self.sp_dead[pos]
         return mask & (self.sp_page[pos] == int(page))
 
     def record(self, nss: np.ndarray, page: int) -> None:
@@ -167,30 +206,28 @@ class PagedSpillMap:
             self.sp_page, np.full(n, page, dtype=np.int64)])
         self.sp_row = np.concatenate([
             self.sp_row, np.arange(n, dtype=np.int64)])
+        self.sp_dead = np.concatenate([
+            self.sp_dead, np.zeros(n, dtype=bool)])
         self.page_rows[int(page)] = n
         self.page_live[int(page)] = n
         self.sorted = False
 
     def unmap_positions(self, pos: np.ndarray) -> List[int]:
         """Tombstone the map entries at ``pos``; returns the distinct
-        pages they lived in (candidates for reap/compact)."""
+        pages they lived in (candidates for reap/compact). O(len(pos)):
+        the arrays are not compressed here — dead entries purge in bulk
+        at the next sort cycle."""
         if not len(pos):
             return []
         pages, counts = np.unique(self.sp_page[pos], return_counts=True)
         for page, c in zip(pages.tolist(), counts.tolist()):
             self.page_live[page] = self.page_live.get(page, c) - c
-        keep = np.ones(len(self.sp_ns), dtype=bool)
-        keep[pos] = False
-        self.sp_ns = self.sp_ns[keep]
-        self.sp_page = self.sp_page[keep]
-        self.sp_row = self.sp_row[keep]
+        self.sp_dead[pos] = True
+        self._dead_count += len(pos)
         return pages.tolist()
 
     def remove_pages(self, pages: np.ndarray) -> None:
-        keep = ~np.isin(self.sp_page, pages)
-        self.sp_ns = self.sp_ns[keep]
-        self.sp_page = self.sp_page[keep]
-        self.sp_row = self.sp_row[keep]
+        self._compress(~np.isin(self.sp_page, pages))
         for page in np.asarray(pages).tolist():
             self.page_rows.pop(int(page), None)
             self.page_live.pop(int(page), None)
@@ -199,6 +236,8 @@ class PagedSpillMap:
         self.sp_ns = np.empty(0, dtype=np.int64)
         self.sp_page = np.empty(0, dtype=np.int64)
         self.sp_row = np.empty(0, dtype=np.int64)
+        self.sp_dead = np.empty(0, dtype=bool)
+        self._dead_count = 0
         self.sorted = True
         self.page_rows.clear()
         self.page_live.clear()
@@ -270,7 +309,7 @@ def _compact_page(spill, pmap: PagedSpillMap, page: int) -> None:
     if entry is None:
         return
     was_dirty = bool(entry.get("__was_dirty__", False))
-    pos = np.nonzero(pmap.sp_page == page)[0]
+    pos = np.nonzero((pmap.sp_page == page) & ~pmap.sp_dead)[0]
     if not len(pos):
         return
     old_rows = pmap.sp_row[pos]

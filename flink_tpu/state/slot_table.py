@@ -25,6 +25,7 @@ window/slice id, mirroring the reference's namespace-per-window keyed state
 
 from __future__ import annotations
 
+import ctypes as _ct
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -295,17 +296,22 @@ class HostSlotIndex(_NamespaceRegistry):
         self._free.extend(slots.tolist())
         return slots
 
-    def free_slots(self, slots: np.ndarray) -> None:
+    def free_slots(self, slots: np.ndarray, keys=None, nss=None) -> None:
         """Release individual slots (TTL expiry — by entry, not by
-        namespace)."""
+        namespace). ``keys``/``nss`` let a caller that already holds the
+        slots' pair columns skip the per-slot metadata gather."""
         slots = np.asarray(slots, dtype=np.int32)
         if not len(slots):
             return
-        self._registry_remove_slots(slots, self.slot_ns[slots])
+        if nss is None:
+            nss = self.slot_ns[slots]
+        self._registry_remove_slots(slots, nss)
+        if keys is None:
+            keys = self.slot_key[slots]
         index = self._index
-        sk, sn = self.slot_key, self.slot_ns
-        for s in slots.tolist():
-            index.pop((int(sk[s]), int(sn[s])), None)
+        for k, v in zip(np.asarray(keys).tolist(),
+                        np.asarray(nss).tolist()):
+            index.pop((int(k), int(v)), None)
         self.slot_used[slots] = False
         self._free.extend(slots.tolist())
 
@@ -319,6 +325,14 @@ class HostSlotIndex(_NamespaceRegistry):
         else:
             limit = self.capacity
         return limit - 1 - self.num_used
+
+
+#: hoisted ctypes pointer types for the native probe wrappers — one
+#: construction per process instead of several per call (the native
+#: index is probed tens of thousands of times per bench second)
+_I64P = _ct.POINTER(_ct.c_int64)
+_I32P = _ct.POINTER(_ct.c_int32)
+_U8P = _ct.POINTER(_ct.c_uint8)
 
 
 class NativeSlotIndex(_NamespaceRegistry):
@@ -374,21 +388,16 @@ class NativeSlotIndex(_NamespaceRegistry):
 
     def lookup_or_insert(self, key_ids: np.ndarray,
                          namespaces: np.ndarray) -> np.ndarray:
-        import ctypes
-
         keys = np.ascontiguousarray(key_ids, dtype=np.int64)
         nss = np.ascontiguousarray(namespaces, dtype=np.int64)
         n = len(keys)
         out = np.empty(n, dtype=np.int32)
         is_new = np.empty(n, dtype=np.uint8)
-        i64p = ctypes.POINTER(ctypes.c_int64)
-        i32p = ctypes.POINTER(ctypes.c_int32)
-        u8p = ctypes.POINTER(ctypes.c_uint8)
         old_cap = self.capacity
         rc = self._lib.sm_lookup_or_insert(
             self._h, n,
-            keys.ctypes.data_as(i64p), nss.ctypes.data_as(i64p),
-            out.ctypes.data_as(i32p), is_new.ctypes.data_as(u8p))
+            keys.ctypes.data_as(_I64P), nss.ctypes.data_as(_I64P),
+            out.ctypes.data_as(_I32P), is_new.ctypes.data_as(_U8P))
         if rc < 0:
             raise SlotTableFullError(
                 f"slot table full (capacity={self.capacity}) and not "
@@ -479,22 +488,16 @@ class NativeSlotIndex(_NamespaceRegistry):
     def lookup(self, key_ids: np.ndarray,
                namespaces: np.ndarray) -> np.ndarray:
         """Read-only probe via the native table: -1 where absent."""
-        import ctypes
-
         keys = np.ascontiguousarray(key_ids, dtype=np.int64)
         nss = np.ascontiguousarray(namespaces, dtype=np.int64)
         out = np.empty(len(keys), dtype=np.int32)
-        i64p = ctypes.POINTER(ctypes.c_int64)
-        i32p = ctypes.POINTER(ctypes.c_int32)
         self._lib.sm_lookup(self._h, len(keys),
-                            keys.ctypes.data_as(i64p),
-                            nss.ctypes.data_as(i64p),
-                            out.ctypes.data_as(i32p))
+                            keys.ctypes.data_as(_I64P),
+                            nss.ctypes.data_as(_I64P),
+                            out.ctypes.data_as(_I32P))
         return out
 
     def free_namespaces(self, namespaces: List[int]) -> Optional[np.ndarray]:
-        import ctypes
-
         drained = self._registry_drain(namespaces)
         if drained is None:
             return None
@@ -502,31 +505,31 @@ class NativeSlotIndex(_NamespaceRegistry):
         keys = np.ascontiguousarray(self.slot_key[slots])
         nss = np.ascontiguousarray(self.slot_ns[slots])
         out = np.empty(len(slots), dtype=np.int32)
-        i64p = ctypes.POINTER(ctypes.c_int64)
-        i32p = ctypes.POINTER(ctypes.c_int32)
         n = self._lib.sm_erase(
             self._h, len(slots),
-            keys.ctypes.data_as(i64p), nss.ctypes.data_as(i64p),
-            out.ctypes.data_as(i32p))
+            keys.ctypes.data_as(_I64P), nss.ctypes.data_as(_I64P),
+            out.ctypes.data_as(_I32P))
         return out[:n]
 
-    def free_slots(self, slots: np.ndarray) -> None:
-        """Release individual slots (TTL expiry) via the native erase."""
-        import ctypes
-
+    def free_slots(self, slots: np.ndarray, keys=None, nss=None) -> None:
+        """Release individual slots (TTL expiry) via the native erase.
+        ``keys``/``nss`` let a caller that already holds the slots' pair
+        columns skip the per-slot metadata gathers."""
         slots = np.ascontiguousarray(slots, dtype=np.int32)
         if not len(slots):
             return
-        self._registry_remove_slots(slots, self.slot_ns[slots])
-        keys = np.ascontiguousarray(self.slot_key[slots])
-        nss = np.ascontiguousarray(self.slot_ns[slots])
+        if nss is None:
+            nss = self.slot_ns[slots]
+        self._registry_remove_slots(slots, nss)
+        if keys is None:
+            keys = self.slot_key[slots]
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+        nss = np.ascontiguousarray(nss, dtype=np.int64)
         out = np.empty(len(slots), dtype=np.int32)
-        i64p = ctypes.POINTER(ctypes.c_int64)
-        i32p = ctypes.POINTER(ctypes.c_int32)
         self._lib.sm_erase(
             self._h, len(slots),
-            keys.ctypes.data_as(i64p), nss.ctypes.data_as(i64p),
-            out.ctypes.data_as(i32p))
+            keys.ctypes.data_as(_I64P), nss.ctypes.data_as(_I64P),
+            out.ctypes.data_as(_I32P))
 
     def used_slots(self) -> np.ndarray:
         return np.nonzero(self.slot_used)[0]
@@ -832,7 +835,7 @@ class SlotTable:
             used = self.index.used_slots()
             resident = np.unique(self.index.slot_ns[used]).tolist()
         if self._paged:
-            return resident + self._sp_ns.tolist()
+            return resident + self._pmap.live_ns().tolist()
         return resident + self.spill.namespaces
 
     # ------------------------------------------------------------- main path
@@ -907,23 +910,18 @@ class SlotTable:
         self._slot_touch[slots] = clock
         return slots
 
-    # compat views over the PagedSpillMap (tests and older callers poke
-    # the raw arrays; the map itself is the shared implementation)
+    # compat READ views over the PagedSpillMap (tests and older callers
+    # inspect the raw arrays; the map itself is the shared
+    # implementation). No setters: assigning a raw array would desync
+    # the tombstone mask (sp_dead) the map keeps alongside — mutate
+    # through the map's API instead.
     @property
     def _sp_ns(self) -> np.ndarray:
         return self._pmap.sp_ns
 
-    @_sp_ns.setter
-    def _sp_ns(self, v: np.ndarray) -> None:
-        self._pmap.sp_ns = v
-
     @property
     def _sp_page(self) -> np.ndarray:
         return self._pmap.sp_page
-
-    @_sp_page.setter
-    def _sp_page(self, v: np.ndarray) -> None:
-        self._pmap.sp_page = v
 
     def spill_counters(self) -> Dict[str, int]:
         """Paged spill traffic counters (zeros when not paged)."""

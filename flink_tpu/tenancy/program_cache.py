@@ -2,9 +2,11 @@
 
 The multi-tenant claim that "job K+1 pays zero steady-state compiles on
 a warm cluster" rests on one property: the compiled step programs
-(scatter / fire / reset / gather / put / merge, and the serving-plane
-query gathers) are keyed on WHAT they compute — ``(program kind, device
-ids, aggregate layout)`` — never on WHO runs them. Shapes are handled
+(scatter / fire / reset / gather / put / merge, the serving-plane query
+gathers, and the fused exchange+scatter family of the device data plane
+— ``parallel/shuffle.py build_exchange_scatter``, keyed ``(device ids,
+aggregate layout, valued)``) are keyed on WHAT they compute — ``(program
+kind, device ids, aggregate layout)`` — never on WHO runs them. Shapes are handled
 one level down by jax's own jit cache together with the engines'
 sticky-bucket padding discipline, so the full effective key is
 ``(kind, layout, bucketed shapes, device ids)``; an engine identity, a

@@ -222,7 +222,7 @@ class SessionWindower:
                      async_ok: bool = False) -> List[RecordBatch]:
         fired_keys, fired_starts, fired_ends, fired_sids = \
             self.meta.pop_fired(watermark)
-        if not fired_keys:
+        if not len(fired_keys):
             return []
         total = len(fired_keys)
         # with a bounded device table, a mass fire (e.g. end of stream) can

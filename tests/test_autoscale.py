@@ -275,7 +275,7 @@ class TestReshardUnderChaos:
         plan = FaultPlan(rules=[
             FaultRule(pattern="rescale.handoff", nth=2, kind="raise",
                       where={"stage": "commit"}),
-            FaultRule(pattern="mesh.dispatch_fence", nth=11,
+            FaultRule(pattern="mesh.dispatch_fence", nth=8,
                       kind="raise"),
         ])
 

@@ -346,13 +346,13 @@ class TestShuffleBucketFaults:
         from flink_tpu.parallel.shuffle import bucket_by_shard
 
         shard_of, cols = self._bucket()
-        base_counts, base_blocked, _ = bucket_by_shard(
+        base_counts, base_blocked = bucket_by_shard(
             shard_of, 4, cols, fills=[0, 0.0])
         plan = FaultPlan(rules=[
             FaultRule(pattern="shuffle.bucket_send", nth=1, kind="drop",
                       where={"shard": 2})])
         with chaos.chaos_active(plan, seed=0) as c:
-            counts, blocked, _ = bucket_by_shard(
+            counts, blocked = bucket_by_shard(
                 shard_of, 4, cols, fills=[0, 0.0])
             assert counts[2] == 0 and base_counts[2] > 0
             assert (blocked[0][2] == 0).all()  # refilled with fill
@@ -364,13 +364,13 @@ class TestShuffleBucketFaults:
         from flink_tpu.parallel.shuffle import bucket_by_shard
 
         shard_of, cols = self._bucket()
-        base_counts, _, _ = bucket_by_shard(
+        base_counts, _ = bucket_by_shard(
             shard_of, 4, cols, fills=[0, 0.0])
         plan = FaultPlan(rules=[
             FaultRule(pattern="shuffle.bucket_send", nth=1,
                       kind="duplicate", where={"shard": 1})])
         with chaos.chaos_active(plan, seed=0) as c:
-            counts, blocked, _ = bucket_by_shard(
+            counts, blocked = bucket_by_shard(
                 shard_of, 4, cols, fills=[0, 0.0])
             cbase = int(base_counts[1])
             assert counts[1] == 2 * cbase
@@ -382,12 +382,136 @@ class TestShuffleBucketFaults:
         from flink_tpu.parallel.shuffle import bucket_by_shard
 
         shard_of, cols = self._bucket()
-        c1, b1, o1 = bucket_by_shard(shard_of, 4, cols, fills=[0, 0.0])
-        c2, b2, o2 = bucket_by_shard(shard_of, 4, cols, fills=[0, 0.0])
+        c1, b1, o1 = bucket_by_shard(shard_of, 4, cols, fills=[0, 0.0],
+                                     want_order=True)
+        c2, b2, o2 = bucket_by_shard(shard_of, 4, cols, fills=[0, 0.0],
+                                     want_order=True)
         np.testing.assert_array_equal(c1, c2)
         np.testing.assert_array_equal(o1, o2)
         for x, y in zip(b1, b2):
             np.testing.assert_array_equal(x, y)
+
+
+class TestDeviceExchangeFaults:
+    """The device data plane's fault point, at its REAL sites: payload
+    kinds (drop/duplicate) apply in ``stage_device_exchange`` before the
+    flat columns go up, and raise/delay fire at the engines'
+    post-dispatch site — a crash lands mid-batch with the fused
+    exchange+scatter already on the device queue."""
+
+    def _flat(self, n=64, shards=4):
+        rng = np.random.default_rng(5)
+        shard_of = rng.integers(0, shards, n)
+        cols = [rng.integers(1, 100, n).astype(np.int32),
+                rng.random(n).astype(np.float32)]
+        return shard_of, cols
+
+    def test_drop_routes_shard_lanes_to_padding(self):
+        from flink_tpu.parallel.shuffle import stage_device_exchange
+
+        shard_of, cols = self._flat()
+        dst0, _, _ = stage_device_exchange(shard_of, 4, cols,
+                                           fills=[0, 0.0])
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="shuffle.device_exchange", nth=1,
+                      kind="drop", where={"shard": 2})])
+        with chaos.chaos_active(plan, seed=0) as c:
+            dst, staged, _ = stage_device_exchange(shard_of, 4, cols,
+                                                   fills=[0, 0.0])
+            n = len(shard_of)
+            # the dropped shard's lanes re-route to the padding
+            # destination (they vanish before the collective); every
+            # other lane is untouched
+            assert (dst0[:n] == 2).sum() > 0
+            assert not (dst[:n] == 2).any()
+            assert ((dst[:n] == 4) == (shard_of == 2)).all()
+            np.testing.assert_array_equal(staged[0][:n], cols[0])
+            _note_reached(c.faults_injected)
+
+    def test_duplicate_replays_shard_records(self):
+        from flink_tpu.parallel.shuffle import stage_device_exchange
+
+        shard_of, cols = self._flat()
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="shuffle.device_exchange", nth=1,
+                      kind="duplicate", where={"shard": 1})])
+        with chaos.chaos_active(plan, seed=0) as c:
+            dst, staged, _ = stage_device_exchange(shard_of, 4, cols,
+                                                   fills=[0, 0.0])
+            n = len(shard_of)
+            c1 = int((shard_of == 1).sum())
+            assert c1 > 0
+            # the duplicated rows ride as extra real lanes after the
+            # original batch
+            assert (dst[n:n + c1] == 1).all()
+            np.testing.assert_array_equal(
+                staged[1][n:n + c1], cols[1][shard_of == 1])
+            _note_reached(c.faults_injected)
+
+    def test_raise_fires_after_fused_dispatch(self, eight_device_mesh):
+        """An engine in device mode crashes AT the post-dispatch site:
+        process_batch raises with the exchange+scatter already
+        dispatched (no fence pushed)."""
+        from tests.test_sessions import keyed_batch
+
+        make = _make_session_engine(eight_device_mesh,
+                                    shuffle_mode="device")
+        eng = make()
+        assert eng.shuffle_mode == "device"
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="shuffle.device_exchange", nth=1)])
+        with chaos.chaos_active(plan, seed=0) as c:
+            with pytest.raises(InjectedFault):
+                eng.process_batch(keyed_batch(
+                    [1, 2, 3], [1.0, 2.0, 3.0], [0, 10, 20]))
+            assert c.faults_injected.get(
+                "shuffle.device_exchange", 0) == 1
+            _note_reached(c.faults_injected)
+
+    def test_device_mode_crash_restore_matches_oracle(
+            self, eight_device_mesh, tmp_path):
+        """The satellite scenario: shuffle.mode=device, crash mid-batch
+        after the fused dispatch, restore from the latest complete
+        checkpoint, replay — committed output oracle-identical, and the
+        run is seed-deterministic."""
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="shuffle.device_exchange", nth=5)])
+
+        def run(tag):
+            return run_crash_restore_verify(
+                _make_session_engine(eight_device_mesh,
+                                     shuffle_mode="device"),
+                _make_session_oracle(),
+                _session_steps(seed=47), plan, seed=9,
+                ckpt_root=str(tmp_path / f"ckpt-{tag}"),
+                checkpoint_every=2)
+
+        r1 = run("a")
+        assert not r1.diverged and r1.windows > 0
+        assert r1.crashes == 1 and r1.restores == 1
+        assert r1.faults_injected.get("shuffle.device_exchange", 0) == 1
+        r2 = run("b")
+        assert r2.signature() == r1.signature()
+        _note_reached(r1.faults_injected)
+
+    def test_device_negative_control_drop_diverges(
+            self, eight_device_mesh, tmp_path):
+        """A dropped shard on the DEVICE data plane must diverge from
+        the oracle — the same loss-detection proof the host path's
+        negative control gives."""
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="shuffle.device_exchange", nth=4,
+                      kind="drop")])
+        r = run_crash_restore_verify(
+            _make_session_engine(eight_device_mesh,
+                                 shuffle_mode="device"),
+            _make_session_oracle(),
+            _session_steps(seed=53), plan, seed=5,
+            ckpt_root=str(tmp_path / "ckpt"), checkpoint_every=2,
+            check=False)
+        assert r.diverged and r.crashes == 0
+        assert r.faults_injected.get("shuffle.device_exchange", 0) == 1
+        _note_reached(r.faults_injected)
 
 
 # -------------------------------------------------------- restart satellites
@@ -521,13 +645,18 @@ def _session_steps(num_keys=6000, n_steps=8, per_step=1500, seed=17):
     return out
 
 
-def _make_session_engine(mesh, dispatch_ahead=2):
+def _make_session_engine(mesh, dispatch_ahead=2, shuffle_mode="host"):
     from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
     from flink_tpu.windowing.aggregates import SumAggregate
 
+    # shuffle_mode="host" pins the EXPLICIT fallback data plane for the
+    # long-standing scenarios, keeping shuffle.bucket_send/_prep
+    # semantics and the host-path negative control exercised; the
+    # device data plane's scenarios live in TestDeviceExchangeFaults
     return lambda: MeshSessionEngine(
         GAP, SumAggregate("v"), mesh, capacity_per_shard=1 << 14,
-        max_device_slots=1024, max_dispatch_ahead=dispatch_ahead)
+        max_device_slots=1024, max_dispatch_ahead=dispatch_ahead,
+        shuffle_mode=shuffle_mode)
 
 
 def _make_session_oracle():
@@ -548,9 +677,9 @@ class TestCrashRestoreVerify:
         Committed output must equal the fault-free oracle exactly, and
         the run must be bit-deterministic for the same seed."""
         plan = FaultPlan(rules=[
-            FaultRule(pattern="mesh.dispatch_fence", nth=9),
-            FaultRule(pattern="spill.page_reload", nth=4),
-            FaultRule(pattern="mesh.session_fire", nth=5),
+            FaultRule(pattern="mesh.dispatch_fence", nth=5),
+            FaultRule(pattern="spill.page_reload", nth=3),
+            FaultRule(pattern="mesh.session_fire", nth=6),
             FaultRule(pattern="checkpoint.write.torn", nth=2,
                       kind="drop"),
             FaultRule(pattern="spill.page_compact", nth=1,
@@ -622,7 +751,7 @@ class TestCrashRestoreVerify:
         harvest; exactly-once must still hold."""
         plan = FaultPlan(rules=[
             FaultRule(pattern="harvest.pending_fire", nth=3),
-            FaultRule(pattern="mesh.dispatch_fence", nth=12),
+            FaultRule(pattern="mesh.dispatch_fence", nth=8),
         ])
         r = run_crash_restore_verify(
             _make_session_engine(eight_device_mesh, dispatch_ahead=3),

@@ -1,0 +1,297 @@
+"""The on-device keyBy shuffle (shuffle.mode=device, the default).
+
+A batch goes host->device ONCE as flat padded columns and a single
+compiled program (``build_exchange_scatter``) segment-sorts records
+into per-destination buckets, exchanges them with ``all_to_all`` over
+the mesh axis, and feeds the aggregate scatter — keyBy -> window ->
+aggregate as ONE XLA program. These tests pin the contract the fused
+path must honor:
+
+- staging shapes walk the ``pad_bucket_size`` tiers (bounded program
+  shapes — the recompile smoke gates the runtime signal),
+- output BIT-IDENTICAL to the explicit host fallback
+  (``bucket_by_shard`` + sharded device_put) and to the single-device
+  oracle, under forced paged eviction,
+- a live ``reshard()`` mid-stream in device mode stays
+  oracle-identical,
+- the fence/dispatch-ahead discipline holds against the one-hop ingest
+  (pooled staging buffers are generation-rotated exactly like the host
+  blocks).
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.records import KEY_ID_FIELD, RecordBatch
+from flink_tpu.ops.segment_ops import pad_bucket_size
+from flink_tpu.parallel.shuffle import (
+    ShuffleBufferPool,
+    bucket_by_shard,
+    exchange_chunk_size,
+    stage_device_exchange,
+)
+from flink_tpu.windowing.aggregates import SumAggregate
+from flink_tpu.windowing.sessions import SessionWindower
+
+from tests.test_sessions import keyed_batch
+
+GAP = 100
+
+
+def _session_engine(mesh, mode, **kw):
+    from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+
+    return MeshSessionEngine(gap=GAP, agg=SumAggregate("v"), mesh=mesh,
+                             capacity_per_shard=1 << 14,
+                             shuffle_mode=mode, **kw)
+
+
+def _window_engine(mesh, mode, **kw):
+    from flink_tpu.parallel.sharded_windower import MeshWindowEngine
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+    return MeshWindowEngine(TumblingEventTimeWindows.of(50),
+                            SumAggregate("v"), mesh,
+                            capacity_per_shard=1 << 14,
+                            shuffle_mode=mode, **kw)
+
+
+def _stream(num_keys=24_000, n_steps=8, per_step=6000, seed=17):
+    """Live set far beyond a 1024-slot/shard budget — forced paged
+    eviction, cold fires, reloads (same shape as test_mesh_paged_spill).
+    Values are small integers so float sums are EXACT and bit-identity
+    across data planes is meaningful."""
+    rng = np.random.default_rng(seed)
+    steps = []
+    for s in range(n_steps):
+        keys = rng.integers(0, num_keys, per_step).astype(np.int64)
+        vals = rng.integers(0, 1000, per_step).astype(np.float32)
+        ts = rng.integers(s * 80, s * 80 + 60, per_step).astype(np.int64)
+        steps.append((keys, vals, ts, (s - 1) * 80))
+    steps.append((np.array([0], dtype=np.int64),
+                  np.array([0.0], dtype=np.float32),
+                  np.array([n_steps * 80 + 10_000], dtype=np.int64),
+                  10 ** 9))
+    return steps
+
+
+def _run(engine, steps, reshard_at=None, reshard_to=None):
+    fired = []
+    for i, (keys, vals, ts, wm) in enumerate(steps):
+        if reshard_at is not None and i == reshard_at:
+            engine.reshard(reshard_to)
+        engine.process_batch(keyed_batch(keys, vals, ts))
+        fired.extend(engine.on_watermark(wm))
+    return fired
+
+
+def _sessions_dict(batches):
+    out = {}
+    for b in batches:
+        for r in b.to_rows():
+            out[(r[KEY_ID_FIELD], r["window_start"],
+                 r["window_end"])] = r["sum_v"]
+    return out
+
+
+class TestStaging:
+    def test_chunk_size_walks_pad_tiers(self):
+        assert exchange_chunk_size(0, 8) == 256
+        assert exchange_chunk_size(8 * 256, 8) == 256
+        assert exchange_chunk_size(8 * 256 + 1, 8) == 512
+        assert exchange_chunk_size(65536, 8) == \
+            pad_bucket_size(65536 // 8)
+
+    def test_flat_layout_and_padding_sentinel(self):
+        rng = np.random.default_rng(1)
+        n, P = 1000, 4
+        shards = rng.integers(0, P, n).astype(np.int64)
+        slots = rng.integers(1, 500, n).astype(np.int32)
+        vals = rng.random(n).astype(np.float32)
+        dst, (s_col, v_col), width = stage_device_exchange(
+            shards, P, [slots, vals], fills=[0, 0.0])
+        C = exchange_chunk_size(n, P)
+        assert len(dst) == P * C == len(s_col) == len(v_col)
+        np.testing.assert_array_equal(dst[:n], shards)
+        # padding lanes carry the out-of-range destination and fills
+        assert (dst[n:] == P).all()
+        assert (s_col[n:] == 0).all() and (v_col[n:] == 0.0).all()
+        np.testing.assert_array_equal(s_col[:n], slots)
+        # bucket width: a pad tier of the densest (chunk, dest) pair,
+        # never wider than the chunk itself
+        assert width <= C
+        chunk = np.arange(n) // C
+        pair_max = int(np.bincount(chunk * P + shards,
+                                   minlength=P * P).max())
+        assert width == min(pad_bucket_size(pair_max), C)
+
+    def test_pool_buffers_rotate_by_generation(self):
+        pool = ShuffleBufferPool(generations=2)
+        shards = np.zeros(10, dtype=np.int64)
+        cols = [np.arange(10, dtype=np.int32)]
+        pool.flip()
+        d1, (c1,), _ = stage_device_exchange(shards, 2, cols, [0],
+                                             pool=pool)
+        pool.flip()
+        d2, (c2,), _ = stage_device_exchange(shards, 2, cols, [0],
+                                             pool=pool)
+        pool.flip()
+        d3, (c3,), _ = stage_device_exchange(shards, 2, cols, [0],
+                                             pool=pool)
+        # generation rotation: gen0's buffers are reused on the third
+        # flip, a different generation's never aliased
+        assert d1 is d3 and c1 is c3
+        assert d1 is not d2 and c1 is not c2
+
+
+class TestFusedExchangeProgram:
+    def test_matches_host_bucket_scatter(self, eight_device_mesh):
+        """The fused program's scatter result equals the host
+        bucket_by_shard + scatter_step path bit-for-bit."""
+        import jax
+        import jax.numpy as jnp
+
+        from flink_tpu.parallel.mesh import KEY_AXIS
+        from flink_tpu.parallel.shuffle import build_exchange_scatter
+        from flink_tpu.parallel.sharded_windower import build_mesh_steps
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = eight_device_mesh
+        agg = SumAggregate("v")
+        sharding = NamedSharding(mesh, P(KEY_AXIS))
+        cap = 4096
+        rng = np.random.default_rng(3)
+        n = 5000
+        shards = rng.integers(0, 8, n).astype(np.int64)
+        slots = rng.integers(1, cap, n).astype(np.int32)
+        vals = rng.integers(0, 100, n).astype(np.float32)
+
+        def fresh_accs():
+            return tuple(
+                jax.device_put(jnp.full((8, cap), l.identity,
+                                        dtype=l.dtype), sharding)
+                for l in agg.leaves)
+
+        xstep = build_exchange_scatter(mesh, agg, valued=False)
+        dst, staged, width = stage_device_exchange(
+            shards, 8, [slots, vals], fills=[0, 0.0])
+        put = jax.device_put((dst, *staged), sharding)
+        dev = jax.device_get(list(xstep(
+            fresh_accs(), put[0], put[1], tuple(put[2:]), width)))
+
+        scatter = build_mesh_steps(mesh, agg)[0]
+        counts, blocked = bucket_by_shard(shards, 8, [slots, vals],
+                                          fills=[0, 0.0])
+        host = jax.device_get(list(scatter(
+            fresh_accs(), jax.device_put(blocked[0], sharding),
+            (jax.device_put(blocked[1], sharding),))))
+        for d, h in zip(dev, host):
+            np.testing.assert_array_equal(np.asarray(d), np.asarray(h))
+
+    def test_invalid_mode_rejected(self, eight_device_mesh):
+        with pytest.raises(ValueError, match="shuffle_mode"):
+            _session_engine(eight_device_mesh, "netty")
+
+
+class TestDeviceModeEngines:
+    def test_sessions_bit_identical_to_host_mode_under_eviction(
+            self, eight_device_mesh):
+        steps = _stream()
+        dev = _session_engine(eight_device_mesh, "device",
+                              max_device_slots=1024)
+        host = _session_engine(eight_device_mesh, "host",
+                               max_device_slots=1024)
+        d_dev = _sessions_dict(_run(dev, steps))
+        d_host = _sessions_dict(_run(host, steps))
+        assert len(d_dev) > 0 and set(d_dev) == set(d_host)
+        diff = [k for k in d_dev if d_dev[k] != d_host[k]]
+        assert not diff, f"{len(diff)} windows differ, e.g. {diff[:3]}"
+        # the run genuinely thrashed the budget (cold fires, reloads)
+        c = dev.spill_counters()
+        assert c["pages_evicted"] > 0 and c["rows_reloaded"] > 0
+
+    def test_sessions_match_single_device_oracle(self,
+                                                 eight_device_mesh):
+        steps = _stream(seed=23)
+        dev = _session_engine(eight_device_mesh, "device",
+                              max_device_slots=1024)
+        single = SessionWindower(GAP, SumAggregate("v"),
+                                 capacity=1 << 15)
+        d_dev = _sessions_dict(_run(dev, steps))
+        d_ref = _sessions_dict(_run(single, steps))
+        assert len(d_ref) > 0 and set(d_dev) == set(d_ref)
+        for k in d_ref:
+            assert d_dev[k] == pytest.approx(d_ref[k], rel=1e-4), k
+
+    def test_windows_bit_identical_to_host_mode_under_eviction(
+            self, eight_device_mesh):
+        steps = _stream(seed=29)
+        dev = _window_engine(eight_device_mesh, "device",
+                             max_device_slots=4096)
+        host = _window_engine(eight_device_mesh, "host",
+                              max_device_slots=4096)
+        d_dev = _sessions_dict(_run(dev, steps))
+        d_host = _sessions_dict(_run(host, steps))
+        assert len(d_dev) > 0 and set(d_dev) == set(d_host)
+        diff = [k for k in d_dev if d_dev[k] != d_host[k]]
+        assert not diff, f"{len(diff)} windows differ, e.g. {diff[:3]}"
+
+    def test_two_phase_partial_batches_use_valued_exchange(
+            self, eight_device_mesh):
+        """Locally pre-aggregated (two-phase) batches route through the
+        VALUED exchange variant and stay equal to the host path."""
+        from flink_tpu.runtime.local_agg import PARTIAL_LEAF_PREFIX
+
+        rng = np.random.default_rng(7)
+        n = 4000
+        keys = rng.integers(0, 800, n).astype(np.int64)
+        vals = rng.integers(0, 50, n).astype(np.float32)
+        ts = rng.integers(0, 40, n).astype(np.int64)
+
+        def partial_batch():
+            b = keyed_batch(keys, vals, ts)
+            return b.with_column(PARTIAL_LEAF_PREFIX + "0", vals)
+
+        out = {}
+        for mode in ("device", "host"):
+            eng = _window_engine(eight_device_mesh, mode)
+            eng.process_batch(partial_batch())
+            out[mode] = _sessions_dict(eng.on_watermark(10 ** 9))
+        assert len(out["device"]) > 0
+        assert out["device"] == out["host"]
+
+    def test_live_reshard_mid_stream_in_device_mode(
+            self, eight_device_mesh):
+        """A live reshard() (8 -> 4 shards) mid-stream with the device
+        data plane active stays oracle-identical — the rebuilt mesh
+        plane rebuilds its exchange programs with it."""
+        steps = _stream(seed=31)
+        dev = _session_engine(eight_device_mesh, "device",
+                              max_device_slots=1024)
+        single = SessionWindower(GAP, SumAggregate("v"),
+                                 capacity=1 << 15)
+        fired = _run(dev, steps, reshard_at=4, reshard_to=4)
+        assert dev.P == 4 and dev.shuffle_mode == "device"
+        d_dev = _sessions_dict(fired)
+        d_ref = _sessions_dict(_run(single, steps))
+        assert len(d_ref) > 0 and set(d_dev) == set(d_ref)
+        for k in d_ref:
+            assert d_dev[k] == pytest.approx(d_ref[k], rel=1e-4), k
+
+    def test_operator_wires_ctx_shuffle_mode(self, eight_device_mesh):
+        """The operator layer hands OperatorContext.shuffle_mode (the
+        shuffle.mode config) through to the mesh engine."""
+        import jax
+
+        from flink_tpu.runtime.operators import (
+            OperatorContext,
+            SessionWindowAggOperator,
+        )
+
+        for mode in ("host", "device"):
+            op = SessionWindowAggOperator(gap=GAP, agg=SumAggregate("v"),
+                                          key_field="k")
+            op.open(OperatorContext(
+                parallelism=min(8, len(jax.devices())),
+                shuffle_mode=mode))
+            assert op.windower.shuffle_mode == mode

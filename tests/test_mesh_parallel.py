@@ -55,7 +55,8 @@ class TestShuffle:
         shards = rng.integers(0, 4, 100)
         vals = rng.random(100).astype(np.float32)
         counts, (block,), order = bucket_by_shard(
-            shards, 4, columns=[vals], fills=[0.0], min_bucket=16)
+            shards, 4, columns=[vals], fills=[0.0], min_bucket=16,
+            want_order=True)
         assert counts.sum() == 100
         for p in range(4):
             got = np.sort(block[p, :counts[p]])

@@ -53,6 +53,17 @@ class TestSessionBasics:
         fired = fired_to_dict(w.on_watermark(219))
         assert fired == {(1, 0, 220): 6.0}
 
+    def test_pathological_timestamp_span_takes_lexsort_fallback(self):
+        """A batch whose timestamp span exceeds the packed-sort bits
+        (sentinel/corrupt timestamps) must fall back to lexsort, not
+        crash sessionization with a negative shift."""
+        w = SessionWindower(gap=100, agg=SumAggregate("v"), capacity=1024)
+        w.process_batch(keyed_batch([1, 2], [1.0, 2.0],
+                                    [-(1 << 62), 1 << 61]))
+        fired = fired_to_dict(w.on_watermark(1 << 62))
+        assert fired == {(1, -(1 << 62), -(1 << 62) + 100): 1.0,
+                         (2, 1 << 61, (1 << 61) + 100): 2.0}
+
     def test_gap_splits_sessions(self):
         w = SessionWindower(gap=10, agg=SumAggregate("v"), capacity=1024)
         w.process_batch(keyed_batch([1, 1], [1, 2], [0, 100]))

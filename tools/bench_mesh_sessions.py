@@ -12,19 +12,37 @@ async (``on_watermark(async_ok=True)``) and harvested coalesced while
 the host buckets the next batch, and the engine's own dispatch-ahead
 overlaps host prep of batch k+1 with the device step of batch k.
 
+The keyBy data plane follows the engine default (``shuffle.mode=device``
+— the fused in-program exchange: one flat ``device_put``, segment sort +
+``all_to_all`` + scatter in ONE compiled program); set
+``BENCH_MESH_SHUFFLE_MODE=host`` to drive the explicit host-bucketing
+fallback.
+
 Methodology matches ``bench.py``: one warm pass compiles the step
 programs, then BENCH_MESH_REPS (default 3) measured reps; the headline
 is the MEDIAN rep, with ``best_events_per_s`` / ``rep_events_per_s`` as
 secondary fields. Each rep also reports a host-prep vs device-step vs
-harvest wall-time breakdown plus the spill counters.
+harvest wall-time breakdown plus the spill counters. The breakdown
+attributes DEVICE work surfacing inside ``process_batch`` — dispatch-
+fence blocks plus the engine-timed inline device interactions (the
+fused exchange dispatch, eviction gathers + D2H, reload puts; the CPU
+backend executes them inline in the dispatch call) — to
+``device_step_s``, so ``host_prep_s`` / ``host_prep_fraction`` measure
+genuine host work: sessionization, slot resolution, flat staging.
 
-Regression gate: with ``BENCH_MESH_AMP_BUDGET`` set (a ratio), the
-process exits non-zero when the page-rewrite amplification
-``(rows_split_on_reload + rows_compacted) / rows_reloaded`` exceeds it
-— tools/tier1.sh pins this so reload write-amplification cannot
-silently return under ANY counter (the old split-on-reload design sat
-at ~16x; the tombstone design's only rewrites are threshold
-compactions, measured ~0.16x).
+Regression gates:
+
+- ``BENCH_MESH_AMP_BUDGET`` (a ratio): exit non-zero when the
+  page-rewrite amplification ``(rows_split_on_reload + rows_compacted)
+  / rows_reloaded`` exceeds it — reload write-amplification cannot
+  silently return under ANY counter (the old split-on-reload design
+  sat at ~16x; the tombstone design's only rewrites are threshold
+  compactions).
+- ``BENCH_HOST_PREP_BUDGET`` (a fraction, device mode only): exit
+  non-zero when ``host_prep_fraction`` exceeds it — the regression
+  class where exchange work silently moves back onto the host.
+
+tools/tier1.sh pins both.
 
     BENCH_SKIP_PROBE=1 JAX_PLATFORMS=cpu python tools/bench_mesh_sessions.py
 """
@@ -66,7 +84,9 @@ def run(total: int, mesh, batch: int = 1 << 16):
 
     eng = MeshSessionEngine(GAP_MS, SumAggregate("v"), mesh,
                             capacity_per_shard=BUDGET_PER_SHARD,
-                            max_device_slots=BUDGET_PER_SHARD)
+                            max_device_slots=BUDGET_PER_SHARD,
+                            shuffle_mode=os.environ.get(
+                                "BENCH_MESH_SHUFFLE_MODE", "device"))
     rng = np.random.default_rng(3)
     produced = 0
     fired = 0
@@ -104,16 +124,29 @@ def run(total: int, mesh, batch: int = 1 << 16):
         fired += len(pending.popleft().harvest())
     t_harvest += time.perf_counter() - t5
     dt = time.perf_counter() - t0
+    # device work surfacing inside process_batch — fence blocks (device
+    # work the pipeline could not hide) plus the inline device
+    # interactions the engine itself timed (the fused in-program
+    # exchange dispatch, eviction gathers + D2H, reload puts; on the
+    # CPU backend these execute inline in the dispatch call) — is
+    # attributed to DEVICE time, so host_prep measures genuine host
+    # work: sessionization, slot resolution, flat staging
+    dev_in_prep = (float(getattr(eng, "pipeline_wait_s", 0.0))
+                   + float(getattr(eng, "device_inline_s", 0.0)))
+    host_prep = max(t_prep - dev_in_prep, 0.0)
     breakdown = {
-        # host_prep: bucketing + slot resolution + scatter dispatch,
-        # including the engine's in-line device waits (eviction
-        # gathers, dispatch fences) — the residue pipelining can't hide
-        "host_prep_s": round(t_prep, 3),
+        # host_prep: sessionization + slot resolution + flat staging
+        # (device mode) / bucketing (host mode) + dispatch bookkeeping,
+        # EXCLUDING fence blocks and inline device interactions
+        "host_prep_s": round(host_prep, 3),
         # device_step: fire dispatch + the fire path's synchronous
         # device work (page reloads / cohort evictions for cold fires)
-        "device_step_s": round(t_fire, 3),
+        # + the device share carved out of host prep
+        "device_step_s": round(t_fire + dev_in_prep, 3),
         # harvest: materializing fired results on host (coalesced)
         "harvest_s": round(t_harvest, 3),
+        "device_in_prep_s": round(dev_in_prep, 3),
+        "host_prep_fraction": round(host_prep / dt, 4),
         "total_s": round(dt, 3),
     }
     return total / dt, fired, eng.spill_counters(), breakdown
@@ -143,6 +176,7 @@ def main():
         reps.append((eps, fired, counters, breakdown))
     by_rate = sorted(reps, key=lambda r: r[0])
     eps, fired, counters, breakdown = by_rate[len(by_rate) // 2]  # median
+    mode = os.environ.get("BENCH_MESH_SHUFFLE_MODE", "device")
     line = {
         "metric": "mesh_sessions_10m_keys_events_per_sec",
         "value": round(eps, 1),
@@ -151,14 +185,29 @@ def main():
         "rep_events_per_s": [round(r[0], 1) for r in reps],
         "backend": jax.devices()[0].platform,
         "mesh_shards": P,
+        "shuffle_mode": mode,
         "sessions_fired": fired,
         "spill": counters,
         "breakdown": breakdown,
+        "host_prep_fraction": breakdown["host_prep_fraction"],
         "shape": (f"400k ev/s event time, 2 s gap, ~800k live sessions "
                   f"vs {P}x{BUDGET_PER_SHARD // 1024}k device slots "
                   f"(paged spill per shard), 10M distinct keys, "
-                  f"pipelined driver"),
+                  f"pipelined driver, {mode}-mode shuffle"),
     }
+    prep_budget = os.environ.get("BENCH_HOST_PREP_BUDGET")
+    if prep_budget is not None and mode == "device":
+        # the device-shuffle contract: host prep is a MINORITY share of
+        # wall clock (the exchange runs inside the compiled program) —
+        # a regression that moves exchange work back onto the host
+        # blows this fraction even when throughput noise hides it
+        if breakdown["host_prep_fraction"] > float(prep_budget):
+            line["error"] = (
+                f"host-prep fraction regressed: "
+                f"{breakdown['host_prep_fraction']:.3f} of wall clock "
+                f"> budget {prep_budget} in device-shuffle mode")
+            print(json.dumps(line))
+            sys.exit(1)
     budget = os.environ.get("BENCH_MESH_AMP_BUDGET")
     if budget is not None:
         # every host-side page REWRITE per row actually reloaded:

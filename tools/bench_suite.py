@@ -325,6 +325,11 @@ def main():
             extra += (f" — host-prep {bd['host_prep_s']}s / device-step "
                       f"{bd['device_step_s']}s / harvest "
                       f"{bd['harvest_s']}s of {bd['total_s']}s")
+            if "host_prep_fraction" in bd:
+                extra += (f" (host-prep fraction "
+                          f"{bd['host_prep_fraction']})")
+        if r.get("shuffle_mode"):
+            extra += f", {r['shuffle_mode']}-mode shuffle"
         if r.get("fire_latency_ms"):
             lat = r["fire_latency_ms"]
             extra += (f" (fire p50 {lat['p50']:.0f} ms / "
@@ -347,6 +352,19 @@ def main():
         "(NOTES_r6.md): `rows_split_on_reload` stays ~0 by design, and "
         "`tools/tier1.sh` gates on the page-rewrite amplification "
         "`(rows_split_on_reload + rows_compacted) / rows_reloaded`.")
+    lines.append("")
+    lines.append(
+        "Fused-path methodology (r11): the mesh-sessions row runs "
+        "`shuffle.mode=device` — flat columns go up in ONE `device_put` "
+        "and a single compiled program segment-sorts, "
+        "`all_to_all`-exchanges and scatter-aggregates them "
+        "(`parallel/shuffle.py`; design in NOTES_r11.md). The breakdown "
+        "attributes device work surfacing inside `process_batch` "
+        "(dispatch-fence blocks + the engine-timed inline device "
+        "interactions) to `device_step_s`, so `host_prep_fraction` "
+        "measures genuine host work (sessionization, slot resolution, "
+        "flat staging); `tools/tier1.sh` gates it via "
+        "`BENCH_HOST_PREP_BUDGET` in device mode.")
     lines.append("")
     lines.append(
         "The queryable-lookups row is `tools/serving_smoke.py` at bench "

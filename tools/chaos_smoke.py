@@ -1,9 +1,11 @@
 """Chaos smoke: a seeded crash-restore-verify run for the tier-1 gate.
 
-Drives the mesh session engine (paged spill, forced eviction, dispatch-
-ahead) through a keyed-session stream with periodic checkpoints while a
-fault plan injects TWO engine crashes and ONE torn checkpoint write.
-The run FAILS (non-zero exit) if
+Drives the mesh session engine (paged spill, dispatch-ahead,
+device-mode shuffle — the default) through a keyed-session stream with
+periodic checkpoints while a fault plan injects THREE engine crashes
+(a dispatch fence, a broken page reload, and the device data plane
+dying mid-batch AFTER the fused exchange+scatter dispatch) and ONE torn
+checkpoint write. The run FAILS (non-zero exit) if
 
 - the committed output diverges from the fault-free single-device
   oracle by even one window (the exactly-once claim), or
@@ -69,9 +71,15 @@ def main() -> int:
     mesh = make_mesh(8)
     plan = FaultPlan(rules=[
         # crash 1: fence failure mid-dispatch-ahead (batches in flight)
-        FaultRule(pattern="mesh.dispatch_fence", nth=9, kind="raise"),
+        FaultRule(pattern="mesh.dispatch_fence", nth=5, kind="raise"),
         # crash 2: a page reload that stays broken past the retry budget
-        FaultRule(pattern="spill.page_reload", nth=4, kind="raise"),
+        FaultRule(pattern="spill.page_reload", nth=3, kind="raise"),
+        # crash 3: the device data plane dies mid-batch, AFTER the
+        # fused exchange+scatter was dispatched (shuffle.mode=device is
+        # the engine default — the post-dispatch site is on every
+        # batch's path)
+        FaultRule(pattern="shuffle.device_exchange", nth=10,
+                  kind="raise"),
         # the torn write: 2nd checkpoint's rename lands, its bytes don't
         FaultRule(pattern="checkpoint.write.torn", nth=2, kind="drop"),
     ])
@@ -108,12 +116,12 @@ def main() -> int:
     print(json.dumps(row))
     failures = []
     want_points = {"mesh.dispatch_fence", "spill.page_reload",
-                   "checkpoint.write.torn"}
+                   "shuffle.device_exchange", "checkpoint.write.torn"}
     missed = want_points - set(report.faults_injected)
     if missed:
         failures.append(f"planned faults never injected: {sorted(missed)}")
-    if report.crashes != 2:
-        failures.append(f"expected exactly 2 crashes, got {report.crashes}")
+    if report.crashes != 3:
+        failures.append(f"expected exactly 3 crashes, got {report.crashes}")
     if report.corrupt_checkpoints_skipped < 1:
         failures.append("the torn checkpoint was never detected/skipped")
     if failures:

@@ -184,11 +184,14 @@ class PackageIndex:
 
     # ----------------------------------------------------------- reachability
 
-    def reachable(self, roots: Dict[str, Iterable[str]]
+    def reachable(self, roots: Dict[str, Iterable[str]],
+                  module_roots: Optional[Dict[str, Iterable[str]]] = None
                   ) -> Dict[str, FunctionInfo]:
         """BFS over the call graph from {class name: [method, ...]}
-        roots. Returns {qualname: FunctionInfo} of every function that
-        can run as part of those entry points."""
+        roots, plus optional {module: [function, ...]} MODULE-LEVEL
+        roots (hot entry points that are plain functions). Returns
+        {qualname: FunctionInfo} of every function that can run as part
+        of those entry points."""
         frontier: List[FunctionInfo] = []
         for cls, names in roots.items():
             for name in names:
@@ -199,6 +202,11 @@ class PackageIndex:
                 for methods in self.class_methods.get(cls, []):
                     if name in methods:
                         frontier.append(methods[name])
+        for mod, names in (module_roots or {}).items():
+            for name in names:
+                fi = self.module_funcs.get((mod, name))
+                if fi is not None:
+                    frontier.append(fi)
         seen: Dict[str, FunctionInfo] = {}
         while frontier:
             fi = frontier.pop()

@@ -246,6 +246,20 @@ HOT_ROOTS: Dict[str, Tuple[str, ...]] = {
     "PendingFire": ("harvest", "ready"),
 }
 
+#: module-level hot entry points: the device data plane's per-batch
+#: staging and the fused exchange+scatter builder are plain functions
+#: (flink_tpu/parallel/shuffle.py), not methods — rooting them
+#: EXPLICITLY keeps the fused path guarded even if an engine stops
+#: calling through a rooted method (the name-based walk would
+#: otherwise silently lose the whole device exchange)
+HOT_MODULE_ROOTS: Dict[str, Tuple[str, ...]] = {
+    "flink_tpu.parallel.shuffle": (
+        "stage_device_exchange",
+        "bucket_by_shard",
+        "_build_exchange_scatter",
+    ),
+}
+
 
 @register
 class HostSyncInHotPath(Checker):
@@ -257,7 +271,9 @@ class HostSyncInHotPath(Checker):
         files = project.package_files("flink_tpu")
         index = PackageIndex(files)
         reachable = index.reachable(
-            {c: list(m) for c, m in HOT_ROOTS.items()})
+            {c: list(m) for c, m in HOT_ROOTS.items()},
+            module_roots={m: list(f)
+                          for m, f in HOT_MODULE_ROOTS.items()})
         for fi in reachable.values():
             tp = taint_function(fi.node, set(), device_mode=True)
             yield from self._scan(fi, tp)

@@ -166,6 +166,75 @@ def _drive_interleaved(engines, total, rep, serve_keys):
     return fired
 
 
+#: batch sizes for the device-shuffle tier walk: per-shard chunk tiers
+#: pad_bucket_size(ceil(b / 8)) cover {256, 512, 1024} twice over, so a
+#: fused exchange program keyed on anything finer than the tier (raw
+#: batch length, bucket width off the tier lattice) compiles mid-rep
+#: and fails the sentinel
+TIER_WALK_WARM = (8192, 4096, 2048, 6000, 3000, 1900)
+TIER_WALK_RUN = (8000, 3500, 2200, 7000, 2600, 1800)
+
+
+def _drive_sized(engine, sizes, offset, rng_seed=11):
+    """Drive ``engine`` with one batch per entry of ``sizes`` (event
+    time advancing so sessions genuinely fire), then flush."""
+    from flink_tpu.core.records import (
+        KEY_ID_FIELD,
+        TIMESTAMP_FIELD,
+        RecordBatch,
+    )
+
+    rng = np.random.default_rng(rng_seed)
+    fired = 0
+    t = offset
+    for b in sizes:
+        keys = rng.integers(0, NUM_KEYS, b).astype(np.int64)
+        ts = t + np.arange(b, dtype=np.int64) // RECORDS_PER_MS
+        engine.process_batch(RecordBatch({
+            KEY_ID_FIELD: keys,
+            "v": np.ones(b, dtype=np.float32),
+            TIMESTAMP_FIELD: ts,
+        }))
+        t = int(ts[-1]) + 1
+        fired += sum(len(x)
+                     for x in engine.on_watermark(t - GAP_MS))
+    fired += sum(len(x)
+                 for x in engine.on_watermark(t + 100 * GAP_MS))
+    return fired
+
+
+def check_device_shuffle_tiers(mesh, budget):
+    """Device-shuffle phase: after one warmup engine walks every
+    pad_bucket_size tier (both size lists), a FRESH engine replaying
+    SHIFTED batch sizes — different lengths, same tier lattice — must
+    compile NOTHING. This is exactly the recompile surface the fused
+    exchange adds: its program shapes are (chunk tier, bucket-width
+    tier), so a shape leak past the tiers shows up here as a
+    steady-state compile."""
+    from flink_tpu.observe import RecompileSentinel
+
+    warm_eng = _make_sessions(mesh, budget)
+    assert warm_eng.shuffle_mode == "device"
+    warm_fired = _drive_sized(warm_eng, TIER_WALK_WARM, offset=0)
+    warm_fired += _drive_sized(warm_eng, TIER_WALK_RUN,
+                               offset=1 << 22)
+    ok = True
+    engine = _make_sessions(mesh, budget)
+    with RecompileSentinel(
+            max_compiles=0,
+            max_transfers=max(len(TIER_WALK_RUN) * 8, 64),
+            label="device-shuffle tier walk") as s:
+        fired = _drive_sized(engine, TIER_WALK_RUN, offset=1 << 23)
+    evicted = int(engine.spill_counters().get("rows_evicted", 0))
+    print(f"  device-shuffle tiers: fired={fired} "
+          f"compiles={s.compiles} transfers={s.transfers} "
+          f"rows_evicted={evicted}")
+    if fired == 0 or warm_fired == 0:
+        print("FAIL: device-shuffle tiers: zero fires — vacuous run")
+        ok = False
+    return ok
+
+
 def check_second_job_on_warm_cluster(mesh, total, budget):
     """The tenancy contract: after job A warms the cluster (ingest,
     fire, evict AND serving programs), a SECOND job's fresh engines on
@@ -233,6 +302,12 @@ def main():
         except Exception as e:  # SteadyStateViolation included
             print(f"FAIL: {name}: {e}")
             ok = False
+    try:
+        ok = check_device_shuffle_tiers(
+            mesh, budgets["mesh-sessions"]) and ok
+    except Exception as e:  # SteadyStateViolation included
+        print(f"FAIL: device-shuffle tiers: {e}")
+        ok = False
     try:
         ok = check_second_job_on_warm_cluster(
             mesh, total, budgets["mesh-sessions"]) and ok
