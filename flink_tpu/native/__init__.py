@@ -1,52 +1,168 @@
 """Native (C++) runtime components, loaded via ctypes.
 
 Build happens on demand with g++ (no pip deps): the shared object is cached
-under ``native/build/``. Set ``FLINK_TPU_NO_NATIVE=1`` to force the pure
+under ``native/build/`` next to a source-hash stamp, so editing a ``.cpp``
+always triggers a rebuild (mtime alone lies after checkouts/copies). Set
+``FLINK_TPU_NO_NATIVE=1`` (or ``FLINK_TPU_NATIVE=0``) to force the pure
 Python fallbacks (used in tests to cover both paths).
+
+Every function fetched off a CDLL returned by :func:`load_native` must
+declare ``argtypes`` AND ``restype`` before its first call — a missing
+``restype`` silently truncates 64-bit returns (and pointers) to C int.
+flint rule NAT01 enforces this statically against
+:data:`NATIVE_SYMBOL_PREFIXES`.
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
-import sysconfig
 import threading
-from typing import Optional
+from typing import Dict, Optional
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+
+#: every exported symbol of every native library starts with one of
+#: these — the registry flint's NAT01 cross-checks ctypes declarations
+#: and call sites against (the stringly-typed-registry discipline of
+#: chaos.KNOWN_FAULT_POINTS, applied to the C ABI)
+NATIVE_SYMBOL_PREFIXES = ("sm_", "sx_", "codec_", "ngen_")
+
+#: the libraries build_all() compiles (source basename -> .so basename)
+NATIVE_LIBS = {
+    "slotmap": ("slotmap.cpp", "_slotmap.so"),
+    "sessions": ("sessions.cpp", "_sessions.so"),
+    "codec": ("codec.cpp", "_codec.so"),
+    "datagen": ("datagen.cpp", "_datagen.so"),
+}
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
+def native_disabled() -> bool:
+    return (os.environ.get("FLINK_TPU_NO_NATIVE") == "1"
+            or os.environ.get("FLINK_TPU_NATIVE") == "0")
+
+
+_build_token: Optional[str] = None
+
+
+def _build_provenance() -> str:
+    """Compiler + host token folded into the artifact stamp: the build
+    uses ``-march=native``, so an artifact is only valid for the
+    (toolchain, CPU) that produced it — a copied build/ directory from
+    a newer microarchitecture would otherwise load and SIGILL
+    mid-suite. Cached per process (one g++ subprocess)."""
+    global _build_token
+    if _build_token is None:
+        try:
+            gxx = subprocess.run(["g++", "-dumpfullversion"],
+                                 capture_output=True, timeout=10,
+                                 text=True).stdout.strip()
+        except Exception:
+            gxx = "unknown"
+        cpu = ""
+        try:
+            with open("/proc/cpuinfo") as f:
+                for line in f:
+                    if line.startswith("model name"):
+                        cpu = line.split(":", 1)[1].strip()
+                        break
+        except OSError:
+            pass
+        import platform
+
+        _build_token = f"g++={gxx};arch={platform.machine()};cpu={cpu}"
+    return _build_token
+
+
+def _source_hash(src: str) -> str:
+    h = hashlib.sha256()
+    with open(src, "rb") as f:
+        h.update(f.read())
+    h.update(b"\x00" + _build_provenance().encode())
+    return h.hexdigest()
+
+
 def load_native(src_basename: str, so_basename: str) -> Optional[ctypes.CDLL]:
     """Compile-on-demand ctypes loader shared by every native component
-    (slotmap, codec). Returns the CDLL, or None when disabled
-    (FLINK_TPU_NO_NATIVE=1) or the toolchain/compile is unavailable.
-    The compile writes to a temp name and os.replace()s it into place so
-    concurrent processes never load a half-written .so."""
-    if os.environ.get("FLINK_TPU_NO_NATIVE") == "1":
+    (slotmap, sessions, codec, datagen). Returns the CDLL, or None when
+    disabled (FLINK_TPU_NO_NATIVE=1 / FLINK_TPU_NATIVE=0) or the
+    toolchain/compile is unavailable.
+
+    Staleness: the cached ``.so`` is paired with a ``.srchash`` stamp
+    holding the sha256 of the source it was built from PLUS the build
+    provenance (g++ version, machine, CPU model — the build uses
+    ``-march=native``); a mismatch (or a missing stamp) forces a
+    rebuild, so editing the ``.cpp`` can never load yesterday's binary
+    and a build/ directory copied from a different host can never load
+    the wrong microarchitecture's code — mtime comparison alone breaks
+    under git checkouts and file copies that preserve timestamps. The
+    compile is flock-guarded (concurrent processes build once) and
+    writes to a temp name, os.replace()d into place — the .so first,
+    the stamp after, so a crash between the two re-runs the build
+    instead of trusting a half-updated pair.
+    """
+    if native_disabled():
         return None
     src = os.path.join(_REPO_ROOT, "native", src_basename)
     so_path = os.path.join(_BUILD_DIR, so_basename)
-    if not os.path.exists(so_path) or (
-            os.path.exists(src)
-            and os.path.getmtime(src) > os.path.getmtime(so_path)):
-        os.makedirs(_BUILD_DIR, exist_ok=True)
-        tmp = so_path + f".tmp.{os.getpid()}"
-        cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-               "-std=c++17", src, "-o", tmp]
+    if not os.path.exists(src):
+        # sourceless deployment: a prebuilt artifact is all there is —
+        # no staleness question to answer
         try:
-            r = subprocess.run(cmd, capture_output=True, timeout=120)
-            if r.returncode != 0 or not os.path.exists(tmp):
-                return None
-            os.replace(tmp, so_path)
-        except Exception:
+            return ctypes.CDLL(so_path) if os.path.exists(so_path) else None
+        except OSError:
             return None
+    stamp_path = so_path + ".srchash"
+    want_hash = _source_hash(src)
+
+    def _stale() -> bool:
+        if not os.path.exists(so_path):
+            return True
+        try:
+            with open(stamp_path, "r") as f:
+                return f.read().strip() != want_hash
+        except OSError:
+            return True  # stampless artifact: provenance unknown
+
+    if _stale():
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        lock_path = so_path + ".lock"
+        try:
+            lock_f = open(lock_path, "w")
+        except OSError:
+            return None
+        try:
+            try:
+                import fcntl
+
+                fcntl.flock(lock_f, fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                pass  # no flock (non-POSIX): fall back to tmp+rename only
+            if _stale():  # a racing process may have built while we waited
+                tmp = so_path + f".tmp.{os.getpid()}"
+                cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+                       "-std=c++17", src, "-o", tmp]
+                try:
+                    r = subprocess.run(cmd, capture_output=True, timeout=120)
+                    if r.returncode != 0 or not os.path.exists(tmp):
+                        return None
+                    os.replace(tmp, so_path)
+                    stamp_tmp = stamp_path + f".tmp.{os.getpid()}"
+                    with open(stamp_tmp, "w") as f:
+                        f.write(want_hash)
+                    os.replace(stamp_tmp, stamp_path)
+                except Exception:
+                    return None
+        finally:
+            lock_f.close()
     try:
         return ctypes.CDLL(so_path)
     except OSError:
@@ -68,6 +184,7 @@ def load_slotmap() -> Optional[ctypes.CDLL]:
         P = c.POINTER
         lib.sm_create.restype = vp
         lib.sm_create.argtypes = [i64, i64]
+        lib.sm_destroy.restype = None
         lib.sm_destroy.argtypes = [vp]
         lib.sm_capacity.restype = i64
         lib.sm_capacity.argtypes = [vp]
@@ -86,6 +203,8 @@ def load_slotmap() -> Optional[ctypes.CDLL]:
         lib.sm_erase.argtypes = [vp, i64, P(i64), P(i64), P(i32)]
         lib.sm_lookup.restype = None
         lib.sm_lookup.argtypes = [vp, i64, P(i64), P(i64), P(i32)]
+        lib.sm_verify.restype = None
+        lib.sm_verify.argtypes = [vp, i64, P(i64), P(i64), P(i32), P(i32)]
         lib.sm_group_rows.restype = i64
         lib.sm_group_rows.argtypes = [P(i64), i64, P(i64), P(i32)]
         lib.sm_pane_ingest.restype = i32
@@ -101,6 +220,108 @@ def load_slotmap() -> Optional[ctypes.CDLL]:
 
 def slotmap_available() -> bool:
     return load_slotmap() is not None
+
+
+_sessions_lib: Optional[ctypes.CDLL] = None
+_sessions_tried = False
+
+
+def load_sessions() -> Optional[ctypes.CDLL]:
+    """The native session-metadata plane (native/sessions.cpp), or None.
+
+    One fused C sweep per batch replaces the numpy hot loop of
+    ``windowing/session_meta.py``: sessionize + absorb + fire-candidate
+    maintenance in one pass, with the session's device slot folded into
+    the metadata row (see flink_tpu/windowing/session_native.py).
+    """
+    global _sessions_lib, _sessions_tried
+    with _lock:
+        if _sessions_tried:
+            return _sessions_lib
+        _sessions_tried = True
+        lib = load_native("sessions.cpp", "_sessions.so")
+        if lib is None:
+            return None
+        c = ctypes
+        i64, i32, u8, vp = (c.c_int64, c.c_int32, c.c_uint8, c.c_void_p)
+        P = c.POINTER
+        lib.sx_create.restype = vp
+        lib.sx_create.argtypes = [i64, i64]
+        lib.sx_destroy.restype = None
+        lib.sx_destroy.argtypes = [vp]
+        lib.sx_capacity.restype = i64
+        lib.sx_capacity.argtypes = [vp]
+        lib.sx_used.restype = i64
+        lib.sx_used.argtypes = [vp]
+        lib.sx_keys.restype = P(i64)
+        lib.sx_keys.argtypes = [vp]
+        lib.sx_starts.restype = P(i64)
+        lib.sx_starts.argtypes = [vp]
+        lib.sx_ends.restype = P(i64)
+        lib.sx_ends.argtypes = [vp]
+        lib.sx_sids.restype = P(i64)
+        lib.sx_sids.argtypes = [vp]
+        lib.sx_dslots.restype = P(i32)
+        lib.sx_dslots.argtypes = [vp]
+        lib.sx_used_mask.restype = P(u8)
+        lib.sx_used_mask.argtypes = [vp]
+        lib.sx_lookup.restype = None
+        lib.sx_lookup.argtypes = [vp, i64, P(i64), P(i32)]
+        lib.sx_insert.restype = i32
+        lib.sx_insert.argtypes = [vp, i64, P(i64), P(i32)]
+        lib.sx_erase_rows.restype = None
+        lib.sx_erase_rows.argtypes = [vp, i64, P(i32)]
+        lib.sx_lookup1.restype = i32
+        lib.sx_lookup1.argtypes = [vp, i64]
+        lib.sx_insert1.restype = i32
+        lib.sx_insert1.argtypes = [vp, i64]
+        lib.sx_erase1.restype = None
+        lib.sx_erase1.argtypes = [vp, i32]
+        lib.sx_multi_add.restype = None
+        lib.sx_multi_add.argtypes = [vp, i64]
+        lib.sx_multi_remove.restype = None
+        lib.sx_multi_remove.argtypes = [vp, i64]
+        lib.sx_multi_count.restype = i64
+        lib.sx_multi_count.argtypes = [vp]
+        lib.sx_absorb.restype = i64
+        lib.sx_absorb.argtypes = [vp, i64, P(i64), P(i64),  # n, keys, ts
+                                  i64, i64, i64, i64,  # gap, late, mfw, sid
+                                  P(i64), P(i64),      # order, rec_to_sess
+                                  P(i64), P(i64), P(i64), P(i64),  # k/s/e/sid
+                                  P(i32), P(i32), P(u8),  # slot/row/flags
+                                  P(i64)]              # out n_fast
+        lib.sx_fold.restype = None
+        lib.sx_fold.argtypes = [vp, i64, P(i64), P(i64), P(i32)]
+        lib.sx_fold_rows.restype = None
+        lib.sx_fold_rows.argtypes = [vp, i64, P(i32), P(i64), P(i32)]
+        lib.sx_push_chunk.restype = None
+        lib.sx_push_chunk.argtypes = [vp, i64, P(i64), P(i64), P(i64)]
+        lib.sx_min_pending.restype = i64
+        lib.sx_min_pending.argtypes = [vp]
+        lib.sx_pop.restype = i64
+        lib.sx_pop.argtypes = [vp, i64, P(i64)]
+        lib.sx_pop_fetch.restype = None
+        lib.sx_pop_fetch.argtypes = [vp, P(i64), P(i64), P(i64), P(i64),
+                                     P(i32)]
+        lib.sx_pop_fetch_rest.restype = None
+        lib.sx_pop_fetch_rest.argtypes = [vp, P(i64), P(i64), P(i64)]
+        lib.sx_shard_group.restype = i64
+        lib.sx_shard_group.argtypes = [i64, P(i64), P(i64), P(u8), P(i32),
+                                       P(i32), i64, i64, i64, i64,
+                                       P(i64), P(i64), P(i64),
+                                       P(i64), P(i64), P(u8), P(i32),
+                                       P(i32)]
+        lib.sx_route.restype = None
+        lib.sx_route.argtypes = [i64, i64, P(i64), P(i64), i64, P(i64),
+                                 P(i32), P(i64), P(i32), P(i64)]
+        lib.sx_rec_shard_max.restype = i64
+        lib.sx_rec_shard_max.argtypes = [i64, P(i64), i64, i64, i64, i64]
+        _sessions_lib = lib
+        return _sessions_lib
+
+
+def sessions_available() -> bool:
+    return load_sessions() is not None
 
 
 _datagen_lib: Optional[ctypes.CDLL] = None
@@ -126,6 +347,27 @@ def load_datagen() -> Optional[ctypes.CDLL]:
                                   P(c.c_int64)]
         _datagen_lib = lib
         return _datagen_lib
+
+
+def build_all() -> Dict[str, bool]:
+    """Compile every native library up front (CI calls this before the
+    suite so a missing toolchain is LOUD, not a silent mid-suite
+    fallback). Returns {name: available}."""
+    return {name: load_native(src, so) is not None
+            for name, (src, so) in NATIVE_LIBS.items()}
+
+
+def build_report() -> str:
+    """One status line for CI logs: ``NATIVE: built`` when every
+    library compiled, else ``NATIVE: SKIPPED (...)`` naming why."""
+    if native_disabled():
+        return "NATIVE: SKIPPED (disabled via env)"
+    built = build_all()
+    if all(built.values()):
+        return "NATIVE: built (" + ", ".join(sorted(built)) + ")"
+    missing = sorted(n for n, ok in built.items() if not ok)
+    return ("NATIVE: SKIPPED (no compiler or build failed: "
+            + ", ".join(missing) + ")")
 
 
 def group_matrix(keys, slots, sidx, n_slices: int):

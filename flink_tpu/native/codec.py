@@ -62,6 +62,7 @@ def load_codec():
         lib.codec_raw_len.argtypes = [u8p, c.c_uint64]
         lib.codec_decode.restype = c.c_int
         lib.codec_decode.argtypes = [u8p, c.c_uint64, u8p, c.c_uint64]
+        lib.codec_free.restype = None
         lib.codec_free.argtypes = [u8p]
         _lib = lib
         return _lib

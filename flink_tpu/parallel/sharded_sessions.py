@@ -49,8 +49,9 @@ from flink_tpu.parallel.shuffle import (
     stage_device_exchange,
 )
 from flink_tpu.state.keygroups import assign_key_groups
+from flink_tpu.state.slot_table import resolve_slot_hints
 from flink_tpu.windowing.aggregates import AggregateFunction
-from flink_tpu.windowing.session_meta import MergeGroup, SessionIntervalSet
+from flink_tpu.windowing.session_meta import MergeGroup, make_session_meta
 from flink_tpu.windowing.windower import WINDOW_END_FIELD, WINDOW_START_FIELD
 
 
@@ -175,7 +176,9 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             for leaf in agg.leaves
         )
         self._build_steps()
-        self.meta = SessionIntervalSet(self.gap, self.allowed_lateness)
+        #: session-interval metadata: the native C sweep when compiled,
+        #: else the pure-Python plane (bit-identical fires/snapshots)
+        self.meta = make_session_meta(self.gap, self.allowed_lateness)
         self._dirty = np.zeros((self.P, self.capacity), dtype=bool)
         #: freed-session tombstone chunks (int64 arrays, deduped at
         #: snapshot time — per-fire tolist round-trips were measurable)
@@ -251,11 +254,16 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                 # counts dominate per-shard uniques (one hash pass, no
                 # sort); only a shard actually over the record bound
                 # pays the np.unique refinement
-                rec_per_shard = np.bincount(
-                    shard_records(keys, self.P, self.max_parallelism,
-                                  self.key_group_range),
-                    minlength=self.P)
-                if int(rec_per_shard.max()) > budget:
+                rsm = getattr(self.meta, "rec_shard_max", None)
+                if rsm is not None:
+                    rec_max = rsm(keys, self.P, self.max_parallelism,
+                                  self.key_group_range)
+                else:
+                    rec_max = int(np.bincount(
+                        shard_records(keys, self.P, self.max_parallelism,
+                                      self.key_group_range),
+                        minlength=self.P).max())
+                if rec_max > budget:
                     uniq = np.unique(keys)
                     per_shard = np.bincount(
                         shard_records(uniq, self.P, self.max_parallelism,
@@ -268,9 +276,10 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                         self.process_batch(batch.filter(~half))
                         return
 
-        sid_floor = self.meta.sid_watermark  # sids below exist already
-        sess_key, sess_sid, rec_to_sess, order, groups = \
-            self.meta.absorb_batch(keys, ts)
+        res = self.meta.absorb_batch_ex(keys, ts,
+                                        want_fresh=self._paged)
+        sess_key, sess_sid = res.sess_key, res.sess_sid
+        rec_to_sess, order, groups = res.rec_to_sess, res.order, res.groups
         for g in groups:
             self._run_merge_group(g)
 
@@ -282,42 +291,81 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             self.meta.late_records_dropped += int(
                 sess_counts[~live_sess].sum())
 
-        # per-shard slot resolution for the live sessions (ONE bincount
-        # plan instead of P boolean mask scans)
+        # per-shard slot resolution for the live sessions: ONE stable
+        # counting sort by shard replaces P boolean-mask scans — the
+        # per-shard selections become contiguous slices of one index
+        # array (within-shard session order unchanged: the sort is
+        # stable over ascending session indices). The native metadata
+        # plane runs the shard assignment + grouping + column gather as
+        # one C sweep (sx_shard_group, same keygroups formula); the
+        # Python plane takes the equivalent numpy path.
         m = len(sess_key)
-        sess_shard = shard_records(sess_key, self.P,
-            self.max_parallelism, self.key_group_range)
-        shard_counts = np.bincount(sess_shard[live_sess],
-                                   minlength=self.P) if m else \
-            np.zeros(self.P, dtype=np.int64)
         per_shard_sel = {}
-        for p in np.nonzero(shard_counts)[0].tolist():
-            per_shard_sel[p] = (sess_shard == p) & live_sess
-        slot_of_sess = np.zeros(m, dtype=np.int32)
-        if self._paged:
-            # sessions CREATED by this absorb (sid >= the pre-absorb
-            # allocator watermark) cannot be resident or paged — the
-            # resolve skips their index probe and page query. A fresh
-            # sid that was a MERGE DESTINATION is excluded: the merge
-            # group already inserted it (older touch clock), and
-            # skipping its probe would leave it eviction-unprotected
-            # inside this very resolve.
-            sess_fresh = sess_sid >= sid_floor
-            if groups:
-                merged_dst = np.unique(np.concatenate(
-                    [np.asarray(g.sids_dst, dtype=np.int64)
-                     for g in groups]))
-                if len(merged_dst):
-                    sess_fresh &= ~np.isin(sess_sid, merged_dst)
-            resolved = self._resolve_slots_paged(
-                {p: (sess_key[sel], sess_sid[sel])
-                 for p, sel in per_shard_sel.items()},
-                fresh={p: sess_fresh[sel]
-                       for p, sel in per_shard_sel.items()})
-            for p, sel in per_shard_sel.items():
-                slot_of_sess[sel] = resolved[p]
-                self._dirty[p, resolved[p]] = True
+        shard_slices = {}
+        sg = getattr(self.meta, "shard_group", None)
+        if sg is not None:
+            (sess_shard, counts, sorted_idx, key_sorted, sid_sorted,
+             fresh_sorted, hint_sorted, row_sorted) = sg(
+                res, self.P, self.max_parallelism, self.key_group_range)
+            offs = np.concatenate(([0], np.cumsum(counts)))
+            for p in np.nonzero(counts)[0].tolist():
+                a, b = int(offs[p]), int(offs[p + 1])
+                shard_slices[p] = (a, b)
+                per_shard_sel[p] = sorted_idx[a:b]
         else:
+            sess_shard = shard_records(sess_key, self.P,
+                self.max_parallelism, self.key_group_range)
+            live_idx = np.nonzero(live_sess)[0]
+            sorted_idx = live_idx
+            if len(live_idx):
+                shards_live = sess_shard[live_idx]
+                sorted_idx = live_idx[np.argsort(shards_live,
+                                                 kind="stable")]
+                counts = np.bincount(shards_live, minlength=self.P)
+                offs = np.concatenate(([0], np.cumsum(counts)))
+                for p in np.nonzero(counts)[0].tolist():
+                    a, b = int(offs[p]), int(offs[p + 1])
+                    shard_slices[p] = (a, b)
+                    per_shard_sel[p] = sorted_idx[a:b]
+            key_sorted = sess_key[sorted_idx]
+            sid_sorted = sess_sid[sorted_idx]
+            fresh_sorted = (None if res.fresh is None
+                            else res.fresh[sorted_idx])
+            hint_sorted = (None if res.slot_hint is None
+                           else res.slot_hint[sorted_idx])
+            row_sorted = (None if res.meta_row is None
+                          else res.meta_row[sorted_idx])
+        slot_of_sess = None
+        if self._paged:
+            # sessions CREATED by this absorb (res.fresh: allocated by
+            # this absorb, minus merge destinations — see
+            # SessionIntervalSet.absorb_batch_ex) cannot be resident or
+            # paged: the resolve skips their index probe and page
+            # query. Sessions carrying a FOLDED device slot from the
+            # native metadata plane (res.slot_hint) skip the hash probe
+            # after metadata verification — at high key cardinality the
+            # state-plane hash is only probed for rows whose fold went
+            # stale (eviction, restore, reshard). Per-shard columns are
+            # gathered ONCE through the shard-sorted index and sliced
+            # contiguously — no per-shard fancy indexing.
+            resolved = self._resolve_slots_paged(
+                {p: (key_sorted[a:b], sid_sorted[a:b])
+                 for p, (a, b) in shard_slices.items()},
+                fresh={p: fresh_sorted[a:b]
+                       for p, (a, b) in shard_slices.items()},
+                hints=(None if hint_sorted is None else
+                       {p: hint_sorted[a:b]
+                        for p, (a, b) in shard_slices.items()}))
+            slot_sorted = np.zeros(len(sorted_idx), dtype=np.int32)
+            for p, (a, b) in shard_slices.items():
+                slot_sorted[a:b] = resolved[p]
+                self._dirty[p, resolved[p]] = True
+            # fold the resolved slots into the metadata rows so the
+            # NEXT batch's resolve skips the probe (native plane only)
+            self.meta.note_slots(key_sorted, sid_sorted, slot_sorted,
+                                 rows=row_sorted)
+        else:
+            slot_of_sess = np.zeros(m, dtype=np.int32)
             if self._spill_active:
                 touched = {p: np.unique(sess_sid[sel])
                            for p, sel in per_shard_sel.items()}
@@ -330,13 +378,24 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                     sess_key[sel], sess_sid[sel])
                 slot_of_sess[sel] = slots
                 self._dirty[p, slots] = True
+            slot_sorted = slot_of_sess[sorted_idx]
 
         # route records: each record scatters into its session's slot on
-        # its session's shard (stale records keep slot 0 = identity)
-        rec_slots = np.empty(n, dtype=np.int32)
-        rec_slots[order] = slot_of_sess[rec_to_sess]
-        rec_shards = np.empty(n, dtype=sess_shard.dtype)
-        rec_shards[order] = sess_shard[rec_to_sess]
+        # its session's shard (stale records keep slot 0 = identity) —
+        # one C pass on the native plane, numpy otherwise
+        rt = getattr(self.meta, "route_records", None)
+        if rt is not None:
+            rec_slots, rec_shards = rt(n, order, rec_to_sess, m,
+                                       sorted_idx, slot_sorted,
+                                       sess_shard)
+        else:
+            if slot_of_sess is None:
+                slot_of_sess = np.zeros(m, dtype=np.int32)
+                slot_of_sess[sorted_idx] = slot_sorted
+            rec_slots = np.empty(n, dtype=np.int32)
+            rec_slots[order] = slot_of_sess[rec_to_sess]
+            rec_shards = np.empty(n, dtype=sess_shard.dtype)
+            rec_shards[order] = sess_shard[rec_to_sess]
         values = self.agg.map_input(batch)
         in_leaves = self.agg.input_leaves
         # pipelining: claim a dispatch slot BEFORE rewriting the pooled
@@ -431,6 +490,22 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             self.accs = self._merge_step(
                 self.accs, self._put_sharded(dst_block),
                 self._put_sharded(src_block))
+        if self._paged:
+            # fold the merge DESTINATIONS' resolved slots into their
+            # metadata rows (native plane) — the dst sessions live on
+            # and would otherwise pay a probe next batch
+            fk, fs, fl = [], [], []
+            for p, (d_slots, _) in enumerate(per_shard):
+                if p not in pairs or not len(d_slots):
+                    continue
+                c = len(d_slots)
+                fk.append(pairs[p][0][:c])
+                fs.append(pairs[p][1][:c])
+                fl.append(d_slots)
+            if fk:
+                self.meta.note_slots(np.concatenate(fk),
+                                     np.concatenate(fs),
+                                     np.concatenate(fl))
         # absorbed host slots reusable now that the kernel moved the values;
         # record tombstones so delta snapshots drop the absorbed rows
         self._freed_ns.append(
@@ -462,7 +537,9 @@ class MeshSessionEngine(MeshPagedSpillSupport):
 
     def on_watermark(self, watermark: int,
                      async_ok: bool = False) -> List[RecordBatch]:
-        keys, starts, ends, sids = self.meta.pop_fired(watermark)
+        pop = self.meta.pop_fired_ex(watermark)
+        keys, starts, ends, sids = pop.keys, pop.starts, pop.ends, pop.sids
+        hint = pop.slot_hint
         if not len(keys):
             return []
         if self._spill_active:
@@ -483,13 +560,16 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                     out.extend(self._fire_sessions(
                         keys[a:a + chunk], starts[a:a + chunk],
                         ends[a:a + chunk], sids[a:a + chunk],
-                        async_ok=async_ok))
+                        async_ok=async_ok,
+                        slot_hint=(None if hint is None
+                                   else hint[a:a + chunk])))
                 return out
         return self._fire_sessions(keys, starts, ends, sids,
-                                   async_ok=async_ok)
+                                   async_ok=async_ok, slot_hint=hint)
 
     def _fire_sessions(self, keys, starts, ends, sids,
-                       async_ok: bool = False) -> List[RecordBatch]:
+                       async_ok: bool = False,
+                       slot_hint=None) -> List[RecordBatch]:
         chaos.fault_point("mesh.session_fire", sessions=len(keys))
         k_arr = np.asarray(keys, dtype=np.int64)
         sid_arr = np.asarray(sids, dtype=np.int64)
@@ -501,7 +581,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             return self._fire_sessions_hybrid(
                 k_arr, np.asarray(starts, dtype=np.int64),
                 np.asarray(ends, dtype=np.int64), sid_arr,
-                per_shard_sel, async_ok)
+                per_shard_sel, async_ok, slot_hint)
         resolved: Dict[int, np.ndarray] = {}
         if self._spill_active:
             touched = {p: np.unique(sid_arr[sel])
@@ -579,8 +659,8 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         return [build(jax.device_get([fire_out[n] for n in names]))]
 
     def _fire_sessions_hybrid(self, k_arr, st_arr, en_arr, sid_arr,
-                              per_shard_sel, async_ok: bool
-                              ) -> List[RecordBatch]:
+                              per_shard_sel, async_ok: bool,
+                              slot_hint=None) -> List[RecordBatch]:
         """Paged-layout fire: RESIDENT sessions merge+finish on device
         (one fire kernel over the whole mesh), COLD sessions fire
         straight from page storage — their accumulators are already on
@@ -612,7 +692,14 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                 continue
             idx = self.indexes[p]
             ks, ss = k_arr[sel], sid_arr[sel]
-            slots = idx.lookup(ks, ss)  # read-only: no insert, no evict
+            if slot_hint is not None:
+                # the pop carried each fired session's FOLDED device
+                # slot out of its metadata row — verified folds replace
+                # the per-fire hash probe; only stale folds (evicted
+                # since the fold) pay the read-only lookup
+                slots = resolve_slot_hints(idx, ks, ss, slot_hint[sel])
+            else:
+                slots = idx.lookup(ks, ss)  # read-only: no insert/evict
             hit = slots >= 0
             rslots = slots[hit].astype(np.int32)
             res_pos.append(sel[hit])
@@ -641,9 +728,11 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                 for i in range(len(leaves)):
                     cold_vals[i].append(vals_p[i])
             # slot-addressed free of the resident fired rows (their
-            # cold siblings were unmapped by the extraction above)
+            # cold siblings were unmapped by the extraction above); the
+            # pair columns are in hand from the pop, so the free skips
+            # the per-slot metadata gathers
             if len(rslots):
-                idx.free_slots(rslots)
+                idx.free_slots(rslots, keys=ks[hit], nss=ss[hit])
                 self._dirty[p, rslots] = False
         # device part: fire + reset over resident rows only (the reset
         # is queue-ordered behind the fire, so async reads never race)

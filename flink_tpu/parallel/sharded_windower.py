@@ -46,7 +46,7 @@ from flink_tpu.parallel.shuffle import (
     stage_device_exchange,
 )
 from flink_tpu.state.keygroups import assign_key_groups
-from flink_tpu.state.slot_table import HostSlotIndex
+from flink_tpu.state.slot_table import HostSlotIndex, resolve_slot_hints
 from flink_tpu.windowing.aggregates import AggregateFunction
 from flink_tpu.windowing.assigners import WindowAssigner
 from flink_tpu.windowing.bookkeeping import SliceBookkeeper
@@ -214,7 +214,10 @@ class MeshSpillSupport:
         # with up to `depth` batches in flight (the hardest restore case)
         chaos.fault_point("mesh.dispatch_fence",
                           in_flight=len(self._dispatch_fences))
-        self._dispatch_fences.append(self.make_fence())
+        # fence creation dispatches a (tiny) device program — an inline
+        # device interaction, attributed as such for the host-prep gate
+        with self._device_span():
+            self._dispatch_fences.append(self.make_fence())
 
     @property
     def _spill_active(self) -> bool:
@@ -1004,6 +1007,7 @@ class MeshPagedSpillSupport(MeshSpillSupport):
     def _resolve_slots_paged(
             self, per_shard: Dict[int, Tuple[np.ndarray, np.ndarray]],
             fresh: Optional[Dict[int, np.ndarray]] = None,
+            hints: Optional[Dict[int, np.ndarray]] = None,
     ) -> Dict[int, np.ndarray]:
         """Batched slot resolution over shards with page reload and
         cohort eviction: resident rows of THIS batch get a fresh clock
@@ -1018,6 +1022,13 @@ class MeshPagedSpillSupport(MeshSpillSupport):
         straight to insert. At high-cardinality shapes most of a
         batch's sessions are fresh, and the skipped page query is a
         sorted-match over the full spilled-row map.
+
+        ``hints``: per-shard folded device slots from the native
+        session-metadata plane (-1 unknown). A hint is VERIFIED against
+        the shard index's metadata views (``verify_slot_hints``) before
+        use — verified rows skip the hash probe entirely, stale folds
+        fall back to it, so the state evolution is identical to the
+        hint-free path (same hits, same misses, same insert order).
 
         Callers pass session-shaped pairs (one row per globally-unique
         sid), so no dedup pass runs here and the insert probe is
@@ -1040,7 +1051,10 @@ class MeshPagedSpillSupport(MeshSpillSupport):
             nss = np.asarray(nss, dtype=np.int64)
             idx = self.indexes[p]
             fr = fresh.get(p) if fresh is not None else None
-            if fr is not None and fr.any():
+            hint = hints.get(p) if hints is not None else None
+            if hint is not None:
+                pre = resolve_slot_hints(idx, keys, nss, hint, skip=fr)
+            elif fr is not None and fr.any():
                 pre = np.full(len(keys), -1, dtype=np.int32)
                 probe = ~fr
                 if probe.any():

@@ -111,6 +111,58 @@ class SlotTableFullError(RuntimeError):
     """Device slot budget exhausted — the owner may evict and retry."""
 
 
+def verify_slot_hints(index, key_ids: np.ndarray, namespaces: np.ndarray,
+                      hints: np.ndarray) -> np.ndarray:
+    """Resolve folded device-slot hints against the index's OWN metadata
+    views: a hint is taken iff the index currently maps exactly that
+    (key, ns) pair at that slot. Returns int32 slots with -1 where the
+    hint is absent or stale — callers fall back to the hash probe there.
+
+    Correct by construction: ``slot_key``/``slot_ns``/``slot_used`` ARE
+    the table's contents, so a passing verification can never name a
+    wrong row — a fold gone stale (eviction, fire, reshard, restore)
+    fails the compare and costs one fallback probe, never a wrong
+    gather. This is what makes the metadata-plane slot fold a pure
+    cache: no invalidation protocol, no correctness coupling."""
+    native = getattr(index, "verify_hints", None)
+    if native is not None:
+        return native(key_ids, namespaces, hints)
+    hints = np.asarray(hints, dtype=np.int32)
+    out = np.full(len(hints), -1, dtype=np.int32)
+    hv = hints >= 0
+    if not hv.any():
+        return out
+    hs = hints[hv]
+    cap = index.capacity
+    safe = np.minimum(hs, cap - 1)
+    ok = ((hs < cap)
+          & index.slot_used[safe]
+          & (index.slot_key[safe]
+             == np.asarray(key_ids, dtype=np.int64)[hv])
+          & (index.slot_ns[safe]
+             == np.asarray(namespaces, dtype=np.int64)[hv]))
+    out[hv] = np.where(ok, hs, np.int32(-1))
+    return out
+
+
+def resolve_slot_hints(index, key_ids: np.ndarray, namespaces: np.ndarray,
+                       hints: np.ndarray, skip=None) -> np.ndarray:
+    """The verify-then-probe resolve every hint consumer runs: take the
+    verified folds, hash-probe the unresolved remainder, and leave -1
+    for pairs the index does not hold. ``skip``: rows the caller KNOWS
+    cannot be present (fresh session ids) — they keep -1 without paying
+    the probe. One copy of the pattern for the resolve, the fire and
+    the single-device table paths."""
+    pre = verify_slot_hints(index, key_ids, namespaces, hints)
+    probe = pre < 0
+    if skip is not None:
+        probe &= ~skip
+    if probe.any():
+        pre[probe] = index.lookup(
+            np.asarray(key_ids)[probe], np.asarray(namespaces)[probe])
+    return pre
+
+
 class _NamespaceRegistry:
     """Shared namespace -> slots registry (O(namespaces), pure Python).
 
@@ -497,6 +549,21 @@ class NativeSlotIndex(_NamespaceRegistry):
                             out.ctypes.data_as(_I32P))
         return out
 
+    def verify_hints(self, key_ids: np.ndarray, namespaces: np.ndarray,
+                     hints: np.ndarray) -> np.ndarray:
+        """Native form of :func:`verify_slot_hints` — one direct-indexed
+        C pass over the table's own metadata (sm_verify)."""
+        keys = np.ascontiguousarray(key_ids, dtype=np.int64)
+        nss = np.ascontiguousarray(namespaces, dtype=np.int64)
+        hints = np.ascontiguousarray(hints, dtype=np.int32)
+        out = np.empty(len(keys), dtype=np.int32)
+        self._lib.sm_verify(self._h, len(keys),
+                            keys.ctypes.data_as(_I64P),
+                            nss.ctypes.data_as(_I64P),
+                            hints.ctypes.data_as(_I32P),
+                            out.ctypes.data_as(_I32P))
+        return out
+
     def free_namespaces(self, namespaces: List[int]) -> Optional[np.ndarray]:
         drained = self._registry_drain(namespaces)
         if drained is None:
@@ -842,10 +909,10 @@ class SlotTable:
 
     def lookup_or_insert(self, key_ids: np.ndarray,
                          namespaces: np.ndarray,
-                         _pairs=None) -> np.ndarray:
+                         _pairs=None, hints=None) -> np.ndarray:
         if self.max_device_slots and self._paged:
             return self._lookup_or_insert_paged(key_ids, namespaces,
-                                                _pairs)
+                                                _pairs, hints)
         if self.max_device_slots:
             # ``_pairs`` lets upsert() hand down its already-computed
             # unique (key, ns) pairs instead of re-sorting the batch
@@ -878,20 +945,32 @@ class SlotTable:
     # --------------------------------------------------- paged spill layout
 
     def _lookup_or_insert_paged(self, key_ids, namespaces,
-                                _pairs=None) -> np.ndarray:
+                                _pairs=None, hints=None) -> np.ndarray:
         """Slot-clock variant of the spill-aware lookup: resident rows of
         THIS batch are stamped with a fresh clock (protecting them from
         the eviction the batch itself triggers), missing pairs reload by
-        page, then the plain index insert runs."""
+        page, then the plain index insert runs.
+
+        ``hints``: folded device slots from the session-metadata plane,
+        aligned with ``key_ids`` — which must then already be UNIQUE
+        pairs (the session contract: one row per sid). Verified hints
+        skip the hash probe; the result path inserts only the misses,
+        which is state-identical to the full lookup_or_insert (hits
+        never allocate) but pays the native probe only for rows whose
+        fold went stale."""
         key_ids = np.asarray(key_ids, dtype=np.int64)
         namespaces = np.asarray(namespaces, dtype=np.int64)
-        if _pairs is None:
-            uk, un, _ = unique_pairs(key_ids, namespaces)
-        else:
-            uk, un = _pairs
         self._touch_clock += 1
         clock = self._touch_clock
-        pre = self.index.lookup(uk, un)
+        if hints is not None:
+            uk, un = key_ids, namespaces
+            pre = resolve_slot_hints(self.index, uk, un, hints)
+        else:
+            if _pairs is None:
+                uk, un, _ = unique_pairs(key_ids, namespaces)
+            else:
+                uk, un = _pairs
+            pre = self.index.lookup(uk, un)
         hit = pre >= 0
         self._slot_touch[pre[hit]] = clock
         missing = ~hit
@@ -906,7 +985,14 @@ class SlotTable:
         needed = int(missing.sum())
         if needed and self.index.free_headroom() < needed:
             self._make_headroom_paged(needed)
-        slots = self.index.lookup_or_insert(key_ids, namespaces)
+        if hints is not None:
+            # unique pairs: hits are final, only the misses insert
+            slots = pre.astype(np.int32, copy=True)
+            if missing.any():
+                slots[missing] = self.index.lookup_or_insert(
+                    uk[missing], un[missing])
+        else:
+            slots = self.index.lookup_or_insert(key_ids, namespaces)
         self._slot_touch[slots] = clock
         return slots
 

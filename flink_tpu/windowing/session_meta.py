@@ -40,6 +40,46 @@ _NEG_INF = -(1 << 62)
 
 
 @dataclasses.dataclass
+class AbsorbResult:
+    """One absorbed batch, engine-facing: the classic absorb_batch tuple
+    plus the per-session columns the state-plane resolve consumes.
+
+    ``fresh``: sessions CREATED by this absorb that cannot be resident
+    or paged in the state plane (skip the hash probe AND the page
+    query). ``slot_hint``: the folded device slot from the metadata row
+    (-1 unknown) — engines VERIFY a hint against the state table's own
+    metadata before trusting it, so a stale fold costs a fallback
+    probe, never a wrong row."""
+
+    sess_key: np.ndarray
+    sess_sid: np.ndarray
+    rec_to_sess: np.ndarray
+    order: np.ndarray
+    groups: List["MergeGroup"]
+    #: None when the caller opted out (want_fresh=False — only the
+    #: paged resolve reads it)
+    fresh: Optional[np.ndarray]
+    slot_hint: Optional[np.ndarray] = None
+    #: native plane: each fast-path session's metadata row, -1 for
+    #: slow/stale sessions — lets note_slots fold by direct array
+    #: scatter instead of a hash pass
+    meta_row: Optional[np.ndarray] = None
+
+
+@dataclasses.dataclass
+class PopResult:
+    """One watermark pop: fired sessions as columnar int64 arrays in end
+    order, plus the folded device slot per fired session (-1 unknown;
+    only the native plane folds)."""
+
+    keys: np.ndarray
+    starts: np.ndarray
+    ends: np.ndarray
+    sids: np.ndarray
+    slot_hint: Optional[np.ndarray] = None
+
+
+@dataclasses.dataclass
 class MergeGroup:
     """A chain-free batch of accumulator merges: within one group no sid is
     both a source and a destination, so a single gather/scatter kernel is
@@ -101,15 +141,13 @@ class SessionIntervalSet:
     def __init__(self, gap: int, allowed_lateness: int = 0):
         self.gap = int(gap)
         self.allowed_lateness = int(allowed_lateness)
-        cap = 1 << 16
-        self._idx = make_slot_index(cap, on_grow=self._on_grow,
-                                    track_namespaces=False)
-        cap = self._idx.capacity
-        self._s_start = np.zeros(cap, dtype=np.int64)
-        self._s_end = np.zeros(cap, dtype=np.int64)
-        self._s_sid = np.zeros(cap, dtype=np.int64)
+        #: time spent inside the native sweep calls (absorb + pop); the
+        #: pure-Python plane keeps it at 0.0 — bench tooling reports it
+        #: as its own host-prep line
+        self.native_sweep_s = 0.0
         #: keys with >= 2 live sessions: reference-shaped interval lists
         self._multi: Dict[int, List[Tuple[int, int, int]]] = {}
+        self._reset_store()
         self._next_sid = 1
         #: fire candidates as COLUMNAR chunks
         #: [(ends, keys, sids, lo, hi), ...] with cached per-chunk
@@ -138,6 +176,17 @@ class SessionIntervalSet:
         self._cur: Optional[MergeGroup] = None
         self._cur_dst: set = set()
         self._cur_src: set = set()
+
+    def _reset_store(self) -> None:
+        """(Re)create the empty singles store — the ONE hook the native
+        plane overrides to swap the numpy arrays for the C views."""
+        self._idx = make_slot_index(1 << 16, on_grow=self._on_grow,
+                                    track_namespaces=False)
+        cap = self._idx.capacity
+        self._s_start = np.zeros(cap, dtype=np.int64)
+        self._s_end = np.zeros(cap, dtype=np.int64)
+        self._s_sid = np.zeros(cap, dtype=np.int64)
+        self._multi.clear()
 
     def _on_grow(self, old: int, new: int) -> None:
         for name in ("_s_start", "_s_end", "_s_sid"):
@@ -365,6 +414,42 @@ class SessionIntervalSet:
         self._groups, self._cur = [], None
         return sess_key, sess_sid, rec_to_sess, order, groups
 
+    def absorb_batch_ex(self, keys: np.ndarray, ts: np.ndarray,
+                        want_fresh: bool = True) -> AbsorbResult:
+        """absorb_batch plus the per-session resolve columns engines
+        consume: the fresh mask (sids allocated by THIS absorb, minus
+        merge destinations — a fresh dst was already inserted by its
+        merge group, and skipping its probe would leave it
+        eviction-unprotected inside the very resolve that follows) and,
+        on the native plane, the folded device-slot hints.
+
+        ``want_fresh=False`` skips the fresh-mask derivation (the
+        unique/isin over merge destinations) — only the PAGED resolve
+        reads it, and this sits on the per-batch hot path."""
+        sid_floor = self.sid_watermark
+        sess_key, sess_sid, rec_to_sess, order, groups = \
+            self.absorb_batch(keys, ts)
+        fresh = None
+        if want_fresh:
+            fresh = sess_sid >= sid_floor
+            if groups:
+                merged_dst = np.unique(np.concatenate(
+                    [np.asarray(g.sids_dst, dtype=np.int64)
+                     for g in groups]))
+                if len(merged_dst):
+                    fresh &= ~np.isin(sess_sid, merged_dst)
+        return AbsorbResult(sess_key, sess_sid, rec_to_sess, order,
+                            groups, fresh)
+
+    def note_slots(self, keys: np.ndarray, sids: np.ndarray,
+                   slots: np.ndarray, rows=None) -> None:
+        """Fold resolved device slots back into the metadata rows so the
+        NEXT batch's resolve can skip the state-plane hash probe.
+        ``rows``: the sessions' metadata rows when the caller holds them
+        (AbsorbResult.meta_row) — fold by direct scatter, no hash pass.
+        The pure-Python plane does not fold (its resolve is the
+        reference path) — no-op."""
+
     def _add_merge(self, key: int, dst_sid: int, src_sid: int) -> None:
         """Queue an accumulator merge. A chain (src was an earlier dst, or
         dst was an earlier src) would make a single gather/scatter kernel
@@ -438,6 +523,13 @@ class SessionIntervalSet:
     # ------------------------------------------------------------------ fire
 
     _EMPTY_POP = (np.empty(0, dtype=np.int64),) * 4
+
+    def pop_fired_ex(self, watermark: int) -> PopResult:
+        """pop_fired plus the fired sessions' folded device slots (the
+        native plane's pop carries them out of the metadata rows; here
+        they are unknown)."""
+        keys, starts, ends, sids = self.pop_fired(watermark)
+        return PopResult(keys, starts, ends, sids)
 
     def pop_fired(self, watermark: int
                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
@@ -520,37 +612,8 @@ class SessionIntervalSet:
 
         rest = np.nonzero(~sing)[0]
         if self._multi and len(rest):
-            ek, es, ee, esid = [], [], [], []
-            for j in rest.tolist():
-                key = int(d_keys[j])
-                sid, end = int(d_sids[j]), int(d_ends[j])
-                ivs = self._multi.get(key)
-                if not ivs:
-                    # the key may have demoted to the single store
-                    # earlier in THIS pop (a sibling session fired and
-                    # left exactly one) — validate there
-                    a = np.asarray([key], dtype=np.int64)
-                    slot = int(self._idx.lookup(a, a)[0])
-                    if (slot >= 0 and self._s_sid[slot] == sid
-                            and self._s_end[slot] == end):
-                        ek.append(key)
-                        es.append(int(self._s_start[slot]))
-                        ee.append(end)
-                        esid.append(sid)
-                        self._idx.free_slots(
-                            np.asarray([slot], dtype=np.int32))
-                    continue
-                cur = next((iv for iv in ivs if iv[2] == sid), None)
-                if cur is None or cur[1] != end:
-                    continue
-                ek.append(key)
-                es.append(cur[0])
-                ee.append(end)
-                esid.append(sid)
-                ivs.remove(cur)
-                if len(ivs) == 1:
-                    del self._multi[key]
-                    self._store_intervals(key, ivs)
+            ek, es, ee, esid, _ = self._pop_rest_walk(
+                d_keys[rest], d_sids[rest], d_ends[rest])
             if ek:
                 out_keys = np.concatenate([
                     out_keys, np.asarray(ek, dtype=np.int64)])
@@ -566,6 +629,60 @@ class SessionIntervalSet:
         return (out_keys, np.asarray(out_starts, dtype=np.int64),
                 out_ends, out_sids)
 
+    def _pop_rest_walk(self, rk, rs, re_):
+        """Validate REST candidates — keys absent from the singles
+        store at cut time — against the multi-interval lists; the ONE
+        copy of the reference-shaped walk both planes run (the native
+        plane only swaps the scalar store accessors via the two hooks
+        below). Returns columnar extras ``(keys, starts, ends, sids,
+        slots)`` — slots are the folded device slots where known."""
+        ek: List[int] = []
+        es: List[int] = []
+        ee: List[int] = []
+        esid: List[int] = []
+        eslot: List[int] = []
+        for j in range(len(rk)):
+            key = int(rk[j])
+            sid, end = int(rs[j]), int(re_[j])
+            ivs = self._multi.get(key)
+            if not ivs:
+                # the key may have demoted to the single store earlier
+                # in THIS pop (a sibling session fired and left exactly
+                # one) — validate there
+                slot = self._rest_single_lookup(key)
+                if (slot >= 0 and self._s_sid[slot] == sid
+                        and self._s_end[slot] == end):
+                    ek.append(key)
+                    es.append(int(self._s_start[slot]))
+                    ee.append(end)
+                    esid.append(sid)
+                    eslot.append(self._rest_single_free(slot))
+                continue
+            cur = next((iv for iv in ivs if iv[2] == sid), None)
+            if cur is None or cur[1] != end:
+                continue
+            ek.append(key)
+            es.append(cur[0])
+            ee.append(end)
+            esid.append(sid)
+            eslot.append(-1)
+            ivs.remove(cur)
+            if len(ivs) == 1:
+                del self._multi[key]
+                self._store_intervals(key, ivs)
+        return ek, es, ee, esid, eslot
+
+    def _rest_single_lookup(self, key: int) -> int:
+        """Store row of ``key`` in the singles store, -1 if absent."""
+        a = np.asarray([key], dtype=np.int64)
+        return int(self._idx.lookup(a, a)[0])
+
+    def _rest_single_free(self, slot: int) -> int:
+        """Free a validated demoted-single row; returns its folded
+        device slot (-1 on this plane — it does not fold)."""
+        self._idx.free_slots(np.asarray([slot], dtype=np.int32))
+        return -1
+
     # -------------------------------------------------------------- snapshot
 
     def snapshot(self) -> Dict[str, object]:
@@ -577,13 +694,7 @@ class SessionIntervalSet:
 
     def restore(self, snap: Dict[str, object],
                 key_group_filter=None, max_parallelism: int = 128) -> None:
-        self._idx = make_slot_index(1 << 16, on_grow=self._on_grow,
-                                    track_namespaces=False)
-        cap = self._idx.capacity
-        self._s_start = np.zeros(cap, dtype=np.int64)
-        self._s_end = np.zeros(cap, dtype=np.int64)
-        self._s_sid = np.zeros(cap, dtype=np.int64)
-        self._multi = {}
+        self._reset_store()
         self._fire_chunks = []
         self._fire_buf = ([], [], [])
         self._min_pending_end = 1 << 62
@@ -617,3 +728,27 @@ class SessionIntervalSet:
                              np.asarray(ssid, dtype=np.int64))
         self._next_sid = snap.get("next_sid", 1)
         self.max_fired_watermark = snap.get("max_fired_watermark", _NEG_INF)
+
+
+def make_session_meta(gap: int,
+                      allowed_lateness: int = 0) -> SessionIntervalSet:
+    """The native metadata plane when the C++ library is available, else
+    the pure-Python plane — selected per engine exactly the way
+    ``make_slot_index`` picks the state-plane index. Fires and snapshots
+    are bit-identical across planes (test-pinned).
+
+    ``FLINK_TPU_NATIVE_SESSIONS=0`` forces the Python plane while the
+    native state-plane index stays on — the A/B knob bench and parity
+    tooling use (the blanket ``FLINK_TPU_NO_NATIVE=1`` disables both)."""
+    import os
+
+    from flink_tpu.native import sessions_available
+
+    if (os.environ.get("FLINK_TPU_NATIVE_SESSIONS") != "0"
+            and sessions_available()):
+        from flink_tpu.windowing.session_native import (
+            NativeSessionIntervalSet,
+        )
+
+        return NativeSessionIntervalSet(gap, allowed_lateness)
+    return SessionIntervalSet(gap, allowed_lateness)

@@ -33,11 +33,7 @@ from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
 from flink_tpu.ops.segment_ops import SCATTER_METHOD, pad_bucket_size, pad_i32
 from flink_tpu.state.slot_table import SlotTable
 from flink_tpu.windowing.aggregates import AggregateFunction, _JIT_CACHE
-from flink_tpu.windowing.session_meta import (
-    _NEG_INF,
-    MergeGroup,
-    SessionIntervalSet,
-)
+from flink_tpu.windowing.session_meta import MergeGroup, make_session_meta
 from flink_tpu.windowing.windower import WINDOW_END_FIELD, WINDOW_START_FIELD
 
 
@@ -100,7 +96,9 @@ class SessionWindower:
         self.table = SlotTable(agg, capacity=capacity,
                                max_parallelism=max_parallelism,
                                **spill_kwargs)
-        self.meta = SessionIntervalSet(self.gap, self.allowed_lateness)
+        #: session-interval metadata: the native C sweep when compiled,
+        #: else the pure-Python plane (bit-identical fires/snapshots)
+        self.meta = make_session_meta(self.gap, self.allowed_lateness)
 
     @property
     def late_records_dropped(self) -> int:
@@ -163,9 +161,10 @@ class SessionWindower:
         ts = np.asarray(batch.timestamps, dtype=np.int64)
         keys = np.asarray(batch.key_ids, dtype=np.int64)
 
-        sess_key, sess_sid, rec_to_sess, order, groups = \
-            self.meta.absorb_batch(keys, ts)
-        for g in groups:
+        res = self.meta.absorb_batch_ex(keys, ts, want_fresh=False)
+        sess_key, sess_sid = res.sess_key, res.sess_sid
+        rec_to_sess, order = res.rec_to_sess, res.order
+        for g in res.groups:
             self._run_merge_group(g)
 
         live_sess = sess_sid >= 0
@@ -176,12 +175,21 @@ class SessionWindower:
             sess_counts = np.diff(np.append(starts_pos, n))
             self.meta.late_records_dropped += int(
                 sess_counts[~live_sess].sum())
-        # ONE vectorized lookup for all session slots, then scatter records
+        # ONE vectorized lookup for all session slots, then scatter
+        # records; the native metadata plane's folded slots skip the
+        # state-table hash probe for sessions whose fold is still valid
         m = len(sess_key)
         slot_of_sess = np.zeros(m, dtype=np.int32)
         if live_sess.any():
             slot_of_sess[live_sess] = self.table.lookup_or_insert(
-                sess_key[live_sess], sess_sid[live_sess])
+                sess_key[live_sess], sess_sid[live_sess],
+                hints=(None if res.slot_hint is None
+                       else res.slot_hint[live_sess]))
+            self.meta.note_slots(sess_key[live_sess],
+                                 sess_sid[live_sess],
+                                 slot_of_sess[live_sess],
+                                 rows=(None if res.meta_row is None
+                                       else res.meta_row[live_sess]))
         rec_slots = np.empty(n, dtype=np.int32)
         rec_slots[order] = slot_of_sess[rec_to_sess]
         self.table.scatter(rec_slots, self.agg.map_input(batch))
@@ -220,8 +228,9 @@ class SessionWindower:
 
     def on_watermark(self, watermark: int,
                      async_ok: bool = False) -> List[RecordBatch]:
-        fired_keys, fired_starts, fired_ends, fired_sids = \
-            self.meta.pop_fired(watermark)
+        pop = self.meta.pop_fired_ex(watermark)
+        fired_keys, fired_starts = pop.keys, pop.starts
+        fired_ends, fired_sids = pop.ends, pop.sids
         if not len(fired_keys):
             return []
         total = len(fired_keys)
@@ -236,7 +245,9 @@ class SessionWindower:
             b = min(a + chunk, total)
             fired_slots = self.table.lookup_or_insert(
                 np.asarray(fired_keys[a:b], dtype=np.int64),
-                np.asarray(fired_sids[a:b], dtype=np.int64))
+                np.asarray(fired_sids[a:b], dtype=np.int64),
+                hints=(None if pop.slot_hint is None
+                       else pop.slot_hint[a:b]))
             matrix = np.asarray(fired_slots, dtype=np.int32)[:, None]
             cols = {
                 KEY_ID_FIELD: np.asarray(fired_keys[a:b], dtype=np.int64),

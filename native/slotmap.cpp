@@ -229,6 +229,35 @@ void sm_lookup(void* h, int64_t n, const int64_t* keys, const int64_t* nss,
   }
 }
 
+// Verify folded slot hints against the table's own metadata: out[i] is
+// hints[i] iff the table currently maps (keys[i], nss[i]) at exactly
+// that slot, else -1 (caller falls back to the hash probe there). A
+// passing verification can never name a wrong row — this IS the
+// table's content. One direct-indexed pass; no hashing.
+void sm_verify(void* h, int64_t n, const int64_t* keys, const int64_t* nss,
+               const int32_t* hints, int32_t* out_slots) {
+  SlotMap* m = (SlotMap*)h;
+  constexpr int64_t CHUNK = 256;
+  for (int64_t base = 0; base < n; base += CHUNK) {
+    int64_t end = base + CHUNK < n ? base + CHUNK : n;
+    for (int64_t r = base; r < end; r++) {
+      int32_t s = hints[r];
+      if (s >= 0 && s < m->capacity) {
+        __builtin_prefetch(&m->slot_used[s], 0, 1);
+        __builtin_prefetch(&m->slot_key[s], 0, 1);
+        __builtin_prefetch(&m->slot_ns[s], 0, 1);
+      }
+    }
+    for (int64_t r = base; r < end; r++) {
+      int32_t s = hints[r];
+      out_slots[r] = (s >= 0 && s < m->capacity && m->slot_used[s] &&
+                      m->slot_key[s] == keys[r] && m->slot_ns[s] == nss[r])
+                         ? s
+                         : -1;
+    }
+  }
+}
+
 // Erase pairs; writes freed slot ids to out_slots (only for pairs that were
 // present). Returns the number actually erased. Deletion is backward-shift
 // (Knuth 6.4 algorithm R): no tombstones, so probe chains stay short under
@@ -238,9 +267,29 @@ int64_t sm_erase(void* h, int64_t n, const int64_t* keys, const int64_t* nss,
   SlotMap* m = (SlotMap*)h;
   int64_t erased = 0;
   uint64_t mask = (uint64_t)m->bucket_count - 1;
-  for (int64_t r = 0; r < n; r++) {
+  constexpr int64_t CHUNK = 256;
+  uint64_t hashes[CHUNK];
+  for (int64_t base = 0; base < n; base += CHUNK) {
+    int64_t end = base + CHUNK < n ? base + CHUNK : n;
+    // chunked prefetch (same discipline as the probe paths): session
+    // fires erase tens of thousands of scattered pairs per watermark,
+    // each probe a likely miss. Erases inside the chunk only stale the
+    // hints — correctness never depends on them.
+    for (int64_t r = base; r < end; r++) {
+      uint64_t hh = mix_hash((uint64_t)keys[r], (uint64_t)nss[r]);
+      hashes[r - base] = hh;
+      __builtin_prefetch(&m->buckets[hh & mask], 0, 1);
+    }
+    for (int64_t r = base; r < end; r++) {
+      int32_t b = m->buckets[hashes[r - base] & mask];
+      if (b >= 0) {
+        __builtin_prefetch(&m->slot_key[b], 0, 1);
+        __builtin_prefetch(&m->slot_ns[b], 0, 1);
+      }
+    }
+  for (int64_t r = base; r < end; r++) {
     int64_t k = keys[r], ns = nss[r];
-    uint64_t i = mix_hash((uint64_t)k, (uint64_t)ns) & mask;
+    uint64_t i = hashes[r - base] & mask;
     for (;;) {
       int32_t b = m->buckets[i];
       if (b == -1) break;  // not present
@@ -272,6 +321,7 @@ int64_t sm_erase(void* h, int64_t n, const int64_t* keys, const int64_t* nss,
       }
       i = (i + 1) & mask;
     }
+  }
   }
   return erased;
 }

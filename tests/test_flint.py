@@ -279,6 +279,77 @@ class TestREG02MetricCounterRegistry:
         assert len(active) == 3
 
 
+# ------------------------------------------------------------------- NAT01
+
+
+class TestNAT01NativeCtypesSignatures:
+    FILES = {
+        "flink_tpu/__init__.py": "",
+        "flink_tpu/native/__init__.py": (
+            'NATIVE_SYMBOL_PREFIXES = ("sm_", "sx_")\n'
+            "\n"
+            "def load_slotmap():\n"
+            "    lib = _load()\n"
+            "    lib.sm_good.restype = None\n"
+            "    lib.sm_good.argtypes = []\n"
+            "    lib.sm_partial.argtypes = []\n"  # restype missing
+            "    return lib\n"
+        ),
+        "flink_tpu/user.py": (
+            "def run(lib):\n"
+            "    lib.sm_good()\n"
+            "    lib.sm_partial()\n"
+            "    lib.sx_undeclared(3)\n"  # no declaration at all
+        ),
+    }
+
+    def test_missing_and_partial_signatures_trip(self, tmp_path):
+        active, _ = run_fixture(tmp_path, self.FILES, ["NAT01"])
+        msgs = "\n".join(v.message for v in active)
+        assert "'sx_undeclared' is called without argtypes and restype" \
+            in msgs
+        assert "'sm_partial' is called without restype" in msgs
+        assert "'sm_partial' declares ['argtypes'] but not restype" \
+            in msgs
+        assert "sm_good" not in msgs
+        assert len(active) == 3
+
+    def test_clean_declarations_pass(self, tmp_path):
+        files = dict(self.FILES)
+        files["flink_tpu/native/__init__.py"] = (
+            'NATIVE_SYMBOL_PREFIXES = ("sm_", "sx_")\n'
+            "def load_all():\n"
+            "    lib = _load()\n"
+            "    for s in ('sm_good', 'sm_partial', 'sx_undeclared'):\n"
+            "        pass\n"
+            "    lib.sm_good.restype = None\n"
+            "    lib.sm_good.argtypes = []\n"
+            "    lib.sm_partial.restype = None\n"
+            "    lib.sm_partial.argtypes = []\n"
+            "    lib.sx_undeclared.restype = None\n"
+            "    lib.sx_undeclared.argtypes = []\n"
+            "    return lib\n"
+        )
+        active, _ = run_fixture(tmp_path, files, ["NAT01"])
+        assert active == []
+
+    def test_missing_prefix_registry_is_a_violation(self, tmp_path):
+        files = dict(self.FILES)
+        files["flink_tpu/native/__init__.py"] = "def load():\n    pass\n"
+        active, _ = run_fixture(tmp_path, files, ["NAT01"])
+        assert len(active) == 1
+        assert "NATIVE_SYMBOL_PREFIXES" in active[0].message
+
+    def test_head_tree_is_clean_for_nat01(self, tmp_path):
+        # the real package: every native symbol called anywhere has a
+        # full ctypes signature in its loader (the codec_free restype
+        # this rule caught on introduction stays fixed)
+        project = Project(
+            discover(["flink_tpu/"], REPO_ROOT), REPO_ROOT)
+        active, _ = run_checks(project, select=["NAT01"])
+        assert active == []
+
+
 # ------------------------------------------------------------- suppressions
 
 

@@ -139,6 +139,12 @@ def run(total: int, mesh, batch: int = 1 << 16):
         # (device mode) / bucketing (host mode) + dispatch bookkeeping,
         # EXCLUDING fence blocks and inline device interactions
         "host_prep_s": round(host_prep, 3),
+        # of which: time inside the NATIVE metadata sweeps (absorb /
+        # shard-group / route / pop — 0.0 on the pure-Python plane);
+        # pop sweeps land in the fire bucket, so this line can exceed
+        # neither bucket alone but attributes the C share explicitly
+        "native_sweep_s": round(
+            float(getattr(eng.meta, "native_sweep_s", 0.0)), 3),
         # device_step: fire dispatch + the fire path's synchronous
         # device work (page reloads / cohort evictions for cold fires)
         # + the device share carved out of host prep
@@ -165,6 +171,20 @@ def main():
 
     P = min(len(jax.devices()), 8)
     mesh = make_mesh(P)
+    from flink_tpu.native import sessions_available
+
+    native_plane = (os.environ.get("FLINK_TPU_NATIVE_SESSIONS") != "0"
+                    and sessions_available())
+    if os.environ.get("BENCH_REQUIRE_NATIVE") == "1" and not native_plane:
+        # no vacuous green: CI asked for the native metadata plane — a
+        # silent fallback to pure Python would pass the bench while
+        # measuring the wrong data plane entirely
+        print(json.dumps({
+            "metric": "mesh_sessions_10m_keys_events_per_sec",
+            "error": "BENCH_REQUIRE_NATIVE=1 but the native session "
+                     "plane is unavailable (compiler missing, build "
+                     "failed, or disabled via env)"}))
+        sys.exit(1)
     total = int(os.environ.get("BENCH_MESH_SESSION_RECORDS", 4_000_000))
     reps_n = max(int(os.environ.get("BENCH_MESH_REPS", 3)), 1)
     run(min(total, 1 << 20), mesh)  # warm: compile the step programs
@@ -186,6 +206,7 @@ def main():
         "backend": jax.devices()[0].platform,
         "mesh_shards": P,
         "shuffle_mode": mode,
+        "native_session_plane": native_plane,
         "sessions_fired": fired,
         "spill": counters,
         "breakdown": breakdown,

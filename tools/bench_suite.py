@@ -328,6 +328,8 @@ def main():
             if "host_prep_fraction" in bd:
                 extra += (f" (host-prep fraction "
                           f"{bd['host_prep_fraction']})")
+            if bd.get("native_sweep_s"):
+                extra += (f", native sweeps {bd['native_sweep_s']}s")
         if r.get("shuffle_mode"):
             extra += f", {r['shuffle_mode']}-mode shuffle"
         if r.get("fire_latency_ms"):
@@ -365,6 +367,21 @@ def main():
         "measures genuine host work (sessionization, slot resolution, "
         "flat staging); `tools/tier1.sh` gates it via "
         "`BENCH_HOST_PREP_BUDGET` in device mode.")
+    lines.append("")
+    lines.append(
+        "Native metadata plane (r12): the mesh-sessions row runs the "
+        "session metadata (sessionize -> absorb -> slot-resolve -> pop) "
+        "as ONE C sweep per batch (`native/sessions.cpp` via "
+        "`windowing/session_native.py`; design in NOTES_r12.md), with "
+        "the session's device slot FOLDED into its metadata row so "
+        "singleton sessions skip the state-plane hash probe "
+        "(fold-verify: a stale fold falls back to the probe, never a "
+        "wrong row). `native_sweep_s` reports the C share of the "
+        "breakdown; `native_session_plane` in the row JSON says which "
+        "plane ran, and the tier-1 smoke FAILS if the native plane was "
+        "requested but unavailable. The pure-Python plane "
+        "(`FLINK_TPU_NATIVE_SESSIONS=0`) is bit-identical in fires, "
+        "snapshots and spill counters (test-pinned).")
     lines.append("")
     lines.append(
         "The queryable-lookups row is `tools/serving_smoke.py` at bench "

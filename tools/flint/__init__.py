@@ -7,7 +7,7 @@ that varies per call erases the pipelining wins invisibly (no test
 fails — throughput just drops 2-5x). flint makes those regressions a
 CI failure instead of a benchmark archaeology project.
 
-Five rules:
+Six rules:
 
 - **TRC01 host-sync-in-hot-path** — ``.item()``, ``float()/int()/
   bool()`` on device-tainted values, per-array ``np.asarray`` reads and
@@ -26,6 +26,11 @@ Five rules:
 - **REG02 metric-counter-registry** — spill-counter and metric-group
   name literals consistent between producers (``state/``,
   ``parallel/``) and consumers (``autoscale/``, ``tools/``).
+- **NAT01 native-ctypes-signatures** — every function fetched off a
+  ``load_native`` CDLL (symbols matching
+  ``flink_tpu.native.NATIVE_SYMBOL_PREFIXES``) declares ``argtypes``
+  AND ``restype`` before first call; an undeclared ``restype``
+  silently truncates 64-bit returns and pointers to C int.
 
 False positives are silenced in place with a reviewed suppression that
 MUST carry a reason::

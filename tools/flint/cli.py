@@ -16,6 +16,7 @@ import sys
 from pathlib import Path
 
 # import for side effect: checker registration
+from tools.flint import rules_native  # noqa: F401
 from tools.flint import rules_registry  # noqa: F401
 from tools.flint import rules_trace  # noqa: F401
 from tools.flint.core import (

@@ -1,0 +1,129 @@
+"""NAT01 — the ctypes signature rule for native (C ABI) symbols.
+
+Every function fetched off a CDLL returned by ``load_native`` must have
+``argtypes`` AND ``restype`` declared before its first call. ctypes
+defaults an undeclared ``restype`` to C ``int`` — a 64-bit count or a
+pointer silently truncates to 32 bits, the bug class that corrupts at
+2^31 rows instead of failing loudly — and undeclared ``argtypes`` let a
+Python int pass where a pointer is expected. The native package exports
+the canonical symbol-prefix registry (``NATIVE_SYMBOL_PREFIXES``), so
+producers (loader declarations) and consumers (call sites anywhere in
+the package or tools/) are cross-checked statically against one source,
+the same discipline REG01/REG02 apply to fault points and metrics.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from tools.flint.core import Checker, Project, SourceFile, Violation, register
+
+_NATIVE_PKG_FILE = "flink_tpu/native/__init__.py"
+
+#: ctypes attributes that constitute a full declaration
+_DECL_ATTRS = ("argtypes", "restype")
+
+
+def _prefix_registry(sf: SourceFile):
+    """(line, prefixes) of the literal NATIVE_SYMBOL_PREFIXES tuple."""
+    if sf.tree is None:
+        return None
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) \
+                        and t.id == "NATIVE_SYMBOL_PREFIXES" \
+                        and isinstance(node.value, (ast.Tuple, ast.List)):
+                    vals = []
+                    for e in node.value.elts:
+                        if isinstance(e, ast.Constant) and isinstance(
+                                e.value, str):
+                            vals.append(e.value)
+                        else:
+                            return (node.lineno, tuple())
+                    return (node.lineno, tuple(vals))
+    return None
+
+
+@register
+class NativeCtypesSignatures(Checker):
+    rule = "NAT01"
+    title = ("every native symbol fetched off a load_native CDLL "
+             "declares argtypes AND restype before first call "
+             "(undeclared restype silently truncates to C int)")
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        reg_sf = project.get(_NATIVE_PKG_FILE)
+        if reg_sf is None:
+            yield Violation(
+                rule=self.rule, path=_NATIVE_PKG_FILE, line=1, col=0,
+                message="native package not found — cannot check ctypes "
+                        "signatures")
+            return
+        parsed = _prefix_registry(reg_sf)
+        if parsed is None or not parsed[1]:
+            yield Violation(
+                rule=self.rule, path=reg_sf.path, line=1, col=0,
+                message="no literal NATIVE_SYMBOL_PREFIXES tuple — the "
+                        "canonical native-symbol prefix registry must be "
+                        "a module-level string tuple here")
+            return
+        _, prefixes = parsed
+
+        def is_native_sym(name: str) -> bool:
+            return name.startswith(prefixes)
+
+        #: sym -> set of declared ctypes attrs, with one decl site
+        declared: Dict[str, Set[str]] = {}
+        decl_site: Dict[str, Tuple[SourceFile, int, int]] = {}
+        #: sym -> call sites
+        called: Dict[str, List[Tuple[SourceFile, int, int]]] = {}
+        scan = project.package_files("flink_tpu") \
+            + project.aux_glob("tools/*.py")
+        for sf in scan:
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                # declaration: <expr>.<sym>.argtypes = ... / .restype = ...
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and t.attr in _DECL_ATTRS \
+                                and isinstance(t.value, ast.Attribute) \
+                                and is_native_sym(t.value.attr):
+                            sym = t.value.attr
+                            declared.setdefault(sym, set()).add(t.attr)
+                            decl_site.setdefault(
+                                sym, (sf, node.lineno, node.col_offset))
+                    continue
+                # call: <expr>.<sym>(...)
+                if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute) \
+                        and is_native_sym(node.func.attr):
+                    called.setdefault(node.func.attr, []).append(
+                        (sf, node.lineno, node.col_offset))
+
+        for sym, sites in sorted(called.items()):
+            missing = [a for a in _DECL_ATTRS
+                       if a not in declared.get(sym, set())]
+            if missing:
+                sf, line, col = sites[0]
+                yield Violation(
+                    rule=self.rule, path=sf.path, line=line, col=col,
+                    message=f"native symbol {sym!r} is called without "
+                            f"{' and '.join(missing)} declared in any "
+                            "loader — declare the full ctypes signature "
+                            "in the load_* function before first use")
+        # partial declarations are latent versions of the same bug even
+        # before a call site lands
+        for sym, attrs in sorted(declared.items()):
+            missing = [a for a in _DECL_ATTRS if a not in attrs]
+            if missing:
+                sf, line, col = decl_site[sym]
+                yield Violation(
+                    rule=self.rule, path=sf.path, line=line, col=col,
+                    message=f"native symbol {sym!r} declares "
+                            f"{sorted(attrs)} but not "
+                            f"{' or '.join(missing)} — incomplete ctypes "
+                            "signature")

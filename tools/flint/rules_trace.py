@@ -258,6 +258,14 @@ HOT_MODULE_ROOTS: Dict[str, Tuple[str, ...]] = {
         "bucket_by_shard",
         "_build_exchange_scatter",
     ),
+    # the native session-metadata plane's per-batch sweep entry points:
+    # one C call per (engine, batch) for absorb/pop — rooted explicitly
+    # so host syncs creeping into their Python halves stay caught even
+    # if an engine stops calling through a rooted method
+    "flink_tpu.windowing.session_native": (
+        "native_absorb",
+        "native_pop",
+    ),
 }
 
 
