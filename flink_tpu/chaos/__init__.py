@@ -39,6 +39,8 @@ KNOWN_FAULT_POINTS = (
     "harvest.pending_fire",
     "task.batch",
     "task.subtask_batch",
+    "device.lost",
+    "watchdog.deadline",
 )
 
 from flink_tpu.chaos.injection import (  # noqa: E402,F401
@@ -46,6 +48,7 @@ from flink_tpu.chaos.injection import (  # noqa: E402,F401
     FaultPlan,
     FaultRule,
     InjectedFault,
+    RetryBudgetExhaustedError,
     arm,
     armed,
     chaos_active,
@@ -62,4 +65,5 @@ from flink_tpu.chaos.harness import (  # noqa: E402,F401
     ChaosReport,
     run_crash_restore_verify,
     run_crash_restore_verify_multi,
+    run_shard_loss_verify,
 )

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -62,6 +63,15 @@ class ChaosReport:
     #: completed LIVE key-group migrations (engine.reshard) — replays
     #: past an already-applied rescale position do not re-count
     live_handoffs: int = 0
+    #: shard-granular failovers (run_shard_loss_verify): shards
+    #: declared dead, key-group ranges restored from their checkpoint
+    #: units, and records re-absorbed to rebuild those ranges — the
+    #: bounded-replay claim is ``records_replayed <= events/shards +
+    #: padding``, gated in tools/chaos_smoke.py
+    shards_lost: int = 0
+    shard_restores: int = 0
+    records_replayed: int = 0
+    shard_loss_recovery_ms: float = 0.0
     divergences: List[str] = dataclasses.field(default_factory=list)
 
     @property
@@ -79,8 +89,24 @@ class ChaosReport:
             "faults_injected": dict(self.faults_injected),
             "windows": self.windows,
             "live_handoffs": self.live_handoffs,
+            "shards_lost": self.shards_lost,
+            "shard_restores": self.shard_restores,
+            "records_replayed": self.records_replayed,
             "diverged": self.diverged,
         }
+
+    def register_metrics(self, group) -> None:
+        """Surface the restore-path counters through a job metric tree
+        (``<scope>.chaos.*``): gauges read the LIVE report, so a group
+        registered before the run sees every later restore. The
+        harnesses call this when given ``metric_group=``; today only
+        harness reports carried these numbers."""
+        g = group.add_group("chaos")
+        for name in ("restores", "cold_restarts",
+                     "corrupt_checkpoints_skipped", "crashes",
+                     "shards_lost", "shard_restores",
+                     "records_replayed", "checkpoints_written"):
+            g.gauge(name, lambda self=self, n=name: getattr(self, n))
 
 
 def _keyed_batch(keys, values, ts):
@@ -183,6 +209,7 @@ def run_crash_restore_verify(
     abs_tol: float = 1e-3,
     check: bool = True,
     rescales: Optional[Dict[int, int]] = None,
+    metric_group=None,
 ) -> ChaosReport:
     """Run ``steps`` (list of ``(keys, values, timestamps, watermark)``)
     through a chaotic engine with periodic checkpoints and through a
@@ -209,6 +236,8 @@ def run_crash_restore_verify(
 
     report = ChaosReport()
     report.events = int(sum(len(s[0]) for s in steps))
+    if metric_group is not None:
+        report.register_metrics(metric_group)
 
     # ---- fault-free oracle (single device, unbounded state) ----
     expected: Dict[_WindowKey, Dict[str, float]] = {}
@@ -463,3 +492,287 @@ def run_crash_restore_verify_multi(
                 "differences):\n  "
                 + "\n  ".join(reports[j].divergences))
     return reports
+
+
+def run_shard_loss_verify(
+    make_engine: Callable[[], Any],
+    make_oracle: Callable[[], Any],
+    steps: Sequence[Tuple[np.ndarray, np.ndarray, np.ndarray, int]],
+    plan: FaultPlan,
+    seed: int,
+    ckpt_root: str,
+    checkpoint_every: int = 2,
+    job_name: str = "shard-loss-harness",
+    max_shard_losses: int = 4,
+    max_crashes: int = 8,
+    watchdog_deadline_ms: float = 0.0,
+    watchdog_max_misses: int = 3,
+    rel_tol: float = 1e-4,
+    abs_tol: float = 1e-3,
+    check: bool = True,
+    metric_group=None,
+) -> ChaosReport:
+    """Partial-failover form of :func:`run_crash_restore_verify`: the
+    unit of failure and recovery is the SHARD (key-group range), not
+    the job.
+
+    A :class:`~flink_tpu.runtime.watchdog.DeviceWatchdog` wraps the
+    engine's device interactions; a chaos-injected ``device.lost``
+    fault (or an escalated ``watchdog.deadline`` miss streak) declares
+    one shard dead at a batch boundary. Recovery then
+
+    1. evacuates the SURVIVORS' live rows and rebuilds the mesh over
+       the remaining devices (``engine.lose_shard`` — the reshard
+       machinery, dirtiness and recency intact),
+    2. restores ONLY the dead shard's key groups from their newest
+       verified checkpoint unit (``ShardedCheckpointStorage`` — a torn
+       unit falls back to that RANGE's unit in an older checkpoint,
+       never discarding the whole chk-N), and
+    3. replays ONLY that range's records from the unit's source
+       position (``records_replayed`` counts them — the bounded-replay
+       claim: about ``events/shards`` per loss, not the whole stream).
+
+    Checkpoints are written SHARD-GRANULAR (``engine.snapshot_sharded``
+    keyed by key-group range, per-unit source positions in the
+    manifest). A non-shard crash (any other injected fault) takes the
+    whole-job path: a fresh engine restores ALL units with per-unit
+    fallback; ranges whose unit fell back to an older checkpoint are
+    GATED during the catch-up replay so ranges already ahead never
+    re-absorb older records.
+
+    Committed output must be bit-identical (within float tolerance) to
+    the fault-free single-device oracle, and the whole run is
+    reproducible from (plan, seed).
+    """
+    from flink_tpu.checkpoint.sharded import ShardedCheckpointStorage
+    from flink_tpu.runtime.watchdog import (
+        DeviceWatchdog,
+        MeshStalledError,
+        ShardFailedError,
+    )
+    from flink_tpu.state.keygroups import assign_key_groups
+
+    if chaos.armed():
+        raise RuntimeError(
+            "run_shard_loss_verify arms its own controller — disarm "
+            "the ambient one first (the oracle must run fault-free)")
+
+    report = ChaosReport()
+    report.events = int(sum(len(s[0]) for s in steps))
+    if metric_group is not None:
+        report.register_metrics(metric_group)
+
+    # ---- fault-free oracle (single device, unbounded state) ----
+    expected: Dict[_WindowKey, Dict[str, float]] = {}
+    oracle = make_oracle()
+    for keys, vals, ts, wm in steps:
+        oracle.process_batch(_keyed_batch(keys, vals, ts))
+        _collect(oracle.on_watermark(int(wm)), expected)
+    _collect(oracle.on_watermark(FINAL_WATERMARK), expected)
+
+    # ---- chaotic run ----
+    storage = ShardedCheckpointStorage(ckpt_root)
+    committed: Dict[_WindowKey, Dict[str, float]] = {}
+    epoch: Dict[_WindowKey, Dict[str, float]] = {}
+    n_steps = len(steps)
+
+    def _attach(engine):
+        wd = DeviceWatchdog(engine.P,
+                            deadline_ms=watchdog_deadline_ms,
+                            max_misses=watchdog_max_misses)
+        engine.attach_watchdog(wd)
+        return engine
+
+    def _range_mask(keys, g0: int, g1: int) -> np.ndarray:
+        kg = assign_key_groups(np.asarray(keys, dtype=np.int64),
+                               engine.max_parallelism)
+        return (kg >= g0) & (kg <= g1)
+
+    with chaos.chaos_active(plan, seed) as ctl:
+        engine = _attach(make_engine())
+        pos = 0
+        cid = 0
+        phase = 0             # 0 = batch pending, 1 = watermark pending
+        need_restore = False
+        pending_loss: Optional[Tuple[int, int]] = None  # (shard, phase)
+        #: (g0, g1, pos_r): range already absorbed up to pos_r — skip
+        #: its records while pos < pos_r (mixed-age unit restore)
+        gates: List[Tuple[int, int, int]] = []
+        while pos <= n_steps:
+            try:
+                if need_restore:
+                    engine = _attach(make_engine())
+                    found = storage.read_all_units_with_fallback()
+                    if found is None:
+                        report.cold_restarts += 1
+                        committed, epoch = {}, {}
+                        pos, phase, gates = 0, 0, []
+                        need_restore = False
+                        continue
+                    newest, units, skipped = found
+                    report.corrupt_checkpoints_skipped += skipped
+                    states = [state for _, state, _ in units]
+                    if len(units) < len(storage.unit_ranges(newest)):
+                        # a range with NO restorable unit replays cold
+                        # from 0: its staleness guards must roll all
+                        # the way back (empty pseudo-unit => the merge
+                        # takes the -inf defaults)
+                        states = states + [{}]
+                    engine.restore(engine.merge_unit_snapshots(states))
+                    report.restores += 1
+                    positions = {r: p for r, _, p in units}
+                    pos = min(positions.values()) \
+                        if len(units) == len(
+                            storage.unit_ranges(newest)) else 0
+                    gates = [(r[0], r[1], p)
+                             for r, p in positions.items() if p > pos]
+                    phase = 0
+                    need_restore = False
+                    continue
+                if pending_loss is not None:
+                    dead, at_phase = pending_loss
+                    t0 = time.perf_counter()
+                    g0, g1 = engine.lose_shard(dead)
+                    groups = range(g0, g1 + 1)
+                    # gates SPLIT around the dead range: the overlap is
+                    # being rebuilt from its unit (its gate is moot),
+                    # but a partially-overlapping gate's OUTSIDE
+                    # sub-ranges still hold state ahead of pos and must
+                    # stay gated or they would re-absorb records they
+                    # already hold
+                    split: List[Tuple[int, int, int]] = []
+                    for a, b, p_r in gates:
+                        if b < g0 or a > g1:
+                            split.append((a, b, p_r))
+                            continue
+                        if a < g0:
+                            split.append((a, g0 - 1, p_r))
+                        if b > g1:
+                            split.append((g1 + 1, b, p_r))
+                    gates = split
+                    found = storage.latest_units_for_groups(groups)
+                    if found is None:
+                        unit_pos = 0
+                        # roll the range's staleness guards back to
+                        # stream start (cold range replay)
+                        engine.restore_key_groups({"table": {}}, groups)
+                    else:
+                        _ucid, states, unit_pos = found
+                        engine.restore_key_groups(
+                            engine.merge_unit_snapshots(states), groups)
+                        report.shard_restores += 1
+                    # uncommitted output of the range is rolled back
+                    # with its state; replay re-produces it
+                    if epoch:
+                        ekeys = np.asarray([k[0] for k in epoch],
+                                           dtype=np.int64)
+                        drop = _range_mask(ekeys, g0, g1)
+                        epoch = {k: v for k, v, d in zip(
+                            epoch, epoch.values(), drop) if not d}
+                    # bounded replay: ONLY the range's records, from
+                    # the unit's position; the step being interrupted
+                    # mid-watermark (at_phase=1) already absorbed pos's
+                    # batch on the survivors, so the range re-absorbs
+                    # through pos INCLUSIVE and the main flow refires
+                    # pos's watermark for everyone. The replay is a
+                    # CRITICAL SECTION: the watchdog detaches for it —
+                    # a second loss declared mid-replay would abandon
+                    # this range's partially-completed rebuild; a
+                    # genuinely dead second device is declared at the
+                    # next main-loop boundary instead
+                    wd_held = engine._watchdog
+                    engine.attach_watchdog(None)
+                    try:
+                        upto = pos + (1 if at_phase == 1 else 0)
+                        for rpos in range(unit_pos, min(upto, n_steps)):
+                            keys, vals, ts, _wm = steps[rpos]
+                            mask = _range_mask(keys, g0, g1)
+                            if mask.any():
+                                engine.process_batch(_keyed_batch(
+                                    keys[mask], vals[mask], ts[mask]))
+                                report.records_replayed += int(
+                                    mask.sum())
+                            if rpos < pos:
+                                _collect(engine.on_watermark(
+                                    int(steps[rpos][3])), epoch)
+                    finally:
+                        engine._watchdog = wd_held
+                    report.shard_loss_recovery_ms += (
+                        time.perf_counter() - t0) * 1000.0
+                    pending_loss = None
+                    continue
+                if phase == 0:
+                    # gate expiry first (also at the final-flush step,
+                    # where no batch runs — a stuck gate would defer
+                    # the final checkpoint and lose the last epoch)
+                    if gates:
+                        gates = [g for g in gates if pos < g[2]]
+                    if pos < n_steps:
+                        keys, vals, ts, _wm = steps[pos]
+                        if gates:
+                            kg = assign_key_groups(
+                                np.asarray(keys, dtype=np.int64),
+                                engine.max_parallelism)
+                            allow = np.ones(len(keys), dtype=bool)
+                            for a, b, p_r in gates:
+                                allow &= ~((kg >= a) & (kg <= b))
+                            if allow.any():
+                                engine.process_batch(_keyed_batch(
+                                    keys[allow], vals[allow],
+                                    ts[allow]))
+                        else:
+                            engine.process_batch(
+                                _keyed_batch(keys, vals, ts))
+                    phase = 1
+                    continue
+                # phase 1: watermark (FINAL flush at end of input)
+                wm = FINAL_WATERMARK if pos == n_steps \
+                    else int(steps[pos][3])
+                _collect(engine.on_watermark(wm), epoch)
+                next_pos = pos + 1
+                # checkpoints are DEFERRED while replay gates are
+                # active: a gated range's state is already ahead of
+                # pos, so recording source_pos=next_pos for its unit
+                # would make a later restore double-replay the records
+                # it already absorbed (alignment returns within at most
+                # checkpoint_every steps, so the deferral is bounded)
+                if (next_pos % checkpoint_every == 0
+                        or next_pos > n_steps) and not gates:
+                    cid += 1
+                    units = engine.snapshot_sharded()
+                    storage.write_checkpoint(
+                        cid, job_name, units,
+                        positions={r: next_pos for r in units})
+                    report.checkpoints_written += 1
+                    committed.update(epoch)
+                    epoch = {}
+                pos = next_pos
+                phase = 0
+            except ShardFailedError as sf:
+                report.shards_lost += 1
+                if report.shards_lost > max_shard_losses:
+                    raise
+                pending_loss = (sf.shard, phase)
+            except (InjectedFault, MeshStalledError):
+                # an unattributable mesh-wide stall takes the same
+                # whole-job path a crash does (see MeshStalledError)
+                report.crashes += 1
+                if report.crashes > max_crashes:
+                    raise
+                epoch = {}
+                pending_loss = None
+                need_restore = True
+
+        report.faults_injected = dict(ctl.faults_injected)
+        report.points_hit = dict(ctl.points_hit)
+        report.retries = ctl.retries
+        report.recoveries = ctl.recoveries
+
+    report.windows = len(committed)
+    report.divergences = _diff(expected, committed, rel_tol, abs_tol)
+    if check and report.divergences:
+        raise ChaosDivergenceError(
+            f"shard-loss output diverged from the fault-free oracle "
+            f"({len(report.divergences)} differences):\n  "
+            + "\n  ".join(report.divergences))
+    return report

@@ -123,6 +123,14 @@ class FaultPlan:
     retry_max_attempts: int = 4
     retry_initial_ms: int = 0
     retry_max_ms: int = 8
+    #: PROCESS-LEVEL retry budget across ALL recoverable sites (0 =
+    #: unlimited): per-site backoff bounds one site's attempts, but a
+    #: permanently failing tier that keeps "recovering" elsewhere would
+    #: otherwise retry forever. When the global budget is spent, the
+    #: next recoverable fault ESCALATES to a real (non-recoverable)
+    #: failure — the same declare-dead discipline the device watchdog
+    #: applies to persistently slow shards, extended to soft faults.
+    retry_budget_total: int = 0
 
     @staticmethod
     def from_spec(spec) -> "FaultPlan":
@@ -168,6 +176,20 @@ class ChaosController:
         self.points_hit: Dict[str, int] = {}
         self.retries = 0
         self.recoveries = 0
+        #: recoverable faults escalated to real failures because the
+        #: process-level retry budget was exhausted
+        self.budget_exhausted = 0
+
+    def consume_retry_budget(self) -> bool:
+        """Account one retry against the process-level budget; False
+        means the budget is spent and the fault must escalate."""
+        with self._lock:
+            total = self.plan.retry_budget_total
+            if total and self.retries >= total:
+                self.budget_exhausted += 1
+                return False
+            self.retries += 1
+            return True
 
     # ------------------------------------------------------------- decisions
 
@@ -243,6 +265,7 @@ class ChaosController:
             "faults_injected_total": sum(self.faults_injected.values()),
             "retries": self.retries,
             "recoveries": self.recoveries,
+            "retry_budget_exhausted": self.budget_exhausted,
         }
 
 
@@ -315,11 +338,29 @@ def payload_action(point: str, kinds: Tuple[str, ...] = FAULT_KINDS,
     return c._apply_payload(point, ctx, kinds)
 
 
+class RetryBudgetExhaustedError(RuntimeError):
+    """The process-level retry budget is spent: a recoverable fault
+    escalated to a real failure (permanent soft fault — e.g. a spill
+    tier that never stops failing). Carries the original fault."""
+
+    def __init__(self, point: str, fault: InjectedFault) -> None:
+        super().__init__(
+            f"global retry budget exhausted at {point!r}: recoverable "
+            f"fault escalated to a real failure ({fault})")
+        self.point = point
+        self.fault = fault
+
+
 def run_recoverable(point: str, fn: Callable[[], T]) -> T:
     """Run ``fn``, retrying transient (``recoverable``) InjectedFaults
     with restart-strategy backoff; counts retries and (on eventual
-    success) recoveries. Non-recoverable faults and exhausted budgets
-    propagate — they are the crash path."""
+    success) recoveries. Non-recoverable faults and exhausted per-site
+    budgets propagate — they are the crash path. The PROCESS-LEVEL
+    budget (``FaultPlan.retry_budget_total``) bounds total retries
+    across all sites: once spent, the next recoverable fault escalates
+    as :class:`RetryBudgetExhaustedError` instead of retrying forever
+    (counted in ``retry_budget_exhausted`` on the ``chaos`` metric
+    group)."""
     c = _controller
     if c is None:
         return fn()
@@ -338,9 +379,9 @@ def run_recoverable(point: str, fn: Callable[[], T]) -> T:
             strategy.notify_failure()
             if not strategy.can_restart():
                 raise
+            if not c.consume_retry_budget():
+                raise RetryBudgetExhaustedError(point, f) from f
             retried = True
-            with c._lock:
-                c.retries += 1
             backoff = strategy.backoff_ms()
             if backoff:
                 time.sleep(backoff / 1000.0)
@@ -370,3 +411,4 @@ def register_chaos_metrics(group) -> None:
     g.gauge("retries", lambda c=c: c.retries)
     g.gauge("recoveries", lambda c=c: c.recoveries)
     g.gauge("points_hit", lambda c=c: sum(c.points_hit.values()))
+    g.gauge("retry_budget_exhausted", lambda c=c: c.budget_exhausted)

@@ -1,3 +1,5 @@
+from flink_tpu.checkpoint.sharded import ShardedCheckpointStorage
 from flink_tpu.checkpoint.storage import CheckpointStorage, CheckpointMetadata
 
-__all__ = ["CheckpointStorage", "CheckpointMetadata"]
+__all__ = ["CheckpointStorage", "CheckpointMetadata",
+           "ShardedCheckpointStorage"]

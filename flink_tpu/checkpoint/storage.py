@@ -419,6 +419,41 @@ def checkpoint_chain_ids(root: str, checkpoint_id: int) -> List[int]:
     return ids
 
 
+def retain_verified_anchors(ids, keep: int, verify_ok, chain_ids,
+                            verified_cache: set, delete) -> None:
+    """The ONE torn-aware retention core shared by the flat and sharded
+    checkpoint stores: scan newest-first, anchor the ``keep`` newest
+    checkpoints whose ``verify_ok`` passes (memoized in
+    ``verified_cache`` — checkpoints are immutable after the atomic
+    rename), keep everything at/above the oldest anchor plus every id
+    an anchor's incremental chain needs, delete the rest unread. If
+    nothing verifies, delete nothing (GC must never strand the job).
+
+    ``verify_ok(cid)`` must verify the WHOLE restorable artifact —
+    including incremental base chains: an anchor whose base is corrupt
+    is not restorable, and anchoring it would let GC delete the older
+    complete snapshots the fallback needs."""
+    anchors = []
+    needed = set()
+    for i in reversed(ids):
+        if len(anchors) >= keep:
+            break
+        if i not in verified_cache:
+            if not verify_ok(i):
+                continue  # torn/corrupt: not an anchor; kept only if
+                # newer than the oldest anchor (harmless forensics)
+            verified_cache.add(i)
+        anchors.append(i)
+        needed.update(chain_ids(i))
+    if not anchors:
+        return
+    floor = min(anchors)
+    for i in ids:
+        if i >= floor or i in needed:
+            continue
+        delete(i)
+
+
 def resolve_snapshot_dir(path: str) -> str:
     """Accept either a self-contained snapshot dir (savepoint / single
     checkpoint) or a checkpoint root holding chk-N children (newest wins)."""
@@ -449,6 +484,10 @@ class CheckpointStorage:
     def __init__(self, root: str, compress: bool = True):
         self.root = root
         self.compress = compress
+        #: checkpoint ids that passed a FULL CRC verification in this
+        #: process — snapshots are immutable after the atomic rename,
+        #: so retention never pays the verify I/O for the same id twice
+        self._verified_ids: set = set()
         os.makedirs(root, exist_ok=True)
 
     # ------------------------------------------------------------------ write
@@ -502,21 +541,39 @@ class CheckpointStorage:
         return None
 
     def retain(self, keep: int) -> None:
-        """Drop all but the newest ``keep`` checkpoints — never a checkpoint
-        that a retained incremental checkpoint still references as (part of)
-        its base chain (reference: shared-state registry refcounting in
-        SharedStateRegistry)."""
+        """Drop all but the newest ``keep`` COMPLETE checkpoints —
+        never a checkpoint that a retained incremental checkpoint still
+        references as (part of) its base chain (reference: shared-state
+        registry refcounting in SharedStateRegistry), and never the
+        fallback chain below a torn/corrupt newest: retention anchors
+        on the ``keep`` newest checkpoints that PASS verification
+        (including every link of an incremental chain — a delta whose
+        base is corrupt is not restorable), so a torn chk-N can never
+        strand the job with zero restorable checkpoints. Shared core:
+        :func:`retain_verified_anchors`."""
         if keep <= 0:
             return
         all_ids = sorted(
             int(n[4:]) for n in os.listdir(self.root)
             if n.startswith("chk-") and n[4:].isdigit())
-        needed = set()
-        for i in all_ids[-keep:]:
-            needed.update(checkpoint_chain_ids(self.root, i))
-        for i in all_ids[:-keep]:
-            if i not in needed:
-                shutil.rmtree(self._dir(i), ignore_errors=True)
+
+        def verify_ok(i: int) -> bool:
+            try:
+                # the whole restorable artifact: the checkpoint AND its
+                # incremental base chain
+                for cid in checkpoint_chain_ids(self.root, i):
+                    d = self._dir(cid)
+                    verify_snapshot_files(
+                        d, read_manifest(d).get("file_crcs") or {})
+                return True
+            except (CheckpointCorruptedError, OSError, ValueError):
+                return False
+
+        retain_verified_anchors(
+            all_ids, keep, verify_ok,
+            lambda i: checkpoint_chain_ids(self.root, i),
+            self._verified_ids,
+            lambda i: shutil.rmtree(self._dir(i), ignore_errors=True))
 
     # ---------------------------------------------------------------- helpers
 

@@ -285,7 +285,7 @@ class JobHandle:
     fairness/arbitration signals (busy time, backlog, resident rows)."""
 
     def __init__(self, job_name, graph, nodes, registry, traces,
-                 job_group, pumps, sources):
+                 job_group, pumps, sources, watchdog=None):
         self.job_name = job_name
         self.graph = graph
         self.nodes = nodes
@@ -294,6 +294,10 @@ class JobHandle:
         self.job_group = job_group
         self.pumps = pumps
         self.sources = sources
+        #: the job's DeviceWatchdog when watchdog.enabled (None
+        #: otherwise) — the tenancy arbiter reads its quarantine count
+        #: to shrink the cross-job shard budget
+        self.watchdog = watchdog
 
     def stateful_operators(self):
         """Operators owning keyed device state (spill_counters is the
@@ -405,6 +409,19 @@ class LocalExecutor:
         # chaos counters ride the job's metric tree when a fault plan is
         # armed (job.<name>.chaos.faults_injected / retries / recoveries)
         chaos.register_chaos_metrics(job_group)
+        # device watchdog (watchdog.enabled): one per job, attached to
+        # every mesh engine through the operator context; heartbeat
+        # gauges under job.<name>.watchdog. A ShardFailedError it raises
+        # surfaces through the normal failure path (restart strategy ->
+        # restore) — the SHARD-granular recovery protocol itself is the
+        # chaos harness's run_shard_loss_verify (see README "Failure
+        # domains").
+        from flink_tpu.runtime.watchdog import watchdog_from_config
+
+        watchdog = watchdog_from_config(
+            self.config, self.config.get(CoreOptions.DEFAULT_PARALLELISM))
+        if watchdog is not None:
+            watchdog.register_metrics(job_group)
 
         # build nodes
         nodes: Dict[int, _Node] = {}
@@ -436,7 +453,8 @@ class LocalExecutor:
                                           BatchOptions.MAX_DISPATCH_AHEAD),
                                       memory_manager=memory_manager,
                                       shuffle_mode=self.config.get(
-                                          DeploymentOptions.SHUFFLE_MODE))
+                                          DeploymentOptions.SHUFFLE_MODE),
+                                      watchdog=watchdog)
                 op.open(ctx)
             nodes[t.uid] = node
             g = job_group.add_group(f"{t.name}#{t.uid}")
@@ -562,7 +580,8 @@ class LocalExecutor:
             "sourceBacklogRecordsEstimate",
             lambda: sum(p.queue.qsize() * p.batch_size
                         for p in pumps.values()))
-        autoscale = self._setup_autoscale(nodes, job_group, pumps)
+        autoscale = self._setup_autoscale(nodes, job_group, pumps,
+                                          watchdog=watchdog)
         # wall-clock tick targets (processing-time windows/timers)
         pt_nodes = [n for n in nodes.values()
                     if n.operator is not None
@@ -571,7 +590,7 @@ class LocalExecutor:
             yield JobHandle(job_name=job_name, graph=graph, nodes=nodes,
                             registry=registry, traces=traces,
                             job_group=job_group, pumps=pumps,
-                            sources=sources)
+                            sources=sources, watchdog=watchdog)
             while active:
                 step_records = 0
                 if cancel_event is not None and cancel_event.is_set():
@@ -675,8 +694,7 @@ class LocalExecutor:
                                     op, "notify_checkpoint_complete"):
                                 op.notify_checkpoint_complete(
                                     checkpoint_count)
-                        storage.retain(
-                            self.config.get(CheckpointOptions.RETAINED))
+                        storage.retain(self._retained())
                         last_ckpt = time.time() * 1000
                         batches_since_ckpt = 0
                 if control_queue is not None:
@@ -789,14 +807,21 @@ class LocalExecutor:
         result.traces = traces
         return result
 
+    def _retained(self) -> int:
+        from flink_tpu.core.config import retained_checkpoints
+
+        return retained_checkpoints(self.config)
+
     # ------------------------------------------------------------ autoscale
 
-    def _setup_autoscale(self, nodes, job_group, pumps):
+    def _setup_autoscale(self, nodes, job_group, pumps, watchdog=None):
         """Build the in-loop autoscale controller for the first keyed
         operator that supports LIVE reshard (mesh engine), when
         autoscale.enabled. The controller ticks at batch boundaries on
         the task loop — the single-owner point where migrating device
-        state is race-free."""
+        state is race-free. A watchdog-quarantined (dead) shard shrinks
+        the device budget: the policy must not scale onto a device that
+        no longer answers."""
         from flink_tpu.core.config import AutoscaleOptions
 
         if not self.config.get(AutoscaleOptions.ENABLED):
@@ -855,6 +880,11 @@ class LocalExecutor:
             # in-flight fires reference the pre-reshard device arrays —
             # the drain boundary is the same one checkpoints use
             self._drain_pending(nodes, wait=True)
+            if watchdog is not None:
+                # a dead shard changes the budget: never scale onto a
+                # quarantined device
+                new_shards = min(
+                    new_shards, watchdog.available(len(jax.devices())))
             return node.operator.reshard(new_shards)
 
         return AutoscaleController(

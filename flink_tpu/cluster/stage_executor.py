@@ -2039,6 +2039,13 @@ class StageParallelExecutor:
                                    checkpoint_id=checkpoint_id)
         if storage is not None:
             storage.write_checkpoint(checkpoint_id, job_name, snap)
+            # bounded disk: same torn-aware GC as the single-slot
+            # executor (state.checkpoints.num-retained; retention
+            # anchors on VERIFIED checkpoints, so a torn newest never
+            # strands the fallback chain)
+            from flink_tpu.core.config import retained_checkpoints
+
+            storage.retain(retained_checkpoints(self.config))
         return None
 
 

@@ -323,6 +323,13 @@ class StateOptions:
     CHECKPOINT_DIR = ConfigOption(
         "state.checkpoints.dir", default=None, type=str,
         description="Directory for checkpoint snapshots.")
+    NUM_RETAINED = ConfigOption(
+        "state.checkpoints.num-retained", default=3, type=int,
+        description="Completed checkpoints to keep on disk (reference: "
+        "state.checkpoints.num-retained). GC anchors on the newest "
+        "checkpoints that PASS CRC verification: a torn/corrupt newest "
+        "can never strand the job by deleting its fallback chain. "
+        "Overrides execution.checkpointing.retained when both are set.")
     MAX_DEVICE_SLOTS = ConfigOption(
         "state.slot-table.max-device-slots", default=0, type=int,
         description="Device-resident slot budget per keyed state (HBM "
@@ -423,6 +430,41 @@ class CheckpointOptions:
         "bounded time under backpressure (reference: "
         "ExecutionCheckpointingOptions.ENABLE_UNALIGNED). Savepoints "
         "remain aligned. Stage-parallel executor only.")
+
+
+def retained_checkpoints(config) -> int:
+    """Checkpoints to keep on disk: ``state.checkpoints.num-retained``
+    (the reference's key) wins when explicitly set; the legacy
+    ``execution.checkpointing.retained`` remains honored. The ONE copy
+    of the precedence rule, shared by both executors."""
+    if config.contains(StateOptions.NUM_RETAINED) or \
+            not config.contains(CheckpointOptions.RETAINED):
+        return config.get(StateOptions.NUM_RETAINED)
+    return config.get(CheckpointOptions.RETAINED)
+
+
+class WatchdogOptions:
+    """Device watchdog (flink_tpu/runtime/watchdog.py): deadline-tracked
+    device interactions on the mesh engines + shard quarantine — the
+    detection half of shard-granular partial failover (the reference's
+    HeartbeatManager role, scoped to one device/shard)."""
+
+    ENABLED = ConfigOption(
+        "watchdog.enabled", default=False, type=bool,
+        description="Wrap mesh-engine device interactions (dispatch "
+        "fences, fire harvests, device_get batches, serving lookups) in "
+        "deadline-tracked watchdog sections; a shard past its miss "
+        "budget is declared dead at the next batch boundary "
+        "(ShardFailedError -> failover).")
+    DEADLINE_MS = ConfigOption(
+        "watchdog.deadline-ms", default=0, type=int,
+        description="A device interaction slower than this records a "
+        "deadline MISS against its shard(s); 0 tracks heartbeats only.")
+    MAX_MISSES = ConfigOption(
+        "watchdog.max-misses", default=3, type=int,
+        description="Consecutive deadline misses a shard survives "
+        "before being declared dead (timeout -> retry -> declare-dead "
+        "escalation).")
 
 
 class RestartOptions:

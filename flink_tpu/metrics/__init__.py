@@ -8,6 +8,7 @@ KNOWN_METRIC_GROUPS = (
     "chaos",
     "state",
     "tenancy",
+    "watchdog",
 )
 
 from flink_tpu.metrics.core import (  # noqa: E402,F401

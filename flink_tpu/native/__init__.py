@@ -50,6 +50,42 @@ def native_disabled() -> bool:
             or os.environ.get("FLINK_TPU_NATIVE") == "0")
 
 
+#: count of LOUD degradations to a Python fallback plane (build
+#: failure, load failure, runtime sweep error) — 0 on a healthy deploy.
+#: Explicit opt-outs (FLINK_TPU_NO_NATIVE=1 etc.) do NOT count: only
+#: the cases where native was wanted and silently losing it would hide
+#: a throughput regression behind a green suite.
+_fallbacks = 0
+_fallback_reasons: set = set()
+
+
+def note_fallback(reason: str) -> None:
+    """Record one native->Python degradation: warn once per distinct
+    reason (so a per-engine construction loop cannot spam) and bump the
+    :func:`native_fallbacks` counter."""
+    global _fallbacks
+    _fallbacks += 1
+    if reason not in _fallback_reasons:
+        _fallback_reasons.add(reason)
+        import warnings
+
+        warnings.warn(
+            f"flink_tpu native plane degraded to Python fallback: "
+            f"{reason}", RuntimeWarning, stacklevel=3)
+
+
+def native_fallbacks() -> int:
+    """Total native->Python degradations this process (see
+    :func:`note_fallback`)."""
+    return _fallbacks
+
+
+def reset_fallbacks_for_testing() -> None:
+    global _fallbacks
+    _fallbacks = 0
+    _fallback_reasons.clear()
+
+
 _build_token: Optional[str] = None
 
 

@@ -235,6 +235,9 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         n = len(batch)
         if n == 0:
             return
+        # batch boundary: the engine is consistent at a known source
+        # position — the one point the watchdog may declare a shard dead
+        self._wd_boundary()
         ts = np.asarray(batch.timestamps, dtype=np.int64)
         keys = np.asarray(batch.key_ids, dtype=np.int64)
         if self._spill_active and n > 1:
@@ -272,12 +275,27 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                     if int(per_shard.max()) > budget:
                         half = np.zeros(n, dtype=bool)
                         half[: n // 2] = True
-                        self.process_batch(batch.filter(half))
-                        self.process_batch(batch.filter(~half))
+                        # split ingest stays ONE failover boundary (the
+                        # probe must not land between the halves)
+                        self._ingest_subbatch(batch.filter(half))
+                        self._ingest_subbatch(batch.filter(~half))
                         return
 
-        res = self.meta.absorb_batch_ex(keys, ts,
-                                        want_fresh=self._paged)
+        from flink_tpu.windowing.session_meta import NativePlaneError
+
+        try:
+            res = self.meta.absorb_batch_ex(keys, ts,
+                                            want_fresh=self._paged)
+        except NativePlaneError as e:
+            # graceful degradation: the absorb is the batch's FIRST
+            # mutation (no device state touched yet), so the batch is
+            # re-runnable on the Python plane — once, loudly, instead
+            # of crashing the job (interval extends are idempotent, so
+            # the partially-swept metadata converges; value scatter has
+            # not happened)
+            self._meta_fallback(e)
+            res = self.meta.absorb_batch_ex(keys, ts,
+                                            want_fresh=self._paged)
         sess_key, sess_sid = res.sess_key, res.sess_sid
         rec_to_sess, order, groups = res.rec_to_sess, res.order, res.groups
         for g in groups:
@@ -537,6 +555,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
 
     def on_watermark(self, watermark: int,
                      async_ok: bool = False) -> List[RecordBatch]:
+        self._wd_boundary()
         pop = self.meta.pop_fired_ex(watermark)
         keys, starts, ends, sids = pop.keys, pop.starts, pop.ends, pop.sids
         hint = pop.slot_hint
@@ -654,9 +673,11 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         if async_ok:
             from flink_tpu.runtime.pending import PendingFire
 
-            return [PendingFire([fire_out[n] for n in names], build)]
+            return [PendingFire([fire_out[n] for n in names], build,
+                                watchdog=self._watchdog)]
         # sync path still batches all columns into ONE device_get
-        return [build(jax.device_get([fire_out[n] for n in names]))]
+        return [build(self._harvest_get(
+            [fire_out[n] for n in names]))]
 
     def _fire_sessions_hybrid(self, k_arr, st_arr, en_arr, sid_arr,
                               per_shard_sel, async_ok: bool,
@@ -790,9 +811,10 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         if async_ok:
             from flink_tpu.runtime.pending import PendingFire
 
-            return [PendingFire(arrays, build)]
+            return [PendingFire(arrays, build,
+                                watchdog=self._watchdog)]
         # sync path still batches all columns into ONE device_get
-        return [build(jax.device_get(arrays))]
+        return [build(self._harvest_get(arrays))]
 
     # ---------------------------------------------------------- point query
 
@@ -853,7 +875,8 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                 block[p, : len(hs)] = hs
             gathered = self._gather_step(self.accs,
                                          self._put_sharded(block))
-            g_host = jax.device_get(gathered)  # ONE batched D2H
+            # ONE batched D2H
+            g_host = self._harvest_get(gathered, "serving_lookup")
             for p, (sel_hit, hs) in lanes.items():
                 for i in range(len(leaves)):
                     leaf_rows[i][sel_hit] = g_host[i][p][: len(hs)]
@@ -1026,3 +1049,56 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             sp.clear_dirty()
         self.meta.restore(snap, key_group_filter=key_group_filter,
                           max_parallelism=self.max_parallelism)
+
+    # ------------------------------------------------ partial-failover hooks
+
+    def _drop_meta_key_groups(self, groups) -> None:
+        # a lost shard's session intervals die with its state rows —
+        # the checkpoint unit (restore_key_groups) brings both back
+        self.meta.drop_key_groups(groups, self.max_parallelism)
+
+    def _merge_restored_meta(self, snap, groups) -> None:
+        self.meta.merge_restore(snap, groups, self.max_parallelism)
+
+    def _filter_meta_snapshot(self, snap, groups):
+        from flink_tpu.windowing.session_meta import SessionIntervalSet
+
+        return SessionIntervalSet.filter_snapshot(
+            snap, groups, self.max_parallelism)
+
+    def _merge_meta_snapshots(self, units):
+        _NEG = -(1 << 62)
+        sessions: Dict[int, list] = {}
+        for u in units:
+            for k, ivs in u.get("sessions", {}).items():
+                sessions[int(k)] = list(ivs)  # ranges are disjoint
+        return {
+            "sessions": sessions,
+            "next_sid": max((int(u.get("next_sid", 1)) for u in units),
+                            default=1),
+            # the OLDEST unit's staleness horizon: its range's records
+            # replay from its position and must not be judged stale
+            "max_fired_watermark": min(
+                (u.get("max_fired_watermark", _NEG) for u in units),
+                default=_NEG),
+        }
+
+    # -------------------------------------------- native-plane degradation
+
+    def _meta_fallback(self, err) -> None:
+        """Swap the native metadata plane for the bit-identical Python
+        plane after a runtime sweep failure — once, loudly (warning +
+        ``flink_tpu.native.native_fallbacks()``), preserving the live
+        interval state via the plane-independent snapshot format."""
+        from flink_tpu.native import note_fallback
+        from flink_tpu.windowing.session_meta import SessionIntervalSet
+
+        note_fallback(
+            f"native session sweep failed at runtime "
+            f"({type(err).__name__}: {err}) — engine degraded to the "
+            "Python metadata plane")
+        py = SessionIntervalSet(self.gap, self.allowed_lateness)
+        py.restore(self.meta.snapshot())
+        py.late_records_dropped = self.meta.late_records_dropped
+        py.native_sweep_s = self.meta.native_sweep_s
+        self.meta = py

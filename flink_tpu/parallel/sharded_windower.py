@@ -187,6 +187,57 @@ class MeshSpillSupport:
         per-batch path the host-prep gate measures)."""
         return _DeviceSpan(self)
 
+    # ------------------------------------------------------------- watchdog
+
+    #: device watchdog (runtime/watchdog.py) — None keeps every hook a
+    #: single attribute check (the default; harness/executor attach one)
+    _watchdog = None
+
+    def attach_watchdog(self, wd) -> None:
+        """Wrap this engine's device interactions (dispatch fences,
+        eviction/fire harvests, batched device_get reads, serving
+        lookups) in the watchdog's deadline-tracked sections, and run
+        its shard-health probe at batch boundaries."""
+        self._watchdog = wd
+        if wd is not None:
+            wd.rebind(self.P,
+                      [d.id for d in self.mesh.devices.flat])
+
+    def _wd_section(self, op: str, shard: int = -1):
+        wd = self._watchdog
+        if wd is None:
+            from flink_tpu.runtime.watchdog import NULL_SECTION
+
+            return NULL_SECTION
+        return wd.section(op, shard)
+
+    def _wd_boundary(self) -> None:
+        """Batch-boundary health probe: the one point a shard may be
+        DECLARED dead (engine state is consistent at a known source
+        position here — see watchdog.boundary_probe)."""
+        wd = self._watchdog
+        if wd is not None:
+            wd.boundary_probe()
+
+    def _ingest_subbatch(self, batch) -> None:
+        """Recursive ingest of a SPLIT sub-batch (working-set bounding):
+        the watchdog is detached for the inner call — a shard declared
+        dead between sub-batches would leave the step half-absorbed on
+        the survivors, which is not a consistent failover point. The
+        boundary probe stays at the OUTER batch boundary."""
+        wd = self._watchdog
+        self._watchdog = None
+        try:
+            self.process_batch(batch)
+        finally:
+            self._watchdog = wd
+
+    def _harvest_get(self, tree, op: str = "fire_harvest"):
+        """The watchdog-sectioned form of the batched-D2H harvest (ONE
+        ``jax.device_get`` per harvest point — the TRC01 discipline)."""
+        with self._wd_section(op):
+            return jax.device_get(tree)
+
     def make_fence(self):
         """A tiny non-donated device value enqueued AFTER everything
         dispatched so far — used by the engine's own dispatch-ahead
@@ -200,11 +251,13 @@ class MeshSpillSupport:
         if len(self._dispatch_fences) < self._pipeline_depth:
             return
         t0 = time.perf_counter()
-        while len(self._dispatch_fences) >= self._pipeline_depth:
-            # flint: disable=TRC01 -- the depth-bounded fence drain IS
-            # the dispatch-ahead backpressure point: it blocks only when
-            # the host ran a full pipeline depth ahead of the device
-            self._dispatch_fences.popleft().block_until_ready()
+        with self._wd_section("fence_drain"):
+            while len(self._dispatch_fences) >= self._pipeline_depth:
+                # flint: disable=TRC01 -- the depth-bounded fence drain
+                # IS the dispatch-ahead backpressure point: it blocks
+                # only when the host ran a full pipeline depth ahead of
+                # the device
+                self._dispatch_fences.popleft().block_until_ready()
         self.pipeline_wait_s += time.perf_counter() - t0
 
     def _push_dispatch_fence(self) -> None:
@@ -216,7 +269,7 @@ class MeshSpillSupport:
                           in_flight=len(self._dispatch_fences))
         # fence creation dispatches a (tiny) device program — an inline
         # device interaction, attributed as such for the host-prep gate
-        with self._device_span():
+        with self._device_span(), self._wd_section("dispatch_fence"):
             self._dispatch_fences.append(self.make_fence())
 
     @property
@@ -299,7 +352,9 @@ class MeshSpillSupport:
         gathered = self._gather_step(self.accs, self._put_sharded(block))
         # ONE batched D2H read for all leaves (per-array np.asarray pays
         # one link round-trip per leaf — see runtime/pending.py)
-        leaves_host = [g[p][:n] for g in jax.device_get(gathered)]
+        leaves_host = [g[p][:n]
+                       for g in self._harvest_get(gathered,
+                                                  "evict_harvest")]
         off = 0
         for ns, slots in chosen:
             m = len(slots)
@@ -689,11 +744,16 @@ class MeshSpillSupport:
         }
         return self.last_reshard
 
-    def _collect_handoff(self) -> Dict[str, np.ndarray]:
+    def _collect_handoff(self, skip_shards=()) -> Dict[str, np.ndarray]:
         """Lift every logical row off the current mesh: key/namespace/
         leaf columns plus the handoff metadata restore does not need —
         per-row dirtiness (delta-snapshot correctness), recency clocks
-        (who stays resident on a scale-down), and residency."""
+        (who stays resident on a scale-down), and residency.
+
+        ``skip_shards``: shards whose state must NOT be read (a lost
+        device — its plane slice and spill tier are gone; partial
+        failover restores that range from its checkpoint unit instead).
+        """
         leaves = self.agg.leaves
         paged = bool(getattr(self, "_paged", False))
         accs_host = jax.device_get(list(self.accs))  # ONE batched D2H
@@ -703,7 +763,10 @@ class MeshSpillSupport:
         touch: List[np.ndarray] = []
         resident: List[np.ndarray] = []
         leaf_cols: List[List[np.ndarray]] = [[] for _ in leaves]
+        skip = set(skip_shards)
         for p in range(self.P):
+            if p in skip:
+                continue
             idx = self.indexes[p]
             used = idx.used_slots()
             if len(used):
@@ -959,6 +1022,254 @@ class MeshSpillSupport:
         return int(stay.sum()), cold_total
 
 
+    # ---------------------------------------------- partial failover (shard)
+
+    #: report dict of the most recent shard loss (None until the first)
+    last_shard_loss: Optional[Dict[str, object]] = None
+
+    def shard_key_groups(self) -> List[Tuple[int, int]]:
+        """GLOBAL ``(first, last)`` inclusive key groups per shard —
+        the unit of failure/recovery, and the split shard-granular
+        checkpoints key their units by (the exact inverse of
+        ``shard_records``' routing formula)."""
+        from flink_tpu.state.keygroups import shard_key_group_ranges
+
+        return shard_key_group_ranges(self.P, self.max_parallelism,
+                                      self.key_group_range)
+
+    def lose_shard(self, dead: int) -> Tuple[int, int]:
+        """Simulated device loss of shard ``dead``: its resident plane
+        slice, spill tier and key-range metadata are gone WHOLESALE
+        (the TaskManager-loss failure domain). Survivors' fences drain,
+        their rows lift intact (dirtiness + recency preserved — the
+        reshard machinery), the mesh rebuilds over the remaining
+        ``P - 1`` devices, and the survivors' rows land on their new
+        owners. Returns the DEAD shard's (first, last) key groups — the
+        caller then restores exactly that range from its checkpoint
+        unit (:meth:`restore_key_groups`) and replays only that range's
+        records from the unit's source position.
+
+        Like ``reshard``, not exception-atomic: a failure mid-evacuation
+        falls back to whole-job checkpoint restore.
+        """
+        dead = int(dead)
+        if not (0 <= dead < self.P):
+            raise ValueError(f"no shard {dead} on a {self.P}-shard mesh")
+        if self.P <= 1:
+            raise ValueError(
+                "cannot partially fail over a 1-shard mesh — the only "
+                "shard IS the job (whole-job restore applies)")
+        t0 = time.perf_counter()
+        dead_range = self.shard_key_groups()[dead]
+        # quiesce the SURVIVORS: every in-flight dispatch must land
+        # before the plane is torn down (the dead shard's fences are
+        # moot — its state is discarded unread below)
+        while self._dispatch_fences:
+            # flint: disable=TRC01 -- failover quiesce: the mesh plane
+            # is about to be rebuilt, in-flight dispatches must land
+            self._dispatch_fences.popleft().block_until_ready()
+        rows = self._collect_handoff(skip_shards={dead})
+        devices = [d for i, d in enumerate(self.mesh.devices.flat)
+                   if i != dead]
+        old_p = self.P
+        self._rebuild_mesh_plane(old_p - 1, devices=devices)
+        resident_rows, spilled_rows = self._redistribute_handoff(rows)
+        # the dead range's host metadata dies with its shard (engine
+        # hook: session intervals for the window engines' global book
+        # there is nothing per-key to drop)
+        self._drop_meta_key_groups(
+            range(int(dead_range[0]), int(dead_range[1]) + 1))
+        wd = self._watchdog
+        if wd is not None:
+            # survivors renumber 0..P-2; the dead device id stays in
+            # the watchdog's quarantine HISTORY for budget accounting
+            wd.rebind(self.P,
+                      [d.id for d in self.mesh.devices.flat])
+        self.last_shard_loss = {
+            "dead_shard": dead, "from": old_p, "to": self.P,
+            "key_groups": (int(dead_range[0]), int(dead_range[1])),
+            "survivor_rows": int(len(rows["key_id"])),
+            "resident_rows": resident_rows,
+            "spilled_rows": spilled_rows,
+            "seconds": time.perf_counter() - t0,
+        }
+        return (int(dead_range[0]), int(dead_range[1]))
+
+    def restore_key_groups(self, snap: Dict[str, object],
+                           groups) -> int:
+        """Partial restore INTO a live engine: land only ``groups``'
+        rows (survivors untouched) and merge the unit's metadata (the
+        engine hook rolls watermark/staleness guards back to the
+        checkpoint so the range's replayed records are accepted).
+        Restored rows are CLEAN — they are in the checkpoint, so the
+        next delta must not re-ship them; survivors keep their genuine
+        dirtiness. Returns rows restored."""
+        table = snap.get("table", {}) or {}
+        key_ids = np.asarray(table.get("key_id", []), dtype=np.int64)
+        gset = np.asarray(sorted(int(g) for g in groups),
+                          dtype=np.int64)
+        n_restored = 0
+        if len(key_ids):
+            kg = table.get("key_group")
+            kg = (np.asarray(kg, dtype=np.int64) if kg is not None
+                  else assign_key_groups(key_ids, self.max_parallelism))
+            keep = np.isin(kg, gset)
+            key_ids = key_ids[keep]
+            namespaces = np.asarray(table["namespace"],
+                                    dtype=np.int64)[keep]
+            leaves = [np.asarray(table[f"leaf_{i}"])[keep]
+                      for i in range(len(self.agg.leaves))]
+            n_restored = int(len(key_ids))
+        if n_restored:
+            shards = shard_records(key_ids, self.P,
+                                   self.max_parallelism,
+                                   self.key_group_range)
+            if getattr(self, "_paged", False):
+                from flink_tpu.state.paged_spill import (
+                    restore_into_pages,
+                )
+
+                for p in range(self.P):
+                    mask = shards == p
+                    if not mask.any():
+                        continue
+                    # APPEND: the survivors' pages must stay intact;
+                    # the restored namespaces (per-session sids) were
+                    # never held by the surviving tiers
+                    restore_into_pages(
+                        self.spills[p], self._pmaps[p], key_ids[mask],
+                        namespaces[mask], [l[mask] for l in leaves],
+                        page_rows=max(self.indexes[p].capacity // 8,
+                                      1024),
+                        append=True)
+            else:
+                # land resident: resolve all slots first (growth must
+                # settle), then ONE batched put program for all shards
+                per_shard: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+                for p in range(self.P):
+                    mask = shards == p
+                    if not mask.any():
+                        continue
+                    if self._spill_active:
+                        self._reserve(p, key_ids[mask],
+                                      namespaces[mask])
+                    slots = self.indexes[p].lookup_or_insert(
+                        key_ids[mask], namespaces[mask])
+                    per_shard[p] = (np.nonzero(mask)[0], slots)
+                B = sticky_bucket(
+                    max(len(s) for _, s in per_shard.values()),
+                    self._reload_bucket)
+                self._reload_bucket = B
+                slot_block = np.zeros((self.P, B), dtype=np.int32)
+                val_blocks = [
+                    np.full((self.P, B), l.identity, dtype=l.dtype)
+                    for l in self.agg.leaves]
+                for p, (sel, slots) in per_shard.items():
+                    m = len(sel)
+                    slot_block[p, :m] = slots
+                    for i in range(len(val_blocks)):
+                        val_blocks[i][p, :m] = leaves[i][sel]
+                    # restored rows are the checkpoint's — clean
+                    self._dirty[p, slots] = False
+                    if self._spill_active:
+                        self._touch(p, np.unique(
+                            namespaces[sel]).tolist())
+                self.accs = self._put_step(
+                    self.accs, self._put_sharded(slot_block),
+                    tuple(self._put_sharded(v) for v in val_blocks))
+        self._merge_restored_meta(snap, groups)
+        return n_restored
+
+    # engine hooks (window engines: global book; session engines:
+    # per-key interval metadata) ------------------------------------------
+
+    def _drop_meta_key_groups(self, groups) -> None:
+        """Discard the host metadata owned by ``groups`` (no-op for
+        engines whose lifecycle metadata carries no per-key state)."""
+
+    def _merge_restored_meta(self, snap: Dict[str, object],
+                             groups) -> None:
+        """Fold a checkpoint unit's metadata for ``groups`` into the
+        live engine (partial failover)."""
+
+    def _filter_meta_snapshot(self, snap: Dict[str, object],
+                              groups) -> Dict[str, object]:
+        """The non-table part of ``snap`` restricted to ``groups`` —
+        default: global metadata replicates whole into every unit."""
+        return {k: v for k, v in snap.items() if k != "table"}
+
+    def _merge_meta_snapshots(self, units: List[Dict[str, object]]
+                              ) -> Dict[str, object]:
+        """Merge units' metadata for a whole-job restore assembled from
+        (possibly different-age) shard units."""
+        raise NotImplementedError
+
+    #: delta/tombstone fields that replicate whole into every unit —
+    #: applying another range's tombstones to a unit's base is a no-op
+    #: (the base holds no rows of that range), so replication is safe
+    #: and keeps each unit independently restorable
+    _UNIT_PASSTHROUGH = ("__delta__", "freed_namespaces",
+                         "tombstone_key_id", "tombstone_namespace")
+
+    def snapshot_sharded(self, mode: str = "full"
+                         ) -> Dict[Tuple[int, int], Dict[str, object]]:
+        """One independently-restorable unit per shard: the logical
+        snapshot split by the current shards' key-group ranges (rows by
+        their ``key_group`` column — the delta machinery keeps
+        increments per-shard through the same split), plus each unit's
+        slice of the metadata. The union of the units is exactly
+        ``snapshot(mode)``."""
+        snap = self.snapshot(mode)
+        table = snap.get("table", {}) or {}
+        kg = np.asarray(table.get("key_group", ()), dtype=np.int64)
+        units: Dict[Tuple[int, int], Dict[str, object]] = {}
+        for g0, g1 in self.shard_key_groups():
+            if len(kg):
+                mask = (kg >= g0) & (kg <= g1)
+                unit_table = {
+                    k: (v if k in self._UNIT_PASSTHROUGH
+                        else np.asarray(v)[mask])
+                    for k, v in table.items()
+                }
+            else:
+                unit_table = dict(table)
+            units[(int(g0), int(g1))] = {
+                "table": unit_table,
+                **self._filter_meta_snapshot(
+                    snap, range(int(g0), int(g1) + 1)),
+            }
+        return units
+
+    def merge_unit_snapshots(self, units: List[Dict[str, object]]
+                             ) -> Dict[str, object]:
+        """Reassemble one engine snapshot from shard units (whole-job
+        restore; units may come from DIFFERENT checkpoints when a torn
+        unit fell back to an older complete one — the caller replays
+        each range from its own unit's source position)."""
+        tables = [u.get("table", {}) or {} for u in units]
+        tables = [t for t in tables if t]
+        merged: Dict[str, object] = {}
+        if tables:
+            cols = set().union(*(set(t) for t in tables))
+            for k in sorted(cols):
+                parts = [np.asarray(t[k]) for t in tables if k in t]
+                if k == "__delta__":
+                    merged[k] = np.asarray(True)
+                elif k == "freed_namespaces":
+                    merged[k] = (np.unique(np.concatenate(parts))
+                                 if parts else np.empty(0,
+                                                        dtype=np.int64))
+                else:
+                    # tombstone_key_id / tombstone_namespace are ROW-
+                    # PAIRED parallel columns (apply_table_delta packs
+                    # them): a per-column unique would break the pair
+                    # correspondence — plain concatenation keeps it
+                    # (duplicate pairs apply idempotently)
+                    merged[k] = (np.concatenate(parts) if parts
+                                 else np.empty(0, dtype=np.int64))
+        return {"table": merged, **self._merge_meta_snapshots(units)}
+
+
 class MeshPagedSpillSupport(MeshSpillSupport):
     """Paged (cohort) spill for session-shaped mesh state — the mesh form
     of the single-device ``spill_layout="pages"`` machinery
@@ -1186,7 +1497,8 @@ class MeshPagedSpillSupport(MeshSpillSupport):
         with self._device_span():
             gathered = self._gather_step(self.accs,
                                          self._put_sharded(block))
-            gathered_host = jax.device_get(gathered)  # ONE batched D2H
+            # ONE batched D2H
+            gathered_host = self._harvest_get(gathered, "evict_harvest")
         for p, chosen in cohorts.items():
             idx = self.indexes[p]
             n = len(chosen)
@@ -1438,6 +1750,9 @@ class MeshWindowEngine(MeshSpillSupport):
         n = len(batch)
         if n == 0:
             return
+        # batch boundary: the engine is consistent at a known source
+        # position — the one point the watchdog may declare a shard dead
+        self._wd_boundary()
         key_ids = batch.key_ids
         slice_ends = self.assigner.assign_slice_ends(batch.timestamps)
         if self._spill_active and n > 1:
@@ -1446,7 +1761,7 @@ class MeshWindowEngine(MeshSpillSupport):
                 for g in groups:
                     mask = np.isin(slice_ends, np.asarray(g))
                     if mask.any():
-                        self.process_batch(batch.filter(mask))
+                        self._ingest_subbatch(batch.filter(mask))
                 return
         live = self.book.live_mask(slice_ends)
         if live is not None:
@@ -1590,6 +1905,7 @@ class MeshWindowEngine(MeshSpillSupport):
     # ------------------------------------------------------------------ fire
 
     def on_watermark(self, watermark: int) -> List[RecordBatch]:
+        self._wd_boundary()
         out: List[RecordBatch] = []
         while True:
             w_end = self.book.next_window(watermark)
@@ -1645,7 +1961,7 @@ class MeshWindowEngine(MeshSpillSupport):
             sm[p, : len(mat)] = mat
         # ONE batched D2H for all result columns (device_get over the
         # whole pytree; per-column np.asarray pays one RTT per column)
-        results = jax.device_get(
+        results = self._harvest_get(
             self._fire_step(self.accs, self._put_sharded(sm)))
         # assemble host batch
         key_cols: List[np.ndarray] = []
@@ -1713,7 +2029,7 @@ class MeshWindowEngine(MeshSpillSupport):
             for p, mat in enumerate(per_shard_mats):
                 sm[p, : len(mat)] = mat
             merged = self._merge_step(self.accs, self._put_sharded(sm))
-            merged_host = jax.device_get(merged)  # ONE batched D2H
+            merged_host = self._harvest_get(merged)  # ONE batched D2H
             for p in range(self.P):
                 m = len(per_shard_keys[p])
                 if m == 0:
@@ -1842,7 +2158,8 @@ class MeshWindowEngine(MeshSpillSupport):
                 block[p, : len(hs)] = hs
             gathered = self._gather_step(self.accs,
                                          self._put_sharded(block))
-            g_host = jax.device_get(gathered)  # ONE batched D2H
+            # ONE batched D2H
+            g_host = self._harvest_get(gathered, "serving_lookup")
             for p, (hs, prow, pn) in lanes.items():
                 shard_leaves = [g[p] for g in g_host]
                 for j in range(len(hs)):
@@ -2026,6 +2343,36 @@ class MeshWindowEngine(MeshSpillSupport):
         for sp in self.spills:
             sp.clear_dirty()
         self.book.restore(snap)
+
+    # ------------------------------------------------ partial-failover hooks
+
+    def _merge_restored_meta(self, snap, groups) -> None:
+        # window lifecycle metadata is global: the book merge re-opens
+        # the windows the restored range must re-fire during replay
+        self.book.merge_restore(snap)
+
+    def _merge_meta_snapshots(self, units):
+        _NEG = -(1 << 62)
+        pending = sorted({int(w) for u in units
+                          for w in u.get("pending", ())})
+        slw: Dict[int, int] = {}
+        for u in units:
+            slw.update(dict(u.get("slice_last_window", {})))
+        return {
+            "pending": pending,
+            "slice_last_window": slw,
+            # the OLDEST unit decides: its range's records replay from
+            # its position and must pass the late-record guard exactly
+            # as they originally did
+            "watermark": min((u.get("watermark", _NEG) for u in units),
+                             default=_NEG),
+            "max_fired_end": min(
+                (u.get("max_fired_end", _NEG) for u in units),
+                default=_NEG),
+            "late_records_dropped": max(
+                (u.get("late_records_dropped", 0) for u in units),
+                default=0),
+        }
 
 
 def build_mesh_steps(mesh: Mesh, agg: AggregateFunction):

@@ -85,7 +85,7 @@ class OperatorContext:
                  max_parallelism: int = 128, metrics=None,
                  async_fires: bool = False, max_dispatch_ahead: int = 4,
                  mesh=None, key_group_range=None, memory_manager=None,
-                 shuffle_mode: str = "device"):
+                 shuffle_mode: str = "device", watchdog=None):
         self.operator_index = operator_index
         self.parallelism = parallelism
         self.max_parallelism = max_parallelism
@@ -108,6 +108,10 @@ class OperatorContext:
         #: keyBy data plane for mesh engines (shuffle.mode):
         #: "device" = in-program exchange, "host" = explicit fallback
         self.shuffle_mode = shuffle_mode
+        #: DeviceWatchdog (runtime/watchdog.py) the mesh engines attach
+        #: when watchdog.enabled — deadline-tracked device sections +
+        #: batch-boundary shard-health probes; None = disabled
+        self.watchdog = watchdog
 
 
 class MapOperator(Operator):
@@ -348,6 +352,11 @@ class WindowAggOperator(Operator):
             and getattr(self.windower, "supports_async_fires", False))
         self._max_dispatch_ahead = int(
             getattr(ctx, "max_dispatch_ahead", self._max_dispatch_ahead))
+        # device watchdog (watchdog.enabled): deadline-tracked device
+        # interactions + shard quarantine on the mesh engines
+        wd = getattr(ctx, "watchdog", None)
+        if wd is not None and hasattr(self.windower, "attach_watchdog"):
+            self.windower.attach_watchdog(wd)
 
     def process_batch(self, batch, input_index=0):
         if self.key_field in batch.columns:

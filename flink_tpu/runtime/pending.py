@@ -30,11 +30,16 @@ class PendingFire:
     copies already in flight) plus a host-side finisher that assembles the
     final result batch once the bytes land."""
 
-    __slots__ = ("arrays", "build", "dispatched_at")
+    __slots__ = ("arrays", "build", "dispatched_at", "watchdog")
 
-    def __init__(self, arrays: Sequence, build: Callable[[List[np.ndarray]], object]):
+    def __init__(self, arrays: Sequence,
+                 build: Callable[[List[np.ndarray]], object],
+                 watchdog=None):
         self.arrays = list(arrays)
         self.build = build
+        #: optional DeviceWatchdog: the harvest is a deadline-tracked
+        #: section (a fire whose D2H never lands is a dead device)
+        self.watchdog = watchdog
         self.dispatched_at = time.perf_counter()
         for a in self.arrays:
             copy = getattr(a, "copy_to_host_async", None)
@@ -63,5 +68,9 @@ class PendingFire:
         # D2H results never land (link loss mid-coalesced-harvest)
         chaos.fault_point("harvest.pending_fire",
                           arrays=len(self.arrays))
-        host = jax.device_get(self.arrays)
+        if self.watchdog is not None:
+            with self.watchdog.section("pending_harvest"):
+                host = jax.device_get(self.arrays)
+        else:
+            host = jax.device_get(self.arrays)
         return self.build([np.asarray(a) for a in host])

@@ -151,6 +151,26 @@ def all_ranges(max_parallelism: int, parallelism: int) -> List[KeyGroupRange]:
             for i in range(parallelism)]
 
 
+def shard_key_group_ranges(parallelism: int, max_parallelism: int,
+                           key_group_range=None) -> List[tuple]:
+    """GLOBAL ``(first, last)`` inclusive key groups owned by each of
+    the ``parallelism`` mesh shards — the exact inverse of the routing
+    formula in ``parallel.shuffle.shard_records`` (including the
+    local-space remap a sub-range engine applies). This is the split
+    shard-granular checkpoints key their units by: the unit a record's
+    state lives in is the unit its shard owns, by construction."""
+    if key_group_range is None:
+        first, span = 0, int(max_parallelism)
+    else:
+        first = int(key_group_range[0])
+        span = int(key_group_range[1]) - first + 1
+    return [
+        (first + r.start, first + r.end)
+        for r in (compute_key_group_range(span, parallelism, p)
+                  for p in range(parallelism))
+    ]
+
+
 def validate_max_parallelism(max_parallelism: int) -> None:
     if not (1 <= max_parallelism <= (1 << 15)):
         raise ValueError(

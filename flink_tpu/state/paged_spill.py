@@ -461,7 +461,8 @@ def drop_spilled_sessions(spill, pmap: PagedSpillMap,
 def restore_into_pages(spill, pmap: PagedSpillMap, key_ids: np.ndarray,
                        namespaces: np.ndarray, leaves: List[np.ndarray],
                        page_rows: int,
-                       dirty: Optional[np.ndarray] = None) -> None:
+                       dirty: Optional[np.ndarray] = None,
+                       append: bool = False) -> None:
     """Pack restored logical rows into page-sized spill entries (sorted
     by ns, never splitting one namespace across pages) — a snapshot far
     larger than the device budget restores with bounded device memory
@@ -472,11 +473,19 @@ def restore_into_pages(spill, pmap: PagedSpillMap, key_ids: np.ndarray,
     live-rescale handoff re-homes rows that have NOT been checkpointed
     since they changed, and the next delta snapshot must still ship
     them. A checkpoint restore passes None (restored state is the new
-    incremental base, nothing is dirty)."""
-    if len(pmap.sp_ns):
-        for page in np.unique(pmap.sp_page).tolist():
-            spill.discard(int(page))
-    pmap.clear()
+    incremental base, nothing is dirty).
+
+    ``append=True`` keeps the tier's existing pages (partial failover:
+    a lost shard's key groups restore INTO survivors whose own pages
+    must stay intact). The caller guarantees the appended namespaces
+    are not already mapped — true by construction for the shard-loss
+    path, whose restored rows belong to key groups the surviving tiers
+    never held."""
+    if not append:
+        if len(pmap.sp_ns):
+            for page in np.unique(pmap.sp_page).tolist():
+                spill.discard(int(page))
+        pmap.clear()
     order = np.argsort(namespaces, kind="stable")
     s_ns = namespaces[order]
     s_keys = key_ids[order]
@@ -496,5 +505,6 @@ def restore_into_pages(spill, pmap: PagedSpillMap, key_ids: np.ndarray,
                     for i in range(len(s_leaves))}}
         spill_page(spill, pmap, entry, count=False)
         a = b
-    # pages were appended in ascending-ns order: the map is sorted
-    pmap.sorted = True
+    if not append:
+        # pages were appended in ascending-ns order: the map is sorted
+        pmap.sorted = True

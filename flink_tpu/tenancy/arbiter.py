@@ -60,9 +60,14 @@ class ShardArbiter:
         self.backlog_norm = float(backlog_norm)
         self._since_change = self.cooldown_ticks  # first tick may act
 
-    def decide(self, demands: List[JobDemand]) -> Dict[str, int]:
+    def decide(self, demands: List[JobDemand],
+               dead_shards: int = 0) -> Dict[str, int]:
         """Per-job shard allocation for this tick (== current when the
-        tick should not act). Deterministic in its inputs."""
+        tick should not act). Deterministic in its inputs.
+
+        ``dead_shards``: devices the watchdog has quarantined — a dead
+        shard changes the budget, so the arbiter divides what actually
+        answers, not the nameplate mesh size."""
         if not demands:
             return {}
         current = {d.job: int(d.current_shards) for d in demands}
@@ -72,7 +77,7 @@ class ShardArbiter:
             # before it, N suppressed only N-1 and 1 suppressed none)
             self._since_change += 1
             return current
-        budget = self.total_shards
+        budget = max(self.total_shards - max(int(dead_shards), 0), 1)
         floor_sum = sum(max(d.min_shards, 1) for d in demands)
         if floor_sum > budget:
             # over-subscribed floors: everyone gets their floor (the

@@ -403,7 +403,28 @@ class SessionCluster:
                 min_shards=job.quota.min_shards, max_shards=hi))
         if not demands:
             return
-        alloc = self.arbiter.decide(demands)
+        # a watchdog-quarantined device changes the budget: the arbiter
+        # divides what actually answers, not the nameplate mesh size.
+        # Jobs SHARE the physical mesh, so dead devices dedupe by
+        # device id (summing per-job quarantine counts would charge one
+        # dead device once per tenant); shard indices without a known
+        # device mapping fall back to the per-job max, never the sum
+        dead_devices: set = set()
+        dead_unmapped = 0
+        for j in self.jobs.values():
+            if j.finished or j.handle is None:
+                continue
+            wd = getattr(j.handle, "watchdog", None)
+            if wd is None:
+                continue
+            if wd.quarantined_devices:
+                dead_devices |= wd.quarantined_devices
+            else:
+                dead_unmapped = max(dead_unmapped,
+                                    len(wd.quarantined))
+        alloc = self.arbiter.decide(
+            demands,
+            dead_shards=max(len(dead_devices), dead_unmapped))
         for name, shards in alloc.items():
             job, op, hi = targets[name]
             shards = min(int(shards), hi)

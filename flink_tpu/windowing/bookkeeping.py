@@ -136,6 +136,57 @@ class SliceBookkeeper:
             "late_records_dropped": self.late_records_dropped,
         }
 
+    def merge_restore(self, snap: Dict[str, object]) -> None:
+        """Partial-failover merge: fold a CHECKPOINT-time book into the
+        LIVE book so a lost shard's key groups can replay their range.
+
+        Rules (window metadata is global, unlike the per-key state):
+
+        - registered slices = UNION — slices created by survivors after
+          the checkpoint stay tracked; slices the checkpoint knew that
+          already expired here re-register (their replayed re-fire emits
+          only the restored range's keys: the survivors' rows are gone).
+        - pending windows = UNION of live pending and the checkpoint's
+          pending + every window of a re-registered slice that can still
+          produce output AT THE CHECKPOINT watermark — a window fired
+          between the checkpoint and the failure must RE-FIRE during
+          replay (its restored-range rows were rolled back), and emits
+          nothing for survivors (their slots were freed at the original
+          fire).
+        - watermark = the CHECKPOINT's — replayed records must pass the
+          late-record guard exactly as they did originally; survivors
+          are unaffected because replay feeds only the restored range,
+          and the watermark monotonically re-advances with the replayed
+          sequence.
+        """
+        self._slice_last_window.update(
+            dict(snap.get("slice_last_window", {})))
+        self._cleanup = [
+            (last - 1 + self.allowed_lateness, se)
+            for se, last in self._slice_last_window.items()
+        ]
+        heapq.heapify(self._cleanup)
+        ckpt_wm = snap.get("watermark", snap.get("max_fired_end",
+                                                 _NEG_INF))
+        lateness = self.allowed_lateness
+        for w in snap.get("pending", []):
+            if w not in self._pending_set:
+                self._pending_set.add(w)
+                heapq.heappush(self._pending, w)
+        # windows fired AFTER the checkpoint: pending in neither book,
+        # but their slices are registered — re-schedule every window
+        # still fireable at the checkpoint watermark
+        for se in self._slice_last_window:
+            for w in self.assigner.window_ends_for_slice(se):
+                if (w - 1 + lateness > ckpt_wm
+                        and w not in self._pending_set):
+                    self._pending_set.add(w)
+                    heapq.heappush(self._pending, w)
+        self.watermark = ckpt_wm
+        self.max_fired_end = min(
+            self.max_fired_end,
+            int(snap.get("max_fired_end", _NEG_INF)))
+
     def restore(self, snap: Dict[str, object]) -> None:
         # empty sub-structures may be pruned by the checkpoint codec
         self._pending = list(snap.get("pending", []))

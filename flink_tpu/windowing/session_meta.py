@@ -39,6 +39,14 @@ from flink_tpu.state.slot_table import make_slot_index
 _NEG_INF = -(1 << 62)
 
 
+class NativePlaneError(RuntimeError):
+    """A native (C) metadata sweep failed at runtime. Engines catch
+    this at the one point where no device/state mutation has happened
+    yet (the absorb is the batch's first mutation) and fall back to the
+    bit-identical Python plane — once, loudly — instead of crashing the
+    batch (see MeshSessionEngine._meta_fallback)."""
+
+
 @dataclasses.dataclass
 class AbsorbResult:
     """One absorbed batch, engine-facing: the classic absorb_batch tuple
@@ -692,6 +700,99 @@ class SessionIntervalSet:
             "max_fired_watermark": self.max_fired_watermark,
         }
 
+    # ------------------------------------------------- partial failover
+
+    def _forget_multi_key(self, key: int) -> None:
+        """Remove a key's multi-interval entry (native plane also
+        un-mirrors its membership set)."""
+        self._multi.pop(key, None)
+
+    def drop_key_groups(self, groups, max_parallelism: int = 128) -> int:
+        """Remove every session whose key falls in ``groups`` — a lost
+        shard's metadata dies with its device state. Fire candidates of
+        the dropped sessions become stale and are skipped by pop
+        validation (the same lazy discipline merged/extended sessions
+        already rely on). Returns sessions dropped."""
+        from flink_tpu.state.keygroups import assign_key_groups
+
+        gset = np.asarray(sorted(groups), dtype=np.int64)
+        dropped = 0
+        used = self._idx.used_slots()
+        if len(used):
+            keys = np.asarray(self._idx.slot_key[used], dtype=np.int64)
+            hit = np.isin(
+                assign_key_groups(keys, max_parallelism), gset)
+            if hit.any():
+                self._idx.free_slots(used[hit].astype(np.int32),
+                                     keys=keys[hit], nss=keys[hit])
+                dropped += int(hit.sum())
+        if self._multi:
+            mkeys = np.asarray(list(self._multi), dtype=np.int64)
+            mhit = np.isin(
+                assign_key_groups(mkeys, max_parallelism), gset)
+            for k in mkeys[mhit].tolist():
+                dropped += len(self._multi[int(k)])
+                self._forget_multi_key(int(k))
+        return dropped
+
+    def merge_restore(self, snap: Dict[str, object], key_group_filter,
+                      max_parallelism: int = 128) -> int:
+        """Partial-failover merge: fold a checkpoint's sessions for the
+        given key groups into the LIVE set (survivors untouched — their
+        keys never fall in the restored groups). Scalars merge by the
+        rules replay depends on: ``next_sid`` takes the max (sids stay
+        globally unique), ``max_fired_watermark`` rolls back to the
+        checkpoint's so the replayed range's records are not judged
+        stale — it re-advances monotonically as replay feeds the
+        original watermark sequence. Returns sessions restored."""
+        from flink_tpu.state.keygroups import assign_key_groups
+
+        sessions = snap.get("sessions", {})
+        restored = 0
+        if sessions:
+            keys = np.asarray([int(k) for k in sessions],
+                              dtype=np.int64)
+            keep = np.isin(
+                assign_key_groups(keys, max_parallelism),
+                np.asarray(sorted(key_group_filter), dtype=np.int64))
+            for k, ok in zip(sessions, keep):
+                if not ok:
+                    continue
+                kept = [tuple(iv) for iv in sessions[k]]
+                self._store_intervals(int(k), kept)
+                restored += len(kept)
+                for start, end, sid in kept:
+                    self._push_fire(int(end), int(k), int(sid))
+        self._drain_fire_buf()
+        self._next_sid = max(self._next_sid,
+                             int(snap.get("next_sid", 1)))
+        self.max_fired_watermark = min(
+            self.max_fired_watermark,
+            snap.get("max_fired_watermark", _NEG_INF))
+        return restored
+
+    @staticmethod
+    def filter_snapshot(snap: Dict[str, object], groups,
+                        max_parallelism: int = 128) -> Dict[str, object]:
+        """A metadata snapshot restricted to ``groups`` (the shard-unit
+        split of shard-granular checkpoints); the scalar fields ride
+        along whole — each unit is independently restorable."""
+        from flink_tpu.state.keygroups import assign_key_groups
+
+        sessions = snap.get("sessions", {})
+        if sessions:
+            keys = np.asarray([int(k) for k in sessions], dtype=np.int64)
+            kg = assign_key_groups(keys, max_parallelism)
+            keep = np.isin(kg, np.asarray(sorted(groups), dtype=np.int64))
+            sessions = {int(k): list(sessions[k])
+                        for k, ok in zip(sessions, keep) if ok}
+        return {
+            "sessions": sessions,
+            "next_sid": snap.get("next_sid", 1),
+            "max_fired_watermark": snap.get("max_fired_watermark",
+                                            _NEG_INF),
+        }
+
     def restore(self, snap: Dict[str, object],
                 key_group_filter=None, max_parallelism: int = 128) -> None:
         self._reset_store()
@@ -739,16 +840,38 @@ def make_session_meta(gap: int,
 
     ``FLINK_TPU_NATIVE_SESSIONS=0`` forces the Python plane while the
     native state-plane index stays on — the A/B knob bench and parity
-    tooling use (the blanket ``FLINK_TPU_NO_NATIVE=1`` disables both)."""
+    tooling use (the blanket ``FLINK_TPU_NO_NATIVE=1`` disables both).
+
+    Graceful degradation: when the native plane was NOT explicitly
+    disabled but is unavailable (the ``.so`` failed to build — missing
+    toolchain, compile error) or fails to initialize, the fall back to
+    the bit-identical Python plane is LOUD: one warning per distinct
+    reason plus the ``flink_tpu.native.native_fallbacks()`` counter —
+    a silent fallback would hide a 1.3x throughput regression behind a
+    green suite."""
     import os
 
-    from flink_tpu.native import sessions_available
+    from flink_tpu.native import (
+        native_disabled,
+        note_fallback,
+        sessions_available,
+    )
 
     if (os.environ.get("FLINK_TPU_NATIVE_SESSIONS") != "0"
-            and sessions_available()):
-        from flink_tpu.windowing.session_native import (
-            NativeSessionIntervalSet,
-        )
+            and not native_disabled()):
+        if sessions_available():
+            try:
+                from flink_tpu.windowing.session_native import (
+                    NativeSessionIntervalSet,
+                )
 
-        return NativeSessionIntervalSet(gap, allowed_lateness)
+                return NativeSessionIntervalSet(gap, allowed_lateness)
+            except Exception as e:  # noqa: BLE001 — degrade, loudly
+                note_fallback(
+                    "native session plane failed to initialize: "
+                    f"{type(e).__name__}: {e}")
+        else:
+            note_fallback(
+                "native sessions library unavailable (build failed or "
+                "no toolchain) — using the bit-identical Python plane")
     return SessionIntervalSet(gap, allowed_lateness)

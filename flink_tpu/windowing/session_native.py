@@ -39,6 +39,7 @@ import numpy as np
 
 from flink_tpu.windowing.session_meta import (
     AbsorbResult,
+    NativePlaneError,
     PopResult,
     SessionIntervalSet,
 )
@@ -134,7 +135,7 @@ class _NativeSessionStore:
         rc = self._lib.sx_insert(self._h, len(keys), _i64p(keys),
                                  _i32p(out))
         if rc < 0:
-            raise RuntimeError(
+            raise NativePlaneError(
                 "native session store full (capacity="
                 f"{self.capacity}) — raise its max capacity")
         if rc > 0:
@@ -182,7 +183,7 @@ def native_absorb(store: _NativeSessionStore, keys: np.ndarray,
         _i64p(sess_sid), _i32p(sess_slot), _i32p(sess_row),
         sess_flag.ctypes.data_as(_U8P), _ct.byref(n_fast))
     if m < 0:
-        raise RuntimeError(
+        raise NativePlaneError(
             "native session store full during absorb — raise its max "
             "capacity")
     store._maybe_rewrap()
@@ -285,7 +286,7 @@ class NativeSessionIntervalSet(SessionIntervalSet):
             if row < 0:
                 row = int(lib.sx_insert1(h, key))
                 if row < 0:
-                    raise RuntimeError(
+                    raise NativePlaneError(
                         "native session store full — raise its max "
                         "capacity")
                 self._store._maybe_rewrap()
@@ -419,6 +420,12 @@ class NativeSessionIntervalSet(SessionIntervalSet):
 
     def _rest_single_lookup(self, key: int) -> int:
         return int(self._lib.sx_lookup1(self._store._h, int(key)))
+
+    def _forget_multi_key(self, key: int) -> None:
+        # keep the native multi-membership set mirrored (the sweep
+        # classifies against it) — see drop_key_groups
+        self._multi.pop(key, None)
+        self._lib.sx_multi_remove(self._store._h, int(key))
 
     def _rest_single_free(self, slot: int) -> int:
         dslot = int(self._store.dslot[slot])

@@ -958,6 +958,73 @@ class TestServingLookupPoint:
         _note_reached(r.faults_injected)
 
 
+class TestWatchdogPoints:
+    """The partial-failover fault points, injected at their real sites:
+    ``device.lost`` fires inside the watchdog's batch-boundary probe on
+    the mesh engine's ingest path, and ``watchdog.deadline`` (a
+    delay-kind injection — a slow device, not an exception) stretches a
+    deadline-tracked device section past its budget until the next
+    boundary declares the shard dead. The full recovery protocol lives
+    in tests/test_shard_failover.py."""
+
+    def _engine_with_watchdog(self, deadline_ms=0.0, max_misses=3):
+        from flink_tpu.parallel.mesh import make_mesh
+        from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+        from flink_tpu.runtime.watchdog import DeviceWatchdog
+        from flink_tpu.windowing.aggregates import SumAggregate
+
+        eng = MeshSessionEngine(GAP, SumAggregate("v"), make_mesh(2),
+                                capacity_per_shard=1024)
+        eng.attach_watchdog(DeviceWatchdog(
+            eng.P, deadline_ms=deadline_ms, max_misses=max_misses))
+        return eng
+
+    def test_device_lost_declares_shard_dead_at_real_site(self):
+        from flink_tpu.runtime.watchdog import ShardFailedError
+
+        from tests.test_sessions import keyed_batch
+
+        eng = self._engine_with_watchdog()
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="device.lost", nth=1,
+                      where={"shard": 1})])
+        with chaos.chaos_active(plan, seed=0) as c:
+            with pytest.raises(ShardFailedError) as ei:
+                eng.process_batch(keyed_batch([1, 2, 3],
+                                              [1.0, 2.0, 3.0],
+                                              [0, 10, 20]))
+            assert ei.value.shard == 1
+            assert 1 in eng._watchdog.quarantined
+            assert c.faults_injected.get("device.lost", 0) == 1
+            _note_reached(c.faults_injected)
+
+    def test_deadline_delay_escalates_at_the_boundary(self):
+        from flink_tpu.runtime.watchdog import MeshStalledError
+
+        from tests.test_sessions import keyed_batch
+
+        # every deadline-tracked section sleeps 20 ms against a 1 ms
+        # deadline: timeout -> retry (miss streak) -> escalated at the
+        # next batch boundary once the miss budget is spent. The
+        # engine's sections are whole-mesh (SPMD), so the uniform
+        # streak carries no shard attribution and escalates as a
+        # MESH STALL (whole-job restart), never a false shard death
+        eng = self._engine_with_watchdog(deadline_ms=1.0, max_misses=2)
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="watchdog.deadline", every=1,
+                      kind="delay", delay_ms=20, max_injections=0)])
+        with chaos.chaos_active(plan, seed=0) as c:
+            with pytest.raises(MeshStalledError):
+                for i in range(8):
+                    eng.process_batch(keyed_batch(
+                        [1, 2, 3], [1.0, 2.0, 3.0],
+                        [i * 10, i * 10 + 1, i * 10 + 2]))
+            assert eng._watchdog.deadline_misses >= 2
+            assert not eng._watchdog.quarantined
+            assert c.faults_injected.get("watchdog.deadline", 0) >= 2
+            _note_reached(c.faults_injected)
+
+
 class TestZZFaultPointReachability:
     """Must run LAST in this file (pytest preserves definition order):
     every fault point of the CANONICAL inventory was injected somewhere

@@ -767,7 +767,7 @@ class TestSessionCluster:
             def __init__(self):
                 self.calls = 0
 
-            def decide(self, demands):
+            def decide(self, demands, dead_shards=0):
                 self.calls += 1
                 want = {"grow": 4, "shrink": 1}
                 return {d.job: want[d.job] for d in demands}

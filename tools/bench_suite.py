@@ -267,13 +267,53 @@ def row6_queryable_lookups():
     return json.loads(lines[-1])
 
 
+def row7_shard_loss_recovery():
+    """Partial failover: kill 1 of 4 shards mid-stream (the chaos
+    smoke's shard-loss scenario at bench scale — 1M events, forced
+    paged eviction) and report wall-clock recovery: survivor
+    evacuation + mesh rebuild + checkpoint-unit restore of ONLY the
+    dead range + bounded replay of ONLY its records."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.setdefault("CHAOS_SHARD_LOSS_KEYS",
+                   str(int(1_000_000 * SCALE)))
+    env.setdefault("CHAOS_SHARD_LOSS_PER_STEP",
+                   str(int(125_000 * SCALE)))
+    env.setdefault("CHAOS_SHARD_LOSS_SLOTS", str(1 << 14))
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.argv=['chaos_smoke']; "
+         "import tools.chaos_smoke as cs; "
+         "sys.exit(cs.shard_loss_scenario())"],
+        capture_output=True, text=True, env=env, timeout=3600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("{")]
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError((proc.stderr or proc.stdout).strip()[-300:])
+    r = json.loads(lines[-1])
+    return {
+        "metric": "shard_loss_recovery_ms",
+        "value": r["shard_loss_recovery_ms"],
+        "shape": (f"{r['events']:,} events over {r['shards']} shards, "
+                  f"1 shard killed mid-stream (device.lost): "
+                  f"{r['shard_restores']} range restored from its "
+                  f"checkpoint unit, {r['records_replayed']:,} records "
+                  f"replayed (bound: events/shards = "
+                  f"{r['events'] // r['shards']:,}), output "
+                  "oracle-identical"),
+    }
+
+
 ROWS = [("wordcount_socket", row1_wordcount),
         ("nexmark_q5", row2_q5),
         ("nexmark_q7", row3_q7),
         ("sql_hop_kafka", row4_sql_hop_kafka),
         ("sessions_10m_keys", row5_sessions_10m_keys),
         ("mesh_sessions_10m_keys", row5b_mesh_sessions),
-        ("queryable_lookups", row6_queryable_lookups)]
+        ("queryable_lookups", row6_queryable_lookups),
+        ("shard_loss_recovery", row7_shard_loss_recovery)]
 
 
 def main():
@@ -390,6 +430,20 @@ def main():
         "against live keyed state; the tier-1 smoke runs the same "
         "script smaller and FAILS on any steady-state compile, p99 over "
         "budget, or quota violation (design note in NOTES_r10.md).")
+    lines.append("")
+    lines.append(
+        "The shard-loss-recovery row runs `tools/chaos_smoke.py`'s "
+        "shard-loss scenario at bench scale: an injected `device.lost` "
+        "kills 1 of 4 shards at a batch boundary mid-stream, and the "
+        "measured span covers the whole partial failover — survivor "
+        "evacuation (live-reshard row lift, dirtiness intact), mesh "
+        "rebuild over the remaining devices, restore of ONLY the dead "
+        "shard's key groups from their shard-granular checkpoint unit "
+        "(flink_tpu/checkpoint/sharded.py), and bounded replay of ONLY "
+        "that range's records from the unit's source position. The "
+        "tier-1 smoke runs the same scenario smaller and FAILS if the "
+        "replay volume exceeds events/shards or the committed output "
+        "diverges from the fault-free oracle (NOTES_r13.md).")
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "BENCHMARKS.md")
     with open(out, "w") as f:

@@ -42,19 +42,116 @@ SEED = 7
 NUM_KEYS = int(os.environ.get("CHAOS_SMOKE_KEYS", 6000))
 N_STEPS = int(os.environ.get("CHAOS_SMOKE_STEPS", 8))
 PER_STEP = int(os.environ.get("CHAOS_SMOKE_PER_STEP", 1500))
+# shard-loss scenario shape: its OWN knobs so the bench suite can scale
+# it up without disturbing the legacy scenario's pinned fault schedules
+SL_KEYS = int(os.environ.get("CHAOS_SHARD_LOSS_KEYS", NUM_KEYS))
+SL_STEPS = int(os.environ.get("CHAOS_SHARD_LOSS_STEPS", N_STEPS))
+SL_PER_STEP = int(os.environ.get("CHAOS_SHARD_LOSS_PER_STEP", PER_STEP))
+SL_SLOTS = int(os.environ.get("CHAOS_SHARD_LOSS_SLOTS", 1024))
 
 
-def _steps():
-    """~12k events, live session set far beyond the 1024-slot/shard
-    budget so page eviction + reload are genuinely on the path."""
+def _steps(n_steps=None, per_step=None, num_keys=None):
+    """~12k events by default, live session set far beyond the
+    1024-slot/shard budget so page eviction + reload are genuinely on
+    the path."""
+    n_steps = N_STEPS if n_steps is None else n_steps
+    per_step = PER_STEP if per_step is None else per_step
+    num_keys = NUM_KEYS if num_keys is None else num_keys
     rng = np.random.default_rng(17)
     out = []
-    for s in range(N_STEPS):
-        keys = rng.integers(0, NUM_KEYS, PER_STEP).astype(np.int64)
-        vals = rng.random(PER_STEP).astype(np.float32)
-        ts = rng.integers(s * 80, s * 80 + 60, PER_STEP).astype(np.int64)
+    for s in range(n_steps):
+        keys = rng.integers(0, num_keys, per_step).astype(np.int64)
+        vals = rng.random(per_step).astype(np.float32)
+        ts = rng.integers(s * 80, s * 80 + 60, per_step).astype(np.int64)
         out.append((keys, vals, ts, (s - 1) * 80))
     return out
+
+
+def shard_loss_scenario() -> int:
+    """Kill 1 of 4 shards mid-stream (device.lost at a batch boundary,
+    paged spill armed with forced eviction): the run FAILS unless the
+    recovery was genuinely PARTIAL — only the dead shard's key groups
+    restored from their checkpoint unit, and the replay volume bounded
+    by ~1/shards of the stream (+padding). A partial recovery silently
+    regressing to full replay trips the gate."""
+    from flink_tpu.chaos.harness import (
+        ChaosDivergenceError,
+        run_shard_loss_verify,
+    )
+    from flink_tpu.chaos.injection import FaultPlan, FaultRule
+    from flink_tpu.parallel.mesh import make_mesh
+    from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+    from flink_tpu.windowing.aggregates import SumAggregate
+    from flink_tpu.windowing.sessions import SessionWindower
+
+    shards = 4
+    mesh = make_mesh(shards)
+    plan = FaultPlan(rules=[
+        # mid-stream loss of shard 1: the 15th boundary probe of that
+        # shard lands in step ~7's ingest (2 probes per step)
+        FaultRule(pattern="device.lost", nth=15, where={"shard": 1}),
+    ])
+
+    def make_engine():
+        return MeshSessionEngine(
+            GAP, SumAggregate("v"), mesh,
+            capacity_per_shard=max(1 << 14, SL_SLOTS),
+            max_device_slots=SL_SLOTS, max_dispatch_ahead=2)
+
+    def make_oracle():
+        return SessionWindower(
+            GAP, SumAggregate("v"),
+            capacity=max(1 << 15, 2 * SL_KEYS))
+
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="chaos-shard-loss-") as tmp:
+        try:
+            report = run_shard_loss_verify(
+                make_engine, make_oracle,
+                _steps(SL_STEPS, SL_PER_STEP, SL_KEYS), plan, seed=SEED,
+                ckpt_root=os.path.join(tmp, "ckpt"), checkpoint_every=2)
+        except ChaosDivergenceError as e:
+            print(f"CHAOS SMOKE FAILED: shard-loss output diverged\n{e}",
+                  file=sys.stderr)
+            return 1
+    row = {
+        "bench": "chaos_smoke_shard_loss",
+        "seconds": round(time.perf_counter() - t0, 2),
+        "events": report.events,
+        "shards": shards,
+        **report.signature(),
+        "shard_loss_recovery_ms": round(report.shard_loss_recovery_ms,
+                                        1),
+    }
+    print(json.dumps(row))
+    failures = []
+    if report.shards_lost != 1:
+        failures.append(
+            f"expected exactly 1 shard loss, got {report.shards_lost}")
+    if report.shard_restores != 1:
+        failures.append(
+            "the dead shard's key groups were never restored from "
+            f"their checkpoint unit (shard_restores="
+            f"{report.shard_restores})")
+    if report.records_replayed <= 0:
+        failures.append("no records were replayed — the loss happened "
+                        "before any progress (stale schedule?)")
+    # THE bounded-replay gate: a single-shard loss must replay about
+    # 1/shards of the stream, never the whole backlog. The replay
+    # window is at most checkpoint_every+1 steps of the range's share;
+    # events/shards is ~2x that here — generous padding, but a
+    # regression to full replay (~5x) trips it hard.
+    budget = report.events // shards
+    if report.records_replayed > budget:
+        failures.append(
+            f"replay volume {report.records_replayed} exceeds "
+            f"events/shards = {budget} — partial recovery regressed "
+            "toward full replay")
+    if failures:
+        print("CHAOS SMOKE FAILED: " + "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    return 0
 
 
 def main() -> int:
@@ -128,7 +225,8 @@ def main() -> int:
         print("CHAOS SMOKE FAILED: " + "; ".join(failures),
               file=sys.stderr)
         return 1
-    return 0
+    # partial failover: lose one shard, not the job (its own gate)
+    return shard_loss_scenario()
 
 
 if __name__ == "__main__":
