@@ -35,6 +35,8 @@ KNOWN_FAULT_POINTS = (
     "mesh.session_fire",
     "mesh.window_fire",
     "rescale.handoff",
+    "join.exchange",
+    "join.versioned_lookup",
     "serving.lookup",
     "harvest.pending_fire",
     "task.batch",

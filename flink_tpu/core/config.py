@@ -258,6 +258,18 @@ class DeploymentOptions:
         "'host' keeps the explicit fallback: [shards, B] bucketing in "
         "host numpy + a sharded device_put per block. See "
         "flink_tpu/parallel/shuffle.py.")
+    JOIN_MODE = ConfigOption(
+        "join.mode", default="host", type=str,
+        description="Execution plane for the DataStream interval join "
+        "(KeyedStream.interval_join().between() — INNER): 'host' "
+        "(default) buffers sides as columnar batches in host numpy "
+        "(runtime/join_operators.py — also the semantics oracle); "
+        "'device' runs the join over dual keyed slot tables on the "
+        "mesh: both inputs ride the keyBy data plane co-partitioned "
+        "by key group, and a banded segment-intersection program "
+        "gathers/intersects/emits each batch's candidates "
+        "(flink_tpu/joins/). Outer joins and the SQL planner's join "
+        "operators stay on the host path regardless of this option.")
     SHUFFLE_SERVICE = ConfigOption(
         "shuffle.service", default="local", type=str,
         description="Registered ShuffleService transport connecting "

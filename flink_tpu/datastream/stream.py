@@ -427,12 +427,32 @@ class IntervalJoinBuilder:
 
     def between(self, lower_ms: int, upper_ms: int,
                 suffixes=("_l", "_r")) -> DataStream:
+        from flink_tpu.core.config import DeploymentOptions
         from flink_tpu.runtime.join_operators import IntervalJoinOperator
 
+        env = self.left.env
+        if env.config.get(DeploymentOptions.JOIN_MODE) == "device":
+            # the device-native path: dual keyed slot tables on the
+            # mesh, banded segment-intersection kernel per batch
+            # (flink_tpu/joins/) — the host operator stays the
+            # semantics oracle and the join.mode=host fallback
+            from flink_tpu.joins.operators import (
+                DeviceIntervalJoinOperator,
+            )
+
+            capacity = env.state_slot_capacity
+            spill = env.state_spill_options
+            factory = lambda: DeviceIntervalJoinOperator(  # noqa: E731
+                lower_ms, upper_ms, suffixes, capacity=capacity,
+                max_device_slots=spill["max_device_slots"],
+                spill_dir=spill["spill_dir"],
+                spill_host_max_bytes=spill["spill_host_max_bytes"])
+        else:
+            factory = lambda: IntervalJoinOperator(  # noqa: E731
+                lower_ms, upper_ms, suffixes)
         t = Transformation(
             name="interval_join", kind="two_input",
-            operator_factory=lambda: IntervalJoinOperator(
-                lower_ms, upper_ms, suffixes),
+            operator_factory=factory,
             inputs=[self.left.transformation, self.right.transformation],
             keyed=True)
         return DataStream(self.left.env, t)

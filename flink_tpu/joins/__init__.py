@@ -1,0 +1,16 @@
+"""Device-native streaming joins: interval + temporal join kernels
+over dual keyed slot tables (see joins/engine.py for the design)."""
+
+from flink_tpu.joins.engine import (  # noqa: F401
+    JoinEngineBase,
+    MeshIntervalJoinEngine,
+    MeshTemporalJoinEngine,
+)
+from flink_tpu.joins.operators import (  # noqa: F401
+    DeviceIntervalJoinOperator,
+    DeviceTemporalJoinOperator,
+)
+from flink_tpu.joins.side_table import (  # noqa: F401
+    JoinSideTable,
+    pair_lower_bound,
+)

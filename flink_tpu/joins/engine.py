@@ -1,0 +1,1195 @@
+"""Device-native two-input join engines over dual keyed slot tables.
+
+The two-input form of the mesh window/session engines: both inputs ride
+the keyBy data plane (``parallel.shuffle`` — device-mode fused exchange
+or host bucketing) co-partitioned onto the SAME mesh axis by the same
+key-group routing, so a key's left and right rows always share a shard
+and every probe is shard-local. Per batch the device runs at most three
+programs — the ingest put/exchange, the banded probe, and (under
+budget pressure) one eviction gather — all cached in the shared
+``PROGRAM_CACHE`` and shape-bounded by the ``pad_bucket_size`` /
+``sticky_bucket`` tier discipline, so steady state compiles nothing
+(gated by the join phase of ``tools/recompile_smoke.py``).
+
+- :class:`MeshIntervalJoinEngine` — keyed interval join (left row at
+  ``t`` matches right rows in ``[t+lower, t+upper]``,
+  reference: IntervalJoinOperator.java): a banded segment-intersection
+  over the two sorted row tables. A new batch probes the OTHER side's
+  table before inserting into its own (pair emitted by whichever side
+  arrives second — the host operator's structural dedup), with the band
+  ``[lo, lo+cnt)`` resolved on host metadata and the candidates
+  gathered/intersected/emitted by ONE compiled program per batch.
+- :class:`MeshTemporalJoinEngine` — event-time temporal join (``FOR
+  SYSTEM_TIME AS OF``, reference: TemporalRowTimeJoinOperator.java):
+  the right side is a VERSIONED state plane (version boundaries are the
+  per-key sorted ``ts`` column of its slot table); left rows wait for
+  the combined watermark, then one searchsorted-style gather program
+  per batch picks each row's latest version at-or-before its time (the
+  ``W == 1`` band). Version state compacts to the reference's
+  cleanupState contract on every watermark.
+
+``backend="host"`` runs the numpy oracle: identical metadata code,
+identical emission order, value movement in host arrays — the
+bit-identity pin for the device path (including under forced paged
+eviction and mid-stream ``reshard()``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from flink_tpu.chaos import injection as chaos
+from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
+from flink_tpu.joins.side_table import (
+    JoinSideTable,
+    pair_lower_bound,
+)
+from flink_tpu.ops.segment_ops import pad_bucket_size, sticky_bucket
+from flink_tpu.state.keygroups import assign_key_groups
+from flink_tpu.state.paged_spill import restore_into_pages
+
+_NEG = -(1 << 62)
+
+SIDE_NAMES = ("left", "right")
+
+# tiny non-donated slice enqueued after everything dispatched so far —
+# its readiness proves the device consumed every earlier staging buffer
+# (the join engines' form of the mesh engines' fence; jit caches per
+# input sharding)
+_FENCE_STEP = jax.jit(lambda a: a[:1, :1])
+
+
+def _suffixed_names(left_names: Sequence[str],
+                    right_names: Sequence[str],
+                    suffixes: Tuple[str, str]
+                    ) -> Tuple[Dict[str, str], Dict[str, str]]:
+    """Output column name per input column, matching the host join
+    operators' ``_merge_columns`` convention: a name present on both
+    sides gets the side suffix, everything else passes through."""
+    overlap = set(left_names) & set(right_names)
+    lmap = {n: (n + suffixes[0] if n in overlap else n)
+            for n in left_names}
+    rmap = {n: (n + suffixes[1] if n in overlap else n)
+            for n in right_names}
+    return lmap, rmap
+
+
+class JoinEngineBase:
+    """Shared machinery of the two-input engines: the dual side tables,
+    the data-plane staging, eviction, probing, checkpoints, partial
+    restore, live reshard and the watchdog plumbing."""
+
+    #: subclasses set: ("interval", lower, upper) or ("temporal",)
+    kind: str = ""
+
+    def __init__(self, mesh=None, num_shards: int = 1,
+                 capacity_per_shard: int = 1 << 16,
+                 max_parallelism: int = 128,
+                 max_device_slots: int = 0,
+                 spill_dir: Optional[str] = None,
+                 spill_host_max_bytes: int = 0,
+                 key_group_range: Optional[Tuple[int, int]] = None,
+                 backend: str = "device",
+                 shuffle_mode: str = "device",
+                 suffixes: Tuple[str, str] = ("_l", "_r")) -> None:
+        if backend not in ("device", "host"):
+            raise ValueError(
+                f"backend must be 'device' or 'host', got {backend!r}")
+        if shuffle_mode not in ("device", "host"):
+            raise ValueError(
+                f"shuffle_mode must be 'device' or 'host', got "
+                f"{shuffle_mode!r}")
+        self.backend = backend
+        self.shuffle_mode = shuffle_mode
+        self.mesh = None
+        if backend == "device":
+            if mesh is None:
+                from flink_tpu.parallel.mesh import make_mesh
+
+                mesh = make_mesh(num_shards)
+            self.mesh = mesh
+            self.P = int(mesh.devices.size)
+        else:
+            self.P = int(num_shards)
+        self.capacity = max(int(capacity_per_shard), 256)
+        self.max_device_slots = int(max_device_slots or 0)
+        if self.max_device_slots:
+            self.capacity = min(self.capacity,
+                                max(self.max_device_slots, 256))
+        self.max_parallelism = int(max_parallelism)
+        if self.max_parallelism < self.P:
+            raise ValueError(
+                f"max_parallelism {max_parallelism} < shard count "
+                f"{self.P}")
+        self.key_group_range = key_group_range
+        self.spill_dir = spill_dir
+        self.spill_host_max_bytes = int(spill_host_max_bytes or 0)
+        self.suffixes = tuple(suffixes)
+        #: per-side state (created lazily at the side's first batch —
+        #: the value schema is observed, like the table-runtime's
+        #: late-bound row types)
+        self.sides: List[Optional[JoinSideTable]] = [None, None]
+        self._planes: List[Optional[tuple]] = [None, None]
+        self._next_rid = 1
+        # sticky compile-shape tiers (per side where shapes differ)
+        self._put_bucket = [0, 0]
+        self._mirror_bucket = [0, 0]
+        self._probe_bucket = [0, 0]
+        self._band_bucket = [0, 0]
+        self._gather_bucket = 0
+        if backend == "device":
+            from jax.sharding import NamedSharding, PartitionSpec
+            from flink_tpu.parallel.mesh import KEY_AXIS
+            from flink_tpu.parallel.shuffle import ShuffleBufferPool
+
+            self._sharding = NamedSharding(self.mesh,
+                                           PartitionSpec(KEY_AXIS))
+            self._pool = ShuffleBufferPool(generations=2)
+            self._fences: List = []
+
+    # ------------------------------------------------------------- watchdog
+
+    _watchdog = None
+
+    def attach_watchdog(self, wd) -> None:
+        self._watchdog = wd
+        if wd is not None and self.mesh is not None:
+            wd.rebind(self.P, [d.id for d in self.mesh.devices.flat])
+
+    def _wd_section(self, op: str, shard: int = -1):
+        wd = self._watchdog
+        if wd is None:
+            from flink_tpu.runtime.watchdog import NULL_SECTION
+
+            return NULL_SECTION
+        return wd.section(op, shard)
+
+    def _wd_boundary(self) -> None:
+        wd = self._watchdog
+        if wd is not None:
+            wd.boundary_probe()
+
+    def _harvest_get(self, tree, op: str = "join_probe_harvest"):
+        """ONE batched D2H per harvest point (the TRC01 discipline)."""
+        import jax
+
+        with self._wd_section(op):
+            return jax.device_get(tree)
+
+    # ----------------------------------------------------------- data plane
+
+    def _drain_fences(self) -> None:
+        if self.backend != "device":
+            return
+        while self._fences:
+            # flint: disable=TRC01 -- the depth-bounded fence drain is
+            # the ingest backpressure point: it blocks only when the
+            # host ran a full staging generation ahead of the device
+            self._fences.pop(0).block_until_ready()
+
+    def _push_fence(self) -> None:
+        import jax
+
+        planes = self._planes[0] or self._planes[1]
+        if planes is None:
+            return
+        with self._wd_section("dispatch_fence"):
+            self._fences.append(_FENCE_STEP(planes[0]))
+        # one staging generation may be in flight; the next must wait
+        if len(self._fences) > 1:
+            with self._wd_section("fence_drain"):
+                # flint: disable=TRC01 -- see _drain_fences: this is
+                # the designed double-buffer backpressure point
+                self._fences.pop(0).block_until_ready()
+
+    def _ensure_side(self, side_idx: int, batch: RecordBatch
+                     ) -> JoinSideTable:
+        side = self.sides[side_idx]
+        if side is not None:
+            return side
+        schema = sorted(
+            (n, np.asarray(batch[n]).dtype) for n in batch.names()
+            if n not in (KEY_ID_FIELD, TIMESTAMP_FIELD))
+        return self._init_side(side_idx, schema)
+
+    def _init_side(self, side_idx: int, schema) -> JoinSideTable:
+        sdir = (f"{self.spill_dir.rstrip('/')}/{SIDE_NAMES[side_idx]}"
+                if self.spill_dir else None)
+        side = JoinSideTable(
+            self.P, self.capacity, schema,
+            max_device_slots=self.max_device_slots,
+            spill_dir=sdir,
+            # the operator's host page-memory budget splits across the
+            # two sides (each side then splits per shard)
+            spill_host_max_bytes=self.spill_host_max_bytes // 2,
+            backend=self.backend)
+        self.sides[side_idx] = side
+        if self.backend == "device":
+            import jax
+            import jax.numpy as jnp
+
+            self._planes[side_idx] = tuple(
+                jax.device_put(
+                    jnp.zeros((self.P, side.capacity),
+                              dtype=side.schema[i][1]),
+                    self._sharding)
+                for i in side.device_cols)
+        return side
+
+    def _check_schema(self, side: JoinSideTable,
+                      batch: RecordBatch, side_idx: int) -> None:
+        names = set(batch.names()) - {KEY_ID_FIELD, TIMESTAMP_FIELD}
+        declared = {n for n, _ in side.schema}
+        if names != declared:
+            raise RuntimeError(
+                f"{SIDE_NAMES[side_idx]} join input changed columns "
+                f"mid-stream: {sorted(declared)} -> {sorted(names)}")
+
+    def _shards_of(self, keys: np.ndarray) -> np.ndarray:
+        from flink_tpu.parallel.shuffle import shard_records
+
+        return shard_records(keys, self.P, self.max_parallelism,
+                             self.key_group_range)
+
+    # --------------------------------------------------------------- ingest
+
+    def _ingest(self, side_idx: int, keys: np.ndarray, ts: np.ndarray,
+                values: List[np.ndarray], shards=None) -> None:
+        """Insert rows into ``side_idx``'s table: route, make headroom,
+        allocate slots, merge metadata, move values (device put /
+        fused exchange / host shadow). ``values`` in schema order;
+        ``shards`` lets a caller that already routed these keys (the
+        probe path) skip the second routing pass."""
+        side = self.sides[side_idx]
+        n = len(keys)
+        if n == 0:
+            return
+        if shards is None:
+            shards = self._shards_of(keys)
+        # chaos: the two-input data plane. Payload kinds (drop /
+        # duplicate) mutate one shard's rows BEFORE any state mutation
+        # — a bucket lost or replayed in flight; raise/delay fire at
+        # the post-dispatch site below (crash mid-batch with the put
+        # on the device queue — the hardest restore case)
+        if chaos.armed():
+            mutations: Dict[int, str] = {}
+            for p in np.unique(shards).tolist():
+                rule = chaos.payload_action(
+                    "join.exchange",
+                    kinds=("drop", "duplicate", "delay"),
+                    shard=int(p), side=side_idx)
+                if rule is not None and rule.kind in ("drop",
+                                                      "duplicate"):
+                    mutations[int(p)] = rule.kind
+            for p, mkind in mutations.items():
+                sel = shards == p
+                if mkind == "drop":
+                    keep = ~sel
+                    keys, ts, shards = keys[keep], ts[keep], shards[keep]
+                    values = [v[keep] for v in values]
+                else:
+                    keys = np.concatenate([keys, keys[sel]])
+                    ts = np.concatenate([ts, ts[sel]])
+                    shards = np.concatenate([shards, shards[sel]])
+                    values = [np.concatenate([v, v[sel]])
+                              for v in values]
+            n = len(keys)
+            if n == 0:
+                return
+        self._ingest_rows(side_idx, keys, ts, values, shards)
+        chaos.fault_point("join.exchange", records=n, side=side_idx)
+
+    def _ingest_rows(self, side_idx: int, keys, ts, values,
+                     shards) -> None:
+        """Route/allocate/insert, bisecting when one batch's per-shard
+        rows exceed the plane (the working-set bound: rows of the SAME
+        chunk cannot evict each other — same discipline as the session
+        engine's batch split)."""
+        side = self.sides[side_idx]
+        n = len(keys)
+        counts = np.bincount(shards, minlength=self.P)
+        if side.spill_active and int(counts.max()) > side.capacity - 1 \
+                and n > 1:
+            half = n // 2
+            self._ingest_rows(side_idx, keys[:half], ts[:half],
+                              [v[:half] for v in values],
+                              shards[:half])
+            self._ingest_rows(side_idx, keys[half:], ts[half:],
+                              [v[half:] for v in values],
+                              shards[half:])
+            return
+        rids = np.arange(self._next_rid, self._next_rid + n,
+                         dtype=np.int64)
+        self._next_rid += n
+        if side.spill_active:
+            self._make_headroom(side_idx, counts)
+        else:
+            need = int(counts.max()) if n else 0
+            while any(side.free_headroom(p) < counts[p]
+                      for p in range(self.P)):
+                self._grow_side(side_idx, max(
+                    side.capacity * 2,
+                    pad_bucket_size(side.capacity + need)))
+        slots = np.zeros(n, dtype=np.int32)
+        order = np.argsort(shards, kind="stable")
+        offs = np.concatenate(([0], np.cumsum(counts)))
+        for p in np.nonzero(counts)[0].tolist():
+            sel = order[offs[p]:offs[p + 1]]
+            sl = side.allocate(p, len(sel))
+            slots[sel] = sl
+            side.meta[p].merge_rows(
+                keys[sel], ts[sel], rids[sel], sl,
+                np.ones(len(sel), dtype=bool))
+            for i in side.shadow:
+                side.shadow[i][p][sl] = np.asarray(
+                    values[i], dtype=side.schema[i][1])[sel]
+        if self.backend == "device" and side.device_cols:
+            self._device_put_rows(side_idx, shards, slots, values)
+
+    def _device_put_rows(self, side_idx: int, shards, slots,
+                         values) -> None:
+        import jax
+
+        from flink_tpu.parallel.shuffle import (
+            bucket_by_shard,
+            stage_device_exchange,
+        )
+        from flink_tpu.joins.kernels import (
+            build_join_exchange_put,
+            build_join_put,
+        )
+
+        side = self.sides[side_idx]
+        planes = self._planes[side_idx]
+        cols = [np.asarray(slots, dtype=np.int32)] + [
+            np.asarray(values[i], dtype=side.schema[i][1])
+            for i in side.device_cols]
+        fills = [0] + [side.schema[i][1].type(0)
+                       for i in side.device_cols]
+        self._pool.flip()
+        if self.shuffle_mode == "device":
+            dst, staged, width = stage_device_exchange(
+                shards, self.P, columns=cols, fills=fills,
+                pool=self._pool)
+            prog = build_join_exchange_put(self.mesh,
+                                           side.dtypes_key())
+            with self._wd_section("join_ingest"):
+                put = jax.device_put((dst, *staged), self._sharding)
+                self._planes[side_idx] = prog(
+                    planes, put[0], put[1], tuple(put[2:]), width)
+        else:
+            counts, blocked = bucket_by_shard(
+                shards, self.P, columns=cols, fills=fills,
+                pool=self._pool)
+            prog = build_join_put(self.mesh, side.dtypes_key())
+            with self._wd_section("join_ingest"):
+                put = jax.device_put(tuple(blocked), self._sharding)
+                self._planes[side_idx] = prog(
+                    planes, put[0], tuple(put[1:]))
+        self._push_fence()
+
+    # ------------------------------------------------------------- eviction
+
+    def _make_headroom(self, side_idx: int, needed: np.ndarray) -> None:
+        """Evict the coldest (oldest-ts) rows of every shard that
+        cannot absorb its share of the batch — cohorts gathered in ONE
+        program + ONE batched D2H across shards."""
+        side = self.sides[side_idx]
+        cohorts: Dict[int, np.ndarray] = {}
+        for p in range(self.P):
+            if side.free_headroom(p) >= int(needed[p]):
+                continue
+            pos = side.choose_eviction(
+                p, int(needed[p]) - side.free_headroom(p))
+            cohorts[p] = pos
+        if not cohorts:
+            return
+        # host backend: shadow_values already carries every column —
+        # the gather would be a duplicate copy immediately discarded
+        vals = (self._gather_rows(side_idx, {
+            p: side.meta[p].slot[pos] for p, pos in cohorts.items()})
+            if self.backend == "device" and side.device_cols else None)
+        for p, pos in cohorts.items():
+            columns = side.shadow_values(p, pos)
+            if vals is not None:
+                for j, i in enumerate(side.device_cols):
+                    columns[i] = vals[p][j]
+            side.evict_rows(p, pos, columns)
+
+    def _gather_rows(self, side_idx: int,
+                     per_shard_slots: Dict[int, np.ndarray]
+                     ) -> Dict[int, List[np.ndarray]]:
+        """Device-column values at the given slots, per shard: one
+        gather program + ONE device_get for all shards. Host backend
+        reads the shadow store."""
+        side = self.sides[side_idx]
+        out: Dict[int, List[np.ndarray]] = {}
+        if self.backend == "host" or not side.device_cols:
+            for p, slots in per_shard_slots.items():
+                sc = np.clip(slots, 0, None)
+                out[p] = [side.shadow[i][p][sc]
+                          for i in side.device_cols]
+            return out
+        from flink_tpu.joins.kernels import build_join_gather
+        import jax
+
+        g_max = max(len(s) for s in per_shard_slots.values())
+        G = sticky_bucket(g_max, self._gather_bucket)
+        self._gather_bucket = G
+        block = np.zeros((self.P, G), dtype=np.int32)
+        for p, slots in per_shard_slots.items():
+            block[p, :len(slots)] = slots
+        prog = build_join_gather(self.mesh, side.dtypes_key())
+        with self._wd_section("evict_gather"):
+            gathered = prog(self._planes[side_idx],
+                            jax.device_put(block, self._sharding))
+        host = self._harvest_get(gathered, "evict_harvest")
+        for p, slots in per_shard_slots.items():
+            out[p] = [h[p][:len(slots)] for h in host]
+        return out
+
+    def _grow_side(self, side_idx: int, new_capacity: int) -> None:
+        side = self.sides[side_idx]
+        old = side.capacity
+        if new_capacity <= old:
+            return
+        side.grow(new_capacity)
+        if self.backend == "device" and side.device_cols:
+            import jax
+            import jax.numpy as jnp
+
+            host = self._harvest_get(list(self._planes[side_idx]),
+                                     "grow_harvest")
+            grown = []
+            for h, i in zip(host, side.device_cols):
+                wide = np.zeros((self.P, new_capacity),
+                                dtype=side.schema[i][1])
+                wide[:, :old] = h
+                grown.append(jax.device_put(jnp.asarray(wide),
+                                            self._sharding))
+            self._planes[side_idx] = tuple(grown)
+
+    # --------------------------------------------------------------- probes
+
+    def _probe_banded(self, store_idx: int,
+                      per_shard: Dict[int, Tuple[np.ndarray,
+                                                 np.ndarray,
+                                                 np.ndarray]],
+                      band) -> Dict[int, dict]:
+        """The banded probe: ``per_shard[p] = (orig_rows, keys, ts)``;
+        ``band(meta, keys, ts) -> (lo, cnt)`` resolves each probe's
+        candidate band over the shard's sorted metadata. Returns per
+        shard the flattened match structure and the stored side's value
+        columns (device-gathered for resident candidates, page-served
+        for cold ones) — identical content and order in both backends.
+        """
+        side = self.sides[store_idx]
+        bounds: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        w_max = 0
+        b_max = 0
+        s_max = 0
+        total = 0
+        for p, (_, pk, pt) in per_shard.items():
+            m = side.meta[p]
+            lo, cnt = band(m, pk, pt)
+            bounds[p] = (lo, cnt)
+            if len(cnt):
+                w_max = max(w_max, int(cnt.max()))
+                total += int(cnt.sum())
+            b_max = max(b_max, len(pk))
+            s_max = max(s_max, len(m))
+        gathered_host = None
+        W = 0
+        if total and w_max:
+            W = sticky_bucket(w_max, self._band_bucket[store_idx],
+                              minimum=8)
+            self._band_bucket[store_idx] = W
+        if (self.backend == "device" and side.device_cols and total
+                and W):
+            gathered_host = self._dispatch_probe(
+                store_idx, per_shard, bounds, W, b_max, s_max)
+        out: Dict[int, dict] = {}
+        for p, (orig, pk, pt) in per_shard.items():
+            lo, cnt = bounds[p]
+            t = int(cnt.sum()) if len(cnt) else 0
+            if t == 0:
+                continue
+            m = side.meta[p]
+            l_rep = np.repeat(np.arange(len(pk), dtype=np.int64), cnt)
+            off = (np.arange(t, dtype=np.int64)
+                   - np.repeat(np.cumsum(cnt) - cnt, cnt))
+            cand = lo[l_rep] + off
+            cslot = m.slot[cand]
+            resident = cslot >= 0
+            cols: List[np.ndarray] = []
+            for i, (_, dt) in enumerate(side.schema):
+                if i in side.shadow:
+                    cols.append(side.shadow[i][p]
+                                [np.clip(cslot, 0, None)].copy())
+                else:
+                    g = gathered_host[side.device_cols.index(i)][p]
+                    cols.append(np.ascontiguousarray(
+                        g[l_rep, off]).astype(dt, copy=False))
+            cold = np.nonzero(~resident)[0]
+            if len(cold):
+                side.fill_cold(
+                    p,
+                    [(int(j), int(pk[l_rep[j]]), int(m.rid[cand[j]]))
+                     for j in cold.tolist()],
+                    cols, np.arange(t, dtype=np.int64))
+            out[p] = {
+                "orig": orig, "l_rep": l_rep, "cand": cand,
+                "cols": cols, "ts": m.ts[cand], "resident": resident,
+            }
+        return out
+
+    def _dispatch_probe(self, store_idx, per_shard, bounds, W,
+                        b_max, s_max):
+        """Stage the sorted-order slot mirror + band bounds and run the
+        banded-probe program; ONE batched D2H for every output column."""
+        import jax
+
+        from flink_tpu.joins.kernels import build_banded_probe
+
+        side = self.sides[store_idx]
+        S = sticky_bucket(max(s_max, 1),
+                          self._mirror_bucket[store_idx])
+        self._mirror_bucket[store_idx] = S
+        B = sticky_bucket(max(b_max, 1),
+                          self._probe_bucket[store_idx], minimum=64)
+        self._probe_bucket[store_idx] = B
+        # NO pool flip here: the probe is synchronous — its harvest
+        # (device_get below) completes before this method returns, so
+        # its tagged buffers are free to rewrite next batch. Flipping
+        # would advance the generation a second time per batch and
+        # break the INGEST path's double-buffer (its fence drains one
+        # generation behind).
+        mirror = self._pool.get((self.P, S), np.int32, -1,
+                                tag=("probe", "mirror", store_idx))
+        lo_b = self._pool.get((self.P, B), np.int32, 0,
+                              tag=("probe", "lo", store_idx))
+        cnt_b = self._pool.get((self.P, B), np.int32, 0,
+                               tag=("probe", "cnt", store_idx))
+        for p, (_, pk, _pt) in per_shard.items():
+            m = side.meta[p]
+            mirror[p, :len(m)] = m.slot
+            lo, cnt = bounds[p]
+            lo_b[p, :len(pk)] = lo
+            cnt_b[p, :len(pk)] = cnt
+        prog = build_banded_probe(self.mesh, side.dtypes_key())
+        with self._wd_section("join_probe"):
+            put = jax.device_put((mirror, lo_b, cnt_b),
+                                 self._sharding)
+            outs = prog(self._planes[store_idx], put[0], put[1],
+                        put[2], W)
+        return self._harvest_get(outs)
+
+    # ------------------------------------------------------ match assembly
+
+    def _assemble(self, probe_idx: int, probe_cols: Dict[str,
+                                                         np.ndarray],
+                  probe_ts: np.ndarray,
+                  probe_keys: np.ndarray,
+                  probed: Dict[int, dict],
+                  out_ts) -> Optional[RecordBatch]:
+        """One output batch from the per-shard probe results:
+        shard-major, probe stream order within shard, band order within
+        probe — deterministic and backend-identical. ``out_ts(lt, rt)``
+        computes the emitted timestamp column."""
+        store_idx = 1 - probe_idx
+        store = self.sides[store_idx]
+        if not probed:
+            return None
+        store_names = [n for n, _ in store.schema]
+        probe_names = sorted(probe_cols)
+        # _suffixed_names takes (left, right); the probe side is left
+        # only when it is input 0
+        if probe_idx == 0:
+            pmap_names, smap_names = _suffixed_names(
+                probe_names, store_names, self.suffixes)
+        else:
+            smap_names, pmap_names = _suffixed_names(
+                store_names, probe_names, self.suffixes)
+        chunks: List[Dict[str, np.ndarray]] = []
+        for p in sorted(probed):
+            r = probed[p]
+            rows = r["orig"][r["l_rep"]]
+            cols: Dict[str, np.ndarray] = {
+                KEY_ID_FIELD: probe_keys[rows]}
+            for n in probe_names:
+                cols[pmap_names[n]] = probe_cols[n][rows]
+            for i, n in enumerate(store_names):
+                cols[smap_names[n]] = r["cols"][i]
+            lt = probe_ts[rows]
+            rt = r["ts"]
+            cols[TIMESTAMP_FIELD] = out_ts(lt, rt)
+            chunks.append(cols)
+        if not chunks:
+            return None
+        merged = {k: (np.concatenate([c[k] for c in chunks])
+                      if len(chunks) > 1 else chunks[0][k])
+                  for k in chunks[0]}
+        return RecordBatch(merged)
+
+    # ------------------------------------------------------------ snapshots
+
+    def _side_snapshot(self, side_idx: int) -> Dict[str, object]:
+        side = self.sides[side_idx]
+        if side is None:
+            return {"table": {}, "schema": []}
+        device_values = None
+        if self.backend == "device" and side.device_cols:
+            host = self._harvest_get(list(self._planes[side_idx]),
+                                     "snapshot_harvest")
+            device_values = [
+                {i: host[j][p]
+                 for j, i in enumerate(side.device_cols)}
+                for p in range(self.P)]
+        else:
+            device_values = [{} for _ in range(self.P)]
+        return {
+            "table": side.snapshot_rows(self.max_parallelism,
+                                        device_values),
+            "schema": [(n, dt.str) for n, dt in side.schema],
+        }
+
+    def snapshot(self, mode: str = "full") -> Dict[str, object]:
+        self._drain_fences()
+        return {
+            "kind": self.kind,
+            "left": self._side_snapshot(0),
+            "right": self._side_snapshot(1),
+            "next_rid": int(self._next_rid),
+            **self._meta_snapshot(),
+        }
+
+    def _meta_snapshot(self) -> Dict[str, object]:
+        return {}
+
+    def _restore_meta(self, snap: Dict[str, object]) -> None:
+        pass
+
+    def restore(self, snap: Dict[str, object],
+                key_group_filter=None) -> None:
+        for side_idx, name in ((0, "left"), (1, "right")):
+            s = snap.get(name) or {}
+            table = s.get("table") or {}
+            schema = [(n, np.dtype(d)) for n, d in
+                      s.get("schema", [])]
+            self.sides[side_idx] = None
+            self._planes[side_idx] = None
+            if not schema:
+                continue
+            self._init_side(side_idx, schema)
+            self._restore_rows(side_idx, table, key_group_filter)
+        self._next_rid = max(int(snap.get("next_rid", 1)),
+                             self._next_rid)
+        self._restore_meta(snap)
+
+    def _restore_rows(self, side_idx: int, table: Dict[str, object],
+                      key_group_filter) -> None:
+        side = self.sides[side_idx]
+        keys = np.asarray(table.get("key_id", ()), dtype=np.int64)
+        if not len(keys):
+            return
+        rids = np.asarray(table["namespace"], dtype=np.int64)
+        ts = np.asarray(table["ts"], dtype=np.int64)
+        dirty = np.asarray(table.get("dirty",
+                                     np.zeros(len(keys), bool)),
+                           dtype=bool)
+        leaves = [np.asarray(table[f"leaf_{i}"],
+                             dtype=side.schema[i][1])
+                  for i in range(len(side.schema))]
+        if key_group_filter is not None:
+            kg = table.get("key_group")
+            kg = (np.asarray(kg, dtype=np.int64) if kg is not None
+                  else assign_key_groups(keys, self.max_parallelism))
+            keep = np.isin(kg, np.asarray(sorted(
+                int(g) for g in key_group_filter)))
+            keys, rids, ts, dirty = (keys[keep], rids[keep],
+                                     ts[keep], dirty[keep])
+            leaves = [lv[keep] for lv in leaves]
+        if not len(keys):
+            return
+        self._next_rid = max(self._next_rid, int(rids.max()) + 1)
+        shards = self._shards_of(keys)
+        if not side.spill_active:
+            # an engine that grew during the run must be able to
+            # restore its own snapshot: grow exactly like ingest does
+            counts = np.bincount(shards, minlength=self.P)
+            need = int(counts.max())
+            while any(side.free_headroom(p) < counts[p]
+                      for p in range(self.P)):
+                self._grow_side(side_idx, max(
+                    side.capacity * 2,
+                    pad_bucket_size(side.capacity + need)))
+        put_slots: Dict[int, np.ndarray] = {}
+        put_sel: Dict[int, np.ndarray] = {}
+        for p in range(self.P):
+            sel = np.nonzero(shards == p)[0]
+            if not len(sel):
+                continue
+            # newest rows stay resident (they expire last and are the
+            # likeliest band candidates); the rest re-home as pages
+            order = sel[np.argsort(-ts[sel], kind="stable")]
+            n_res = min(len(order), side.free_headroom(p))
+            res, cold = order[:n_res], order[n_res:]
+            slots = side.allocate(p, n_res)
+            slot_col = np.full(len(sel), -1, dtype=np.int32)
+            if len(cold):
+                restore_into_pages(
+                    side.spills[p], side.pmaps[p], keys[cold],
+                    rids[cold], [lv[cold] for lv in leaves],
+                    page_rows=max(side.capacity // 8, 256),
+                    dirty=dirty[cold], append=True)
+            # metadata rows for everything (cold rows carry slot -1);
+            # keep (res-first) ordering irrelevant — merge sorts
+            both = np.concatenate([res, cold]).astype(np.int64)
+            slot_col[:n_res] = slots
+            side.meta[p].merge_rows(keys[both], ts[both], rids[both],
+                                    slot_col, dirty[both])
+            for i in side.shadow:
+                side.shadow[i][p][slots] = leaves[i][res]
+            if len(res):
+                put_slots[p] = slots
+                put_sel[p] = res
+        if self.backend == "device" and side.device_cols and put_slots:
+            import jax
+
+            from flink_tpu.joins.kernels import build_join_put
+
+            B = sticky_bucket(max(len(s) for s in put_slots.values()),
+                              self._put_bucket[side_idx])
+            self._put_bucket[side_idx] = B
+            slot_block = np.zeros((self.P, B), dtype=np.int32)
+            val_blocks = [np.zeros((self.P, B),
+                                   dtype=side.schema[i][1])
+                          for i in side.device_cols]
+            for p, slots in put_slots.items():
+                m = len(slots)
+                slot_block[p, :m] = slots
+                for j, i in enumerate(side.device_cols):
+                    val_blocks[j][p, :m] = leaves[i][put_sel[p]]
+            prog = build_join_put(self.mesh, side.dtypes_key())
+            with self._wd_section("restore_put"):
+                put = jax.device_put(
+                    (slot_block, *val_blocks), self._sharding)
+                self._planes[side_idx] = prog(
+                    self._planes[side_idx], put[0], tuple(put[1:]))
+
+    # ---------------------------------------------- shard-granular units
+
+    def shard_key_groups(self) -> List[Tuple[int, int]]:
+        from flink_tpu.state.keygroups import shard_key_group_ranges
+
+        return shard_key_group_ranges(self.P, self.max_parallelism,
+                                      self.key_group_range)
+
+    def snapshot_sharded(self, mode: str = "full"
+                         ) -> Dict[Tuple[int, int], Dict[str, object]]:
+        """One independently-restorable unit per shard's key-group
+        range — both sides' rows split by their ``key_group`` column,
+        scalar metadata replicated (monotonic-max / watermark-min on
+        merge). The union of the units is exactly ``snapshot()``."""
+        snap = self.snapshot(mode)
+        units: Dict[Tuple[int, int], Dict[str, object]] = {}
+        for g0, g1 in self.shard_key_groups():
+            unit = {"kind": snap["kind"],
+                    "next_rid": snap["next_rid"],
+                    **{k: v for k, v in snap.items()
+                       if k not in ("kind", "left", "right",
+                                    "next_rid")}}
+            for name in ("left", "right"):
+                s = snap[name]
+                table = s.get("table") or {}
+                kg = np.asarray(table.get("key_group", ()),
+                                dtype=np.int64)
+                if len(kg):
+                    mask = (kg >= g0) & (kg <= g1)
+                    unit[name] = {
+                        "table": {k: np.asarray(v)[mask]
+                                  for k, v in table.items()},
+                        "schema": s.get("schema", []),
+                    }
+                else:
+                    unit[name] = {"table": dict(table),
+                                  "schema": s.get("schema", [])}
+            units[(int(g0), int(g1))] = unit
+        return units
+
+    def merge_unit_snapshots(self, units: List[Dict[str, object]]
+                             ) -> Dict[str, object]:
+        merged: Dict[str, object] = {
+            "kind": self.kind,
+            "next_rid": max((int(u.get("next_rid", 1))
+                             for u in units), default=1),
+            **self._merge_meta_units(units),
+        }
+        for name in ("left", "right"):
+            tables = [u.get(name, {}).get("table") or {}
+                      for u in units]
+            tables = [t for t in tables if t and len(
+                np.asarray(t.get("key_id", ())))]
+            schema = next((u[name]["schema"] for u in units
+                           if u.get(name, {}).get("schema")), [])
+            if not tables:
+                merged[name] = {"table": {}, "schema": schema}
+                continue
+            cols = sorted(set().union(*(set(t) for t in tables)))
+            table = {k: np.concatenate([np.asarray(t[k])
+                                        for t in tables])
+                     for k in cols}
+            order = np.argsort(table["namespace"], kind="stable")
+            merged[name] = {
+                "table": {k: v[order] for k, v in table.items()},
+                "schema": schema,
+            }
+        return merged
+
+    def _merge_meta_units(self, units) -> Dict[str, object]:
+        return {}
+
+    # ------------------------------------------------------------- reshard
+
+    def reshard(self, new_shards: int, devices=None) -> Dict[str, object]:
+        """LIVE key-group migration to a new mesh size: every logical
+        row (resident + paged, dirtiness intact) lifts off the old
+        plane, the mesh rebuilds, and rows land on their new owners —
+        the join form of ``MeshSpillSupport.reshard``."""
+        new_shards = int(new_shards)
+        if new_shards < 1:
+            raise ValueError("new_shards must be >= 1")
+        t0 = time.perf_counter()
+        self._drain_fences()
+        chaos.fault_point("rescale.handoff", stage="drain",
+                          shards=new_shards)
+        snaps = [self._side_snapshot(i) for i in (0, 1)]
+        rows_moved = sum(
+            len(np.asarray((s.get("table") or {}).get("key_id", ())))
+            for s in snaps)
+        if self.backend == "device":
+            from flink_tpu.parallel.mesh import make_mesh
+            from jax.sharding import NamedSharding, PartitionSpec
+            from flink_tpu.parallel.mesh import KEY_AXIS
+
+            self.mesh = make_mesh(new_shards, devices=devices)
+            self.P = int(self.mesh.devices.size)
+            self._sharding = NamedSharding(self.mesh,
+                                           PartitionSpec(KEY_AXIS))
+        else:
+            self.P = new_shards
+        if self.max_parallelism < self.P:
+            raise ValueError(
+                f"cannot reshard to {new_shards}: max_parallelism "
+                f"{self.max_parallelism}")
+        chaos.fault_point("rescale.handoff", stage="commit",
+                          shards=new_shards)
+        old_counters = [
+            self.sides[i].spill_counters() if self.sides[i] else None
+            for i in (0, 1)]
+        for side_idx, s in enumerate(snaps):
+            schema = [(n, np.dtype(d))
+                      for n, d in s.get("schema", [])]
+            self.sides[side_idx] = None
+            self._planes[side_idx] = None
+            if not schema:
+                continue
+            self._init_side(side_idx, schema)
+            # job-lifetime spill counters survive the mesh resize
+            if old_counters[side_idx]:
+                c = old_counters[side_idx]
+                pm = self.sides[side_idx].pmaps[0]
+                pm.pages_evicted += c["pages_evicted"]
+                pm.rows_evicted += c["rows_evicted"]
+                pm.pages_reloaded += c["pages_reloaded"]
+                pm.rows_reloaded += c["rows_reloaded"]
+                pm.rows_compacted += c["rows_compacted"]
+                self.sides[side_idx].cold_rows_served = \
+                    c["cold_rows_served"]
+            # lifted rows keep their dirtiness: _restore_rows carries
+            # the snapshot's dirty column into metadata and pages
+            self._restore_rows(side_idx, s.get("table") or {}, None)
+        wd = self._watchdog
+        if wd is not None and self.mesh is not None:
+            wd.rebind(self.P,
+                      [d.id for d in self.mesh.devices.flat])
+        return {"shards": self.P, "rows_moved": rows_moved,
+                "seconds": time.perf_counter() - t0}
+
+    # ------------------------------------------------------------ counters
+
+    def spill_counters(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for side_idx, name in ((0, "left"), (1, "right")):
+            side = self.sides[side_idx]
+            if side is None:
+                continue
+            for k, v in side.spill_counters().items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    def shard_resident_rows(self) -> List[int]:
+        totals = [0] * self.P
+        for side in self.sides:
+            if side is None:
+                continue
+            for p, n in enumerate(side.resident_rows()):
+                totals[p] += n
+        return totals
+
+
+class MeshIntervalJoinEngine(JoinEngineBase):
+    """Keyed interval join over the dual slot tables (INNER)."""
+
+    kind = "interval"
+
+    def __init__(self, lower: int, upper: int, **kw) -> None:
+        if lower > upper:
+            raise ValueError(f"lower {lower} > upper {upper}")
+        super().__init__(**kw)
+        self.lower = int(lower)
+        self.upper = int(upper)
+
+    # band of STORED rows matching a probe at time t: the stored side's
+    # admissible window depends on which side probes —
+    #   probe = left  -> stored right rows in [t+lower, t+upper]
+    #   probe = right -> stored left rows with t in [lts+lower,
+    #   lts+upper], i.e. lts in [t-upper, t-lower]
+    def _band_for(self, probe_idx: int):
+        if probe_idx == 0:
+            blo, bhi = self.lower, self.upper
+        else:
+            blo, bhi = -self.upper, -self.lower
+
+        def band(m, pk, pt):
+            lo = pair_lower_bound(m.key, m.ts, pk, pt + blo)
+            hi = pair_lower_bound(m.key, m.ts, pk, pt + bhi + 1)
+            return lo, (hi - lo).astype(np.int64)
+
+        return band
+
+    def process_batch(self, batch: RecordBatch,
+                      input_index: int = 0) -> List[RecordBatch]:
+        if len(batch) == 0:
+            return []
+        self._wd_boundary()
+        side_idx = int(input_index)
+        side = self._ensure_side(side_idx, batch)
+        self._check_schema(side, batch, side_idx)
+        keys = np.asarray(batch.key_ids, dtype=np.int64)
+        ts = np.asarray(batch.timestamps, dtype=np.int64)
+        values = [np.asarray(batch[n]) for n, _ in side.schema]
+        out: List[RecordBatch] = []
+        store_idx = 1 - side_idx
+        store = self.sides[store_idx]
+        shards = self._shards_of(keys)
+        if store is not None and store.num_rows():
+            per_shard: Dict[int, Tuple[np.ndarray, np.ndarray,
+                                       np.ndarray]] = {}
+            for p in np.unique(shards).tolist():
+                sel = np.nonzero(shards == p)[0]
+                per_shard[int(p)] = (sel, keys[sel], ts[sel])
+            probed = self._probe_banded(store_idx, per_shard,
+                                        self._band_for(side_idx))
+            probe_cols = {n: np.asarray(batch[n])
+                          for n in batch.names()
+                          if n not in (KEY_ID_FIELD,
+                                       TIMESTAMP_FIELD)}
+            m = self._assemble(side_idx, probe_cols, ts, keys, probed,
+                               out_ts=np.maximum)
+            if m is not None and len(m):
+                out.append(m)
+        # insert AFTER the probe: a pair is emitted by whichever side
+        # arrives second (never joins its own batch — the structural
+        # dedup of the reference operator)
+        self._ingest(side_idx, keys, ts, values, shards=shards)
+        return out
+
+    def on_watermark(self, watermark: int) -> List[RecordBatch]:
+        """Prune expired rows: a left row at t is dead once the
+        watermark passes ``t + upper``; a right row at t once it passes
+        ``t - lower`` (no right-side probe can still reach it)."""
+        self._wd_boundary()
+        if self.sides[0] is not None:
+            self.sides[0].prune(int(watermark) - self.upper)
+        if self.sides[1] is not None:
+            self.sides[1].prune(int(watermark) + self.lower)
+        return []
+
+    def _meta_snapshot(self) -> Dict[str, object]:
+        return {"lower": self.lower, "upper": self.upper}
+
+    def _merge_meta_units(self, units) -> Dict[str, object]:
+        return {"lower": self.lower, "upper": self.upper}
+
+
+class MeshTemporalJoinEngine(JoinEngineBase):
+    """Event-time temporal join against the versioned right plane."""
+
+    kind = "temporal"
+
+    def __init__(self, **kw) -> None:
+        super().__init__(**kw)
+        #: pending left rows (host columnar, drained per watermark:
+        #: they are transient ordering state, not keyed state — the
+        #: versioned RIGHT side is the device-resident plane)
+        self._pending: List[RecordBatch] = []
+        self._emitted_wm = _NEG
+        self.late_left_dropped = 0
+
+    def process_batch(self, batch: RecordBatch,
+                      input_index: int = 0) -> List[RecordBatch]:
+        if len(batch) == 0:
+            return []
+        self._wd_boundary()
+        if int(input_index) == 0:
+            late = np.asarray(batch.timestamps,
+                              dtype=np.int64) <= self._emitted_wm
+            if late.any():
+                self.late_left_dropped += int(late.sum())
+                batch = batch.filter(~late)
+            if len(batch):
+                self._pending.append(batch)
+            return []
+        side = self._ensure_side(1, batch)
+        self._check_schema(side, batch, 1)
+        self._ingest(1, np.asarray(batch.key_ids, dtype=np.int64),
+                     np.asarray(batch.timestamps, dtype=np.int64),
+                     [np.asarray(batch[n]) for n, _ in side.schema])
+        return []
+
+    @staticmethod
+    def _version_band(m, pk, pt):
+        """Latest version at-or-before each probe time: the ``W == 1``
+        band ``[ub(k, t) - 1]`` where the candidate's key matches."""
+        hi = pair_lower_bound(m.key, m.ts, pk, pt + 1)
+        pos = hi - 1
+        ok = pos >= 0
+        ok[ok] &= m.key[pos[ok]] == pk[ok]
+        return np.maximum(pos, 0), ok.astype(np.int64)
+
+    def on_watermark(self, watermark: int) -> List[RecordBatch]:
+        self._wd_boundary()
+        watermark = int(watermark)
+        out: List[RecordBatch] = []
+        if self._pending:
+            left = (self._pending[0] if len(self._pending) == 1
+                    else RecordBatch.concat(self._pending))
+            ready_mask = left.timestamps <= watermark
+            ready = left.filter(ready_mask)
+            if len(ready) and self.sides[1] is not None \
+                    and self.sides[1].num_rows():
+                # sort once by (key, ts): the reference's per-key
+                # ordered probe, vectorized — and the left side must
+                # know its schema even when it never stores rows
+                self._ensure_side(0, ready)
+                order = np.lexsort((ready.timestamps, ready.key_ids))
+                ready = ready.take(order)
+                keys = np.asarray(ready.key_ids, dtype=np.int64)
+                ts = np.asarray(ready.timestamps, dtype=np.int64)
+                shards = self._shards_of(keys)
+                per_shard = {}
+                for p in np.unique(shards).tolist():
+                    sel = np.nonzero(shards == p)[0]
+                    per_shard[int(p)] = (sel, keys[sel], ts[sel])
+                # a crash/stall at the versioned-plane lookup: the
+                # probe is read-only and the pending left buffer is
+                # still intact, so recovery replays this watermark
+                # consistently
+                chaos.fault_point("join.versioned_lookup",
+                                  probes=len(ready))
+                probed = self._probe_banded(1, per_shard,
+                                            self._version_band)
+                probe_cols = {n: np.asarray(ready[n])
+                              for n in ready.names()
+                              if n not in (KEY_ID_FIELD,
+                                           TIMESTAMP_FIELD)}
+                m = self._assemble(0, probe_cols, ts, keys, probed,
+                                   out_ts=lambda lt, rt: lt)
+                if m is not None and len(m):
+                    out.append(m)
+            elif len(ready) and self.sides[0] is None:
+                self._ensure_side(0, ready)
+            # buffer mutation AFTER the probe: a crash mid-probe
+            # replays with the pending rows intact
+            keep = ~ready_mask
+            self._pending = ([left.filter(keep)] if keep.any()
+                             else [])
+        self._emitted_wm = max(self._emitted_wm, watermark)
+        self._compact_versions(watermark)
+        return out
+
+    def _compact_versions(self, watermark: int) -> None:
+        """Keep versions newer than the watermark plus each key's
+        single latest at-or-before it (the cleanupState contract)."""
+        side = self.sides[1]
+        if side is None:
+            return
+        for p in range(self.P):
+            m = side.meta[p]
+            if not len(m):
+                continue
+            future = m.ts > watermark
+            last_of_prefix = np.r_[
+                (m.key[1:] != m.key[:-1]) | future[1:], True] & ~future
+            dead = ~(future | last_of_prefix)
+            if dead.any():
+                side.drop_positions(p, np.nonzero(dead)[0])
+
+    def _meta_snapshot(self) -> Dict[str, object]:
+        pend = (RecordBatch.concat(self._pending)
+                if self._pending else None)
+        return {
+            "emitted_wm": int(self._emitted_wm),
+            "late_left_dropped": int(self.late_left_dropped),
+            "pending": (dict(pend.columns) if pend is not None
+                        else None),
+        }
+
+    def _restore_meta(self, snap: Dict[str, object]) -> None:
+        self._emitted_wm = int(snap.get("emitted_wm", _NEG))
+        self.late_left_dropped = int(snap.get("late_left_dropped", 0))
+        pend = snap.get("pending")
+        self._pending = (
+            [RecordBatch({k: np.asarray(v) for k, v in pend.items()})]
+            if pend else [])
+
+    def _merge_meta_units(self, units) -> Dict[str, object]:
+        pend_tabs = [u.get("pending") for u in units
+                     if u.get("pending")]
+        pending = None
+        if pend_tabs:
+            merged = {
+                k: np.concatenate([np.asarray(t[k])
+                                   for t in pend_tabs])
+                for k in pend_tabs[0]}
+            pending = merged
+        return {
+            # the OLDEST unit's horizon: its range replays from its
+            # position and must not be judged late
+            "emitted_wm": min((int(u.get("emitted_wm", _NEG))
+                               for u in units), default=_NEG),
+            "late_left_dropped": max(
+                (int(u.get("late_left_dropped", 0)) for u in units),
+                default=0),
+            "pending": pending,
+        }
+
+    def snapshot_sharded(self, mode: str = "full"):
+        units = super().snapshot_sharded(mode)
+        # pending left rows split by key group like table rows — each
+        # unit replays only its own range
+        for (g0, g1), unit in units.items():
+            pend = unit.get("pending")
+            if not pend:
+                continue
+            kid = np.asarray(pend[KEY_ID_FIELD], dtype=np.int64)
+            kg = assign_key_groups(kid, self.max_parallelism)
+            mask = (kg >= g0) & (kg <= g1)
+            unit["pending"] = {k: np.asarray(v)[mask]
+                               for k, v in pend.items()}
+        return units

@@ -1025,6 +1025,247 @@ class TestWatchdogPoints:
             _note_reached(c.faults_injected)
 
 
+class _IntervalJoinHarnessEngine:
+    """Adapts the device interval-join engine to the crash-restore
+    harness protocol: each step batch splits by row parity into the
+    two inputs (values carry the row's own timestamp, so every joined
+    pair lands in a unique ``(key, lts, rts)`` upsert cell — a lost or
+    duplicated pair changes the committed cells, never hides)."""
+
+    def __init__(self, backend="device", shards=2, **kw):
+        from flink_tpu.joins import MeshIntervalJoinEngine
+
+        if backend == "device":
+            from flink_tpu.parallel.mesh import make_mesh
+
+            self.eng = MeshIntervalJoinEngine(
+                -60, 60, mesh=make_mesh(shards), **kw)
+        else:
+            self.eng = MeshIntervalJoinEngine(
+                -60, 60, backend="host", num_shards=shards, **kw)
+        self._buf = []
+
+    @property
+    def P(self):
+        return self.eng.P
+
+    def reshard(self, n):
+        return self.eng.reshard(n)
+
+    def process_batch(self, batch):
+        left = np.arange(len(batch)) % 2 == 0
+        self._buf += self.eng.process_batch(batch.filter(left), 0)
+        self._buf += self.eng.process_batch(batch.filter(~left), 1)
+
+    def on_watermark(self, wm, async_ok=False):
+        from flink_tpu.core.records import (
+            KEY_ID_FIELD,
+            TIMESTAMP_FIELD,
+            RecordBatch,
+        )
+        from flink_tpu.windowing.windower import (
+            WINDOW_END_FIELD,
+            WINDOW_START_FIELD,
+        )
+
+        out = []
+        for b in self._buf:
+            lts = np.asarray(b["v_l"], dtype=np.int64)
+            rts = np.asarray(b["v_r"], dtype=np.int64)
+            out.append(RecordBatch({
+                KEY_ID_FIELD: b[KEY_ID_FIELD],
+                WINDOW_START_FIELD: lts,
+                WINDOW_END_FIELD: rts + 1,
+                TIMESTAMP_FIELD: b[TIMESTAMP_FIELD],
+                "val": np.asarray(b["v_l"])
+                + np.asarray(b["v_r"]),
+            }))
+        self._buf = []
+        self.eng.on_watermark(int(wm))
+        return out
+
+    def snapshot(self):
+        return self.eng.snapshot()
+
+    def restore(self, snap):
+        self.eng.restore(snap)
+        self._buf = []
+
+
+def _join_steps(n_steps=6, n=96, keys=24, seed=4):
+    """Harness steps whose values ARE the row timestamps. Event time
+    OVERLAPS across steps (step stride 60 < in-step span 96, band
+    +-60), so buffered rows of one step match probes of later steps —
+    a row lost at INGEST (after the arriving batch's own probe) still
+    changes the committed cells."""
+    rng = np.random.default_rng(seed)
+    steps = []
+    for i in range(n_steps):
+        ks = rng.integers(0, keys, n)
+        ts = i * 60 + np.arange(n, dtype=np.int64)
+        steps.append((ks, ts.astype(np.float32), ts, i * 60 - 300))
+    return steps
+
+
+class TestJoinExchangePoint:
+    """The two-input data plane's fault point at its real site
+    (JoinEngineBase._ingest): a raise crashes mid-batch with the join
+    put on the device queue — crash-restore must stay oracle-identical
+    — and a DROPPED side bucket must DIVERGE (the negative control:
+    the harness catches genuine loss in the join plane)."""
+
+    def test_join_job_crash_restore_oracle_identical(self, tmp_path):
+        # nth=7 = step 3's left ingest: past the first checkpoint, so
+        # the recovery is a genuine RESTORE (not a cold restart)
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="join.exchange", nth=7)])
+        report = run_crash_restore_verify(
+            make_engine=lambda: _IntervalJoinHarnessEngine("device"),
+            make_oracle=lambda: _IntervalJoinHarnessEngine("host"),
+            steps=_join_steps(), plan=plan, seed=7,
+            ckpt_root=str(tmp_path))
+        assert report.crashes >= 1 and report.restores >= 1
+        assert report.faults_injected.get("join.exchange", 0) >= 1
+        assert not report.diverged
+        assert report.windows > 0
+        _note_reached(report.faults_injected)
+
+    def test_join_job_crash_restore_is_deterministic(self, tmp_path):
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="join.exchange", nth=7)])
+        sigs = []
+        for i in range(2):
+            r = run_crash_restore_verify(
+                make_engine=lambda: _IntervalJoinHarnessEngine(
+                    "device"),
+                make_oracle=lambda: _IntervalJoinHarnessEngine(
+                    "host"),
+                steps=_join_steps(), plan=plan, seed=7,
+                ckpt_root=str(tmp_path / f"run{i}"))
+            sigs.append(r.signature())
+        assert sigs[0] == sigs[1]
+
+    def test_dropped_side_bucket_diverges(self, tmp_path):
+        # negative control: one shard's bucket of ONE side vanishes in
+        # flight — its pairs never form and the diff MUST catch it
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="join.exchange", nth=2, kind="drop",
+                      where={"side": 1})])
+        report = run_crash_restore_verify(
+            make_engine=lambda: _IntervalJoinHarnessEngine("device"),
+            make_oracle=lambda: _IntervalJoinHarnessEngine("host"),
+            steps=_join_steps(), plan=plan, seed=7,
+            ckpt_root=str(tmp_path), check=False)
+        assert report.faults_injected.get("join.exchange", 0) >= 1
+        assert report.diverged, (
+            "a dropped join-side bucket produced identical output — "
+            "the harness cannot catch join data-plane loss")
+        _note_reached(report.faults_injected)
+
+    def test_payload_injection_at_real_site(self):
+        from flink_tpu.core.records import (
+            KEY_ID_FIELD,
+            TIMESTAMP_FIELD,
+            RecordBatch,
+        )
+        from flink_tpu.joins import MeshIntervalJoinEngine
+
+        eng = MeshIntervalJoinEngine(-60, 60, backend="host",
+                                     num_shards=2)
+        b = RecordBatch({
+            KEY_ID_FIELD: np.arange(32, dtype=np.int64),
+            "v": np.ones(32, dtype=np.float32),
+            TIMESTAMP_FIELD: np.arange(32, dtype=np.int64)})
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="join.exchange", nth=1,
+                      kind="duplicate", where={"shard": 0})])
+        with chaos.chaos_active(plan, seed=0) as c:
+            eng.process_batch(b, 0)
+            assert c.faults_injected.get("join.exchange", 0) == 1
+            _note_reached(c.faults_injected)
+        # shard 0's rows were replayed in flight: more rows buffered
+        # than sent on that shard
+        assert sum(len(m) for m in eng.sides[0].meta) > 32
+
+
+class _TemporalJoinHarnessEngine:
+    """Temporal-join adapter: odd rows are versions, even rows probe;
+    matches emit at the watermark with the left time as the cell."""
+
+    def __init__(self, backend="device", shards=2, **kw):
+        from flink_tpu.joins import MeshTemporalJoinEngine
+
+        if backend == "device":
+            from flink_tpu.parallel.mesh import make_mesh
+
+            self.eng = MeshTemporalJoinEngine(
+                mesh=make_mesh(shards), **kw)
+        else:
+            self.eng = MeshTemporalJoinEngine(
+                backend="host", num_shards=shards, **kw)
+
+    @property
+    def P(self):
+        return self.eng.P
+
+    def process_batch(self, batch):
+        left = np.arange(len(batch)) % 2 == 0
+        self.eng.process_batch(batch.filter(~left), 1)
+        self.eng.process_batch(batch.filter(left), 0)
+
+    def on_watermark(self, wm, async_ok=False):
+        from flink_tpu.core.records import (
+            KEY_ID_FIELD,
+            TIMESTAMP_FIELD,
+            RecordBatch,
+        )
+        from flink_tpu.windowing.windower import (
+            WINDOW_END_FIELD,
+            WINDOW_START_FIELD,
+        )
+
+        out = []
+        for b in self.eng.on_watermark(int(wm)):
+            lts = np.asarray(b[TIMESTAMP_FIELD], dtype=np.int64)
+            out.append(RecordBatch({
+                KEY_ID_FIELD: b[KEY_ID_FIELD],
+                WINDOW_START_FIELD: lts,
+                WINDOW_END_FIELD: lts + 1,
+                TIMESTAMP_FIELD: lts,
+                "val": np.asarray(b["v_l"]) + np.asarray(b["v_r"]),
+            }))
+        return out
+
+    def snapshot(self):
+        return self.eng.snapshot()
+
+    def restore(self, snap):
+        self.eng.restore(snap)
+
+
+class TestJoinVersionedLookupPoint:
+    """The versioned-plane lookup fault point at its real site (the
+    temporal engine's watermark probe): a crash there happens with the
+    pending left buffer intact, so restore + replay stays
+    oracle-identical."""
+
+    def test_crash_at_versioned_lookup_restores_identical(
+            self, tmp_path):
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="join.versioned_lookup", nth=2)])
+        report = run_crash_restore_verify(
+            make_engine=lambda: _TemporalJoinHarnessEngine("device"),
+            make_oracle=lambda: _TemporalJoinHarnessEngine("host"),
+            steps=_join_steps(seed=5), plan=plan, seed=9,
+            ckpt_root=str(tmp_path))
+        assert report.crashes >= 1 and report.restores >= 1
+        assert report.faults_injected.get(
+            "join.versioned_lookup", 0) >= 1
+        assert not report.diverged
+        assert report.windows > 0
+        _note_reached(report.faults_injected)
+
+
 class TestZZFaultPointReachability:
     """Must run LAST in this file (pytest preserves definition order):
     every fault point of the CANONICAL inventory was injected somewhere

@@ -81,11 +81,13 @@ def row1_wordcount():
         .window(TumblingProcessingTimeWindows.of(5_000))
         .sum("one").sink_to(sink))
     t0 = time.perf_counter()
-    env.execute("wordcount")
+    result = env.execute("wordcount")
     dt = time.perf_counter() - t0
     words = n_lines * 10
     return {"metric": "wordcount_socket_words_per_sec",
-            "value": round(words / dt, 1), "unit": "words/s"}
+            "value": round(words / dt, 1), "unit": "words/s",
+            "fire_latency_ms": result.metrics.get(
+                "window_fire_latency_ms")}
 
 
 def row2_q5():
@@ -112,13 +114,19 @@ def row3_q7():
                         events_per_second_of_eventtime=100_000)
         build_q7(env, src, size_ms=10_000).sink_to(sink)
         t0 = time.perf_counter()
-        env.execute("q7")
-        return total / (time.perf_counter() - t0)
+        result = env.execute("q7")
+        return (total / (time.perf_counter() - t0),
+                result.metrics.get("window_fire_latency_ms"))
 
     run(1 << 20)  # warm
     total = int(10_000_000 * SCALE)
+    evps, lat = run(total)
+    # fire percentiles on EVERY windowed row: the matrix stays
+    # comparable (q5 reported them, q7 did not — and the latency-tier
+    # gate of ROADMAP item 2 needs this hook on each row)
     return {"metric": "nexmark_q7_max_join_events_per_sec_per_chip",
-            "value": round(run(total), 1), "unit": "events/s"}
+            "value": round(evps, 1), "unit": "events/s",
+            "fire_latency_ms": lat}
 
 
 def row4_sql_hop_kafka():
@@ -167,11 +175,19 @@ def row4_sql_hop_kafka():
         """).collect()
         dt = time.perf_counter() - t0
         assert len(rows) > 0
-        return total / dt
+        # the SQL collect path runs env.execute internally; the env
+        # keeps the job result so windowed SQL rows report fire
+        # percentiles like the DataStream rows
+        res = getattr(env, "last_execution_result", None)
+        return (total / dt,
+                res.metrics.get("window_fire_latency_ms")
+                if res is not None else None)
 
     run()  # warm
+    evps, lat = run()
     return {"metric": "sql_group_by_hop_over_kafka_events_per_sec",
-            "value": round(run(), 1), "unit": "events/s"}
+            "value": round(evps, 1), "unit": "events/s",
+            "fire_latency_ms": lat}
 
 
 def row5_sessions_10m_keys():
@@ -207,15 +223,17 @@ def row5_sessions_10m_keys():
            .window(EventTimeSessionWindows.with_gap(2_000))
            .sum("value").sink_to(sink))
         t0 = time.perf_counter()
-        env.execute("sessions")
+        result = env.execute("sessions")
         dt = time.perf_counter() - t0
         assert len(sink.result()) > 0
-        return n / dt
+        return n / dt, result.metrics.get("window_fire_latency_ms")
 
     run(1 << 20)  # warm
+    evps, lat = run(total)
     return {"metric":
             "session_clickstream_10m_keys_events_per_sec_per_chip",
-            "value": round(run(total), 1), "unit": "events/s",
+            "value": round(evps, 1), "unit": "events/s",
+            "fire_latency_ms": lat,
             "shape": "400k ev/s event time, 2 s gap, ~800k live "
                      "sessions vs 512k device budget (paged spill), "
                      "10M distinct keys"}
@@ -306,6 +324,39 @@ def row7_shard_loss_recovery():
     }
 
 
+def _join_rows():
+    """Both join rows from tools/bench_joins.py in ONE subprocess (the
+    mesh needs the virtual-device flag, like row5b; the tool prints one
+    JSON line per row)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.setdefault("BENCH_JOIN_RECORDS", str(int(4_000_000 * SCALE)))
+    env.setdefault("BENCH_JOIN_REQUIRE_SPILL", "1")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "bench_joins.py")],
+        capture_output=True, text=True, env=env, timeout=3600)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("{")]
+    if proc.returncode != 0 or len(lines) < 2:
+        raise RuntimeError((proc.stderr or proc.stdout).strip()[-300:])
+    return [json.loads(ln) for ln in lines[-2:]]
+
+
+_JOIN_CACHE = {}
+
+
+def _join_row(idx):
+    def run():
+        if "rows" not in _JOIN_CACHE:
+            _JOIN_CACHE["rows"] = _join_rows()
+        return _JOIN_CACHE["rows"][idx]
+
+    return run
+
+
 ROWS = [("wordcount_socket", row1_wordcount),
         ("nexmark_q5", row2_q5),
         ("nexmark_q7", row3_q7),
@@ -313,7 +364,9 @@ ROWS = [("wordcount_socket", row1_wordcount),
         ("sessions_10m_keys", row5_sessions_10m_keys),
         ("mesh_sessions_10m_keys", row5b_mesh_sessions),
         ("queryable_lookups", row6_queryable_lookups),
-        ("shard_loss_recovery", row7_shard_loss_recovery)]
+        ("shard_loss_recovery", row7_shard_loss_recovery),
+        ("nexmark_q8_windowed_join", _join_row(0)),
+        ("interval_join_10m_keys", _join_row(1))]
 
 
 def main():
@@ -372,6 +425,8 @@ def main():
                 extra += (f", native sweeps {bd['native_sweep_s']}s")
         if r.get("shuffle_mode"):
             extra += f", {r['shuffle_mode']}-mode shuffle"
+        if r.get("matches"):
+            extra += f" — {r['matches']:,} joined pairs"
         if r.get("fire_latency_ms"):
             lat = r["fire_latency_ms"]
             extra += (f" (fire p50 {lat['p50']:.0f} ms / "
@@ -430,6 +485,21 @@ def main():
         "against live keyed state; the tier-1 smoke runs the same "
         "script smaller and FAILS on any steady-state compile, p99 over "
         "budget, or quota violation (design note in NOTES_r10.md).")
+    lines.append("")
+    lines.append(
+        "Streaming-join rows (r14): `tools/bench_joins.py` drives the "
+        "device-native interval-join engine (`flink_tpu/joins/` — dual "
+        "keyed slot tables co-partitioned by the keyBy exchange, one "
+        "banded segment-intersection program per batch, design in "
+        "NOTES_r14.md). `fire_latency_ms` is the EMIT latency: wall "
+        "time from an arriving batch to its matches materialized on "
+        "the host (the two-input analogue of window fire latency — "
+        "every windowed row reports fire percentiles since r14, which "
+        "is also the hook ROADMAP item 2's latency gate needs). The "
+        "10M-key row forces paged eviction (live rows >> device "
+        "budget) and FAILS as vacuous if spill never engages; "
+        "`tools/join_smoke.py` gates the same engine bit-identical to "
+        "its host-numpy oracle in tier-1.")
     lines.append("")
     lines.append(
         "The shard-loss-recovery row runs `tools/chaos_smoke.py`'s "

@@ -244,6 +244,12 @@ HOT_ROOTS: Dict[str, Tuple[str, ...]] = {
                           "poll_pending_output"),
     "SessionWindowAggOperator": ("process_batch", "process_watermark"),
     "PendingFire": ("harvest", "ready"),
+    # the two-input join engines (flink_tpu/joins/engine.py): ingest,
+    # probe and prune all run per batch / per watermark
+    "MeshIntervalJoinEngine": ("process_batch", "on_watermark"),
+    "MeshTemporalJoinEngine": ("process_batch", "on_watermark"),
+    "JoinEngineBase": ("_ingest", "_probe_banded", "_dispatch_probe",
+                       "_make_headroom", "_gather_rows"),
 }
 
 #: module-level hot entry points: the device data plane's per-batch
@@ -265,6 +271,19 @@ HOT_MODULE_ROOTS: Dict[str, Tuple[str, ...]] = {
     "flink_tpu.windowing.session_native": (
         "native_absorb",
         "native_pop",
+    ),
+    # the join kernel builders: their closures ARE the per-batch
+    # compiled programs — a host sync creeping into the staging or
+    # builder path stalls every probe/ingest (rooting the module
+    # functions keeps them guarded even off-method)
+    "flink_tpu.joins.kernels": (
+        "_build_join_put",
+        "_build_join_exchange_put",
+        "_build_join_gather",
+        "_build_banded_probe",
+    ),
+    "flink_tpu.joins.side_table": (
+        "pair_lower_bound",
     ),
 }
 

@@ -1,0 +1,192 @@
+"""Join smoke (tier-1 gate): the device-native interval + temporal
+join engines against the host-numpy oracle.
+
+FAILS on:
+- ORACLE DIVERGENCE: any emitted batch differing — bit-for-bit,
+  including order — between the device engine (fused device-mode
+  exchange) and the host-backend oracle, for the interval engine
+  (under forced paged eviction) and the temporal engine (versioned
+  plane + late-row drops).
+- STEADY-STATE COMPILE: after the oracle pass warmed the shared
+  program cache, a FRESH device engine replaying the same stream must
+  compile ZERO XLA programs (the recompile-sentinel claim, scoped to
+  the join program family).
+- VACUOUS RUN: the spill tier must genuinely engage (rows evicted AND
+  cold candidates served from pages) — a shape drift that stops spill
+  from engaging would silently shrink what the gate covers.
+
+    JAX_PLATFORMS=cpu python tools/join_smoke.py
+    JOIN_SMOKE_STEPS=... JOIN_SMOKE_BATCH=... to scale.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+STEPS = int(os.environ.get("JOIN_SMOKE_STEPS", 8))
+BATCH = int(os.environ.get("JOIN_SMOKE_BATCH", 2048))
+KEYS = 40_000
+BUDGET = 512          # slots/shard/side — far below the live set
+BAND = 2500           # ms: deep enough to probe into the paged tier
+WM_LAG = 3000
+
+
+def _batch(rng, t, name):
+    from flink_tpu.core.records import (
+        KEY_ID_FIELD,
+        TIMESTAMP_FIELD,
+        RecordBatch,
+    )
+
+    keys = rng.integers(0, KEYS, BATCH).astype(np.int64)
+    ts = t + np.arange(BATCH, dtype=np.int64) // 4
+    return RecordBatch({
+        KEY_ID_FIELD: keys,
+        name: rng.random(BATCH).astype(np.float32),
+        TIMESTAMP_FIELD: ts,
+    }), int(ts[-1]) + 1
+
+
+def drive_interval(engine, seed=23):
+    rng = np.random.default_rng(seed)
+    out = []
+    t = 0
+    for _ in range(STEPS):
+        for side, name in ((0, "v"), (1, "w")):
+            b, t = _batch(rng, t, name)
+            out += engine.process_batch(b, side)
+        engine.on_watermark(t - WM_LAG)
+    return out
+
+
+def drive_temporal(engine, seed=29):
+    rng = np.random.default_rng(seed)
+    out = []
+    t = 0
+    for _ in range(STEPS):
+        b, _ = _batch(rng, t, "rate")
+        out += engine.process_batch(b, 1)
+        b, t = _batch(rng, t, "v")
+        out += engine.process_batch(b, 0)
+        out += engine.on_watermark(t - WM_LAG)
+    out += engine.on_watermark(1 << 40)
+    return out
+
+
+def diff_batches(got, want, label):
+    if len(got) != len(want):
+        return [f"{label}: {len(got)} batches vs oracle {len(want)}"]
+    errs = []
+    for i, (a, b) in enumerate(zip(got, want)):
+        if sorted(a.names()) != sorted(b.names()):
+            errs.append(f"{label}[{i}]: schema differs")
+            continue
+        if len(a) != len(b):
+            errs.append(f"{label}[{i}]: {len(a)} rows vs {len(b)}")
+            continue
+        for n in a.names():
+            if not np.array_equal(np.asarray(a[n]),
+                                  np.asarray(b[n])):
+                errs.append(f"{label}[{i}]: column {n} diverges")
+                break
+    return errs
+
+
+def main():
+    import warnings
+
+    warnings.filterwarnings("ignore")
+    import time
+
+    import jax
+
+    from flink_tpu.joins import (
+        MeshIntervalJoinEngine,
+        MeshTemporalJoinEngine,
+    )
+    from flink_tpu.observe import RecompileSentinel
+    from flink_tpu.parallel.mesh import make_mesh
+
+    P = min(len(jax.devices()), 8)
+    mesh = make_mesh(P)
+    errs = []
+
+    def mk_interval(backend):
+        kw = dict(capacity_per_shard=BUDGET, max_device_slots=BUDGET)
+        if backend == "device":
+            return MeshIntervalJoinEngine(-BAND, BAND, mesh=mesh,
+                                          **kw)
+        return MeshIntervalJoinEngine(-BAND, BAND, backend="host",
+                                      num_shards=P, **kw)
+
+    # ---- interval: device vs oracle, forced eviction ----
+    t0 = time.perf_counter()
+    dev = mk_interval("device")
+    got = drive_interval(dev)
+    want = drive_interval(mk_interval("host"))
+    errs += diff_batches(got, want, "interval")
+    matches = sum(len(b) for b in got)
+    sc = dev.spill_counters()
+    if matches == 0:
+        errs.append("interval: zero matches — vacuous run")
+    if sc["rows_evicted"] == 0:
+        errs.append("interval: spill never engaged (rows_evicted=0)")
+    if sc["cold_rows_served"] == 0:
+        errs.append("interval: no cold candidate ever served from "
+                    "the page tier — the band never reached spilled "
+                    "rows (vacuous spill coverage)")
+
+    # ---- temporal: device vs oracle ----
+    tdev = MeshTemporalJoinEngine(mesh=mesh,
+                                  capacity_per_shard=BUDGET,
+                                  max_device_slots=BUDGET)
+    tgot = drive_temporal(tdev)
+    twant = drive_temporal(MeshTemporalJoinEngine(
+        backend="host", num_shards=P, capacity_per_shard=BUDGET,
+        max_device_slots=BUDGET))
+    errs += diff_batches(tgot, twant, "temporal")
+    tmatches = sum(len(b) for b in tgot)
+    if tmatches == 0:
+        errs.append("temporal: zero matches — vacuous run")
+
+    # ---- steady state: a fresh engine compiles NOTHING ----
+    steady = mk_interval("device")
+    try:
+        with RecompileSentinel(
+                max_compiles=0, max_transfers=STEPS * 16,
+                label="join steady state") as s:
+            drive_interval(steady)
+        compiles = s.compiles
+    except Exception as e:  # SteadyStateViolation included
+        errs.append(f"steady-state: {e}")
+        compiles = -1
+
+    result = {
+        "join_smoke": "ok" if not errs else "FAIL",
+        "shards": P,
+        "interval_matches": matches,
+        "temporal_matches": tmatches,
+        "rows_evicted": sc["rows_evicted"],
+        "cold_rows_served": sc["cold_rows_served"],
+        "steady_state_compiles": compiles,
+        "seconds": round(time.perf_counter() - t0, 2),
+    }
+    print(json.dumps(result))
+    for e in errs:
+        print(f"FAIL: {e}", file=sys.stderr)
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -235,6 +235,91 @@ def check_device_shuffle_tiers(mesh, budget):
     return ok
 
 
+#: join-phase batch-size walks: same tier lattice, shifted lengths —
+#: a probe/ingest/eviction program keyed on anything finer than the
+#: (chunk, probe-bucket, band, mirror) tiers compiles mid-rep here
+JOIN_WALK_WARM = (4096, 2048, 1024, 3000, 1500, 900)
+JOIN_WALK_RUN = (4000, 2200, 1100, 2800, 1300, 1000)
+
+
+def _drive_join_sized(engine, sizes, offset, rng_seed=17):
+    """Two-sided interval-join stream: one left + one right batch per
+    entry of ``sizes``, event time advancing with a lagging watermark
+    so the band stays populated AND the spill tier genuinely engages
+    (keys >> budget)."""
+    from flink_tpu.core.records import (
+        KEY_ID_FIELD,
+        TIMESTAMP_FIELD,
+        RecordBatch,
+    )
+
+    rng = np.random.default_rng(rng_seed)
+    matches = 0
+    t = offset
+    for b in sizes:
+        for side, name in ((0, "v"), (1, "w")):
+            keys = rng.integers(0, NUM_KEYS, b).astype(np.int64)
+            ts = t + np.arange(b, dtype=np.int64) // RECORDS_PER_MS
+            out = engine.process_batch(RecordBatch({
+                KEY_ID_FIELD: keys,
+                name: np.ones(b, dtype=np.float32),
+                TIMESTAMP_FIELD: ts,
+            }), side)
+            matches += sum(len(x) for x in out)
+        t = int(ts[-1]) + 1
+        engine.on_watermark(t - 3000)
+    return matches
+
+
+def _make_join(mesh, budget):
+    from flink_tpu.joins import MeshIntervalJoinEngine
+
+    # band as deep as the pruning horizon: probes reach well past the
+    # resident (newest) rows into the paged tier, so cold service is
+    # part of the guarded steady state (the vacuity check below)
+    return MeshIntervalJoinEngine(
+        -2500, 2500, mesh=mesh, capacity_per_shard=max(budget // 4,
+                                                       256),
+        max_device_slots=max(budget // 4, 256))
+
+
+def check_join_phase(mesh, budget):
+    """Join phase: after one warmup engine walks every tier of the
+    banded-probe / ingest-exchange / eviction-gather program family
+    (both batch-size lists), a FRESH interval-join engine replaying
+    SHIFTED batch sizes — different lengths, same tier lattice — must
+    compile NOTHING. Spill is armed and ASSERTED (rows must evict and
+    cold candidates must serve from pages), so the eviction and
+    cold-probe paths are part of the guarded steady state."""
+    from flink_tpu.observe import RecompileSentinel
+
+    warm = _make_join(mesh, budget)
+    warm_matches = _drive_join_sized(warm, JOIN_WALK_WARM, offset=0)
+    warm_matches += _drive_join_sized(warm, JOIN_WALK_RUN,
+                                      offset=1 << 22)
+    ok = True
+    engine = _make_join(mesh, budget)
+    with RecompileSentinel(
+            max_compiles=0,
+            max_transfers=max(len(JOIN_WALK_RUN) * 16, 64),
+            label="join tier walk") as s:
+        matches = _drive_join_sized(engine, JOIN_WALK_RUN,
+                                    offset=1 << 23)
+    sc = engine.spill_counters()
+    print(f"  join tiers: matches={matches} compiles={s.compiles} "
+          f"transfers={s.transfers} "
+          f"rows_evicted={sc['rows_evicted']} "
+          f"cold_served={sc['cold_rows_served']}")
+    if matches == 0 or warm_matches == 0:
+        print("FAIL: join tiers: zero matches — vacuous run")
+        ok = False
+    if sc["rows_evicted"] == 0 or sc["cold_rows_served"] == 0:
+        print("FAIL: join tiers: spill never engaged — the eviction/"
+              "cold-probe kernels were not covered")
+        ok = False
+    return ok
+
+
 def check_second_job_on_warm_cluster(mesh, total, budget):
     """The tenancy contract: after job A warms the cluster (ingest,
     fire, evict AND serving programs), a SECOND job's fresh engines on
@@ -307,6 +392,11 @@ def main():
             mesh, budgets["mesh-sessions"]) and ok
     except Exception as e:  # SteadyStateViolation included
         print(f"FAIL: device-shuffle tiers: {e}")
+        ok = False
+    try:
+        ok = check_join_phase(mesh, budgets["mesh-sessions"]) and ok
+    except Exception as e:  # SteadyStateViolation included
+        print(f"FAIL: join tiers: {e}")
         ok = False
     try:
         ok = check_second_job_on_warm_cluster(

@@ -95,6 +95,14 @@ if [ "${SKIP_BENCH_SMOKE:-0}" != "1" ]; then
   JAX_PLATFORMS=cpu timeout -k 10 120 \
     python tools/autoscale_smoke.py || exit 1
 
+  # Join smoke: the device-native interval + temporal join engines vs
+  # the host-numpy oracle — FAILS on any bit divergence (values OR
+  # order), on a steady-state XLA compile after warmup, or on a
+  # vacuous run where the spill tier never engages (rows must evict
+  # AND cold band candidates must serve from pages). ~2 s on CPU.
+  JAX_PLATFORMS=cpu timeout -k 10 120 \
+    python tools/join_smoke.py || exit 1
+
   # Recompile sentinel: after one warmup rep, 2 measured reps on FRESH
   # engines (both mesh engines, spill armed, disarmed chaos) must show
   # ZERO XLA backend compiles and bounded device->host transfers —
