@@ -34,6 +34,9 @@ class SignalSample:
     busy_ms_total: float = 0.0
     backlog: float = 0.0
     shard_resident_rows: Sequence[int] = ()
+    #: recent window-fire p99 (ms) — instantaneous like backlog, passed
+    #: through to the policy's fire-latency signal (0 = no fires yet)
+    fire_latency_p99_ms: float = 0.0
 
 
 @dataclasses.dataclass
@@ -149,6 +152,7 @@ class AutoscaleController:
             backlog=sample.backlog,
             backlog_growth=(sample.backlog - prev.backlog) / dt,
             shard_resident_rows=sample.shard_resident_rows,
+            fire_latency_p99_ms=sample.fire_latency_p99_ms,
         )
 
     def tick(self, now: Optional[float] = None) -> Optional[RescaleEvent]:

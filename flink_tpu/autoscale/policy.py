@@ -51,6 +51,11 @@ class PolicyInput:
     backlog_growth: float = 0.0
     #: device-resident rows per shard (the key-imbalance signal)
     shard_resident_rows: Sequence[int] = ()
+    #: recent window-fire p99 in wall-clock ms (0 = no fires observed)
+    #: — the SECOND signal next to backlog: sustained misses of the
+    #: fire deadline are a capacity problem even when throughput keeps
+    #: up (the latency tier's autoscale hook, ROADMAP item 1)
+    fire_latency_p99_ms: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,7 +71,7 @@ class Decision:
 
 
 _STAY_REASONS = ("no-signal", "steady", "hysteresis", "cooldown",
-                 "imbalance")
+                 "imbalance", "fire-latency-hold")
 
 
 class ScalingPolicy:
@@ -89,6 +94,14 @@ class ScalingPolicy:
       keys harder.
     - **backlog_drain_s**: extra capacity is provisioned to drain the
       standing backlog within this horizon.
+    - **fire_deadline_ms / fire_breach_ticks**: the fire-latency
+      signal. When a deadline is set (> 0) and the sampled window-fire
+      p99 exceeds it for ``fire_breach_ticks`` CONSECUTIVE decisions,
+      scale up by half the current size even though the rate signal
+      says steady — a sustained deadline miss means fires are queueing
+      behind ingest, which more shards (smaller per-shard deltas)
+      relieve. While any breach streak is active, scale-DOWN decisions
+      are vetoed (a deadline-missing operator is not overprovisioned).
 
     ``clock`` is injectable (unit tests pass a fake); cooldown tracking
     is internal — call :meth:`mark_rescaled` after actually applying a
@@ -105,6 +118,8 @@ class ScalingPolicy:
         imbalance_limit: float = 2.0,
         backlog_drain_s: float = 60.0,
         backlog_threshold: float = 0.0,
+        fire_deadline_ms: float = 0.0,
+        fire_breach_ticks: int = 3,
         clock=None,
     ) -> None:
         import time as _time
@@ -125,8 +140,12 @@ class ScalingPolicy:
         self.imbalance_limit = float(imbalance_limit)
         self.backlog_drain_s = max(float(backlog_drain_s), 1.0)
         self.backlog_threshold = float(backlog_threshold)
+        self.fire_deadline_ms = max(float(fire_deadline_ms), 0.0)
+        self.fire_breach_ticks = max(int(fire_breach_ticks), 1)
         self._clock = clock or _time.monotonic
         self._last_rescale: Optional[float] = None
+        #: consecutive decisions whose fire p99 exceeded the deadline
+        self._fire_breaches = 0
 
     # --------------------------------------------------------------- helpers
 
@@ -164,6 +183,22 @@ class ScalingPolicy:
                 return Decision(cur, "cooldown")
             return Decision(bounded, "bounds")
 
+        # fire-latency signal: independent of the rate signal (fires can
+        # miss their deadline while throughput keeps up — the queueing
+        # problem the latency tier exists for)
+        if self.fire_deadline_ms > 0.0:
+            if inp.fire_latency_p99_ms > self.fire_deadline_ms:
+                self._fire_breaches += 1
+            else:
+                self._fire_breaches = 0
+            if self._fire_breaches >= self.fire_breach_ticks:
+                target = self._clamp(cur + max(cur // 2, 1))
+                if target > cur:
+                    if self.in_cooldown(now):
+                        return Decision(cur, "cooldown")
+                    self._fire_breaches = 0
+                    return Decision(target, "fire-latency")
+
         if inp.processing_rate <= 0.0 or inp.busy_fraction <= 0.0:
             return Decision(cur, "no-signal")
 
@@ -188,6 +223,10 @@ class ScalingPolicy:
         if self.in_cooldown(now):
             return Decision(cur, "cooldown")
         if target < cur:
+            if self._fire_breaches > 0:
+                # fires are missing their deadline: the operator is not
+                # overprovisioned, whatever the rate math says
+                return Decision(cur, "fire-latency-hold")
             imb = self.imbalance(inp.shard_resident_rows)
             if imb > self.imbalance_limit:
                 # the hot shard explains the load: scaling down would

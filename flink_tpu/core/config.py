@@ -193,6 +193,36 @@ class BatchOptions:
         "0 = poll sources inline on the task loop.")
 
 
+class LatencyOptions:
+    """The fire-latency tier: a watermark fire must cost a bounded delta,
+    not a full-window harvest, and must never queue behind a
+    multi-hundred-ms ingest dispatch (the Drizzle/Spark-Streaming
+    micro-batch latency/throughput trade, applied to the device state
+    plane — see README "Latency tier")."""
+
+    FIRE_DEADLINE_MS = ConfigOption(
+        "latency.fire-deadline-ms", default=0, type=int,
+        description="Fire-latency budget in wall-clock ms. When > 0 the "
+        "task loop splits each ingest micro-batch against this budget "
+        "using the measured per-record step rate, harvesting landed "
+        "async fires between the splits — a due fire is never stuck "
+        "behind a full batch dispatch. Also the deadline the autoscale "
+        "fire-latency signal judges p99 against. 0 (default) = whole "
+        "batches, fires harvested at batch boundaries only.")
+    PANE_PREAGG = ConfigOption(
+        "latency.pane-preagg", default=True, type=bool,
+        description="Incremental pane pre-aggregation for the panes "
+        "window layout (state.window-layout=panes): maintain per-window "
+        "running partials combined AT ABSORB, so a watermark fire "
+        "gathers ONE partial ring row (the pane that closes) instead of "
+        "merging the window's k slice rows (the full-window harvest). "
+        "The full-harvest path remains as the fallback for windows "
+        "without a maintained partial (and for this option = false). "
+        "Float sums fold in record order rather than per-slice order, "
+        "so f32 results can differ from the full harvest in the last "
+        "ulp (exact for count/min/max and integer-valued sums).")
+
+
 class ExecutionModeOptions:
     """Bounded/batch execution (reference: RuntimeExecutionMode.BATCH,
     the adaptive batch scheduler deciding parallelism from data volume —
@@ -407,6 +437,13 @@ class AutoscaleOptions:
         description="Refuse to scale DOWN while max/mean resident rows "
         "per shard exceeds this — a hot shard under key skew is not "
         "spare capacity.")
+    FIRE_BREACH_TICKS = ConfigOption(
+        "autoscale.fire-breach-ticks", default=3, type=int,
+        description="Consecutive policy ticks the fire-latency p99 must "
+        "exceed latency.fire-deadline-ms before the fire-latency signal "
+        "triggers a scale-up — a single slow harvest is noise, a "
+        "sustained deadline miss is a capacity problem even when "
+        "throughput keeps up.")
 
 
 class CheckpointOptions:

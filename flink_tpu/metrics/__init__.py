@@ -9,6 +9,7 @@ KNOWN_METRIC_GROUPS = (
     "state",
     "tenancy",
     "watchdog",
+    "window",
 )
 
 from flink_tpu.metrics.core import (  # noqa: E402,F401

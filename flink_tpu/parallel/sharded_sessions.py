@@ -39,6 +39,7 @@ from flink_tpu.ops.segment_ops import (
 from flink_tpu.parallel.mesh import KEY_AXIS, shard_map
 from flink_tpu.parallel.sharded_windower import (
     MeshPagedSpillSupport,
+    build_delta_fire_step,
     build_mesh_steps,
 )
 from flink_tpu.tenancy.program_cache import PROGRAM_CACHE
@@ -197,6 +198,10 @@ class MeshSessionEngine(MeshPagedSpillSupport):
          self._gather_step, self._put_step, self._merge_leaves_step,
          self._valued_scatter_step) = build_mesh_steps(self.mesh, self.agg)
         self._merge_step = build_session_merge_step(self.mesh, self.agg)
+        # delta-harvest family: fire + reset fused into ONE dispatch —
+        # a session fire pops only the sessions that close, merges and
+        # finishes them, and resets their slots in a single program
+        self._delta_fire_step = build_delta_fire_step(self.mesh, self.agg)
         # fused exchange+scatter (device shuffle mode) — built through
         # the shared program cache regardless of mode (cheap closure;
         # compiles lazily on first use)
@@ -238,6 +243,10 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         # batch boundary: the engine is consistent at a known source
         # position — the one point the watchdog may declare a shard dead
         self._wd_boundary()
+        if self._paged:
+            # page sweeps queued by fire-path extractions run HERE, on
+            # the ingest step, so fires stay bounded deltas
+            self._drain_deferred_sweeps()
         ts = np.asarray(batch.timestamps, dtype=np.int64)
         keys = np.asarray(batch.key_ids, dtype=np.int64)
         if self._spill_active and n > 1:
@@ -625,16 +634,19 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         W = sticky_bucket(w_max, self._fire_bucket, minimum=64)
         self._fire_bucket = W
         sm = np.zeros((self.P, W, 1), dtype=np.int32)
-        for p, slots in enumerate(per_shard_slots):
-            sm[p, : len(slots), 0] = slots
-        fire_out = self._fire_step(self.accs, self._put_sharded(sm))
-        # reset fired slots + free their index entries; the donated
-        # reset is device-queue-ordered BEHIND the fire kernel, so a
-        # deferred (async) host read never races it
-        self._freed_ns.append(sid_arr)
         rb = np.zeros((self.P, W), dtype=np.int32)
         for p, slots in enumerate(per_shard_slots):
+            sm[p, : len(slots), 0] = slots
             rb[p, : len(slots)] = slots
+        # delta harvest: fire + reset of exactly the closing sessions'
+        # slots in ONE fused program (build_delta_fire_step); the fire
+        # outputs are fresh buffers, so a deferred (async) host read
+        # never races the donated reset
+        self.accs, fire_out = self._delta_fire_step(
+            self.accs, self._put_sharded(sm), self._put_sharded(rb))
+        # free the fired slots' index entries (host bookkeeping)
+        self._freed_ns.append(sid_arr)
+        for p, slots in enumerate(per_shard_slots):
             if len(slots):
                 self._dirty[p, slots] = False
             if self._track_ns:
@@ -648,7 +660,6 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                                           sid_arr[per_shard_sel[p]])
                 else:
                     self.indexes[p].free_slots(slots)
-        self.accs = self._reset_step(self.accs, self._put_sharded(rb))
         # assemble the output batch in shard order
         st_arr = np.asarray(starts, dtype=np.int64)
         en_arr = np.asarray(ends, dtype=np.int64)
@@ -755,8 +766,9 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             if len(rslots):
                 idx.free_slots(rslots, keys=ks[hit], nss=ss[hit])
                 self._dirty[p, rslots] = False
-        # device part: fire + reset over resident rows only (the reset
-        # is queue-ordered behind the fire, so async reads never race)
+        # device part: fire + reset over resident rows only, fused into
+        # ONE delta-harvest program (the fire outputs are fresh buffers,
+        # so async reads never race the donated reset)
         fire_out = None
         if w_max:
             W = sticky_bucket(w_max, self._fire_bucket, minimum=64)
@@ -767,9 +779,8 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                 m = len(rslots)
                 sm[p, :m, 0] = rslots
                 rb[p, :m] = rslots
-            fire_out = self._fire_step(self.accs, self._put_sharded(sm))
-            self.accs = self._reset_step(self.accs,
-                                         self._put_sharded(rb))
+            self.accs, fire_out = self._delta_fire_step(
+                self.accs, self._put_sharded(sm), self._put_sharded(rb))
         # host finish over the COLD positions only (the resident
         # majority's finish already ran inside the device fire kernel)
         names = sorted(self.agg.output_names)

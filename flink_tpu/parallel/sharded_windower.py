@@ -1293,10 +1293,25 @@ class MeshPagedSpillSupport(MeshSpillSupport):
         #: one membership map (+ counters) per shard — spilled pages are
         #: shard-local like the device rows
         self._pmaps = [PagedSpillMap() for _ in range(self.P)]
+        # latency tier: fire-path extractions queue their page sweeps
+        # (reap/compact) instead of running them inline — the engine
+        # drains the queue on its next ingest step, keeping the fire
+        # span a bounded delta (space reclamation is time-insensitive)
+        for pm in self._pmaps:
+            pm.defer_sweeps = True
         #: [P, capacity] per-slot touch clocks (the paged analog of the
         #: namespace recency map)
         self._slot_touch = np.zeros((self.P, self.capacity),
                                     dtype=np.int64)
+
+    def _drain_deferred_sweeps(self) -> None:
+        """Run the page sweeps queued by fire-path extractions (ingest
+        boundary — see PagedSpillMap.defer_sweeps)."""
+        from flink_tpu.state.paged_spill import run_deferred_sweeps
+
+        for p, pm in enumerate(self._pmaps):
+            if pm.deferred_pages:
+                run_deferred_sweeps(self.spills[p], pm)
 
     def _paged_grow(self, new_capacity: int) -> None:
         if new_capacity <= self._slot_touch.shape[1]:
@@ -1904,30 +1919,45 @@ class MeshWindowEngine(MeshSpillSupport):
 
     # ------------------------------------------------------------------ fire
 
-    def on_watermark(self, watermark: int) -> List[RecordBatch]:
+    #: fires may be dispatched async (on_watermark(async_ok=True)
+    #: returns PendingFire handles): the fire kernel and its D2H copies
+    #: overlap the next ingest step's host prep, and the harvest is ONE
+    #: batched device_get once the copies land — the mesh window form
+    #: of the session engine's overlapped fire harvests (latency tier)
+    supports_async_fires = True
+
+    def on_watermark(self, watermark: int,
+                     async_ok: bool = False) -> List[RecordBatch]:
         self._wd_boundary()
         out: List[RecordBatch] = []
         while True:
             w_end = self.book.next_window(watermark)
             if w_end is None:
                 break
-            batch = self._fire_window(w_end)
-            if batch is not None and len(batch) > 0:
+            batch = self._fire_window(w_end, async_ok=async_ok)
+            if batch is not None and (not hasattr(batch, "__len__")
+                                      or len(batch) > 0):
                 out.append(batch)
             self.book.mark_fired(w_end)
         expired = self.book.expired_slices(watermark)
         if expired:
+            # the donated reset is device-queue-ordered BEHIND the fire
+            # kernels dispatched above, so a deferred (async) host read
+            # of the fire outputs never races the frees
             self._free_slices(expired)
         return out
 
-    def _fire_window(self, window_end: int) -> Optional[RecordBatch]:
+    def _fire_window(self, window_end: int,
+                     async_ok: bool = False) -> Optional[RecordBatch]:
         chaos.fault_point("mesh.window_fire", window_end=window_end)
         slice_ends = self.assigner.slice_ends_for_window(window_end)
         if self._any_spilled(slice_ends):
             # hybrid fire: resident slices merge on device (one kernel),
             # spilled slices merge on host — the device budget stays
             # independent of the window's slice count (the mesh form of
-            # SlotTable.fire_hybrid)
+            # SlotTable.fire_hybrid). Host-merged values are already on
+            # the host, so there is nothing to defer: stays synchronous
+            # inside an async on_watermark.
             return self._fire_window_hybrid(window_end, slice_ends)
         k = len(slice_ends)
         per_shard_mats: List[np.ndarray] = []
@@ -1959,35 +1989,48 @@ class MeshWindowEngine(MeshSpillSupport):
         sm = np.zeros((self.P, W, k), dtype=np.int32)
         for p, mat in enumerate(per_shard_mats):
             sm[p, : len(mat)] = mat
-        # ONE batched D2H for all result columns (device_get over the
-        # whole pytree; per-column np.asarray pays one RTT per column)
-        results = self._harvest_get(
-            self._fire_step(self.accs, self._put_sharded(sm)))
-        # assemble host batch
-        key_cols: List[np.ndarray] = []
-        res_cols: Dict[str, List[np.ndarray]] = {n: [] for n in results}
-        for p in range(self.P):
-            m = len(per_shard_keys[p])
-            if m == 0:
-                continue
-            key_cols.append(per_shard_keys[p])
-            for name, arr in results.items():
-                res_cols[name].append(arr[p][:m])
-        keys = np.concatenate(key_cols)
-        merged = {name: np.concatenate(chunks)
-                  for name, chunks in res_cols.items()}
-        if self.fire_projector is not None:
-            keys, merged = self.fire_projector.project_host(keys, merged)
-        m = len(keys)
-        cols = {
-            KEY_ID_FIELD: keys,
-            WINDOW_START_FIELD: np.full(
-                m, self.assigner.window_start(window_end), dtype=np.int64),
-            WINDOW_END_FIELD: np.full(m, window_end, dtype=np.int64),
-            TIMESTAMP_FIELD: np.full(m, window_end - 1, dtype=np.int64),
-        }
-        cols.update(merged)
-        return RecordBatch(cols)
+        fire_out = self._fire_step(self.accs, self._put_sharded(sm))
+        names = sorted(fire_out.keys())
+        projector = self.fire_projector
+        w_start = self.assigner.window_start(window_end)
+        per_keys = per_shard_keys  # host arrays, stable after dispatch
+
+        def build(host: List[np.ndarray]) -> Optional[RecordBatch]:
+            key_cols: List[np.ndarray] = []
+            res_cols: Dict[str, List[np.ndarray]] = {n: [] for n in names}
+            for p in range(len(per_keys)):
+                m = len(per_keys[p])
+                if m == 0:
+                    continue
+                key_cols.append(per_keys[p])
+                for name, arr in zip(names, host):
+                    res_cols[name].append(arr[p][:m])
+            keys = np.concatenate(key_cols)
+            merged = {name: np.concatenate(chunks)
+                      for name, chunks in res_cols.items()}
+            if projector is not None:
+                keys, merged = projector.project_host(keys, merged)
+            m = len(keys)
+            cols = {
+                KEY_ID_FIELD: keys,
+                WINDOW_START_FIELD: np.full(m, w_start, dtype=np.int64),
+                WINDOW_END_FIELD: np.full(m, window_end, dtype=np.int64),
+                TIMESTAMP_FIELD: np.full(m, window_end - 1,
+                                         dtype=np.int64),
+            }
+            cols.update(merged)
+            return RecordBatch(cols)
+
+        if async_ok:
+            from flink_tpu.runtime.pending import PendingFire
+
+            # overlapped fire harvest: the kernel + D2H copies run while
+            # the task loop keeps ingesting; the harvest is one batched
+            # device_get when the copies land (runtime/pending.py)
+            return PendingFire([fire_out[n] for n in names], build,
+                               watchdog=self._watchdog)
+        # sync path still batches all columns into ONE device_get
+        return build(self._harvest_get([fire_out[n] for n in names]))
 
     def _fire_window_hybrid(self, window_end: int,
                             slice_ends) -> Optional[RecordBatch]:
@@ -2539,4 +2582,53 @@ def _build_mesh_steps(mesh: Mesh, agg: AggregateFunction):
 
     return (scatter_step, fire_step, reset_step, gather_step,
             put_step, merge_step, valued_scatter_step)
+
+
+def build_delta_fire_step(mesh: Mesh, agg: AggregateFunction):
+    """The delta-harvest program: fire + reset FUSED into one compiled
+    program — ``merge+finish`` over each closing row's slots, then the
+    fired slots reset to identity, in a single dispatch (the separate
+    fire_step + reset_step pair paid two). The merged reads are data-
+    dependencies of the donated writes, so XLA orders them correctly;
+    the fire outputs are fresh buffers, safe for deferred (async)
+    harvest. Cached in the shared PROGRAM_CACHE per (devices, aggregate
+    layout) — family "delta-fire", 0 steady-state compiles (shapes ride
+    the same sticky fire buckets as the unfused pair)."""
+    cache_key = (tuple(d.id for d in mesh.devices.flat), agg.cache_key())
+    return PROGRAM_CACHE.get_or_build(
+        "delta-fire", cache_key, lambda: _build_delta_fire_step(mesh, agg))
+
+
+def _build_delta_fire_step(mesh: Mesh, agg: AggregateFunction):
+    merges = tuple(MERGE_FN[l.reduce] for l in agg.leaves)
+    idents = tuple(l.identity for l in agg.leaves)
+    finish = agg.finish
+    n_leaves = len(agg.leaves)
+    names = sorted(agg.output_names)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def delta_fire_step(accs, slot_matrix, reset_slots):
+        # slot_matrix: [P, W, k] sharded; reset_slots: [P, W] (padded
+        # lanes target the reserved identity slot 0 — reset is a no-op
+        # there). Returns (new accs, {name -> [P, W] result columns}).
+        def local(*args):
+            accs_l = args[:n_leaves]
+            sm = args[n_leaves][0]       # [W, k]
+            rs = args[n_leaves + 1][0]   # [W]
+            merged = tuple(
+                m(a[0][sm], axis=1) for a, m in zip(accs_l, merges))
+            out = finish(merged)
+            fresh = tuple(
+                a.at[0, rs].set(jnp.asarray(i, dtype=a.dtype))
+                for a, i in zip(accs_l, idents))
+            return fresh + tuple(out[name][None] for name in names)
+
+        outs = shard_map(
+            local, mesh=mesh,
+            in_specs=(P(KEY_AXIS),) * (n_leaves + 2),
+            out_specs=(P(KEY_AXIS),) * (n_leaves + len(names)),
+        )(*accs, slot_matrix, reset_slots)
+        return tuple(outs[:n_leaves]), dict(zip(names, outs[n_leaves:]))
+
+    return delta_fire_step
 

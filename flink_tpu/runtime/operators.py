@@ -85,7 +85,8 @@ class OperatorContext:
                  max_parallelism: int = 128, metrics=None,
                  async_fires: bool = False, max_dispatch_ahead: int = 4,
                  mesh=None, key_group_range=None, memory_manager=None,
-                 shuffle_mode: str = "device", watchdog=None):
+                 shuffle_mode: str = "device", watchdog=None,
+                 pane_preagg: bool = True):
         self.operator_index = operator_index
         self.parallelism = parallelism
         self.max_parallelism = max_parallelism
@@ -112,6 +113,12 @@ class OperatorContext:
         #: when watchdog.enabled — deadline-tracked device sections +
         #: batch-boundary shard-health probes; None = disabled
         self.watchdog = watchdog
+        #: incremental pane pre-aggregation for the panes window layout
+        #: (latency.pane-preagg): per-window running partials combined
+        #: at absorb, so a fire gathers one closing pane. The other
+        #: latency-tier knob (latency.fire-deadline-ms) lives on the
+        #: EXECUTOR, which owns the batch loop and the autoscale policy.
+        self.pane_preagg = pane_preagg
 
 
 class MapOperator(Operator):
@@ -209,6 +216,10 @@ class WindowAggOperator(Operator):
         from collections import deque
 
         self.fire_latencies_ms = deque(maxlen=8192)
+        #: monotonic fire-sample count — the reservoir above is BOUNDED
+        #: (its len saturates at maxlen), so counters and "any new
+        #: fires since last tick?" checks read this instead
+        self.fires_total = 0
         #: dispatched-but-unharvested fires (FIFO; see poll_pending_output)
         self._pending = deque()
         self._async_fires = False
@@ -298,7 +309,10 @@ class WindowAggOperator(Operator):
                     max_parallelism=ctx.max_parallelism,
                     allowed_lateness=self.allowed_lateness,
                     fire_projector=self.fire_projector,
-                    memory=self._managed_memory(ctx))
+                    memory=self._managed_memory(ctx),
+                    # latency tier: per-window partials combined at
+                    # absorb, fires gather one closing pane
+                    preagg=getattr(ctx, "pane_preagg", True))
             else:
                 self.windower = SliceSharedWindower(
                     self.assigner, self.agg, capacity=self.capacity,
@@ -438,6 +452,7 @@ class WindowAggOperator(Operator):
             # one sample per watermark advance, like the async path's one
             # sample per fire-to-harvest span
             self.fire_latencies_ms.append((_time.perf_counter() - t0) * 1e3)
+            self.fires_total += 1
         while len(self._pending) > self._max_pending:
             outs.extend(self._harvest_one())
         return outs
@@ -462,6 +477,7 @@ class WindowAggOperator(Operator):
         # the same span the synchronous path measures
         self.fire_latencies_ms.append(
             (_time.perf_counter() - pf.dispatched_at) * 1e3)
+        self.fires_total += 1
         if batch is None or len(batch) == 0:
             return []
         return [self._reattach_keys(batch)]

@@ -101,6 +101,14 @@ class PagedSpillMap:
         self._dead_count = 0
         self.sorted = True
         self.compact_dead_fraction = float(compact_dead_fraction)
+        #: latency tier: when set, the fire-path extraction QUEUES its
+        #: touched pages here instead of sweeping (reap/compact) them
+        #: inline — space reclamation is time-insensitive, so the owner
+        #: drains the queue on its next ingest step
+        #: (run_deferred_sweeps) and the fire span stays a bounded
+        #: delta instead of absorbing compaction bursts
+        self.defer_sweeps = False
+        self.deferred_pages: set = set()
         #: per-page physical row count (as stored) and live row count
         #: (still mapped); dead fraction = 1 - live/rows
         self.page_rows: Dict[int, int] = {}
@@ -191,9 +199,11 @@ class PagedSpillMap:
         and freed rows are tombstones — physically present, logically
         gone). Readers (snapshots, queries) filter through this."""
         rns = np.asarray(rns, dtype=np.int64)
+        self.sort()
+        # re-check AFTER sort: a fully-tombstoned map compresses to
+        # empty there (common with deferred fire-path sweeps)
         if not len(self.sp_ns):
             return np.zeros(len(rns), dtype=bool)
-        self.sort()
         mask, pos = sorted_match(self.sp_ns, rns)
         if self._dead_count:
             mask &= ~self.sp_dead[pos]
@@ -432,7 +442,10 @@ def reload_rows_for(spill, pmap: PagedSpillMap, nss: np.ndarray,
             leaf_chunks[i].append(
                 np.asarray(entry[f"leaf_{i}"], dtype=dt)[rows])
     touched = pmap.unmap_positions(pos)
-    _sweep_pages(spill, pmap, touched)
+    if pmap.defer_sweeps:
+        pmap.deferred_pages.update(touched)
+    else:
+        _sweep_pages(spill, pmap, touched)
     if not key_chunks:
         return None
     keys = np.concatenate(key_chunks)
@@ -455,7 +468,24 @@ def drop_spilled_sessions(spill, pmap: PagedSpillMap,
     if not len(pos):
         return
     touched = pmap.unmap_positions(pos)
-    _sweep_pages(spill, pmap, touched)
+    if pmap.defer_sweeps:
+        pmap.deferred_pages.update(touched)
+    else:
+        _sweep_pages(spill, pmap, touched)
+
+
+def run_deferred_sweeps(spill, pmap: PagedSpillMap) -> int:
+    """Drain the pages queued by fire-path extractions under
+    ``defer_sweeps`` — reap the fully-dead ones, compact the mostly-dead
+    ones. Called by the owning engine on its INGEST step (and harmless
+    to skip: tombstones stay valid, only space reclamation is delayed).
+    Returns pages swept."""
+    if not pmap.deferred_pages:
+        return 0
+    pages = sorted(pmap.deferred_pages)
+    pmap.deferred_pages.clear()
+    _sweep_pages(spill, pmap, pages)
+    return len(pages)
 
 
 def restore_into_pages(spill, pmap: PagedSpillMap, key_ids: np.ndarray,

@@ -128,8 +128,19 @@ def _pane_kernels(agg: AggregateFunction, projector=None):
             jnp.where(cols == 0, 0, 1).astype(jnp.int8))
         return tuple(out) + (presence,)
 
+    @partial(jax.jit, donate_argnums=(0,))
+    def fold_rows(accs, dst, rows):
+        # window-partial (re)build: dst row := merge of the given ring
+        # rows (overwrite semantics — dst is freshly allocated or being
+        # rebuilt from the authoritative panes). One dispatch per
+        # window, amortized one per slide period.
+        out = [a.at[dst].set(m(a[rows], axis=0))
+               for a, m in zip(accs[:n], merges)]
+        presence = accs[n].at[dst].set(accs[n][rows].max(axis=0))
+        return tuple(out) + (presence,)
+
     _JIT_CACHE[key] = fns = (scatter2d, scatter2d_valued, fire_rows,
-                             reset_row, put_row)
+                             reset_row, put_row, fold_rows)
     return fns
 
 
@@ -139,10 +150,14 @@ class PaneTable:
 
     def __init__(self, agg: AggregateFunction, capacity: int = 1 << 16,
                  max_parallelism: int = 128, fire_projector=None,
-                 memory=None):
+                 memory=None, slices_for_window=None):
         self.agg = agg
         self.max_parallelism = max_parallelism
         self.fire_projector = fire_projector
+        #: window_end -> slice ends (the assigner's mapping) — needed to
+        #: rebuild window-partial rows from the authoritative panes
+        #: after a restore or an internal compaction (preagg mode)
+        self._slices_for_window = slices_for_window
         #: (MemoryManager, owner) — the DENSE [R, capacity] per-leaf
         #: footprint (plus the int8 presence plane) is managed
         #: (flink_tpu/core/memory.py), the layout most likely to exhaust
@@ -158,6 +173,12 @@ class PaneTable:
         ) + (jnp.zeros((self.R, self.capacity), dtype=jnp.int8),)
         #: slice_end -> ring row (row 0 reserved identity)
         self.slice_row: Dict[int, int] = {}
+        #: window_end -> ring row holding the window's RUNNING PARTIAL
+        #: (incremental pane pre-aggregation: combined at absorb so a
+        #: fire gathers exactly the one pane that closes). Derived
+        #: state — snapshots ignore it, restore/compaction rebuild it
+        #: from the panes.
+        self.window_row: Dict[int, int] = {}
         self._free_rows: List[int] = list(range(self.R - 1, 0, -1))
         self._dirty_slices: set = set()
         self._freed_ns: List[int] = []
@@ -166,7 +187,8 @@ class PaneTable:
         #: allocations stay contiguous from 1)
         self._high_water = 1
         (self._scatter2d, self._scatter2d_valued, self._fire_rows,
-         self._reset_row, self._put_row) = _pane_kernels(agg, fire_projector)
+         self._reset_row, self._put_row,
+         self._fold_rows) = _pane_kernels(agg, fire_projector)
 
     # ---------------------------------------------------------------- sizing
 
@@ -195,7 +217,7 @@ class PaneTable:
         self.accs = tuple(grown) + (
             jnp.concatenate([self.accs[-1], pad], axis=1),)
 
-    def _alloc_row(self, slice_end: int) -> int:
+    def _take_row(self) -> int:
         if not self._free_rows:
             old = self.R
             self._reserve_cells(old * self.capacity)  # doubling the ring
@@ -209,8 +231,16 @@ class PaneTable:
             self.accs = tuple(grown) + (
                 jnp.concatenate([self.accs[-1], pad], axis=0),)
             self._free_rows = list(range(self.R - 1, old - 1, -1))
-        row = self._free_rows.pop()
+        return self._free_rows.pop()
+
+    def _alloc_row(self, slice_end: int) -> int:
+        row = self._take_row()
         self.slice_row[int(slice_end)] = row
+        return row
+
+    def _alloc_window_row(self, window_end: int) -> int:
+        row = self._take_row()
+        self.window_row[int(window_end)] = row
         return row
 
     @property
@@ -248,11 +278,7 @@ class PaneTable:
             (self.slice_row[int(se)] for se in uniq.tolist()),
             dtype=np.int64, count=len(uniq))
         rows = uniq_rows[inv]
-        if self.R * self.capacity > np.iinfo(np.int32).max:
-            raise RuntimeError(
-                f"pane table exceeds int32 flat-index range "
-                f"(ring={self.R} x capacity={self.capacity}); lower "
-                "state.slot-table.capacity or the window's slice count")
+        self._check_flat_range()
         return (rows * self.capacity + cols).astype(np.int32)
 
     def ingest_indices(self, key_ids: np.ndarray, timestamps: np.ndarray,
@@ -278,11 +304,7 @@ class PaneTable:
                 self._alloc_row(se)
             self._dirty_slices.add(se)
             rowmap[j] = self.slice_row[se]
-        if self.R * self.capacity > np.iinfo(np.int32).max:
-            raise RuntimeError(
-                f"pane table exceeds int32 flat-index range "
-                f"(ring={self.R} x capacity={self.capacity}); lower "
-                "state.slot-table.capacity or the window's slice count")
+        self._check_flat_range()
         flat = self.index.flat_fuse(cols, sinv, rowmap, self.capacity)
         return flat, uniq, sinv
 
@@ -331,6 +353,132 @@ class PaneTable:
             tuple(pad_values(np.asarray(v, dtype=l.dtype), size, l.identity)
                   for v, l in zip(values, self.agg.leaves)))
 
+    # ------------------------------------- incremental pane pre-aggregation
+
+    def has_window_partial(self, window_end: int) -> bool:
+        return int(window_end) in self.window_row
+
+    def _check_flat_range(self) -> None:
+        if self.R * self.capacity > np.iinfo(np.int32).max:
+            raise RuntimeError(
+                f"pane table exceeds int32 flat-index range "
+                f"(ring={self.R} x capacity={self.capacity}); lower "
+                "state.slot-table.capacity or the window's slice count")
+
+    def window_flat(self, cols: np.ndarray, sinv: np.ndarray,
+                    wins_per_slice):
+        """Flat scatter indices folding each record into its live
+        windows' PARTIAL rows (combine-on-absorb). ``cols`` are the
+        records' key columns (``flat %% capacity``), ``sinv`` the
+        unique-slice inverse, ``wins_per_slice`` one list of window
+        ends per unique slice — only windows that already HAVE a
+        partial row receive direct folds (missing ones are rebuilt
+        from the authoritative panes after the scatter). Returns
+        ``(flat, rec_idx)`` or None when nothing folds."""
+        chunks_f: List[np.ndarray] = []
+        chunks_i: List[np.ndarray] = []
+        order = np.argsort(sinv, kind="stable")
+        counts = np.bincount(sinv, minlength=len(wins_per_slice))
+        offs = np.concatenate(([0], np.cumsum(counts)))
+        C = self.capacity
+        self._check_flat_range()
+        for j, wins in enumerate(wins_per_slice):
+            if not wins:
+                continue
+            sel = order[offs[j]:offs[j + 1]]
+            if not len(sel):
+                continue
+            c = cols[sel].astype(np.int64)
+            for w in wins:
+                row = self.window_row.get(int(w))
+                if row is None:
+                    continue
+                # pad lanes (col 0) stay on the identity column of the
+                # window row: (flat %% C) == 0 keeps them pure
+                chunks_f.append((row * C + c).astype(np.int32))
+                chunks_i.append(sel)
+        if not chunks_f:
+            return None
+        return np.concatenate(chunks_f), np.concatenate(chunks_i)
+
+    def scatter_combined(self, flat: np.ndarray, win,
+                         values: Tuple[np.ndarray, ...],
+                         valued: bool = False) -> None:
+        """One scatter covering the pane cells AND the window-partial
+        cells: the window half replicates each record's value through
+        ``rec_idx`` (see window_flat), so the whole batch still costs
+        ONE flat index array over the link and ONE dispatch."""
+        if win is None:
+            return self.scatter_flat(flat, values, valued)
+        flat_w, rec_idx = win
+        flat_all = np.concatenate([flat, flat_w])
+        vals = tuple(np.concatenate([np.asarray(v), np.asarray(v)[rec_idx]])
+                     for v in values)
+        self.scatter_flat(flat_all, vals, valued)
+
+    def rebuild_window_partials(self, window_ends) -> int:
+        """(Re)build partial rows for pending windows that lack one —
+        fold of the window's pane rows (the panes are authoritative:
+        this is exactly the full-window harvest, landed into a ring row
+        instead of the host). Runs after restore, after compaction, and
+        for windows newly pending this batch (including late
+        re-registrations under allowed lateness). Returns rows built."""
+        if self._slices_for_window is None:
+            return 0
+        built = 0
+        # sorted: ring-row allocation order must be deterministic
+        # (window_ends may arrive as a set)
+        for w in sorted(int(x) for x in window_ends):
+            if w in self.window_row:
+                continue
+            rows = [self.slice_row.get(int(se), 0)
+                    for se in self._slices_for_window(w)]
+            if not any(rows):
+                continue  # no pane data: the fire falls back / emits nothing
+            dst = self._alloc_window_row(w)
+            self.accs = self._fold_rows(
+                self.accs, dst,
+                jnp.asarray(np.asarray(rows, dtype=np.int32)))
+            built += 1
+        return built
+
+    def release_window_row(self, window_end: int) -> None:
+        """Reset + free a fired window's partial row (queue-ordered
+        behind the fire kernel, so deferred harvests never race it)."""
+        row = self.window_row.pop(int(window_end), None)
+        if row is None:
+            return
+        self.accs = self._reset_row(self.accs, row)
+        self._free_rows.append(row)
+
+    def clear_window_rows(self) -> None:
+        for w in list(self.window_row):
+            self.release_window_row(w)
+
+    def fire_partial(self, window_end: int
+                     ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """Delta fire: gather ONE partial ring row — the pane that
+        closes — instead of merging the window's k slice rows. The row
+        is released after the fire (a fired window's partial is spent;
+        a late re-registration rebuilds it from the retained panes)."""
+        row = self.window_row.get(int(window_end))
+        if row is None:
+            return np.empty(0, dtype=np.int64), {}
+        out = self._harvest_rows(np.asarray([row], dtype=np.int32))
+        self.release_window_row(window_end)
+        return out
+
+    def fire_partial_async(self, window_end: int):
+        """Async delta fire: PendingFire (or None) whose harvest yields
+        (keys, result columns); the row release is dispatched right
+        after the fire kernel (device-queue-ordered behind it)."""
+        row = self.window_row.get(int(window_end))
+        if row is None:
+            return None
+        pf = self._harvest_rows_async(np.asarray([row], dtype=np.int32))
+        self.release_window_row(window_end)
+        return pf
+
     def make_fence(self):
         """Dispatch-depth fence (see SlotTable.make_fence): a [1, 1] slice
         of the live accumulator, enqueued behind all prior work."""
@@ -353,10 +501,29 @@ class PaneTable:
             dtype=np.int32)
         if not rows.any():
             return np.empty(0, dtype=np.int64), {}
+        return self._harvest_rows(rows)
+
+    def fire_window_async(self, slice_ends: List[int]):
+        """Async-dispatch variant of fire_window: returns a PendingFire
+        (or None for a no-op window) whose harvest yields (keys, result
+        columns)."""
+        rows = np.asarray(
+            [self.slice_row.get(int(se), 0) for se in slice_ends],
+            dtype=np.int32)
+        if not rows.any():
+            return None
+        return self._harvest_rows_async(rows)
+
+    def _harvest_rows(self, rows: np.ndarray
+                      ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """Merge+finish the given ring rows and materialize (keys,
+        result columns) — THE one sync harvest body, shared by the
+        full-window fire (k slice rows) and the delta fire (one partial
+        row), so projector/harvest semantics cannot drift between the
+        two paths. One batched device_get: each independent read costs
+        a full link RTT, batched reads pipeline into ~one."""
         used = self.used_cols
         out = self._fire_rows(self.accs, jnp.asarray(rows), used)
-        # one batched device_get: each independent read costs a full link
-        # RTT, batched reads pipeline into ~one
         if self.fire_projector is None:
             cols, valid = out
             names = list(cols)
@@ -373,19 +540,13 @@ class PaneTable:
         return keys, {name: c[sel]
                       for name, c in zip(names, host[2:])}
 
-    def fire_window_async(self, slice_ends: List[int]):
-        """Async-dispatch variant of fire_window: returns a PendingFire
-        (or None for a no-op window) whose harvest yields (keys, result
-        columns). The key rows backing the result are snapshotted at
-        dispatch (keys are append-only, so rows < used never mutate, but
-        the copy also survives an index grow/realloc)."""
+    def _harvest_rows_async(self, rows: np.ndarray):
+        """Async form of :meth:`_harvest_rows`: dispatch + PendingFire.
+        The key rows backing the result are snapshotted at dispatch
+        (keys are append-only, so rows < used never mutate, but the
+        copy also survives an index grow/realloc)."""
         from flink_tpu.runtime.pending import PendingFire
 
-        rows = np.asarray(
-            [self.slice_row.get(int(se), 0) for se in slice_ends],
-            dtype=np.int32)
-        if not rows.any():
-            return None
         used = self.used_cols
         out = self._fire_rows(self.accs, jnp.asarray(rows), used)
         if self.fire_projector is None:
@@ -464,11 +625,13 @@ class PaneTable:
             return
         snap = self.snapshot(reset_dirty=False)
         dirty, freed = self._dirty_slices, self._freed_ns
+        wins = sorted(self.window_row)  # derived rows: rebuilt below
         self.index = make_slot_index(self.index.capacity,
                                      on_grow=self._grow_cols)
         self.capacity = self.index.capacity
         self._high_water = 1
         self.slice_row = {}
+        self.window_row = {}
         self._free_rows = list(range(self.R - 1, 0, -1))
         self.accs = tuple(
             jnp.full((self.R, self.capacity), l.identity, dtype=l.dtype)
@@ -479,6 +642,9 @@ class PaneTable:
         # slice moved, so they are all dirty vs the last base
         self._dirty_slices = set(dirty) | set(self.slice_row)
         self._freed_ns = freed
+        # window partials are derived state — refold them from the
+        # compacted panes (preagg mode; no-op without the mapping)
+        self.rebuild_window_partials(wins)
 
     # ------------------------------------------------------------ point query
 

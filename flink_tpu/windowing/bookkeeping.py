@@ -101,6 +101,15 @@ class SliceBookkeeper:
 
     # -------------------------------------------------------------------- fire
 
+    def pending_windows(self) -> Set[int]:
+        """Window ends currently scheduled to fire (a read-only view of
+        the live set — do not mutate) — the set the pane pre-aggregation
+        keeps a running partial row for (windowing/windower.py
+        PaneWindower; includes late re-registrations). Consumers needing
+        deterministic order sort it themselves (rebuild_window_partials
+        does)."""
+        return self._pending_set
+
     def next_window(self, watermark: int) -> Optional[int]:
         """Pop the next window due at ``watermark`` (end-1 <= watermark)."""
         self.watermark = max(self.watermark, watermark)

@@ -334,13 +334,24 @@ class PaneWindower(SliceSharedWindower):
     rows — no host-built slot matrix, no per-fire host->device transfer —
     and freeing an expired slice is one index-free row reset.
 
+    With ``preagg`` (latency.fire-deadline tier, default on), the layout
+    additionally maintains a RUNNING PARTIAL ring row per pending window,
+    combined at absorb: each record scatters into its pane AND into every
+    pending window containing that pane, in the same single flat-index
+    dispatch. A watermark fire then gathers exactly ONE ring row — the
+    pane that closes — instead of merging the window's k slice rows (the
+    full-window harvest, which remains the fallback for windows without a
+    maintained partial and for ``preagg=False``). Partials are DERIVED
+    state: snapshots carry only the panes, restore/compaction refold the
+    pending windows' rows from them, and a late re-registration under
+    allowed lateness refolds too. Float sums fold in record order rather
+    than per-slice order, so f32 results can differ from the full harvest
+    in the last ulp (count/min/max and integer-valued sums are exact).
+
     Opt-in via state.window-layout=panes for aligned (non-merging)
     assigners without a spill tier at parallelism 1 ('auto' resolves to
     the slot layout until hardware measurements land); the slot layout
-    stays the engine for sessions, spill, and the mesh. Only table
-    construction
-    and the per-window fire differ — ingest, watermark loop, queries and
-    snapshots are inherited.
+    stays the engine for sessions, spill, and the mesh.
     """
 
     def __init__(
@@ -352,20 +363,88 @@ class PaneWindower(SliceSharedWindower):
         allowed_lateness: int = 0,
         fire_projector=None,
         memory=None,
+        preagg: bool = True,
     ) -> None:
         from flink_tpu.state.pane_table import PaneTable
 
         self.assigner = assigner
         self.agg = agg
+        # pre-aggregation only pays when windows SHARE panes: for
+        # single-slice (tumbling) windows the partial would be an exact
+        # duplicate of the pane — double the scatter volume and ring
+        # rows for a fire that already gathers one row (k == 1)
+        self._preagg = bool(preagg) and int(
+            getattr(assigner, "slices_per_window", 1)) > 1
         self.table = PaneTable(agg, capacity=capacity,
                                max_parallelism=max_parallelism,
                                fire_projector=fire_projector,
-                               memory=memory)
+                               memory=memory,
+                               slices_for_window=(
+                                   assigner.slice_ends_for_window
+                                   if self._preagg else None))
         self.book = SliceBookkeeper(assigner, allowed_lateness)
         self.fire_projector = fire_projector
 
+    # --------------------------------------------------------------- ingest
+
+    def process_batch(self, batch: RecordBatch) -> None:
+        if not self._preagg:
+            return super().process_batch(batch)
+        n = len(batch)
+        if n == 0:
+            return
+        table = self.table
+        flat = uniq = sinv = None
+        fused = getattr(table, "ingest_indices", None)
+        if fused is not None:
+            out = fused(batch.key_ids, batch.timestamps,
+                        self.assigner.offset, self.assigner.slice_width)
+            if out is not None:
+                flat, uniq, sinv = out
+                self._register_fused(uniq, sinv)
+        if flat is None:
+            slice_ends = self.assigner.assign_slice_ends(batch.timestamps)
+            live = self.book.live_mask(slice_ends)
+            if live is not None:
+                slice_ends = slice_ends[live]
+                batch = batch.filter(live)
+                if len(batch) == 0:
+                    return
+            plan = self.assigner.slice_plan(slice_ends)
+            self.book.register_slices(slice_ends, uniq=plan[0])
+            uniq, sinv = plan
+            flat = table._flat_indices(batch.key_ids, slice_ends, plan)
+        # combine-on-absorb: fold each record into its pending windows'
+        # partial rows in the SAME scatter. Only windows that already
+        # have a row get direct folds — everything else (new windows,
+        # late re-registrations, restored/compacted state) is refolded
+        # from the authoritative panes right after.
+        pending = self.book.pending_windows()
+        wins = [[w for w in self.assigner.window_ends_for_slice(int(se))
+                 if w in pending and table.has_window_partial(w)]
+                for se in uniq.tolist()]
+        win = table.window_flat(flat % np.int32(table.capacity), sinv,
+                                wins)
+        if is_partial_batch(batch):
+            table.scatter_combined(
+                flat, win, partial_leaf_values(batch, self.agg),
+                valued=True)
+        else:
+            table.scatter_combined(flat, win, self.agg.map_input(batch))
+        table.rebuild_window_partials(pending)
+
+    # ----------------------------------------------------------------- fire
+
     def _fire_window(self, window_end: int,
                      async_ok: bool = False) -> Optional[RecordBatch]:
+        if self._preagg and self.table.has_window_partial(window_end):
+            # delta harvest: ONE ring row — the pane that closes
+            if async_ok:
+                return self._wrap_pending(
+                    self.table.fire_partial_async(window_end), window_end)
+            keys, results = self.table.fire_partial(window_end)
+            return self._assemble(window_end, keys, results)
+        # full-window harvest (fallback: preagg off, or no partial row)
         slice_ends = [int(se)
                       for se in self.assigner.slice_ends_for_window(
                           window_end)]
@@ -373,6 +452,10 @@ class PaneWindower(SliceSharedWindower):
             return self._wrap_pending(
                 self.table.fire_window_async(slice_ends), window_end)
         keys, results = self.table.fire_window(slice_ends)
+        return self._assemble(window_end, keys, results)
+
+    def _assemble(self, window_end: int, keys,
+                  results) -> Optional[RecordBatch]:
         if len(keys) == 0:
             return None
         m = len(keys)
@@ -385,3 +468,15 @@ class PaneWindower(SliceSharedWindower):
         }
         cols.update(results)
         return RecordBatch(cols)
+
+    # ------------------------------------------------------------- snapshot
+
+    def restore(self, snap, key_group_filter=None) -> None:
+        if self._preagg:
+            # partial rows are derived: drop any stale ones, land the
+            # panes, then refold the pending windows' partials
+            self.table.clear_window_rows()
+        super().restore(snap, key_group_filter=key_group_filter)
+        if self._preagg:
+            self.table.rebuild_window_partials(
+                self.book.pending_windows())

@@ -1,0 +1,556 @@
+"""The fire-latency tier (ROADMAP item 1): incremental pane
+pre-aggregation, fire-deadline-aware micro-batching, overlapped fire
+harvests, and the fire-latency autoscale signal.
+
+Pins:
+
+- the pane layout's DELTA fire (per-window running partials combined at
+  absorb, one closing ring row gathered per fire) is bit-identical to
+  the full-window harvest AND to the slot-layout oracle — values and
+  emission order — on integer-valued data (float sums refold in record
+  order, exact there), across restore-rebuild and late re-firing;
+- the mesh window engine's async fires (PendingFire) equal its sync
+  fires exactly;
+- the mesh session engine's fused delta-fire program family lives in
+  the shared PROGRAM_CACHE (kind "delta-fire");
+- a fire-deadline-split run (latency.fire-deadline-ms) is output-
+  identical to the unsplit run — values AND order — including under
+  forced paged eviction, and matches the single-device oracle's values;
+- crash-restore-verify over a ``harvest.pending_fire`` chaos fault on
+  the async delta-harvest path (forced eviction; with and without a
+  mid-stream reshard) stays oracle-identical and seed-deterministic;
+- the autoscale policy's fire-latency signal: sustained deadline
+  breaches scale up, an active breach vetoes scale-down, cooldown
+  holds;
+- the ``window`` metric group exposes fire-latency p50/p99 gauges fed
+  from the operator reservoir.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.records import KEY_ID_FIELD, RecordBatch
+from flink_tpu.windowing.aggregates import (
+    CountAggregate,
+    MultiAggregate,
+    SumAggregate,
+)
+from flink_tpu.windowing.assigners import SlidingEventTimeWindows
+from flink_tpu.windowing.windower import PaneWindower, SliceSharedWindower
+
+
+def _int_events(n=4000, keys=150, seed=5, rate=1000):
+    """Integer-valued float32 payloads: exact under any fold order, so
+    delta-vs-full comparisons can demand BITWISE equality."""
+    rng = np.random.default_rng(seed)
+    ks = rng.integers(0, keys, n).astype(np.int64)
+    ts = (np.arange(n, dtype=np.int64) * 1000) // rate
+    vs = rng.integers(0, 16, n).astype(np.float32)
+    return RecordBatch.from_pydict(
+        {KEY_ID_FIELD: ks, "v": vs}, timestamps=ts)
+
+
+AGG = lambda: MultiAggregate(  # noqa: E731
+    [SumAggregate("v", output="s"), CountAggregate(output="n")])
+
+
+def _drive_rows(w, batch, step=800, wm_lag=500, async_ok=False,
+                flush=True):
+    """Feed in chunks with advancing watermarks; returns the emitted
+    rows IN EMISSION ORDER (the order pin) as (key, ws, we, s, n)."""
+    rows = []
+
+    def collect(fired):
+        for b in fired:
+            if b is None:
+                continue
+            if hasattr(b, "harvest"):
+                b = b.harvest()
+                if b is None:
+                    continue
+            for r in b.to_rows():
+                rows.append((r[KEY_ID_FIELD], r["window_start"],
+                             r["window_end"], float(r["s"]), int(r["n"])))
+
+    n = len(batch)
+    for i in range(0, n, step):
+        chunk = batch.slice(i, min(i + step, n))
+        w.process_batch(chunk)
+        kw = {"async_ok": True} if async_ok else {}
+        collect(w.on_watermark(
+            int(chunk.timestamps.max()) - wm_lag, **kw))
+    if flush:
+        kw = {"async_ok": True} if async_ok else {}
+        collect(w.on_watermark(1 << 60, **kw))
+    return rows
+
+
+class TestPaneDeltaFire:
+    def test_delta_bit_identical_to_full_harvest_and_slot_oracle(self):
+        batch = _int_events()
+        assigner = lambda: SlidingEventTimeWindows.of(2000, 500)  # noqa
+        delta = _drive_rows(PaneWindower(assigner(), AGG(),
+                                         capacity=2048, preagg=True),
+                            batch)
+        full = _drive_rows(PaneWindower(assigner(), AGG(),
+                                        capacity=2048, preagg=False),
+                           batch)
+        slot = _drive_rows(SliceSharedWindower(assigner(), AGG(),
+                                               capacity=2048), batch)
+        # values AND emission order, bitwise (integer-valued sums)
+        assert delta == full and len(delta) > 100
+        # vs the slot-layout oracle: same windows/keys/values bitwise;
+        # within-window key order differs between LAYOUTS by design
+        # (the slot fire sorts keys, the pane fire emits column order)
+        assert sorted(delta) == sorted(slot)
+
+    def test_fires_gather_one_partial_row(self):
+        batch = _int_events(n=1500)
+        w = PaneWindower(SlidingEventTimeWindows.of(2000, 500), AGG(),
+                         capacity=1024, preagg=True)
+        w.process_batch(batch)
+        # partial rows are maintained for the pending windows
+        assert len(w.table.window_row) > 0
+        pending = set(w.book.pending_windows())
+        assert set(w.table.window_row).issubset(pending)
+        _drive_rows(w, batch.slice(0, 0))  # final watermark only
+        # fired windows release their partial rows
+        assert len(w.table.window_row) == 0
+
+    def test_async_delta_equals_sync(self):
+        batch = _int_events(seed=11)
+        assigner = lambda: SlidingEventTimeWindows.of(2000, 500)  # noqa
+        sync = _drive_rows(PaneWindower(assigner(), AGG(),
+                                        capacity=2048), batch)
+        asyn = _drive_rows(PaneWindower(assigner(), AGG(),
+                                        capacity=2048), batch,
+                           async_ok=True)
+        assert sync == asyn and len(sync) > 50
+
+    def test_restore_rebuilds_partials(self):
+        batch = _int_events(n=3000, seed=7)
+        half_a, half_b = batch.slice(0, 1500), batch.slice(1500, 3000)
+        assigner = lambda: SlidingEventTimeWindows.of(2000, 500)  # noqa
+        one = PaneWindower(assigner(), AGG(), capacity=2048, preagg=True)
+        rows = _drive_rows(one, half_a, wm_lag=900, flush=False)
+        snap = one.snapshot()
+        two = PaneWindower(assigner(), AGG(), capacity=2048, preagg=True)
+        two.restore(snap)
+        # partial rows were refolded from the authoritative panes
+        assert set(two.table.window_row) == set(one.table.window_row)
+        rows += _drive_rows(two, half_b, wm_lag=900)
+        oracle = _drive_rows(
+            PaneWindower(assigner(), AGG(), capacity=2048,
+                         preagg=False), batch, wm_lag=900)
+        assert rows == oracle and len(rows) > 50
+
+    def test_late_refire_refolds_from_panes(self):
+        """allowed_lateness > 0: a late record re-registers an
+        already-fired window; the delta path must refold that window's
+        partial from the retained panes and re-fire identically to the
+        full harvest."""
+        assigner = lambda: SlidingEventTimeWindows.of(1000, 500)  # noqa
+
+        def run(preagg):
+            w = PaneWindower(assigner(), AGG(), capacity=1024,
+                             allowed_lateness=2000, preagg=preagg)
+            rows = []
+
+            def go(ks, vs, ts, wm):
+                w.process_batch(RecordBatch.from_pydict(
+                    {KEY_ID_FIELD: np.asarray(ks, dtype=np.int64),
+                     "v": np.asarray(vs, dtype=np.float32)},
+                    timestamps=ts))
+                for b in w.on_watermark(wm):
+                    for r in b.to_rows():
+                        rows.append((r[KEY_ID_FIELD], r["window_start"],
+                                     r["window_end"], float(r["s"]),
+                                     int(r["n"])))
+
+            go([1, 2], [3, 5], [100, 600], 1100)   # fires w<=1000
+            go([1], [7], [300], 1200)              # LATE: re-fires 1000
+            go([2], [2], [1400], 1 << 60)          # flush
+            return rows
+
+        assert run(True) == run(False)
+        # the late re-firing actually happened (window 1000 emitted twice)
+        fired_1000 = [r for r in run(True) if r[2] == 1000]
+        assert len(fired_1000) >= 2
+
+    def test_preagg_config_reaches_operator(self):
+        from flink_tpu.runtime.operators import (
+            OperatorContext,
+            WindowAggOperator,
+        )
+
+        op = WindowAggOperator(SlidingEventTimeWindows.of(2000, 500),
+                               AGG(), key_field="k",
+                               window_layout="panes")
+        op.open(OperatorContext(parallelism=1, pane_preagg=False))
+        assert op.windower._preagg is False
+        op2 = WindowAggOperator(SlidingEventTimeWindows.of(2000, 500),
+                                AGG(), key_field="k",
+                                window_layout="panes")
+        op2.open(OperatorContext(parallelism=1))
+        assert op2.windower._preagg is True
+
+
+class TestMeshWindowAsyncFires:
+    def _drive(self, mesh, async_ok):
+        from flink_tpu.parallel.sharded_windower import MeshWindowEngine
+
+        eng = MeshWindowEngine(SlidingEventTimeWindows.of(2000, 500),
+                               AGG(), mesh, capacity_per_shard=2048)
+        assert eng.supports_async_fires
+        batch = _int_events(n=3000, seed=3)
+        rows = []
+        n = len(batch)
+        for i in range(0, n, 1000):
+            chunk = batch.slice(i, min(i + 1000, n))
+            eng.process_batch(chunk)
+            fired = eng.on_watermark(int(chunk.timestamps.max()) - 600,
+                                     async_ok=async_ok)
+            for b in fired:
+                if hasattr(b, "harvest"):
+                    b = b.harvest()
+                if b is None:
+                    continue
+                for r in b.to_rows():
+                    rows.append((r[KEY_ID_FIELD], r["window_end"],
+                                 float(r["s"]), int(r["n"])))
+        for b in eng.on_watermark(1 << 60, async_ok=async_ok):
+            if hasattr(b, "harvest"):
+                b = b.harvest()
+            if b is None:
+                continue
+            for r in b.to_rows():
+                rows.append((r[KEY_ID_FIELD], r["window_end"],
+                             float(r["s"]), int(r["n"])))
+        return rows
+
+    def test_async_equals_sync(self, eight_device_mesh):
+        sync = self._drive(eight_device_mesh, async_ok=False)
+        asyn = self._drive(eight_device_mesh, async_ok=True)
+        assert sync == asyn and len(sync) > 100
+
+
+class TestDeltaFireProgramFamily:
+    def test_registered_in_shared_cache(self, eight_device_mesh):
+        from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+        from flink_tpu.tenancy.program_cache import PROGRAM_CACHE
+
+        eng = MeshSessionEngine(40, SumAggregate("v"),
+                                eight_device_mesh,
+                                capacity_per_shard=2048)
+        eng.process_batch(RecordBatch.from_pydict(
+            {KEY_ID_FIELD: np.asarray([1, 2, 3], dtype=np.int64),
+             "v": np.ones(3, dtype=np.float32)},
+            timestamps=[0, 10, 20]))
+        fired = eng.on_watermark(1 << 40)
+        assert sum(len(b) for b in fired) == 3
+        kinds = {k for (k, _) in PROGRAM_CACHE.programs}
+        assert "delta-fire" in kinds
+
+
+class TestDeadlineSplitExecutor:
+    def _run(self, parallelism, deadline_ms, async_fires,
+             spill_slots=0, batch=512, data=None, gap=400):
+        from flink_tpu import Configuration, StreamExecutionEnvironment
+        from flink_tpu.connectors.sinks import CollectSink
+        from flink_tpu.windowing.assigners import EventTimeSessionWindows
+
+        if data is None:
+            rng = np.random.default_rng(23)
+            data = []
+            t = 0
+            for _ in range(4000):
+                t += int(rng.integers(1, 50))
+                data.append({"key": int(rng.integers(900)),
+                             "v": float(rng.integers(1, 8)), "t": t})
+        conf = {
+            "execution.micro-batch.size": batch,
+            "parallelism.default": parallelism,
+            "latency.fire-deadline-ms": deadline_ms,
+            "execution.window.async-fires": async_fires,
+        }
+        if spill_slots:
+            conf["state.slot-table.capacity"] = spill_slots
+            conf["state.slot-table.max-device-slots"] = spill_slots
+        env = StreamExecutionEnvironment(Configuration(conf))
+        sink = CollectSink()
+        (env.from_collection(data, timestamp_field="t")
+            .key_by("key")
+            .window(EventTimeSessionWindows.with_gap(gap))
+            .sum("v").sink_to(sink))
+        result = env.execute("latency-tier")
+        out = [(r["key"], r["window_start"], r["window_end"],
+                float(r["sum_v"]))
+               for r in sink.rows()]
+        return out, result
+
+    @staticmethod
+    def _thrash_data():
+        """Live-session set well beyond a 1024-slot/shard budget at
+        parallelism 2 (paged eviction genuinely on the path): huge key
+        space (sessions ~singletons), slow event time, 700 ms gap."""
+        rng = np.random.default_rng(41)
+        data = []
+        t = 0
+        for i in range(12000):
+            t += int(rng.integers(0, 2)) if i % 2 else 0
+            data.append({"key": int(rng.integers(1_000_000)),
+                         "v": float(rng.integers(1, 8)), "t": t})
+        return data
+
+    @staticmethod
+    def _per_key(rows):
+        from collections import defaultdict
+
+        seq = defaultdict(list)
+        for k, ws, we, s in rows:
+            seq[k].append((ws, we, s))
+        return dict(seq)
+
+    def test_split_single_device_bit_identical(self):
+        """At parallelism 1 the emission order is fully defined (pop in
+        session-end order), so a deadline-split run with mid-stream
+        fires must be BIT-IDENTICAL — values and emission order — to
+        the synchronous unsplit run."""
+        oracle, _ = self._run(parallelism=1, deadline_ms=0,
+                              async_fires=False)
+        split, _ = self._run(parallelism=1, deadline_ms=2,
+                             async_fires=True)
+        assert split == oracle and len(split) > 500
+
+    def test_split_mesh_identical(self):
+        """On the mesh, emission within ONE watermark advance is shard-
+        ordered, so splitting an advance legitimately interleaves shards
+        differently — the pins are per-key emission order (session-end
+        order both ways) and exact values vs both the whole-batch run
+        and the synchronous single-device oracle."""
+        oracle, _ = self._run(parallelism=1, deadline_ms=0,
+                              async_fires=False)
+        split, _ = self._run(parallelism=8, deadline_ms=2,
+                             async_fires=True)
+        whole, _ = self._run(parallelism=8, deadline_ms=0,
+                             async_fires=True)
+        assert len(split) > 500
+        assert self._per_key(split) == self._per_key(whole) \
+            == self._per_key(oracle)
+
+    def test_split_mesh_forced_eviction(self):
+        """Same pins at a shape whose live-session set EXCEEDS the
+        device budget — the deadline-split delta fires run against the
+        paged spill tier, and the test fails as vacuous if eviction
+        never engaged."""
+        data = self._thrash_data()
+        oracle, _ = self._run(parallelism=1, deadline_ms=0,
+                              async_fires=False, data=data, gap=700)
+        split, res = self._run(parallelism=2, deadline_ms=2,
+                               async_fires=True, spill_slots=1024,
+                               data=data, gap=700)
+        whole, _ = self._run(parallelism=2, deadline_ms=0,
+                             async_fires=True, spill_slots=1024,
+                             data=data, gap=700)
+        snap = res.registry.snapshot()
+        evicted = [v for k, v in snap.items()
+                   if k.endswith("state.rows_evicted")]
+        assert evicted and max(evicted) > 0, "vacuous: no eviction"
+        assert len(split) > 500
+        assert self._per_key(split) == self._per_key(whole) \
+            == self._per_key(oracle)
+
+    def test_deadline_rate_ema_settles(self):
+        from flink_tpu.cluster.local_executor import LocalExecutor
+
+        ex = LocalExecutor()
+        ex._fire_deadline_ms = 10
+        ex._deadline_rate = 0.0
+        ex._deadline_observe(1000, 0.01)  # 100k rec/s
+        assert ex._deadline_rate == pytest.approx(100_000)
+        ex._deadline_observe(1000, 0.02)  # 50k rec/s folds in
+        assert 50_000 < ex._deadline_rate < 100_000
+
+
+class TestChaosDeltaHarvest:
+    def test_pending_fire_crash_restore_on_delta_path(
+            self, eight_device_mesh, tmp_path):
+        """The satellite scenario: a ``harvest.pending_fire`` fault
+        kills the job between a delta fire's dispatch and its harvest
+        (forced paged eviction on the path); restore + replay must be
+        oracle-identical and seed-deterministic."""
+        from flink_tpu.chaos.harness import run_crash_restore_verify
+        from flink_tpu.chaos.injection import FaultPlan, FaultRule
+        from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+        from flink_tpu.windowing.sessions import SessionWindower
+
+        GAP = 25
+        rng = np.random.default_rng(31)
+        steps = []
+        for s in range(8):
+            keys = rng.integers(0, 6000, 1500).astype(np.int64)
+            vals = rng.random(1500).astype(np.float32)
+            ts = rng.integers(s * 80, s * 80 + 60, 1500).astype(np.int64)
+            steps.append((keys, vals, ts, (s - 1) * 80))
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="harvest.pending_fire", nth=3)])
+
+        def run(tag, rescales=None):
+            return run_crash_restore_verify(
+                lambda: MeshSessionEngine(
+                    GAP, SumAggregate("v"), eight_device_mesh,
+                    capacity_per_shard=1 << 14, max_device_slots=1024),
+                lambda: SessionWindower(GAP, SumAggregate("v"),
+                                        capacity=1 << 15),
+                steps, plan, seed=13,
+                ckpt_root=str(tmp_path / f"ckpt-{tag}"),
+                checkpoint_every=2, async_fires=True,
+                rescales=rescales)
+
+        r1 = run("a")
+        assert not r1.diverged and r1.windows > 0
+        assert r1.crashes >= 1 and r1.restores >= 1
+        assert r1.faults_injected.get("harvest.pending_fire", 0) >= 1
+        r2 = run("b")
+        assert r2.signature() == r1.signature()
+
+    def test_pending_fire_crash_with_midstream_reshard(
+            self, eight_device_mesh, tmp_path):
+        from flink_tpu.chaos.harness import run_crash_restore_verify
+        from flink_tpu.chaos.injection import FaultPlan, FaultRule
+        from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+        from flink_tpu.windowing.sessions import SessionWindower
+
+        GAP = 25
+        rng = np.random.default_rng(37)
+        steps = []
+        for s in range(8):
+            keys = rng.integers(0, 6000, 1500).astype(np.int64)
+            vals = rng.random(1500).astype(np.float32)
+            ts = rng.integers(s * 80, s * 80 + 60, 1500).astype(np.int64)
+            steps.append((keys, vals, ts, (s - 1) * 80))
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="harvest.pending_fire", nth=4)])
+        r = run_crash_restore_verify(
+            lambda: MeshSessionEngine(
+                GAP, SumAggregate("v"), eight_device_mesh,
+                capacity_per_shard=1 << 14, max_device_slots=1024),
+            lambda: SessionWindower(GAP, SumAggregate("v"),
+                                    capacity=1 << 15),
+            steps, plan, seed=19,
+            ckpt_root=str(tmp_path / "ckpt"),
+            checkpoint_every=2, async_fires=True,
+            rescales={3: 4})
+        assert not r.diverged and r.windows > 0
+        assert r.faults_injected.get("harvest.pending_fire", 0) >= 1
+
+
+class TestFireLatencyAutoscaleSignal:
+    def _policy(self, **kw):
+        from flink_tpu.autoscale.policy import ScalingPolicy
+
+        base = dict(cooldown_s=0.0, fire_deadline_ms=100.0,
+                    fire_breach_ticks=3, max_shards=16)
+        base.update(kw)
+        return ScalingPolicy(**base)
+
+    def _inp(self, shards=4, p99=0.0, rate=0.0, busy=0.0, **kw):
+        from flink_tpu.autoscale.policy import PolicyInput
+
+        return PolicyInput(current_shards=shards, processing_rate=rate,
+                           busy_fraction=busy, fire_latency_p99_ms=p99,
+                           **kw)
+
+    def test_sustained_breach_scales_up(self):
+        p = self._policy()
+        # two breaches: not yet (a single slow harvest is noise)
+        assert p.decide(self._inp(p99=250.0), now=1.0).target == 4
+        assert p.decide(self._inp(p99=250.0), now=2.0).target == 4
+        d = p.decide(self._inp(p99=250.0), now=3.0)
+        assert d.target == 6 and d.reason == "fire-latency" and d.rescale
+
+    def test_recovery_resets_streak(self):
+        p = self._policy()
+        p.decide(self._inp(p99=250.0), now=1.0)
+        p.decide(self._inp(p99=250.0), now=2.0)
+        p.decide(self._inp(p99=50.0), now=3.0)   # back under deadline
+        d = p.decide(self._inp(p99=250.0), now=4.0)
+        assert d.target == 4  # streak restarted
+
+    def test_breach_vetoes_scale_down(self):
+        p = self._policy(hysteresis=0.0)
+        # rate signal says "half the shards would do", but fires are
+        # missing their deadline — hold
+        inp = self._inp(shards=4, p99=250.0, rate=100.0, busy=0.25)
+        d = p.decide(inp, now=1.0)
+        assert d.target == 4 and d.reason == "fire-latency-hold"
+        assert not d.rescale
+
+    def test_cooldown_holds_breach_scaleup(self):
+        p = self._policy(cooldown_s=60.0)
+        p.mark_rescaled(now=0.0)
+        for t in (1.0, 2.0, 3.0, 4.0):
+            d = p.decide(self._inp(p99=250.0), now=t)
+        assert d.target == 4 and d.reason == "cooldown"
+
+    def test_no_deadline_no_signal(self):
+        p = self._policy(fire_deadline_ms=0.0)
+        for t in (1.0, 2.0, 3.0, 4.0):
+            d = p.decide(self._inp(p99=9999.0), now=t)
+        assert d.target == 4 and d.reason == "no-signal"
+
+    def test_controller_passes_signal_through(self):
+        from flink_tpu.autoscale.controller import (
+            AutoscaleController,
+            SignalSample,
+        )
+
+        seen = []
+        policy = self._policy(fire_breach_ticks=1)
+        orig = policy.decide
+
+        def spy(inp, now=None):
+            seen.append(inp.fire_latency_p99_ms)
+            return orig(inp, now=now)
+
+        policy.decide = spy
+        clock_t = [0.0]
+        ctl = AutoscaleController(
+            policy,
+            sample_fn=lambda: SignalSample(records_total=100.0,
+                                           busy_ms_total=10.0,
+                                           fire_latency_p99_ms=321.0),
+            apply_fn=lambda n: {"seconds": 0.0},
+            current_shards_fn=lambda: 4,
+            interval_s=0.0, clock=lambda: clock_t[0])
+        ctl.tick()
+        clock_t[0] = 1.0
+        ctl.tick()
+        assert seen and seen[-1] == 321.0
+
+
+class TestWindowMetricGroup:
+    def test_known_group_and_gauges(self):
+        from flink_tpu.metrics import KNOWN_METRIC_GROUPS
+
+        assert "window" in KNOWN_METRIC_GROUPS
+
+    def test_fire_latency_gauges_registered(self):
+        from flink_tpu import Configuration, StreamExecutionEnvironment
+        from flink_tpu.connectors.sinks import CollectSink
+        from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 64}))
+        sink = CollectSink()
+        rows = [{"k": i % 5, "v": 1, "ts": i * 50} for i in range(500)]
+        (env.from_collection(rows, timestamp_field="ts")
+            .key_by("k").window(TumblingEventTimeWindows.of(1000))
+            .sum("v").sink_to(sink))
+        result = env.execute("window-metrics")
+        snap = result.registry.snapshot()
+        p99 = [k for k in snap if k.endswith("window.fireLatencyP99Ms")]
+        p50 = [k for k in snap if k.endswith("window.fireLatencyP50Ms")]
+        cnt = [k for k in snap if k.endswith("window.fireCount")]
+        assert p99 and p50 and cnt
+        assert any(snap[k] > 0 for k in cnt)

@@ -12,6 +12,17 @@ async (``on_watermark(async_ok=True)``) and harvested coalesced while
 the host buckets the next batch, and the engine's own dispatch-ahead
 overlaps host prep of batch k+1 with the device step of batch k.
 
+The driver is also FIRE-DEADLINE-AWARE (the latency tier,
+``BENCH_MESH_FIRE_DEADLINE_MS``, default 25, 0 = legacy whole-batch
+path): each ingest batch is split against the deadline using the
+measured per-record rate, the watermark advances per split, and landed
+fires are harvested between splits — so a fire pops a bounded DELTA of
+closing sessions (one fused fire+reset program, the "delta-fire"
+PROGRAM_CACHE family) instead of a catch-up pile, and its harvest never
+waits out a full batch dispatch. ``fire_latency_ms`` in the JSON is the
+executor's definition: wall time from the watermark advance that
+dispatched the fire to its results materialized on the host.
+
 The keyBy data plane follows the engine default (``shuffle.mode=device``
 — the fused in-program exchange: one flat ``device_put``, segment sort +
 ``all_to_all`` + scatter in ONE compiled program); set
@@ -41,8 +52,13 @@ Regression gates:
 - ``BENCH_HOST_PREP_BUDGET`` (a fraction, device mode only): exit
   non-zero when ``host_prep_fraction`` exceeds it — the regression
   class where exchange work silently moves back onto the host.
+- ``BENCH_FIRE_P99_BUDGET`` (ms): exit non-zero when the MEDIAN of the
+  reps' fire p99 exceeds it — the latency-tier gate (ROADMAP item 1:
+  a fire must cost a bounded delta, not a full-window harvest). A run
+  that recorded fewer than 10 fires FAILS as vacuous regardless of
+  the budget (a shape that fires too rarely measures nothing).
 
-tools/tier1.sh pins both.
+tools/tier1.sh pins all three.
 
     BENCH_SKIP_PROBE=1 JAX_PLATFORMS=cpu python tools/bench_mesh_sessions.py
 """
@@ -70,6 +86,7 @@ MAX_PENDING_FIRES = 8
 
 def run(total: int, mesh, batch: int = 1 << 16):
     """One pass; returns (events/s, fired, counters, breakdown)."""
+    import gc
     from collections import deque
 
     import numpy as np
@@ -87,75 +104,152 @@ def run(total: int, mesh, batch: int = 1 << 16):
                             max_device_slots=BUDGET_PER_SHARD,
                             shuffle_mode=os.environ.get(
                                 "BENCH_MESH_SHUFFLE_MODE", "device"))
+    deadline_s = float(os.environ.get(
+        "BENCH_MESH_FIRE_DEADLINE_MS", "25")) / 1000.0
     rng = np.random.default_rng(3)
     produced = 0
     fired = 0
-    pending = deque()
+    pending = deque()  # (PendingFire, watermark-advance start time)
+    lat = []  # fire latency: watermark advance -> results on host (ms)
+    rate = 0.0  # EMA records/s, sizes the deadline splits
     t_prep = t_fire = t_harvest = 0.0
-    t0 = time.perf_counter()
-    while produced < total:
-        b = min(batch, total - produced)
-        keys = rng.integers(0, NUM_KEYS, b).astype(np.int64)
-        ts = ((produced + np.arange(b, dtype=np.int64)) * 1000
-              // EVENTS_PER_S_OF_EVENTTIME)
-        t1 = time.perf_counter()
-        eng.process_batch(RecordBatch({
-            KEY_ID_FIELD: keys,
-            "v": np.ones(b, dtype=np.float32),
-            TIMESTAMP_FIELD: ts}))
-        t2 = time.perf_counter()
-        # dispatch this advance's fires async; the device fire + D2H
-        # copy overlap the NEXT batch's host bucketing
-        pending.extend(eng.on_watermark(int(ts[-1]), async_ok=True))
-        t3 = time.perf_counter()
-        # coalesced harvest: drain everything whose copy already landed,
-        # and enforce a bound so a catch-up burst cannot hoard buffers
-        while pending and (pending[0].ready()
-                           or len(pending) > MAX_PENDING_FIRES):
-            fired += len(pending.popleft().harvest())
-        t4 = time.perf_counter()
-        t_prep += t2 - t1
-        t_fire += t3 - t2
-        t_harvest += t4 - t3
-        produced += b
-    t5 = time.perf_counter()
-    pending.extend(eng.on_watermark(1 << 60, async_ok=True))
-    while pending:
-        fired += len(pending.popleft().harvest())
-    t_harvest += time.perf_counter() - t5
-    dt = time.perf_counter() - t0
-    # device work surfacing inside process_batch — fence blocks (device
-    # work the pipeline could not hide) plus the inline device
-    # interactions the engine itself timed (the fused in-program
-    # exchange dispatch, eviction gathers + D2H, reload puts; on the
-    # CPU backend these execute inline in the dispatch call) — is
-    # attributed to DEVICE time, so host_prep measures genuine host
-    # work: sessionization, slot resolution, flat staging
-    dev_in_prep = (float(getattr(eng, "pipeline_wait_s", 0.0))
-                   + float(getattr(eng, "device_inline_s", 0.0)))
-    host_prep = max(t_prep - dev_in_prep, 0.0)
-    breakdown = {
-        # host_prep: sessionization + slot resolution + flat staging
-        # (device mode) / bucketing (host mode) + dispatch bookkeeping,
-        # EXCLUDING fence blocks and inline device interactions
-        "host_prep_s": round(host_prep, 3),
-        # of which: time inside the NATIVE metadata sweeps (absorb /
-        # shard-group / route / pop — 0.0 on the pure-Python plane);
-        # pop sweeps land in the fire bucket, so this line can exceed
-        # neither bucket alone but attributes the C share explicitly
-        "native_sweep_s": round(
-            float(getattr(eng.meta, "native_sweep_s", 0.0)), 3),
-        # device_step: fire dispatch + the fire path's synchronous
-        # device work (page reloads / cohort evictions for cold fires)
-        # + the device share carved out of host prep
-        "device_step_s": round(t_fire + dev_in_prep, 3),
-        # harvest: materializing fired results on host (coalesced)
-        "harvest_s": round(t_harvest, 3),
-        "device_in_prep_s": round(dev_in_prep, 3),
-        "host_prep_fraction": round(host_prep / dt, 4),
-        "total_s": round(dt, 3),
-    }
-    return total / dt, fired, eng.spill_counters(), breakdown
+
+    def harvest(bound=MAX_PENDING_FIRES):
+        # coalesced harvest: drain everything whose copy already
+        # landed, and enforce a bound so a catch-up burst cannot
+        # hoard buffers
+        nonlocal fired
+        while pending and (pending[0][0].ready() or len(pending) > bound):
+            pf, t_wm = pending.popleft()
+            fired += len(pf.harvest())
+            lat.append((time.perf_counter() - t_wm) * 1e3)
+
+    # the cyclic collector's gen2 pauses (~100 ms over the page-entry
+    # object graph) land inside fire spans and dominate p99 — collect
+    # the PREVIOUS rep's garbage now, then keep the collector out of
+    # the measured loop (numpy buffers are refcounted; re-enabled in
+    # the finally below)
+    gc.collect()
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        while produced < total:
+            b = min(batch, total - produced)
+            keys = rng.integers(0, NUM_KEYS, b).astype(np.int64)
+            ts = ((produced + np.arange(b, dtype=np.int64)) * 1000
+                  // EVENTS_PER_S_OF_EVENTTIME)
+            # fire-deadline-aware micro-batching: ingest splits are sized a
+            # small multiple of the deadline (per-dispatch fixed costs —
+            # absorb sweep, exchange staging, fences — amortize over the
+            # bigger chunk), while the WATERMARK advances in deadline-sized
+            # quanta within each split, so every fire pops a bounded DELTA
+            # of closing sessions and harvests land between quanta
+            if deadline_s <= 0:
+                chunk = b
+            elif rate <= 0:
+                chunk = 16384  # seed until the rate EMA settles
+            else:
+                # power-of-two split sizes: the rate EMA drifts every step,
+                # and a continuously-varying chunk feeds XLA a fresh padded
+                # shape per dispatch — pow2 rounding keeps the shape set
+                # bounded (0 steady-state compiles, the recompile-smoke
+                # contract) so no fire span absorbs a compile
+                chunk = 1 << max(int(rate * deadline_s) * 4, 8192).bit_length()
+            for a in range(0, b, chunk):
+                z = min(a + chunk, b)
+                if deadline_s <= 0:
+                    quanta = 1
+                else:
+                    # one watermark quantum per deadline's worth of records
+                    per_q = 1 << max(int(rate * deadline_s),
+                                     2048).bit_length()
+                    quanta = min(max((z - a + per_q - 1) // per_q, 1), 32)
+                t1 = time.perf_counter()
+                eng.process_batch(RecordBatch({
+                    KEY_ID_FIELD: keys[a:z],
+                    "v": np.ones(z - a, dtype=np.float32),
+                    TIMESTAMP_FIELD: ts[a:z]}))
+                t2 = time.perf_counter()
+                # dispatch each quantum's fires async; the fused delta-fire
+                # program + D2H copies overlap the next quantum's dispatch
+                # and the next split's host prep
+                for j in range(quanta):
+                    w = a + (z - a) * (j + 1) // quanta
+                    if w <= a:
+                        continue
+                    t_wm = time.perf_counter()
+                    for pf in eng.on_watermark(int(ts[w - 1]),
+                                               async_ok=True):
+                        pending.append((pf, t_wm))
+                    harvest()
+                t3 = time.perf_counter()
+                t_prep += t2 - t1
+                t_fire += t3 - t2
+                step_rate = (z - a) / max(t2 - t1, 1e-9)
+                rate = step_rate if rate <= 0 else 0.7 * rate + 0.3 * step_rate
+            produced += b
+        # drain the steady-state pending fires FIRST: harvested after the
+        # shutdown flush below, their samples would carry the whole drain
+        # span and pollute the p99 the gate reads
+        t5 = time.perf_counter()
+        harvest(bound=0)
+        t_harvest += time.perf_counter() - t5
+        # end-of-input: flush ALL remaining live sessions. This is the
+        # shutdown DRAIN, not a steady-state watermark fire — it pops the
+        # whole residual state by construction, so it is timed separately
+        # (final_drain_ms) and excluded from the fire percentiles the
+        # latency gate reads.
+        t5 = time.perf_counter()
+        for pf in eng.on_watermark(1 << 60, async_ok=True):
+            fired += len(pf.harvest())
+        t_drain = time.perf_counter() - t5
+        dt = time.perf_counter() - t0
+        lat.sort()
+        # device work surfacing inside process_batch — fence blocks (device
+        # work the pipeline could not hide) plus the inline device
+        # interactions the engine itself timed (the fused in-program
+        # exchange dispatch, eviction gathers + D2H, reload puts; on the
+        # CPU backend these execute inline in the dispatch call) — is
+        # attributed to DEVICE time, so host_prep measures genuine host
+        # work: sessionization, slot resolution, flat staging
+        dev_in_prep = (float(getattr(eng, "pipeline_wait_s", 0.0))
+                       + float(getattr(eng, "device_inline_s", 0.0)))
+        host_prep = max(t_prep - dev_in_prep, 0.0)
+        breakdown = {
+            # host_prep: sessionization + slot resolution + flat staging
+            # (device mode) / bucketing (host mode) + dispatch bookkeeping,
+            # EXCLUDING fence blocks and inline device interactions
+            "host_prep_s": round(host_prep, 3),
+            # of which: time inside the NATIVE metadata sweeps (absorb /
+            # shard-group / route / pop — 0.0 on the pure-Python plane);
+            # pop sweeps land in the fire bucket, so this line can exceed
+            # neither bucket alone but attributes the C share explicitly
+            "native_sweep_s": round(
+                float(getattr(eng.meta, "native_sweep_s", 0.0)), 3),
+            # device_step: fire dispatch + the fire path's synchronous
+            # device work (page reloads / cohort evictions for cold fires)
+            # + the device share carved out of host prep
+            "device_step_s": round(t_fire + dev_in_prep, 3),
+            # harvest: materializing fired results on host (coalesced)
+            "harvest_s": round(t_harvest, 3),
+            "device_in_prep_s": round(dev_in_prep, 3),
+            "host_prep_fraction": round(host_prep / dt, 4),
+            "total_s": round(dt, 3),
+        }
+        from flink_tpu.metrics.core import quantile_sorted
+
+        fire_latency = {
+            "p50": round(quantile_sorted(lat, 0.5), 1) if lat else 0.0,
+            "p99": round(quantile_sorted(lat, 0.99), 1) if lat else 0.0,
+            "max": round(lat[-1], 1) if lat else 0.0,
+            "count": len(lat),
+            # the end-of-input flush of ALL residual sessions — a shutdown
+            # drain, reported but outside the steady-state percentiles
+            "final_drain_ms": round(t_drain * 1e3, 1),
+        }
+        return total / dt, fired, eng.spill_counters(), breakdown, fire_latency
+    finally:
+        gc.enable()
 
 
 def main():
@@ -190,12 +284,21 @@ def main():
     run(min(total, 1 << 20), mesh)  # warm: compile the step programs
     reps = []
     for i in range(reps_n):
-        eps, fired, counters, breakdown = run(total, mesh)
-        print(f"# rep {i}: {eps:.0f} events/s, breakdown={breakdown}",
+        eps, fired, counters, breakdown, fire_lat = run(total, mesh)
+        print(f"# rep {i}: {eps:.0f} events/s, fire p50/p99 "
+              f"{fire_lat['p50']}/{fire_lat['p99']} ms (n="
+              f"{fire_lat['count']}), breakdown={breakdown}",
               file=sys.stderr)
-        reps.append((eps, fired, counters, breakdown))
+        reps.append((eps, fired, counters, breakdown, fire_lat))
     by_rate = sorted(reps, key=lambda r: r[0])
-    eps, fired, counters, breakdown = by_rate[len(by_rate) // 2]  # median
+    eps, fired, counters, breakdown, fire_lat = \
+        by_rate[len(by_rate) // 2]  # median
+    # the latency gate reads the MEDIAN of the reps' p99s (one noisy
+    # rep must not decide), mirroring the host-prep gate's median rule
+    p99s = sorted(r[4]["p99"] for r in reps)
+    median_p99 = p99s[len(p99s) // 2]
+    deadline_ms = float(os.environ.get("BENCH_MESH_FIRE_DEADLINE_MS",
+                                       "25"))
     mode = os.environ.get("BENCH_MESH_SHUFFLE_MODE", "device")
     line = {
         "metric": "mesh_sessions_10m_keys_events_per_sec",
@@ -211,10 +314,15 @@ def main():
         "spill": counters,
         "breakdown": breakdown,
         "host_prep_fraction": breakdown["host_prep_fraction"],
+        "fire_latency_ms": fire_lat,
+        "fire_p99_ms_median": median_p99,
+        "fire_p99_ms_reps": p99s,
+        "fire_deadline_ms": deadline_ms,
         "shape": (f"400k ev/s event time, 2 s gap, ~800k live sessions "
                   f"vs {P}x{BUDGET_PER_SHARD // 1024}k device slots "
                   f"(paged spill per shard), 10M distinct keys, "
-                  f"pipelined driver, {mode}-mode shuffle"),
+                  f"pipelined driver, {mode}-mode shuffle, "
+                  f"{deadline_ms:.0f} ms fire deadline"),
     }
     prep_budget = os.environ.get("BENCH_HOST_PREP_BUDGET")
     if prep_budget is not None and mode == "device":
@@ -227,6 +335,28 @@ def main():
                 f"host-prep fraction regressed: "
                 f"{breakdown['host_prep_fraction']:.3f} of wall clock "
                 f"> budget {prep_budget} in device-shuffle mode")
+            print(json.dumps(line))
+            sys.exit(1)
+    fire_budget = os.environ.get("BENCH_FIRE_P99_BUDGET")
+    if fire_budget is not None:
+        # vacuity guard FIRST, over EVERY rep (the p99 gate reads the
+        # median across reps, so a single under-sampled rep would feed
+        # the gate a statistic the guard never validated): a shape that
+        # fires too rarely measures nothing — fail loudly
+        min_fires = min(r[4]["count"] for r in reps)
+        if min_fires < 10:
+            line["error"] = (
+                f"fire-latency gate is VACUOUS: a rep recorded only "
+                f"{min_fires} fires (< 10) — the smoke shape no longer "
+                "fires often enough to measure p99")
+            print(json.dumps(line))
+            sys.exit(1)
+        if median_p99 > float(fire_budget):
+            line["error"] = (
+                f"fire p99 regressed: median of reps "
+                f"{median_p99:.1f} ms > budget {fire_budget} ms "
+                "(watermark-advance -> results-on-host, the latency "
+                "tier's bounded-delta contract)")
             print(json.dumps(line))
             sys.exit(1)
     budget = os.environ.get("BENCH_MESH_AMP_BUDGET")

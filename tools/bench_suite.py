@@ -112,7 +112,12 @@ def row3_q7():
         sink = CollectSink()
         src = BidSource(total_records=total, num_auctions=10_000,
                         events_per_second_of_eventtime=100_000)
-        build_q7(env, src, size_ms=10_000).sink_to(sink)
+        # 2 s windows (was 10 s): the 10 s shape fired only 10 windows
+        # over the row's 100 s of event time, so its percentiles were
+        # VACUOUS (n=10, p99 == the single worst sample). 2 s gives
+        # n >= 30 fires — the floor below which the suite flags a row's
+        # fire percentiles low-confidence.
+        build_q7(env, src, size_ms=2_000).sink_to(sink)
         t0 = time.perf_counter()
         result = env.execute("q7")
         return (total / (time.perf_counter() - t0),
@@ -390,6 +395,12 @@ def main():
         except Exception as e:  # noqa: BLE001 — a row must not kill the suite
             r = {"metric": name, "error": repr(e)}
         r["backend"] = platform
+        lat = r.get("fire_latency_ms")
+        if lat and lat.get("count", 0) < 30:
+            # a windowed row that fired < 30 times has vacuous
+            # percentiles (p99 == the worst 1-2 samples): flag it so
+            # nobody gates or compares against noise
+            r["fire_latency_low_confidence"] = True
         results.append(r)
         print(json.dumps(r), flush=True)
     lines = [
@@ -429,8 +440,10 @@ def main():
             extra += f" — {r['matches']:,} joined pairs"
         if r.get("fire_latency_ms"):
             lat = r["fire_latency_ms"]
+            conf = (" LOW-CONFIDENCE (n<30)"
+                    if r.get("fire_latency_low_confidence") else "")
             extra += (f" (fire p50 {lat['p50']:.0f} ms / "
-                      f"p99 {lat['p99']:.0f} ms, n={lat['count']})")
+                      f"p99 {lat['p99']:.0f} ms, n={lat['count']}{conf})")
         lines.append(f"| {name} | {r['metric']} | {val}{extra} | "
                      f"{r.get('unit', '')} |")
     lines.append("")

@@ -244,6 +244,14 @@ HOT_ROOTS: Dict[str, Tuple[str, ...]] = {
                           "poll_pending_output"),
     "SessionWindowAggOperator": ("process_batch", "process_watermark"),
     "PendingFire": ("harvest", "ready"),
+    # the latency tier's delta-harvest entry points (pane
+    # pre-aggregation): combined absorb scatter, one-row delta fires,
+    # and the partial refolds all run per batch / per watermark
+    "PaneTable": ("scatter_flat", "scatter_combined", "window_flat",
+                  "fire_window", "fire_window_async", "fire_partial",
+                  "fire_partial_async", "rebuild_window_partials",
+                  "release_window_row"),
+    "PaneWindower": ("process_batch", "on_watermark"),
     # the two-input join engines (flink_tpu/joins/engine.py): ingest,
     # probe and prune all run per batch / per watermark
     "MeshIntervalJoinEngine": ("process_batch", "on_watermark"),
@@ -284,6 +292,12 @@ HOT_MODULE_ROOTS: Dict[str, Tuple[str, ...]] = {
     ),
     "flink_tpu.joins.side_table": (
         "pair_lower_bound",
+    ),
+    # the delta-harvest program family (fire + reset fused in one
+    # dispatch) — its builder closure IS the per-fire compiled program,
+    # rooted explicitly like the join kernel builders
+    "flink_tpu.parallel.sharded_windower": (
+        "_build_delta_fire_step",
     ),
 }
 

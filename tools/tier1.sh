@@ -67,14 +67,25 @@ if [ "${SKIP_BENCH_SMOKE:-0}" != "1" ]; then
   #     ~0.34 measured on the 1-core CI host. BENCH_REQUIRE_NATIVE
   #     (exported above when the up-front build succeeded) makes the
   #     smoke FAIL rather than silently measure the pure-Python plane.
+  # (3) fire p99 (the latency tier, ROADMAP item 1): FAILS if the
+  #     MEDIAN of the reps' fire p99 (watermark advance -> results on
+  #     host, steady state — the end-of-input drain is excluded and
+  #     reported as final_drain_ms) exceeds the budget at the
+  #     mesh-sessions smoke shape, or if the smoke recorded < 10 fires
+  #     (vacuity guard — a shape that fires too rarely measures
+  #     nothing). Budget 140 ms vs ~90-120 measured with the 25 ms
+  #     fire deadline on the 1-core CI box; the legacy whole-batch
+  #     path (BENCH_MESH_FIRE_DEADLINE_MS=0) measures ~164 ms median
+  #     here, so a regression to full-harvest fires trips the gate.
   # 2M records so the live session set genuinely exceeds the 512k
   # device budget — below ~1M the tier never spills and the
-  # amplification gate would be vacuous. 3 reps: both gates read the
+  # amplification gate would be vacuous. 3 reps: all gates read the
   # MEDIAN rep (the bench's own methodology) — a single-rep gate at a
   # tight budget tripped on scheduler noise, not regressions.
   BENCH_SKIP_PROBE=1 BENCH_MESH_SESSION_RECORDS=$((1 << 21)) \
     BENCH_MESH_REPS=3 BENCH_MESH_AMP_BUDGET=0.5 \
     BENCH_HOST_PREP_BUDGET=0.35 \
+    BENCH_FIRE_P99_BUDGET=140 BENCH_MESH_FIRE_DEADLINE_MS=25 \
     JAX_PLATFORMS=cpu timeout -k 10 600 \
     python tools/bench_mesh_sessions.py || exit 1
 
