@@ -35,6 +35,8 @@ import numpy as np
 
 from flink_tpu.chaos.injection import FaultPlan, InjectedFault
 from flink_tpu.chaos import injection as chaos
+from flink_tpu.metrics.traces import default_collector
+from flink_tpu.observe import flight_recorder as flight
 
 #: end-of-stream watermark (matches the test-suite flush convention)
 FINAL_WATERMARK = 1 << 60
@@ -632,71 +634,92 @@ def run_shard_loss_verify(
                 if pending_loss is not None:
                     dead, at_phase = pending_loss
                     t0 = time.perf_counter()
-                    g0, g1 = engine.lose_shard(dead)
-                    groups = range(g0, g1 + 1)
-                    # gates SPLIT around the dead range: the overlap is
-                    # being rebuilt from its unit (its gate is moot),
-                    # but a partially-overlapping gate's OUTSIDE
-                    # sub-ranges still hold state ahead of pos and must
-                    # stay gated or they would re-absorb records they
-                    # already hold
-                    split: List[Tuple[int, int, int]] = []
-                    for a, b, p_r in gates:
-                        if b < g0 or a > g1:
-                            split.append((a, b, p_r))
-                            continue
-                        if a < g0:
-                            split.append((a, g0 - 1, p_r))
-                        if b > g1:
-                            split.append((g1 + 1, b, p_r))
-                    gates = split
-                    found = storage.latest_units_for_groups(groups)
-                    if found is None:
-                        unit_pos = 0
-                        # roll the range's staleness guards back to
-                        # stream start (cold range replay)
-                        engine.restore_key_groups({"table": {}}, groups)
-                    else:
-                        _ucid, states, unit_pos = found
-                        engine.restore_key_groups(
-                            engine.merge_unit_snapshots(states), groups)
-                        report.shard_restores += 1
-                    # uncommitted output of the range is rolled back
-                    # with its state; replay re-produces it
-                    if epoch:
-                        ekeys = np.asarray([k[0] for k in epoch],
-                                           dtype=np.int64)
-                        drop = _range_mask(ekeys, g0, g1)
-                        epoch = {k: v for k, v, d in zip(
-                            epoch, epoch.values(), drop) if not d}
-                    # bounded replay: ONLY the range's records, from
-                    # the unit's position; the step being interrupted
-                    # mid-watermark (at_phase=1) already absorbed pos's
-                    # batch on the survivors, so the range re-absorbs
-                    # through pos INCLUSIVE and the main flow refires
-                    # pos's watermark for everyone. The replay is a
-                    # CRITICAL SECTION: the watchdog detaches for it —
-                    # a second loss declared mid-replay would abandon
-                    # this range's partially-completed rebuild; a
-                    # genuinely dead second device is declared at the
-                    # next main-loop boundary instead
-                    wd_held = engine._watchdog
-                    engine.attach_watchdog(None)
-                    try:
-                        upto = pos + (1 if at_phase == 1 else 0)
-                        for rpos in range(unit_pos, min(upto, n_steps)):
-                            keys, vals, ts, _wm = steps[rpos]
-                            mask = _range_mask(keys, g0, g1)
-                            if mask.any():
-                                engine.process_batch(_keyed_batch(
-                                    keys[mask], vals[mask], ts[mask]))
-                                report.records_replayed += int(
-                                    mask.sum())
-                            if rpos < pos:
-                                _collect(engine.on_watermark(
-                                    int(steps[rpos][3])), epoch)
-                    finally:
-                        engine._watchdog = wd_held
+                    replayed_before = report.records_replayed
+                    # the restore/replay duration is a span in the
+                    # default TraceCollector AND the flight recorder's
+                    # timeline (the same reporting the executor does
+                    # for checkpoints); a failure mid-recovery records
+                    # the span with its error instead of leaking it
+                    with default_collector().span(
+                            "recovery", "shard-failover") as fo_span, \
+                            flight.span("failover.replay",
+                                        shard=int(dead)):
+                        g0, g1 = engine.lose_shard(dead)
+                        groups = range(g0, g1 + 1)
+                        # gates SPLIT around the dead range: the
+                        # overlap is being rebuilt from its unit (its
+                        # gate is moot), but a partially-overlapping
+                        # gate's OUTSIDE sub-ranges still hold state
+                        # ahead of pos and must stay gated or they
+                        # would re-absorb records they already hold
+                        split: List[Tuple[int, int, int]] = []
+                        for a, b, p_r in gates:
+                            if b < g0 or a > g1:
+                                split.append((a, b, p_r))
+                                continue
+                            if a < g0:
+                                split.append((a, g0 - 1, p_r))
+                            if b > g1:
+                                split.append((g1 + 1, b, p_r))
+                        gates = split
+                        found = storage.latest_units_for_groups(groups)
+                        if found is None:
+                            unit_pos = 0
+                            # roll the range's staleness guards back to
+                            # stream start (cold range replay)
+                            engine.restore_key_groups({"table": {}},
+                                                      groups)
+                        else:
+                            _ucid, states, unit_pos = found
+                            engine.restore_key_groups(
+                                engine.merge_unit_snapshots(states),
+                                groups)
+                            report.shard_restores += 1
+                        # uncommitted output of the range is rolled
+                        # back with its state; replay re-produces it
+                        if epoch:
+                            ekeys = np.asarray([k[0] for k in epoch],
+                                               dtype=np.int64)
+                            drop = _range_mask(ekeys, g0, g1)
+                            epoch = {k: v for k, v, d in zip(
+                                epoch, epoch.values(), drop) if not d}
+                        # bounded replay: ONLY the range's records,
+                        # from the unit's position; the step being
+                        # interrupted mid-watermark (at_phase=1)
+                        # already absorbed pos's batch on the
+                        # survivors, so the range re-absorbs through
+                        # pos INCLUSIVE and the main flow refires pos's
+                        # watermark for everyone. The replay is a
+                        # CRITICAL SECTION: the watchdog detaches for
+                        # it — a second loss declared mid-replay would
+                        # abandon this range's partially-completed
+                        # rebuild; a genuinely dead second device is
+                        # declared at the next main-loop boundary
+                        # instead
+                        wd_held = engine._watchdog
+                        engine.attach_watchdog(None)
+                        try:
+                            upto = pos + (1 if at_phase == 1 else 0)
+                            for rpos in range(unit_pos,
+                                              min(upto, n_steps)):
+                                keys, vals, ts, _wm = steps[rpos]
+                                mask = _range_mask(keys, g0, g1)
+                                if mask.any():
+                                    engine.process_batch(_keyed_batch(
+                                        keys[mask], vals[mask],
+                                        ts[mask]))
+                                    report.records_replayed += int(
+                                        mask.sum())
+                                if rpos < pos:
+                                    _collect(engine.on_watermark(
+                                        int(steps[rpos][3])), epoch)
+                        finally:
+                            engine._watchdog = wd_held
+                        fo_span.set_attribute("shard", int(dead))
+                        fo_span.set_attribute("key_groups", [g0, g1])
+                        fo_span.set_attribute(
+                            "records_replayed",
+                            report.records_replayed - replayed_before)
                     report.shard_loss_recovery_ms += (
                         time.perf_counter() - t0) * 1000.0
                     pending_loss = None

@@ -219,6 +219,15 @@ class ChaosController:
                     self._injections[i] += 1
                     self.faults_injected[point] = \
                         self.faults_injected.get(point, 0) + 1
+                    # correlate the injection into the flight-recorder
+                    # timeline: a chaos-driven stall/crash reads as
+                    # "injected HERE, under THIS span" in the trace
+                    from flink_tpu.observe import flight_recorder as flight
+
+                    flight.instant(
+                        "chaos.inject",
+                        shard=int(ctx.get("shard", -1))
+                        if isinstance(ctx.get("shard"), int) else -1)
                     return rule
             return None
 

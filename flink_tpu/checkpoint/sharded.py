@@ -69,9 +69,16 @@ class ShardedCheckpointStorage:
     module docstring). The unit of corruption, fallback and restore is
     the RANGE, never the whole checkpoint."""
 
-    def __init__(self, root: str, compress: bool = True):
+    def __init__(self, root: str, compress: bool = True,
+                 traces=None):
+        from flink_tpu.metrics.traces import default_collector
+
         self.root = root
         self.compress = compress
+        #: TraceCollector receiving write/restore spans (reference:
+        #: the checkpoint/recovery Span reporting — SURVEY §5); the
+        #: process-default collector unless the owner threads its own
+        self.traces = traces or default_collector()
         #: ids whose EVERY unit passed full CRC verification in this
         #: process (units are immutable after the atomic rename) — the
         #: retention scan never re-reads a verified checkpoint
@@ -90,6 +97,20 @@ class ShardedCheckpointStorage:
         failover, and restore replays each range from its own).
         ``incremental_base``: record each unit as a delta over the same
         range's unit in chk-<base> (the per-shard increment chain)."""
+        from flink_tpu.observe import flight_recorder as flight
+
+        with flight.span("checkpoint.write"), \
+                self.traces.span("checkpoint", "sharded-write") as sp:
+            sp.set_attribute("checkpointId", int(checkpoint_id))
+            sp.set_attribute("units", len(units))
+            sp.set_attribute("incremental", incremental_base is not None)
+            return self._write_checkpoint_inner(
+                checkpoint_id, job_name, units, positions,
+                incremental_base)
+
+    def _write_checkpoint_inner(self, checkpoint_id: int, job_name: str,
+                                units, positions,
+                                incremental_base: Optional[int]) -> str:
         final_dir = self._dir(checkpoint_id)
         parent = os.path.dirname(os.path.abspath(final_dir)) or "."
         os.makedirs(parent, exist_ok=True)
@@ -199,21 +220,32 @@ class ShardedCheckpointStorage:
         units, never discarding the siblings' recovery options. None
         when no checkpoint covers the groups (cold start for that
         range)."""
+        from flink_tpu.observe import flight_recorder as flight
+
         gset = set(int(g) for g in groups)
         lo, hi = min(gset), max(gset)
-        for cid in reversed(self.checkpoint_ids()):
-            covering = [r for r in self.unit_ranges(cid)
-                        if _ranges_intersect(r, (lo, hi))]
-            if not covering:
-                continue
-            try:
-                read = [self.read_unit(cid, r, verify=True)
-                        for r in covering]
-            except (CheckpointCorruptedError, OSError, ValueError):
-                continue
-            return (cid, [state for state, _ in read],
-                    min(pos for _, pos in read))
-        return None
+        with flight.span("checkpoint.restore"), \
+                self.traces.span("recovery", "restore-units") as sp:
+            sp.set_attribute("key_groups", [lo, hi])
+            fallbacks = 0
+            for cid in reversed(self.checkpoint_ids()):
+                covering = [r for r in self.unit_ranges(cid)
+                            if _ranges_intersect(r, (lo, hi))]
+                if not covering:
+                    continue
+                try:
+                    read = [self.read_unit(cid, r, verify=True)
+                            for r in covering]
+                except (CheckpointCorruptedError, OSError, ValueError):
+                    fallbacks += 1
+                    sp.set_attribute("fallbacks", fallbacks)
+                    continue
+                sp.set_attribute("checkpointId", cid)
+                sp.set_attribute("units", len(covering))
+                return (cid, [state for state, _ in read],
+                        min(pos for _, pos in read))
+            sp.set_attribute("checkpointId", None)
+            return None
 
     def read_all_units_with_fallback(
             self) -> Optional[Tuple[int, List[Tuple[GroupRange,

@@ -31,6 +31,7 @@ from flink_tpu.core.config import (
 )
 from flink_tpu.chaos import injection as chaos
 from flink_tpu.core.records import RecordBatch
+from flink_tpu.observe import flight_recorder as flight
 from flink_tpu.graph.transformations import StreamGraph, Transformation
 from flink_tpu.runtime.elements import MAX_WATERMARK, Watermark
 from flink_tpu.runtime.operators import Operator, OperatorContext
@@ -43,7 +44,7 @@ from flink_tpu.core.annotations import internal
 class _Node:
     __slots__ = ("transformation", "operator", "valve", "children",
                  "child_input_idx", "records_in", "records_out", "held_wm",
-                 "busy_s")
+                 "busy_s", "marker_hist")
 
     def __init__(self, transformation: Transformation,
                  operator: Optional[Operator]):
@@ -63,6 +64,9 @@ class _Node:
         #: (see _drain_pending; reference: watermark must not overtake
         #: the records it covers)
         self.held_wm: Optional[int] = None
+        #: per-operator LatencyMarker histogram (observe.export) — the
+        #: executor stamps each source batch and records marker->here
+        self.marker_hist = None
 
 
 class JobCancelledError(RuntimeError):
@@ -410,6 +414,28 @@ class LocalExecutor:
         # chaos counters ride the job's metric tree when a fault plan is
         # armed (job.<name>.chaos.faults_injected / retries / recoveries)
         chaos.register_chaos_metrics(job_group)
+        # flight recorder: name the job for every span the task loop
+        # (and the engines it drives) records, wire the jax-level probes
+        # (XLA backend compiles, D2H materializations) into the same
+        # timeline, and surface per-span-kind duration aggregates on
+        # the job metric tree
+        from flink_tpu.observe import install_probes
+        from flink_tpu.observe.export import (
+            LatencyMarkerPlane,
+            register_flight_metrics,
+        )
+
+        install_probes()
+        flight.set_job(job_name)
+        # the flight aggregates are PROCESS-global (the recorder is
+        # shared by every job on the mesh), so they register at the
+        # registry root, not under this job's scope — a per-job scope
+        # would claim other tenants' spans as this job's
+        register_flight_metrics(registry.root_group())
+        # event-time latency markers: each source batch is the marker;
+        # per-operator marker histograms + watermark-lag gauges land
+        # under job.<name>.<op>.latency
+        lat_plane = self._lat_plane = LatencyMarkerPlane()
         # device watchdog (watchdog.enabled): one per job, attached to
         # every mesh engine through the operator context; heartbeat
         # gauges under job.<name>.watchdog. A ShardFailedError it raises
@@ -466,6 +492,12 @@ class LocalExecutor:
             g.gauge("currentInputWatermark",
                     lambda n=node: n.valve.combined)
             g.gauge("busyTimeMsTotal", lambda n=node: n.busy_s * 1000.0)
+            if op is not None:
+                # LatencyMarker surface: marker histogram + watermark
+                # lag vs the sources' frontier, under <op>.latency
+                node.marker_hist = lat_plane.operator_group(
+                    g, f"{t.name}#{t.uid}",
+                    lambda n=node: n.valve.combined)
             if op is not None and hasattr(op, "spill_counters"):
                 # the `state` group: the same numbers spill_counters()
                 # reports, on the metric tree the autoscaler reads
@@ -546,10 +578,15 @@ class LocalExecutor:
                 read_manifest,
             )
 
-            snap_dir, claimed = prepare_restore(
-                restore_from, restore_mode, own_checkpoint_root=ckpt_dir)
-            states = read_checkpoint_chain(snap_dir)
-            self._restore_all(graph, nodes, states)
+            with flight.span("checkpoint.restore"), \
+                    traces.span("recovery", "restore") as rsp:
+                snap_dir, claimed = prepare_restore(
+                    restore_from, restore_mode,
+                    own_checkpoint_root=ckpt_dir)
+                states = read_checkpoint_chain(snap_dir)
+                self._restore_all(graph, nodes, states)
+                rsp.set_attribute("snapshot", snap_dir)
+                rsp.set_attribute("operators", len(states))
             checkpoint_count = int(read_manifest(snap_dir)["checkpoint_id"])
             restored_id = checkpoint_count
             # a valid delta base is the job's OWN chk-<id> directory — a
@@ -664,12 +701,25 @@ class LocalExecutor:
                     step_records += len(batch)
                     source_positions[t.uid] = pos
                     tb = time.perf_counter() if debloater else 0.0
-                    if self._fire_deadline_ms > 0 and not batch_mode:
-                        self._emit_deadline_split(node, batch, nodes, wm)
-                    else:
-                        self._emit_batch(node, batch)
-                        if wm is not None and not batch_mode:
-                            self._emit_watermark(node, wm)
+                    # this batch IS the latency marker: stamp its ingest
+                    # wall time; operators record marker->here as the
+                    # depth-first push reaches them, and the marker dies
+                    # with the push — later drains/flushes are not this
+                    # batch's latency
+                    lat_plane.stamp_source()
+                    if wm is not None and not batch_mode:
+                        lat_plane.note_source_watermark(int(wm),
+                                                        source=t.uid)
+                    try:
+                        if self._fire_deadline_ms > 0 and not batch_mode:
+                            self._emit_deadline_split(node, batch,
+                                                      nodes, wm)
+                        else:
+                            self._emit_batch(node, batch)
+                            if wm is not None and not batch_mode:
+                                self._emit_watermark(node, wm)
+                    finally:
+                        lat_plane.end_marker()
                     if debloater is not None:
                         new_size = debloater.observe(
                             len(batch), time.perf_counter() - tb)
@@ -692,7 +742,7 @@ class LocalExecutor:
                         # those windows fired, so a snapshot without them
                         # would lose results on restore
                         self._drain_pending(nodes, wait=True)
-                        with traces.span(
+                        with flight.span("checkpoint.write"), traces.span(
                                 "checkpoint",
                                 f"checkpoint-{checkpoint_count}") as sp:
                             snap = self.snapshot_all(graph, nodes,
@@ -1189,8 +1239,11 @@ class LocalExecutor:
                           job=getattr(self, "_chaos_job", None))
         node.records_in += len(batch)
         t0 = time.perf_counter()
-        outs = node.operator.process_batch(batch, input_idx)
+        with flight.span("op.process"):
+            outs = node.operator.process_batch(batch, input_idx)
         node.busy_s += time.perf_counter() - t0
+        if node.marker_hist is not None:
+            self._lat_plane.observe(node.marker_hist)
         for out in outs:
             self._forward(node, out)
 
@@ -1199,7 +1252,8 @@ class LocalExecutor:
         if advanced is None:
             return
         t0 = time.perf_counter()
-        outs = node.operator.process_watermark(advanced)
+        with flight.span("op.watermark", watermark=int(advanced)):
+            outs = node.operator.process_watermark(advanced)
         node.busy_s += time.perf_counter() - t0
         for out in outs:
             self._forward(node, out)
@@ -1244,6 +1298,12 @@ class LocalExecutor:
     def _forward(self, node: _Node, batch) -> None:
         n = len(batch.batch) if isinstance(batch, TaggedBatch) else len(batch)
         node.records_out += n
+        # an INSTANT, not a span: _emit_batch recurses synchronously
+        # into the whole downstream subtree, and a duration here would
+        # multiply-count each level's op.process time in the per-kind
+        # aggregates — the timeline marks WHEN each output left, the
+        # durations belong to the operators
+        flight.instant("emit")
         self._emit_batch(node, batch)
 
     # ----------------------------------------------------------- checkpoint

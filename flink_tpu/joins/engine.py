@@ -135,6 +135,9 @@ class JoinEngineBase:
         self.sides: List[Optional[JoinSideTable]] = [None, None]
         self._planes: List[Optional[tuple]] = [None, None]
         self._next_rid = 1
+        #: flight-recorder batch sequence (the join engines' analog of
+        #: MeshSpillSupport._flight_batch)
+        self._flight_batch = 0
         # sticky compile-shape tiers (per side where shapes differ)
         self._put_bucket = [0, 0]
         self._mirror_bucket = [0, 0]
@@ -177,8 +180,26 @@ class JoinEngineBase:
         """ONE batched D2H per harvest point (the TRC01 discipline)."""
         import jax
 
-        with self._wd_section(op):
+        from flink_tpu.observe import flight_recorder as flight
+
+        with flight.span("fire.harvest"), self._wd_section(op):
             return jax.device_get(tree)
+
+    def _flight_ingest(self):
+        """Open the ``batch.ingest`` flight span for one
+        ``process_batch`` (the one contract, shared with the mesh
+        engines — see flight_recorder.ingest_span)."""
+        from flink_tpu.observe import flight_recorder as flight
+
+        self._flight_batch += 1
+        return flight.ingest_span(self._flight_batch)
+
+    def _flight_fire(self, watermark: int):
+        """Open the ``fire.dispatch`` flight span for one
+        ``on_watermark`` (see flight_recorder.fire_span)."""
+        from flink_tpu.observe import flight_recorder as flight
+
+        return flight.fire_span(watermark)
 
     # ----------------------------------------------------------- data plane
 
@@ -976,8 +997,12 @@ class MeshIntervalJoinEngine(JoinEngineBase):
                       input_index: int = 0) -> List[RecordBatch]:
         if len(batch) == 0:
             return []
+        with self._flight_ingest():
+            return self._process_batch_inner(batch, int(input_index))
+
+    def _process_batch_inner(self, batch: RecordBatch,
+                             side_idx: int) -> List[RecordBatch]:
         self._wd_boundary()
-        side_idx = int(input_index)
         side = self._ensure_side(side_idx, batch)
         self._check_schema(side, batch, side_idx)
         keys = np.asarray(batch.key_ids, dtype=np.int64)
@@ -1013,11 +1038,12 @@ class MeshIntervalJoinEngine(JoinEngineBase):
         """Prune expired rows: a left row at t is dead once the
         watermark passes ``t + upper``; a right row at t once it passes
         ``t - lower`` (no right-side probe can still reach it)."""
-        self._wd_boundary()
-        if self.sides[0] is not None:
-            self.sides[0].prune(int(watermark) - self.upper)
-        if self.sides[1] is not None:
-            self.sides[1].prune(int(watermark) + self.lower)
+        with self._flight_fire(watermark):
+            self._wd_boundary()
+            if self.sides[0] is not None:
+                self.sides[0].prune(int(watermark) - self.upper)
+            if self.sides[1] is not None:
+                self.sides[1].prune(int(watermark) + self.lower)
         return []
 
     def _meta_snapshot(self) -> Dict[str, object]:
@@ -1045,6 +1071,11 @@ class MeshTemporalJoinEngine(JoinEngineBase):
                       input_index: int = 0) -> List[RecordBatch]:
         if len(batch) == 0:
             return []
+        with self._flight_ingest():
+            return self._process_batch_inner(batch, int(input_index))
+
+    def _process_batch_inner(self, batch: RecordBatch,
+                             input_index: int) -> List[RecordBatch]:
         self._wd_boundary()
         if int(input_index) == 0:
             late = np.asarray(batch.timestamps,
@@ -1073,8 +1104,11 @@ class MeshTemporalJoinEngine(JoinEngineBase):
         return np.maximum(pos, 0), ok.astype(np.int64)
 
     def on_watermark(self, watermark: int) -> List[RecordBatch]:
+        with self._flight_fire(watermark):
+            return self._on_watermark_inner(int(watermark))
+
+    def _on_watermark_inner(self, watermark: int) -> List[RecordBatch]:
         self._wd_boundary()
-        watermark = int(watermark)
         out: List[RecordBatch] = []
         if self._pending:
             left = (self._pending[0] if len(self._pending) == 1

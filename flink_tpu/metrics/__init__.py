@@ -6,6 +6,8 @@
 KNOWN_METRIC_GROUPS = (
     "autoscale",
     "chaos",
+    "flight",
+    "latency",
     "state",
     "tenancy",
     "watchdog",
@@ -28,4 +30,5 @@ from flink_tpu.metrics.traces import (  # noqa: E402,F401
     Span,
     SpanBuilder,
     TraceCollector,
+    default_collector,
 )

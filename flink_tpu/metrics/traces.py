@@ -55,6 +55,20 @@ class SpanBuilder:
                                  dict(self._attributes)))
 
 
+#: process-default collector: control-plane paths that are not owned by
+#: one job's executor (sharded checkpoint storage, the partial-failover
+#: protocol) report their restore/replay durations here so they are
+#: observable even when no per-job collector was threaded through
+_DEFAULT: Optional["TraceCollector"] = None
+
+
+def default_collector() -> "TraceCollector":
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = TraceCollector()
+    return _DEFAULT
+
+
 class TraceCollector:
     """Bounded in-memory span store; the REST layer and tests read it."""
 

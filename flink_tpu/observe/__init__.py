@@ -5,12 +5,57 @@ destabilize jit identities; this package proves the *running program*
 behaves: :mod:`~flink_tpu.observe.recompile_sentinel` counts actual XLA
 backend compiles and device->host materializations around an engine
 run and turns "the steady state recompiles" into an exception instead
-of a silent 2-5x throughput loss.
+of a silent 2-5x throughput loss, and
+:mod:`~flink_tpu.observe.flight_recorder` is the always-on span plane
+the whole batch lifecycle reports into (exported to Perfetto/Chrome
+traces, Prometheus histograms and event-time latency markers by
+:mod:`~flink_tpu.observe.export`).
 """
 
-from flink_tpu.observe.recompile_sentinel import (  # noqa: F401
+#: Canonical span-kind inventory — THE single source of truth shared by
+#: the flight recorder (an unregistered kind raises at the call site),
+#: the exporters (category mapping derives from this tuple) and flint's
+#: REG03 registry check (tools/flint). Adding an instrumentation point
+#: means adding its kind here; a typo in either direction — a call site
+#: not listed, or a listed kind with no call site — fails both gates.
+#: Keep this a plain literal tuple: flint parses it statically.
+KNOWN_SPAN_KINDS = (
+    # per-batch lifecycle (the engines' ingest -> emit pipeline)
+    "batch.ingest",        # one engine process_batch (host prep + dispatch)
+    "prep.meta_sweep",     # session-metadata absorb (native C or Python)
+    "prep.stage",          # shuffle staging / bucketing into [P, B] blocks
+    "device.dispatch",     # inline device interactions on the ingest path
+    "device.fence_wait",   # host blocked on dispatch-ahead fences
+    "fire.dispatch",       # watermark advance -> fire programs enqueued
+    "fire.shard",          # one shard's fire-path host work (resolve,
+                           # cold page extraction) — the per-shard track
+    "fire.harvest",        # D2H materialization of fire/query results
+    "op.process",          # executor: one operator's process_batch
+    "op.watermark",        # executor: one operator's process_watermark
+    "emit",                # executor: one output left its operator
+                           # (instant — durations belong to op.process)
+    # control plane
+    "checkpoint.write",
+    "checkpoint.restore",
+    "failover.replay",     # partial-failover bounded replay of one range
+    "reshard.handoff",     # live key-group migration between mesh sizes
+    "serving.lookup",      # one coalesced queryable-state flush
+    # instants correlated into the same timeline
+    "xla.compile",         # real XLA backend compile (jax.monitoring)
+    "d2h.transfer",        # device->host materialization (__array__)
+    "watchdog.miss",       # a deadline-tracked section ran past budget
+    "chaos.inject",        # an armed fault plan fired at a fault point
+)
+
+from flink_tpu.observe.recompile_sentinel import (  # noqa: E402,F401
     RecompileSentinel,
     SteadyStateViolation,
     compile_count,
     transfer_count,
+)
+from flink_tpu.observe.flight_recorder import (  # noqa: E402,F401
+    FlightRecorder,
+    SpanRecord,
+    install_probes,
+    recorder,
 )

@@ -29,25 +29,48 @@ while the compile count is exact on every backend.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, List, Optional
 
 #: the monitoring event emitted once per real XLA backend compilation
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 _probe_counts = {"compiles": 0, "transfers": 0}
 _installed = False
+#: subscriber fan-out: the hooks are installed ONCE process-wide
+#: (jax.monitoring has no listener deregistration), so other consumers
+#: of the same signals — the flight recorder correlates compiles and
+#: D2H materializations into its span timeline — subscribe here
+#: instead of double-wrapping __array__
+_compile_listeners: List[Callable[[float], None]] = []
+_transfer_listeners: List[Callable[[], None]] = []
 
 
 class SteadyStateViolation(AssertionError):
     """A guarded region compiled or transferred past its budget."""
 
 
+def add_compile_listener(cb: Callable[[float], None]) -> None:
+    """Subscribe ``cb(duration_secs)`` to real XLA backend compiles
+    (idempotent per callback)."""
+    if cb not in _compile_listeners:
+        _compile_listeners.append(cb)
+
+
+def add_transfer_listener(cb: Callable[[], None]) -> None:
+    """Subscribe ``cb()`` to device->host materializations (idempotent
+    per callback; best-effort, see module docstring)."""
+    if cb not in _transfer_listeners:
+        _transfer_listeners.append(cb)
+
+
 def _on_duration_event(name: str, secs: float, **kwargs) -> None:
     if name == _COMPILE_EVENT:
         _probe_counts["compiles"] += 1
+        for cb in _compile_listeners:
+            cb(secs)
 
 
-def _install() -> None:
+def install() -> None:
     """Idempotent one-time hook installation (listener + __array__
     wrapper). Deferred so importing flink_tpu never forces jax init."""
     global _installed
@@ -63,6 +86,8 @@ def _install() -> None:
 
         def _counting_array(self, *args, **kwargs):
             _probe_counts["transfers"] += 1
+            for cb in _transfer_listeners:
+                cb()
             return orig_array(self, *args, **kwargs)
 
         _xe.ArrayImpl.__array__ = _counting_array
@@ -71,6 +96,10 @@ def _install() -> None:
         # signal) installed above regardless
         pass
     _installed = True
+
+
+#: original (pre-rename) spelling, kept for existing callers
+_install = install
 
 
 def compile_count() -> int:

@@ -21,6 +21,7 @@ mutually restorable across engines and mesh sizes.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Dict, List, Optional, Tuple
 
@@ -31,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flink_tpu.chaos import injection as chaos
 from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
+from flink_tpu.observe import flight_recorder as flight
 from flink_tpu.ops.segment_ops import (
     SCATTER_METHOD,
     pad_bucket_size,
@@ -237,9 +239,13 @@ class MeshSessionEngine(MeshPagedSpillSupport):
     # ---------------------------------------------------------------- ingest
 
     def process_batch(self, batch: RecordBatch) -> None:
-        n = len(batch)
-        if n == 0:
+        if len(batch) == 0:
             return
+        with self._flight_ingest():
+            self._process_batch_inner(batch)
+
+    def _process_batch_inner(self, batch: RecordBatch) -> None:
+        n = len(batch)
         # batch boundary: the engine is consistent at a known source
         # position — the one point the watchdog may declare a shard dead
         self._wd_boundary()
@@ -292,19 +298,20 @@ class MeshSessionEngine(MeshPagedSpillSupport):
 
         from flink_tpu.windowing.session_meta import NativePlaneError
 
-        try:
-            res = self.meta.absorb_batch_ex(keys, ts,
-                                            want_fresh=self._paged)
-        except NativePlaneError as e:
-            # graceful degradation: the absorb is the batch's FIRST
-            # mutation (no device state touched yet), so the batch is
-            # re-runnable on the Python plane — once, loudly, instead
-            # of crashing the job (interval extends are idempotent, so
-            # the partially-swept metadata converges; value scatter has
-            # not happened)
-            self._meta_fallback(e)
-            res = self.meta.absorb_batch_ex(keys, ts,
-                                            want_fresh=self._paged)
+        with flight.span("prep.meta_sweep"):
+            try:
+                res = self.meta.absorb_batch_ex(keys, ts,
+                                                want_fresh=self._paged)
+            except NativePlaneError as e:
+                # graceful degradation: the absorb is the batch's FIRST
+                # mutation (no device state touched yet), so the batch
+                # is re-runnable on the Python plane — once, loudly,
+                # instead of crashing the job (interval extends are
+                # idempotent, so the partially-swept metadata converges;
+                # value scatter has not happened)
+                self._meta_fallback(e)
+                res = self.meta.absorb_batch_ex(keys, ts,
+                                                want_fresh=self._paged)
         sess_key, sess_sid = res.sess_key, res.sess_sid
         rec_to_sess, order, groups = res.rec_to_sess, res.order, res.groups
         for g in groups:
@@ -435,9 +442,10 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                      for v, l in zip(values, in_leaves)]]
         fills = [0, *[l.identity for l in in_leaves]]
         if self.shuffle_mode == "device":
-            dst, staged, width = stage_device_exchange(
-                rec_shards, self.P, columns=columns, fills=fills,
-                pool=self._shuffle_pool)
+            with flight.span("prep.stage"):
+                dst, staged, width = stage_device_exchange(
+                    rec_shards, self.P, columns=columns, fills=fills,
+                    pool=self._shuffle_pool)
             with self._device_span():
                 # ONE host->device hop: all flat columns in a single
                 # device_put, then the fused exchange+scatter program
@@ -448,9 +456,10 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             # is on the device queue, the host dies before the fence
             chaos.fault_point("shuffle.device_exchange", records=n)
         else:
-            counts, blocked = bucket_by_shard(
-                rec_shards, self.P, columns=columns, fills=fills,
-                pool=self._shuffle_pool)
+            with flight.span("prep.stage"):
+                counts, blocked = bucket_by_shard(
+                    rec_shards, self.P, columns=columns, fills=fills,
+                    pool=self._shuffle_pool)
             slot_block = blocked[0]
             value_blocks = blocked[1:]
             with self._device_span():
@@ -565,6 +574,11 @@ class MeshSessionEngine(MeshPagedSpillSupport):
     def on_watermark(self, watermark: int,
                      async_ok: bool = False) -> List[RecordBatch]:
         self._wd_boundary()
+        with flight.fire_span(watermark):
+            return self._on_watermark_inner(watermark, async_ok)
+
+    def _on_watermark_inner(self, watermark: int,
+                            async_ok: bool = False) -> List[RecordBatch]:
         pop = self.meta.pop_fired_ex(watermark)
         keys, starts, ends, sids = pop.keys, pop.starts, pop.ends, pop.sids
         hint = pop.slot_hint
@@ -722,6 +736,10 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                 res_pos.append(np.empty(0, dtype=np.int64))
                 res_slots.append(np.empty(0, dtype=np.int32))
                 continue
+            # per-shard attribution: this shard's fire-path host work
+            # (slot resolve + cold page extraction) lands on its own
+            # Perfetto track — "shard 3 is slow" reads off the trace
+            _t_shard = time.perf_counter()
             idx = self.indexes[p]
             ks, ss = k_arr[sel], sid_arr[sel]
             if slot_hint is not None:
@@ -766,6 +784,8 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             if len(rslots):
                 idx.free_slots(rslots, keys=ks[hit], nss=ss[hit])
                 self._dirty[p, rslots] = False
+            flight.instant("fire.shard", shard=p,
+                           duration_s=time.perf_counter() - _t_shard)
         # device part: fire + reset over resident rows only, fused into
         # ONE delta-harvest program (the fire outputs are fresh buffers,
         # so async reads never race the donated reset)

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flink_tpu.chaos import injection as chaos
 from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
+from flink_tpu.observe import flight_recorder as flight
 from flink_tpu.ops.segment_ops import (
     SCATTER_METHOD,
     MERGE_FN,
@@ -80,7 +81,11 @@ class _DeviceSpan:
         return self
 
     def __exit__(self, *exc) -> None:
-        self._owner.device_inline_s += time.perf_counter() - self._t0
+        dt = time.perf_counter() - self._t0
+        self._owner.device_inline_s += dt
+        # same section, same number, into the flight-recorder timeline —
+        # the bench breakdown and a Perfetto trace read ONE measurement
+        flight.instant("device.dispatch", duration_s=dt)
 
 
 class MeshSpillSupport:
@@ -180,6 +185,18 @@ class MeshSpillSupport:
         #: from process_batch wall time to report genuine host prep.
         if not hasattr(self, "device_inline_s"):
             self.device_inline_s = 0.0
+        #: monotonically increasing per-engine batch sequence — the
+        #: flight recorder's batch_id attribution (survives reshard)
+        if not hasattr(self, "_flight_batch"):
+            self._flight_batch = 0
+
+    def _flight_ingest(self):
+        """Open the ``batch.ingest`` span for one ``process_batch`` and
+        advance the engine's batch sequence (sub-spans and instants
+        opened below it inherit the batch id from the ambient thread
+        context)."""
+        self._flight_batch += 1
+        return flight.ingest_span(self._flight_batch)
 
     def _device_span(self) -> "_DeviceSpan":
         """Context manager accumulating into ``device_inline_s`` —
@@ -235,7 +252,7 @@ class MeshSpillSupport:
     def _harvest_get(self, tree, op: str = "fire_harvest"):
         """The watchdog-sectioned form of the batched-D2H harvest (ONE
         ``jax.device_get`` per harvest point — the TRC01 discipline)."""
-        with self._wd_section(op):
+        with flight.span("fire.harvest"), self._wd_section(op):
             return jax.device_get(tree)
 
     def make_fence(self):
@@ -258,7 +275,9 @@ class MeshSpillSupport:
                 # only when the host ran a full pipeline depth ahead of
                 # the device
                 self._dispatch_fences.popleft().block_until_ready()
-        self.pipeline_wait_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.pipeline_wait_s += dt
+        flight.instant("device.fence_wait", duration_s=dt)
 
     def _push_dispatch_fence(self) -> None:
         # chaos: a fence failure mid-dispatch-ahead — the batch's device
@@ -718,22 +737,26 @@ class MeshSpillSupport:
                 f"cannot reshard to {new_shards} shards: only "
                 f"{len(jax.devices())} devices exist")
         t0 = time.perf_counter()
-        # quiesce: prove the device consumed every staged host buffer
-        # before the staging pool and the accumulator plane are replaced
-        while self._dispatch_fences:
-            # flint: disable=TRC01 -- reshard quiesce: the mesh plane is
-            # about to be torn down, every in-flight dispatch must land
-            self._dispatch_fences.popleft().block_until_ready()
-        chaos.fault_point("rescale.handoff", stage="drain",
-                          from_shards=self.P, to_shards=new_shards)
-        rows = self._collect_handoff()
-        old_p = self.P
-        self._rebuild_mesh_plane(new_shards, devices)
-        # the hardest crash point: old state lifted, new plane empty —
-        # recovery is restore-from-checkpoint (the engine object is dead)
-        chaos.fault_point("rescale.handoff", stage="commit",
-                          from_shards=old_p, to_shards=new_shards)
-        resident_rows, spilled_rows = self._redistribute_handoff(rows)
+        with flight.span("reshard.handoff"):
+            # quiesce: prove the device consumed every staged host buffer
+            # before the staging pool and the accumulator plane are
+            # replaced
+            while self._dispatch_fences:
+                # flint: disable=TRC01 -- reshard quiesce: the mesh plane
+                # is about to be torn down, every in-flight dispatch must
+                # land
+                self._dispatch_fences.popleft().block_until_ready()
+            chaos.fault_point("rescale.handoff", stage="drain",
+                              from_shards=self.P, to_shards=new_shards)
+            rows = self._collect_handoff()
+            old_p = self.P
+            self._rebuild_mesh_plane(new_shards, devices)
+            # the hardest crash point: old state lifted, new plane empty
+            # — recovery is restore-from-checkpoint (the engine object is
+            # dead)
+            chaos.fault_point("rescale.handoff", stage="commit",
+                              from_shards=old_p, to_shards=new_shards)
+            resident_rows, spilled_rows = self._redistribute_handoff(rows)
         self.reshards_completed += 1
         self.last_reshard = {
             "from": old_p, "to": new_shards,
@@ -1762,9 +1785,13 @@ class MeshWindowEngine(MeshSpillSupport):
         return groups if len(groups) > 1 else None
 
     def process_batch(self, batch: RecordBatch) -> None:
-        n = len(batch)
-        if n == 0:
+        if len(batch) == 0:
             return
+        with self._flight_ingest():
+            self._process_batch_inner(batch)
+
+    def _process_batch_inner(self, batch: RecordBatch) -> None:
+        n = len(batch)
         # batch boundary: the engine is consistent at a known source
         # position — the one point the watchdog may declare a shard dead
         self._wd_boundary()
@@ -1929,6 +1956,11 @@ class MeshWindowEngine(MeshSpillSupport):
     def on_watermark(self, watermark: int,
                      async_ok: bool = False) -> List[RecordBatch]:
         self._wd_boundary()
+        with flight.fire_span(watermark):
+            return self._on_watermark_inner(watermark, async_ok)
+
+    def _on_watermark_inner(self, watermark: int,
+                            async_ok: bool = False) -> List[RecordBatch]:
         out: List[RecordBatch] = []
         while True:
             w_end = self.book.next_window(watermark)

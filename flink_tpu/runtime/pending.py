@@ -63,14 +63,16 @@ class PendingFire:
         import jax
 
         from flink_tpu.chaos import injection as chaos
+        from flink_tpu.observe import flight_recorder as flight
 
         # chaos: a harvest failure — the fire was dispatched but its
         # D2H results never land (link loss mid-coalesced-harvest)
         chaos.fault_point("harvest.pending_fire",
                           arrays=len(self.arrays))
-        if self.watchdog is not None:
-            with self.watchdog.section("pending_harvest"):
+        with flight.span("fire.harvest"):
+            if self.watchdog is not None:
+                with self.watchdog.section("pending_harvest"):
+                    host = jax.device_get(self.arrays)
+            else:
                 host = jax.device_get(self.arrays)
-        else:
-            host = jax.device_get(self.arrays)
-        return self.build([np.asarray(a) for a in host])
+            return self.build([np.asarray(a) for a in host])

@@ -186,6 +186,16 @@ class DeviceWatchdog:
             elif not failed:
                 self._misses[p] = 0
                 self._last_beat[p] = now
+        if missed:
+            # one instant per missed section (not per implicated shard:
+            # a whole-mesh section carries no shard attribution) — lands
+            # in the flight-recorder timeline next to the batch/fire
+            # spans that were running when the device went quiet
+            from flink_tpu.observe import flight_recorder as flight
+
+            flight.instant("watchdog.miss",
+                           shard=shard if 0 <= shard < self.num_shards
+                           else -1)
 
     # ------------------------------------------------------------- boundary
 

@@ -384,6 +384,14 @@ class ServingPlane:
         return co
 
     def _flush(self, job_name: str, operator: str, keys, namespace):
+        from flink_tpu.observe import flight_recorder as flight
+
+        with flight.span("serving.lookup", job=job_name):
+            return self._flush_inner(job_name, operator, keys,
+                                     namespace)
+
+    def _flush_inner(self, job_name: str, operator: str, keys,
+                     namespace):
         from flink_tpu.cluster.local_executor import (
             StateQueryBatchRequest,
         )

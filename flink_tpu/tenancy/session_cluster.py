@@ -232,6 +232,12 @@ class SessionCluster:
                 continue
             live = True
             t0 = time.perf_counter()
+            # flight attribution follows the scheduler: every span the
+            # quantum records (engine ingest, fires, harvests) carries
+            # THIS tenant's name — one Perfetto pid per job
+            from flink_tpu.observe import flight_recorder as flight
+
+            flight.set_job(name)
             with PROGRAM_CACHE.job_scope(name):
                 while self.drr.can_run(name) and not job.finished:
                     try:

@@ -150,6 +150,8 @@ async function viewJob(jobId) {
       return;
     }
     if (!live() || editingInView()) return;
+    // flatten the metrics payload ONCE; both panels read the same map
+    const flatMetrics = flattenMetrics(metrics);
     const hist = job.state_history || [];
     const started = hist.length ? hist[0].ts : null;
     const uptime = started ? ((Date.now() / 1000) - started) : null;
@@ -175,8 +177,9 @@ async function viewJob(jobId) {
       <h2>Job plan</h2>
       ${plan && plan.plan ? renderDag(plan.plan) :
         `<p class="hint">plan unavailable</p>`}
+      ${renderLatencyPanel(flatMetrics)}
       <h2>Metrics</h2>
-      ${renderMetrics(jobId, metrics)}
+      ${renderMetrics(jobId, metrics, flatMetrics)}
       ${job.error ? `<h2>Error</h2>
         <pre class="block error">${esc(job.error)}</pre>` : ""}
       <h2>State history</h2>
@@ -254,15 +257,9 @@ function renderDag(plan) {
 }
 function trunc(s, n) { s = String(s || ""); return s.length > n ? s.slice(0, n - 1) + "…" : s; }
 
-/* metrics: numeric leaves as sparkline cards (history accumulates while
-   the view is open), non-numeric in a table */
-function renderMetrics(jobId, payload) {
-  if (!payload || !payload.metrics ||
-      !Object.keys(payload.metrics).length) {
-    return `<p class="hint">${esc(payload && payload.note ||
-      "no metrics yet")}</p>`;
-  }
+function flattenMetrics(payload) {
   const flat = {};
+  if (!payload || !payload.metrics) return flat;
   (function walk(obj, prefix) {
     Object.entries(obj).forEach(([k, v]) => {
       const name = prefix ? `${prefix}.${k}` : k;
@@ -270,6 +267,53 @@ function renderMetrics(jobId, payload) {
       else flat[name] = v;
     });
   })(payload.metrics, "");
+  return flat;
+}
+
+/* latency panel: per-operator fire p50/p99 (the latency-tier signal),
+   watermark lag vs the sources' frontier and LatencyMarker p99 —
+   pulled from the .window / .latency metric groups the executor
+   registers, same reservoirs the tier-1 fire-p99 gate reads */
+function renderLatencyPanel(flat) {
+  const ops = {};
+  Object.entries(flat).forEach(([k, v]) => {
+    let m = k.match(/^(.*)\.window\.(fireLatencyP50Ms|fireLatencyP99Ms|fireCount)$/);
+    if (m) { (ops[m[1]] ||= {})[m[2]] = v; return; }
+    m = k.match(/^(.*)\.latency\.(watermarkLagMs)$/);
+    if (m) { (ops[m[1]] ||= {})[m[2]] = v; return; }
+    m = k.match(/^(.*)\.latency\.markerLatencyMs\.(p99)$/);
+    if (m) (ops[m[1]] ||= {})["markerP99"] = v;
+  });
+  const names = Object.keys(ops).filter(op =>
+    Object.keys(ops[op]).length);
+  if (!names.length) return "";
+  const rows = names.sort().map(op => {
+    const d = ops[op];
+    const short = op.split(".").pop();
+    return `<tr><td title="${esc(op)}">${esc(short)}</td>
+      <td class="num">${fmt(d.fireLatencyP50Ms ?? "")}</td>
+      <td class="num">${fmt(d.fireLatencyP99Ms ?? "")}</td>
+      <td class="num">${fmt(d.fireCount ?? "")}</td>
+      <td class="num">${fmt(d.watermarkLagMs ?? "")}</td>
+      <td class="num">${fmt(d.markerP99 ?? "")}</td></tr>`;
+  }).join("");
+  return `<h2>Latency</h2>
+    <table><thead><tr><th>Operator</th>
+      <th class="num">Fire p50 (ms)</th><th class="num">Fire p99 (ms)</th>
+      <th class="num">Fires</th><th class="num">Watermark lag (ms)</th>
+      <th class="num">Marker p99 (ms)</th></tr></thead>
+    <tbody>${rows}</tbody></table>`;
+}
+
+/* metrics: numeric leaves as sparkline cards (history accumulates while
+   the view is open), non-numeric in a table */
+function renderMetrics(jobId, payload, flat) {
+  if (!payload || !payload.metrics ||
+      !Object.keys(payload.metrics).length) {
+    return `<p class="hint">${esc(payload && payload.note ||
+      "no metrics yet")}</p>`;
+  }
+  flat = flat || flattenMetrics(payload);
   const numeric = [], other = [];
   Object.entries(flat).forEach(([k, v]) =>
     (typeof v === "number" ? numeric : other).push([k, v]));

@@ -1,0 +1,347 @@
+"""Flight recorder: the always-on span plane + its exporters.
+
+reference test model: the reference's metric/trace reporting tests
+(SURVEY §5 — spans, latency markers, the webmonitor), applied to the
+per-batch recorder of flink_tpu.observe.flight_recorder.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu.observe import KNOWN_SPAN_KINDS
+from flink_tpu.observe import flight_recorder as flight
+from flink_tpu.observe.export import (
+    breakdown_from_kind_totals,
+    chrome_trace,
+    register_flight_metrics,
+    validate_trace_schema,
+)
+from flink_tpu.observe.flight_recorder import FlightRecorder
+
+
+@pytest.fixture()
+def rec():
+    r = flight.recorder()
+    r.clear()
+    return r
+
+
+class TestRecorder:
+    def test_span_records_duration_and_attribution(self, rec):
+        flight.set_job("t-job")
+        flight.set_batch(41)
+        with flight.span("batch.ingest", batch=42):
+            time.sleep(0.002)
+        got = [r for r in rec.snapshot() if r.kind == "batch.ingest"]
+        assert got, "span not recorded"
+        r = got[-1]
+        assert r.job == "t-job"
+        assert r.batch_id == 42
+        assert not r.instant
+        assert r.duration_s >= 0.002
+
+    def test_ambient_context_inherited_by_nested_spans(self, rec):
+        flight.set_job("ambient-job")
+        flight.set_batch(7)
+        flight.set_watermark(1234)
+        with flight.span("fire.dispatch"):
+            flight.instant("watchdog.miss", shard=3)
+        miss = [r for r in rec.snapshot()
+                if r.kind == "watchdog.miss"][-1]
+        assert miss.job == "ambient-job"
+        assert miss.batch_id == 7
+        assert miss.watermark == 1234
+        assert miss.shard == 3
+        assert miss.instant
+
+    def test_unknown_kind_raises(self, rec):
+        with pytest.raises(KeyError):
+            rec.span("no.such.kind")
+        with pytest.raises(KeyError):
+            rec.instant("no.such.kind")
+
+    def test_disabled_region_records_nothing(self, rec):
+        before = len(rec.snapshot())
+        with flight.disabled():
+            with flight.span("batch.ingest"):
+                pass
+            flight.instant("watchdog.miss")
+        assert len(rec.snapshot()) == before
+
+    def test_drop_oldest_bounds_memory(self):
+        # private instance: fill one thread's ring past capacity — the
+        # ring wraps (drop-oldest), never grows
+        r = FlightRecorder(KNOWN_SPAN_KINDS)
+        cap = r._ring().mask + 1
+        for _ in range(cap + 100):
+            r.instant("d2h.transfer")
+        assert r.dropped() == 100
+        assert len(r.snapshot()) == cap
+
+    def test_kind_totals_aggregates(self, rec):
+        for _ in range(5):
+            with flight.span("serving.lookup"):
+                pass
+        stats = rec.kind_totals()["serving.lookup"]
+        assert stats["count"] >= 5
+        assert stats["total_s"] >= 0
+        assert stats["p99_ms"] >= stats["p50_ms"] >= 0
+
+    def test_span_contexts_are_pooled(self, rec):
+        # entering/exiting spans reuses the per-thread pool — the hot
+        # path must not grow an object per span
+        ring = rec._ring()
+        with flight.span("emit"):
+            pass
+        n = len(ring.pool)
+        for _ in range(50):
+            with flight.span("emit"):
+                pass
+        assert len(ring.pool) == n
+
+    def test_registry_matches_recorder(self, rec):
+        assert rec.kinds == KNOWN_SPAN_KINDS
+        assert len(set(KNOWN_SPAN_KINDS)) == len(KNOWN_SPAN_KINDS)
+
+
+class TestChromeExport:
+    def test_pid_per_job_tid_per_shard(self, rec):
+        with flight.span("batch.ingest", job="job-a", batch=1):
+            pass
+        with flight.span("fire.shard", job="job-b", shard=3):
+            pass
+        trace = chrome_trace(
+            [r for r in rec.snapshot()
+             if r.job in ("job-a", "job-b")], anchor=rec.anchor)
+        evs = [e for e in trace["traceEvents"] if e["ph"] != "M"]
+        pids = {e["pid"] for e in evs}
+        assert len(pids) == 2, "one pid per job"
+        shard_ev = next(e for e in evs if e["name"] == "fire.shard")
+        assert shard_ev["tid"] == 4  # shard 3 -> tid 4 (0 is host)
+        names = {(e["pid"], e["args"]["name"])
+                 for e in trace["traceEvents"] if e["ph"] == "M"
+                 and e["name"] == "process_name"}
+        assert {n for _, n in names} == {"job-a", "job-b"}
+        thread_names = {e["args"]["name"]
+                        for e in trace["traceEvents"]
+                        if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert "shard-3" in thread_names
+        # shard-less spans ride PER-THREAD host tracks (concurrent
+        # threads must not interleave complete events on one tid)
+        assert any(n.startswith("host:") for n in thread_names)
+
+    def test_instants_are_instant_events(self, rec):
+        flight.instant("chaos.inject", job="job-i", shard=1)
+        trace = chrome_trace(
+            [r for r in rec.snapshot() if r.job == "job-i"])
+        ev = next(e for e in trace["traceEvents"]
+                  if e["name"] == "chaos.inject")
+        assert ev["ph"] == "i"
+        assert ev["args"]["shard"] == 1
+
+    def test_schema_validation_catches_drift(self):
+        good = {"traceEvents": [
+            {"ph": "X", "name": "batch.ingest", "dur": 5, "ts": 0,
+             "pid": 1, "tid": 0, "args": {"batch": 3}}]}
+        assert validate_trace_schema(good, KNOWN_SPAN_KINDS) == []
+        bad_kind = {"traceEvents": [
+            {"ph": "X", "name": "not.registered", "dur": 5, "ts": 0,
+             "pid": 1, "tid": 0, "args": {}}]}
+        assert validate_trace_schema(bad_kind, KNOWN_SPAN_KINDS)
+        no_batch = {"traceEvents": [
+            {"ph": "X", "name": "batch.ingest", "dur": 5, "ts": 0,
+             "pid": 1, "tid": 0, "args": {"batch": -1}}]}
+        assert validate_trace_schema(no_batch, KNOWN_SPAN_KINDS)
+
+
+class TestBreakdown:
+    def test_host_prep_excludes_device_and_fence(self):
+        kt = {
+            "batch.ingest": {"total_s": 10.0},
+            "device.dispatch": {"total_s": 3.0},
+            "device.fence_wait": {"total_s": 2.0},
+            "fire.dispatch": {"total_s": 1.5},
+            "fire.harvest": {"total_s": 0.5},
+        }
+        b = breakdown_from_kind_totals(kt, wall_s=20.0)
+        assert b["host_prep_s"] == pytest.approx(5.0)
+        assert b["device_step_s"] == pytest.approx(6.5)
+        assert b["harvest_s"] == pytest.approx(0.5)
+        assert b["host_prep_fraction"] == pytest.approx(0.25)
+
+    def test_empty_totals_zero_breakdown(self):
+        b = breakdown_from_kind_totals({}, wall_s=1.0)
+        assert b["host_prep_s"] == 0.0
+        assert b["device_step_s"] == 0.0
+
+
+class TestMetricExport:
+    def test_flight_group_gauges_render(self, rec):
+        from flink_tpu.metrics import MetricRegistry, PrometheusReporter
+
+        with flight.span("checkpoint.write"):
+            pass
+        registry = MetricRegistry()
+        register_flight_metrics(
+            registry.root_group("job", "x"), rec)
+        snap = registry.snapshot()
+        assert snap["job.x.flight.checkpoint_write_count"] >= 1
+        assert "job.x.flight.records_dropped" in snap
+        rep = PrometheusReporter()
+        rep.open(registry)
+        text = rep.render()
+        assert "checkpoint_write_p99_ms" in text
+
+
+class TestProbeCorrelation:
+    def test_compile_event_lands_in_timeline(self, rec):
+        from flink_tpu.observe import recompile_sentinel as rs
+
+        flight.install_probes()
+        before = rec.kind_totals().get("xla.compile",
+                                       {}).get("count", 0)
+        # drive the monitoring listener directly: one "real" backend
+        # compile of 12.5 ms
+        rs._on_duration_event(
+            "/jax/core/compile/backend_compile_duration", 0.0125)
+        got = [r for r in rec.snapshot() if r.kind == "xla.compile"]
+        assert got, "compile not correlated into the timeline"
+        assert got[-1].duration_s == pytest.approx(0.0125, abs=1e-6)
+        after = rec.kind_totals()["xla.compile"]["count"]
+        assert after == before + 1
+
+    def test_watchdog_miss_instant(self, rec):
+        from flink_tpu.runtime.watchdog import DeviceWatchdog
+
+        clock = [0.0]
+        wd = DeviceWatchdog(num_shards=2, deadline_ms=1.0,
+                            clock=lambda: clock[0])
+        with wd.section("probe", shard=1):
+            clock[0] += 0.5  # 500 ms >> the 1 ms deadline
+        misses = [r for r in rec.snapshot()
+                  if r.kind == "watchdog.miss"]
+        assert misses and misses[-1].shard == 1
+
+    def test_chaos_injection_instant(self, rec):
+        import flink_tpu.chaos as chaos
+
+        plan = chaos.FaultPlan(rules=[
+            chaos.FaultRule("serving.lookup", nth=1)])
+        with chaos.chaos_active(plan, seed=7):
+            with pytest.raises(chaos.InjectedFault):
+                chaos.fault_point("serving.lookup", shard=2)
+        inj = [r for r in rec.snapshot() if r.kind == "chaos.inject"]
+        assert inj and inj[-1].shard == 2
+
+
+class TestExecutorIntegration:
+    def test_job_spans_latency_markers_and_flight_metrics(self, tmp_path):
+        from flink_tpu.core.config import Configuration
+        from flink_tpu.connectors.sinks import CollectSink
+        from flink_tpu.datastream.environment import (
+            StreamExecutionEnvironment,
+        )
+        from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+        rec = flight.recorder()
+        rec.clear()
+        conf = Configuration({
+            "state.checkpoints.dir": str(tmp_path / "ckpt"),
+            "execution.checkpointing.every-n-source-batches": 1,
+        })
+        env = StreamExecutionEnvironment(conf)
+        sink = CollectSink()
+        rows = [{"k": i % 3, "v": 1, "ts": i * 100} for i in range(200)]
+        env.from_collection(rows, timestamp_field="ts") \
+            .key_by("k").window(TumblingEventTimeWindows.of(1000)) \
+            .sum("v").sink_to(sink)
+        result = env.execute("flight-job")
+        kinds = {r.kind for r in rec.snapshot()
+                 if r.job == "flight-job"}
+        # executor lifecycle spans, attributed to THIS job
+        assert {"op.process", "op.watermark", "emit",
+                "checkpoint.write"} <= kinds
+        snap = result.registry.snapshot()
+        # latency markers: per-operator histogram + watermark lag
+        marker_keys = [k for k in snap
+                       if k.endswith("latency.markerLatencyMs.count")]
+        assert marker_keys and any(snap[k] > 0 for k in marker_keys)
+        assert any(k.endswith("latency.watermarkLagMs") for k in snap)
+        # per-span-kind aggregates at the REGISTRY ROOT: the recorder
+        # is process-global, so the rollups are not claimed by one job
+        assert snap["flight.op_process_count"] > 0
+
+    def test_restore_records_checkpoint_restore_span(self, tmp_path):
+        from flink_tpu.core.config import Configuration
+        from flink_tpu.connectors.sinks import CollectSink
+        from flink_tpu.datastream.environment import (
+            StreamExecutionEnvironment,
+        )
+        from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+        ckpt = tmp_path / "ckpt"
+        conf = Configuration({
+            "state.checkpoints.dir": str(ckpt),
+            "execution.checkpointing.every-n-source-batches": 1,
+        })
+
+        def build(env):
+            sink = CollectSink()
+            rows = [{"k": i % 3, "v": 1, "ts": i * 100}
+                    for i in range(100)]
+            env.from_collection(rows, timestamp_field="ts") \
+                .key_by("k").window(TumblingEventTimeWindows.of(1000)) \
+                .sum("v").sink_to(sink)
+
+        env = StreamExecutionEnvironment(conf)
+        build(env)
+        env.execute("restore-a")
+        import os
+
+        chks = sorted(p for p in os.listdir(ckpt)
+                      if p.startswith("chk-"))
+        rec = flight.recorder()
+        rec.clear()
+        env2 = StreamExecutionEnvironment(conf)
+        build(env2)
+        result = env2.execute("restore-b",
+                              restore_from=str(ckpt / chks[-1]))
+        assert [r for r in rec.snapshot()
+                if r.kind == "checkpoint.restore"]
+        assert result.traces.spans("recovery")
+
+
+class TestShardedCheckpointSpans:
+    def test_write_and_restore_report_spans(self, tmp_path):
+        from flink_tpu.checkpoint.sharded import ShardedCheckpointStorage
+        from flink_tpu.metrics.traces import TraceCollector
+
+        tc = TraceCollector()
+        storage = ShardedCheckpointStorage(str(tmp_path), traces=tc)
+        units = {
+            (0, 63): {"table": {"key_id": np.arange(3)}},
+            (64, 127): {"table": {"key_id": np.arange(2)}},
+        }
+        storage.write_checkpoint(1, "job", units,
+                                 {(0, 63): 10, (64, 127): 10})
+        writes = tc.spans("checkpoint")
+        assert writes and writes[-1].attributes["units"] == 2
+        assert writes[-1].attributes["checkpointId"] == 1
+        found = storage.latest_units_for_groups(range(0, 40))
+        assert found is not None and found[0] == 1
+        restores = tc.spans("recovery")
+        assert restores
+        assert restores[-1].attributes["checkpointId"] == 1
+        assert restores[-1].duration_ms >= 0
+
+    def test_default_collector_used_when_unthreaded(self, tmp_path):
+        from flink_tpu.checkpoint.sharded import ShardedCheckpointStorage
+        from flink_tpu.metrics.traces import default_collector
+
+        storage = ShardedCheckpointStorage(str(tmp_path))
+        before = len(default_collector().spans("checkpoint"))
+        storage.write_checkpoint(
+            1, "job", {(0, 7): {"table": {}}}, {(0, 7): 0})
+        assert len(default_collector().spans("checkpoint")) == before + 1

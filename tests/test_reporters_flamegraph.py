@@ -1,0 +1,157 @@
+"""Direct coverage for metrics/reporters.py text rendering and
+metrics/flamegraph.py folding — both previously tested only through
+smoke paths (reference: flink-metrics-prometheus reporter tests +
+VertexFlameGraph factory tests).
+"""
+
+import threading
+import time
+
+from flink_tpu.metrics import (
+    MetricRegistry,
+    PrometheusReporter,
+)
+from flink_tpu.metrics.core import Meter, SettableGauge
+from flink_tpu.metrics.flamegraph import sample_flame_graph
+from flink_tpu.metrics.reporters import _prom_name
+
+
+class TestPrometheusRendering:
+    def _render(self, registry):
+        rep = PrometheusReporter()
+        rep.open(registry)
+        return rep.render()
+
+    def test_histogram_quantiles_are_real_values(self):
+        reg = MetricRegistry()
+        h = reg.root_group("job", "q").histogram("lat")
+        for v in range(1, 101):
+            h.update(float(v))
+        text = self._render(reg)
+        lines = {l.split(" ")[0]: l for l in text.splitlines()
+                 if l and not l.startswith("#")}
+        # quantile sample lines carry the histogram's actual data, and
+        # the summary count line matches the update count
+        p50 = next(l for l in text.splitlines()
+                   if 'quantile="0.5"' in l)
+        p99 = next(l for l in text.splitlines()
+                   if 'quantile="0.99"' in l)
+        assert 45.0 <= float(p50.rsplit(" ", 1)[1]) <= 55.0
+        assert float(p99.rsplit(" ", 1)[1]) >= 95.0
+        count_line = next(k for k in lines if "lat_count" in k)
+        assert lines[count_line].rsplit(" ", 1)[1] == "100"
+
+    def test_name_sanitization(self):
+        # scopes/names with Prometheus-hostile characters render as
+        # legal metric names (only [a-zA-Z0-9_:])
+        assert _prom_name(("flink_tpu", "win agg#3", "fire-p99.ms")) \
+            == "flink_tpu_win_agg_3_fire_p99_ms"
+        reg = MetricRegistry()
+        reg.root_group("job", "my job!").counter("weird metric#1").inc(2)
+        text = self._render(reg)
+        for line in text.splitlines():
+            if line.startswith("#") or not line:
+                continue
+            name = line.split("{")[0].split(" ")[0]
+            assert all(c.isalnum() or c in "_:" for c in name), line
+
+    def test_deep_scope_renders_as_labels(self):
+        reg = MetricRegistry()
+        reg.root_group("job", "j1", "op#2").counter("numRecordsIn").inc(5)
+        text = self._render(reg)
+        line = next(l for l in text.splitlines()
+                    if "numRecordsIn" in l and not l.startswith("#"))
+        assert 'scope_0="job"' in line and 'scope_1="j1"' in line
+        assert line.endswith(" 5")
+
+    def test_meter_renders_as_gauge_rate(self):
+        reg = MetricRegistry()
+        m = reg.root_group("job", "j").meter("throughput")
+        assert isinstance(m, Meter)
+        m.mark(10)
+        time.sleep(0.01)
+        m.mark(10)
+        text = self._render(reg)
+        assert "# TYPE flink_tpu_j_throughput gauge" in text
+
+    def test_non_numeric_gauges_are_skipped(self):
+        reg = MetricRegistry()
+        g = reg.root_group("job", "j")
+        g.gauge("shape", lambda: "rows=[1,2]")
+        g.gauge("flag", lambda: True)  # bools are not samples either
+        sg = g.settable_gauge("depth", 0)
+        assert isinstance(sg, SettableGauge)
+        sg.set(3)
+        text = self._render(reg)
+        assert "shape" not in text
+        assert "flag" not in text
+        assert "flink_tpu_j_depth{" in text or \
+            "flink_tpu_j_depth " in text
+
+
+class TestFlameGraphFolding:
+    def _sample(self, prefixes, duration_ms=120):
+        stop = threading.Event()
+
+        def busy():
+            while not stop.is_set():
+                sum(range(500))
+
+        t = threading.Thread(target=busy, name="task-fold-test",
+                             daemon=True)
+        t.start()
+        try:
+            return sample_flame_graph(duration_ms=duration_ms,
+                                      interval_ms=10,
+                                      thread_name_prefixes=prefixes)
+        finally:
+            stop.set()
+
+    def test_d3_shape_and_parent_child_invariant(self):
+        fg = self._sample(["task-fold-"])
+        assert set(fg) == {"endTimestamp", "samples", "root"}
+        assert fg["samples"] > 0
+
+        def check(node):
+            assert set(node) == {"name", "value", "children"}
+            kid_sum = sum(c["value"] for c in node["children"])
+            # the d3 invariant: a parent's value covers its children
+            assert node["value"] >= kid_sum, node["name"]
+            for c in node["children"]:
+                check(c)
+
+        check(fg["root"])
+        # root accumulates one unit per thread-sample
+        assert fg["root"]["value"] == fg["samples"]
+
+    def test_children_sorted_by_weight(self):
+        fg = self._sample(["task-fold-"])
+
+        def check(node):
+            values = [c["value"] for c in node["children"]]
+            assert values == sorted(values, reverse=True)
+            for c in node["children"]:
+                check(c)
+
+        check(fg["root"])
+
+    def test_prefix_filter_excludes_everything_else(self):
+        fg = self._sample(["no-thread-has-this-prefix-"],
+                          duration_ms=40)
+        assert fg["samples"] == 0
+        assert fg["root"]["children"] == []
+
+    def test_sampler_thread_never_samples_itself(self):
+        fg = sample_flame_graph(duration_ms=40, interval_ms=10,
+                                thread_name_prefixes=None)
+        me = threading.current_thread().name
+
+        def names(node):
+            yield node["name"]
+            for c in node["children"]:
+                yield from names(c)
+
+        assert me not in set(n for n in names(fg["root"])
+                             if n == me) or True
+        # direct check: the calling thread's name is not a root child
+        assert me not in [c["name"] for c in fg["root"]["children"]]

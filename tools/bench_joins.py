@@ -17,7 +17,12 @@ Methodology matches bench.py: median of post-warm reps (best/all reps
 as secondary fields). ``fire_latency_ms`` reports the emit-latency
 percentiles — wall time from an arriving batch to its matches
 materialized on the host (the two-input analogue of window fire
-latency, so the matrix stays comparable).
+latency, so the matrix stays comparable). The ``breakdown`` field is
+derived from flight-recorder spans — the same spans a captured
+Perfetto trace of the run shows, never private driver timers. It
+reports span TOTALS (ingest / probe+prune / harvest): the join
+engines don't yet emit per-interaction device spans, so no host-prep
+split is claimed (the mesh-sessions bench owns that contract).
 
     BENCH_JOIN_RECORDS=... BENCH_JOIN_REPS=... \
         JAX_PLATFORMS=cpu python tools/bench_joins.py
@@ -69,14 +74,20 @@ def _mesh(shards=8):
 def _drive(engine, total, num_keys, rate, band_ms, seed):
     """Alternate left/right batches at ``rate`` events/s of event
     time; watermark trails by the band so pruning is live. Returns
-    (events, matches, emit-latency samples, wall seconds)."""
+    (events, matches, emit-latency samples, wall seconds, breakdown)
+    with the breakdown derived from this pass's flight-recorder
+    spans."""
     rng = np.random.default_rng(seed)
     from flink_tpu.core.records import (
         KEY_ID_FIELD,
         TIMESTAMP_FIELD,
         RecordBatch,
     )
+    from flink_tpu.observe import flight_recorder as flight
 
+    rec = flight.recorder()
+    flight.set_job("bench_joins")
+    rec.clear()
     events = matches = 0
     lat = []
     t0 = time.perf_counter()
@@ -99,7 +110,23 @@ def _drive(engine, total, num_keys, rate, band_ms, seed):
             events += n
         t = int(ts[-1]) + 1
         engine.on_watermark(t - band_ms)
-    return events, matches, lat, time.perf_counter() - t0
+    dt = time.perf_counter() - t0
+    # span-derived totals, NOT the mesh engines' host-prep breakdown:
+    # the join engines don't (yet) emit device.dispatch/fence spans,
+    # so a host_prep_s line here would claim their inline device work
+    # as host time — report only what the spans actually attribute
+    kt = rec.kind_totals()
+
+    def _tot(kind):
+        return round(kt.get(kind, {}).get("total_s", 0.0), 3)
+
+    breakdown = {
+        "ingest_s": _tot("batch.ingest"),
+        "probe_fire_s": _tot("fire.dispatch"),
+        "harvest_s": _tot("fire.harvest"),
+        "total_s": round(dt, 3),
+    }
+    return events, matches, lat, dt, breakdown
 
 
 def bench_q8(scale=1.0, reps=None):
@@ -126,8 +153,8 @@ def bench_q8(scale=1.0, reps=None):
            seed=1)  # warm
     runs = [_drive(make(), total, num_keys, rate, window_ms, seed=1)
             for _ in range(reps)]
-    evps = [ev / dt for ev, _, _, dt in runs]
-    ev, matches, lat, dt = runs[evps.index(_median(evps))]
+    evps = [ev / dt for ev, _, _, dt, _ in runs]
+    ev, matches, lat, dt, breakdown = runs[evps.index(_median(evps))]
     return {
         "metric": "nexmark_q8_windowed_join_events_per_sec",
         "value": round(_median(evps), 1),
@@ -136,6 +163,7 @@ def bench_q8(scale=1.0, reps=None):
         "unit": "events/s",
         "matches": int(matches),
         "fire_latency_ms": _latency(lat),
+        "breakdown": breakdown,
         "shape": (f"person/auction interval join, {num_keys:,} "
                   f"sellers, 10 s trailing window, "
                   f"{rate:,} ev/s/side event time, device-mode "
@@ -169,9 +197,9 @@ def bench_interval_10m(scale=1.0, reps=None):
         runs.append(_drive(eng, total, num_keys, rate, band_ms,
                            seed=2))
         spills.append(eng.spill_counters())
-    evps = [ev / dt for ev, _, _, dt in runs]
+    evps = [ev / dt for ev, _, _, dt, _ in runs]
     i = evps.index(_median(evps))
-    ev, matches, lat, dt = runs[i]
+    ev, matches, lat, dt, breakdown = runs[i]
     sp = spills[i]
     if os.environ.get("BENCH_JOIN_REQUIRE_SPILL") == "1" and (
             sp["rows_evicted"] == 0 or sp["cold_rows_served"] == 0):
@@ -185,6 +213,7 @@ def bench_interval_10m(scale=1.0, reps=None):
         "unit": "events/s",
         "matches": int(matches),
         "fire_latency_ms": _latency(lat),
+        "breakdown": breakdown,
         "spill": sp,
         "shape": (f"10M distinct keys, +-2 s band at {rate:,} ev/s "
                   f"of event time (~1.6M live rows vs "

@@ -33,13 +33,24 @@ Methodology matches ``bench.py``: one warm pass compiles the step
 programs, then BENCH_MESH_REPS (default 3) measured reps; the headline
 is the MEDIAN rep, with ``best_events_per_s`` / ``rep_events_per_s`` as
 secondary fields. Each rep also reports a host-prep vs device-step vs
-harvest wall-time breakdown plus the spill counters. The breakdown
-attributes DEVICE work surfacing inside ``process_batch`` — dispatch-
-fence blocks plus the engine-timed inline device interactions (the
-fused exchange dispatch, eviction gathers + D2H, reload puts; the CPU
-backend executes them inline in the dispatch call) — to
-``device_step_s``, so ``host_prep_s`` / ``host_prep_fraction`` measure
-genuine host work: sessionization, slot resolution, flat staging.
+harvest wall-time breakdown plus the spill counters. The breakdown is
+DERIVED FROM FLIGHT-RECORDER SPANS (``observe.flight_recorder`` +
+``observe.export.breakdown_from_kind_totals``), not private driver
+timers — the host-prep gate, a captured Perfetto trace and the
+dashboard all read the same measurements, so they cannot disagree.
+Host-prep attribution is unchanged from the timer era: device work
+surfacing inside ``process_batch`` — fence blocks
+(``device.fence_wait``) plus inline device interactions
+(``device.dispatch``: the fused exchange dispatch, eviction gathers +
+D2H, reload puts; the CPU backend executes them inline) — counts as
+``device_step_s``, so ``host_prep_s`` / ``host_prep_fraction`` (the
+gated number) measure genuine host work: sessionization, slot
+resolution, flat staging. ``harvest_s`` now counts ALL D2H
+materializations — including ones nested inside device interactions —
+so it can overlap ``device_step_s`` (the timer era reported only the
+post-loop drain there), and ``device_step_s`` includes the
+end-of-input drain fire (the old ``t_fire`` stopped at the loop; the
+drain is still separately visible as ``final_drain_ms``).
 
 Regression gates:
 
@@ -96,9 +107,16 @@ def run(total: int, mesh, batch: int = 1 << 16):
         TIMESTAMP_FIELD,
         RecordBatch,
     )
+    from flink_tpu.observe import flight_recorder as flight
+    from flink_tpu.observe.export import breakdown_from_kind_totals
     from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
     from flink_tpu.windowing.aggregates import SumAggregate
 
+    # the breakdown is derived from flight-recorder spans; a disabled
+    # recorder (the trace smoke's A/B baseline) yields a zeroed
+    # breakdown — main() refuses to GATE on one (vacuity guard there)
+    rec = flight.recorder()
+    flight.set_job("bench_mesh_sessions")
     eng = MeshSessionEngine(GAP_MS, SumAggregate("v"), mesh,
                             capacity_per_shard=BUDGET_PER_SHARD,
                             max_device_slots=BUDGET_PER_SHARD,
@@ -112,7 +130,10 @@ def run(total: int, mesh, batch: int = 1 << 16):
     pending = deque()  # (PendingFire, watermark-advance start time)
     lat = []  # fire latency: watermark advance -> results on host (ms)
     rate = 0.0  # EMA records/s, sizes the deadline splits
-    t_prep = t_fire = t_harvest = 0.0
+    # the breakdown reads per-kind span aggregates as a DELTA over this
+    # pass (clear() resets rings + aggregates; the pass's spans then
+    # also ARE the capturable trace — tools/trace_smoke.py reads them)
+    rec.clear()
 
     def harvest(bound=MAX_PENDING_FIRES):
         # coalesced harvest: drain everything whose copy already
@@ -182,18 +203,13 @@ def run(total: int, mesh, batch: int = 1 << 16):
                                                async_ok=True):
                         pending.append((pf, t_wm))
                     harvest()
-                t3 = time.perf_counter()
-                t_prep += t2 - t1
-                t_fire += t3 - t2
                 step_rate = (z - a) / max(t2 - t1, 1e-9)
                 rate = step_rate if rate <= 0 else 0.7 * rate + 0.3 * step_rate
             produced += b
         # drain the steady-state pending fires FIRST: harvested after the
         # shutdown flush below, their samples would carry the whole drain
         # span and pollute the p99 the gate reads
-        t5 = time.perf_counter()
         harvest(bound=0)
-        t_harvest += time.perf_counter() - t5
         # end-of-input: flush ALL remaining live sessions. This is the
         # shutdown DRAIN, not a steady-state watermark fire — it pops the
         # whole residual state by construction, so it is timed separately
@@ -205,37 +221,18 @@ def run(total: int, mesh, batch: int = 1 << 16):
         t_drain = time.perf_counter() - t5
         dt = time.perf_counter() - t0
         lat.sort()
-        # device work surfacing inside process_batch — fence blocks (device
-        # work the pipeline could not hide) plus the inline device
-        # interactions the engine itself timed (the fused in-program
-        # exchange dispatch, eviction gathers + D2H, reload puts; on the
-        # CPU backend these execute inline in the dispatch call) — is
-        # attributed to DEVICE time, so host_prep measures genuine host
-        # work: sessionization, slot resolution, flat staging
-        dev_in_prep = (float(getattr(eng, "pipeline_wait_s", 0.0))
-                       + float(getattr(eng, "device_inline_s", 0.0)))
-        host_prep = max(t_prep - dev_in_prep, 0.0)
-        breakdown = {
-            # host_prep: sessionization + slot resolution + flat staging
-            # (device mode) / bucketing (host mode) + dispatch bookkeeping,
-            # EXCLUDING fence blocks and inline device interactions
-            "host_prep_s": round(host_prep, 3),
-            # of which: time inside the NATIVE metadata sweeps (absorb /
-            # shard-group / route / pop — 0.0 on the pure-Python plane);
-            # pop sweeps land in the fire bucket, so this line can exceed
-            # neither bucket alone but attributes the C share explicitly
-            "native_sweep_s": round(
-                float(getattr(eng.meta, "native_sweep_s", 0.0)), 3),
-            # device_step: fire dispatch + the fire path's synchronous
-            # device work (page reloads / cohort evictions for cold fires)
-            # + the device share carved out of host prep
-            "device_step_s": round(t_fire + dev_in_prep, 3),
-            # harvest: materializing fired results on host (coalesced)
-            "harvest_s": round(t_harvest, 3),
-            "device_in_prep_s": round(dev_in_prep, 3),
-            "host_prep_fraction": round(host_prep / dt, 4),
-            "total_s": round(dt, 3),
-        }
+        # the breakdown comes FROM the recorder's span aggregates (see
+        # observe.export.breakdown_from_kind_totals for the attribution
+        # contract): host_prep = ingest spans minus the device.dispatch
+        # and device.fence_wait spans recorded under them — the same
+        # numbers a captured Perfetto trace of this pass shows
+        breakdown = breakdown_from_kind_totals(rec.kind_totals(), dt)
+        # of which: time inside the NATIVE metadata sweeps (absorb /
+        # shard-group / route / pop — 0.0 on the pure-Python plane);
+        # pop sweeps land in the fire bucket, so this line can exceed
+        # neither bucket alone but attributes the C share explicitly
+        breakdown["native_sweep_s"] = round(
+            float(getattr(eng.meta, "native_sweep_s", 0.0)), 3)
         from flink_tpu.metrics.core import quantile_sorted
 
         fire_latency = {
@@ -326,6 +323,17 @@ def main():
     }
     prep_budget = os.environ.get("BENCH_HOST_PREP_BUDGET")
     if prep_budget is not None and mode == "device":
+        from flink_tpu.observe import flight_recorder as flight
+
+        if not flight.enabled():
+            # no vacuous green: a disabled recorder zeroes the
+            # span-derived breakdown, which would always pass the gate
+            line["error"] = (
+                "host-prep gate needs the flight recorder: breakdown "
+                "is span-derived and FLINK_TPU_FLIGHT_RECORDER=0 "
+                "zeroes it")
+            print(json.dumps(line))
+            sys.exit(1)
         # the device-shuffle contract: host prep is a MINORITY share of
         # wall clock (the exchange runs inside the compiled program) —
         # a regression that moves exchange work back onto the host

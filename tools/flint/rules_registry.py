@@ -1,11 +1,11 @@
-"""REG01 / REG02 — the stringly-typed registry rules.
+"""REG01 / REG02 / REG03 — the stringly-typed registry rules.
 
-The codebase carries three name registries that only stay consistent by
-convention: chaos fault points, spill counters and metric groups. Each
-now has ONE canonical tuple in the package; these rules statically
-cross-check every literal producer and consumer against it, so a typo
-on either side fails CI instead of silently never injecting / never
-reporting.
+The codebase carries four name registries that only stay consistent by
+convention: chaos fault points, spill counters, metric groups and
+flight-recorder span kinds. Each has ONE canonical tuple in the
+package; these rules statically cross-check every literal producer and
+consumer against it, so a typo on either side fails CI instead of
+silently never injecting / never reporting / never recording.
 """
 
 from __future__ import annotations
@@ -267,3 +267,104 @@ class MetricCounterRegistry(Checker):
                 message=f"KNOWN_METRIC_GROUPS entry {name!r} has no "
                         "add_group producer in the package — stale "
                         "registry entry")
+
+
+# --------------------------------------------------------------------- REG03
+
+_SPAN_REGISTRY_FILE = "flink_tpu/observe/__init__.py"
+_FLIGHT_CALLS = ("span", "instant")
+#: call-site convention the rule keys on: the flight recorder is always
+#: imported as ``from flink_tpu.observe import flight_recorder as
+#: flight`` and used as ``flight.span("kind", ...)``
+_FLIGHT_RECEIVER = "flight"
+
+
+@register
+class SpanKindRegistry(Checker):
+    rule = "REG03"
+    title = ("flight-recorder span-kind literals cross-checked against "
+             "observe.KNOWN_SPAN_KINDS")
+
+    @staticmethod
+    def _flight_call(node: ast.Call, in_observe: bool) -> Optional[str]:
+        """The span-kind literal of a recorder call site, or None.
+
+        Matches ``flight.span("k")`` / ``flight.instant("k")`` (the
+        package-wide convention), plus bare ``span("k")`` /
+        ``instant("k")`` and ``recorder().span("k")`` inside the
+        observe package itself (the defining module and its tests use
+        the functions directly). The single-positional-string-literal
+        shape keeps ``TraceCollector.span(scope, name)`` — two
+        positional args — out of scope."""
+        func = node.func
+        name = recv = ""
+        if isinstance(func, ast.Attribute):
+            name = func.attr
+            if isinstance(func.value, ast.Name):
+                recv = func.value.id
+            elif in_observe and isinstance(func.value, ast.Call):
+                recv = _FLIGHT_RECEIVER  # recorder().span(...)
+        elif isinstance(func, ast.Name):
+            name = func.id
+            if in_observe:
+                recv = _FLIGHT_RECEIVER
+        if name not in _FLIGHT_CALLS or recv != _FLIGHT_RECEIVER:
+            return None
+        if len(node.args) != 1:
+            return None
+        return _literal_call_arg(node)
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        reg_sf = project.get(_SPAN_REGISTRY_FILE)
+        if reg_sf is None:
+            yield Violation(
+                rule=self.rule, path=_SPAN_REGISTRY_FILE, line=1, col=0,
+                message="observe package not found — cannot check span "
+                        "kinds")
+            return
+        parsed = _string_tuple(reg_sf, "KNOWN_SPAN_KINDS")
+        if parsed is None:
+            yield Violation(
+                rule=self.rule, path=reg_sf.path, line=1, col=0,
+                message="no literal KNOWN_SPAN_KINDS tuple — the "
+                        "canonical span-kind inventory must be a "
+                        "module-level string tuple here")
+            return
+        reg_line, names = parsed
+        known = set(names)
+        if len(names) != len(known):
+            yield Violation(
+                rule=self.rule, path=reg_sf.path, line=reg_line, col=0,
+                message="KNOWN_SPAN_KINDS contains duplicates")
+        produced: Set[str] = set()
+        scan = project.package_files("flink_tpu") \
+            + project.aux_glob("tools/*.py") \
+            + project.aux_glob("tests/*.py")
+        for sf in scan:
+            if sf.tree is None:
+                continue
+            in_observe = sf.path.startswith("flink_tpu/observe/") \
+                or sf.path.startswith("tests/")
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                lit = self._flight_call(node, in_observe)
+                if lit is None:
+                    continue
+                if lit not in known:
+                    yield Violation(
+                        rule=self.rule, path=sf.path, line=node.lineno,
+                        col=node.col_offset,
+                        message=f"span kind {lit!r} is not in "
+                                "observe.KNOWN_SPAN_KINDS — register "
+                                "it (and its exporter category) or fix "
+                                "the typo")
+                elif sf.path.startswith("flink_tpu/"):
+                    produced.add(lit)
+        for name in sorted(known - produced):
+            yield Violation(
+                rule=self.rule, path=reg_sf.path, line=reg_line, col=0,
+                message=f"KNOWN_SPAN_KINDS entry {name!r} has no "
+                        "flight.span/flight.instant call site in the "
+                        "package — the instrumentation point went "
+                        "stale")

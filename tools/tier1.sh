@@ -89,6 +89,23 @@ if [ "${SKIP_BENCH_SMOKE:-0}" != "1" ]; then
     JAX_PLATFORMS=cpu timeout -k 10 600 \
     python tools/bench_mesh_sessions.py || exit 1
 
+  # Trace smoke: the flight recorder's gate at the SAME bench shape —
+  # (1) a captured Chrome/Perfetto trace must be schema-valid (every
+  #     event a registered KNOWN_SPAN_KINDS kind, batch + watermark +
+  #     per-shard attribution present),
+  # (2) the measured pass must record 0 steady-state XLA compiles
+  #     (the compile-correlation agrees with the recompile sentinel),
+  # (3) recorder overhead must stay under 3% of the pass's wall
+  #     clock, gated on a DIRECT measurement (live-microbenched
+  #     per-record cost x the pass's actual record count / wall;
+  #     ~0.05% measured), with the A/B on/off throughput ratio
+  #     sanity-bounded at 15% — scheduler noise on this 1-core box is
+  #     ~±10%, so a tight A/B gate would flake on noise, not
+  #     regressions. ~25 s on CPU.
+  TRACE_SMOKE_RECORDS=$((1 << 20)) \
+    JAX_PLATFORMS=cpu timeout -k 10 300 \
+    python tools/trace_smoke.py || exit 1
+
   # Chaos smoke: seeded crash-restore-verify (3 injected engine crashes
   # — incl. the device data plane dying after the fused exchange
   # dispatch — + 1 torn checkpoint write over ~12k events) — FAILS on

@@ -1,0 +1,113 @@
+"""Capture a flight-recorder trace and write it as Perfetto-loadable
+Chrome trace-event JSON.
+
+Two capture shapes:
+
+- ``--shape mesh_sessions`` (default): drive the mesh-sessions bench
+  shape (``tools/bench_mesh_sessions.run`` — the row-5 thrashing shape,
+  scaled by ``--records``) and dump the pass's spans. The trace shows
+  the full per-batch hierarchy: ``batch.ingest`` with
+  ``prep.meta_sweep`` / ``prep.stage`` / ``device.dispatch`` /
+  ``device.fence_wait`` under it, ``fire.dispatch`` with per-shard
+  ``fire.shard`` tracks, coalesced ``fire.harvest`` spans, and any
+  ``xla.compile`` / ``d2h.transfer`` / ``watchdog.miss`` /
+  ``chaos.inject`` instants on the same clock.
+- ``--shape pipeline``: run a small end-to-end executor job
+  (source -> keyBy -> session window -> sink, checkpointing every
+  batch), so the executor-level spans (``op.process`` /
+  ``op.watermark`` / ``emit`` / ``checkpoint.write``) appear too.
+
+Open the output at https://ui.perfetto.dev (or chrome://tracing). One
+pid per job, one tid per shard; shard-less spans ride the "host" track.
+
+    JAX_PLATFORMS=cpu python tools/trace_capture.py --out /tmp/trace.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def capture_mesh_sessions(records: int) -> None:
+    import jax
+
+    from flink_tpu.parallel.mesh import make_mesh
+    from tools.bench_mesh_sessions import run
+
+    mesh = make_mesh(min(len(jax.devices()), 8))
+    run(min(records, 1 << 20), mesh)   # warm: compiles stay out of the
+    run(records, mesh)                 # captured steady-state pass
+
+
+def capture_pipeline(records: int) -> None:
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.connectors.sinks import CollectSink
+    from flink_tpu.datastream.environment import (
+        StreamExecutionEnvironment,
+    )
+    from flink_tpu.windowing.assigners import EventTimeSessionWindows
+
+    conf = Configuration({
+        "state.checkpoints.dir": "/tmp/flink-tpu-trace-capture-ckpt",
+        "execution.checkpointing.every-n-source-batches": 4,
+    })
+    env = StreamExecutionEnvironment(conf)
+    rows = [{"k": i % 64, "v": 1, "ts": i * 7} for i in range(records)]
+    env.from_collection(rows, timestamp_field="ts") \
+        .key_by("k").window(EventTimeSessionWindows.with_gap(50)) \
+        .sum("v").sink_to(CollectSink())
+    env.execute("trace-capture")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="/tmp/flink_tpu_trace.json")
+    ap.add_argument("--shape", default="mesh_sessions",
+                    choices=("mesh_sessions", "pipeline"))
+    ap.add_argument("--records", type=int,
+                    default=int(os.environ.get("TRACE_CAPTURE_RECORDS",
+                                               1 << 20)))
+    args = ap.parse_args()
+
+    import warnings
+
+    warnings.filterwarnings("ignore")
+    from flink_tpu.observe import install_probes
+    from flink_tpu.observe import flight_recorder as flight
+    from flink_tpu.observe.export import write_chrome_trace
+
+    if not flight.enabled():
+        print("flight recorder is disabled "
+              "(FLINK_TPU_FLIGHT_RECORDER=0) — nothing to capture",
+              file=sys.stderr)
+        return 1
+    install_probes()
+    if args.shape == "mesh_sessions":
+        capture_mesh_sessions(args.records)
+    else:
+        capture_pipeline(args.records)
+    rec = flight.recorder()
+    n = write_chrome_trace(args.out, rec)
+    kinds = sorted(rec.kind_totals())
+    print(json.dumps({
+        "trace": args.out,
+        "events": n,
+        "dropped_oldest": rec.dropped(),
+        "span_kinds": kinds,
+        "open_with": "https://ui.perfetto.dev",
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
