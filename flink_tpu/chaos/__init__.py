@@ -38,6 +38,7 @@ KNOWN_FAULT_POINTS = (
     "join.exchange",
     "join.versioned_lookup",
     "serving.lookup",
+    "serving.replica_publish",
     "harvest.pending_fire",
     "task.batch",
     "task.subtask_batch",

@@ -223,6 +223,44 @@ class LatencyOptions:
         "ulp (exact for count/min/max and integer-valued sums).")
 
 
+class ServingOptions:
+    """The queryable-state read path (tenancy serving plane). The read
+    replica decouples lookups from ingest: engines publish a bounded
+    delta at fire/watermark boundaries into a double-buffered
+    device-resident replica, serving workers resolve misses against
+    the SEALED generation off the task loop, and the host hot-row
+    cache (generation-invalidated) absorbs repeat traffic without
+    touching the device at all. See README "Multi-tenant serving"."""
+
+    REPLICA = ConfigOption(
+        "serving.replica", default=True, type=bool,
+        description="Arm the read-replica serving plane for jobs "
+        "submitted to a tenancy session cluster: mesh engines publish "
+        "a boundary delta per watermark (one device-to-device copy "
+        "program, no D2H) and lookups resolve against the sealed "
+        "generation — snapshot isolation, zero contention with "
+        "ingest. false = every lookup takes the legacy control-queue "
+        "path, serialized behind the owning job's batch boundaries "
+        "(the pre-replica behavior; also the A/B lever the NOTES_r17 "
+        "measurements use). Plain LocalExecutor runs never arm a "
+        "replica regardless — publishing costs a per-boundary "
+        "metadata diff that only pays off when something reads it.")
+    PUBLISH_INTERVAL_MS = ConfigOption(
+        "serving.replica.publish-interval-ms", default=0, type=int,
+        description="Minimum milliseconds between replica publishes. "
+        "0 (default) publishes at every fire/watermark boundary — the "
+        "tightest staleness. > 0 batches boundaries under one publish: "
+        "the per-boundary metadata diff is paid once per interval and "
+        "the hot-row cache invalidates at a bounded rate (lookup "
+        "staleness stays <= the interval + one boundary). The serving "
+        "bench runs 25 ms; per-boundary costs only matter when "
+        "boundaries are much more frequent than readers need.")
+    # NOTE: the worker-pool size and hot-row cache capacity are
+    # CLUSTER-scoped (one serving plane serves every tenant), so they
+    # are constructor parameters of ServingPlane / SessionCluster, not
+    # per-job config options.
+
+
 class ExecutionModeOptions:
     """Bounded/batch execution (reference: RuntimeExecutionMode.BATCH,
     the adaptive batch scheduler deciding parallelism from data volume —

@@ -962,6 +962,225 @@ class JoinEngineBase:
                 totals[p] += n
         return totals
 
+    # --------------------------------------------------- read replica
+    # (tenancy/replica.py — publish hooks for the join side tables;
+    # rows are immutable after insert, so the boundary delta is pure
+    # identity churn: inserts, evictions, prunes)
+
+    #: per-side ReplicaPlane (armed by arm_side_replica)
+    _side_replicas = (None, None)
+
+    def arm_side_replica(self, side_idx: int):
+        """Arm a read replica over one side table (device backend; the
+        side must have seen its first batch — the value schema is
+        late-bound). Returns a
+        :class:`~flink_tpu.tenancy.replica.JoinSideReplicaAdapter`."""
+        from flink_tpu.tenancy.replica import (
+            JoinSideReplicaAdapter,
+            ReplicaPlane,
+        )
+
+        side = self.sides[side_idx]
+        if side is None:
+            raise RuntimeError(
+                "side table not initialized yet — the value schema is "
+                "observed at the side's first batch")
+        if self.backend != "device":
+            raise RuntimeError(
+                "side replicas ride the device value planes; the host "
+                "oracle backend serves reads directly")
+
+        class _Leaf:
+            def __init__(self, dtype):
+                self.dtype = dtype
+                self.identity = np.dtype(dtype).type(0)
+
+        plane = ReplicaPlane(
+            self.mesh, [_Leaf(side.schema[i][1])
+                        for i in side.device_cols], side.capacity)
+        plane.warm_tiers()
+        reps = list(self._side_replicas)
+        reps[side_idx] = plane
+        self._side_replicas = tuple(reps)
+        return JoinSideReplicaAdapter(plane, side)
+
+    def _publish_side_replicas(self, watermark: int) -> None:
+        for side_idx in (0, 1):
+            rep = self._side_replicas[side_idx]
+            side = self.sides[side_idx]
+            if rep is None or side is None:
+                continue
+            from flink_tpu.observe import flight_recorder as flight
+
+            with flight.span("serving.replica_publish",
+                             watermark=int(watermark)):
+                self._publish_one_side(rep, side, side_idx,
+                                       int(watermark))
+
+    def _publish_one_side(self, rep, side, side_idx: int,
+                          watermark: int) -> None:
+        """The join form of the boundary publish: derive per-slot
+        metadata from the sorted row metadata, diff against the
+        replica's shadow (rows are immutable — identity changes ARE
+        the delta), split disappeared rows into cold (still mapped in
+        the page tier) vs pruned, and hand the changed slots to the
+        shared publish program."""
+        if not hasattr(self, "_rep_last_rid"):
+            self._rep_last_rid = [0, 0]
+        if rep.needs_rebuild(self.P, side.capacity):
+            rep.rebuild(self.mesh, side.capacity)
+            rep.warm_tiers()
+            # a rebuild's republish covers resident rows; resetting the
+            # rid watermark makes every COLD row re-enter the index too
+            self._rep_last_rid[side_idx] = 0
+        last_rid = self._rep_last_rid[side_idx]
+        per_shard = {}
+        for p in range(self.P):
+            m = side.meta[p]
+            cap = side.capacity
+            cur_used = np.zeros(cap, dtype=bool)
+            cur_key = np.zeros(cap, dtype=np.int64)
+            cur_rid = np.zeros(cap, dtype=np.int64)
+            cur_ts = np.zeros(cap, dtype=np.int64)
+            res = np.nonzero(m.slot >= 0)[0]
+            slots_res = m.slot[res]
+            cur_used[slots_res] = True
+            cur_key[slots_res] = m.key[res]
+            cur_rid[slots_res] = m.rid[res]
+            cur_ts[slots_res] = m.ts[res]
+            r_used = rep.rep_used[p]
+            r_key = rep.rep_key[p]
+            r_rid = rep.rep_ns[p]
+            moved = (cur_key != r_key) | (cur_rid != r_rid)
+            ident_change = cur_used & (~r_used | moved)
+            up = np.nonzero(ident_change)[0]
+            gone = np.nonzero(r_used & (~cur_used | moved))[0]
+            cold: List[Tuple[int, int]] = []
+            freed: List[Tuple[int, int]] = []
+            if len(gone):
+                from flink_tpu.joins.side_table import _rid_positions
+
+                g_keys = r_key[gone].copy()
+                g_rids = r_rid[gone].copy()
+                # still resident at another slot? covered by its upsert
+                found, src = _rid_positions(m.rid, g_rids)
+                still = np.zeros(len(g_rids), dtype=bool)
+                still[found] = m.slot[src] >= 0
+                miss = ~still
+                if miss.any():
+                    mk, mr = g_keys[miss], g_rids[miss]
+                    is_cold = side.pmaps[p].spilled_mask(
+                        np.asarray(mr, dtype=np.int64))
+                    for j in range(len(mk)):
+                        if is_cold[j]:
+                            cold.append((int(mk[j]), int(mr[j]), None))
+                        else:
+                            freed.append((int(mk[j]), int(mr[j])))
+            # rows created AND evicted since the last publish (never
+            # resident at a boundary): rids are allocation-monotonic,
+            # so "new" is one vectorized compare
+            new_cold = np.nonzero((m.slot < 0) & (m.rid > last_rid))[0]
+            for pos in new_cold.tolist():
+                cold.append((int(m.key[pos]), int(m.rid[pos]),
+                             (int(m.ts[pos]), None)))
+            # extra payload: (ts, host-shadow column values) per row —
+            # device-ineligible columns never ride the device plane
+            extra = None
+            if len(up):
+                host_cols = [side.shadow[i][p][up]
+                             for i in side.host_cols]
+                extra = [
+                    (int(cur_ts[s]),
+                     tuple(hc[j] for hc in host_cols))
+                    for j, s in enumerate(up)]
+            per_shard[p] = {
+                "up_slots": up.astype(np.int32),
+                "up_keys": cur_key[up].copy(),
+                "up_ns": cur_rid[up].copy(),
+                "up_extra": extra,
+                "cold": cold,
+                "freed": freed,
+                "fresh": bool(ident_change.any()),
+            }
+            per_shard[p]["_shadow"] = (cur_used, cur_key, cur_rid)
+        # shadow + rid watermark update ONLY after the publish succeeds
+        # (a torn publish must leave the delta re-derivable)
+        rep.publish(self._planes[side_idx] or (), per_shard, watermark)
+        for p, d in per_shard.items():
+            cur_used, cur_key, cur_rid = d.pop("_shadow")
+            rep.rep_used[p][:] = cur_used
+            rep.rep_key[p][:] = cur_key
+            rep.rep_ns[p][:] = cur_rid
+        self._rep_last_rid[side_idx] = self._next_rid - 1
+
+    def query_side_batch(self, side_idx: int, key_ids
+                         ) -> List[List[dict]]:
+        """LIVE point lookup against one side table: per requested key,
+        the side's buffered rows as ``[{"ts", "rid", <col>: v}, ...]``
+        sorted by (ts, rid) — resident rows through ONE gather + ONE
+        device read, cold rows from their shards' page tiers
+        (``cold_rows_served`` counted). The replica staleness tests pin
+        the replica path bit-identical to this at every published
+        boundary (via a checkpoint round-trip)."""
+        side_idx = int(side_idx)
+        side = self.sides[side_idx]
+        key_ids = np.asarray(key_ids, dtype=np.int64)
+        n = len(key_ids)
+        results: List[List[dict]] = [[] for _ in range(n)]
+        if side is None or n == 0:
+            return results
+        shards = self._shards_of(key_ids)
+        #: (request row, meta position) per matched row, per shard
+        rows_of: Dict[int, List[Tuple[int, int]]] = {}
+        for p in np.unique(shards).tolist():
+            m = side.meta[p]
+            if not len(m):
+                continue
+            sel = np.nonzero(shards == p)[0]
+            lo = pair_lower_bound(m.key, m.ts, key_ids[sel],
+                                  np.full(len(sel), -(1 << 62)))
+            hi = pair_lower_bound(m.key, m.ts, key_ids[sel],
+                                  np.full(len(sel), (1 << 62)))
+            lanes = []
+            for j, r in enumerate(sel.tolist()):
+                for pos in range(int(lo[j]), int(hi[j])):
+                    lanes.append((r, pos))
+            if lanes:
+                rows_of[int(p)] = lanes
+        # resident values: one gather + one batched D2H for all shards
+        gathered = self._gather_rows(side_idx, {
+            p: np.clip(side.meta[p].slot[[pos for _, pos in lanes]],
+                       0, None)
+            for p, lanes in rows_of.items()}) if rows_of else {}
+        names = [nm for nm, _ in side.schema]
+        for p, lanes in rows_of.items():
+            m = side.meta[p]
+            cold_wants: List[Tuple[int, int, int]] = []
+            sinks = [np.zeros(len(lanes), dtype=dt)
+                     for _, dt in side.schema]
+            rows_arr = np.arange(len(lanes))
+            for j, (r, pos) in enumerate(lanes):
+                if m.slot[pos] < 0:
+                    cold_wants.append((j, int(m.key[pos]),
+                                       int(m.rid[pos])))
+                else:
+                    for i in side.shadow:
+                        sinks[i][j] = side.shadow[i][p][m.slot[pos]]
+                    gi = 0
+                    for i in side.device_cols:
+                        sinks[i][j] = gathered[p][gi][j]
+                        gi += 1
+            if cold_wants:
+                side.fill_cold(p, cold_wants, sinks, rows_arr)
+            for j, (r, pos) in enumerate(lanes):
+                row = {"ts": int(m.ts[pos]), "rid": int(m.rid[pos])}
+                for i, nm in enumerate(names):
+                    row[nm] = sinks[i][j].item()
+                results[r].append(row)
+        for r in range(n):
+            results[r].sort(key=lambda d: (d["ts"], d["rid"]))
+        return results
+
 
 class MeshIntervalJoinEngine(JoinEngineBase):
     """Keyed interval join over the dual slot tables (INNER)."""
@@ -1044,6 +1263,8 @@ class MeshIntervalJoinEngine(JoinEngineBase):
                 self.sides[0].prune(int(watermark) - self.upper)
             if self.sides[1] is not None:
                 self.sides[1].prune(int(watermark) + self.lower)
+        # replica publish AFTER the prunes of this boundary
+        self._publish_side_replicas(int(watermark))
         return []
 
     def _meta_snapshot(self) -> Dict[str, object]:
@@ -1105,7 +1326,10 @@ class MeshTemporalJoinEngine(JoinEngineBase):
 
     def on_watermark(self, watermark: int) -> List[RecordBatch]:
         with self._flight_fire(watermark):
-            return self._on_watermark_inner(int(watermark))
+            out = self._on_watermark_inner(int(watermark))
+        # replica publish AFTER this boundary's probes/compaction
+        self._publish_side_replicas(int(watermark))
+        return out
 
     def _on_watermark_inner(self, watermark: int) -> List[RecordBatch]:
         self._wd_boundary()

@@ -40,6 +40,11 @@ KNOWN_SPAN_KINDS = (
     "failover.replay",     # partial-failover bounded replay of one range
     "reshard.handoff",     # live key-group migration between mesh sizes
     "serving.lookup",      # one coalesced queryable-state flush
+    "serving.replica_publish",  # boundary publish of the read replica
+                           # (batch field carries the sealed generation)
+    "serving.cache_hit",   # hot-row cache served a lookup batch without
+                           # touching the device (instant; batch field
+                           # carries the generation the hits were tagged)
     # instants correlated into the same timeline
     "xla.compile",         # real XLA backend compile (jax.monitoring)
     "d2h.transfer",        # device->host materialization (__array__)

@@ -394,6 +394,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             for p, (a, b) in shard_slices.items():
                 slot_sorted[a:b] = resolved[p]
                 self._dirty[p, resolved[p]] = True
+                self._rep_mark(p, resolved[p])
             # fold the resolved slots into the metadata rows so the
             # NEXT batch's resolve skips the probe (native plane only)
             self.meta.note_slots(key_sorted, sid_sorted, slot_sorted,
@@ -412,6 +413,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                     sess_key[sel], sess_sid[sel])
                 slot_of_sess[sel] = slots
                 self._dirty[p, slots] = True
+                self._rep_mark(p, slots)
             slot_sorted = slot_of_sess[sorted_idx]
 
         # route records: each record scatters into its session's slot on
@@ -510,6 +512,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             c = len(both) // 2
             d_slots, s_slots = both[:c], both[c:]
             self._dirty[p, d_slots] = True
+            self._rep_mark(p, d_slots)
             per_shard.append((d_slots.astype(np.int32),
                               s_slots.astype(np.int32)))
             m_max = max(m_max, c)
@@ -575,7 +578,45 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                      async_ok: bool = False) -> List[RecordBatch]:
         self._wd_boundary()
         with flight.fire_span(watermark):
-            return self._on_watermark_inner(watermark, async_ok)
+            out = self._on_watermark_inner(watermark, async_ok)
+        # replica publish AFTER this boundary's fires/frees (outside
+        # the fire span — serving-plane work, budgeted under its own
+        # serving.replica_publish span)
+        self._publish_replica(watermark)
+        return out
+
+    # -------------------------------------------------- replica hooks
+
+    def _rep_extra(self, p: int, keys: np.ndarray, nss: np.ndarray):
+        """The session END per published (key, sid) row — the result
+        key of the serving composition ({session_end -> columns}).
+        One interval-list scan per KEY (not per row): this runs on the
+        task thread inside the boundary publish, where the fire-
+        deadline budget lives."""
+        out = np.zeros(len(keys), dtype=np.int64)
+        sessions = self.meta.sessions
+        by_key: Dict[int, List[int]] = {}
+        for j in range(len(keys)):
+            by_key.setdefault(int(keys[j]), []).append(j)
+        for key, idxs in by_key.items():
+            ivs = sessions.get(key, ())
+            if not ivs:
+                continue
+            end_of = {int(iv[2]): int(iv[1]) for iv in ivs}
+            for j in idxs:
+                out[j] = end_of.get(int(nss[j]), 0)
+        return out
+
+    def _rep_probe_cold(self, p: int, keys: np.ndarray,
+                        nss: np.ndarray) -> np.ndarray:
+        """A session that left the resident set is COLD iff its sid is
+        still mapped in the shard's page tier (paged layout) or its
+        namespace is spilled (registry layout); otherwise it fired/
+        merged away and the index entry drops."""
+        if self._paged:
+            return self._pmaps[p].spilled_mask(
+                np.asarray(nss, dtype=np.int64))
+        return super()._rep_probe_cold(p, keys, nss)
 
     def _on_watermark_inner(self, watermark: int,
                             async_ok: bool = False) -> List[RecordBatch]:
@@ -1078,6 +1119,9 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         self._freed_ns.clear()
         for sp in self.spills:
             sp.clear_dirty()
+        # restored values bypass the scatter sites — the replica shadow
+        # is stale wholesale; republish at the next boundary
+        self._rep_rebuild = True
         self.meta.restore(snap, key_group_filter=key_group_filter,
                           max_parallelism=self.max_parallelism)
 

@@ -255,6 +255,196 @@ class MeshSpillSupport:
         with flight.span("fire.harvest"), self._wd_section(op):
             return jax.device_get(tree)
 
+    # ---------------------------------------------------- read replica
+    # (tenancy/replica.py — the boundary-published serving plane)
+
+    #: armed by the tenancy layer (session cluster / tests); None keeps
+    #: every hook a single attribute check on the ingest path
+    _replica = None
+    #: set where the replica's shadow of the slot metadata goes stale
+    #: wholesale (restore, reshard, shard loss) — the next publish
+    #: rebuilds the plane and republishes every resident row
+    _rep_rebuild = False
+
+    def arm_replica(self, plane=None):
+        """Attach (or build) the read-replica plane this engine
+        publishes into at watermark boundaries. Must run on the task
+        thread (single-owner), before or between batches."""
+        from flink_tpu.tenancy.replica import ReplicaPlane
+
+        if plane is None:
+            plane = ReplicaPlane(self.mesh, self.agg.leaves,
+                                 self.capacity)
+        plane.warm_tiers()
+        self._replica = plane
+        self._rep_cold_pending: Dict[int, list] = {}
+        self._rep_rebuild = True
+        return plane
+
+    def _rep_note_cold(self, p: int, keys, nss) -> None:
+        """Record rows leaving residency (evictions) so a row created
+        AND evicted within one publish interval still reaches the
+        replica index as a cold entry at the next boundary."""
+        if self._replica is not None:
+            self._rep_cold_pending.setdefault(p, []).append(
+                (np.asarray(keys, dtype=np.int64).copy(),
+                 np.asarray(nss, dtype=np.int64).copy()))
+
+    def _rep_mark(self, p: int, slots) -> None:
+        """Note value-changing scatters for the next publish delta
+        (residency/identity changes are derived by the publish diff
+        instead — see _publish_replica). While a rebuild is pending
+        (reshard/restore/growth changed the plane shape under the
+        shadow) marks are moot — the rebuild republishes everything."""
+        rep = self._replica
+        if rep is not None and not self._rep_rebuild \
+                and not rep.needs_rebuild(self.P, self.capacity):
+            rep.mark_dirty(p, slots)
+
+    def _rep_extra(self, p: int, keys: np.ndarray,
+                   nss: np.ndarray):
+        """Per-row adapter payload published with the index entries
+        (sessions: the session END; windows: none — the namespace IS
+        the slice end)."""
+        return None
+
+    def _rep_probe_cold(self, p: int, keys: np.ndarray,
+                        nss: np.ndarray) -> np.ndarray:
+        """For pairs that left the resident set since the last publish:
+        True = the row serves from the page tier (evicted), False =
+        freed (fired/expired — drop from the index). Namespace-layout
+        default: a namespace present in the shard's spill tier is cold
+        (eviction moves whole namespaces)."""
+        nsset = set(int(x) for x in self.spills[p].namespaces) \
+            if self._spill_active else set()
+        return np.asarray([int(ns) in nsset for ns in nss], dtype=bool)
+
+    def _publish_replica(self, watermark: int) -> None:
+        """Publish the boundary delta into the replica plane: diff the
+        engine's per-shard slot metadata against the replica's shadow
+        (plus the scatter-site dirty marks), hand the changed slots to
+        ONE device-to-device copy program, and seal the next
+        generation. Runs at the END of on_watermark — the fires and
+        frees of this boundary are already applied, so the sealed view
+        is exactly the engine state a checkpoint cut here would
+        capture."""
+        rep = self._replica
+        if rep is None:
+            return
+        if rep.min_interval_s and not self._rep_rebuild:
+            s = rep.sealed
+            if s is not None and (time.monotonic() - s.published_at
+                                  < rep.min_interval_s):
+                # batch this boundary into the next publish: the dirty
+                # marks keep accumulating, the diff/copy cost is paid
+                # once per interval, and the cache invalidation rate is
+                # bounded (staleness <= the interval, by construction)
+                return
+        with flight.span("serving.replica_publish",
+                         watermark=int(watermark)):
+            include_spilled = False
+            if self._rep_rebuild or rep.needs_rebuild(self.P,
+                                                      self.capacity):
+                rep.rebuild(self.mesh, self.capacity)
+                rep.warm_tiers()
+                self._rep_cold_pending = {}
+                self._rep_rebuild = False
+                # the rebuild's full republish covers resident rows;
+                # rows already cold (restored/re-homed pages) must
+                # re-enter the index too — enumerated below
+                include_spilled = self._spill_active
+            per_shard = {}
+            for p in range(self.P):
+                idx = self.indexes[p]
+                used = idx.slot_used
+                L = len(used)
+                cur_used = np.asarray(used[:L], dtype=bool)
+                cur_key = np.asarray(idx.slot_key[:L])
+                cur_ns = np.asarray(idx.slot_ns[:L])
+                r_used = rep.rep_used[p][:L]
+                r_key = rep.rep_key[p][:L]
+                r_ns = rep.rep_ns[p][:L]
+                moved = (cur_key != r_key) | (cur_ns != r_ns)
+                ident_change = cur_used & (~r_used | moved)
+                up = np.nonzero(ident_change
+                                | (rep.rep_dirty[p][:L] & cur_used))[0]
+                gone = np.nonzero(r_used & (~cur_used | moved))[0]
+                cold: List[Tuple[int, int]] = []
+                freed: List[Tuple[int, int]] = []
+                if len(gone):
+                    g_keys = r_key[gone].copy()
+                    g_ns = r_ns[gone].copy()
+                    # a pair re-homed to a NEW slot is covered by its
+                    # upsert there; only pairs no longer resident at
+                    # all need the cold/freed split
+                    miss = idx.lookup(g_keys, g_ns) < 0
+                    if miss.any():
+                        mk, mn = g_keys[miss], g_ns[miss]
+                        is_cold = self._rep_probe_cold(p, mk, mn)
+                        for j in range(len(mk)):
+                            if is_cold[j]:
+                                cold.append((int(mk[j]), int(mn[j]),
+                                             None))
+                            else:
+                                freed.append((int(mk[j]), int(mn[j])))
+                # rows created AND evicted since the last publish were
+                # never resident at a boundary — the eviction sites
+                # recorded them; enter them cold (skipping any that
+                # reloaded back to residency, covered by the diff)
+                pend = self._rep_cold_pending.get(p)
+                if pend:
+                    pk = np.concatenate([a for a, _ in pend])
+                    pn = np.concatenate([b for _, b in pend])
+                    nonres = idx.lookup(pk, pn) < 0
+                    if nonres.any():
+                        ck, cn = pk[nonres], pn[nonres]
+                        still = self._rep_probe_cold(p, ck, cn)
+                        cx = self._rep_extra(p, ck, cn)
+                        for j in range(len(ck)):
+                            if still[j]:
+                                cold.append((
+                                    int(ck[j]), int(cn[j]),
+                                    None if cx is None else cx[j]))
+                    # cleared after the publish SUCCEEDS (torn-publish
+                    # re-derivability — see below)
+                up_keys = cur_key[up].copy()
+                up_ns = cur_ns[up].copy()
+                per_shard[p] = {
+                    "up_slots": up.astype(np.int32),
+                    "up_keys": up_keys,
+                    "up_ns": up_ns,
+                    "up_extra": self._rep_extra(p, up_keys, up_ns),
+                    "cold": cold,
+                    "freed": freed,
+                    "fresh": bool(ident_change.any()),
+                }
+                per_shard[p]["_shadow"] = (L, cur_used, cur_key, cur_ns)
+            if include_spilled:
+                cold0 = per_shard[0]["cold"]
+                for part in self._spill_snapshot_parts():
+                    ck = np.asarray(part["key_id"], dtype=np.int64)
+                    cn = np.asarray(part["namespace"], dtype=np.int64)
+                    cx = self._rep_extra(0, ck, cn)
+                    for j in range(len(ck)):
+                        cold0.append((int(ck[j]), int(cn[j]),
+                                      None if cx is None else cx[j]))
+                if cold0:
+                    per_shard[0]["fresh"] = True
+            # the metadata shadow, dirty marks and pending cold events
+            # update ONLY after the publish succeeds: a fault inside
+            # the publish (serving.replica_publish chaos, a device
+            # error) must leave the delta re-derivable — otherwise the
+            # torn boundary's rows silently never reach the replica
+            rep.publish(self.accs, per_shard, int(watermark))
+            for p, d in per_shard.items():
+                L, cur_used, cur_key, cur_ns = d.pop("_shadow")
+                rep.rep_used[p][:L] = cur_used
+                rep.rep_used[p][L:] = False
+                rep.rep_key[p][:L] = cur_key
+                rep.rep_ns[p][:L] = cur_ns
+                rep.rep_dirty[p][:] = False
+                self._rep_cold_pending[p] = []
+
     def make_fence(self):
         """A tiny non-donated device value enqueued AFTER everything
         dispatched so far — used by the engine's own dispatch-ahead
@@ -384,6 +574,9 @@ class MeshSpillSupport:
             }
             self.spills[p].put(ns, entry,
                                dirty=bool(self._dirty[p, slots].any()))
+            # replica: never-published rows going cold (see _evict_cohorts)
+            self._rep_note_cold(p, entry["key_id"],
+                                np.full(m, int(ns), dtype=np.int64))
             off += m
             self._ns_touch[p].pop(ns, None)
         self._ns_counters["pages_evicted"] += len(chosen)
@@ -881,6 +1074,9 @@ class MeshSpillSupport:
         self.release_memory()
         self.mesh = mesh
         self.P = int(mesh.devices.size)
+        # the replica's metadata shadow describes the OLD plane — the
+        # next boundary publish rebuilds it over the new mesh
+        self._rep_rebuild = True
         self._sharding = NamedSharding(mesh, P(KEY_AXIS))
         if hasattr(self, "_replicated"):
             self._replicated = NamedSharding(mesh, P())
@@ -1127,6 +1323,9 @@ class MeshSpillSupport:
         Restored rows are CLEAN — they are in the checkpoint, so the
         next delta must not re-ship them; survivors keep their genuine
         dirtiness. Returns rows restored."""
+        # restored values bypass the scatter sites: the replica shadow
+        # cannot tell them apart — republish wholesale
+        self._rep_rebuild = True
         table = snap.get("table", {}) or {}
         key_ids = np.asarray(table.get("key_id", []), dtype=np.int64)
         gset = np.asarray(sorted(int(g) for g in groups),
@@ -1547,6 +1746,10 @@ class MeshPagedSpillSupport(MeshSpillSupport):
                 **{f"leaf_{i}": g[p][:n]
                    for i, g in enumerate(gathered_host)},
             }
+            # replica: a row evicted before it was ever published
+            # resident must still enter the index cold at the next
+            # boundary (the publish drains these events)
+            self._rep_note_cold(p, entry["key_id"], entry["ns"])
             spill_page(self.spills[p], self._pmaps[p], entry)
             idx.free_slots(chosen)
             self._dirty[p, chosen] = False
@@ -1871,6 +2074,7 @@ class MeshWindowEngine(MeshSpillSupport):
             slot_block[p, :c] = self.indexes[p].lookup_or_insert(
                 key_block[p, :c], ns_block[p, :c])
             self._dirty[p, slot_block[p, :c]] = True
+            self._rep_mark(p, slot_block[p, :c])
 
         step = self._valued_scatter_step if partial else self._scatter_step
         with self._device_span():
@@ -1914,6 +2118,7 @@ class MeshWindowEngine(MeshSpillSupport):
                 s_keys[a:b], s_ns[a:b])
             slots_sorted[a:b] = slots
             self._dirty[p, slots] = True
+            self._rep_mark(p, slots)
         rec_slots = np.empty(n, dtype=np.int32)
         rec_slots[order] = slots_sorted
         # pipelining: claim a dispatch slot BEFORE rewriting the pooled
@@ -1957,7 +2162,12 @@ class MeshWindowEngine(MeshSpillSupport):
                      async_ok: bool = False) -> List[RecordBatch]:
         self._wd_boundary()
         with flight.fire_span(watermark):
-            return self._on_watermark_inner(watermark, async_ok)
+            out = self._on_watermark_inner(watermark, async_ok)
+        # replica publish AFTER the fires/frees of this boundary (and
+        # outside the fire span — it is serving-plane work, budgeted
+        # under its own serving.replica_publish span)
+        self._publish_replica(watermark)
+        return out
 
     def _on_watermark_inner(self, watermark: int,
                             async_ok: bool = False) -> List[RecordBatch]:
@@ -2417,6 +2627,9 @@ class MeshWindowEngine(MeshSpillSupport):
         self._freed_ns.clear()
         for sp in self.spills:
             sp.clear_dirty()
+        # restored VALUES bypass the scatter sites — the replica shadow
+        # is stale wholesale; republish everything at the next boundary
+        self._rep_rebuild = True
         self.book.restore(snap)
 
     # ------------------------------------------------ partial-failover hooks

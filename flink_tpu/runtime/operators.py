@@ -654,6 +654,23 @@ class WindowAggOperator(Operator):
         self._fences.clear()
         return self.windower.reshard(new_shards)
 
+    # ------------------------------------------------------ replica serving
+
+    def arm_serving_replica(self, publish_interval_ms: float = 0.0):
+        """Arm the engine's read replica (tenancy/replica.py) and return
+        its serving adapter, or None when the engine cannot host one
+        (single-device layouts serve through the legacy control-queue
+        path). Must run on the task thread before/between batches — the
+        session cluster calls it at submit/restart."""
+        w = self.windower
+        if not hasattr(w, "arm_replica"):
+            return None
+        from flink_tpu.tenancy.replica import WindowReplicaAdapter
+
+        plane = w.arm_replica()
+        plane.min_interval_s = float(publish_interval_ms) / 1e3
+        return WindowReplicaAdapter(plane, w.agg, w.assigner)
+
     # ----------------------------------------------------- state observability
 
     def spill_counters(self) -> Optional[Dict[str, int]]:
@@ -747,6 +764,18 @@ class SessionWindowAggOperator(WindowAggOperator):
                 allowed_lateness=self.allowed_lateness,
                 spill=table_kwargs)
         self._resolve_async_fires(ctx)
+
+    def arm_serving_replica(self, publish_interval_ms: float = 0.0):
+        """Session form: the adapter composes {session_end -> columns}
+        from the published (key, sid) rows' END payloads."""
+        w = self.windower
+        if not hasattr(w, "arm_replica"):
+            return None
+        from flink_tpu.tenancy.replica import SessionReplicaAdapter
+
+        plane = w.arm_replica()
+        plane.min_interval_s = float(publish_interval_ms) / 1e3
+        return SessionReplicaAdapter(plane, w.agg)
 
     def query_state_batch(self, key_values, namespace=None):
         """Session variant: the keys' live sessions are host metadata

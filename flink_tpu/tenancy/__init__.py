@@ -16,10 +16,17 @@ hardware. Four pillars:
 - **Fair batch interleaving** (:mod:`fairness` + :mod:`session_cluster`):
   deficit-round-robin over per-job ready queues with per-job
   ``busyTimeMsTotal``, so one hot job cannot starve the rest.
-- **High-QPS serving plane** (:mod:`serving`): concurrent queryable-
-  state lookups coalesce into device batches — one gather program +
-  ONE ``jax.device_get`` per request batch (the flint TRC01
-  discipline), measured as the ``queryable_lookups_per_s`` bench row.
+- **Read-replica serving plane** (:mod:`serving` + :mod:`replica` +
+  :mod:`hot_cache`, r17): engines publish a bounded delta into a
+  double-buffered device-resident replica at fire/watermark
+  boundaries (snapshot isolation, zero contention with ingest); the
+  publish harvest primes a host hot-row cache so hot-key lookups
+  never touch the device, and cache misses batch per sealed
+  generation on sharded worker queues — one gather program + ONE
+  ``jax.device_get`` per miss batch (the flint TRC01 discipline),
+  measured as the ``queryable_lookups_per_s`` bench row. The legacy
+  control-queue coalescers remain for single-device engines and the
+  cold-row (page tier) detour.
 
 The autoscaler composes one level up (:mod:`arbiter`): shard budgets
 are arbitrated BETWEEN jobs (weighted by backlog + quota pressure),
@@ -40,6 +47,11 @@ _LAZY = {
     "DeficitRoundRobin": "flink_tpu.tenancy.fairness",
     "ServingPlane": "flink_tpu.tenancy.serving",
     "LookupCoalescer": "flink_tpu.tenancy.serving",
+    "ReplicaPlane": "flink_tpu.tenancy.replica",
+    "SessionReplicaAdapter": "flink_tpu.tenancy.replica",
+    "WindowReplicaAdapter": "flink_tpu.tenancy.replica",
+    "JoinSideReplicaAdapter": "flink_tpu.tenancy.replica",
+    "HotRowCache": "flink_tpu.tenancy.hot_cache",
     "ShardArbiter": "flink_tpu.tenancy.arbiter",
     "JobDemand": "flink_tpu.tenancy.arbiter",
     "SessionCluster": "flink_tpu.tenancy.session_cluster",

@@ -1,0 +1,175 @@
+"""Host-side hot-row cache over the replica serving plane.
+
+Fires and lookup harvests materialize exactly the HOT rows host-side;
+this cache retains those composed per-key results keyed ``(job,
+operator, key_id)`` and tagged with the replica GENERATION that
+produced them. Invalidation is the generation tag itself: a publish
+advances the generation, so the next probe of a stale entry misses
+(and drops it) — no flush pass, no timer. Between publishes, repeat
+lookups of hot keys never touch the device at all: the probe is one
+dict access under one lock.
+
+Capacity is bounded LRU (an ``OrderedDict``): a churning key space
+evicts the coldest entries instead of growing per historical key.
+The cached value is the composed result dict the operator's
+``query_state_batch`` would return — callers treat it as immutable
+(the serving plane hands the same object to concurrent riders).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+
+class HotRowCache:
+    """Generation-tagged LRU of composed lookup results."""
+
+    def __init__(self, max_entries: int = 1 << 18) -> None:
+        self.max_entries = int(max_entries)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, Tuple[int, Any]]" = \
+            OrderedDict()
+        #: counters read (under the lock) by the serving gauges and the
+        #: smoke's vacuity gate
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.primes = 0
+
+    def get(self, job: str, operator: str, key_id: int, gen: int,
+            exact: bool = True) -> Tuple[bool, Any]:
+        """(hit, value). ``exact=True``: only an entry tagged with the
+        CURRENT generation hits; older tags are dropped (pure
+        tag-invalidation — the mode when nothing re-primes entries).
+        ``exact=False`` (the primed serving path): ANY entry hits —
+        the publish harvest re-primes or drops every cached entry a
+        boundary changed, so an entry's presence IS its validity (an
+        unchanged key's value is by definition still its boundary
+        value)."""
+        k = (job, operator, key_id)
+        with self._lock:
+            ent = self._entries.get(k)
+            if ent is not None and (not exact or ent[0] == gen):
+                self._entries.move_to_end(k)
+                self.hits += 1
+                return True, ent[1]
+            if ent is not None:
+                del self._entries[k]
+            self.misses += 1
+            return False, None
+
+    def get_many(self, job: str, operator: str, key_ids, gen: int,
+                 out: list, misses: list, exact: bool = True) -> int:
+        """Batched probe under ONE lock acquisition: fills ``out[i]``
+        for hits, appends ``(i, key_id)`` to ``misses`` otherwise;
+        returns the hit count. The per-key locked ``get`` would spend
+        more time on lock traffic than on the probes at cache-hit QPS
+        (the serving hot loop). ``exact`` as in :meth:`get`."""
+        hits = 0
+        entries = self._entries
+        with self._lock:
+            for i, kid in enumerate(key_ids):
+                k = (job, operator, kid)
+                ent = entries.get(k)
+                if ent is not None and (not exact or ent[0] == gen):
+                    entries.move_to_end(k)
+                    out[i] = ent[1]
+                    hits += 1
+                    continue
+                if ent is not None:
+                    del entries[k]
+                misses.append((i, kid))
+            self.hits += hits
+            self.misses += len(misses)
+        return hits
+
+    def put(self, job: str, operator: str, key_id: int, gen: int,
+            value: Any) -> None:
+        k = (job, operator, key_id)
+        with self._lock:
+            ent = self._entries.get(k)
+            if ent is not None and ent[0] > gen:
+                # no downgrade: a worker that resolved against an older
+                # sealed generation must not overwrite a fresher prime
+                # (the stale value would then be served "forever" — no
+                # future prime touches a key that stops changing)
+                return
+            self._entries[k] = (gen, value)
+            self._entries.move_to_end(k)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+    def prime(self, job: str, operator: str, key_id: int, gen: int,
+              updates: Optional[dict] = None, remove=(),
+              insert_ok: bool = False) -> None:
+        """Publish-harvest feed: fold a boundary's changes into an
+        EXISTING entry (copy-on-write — readers hold references to the
+        old value dict) and retag it with the publishing generation.
+        ``insert_ok=True`` means ``updates`` is the key's COMPLETE
+        composed state (the adapter checked the delta covers every
+        published row of the key), so an absent entry may be created —
+        first-touch lookups of hot keys then hit without ever paying a
+        device round trip. Otherwise keys nobody cached are skipped."""
+        k = (job, operator, key_id)
+        with self._lock:
+            ent = self._entries.get(k)
+            if ent is None and not insert_ok:
+                return
+            if ent is not None and ent[0] > gen:
+                return
+            val = dict(ent[1]) if ent is not None else {}
+            for ns in remove:
+                val.pop(ns, None)
+            if updates:
+                val.update(updates)
+            self._entries[k] = (gen, val)
+            self._entries.move_to_end(k)
+            self.primes += 1
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+    def drop(self, job: str, operator: str, key_id: int) -> None:
+        with self._lock:
+            self._entries.pop((job, operator, key_id), None)
+
+    def invalidate_job(self, job: str) -> None:
+        """Drop a finished/unbound job's entries (the per-historical-job
+        leak rule the coalescer pool already follows)."""
+        with self._lock:
+            for k in [k for k in self._entries if k[0] == job]:
+                del self._entries[k]
+
+    def invalidate_op(self, job: str, operator: str) -> None:
+        """Drop one operator's entries — a replica REBUILD (restore/
+        reshard/shard loss) may roll values back, and the rebuild's
+        full republish only re-primes keys still present: entries for
+        keys that vanished across the restore would otherwise serve
+        stale forever."""
+        with self._lock:
+            for k in [k for k in self._entries
+                      if k[0] == job and k[1] == operator]:
+                del self._entries[k]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def hit_rate(self) -> float:
+        with self._lock:
+            total = self.hits + self.misses
+            return self.hits / total if total else 0.0
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "hot_row_hits": float(self.hits),
+                "hot_row_misses": float(self.misses),
+                "hot_row_evictions": float(self.evictions),
+                "hot_row_entries": float(len(self._entries)),
+                "hot_row_hit_rate": (self.hits / total) if total else 0.0,
+            }

@@ -29,6 +29,8 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+import numpy as np
+
 
 def reservoir_p99_ms(latencies) -> float:
     """p99 of a latency reservoir (ms); 0.0 when empty. Pays the one
@@ -333,16 +335,102 @@ class CoalescerPool:
         return lookup_stats_dict(lookups, batches, lat)
 
 
+class _RepPending:
+    """One rider of the replica serving path (shard-queue entry)."""
+
+    __slots__ = ("key", "key_id", "namespace", "result", "error", "done")
+
+    def __init__(self, key, key_id: int, namespace):
+        self.key = key
+        self.key_id = key_id
+        self.namespace = namespace
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+
+
+class _ReplicaWorker(threading.Thread):
+    """One serving worker: the single owner of its set of per-(job,
+    operator, shard) lookup queues. Riders enqueue misses; the worker
+    drains every owned queue each round, batches the entries per (job,
+    operator) against ONE sealed replica generation, and completes the
+    riders — multiple workers drain disjoint shard sets concurrently,
+    so one tenant's burst never serializes every tenant's traffic
+    behind a single drain loop (the pre-replica bottleneck)."""
+
+    def __init__(self, plane: "ServingPlane", idx: int) -> None:
+        super().__init__(name=f"serving-worker-{idx}", daemon=True)
+        self._plane = plane
+        self._lock = threading.Lock()
+        self._queues: Dict[tuple, deque] = {}
+        self._event = threading.Event()
+        self._stopped = False
+
+    def enqueue(self, qkey: tuple, entry: _RepPending) -> None:
+        with self._lock:
+            self._queues.setdefault(qkey, deque()).append(entry)
+        self._event.set()
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._event.set()
+
+    def fail_pending(self, reason: str) -> None:
+        """Complete any still-queued riders with an error (shutdown —
+        nothing will drain the queues again)."""
+        with self._lock:
+            leftovers = [e for q in self._queues.values() for e in q]
+            self._queues.clear()
+        for e in leftovers:
+            e.error = RuntimeError(reason)
+            e.done.set()
+
+    def run(self) -> None:
+        while not self._stopped:
+            self._event.wait(timeout=0.1)
+            self._event.clear()
+            while self._drain_round():
+                pass
+
+    def _drain_round(self) -> bool:
+        # pop everything queued this round (bounded: later arrivals
+        # land in the next round), grouped per (job, operator) — one
+        # replica batch per group per round
+        groups: Dict[tuple, List[_RepPending]] = {}
+        with self._lock:
+            for (job, op, _shard), q in self._queues.items():
+                if q:
+                    groups.setdefault((job, op), []).extend(q)
+                    q.clear()
+        if not groups:
+            return False
+        for (job, op), entries in groups.items():
+            self._plane._flush_replica(job, op, entries)
+        return True
+
+
 class ServingPlane:
-    """The session cluster's lookup surface: per-(job, operator)
-    coalescers flushing batched StateQueryBatchRequests onto the owning
-    job's control queue."""
+    """The session cluster's lookup surface. Two read paths:
+
+    - **Replica path** (an adapter is bound for the (job, operator)):
+      probe the host hot-row cache; misses go to per-shard lookup
+      queues drained by the worker pool, which resolves them against
+      the SEALED replica generation — one gather + one device read per
+      miss batch, zero contention with ingest, results cached under
+      the generation tag. Cold rows detour through the legacy path
+      below (page tiers are single-owner host state).
+    - **Legacy path** (no replica — single-device engines, pre-publish
+      warmup): per-(job, operator) coalescers flushing batched
+      StateQueryBatchRequests onto the owning job's control queue,
+      served by the task loop at a batch boundary."""
 
     def __init__(self, max_batch: int = 512, window_ms: float = 1.0,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, workers: int = 2,
+                 cache_entries: int = 1 << 18):
         self.max_batch = int(max_batch)
         self.window_ms = float(window_ms)
         self.timeout_s = float(timeout_s)
+        self.n_workers = max(int(workers), 1)
 
         def make_flush(key):
             def flush(keys, namespace, _job=key[0], _op=key[1]):
@@ -354,16 +442,65 @@ class ServingPlane:
                                    window_ms=self.window_ms)
         #: job name -> control queue (bound by the session cluster)
         self._queues: Dict[str, Any] = {}
+        #: (job, operator) -> ReplicaAdapter (bound by the cluster)
+        self._replicas: Dict[tuple, Any] = {}
+        from flink_tpu.tenancy.hot_cache import HotRowCache
+
+        self.hot_cache = HotRowCache(max_entries=cache_entries)
+        self._workers: List[_ReplicaWorker] = []
+        self._workers_lock = threading.Lock()
+        #: sampled serving.cache_hit instants (1-in-N — a per-hit ring
+        #: write at cache-hit QPS would itself cost a core fraction)
+        self._hit_sample = 0
+
+    # ------------------------------------------------------------- binding
 
     def bind_job(self, job_name: str, control_queue) -> None:
         self._queues[job_name] = control_queue
 
+    def bind_replica(self, job_name: str, operator: str,
+                     adapter) -> None:
+        """Register a replica adapter for (job, operator) lookups; the
+        cold-row detour rides the legacy control-queue flush."""
+        adapter.cold_fetch = (
+            lambda keys, _j=job_name, _o=operator:
+            self._flush(_j, _o, list(keys), None))
+        adapter.attach_cache(self.hot_cache, job_name, operator)
+        self._replicas[(job_name, operator)] = adapter
+        self._ensure_workers()
+
     def unbind_job(self, job_name: str) -> None:
         self._queues.pop(job_name, None)
+        for k in [k for k in self._replicas if k[0] == job_name]:
+            del self._replicas[k]
+        self.hot_cache.invalidate_job(job_name)
         # retire the job's coalescers: a cluster churning many short
         # jobs would otherwise grow the pool (and its latency
         # reservoirs, and every scrape's walk) per HISTORICAL job
         self._pool.retire(lambda k: k[0] == job_name)
+
+    def _ensure_workers(self) -> None:
+        self._pick_worker(("", "", 0))  # starts the pool if stopped
+
+    def shutdown_workers(self) -> None:
+        """Stop the worker pool (cluster run finished). A later
+        bind_replica restarts it; riders still queued fail fast."""
+        with self._workers_lock:
+            workers, self._workers = self._workers, []
+        for w in workers:
+            w.stop()
+        for w in workers:
+            w.join(timeout=2)
+            w.fail_pending("serving workers shut down (cluster run "
+                           "finished)")
+
+    def _pick_worker(self, qkey: tuple) -> _ReplicaWorker:
+        with self._workers_lock:
+            while len(self._workers) < self.n_workers:
+                w = _ReplicaWorker(self, len(self._workers))
+                self._workers.append(w)
+                w.start()
+            return self._workers[hash(qkey) % len(self._workers)]
 
     def _coalescer(self, job_name: str, operator: str) -> LookupCoalescer:
         # bound-check BEFORE pool.get: a client still polling a finished
@@ -422,19 +559,203 @@ class ServingPlane:
                     "finished)"))
         return req.wait(self.timeout_s)
 
+    # ---------------------------------------------------------- replica path
+
+    def _adapter(self, job_name: str, operator: str):
+        ad = self._replicas.get((job_name, operator))
+        if ad is None or not ad.ready():
+            return None
+        return ad
+
+    @staticmethod
+    def _filter_ns(result, namespace):
+        if namespace is None:
+            return result
+        ns = int(namespace)
+        return {ns: result[ns]} if ns in result else {}
+
+    def _cache_probe(self, job_name: str, operator: str, ad, key,
+                     co) -> Tuple[bool, int, int, Any]:
+        """(hit, key_id, generation, value) — one locked dict access;
+        a hit records its (sub-ms) latency against the coalescer's
+        reservoir and a SAMPLED serving.cache_hit instant."""
+        from flink_tpu.observe import flight_recorder as flight
+
+        kid = ad.key_id(key)
+        gen = ad.generation()
+        # exact=False: bound adapters re-prime/drop every entry a
+        # publish changes, so presence implies validity (see HotRowCache)
+        hit, val = self.hot_cache.get(job_name, operator, kid, gen,
+                                      exact=False)
+        if hit:
+            co._record(n_lookups=1)
+            self._hit_sample += 1
+            if self._hit_sample % 256 == 1:
+                flight.instant("serving.cache_hit", job=job_name,
+                               batch=gen)
+        return hit, kid, gen, val
+
+    def _enqueue_miss(self, job_name: str, operator: str, ad, key,
+                      kid: int, namespace) -> _RepPending:
+        entry = _RepPending(key, kid, namespace)
+        shard = ad.shard_of(kid)
+        qkey = (job_name, operator, shard)
+        # shard -> worker is a stable partition: exactly one worker
+        # ever drains one shard queue (single-owner discipline)
+        self._pick_worker(qkey).enqueue(qkey, entry)
+        return entry
+
+    def _flush_replica(self, job_name: str, operator: str,
+                       entries: List[_RepPending]) -> None:
+        """Worker-side: resolve one miss batch against ONE sealed
+        generation, fill the hot-row cache, complete the riders. The
+        PR 6 coalescer guarantees carry over: a short result raises to
+        EVERY rider (zip-truncation would read as 'key has no state'),
+        and counters/latencies are recorded under the coalescer lock
+        (through _record, which also folds into retained totals when a
+        retire raced — nothing drops from cumulative stats)."""
+        from flink_tpu.observe import flight_recorder as flight
+
+        t0 = time.perf_counter()
+        try:
+            # the bound-check/retire dance of the legacy path: a job
+            # unbound mid-flight must not re-create a retired coalescer
+            # (the per-historical-job leak) — and its riders get the
+            # prompt not-serving error
+            co = self._coalescer(job_name, operator)
+        except RuntimeError as err:
+            for e in entries:
+                e.error = err
+                e.done.set()
+            self._pool._absorb(len(entries), 1, ())
+            return
+        ad = self._replicas.get((job_name, operator))
+        # chunk at max_batch: bounds one device batch's gather tier and
+        # keeps a burst from stretching every rider's latency behind
+        # one giant flush (the legacy coalescer's exact discipline)
+        for i in range(0, len(entries), self.max_batch):
+            chunk = entries[i:i + self.max_batch]
+            try:
+                if ad is None:
+                    raise RuntimeError(
+                        f"job {job_name!r} is not serving (not running, "
+                        "or finished)")
+                gen = ad.generation()
+                with flight.span("serving.lookup", job=job_name,
+                                 batch=gen):
+                    results, gen = ad.lookup_batch(
+                        [e.key for e in chunk])
+                if len(results) != len(chunk):
+                    raise RuntimeError(
+                        f"replica lookup returned {len(results)} "
+                        f"results for {len(chunk)} keys")
+            except BaseException as err:  # noqa: BLE001
+                for e in chunk:
+                    e.error = err
+                    e.done.set()
+                co._record(n_lookups=len(chunk), batches=1)
+                continue
+            # fill the cache only when the plane has not sealed a newer
+            # generation since this chunk resolved: put() guards
+            # downgrades of EXISTING entries, but an ABSENT key would
+            # insert the stale value — and with presence-implies-
+            # validity probes, a key that then stops changing (so no
+            # future prime touches it) would serve it forever
+            fill = ad.generation() == gen
+            for e, r in zip(chunk, results):
+                e.result = r
+                if fill:
+                    self.hot_cache.put(job_name, operator, e.key_id,
+                                       gen, r)
+                e.done.set()
+            co._record(n_lookups=len(chunk), batches=1,
+                       lat=((time.perf_counter() - t0) * 1e3,))
+
+    # ------------------------------------------------------------- lookups
+
     def lookup(self, job_name: str, operator: str, key,
                namespace=None):
-        """One point lookup; rides whatever batch is forming."""
-        return self._coalescer(job_name, operator).lookup(
-            key, namespace, timeout_s=self.timeout_s)
+        """One point lookup. Replica-armed operators probe the hot-row
+        cache, then ride the shard-queue worker path; others ride the
+        legacy coalescer's forming batch."""
+        ad = self._adapter(job_name, operator)
+        if ad is None:
+            return self._coalescer(job_name, operator).lookup(
+                key, namespace, timeout_s=self.timeout_s)
+        t0 = time.perf_counter()
+        co = self._coalescer(job_name, operator)
+        hit, kid, gen, val = self._cache_probe(job_name, operator, ad,
+                                               key, co)
+        if hit:
+            co._record(lat=((time.perf_counter() - t0) * 1e3,))
+            return self._filter_ns(val, namespace)
+        entry = self._enqueue_miss(job_name, operator, ad, key, kid,
+                                   namespace)
+        if not entry.done.wait(self.timeout_s):
+            raise TimeoutError("queryable-state lookup not served")
+        co._record(lat=((time.perf_counter() - t0) * 1e3,))
+        if entry.error is not None:
+            raise entry.error
+        return self._filter_ns(entry.result, namespace)
 
     def lookup_batch(self, job_name: str, operator: str, keys,
                      namespace=None) -> List[Any]:
-        """An explicit batch: bypasses the window, one request batch."""
-        co = self._coalescer(job_name, operator)
+        """An explicit batch. Replica path: per-key cache probes, the
+        misses coalesce onto the shard queues (riding other clients'
+        batches); legacy path: one request batch on the control queue."""
+        ad = self._adapter(job_name, operator)
+        if ad is None:
+            co = self._coalescer(job_name, operator)
+            t0 = time.perf_counter()
+            out = self._flush(job_name, operator, list(keys), namespace)
+            co.note_batch(len(out), (time.perf_counter() - t0) * 1e3)
+            return out
+        from flink_tpu.observe import flight_recorder as flight
+        from flink_tpu.state.keygroups import hash_keys_to_i64
+
         t0 = time.perf_counter()
-        out = self._flush(job_name, operator, list(keys), namespace)
-        co.note_batch(len(out), (time.perf_counter() - t0) * 1e3)
+        co = self._coalescer(job_name, operator)
+        keys = list(keys)
+        # one vectorized hash + ONE locked cache pass for the whole
+        # batch — the per-key dance would be lock traffic, not probes
+        kids = hash_keys_to_i64(np.asarray(keys)).tolist()
+        out: List[Any] = [None] * len(keys)
+        miss_idx: List[Tuple[int, int]] = []
+        gen = ad.generation()
+        hits = self.hot_cache.get_many(job_name, operator, kids, gen,
+                                       out, miss_idx, exact=False)
+        if namespace is not None:
+            for i in range(len(out)):
+                if out[i] is not None:
+                    out[i] = self._filter_ns(out[i], namespace)
+        pending = [(i, self._enqueue_miss(job_name, operator, ad,
+                                          keys[i], kid, namespace))
+                   for i, kid in miss_idx]
+        if hits:
+            # one locked record + one sampled instant for the whole
+            # batch's hits — per-key lock traffic at cache-hit QPS
+            # would itself be the bottleneck
+            co._record(n_lookups=hits)
+            self._hit_sample += hits
+            if self._hit_sample % 256 < hits:
+                flight.instant("serving.cache_hit", job=job_name,
+                               batch=gen)
+        err: Optional[BaseException] = None
+        # ONE deadline for the whole request (the legacy batch path's
+        # bound): a fresh full timeout per rider would let a degraded
+        # worker stretch one call to n_misses x timeout_s
+        deadline = t0 + self.timeout_s
+        for i, entry in pending:
+            if not entry.done.wait(
+                    max(deadline - time.perf_counter(), 0.0)):
+                raise TimeoutError("queryable-state lookup not served")
+            if entry.error is not None:
+                err = entry.error
+            else:
+                out[i] = self._filter_ns(entry.result, namespace)
+        co._record(lat=((time.perf_counter() - t0) * 1e3,))
+        if err is not None:
+            raise err
         return out
 
     # ---------------------------------------------------------------- metrics
@@ -450,5 +771,35 @@ class ServingPlane:
         """p99 over every coalescer's latency reservoir (pays one sort)."""
         return reservoir_p99_ms(self._pool.latencies())
 
+    def replica_staleness_ms(self) -> float:
+        """Worst-case age of any bound replica's sealed generation (ms
+        since its boundary publish) — the serving SLO's staleness arm.
+        Snapshots the adapter list first: sampler/scrape threads read
+        while bind/unbind mutate the dict (iterating the live dict
+        raises mid-mutation and would kill the sampler silently)."""
+        return max((ad.plane.staleness_ms()
+                    for ad in list(self._replicas.values())),
+                   default=0.0)
+
+    def hot_row_hit_rate(self) -> float:
+        return self.hot_cache.hit_rate()
+
+    def replica_generations(self) -> int:
+        """Total sealed generations across bound replicas (the smoke's
+        publish-vacuity gate reads this; snapshot — see staleness)."""
+        return sum(ad.plane.generation()
+                   for ad in list(self._replicas.values()))
+
+    def replica_counters(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for ad in list(self._replicas.values()):
+            for k, v in ad.plane.counters().items():
+                out[k] = out.get(k, 0) + v
+        return out
+
     def metrics(self) -> Dict[str, float]:
-        return self._pool.stats()
+        out = self._pool.stats()
+        out.update(self.hot_cache.stats())
+        out["replica_staleness_ms"] = self.replica_staleness_ms()
+        out["replica_generations"] = float(self.replica_generations())
+        return out

@@ -94,10 +94,18 @@ class SessionCluster:
     def __init__(self, quantum_records: int = 8192,
                  max_restarts: int = 2,
                  arbiter=None, arbitrate_every_s: float = 0.0,
-                 serving: Optional[ServingPlane] = None):
+                 serving: Optional[ServingPlane] = None,
+                 serving_workers: int = 2,
+                 serving_cache_entries: int = 1 << 18):
         self.jobs: Dict[str, TenantJob] = {}
         self.drr = DeficitRoundRobin(quantum=quantum_records)
-        self.serving = serving or ServingPlane()
+        #: serving_workers — threads draining the per-(job, operator,
+        #: shard) lookup queues (each queue owned by exactly ONE
+        #: worker); serving_cache_entries — hot-row cache LRU bound
+        #: (0 disables the cache: every lookup resolves on the replica)
+        self.serving = serving or ServingPlane(
+            workers=serving_workers,
+            cache_entries=serving_cache_entries)
         self.max_restarts = int(max_restarts)
         self.arbiter = arbiter
         self.arbitrate_every_s = float(arbitrate_every_s)
@@ -171,8 +179,32 @@ class SessionCluster:
                 job.graph, job.name, restore_from=restore_from,
                 control_queue=job.control, cooperative=True)
             job.handle = next(job.gen)
+            self._arm_replicas(job)
         job.ledger.engines.clear()
         job.ledger.bind(job.handle.stateful_operators())
+
+    def _arm_replicas(self, job: TenantJob) -> None:
+        """Arm every replica-capable operator's read replica and bind
+        its adapter to the serving plane (serving.replica; re-run on
+        restart — the fresh engines get fresh planes, and rebinding
+        atomically retargets lookups so clients that kept serving the
+        pre-crash sealed generation move to the restored job's first
+        republish). Runs inside the job's program-cache scope: the
+        replica program families are charged like any other."""
+        from flink_tpu.core.config import ServingOptions
+
+        if not job.config.get(ServingOptions.REPLICA):
+            return
+        interval = job.config.get(ServingOptions.PUBLISH_INTERVAL_MS)
+        for node in job.handle.nodes.values():
+            op = node.operator
+            if op is None or not hasattr(op, "arm_serving_replica"):
+                continue
+            adapter = op.arm_serving_replica(
+                publish_interval_ms=interval)
+            if adapter is not None:
+                self.serving.bind_replica(
+                    job.name, node.transformation.name, adapter)
 
     @staticmethod
     def _isolate_spill_dirs(job: TenantJob) -> None:
@@ -280,6 +312,10 @@ class SessionCluster:
                 raise TimeoutError(
                     f"session cluster did not finish within {timeout_s}s "
                     f"(live: {[j.name for j in self.jobs.values() if not j.finished]})")
+        # every job finished: stop the serving workers (a later submit
+        # re-binds replicas and restarts the pool); riders still queued
+        # fail fast instead of timing out against dead queues
+        self.serving.shutdown_workers()
         return {name: (job.result if job.error is None else job.error)
                 for name, job in self.jobs.items()}
 
@@ -465,6 +501,14 @@ class SessionCluster:
         # only the p99 gauge pays the latency-reservoir sort
         g.gauge("queryable_lookup_p99_ms",
                 lambda: self.serving.lookup_p99_ms())
+        # serving SLO gauges (the read-replica plane): lookup p99,
+        # worst-case sealed-generation age, hot-row cache hit rate
+        g.gauge("serving.lookupP99Ms",
+                lambda: self.serving.lookup_p99_ms())
+        g.gauge("serving.replicaStalenessMs",
+                lambda: self.serving.replica_staleness_ms())
+        g.gauge("serving.hotRowHitRate",
+                lambda: self.serving.hot_row_hit_rate())
 
     def _register_job_gauges(self, job: TenantJob) -> None:
         g = self._tenancy_group.add_group(job.name)

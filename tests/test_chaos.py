@@ -21,6 +21,7 @@ import numpy as np
 import pytest
 
 from flink_tpu.chaos import KNOWN_FAULT_POINTS
+from flink_tpu.core.records import RecordBatch
 from flink_tpu.chaos import injection as chaos
 from flink_tpu.chaos.harness import (
     ChaosDivergenceError,
@@ -956,6 +957,63 @@ class TestServingLookupPoint:
         assert r.retries >= 1 and r.recoveries >= 1
         assert r.crashes == 0 and not r.diverged
         _note_reached(r.faults_injected)
+
+
+class TestReplicaPublishPoint:
+    """``serving.replica_publish``, injected at its real site — INSIDE
+    a boundary publish, before the seal swap. The crash-restore shape:
+    readers keep serving the intact sealed generation through the torn
+    publish, the restored engine republishes, and lookups never observe
+    a torn replica (the snapshot-isolation-under-fault pin; the full
+    scenario with checkpoint restore lives in
+    tests/test_serving_replica.py::TestReplicaChaos)."""
+
+    def test_replica_publish_injected_at_real_site(self):
+        from flink_tpu.parallel.mesh import make_mesh
+        from flink_tpu.parallel.sharded_windower import MeshWindowEngine
+        from flink_tpu.tenancy.replica import WindowReplicaAdapter
+        from flink_tpu.windowing.aggregates import SumAggregate
+        from flink_tpu.windowing.assigners import (
+            TumblingEventTimeWindows,
+        )
+
+        eng = MeshWindowEngine(
+            TumblingEventTimeWindows(1000), SumAggregate("v"),
+            make_mesh(2), capacity_per_shard=1024, max_parallelism=128)
+        plane = eng.arm_replica()
+        ad = WindowReplicaAdapter(plane, eng.agg, eng.assigner)
+        ad.cold_fetch = lambda ks: eng.query_batch(
+            np.asarray(ks, dtype=np.int64))
+
+        def step(t):
+            eng.process_batch(RecordBatch({
+                "__key_id__": np.arange(16, dtype=np.int64),
+                "__ts__": np.full(16, t, dtype=np.int64),
+                "v": np.ones(16, dtype=np.float32),
+            }))
+
+        step(100)
+        eng.on_watermark(50)  # first publish seals generation 1
+        before, gen = ad.lookup_batch([3])
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="serving.replica_publish", nth=1)])
+        with chaos.chaos_active(plan, seed=0) as c:
+            step(600)
+            with pytest.raises(InjectedFault):
+                eng.on_watermark(550)
+            assert c.faults_injected.get("serving.replica_publish",
+                                         0) == 1
+            _note_reached(c.faults_injected)
+        # torn publish: the sealed generation is untouched
+        again, gen2 = ad.lookup_batch([3])
+        assert gen2 == gen and again == before
+        # the engine recovers at its next boundary (the publish is
+        # re-derivable: dirty marks and metadata survived the raise)
+        out = eng.on_watermark(550)
+        fresh, gen3 = ad.lookup_batch([3])
+        assert gen3 > gen
+        assert fresh == eng.query_batch(np.asarray([3],
+                                                   dtype=np.int64))
 
 
 class TestWatchdogPoints:

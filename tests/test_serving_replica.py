@@ -1,0 +1,692 @@
+"""Read-replica serving plane: staleness semantics, snapshot isolation,
+hot-row-cache priming, sharded-coalescer guarantees, chaos.
+
+The staleness contract under test (the ISSUE's acceptance bar): a
+lookup after watermark W sees state >= the last published boundary
+<= W, BIT-IDENTICAL to a ``query_batch`` against a checkpoint taken at
+that boundary — for window, session and join side tables, including
+forced eviction (cold rows serve from the page tier through the
+replica path, ``cold_rows_served`` counted).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.records import RecordBatch
+from flink_tpu.parallel.mesh import make_mesh
+from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+from flink_tpu.parallel.sharded_windower import MeshWindowEngine
+from flink_tpu.tenancy.hot_cache import HotRowCache
+from flink_tpu.tenancy.replica import (
+    SessionReplicaAdapter,
+    WindowReplicaAdapter,
+)
+from flink_tpu.windowing.aggregates import SumAggregate
+from flink_tpu.windowing.assigners import (
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+)
+
+def _batch(keys, ts, vals):
+    return RecordBatch({
+        "__key_id__": np.asarray(keys, dtype=np.int64),
+        "__ts__": np.asarray(ts, dtype=np.int64),
+        "value": np.asarray(vals, dtype=np.float32),
+    })
+
+
+def _drive(engine, n_batches=6, keys=64, per=256, t0=0, step=700,
+           wm_lag=600, rng=None):
+    rng = rng or np.random.default_rng(7)
+    t = t0
+    wm = None
+    for _ in range(n_batches):
+        ks = rng.integers(0, keys, per)
+        ts = t + rng.integers(0, 500, per)
+        vs = rng.random(per).astype(np.float32)
+        engine.process_batch(_batch(ks, ts, vs))
+        t += step
+        wm = t - wm_lag
+        engine.on_watermark(wm)
+    return t, wm
+
+
+class TestWindowReplica:
+    def _engine(self, assigner=None, **kw):
+        return MeshWindowEngine(
+            assigner or TumblingEventTimeWindows(5000),
+            SumAggregate("value"), make_mesh(4),
+            capacity_per_shard=kw.pop("capacity", 4096),
+            max_parallelism=128, **kw)
+
+    def test_boundary_equals_live_and_checkpoint(self):
+        eng = self._engine()
+        plane = eng.arm_replica()
+        _drive(eng)
+        ad = WindowReplicaAdapter(plane, eng.agg, eng.assigner)
+        ad.cold_fetch = lambda ks: eng.query_batch(
+            np.asarray(ks, dtype=np.int64))
+        qk = list(range(32))
+        snap = eng.snapshot(mode="savepoint")
+        live = eng.query_batch(np.asarray(qk, dtype=np.int64))
+        repl, gen = ad.lookup_batch(qk)
+        assert repl == live
+        assert gen == plane.generation() >= 2
+        # bit-identical to a query_batch against a checkpoint at the
+        # boundary (the acceptance criterion, literally)
+        fresh = self._engine()
+        fresh.restore(snap)
+        assert repl == fresh.query_batch(np.asarray(qk, dtype=np.int64))
+
+    def test_snapshot_isolation_mid_batch(self):
+        eng = self._engine()
+        plane = eng.arm_replica()
+        _, wm = _drive(eng)
+        ad = WindowReplicaAdapter(plane, eng.agg, eng.assigner)
+        ad.cold_fetch = lambda ks: eng.query_batch(
+            np.asarray(ks, dtype=np.int64))
+        qk = list(range(16))
+        before, gen = ad.lookup_batch(qk)
+        # ingest WITHOUT a boundary: the sealed generation must not move
+        eng.process_batch(_batch([1, 2, 3], [wm + 100] * 3,
+                                 [9.0, 9.0, 9.0]))
+        after, gen2 = ad.lookup_batch(qk)
+        assert gen2 == gen and after == before
+        assert eng.query_batch(np.asarray(qk, dtype=np.int64)) != before
+
+    def test_sliding_windows_compose(self):
+        eng = self._engine(assigner=SlidingEventTimeWindows(4000, 1000))
+        plane = eng.arm_replica()
+        _drive(eng)
+        ad = WindowReplicaAdapter(plane, eng.agg, eng.assigner)
+        ad.cold_fetch = lambda ks: eng.query_batch(
+            np.asarray(ks, dtype=np.int64))
+        qk = list(range(24))
+        assert ad.lookup_batch(qk)[0] == eng.query_batch(
+            np.asarray(qk, dtype=np.int64))
+
+    def test_forced_eviction_cold_slices_served(self):
+        # many live slices (watermark held back), tight device budget:
+        # namespaces evict; lookups must still be bit-identical, with
+        # the cold detour exercised
+        eng = self._engine(assigner=TumblingEventTimeWindows(500),
+                           capacity=2048, max_device_slots=1024)
+        plane = eng.arm_replica()
+        rng = np.random.default_rng(3)
+        t = 0
+        for _ in range(8):
+            ks = rng.integers(0, 700, 512)
+            ts = t + rng.integers(0, 4000, 512)
+            eng.process_batch(_batch(ks, ts,
+                                     rng.random(512).astype(np.float32)))
+            t += 4000
+            eng.on_watermark(0)  # hold every window open
+        assert eng.spill_counters()["rows_evicted"] > 0
+        ad = WindowReplicaAdapter(plane, eng.agg, eng.assigner)
+        ad.cold_fetch = lambda ks: eng.query_batch(
+            np.asarray(ks, dtype=np.int64))
+        qk = list(range(0, 700, 3))
+        snap = eng.snapshot(mode="savepoint")
+        repl, _ = ad.lookup_batch(qk)
+        assert repl == eng.query_batch(np.asarray(qk, dtype=np.int64))
+        assert plane.cold_rows_served > 0
+        fresh = self._engine(assigner=TumblingEventTimeWindows(500),
+                             capacity=2048, max_device_slots=1024)
+        fresh.restore(snap)
+        assert repl == fresh.query_batch(np.asarray(qk, dtype=np.int64))
+
+    def test_reshard_rebuild_republishes(self):
+        eng = self._engine()
+        plane = eng.arm_replica()
+        _drive(eng, n_batches=3)
+        eng.reshard(2)
+        t0, _ = _drive(eng, n_batches=3, t0=3 * 700)
+        ad = WindowReplicaAdapter(plane, eng.agg, eng.assigner)
+        ad.cold_fetch = lambda ks: eng.query_batch(
+            np.asarray(ks, dtype=np.int64))
+        qk = list(range(32))
+        assert ad.lookup_batch(qk)[0] == eng.query_batch(
+            np.asarray(qk, dtype=np.int64))
+
+
+class TestSessionReplica:
+    def _engine(self, gap=1000, **kw):
+        return MeshSessionEngine(
+            gap, SumAggregate("value"), make_mesh(4),
+            capacity_per_shard=kw.pop("capacity", 4096),
+            max_parallelism=128, **kw)
+
+    def test_boundary_equals_live_and_checkpoint(self):
+        eng = self._engine()
+        plane = eng.arm_replica()
+        _drive(eng)
+        ad = SessionReplicaAdapter(plane, eng.agg)
+        ad.cold_fetch = lambda ks: eng.query_batch(
+            np.asarray(ks, dtype=np.int64))
+        qk = list(range(32))
+        snap = eng.snapshot(mode="savepoint")
+        live = eng.query_batch(np.asarray(qk, dtype=np.int64))
+        repl, gen = ad.lookup_batch(qk)
+        assert repl == live and gen >= 2
+        fresh = self._engine()
+        fresh.restore(snap)
+        assert repl == fresh.query_batch(np.asarray(qk, dtype=np.int64))
+
+    def test_snapshot_isolation_mid_batch(self):
+        eng = self._engine()
+        plane = eng.arm_replica()
+        _, wm = _drive(eng)
+        ad = SessionReplicaAdapter(plane, eng.agg)
+        ad.cold_fetch = lambda ks: eng.query_batch(
+            np.asarray(ks, dtype=np.int64))
+        qk = list(range(16))
+        before, gen = ad.lookup_batch(qk)
+        eng.process_batch(_batch([1, 2, 3], [wm + 100] * 3,
+                                 [9.0, 9.0, 9.0]))
+        after, gen2 = ad.lookup_batch(qk)
+        assert gen2 == gen and after == before
+
+    def test_forced_eviction_cold_sessions_served(self):
+        # long gap: sessions never fire; tight budget: page cohorts
+        # evict; replica lookups must stay bit-identical to live AND
+        # to a checkpoint restored at the boundary
+        eng = self._engine(gap=10 ** 6, capacity=2048,
+                           max_device_slots=1024)
+        plane = eng.arm_replica()
+        rng = np.random.default_rng(9)
+        t = 0
+        for _ in range(8):
+            ks = rng.integers(0, 20000, 2048)
+            ts = t + rng.integers(0, 500, 2048)
+            eng.process_batch(_batch(
+                ks, ts, rng.random(2048).astype(np.float32)))
+            t += 700
+            eng.on_watermark(t - 600)
+        assert eng.spill_counters()["rows_evicted"] > 0
+        ad = SessionReplicaAdapter(plane, eng.agg)
+        ad.cold_fetch = lambda ks: eng.query_batch(
+            np.asarray(ks, dtype=np.int64))
+        qk = list(range(0, 20000, 37))
+        snap = eng.snapshot(mode="savepoint")
+        repl, _ = ad.lookup_batch(qk)
+        assert repl == eng.query_batch(np.asarray(qk, dtype=np.int64))
+        assert plane.cold_rows_served > 0
+        fresh = self._engine(gap=10 ** 6, capacity=2048,
+                             max_device_slots=1024)
+        fresh.restore(snap)
+        assert repl == fresh.query_batch(np.asarray(qk, dtype=np.int64))
+
+    def test_restore_triggers_rebuild(self):
+        eng = self._engine()
+        _drive(eng, n_batches=3)
+        snap = eng.snapshot(mode="savepoint")
+        # crash-restore path: a FRESH engine (as _start builds) with an
+        # armed replica restores, then republishes at its next boundary
+        fresh = self._engine()
+        plane = fresh.arm_replica()
+        fresh.on_watermark(0)  # clears the arm-time rebuild flag
+        fresh.restore(snap)    # must set it again
+        fresh.on_watermark(3 * 700 - 600)
+        ad = SessionReplicaAdapter(plane, fresh.agg)
+        ad.cold_fetch = lambda ks: fresh.query_batch(
+            np.asarray(ks, dtype=np.int64))
+        qk = list(range(32))
+        assert ad.lookup_batch(qk)[0] == fresh.query_batch(
+            np.asarray(qk, dtype=np.int64))
+
+
+class TestJoinSideReplica:
+    def _engine(self, **kw):
+        from flink_tpu.joins.engine import MeshIntervalJoinEngine
+
+        return MeshIntervalJoinEngine(
+            -2000, 2000, mesh=make_mesh(4),
+            capacity_per_shard=kw.pop("capacity", 1024),
+            max_parallelism=128, **kw)
+
+    @staticmethod
+    def _jbatch(rng, t, n=512, keys=800):
+        ts = t + rng.integers(0, 500, n)
+        return RecordBatch({
+            "__key_id__": rng.integers(0, keys, n).astype(np.int64),
+            "__ts__": ts.astype(np.int64),
+            "price": rng.random(n).astype(np.float32),
+            # int64 column: rides the host shadow in both modes
+            "tag": (ts * 7 + 1).astype(np.int64),
+        })
+
+    def _drive(self, eng, rng, n=6, t0=0):
+        t = t0
+        for _ in range(n):
+            t += 400
+            eng.process_batch(self._jbatch(rng, t), 0)
+            eng.process_batch(self._jbatch(rng, t), 1)
+            eng.on_watermark(t - 300)
+        return t
+
+    def test_boundary_equals_live_and_checkpoint_with_eviction(self):
+        eng = self._engine(max_device_slots=512)
+        rng = np.random.default_rng(5)
+        t = self._drive(eng, rng, n=1)
+        ad = eng.arm_side_replica(1)
+        ad.cold_fetch = lambda ks: eng.query_side_batch(
+            1, np.asarray(ks, dtype=np.int64))
+        t = self._drive(eng, rng, n=6, t0=t)
+        assert eng.spill_counters()["rows_evicted"] > 0
+        qk = list(range(0, 800, 3))
+        snap = eng.snapshot(mode="savepoint")
+        live = eng.query_side_batch(1, np.asarray(qk, dtype=np.int64))
+        repl, gen = ad.lookup_batch(qk)
+        assert repl == live and gen >= 2
+        assert ad.plane.cold_rows_served > 0
+        # checkpoint form: a fresh engine restored at the boundary
+        # answers bit-identically
+        fresh = self._engine(max_device_slots=512)
+        fresh.restore(snap)
+        assert repl == fresh.query_side_batch(
+            1, np.asarray(qk, dtype=np.int64))
+
+    def test_snapshot_isolation_mid_batch(self):
+        eng = self._engine()
+        rng = np.random.default_rng(6)
+        t = self._drive(eng, rng, n=1)
+        ad = eng.arm_side_replica(1)
+        ad.cold_fetch = lambda ks: eng.query_side_batch(
+            1, np.asarray(ks, dtype=np.int64))
+        t = self._drive(eng, rng, n=3, t0=t)
+        qk = list(range(0, 800, 7))
+        before, gen = ad.lookup_batch(qk)
+        eng.process_batch(self._jbatch(rng, t + 100, n=8), 1)
+        after, gen2 = ad.lookup_batch(qk)
+        assert gen2 == gen and after == before
+        assert eng.query_side_batch(
+            1, np.asarray(qk, dtype=np.int64)) != before
+
+
+class TestHotCachePriming:
+    def _armed(self):
+        eng = MeshWindowEngine(
+            TumblingEventTimeWindows(5000), SumAggregate("value"),
+            make_mesh(4), capacity_per_shard=4096, max_parallelism=128)
+        plane = eng.arm_replica()
+        ad = WindowReplicaAdapter(plane, eng.agg, eng.assigner)
+        ad.cold_fetch = lambda ks: eng.query_batch(
+            np.asarray(ks, dtype=np.int64))
+        cache = HotRowCache(max_entries=1 << 12)
+        ad.attach_cache(cache, "j", "op")
+        return eng, plane, ad, cache
+
+    def test_prime_keeps_entries_true_across_publishes(self):
+        eng, plane, ad, cache = self._armed()
+        _drive(eng, n_batches=2)
+        qk = list(range(16))
+        # warm the cache through the miss path
+        res, gen = ad.lookup_batch(qk)
+        for k, r in zip(qk, res):
+            cache.put("j", "op", k, gen, r)
+        # more boundaries: the publish harvest must re-prime the
+        # entries IN PLACE — a probe never touches the device and the
+        # value equals the live boundary state
+        _drive(eng, n_batches=3, t0=2 * 700)
+        live = eng.query_batch(np.asarray(qk, dtype=np.int64))
+        for k, want in zip(qk, live):
+            hit, val = cache.get("j", "op", k, plane.generation(),
+                                 exact=False)
+            assert hit, f"key {k} should have been primed, not dropped"
+            assert val == want
+        assert cache.primes > 0
+
+    def test_prime_removes_fired_windows(self):
+        eng, plane, ad, cache = self._armed()
+        _drive(eng, n_batches=2)
+        qk = list(range(16))
+        res, gen = ad.lookup_batch(qk)
+        for k, r in zip(qk, res):
+            cache.put("j", "op", k, gen, r)
+        # fire everything: the freed slices must leave cached entries
+        eng.on_watermark(10 ** 9)
+        live = eng.query_batch(np.asarray(qk, dtype=np.int64))
+        for k, want in zip(qk, live):
+            hit, val = cache.get("j", "op", k, plane.generation(),
+                                 exact=False)
+            if hit:
+                assert val == want  # i.e. shrunk to live state
+
+    def test_rebuild_invalidates_op_entries(self):
+        eng, plane, ad, cache = self._armed()
+        _drive(eng, n_batches=2)
+        cache.put("j", "op", 1, plane.generation(), {"x": 1})
+        cache.put("other", "op", 1, 5, {"y": 2})
+        eng.reshard(2)
+        eng.on_watermark(10)  # publish -> rebuild -> invalidate
+        hit, val = cache.get("j", "op", 1, plane.generation(),
+                             exact=False)
+        if hit:
+            # the rebuild's full republish may re-insert the key — but
+            # the STALE pre-rebuild value must be gone
+            assert val != {"x": 1}
+            assert val == eng.query_batch(
+                np.asarray([1], dtype=np.int64))[0]
+        # the OTHER job's entries survive
+        assert cache.get("other", "op", 1, 5)[0]
+
+    def test_put_never_downgrades(self):
+        cache = HotRowCache()
+        cache.put("j", "o", 1, 5, {"v": 5})
+        cache.put("j", "o", 1, 4, {"v": 4})  # stale worker result
+        assert cache.get("j", "o", 1, 5)[1] == {"v": 5}
+
+    def test_lru_bound(self):
+        cache = HotRowCache(max_entries=8)
+        for k in range(20):
+            cache.put("j", "o", k, 1, k)
+        assert len(cache) == 8
+        assert cache.evictions == 12
+
+
+class TestReplicaPlaneRebuild:
+    def test_rebuild_drops_ghost_index_entries(self):
+        """A rebuild's republish must build its index FROM SCRATCH:
+        carrying the sealed index forward would keep entries for keys
+        that do not exist in the rebuilt (restored) state, whose stale
+        slots could then address OTHER keys' rows."""
+        from flink_tpu.tenancy.replica import ReplicaPlane
+
+        class _Leaf:
+            dtype = np.float32
+            identity = 0.0
+
+        plane = ReplicaPlane(make_mesh(2), [_Leaf()], 256)
+
+        def shard(up, cold=(), freed=()):
+            up = np.asarray(up, dtype=np.int64)
+            return {"up_slots": up.astype(np.int32), "up_keys": up,
+                    "up_ns": up, "up_extra": None, "cold": list(cold),
+                    "freed": list(freed), "fresh": bool(len(up))}
+
+        plane.publish(plane._accs, {0: shard([7]), 1: shard([])}, 10)
+        assert 7 in plane.sealed.index
+        plane.rebuild(plane.mesh, 256)
+        # the restored state has only key 3 — key 7 must NOT survive
+        plane.publish(plane._accs, {0: shard([3]), 1: shard([])}, 20)
+        assert 3 in plane.sealed.index
+        assert 7 not in plane.sealed.index
+
+    def test_rebuild_republish_seals_even_when_empty(self):
+        from flink_tpu.tenancy.replica import ReplicaPlane
+
+        class _Leaf:
+            dtype = np.float32
+            identity = 0.0
+
+        plane = ReplicaPlane(make_mesh(2), [_Leaf()], 256)
+
+        def empty():
+            return {"up_slots": np.zeros(0, np.int32),
+                    "up_keys": np.zeros(0, np.int64),
+                    "up_ns": np.zeros(0, np.int64),
+                    "up_extra": None, "cold": [], "freed": [],
+                    "fresh": False}
+
+        up = np.asarray([5], dtype=np.int64)
+        plane.publish(plane._accs, {0: {
+            "up_slots": up.astype(np.int32), "up_keys": up,
+            "up_ns": up, "up_extra": None, "cold": [], "freed": [],
+            "fresh": True}, 1: empty()}, 10)
+        gen = plane.generation()
+        plane.rebuild(plane.mesh, 256)
+        # restored-to-empty state: the republish must still seal (and
+        # drop the ghost), not skip as a no-change boundary
+        assert plane.publish(plane._accs, {0: empty(), 1: empty()}, 20)
+        assert plane.generation() > gen
+        assert 5 not in plane.sealed.index
+
+
+class _FakeAdapter:
+    """Deterministic adapter for coalescer-guarantee tests (no engine,
+    no device)."""
+
+    class _PlaneStub:
+        def staleness_ms(self):
+            return 0.0
+
+        def generation(self):
+            return 3
+
+        def counters(self):
+            return {}
+
+    def __init__(self, short_by: int = 0, fail: bool = False):
+        self._gen = 3
+        self.short_by = short_by
+        self.fail = fail
+        self.calls = []
+        self.plane = self._PlaneStub()
+
+    def ready(self):
+        return True
+
+    def generation(self):
+        return self._gen
+
+    def key_id(self, key):
+        return int(key)
+
+    def shard_of(self, kid):
+        return kid % 4
+
+    def attach_cache(self, cache, job, op):
+        pass
+
+    def lookup_batch(self, keys):
+        self.calls.append(list(keys))
+        if self.fail:
+            raise RuntimeError("flush exploded")
+        out = [{"k": int(k)} for k in keys]
+        if self.short_by:
+            out = out[:-self.short_by]
+        return out, self._gen
+
+
+class TestShardedCoalescerGuarantees:
+    """The PR 6 tenth-round coalescer guarantees, ported to the
+    sharded-queue worker path so the rewrite cannot shed them."""
+
+    def _plane(self, adapter):
+        from flink_tpu.tenancy.serving import ServingPlane
+
+        plane = ServingPlane(workers=2, window_ms=0.0)
+        plane.bind_job("j", __import__("queue").Queue())
+        plane._replicas[("j", "op")] = adapter
+        plane._ensure_workers()
+        return plane
+
+    def test_short_result_raises_to_every_rider(self):
+        plane = self._plane(_FakeAdapter(short_by=1))
+        errs = []
+
+        def rider(k):
+            try:
+                plane.lookup("j", "op", k)
+            except RuntimeError as e:
+                errs.append(str(e))
+
+        ts = [threading.Thread(target=rider, args=(k,))
+              for k in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(5)
+        plane.shutdown_workers()
+        assert len(errs) == 4
+        assert all("results for" in e for e in errs)
+
+    def test_flush_error_fans_out_and_counters_recorded(self):
+        plane = self._plane(_FakeAdapter(fail=True))
+        with pytest.raises(RuntimeError, match="flush exploded"):
+            plane.lookup("j", "op", 7)
+        m = plane.metrics()
+        assert m["lookups_total"] >= 1
+        assert m["lookup_batches_total"] >= 1
+        plane.shutdown_workers()
+
+    def test_retire_race_folds_into_retained_totals(self):
+        plane = self._plane(_FakeAdapter())
+        assert plane.lookup("j", "op", 5) == {"k": 5}
+        before = plane.lookups_total()
+        co = plane._pool.get(("j", "op"))
+        plane.unbind_job("j")  # retires the coalescer
+        # a lookup that raced the retire still records its counts
+        co._record(n_lookups=3, batches=1)
+        assert plane.lookups_total() == before + 3
+        plane.shutdown_workers()
+
+    def test_shard_queue_single_owner(self):
+        # one (job, op, shard) queue is drained by exactly one worker:
+        # the partition function is a pure hash — two enqueues for one
+        # shard land on the same worker object
+        plane = self._plane(_FakeAdapter())
+        w1 = plane._pick_worker(("j", "op", 2))
+        w2 = plane._pick_worker(("j", "op", 2))
+        assert w1 is w2
+        plane.shutdown_workers()
+
+    def test_cache_hits_count_as_lookups(self):
+        ad = _FakeAdapter()
+        plane = self._plane(ad)
+        assert plane.lookup("j", "op", 9) == {"k": 9}
+        n_calls = len(ad.calls)
+        # second lookup of the same key: cache hit, no adapter call
+        assert plane.lookup("j", "op", 9) == {"k": 9}
+        assert len(ad.calls) == n_calls
+        assert plane.hot_cache.hits >= 1
+        assert plane.lookups_total() >= 2
+        plane.shutdown_workers()
+
+
+class TestClusterReplicaServing:
+    def _cluster_one_job(self, tmp_path, records=40_000,
+                         interval_ms=0):
+        from flink_tpu.connectors.sinks import CollectSink
+        from flink_tpu.connectors.sources import DataGenSource
+        from flink_tpu.core.config import Configuration
+        from flink_tpu.datastream.environment import (
+            StreamExecutionEnvironment,
+        )
+        from flink_tpu.runtime.watermarks import WatermarkStrategy
+        from flink_tpu.tenancy.session_cluster import SessionCluster
+
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 4096,
+            "parallelism.default": 4,
+            "serving.replica.publish-interval-ms": interval_ms,
+        }))
+        sink = CollectSink()
+        (env.add_source(
+            DataGenSource(total_records=records, num_keys=128,
+                          events_per_second_of_eventtime=50_000,
+                          seed=13),
+            WatermarkStrategy.for_bounded_out_of_orderness(0))
+            .key_by("key")
+            .window(TumblingEventTimeWindows.of(60_000))
+            .sum("value").sink_to(sink))
+        cluster = SessionCluster(quantum_records=4096)
+        cluster.submit(env, "job-r")
+        return cluster, sink
+
+    def test_lookup_equals_live_query_at_boundaries(self, tmp_path):
+        cluster, _ = self._cluster_one_job(tmp_path)
+        assert ("job-r", "window_agg(SumAggregate)") \
+            in cluster.serving._replicas
+        op = cluster.jobs["job-r"].handle.stateful_operators()[0]
+        rounds = 0
+        checked = 0
+        while cluster.step_round() and rounds < 6:
+            rounds += 1
+            if op.windower._replica.sealed is None:
+                continue
+            # between rounds the job is quiesced at a published
+            # boundary: the replica lookup must equal the live query
+            for key in (1, 5, 77):
+                got = cluster.lookup("job-r",
+                                     "window_agg(SumAggregate)", key)
+                want = op.query_state_batch([key])[0]
+                assert got == want
+                checked += 1
+        assert checked > 0
+        assert cluster.serving.replica_generations() >= 2
+        cluster.run(timeout_s=120)
+        cluster.serving.shutdown_workers()
+
+    def test_hot_cache_hits_and_slo_gauges(self, tmp_path):
+        cluster, _ = self._cluster_one_job(tmp_path)
+        op = cluster.jobs["job-r"].handle.stateful_operators()[0]
+        rounds = 0
+        while cluster.step_round() and rounds < 5:
+            rounds += 1
+            if op.windower._replica.sealed is None:
+                continue
+            for _ in range(3):
+                cluster.lookup_batch("job-r",
+                                     "window_agg(SumAggregate)",
+                                     list(range(32)))
+        assert cluster.serving.hot_row_hit_rate() > 0
+        assert cluster.serving.replica_staleness_ms() >= 0.0
+        # the SLO gauges are registered on the tenancy group
+        names = {m.rsplit(".", 1)[-1]
+                 for m in cluster.registry.snapshot()}
+        assert {"lookupP99Ms", "replicaStalenessMs",
+                "hotRowHitRate"} & names
+        cluster.run(timeout_s=120)
+        cluster.serving.shutdown_workers()
+
+    def test_lookup_after_finish_raises_not_serving(self, tmp_path):
+        cluster, _ = self._cluster_one_job(tmp_path, records=8192)
+        cluster.run(timeout_s=120)
+        with pytest.raises(RuntimeError, match="not serving"):
+            cluster.lookup("job-r", "window_agg(SumAggregate)", 1)
+
+
+class TestReplicaChaos:
+    def test_crash_mid_publish_readers_keep_sealed_generation(self):
+        """A crash INSIDE a publish (before the seal swap) leaves the
+        sealed generation intact: readers keep serving it, and after
+        the engine 'restores' (restore + republish) lookups never
+        observe a torn replica."""
+        from flink_tpu.chaos import injection as chaos
+        from flink_tpu.chaos.injection import (
+            FaultPlan,
+            FaultRule,
+            InjectedFault,
+        )
+
+        eng = MeshWindowEngine(
+            TumblingEventTimeWindows(5000), SumAggregate("value"),
+            make_mesh(4), capacity_per_shard=4096, max_parallelism=128)
+        plane = eng.arm_replica()
+        ad = WindowReplicaAdapter(plane, eng.agg, eng.assigner)
+        ad.cold_fetch = lambda ks: eng.query_batch(
+            np.asarray(ks, dtype=np.int64))
+        t, _ = _drive(eng, n_batches=3)
+        qk = list(range(16))
+        sealed_before, gen = ad.lookup_batch(qk)
+        snap = eng.snapshot(mode="savepoint")
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="serving.replica_publish", nth=1)])
+        with chaos.chaos_active(plan, seed=1):
+            eng.process_batch(_batch([1, 2], [t, t], [5.0, 5.0]))
+            with pytest.raises(InjectedFault):
+                eng.on_watermark(t - 100)
+        # the sealed generation survived the torn publish
+        again, gen2 = ad.lookup_batch(qk)
+        assert gen2 == gen and again == sealed_before
+        # crash-restore: the restored engine republishes at its next
+        # boundary; lookups see a consistent (restored) boundary
+        eng.restore(snap)
+        eng.on_watermark(t - 100)
+        restored, gen3 = ad.lookup_batch(qk)
+        assert gen3 > gen
+        assert restored == eng.query_batch(
+            np.asarray(qk, dtype=np.int64))

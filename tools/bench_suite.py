@@ -493,11 +493,19 @@ def main():
     lines.append("")
     lines.append(
         "The queryable-lookups row is `tools/serving_smoke.py` at bench "
-        "scale: two concurrent jobs share one mesh and the compiled-"
-        "program cache while client threads issue batched point lookups "
-        "against live keyed state; the tier-1 smoke runs the same "
-        "script smaller and FAILS on any steady-state compile, p99 over "
-        "budget, or quota violation (design note in NOTES_r10.md).")
+        "scale: two concurrent ingesting jobs share one mesh and the "
+        "compiled-program cache while client threads issue batched "
+        "point lookups through the READ-REPLICA serving plane — "
+        "boundary-published double-buffered snapshots (snapshot "
+        "isolation, zero contention with ingest), a publish-harvest "
+        "hot-row cache, and sharded coalescer workers; the row reports "
+        "hit rate, replica staleness p99 and generations alongside "
+        "lookups/s. SERVING_SMOKE_REPLICA=0 measures the legacy "
+        "live-plane path (the recorded pre-replica baseline). The "
+        "tier-1 smoke runs the same script smaller and FAILS on any "
+        "steady-state compile, p99 over 25 ms, throughput under 3x the "
+        "pre-replica row, vacuous cache/publish activity, or a quota "
+        "violation (design notes in NOTES_r10.md and NOTES_r17.md).")
     lines.append("")
     lines.append(
         "Streaming-join rows (r14): `tools/bench_joins.py` drives the "

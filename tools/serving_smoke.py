@@ -1,30 +1,37 @@
 """Multi-tenant serving smoke: 2 jobs + concurrent lookup load (tier-1).
 
-The executable form of the tenancy acceptance criteria:
+The executable form of the serving-plane acceptance criteria — since
+r17 this gates the READ-REPLICA path (boundary-published snapshots +
+host hot-row cache + sharded coalescer workers):
 
 1. **Warm phase** — job-1 runs alone on the session cluster and compiles
-   the step-program family.
+   the step-program family (incl. the replica publish/gather tiers).
 2. **Measured phase** — a FRESH cluster runs TWO fresh jobs (new engine
    instances, same mesh/layout) under the recompile sentinel while
    client threads hammer batched queryable-state lookups. The run FAILS
    on:
-   - ANY steady-state XLA compile (the shared program cache must serve
-     both jobs — a cache key leaking engine/job identity compiles per
-     job and trips the sentinel),
-   - per-job program-cache misses > 0 (the diagnostic twin of the
-     sentinel signal),
+   - ANY steady-state XLA compile (shared program cache + warmed
+     replica tier lattice must serve both jobs),
+   - per-job program-cache misses > 0,
    - lookup p99 over budget (``SERVING_SMOKE_P99_BUDGET_MS``, default
-     500 ms on CPU — the coalescer + batched gather path must hold it
-     under concurrent load),
-   - any quota violation (job-2 runs under a resident-row quota with a
-     spill tier; enforcement must shed, never violate),
-   - zero served lookups (a vacuous run must not pass).
+     25 ms — the replica+cache path must hold it under concurrent
+     ingest),
+   - throughput under the floor (``SERVING_SMOKE_MIN_LOOKUPS_PER_S``,
+     default 216,000/s = 3x the recorded pre-replica 72k row),
+   - hot-row cache hit rate == 0 (vacuity: the cache must actually
+     serve),
+   - replica generations < 2 (vacuity: boundary publishes must
+     actually happen),
+   - any quota violation, zero served lookups, or empty job output.
 
 Prints a JSON line with ``queryable_lookups_per_s`` — `tools/bench_suite.py`
 runs this script at bench scale for the BENCHMARKS.md serving row.
 
     JAX_PLATFORMS=cpu python tools/serving_smoke.py
     SERVING_SMOKE_RECORDS=... SERVING_SMOKE_CLIENTS=... to scale.
+    SERVING_SMOKE_REPLICA=0 measures the legacy live-plane path
+    (floor/hit-rate/generation gates auto-disable — the A/B lever the
+    NOTES_r17 walk uses).
 """
 
 import json
@@ -43,20 +50,35 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 RECORDS = int(os.environ.get("SERVING_SMOKE_RECORDS", 200_000))
-CLIENTS = int(os.environ.get("SERVING_SMOKE_CLIENTS", 8))
-KEYS = int(os.environ.get("SERVING_SMOKE_KEYS", 512))
-P99_BUDGET_MS = float(os.environ.get("SERVING_SMOKE_P99_BUDGET_MS", 500))
-QUOTA_ROWS = int(os.environ.get("SERVING_SMOKE_QUOTA_ROWS", 4096))
-#: keys per client request: 1 = coalesced point lookups (the smoke
-#: default), >1 = explicit request batches (the high-QPS bench shape —
-#: a serving frontend amortizes its fan-in into device batches)
-LOOKUP_BATCH = int(os.environ.get("SERVING_SMOKE_LOOKUP_BATCH", 1))
+CLIENTS = int(os.environ.get("SERVING_SMOKE_CLIENTS", 16))
+KEYS = int(os.environ.get("SERVING_SMOKE_KEYS", 4096))
+P99_BUDGET_MS = float(os.environ.get("SERVING_SMOKE_P99_BUDGET_MS", 25))
+#: throughput floor: 3x the recorded pre-replica 72k lookups/s row
+MIN_LOOKUPS_PER_S = float(os.environ.get(
+    "SERVING_SMOKE_MIN_LOOKUPS_PER_S", 216_000))
+QUOTA_ROWS = int(os.environ.get("SERVING_SMOKE_QUOTA_ROWS", 8192))
+#: keys per client request: the serving frontend shape — a fan-in of
+#: point lookups amortized into request batches (the recorded 72k row
+#: used the same 256-key batches, so the 3x floor is apples-to-apples)
+LOOKUP_BATCH = int(os.environ.get("SERVING_SMOKE_LOOKUP_BATCH", 256))
 #: client inter-request pause: models request interarrival AND keeps
 #: unthrottled client spin from GIL-starving the single scheduler
 #: thread (point-lookup mode is implicitly paced by the coalescer's
 #: ride-collection window; explicit batches are not)
 CLIENT_PAUSE_MS = float(os.environ.get(
     "SERVING_SMOKE_CLIENT_PAUSE_MS", 5.0 if LOOKUP_BATCH > 1 else 0.0))
+#: replica A/B lever: 0 = legacy live-plane path (control-queue
+#: coalescers only) — the floor and replica vacuity gates disable
+REPLICA = os.environ.get("SERVING_SMOKE_REPLICA", "1") != "0"
+#: boundary publishes batched under this interval (staleness bound)
+PUBLISH_INTERVAL_MS = int(os.environ.get(
+    "SERVING_SMOKE_PUBLISH_INTERVAL_MS", 25))
+#: per-optimization A/B levers (the NOTES_r17 measured walk): hot-row
+#: cache capacity (0 = every lookup resolves on the replica) and the
+#: serving worker-pool size (1 = one drain loop for all shards)
+CACHE_ENTRIES = int(os.environ.get(
+    "SERVING_SMOKE_CACHE_ENTRIES", 1 << 18))
+WORKERS = int(os.environ.get("SERVING_SMOKE_WORKERS", 2))
 
 
 def _pipeline(sink):
@@ -71,6 +93,12 @@ def _pipeline(sink):
     env = StreamExecutionEnvironment(Configuration({
         "execution.micro-batch.size": 4096,
         "parallelism.default": 4,
+        # the latency tier composes with the serving plane: deadline
+        # splitting bounds each ingest dispatch, so a lookup miss batch
+        # queued behind the device never waits out a full-batch program
+        "latency.fire-deadline-ms": 25,
+        "serving.replica": REPLICA,
+        "serving.replica.publish-interval-ms": PUBLISH_INTERVAL_MS,
         # spill tier sized to the quota's per-shard slice (so the quota
         # has somewhere to shed and steady state stays under it)
         "state.slot-table.max-device-slots": TenantQuota(
@@ -91,6 +119,7 @@ def main():
 
     warnings.filterwarnings("ignore")
     from flink_tpu.connectors.sinks import CollectSink
+    from flink_tpu.metrics.core import quantile_sorted
     from flink_tpu.observe import RecompileSentinel
     from flink_tpu.tenancy.program_cache import PROGRAM_CACHE
     from flink_tpu.tenancy.quotas import TenantQuota
@@ -100,9 +129,23 @@ def main():
 
     def run_with_lookups(cluster, job_names, n_clients):
         """Drive the cluster while client threads hammer lookups;
-        returns (elapsed_s, errors)."""
+        returns (elapsed_s, errors, max_generations, staleness_ms[])."""
         stop = threading.Event()
         errors = []
+        seen = {"gens": 0}
+        staleness = []
+
+        def sampler():
+            # replica observability: max generations seen (the jobs
+            # unbind their replicas at finish, so read DURING the run)
+            # and a staleness reservoir for the p99
+            while not stop.is_set():
+                g = cluster.serving.replica_generations()
+                if g > seen["gens"]:
+                    seen["gens"] = g
+                staleness.append(
+                    cluster.serving.replica_staleness_ms())
+                time.sleep(0.01)
 
         def client(i):
             import numpy as np
@@ -121,10 +164,11 @@ def main():
                                        int(rng.integers(0, KEYS)))
                 except RuntimeError as e:
                     if ("is not serving" in str(e)
-                            or "already terminated" in str(e)):
-                        # both clean-shutdown shapes: the plane's
-                        # unbound-job error and the executor's
-                        # terminal control-queue drain
+                            or "already terminated" in str(e)
+                            or "shut down" in str(e)):
+                        # clean-shutdown shapes: the plane's unbound-job
+                        # error, the executor's terminal control-queue
+                        # drain, and the worker-pool shutdown
                         return  # job finished: lookups drain off
                     # any OTHER RuntimeError is a serving-path
                     # regression: swallowing it here would kill every
@@ -140,6 +184,7 @@ def main():
         threads = [threading.Thread(target=client, args=(i,),
                                     daemon=True)
                    for i in range(n_clients)]
+        threads.append(threading.Thread(target=sampler, daemon=True))
         t0 = time.perf_counter()
         for t in threads:
             t.start()
@@ -147,25 +192,30 @@ def main():
         stop.set()
         for t in threads:
             t.join(timeout=10)
-        return time.perf_counter() - t0, errors
+        return (time.perf_counter() - t0, errors, seen["gens"],
+                staleness)
 
-    # ---- phase 1: job-1 warms the cluster — ingest, fire AND serving
-    # programs all compile here (compiles are expected)
-    warm = SessionCluster(quantum_records=8192)
+    # ---- phase 1: job-1 warms the cluster — ingest, fire, serving AND
+    # replica publish/gather programs all compile here
+    warm = SessionCluster(quantum_records=8192,
+                          serving_workers=WORKERS,
+                          serving_cache_entries=CACHE_ENTRIES)
     warm.submit(_pipeline(CollectSink()), "job-1")
     run_with_lookups(warm, ["job-1"], 2)
 
     # ---- phase 2: two FRESH jobs on a fresh cluster + lookup load,
     # zero compiles allowed
     PROGRAM_CACHE.reset_stats()
-    cluster = SessionCluster(quantum_records=8192)
+    cluster = SessionCluster(quantum_records=8192,
+                             serving_workers=WORKERS,
+                             serving_cache_entries=CACHE_ENTRIES)
     s2, s3 = CollectSink(), CollectSink()
     cluster.submit(_pipeline(s2), "job-2",
                    quota=TenantQuota(max_resident_rows=QUOTA_ROWS))
     cluster.submit(_pipeline(s3), "job-3")
     with RecompileSentinel(max_compiles=0,
                            label="second job on warm cluster") as s:
-        elapsed, errors = run_with_lookups(
+        elapsed, errors, gens, staleness = run_with_lookups(
             cluster, ["job-2", "job-3"], CLIENTS)
 
     ok = True
@@ -175,6 +225,9 @@ def main():
     metrics = cluster.serving.metrics()
     lookups = int(metrics["lookups_total"])
     p99 = float(metrics["lookup_p99_ms"])
+    hit_rate = float(metrics["hot_row_hit_rate"])
+    staleness_p99 = quantile_sorted(sorted(staleness), 0.99) \
+        if staleness else 0.0
     lookups_per_s = lookups / elapsed if elapsed > 0 else 0.0
     for job in ("job-2", "job-3"):
         misses = PROGRAM_CACHE.stats_for(job)["misses"]
@@ -189,6 +242,20 @@ def main():
         print(f"FAIL: lookup p99 {p99:.1f} ms over the "
               f"{P99_BUDGET_MS:.0f} ms budget")
         ok = False
+    if REPLICA:
+        if lookups_per_s < MIN_LOOKUPS_PER_S:
+            print(f"FAIL: {lookups_per_s:,.0f} lookups/s under the "
+                  f"{MIN_LOOKUPS_PER_S:,.0f} floor (3x the recorded "
+                  "pre-replica row)")
+            ok = False
+        if hit_rate <= 0.0:
+            print("FAIL: hot-row cache never served a hit — the "
+                  "replica path is vacuously off")
+            ok = False
+        if gens < 2:
+            print(f"FAIL: replica generations advanced only {gens} "
+                  "times — boundary publishes are vacuously off")
+            ok = False
     viol = cluster.jobs["job-2"].ledger.quota_violations
     if viol:
         print(f"FAIL: {viol} quota violations on job-2")
@@ -203,16 +270,21 @@ def main():
         "unit": "lookups/s",
         "shape": f"{CLIENTS} client threads x "
                  f"{'point lookups' if LOOKUP_BATCH == 1 else f'{LOOKUP_BATCH}-key request batches'} "
-                 f"against 2 concurrent jobs "
+                 f"against 2 concurrent ingesting jobs "
                  f"({RECORDS} records each, mesh of 4) "
-                 f"— coalesced device batches "
-                 f"(avg {metrics['avg_batch_size']:.1f} lookups/batch), "
-                 f"p99 {p99:.1f} ms, 0 steady-state compiles "
-                 f"(compiles={s.compiles})",
+                 f"— read-replica serving plane "
+                 f"({'armed' if REPLICA else 'DISARMED: legacy live-plane path'}): "
+                 f"hot-row hit rate {hit_rate:.3f}, "
+                 f"replica staleness p99 {staleness_p99:.1f} ms "
+                 f"({gens} generations), p99 {p99:.2f} ms, "
+                 f"0 steady-state compiles (compiles={s.compiles})",
     }), flush=True)
     print(f"serving smoke: lookups={lookups} "
           f"batches={int(metrics['lookup_batches_total'])} "
-          f"p99={p99:.1f}ms compiles={s.compiles} quota_violations={viol} "
+          f"p99={p99:.2f}ms lookups/s={lookups_per_s:,.0f} "
+          f"hit_rate={hit_rate:.3f} generations={gens} "
+          f"staleness_p99={staleness_p99:.1f}ms "
+          f"compiles={s.compiles} quota_violations={viol} "
           f"=> {'OK' if ok else 'FAIL'}")
     return 0 if ok else 1
 

@@ -142,11 +142,17 @@ if [ "${SKIP_BENCH_SMOKE:-0}" != "1" ]; then
   JAX_PLATFORMS=cpu timeout -k 10 300 \
     python tools/recompile_smoke.py || exit 1
 
-  # Serving smoke: 2 concurrent jobs on one mesh + client threads
-  # hammering coalesced queryable-state lookups. FAILS on any
+  # Serving smoke: 2 concurrent ingesting jobs on one mesh + client
+  # threads hammering batched queryable-state lookups through the
+  # READ-REPLICA plane (boundary-published snapshots + publish-harvest
+  # hot-row cache + sharded coalescer workers, r17). FAILS on any
   # steady-state XLA compile after job-1 warms the shared program
-  # cache, on a per-job program-cache miss, on lookup p99 over budget,
-  # or on a quota violation. ~60 s on CPU.
+  # cache + replica tier lattice, on a per-job program-cache miss, on
+  # lookup p99 over 25 ms, on throughput under 216k lookups/s (3x the
+  # recorded pre-replica 72k row; measured ~395-430k here), on a zero
+  # hot-row hit rate / <2 replica generations (vacuity guards — the
+  # replica path must actually serve), or on a quota violation.
+  # ~40 s on CPU.
   SERVING_SMOKE_RECORDS=$((1 << 17)) \
     JAX_PLATFORMS=cpu timeout -k 10 300 \
     python tools/serving_smoke.py || exit 1

@@ -205,6 +205,71 @@ def main() -> int:
             "(lock contention? allocation storm?)")
         print(json.dumps(line))
         return 1
+    # --- 4. serving capture: lookups attribute to (job, generation) ------
+    # a small tenancy job under lookup load must leave serving spans in
+    # the rings with the TENANT named and the replica generation in the
+    # batch field (serving.lookup) plus boundary publishes
+    # (serving.replica_publish) — the correlation the Perfetto view
+    # needs to explain a slow lookup by what the replica was doing
+    rec.clear()
+    from flink_tpu.connectors.sinks import CollectSink
+    from flink_tpu.connectors.sources import DataGenSource
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.datastream.environment import (
+        StreamExecutionEnvironment,
+    )
+    from flink_tpu.runtime.watermarks import WatermarkStrategy
+    from flink_tpu.tenancy.session_cluster import SessionCluster
+    from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+
+    env = StreamExecutionEnvironment(Configuration({
+        "execution.micro-batch.size": 4096,
+        "parallelism.default": 4,
+    }))
+    (env.add_source(
+        DataGenSource(total_records=32768, num_keys=128,
+                      events_per_second_of_eventtime=50_000, seed=7),
+        WatermarkStrategy.for_bounded_out_of_orderness(0))
+        .key_by("key")
+        .window(TumblingEventTimeWindows.of(60_000))
+        .sum("value").sink_to(CollectSink()))
+    cluster = SessionCluster(quantum_records=4096)
+    cluster.submit(env, "trace-job")
+    rounds = 0
+    while cluster.step_round() and rounds < 8:
+        rounds += 1
+        try:
+            # fresh keys each round: misses exercise the worker flush
+            # (the serving.lookup span); repeats exercise the cache
+            cluster.lookup_batch(
+                "trace-job", "window_agg(SumAggregate)",
+                list(range(16)) + list(range(rounds * 64,
+                                             rounds * 64 + 32)))
+        except RuntimeError:
+            pass  # pre-first-publish rounds
+    cluster.run(timeout_s=120)
+    cluster.serving.shutdown_workers()
+    spans = rec.snapshot()
+    lookups_attr = [s for s in spans if s.kind == "serving.lookup"
+                    and s.job == "trace-job" and s.batch_id >= 1]
+    publishes = [s for s in spans
+                 if s.kind == "serving.replica_publish"]
+    line["serving_lookup_spans"] = len(lookups_attr)
+    line["serving_publish_spans"] = len(publishes)
+    problems = []
+    if not publishes:
+        problems.append(
+            "no serving.replica_publish span captured — boundary "
+            "publishes are invisible to the trace")
+    if not lookups_attr:
+        problems.append(
+            "no serving.lookup span attributed to (job, generation) — "
+            "a slow lookup cannot be correlated to its tenant and "
+            "replica generation in the Perfetto view")
+    if problems:
+        line["error"] = "; ".join(problems)
+        print(json.dumps(line))
+        return 1
     print(json.dumps(line))
     return 0
 
