@@ -26,6 +26,7 @@ KNOWN_FAULT_POINTS = (
     "shuffle.bucket_prep",
     "shuffle.bucket_send",
     "shuffle.device_exchange",
+    "exchange.dcn_send",
     "spill.page_reload",
     "spill.page_compact",
     "checkpoint.write",
@@ -43,6 +44,7 @@ KNOWN_FAULT_POINTS = (
     "task.batch",
     "task.subtask_batch",
     "device.lost",
+    "host.lost",
     "watchdog.deadline",
 )
 

@@ -74,6 +74,10 @@ class ChaosReport:
     shard_restores: int = 0
     records_replayed: int = 0
     shard_loss_recovery_ms: float = 0.0
+    #: HOST-granular failovers: a HostFailedError took a whole
+    #: process's contiguous slice of shards in one evacuation (each
+    #: also counts its member shards into shards_lost)
+    hosts_lost: int = 0
     divergences: List[str] = dataclasses.field(default_factory=list)
 
     @property
@@ -92,6 +96,7 @@ class ChaosReport:
             "windows": self.windows,
             "live_handoffs": self.live_handoffs,
             "shards_lost": self.shards_lost,
+            "hosts_lost": self.hosts_lost,
             "shard_restores": self.shard_restores,
             "records_replayed": self.records_replayed,
             "diverged": self.diverged,
@@ -106,7 +111,7 @@ class ChaosReport:
         g = group.add_group("chaos")
         for name in ("restores", "cold_restarts",
                      "corrupt_checkpoints_skipped", "crashes",
-                     "shards_lost", "shard_restores",
+                     "shards_lost", "hosts_lost", "shard_restores",
                      "records_replayed", "checkpoints_written"):
             g.gauge(name, lambda self=self, n=name: getattr(self, n))
 
@@ -596,7 +601,7 @@ def run_shard_loss_verify(
         cid = 0
         phase = 0             # 0 = batch pending, 1 = watermark pending
         need_restore = False
-        pending_loss: Optional[Tuple[int, int]] = None  # (shard, phase)
+        pending_loss: Optional[Tuple[tuple, int]] = None  # (shards, phase)
         #: (g0, g1, pos_r): range already absorbed up to pos_r — skip
         #: its records while pos < pos_r (mixed-age unit restore)
         gates: List[Tuple[int, int, int]] = []
@@ -632,7 +637,7 @@ def run_shard_loss_verify(
                     need_restore = False
                     continue
                 if pending_loss is not None:
-                    dead, at_phase = pending_loss
+                    dead_shards, at_phase = pending_loss
                     t0 = time.perf_counter()
                     replayed_before = report.records_replayed
                     # the restore/replay duration is a span in the
@@ -643,8 +648,10 @@ def run_shard_loss_verify(
                     with default_collector().span(
                             "recovery", "shard-failover") as fo_span, \
                             flight.span("failover.replay",
-                                        shard=int(dead)):
-                        g0, g1 = engine.lose_shard(dead)
+                                        shard=int(dead_shards[0])):
+                        # a HostFailedError carries the whole host's
+                        # contiguous slice: one evacuation, k units
+                        g0, g1 = engine.lose_shards(list(dead_shards))
                         groups = range(g0, g1 + 1)
                         # gates SPLIT around the dead range: the
                         # overlap is being rebuilt from its unit (its
@@ -715,7 +722,11 @@ def run_shard_loss_verify(
                                         int(steps[rpos][3])), epoch)
                         finally:
                             engine._watchdog = wd_held
-                        fo_span.set_attribute("shard", int(dead))
+                        fo_span.set_attribute(
+                            "shard", int(dead_shards[0]))
+                        if len(dead_shards) > 1:
+                            fo_span.set_attribute(
+                                "shards", [int(s) for s in dead_shards])
                         fo_span.set_attribute("key_groups", [g0, g1])
                         fo_span.set_attribute(
                             "records_replayed",
@@ -772,10 +783,19 @@ def run_shard_loss_verify(
                 pos = next_pos
                 phase = 0
             except ShardFailedError as sf:
-                report.shards_lost += 1
+                # a HostFailedError carries the host's whole slice —
+                # every member shard counts toward the loss budget
+                # (type check, not length: a 1-device-per-host pod
+                # loses exactly one shard per host)
+                from flink_tpu.runtime.watchdog import HostFailedError
+
+                shards = tuple(getattr(sf, "shards", ()) or (sf.shard,))
+                report.shards_lost += len(shards)
+                if isinstance(sf, HostFailedError):
+                    report.hosts_lost += 1
                 if report.shards_lost > max_shard_losses:
                     raise
-                pending_loss = (sf.shard, phase)
+                pending_loss = (shards, phase)
             except (InjectedFault, MeshStalledError):
                 # an unattributable mesh-wide stall takes the same
                 # whole-job path a crash does (see MeshStalledError)

@@ -481,6 +481,9 @@ class LocalExecutor:
                                       memory_manager=memory_manager,
                                       shuffle_mode=self.config.get(
                                           DeploymentOptions.SHUFFLE_MODE),
+                                      host_topology=(self.config.get(
+                                          DeploymentOptions.SHUFFLE_HOSTS)
+                                          or None),
                                       watchdog=watchdog,
                                       pane_preagg=self.config.get(
                                           LatencyOptions.PANE_PREAGG))

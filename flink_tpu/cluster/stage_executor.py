@@ -1091,7 +1091,10 @@ class _KeyedSubtask(threading.Thread):
                               max_parallelism=self.max_parallelism,
                               memory_manager=self.memory_manager,
                               shuffle_mode=self.config.get(
-                                  DeploymentOptions.SHUFFLE_MODE))
+                                  DeploymentOptions.SHUFFLE_MODE),
+                              host_topology=(self.config.get(
+                                  DeploymentOptions.SHUFFLE_HOSTS)
+                                  or None))
         if self.mesh_devices > 1:
             # mesh x stage composition: this subtask opens its keyed
             # engine over a private sub-mesh — subtasks distribute across

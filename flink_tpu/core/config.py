@@ -326,6 +326,18 @@ class DeploymentOptions:
         "'host' keeps the explicit fallback: [shards, B] bucketing in "
         "host numpy + a sharded device_put per block. See "
         "flink_tpu/parallel/shuffle.py.")
+    SHUFFLE_HOSTS = ConfigOption(
+        "shuffle.hosts", default=0, type=int,
+        description="Number of HOSTS the key-group mesh spans (the "
+        "(hosts, local) factorization of the device axis). 0/1 (the "
+        "default) keeps the flat single-axis exchange; >1 routes "
+        "device-mode keyBy through the two-level ICI/DCN exchange "
+        "(parallel/exchange2.py): stage 1 all_to_all over the "
+        "intra-host axis, stage 2 batches only the cross-host residue "
+        "over the hosts axis — on a multi-process pod mesh the hosts "
+        "axis IS the process boundary; on one process it is a virtual "
+        "factorization (tests/CI). Engines whose mesh size the count "
+        "does not divide keep the flat exchange.")
     JOIN_MODE = ConfigOption(
         "join.mode", default="host", type=str,
         description="Execution plane for the DataStream interval join "

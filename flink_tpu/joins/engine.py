@@ -95,6 +95,7 @@ class JoinEngineBase:
                  key_group_range: Optional[Tuple[int, int]] = None,
                  backend: str = "device",
                  shuffle_mode: str = "device",
+                 host_topology=None,
                  suffixes: Tuple[str, str] = ("_l", "_r")) -> None:
         if backend not in ("device", "host"):
             raise ValueError(
@@ -153,6 +154,30 @@ class JoinEngineBase:
                                            PartitionSpec(KEY_AXIS))
             self._pool = ShuffleBufferPool(generations=2)
             self._fences: List = []
+        #: (hosts, local) factorization when the mesh spans processes:
+        #: device-mode ingest then runs the two-level ICI/DCN exchange
+        #: (the join twin of the mesh engines' pod path)
+        self.host_topology = None
+        self._exchange2_traffic = None
+        if host_topology is not None:
+            if backend != "device":
+                raise ValueError(
+                    "host_topology requires the device backend")
+            host_topology.check_covers(self.P)
+            from flink_tpu.parallel.exchange2 import ExchangeTraffic
+
+            self.host_topology = host_topology
+            self._exchange2_traffic = ExchangeTraffic()
+
+    def _two_level_active(self) -> bool:
+        from flink_tpu.parallel.exchange2 import two_level_active
+
+        return two_level_active(self.host_topology, self.shuffle_mode)
+
+    def exchange2_traffic(self) -> Dict[str, int]:
+        from flink_tpu.parallel.exchange2 import ExchangeTraffic
+
+        return ExchangeTraffic.dict_of(self._exchange2_traffic)
 
     # ------------------------------------------------------------- watchdog
 
@@ -162,6 +187,7 @@ class JoinEngineBase:
         self._watchdog = wd
         if wd is not None and self.mesh is not None:
             wd.rebind(self.P, [d.id for d in self.mesh.devices.flat])
+            wd.set_topology(self.host_topology)
 
     def _wd_section(self, op: str, shard: int = -1):
         wd = self._watchdog
@@ -392,7 +418,31 @@ class JoinEngineBase:
         fills = [0] + [side.schema[i][1].type(0)
                        for i in side.device_cols]
         self._pool.flip()
-        if self.shuffle_mode == "device":
+        if self._two_level_active():
+            # pod mesh: two-level ICI/DCN exchange then the plane
+            # write — stream order preserved, so the last-write-wins
+            # semantics stay bit-identical to the flat exchange
+            from flink_tpu.observe import flight_recorder as flight
+            from flink_tpu.parallel.exchange2 import (
+                build_join_exchange2_steps,
+                stage_two_level_exchange,
+            )
+
+            dst, staged, w1, w2 = stage_two_level_exchange(
+                shards, self.host_topology, columns=cols, fills=fills,
+                pool=self._pool, traffic=self._exchange2_traffic)
+            s1, s2 = build_join_exchange2_steps(
+                self.mesh, self.host_topology, side.dtypes_key())
+            with self._wd_section("join_ingest"):
+                with flight.span("exchange.stage1"):
+                    put = jax.device_put((dst, *staged),
+                                         self._sharding)
+                    inter = s1(put[0], put[1], tuple(put[2:]), w1)
+                with flight.span("exchange.stage2"):
+                    self._planes[side_idx] = s2(
+                        planes, inter[0], inter[1], tuple(inter[2:]),
+                        w2)
+        elif self.shuffle_mode == "device":
             dst, staged, width = stage_device_exchange(
                 shards, self.P, columns=cols, fills=fills,
                 pool=self._pool)
@@ -903,6 +953,11 @@ class JoinEngineBase:
                                            PartitionSpec(KEY_AXIS))
         else:
             self.P = new_shards
+        t = self.host_topology
+        if t is not None and t.num_shards != self.P:
+            # the (hosts, local) factorization no longer covers the
+            # resized mesh — drop to the flat single-axis exchange
+            self.host_topology = None
         if self.max_parallelism < self.P:
             raise ValueError(
                 f"cannot reshard to {new_shards}: max_parallelism "

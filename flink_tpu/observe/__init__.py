@@ -26,6 +26,9 @@ KNOWN_SPAN_KINDS = (
     "prep.stage",          # shuffle staging / bucketing into [P, B] blocks
     "device.dispatch",     # inline device interactions on the ingest path
     "device.fence_wait",   # host blocked on dispatch-ahead fences
+    "exchange.stage1",     # two-level exchange: intra-host (ICI) route
+    "exchange.stage2",     # two-level exchange: cross-host (DCN) hop +
+                           # the stream-order scatter
     "fire.dispatch",       # watermark advance -> fire programs enqueued
     "fire.shard",          # one shard's fire-path host work (resolve,
                            # cold page extraction) — the per-shard track

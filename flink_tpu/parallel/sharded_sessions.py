@@ -122,6 +122,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         spill_layout: str = "pages",
         max_dispatch_ahead: int = 2,
         shuffle_mode: str = "device",
+        host_topology=None,
     ) -> None:
         self.gap = int(gap)
         self.agg = agg
@@ -147,6 +148,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         self._memory = memory
         self.mesh = mesh
         self.P = int(mesh.devices.size)
+        self._set_host_topology(host_topology)
         #: per-SHARD HBM slot budget; cold sessions spill per shard and
         #: reload on access (see MeshSpillSupport — the 10M-key session
         #: capacity of BASELINE row 5 cannot be device-resident)
@@ -209,6 +211,13 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         # compiles lazily on first use)
         self._exchange_scatter_step = build_exchange_scatter(
             self.mesh, self.agg, valued=False)
+        if self._two_level_active():
+            from flink_tpu.parallel.exchange2 import (
+                build_exchange2_steps,
+            )
+
+            self._exchange2_steps = build_exchange2_steps(
+                self.mesh, self.host_topology, self.agg, valued=False)
 
     def _shard_index_grew(self, new_capacity: int) -> None:
         """Uniform-SPMD grow: widen [P, capacity] arrays to the largest
@@ -443,7 +452,29 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                    *[np.asarray(v, dtype=l.dtype)
                      for v, l in zip(values, in_leaves)]]
         fills = [0, *[l.identity for l in in_leaves]]
-        if self.shuffle_mode == "device":
+        if self._two_level_active():  # implies device shuffle mode
+            # pod mesh: the two-level ICI/DCN exchange (see
+            # parallel/exchange2.py) — bit-identical to the flat
+            # program, two dispatches so ICI vs DCN time attributes
+            # as distinct span kinds
+            from flink_tpu.parallel.exchange2 import (
+                stage_two_level_exchange,
+            )
+
+            with flight.span("prep.stage"):
+                dst, staged, w1, w2 = stage_two_level_exchange(
+                    rec_shards, self.host_topology, columns=columns,
+                    fills=fills, pool=self._shuffle_pool,
+                    traffic=self._exchange2_traffic)
+            s1, s2 = self._exchange2_steps
+            with self._device_span(), flight.span("exchange.stage1"):
+                put = jax.device_put((dst, *staged), self._sharding)
+                inter = s1(put[0], put[1], tuple(put[2:]), w1)
+            with self._device_span(), flight.span("exchange.stage2"):
+                self.accs = s2(self.accs, inter[0], inter[1],
+                               tuple(inter[2:]), w2)
+            chaos.fault_point("shuffle.device_exchange", records=n)
+        elif self.shuffle_mode == "device":
             with flight.span("prep.stage"):
                 dst, staged, width = stage_device_exchange(
                     rec_shards, self.P, columns=columns, fills=fills,

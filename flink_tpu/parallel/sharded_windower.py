@@ -104,6 +104,15 @@ class MeshSpillSupport:
     #: in ONE compiled program), "host" through the [P, B] bucketing +
     #: sharded device_put (the explicit fallback — see parallel.shuffle)
     shuffle_mode: str = "device"
+    #: the (hosts, local) factorization of the mesh, when it spans
+    #: hosts/processes: device-mode ingest then runs the TWO-LEVEL
+    #: ICI/DCN exchange (parallel/exchange2.py) instead of the flat
+    #: single-axis program; None (or a 1-host topology) keeps the flat
+    #: fast path — every engine on a single-process mesh is unchanged
+    host_topology = None
+    #: intra- vs cross-host row accounting for the two-level exchange
+    #: (smoke vacuity guard + the NOTES traffic split)
+    _exchange2_traffic = None
 
     @staticmethod
     def _check_shuffle_mode(mode: str) -> str:
@@ -111,6 +120,26 @@ class MeshSpillSupport:
             raise ValueError(
                 f"shuffle_mode must be 'host' or 'device', got {mode!r}")
         return mode
+
+    def _set_host_topology(self, topology) -> None:
+        if topology is not None:
+            topology.check_covers(self.P)
+        self.host_topology = topology
+        if topology is not None and self._exchange2_traffic is None:
+            from flink_tpu.parallel.exchange2 import ExchangeTraffic
+
+            self._exchange2_traffic = ExchangeTraffic()
+
+    def _two_level_active(self) -> bool:
+        from flink_tpu.parallel.exchange2 import two_level_active
+
+        return two_level_active(self.host_topology, self.shuffle_mode)
+
+    def exchange2_traffic(self) -> Dict[str, int]:
+        """Two-level exchange traffic split (zeros when flat)."""
+        from flink_tpu.parallel.exchange2 import ExchangeTraffic
+
+        return ExchangeTraffic.dict_of(self._exchange2_traffic)
 
     def _reserve_rows(self, rows: int) -> None:
         if self._memory is not None:
@@ -219,6 +248,8 @@ class MeshSpillSupport:
         if wd is not None:
             wd.rebind(self.P,
                       [d.id for d in self.mesh.devices.flat])
+            # host-granular escalation needs the (hosts, local) map
+            wd.set_topology(self.host_topology)
 
     def _wd_section(self, op: str, shard: int = -1):
         wd = self._watchdog
@@ -1074,6 +1105,14 @@ class MeshSpillSupport:
         self.release_memory()
         self.mesh = mesh
         self.P = int(mesh.devices.size)
+        t = self.host_topology
+        if t is not None and t.num_shards != self.P:
+            # a reshard / partial failover changed the device count:
+            # the (hosts, local) factorization no longer describes the
+            # mesh — fall back to the flat single-axis exchange (the
+            # evacuated mesh is host-local until a re-plan re-declares
+            # a topology)
+            self.host_topology = None
         # the replica's metadata shadow describes the OLD plane — the
         # next boundary publish rebuilds it over the new mesh
         self._rep_rebuild = True
@@ -1271,48 +1310,72 @@ class MeshSpillSupport:
         Like ``reshard``, not exception-atomic: a failure mid-evacuation
         falls back to whole-job checkpoint restore.
         """
-        dead = int(dead)
-        if not (0 <= dead < self.P):
-            raise ValueError(f"no shard {dead} on a {self.P}-shard mesh")
-        if self.P <= 1:
+        return self.lose_shards([dead])
+
+    def lose_shards(self, dead) -> Tuple[int, int]:
+        """Multi-shard loss in ONE evacuation — the HOST failure
+        domain: a lost process takes its whole contiguous slice of
+        shards (``HostTopology.shards_of_host``), survivors evacuate
+        once, the mesh rebuilds over ``P - k`` devices, and the caller
+        restores the dead shards' ``k`` checkpoint units. The dead
+        shards must be CONTIGUOUS in flat shard order (hosts are, by
+        construction — host-major layout), so the merged key-group
+        span ``(first, last)`` returned covers exactly their units and
+        the bounded replay is one contiguous range."""
+        dead_set = sorted({int(d) for d in dead})
+        if not dead_set:
+            raise ValueError("no shards to lose")
+        for d in dead_set:
+            if not (0 <= d < self.P):
+                raise ValueError(
+                    f"no shard {d} on a {self.P}-shard mesh")
+        if dead_set != list(range(dead_set[0], dead_set[-1] + 1)):
             raise ValueError(
-                "cannot partially fail over a 1-shard mesh — the only "
-                "shard IS the job (whole-job restore applies)")
+                f"dead shards must be contiguous (a host's slice), "
+                f"got {dead_set}")
+        if len(dead_set) >= self.P:
+            raise ValueError(
+                "cannot partially fail over the whole mesh — "
+                "whole-job restore applies")
         t0 = time.perf_counter()
-        dead_range = self.shard_key_groups()[dead]
+        ranges = self.shard_key_groups()
+        dead_range = (int(ranges[dead_set[0]][0]),
+                      int(ranges[dead_set[-1]][1]))
         # quiesce the SURVIVORS: every in-flight dispatch must land
-        # before the plane is torn down (the dead shard's fences are
-        # moot — its state is discarded unread below)
+        # before the plane is torn down (the dead shards' fences are
+        # moot — their state is discarded unread below)
         while self._dispatch_fences:
             # flint: disable=TRC01 -- failover quiesce: the mesh plane
             # is about to be rebuilt, in-flight dispatches must land
             self._dispatch_fences.popleft().block_until_ready()
-        rows = self._collect_handoff(skip_shards={dead})
+        rows = self._collect_handoff(skip_shards=set(dead_set))
         devices = [d for i, d in enumerate(self.mesh.devices.flat)
-                   if i != dead]
+                   if i not in dead_set]
         old_p = self.P
-        self._rebuild_mesh_plane(old_p - 1, devices=devices)
+        self._rebuild_mesh_plane(old_p - len(dead_set),
+                                 devices=devices)
         resident_rows, spilled_rows = self._redistribute_handoff(rows)
-        # the dead range's host metadata dies with its shard (engine
+        # the dead ranges' host metadata dies with their shards (engine
         # hook: session intervals for the window engines' global book
         # there is nothing per-key to drop)
         self._drop_meta_key_groups(
-            range(int(dead_range[0]), int(dead_range[1]) + 1))
+            range(dead_range[0], dead_range[1] + 1))
         wd = self._watchdog
         if wd is not None:
-            # survivors renumber 0..P-2; the dead device id stays in
+            # survivors renumber 0..P-k-1; the dead device ids stay in
             # the watchdog's quarantine HISTORY for budget accounting
             wd.rebind(self.P,
                       [d.id for d in self.mesh.devices.flat])
         self.last_shard_loss = {
-            "dead_shard": dead, "from": old_p, "to": self.P,
-            "key_groups": (int(dead_range[0]), int(dead_range[1])),
+            "dead_shard": dead_set[0], "dead_shards": dead_set,
+            "from": old_p, "to": self.P,
+            "key_groups": dead_range,
             "survivor_rows": int(len(rows["key_id"])),
             "resident_rows": resident_rows,
             "spilled_rows": spilled_rows,
             "seconds": time.perf_counter() - t0,
         }
-        return (int(dead_range[0]), int(dead_range[1]))
+        return dead_range
 
     def restore_key_groups(self, snap: Dict[str, object],
                            groups) -> int:
@@ -1822,6 +1885,7 @@ class MeshWindowEngine(MeshSpillSupport):
         memory=None,
         max_dispatch_ahead: int = 2,
         shuffle_mode: str = "device",
+        host_topology=None,
     ) -> None:
         self.assigner = assigner
         self.agg = agg
@@ -1842,6 +1906,7 @@ class MeshWindowEngine(MeshSpillSupport):
         self.fire_projector = fire_projector
         self.mesh = mesh
         self.P = int(mesh.devices.size)
+        self._set_host_topology(host_topology)
         #: per-SHARD HBM slot budget — the raw
         #: state.slot-table.max-device-slots value, which is PER DEVICE
         #: (each shard owns one chip's HBM, so total capacity scales with
@@ -1906,6 +1971,15 @@ class MeshWindowEngine(MeshSpillSupport):
             self.mesh, self.agg, valued=False)
         self._exchange_valued_step = build_exchange_scatter(
             self.mesh, self.agg, valued=True)
+        if self._two_level_active():
+            from flink_tpu.parallel.exchange2 import (
+                build_exchange2_steps,
+            )
+
+            self._exchange2_steps = build_exchange2_steps(
+                self.mesh, self.host_topology, self.agg, valued=False)
+            self._exchange2_valued = build_exchange2_steps(
+                self.mesh, self.host_topology, self.agg, valued=True)
 
     def _shard_index_grew(self, new_capacity: int) -> None:
         """One shard's index outgrew the device column count: widen the
@@ -2126,23 +2200,50 @@ class MeshWindowEngine(MeshSpillSupport):
         # finished — the same fence discipline as the host blocks)
         self._await_dispatch_slot()
         self._shuffle_pool.flip()
-        dst, staged, width = stage_device_exchange(
-            shards, self.P,
-            columns=[rec_slots,
-                     *[np.asarray(v, dtype=l.dtype)
-                       for v, l in zip(values, leaves)]],
-            fills=[0, *[l.identity for l in leaves]],
-            pool=self._shuffle_pool,
-        )
-        with self._device_span():
-            # ONE host->device hop for the whole batch: every flat
-            # column in a single device_put against the key-group
-            # sharding
-            put = jax.device_put((dst, *staged), self._sharding)
-            step = (self._exchange_valued_step if partial
-                    else self._exchange_scatter_step)
-            self.accs = step(self.accs, put[0], put[1], tuple(put[2:]),
-                             width)
+        columns = [rec_slots,
+                   *[np.asarray(v, dtype=l.dtype)
+                     for v, l in zip(values, leaves)]]
+        fills = [0, *[l.identity for l in leaves]]
+        if self._two_level_active():
+            # pod mesh: the two-level ICI/DCN exchange — stage 1 routes
+            # by destination local index over the intra-host axis,
+            # stage 2 batches the cross-host residue over the hosts
+            # axis and scatters in global stream order (bit-identical
+            # to the flat program; two dispatches so the recorder can
+            # attribute ICI vs DCN time)
+            from flink_tpu.parallel.exchange2 import (
+                stage_two_level_exchange,
+            )
+
+            with flight.span("prep.stage"):
+                dst, staged, w1, w2 = stage_two_level_exchange(
+                    shards, self.host_topology, columns=columns,
+                    fills=fills, pool=self._shuffle_pool,
+                    traffic=self._exchange2_traffic)
+            s1, s2 = (self._exchange2_valued if partial
+                      else self._exchange2_steps)
+            with self._device_span(), flight.span("exchange.stage1"):
+                put = jax.device_put((dst, *staged), self._sharding)
+                inter = s1(put[0], put[1], tuple(put[2:]), w1)
+            with self._device_span(), flight.span("exchange.stage2"):
+                self.accs = s2(self.accs, inter[0], inter[1],
+                               tuple(inter[2:]), w2)
+        else:
+            dst, staged, width = stage_device_exchange(
+                shards, self.P,
+                columns=columns,
+                fills=fills,
+                pool=self._shuffle_pool,
+            )
+            with self._device_span():
+                # ONE host->device hop for the whole batch: every flat
+                # column in a single device_put against the key-group
+                # sharding
+                put = jax.device_put((dst, *staged), self._sharding)
+                step = (self._exchange_valued_step if partial
+                        else self._exchange_scatter_step)
+                self.accs = step(self.accs, put[0], put[1],
+                                 tuple(put[2:]), width)
         # "crash mid-batch after the fused dispatch": the scatter is in
         # flight on the device queue, the host dies before the fence —
         # the hardest restore case for the device data plane

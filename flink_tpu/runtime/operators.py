@@ -78,6 +78,26 @@ class Operator:
         pass
 
 
+def _ctx_topology(ctx, mesh):
+    """Resolve the context's host-topology declaration against the
+    engine's actual mesh: an int (``shuffle.hosts``) factors the mesh
+    size; a :class:`~flink_tpu.parallel.mesh.HostTopology` is used when
+    it covers. A declaration that cannot factor THIS mesh (e.g. a
+    stage sub-mesh of a different size) falls back to the flat
+    exchange rather than failing the job."""
+    decl = getattr(ctx, "host_topology", None)
+    if decl is None:
+        return None
+    size = int(mesh.devices.size)
+    if isinstance(decl, int):
+        if decl > 1 and size % decl == 0:
+            from flink_tpu.parallel.mesh import HostTopology
+
+            return HostTopology(decl, size // decl)
+        return None
+    return decl if decl.num_shards == size else None
+
+
 class OperatorContext:
     """Per-operator runtime context (task info, metrics hook)."""
 
@@ -86,7 +106,7 @@ class OperatorContext:
                  async_fires: bool = False, max_dispatch_ahead: int = 4,
                  mesh=None, key_group_range=None, memory_manager=None,
                  shuffle_mode: str = "device", watchdog=None,
-                 pane_preagg: bool = True):
+                 pane_preagg: bool = True, host_topology=None):
         self.operator_index = operator_index
         self.parallelism = parallelism
         self.max_parallelism = max_parallelism
@@ -109,6 +129,10 @@ class OperatorContext:
         #: keyBy data plane for mesh engines (shuffle.mode):
         #: "device" = in-program exchange, "host" = explicit fallback
         self.shuffle_mode = shuffle_mode
+        #: (hosts, local) factorization of the mesh (shuffle.hosts) —
+        #: an int host count or a HostTopology; mesh engines then run
+        #: the two-level ICI/DCN exchange (parallel/exchange2.py)
+        self.host_topology = host_topology
         #: DeviceWatchdog (runtime/watchdog.py) the mesh engines attach
         #: when watchdog.enabled — deadline-tracked device sections +
         #: batch-boundary shard-health probes; None = disabled
@@ -273,7 +297,10 @@ class WindowAggOperator(Operator):
                 max_dispatch_ahead=getattr(ctx, "max_dispatch_ahead", 2),
                 # keyBy data plane (shuffle.mode): in-program device
                 # exchange by default, host bucketing as the fallback
-                shuffle_mode=getattr(ctx, "shuffle_mode", "device"))
+                shuffle_mode=getattr(ctx, "shuffle_mode", "device"),
+                # (hosts, local) factorization (shuffle.hosts): the
+                # two-level ICI/DCN exchange on a pod-spanning mesh
+                host_topology=_ctx_topology(ctx, mesh))
         else:
             table_kwargs, placement = self._table_kwargs()
             if self._managed_memory(ctx) is not None:
@@ -753,7 +780,8 @@ class SessionWindowAggOperator(WindowAggOperator):
                 # pipeline depth (execution.pipeline.max-dispatch-batches)
                 max_dispatch_ahead=getattr(ctx, "max_dispatch_ahead", 2),
                 # keyBy data plane (shuffle.mode)
-                shuffle_mode=getattr(ctx, "shuffle_mode", "device"))
+                shuffle_mode=getattr(ctx, "shuffle_mode", "device"),
+                host_topology=_ctx_topology(ctx, mesh))
         else:
             table_kwargs, _ = self._table_kwargs()
             if self._managed_memory(ctx) is not None:

@@ -61,6 +61,31 @@ class ShardFailedError(RuntimeError):
         self.reason = reason
 
 
+class HostFailedError(ShardFailedError):
+    """A whole HOST (one process's slice of the mesh — ``local_devices``
+    shards, one contiguous key-group range) was declared dead: the
+    chaos ``host.lost`` point fired, or every one of the host's shards
+    uniformly ran past the deadline-miss budget while other hosts
+    stayed healthy — the attribution signature of a lost process /
+    severed DCN link, not of one wedged chip. Recovery is
+    HOST-GRANULAR: survivors evacuate, the dead host's ``k`` shard
+    units restore, its contiguous range replays (bounded by the
+    per-host share of the stream)."""
+
+    def __init__(self, host: int, shards, reason: str) -> None:
+        self.host = int(host)
+        self.shards = tuple(int(s) for s in shards)
+        # ShardFailedError compat: .shard carries the first member so
+        # shard-granular consumers still attribute SOMETHING sensible
+        RuntimeError.__init__(
+            self,
+            f"host {host} declared dead (shards "
+            f"{list(self.shards)}): {reason} — host failover (restore "
+            "that host's key-group ranges, replay only its span)")
+        self.shard = self.shards[0] if self.shards else -1
+        self.reason = reason
+
+
 class MeshStalledError(RuntimeError):
     """EVERY live shard is past its deadline-miss budget at once.
 
@@ -161,6 +186,26 @@ class DeviceWatchdog:
         self._device_ids = (list(device_ids)
                             if device_ids is not None else None)
         self.quarantined = set()
+        t = getattr(self, "_topology", None)
+        if t is not None and t.num_shards != self.num_shards:
+            # a failover/reshard renumbered the shards: the (hosts,
+            # local) mapping no longer applies — host attribution is
+            # off until an engine re-declares a topology
+            self._topology = None
+
+    #: HostTopology for HOST-granular escalation (None = shard-only)
+    _topology = None
+
+    def set_topology(self, topology) -> None:
+        """Teach the watchdog the mesh's (hosts, local) factorization:
+        the boundary probe then (a) fires the chaos ``host.lost`` point
+        once per live host and (b) escalates a miss streak that
+        uniformly covers exactly one host's shards — while other hosts
+        stay healthy — to :class:`HostFailedError` instead of picking
+        one member shard."""
+        if topology is not None:
+            topology.check_covers(self.num_shards)
+        self._topology = topology
 
     # ------------------------------------------------------------ sections
 
@@ -204,7 +249,19 @@ class DeviceWatchdog:
         declared dead, so the raising point always sees an engine that
         is consistent at a known source position (the micro-batch analog
         of failing over at a barrier, not mid-record)."""
+        topo = self._topology
         if chaos.armed():
+            if topo is not None:
+                for h in range(topo.num_hosts):
+                    members = [p for p in topo.shards_of_host(h)
+                               if p not in self.quarantined]
+                    if not members:
+                        continue
+                    try:
+                        chaos.fault_point("host.lost", host=h)
+                    except chaos.InjectedFault as f:
+                        self.declare_host_dead(
+                            h, members, f"host.lost injected ({f})")
             for p in range(self.num_shards):
                 if p in self.quarantined:
                     continue
@@ -227,6 +284,24 @@ class DeviceWatchdog:
                 f"miss budget ({self.max_misses} misses at "
                 f"{self.deadline_ms} ms) — mesh-wide stall, no shard "
                 "attribution: whole-job restart")
+        if topo is not None:
+            # HOST escalation: a streak that uniformly covers EXACTLY
+            # one host's live shards — no offenders anywhere else — is
+            # the signature of a lost PROCESS (or severed DCN link),
+            # not one wedged chip: declare the host, not a member. A
+            # streak that spills outside one host carries mixed
+            # attribution and stays shard-granular below.
+            off = set(offenders)
+            for h in range(topo.num_hosts):
+                members = {p for p in topo.shards_of_host(h)
+                           if p in live}
+                if members and off == members:
+                    self.declare_host_dead(
+                        h, sorted(members),
+                        f"uniform deadline-miss streak across all "
+                        f"{len(members)} live shards of host {h} "
+                        f"(budget {self.max_misses}, deadline "
+                        f"{self.deadline_ms} ms)")
         p = offenders[0]
         self.declare_dead(
             p, f"{self._misses[p]} consecutive deadline misses "
@@ -240,6 +315,22 @@ class DeviceWatchdog:
             self.quarantined_devices.add(self._device_ids[int(shard)])
         self.declared_dead += 1
         raise ShardFailedError(int(shard), reason)
+
+    def declare_host_dead(self, host: int, shards,
+                          reason: str) -> None:
+        """Quarantine every shard of ``host`` at once and raise the
+        host-granular failure (the escalation ladder's HOST level)."""
+        for p in shards:
+            self.quarantined.add(int(p))
+            if self._device_ids is not None \
+                    and 0 <= int(p) < len(self._device_ids):
+                self.quarantined_devices.add(self._device_ids[int(p)])
+        self.declared_dead += 1
+        self.hosts_declared_dead += 1
+        raise HostFailedError(int(host), shards, reason)
+
+    #: hosts declared dead over the watchdog's lifetime
+    hosts_declared_dead = 0
 
     # -------------------------------------------------------------- signals
 
@@ -266,6 +357,8 @@ class DeviceWatchdog:
         g.gauge("deadline_misses", lambda: self.deadline_misses)
         g.gauge("shards_quarantined", lambda: len(self.quarantined))
         g.gauge("declared_dead", lambda: self.declared_dead)
+        g.gauge("hosts_declared_dead",
+                lambda: self.hosts_declared_dead)
         g.gauge("heartbeat_age_s", lambda: self.heartbeat_age_s())
 
 

@@ -171,6 +171,36 @@ def shard_key_group_ranges(parallelism: int, max_parallelism: int,
     ]
 
 
+def host_key_group_ranges(num_hosts: int, local_devices: int,
+                          max_parallelism: int,
+                          key_group_range=None) -> List[tuple]:
+    """GLOBAL ``(first, last)`` inclusive key groups owned by each HOST
+    of a ``num_hosts x local_devices`` pod mesh — the stable
+    process -> key-group-range mapping (ROADMAP item 2). Host ``h``
+    owns the union of its local shards' ranges, which is contiguous by
+    construction (shard ranges are contiguous and host-major adjacent),
+    so a lost HOST is exactly "lose ``local_devices`` shard units,
+    restore them, replay one contiguous range"."""
+    shard_ranges = shard_key_group_ranges(
+        int(num_hosts) * int(local_devices), max_parallelism,
+        key_group_range)
+    L = int(local_devices)
+    return [(shard_ranges[h * L][0], shard_ranges[h * L + L - 1][1])
+            for h in range(int(num_hosts))]
+
+
+def host_of_key_group(key_groups: np.ndarray, num_hosts: int,
+                      local_devices: int, max_parallelism: int
+                      ) -> np.ndarray:
+    """key group -> owning host, vectorized: the shard formula composed
+    with the host-major shard layout (``shard // local_devices``)."""
+    shard = key_group_to_operator_index(
+        key_groups, max_parallelism,
+        int(num_hosts) * int(local_devices))
+    return (np.asarray(shard, dtype=np.int64)
+            // int(local_devices)).astype(np.int32)
+
+
 def validate_max_parallelism(max_parallelism: int) -> None:
     if not (1 <= max_parallelism <= (1 << 15)):
         raise ValueError(

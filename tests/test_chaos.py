@@ -1083,6 +1083,98 @@ class TestWatchdogPoints:
             _note_reached(c.faults_injected)
 
 
+class TestPodFaultPoints:
+    """The pod-scale fault points at their real sites: ``host.lost``
+    fires inside the watchdog's boundary probe once per live HOST (the
+    process-granular death the multi-process chaos scenario injects),
+    and ``exchange.dcn_send`` models a lossy DCN link in the two-level
+    exchange staging — drop/duplicate per CROSS-host (src, dst) bucket.
+    The full host-failover protocol lives in
+    tests/test_host_failover.py."""
+
+    def _pod_engine(self, watchdog=True):
+        from flink_tpu.parallel.mesh import HostTopology, make_mesh
+        from flink_tpu.parallel.sharded_sessions import (
+            MeshSessionEngine,
+        )
+        from flink_tpu.runtime.watchdog import DeviceWatchdog
+        from flink_tpu.windowing.aggregates import SumAggregate
+
+        eng = MeshSessionEngine(GAP, SumAggregate("v"), make_mesh(4),
+                                capacity_per_shard=1024,
+                                host_topology=HostTopology(2, 2))
+        if watchdog:
+            eng.attach_watchdog(DeviceWatchdog(eng.P))
+        return eng
+
+    def test_host_lost_declares_whole_host_at_real_site(self):
+        from flink_tpu.runtime.watchdog import HostFailedError
+
+        from tests.test_sessions import keyed_batch
+
+        eng = self._pod_engine()
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="host.lost", nth=1,
+                      where={"host": 1})])
+        with chaos.chaos_active(plan, seed=0) as c:
+            with pytest.raises(HostFailedError) as ei:
+                eng.process_batch(keyed_batch([1, 2, 3],
+                                              [1.0, 2.0, 3.0],
+                                              [0, 10, 20]))
+            assert ei.value.host == 1
+            # the whole host's slice quarantines in one declaration
+            assert ei.value.shards == (2, 3)
+            assert eng._watchdog.quarantined == {2, 3}
+            assert eng._watchdog.hosts_declared_dead == 1
+            assert c.faults_injected.get("host.lost", 0) == 1
+            _note_reached(c.faults_injected)
+
+    def test_dcn_send_drop_loses_the_cross_host_bucket(self):
+        from flink_tpu.parallel.exchange2 import (
+            stage_two_level_exchange,
+        )
+        from flink_tpu.parallel.mesh import HostTopology
+
+        topo = HostTopology(2, 2)
+        # records in chunk 0 (source host 0) destined to shards 2 and 3
+        # (host 1) — the (0 -> 1) DCN bucket
+        shards = np.array([2, 3, 0, 2], dtype=np.int64)
+        slots = np.arange(1, 5, dtype=np.int32)
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="exchange.dcn_send", nth=1, kind="drop",
+                      where={"src_host": 0, "dst_host": 1})])
+        with chaos.chaos_active(plan, seed=0) as c:
+            dst, (s_col,), w1, w2 = stage_two_level_exchange(
+                shards, topo, columns=[slots], fills=[0])
+            assert c.faults_injected.get("exchange.dcn_send", 0) == 1
+            _note_reached(c.faults_injected)
+        # the cross-host rows re-routed to the padding destination
+        # (they vanish before the stage-1 collective); the intra-host
+        # row survives
+        np.testing.assert_array_equal(dst[:4], [4, 4, 0, 4])
+
+    def test_dcn_send_duplicate_replays_the_bucket(self):
+        from flink_tpu.parallel.exchange2 import (
+            stage_two_level_exchange,
+        )
+        from flink_tpu.parallel.mesh import HostTopology
+
+        topo = HostTopology(2, 2)
+        shards = np.array([2, 3, 0], dtype=np.int64)
+        slots = np.arange(1, 4, dtype=np.int32)
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="exchange.dcn_send", nth=1,
+                      kind="duplicate",
+                      where={"src_host": 0, "dst_host": 1})])
+        with chaos.chaos_active(plan, seed=0) as c:
+            dst, (s_col,), w1, w2 = stage_two_level_exchange(
+                shards, topo, columns=[slots], fills=[0])
+            assert c.faults_injected.get("exchange.dcn_send", 0) == 1
+        # the (0 -> 1) bucket's rows replay at the tail
+        np.testing.assert_array_equal(dst[:5], [2, 3, 0, 2, 3])
+        np.testing.assert_array_equal(s_col[:5], [1, 2, 3, 1, 2])
+
+
 class _IntervalJoinHarnessEngine:
     """Adapts the device interval-join engine to the crash-restore
     harness protocol: each step batch splits by row parity into the

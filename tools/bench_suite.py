@@ -329,6 +329,38 @@ def row7_shard_loss_recovery():
     }
 
 
+def row8_mesh_sessions_2proc():
+    """Pod-scale row: the mesh_sessions shape split across 2 REAL
+    processes (jax.distributed + gloo CPU collectives), each owning
+    half the key-group space with its own metadata plane, spill tier
+    and checkpoint units, exchanging records over the DCN axis of the
+    process-spanning mesh ON DEVICE (tools/multiproc_smoke.py). The
+    row records the aggregate throughput and the scaling factor vs the
+    same-box 1-process run — near-linear on real multi-core/multi-host
+    boxes; a 1-core CI box time-shares the clock and reports the
+    pod-protocol overhead instead (NOTES_r18.md)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.setdefault("MP_SMOKE_RECORDS", str(int(262_144 * SCALE)))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "multiproc_smoke.py")],
+        capture_output=True, text=True, env=env, timeout=3600)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("{")]
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError((proc.stderr or proc.stdout).strip()[-300:])
+    r = json.loads(lines[-1])
+    r["unit"] = "events/s aggregate"
+    r["shape"] += (
+        f"; 1-proc same-box {r['single_proc_events_per_s']:,.0f} ev/s "
+        f"-> scaling {r['scaling_x']}x, "
+        f"{r['cross_host_rows']:,} rows crossed the DCN axis")
+    return r
+
+
 def _join_rows():
     """Both join rows from tools/bench_joins.py in ONE subprocess (the
     mesh needs the virtual-device flag, like row5b; the tool prints one
@@ -371,7 +403,8 @@ ROWS = [("wordcount_socket", row1_wordcount),
         ("queryable_lookups", row6_queryable_lookups),
         ("shard_loss_recovery", row7_shard_loss_recovery),
         ("nexmark_q8_windowed_join", _join_row(0)),
-        ("interval_join_10m_keys", _join_row(1))]
+        ("interval_join_10m_keys", _join_row(1)),
+        ("mesh_sessions_2proc", row8_mesh_sessions_2proc)]
 
 
 def main():
@@ -506,6 +539,25 @@ def main():
         "steady-state compile, p99 over 25 ms, throughput under 3x the "
         "pre-replica row, vacuous cache/publish activity, or a quota "
         "violation (design notes in NOTES_r10.md and NOTES_r17.md).")
+    lines.append("")
+    lines.append(
+        "Pod scale (r18): the mesh_sessions_2proc row is "
+        "`tools/multiproc_smoke.py` at bench scale — 2 REAL processes "
+        "(`jax.distributed.initialize` + gloo CPU collectives), each "
+        "owning half the key-group space (`host_key_group_ranges`) "
+        "with its own session-metadata plane, spill tier and per-range "
+        "checkpoint units; records reach their owner over the DCN axis "
+        "of the process-spanning mesh ON DEVICE "
+        "(`parallel/pod.PodDataPlane`), and each process's fused "
+        "exchange is the intra-host ICI stage. The row reports "
+        "aggregate ev/s and the scaling factor vs the same-box "
+        "1-process run. CAVEAT: on a 1-core CI box both processes "
+        "time-share one clock, so the scaling factor there measures "
+        "pod-protocol overhead (exchange + harvest + re-stage), not "
+        "the near-linear speedup a multi-core/multi-host box shows; "
+        "the smoke's correctness gates (bit-identity, 0 steady-state "
+        "compiles, cross-host traffic, kill-1-of-2 recovery) hold "
+        "regardless (NOTES_r18.md).")
     lines.append("")
     lines.append(
         "Streaming-join rows (r14): `tools/bench_joins.py` drives the "

@@ -235,6 +235,57 @@ def check_device_shuffle_tiers(mesh, budget):
     return ok
 
 
+def check_two_level_exchange_tiers(mesh, budget):
+    """Two-level (pod) exchange phase: a virtual (2, P/2) topology arms
+    parallel/exchange2.py's stage-1/stage-2 program pair. After one
+    warmup engine walks the tier lattice (both size lists), a FRESH
+    engine on SHIFTED sizes must compile NOTHING — the pod programs'
+    shapes are (chunk, W1, W2) tiers, and a leak past any level shows
+    up here as a steady-state compile. Covers fresh-engine rebuilds:
+    the PROGRAM_CACHE family must be hit, not rebuilt."""
+    from flink_tpu.observe import RecompileSentinel
+    from flink_tpu.parallel.mesh import HostTopology
+    from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+    from flink_tpu.windowing.aggregates import SumAggregate
+
+    P = int(mesh.devices.size)
+    if P % 2:
+        print("  two-level tiers: skipped (odd mesh)")
+        return True
+    topo = HostTopology(2, P // 2)
+
+    def make():
+        return MeshSessionEngine(GAP_MS, SumAggregate("v"), mesh,
+                                 capacity_per_shard=budget,
+                                 max_device_slots=budget,
+                                 host_topology=topo)
+
+    warm_eng = make()
+    assert warm_eng._two_level_active()
+    warm_fired = _drive_sized(warm_eng, TIER_WALK_WARM, offset=0)
+    warm_fired += _drive_sized(warm_eng, TIER_WALK_RUN,
+                               offset=1 << 22)
+    ok = True
+    engine = make()
+    with RecompileSentinel(
+            max_compiles=0,
+            max_transfers=max(len(TIER_WALK_RUN) * 8, 64),
+            label="two-level exchange tier walk") as s:
+        fired = _drive_sized(engine, TIER_WALK_RUN, offset=1 << 23)
+    traffic = engine.exchange2_traffic()
+    print(f"  two-level tiers: fired={fired} "
+          f"compiles={s.compiles} transfers={s.transfers} "
+          f"cross_host_rows={traffic['rows_cross_host']}")
+    if fired == 0 or warm_fired == 0:
+        print("FAIL: two-level tiers: zero fires — vacuous run")
+        ok = False
+    if traffic["rows_cross_host"] == 0:
+        print("FAIL: two-level tiers: no cross-host rows — the DCN "
+              "stage never carried anything")
+        ok = False
+    return ok
+
+
 #: join-phase batch-size walks: same tier lattice, shifted lengths —
 #: a probe/ingest/eviction program keyed on anything finer than the
 #: (chunk, probe-bucket, band, mirror) tiers compiles mid-rep here
@@ -392,6 +443,12 @@ def main():
             mesh, budgets["mesh-sessions"]) and ok
     except Exception as e:  # SteadyStateViolation included
         print(f"FAIL: device-shuffle tiers: {e}")
+        ok = False
+    try:
+        ok = check_two_level_exchange_tiers(
+            mesh, budgets["mesh-sessions"]) and ok
+    except Exception as e:  # SteadyStateViolation included
+        print(f"FAIL: two-level tiers: {e}")
         ok = False
     try:
         ok = check_join_phase(mesh, budgets["mesh-sessions"]) and ok

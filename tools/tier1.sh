@@ -131,6 +131,21 @@ if [ "${SKIP_BENCH_SMOKE:-0}" != "1" ]; then
   JAX_PLATFORMS=cpu timeout -k 10 120 \
     python tools/join_smoke.py || exit 1
 
+  # Multi-process smoke: 2 REAL CPU processes (jax.distributed + gloo
+  # collectives), each owning half the key-group space, exchanging
+  # records over the DCN axis of the process-spanning mesh ON DEVICE
+  # (the pod data plane, ROADMAP item 2). FAILS on output divergence
+  # from the 1-process run (bit-identity), on any steady-state compile
+  # in the measured rep, on a vacuous run (0 rows crossed a process
+  # boundary), or on the chaos leg: kill 1 of 2 processes mid-stream —
+  # the survivor must restore ONLY the dead host's key-group ranges
+  # from its checkpoint units, replay within the per-host bound, and
+  # finish bit-identical. Also emits the mesh_sessions_2proc scaling
+  # numbers (gateable via MP_SMOKE_MIN_SCALING on multi-core boxes —
+  # this 1-core box time-shares the clock, NOTES_r18.md). ~2 min.
+  MP_SMOKE_RECORDS=$((1 << 16)) \
+    timeout -k 10 600 python tools/multiproc_smoke.py || exit 1
+
   # Recompile sentinel: after one warmup rep, 2 measured reps on FRESH
   # engines (both mesh engines, spill armed, disarmed chaos) must show
   # ZERO XLA backend compiles and bounded device->host transfers —

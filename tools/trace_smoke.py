@@ -270,6 +270,67 @@ def main() -> int:
         line["error"] = "; ".join(problems)
         print(json.dumps(line))
         return 1
+
+    # --- 5. two-level exchange: stage-1 vs stage-2 attribution -----------
+    # a short pass with the pod (2 x P/2) topology armed must attribute
+    # the ICI route and the DCN hop as DISTINCT span kinds with real
+    # time in each — the pod-scale perf story is only debuggable if
+    # the trace says which level a slow exchange spent its time in
+    rec.clear()
+    import numpy as np
+
+    from flink_tpu.core.records import (
+        KEY_ID_FIELD,
+        TIMESTAMP_FIELD,
+        RecordBatch,
+    )
+    from flink_tpu.parallel.mesh import HostTopology
+    from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+    from flink_tpu.windowing.aggregates import SumAggregate
+
+    P = int(mesh.devices.size)
+    if P < 2 or P % 2:
+        # a 1-device or odd mesh cannot factor into (2, P/2) — the
+        # phase needs the pod topology to exist (recompile_smoke's
+        # two-level phase skips the same way)
+        line["exchange_stage_phase"] = f"skipped ({P} devices)"
+        print(json.dumps(line))
+        return 0
+    eng = MeshSessionEngine(
+        16_000, SumAggregate("v"), mesh,
+        capacity_per_shard=1 << 14,
+        host_topology=HostTopology(2, P // 2))
+    rng = np.random.default_rng(5)
+    t = 0
+    for _ in range(6):
+        n = 4096
+        ks = rng.integers(0, 20_000, n).astype(np.int64)
+        ts = t + np.arange(n, dtype=np.int64) // 4
+        eng.process_batch(RecordBatch({
+            KEY_ID_FIELD: ks, "v": np.ones(n, dtype=np.float32),
+            TIMESTAMP_FIELD: ts}))
+        t = int(ts[-1]) + 1
+        eng.on_watermark(t - 16_000)
+    totals2 = rec.kind_totals()
+    s1 = totals2.get("exchange.stage1", {})
+    s2 = totals2.get("exchange.stage2", {})
+    line["exchange_stage1_spans"] = int(s1.get("count", 0))
+    line["exchange_stage2_spans"] = int(s2.get("count", 0))
+    line["exchange_stage1_ms"] = round(s1.get("total_s", 0.0) * 1e3, 2)
+    line["exchange_stage2_ms"] = round(s2.get("total_s", 0.0) * 1e3, 2)
+    problems = []
+    if not s1.get("count") or not s2.get("count"):
+        problems.append(
+            "two-level exchange stages missing from the capture "
+            f"(stage1={s1.get('count', 0)}, "
+            f"stage2={s2.get('count', 0)} spans) — ICI vs DCN time "
+            "cannot be attributed")
+    elif not (s1.get("total_s", 0) > 0 and s2.get("total_s", 0) > 0):
+        problems.append("two-level exchange stages carry no time")
+    if problems:
+        line["error"] = "; ".join(problems)
+        print(json.dumps(line))
+        return 1
     print(json.dumps(line))
     return 0
 
