@@ -40,6 +40,7 @@ KNOWN_FAULT_POINTS = (
     "join.versioned_lookup",
     "serving.lookup",
     "serving.replica_publish",
+    "serving.cache_probe",
     "harvest.pending_fire",
     "task.batch",
     "task.subtask_batch",

@@ -60,6 +60,15 @@ class QueryableStateClient:
 
     # ------------------------------------------------------------------ API
 
+    def _serving_plane(self):
+        """The cluster's ServingPlane when it exposes one (the tenancy
+        session cluster): lookups then take the native fast path — the
+        whole key batch probes the GIL-free hot-row table in ONE call
+        before any Python-per-key work, and only misses ride the
+        replica worker queues. RPC-gateway clusters return None and
+        keep the control-plane route."""
+        return getattr(self.cluster, "serving", None)
+
     def get_state(self, job_id: str, operator_name: str, key,
                   namespace: Optional[int] = None
                   ) -> Dict[int, Dict[str, Any]]:
@@ -67,20 +76,57 @@ class QueryableStateClient:
         operator; one entry per live namespace (window), or just the one
         requested. Thin wrapper over the batched path: the lookup rides
         whatever device batch concurrent callers are forming."""
+        plane = self._serving_plane()
+        if plane is not None:
+            t0 = time.perf_counter()
+            out = plane.lookup(job_id, operator_name, key, namespace)
+            # keep client-side stats counting point lookups on the
+            # plane route (the legacy coalescer path counted each one)
+            self._coalescer(job_id, operator_name).note_batch(
+                1, (time.perf_counter() - t0) * 1e3)
+            return out
         return self._coalescer(job_id, operator_name).lookup(
             key, namespace)
 
     def get_state_batch(self, job_id: str, operator_name: str, keys,
                         namespace: Optional[int] = None
                         ) -> List[Dict[int, Dict[str, Any]]]:
-        """One result dict per key, request order — a single RPC and a
-        single device batch for the whole list. Recorded against the
-        (job, operator) coalescer's counters (as ServingPlane's
+        """One result dict per key, request order — a single RPC (or
+        one batched serving-plane probe, see :meth:`_serving_plane`)
+        and a single device batch for the whole list. Recorded against
+        the (job, operator) coalescer's counters (as ServingPlane's
         ``lookup_batch``) so :meth:`stats` covers the explicit-batch
         shape too, not just coalesced ``get_state`` traffic."""
         t0 = time.perf_counter()
-        out = self._query_batch_rpc(job_id, operator_name, keys,
-                                    namespace)
+        plane = self._serving_plane()
+        if plane is not None:
+            out = plane.lookup_batch(job_id, operator_name, keys,
+                                     namespace)
+        else:
+            out = self._query_batch_rpc(job_id, operator_name, keys,
+                                        namespace)
+        self._coalescer(job_id, operator_name).note_batch(
+            len(out), (time.perf_counter() - t0) * 1e3)
+        return out
+
+    def get_state_batch_packed(self, job_id: str, operator_name: str,
+                               keys):
+        """The zero-copy batch form: against a serving-plane cluster,
+        hit results stay in the native probe's packed buffers and
+        materialize per key only on read (bit-identical to
+        :meth:`get_state_batch` when consumed). Against an RPC-gateway
+        cluster it wraps the ordinary batch — same read surface either
+        way."""
+        from flink_tpu.tenancy.serving import PackedLookupResult
+
+        t0 = time.perf_counter()
+        plane = self._serving_plane()
+        if plane is not None:
+            out = plane.lookup_batch_packed(job_id, operator_name,
+                                            keys)
+        else:
+            out = PackedLookupResult.from_dicts(self._query_batch_rpc(
+                job_id, operator_name, keys, None))
         self._coalescer(job_id, operator_name).note_batch(
             len(out), (time.perf_counter() - t0) * 1e3)
         return out

@@ -30,7 +30,7 @@ _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 #: these — the registry flint's NAT01 cross-checks ctypes declarations
 #: and call sites against (the stringly-typed-registry discipline of
 #: chaos.KNOWN_FAULT_POINTS, applied to the C ABI)
-NATIVE_SYMBOL_PREFIXES = ("sm_", "sx_", "codec_", "ngen_")
+NATIVE_SYMBOL_PREFIXES = ("sm_", "sx_", "codec_", "ngen_", "hc_")
 
 #: the libraries build_all() compiles (source basename -> .so basename)
 NATIVE_LIBS = {
@@ -38,6 +38,7 @@ NATIVE_LIBS = {
     "sessions": ("sessions.cpp", "_sessions.so"),
     "codec": ("codec.cpp", "_codec.so"),
     "datagen": ("datagen.cpp", "_datagen.so"),
+    "hotcache": ("hotcache.cpp", "_hotcache.so"),
 }
 
 _lock = threading.Lock()
@@ -383,6 +384,84 @@ def load_datagen() -> Optional[ctypes.CDLL]:
                                   P(c.c_int64)]
         _datagen_lib = lib
         return _datagen_lib
+
+
+_hotcache_lib: Optional[ctypes.CDLL] = None
+_hotcache_tried = False
+
+#: hc_stat counter indices (must match the Stat enum in hotcache.cpp)
+HC_STAT_HITS = 0
+HC_STAT_MISSES = 1
+HC_STAT_EVICTIONS = 2
+HC_STAT_PRIMES = 3
+HC_STAT_PUTS = 4
+HC_STAT_TORN_RETRIES = 5
+HC_STAT_TORN_MISSES = 6
+HC_STAT_OVERSIZE_DROPS = 7
+
+
+def load_hotcache() -> Optional[ctypes.CDLL]:
+    """The native hot-row probe table (native/hotcache.cpp), or None.
+
+    One GIL-released C call probes/primes a whole key batch against an
+    open-addressing, seqlock-stamped table of packed composed results —
+    the serving hot loop of flink_tpu/tenancy/hot_cache_native.py
+    (flink_tpu/tenancy/hot_cache.py stays the bit-identical Python
+    fallback).
+    """
+    global _hotcache_lib, _hotcache_tried
+    with _lock:
+        if _hotcache_tried:
+            return _hotcache_lib
+        _hotcache_tried = True
+        lib = load_native("hotcache.cpp", "_hotcache.so")
+        if lib is None:
+            return None
+        c = ctypes
+        i64, i32, u8, u64, vp = (c.c_int64, c.c_int32, c.c_uint8,
+                                 c.c_uint64, c.c_void_p)
+        P = c.POINTER
+        lib.hc_create.restype = vp
+        lib.hc_create.argtypes = [i64, i64, i64]
+        lib.hc_destroy.restype = None
+        lib.hc_destroy.argtypes = [vp]
+        lib.hc_len.restype = i64
+        lib.hc_len.argtypes = [vp]
+        lib.hc_capacity.restype = i64
+        lib.hc_capacity.argtypes = [vp]
+        lib.hc_stat.restype = i64
+        lib.hc_stat.argtypes = [vp, i32]
+        lib.hc_add_stat.restype = None
+        lib.hc_add_stat.argtypes = [vp, i32, i64]
+        lib.hc_clear.restype = None
+        lib.hc_clear.argtypes = [vp]
+        lib.hc_get_batch.restype = i64
+        lib.hc_get_batch.argtypes = [vp, i64, P(i64), i64, P(u8),
+                                     P(i32), P(i64), P(i64), P(i64),
+                                     P(u64)]
+        lib.hc_put_batch.restype = i64
+        lib.hc_put_batch.argtypes = [vp, i64, P(i64), P(i64), P(i64),
+                                     P(i64), P(i64), P(u64)]
+        lib.hc_prime_batch.restype = i64
+        lib.hc_prime_batch.argtypes = [vp, i64, P(i64), i64, P(i64),
+                                       P(i64), P(i64), P(u64), P(i64),
+                                       P(i64), P(u8)]
+        lib.hc_drop.restype = None
+        lib.hc_drop.argtypes = [vp, i64]
+        lib.hc_migrate.restype = i64
+        lib.hc_migrate.argtypes = [vp, vp]
+        # test-only: freeze/unfreeze a slot's seqlock stamp so the
+        # torn-read retry path is deterministically coverable
+        lib.hc_debug_lock_slot.restype = i64
+        lib.hc_debug_lock_slot.argtypes = [vp, i64]
+        lib.hc_debug_unlock_slot.restype = i64
+        lib.hc_debug_unlock_slot.argtypes = [vp, i64]
+        _hotcache_lib = lib
+        return _hotcache_lib
+
+
+def hotcache_available() -> bool:
+    return load_hotcache() is not None
 
 
 def build_all() -> Dict[str, bool]:

@@ -26,7 +26,14 @@ hardware. Four pillars:
   ``jax.device_get`` per miss batch (the flint TRC01 discipline),
   measured as the ``queryable_lookups_per_s`` bench row. The legacy
   control-queue coalescers remain for single-device engines and the
-  cold-row (page tier) detour.
+  cold-row (page tier) detour. Since r19 the hit path is NATIVE
+  (:mod:`hot_cache_native` over ``native/hotcache.cpp``): a whole key
+  batch probes a GIL-free seqlock-stamped table of packed composed
+  results in ONE C call, results stay packed until a consumer reads
+  them (``lookup_batch_packed``), publishes prime via one packed
+  buffer, and sessions PRIME under their moving end instead of
+  invalidating — measured 1.14M lookups/s vs the 477k same-box dict
+  control.
 
 The autoscaler composes one level up (:mod:`arbiter`): shard budgets
 are arbitrated BETWEEN jobs (weighted by backlog + quota pressure),
@@ -52,6 +59,10 @@ _LAZY = {
     "WindowReplicaAdapter": "flink_tpu.tenancy.replica",
     "JoinSideReplicaAdapter": "flink_tpu.tenancy.replica",
     "HotRowCache": "flink_tpu.tenancy.hot_cache",
+    "PrimeDelta": "flink_tpu.tenancy.hot_cache",
+    "make_hot_row_cache": "flink_tpu.tenancy.hot_cache",
+    "NativeHotRowCache": "flink_tpu.tenancy.hot_cache_native",
+    "PackedLookupResult": "flink_tpu.tenancy.serving",
     "ShardArbiter": "flink_tpu.tenancy.arbiter",
     "JobDemand": "flink_tpu.tenancy.arbiter",
     "SessionCluster": "flink_tpu.tenancy.session_cluster",

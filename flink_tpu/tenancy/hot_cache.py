@@ -20,7 +20,77 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class PrimeDelta:
+    """One publish boundary's cache delta, FLAT: the shape both cache
+    planes consume (``prime_batch``), built once by the replica adapter
+    from the publish harvest — the native plane packs it into a single
+    GIL-released C call, the Python plane folds it under one lock.
+
+    ``keys[i]``'s updates are rows ``uoff[i]:uoff[i+1]`` of ``u_ns`` /
+    the ``u_cols`` value columns; its removals are ``roff[i]:roff[i+1]``
+    of ``r_ns``. ``flags`` bit0 = insert_ok (the updates are the key's
+    COMPLETE composed state — an absent entry may be created), bit1 =
+    drop (the key's entry is removed outright — the invalidate-on-change
+    path for compositions that cannot update incrementally)."""
+
+    __slots__ = ("keys", "uoff", "u_ns", "u_cols", "roff", "r_ns",
+                 "flags")
+
+    def __init__(self, keys, uoff, u_ns, u_cols, roff, r_ns, flags):
+        self.keys = keys
+        self.uoff = uoff
+        self.u_ns = u_ns
+        #: [(column name, value array aligned with u_ns)]
+        self.u_cols = u_cols
+        self.roff = roff
+        self.r_ns = r_ns
+        self.flags = flags
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+
+def make_hot_row_cache(max_entries: int = 1 << 18):
+    """The native (C++) hot-row probe table when available, else this
+    module's :class:`HotRowCache` — selected exactly the way
+    ``make_session_meta`` picks the session-metadata plane. Lookup
+    results are bit-identical across planes (test-pinned); the native
+    plane probes/primes a whole key batch in ONE GIL-released C call.
+
+    ``FLINK_TPU_NATIVE_HOTCACHE=0`` forces the Python plane while other
+    native components stay on — the A/B knob the serving bench and the
+    NOTES_r19 walk use (the blanket ``FLINK_TPU_NO_NATIVE=1`` disables
+    everything native). Unavailability (no toolchain, build failure)
+    degrades LOUDLY via ``flink_tpu.native.note_fallback``."""
+    import os
+
+    from flink_tpu.native import (
+        hotcache_available,
+        native_disabled,
+        note_fallback,
+    )
+
+    if (os.environ.get("FLINK_TPU_NATIVE_HOTCACHE") != "0"
+            and not native_disabled()):
+        if hotcache_available():
+            try:
+                from flink_tpu.tenancy.hot_cache_native import (
+                    NativeHotRowCache,
+                )
+
+                return NativeHotRowCache(max_entries=max_entries)
+            except Exception as e:  # noqa: BLE001 — degrade, loudly
+                note_fallback(
+                    "native hot-row cache failed to initialize: "
+                    f"{type(e).__name__}: {e}")
+        else:
+            note_fallback(
+                "native hotcache library unavailable (build failed or "
+                "no toolchain) — using the bit-identical Python cache")
+    return HotRowCache(max_entries=max_entries)
 
 
 class HotRowCache:
@@ -67,6 +137,8 @@ class HotRowCache:
         returns the hit count. The per-key locked ``get`` would spend
         more time on lock traffic than on the probes at cache-hit QPS
         (the serving hot loop). ``exact`` as in :meth:`get`."""
+        if hasattr(key_ids, "tolist"):  # ndarray: bulk-convert once
+            key_ids = key_ids.tolist()
         hits = 0
         entries = self._entries
         with self._lock:
@@ -131,6 +203,44 @@ class HotRowCache:
             while len(self._entries) > self.max_entries:
                 self._entries.popitem(last=False)
                 self.evictions += 1
+
+    def put_many(self, job: str, operator: str, key_ids, gen: int,
+                 values) -> None:
+        """Worker miss-resolution feed: one :meth:`put` per key (the
+        native plane replaces this with one packed C call; here the
+        loop is the bit-identical reference)."""
+        for kid, val in zip(key_ids, values):
+            self.put(job, operator, int(kid), gen, val)
+
+    def prime_batch(self, job: str, operator: str, gen: int,
+                    delta: "PrimeDelta") -> None:
+        """Fold one publish boundary's flat delta (:class:`PrimeDelta`)
+        into the cache: per key, drops apply first, then updates/
+        removals through :meth:`prime` — semantically the per-key feed
+        the adapters used to drive, now built once as arrays so the
+        native plane can consume the SAME delta in one C call."""
+        uoff = delta.uoff
+        roff = delta.roff
+        u_ns = delta.u_ns
+        r_ns = delta.r_ns
+        cols = delta.u_cols or []
+        for i, kid in enumerate(delta.keys):
+            kid = int(kid)
+            fl = int(delta.flags[i])
+            if fl & 2:
+                self.drop(job, operator, kid)
+                continue
+            ups: Optional[Dict[int, dict]] = None
+            lo, hi = int(uoff[i]), int(uoff[i + 1])
+            if hi > lo:
+                ups = {int(u_ns[j]): {name: col[j].item()
+                                      for name, col in cols}
+                       for j in range(lo, hi)}
+            rem: List[int] = [int(r_ns[j])
+                              for j in range(int(roff[i]),
+                                             int(roff[i + 1]))]
+            self.prime(job, operator, kid, gen, ups, rem,
+                       insert_ok=bool(fl & 1))
 
     def drop(self, job: str, operator: str, key_id: int) -> None:
         with self._lock:

@@ -408,6 +408,11 @@ class ReplicaPlane:
         self._gen += 1
         self.rows_published += rows
         self.publishes += 1
+        # the pre-publish index: the adapters' prime needs the OLD
+        # result addressing (a session's end MOVES as it absorbs — the
+        # stale entry is found here). A rebuild publish starts from
+        # nothing: its caches were invalidated, nothing maps back.
+        prev_index = {} if self._index_reset else index
         self._index_reset = False
         self.sealed = ReplicaGeneration(
             self._gen, boundary_wm, time.monotonic(), self._accs,
@@ -417,7 +422,7 @@ class ReplicaPlane:
             # generation, so it must not run while probes still
             # resolve the old one (they would read fresh tags as
             # future and miss)
-            self.on_publish(self._gen, per_shard, harvest)
+            self.on_publish(self._gen, per_shard, harvest, prev_index)
         return True
 
     # -------------------------------------------------------------- reading
@@ -516,15 +521,47 @@ class ReplicaAdapter:
         return None
 
     def prime_free_ns(self, ns: int, extra):
-        """Result-dict key removed by a freed row, or None to drop the
-        key's entry instead."""
+        """Result-dict key removed by a freed row (``extra`` is the
+        row's payload in the PRE-publish index, when it had one), or
+        None to drop the key's entry instead."""
         return None
 
+    def _prime_rows(self, keys_f: np.ndarray, ns_f: np.ndarray,
+                    extra_f: np.ndarray, prev_index):
+        """Map the delta rows to cache updates: ``(rns, valid,
+        removals, kill)`` where ``rns[j]`` is row j's RESULT namespace
+        (valid[j] False = no incremental update), ``removals`` is a
+        list of ``(kid, result_ns)`` stale entries to delete in the
+        SAME batched prime, and ``kill`` is kids whose cached entry
+        drops outright. Default: per-row :meth:`prime_value_ns`."""
+        n = len(keys_f)
+        rns = np.zeros(n, dtype=np.int64)
+        valid = np.zeros(n, dtype=bool)
+        kill: set = set()
+        for j in range(n):
+            r = self.prime_value_ns(int(ns_f[j]), extra_f[j])
+            if r is None:
+                kill.add(int(keys_f[j]))
+            else:
+                rns[j] = int(r)
+                valid[j] = True
+        return rns, valid, [], kill
+
     def _on_publish(self, gen: int, per_shard: Dict[int, dict],
-                    harvest) -> None:
+                    harvest, prev_index) -> None:
+        """The publish-harvest cache feed, batch-first: flatten the
+        delta rows once, finish the value columns ONCE, map rows to
+        result namespaces, and fold the whole boundary into the cache
+        as ONE :class:`~flink_tpu.tenancy.hot_cache.PrimeDelta` (one
+        GIL-released C call on the native plane; one locked pass on
+        the Python fallback) — the publish used to pay one ``put()``
+        per touched key on the task thread, inside the fire-deadline
+        budget."""
         cache = self._cache
         if cache is None:
             return
+        from flink_tpu.tenancy.hot_cache import PrimeDelta
+
         job, op = self._cache_job, self._cache_op
         leaves = self.agg.leaves
         # flatten the delta rows across shards, finish ONCE
@@ -549,42 +586,90 @@ class ReplicaAdapter:
                     for i, l in enumerate(leaves)))
                 val_cols = [(name, np.asarray(col))
                             for name, col in finished.items()]
-        updates: Dict[int, Dict[int, dict]] = {}
-        kill: set = set()
-        if val_cols is not None:
+        if keys_l:
             keys_f = np.concatenate(keys_l)
             ns_f = np.concatenate(ns_l)
             extra_f = np.concatenate(extra_l)
-            for j in range(len(keys_f)):
-                rns = self.prime_value_ns(int(ns_f[j]), extra_f[j])
-                kid = int(keys_f[j])
-                if rns is None:
-                    kill.add(kid)
-                else:
-                    updates.setdefault(kid, {})[int(rns)] = {
-                        name: col[j].item() for name, col in val_cols}
-        removals: Dict[int, List[int]] = {}
+        else:
+            keys_f = ns_f = extra_f = np.zeros(0, dtype=np.int64)
+        removals: List[Tuple[int, int]] = []
+        kill: set = set()
+        if val_cols is not None:
+            rns, valid, removals, kill = self._prime_rows(
+                keys_f, ns_f, extra_f, prev_index)
+        else:
+            rns = np.zeros(0, dtype=np.int64)
+            valid = np.zeros(len(keys_f), dtype=bool)
         for d in per_shard.values():
             for key, ns in d["freed"]:
-                rns = self.prime_free_ns(int(ns), None)
-                if rns is None:
+                prev = prev_index.get(int(key), {}).get(int(ns))
+                r = self.prime_free_ns(
+                    int(ns), prev[2] if prev is not None else None)
+                if r is None:
                     kill.add(int(key))
                 else:
-                    removals.setdefault(int(key), []).append(int(rns))
-        for kid in kill:
-            cache.drop(job, op, kid)
-            updates.pop(kid, None)
-            removals.pop(kid, None)
+                    removals.append((int(key), int(r)))
+        # ---- group per kid into the flat delta
+        if valid.any():
+            u_kids = keys_f[valid]
+            u_rns = rns[valid] if len(rns) == len(keys_f) else rns
+            order = np.argsort(u_kids, kind="stable")
+            u_kids = u_kids[order]
+            u_rns = u_rns[order]
+            u_cols = [(name, col[valid][order])
+                      for name, col in val_cols]
+            uniq, starts = np.unique(u_kids, return_index=True)
+            ends = np.append(starts[1:], len(u_kids))
+        else:
+            u_rns = np.zeros(0, dtype=np.int64)
+            u_cols = [(name, col[:0]) for name, col in (val_cols or [])]
+            uniq = np.zeros(0, dtype=np.int64)
+            starts = ends = np.zeros(0, dtype=np.int64)
+        upd_of = {int(uniq[i]): (int(starts[i]), int(ends[i]))
+                  for i in range(len(uniq))}
+        rem_of: Dict[int, List[int]] = {}
+        for kid, r in removals:
+            if kid not in kill:
+                rem_of.setdefault(kid, []).append(r)
+        all_kids = sorted(set(upd_of) - kill | set(rem_of) | kill)
+        if not all_kids:
+            return
         index = self.plane.sealed.index if self.plane.sealed else {}
-        for kid in set(updates) | set(removals):
-            ups = updates.get(kid)
-            # the delta covered EVERY published row of the key -> the
-            # update IS its complete composed state, safe to INSERT:
-            # first-touch lookups of hot keys never touch the device
-            complete = (ups is not None
-                        and len(ups) == len(index.get(kid, ())))
-            cache.prime(job, op, kid, gen, ups,
-                        removals.get(kid, ()), insert_ok=complete)
+        keys_a = np.asarray(all_kids, dtype=np.int64)
+        uoff = np.zeros(len(all_kids) + 1, dtype=np.int64)
+        u_take: List[int] = []
+        roff = np.zeros(len(all_kids) + 1, dtype=np.int64)
+        r_ns: List[int] = []
+        flags = np.zeros(len(all_kids), dtype=np.uint8)
+        for i, kid in enumerate(all_kids):
+            if kid in kill:
+                flags[i] = 2
+                uoff[i + 1] = uoff[i]
+                roff[i + 1] = roff[i]
+                continue
+            lo_hi = upd_of.get(kid)
+            if lo_hi is not None:
+                u_take.extend(range(lo_hi[0], lo_hi[1]))
+                # the delta covered EVERY published row of the key ->
+                # the update IS its complete composed state, safe to
+                # INSERT: first-touch lookups of hot keys never touch
+                # the device
+                if lo_hi[1] - lo_hi[0] == len(index.get(kid, ())):
+                    flags[i] |= 1
+            uoff[i + 1] = uoff[i] + (
+                lo_hi[1] - lo_hi[0] if lo_hi is not None else 0)
+            rem = rem_of.get(kid, ())
+            r_ns.extend(rem)
+            roff[i + 1] = roff[i] + len(rem)
+        take = np.asarray(u_take, dtype=np.int64)
+        cache.prime_batch(job, op, gen, PrimeDelta(
+            keys=keys_a, uoff=uoff,
+            u_ns=u_rns[take] if len(take) else u_rns[:0],
+            u_cols=[(name, col[take] if len(take) else col[:0])
+                    for name, col in u_cols],
+            roff=roff,
+            r_ns=np.asarray(r_ns, dtype=np.int64),
+            flags=flags))
 
     # -- key plumbing (worker threads)
 
@@ -662,7 +747,44 @@ class ReplicaAdapter:
 
 class SessionReplicaAdapter(ReplicaAdapter):
     """Session engine: an index entry's ``extra`` is the session END;
-    a key's result is ``{session_end -> finished columns}``."""
+    a key's result is ``{session_end -> finished columns}``.
+
+    Sessions PRIME instead of invalidating: a session's result key —
+    its END — moves as the session absorbs, so each publish upserts
+    the row under the NEW end and deletes the stale-end entry in the
+    SAME batched prime (the old end read from the PRE-publish index,
+    where the (key, sid) row still carries it). The hottest workload
+    class — a session absorbing across many boundaries — stays on the
+    hit path instead of structurally missing at every boundary."""
+
+    def _prime_rows(self, keys_f, ns_f, extra_f, prev_index):
+        n = len(keys_f)
+        rns = np.asarray(extra_f, dtype=np.int64)  # the NEW ends
+        valid = np.ones(n, dtype=bool)
+        removals: List[Tuple[int, int]] = []
+        # one prev-index probe per KEY (rows grouped), not per row
+        by_key: Dict[int, List[int]] = {}
+        for j in range(n):
+            by_key.setdefault(int(keys_f[j]), []).append(j)
+        for kid, idxs in by_key.items():
+            prev = prev_index.get(kid)
+            if not prev:
+                continue
+            for j in idxs:
+                ent = prev.get(int(ns_f[j]))
+                if ent is not None and ent[2] is not None \
+                        and int(ent[2]) != int(rns[j]):
+                    # the session's end MOVED: the entry cached under
+                    # the old end is stale — delete it in this prime
+                    removals.append((kid, int(ent[2])))
+        return rns, valid, removals, set()
+
+    def prime_free_ns(self, ns: int, extra):
+        # a freed (fired/merged-away) session removes its END entry;
+        # ``extra`` is the pre-publish index payload = the old end.
+        # A freed row with no recorded end cannot be mapped — drop the
+        # key's entry (the safe fallback the old invalidate path took).
+        return int(extra) if extra is not None else None
 
     def compose(self, entries, vals, cold_entries, cold_result) -> dict:
         out: Dict[int, Dict[str, float]] = {}
@@ -763,6 +885,18 @@ class WindowReplicaAdapter(ReplicaAdapter):
 
     def prime_free_ns(self, ns: int, extra):
         return ns if self._probe_one_to_one(ns) else None
+
+    def _prime_rows(self, keys_f, ns_f, extra_f, prev_index):
+        # vectorized: ONE assigner probe decides the whole batch —
+        # tumbling-style rows prime under their own namespace, other
+        # shapes drop every touched key (the base class would have
+        # made the same per-row decision n times)
+        n = len(keys_f)
+        if n and self._probe_one_to_one(int(ns_f[0])):
+            return (np.asarray(ns_f, dtype=np.int64),
+                    np.ones(n, dtype=bool), [], set())
+        return (np.zeros(n, dtype=np.int64), np.zeros(n, dtype=bool),
+                [], {int(k) for k in keys_f})
 
     def compose_all(self, row_of, vals, cold_of, cold_vals):
         # vectorized fast path (the serving hot loop): tumbling-style

@@ -335,6 +335,65 @@ class CoalescerPool:
         return lookup_stats_dict(lookups, batches, lat)
 
 
+class PackedLookupResult:
+    """A batch lookup's results, materialized LAZILY: hit keys live in
+    the native probe's packed buffers (:class:`PackedProbe` — raw
+    int64/float64 bit patterns, zero copies, zero dicts built); only a
+    key somebody actually reads pays dict construction, and it is
+    cached per index. Misses (and Python-plane fallbacks) are
+    pre-materialized ``overrides``. Sequence-compatible: ``len``,
+    indexing, iteration, ``==`` against a plain list — and
+    :meth:`to_dicts` for the full eager form (bit-identical to
+    ``lookup_batch``, test-pinned)."""
+
+    __slots__ = ("_n", "_probe", "_overrides", "_cache")
+
+    def __init__(self, n: int, probe, overrides: Dict[int, Any]) -> None:
+        self._n = int(n)
+        self._probe = probe
+        self._overrides = overrides
+        self._cache: Dict[int, Any] = {}
+
+    @classmethod
+    def from_dicts(cls, results) -> "PackedLookupResult":
+        return cls(len(results), None, dict(enumerate(results)))
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(self._n))]
+        if i < 0:
+            i += self._n
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        if i in self._overrides:
+            return self._overrides[i]
+        v = self._cache.get(i)
+        if v is None:
+            v = self._probe.materialize(i)
+            self._cache[i] = v
+        return v
+
+    def __iter__(self):
+        for i in range(self._n):
+            yield self[i]
+
+    def to_dicts(self) -> List[Any]:
+        return [self[i] for i in range(self._n)]
+
+    def __eq__(self, other):
+        if isinstance(other, PackedLookupResult):
+            return self.to_dicts() == other.to_dicts()
+        if isinstance(other, list):
+            return self.to_dicts() == other
+        return NotImplemented
+
+    def __repr__(self) -> str:
+        return f"PackedLookupResult(n={self._n})"
+
+
 class _RepPending:
     """One rider of the replica serving path (shard-queue entry)."""
 
@@ -444,9 +503,11 @@ class ServingPlane:
         self._queues: Dict[str, Any] = {}
         #: (job, operator) -> ReplicaAdapter (bound by the cluster)
         self._replicas: Dict[tuple, Any] = {}
-        from flink_tpu.tenancy.hot_cache import HotRowCache
+        from flink_tpu.tenancy.hot_cache import make_hot_row_cache
 
-        self.hot_cache = HotRowCache(max_entries=cache_entries)
+        #: the native GIL-free probe table when available, else the
+        #: bit-identical Python LRU (FLINK_TPU_NATIVE_HOTCACHE=0 A/B)
+        self.hot_cache = make_hot_row_cache(cache_entries)
         self._workers: List[_ReplicaWorker] = []
         self._workers_lock = threading.Lock()
         #: sampled serving.cache_hit instants (1-in-N — a per-hit ring
@@ -574,15 +635,32 @@ class ServingPlane:
         ns = int(namespace)
         return {ns: result[ns]} if ns in result else {}
 
+    @staticmethod
+    def _probe_faulted(job_name: str, operator: str) -> bool:
+        """The ``serving.cache_probe`` chaos point: raise/delay kinds
+        apply in place; a ``drop`` kind makes the probe fall to the
+        MISS path for this request (the system-level shape of a torn
+        native read — the entry is skipped, never served mixed).
+        One module-global None check while disarmed."""
+        from flink_tpu.chaos import injection as chaos
+
+        rule = chaos.payload_action(
+            "serving.cache_probe", kinds=("raise", "delay", "drop"),
+            job=job_name, operator=operator)
+        return rule is not None and rule.kind == "drop"
+
     def _cache_probe(self, job_name: str, operator: str, ad, key,
                      co) -> Tuple[bool, int, int, Any]:
-        """(hit, key_id, generation, value) — one locked dict access;
-        a hit records its (sub-ms) latency against the coalescer's
-        reservoir and a SAMPLED serving.cache_hit instant."""
+        """(hit, key_id, generation, value) — one batched native probe
+        (or one locked dict access on the Python fallback); a hit
+        records its (sub-ms) latency against the coalescer's reservoir
+        and a SAMPLED serving.cache_hit instant."""
         from flink_tpu.observe import flight_recorder as flight
 
         kid = ad.key_id(key)
         gen = ad.generation()
+        if self._probe_faulted(job_name, operator):
+            return False, kid, gen, None
         # exact=False: bound adapters re-prime/drop every entry a
         # publish changes, so presence implies validity (see HotRowCache)
         hit, val = self.hot_cache.get(job_name, operator, kid, gen,
@@ -661,12 +739,14 @@ class ServingPlane:
             # insert the stale value — and with presence-implies-
             # validity probes, a key that then stops changing (so no
             # future prime touches it) would serve it forever
-            fill = ad.generation() == gen
+            if ad.generation() == gen:
+                # ONE batched fill (a single GIL-released C call on the
+                # native plane) instead of a locked put per key
+                self.hot_cache.put_many(
+                    job_name, operator, [e.key_id for e in chunk],
+                    gen, results)
             for e, r in zip(chunk, results):
                 e.result = r
-                if fill:
-                    self.hot_cache.put(job_name, operator, e.key_id,
-                                       gen, r)
                 e.done.set()
             co._record(n_lookups=len(chunk), batches=1,
                        lat=((time.perf_counter() - t0) * 1e3,))
@@ -716,14 +796,21 @@ class ServingPlane:
         t0 = time.perf_counter()
         co = self._coalescer(job_name, operator)
         keys = list(keys)
-        # one vectorized hash + ONE locked cache pass for the whole
-        # batch — the per-key dance would be lock traffic, not probes
-        kids = hash_keys_to_i64(np.asarray(keys)).tolist()
+        # BATCH-FIRST: one vectorized hash, then ONE probe call for
+        # the whole key batch — a single GIL-released C call on the
+        # native plane (one locked pass on the Python fallback) —
+        # before ANY per-key Python work; only misses compose below
+        kids = hash_keys_to_i64(np.asarray(keys))
         out: List[Any] = [None] * len(keys)
         miss_idx: List[Tuple[int, int]] = []
         gen = ad.generation()
-        hits = self.hot_cache.get_many(job_name, operator, kids, gen,
-                                       out, miss_idx, exact=False)
+        if self._probe_faulted(job_name, operator):
+            miss_idx = [(i, int(k)) for i, k in enumerate(kids)]
+            hits = 0
+        else:
+            hits = self.hot_cache.get_many(job_name, operator, kids,
+                                           gen, out, miss_idx,
+                                           exact=False)
         if namespace is not None:
             for i in range(len(out)):
                 if out[i] is not None:
@@ -757,6 +844,74 @@ class ServingPlane:
         if err is not None:
             raise err
         return out
+
+    def lookup_batch_packed(self, job_name: str, operator: str,
+                            keys) -> PackedLookupResult:
+        """The NATIVE SERVING FAST PATH: one vectorized key hash, ONE
+        GIL-released C probe for the whole batch, and the hits never
+        leave the packed buffers — :class:`PackedLookupResult`
+        materializes a dict only for keys the caller actually reads
+        (a frontend serializing from the packed form pays the
+        interpreter nothing per hit). Misses coalesce onto the shard
+        worker queues exactly like :meth:`lookup_batch`. Falls back to
+        the (bit-identical) dict path when the operator has no replica
+        adapter or no native table yet."""
+        ad = self._adapter(job_name, operator)
+        get_packed = getattr(self.hot_cache, "get_many_packed", None)
+        if ad is None or get_packed is None:
+            return PackedLookupResult.from_dicts(
+                self.lookup_batch(job_name, operator, keys))
+        from flink_tpu.observe import flight_recorder as flight
+        from flink_tpu.state.keygroups import hash_keys_to_i64
+
+        t0 = time.perf_counter()
+        co = self._coalescer(job_name, operator)
+        keys = list(keys)
+        n = len(keys)
+        kids = hash_keys_to_i64(np.asarray(keys))
+        out: List[Any] = [None] * n
+        miss_idx: List[Tuple[int, int]] = []
+        gen = ad.generation()
+        if self._probe_faulted(job_name, operator):
+            probe = None
+            hits = 0
+            miss_idx = [(i, int(k)) for i, k in enumerate(kids)]
+        else:
+            hits, probe = get_packed(job_name, operator, kids, gen,
+                                     out, miss_idx, exact=False)
+            if probe is None and not miss_idx:
+                # no native table for the op yet (first touches, or a
+                # non-packable shape): the dict path IS the fast path
+                return PackedLookupResult.from_dicts(
+                    self.lookup_batch(job_name, operator, keys))
+        # overflow-store hits (rare: non-packable ops) were
+        # materialized into `out` by the probe — carry them as
+        # overrides (their packed hit flag is 0)
+        overrides: Dict[int, Any] = {
+            i: v for i, v in enumerate(out) if v is not None}
+        pending = [(i, self._enqueue_miss(job_name, operator, ad,
+                                          keys[i], kid, None))
+                   for i, kid in miss_idx]
+        if hits:
+            co._record(n_lookups=hits)
+            self._hit_sample += hits
+            if self._hit_sample % 256 < hits:
+                flight.instant("serving.cache_hit", job=job_name,
+                               batch=gen)
+        err: Optional[BaseException] = None
+        deadline = t0 + self.timeout_s
+        for i, entry in pending:
+            if not entry.done.wait(
+                    max(deadline - time.perf_counter(), 0.0)):
+                raise TimeoutError("queryable-state lookup not served")
+            if entry.error is not None:
+                err = entry.error
+            else:
+                overrides[i] = entry.result
+        co._record(lat=((time.perf_counter() - t0) * 1e3,))
+        if err is not None:
+            raise err
+        return PackedLookupResult(n, probe, overrides)
 
     # ---------------------------------------------------------------- metrics
 

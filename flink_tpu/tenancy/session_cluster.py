@@ -251,6 +251,15 @@ class SessionCluster:
         return self.serving.lookup_batch(job_name, operator, keys,
                                          namespace)
 
+    def lookup_batch_packed(self, job_name: str, operator: str, keys):
+        """The native serving fast path: the whole key batch probes the
+        GIL-free hot-row table in ONE call and hit results stay packed
+        until (unless) the caller reads them — see
+        :meth:`ServingPlane.lookup_batch_packed`. Bit-identical to
+        :meth:`lookup_batch` when materialized."""
+        return self.serving.lookup_batch_packed(job_name, operator,
+                                                keys)
+
     # ------------------------------------------------------------ scheduling
 
     def step_round(self) -> bool:

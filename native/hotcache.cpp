@@ -1,0 +1,600 @@
+// Native hot-row probe table: the GIL-free serving cache.
+//
+// This is the serving sibling of native/sessions.cpp: where the session
+// plane owns merge metadata, this table owns the HOT-ROW CACHE of the
+// read-replica serving plane (flink_tpu/tenancy/hot_cache.py is the
+// bit-identical Python fallback; flink_tpu/tenancy/hot_cache_native.py
+// is the ctypes wrapper). The cost model it exists for: at cache-hit
+// QPS the old path spent more time on the interpreter lock (one Python
+// dict probe + per-key bookkeeping per hit, all serialized on the GIL)
+// than on the probes themselves. Here a whole key batch probes in ONE
+// C call — ctypes releases the GIL for the call, so concurrent serving
+// clients probe in parallel with each other AND with the ingesting
+// task thread.
+//
+// Layout (struct-of-arrays, one table per (job, operator)):
+//   - open addressing over pow2 slots, linear probing, bounded window
+//     (load factor <= 0.5 by construction; deletions leave tombstones
+//     the probe walks past and inserts reuse);
+//   - each slot holds a PACKED COMPOSED RESULT: a fixed header (key,
+//     generation, entry count) plus up to ``entry_cap`` entries of
+//     (namespace i64, per-column value words, a per-entry dtype tag
+//     bitmask). Values are raw int64 bit patterns — float64 and int64
+//     round-trip EXACTLY (the tag says which each column is);
+//   - a seqlock-style even/odd STAMP per slot: writers (the publish
+//     prime on the task thread, worker puts) flip the stamp odd, write,
+//     flip it even; readers never take a lock — they re-check the stamp
+//     around the copy and a torn read RETRIES, then falls to the miss
+//     path. A reader can never observe a mixed-generation row.
+//
+// Writers serialize on one per-table mutex (primes and puts are rare
+// next to probes; the mutex is held only inside the GIL-released call),
+// readers never touch it. Capacity pressure evicts the oldest
+// generation in the probe window — approximate LRU by publish age,
+// which is the invalidation clock anyway.
+//
+// Exposed as a plain C ABI for ctypes; batch arguments are raw pointers
+// into NumPy buffers. All exported symbols are prefixed ``hc_`` (the
+// NATIVE_SYMBOL_PREFIXES registry; flint NAT01 polices the ctypes
+// declarations).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+
+namespace {
+
+inline uint64_t mix_hash(uint64_t k) {
+  uint64_t x = k ^ 0x9E3779B97F4A7C15ull;
+  x ^= x >> 30;
+  x *= 0xBF58476D1CE4E5B9ull;
+  x ^= x >> 27;
+  x *= 0x94D049BB133111EBull;
+  x ^= x >> 31;
+  return x;
+}
+
+// slot states
+constexpr uint8_t kEmpty = 0;
+constexpr uint8_t kLive = 1;
+constexpr uint8_t kTomb = 2;
+
+// counter indices (hc_stat)
+enum Stat {
+  kHits = 0,
+  kMisses = 1,
+  kEvictions = 2,
+  kPrimes = 3,
+  kPuts = 4,
+  kTornRetries = 5,
+  kTornMisses = 6,
+  kOversizeDrops = 7,
+  kStatCount = 8,
+};
+
+constexpr int kReadRetries = 4;
+
+struct HotTable {
+  int64_t n_slots = 0;     // pow2
+  int64_t mask = 0;
+  int64_t max_probe = 0;
+  int64_t n_cols = 0;
+  int64_t entry_cap = 0;
+  std::atomic<int64_t> live{0};
+  std::atomic<int64_t> stats[kStatCount];
+  std::mutex write_mu;
+
+  std::atomic<uint64_t>* stamp = nullptr;
+  std::atomic<uint8_t>* state = nullptr;
+  std::atomic<int64_t>* key = nullptr;
+  int64_t* gen = nullptr;
+  int32_t* n = nullptr;         // entries used in the slot
+  int64_t* ns = nullptr;        // [n_slots * entry_cap]
+  int64_t* vals = nullptr;      // [n_slots * entry_cap * n_cols]
+  uint64_t* tags = nullptr;     // [n_slots * entry_cap] dtype bitmasks
+
+  ~HotTable() {
+    delete[] stamp;
+    delete[] state;
+    delete[] key;
+    std::free(gen);
+    std::free(n);
+    std::free(ns);
+    std::free(vals);
+    std::free(tags);
+  }
+};
+
+inline int64_t pow2_at_least(int64_t v) {
+  int64_t p = 64;
+  while (p < v) p <<= 1;
+  return p;
+}
+
+// ---- writer-side slot lock (the seqlock write half). Callers hold
+// write_mu, so the CAS never actually contends with another writer —
+// the odd stamp exists for READERS to detect the in-progress write.
+inline uint64_t lock_slot(HotTable* t, int64_t j) {
+  uint64_t s = t->stamp[j].load(std::memory_order_relaxed) & ~1ull;
+  t->stamp[j].store(s + 1, std::memory_order_release);
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  return s;
+}
+
+inline void unlock_slot(HotTable* t, int64_t j, uint64_t s) {
+  t->stamp[j].store(s + 2, std::memory_order_release);
+}
+
+// Find the slot for `k` under write_mu: (found_slot, insert_slot).
+// found >= 0 when the key is live in the window; insert is the first
+// reusable slot (empty/tombstone), or — window full of other live
+// keys — the live slot with the OLDEST generation (the eviction
+// victim), flagged via *evict.
+inline void locate_for_write(HotTable* t, int64_t k, int64_t* found,
+                             int64_t* insert, bool* evict) {
+  *found = -1;
+  *insert = -1;
+  *evict = false;
+  int64_t j = (int64_t)(mix_hash((uint64_t)k)) & t->mask;
+  int64_t victim = -1;
+  int64_t victim_gen = INT64_MAX;
+  for (int64_t step = 0; step < t->max_probe; ++step) {
+    uint8_t st = t->state[j].load(std::memory_order_relaxed);
+    if (st == kEmpty) {
+      if (*insert < 0) *insert = j;
+      return;  // key cannot be past the first empty
+    }
+    if (st == kTomb) {
+      if (*insert < 0) *insert = j;
+    } else {  // live
+      if (t->key[j].load(std::memory_order_relaxed) == k) {
+        *found = j;
+        return;
+      }
+      if (t->gen[j] < victim_gen) {
+        victim_gen = t->gen[j];
+        victim = j;
+      }
+    }
+    j = (j + 1) & t->mask;
+  }
+  if (*insert < 0) {
+    *insert = victim;
+    *evict = true;
+  }
+}
+
+inline void write_payload(HotTable* t, int64_t j, int64_t k, int64_t g,
+                          int64_t cnt, const int64_t* src_ns,
+                          const int64_t* src_vals,
+                          const uint64_t* src_tags) {
+  t->key[j].store(k, std::memory_order_relaxed);
+  t->gen[j] = g;
+  t->n[j] = (int32_t)cnt;
+  std::memcpy(t->ns + j * t->entry_cap, src_ns, cnt * sizeof(int64_t));
+  std::memcpy(t->vals + j * t->entry_cap * t->n_cols, src_vals,
+              cnt * t->n_cols * sizeof(int64_t));
+  std::memcpy(t->tags + j * t->entry_cap, src_tags,
+              cnt * sizeof(uint64_t));
+}
+
+// erase under the slot lock (caller holds write_mu + slot stamp odd)
+inline void erase_slot(HotTable* t, int64_t j) {
+  if (t->state[j].load(std::memory_order_relaxed) == kLive) {
+    t->state[j].store(kTomb, std::memory_order_relaxed);
+    t->live.fetch_sub(1, std::memory_order_relaxed);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* hc_create(int64_t max_entries, int64_t n_cols, int64_t entry_cap) {
+  if (max_entries <= 0 || n_cols <= 0 || n_cols > 63 || entry_cap <= 0)
+    return nullptr;
+  HotTable* t = new HotTable();
+  // load factor <= 0.5: probes stay inside a short window
+  t->n_slots = pow2_at_least(max_entries * 2);
+  t->mask = t->n_slots - 1;
+  t->max_probe = t->n_slots < 128 ? t->n_slots : 128;
+  t->n_cols = n_cols;
+  t->entry_cap = entry_cap;
+  for (int i = 0; i < kStatCount; ++i) t->stats[i].store(0);
+  t->stamp = new std::atomic<uint64_t>[t->n_slots];
+  t->state = new std::atomic<uint8_t>[t->n_slots];
+  t->key = new std::atomic<int64_t>[t->n_slots];
+  for (int64_t i = 0; i < t->n_slots; ++i) {
+    t->stamp[i].store(0, std::memory_order_relaxed);
+    t->state[i].store(kEmpty, std::memory_order_relaxed);
+    t->key[i].store(0, std::memory_order_relaxed);
+  }
+  t->gen = (int64_t*)std::calloc(t->n_slots, sizeof(int64_t));
+  t->n = (int32_t*)std::calloc(t->n_slots, sizeof(int32_t));
+  t->ns = (int64_t*)std::calloc(t->n_slots * entry_cap, sizeof(int64_t));
+  t->vals = (int64_t*)std::calloc(t->n_slots * entry_cap * n_cols,
+                                  sizeof(int64_t));
+  t->tags =
+      (uint64_t*)std::calloc(t->n_slots * entry_cap, sizeof(uint64_t));
+  if (!t->gen || !t->n || !t->ns || !t->vals || !t->tags) {
+    delete t;
+    return nullptr;
+  }
+  return t;
+}
+
+void hc_destroy(void* h) { delete (HotTable*)h; }
+
+int64_t hc_len(void* h) {
+  return ((HotTable*)h)->live.load(std::memory_order_relaxed);
+}
+
+int64_t hc_capacity(void* h) { return ((HotTable*)h)->n_slots; }
+
+int64_t hc_stat(void* h, int32_t which) {
+  HotTable* t = (HotTable*)h;
+  if (which < 0 || which >= kStatCount) return -1;
+  return t->stats[which].load(std::memory_order_relaxed);
+}
+
+void hc_add_stat(void* h, int32_t which, int64_t delta) {
+  // the wrapper folds Python-side overflow-path traffic into the same
+  // counters so stats() reads one source whatever path served
+  HotTable* t = (HotTable*)h;
+  if (which < 0 || which >= kStatCount) return;
+  t->stats[which].fetch_add(delta, std::memory_order_relaxed);
+}
+
+void hc_clear(void* h) {
+  HotTable* t = (HotTable*)h;
+  std::lock_guard<std::mutex> g(t->write_mu);
+  for (int64_t j = 0; j < t->n_slots; ++j) {
+    uint64_t s = lock_slot(t, j);
+    erase_slot(t, j);
+    t->state[j].store(kEmpty, std::memory_order_relaxed);
+    unlock_slot(t, j, s);
+  }
+}
+
+// Batch probe: ONE call for the whole key batch (the serving hot
+// loop). Hit entries land COMPACTLY: key i's counts[i] entries follow
+// the previous hits' in out_ns / out_tags (and counts[i]*n_cols value
+// words in out_vals) — the caller sizes the buffers at nk*entry_cap
+// worst case and bulk-converts exactly sum(counts) entries, no
+// per-key stride walking.
+// ``exact_gen`` < 0 = presence-implies-validity (the primed serving
+// path: ANY live entry hits); >= 0 = only that generation hits.
+// A torn read (stamp moved under the copy) retries, then counts a
+// torn miss and reports MISS — never a mixed-generation row.
+// Returns the hit count.
+int64_t hc_get_batch(void* h, int64_t nk, const int64_t* keys,
+                     int64_t exact_gen, uint8_t* hit, int32_t* counts,
+                     int64_t* out_gen, int64_t* out_ns, int64_t* out_vals,
+                     uint64_t* out_tags) {
+  HotTable* t = (HotTable*)h;
+  int64_t hits = 0;
+  int64_t tot = 0;  // compact output cursor (entries)
+  int64_t torn_retries = 0, torn_misses = 0;
+  for (int64_t i = 0; i < nk; ++i) {
+    const int64_t k = keys[i];
+    hit[i] = 0;
+    counts[i] = 0;
+    bool done = false;
+    for (int attempt = 0; attempt < kReadRetries && !done; ++attempt) {
+      int64_t j = (int64_t)(mix_hash((uint64_t)k)) & t->mask;
+      bool torn = false;
+      for (int64_t step = 0; step < t->max_probe; ++step) {
+        uint8_t st = t->state[j].load(std::memory_order_acquire);
+        if (st == kEmpty) break;  // definitive miss for this attempt
+        if (st == kLive &&
+            t->key[j].load(std::memory_order_relaxed) == k) {
+          uint64_t s1 = t->stamp[j].load(std::memory_order_acquire);
+          if (s1 & 1) {  // write in progress
+            torn = true;
+            break;
+          }
+          int64_t g = t->gen[j];
+          int32_t cnt = t->n[j];
+          if (cnt > t->entry_cap) cnt = (int32_t)t->entry_cap;
+          std::memcpy(out_ns + tot, t->ns + j * t->entry_cap,
+                      cnt * sizeof(int64_t));
+          std::memcpy(out_vals + tot * t->n_cols,
+                      t->vals + j * t->entry_cap * t->n_cols,
+                      cnt * t->n_cols * sizeof(int64_t));
+          std::memcpy(out_tags + tot, t->tags + j * t->entry_cap,
+                      cnt * sizeof(uint64_t));
+          std::atomic_thread_fence(std::memory_order_acquire);
+          uint64_t s2 = t->stamp[j].load(std::memory_order_relaxed);
+          if (s1 != s2 ||
+              t->key[j].load(std::memory_order_relaxed) != k) {
+            torn = true;  // writer moved under us: retry the key
+            break;
+          }
+          if (exact_gen >= 0 && g != exact_gen) break;  // stale: miss
+          out_gen[i] = g;
+          counts[i] = cnt;
+          hit[i] = 1;
+          tot += cnt;
+          ++hits;
+          done = true;
+          break;
+        }
+        j = (j + 1) & t->mask;
+      }
+      if (done) break;
+      if (!torn) break;  // clean miss — no point retrying
+      ++torn_retries;
+      if (attempt == kReadRetries - 1) ++torn_misses;
+    }
+  }
+  t->stats[kHits].fetch_add(hits, std::memory_order_relaxed);
+  t->stats[kMisses].fetch_add(nk - hits, std::memory_order_relaxed);
+  if (torn_retries)
+    t->stats[kTornRetries].fetch_add(torn_retries,
+                                     std::memory_order_relaxed);
+  if (torn_misses)
+    t->stats[kTornMisses].fetch_add(torn_misses,
+                                    std::memory_order_relaxed);
+  return hits;
+}
+
+// Batch put (worker miss-resolution feed): whole-value replace with the
+// no-downgrade rule — an existing entry tagged with a NEWER generation
+// is never overwritten by a stale worker result. Entries are packed
+// flat with off[nk] prefix offsets (off[i]..off[i+1] in ns/tags;
+// times n_cols in vals). A value wider than entry_cap cannot be
+// represented: the key is dropped instead (counted; it simply stays a
+// miss — the cache is best-effort). Returns entries written.
+int64_t hc_put_batch(void* h, int64_t nk, const int64_t* keys,
+                     const int64_t* gens, const int64_t* off,
+                     const int64_t* ns, const int64_t* vals,
+                     const uint64_t* tags) {
+  HotTable* t = (HotTable*)h;
+  std::lock_guard<std::mutex> g(t->write_mu);
+  int64_t written = 0, evictions = 0, oversize = 0;
+  for (int64_t i = 0; i < nk; ++i) {
+    const int64_t k = keys[i];
+    const int64_t cnt = off[i + 1] - off[i];
+    int64_t found, insert;
+    bool evict;
+    locate_for_write(t, k, &found, &insert, &evict);
+    if (cnt > t->entry_cap) {
+      ++oversize;
+      if (found >= 0) {
+        uint64_t s = lock_slot(t, found);
+        erase_slot(t, found);
+        unlock_slot(t, found, s);
+      }
+      continue;
+    }
+    int64_t j = found >= 0 ? found : insert;
+    if (j < 0) continue;  // no slot (tiny table fully torn) — skip
+    if (found >= 0 && t->gen[found] > gens[i]) continue;  // no downgrade
+    if (found < 0 && evict) ++evictions;
+    uint64_t s = lock_slot(t, j);
+    if (found < 0) {
+      if (t->state[j].load(std::memory_order_relaxed) != kLive)
+        t->live.fetch_add(1, std::memory_order_relaxed);
+      t->state[j].store(kLive, std::memory_order_relaxed);
+    }
+    write_payload(t, j, k, gens[i], cnt, ns + off[i],
+                  vals + off[i] * t->n_cols, tags + off[i]);
+    unlock_slot(t, j, s);
+    ++written;
+  }
+  t->stats[kPuts].fetch_add(written, std::memory_order_relaxed);
+  if (evictions)
+    t->stats[kEvictions].fetch_add(evictions, std::memory_order_relaxed);
+  if (oversize)
+    t->stats[kOversizeDrops].fetch_add(oversize,
+                                       std::memory_order_relaxed);
+  return written;
+}
+
+// Publish-side batch prime: ONE call folds a boundary's delta into the
+// table (the task-thread half of the hit path — its cost sits inside
+// the fire-deadline budget, which is why it is one GIL-released sweep
+// instead of N Python put()s). Per key i:
+//   updates  u_ns/u_vals/u_tags[uoff[i]..uoff[i+1]) upsert by namespace
+//   removals r_ns[roff[i]..roff[i+1]) drop namespaces
+//   flags bit0 (insert_ok): the updates are the key's COMPLETE composed
+//     state — an ABSENT key may be created; otherwise absent keys skip
+//   flags bit1 (drop): remove the key's entry entirely
+// The merged entry retags with ``gen``; a key whose existing tag is
+// NEWER is left alone (no downgrade). Overflow past entry_cap drops
+// the key (it becomes a plain miss). Returns keys primed.
+int64_t hc_prime_batch(void* h, int64_t nk, const int64_t* keys,
+                       int64_t gen, const int64_t* uoff,
+                       const int64_t* u_ns, const int64_t* u_vals,
+                       const uint64_t* u_tags, const int64_t* roff,
+                       const int64_t* r_ns, const uint8_t* flags) {
+  HotTable* t = (HotTable*)h;
+  std::lock_guard<std::mutex> g(t->write_mu);
+  int64_t primed = 0, evictions = 0, oversize = 0;
+  // scratch for the merged entry
+  int64_t* m_ns = (int64_t*)std::malloc(t->entry_cap * sizeof(int64_t));
+  int64_t* m_vals =
+      (int64_t*)std::malloc(t->entry_cap * t->n_cols * sizeof(int64_t));
+  uint64_t* m_tags =
+      (uint64_t*)std::malloc(t->entry_cap * sizeof(uint64_t));
+  if (!m_ns || !m_vals || !m_tags) {
+    std::free(m_ns);
+    std::free(m_vals);
+    std::free(m_tags);
+    return 0;
+  }
+  for (int64_t i = 0; i < nk; ++i) {
+    const int64_t k = keys[i];
+    const uint8_t fl = flags[i];
+    int64_t found, insert;
+    bool evict;
+    locate_for_write(t, k, &found, &insert, &evict);
+    if (fl & 2) {  // drop
+      if (found >= 0) {
+        uint64_t s = lock_slot(t, found);
+        erase_slot(t, found);
+        unlock_slot(t, found, s);
+        ++primed;
+      }
+      continue;
+    }
+    if (found < 0 && !(fl & 1)) continue;  // nobody cached it
+    if (found >= 0 && t->gen[found] > gen) continue;  // no downgrade
+    // ---- merge into scratch: surviving old entries, then upserts
+    int64_t m = 0;
+    bool overflow = false;
+    if (found >= 0) {
+      const int64_t* e_ns = t->ns + found * t->entry_cap;
+      const int64_t* e_vals = t->vals + found * t->entry_cap * t->n_cols;
+      const uint64_t* e_tags = t->tags + found * t->entry_cap;
+      for (int32_t e = 0; e < t->n[found]; ++e) {
+        bool removed = false;
+        for (int64_t r = roff[i]; r < roff[i + 1]; ++r)
+          if (r_ns[r] == e_ns[e]) {
+            removed = true;
+            break;
+          }
+        if (!removed)
+          for (int64_t u = uoff[i]; u < uoff[i + 1]; ++u)
+            if (u_ns[u] == e_ns[e]) {
+              removed = true;  // superseded by the upsert below
+              break;
+            }
+        if (removed) continue;
+        if (m >= t->entry_cap) {
+          overflow = true;
+          break;
+        }
+        m_ns[m] = e_ns[e];
+        std::memcpy(m_vals + m * t->n_cols, e_vals + e * t->n_cols,
+                    t->n_cols * sizeof(int64_t));
+        m_tags[m] = e_tags[e];
+        ++m;
+      }
+    }
+    for (int64_t u = uoff[i]; u < uoff[i + 1] && !overflow; ++u) {
+      if (m >= t->entry_cap) {
+        overflow = true;
+        break;
+      }
+      m_ns[m] = u_ns[u];
+      std::memcpy(m_vals + m * t->n_cols, u_vals + u * t->n_cols,
+                  t->n_cols * sizeof(int64_t));
+      m_tags[m] = u_tags[u];
+      ++m;
+    }
+    if (overflow) {
+      ++oversize;
+      if (found >= 0) {
+        uint64_t s = lock_slot(t, found);
+        erase_slot(t, found);
+        unlock_slot(t, found, s);
+      }
+      continue;
+    }
+    int64_t j = found >= 0 ? found : insert;
+    if (j < 0) continue;
+    if (found < 0 && evict) ++evictions;
+    uint64_t s = lock_slot(t, j);
+    if (found < 0) {
+      if (t->state[j].load(std::memory_order_relaxed) != kLive)
+        t->live.fetch_add(1, std::memory_order_relaxed);
+      t->state[j].store(kLive, std::memory_order_relaxed);
+    }
+    write_payload(t, j, k, gen, m, m_ns, m_vals, m_tags);
+    unlock_slot(t, j, s);
+    ++primed;
+  }
+  std::free(m_ns);
+  std::free(m_vals);
+  std::free(m_tags);
+  t->stats[kPrimes].fetch_add(primed, std::memory_order_relaxed);
+  if (evictions)
+    t->stats[kEvictions].fetch_add(evictions, std::memory_order_relaxed);
+  if (oversize)
+    t->stats[kOversizeDrops].fetch_add(oversize,
+                                       std::memory_order_relaxed);
+  return primed;
+}
+
+// Growth migration: re-insert every live entry of ``src`` into ``dst``
+// (same n_cols/entry_cap — the wrapper grows within one schema). Runs
+// under BOTH write mutexes; readers may still probe src concurrently
+// (seqlock-safe). Returns entries migrated.
+int64_t hc_migrate(void* dst_h, void* src_h) {
+  HotTable* dst = (HotTable*)dst_h;
+  HotTable* src = (HotTable*)src_h;
+  if (dst->n_cols != src->n_cols || dst->entry_cap != src->entry_cap)
+    return -1;
+  std::lock_guard<std::mutex> gs(src->write_mu);
+  std::lock_guard<std::mutex> gd(dst->write_mu);
+  int64_t moved = 0;
+  for (int64_t j = 0; j < src->n_slots; ++j) {
+    if (src->state[j].load(std::memory_order_relaxed) != kLive) continue;
+    const int64_t k = src->key[j].load(std::memory_order_relaxed);
+    int64_t found, insert;
+    bool evict;
+    locate_for_write(dst, k, &found, &insert, &evict);
+    int64_t t = found >= 0 ? found : insert;
+    if (t < 0) continue;
+    uint64_t s = lock_slot(dst, t);
+    if (found < 0) {
+      if (dst->state[t].load(std::memory_order_relaxed) != kLive)
+        dst->live.fetch_add(1, std::memory_order_relaxed);
+      dst->state[t].store(kLive, std::memory_order_relaxed);
+    }
+    write_payload(dst, t, k, src->gen[j], src->n[j],
+                  src->ns + j * src->entry_cap,
+                  src->vals + j * src->entry_cap * src->n_cols,
+                  src->tags + j * src->entry_cap);
+    unlock_slot(dst, t, s);
+    ++moved;
+  }
+  return moved;
+}
+
+// Test-only hooks: hold a key's slot stamp ODD (a write frozen
+// mid-flight) so the torn-read retry/fall-to-miss path is exercised
+// DETERMINISTICALLY (tests/test_hotcache_native.py) — a concurrency
+// race would cover it only probabilistically. Returns 1 when the key
+// was found and its stamp flipped.
+int64_t hc_debug_lock_slot(void* h, int64_t key) {
+  HotTable* t = (HotTable*)h;
+  std::lock_guard<std::mutex> g(t->write_mu);
+  int64_t found, insert;
+  bool evict;
+  locate_for_write(t, key, &found, &insert, &evict);
+  if (found < 0) return 0;
+  uint64_t s = t->stamp[found].load(std::memory_order_relaxed) & ~1ull;
+  t->stamp[found].store(s + 1, std::memory_order_release);
+  return 1;
+}
+
+int64_t hc_debug_unlock_slot(void* h, int64_t key) {
+  HotTable* t = (HotTable*)h;
+  std::lock_guard<std::mutex> g(t->write_mu);
+  int64_t found, insert;
+  bool evict;
+  locate_for_write(t, key, &found, &insert, &evict);
+  if (found < 0) return 0;
+  uint64_t s = t->stamp[found].load(std::memory_order_relaxed);
+  if (s & 1) t->stamp[found].store(s + 1, std::memory_order_release);
+  return 1;
+}
+
+void hc_drop(void* h, int64_t key) {
+  HotTable* t = (HotTable*)h;
+  std::lock_guard<std::mutex> g(t->write_mu);
+  int64_t found, insert;
+  bool evict;
+  locate_for_write(t, key, &found, &insert, &evict);
+  if (found >= 0) {
+    uint64_t s = lock_slot(t, found);
+    erase_slot(t, found);
+    unlock_slot(t, found, s);
+  }
+}
+
+}  // extern "C"

@@ -1016,6 +1016,81 @@ class TestReplicaPublishPoint:
                                                    dtype=np.int64))
 
 
+class TestServingCacheProbePoint:
+    """``serving.cache_probe``, injected at its real site — the batched
+    hot-row probe in ``ServingPlane.lookup_batch``. A ``drop`` kind
+    makes the probe fall to the MISS path (the system-level shape of a
+    torn native read): the request still answers, bit-identical,
+    resolved against the sealed replica instead of the cache. A
+    ``raise`` kind surfaces to the client as the crash path."""
+
+    def _serving(self):
+        import queue as _q
+
+        from flink_tpu.parallel.mesh import make_mesh
+        from flink_tpu.parallel.sharded_windower import MeshWindowEngine
+        from flink_tpu.tenancy.replica import WindowReplicaAdapter
+        from flink_tpu.tenancy.serving import ServingPlane
+        from flink_tpu.windowing.aggregates import SumAggregate
+        from flink_tpu.windowing.assigners import (
+            TumblingEventTimeWindows,
+        )
+
+        eng = MeshWindowEngine(
+            TumblingEventTimeWindows(1000), SumAggregate("v"),
+            make_mesh(2), capacity_per_shard=1024, max_parallelism=128)
+        plane = eng.arm_replica()
+        ad = WindowReplicaAdapter(plane, eng.agg, eng.assigner)
+        serving = ServingPlane(workers=1)
+        serving.bind_job("j", _q.Queue())
+        serving.bind_replica("j", "op", ad)
+        eng.process_batch(RecordBatch({
+            "__key_id__": np.arange(16, dtype=np.int64),
+            "__ts__": np.full(16, 100, dtype=np.int64),
+            "v": np.ones(16, dtype=np.float32),
+        }))
+        eng.on_watermark(50)  # publish + harvest-prime the cache
+        return eng, serving
+
+    def test_drop_kind_falls_to_miss_path_bit_identical(self):
+        eng, serving = self._serving()
+        keys = list(range(8))
+        try:
+            want = serving.lookup_batch("j", "op", keys)
+            hits_before = serving.hot_cache.hits
+            assert hits_before > 0  # primed: the probe actually served
+            plan = FaultPlan(rules=[
+                FaultRule(pattern="serving.cache_probe", kind="drop",
+                          every=1)])
+            with chaos.chaos_active(plan, seed=0) as c:
+                got = serving.lookup_batch("j", "op", keys)
+                assert c.faults_injected.get("serving.cache_probe",
+                                             0) >= 1
+                _note_reached(c.faults_injected)
+            # the dropped probe NEVER serves a mixed row — the whole
+            # batch re-resolved against the sealed replica, bit-equal
+            assert got == want
+        finally:
+            serving.shutdown_workers()
+
+    def test_raise_kind_surfaces_to_client(self):
+        eng, serving = self._serving()
+        try:
+            plan = FaultPlan(rules=[
+                FaultRule(pattern="serving.cache_probe", nth=1)])
+            with chaos.chaos_active(plan, seed=0) as c:
+                with pytest.raises(InjectedFault):
+                    serving.lookup_batch("j", "op", [1, 2, 3])
+                assert c.faults_injected.get("serving.cache_probe",
+                                             0) == 1
+                _note_reached(c.faults_injected)
+            # disarmed again: the probe path is intact
+            assert serving.lookup_batch("j", "op", [1])[0] == \
+                eng.query_batch(np.asarray([1], dtype=np.int64))[0]
+        finally:
+            serving.shutdown_workers()
+
+
 class TestWatchdogPoints:
     """The partial-failover fault points, injected at their real sites:
     ``device.lost`` fires inside the watchdog's batch-boundary probe on

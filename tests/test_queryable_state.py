@@ -242,6 +242,91 @@ class TestClientCoalescerRetirement:
         assert s["avg_batch_size"] == 3.0
 
 
+class TestClientServingPlaneFastPath:
+    """r19 client plumbing: against a cluster that exposes a
+    ServingPlane (the tenancy session cluster), the client routes batch
+    lookups through the plane — the whole key batch probes the native
+    hot-row table in ONE call — instead of the RPC control plane; the
+    packed form stays lazy until read."""
+
+    def _plane_cluster(self, served):
+        import types
+
+        class _Plane:
+            def lookup_batch(self, job, op, keys, namespace=None):
+                served.append(("dict", job, op, list(keys)))
+                return [{1: {"v": float(k)}} for k in keys]
+
+            def lookup_batch_packed(self, job, op, keys):
+                from flink_tpu.tenancy.serving import (
+                    PackedLookupResult,
+                )
+
+                served.append(("packed", job, op, list(keys)))
+                return PackedLookupResult.from_dicts(
+                    [{1: {"v": float(k)}} for k in keys])
+
+            def lookup(self, job, op, key, namespace=None):
+                served.append(("point", job, op, key))
+                return {1: {"v": float(key)}}
+
+        def _gw():  # the RPC path must NOT be taken
+            raise AssertionError("RPC gateway used despite a plane")
+
+        return types.SimpleNamespace(serving=_Plane(),
+                                     dispatcher_gateway=_gw)
+
+    def test_batch_routes_through_plane_not_rpc(self):
+        from flink_tpu.cluster.queryable_state import (
+            QueryableStateClient,
+        )
+
+        served = []
+        client = QueryableStateClient(self._plane_cluster(served))
+        out = client.get_state_batch("j", "op", [1, 2])
+        assert out == [{1: {"v": 1.0}}, {1: {"v": 2.0}}]
+        assert served[0][0] == "dict"
+        assert client.get_state("j", "op", 7) == {1: {"v": 7.0}}
+        assert served[-1][0] == "point"
+        # counters: the batch AND the point lookup both recorded
+        # client-side (the plane route must not silently stop counting
+        # what the legacy coalescer path counted)
+        assert client.stats()["lookups_total"] == 3
+
+    def test_packed_batch_lazy_and_bit_identical(self):
+        from flink_tpu.cluster.queryable_state import (
+            QueryableStateClient,
+        )
+        from flink_tpu.tenancy.serving import PackedLookupResult
+
+        served = []
+        client = QueryableStateClient(self._plane_cluster(served))
+        res = client.get_state_batch_packed("j", "op", [3, 4, 5])
+        assert isinstance(res, PackedLookupResult)
+        assert len(res) == 3
+        assert res[1] == {1: {"v": 4.0}}
+        assert res.to_dicts() == client.get_state_batch(
+            "j", "op", [3, 4, 5])
+        assert res == client.get_state_batch("j", "op", [3, 4, 5])
+
+    def test_packed_wraps_rpc_cluster(self):
+        import types
+
+        from flink_tpu.cluster.queryable_state import (
+            QueryableStateClient,
+        )
+        from flink_tpu.tenancy.serving import PackedLookupResult
+
+        gw = types.SimpleNamespace(
+            query_state_batch=lambda j, o, keys, ns:
+            [{0: {"v": 1.0}}] * len(keys))
+        cluster = types.SimpleNamespace(dispatcher_gateway=lambda: gw)
+        client = QueryableStateClient(cluster)
+        res = client.get_state_batch_packed("j", "op", [1, 2])
+        assert isinstance(res, PackedLookupResult)
+        assert res.to_dicts() == [{0: {"v": 1.0}}] * 2
+
+
 class TestSlidingWindowQuery:
     def test_query_composes_window_values_from_slices(self):
         """Sliding windows: a query must return true WINDOW results

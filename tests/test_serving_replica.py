@@ -237,6 +237,111 @@ class TestSessionReplica:
             np.asarray(qk, dtype=np.int64))
 
 
+class TestSessionEndMovePriming:
+    """The session-priming invariant (r19): a session's result key —
+    its END — moves as the session absorbs, and each publish primes
+    the cached entry under the NEW end and deletes the stale-end entry
+    in the SAME batched prime. A session absorbing across THREE publish
+    boundaries must serve the correct end from the HIT path at every
+    boundary (no device touch), with every stale end gone — on the
+    native probe table AND the Python fallback, bit-identical to
+    ``query_batch`` against a checkpoint at that boundary."""
+
+    GAP = 1000
+
+    def _engine(self):
+        return MeshSessionEngine(
+            self.GAP, SumAggregate("value"), make_mesh(4),
+            capacity_per_shard=4096, max_parallelism=128)
+
+    def _cache(self, kind):
+        if kind == "native":
+            from flink_tpu.native import hotcache_available
+
+            if not hotcache_available():
+                pytest.skip("native hotcache unavailable")
+            from flink_tpu.tenancy.hot_cache_native import (
+                NativeHotRowCache,
+            )
+
+            return NativeHotRowCache(max_entries=1 << 12)
+        return HotRowCache(max_entries=1 << 12)
+
+    @pytest.mark.parametrize("kind", ["native", "python"])
+    def test_absorb_across_three_boundaries_hits_with_moving_end(
+            self, kind):
+        eng = self._engine()
+        plane = eng.arm_replica()
+        ad = SessionReplicaAdapter(plane, eng.agg)
+        ad.cold_fetch = lambda ks: eng.query_batch(
+            np.asarray(ks, dtype=np.int64))
+        cache = self._cache(kind)
+        ad.attach_cache(cache, "j", "op")
+        seen_ends = []
+        total = 0.0
+        for b in range(3):
+            t = 100 + b * 600  # within the gap: the SAME session absorbs
+            total += 1.0
+            eng.process_batch(_batch([5], [t], [1.0]))
+            eng.on_watermark(t - 50)  # publish (session alive: wm < end)
+            end = t + self.GAP
+            hits0 = cache.hits
+            hit, val = cache.get("j", "op", 5, plane.generation(),
+                                 exact=False)
+            # the HIT path serves the session at every boundary — the
+            # old behavior invalidated on change, so boundary 2 and 3
+            # would structurally miss here
+            assert hit, f"boundary {b}: primed entry missing"
+            assert cache.hits == hits0 + 1
+            # the NEW end is the only result key: every stale end from
+            # earlier boundaries was deleted in the same batched prime
+            assert set(val.keys()) == {end}, \
+                f"boundary {b}: stale ends {set(val) - {end}}"
+            assert val[end]["sum_value"] == pytest.approx(total)
+            seen_ends.append(end)
+            # bit-identical to the live query AND to a checkpoint
+            # restored at this boundary
+            live = eng.query_batch(np.asarray([5], dtype=np.int64))
+            assert val == live[0]
+            fresh = self._engine()
+            fresh.restore(eng.snapshot(mode="savepoint"))
+            assert val == fresh.query_batch(
+                np.asarray([5], dtype=np.int64))[0]
+        assert len(set(seen_ends)) == 3  # the end genuinely moved
+        if hasattr(cache, "close"):
+            cache.close()
+
+    @pytest.mark.parametrize("kind", ["native", "python"])
+    def test_merge_removes_both_stale_ends(self, kind):
+        # two disjoint sessions of one key merge when a bridging event
+        # arrives: the merged entry must carry ONLY the merged end —
+        # both pre-merge ends (including the one EQUAL to the absorbed
+        # session's end) resolve correctly through remove-then-upsert
+        eng = self._engine()
+        plane = eng.arm_replica()
+        ad = SessionReplicaAdapter(plane, eng.agg)
+        ad.cold_fetch = lambda ks: eng.query_batch(
+            np.asarray(ks, dtype=np.int64))
+        cache = self._cache(kind)
+        ad.attach_cache(cache, "j", "op")
+        eng.process_batch(_batch([5, 5], [100, 1900], [1.0, 2.0]))
+        eng.on_watermark(50)
+        hit, val = cache.get("j", "op", 5, plane.generation(),
+                             exact=False)
+        assert hit and set(val.keys()) == {1100, 2900}
+        eng.process_batch(_batch([5], [1000], [4.0]))  # bridges both
+        eng.on_watermark(60)
+        hit, val = cache.get("j", "op", 5, plane.generation(),
+                             exact=False)
+        assert hit, "merged session must stay on the hit path"
+        assert set(val.keys()) == {2900}
+        assert val[2900]["sum_value"] == pytest.approx(7.0)
+        assert val == eng.query_batch(
+            np.asarray([5], dtype=np.int64))[0]
+        if hasattr(cache, "close"):
+            cache.close()
+
+
 class TestJoinSideReplica:
     def _engine(self, **kw):
         from flink_tpu.joins.engine import MeshIntervalJoinEngine

@@ -17,12 +17,25 @@ host hot-row cache + sharded coalescer workers):
      25 ms — the replica+cache path must hold it under concurrent
      ingest),
    - throughput under the floor (``SERVING_SMOKE_MIN_LOOKUPS_PER_S``,
-     default 216,000/s = 3x the recorded pre-replica 72k row),
+     default 350,000/s — raised from 216k when the r19 native fast
+     path landed: GIL-free hot-row probe table + packed zero-copy
+     batch lookups),
+   - the native hit path less than ``SERVING_SMOKE_MIN_HIT_RATIO``
+     (default 2x) cheaper per hit than the Python dict path
+     (microbenched via tools/bench_hotcache.py after the load phase),
+   - the serving plane silently on the Python cache while
+     ``SERVING_REQUIRE_NATIVE_HOTCACHE=1`` (tier1.sh exports it when
+     the up-front native build succeeded — no vacuous green),
    - hot-row cache hit rate == 0 (vacuity: the cache must actually
      serve),
    - replica generations < 2 (vacuity: boundary publishes must
      actually happen),
-   - any quota violation, zero served lookups, or empty job output.
+   - any quota violation, zero served lookups, empty job output, or a
+     packed-vs-dict lookup mismatch (one materialized cross-check).
+   ``SERVING_SMOKE_PACKED=0`` forces the dict client path (the
+   PR-13-shaped control of the NOTES_r19 walk, gated at the pre-r19
+   216k floor); ``FLINK_TPU_NATIVE_HOTCACHE=0`` is the cache-plane
+   A/B knob.
 
 Prints a JSON line with ``queryable_lookups_per_s`` — `tools/bench_suite.py`
 runs this script at bench scale for the BENCHMARKS.md serving row.
@@ -53,9 +66,27 @@ RECORDS = int(os.environ.get("SERVING_SMOKE_RECORDS", 200_000))
 CLIENTS = int(os.environ.get("SERVING_SMOKE_CLIENTS", 16))
 KEYS = int(os.environ.get("SERVING_SMOKE_KEYS", 4096))
 P99_BUDGET_MS = float(os.environ.get("SERVING_SMOKE_P99_BUDGET_MS", 25))
-#: throughput floor: 3x the recorded pre-replica 72k lookups/s row
+#: packed (zero-copy) client lever — read early: the default floor
+#: keys on it (1 = the native fast path; 0 = the PR-13-shaped dict
+#: control of the NOTES_r19 walk, gated at the old floor)
+PACKED = os.environ.get("SERVING_SMOKE_PACKED", "1") != "0"
+#: throughput floor, raised for the r19 native fast path (216k was
+#: 3x the pre-replica 72k row; the native hot-row table + packed
+#: lookups measured ~500k+ here — 350k keeps scheduler-noise headroom
+#: while a regression to the GIL-bound hit path trips it)
 MIN_LOOKUPS_PER_S = float(os.environ.get(
-    "SERVING_SMOKE_MIN_LOOKUPS_PER_S", 216_000))
+    "SERVING_SMOKE_MIN_LOOKUPS_PER_S",
+    350_000 if PACKED else 216_000))
+#: per-hit-cost gate: the native hit path must stay at least this many
+#: times cheaper than the Python dict path on THIS box (microbenched
+#: via tools/bench_hotcache.py after the load phase; 0 disables)
+MIN_HIT_RATIO = float(os.environ.get(
+    "SERVING_SMOKE_MIN_HIT_RATIO", 2.0))
+#: exported by tier1.sh when the up-front native build succeeded: the
+#: smoke then FAILS if the serving plane silently fell back to the
+#: Python cache (no vacuous green on the native gates)
+REQUIRE_NATIVE = os.environ.get(
+    "SERVING_REQUIRE_NATIVE_HOTCACHE") == "1"
 QUOTA_ROWS = int(os.environ.get("SERVING_SMOKE_QUOTA_ROWS", 8192))
 #: keys per client request: the serving frontend shape — a fan-in of
 #: point lookups amortized into request batches (the recorded 72k row
@@ -73,6 +104,14 @@ REPLICA = os.environ.get("SERVING_SMOKE_REPLICA", "1") != "0"
 #: boundary publishes batched under this interval (staleness bound)
 PUBLISH_INTERVAL_MS = int(os.environ.get(
     "SERVING_SMOKE_PUBLISH_INTERVAL_MS", 25))
+#: replica staleness p99 budget (ms): a client shape that starves the
+#: ingest/publish loop can post huge lookup numbers against a frozen
+#: replica — that is a DIFFERENT product. The r19 pause sweep showed
+#: exactly this: the GIL-held dict path at 2 ms pause reached 724k/s
+#: with staleness p99 2.5 s (rejected), the packed path 1.05M/s at
+#: 350 ms (accepted). 0 disables.
+STALENESS_BUDGET_MS = float(os.environ.get(
+    "SERVING_SMOKE_STALENESS_BUDGET_MS", 1000))
 #: per-optimization A/B levers (the NOTES_r17 measured walk): hot-row
 #: cache capacity (0 = every lookup resolves on the replica) and the
 #: serving worker-pool size (1 = one drain loop for all shards)
@@ -151,10 +190,35 @@ def main():
             import numpy as np
 
             rng = np.random.default_rng(100 + i)
+            checked = False
             while not stop.is_set():
                 try:
                     job = job_names[i % len(job_names)]
-                    if LOOKUP_BATCH > 1:
+                    if LOOKUP_BATCH > 1 and PACKED and REPLICA:
+                        ks = rng.integers(0, KEYS,
+                                          LOOKUP_BATCH).tolist()
+                        res = cluster.lookup_batch_packed(
+                            job, operator, ks)
+                        if not checked and i == 0:
+                            # materialized cross-check: the packed fast
+                            # path must match the dict path (the test
+                            # suite pins bit-identity; this catches a
+                            # broken wire). A publish can land between
+                            # the two calls, so only REPEATED mismatch
+                            # counts — one moved boundary does not.
+                            for _ in range(5):
+                                if res.to_dicts() == \
+                                        cluster.lookup_batch(
+                                            job, operator, ks):
+                                    checked = True
+                                    break
+                                res = cluster.lookup_batch_packed(
+                                    job, operator, ks)
+                            else:
+                                errors.append(
+                                    "packed != dict lookup results")
+                                return
+                    elif LOOKUP_BATCH > 1:
                         cluster.lookup_batch(
                             job, operator,
                             rng.integers(0, KEYS,
@@ -222,6 +286,14 @@ def main():
     if errors:
         print(f"FAIL: {errors[:3]}")
         ok = False
+    from flink_tpu.tenancy.hot_cache import HotRowCache
+
+    native_cache = not isinstance(cluster.serving.hot_cache,
+                                  HotRowCache)
+    if REQUIRE_NATIVE and not native_cache:
+        print("FAIL: native hotcache built but the serving plane fell "
+              "back to the Python cache (vacuous native gates)")
+        ok = False
     metrics = cluster.serving.metrics()
     lookups = int(metrics["lookups_total"])
     p99 = float(metrics["lookup_p99_ms"])
@@ -243,6 +315,11 @@ def main():
               f"{P99_BUDGET_MS:.0f} ms budget")
         ok = False
     if REPLICA:
+        if STALENESS_BUDGET_MS and staleness_p99 > STALENESS_BUDGET_MS:
+            print(f"FAIL: replica staleness p99 {staleness_p99:.0f} ms "
+                  f"over the {STALENESS_BUDGET_MS:.0f} ms budget — "
+                  "lookups are outrunning a starved publish loop")
+            ok = False
         if lookups_per_s < MIN_LOOKUPS_PER_S:
             print(f"FAIL: {lookups_per_s:,.0f} lookups/s under the "
                   f"{MIN_LOOKUPS_PER_S:,.0f} floor (3x the recorded "
@@ -260,6 +337,27 @@ def main():
     if viol:
         print(f"FAIL: {viol} quota violations on job-2")
         ok = False
+    # per-hit-cost gate (after the load phase — it microbenches on the
+    # quiet box): the native hit path must beat the Python dict path
+    # by the floor ratio, or the fast path silently regressed
+    hit_ratio = None
+    if MIN_HIT_RATIO and native_cache:
+        from tools.bench_hotcache import measure_hit_cost
+
+        cost = measure_hit_cost(rounds=9)
+        if cost is None:
+            print("FAIL: native cache armed but the microbench found "
+                  "no native library")
+            ok = False
+        else:
+            hit_ratio = cost["ratio"]
+            if hit_ratio < MIN_HIT_RATIO:
+                print(f"FAIL: native hit path only {hit_ratio:.2f}x "
+                      f"cheaper than the Python dict path (floor "
+                      f"{MIN_HIT_RATIO:.1f}x; native "
+                      f"{cost['native_hit_ns']:.0f} ns vs python "
+                      f"{cost['python_hit_ns']:.0f} ns)")
+                ok = False
     for name, sink in (("job-2", s2), ("job-3", s3)):
         if len(sink.result()) == 0:
             print(f"FAIL: {name} produced no output")
@@ -273,7 +371,10 @@ def main():
                  f"against 2 concurrent ingesting jobs "
                  f"({RECORDS} records each, mesh of 4) "
                  f"— read-replica serving plane "
-                 f"({'armed' if REPLICA else 'DISARMED: legacy live-plane path'}): "
+                 f"({'armed' if REPLICA else 'DISARMED: legacy live-plane path'}), "
+                 f"native hot-row table "
+                 f"{'armed' if native_cache else 'OFF (Python cache)'}"
+                 f"{', packed zero-copy lookups' if PACKED and REPLICA else ', dict lookups'}: "
                  f"hot-row hit rate {hit_rate:.3f}, "
                  f"replica staleness p99 {staleness_p99:.1f} ms "
                  f"({gens} generations), p99 {p99:.2f} ms, "
@@ -285,6 +386,8 @@ def main():
           f"hit_rate={hit_rate:.3f} generations={gens} "
           f"staleness_p99={staleness_p99:.1f}ms "
           f"compiles={s.compiles} quota_violations={viol} "
+          f"native_cache={native_cache} "
+          f"hit_ratio={hit_ratio if hit_ratio is None else round(hit_ratio, 2)} "
           f"=> {'OK' if ok else 'FAIL'}")
     return 0 if ok else 1
 

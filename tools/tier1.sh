@@ -31,6 +31,12 @@ echo "$native_status"
 if python -c 'import sys; from flink_tpu.native import sessions_available; sys.exit(0 if sessions_available() else 1)'; then
   export BENCH_REQUIRE_NATIVE=1
 fi
+# same discipline for the serving fast path: when the HOTCACHE library
+# built, the serving smoke FAILS if the plane silently fell back to
+# the Python cache (its throughput/per-hit gates would go vacuous)
+if python -c 'import sys; from flink_tpu.native import hotcache_available; sys.exit(0 if hotcache_available() else 1)'; then
+  export SERVING_REQUIRE_NATIVE_HOTCACHE=1
+fi
 
 set -o pipefail
 log="${T1_LOG:-/tmp/_t1.$$.log}"   # unique per run: concurrent gates must not clobber
@@ -159,15 +165,22 @@ if [ "${SKIP_BENCH_SMOKE:-0}" != "1" ]; then
 
   # Serving smoke: 2 concurrent ingesting jobs on one mesh + client
   # threads hammering batched queryable-state lookups through the
-  # READ-REPLICA plane (boundary-published snapshots + publish-harvest
-  # hot-row cache + sharded coalescer workers, r17). FAILS on any
-  # steady-state XLA compile after job-1 warms the shared program
-  # cache + replica tier lattice, on a per-job program-cache miss, on
-  # lookup p99 over 25 ms, on throughput under 216k lookups/s (3x the
-  # recorded pre-replica 72k row; measured ~395-430k here), on a zero
-  # hot-row hit rate / <2 replica generations (vacuity guards — the
-  # replica path must actually serve), or on a quota violation.
-  # ~40 s on CPU.
+  # READ-REPLICA plane and the r19 NATIVE FAST PATH (GIL-free hot-row
+  # probe table in native/hotcache.cpp + packed zero-copy batch
+  # lookups + session priming). FAILS on any steady-state XLA compile
+  # after job-1 warms the shared program cache + replica tier lattice,
+  # on a per-job program-cache miss, on lookup p99 over 25 ms, on
+  # throughput under 350k lookups/s (raised from 216k when the native
+  # fast path landed; measured ~500-580k here at the 5 ms client
+  # pause, ~1.1M/s at the bench row's 2 ms point), on the native hit
+  # path being < 2x cheaper per hit than the Python dict path
+  # (tools/bench_hotcache.py microbench), on replica staleness p99
+  # over 1 s (a starved publish loop behind big lookup numbers is a
+  # different product), on a packed-vs-dict result mismatch, on a
+  # silent fallback to the Python cache while the native library
+  # built (SERVING_REQUIRE_NATIVE_HOTCACHE above), on a zero hot-row
+  # hit rate / <2 replica generations (vacuity guards), or on a quota
+  # violation. ~60 s on CPU.
   SERVING_SMOKE_RECORDS=$((1 << 17)) \
     JAX_PLATFORMS=cpu timeout -k 10 300 \
     python tools/serving_smoke.py || exit 1
