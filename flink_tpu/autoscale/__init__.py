@@ -6,6 +6,10 @@
 for the mesh engines (``MeshWindowEngine.reshard`` /
 ``MeshSessionEngine.reshard``), the minicluster's reactive redeploy
 (checkpoint-restore-at-new-parallelism) as the cold fallback.
+``rebalance`` handles what shard-count changes cannot: skew. It moves
+hot key groups between shards (``engine.reassign_key_groups``) and
+splits single dominant keys (``engine.register_hot_key``) when the
+scaling policy's skew guard refuses to act.
 """
 
 from flink_tpu.autoscale.policy import (  # noqa: F401
@@ -16,4 +20,9 @@ from flink_tpu.autoscale.policy import (  # noqa: F401
 from flink_tpu.autoscale.controller import (  # noqa: F401
     AutoscaleController,
     RescaleEvent,
+)
+from flink_tpu.autoscale.rebalance import (  # noqa: F401
+    RebalancePlan,
+    RebalancePolicy,
+    SkewResponder,
 )

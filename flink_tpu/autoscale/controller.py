@@ -71,6 +71,7 @@ class AutoscaleController:
         interval_s: float = 1.0,
         clock=None,
         metrics_group=None,
+        on_imbalance: Optional[Callable[[PolicyInput], None]] = None,
     ) -> None:
         import time as _time
 
@@ -98,6 +99,11 @@ class AutoscaleController:
         self._last_sample_t: Optional[float] = None
         self._last_tick: Optional[float] = None
         self._handoff_hist = None
+        #: called (with the PolicyInput) whenever the skew guard refuses
+        #: a scale-down — the hand-off hook a rebalancer (e.g.
+        #: autoscale.rebalance.SkewResponder) hangs off so "imbalance"
+        #: triggers a key-group MOVE instead of merely holding P
+        self.on_imbalance = on_imbalance
         if metrics_group is not None:
             self.register_metrics(metrics_group)
 
@@ -116,6 +122,9 @@ class AutoscaleController:
         g.gauge("last_decision",
                 lambda: (self.last_decision.reason
                          if self.last_decision else ""))
+        g.gauge("skew_guard_refusals",
+                lambda: self.policy.skew_guard_refusals)
+        g.gauge("key_imbalance", lambda: self.policy.last_imbalance)
         self._handoff_hist = g.histogram("handoff_ms")
 
     # ---------------------------------------------------------------- state
@@ -169,6 +178,11 @@ class AutoscaleController:
             return None
         decision = self.policy.decide(inp, now=now)
         self.last_decision = decision
+        if decision.reason == "imbalance" and self.on_imbalance is not None:
+            # the guard refused a scale-down because one shard is hot:
+            # hand the sample to the rebalancer — moving hot key groups
+            # is the fix a shard-count change cannot provide
+            self.on_imbalance(inp)
         if not decision.rescale or decision.target == inp.current_shards:
             return None
         return self._apply(decision, inp.current_shards, now)

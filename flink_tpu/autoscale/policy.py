@@ -146,6 +146,13 @@ class ScalingPolicy:
         self._last_rescale: Optional[float] = None
         #: consecutive decisions whose fire p99 exceeded the deadline
         self._fire_breaches = 0
+        #: times the skew guard vetoed a scale-down — previously the
+        #: guard refused SILENTLY; a rebalancer (autoscale.rebalance)
+        #: keys off this signal instead of a log line
+        self.skew_guard_refusals = 0
+        #: imbalance measured at the most recent decide() with resident
+        #: rows in the sample (1.0 = balanced)
+        self.last_imbalance = 1.0
 
     # --------------------------------------------------------------- helpers
 
@@ -174,6 +181,8 @@ class ScalingPolicy:
                now: Optional[float] = None) -> Decision:
         now = self._clock() if now is None else now
         cur = max(int(inp.current_shards), 1)
+        if len(inp.shard_resident_rows):
+            self.last_imbalance = self.imbalance(inp.shard_resident_rows)
 
         # hard bounds win over everything except cooldown: a job
         # deployed outside [min, max] converges on the next tick
@@ -230,7 +239,9 @@ class ScalingPolicy:
             imb = self.imbalance(inp.shard_resident_rows)
             if imb > self.imbalance_limit:
                 # the hot shard explains the load: scaling down would
-                # concentrate the skew, not shed capacity
+                # concentrate the skew, not shed capacity — counted (not
+                # silent) so the rebalance hand-off can observe it
+                self.skew_guard_refusals += 1
                 return Decision(cur, "imbalance")
             return Decision(target, "scale-down")
         return Decision(target, "scale-up")

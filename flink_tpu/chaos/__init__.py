@@ -36,6 +36,7 @@ KNOWN_FAULT_POINTS = (
     "mesh.session_fire",
     "mesh.window_fire",
     "rescale.handoff",
+    "rebalance.handoff",
     "join.exchange",
     "join.versioned_lookup",
     "serving.lookup",

@@ -216,6 +216,7 @@ def run_crash_restore_verify(
     abs_tol: float = 1e-3,
     check: bool = True,
     rescales: Optional[Dict[int, int]] = None,
+    rebalances: Optional[Dict[int, Any]] = None,
     metric_group=None,
 ) -> ChaosReport:
     """Run ``steps`` (list of ``(keys, values, timestamps, watermark)``)
@@ -233,7 +234,17 @@ def run_crash_restore_verify(
     re-reaches a scheduled position (the shard count is an
     implementation detail — output equivalence is what the diff pins);
     a position already past the restored source position simply stays
-    at the restored engine's default mesh size."""
+    at the restored engine's default mesh size.
+
+    ``rebalances``: {step position -> key-group assignment, or a
+    callable ``engine -> assignment``} — before processing that step,
+    the engine live-MOVES key groups between shards at unchanged P
+    (``engine.reassign_key_groups``, optionally crashed by a
+    ``rebalance.handoff`` fault). The assignment is runtime routing
+    state, not checkpointed: a restored engine comes back contiguous
+    and re-applies the move when replay re-reaches the position —
+    output equivalence is what the diff pins, whichever layout a row
+    was fired from."""
     from flink_tpu.checkpoint.storage import CheckpointStorage
 
     if chaos.armed():
@@ -283,6 +294,13 @@ def run_crash_restore_verify(
                         int(getattr(engine, "P", 0)) != rescales[pos]:
                     engine.reshard(rescales[pos])
                     report.live_handoffs += 1
+                if rebalances and pos in rebalances:
+                    target = rebalances[pos]
+                    if callable(target):
+                        target = target(engine)
+                    rep = engine.reassign_key_groups(target)
+                    if rep.get("groups_moved", 0):
+                        report.live_handoffs += 1
                 if pos == n_steps:
                     # end of input: flush every remaining window
                     _collect(engine.on_watermark(

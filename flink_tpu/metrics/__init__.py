@@ -8,6 +8,7 @@ KNOWN_METRIC_GROUPS = (
     "chaos",
     "flight",
     "latency",
+    "skew",
     "state",
     "tenancy",
     "watchdog",

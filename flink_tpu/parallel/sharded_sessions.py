@@ -51,11 +51,36 @@ from flink_tpu.parallel.shuffle import (
     shard_records,
     stage_device_exchange,
 )
-from flink_tpu.state.keygroups import assign_key_groups
+from flink_tpu.state.keygroups import _splitmix64, assign_key_groups
 from flink_tpu.state.slot_table import resolve_slot_hints
 from flink_tpu.windowing.aggregates import AggregateFunction
 from flink_tpu.windowing.session_meta import MergeGroup, make_session_meta
 from flink_tpu.windowing.windower import WINDOW_END_FIELD, WINDOW_START_FIELD
+
+#: hot-key splitting: upper bound on sub-keys per split key. Salted
+#: sub-rows live in the SAME state plane as real sessions, addressed by
+#: (salted key, salted namespace): ``ssid = -(sid * MAX_SALTS + salt
+#: + 1)`` — globally unique NEGATIVE namespaces that can never collide
+#: with real (non-negative) session ids, and decode back to (sid, salt).
+MAX_SALTS = 64
+
+_SALT_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+
+
+def _salted_keys(key_ids: np.ndarray, salts: np.ndarray) -> np.ndarray:
+    """Deterministic synthetic key id for (key, salt) — splitmix64 over
+    the XOR-folded pair, so every sub-key lands in its own key group
+    (that is the point: the group spread is what moves the load)."""
+    x = (np.asarray(key_ids, dtype=np.int64).astype(np.uint64)
+         ^ ((np.asarray(salts, dtype=np.uint64) + np.uint64(1))
+            * _SALT_GOLDEN))
+    return _splitmix64(x).astype(np.int64)
+
+
+def _salted_ns(sids: np.ndarray, salts: np.ndarray) -> np.ndarray:
+    """(sid, salt) -> unique negative namespace (see MAX_SALTS)."""
+    return -(np.asarray(sids, dtype=np.int64) * MAX_SALTS
+             + np.asarray(salts, dtype=np.int64) + 1)
 
 
 def build_session_merge_step(mesh: Mesh, agg: AggregateFunction):
@@ -188,6 +213,17 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         #: freed-session tombstone chunks (int64 arrays, deduped at
         #: snapshot time — per-fire tolist round-trips were measurable)
         self._freed_ns: List[np.ndarray] = []
+        #: hot-key splitting (two-stage aggregation): key_id -> number of
+        #: salts. Records for a hot key are salted into sub-keys whose
+        #: partials live as ordinary (salted-key, negative-ns) rows in the
+        #: SAME state plane — spill, checkpoint and reshard machinery see
+        #: nothing special. Fires and queries fold the sub-rows back in a
+        #: fixed order (main row, then salts ascending) on the host.
+        self._hot_keys: Dict[int, int] = {}
+        #: records diverted through the salting path (skew gauge)
+        self._hot_salted_records = 0
+        #: fires that folded at least one salted sub-row (skew gauge)
+        self._hot_salted_fires = 0
         self._merge_bucket = 0
         self._fire_bucket = 0
         self._reset_bucket = 0
@@ -282,19 +318,22 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                 # sort); only a shard actually over the record bound
                 # pays the np.unique refinement
                 rsm = getattr(self.meta, "rec_shard_max", None)
+                if self._assignment is not None:
+                    # the native sweep hard-codes the contiguous
+                    # group->shard formula — a live rebalanced table
+                    # must take the numpy path
+                    rsm = None
                 if rsm is not None:
                     rec_max = rsm(keys, self.P, self.max_parallelism,
                                   self.key_group_range)
                 else:
                     rec_max = int(np.bincount(
-                        shard_records(keys, self.P, self.max_parallelism,
-                                      self.key_group_range),
+                        self._route(keys),
                         minlength=self.P).max())
                 if rec_max > budget:
                     uniq = np.unique(keys)
                     per_shard = np.bincount(
-                        shard_records(uniq, self.P, self.max_parallelism,
-                                      self.key_group_range),
+                        self._route(uniq),
                         minlength=self.P)
                     if int(per_shard.max()) > budget:
                         half = np.zeros(n, dtype=bool)
@@ -346,6 +385,12 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         per_shard_sel = {}
         shard_slices = {}
         sg = getattr(self.meta, "shard_group", None)
+        if self._assignment is not None:
+            # sx_shard_group applies the contiguous formula in C; under
+            # a rebalanced assignment the equivalent numpy path routes
+            # through the table (meta.route_records below stays valid —
+            # it consumes the sess_shard we hand it)
+            sg = None
         if sg is not None:
             (sess_shard, counts, sorted_idx, key_sorted, sid_sorted,
              fresh_sorted, hint_sorted, row_sorted) = sg(
@@ -356,8 +401,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                 shard_slices[p] = (a, b)
                 per_shard_sel[p] = sorted_idx[a:b]
         else:
-            sess_shard = shard_records(sess_key, self.P,
-                self.max_parallelism, self.key_group_range)
+            sess_shard = self._route(sess_key)
             live_idx = np.nonzero(live_sess)[0]
             sorted_idx = live_idx
             if len(live_idx):
@@ -441,6 +485,10 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             rec_slots[order] = slot_of_sess[rec_to_sess]
             rec_shards = np.empty(n, dtype=sess_shard.dtype)
             rec_shards[order] = sess_shard[rec_to_sess]
+        if self._hot_keys:
+            rec_slots, rec_shards = self._salt_hot_records(
+                keys, ts, sess_key, sess_sid, rec_to_sess, order,
+                rec_slots, rec_shards)
         values = self.agg.map_input(batch)
         in_leaves = self.agg.input_leaves
         # pipelining: claim a dispatch slot BEFORE rewriting the pooled
@@ -507,8 +555,9 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         gk = np.asarray(g.keys_dst, dtype=np.int64)
         ds = np.asarray(g.sids_dst, dtype=np.int64)
         ss = np.asarray(g.sids_src, dtype=np.int64)
-        shards = shard_records(gk, self.P,
-            self.max_parallelism, self.key_group_range)
+        if self._hot_keys:
+            gk, ds, ss = self._expand_hot_merges(gk, ds, ss)
+        shards = self._route(gk)
         # combined dst+src pairs per shard (dst and src share the key,
         # hence the shard): with a spill tier, both sides must be
         # device-resident simultaneously for the merge kernel
@@ -569,9 +618,17 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                 if p not in pairs or not len(d_slots):
                     continue
                 c = len(d_slots)
-                fk.append(pairs[p][0][:c])
-                fs.append(pairs[p][1][:c])
-                fl.append(d_slots)
+                pk2, ps2 = pairs[p][0][:c], pairs[p][1][:c]
+                if self._hot_keys:
+                    # salted sub-rows (negative sids) have no metadata
+                    # row to fold a slot into
+                    keep2 = ps2 >= 0
+                    pk2, ps2 = pk2[keep2], ps2[keep2]
+                    d_slots = d_slots[keep2]
+                if len(pk2):
+                    fk.append(pk2)
+                    fs.append(ps2)
+                    fl.append(d_slots)
             if fk:
                 self.meta.note_slots(np.concatenate(fk),
                                      np.concatenate(fs),
@@ -596,6 +653,257 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                 else:
                     self.indexes[p].free_slots(s_slots)
                     self._dirty[p, s_slots] = False
+
+    # ---------------------------------------------------- hot-key splitting
+
+    def register_hot_key(self, key_id: int, salts: int = 8,
+                         allow_inexact: bool = False) -> int:
+        """Two-stage aggregation for one dominating key: salt its
+        records into ``salts`` sub-keys, pre-aggregated on their OWN
+        shards as ordinary (salted-key, negative-namespace) rows, and
+        folded back into the main row's result at fire / query time in
+        a fixed order (main row, then salts ascending — the same fold
+        discipline the exchange applies within a shard).
+
+        Exactness: min/max and integer sums commute freely, so salting
+        is bit-identical to the unsalted oracle. Floating-point sums
+        reassociate; pass ``allow_inexact=True`` to accept that —
+        streams whose values are integer-valued floats (e.g. counters
+        held in float32, exact below 2**24) remain bit-identical in
+        practice. Requires the paged spill layout (the split rows ride
+        the registry-free slot machinery). Returns the clamped salt
+        count actually applied."""
+        if not self._paged:
+            raise ValueError(
+                "hot-key splitting requires the paged spill layout "
+                "(spill_layout='pages' with max_device_slots > 0)")
+        salts = max(2, min(int(salts), MAX_SALTS))
+        exact = all(
+            l.reduce in ("min", "max") or np.dtype(l.dtype).kind in "iub"
+            for l in self.agg.leaves)
+        if not exact and not allow_inexact:
+            raise ValueError(
+                "splitting a float sum reassociates the fold; pass "
+                "allow_inexact=True if the stream tolerates it (exact "
+                "for integer-valued floats below the mantissa limit)")
+        self._hot_keys[int(key_id)] = salts
+        # the serving shadow must re-route the split key through the
+        # live combined fold (one lookup answers main + salts)
+        self._rep_rebuild = True
+        return salts
+
+    def hot_key_stats(self) -> Dict[str, object]:
+        return {
+            "keys": dict(self._hot_keys),
+            "salted_records": int(self._hot_salted_records),
+            "salted_fires": int(self._hot_salted_fires),
+        }
+
+    def _hot_key_array(self) -> np.ndarray:
+        return np.fromiter(self._hot_keys, dtype=np.int64,
+                           count=len(self._hot_keys))
+
+    def _salt_hot_records(self, keys, ts, sess_key, sess_sid,
+                          rec_to_sess, order, rec_slots, rec_shards):
+        """Ingest diversion: re-point hot keys' records at their salted
+        sub-rows. The salt is derived from the record TIMESTAMP
+        (splitmix64 mod n_salts) so a replay salts identically — no
+        RNG, no per-batch state."""
+        hot = self._hot_key_array()
+        hot_sess = np.isin(sess_key, hot) & (sess_sid >= 0)
+        j = np.nonzero(hot_sess[rec_to_sess])[0]
+        if not len(j):
+            return rec_slots, rec_shards
+        ridx = order[j]  # original record positions (session-sorted -> raw)
+        rk = keys[ridx]
+        rs = sess_sid[rec_to_sess[j]]
+        nsalts = np.zeros(len(ridx), dtype=np.uint64)
+        for hk, hv in self._hot_keys.items():
+            nsalts[rk == hk] = np.uint64(hv)
+        salt = (_splitmix64(ts[ridx].astype(np.uint64))
+                % nsalts).astype(np.int64)
+        skey = _salted_keys(rk, salt)
+        sns = _salted_ns(rs, salt)
+        # sids are globally unique, so the salted namespace alone
+        # identifies the (session, salt) pair: resolve each unique
+        # sub-row once, scatter the slot to every diverted record
+        uns, inv = np.unique(sns, return_inverse=True)
+        first_pos = np.zeros(len(uns), dtype=np.int64)
+        first_pos[inv[::-1]] = np.arange(len(sns) - 1, -1, -1)
+        ukey = skey[first_pos]
+        shards_u = self._route(ukey)
+        per = {}
+        for p in np.unique(shards_u).tolist():
+            selp = np.nonzero(shards_u == p)[0]
+            per[p] = (ukey[selp], uns[selp])
+        resolved = self._resolve_slots_paged(per)
+        slots_u = np.zeros(len(uns), dtype=np.int32)
+        for p in per:
+            selp = np.nonzero(shards_u == p)[0]
+            slots_u[selp] = resolved[p]
+            self._dirty[p, resolved[p]] = True
+        if not rec_slots.flags.writeable:
+            rec_slots = rec_slots.copy()
+        if not rec_shards.flags.writeable:
+            rec_shards = rec_shards.copy()
+        rec_slots[ridx] = slots_u[inv]
+        rec_shards[ridx] = shards_u[inv]
+        self._hot_salted_records += len(ridx)
+        return rec_slots, rec_shards
+
+    def _expand_hot_merges(self, gk, ds, ss):
+        """Session merges of a split key carry their salted sub-rows
+        along: (skey(k,t), ssid(src,t)) folds into (skey(k,t),
+        ssid(dst,t)) — same salted key, hence the same shard, so the
+        merge kernel's no-cross-shard invariant holds. Missing sub-rows
+        resolve to identity (a no-op merge)."""
+        sel = np.nonzero(np.isin(gk, self._hot_key_array()))[0]
+        if not len(sel):
+            return gk, ds, ss
+        ek, ed, es = [gk], [ds], [ss]
+        freed = []
+        for i in sel.tolist():
+            k = int(gk[i])
+            n = self._hot_keys[k]
+            salts = np.arange(n, dtype=np.int64)
+            kk = np.full(n, k, dtype=np.int64)
+            ek.append(_salted_keys(kk, salts))
+            ed.append(_salted_ns(np.full(n, int(ds[i]),
+                                         dtype=np.int64), salts))
+            sns = _salted_ns(np.full(n, int(ss[i]),
+                                     dtype=np.int64), salts)
+            es.append(sns)
+            freed.append(sns)
+        # absorbed sub-rows die with their session: tombstones so delta
+        # snapshots drop them (mirrors g.absorbed_sids for main rows)
+        self._freed_ns.append(np.concatenate(freed))
+        return (np.concatenate(ek), np.concatenate(ed),
+                np.concatenate(es))
+
+    def _fire_hot_fold(self, hk, hs) -> List[np.ndarray]:
+        """RAW folded leaves for hot fired sessions. The device delta
+        fire FINISHES on device (nonlinear), so a split session cannot
+        fire there — its sub-rows must fold BEFORE the finish. Resident
+        physical rows come back through one gather + one reset (slots
+        return to identity before reuse); paged rows extract from the
+        page tier (tombstoning them); absent rows are identity. The
+        fold runs per leaf with the exchange's combine op in array
+        order: main row first, then salts ascending."""
+        from flink_tpu.ops.segment_ops import HOST_COMBINE
+        from flink_tpu.state.paged_spill import (
+            reload_rows_for,
+            sorted_match,
+        )
+
+        leaves = self.agg.leaves
+        leaf_dtypes = [l.dtype for l in leaves]
+        nh = len(hk)
+        pks, pns, gids = [], [], []
+        for i in range(nh):
+            k, s = int(hk[i]), int(hs[i])
+            n = self._hot_keys[k]
+            salts = np.arange(n, dtype=np.int64)
+            pks.append(np.concatenate((
+                np.asarray([k], dtype=np.int64),
+                _salted_keys(np.full(n, k, dtype=np.int64), salts))))
+            pns.append(np.concatenate((
+                np.asarray([s], dtype=np.int64),
+                _salted_ns(np.full(n, s, dtype=np.int64), salts))))
+            gids.append(np.full(n + 1, i, dtype=np.int64))
+        pk = np.concatenate(pks)
+        pn = np.concatenate(pns)
+        gid = np.concatenate(gids)
+        vals = [np.full(len(pk), l.identity, dtype=l.dtype)
+                for l in leaves]
+        shards = self._route(pk)
+        lanes: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        g_max = 0
+        for p in range(self.P):
+            selp = np.nonzero(shards == p)[0]
+            if not len(selp):
+                continue
+            idx = self.indexes[p]
+            ks, ns = pk[selp], pn[selp]
+            slots = idx.lookup(ks, ns)
+            hit = slots >= 0
+            if hit.any():
+                rslots = slots[hit].astype(np.int32)
+                lanes[p] = (selp[hit], rslots)
+                g_max = max(g_max, len(rslots))
+                idx.free_slots(rslots, keys=ks[hit], nss=ns[hit])
+                self._dirty[p, rslots] = False
+            miss = ~hit
+            if miss.any() and len(self._pmaps[p]):
+                rl = reload_rows_for(self.spills[p], self._pmaps[p],
+                                     ns[miss], leaf_dtypes)
+                if rl is not None:
+                    _, rns, _, rvals = rl
+                    ro = np.argsort(rns)
+                    found, pos = sorted_match(rns[ro], ns[miss])
+                    src = ro[pos[found]]
+                    dstp = selp[miss][found]
+                    for i in range(len(leaves)):
+                        vals[i][dstp] = rvals[i][src]
+        if g_max:
+            G = pad_bucket_size(g_max, minimum=64)
+            block = np.zeros((self.P, G), dtype=np.int32)
+            for p, (_, rslots) in lanes.items():
+                block[p, : len(rslots)] = rslots
+            gathered = self._gather_step(self.accs,
+                                         self._put_sharded(block))
+            g_host = self._harvest_get(list(gathered), "hot_fire")
+            # freed slots must hold identity before reuse (padded
+            # lanes target reserved slot 0: harmless)
+            self.accs = self._reset_step(self.accs,
+                                         self._put_sharded(block))
+            for p, (selp_hit, rslots) in lanes.items():
+                for i in range(len(leaves)):
+                    vals[i][selp_hit] = g_host[i][p][: len(rslots)]
+        # salted namespaces die with the fire: delta tombstones
+        self._freed_ns.append(pn[pn < 0])
+        out = [np.full(nh, l.identity, dtype=l.dtype) for l in leaves]
+        for i, l in enumerate(leaves):
+            # np.ufunc.at is unbuffered: repeated gids fold in ARRAY
+            # order — main first, salts ascending (the documented order)
+            HOST_COMBINE[l.reduce].at(out[i], gid, vals[i])
+        return out
+
+    def _expand_hot_query(self, keys_r, sids):
+        """Physical-row expansion for point lookups: every logical row
+        of a split key reads main + all salted sub-rows, folded back by
+        ``gid`` (index into the logical rows)."""
+        pk: List[int] = []
+        pn: List[int] = []
+        gid: List[int] = []
+        for j in range(len(keys_r)):
+            k, s = int(keys_r[j]), int(sids[j])
+            pk.append(k)
+            pn.append(s)
+            gid.append(j)
+            n = self._hot_keys.get(k)
+            if n and s >= 0:
+                salts = np.arange(n, dtype=np.int64)
+                pk.extend(_salted_keys(
+                    np.full(n, k, dtype=np.int64), salts).tolist())
+                pn.extend(_salted_ns(
+                    np.full(n, s, dtype=np.int64), salts).tolist())
+                gid.extend([j] * n)
+        return (np.asarray(pk, dtype=np.int64),
+                np.asarray(pn, dtype=np.int64),
+                np.asarray(gid, dtype=np.int64))
+
+    def _rep_publish_split(self, p, keys, nss):
+        """Serving-plane filter: salted sub-rows never publish (their
+        partials are meaningless alone); a hot key's MAIN rows publish
+        as COLD entries so replica lookups route through the live
+        engine's combined fold — a split key still answers ONE lookup."""
+        if not self._hot_keys:
+            return None
+        nss = np.asarray(nss, dtype=np.int64)
+        drop = nss < 0
+        coldm = np.isin(np.asarray(keys, dtype=np.int64),
+                        self._hot_key_array()) & ~drop
+        return drop, coldm
 
     # ------------------------------------------------------------------ fire
 
@@ -687,8 +995,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         chaos.fault_point("mesh.session_fire", sessions=len(keys))
         k_arr = np.asarray(keys, dtype=np.int64)
         sid_arr = np.asarray(sids, dtype=np.int64)
-        shards = shard_records(k_arr, self.P,
-            self.max_parallelism, self.key_group_range)
+        shards = self._route(k_arr)
         per_shard_sel: List[np.ndarray] = [
             np.nonzero(shards == p)[0] for p in range(self.P)]
         if self._paged:
@@ -798,6 +1105,21 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         n = len(k_arr)
         self._freed_ns.append(sid_arr)
         leaf_dtypes = [l.dtype for l in leaves]
+        # hot (split) sessions cannot finish on device — fold their
+        # physical rows on the host first and route the folded values
+        # through the cold host-finish below. The ORIGINAL per-shard
+        # selection keeps the output ordering; the loop skips hot rows.
+        per_shard_out = per_shard_sel
+        hot_pos = None
+        hot_vals = None
+        if self._hot_keys:
+            hmask = np.isin(k_arr, self._hot_key_array())
+            if hmask.any():
+                hot_pos = np.nonzero(hmask)[0]
+                hot_vals = self._fire_hot_fold(k_arr[hot_pos],
+                                               sid_arr[hot_pos])
+                self._hot_salted_fires += len(hot_pos)
+                per_shard_sel = [s[~hmask[s]] for s in per_shard_sel]
         res_pos: List[np.ndarray] = []   # positions fired on device
         res_slots: List[np.ndarray] = []
         cold_chunks: List[np.ndarray] = []  # positions fired from pages
@@ -876,6 +1198,12 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         # host finish over the COLD positions only (the resident
         # majority's finish already ran inside the device fire kernel)
         names = sorted(self.agg.output_names)
+        if hot_pos is not None:
+            # folded hot sessions finish with the cold rows (identical
+            # host finish; their values scatter back by position)
+            cold_chunks.append(hot_pos)
+            for i in range(len(leaves)):
+                cold_vals[i].append(hot_vals[i])
         if cold_chunks:
             cold_pos = np.concatenate(cold_chunks)
             finished = self.agg.finish(tuple(
@@ -885,7 +1213,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         else:
             cold_pos = None
             cold_out = {}
-        out_idx = np.concatenate([s for s in per_shard_sel if len(s)])
+        out_idx = np.concatenate([s for s in per_shard_out if len(s)])
         cols = {
             KEY_ID_FIELD: k_arr[out_idx],
             WINDOW_START_FIELD: st_arr[out_idx],
@@ -951,12 +1279,18 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         rr = np.asarray([t[0] for t in rows], dtype=np.int64)
         sids = np.asarray([t[1] for t in rows], dtype=np.int64)
         keys_r = key_ids[rr]
-        shards = shard_records(keys_r, self.P,
-                               self.max_parallelism, self.key_group_range)
+        if self._hot_keys:
+            # split keys read main + all salted sub-rows; gid folds the
+            # physical rows back to their logical row below
+            pk, pn, gid = self._expand_hot_query(keys_r, sids)
+        else:
+            pk, pn, gid = keys_r, sids, None
+        mp = len(pk)
+        shards = self._route(pk)
         leaves = self.agg.leaves
-        leaf_rows = [np.full(m, l.identity, dtype=l.dtype)
+        leaf_rows = [np.full(mp, l.identity, dtype=l.dtype)
                      for l in leaves]
-        have = np.zeros(m, dtype=bool)
+        have = np.zeros(mp, dtype=bool)
         lanes: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
         g_max = 0
         cold: Dict[int, np.ndarray] = {}
@@ -964,7 +1298,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             sel = np.nonzero(shards == p)[0]
             if not len(sel):
                 continue
-            slots = self.indexes[p].lookup(keys_r[sel], sids[sel])
+            slots = self.indexes[p].lookup(pk[sel], pn[sel])
             hit = slots >= 0
             if hit.any():
                 lanes[p] = (sel[hit], slots[hit].astype(np.int32))
@@ -998,9 +1332,22 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             read_spilled_rows(
                 self.spills[p],
                 self._pmaps[p] if self._paged else None, self._paged,
-                [(j, int(keys_r[j]), int(sids[j]))
+                [(j, int(pk[j]), int(pn[j]))
                  for j in sel_cold.tolist()],
                 _take_row)
+        if gid is not None:
+            from flink_tpu.ops.segment_ops import HOST_COMBINE
+
+            # fold physical rows into their logical row — array order
+            # is main first, salts ascending (the documented order);
+            # not-found rows hold identity and fold as no-ops
+            folded = [np.full(m, l.identity, dtype=l.dtype)
+                      for l in leaves]
+            for i, l in enumerate(leaves):
+                HOST_COMBINE[l.reduce].at(folded[i], gid, leaf_rows[i])
+            hv = np.zeros(m, dtype=bool)
+            np.logical_or.at(hv, gid, have)
+            leaf_rows, have = folded, hv
         # one host finish over every found row at once
         finished = self.agg.finish(tuple(leaf_rows))
         cols = {name: np.asarray(col) for name, col in finished.items()}
@@ -1016,7 +1363,12 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         """Same logical format as SessionWindower.snapshot — restorable
         across engines and mesh sizes (re-sharded by key group)."""
         if mode == "delta":
-            return {"table": self._snapshot_delta(), **self.meta.snapshot()}
+            out = {"table": self._snapshot_delta(),
+                   **self.meta.snapshot()}
+            if self._hot_keys:
+                out["hot_keys"] = {int(k): int(v)
+                                   for k, v in self._hot_keys.items()}
+            return out
         accs_host = jax.device_get(list(self.accs))  # ONE batched D2H
         parts = []
         for p in range(self.P):
@@ -1041,7 +1393,13 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             self._freed_ns.clear()
             for sp in self.spills:
                 sp.clear_dirty()
-        return {"table": merged, **self.meta.snapshot()}
+        out = {"table": merged, **self.meta.snapshot()}
+        if self._hot_keys:
+            # the salted rows above are physical state; the registry
+            # travels with them so a restore folds them correctly
+            out["hot_keys"] = {int(k): int(v)
+                               for k, v in self._hot_keys.items()}
+        return out
 
     def _snapshot_delta(self) -> Dict[str, np.ndarray]:
         """Dirty rows + freed-session tombstones (same format as
@@ -1107,6 +1465,12 @@ class MeshSessionEngine(MeshPagedSpillSupport):
         """Restore, re-sharding by key group — accepts single-device
         SessionWindower snapshots and mesh snapshots of any mesh size."""
         table = snap.get("table", {})
+        hk = snap.get("hot_keys")
+        if hk:
+            # the snapshot carries salted physical rows — the registry
+            # must be live BEFORE any fire/query folds them
+            for k, v in hk.items():
+                self._hot_keys[int(k)] = int(v)
         key_ids = np.asarray(table.get("key_id", []), dtype=np.int64)
         namespaces = np.asarray(table.get("namespace", []), dtype=np.int64)
         if len(key_ids):
@@ -1125,8 +1489,7 @@ class MeshSessionEngine(MeshPagedSpillSupport):
             else:
                 self._spill_restore_rows(key_ids, namespaces, leaves)
         elif len(key_ids):
-            shards = shard_records(key_ids, self.P,
-            self.max_parallelism, self.key_group_range)
+            shards = self._route(key_ids)
             # inserts first — growth must settle before the host copy
             # (same contract as MeshWindowEngine.restore)
             per_shard_slots: Dict[int, np.ndarray] = {}
@@ -1169,16 +1532,25 @@ class MeshSessionEngine(MeshPagedSpillSupport):
     def _filter_meta_snapshot(self, snap, groups):
         from flink_tpu.windowing.session_meta import SessionIntervalSet
 
-        return SessionIntervalSet.filter_snapshot(
+        out = SessionIntervalSet.filter_snapshot(
             snap, groups, self.max_parallelism)
+        hk = snap.get("hot_keys")
+        if hk:
+            # every unit carries the full split registry (tiny) — any
+            # subset of units restores with the folds intact
+            out["hot_keys"] = dict(hk)
+        return out
 
     def _merge_meta_snapshots(self, units):
         _NEG = -(1 << 62)
         sessions: Dict[int, list] = {}
+        hot: Dict[int, int] = {}
         for u in units:
             for k, ivs in u.get("sessions", {}).items():
                 sessions[int(k)] = list(ivs)  # ranges are disjoint
-        return {
+            for k, v in (u.get("hot_keys") or {}).items():
+                hot[int(k)] = max(hot.get(int(k), 0), int(v))
+        out = {
             "sessions": sessions,
             "next_sid": max((int(u.get("next_sid", 1)) for u in units),
                             default=1),
@@ -1188,6 +1560,9 @@ class MeshSessionEngine(MeshPagedSpillSupport):
                 (u.get("max_fired_watermark", _NEG) for u in units),
                 default=_NEG),
         }
+        if hot:
+            out["hot_keys"] = hot
+        return out
 
     # -------------------------------------------- native-plane degradation
 

@@ -113,6 +113,15 @@ class MeshSpillSupport:
     #: intra- vs cross-host row accounting for the two-level exchange
     #: (smoke vacuity guard + the NOTES traffic split)
     _exchange2_traffic = None
+    #: live non-contiguous shard->key-group assignment installed by
+    #: reassign_key_groups(); None = the contiguous formula (the common
+    #: case — every routing site goes through _route so a rebalanced
+    #: table threads the whole data plane without per-site branching)
+    _assignment = None
+    #: hot-range rebalances applied (counterpart of reshards_completed)
+    rebalances_completed: int = 0
+    #: report dict of the most recent reassign_key_groups()
+    last_rebalance = None
 
     @staticmethod
     def _check_shuffle_mode(mode: str) -> str:
@@ -120,6 +129,19 @@ class MeshSpillSupport:
             raise ValueError(
                 f"shuffle_mode must be 'host' or 'device', got {mode!r}")
         return mode
+
+    def _route(self, key_ids) -> np.ndarray:
+        """key id -> owning shard, THE engine routing decision: the
+        contiguous ``shard_records`` formula, or the live assignment
+        table after a hot-range rebalance. Every internal routing site
+        (ingest, merges, fires, queries, spill restore, checkpoint
+        restore, handoff redistribution) goes through here so an
+        installed table re-routes the whole data plane at once."""
+        if self._assignment is not None:
+            return self._assignment.shard_of_keys(
+                key_ids, self.max_parallelism).astype(np.int64)
+        return shard_records(key_ids, self.P, self.max_parallelism,
+                             self.key_group_range)
 
     def _set_host_topology(self, topology) -> None:
         if topology is not None:
@@ -339,6 +361,18 @@ class MeshSpillSupport:
         the slice end)."""
         return None
 
+    def _rep_publish_split(self, p: int, keys: np.ndarray,
+                           nss: np.ndarray):
+        """Hook: ``(drop_mask, cold_mask)`` over the publish upserts, or
+        None (default — publish everything resident). The session
+        engine's hot-key splitting uses it to keep PARTIAL rows out of
+        the serving index: salted sub-rows are dropped outright (their
+        synthetic keys are never looked up), and a split key's main row
+        is entered COLD so the lookup routes through ``cold_fetch`` to
+        the live engine's combined fold — a split key still answers one
+        lookup, with the full value."""
+        return None
+
     def _rep_probe_cold(self, p: int, keys: np.ndarray,
                         nss: np.ndarray) -> np.ndarray:
         """For pairs that left the resident set since the last publish:
@@ -440,6 +474,20 @@ class MeshSpillSupport:
                     # re-derivability — see below)
                 up_keys = cur_key[up].copy()
                 up_ns = cur_ns[up].copy()
+                split = self._rep_publish_split(p, up_keys, up_ns)
+                if split is not None:
+                    drop, coldm = split
+                    if coldm.any():
+                        cks, cns = up_keys[coldm], up_ns[coldm]
+                        cx = self._rep_extra(p, cks, cns)
+                        for j in range(len(cks)):
+                            cold.append((int(cks[j]), int(cns[j]),
+                                         None if cx is None else cx[j]))
+                    keep = ~(drop | coldm)
+                    if not keep.all():
+                        up = up[keep]
+                        up_keys = up_keys[keep]
+                        up_ns = up_ns[keep]
                 per_shard[p] = {
                     "up_slots": up.astype(np.int32),
                     "up_keys": up_keys,
@@ -455,6 +503,10 @@ class MeshSpillSupport:
                 for part in self._spill_snapshot_parts():
                     ck = np.asarray(part["key_id"], dtype=np.int64)
                     cn = np.asarray(part["namespace"], dtype=np.int64)
+                    split = self._rep_publish_split(0, ck, cn)
+                    if split is not None:
+                        keep = ~split[0]  # spilled rows are already cold
+                        ck, cn = ck[keep], cn[keep]
                     cx = self._rep_extra(0, ck, cn)
                     for j in range(len(ck)):
                         cold0.append((int(ck[j]), int(cn[j]),
@@ -784,8 +836,7 @@ class MeshSpillSupport:
         grouped by namespace and reload lazily on first access — a
         snapshot far larger than the HBM budget restores with bounded
         device memory (same contract as SlotTable.restore)."""
-        shards = shard_records(key_ids, self.P,
-            self.max_parallelism, self.key_group_range)
+        shards = self._route(key_ids)
         for p in range(self.P):
             mask = shards == p
             if not mask.any():
@@ -974,6 +1025,11 @@ class MeshSpillSupport:
                               from_shards=self.P, to_shards=new_shards)
             rows = self._collect_handoff()
             old_p = self.P
+            # a live rebalanced assignment is defined over the OLD shard
+            # count: changing P resets to the contiguous layout (the
+            # lifted rows re-route below; the rebalancer re-detects on
+            # the new mesh if the skew persists)
+            self._assignment = None
             self._rebuild_mesh_plane(new_shards, devices)
             # the hardest crash point: old state lifted, new plane empty
             # — recovery is restore-from-checkpoint (the engine object is
@@ -990,6 +1046,92 @@ class MeshSpillSupport:
             "seconds": time.perf_counter() - t0,
         }
         return self.last_reshard
+
+    def reassign_key_groups(self, assignment) -> Dict[str, object]:
+        """LIVE hot-range rebalance: move key groups BETWEEN shards at a
+        batch boundary without changing P — the skew response the
+        rescale path cannot provide (more shards under a hot range just
+        concentrates the same keys).
+
+        Same handoff discipline as :meth:`reshard` (drain fences ->
+        lift rows -> rebuild plane -> redistribute by the NEW routing),
+        with its own chaos fault point (``rebalance.handoff``) at the
+        same two stages. The full row lift is acceptable because the
+        rebalance policy's cooldown makes moves rare; the win is
+        steady-state throughput, not handoff latency.
+
+        NOT exception-atomic, like reshard: a crash mid-handoff is
+        recovered by checkpoint restore (the restoring engine routes by
+        ITS OWN assignment — snapshots are key-id addressed and carry no
+        assignment, so restore after a crash-at-commit is well-defined).
+        """
+        from flink_tpu.state.keygroups import KeyGroupAssignment
+
+        if not isinstance(assignment, KeyGroupAssignment):
+            raise TypeError(
+                f"expected KeyGroupAssignment, got {type(assignment).__name__}")
+        if assignment.num_shards != self.P:
+            raise ValueError(
+                f"assignment is for {assignment.num_shards} shards, "
+                f"engine has {self.P} — rebalance moves groups, "
+                "reshard() changes P")
+        if self.key_group_range is not None:
+            first = int(self.key_group_range[0])
+            span = int(self.key_group_range[1]) - first + 1
+        else:
+            first, span = 0, self.max_parallelism
+        if assignment.first != first or assignment.span != span:
+            raise ValueError(
+                f"assignment covers groups [{assignment.first}, "
+                f"{assignment.first + assignment.span - 1}], engine owns "
+                f"[{first}, {first + span - 1}]")
+        cur = self._assignment if self._assignment is not None else \
+            KeyGroupAssignment.contiguous(self.P, self.max_parallelism,
+                                          self.key_group_range)
+        moved = np.nonzero(assignment.table != cur.table)[0]
+        if len(moved) == 0:
+            return {"groups_moved": 0, "rows_moved": 0,
+                    "resident_rows": 0, "spilled_rows": 0,
+                    "seconds": 0.0, "noop": True}
+        t0 = time.perf_counter()
+        with flight.span("reshard.handoff"):
+            while self._dispatch_fences:
+                # flint: disable=TRC01 -- rebalance quiesce: the mesh
+                # plane is about to be torn down, every in-flight
+                # dispatch must land
+                self._dispatch_fences.popleft().block_until_ready()
+            chaos.fault_point("rebalance.handoff", stage="drain",
+                              groups_moved=len(moved))
+            rows = self._collect_handoff()
+            # install the table BEFORE redistribution: _route must send
+            # the lifted rows to their NEW owners
+            self._assignment = None if assignment.is_contiguous \
+                else assignment
+            self._rebuild_mesh_plane(self.P)
+            chaos.fault_point("rebalance.handoff", stage="commit",
+                              groups_moved=len(moved))
+            resident_rows, spilled_rows = self._redistribute_handoff(rows)
+        self.rebalances_completed += 1
+        self.last_rebalance = {
+            "groups_moved": int(len(moved)),
+            "rows_moved": int(len(rows["key_id"])),
+            "resident_rows": resident_rows,
+            "spilled_rows": spilled_rows,
+            "seconds": time.perf_counter() - t0,
+        }
+        return self.last_rebalance
+
+    @property
+    def key_group_assignment(self):
+        """The EFFECTIVE assignment (explicit table, or the contiguous
+        default) — what serving-side ``host_of_key_group`` routing must
+        follow after a rebalance."""
+        from flink_tpu.state.keygroups import KeyGroupAssignment
+
+        if self._assignment is not None:
+            return self._assignment
+        return KeyGroupAssignment.contiguous(
+            self.P, self.max_parallelism, self.key_group_range)
 
     def _collect_handoff(self, skip_shards=()) -> Dict[str, np.ndarray]:
         """Lift every logical row off the current mesh: key/namespace/
@@ -1172,8 +1314,7 @@ class MeshSpillSupport:
         if n == 0:
             return 0, 0
         paged = bool(getattr(self, "_paged", False))
-        shards = shard_records(keys, self.P,
-                               self.max_parallelism, self.key_group_range)
+        shards = self._route(keys)
         stay = rows["resident"].copy()
         if self._spill_active:
             # slot 0 is the reserved identity row — usable capacity is
@@ -1289,11 +1430,28 @@ class MeshSpillSupport:
         """GLOBAL ``(first, last)`` inclusive key groups per shard —
         the unit of failure/recovery, and the split shard-granular
         checkpoints key their units by (the exact inverse of
-        ``shard_records``' routing formula)."""
+        ``shard_records``' routing formula). Undefined under a live
+        rebalanced assignment (a shard's groups are no longer ONE
+        range) — use :meth:`shard_key_group_runs` there."""
         from flink_tpu.state.keygroups import shard_key_group_ranges
 
+        if self._assignment is not None:
+            raise ValueError(
+                "shard->key-group ownership is non-contiguous under a "
+                "live rebalanced assignment — shard_key_group_runs() "
+                "gives the per-run decomposition")
         return shard_key_group_ranges(self.P, self.max_parallelism,
                                       self.key_group_range)
+
+    def shard_key_group_runs(self) -> List[Tuple[int, int, int]]:
+        """GLOBAL ``(first, last, shard)`` maximal same-shard runs in
+        key-group order — the checkpoint-unit granularity that stays
+        well-defined under a rebalanced assignment (contiguous layout:
+        exactly one run per shard)."""
+        if self._assignment is not None:
+            return self._assignment.runs()
+        return [(g0, g1, p) for p, (g0, g1)
+                in enumerate(self.shard_key_groups())]
 
     def lose_shard(self, dead: int) -> Tuple[int, int]:
         """Simulated device loss of shard ``dead``: its resident plane
@@ -1322,6 +1480,12 @@ class MeshSpillSupport:
         construction — host-major layout), so the merged key-group
         span ``(first, last)`` returned covers exactly their units and
         the bounded replay is one contiguous range."""
+        if self._assignment is not None:
+            raise ValueError(
+                "partial failover under a live rebalanced assignment is "
+                "not supported: a dead shard's groups are no longer one "
+                "contiguous range, so the bounded contiguous replay "
+                "contract does not hold — whole-job restore applies")
         dead_set = sorted({int(d) for d in dead})
         if not dead_set:
             raise ValueError("no shards to lose")
@@ -1406,9 +1570,7 @@ class MeshSpillSupport:
                       for i in range(len(self.agg.leaves))]
             n_restored = int(len(key_ids))
         if n_restored:
-            shards = shard_records(key_ids, self.P,
-                                   self.max_parallelism,
-                                   self.key_group_range)
+            shards = self._route(key_ids)
             if getattr(self, "_paged", False):
                 from flink_tpu.state.paged_spill import (
                     restore_into_pages,
@@ -1508,7 +1670,11 @@ class MeshSpillSupport:
         table = snap.get("table", {}) or {}
         kg = np.asarray(table.get("key_group", ()), dtype=np.int64)
         units: Dict[Tuple[int, int], Dict[str, object]] = {}
-        for g0, g1 in self.shard_key_groups():
+        # one unit per maximal same-shard RUN: under the contiguous
+        # layout that is exactly one unit per shard (unchanged); under a
+        # rebalanced assignment a shard contributes one unit per run it
+        # owns, and the union of units is still exactly snapshot(mode)
+        for g0, g1, _p in self.shard_key_group_runs():
             if len(kg):
                 mask = (kg >= g0) & (kg <= g1)
                 unit_table = {
@@ -1847,8 +2013,7 @@ class MeshPagedSpillSupport(MeshSpillSupport):
         page-sized entries and reload lazily by page."""
         from flink_tpu.state.paged_spill import restore_into_pages
 
-        shards = shard_records(key_ids, self.P,
-            self.max_parallelism, self.key_group_range)
+        shards = self._route(key_ids)
         for p in range(self.P):
             mask = shards == p
             if not mask.any():
@@ -2031,8 +2196,7 @@ class MeshWindowEngine(MeshSpillSupport):
         if len(uniq_ns) <= 1:
             return None
         budget = max(self.max_device_slots // 2, 1024)
-        pshards = shard_records(pk, self.P,
-            self.max_parallelism, self.key_group_range)
+        pshards = self._route(pk)
         costs: Dict[int, int] = {}
         for ns in uniq_ns.tolist():
             ns = int(ns)
@@ -2091,8 +2255,7 @@ class MeshWindowEngine(MeshSpillSupport):
         self.book.register_slices(slice_ends)
 
         # route to owning shard, bucket into [P, B] blocks
-        shards = shard_records(key_ids, self.P,
-            self.max_parallelism, self.key_group_range)
+        shards = self._route(key_ids)
         from flink_tpu.runtime.local_agg import (
             is_partial_batch,
             partial_leaf_values,
@@ -2510,8 +2673,7 @@ class MeshWindowEngine(MeshSpillSupport):
         if n == 0:
             return []
         leaves = self.agg.leaves
-        shards = shard_records(key_ids, self.P,
-                               self.max_parallelism, self.key_group_range)
+        shards = self._route(key_ids)
         #: per request row: slice end -> per-leaf 1-element raw values
         slice_vals: List[Dict[int, Tuple[np.ndarray, ...]]] = [
             {} for _ in range(n)]
@@ -2701,8 +2863,7 @@ class MeshWindowEngine(MeshSpillSupport):
         if self._spill_active and len(key_ids):
             self._spill_restore_rows(key_ids, namespaces, leaves)
         elif len(key_ids):
-            shards = shard_records(key_ids, self.P,
-            self.max_parallelism, self.key_group_range)
+            shards = self._route(key_ids)
             # resolve ALL slots first: inserts may grow the table
             # (on_grow widens self.accs / self.capacity), so the host
             # copy must be taken only after growth has settled

@@ -175,6 +175,7 @@ def shard_records(
     num_shards: int,
     max_parallelism: int,
     key_group_range=None,
+    assignment=None,
 ) -> np.ndarray:
     """key id -> owning shard (the keyBy routing decision).
 
@@ -186,8 +187,15 @@ def shard_records(
     of the global key-group space and shards it across its private
     sub-mesh). The reference formula applied to the LOCAL group space —
     without the remap, a sub-range would collapse onto a couple of shards.
+
+    ``assignment``: a :class:`flink_tpu.state.KeyGroupAssignment` — the
+    explicit table a rebalanced plane routes by instead of the
+    contiguous formula. Subsumes ``key_group_range`` (an assignment
+    carries its own first/span).
     """
     groups = assign_key_groups(key_ids, max_parallelism)
+    if assignment is not None:
+        return assignment.shard_of_groups(groups).astype(np.int64)
     if key_group_range is not None:
         first, last = key_group_range
         local = (np.asarray(groups, dtype=np.int64) - int(first))

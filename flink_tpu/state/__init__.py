@@ -1,4 +1,5 @@
 from flink_tpu.state.keygroups import (
+    KeyGroupAssignment,
     KeyGroupRange,
     assign_key_groups,
     compute_key_group_range,
@@ -8,6 +9,7 @@ from flink_tpu.state.keygroups import (
 from flink_tpu.state.slot_table import SlotTable
 
 __all__ = [
+    "KeyGroupAssignment",
     "KeyGroupRange",
     "assign_key_groups",
     "compute_key_group_range",

@@ -190,15 +190,132 @@ def host_key_group_ranges(num_hosts: int, local_devices: int,
 
 
 def host_of_key_group(key_groups: np.ndarray, num_hosts: int,
-                      local_devices: int, max_parallelism: int
+                      local_devices: int, max_parallelism: int,
+                      assignment: "KeyGroupAssignment" = None
                       ) -> np.ndarray:
     """key group -> owning host, vectorized: the shard formula composed
-    with the host-major shard layout (``shard // local_devices``)."""
-    shard = key_group_to_operator_index(
-        key_groups, max_parallelism,
-        int(num_hosts) * int(local_devices))
+    with the host-major shard layout (``shard // local_devices``).
+
+    ``assignment`` (optional): a live :class:`KeyGroupAssignment` — when
+    the data plane has rebalanced hot ranges away from the contiguous
+    layout, serving-side routing must follow the same table or lookups
+    land on the wrong host."""
+    if assignment is not None:
+        shard = assignment.shard_of_groups(key_groups)
+    else:
+        shard = key_group_to_operator_index(
+            key_groups, max_parallelism,
+            int(num_hosts) * int(local_devices))
     return (np.asarray(shard, dtype=np.int64)
             // int(local_devices)).astype(np.int32)
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class KeyGroupAssignment:
+    """Explicit (possibly non-contiguous) shard -> key-group assignment.
+
+    Generalizes the reference's contiguous ``KeyGroupRange`` ownership
+    (one range per subtask, ``group * parallelism // max_parallelism``)
+    to an arbitrary table so a controller can move HOT ranges between
+    shards without changing parallelism. ``table[local_group]`` is the
+    owning shard for global group ``first + local_group``.
+
+    The default (:meth:`contiguous`) reproduces the routing formula in
+    ``parallel.shuffle.shard_records`` bit-for-bit, so threading an
+    assignment through the data plane is a no-op until a move happens.
+
+    Frozen + ``eq=False``: the ndarray field would break the generated
+    ``__eq__``; identity comparison is what engine code wants anyway.
+    Treat the table as immutable — constructors copy, mutators return
+    new instances.
+    """
+
+    first: int
+    num_shards: int
+    table: np.ndarray  # int32 [span]: local group -> shard
+
+    def __post_init__(self):
+        t = np.ascontiguousarray(self.table, dtype=np.int32)
+        if t.ndim != 1 or len(t) == 0:
+            raise ValueError("assignment table must be a non-empty 1-D array")
+        if int(self.num_shards) <= 0:
+            raise ValueError(f"num_shards must be positive, got {self.num_shards}")
+        if t.min() < 0 or t.max() >= int(self.num_shards):
+            raise ValueError(
+                f"assignment table values must be in [0, {self.num_shards}), "
+                f"got range [{t.min()}, {t.max()}]")
+        object.__setattr__(self, "table", t)
+        object.__setattr__(self, "first", int(self.first))
+        object.__setattr__(self, "num_shards", int(self.num_shards))
+
+    # ---- constructors -------------------------------------------------
+
+    @classmethod
+    def contiguous(cls, parallelism: int, max_parallelism: int,
+                   key_group_range=None) -> "KeyGroupAssignment":
+        """The default layout: identical to ``shard_records``'s formula
+        (including the local-space remap a sub-range engine applies)."""
+        if key_group_range is None:
+            first, span = 0, int(max_parallelism)
+        else:
+            first = int(key_group_range[0])
+            span = int(key_group_range[1]) - first + 1
+        local = np.arange(span, dtype=np.int64)
+        table = (local * int(parallelism) // span).astype(np.int32)
+        return cls(first=first, num_shards=int(parallelism), table=table)
+
+    def move(self, groups: Sequence, dst_shard: int) -> "KeyGroupAssignment":
+        """New assignment with GLOBAL ``groups`` reassigned to ``dst_shard``."""
+        g = np.asarray(groups, dtype=np.int64) - self.first
+        if len(g) and (g.min() < 0 or g.max() >= len(self.table)):
+            raise ValueError(f"groups out of range [{self.first}, "
+                             f"{self.first + len(self.table) - 1}]")
+        table = self.table.copy()
+        table[g] = np.int32(dst_shard)
+        return KeyGroupAssignment(self.first, self.num_shards, table)
+
+    # ---- routing ------------------------------------------------------
+
+    def shard_of_groups(self, key_groups: np.ndarray) -> np.ndarray:
+        """GLOBAL key group -> owning shard (vectorized table lookup)."""
+        g = np.asarray(key_groups, dtype=np.int64) - self.first
+        return self.table[g]
+
+    def shard_of_keys(self, key_ids: np.ndarray,
+                      max_parallelism: int) -> np.ndarray:
+        """key id -> owning shard: the murmur group spread composed with
+        the assignment table (replaces the contiguous formula)."""
+        return self.shard_of_groups(
+            assign_key_groups(key_ids, max_parallelism))
+
+    def groups_of_shard(self, shard: int) -> np.ndarray:
+        """GLOBAL key groups owned by ``shard`` (ascending)."""
+        return (np.nonzero(self.table == np.int32(shard))[0]
+                + self.first).astype(np.int64)
+
+    # ---- structure ----------------------------------------------------
+
+    @property
+    def span(self) -> int:
+        return len(self.table)
+
+    @property
+    def is_contiguous(self) -> bool:
+        """True iff the table equals the default contiguous layout."""
+        local = np.arange(len(self.table), dtype=np.int64)
+        expect = (local * self.num_shards // len(self.table)).astype(np.int32)
+        return bool(np.array_equal(self.table, expect))
+
+    def runs(self) -> List[tuple]:
+        """Maximal GLOBAL ``(first, last, shard)`` same-shard runs in
+        group order — the unit granularity for sharded checkpoints
+        under a non-contiguous layout (one unit per run)."""
+        t = self.table
+        cuts = np.nonzero(t[1:] != t[:-1])[0] + 1
+        starts = np.concatenate(([0], cuts))
+        ends = np.concatenate((cuts - 1, [len(t) - 1]))
+        return [(int(s) + self.first, int(e) + self.first, int(t[s]))
+                for s, e in zip(starts, ends)]
 
 
 def validate_max_parallelism(max_parallelism: int) -> None:

@@ -916,6 +916,40 @@ class TestRescaleHandoffPoint:
         # tests/test_autoscale.py's chaos crash test
 
 
+class TestRebalanceHandoffPoint:
+    """The skew rebalancer's fault point, injected at its real site
+    (MeshSpillSupport.reassign_key_groups — a key-group MOVE at
+    unchanged P) so the canonical inventory's reachability ledger
+    covers it in THIS suite too (the crash-at-commit crash-restore-
+    verify exercise lives in tests/test_autoscale.py)."""
+
+    def test_rebalance_commit_crash_at_real_site(self):
+        from flink_tpu.parallel.mesh import make_mesh
+        from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+        from flink_tpu.windowing.aggregates import SumAggregate
+
+        from tests.test_sessions import keyed_batch
+
+        eng = MeshSessionEngine(GAP, SumAggregate("v"), make_mesh(2),
+                                capacity_per_shard=1024)
+        eng.process_batch(keyed_batch([1, 2, 3], [1.0, 2.0, 3.0],
+                                      [0, 10, 20]))
+        cur = eng.key_group_assignment
+        moved = cur.move(
+            np.arange(cur.first, cur.first + cur.span // 2), 1)
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="rebalance.handoff", nth=1,
+                      where={"stage": "commit"})])
+        with chaos.chaos_active(plan, seed=0) as c:
+            with pytest.raises(InjectedFault):
+                eng.reassign_key_groups(moved)
+            assert c.faults_injected.get("rebalance.handoff", 0) == 1
+            _note_reached(c.faults_injected)
+        # commit crashed with the hot range's rows lifted: the engine
+        # is dead; recovery restores a contiguous engine and re-applies
+        # the move on replay (proven in tests/test_autoscale.py)
+
+
 class TestServingLookupPoint:
     """The serving plane's fault point, injected at its real site (the
     batched queryable-state lookup wrapped in run_recoverable): a

@@ -1,0 +1,265 @@
+"""Hot-key splitting (two-stage aggregation), pinned to oracles.
+
+One dominating key is salted into sub-keys pre-aggregated on their OWN
+shards as ordinary (salted-key, negative-namespace) rows; at fire and
+query time the sub-rows fold back into the main row in a fixed order
+(main first, then salts ascending). Everything downstream of the split
+must be indistinguishable from never having split: fires and queries
+bit-identical to the unsalted single-device oracle — mid-stream
+registration, forced paged eviction, snapshot/restore with LIVE salted
+rows, and a serving replica that still answers the split key in one
+lookup. The exactness gate (float sums reassociate) and the
+paged-layout requirement are pinned as errors.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.records import KEY_ID_FIELD, RecordBatch
+from flink_tpu.parallel.mesh import make_mesh
+from flink_tpu.parallel.sharded_sessions import (
+    MAX_SALTS,
+    MeshSessionEngine,
+)
+from flink_tpu.tenancy.replica import SessionReplicaAdapter
+from flink_tpu.windowing.aggregates import (
+    MaxAggregate,
+    MultiAggregate,
+    SumAggregate,
+)
+from flink_tpu.windowing.sessions import SessionWindower
+
+GAP = 100
+HOT = 7
+
+
+def keyed_batch(keys, vals, ts):
+    return RecordBatch.from_pydict(
+        {KEY_ID_FIELD: np.asarray(keys, dtype=np.int64),
+         "v": np.asarray(vals, dtype=np.float32)},
+        timestamps=np.asarray(ts, dtype=np.int64))
+
+
+def _skewed_stream(num_keys=4_000, n_steps=8, per_step=2_000, seed=13,
+                   hot_frac=0.5):
+    """~half the records carry the one hot key. Integer-valued float32
+    values: the salted sum fold stays exact, so assertions can demand
+    bit-identity rather than tolerance."""
+    rng = np.random.default_rng(seed)
+    steps = []
+    for s in range(n_steps):
+        keys = rng.integers(0, num_keys, per_step).astype(np.int64)
+        keys[rng.random(per_step) < hot_frac] = HOT
+        vals = rng.integers(1, 6, per_step).astype(np.float32)
+        ts = rng.integers(s * 80, s * 80 + 60, per_step).astype(np.int64)
+        steps.append((keys, vals, ts, (s - 1) * 80))
+    return steps
+
+
+def _engine(agg=None, **kw):
+    kw.setdefault("max_device_slots", 1024)
+    return MeshSessionEngine(GAP, agg or SumAggregate("v"), make_mesh(4),
+                             capacity_per_shard=1 << 14, **kw)
+
+
+def _drive(engine, steps, register_at=None, salts=8):
+    fired = []
+    for i, (keys, vals, ts, wm) in enumerate(steps):
+        if register_at is not None and i == register_at:
+            got = engine.register_hot_key(HOT, salts=salts,
+                                          allow_inexact=True)
+            assert got == max(2, min(salts, MAX_SALTS))
+        engine.process_batch(keyed_batch(keys, vals, ts))
+        fired.extend(engine.on_watermark(wm))
+    fired.extend(engine.on_watermark(1 << 60))
+    out = {}
+    for b in fired:
+        for r in b.to_rows():
+            out[(r[KEY_ID_FIELD], r["window_start"],
+                 r["window_end"])] = r[list(r)[-1]]
+    return out
+
+
+class TestSaltedFires:
+    def test_mid_stream_split_bit_identical_to_oracle(self):
+        """Salting registered at batch 2, with the hot key's session
+        ALREADY live (pre-salt rows on device) and paged eviction
+        forced — the fold-back must still reproduce the oracle bit for
+        bit, and the split must actually have engaged (non-vacuous:
+        salted records and salted fires both counted)."""
+        steps = _skewed_stream(num_keys=20_000, per_step=5_000)
+        eng = _engine()
+        got = _drive(eng, steps, register_at=2)
+        oracle = SessionWindower(GAP, SumAggregate("v"),
+                                 capacity=1 << 15)
+        expected = _drive(oracle, steps)
+        assert got == expected  # EXACT, not approx
+        stats = eng.hot_key_stats()
+        assert stats["keys"] == {HOT: 8}
+        assert stats["salted_records"] > 1_000
+        assert stats["salted_fires"] > 0
+        assert eng.spill_counters()["pages_evicted"] > 0
+
+    def test_max_aggregate_splits_exactly_without_flag(self):
+        """min/max commute: no allow_inexact needed, still exact."""
+        steps = _skewed_stream(seed=29, n_steps=5)
+        eng = _engine(agg=MaxAggregate("v"))
+        fired = []
+        for i, (keys, vals, ts, wm) in enumerate(steps):
+            if i == 1:
+                eng.register_hot_key(HOT, salts=6)  # no flag needed
+            eng.process_batch(keyed_batch(keys, vals, ts))
+            fired.extend(eng.on_watermark(wm))
+        fired.extend(eng.on_watermark(1 << 60))
+        oracle = SessionWindower(GAP, MaxAggregate("v"),
+                                 capacity=1 << 15)
+        got = {}
+        for b in fired:
+            for r in b.to_rows():
+                got[(r[KEY_ID_FIELD], r["window_start"],
+                     r["window_end"])] = r["max_v"]
+        assert got == _drive(oracle, steps)
+        assert eng.hot_key_stats()["salted_fires"] > 0
+
+    def test_multi_leaf_aggregate_splits_exactly(self):
+        steps = _skewed_stream(seed=41, n_steps=5)
+        agg = MultiAggregate([SumAggregate("v"), MaxAggregate("v")])
+        eng = _engine(agg=agg)
+        fired = []
+        for i, (keys, vals, ts, wm) in enumerate(steps):
+            if i == 1:
+                eng.register_hot_key(HOT, salts=4, allow_inexact=True)
+            eng.process_batch(keyed_batch(keys, vals, ts))
+            fired.extend(eng.on_watermark(wm))
+        fired.extend(eng.on_watermark(1 << 60))
+        oracle = SessionWindower(
+            GAP, MultiAggregate([SumAggregate("v"), MaxAggregate("v")]),
+            capacity=1 << 15)
+        ofired = []
+        for keys, vals, ts, wm in steps:
+            oracle.process_batch(keyed_batch(keys, vals, ts))
+            ofired.extend(oracle.on_watermark(wm))
+        ofired.extend(oracle.on_watermark(1 << 60))
+
+        def rows(bs):
+            return sorted(
+                (r[KEY_ID_FIELD], r["window_start"], r["window_end"],
+                 r["sum_v"], r["max_v"])
+                for b in bs for r in b.to_rows())
+
+        assert rows(fired) == rows(ofired)
+
+
+class TestSaltedQueries:
+    def test_query_batch_combines_split_rows(self):
+        """One query_batch call answers the split key: the engine folds
+        main + salt rows before agg.finish — same numbers the oracle's
+        query path produces, salted rows invisible to the caller."""
+        steps = _skewed_stream(seed=53, n_steps=4)
+        eng = _engine()
+        oracle = SessionWindower(GAP, SumAggregate("v"),
+                                 capacity=1 << 15)
+        for i, (keys, vals, ts, wm) in enumerate(steps):
+            if i == 1:
+                eng.register_hot_key(HOT, salts=8, allow_inexact=True)
+            eng.process_batch(keyed_batch(keys, vals, ts))
+            eng.on_watermark(wm)
+            oracle.process_batch(keyed_batch(keys, vals, ts))
+            oracle.on_watermark(wm)
+        assert eng.hot_key_stats()["salted_records"] > 0
+        qk = np.array([HOT, 0, 1, 2, 999, 10 ** 9], dtype=np.int64)
+        assert eng.query_batch(qk) == oracle.query_sessions_batch(qk)
+
+
+class TestSaltedPersistence:
+    def test_snapshot_restore_with_live_salted_rows(self):
+        """Crash mid-split: the snapshot carries the salted rows (they
+        are ordinary table rows) AND the hot-key registry; the restored
+        engine keeps salting and finishes bit-identical."""
+        steps = _skewed_stream(seed=67)
+        cut = 4
+        eng = _engine()
+        fired = []
+        for i, (keys, vals, ts, wm) in enumerate(steps[:cut]):
+            if i == 1:
+                eng.register_hot_key(HOT, salts=5, allow_inexact=True)
+            eng.process_batch(keyed_batch(keys, vals, ts))
+            fired.extend(eng.on_watermark(wm))
+        assert eng.hot_key_stats()["salted_records"] > 0
+        snap = eng.snapshot(mode="savepoint")
+        fresh = _engine()
+        fresh.restore(snap)
+        # the registry travelled: the fresh engine keeps splitting
+        assert fresh.hot_key_stats()["keys"] == {HOT: 5}
+        for keys, vals, ts, wm in steps[cut:]:
+            fresh.process_batch(keyed_batch(keys, vals, ts))
+            fired.extend(fresh.on_watermark(wm))
+        fired.extend(fresh.on_watermark(1 << 60))
+        got = {}
+        for b in fired:
+            for r in b.to_rows():
+                got[(r[KEY_ID_FIELD], r["window_start"],
+                     r["window_end"])] = r["sum_v"]
+        oracle = SessionWindower(GAP, SumAggregate("v"),
+                                 capacity=1 << 15)
+        assert got == _drive(oracle, steps)
+        assert fresh.hot_key_stats()["salted_records"] > 0
+
+    def test_unit_snapshots_carry_the_registry(self):
+        steps = _skewed_stream(seed=79, n_steps=3)
+        eng = _engine()
+        for i, (keys, vals, ts, wm) in enumerate(steps):
+            if i == 1:
+                eng.register_hot_key(HOT, salts=3, allow_inexact=True)
+            eng.process_batch(keyed_batch(keys, vals, ts))
+            eng.on_watermark(wm)
+        units = eng.snapshot_sharded(mode="savepoint")
+        # every unit carries the full registry (any unit subset must be
+        # able to re-arm splitting on restore)
+        for u in units.values():
+            assert u.get("hot_keys") == {HOT: 3}
+        merged = eng.merge_unit_snapshots(list(units.values()))
+        assert merged.get("hot_keys") == {HOT: 3}
+
+
+class TestServingSplitKey:
+    def test_replica_answers_split_key_in_one_lookup(self):
+        """The split key's sub-rows never reach the published replica
+        plane — its single published entry routes the lookup through
+        the live combined fold, so ONE lookup_batch call still answers
+        it, bit-identical to the live query."""
+        steps = _skewed_stream(seed=91, n_steps=5)
+        eng = _engine()
+        plane = eng.arm_replica()
+        for i, (keys, vals, ts, wm) in enumerate(steps):
+            if i == 1:
+                eng.register_hot_key(HOT, salts=8, allow_inexact=True)
+            eng.process_batch(keyed_batch(keys, vals, ts))
+            eng.on_watermark(wm)
+        ad = SessionReplicaAdapter(plane, eng.agg)
+        ad.cold_fetch = lambda ks: eng.query_batch(
+            np.asarray(ks, dtype=np.int64))
+        qk = [HOT, 0, 1, 2, 3]
+        repl, _gen = ad.lookup_batch(qk)
+        assert repl == eng.query_batch(np.asarray(qk, dtype=np.int64))
+        # the hot key was served through the cold (live-fold) route
+        assert plane.cold_rows_served > 0
+
+
+class TestSplitGuards:
+    def test_float_sum_requires_allow_inexact(self):
+        eng = _engine()
+        with pytest.raises(ValueError, match="allow_inexact"):
+            eng.register_hot_key(HOT, salts=8)
+        assert eng.hot_key_stats()["keys"] == {}
+
+    def test_requires_paged_layout(self):
+        eng = MeshSessionEngine(GAP, SumAggregate("v"), make_mesh(4),
+                                capacity_per_shard=1 << 14)
+        with pytest.raises(ValueError, match="paged"):
+            eng.register_hot_key(HOT, salts=8, allow_inexact=True)
+
+    def test_salt_count_clamped(self):
+        eng = _engine(agg=MaxAggregate("v"))
+        assert eng.register_hot_key(HOT, salts=1) == 2
+        assert eng.register_hot_key(HOT, salts=10 ** 6) == MAX_SALTS

@@ -1,10 +1,13 @@
 import numpy as np
+import pytest
 
 from flink_tpu.state.keygroups import (
+    KeyGroupAssignment,
     assign_key_groups,
     all_ranges,
     compute_key_group_range,
     hash_keys_to_i64,
+    host_of_key_group,
     key_group_to_operator_index,
     murmur_fmix32,
 )
@@ -58,3 +61,70 @@ def test_hash_keys_stable_for_strings():
 def test_hash_keys_ints_passthrough():
     k = np.array([5, -3, 5], dtype=np.int64)
     np.testing.assert_array_equal(hash_keys_to_i64(k), k)
+
+
+# ---------------------------------------------------------------------------
+# KeyGroupAssignment — explicit (possibly non-contiguous) routing table
+# ---------------------------------------------------------------------------
+
+
+def test_contiguous_assignment_matches_shard_records():
+    """The default table IS the reference formula — threading an
+    assignment through the data plane must be a routing no-op until a
+    move happens. Bit-for-bit, full-range and sub-range."""
+    from flink_tpu.parallel.shuffle import shard_records
+
+    keys = np.arange(20_000, dtype=np.int64) * 977
+    a = KeyGroupAssignment.contiguous(8, 128)
+    np.testing.assert_array_equal(
+        a.shard_of_keys(keys, 128), shard_records(keys, 8, 128))
+    assert a.is_contiguous
+    # sub-range engine (mesh x stage composition)
+    sub = KeyGroupAssignment.contiguous(4, 128, (32, 63))
+    groups = assign_key_groups(keys, 128)
+    sel = (groups >= 32) & (groups <= 63)
+    np.testing.assert_array_equal(
+        sub.shard_of_keys(keys[sel], 128),
+        shard_records(keys[sel], 4, 128, key_group_range=(32, 63)))
+
+
+def test_move_runs_and_contiguity():
+    a = KeyGroupAssignment.contiguous(4, 16)
+    assert a.span == 16 and a.is_contiguous
+    assert a.runs() == [(0, 3, 0), (4, 7, 1), (8, 11, 2), (12, 15, 3)]
+    b = a.move([1, 2], 3)
+    # immutably derived: the original is untouched
+    assert a.is_contiguous and not b.is_contiguous
+    assert b.runs() == [(0, 0, 0), (1, 2, 3), (3, 3, 0), (4, 7, 1),
+                        (8, 11, 2), (12, 15, 3)]
+    np.testing.assert_array_equal(b.groups_of_shard(3),
+                                  [1, 2, 12, 13, 14, 15])
+    np.testing.assert_array_equal(b.shard_of_groups([0, 1, 2, 3]),
+                                  [0, 3, 3, 0])
+
+
+def test_assignment_validation():
+    with pytest.raises(ValueError):
+        KeyGroupAssignment(0, 4, np.array([], dtype=np.int32))
+    with pytest.raises(ValueError):
+        KeyGroupAssignment(0, 4, np.array([0, 4], dtype=np.int32))
+    with pytest.raises(ValueError):
+        KeyGroupAssignment(0, 0, np.array([0], dtype=np.int32))
+    a = KeyGroupAssignment.contiguous(4, 16)
+    with pytest.raises(ValueError):
+        a.move([16], 0)  # out of the global range
+
+
+def test_host_of_key_group_follows_assignment():
+    """Serving-side host routing must track the live table — a moved
+    group's lookups land on the mover's host."""
+    mp, hosts, local = 32, 2, 2
+    groups = np.arange(mp)
+    base = host_of_key_group(groups, hosts, local, mp)
+    a = KeyGroupAssignment.contiguous(hosts * local, mp)
+    np.testing.assert_array_equal(
+        base, host_of_key_group(groups, hosts, local, mp, assignment=a))
+    moved = a.move([0], hosts * local - 1)  # shard 3 -> host 1
+    routed = host_of_key_group(groups, hosts, local, mp, assignment=moved)
+    assert routed[0] == 1 and base[0] == 0
+    np.testing.assert_array_equal(routed[1:], base[1:])

@@ -1,0 +1,301 @@
+"""Live key-group rebalancing on the mesh engines, pinned to oracles.
+
+The moves happen MID-STREAM with state live and paged spill under
+forced eviction (1024 device slots vs thousands of live keys), and
+every run must stay row-for-row identical to the never-rebalanced
+single-device windower: the assignment table is pure routing — WHERE
+state lives — and must never change WHAT is computed. Also pinned:
+sharded checkpoints under a non-contiguous layout (one unit per
+same-shard run) merge back losslessly and restore contiguous, a
+subsequent reshard() resets the table, and the SkewResponder closes
+the detect -> rebalance -> split loop end-to-end on a skewed stream.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.autoscale import RebalancePolicy, SkewResponder
+from flink_tpu.core.records import KEY_ID_FIELD, RecordBatch
+from flink_tpu.parallel.load import ShardLoadAccountant
+from flink_tpu.parallel.mesh import make_mesh
+from flink_tpu.state.keygroups import KeyGroupAssignment
+from flink_tpu.windowing.aggregates import SumAggregate
+from flink_tpu.windowing.assigners import TumblingEventTimeWindows
+from flink_tpu.windowing.sessions import SessionWindower
+from flink_tpu.windowing.windower import SliceSharedWindower
+
+GAP = 100
+
+
+def keyed_batch(keys, vals, ts):
+    return RecordBatch.from_pydict(
+        {KEY_ID_FIELD: np.asarray(keys, dtype=np.int64),
+         "v": np.asarray(vals, dtype=np.float32)},
+        timestamps=np.asarray(ts, dtype=np.int64))
+
+
+def _stream(num_keys=6_000, n_steps=8, per_step=2_500, seed=31,
+            hot_frac=0.0, hot_key=7):
+    """Optionally skewed: ``hot_frac`` of each step's records carry one
+    key. Values are integer-valued float32 so float sums stay exact —
+    bit-identity assertions remain meaningful through salting."""
+    rng = np.random.default_rng(seed)
+    steps = []
+    for s in range(n_steps):
+        keys = rng.integers(0, num_keys, per_step).astype(np.int64)
+        if hot_frac:
+            hot = rng.random(per_step) < hot_frac
+            keys[hot] = hot_key
+        vals = rng.integers(1, 6, per_step).astype(np.float32)
+        ts = rng.integers(s * 80, s * 80 + 60, per_step).astype(np.int64)
+        steps.append((keys, vals, ts, (s - 1) * 80))
+    return steps
+
+
+def _run(engine, steps, rebalances=None, on_step=None):
+    """Drive steps; rebalances = {step index -> fn(engine) -> assignment}
+    applied BEFORE that step (mid-stream, state live)."""
+    fired = []
+    for i, (keys, vals, ts, wm) in enumerate(steps):
+        if rebalances and i in rebalances:
+            rep = engine.reassign_key_groups(rebalances[i](engine))
+            assert rep["groups_moved"] > 0 and rep["rows_moved"] > 0
+        engine.process_batch(keyed_batch(keys, vals, ts))
+        fired.extend(engine.on_watermark(wm))
+        if on_step is not None:
+            on_step(i, keys)
+    fired.extend(engine.on_watermark(1 << 60))
+    out = {}
+    for b in fired:
+        for r in b.to_rows():
+            out[(r[KEY_ID_FIELD], r["window_start"],
+                 r["window_end"])] = r["sum_v"]
+    return out
+
+
+def _assert_equal(got, expected):
+    assert len(expected) > 0
+    assert set(got) == set(expected)
+    for k in expected:
+        assert got[k] == pytest.approx(expected[k], rel=1e-4,
+                                       abs=1e-3), k
+
+
+def _session_engine(mesh, **kw):
+    from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+
+    return MeshSessionEngine(GAP, SumAggregate("v"), mesh,
+                             capacity_per_shard=1 << 14, **kw)
+
+
+def _window_engine(mesh, **kw):
+    from flink_tpu.parallel.sharded_windower import MeshWindowEngine
+
+    return MeshWindowEngine(TumblingEventTimeWindows.of(100),
+                            SumAggregate("v"), mesh,
+                            capacity_per_shard=1 << 14, **kw)
+
+
+def _move_half_of_shard(src, dst):
+    """fn(engine) -> assignment moving half of ``src``'s groups to
+    ``dst`` — derived from the engine's CURRENT table so two moves
+    compose."""
+    def fn(engine):
+        cur = engine.key_group_assignment
+        groups = cur.groups_of_shard(src)
+        assert len(groups) > 1
+        return cur.move(groups[: len(groups) // 2], dst)
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# mid-stream moves: oracle equivalence under forced paged eviction
+# ---------------------------------------------------------------------------
+
+
+class TestRebalanceOracle:
+    def test_session_engine_two_moves_paged(self):
+        """Two composed mid-stream rebalances (4-shard mesh, 1024
+        device slots vs ~6k live sessions: resident AND paged rows
+        move), bit-identical to the single-device oracle."""
+        steps = _stream()
+        eng = _session_engine(make_mesh(4), max_device_slots=1024)
+        oracle = SessionWindower(GAP, SumAggregate("v"), capacity=1 << 15)
+        got = _run(eng, steps, rebalances={
+            3: _move_half_of_shard(0, 2),
+            6: _move_half_of_shard(1, 3),
+        })
+        _assert_equal(got, _run(oracle, steps))
+        assert eng.rebalances_completed == 2
+        assert not eng.key_group_assignment.is_contiguous
+        # the non-contiguous layout decomposes into more runs than
+        # shards — the checkpoint-unit granularity follows the table
+        assert len(eng.shard_key_group_runs()) > eng.P
+        assert eng.last_rebalance["rows_moved"] > 0
+        c = eng.spill_counters()
+        assert c["pages_evicted"] > 0 and c["pages_reloaded"] > 0
+
+    def test_window_engine_two_moves(self):
+        steps = _stream(seed=43)
+        eng = _window_engine(make_mesh(4), max_device_slots=1024)
+        oracle = SliceSharedWindower(TumblingEventTimeWindows.of(100),
+                                     SumAggregate("v"), capacity=1 << 15)
+        got = _run(eng, steps, rebalances={
+            2: _move_half_of_shard(0, 3),
+            5: _move_half_of_shard(2, 1),
+        })
+        _assert_equal(got, _run(oracle, steps))
+        assert eng.rebalances_completed == 2
+        assert not eng.key_group_assignment.is_contiguous
+
+    def test_reshard_after_rebalance_resets_to_contiguous(self):
+        """reshard() changes P: the old table is meaningless for the
+        new shard count, so the handoff re-routes by the contiguous
+        formula — and the stream still matches the oracle."""
+        steps = _stream(seed=57)
+        eng = _session_engine(make_mesh(4), max_device_slots=1024)
+        oracle = SessionWindower(GAP, SumAggregate("v"), capacity=1 << 15)
+        fired = []
+        for i, (keys, vals, ts, wm) in enumerate(steps):
+            if i == 2:
+                eng.reassign_key_groups(
+                    _move_half_of_shard(0, 2)(eng))
+                assert not eng.key_group_assignment.is_contiguous
+            if i == 5:
+                eng.reshard(8)
+                assert eng.key_group_assignment.is_contiguous
+            eng.process_batch(keyed_batch(keys, vals, ts))
+            fired.extend(eng.on_watermark(wm))
+        fired.extend(eng.on_watermark(1 << 60))
+        got = {}
+        for b in fired:
+            for r in b.to_rows():
+                got[(r[KEY_ID_FIELD], r["window_start"],
+                     r["window_end"])] = r["sum_v"]
+        _assert_equal(got, _run(oracle, steps))
+        assert eng.P == 8
+
+    def test_noop_and_validation(self):
+        eng = _session_engine(make_mesh(4))
+        cur = eng.key_group_assignment
+        rep = eng.reassign_key_groups(cur)  # identical table: no-op
+        assert rep["groups_moved"] == 0 and rep.get("noop")
+        assert eng.rebalances_completed == 0
+        with pytest.raises(TypeError):
+            eng.reassign_key_groups("not-an-assignment")
+        with pytest.raises(ValueError):
+            # rebalance moves groups; changing P is reshard()'s job
+            eng.reassign_key_groups(
+                KeyGroupAssignment.contiguous(8, eng.max_parallelism))
+
+    def test_partial_failover_refused_under_live_assignment(self):
+        """A dead shard's groups are no longer one contiguous range
+        under a live table — the bounded-replay contract is gone, so
+        lose_shards must refuse (whole-job restore applies)."""
+        steps = _stream(n_steps=2)
+        eng = _session_engine(make_mesh(4), max_device_slots=1024)
+        for keys, vals, ts, wm in steps:
+            eng.process_batch(keyed_batch(keys, vals, ts))
+        eng.reassign_key_groups(_move_half_of_shard(0, 2)(eng))
+        with pytest.raises(ValueError, match="rebalanced"):
+            eng.lose_shard(1)
+        with pytest.raises(ValueError, match="non-contiguous"):
+            eng.shard_key_groups()
+
+
+# ---------------------------------------------------------------------------
+# sharded checkpoints under a non-contiguous table
+# ---------------------------------------------------------------------------
+
+
+class TestRebalancedCheckpointRoundTrip:
+    def test_units_follow_runs_merge_and_restore_contiguous(self):
+        """Mid-stream: rebalance, snapshot per-unit (one unit per
+        same-shard RUN), merge, restore into a FRESH engine — which
+        comes back on the contiguous layout (the assignment is runtime
+        routing state, never checkpointed) — and both the original and
+        the restored engine finish the stream oracle-identical."""
+        steps = _stream(seed=71, n_steps=8)
+        eng = _session_engine(make_mesh(4), max_device_slots=1024)
+        oracle = SessionWindower(GAP, SumAggregate("v"), capacity=1 << 15)
+        cut = 5
+        fired = []
+        for i, (keys, vals, ts, wm) in enumerate(steps[:cut]):
+            if i == 3:
+                eng.reassign_key_groups(_move_half_of_shard(1, 3)(eng))
+            eng.process_batch(keyed_batch(keys, vals, ts))
+            fired.extend(eng.on_watermark(wm))
+        # unit keys are the maximal same-shard runs of the LIVE table
+        units = eng.snapshot_sharded(mode="savepoint")
+        runs = eng.shard_key_group_runs()
+        assert set(units) == {(g0, g1) for g0, g1, _p in runs}
+        assert len(units) > eng.P  # non-contiguous: more runs than shards
+        merged = eng.merge_unit_snapshots(list(units.values()))
+        # restored engine: contiguous routing, same logical state
+        fresh = _session_engine(make_mesh(4), max_device_slots=1024)
+        fresh.restore(merged)
+        assert fresh.key_group_assignment.is_contiguous
+        fresh_fired = list(fired)
+        for eng2, acc in ((eng, fired), (fresh, fresh_fired)):
+            for keys, vals, ts, wm in steps[cut:]:
+                eng2.process_batch(keyed_batch(keys, vals, ts))
+                acc.extend(eng2.on_watermark(wm))
+            acc.extend(eng2.on_watermark(1 << 60))
+
+        def to_map(batches):
+            out = {}
+            for b in batches:
+                for r in b.to_rows():
+                    out[(r[KEY_ID_FIELD], r["window_start"],
+                         r["window_end"])] = r["sum_v"]
+            return out
+
+        expected = _run(oracle, steps)
+        _assert_equal(to_map(fired), expected)
+        _assert_equal(to_map(fresh_fired), expected)
+
+
+# ---------------------------------------------------------------------------
+# SkewResponder: the loop closed end-to-end on a live engine
+# ---------------------------------------------------------------------------
+
+
+class TestSkewResponderEndToEnd:
+    def test_detect_rebalance_split_on_skewed_stream(self):
+        """40% of all records carry ONE key: the accountant detects it,
+        the policy plans moves AND flags the dominant key, the
+        responder applies both to the live engine — and the output is
+        still bit-identical to the oracle (integer-valued float sums
+        stay exact through salting)."""
+        class Clock:
+            t = 0.0
+
+            def __call__(self):
+                return self.t
+
+        clk = Clock()
+        steps = _stream(seed=83, hot_frac=0.4, hot_key=7)
+        eng = _session_engine(make_mesh(4), max_device_slots=1024)
+        acc = ShardLoadAccountant(eng.P, eng.max_parallelism,
+                                  ewma_alpha=0.5, clock=clk)
+        resp = SkewResponder(
+            eng, acc,
+            policy=RebalancePolicy(imbalance_trigger=1.3, hysteresis=0.02,
+                                   cooldown_s=0.0, clock=clk),
+            salts=8, hot_key_share=0.5, allow_inexact=True)
+
+        def on_step(_i, keys):
+            clk.t += 1.0
+            resp.note_batch(keys)
+            acc.tick()
+            resp.maybe_respond(now=clk.t)
+
+        got = _run(eng, steps, on_step=on_step)
+        oracle = SessionWindower(GAP, SumAggregate("v"), capacity=1 << 15)
+        _assert_equal(got, _run(oracle, steps))
+        # every stage of the ladder actually fired
+        assert resp.rebalances >= 1 and resp.groups_moved >= 1
+        assert resp.keys_split >= 1 and 7 in eng._hot_keys
+        stats = eng.hot_key_stats()
+        assert stats["salted_records"] > 0 and stats["salted_fires"] > 0
+        assert eng.rebalances_completed == resp.rebalances

@@ -72,6 +72,23 @@ Regression gates:
 tools/tier1.sh pins all three.
 
     BENCH_SKIP_PROBE=1 JAX_PLATFORMS=cpu python tools/bench_mesh_sessions.py
+
+Zipf mode (``--zipf`` or ``BENCH_MESH_ZIPF=1``): the same shape with the
+key column drawn Zipf(``BENCH_MESH_ZIPF_S``, default 1.1) over the 10M
+key space instead of uniform — a handful of keys carry most of the
+stream, so the contiguous key-group layout pins one shard at the hot
+groups while the others idle. The driver wires the SKEW-ADAPTIVE plane
+(``parallel/load.ShardLoadAccountant`` ->
+``autoscale/rebalance.RebalancePolicy`` -> ``SkewResponder``): per-batch
+load accounting, live key-group MOVES between shards at batch
+boundaries (``reassign_key_groups``, P unchanged), and two-stage
+HOT-KEY SPLITTING (``register_hot_key``: salted sub-rows pre-aggregated
+on their own shards, folded back at fire). The row reports the zipf
+throughput, a 1-pass UNIFORM control, and their ratio
+(``skew_recovery_fraction``) plus the responder counters; with
+``BENCH_SKEW_RECOVERY`` set it FAILS when the ratio drops below the
+budget or when the run was vacuous (no live move, nothing salted) —
+a green that never rebalanced measures nothing.
 """
 
 import json
@@ -95,8 +112,12 @@ BUDGET_PER_SHARD = 1 << 16  # x8 shards = the row-5 512k total budget
 MAX_PENDING_FIRES = 8
 
 
-def run(total: int, mesh, batch: int = 1 << 16):
-    """One pass; returns (events/s, fired, counters, breakdown)."""
+def run(total: int, mesh, batch: int = 1 << 16, zipf: float = 0.0,
+        respond: bool = False):
+    """One pass; returns (events/s, fired, counters, breakdown,
+    fire_latency, skew). ``zipf`` > 0 draws the key column
+    Zipf-distributed; ``respond`` wires the skew-adaptive plane
+    (load accounting -> live group moves -> hot-key splitting)."""
     import gc
     from collections import deque
 
@@ -124,6 +145,26 @@ def run(total: int, mesh, batch: int = 1 << 16):
                                 "BENCH_MESH_SHUFFLE_MODE", "device"))
     deadline_s = float(os.environ.get(
         "BENCH_MESH_FIRE_DEADLINE_MS", "25")) / 1000.0
+    responder = None
+    if respond:
+        from flink_tpu.autoscale import RebalancePolicy, SkewResponder
+        from flink_tpu.parallel.load import ShardLoadAccountant
+
+        # a 10M-key Zipf tail constantly decrements a small Misra-Gries
+        # sketch (estimate >= true - N/(top_k+1)): 64 counters keep the
+        # dominant keys' share estimates above the split threshold
+        acc = ShardLoadAccountant(eng.P, eng.max_parallelism,
+                                  ewma_alpha=0.5,
+                                  top_k=int(os.environ.get(
+                                      "BENCH_SKEW_TOPK", "64")))
+        responder = SkewResponder(
+            eng, acc,
+            policy=RebalancePolicy(
+                imbalance_trigger=float(os.environ.get(
+                    "BENCH_SKEW_TRIGGER", "1.25")),
+                hysteresis=0.05, cooldown_s=2.0, max_moves=16),
+            salts=int(os.environ.get("BENCH_SKEW_SALTS", "16")),
+            hot_key_share=0.5, allow_inexact=True)
     rng = np.random.default_rng(3)
     produced = 0
     fired = 0
@@ -156,7 +197,14 @@ def run(total: int, mesh, batch: int = 1 << 16):
         t0 = time.perf_counter()
         while produced < total:
             b = min(batch, total - produced)
-            keys = rng.integers(0, NUM_KEYS, b).astype(np.int64)
+            if zipf > 0:
+                # heavy-tailed keys: a handful of ranks carry most of
+                # the stream — the shape the contiguous layout cannot
+                # balance and the responder exists to fix
+                keys = ((rng.zipf(zipf, b) - 1) % NUM_KEYS).astype(
+                    np.int64)
+            else:
+                keys = rng.integers(0, NUM_KEYS, b).astype(np.int64)
             ts = ((produced + np.arange(b, dtype=np.int64)) * 1000
                   // EVENTS_PER_S_OF_EVENTTIME)
             # fire-deadline-aware micro-batching: ingest splits are sized a
@@ -205,7 +253,13 @@ def run(total: int, mesh, batch: int = 1 << 16):
                     harvest()
                 step_rate = (z - a) / max(t2 - t1, 1e-9)
                 rate = step_rate if rate <= 0 else 0.7 * rate + 0.3 * step_rate
+                if responder is not None:
+                    responder.note_batch(keys[a:z])
             produced += b
+            if responder is not None:
+                # batch boundary: tick the accountant, maybe move hot
+                # groups / register splits (cooldown bounds the churn)
+                responder.maybe_respond()
         # drain the steady-state pending fires FIRST: harvested after the
         # shutdown flush below, their samples would carry the whole drain
         # span and pollute the p99 the gate reads
@@ -244,9 +298,95 @@ def run(total: int, mesh, batch: int = 1 << 16):
             # drain, reported but outside the steady-state percentiles
             "final_drain_ms": round(t_drain * 1e3, 1),
         }
-        return total / dt, fired, eng.spill_counters(), breakdown, fire_latency
+        skew = None
+        if responder is not None:
+            hot = eng.hot_key_stats()
+            skew = {
+                "rebalances": responder.rebalances,
+                "groups_moved": responder.groups_moved,
+                "keys_split": responder.keys_split,
+                "hot_keys": hot["keys"],
+                "salted_records": hot["salted_records"],
+                "salted_fires": hot["salted_fires"],
+                # measured load imbalance under the LIVE table vs what
+                # the contiguous layout would have concentrated
+                "imbalance_live": round(responder.accountant.imbalance(
+                    eng.key_group_assignment), 3),
+                "imbalance_contiguous": round(
+                    responder.accountant.imbalance(), 3),
+                "assignment_contiguous":
+                    eng.key_group_assignment.is_contiguous,
+            }
+        return (total / dt, fired, eng.spill_counters(), breakdown,
+                fire_latency, skew)
     finally:
         gc.enable()
+
+
+def main_zipf(mesh, P, total, reps_n, native_plane):
+    """The skew row: Zipf-keyed stream with the skew-adaptive plane
+    live, a 1-pass uniform control as the recovery denominator, and a
+    non-vacuous recovery gate (``BENCH_SKEW_RECOVERY``)."""
+    import jax
+
+    s = float(os.environ.get("BENCH_MESH_ZIPF_S", "1.1"))
+    run(min(total, 1 << 20), mesh, zipf=s, respond=True)  # warm
+    uniform_eps, _, _, _, _, _ = run(total, mesh)
+    print(f"# uniform control: {uniform_eps:.0f} events/s",
+          file=sys.stderr)
+    reps = []
+    for i in range(reps_n):
+        eps, fired, counters, breakdown, fire_lat, skew = run(
+            total, mesh, zipf=s, respond=True)
+        print(f"# zipf rep {i}: {eps:.0f} events/s, skew={skew}",
+              file=sys.stderr)
+        reps.append((eps, fired, counters, breakdown, fire_lat, skew))
+    by_rate = sorted(reps, key=lambda r: r[0])
+    eps, fired, counters, breakdown, fire_lat, skew = \
+        by_rate[len(by_rate) // 2]  # median
+    recovery = eps / max(uniform_eps, 1e-9)
+    line = {
+        "metric": "mesh_sessions_zipf_events_per_sec",
+        "value": round(eps, 1),
+        "unit": "events/s",
+        "uniform_events_per_s": round(uniform_eps, 1),
+        "skew_recovery_fraction": round(recovery, 3),
+        "rep_events_per_s": [round(r[0], 1) for r in reps],
+        "backend": jax.devices()[0].platform,
+        "mesh_shards": P,
+        "native_session_plane": native_plane,
+        "zipf_s": s,
+        "sessions_fired": fired,
+        "spill": counters,
+        "skew": skew,
+        "fire_latency_ms": fire_lat,
+        "shape": (f"Zipf({s}) keys over 10M-key space, 400k ev/s event "
+                  f"time, 2 s gap vs {P}x{BUDGET_PER_SHARD // 1024}k "
+                  f"device slots (paged spill), skew-adaptive plane "
+                  f"live: load-driven key-group moves + hot-key "
+                  f"splitting; recovery = zipf/uniform throughput"),
+    }
+    gate = os.environ.get("BENCH_SKEW_RECOVERY")
+    if gate is not None:
+        # no vacuous green: a run that never moved a group and never
+        # salted a record "recovered" nothing — the plane was idle
+        if skew["rebalances"] < 1 or skew["salted_records"] == 0:
+            line["error"] = (
+                f"skew gate is VACUOUS: rebalances="
+                f"{skew['rebalances']}, salted_records="
+                f"{skew['salted_records']} — the skew-adaptive plane "
+                "never engaged on the Zipf shape")
+            print(json.dumps(line))
+            sys.exit(1)
+        if recovery < float(gate):
+            line["error"] = (
+                f"skew recovery regressed: zipf/uniform = "
+                f"{recovery:.3f} < budget {gate} "
+                f"({eps:.0f} vs {uniform_eps:.0f} events/s)")
+            print(json.dumps(line))
+            sys.exit(1)
+    print(json.dumps(line))
+    sys.stdout.flush()
 
 
 def main():
@@ -278,10 +418,14 @@ def main():
         sys.exit(1)
     total = int(os.environ.get("BENCH_MESH_SESSION_RECORDS", 4_000_000))
     reps_n = max(int(os.environ.get("BENCH_MESH_REPS", 3)), 1)
+    zipf_mode = ("--zipf" in sys.argv
+                 or os.environ.get("BENCH_MESH_ZIPF") == "1")
+    if zipf_mode:
+        return main_zipf(mesh, P, total, reps_n, native_plane)
     run(min(total, 1 << 20), mesh)  # warm: compile the step programs
     reps = []
     for i in range(reps_n):
-        eps, fired, counters, breakdown, fire_lat = run(total, mesh)
+        eps, fired, counters, breakdown, fire_lat, _ = run(total, mesh)
         print(f"# rep {i}: {eps:.0f} events/s, fire p50/p99 "
               f"{fire_lat['p50']}/{fire_lat['p99']} ms (n="
               f"{fire_lat['count']}), breakdown={breakdown}",

@@ -265,6 +265,41 @@ def row5b_mesh_sessions():
     return json.loads(lines[-1])
 
 
+def row5c_mesh_sessions_zipf():
+    """Row 5's shape with Zipf(1.1) keys and the skew-adaptive plane
+    live (load accounting -> key-group moves -> hot-key splitting);
+    reports the recovered fraction of the uniform control's
+    throughput. Subprocess for the virtual-device flag, like row5b."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.setdefault("BENCH_MESH_SESSION_RECORDS",
+                   str(int(4_000_000 * SCALE)))
+    env["BENCH_MESH_ZIPF"] = "1"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "bench_mesh_sessions.py"), "--zipf"],
+        capture_output=True, text=True, env=env, timeout=3600)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("{")]
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError((proc.stderr or proc.stdout).strip()[-300:])
+    r = json.loads(lines[-1])
+    sk = r.get("skew") or {}
+    r["shape"] = (
+        f"{r['shape']}; recovered "
+        f"{r['skew_recovery_fraction']:.2f}x of uniform "
+        f"({r['uniform_events_per_s']:,.0f} ev/s), "
+        f"{sk.get('rebalances', 0)} rebalances / "
+        f"{sk.get('groups_moved', 0)} groups moved / "
+        f"{sk.get('keys_split', 0)} keys split "
+        f"({sk.get('salted_records', 0):,} records salted), "
+        f"imbalance {sk.get('imbalance_contiguous', 0)} -> "
+        f"{sk.get('imbalance_live', 0)}")
+    return r
+
+
 def row6_queryable_lookups():
     """High-QPS queryable-state serving: 2 concurrent jobs on one mesh,
     client threads issuing 256-key batched point lookups (the tenancy
@@ -404,6 +439,7 @@ ROWS = [("wordcount_socket", row1_wordcount),
         ("sql_hop_kafka", row4_sql_hop_kafka),
         ("sessions_10m_keys", row5_sessions_10m_keys),
         ("mesh_sessions_10m_keys", row5b_mesh_sessions),
+        ("mesh_sessions_zipf", row5c_mesh_sessions_zipf),
         ("queryable_lookups", row6_queryable_lookups),
         ("shard_loss_recovery", row7_shard_loss_recovery),
         ("nexmark_q8_windowed_join", _join_row(0)),
@@ -463,14 +499,19 @@ def main():
                       f"reload, {sp.get('rows_compacted', 0)} compacted")
         if r.get("breakdown"):
             bd = r["breakdown"]
-            extra += (f" — host-prep {bd['host_prep_s']}s / device-step "
-                      f"{bd['device_step_s']}s / harvest "
-                      f"{bd['harvest_s']}s of {bd['total_s']}s")
-            if "host_prep_fraction" in bd:
-                extra += (f" (host-prep fraction "
-                          f"{bd['host_prep_fraction']})")
-            if bd.get("native_sweep_s"):
-                extra += (f", native sweeps {bd['native_sweep_s']}s")
+            if "host_prep_s" in bd:
+                extra += (f" — host-prep {bd['host_prep_s']}s / "
+                          f"device-step {bd['device_step_s']}s / harvest "
+                          f"{bd['harvest_s']}s of {bd['total_s']}s")
+                if "host_prep_fraction" in bd:
+                    extra += (f" (host-prep fraction "
+                              f"{bd['host_prep_fraction']})")
+                if bd.get("native_sweep_s"):
+                    extra += (f", native sweeps {bd['native_sweep_s']}s")
+            elif "ingest_s" in bd:  # the join benches' phase split
+                extra += (f" — ingest {bd['ingest_s']}s / probe+fire "
+                          f"{bd['probe_fire_s']}s / harvest "
+                          f"{bd['harvest_s']}s of {bd['total_s']}s")
         if r.get("shuffle_mode"):
             extra += f", {r['shuffle_mode']}-mode shuffle"
         if r.get("matches"):
@@ -574,6 +615,31 @@ def main():
         "the smoke's correctness gates (bit-identity, 0 steady-state "
         "compiles, cross-host traffic, kill-1-of-2 recovery) hold "
         "regardless (NOTES_r18.md).")
+    lines.append("")
+    lines.append(
+        "Skew-adaptive plane (r20): the mesh_sessions_zipf row is "
+        "`tools/bench_mesh_sessions.py --zipf` — the same 10M-key "
+        "shape with the key column drawn Zipf(1.1), so a handful of "
+        "keys carry most of the stream and the contiguous key-group "
+        "layout pins one shard. The driver wires the skew ladder "
+        "(detect -> rebalance -> split): `parallel/load.py` accounts "
+        "per-key-group load from routed batches (EWMA + a Misra-Gries "
+        "hot-key sketch), `autoscale/rebalance.py` plans greedy "
+        "hottest-group-to-coldest-shard MOVES (hysteresis + cooldown) "
+        "applied live via `reassign_key_groups` (P unchanged, same "
+        "handoff discipline as reshard, own chaos fault point), and "
+        "keys that dominate their group — which no group move can fix "
+        "— are SPLIT via `register_hot_key`: records salt into "
+        "sub-rows pre-aggregated on their own shards and fold back at "
+        "fire in a fixed order (bit-identical for min/max/integer "
+        "sums; float sums opt in via allow_inexact). The row reports "
+        "zipf throughput, the uniform control, their ratio "
+        "(`skew_recovery_fraction`) and the responder counters; "
+        "`tools/tier1.sh` runs the same plane smaller via "
+        "`tools/skew_smoke.py` and FAILS if recovery drops below "
+        "`BENCH_SKEW_RECOVERY`, if no live move happened, if nothing "
+        "was salted, or if the rebalanced/salted output diverges from "
+        "the single-device oracle (NOTES_r20.md).")
     lines.append("")
     lines.append(
         "Streaming-join rows (r14): `tools/bench_joins.py` drives the "

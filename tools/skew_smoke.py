@@ -1,0 +1,228 @@
+"""Skew smoke: the skew-adaptive data plane's tier-1 gate.
+
+Drives the mesh session engine through a skewed stream (one key
+carrying ~40% of all records) with the :class:`SkewResponder` live,
+next to a uniform control run of the same shape, and pins BOTH halves
+of the story: the plane must actually engage, and engaging must be
+invisible in the output. The run FAILS (non-zero exit) if
+
+- the responder never moved a key group live (``rebalances < 1``,
+  ``groups_moved < 1``, or the assignment stayed contiguous), or
+- the dominant key was never split (``keys_split < 1``, or zero
+  salted records / salted fires — two-stage aggregation never
+  engaged: a vacuous green), or
+- the applied moves did not improve the accountant's measured
+  imbalance vs the contiguous layout, or
+- the skewed run's output diverges from the fault-free single-device
+  oracle by even one window (integer-valued float32 values keep the
+  salted sum fold exact, so the comparison is bit-identity, the part
+  the throughput bench does not check), or
+- skewed throughput fell below ``BENCH_SKEW_RECOVERY`` (default 0.7)
+  of the uniform control — the regression class where the responder
+  thrashes and makes skew WORSE than doing nothing.
+
+    JAX_PLATFORMS=cpu python tools/skew_smoke.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# must precede the first jax import: on CPU the mesh needs virtual devices
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+GAP = 100
+HOT = 7
+NUM_KEYS = 20_000
+N_STEPS = 8
+TOTAL = int(os.environ.get("SKEW_SMOKE_RECORDS", 1 << 18))
+RECOVERY_BUDGET = float(os.environ.get("BENCH_SKEW_RECOVERY", "0.7"))
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _steps(hot_frac):
+    rng = np.random.default_rng(47)
+    per_step = max(2_000, TOTAL // N_STEPS)
+    out = []
+    for s in range(N_STEPS):
+        keys = rng.integers(0, NUM_KEYS, per_step).astype(np.int64)
+        if hot_frac:
+            keys[rng.random(per_step) < hot_frac] = HOT
+        # integer-valued float32: salted sum folds stay exact, so the
+        # oracle comparison below can demand bit-identity
+        vals = rng.integers(1, 6, per_step).astype(np.float32)
+        ts = rng.integers(s * 80, s * 80 + 60, per_step).astype(np.int64)
+        out.append((keys, vals, ts, (s - 1) * 80))
+    return out
+
+
+def _keyed(keys, vals, ts):
+    from flink_tpu.core.records import KEY_ID_FIELD, RecordBatch
+
+    return RecordBatch.from_pydict(
+        {KEY_ID_FIELD: keys, "v": vals}, timestamps=ts)
+
+
+def _collect(fired, out):
+    from flink_tpu.core.records import KEY_ID_FIELD
+
+    for b in fired:
+        for r in b.to_rows():
+            out[(r[KEY_ID_FIELD], r["window_start"],
+                 r["window_end"])] = r["sum_v"]
+
+
+def _engine():
+    from flink_tpu.parallel.mesh import make_mesh
+    from flink_tpu.parallel.sharded_sessions import MeshSessionEngine
+    from flink_tpu.windowing.aggregates import SumAggregate
+
+    # paged layout (required for hot-key splitting) with a slot budget
+    # small enough that the skewed run genuinely evicts
+    return MeshSessionEngine(
+        GAP, SumAggregate("v"), make_mesh(4),
+        capacity_per_shard=1 << 15, max_device_slots=4096)
+
+
+def _run(steps, responder_factory=None):
+    """One timed pass; returns (outputs, events_per_s, responder)."""
+    engine = _engine()
+    responder = responder_factory(engine) if responder_factory else None
+    got = {}
+    t0 = time.perf_counter()
+    for keys, vals, ts, wm in steps:
+        if responder is not None:
+            responder.clock.t += 1.0
+            responder.note_batch(keys)
+        engine.process_batch(_keyed(keys, vals, ts))
+        _collect(engine.on_watermark(wm), got)
+        if responder is not None:
+            responder.maybe_respond()
+    _collect(engine.on_watermark(1 << 60), got)
+    dt = time.perf_counter() - t0
+    events = sum(len(s[0]) for s in steps)
+    return got, events / dt, engine, responder
+
+
+def main() -> int:
+    from flink_tpu.autoscale.rebalance import RebalancePolicy, SkewResponder
+    from flink_tpu.parallel.load import ShardLoadAccountant
+    from flink_tpu.state.keygroups import KeyGroupAssignment
+    from flink_tpu.windowing.aggregates import SumAggregate
+    from flink_tpu.windowing.sessions import SessionWindower
+
+    skewed = _steps(hot_frac=0.4)
+    uniform = _steps(hot_frac=0.0)
+
+    # oracle: fault-free, never rebalanced, never salted, single device
+    expected = {}
+    oracle = SessionWindower(GAP, SumAggregate("v"), capacity=1 << 16)
+    for keys, vals, ts, wm in skewed:
+        oracle.process_batch(_keyed(keys, vals, ts))
+        _collect(oracle.on_watermark(wm), expected)
+    _collect(oracle.on_watermark(1 << 60), expected)
+
+    # uniform control FIRST: it warms the per-shape XLA cache, so the
+    # skewed pass is not charged for shared compiles
+    _, uniform_eps, _, _ = _run(uniform)
+
+    def _responder(engine):
+        clk = FakeClock()
+        acc = ShardLoadAccountant(engine.P, engine.max_parallelism,
+                                  ewma_alpha=0.5, top_k=32, clock=clk)
+        responder = SkewResponder(
+            engine, acc,
+            policy=RebalancePolicy(imbalance_trigger=1.3, hysteresis=0.02,
+                                   cooldown_s=0.0, clock=clk),
+            salts=8, hot_key_share=0.5, allow_inexact=True)
+        responder.clock = clk  # the smoke advances time by hand
+        return responder
+
+    got, skew_eps, engine, responder = _run(skewed, _responder)
+    recovery = skew_eps / uniform_eps if uniform_eps else 0.0
+
+    acc = responder.accountant
+    assignment = engine.key_group_assignment
+    imb_live = acc.imbalance(assignment)
+    imb_contig = acc.imbalance(
+        KeyGroupAssignment.contiguous(engine.P, engine.max_parallelism))
+    stats = engine.hot_key_stats()
+    row = {
+        "bench": "skew_smoke",
+        "events": int(sum(len(s[0]) for s in skewed)),
+        "windows": len(expected),
+        "uniform_events_per_s": round(uniform_eps, 1),
+        "skew_events_per_s": round(skew_eps, 1),
+        "recovery": round(recovery, 3),
+        "rebalances": responder.rebalances,
+        "groups_moved": responder.groups_moved,
+        "keys_split": responder.keys_split,
+        "salted_records": stats["salted_records"],
+        "salted_fires": stats["salted_fires"],
+        "imbalance_live": round(imb_live, 3),
+        "imbalance_contiguous": round(imb_contig, 3),
+        "assignment_contiguous": assignment.is_contiguous,
+        "spill": engine.spill_counters(),
+    }
+    print(json.dumps(row))
+
+    failures = []
+    if responder.rebalances < 1 or responder.groups_moved < 1:
+        failures.append(
+            f"no live rebalance happened (rebalances="
+            f"{responder.rebalances}, groups_moved="
+            f"{responder.groups_moved})")
+    if assignment.is_contiguous:
+        failures.append("assignment is still contiguous — the moves "
+                        "never reached the engine")
+    if responder.keys_split < 1 or HOT not in stats["keys"]:
+        failures.append(
+            f"the dominant key was never split (keys_split="
+            f"{responder.keys_split}, registry={stats['keys']})")
+    if stats["salted_records"] == 0 or stats["salted_fires"] == 0:
+        failures.append(
+            f"two-stage aggregation never engaged (salted_records="
+            f"{stats['salted_records']}, salted_fires="
+            f"{stats['salted_fires']}) — vacuous")
+    if imb_live >= imb_contig:
+        failures.append(
+            f"moves did not improve imbalance: live {imb_live:.3f} vs "
+            f"contiguous {imb_contig:.3f}")
+    if set(got) != set(expected):
+        failures.append(
+            f"window sets differ from the oracle: {len(got)} vs "
+            f"{len(expected)}")
+    elif got != expected:
+        diverged = sum(1 for k in expected if got[k] != expected[k])
+        failures.append(
+            f"{diverged} windows diverged from the oracle (moves or "
+            "salting leaked into the output)")
+    if recovery < RECOVERY_BUDGET:
+        failures.append(
+            f"skewed throughput recovered only {recovery:.2f}x of the "
+            f"uniform control (budget {RECOVERY_BUDGET})")
+    if failures:
+        print("SKEW SMOKE FAILED: " + "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -129,6 +129,18 @@ if [ "${SKIP_BENCH_SMOKE:-0}" != "1" ]; then
   JAX_PLATFORMS=cpu timeout -k 10 120 \
     python tools/autoscale_smoke.py || exit 1
 
+  # Skew smoke: a skewed stream (one key ~40% of records) through the
+  # LIVE SkewResponder next to a uniform control — FAILS if no key
+  # group moved live, the dominant key never split (zero salted
+  # records/fires: vacuous), the moves did not improve measured
+  # imbalance, the output diverges from the single-device oracle by
+  # one window (bit-identity — integer-valued floats keep the salted
+  # fold exact), or skewed throughput drops below BENCH_SKEW_RECOVERY
+  # (0.7) of the uniform control — the responder-thrash regression
+  # class. ~90 s on CPU.
+  BENCH_SKEW_RECOVERY=0.7 JAX_PLATFORMS=cpu timeout -k 10 300 \
+    python tools/skew_smoke.py || exit 1
+
   # Join smoke: the device-native interval + temporal join engines vs
   # the host-numpy oracle — FAILS on any bit divergence (values OR
   # order), on a steady-state XLA compile after warmup, or on a
