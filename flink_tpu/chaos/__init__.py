@@ -42,6 +42,7 @@ KNOWN_FAULT_POINTS = (
     "serving.lookup",
     "serving.replica_publish",
     "serving.cache_probe",
+    "serving.frontend",
     "harvest.pending_fire",
     "task.batch",
     "task.subtask_batch",

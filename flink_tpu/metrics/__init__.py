@@ -7,6 +7,7 @@ KNOWN_METRIC_GROUPS = (
     "autoscale",
     "chaos",
     "flight",
+    "frontends",
     "latency",
     "skew",
     "state",

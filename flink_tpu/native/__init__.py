@@ -399,6 +399,17 @@ HC_STAT_TORN_RETRIES = 5
 HC_STAT_TORN_MISSES = 6
 HC_STAT_OVERSIZE_DROPS = 7
 
+#: per-frontend counter indices (must match the FeStat enum in
+#: hotcache.cpp) — accumulated IN the shared arena header by attached
+#: frontend processes (hc_fe_note), read owner-side without IPC
+HC_FE_STAT_PROBES = 0
+HC_FE_STAT_HITS = 1
+HC_FE_STAT_TORN_RETRIES = 2
+HC_FE_STAT_MISS_CROSSINGS = 3
+HC_FE_STAT_NAMES = ("probes", "hits", "torn_retries", "miss_crossings")
+#: fe_stats rows reserved in the arena header (kMaxFrontends)
+HC_MAX_FRONTENDS = 64
+
 
 def load_hotcache() -> Optional[ctypes.CDLL]:
     """The native hot-row probe table (native/hotcache.cpp), or None.
@@ -423,6 +434,23 @@ def load_hotcache() -> Optional[ctypes.CDLL]:
         P = c.POINTER
         lib.hc_create.restype = vp
         lib.hc_create.argtypes = [i64, i64, i64]
+        # shared-memory arena family (r21): the owner creates the table
+        # as a MAP_SHARED file arena; frontend processes attach the SAME
+        # table and probe it lock-free (seqlock readers are address-free)
+        lib.hc_create_shared.restype = vp
+        lib.hc_create_shared.argtypes = [c.c_char_p, i64, i64, i64]
+        lib.hc_attach.restype = vp
+        lib.hc_attach.argtypes = [c.c_char_p]
+        lib.hc_epoch.restype = i64
+        lib.hc_epoch.argtypes = [vp]
+        lib.hc_arena_bytes.restype = i64
+        lib.hc_arena_bytes.argtypes = [vp]
+        lib.hc_is_attached.restype = i64
+        lib.hc_is_attached.argtypes = [vp]
+        lib.hc_fe_note.restype = None
+        lib.hc_fe_note.argtypes = [vp, i32, i64, i64, i64, i64]
+        lib.hc_fe_stat.restype = i64
+        lib.hc_fe_stat.argtypes = [vp, i32, i32]
         lib.hc_destroy.restype = None
         lib.hc_destroy.argtypes = [vp]
         lib.hc_len.restype = i64
@@ -439,6 +467,12 @@ def load_hotcache() -> Optional[ctypes.CDLL]:
         lib.hc_get_batch.argtypes = [vp, i64, P(i64), i64, P(u8),
                                      P(i32), P(i64), P(i64), P(i64),
                                      P(u64)]
+        # the frontend variant: same probe + per-frontend attribution
+        # folded in the same GIL-released call
+        lib.hc_get_batch_fe.restype = i64
+        lib.hc_get_batch_fe.argtypes = [vp, i32, i64, P(i64), i64,
+                                        P(u8), P(i32), P(i64), P(i64),
+                                        P(i64), P(u64)]
         lib.hc_put_batch.restype = i64
         lib.hc_put_batch.argtypes = [vp, i64, P(i64), P(i64), P(i64),
                                      P(i64), P(i64), P(u64)]

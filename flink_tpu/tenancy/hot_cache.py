@@ -53,12 +53,20 @@ class PrimeDelta:
         return len(self.keys)
 
 
-def make_hot_row_cache(max_entries: int = 1 << 18):
+def make_hot_row_cache(max_entries: int = 1 << 18,
+                       shm_dir: Optional[str] = None):
     """The native (C++) hot-row probe table when available, else this
     module's :class:`HotRowCache` — selected exactly the way
     ``make_session_meta`` picks the session-metadata plane. Lookup
     results are bit-identical across planes (test-pinned); the native
     plane probes/primes a whole key batch in ONE GIL-released C call.
+
+    ``shm_dir`` arms the multi-process serving tier: the native tables
+    allocate as MAP_SHARED file arenas under it (plus an attach
+    manifest), so frontend processes probe the SAME table over shared
+    memory (``flink_tpu.tenancy.frontend``). The Python plane cannot
+    shm-map — requesting ``shm_dir`` without the native plane raises
+    rather than silently serving a frontendless cache.
 
     ``FLINK_TPU_NATIVE_HOTCACHE=0`` forces the Python plane while other
     native components stay on — the A/B knob the serving bench and the
@@ -81,8 +89,11 @@ def make_hot_row_cache(max_entries: int = 1 << 18):
                     NativeHotRowCache,
                 )
 
-                return NativeHotRowCache(max_entries=max_entries)
+                return NativeHotRowCache(max_entries=max_entries,
+                                         shm_dir=shm_dir)
             except Exception as e:  # noqa: BLE001 — degrade, loudly
+                if shm_dir is not None:
+                    raise
                 note_fallback(
                     "native hot-row cache failed to initialize: "
                     f"{type(e).__name__}: {e}")
@@ -90,6 +101,12 @@ def make_hot_row_cache(max_entries: int = 1 << 18):
             note_fallback(
                 "native hotcache library unavailable (build failed or "
                 "no toolchain) — using the bit-identical Python cache")
+    if shm_dir is not None:
+        raise RuntimeError(
+            "shm_dir (the multi-process serving tier) requires the "
+            "native hotcache plane — it is disabled or unavailable "
+            "here, and the Python cache cannot be shared-memory "
+            "mapped by frontend processes")
     return HotRowCache(max_entries=max_entries)
 
 

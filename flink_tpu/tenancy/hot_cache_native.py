@@ -35,12 +35,16 @@ re-prime within a publish interval.
 from __future__ import annotations
 
 import ctypes
+import json
+import os
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from flink_tpu.native import (
+    HC_FE_STAT_NAMES,
+    HC_MAX_FRONTENDS,
     HC_STAT_EVICTIONS,
     HC_STAT_HITS,
     HC_STAT_MISSES,
@@ -52,6 +56,11 @@ from flink_tpu.native import (
     load_hotcache,
 )
 from flink_tpu.tenancy.hot_cache import HotRowCache, PrimeDelta
+
+#: the owner's table registry inside ``shm_dir`` — frontends read it to
+#: know which arena file serves which (job, operator), with the epoch
+#: each arena was created under (owner-restart detector)
+MANIFEST_NAME = "hotcache_manifest.json"
 
 _i64p = ctypes.POINTER(ctypes.c_int64)
 _i32p = ctypes.POINTER(ctypes.c_int32)
@@ -158,17 +167,30 @@ class _Scratch:
 
 
 class _Table:
-    """One (job, operator) native table + its packing schema."""
+    """One (job, operator) native table + its packing schema. With a
+    ``shm_path`` the arena is a MAP_SHARED file frontends hc_attach;
+    without, it is the private heap arena (the single-process path,
+    byte-for-byte today's behavior)."""
 
-    __slots__ = ("ptr", "cols", "n_cols", "entries", "graveyard")
+    __slots__ = ("ptr", "cols", "n_cols", "entries", "graveyard",
+                 "shm_path", "epoch")
 
-    def __init__(self, lib, cols: Tuple[str, ...], entries: int) -> None:
+    def __init__(self, lib, cols: Tuple[str, ...], entries: int,
+                 shm_path: Optional[str] = None) -> None:
         self.cols = cols
         self.n_cols = len(cols)
         self.entries = int(entries)
-        self.ptr = lib.hc_create(self.entries, self.n_cols, ENTRY_CAP)
+        self.shm_path = shm_path
+        if shm_path is None:
+            self.ptr = lib.hc_create(self.entries, self.n_cols,
+                                     ENTRY_CAP)
+        else:
+            self.ptr = lib.hc_create_shared(
+                shm_path.encode(), self.entries, self.n_cols,
+                ENTRY_CAP)
         if not self.ptr:
             raise MemoryError("hc_create failed")
+        self.epoch = int(lib.hc_epoch(self.ptr))
         #: old table pointers kept alive across growth swaps: a reader
         #: that grabbed the previous pointer must stay safe (freed on
         #: cache close)
@@ -179,11 +201,20 @@ class NativeHotRowCache:
     """Drop-in :class:`HotRowCache` with the native probe table under
     it. See the module doc for the packing/overflow split."""
 
-    def __init__(self, max_entries: int = 1 << 18) -> None:
+    def __init__(self, max_entries: int = 1 << 18,
+                 shm_dir: Optional[str] = None) -> None:
         self._lib = load_hotcache()
         if self._lib is None:
             raise RuntimeError("native hotcache library unavailable")
         self.max_entries = int(max_entries)
+        #: shared-memory mode: every table is a MAP_SHARED file arena
+        #: under this directory (ideally /dev/shm-backed) plus a JSON
+        #: manifest frontends poll to attach — None keeps the private
+        #: heap arenas (zero frontends = exactly the one-process path)
+        self.shm_dir = shm_dir
+        self._shm_seq = 0
+        if shm_dir is not None:
+            os.makedirs(shm_dir, exist_ok=True)
         #: (job, operator) -> _Table (created on first packable value)
         self._tables: Dict[tuple, _Table] = {}
         #: (job, operator) whose values fundamentally cannot pack
@@ -236,6 +267,41 @@ class NativeHotRowCache:
 
     # ------------------------------------------------------------- tables
 
+    def _next_shm_path(self) -> Optional[str]:
+        """A FRESH arena filename per create (also per growth swap):
+        re-using a path would mean truncating a file a live frontend
+        has mapped — a fault, not a race. Old files unlink immediately
+        after the swap; existing mappings keep their pages (POSIX), and
+        frontends re-attach off the rewritten manifest."""
+        if self.shm_dir is None:
+            return None
+        self._shm_seq += 1
+        return os.path.join(self.shm_dir,
+                            f"hc_{os.getpid()}_{self._shm_seq:05d}.arena")
+
+    def _write_manifest(self) -> None:
+        """Rewrite the frontend attach manifest (atomic rename). Called
+        under ``self._lock`` after any structural change (new table,
+        growth swap) so frontends always see a consistent registry:
+        every listed path exists and its arena's epoch matches."""
+        if self.shm_dir is None:
+            return
+        doc = {
+            "version": 1,
+            "seq": self._shm_seq,
+            "tables": [
+                {"job": j, "operator": op, "path": t.shm_path,
+                 "cols": list(t.cols), "epoch": t.epoch,
+                 "entries": t.entries}
+                for (j, op), t in self._tables.items()
+                if t.shm_path is not None],
+        }
+        path = os.path.join(self.shm_dir, MANIFEST_NAME)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+
     def _table_for(self, job: str, operator: str,
                    cols: Tuple[str, ...]) -> Optional[_Table]:
         key = (job, operator)
@@ -245,9 +311,19 @@ class NativeHotRowCache:
         with self._lock:
             tbl = self._tables.get(key)
             if tbl is None:
-                tbl = _Table(self._lib, cols,
-                             min(self.max_entries, MIN_TABLE_ENTRIES))
+                # shm tables allocate at the FULL cache bound up front:
+                # growth would swap arena files under attached
+                # frontends every x4 step — one fixed file per
+                # (job, operator) keeps attachments stable for the
+                # table's whole life (memory is the configured bound
+                # either way; private tables keep the lazy ramp)
+                entries = (self.max_entries if self.shm_dir is not None
+                           else min(self.max_entries,
+                                    MIN_TABLE_ENTRIES))
+                tbl = _Table(self._lib, cols, entries,
+                             shm_path=self._next_shm_path())
                 self._tables[key] = tbl
+                self._write_manifest()
             return tbl if tbl.cols == cols else None
 
     def _maybe_grow(self, tbl: _Table) -> None:
@@ -288,7 +364,18 @@ class NativeHotRowCache:
                 for p in tbl.graveyard:
                     self._lib.hc_destroy(p)
                 self._lib.hc_destroy(tbl.ptr)
+                if tbl.shm_path is not None:
+                    try:
+                        os.unlink(tbl.shm_path)
+                    except OSError:
+                        pass
             self._tables.clear()
+            if self.shm_dir is not None:
+                try:
+                    os.unlink(os.path.join(self.shm_dir,
+                                           MANIFEST_NAME))
+                except OSError:
+                    pass
 
     def __del__(self):  # pragma: no cover - interpreter teardown
         try:
@@ -729,3 +816,189 @@ class NativeHotRowCache:
                 self._sum_stat(HC_STAT_OVERSIZE_DROPS)),
             "hot_row_native_puts": float(self._sum_stat(HC_STAT_PUTS)),
         }
+
+    def fe_stats(self, n_frontends: int = HC_MAX_FRONTENDS
+                 ) -> List[Dict[str, int]]:
+        """Per-frontend counters read OWNER-SIDE off the shared arena
+        headers (no IPC — the frontends accumulated them there via
+        ``hc_fe_note`` / ``hc_get_batch_fe``), summed across this
+        cache's tables: one dict per frontend slot with the
+        ``HC_FE_STAT_NAMES`` keys. All-zero rows for unused slots."""
+        rows = [dict.fromkeys(HC_FE_STAT_NAMES, 0)
+                for _ in range(int(n_frontends))]
+        for tbl in self._tables.values():
+            for fe in range(len(rows)):
+                for which, name in enumerate(HC_FE_STAT_NAMES):
+                    v = int(self._lib.hc_fe_stat(tbl.ptr, fe, which))
+                    if v > 0:
+                        rows[fe][name] += v
+        return rows
+
+
+class FrontendCacheClient:
+    """The FRONTEND-process face of the shared hot cache: attach every
+    arena the owner's manifest lists and probe them lock-free (the
+    seqlock read protocol is address-free — an attached mapper is
+    exactly as safe as an in-process reader thread). The hit path is
+    shm-probe → :class:`PackedProbe`; nothing here ever takes a lock,
+    touches the owner process, or imports the serving plane.
+
+    Owner-restart discipline: each attachment remembers the epoch the
+    manifest promised; ``refresh()`` re-reads the manifest when its
+    ``seq`` moved or a probe-time ``hc_epoch`` check disagrees, then
+    re-attaches the changed tables. A table the manifest no longer
+    lists detaches (its unlinked file's pages stay valid while mapped,
+    so in-flight probes on the OLD attachment were never at risk)."""
+
+    def __init__(self, shm_dir: str, frontend_id: int = 0) -> None:
+        self._lib = load_hotcache()
+        if self._lib is None:
+            raise RuntimeError("native hotcache library unavailable")
+        if not (0 <= int(frontend_id) < HC_MAX_FRONTENDS):
+            raise ValueError(
+                f"frontend_id must be in [0, {HC_MAX_FRONTENDS})")
+        self.shm_dir = shm_dir
+        self.frontend_id = int(frontend_id)
+        self._manifest_path = os.path.join(shm_dir, MANIFEST_NAME)
+        self._manifest_seq = -1
+        self._manifest_mtime = -1
+        #: (job, operator) -> (ptr, cols, epoch, path)
+        self._attached: Dict[tuple, tuple] = {}
+        self._tls = threading.local()
+        self.refresh()
+
+    # ---------------------------------------------------------- attach
+
+    def refresh(self) -> bool:
+        """Re-read the manifest and (re-)attach changed tables.
+        Returns True when the attachment set changed. Missing manifest
+        (owner not up yet / shut down) detaches everything."""
+        try:
+            with open(self._manifest_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            changed = bool(self._attached)
+            self._detach_all()
+            self._manifest_seq = -1
+            self._manifest_mtime = -1
+            return changed
+        try:
+            self._manifest_mtime = os.stat(
+                self._manifest_path).st_mtime_ns
+        except OSError:
+            self._manifest_mtime = -1
+        changed = False
+        want = {}
+        for row in doc.get("tables", ()):
+            want[(row["job"], row["operator"])] = row
+        for key in list(self._attached):
+            if key not in want:
+                self._detach(key)
+                changed = True
+        for key, row in want.items():
+            cur = self._attached.get(key)
+            if cur is not None and cur[2] == row["epoch"]:
+                continue  # same owner session: attachment still valid
+            if cur is not None:
+                self._detach(key)
+            ptr = self._lib.hc_attach(row["path"].encode())
+            if ptr and int(self._lib.hc_epoch(ptr)) == row["epoch"]:
+                self._attached[key] = (ptr, tuple(row["cols"]),
+                                       int(row["epoch"]), row["path"])
+                changed = True
+            elif ptr:
+                # arena newer than the manifest copy we read — a
+                # re-read next refresh picks the matching pair up
+                self._lib.hc_destroy(ptr)
+        self._manifest_seq = int(doc.get("seq", 0))
+        return changed
+
+    def _detach(self, key) -> None:
+        ptr, _cols, _epoch, _path = self._attached.pop(key)
+        self._lib.hc_destroy(ptr)  # attached mode: munmap only
+
+    def _detach_all(self) -> None:
+        for key in list(self._attached):
+            self._detach(key)
+
+    def close(self) -> None:
+        self._detach_all()
+
+    def __del__(self):  # pragma: no cover - interpreter teardown
+        try:
+            self._detach_all()
+        except Exception:
+            pass
+
+    def tables(self) -> List[tuple]:
+        return sorted(self._attached)
+
+    # ----------------------------------------------------------- probes
+
+    def _scratch(self, n: int, ncol: int) -> _Scratch:
+        pool = getattr(self._tls, "sc", None)
+        if pool is None:
+            pool = self._tls.sc = {}
+        sc = pool.get(ncol)
+        if sc is None:
+            sc = pool[ncol] = _Scratch()
+        sc.ensure(n, ncol)
+        return sc
+
+    def probe(self, job: str, operator: str, key_ids,
+              gen: int = -1, exact: bool = False):
+        """One shm probe for the whole batch: ``(hits, probe, misses)``
+        with ``probe`` a :class:`PackedProbe` (None when the table is
+        not attached — every key is then a miss) and ``misses`` the
+        indices to cross to the owner. Stale-attachment detection rides
+        the probe: an epoch mismatch (the GRACEFUL owner-restart path —
+        the retiring owner zeroes the arena's epoch word) triggers one
+        refresh + re-probe, and a manifest mtime change (the CRASHED-
+        owner path, where nobody retired the old arena) does the same
+        at the cost of one stat per batch."""
+        try:
+            mt = os.stat(self._manifest_path).st_mtime_ns
+        except OSError:
+            mt = -1
+        if mt != self._manifest_mtime:
+            self.refresh()
+        for _attempt in range(2):
+            entry = self._attached.get((job, operator))
+            if entry is None:
+                self.refresh()
+                entry = self._attached.get((job, operator))
+                if entry is None:
+                    return 0, None, list(range(len(key_ids)))
+            ptr, cols, epoch, _path = entry
+            if int(self._lib.hc_epoch(ptr)) != epoch:
+                self.refresh()  # owner restarted: re-attach and retry
+                continue
+            keys = np.ascontiguousarray(
+                np.asarray(key_ids, dtype=np.int64))
+            n = len(keys)
+            ncol = len(cols)
+            sc = self._scratch(n, ncol)
+            np.copyto(sc.keys[:n], keys)
+            hits = self._lib.hc_get_batch_fe(
+                ptr, self.frontend_id, n, sc.p_keys,
+                int(gen) if exact else -1, sc.p_hit, sc.p_cnt,
+                sc.p_ogen, sc.p_ons, sc.p_ovals, sc.p_otags)
+            misses = ([] if hits == n else
+                      [i for i, h in enumerate(sc.hit[:n].tolist())
+                       if not h])
+            tot = int(sc.cnt[:n].sum())
+            probe = PackedProbe(sc.hit[:n].copy(), sc.cnt[:n].copy(),
+                                sc.ons[:tot].copy(),
+                                sc.ovals[:tot * ncol].copy(),
+                                sc.otags[:tot].copy(), cols)
+            return hits, probe, misses
+        return 0, None, list(range(len(key_ids)))
+
+    def note_miss_crossings(self, job: str, operator: str,
+                            n: int) -> None:
+        """Attribute ``n`` cold misses this frontend CROSSED to the
+        owner for (the request-pipe trips) in the shared header."""
+        entry = self._attached.get((job, operator))
+        if entry is not None and n:
+            self._lib.hc_fe_note(entry[0], self.frontend_id,
+                                 0, 0, 0, int(n))

@@ -54,11 +54,20 @@ def lookup_stats_dict(lookups: int, batches: int,
     }
 
 
-def aggregate_lookup_stats(coalescers) -> Dict[str, float]:
+def aggregate_lookup_stats(coalescers,
+                           frontend_stats=None) -> Dict[str, float]:
     """Merge coalescer counters + latency reservoirs into the canonical
     serving-stats dict (one sort, for the p99). Reads go through each
     coalescer's locked snapshot — client threads append concurrently,
-    and iterating a deque mid-append raises."""
+    and iterating a deque mid-append raises.
+
+    ``frontend_stats`` (optional): per-frontend counter rows as
+    ``NativeHotRowCache.fe_stats`` returns them — the multi-process
+    tier's shm-header counters. Frontend-served probes fold into
+    ``lookups_total`` (a frontend hit IS a served lookup that never
+    reached a coalescer) and the per-counter sums ride along under
+    ``frontend_*``, so the bench breakdown derives from the real
+    counters, not wall-clock division."""
     lookups = 0
     batches = 0
     lat: List[float] = []
@@ -67,7 +76,15 @@ def aggregate_lookup_stats(coalescers) -> Dict[str, float]:
         lookups += n
         batches += b
         lat.extend(ms)
-    return lookup_stats_dict(lookups, batches, lat)
+    out = lookup_stats_dict(lookups, batches, lat)
+    if frontend_stats:
+        for k in frontend_stats[0].keys():
+            out[f"frontend_{k}"] = float(
+                sum(r[k] for r in frontend_stats))
+        # hits answered inside a frontend never cross to a coalescer;
+        # miss crossings DO reach one (counted there already)
+        out["lookups_total"] += out.get("frontend_hits", 0.0)
+    return out
 
 
 class _Pending:
@@ -485,11 +502,16 @@ class ServingPlane:
 
     def __init__(self, max_batch: int = 512, window_ms: float = 1.0,
                  timeout_s: float = 30.0, workers: int = 2,
-                 cache_entries: int = 1 << 18):
+                 cache_entries: int = 1 << 18,
+                 shm_dir: Optional[str] = None):
         self.max_batch = int(max_batch)
         self.window_ms = float(window_ms)
         self.timeout_s = float(timeout_s)
         self.n_workers = max(int(workers), 1)
+        #: when set, the hot cache allocates MAP_SHARED arenas under
+        #: this directory and frontend processes may attach (the
+        #: multi-process serving tier — flink_tpu.tenancy.frontend)
+        self.shm_dir = shm_dir
 
         def make_flush(key):
             def flush(keys, namespace, _job=key[0], _op=key[1]):
@@ -507,7 +529,8 @@ class ServingPlane:
 
         #: the native GIL-free probe table when available, else the
         #: bit-identical Python LRU (FLINK_TPU_NATIVE_HOTCACHE=0 A/B)
-        self.hot_cache = make_hot_row_cache(cache_entries)
+        self.hot_cache = make_hot_row_cache(cache_entries,
+                                            shm_dir=shm_dir)
         self._workers: List[_ReplicaWorker] = []
         self._workers_lock = threading.Lock()
         #: sampled serving.cache_hit instants (1-in-N — a per-hit ring
@@ -952,9 +975,26 @@ class ServingPlane:
                 out[k] = out.get(k, 0) + v
         return out
 
+    def frontend_stats(self) -> Dict[str, float]:
+        """Aggregate per-frontend shm counters (probes / hits / torn
+        retries / miss crossings), summed across frontend slots and
+        tables straight off the shared arena headers — the frontends
+        write them lock-free in their own processes; the owner reads
+        them here with no IPC. Empty when the multi-process tier is
+        not armed (no ``shm_dir``)."""
+        if self.shm_dir is None:
+            return {}
+        fe_stats = getattr(self.hot_cache, "fe_stats", None)
+        if fe_stats is None:
+            return {}
+        rows = fe_stats()
+        return {f"frontend_{k}": float(sum(r[k] for r in rows))
+                for k in (rows[0].keys() if rows else ())}
+
     def metrics(self) -> Dict[str, float]:
         out = self._pool.stats()
         out.update(self.hot_cache.stats())
+        out.update(self.frontend_stats())
         out["replica_staleness_ms"] = self.replica_staleness_ms()
         out["replica_generations"] = float(self.replica_generations())
         return out

@@ -96,16 +96,20 @@ class SessionCluster:
                  arbiter=None, arbitrate_every_s: float = 0.0,
                  serving: Optional[ServingPlane] = None,
                  serving_workers: int = 2,
-                 serving_cache_entries: int = 1 << 18):
+                 serving_cache_entries: int = 1 << 18,
+                 serving_shm_dir: Optional[str] = None):
         self.jobs: Dict[str, TenantJob] = {}
         self.drr = DeficitRoundRobin(quantum=quantum_records)
         #: serving_workers — threads draining the per-(job, operator,
         #: shard) lookup queues (each queue owned by exactly ONE
         #: worker); serving_cache_entries — hot-row cache LRU bound
-        #: (0 disables the cache: every lookup resolves on the replica)
+        #: (0 disables the cache: every lookup resolves on the replica);
+        #: serving_shm_dir — arms the multi-process frontend tier (the
+        #: hot cache allocates shm arenas there; FrontendPool attaches)
         self.serving = serving or ServingPlane(
             workers=serving_workers,
-            cache_entries=serving_cache_entries)
+            cache_entries=serving_cache_entries,
+            shm_dir=serving_shm_dir)
         self.max_restarts = int(max_restarts)
         self.arbiter = arbiter
         self.arbitrate_every_s = float(arbitrate_every_s)
@@ -518,6 +522,14 @@ class SessionCluster:
                 lambda: self.serving.replica_staleness_ms())
         g.gauge("serving.hotRowHitRate",
                 lambda: self.serving.hot_row_hit_rate())
+        if self.serving.shm_dir is not None:
+            # the multi-process tier's shm-header counters (live reads
+            # off the shared arenas — frontends write them lock-free)
+            for name in ("probes", "hits", "torn_retries",
+                         "miss_crossings"):
+                g.gauge(f"serving.frontend.{name}",
+                        (lambda n=name: self.serving.frontend_stats()
+                         .get(f"frontend_{n}", 0.0)))
 
     def _register_job_gauges(self, job: TenantJob) -> None:
         g = self._tenancy_group.add_group(job.name)

@@ -13,6 +13,14 @@
 // task thread.
 //
 // Layout (struct-of-arrays, one table per (job, operator)):
+//   - ONE CONTIGUOUS ARENA: a fixed 4 KiB header (magic, epoch, shape,
+//     stats, per-frontend counters) followed by the slot arrays at
+//     computed offsets (stamp, key, gen, ns, vals, tags, n, state —
+//     8-byte fields first so every array is naturally aligned). The
+//     arena is either private heap (hc_create — the single-process
+//     path, unchanged semantics) or a mmap-ed MAP_SHARED file
+//     (hc_create_shared / hc_attach) so FRONTEND PROCESSES map the
+//     same table and probe it lock-free over shared memory;
 //   - open addressing over pow2 slots, linear probing, bounded window
 //     (load factor <= 0.5 by construction; deletions leave tombstones
 //     the probe walks past and inserts reuse);
@@ -25,7 +33,23 @@
 //     prime on the task thread, worker puts) flip the stamp odd, write,
 //     flip it even; readers never take a lock — they re-check the stamp
 //     around the copy and a torn read RETRIES, then falls to the miss
-//     path. A reader can never observe a mixed-generation row.
+//     path. A reader can never observe a mixed-generation row. The
+//     protocol is address-free (no pointers, no process-local state in
+//     the arena), so it is exactly as safe for a reader in ANOTHER
+//     process as for a reader thread in this one.
+//
+// Ownership across processes: the CREATOR is the only writer
+// (hc_put_batch / hc_prime_batch / hc_clear / hc_drop refuse on an
+// attached handle); its write mutex lives in the process-local handle,
+// NOT in the arena — cross-process writer exclusion is by role, not by
+// a shared lock. Attached frontends only probe (hc_get_batch), bump
+// the shared stat words, and accumulate their per-frontend counters
+// (hc_fe_note) — all lock-free atomics on the mapped header. The
+// header's EPOCH word identifies the owner session: a new
+// hc_create_shared writes a fresh epoch, so a frontend that cached an
+// attachment detects owner restart by comparing hc_epoch against the
+// value it saw at attach time and re-attaches (the Python manifest
+// carries the expected epoch).
 //
 // Writers serialize on one per-table mutex (primes and puts are rare
 // next to probes; the mutex is held only inside the GIL-released call),
@@ -43,6 +67,12 @@
 #include <cstdlib>
 #include <cstring>
 #include <mutex>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
 
 namespace {
 
@@ -74,36 +104,90 @@ enum Stat {
   kStatCount = 8,
 };
 
+// per-frontend counter indices (hc_fe_note / hc_fe_stat)
+enum FeStat {
+  kFeProbes = 0,
+  kFeHits = 1,
+  kFeTornRetries = 2,
+  kFeMissCrossings = 3,
+  kFeStatCount = 4,
+};
+constexpr int kMaxFrontends = 64;
+
 constexpr int kReadRetries = 4;
 
+constexpr uint64_t kMagic = 0x464C4E4B48433032ull;  // "FLNKHC02"
+constexpr uint64_t kLayoutVersion = 2;
+constexpr int64_t kHeaderBytes = 4096;
+
+// handle modes
+constexpr int kModePrivate = 0;   // hc_create: heap arena, this process
+constexpr int kModeShared = 1;    // hc_create_shared: owner, MAP_SHARED
+constexpr int kModeAttached = 2;  // hc_attach: read-side mapper
+
+// The arena header. Everything a mapper needs to bind the arrays is
+// here; the magic word is written LAST (release) by the creator so an
+// attacher never binds a half-initialized arena. std::atomic<int64_t>
+// / <uint64_t> are lock-free and ADDRESS-FREE on every target this
+// builds for (static_asserted below) — valid across processes in
+// MAP_SHARED memory.
+struct ArenaHeader {
+  std::atomic<uint64_t> magic;
+  uint64_t layout_version;
+  std::atomic<uint64_t> epoch;  // owner-session word (restart detector)
+  int64_t n_slots;              // pow2
+  int64_t n_cols;
+  int64_t entry_cap;
+  int64_t arena_bytes;
+  std::atomic<int64_t> live;
+  std::atomic<int64_t> stats[kStatCount];
+  std::atomic<int64_t> fe_stats[kMaxFrontends * kFeStatCount];
+};
+static_assert(sizeof(ArenaHeader) <= kHeaderBytes,
+              "arena header must fit its reserved block");
+static_assert(std::atomic<uint64_t>::is_always_lock_free &&
+                  std::atomic<int64_t>::is_always_lock_free &&
+                  std::atomic<uint8_t>::is_always_lock_free,
+              "shm seqlock needs lock-free (address-free) atomics");
+
+// Process-local handle: arena pointers + the writer-side mutex. The
+// mutex is deliberately OUTSIDE the arena — only the owner process
+// writes, so writer exclusion never needs to cross processes.
 struct HotTable {
-  int64_t n_slots = 0;     // pow2
+  ArenaHeader* hdr = nullptr;
+  int64_t n_slots = 0;  // cached from hdr (hot-loop fields)
   int64_t mask = 0;
   int64_t max_probe = 0;
   int64_t n_cols = 0;
   int64_t entry_cap = 0;
-  std::atomic<int64_t> live{0};
-  std::atomic<int64_t> stats[kStatCount];
   std::mutex write_mu;
+  int mode = kModePrivate;
+  void* base = nullptr;
+  size_t map_bytes = 0;
 
   std::atomic<uint64_t>* stamp = nullptr;
   std::atomic<uint8_t>* state = nullptr;
   std::atomic<int64_t>* key = nullptr;
   int64_t* gen = nullptr;
-  int32_t* n = nullptr;         // entries used in the slot
-  int64_t* ns = nullptr;        // [n_slots * entry_cap]
-  int64_t* vals = nullptr;      // [n_slots * entry_cap * n_cols]
-  uint64_t* tags = nullptr;     // [n_slots * entry_cap] dtype bitmasks
+  int32_t* n = nullptr;     // entries used in the slot
+  int64_t* ns = nullptr;    // [n_slots * entry_cap]
+  int64_t* vals = nullptr;  // [n_slots * entry_cap * n_cols]
+  uint64_t* tags = nullptr; // [n_slots * entry_cap] dtype bitmasks
 
   ~HotTable() {
-    delete[] stamp;
-    delete[] state;
-    delete[] key;
-    std::free(gen);
-    std::free(n);
-    std::free(ns);
-    std::free(vals);
-    std::free(tags);
+    if (base == nullptr) return;
+    if (mode == kModePrivate) {
+      std::free(base);
+    } else {
+      if (mode == kModeShared)
+        // RETIRE the arena: a still-attached frontend's probe-time
+        // epoch check (hc_epoch != manifest epoch) now fires and sends
+        // it back to the manifest for the successor arena. The pages
+        // stay valid for attached mappers until they munmap — only the
+        // epoch word says "this owner session is over".
+        hdr->epoch.store(0, std::memory_order_release);
+      munmap(base, map_bytes);
+    }
   }
 };
 
@@ -111,6 +195,94 @@ inline int64_t pow2_at_least(int64_t v) {
   int64_t p = 64;
   while (p < v) p <<= 1;
   return p;
+}
+
+inline int64_t align64(int64_t v) { return (v + 63) & ~63ll; }
+
+// Arena size for a shape: header block, then the arrays 8-byte fields
+// first (each offset 64-aligned so every array is naturally aligned
+// whatever its element width).
+struct ArenaLayout {
+  int64_t off_stamp, off_key, off_gen, off_ns, off_vals, off_tags;
+  int64_t off_n, off_state, total;
+};
+
+inline ArenaLayout layout_for(int64_t n_slots, int64_t n_cols,
+                              int64_t entry_cap) {
+  ArenaLayout L;
+  int64_t off = kHeaderBytes;
+  L.off_stamp = off;
+  off = align64(off + n_slots * 8);
+  L.off_key = off;
+  off = align64(off + n_slots * 8);
+  L.off_gen = off;
+  off = align64(off + n_slots * 8);
+  L.off_ns = off;
+  off = align64(off + n_slots * entry_cap * 8);
+  L.off_vals = off;
+  off = align64(off + n_slots * entry_cap * n_cols * 8);
+  L.off_tags = off;
+  off = align64(off + n_slots * entry_cap * 8);
+  L.off_n = off;
+  off = align64(off + n_slots * 4);
+  L.off_state = off;
+  off = align64(off + n_slots * 1);
+  L.total = off;
+  return L;
+}
+
+// Bind the handle's array pointers into an arena whose header carries
+// the shape (creator already wrote it / attacher validated it).
+inline void bind_arena(HotTable* t) {
+  char* b = (char*)t->base;
+  t->hdr = (ArenaHeader*)b;
+  t->n_slots = t->hdr->n_slots;
+  t->mask = t->n_slots - 1;
+  t->max_probe = t->n_slots < 128 ? t->n_slots : 128;
+  t->n_cols = t->hdr->n_cols;
+  t->entry_cap = t->hdr->entry_cap;
+  ArenaLayout L = layout_for(t->n_slots, t->n_cols, t->entry_cap);
+  t->stamp = (std::atomic<uint64_t>*)(b + L.off_stamp);
+  t->key = (std::atomic<int64_t>*)(b + L.off_key);
+  t->gen = (int64_t*)(b + L.off_gen);
+  t->ns = (int64_t*)(b + L.off_ns);
+  t->vals = (int64_t*)(b + L.off_vals);
+  t->tags = (uint64_t*)(b + L.off_tags);
+  t->n = (int32_t*)(b + L.off_n);
+  t->state = (std::atomic<uint8_t>*)(b + L.off_state);
+}
+
+// Owner-session epoch: unique across restarts of the same path (wall
+// ns xor pid — two owner generations can never collide in practice,
+// and equality is only ever used as a cheap "did the owner restart
+// under me" check, never as an identity the data depends on).
+inline uint64_t fresh_epoch() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  uint64_t e = (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+  e ^= ((uint64_t)getpid()) << 48;
+  return e ? e : 1;
+}
+
+// Fill a fresh (zeroed) arena's header for a shape. The zero fill
+// already IS the empty table: stamp 0 (even), state kEmpty, key 0 —
+// identical to what the old per-array init stored.
+inline void init_header(HotTable* t, int64_t n_slots, int64_t n_cols,
+                        int64_t entry_cap, int64_t total) {
+  ArenaHeader* h = (ArenaHeader*)t->base;
+  h->layout_version = kLayoutVersion;
+  h->n_slots = n_slots;
+  h->n_cols = n_cols;
+  h->entry_cap = entry_cap;
+  h->arena_bytes = total;
+  h->live.store(0, std::memory_order_relaxed);
+  for (int i = 0; i < kStatCount; ++i)
+    h->stats[i].store(0, std::memory_order_relaxed);
+  for (int i = 0; i < kMaxFrontends * kFeStatCount; ++i)
+    h->fe_stats[i].store(0, std::memory_order_relaxed);
+  h->epoch.store(fresh_epoch(), std::memory_order_relaxed);
+  // magic LAST: an attacher that raced the create sees 0 and refuses
+  h->magic.store(kMagic, std::memory_order_release);
 }
 
 // ---- writer-side slot lock (the seqlock write half). Callers hold
@@ -184,9 +356,11 @@ inline void write_payload(HotTable* t, int64_t j, int64_t k, int64_t g,
 inline void erase_slot(HotTable* t, int64_t j) {
   if (t->state[j].load(std::memory_order_relaxed) == kLive) {
     t->state[j].store(kTomb, std::memory_order_relaxed);
-    t->live.fetch_sub(1, std::memory_order_relaxed);
+    t->hdr->live.fetch_sub(1, std::memory_order_relaxed);
   }
 }
+
+inline bool can_write(HotTable* t) { return t->mode != kModeAttached; }
 
 }  // namespace
 
@@ -197,38 +371,112 @@ void* hc_create(int64_t max_entries, int64_t n_cols, int64_t entry_cap) {
     return nullptr;
   HotTable* t = new HotTable();
   // load factor <= 0.5: probes stay inside a short window
-  t->n_slots = pow2_at_least(max_entries * 2);
-  t->mask = t->n_slots - 1;
-  t->max_probe = t->n_slots < 128 ? t->n_slots : 128;
-  t->n_cols = n_cols;
-  t->entry_cap = entry_cap;
-  for (int i = 0; i < kStatCount; ++i) t->stats[i].store(0);
-  t->stamp = new std::atomic<uint64_t>[t->n_slots];
-  t->state = new std::atomic<uint8_t>[t->n_slots];
-  t->key = new std::atomic<int64_t>[t->n_slots];
-  for (int64_t i = 0; i < t->n_slots; ++i) {
-    t->stamp[i].store(0, std::memory_order_relaxed);
-    t->state[i].store(kEmpty, std::memory_order_relaxed);
-    t->key[i].store(0, std::memory_order_relaxed);
-  }
-  t->gen = (int64_t*)std::calloc(t->n_slots, sizeof(int64_t));
-  t->n = (int32_t*)std::calloc(t->n_slots, sizeof(int32_t));
-  t->ns = (int64_t*)std::calloc(t->n_slots * entry_cap, sizeof(int64_t));
-  t->vals = (int64_t*)std::calloc(t->n_slots * entry_cap * n_cols,
-                                  sizeof(int64_t));
-  t->tags =
-      (uint64_t*)std::calloc(t->n_slots * entry_cap, sizeof(uint64_t));
-  if (!t->gen || !t->n || !t->ns || !t->vals || !t->tags) {
+  int64_t n_slots = pow2_at_least(max_entries * 2);
+  ArenaLayout L = layout_for(n_slots, n_cols, entry_cap);
+  t->mode = kModePrivate;
+  t->base = std::calloc(1, (size_t)L.total);
+  if (t->base == nullptr) {
     delete t;
     return nullptr;
   }
+  init_header(t, n_slots, n_cols, entry_cap, L.total);
+  bind_arena(t);
+  return t;
+}
+
+// Owner-side shared create: the same table in a MAP_SHARED file arena
+// that frontend processes hc_attach. The path must be on a mmap-able
+// filesystem (/dev/shm for a RAM-backed table); ftruncate zero-fills,
+// which IS the empty table. The caller owns the file's lifecycle
+// (unlink after hc_destroy); re-creating a table always uses a FRESH
+// path — an in-place truncate under a live mapper would fault it.
+void* hc_create_shared(const char* path, int64_t max_entries,
+                       int64_t n_cols, int64_t entry_cap) {
+  if (path == nullptr || max_entries <= 0 || n_cols <= 0 ||
+      n_cols > 63 || entry_cap <= 0)
+    return nullptr;
+  int64_t n_slots = pow2_at_least(max_entries * 2);
+  ArenaLayout L = layout_for(n_slots, n_cols, entry_cap);
+  int fd = open(path, O_RDWR | O_CREAT | O_TRUNC, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)L.total) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, (size_t)L.total, PROT_READ | PROT_WRITE,
+                    MAP_SHARED, fd, 0);
+  close(fd);  // the mapping keeps the pages
+  if (base == MAP_FAILED) return nullptr;
+  HotTable* t = new HotTable();
+  t->mode = kModeShared;
+  t->base = base;
+  t->map_bytes = (size_t)L.total;
+  init_header(t, n_slots, n_cols, entry_cap, L.total);
+  bind_arena(t);
+  return t;
+}
+
+// Frontend-side attach: map an existing shared arena. The mapping is
+// PROT_WRITE because attached probes still bump the shared stat words
+// and their per-frontend counters — but the TABLE write entry points
+// all refuse on an attached handle (owner-exclusive write is by role).
+// Returns nullptr when the file is missing, not yet initialized
+// (magic unset — creator mid-init), or shape-inconsistent.
+void* hc_attach(const char* path) {
+  if (path == nullptr) return nullptr;
+  int fd = open(path, O_RDWR);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || (int64_t)st.st_size < kHeaderBytes) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                    MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return nullptr;
+  ArenaHeader* h = (ArenaHeader*)base;
+  if (h->magic.load(std::memory_order_acquire) != kMagic ||
+      h->layout_version != kLayoutVersion ||
+      h->arena_bytes != (int64_t)st.st_size || h->n_slots <= 0 ||
+      h->n_cols <= 0 || h->n_cols > 63 || h->entry_cap <= 0 ||
+      (h->n_slots & (h->n_slots - 1)) != 0) {
+    munmap(base, (size_t)st.st_size);
+    return nullptr;
+  }
+  ArenaLayout L = layout_for(h->n_slots, h->n_cols, h->entry_cap);
+  if (L.total != (int64_t)st.st_size) {
+    munmap(base, (size_t)st.st_size);
+    return nullptr;
+  }
+  HotTable* t = new HotTable();
+  t->mode = kModeAttached;
+  t->base = base;
+  t->map_bytes = (size_t)st.st_size;
+  bind_arena(t);
   return t;
 }
 
 void hc_destroy(void* h) { delete (HotTable*)h; }
 
+// Owner-session word: an attached frontend compares this against the
+// epoch its manifest promised — a mismatch means a NEW owner built a
+// new arena at this path's slot and the frontend must re-attach.
+int64_t hc_epoch(void* h) {
+  return (int64_t)((HotTable*)h)
+      ->hdr->epoch.load(std::memory_order_acquire);
+}
+
+int64_t hc_arena_bytes(void* h) {
+  return ((HotTable*)h)->hdr->arena_bytes;
+}
+
+int64_t hc_is_attached(void* h) {
+  return ((HotTable*)h)->mode == kModeAttached ? 1 : 0;
+}
+
 int64_t hc_len(void* h) {
-  return ((HotTable*)h)->live.load(std::memory_order_relaxed);
+  return ((HotTable*)h)->hdr->live.load(std::memory_order_relaxed);
 }
 
 int64_t hc_capacity(void* h) { return ((HotTable*)h)->n_slots; }
@@ -236,7 +484,7 @@ int64_t hc_capacity(void* h) { return ((HotTable*)h)->n_slots; }
 int64_t hc_stat(void* h, int32_t which) {
   HotTable* t = (HotTable*)h;
   if (which < 0 || which >= kStatCount) return -1;
-  return t->stats[which].load(std::memory_order_relaxed);
+  return t->hdr->stats[which].load(std::memory_order_relaxed);
 }
 
 void hc_add_stat(void* h, int32_t which, int64_t delta) {
@@ -244,11 +492,40 @@ void hc_add_stat(void* h, int32_t which, int64_t delta) {
   // counters so stats() reads one source whatever path served
   HotTable* t = (HotTable*)h;
   if (which < 0 || which >= kStatCount) return;
-  t->stats[which].fetch_add(delta, std::memory_order_relaxed);
+  t->hdr->stats[which].fetch_add(delta, std::memory_order_relaxed);
+}
+
+// Per-frontend counters, accumulated IN the shared header so the owner
+// reads every frontend's traffic without IPC (which = FeStat index;
+// fe is the frontend's pool slot). Wrap-around indices are rejected,
+// not clamped — a bad id must read as zero traffic, not alias slot 0.
+void hc_fe_note(void* h, int32_t fe, int64_t probes, int64_t hits,
+                int64_t torn_retries, int64_t miss_crossings) {
+  HotTable* t = (HotTable*)h;
+  if (fe < 0 || fe >= kMaxFrontends) return;
+  std::atomic<int64_t>* row = t->hdr->fe_stats + fe * kFeStatCount;
+  if (probes) row[kFeProbes].fetch_add(probes, std::memory_order_relaxed);
+  if (hits) row[kFeHits].fetch_add(hits, std::memory_order_relaxed);
+  if (torn_retries)
+    row[kFeTornRetries].fetch_add(torn_retries,
+                                  std::memory_order_relaxed);
+  if (miss_crossings)
+    row[kFeMissCrossings].fetch_add(miss_crossings,
+                                    std::memory_order_relaxed);
+}
+
+int64_t hc_fe_stat(void* h, int32_t fe, int32_t which) {
+  HotTable* t = (HotTable*)h;
+  if (fe < 0 || fe >= kMaxFrontends || which < 0 ||
+      which >= kFeStatCount)
+    return -1;
+  return t->hdr->fe_stats[fe * kFeStatCount + which].load(
+      std::memory_order_relaxed);
 }
 
 void hc_clear(void* h) {
   HotTable* t = (HotTable*)h;
+  if (!can_write(t)) return;
   std::lock_guard<std::mutex> g(t->write_mu);
   for (int64_t j = 0; j < t->n_slots; ++j) {
     uint64_t s = lock_slot(t, j);
@@ -258,22 +535,17 @@ void hc_clear(void* h) {
   }
 }
 
-// Batch probe: ONE call for the whole key batch (the serving hot
-// loop). Hit entries land COMPACTLY: key i's counts[i] entries follow
-// the previous hits' in out_ns / out_tags (and counts[i]*n_cols value
-// words in out_vals) — the caller sizes the buffers at nk*entry_cap
-// worst case and bulk-converts exactly sum(counts) entries, no
-// per-key stride walking.
-// ``exact_gen`` < 0 = presence-implies-validity (the primed serving
-// path: ANY live entry hits); >= 0 = only that generation hits.
-// A torn read (stamp moved under the copy) retries, then counts a
-// torn miss and reports MISS — never a mixed-generation row.
-// Returns the hit count.
-int64_t hc_get_batch(void* h, int64_t nk, const int64_t* keys,
-                     int64_t exact_gen, uint8_t* hit, int32_t* counts,
-                     int64_t* out_gen, int64_t* out_ns, int64_t* out_vals,
-                     uint64_t* out_tags) {
-  HotTable* t = (HotTable*)h;
+namespace {
+
+// Probe core shared by hc_get_batch (in-process) and hc_get_batch_fe
+// (attached frontends — same probe, plus per-frontend attribution).
+// Torn counts report back so the frontend variant attributes them
+// without a racy read of the SHARED cumulative stat words.
+int64_t probe_batch(HotTable* t, int64_t nk, const int64_t* keys,
+                    int64_t exact_gen, uint8_t* hit, int32_t* counts,
+                    int64_t* out_gen, int64_t* out_ns,
+                    int64_t* out_vals, uint64_t* out_tags,
+                    int64_t* o_torn_retries) {
   int64_t hits = 0;
   int64_t tot = 0;  // compact output cursor (entries)
   int64_t torn_retries = 0, torn_misses = 0;
@@ -329,14 +601,57 @@ int64_t hc_get_batch(void* h, int64_t nk, const int64_t* keys,
       if (attempt == kReadRetries - 1) ++torn_misses;
     }
   }
-  t->stats[kHits].fetch_add(hits, std::memory_order_relaxed);
-  t->stats[kMisses].fetch_add(nk - hits, std::memory_order_relaxed);
+  t->hdr->stats[kHits].fetch_add(hits, std::memory_order_relaxed);
+  t->hdr->stats[kMisses].fetch_add(nk - hits,
+                                   std::memory_order_relaxed);
   if (torn_retries)
-    t->stats[kTornRetries].fetch_add(torn_retries,
-                                     std::memory_order_relaxed);
+    t->hdr->stats[kTornRetries].fetch_add(torn_retries,
+                                          std::memory_order_relaxed);
   if (torn_misses)
-    t->stats[kTornMisses].fetch_add(torn_misses,
-                                    std::memory_order_relaxed);
+    t->hdr->stats[kTornMisses].fetch_add(torn_misses,
+                                         std::memory_order_relaxed);
+  if (o_torn_retries) *o_torn_retries = torn_retries;
+  return hits;
+}
+
+}  // namespace
+
+// Batch probe: ONE call for the whole key batch (the serving hot
+// loop). Hit entries land COMPACTLY: key i's counts[i] entries follow
+// the previous hits' in out_ns / out_tags (and counts[i]*n_cols value
+// words in out_vals) — the caller sizes the buffers at nk*entry_cap
+// worst case and bulk-converts exactly sum(counts) entries, no
+// per-key stride walking.
+// ``exact_gen`` < 0 = presence-implies-validity (the primed serving
+// path: ANY live entry hits); >= 0 = only that generation hits.
+// A torn read (stamp moved under the copy) retries, then counts a
+// torn miss and reports MISS — never a mixed-generation row.
+// Returns the hit count.
+int64_t hc_get_batch(void* h, int64_t nk, const int64_t* keys,
+                     int64_t exact_gen, uint8_t* hit, int32_t* counts,
+                     int64_t* out_gen, int64_t* out_ns, int64_t* out_vals,
+                     uint64_t* out_tags) {
+  return probe_batch((HotTable*)h, nk, keys, exact_gen, hit, counts,
+                     out_gen, out_ns, out_vals, out_tags, nullptr);
+}
+
+// Frontend probe: identical to hc_get_batch, plus the caller's
+// per-frontend attribution (probes/hits/torn_retries) folded into the
+// shared header IN the same call — the owner reads every frontend's
+// real traffic without IPC, and torn retries attribute to the frontend
+// that actually saw them (not inferrable from the shared cumulative
+// words under concurrency).
+int64_t hc_get_batch_fe(void* h, int32_t fe, int64_t nk,
+                        const int64_t* keys, int64_t exact_gen,
+                        uint8_t* hit, int32_t* counts, int64_t* out_gen,
+                        int64_t* out_ns, int64_t* out_vals,
+                        uint64_t* out_tags) {
+  HotTable* t = (HotTable*)h;
+  int64_t torn = 0;
+  int64_t hits = probe_batch(t, nk, keys, exact_gen, hit, counts,
+                             out_gen, out_ns, out_vals, out_tags,
+                             &torn);
+  hc_fe_note(h, fe, nk, hits, torn, 0);
   return hits;
 }
 
@@ -352,6 +667,7 @@ int64_t hc_put_batch(void* h, int64_t nk, const int64_t* keys,
                      const int64_t* ns, const int64_t* vals,
                      const uint64_t* tags) {
   HotTable* t = (HotTable*)h;
+  if (!can_write(t)) return 0;
   std::lock_guard<std::mutex> g(t->write_mu);
   int64_t written = 0, evictions = 0, oversize = 0;
   for (int64_t i = 0; i < nk; ++i) {
@@ -376,7 +692,7 @@ int64_t hc_put_batch(void* h, int64_t nk, const int64_t* keys,
     uint64_t s = lock_slot(t, j);
     if (found < 0) {
       if (t->state[j].load(std::memory_order_relaxed) != kLive)
-        t->live.fetch_add(1, std::memory_order_relaxed);
+        t->hdr->live.fetch_add(1, std::memory_order_relaxed);
       t->state[j].store(kLive, std::memory_order_relaxed);
     }
     write_payload(t, j, k, gens[i], cnt, ns + off[i],
@@ -384,12 +700,13 @@ int64_t hc_put_batch(void* h, int64_t nk, const int64_t* keys,
     unlock_slot(t, j, s);
     ++written;
   }
-  t->stats[kPuts].fetch_add(written, std::memory_order_relaxed);
+  t->hdr->stats[kPuts].fetch_add(written, std::memory_order_relaxed);
   if (evictions)
-    t->stats[kEvictions].fetch_add(evictions, std::memory_order_relaxed);
+    t->hdr->stats[kEvictions].fetch_add(evictions,
+                                        std::memory_order_relaxed);
   if (oversize)
-    t->stats[kOversizeDrops].fetch_add(oversize,
-                                       std::memory_order_relaxed);
+    t->hdr->stats[kOversizeDrops].fetch_add(oversize,
+                                            std::memory_order_relaxed);
   return written;
 }
 
@@ -411,6 +728,7 @@ int64_t hc_prime_batch(void* h, int64_t nk, const int64_t* keys,
                        const uint64_t* u_tags, const int64_t* roff,
                        const int64_t* r_ns, const uint8_t* flags) {
   HotTable* t = (HotTable*)h;
+  if (!can_write(t)) return 0;
   std::lock_guard<std::mutex> g(t->write_mu);
   int64_t primed = 0, evictions = 0, oversize = 0;
   // scratch for the merged entry
@@ -500,7 +818,7 @@ int64_t hc_prime_batch(void* h, int64_t nk, const int64_t* keys,
     uint64_t s = lock_slot(t, j);
     if (found < 0) {
       if (t->state[j].load(std::memory_order_relaxed) != kLive)
-        t->live.fetch_add(1, std::memory_order_relaxed);
+        t->hdr->live.fetch_add(1, std::memory_order_relaxed);
       t->state[j].store(kLive, std::memory_order_relaxed);
     }
     write_payload(t, j, k, gen, m, m_ns, m_vals, m_tags);
@@ -510,12 +828,13 @@ int64_t hc_prime_batch(void* h, int64_t nk, const int64_t* keys,
   std::free(m_ns);
   std::free(m_vals);
   std::free(m_tags);
-  t->stats[kPrimes].fetch_add(primed, std::memory_order_relaxed);
+  t->hdr->stats[kPrimes].fetch_add(primed, std::memory_order_relaxed);
   if (evictions)
-    t->stats[kEvictions].fetch_add(evictions, std::memory_order_relaxed);
+    t->hdr->stats[kEvictions].fetch_add(evictions,
+                                        std::memory_order_relaxed);
   if (oversize)
-    t->stats[kOversizeDrops].fetch_add(oversize,
-                                       std::memory_order_relaxed);
+    t->hdr->stats[kOversizeDrops].fetch_add(oversize,
+                                            std::memory_order_relaxed);
   return primed;
 }
 
@@ -528,6 +847,7 @@ int64_t hc_migrate(void* dst_h, void* src_h) {
   HotTable* src = (HotTable*)src_h;
   if (dst->n_cols != src->n_cols || dst->entry_cap != src->entry_cap)
     return -1;
+  if (!can_write(dst)) return -1;
   std::lock_guard<std::mutex> gs(src->write_mu);
   std::lock_guard<std::mutex> gd(dst->write_mu);
   int64_t moved = 0;
@@ -542,7 +862,7 @@ int64_t hc_migrate(void* dst_h, void* src_h) {
     uint64_t s = lock_slot(dst, t);
     if (found < 0) {
       if (dst->state[t].load(std::memory_order_relaxed) != kLive)
-        dst->live.fetch_add(1, std::memory_order_relaxed);
+        dst->hdr->live.fetch_add(1, std::memory_order_relaxed);
       dst->state[t].store(kLive, std::memory_order_relaxed);
     }
     write_payload(dst, t, k, src->gen[j], src->n[j],
@@ -562,6 +882,7 @@ int64_t hc_migrate(void* dst_h, void* src_h) {
 // was found and its stamp flipped.
 int64_t hc_debug_lock_slot(void* h, int64_t key) {
   HotTable* t = (HotTable*)h;
+  if (!can_write(t)) return 0;
   std::lock_guard<std::mutex> g(t->write_mu);
   int64_t found, insert;
   bool evict;
@@ -574,6 +895,7 @@ int64_t hc_debug_lock_slot(void* h, int64_t key) {
 
 int64_t hc_debug_unlock_slot(void* h, int64_t key) {
   HotTable* t = (HotTable*)h;
+  if (!can_write(t)) return 0;
   std::lock_guard<std::mutex> g(t->write_mu);
   int64_t found, insert;
   bool evict;
@@ -586,6 +908,7 @@ int64_t hc_debug_unlock_slot(void* h, int64_t key) {
 
 void hc_drop(void* h, int64_t key) {
   HotTable* t = (HotTable*)h;
+  if (!can_write(t)) return;
   std::lock_guard<std::mutex> g(t->write_mu);
   int64_t found, insert;
   bool evict;

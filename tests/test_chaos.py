@@ -1125,6 +1125,110 @@ class TestServingCacheProbePoint:
             serving.shutdown_workers()
 
 
+class TestServingFrontendPoint:
+    """``serving.frontend``, injected at its real site — the owner-side
+    dispatch in ``FrontendPool.lookup_batch``. The ``drop`` kind KILLS
+    the chosen frontend process for real (death mid-burst): the
+    in-flight lookup must fail over to a live sibling and the surviving
+    results stay bit-identical to the dict oracle (the owner's own
+    lookup path); owner and siblings are unharmed. ``raise`` surfaces
+    to the client as the crash path."""
+
+    def _serving_shm(self, tmp_path):
+        import queue as _q
+
+        from flink_tpu.parallel.mesh import make_mesh
+        from flink_tpu.parallel.sharded_windower import MeshWindowEngine
+        from flink_tpu.tenancy.replica import WindowReplicaAdapter
+        from flink_tpu.tenancy.serving import ServingPlane
+        from flink_tpu.windowing.aggregates import SumAggregate
+        from flink_tpu.windowing.assigners import (
+            TumblingEventTimeWindows,
+        )
+
+        eng = MeshWindowEngine(
+            TumblingEventTimeWindows(1000), SumAggregate("v"),
+            make_mesh(2), capacity_per_shard=1024, max_parallelism=128)
+        plane = eng.arm_replica()
+        ad = WindowReplicaAdapter(plane, eng.agg, eng.assigner)
+        serving = ServingPlane(workers=1,
+                               shm_dir=str(tmp_path / "shm"))
+        serving.bind_job("j", _q.Queue())
+        serving.bind_replica("j", "op", ad)
+        eng.process_batch(RecordBatch({
+            "__key_id__": np.arange(16, dtype=np.int64),
+            "__ts__": np.full(16, 100, dtype=np.int64),
+            "v": np.ones(16, dtype=np.float32),
+        }))
+        eng.on_watermark(50)  # publish + harvest-prime the shm cache
+        return eng, serving
+
+    @pytest.mark.skipif(
+        not __import__("flink_tpu.native", fromlist=["x"])
+        .hotcache_available(),
+        reason="native hotcache unavailable")
+    def test_drop_kind_kills_frontend_failover_bit_identical(
+            self, tmp_path):
+        from flink_tpu.tenancy.frontend import FrontendPool
+
+        eng, serving = self._serving_shm(tmp_path)
+        pool = None
+        keys = list(range(8))
+        try:
+            want = serving.lookup_batch("j", "op", keys)  # dict oracle
+            pool = FrontendPool(serving, n_frontends=2)
+            assert pool.lookup_batch("j", "op", keys) == want
+            plan = FaultPlan(rules=[
+                FaultRule(pattern="serving.frontend", kind="drop",
+                          nth=1)])
+            with chaos.chaos_active(plan, seed=0) as c:
+                got = pool.lookup_batch("j", "op", keys)
+                assert c.faults_injected.get("serving.frontend",
+                                             0) >= 1
+                _note_reached(c.faults_injected)
+            # the killed frontend's in-flight lookup failed over to the
+            # sibling, bit-identical to the oracle
+            assert got == want
+            assert pool.failovers >= 1
+            assert len(pool.live_frontends()) == 1
+            # owner and sibling unharmed: both paths still serve
+            assert pool.lookup_batch("j", "op", keys) == want
+            assert serving.lookup_batch("j", "op", keys) == want
+        finally:
+            if pool is not None:
+                pool.close()
+            serving.shutdown_workers()
+            serving.hot_cache.close()
+
+    @pytest.mark.skipif(
+        not __import__("flink_tpu.native", fromlist=["x"])
+        .hotcache_available(),
+        reason="native hotcache unavailable")
+    def test_raise_kind_surfaces_to_client(self, tmp_path):
+        from flink_tpu.tenancy.frontend import FrontendPool
+
+        eng, serving = self._serving_shm(tmp_path)
+        pool = None
+        try:
+            pool = FrontendPool(serving, n_frontends=1)
+            plan = FaultPlan(rules=[
+                FaultRule(pattern="serving.frontend", nth=1)])
+            with chaos.chaos_active(plan, seed=0) as c:
+                with pytest.raises(InjectedFault):
+                    pool.lookup_batch("j", "op", [1, 2, 3])
+                assert c.faults_injected.get("serving.frontend",
+                                             0) == 1
+                _note_reached(c.faults_injected)
+            # disarmed again: the frontend path is intact
+            assert pool.lookup_batch("j", "op", [1]) == \
+                serving.lookup_batch("j", "op", [1])
+        finally:
+            if pool is not None:
+                pool.close()
+            serving.shutdown_workers()
+            serving.hot_cache.close()
+
+
 class TestWatchdogPoints:
     """The partial-failover fault points, injected at their real sites:
     ``device.lost`` fires inside the watchdog's batch-boundary probe on
@@ -1531,7 +1635,15 @@ class TestZZFaultPointReachability:
     above."""
 
     def test_every_fault_point_injected_at_least_once(self):
-        missing = [p for p in KNOWN_FAULT_POINTS
+        from flink_tpu.native import hotcache_available
+
+        known = list(KNOWN_FAULT_POINTS)
+        if not hotcache_available():
+            # the frontend-pool dispatch site cannot be built without
+            # the native shm plane (FrontendPool refuses) — its tests
+            # skip above, so the point is unreachable by construction
+            known.remove("serving.frontend")
+        missing = [p for p in known
                    if REACHED.get(p, 0) < 1]
         assert not missing, (
             f"fault points never injected across the suite: {missing} "

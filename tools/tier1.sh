@@ -196,4 +196,18 @@ if [ "${SKIP_BENCH_SMOKE:-0}" != "1" ]; then
   SERVING_SMOKE_RECORDS=$((1 << 17)) \
     JAX_PLATFORMS=cpu timeout -k 10 300 \
     python tools/serving_smoke.py || exit 1
+
+  # Frontend smoke: the MULTI-PROCESS serving tier — 2 frontend
+  # processes attach the owner's shm hot-cache arenas and serve the
+  # hit path in their own processes (seqlock probes over MAP_SHARED,
+  # misses crossing to the owner's replica path). Phase 1 fuzzes the
+  # cross-process seqlock: readers probe while the owner primes
+  # generation after generation — FAILS on ANY torn read surfacing
+  # (generation-deterministic value oracle) or a vacuous overlap.
+  # Phase 2 runs real ingest + frontend lookup load — FAILS on
+  # owner/frontend parity divergence, replica staleness p99 over 2 s,
+  # zero frontend shm hits (hit rate must be > 0), or a dead pool.
+  # ~15 s on CPU.
+  JAX_PLATFORMS=cpu timeout -k 10 300 \
+    python tools/frontend_smoke.py || exit 1
 fi
