@@ -9,4 +9,26 @@ from flink_tpu.cep.operator import CEP, CepOperator, PatternStream
 from flink_tpu.cep.pattern import AfterMatchSkipStrategy, Pattern
 
 __all__ = ["AfterMatchSkipStrategy", "CEP", "CepOperator", "KeyNFA",
-           "Match", "Pattern", "PatternStream"]
+           "Match", "MeshCepEngine", "MeshCepOperator", "Pattern",
+           "PatternStream", "UnsupportedCepPattern",
+           "compile_device_pattern"]
+
+
+def __getattr__(name):
+    # the device engine pulls in jax + the state-plane stack; keep the
+    # host-only CEP API importable without that weight
+    if name in ("MeshCepEngine", "CepMatchReplicaAdapter",
+                "record_host_fallback", "host_fallbacks"):
+        from flink_tpu.cep import mesh_engine
+
+        return getattr(mesh_engine, name)
+    if name in ("UnsupportedCepPattern", "compile_device_pattern",
+                "DevicePatternLayout"):
+        from flink_tpu.cep import kernels
+
+        return getattr(kernels, name)
+    if name == "MeshCepOperator":
+        from flink_tpu.cep.operators import MeshCepOperator
+
+        return MeshCepOperator
+    raise AttributeError(name)

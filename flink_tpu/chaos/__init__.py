@@ -39,6 +39,8 @@ KNOWN_FAULT_POINTS = (
     "rebalance.handoff",
     "join.exchange",
     "join.versioned_lookup",
+    "cep.advance",
+    "cep.match_fire",
     "serving.lookup",
     "serving.replica_publish",
     "serving.cache_probe",

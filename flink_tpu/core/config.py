@@ -350,6 +350,21 @@ class DeploymentOptions:
         "gathers/intersects/emits each batch's candidates "
         "(flink_tpu/joins/). Outer joins and the SQL planner's join "
         "operators stay on the host path regardless of this option.")
+    CEP_MODE = ConfigOption(
+        "cep.mode", default="host", type=str,
+        description="Execution plane for CEP pattern matching "
+        "(CEP.pattern() and SQL MATCH_RECOGNIZE): 'host' (default) "
+        "threads each key's NFA through the Python per-event loop "
+        "(cep/operator.py — also the semantics oracle); 'device' keeps "
+        "per-key computation states as [P, capacity] bitmask columns "
+        "on the key-group mesh and advances ALL keys' NFAs with one "
+        "compiled gather/scan/scatter program per fire "
+        "(flink_tpu/cep/mesh_engine.py), with completed matches "
+        "queryable through the replica plane. Only bounded-partial "
+        "patterns (fixed-length sequences, consecutive times(), "
+        "SKIP_PAST_LAST_EVENT or NO_SKIP) compile to the device; "
+        "anything else falls back LOUDLY to the host operator "
+        "(cep.host_fallbacks metric).")
     SHUFFLE_SERVICE = ConfigOption(
         "shuffle.service", default="local", type=str,
         description="Registered ShuffleService transport connecting "

@@ -5,6 +5,7 @@
 #: a plain literal tuple: flint parses it statically.
 KNOWN_METRIC_GROUPS = (
     "autoscale",
+    "cep",
     "chaos",
     "flight",
     "frontends",

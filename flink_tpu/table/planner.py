@@ -355,10 +355,36 @@ class Planner:
 
         keyed = source.stream.key_by(key_col)
         pat = pat.validate()
+        # cep.mode=device routes the row pattern onto the mesh NFA
+        # engine (one compiled advance per fire, matches queryable via
+        # the replica plane). Eligibility is checked HERE so the plan
+        # explains itself: an ineligible pattern plans the host
+        # operator with the loud fallback counter, not a job failure.
+        from flink_tpu.core.config import DeploymentOptions
+
+        cep_mode = self.env.config.get(DeploymentOptions.CEP_MODE)
+        if cep_mode == "device":
+            from flink_tpu.cep.kernels import UnsupportedCepPattern
+            from flink_tpu.cep.kernels import compile_device_pattern
+            from flink_tpu.cep.mesh_engine import record_host_fallback
+
+            try:
+                compile_device_pattern(pat)
+            except UnsupportedCepPattern as e:
+                record_host_fallback(
+                    f"MATCH_RECOGNIZE {mr.alias or ''}: {e}")
+                cep_mode = "host"
+        if cep_mode == "device":
+            from flink_tpu.cep.operators import MeshCepOperator
+
+            factory = (lambda pat=pat, key_col=key_col, sel=select:
+                       MeshCepOperator(pat, key_col, select=sel))
+        else:
+            factory = (lambda pat=pat, key_col=key_col, sel=select:
+                       CepOperator(pat, key_col, select=sel))
         t = Transformation(
             name="sql_match_recognize", kind="one_input",
-            operator_factory=lambda pat=pat, key_col=key_col, sel=select:
-                CepOperator(pat, key_col, select=sel),
+            operator_factory=factory,
             inputs=[keyed.transformation], keyed=True, key_field=key_col)
         out_cols = [key_col] + [alias for _, _, _, alias in measures]
         return PlannedTable(DataStream(self.env, t), out_cols, mr.alias,

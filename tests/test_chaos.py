@@ -1629,6 +1629,210 @@ class TestJoinVersionedLookupPoint:
         _note_reached(report.faults_injected)
 
 
+class _CepHarnessEngine:
+    """CEP adapter for the crash-restore harness: the pattern is a
+    2-stage strict sequence over the value stream (``v%3==0`` then
+    ``v%3==1``, within 120), SKIP_PAST_LAST_EVENT — device-eligible.
+    Each emitted match maps to the harness upsert cell
+    ``(key, start_ts, end_ts+1)`` with the stage counts as the value,
+    so a lost/duplicated event changes which matches form — it can
+    shift a cell, drop a cell, or change a count, never hide."""
+
+    def __init__(self, backend="device", shards=2):
+        from flink_tpu.cep.mesh_engine import MeshCepEngine
+        from flink_tpu.cep.pattern import (
+            AfterMatchSkipStrategy,
+            Pattern,
+        )
+
+        pat = (Pattern.begin(
+                "a", skip=AfterMatchSkipStrategy.SKIP_PAST_LAST_EVENT)
+               .where(lambda b: np.asarray(b["v"]) % 3 == 0)
+               .next("b")
+               .where(lambda b: np.asarray(b["v"]) % 3 == 1)
+               .within(120))
+        if backend == "device":
+            from flink_tpu.parallel.mesh import make_mesh
+
+            self.eng = MeshCepEngine(pat, mesh=make_mesh(shards),
+                                     capacity_per_shard=256,
+                                     backend="device")
+        else:
+            self.eng = MeshCepEngine(pat, num_shards=shards,
+                                     backend="host",
+                                     shuffle_mode="host")
+
+    @property
+    def P(self):
+        return self.eng.P
+
+    def reshard(self, n):
+        return self.eng.reshard(n)
+
+    def process_batch(self, batch):
+        self.eng.process_batch(batch)
+
+    def on_watermark(self, wm, async_ok=False):
+        from flink_tpu.core.records import (
+            KEY_ID_FIELD,
+            TIMESTAMP_FIELD,
+            RecordBatch,
+        )
+        from flink_tpu.windowing.windower import (
+            WINDOW_END_FIELD,
+            WINDOW_START_FIELD,
+        )
+
+        out = []
+        for b in self.eng.on_watermark(int(wm)):
+            rows = b.to_rows()
+            out.append(RecordBatch({
+                KEY_ID_FIELD: np.asarray(
+                    [r["key"] for r in rows], dtype=np.int64),
+                WINDOW_START_FIELD: np.asarray(
+                    [r["start_ts"] for r in rows], dtype=np.int64),
+                WINDOW_END_FIELD: np.asarray(
+                    [r["end_ts"] + 1 for r in rows], dtype=np.int64),
+                TIMESTAMP_FIELD: np.asarray(b.timestamps,
+                                            dtype=np.int64),
+                "val": np.asarray(
+                    [r["a_count"] * 10 + r["b_count"] for r in rows],
+                    dtype=np.float64),
+            }))
+        return out
+
+    def snapshot(self):
+        return self.eng.snapshot()
+
+    def restore(self, snap):
+        self.eng.restore(snap)
+
+
+def _cep_steps(n_steps=6, n=96, keys=24, seed=13):
+    """Value stream for the CEP pattern: small integers so the 0-mod-3
+    -> 1-mod-3 sequence occurs often per key; timestamps advance 60
+    per step with in-step spread, watermark trails one step."""
+    rng = np.random.default_rng(seed)
+    steps = []
+    for i in range(n_steps):
+        ks = rng.integers(0, keys, n)
+        vs = rng.integers(0, 9, n).astype(np.float32)
+        ts = i * 60 + np.sort(rng.integers(0, 60, n)).astype(np.int64)
+        steps.append((ks, vs, ts, i * 60 - 30))
+    return steps
+
+
+class TestCepAdvancePoint:
+    """The CEP data plane's fault points at their real sites: a raise
+    at ``cep.advance`` (post-dispatch, ingest) crashes mid-batch with
+    the pending scatter already on the device queue — crash-restore
+    must stay oracle-identical — and a DROPPED device-exchange bucket
+    must DIVERGE (the negative control: the harness catches genuine
+    loss in the CEP pending plane)."""
+
+    def test_cep_crash_restore_oracle_identical(self, tmp_path):
+        # nth=4 = step 4's ingest: past the first checkpoint, so the
+        # recovery is a genuine RESTORE (not a cold restart)
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="cep.advance", nth=4)])
+        report = run_crash_restore_verify(
+            make_engine=lambda: _CepHarnessEngine("device"),
+            make_oracle=lambda: _CepHarnessEngine("host"),
+            steps=_cep_steps(), plan=plan, seed=11,
+            ckpt_root=str(tmp_path))
+        assert report.crashes >= 1 and report.restores >= 1
+        assert report.faults_injected.get("cep.advance", 0) >= 1
+        assert not report.diverged
+        assert report.windows > 0
+        _note_reached(report.faults_injected)
+
+    def test_cep_crash_restore_is_deterministic(self, tmp_path):
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="cep.advance", nth=4)])
+        sigs = []
+        for i in range(2):
+            r = run_crash_restore_verify(
+                make_engine=lambda: _CepHarnessEngine("device"),
+                make_oracle=lambda: _CepHarnessEngine("host"),
+                steps=_cep_steps(), plan=plan, seed=11,
+                ckpt_root=str(tmp_path / f"run{i}"))
+            sigs.append(r.signature())
+        assert sigs[0] == sigs[1]
+
+    def test_dropped_cep_exchange_diverges(self, tmp_path):
+        # negative control: one shard's staged CEP columns vanish in
+        # flight (re-routed to the padding destination) — the device
+        # pending rows keep hits=0 while the host mirror retains the
+        # real events, so those matches never fire and the diff MUST
+        # catch it
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="shuffle.device_exchange", nth=2,
+                      kind="drop")])
+        report = run_crash_restore_verify(
+            make_engine=lambda: _CepHarnessEngine("device"),
+            make_oracle=lambda: _CepHarnessEngine("host"),
+            steps=_cep_steps(), plan=plan, seed=11,
+            ckpt_root=str(tmp_path), check=False)
+        assert report.faults_injected.get(
+            "shuffle.device_exchange", 0) >= 1
+        assert report.diverged, (
+            "a dropped CEP exchange bucket produced identical output "
+            "— the harness cannot catch CEP data-plane loss")
+        _note_reached(report.faults_injected)
+
+    def test_advance_injection_at_real_site(self):
+        from flink_tpu.core.records import (
+            KEY_ID_FIELD,
+            RecordBatch,
+        )
+
+        eng = _CepHarnessEngine("device").eng
+        b = RecordBatch.from_pydict(
+            {KEY_ID_FIELD: np.arange(32, dtype=np.int64),
+             "v": np.ones(32, dtype=np.float32)},
+            timestamps=np.arange(32, dtype=np.int64))
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="cep.advance", nth=1, kind="delay",
+                      delay_ms=1)])
+        with chaos.chaos_active(plan, seed=0) as c:
+            eng.process_batch(b)
+            assert c.faults_injected.get("cep.advance", 0) == 1
+            _note_reached(c.faults_injected)
+        # the batch survived the delay: pending mirrors hold the rows
+        assert sum(len(sh.p_key) for sh in eng._st) == 32
+
+
+class TestCepMatchFirePoint:
+    """``cep.match_fire`` at its real site (after the match-store
+    write, before the watermark commits): a crash there lands with
+    matches already on the device match planes but the pending rows
+    unconsumed — restore + replay must re-fire them identically."""
+
+    def test_crash_at_match_fire_restores_identical(self, tmp_path):
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="cep.match_fire", nth=3)])
+        report = run_crash_restore_verify(
+            make_engine=lambda: _CepHarnessEngine("device"),
+            make_oracle=lambda: _CepHarnessEngine("host"),
+            steps=_cep_steps(seed=29), plan=plan, seed=17,
+            ckpt_root=str(tmp_path))
+        assert report.crashes >= 1 and report.restores >= 1
+        assert report.faults_injected.get("cep.match_fire", 0) >= 1
+        assert not report.diverged
+        assert report.windows > 0
+        _note_reached(report.faults_injected)
+
+    def test_fire_injection_at_real_site(self):
+        eng = _CepHarnessEngine("device").eng
+        plan = FaultPlan(rules=[
+            FaultRule(pattern="cep.match_fire", nth=1, kind="delay",
+                      delay_ms=1)])
+        with chaos.chaos_active(plan, seed=0) as c:
+            eng.on_watermark(10)
+            assert c.faults_injected.get("cep.match_fire", 0) == 1
+            _note_reached(c.faults_injected)
+
+
 class TestZZFaultPointReachability:
     """Must run LAST in this file (pytest preserves definition order):
     every fault point of the CANONICAL inventory was injected somewhere

@@ -274,3 +274,76 @@ class TestMatchRecognizeSQL:
                   DEFINE A AS A.price < 2
                 ) AS m
             """)
+
+
+class TestMatchRecognizeDeviceRouting:
+    """cep.mode=device routes MATCH_RECOGNIZE onto the mesh NFA engine;
+    ineligible patterns fall back LOUDLY to the host operator."""
+
+    _QUERY = """
+        SELECT sym, n_up, total FROM {t} MATCH_RECOGNIZE (
+          PARTITION BY sym ORDER BY ts
+          MEASURES COUNT(UP.price) AS n_up, SUM(UP.price) AS total
+          AFTER MATCH SKIP PAST LAST ROW
+          PATTERN (LO UP{{2}})
+          DEFINE LO AS LO.price < 2,
+                 UP AS UP.price > 4
+        ) AS m
+    """
+
+    def _env(self, mode):
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.micro-batch.size": 4, "cep.mode": mode}))
+        return StreamTableEnvironment(env)
+
+    def _ddl(self, tenv, topic):
+        tenv.execute_sql(
+            f"CREATE TABLE {topic} (sym BIGINT, price DOUBLE, "
+            "ts BIGINT, WATERMARK FOR ts AS ts) "
+            f"WITH ('connector'='kafka', 'topic'='{topic}')")
+
+    def test_device_mode_plans_mesh_operator_bit_identical(self):
+        from flink_tpu.cep import mesh_engine
+
+        prices = [1, 5, 6, 2, 1, 5, 6, 7, 2]
+        syms = [0, 0, 0, 0, 1, 1, 1, 1, 1]
+        _ticks("mrd1", prices, syms)
+        tenv = self._env("host")
+        self._ddl(tenv, "mrd1")
+        host_rows = tenv.execute_sql(
+            self._QUERY.format(t="mrd1")).collect()
+
+        _ticks("mrd2", prices, syms)
+        tenv = self._env("device")
+        self._ddl(tenv, "mrd2")
+        before = mesh_engine.host_fallbacks()
+        dev_rows = tenv.execute_sql(
+            self._QUERY.format(t="mrd2")).collect()
+        assert mesh_engine.host_fallbacks() == before  # no fallback
+        key = lambda r: (r["sym"], r["n_up"], r["total"])  # noqa: E731
+        assert sorted(dev_rows, key=key) == sorted(host_rows, key=key)
+        assert len(dev_rows) == 2
+
+    def test_ineligible_pattern_falls_back_loudly(self):
+        from flink_tpu.cep import mesh_engine
+
+        # B+ is greedy by SQL default -> outside the bounded-partial
+        # device class -> the plan routes to the host operator and the
+        # fallback counter ticks (never a job failure)
+        _ticks("mrd3", [1, 5, 6, 9])
+        tenv = self._env("device")
+        self._ddl(tenv, "mrd3")
+        before = mesh_engine.host_fallbacks()
+        rows = tenv.execute_sql("""
+            SELECT sym, cnt FROM mrd3 MATCH_RECOGNIZE (
+              PARTITION BY sym ORDER BY ts
+              MEASURES COUNT(UP.price) AS cnt
+              AFTER MATCH SKIP PAST LAST ROW
+              PATTERN (LO UP+ HI)
+              DEFINE LO AS LO.price < 2,
+                     UP AS UP.price > 4 AND UP.price < 9,
+                     HI AS HI.price >= 9
+            ) AS m
+        """).collect()
+        assert mesh_engine.host_fallbacks() == before + 1
+        assert [r["cnt"] for r in rows] == [2]

@@ -448,6 +448,31 @@ def _join_rows():
     return [json.loads(ln) for ln in lines[-2:]]
 
 
+def row_cep():
+    """Device-vectorized CEP at the row-5 thrashing shape: a 2-stage
+    within-window sequence over 10M keys, live partials >> device
+    budget (forced paged eviction), raced against the host CepOperator
+    oracle at the same shape — the bench FAILS itself if the device
+    engine loses or the spill tier never engages. Subprocess for the
+    virtual-device flag, like row5b."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.setdefault("BENCH_CEP_RECORDS", str(int(4_000_000 * SCALE)))
+    env.setdefault("BENCH_CEP_REQUIRE_SPILL", "1")
+    env.setdefault("BENCH_CEP_REQUIRE_WIN", "1")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "bench_cep.py")],
+        capture_output=True, text=True, env=env, timeout=3600)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("{")]
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError((proc.stderr or proc.stdout).strip()[-300:])
+    return json.loads(lines[-1])
+
+
 _JOIN_CACHE = {}
 
 
@@ -471,6 +496,7 @@ ROWS = [("wordcount_socket", row1_wordcount),
         ("shard_loss_recovery", row7_shard_loss_recovery),
         ("nexmark_q8_windowed_join", _join_row(0)),
         ("interval_join_10m_keys", _join_row(1)),
+        ("cep_patterns_10m_keys", row_cep),
         ("mesh_sessions_2proc", row8_mesh_sessions_2proc),
         ("serving_mp_lookups", row9_serving_mp)]
 
@@ -543,7 +569,7 @@ def main():
         if r.get("shuffle_mode"):
             extra += f", {r['shuffle_mode']}-mode shuffle"
         if r.get("matches"):
-            extra += f" — {r['matches']:,} joined pairs"
+            extra += f" — {r['matches']:,} matches"
         if r.get("fire_latency_ms"):
             lat = r["fire_latency_ms"]
             conf = (" LOW-CONFIDENCE (n<30)"
@@ -711,6 +737,26 @@ def main():
         "budget) and FAILS as vacuous if spill never engages; "
         "`tools/join_smoke.py` gates the same engine bit-identical to "
         "its host-numpy oracle in tier-1.")
+    lines.append("")
+    lines.append(
+        "CEP row (r22): `tools/bench_cep.py` drives the "
+        "device-vectorized mesh NFA engine "
+        "(`flink_tpu/cep/mesh_engine.py` — per-key computation states "
+        "as int32 bitmask columns on the state plane, ONE compiled "
+        "gather/scan/scatter advance program per fire, design in "
+        "NOTES_r22.md) at the row-5 thrashing shape: a 2-stage "
+        "within-window sequence over 10M keys whose live partial set "
+        "sits far above the device budget, so the paged tier churns "
+        "(asserted — `BENCH_CEP_REQUIRE_SPILL` fails a vacuous run). "
+        "The SAME shape runs on the host `CepOperator` NFA — the "
+        "bit-identity oracle every CEP gate diffs against — and the "
+        "row reports `speedup_vs_host`; `BENCH_CEP_REQUIRE_WIN` makes "
+        "a device loss a bench failure, not a footnote. "
+        "`fire_latency_ms` is the emit latency from a watermark "
+        "advance to matches on the host; `tools/cep_smoke.py` gates "
+        "the engine bit-identical (values AND emission order) to the "
+        "oracle in tier-1, including a replica-plane matched-pattern "
+        "lookup leg.")
     lines.append("")
     lines.append(
         "The shard-loss-recovery row runs `tools/chaos_smoke.py`'s "

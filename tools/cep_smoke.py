@@ -1,0 +1,218 @@
+"""CEP smoke (tier-1 gate): the device-vectorized mesh NFA engine
+against the host ``CepOperator`` oracle.
+
+FAILS on:
+- ORACLE DIVERGENCE: any emitted match differing — bit-for-bit,
+  INCLUDING emission order — between the device engine and the host
+  backend, for a 3-stage within-window sequence under BOTH after-match
+  skip strategies (dense key space) and for an always-alive two-stage
+  pattern under FORCED paged eviction (live key set >> device budget,
+  spill tier armed).
+- VACUOUS RUN: every leg must emit matches, and the eviction leg must
+  genuinely churn the spill tier (rows_evicted > 0 AND
+  rows_reloaded > 0) — a shape drift that stops spill from engaging
+  would silently shrink what the gate covers.
+- STEADY-STATE COMPILE: after the first device pass warmed the shared
+  program cache, a FRESH engine replaying the same stream must compile
+  ZERO XLA programs (the recompile-sentinel claim, scoped to the
+  cep-advance / cep-prune program family).
+- SERVING DIVERGENCE: matched-pattern lookups through the READ-REPLICA
+  plane must agree with the live match-store probe on every key, and
+  must return > 0 rows (vacuity guard on the queryable store).
+
+    JAX_PLATFORMS=cpu python tools/cep_smoke.py
+    CEP_SMOKE_STEPS=... CEP_SMOKE_BATCH=... to scale.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+STEPS = int(os.environ.get("CEP_SMOKE_STEPS", 12))
+BATCH = int(os.environ.get("CEP_SMOKE_BATCH", 256))
+DENSE_KEYS = 40       # dense: per-key sequences actually complete
+CHURN_KEYS = 20_000   # sparse: live partials >> device budget
+BUDGET = 256          # slots/shard — the engine's floor, far below
+                      # the churn leg's live set
+
+
+def _steps(seed, n_keys):
+    """(keys, vals, ts, watermark) tuples — event time advances with a
+    trailing watermark so every fire drains that step's pending set."""
+    rng = np.random.default_rng(seed)
+    ts = 0
+    out = []
+    for _ in range(STEPS):
+        keys = rng.integers(0, n_keys, size=BATCH).astype(np.int64)
+        vals = rng.integers(0, 9, size=BATCH).astype(np.int64)
+        tss = ts + np.sort(
+            rng.integers(0, 30, size=BATCH)).astype(np.int64)
+        ts += 25
+        out.append((keys, vals, tss, ts - 5))
+    return out
+
+
+def drive(engine, steps):
+    from flink_tpu.core.records import RecordBatch
+
+    out = []
+    for keys, vals, tss, wm in steps:
+        b = RecordBatch.from_pydict(
+            {"k": keys, "v": vals, "__key_id__": keys},
+            timestamps=tss)
+        out.extend(engine.process_batch(b))
+        out.extend(engine.on_watermark(wm))
+    return out
+
+
+def rows_of(batches):
+    """Flatten to (timestamp, sorted-row) tuples — order-preserving,
+    so a reordered emission diverges even when the value set matches."""
+    rows = []
+    for b in batches:
+        for r, t in zip(b.to_rows(),
+                        np.asarray(b.timestamps).tolist()):
+            rows.append((t, tuple(sorted(r.items()))))
+    return rows
+
+
+def main():
+    import warnings
+
+    warnings.filterwarnings("ignore")
+    import time
+
+    import jax
+
+    from flink_tpu.cep.mesh_engine import MeshCepEngine
+    from flink_tpu.cep.pattern import (
+        AfterMatchSkipStrategy,
+        Pattern,
+    )
+    from flink_tpu.observe import RecompileSentinel
+    from flink_tpu.parallel.mesh import make_mesh
+
+    P = min(len(jax.devices()), 8)
+    mesh = make_mesh(P)
+    errs = []
+    t0 = time.perf_counter()
+
+    def seq3(skip):
+        return (Pattern.begin("a", skip=skip)
+                .where(lambda b: np.asarray(b["v"]) % 3 == 0)
+                .next("b")
+                .where(lambda b: np.asarray(b["v"]) % 3 == 1)
+                .next("c")
+                .where(lambda b: np.asarray(b["v"]) % 3 == 2)
+                .within(50))
+
+    def mk(pat, backend, **kw):
+        if backend == "device":
+            return MeshCepEngine(pat, key_field="k", mesh=mesh,
+                                 capacity_per_shard=BUDGET, **kw)
+        return MeshCepEngine(pat, key_field="k", backend="host")
+
+    # ---- bit-identity: 3-stage within, both skip strategies ----
+    matches = 0
+    for skip in (AfterMatchSkipStrategy.SKIP_PAST_LAST_EVENT,
+                 AfterMatchSkipStrategy.NO_SKIP):
+        pat = seq3(skip)
+        steps = _steps(7, DENSE_KEYS)
+        want = rows_of(drive(mk(pat, "host"), steps))
+        got = rows_of(drive(mk(pat, "device"), steps))
+        if want != got:
+            errs.append(f"seq3/{skip.name}: device diverges from "
+                        f"host oracle ({len(got)} vs {len(want)} "
+                        "rows, or order/values differ)")
+        if not want:
+            errs.append(f"seq3/{skip.name}: zero matches — "
+                        "vacuous run")
+        matches += len(want)
+
+    # ---- forced eviction: always-alive pattern, keys >> budget ----
+    # the virtual start state keeps every seen key's column alive, so
+    # residency grows without bound and the spill tier MUST churn
+    churn = (Pattern.begin(
+                 "a", skip=AfterMatchSkipStrategy.SKIP_PAST_LAST_EVENT)
+             .next("b").where(lambda b: np.asarray(b["v"]) == 7))
+    steps = _steps(11, CHURN_KEYS)
+    want = rows_of(drive(mk(churn, "host"), steps))
+    with tempfile.TemporaryDirectory() as td:
+        dev = mk(churn, "device", spill_dir=td)
+        got = rows_of(drive(dev, steps))
+        sc = dev.spill_counters()
+    if want != got:
+        errs.append("churn: device diverges from host oracle under "
+                    "paged eviction")
+    if not want:
+        errs.append("churn: zero matches — vacuous run")
+    if sc.get("rows_evicted", 0) == 0:
+        errs.append("churn: spill never engaged (rows_evicted=0) — "
+                    "vacuous eviction coverage")
+    if sc.get("rows_reloaded", 0) == 0:
+        errs.append("churn: no evicted column ever reloaded "
+                    "(rows_reloaded=0) — the restore-put path was "
+                    "not covered")
+
+    # ---- steady state: a fresh engine compiles NOTHING ----
+    pat = seq3(AfterMatchSkipStrategy.SKIP_PAST_LAST_EVENT)
+    steps = _steps(7, DENSE_KEYS)
+    steady = mk(pat, "device")
+    try:
+        with RecompileSentinel(
+                max_compiles=0, max_transfers=STEPS * 64,
+                label="cep steady state") as s:
+            drive(steady, steps)
+        compiles = s.compiles
+    except Exception as e:  # SteadyStateViolation included
+        errs.append(f"steady-state: {e}")
+        compiles = -1
+
+    # ---- serving: replica-plane lookups == live match store ----
+    serve = mk(pat, "device")
+    adapter = serve.arm_match_replica()
+    drive(serve, steps)
+    qkeys = np.arange(DENSE_KEYS, dtype=np.int64)
+    live = serve.query_match_batch(qkeys)
+    rep, _gen = adapter.lookup_batch(qkeys)
+    served = sum(len(r) for r in live)
+    if served == 0:
+        errs.append("serving: zero rows in the match store — "
+                    "vacuous lookup leg")
+    for i in range(DENSE_KEYS):
+        if live[i] != rep[i]:
+            errs.append(f"serving: replica row set for key {i} "
+                        "diverges from the live probe")
+            break
+
+    result = {
+        "cep_smoke": "ok" if not errs else "FAIL",
+        "shards": P,
+        "seq3_matches": matches,
+        "churn_matches": len(want),
+        "rows_evicted": sc.get("rows_evicted", 0),
+        "rows_reloaded": sc.get("rows_reloaded", 0),
+        "steady_state_compiles": compiles,
+        "match_rows_served": served,
+        "seconds": round(time.perf_counter() - t0, 2),
+    }
+    print(json.dumps(result))
+    for e in errs:
+        print(f"FAIL: {e}", file=sys.stderr)
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

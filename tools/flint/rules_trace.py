@@ -258,6 +258,11 @@ HOT_ROOTS: Dict[str, Tuple[str, ...]] = {
     "MeshTemporalJoinEngine": ("process_batch", "on_watermark"),
     "JoinEngineBase": ("_ingest", "_probe_banded", "_dispatch_probe",
                        "_make_headroom", "_gather_rows"),
+    # the device CEP engine (flink_tpu/cep/mesh_engine.py): ingest
+    # staging and the fire walk (slot residency, advance dispatch,
+    # decode, match-store put, within-prune) run per batch / per
+    # watermark
+    "MeshCepEngine": ("process_batch", "on_watermark"),
 }
 
 #: module-level hot entry points: the device data plane's per-batch
@@ -292,6 +297,14 @@ HOT_MODULE_ROOTS: Dict[str, Tuple[str, ...]] = {
     ),
     "flink_tpu.joins.side_table": (
         "pair_lower_bound",
+    ),
+    # the CEP kernel builders: the advance closure IS the per-fire
+    # compiled NFA program (scan over events, unrolled over states) —
+    # rooted like the join kernel builders so a host sync creeping in
+    # stalls flint, not production
+    "flink_tpu.cep.kernels": (
+        "_build_cep_advance",
+        "_build_cep_prune",
     ),
     # the delta-harvest program family (fire + reset fused in one
     # dispatch) — its builder closure IS the per-fire compiled program,

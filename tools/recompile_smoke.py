@@ -371,6 +371,111 @@ def check_join_phase(mesh, budget):
     return ok
 
 
+#: cep-phase batch-size walks: shifted lengths, same padded-lane tier
+#: lattice — an advance/harvest/prune program keyed on raw batch
+#: length (instead of the sticky padded tiers) compiles mid-walk here
+CEP_WALK_WARM = (512, 256, 128, 384, 192, 96)
+CEP_WALK_RUN = (448, 288, 144, 336, 224, 112)
+
+
+def _drive_cep_sized(engine, sizes, offset, n_keys, rng):
+    """One keyed batch + one trailing-watermark fire per entry of
+    ``sizes`` — every fire drains that step's pending set, so the
+    advance program runs at each shifted length."""
+    from flink_tpu.core.records import RecordBatch
+
+    matches = 0
+    t = offset
+    for n in sizes:
+        keys = rng.integers(0, n_keys, n).astype(np.int64)
+        vals = rng.integers(0, 9, n).astype(np.int64)
+        ts = t + np.sort(
+            rng.integers(0, 30, size=n)).astype(np.int64)
+        t += 25
+        engine.process_batch(RecordBatch.from_pydict(
+            {"k": keys, "v": vals, "__key_id__": keys},
+            timestamps=ts))
+        out = engine.on_watermark(t - 5)
+        matches += sum(len(b) for b in out)
+    return matches, t
+
+
+def check_cep_phase(mesh):
+    """CEP phase: after warmup engines walk the padded-lane tier
+    lattice for BOTH device program families — the within-window
+    sequence (advance + within-prune) and the always-alive churn
+    pattern (advance + evict/restore, spill armed, keys >> budget) —
+    FRESH engines replaying SHIFTED batch sizes must compile NOTHING.
+    Matches and spill churn are ASSERTED so neither leg can go
+    vacuous."""
+    import tempfile
+
+    from flink_tpu.cep.mesh_engine import MeshCepEngine
+    from flink_tpu.cep.pattern import (
+        AfterMatchSkipStrategy,
+        Pattern,
+    )
+    from flink_tpu.observe import RecompileSentinel
+
+    skip = AfterMatchSkipStrategy.SKIP_PAST_LAST_EVENT
+    within_pat = (Pattern.begin("a", skip=skip)
+                  .where(lambda b: np.asarray(b["v"]) % 3 == 0)
+                  .next("b")
+                  .where(lambda b: np.asarray(b["v"]) % 3 == 1)
+                  .within(50))
+    churn_pat = (Pattern.begin("a", skip=skip)
+                 .next("b")
+                 .where(lambda b: np.asarray(b["v"]) == 7))
+
+    def mk(pat, spill_dir=None):
+        return MeshCepEngine(pat, key_field="k", mesh=mesh,
+                             capacity_per_shard=256,
+                             spill_dir=spill_dir)
+
+    # warmup: both walks, both program families
+    rng = np.random.default_rng(19)
+    w_within = mk(within_pat)
+    warm_m, t = _drive_cep_sized(w_within, CEP_WALK_WARM, 0, 64, rng)
+    warm_m += _drive_cep_sized(w_within, CEP_WALK_RUN, t, 64, rng)[0]
+    with tempfile.TemporaryDirectory() as td:
+        w_churn = mk(churn_pat, spill_dir=td)
+        _, t = _drive_cep_sized(w_churn, CEP_WALK_WARM, 0, 20_000,
+                                rng)
+        _drive_cep_sized(w_churn, CEP_WALK_RUN, t, 20_000, rng)
+
+        ok = True
+        within = mk(within_pat)
+        churn = mk(churn_pat, spill_dir=td)
+        with RecompileSentinel(
+                max_compiles=0,
+                max_transfers=len(CEP_WALK_RUN) * 6 * 64,
+                label="cep tier walk") as s:
+            m, t = _drive_cep_sized(within, CEP_WALK_RUN, 0, 64, rng)
+            # two passes on the churn engine: the live key set must
+            # outgrow the 8x256 slot budget so evict/restore programs
+            # are part of the guarded steady state
+            _, t2 = _drive_cep_sized(churn, CEP_WALK_RUN, 0, 20_000,
+                                     rng)
+            cm = _drive_cep_sized(churn, CEP_WALK_RUN, t2, 20_000,
+                                  rng)[0]
+        sc = churn.spill_counters()
+    print(f"  cep tiers: matches={m} churn_matches={cm} "
+          f"compiles={s.compiles} transfers={s.transfers} "
+          f"rows_evicted={sc['rows_evicted']}")
+    if m == 0 or warm_m == 0:
+        print("FAIL: cep tiers: zero matches — vacuous run")
+        ok = False
+    if cm == 0:
+        print("FAIL: cep tiers: churn leg emitted nothing — "
+              "vacuous run")
+        ok = False
+    if sc["rows_evicted"] == 0:
+        print("FAIL: cep tiers: spill never engaged — the "
+              "evict/restore programs were not covered")
+        ok = False
+    return ok
+
+
 def check_second_job_on_warm_cluster(mesh, total, budget):
     """The tenancy contract: after job A warms the cluster (ingest,
     fire, evict AND serving programs), a SECOND job's fresh engines on
@@ -454,6 +559,11 @@ def main():
         ok = check_join_phase(mesh, budgets["mesh-sessions"]) and ok
     except Exception as e:  # SteadyStateViolation included
         print(f"FAIL: join tiers: {e}")
+        ok = False
+    try:
+        ok = check_cep_phase(mesh) and ok
+    except Exception as e:  # SteadyStateViolation included
+        print(f"FAIL: cep tiers: {e}")
         ok = False
     try:
         ok = check_second_job_on_warm_cluster(

@@ -149,6 +149,16 @@ if [ "${SKIP_BENCH_SMOKE:-0}" != "1" ]; then
   JAX_PLATFORMS=cpu timeout -k 10 120 \
     python tools/join_smoke.py || exit 1
 
+  # CEP smoke: the device-vectorized mesh NFA engine vs the host
+  # CepOperator oracle — FAILS on any bit divergence (values OR
+  # emission order) across both after-match skip strategies and a
+  # forced-paged-eviction leg, on a steady-state XLA compile from a
+  # FRESH engine on the warm program cache, on a vacuous run (zero
+  # matches, rows_evicted=0 or rows_reloaded=0), or on a replica-plane
+  # matched-pattern lookup diverging from the live store. ~5 s on CPU.
+  JAX_PLATFORMS=cpu timeout -k 10 120 \
+    python tools/cep_smoke.py || exit 1
+
   # Multi-process smoke: 2 REAL CPU processes (jax.distributed + gloo
   # collectives), each owning half the key-group space, exchanging
   # records over the DCN axis of the process-spanning mesh ON DEVICE
