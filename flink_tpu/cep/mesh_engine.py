@@ -995,12 +995,15 @@ class MeshCepEngine:
             self._match_planes = prog(self._match_planes, put[0],
                                       tuple(put[1:]))
 
-    def arm_match_replica(self):
+    def arm_match_replica(self, serving: bool = False):
         """Arm the matched-pattern read replica: completed matches
         become queryable state on the serving path — the replica plane
         double-buffers the match planes and seals a generation per
         boundary publish. Returns a :class:`CepMatchReplicaAdapter`
-        (bindable to a ServingPlane like any other adapter)."""
+        (bindable to a ServingPlane like any other adapter), or — with
+        ``serving=True`` — a :class:`CepMatchServingAdapter`, whose
+        composed results pack into the native shm hot cache so frontend
+        processes serve match lookups without crossing to the owner."""
         if self.backend != "device":
             raise RuntimeError(
                 "the matched-pattern replica rides the device match "
@@ -1019,7 +1022,9 @@ class MeshCepEngine:
         self._rep_full = True
         self._rep_up = [set() for _ in range(self.P)]
         self._rep_freed = [[] for _ in range(self.P)]
-        return CepMatchReplicaAdapter(plane)
+        cls = CepMatchServingAdapter if serving else \
+            CepMatchReplicaAdapter
+        return cls(plane)
 
     def _publish_matches(self, watermark: int) -> None:
         from flink_tpu.observe import flight_recorder as flight
@@ -1754,3 +1759,72 @@ class CepMatchReplicaAdapter(ReplicaAdapter):
             })
         rows.sort(key=lambda d: (d["end_ts"], d["rid"]))
         return rows
+
+
+class CepMatchServingAdapter(CepMatchReplicaAdapter):
+    """The ServingPlane/frontend-tier variant: composes each key's
+    matches as ``{rid -> {start_ts, end_ts, depth, first_seq,
+    last_seq}}`` — the ``{int namespace -> {column -> int}}`` shape the
+    native hot cache packs into its shm arenas, so FRONTEND processes
+    serve match lookups straight off the shared table (the list shape
+    the base adapter returns rides the owner-side overflow store, which
+    frontends cannot map). :meth:`match_rows` decodes a composed result
+    back to the live ``query_match_batch`` row list, bit-identically.
+
+    The publish feed is KILL-ONLY: retained matches are immutable (the
+    FIFO inserts and overwrites-oldest, never edits), so a boundary's
+    delta for a key is pure identity churn — dropping the key's cached
+    entry (PrimeDelta flags bit1) is both correct and complete, and the
+    base class's value-column finish (which needs an aggregate the
+    match store does not have) never runs."""
+
+    def compose(self, entries, vals, cold_entries, cold_result
+                ) -> dict:
+        out: Dict[int, dict] = {}
+        for rid, j, extra in entries:
+            start, end = extra
+            out[int(rid)] = {
+                "start_ts": int(start),
+                "end_ts": int(end),
+                "depth": int(np.asarray(vals[j][0]).item()),
+                "first_seq": int(np.asarray(vals[j][1]).item()),
+                "last_seq": int(np.asarray(vals[j][2]).item()),
+            }
+        return out
+
+    @staticmethod
+    def match_rows(result) -> List[dict]:
+        """Decode one composed/served result back to the live
+        ``query_match_batch`` shape: rows sorted by (end_ts, rid)."""
+        rows = [{"rid": int(rid),
+                 "start_ts": int(cols["start_ts"]),
+                 "end_ts": int(cols["end_ts"]),
+                 "depth": int(cols["depth"]),
+                 "first_seq": int(cols["first_seq"]),
+                 "last_seq": int(cols["last_seq"])}
+                for rid, cols in (result or {}).items()]
+        rows.sort(key=lambda d: (d["end_ts"], d["rid"]))
+        return rows
+
+    def _on_publish(self, gen: int, per_shard: Dict[int, dict],
+                    harvest, prev_index) -> None:
+        cache = self._cache
+        if cache is None:
+            return
+        from flink_tpu.tenancy.hot_cache import PrimeDelta
+
+        touched: set = set()
+        for d in per_shard.values():
+            touched.update(
+                int(k) for k in np.asarray(d["up_keys"]).tolist())
+            touched.update(int(k) for k, _ns in d["freed"])
+        if not touched:
+            return
+        kids = np.asarray(sorted(touched), dtype=np.int64)
+        zeros = np.zeros(len(kids) + 1, dtype=np.int64)
+        empty = np.zeros(0, dtype=np.int64)
+        cache.prime_batch(
+            self._cache_job, self._cache_op, gen,
+            PrimeDelta(keys=kids, uoff=zeros, u_ns=empty, u_cols=[],
+                       roff=zeros, r_ns=empty,
+                       flags=np.full(len(kids), 2, dtype=np.uint8)))

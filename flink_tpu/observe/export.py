@@ -139,6 +139,30 @@ def validate_trace_schema(trace: Dict[str, Any],
     return problems
 
 
+def span_rollup(kind_totals: Dict[str, Dict[str, float]],
+                wall_s: float,
+                buckets: Dict[str, Any]) -> Dict[str, float]:
+    """Sum per-kind span totals into named wall-time buckets — THE
+    bench drivers' rollup primitive. ``buckets`` maps an output field
+    to one span kind or a sequence of kinds; absent kinds contribute
+    0.0 (a driver may name kinds its engine doesn't emit yet). Always
+    appends ``total_s`` (the measured wall clock) so every driver's
+    breakdown dict carries the same denominator. Buckets may overlap
+    (a kind can appear in several) and are not guaranteed to sum to
+    ``total_s`` — they attribute, they don't partition."""
+
+    def total(kind: str) -> float:
+        return kind_totals.get(kind, {}).get("total_s", 0.0)
+
+    out: Dict[str, float] = {}
+    for name, kinds in buckets.items():
+        if isinstance(kinds, str):
+            kinds = (kinds,)
+        out[name] = round(sum(total(k) for k in kinds), 3)
+    out["total_s"] = round(wall_s, 3)
+    return out
+
+
 def breakdown_from_kind_totals(kind_totals: Dict[str, Dict[str, float]],
                                wall_s: float) -> Dict[str, float]:
     """The canonical host-prep / device / harvest wall-time breakdown,
@@ -164,22 +188,20 @@ def breakdown_from_kind_totals(kind_totals: Dict[str, Dict[str, float]],
     def total(kind: str) -> float:
         return kind_totals.get(kind, {}).get("total_s", 0.0)
 
-    ingest = total("batch.ingest")
-    dev_inline = total("device.dispatch")
-    fence = total("device.fence_wait")
-    host_prep = max(ingest - dev_inline - fence, 0.0)
-    return {
-        "host_prep_s": round(host_prep, 3),
-        "meta_sweep_s": round(total("prep.meta_sweep"), 3),
-        "stage_s": round(total("prep.stage"), 3),
-        "device_step_s": round(
-            total("fire.dispatch") + dev_inline + fence, 3),
-        "harvest_s": round(total("fire.harvest"), 3),
-        "device_in_prep_s": round(dev_inline + fence, 3),
-        "host_prep_fraction": round(host_prep / wall_s, 4)
-        if wall_s > 0 else 0.0,
-        "total_s": round(wall_s, 3),
-    }
+    host_prep = max(total("batch.ingest") - total("device.dispatch")
+                    - total("device.fence_wait"), 0.0)
+    out = {"host_prep_s": round(host_prep, 3)}
+    out.update(span_rollup(kind_totals, wall_s, {
+        "meta_sweep_s": "prep.meta_sweep",
+        "stage_s": "prep.stage",
+        "device_step_s": ("fire.dispatch", "device.dispatch",
+                          "device.fence_wait"),
+        "harvest_s": "fire.harvest",
+        "device_in_prep_s": ("device.dispatch", "device.fence_wait"),
+    }))
+    out["host_prep_fraction"] = round(host_prep / wall_s, 4) \
+        if wall_s > 0 else 0.0
+    return out
 
 
 def register_flight_metrics(group,

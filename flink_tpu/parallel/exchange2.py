@@ -59,6 +59,8 @@ from flink_tpu.parallel.mesh import (
     pod_mesh_view,
     shard_map,
 )
+from flink_tpu.stateplane.backends import backend_of
+from flink_tpu.stateplane.rank import exchange_rank_flat
 from flink_tpu.tenancy.program_cache import PROGRAM_CACHE
 
 
@@ -221,11 +223,13 @@ def _mesh_key(mesh) -> Tuple[int, ...]:
     return tuple(d.id for d in mesh.devices.flat)
 
 
-def _stage1_route(mesh2, H: int, L: int, fill_specs):
+def _stage1_route(mesh2, H: int, L: int, fill_specs,
+                  rank_backend: str = "xla"):
     """Stage 1: route (dst, slot, values...) by destination LOCAL index
     over the intra-host axis. Returns per-column received buckets
     flattened ``[L * W1]`` in (source-local, rank) order."""
     num_shards = H * L
+    sm_kwargs = {"check_rep": False} if rank_backend == "pallas" else {}
 
     def _xc_local(block):
         if L == 1:
@@ -243,12 +247,7 @@ def _stage1_route(mesh2, H: int, L: int, fill_specs):
             vals = args[2:]
             dl = jnp.where(d < num_shards,
                            jax.lax.rem(d, L), L)
-            oh = jax.nn.one_hot(dl, L, dtype=jnp.int32)
-            rank = jnp.cumsum(oh, axis=0) - oh
-            rank_d = jnp.take_along_axis(
-                rank, jnp.clip(dl, 0, L - 1)[:, None], axis=1)[:, 0]
-            ok = (dl < L) & (rank_d < W1)
-            flat = jnp.where(ok, dl * W1 + rank_d, L * W1)
+            flat = exchange_rank_flat(dl, L, W1, rank_backend)
             outs = []
             # the destination shard rides the exchange (stage 2 needs
             # the host part); empty lanes carry the padding sentinel
@@ -273,21 +272,19 @@ def _stage1_route(mesh2, H: int, L: int, fill_specs):
             local, mesh=mesh2,
             in_specs=(spec,) * (2 + n_vals),
             out_specs=(spec,) * (2 + n_vals),
+            **sm_kwargs,
         )(dst, slots, *values)
 
     return stage1
 
 
-def _stage2_rank(d2, H: int, L: int, num_shards: int, W2: int):
-    """Shared stage-2 bucketing: destination-host one-hot-cumsum ranks
-    over the stage-1 receive order."""
+def _stage2_rank(d2, H: int, L: int, num_shards: int, W2: int,
+                 rank_backend: str = "xla"):
+    """Shared stage-2 bucketing: destination-host rank-within-
+    destination (the stateplane exchange-rank combinator) over the
+    stage-1 receive order."""
     dh = jnp.where(d2 < num_shards, d2 // L, H)
-    oh = jax.nn.one_hot(dh, H, dtype=jnp.int32)
-    rank = jnp.cumsum(oh, axis=0) - oh
-    rank_d = jnp.take_along_axis(
-        rank, jnp.clip(dh, 0, H - 1)[:, None], axis=1)[:, 0]
-    ok = (dh < H) & (rank_d < W2)
-    return jnp.where(ok, dh * W2 + rank_d, H * W2)
+    return exchange_rank_flat(dh, H, W2, rank_backend)
 
 
 def build_exchange2_steps(mesh, topology: HostTopology, agg,
@@ -298,15 +295,19 @@ def build_exchange2_steps(mesh, topology: HostTopology, agg,
     the same per-slot stream-order guarantee as
     ``build_exchange_scatter`` — bit-identical output, two dispatches.
     """
+    rank_backend = backend_of("exchange-rank")
     key = (_mesh_key(mesh), topology.num_hosts,
-           topology.local_devices, agg.cache_key(), bool(valued))
+           topology.local_devices, agg.cache_key(), bool(valued),
+           rank_backend)
     return (
         PROGRAM_CACHE.get_or_build(
             "exchange2-stage1", key,
-            lambda: _build_fold_stage1(mesh, topology, agg, valued)),
+            lambda: _build_fold_stage1(mesh, topology, agg, valued,
+                                       rank_backend)),
         PROGRAM_CACHE.get_or_build(
             "exchange2-stage2", key,
-            lambda: _build_fold_stage2(mesh, topology, agg, valued)),
+            lambda: _build_fold_stage2(mesh, topology, agg, valued,
+                                       rank_backend)),
     )
 
 
@@ -319,21 +320,24 @@ def _exchanged_leaves(agg, valued: bool):
     return [l for l in agg.leaves if l.const is None]
 
 
-def _build_fold_stage1(mesh, topology: HostTopology, agg, valued: bool):
+def _build_fold_stage1(mesh, topology: HostTopology, agg, valued: bool,
+                       rank_backend: str = "xla"):
     H, L = topology.num_hosts, topology.local_devices
     mesh2 = pod_mesh_view(mesh, topology)
     fill_specs = tuple((np.dtype(l.dtype).str, l.identity)
                        for l in _exchanged_leaves(agg, valued))
-    return _stage1_route(mesh2, H, L, fill_specs)
+    return _stage1_route(mesh2, H, L, fill_specs, rank_backend)
 
 
-def _build_fold_stage2(mesh, topology: HostTopology, agg, valued: bool):
+def _build_fold_stage2(mesh, topology: HostTopology, agg, valued: bool,
+                       rank_backend: str = "xla"):
     H, L = topology.num_hosts, topology.local_devices
     num_shards = H * L
     mesh2 = pod_mesh_view(mesh, topology)
     leaves = agg.leaves
     methods = tuple(SCATTER_METHOD[l.reduce] for l in leaves)
     n_leaves = len(leaves)
+    sm_kwargs = {"check_rep": False} if rank_backend == "pallas" else {}
 
     def _xc_hosts(block):
         if H == 1:
@@ -350,7 +354,7 @@ def _build_fold_stage2(mesh, topology: HostTopology, agg, valued: bool):
             d2 = args[n_leaves]          # [L*W1] destination shard
             s2 = args[n_leaves + 1]      # [L*W1] destination slot
             vals_l = iter(args[n_leaves + 2:])
-            flat = _stage2_rank(d2, H, L, num_shards, W2)
+            flat = _stage2_rank(d2, H, L, num_shards, W2, rank_backend)
             recv_s = _xc_hosts(
                 jnp.zeros((H * W2,), jnp.int32)
                 .at[flat].set(s2, mode="drop")
@@ -378,6 +382,7 @@ def _build_fold_stage2(mesh, topology: HostTopology, agg, valued: bool):
             local, mesh=mesh2,
             in_specs=(spec,) * (n_leaves + 2 + n_vals),
             out_specs=(spec,) * n_leaves,
+            **sm_kwargs,
         )(*accs, dst2, slots2, *vals2)
 
     return stage2
@@ -390,30 +395,36 @@ def build_join_exchange2_steps(mesh, topology: HostTopology,
     the host axis and writes the received rows into the side table's
     plane (``.set`` — last write in stream order wins, identical to the
     flat join exchange)."""
+    rank_backend = backend_of("exchange-rank")
     key = (_mesh_key(mesh), topology.num_hosts,
-           topology.local_devices, tuple(dtypes))
+           topology.local_devices, tuple(dtypes), rank_backend)
     return (
         PROGRAM_CACHE.get_or_build(
             "join-exchange2-stage1", key,
-            lambda: _build_join_stage1(mesh, topology, dtypes)),
+            lambda: _build_join_stage1(mesh, topology, dtypes,
+                                       rank_backend)),
         PROGRAM_CACHE.get_or_build(
             "join-exchange2-stage2", key,
-            lambda: _build_join_stage2(mesh, topology, dtypes)),
+            lambda: _build_join_stage2(mesh, topology, dtypes,
+                                       rank_backend)),
     )
 
 
-def _build_join_stage1(mesh, topology: HostTopology, dtypes):
+def _build_join_stage1(mesh, topology: HostTopology, dtypes,
+                       rank_backend: str = "xla"):
     H, L = topology.num_hosts, topology.local_devices
     mesh2 = pod_mesh_view(mesh, topology)
     fill_specs = tuple((np.dtype(dt).str, 0) for dt in dtypes)
-    return _stage1_route(mesh2, H, L, fill_specs)
+    return _stage1_route(mesh2, H, L, fill_specs, rank_backend)
 
 
-def _build_join_stage2(mesh, topology: HostTopology, dtypes):
+def _build_join_stage2(mesh, topology: HostTopology, dtypes,
+                       rank_backend: str = "xla"):
     H, L = topology.num_hosts, topology.local_devices
     num_shards = H * L
     mesh2 = pod_mesh_view(mesh, topology)
     n_cols = len(dtypes)
+    sm_kwargs = {"check_rep": False} if rank_backend == "pallas" else {}
 
     def _xc_hosts(block):
         if H == 1:
@@ -430,7 +441,7 @@ def _build_join_stage2(mesh, topology: HostTopology, dtypes):
             d2 = args[n_cols]
             s2 = args[n_cols + 1]
             vs = args[n_cols + 2:]
-            flat = _stage2_rank(d2, H, L, num_shards, W2)
+            flat = _stage2_rank(d2, H, L, num_shards, W2, rank_backend)
             recv_s = _xc_hosts(
                 jnp.zeros((H * W2,), jnp.int32)
                 .at[flat].set(s2, mode="drop")
@@ -451,6 +462,7 @@ def _build_join_stage2(mesh, topology: HostTopology, dtypes):
             local, mesh=mesh2,
             in_specs=(spec,) * (2 * n_cols + 2),
             out_specs=(spec,) * n_cols,
+            **sm_kwargs,
         )(*planes, dst2, slots2, *vals2)
 
     return stage2

@@ -30,7 +30,6 @@ tier; sessions, spill, and the mesh keep the slot layout.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Dict, List, Tuple
 
 import jax
@@ -39,109 +38,24 @@ import numpy as np
 
 from flink_tpu.core.annotations import internal
 from flink_tpu.ops.segment_ops import (
-    MERGE_FN,
-    SCATTER_METHOD,
     pad_i32,
     sticky_bucket,
 )
 from flink_tpu.state.keygroups import assign_key_groups
 from flink_tpu.state.slot_table import make_slot_index
-from flink_tpu.windowing.aggregates import _JIT_CACHE, AggregateFunction
+from flink_tpu.stateplane import pane_programs
+from flink_tpu.stateplane.families import pane_fence
+from flink_tpu.windowing.aggregates import AggregateFunction
 
 _INITIAL_RING = 8
 
 
 def _pane_kernels(agg: AggregateFunction, projector=None):
-    """(scatter2d, fire_rows, reset_row, put_row) for [R, C] pane arrays.
-    The presence plane rides as an extra trailing array in ``accs``."""
-    key = ("pane", agg.cache_key(),
-           None if projector is None else projector.cache_key())
-    fns = _JIT_CACHE.get(key)
-    if fns is not None:
-        return fns
-    leaves = agg.leaves
-    methods = tuple(SCATTER_METHOD[l.reduce] for l in leaves)
-    merges = tuple(MERGE_FN[l.reduce] for l in leaves)
-    idents = tuple(l.identity for l in leaves)
-    finish = agg.finish
-    n = len(leaves)
-
-    @partial(jax.jit, donate_argnums=(0,))
-    def scatter2d(accs, flat, values):
-        # ONE flat i32 index array crosses host->device per batch (the
-        # tunneled link's bandwidth is the scarce resource — rows/cols
-        # are pre-fused on host; flat 1-D scatter also lowers better on
-        # TPU than 2-D scatter; the reshape is a bitcast under jit)
-        C = accs[0].shape[1]
-        pad = (flat % C) == 0  # col 0 is the reserved identity column
-        vit = iter(values)
-        out = []
-        for a, m, l in zip(accs[:n], methods, leaves):
-            if l.const is not None:
-                v = jnp.where(pad,
-                              jnp.asarray(l.identity, dtype=l.dtype),
-                              jnp.asarray(l.const, dtype=l.dtype))
-            else:
-                v = next(vit)
-            shape = a.shape
-            out.append(
-                getattr(a.reshape(-1).at[flat], m)(v).reshape(shape))
-        presence = accs[n].reshape(-1).at[flat].max(
-            jnp.where(pad, 0, 1).astype(jnp.int8)
-        ).reshape(accs[n].shape)
-        return tuple(out) + (presence,)
-
-    @jax.jit
-    def fire_rows(accs, rows, used_n):
-        merged = tuple(
-            m(a[rows], axis=0) for a, m in zip(accs[:n], merges))
-        present = accs[n][rows].max(axis=0)
-        cols = finish(merged)
-        valid = (jnp.arange(present.shape[0]) < used_n) & (present > 0)
-        if projector is None:
-            return cols, valid
-        return projector.project(cols, valid)
-
-    @partial(jax.jit, donate_argnums=(0,))
-    def scatter2d_valued(accs, flat, values):
-        # every leaf valued (locally pre-aggregated partials), each folded
-        # by its own reduce; pad lanes carry leaf identities at flat 0
-        C = accs[0].shape[1]
-        pad = (flat % C) == 0
-        out = [getattr(a.reshape(-1).at[flat], m)(v).reshape(a.shape)
-               for a, m, v in zip(accs[:n], methods, values)]
-        presence = accs[n].reshape(-1).at[flat].max(
-            jnp.where(pad, 0, 1).astype(jnp.int8)).reshape(accs[n].shape)
-        return tuple(out) + (presence,)
-
-    @partial(jax.jit, donate_argnums=(0,))
-    def reset_row(accs, row):
-        out = [a.at[row].set(jnp.asarray(i, dtype=a.dtype))
-               for a, i in zip(accs[:n], idents)]
-        return tuple(out) + (accs[n].at[row].set(jnp.int8(0)),)
-
-    @partial(jax.jit, donate_argnums=(0,))
-    def put_row(accs, row, cols, values):
-        out = [a.at[row, cols].set(v)
-               for a, v in zip(accs[:n], values)]
-        presence = accs[n].at[row, cols].set(
-            jnp.where(cols == 0, 0, 1).astype(jnp.int8))
-        return tuple(out) + (presence,)
-
-    @partial(jax.jit, donate_argnums=(0,))
-    def fold_rows(accs, dst, rows):
-        # window-partial (re)build: dst row := merge of the given ring
-        # rows (overwrite semantics — dst is freshly allocated or being
-        # rebuilt from the authoritative panes). One dispatch per
-        # window, amortized one per slide period.
-        out = [a.at[dst].set(m(a[rows], axis=0))
-               for a, m in zip(accs[:n], merges)]
-        presence = accs[n].at[dst].set(accs[n][rows].max(axis=0))
-        return tuple(out) + (presence,)
-
-    _JIT_CACHE[key] = fns = (scatter2d, scatter2d_valued, fire_rows,
-                             reset_row, put_row, fold_rows)
-    return fns
+    """(scatter2d, scatter2d_valued, fire_rows, reset_row, put_row,
+    fold_rows) for [R, C] pane arrays — the stateplane delta-harvest
+    bundle (bodies in ``flink_tpu/stateplane/pane.py``). The presence
+    plane rides as an extra trailing array in ``accs``."""
+    return pane_programs(agg, projector)
 
 
 @internal
@@ -482,12 +396,7 @@ class PaneTable:
     def make_fence(self):
         """Dispatch-depth fence (see SlotTable.make_fence): a [1, 1] slice
         of the live accumulator, enqueued behind all prior work."""
-        key = ("pane_fence", self.agg.leaves[0].dtype.str)
-        fn = _JIT_CACHE.get(key)
-        if fn is None:
-            fn = jax.jit(lambda a: a[:1, :1])
-            _JIT_CACHE[key] = fn
-        return fn(self.accs[0])
+        return pane_fence(self.agg.leaves[0].dtype.str)(self.accs[0])
 
     # ------------------------------------------------------------------ fire
 

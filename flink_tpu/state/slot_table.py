@@ -33,7 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from flink_tpu.state.keygroups import assign_key_groups
-from flink_tpu.windowing.aggregates import _JIT_CACHE, AggregateFunction
+from flink_tpu.stateplane import flat_fence
+from flink_tpu.windowing.aggregates import AggregateFunction
 from flink_tpu.ops.segment_ops import (
     pad_bucket_size,
     pad_i32,
@@ -1312,12 +1313,7 @@ class SlotTable:
         fire latency grows without limit (reference: checkpoint alignment
         bounds in-flight data the same way; here the scarce resource is
         the device queue)."""
-        key = ("fence", self.agg.leaves[0].dtype.str)
-        fn = _JIT_CACHE.get(key)
-        if fn is None:
-            fn = jax.jit(lambda a: a[:1])
-            _JIT_CACHE[key] = fn
-        return fn(self.accs[0])
+        return flat_fence(self.agg.leaves[0].dtype.str)(self.accs[0])
 
     def scatter_valued(self, slots: np.ndarray,
                        values: Tuple[np.ndarray, ...]) -> None:

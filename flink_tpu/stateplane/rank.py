@@ -1,0 +1,122 @@
+"""The exchange-rank program family: rank-within-destination.
+
+Every device exchange in the repo (the fused flat exchange+scatter, the
+join ingest exchange, both stages of the two-level pod exchange) needs
+the same combinator: for a staged column of destination indices ``d``,
+the rank of record ``i`` within its destination — the count of PRIOR
+same-destination records. Ranks flatten to per-destination bucket
+offsets ``d * W + rank`` so an ``all_to_all`` block scatter preserves
+stream order per destination (the property that keeps float folds
+bit-identical between host bucketing and device exchange).
+
+Two backends compute the same rank:
+
+- ``xla``: the one-hot-cumsum idiom — ``cumsum(one_hot(d, D))`` is an
+  O(n*D) matmul-shaped program standing in for a counting sort
+  (ROADMAP item 3b's named worst offender).
+- ``pallas``: a ``pl.pallas_call`` counting-sort kernel — one O(n)
+  sequential pass over an SMEM count array. Interpret mode on CPU CI;
+  real-TPU numbers belong to the item-3b revalidation round.
+
+Both are A/B gated bit-identical for ALL int32 inputs (including
+negative and out-of-range sentinel lanes): rank(i) = #{j < i :
+0 <= d_j < D and d_j == clip(d_i, 0, D-1)}. The enclosing exchange
+builders resolve the backend at build time via
+:mod:`flink_tpu.stateplane.backends` and tag their PROGRAM_CACHE keys
+with it, so an A/B swap is a new cache entry, never a silent retrace.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from flink_tpu.tenancy.program_cache import PROGRAM_CACHE
+
+try:  # pallas ships with jax but may be absent/broken on some builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover - import-time environment gate
+    pl = None
+    pltpu = None
+
+
+def xla_rank(d, num_dests: int):
+    """Rank within destination via one-hot + cumsum (the XLA idiom all
+    four exchange sites hand-rolled before the stateplane extraction)."""
+    oh = jax.nn.one_hot(d, num_dests, dtype=jnp.int32)
+    rank = jnp.cumsum(oh, axis=0) - oh
+    return jnp.take_along_axis(
+        rank, jnp.clip(d, 0, num_dests - 1)[:, None], axis=1)[:, 0]
+
+
+def _rank_kernel(d_ref, out_ref, counts_ref, *, num_dests: int):
+    """Counting sort: one sequential pass, counts in SMEM.
+
+    Bit-compatible with :func:`xla_rank` for every int32 input: lanes
+    with ``d`` outside ``[0, num_dests)`` READ the count at the clipped
+    bucket (what take_along_axis does) but never increment (their
+    one-hot row is all zero)."""
+    counts_ref[...] = jnp.zeros_like(counts_ref)
+
+    def body(i, carry):
+        d = d_ref[i]
+        b = jnp.clip(d, 0, num_dests - 1)
+        c = counts_ref[b]
+        out_ref[i] = c
+        counts_ref[b] = jnp.where((d >= 0) & (d < num_dests), c + 1, c)
+        return carry
+
+    jax.lax.fori_loop(0, d_ref.shape[0], body, 0)
+
+
+def pallas_rank(d, num_dests: int):
+    """Rank within destination as a Pallas counting-sort kernel."""
+    if pl is None or pltpu is None:  # pragma: no cover
+        raise RuntimeError("pallas backend requested but "
+                           "jax.experimental.pallas is unavailable")
+    interpret = jax.default_backend() != "tpu"
+    return pl.pallas_call(
+        partial(_rank_kernel, num_dests=int(num_dests)),
+        out_shape=jax.ShapeDtypeStruct(d.shape, jnp.int32),
+        scratch_shapes=[pltpu.SMEM((int(num_dests),), jnp.int32)],
+        interpret=interpret,
+    )(d.astype(jnp.int32))
+
+
+_RANK_FNS = {"xla": xla_rank, "pallas": pallas_rank}
+
+
+def exchange_rank_flat(d, num_dests: int, width, backend: str = "xla"):
+    """Destination indices ``[C]`` -> flat bucket offsets ``[C]``.
+
+    ``flat[i] = d[i] * width + rank(i)`` for in-range lanes whose rank
+    fits the bucket; every other lane gets the out-of-range sentinel
+    ``num_dests * width`` (dropped by ``.at[flat].set(mode="drop")``).
+    ``width`` may be a python int or a traced scalar from a static arg.
+    """
+    rank_d = _RANK_FNS[backend](d, int(num_dests))
+    ok = (d < num_dests) & (rank_d < width)
+    return jnp.where(ok, d * width + rank_d, num_dests * width)
+
+
+def build_exchange_rank(num_dests: int, backend: str = "xla"):
+    """The standalone cached exchange-rank program: ``(d, width) ->
+    flat``. The in-exchange sites trace :func:`exchange_rank_flat`
+    inline (it fuses into their one program); this cached form is the
+    unit the A/B gate, the property test and the recompile phases
+    exercise directly."""
+    key = (int(num_dests), str(backend))
+    return PROGRAM_CACHE.get_or_build(
+        "exchange-rank", key, lambda: _build_exchange_rank(
+            int(num_dests), str(backend)))
+
+
+def _build_exchange_rank(num_dests: int, backend: str):
+    @partial(jax.jit, static_argnums=(1,))
+    def rank_program(d, width):
+        return exchange_rank_flat(d, num_dests, int(width), backend)
+
+    return rank_program

@@ -17,20 +17,18 @@ per kernel launch instead of one object per key.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Dict, List, Sequence, Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from flink_tpu.core.records import RecordBatch
 from flink_tpu.ops.segment_ops import (
-    MERGE_FN,
     SCATTER_METHOD,
     identity_for,
     pad_values,
 )
+from flink_tpu.stateplane import families as _families
 
 
 from flink_tpu.core.annotations import public
@@ -62,10 +60,14 @@ class AccLeaf:
         return identity_for(self.reduce, self.dtype)
 
 
-# Compiled steps are cached at module level keyed by aggregate *layout*, not
-# instance, so two pipelines with the same aggregate shape (e.g. a warmup run
-# and a measured run, or repeated jobs) share XLA executables.
-_JIT_CACHE: Dict[tuple, object] = {}
+# Compiled steps live in the shared PROGRAM_CACHE via the stateplane
+# family builders, keyed by aggregate *layout*, not instance, so two
+# pipelines with the same aggregate shape (e.g. a warmup run and a
+# measured run, or repeated jobs — or two tenants) share XLA
+# executables. The ``_*_jit`` properties below are the engines' stable
+# entry points; the program bodies moved verbatim to
+# ``flink_tpu/stateplane/families.py`` (bit-identity pinned by
+# tests/test_stateplane.py).
 
 
 @public
@@ -120,54 +122,12 @@ class AggregateFunction:
 
     @property
     def _scatter_jit(self):
-        methods = tuple(SCATTER_METHOD[l.reduce] for l in self.leaves)
-        consts = tuple(
-            None if l.const is None else (l.const, l.dtype.str)
-            for l in self.leaves)
-        key = ("scatter", methods, consts,
-               tuple(l.dtype.str for l in self.leaves))
-        fn = _JIT_CACHE.get(key)
-        if fn is None:
-            leaves = self.leaves
-
-            @partial(jax.jit, donate_argnums=(0,))
-            def scatter(accs, slots, values):
-                vit = iter(values)
-                out = []
-                for a, m, l in zip(accs, methods, leaves):
-                    if l.const is not None:
-                        # padded lanes target the reserved slot 0, which
-                        # must stay identity (fires read it for missing
-                        # slices) — mask the const there
-                        v = jnp.where(slots == 0,
-                                      jnp.asarray(l.identity, dtype=l.dtype),
-                                      jnp.asarray(l.const, dtype=l.dtype))
-                    else:
-                        v = next(vit)
-                    out.append(getattr(a.at[slots], m)(v))
-                return tuple(out)
-
-            _JIT_CACHE[key] = fn = scatter
-        return fn
+        return _families.flat_scatter_combine(self.leaves)
 
     @property
     def _fire_jit(self):
         """(accs, slot_matrix [w, k]) -> result columns [w] + merged leaves."""
-        key = ("fire", self.cache_key())
-        fn = _JIT_CACHE.get(key)
-        if fn is None:
-            merges = tuple(MERGE_FN[l.reduce] for l in self.leaves)
-            finish = self.finish
-
-            @jax.jit
-            def fire(accs, slot_matrix):
-                merged = tuple(
-                    m(a[slot_matrix], axis=1) for a, m in zip(accs, merges)
-                )
-                return finish(merged)
-
-            _JIT_CACHE[key] = fn = fire
-        return fn
+        return _families.flat_segment_fire(self)
 
     def _fire_project_jit(self, projector):
         """(accs, slot_matrix [wp, k], w scalar) -> projected (row indices
@@ -177,59 +137,21 @@ class AggregateFunction:
         keys never ship at all (the host resolves indices->keys), keeping
         the fire's host->device traffic to the slot matrix alone (see
         flink_tpu.windowing.fire_projectors)."""
-        key = ("fire_proj", self.cache_key(), projector.cache_key())
-        fn = _JIT_CACHE.get(key)
-        if fn is None:
-            merges = tuple(MERGE_FN[l.reduce] for l in self.leaves)
-            finish = self.finish
-            project = projector.project
-
-            @jax.jit
-            def fire_proj(accs, slot_matrix, w):
-                valid = jnp.arange(slot_matrix.shape[0]) < w
-                merged = tuple(
-                    m(a[slot_matrix], axis=1) for a, m in zip(accs, merges)
-                )
-                return project(finish(merged), valid)
-
-            _JIT_CACHE[key] = fn = fire_proj
-        return fn
+        return _families.flat_segment_fire_projected(self, projector)
 
     @property
     def _gather_jit(self):
         """(accs, slots) -> per-leaf gathered values — the incremental-
         snapshot read path: only dirty slots leave the device instead of
         the whole [capacity] arrays (HBM->host bandwidth is the cost)."""
-        key = ("gather", tuple(l.dtype.str for l in self.leaves))
-        fn = _JIT_CACHE.get(key)
-        if fn is None:
-
-            @jax.jit
-            def gather(accs, slots):
-                return tuple(a[slots] for a in accs)
-
-            _JIT_CACHE[key] = fn = gather
-        return fn
+        return _families.flat_gather(self.leaves)
 
     @property
     def _merge_jit(self):
         """(accs, slot_matrix [w, k]) -> merged leaves [w] WITHOUT finish —
         the hybrid-fire read path: device-resident slices merge on device,
         spilled slices merge on host, finish runs on host over the union."""
-        key = ("merge", tuple(MERGE_FN[l.reduce].__name__
-                              for l in self.leaves),
-               tuple(l.dtype.str for l in self.leaves))
-        fn = _JIT_CACHE.get(key)
-        if fn is None:
-            merges = tuple(MERGE_FN[l.reduce] for l in self.leaves)
-
-            @jax.jit
-            def merge(accs, slot_matrix):
-                return tuple(
-                    m(a[slot_matrix], axis=1) for a, m in zip(accs, merges))
-
-            _JIT_CACHE[key] = fn = merge
-        return fn
+        return _families.flat_segment_merge(self.leaves)
 
     @property
     def _put_jit(self):
@@ -237,38 +159,11 @@ class AggregateFunction:
         the spill-reload write path: values gathered to host at eviction
         time are placed back verbatim (identity-masked at the reserved
         slot 0 pad target)."""
-        idents = tuple(l.identity for l in self.leaves)
-        key = ("put", idents, tuple(l.dtype.str for l in self.leaves))
-        fn = _JIT_CACHE.get(key)
-        if fn is None:
-
-            @partial(jax.jit, donate_argnums=(0,))
-            def put(accs, slots, values):
-                out = []
-                for a, v, i in zip(accs, values, idents):
-                    v = jnp.where(slots == 0, jnp.asarray(i, dtype=v.dtype),
-                                  v)
-                    out.append(a.at[slots].set(v))
-                return tuple(out)
-
-            _JIT_CACHE[key] = fn = put
-        return fn
+        return _families.flat_put(self.leaves)
 
     @property
     def _reset_jit(self):
-        idents = tuple(l.identity for l in self.leaves)
-        key = ("reset", idents, tuple(l.dtype.str for l in self.leaves))
-        fn = _JIT_CACHE.get(key)
-        if fn is None:
-
-            @partial(jax.jit, donate_argnums=(0,))
-            def reset(accs, slots):
-                return tuple(
-                    a.at[slots].set(i) for a, i in zip(accs, idents)
-                )
-
-            _JIT_CACHE[key] = fn = reset
-        return fn
+        return _families.flat_reset(self.leaves)
 
     # -- retraction (changelog) support -------------------------------------
 
@@ -309,20 +204,7 @@ class AggregateFunction:
         local/global split of MiniBatchLocalGroupAggFunction +
         MiniBatchGlobalGroupAggFunction). Pad lanes must carry each leaf's
         identity at the reserved slot 0."""
-        methods = tuple(SCATTER_METHOD[l.reduce] for l in self.leaves)
-        key = ("scatter_valued", methods,
-               tuple(l.dtype.str for l in self.leaves))
-        fn = _JIT_CACHE.get(key)
-        if fn is None:
-
-            @partial(jax.jit, donate_argnums=(0,))
-            def scatter_valued(accs, slots, values):
-                return tuple(
-                    getattr(a.at[slots], m)(v)
-                    for a, m, v in zip(accs, methods, values))
-
-            _JIT_CACHE[key] = fn = scatter_valued
-        return fn
+        return _families.flat_scatter_valued(self.leaves)
 
     @property
     def _scatter_signed_jit(self):
@@ -334,17 +216,7 @@ class AggregateFunction:
             raise TypeError(
                 f"{type(self).__name__} is not retractable (non-additive "
                 "accumulator leaf); an updating input cannot be folded")
-        key = ("scatter_signed", tuple(l.dtype.str for l in self.leaves))
-        fn = _JIT_CACHE.get(key)
-        if fn is None:
-
-            @partial(jax.jit, donate_argnums=(0,))
-            def scatter_signed(accs, slots, values):
-                return tuple(
-                    a.at[slots].add(v) for a, v in zip(accs, values))
-
-            _JIT_CACHE[key] = fn = scatter_signed
-        return fn
+        return _families.flat_scatter_signed(self.leaves)
 
     # -- convenience --------------------------------------------------------
 

@@ -23,42 +23,22 @@ end = last_event_ts + gap. Extensions/merges invalidate heap entries lazily
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Dict, List, Tuple
 
-import jax
 import numpy as np
 
 from flink_tpu.core.records import KEY_ID_FIELD, TIMESTAMP_FIELD, RecordBatch
-from flink_tpu.ops.segment_ops import SCATTER_METHOD, pad_bucket_size, pad_i32
+from flink_tpu.ops.segment_ops import pad_bucket_size, pad_i32
 from flink_tpu.state.slot_table import SlotTable
-from flink_tpu.windowing.aggregates import AggregateFunction, _JIT_CACHE
+from flink_tpu.stateplane import flat_merge_pairs
+from flink_tpu.windowing.aggregates import AggregateFunction
 from flink_tpu.windowing.session_meta import MergeGroup, make_session_meta
 from flink_tpu.windowing.windower import WINDOW_END_FIELD, WINDOW_START_FIELD
 
 
 def _merge_jit(agg: AggregateFunction):
     """acc[dst] op= acc[src] for arrays of (dst, src), then reset src slots."""
-    methods = tuple(SCATTER_METHOD[l.reduce] for l in agg.leaves)
-    idents = tuple(l.identity for l in agg.leaves)
-    key = ("session-merge", methods, idents,
-           tuple(l.dtype.str for l in agg.leaves))
-    fn = _JIT_CACHE.get(key)
-    if fn is None:
-
-        @partial(jax.jit, donate_argnums=(0,))
-        def merge(accs, dst, src):
-            out = []
-            for a, m, i in zip(accs, methods, idents):
-                moved = a[src]
-                a = getattr(a.at[dst], m)(moved)
-                # src != dst for real pairs; padded lanes have src == dst == 0
-                a = a.at[src].set(i)
-                out.append(a)
-            return tuple(out)
-
-        _JIT_CACHE[key] = fn = merge
-    return fn
+    return flat_merge_pairs(agg.leaves)
 
 
 class SessionWindower:

@@ -1,7 +1,8 @@
 """flint (tools/flint) — the TPU-tracing static analyzer — and the
 recompile sentinel (flink_tpu/observe).
 
-Covers: a failing fixture per rule (TRC01/TRC02/JIT01/REG01/REG02), the
+Covers: a failing fixture per rule (TRC01/TRC02/JIT01/REG01/REG02/REG04),
+the
 suppression protocol (reason mandatory), the clean-tree invariant
 (flint exits 0 over flink_tpu/ at HEAD — the same gate tools/tier1.sh
 runs), the sentinel's compile/transfer accounting, and the
@@ -279,6 +280,50 @@ class TestREG02MetricCounterRegistry:
         assert len(active) == 3
 
 
+# ------------------------------------------------------------------- REG04
+
+
+class TestREG04ProgramFamilyRegistry:
+    FILES = {
+        "flink_tpu/__init__.py": "",
+        "flink_tpu/stateplane/__init__.py": "",
+        "flink_tpu/stateplane/families.py": (
+            'KNOWN_PROGRAM_FAMILIES = ("gather", "stale-family")\n'
+        ),
+        "flink_tpu/mod.py": (
+            "from flink_tpu.tenancy.program_cache import PROGRAM_CACHE\n"
+            "\n"
+            "def build(key, builder):\n"
+            '    PROGRAM_CACHE.get_or_build("gather", key, builder)\n'
+            '    PROGRAM_CACHE.get_or_build("gahter", key, builder)\n'
+        ),
+    }
+
+    def test_typo_kind_and_stale_entry_trip(self, tmp_path):
+        active, _ = run_fixture(tmp_path, self.FILES, ["REG04"])
+        msgs = "\n".join(v.message for v in active)
+        assert "'gahter' is not in" in msgs
+        assert "'stale-family' has no" in msgs
+        assert len(active) == 2
+        # the typo points at the producing call site, not the registry
+        typo = next(v for v in active if "gahter" in v.message)
+        assert typo.path == "flink_tpu/mod.py"
+
+    def test_clean_inventory_passes(self, tmp_path):
+        files = dict(self.FILES)
+        files["flink_tpu/stateplane/families.py"] = \
+            'KNOWN_PROGRAM_FAMILIES = ("gather", "gahter")\n'
+        active, _ = run_fixture(tmp_path, files, ["REG04"])
+        assert active == []
+
+    def test_missing_registry_tuple_is_a_violation(self, tmp_path):
+        files = dict(self.FILES)
+        files["flink_tpu/stateplane/families.py"] = "def helper():\n    pass\n"
+        active, _ = run_fixture(tmp_path, files, ["REG04"])
+        assert len(active) == 1
+        assert "KNOWN_PROGRAM_FAMILIES" in active[0].message
+
+
 # ------------------------------------------------------------------- NAT01
 
 
@@ -416,8 +461,8 @@ class TestCleanTree:
         data = json.loads(report.read_text())
         assert rc == 0, data["violations"]
         assert data["violations"] == []
-        assert {"TRC01", "TRC02", "JIT01", "REG01", "REG02"} <= set(
-            data["rules"])
+        assert {"TRC01", "TRC02", "JIT01", "REG01", "REG02",
+                "REG04"} <= set(data["rules"])
         for s in data["suppressed"]:
             assert s["reason"], f"reasonless suppression: {s}"
 

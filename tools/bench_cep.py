@@ -82,13 +82,21 @@ def _pattern():
 def _drive(engine, total, seed):
     """Keyed batches at RATE ev/s of event time, a trailing-watermark
     fire after every batch, and a final drain fire. Returns (events,
-    matches, emit-latency samples, wall seconds)."""
+    matches, emit-latency samples, wall seconds, breakdown) with the
+    breakdown rolled up from this pass's flight-recorder spans (the
+    shared ``observe.export.span_rollup`` — same primitive as the
+    session and join rows, so the matrix attributes time the same
+    way everywhere)."""
     from flink_tpu.core.records import (
         KEY_ID_FIELD,
         TIMESTAMP_FIELD,
         RecordBatch,
     )
+    from flink_tpu.observe import flight_recorder as flight
 
+    rec = flight.recorder()
+    flight.set_job("bench_cep")
+    rec.clear()
     rng = np.random.default_rng(seed)
     events = matches = 0
     lat = []
@@ -120,7 +128,18 @@ def _drive(engine, total, seed):
     while wm < t:
         wm = min(wm + step, t)
         matches += sum(len(b) for b in engine.on_watermark(wm))
-    return events, matches, lat, time.perf_counter() - t0
+    dt = time.perf_counter() - t0
+    from flink_tpu.observe.export import span_rollup
+
+    # the CEP engine emits ingest/fire/harvest spans but no
+    # device.dispatch/fence pair (yet), so — like the join row — no
+    # host_prep_s line: report only what the spans attribute
+    breakdown = span_rollup(rec.kind_totals(), dt, {
+        "ingest_s": "batch.ingest",
+        "advance_fire_s": "fire.dispatch",
+        "harvest_s": "fire.harvest",
+    })
+    return events, matches, lat, dt, breakdown
 
 
 def bench_cep(scale=1.0, reps=None):
@@ -150,9 +169,9 @@ def bench_cep(scale=1.0, reps=None):
             eng = make(td)
             runs.append(_drive(eng, total, seed=3))
             spills.append(eng.spill_counters())
-    evps = [ev / dt for ev, _, _, dt in runs]
+    evps = [ev / dt for ev, _, _, dt, _ in runs]
     i = evps.index(_median(evps))
-    ev, matches, lat, dt = runs[i]
+    ev, matches, lat, dt, breakdown = runs[i]
     sp = spills[i]
     if matches == 0:
         raise RuntimeError("vacuous cep bench: zero matches")
@@ -165,7 +184,7 @@ def bench_cep(scale=1.0, reps=None):
     # per-key python NFA is the thing being beaten, not raced at 4M)
     host_total = min(total, 1 << 18)
     host = MeshCepEngine(_pattern(), backend="host")
-    hev, hmatches, _, hdt = _drive(host, host_total, seed=3)
+    hev, hmatches, _, hdt, _ = _drive(host, host_total, seed=3)
     host_evps = hev / hdt
     if hmatches == 0:
         raise RuntimeError("vacuous cep bench: host oracle emitted "
@@ -184,6 +203,7 @@ def bench_cep(scale=1.0, reps=None):
         "unit": "events/s",
         "matches": int(matches),
         "fire_latency_ms": _latency(lat),
+        "breakdown": breakdown,
         "spill": sp,
         "host_events_per_s": round(host_evps, 1),
         "speedup_vs_host": round(speedup, 2),

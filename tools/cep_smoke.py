@@ -19,6 +19,11 @@ FAILS on:
 - SERVING DIVERGENCE: matched-pattern lookups through the READ-REPLICA
   plane must agree with the live match-store probe on every key, and
   must return > 0 rows (vacuity guard on the queryable store).
+- FRONTEND DIVERGENCE: the same lookups through the MULTI-PROCESS
+  serving tier (shm hot cache + FrontendPool — GIL-free out-of-process
+  match reads via ``CepMatchServingAdapter``) must decode to the
+  identical row sets. Skipped LOUDLY when the native hotcache plane is
+  unavailable (no toolchain): the tier cannot exist without it.
 
     JAX_PLATFORMS=cpu python tools/cep_smoke.py
     CEP_SMOKE_STEPS=... CEP_SMOKE_BATCH=... to scale.
@@ -197,6 +202,9 @@ def main():
                         "diverges from the live probe")
             break
 
+    # ---- frontend tier: shm frontends == live match store ----
+    frontend_hits = _frontend_leg(mk, pat, steps, errs)
+
     result = {
         "cep_smoke": "ok" if not errs else "FAIL",
         "shards": P,
@@ -206,12 +214,68 @@ def main():
         "rows_reloaded": sc.get("rows_reloaded", 0),
         "steady_state_compiles": compiles,
         "match_rows_served": served,
+        "frontend_hits": frontend_hits,
         "seconds": round(time.perf_counter() - t0, 2),
     }
     print(json.dumps(result))
     for e in errs:
         print(f"FAIL: {e}", file=sys.stderr)
     return 1 if errs else 0
+
+
+def _frontend_leg(mk, pat, steps, errs):
+    """Matched-pattern lookups through the multi-process serving tier:
+    owner primes the shm hot cache via CepMatchServingAdapter, frontend
+    processes probe it over shared memory, and every decoded row set
+    must match the live ``query_match_batch`` probe bit-for-bit. The
+    second lookup round must hit the shm table (hits > 0): a packing
+    regression would silently turn every probe into an owner crossing.
+    Returns the frontend shm hit count (-1 = skipped)."""
+    import queue
+
+    from flink_tpu.cep.mesh_engine import CepMatchServingAdapter
+    from flink_tpu.tenancy.frontend import FrontendPool
+    from flink_tpu.tenancy.serving import ServingPlane
+
+    with tempfile.TemporaryDirectory() as td:
+        try:
+            plane = ServingPlane(shm_dir=os.path.join(td, "hc"))
+        except RuntimeError as e:
+            print("SKIP: frontend serving leg NOT RUN — native "
+                  f"hotcache plane unavailable ({e})", file=sys.stderr)
+            return -1
+        engine = mk(pat, "device")
+        adapter = engine.arm_match_replica(serving=True)
+        assert isinstance(adapter, CepMatchServingAdapter)
+        plane.bind_job("cep", queue.Queue())
+        plane.bind_replica("cep", "matches", adapter)
+        drive(engine, steps)
+        qkeys = np.arange(DENSE_KEYS, dtype=np.int64)
+        live = engine.query_match_batch(qkeys)
+        try:
+            with FrontendPool(plane, n_frontends=2) as pool:
+                pool.wait_ready()
+                # round 1 fills the shm table through the miss path;
+                # round 2 must serve out-of-process from shared memory
+                pool.lookup_batch("cep", "matches", qkeys.tolist())
+                got = pool.lookup_batch("cep", "matches",
+                                        qkeys.tolist())
+                stats = pool.fe_stats()
+            hits = int(sum(r.get("probes_hit", r.get("hits", 0))
+                           for r in stats))
+            for i in range(DENSE_KEYS):
+                if CepMatchServingAdapter.match_rows(got[i]) != live[i]:
+                    errs.append(
+                        f"frontend: decoded row set for key {i} "
+                        "diverges from the live probe")
+                    break
+            if hits == 0:
+                errs.append(
+                    "frontend: zero shm hits — every lookup crossed "
+                    "to the owner (match results stopped packing)")
+            return hits
+        finally:
+            plane.shutdown_workers()
 
 
 if __name__ == "__main__":

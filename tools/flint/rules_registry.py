@@ -1,11 +1,12 @@
-"""REG01 / REG02 / REG03 — the stringly-typed registry rules.
+"""REG01 / REG02 / REG03 / REG04 — the stringly-typed registry rules.
 
-The codebase carries four name registries that only stay consistent by
-convention: chaos fault points, spill counters, metric groups and
-flight-recorder span kinds. Each has ONE canonical tuple in the
-package; these rules statically cross-check every literal producer and
-consumer against it, so a typo on either side fails CI instead of
-silently never injecting / never reporting / never recording.
+The codebase carries five name registries that only stay consistent by
+convention: chaos fault points, spill counters, metric groups,
+flight-recorder span kinds and compiled program families. Each has ONE
+canonical tuple in the package; these rules statically cross-check
+every literal producer and consumer against it, so a typo on either
+side fails CI instead of silently never injecting / never reporting /
+never recording / never sharing an executable.
 """
 
 from __future__ import annotations
@@ -368,3 +369,71 @@ class SpanKindRegistry(Checker):
                         "flight.span/flight.instant call site in the "
                         "package — the instrumentation point went "
                         "stale")
+
+
+# --------------------------------------------------------------------- REG04
+
+_FAMILY_REGISTRY_FILE = "flink_tpu/stateplane/families.py"
+#: the cache's own module — its docstring/examples mention kinds without
+#: producing them
+_PROGRAM_CACHE_FILE = "flink_tpu/tenancy/program_cache.py"
+
+
+@register
+class ProgramFamilyRegistry(Checker):
+    rule = "REG04"
+    title = ("PROGRAM_CACHE family kinds cross-checked against "
+             "stateplane.KNOWN_PROGRAM_FAMILIES")
+
+    def check(self, project: Project) -> Iterator[Violation]:
+        reg_sf = project.get(_FAMILY_REGISTRY_FILE)
+        if reg_sf is None:
+            yield Violation(
+                rule=self.rule, path=_FAMILY_REGISTRY_FILE, line=1, col=0,
+                message="stateplane package not found — cannot check "
+                        "program families")
+            return
+        parsed = _string_tuple(reg_sf, "KNOWN_PROGRAM_FAMILIES")
+        if parsed is None:
+            yield Violation(
+                rule=self.rule, path=reg_sf.path, line=1, col=0,
+                message="no literal KNOWN_PROGRAM_FAMILIES tuple — the "
+                        "canonical program-family inventory must be a "
+                        "module-level string tuple here")
+            return
+        reg_line, names = parsed
+        known = set(names)
+        if len(names) != len(known):
+            yield Violation(
+                rule=self.rule, path=reg_sf.path, line=reg_line, col=0,
+                message="KNOWN_PROGRAM_FAMILIES contains duplicates")
+
+        # producers: every <cache>.get_or_build("kind", ...) call in the
+        # package whose first argument is a string literal
+        produced: Dict[str, List[Tuple[SourceFile, int, int]]] = {}
+        for sf in project.package_files("flink_tpu"):
+            if sf.tree is None or sf.path == _PROGRAM_CACHE_FILE:
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute) \
+                        and node.func.attr == "get_or_build":
+                    lit = _literal_call_arg(node)
+                    if lit is not None:
+                        produced.setdefault(lit, []).append(
+                            (sf, node.lineno, node.col_offset))
+        for name, sites in sorted(produced.items()):
+            if name not in known:
+                sf, line, col = sites[0]
+                yield Violation(
+                    rule=self.rule, path=sf.path, line=line, col=col,
+                    message=f"program family {name!r} is not in "
+                            "stateplane.KNOWN_PROGRAM_FAMILIES — add it "
+                            "to the inventory (and the README state-"
+                            "plane table) or fix the typo")
+        for name in sorted(known - set(produced)):
+            yield Violation(
+                rule=self.rule, path=reg_sf.path, line=reg_line, col=0,
+                message=f"KNOWN_PROGRAM_FAMILIES entry {name!r} has no "
+                        "PROGRAM_CACHE.get_or_build call site — the "
+                        "program family went stale")

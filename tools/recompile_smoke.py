@@ -235,6 +235,45 @@ def check_device_shuffle_tiers(mesh, budget):
     return ok
 
 
+def check_pallas_backend_phase(mesh, budget):
+    """Stateplane backend-swap phase: the same tier walk under
+    ``backend_scope("exchange-rank", "pallas")``. The pallas builders
+    tag their PROGRAM_CACHE keys with the backend, so the swap pays its
+    own warmup ONCE — after a warm engine walks the tier lattice in
+    pallas scope, a FRESH engine replaying SHIFTED sizes (still in
+    scope) must compile NOTHING. A backend hook that leaked into the
+    key unstably (per-engine closure, config object identity) or that
+    failed to key at all (silent retrace on every scope flip) shows up
+    here as a steady-state compile. Skips LOUDLY when the pallas kernel
+    is unavailable on this host."""
+    from flink_tpu.observe import RecompileSentinel
+    from flink_tpu.stateplane import backend_scope, pallas_available
+
+    if not pallas_available():
+        print("  pallas-backend tiers: SKIPPED — pallas kernel "
+              "unavailable on this host; the backend-swap "
+              "zero-recompile claim is NOT verified here")
+        return True
+    with backend_scope("exchange-rank", "pallas"):
+        warm_eng = _make_sessions(mesh, budget)
+        warm_fired = _drive_sized(warm_eng, TIER_WALK_WARM, offset=0)
+        warm_fired += _drive_sized(warm_eng, TIER_WALK_RUN,
+                                   offset=1 << 22)
+        ok = True
+        engine = _make_sessions(mesh, budget)
+        with RecompileSentinel(
+                max_compiles=0,
+                max_transfers=max(len(TIER_WALK_RUN) * 8, 64),
+                label="pallas-backend tier walk") as s:
+            fired = _drive_sized(engine, TIER_WALK_RUN, offset=1 << 23)
+    print(f"  pallas-backend tiers: fired={fired} "
+          f"compiles={s.compiles} transfers={s.transfers}")
+    if fired == 0 or warm_fired == 0:
+        print("FAIL: pallas-backend tiers: zero fires — vacuous run")
+        ok = False
+    return ok
+
+
 def check_two_level_exchange_tiers(mesh, budget):
     """Two-level (pod) exchange phase: a virtual (2, P/2) topology arms
     parallel/exchange2.py's stage-1/stage-2 program pair. After one
@@ -548,6 +587,12 @@ def main():
             mesh, budgets["mesh-sessions"]) and ok
     except Exception as e:  # SteadyStateViolation included
         print(f"FAIL: device-shuffle tiers: {e}")
+        ok = False
+    try:
+        ok = check_pallas_backend_phase(
+            mesh, budgets["mesh-sessions"]) and ok
+    except Exception as e:  # SteadyStateViolation included
+        print(f"FAIL: pallas-backend tiers: {e}")
         ok = False
     try:
         ok = check_two_level_exchange_tiers(

@@ -154,10 +154,26 @@ if [ "${SKIP_BENCH_SMOKE:-0}" != "1" ]; then
   # emission order) across both after-match skip strategies and a
   # forced-paged-eviction leg, on a steady-state XLA compile from a
   # FRESH engine on the warm program cache, on a vacuous run (zero
-  # matches, rows_evicted=0 or rows_reloaded=0), or on a replica-plane
-  # matched-pattern lookup diverging from the live store. ~5 s on CPU.
+  # matches, rows_evicted=0 or rows_reloaded=0), on a replica-plane
+  # matched-pattern lookup diverging from the live store, or on the
+  # frontend leg: the same lookups through the multi-process shm
+  # serving tier (CepMatchServingAdapter) must decode bit-identical
+  # with > 0 shm hits (skipped loudly without the native hotcache).
+  # ~10 s on CPU.
   JAX_PLATFORMS=cpu timeout -k 10 120 \
     python tools/cep_smoke.py || exit 1
+
+  # Pallas A/B gate: the stateplane's first Pallas kernel (the
+  # exchange-rank counting sort) vs the XLA one-hot-cumsum it
+  # replaces — FAILS on any bit divergence at the kernel level
+  # (random shapes incl. out-of-range/negative lanes), the cached-
+  # program level (xla and pallas keys must also be DISTINCT cache
+  # entries), or the engine level (device-mode session fires must be
+  # bit-identical IN ORDER across backends). Interpret mode on CPU;
+  # SKIPS LOUDLY (exit 0, unmistakable marker line) when the pallas
+  # kernel is unavailable on this host. ~20 s on CPU.
+  JAX_PLATFORMS=cpu timeout -k 10 300 \
+    python tools/pallas_ab_gate.py || exit 1
 
   # Multi-process smoke: 2 REAL CPU processes (jax.distributed + gloo
   # collectives), each owning half the key-group space, exchanging
@@ -181,7 +197,10 @@ if [ "${SKIP_BENCH_SMOKE:-0}" != "1" ]; then
   # padded shape varying per step fails here even though every
   # correctness test still passes. Includes the multi-tenant phase: a
   # SECOND job's fresh engines interleaved on the warm cluster (plus
-  # batched serving lookups) must also compile nothing. ~20 s on CPU.
+  # batched serving lookups) must also compile nothing, and the
+  # stateplane backend-swap phase: a fresh engine under the pallas
+  # exchange-rank backend on its own warm (backend-tagged) program
+  # keys must compile nothing either. ~25 s on CPU.
   JAX_PLATFORMS=cpu timeout -k 10 300 \
     python tools/recompile_smoke.py || exit 1
 
