@@ -269,13 +269,15 @@ class ChaosController:
             max_attempts=self.plan.retry_max_attempts)
 
     def counters(self) -> Dict[str, Any]:
-        return {
-            "faults_injected": dict(self.faults_injected),
-            "faults_injected_total": sum(self.faults_injected.values()),
-            "retries": self.retries,
-            "recoveries": self.recoveries,
-            "retry_budget_exhausted": self.budget_exhausted,
-        }
+        with self._lock:
+            return {
+                "faults_injected": dict(self.faults_injected),
+                "faults_injected_total":
+                    sum(self.faults_injected.values()),
+                "retries": self.retries,
+                "recoveries": self.recoveries,
+                "retry_budget_exhausted": self.budget_exhausted,
+            }
 
 
 #: THE process-global controller slot. None = disarmed; every fault

@@ -112,6 +112,9 @@ class FakeBroker:
         if not rows:
             return
         n_parts = num_partitions or max(self.partitions(topic), 1)
+        # flint: disable=LCK03 -- topics only grow: create_topic is
+        # idempotent-or-raise on a partition-count conflict, so a racing
+        # creator changes nothing this routing read depends on
         self.create_topic(topic, n_parts)
         buckets: List[List[dict]] = [[] for _ in range(n_parts)]
         for i, r in enumerate(rows):
@@ -124,6 +127,9 @@ class FakeBroker:
             cols = {k: np.asarray([r[k] for r in rs]) for k in rs[0]}
             ts = (np.asarray(cols[timestamp_field], dtype=np.int64)
                   if timestamp_field else None)
+            # flint: disable=LCK03 -- the partition count read above is
+            # only a routing hint; append() self-extends the partition
+            # list under its own hold, so a stale count cannot drop rows
             self.append(topic, p, RecordBatch.from_pydict(
                 {k: v for k, v in cols.items()}, timestamps=ts))
 

@@ -178,10 +178,11 @@ def get_filesystem(path: str) -> Tuple[FileSystem, str]:
         scheme, rest = path.split("://", 1)
         with _lock:
             fs = _registry.get(scheme)
+            known = sorted(_registry)
         if fs is None:
             raise ValueError(
                 f"no filesystem registered for scheme {scheme!r} "
-                f"(registered: {sorted(_registry)})")
+                f"(registered: {known})")
         return fs, rest
     with _lock:
         return _registry["file"], path

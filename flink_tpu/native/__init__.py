@@ -32,6 +32,14 @@ _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 #: chaos.KNOWN_FAULT_POINTS, applied to the C ABI)
 NATIVE_SYMBOL_PREFIXES = ("sm_", "sx_", "codec_", "ngen_", "hc_")
 
+#: hotcache symbols that MUTATE the arena — owner-side only. Frontends
+#: attach with hc_attach and are read-only by contract (the seqlock
+#: protects readers against a concurrent writer, not writer vs writer);
+#: flint's SHM01 statically forbids any of these in an attach-rooted
+#: scope. Keep this a plain literal tuple: flint parses it statically.
+HOTCACHE_WRITER_SYMBOLS = ("hc_put_batch", "hc_prime_batch", "hc_drop",
+                           "hc_clear", "hc_migrate", "hc_add_stat")
+
 #: the libraries build_all() compiles (source basename -> .so basename)
 NATIVE_LIBS = {
     "slotmap": ("slotmap.cpp", "_slotmap.so"),

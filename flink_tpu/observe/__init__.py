@@ -67,3 +67,9 @@ from flink_tpu.observe.flight_recorder import (  # noqa: E402,F401
     install_probes,
     recorder,
 )
+from flink_tpu.observe.lock_sentinel import (  # noqa: E402,F401
+    LockOrderViolation,
+    LockSentinel,
+    NamedLock,
+    named_lock,
+)

@@ -251,6 +251,9 @@ class FlightRecorder:
     # ------------------------------------------------------ ambient context
 
     def job_id(self, name: str) -> int:
+        # flint: disable=LCK01 -- deliberate double-checked fast path
+        # on the per-span hot path: entries are insert-only and the
+        # slow path re-checks under the lock before assigning
         jid = self._job_id.get(name)
         if jid is None:
             with self._lock:
@@ -283,6 +286,8 @@ class FlightRecorder:
         all records (oldest first is not guaranteed across the wrap —
         the sort restores global time order)."""
         out: List[SpanRecord] = []
+        with self._lock:
+            jobs = list(self._jobs)
         for ring in self._iter_rings():
             n = min(ring.cursor, ring.mask + 1)
             if n == 0:
@@ -294,8 +299,7 @@ class FlightRecorder:
                     kind=self.kinds[int(ring.kind[i])],
                     instant=bool(ring.flags[i]),
                     t0=float(ring.t0[i]), t1=float(ring.t1[i]),
-                    job=self._jobs[jid] if 0 <= jid < len(self._jobs)
-                    else None,
+                    job=jobs[jid] if 0 <= jid < len(jobs) else None,
                     shard=int(ring.shard[i]),
                     batch_id=int(ring.batch[i]),
                     watermark=None if wm == WM_NONE else wm,
@@ -371,12 +375,15 @@ _recorder_lock = threading.Lock()
 def recorder() -> FlightRecorder:
     """The process-global recorder (created on first use)."""
     global _recorder
+    # flint: disable=LCK01 -- double-checked publish of an immutable
+    # singleton slot; the slow path re-checks under the lock
     if _recorder is None:
         with _recorder_lock:
             if _recorder is None:
                 from flink_tpu.observe import KNOWN_SPAN_KINDS
 
                 _recorder = FlightRecorder(KNOWN_SPAN_KINDS)
+    # flint: disable=LCK01 -- read of the published immutable singleton
     return _recorder
 
 

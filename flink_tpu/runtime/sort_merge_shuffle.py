@@ -264,7 +264,9 @@ class SortMergeShuffleService(ShuffleService):
         pass
 
     def close(self) -> None:
-        for part in self._parts.values():
+        with self._lock:
+            parts = list(self._parts.values())
+        for part in parts:
             if not part.finished:
                 part.finish()
         if self._own_dir:

@@ -25,15 +25,16 @@ running XLA (a config typo must not vacuously pass an A/B experiment).
 from __future__ import annotations
 
 import contextlib
-import threading
 from typing import Dict, Optional
+
+from flink_tpu.observe.lock_sentinel import named_lock
 
 #: families with a real alternative implementation, by backend name
 _PALLAS_CAPABLE = ("exchange-rank",)
 
 _VALID_BACKENDS = ("xla", "pallas")
 
-_lock = threading.Lock()
+_lock = named_lock("stateplane.backends")
 _overrides: Dict[str, str] = {}
 
 _CONFIG_PREFIX = "stateplane.backend."
@@ -85,25 +86,41 @@ def backend_of(family: str) -> str:
         return _overrides.get(family, "xla")
 
 
+def _set_locked(family: str, backend: str) -> None:
+    """Install one override. Caller holds ``_lock``."""
+    if backend == "xla":
+        _overrides.pop(family, None)
+    else:
+        _overrides[family] = backend
+
+
 def set_backend(family: str, backend: str) -> None:
     """Process-scope override (the config hook calls through here)."""
     _validate(family, backend)
     with _lock:
-        if backend == "xla":
-            _overrides.pop(family, None)
-        else:
-            _overrides[family] = backend
+        _set_locked(family, backend)
 
 
 @contextlib.contextmanager
 def backend_scope(family: str, backend: str):
-    """Scoped override — the A/B gates swap backends under this."""
-    prev = backend_of(family)
-    set_backend(family, backend)
+    """Scoped override — the A/B gates swap backends under this.
+
+    Entry reads the previous value and installs the override under ONE
+    lock hold; exit restores under one hold and only after re-checking
+    that the override is still the one this scope installed. A
+    concurrent :func:`set_backend` mid-scope therefore wins and
+    survives the exit — the naive read/set/.../restore shape let the
+    exit silently clobber it (the check-then-act race LCK03 flags)."""
+    _validate(family, backend)
+    with _lock:
+        prev = _overrides.get(family, "xla")
+        _set_locked(family, backend)
     try:
         yield
     finally:
-        set_backend(family, prev)
+        with _lock:
+            if _overrides.get(family, "xla") == backend:
+                _set_locked(family, prev)
 
 
 def configure_backends(config) -> Dict[str, str]:

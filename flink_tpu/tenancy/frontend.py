@@ -53,6 +53,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from flink_tpu.observe.lock_sentinel import named_lock
+
 #: default seconds a dispatched request may wait before the frontend is
 #: declared dead and the request retries on a sibling
 REQUEST_TIMEOUT_S = 30.0
@@ -171,7 +173,7 @@ class _Frontend:
         self.miss = None
         #: one in-flight request per frontend (the bounded pipe): the
         #: lock serializes owner-side dispatchers onto it
-        self.lock = threading.Lock()
+        self.lock = named_lock("frontend.pipe")
         self.alive = False
         self.miss_thread = None
 

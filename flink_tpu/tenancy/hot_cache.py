@@ -18,9 +18,10 @@ The cached value is the composed result dict the operator's
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
+
+from flink_tpu.observe.lock_sentinel import named_lock
 
 
 class PrimeDelta:
@@ -115,7 +116,7 @@ class HotRowCache:
 
     def __init__(self, max_entries: int = 1 << 18) -> None:
         self.max_entries = int(max_entries)
-        self._lock = threading.Lock()
+        self._lock = named_lock("tenancy.hot_rows")
         self._entries: "OrderedDict[tuple, Tuple[int, Any]]" = \
             OrderedDict()
         #: counters read (under the lock) by the serving gauges and the
@@ -157,8 +158,8 @@ class HotRowCache:
         if hasattr(key_ids, "tolist"):  # ndarray: bulk-convert once
             key_ids = key_ids.tolist()
         hits = 0
-        entries = self._entries
         with self._lock:
+            entries = self._entries
             for i, kid in enumerate(key_ids):
                 k = (job, operator, kid)
                 ent = entries.get(k)

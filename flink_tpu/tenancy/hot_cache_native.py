@@ -234,7 +234,10 @@ class NativeHotRowCache:
         #: (a probe against the just-retired pointer reads migrated,
         #: still-alive data — bounded to one race window). RLock:
         #: _maybe_grow runs inside locked writer sections.
-        self._lock = threading.RLock()
+        # function-level import: keep the frontend child's spawn
+        # closure (FrontendCacheClient only) free of the observe plane
+        from flink_tpu.observe.lock_sentinel import named_lock
+        self._lock = named_lock("tenancy.native_cache", reentrant=True)
         self._closed = False
         #: per-thread probe scratch, one per column count (a thread
         #: alternating operators with different n_cols must not
@@ -463,6 +466,10 @@ class NativeHotRowCache:
         table missed (and only when the op ever routed values there).
         Interface and results identical to ``HotRowCache.get_many``."""
         opkey = (job, operator)
+        # flint: disable=LCK01 -- probes are deliberately lock-free
+        # (see the _lock docstring): a pointer read racing a growth
+        # swap probes the retired-but-alive table — bounded staleness,
+        # never corruption, and the publish path holds the lock
         tbl = self._tables.get(opkey)
         if tbl is None:
             return self._py.get_many(job, operator, key_ids, gen, out,
@@ -494,6 +501,9 @@ class NativeHotRowCache:
                             else il[pos]}
                         pos += 1
                     out[i] = res
+                # flint: disable=LCK01 -- _py_ops only ever grows; a
+                # stale negative read skips one overflow probe that
+                # could not have entries yet (lock-free probe path)
                 return hits if opkey not in self._py_ops else \
                     self._py_fallthrough(job, operator, gen, out,
                                          misses, exact, tbl, hits)
@@ -514,6 +524,8 @@ class NativeHotRowCache:
         else:
             for i in range(n):
                 misses.append((i, key_ids[i]))
+        # flint: disable=LCK01 -- _py_ops only ever grows; stale
+        # negative read is a skipped probe of a still-empty overflow
         if opkey in self._py_ops:
             return self._py_fallthrough(job, operator, gen, out,
                                         misses, exact, tbl, hits)
@@ -551,6 +563,9 @@ class NativeHotRowCache:
         — probe None when the op has no native table (caller takes the
         dict path)."""
         opkey = (job, operator)
+        # flint: disable=LCK01 -- lock-free probe path (see the _lock
+        # docstring): racing a growth swap reads the retired-but-alive
+        # table, bounded staleness only
         tbl = self._tables.get(opkey)
         if tbl is None:
             return 0, None
@@ -560,6 +575,8 @@ class NativeHotRowCache:
             for i in range(n):
                 if not hit_l[i]:
                     misses.append((i, key_ids[i]))
+            # flint: disable=LCK01 -- _py_ops only ever grows; stale
+            # negative read skips a probe of a still-empty overflow
             if opkey in self._py_ops:
                 hits = self._py_fallthrough(job, operator, gen, out,
                                             misses, exact, tbl, hits)
@@ -744,7 +761,8 @@ class NativeHotRowCache:
             tbl = self._tables.get((job, operator))
             if tbl is not None:
                 self._lib.hc_drop(tbl.ptr, int(key_id))
-        if (job, operator) in self._py_ops:
+            py = (job, operator) in self._py_ops
+        if py:
             self._py.drop(job, operator, key_id)
 
     def invalidate_op(self, job: str, operator: str) -> None:
@@ -763,9 +781,17 @@ class NativeHotRowCache:
 
     # ------------------------------------------------------------- metrics
 
+    def _tables_snapshot(self) -> List["_Table"]:
+        """Consistent list of live tables for metric scans: ``_tables``
+        mutates under ``_lock`` (bind/grow), so an unlocked iteration
+        can see the dict resize mid-walk. Counters stay monotonic
+        either side of a swap — only the LIST copy needs the lock."""
+        with self._lock:
+            return list(self._tables.values())
+
     def _sum_stat(self, which: int) -> int:
         return sum(int(self._lib.hc_stat(t.ptr, which))
-                   for t in self._tables.values())
+                   for t in self._tables_snapshot())
 
     @property
     def hits(self) -> int:
@@ -793,7 +819,7 @@ class NativeHotRowCache:
 
     def __len__(self) -> int:
         return (sum(int(self._lib.hc_len(t.ptr))
-                    for t in self._tables.values()) + len(self._py))
+                    for t in self._tables_snapshot()) + len(self._py))
 
     def hit_rate(self) -> float:
         h, m = self.hits, self.misses
@@ -809,7 +835,7 @@ class NativeHotRowCache:
             "hot_row_evictions": float(self.evictions),
             "hot_row_entries": float(len(self)),
             "hot_row_hit_rate": (h / total) if total else 0.0,
-            "hot_row_native_tables": float(len(self._tables)),
+            "hot_row_native_tables": float(len(self._tables_snapshot())),
             "hot_row_torn_retries": float(self.torn_retries),
             "hot_row_torn_misses": float(self.torn_misses),
             "hot_row_oversize_drops": float(
@@ -826,7 +852,7 @@ class NativeHotRowCache:
         ``HC_FE_STAT_NAMES`` keys. All-zero rows for unused slots."""
         rows = [dict.fromkeys(HC_FE_STAT_NAMES, 0)
                 for _ in range(int(n_frontends))]
-        for tbl in self._tables.values():
+        for tbl in self._tables_snapshot():
             for fe in range(len(rows)):
                 for which, name in enumerate(HC_FE_STAT_NAMES):
                     v = int(self._lib.hc_fe_stat(tbl.ptr, fe, which))

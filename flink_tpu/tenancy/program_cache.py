@@ -30,6 +30,8 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from flink_tpu.observe.lock_sentinel import named_lock
+
 
 class SharedProgramCache:
     """Process-global registry of compiled program families.
@@ -59,7 +61,7 @@ class SharedProgramCache:
         #: BUILDS run outside it behind a per-key once-latch (an XLA
         #: compile takes seconds — holding the cache lock across it
         #: would stall every other thread's unrelated cache hits)
-        self._lock = threading.RLock()
+        self._lock = named_lock("tenancy.program_cache", reentrant=True)
         #: key -> Event for builds in flight (see get_or_build)
         self._building: Dict[Tuple[str, Any], threading.Event] = {}
         #: job -> {"hits": n, "misses": n}
@@ -128,10 +130,17 @@ class SharedProgramCache:
         try:
             built = builder()
         except BaseException:
+            # flint: disable=LCK03 -- latch protocol: the thread that
+            # installed the latch above is its sole owner; no other
+            # thread deletes this key's latch, so the boundary is safe
             with self._lock:
                 del self._building[full]
             latch.set()
             raise
+        # flint: disable=LCK03 -- latch protocol: this thread won the
+        # builder election under the first hold and is the only writer
+        # of this key until it sets the latch; waiters re-probe in the
+        # while-loop, so the release boundary cannot lose an update
         with self._lock:
             self.programs[full] = built
             del self._building[full]

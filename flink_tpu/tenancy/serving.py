@@ -31,6 +31,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from flink_tpu.observe.lock_sentinel import named_lock
+
 
 def reservoir_p99_ms(latencies) -> float:
     """p99 of a latency reservoir (ms); 0.0 when empty. Pays the one
@@ -116,7 +118,7 @@ class LookupCoalescer:
         self._flush_fn = flush_fn
         self.max_batch = int(max_batch)
         self.window_s = float(window_ms) / 1000.0
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.coalescer")
         self._queue: deque = deque()
         self._flusher_active = False
         #: served lookups / flush batches (the amortization evidence)
@@ -163,6 +165,10 @@ class LookupCoalescer:
                         if len(self._queue) >= self.max_batch:
                             break
                     time.sleep(self.window_s / 4)
+            # flint: disable=LCK03 -- flusher-duty handoff: exactly one
+            # thread set _flusher_active under the first hold and owns
+            # the duty until _drain clears it under its own hold; late
+            # enqueuers see the flag and ride instead of flushing
             self._drain()
         if not entry.done.wait(timeout_s):
             raise TimeoutError("queryable-state lookup not served")
@@ -260,7 +266,7 @@ class CoalescerPool:
         self._max_batch = int(max_batch)
         self._window_ms = float(window_ms)
         self._members: Dict[Any, LookupCoalescer] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.pool")
         self._retired_lookups = 0
         self._retired_batches = 0
         self._retired_lat: deque = deque(maxlen=8192)
@@ -437,7 +443,7 @@ class _ReplicaWorker(threading.Thread):
     def __init__(self, plane: "ServingPlane", idx: int) -> None:
         super().__init__(name=f"serving-worker-{idx}", daemon=True)
         self._plane = plane
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.worker")
         self._queues: Dict[tuple, deque] = {}
         self._event = threading.Event()
         self._stopped = False
@@ -532,7 +538,7 @@ class ServingPlane:
         self.hot_cache = make_hot_row_cache(cache_entries,
                                             shm_dir=shm_dir)
         self._workers: List[_ReplicaWorker] = []
-        self._workers_lock = threading.Lock()
+        self._workers_lock = named_lock("serving.workers")
         #: sampled serving.cache_hit instants (1-in-N — a per-hit ring
         #: write at cache-hit QPS would itself cost a core fraction)
         self._hit_sample = 0

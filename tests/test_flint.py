@@ -1,11 +1,12 @@
 """flint (tools/flint) — the TPU-tracing static analyzer — and the
 recompile sentinel (flink_tpu/observe).
 
-Covers: a failing fixture per rule (TRC01/TRC02/JIT01/REG01/REG02/REG04),
-the
+Covers: a failing fixture per rule (TRC01/TRC02/JIT01/REG01/REG02/
+REG04/NAT01 and the r24 concurrency rules LCK01/LCK02/LCK03/SHM01), the
 suppression protocol (reason mandatory), the clean-tree invariant
 (flint exits 0 over flink_tpu/ at HEAD — the same gate tools/tier1.sh
-runs), the sentinel's compile/transfer accounting, and the
+runs), the --rule CLI filter + per-rule timings in the JSON report,
+the sentinel's compile/transfer accounting, and the
 slow-lane bookkeeping of the known-flaky unaligned-checkpoint timing
 test (deflake follow-up)."""
 
@@ -395,6 +396,326 @@ class TestNAT01NativeCtypesSignatures:
         assert active == []
 
 
+# ------------------------------------------------------------------- LCK01
+
+
+class TestLCK01GuardedFieldDiscipline:
+    FILES = {
+        "flink_tpu/__init__.py": "",
+        "flink_tpu/ledger.py": (
+            "import threading\n"
+            "\n"
+            "class Ledger:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.count = 0\n"
+            "\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self.count += 1\n"
+            "\n"
+            "    def bump_twice(self):\n"
+            "        with self._lock:\n"
+            "            self.count += 2\n"
+            "\n"
+            "    def peek(self):\n"
+            "        return self.count\n"
+        ),
+    }
+
+    def test_unguarded_read_of_majority_guarded_field_trips(
+            self, tmp_path):
+        active, _ = run_fixture(tmp_path, self.FILES, ["LCK01"])
+        assert [v.rule for v in active] == ["LCK01"]
+        assert "'self.count' is guarded by 'self._lock'" \
+            in active[0].message
+        assert "peek" in active[0].message
+
+    def test_guarded_everywhere_is_clean(self, tmp_path):
+        files = dict(self.FILES)
+        files["flink_tpu/ledger.py"] = files[
+            "flink_tpu/ledger.py"].replace(
+            "    def peek(self):\n"
+            "        return self.count\n",
+            "    def peek(self):\n"
+            "        with self._lock:\n"
+            "            return self.count\n")
+        active, _ = run_fixture(tmp_path, files, ["LCK01"])
+        assert active == []
+
+    def test_majority_tie_infers_no_guard(self, tmp_path):
+        # 1 of 2 write sites hold the lock: no strict majority, no
+        # inference, no violations — the rule must not guess
+        files = {
+            "flink_tpu/__init__.py": "",
+            "flink_tpu/ledger.py": (
+                "import threading\n"
+                "\n"
+                "class Half:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self.n = 0\n"
+                "\n"
+                "    def locked_write(self):\n"
+                "        with self._lock:\n"
+                "            self.n = 1\n"
+                "\n"
+                "    def bare_write(self):\n"
+                "        self.n = 2\n"
+            ),
+        }
+        active, _ = run_fixture(tmp_path, files, ["LCK01"])
+        assert active == []
+
+    def test_module_scope_globals_are_checked(self, tmp_path):
+        files = {
+            "flink_tpu/__init__.py": "",
+            "flink_tpu/reg.py": (
+                "import threading\n"
+                "\n"
+                "_lock = threading.Lock()\n"
+                "_registry = {}\n"
+                "\n"
+                "def put(k, v):\n"
+                "    with _lock:\n"
+                "        _registry[k] = v\n"
+                "\n"
+                "def drop(k):\n"
+                "    with _lock:\n"
+                "        _registry.pop(k, None)\n"
+                "\n"
+                "def peek():\n"
+                "    return sorted(_registry)\n"
+            ),
+        }
+        active, _ = run_fixture(tmp_path, files, ["LCK01"])
+        assert len(active) == 1
+        assert "_registry" in active[0].message
+        assert "peek" in active[0].message
+
+
+# ------------------------------------------------------------------- LCK02
+
+
+class TestLCK02LockOrderConsistency:
+    FILES = {
+        "flink_tpu/__init__.py": "",
+        "flink_tpu/pipe.py": (
+            "import threading\n"
+            "\n"
+            "class Pipeline:\n"
+            "    def __init__(self):\n"
+            "        self.a = threading.Lock()\n"
+            "        self.b = threading.Lock()\n"
+            "\n"
+            "    def forward(self):\n"
+            "        with self.a:\n"
+            "            with self.b:\n"
+            "                pass\n"
+            "\n"
+            "    def backward(self):\n"
+            "        with self.b:\n"
+            "            with self.a:\n"
+            "                pass\n"
+        ),
+    }
+
+    def test_ab_ba_cycle_trips_with_both_witnesses(self, tmp_path):
+        active, _ = run_fixture(tmp_path, self.FILES, ["LCK02"])
+        assert len(active) == 1
+        msg = active[0].message
+        assert "potential deadlock" in msg
+        assert "Pipeline.a" in msg and "Pipeline.b" in msg
+        # both legs of the cycle carry a witness site
+        assert msg.count("pipe.py") >= 2
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        files = dict(self.FILES)
+        files["flink_tpu/pipe.py"] = files["flink_tpu/pipe.py"].replace(
+            "    def backward(self):\n"
+            "        with self.b:\n"
+            "            with self.a:\n",
+            "    def backward(self):\n"
+            "        with self.a:\n"
+            "            with self.b:\n")
+        active, _ = run_fixture(tmp_path, files, ["LCK02"])
+        assert active == []
+
+    def test_cycle_through_a_call_edge_trips(self, tmp_path):
+        # the b->a leg hides behind a method call under the held lock
+        files = {
+            "flink_tpu/__init__.py": "",
+            "flink_tpu/pipe.py": (
+                "import threading\n"
+                "\n"
+                "class Pipeline:\n"
+                "    def __init__(self):\n"
+                "        self.a = threading.Lock()\n"
+                "        self.b = threading.Lock()\n"
+                "\n"
+                "    def forward(self):\n"
+                "        with self.a:\n"
+                "            with self.b:\n"
+                "                pass\n"
+                "\n"
+                "    def drain(self):\n"
+                "        with self.b:\n"
+                "            self._grab_a()\n"
+                "\n"
+                "    def _grab_a(self):\n"
+                "        with self.a:\n"
+                "            pass\n"
+            ),
+        }
+        active, _ = run_fixture(tmp_path, files, ["LCK02"])
+        assert len(active) == 1
+        assert "potential deadlock" in active[0].message
+
+
+# ------------------------------------------------------------------- LCK03
+
+
+class TestLCK03CheckThenAct:
+    FILES = {
+        "flink_tpu/__init__.py": "",
+        "flink_tpu/reg.py": (
+            "import threading\n"
+            "\n"
+            "class Registry:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._items = {}\n"
+            "\n"
+            "    def put_if_absent(self, k, v):\n"
+            "        with self._lock:\n"
+            "            missing = k not in self._items\n"
+            "        if missing:\n"
+            "            with self._lock:\n"
+            "                self._items[k] = v\n"
+        ),
+    }
+
+    def test_check_then_act_across_release_trips(self, tmp_path):
+        active, _ = run_fixture(tmp_path, self.FILES, ["LCK03"])
+        assert [v.rule for v in active] == ["LCK03"]
+        assert "_items" in active[0].message
+        assert "release" in active[0].message
+
+    def test_recheck_under_second_hold_is_exempt(self, tmp_path):
+        # the compare-and-restore / drain-loop idiom: the second region
+        # RE-READS the field under its own hold before acting — clean
+        files = dict(self.FILES)
+        files["flink_tpu/reg.py"] = files["flink_tpu/reg.py"].replace(
+            "        if missing:\n"
+            "            with self._lock:\n"
+            "                self._items[k] = v\n",
+            "        if missing:\n"
+            "            with self._lock:\n"
+            "                if k not in self._items:\n"
+            "                    self._items[k] = v\n")
+        active, _ = run_fixture(tmp_path, files, ["LCK03"])
+        assert active == []
+
+    def test_single_hold_is_clean(self, tmp_path):
+        files = dict(self.FILES)
+        files["flink_tpu/reg.py"] = (
+            "import threading\n"
+            "\n"
+            "class Registry:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._items = {}\n"
+            "\n"
+            "    def put_if_absent(self, k, v):\n"
+            "        with self._lock:\n"
+            "            if k not in self._items:\n"
+            "                self._items[k] = v\n"
+        )
+        active, _ = run_fixture(tmp_path, files, ["LCK03"])
+        assert active == []
+
+
+# ------------------------------------------------------------------- SHM01
+
+
+class TestSHM01AttachedHandleWriteDiscipline:
+    NATIVE = (
+        'NATIVE_SYMBOL_PREFIXES = ("hc_",)\n'
+        'HOTCACHE_WRITER_SYMBOLS = ("hc_put_batch", "hc_drop")\n'
+    )
+    FILES = {
+        "flink_tpu/__init__.py": "",
+        "flink_tpu/native/__init__.py": NATIVE,
+        "flink_tpu/fe.py": (
+            "class FrontendClient:\n"
+            "    def attach(self, lib, path):\n"
+            "        self.ptr = lib.hc_attach(path)\n"
+            "\n"
+            "    def corrupt(self, lib):\n"
+            "        lib.hc_put_batch(self.ptr)\n"
+        ),
+    }
+
+    def test_writer_symbol_in_attach_scope_trips(self, tmp_path):
+        active, _ = run_fixture(tmp_path, self.FILES, ["SHM01"])
+        assert [v.rule for v in active] == ["SHM01"]
+        assert "hc_put_batch" in active[0].message
+        assert active[0].path == "flink_tpu/fe.py"
+
+    def test_writer_in_owner_scope_is_clean(self, tmp_path):
+        files = dict(self.FILES)
+        files["flink_tpu/fe.py"] = (
+            "class OwnerCache:\n"
+            "    def prime(self, lib, ptr):\n"
+            "        lib.hc_put_batch(ptr)\n"
+        )
+        active, _ = run_fixture(tmp_path, files, ["SHM01"])
+        assert active == []
+
+    def test_missing_writer_registry_is_a_violation(self, tmp_path):
+        files = dict(self.FILES)
+        files["flink_tpu/native/__init__.py"] = \
+            'NATIVE_SYMBOL_PREFIXES = ("hc_",)\n'
+        active, _ = run_fixture(tmp_path, files, ["SHM01"])
+        assert any("HOTCACHE_WRITER_SYMBOLS" in v.message
+                   for v in active)
+
+
+# ------------------------------------------------------- conc suppressions
+
+
+class TestConcSuppressions:
+    def test_reasoned_lck01_suppression_silences(self, tmp_path):
+        files = dict(TestLCK01GuardedFieldDiscipline.FILES)
+        files["flink_tpu/ledger.py"] = files[
+            "flink_tpu/ledger.py"].replace(
+            "    def peek(self):\n"
+            "        return self.count\n",
+            "    def peek(self):\n"
+            "        # flint: disable=LCK01 -- fixture: approximate "
+            "gauge read\n"
+            "        return self.count\n")
+        active, suppressed = run_fixture(tmp_path, files,
+                                         ["LCK01", "SUP01"])
+        assert active == []
+        assert len(suppressed) == 1
+        assert suppressed[0].reason == "fixture: approximate gauge read"
+
+    def test_bare_lck03_suppression_still_fails_sup01(self, tmp_path):
+        files = dict(TestLCK03CheckThenAct.FILES)
+        files["flink_tpu/reg.py"] = files["flink_tpu/reg.py"].replace(
+            "        if missing:\n"
+            "            with self._lock:\n",
+            "        if missing:\n"
+            "            # flint: disable=LCK03\n"
+            "            with self._lock:\n")
+        active, suppressed = run_fixture(tmp_path, files,
+                                         ["LCK03", "SUP01"])
+        assert [v.rule for v in active] == ["SUP01"]
+        assert "without a reason" in active[0].message
+        assert len(suppressed) == 1
+
+
 # ------------------------------------------------------------- suppressions
 
 
@@ -461,10 +782,61 @@ class TestCleanTree:
         data = json.loads(report.read_text())
         assert rc == 0, data["violations"]
         assert data["violations"] == []
-        assert {"TRC01", "TRC02", "JIT01", "REG01", "REG02",
-                "REG04"} <= set(data["rules"])
+        assert {"TRC01", "TRC02", "JIT01", "REG01", "REG02", "REG04",
+                "LCK01", "LCK02", "LCK03", "SHM01"} <= set(data["rules"])
         for s in data["suppressed"]:
             assert s["reason"], f"reasonless suppression: {s}"
+
+    def test_rule_filter_and_per_rule_timings(self, tmp_path):
+        """--rule runs only the named rules and the JSON report carries
+        their wall time (the tier-1 guard on conc-rule cost bloat)."""
+        from tools.flint.cli import main
+
+        pkg = tmp_path / "flink_tpu"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "eng.py").write_text(
+            "import numpy as np\n"
+            "import threading\n"
+            "\n"
+            "class MeshWindowEngine:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.n = 0\n"
+            "    def process_batch(self, batch):\n"
+            "        out = self._gather_step(batch)\n"
+            "        return [np.asarray(g) for g in out]\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self.n += 1\n"
+            "    def bump2(self):\n"
+            "        with self._lock:\n"
+            "            self.n += 1\n"
+            "    def peek(self):\n"
+            "        return self.n\n", encoding="utf-8")
+        report = tmp_path / "r.json"
+        # only LCK01 selected: the TRC01 host sync must NOT surface
+        rc = main([str(pkg), "--rule", "LCK01", "--json", str(report)])
+        assert rc == 1
+        data = json.loads(report.read_text())
+        assert {v["rule"] for v in data["violations"]} == {"LCK01"}
+        assert set(data["rule_times_s"]) == {"LCK01"}
+        assert all(t >= 0 for t in data["rule_times_s"].values())
+        # repeatable + combines: both rules now surface
+        rc = main([str(pkg), "--rule", "LCK01", "--rule", "TRC01",
+                   "--json", str(report)])
+        assert rc == 1
+        data = json.loads(report.read_text())
+        assert {v["rule"] for v in data["violations"]} == \
+            {"LCK01", "TRC01"}
+        assert set(data["rule_times_s"]) == {"LCK01", "TRC01"}
+
+    def test_unknown_rule_flag_is_a_usage_error(self, capsys):
+        from tools.flint.cli import main
+
+        rc = main([str(REPO_ROOT / "flink_tpu"), "--rule", "NOPE99"])
+        assert rc == 2
+        assert "unknown rule" in capsys.readouterr().err
 
     def test_nonexistent_target_is_a_usage_error(self, capsys):
         """A typo'd path must exit 2 with a diagnostic, not traceback."""

@@ -1,0 +1,262 @@
+"""LockSentinel + named_lock (flink_tpu/observe/lock_sentinel) and the
+r24 thread-safety fix of the state-plane backend registry.
+
+Covers: the acquisition-order graph (cycle raised AND recorded, clean
+orders pass), reentrant re-acquisition recording no edge, the same-name
+two-instance nesting hazard, hold-budget and contention accounting, the
+no-sentinel fast path, and the backend registry's compare-and-restore
+scope exit under a concurrent ``set_backend`` (the lost-override race
+LCK01/LCK03 flagged before the fix)."""
+
+import threading
+import time
+
+import pytest
+
+from flink_tpu.observe.lock_sentinel import (
+    LockOrderViolation,
+    LockSentinel,
+    NamedLock,
+    current_sentinel,
+    named_lock,
+)
+
+
+class TestNamedLock:
+    def test_factory_returns_wrapper_with_name(self):
+        lk = named_lock("t.basic")
+        assert isinstance(lk, NamedLock)
+        assert lk.name == "t.basic"
+        assert not lk.reentrant
+
+    def test_plain_lock_semantics_without_sentinel(self):
+        assert current_sentinel() is None
+        lk = named_lock("t.plain")
+        with lk:
+            assert lk.locked()
+            assert not lk.acquire(blocking=False)
+        assert not lk.locked()
+
+    def test_reentrant_without_sentinel(self):
+        lk = named_lock("t.re0", reentrant=True)
+        with lk:
+            with lk:
+                assert lk.locked()
+        assert not lk.locked()
+
+
+class TestLockSentinel:
+    def test_cycle_raises_and_is_recorded(self):
+        a, b = named_lock("t.a"), named_lock("t.b")
+        s = LockSentinel()
+        with s:
+            with a:
+                with b:
+                    pass
+            with b:
+                with pytest.raises(LockOrderViolation,
+                                   match="lock order cycle"):
+                    with a:
+                        pass
+        assert len(s.cycles) == 1
+        assert set(s.cycles[0][0]) == {"t.a", "t.b"}
+        with pytest.raises(LockOrderViolation):
+            s.check()
+
+    def test_consistent_order_is_clean(self):
+        a, b = named_lock("t.c"), named_lock("t.d")
+        s = LockSentinel()
+        with s:
+            for _ in range(3):
+                with a:
+                    with b:
+                        pass
+        s.check()
+        assert s.cycles == []
+        assert s.edges == {"t.c": {"t.d"}}
+
+    def test_reentrant_reacquire_records_no_edge(self):
+        lk = named_lock("t.re", reentrant=True)
+        s = LockSentinel()
+        with s:
+            with lk:
+                with lk:
+                    pass
+        s.check()
+        assert s.edges == {}
+        assert s.stats["t.re"].acquisitions == 1  # one real acquire
+
+    def test_same_name_two_instances_nested_is_a_cycle(self):
+        # two objects, one name: undefined intra-name order — the ABBA
+        # hazard the 'staggered, never nested' discipline prevents
+        l1, l2 = named_lock("t.same"), named_lock("t.same")
+        s = LockSentinel()
+        with s:
+            with l1:
+                with pytest.raises(LockOrderViolation,
+                                   match="two instances"):
+                    with l2:
+                        pass
+        assert s.cycles
+
+    def test_hold_budget(self):
+        lk = named_lock("t.hold")
+        s = LockSentinel()
+        with s:
+            with lk:
+                time.sleep(0.05)
+        s.check()  # no budget: clean
+        with pytest.raises(LockOrderViolation, match="hold budget"):
+            s.check(hold_budget_s=0.01)
+        s.check(hold_budget_s=10.0)
+
+    def test_contention_is_counted(self):
+        lk = named_lock("t.cont")
+        s = LockSentinel()
+        entered = threading.Event()
+
+        def taker():
+            entered.wait(5)
+            with lk:
+                pass
+
+        with s:
+            t = threading.Thread(target=taker, daemon=True)
+            t.start()
+            with lk:
+                entered.set()
+                time.sleep(0.05)  # taker parks on the held lock
+            t.join(5)
+        assert s.stats["t.cont"].acquisitions == 2
+        assert s.stats["t.cont"].contended >= 1
+        assert s.contended_locks() == ["t.cont"]
+        assert s.stats["t.cont"].wait_s > 0
+
+    def test_report_shape(self):
+        a, b = named_lock("t.r1"), named_lock("t.r2")
+        s = LockSentinel()
+        with s:
+            with a:
+                with b:
+                    pass
+        rep = s.report()
+        assert set(rep["locks"]) == {"t.r1", "t.r2"}
+        assert rep["locks"]["t.r1"]["acquisitions"] == 1
+        assert rep["cycles"] == []
+        assert len(rep["edges"]) == 1
+        assert rep["edges"][0][:2] == ["t.r1", "t.r2"]
+        assert "t.r1@" in rep["edges"][0][2]  # witness carries the site
+
+    def test_second_install_rejected_and_uninstall_clears(self):
+        s1, s2 = LockSentinel(), LockSentinel()
+        with s1:
+            assert current_sentinel() is s1
+            with pytest.raises(RuntimeError, match="already installed"):
+                s2.install()
+        assert current_sentinel() is None
+        with s2:
+            assert current_sentinel() is s2
+
+
+class TestBackendRegistryThreadSafety:
+    """The r24 satellite: set_backend/backend_scope/configure_backends
+    share one module lock, and a scope exit must not clobber overrides
+    it did not install."""
+
+    def setup_method(self):
+        from flink_tpu.stateplane import backends
+
+        backends.set_backend("exchange-rank", "xla")
+
+    teardown_method = setup_method
+
+    def test_overlapping_scopes_leak_no_override(self):
+        """Two threads' scopes overlap, exiting in ENTER order. The
+        naive read/set/restore exit re-installed the second scope's
+        stale 'prev' (= the first scope's override) after BOTH scopes
+        closed; compare-and-restore leaves the default."""
+        from flink_tpu.stateplane.backends import (
+            backend_of,
+            backend_scope,
+        )
+
+        t1_in, t1_go, t1_out = (threading.Event() for _ in range(3))
+        t2_in, t2_go = threading.Event(), threading.Event()
+
+        def first():
+            with backend_scope("exchange-rank", "pallas"):
+                t1_in.set()
+                t1_go.wait(5)
+            t1_out.set()
+
+        def second():
+            t1_in.wait(5)
+            with backend_scope("exchange-rank", "pallas"):
+                t2_in.set()
+                t2_go.wait(5)
+
+        a = threading.Thread(target=first, daemon=True)
+        b = threading.Thread(target=second, daemon=True)
+        a.start()
+        b.start()
+        t2_in.wait(5)       # both scopes open
+        t1_go.set()         # first exits while second is still open
+        t1_out.wait(5)
+        t2_go.set()         # second exits last
+        a.join(5)
+        b.join(5)
+        assert backend_of("exchange-rank") == "xla"
+
+    def test_concurrent_set_backend_survives_scope_exit(self):
+        """A set_backend racing a scope's exit wins: the exit re-checks
+        that the installed override is still its own before restoring."""
+        from flink_tpu.stateplane.backends import (
+            backend_of,
+            backend_scope,
+            set_backend,
+        )
+
+        entered, release = threading.Event(), threading.Event()
+
+        def scoped():
+            with backend_scope("exchange-rank", "pallas"):
+                entered.set()
+                release.wait(5)
+
+        t = threading.Thread(target=scoped, daemon=True)
+        t.start()
+        entered.wait(5)
+        set_backend("exchange-rank", "xla")  # mid-scope override
+        release.set()
+        t.join(5)
+        # the exit saw the override was no longer its own and did NOT
+        # re-install its stale prev
+        assert backend_of("exchange-rank") == "xla"
+
+    def test_set_backend_churn_is_consistent(self):
+        """Two threads hammer set_backend; every read must be a valid
+        backend and the final state deterministic."""
+        from flink_tpu.stateplane.backends import (
+            backend_of,
+            set_backend,
+        )
+
+        bad = []
+
+        def churn(i):
+            for _ in range(300):
+                set_backend("exchange-rank",
+                            "pallas" if i % 2 == 0 else "xla")
+                got = backend_of("exchange-rank")
+                if got not in ("xla", "pallas"):
+                    bad.append(got)
+
+        threads = [threading.Thread(target=churn, args=(i,),
+                                    daemon=True) for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert bad == []
+        set_backend("exchange-rank", "xla")
+        assert backend_of("exchange-rank") == "xla"

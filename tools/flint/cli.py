@@ -16,6 +16,7 @@ import sys
 from pathlib import Path
 
 # import for side effect: checker registration
+from tools.flint import rules_conc  # noqa: F401
 from tools.flint import rules_native  # noqa: F401
 from tools.flint import rules_registry  # noqa: F401
 from tools.flint import rules_trace  # noqa: F401
@@ -55,6 +56,10 @@ def main(argv=None) -> int:
     ap.add_argument("--select", metavar="RULES",
                     help="comma-separated rule ids to run "
                          "(default: all)")
+    ap.add_argument("--rule", metavar="RULE", action="append",
+                    default=[],
+                    help="run only this rule (repeatable; combines "
+                         "with --select)")
     ap.add_argument("--fail-on-violation", action="store_true",
                     help="exit 1 when violations remain (the default; "
                          "spelled out for CI scripts)")
@@ -82,8 +87,10 @@ def main(argv=None) -> int:
         print(f"flint: no python files under {paths}", file=sys.stderr)
         return 2
     select = None
-    if args.select:
-        select = [r.strip() for r in args.select.split(",") if r.strip()]
+    if args.select or args.rule:
+        select = [r.strip() for r in (args.select or "").split(",")
+                  if r.strip()]
+        select += [r.strip() for r in args.rule if r.strip()]
         known = set(CHECKERS) | {"SUP01"}
         unknown = [r for r in select if r not in known]
         if unknown:
@@ -92,9 +99,11 @@ def main(argv=None) -> int:
             return 2
 
     project = Project(files, root)
-    active, suppressed = run_checks(project, select)
+    timings = {}
+    active, suppressed = run_checks(project, select, timings=timings)
     if args.json:
-        write_report(args.json, active, suppressed, len(files))
+        write_report(args.json, active, suppressed, len(files),
+                     timings=timings)
     print_human(active, suppressed, len(files), verbose=args.verbose)
     if active and not args.no_fail:
         return 1

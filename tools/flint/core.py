@@ -15,6 +15,7 @@ import dataclasses
 import json
 import re
 import sys
+import time
 from pathlib import Path
 from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
@@ -211,9 +212,12 @@ def discover(paths: Iterable[str], root: Path) -> List[SourceFile]:
 
 
 def run_checks(project: Project,
-               select: Optional[Iterable[str]] = None
+               select: Optional[Iterable[str]] = None,
+               timings: Optional[Dict[str, float]] = None
                ) -> Tuple[List[Violation], List[Violation]]:
-    """Returns (active_violations, suppressed_violations)."""
+    """Returns (active_violations, suppressed_violations). Pass a dict
+    as ``timings`` to get per-rule wall seconds back (the gate on the
+    conc rules' call-graph pass not silently bloating tier-1)."""
     active: List[Violation] = []
     suppressed: List[Violation] = []
 
@@ -228,6 +232,7 @@ def run_checks(project: Project,
     rules = sorted(CHECKERS) if select is None else [
         r for r in sorted(CHECKERS) if r in set(select)]
     for rule in rules:
+        t0 = time.monotonic()
         checker = CHECKERS[rule]()
         for v in checker.check(project):
             sf = project.get(v.path)
@@ -241,6 +246,8 @@ def run_checks(project: Project,
                 suppressed.append(v)
             else:
                 active.append(v)
+        if timings is not None:
+            timings[rule] = round(time.monotonic() - t0, 6)
 
     # the suppression protocol itself: every directive needs a reason,
     # and directives naming unknown rules are dead weight (typo guard)
@@ -271,12 +278,14 @@ SUP01_TITLE = ("suppression protocol: every '# flint: disable' needs "
 
 
 def write_report(path: str, active: List[Violation],
-                 suppressed: List[Violation], files: int) -> None:
+                 suppressed: List[Violation], files: int,
+                 timings: Optional[Dict[str, float]] = None) -> None:
     report = {
         "tool": "flint",
         "checked_files": files,
         "rules": {**{r: CHECKERS[r].title for r in sorted(CHECKERS)},
                   "SUP01": SUP01_TITLE},
+        "rule_times_s": dict(sorted((timings or {}).items())),
         "violations": [v.to_json() for v in active],
         "suppressed": [v.to_json() for v in suppressed],
     }

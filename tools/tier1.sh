@@ -239,4 +239,20 @@ if [ "${SKIP_BENCH_SMOKE:-0}" != "1" ]; then
   # ~15 s on CPU.
   JAX_PLATFORMS=cpu timeout -k 10 300 \
     python tools/frontend_smoke.py || exit 1
+
+  # Lock smoke: the runtime complement of the flint LCK rules — ONE
+  # LockSentinel observes every named_lock across a 2-job session
+  # cluster + lookup clients (+ the 2-process frontend pool when the
+  # native hotcache built), a backend_scope/set_backend churn on the
+  # stateplane backend registry, and a get_or_build race on the
+  # program cache's once-latch. FAILS on ANY observed lock-order
+  # cycle, on a single hold over 2 s (a lock held across a compile or
+  # device call — frontend.pipe's by-design IPC wait is exempt), on
+  # fewer than 2 DISTINCT locks actually contended (vacuity: the load
+  # must produce real cross-thread traffic on this 1-core box), or on
+  # any expected lock family showing zero acquisitions (a hot class
+  # reverting named_lock to the bare primitive disappears from the
+  # sentinel — the unguarded-hit regression). ~30 s on CPU.
+  JAX_PLATFORMS=cpu timeout -k 10 300 \
+    python tools/lock_smoke.py || exit 1
 fi
